@@ -1,0 +1,55 @@
+"""skypilot_tpu: a TPU-native infrastructure orchestrator.
+
+Public API mirrors the reference's ``sky/__init__.py:96-120`` re-exports:
+``Task``/``Resources``/``Dag`` plus lifecycle verbs (``launch``, ``exec_``,
+``status``, ``stop``, ``start``, ``down``, ``queue``, ``cancel``,
+``tail_logs``, ``autostop``).  Heavy modules are imported lazily so
+``import skypilot_tpu`` stays fast and works with no cloud SDKs installed
+(reference keeps the same property via ``sky/adaptors/``).
+"""
+from __future__ import annotations
+
+import typing
+
+__version__ = '0.1.0'
+
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+from skypilot_tpu import exceptions
+from skypilot_tpu import topology
+
+_LAZY_ATTRS = {
+    # lifecycle verbs live in execution/core (reference: execution.py:539,736;
+    # core.py:99-1460)
+    'launch': ('skypilot_tpu.execution', 'launch'),
+    'exec_': ('skypilot_tpu.execution', 'exec_'),
+    'status': ('skypilot_tpu.core', 'status'),
+    'start': ('skypilot_tpu.core', 'start'),
+    'stop': ('skypilot_tpu.core', 'stop'),
+    'down': ('skypilot_tpu.core', 'down'),
+    'autostop': ('skypilot_tpu.core', 'autostop'),
+    'queue': ('skypilot_tpu.core', 'queue'),
+    'cancel': ('skypilot_tpu.core', 'cancel'),
+    'tail_logs': ('skypilot_tpu.core', 'tail_logs'),
+    'job_status': ('skypilot_tpu.core', 'job_status'),
+    'optimize': ('skypilot_tpu.optimizer', 'optimize'),
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY_ATTRS:
+        import importlib
+        module_name, attr = _LAZY_ATTRS[name]
+        module = importlib.import_module(module_name)
+        value = getattr(module, attr)
+        globals()[name] = value
+        return value
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')
+
+
+# __all__ lists only eagerly-importable names so `from skypilot_tpu import *`
+# never trips on a lazy module; the lifecycle verbs resolve via __getattr__.
+__all__ = [
+    'Dag', 'Resources', 'Task', 'exceptions', 'topology', '__version__',
+]

@@ -1,0 +1,25 @@
+"""Catalog package: per-cloud pricing/topology/instance-type data.
+
+Reference analog: ``sky/catalog/`` (10,549 LoC; dispatch in
+``catalog/__init__.py``).  Queries route to per-cloud modules by cloud name.
+"""
+from __future__ import annotations
+
+import importlib
+from typing import Optional
+
+_CLOUD_MODULES = {
+    'gcp': 'skypilot_tpu.catalog.gcp_catalog',
+}
+
+
+def get_module(cloud: str):
+    cloud = cloud.lower()
+    if cloud not in _CLOUD_MODULES:
+        raise ValueError(f'No catalog for cloud {cloud!r}')
+    return importlib.import_module(_CLOUD_MODULES[cloud])
+
+
+def list_accelerators(cloud: str = 'gcp', name_filter: Optional[str] = None,
+                      region_filter: Optional[str] = None):
+    return get_module(cloud).list_accelerators(name_filter, region_filter)

@@ -1,0 +1,76 @@
+"""Catalog infrastructure: lazy CSV loading + query helpers.
+
+Reference analog: ``sky/catalog/common.py`` (``LazyDataFrame`` at ``:124``,
+``read_catalog`` at ``:165``, query impls at ``:478,548``).  Catalogs are
+plain CSVs committed under ``skypilot_tpu/catalog/data/``; a user-writable
+override dir (``~/.skypilot_tpu/catalogs/``) takes precedence so refreshed
+pricing can be dropped in without reinstalling.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Dict, List, Optional
+
+import pandas as pd
+
+_PACKAGE_DATA_DIR = os.path.join(os.path.dirname(__file__), 'data')
+_OVERRIDE_DIR = os.path.expanduser('~/.skypilot_tpu/catalogs')
+
+
+def catalog_path(filename: str) -> str:
+    override = os.path.join(_OVERRIDE_DIR, filename)
+    if os.path.exists(override):
+        return override
+    return os.path.join(_PACKAGE_DATA_DIR, filename)
+
+
+class LazyDataFrame:
+    """Loads a catalog CSV on first access; thread-safe; reload on mtime bump."""
+
+    def __init__(self, filename: str):
+        self._filename = filename
+        self._df: Optional[pd.DataFrame] = None
+        self._mtime: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def _load(self) -> pd.DataFrame:
+        path = catalog_path(self._filename)
+        with self._lock:
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError as e:
+                raise FileNotFoundError(
+                    f'Catalog file missing: {path}. Run '
+                    f'`python -m skypilot_tpu.catalog.data_fetchers.fetch_gcp_tpu` '
+                    'to regenerate.') from e
+            if self._df is None or mtime != self._mtime:
+                self._df = pd.read_csv(path)
+                self._mtime = mtime
+            return self._df
+
+    @property
+    def df(self) -> pd.DataFrame:
+        return self._load()
+
+    def __getattr__(self, name: str):
+        return getattr(self._load(), name)
+
+    def __getitem__(self, key):
+        return self._load()[key]
+
+
+def filter_df(df: pd.DataFrame, **equals) -> pd.DataFrame:
+    for col, val in equals.items():
+        if val is None:
+            continue
+        df = df[df[col] == val]
+    return df
+
+
+def cheapest_row(df: pd.DataFrame, use_spot: bool) -> Optional[pd.Series]:
+    col = 'SpotPrice' if use_spot else 'Price'
+    df = df[df[col].notna()]
+    if df.empty:
+        return None
+    return df.loc[df[col].idxmin()]

@@ -1,0 +1,142 @@
+"""Generate the GCP TPU + VM catalog CSVs.
+
+Reference analog: ``sky/catalog/data_fetchers/fetch_gcp.py`` — which crawls
+the GCP pricing API but *hardcodes* the TPU pod-slice price tables for v2-v6e
+(``fetch_gcp.py:34-90``) because TPU pricing has no public API.  We keep the
+same structure: per-chip-hour base prices + per-region multipliers, expanded
+over the valid slice-size table from :mod:`skypilot_tpu.topology`.
+
+Run ``python -m skypilot_tpu.catalog.data_fetchers.fetch_gcp_tpu`` to
+regenerate ``skypilot_tpu/catalog/data/gcp/{tpus,vms}.csv``.  In an
+environment with network + credentials this is where a live pricing crawl
+would slot in; prices below are public list prices (us-central-class regions,
+USD/chip-hour) and are configuration data, not measurements.
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import Dict, List, Tuple
+
+from skypilot_tpu import topology
+
+OUT_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), 'data', 'gcp')
+
+# USD per chip-hour, on-demand / spot.
+TPU_CHIP_HOUR_PRICES: Dict[str, Tuple[float, float]] = {
+    'v2': (1.125, 0.3375),
+    'v3': (2.00, 0.60),
+    'v4': (3.22, 1.127),
+    'v5e': (1.20, 0.48),
+    'v5p': (4.20, 1.68),
+    'v6e': (2.70, 1.08),
+}
+
+# Zones offering each generation, with a regional price multiplier.
+TPU_ZONES: Dict[str, List[Tuple[str, float]]] = {
+    'v2': [('us-central1-b', 1.0), ('us-central1-c', 1.0),
+           ('europe-west4-a', 1.096), ('asia-east1-c', 1.17)],
+    'v3': [('us-central1-a', 1.0), ('europe-west4-a', 1.10)],
+    'v4': [('us-central2-b', 1.0)],
+    'v5e': [('us-west4-a', 1.0), ('us-east1-c', 1.0), ('us-east5-a', 1.0),
+            ('us-south1-a', 1.0), ('europe-west4-b', 1.096),
+            ('asia-southeast1-b', 1.17)],
+    'v5p': [('us-east5-a', 1.0), ('us-central1-a', 1.0),
+            ('europe-west4-b', 1.10)],
+    'v6e': [('us-east5-b', 1.0), ('us-east1-d', 1.0),
+            ('us-central2-b', 1.0), ('europe-west4-a', 1.096),
+            ('asia-northeast1-b', 1.17)],
+}
+
+# Max slice size offered per zone (big slices only exist in flagship zones).
+ZONE_MAX_CHIPS: Dict[str, int] = {
+    'asia-east1-c': 128,
+    'asia-southeast1-b': 64,
+    'asia-northeast1-b': 32,
+    'europe-west4-a': 512,
+    'europe-west4-b': 256,
+}
+
+# VM shapes for CPU tasks and as a sanity floor for the optimizer.
+VM_SHAPES: List[Tuple[str, int, float]] = [
+    ('e2-standard-2', 2, 8), ('e2-standard-4', 4, 16), ('e2-standard-8', 8, 32),
+    ('n2-standard-2', 2, 8), ('n2-standard-4', 4, 16), ('n2-standard-8', 8, 32),
+    ('n2-standard-16', 16, 64), ('n2-standard-32', 32, 128),
+    ('n2-standard-64', 64, 256),
+    ('n2-highmem-8', 8, 64), ('n2-highmem-16', 16, 128),
+]
+VM_REGIONS: List[Tuple[str, float]] = [
+    ('us-central1', 1.0), ('us-central2', 1.0), ('us-east1', 1.0),
+    ('us-east5', 1.0), ('us-west4', 1.0), ('us-south1', 1.0),
+    ('europe-west4', 1.10), ('asia-east1', 1.17),
+    ('asia-southeast1', 1.17), ('asia-northeast1', 1.17),
+]
+_N2_VCPU_HR, _N2_GB_HR = 0.048553, 0.006511
+_E2_VCPU_HR, _E2_GB_HR = 0.033577, 0.004501
+
+
+def generate_tpu_rows() -> List[dict]:
+    rows = []
+    for gen_name, zones in TPU_ZONES.items():
+        base, spot_base = TPU_CHIP_HOUR_PRICES[gen_name]
+        for chips in sorted(topology.VALID_CHIP_COUNTS[gen_name]):
+            sl = topology.parse_accelerator(
+                f'tpu-{gen_name}-'
+                f'{chips * 2 if topology.GENERATIONS[gen_name].suffix_counts_cores else chips}')
+            assert sl is not None
+            for zone, mult in zones:
+                if chips > ZONE_MAX_CHIPS.get(zone, 10**9):
+                    continue
+                region = zone.rsplit('-', 1)[0]
+                rows.append({
+                    'AcceleratorName': sl.name,
+                    'Generation': gen_name,
+                    'Chips': sl.chips,
+                    'Hosts': sl.hosts,
+                    'Topology': sl.topology_str,
+                    'Region': region,
+                    'AvailabilityZone': zone,
+                    'Price': round(base * chips * mult, 4),
+                    'SpotPrice': round(spot_base * chips * mult, 4),
+                })
+    return rows
+
+
+def generate_vm_rows() -> List[dict]:
+    rows = []
+    for name, vcpus, mem in VM_SHAPES:
+        vcpu_hr, gb_hr = (_E2_VCPU_HR, _E2_GB_HR) if name.startswith('e2') \
+            else (_N2_VCPU_HR, _N2_GB_HR)
+        base = vcpus * vcpu_hr + mem * gb_hr
+        for region, mult in VM_REGIONS:
+            for suffix in ('a', 'b'):
+                rows.append({
+                    'InstanceType': name,
+                    'vCPUs': vcpus,
+                    'MemoryGiB': mem,
+                    'Region': region,
+                    'AvailabilityZone': f'{region}-{suffix}',
+                    'Price': round(base * mult, 6),
+                    'SpotPrice': round(base * mult * 0.3, 6),
+                })
+    return rows
+
+
+def _write(path: str, rows: List[dict]) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+        writer.writeheader()
+        writer.writerows(rows)
+
+
+def main() -> None:
+    tpus = generate_tpu_rows()
+    vms = generate_vm_rows()
+    _write(os.path.join(OUT_DIR, 'tpus.csv'), tpus)
+    _write(os.path.join(OUT_DIR, 'vms.csv'), vms)
+    print(f'Wrote {len(tpus)} TPU rows, {len(vms)} VM rows to {OUT_DIR}')
+
+
+if __name__ == '__main__':
+    main()

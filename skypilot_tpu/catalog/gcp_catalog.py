@@ -1,0 +1,124 @@
+"""GCP catalog queries: TPU slices + CPU VMs.
+
+Reference analog: ``sky/catalog/gcp_catalog.py`` (TPU-specific filtering at
+``:476-556,606``) — but TPU rows here carry full topology columns (Hosts,
+Topology) so the optimizer/provisioner never re-derive slice shape.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import pandas as pd
+
+from skypilot_tpu import topology
+from skypilot_tpu.catalog import common
+
+_tpu_df = common.LazyDataFrame('gcp/tpus.csv')
+_vm_df = common.LazyDataFrame('gcp/vms.csv')
+
+# TPU-VM host machine specs (vCPUs/memory come with the slice, not chosen):
+# reference handles this quirk at ``sky/clouds/gcp.py:739-768``.
+TPU_VM_HOST_SPECS: Dict[str, Tuple[int, int]] = {
+    'v2': (96, 334), 'v3': (96, 334), 'v4': (240, 407),
+    'v5e': (112, 192), 'v5p': (208, 448), 'v6e': (180, 720),
+}
+
+
+def list_accelerators(
+        name_filter: Optional[str] = None,
+        region_filter: Optional[str] = None) -> pd.DataFrame:
+    df = _tpu_df.df
+    if name_filter:
+        df = df[df['AcceleratorName'].str.contains(name_filter, regex=False)]
+    if region_filter:
+        df = df[df['Region'] == region_filter]
+    return df
+
+
+def get_tpu_offerings(
+        acc_name: str,
+        region: Optional[str] = None,
+        zone: Optional[str] = None,
+        use_spot: bool = False) -> List[dict]:
+    """All (region, zone, price) rows for a slice name, cheapest first."""
+    df = common.filter_df(_tpu_df.df, AcceleratorName=acc_name,
+                          Region=region, AvailabilityZone=zone)
+    col = 'SpotPrice' if use_spot else 'Price'
+    df = df[df[col].notna()].sort_values(col)
+    return df.to_dict('records')
+
+
+def get_tpu_price(acc_name: str, region: str, use_spot: bool) -> Optional[float]:
+    rows = get_tpu_offerings(acc_name, region=region, use_spot=use_spot)
+    if not rows:
+        return None
+    return rows[0]['SpotPrice' if use_spot else 'Price']
+
+
+def get_instance_type_for_cpus(
+        cpus: Optional[float], cpus_at_least: bool,
+        memory: Optional[float], memory_at_least: bool,
+        region: Optional[str] = None,
+        use_spot: bool = False) -> Optional[dict]:
+    """Smallest/cheapest VM satisfying a cpus/memory request
+    (reference: ``catalog/common.py:478`` get_instance_type_for_cpus_mem_impl).
+    Defaults to 4+ vCPUs when unspecified, like the reference."""
+    df = _vm_df.df
+    if region:
+        df = df[df['Region'] == region]
+    want_cpus = cpus if cpus is not None else 4.0
+    if cpus_at_least or cpus is None:
+        df = df[df['vCPUs'] >= want_cpus]
+    else:
+        df = df[df['vCPUs'] == want_cpus]
+    if memory is not None:
+        if memory_at_least:
+            df = df[df['MemoryGiB'] >= memory]
+        else:
+            df = df[df['MemoryGiB'] == memory]
+    row = common.cheapest_row(df, use_spot)
+    return None if row is None else row.to_dict()
+
+
+def get_vm_offerings(instance_type: str, region: Optional[str] = None,
+                     zone: Optional[str] = None,
+                     use_spot: bool = False) -> List[dict]:
+    df = common.filter_df(_vm_df.df, InstanceType=instance_type,
+                          Region=region, AvailabilityZone=zone)
+    col = 'SpotPrice' if use_spot else 'Price'
+    df = df[df[col].notna()].sort_values(col)
+    return df.to_dict('records')
+
+
+def instance_type_exists(instance_type: str) -> bool:
+    return bool((_vm_df.df['InstanceType'] == instance_type).any())
+
+
+def get_vcpus_mem_from_instance_type(
+        instance_type: str) -> Tuple[Optional[float], Optional[float]]:
+    df = _vm_df.df
+    rows = df[df['InstanceType'] == instance_type]
+    if rows.empty:
+        return None, None
+    r = rows.iloc[0]
+    return float(r['vCPUs']), float(r['MemoryGiB'])
+
+
+def validate_region_zone(
+        region: Optional[str],
+        zone: Optional[str]) -> Tuple[Optional[str], Optional[str]]:
+    df = pd.concat([
+        _tpu_df.df[['Region', 'AvailabilityZone']],
+        _vm_df.df[['Region', 'AvailabilityZone']],
+    ])
+    if region is not None and not (df['Region'] == region).any():
+        raise ValueError(f'Unknown GCP region {region!r}')
+    if zone is not None:
+        rows = df[df['AvailabilityZone'] == zone]
+        if rows.empty:
+            raise ValueError(f'Unknown GCP zone {zone!r}')
+        inferred = rows.iloc[0]['Region']
+        if region is not None and inferred != region:
+            raise ValueError(f'Zone {zone!r} is not in region {region!r}')
+        region = inferred
+    return region, zone

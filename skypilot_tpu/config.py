@@ -1,0 +1,126 @@
+"""Layered configuration system.
+
+Reference analog: ``sky/skypilot_config.py`` (946 LoC) + deep-merge in
+``sky/utils/config_utils.py``.  Same override chain, lowest to highest
+precedence:
+
+  1. server/global config   ``~/.skypilot_tpu/config.yaml``
+  2. project config         ``./.skytpu.yaml``
+  3. task-YAML ``config:`` block
+  4. in-process overrides (``override_config`` context manager)
+
+Accessors use dotted paths: ``config.get_nested(('gcp', 'project_id'), None)``.
+"""
+from __future__ import annotations
+
+import contextlib
+import copy
+import os
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.utils import common_utils
+
+GLOBAL_CONFIG_PATH = '~/.skypilot_tpu/config.yaml'
+PROJECT_CONFIG_PATH = '.skytpu.yaml'
+ENV_VAR_CONFIG_PATH = 'SKYTPU_CONFIG'
+
+_local = threading.local()
+
+
+def deep_merge(base: Dict[str, Any], override: Dict[str, Any]) -> Dict[str, Any]:
+    """Recursive dict merge; override wins; lists are replaced not appended."""
+    out = copy.deepcopy(base)
+    for k, v in override.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = deep_merge(out[k], v)
+        else:
+            out[k] = copy.deepcopy(v)
+    return out
+
+
+def _load_file(path: str) -> Dict[str, Any]:
+    path = os.path.expanduser(path)
+    if not os.path.exists(path):
+        return {}
+    try:
+        return common_utils.read_yaml(path)
+    except Exception as e:  # noqa: BLE001 — config errors must not crash import
+        import logging
+        logging.getLogger(__name__).warning('Failed to load config %s: %s',
+                                            path, e)
+        return {}
+
+
+_base_cache_lock = threading.Lock()
+_base_cache: Optional[Tuple[tuple, Dict[str, Any]]] = None  # (stamp, config)
+
+
+def _config_paths() -> List[str]:
+    env_path = os.environ.get(ENV_VAR_CONFIG_PATH)
+    return [GLOBAL_CONFIG_PATH, PROJECT_CONFIG_PATH] + (
+        [env_path] if env_path else [])
+
+
+def _base_config() -> Dict[str, Any]:
+    """Merged file-backed config, cached on file mtimes (same staleness
+    pattern as catalog LazyDataFrame) so hot loops don't re-parse YAML."""
+    global _base_cache
+    stamp = []
+    for path in _config_paths():
+        p = os.path.expanduser(path)
+        try:
+            stamp.append((p, os.path.getmtime(p)))
+        except OSError:
+            stamp.append((p, None))
+    stamp = tuple(stamp)
+    with _base_cache_lock:
+        if _base_cache is not None and _base_cache[0] == stamp:
+            return _base_cache[1]
+    cfg: Dict[str, Any] = {}
+    for path in _config_paths():
+        cfg = deep_merge(cfg, _load_file(path))
+    with _base_cache_lock:
+        _base_cache = (stamp, cfg)
+    return cfg
+
+
+def _overrides() -> List[Dict[str, Any]]:
+    if not hasattr(_local, 'overrides'):
+        _local.overrides = []
+    return _local.overrides
+
+
+def to_dict() -> Dict[str, Any]:
+    cfg = _base_config()
+    for o in _overrides():
+        cfg = deep_merge(cfg, o)
+    return cfg
+
+
+def get_nested(keys: Tuple[str, ...], default: Any = None,
+               override_configs: Optional[Dict[str, Any]] = None) -> Any:
+    cfg = to_dict()
+    if override_configs:
+        cfg = deep_merge(cfg, override_configs)
+    cur: Any = cfg
+    for k in keys:
+        if not isinstance(cur, dict) or k not in cur:
+            return default
+        cur = cur[k]
+    return cur
+
+
+@contextlib.contextmanager
+def override_config(config: Dict[str, Any]) -> Iterator[None]:
+    """Task-level ``config:`` blocks and admin policies push overrides here."""
+    _overrides().append(config or {})
+    try:
+        yield
+    finally:
+        _overrides().pop()
+
+
+def loaded_config_path() -> Optional[str]:
+    p = os.path.expanduser(GLOBAL_CONFIG_PATH)
+    return p if os.path.exists(p) else None

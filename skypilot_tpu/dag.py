@@ -1,0 +1,86 @@
+"""DAG of tasks (reference analog: ``sky/dag.py``, 128 LoC — networkx graph,
+chain detection, thread-local current-dag context)."""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import networkx as nx
+
+
+class Dag:
+    """A DAG of Tasks. ``with Dag() as d: ... a >> b`` builds edges."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name
+        self.graph = nx.DiGraph()
+        self.tasks: List = []
+
+    def add(self, task) -> None:
+        if task not in self.tasks:
+            self.graph.add_node(task)
+            self.tasks.append(task)
+
+    def remove(self, task) -> None:
+        self.graph.remove_node(task)
+        self.tasks.remove(task)
+
+    def add_edge(self, op1, op2) -> None:
+        self.add(op1)
+        self.add(op2)
+        self.graph.add_edge(op1, op2)
+
+    def __len__(self) -> int:
+        return len(self.tasks)
+
+    def __enter__(self) -> 'Dag':
+        push_dag(self)
+        return self
+
+    def __exit__(self, *args) -> None:
+        pop_dag()
+
+    def is_chain(self) -> bool:
+        """True iff the DAG is a linear chain (reference: ``dag.py:59``).
+        The optimizer uses DP on chains, enumeration otherwise."""
+        if len(self.tasks) <= 1:
+            return True
+        out_degrees = [self.graph.out_degree(t) for t in self.tasks]
+        in_degrees = [self.graph.in_degree(t) for t in self.tasks]
+        return (all(d <= 1 for d in out_degrees) and
+                all(d <= 1 for d in in_degrees) and
+                sum(int(d == 0) for d in out_degrees) == 1 and
+                nx.is_weakly_connected(self.graph))
+
+    def topological_order(self) -> List:
+        return list(nx.topological_sort(self.graph))
+
+    def validate(self) -> None:
+        if not nx.is_directed_acyclic_graph(self.graph):
+            raise ValueError('Task graph has a cycle.')
+
+    def __repr__(self) -> str:
+        return f'Dag(name={self.name!r}, tasks={len(self.tasks)})'
+
+
+_local = threading.local()
+
+
+def _stack() -> List[Dag]:
+    if not hasattr(_local, 'stack'):
+        _local.stack = []
+    return _local.stack
+
+
+def push_dag(dag: Dag) -> None:
+    _stack().append(dag)
+
+
+def pop_dag() -> Optional[Dag]:
+    s = _stack()
+    return s.pop() if s else None
+
+
+def get_current_dag() -> Optional[Dag]:
+    s = _stack()
+    return s[-1] if s else None

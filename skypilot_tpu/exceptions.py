@@ -1,0 +1,234 @@
+"""Typed error taxonomy for skypilot_tpu.
+
+Mirrors the role of the reference's ``sky/exceptions.py`` (694 LoC): a single
+module of exception types that every layer raises, so callers can catch by
+semantic category instead of string-matching messages.  The TPU-native build
+keeps the same top categories (resources-unavailable with failover history,
+cluster lifecycle, command execution, storage) and adds slice/topology errors
+that have no GPU analog.
+"""
+from __future__ import annotations
+
+import enum
+from typing import List, Optional
+
+
+class SkyTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+# ---------------------------------------------------------------------------
+# Planning / optimization
+# ---------------------------------------------------------------------------
+
+
+class ResourcesUnfeasibleError(SkyTpuError):
+    """No catalog entry can satisfy the requested resources at all.
+
+    Reference analog: ``sky/exceptions.py`` ResourcesUnavailableError raised
+    from the optimizer when ``_fill_in_launchable_resources`` finds nothing.
+    """
+
+    def __init__(self, message: str, failover_history: Optional[List[Exception]] = None):
+        super().__init__(message)
+        self.failover_history: List[Exception] = failover_history or []
+
+    def with_failover_history(self, history: List[Exception]) -> 'ResourcesUnfeasibleError':
+        self.failover_history = history
+        return self
+
+
+class ResourcesUnavailableError(ResourcesUnfeasibleError):
+    """Feasible on paper, but every zone/region/cloud attempt failed (stockout).
+
+    Carries the failover history so the caller (managed-jobs recovery, user
+    report) can see which zones were tried and why each failed — same contract
+    as the reference's failover loop (``cloud_vm_ray_backend.py:1637``).
+    """
+
+
+class NoCloudAccessError(SkyTpuError):
+    """No cloud has valid credentials / is enabled."""
+
+
+class InvalidTopologyError(SkyTpuError):
+    """A TPU accelerator string or topology is malformed or unknown.
+
+    TPU-specific: e.g. ``tpu-v5e-17`` (not a valid slice size) or a 3D
+    topology string that does not multiply out to the chip count.
+    """
+
+
+class QuotaExceededError(SkyTpuError):
+    """Cloud-side quota/stockout error that should blocklist the zone."""
+
+
+# ---------------------------------------------------------------------------
+# Cluster lifecycle
+# ---------------------------------------------------------------------------
+
+
+class ClusterNotUpError(SkyTpuError):
+    """Operation requires an UP cluster but it is stopped/init/missing."""
+
+    def __init__(self, message: str, cluster_status=None, handle=None):
+        super().__init__(message)
+        self.cluster_status = cluster_status
+        self.handle = handle
+
+
+class ClusterDoesNotExist(SkyTpuError):
+    """Named cluster not found in state."""
+
+
+class ClusterOwnerIdentityMismatchError(SkyTpuError):
+    """Cluster was created under a different cloud identity."""
+
+
+class NotSupportedError(SkyTpuError):
+    """The requested operation is not supported by this cloud/backend."""
+
+
+class ProvisionPrechecksError(SkyTpuError):
+    """Pre-provision validation (credentials, quota, image) failed."""
+
+    def __init__(self, reasons: List[Exception]):
+        super().__init__('; '.join(str(r) for r in reasons))
+        self.reasons = reasons
+
+
+# ---------------------------------------------------------------------------
+# Execution
+# ---------------------------------------------------------------------------
+
+
+class CommandError(SkyTpuError):
+    """A remote/local command exited non-zero.
+
+    Reference analog: ``sky/exceptions.py`` CommandError with returncode +
+    command + detailed_reason.
+    """
+
+    def __init__(self, returncode: int, command: str, error_msg: str = '',
+                 detailed_reason: str = ''):
+        self.returncode = returncode
+        self.command = command
+        self.error_msg = error_msg
+        self.detailed_reason = detailed_reason
+        super().__init__(
+            f'Command failed with return code {returncode}: {command}\n{error_msg}')
+
+
+class JobError(SkyTpuError):
+    """A submitted job reached FAILED/FAILED_SETUP/FAILED_DRIVER."""
+
+
+class JobNotFoundError(SkyTpuError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Managed jobs
+# ---------------------------------------------------------------------------
+
+
+class ManagedJobReachedMaxRetriesError(SkyTpuError):
+    """Recovery gave up after max_restarts_on_errors."""
+
+
+class ManagedJobStatusError(SkyTpuError):
+    pass
+
+
+class SpotPreemptedError(SkyTpuError):
+    """Detected that the spot/preemptible slice was reclaimed."""
+
+
+# ---------------------------------------------------------------------------
+# Serve
+# ---------------------------------------------------------------------------
+
+
+class ServeUserTerminatedError(SkyTpuError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Storage / data
+# ---------------------------------------------------------------------------
+
+
+class StorageError(SkyTpuError):
+    pass
+
+
+class StorageSpecError(StorageError):
+    pass
+
+
+class StorageBucketCreateError(StorageError):
+    pass
+
+
+class StorageBucketGetError(StorageError):
+    pass
+
+
+class StorageModeError(StorageError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# API plane
+# ---------------------------------------------------------------------------
+
+
+class ApiServerConnectionError(SkyTpuError):
+    def __init__(self, server_url: str, message: str = ''):
+        super().__init__(
+            f'Could not connect to API server at {server_url}. {message}')
+        self.server_url = server_url
+
+
+class RequestCancelled(SkyTpuError):
+    pass
+
+
+class RequestNotFoundError(SkyTpuError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Error codes for CLI exits (reference keeps these implicit; we make them enum)
+# ---------------------------------------------------------------------------
+
+
+class ExitCode(enum.IntEnum):
+    SUCCESS = 0
+    FAILURE = 1
+    COMMAND_FAILED = 100
+    NOT_SUPPORTED = 101
+    RESOURCES_UNAVAILABLE = 102
+    CLUSTER_NOT_UP = 103
+
+
+def serialize_exception(e: Exception) -> dict:
+    """JSON-safe form for shipping across the API boundary."""
+    return {
+        'type': type(e).__name__,
+        'message': str(e),
+    }
+
+
+def deserialize_exception(d: dict) -> Exception:
+    cls = globals().get(d.get('type', ''), SkyTpuError)
+    msg = d.get('message', '')
+    # Only reconstruct types whose __init__ takes a plain message; anything
+    # with a structured signature (e.g. ProvisionPrechecksError's reasons
+    # list) degrades to the base type rather than garbling its args.
+    if cls in (ProvisionPrechecksError, CommandError, ApiServerConnectionError):
+        return SkyTpuError(f"{d.get('type')}: {msg}")
+    try:
+        return cls(msg)
+    except Exception:  # noqa: BLE001 — never let deserialization raise
+        return SkyTpuError(f"{d.get('type')}: {msg}")

@@ -1,0 +1,375 @@
+"""Resource specification: what hardware a task wants.
+
+Reference analog: ``sky/resources.py`` (``Resources``, ``resources.py:119``;
+accelerator canonicalization ``:1012``; ``LaunchableResources :2417``).  The
+TPU-native difference: ``accelerators: tpu-v5e-256`` parses into a full
+:class:`~skypilot_tpu.topology.TpuSlice` (topology, hosts, chips/host, ICI
+shape) at spec time, so every later layer — optimizer, provisioner, gang
+executor — operates on typed slice topology instead of an opaque
+``{'TPU-V5E': 256}`` count plus scattered ``accelerator_args`` special cases.
+
+A Resources may be *partial* (just an accelerator; optimizer fills in cloud /
+region / zone / instance type) or *launchable* (everything pinned, produced by
+``Cloud.get_feasible_launchable_resources``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Set, Tuple, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import topology
+
+_DEFAULT_DISK_SIZE_GB = 100
+
+
+@dataclasses.dataclass
+class AcceleratorArgs:
+    """TPU-specific knobs (reference: ``accelerator_args`` dict,
+    ``sky/resources.py:773`` + GCP deploy vars ``sky/clouds/gcp.py:509-544``).
+    """
+    runtime_version: Optional[str] = None
+    topology: Optional[str] = None  # explicit ICI shape, e.g. '4x8'
+    reserved: bool = False  # use a reservation / queued resource
+    network: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in dataclasses.asdict(self).items() if v not in (None, False)}
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> 'AcceleratorArgs':
+        d = dict(d or {})
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f'Unknown accelerator_args: {sorted(unknown)}')
+        return cls(**d)
+
+
+class Resources:
+    """One alternative hardware target for a task.
+
+    Exposed YAML surface (mirrors the reference's ``resources:`` section):
+
+    .. code-block:: yaml
+
+        resources:
+          accelerators: tpu-v5e-16      # or {'tpu-v5e-16': 1} / 'cpu-only'
+          accelerator_args:
+            runtime_version: v2-alpha-tpuv5-lite
+            topology: 4x4
+          cloud: gcp
+          region: us-central2
+          zone: us-central2-b
+          instance_type: n2-standard-8   # CPU tasks
+          cpus: 8+                       # request, catalog-resolved
+          memory: 32+
+          use_spot: true
+          disk_size: 200
+          ports: [8080]
+          image_id: v2-alpha-tpuv5-lite  # TPU runtime image
+          labels: {team: infra}
+          any_of: [...]                  # union of candidates
+    """
+
+    def __init__(
+        self,
+        cloud: Optional[str] = None,
+        instance_type: Optional[str] = None,
+        accelerators: Union[None, str, Dict[str, int]] = None,
+        accelerator_args: Union[None, Dict[str, Any], AcceleratorArgs] = None,
+        cpus: Union[None, int, float, str] = None,
+        memory: Union[None, int, float, str] = None,
+        region: Optional[str] = None,
+        zone: Optional[str] = None,
+        use_spot: Optional[bool] = None,
+        disk_size: Optional[int] = None,
+        ports: Optional[List[Union[int, str]]] = None,
+        image_id: Optional[str] = None,
+        labels: Optional[Dict[str, str]] = None,
+        autostop: Optional[Dict[str, Any]] = None,
+        job_recovery: Optional[str] = None,
+        _price_per_hour: Optional[float] = None,
+    ):
+        self._cloud_name = cloud.lower() if cloud else None
+        self.region = region
+        self.zone = zone
+        self.instance_type = instance_type
+        self._use_spot = use_spot
+        self.disk_size = disk_size if disk_size is not None else _DEFAULT_DISK_SIZE_GB
+        self.ports = [str(p) for p in ports] if ports else None
+        self.image_id = image_id
+        self.labels = dict(labels or {})
+        self.autostop = autostop
+        self.job_recovery = job_recovery
+        self.cpus = str(cpus) if cpus is not None else None
+        self.memory = str(memory) if memory is not None else None
+        self._price_per_hour = _price_per_hour
+
+        if isinstance(accelerator_args, AcceleratorArgs):
+            self.accelerator_args = accelerator_args
+        else:
+            self.accelerator_args = AcceleratorArgs.from_dict(accelerator_args)
+
+        self._accelerator_name: Optional[str] = None
+        self._accelerator_count: int = 1
+        self._tpu: Optional[topology.TpuSlice] = None
+        self._set_accelerators(accelerators)
+
+    # -- accelerators ------------------------------------------------------
+
+    def _set_accelerators(
+            self, accelerators: Union[None, str, Dict[str, int]]) -> None:
+        """Canonicalize accelerators (reference: ``resources.py:773,1012``)."""
+        if accelerators is None:
+            return
+        if isinstance(accelerators, dict):
+            if len(accelerators) != 1:
+                raise ValueError(
+                    f'accelerators dict must have exactly one entry, got '
+                    f'{accelerators}')
+            name, count = next(iter(accelerators.items()))
+        else:
+            name = str(accelerators)
+            count = 1
+            if ':' in name:
+                name, count_s = name.rsplit(':', 1)
+                count = int(count_s)
+        name = name.strip()
+        if name.lower() in ('none', 'cpu-only', 'cpu'):
+            return
+        tpu = topology.parse_accelerator(name, self.accelerator_args.topology)
+        if tpu is not None:
+            if count != 1:
+                raise ValueError(
+                    f'TPU slices are atomic; use a larger slice instead of '
+                    f'{name}:{count}.')
+            self._tpu = tpu
+            self._accelerator_name = tpu.name
+            self._accelerator_count = 1
+        else:
+            # Non-TPU accelerator (e.g. GPUs on another provider). Kept
+            # catalog-resolved so the framework is not TPU-only
+            # (SURVEY.md §7 "hard parts": minimal.yaml must keep working).
+            self._accelerator_name = name
+            self._accelerator_count = int(count)
+
+    @property
+    def tpu(self) -> Optional[topology.TpuSlice]:
+        return self._tpu
+
+    @property
+    def accelerators(self) -> Optional[Dict[str, int]]:
+        if self._accelerator_name is None:
+            return None
+        return {self._accelerator_name: self._accelerator_count}
+
+    @property
+    def accelerator_name(self) -> Optional[str]:
+        return self._accelerator_name
+
+    @property
+    def cloud(self) -> Optional[str]:
+        return self._cloud_name
+
+    @property
+    def use_spot(self) -> bool:
+        return bool(self._use_spot)
+
+    @property
+    def use_spot_specified(self) -> bool:
+        return self._use_spot is not None
+
+    @property
+    def price_per_hour(self) -> Optional[float]:
+        return self._price_per_hour
+
+    # -- derived slice facts ----------------------------------------------
+
+    @property
+    def hosts_per_node(self) -> int:
+        """Worker VMs per task node. >1 exactly for multi-host TPU slices —
+        the generalization of the reference's ``num_ips_per_node``
+        (``cloud_vm_ray_backend.py:2484``)."""
+        if self._tpu is not None:
+            return self._tpu.hosts
+        return 1
+
+    @property
+    def chips_per_host(self) -> int:
+        if self._tpu is not None:
+            return self._tpu.chips_per_host
+        return 0
+
+    # -- cpu/memory parsing ------------------------------------------------
+
+    @staticmethod
+    def _parse_plus(value: Optional[str]) -> Tuple[Optional[float], bool]:
+        """'8+' -> (8.0, True) meaning at-least; '8' -> (8.0, False)."""
+        if value is None:
+            return None, True
+        v = value.strip()
+        if v.endswith('+'):
+            return float(v[:-1]), True
+        return float(v), False
+
+    def cpus_requirement(self) -> Tuple[Optional[float], bool]:
+        return self._parse_plus(self.cpus)
+
+    def memory_requirement(self) -> Tuple[Optional[float], bool]:
+        return self._parse_plus(self.memory)
+
+    # -- launchability -----------------------------------------------------
+
+    def is_launchable(self) -> bool:
+        """Everything the provisioner needs is pinned."""
+        if self._cloud_name is None or self.region is None:
+            return False
+        if self._tpu is not None:
+            return True
+        return self.instance_type is not None
+
+    def assert_launchable(self) -> 'Resources':
+        assert self.is_launchable(), f'Resources not launchable: {self}'
+        return self
+
+    # -- copies / YAML -----------------------------------------------------
+
+    def copy(self, **override) -> 'Resources':
+        cfg = self.to_yaml_config()
+        cfg.pop('any_of', None)
+        price = override.pop('_price_per_hour', self._price_per_hour)
+        cfg.update(override)
+        r = Resources.from_yaml_config(cfg)
+        r._price_per_hour = price  # pylint: disable=protected-access
+        return r
+
+    @classmethod
+    def from_yaml_config(
+            cls, config: Union[None, str, Dict[str, Any]]
+    ) -> Union['Resources', List['Resources']]:
+        """Parse a ``resources:`` section. ``any_of:`` yields a list of
+        candidates (reference: ``resources.py:1972`` + any_of/ordered)."""
+        if config is None:
+            return cls()
+        if isinstance(config, str):
+            return cls(accelerators=config)
+        config = dict(config)
+        any_of = config.pop('any_of', None)
+        if any_of is not None:
+            base = config
+            out: List[Resources] = []
+            for cand in any_of:
+                merged = {**base, **(cand or {})}
+                out.append(cls.from_yaml_config(merged))  # type: ignore
+            return out
+        known = {
+            'cloud', 'instance_type', 'accelerators', 'accelerator_args',
+            'cpus', 'memory', 'region', 'zone', 'use_spot', 'disk_size',
+            'ports', 'image_id', 'labels', 'autostop', 'job_recovery',
+        }
+        unknown = set(config) - known
+        if unknown:
+            raise ValueError(
+                f'Unknown fields in resources: {sorted(unknown)}')
+        return cls(**config)  # type: ignore[arg-type]
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {}
+
+        def add(key: str, value: Any) -> None:
+            if value is not None and value != {} and value != []:
+                cfg[key] = value
+
+        add('cloud', self._cloud_name)
+        add('region', self.region)
+        add('zone', self.zone)
+        add('instance_type', self.instance_type)
+        if self._accelerator_name is not None:
+            if self._accelerator_count == 1:
+                add('accelerators', self._accelerator_name)
+            else:
+                add('accelerators',
+                    {self._accelerator_name: self._accelerator_count})
+        aa = self.accelerator_args.to_dict()
+        add('accelerator_args', aa or None)
+        add('cpus', self.cpus)
+        add('memory', self.memory)
+        if self._use_spot is not None:
+            cfg['use_spot'] = self._use_spot
+        if self.disk_size != _DEFAULT_DISK_SIZE_GB:
+            cfg['disk_size'] = self.disk_size
+        add('ports', self.ports)
+        add('image_id', self.image_id)
+        add('labels', self.labels or None)
+        add('autostop', self.autostop)
+        add('job_recovery', self.job_recovery)
+        return cfg
+
+    # -- comparison --------------------------------------------------------
+
+    def less_demanding_than(self, other: 'Resources') -> bool:
+        """Can a task wanting `self` run on a cluster provisioned as `other`?
+
+        Used by ``exec``-style fast paths to fit a job onto an existing
+        cluster (reference: ``check_resources_fit_cluster``,
+        ``cloud_vm_ray_backend.py:2875``).
+        """
+        if self._cloud_name is not None and self._cloud_name != other._cloud_name:
+            return False
+        if self.region is not None and self.region != other.region:
+            return False
+        if self.zone is not None and self.zone != other.zone:
+            return False
+        if self._use_spot is not None and self._use_spot != other.use_spot:
+            return False
+        if self._tpu is not None:
+            if other._tpu is None:
+                return False
+            if self._tpu.generation != other._tpu.generation:
+                return False
+            if self._tpu.chips > other._tpu.chips:
+                return False
+        elif self._accelerator_name is not None:
+            oacc = other.accelerators or {}
+            if oacc.get(self._accelerator_name, 0) < self._accelerator_count:
+                return False
+        if self.instance_type is not None and \
+                self.instance_type != other.instance_type:
+            return False
+        return True
+
+    def __repr__(self) -> str:
+        parts = []
+        if self._cloud_name:
+            parts.append(self._cloud_name)
+        if self.region:
+            parts.append(self.region)
+        if self.instance_type:
+            parts.append(self.instance_type)
+        if self._tpu is not None:
+            parts.append(str(self._tpu))
+        elif self._accelerator_name:
+            parts.append(f'{self._accelerator_name}:{self._accelerator_count}')
+        if self.cpus:
+            parts.append(f'cpus={self.cpus}')
+        if self.use_spot:
+            parts.append('[spot]')
+        if self._price_per_hour is not None:
+            parts.append(f'${self._price_per_hour:.2f}/hr')
+        return f'Resources({", ".join(parts) or "default"})'
+
+    # equality for dedup in any_of/failover lists
+    def _key(self) -> tuple:
+        return (self._cloud_name, self.region, self.zone, self.instance_type,
+                self._accelerator_name, self._accelerator_count,
+                self._use_spot, self.image_id, self.cpus, self.memory,
+                self.disk_size, tuple(self.ports or ()),
+                tuple(sorted(self.labels.items())),
+                tuple(sorted(self.accelerator_args.to_dict().items())))
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Resources) and self._key() == other._key()
+
+    def __hash__(self) -> int:
+        return hash(self._key())

@@ -1,0 +1,246 @@
+"""Task: the unit of user work.
+
+Reference analog: ``sky/task.py`` (``Task`` at ``task.py:241``,
+``from_yaml_config`` at ``:544``, ``>>`` DAG edge at ``:1779``).  Semantics are
+preserved — ``setup`` runs once per provision, ``run`` gang-executes on every
+node, env/secret injection, file/storage mounts, YAML round-trip — with one
+TPU-native reinterpretation: ``num_nodes`` counts **slices** (for multislice /
+DCN-connected training), not VMs.  A single ``num_nodes: 1`` task on
+``tpu-v5e-256`` still fans out to 64 worker hosts; host fan-out is derived
+from ``Resources.hosts_per_node``, keeping rank semantics coherent for both
+cases (SURVEY.md §7 "hard parts").
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional, Set, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.utils import common_utils
+
+_VALID_NAME_RE = re.compile(r'^[a-zA-Z0-9]+(?:[._-]{1,2}[a-zA-Z0-9]+)*$')
+_RUN_FN_TYPE = Callable[[int, List[str]], Optional[str]]
+
+
+def _validate_env_name(name: str) -> str:
+    if not re.fullmatch(r'[A-Za-z_][A-Za-z0-9_]*', name):
+        raise ValueError(f'Invalid env var name: {name!r}')
+    return name
+
+
+class Task:
+    """A coarse-grained unit of work: setup + run on N slice-nodes.
+
+    .. code-block:: yaml
+
+        name: train
+        resources:
+          accelerators: tpu-v5e-16
+        num_nodes: 1          # slices
+        workdir: .
+        envs: {LR: "3e-4"}
+        secrets: {HF_TOKEN: null}
+        file_mounts:
+          /data: gs://my-bucket/data    # or local path
+        setup: pip install -e .
+        run: python train.py --lr $LR
+    """
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        *,
+        setup: Optional[str] = None,
+        run: Union[None, str, _RUN_FN_TYPE] = None,
+        envs: Optional[Dict[str, str]] = None,
+        secrets: Optional[Dict[str, Optional[str]]] = None,
+        workdir: Optional[str] = None,
+        num_nodes: Optional[int] = None,
+        file_mounts: Optional[Dict[str, str]] = None,
+        storage_mounts: Optional[Dict[str, Any]] = None,
+    ):
+        self.name = name
+        self.setup = setup
+        self.run = run
+        self.workdir = workdir
+        self.num_nodes = num_nodes if num_nodes is not None else 1
+        for k, v in (envs or {}).items():
+            if v is None:
+                raise ValueError(
+                    f'Env var {k!r} has null value. Only `secrets:` entries '
+                    'may be null (filled at launch with --secret).')
+        self._envs = {_validate_env_name(k): str(v) for k, v in (envs or {}).items()}
+        self._secrets = {
+            _validate_env_name(k): (str(v) if v is not None else None)
+            for k, v in (secrets or {}).items()
+        }
+        self.file_mounts: Dict[str, str] = dict(file_mounts or {})
+        self.storage_mounts: Dict[str, Any] = dict(storage_mounts or {})
+        self._resources: Set[Resources] = {Resources()}
+        self._resources_ordered: List[Resources] = [Resources()]
+        self.service: Optional[Any] = None  # serve.SpecType, set by serve layer
+        self.best_resources: Optional[Resources] = None  # optimizer output
+
+        self._validate()
+
+    # -- validation --------------------------------------------------------
+
+    def _validate(self) -> None:
+        if self.name is not None and not _VALID_NAME_RE.fullmatch(self.name):
+            raise ValueError(f'Invalid task name {self.name!r}')
+        if self.num_nodes < 1:
+            raise ValueError(f'num_nodes must be >= 1, got {self.num_nodes}')
+        if isinstance(self.run, str) and not self.run.strip():
+            self.run = None
+        if self.workdir is not None:
+            expanded = os.path.expanduser(self.workdir)
+            # Existence checked at launch, not parse (YAML may be authored
+            # on a different machine than where it is submitted).
+            self.workdir = expanded
+
+    # -- resources ---------------------------------------------------------
+
+    @property
+    def resources(self) -> Set[Resources]:
+        return self._resources
+
+    @property
+    def resources_ordered(self) -> List[Resources]:
+        """Candidates in user-preference order (any_of preserves order)."""
+        return self._resources_ordered
+
+    def set_resources(
+        self, resources: Union[Resources, List[Resources], Set[Resources]]
+    ) -> 'Task':
+        if isinstance(resources, Resources):
+            resources = [resources]
+        ordered = list(resources)
+        if not ordered:
+            raise ValueError('At least one Resources candidate is required.')
+        self._resources_ordered = ordered
+        self._resources = set(ordered)
+        return self
+
+    # -- envs / secrets ----------------------------------------------------
+
+    @property
+    def envs(self) -> Dict[str, str]:
+        return dict(self._envs)
+
+    @property
+    def secrets(self) -> Dict[str, Optional[str]]:
+        return dict(self._secrets)
+
+    @property
+    def envs_and_secrets(self) -> Dict[str, str]:
+        out = dict(self._envs)
+        for k, v in self._secrets.items():
+            if v is None:
+                raise ValueError(
+                    f'Secret {k} has no value. Pass it with `--secret {k}` '
+                    'or set it in the environment.')
+            out[k] = v
+        return out
+
+    def update_envs(self, envs: Dict[str, str]) -> 'Task':
+        for k, v in envs.items():
+            self._envs[_validate_env_name(k)] = str(v)
+        return self
+
+    def update_secrets(self, secrets: Dict[str, str]) -> 'Task':
+        for k, v in secrets.items():
+            self._secrets[_validate_env_name(k)] = str(v)
+        return self
+
+    # -- YAML round-trip ---------------------------------------------------
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'Task':
+        config = dict(config)
+        known = {
+            'name', 'setup', 'run', 'envs', 'secrets', 'workdir', 'num_nodes',
+            'file_mounts', 'resources', 'config', 'service',
+        }
+        unknown = set(config) - known
+        if unknown:
+            raise ValueError(f'Unknown fields in task YAML: {sorted(unknown)}')
+        resources_cfg = config.pop('resources', None)
+        service_cfg = config.pop('service', None)
+        config.pop('config', None)  # consumed by execution via config.override
+        file_mounts_cfg = config.pop('file_mounts', None) or {}
+        # Split file_mounts into plain path copies vs storage specs
+        # (reference: task.py:930-1010 set_file_mounts/set_storage_mounts).
+        file_mounts: Dict[str, str] = {}
+        storage_mounts: Dict[str, Any] = {}
+        for dst, src in file_mounts_cfg.items():
+            if isinstance(src, dict):
+                storage_mounts[dst] = src
+            elif isinstance(src, str) and re.match(r'^(gs|s3|r2|cos)://', src):
+                storage_mounts[dst] = {'source': src, 'mode': 'MOUNT'}
+            else:
+                file_mounts[dst] = src
+        task = cls(file_mounts=file_mounts, storage_mounts=storage_mounts,
+                   **config)
+        parsed = Resources.from_yaml_config(resources_cfg)
+        task.set_resources(parsed if isinstance(parsed, list) else [parsed])
+        if service_cfg is not None:
+            from skypilot_tpu.serve import service_spec  # lazy: avoid cycle
+            task.service = service_spec.ServiceSpec.from_yaml_config(service_cfg)
+        return task
+
+    @classmethod
+    def from_yaml(cls, path: str) -> 'Task':
+        config = common_utils.read_yaml(path)
+        if not isinstance(config, dict):
+            raise ValueError(f'{path} is not a task YAML (expected a mapping).')
+        return cls.from_yaml_config(config)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {}
+        if self.name:
+            cfg['name'] = self.name
+        if len(self._resources_ordered) == 1:
+            cfg['resources'] = self._resources_ordered[0].to_yaml_config()
+        else:
+            cfg['resources'] = {
+                'any_of': [r.to_yaml_config() for r in self._resources_ordered]
+            }
+        if self.num_nodes != 1:
+            cfg['num_nodes'] = self.num_nodes
+        if self.workdir:
+            cfg['workdir'] = self.workdir
+        if self._envs:
+            cfg['envs'] = dict(self._envs)
+        if self._secrets:
+            cfg['secrets'] = {k: None for k in self._secrets}  # never persist values
+        mounts: Dict[str, Any] = dict(self.file_mounts)
+        for dst, spec in self.storage_mounts.items():
+            mounts[dst] = spec
+        if mounts:
+            cfg['file_mounts'] = mounts
+        if self.setup:
+            cfg['setup'] = self.setup
+        if isinstance(self.run, str):
+            cfg['run'] = self.run
+        if self.service is not None:
+            cfg['service'] = self.service.to_yaml_config()
+        return cfg
+
+    # -- DAG sugar ---------------------------------------------------------
+
+    def __rshift__(self, other: 'Task') -> 'Task':
+        """``a >> b``: b depends on a (reference: ``task.py:1779``)."""
+        from skypilot_tpu import dag as dag_lib
+        dag = dag_lib.get_current_dag()
+        if dag is None:
+            raise RuntimeError('Task >> Task requires an active `with Dag():`')
+        dag.add_edge(self, other)
+        return other
+
+    def __repr__(self) -> str:
+        rs = self._resources_ordered
+        r = repr(rs[0]) if len(rs) == 1 else f'{len(rs)} candidates'
+        return (f'Task(name={self.name!r}, num_nodes={self.num_nodes}, '
+                f'resources={r})')

@@ -1,0 +1,222 @@
+"""First-class TPU slice topology model.
+
+This is the central TPU-first inversion of the reference design: in SkyPilot a
+scheduling atom is "a VM with K accelerators" and multi-host TPU pods are
+retrofitted (one ``InstanceInfo`` per ``networkEndpoint``,
+``sky/provision/gcp/instance_utils.py:1649-1670``; ``handle.num_ips_per_node``,
+``sky/backends/cloud_vm_ray_backend.py:2484``).  Here the atom is a
+*topology-typed slice*: ``tpu-v5e-256 = 64 hosts x 4 chips, ICI mesh 16x16``.
+Everything downstream (catalog rows, optimizer, provisioner, gang executor,
+mesh construction inside workloads) consumes this one dataclass.
+
+Naming conventions (public Cloud TPU naming):
+  * v2/v3/v5p: the suffix counts **TensorCores** (2 cores per chip).
+  * v4:        the suffix counts TensorCores as well (v4-8 = 4 chips).
+  * v5e (v5litepod) and v6e: the suffix counts **chips** directly.
+We normalize everything to chips internally.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+# Per-generation physical facts. `cores_per_chip` governs how the public
+# accelerator suffix maps to chips; `default_chips_per_host` is the host
+# granularity for multi-host slices. Single-host slice sizes below
+# `max_chips_single_host` run on one VM with all chips attached.
+@dataclasses.dataclass(frozen=True)
+class TpuGeneration:
+    name: str
+    suffix_counts_cores: bool  # True: tpu-vX-N counts TensorCores (2/chip)
+    chips_per_host: int  # multi-host granularity
+    max_chips_single_host: int
+    hbm_gb_per_chip: float
+    bf16_tflops_per_chip: float
+    ici_dims: int  # 2 = 2D torus (v2/v3/v5e/v6e), 3 = 3D torus (v4/v5p)
+    default_runtime_version: str
+
+
+GENERATIONS: Dict[str, TpuGeneration] = {
+    'v2': TpuGeneration('v2', True, 4, 8, 8, 23, 2, 'tpu-vm-base'),
+    'v3': TpuGeneration('v3', True, 4, 8, 16, 61, 2, 'tpu-vm-base'),
+    'v4': TpuGeneration('v4', True, 4, 4, 32, 138, 3, 'tpu-ubuntu2204-base'),
+    'v5e': TpuGeneration('v5e', False, 4, 8, 16, 197, 2, 'v2-alpha-tpuv5-lite'),
+    'v5p': TpuGeneration('v5p', True, 4, 4, 95, 229, 3, 'v2-alpha-tpuv5'),
+    'v6e': TpuGeneration('v6e', False, 4, 8, 32, 918, 2, 'v2-alpha-tpuv6e'),
+}
+
+# Valid slice sizes (in chips) per generation. Cloud TPU only offers specific
+# slice shapes; arbitrary chip counts are invalid (`InvalidTopologyError`).
+_POW2 = [1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048]
+VALID_CHIP_COUNTS: Dict[str, List[int]] = {
+    'v2': [c for c in _POW2 if 4 <= c <= 256],
+    'v3': [c for c in _POW2 if 4 <= c <= 1024],
+    'v4': [c for c in _POW2 if 4 <= c <= 2048] + [12, 24, 48, 96, 192, 384, 768, 1536],
+    'v5e': [1, 2, 4, 8, 16, 32, 64, 128, 256],
+    'v5p': [c for c in _POW2 if 4 <= c <= 2048] + [12, 24, 48, 96, 192, 384, 768, 1536, 3072, 6144],
+    'v6e': [1, 2, 4, 8, 16, 32, 64, 128, 256],
+}
+
+_ACC_RE = re.compile(r'^tpu-(v[0-9]+[a-z]*)-([0-9]+)$')
+
+
+def _default_topology(gen: TpuGeneration, chips: int) -> Tuple[int, ...]:
+    """Pick the standard ICI torus shape for a slice size.
+
+    2D generations use the squarest 2D factorization with power-of-two sides
+    (v5e-256 -> 16x16, v5e-16 -> 4x4); 3D generations use the standard
+    2-2-ascending factorization (v4-32 = 16 chips -> 2x2x4).
+    """
+    if chips == 1:
+        return (1, 1)
+    if gen.ici_dims == 2:
+        a = 2 ** (int(math.log2(chips)) // 2)
+        while chips % a != 0:
+            a //= 2
+        return (a, chips // a)
+    # 3D: factor into (x, y, z) with x<=y<=z, sides multiples of 2 when >1.
+    best: Optional[Tuple[int, int, int]] = None
+    for x in range(1, int(round(chips ** (1 / 3))) + 2):
+        if chips % x:
+            continue
+        rest = chips // x
+        for y in range(x, int(math.isqrt(rest)) + 1):
+            if rest % y:
+                continue
+            z = rest // y
+            cand = (x, y, z)
+            if best is None or (z - x) < (best[2] - best[0]):
+                best = cand
+    return best if best is not None else (1, 1, chips)
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuSlice:
+    """A topology-typed TPU slice — the scheduling atom.
+
+    ``hosts`` is the number of worker VMs the provisioner must bring up and the
+    gang executor must rendezvous; ``topology`` is the ICI torus shape handed
+    to the workload for mesh construction (and to GCP's create-node API as the
+    ``acceleratorConfig.topology`` string for v4+).
+    """
+    generation: str
+    chips: int
+    topology: Tuple[int, ...]
+
+    @property
+    def gen(self) -> TpuGeneration:
+        return GENERATIONS[self.generation]
+
+    @property
+    def name(self) -> str:
+        g = self.gen
+        n = self.chips * 2 if g.suffix_counts_cores else self.chips
+        return f'tpu-{self.generation}-{n}'
+
+    @property
+    def accelerator_type(self) -> str:
+        """GCP API acceleratorType string (e.g. ``v5litepod-16``)."""
+        g = self.gen
+        n = self.chips * 2 if g.suffix_counts_cores else self.chips
+        if self.generation == 'v5e':
+            return f'v5litepod-{n}'
+        return f'{self.generation}-{n}'
+
+    @property
+    def topology_str(self) -> str:
+        return 'x'.join(str(d) for d in self.topology)
+
+    @property
+    def is_multi_host(self) -> bool:
+        return self.hosts > 1
+
+    @property
+    def hosts(self) -> int:
+        g = self.gen
+        if self.chips <= g.max_chips_single_host:
+            return 1
+        assert self.chips % g.chips_per_host == 0, self
+        return self.chips // g.chips_per_host
+
+    @property
+    def chips_per_host(self) -> int:
+        return self.chips // self.hosts
+
+    @property
+    def total_bf16_tflops(self) -> float:
+        return self.chips * self.gen.bf16_tflops_per_chip
+
+    @property
+    def total_hbm_gb(self) -> float:
+        return self.chips * self.gen.hbm_gb_per_chip
+
+    def mesh_shape(self, num_slices: int = 1) -> Tuple[int, ...]:
+        """Device mesh shape for jax: (dcn, *ici torus) flattened later by
+        workloads into logical axes (data/fsdp/tensor/...)."""
+        if num_slices > 1:
+            return (num_slices,) + self.topology
+        return self.topology
+
+    def __str__(self) -> str:
+        return (f'{self.name}[{self.topology_str}, {self.hosts} host'
+                f'{"s" if self.hosts > 1 else ""} x {self.chips_per_host} chips]')
+
+
+def parse_accelerator(acc: str,
+                      topology: Optional[str] = None) -> Optional[TpuSlice]:
+    """Parse ``tpu-v5e-256`` (+ optional explicit topology) into a TpuSlice.
+
+    Returns None for non-TPU accelerator strings (the catalog handles those).
+    Raises InvalidTopologyError for malformed TPU strings — the same place the
+    reference canonicalizes accelerator names (``sky/resources.py:1012``),
+    except topology validation is first-class here.
+    """
+    m = _ACC_RE.match(acc.lower().strip())
+    if m is None:
+        return None
+    gen_name, n = m.group(1), int(m.group(2))
+    if gen_name not in GENERATIONS:
+        raise exceptions.InvalidTopologyError(
+            f'Unknown TPU generation {gen_name!r} in {acc!r}. '
+            f'Known: {sorted(GENERATIONS)}')
+    g = GENERATIONS[gen_name]
+    if g.suffix_counts_cores:
+        if n % 2:
+            raise exceptions.InvalidTopologyError(
+                f'{acc!r}: {gen_name} sizes count TensorCores and must be even.')
+        chips = n // 2
+    else:
+        chips = n
+    if chips not in VALID_CHIP_COUNTS[gen_name]:
+        valid = VALID_CHIP_COUNTS[gen_name]
+        sizes = [c * 2 if g.suffix_counts_cores else c for c in sorted(valid)]
+        raise exceptions.InvalidTopologyError(
+            f'{acc!r} is not an offered slice size. Valid tpu-{gen_name}-N: '
+            f'{sizes}')
+    if topology is not None:
+        dims = tuple(int(d) for d in topology.lower().split('x'))
+        if math.prod(dims) != chips:
+            raise exceptions.InvalidTopologyError(
+                f'Topology {topology!r} has {math.prod(dims)} chips, but '
+                f'{acc!r} is a {chips}-chip slice.')
+        if len(dims) != g.ici_dims and chips > 1:
+            raise exceptions.InvalidTopologyError(
+                f'{gen_name} uses a {g.ici_dims}D ICI torus; got '
+                f'{len(dims)}D topology {topology!r}.')
+    else:
+        dims = _default_topology(g, chips)
+    return TpuSlice(generation=gen_name, chips=chips, topology=dims)
+
+
+def list_slice_names() -> List[str]:
+    """All valid accelerator strings, for catalog generation / `show-tpus`."""
+    out = []
+    for gen_name, g in GENERATIONS.items():
+        for chips in sorted(VALID_CHIP_COUNTS[gen_name]):
+            n = chips * 2 if g.suffix_counts_cores else chips
+            out.append(f'tpu-{gen_name}-{n}')
+    return out

@@ -1,0 +1,174 @@
+"""Small shared helpers (reference analog: ``sky/utils/common_utils.py``)."""
+from __future__ import annotations
+
+import functools
+import hashlib
+import json
+import os
+import re
+import socket
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+_USER_HASH_FILE = os.path.expanduser('~/.skypilot_tpu/user_hash')
+CLUSTER_NAME_VALID_RE = re.compile(r'^[a-zA-Z]([-a-zA-Z0-9]*[a-zA-Z0-9])?$')
+
+
+def get_user_hash() -> str:
+    """Stable per-user id used to namespace cluster names on the cloud."""
+    if os.path.exists(_USER_HASH_FILE):
+        with open(_USER_HASH_FILE, encoding='utf-8') as f:
+            h = f.read().strip()
+            if h:
+                return h
+    import getpass
+    try:
+        user = getpass.getuser()
+    except (OSError, KeyError):  # tty-less containers / no passwd entry
+        user = str(os.getuid()) if hasattr(os, 'getuid') else 'unknown'
+    h = hashlib.md5(f'{user}-{uuid.getnode()}'.encode()).hexdigest()[:8]
+    os.makedirs(os.path.dirname(_USER_HASH_FILE), exist_ok=True)
+    with open(_USER_HASH_FILE, 'w', encoding='utf-8') as f:
+        f.write(h)
+    return h
+
+
+def get_usage_run_id() -> str:
+    return str(uuid.uuid4())
+
+
+def check_cluster_name_is_valid(name: str) -> None:
+    if not CLUSTER_NAME_VALID_RE.match(name):
+        raise ValueError(
+            f'Cluster name {name!r} is invalid: must match '
+            f'{CLUSTER_NAME_VALID_RE.pattern} (letters, digits, dashes; '
+            'starts with a letter).')
+
+
+def make_cluster_name_on_cloud(display_name: str, max_length: int = 35) -> str:
+    """Cloud-side resource name: display name + user hash, length-capped."""
+    user = get_user_hash()
+    base = re.sub(r'[^a-z0-9-]', '-', display_name.lower())
+    if len(base) > max_length - 9:
+        digest = hashlib.md5(base.encode()).hexdigest()[:4]
+        base = f'{base[:max_length - 14]}-{digest}'
+    return f'{base}-{user}'
+
+
+def fill_template(template: str, variables: Dict[str, Any]) -> str:
+    import jinja2
+    return jinja2.Template(template,
+                           undefined=jinja2.StrictUndefined).render(**variables)
+
+
+def read_yaml(path: str) -> Dict[str, Any]:
+    import yaml
+    with open(os.path.expanduser(path), encoding='utf-8') as f:
+        return yaml.safe_load(f) or {}
+
+
+def read_yaml_all(path: str) -> List[Dict[str, Any]]:
+    import yaml
+    with open(os.path.expanduser(path), encoding='utf-8') as f:
+        return [c for c in yaml.safe_load_all(f) if c is not None]
+
+
+def dump_yaml(path: str, config: Any) -> None:
+    import yaml
+    os.makedirs(os.path.dirname(os.path.expanduser(path)) or '.', exist_ok=True)
+    with open(os.path.expanduser(path), 'w', encoding='utf-8') as f:
+        yaml.safe_dump(config, f, default_flow_style=False, sort_keys=False)
+
+
+def dump_yaml_str(config: Any) -> str:
+    import yaml
+    return yaml.safe_dump(config, default_flow_style=False, sort_keys=False)
+
+
+def find_free_port(start: int = 10000) -> int:
+    for port in range(start, start + 1000):
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            try:
+                s.bind(('', port))
+                return port
+            except OSError:
+                continue
+    raise RuntimeError('No free port found.')
+
+
+def get_local_ip() -> str:
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(('8.8.8.8', 80))
+            return s.getsockname()[0]
+    except OSError:
+        return '127.0.0.1'
+
+
+def retry(max_retries: int = 3, initial_backoff: float = 1.0,
+          exceptions_to_retry=(Exception,)) -> Callable:
+    """Exponential-backoff retry decorator for flaky cloud calls."""
+
+    def decorator(fn):
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            backoff = initial_backoff
+            for attempt in range(max_retries):
+                try:
+                    return fn(*args, **kwargs)
+                except exceptions_to_retry:
+                    if attempt == max_retries - 1:
+                        raise
+                    time.sleep(backoff)
+                    backoff *= 2
+
+        return wrapper
+
+    return decorator
+
+
+def format_float(x: Optional[float], precision: int = 2) -> str:
+    if x is None:
+        return '-'
+    if x >= 100 or x == int(x):
+        return f'{x:.0f}'
+    return f'{x:.{precision}f}'
+
+
+def json_dumps_compact(obj: Any) -> str:
+    return json.dumps(obj, separators=(',', ':'), sort_keys=True)
+
+
+def readable_time_duration(start: Optional[float],
+                           end: Optional[float] = None) -> str:
+    if start is None:
+        return '-'
+    end = end if end is not None else time.time()
+    secs = int(end - start)
+    if secs < 60:
+        return f'{secs}s'
+    if secs < 3600:
+        return f'{secs // 60}m {secs % 60}s'
+    if secs < 86400:
+        return f'{secs // 3600}h {secs % 3600 // 60}m'
+    return f'{secs // 86400}d {secs % 86400 // 3600}h'
+
+
+def truncate_long_string(s: str, max_length: int = 60) -> str:
+    return s if len(s) <= max_length else s[:max_length - 3] + '...'
+
+
+class Backoff:
+    """Capped exponential backoff with jitter-free determinism for tests."""
+
+    def __init__(self, initial: float = 1.0, cap: float = 30.0, factor: float = 2.0):
+        self._delay = initial
+        self._cap = cap
+        self._factor = factor
+
+    def current_backoff(self) -> float:
+        d = self._delay
+        self._delay = min(self._delay * self._factor, self._cap)
+        return d

@@ -1,0 +1,63 @@
+"""Cloud/backend registries (reference analog: ``sky/utils/registry.py``).
+
+Clouds register themselves by subclass decorator; the optimizer and `check`
+enumerate the registry rather than importing concrete classes.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Generic, List, Optional, Type, TypeVar
+
+T = TypeVar('T')
+
+
+class Registry(Generic[T]):
+
+    def __init__(self, registry_name: str):
+        self._name = registry_name
+        self._registry: Dict[str, Type[T]] = {}
+        self._aliases: Dict[str, str] = {}
+
+    def register(self, cls: Optional[Type[T]] = None, *,
+                 aliases: Optional[List[str]] = None) -> Callable:
+
+        def _do(c: Type[T]) -> Type[T]:
+            name = c.__name__.lower()
+            canonical = getattr(c, '_REPR', c.__name__).lower()
+            self._registry[canonical] = c
+            if canonical != name:
+                self._aliases[name] = canonical
+            for a in aliases or []:
+                self._aliases[a.lower()] = canonical
+            return c
+
+        if cls is not None:
+            return _do(cls)
+        return _do
+
+    def from_str(self, name: Optional[str]) -> Optional[T]:
+        if name is None:
+            return None
+        key = name.lower()
+        key = self._aliases.get(key, key)
+        if key not in self._registry:
+            raise ValueError(
+                f'Unknown {self._name} {name!r}. Registered: '
+                f'{sorted(self._registry)}')
+        return self._registry[key]()
+
+    def type_from_str(self, name: str) -> Type[T]:
+        key = name.lower()
+        key = self._aliases.get(key, key)
+        if key not in self._registry:
+            raise ValueError(f'Unknown {self._name} {name!r}.')
+        return self._registry[key]
+
+    def names(self) -> List[str]:
+        return sorted(self._registry)
+
+    def values(self) -> List[Type[T]]:
+        return [self._registry[k] for k in sorted(self._registry)]
+
+
+CLOUD_REGISTRY: Registry = Registry('cloud')
+BACKEND_REGISTRY: Registry = Registry('backend')

@@ -1,0 +1,104 @@
+"""Chrome trace-event profiling of control-plane operations.
+
+Reference analog: ``sky/utils/timeline.py:23`` (``Event`` + ``@timeline.event``
+decorators, dumped when an env var names a file).  Same opt-in contract here:
+set ``SKYTPU_TIMELINE_FILE_PATH`` and every decorated control-plane call
+(provision, sync, setup, execute) records complete events; ``save_timeline()``
+writes a ``chrome://tracing`` / Perfetto-loadable JSON.
+"""
+from __future__ import annotations
+
+import atexit
+import functools
+import json
+import os
+import threading
+import time
+from typing import Callable, List, Optional, Union
+
+_ENV_VAR = 'SKYTPU_TIMELINE_FILE_PATH'
+_events: List[dict] = []
+_lock = threading.Lock()
+
+
+def _enabled() -> bool:
+    return bool(os.environ.get(_ENV_VAR))
+
+
+class Event:
+    """Context manager recording a complete ('X') trace event."""
+
+    def __init__(self, name: str, message: Optional[str] = None):
+        self._name = name
+        self._message = message
+        self._begin_us: Optional[float] = None
+
+    def begin(self) -> None:
+        self._begin_us = time.time() * 1e6
+
+    def end(self) -> None:
+        if self._begin_us is None or not _enabled():
+            return
+        now = time.time() * 1e6
+        ev = {
+            'name': self._name,
+            'cat': 'skypilot_tpu',
+            'ph': 'X',
+            'ts': self._begin_us,
+            'dur': now - self._begin_us,
+            'pid': os.getpid(),
+            'tid': threading.get_ident() % 100000,
+        }
+        if self._message:
+            ev['args'] = {'message': self._message}
+        with _lock:
+            _events.append(ev)
+
+    def __enter__(self) -> 'Event':
+        self.begin()
+        return self
+
+    def __exit__(self, *args) -> None:
+        self.end()
+
+
+def event(name_or_fn: Union[str, Callable], message: Optional[str] = None):
+    """Decorator (``@timeline.event``) or named decorator factory."""
+    if callable(name_or_fn):
+        fn = name_or_fn
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with Event(f'{fn.__module__}.{fn.__qualname__}'):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    def decorator(fn):
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with Event(name_or_fn, message):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return decorator
+
+
+def save_timeline() -> None:
+    path = os.environ.get(_ENV_VAR)
+    if not path or not _events:
+        return
+    with _lock:
+        payload = {
+            'traceEvents': list(_events),
+            'displayTimeUnit': 'ms',
+        }
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump(payload, f)
+
+
+if _enabled():
+    atexit.register(save_timeline)

@@ -1,0 +1,40 @@
+"""Global test fixtures.
+
+Mirrors the reference's test strategy (SURVEY.md §4 /
+``tests/common_test_fixtures.py``): unit tests run with zero cloud
+credentials; multi-chip logic runs on a virtual 8-device CPU mesh
+(``xla_force_host_platform_device_count``) — the fake TPU topology backend
+the reference lacks.
+
+IMPORTANT: env vars must be set before jax initializes its backends, hence
+the module-level os.environ writes at import time.
+"""
+import os
+
+# Force an 8-device virtual CPU platform for all tests, before jax import.
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in _flags:
+    os.environ['XLA_FLAGS'] = (
+        _flags + ' --xla_force_host_platform_device_count=8').strip()
+os.environ.setdefault('SKYTPU_STATE_DB_DIR_FOR_TESTS', '')
+
+import pytest
+
+
+@pytest.fixture()
+def tmp_state_dir(tmp_path, monkeypatch):
+    """Isolate on-disk state (cluster DB, logs) per test."""
+    monkeypatch.setenv('SKYTPU_STATE_DIR', str(tmp_path / 'state'))
+    yield tmp_path / 'state'
+
+
+@pytest.fixture()
+def enable_fake_cloud(monkeypatch, tmp_state_dir):
+    """Analog of the reference's `enable_all_clouds` fixture
+    (common_test_fixtures.py:176): make the `fake` cloud report valid
+    credentials so the optimizer/backend can run without any real cloud."""
+    monkeypatch.setenv('SKYTPU_ENABLE_FAKE_CLOUD', '1')
+    from skypilot_tpu.provision.fake import instance as fake_instance
+    fake_instance.reset_state()
+    yield

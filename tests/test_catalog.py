@@ -1,0 +1,58 @@
+"""Unit tests for the catalog layer (reference analog:
+tests/unit_tests/test_catalog.py)."""
+from skypilot_tpu.catalog import gcp_catalog
+
+
+def test_tpu_offerings_sorted_cheapest_first():
+    rows = gcp_catalog.get_tpu_offerings('tpu-v5e-16', use_spot=False)
+    assert rows, 'v5e-16 must exist in catalog'
+    prices = [r['Price'] for r in rows]
+    assert prices == sorted(prices)
+    assert all(r['Hosts'] == 4 for r in rows)
+    assert all(r['Topology'] == '4x4' for r in rows)
+
+
+def test_spot_cheaper_than_on_demand():
+    for name in ['tpu-v5e-256', 'tpu-v4-32', 'tpu-v6e-8']:
+        rows = gcp_catalog.get_tpu_offerings(name)
+        assert rows
+        for r in rows:
+            assert r['SpotPrice'] < r['Price']
+
+
+def test_price_scales_with_chips():
+    p8 = gcp_catalog.get_tpu_price('tpu-v5e-8', 'us-west4', use_spot=False)
+    p16 = gcp_catalog.get_tpu_price('tpu-v5e-16', 'us-west4', use_spot=False)
+    assert p16 == p8 * 2
+
+
+def test_vm_for_cpus():
+    row = gcp_catalog.get_instance_type_for_cpus(
+        8, True, 32, True, region='us-central1')
+    assert row is not None
+    assert row['vCPUs'] >= 8
+    assert row['MemoryGiB'] >= 32
+    # cheapest satisfying shape should be e2-standard-8
+    assert row['InstanceType'] == 'e2-standard-8'
+
+
+def test_default_cpus_when_unspecified():
+    row = gcp_catalog.get_instance_type_for_cpus(None, True, None, True)
+    assert row is not None
+    assert row['vCPUs'] >= 4
+
+
+def test_validate_region_zone():
+    region, zone = gcp_catalog.validate_region_zone(None, 'us-west4-a')
+    assert region == 'us-west4'
+    import pytest
+    with pytest.raises(ValueError):
+        gcp_catalog.validate_region_zone('nope-region', None)
+    with pytest.raises(ValueError):
+        gcp_catalog.validate_region_zone('us-east1', 'us-west4-a')
+
+
+def test_list_accelerators_filter():
+    df = gcp_catalog.list_accelerators(name_filter='v6e')
+    assert not df.empty
+    assert set(df['Generation']) == {'v6e'}

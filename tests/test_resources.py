@@ -1,0 +1,66 @@
+"""Unit tests for Resources parsing/round-trip (reference analog:
+tests/unit_tests/test_resources.py)."""
+import pytest
+
+from skypilot_tpu.resources import Resources
+
+
+def test_tpu_accelerator_parses_to_slice():
+    r = Resources(accelerators='tpu-v5e-16')
+    assert r.tpu is not None
+    assert r.tpu.hosts == 4
+    assert r.hosts_per_node == 4
+    assert r.accelerators == {'tpu-v5e-16': 1}
+
+
+def test_tpu_count_rejected():
+    with pytest.raises(ValueError):
+        Resources(accelerators={'tpu-v5e-8': 2})
+
+
+def test_cpu_only():
+    r = Resources(cpus='8+', memory='32+')
+    assert r.tpu is None
+    assert r.cpus_requirement() == (8.0, True)
+    assert r.memory_requirement() == (32.0, True)
+    assert r.hosts_per_node == 1
+
+
+def test_yaml_round_trip():
+    r = Resources(accelerators='tpu-v5p-128', cloud='gcp',
+                  region='us-east5', use_spot=True, disk_size=200,
+                  accelerator_args={'runtime_version': 'v2-alpha-tpuv5'})
+    cfg = r.to_yaml_config()
+    r2 = Resources.from_yaml_config(cfg)
+    assert r2 == r
+    assert r2.tpu.chips == 64
+    assert r2.accelerator_args.runtime_version == 'v2-alpha-tpuv5'
+
+
+def test_any_of_returns_list():
+    parsed = Resources.from_yaml_config({
+        'use_spot': True,
+        'any_of': [
+            {'accelerators': 'tpu-v5e-16'},
+            {'accelerators': 'tpu-v6e-16'},
+        ],
+    })
+    assert isinstance(parsed, list) and len(parsed) == 2
+    assert all(r.use_spot for r in parsed)
+    assert parsed[0].tpu.generation == 'v5e'
+    assert parsed[1].tpu.generation == 'v6e'
+
+
+def test_less_demanding_than():
+    small = Resources(accelerators='tpu-v5e-8')
+    big = Resources(accelerators='tpu-v5e-16', cloud='gcp',
+                    region='us-west4')
+    assert small.less_demanding_than(big)
+    assert not big.less_demanding_than(small)
+    spot = Resources(accelerators='tpu-v5e-16', use_spot=True)
+    assert not spot.less_demanding_than(big)  # spot mismatch
+
+
+def test_unknown_field_rejected():
+    with pytest.raises(ValueError):
+        Resources.from_yaml_config({'acelerators': 'tpu-v5e-8'})

@@ -1,0 +1,88 @@
+"""Unit tests for Task YAML parsing (reference analog:
+tests/test_yaml_parser.py)."""
+import textwrap
+
+import pytest
+import yaml
+
+from skypilot_tpu import Dag, Task
+
+
+def _task_from_yaml_str(s: str) -> Task:
+    return Task.from_yaml_config(yaml.safe_load(textwrap.dedent(s)))
+
+
+def test_minimal_task():
+    t = _task_from_yaml_str("""
+        run: echo hello
+    """)
+    assert t.run == 'echo hello'
+    assert t.num_nodes == 1
+    assert len(t.resources) == 1
+
+
+def test_full_task_round_trip():
+    t = _task_from_yaml_str("""
+        name: train
+        resources:
+          accelerators: tpu-v5e-16
+          use_spot: true
+        num_nodes: 2
+        envs:
+          LR: "3e-4"
+        secrets:
+          HF_TOKEN: null
+        file_mounts:
+          /data: /tmp/data
+          /ckpt: gs://bucket/ckpt
+        setup: pip install -e .
+        run: python train.py
+    """)
+    assert t.num_nodes == 2  # 2 slices (multislice)
+    r = next(iter(t.resources))
+    assert r.tpu.hosts == 4
+    assert t.file_mounts == {'/data': '/tmp/data'}
+    assert '/ckpt' in t.storage_mounts
+    cfg = t.to_yaml_config()
+    t2 = Task.from_yaml_config(cfg)
+    assert t2.num_nodes == 2
+    assert t2.envs == {'LR': '3e-4'}
+    # secrets values never persisted
+    assert cfg['secrets'] == {'HF_TOKEN': None}
+
+
+def test_secret_required_at_execution():
+    t = Task(run='echo', secrets={'TOKEN': None})
+    with pytest.raises(ValueError, match='TOKEN'):
+        _ = t.envs_and_secrets
+    t.update_secrets({'TOKEN': 'abc'})
+    assert t.envs_and_secrets['TOKEN'] == 'abc'
+
+
+def test_unknown_field_rejected():
+    with pytest.raises(ValueError):
+        _task_from_yaml_str("""
+            runn: echo typo
+        """)
+
+
+def test_dag_chain():
+    with Dag() as d:
+        a = Task('a', run='echo a')
+        b = Task('b', run='echo b')
+        c = Task('c', run='echo c')
+        a >> b >> c
+    assert d.is_chain()
+    order = d.topological_order()
+    assert [t.name for t in order] == ['a', 'b', 'c']
+
+
+def test_dag_non_chain():
+    with Dag() as d:
+        a = Task('a', run='x')
+        b = Task('b', run='x')
+        c = Task('c', run='x')
+        a >> c
+        b >> c
+    assert not d.is_chain()
+    d.validate()

@@ -1,0 +1,69 @@
+"""Unit tests for the TPU slice topology model."""
+import math
+
+import pytest
+
+from skypilot_tpu import exceptions, topology
+
+
+def test_v5e_256_shape():
+    sl = topology.parse_accelerator('tpu-v5e-256')
+    assert sl is not None
+    assert sl.chips == 256
+    assert sl.hosts == 64
+    assert sl.chips_per_host == 4
+    assert sl.topology == (16, 16)
+    assert sl.is_multi_host
+
+
+def test_v5e_single_host_sizes():
+    for n, hosts in [(1, 1), (4, 1), (8, 1), (16, 4), (32, 8)]:
+        sl = topology.parse_accelerator(f'tpu-v5e-{n}')
+        assert sl.hosts == hosts, (n, sl)
+
+
+def test_core_counted_generations():
+    # v4-8 = 4 chips, 1 host; v5p-128 = 64 chips = 16 hosts.
+    sl = topology.parse_accelerator('tpu-v4-8')
+    assert sl.chips == 4 and sl.hosts == 1
+    sl = topology.parse_accelerator('tpu-v5p-128')
+    assert sl.chips == 64 and sl.hosts == 16
+    # 3D torus for v4/v5p
+    assert len(sl.topology) == 3
+    assert math.prod(sl.topology) == 64
+
+
+def test_accelerator_type_strings():
+    assert topology.parse_accelerator('tpu-v5e-16').accelerator_type == 'v5litepod-16'
+    assert topology.parse_accelerator('tpu-v4-32').accelerator_type == 'v4-32'
+    assert topology.parse_accelerator('tpu-v6e-8').accelerator_type == 'v6e-8'
+
+
+def test_invalid_sizes_rejected():
+    with pytest.raises(exceptions.InvalidTopologyError):
+        topology.parse_accelerator('tpu-v5e-17')
+    with pytest.raises(exceptions.InvalidTopologyError):
+        topology.parse_accelerator('tpu-v4-7')  # odd core count
+    with pytest.raises(exceptions.InvalidTopologyError):
+        topology.parse_accelerator('tpu-v9-8')
+
+
+def test_non_tpu_returns_none():
+    assert topology.parse_accelerator('A100') is None
+    assert topology.parse_accelerator('H100:8') is None
+
+
+def test_explicit_topology():
+    sl = topology.parse_accelerator('tpu-v5e-16', topology='2x8')
+    assert sl.topology == (2, 8)
+    with pytest.raises(exceptions.InvalidTopologyError):
+        topology.parse_accelerator('tpu-v5e-16', topology='3x5')
+
+
+def test_topology_product_invariant():
+    for name in topology.list_slice_names():
+        sl = topology.parse_accelerator(name)
+        assert math.prod(sl.topology) == sl.chips, name
+        assert sl.hosts * sl.chips_per_host == sl.chips, name
+        # round-trip
+        assert sl.name == name
