@@ -1,0 +1,66 @@
+"""Credential checking / enabled-cloud caching.
+
+Reference analog: ``sky/check.py`` (``:81,378,409``) — `sky check` validates
+per-cloud credentials and caches which clouds are enabled so the optimizer
+only plans over usable providers.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+_CACHE_TTL_S = 300
+
+
+def _cache_path() -> str:
+    state_dir = os.environ.get('SKYTPU_STATE_DIR',
+                               os.path.expanduser('~/.skypilot_tpu'))
+    return os.path.join(state_dir, 'enabled_clouds.json')
+
+
+def check_capabilities(
+        quiet: bool = False) -> Dict[str, Tuple[bool, Optional[str]]]:
+    """Run every registered cloud's credential check; cache the result."""
+    import skypilot_tpu.clouds  # noqa: F401 — registers clouds
+    results: Dict[str, Tuple[bool, Optional[str]]] = {}
+    for cloud_cls in CLOUD_REGISTRY.values():
+        ok, reason = cloud_cls.check_credentials()
+        results[cloud_cls._REPR] = (ok, reason)  # pylint: disable=protected-access
+    path = _cache_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump({'time': time.time(),
+                   'enabled': [c for c, (ok, _) in results.items() if ok]}, f)
+    if not quiet:
+        for c, (ok, reason) in sorted(results.items()):
+            mark = 'enabled' if ok else f'disabled ({reason})'
+            print(f'  {c}: {mark}')
+    return results
+
+
+def get_cached_enabled_clouds(refresh: bool = False) -> List[str]:
+    path = _cache_path()
+    if not refresh and os.path.exists(path):
+        try:
+            with open(path, encoding='utf-8') as f:
+                data = json.load(f)
+            if time.time() - data.get('time', 0) < _CACHE_TTL_S:
+                return list(data.get('enabled', []))
+        except (json.JSONDecodeError, OSError):
+            pass
+    results = check_capabilities(quiet=True)
+    return [c for c, (ok, _) in results.items() if ok]
+
+
+def get_enabled_clouds_or_raise() -> List[str]:
+    enabled = get_cached_enabled_clouds()
+    if not enabled:
+        raise exceptions.NoCloudAccessError(
+            'No cloud is enabled. Run `stpu check` for reasons; for GCP run '
+            '`gcloud auth application-default login`.')
+    return enabled

@@ -1,0 +1,8 @@
+"""Clouds package. Importing it registers all built-in clouds."""
+from skypilot_tpu.clouds.cloud import Cloud, CloudImplementationFeatures, Region
+from skypilot_tpu.clouds.fake import Fake
+from skypilot_tpu.clouds.gcp import GCP
+from skypilot_tpu.clouds.local import Local
+
+__all__ = ['Cloud', 'CloudImplementationFeatures', 'Region', 'GCP', 'Local',
+           'Fake']

@@ -1,0 +1,119 @@
+"""Cloud abstraction base class.
+
+Reference analog: ``sky/clouds/cloud.py:140`` (``Cloud``), feature flags at
+``cloud.py:33``, provisioner versioning at ``:92``.  A Cloud knows its
+catalog, credentials, and how to turn a partial ``Resources`` into concrete
+*launchable* candidates; the provision layer (``skypilot_tpu/provision``) owns
+actual instance CRUD.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+
+class CloudImplementationFeatures(enum.Enum):
+    """What a cloud supports (reference: ``clouds/cloud.py:33``). The backend
+    checks task requirements against this set and fails fast with
+    NotSupportedError instead of deep in provisioning."""
+    MULTI_NODE = 'multi_node'
+    SPOT_INSTANCE = 'spot_instance'
+    STOP = 'stop'
+    AUTOSTOP = 'autostop'
+    OPEN_PORTS = 'open_ports'
+    STORAGE_MOUNTING = 'storage_mounting'
+    TPU_SLICE = 'tpu_slice'
+    MULTISLICE = 'multislice'
+    CUSTOM_DISK_SIZE = 'custom_disk_size'
+
+
+@dataclasses.dataclass
+class Region:
+    name: str
+    zones: List[str] = dataclasses.field(default_factory=list)
+
+
+class Cloud:
+    """Subclass + ``@CLOUD_REGISTRY.register`` to add a provider."""
+
+    _REPR = 'cloud'
+
+    # -- identity / capabilities ------------------------------------------
+
+    @property
+    def name(self) -> str:
+        return self._REPR
+
+    @classmethod
+    def supported_features(cls) -> set:
+        return set()
+
+    @classmethod
+    def check_features_are_supported(cls, requested: set) -> None:
+        unsupported = requested - cls.supported_features()
+        if unsupported:
+            raise exceptions.NotSupportedError(
+                f'{cls._REPR} does not support: '
+                f'{sorted(f.value for f in unsupported)}')
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        """(ok, reason-if-not). Reference: per-cloud ``check_credentials``."""
+        return False, f'{cls._REPR} has no credential check implemented.'
+
+    # -- geography ---------------------------------------------------------
+
+    def regions(self) -> List[Region]:
+        raise NotImplementedError
+
+    def zones_for(self, resources: Resources) -> Iterator[Tuple[str, str]]:
+        """Yield (region, zone) candidates for launchable resources, cheapest
+        first — the iteration order of the failover loop
+        (reference: ``_yield_zones``, ``cloud_vm_ray_backend.py:776``)."""
+        raise NotImplementedError
+
+    # -- planning ----------------------------------------------------------
+
+    def get_feasible_launchable_resources(
+            self, resources: Resources) -> List[Resources]:
+        """Concrete candidates (instance type/region pinned, price attached)
+        satisfying a partial request; cheapest first; [] if infeasible.
+        Reference: ``Cloud.get_feasible_launchable_resources``."""
+        raise NotImplementedError
+
+    def estimate_hourly_cost(self, resources: Resources) -> float:
+        assert resources.price_per_hour is not None, (
+            f'{resources} missing price; came from '
+            'get_feasible_launchable_resources?')
+        return resources.price_per_hour
+
+    def make_deploy_variables(self, resources: Resources,
+                              cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str],
+                              num_nodes: int) -> Dict[str, Any]:
+        """Template/provisioner variables (reference:
+        ``Resources.make_deploy_variables``, ``resources.py:1541`` +
+        ``clouds/gcp.py:509-544`` for the TPU block)."""
+        raise NotImplementedError
+
+    # -- provision routing -------------------------------------------------
+
+    @property
+    def provisioner_module(self) -> str:
+        """Dotted module under skypilot_tpu.provision implementing the
+        uniform provision interface for this cloud."""
+        raise NotImplementedError
+
+    def __repr__(self) -> str:
+        return self._REPR.upper() if self._REPR == 'gcp' else self._REPR.capitalize()
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Cloud) and self._REPR == other._REPR
+
+    def __hash__(self) -> int:
+        return hash(self._REPR)

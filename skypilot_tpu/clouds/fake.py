@@ -1,0 +1,125 @@
+"""Fake cloud: the in-process TPU topology backend for tests.
+
+SURVEY.md §4's key gap in the reference: *"add a fake TPU topology backend
+(the reference lacks one) so multi-host slice logic is unit-testable without
+TPU quota."*  This cloud mirrors the GCP TPU catalog (same slice names,
+topologies, prices) but its provisioner (``provision/fake``) materializes
+instances as in-memory records + optional local worker processes, with
+injectable stockouts and preemptions for failover/recovery tests.
+
+Enabled only when ``SKYTPU_ENABLE_FAKE_CLOUD=1`` (set by the
+``enable_fake_cloud`` fixture), so it never shows up for real users.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.catalog import gcp_catalog
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.topology import GENERATIONS
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+Features = cloud_lib.CloudImplementationFeatures
+
+
+@CLOUD_REGISTRY.register
+class Fake(cloud_lib.Cloud):
+
+    _REPR = 'fake'
+
+    @classmethod
+    def supported_features(cls) -> set:
+        return {
+            Features.MULTI_NODE, Features.SPOT_INSTANCE, Features.STOP,
+            Features.AUTOSTOP, Features.OPEN_PORTS, Features.TPU_SLICE,
+            Features.MULTISLICE, Features.CUSTOM_DISK_SIZE,
+        }
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        if os.environ.get('SKYTPU_ENABLE_FAKE_CLOUD') == '1':
+            return True, None
+        return False, 'fake cloud is test-only (SKYTPU_ENABLE_FAKE_CLOUD=1).'
+
+    def regions(self) -> List[cloud_lib.Region]:
+        # Reuse GCP geography so zone-failover tests look realistic.
+        df = gcp_catalog.list_accelerators()
+        out: Dict[str, List[str]] = {}
+        for _, row in df.iterrows():
+            out.setdefault(row['Region'], [])
+            if row['AvailabilityZone'] not in out[row['Region']]:
+                out[row['Region']].append(row['AvailabilityZone'])
+        return [cloud_lib.Region(name=r, zones=z) for r, z in sorted(out.items())]
+
+    def zones_for(self, resources: Resources) -> Iterator[Tuple[str, str]]:
+        if resources.tpu is not None:
+            rows = gcp_catalog.get_tpu_offerings(
+                resources.tpu.name, region=resources.region,
+                zone=resources.zone, use_spot=resources.use_spot)
+        elif resources.instance_type not in (None, 'fake-vm'):
+            rows = gcp_catalog.get_vm_offerings(
+                resources.instance_type, region=resources.region,
+                zone=resources.zone, use_spot=resources.use_spot)
+        else:
+            yield resources.region or 'us-west4', resources.zone or 'us-west4-a'
+            return
+        for row in rows:
+            yield row['Region'], row['AvailabilityZone']
+
+    def get_feasible_launchable_resources(
+            self, resources: Resources) -> List[Resources]:
+        if resources.cloud is not None and resources.cloud != self._REPR:
+            return []
+        if resources.accelerator_name is not None and resources.tpu is None:
+            return []
+        if resources.tpu is not None:
+            rows = gcp_catalog.get_tpu_offerings(
+                resources.tpu.name, region=resources.region,
+                zone=resources.zone, use_spot=resources.use_spot)
+            seen = set()
+            out = []
+            for row in rows:
+                if row['Region'] in seen:
+                    continue
+                seen.add(row['Region'])
+                price = row['SpotPrice' if resources.use_spot else 'Price']
+                out.append(resources.copy(cloud=self._REPR,
+                                          region=row['Region'],
+                                          _price_per_hour=float(price)))
+            return out
+        return [resources.copy(cloud=self._REPR,
+                               region=resources.region or 'us-west4',
+                               instance_type='fake-vm', _price_per_hour=0.01)]
+
+    def make_deploy_variables(self, resources: Resources,
+                              cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str],
+                              num_nodes: int) -> Dict[str, Any]:
+        v: Dict[str, Any] = {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': zone,
+            'use_spot': resources.use_spot,
+            'num_nodes': num_nodes,
+        }
+        if resources.tpu is not None:
+            sl = resources.tpu
+            v.update({
+                'tpu_vm': True,
+                'accelerator_type': sl.accelerator_type,
+                'topology': sl.topology_str,
+                'hosts_per_slice': sl.hosts,
+                'runtime_version':
+                    resources.accelerator_args.runtime_version or
+                    GENERATIONS[sl.generation].default_runtime_version,
+            })
+        else:
+            v.update({'tpu_vm': False, 'instance_type':
+                      resources.instance_type or 'fake-vm'})
+        return v
+
+    @property
+    def provisioner_module(self) -> str:
+        return 'skypilot_tpu.provision.fake'

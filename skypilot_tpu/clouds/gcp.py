@@ -1,0 +1,164 @@
+"""GCP cloud: TPU slices (primary) + CPU VMs.
+
+Reference analog: ``sky/clouds/gcp.py`` — TPU deploy vars at ``:509-544``,
+TPU-VM cpu/mem quirks at ``:739-768``, TPU quota/spot rules at ``:1098-1101``.
+The TPU-native inversion: the *slice* path is primary; a request with
+``accelerators: tpu-*`` resolves directly against the TPU catalog (topology
+rows included), and CPU VMs are the secondary path for controller/setup tasks.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu.catalog import gcp_catalog
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.topology import GENERATIONS
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+Features = cloud_lib.CloudImplementationFeatures
+
+
+@CLOUD_REGISTRY.register
+class GCP(cloud_lib.Cloud):
+
+    _REPR = 'gcp'
+
+    @classmethod
+    def supported_features(cls) -> set:
+        return {
+            Features.MULTI_NODE, Features.SPOT_INSTANCE, Features.STOP,
+            Features.AUTOSTOP, Features.OPEN_PORTS, Features.STORAGE_MOUNTING,
+            Features.TPU_SLICE, Features.MULTISLICE, Features.CUSTOM_DISK_SIZE,
+        }
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        """Valid iff application-default credentials / service account key is
+        present. No network call here (mirrors the reference's local-file
+        check); API reachability is validated at first provision."""
+        adc = os.path.expanduser(
+            '~/.config/gcloud/application_default_credentials.json')
+        sa_key = os.environ.get('GOOGLE_APPLICATION_CREDENTIALS')
+        if sa_key and os.path.exists(os.path.expanduser(sa_key)):
+            return True, None
+        if os.path.exists(adc):
+            return True, None
+        return False, (
+            'GCP credentials not found. Run `gcloud auth application-default '
+            'login` or set GOOGLE_APPLICATION_CREDENTIALS.')
+
+    def regions(self) -> List[cloud_lib.Region]:
+        df = gcp_catalog.list_accelerators()
+        out: Dict[str, List[str]] = {}
+        for _, row in df.iterrows():
+            out.setdefault(row['Region'], [])
+            if row['AvailabilityZone'] not in out[row['Region']]:
+                out[row['Region']].append(row['AvailabilityZone'])
+        return [cloud_lib.Region(name=r, zones=z) for r, z in sorted(out.items())]
+
+    def zones_for(self, resources: Resources) -> Iterator[Tuple[str, str]]:
+        if resources.tpu is not None:
+            rows = gcp_catalog.get_tpu_offerings(
+                resources.tpu.name, region=resources.region,
+                zone=resources.zone, use_spot=resources.use_spot)
+        else:
+            assert resources.instance_type is not None, resources
+            rows = gcp_catalog.get_vm_offerings(
+                resources.instance_type, region=resources.region,
+                zone=resources.zone, use_spot=resources.use_spot)
+        for row in rows:
+            yield row['Region'], row['AvailabilityZone']
+
+    def get_feasible_launchable_resources(
+            self, resources: Resources) -> List[Resources]:
+        if resources.cloud is not None and resources.cloud != self._REPR:
+            return []
+        # Non-TPU accelerators (GPUs) are not in this build's GCP catalog.
+        if resources.accelerator_name is not None and resources.tpu is None:
+            return []
+        out: List[Resources] = []
+        if resources.tpu is not None:
+            rows = gcp_catalog.get_tpu_offerings(
+                resources.tpu.name, region=resources.region,
+                zone=resources.zone, use_spot=resources.use_spot)
+            seen_regions = set()
+            for row in rows:
+                if row['Region'] in seen_regions:
+                    continue  # one candidate per region; zones iterate later
+                seen_regions.add(row['Region'])
+                price = row['SpotPrice' if resources.use_spot else 'Price']
+                out.append(resources.copy(
+                    cloud=self._REPR, region=row['Region'],
+                    _price_per_hour=float(price)))
+            return out
+        # CPU path: resolve instance type from cpus/memory request.
+        if resources.instance_type is not None:
+            rows = gcp_catalog.get_vm_offerings(
+                resources.instance_type, region=resources.region,
+                zone=resources.zone, use_spot=resources.use_spot)
+            seen_regions = set()
+            for row in rows:
+                if row['Region'] in seen_regions:
+                    continue
+                seen_regions.add(row['Region'])
+                price = row['SpotPrice' if resources.use_spot else 'Price']
+                out.append(resources.copy(
+                    cloud=self._REPR, region=row['Region'],
+                    _price_per_hour=float(price)))
+            return out
+        cpus, cpus_plus = resources.cpus_requirement()
+        mem, mem_plus = resources.memory_requirement()
+        row = gcp_catalog.get_instance_type_for_cpus(
+            cpus, cpus_plus, mem, mem_plus, region=resources.region,
+            use_spot=resources.use_spot)
+        if row is None:
+            return []
+        price = row['SpotPrice' if resources.use_spot else 'Price']
+        return [resources.copy(
+            cloud=self._REPR, region=row['Region'],
+            instance_type=row['InstanceType'], _price_per_hour=float(price))]
+
+    def make_deploy_variables(self, resources: Resources,
+                              cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str],
+                              num_nodes: int) -> Dict[str, Any]:
+        project_id = config_lib.get_nested(('gcp', 'project_id'),
+                                           os.environ.get('GOOGLE_CLOUD_PROJECT'))
+        base: Dict[str, Any] = {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'project_id': project_id,
+            'region': region,
+            'zone': zone,
+            'use_spot': resources.use_spot,
+            'disk_size_gb': resources.disk_size,
+            'labels': resources.labels,
+            'num_nodes': num_nodes,
+        }
+        if resources.tpu is not None:
+            sl = resources.tpu
+            runtime_version = (resources.accelerator_args.runtime_version or
+                               resources.image_id or
+                               GENERATIONS[sl.generation].default_runtime_version)
+            base.update({
+                'tpu_vm': True,
+                'accelerator_type': sl.accelerator_type,
+                'topology': sl.topology_str,
+                'hosts_per_slice': sl.hosts,
+                'runtime_version': runtime_version,
+                'reserved': resources.accelerator_args.reserved,
+                'network': resources.accelerator_args.network or 'default',
+            })
+        else:
+            base.update({
+                'tpu_vm': False,
+                'instance_type': resources.instance_type,
+                'image_id': resources.image_id,
+            })
+        return base
+
+    @property
+    def provisioner_module(self) -> str:
+        return 'skypilot_tpu.provision.gcp'

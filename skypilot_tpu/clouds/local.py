@@ -1,0 +1,72 @@
+"""Local "cloud": run tasks as processes on this machine.
+
+Reference analog: the BYO-SSH cloud (``sky/clouds/ssh.py``) + the Slurm
+cloud's ``uses_ray()=False`` execution path (``clouds/slurm.py:77``) — an
+always-available provider that needs no cloud credentials.  Used for
+`stpu launch --cloud local`, for the end-to-end path in environments with no
+cloud access, and as the substrate for controller processes in tests.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import psutil
+
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+Features = cloud_lib.CloudImplementationFeatures
+
+
+@CLOUD_REGISTRY.register
+class Local(cloud_lib.Cloud):
+
+    _REPR = 'local'
+
+    @classmethod
+    def supported_features(cls) -> set:
+        # No STOP/SPOT: a local process cluster is either up or down.
+        return {Features.MULTI_NODE, Features.AUTOSTOP, Features.OPEN_PORTS}
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        return True, None
+
+    def regions(self) -> List[cloud_lib.Region]:
+        return [cloud_lib.Region(name='local', zones=['local'])]
+
+    def zones_for(self, resources: Resources) -> Iterator[Tuple[str, str]]:
+        yield 'local', 'local'
+
+    def get_feasible_launchable_resources(
+            self, resources: Resources) -> List[Resources]:
+        if resources.cloud is not None and resources.cloud != self._REPR:
+            return []
+        if resources.accelerator_name is not None:
+            # TPUs via `local` only when this host actually has chips —
+            # checked at provision; planning-wise we only accept cpu tasks.
+            return []
+        if resources.use_spot:
+            return []
+        cpus, cpus_plus = resources.cpus_requirement()
+        ncpu = psutil.cpu_count() or 1
+        if cpus is not None and not cpus_plus and cpus > ncpu:
+            return []
+        if cpus is not None and cpus_plus and cpus > ncpu:
+            return []
+        return [resources.copy(cloud=self._REPR, region='local', zone='local',
+                               instance_type='local', _price_per_hour=0.0)]
+
+    def make_deploy_variables(self, resources: Resources,
+                              cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str],
+                              num_nodes: int) -> Dict[str, Any]:
+        return {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'num_nodes': num_nodes,
+        }
+
+    @property
+    def provisioner_module(self) -> str:
+        return 'skypilot_tpu.provision.local_cloud'

@@ -1,0 +1,83 @@
+"""Uniform provision interface, routed per cloud.
+
+Reference analog: ``sky/provision/__init__.py:45-290`` — a fixed set of
+module-level functions (``run_instances``, ``stop_instances``,
+``terminate_instances``, ``wait_instances``, ``get_cluster_info``,
+``query_instances``, ``open_ports``, ``cleanup_ports``) that every provider
+implements, dispatched by ``@_route_to_cloud_impl``.  We keep the same
+shape with an explicit router.
+"""
+from __future__ import annotations
+
+import importlib
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common
+from skypilot_tpu.utils import timeline
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+
+def _impl(provider_name: str):
+    import skypilot_tpu.clouds  # noqa: F401 — registers clouds
+    cloud = CLOUD_REGISTRY.from_str(provider_name)
+    return importlib.import_module(cloud.provisioner_module + '.instance')
+
+
+@timeline.event
+def run_instances(provider_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    """Create (or resume) all instances; atomic per slice for TPU providers."""
+    return _impl(provider_name).run_instances(config)
+
+
+@timeline.event
+def wait_instances(provider_name: str, region: str,
+                   cluster_name_on_cloud: str, state: str) -> None:
+    return _impl(provider_name).wait_instances(region, cluster_name_on_cloud,
+                                               state)
+
+
+@timeline.event
+def stop_instances(provider_name: str, cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
+    return _impl(provider_name).stop_instances(cluster_name_on_cloud,
+                                               provider_config)
+
+
+@timeline.event
+def terminate_instances(provider_name: str, cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None) -> None:
+    return _impl(provider_name).terminate_instances(cluster_name_on_cloud,
+                                                    provider_config)
+
+
+def query_instances(provider_name: str, cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Optional[str]]:
+    """instance_id -> normalized status ('running'|'stopped'|'terminated'|...)."""
+    return _impl(provider_name).query_instances(cluster_name_on_cloud,
+                                                provider_config)
+
+
+@timeline.event
+def get_cluster_info(provider_name: str, region: str,
+                     cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    return _impl(provider_name).get_cluster_info(region, cluster_name_on_cloud,
+                                                 provider_config)
+
+
+def open_ports(provider_name: str, cluster_name_on_cloud: str,
+               ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    impl = _impl(provider_name)
+    if hasattr(impl, 'open_ports'):
+        impl.open_ports(cluster_name_on_cloud, ports, provider_config)
+
+
+def cleanup_ports(provider_name: str, cluster_name_on_cloud: str,
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    impl = _impl(provider_name)
+    if hasattr(impl, 'cleanup_ports'):
+        impl.cleanup_ports(cluster_name_on_cloud, provider_config)

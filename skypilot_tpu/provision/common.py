@@ -1,0 +1,101 @@
+"""Provision-layer dataclasses shared by all providers.
+
+Reference analog: ``sky/provision/common.py`` (``ProvisionConfig :48``,
+``ProvisionRecord :84``, ``InstanceInfo :113``, ``ClusterInfo :132``).  The
+TPU-first change: an *instance* is a slice **worker host**, and a
+``ClusterInfo`` groups workers by ``node_id`` (slice index) — one slice spans
+many workers, mirroring how the reference emits one ``InstanceInfo`` per TPU
+``networkEndpoint`` (``provision/gcp/instance_utils.py:1649-1670``) but typed
+instead of special-cased.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class ProvisionConfig:
+    """Everything a provider needs to create a cluster's instances."""
+    provider_name: str
+    region: str
+    zone: Optional[str]
+    cluster_name: str  # display name
+    cluster_name_on_cloud: str
+    num_nodes: int  # slices (TPU) or VMs (CPU)
+    node_config: Dict[str, Any]  # cloud-specific (deploy variables)
+    tags: Dict[str, str] = dataclasses.field(default_factory=dict)
+    resume_stopped_nodes: bool = True
+    ports_to_open: Optional[List[str]] = None
+
+
+@dataclasses.dataclass
+class InstanceInfo:
+    """One worker host (a TPU slice worker VM, a CPU VM, or a local proc)."""
+    instance_id: str
+    node_id: int  # which task-node (slice index) this worker belongs to
+    worker_id: int  # rank within the slice (TPU_WORKER_ID)
+    internal_ip: str
+    external_ip: Optional[str]
+    status: str  # provider-native status string
+    tags: Dict[str, str] = dataclasses.field(default_factory=dict)
+    ssh_port: int = 22
+
+
+@dataclasses.dataclass
+class ClusterInfo:
+    """Full membership of a provisioned cluster.
+
+    ``head_instance_id`` is slice 0 / worker 0 — the coordinator host, which
+    plays the role the reference's Ray head + ``JAX_COORDINATOR_ADDR`` source
+    both play.
+    """
+    instances: List[InstanceInfo]
+    head_instance_id: Optional[str]
+    provider_name: str
+    region: str
+    zone: Optional[str]
+    ssh_user: str = 'skytpu'
+    ssh_key_path: Optional[str] = None
+    docker_user: Optional[str] = None
+
+    def get_head(self) -> Optional[InstanceInfo]:
+        for inst in self.instances:
+            if inst.instance_id == self.head_instance_id:
+                return inst
+        return None
+
+    def workers_of_node(self, node_id: int) -> List[InstanceInfo]:
+        return sorted((i for i in self.instances if i.node_id == node_id),
+                      key=lambda i: i.worker_id)
+
+    @property
+    def num_nodes(self) -> int:
+        return len({i.node_id for i in self.instances})
+
+    @property
+    def num_workers(self) -> int:
+        return len(self.instances)
+
+    def all_workers_sorted(self) -> List[InstanceInfo]:
+        """Global host order: (node_id, worker_id) — defines global host rank."""
+        return sorted(self.instances, key=lambda i: (i.node_id, i.worker_id))
+
+    def ip_list(self) -> List[str]:
+        return [i.internal_ip for i in self.all_workers_sorted()]
+
+
+@dataclasses.dataclass
+class ProvisionRecord:
+    """Result of run_instances: what was created/resumed."""
+    provider_name: str
+    region: str
+    zone: Optional[str]
+    cluster_name_on_cloud: str
+    head_instance_id: Optional[str]
+    created_instance_ids: List[str] = dataclasses.field(default_factory=list)
+    resumed_instance_ids: List[str] = dataclasses.field(default_factory=list)
+
+    def is_instance_just_booted(self, instance_id: str) -> bool:
+        return (instance_id in self.created_instance_ids or
+                instance_id in self.resumed_instance_ids)

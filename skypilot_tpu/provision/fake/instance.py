@@ -1,0 +1,181 @@
+"""Fake provisioner: in-memory TPU topology backend for tests.
+
+The testing gap SURVEY.md §4 calls out in the reference: multi-node logic is
+only testable by mocking the provision interface ad hoc.  Here the fake
+provider *implements* the interface with full slice semantics:
+
+* atomic slice acquisition — a multi-host slice materializes all workers or
+  raises (stockout), never partially;
+* injectable per-zone stockouts (``inject_stockout``) to drive the
+  failover loop (reference behavior under test:
+  ``cloud_vm_ray_backend.py:932`` ``_retry_zones``);
+* injectable preemption (``preempt_cluster``) — all workers of a slice
+  vanish at once, the TPU failure mode (SURVEY.md §7 hard parts);
+* stop/resume, status queries, and deterministic fake IPs.
+
+State is process-global so backend code under test sees a consistent cloud;
+``reset_state()`` runs per-test from the ``enable_fake_cloud`` fixture.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Set
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+
+_lock = threading.RLock()
+# cluster_name_on_cloud -> {'config': ProvisionConfig, 'instances': {id: dict}}
+_clusters: Dict[str, Dict[str, Any]] = {}
+_stockout_zones: Set[str] = set()
+_stockout_once_zones: Set[str] = set()
+_provision_attempts: List[str] = []  # zone per run_instances call (for asserts)
+
+
+def reset_state() -> None:
+    with _lock:
+        _clusters.clear()
+        _stockout_zones.clear()
+        _stockout_once_zones.clear()
+        _provision_attempts.clear()
+
+
+def inject_stockout(zone: str, once: bool = False) -> None:
+    with _lock:
+        (_stockout_once_zones if once else _stockout_zones).add(zone)
+
+
+def clear_stockout(zone: str) -> None:
+    with _lock:
+        _stockout_zones.discard(zone)
+        _stockout_once_zones.discard(zone)
+
+
+def provision_attempts() -> List[str]:
+    with _lock:
+        return list(_provision_attempts)
+
+
+def preempt_cluster(cluster_name_on_cloud: str) -> None:
+    """Simulate spot reclamation: every worker of every slice terminates."""
+    with _lock:
+        cluster = _clusters.get(cluster_name_on_cloud)
+        if cluster is None:
+            return
+        for inst in cluster['instances'].values():
+            inst['status'] = 'terminated'
+
+
+def list_cluster_names() -> List[str]:
+    with _lock:
+        return list(_clusters)
+
+
+def _fake_ip(cluster: str, node_id: int, worker_id: int) -> str:
+    h = abs(hash(cluster)) % 200
+    return f'10.{h}.{node_id}.{worker_id + 10}'
+
+
+def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    zone = config.zone or f'{config.region}-a'
+    with _lock:
+        _provision_attempts.append(zone)
+        if zone in _stockout_once_zones:
+            _stockout_once_zones.discard(zone)
+            raise exceptions.QuotaExceededError(
+                f'[fake] transient stockout in {zone}')
+        if zone in _stockout_zones:
+            raise exceptions.QuotaExceededError(
+                f'[fake] no capacity for {config.node_config.get("accelerator_type", "vm")} '
+                f'in {zone}')
+        name = config.cluster_name_on_cloud
+        hosts_per_slice = int(config.node_config.get('hosts_per_slice', 1))
+        cluster = _clusters.setdefault(
+            name, {'config': config, 'instances': {}})
+        created, resumed = [], []
+        for node_id in range(config.num_nodes):
+            for worker_id in range(hosts_per_slice):
+                iid = f'{name}-n{node_id}-w{worker_id}'
+                inst = cluster['instances'].get(iid)
+                if inst is None:
+                    cluster['instances'][iid] = {
+                        'instance_id': iid,
+                        'node_id': node_id,
+                        'worker_id': worker_id,
+                        'internal_ip': _fake_ip(name, node_id, worker_id),
+                        'status': 'running',
+                        'tags': dict(config.tags),
+                    }
+                    created.append(iid)
+                elif inst['status'] in ('stopped', 'terminated'):
+                    inst['status'] = 'running'
+                    resumed.append(iid)
+        head = f'{name}-n0-w0'
+        return common.ProvisionRecord(
+            provider_name='fake', region=config.region, zone=zone,
+            cluster_name_on_cloud=name, head_instance_id=head,
+            created_instance_ids=created, resumed_instance_ids=resumed)
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: str) -> None:
+    # In-memory instances transition instantly.
+    del region, state
+    with _lock:
+        if cluster_name_on_cloud not in _clusters:
+            raise exceptions.ClusterDoesNotExist(cluster_name_on_cloud)
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del provider_config
+    with _lock:
+        cluster = _clusters.get(cluster_name_on_cloud)
+        if cluster is None:
+            return
+        for inst in cluster['instances'].values():
+            if inst['status'] == 'running':
+                inst['status'] = 'stopped'
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del provider_config
+    with _lock:
+        _clusters.pop(cluster_name_on_cloud, None)
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Optional[str]]:
+    del provider_config
+    with _lock:
+        cluster = _clusters.get(cluster_name_on_cloud)
+        if cluster is None:
+            return {}
+        return {iid: i['status'] for iid, i in cluster['instances'].items()}
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    del provider_config
+    with _lock:
+        cluster = _clusters.get(cluster_name_on_cloud)
+        if cluster is None:
+            raise exceptions.ClusterDoesNotExist(cluster_name_on_cloud)
+        instances = [
+            common.InstanceInfo(
+                instance_id=i['instance_id'], node_id=i['node_id'],
+                worker_id=i['worker_id'], internal_ip=i['internal_ip'],
+                external_ip=i['internal_ip'], status=i['status'],
+                tags=dict(i['tags']))
+            for i in cluster['instances'].values() if i['status'] == 'running'
+        ]
+        head = f'{cluster_name_on_cloud}-n0-w0'
+        return common.ClusterInfo(
+            instances=instances,
+            head_instance_id=head if any(
+                i.instance_id == head for i in instances) else None,
+            provider_name='fake', region=region,
+            zone=cluster['config'].zone)

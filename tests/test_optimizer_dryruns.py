@@ -1,0 +1,107 @@
+"""Optimizer dryrun tests (reference analog: tests/test_optimizer_dryruns.py —
+accelerator→instance resolution incl. TPU names, no credentials needed)."""
+import pytest
+
+from skypilot_tpu import Dag, Resources, Task, exceptions, optimizer
+
+
+@pytest.fixture(autouse=True)
+def _fake_cloud(enable_fake_cloud):
+    yield
+
+
+def _opt(task_or_dag):
+    return optimizer.optimize(task_or_dag)
+
+
+def test_tpu_slice_resolution():
+    t = Task(run='x').set_resources(Resources(accelerators='tpu-v5e-16'))
+    _opt(t)
+    best = t.best_resources
+    assert best is not None
+    assert best.cloud == 'fake'
+    assert best.region is not None
+    assert best.tpu.hosts == 4
+    assert best.price_per_hour == pytest.approx(1.20 * 16)
+
+
+def test_spot_picks_spot_price():
+    t = Task(run='x').set_resources(
+        Resources(accelerators='tpu-v5e-16', use_spot=True))
+    _opt(t)
+    assert t.best_resources.price_per_hour == pytest.approx(0.48 * 16)
+
+
+def test_cheapest_generation_among_any_of():
+    t = Task(run='x').set_resources([
+        Resources(accelerators='tpu-v6e-8'),
+        Resources(accelerators='tpu-v5e-8'),
+    ])
+    _opt(t)
+    # v5e ($1.20/chip) beats v6e ($2.70/chip) on cost.
+    assert t.best_resources.tpu.generation == 'v5e'
+
+
+def test_cpu_task_resolution():
+    t = Task(run='x').set_resources(Resources(cpus='1+'))
+    _opt(t)
+    # local cloud is free and feasible → beats fake-vm.
+    assert t.best_resources.cloud == 'local'
+    assert t.best_resources.price_per_hour == 0.0
+
+
+def test_cpu_task_exceeding_local_falls_back():
+    import psutil
+    ncpu = psutil.cpu_count() or 1
+    t = Task(run='x').set_resources(Resources(cpus=f'{ncpu + 7}+'))
+    _opt(t)
+    assert t.best_resources.cloud == 'fake'
+
+
+def test_region_pin_respected():
+    t = Task(run='x').set_resources(
+        Resources(accelerators='tpu-v5e-16', region='europe-west4'))
+    _opt(t)
+    assert t.best_resources.region == 'europe-west4'
+    # regional multiplier applied
+    assert t.best_resources.price_per_hour > 1.20 * 16
+
+
+def test_infeasible_raises():
+    t = Task(run='x').set_resources(
+        Resources(accelerators='tpu-v4-8', region='europe-west4'))
+    with pytest.raises(exceptions.ResourcesUnfeasibleError):
+        _opt(t)  # v4 only offered in us-central2
+
+
+def test_chain_dp_runs():
+    with Dag() as d:
+        a = Task('a', run='x').set_resources(Resources(cpus='2+'))
+        b = Task('b', run='x').set_resources(
+            Resources(accelerators='tpu-v5e-8'))
+        a >> b
+    _opt(d)
+    assert a.best_resources is not None
+    assert b.best_resources.tpu is not None
+
+
+def test_non_chain_dag_enumeration():
+    with Dag() as d:
+        a = Task('a', run='x').set_resources(Resources(cpus='2+'))
+        b = Task('b', run='x').set_resources(Resources(cpus='2+'))
+        c = Task('c', run='x').set_resources(
+            Resources(accelerators='tpu-v5e-8'))
+        a >> c
+        b >> c
+    _opt(d)
+    assert c.best_resources.tpu.chips == 8
+
+
+def test_blocked_resources_skipped():
+    t = Task(run='x').set_resources(Resources(accelerators='tpu-v5e-16'))
+    _opt(t)
+    first = t.best_resources
+    t2 = Task(run='x').set_resources(Resources(accelerators='tpu-v5e-16'))
+    optimizer.optimize(t2, blocked_resources=[first])
+    assert t2.best_resources != first
+    assert t2.best_resources.price_per_hour >= first.price_per_hour
