@@ -1,0 +1,103 @@
+"""Flagship benchmark: Llama train-step throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline derivation (BASELINE.md / reference
+``examples/tpu/v6e/README.md:33-44``): the reference's flagship recipe
+(HF Llama-3-8B, PyTorch/XLA, FSDP, adafactor, seq 8192) reached
+0.476 samples/s on v6e-8 = 487.4 tokens/s/chip; in HF's own 6*N*T
+``total_flos`` convention that is 6 * 8.03e9 * 487.4 = **23.48 model
+TFLOP/s per chip** (≈2.6% of v6e peak — the recipe is badly tuned, which
+is exactly the headroom a TPU-native stack should reclaim).
+
+We measure the same quantity — achieved model FLOP/s per chip, 6*N*T over
+wall-clock — for our pjit train step (bf16, pallas flash attention, adafactor,
+remat) on whatever chip is attached (here: one v5e, peak 197 TFLOP/s bf16, so
+vs_baseline > 1 means beating the reference's per-chip utilization despite a
+4.7x slower chip than its v6e).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _bench_tpu() -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.train import Trainer, TrainerConfig
+    from skypilot_tpu.train import data as data_lib
+    from skypilot_tpu.train import trainer as trainer_mod
+
+    backend = jax.default_backend()
+    on_tpu = backend in ('tpu', 'axon')
+    if on_tpu:
+        cfg = TrainerConfig(model=llama.BENCH_1B, global_batch_size=4,
+                            seq_len=2048, optimizer='adafactor', remat=True)
+        warmup, iters = 2, 10
+    else:  # CPU fallback so the bench always emits a line
+        cfg = TrainerConfig(model=llama.TINY, global_batch_size=2,
+                            seq_len=128, optimizer='adafactor', remat=True)
+        warmup, iters = 1, 3
+
+    trainer = Trainer(cfg)
+    state = trainer.init_state(seed=0)
+    step = trainer.compiled_step()
+    batches = data_lib.synthetic_batches(
+        cfg.global_batch_size, cfg.seq_len, cfg.model.vocab_size, seed=0,
+        num_batches=warmup + iters)
+    batches = [jnp.asarray(b) for b in batches]
+
+    # Sync via host transfer of the metrics, not block_until_ready: on the
+    # sandbox's remote-TPU platform block_until_ready returns at dispatch
+    # time, which would overstate throughput ~300x. device_get forces the
+    # whole state-dependency chain to finish.
+    for b in batches[:warmup]:
+        state, metrics = step(state, b)
+    float(jax.device_get(metrics['loss']))
+
+    t0 = time.perf_counter()
+    for b in batches[warmup:]:
+        state, metrics = step(state, b)
+    final_loss = float(jax.device_get(metrics['loss']))
+    dt = time.perf_counter() - t0
+
+    steps_per_s = iters / dt
+    tokens_per_s = trainer_mod.tokens_per_step(cfg) * steps_per_s
+    model_flops_per_s = trainer_mod.model_flops_per_step(cfg) * steps_per_s
+    n_chips = jax.device_count()
+    tflops_per_chip = model_flops_per_s / n_chips / 1e12
+
+    baseline_tflops_per_chip = 23.48  # reference recipe, see module docstring
+    return {
+        'metric': 'llama_train_model_tflops_per_chip',
+        'value': round(tflops_per_chip, 3),
+        'unit': 'TFLOP/s/chip (6ND)',
+        'vs_baseline': round(tflops_per_chip / baseline_tflops_per_chip, 3),
+        'detail': {
+            'backend': backend,
+            'chips': n_chips,
+            'model_params': cfg.model.param_count,
+            'tokens_per_sec_per_chip': round(tokens_per_s / n_chips, 1),
+            'steps_per_sec': round(steps_per_s, 4),
+            'loss': round(final_loss, 4),
+            'seq_len': cfg.seq_len,
+            'global_batch': cfg.global_batch_size,
+            'cpu_fallback': not on_tpu,
+        },
+    }
+
+
+def main() -> None:
+    result = _bench_tpu()
+    print(json.dumps(result))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,3 @@
+from skypilot_tpu.models import llama
+
+__all__ = ['llama']

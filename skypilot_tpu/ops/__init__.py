@@ -1,0 +1,4 @@
+"""TPU kernels (pallas) and their reference fallbacks for the hot ops."""
+from skypilot_tpu.ops.attention import flash_attention
+
+__all__ = ['flash_attention']

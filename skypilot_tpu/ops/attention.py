@@ -1,0 +1,171 @@
+"""Flash attention for TPU (pallas) with a reference jnp fallback.
+
+Design (pallas_guide.md patterns):
+  * grid = (batch, q_heads, S // BLOCK_Q); each program owns one query block
+    and streams K/V for its (batch, kv_head) through VMEM.
+  * online softmax: running max ``m``, normalizer ``l``, fp32 accumulator —
+    no S x S matrix ever materializes in HBM.
+  * causal masking prunes the KV loop to blocks at-or-before the query block
+    (the loop bound is computed from ``program_id``, so the compiler still
+    sees a static grid).
+  * GQA: q_heads grouped onto n_kv_heads; the kv head index is derived from
+    the q head index.
+
+Backward pass: ``jax.custom_vjp`` whose bwd re-runs the *reference*
+implementation under ``jax.vjp`` on the saved (q, k, v).  Numerics match the
+kernel (same math, fp32 accum); memory cost is O(S^2) transiently per layer,
+which combined with per-layer remat is fine for trained context lengths; the
+long-context path (parallel/ring_attention.py) chunks over sequence instead.
+A fused pallas backward is a planned optimization, not a semantic change.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+BLOCK_Q = 128
+BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def _use_pallas() -> bool:
+    # 'axon' is the sandbox's remote-TPU platform name; same Mosaic path.
+    return jax.default_backend() in ('tpu', 'axon')
+
+
+# ---------------------------------------------------------------------------
+# Reference implementation (fallback + backward)
+# ---------------------------------------------------------------------------
+
+
+def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = True) -> jax.Array:
+    """Plain attention. q: [B, Hq, S, D]; k/v: [B, Hkv, S, D]; fp32 softmax."""
+    b, hq, s, d = q.shape
+    hkv = k.shape[1]
+    assert hq % hkv == 0, (hq, hkv)
+    group = hq // hkv
+    qg = q.reshape(b, hkv, group, s, d)
+    scale = d ** -0.5
+    logits = jnp.einsum('bhgqd,bhkd->bhgqk', qg, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        qi = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
+        ki = jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
+        logits = jnp.where(ki <= qi, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum('bhgqk,bhkd->bhgqd', probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, hq, s, d).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool,
+                      block_k: int, seq_len: int):
+    # q_ref: [BLOCK_Q, D]; k_ref/v_ref: [S, D]; o_ref: [BLOCK_Q, D]
+    q_blk_idx = pl.program_id(2)
+    q = q_ref[...].astype(jnp.float32)
+    d = q.shape[-1]
+    scale = d ** -0.5
+    q = q * scale
+
+    q_start = q_blk_idx * BLOCK_Q
+    if causal:
+        # Only KV blocks whose start is <= last query index participate.
+        num_k_blocks = (q_start + BLOCK_Q + block_k - 1) // block_k
+    else:
+        num_k_blocks = pl.cdiv(seq_len, block_k)
+
+    def body(kb, carry):
+        acc, m_prev, l_prev = carry
+        k_start = kb * block_k
+        kblk = k_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
+        vblk = v_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
+        s_ij = jax.lax.dot_general(
+            q, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [BLOCK_Q, block_k]
+        if causal:
+            qi = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (BLOCK_Q, block_k), 0)
+            ki = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (BLOCK_Q, block_k), 1)
+            s_ij = jnp.where(ki <= qi, s_ij, _NEG_INF)
+        m_cur = jnp.max(s_ij, axis=-1, keepdims=True)  # [BLOCK_Q, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s_ij - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((BLOCK_Q, d), jnp.float32)
+    m0 = jnp.full((BLOCK_Q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((BLOCK_Q, 1), jnp.float32)
+    acc, _, l = jax.lax.fori_loop(0, num_k_blocks, body, (acc0, m0, l0))
+    o_ref[...] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array,
+               causal: bool) -> jax.Array:
+    b, hq, s, d = q.shape
+    hkv = k.shape[1]
+    group = hq // hkv
+    assert s % BLOCK_Q == 0, f'seq_len {s} must be a multiple of {BLOCK_Q}'
+    block_k = min(BLOCK_K, s)
+    grid = (b, hq, s // BLOCK_Q)
+    kernel = functools.partial(_flash_fwd_kernel, causal=causal,
+                               block_k=block_k, seq_len=s)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            # `None` block dims are squeezed: refs arrive as [BLOCK_Q, D] /
+            # [S, D] inside the kernel.
+            pl.BlockSpec((None, None, BLOCK_Q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, s, d),
+                         lambda bi, hi, qi, _g=group: (bi, hi // _g, 0, 0)),
+            pl.BlockSpec((None, None, s, d),
+                         lambda bi, hi, qi, _g=group: (bi, hi // _g, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, BLOCK_Q, d),
+                               lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_attention(q, k, v, causal):
+    return _flash_fwd(q, k, v, causal)
+
+
+def _flash_attention_fwd(q, k, v, causal):
+    return _flash_fwd(q, k, v, causal), (q, k, v)
+
+
+def _flash_attention_bwd(causal, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda q_, k_, v_: attention_reference(q_, k_, v_, causal),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True) -> jax.Array:
+    """Public entrypoint. q: [B, Hq, S, D]; k/v: [B, Hkv, S, D] (GQA ok)."""
+    if _use_pallas() and q.shape[2] % BLOCK_Q == 0 and q.shape[-1] >= 64:
+        return _flash_attention(q, k, v, causal)
+    return attention_reference(q, k, v, causal)

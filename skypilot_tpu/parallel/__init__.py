@@ -1,0 +1,13 @@
+"""TPU parallelism primitives: mesh construction, sharding rules, collectives,
+and long-context sequence parallelism (ring attention).
+
+This package is the TPU-native answer to what the reference delegates to
+launched workloads + NCCL (SURVEY.md §2.11): here the framework ships its own
+mesh/sharding layer so recipes (models/, train/) are first-class citizens.
+"""
+from skypilot_tpu.parallel.mesh import MeshSpec, build_mesh
+from skypilot_tpu.parallel.sharding import (ShardingRules, logical_sharding,
+                                            shard_pytree)
+
+__all__ = ['MeshSpec', 'build_mesh', 'ShardingRules', 'logical_sharding',
+           'shard_pytree']

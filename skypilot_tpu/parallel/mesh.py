@@ -1,0 +1,96 @@
+"""Device mesh construction for TPU slices and multislice.
+
+The mesh axes follow the MaxText/scaling-book convention:
+
+* ``data``   — pure data parallelism (gradient all-reduce over DCN or ICI)
+* ``fsdp``   — sharded data parallel (params/optimizer sharded, all-gathered
+  per layer); maps to ICI
+* ``tensor`` — tensor (megatron-style) parallelism within attention/MLP
+  blocks; innermost, so it rides the fastest ICI neighbors
+* ``seq``    — sequence/context parallelism for long-context (ring attention)
+* ``expert`` — expert parallelism for MoE
+
+For multislice (num_nodes > 1 slices over DCN), the ``data`` axis is placed
+on the DCN dimension — collectives across slices are gradient all-reduces
+only, which tolerate DCN latency; everything bandwidth-hungry stays on ICI.
+This mirrors ``jax.experimental.mesh_utils.create_hybrid_device_mesh``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+AXIS_ORDER = ('data', 'fsdp', 'seq', 'expert', 'tensor')
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape. Unspecified axes default to 1; a single -1 axis
+    absorbs the remaining devices (like a reshape)."""
+    data: int = 1
+    fsdp: int = -1
+    seq: int = 1
+    expert: int = 1
+    tensor: int = 1
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        sizes = {a: getattr(self, a) for a in AXIS_ORDER}
+        minus = [a for a, s in sizes.items() if s == -1]
+        if len(minus) > 1:
+            raise ValueError(f'At most one -1 axis allowed, got {minus}')
+        known = math.prod(s for s in sizes.values() if s != -1)
+        if minus:
+            if n_devices % known:
+                raise ValueError(
+                    f'{n_devices} devices not divisible by fixed axes {sizes}')
+            sizes[minus[0]] = n_devices // known
+        if math.prod(sizes.values()) != n_devices:
+            raise ValueError(
+                f'Mesh {sizes} does not use all {n_devices} devices.')
+        return sizes
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return AXIS_ORDER
+
+
+def build_mesh(spec: Optional[MeshSpec] = None,
+               devices: Optional[Sequence[jax.Device]] = None,
+               num_slices: int = 1) -> Mesh:
+    """Build a Mesh with all five logical axes.
+
+    ``num_slices > 1``: hybrid ICI/DCN mesh — the ``data`` axis must be a
+    multiple of num_slices so inter-slice traffic is data-parallel only.
+    """
+    spec = spec or MeshSpec()
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    sizes = spec.resolve(n)
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    if num_slices > 1:
+        if sizes['data'] % num_slices:
+            raise ValueError(
+                f"data axis ({sizes['data']}) must be a multiple of "
+                f'num_slices ({num_slices}) for DCN placement.')
+        dcn_parallelism = [1] * len(AXIS_ORDER)
+        dcn_parallelism[0] = num_slices
+        ici_shape = list(shape)
+        ici_shape[0] //= num_slices
+        device_array = mesh_utils.create_hybrid_device_mesh(
+            tuple(ici_shape), tuple(dcn_parallelism), devices=devices)
+    else:
+        device_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    return Mesh(device_array, AXIS_ORDER)
+
+
+def single_device_mesh() -> Mesh:
+    """1-device mesh with all axes size 1 — lets the same pjit'd train step
+    run on one chip (bench) and a pod (prod) without code changes."""
+    dev = np.array(jax.devices()[:1]).reshape((1,) * len(AXIS_ORDER))
+    return Mesh(dev, AXIS_ORDER)

@@ -1,0 +1,89 @@
+"""Logical-axis sharding rules.
+
+Params and activations are annotated with *logical* axis names
+(``'embed'``, ``'mlp'``, ``'heads'``, ``'vocab'``, ``'batch'``, ``'seqlen'``,
+``'layers'``); :class:`ShardingRules` maps logical names to mesh axes.  This
+is the scaling-book recipe: pick a mesh, annotate shardings, let XLA insert
+collectives.  Changing the parallelism strategy = changing the rule table,
+not the model code.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+LogicalAxes = Tuple[Optional[str], ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingRules:
+    """logical axis -> mesh axis (or tuple of mesh axes, or None=replicate).
+
+    The default is the standard FSDP+TP llama recipe:
+      * embed dim sharded over ``tensor`` for activations, params sharded
+        over ``fsdp`` on their largest dim;
+      * batch over ``(data, fsdp)`` — fsdp acts as extra data parallelism
+        for activations;
+      * attention heads and mlp hidden over ``tensor``;
+      * sequence over ``seq`` for ring attention / long context.
+    """
+    rules: Tuple[Tuple[str, Union[None, str, Tuple[str, ...]]], ...] = (
+        ('batch', ('data', 'fsdp')),
+        ('seqlen', 'seq'),
+        ('embed', 'fsdp'),
+        ('heads', 'tensor'),
+        ('kv_heads', 'tensor'),
+        ('mlp', 'tensor'),
+        ('vocab', 'tensor'),
+        ('head_dim', None),
+        ('layers', None),
+        ('act_embed', 'tensor'),
+    )
+
+    def mesh_axes(self, logical: Sequence[Optional[str]]) -> P:
+        table = dict(self.rules)
+        out = []
+        used = set()
+        for name in logical:
+            axis = table.get(name) if name is not None else None
+            # Never map two tensor dims onto the same mesh axis.
+            if axis is not None:
+                flat = (axis,) if isinstance(axis, str) else tuple(axis)
+                if any(a in used for a in flat):
+                    axis = None
+                else:
+                    used.update(flat)
+            out.append(axis)
+        return P(*out)
+
+
+def logical_sharding(mesh: Mesh, rules: ShardingRules,
+                     logical: Sequence[Optional[str]]) -> NamedSharding:
+    return NamedSharding(mesh, rules.mesh_axes(logical))
+
+
+def shard_pytree(tree: Any, logical_tree: Any, mesh: Mesh,
+                 rules: ShardingRules) -> Any:
+    """Apply per-leaf logical axes → NamedSharding via device_put."""
+    shardings = jax.tree.map(
+        lambda la: logical_sharding(mesh, rules, la), logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple))
+    return jax.device_put(tree, shardings)
+
+
+def sharding_tree(logical_tree: Any, mesh: Mesh, rules: ShardingRules) -> Any:
+    """Pytree of NamedShardings matching a pytree of logical-axes tuples
+    (for jit in_shardings/out_shardings)."""
+    return jax.tree.map(
+        lambda la: logical_sharding(mesh, rules, la), logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple))
+
+
+def constrain(x: jax.Array, mesh: Mesh, rules: ShardingRules,
+              logical: Sequence[Optional[str]]) -> jax.Array:
+    """with_sharding_constraint by logical names (no-op outside jit/mesh)."""
+    return jax.lax.with_sharding_constraint(
+        x, logical_sharding(mesh, rules, logical))

@@ -1,0 +1,3 @@
+from skypilot_tpu.train.trainer import Trainer, TrainerConfig
+
+__all__ = ['Trainer', 'TrainerConfig']

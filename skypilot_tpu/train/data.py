@@ -1,0 +1,43 @@
+"""Input pipelines: synthetic LM batches + byte-level text corpus.
+
+The reference recipe streams HF wikitext; with zero egress here, the
+equivalents are (a) a seeded synthetic stream with the same shapes (bench,
+tests) and (b) a byte-tokenizer over local text files (real-loss demos).
+Host-side numpy only — batches land on device via the trainer's shardings.
+"""
+from __future__ import annotations
+
+import os
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+def synthetic_batches(batch_size: int, seq_len: int, vocab_size: int,
+                      seed: int = 0,
+                      num_batches: Optional[int] = None) -> Iterator[np.ndarray]:
+    """Zipf-ish token distribution so loss curves look like language, not
+    uniform noise (uniform makes the loss start at ln(V) and stay there)."""
+    rng = np.random.default_rng(seed)
+    ranks = np.arange(1, vocab_size + 1, dtype=np.float64)
+    probs = 1.0 / ranks
+    probs /= probs.sum()
+    i = 0
+    while num_batches is None or i < num_batches:
+        yield rng.choice(vocab_size, size=(batch_size, seq_len),
+                         p=probs).astype(np.int32)
+        i += 1
+
+
+def byte_corpus_batches(path: str, batch_size: int, seq_len: int,
+                        seed: int = 0) -> Iterator[np.ndarray]:
+    """Next-byte LM over a local file (vocab 256)."""
+    with open(os.path.expanduser(path), 'rb') as f:
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    if len(data) < seq_len + 1:
+        raise ValueError(f'{path} too small ({len(data)} bytes) for '
+                         f'seq_len={seq_len}')
+    rng = np.random.default_rng(seed)
+    while True:
+        starts = rng.integers(0, len(data) - seq_len - 1, size=batch_size)
+        yield np.stack([data[s:s + seq_len] for s in starts]).astype(np.int32)

@@ -11,13 +11,20 @@ the module-level os.environ writes at import time.
 """
 import os
 
-# Force an 8-device virtual CPU platform for all tests, before jax import.
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# Force an 8-device virtual CPU platform for all tests, before jax backend
+# init. The sandbox presets JAX_PLATFORMS=axon (the single real TPU chip) and
+# its sitecustomize imports jax at interpreter start, latching config from
+# env — so the override must go through jax.config, not os.environ alone.
+# Backends are not yet initialized when conftest loads, so this takes effect.
+os.environ['JAX_PLATFORMS'] = 'cpu'
 _flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in _flags:
     os.environ['XLA_FLAGS'] = (
         _flags + ' --xla_force_host_platform_device_count=8').strip()
-os.environ.setdefault('SKYTPU_STATE_DB_DIR_FOR_TESTS', '')
+
+import jax
+
+jax.config.update('jax_platforms', 'cpu')
 
 import pytest
 
