@@ -1,0 +1,110 @@
+"""Ring attention: sequence/context parallelism for long context.
+
+The reference framework has **no** long-context support of its own — it
+delegates to launched workloads (SURVEY.md §5 "long-context / sequence
+parallelism: absent by design").  Here it is first-class: shard the sequence
+over the ``seq`` mesh axis and rotate K/V shards around the ring with
+``ppermute`` (ICI neighbor exchange), overlapping each hop with the local
+attention block.  Memory per chip is O(S/n), enabling context lengths that
+cannot fit a single chip's HBM.
+
+Math: blockwise-stable online softmax (same accumulation as the flash
+kernel, ``ops/attention.py``), so the result equals full causal attention to
+within bf16 rounding.  Collective pattern follows the public ring-attention
+formulation (Liu et al.) expressed with ``jax.lax.ppermute`` — XLA overlaps
+the permute DMA with the block einsum.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, q_start, k_start, causal):
+    """One (q_shard x kv_shard) block: returns (unnormalized out, m, l)."""
+    b, hq, sq, d = q.shape
+    hkv = k.shape[1]
+    group = hq // hkv
+    qg = q.reshape(b, hkv, group, sq, d).astype(jnp.float32)
+    scale = d ** -0.5
+    s_ij = jnp.einsum('bhgqd,bhkd->bhgqk', qg, k.astype(jnp.float32),
+                      preferred_element_type=jnp.float32) * scale
+    if causal:
+        sk = k.shape[2]
+        qi = q_start + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        ki = k_start + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s_ij = jnp.where((ki <= qi)[None, None, None], s_ij, _NEG_INF)
+    m = jnp.max(s_ij, axis=-1, keepdims=True)
+    # No stop_gradient on the shift: the max must flow consistently through
+    # both p and the cross-block alpha/beta rescales or softmax's shift-
+    # cancellation breaks in the backward pass. Guard fully-masked rows
+    # (m = -inf) by clamping the shift and zeroing their probabilities.
+    p = jnp.exp(s_ij - jnp.maximum(m, _NEG_INF / 2))
+    p = jnp.where(s_ij <= _NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum('bhgqk,bhkd->bhgqd', p, v.astype(jnp.float32),
+                   preferred_element_type=jnp.float32)
+    return o, m, l
+
+
+def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
+                         axis_name: str = 'seq',
+                         causal: bool = True) -> jax.Array:
+    """Per-shard body (call inside shard_map). q/k/v: [B, H(q|kv), S_loc, D]
+    sharded on S over ``axis_name``; returns the local output shard."""
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, hq, s_loc, d = q.shape
+    hkv = k.shape[1]
+    group = hq // hkv
+    q_start = my_idx * s_loc
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def body(i, carry):
+        acc, m_run, l_run, k_cur, v_cur = carry
+        src = (my_idx - i) % n  # whose KV shard we hold this step
+        k_start = src * s_loc
+        o_blk, m_blk, l_blk = _block_attn(q, k_cur, v_cur, q_start, k_start,
+                                          causal)
+        m_new = jnp.maximum(m_run, m_blk)
+        alpha = jnp.exp(m_run - m_new)
+        beta = jnp.exp(m_blk - m_new)
+        acc = acc * alpha + o_blk * beta
+        l_new = l_run * alpha + l_blk * beta
+        # Rotate KV around the ring (skip after the last block).
+        k_nxt, v_nxt = jax.lax.cond(
+            i < n - 1,
+            lambda kv: (jax.lax.ppermute(kv[0], axis_name, perm),
+                        jax.lax.ppermute(kv[1], axis_name, perm)),
+            lambda kv: kv,
+            (k_cur, v_cur))
+        return acc, m_new, l_new, k_nxt, v_nxt
+
+    acc0 = jnp.zeros((b, hkv, group, s_loc, d), jnp.float32)
+    m0 = jnp.full((b, hkv, group, s_loc, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hkv, group, s_loc, 1), jnp.float32)
+    acc, _, l, _, _ = jax.lax.fori_loop(0, n, body, (acc0, m0, l0, k, v))
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.reshape(b, hq, s_loc, d).astype(q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+                   causal: bool = True, axis_name: str = 'seq',
+                   batch_axes=('data', 'fsdp'),
+                   head_axis: Optional[str] = 'tensor') -> jax.Array:
+    """Sharded entrypoint: q [B, Hq, S, D], k/v [B, Hkv, S, D] with S sharded
+    over ``axis_name``. Wraps :func:`ring_attention_local` in shard_map."""
+    spec = P(batch_axes, head_axis, axis_name, None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention_local, axis_name=axis_name,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
