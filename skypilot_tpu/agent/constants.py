@@ -1,0 +1,62 @@
+"""On-cluster runtime constants: the environment contract.
+
+Reference analog: ``sky/skylet/constants.py:431-436`` — the
+``SKYPILOT_NUM_NODES / NODE_IPS / NODE_RANK / NUM_GPUS_PER_NODE`` contract
+that torchrun/deepspeed recipes consume.  The TPU-native contract keeps those
+names **verbatim** (so reference-style YAMLs run unchanged) and adds the
+JAX/libtpu layer: per-worker ``TPU_WORKER_ID``/``TPU_WORKER_HOSTNAMES``
+(intra-slice, consumed by libtpu topology discovery) and
+``JAX_COORDINATOR_ADDRESS``/``MEGASCALE_*`` (multislice over DCN,
+``jax.distributed.initialize`` contract).
+
+Rank semantics (SURVEY.md §7 hard parts): ``SKYPILOT_NODE_RANK`` counts
+*task nodes* = slices; ``SKYPILOT_WORKER_RANK`` counts hosts globally;
+``TPU_WORKER_ID`` counts hosts *within* a slice.  Single-slice multi-host
+jobs therefore see NODE_RANK=0 on every host — exactly what a jax program
+wants (one process group, libtpu handles intra-slice).
+"""
+
+# SkyPilot-compatible (per reference contract)
+ENV_NUM_NODES = 'SKYPILOT_NUM_NODES'
+ENV_NODE_RANK = 'SKYPILOT_NODE_RANK'
+ENV_NODE_IPS = 'SKYPILOT_NODE_IPS'
+ENV_NUM_GPUS_PER_NODE = 'SKYPILOT_NUM_GPUS_PER_NODE'  # chips per node (slice)
+ENV_TASK_ID = 'SKYPILOT_TASK_ID'
+ENV_CLUSTER_INFO = 'SKYPILOT_CLUSTER_INFO'
+
+# TPU-native additions
+ENV_NUM_SLICES = 'SKYTPU_NUM_SLICES'
+ENV_SLICE_ID = 'SKYTPU_SLICE_ID'
+ENV_WORKER_RANK = 'SKYTPU_WORKER_RANK'  # global host rank
+ENV_NUM_WORKERS = 'SKYTPU_NUM_WORKERS'  # global host count
+ENV_WORKER_IPS = 'SKYTPU_WORKER_IPS'
+ENV_CHIPS_PER_HOST = 'SKYTPU_CHIPS_PER_HOST'
+
+# libtpu / JAX contract
+ENV_TPU_WORKER_ID = 'TPU_WORKER_ID'
+ENV_TPU_WORKER_HOSTNAMES = 'TPU_WORKER_HOSTNAMES'
+ENV_JAX_COORDINATOR_ADDRESS = 'JAX_COORDINATOR_ADDRESS'
+ENV_JAX_COORDINATOR_PORT = 'JAX_COORDINATOR_PORT'
+ENV_JAX_NUM_PROCESSES = 'JAX_NUM_PROCESSES'
+ENV_JAX_PROCESS_ID = 'JAX_PROCESS_ID'
+
+# Multislice (DCN) — megascale contract
+ENV_MEGASCALE_COORDINATOR_ADDRESS = 'MEGASCALE_COORDINATOR_ADDRESS'
+ENV_MEGASCALE_NUM_SLICES = 'MEGASCALE_NUM_SLICES'
+ENV_MEGASCALE_SLICE_ID = 'MEGASCALE_SLICE_ID'
+ENV_MEGASCALE_PORT = 'MEGASCALE_PORT'
+
+JAX_COORDINATOR_PORT = 8476
+MEGASCALE_PORT = 8477
+
+# On-"cluster" filesystem layout (under the per-cluster runtime dir)
+CLUSTER_RUNTIME_DIR = '~/.skypilot_tpu/runtime/{cluster_name}'
+JOBS_SUBDIR = 'jobs'
+WORKDIR_SUBDIR = 'workdir'
+JOB_TABLE_DB = 'jobs.db'
+AUTOSTOP_FILE = 'autostop.json'
+AGENT_LOG = 'agent.log'
+
+RANK_LOG_FILE = 'rank-{rank}.log'
+MERGED_LOG_FILE = 'run.log'
+SETUP_LOG_FILE = 'setup.log'

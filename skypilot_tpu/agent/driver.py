@@ -1,0 +1,174 @@
+"""Gang-execution driver: runs one job across all slice workers.
+
+This replaces the reference's Ray-based driver program
+(``sky/backends/task_codegen.py`` ``RayCodeGen`` — placement group
+``STRICT_SPREAD`` ``:415-425``, rank/IP export ``:500-522``, per-node task
+submission ``:544-636``).  On TPU pods there is nothing for a general
+placement-group scheduler to do — the slice *is* the gang — so the driver is
+a plain process: read the job spec, run setup once per worker, fan the run
+command out to every worker with the rank env contract, aggregate exit codes
+(job fails iff any rank fails), update the job table.
+
+Invoked detached on the head (``python -m skypilot_tpu.agent.driver
+--cluster-dir D --job-id N``) so the submitting client can disconnect; logs
+and status remain pollable through the job table (reference behavior:
+``_exec_code_on_head``, ``cloud_vm_ray_backend.py:3739``).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+from typing import Any, Dict, List
+
+from skypilot_tpu.agent import constants, job_lib, log_lib
+from skypilot_tpu.utils.command_runner import RunnerSpec
+
+
+def build_worker_env(spec: Dict[str, Any], worker: Dict[str, Any],
+                     job_id: int) -> Dict[str, str]:
+    """The full rank/topology env contract for one worker host."""
+    workers: List[Dict[str, Any]] = spec['workers']
+    num_slices = spec['num_nodes']
+    chips_per_host = spec.get('chips_per_host', 0)
+    hosts_per_slice = max(1, len(workers) // max(1, num_slices))
+    node_id, worker_id = worker['node_id'], worker['worker_id']
+    global_rank = node_id * hosts_per_slice + worker_id
+    slice_workers = [w for w in workers if w['node_id'] == node_id]
+    slice_ips = [w['ip'] for w in sorted(slice_workers,
+                                         key=lambda w: w['worker_id'])]
+    node_ips = [w['ip'] for w in workers if w['worker_id'] == 0]
+    head_ip = workers[0]['ip']
+
+    env = {
+        constants.ENV_NUM_NODES: str(num_slices),
+        constants.ENV_NODE_RANK: str(node_id),
+        constants.ENV_NODE_IPS: '\n'.join(node_ips),
+        constants.ENV_NUM_GPUS_PER_NODE: str(chips_per_host * hosts_per_slice),
+        constants.ENV_TASK_ID: f'{spec["cluster_name"]}-{job_id}',
+        constants.ENV_NUM_SLICES: str(num_slices),
+        constants.ENV_SLICE_ID: str(node_id),
+        constants.ENV_WORKER_RANK: str(global_rank),
+        constants.ENV_NUM_WORKERS: str(len(workers)),
+        constants.ENV_WORKER_IPS: ','.join(w['ip'] for w in workers),
+        constants.ENV_CHIPS_PER_HOST: str(chips_per_host),
+    }
+    if spec.get('tpu', False):
+        env.update({
+            constants.ENV_TPU_WORKER_ID: str(worker_id),
+            constants.ENV_TPU_WORKER_HOSTNAMES: ','.join(slice_ips),
+            constants.ENV_JAX_COORDINATOR_ADDRESS:
+                f'{head_ip}:{constants.JAX_COORDINATOR_PORT}',
+            constants.ENV_JAX_COORDINATOR_PORT:
+                str(constants.JAX_COORDINATOR_PORT),
+            constants.ENV_JAX_NUM_PROCESSES: str(len(workers)),
+            constants.ENV_JAX_PROCESS_ID: str(global_rank),
+        })
+        if num_slices > 1:
+            env.update({
+                constants.ENV_MEGASCALE_COORDINATOR_ADDRESS:
+                    f'{head_ip}:{constants.MEGASCALE_PORT}',
+                constants.ENV_MEGASCALE_NUM_SLICES: str(num_slices),
+                constants.ENV_MEGASCALE_SLICE_ID: str(node_id),
+                constants.ENV_MEGASCALE_PORT: str(constants.MEGASCALE_PORT),
+            })
+    env.update(spec.get('envs', {}))
+    return env
+
+
+def _prefix_for(worker: Dict[str, Any], num_workers: int) -> str:
+    """Log prefix matching the reference's transcript convention
+    ((head, rank=0) / (workerN, rank=N), ``skylet/log_lib.py``)."""
+    if num_workers == 1:
+        return ''
+    rank = worker.get('global_rank', 0)
+    name = 'head' if rank == 0 else f'worker{rank}'
+    return f'({name}, rank={rank}) '
+
+
+def run_job(cluster_dir: str, job_id: int) -> int:
+    table = job_lib.JobTable(cluster_dir)
+    job = table.get(job_id)
+    assert job is not None, f'job {job_id} not found in {cluster_dir}'
+    log_dir = job['log_dir']
+    with open(os.path.join(log_dir, 'spec.json'), encoding='utf-8') as f:
+        spec = json.load(f)
+
+    workers = spec['workers']
+    hosts_per_slice = max(1, len(workers) // max(1, spec['num_nodes']))
+    for w in workers:
+        w['global_rank'] = w['node_id'] * hosts_per_slice + w['worker_id']
+    workers.sort(key=lambda w: w['global_rank'])
+
+    # -- setup phase (once per worker, parallel) ---------------------------
+    setup_cmd = spec.get('setup')
+    if setup_cmd:
+        table.set_status(job_id, job_lib.JobStatus.SETTING_UP,
+                         driver_pid=os.getpid())
+        gang = []
+        for w in workers:
+            runner = RunnerSpec.from_dict(w['runner'])
+            env = build_worker_env(spec, w, job_id)
+            argv = runner.make().popen_argv(setup_cmd, env=env,
+                                            cwd=spec.get('workdir_on_worker'))
+            log_path = os.path.join(
+                log_dir, f'setup-rank-{w["global_rank"]}.log')
+            gang.append((argv, env if runner.kind == 'local' else {},
+                         log_path, _prefix_for(w, len(workers))))
+        codes = log_lib.run_parallel_with_logs(gang)
+        if any(c != 0 for c in codes):
+            table.set_status(job_id, job_lib.JobStatus.FAILED_SETUP)
+            return 1
+
+    # -- run phase (gang) --------------------------------------------------
+    table.set_status(job_id, job_lib.JobStatus.RUNNING,
+                     driver_pid=os.getpid())
+    run_cmd = spec.get('run')
+    if not run_cmd:
+        table.set_status(job_id, job_lib.JobStatus.SUCCEEDED)
+        return 0
+    gang = []
+    for w in workers:
+        runner = RunnerSpec.from_dict(w['runner'])
+        env = build_worker_env(spec, w, job_id)
+        argv = runner.make().popen_argv(run_cmd, env=env,
+                                        cwd=spec.get('workdir_on_worker'))
+        log_path = os.path.join(
+            log_dir, constants.RANK_LOG_FILE.format(rank=w['global_rank']))
+        gang.append((argv, env if runner.kind == 'local' else {}, log_path,
+                     _prefix_for(w, len(workers))))
+    codes = log_lib.run_parallel_with_logs(gang)
+    ok = all(c == 0 for c in codes)
+    table.set_status(
+        job_id, job_lib.JobStatus.SUCCEEDED if ok else job_lib.JobStatus.FAILED)
+    return 0 if ok else 1
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--cluster-dir', required=True)
+    parser.add_argument('--job-id', type=int, required=True)
+    args = parser.parse_args()
+
+    # The driver's own stdout goes to the merged job log.
+    table = job_lib.JobTable(args.cluster_dir)
+    job = table.get(args.job_id)
+    assert job is not None
+    merged = os.path.join(job['log_dir'], constants.MERGED_LOG_FILE)
+    os.makedirs(job['log_dir'], exist_ok=True)
+    with open(merged, 'a', buffering=1, encoding='utf-8') as out:
+        os.dup2(out.fileno(), sys.stdout.fileno())
+        os.dup2(out.fileno(), sys.stderr.fileno())
+        try:
+            code = run_job(args.cluster_dir, args.job_id)
+        except Exception as e:  # noqa: BLE001 — record driver crashes
+            print(f'[driver] crashed: {e!r}')
+            table.set_status(args.job_id, job_lib.JobStatus.FAILED)
+            code = 1
+    sys.exit(code)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,149 @@
+"""Per-cluster job table + FIFO scheduler.
+
+Reference analog: ``sky/skylet/job_lib.py`` (``JobStatus :153``,
+``FIFOScheduler :350``) — a SQLite job queue living on the cluster head.
+Here the table lives in the cluster runtime dir; the gang driver
+(``agent/driver.py``) transitions statuses, and CLI ``queue``/``cancel``/
+``logs`` read it (over SSH for remote clusters, directly for local).
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+import filelock
+
+
+class JobStatus(enum.Enum):
+    INIT = 'INIT'
+    PENDING = 'PENDING'
+    SETTING_UP = 'SETTING_UP'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in (JobStatus.SUCCEEDED, JobStatus.FAILED,
+                        JobStatus.FAILED_SETUP, JobStatus.CANCELLED)
+
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS jobs (
+    job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT,
+    status TEXT NOT NULL,
+    submitted_at REAL NOT NULL,
+    started_at REAL,
+    ended_at REAL,
+    num_nodes INTEGER NOT NULL DEFAULT 1,
+    num_workers INTEGER NOT NULL DEFAULT 1,
+    driver_pid INTEGER,
+    log_dir TEXT,
+    metadata TEXT
+);
+"""
+
+
+class JobTable:
+
+    def __init__(self, cluster_dir: str):
+        self._dir = os.path.expanduser(cluster_dir)
+        os.makedirs(self._dir, exist_ok=True)
+        from skypilot_tpu.agent import constants
+        self._db_path = os.path.join(self._dir, constants.JOB_TABLE_DB)
+        self._lock = filelock.FileLock(self._db_path + '.lock')
+        with self._conn() as conn:
+            conn.executescript(_SCHEMA)
+
+    def _conn(self) -> sqlite3.Connection:
+        conn = sqlite3.connect(self._db_path, timeout=10)
+        conn.row_factory = sqlite3.Row
+        return conn
+
+    # -- writes ------------------------------------------------------------
+
+    def submit(self, name: Optional[str], num_nodes: int, num_workers: int,
+               log_dir: str, metadata: Optional[Dict[str, Any]] = None) -> int:
+        with self._lock, self._conn() as conn:
+            cur = conn.execute(
+                'INSERT INTO jobs (name, status, submitted_at, num_nodes, '
+                'num_workers, log_dir, metadata) VALUES (?, ?, ?, ?, ?, ?, ?)',
+                (name, JobStatus.PENDING.value, time.time(), num_nodes,
+                 num_workers, log_dir, json.dumps(metadata or {})))
+            return int(cur.lastrowid)
+
+    def set_status(self, job_id: int, status: JobStatus,
+                   driver_pid: Optional[int] = None) -> None:
+        sets = ['status = ?']
+        args: List[Any] = [status.value]
+        if status == JobStatus.RUNNING:
+            sets.append('started_at = COALESCE(started_at, ?)')
+            args.append(time.time())
+        if status.is_terminal():
+            sets.append('ended_at = ?')
+            args.append(time.time())
+        if driver_pid is not None:
+            sets.append('driver_pid = ?')
+            args.append(driver_pid)
+        args.append(job_id)
+        with self._lock, self._conn() as conn:
+            conn.execute(f'UPDATE jobs SET {", ".join(sets)} WHERE job_id = ?',
+                         args)
+
+    def cancel(self, job_id: int) -> Optional[int]:
+        """Mark cancelled; returns driver pid to kill (if running)."""
+        job = self.get(job_id)
+        if job is None or JobStatus(job['status']).is_terminal():
+            return None
+        self.set_status(job_id, JobStatus.CANCELLED)
+        return job['driver_pid']
+
+    # -- reads -------------------------------------------------------------
+
+    def get(self, job_id: int) -> Optional[Dict[str, Any]]:
+        with self._conn() as conn:
+            row = conn.execute('SELECT * FROM jobs WHERE job_id = ?',
+                               (job_id,)).fetchone()
+            return dict(row) if row else None
+
+    def list_jobs(self, limit: int = 100) -> List[Dict[str, Any]]:
+        with self._conn() as conn:
+            rows = conn.execute(
+                'SELECT * FROM jobs ORDER BY job_id DESC LIMIT ?',
+                (limit,)).fetchall()
+            return [dict(r) for r in rows]
+
+    def latest_job_id(self) -> Optional[int]:
+        with self._conn() as conn:
+            row = conn.execute('SELECT MAX(job_id) AS m FROM jobs').fetchone()
+            return row['m']
+
+    def next_pending(self) -> Optional[Dict[str, Any]]:
+        """FIFO: oldest PENDING job, only if nothing is running/setting up
+        (one gang job owns the slice at a time — what Ray placement groups
+        serialized in the reference, reference ``job_lib.py:350``)."""
+        with self._conn() as conn:
+            busy = conn.execute(
+                'SELECT COUNT(*) AS c FROM jobs WHERE status IN (?, ?)',
+                (JobStatus.RUNNING.value,
+                 JobStatus.SETTING_UP.value)).fetchone()['c']
+            if busy:
+                return None
+            row = conn.execute(
+                'SELECT * FROM jobs WHERE status = ? ORDER BY job_id LIMIT 1',
+                (JobStatus.PENDING.value,)).fetchone()
+            return dict(row) if row else None
+
+    def unfinished_jobs(self) -> List[Dict[str, Any]]:
+        with self._conn() as conn:
+            rows = conn.execute(
+                'SELECT * FROM jobs WHERE status NOT IN (?, ?, ?, ?)',
+                tuple(s.value for s in JobStatus if s.is_terminal())
+            ).fetchall()
+            return [dict(r) for r in rows]

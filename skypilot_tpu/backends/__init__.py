@@ -1,0 +1,4 @@
+from skypilot_tpu.backends.backend import Backend, ClusterHandle
+from skypilot_tpu.backends.tpu_gang_backend import TpuGangBackend
+
+__all__ = ['Backend', 'ClusterHandle', 'TpuGangBackend']

@@ -1,0 +1,76 @@
+"""Abstract backend interface.
+
+Reference analog: ``sky/backends/backend.py:30`` — the five-phase contract
+(provision / sync_workdir / sync_file_mounts / setup / execute / teardown)
+that ``execution.py`` drives.  The sole real implementation is
+:class:`~skypilot_tpu.backends.tpu_gang_backend.TpuGangBackend` (the
+reference's sole real one is ``CloudVmRayBackend``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.task import Task
+
+
+@dataclasses.dataclass
+class ClusterHandle:
+    """Serializable record of a provisioned cluster.
+
+    Reference analog: ``CloudVmRayResourceHandle``
+    (``cloud_vm_ray_backend.py:1842``) — but JSON, and slice topology is
+    explicit (``hosts_per_node`` generalizes ``num_ips_per_node`` ``:2484``).
+    """
+    cluster_name: str
+    cluster_name_on_cloud: str
+    cloud: str
+    region: str
+    zone: Optional[str]
+    num_nodes: int  # slices
+    hosts_per_node: int
+    chips_per_host: int
+    launched_resources: Dict[str, Any]  # Resources.to_yaml_config()
+    is_tpu: bool = False
+    price_per_hour: Optional[float] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> 'ClusterHandle':
+        return cls(**d)
+
+    @property
+    def total_workers(self) -> int:
+        return self.num_nodes * self.hosts_per_node
+
+
+class Backend:
+
+    NAME = 'abstract'
+
+    def provision(self, task: Task, cluster_name: str,
+                  retry_until_up: bool = False,
+                  dryrun: bool = False) -> Optional[ClusterHandle]:
+        raise NotImplementedError
+
+    def sync_workdir(self, handle: ClusterHandle, workdir: str) -> None:
+        raise NotImplementedError
+
+    def sync_file_mounts(self, handle: ClusterHandle,
+                         file_mounts: Dict[str, str]) -> None:
+        raise NotImplementedError
+
+    def execute(self, handle: ClusterHandle, task: Task,
+                detach_run: bool = False,
+                include_setup: bool = True) -> int:
+        """Submit the task as a job; returns job_id."""
+        raise NotImplementedError
+
+    def tail_logs(self, handle: ClusterHandle, job_id: Optional[int],
+                  follow: bool = True) -> None:
+        raise NotImplementedError
+
+    def teardown(self, handle: ClusterHandle, terminate: bool = True) -> None:
+        raise NotImplementedError

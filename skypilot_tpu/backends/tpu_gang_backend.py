@@ -1,0 +1,373 @@
+"""TpuGangBackend: THE backend — provision-with-failover + gang execution.
+
+Reference analog: ``sky/backends/cloud_vm_ray_backend.py`` (5,936 LoC):
+``RetryingVmProvisioner.provision_with_retries :1637`` / ``_retry_zones
+:932`` (the failover loops), ``_exec_code_on_head :3739`` (job submission).
+TPU-native differences:
+
+* the provisioning atom is a **slice** — capacity errors blocklist
+  (zone x topology), not individual VMs (SURVEY.md §7 hard parts);
+* no Ray: the gang driver (``agent/driver.py``) fans the job out over all
+  slice workers with the rank env contract; the FIFO job table serializes
+  jobs per cluster;
+* the driver runs on the submitting host and reaches workers through
+  RunnerSpecs (local subprocess or pooled-ControlMaster SSH), which is the
+  Slurm-path execution model the reference already trusts
+  (``uses_ray()=False``, ``clouds/slurm.py:77``).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import check as check_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import provision as provision_lib
+from skypilot_tpu.agent import constants, job_lib, log_lib
+from skypilot_tpu.backends.backend import Backend, ClusterHandle
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import common_utils, timeline
+from skypilot_tpu.utils.command_runner import RunnerSpec
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+_PROVISION_LOG = 'provision.log'
+
+
+def runtime_dir(cluster_name: str) -> str:
+    return os.path.expanduser(
+        os.path.join(
+            os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'),
+            'runtime', cluster_name))
+
+
+class TpuGangBackend(Backend):
+
+    NAME = 'tpu_gang'
+
+    # -- provision ---------------------------------------------------------
+
+    @timeline.event
+    def provision(self, task: Task, cluster_name: str,
+                  retry_until_up: bool = False,
+                  dryrun: bool = False) -> Optional[ClusterHandle]:
+        common_utils.check_cluster_name_is_valid(cluster_name)
+        existing = global_user_state.get_cluster(cluster_name)
+        if existing is not None and existing['status'] == \
+                global_user_state.ClusterStatus.UP:
+            handle = ClusterHandle.from_dict(existing['handle'])
+            self._check_task_fits(task, handle)
+            return handle
+
+        enabled = check_lib.get_enabled_clouds_or_raise()
+        blocked: List[Resources] = []
+        failover_history: List[Exception] = []
+        while True:
+            candidates = optimizer_lib._fill_in_launchable_resources(  # pylint: disable=protected-access
+                task, enabled, blocked)
+            if not candidates:
+                raise exceptions.ResourcesUnavailableError(
+                    f'All candidate zones/regions failed for {task}. '
+                    f'History: {[str(e) for e in failover_history]}',
+                    failover_history=failover_history)
+            to_provision = candidates[0]
+            if dryrun:
+                print(f'[dryrun] would provision {to_provision!r} as '
+                      f'{cluster_name}')
+                return None
+            handle = self._try_provision_resources(
+                task, cluster_name, to_provision, failover_history)
+            if handle is not None:
+                return handle
+            blocked.append(to_provision)
+            if not retry_until_up and len(blocked) > 16:
+                raise exceptions.ResourcesUnavailableError(
+                    'Exhausted failover candidates.',
+                    failover_history=failover_history)
+
+    def _try_provision_resources(
+            self, task: Task, cluster_name: str, to_provision: Resources,
+            failover_history: List[Exception]) -> Optional[ClusterHandle]:
+        """The per-resources zone loop (reference ``_retry_zones :932``)."""
+        cloud = CLOUD_REGISTRY.from_str(to_provision.cloud)
+        name_on_cloud = common_utils.make_cluster_name_on_cloud(cluster_name)
+        global_user_state.add_cluster_event(
+            cluster_name, 'PROVISION_START', repr(to_provision))
+        for region, zone in cloud.zones_for(to_provision):
+            deploy_vars = cloud.make_deploy_variables(
+                to_provision, name_on_cloud, region, zone, task.num_nodes)
+            cfg = provision_common.ProvisionConfig(
+                provider_name=to_provision.cloud, region=region, zone=zone,
+                cluster_name=cluster_name,
+                cluster_name_on_cloud=name_on_cloud,
+                num_nodes=task.num_nodes, node_config=deploy_vars,
+                tags={'skytpu-cluster': cluster_name},
+                ports_to_open=to_provision.ports)
+            try:
+                provision_lib.run_instances(to_provision.cloud, cfg)
+                provision_lib.wait_instances(to_provision.cloud, region,
+                                             name_on_cloud, 'running')
+            except (exceptions.QuotaExceededError,
+                    exceptions.ResourcesUnavailableError) as e:
+                failover_history.append(e)
+                global_user_state.add_cluster_event(
+                    cluster_name, 'PROVISION_FAILOVER',
+                    f'{region}/{zone}: {e}')
+                continue
+            handle = ClusterHandle(
+                cluster_name=cluster_name,
+                cluster_name_on_cloud=name_on_cloud,
+                cloud=to_provision.cloud, region=region, zone=zone,
+                num_nodes=task.num_nodes,
+                hosts_per_node=to_provision.hosts_per_node,
+                chips_per_host=to_provision.chips_per_host,
+                launched_resources=to_provision.to_yaml_config(),
+                is_tpu=to_provision.tpu is not None,
+                price_per_hour=to_provision.price_per_hour)
+            os.makedirs(runtime_dir(cluster_name), exist_ok=True)
+            global_user_state.add_or_update_cluster(
+                cluster_name, handle.to_dict(),
+                global_user_state.ClusterStatus.UP, is_launch=True)
+            global_user_state.add_cluster_event(
+                cluster_name, 'PROVISION_DONE', f'{region}/{zone}')
+            return handle
+        return None
+
+    def _check_task_fits(self, task: Task, handle: ClusterHandle) -> None:
+        launched = Resources.from_yaml_config(handle.launched_resources)
+        assert isinstance(launched, Resources)
+        for res in task.resources_ordered:
+            if res.less_demanding_than(launched) or res == Resources():
+                return
+        raise exceptions.ResourcesUnfeasibleError(
+            f'Task {task.name!r} requires {task.resources_ordered} but '
+            f'cluster {handle.cluster_name!r} has {launched!r}. '
+            f'Use a new cluster or relax the requirement.')
+
+    # -- cluster info / runners -------------------------------------------
+
+    def _cluster_info(self, handle: ClusterHandle) -> provision_common.ClusterInfo:
+        return provision_lib.get_cluster_info(
+            handle.cloud, handle.region, handle.cluster_name_on_cloud)
+
+    def _runner_spec_for(self, handle: ClusterHandle,
+                         inst: provision_common.InstanceInfo) -> RunnerSpec:
+        if handle.cloud in ('local', 'fake'):
+            return RunnerSpec(kind='local', ip=inst.internal_ip)
+        info = self._cluster_info(handle)
+        return RunnerSpec(kind='ssh', ip=inst.external_ip or inst.internal_ip,
+                          user=info.ssh_user, ssh_key=info.ssh_key_path)
+
+    # -- sync --------------------------------------------------------------
+
+    @timeline.event
+    def sync_workdir(self, handle: ClusterHandle, workdir: str) -> None:
+        """Sync the user's workdir to every worker (rsync fan-out).
+
+        For local/fake clusters all workers share this host: one copy into
+        the cluster runtime dir."""
+        target = os.path.join(runtime_dir(handle.cluster_name),
+                              constants.WORKDIR_SUBDIR)
+        if handle.cloud in ('local', 'fake'):
+            RunnerSpec(kind='local').make().rsync(workdir, target, up=True)
+            return
+        info = self._cluster_info(handle)
+        for inst in info.all_workers_sorted():
+            self._runner_spec_for(handle, inst).make().rsync(
+                workdir, '~/sky_workdir', up=True)
+
+    @timeline.event
+    def sync_file_mounts(self, handle: ClusterHandle,
+                         file_mounts: Dict[str, str]) -> None:
+        if not file_mounts:
+            return
+        for dst, src in file_mounts.items():
+            src = os.path.expanduser(src)
+            if not os.path.exists(src):
+                raise exceptions.StorageError(
+                    f'file_mount source {src} does not exist')
+            if handle.cloud in ('local', 'fake'):
+                dst_local = dst
+                if not os.path.isabs(dst_local):
+                    dst_local = os.path.join(
+                        runtime_dir(handle.cluster_name),
+                        constants.WORKDIR_SUBDIR, dst_local)
+                if os.path.isdir(src):
+                    RunnerSpec(kind='local').make().rsync(src, dst_local)
+                else:
+                    os.makedirs(os.path.dirname(dst_local) or '/',
+                                exist_ok=True)
+                    shutil.copy2(src, dst_local)
+            else:
+                info = self._cluster_info(handle)
+                for inst in info.all_workers_sorted():
+                    self._runner_spec_for(handle, inst).make().rsync(
+                        src, dst, up=True)
+
+    # -- execute -----------------------------------------------------------
+
+    @timeline.event
+    def execute(self, handle: ClusterHandle, task: Task,
+                detach_run: bool = False,
+                include_setup: bool = True) -> int:
+        info = self._cluster_info(handle)
+        expected = handle.total_workers
+        if info.num_workers != expected:
+            raise exceptions.ClusterNotUpError(
+                f'Cluster {handle.cluster_name!r} has {info.num_workers} '
+                f'live workers, expected {expected} (preempted or partially '
+                'stopped?)')
+        cdir = runtime_dir(handle.cluster_name)
+        table = job_lib.JobTable(cdir)
+
+        workers = []
+        for inst in info.all_workers_sorted():
+            workers.append({
+                'node_id': inst.node_id,
+                'worker_id': inst.worker_id,
+                'ip': inst.internal_ip,
+                'runner': self._runner_spec_for(handle, inst).to_dict(),
+            })
+        workdir_on_worker = None
+        if task.workdir:
+            workdir_on_worker = (
+                os.path.join(cdir, constants.WORKDIR_SUBDIR)
+                if handle.cloud in ('local', 'fake') else '~/sky_workdir')
+
+        job_name = task.name or 'task'
+        log_root = os.path.join(cdir, constants.JOBS_SUBDIR)
+        job_id = table.submit(job_name, handle.num_nodes, len(workers),
+                              log_dir='pending')
+        log_dir = os.path.join(log_root, str(job_id))
+        os.makedirs(log_dir, exist_ok=True)
+        with open(os.path.join(cdir, constants.JOB_TABLE_DB), 'a'):
+            pass
+        # record real log dir
+        with table._lock, table._conn() as conn:  # pylint: disable=protected-access
+            conn.execute('UPDATE jobs SET log_dir = ? WHERE job_id = ?',
+                         (log_dir, job_id))
+
+        spec = {
+            'cluster_name': handle.cluster_name,
+            'num_nodes': handle.num_nodes,
+            'chips_per_host': handle.chips_per_host,
+            'tpu': handle.is_tpu,
+            'workers': workers,
+            'envs': task.envs_and_secrets,
+            'setup': task.setup if include_setup else None,
+            'run': task.run if isinstance(task.run, str) else None,
+            'workdir_on_worker': workdir_on_worker,
+        }
+        with open(os.path.join(log_dir, 'spec.json'), 'w',
+                  encoding='utf-8') as f:
+            json.dump(spec, f, indent=1)
+
+        # Detached driver: survives this process; job table tracks it.
+        driver_cmd = [
+            sys.executable, '-m', 'skypilot_tpu.agent.driver',
+            '--cluster-dir', cdir, '--job-id', str(job_id),
+        ]
+        env = dict(os.environ)
+        env['PYTHONPATH'] = (os.path.dirname(os.path.dirname(__file__)) +
+                             os.pathsep + env.get('PYTHONPATH', ''))
+        subprocess.Popen(driver_cmd, stdout=subprocess.DEVNULL,
+                         stderr=subprocess.DEVNULL, env=env,
+                         start_new_session=True)
+        global_user_state.touch_activity(handle.cluster_name)
+        global_user_state.add_cluster_event(
+            handle.cluster_name, 'JOB_SUBMITTED', f'job {job_id} {job_name}')
+        if not detach_run:
+            self.tail_logs(handle, job_id, follow=True)
+        return job_id
+
+    # -- logs / queue ------------------------------------------------------
+
+    def tail_logs(self, handle: ClusterHandle, job_id: Optional[int],
+                  follow: bool = True) -> None:
+        cdir = runtime_dir(handle.cluster_name)
+        table = job_lib.JobTable(cdir)
+        if job_id is None:
+            job_id = table.latest_job_id()
+        if job_id is None:
+            print('No jobs on this cluster.')
+            return
+        job = table.get(job_id)
+        if job is None:
+            raise exceptions.JobNotFoundError(f'Job {job_id} not found.')
+        log_path = os.path.join(job['log_dir'], constants.MERGED_LOG_FILE)
+
+        def _done() -> bool:
+            j = table.get(job_id)
+            return j is None or job_lib.JobStatus(j['status']).is_terminal()
+
+        log_lib.tail_log(log_path, follow=follow, stop_fn=_done)
+        if follow:
+            j = table.get(job_id)
+            if j:
+                print(f'Job {job_id} finished (status: {j["status"]}).')
+
+    def job_queue(self, handle: ClusterHandle) -> List[Dict[str, Any]]:
+        return job_lib.JobTable(runtime_dir(handle.cluster_name)).list_jobs()
+
+    def cancel_job(self, handle: ClusterHandle, job_id: int) -> bool:
+        table = job_lib.JobTable(runtime_dir(handle.cluster_name))
+        pid = table.cancel(job_id)
+        if pid:
+            try:
+                os.killpg(pid, 15)
+            except (ProcessLookupError, PermissionError):
+                try:
+                    os.kill(pid, 15)
+                except (ProcessLookupError, PermissionError):
+                    pass
+            return True
+        return False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @timeline.event
+    def teardown(self, handle: ClusterHandle, terminate: bool = True) -> None:
+        if terminate:
+            provision_lib.terminate_instances(handle.cloud,
+                                              handle.cluster_name_on_cloud)
+            global_user_state.remove_cluster(handle.cluster_name)
+            shutil.rmtree(runtime_dir(handle.cluster_name),
+                          ignore_errors=True)
+        else:
+            provision_lib.stop_instances(handle.cloud,
+                                         handle.cluster_name_on_cloud)
+            global_user_state.update_cluster_status(
+                handle.cluster_name, global_user_state.ClusterStatus.STOPPED)
+
+    def refresh_status(
+            self, cluster_name: str) -> Optional[global_user_state.ClusterStatus]:
+        """Query the provider and reconcile the cluster table (reference:
+        ``backend_utils.refresh_cluster_status``)."""
+        record = global_user_state.get_cluster(cluster_name)
+        if record is None:
+            return None
+        handle = ClusterHandle.from_dict(record['handle'])
+        statuses = provision_lib.query_instances(
+            handle.cloud, handle.cluster_name_on_cloud)
+        if not statuses:
+            # All instances gone: preempted or externally deleted.
+            global_user_state.remove_cluster(cluster_name)
+            return None
+        values = set(statuses.values())
+        expected = handle.total_workers
+        if values == {'running'} and len(statuses) == expected:
+            status = global_user_state.ClusterStatus.UP
+        elif values == {'stopped'}:
+            status = global_user_state.ClusterStatus.STOPPED
+        else:
+            status = global_user_state.ClusterStatus.INIT
+        global_user_state.update_cluster_status(cluster_name, status)
+        return status

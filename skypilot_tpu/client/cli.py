@@ -1,0 +1,289 @@
+"""CLI: the `stpu` command.
+
+Reference analog: ``sky/client/cli/command.py`` (6,921 LoC click CLI).  Same
+verb surface: launch/exec/status/queue/logs/cancel/stop/start/down/autostop/
+check/show-tpus/cost-report, plus `jobs` and `serve` sub-groups (wired as
+their planes land).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import List, Optional, Tuple
+
+import click
+
+from skypilot_tpu import exceptions
+
+
+def _clean_errors(f):
+    """Render framework errors as one-line CLI errors, not tracebacks."""
+    import functools
+
+    @functools.wraps(f)
+    def wrapper(*args, **kwargs):
+        try:
+            return f(*args, **kwargs)
+        except exceptions.SkyTpuError as e:
+            raise click.ClickException(str(e)) from e
+
+    return wrapper
+
+
+def _echo_table(rows: List[dict], columns: List[Tuple[str, str]]) -> None:
+    if not rows:
+        click.echo('(none)')
+        return
+    widths = {key: max(len(header), *(len(str(r.get(key, ''))) for r in rows))
+              for key, header in columns}
+    header = '  '.join(h.ljust(widths[k]) for k, h in columns)
+    click.echo(click.style(header, bold=True))
+    for r in rows:
+        click.echo('  '.join(
+            str(r.get(k, '')).ljust(widths[k]) for k, _ in columns))
+
+
+def _load_task(entrypoint: Tuple[str, ...], name: Optional[str],
+               workdir: Optional[str], cloud: Optional[str],
+               accelerators: Optional[str], num_nodes: Optional[int],
+               use_spot: Optional[bool], envs: Tuple[Tuple[str, str], ...],
+               secrets: Tuple[str, ...]):
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
+    if entrypoint and entrypoint[0].endswith(('.yaml', '.yml')):
+        task = Task.from_yaml(entrypoint[0])
+    elif entrypoint:
+        task = Task(run=' '.join(entrypoint))
+    else:
+        raise click.UsageError('Provide a task YAML or an inline command.')
+    if name:
+        task.name = name
+    if workdir:
+        task.workdir = workdir
+    if num_nodes:
+        task.num_nodes = num_nodes
+    overrides = {}
+    if cloud:
+        overrides['cloud'] = cloud
+    if accelerators:
+        overrides['accelerators'] = accelerators
+    if use_spot is not None:
+        overrides['use_spot'] = use_spot
+    if overrides:
+        task.set_resources([r.copy(**overrides)
+                            for r in task.resources_ordered])
+    if envs:
+        task.update_envs(dict(envs))
+    for s in secrets:
+        if '=' in s:
+            k, v = s.split('=', 1)
+        else:
+            k, v = s, os.environ.get(s, '')
+        task.update_secrets({k: v})
+    return task
+
+
+def _common_task_options(f):
+    f = click.option('--name', '-n', default=None)(f)
+    f = click.option('--workdir', default=None,
+                     type=click.Path(exists=True, file_okay=False))(f)
+    f = click.option('--cloud', default=None)(f)
+    f = click.option('--gpus', '--tpus', 'accelerators', default=None,
+                     help='Accelerator spec, e.g. tpu-v5e-16')(f)
+    f = click.option('--num-nodes', type=int, default=None,
+                     help='Number of slices (multislice when > 1)')(f)
+    f = click.option('--use-spot/--no-use-spot', default=None)(f)
+    f = click.option('--env', 'envs', multiple=True,
+                     type=(str, str))(f)
+    f = click.option('--secret', 'secrets', multiple=True)(f)
+    return f
+
+
+@click.group()
+@click.version_option('0.1.0', prog_name='stpu')
+def cli() -> None:
+    """skypilot_tpu: TPU-native cluster orchestration."""
+
+
+@cli.command()
+@click.argument('entrypoint', nargs=-1)
+@click.option('--cluster', '-c', default=None)
+@click.option('--detach-run', '-d', is_flag=True, default=False)
+@click.option('--retry-until-up', is_flag=True, default=False)
+@click.option('--idle-minutes-to-autostop', '-i', type=int, default=None)
+@click.option('--down', is_flag=True, default=False)
+@click.option('--dryrun', is_flag=True, default=False)
+@_common_task_options
+@_clean_errors
+def launch(entrypoint, cluster, detach_run, retry_until_up,
+           idle_minutes_to_autostop, down, dryrun, name, workdir, cloud,
+           accelerators, num_nodes, use_spot, envs, secrets):
+    """Provision a cluster (TPU slice or VM) and run a task on it."""
+    from skypilot_tpu import execution
+    task = _load_task(entrypoint, name, workdir, cloud, accelerators,
+                      num_nodes, use_spot, envs, secrets)
+    try:
+        job_id, handle = execution.launch(
+            task, cluster_name=cluster, retry_until_up=retry_until_up,
+            idle_minutes_to_autostop=idle_minutes_to_autostop, down=down,
+            detach_run=detach_run, dryrun=dryrun)
+    except exceptions.SkyTpuError as e:
+        raise click.ClickException(str(e)) from e
+    if handle is not None:
+        click.echo(f'Cluster: {handle.cluster_name} '
+                   f'(job {job_id if job_id is not None else "-"})')
+
+
+@cli.command('exec')
+@click.argument('cluster')
+@click.argument('entrypoint', nargs=-1)
+@click.option('--detach-run', '-d', is_flag=True, default=False)
+@_common_task_options
+@_clean_errors
+def exec_cmd(cluster, entrypoint, detach_run, name, workdir, cloud,
+             accelerators, num_nodes, use_spot, envs, secrets):
+    """Run a task on an existing cluster (no provisioning/setup)."""
+    from skypilot_tpu import execution
+    task = _load_task(entrypoint, name, workdir, cloud, accelerators,
+                      num_nodes, use_spot, envs, secrets)
+    try:
+        job_id, _ = execution.exec_(task, cluster, detach_run=detach_run)
+    except exceptions.SkyTpuError as e:
+        raise click.ClickException(str(e)) from e
+    click.echo(f'Job {job_id} submitted to {cluster}.')
+
+
+@cli.command()
+@click.option('--refresh', '-r', is_flag=True, default=False)
+@_clean_errors
+def status(refresh):
+    """Show clusters."""
+    from skypilot_tpu import core
+    rows = core.status(refresh=refresh)
+    _echo_table(rows, [('name', 'NAME'), ('status', 'STATUS'),
+                       ('cloud', 'CLOUD'), ('region', 'REGION'),
+                       ('resources', 'RESOURCES'), ('nodes', 'NODES'),
+                       ('workers', 'WORKERS'), ('autostop', 'AUTOSTOP')])
+
+
+@cli.command()
+@click.argument('cluster')
+@_clean_errors
+def queue(cluster):
+    """Show a cluster's job queue."""
+    from skypilot_tpu import core
+    rows = core.queue(cluster)
+    _echo_table(rows, [('job_id', 'ID'), ('name', 'NAME'),
+                       ('status', 'STATUS'), ('num_workers', 'WORKERS'),
+                       ('submitted_at', 'SUBMITTED')])
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('job_id', required=False, type=int)
+@click.option('--no-follow', is_flag=True, default=False)
+@_clean_errors
+def logs(cluster, job_id, no_follow):
+    """Tail a job's logs."""
+    from skypilot_tpu import core
+    core.tail_logs(cluster, job_id, follow=not no_follow)
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('job_id', required=False, type=int)
+@_clean_errors
+def cancel(cluster, job_id):
+    """Cancel a job."""
+    from skypilot_tpu import core
+    ok = core.cancel(cluster, job_id)
+    click.echo('Cancelled.' if ok else 'Nothing to cancel.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+@_clean_errors
+def down(clusters, yes):
+    """Terminate clusters."""
+    from skypilot_tpu import core
+    for c in clusters:
+        if not yes:
+            click.confirm(f'Terminate cluster {c}?', abort=True)
+        core.down(c)
+        click.echo(f'Terminated {c}.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@_clean_errors
+def stop(clusters):
+    """Stop clusters (restartable with `stpu start`)."""
+    from skypilot_tpu import core
+    for c in clusters:
+        core.stop(c)
+        click.echo(f'Stopped {c}.')
+
+
+@cli.command()
+@click.argument('cluster')
+@_clean_errors
+def start(cluster):
+    """Restart a stopped cluster."""
+    from skypilot_tpu import core
+    core.start(cluster)
+    click.echo(f'Started {cluster}.')
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--idle-minutes', '-i', type=int, required=True,
+              help='-1 cancels autostop')
+@click.option('--down', is_flag=True, default=False)
+def autostop(cluster, idle_minutes, down):
+    """Schedule automatic stop/down after idleness."""
+    from skypilot_tpu import core
+    core.autostop(cluster, idle_minutes, down=down)
+    click.echo(f'Autostop set on {cluster}: {idle_minutes}m '
+               f'({"down" if down else "stop"}).')
+
+
+@cli.command()
+@_clean_errors
+def check():
+    """Check cloud credentials."""
+    from skypilot_tpu import check as check_lib
+    results = check_lib.check_capabilities(quiet=False)
+    if not any(ok for ok, _ in results.values()):
+        sys.exit(1)
+
+
+@cli.command('show-tpus')
+@click.option('--name-filter', default=None)
+@click.option('--region', default=None)
+@_clean_errors
+def show_tpus(name_filter, region):
+    """List TPU slice offerings and prices (analog of `sky show-gpus`)."""
+    from skypilot_tpu.catalog import gcp_catalog
+    df = gcp_catalog.list_accelerators(name_filter, region)
+    rows = df.to_dict('records')
+    _echo_table(rows, [('AcceleratorName', 'ACCELERATOR'),
+                       ('Topology', 'TOPOLOGY'), ('Hosts', 'HOSTS'),
+                       ('Region', 'REGION'),
+                       ('AvailabilityZone', 'ZONE'),
+                       ('Price', '$/HR'), ('SpotPrice', '$/HR(SPOT)')])
+
+
+@cli.command('cost-report')
+@_clean_errors
+def cost_report():
+    """Estimated accumulated cost per cluster."""
+    from skypilot_tpu import core
+    _echo_table(core.cost_report(),
+                [('name', 'NAME'), ('duration_hours', 'HOURS'),
+                 ('price_per_hour', '$/HR'), ('cost', 'COST($)')])
+
+
+if __name__ == '__main__':
+    cli()

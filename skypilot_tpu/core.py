@@ -1,0 +1,155 @@
+"""Non-launch lifecycle operations.
+
+Reference analog: ``sky/core.py`` (status/start/stop/down/autostop/queue/
+cancel/logs/cost-report at ``core.py:99-1460``).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import provision as provision_lib
+from skypilot_tpu.agent import constants, job_lib
+from skypilot_tpu.backends import ClusterHandle, TpuGangBackend
+from skypilot_tpu.backends.tpu_gang_backend import runtime_dir
+from skypilot_tpu.resources import Resources
+
+
+def _get_handle(cluster_name: str) -> ClusterHandle:
+    record = global_user_state.get_cluster(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    return ClusterHandle.from_dict(record['handle'])
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> List[Dict[str, Any]]:
+    """Cluster table (reference ``core.status :99``)."""
+    backend = TpuGangBackend()
+    records = global_user_state.get_clusters()
+    if cluster_names:
+        records = [r for r in records if r['name'] in cluster_names]
+    out = []
+    for r in records:
+        if refresh:
+            new_status = backend.refresh_status(r['name'])
+            if new_status is None:
+                continue  # cluster vanished
+            r = global_user_state.get_cluster(r['name']) or r
+        handle = r['handle']
+        launched = Resources.from_yaml_config(
+            handle['launched_resources']) if handle else None
+        out.append({
+            'name': r['name'],
+            'status': r['status'].value if hasattr(r['status'], 'value')
+                      else r['status'],
+            'launched_at': r['launched_at'],
+            'resources': repr(launched) if launched else '-',
+            'cloud': handle['cloud'] if handle else '-',
+            'region': handle['region'] if handle else '-',
+            'nodes': handle['num_nodes'] if handle else 0,
+            'workers': (handle['num_nodes'] * handle['hosts_per_node'])
+                       if handle else 0,
+            'autostop': r.get('autostop_minutes', -1),
+            'price_per_hour': handle.get('price_per_hour') if handle else None,
+        })
+    return out
+
+
+def stop(cluster_name: str) -> None:
+    handle = _get_handle(cluster_name)
+    TpuGangBackend().teardown(handle, terminate=False)
+
+
+def start(cluster_name: str) -> None:
+    """Restart a stopped cluster's instances (reference ``core.start``)."""
+    record = global_user_state.get_cluster(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(cluster_name)
+    handle = ClusterHandle.from_dict(record['handle'])
+    from skypilot_tpu.provision import common as provision_common
+    cfg = provision_common.ProvisionConfig(
+        provider_name=handle.cloud, region=handle.region, zone=handle.zone,
+        cluster_name=cluster_name,
+        cluster_name_on_cloud=handle.cluster_name_on_cloud,
+        num_nodes=handle.num_nodes,
+        node_config={'hosts_per_slice': handle.hosts_per_node},
+        resume_stopped_nodes=True)
+    provision_lib.run_instances(handle.cloud, cfg)
+    global_user_state.update_cluster_status(
+        cluster_name, global_user_state.ClusterStatus.UP)
+
+
+def down(cluster_name: str) -> None:
+    handle = _get_handle(cluster_name)
+    TpuGangBackend().teardown(handle, terminate=True)
+
+
+def autostop(cluster_name: str, idle_minutes: int, down: bool = False) -> None:
+    """Set (or -1 to cancel) the autostop policy; enforced by the cluster
+    daemon (reference: ``skylet/autostop_lib.py`` + AutostopEvent)."""
+    _get_handle(cluster_name)  # existence check
+    global_user_state.set_autostop(cluster_name, idle_minutes, down)
+    cdir = runtime_dir(cluster_name)
+    os.makedirs(cdir, exist_ok=True)
+    with open(os.path.join(cdir, constants.AUTOSTOP_FILE), 'w',
+              encoding='utf-8') as f:
+        json.dump({'idle_minutes': idle_minutes, 'down': down,
+                   'set_at': time.time()}, f)
+
+
+def queue(cluster_name: str) -> List[Dict[str, Any]]:
+    handle = _get_handle(cluster_name)
+    return TpuGangBackend().job_queue(handle)
+
+
+def cancel(cluster_name: str, job_id: Optional[int] = None) -> bool:
+    handle = _get_handle(cluster_name)
+    backend = TpuGangBackend()
+    if job_id is None:
+        table = job_lib.JobTable(runtime_dir(cluster_name))
+        job_id = table.latest_job_id()
+        if job_id is None:
+            return False
+    return backend.cancel_job(handle, job_id)
+
+
+def tail_logs(cluster_name: str, job_id: Optional[int] = None,
+              follow: bool = True) -> None:
+    handle = _get_handle(cluster_name)
+    TpuGangBackend().tail_logs(handle, job_id, follow=follow)
+
+
+def job_status(cluster_name: str,
+               job_id: Optional[int] = None) -> Optional[str]:
+    _get_handle(cluster_name)
+    table = job_lib.JobTable(runtime_dir(cluster_name))
+    if job_id is None:
+        job_id = table.latest_job_id()
+    if job_id is None:
+        return None
+    job = table.get(job_id)
+    return job['status'] if job else None
+
+
+def cost_report() -> List[Dict[str, Any]]:
+    """Per-cluster accumulated cost estimate (reference ``core.py:1023``)."""
+    out = []
+    for r in global_user_state.get_clusters():
+        handle = r['handle']
+        if not handle:
+            continue
+        hours = (time.time() - (r['launched_at'] or time.time())) / 3600
+        price = handle.get('price_per_hour')
+        out.append({
+            'name': r['name'],
+            'duration_hours': round(hours, 2),
+            'price_per_hour': price,
+            'cost': round(price * hours, 2) if price is not None else None,
+        })
+    return out

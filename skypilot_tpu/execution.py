@@ -1,0 +1,104 @@
+"""Lifecycle driver: the staged launch/exec pipeline.
+
+Reference analog: ``sky/execution.py`` — ``Stage`` enum (``:41``),
+``_execute`` (``:105``), ``launch`` (``:539``), ``exec`` (``:736``).
+"""
+from __future__ import annotations
+
+import enum
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu.backends import ClusterHandle, TpuGangBackend
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import timeline
+
+
+class Stage(enum.Enum):
+    OPTIMIZE = 'OPTIMIZE'
+    PROVISION = 'PROVISION'
+    SYNC_WORKDIR = 'SYNC_WORKDIR'
+    SYNC_FILE_MOUNTS = 'SYNC_FILE_MOUNTS'
+    EXEC = 'EXEC'
+    DOWN = 'DOWN'
+
+
+def _generate_cluster_name() -> str:
+    return f'stpu-{uuid.uuid4().hex[:6]}'
+
+
+@timeline.event
+def launch(task: Task,
+           cluster_name: Optional[str] = None,
+           retry_until_up: bool = False,
+           idle_minutes_to_autostop: Optional[int] = None,
+           down: bool = False,
+           detach_run: bool = False,
+           dryrun: bool = False,
+           stages: Optional[List[Stage]] = None,
+           ) -> Tuple[Optional[int], Optional[ClusterHandle]]:
+    """Provision (or reuse) a cluster and run the task on it.
+
+    Returns (job_id, handle). Reference: ``execution.launch :539``.
+    """
+    cluster_name = cluster_name or _generate_cluster_name()
+    backend = TpuGangBackend()
+    stages = stages or list(Stage)
+
+    if Stage.OPTIMIZE in stages:
+        existing = global_user_state.get_cluster(cluster_name)
+        if existing is None and task.best_resources is None:
+            optimizer_lib.optimize(task)
+
+    handle: Optional[ClusterHandle] = None
+    if Stage.PROVISION in stages:
+        handle = backend.provision(task, cluster_name,
+                                   retry_until_up=retry_until_up,
+                                   dryrun=dryrun)
+        if dryrun:
+            return None, None
+    assert handle is not None
+
+    if idle_minutes_to_autostop is not None:
+        from skypilot_tpu import core
+        core.autostop(cluster_name, idle_minutes_to_autostop, down=down)
+
+    if Stage.SYNC_WORKDIR in stages and task.workdir:
+        backend.sync_workdir(handle, task.workdir)
+    if Stage.SYNC_FILE_MOUNTS in stages:
+        backend.sync_file_mounts(handle, task.file_mounts)
+
+    job_id: Optional[int] = None
+    if Stage.EXEC in stages and (task.run is not None or task.setup):
+        job_id = backend.execute(handle, task, detach_run=detach_run,
+                                 include_setup=True)
+    if Stage.DOWN in stages and down and idle_minutes_to_autostop is None:
+        backend.teardown(handle, terminate=True)
+        handle = None
+    return job_id, handle
+
+
+@timeline.event
+def exec_(task: Task, cluster_name: str,
+          detach_run: bool = False) -> Tuple[Optional[int], ClusterHandle]:
+    """Fast path: run on an existing cluster, skipping provision/setup
+    (reference: ``execution.exec :736`` — stages=[SYNC_WORKDIR, EXEC])."""
+    record = global_user_state.get_cluster(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} not found; `launch` first.')
+    if record['status'] != global_user_state.ClusterStatus.UP:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} is {record["status"].value}.',
+            cluster_status=record['status'])
+    backend = TpuGangBackend()
+    handle = ClusterHandle.from_dict(record['handle'])
+    backend._check_task_fits(task, handle)  # pylint: disable=protected-access
+    if task.workdir:
+        backend.sync_workdir(handle, task.workdir)
+    job_id = backend.execute(handle, task, detach_run=detach_run,
+                             include_setup=False)
+    return job_id, handle

@@ -1,0 +1,154 @@
+"""Global client-side state: cluster table + events.
+
+Reference analog: ``sky/global_user_state.py`` (2,743 LoC) — a SQLite DB
+holding every cluster's pickled handle, status, and history.  Handles here
+are JSON (dataclass dicts), not pickles, so the DB is inspectable and
+forward-compatible.  Override location with ``SKYTPU_STATE_DIR`` (tests use
+per-test dirs).
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+import filelock
+
+
+class ClusterStatus(enum.Enum):
+    INIT = 'INIT'
+    UP = 'UP'
+    STOPPED = 'STOPPED'
+
+
+def _state_dir() -> str:
+    return os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+
+
+def _db_path() -> str:
+    d = _state_dir()
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, 'state.db')
+
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS clusters (
+    name TEXT PRIMARY KEY,
+    launched_at REAL,
+    handle TEXT,
+    last_use TEXT,
+    status TEXT,
+    autostop_minutes INTEGER DEFAULT -1,
+    autostop_down INTEGER DEFAULT 0,
+    last_activity REAL,
+    owner TEXT
+);
+CREATE TABLE IF NOT EXISTS cluster_events (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    cluster_name TEXT,
+    timestamp REAL,
+    event TEXT,
+    detail TEXT
+);
+"""
+
+
+def _conn() -> sqlite3.Connection:
+    conn = sqlite3.connect(_db_path(), timeout=10)
+    conn.row_factory = sqlite3.Row
+    conn.executescript(_SCHEMA)
+    return conn
+
+
+def _lock() -> filelock.FileLock:
+    return filelock.FileLock(_db_path() + '.lock')
+
+
+def add_or_update_cluster(name: str, handle: Dict[str, Any],
+                          status: ClusterStatus,
+                          is_launch: bool = False) -> None:
+    now = time.time()
+    with _lock(), _conn() as conn:
+        existing = conn.execute('SELECT name FROM clusters WHERE name = ?',
+                                (name,)).fetchone()
+        if existing:
+            sets = 'handle = ?, status = ?, last_activity = ?'
+            args: List[Any] = [json.dumps(handle), status.value, now]
+            if is_launch:
+                sets += ', launched_at = ?'
+                args.append(now)
+            args.append(name)
+            conn.execute(f'UPDATE clusters SET {sets} WHERE name = ?', args)
+        else:
+            conn.execute(
+                'INSERT INTO clusters (name, launched_at, handle, status, '
+                'last_activity) VALUES (?, ?, ?, ?, ?)',
+                (name, now, json.dumps(handle), status.value, now))
+
+
+def update_cluster_status(name: str, status: ClusterStatus) -> None:
+    with _lock(), _conn() as conn:
+        conn.execute('UPDATE clusters SET status = ? WHERE name = ?',
+                     (status.value, name))
+
+
+def set_autostop(name: str, minutes: int, down: bool) -> None:
+    with _lock(), _conn() as conn:
+        conn.execute(
+            'UPDATE clusters SET autostop_minutes = ?, autostop_down = ? '
+            'WHERE name = ?', (minutes, int(down), name))
+
+
+def touch_activity(name: str) -> None:
+    with _lock(), _conn() as conn:
+        conn.execute('UPDATE clusters SET last_activity = ? WHERE name = ?',
+                     (time.time(), name))
+
+
+def remove_cluster(name: str) -> None:
+    with _lock(), _conn() as conn:
+        conn.execute('DELETE FROM clusters WHERE name = ?', (name,))
+
+
+def get_cluster(name: str) -> Optional[Dict[str, Any]]:
+    with _conn() as conn:
+        row = conn.execute('SELECT * FROM clusters WHERE name = ?',
+                           (name,)).fetchone()
+        if row is None:
+            return None
+        d = dict(row)
+        d['handle'] = json.loads(d['handle']) if d['handle'] else None
+        d['status'] = ClusterStatus(d['status'])
+        return d
+
+
+def get_clusters() -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute(
+            'SELECT * FROM clusters ORDER BY launched_at DESC').fetchall()
+    out = []
+    for row in rows:
+        d = dict(row)
+        d['handle'] = json.loads(d['handle']) if d['handle'] else None
+        d['status'] = ClusterStatus(d['status'])
+        out.append(d)
+    return out
+
+
+def add_cluster_event(name: str, event: str, detail: str = '') -> None:
+    with _lock(), _conn() as conn:
+        conn.execute(
+            'INSERT INTO cluster_events (cluster_name, timestamp, event, '
+            'detail) VALUES (?, ?, ?, ?)', (name, time.time(), event, detail))
+
+
+def get_cluster_events(name: str, limit: int = 50) -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute(
+            'SELECT * FROM cluster_events WHERE cluster_name = ? '
+            'ORDER BY id DESC LIMIT ?', (name, limit)).fetchall()
+        return [dict(r) for r in rows]

@@ -33,6 +33,10 @@ def allreduce_benchmark(payload_mb: float = 64.0,
                 'busbw_gbps': 0.0, 'note': 'single rank; nothing to reduce'}
     n_elems = int(payload_mb * 1e6 / 4)
     n_elems -= n_elems % n
+    # Input sharded over the axis: each rank reduces n_elems/n elements.
+    # nccl-tests algbw convention = per-rank buffer bytes / time, so the
+    # bandwidth math below uses the per-rank size.
+    per_rank_elems = n_elems // n
     x = jnp.ones((n_elems,), jnp.float32)
 
     def body(x):
@@ -48,7 +52,7 @@ def allreduce_benchmark(payload_mb: float = 64.0,
         out = fn(out)
     np.asarray(jax.device_get(out[:1]))
     dt = (time.perf_counter() - t0) / iters
-    bytes_payload = n_elems * 4
+    bytes_payload = per_rank_elems * 4
     algbw = bytes_payload / dt / 1e9
     busbw = algbw * 2 * (n - 1) / n
     return {'ranks': n, 'payload_mb': payload_mb,

@@ -30,6 +30,7 @@ def _block_attn(q, k, v, q_start, k_start, causal):
     """One (q_shard x kv_shard) block: returns (unnormalized out, m, l)."""
     b, hq, sq, d = q.shape
     hkv = k.shape[1]
+    assert hq % hkv == 0, (hq, hkv)
     group = hq // hkv
     qg = q.reshape(b, hkv, group, sq, d).astype(jnp.float32)
     scale = d ** -0.5

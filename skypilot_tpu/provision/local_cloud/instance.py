@@ -1,0 +1,121 @@
+"""Local provisioner: "instances" are records backed by this machine.
+
+The always-available provider (reference analog: BYO-SSH node pools /
+``sky local up``): provisioning writes a cluster record under the state dir;
+workers are processes on 127.0.0.1.  State persists across CLI invocations
+(unlike the in-memory fake provider), so `stpu launch` then `stpu status`
+from another process agree.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import filelock
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+
+
+def _clusters_dir() -> str:
+    d = os.path.join(
+        os.path.expanduser(
+            os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu')),
+        'local_clusters')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _path(name: str) -> str:
+    return os.path.join(_clusters_dir(), f'{name}.json')
+
+
+def _load(name: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(_path(name), encoding='utf-8') as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+
+
+def _save(name: str, data: Dict[str, Any]) -> None:
+    with open(_path(name), 'w', encoding='utf-8') as f:
+        json.dump(data, f, indent=1)
+
+
+def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    name = config.cluster_name_on_cloud
+    lock = filelock.FileLock(_path(name) + '.lock')
+    with lock:
+        data = _load(name) or {'instances': {}, 'region': config.region}
+        created, resumed = [], []
+        for node_id in range(config.num_nodes):
+            iid = f'{name}-n{node_id}'
+            inst = data['instances'].get(iid)
+            if inst is None:
+                data['instances'][iid] = {
+                    'instance_id': iid, 'node_id': node_id, 'worker_id': 0,
+                    'internal_ip': '127.0.0.1', 'status': 'running',
+                }
+                created.append(iid)
+            elif inst['status'] != 'running':
+                inst['status'] = 'running'
+                resumed.append(iid)
+        _save(name, data)
+    return common.ProvisionRecord(
+        provider_name='local', region=config.region, zone=config.zone,
+        cluster_name_on_cloud=name, head_instance_id=f'{name}-n0',
+        created_instance_ids=created, resumed_instance_ids=resumed)
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: str) -> None:
+    del region, state
+    if _load(cluster_name_on_cloud) is None:
+        raise exceptions.ClusterDoesNotExist(cluster_name_on_cloud)
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
+    raise exceptions.NotSupportedError(
+        'local clusters cannot be stopped; use down.')
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del provider_config
+    try:
+        os.remove(_path(cluster_name_on_cloud))
+    except FileNotFoundError:
+        pass
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Optional[str]]:
+    del provider_config
+    data = _load(cluster_name_on_cloud)
+    if data is None:
+        return {}
+    return {iid: i['status'] for iid, i in data['instances'].items()}
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    del provider_config
+    data = _load(cluster_name_on_cloud)
+    if data is None:
+        raise exceptions.ClusterDoesNotExist(cluster_name_on_cloud)
+    instances = [
+        common.InstanceInfo(
+            instance_id=i['instance_id'], node_id=i['node_id'],
+            worker_id=i['worker_id'], internal_ip=i['internal_ip'],
+            external_ip=i['internal_ip'], status=i['status'])
+        for i in data['instances'].values() if i['status'] == 'running'
+    ]
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=f'{cluster_name_on_cloud}-n0',
+        provider_name='local', region=region, zone='local')

@@ -1,0 +1,152 @@
+"""Command runners: execute/rsync on cluster workers.
+
+Reference analog: ``sky/utils/command_runner.py`` (``SSHCommandRunner :615``,
+``LocalProcessCommandRunner :1190``) — one object per worker host knowing how
+to run a command and sync files.  SSH runners use ControlMaster connection
+pooling, which is what makes 64-host gang fan-out tolerable
+(SURVEY.md §7 hard parts).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import shlex
+import subprocess
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.agent import log_lib
+
+SSH_OPTIONS = [
+    '-o', 'StrictHostKeyChecking=no',
+    '-o', 'UserKnownHostsFile=/dev/null',
+    '-o', 'IdentitiesOnly=yes',
+    '-o', 'ConnectTimeout=30',
+    '-o', 'ServerAliveInterval=20',
+    '-o', 'ServerAliveCountMax=3',
+    '-o', 'LogLevel=ERROR',
+    # ControlMaster pooling: one TCP/auth handshake per host, reused by
+    # every subsequent command/rsync (critical at pod-slice host counts).
+    '-o', 'ControlMaster=auto',
+    '-o', 'ControlPath=~/.skypilot_tpu/ssh_control/%C',
+    '-o', 'ControlPersist=120s',
+]
+
+
+@dataclasses.dataclass
+class RunnerSpec:
+    """Serializable description of how to reach one worker."""
+    kind: str  # 'local' | 'ssh'
+    ip: str = '127.0.0.1'
+    user: Optional[str] = None
+    ssh_key: Optional[str] = None
+    port: int = 22
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> 'RunnerSpec':
+        return cls(**d)
+
+    def make(self) -> 'CommandRunner':
+        if self.kind == 'local':
+            return LocalProcessCommandRunner(self.ip)
+        if self.kind == 'ssh':
+            return SSHCommandRunner(self.ip, self.user or 'skytpu',
+                                    self.ssh_key, self.port)
+        raise ValueError(f'Unknown runner kind {self.kind!r}')
+
+
+class CommandRunner:
+
+    def run(self, cmd: str, env: Optional[Dict[str, str]] = None,
+            log_path: Optional[str] = None, stream: bool = False,
+            prefix: str = '', cwd: Optional[str] = None) -> int:
+        raise NotImplementedError
+
+    def popen_argv(self, cmd: str, env: Optional[Dict[str, str]] = None,
+                   cwd: Optional[str] = None) -> List[str]:
+        """argv that executes `cmd` on the worker (for gang fan-out)."""
+        raise NotImplementedError
+
+    def rsync(self, src: str, dst: str, up: bool = True) -> None:
+        raise NotImplementedError
+
+
+def _env_prefix(env: Optional[Dict[str, str]]) -> str:
+    if not env:
+        return ''
+    return ' '.join(f'{k}={shlex.quote(v)}' for k, v in env.items()) + ' '
+
+
+class LocalProcessCommandRunner(CommandRunner):
+    """Runs on this machine (local cloud, fake cloud workers, tests)."""
+
+    def __init__(self, ip: str = '127.0.0.1'):
+        self.ip = ip
+
+    def popen_argv(self, cmd, env=None, cwd=None):
+        # env handled by the caller's process env; cwd via cd in shell.
+        inner = cmd
+        if cwd:
+            inner = f'cd {shlex.quote(cwd)} && {cmd}'
+        return ['bash', '-c', inner]
+
+    def run(self, cmd, env=None, log_path=None, stream=False, prefix='',
+            cwd=None) -> int:
+        argv = self.popen_argv(cmd, cwd=cwd)
+        if log_path is None:
+            full_env = dict(os.environ)
+            full_env.update(env or {})
+            return subprocess.run(argv, env=full_env, check=False).returncode
+        return log_lib.run_with_log(argv, log_path, env=env, stream=stream,
+                                    prefix=prefix)
+
+    def rsync(self, src: str, dst: str, up: bool = True) -> None:
+        src, dst = os.path.expanduser(src), os.path.expanduser(dst)
+        os.makedirs(os.path.dirname(dst.rstrip('/')) or '/', exist_ok=True)
+        subprocess.run(
+            ['rsync', '-a', '--delete',
+             src.rstrip('/') + '/', dst.rstrip('/') + '/'],
+            check=True)
+
+
+class SSHCommandRunner(CommandRunner):
+
+    def __init__(self, ip: str, user: str, ssh_key: Optional[str],
+                 port: int = 22):
+        self.ip = ip
+        self.user = user
+        self.ssh_key = ssh_key
+        self.port = port
+        os.makedirs(os.path.expanduser('~/.skypilot_tpu/ssh_control'),
+                    exist_ok=True)
+
+    def _ssh_base(self) -> List[str]:
+        base = ['ssh'] + SSH_OPTIONS + ['-p', str(self.port)]
+        if self.ssh_key:
+            base += ['-i', os.path.expanduser(self.ssh_key)]
+        return base + [f'{self.user}@{self.ip}']
+
+    def popen_argv(self, cmd, env=None, cwd=None):
+        inner = _env_prefix(env) + cmd
+        if cwd:
+            inner = f'cd {shlex.quote(cwd)} && {inner}'
+        return self._ssh_base() + ['bash', '-lc', shlex.quote(inner)]
+
+    def run(self, cmd, env=None, log_path=None, stream=False, prefix='',
+            cwd=None) -> int:
+        # env is embedded in the remote command line (ssh does not forward
+        # arbitrary env), so pass env=None to the local process.
+        argv = self.popen_argv(cmd, env=env, cwd=cwd)
+        if log_path is None:
+            return subprocess.run(argv, check=False).returncode
+        return log_lib.run_with_log(argv, log_path, stream=stream,
+                                    prefix=prefix)
+
+    def rsync(self, src: str, dst: str, up: bool = True) -> None:
+        ssh_cmd = ' '.join(self._ssh_base()[:-1])  # without host
+        remote = f'{self.user}@{self.ip}:{dst}'
+        pair = [src.rstrip('/') + '/', remote] if up else [remote, src]
+        subprocess.run(['rsync', '-a', '--delete', '-e', ssh_cmd] + pair,
+                       check=True)

@@ -1,0 +1,179 @@
+"""End-to-end launch tests on the local and fake clouds.
+
+This is the framework analog of the reference's smoke tests
+(``tests/smoke_tests/test_basic.py``) run against in-sandbox providers: real
+subprocesses, real job table, real logs — no mocks in the execute path.
+"""
+import os
+import time
+
+import pytest
+
+from skypilot_tpu import core, execution, global_user_state
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.backends.tpu_gang_backend import runtime_dir
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+
+@pytest.fixture(autouse=True)
+def _fake(enable_fake_cloud):
+    yield
+
+
+def _wait_job(cluster: str, job_id: int, timeout: float = 30.0) -> str:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        s = core.job_status(cluster, job_id)
+        if s and job_lib.JobStatus(s).is_terminal():
+            return s
+        time.sleep(0.2)
+    raise TimeoutError(f'job {job_id} on {cluster} did not finish')
+
+
+def test_launch_local_end_to_end(tmp_path):
+    task = Task('hello', run='echo hello-from-$SKYPILOT_NODE_RANK; echo done')
+    task.set_resources(Resources(cloud='local'))
+    job_id, handle = execution.launch(task, cluster_name='t1',
+                                      detach_run=True)
+    assert handle is not None and job_id is not None
+    status = _wait_job('t1', job_id)
+    assert status == 'SUCCEEDED'
+    log = os.path.join(runtime_dir('t1'), 'jobs', str(job_id), 'run.log')
+    with open(log, encoding='utf-8') as f:
+        content = f.read()
+    assert 'hello-from-0' in content
+    # queue shows the job
+    q = core.queue('t1')
+    assert q[0]['job_id'] == job_id
+    assert q[0]['status'] == 'SUCCEEDED'
+    core.down('t1')
+    assert global_user_state.get_cluster('t1') is None
+
+
+def test_launch_tpu_slice_gang_on_fake_cloud():
+    """A v5e-16 slice = 4 workers; each rank must see the full env contract."""
+    task = Task(
+        'gang',
+        run='echo rank=$SKYTPU_WORKER_RANK tpuid=$TPU_WORKER_ID '
+            'nw=$SKYTPU_NUM_WORKERS coord=$JAX_COORDINATOR_ADDRESS '
+            'hosts=$TPU_WORKER_HOSTNAMES')
+    task.set_resources(Resources(accelerators='tpu-v5e-16', cloud='fake'))
+    job_id, handle = execution.launch(task, cluster_name='gang1',
+                                      detach_run=True)
+    assert handle.hosts_per_node == 4
+    status = _wait_job('gang1', job_id)
+    assert status == 'SUCCEEDED'
+    jdir = os.path.join(runtime_dir('gang1'), 'jobs', str(job_id))
+    ranks_seen = set()
+    for r in range(4):
+        with open(os.path.join(jdir, f'rank-{r}.log'), encoding='utf-8') as f:
+            line = f.read()
+        assert f'rank={r}' in line
+        assert f'tpuid={r}' in line  # single slice: worker_id == global rank
+        assert 'nw=4' in line
+        assert ':8476' in line  # JAX coordinator port
+        ranks_seen.add(r)
+    assert ranks_seen == {0, 1, 2, 3}
+    core.down('gang1')
+
+
+def test_multislice_env_contract():
+    """num_nodes=2 slices of v5e-8 (1 host each): megascale vars present."""
+    task = Task(
+        'ms',
+        num_nodes=2,
+        run='echo slice=$SKYTPU_SLICE_ID nslices=$MEGASCALE_NUM_SLICES '
+            'msid=$MEGASCALE_SLICE_ID nr=$SKYPILOT_NODE_RANK')
+    task.set_resources(Resources(accelerators='tpu-v5e-8', cloud='fake'))
+    job_id, handle = execution.launch(task, cluster_name='ms1',
+                                      detach_run=True)
+    status = _wait_job('ms1', job_id)
+    assert status == 'SUCCEEDED'
+    jdir = os.path.join(runtime_dir('ms1'), 'jobs', str(job_id))
+    for r, (slice_id,) in enumerate([(0,), (1,)]):
+        with open(os.path.join(jdir, f'rank-{r}.log'), encoding='utf-8') as f:
+            line = f.read()
+        assert f'slice={slice_id}' in line
+        assert 'nslices=2' in line
+        assert f'nr={slice_id}' in line
+    core.down('ms1')
+
+
+def test_setup_failure_marks_failed_setup():
+    task = Task('bad', setup='exit 3', run='echo never')
+    task.set_resources(Resources(cloud='local'))
+    job_id, _ = execution.launch(task, cluster_name='t2', detach_run=True)
+    status = _wait_job('t2', job_id)
+    assert status == 'FAILED_SETUP'
+    core.down('t2')
+
+
+def test_failed_rank_fails_gang_job():
+    task = Task('partial',
+                run='if [ "$SKYTPU_WORKER_RANK" = "1" ]; then exit 7; fi')
+    task.set_resources(Resources(accelerators='tpu-v5e-16', cloud='fake'))
+    job_id, _ = execution.launch(task, cluster_name='t3', detach_run=True)
+    status = _wait_job('t3', job_id)
+    assert status == 'FAILED'
+    core.down('t3')
+
+
+def test_exec_reuses_cluster_and_fifo():
+    task = Task('first', run='sleep 0.5; echo first')
+    task.set_resources(Resources(cloud='local'))
+    job1, handle = execution.launch(task, cluster_name='t4', detach_run=True)
+    task2 = Task('second', run='echo second')
+    job2, _ = execution.exec_(task2, 't4', detach_run=True)
+    assert job2 == job1 + 1
+    assert _wait_job('t4', job1) == 'SUCCEEDED'
+    assert _wait_job('t4', job2) == 'SUCCEEDED'
+    core.down('t4')
+
+
+def test_failover_on_stockout():
+    """Zone stockout → provisioner fails over to the next zone."""
+    from skypilot_tpu.provision.fake import instance as fake
+    # v4 is only offered in us-central2-b; inject a transient stockout so
+    # the retry lands on the same zone second time? No: use v5e (many zones)
+    # and kill the cheapest zone permanently.
+    task = Task('fo', run='echo ok')
+    task.set_resources(Resources(accelerators='tpu-v5e-8', cloud='fake'))
+    from skypilot_tpu.catalog import gcp_catalog
+    offers = gcp_catalog.get_tpu_offerings('tpu-v5e-8')
+    cheapest_zone = offers[0]['AvailabilityZone']
+    fake.inject_stockout(cheapest_zone)
+    job_id, handle = execution.launch(task, cluster_name='t5',
+                                      detach_run=True)
+    assert handle.zone != cheapest_zone
+    attempts = fake.provision_attempts()
+    assert attempts[0] == cheapest_zone  # tried cheapest first
+    assert _wait_job('t5', job_id) == 'SUCCEEDED'
+    core.down('t5')
+
+
+def test_cancel_running_job():
+    task = Task('longrun', run='sleep 60')
+    task.set_resources(Resources(cloud='local'))
+    job_id, _ = execution.launch(task, cluster_name='t6', detach_run=True)
+    deadline = time.time() + 10
+    while core.job_status('t6', job_id) not in ('RUNNING',):
+        assert time.time() < deadline
+        time.sleep(0.1)
+    assert core.cancel('t6', job_id)
+    assert core.job_status('t6', job_id) == 'CANCELLED'
+    core.down('t6')
+
+
+def test_status_and_refresh():
+    task = Task('st', run='echo x')
+    task.set_resources(Resources(cloud='local'))
+    job_id, _ = execution.launch(task, cluster_name='t7', detach_run=True)
+    _wait_job('t7', job_id)
+    rows = core.status()
+    row = next(r for r in rows if r['name'] == 't7')
+    assert row['status'] == 'UP'
+    rows = core.status(refresh=True)
+    assert any(r['name'] == 't7' for r in rows)
+    core.down('t7')
+    assert not any(r['name'] == 't7' for r in core.status())
