@@ -88,8 +88,50 @@ def _prefix_for(worker: Dict[str, Any], num_workers: int) -> str:
     return f'({name}, rank={rank}) '
 
 
+# Live worker Popen objects, killed when the driver receives SIGTERM
+# (cancel) so gang processes never outlive their job.
+_live_procs: List[Any] = []
+
+
+def _register_proc(proc) -> None:
+    _live_procs.append(proc)
+
+
+def _kill_workers(signum=None, frame=None) -> None:
+    del frame
+    for proc in _live_procs:
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            try:
+                proc.terminate()
+            except ProcessLookupError:
+                pass
+    if signum is not None:
+        sys.exit(143)
+
+
+def _wait_for_turn(table: job_lib.JobTable, job_id: int,
+                   poll_s: float = 0.3) -> bool:
+    """FIFO admission: block until this job is the oldest PENDING with no
+    job running/setting-up (one gang owns the slice at a time). Returns
+    False if the job was cancelled while waiting."""
+    import time as _time
+    while True:
+        job = table.get(job_id)
+        if job is None or job_lib.JobStatus(job['status']).is_terminal():
+            return False
+        nxt = table.next_pending()
+        if nxt is not None and nxt['job_id'] == job_id:
+            return True
+        _time.sleep(poll_s)
+
+
 def run_job(cluster_dir: str, job_id: int) -> int:
     table = job_lib.JobTable(cluster_dir)
+    signal.signal(signal.SIGTERM, _kill_workers)
+    if not _wait_for_turn(table, job_id):
+        return 0  # cancelled before starting
     job = table.get(job_id)
     assert job is not None, f'job {job_id} not found in {cluster_dir}'
     log_dir = job['log_dir']
@@ -105,8 +147,9 @@ def run_job(cluster_dir: str, job_id: int) -> int:
     # -- setup phase (once per worker, parallel) ---------------------------
     setup_cmd = spec.get('setup')
     if setup_cmd:
-        table.set_status(job_id, job_lib.JobStatus.SETTING_UP,
-                         driver_pid=os.getpid())
+        if not table.set_status(job_id, job_lib.JobStatus.SETTING_UP,
+                                driver_pid=os.getpid()):
+            return 0  # cancelled in the admission race
         gang = []
         for w in workers:
             runner = RunnerSpec.from_dict(w['runner'])
@@ -117,14 +160,16 @@ def run_job(cluster_dir: str, job_id: int) -> int:
                 log_dir, f'setup-rank-{w["global_rank"]}.log')
             gang.append((argv, env if runner.kind == 'local' else {},
                          log_path, _prefix_for(w, len(workers))))
-        codes = log_lib.run_parallel_with_logs(gang)
+        codes = log_lib.run_parallel_with_logs(gang, on_spawn=_register_proc)
+        _live_procs.clear()
         if any(c != 0 for c in codes):
             table.set_status(job_id, job_lib.JobStatus.FAILED_SETUP)
             return 1
 
     # -- run phase (gang) --------------------------------------------------
-    table.set_status(job_id, job_lib.JobStatus.RUNNING,
-                     driver_pid=os.getpid())
+    if not table.set_status(job_id, job_lib.JobStatus.RUNNING,
+                            driver_pid=os.getpid()):
+        return 0  # cancelled in the admission race
     run_cmd = spec.get('run')
     if not run_cmd:
         table.set_status(job_id, job_lib.JobStatus.SUCCEEDED)
@@ -139,7 +184,8 @@ def run_job(cluster_dir: str, job_id: int) -> int:
             log_dir, constants.RANK_LOG_FILE.format(rank=w['global_rank']))
         gang.append((argv, env if runner.kind == 'local' else {}, log_path,
                      _prefix_for(w, len(workers))))
-    codes = log_lib.run_parallel_with_logs(gang)
+    codes = log_lib.run_parallel_with_logs(gang, on_spawn=_register_proc)
+    _live_procs.clear()
     ok = all(c == 0 for c in codes)
     table.set_status(
         job_id, job_lib.JobStatus.SUCCEEDED if ok else job_lib.JobStatus.FAILED)

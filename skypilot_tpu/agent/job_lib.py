@@ -79,7 +79,10 @@ class JobTable:
             return int(cur.lastrowid)
 
     def set_status(self, job_id: int, status: JobStatus,
-                   driver_pid: Optional[int] = None) -> None:
+                   driver_pid: Optional[int] = None) -> bool:
+        """Transition a job's status. Terminal states are frozen: a driver
+        racing a cancel cannot overwrite CANCELLED. Returns False if the
+        transition was rejected."""
         sets = ['status = ?']
         args: List[Any] = [status.value]
         if status == JobStatus.RUNNING:
@@ -92,17 +95,28 @@ class JobTable:
             sets.append('driver_pid = ?')
             args.append(driver_pid)
         args.append(job_id)
+        terminal_values = [s.value for s in JobStatus if s.is_terminal()]
         with self._lock, self._conn() as conn:
-            conn.execute(f'UPDATE jobs SET {", ".join(sets)} WHERE job_id = ?',
-                         args)
+            cur = conn.execute(
+                f'UPDATE jobs SET {", ".join(sets)} WHERE job_id = ? '
+                f'AND status NOT IN ({",".join("?" * len(terminal_values))})',
+                args + terminal_values)
+            return cur.rowcount > 0
 
-    def cancel(self, job_id: int) -> Optional[int]:
-        """Mark cancelled; returns driver pid to kill (if running)."""
+    def set_log_dir(self, job_id: int, log_dir: str) -> None:
+        with self._lock, self._conn() as conn:
+            conn.execute('UPDATE jobs SET log_dir = ? WHERE job_id = ?',
+                         (log_dir, job_id))
+
+    def cancel(self, job_id: int) -> tuple:
+        """Mark cancelled. Returns (cancelled, driver_pid): cancelled is True
+        iff the job existed and was not already terminal; driver_pid may be
+        None for jobs whose driver has not started (PENDING)."""
         job = self.get(job_id)
         if job is None or JobStatus(job['status']).is_terminal():
-            return None
+            return False, None
         self.set_status(job_id, JobStatus.CANCELLED)
-        return job['driver_pid']
+        return True, job['driver_pid']
 
     # -- reads -------------------------------------------------------------
 

@@ -42,7 +42,8 @@ def run_with_log(cmd: List[str], log_path: str,
 
 def run_parallel_with_logs(cmds_envs_logs: List[tuple],
                            cwd: Optional[str] = None,
-                           stream_rank0: bool = True) -> List[int]:
+                           stream_rank0: bool = True,
+                           on_spawn=None) -> List[int]:
     """Gang-run: launch every (cmd, env, log_path, prefix) concurrently,
     multiplex their output to per-rank logs (+ stdout), wait for all.
 
@@ -67,6 +68,8 @@ def run_parallel_with_logs(cmds_envs_logs: List[tuple],
         sel.register(proc.stdout, selectors.EVENT_READ,
                      data=(proc, f, prefix))
         procs.append(proc)
+        if on_spawn is not None:
+            on_spawn(proc)
     open_streams = len(procs)
     while open_streams > 0:
         for key, _ in sel.select(timeout=0.2):

@@ -70,10 +70,22 @@ class TpuGangBackend(Backend):
         enabled = check_lib.get_enabled_clouds_or_raise()
         blocked: List[Resources] = []
         failover_history: List[Exception] = []
+        backoff = common_utils.Backoff(initial=5.0, cap=300.0)
         while True:
             candidates = optimizer_lib._fill_in_launchable_resources(  # pylint: disable=protected-access
                 task, enabled, blocked)
             if not candidates:
+                if retry_until_up:
+                    # Full stockout across every candidate: clear the
+                    # blocklist and re-poll after a backoff (the reference's
+                    # --retry-until-up loop, ``execution.py`` retry plumbing).
+                    wait = backoff.current_backoff()
+                    global_user_state.add_cluster_event(
+                        cluster_name, 'RETRY_WAIT',
+                        f'all candidates stocked out; retrying in {wait:.0f}s')
+                    time.sleep(wait)
+                    blocked.clear()
+                    continue
                 raise exceptions.ResourcesUnavailableError(
                     f'All candidate zones/regions failed for {task}. '
                     f'History: {[str(e) for e in failover_history]}',
@@ -88,10 +100,6 @@ class TpuGangBackend(Backend):
             if handle is not None:
                 return handle
             blocked.append(to_provision)
-            if not retry_until_up and len(blocked) > 16:
-                raise exceptions.ResourcesUnavailableError(
-                    'Exhausted failover candidates.',
-                    failover_history=failover_history)
 
     def _try_provision_resources(
             self, task: Task, cluster_name: str, to_provision: Resources,
@@ -159,10 +167,10 @@ class TpuGangBackend(Backend):
             handle.cloud, handle.region, handle.cluster_name_on_cloud)
 
     def _runner_spec_for(self, handle: ClusterHandle,
-                         inst: provision_common.InstanceInfo) -> RunnerSpec:
+                         inst: provision_common.InstanceInfo,
+                         info: provision_common.ClusterInfo) -> RunnerSpec:
         if handle.cloud in ('local', 'fake'):
             return RunnerSpec(kind='local', ip=inst.internal_ip)
-        info = self._cluster_info(handle)
         return RunnerSpec(kind='ssh', ip=inst.external_ip or inst.internal_ip,
                           user=info.ssh_user, ssh_key=info.ssh_key_path)
 
@@ -181,7 +189,7 @@ class TpuGangBackend(Backend):
             return
         info = self._cluster_info(handle)
         for inst in info.all_workers_sorted():
-            self._runner_spec_for(handle, inst).make().rsync(
+            self._runner_spec_for(handle, inst, info).make().rsync(
                 workdir, '~/sky_workdir', up=True)
 
     @timeline.event
@@ -189,6 +197,7 @@ class TpuGangBackend(Backend):
                          file_mounts: Dict[str, str]) -> None:
         if not file_mounts:
             return
+        info = None  # fetched once, lazily, for remote clusters
         for dst, src in file_mounts.items():
             src = os.path.expanduser(src)
             if not os.path.exists(src):
@@ -207,9 +216,10 @@ class TpuGangBackend(Backend):
                                 exist_ok=True)
                     shutil.copy2(src, dst_local)
             else:
-                info = self._cluster_info(handle)
+                if info is None:
+                    info = self._cluster_info(handle)
                 for inst in info.all_workers_sorted():
-                    self._runner_spec_for(handle, inst).make().rsync(
+                    self._runner_spec_for(handle, inst, info).make().rsync(
                         src, dst, up=True)
 
     # -- execute -----------------------------------------------------------
@@ -234,7 +244,7 @@ class TpuGangBackend(Backend):
                 'node_id': inst.node_id,
                 'worker_id': inst.worker_id,
                 'ip': inst.internal_ip,
-                'runner': self._runner_spec_for(handle, inst).to_dict(),
+                'runner': self._runner_spec_for(handle, inst, info).to_dict(),
             })
         workdir_on_worker = None
         if task.workdir:
@@ -248,12 +258,7 @@ class TpuGangBackend(Backend):
                               log_dir='pending')
         log_dir = os.path.join(log_root, str(job_id))
         os.makedirs(log_dir, exist_ok=True)
-        with open(os.path.join(cdir, constants.JOB_TABLE_DB), 'a'):
-            pass
-        # record real log dir
-        with table._lock, table._conn() as conn:  # pylint: disable=protected-access
-            conn.execute('UPDATE jobs SET log_dir = ? WHERE job_id = ?',
-                         (log_dir, job_id))
+        table.set_log_dir(job_id, log_dir)
 
         spec = {
             'cluster_name': handle.cluster_name,
@@ -319,17 +324,15 @@ class TpuGangBackend(Backend):
 
     def cancel_job(self, handle: ClusterHandle, job_id: int) -> bool:
         table = job_lib.JobTable(runtime_dir(handle.cluster_name))
-        pid = table.cancel(job_id)
-        if pid:
+        cancelled, pid = table.cancel(job_id)
+        if cancelled and pid:
+            # SIGTERM the driver; its handler forwards to every worker
+            # process group so the gang never outlives the job.
             try:
-                os.killpg(pid, 15)
+                os.kill(pid, 15)
             except (ProcessLookupError, PermissionError):
-                try:
-                    os.kill(pid, 15)
-                except (ProcessLookupError, PermissionError):
-                    pass
-            return True
-        return False
+                pass
+        return cancelled
 
     # -- lifecycle ---------------------------------------------------------
 

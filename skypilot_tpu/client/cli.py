@@ -7,7 +7,6 @@ their planes land).
 """
 from __future__ import annotations
 
-import json
 import os
 import sys
 from typing import List, Optional, Tuple
@@ -123,13 +122,10 @@ def launch(entrypoint, cluster, detach_run, retry_until_up,
     from skypilot_tpu import execution
     task = _load_task(entrypoint, name, workdir, cloud, accelerators,
                       num_nodes, use_spot, envs, secrets)
-    try:
-        job_id, handle = execution.launch(
-            task, cluster_name=cluster, retry_until_up=retry_until_up,
-            idle_minutes_to_autostop=idle_minutes_to_autostop, down=down,
-            detach_run=detach_run, dryrun=dryrun)
-    except exceptions.SkyTpuError as e:
-        raise click.ClickException(str(e)) from e
+    job_id, handle = execution.launch(
+        task, cluster_name=cluster, retry_until_up=retry_until_up,
+        idle_minutes_to_autostop=idle_minutes_to_autostop, down=down,
+        detach_run=detach_run, dryrun=dryrun)
     if handle is not None:
         click.echo(f'Cluster: {handle.cluster_name} '
                    f'(job {job_id if job_id is not None else "-"})')
@@ -147,10 +143,7 @@ def exec_cmd(cluster, entrypoint, detach_run, name, workdir, cloud,
     from skypilot_tpu import execution
     task = _load_task(entrypoint, name, workdir, cloud, accelerators,
                       num_nodes, use_spot, envs, secrets)
-    try:
-        job_id, _ = execution.exec_(task, cluster, detach_run=detach_run)
-    except exceptions.SkyTpuError as e:
-        raise click.ClickException(str(e)) from e
+    job_id, _ = execution.exec_(task, cluster, detach_run=detach_run)
     click.echo(f'Job {job_id} submitted to {cluster}.')
 
 
@@ -241,6 +234,7 @@ def start(cluster):
 @click.option('--idle-minutes', '-i', type=int, required=True,
               help='-1 cancels autostop')
 @click.option('--down', is_flag=True, default=False)
+@_clean_errors
 def autostop(cluster, idle_minutes, down):
     """Schedule automatic stop/down after idleness."""
     from skypilot_tpu import core

@@ -120,7 +120,8 @@ def test_failed_rank_fails_gang_job():
 
 
 def test_exec_reuses_cluster_and_fifo():
-    task = Task('first', run='sleep 0.5; echo first')
+    """Two jobs on one cluster must serialize: one gang owns the slice."""
+    task = Task('first', run='sleep 1.2; echo first')
     task.set_resources(Resources(cloud='local'))
     job1, handle = execution.launch(task, cluster_name='t4', detach_run=True)
     task2 = Task('second', run='echo second')
@@ -128,7 +129,32 @@ def test_exec_reuses_cluster_and_fifo():
     assert job2 == job1 + 1
     assert _wait_job('t4', job1) == 'SUCCEEDED'
     assert _wait_job('t4', job2) == 'SUCCEEDED'
+    table = job_lib.JobTable(runtime_dir('t4'))
+    j1, j2 = table.get(job1), table.get(job2)
+    assert j2['started_at'] >= j1['ended_at'], (
+        'FIFO violated: job2 started before job1 finished')
     core.down('t4')
+
+
+def test_cancel_pending_job_never_runs():
+    """Cancel racing a pending job: the job must stay CANCELLED and its
+    run command must never execute."""
+    task = Task('block', run='sleep 5')
+    task.set_resources(Resources(cloud='local'))
+    job1, _ = execution.launch(task, cluster_name='t8', detach_run=True)
+    marker = '/tmp/skytpu_test_cancel_marker'
+    if os.path.exists(marker):
+        os.remove(marker)
+    task2 = Task('victim', run=f'touch {marker}')
+    job2, _ = execution.exec_(task2, 't8', detach_run=True)
+    # job2 is PENDING behind job1; cancel it before it starts.
+    assert core.cancel('t8', job2)
+    assert core.cancel('t8', job1)
+    assert _wait_job('t8', job1, timeout=10) == 'CANCELLED'
+    time.sleep(1.0)  # give a (wrongly) surviving driver time to run it
+    assert core.job_status('t8', job2) == 'CANCELLED'
+    assert not os.path.exists(marker), 'cancelled job still executed!'
+    core.down('t8')
 
 
 def test_failover_on_stockout():
