@@ -281,3 +281,57 @@ def cost_report():
 
 if __name__ == '__main__':
     cli()
+
+
+@cli.group('jobs')
+def jobs_group():
+    """Managed jobs with automatic recovery (analog of `sky jobs`)."""
+
+
+@jobs_group.command('launch')
+@click.argument('entrypoint', nargs=-1)
+@click.option('--recovery', default='FAILOVER',
+              type=click.Choice(['FAILOVER', 'EAGER_FAILOVER']))
+@click.option('--max-restarts-on-errors', type=int, default=0)
+@_common_task_options
+@_clean_errors
+def jobs_launch(entrypoint, recovery, max_restarts_on_errors, name, workdir,
+                cloud, accelerators, num_nodes, use_spot, envs, secrets):
+    """Submit a managed job (auto-recovers from preemption)."""
+    from skypilot_tpu import jobs
+    task = _load_task(entrypoint, name, workdir, cloud, accelerators,
+                      num_nodes, use_spot, envs, secrets)
+    job_id = jobs.launch(task, recovery_strategy=recovery,
+                         max_restarts_on_errors=max_restarts_on_errors)
+    click.echo(f'Managed job {job_id} submitted '
+               f'(strategy={recovery}). Track: stpu jobs queue')
+
+
+@jobs_group.command('queue')
+@_clean_errors
+def jobs_queue():
+    """List managed jobs."""
+    from skypilot_tpu import jobs
+    _echo_table(jobs.queue(),
+                [('job_id', 'ID'), ('name', 'NAME'), ('status', 'STATUS'),
+                 ('cluster', 'CLUSTER'), ('recoveries', 'RECOVERIES')])
+
+
+@jobs_group.command('cancel')
+@click.argument('job_id', type=int)
+@_clean_errors
+def jobs_cancel(job_id):
+    """Cancel a managed job."""
+    from skypilot_tpu import jobs
+    ok = jobs.cancel(job_id)
+    click.echo('Cancellation requested.' if ok else 'Nothing to cancel.')
+
+
+@jobs_group.command('logs')
+@click.argument('job_id', type=int)
+@click.option('--no-follow', is_flag=True, default=False)
+@_clean_errors
+def jobs_logs(job_id, no_follow):
+    """Tail a managed job's logs."""
+    from skypilot_tpu import jobs
+    jobs.tail_logs(job_id, follow=not no_follow)

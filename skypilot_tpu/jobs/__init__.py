@@ -1,0 +1,77 @@
+"""Managed jobs: submit-and-forget with automatic spot recovery.
+
+Reference analog: ``sky/jobs/`` — the public verbs (`launch`, `queue`,
+`cancel`, `tail_logs`) backed by per-job controllers.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.jobs import state
+from skypilot_tpu.task import Task
+
+MAX_CONCURRENT_CONTROLLERS = 16
+
+
+def launch(task: Task, name: Optional[str] = None,
+           recovery_strategy: str = 'FAILOVER',
+           max_restarts_on_errors: int = 0,
+           _in_process: bool = False) -> int:
+    """Submit a managed job; returns the managed job id.
+
+    Admission control (reference ``jobs/scheduler.py:266``): bounded number
+    of live controllers; beyond that jobs stay PENDING until slots free
+    (round 1: submission fails fast instead of queuing a waiting pool).
+    """
+    if state.count_nonterminal() >= MAX_CONCURRENT_CONTROLLERS:
+        raise RuntimeError(
+            f'Too many active managed jobs (>{MAX_CONCURRENT_CONTROLLERS}).')
+    job_id = state.submit(name or task.name, task.to_yaml_config(),
+                          recovery_strategy=recovery_strategy,
+                          max_restarts_on_errors=max_restarts_on_errors)
+    state.set_status(job_id, state.ManagedJobStatus.SUBMITTED)
+    if _in_process:
+        from skypilot_tpu.jobs.controller import JobController
+        JobController(job_id).run()
+    else:
+        env = dict(os.environ)
+        subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
+             '--job-id', str(job_id)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
+            start_new_session=True)
+    return job_id
+
+
+def queue(limit: int = 200) -> List[Dict[str, Any]]:
+    rows = state.list_jobs(limit)
+    return [{
+        'job_id': r['job_id'],
+        'name': r['name'],
+        'status': r['status'].value,
+        'cluster': r['cluster_name'],
+        'recoveries': r['recovery_count'],
+        'submitted_at': r['submitted_at'],
+    } for r in rows]
+
+
+def cancel(job_id: int) -> bool:
+    """Request cancellation; the controller notices CANCELLING and cleans
+    up. For jobs with a dead controller the status flips directly."""
+    record = state.get(job_id)
+    if record is None or record['status'].is_terminal():
+        return False
+    return state.set_status(job_id, state.ManagedJobStatus.CANCELLING,
+                            detail='user requested')
+
+
+def tail_logs(job_id: int, follow: bool = True) -> None:
+    from skypilot_tpu import core
+    record = state.get(job_id)
+    if record is None or not record['cluster_name']:
+        print(f'Managed job {job_id} has no cluster yet.')
+        return
+    core.tail_logs(record['cluster_name'], None, follow=follow)

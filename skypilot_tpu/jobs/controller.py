@@ -1,0 +1,162 @@
+"""Managed-job controller: launch → monitor → recover → cleanup.
+
+Reference analog: ``sky/jobs/controller.py`` (``JobController :91``) +
+preemption detection in ``sky/jobs/utils.py:719-743``.  TPU-native
+difference in detection (SURVEY.md §7 hard parts): a preempted slice loses
+*all* workers at once, so "cluster exists but is SSH-unreachable" heuristics
+are replaced by authoritative provider queries — worker count below the
+slice's expectation = preempted, full stop.
+
+The controller is a plain loop object so tests can drive it in-process
+(``run()``), while the CLI runs it as a detached process per job
+(``python -m skypilot_tpu.jobs.controller --job-id N``).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import time
+from typing import Optional
+
+from skypilot_tpu import core, exceptions, global_user_state
+from skypilot_tpu import provision as provision_lib
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.backends import ClusterHandle
+from skypilot_tpu.backends.tpu_gang_backend import runtime_dir
+from skypilot_tpu.jobs import recovery_strategy, state
+from skypilot_tpu.task import Task
+
+_POLL_SECONDS = 1.0
+
+
+class JobController:
+
+    def __init__(self, job_id: int, poll_seconds: float = _POLL_SECONDS):
+        self.job_id = job_id
+        self.poll_seconds = poll_seconds
+        record = state.get(job_id)
+        assert record is not None, f'managed job {job_id} not found'
+        self.record = record
+        self.task = Task.from_yaml_config(record['task_config'])
+        self.cluster_name = record['cluster_name'] or \
+            f'managed-{job_id}-{(record["name"] or "job")[:20]}'
+        state.set_cluster_name(job_id, self.cluster_name)
+        self.strategy = recovery_strategy.make(
+            record['recovery_strategy'], self.task, self.cluster_name)
+        self.max_restarts_on_errors = record['max_restarts_on_errors']
+
+    # -- health ------------------------------------------------------------
+
+    def _cluster_is_healthy(self) -> bool:
+        """Authoritative provider-side check: all slice workers running."""
+        record = global_user_state.get_cluster(self.cluster_name)
+        if record is None or not record['handle']:
+            return False
+        handle = ClusterHandle.from_dict(record['handle'])
+        try:
+            statuses = provision_lib.query_instances(
+                handle.cloud, handle.cluster_name_on_cloud)
+        except exceptions.SkyTpuError:
+            return False
+        running = [s for s in statuses.values() if s == 'running']
+        return len(running) == handle.total_workers
+
+    def _agent_job_status(self, agent_job_id: int) -> Optional[str]:
+        table = job_lib.JobTable(runtime_dir(self.cluster_name))
+        job = table.get(agent_job_id)
+        return job['status'] if job else None
+
+    # -- main loop ---------------------------------------------------------
+
+    def run(self) -> state.ManagedJobStatus:
+        job_id = self.job_id
+        try:
+            return self._run_inner()
+        except Exception as e:  # noqa: BLE001 — controller crash is a state
+            state.set_status(job_id, state.ManagedJobStatus.FAILED_CONTROLLER,
+                             detail=repr(e))
+            return state.ManagedJobStatus.FAILED_CONTROLLER
+
+    def _run_inner(self) -> state.ManagedJobStatus:
+        job_id = self.job_id
+        state.set_status(job_id, state.ManagedJobStatus.STARTING)
+        try:
+            agent_job_id = self.strategy.launch()
+        except exceptions.ResourcesUnfeasibleError as e:
+            state.set_status(job_id, state.ManagedJobStatus.FAILED_NO_RESOURCE,
+                             detail=str(e))
+            return state.ManagedJobStatus.FAILED_NO_RESOURCE
+        state.set_status(job_id, state.ManagedJobStatus.RUNNING)
+
+        failure_restarts = 0
+        while True:
+            record = state.get(job_id)
+            if record is not None and \
+                    record['status'] == state.ManagedJobStatus.CANCELLING:
+                core.cancel(self.cluster_name, agent_job_id)
+                self._teardown()
+                state.set_status(job_id, state.ManagedJobStatus.CANCELLED)
+                return state.ManagedJobStatus.CANCELLED
+
+            agent_status = self._agent_job_status(agent_job_id)
+            if agent_status is not None and \
+                    job_lib.JobStatus(agent_status).is_terminal():
+                if agent_status == 'SUCCEEDED':
+                    self._teardown()
+                    state.set_status(job_id, state.ManagedJobStatus.SUCCEEDED)
+                    return state.ManagedJobStatus.SUCCEEDED
+                if agent_status in ('FAILED', 'FAILED_SETUP'):
+                    # User-code failure: bounded restarts
+                    # (reference ``should_restart_on_failure :592``).
+                    if failure_restarts < self.max_restarts_on_errors:
+                        failure_restarts += 1
+                        state.bump_recovery_count(job_id)
+                        state.set_status(
+                            job_id, state.ManagedJobStatus.RECOVERING,
+                            detail=f'user failure restart {failure_restarts}')
+                        agent_job_id = self.strategy.recover()
+                        state.set_status(job_id,
+                                         state.ManagedJobStatus.RUNNING)
+                        continue
+                    self._teardown()
+                    final = (state.ManagedJobStatus.FAILED_SETUP
+                             if agent_status == 'FAILED_SETUP'
+                             else state.ManagedJobStatus.FAILED)
+                    state.set_status(job_id, final)
+                    return final
+                if agent_status == 'CANCELLED':
+                    self._teardown()
+                    state.set_status(job_id, state.ManagedJobStatus.CANCELLED)
+                    return state.ManagedJobStatus.CANCELLED
+
+            if not self._cluster_is_healthy():
+                # Whole-slice preemption (or external deletion): recover.
+                state.bump_recovery_count(job_id)
+                state.set_status(job_id, state.ManagedJobStatus.RECOVERING,
+                                 detail='slice preempted')
+                agent_job_id = self.strategy.recover()
+                state.set_status(job_id, state.ManagedJobStatus.RUNNING)
+                continue
+
+            time.sleep(self.poll_seconds)
+
+    def _teardown(self) -> None:
+        record = global_user_state.get_cluster(self.cluster_name)
+        if record is None:
+            return
+        try:
+            core.down(self.cluster_name)
+        except exceptions.SkyTpuError:
+            global_user_state.remove_cluster(self.cluster_name)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--job-id', type=int, required=True)
+    args = parser.parse_args()
+    state.set_controller_pid(args.job_id, os.getpid())
+    JobController(args.job_id).run()
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,153 @@
+"""Launch/recovery strategies for managed jobs.
+
+Reference analog: ``sky/jobs/recovery_strategy.py`` — ``StrategyExecutor
+:60``, ``FailoverStrategyExecutor :606``, ``EagerFailoverStrategyExecutor
+:706``, ``should_restart_on_failure :592``.
+
+TPU-specific behavior: preemption takes the whole slice at once, so recovery
+always starts from "terminate remnants, re-acquire a slice" — there is no
+partial-cluster repair.  FAILOVER retries the same region first (data/
+checkpoint locality), then lets the provisioner's blocklist walk other
+zones; EAGER_FAILOVER blocklists the preempted zone immediately and
+re-optimizes from scratch (fastest escape from a capacity-drained zone).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Type
+
+from skypilot_tpu import exceptions, execution, global_user_state
+from skypilot_tpu.backends import ClusterHandle, TpuGangBackend
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+_STRATEGIES: Dict[str, Type['StrategyExecutor']] = {}
+
+
+def register(name: str):
+
+    def deco(cls):
+        _STRATEGIES[name] = cls
+        cls.NAME = name
+        return cls
+
+    return deco
+
+
+def make(name: str, task: Task, cluster_name: str) -> 'StrategyExecutor':
+    if name not in _STRATEGIES:
+        raise ValueError(
+            f'Unknown recovery strategy {name!r}; have {sorted(_STRATEGIES)}')
+    return _STRATEGIES[name](task, cluster_name)
+
+
+class StrategyExecutor:
+    """Owns launching (and re-launching) the job's cluster + job."""
+
+    NAME = 'abstract'
+    RETRY_INIT_GAP_SECONDS = 5.0
+
+    def __init__(self, task: Task, cluster_name: str):
+        self.task = task
+        self.cluster_name = cluster_name
+        self.backend = TpuGangBackend()
+
+    # -- helpers -----------------------------------------------------------
+
+    def _cleanup_remnants(self) -> None:
+        """Terminate whatever partially remains of the previous cluster
+        (reference: ``recovery_strategy.py:314`` terminate_cluster)."""
+        record = global_user_state.get_cluster(self.cluster_name)
+        if record is None:
+            return
+        try:
+            self.backend.teardown(ClusterHandle.from_dict(record['handle']),
+                                  terminate=True)
+        except exceptions.SkyTpuError:
+            global_user_state.remove_cluster(self.cluster_name)
+
+    def _launch_once(self, retry_until_up: bool) -> Optional[int]:
+        """One launch attempt; returns job_id or None."""
+        job_id, handle = execution.launch(
+            self.task, cluster_name=self.cluster_name,
+            retry_until_up=retry_until_up, detach_run=True)
+        if handle is None:
+            return None
+        return job_id
+
+    # -- interface ---------------------------------------------------------
+
+    def launch(self) -> int:
+        """Initial launch; raises on definitive infeasibility."""
+        job_id = self._launch_once(retry_until_up=True)
+        assert job_id is not None
+        return job_id
+
+    def recover(self) -> int:
+        raise NotImplementedError
+
+
+@register('FAILOVER')
+class FailoverStrategyExecutor(StrategyExecutor):
+    """Retry in the launched region first, then anywhere
+    (reference ``FailoverStrategyExecutor :606``)."""
+
+    def recover(self) -> int:
+        # 1. Same region (checkpoint/data locality): pin the previous
+        #    region on a fresh task copy.
+        record = global_user_state.get_cluster(self.cluster_name)
+        prev_region: Optional[str] = None
+        prev_cloud: Optional[str] = None
+        if record is not None and record['handle']:
+            prev_region = record['handle'].get('region')
+            prev_cloud = record['handle'].get('cloud')
+        self._cleanup_remnants()
+        if prev_region is not None:
+            pinned = [
+                r.copy(region=prev_region, cloud=prev_cloud)
+                for r in self.task.resources_ordered
+            ]
+            original = self.task.resources_ordered
+            self.task.set_resources(pinned)
+            self.task.best_resources = None
+            try:
+                job_id = self._launch_once(retry_until_up=False)
+                if job_id is not None:
+                    return job_id
+            except exceptions.ResourcesUnfeasibleError:
+                pass
+            finally:
+                self.task.set_resources(original)
+        # 2. Anywhere: full re-optimize, retry until capacity appears.
+        self.task.best_resources = None
+        time.sleep(self.RETRY_INIT_GAP_SECONDS)
+        job_id = self._launch_once(retry_until_up=True)
+        assert job_id is not None
+        return job_id
+
+
+@register('EAGER_FAILOVER')
+class EagerFailoverStrategyExecutor(StrategyExecutor):
+    """Skip the same-region retry: blocklist the preempted zone and
+    re-optimize immediately (reference ``EagerFailoverStrategyExecutor
+    :706``)."""
+
+    def recover(self) -> int:
+        record = global_user_state.get_cluster(self.cluster_name)
+        blocked = []
+        if record is not None and record['handle']:
+            h = record['handle']
+            prev = Resources.from_yaml_config(h['launched_resources'])
+            if isinstance(prev, Resources):
+                blocked.append(prev)
+        self._cleanup_remnants()
+        self.task.best_resources = None
+        if blocked:
+            from skypilot_tpu import optimizer as optimizer_lib
+            try:
+                optimizer_lib.optimize(self.task, blocked_resources=blocked)
+            except exceptions.ResourcesUnfeasibleError:
+                self.task.best_resources = None
+        job_id = self._launch_once(retry_until_up=True)
+        assert job_id is not None
+        return job_id

@@ -1,0 +1,193 @@
+"""Managed-jobs state tables.
+
+Reference analog: ``sky/jobs/state.py`` (2,521 LoC) — ``ManagedJobStatus``
+(``:382``, incl. RECOVERING / FAILED_CONTROLLER) and the schedule-state
+machine (``:593``).  One SQLite DB under the state dir; controllers and the
+CLI read/write through this module only.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+import filelock
+
+
+class ManagedJobStatus(enum.Enum):
+    PENDING = 'PENDING'
+    SUBMITTED = 'SUBMITTED'
+    STARTING = 'STARTING'
+    RUNNING = 'RUNNING'
+    RECOVERING = 'RECOVERING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    FAILED_PRECHECKS = 'FAILED_PRECHECKS'
+    FAILED_NO_RESOURCE = 'FAILED_NO_RESOURCE'
+    FAILED_CONTROLLER = 'FAILED_CONTROLLER'
+    CANCELLING = 'CANCELLING'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL
+
+
+_TERMINAL = {
+    ManagedJobStatus.SUCCEEDED, ManagedJobStatus.FAILED,
+    ManagedJobStatus.FAILED_SETUP, ManagedJobStatus.FAILED_PRECHECKS,
+    ManagedJobStatus.FAILED_NO_RESOURCE, ManagedJobStatus.FAILED_CONTROLLER,
+    ManagedJobStatus.CANCELLED,
+}
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS managed_jobs (
+    job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT,
+    task_config TEXT NOT NULL,
+    status TEXT NOT NULL,
+    cluster_name TEXT,
+    recovery_count INTEGER DEFAULT 0,
+    max_restarts_on_errors INTEGER DEFAULT 0,
+    recovery_strategy TEXT DEFAULT 'FAILOVER',
+    submitted_at REAL,
+    started_at REAL,
+    ended_at REAL,
+    last_event TEXT,
+    controller_pid INTEGER
+);
+CREATE TABLE IF NOT EXISTS managed_job_events (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    job_id INTEGER,
+    timestamp REAL,
+    from_status TEXT,
+    to_status TEXT,
+    detail TEXT
+);
+"""
+
+
+def _db_path() -> str:
+    d = os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, 'managed_jobs.db')
+
+
+def _conn() -> sqlite3.Connection:
+    conn = sqlite3.connect(_db_path(), timeout=10)
+    conn.row_factory = sqlite3.Row
+    conn.executescript(_SCHEMA)
+    return conn
+
+
+def _lock() -> filelock.FileLock:
+    return filelock.FileLock(_db_path() + '.lock')
+
+
+def submit(name: Optional[str], task_config: Dict[str, Any],
+           recovery_strategy: str = 'FAILOVER',
+           max_restarts_on_errors: int = 0) -> int:
+    with _lock(), _conn() as conn:
+        cur = conn.execute(
+            'INSERT INTO managed_jobs (name, task_config, status, '
+            'recovery_strategy, max_restarts_on_errors, submitted_at) '
+            'VALUES (?, ?, ?, ?, ?, ?)',
+            (name, json.dumps(task_config), ManagedJobStatus.PENDING.value,
+             recovery_strategy, max_restarts_on_errors, time.time()))
+        return int(cur.lastrowid)
+
+
+def set_status(job_id: int, status: ManagedJobStatus,
+               detail: str = '') -> bool:
+    """Record a transition (terminal states frozen, like the job table)."""
+    with _lock(), _conn() as conn:
+        row = conn.execute(
+            'SELECT status FROM managed_jobs WHERE job_id = ?',
+            (job_id,)).fetchone()
+        if row is None:
+            return False
+        cur_status = ManagedJobStatus(row['status'])
+        if cur_status.is_terminal():
+            return False
+        sets = 'status = ?, last_event = ?'
+        args: List[Any] = [status.value, detail]
+        if status == ManagedJobStatus.RUNNING:
+            sets += ', started_at = COALESCE(started_at, ?)'
+            args.append(time.time())
+        if status.is_terminal():
+            sets += ', ended_at = ?'
+            args.append(time.time())
+        args.append(job_id)
+        conn.execute(f'UPDATE managed_jobs SET {sets} WHERE job_id = ?', args)
+        conn.execute(
+            'INSERT INTO managed_job_events (job_id, timestamp, from_status, '
+            'to_status, detail) VALUES (?, ?, ?, ?, ?)',
+            (job_id, time.time(), cur_status.value, status.value, detail))
+        return True
+
+
+def set_cluster_name(job_id: int, cluster_name: Optional[str]) -> None:
+    with _lock(), _conn() as conn:
+        conn.execute('UPDATE managed_jobs SET cluster_name = ? '
+                     'WHERE job_id = ?', (cluster_name, job_id))
+
+
+def set_controller_pid(job_id: int, pid: int) -> None:
+    with _lock(), _conn() as conn:
+        conn.execute('UPDATE managed_jobs SET controller_pid = ? '
+                     'WHERE job_id = ?', (pid, job_id))
+
+
+def bump_recovery_count(job_id: int) -> int:
+    with _lock(), _conn() as conn:
+        conn.execute('UPDATE managed_jobs SET recovery_count = '
+                     'recovery_count + 1 WHERE job_id = ?', (job_id,))
+        row = conn.execute('SELECT recovery_count FROM managed_jobs '
+                           'WHERE job_id = ?', (job_id,)).fetchone()
+        return int(row['recovery_count'])
+
+
+def get(job_id: int) -> Optional[Dict[str, Any]]:
+    with _conn() as conn:
+        row = conn.execute('SELECT * FROM managed_jobs WHERE job_id = ?',
+                           (job_id,)).fetchone()
+        if row is None:
+            return None
+        d = dict(row)
+        d['task_config'] = json.loads(d['task_config'])
+        d['status'] = ManagedJobStatus(d['status'])
+        return d
+
+
+def list_jobs(limit: int = 200) -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute('SELECT * FROM managed_jobs ORDER BY job_id DESC '
+                            'LIMIT ?', (limit,)).fetchall()
+    out = []
+    for row in rows:
+        d = dict(row)
+        d['task_config'] = json.loads(d['task_config'])
+        d['status'] = ManagedJobStatus(d['status'])
+        out.append(d)
+    return out
+
+
+def events(job_id: int) -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute(
+            'SELECT * FROM managed_job_events WHERE job_id = ? ORDER BY id',
+            (job_id,)).fetchall()
+        return [dict(r) for r in rows]
+
+
+def count_nonterminal() -> int:
+    with _conn() as conn:
+        terminal = [s.value for s in _TERMINAL]
+        row = conn.execute(
+            f'SELECT COUNT(*) AS c FROM managed_jobs WHERE status NOT IN '
+            f'({",".join("?" * len(terminal))})', terminal).fetchone()
+        return int(row['c'])

@@ -1,0 +1,143 @@
+"""Managed-job controller + recovery tests (fake cloud, in-process
+controller, injected whole-slice preemption).
+
+Reference analog: managed-job smoke tests that manually terminate spot
+instances mid-job (SURVEY.md §4) — here the preemption injection is a
+first-class fake-provider API, so recovery is unit-testable.
+"""
+import threading
+import time
+
+import pytest
+
+from skypilot_tpu import global_user_state, jobs
+from skypilot_tpu.jobs import state
+from skypilot_tpu.jobs.controller import JobController
+from skypilot_tpu.provision.fake import instance as fake
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+
+@pytest.fixture(autouse=True)
+def _fake(enable_fake_cloud):
+    yield
+
+
+def _run_controller(job_id: int) -> threading.Thread:
+    t = threading.Thread(
+        target=lambda: JobController(job_id, poll_seconds=0.2).run(),
+        daemon=True)
+    t.start()
+    return t
+
+
+def _wait_status(job_id: int, targets, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        r = state.get(job_id)
+        if r and r['status'] in targets:
+            return r['status']
+        time.sleep(0.1)
+    r = state.get(job_id)
+    raise TimeoutError(
+        f'job {job_id} stuck at {r["status"] if r else None}, '
+        f'events={state.events(job_id)}')
+
+
+def test_managed_job_success_cleans_up():
+    task = Task('ok', run='echo fine')
+    task.set_resources(Resources(accelerators='tpu-v5e-8', cloud='fake',
+                                 use_spot=True))
+    job_id = jobs.launch(task, _in_process=True)
+    r = state.get(job_id)
+    assert r['status'] == state.ManagedJobStatus.SUCCEEDED
+    # cluster torn down
+    assert global_user_state.get_cluster(r['cluster_name']) is None
+
+
+def test_managed_job_recovers_from_preemption():
+    task = Task('longjob', run='sleep 4; echo done')
+    task.set_resources(Resources(accelerators='tpu-v5e-8', cloud='fake',
+                                 use_spot=True))
+    job_id = state.submit('longjob', task.to_yaml_config(),
+                          recovery_strategy='FAILOVER')
+    t = _run_controller(job_id)
+    _wait_status(job_id, {state.ManagedJobStatus.RUNNING})
+    # Preempt the whole slice mid-run.
+    r = state.get(job_id)
+    record = global_user_state.get_cluster(r['cluster_name'])
+    fake.preempt_cluster(record['handle']['cluster_name_on_cloud'])
+    _wait_status(job_id, {state.ManagedJobStatus.RECOVERING,
+                          state.ManagedJobStatus.RUNNING,
+                          state.ManagedJobStatus.SUCCEEDED})
+    final = _wait_status(job_id, {state.ManagedJobStatus.SUCCEEDED},
+                         timeout=60)
+    assert final == state.ManagedJobStatus.SUCCEEDED
+    r = state.get(job_id)
+    assert r['recovery_count'] >= 1
+    transitions = [(e['from_status'], e['to_status'])
+                   for e in state.events(job_id)]
+    assert ('RUNNING', 'RECOVERING') in transitions
+    assert ('RECOVERING', 'RUNNING') in transitions
+    t.join(timeout=5)
+
+
+def test_managed_job_failure_restarts_bounded():
+    task = Task('flaky', run='exit 9')
+    task.set_resources(Resources(accelerators='tpu-v5e-8', cloud='fake'))
+    job_id = state.submit('flaky', task.to_yaml_config(),
+                          recovery_strategy='FAILOVER',
+                          max_restarts_on_errors=2)
+    JobController(job_id, poll_seconds=0.1).run()
+    r = state.get(job_id)
+    assert r['status'] == state.ManagedJobStatus.FAILED
+    assert r['recovery_count'] == 2  # restarted exactly max times
+
+
+def test_managed_job_cancel():
+    task = Task('cancelme', run='sleep 60')
+    task.set_resources(Resources(accelerators='tpu-v5e-8', cloud='fake'))
+    job_id = state.submit('cancelme', task.to_yaml_config(),
+                          recovery_strategy='FAILOVER')
+    t = _run_controller(job_id)
+    _wait_status(job_id, {state.ManagedJobStatus.RUNNING})
+    assert jobs.cancel(job_id)
+    final = _wait_status(job_id, {state.ManagedJobStatus.CANCELLED},
+                         timeout=20)
+    assert final == state.ManagedJobStatus.CANCELLED
+    r = state.get(job_id)
+    assert global_user_state.get_cluster(r['cluster_name']) is None
+    t.join(timeout=5)
+
+
+def test_managed_job_infeasible():
+    task = Task('nores', run='echo x')
+    # v4 only exists in us-central2; pin an impossible region.
+    task.set_resources(Resources(accelerators='tpu-v4-8', cloud='fake',
+                                 region='europe-west4'))
+    job_id = state.submit('nores', task.to_yaml_config(),
+                          recovery_strategy='FAILOVER')
+    JobController(job_id, poll_seconds=0.1).run()
+    r = state.get(job_id)
+    assert r['status'] == state.ManagedJobStatus.FAILED_NO_RESOURCE
+
+
+def test_eager_failover_moves_zone():
+    task = Task('mover', run='sleep 3; echo ok')
+    task.set_resources(Resources(accelerators='tpu-v5e-8', cloud='fake',
+                                 use_spot=True))
+    job_id = state.submit('mover', task.to_yaml_config(),
+                          recovery_strategy='EAGER_FAILOVER')
+    t = _run_controller(job_id)
+    _wait_status(job_id, {state.ManagedJobStatus.RUNNING})
+    r = state.get(job_id)
+    record = global_user_state.get_cluster(r['cluster_name'])
+    first_region = record['handle']['region']
+    fake.preempt_cluster(record['handle']['cluster_name_on_cloud'])
+    _wait_status(job_id, {state.ManagedJobStatus.SUCCEEDED}, timeout=60)
+    t.join(timeout=5)
+    # EAGER_FAILOVER blocklists the preempted candidate: new region differs
+    # (v5e-8 is offered in several regions at identical spot price).
+    transitions = [(e['from_status'], e['to_status'])
+                   for e in state.events(job_id)]
+    assert ('RUNNING', 'RECOVERING') in transitions
