@@ -1,0 +1,165 @@
+"""GCP TPU provisioner tests against a fake HTTP transport.
+
+Reference analog: tests/unit_tests/test_gcp.py — no network, no SDK; the
+transport is swapped for an in-memory TPU API emulator.
+"""
+import re
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.gcp import instance as gcp_instance
+from skypilot_tpu.provision.gcp import tpu_client
+
+
+class FakeTpuApi:
+    """Tiny in-memory emulation of tpu.googleapis.com/v2 nodes."""
+
+    def __init__(self, workers_per_node=4, stockout_zones=()):
+        self.nodes = {}  # (zone, node_id) -> node dict
+        self.workers_per_node = workers_per_node
+        self.stockout_zones = set(stockout_zones)
+        self.calls = []
+
+    def request(self, method, url, body=None, params=None):
+        self.calls.append((method, url))
+        m = re.match(
+            r'.*/projects/(?P<p>[^/]+)/locations/(?P<zone>[^/]+)/nodes'
+            r'(/(?P<node>[^:/]+))?(:(?P<verb>\w+))?$', url)
+        if m is None:
+            raise AssertionError(f'unhandled url {url}')
+        zone, node_id, verb = m.group('zone'), m.group('node'), m.group('verb')
+        if method == 'POST' and node_id is None:
+            node_id = params['nodeId']
+            if zone in self.stockout_zones:
+                raise tpu_client.GcpApiError(
+                    429, 'There is no more capacity in the zone')
+            node = {
+                'name': f'projects/p/locations/{zone}/nodes/{node_id}',
+                'state': 'READY',
+                'acceleratorType': body.get('acceleratorType'),
+                'networkEndpoints': [
+                    {'ipAddress': f'10.0.{len(self.nodes)}.{i + 2}',
+                     'accessConfig': {'externalIp': f'34.1.{len(self.nodes)}.{i + 2}'}}
+                    for i in range(self.workers_per_node)
+                ],
+            }
+            self.nodes[(zone, node_id)] = node
+            return {'done': True, 'response': node}
+        if method == 'GET' and node_id is None:
+            return {'nodes': [n for (z, _), n in self.nodes.items()
+                              if z == zone]}
+        if method == 'GET':
+            key = (zone, node_id)
+            if key not in self.nodes:
+                raise tpu_client.GcpApiError(404, 'not found')
+            return self.nodes[key]
+        if method == 'DELETE':
+            self.nodes.pop((zone, node_id), None)
+            return {'done': True}
+        if method == 'POST' and verb == 'stop':
+            self.nodes[(zone, node_id)]['state'] = 'STOPPED'
+            return {'done': True}
+        if method == 'POST' and verb == 'start':
+            self.nodes[(zone, node_id)]['state'] = 'READY'
+            return {'done': True}
+        raise AssertionError(f'unhandled {method} {url}')
+
+
+@pytest.fixture()
+def fake_api(monkeypatch):
+    api = FakeTpuApi()
+    client = tpu_client.TpuClient('test-project', transport=api)
+    monkeypatch.setenv('GOOGLE_CLOUD_PROJECT', 'test-project')
+    gcp_instance.set_client_for_testing(client)
+    monkeypatch.setenv('SKYTPU_GCP_ZONE', 'us-west4-a')
+    yield api
+
+
+def _cfg(num_nodes=1, zone='us-west4-a', spot=False):
+    return common.ProvisionConfig(
+        provider_name='gcp', region='us-west4', zone=zone,
+        cluster_name='c', cluster_name_on_cloud='c-abc',
+        num_nodes=num_nodes,
+        node_config={
+            'tpu_vm': True, 'accelerator_type': 'v5litepod-16',
+            'topology': '4x4', 'hosts_per_slice': 4,
+            'runtime_version': 'v2-alpha-tpuv5-lite', 'use_spot': spot,
+        })
+
+
+def test_create_slice_and_cluster_info(fake_api):
+    record = gcp_instance.run_instances(_cfg())
+    assert record.created_instance_ids == ['c-abc-0']
+    info = gcp_instance.get_cluster_info('us-west4', 'c-abc')
+    assert info.num_workers == 4  # one InstanceInfo per networkEndpoint
+    assert info.head_instance_id == 'c-abc-0-w0'
+    ranks = [(i.node_id, i.worker_id) for i in info.all_workers_sorted()]
+    assert ranks == [(0, 0), (0, 1), (0, 2), (0, 3)]
+    assert all(i.internal_ip.startswith('10.0.') for i in info.instances)
+
+
+def test_multislice_creates_n_nodes(fake_api):
+    record = gcp_instance.run_instances(_cfg(num_nodes=2))
+    assert record.created_instance_ids == ['c-abc-0', 'c-abc-1']
+    info = gcp_instance.get_cluster_info('us-west4', 'c-abc')
+    assert info.num_nodes == 2
+    assert info.num_workers == 8
+
+
+def test_stockout_maps_to_quota_error_and_rolls_back(fake_api):
+    fake_api.stockout_zones.add('us-west4-a')
+    with pytest.raises(exceptions.QuotaExceededError):
+        gcp_instance.run_instances(_cfg())
+    assert not fake_api.nodes  # nothing leaked
+
+
+def test_partial_multislice_stockout_rolls_back_created(fake_api):
+    # First slice succeeds, then the zone runs dry: the created slice
+    # must be deleted (atomic multislice acquisition).
+    class FlakyApi(FakeTpuApi):
+        def __init__(self):
+            super().__init__()
+            self.creates = 0
+
+        def request(self, method, url, body=None, params=None):
+            if method == 'POST' and url.endswith('/nodes'):
+                self.creates += 1
+                if self.creates >= 2:
+                    raise tpu_client.GcpApiError(
+                        429, 'There is no more capacity in the zone')
+            return super().request(method, url, body=body, params=params)
+
+    api = FlakyApi()
+    gcp_instance.set_client_for_testing(
+        tpu_client.TpuClient('test-project', transport=api))
+    with pytest.raises(exceptions.QuotaExceededError):
+        gcp_instance.run_instances(_cfg(num_nodes=2))
+    assert not api.nodes
+
+
+def test_stop_start_cycle(fake_api):
+    gcp_instance.run_instances(_cfg())
+    gcp_instance.stop_instances('c-abc', {'zone': 'us-west4-a'})
+    statuses = gcp_instance.query_instances('c-abc', {'zone': 'us-west4-a'})
+    assert set(statuses.values()) == {'stopped'}
+    # resume via run_instances (resume_stopped_nodes)
+    record = gcp_instance.run_instances(_cfg())
+    assert record.resumed_instance_ids == ['c-abc-0']
+    statuses = gcp_instance.query_instances('c-abc', {'zone': 'us-west4-a'})
+    assert set(statuses.values()) == {'running'}
+
+
+def test_terminate_removes_nodes(fake_api):
+    gcp_instance.run_instances(_cfg())
+    gcp_instance.terminate_instances('c-abc', {'zone': 'us-west4-a'})
+    assert gcp_instance.query_instances('c-abc', {'zone': 'us-west4-a'}) == {}
+
+
+def test_preempted_state_maps_to_terminated(fake_api):
+    gcp_instance.run_instances(_cfg())
+    fake_api.nodes[('us-west4-a', 'c-abc-0')]['state'] = 'PREEMPTED'
+    statuses = gcp_instance.query_instances('c-abc', {'zone': 'us-west4-a'})
+    assert set(statuses.values()) == {'terminated'}
+    assert len(statuses) == 4  # per-worker expansion
