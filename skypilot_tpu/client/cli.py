@@ -335,3 +335,38 @@ def jobs_logs(job_id, no_follow):
     """Tail a managed job's logs."""
     from skypilot_tpu import jobs
     jobs.tail_logs(job_id, follow=not no_follow)
+
+
+@cli.group('api')
+def api_group():
+    """API server management (analog of `sky api`)."""
+
+
+@api_group.command('start')
+@click.option('--port', type=int, default=46580)
+@_clean_errors
+def api_start(port):
+    """Start the local API server daemon."""
+    import os
+    os.environ.setdefault('SKYTPU_API_SERVER_URL', f'http://127.0.0.1:{port}')
+    from skypilot_tpu.client import sdk
+    sdk.ensure_server()
+    click.echo(f'API server healthy at {sdk.server_url()}')
+
+
+@api_group.command('info')
+@_clean_errors
+def api_info_cmd():
+    """Show API server health."""
+    from skypilot_tpu.client import sdk
+    click.echo(sdk.api_info())
+
+
+@api_group.command('requests')
+@_clean_errors
+def api_requests_cmd():
+    """List recent API requests."""
+    from skypilot_tpu.client import sdk
+    _echo_table(sdk.api_requests(),
+                [('request_id', 'ID'), ('name', 'NAME'),
+                 ('status', 'STATUS')])

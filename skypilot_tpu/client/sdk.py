@@ -1,0 +1,208 @@
+"""Client SDK: HTTP client mirroring the API server endpoints.
+
+Reference analog: ``sky/client/sdk.py`` (2,800 LoC) — every verb returns a
+``request_id`` immediately; ``get()`` blocks for the result,
+``stream_and_get()`` streams the server-side log then returns the result
+(``sdk.py:455,1477``).  ``ensure_server()`` starts a local API server
+daemon on first use (the reference auto-starts its server the same way).
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+import requests as requests_lib
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.task import Task
+
+DEFAULT_SERVER_URL = f'http://127.0.0.1:46580'
+
+
+def server_url() -> str:
+    return os.environ.get('SKYTPU_API_SERVER_URL', DEFAULT_SERVER_URL)
+
+
+def api_info() -> Dict[str, Any]:
+    try:
+        r = requests_lib.get(f'{server_url()}/health', timeout=5)
+        return r.json()
+    except requests_lib.RequestException as e:
+        raise exceptions.ApiServerConnectionError(server_url(), str(e)) from e
+
+
+def ensure_server(timeout: float = 20.0) -> None:
+    """Start a local API server daemon if none is reachable."""
+    try:
+        api_info()
+        return
+    except exceptions.ApiServerConnectionError:
+        pass
+    url = server_url()
+    if '127.0.0.1' not in url and 'localhost' not in url:
+        raise exceptions.ApiServerConnectionError(
+            url, 'Remote server unreachable; cannot auto-start it.')
+    port = int(url.rsplit(':', 1)[-1])
+    subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.server.server',
+         '--port', str(port)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        env=dict(os.environ), start_new_session=True)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            api_info()
+            return
+        except exceptions.ApiServerConnectionError:
+            time.sleep(0.3)
+    raise exceptions.ApiServerConnectionError(url, 'auto-start timed out')
+
+
+def _post(path: str, payload: Dict[str, Any]) -> str:
+    r = requests_lib.post(f'{server_url()}/api/v1/{path}', json=payload,
+                          timeout=30)
+    body = r.json()
+    if r.status_code != 200:
+        raise exceptions.SkyTpuError(body.get('error', r.text))
+    return body['request_id']
+
+
+def _get(path: str, params: Dict[str, Any]) -> str:
+    r = requests_lib.get(f'{server_url()}/api/v1/{path}', params=params,
+                         timeout=30)
+    body = r.json()
+    if r.status_code != 200:
+        raise exceptions.SkyTpuError(body.get('error', r.text))
+    return body['request_id']
+
+
+def get(request_id: str, timeout: float = 600.0) -> Any:
+    """Block until the request finishes; return its result or raise its
+    error (reference ``sdk.get``)."""
+    r = requests_lib.get(f'{server_url()}/api/v1/api/get',
+                         params={'request_id': request_id,
+                                 'timeout': str(timeout)},
+                         timeout=timeout + 10)
+    body = r.json()
+    if r.status_code == 202:
+        raise TimeoutError(f'request {request_id} still {body.get("status")}')
+    if r.status_code != 200:
+        raise exceptions.SkyTpuError(body.get('error', r.text))
+    if body.get('error'):
+        raise exceptions.deserialize_exception(body['error'])
+    return body.get('result')
+
+
+def stream_and_get(request_id: str, timeout: float = 600.0,
+                   quiet: bool = False) -> Any:
+    """Stream the request's server-side log (SSE), then return the result."""
+    with requests_lib.get(
+            f'{server_url()}/api/v1/api/stream',
+            params={'request_id': request_id}, stream=True,
+            timeout=timeout) as r:
+        for raw in r.iter_lines():
+            if not raw:
+                continue
+            line = raw.decode('utf-8', errors='replace')
+            if line.startswith('data: ') and not quiet:
+                try:
+                    print(json.loads(line[len('data: '):]))
+                except json.JSONDecodeError:
+                    pass
+            elif line.startswith('event: done'):
+                break
+    return get(request_id, timeout=timeout)
+
+
+# -- verbs (each returns request_id) ----------------------------------------
+
+
+def launch(task: Task, cluster_name: Optional[str] = None,
+           retry_until_up: bool = False,
+           idle_minutes_to_autostop: Optional[int] = None,
+           down: bool = False) -> str:
+    return _post('launch', {
+        'task': task.to_yaml_config(),
+        'cluster_name': cluster_name,
+        'retry_until_up': retry_until_up,
+        'idle_minutes_to_autostop': idle_minutes_to_autostop,
+        'down': down,
+    })
+
+
+def exec_(task: Task, cluster_name: str) -> str:
+    return _post('exec', {'task': task.to_yaml_config(),
+                          'cluster_name': cluster_name})
+
+
+def status(refresh: bool = False) -> str:
+    return _get('status', {'refresh': '1' if refresh else '0'})
+
+
+def queue(cluster_name: str) -> str:
+    return _get('queue', {'cluster_name': cluster_name})
+
+
+def job_status(cluster_name: str, job_id: Optional[int] = None) -> str:
+    params: Dict[str, Any] = {'cluster_name': cluster_name}
+    if job_id is not None:
+        params['job_id'] = job_id
+    return _get('job_status', params)
+
+
+def cancel(cluster_name: str, job_id: Optional[int] = None) -> str:
+    payload: Dict[str, Any] = {'cluster_name': cluster_name}
+    if job_id is not None:
+        payload['job_id'] = job_id
+    return _post('cancel', payload)
+
+
+def down(cluster_name: str) -> str:
+    return _post('down', {'cluster_name': cluster_name})
+
+
+def stop(cluster_name: str) -> str:
+    return _post('stop', {'cluster_name': cluster_name})
+
+
+def start(cluster_name: str) -> str:
+    return _post('start', {'cluster_name': cluster_name})
+
+
+def autostop(cluster_name: str, idle_minutes: int, down: bool = False) -> str:
+    return _post('autostop', {'cluster_name': cluster_name,
+                              'idle_minutes': idle_minutes, 'down': down})
+
+
+def cost_report() -> str:
+    return _get('cost_report', {})
+
+
+def check() -> str:
+    return _get('check', {})
+
+
+def jobs_launch(task: Task, recovery_strategy: str = 'FAILOVER',
+                max_restarts_on_errors: int = 0) -> str:
+    return _post('jobs/launch', {
+        'task': task.to_yaml_config(),
+        'recovery_strategy': recovery_strategy,
+        'max_restarts_on_errors': max_restarts_on_errors,
+    })
+
+
+def jobs_queue() -> str:
+    return _get('jobs/queue', {})
+
+
+def jobs_cancel(job_id: int) -> str:
+    return _post('jobs/cancel', {'job_id': job_id})
+
+
+def api_requests() -> List[Dict[str, Any]]:
+    r = requests_lib.get(f'{server_url()}/api/v1/api/requests', timeout=10)
+    return r.json()

@@ -1,0 +1,43 @@
+"""Request executor: runs API requests in isolated worker processes.
+
+Reference analog: ``sky/server/requests/executor.py`` (886 LoC) — long/short
+request lanes over process pools.  Here each request gets its own worker
+process (``python -m skypilot_tpu.server.request_runner``): crash isolation
+per request, results/errors land in the request DB, stdout in the per-request
+log (which ``/api/stream`` serves).  Lanes bound concurrency: 'short'
+(status/queue reads) is effectively unbounded, 'long' (launch/down) capped.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Any, Dict
+
+from skypilot_tpu.server import requests_db
+
+MAX_LONG_REQUESTS = 8
+
+_OPS_LANES: Dict[str, str] = {
+    'launch': 'long', 'exec': 'long', 'down': 'long', 'stop': 'long',
+    'start': 'long', 'jobs_launch': 'long',
+    'status': 'short', 'queue': 'short', 'cost_report': 'short',
+    'cancel': 'short', 'autostop': 'short', 'jobs_queue': 'short',
+    'jobs_cancel': 'short', 'job_status': 'short', 'check': 'short',
+}
+
+
+def schedule(op: str, payload: Dict[str, Any]) -> str:
+    lane = _OPS_LANES.get(op, 'long')
+    if lane == 'long' and requests_db.count_active('long') >= MAX_LONG_REQUESTS:
+        raise RuntimeError(
+            f'Server busy: {MAX_LONG_REQUESTS} long requests in flight.')
+    request_id = requests_db.create(op, {'op': op, **payload}, lane=lane)
+    log_path = requests_db.request_log_path(request_id)
+    with open(log_path, 'ab') as log_file:
+        subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.server.request_runner',
+             '--request-id', request_id],
+            stdout=log_file, stderr=subprocess.STDOUT,
+            env=dict(os.environ), start_new_session=True)
+    return request_id

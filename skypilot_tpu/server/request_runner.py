@@ -1,0 +1,107 @@
+"""Worker process executing one API request (see executor.py)."""
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Any, Dict
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.server import requests_db
+
+
+def _run_op(payload: Dict[str, Any]) -> Any:
+    op = payload['op']
+    if op == 'launch':
+        from skypilot_tpu import execution
+        from skypilot_tpu.task import Task
+        task = Task.from_yaml_config(payload['task'])
+        job_id, handle = execution.launch(
+            task, cluster_name=payload.get('cluster_name'),
+            retry_until_up=payload.get('retry_until_up', False),
+            idle_minutes_to_autostop=payload.get('idle_minutes_to_autostop'),
+            down=payload.get('down', False),
+            detach_run=True)
+        return {'job_id': job_id,
+                'handle': handle.to_dict() if handle else None}
+    if op == 'exec':
+        from skypilot_tpu import execution
+        from skypilot_tpu.task import Task
+        task = Task.from_yaml_config(payload['task'])
+        job_id, handle = execution.exec_(task, payload['cluster_name'],
+                                         detach_run=True)
+        return {'job_id': job_id, 'handle': handle.to_dict()}
+    if op == 'status':
+        from skypilot_tpu import core
+        return core.status(refresh=payload.get('refresh', False))
+    if op == 'queue':
+        from skypilot_tpu import core
+        return core.queue(payload['cluster_name'])
+    if op == 'job_status':
+        from skypilot_tpu import core
+        return core.job_status(payload['cluster_name'],
+                               payload.get('job_id'))
+    if op == 'cancel':
+        from skypilot_tpu import core
+        return core.cancel(payload['cluster_name'], payload.get('job_id'))
+    if op == 'down':
+        from skypilot_tpu import core
+        core.down(payload['cluster_name'])
+        return True
+    if op == 'stop':
+        from skypilot_tpu import core
+        core.stop(payload['cluster_name'])
+        return True
+    if op == 'start':
+        from skypilot_tpu import core
+        core.start(payload['cluster_name'])
+        return True
+    if op == 'autostop':
+        from skypilot_tpu import core
+        core.autostop(payload['cluster_name'], payload['idle_minutes'],
+                      payload.get('down', False))
+        return True
+    if op == 'cost_report':
+        from skypilot_tpu import core
+        return core.cost_report()
+    if op == 'check':
+        from skypilot_tpu import check as check_lib
+        return {c: {'enabled': ok, 'reason': reason}
+                for c, (ok, reason) in check_lib.check_capabilities(
+                    quiet=True).items()}
+    if op == 'jobs_launch':
+        from skypilot_tpu import jobs
+        from skypilot_tpu.task import Task
+        task = Task.from_yaml_config(payload['task'])
+        return jobs.launch(
+            task, recovery_strategy=payload.get('recovery_strategy',
+                                                'FAILOVER'),
+            max_restarts_on_errors=payload.get('max_restarts_on_errors', 0))
+    if op == 'jobs_queue':
+        from skypilot_tpu import jobs
+        return jobs.queue()
+    if op == 'jobs_cancel':
+        from skypilot_tpu import jobs
+        return jobs.cancel(payload['job_id'])
+    raise ValueError(f'Unknown op {op!r}')
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--request-id', required=True)
+    args = parser.parse_args()
+    record = requests_db.get(args.request_id)
+    assert record is not None, args.request_id
+    if record['status'].is_terminal():  # cancelled before start
+        return
+    requests_db.set_running(args.request_id, os.getpid())
+    try:
+        result = _run_op(record['payload'])
+        requests_db.finish(args.request_id, result=result)
+    except Exception as e:  # noqa: BLE001 — errors become request state
+        print(f'[request] failed: {e!r}', flush=True)
+        requests_db.finish(args.request_id,
+                           error=exceptions.serialize_exception(e))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,154 @@
+"""API-server request table.
+
+Reference analog: ``sky/server/requests/requests.py`` (1,208 LoC) — every
+API call becomes a persisted request row (status, payload, result, logs) so
+clients can disconnect and re-attach (``/api/get``, ``/api/stream``).
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import filelock
+
+
+class RequestStatus(enum.Enum):
+    PENDING = 'PENDING'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in (RequestStatus.SUCCEEDED, RequestStatus.FAILED,
+                        RequestStatus.CANCELLED)
+
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS requests (
+    request_id TEXT PRIMARY KEY,
+    name TEXT,
+    status TEXT NOT NULL,
+    created_at REAL NOT NULL,
+    started_at REAL,
+    finished_at REAL,
+    payload TEXT,
+    result TEXT,
+    error TEXT,
+    pid INTEGER,
+    log_path TEXT,
+    lane TEXT DEFAULT 'long'
+);
+"""
+
+
+def _server_dir() -> str:
+    d = os.path.join(
+        os.path.expanduser(
+            os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu')), 'server')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _db_path() -> str:
+    return os.path.join(_server_dir(), 'requests.db')
+
+
+def request_log_path(request_id: str) -> str:
+    d = os.path.join(_server_dir(), 'request_logs')
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f'{request_id}.log')
+
+
+def _conn() -> sqlite3.Connection:
+    conn = sqlite3.connect(_db_path(), timeout=10)
+    conn.row_factory = sqlite3.Row
+    conn.executescript(_SCHEMA)
+    return conn
+
+
+def _lock() -> filelock.FileLock:
+    return filelock.FileLock(_db_path() + '.lock')
+
+
+def create(name: str, payload: Dict[str, Any], lane: str = 'long') -> str:
+    request_id = uuid.uuid4().hex[:16]
+    with _lock(), _conn() as conn:
+        conn.execute(
+            'INSERT INTO requests (request_id, name, status, created_at, '
+            'payload, log_path, lane) VALUES (?, ?, ?, ?, ?, ?, ?)',
+            (request_id, name, RequestStatus.PENDING.value, time.time(),
+             json.dumps(payload), request_log_path(request_id), lane))
+    return request_id
+
+
+def set_running(request_id: str, pid: int) -> None:
+    with _lock(), _conn() as conn:
+        conn.execute(
+            'UPDATE requests SET status = ?, started_at = ?, pid = ? '
+            'WHERE request_id = ?',
+            (RequestStatus.RUNNING.value, time.time(), pid, request_id))
+
+
+def finish(request_id: str, result: Optional[Any] = None,
+           error: Optional[Dict[str, Any]] = None) -> None:
+    status = RequestStatus.FAILED if error else RequestStatus.SUCCEEDED
+    with _lock(), _conn() as conn:
+        conn.execute(
+            'UPDATE requests SET status = ?, finished_at = ?, result = ?, '
+            'error = ? WHERE request_id = ? AND status NOT IN (?, ?)',
+            (status.value, time.time(),
+             json.dumps(result) if result is not None else None,
+             json.dumps(error) if error else None,
+             request_id, RequestStatus.CANCELLED.value,
+             RequestStatus.SUCCEEDED.value))
+
+
+def cancel(request_id: str) -> Optional[int]:
+    with _lock(), _conn() as conn:
+        row = conn.execute(
+            'SELECT status, pid FROM requests WHERE request_id = ?',
+            (request_id,)).fetchone()
+        if row is None or RequestStatus(row['status']).is_terminal():
+            return None
+        conn.execute(
+            'UPDATE requests SET status = ?, finished_at = ? '
+            'WHERE request_id = ?',
+            (RequestStatus.CANCELLED.value, time.time(), request_id))
+        return row['pid']
+
+
+def get(request_id: str) -> Optional[Dict[str, Any]]:
+    with _conn() as conn:
+        row = conn.execute('SELECT * FROM requests WHERE request_id = ?',
+                           (request_id,)).fetchone()
+        if row is None:
+            return None
+        d = dict(row)
+        d['status'] = RequestStatus(d['status'])
+        d['payload'] = json.loads(d['payload']) if d['payload'] else None
+        d['result'] = json.loads(d['result']) if d['result'] else None
+        d['error'] = json.loads(d['error']) if d['error'] else None
+        return d
+
+
+def list_requests(limit: int = 100) -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute(
+            'SELECT request_id, name, status, created_at, finished_at FROM '
+            'requests ORDER BY created_at DESC LIMIT ?', (limit,)).fetchall()
+        return [dict(r) for r in rows]
+
+
+def count_active(lane: str) -> int:
+    with _conn() as conn:
+        row = conn.execute(
+            'SELECT COUNT(*) AS c FROM requests WHERE lane = ? AND status '
+            'IN (?, ?)', (lane, RequestStatus.PENDING.value,
+                          RequestStatus.RUNNING.value)).fetchone()
+        return int(row['c'])

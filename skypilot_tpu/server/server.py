@@ -1,0 +1,180 @@
+"""API server: async REST endpoints over the request executor.
+
+Reference analog: ``sky/server/server.py`` (FastAPI app, ~45 endpoints at
+``:705-2142``, SSE log streaming via ``server/stream_utils.py``).  Built on
+aiohttp (FastAPI/uvicorn are not in this image); the endpoint contract is
+the same shape:
+
+  POST /api/v1/{launch,exec,down,stop,start,autostop,cancel,jobs/launch,...}
+      -> {"request_id": ...}            (async; result via /api/get)
+  GET  /api/v1/{status,queue,...}       -> {"request_id": ...}
+  GET  /api/v1/api/get?request_id=X     -> blocks until terminal, returns
+                                           {"status", "result"|"error"}
+  GET  /api/v1/api/stream?request_id=X  -> SSE of the request's log
+  GET  /api/v1/api/requests             -> request table
+  GET  /health                          -> {"status": "healthy", ...}
+
+Run: ``python -m skypilot_tpu.server.server --host 127.0.0.1 --port 46580``
+(46580 = the reference API server's default port).
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+from typing import Any, Dict
+
+from aiohttp import web
+
+from skypilot_tpu import __version__
+from skypilot_tpu.server import executor, requests_db
+
+DEFAULT_PORT = 46580
+
+routes = web.RouteTableDef()
+
+
+def _schedule_response(op: str, payload: Dict[str, Any]) -> web.Response:
+    try:
+        request_id = executor.schedule(op, payload)
+    except RuntimeError as e:
+        return web.json_response({'error': str(e)}, status=503)
+    return web.json_response({'request_id': request_id})
+
+
+@routes.get('/health')
+async def health(request: web.Request) -> web.Response:
+    del request
+    return web.json_response({
+        'status': 'healthy',
+        'api_version': '1',
+        'version': __version__,
+    })
+
+
+def _make_post(op: str):
+
+    async def handler(request: web.Request) -> web.Response:
+        payload = await request.json() if request.can_read_body else {}
+        return _schedule_response(op, payload)
+
+    return handler
+
+
+def _make_get(op: str):
+
+    async def handler(request: web.Request) -> web.Response:
+        payload = dict(request.query)
+        if 'refresh' in payload:
+            payload['refresh'] = payload['refresh'] in ('1', 'true', 'True')
+        if 'job_id' in payload and payload['job_id']:
+            payload['job_id'] = int(payload['job_id'])
+        return _schedule_response(op, payload)
+
+    return handler
+
+
+@routes.get('/api/v1/api/get')
+async def api_get(request: web.Request) -> web.Response:
+    request_id = request.query.get('request_id', '')
+    timeout = float(request.query.get('timeout', 600))
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        record = requests_db.get(request_id)
+        if record is None:
+            return web.json_response({'error': 'request not found'},
+                                     status=404)
+        if record['status'].is_terminal():
+            return web.json_response({
+                'request_id': request_id,
+                'name': record['name'],
+                'status': record['status'].value,
+                'result': record['result'],
+                'error': record['error'],
+            })
+        if asyncio.get_event_loop().time() > deadline:
+            return web.json_response({'status': record['status'].value,
+                                      'request_id': request_id}, status=202)
+        await asyncio.sleep(0.2)
+
+
+@routes.get('/api/v1/api/stream')
+async def api_stream(request: web.Request) -> web.StreamResponse:
+    """SSE stream of a request's log, then a final status event
+    (reference: ``server/stream_utils.py`` + ``/api/stream`` ``:1607``)."""
+    request_id = request.query.get('request_id', '')
+    record = requests_db.get(request_id)
+    if record is None:
+        return web.json_response({'error': 'request not found'}, status=404)
+    resp = web.StreamResponse(headers={
+        'Content-Type': 'text/event-stream',
+        'Cache-Control': 'no-cache',
+    })
+    await resp.prepare(request)
+    log_path = record['log_path']
+    pos = 0
+    while True:
+        if os.path.exists(log_path):
+            with open(log_path, 'rb') as f:
+                f.seek(pos)
+                chunk = f.read()
+                pos = f.tell()
+            if chunk:
+                for line in chunk.decode('utf-8',
+                                         errors='replace').splitlines():
+                    await resp.write(f'data: {json.dumps(line)}\n\n'.encode())
+        record = requests_db.get(request_id)
+        if record is None or record['status'].is_terminal():
+            final = record['status'].value if record else 'UNKNOWN'
+            await resp.write(
+                f'event: done\ndata: {json.dumps(final)}\n\n'.encode())
+            break
+        await asyncio.sleep(0.3)
+    await resp.write_eof()
+    return resp
+
+
+@routes.get('/api/v1/api/requests')
+async def api_requests(request: web.Request) -> web.Response:
+    del request
+    return web.json_response(requests_db.list_requests())
+
+
+@routes.post('/api/v1/api/cancel')
+async def api_cancel(request: web.Request) -> web.Response:
+    payload = await request.json()
+    pid = requests_db.cancel(payload['request_id'])
+    if pid:
+        try:
+            os.kill(pid, 15)
+        except (ProcessLookupError, PermissionError):
+            pass
+    return web.json_response({'cancelled': pid is not None})
+
+
+def make_app() -> web.Application:
+    app = web.Application()
+    app.add_routes(routes)
+    for op in ('launch', 'exec', 'down', 'stop', 'start', 'autostop',
+               'cancel'):
+        app.router.add_post(f'/api/v1/{op}', _make_post(op))
+    for op in ('status', 'queue', 'cost_report', 'job_status', 'check'):
+        app.router.add_get(f'/api/v1/{op}', _make_get(op))
+    app.router.add_post('/api/v1/jobs/launch', _make_post('jobs_launch'))
+    app.router.add_get('/api/v1/jobs/queue', _make_get('jobs_queue'))
+    app.router.add_post('/api/v1/jobs/cancel', _make_post('jobs_cancel'))
+    return app
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--host', default='127.0.0.1')
+    parser.add_argument('--port', type=int, default=DEFAULT_PORT)
+    args = parser.parse_args()
+    web.run_app(make_app(), host=args.host, port=args.port,
+                print=lambda *a: None)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,106 @@
+"""Client-server plane integration test: real server subprocess, real SDK,
+real local-cloud launches through the request executor.
+
+Reference analog: ``mock_client_requests`` running the whole client-server
+path (common_test_fixtures.py:56) + API resumption semantics (request table
+survives client disconnects).
+"""
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+import requests as requests_lib
+
+from skypilot_tpu.client import sdk
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import common_utils
+
+
+@pytest.fixture(scope='module')
+def server(tmp_path_factory):
+    state_dir = str(tmp_path_factory.mktemp('server_state'))
+    port = common_utils.find_free_port(47000)
+    env = dict(os.environ)
+    env['SKYTPU_STATE_DIR'] = state_dir
+    env.pop('JAX_PLATFORMS', None)
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.server.server',
+         '--port', str(port)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    url = f'http://127.0.0.1:{port}'
+    os.environ['SKYTPU_API_SERVER_URL'] = url
+    os.environ['SKYTPU_STATE_DIR'] = state_dir
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            requests_lib.get(f'{url}/health', timeout=2)
+            break
+        except requests_lib.RequestException:
+            time.sleep(0.2)
+    else:
+        proc.kill()
+        raise RuntimeError('server did not come up')
+    yield url
+    proc.terminate()
+    os.environ.pop('SKYTPU_API_SERVER_URL', None)
+    os.environ.pop('SKYTPU_STATE_DIR', None)
+
+
+def test_health(server):
+    info = sdk.api_info()
+    assert info['status'] == 'healthy'
+
+
+def test_launch_via_server_and_get(server):
+    task = Task('apitest', run='echo via-api-$SKYPILOT_NODE_RANK')
+    from skypilot_tpu.resources import Resources
+    task.set_resources(Resources(cloud='local'))
+    request_id = sdk.launch(task, cluster_name='api1')
+    result = sdk.get(request_id, timeout=60)
+    assert result['handle']['cluster_name'] == 'api1'
+    assert result['job_id'] == 1
+
+    # status through the server
+    result = sdk.get(sdk.status(), timeout=30)
+    names = [r['name'] for r in result]
+    assert 'api1' in names
+
+    # wait for job completion through the server
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        s = sdk.get(sdk.job_status('api1', 1), timeout=30)
+        if s in ('SUCCEEDED', 'FAILED'):
+            break
+        time.sleep(0.3)
+    assert s == 'SUCCEEDED'
+
+    # queue + down
+    q = sdk.get(sdk.queue('api1'), timeout=30)
+    assert q[0]['status'] == 'SUCCEEDED'
+    assert sdk.get(sdk.down('api1'), timeout=60) is True
+
+
+def test_failed_request_carries_error(server):
+    request_id = sdk.down('no-such-cluster')
+    with pytest.raises(Exception) as exc_info:
+        sdk.get(request_id, timeout=30)
+    assert 'no-such-cluster' in str(exc_info.value)
+
+
+def test_request_table_lists_history(server):
+    rows = sdk.api_requests()
+    names = {r['name'] for r in rows}
+    assert 'launch' in names
+    assert 'down' in names
+
+
+def test_stream_and_get(server, capsys):
+    task = Task('streamy', run='echo streamed-line')
+    from skypilot_tpu.resources import Resources
+    task.set_resources(Resources(cloud='local'))
+    request_id = sdk.launch(task, cluster_name='api2')
+    result = sdk.stream_and_get(request_id, timeout=60)
+    assert result['handle']['cluster_name'] == 'api2'
+    sdk.get(sdk.down('api2'), timeout=60)
