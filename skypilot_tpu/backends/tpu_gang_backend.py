@@ -338,6 +338,14 @@ class TpuGangBackend(Backend):
 
     @timeline.event
     def teardown(self, handle: ClusterHandle, terminate: bool = True) -> None:
+        # Kill unfinished jobs first: their detached drivers (and gang
+        # worker processes) must not outlive the cluster.
+        try:
+            table = job_lib.JobTable(runtime_dir(handle.cluster_name))
+            for job in table.unfinished_jobs():
+                self.cancel_job(handle, job['job_id'])
+        except Exception:  # noqa: BLE001 — teardown must not fail on this
+            pass
         if terminate:
             provision_lib.terminate_instances(handle.cloud,
                                               handle.cluster_name_on_cloud)

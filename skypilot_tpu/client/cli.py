@@ -370,3 +370,46 @@ def api_requests_cmd():
     _echo_table(sdk.api_requests(),
                 [('request_id', 'ID'), ('name', 'NAME'),
                  ('status', 'STATUS')])
+
+
+@cli.group('serve')
+def serve_group():
+    """Autoscaled serving (analog of `sky serve`)."""
+
+
+@serve_group.command('up')
+@click.argument('entrypoint', nargs=-1)
+@click.option('--service-name', '-n', 'service_name', required=True)
+@_common_task_options
+@_clean_errors
+def serve_up(entrypoint, service_name, name, workdir, cloud, accelerators,
+             num_nodes, use_spot, envs, secrets):
+    """Start an autoscaled service from a task YAML with a service: section."""
+    from skypilot_tpu import serve
+    task = _load_task(entrypoint, name, workdir, cloud, accelerators,
+                      num_nodes, use_spot, envs, secrets)
+    endpoint = serve.up(task, service_name)
+    click.echo(f'Service {service_name} starting; endpoint: {endpoint}')
+
+
+@serve_group.command('status')
+@click.argument('service_name', required=False)
+@_clean_errors
+def serve_status(service_name):
+    """Show services and their replicas."""
+    from skypilot_tpu import serve
+    for svc in serve.status(service_name):
+        click.echo(f"{svc['name']}: {svc['status']} @ {svc['endpoint']}")
+        for r in svc['replicas']:
+            click.echo(f"  replica {r['replica_id']}: {r['status']} "
+                       f"@ {r['endpoint']}")
+
+
+@serve_group.command('down')
+@click.argument('service_name')
+@_clean_errors
+def serve_down(service_name):
+    """Tear down a service."""
+    from skypilot_tpu import serve
+    serve.down(service_name)
+    click.echo(f'Service {service_name} shutting down.')

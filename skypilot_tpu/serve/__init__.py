@@ -1,0 +1,80 @@
+"""Serving plane: autoscaled replicas behind a load balancer.
+
+Reference analog: ``sky/serve/`` public verbs (`up`, `down`, `status`).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import common_utils
+
+
+def up(task: Task, service_name: str,
+       _in_process: bool = False) -> str:
+    """Start a service; returns the LB endpoint."""
+    if task.service is None:
+        raise ValueError('Task has no `service:` section.')
+    spec: ServiceSpec = task.service
+    existing = serve_state.get_service(service_name)
+    if existing is not None and existing['status'] not in (
+            serve_state.ServiceStatus.SHUTDOWN,
+            serve_state.ServiceStatus.FAILED):
+        raise ValueError(f'Service {service_name!r} already exists.')
+    lb_port = common_utils.find_free_port(30000)
+    serve_state.add_service(service_name, spec.to_yaml_config(),
+                            task.to_yaml_config())
+    if _in_process:
+        from skypilot_tpu.serve.controller import ServeController
+        import threading
+        controller = ServeController(service_name, lb_port)
+        t = threading.Thread(target=controller.run, daemon=True)
+        t.start()
+        up._controllers[service_name] = controller  # type: ignore[attr-defined]
+    else:
+        subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.serve.controller',
+             '--service-name', service_name, '--lb-port', str(lb_port)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            env=dict(os.environ), start_new_session=True)
+    return f'127.0.0.1:{lb_port}'
+
+
+up._controllers = {}  # in-process controllers for tests
+
+
+def down(service_name: str) -> None:
+    record = serve_state.get_service(service_name)
+    if record is None:
+        raise ValueError(f'Service {service_name!r} not found.')
+    serve_state.set_service_status(service_name,
+                                   serve_state.ServiceStatus.SHUTTING_DOWN)
+    controller = up._controllers.pop(service_name, None)  # type: ignore[attr-defined]
+    if controller is not None:
+        controller.stop()
+
+
+def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:
+    services = ([serve_state.get_service(service_name)]
+                if service_name else serve_state.list_services())
+    out = []
+    for svc in services:
+        if svc is None:
+            continue
+        replicas = serve_state.list_replicas(svc['name'])
+        out.append({
+            'name': svc['name'],
+            'status': svc['status'].value,
+            'endpoint': svc['endpoint'],
+            'replicas': [{
+                'replica_id': r['replica_id'],
+                'status': r['status'].value,
+                'endpoint': r['endpoint'],
+            } for r in replicas],
+        })
+    return out

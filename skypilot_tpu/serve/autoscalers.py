@@ -1,0 +1,97 @@
+"""Autoscalers: request-rate scaling with hysteresis.
+
+Reference analog: ``sky/serve/autoscalers.py`` — ``Autoscaler :116``,
+``RequestRateAutoscaler :455``, hysteresis base ``:369``.  The decision
+function is pure (request timestamps in, target count out), so it is
+unit-testable without any service running.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import List, Optional
+
+from skypilot_tpu.serve.service_spec import ReplicaPolicy
+
+
+@dataclasses.dataclass
+class AutoscalerDecision:
+    target_num_replicas: int
+    reason: str = ''
+
+
+class Autoscaler:
+
+    def __init__(self, policy: ReplicaPolicy):
+        self.policy = policy
+
+    def evaluate(self, num_ready: int, num_launching: int,
+                 request_times: List[float],
+                 now: Optional[float] = None) -> AutoscalerDecision:
+        raise NotImplementedError
+
+
+class FixedReplicaAutoscaler(Autoscaler):
+
+    def evaluate(self, num_ready, num_launching, request_times,
+                 now=None) -> AutoscalerDecision:
+        return AutoscalerDecision(self.policy.min_replicas, 'fixed')
+
+
+class RequestRateAutoscaler(Autoscaler):
+    """Scale to ceil(qps / target_qps_per_replica) with hysteresis: N
+    consecutive over-threshold evaluations to scale up, M to scale down
+    (reference defaults both; we keep them small and configurable)."""
+
+    QPS_WINDOW_SECONDS = 60.0
+
+    def __init__(self, policy: ReplicaPolicy,
+                 upscale_counter_threshold: int = 2,
+                 downscale_counter_threshold: int = 5):
+        super().__init__(policy)
+        assert policy.target_qps_per_replica is not None
+        self.upscale_threshold = upscale_counter_threshold
+        self.downscale_threshold = downscale_counter_threshold
+        self._upscale_counter = 0
+        self._downscale_counter = 0
+        self._target = policy.min_replicas
+
+    def evaluate(self, num_ready, num_launching, request_times,
+                 now=None) -> AutoscalerDecision:
+        now = now if now is not None else time.time()
+        window_start = now - self.QPS_WINDOW_SECONDS
+        recent = [t for t in request_times if t >= window_start]
+        qps = len(recent) / self.QPS_WINDOW_SECONDS
+        desired = max(
+            self.policy.min_replicas,
+            -(-int(qps * 100) // int(self.policy.target_qps_per_replica * 100))
+            if qps > 0 else self.policy.min_replicas)
+        if self.policy.max_replicas is not None:
+            desired = min(desired, self.policy.max_replicas)
+
+        if desired > self._target:
+            self._upscale_counter += 1
+            self._downscale_counter = 0
+            if self._upscale_counter >= self.upscale_threshold:
+                self._upscale_counter = 0
+                self._target = desired
+                return AutoscalerDecision(
+                    self._target, f'scale up: qps={qps:.2f}')
+        elif desired < self._target:
+            self._downscale_counter += 1
+            self._upscale_counter = 0
+            if self._downscale_counter >= self.downscale_threshold:
+                self._downscale_counter = 0
+                self._target = desired
+                return AutoscalerDecision(
+                    self._target, f'scale down: qps={qps:.2f}')
+        else:
+            self._upscale_counter = 0
+            self._downscale_counter = 0
+        return AutoscalerDecision(self._target, f'hold: qps={qps:.2f}')
+
+
+def make_autoscaler(policy: ReplicaPolicy) -> Autoscaler:
+    if policy.autoscaling and policy.target_qps_per_replica:
+        return RequestRateAutoscaler(policy)
+    return FixedReplicaAutoscaler(policy)

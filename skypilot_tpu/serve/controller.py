@@ -1,0 +1,85 @@
+"""Serve controller: autoscaler loop + replica manager + load balancer.
+
+Reference analog: ``sky/serve/service.py`` (controller + LB processes,
+``:333,360``) and ``sky/serve/controller.py`` ``SkyServeController :40``.
+Runs in-process (tests) or as a detached process per service (CLI).
+"""
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+from typing import Optional
+
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.autoscalers import make_autoscaler
+from skypilot_tpu.serve.load_balancer import LoadBalancer
+from skypilot_tpu.serve.replica_managers import ReplicaManager
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.task import Task
+
+
+class ServeController:
+
+    def __init__(self, service_name: str, lb_port: int,
+                 poll_seconds: float = 1.0):
+        record = serve_state.get_service(service_name)
+        assert record is not None, f'service {service_name} not found'
+        self.service_name = service_name
+        self.spec = ServiceSpec.from_yaml_config(record['spec'])
+        self.task = Task.from_yaml_config(record['task_config'])
+        self.poll_seconds = poll_seconds
+        self.lb = LoadBalancer(lb_port, self.spec.load_balancing_policy)
+        self.replica_manager = ReplicaManager(service_name, self.spec,
+                                              self.task)
+        self.autoscaler = make_autoscaler(self.spec.replica_policy)
+        self._stop = threading.Event()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        serve_state.set_service_status(
+            self.service_name, serve_state.ServiceStatus.REPLICA_INIT,
+            endpoint=f'127.0.0.1:{self.lb.port}')
+        self.lb.start_in_thread()
+        self.replica_manager.scale_to(self.spec.replica_policy.min_replicas)
+        became_ready = False
+        try:
+            while not self._stop.is_set():
+                record = serve_state.get_service(self.service_name)
+                if record is None or record['status'] == \
+                        serve_state.ServiceStatus.SHUTTING_DOWN:
+                    break
+                ready = self.replica_manager.probe_all()
+                self.lb.set_replicas(ready)
+                if ready and not became_ready:
+                    became_ready = True
+                    serve_state.set_service_status(
+                        self.service_name, serve_state.ServiceStatus.READY)
+                decision = self.autoscaler.evaluate(
+                    num_ready=len(ready),
+                    num_launching=self.replica_manager.num_alive() - len(ready),
+                    request_times=self.lb.drain_request_times())
+                if decision.target_num_replicas != \
+                        self.replica_manager.num_alive():
+                    self.replica_manager.scale_to(
+                        decision.target_num_replicas)
+                self._stop.wait(self.poll_seconds)
+        finally:
+            self.replica_manager.teardown_all()
+            self.lb.stop()
+            serve_state.set_service_status(
+                self.service_name, serve_state.ServiceStatus.SHUTDOWN)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--service-name', required=True)
+    parser.add_argument('--lb-port', type=int, required=True)
+    args = parser.parse_args()
+    ServeController(args.service_name, args.lb_port).run()
+
+
+if __name__ == '__main__':
+    main()

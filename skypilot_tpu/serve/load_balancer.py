@@ -1,0 +1,108 @@
+"""Async HTTP load balancer (the data plane).
+
+Reference analog: ``sky/serve/load_balancer.py`` ``SkyServeLoadBalancer
+:24`` — an async reverse proxy that forwards each request to a replica
+chosen by the policy and records request timestamps for the autoscaler.
+"""
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from typing import List, Optional
+
+import aiohttp
+from aiohttp import web
+
+from skypilot_tpu.serve.load_balancing_policies import (LoadBalancingPolicy,
+                                                        make_policy)
+
+
+class LoadBalancer:
+
+    def __init__(self, port: int, policy: str = 'least_load'):
+        self.port = port
+        self.policy: LoadBalancingPolicy = make_policy(policy)
+        self.request_times: List[float] = []
+        self._times_lock = threading.Lock()
+        self._runner: Optional[web.AppRunner] = None
+        self._thread: Optional[threading.Thread] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+
+    # -- autoscaler API ----------------------------------------------------
+
+    def set_replicas(self, endpoints: List[str]) -> None:
+        self.policy.set_replicas(endpoints)
+
+    def drain_request_times(self, window_seconds: float = 120.0) -> List[float]:
+        cutoff = time.time() - window_seconds
+        with self._times_lock:
+            self.request_times = [t for t in self.request_times if t > cutoff]
+            return list(self.request_times)
+
+    # -- proxy -------------------------------------------------------------
+
+    async def _proxy(self, request: web.Request) -> web.StreamResponse:
+        replica = self.policy.select()
+        if replica is None:
+            return web.json_response(
+                {'error': 'No ready replicas.'}, status=503)
+        with self._times_lock:
+            self.request_times.append(time.time())
+        url = f'http://{replica}{request.path_qs}'
+        self.policy.on_request_start(replica)
+        try:
+            async with aiohttp.ClientSession() as session:
+                body = await request.read()
+                async with session.request(
+                        request.method, url, data=body,
+                        headers={k: v for k, v in request.headers.items()
+                                 if k.lower() not in ('host',)},
+                        timeout=aiohttp.ClientTimeout(total=300)) as resp:
+                    payload = await resp.read()
+                    return web.Response(status=resp.status, body=payload,
+                                        headers={'X-Served-By': replica})
+        except aiohttp.ClientError as e:
+            return web.json_response(
+                {'error': f'replica {replica} failed: {e}'}, status=502)
+        finally:
+            self.policy.on_request_end(replica)
+
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_route('*', '/{tail:.*}', self._proxy)
+        return app
+
+    # -- lifecycle (thread-hosted for the in-process controller) -----------
+
+    def start_in_thread(self) -> None:
+        started = threading.Event()
+
+        def run() -> None:
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+            self._runner = web.AppRunner(self.make_app())
+            self._loop.run_until_complete(self._runner.setup())
+            site = web.TCPSite(self._runner, '127.0.0.1', self.port)
+            self._loop.run_until_complete(site.start())
+            started.set()
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        if not started.wait(timeout=10):
+            raise RuntimeError('load balancer failed to start')
+
+    def stop(self) -> None:
+        if self._loop is None:
+            return
+        loop = self._loop
+
+        async def shutdown():
+            if self._runner is not None:
+                await self._runner.cleanup()
+            loop.stop()
+
+        asyncio.run_coroutine_threadsafe(shutdown(), loop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)

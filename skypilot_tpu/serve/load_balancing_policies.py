@@ -1,0 +1,92 @@
+"""Load-balancing policies (reference analog:
+``sky/serve/load_balancing_policies.py`` — ``RoundRobinPolicy :85``,
+``LeastLoadPolicy`` (default) ``:111``)."""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+
+class LoadBalancingPolicy:
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.replicas: List[str] = []
+
+    def set_replicas(self, replicas: List[str]) -> None:
+        with self._lock:
+            self.replicas = list(replicas)
+
+    def select(self) -> Optional[str]:
+        raise NotImplementedError
+
+    def on_request_start(self, replica: str) -> None:
+        pass
+
+    def on_request_end(self, replica: str) -> None:
+        pass
+
+
+class RoundRobinPolicy(LoadBalancingPolicy):
+
+    def __init__(self):
+        super().__init__()
+        self._idx = 0
+
+    def select(self) -> Optional[str]:
+        with self._lock:
+            if not self.replicas:
+                return None
+            replica = self.replicas[self._idx % len(self.replicas)]
+            self._idx += 1
+            return replica
+
+
+class LeastLoadPolicy(LoadBalancingPolicy):
+    """Route to the replica with the fewest in-flight requests; ties are
+    broken by rotation so sequential (zero-load) traffic still spreads."""
+
+    def __init__(self):
+        super().__init__()
+        self._inflight: Dict[str, int] = {}
+        self._rotation = 0
+
+    def set_replicas(self, replicas: List[str]) -> None:
+        with self._lock:
+            self.replicas = list(replicas)
+            for r in replicas:
+                self._inflight.setdefault(r, 0)
+            for r in list(self._inflight):
+                if r not in replicas:
+                    del self._inflight[r]
+
+    def select(self) -> Optional[str]:
+        with self._lock:
+            if not self.replicas:
+                return None
+            low = min(self._inflight.get(r, 0) for r in self.replicas)
+            candidates = [r for r in self.replicas
+                          if self._inflight.get(r, 0) == low]
+            self._rotation += 1
+            return candidates[self._rotation % len(candidates)]
+
+    def on_request_start(self, replica: str) -> None:
+        with self._lock:
+            self._inflight[replica] = self._inflight.get(replica, 0) + 1
+
+    def on_request_end(self, replica: str) -> None:
+        with self._lock:
+            self._inflight[replica] = max(
+                0, self._inflight.get(replica, 0) - 1)
+
+
+POLICIES = {
+    'round_robin': RoundRobinPolicy,
+    'least_load': LeastLoadPolicy,
+}
+
+
+def make_policy(name: str) -> LoadBalancingPolicy:
+    if name not in POLICIES:
+        raise ValueError(f'Unknown LB policy {name!r}; have {sorted(POLICIES)}')
+    return POLICIES[name]()

@@ -1,0 +1,173 @@
+"""Replica manager: launch/probe/replace replicas.
+
+Reference analog: ``sky/serve/replica_managers.py`` ``SkyPilotReplicaManager
+:731`` — replicas are ordinary clusters launched via ``execution.launch``;
+readiness comes from HTTP probes; failed replicas are torn down and
+replaced with fresh replica ids.
+
+Each replica gets ``SKYTPU_REPLICA_PORT`` (free port on the replica host)
+injected, so one local host can run many replicas, while cloud replicas can
+simply bind the spec port (the env equals it there).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import requests as requests_lib
+
+from skypilot_tpu import core, exceptions, execution, global_user_state
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import common_utils
+
+
+class ReplicaManager:
+
+    def __init__(self, service_name: str, spec: ServiceSpec, task: Task):
+        self.service_name = service_name
+        self.spec = spec
+        self.task = task
+        self._next_replica_id = 1 + max(
+            [r['replica_id'] for r in
+             serve_state.list_replicas(service_name)] or [0])
+        self._ready_since: Dict[int, float] = {}
+
+    def _cluster_name(self, replica_id: int) -> str:
+        return f'sv-{self.service_name}-r{replica_id}'
+
+    # -- scale up ----------------------------------------------------------
+
+    def launch_replica(self) -> int:
+        replica_id = self._next_replica_id
+        self._next_replica_id += 1
+        cluster = self._cluster_name(replica_id)
+        serve_state.upsert_replica(self.service_name, replica_id,
+                                   serve_state.ReplicaStatus.PROVISIONING,
+                                   cluster_name=cluster)
+        task = Task.from_yaml_config(self.task.to_yaml_config())
+        is_local = any(r.cloud in ('local', 'fake') or r.cloud is None
+                       for r in task.resources_ordered)
+        port = (common_utils.find_free_port(20000 + replica_id * 17)
+                if is_local else self.spec.port)
+        task.update_envs({'SKYTPU_REPLICA_PORT': str(port)})
+        try:
+            execution.launch(task, cluster_name=cluster, detach_run=True)
+        except exceptions.SkyTpuError as e:
+            serve_state.upsert_replica(self.service_name, replica_id,
+                                       serve_state.ReplicaStatus.FAILED)
+            raise
+        record = global_user_state.get_cluster(cluster)
+        # Endpoint: head worker ip + the replica port.
+        ip = '127.0.0.1'
+        if record and record['handle']:
+            from skypilot_tpu import provision as provision_lib
+            handle = record['handle']
+            try:
+                info = provision_lib.get_cluster_info(
+                    handle['cloud'], handle['region'],
+                    handle['cluster_name_on_cloud'])
+                head = info.get_head()
+                if head is not None:
+                    ip = head.external_ip or head.internal_ip
+            except exceptions.SkyTpuError:
+                pass
+        serve_state.upsert_replica(self.service_name, replica_id,
+                                   serve_state.ReplicaStatus.STARTING,
+                                   endpoint=f'{ip}:{port}')
+        return replica_id
+
+    # -- scale down / replace ---------------------------------------------
+
+    def terminate_replica(self, replica_id: int, failed: bool = False) -> None:
+        cluster = self._cluster_name(replica_id)
+        serve_state.upsert_replica(
+            self.service_name, replica_id,
+            serve_state.ReplicaStatus.FAILED if failed
+            else serve_state.ReplicaStatus.SHUTTING_DOWN)
+        try:
+            core.down(cluster)
+        except exceptions.SkyTpuError:
+            pass
+        self._ready_since.pop(replica_id, None)
+        if failed:
+            serve_state.upsert_replica(self.service_name, replica_id,
+                                       serve_state.ReplicaStatus.FAILED)
+        else:
+            serve_state.remove_replica(self.service_name, replica_id)
+
+    # -- probing -----------------------------------------------------------
+
+    def _probe(self, endpoint: str) -> bool:
+        probe = self.spec.readiness_probe
+        try:
+            r = requests_lib.get(f'http://{endpoint}{probe.path}',
+                                 timeout=probe.timeout_seconds)
+            return r.status_code < 500
+        except requests_lib.RequestException:
+            return False
+
+    def probe_all(self) -> List[str]:
+        """Probe every live replica; update statuses; replace dead READY
+        replicas. Returns ready endpoints."""
+        ready: List[str] = []
+        now = time.time()
+        for rep in serve_state.list_replicas(self.service_name):
+            rid, status = rep['replica_id'], rep['status']
+            endpoint = rep['endpoint']
+            if status in (serve_state.ReplicaStatus.FAILED,
+                          serve_state.ReplicaStatus.SHUTDOWN,
+                          serve_state.ReplicaStatus.SHUTTING_DOWN):
+                continue
+            if endpoint is None:
+                continue
+            ok = self._probe(endpoint)
+            if ok:
+                self._ready_since.setdefault(rid, now)
+                serve_state.upsert_replica(self.service_name, rid,
+                                           serve_state.ReplicaStatus.READY)
+                ready.append(endpoint)
+            else:
+                age = now - rep['created_at']
+                grace = self.spec.readiness_probe.initial_delay_seconds
+                if status == serve_state.ReplicaStatus.READY or age > grace:
+                    # Was ready (or exceeded its grace period) and now is
+                    # not: tear down and replace.
+                    serve_state.upsert_replica(
+                        self.service_name, rid,
+                        serve_state.ReplicaStatus.NOT_READY)
+                    self.terminate_replica(rid, failed=True)
+                    self.launch_replica()
+        return ready
+
+    def num_alive(self) -> int:
+        alive = {serve_state.ReplicaStatus.PROVISIONING,
+                 serve_state.ReplicaStatus.STARTING,
+                 serve_state.ReplicaStatus.READY,
+                 serve_state.ReplicaStatus.NOT_READY}
+        return sum(1 for r in serve_state.list_replicas(self.service_name)
+                   if r['status'] in alive)
+
+    def scale_to(self, target: int) -> None:
+        alive = self.num_alive()
+        while alive < target:
+            self.launch_replica()
+            alive += 1
+        if alive > target:
+            # Prefer terminating non-ready replicas first.
+            reps = serve_state.list_replicas(self.service_name)
+            order = sorted(
+                (r for r in reps if r['status'] in (
+                    serve_state.ReplicaStatus.PROVISIONING,
+                    serve_state.ReplicaStatus.STARTING,
+                    serve_state.ReplicaStatus.NOT_READY,
+                    serve_state.ReplicaStatus.READY)),
+                key=lambda r: (r['status'] == serve_state.ReplicaStatus.READY,
+                               r['replica_id']))
+            for rep in order[:alive - target]:
+                self.terminate_replica(rep['replica_id'])
+
+    def teardown_all(self) -> None:
+        for rep in serve_state.list_replicas(self.service_name):
+            self.terminate_replica(rep['replica_id'])

@@ -1,0 +1,163 @@
+"""Serve state tables (reference analog: ``sky/serve/serve_state.py``)."""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+import filelock
+
+
+class ServiceStatus(enum.Enum):
+    CONTROLLER_INIT = 'CONTROLLER_INIT'
+    REPLICA_INIT = 'REPLICA_INIT'
+    READY = 'READY'
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    FAILED = 'FAILED'
+    SHUTDOWN = 'SHUTDOWN'
+
+
+class ReplicaStatus(enum.Enum):
+    PROVISIONING = 'PROVISIONING'
+    STARTING = 'STARTING'
+    READY = 'READY'
+    NOT_READY = 'NOT_READY'
+    FAILED = 'FAILED'
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    SHUTDOWN = 'SHUTDOWN'
+
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS services (
+    name TEXT PRIMARY KEY,
+    status TEXT NOT NULL,
+    spec TEXT NOT NULL,
+    task_config TEXT NOT NULL,
+    endpoint TEXT,
+    created_at REAL,
+    controller_pid INTEGER
+);
+CREATE TABLE IF NOT EXISTS replicas (
+    service_name TEXT,
+    replica_id INTEGER,
+    status TEXT NOT NULL,
+    cluster_name TEXT,
+    endpoint TEXT,
+    created_at REAL,
+    PRIMARY KEY (service_name, replica_id)
+);
+"""
+
+
+def _db_path() -> str:
+    d = os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, 'serve.db')
+
+
+def _conn() -> sqlite3.Connection:
+    conn = sqlite3.connect(_db_path(), timeout=10)
+    conn.row_factory = sqlite3.Row
+    conn.executescript(_SCHEMA)
+    return conn
+
+
+def _lock() -> filelock.FileLock:
+    return filelock.FileLock(_db_path() + '.lock')
+
+
+def add_service(name: str, spec: Dict[str, Any],
+                task_config: Dict[str, Any]) -> None:
+    with _lock(), _conn() as conn:
+        conn.execute(
+            'INSERT OR REPLACE INTO services (name, status, spec, '
+            'task_config, created_at) VALUES (?, ?, ?, ?, ?)',
+            (name, ServiceStatus.CONTROLLER_INIT.value, json.dumps(spec),
+             json.dumps(task_config), time.time()))
+
+
+def set_service_status(name: str, status: ServiceStatus,
+                       endpoint: Optional[str] = None) -> None:
+    with _lock(), _conn() as conn:
+        if endpoint is not None:
+            conn.execute('UPDATE services SET status = ?, endpoint = ? '
+                         'WHERE name = ?', (status.value, endpoint, name))
+        else:
+            conn.execute('UPDATE services SET status = ? WHERE name = ?',
+                         (status.value, name))
+
+
+def get_service(name: str) -> Optional[Dict[str, Any]]:
+    with _conn() as conn:
+        row = conn.execute('SELECT * FROM services WHERE name = ?',
+                           (name,)).fetchone()
+        if row is None:
+            return None
+        d = dict(row)
+        d['spec'] = json.loads(d['spec'])
+        d['task_config'] = json.loads(d['task_config'])
+        d['status'] = ServiceStatus(d['status'])
+        return d
+
+
+def list_services() -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute('SELECT name FROM services').fetchall()
+    return [get_service(r['name']) for r in rows]
+
+
+def remove_service(name: str) -> None:
+    with _lock(), _conn() as conn:
+        conn.execute('DELETE FROM services WHERE name = ?', (name,))
+        conn.execute('DELETE FROM replicas WHERE service_name = ?', (name,))
+
+
+def upsert_replica(service_name: str, replica_id: int,
+                   status: ReplicaStatus,
+                   cluster_name: Optional[str] = None,
+                   endpoint: Optional[str] = None) -> None:
+    with _lock(), _conn() as conn:
+        existing = conn.execute(
+            'SELECT replica_id FROM replicas WHERE service_name = ? AND '
+            'replica_id = ?', (service_name, replica_id)).fetchone()
+        if existing is None:
+            conn.execute(
+                'INSERT INTO replicas (service_name, replica_id, status, '
+                'cluster_name, endpoint, created_at) VALUES (?, ?, ?, ?, ?, ?)',
+                (service_name, replica_id, status.value, cluster_name,
+                 endpoint, time.time()))
+        else:
+            sets, args = ['status = ?'], [status.value]
+            if cluster_name is not None:
+                sets.append('cluster_name = ?')
+                args.append(cluster_name)
+            if endpoint is not None:
+                sets.append('endpoint = ?')
+                args.append(endpoint)
+            args += [service_name, replica_id]
+            conn.execute(
+                f'UPDATE replicas SET {", ".join(sets)} WHERE '
+                'service_name = ? AND replica_id = ?', args)
+
+
+def list_replicas(service_name: str) -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute(
+            'SELECT * FROM replicas WHERE service_name = ? ORDER BY '
+            'replica_id', (service_name,)).fetchall()
+    out = []
+    for row in rows:
+        d = dict(row)
+        d['status'] = ReplicaStatus(d['status'])
+        out.append(d)
+    return out
+
+
+def remove_replica(service_name: str, replica_id: int) -> None:
+    with _lock(), _conn() as conn:
+        conn.execute('DELETE FROM replicas WHERE service_name = ? AND '
+                     'replica_id = ?', (service_name, replica_id))

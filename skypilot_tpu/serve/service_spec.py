@@ -1,0 +1,101 @@
+"""Service spec: the ``service:`` section of a task YAML.
+
+Reference analog: ``sky/serve/service_spec.py`` — readiness probe, replica
+policy (fixed count or autoscaling with target QPS), ports.
+
+.. code-block:: yaml
+
+    service:
+      readiness_probe:
+        path: /health
+        initial_delay_seconds: 20
+      replica_policy:
+        min_replicas: 1
+        max_replicas: 4
+        target_qps_per_replica: 10
+      port: 8080
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class ReadinessProbe:
+    path: str = '/'
+    initial_delay_seconds: float = 20.0
+    timeout_seconds: float = 5.0
+
+    @classmethod
+    def from_config(cls, cfg: Any) -> 'ReadinessProbe':
+        if cfg is None:
+            return cls()
+        if isinstance(cfg, str):
+            return cls(path=cfg)
+        return cls(path=cfg.get('path', '/'),
+                   initial_delay_seconds=cfg.get('initial_delay_seconds', 20),
+                   timeout_seconds=cfg.get('timeout_seconds', 5))
+
+
+@dataclasses.dataclass
+class ReplicaPolicy:
+    min_replicas: int = 1
+    max_replicas: Optional[int] = None  # None = fixed at min
+    target_qps_per_replica: Optional[float] = None
+
+    @property
+    def autoscaling(self) -> bool:
+        return (self.max_replicas is not None and
+                self.max_replicas > self.min_replicas)
+
+    @classmethod
+    def from_config(cls, cfg: Any) -> 'ReplicaPolicy':
+        if cfg is None:
+            return cls()
+        if isinstance(cfg, int):
+            return cls(min_replicas=cfg)
+        return cls(min_replicas=cfg.get('min_replicas', 1),
+                   max_replicas=cfg.get('max_replicas'),
+                   target_qps_per_replica=cfg.get('target_qps_per_replica'))
+
+
+@dataclasses.dataclass
+class ServiceSpec:
+    readiness_probe: ReadinessProbe
+    replica_policy: ReplicaPolicy
+    port: int = 8080
+    load_balancing_policy: str = 'least_load'
+
+    @classmethod
+    def from_yaml_config(cls, cfg: Dict[str, Any]) -> 'ServiceSpec':
+        known = {'readiness_probe', 'replica_policy', 'replicas', 'port',
+                 'load_balancing_policy'}
+        unknown = set(cfg) - known
+        if unknown:
+            raise ValueError(f'Unknown fields in service: {sorted(unknown)}')
+        policy_cfg = cfg.get('replica_policy', cfg.get('replicas'))
+        return cls(
+            readiness_probe=ReadinessProbe.from_config(
+                cfg.get('readiness_probe')),
+            replica_policy=ReplicaPolicy.from_config(policy_cfg),
+            port=int(cfg.get('port', 8080)),
+            load_balancing_policy=cfg.get('load_balancing_policy',
+                                          'least_load'))
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        return {
+            'readiness_probe': {
+                'path': self.readiness_probe.path,
+                'initial_delay_seconds':
+                    self.readiness_probe.initial_delay_seconds,
+            },
+            'replica_policy': {
+                'min_replicas': self.replica_policy.min_replicas,
+                'max_replicas': self.replica_policy.max_replicas,
+                'target_qps_per_replica':
+                    self.replica_policy.target_qps_per_replica,
+            },
+            'port': self.port,
+            'load_balancing_policy': self.load_balancing_policy,
+        }

@@ -1,0 +1,165 @@
+"""Serve plane tests: real replicas (local aiohttp servers launched as
+cluster jobs), real load balancer, real probes.
+
+Reference analog: tests/smoke_tests/test_sky_serve.py, shrunk to the local
+cloud so it runs creditless.
+"""
+import textwrap
+import time
+
+import pytest
+import requests as requests_lib
+import yaml
+
+from skypilot_tpu import serve
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.autoscalers import RequestRateAutoscaler
+from skypilot_tpu.serve.load_balancing_policies import (LeastLoadPolicy,
+                                                        RoundRobinPolicy)
+from skypilot_tpu.serve.service_spec import ReplicaPolicy
+from skypilot_tpu.task import Task
+
+# A tiny HTTP replica: /health + / returning its own port.
+_REPLICA_SERVER = (
+    "python -c \""
+    "import http.server, os, json; "
+    "port = int(os.environ['SKYTPU_REPLICA_PORT']); "
+    "h = type('H', (http.server.BaseHTTPRequestHandler,), "
+    "{'do_GET': lambda s: (s.send_response(200), s.end_headers(), "
+    "s.wfile.write(json.dumps({'port': port}).encode())), "
+    "'log_message': lambda s, *a: None}); "
+    "http.server.HTTPServer(('127.0.0.1', port), h).serve_forever()\""
+)
+
+
+@pytest.fixture(autouse=True)
+def _fake(enable_fake_cloud):
+    yield
+    # Ensure all controllers stopped.
+    for name in list(serve.up._controllers):
+        try:
+            serve.down(name)
+        except ValueError:
+            pass
+    time.sleep(0.3)
+
+
+def _service_task(min_replicas=2, max_replicas=None, target_qps=None):
+    cfg = yaml.safe_load(textwrap.dedent(f"""
+        name: echo-svc
+        resources:
+          cloud: local
+        service:
+          port: 9000
+          readiness_probe:
+            path: /health
+            initial_delay_seconds: 15
+          replica_policy:
+            min_replicas: {min_replicas}
+            max_replicas: {max_replicas if max_replicas else 'null'}
+            target_qps_per_replica: {target_qps if target_qps else 'null'}
+    """))
+    cfg['run'] = _REPLICA_SERVER
+    return Task.from_yaml_config(cfg)
+
+
+def _wait_ready(name: str, want_replicas: int, timeout: float = 60.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        st = serve.status(name)
+        if st and st[0]['status'] == 'READY':
+            ready = [r for r in st[0]['replicas'] if r['status'] == 'READY']
+            if len(ready) >= want_replicas:
+                return st[0]
+        time.sleep(0.3)
+    raise TimeoutError(f'service {name} not ready: {serve.status(name)}')
+
+
+def test_service_up_lb_round_trip_and_down():
+    task = _service_task(min_replicas=2)
+    endpoint = serve.up(task, 'svc1', _in_process=True)
+    st = _wait_ready('svc1', want_replicas=2)
+    assert len(st['replicas']) == 2
+
+    # Requests through the LB reach both replicas (least-load spreads).
+    seen_ports = set()
+    for _ in range(10):
+        r = requests_lib.get(f'http://{endpoint}/', timeout=10)
+        assert r.status_code == 200
+        seen_ports.add(r.json()['port'])
+    assert len(seen_ports) == 2, f'LB did not spread load: {seen_ports}'
+
+    serve.down('svc1')
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        st = serve.status('svc1')
+        if st and st[0]['status'] == 'SHUTDOWN':
+            break
+        time.sleep(0.3)
+    assert serve.status('svc1')[0]['status'] == 'SHUTDOWN'
+    # All replica clusters torn down.
+    from skypilot_tpu import core
+    names = [r['name'] for r in core.status()]
+    assert not any(n.startswith('sv-svc1-') for n in names)
+
+
+def test_failed_replica_is_replaced():
+    task = _service_task(min_replicas=1)
+    endpoint = serve.up(task, 'svc2', _in_process=True)
+    st = _wait_ready('svc2', want_replicas=1)
+    first = [r for r in st['replicas'] if r['status'] == 'READY'][0]
+    # Kill the replica's server process out from under the service.
+    port = int(first['endpoint'].rsplit(':', 1)[-1])
+    import psutil
+    for proc in psutil.process_iter(['pid']):
+        try:
+            for conn in proc.net_connections(kind='tcp'):
+                if conn.laddr and conn.laddr.port == port and \
+                        conn.status == 'LISTEN':
+                    proc.kill()
+        except (psutil.AccessDenied, psutil.NoSuchProcess):
+            continue
+    deadline = time.time() + 60
+    replaced = False
+    while time.time() < deadline:
+        reps = serve_state.list_replicas('svc2')
+        ready = [r for r in reps
+                 if r['status'] == serve_state.ReplicaStatus.READY]
+        if ready and ready[0]['replica_id'] != first['replica_id']:
+            replaced = True
+            break
+        time.sleep(0.5)
+    assert replaced, serve_state.list_replicas('svc2')
+    serve.down('svc2')
+
+
+def test_autoscaler_pure_decisions():
+    policy = ReplicaPolicy(min_replicas=1, max_replicas=4,
+                           target_qps_per_replica=1.0)
+    a = RequestRateAutoscaler(policy, upscale_counter_threshold=2,
+                              downscale_counter_threshold=2)
+    now = 1000.0
+    burst = [now - i * 0.2 for i in range(180)]  # 3 qps over 60s window
+    d1 = a.evaluate(1, 0, burst, now=now)
+    assert d1.target_num_replicas == 1  # hysteresis: first over-threshold
+    d2 = a.evaluate(1, 0, burst, now=now)
+    assert d2.target_num_replicas == 3  # second consecutive: scale to qps
+    # Quiet: scale down after threshold evaluations
+    d3 = a.evaluate(3, 0, [], now=now)
+    d4 = a.evaluate(3, 0, [], now=now)
+    assert d4.target_num_replicas == 1
+    assert d3.target_num_replicas == 3  # not yet on first quiet tick
+
+
+def test_lb_policies():
+    rr = RoundRobinPolicy()
+    rr.set_replicas(['a:1', 'b:2'])
+    assert [rr.select() for _ in range(4)] == ['a:1', 'b:2', 'a:1', 'b:2']
+
+    ll = LeastLoadPolicy()
+    ll.set_replicas(['a:1', 'b:2'])
+    first = ll.select()
+    ll.on_request_start(first)
+    second = ll.select()
+    assert second != first  # least load picks the idle one
+    ll.on_request_end(first)
