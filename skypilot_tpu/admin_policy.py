@@ -1,0 +1,70 @@
+"""Pluggable admin policy: org-level request mutation/validation.
+
+Reference analog: ``sky/admin_policy.py`` + ``sky/utils/admin_policy_utils``
+— a hook class loaded from config that can rewrite or reject every user
+request before execution (enforce labels, cap slice sizes, force spot, pin
+regions, ...).
+
+Configure in ``~/.skypilot_tpu/config.yaml``::
+
+    admin_policy: mypkg.policies:CapSliceSize
+"""
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Optional
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu.task import Task
+
+
+@dataclasses.dataclass
+class UserRequest:
+    task: Task
+    cluster_name: Optional[str] = None
+    idle_minutes_to_autostop: Optional[int] = None
+    down: bool = False
+
+
+@dataclasses.dataclass
+class MutatedUserRequest:
+    task: Task
+    skipped: bool = False  # policy may reject outright
+    reason: str = ''
+
+
+class AdminPolicy:
+    """Subclass and point ``admin_policy`` config at it."""
+
+    @classmethod
+    def validate_and_mutate(cls, request: UserRequest) -> MutatedUserRequest:
+        return MutatedUserRequest(task=request.task)
+
+
+def load_policy() -> Optional[type]:
+    spec = config_lib.get_nested(('admin_policy',), None)
+    if not spec:
+        return None
+    module_name, _, class_name = str(spec).partition(':')
+    if not class_name:
+        raise ValueError(
+            f'admin_policy must be "module:Class", got {spec!r}')
+    module = importlib.import_module(module_name)
+    policy = getattr(module, class_name)
+    if not issubclass(policy, AdminPolicy):
+        raise TypeError(f'{spec} is not an AdminPolicy subclass')
+    return policy
+
+
+def apply(request: UserRequest) -> Task:
+    """Run the configured policy (if any); raises on rejection."""
+    policy = load_policy()
+    if policy is None:
+        return request.task
+    mutated = policy.validate_and_mutate(request)
+    if mutated.skipped:
+        from skypilot_tpu import exceptions
+        raise exceptions.NotSupportedError(
+            f'Request rejected by admin policy: {mutated.reason}')
+    return mutated.task

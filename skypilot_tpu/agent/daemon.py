@@ -1,0 +1,97 @@
+"""Per-cluster daemon: autostop enforcement + heartbeat.
+
+Reference analog: ``sky/skylet/skylet.py`` periodic events — specifically
+``AutostopEvent`` (``skylet/events.py:161``) and ``autostop_lib``'s
+last-active tracking.  One daemon process per cluster, spawned at first
+launch; it watches the job table for idleness and executes the recorded
+autostop policy (stop or down) against the provider.
+
+``check_once`` is a pure step (read state, maybe act) so tests drive it
+synchronously without a process.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from typing import Optional
+
+from skypilot_tpu import exceptions, global_user_state
+from skypilot_tpu.agent import constants, job_lib
+
+
+def _runtime_dir(cluster_name: str) -> str:
+    from skypilot_tpu.backends.tpu_gang_backend import runtime_dir
+    return runtime_dir(cluster_name)
+
+
+def _idle_seconds(cluster_name: str) -> Optional[float]:
+    """Seconds since the last job activity; None while a job is active."""
+    table = job_lib.JobTable(_runtime_dir(cluster_name))
+    if table.unfinished_jobs():
+        return None
+    jobs = table.list_jobs(limit=1)
+    record = global_user_state.get_cluster(cluster_name)
+    candidates = []
+    if jobs and jobs[0].get('ended_at'):
+        candidates.append(jobs[0]['ended_at'])
+    if record is not None and record.get('last_activity'):
+        candidates.append(record['last_activity'])
+    if not candidates:
+        return None
+    return time.time() - max(candidates)
+
+
+def check_once(cluster_name: str) -> Optional[str]:
+    """Evaluate the autostop policy once. Returns 'stop'/'down' if it acted,
+    None otherwise."""
+    path = os.path.join(_runtime_dir(cluster_name), constants.AUTOSTOP_FILE)
+    try:
+        with open(path, encoding='utf-8') as f:
+            policy = json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+    idle_minutes = policy.get('idle_minutes', -1)
+    if idle_minutes is None or idle_minutes < 0:
+        return None
+    idle = _idle_seconds(cluster_name)
+    if idle is None or idle < idle_minutes * 60:
+        return None
+    from skypilot_tpu import core
+    try:
+        if policy.get('down'):
+            core.down(cluster_name)
+            return 'down'
+        core.stop(cluster_name)
+        return 'stop'
+    except exceptions.NotSupportedError:
+        # Cloud cannot stop (e.g. local): fall back to down.
+        core.down(cluster_name)
+        return 'down'
+    except exceptions.ClusterDoesNotExist:
+        return None
+
+
+def run_loop(cluster_name: str, interval_s: float = 20.0) -> None:
+    """Daemon loop (20 s tick, matching the reference's SkyletEvent)."""
+    while True:
+        record = global_user_state.get_cluster(cluster_name)
+        if record is None:
+            return  # cluster downed: daemon exits
+        acted = check_once(cluster_name)
+        if acted == 'down':
+            return
+        time.sleep(interval_s)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--cluster-name', required=True)
+    parser.add_argument('--interval', type=float, default=20.0)
+    args = parser.parse_args()
+    run_loop(args.cluster_name, args.interval)
+
+
+if __name__ == '__main__':
+    main()

@@ -146,8 +146,18 @@ class TpuGangBackend(Backend):
                 global_user_state.ClusterStatus.UP, is_launch=True)
             global_user_state.add_cluster_event(
                 cluster_name, 'PROVISION_DONE', f'{region}/{zone}')
+            self._start_cluster_daemon(cluster_name)
             return handle
         return None
+
+    def _start_cluster_daemon(self, cluster_name: str) -> None:
+        """Spawn the per-cluster autostop/heartbeat daemon (skylet analog).
+        Exits on its own when the cluster is downed."""
+        subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.agent.daemon',
+             '--cluster-name', cluster_name],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            env=dict(os.environ), start_new_session=True)
 
     def _check_task_fits(self, task: Task, handle: ClusterHandle) -> None:
         launched = Resources.from_yaml_config(handle.launched_resources)
@@ -221,6 +231,37 @@ class TpuGangBackend(Backend):
                 for inst in info.all_workers_sorted():
                     self._runner_spec_for(handle, inst, info).make().rsync(
                         src, dst, up=True)
+
+    @timeline.event
+    def sync_storage_mounts(self, handle: ClusterHandle,
+                            storage_mounts: Dict[str, Any]) -> None:
+        """Materialize ``file_mounts`` entries that point at object stores
+        (reference: ``task.sync_storage_mounts`` ``task.py:1415`` +
+        per-worker FUSE mounts at provision time)."""
+        if not storage_mounts:
+            return
+        from skypilot_tpu.data import storage as storage_lib
+        info = None
+        for dst, cfg in storage_mounts.items():
+            st = storage_lib.Storage.from_config(cfg)
+            if handle.cloud in ('local', 'fake'):
+                dst_local = dst
+                if not os.path.isabs(dst_local):
+                    dst_local = os.path.join(
+                        runtime_dir(handle.cluster_name),
+                        constants.WORKDIR_SUBDIR, dst_local)
+                st.materialize_local(dst_local)
+            else:
+                if info is None:
+                    info = self._cluster_info(handle)
+                cmd = st.mount_command(dst)
+                for inst in info.all_workers_sorted():
+                    runner = self._runner_spec_for(handle, inst, info).make()
+                    rc = runner.run(cmd)
+                    if rc != 0:
+                        raise exceptions.StorageError(
+                            f'Mounting {st.source} at {dst} failed on '
+                            f'{inst.instance_id} (rc={rc})')
 
     # -- execute -----------------------------------------------------------
 

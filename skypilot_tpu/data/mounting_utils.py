@@ -1,0 +1,60 @@
+"""Mount-command builders for object stores.
+
+Reference analog: ``sky/data/mounting_utils.py`` (706 LoC) — shell snippets
+that install and invoke FUSE adapters on cluster workers.  TPU-native default
+is gcsfuse (GCS is the checkpoint store for TPU fleets); rclone is the
+fallback for S3-compatible stores.
+"""
+from __future__ import annotations
+
+import shlex
+from typing import Optional
+
+GCSFUSE_VERSION = '2.5.1'
+
+_INSTALL_GCSFUSE = (
+    'command -v gcsfuse >/dev/null || ('
+    'curl -fsSL -o /tmp/gcsfuse.deb '
+    'https://github.com/GoogleCloudPlatform/gcsfuse/releases/download/'
+    f'v{GCSFUSE_VERSION}/gcsfuse_{GCSFUSE_VERSION}_amd64.deb '
+    '&& sudo dpkg -i /tmp/gcsfuse.deb)')
+
+
+def gcsfuse_mount_command(bucket: str, mount_path: str,
+                          only_dir: Optional[str] = None) -> str:
+    """Idempotent gcsfuse mount with TPU-friendly caching flags (metadata
+    cache + parallel downloads help checkpoint restore throughput)."""
+    flags = [
+        '--implicit-dirs',
+        '--stat-cache-ttl 10s',
+        '--type-cache-ttl 10s',
+        '--file-cache-enable-parallel-downloads',
+        '--rename-dir-limit 10000',
+    ]
+    if only_dir:
+        flags.append(f'--only-dir {shlex.quote(only_dir)}')
+    return (f'{_INSTALL_GCSFUSE} && '
+            f'mkdir -p {shlex.quote(mount_path)} && '
+            f'(mountpoint -q {shlex.quote(mount_path)} || '
+            f'gcsfuse {" ".join(flags)} {shlex.quote(bucket)} '
+            f'{shlex.quote(mount_path)})')
+
+
+def rclone_mount_command(remote: str, bucket: str, mount_path: str) -> str:
+    return (f'mkdir -p {shlex.quote(mount_path)} && '
+            f'(mountpoint -q {shlex.quote(mount_path)} || '
+            f'rclone mount {shlex.quote(remote)}:{shlex.quote(bucket)} '
+            f'{shlex.quote(mount_path)} --daemon --vfs-cache-mode writes)')
+
+
+def rclone_flush_script(mount_path: str) -> str:
+    """Flush cached writes before job exit (reference:
+    ``task_codegen.py`` ``_get_rclone_flush_script``) so checkpoints are
+    durable before a spot VM disappears."""
+    return (f'if mountpoint -q {shlex.quote(mount_path)}; then '
+            f'sync {shlex.quote(mount_path)} 2>/dev/null || sync; fi')
+
+
+def unmount_command(mount_path: str) -> str:
+    return (f'mountpoint -q {shlex.quote(mount_path)} && '
+            f'fusermount -u {shlex.quote(mount_path)} || true')

@@ -1,0 +1,238 @@
+"""Storage abstraction: buckets mounted/copied into tasks.
+
+Reference analog: ``sky/data/storage.py`` (4,763 LoC) — ``Storage`` /
+``AbstractStore`` (``:560,320``) with modes MOUNT / COPY / MOUNT_CACHED
+(``:306``).  Stores here:
+
+* ``GcsStore`` — Google Cloud Storage via the JSON API (requests +
+  injectable transport, same pattern as ``provision/gcp/tpu_client.py``);
+  the store a TPU fleet actually uses.
+* ``LocalStore`` — a directory standing in for a bucket (``file://`` URIs);
+  fully functional in-sandbox, and the substrate for checkpoint/resume
+  tests (the reference's checkpoint contract is "mount a bucket, rerun
+  resumes from it" — SURVEY.md §5 checkpoint/resume).
+
+Mounting on real clusters uses gcsfuse/rclone command builders from
+``mounting_utils``; on local/fake clusters MOUNT degrades to a symlink and
+COPY to a real copy.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+import shutil
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+
+class StorageMode(enum.Enum):
+    MOUNT = 'MOUNT'
+    COPY = 'COPY'
+    MOUNT_CACHED = 'MOUNT_CACHED'
+
+
+class AbstractStore:
+    """One bucket in one object store."""
+
+    scheme = 'abstract'
+
+    def __init__(self, bucket: str, prefix: str = ''):
+        self.bucket = bucket
+        self.prefix = prefix.strip('/')
+
+    @property
+    def url(self) -> str:
+        suffix = f'/{self.prefix}' if self.prefix else ''
+        return f'{self.scheme}://{self.bucket}{suffix}'
+
+    def exists(self) -> bool:
+        raise NotImplementedError
+
+    def upload(self, local_path: str, dest_rel: str = '') -> None:
+        raise NotImplementedError
+
+    def download(self, local_path: str, src_rel: str = '') -> None:
+        raise NotImplementedError
+
+    def list_objects(self, rel: str = '') -> List[str]:
+        raise NotImplementedError
+
+    def delete(self) -> None:
+        raise NotImplementedError
+
+    def mount_command(self, mount_path: str) -> str:
+        """Shell command mounting this store on a cluster worker."""
+        raise NotImplementedError
+
+
+class LocalStore(AbstractStore):
+    """Directory-backed 'bucket' (file:// scheme)."""
+
+    scheme = 'file'
+
+    def _root(self) -> str:
+        base = os.path.expanduser(
+            os.environ.get('SKYTPU_LOCAL_BUCKET_ROOT',
+                           '~/.skypilot_tpu/buckets'))
+        return os.path.join(base, self.bucket, self.prefix)
+
+    def exists(self) -> bool:
+        return os.path.isdir(self._root())
+
+    def _ensure(self) -> str:
+        root = self._root()
+        os.makedirs(root, exist_ok=True)
+        return root
+
+    def upload(self, local_path: str, dest_rel: str = '') -> None:
+        root = os.path.join(self._ensure(), dest_rel)
+        local_path = os.path.expanduser(local_path)
+        if os.path.isdir(local_path):
+            shutil.copytree(local_path, root, dirs_exist_ok=True)
+        else:
+            os.makedirs(os.path.dirname(root) or root, exist_ok=True)
+            dst = root if not os.path.isdir(root) else os.path.join(
+                root, os.path.basename(local_path))
+            shutil.copy2(local_path, dst)
+
+    def download(self, local_path: str, src_rel: str = '') -> None:
+        src = os.path.join(self._root(), src_rel)
+        if not os.path.exists(src):
+            raise exceptions.StorageBucketGetError(f'{self.url}/{src_rel}')
+        local_path = os.path.expanduser(local_path)
+        if os.path.isdir(src):
+            shutil.copytree(src, local_path, dirs_exist_ok=True)
+        else:
+            os.makedirs(os.path.dirname(local_path) or '.', exist_ok=True)
+            shutil.copy2(src, local_path)
+
+    def list_objects(self, rel: str = '') -> List[str]:
+        root = os.path.join(self._root(), rel)
+        out = []
+        for dirpath, _, files in os.walk(root):
+            for f in files:
+                out.append(os.path.relpath(os.path.join(dirpath, f),
+                                           self._root()))
+        return sorted(out)
+
+    def delete(self) -> None:
+        shutil.rmtree(self._root(), ignore_errors=True)
+
+    def mount_command(self, mount_path: str) -> str:
+        # Local 'mount' = symlink to the backing dir.
+        root = self._ensure()
+        return (f'mkdir -p {os.path.dirname(mount_path)} && '
+                f'rm -rf {mount_path} && ln -sfn {root} {mount_path}')
+
+
+class GcsStore(AbstractStore):
+    """GCS via the JSON API (no SDK). Mounting uses gcsfuse."""
+
+    scheme = 'gs'
+    API = 'https://storage.googleapis.com/storage/v1'
+    UPLOAD_API = 'https://storage.googleapis.com/upload/storage/v1'
+
+    def __init__(self, bucket: str, prefix: str = '', transport=None):
+        super().__init__(bucket, prefix)
+        if transport is None:
+            from skypilot_tpu.provision.gcp import tpu_client
+            transport = tpu_client.Transport()
+        self.transport = transport
+
+    def exists(self) -> bool:
+        from skypilot_tpu.provision.gcp import tpu_client
+        try:
+            self.transport.request('GET', f'{self.API}/b/{self.bucket}')
+            return True
+        except tpu_client.GcpApiError as e:
+            if e.status_code in (403, 404):
+                return False
+            raise
+
+    def _obj(self, rel: str) -> str:
+        key = f'{self.prefix}/{rel}' if self.prefix else rel
+        return key.strip('/')
+
+    def list_objects(self, rel: str = '') -> List[str]:
+        out = self.transport.request(
+            'GET', f'{self.API}/b/{self.bucket}/o',
+            params={'prefix': self._obj(rel)})
+        items = out.get('items', [])
+        names = [i['name'] for i in items]
+        if self.prefix:
+            names = [n[len(self.prefix) + 1:] for n in names
+                     if n.startswith(self.prefix + '/')]
+        return names
+
+    def upload(self, local_path: str, dest_rel: str = '') -> None:
+        raise exceptions.NotSupportedError(
+            'GcsStore.upload from this host requires gsutil/gcloud; on '
+            'cluster workers data lands via gcsfuse mounts.')
+
+    def download(self, local_path: str, src_rel: str = '') -> None:
+        raise exceptions.NotSupportedError(
+            'GcsStore.download from this host requires gsutil/gcloud.')
+
+    def delete(self) -> None:
+        for name in self.list_objects():
+            self.transport.request(
+                'DELETE',
+                f'{self.API}/b/{self.bucket}/o/{name.replace("/", "%2F")}')
+
+    def mount_command(self, mount_path: str) -> str:
+        from skypilot_tpu.data import mounting_utils
+        return mounting_utils.gcsfuse_mount_command(
+            self.bucket, mount_path, only_dir=self.prefix or None)
+
+
+_SCHEMES = {'gs': GcsStore, 'file': LocalStore}
+
+
+def parse_source(source: str) -> Tuple[str, str, str]:
+    """'gs://bucket/pre/fix' -> ('gs', 'bucket', 'pre/fix')."""
+    if '://' not in source:
+        raise exceptions.StorageSpecError(
+            f'Not a storage URI: {source!r} (expected scheme://bucket/...)')
+    scheme, rest = source.split('://', 1)
+    parts = rest.split('/', 1)
+    bucket = parts[0]
+    prefix = parts[1] if len(parts) > 1 else ''
+    return scheme, bucket, prefix
+
+
+@dataclasses.dataclass
+class Storage:
+    """A task's storage mount: source bucket + mode."""
+
+    source: str
+    mode: StorageMode = StorageMode.MOUNT
+
+    @classmethod
+    def from_config(cls, cfg) -> 'Storage':
+        if isinstance(cfg, str):
+            return cls(source=cfg)
+        mode = StorageMode(cfg.get('mode', 'MOUNT').upper())
+        return cls(source=cfg['source'], mode=mode)
+
+    def store(self) -> AbstractStore:
+        scheme, bucket, prefix = parse_source(self.source)
+        if scheme not in _SCHEMES:
+            raise exceptions.StorageSpecError(
+                f'Unsupported store {scheme!r}; have {sorted(_SCHEMES)}')
+        return _SCHEMES[scheme](bucket, prefix)
+
+    def materialize_local(self, dst: str) -> None:
+        """Apply on a local/fake cluster: MOUNT=symlink, COPY=copy."""
+        store = self.store()
+        dst = os.path.expanduser(dst)
+        if self.mode in (StorageMode.MOUNT, StorageMode.MOUNT_CACHED):
+            cmd = store.mount_command(dst)
+            import subprocess
+            subprocess.run(['bash', '-c', cmd], check=True)
+        else:
+            store.download(dst)
+
+    def mount_command(self, dst: str) -> str:
+        return self.store().mount_command(dst)

@@ -48,6 +48,13 @@ def launch(task: Task,
     backend = TpuGangBackend()
     stages = stages or list(Stage)
 
+    # Admin policy hook: may mutate or reject the request
+    # (reference: ``_execute`` applying admin policy, ``execution.py:105``).
+    from skypilot_tpu import admin_policy
+    task = admin_policy.apply(admin_policy.UserRequest(
+        task=task, cluster_name=cluster_name,
+        idle_minutes_to_autostop=idle_minutes_to_autostop, down=down))
+
     if Stage.OPTIMIZE in stages:
         existing = global_user_state.get_cluster(cluster_name)
         if existing is None and task.best_resources is None:
@@ -70,6 +77,7 @@ def launch(task: Task,
         backend.sync_workdir(handle, task.workdir)
     if Stage.SYNC_FILE_MOUNTS in stages:
         backend.sync_file_mounts(handle, task.file_mounts)
+        backend.sync_storage_mounts(handle, task.storage_mounts)
 
     job_id: Optional[int] = None
     if Stage.EXEC in stages and (task.run is not None or task.setup):

@@ -177,7 +177,8 @@ class Task:
         for dst, src in file_mounts_cfg.items():
             if isinstance(src, dict):
                 storage_mounts[dst] = src
-            elif isinstance(src, str) and re.match(r'^(gs|s3|r2|cos)://', src):
+            elif isinstance(src, str) and re.match(r'^(gs|s3|r2|cos|file)://',
+                                                   src):
                 storage_mounts[dst] = {'source': src, 'mode': 'MOUNT'}
             else:
                 file_mounts[dst] = src

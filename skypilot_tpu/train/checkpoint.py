@@ -1,0 +1,65 @@
+"""Checkpoint/restore for train state (orbax-backed).
+
+The framework-level contract (reference SURVEY.md §5 checkpoint/resume):
+recipes mount a bucket at e.g. ``/ckpt`` (MOUNT mode) and save here; on
+spot preemption the managed-jobs controller relaunches the task, which calls
+``restore_latest`` and resumes from the last durable step.  Orbax handles
+sharded arrays natively, so the same checkpoint round-trips between
+different mesh shapes (save on v5e-256, restore on v5e-128 resharded).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 save_interval_steps: int = 100):
+        self.directory = os.path.abspath(os.path.expanduser(directory))
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                enable_async_checkpointing=False))
+
+    def save(self, step: int, state: Dict[str, Any],
+             force: bool = False) -> bool:
+        """Save if the interval policy says so (or force=True)."""
+        saved = self._mgr.save(
+            step, args=ocp.args.StandardSave(state), force=force)
+        self._mgr.wait_until_finished()
+        return bool(saved)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore_latest(
+            self, abstract_state: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Restore the newest checkpoint into the given state layout
+        (shardings come from abstract_state's arrays). None if no
+        checkpoint exists yet — caller starts from scratch."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        return self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract_state))
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+def save_for_preemption(directory: str, step: int,
+                        state: Dict[str, Any]) -> None:
+    """One-shot forced save (for SIGTERM handlers on spot VMs)."""
+    mgr = CheckpointManager(directory, save_interval_steps=1)
+    try:
+        mgr.save(step, state, force=True)
+    finally:
+        mgr.close()

@@ -1,0 +1,99 @@
+"""Autostop enforcement + admin policy tests."""
+import time
+
+import pytest
+
+from skypilot_tpu import admin_policy, core, execution, global_user_state
+from skypilot_tpu.agent import daemon, job_lib
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+
+@pytest.fixture(autouse=True)
+def _fake(enable_fake_cloud):
+    yield
+
+
+def _wait_terminal(cluster, job_id, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        s = core.job_status(cluster, job_id)
+        if s and job_lib.JobStatus(s).is_terminal():
+            return s
+        time.sleep(0.2)
+    raise TimeoutError
+
+
+def test_autostop_downs_idle_cluster():
+    task = Task('idle', run='echo done')
+    task.set_resources(Resources(accelerators='tpu-v5e-8', cloud='fake'))
+    job_id, _ = execution.launch(task, cluster_name='as1', detach_run=True,
+                                 idle_minutes_to_autostop=0, down=True)
+    _wait_terminal('as1', job_id)
+    # idle_minutes=0: first daemon check after job end must down it.
+    deadline = time.time() + 10
+    acted = None
+    while time.time() < deadline and acted is None:
+        acted = daemon.check_once('as1')
+        time.sleep(0.2)
+    assert acted == 'down'
+    assert global_user_state.get_cluster('as1') is None
+
+
+def test_autostop_not_triggered_while_running():
+    task = Task('busy', run='sleep 30')
+    task.set_resources(Resources(accelerators='tpu-v5e-8', cloud='fake'))
+    job_id, _ = execution.launch(task, cluster_name='as2', detach_run=True,
+                                 idle_minutes_to_autostop=0)
+    deadline = time.time() + 10
+    while core.job_status('as2', job_id) != 'RUNNING':
+        assert time.time() < deadline
+        time.sleep(0.1)
+    assert daemon.check_once('as2') is None  # job active: no stop
+    core.cancel('as2', job_id)
+    core.down('as2')
+
+
+def test_autostop_stop_unsupported_falls_back_to_down():
+    task = Task('idle2', run='echo done')
+    task.set_resources(Resources(cloud='local'))
+    job_id, _ = execution.launch(task, cluster_name='as3', detach_run=True)
+    _wait_terminal('as3', job_id)
+    core.autostop('as3', 0, down=False)  # local cannot stop
+    deadline = time.time() + 10
+    acted = None
+    while time.time() < deadline and acted is None:
+        acted = daemon.check_once('as3')
+        time.sleep(0.2)
+    assert acted == 'down'
+
+
+class ForbidSpot(admin_policy.AdminPolicy):
+
+    @classmethod
+    def validate_and_mutate(cls, request):
+        for r in request.task.resources_ordered:
+            if r.use_spot:
+                return admin_policy.MutatedUserRequest(
+                    task=request.task, skipped=True,
+                    reason='spot is forbidden by org policy')
+        return admin_policy.MutatedUserRequest(task=request.task)
+
+
+def test_admin_policy_rejects(monkeypatch, tmp_path):
+    cfg = tmp_path / 'cfg.yaml'
+    cfg.write_text(
+        'admin_policy: tests.test_autostop_and_policy:ForbidSpot\n')
+    monkeypatch.setenv('SKYTPU_CONFIG', str(cfg))
+    task = Task('spotty', run='echo x')
+    task.set_resources(Resources(accelerators='tpu-v5e-8', cloud='fake',
+                                 use_spot=True))
+    from skypilot_tpu import exceptions
+    with pytest.raises(exceptions.NotSupportedError, match='forbidden'):
+        execution.launch(task, cluster_name='pol1', detach_run=True)
+    # non-spot passes
+    task2 = Task('ok', run='echo x')
+    task2.set_resources(Resources(accelerators='tpu-v5e-8', cloud='fake'))
+    job_id, _ = execution.launch(task2, cluster_name='pol2', detach_run=True)
+    assert job_id is not None
+    core.down('pol2')
