@@ -160,9 +160,9 @@ def run_job(cluster_dir: str, job_id: int) -> int:
                 log_dir, f'setup-rank-{w["global_rank"]}.log')
             gang.append((argv, env if runner.kind == 'local' else {},
                          log_path, _prefix_for(w, len(workers))))
-        codes = log_lib.run_parallel_with_logs(gang, on_spawn=_register_proc)
+        rc = log_lib.run_gang(gang, on_spawn=_register_proc)
         _live_procs.clear()
-        if any(c != 0 for c in codes):
+        if rc != 0:
             table.set_status(job_id, job_lib.JobStatus.FAILED_SETUP)
             return 1
 
@@ -184,9 +184,9 @@ def run_job(cluster_dir: str, job_id: int) -> int:
             log_dir, constants.RANK_LOG_FILE.format(rank=w['global_rank']))
         gang.append((argv, env if runner.kind == 'local' else {}, log_path,
                      _prefix_for(w, len(workers))))
-    codes = log_lib.run_parallel_with_logs(gang, on_spawn=_register_proc)
+    rc = log_lib.run_gang(gang, on_spawn=_register_proc)
     _live_procs.clear()
-    ok = all(c == 0 for c in codes)
+    ok = rc == 0
     table.set_status(
         job_id, job_lib.JobStatus.SUCCEEDED if ok else job_lib.JobStatus.FAILED)
     return 0 if ok else 1

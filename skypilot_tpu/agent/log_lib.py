@@ -93,6 +93,50 @@ def run_parallel_with_logs(cmds_envs_logs: List[tuple],
     return codes
 
 
+def run_gang(cmds_envs_logs: List[tuple], on_spawn=None,
+             fail_fast: bool = True) -> int:
+    """Gang-run via the native supervisor (skytpu_gangd) when available,
+    else the pure-Python multiplexer. Returns the job's exit code (0 iff
+    every rank succeeded; with fail-fast, the triggering rank's code).
+
+    Native path rationale: one C++ process owns spawn/mux/signal for the
+    whole gang — O(1) Python overhead regardless of worker count, and
+    cancel semantics survive even if the Python driver is SIGKILLed.
+    """
+    import shlex
+    import tempfile
+
+    from skypilot_tpu.agent import native
+
+    binary = native.gang_binary()
+    if binary is not None:
+        workers = []
+        for argv, env, log_path, prefix in cmds_envs_logs:
+            cmd = ' '.join(shlex.quote(a) for a in argv)
+            workers.append((cmd, env or {}, log_path, prefix))
+        with tempfile.NamedTemporaryFile('w', suffix='.gangspec',
+                                         delete=False) as f:
+            spec_path = f.name
+        native.write_spec(spec_path, workers)
+        args = [binary, '--spec', spec_path]
+        if fail_fast:
+            args.append('--fail-fast')
+        proc = subprocess.Popen(args, start_new_session=True)
+        if on_spawn is not None:
+            on_spawn(proc)
+        rc = proc.wait()
+        try:
+            os.unlink(spec_path)
+        except OSError:
+            pass
+        return rc
+    codes = run_parallel_with_logs(cmds_envs_logs, on_spawn=on_spawn)
+    for c in codes:
+        if c != 0:
+            return c
+    return 0
+
+
 def tail_log(log_path: str, follow: bool = False, lines: int = 100,
              poll_interval: float = 0.5,
              stop_fn=None) -> None:

@@ -1,0 +1,59 @@
+"""Native gang supervisor: build + invoke helpers.
+
+``gang_binary()`` builds ``skytpu_gangd`` on first use (g++, no deps) and
+caches the path; callers fall back to the pure-Python gang runner when no
+toolchain is available (``log_lib.run_parallel_with_logs``).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import threading
+from typing import Dict, List, Optional, Tuple
+
+_DIR = os.path.dirname(__file__)
+_BINARY = os.path.join(_DIR, 'skytpu_gangd')
+_build_lock = threading.Lock()
+_build_failed = False
+
+
+def gang_binary() -> Optional[str]:
+    """Path to the built supervisor, building it if needed; None if the
+    native path is unavailable (no compiler / build failure / opt-out)."""
+    global _build_failed
+    if os.environ.get('SKYTPU_NATIVE_GANG', '1') == '0':
+        return None
+    with _build_lock:
+        if os.path.exists(_BINARY):
+            src_mtime = os.path.getmtime(os.path.join(_DIR, 'gangd.cc'))
+            if os.path.getmtime(_BINARY) >= src_mtime:
+                return _BINARY
+        if _build_failed:
+            return None
+        if shutil.which('g++') is None and shutil.which('make') is None:
+            _build_failed = True
+            return None
+        proc = subprocess.run(['make', '-C', _DIR, 'skytpu_gangd'],
+                              capture_output=True, text=True, check=False)
+        if proc.returncode != 0 or not os.path.exists(_BINARY):
+            _build_failed = True
+            return None
+        return _BINARY
+
+
+def write_spec(path: str, workers: List[Tuple[str, Dict[str, str], str, str]]
+               ) -> None:
+    """workers: (cmd, env, log_path, prefix) — matches the Python gang
+    runner's tuple shape (argv is collapsed to a bash -c string upstream).
+    """
+    with open(path, 'w', encoding='utf-8') as f:
+        for cmd, env, log_path, prefix in workers:
+            f.write(f'log={log_path}\n')
+            if prefix:
+                f.write(f'prefix={prefix}\n')
+            for k, v in (env or {}).items():
+                if '\n' in v:
+                    continue  # spec format is line-based; such vars are rare
+                f.write(f'env={k}={v}\n')
+            f.write(f'cmd={cmd}\n\n')

@@ -1,0 +1,295 @@
+// skytpu_gangd: native gang supervisor.
+//
+// The C++ piece of the on-cluster runtime (SURVEY.md §2.10/§7: the
+// reference leans on Ray's C++ core for gang scheduling; the TPU-native
+// equivalent is this thin supervisor, because on a TPU slice the gang *is*
+// the slice and all that's left is process supervision).  Responsibilities:
+//
+//   * spawn N worker processes (each its own process group);
+//   * multiplex their stdout/stderr into per-worker log files and a
+//     prefixed combined stream on stdout ("(head, rank=0) ..." convention);
+//   * forward SIGTERM/SIGINT to every worker process group (cancel path);
+//   * gang semantics: with --fail-fast, the first non-zero exit tears the
+//     rest down after a grace period;
+//   * exit code = max worker exit code.
+//
+// Invoked by skypilot_tpu/agent/log_lib.py (native path of
+// run_parallel_with_logs) with a plain-text spec file:
+//
+//   log=/path/rank-0.log
+//   prefix=(head, rank=0)
+//   env=FOO=bar            (repeatable)
+//   cmd=bash -c 'echo hi'  (last field; ends the record)
+//   <blank line between records>
+//
+// Build: make -C skypilot_tpu/agent/native   (produces skytpu_gangd)
+
+#include <errno.h>
+#include <fcntl.h>
+#include <poll.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <fstream>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct WorkerSpec {
+  std::string log_path;
+  std::string prefix;
+  std::vector<std::string> env;  // KEY=VALUE
+  std::string cmd;
+};
+
+struct Worker {
+  WorkerSpec spec;
+  pid_t pid = -1;
+  int pipe_fd = -1;
+  int log_fd = -1;
+  std::string line_buf;
+  int exit_code = -1;
+  bool exited = false;
+};
+
+volatile sig_atomic_t g_got_term = 0;
+
+void term_handler(int) { g_got_term = 1; }
+
+std::vector<WorkerSpec> ParseSpec(const char* path) {
+  std::ifstream in(path);
+  if (!in) {
+    fprintf(stderr, "gangd: cannot open spec %s\n", path);
+    exit(2);
+  }
+  std::vector<WorkerSpec> specs;
+  WorkerSpec cur;
+  bool has_any = false;
+  std::string line;
+  while (std::getline(in, line)) {
+    if (line.empty()) {
+      if (has_any) {
+        specs.push_back(cur);
+        cur = WorkerSpec();
+        has_any = false;
+      }
+      continue;
+    }
+    auto eq = line.find('=');
+    if (eq == std::string::npos) continue;
+    std::string key = line.substr(0, eq);
+    std::string val = line.substr(eq + 1);
+    has_any = true;
+    if (key == "log") cur.log_path = val;
+    else if (key == "prefix") cur.prefix = val;
+    else if (key == "env") cur.env.push_back(val);
+    else if (key == "cmd") cur.cmd = val;
+  }
+  if (has_any) specs.push_back(cur);
+  return specs;
+}
+
+bool SpawnWorker(Worker* w) {
+  int fds[2];
+  if (pipe(fds) != 0) return false;
+  pid_t pid = fork();
+  if (pid < 0) return false;
+  if (pid == 0) {
+    // Child: own process group so the supervisor can kill the whole tree.
+    setpgid(0, 0);
+    close(fds[0]);
+    dup2(fds[1], STDOUT_FILENO);
+    dup2(fds[1], STDERR_FILENO);
+    close(fds[1]);
+    for (const auto& kv : w->spec.env) {
+      auto eq = kv.find('=');
+      if (eq != std::string::npos) {
+        setenv(kv.substr(0, eq).c_str(), kv.substr(eq + 1).c_str(), 1);
+      }
+    }
+    execl("/bin/bash", "bash", "-c", w->spec.cmd.c_str(), (char*)nullptr);
+    fprintf(stderr, "gangd: exec failed: %s\n", strerror(errno));
+    _exit(127);
+  }
+  setpgid(pid, pid);  // also from parent: avoid the race
+  close(fds[1]);
+  fcntl(fds[0], F_SETFL, O_NONBLOCK);
+  w->pid = pid;
+  w->pipe_fd = fds[0];
+  w->log_fd = open(w->spec.log_path.c_str(),
+                   O_WRONLY | O_CREAT | O_APPEND, 0644);
+  return true;
+}
+
+void FlushLines(Worker* w, const char* data, ssize_t n, bool stream) {
+  if (w->log_fd >= 0) {
+    ssize_t off = 0;
+    while (off < n) {
+      ssize_t k = write(w->log_fd, data + off, n - off);
+      if (k <= 0) break;
+      off += k;
+    }
+  }
+  if (!stream) return;
+  w->line_buf.append(data, n);
+  size_t pos;
+  while ((pos = w->line_buf.find('\n')) != std::string::npos) {
+    std::string line = w->line_buf.substr(0, pos + 1);
+    w->line_buf.erase(0, pos + 1);
+    if (!w->spec.prefix.empty()) {
+      fwrite(w->spec.prefix.data(), 1, w->spec.prefix.size(), stdout);
+    }
+    fwrite(line.data(), 1, line.size(), stdout);
+  }
+  fflush(stdout);
+}
+
+void KillAll(std::vector<Worker>* workers, int sig) {
+  for (auto& w : *workers) {
+    if (w.pid > 0 && !w.exited) kill(-w.pid, sig);
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* spec_path = nullptr;
+  bool fail_fast = false;
+  bool stream = true;
+  int grace_ms = 3000;
+  for (int i = 1; i < argc; i++) {
+    if (strcmp(argv[i], "--spec") == 0 && i + 1 < argc) {
+      spec_path = argv[++i];
+    } else if (strcmp(argv[i], "--fail-fast") == 0) {
+      fail_fast = true;
+    } else if (strcmp(argv[i], "--no-stream") == 0) {
+      stream = false;
+    } else if (strcmp(argv[i], "--grace-ms") == 0 && i + 1 < argc) {
+      grace_ms = atoi(argv[++i]);
+    }
+  }
+  if (spec_path == nullptr) {
+    fprintf(stderr,
+            "usage: skytpu_gangd --spec FILE [--fail-fast] [--no-stream] "
+            "[--grace-ms N]\n");
+    return 2;
+  }
+  auto specs = ParseSpec(spec_path);
+  if (specs.empty()) {
+    fprintf(stderr, "gangd: empty spec\n");
+    return 2;
+  }
+
+  struct sigaction sa = {};
+  sa.sa_handler = term_handler;
+  sigaction(SIGTERM, &sa, nullptr);
+  sigaction(SIGINT, &sa, nullptr);
+  signal(SIGPIPE, SIG_IGN);
+
+  std::vector<Worker> workers(specs.size());
+  for (size_t i = 0; i < specs.size(); i++) {
+    workers[i].spec = specs[i];
+    if (!SpawnWorker(&workers[i])) {
+      fprintf(stderr, "gangd: spawn failed for worker %zu\n", i);
+      KillAll(&workers, SIGTERM);
+      return 2;
+    }
+  }
+
+  size_t open_pipes = workers.size();
+  bool tearing_down = false;
+  long long teardown_deadline_ms = -1;
+  int first_fail_code = 0;  // triggering failure, not teardown signals
+
+  auto now_ms = []() -> long long {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (long long)ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+  };
+
+  while (open_pipes > 0 || [&] {
+           for (auto& w : workers)
+             if (!w.exited) return true;
+           return false;
+         }()) {
+    if (g_got_term) {
+      KillAll(&workers, SIGTERM);
+      g_got_term = 0;
+      tearing_down = true;
+      teardown_deadline_ms = now_ms() + grace_ms;
+    }
+    if (tearing_down && teardown_deadline_ms > 0 &&
+        now_ms() > teardown_deadline_ms) {
+      KillAll(&workers, SIGKILL);
+      teardown_deadline_ms = -1;
+    }
+
+    std::vector<struct pollfd> pfds;
+    std::vector<Worker*> pfd_owner;
+    for (auto& w : workers) {
+      if (w.pipe_fd >= 0) {
+        pfds.push_back({w.pipe_fd, POLLIN, 0});
+        pfd_owner.push_back(&w);
+      }
+    }
+    if (!pfds.empty()) {
+      int rc = poll(pfds.data(), pfds.size(), 200);
+      if (rc > 0) {
+        char buf[65536];
+        for (size_t i = 0; i < pfds.size(); i++) {
+          if (pfds[i].revents & (POLLIN | POLLHUP)) {
+            ssize_t n = read(pfds[i].fd, buf, sizeof(buf));
+            if (n > 0) {
+              FlushLines(pfd_owner[i], buf, n, stream);
+            } else if (n == 0 || (n < 0 && errno != EAGAIN)) {
+              close(pfds[i].fd);
+              if (pfd_owner[i]->log_fd >= 0) close(pfd_owner[i]->log_fd);
+              pfd_owner[i]->pipe_fd = -1;
+              open_pipes--;
+            }
+          }
+        }
+      }
+    } else {
+      usleep(50000);
+    }
+
+    // Reap exited children (non-blocking).
+    int status;
+    pid_t pid;
+    while ((pid = waitpid(-1, &status, WNOHANG)) > 0) {
+      for (auto& w : workers) {
+        if (w.pid == pid) {
+          w.exited = true;
+          w.exit_code = WIFEXITED(status) ? WEXITSTATUS(status)
+                        : 128 + (WIFSIGNALED(status) ? WTERMSIG(status) : 1);
+          if (w.exit_code != 0 && !tearing_down && first_fail_code == 0) {
+            first_fail_code = w.exit_code;
+          }
+          if (fail_fast && w.exit_code != 0 && !tearing_down) {
+            fprintf(stderr,
+                    "gangd: worker (pid %d) exited %d; tearing down gang\n",
+                    pid, w.exit_code);
+            tearing_down = true;
+            teardown_deadline_ms = now_ms() + grace_ms;
+            KillAll(&workers, SIGTERM);
+          }
+        }
+      }
+    }
+  }
+
+  if (first_fail_code != 0) return first_fail_code;
+  int max_code = 0;
+  for (auto& w : workers) {
+    if (w.exit_code > max_code) max_code = w.exit_code;
+  }
+  return max_code;
+}

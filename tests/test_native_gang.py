@@ -1,0 +1,77 @@
+"""Native gang supervisor (skytpu_gangd) tests: parity with the Python
+gang runner + its unique guarantees (fail-fast teardown, signal handling).
+"""
+import os
+import signal
+import subprocess
+import time
+
+import pytest
+
+from skypilot_tpu.agent import log_lib, native
+
+
+@pytest.fixture(scope='module')
+def binary():
+    b = native.gang_binary()
+    if b is None:
+        pytest.skip('no C++ toolchain available')
+    return b
+
+
+def _gang(tmp_path, specs):
+    """specs: list of (cmd, env) -> gang tuples."""
+    out = []
+    for i, (cmd, env) in enumerate(specs):
+        out.append((['bash', '-c', cmd], env, str(tmp_path / f'r{i}.log'),
+                    f'(rank={i}) '))
+    return out
+
+
+def test_native_gang_success_and_logs(tmp_path, binary):
+    rc = log_lib.run_gang(_gang(tmp_path, [
+        ('echo one-$V', {'V': 'a'}),
+        ('echo two-$V', {'V': 'b'}),
+    ]))
+    assert rc == 0
+    assert 'one-a' in (tmp_path / 'r0.log').read_text()
+    assert 'two-b' in (tmp_path / 'r1.log').read_text()
+
+
+def test_native_gang_fail_fast_kills_stragglers(tmp_path, binary):
+    t0 = time.time()
+    rc = log_lib.run_gang(_gang(tmp_path, [
+        ('sleep 30', {}),
+        ('sleep 0.1; exit 7', {}),
+    ]))
+    elapsed = time.time() - t0
+    assert rc == 7  # the triggering code, not the teardown signal
+    assert elapsed < 15, f'straggler not killed: {elapsed:.1f}s'
+
+
+def test_native_gang_sigterm_forwards(tmp_path, binary):
+    spec_path = tmp_path / 'spec.txt'
+    marker = tmp_path / 'trapped'
+    native.write_spec(str(spec_path), [
+        (f'trap "touch {marker}; exit 0" TERM; sleep 30 & wait', {},
+         str(tmp_path / 's0.log'), ''),
+    ])
+    proc = subprocess.Popen([binary, '--spec', str(spec_path)],
+                            start_new_session=True)
+    time.sleep(1.0)
+    os.killpg(proc.pid, signal.SIGTERM)
+    rc = proc.wait(timeout=15)
+    deadline = time.time() + 5
+    while not marker.exists() and time.time() < deadline:
+        time.sleep(0.1)
+    assert marker.exists(), 'worker did not receive forwarded SIGTERM'
+
+
+def test_python_fallback_parity(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYTPU_NATIVE_GANG', '0')
+    rc = log_lib.run_gang(_gang(tmp_path, [
+        ('echo py-one', {}),
+        ('exit 3', {}),
+    ]))
+    assert rc == 3
+    assert 'py-one' in (tmp_path / 'r0.log').read_text()

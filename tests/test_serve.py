@@ -53,7 +53,7 @@ def _service_task(min_replicas=2, max_replicas=None, target_qps=None):
           port: 9000
           readiness_probe:
             path: /health
-            initial_delay_seconds: 15
+            initial_delay_seconds: 90
           replica_policy:
             min_replicas: {min_replicas}
             max_replicas: {max_replicas if max_replicas else 'null'}
@@ -63,7 +63,7 @@ def _service_task(min_replicas=2, max_replicas=None, target_qps=None):
     return Task.from_yaml_config(cfg)
 
 
-def _wait_ready(name: str, want_replicas: int, timeout: float = 60.0):
+def _wait_ready(name: str, want_replicas: int, timeout: float = 120.0):
     deadline = time.time() + timeout
     while time.time() < deadline:
         st = serve.status(name)
