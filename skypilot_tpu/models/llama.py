@@ -21,6 +21,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from skypilot_tpu.models import moe
 from skypilot_tpu.ops import flash_attention
 
 Params = Dict[str, Any]
@@ -39,13 +40,25 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     max_seq_len: int = 8192
     dtype: Any = jnp.bfloat16
+    # MoE (0 experts = dense SwiGLU MLP). Expert dim shards over the
+    # `expert` mesh axis (models/moe.py).
+    num_experts: int = 0
+    expert_top_k: int = 2
+    expert_capacity_factor: float = 1.5
+    # Pipeline parallelism (1 = off). Stages shard over the `pipe` mesh
+    # axis (parallel/pipeline.py); n_layers % pipeline_stages == 0.
+    pipeline_stages: int = 1
+    pipeline_microbatches: int = 1
 
     @property
     def param_count(self) -> int:
         d, L = self.d_model, self.n_layers
         attn = d * self.n_heads * self.head_dim * 2 + \
             d * self.n_kv_heads * self.head_dim * 2
-        mlp = 3 * d * self.d_ff
+        if self.num_experts > 0:
+            mlp = self.num_experts * 3 * d * self.d_ff + d * self.num_experts
+        else:
+            mlp = 3 * d * self.d_ff
         embed = self.vocab_size * d * 2  # in + out (untied)
         return L * (attn + mlp + 2 * d) + embed + d
 
@@ -62,9 +75,11 @@ BENCH_1B = LlamaConfig(vocab_size=32_768, d_model=2048, n_layers=18,
                        max_seq_len=4096)
 TINY = LlamaConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
                    n_kv_heads=2, d_ff=128, head_dim=16, max_seq_len=512)
+# Mixtral-shaped MoE variant of TINY for ep tests/dryruns.
+MOE_TINY = dataclasses.replace(TINY, num_experts=4, expert_top_k=2)
 
 PRESETS = {'llama3-8b': LLAMA3_8B, 'llama3-1b': LLAMA3_1B,
-           'bench-1b': BENCH_1B, 'tiny': TINY}
+           'bench-1b': BENCH_1B, 'tiny': TINY, 'moe-tiny': MOE_TINY}
 
 
 # -- params -----------------------------------------------------------------
@@ -85,7 +100,7 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
 
     def layer(k):
         ks = jax.random.split(k, 7)
-        return {
+        p = {
             'attn_norm': norm_init((d,)),
             'wq': dense_init(ks[0], (d, cfg.n_heads, cfg.head_dim), d),
             'wk': dense_init(ks[1], (d, cfg.n_kv_heads, cfg.head_dim), d),
@@ -93,10 +108,15 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
             'wo': dense_init(ks[3], (cfg.n_heads, cfg.head_dim, d),
                              cfg.n_heads * cfg.head_dim),
             'mlp_norm': norm_init((d,)),
-            'w_gate': dense_init(ks[4], (d, cfg.d_ff), d),
-            'w_up': dense_init(ks[5], (d, cfg.d_ff), d),
-            'w_down': dense_init(ks[6], (cfg.d_ff, d), cfg.d_ff),
         }
+        if cfg.num_experts > 0:
+            p['moe'] = moe.init_moe_params(ks[4], d, cfg.d_ff,
+                                           cfg.num_experts, cfg.dtype)
+        else:
+            p['w_gate'] = dense_init(ks[4], (d, cfg.d_ff), d)
+            p['w_up'] = dense_init(ks[5], (d, cfg.d_ff), d)
+            p['w_down'] = dense_init(ks[6], (cfg.d_ff, d), cfg.d_ff)
+        return p
 
     layers = jax.vmap(layer)(kl)  # leading axis = layer
     return {
@@ -109,20 +129,24 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
 
 def param_logical_axes(cfg: LlamaConfig) -> Params:
     """Logical sharding axes matching init_params' tree (leaves = tuples)."""
-    del cfg
+    layers: Params = {
+        'attn_norm': ('layers', None),
+        'wq': ('layers', 'embed', 'heads', 'head_dim'),
+        'wk': ('layers', 'embed', 'kv_heads', 'head_dim'),
+        'wv': ('layers', 'embed', 'kv_heads', 'head_dim'),
+        'wo': ('layers', 'heads', 'head_dim', 'embed'),
+        'mlp_norm': ('layers', None),
+    }
+    if cfg.num_experts > 0:
+        layers['moe'] = {
+            k: ('layers',) + v for k, v in moe.moe_logical_axes().items()}
+    else:
+        layers['w_gate'] = ('layers', 'embed', 'mlp')
+        layers['w_up'] = ('layers', 'embed', 'mlp')
+        layers['w_down'] = ('layers', 'mlp', 'embed')
     return {
         'embed': ('vocab', 'embed'),
-        'layers': {
-            'attn_norm': ('layers', None),
-            'wq': ('layers', 'embed', 'heads', 'head_dim'),
-            'wk': ('layers', 'embed', 'kv_heads', 'head_dim'),
-            'wv': ('layers', 'embed', 'kv_heads', 'head_dim'),
-            'wo': ('layers', 'heads', 'head_dim', 'embed'),
-            'mlp_norm': ('layers', None),
-            'w_gate': ('layers', 'embed', 'mlp'),
-            'w_up': ('layers', 'embed', 'mlp'),
-            'w_down': ('layers', 'mlp', 'embed'),
-        },
+        'layers': layers,
         'final_norm': (None,),
         'lm_head': ('embed', 'vocab'),
     }
@@ -154,7 +178,9 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 
 def _decoder_layer(cfg: LlamaConfig, x: jax.Array, layer: Params,
-                   positions: jax.Array) -> jax.Array:
+                   positions: jax.Array,
+                   moe_constrain=None) -> Tuple[jax.Array, jax.Array]:
+    """One decoder block; returns (x, moe_aux_loss)."""
     # Attention block
     h = rms_norm(x, layer['attn_norm'], cfg.norm_eps)
     q = jnp.einsum('bsd,dhk->bshk', h, layer['wq'])
@@ -167,43 +193,119 @@ def _decoder_layer(cfg: LlamaConfig, x: jax.Array, layer: Params,
                           v.transpose(0, 2, 1, 3), causal=True)
     att = att.transpose(0, 2, 1, 3)
     x = x + jnp.einsum('bshk,hkd->bsd', att, layer['wo'])
-    # MLP block (SwiGLU)
+    # MLP block: dense SwiGLU or expert-parallel MoE
     h = rms_norm(x, layer['mlp_norm'], cfg.norm_eps)
-    gate = jnp.einsum('bsd,df->bsf', h, layer['w_gate'])
-    up = jnp.einsum('bsd,df->bsf', h, layer['w_up'])
-    x = x + jnp.einsum('bsf,fd->bsd', jax.nn.silu(gate) * up,
-                       layer['w_down'])
-    return x
+    if cfg.num_experts > 0:
+        mlp_out, aux = moe.moe_mlp(h, layer['moe'], cfg.num_experts,
+                                   cfg.expert_top_k,
+                                   cfg.expert_capacity_factor,
+                                   constrain=moe_constrain)
+    else:
+        gate = jnp.einsum('bsd,df->bsf', h, layer['w_gate'])
+        up = jnp.einsum('bsd,df->bsf', h, layer['w_up'])
+        mlp_out = jnp.einsum('bsf,fd->bsd', jax.nn.silu(gate) * up,
+                             layer['w_down'])
+        aux = jnp.zeros((), jnp.float32)
+    return x + mlp_out, aux
 
 
-def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig,
-            remat: bool = False) -> jax.Array:
-    """tokens: [B, S] int32 -> logits [B, S, vocab] (fp32)."""
-    b, s = tokens.shape
-    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
-    x = params['embed'].astype(cfg.dtype)[tokens]
+def _layer_stack(cfg: LlamaConfig, x: jax.Array, layers: Params,
+                 positions: jax.Array, remat: bool,
+                 moe_constrain=None) -> Tuple[jax.Array, jax.Array]:
+    """Scan over (a slice of) the layer stack; returns (x, aux_sum)."""
 
     def body(carry, layer):
-        y = _decoder_layer(cfg, carry, layer, positions)
-        return y, None
+        x, aux = carry
+        y, a = _decoder_layer(cfg, x, layer, positions,
+                              moe_constrain=moe_constrain)
+        return (y, aux + a), None
 
     if remat:
         body = jax.checkpoint(
             body, policy=jax.checkpoint_policies.nothing_saveable)
-    x, _ = jax.lax.scan(body, x, params['layers'])
+    (x, aux), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)), layers)
+    return x, aux
+
+
+def forward_with_aux(params: Params, tokens: jax.Array, cfg: LlamaConfig,
+                     remat: bool = False, mesh=None,
+                     rules=None) -> Tuple[jax.Array, jax.Array]:
+    """tokens: [B, S] int32 -> (logits [B, S, vocab] fp32, moe aux loss)."""
+    b, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    x = params['embed'].astype(cfg.dtype)[tokens]
+
+    moe_constrain = None
+    if mesh is not None and rules is not None and cfg.num_experts > 0:
+        from skypilot_tpu.parallel import sharding as _sh
+
+        def moe_constrain(t):
+            return _sh.constrain(t, mesh, rules, ('expert', None, None))
+
+    if cfg.pipeline_stages > 1:
+        from skypilot_tpu.parallel import pipeline as pipe_lib
+        from skypilot_tpu.parallel import sharding as sharding_lib
+        n_stages = cfg.pipeline_stages
+        n_micro = max(cfg.pipeline_microbatches, 1)
+        if b % n_micro:
+            raise ValueError(f'batch {b} not divisible by '
+                             f'{n_micro} microbatches')
+        stage_params = pipe_lib.split_stages(params['layers'], n_stages)
+        micro = x.reshape(n_micro, b // n_micro, s, x.shape[-1])
+        mb_positions = positions[:b // n_micro]
+
+        def stage_fn(layers, x_mb):
+            return _layer_stack(cfg, x_mb, layers, mb_positions, remat,
+                                moe_constrain=moe_constrain)
+
+        constrain = None
+        if mesh is not None and rules is not None:
+            def constrain(buf):
+                return sharding_lib.constrain(
+                    buf, mesh, rules, ('stage', 'batch', 'seqlen', None))
+        micro_out, aux = pipe_lib.pipeline_apply(
+            stage_fn, stage_params, micro, num_stages=n_stages,
+            constrain=constrain)
+        # aux summed over M microbatches x S stages; average over micro-
+        # batches so its scale matches the unpipelined per-layer sum.
+        aux = aux / n_micro
+        x = micro_out.reshape(b, s, x.shape[-1])
+    else:
+        x, aux = _layer_stack(cfg, x, params['layers'], positions, remat,
+                              moe_constrain=moe_constrain)
+
     x = rms_norm(x, params['final_norm'], cfg.norm_eps)
     logits = jnp.einsum('bsd,dv->bsv', x, params['lm_head'],
                         preferred_element_type=jnp.float32)
-    return logits
+    return logits, aux
+
+
+def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig,
+            remat: bool = False, mesh=None, rules=None) -> jax.Array:
+    """tokens: [B, S] int32 -> logits [B, S, vocab] (fp32)."""
+    return forward_with_aux(params, tokens, cfg, remat=remat, mesh=mesh,
+                            rules=rules)[0]
+
+
+MOE_AUX_WEIGHT = 0.01
 
 
 def loss_fn(params: Params, tokens: jax.Array, cfg: LlamaConfig,
-            remat: bool = True) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """Next-token cross-entropy over tokens[:, 1:]."""
-    logits = forward(params, tokens[:, :-1], cfg, remat=remat)
+            remat: bool = True, mesh=None,
+            rules=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Next-token cross-entropy over tokens[:, 1:] (+ MoE balance loss)."""
+    logits, aux = forward_with_aux(params, tokens[:, :-1], cfg, remat=remat,
+                                   mesh=mesh, rules=rules)
     targets = tokens[:, 1:]
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None],
                                axis=-1).squeeze(-1)
     nll = (logz - gold).mean()
-    return nll, {'loss': nll, 'perplexity': jnp.exp(nll)}
+    metrics = {'loss': nll, 'perplexity': jnp.exp(nll)}
+    total = nll
+    if cfg.num_experts > 0:
+        # Normalize the scanned/pipelined aux sum to a per-layer mean.
+        aux_mean = aux / cfg.n_layers
+        total = nll + MOE_AUX_WEIGHT * aux_mean
+        metrics['moe_aux'] = aux_mean
+    return total, metrics

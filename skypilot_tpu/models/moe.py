@@ -1,0 +1,121 @@
+"""Mixture-of-Experts MLP with expert parallelism over the ``expert`` axis.
+
+The reference delegates MoE (like every parallelism strategy) to launched
+workloads (SURVEY.md §2.11); here it is a first-class layer.  The design is
+the GShard/Switch einsum formulation, which is the TPU-idiomatic one:
+
+* routing, dispatch, and combine are dense one-hot einsums — MXU work with
+  static shapes, no gather/scatter, no dynamic shapes that would defeat XLA;
+* the dispatched activations ``[experts, capacity, d_model]`` carry an
+  ``expert`` logical axis; with the expert dim sharded over the ``expert``
+  mesh axis, XLA SPMD inserts the all-to-all between the token-sharded and
+  expert-sharded layouts automatically (sharding-annotation recipe — we
+  never hand-write the collective);
+* per-expert FFNs run as one batched einsum over the expert dim (vmap-free,
+  one big MXU contraction).
+
+Capacity-based token dropping (``capacity_factor``) keeps shapes static;
+the Switch-style load-balancing aux loss pushes the router toward uniform
+expert utilization so drops stay rare.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+def init_moe_params(key: jax.Array, d_model: int, d_ff: int,
+                    num_experts: int, dtype: Any) -> Params:
+    ks = jax.random.split(key, 4)
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) *
+                (fan_in ** -0.5)).astype(dtype)
+
+    return {
+        # Router stays fp32: tiny, and routing decisions are precision-
+        # sensitive.
+        'router': jax.random.normal(ks[0], (d_model, num_experts),
+                                    jnp.float32) * (d_model ** -0.5),
+        'we_gate': dense(ks[1], (num_experts, d_model, d_ff), d_model),
+        'we_up': dense(ks[2], (num_experts, d_model, d_ff), d_model),
+        'we_down': dense(ks[3], (num_experts, d_ff, d_model), d_ff),
+    }
+
+
+def moe_logical_axes() -> Params:
+    return {
+        'router': ('embed', None),
+        'we_gate': ('expert', 'embed', 'mlp'),
+        'we_up': ('expert', 'embed', 'mlp'),
+        'we_down': ('expert', 'mlp', 'embed'),
+    }
+
+
+def expert_capacity(num_tokens: int, num_experts: int, top_k: int,
+                    capacity_factor: float) -> int:
+    """Static per-expert slot count, rounded up to a multiple of 8 so the
+    capacity dim tiles cleanly on the MXU/VPU."""
+    cap = math.ceil(top_k * num_tokens / num_experts * capacity_factor)
+    return max(8, -(-cap // 8) * 8)
+
+
+def moe_mlp(x: jax.Array, params: Params, num_experts: int, top_k: int,
+            capacity_factor: float,
+            constrain=None) -> Tuple[jax.Array, jax.Array]:
+    """``x: [B, S, D] -> ([B, S, D], aux_loss)``.
+
+    Dispatch priority is choice-major (all first choices across tokens beat
+    any second choice), matching GShard's overflow semantics.
+    """
+    b, s, d = x.shape
+    n = b * s
+    e, k = num_experts, top_k
+    cap = expert_capacity(n, e, k, capacity_factor)
+    xf = x.reshape(n, d)
+
+    logits = xf.astype(jnp.float32) @ params['router']        # [N, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)             # [N, K]
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(-1, keepdims=True), 1e-9)
+    choice_hot = jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)  # [N, K, E]
+
+    # Position of each (token, choice) in its expert's buffer: cumulative
+    # count in choice-major order.
+    flat = choice_hot.transpose(1, 0, 2).reshape(k * n, e)
+    pos = jnp.cumsum(flat, axis=0) - 1.0
+    keep = flat * (pos < cap)
+    pos = pos.reshape(k, n, e).transpose(1, 0, 2)             # [N, K, E]
+    keep = keep.reshape(k, n, e).transpose(1, 0, 2)
+
+    slot_hot = jax.nn.one_hot(pos.astype(jnp.int32), cap,
+                              dtype=jnp.float32) * keep[..., None]
+    dispatch = slot_hot.sum(axis=1)                           # [N, E, C]
+    combine = jnp.einsum('nk,nkec->nec', gate_vals, slot_hot)  # [N, E, C]
+
+    # Token-sharded -> expert-sharded: XLA inserts the all-to-all here once
+    # expert_in's expert dim is pinned to the `expert` mesh axis by the
+    # caller-provided constraint (falling back to propagation from the
+    # we_* param shardings when no mesh is in scope).
+    expert_in = jnp.einsum('nec,nd->ecd', dispatch,
+                           xf.astype(jnp.float32)).astype(x.dtype)
+    if constrain is not None:
+        expert_in = constrain(expert_in)
+    gate = jnp.einsum('ecd,edf->ecf', expert_in, params['we_gate'])
+    up = jnp.einsum('ecd,edf->ecf', expert_in, params['we_up'])
+    expert_out = jnp.einsum('ecf,efd->ecd', jax.nn.silu(gate) * up,
+                            params['we_down'])
+    out = jnp.einsum('nec,ecd->nd', combine,
+                     expert_out.astype(jnp.float32))
+
+    # Switch aux loss: E * sum_e f_e * P_e — minimized at uniform routing.
+    frac_dispatched = choice_hot[:, 0, :].mean(axis=0)
+    mean_prob = probs.mean(axis=0)
+    aux = e * jnp.sum(frac_dispatched * mean_prob)
+    return out.reshape(b, s, d).astype(x.dtype), aux

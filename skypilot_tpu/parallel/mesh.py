@@ -3,6 +3,8 @@
 The mesh axes follow the MaxText/scaling-book convention:
 
 * ``data``   — pure data parallelism (gradient all-reduce over DCN or ICI)
+* ``pipe``   — pipeline stages (point-to-point activation permutes; the
+  most DCN-tolerant axis after ``data``)
 * ``fsdp``   — sharded data parallel (params/optimizer sharded, all-gathered
   per layer); maps to ICI
 * ``tensor`` — tensor (megatron-style) parallelism within attention/MLP
@@ -26,14 +28,20 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
-AXIS_ORDER = ('data', 'fsdp', 'seq', 'expert', 'tensor')
+AXIS_ORDER = ('data', 'pipe', 'fsdp', 'seq', 'expert', 'tensor')
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshSpec:
     """Logical mesh shape. Unspecified axes default to 1; a single -1 axis
-    absorbs the remaining devices (like a reshape)."""
+    absorbs the remaining devices (like a reshape).
+
+    ``pipe`` (pipeline stages) sits next to ``data`` on the slow end of the
+    axis order: pipeline traffic is point-to-point activations, the most
+    DCN-tolerant collective after data-parallel all-reduce.
+    """
     data: int = 1
+    pipe: int = 1
     fsdp: int = -1
     seq: int = 1
     expert: int = 1
@@ -63,7 +71,7 @@ class MeshSpec:
 def build_mesh(spec: Optional[MeshSpec] = None,
                devices: Optional[Sequence[jax.Device]] = None,
                num_slices: int = 1) -> Mesh:
-    """Build a Mesh with all five logical axes.
+    """Build a Mesh with all six logical axes (AXIS_ORDER).
 
     ``num_slices > 1``: hybrid ICI/DCN mesh — the ``data`` axis must be a
     multiple of num_slices so inter-slice traffic is data-parallel only.

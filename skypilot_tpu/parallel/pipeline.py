@@ -1,0 +1,106 @@
+"""Pipeline parallelism: circular microbatch pipeline inside one ``jit``.
+
+The reference framework has no pipeline parallelism of its own (SURVEY.md
+§2.11 — TP/PP live inside launched workloads like torchtitan).  Here it is
+first-class and TPU-idiomatic: instead of point-to-point sends between
+per-stage processes (the NCCL/torch pattern), the whole pipeline is a single
+SPMD program —
+
+* per-stage parameters are stacked on a leading ``stage`` dim that is
+  sharded over the ``pipe`` mesh axis;
+* the activation buffer ``[stage, microbatch, ...]`` is likewise sharded on
+  ``stage``; shifting microbatches to the next stage is ``jnp.roll`` on that
+  dim, which XLA SPMD compiles to a ``CollectivePermute`` riding ICI
+  neighbor links;
+* a ``lax.scan`` over ``num_microbatches + num_stages - 1`` ticks drives the
+  fill/steady/drain phases (GPipe schedule), all under one ``jit`` so XLA
+  overlaps the permute DMA with each stage's compute.
+
+This is the same formulation MaxText uses for TPU pipelining; backward flows
+through the scan/roll automatically (reverse-mode turns the roll into the
+opposite rotation — the reverse pipeline).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def split_stages(stacked_params: Any, num_stages: int) -> Any:
+    """Reshape layer-stacked params ``[L, ...]`` -> ``[S, L // S, ...]``.
+
+    Layer l lands on stage ``l // (L // S)`` — contiguous layers per stage,
+    so sharding the new leading dim over ``pipe`` places each stage's
+    weights on its pipeline group.
+    """
+
+    def reshape(p):
+        length = p.shape[0]
+        if length % num_stages:
+            raise ValueError(
+                f'{length} layers not divisible by {num_stages} stages')
+        return p.reshape((num_stages, length // num_stages) + p.shape[1:])
+
+    return jax.tree.map(reshape, stacked_params)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], Tuple[jax.Array, jax.Array]],
+    stage_params: Any,
+    microbatches: jax.Array,
+    *,
+    num_stages: int,
+    constrain: Optional[Callable[[jax.Array], jax.Array]] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Run ``microbatches`` through the stage pipeline.
+
+    Args:
+      stage_fn: ``(per_stage_params, x_mb) -> (y_mb, aux_scalar)`` — applies
+        one stage's layers to one microbatch (vmapped over the stage dim).
+      stage_params: pytree with leading dim ``num_stages`` on every leaf
+        (see :func:`split_stages`).
+      microbatches: ``[M, mb, ...]`` inputs.
+      constrain: optional sharding constraint applied to the
+        ``[S, mb, ...]`` buffer each tick (stage dim -> ``pipe``).
+
+    Returns:
+      ``(outputs [M, mb, ...], aux_total)`` where ``aux_total`` sums
+      ``stage_fn``'s aux over every *valid* (stage, microbatch) pair —
+      bubble ticks are masked out, so regularizer losses stay exact.
+    """
+    num_micro = microbatches.shape[0]
+    ticks = num_micro + num_stages - 1
+    buffer = jnp.zeros((num_stages,) + microbatches.shape[1:],
+                       microbatches.dtype)
+    outputs = jnp.zeros_like(microbatches)
+    stage_ids = jnp.arange(num_stages)
+
+    def tick(carry, i):
+        buffer, outputs, aux = carry
+        # Stage 0 ingests microbatch i (clamped repeats during drain; the
+        # resulting bubble compute is discarded by the masks below).
+        inp = jax.lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(i, 0, num_micro - 1), axis=0,
+            keepdims=False)
+        buffer = buffer.at[0].set(inp)
+        if constrain is not None:
+            buffer = constrain(buffer)
+        out, stage_aux = jax.vmap(stage_fn)(stage_params, buffer)
+        # Stage s holds microbatch i - s; valid iff 0 <= i - s < M.
+        valid = (stage_ids <= i) & (i < stage_ids + num_micro)
+        aux = aux + jnp.sum(jnp.where(valid, stage_aux, 0.0))
+        # Last stage emits microbatch i - (S - 1) once the pipe is full.
+        out_idx = jnp.clip(i - (num_stages - 1), 0, num_micro - 1)
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs, out[-1], out_idx, axis=0)
+        # Advance: stage s's output becomes stage s+1's input. On a
+        # pipe-sharded dim XLA lowers this roll to a CollectivePermute.
+        buffer = jnp.roll(out, 1, axis=0)
+        return (buffer, outputs, aux), None
+
+    (_, outputs, aux), _ = jax.lax.scan(
+        tick, (buffer, outputs, jnp.zeros((), jnp.float32)),
+        jnp.arange(ticks))
+    return outputs, aux

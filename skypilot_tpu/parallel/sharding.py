@@ -39,7 +39,11 @@ class ShardingRules:
         ('mlp', 'tensor'),
         ('vocab', 'tensor'),
         ('head_dim', None),
-        ('layers', None),
+        # Contiguous layer blocks land on their pipeline group; with
+        # pipe=1 this is a no-op replicate.
+        ('layers', 'pipe'),
+        ('stage', 'pipe'),
+        ('expert', 'expert'),
         ('act_embed', 'tensor'),
     )
 

@@ -99,7 +99,8 @@ class Trainer:
         cfg = self.cfg
 
         def loss(params):
-            return llama.loss_fn(params, tokens, cfg.model, remat=cfg.remat)
+            return llama.loss_fn(params, tokens, cfg.model, remat=cfg.remat,
+                                 mesh=self.mesh, rules=self.rules)
 
         (loss_val, metrics), grads = jax.value_and_grad(
             loss, has_aux=True)(state['params'])

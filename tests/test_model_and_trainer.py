@@ -83,7 +83,8 @@ def test_param_sharding_applied():
 def test_mesh_spec_resolution():
     spec = mesh_lib.MeshSpec(data=2, fsdp=-1, tensor=2)
     sizes = spec.resolve(8)
-    assert sizes == {'data': 2, 'fsdp': 2, 'seq': 1, 'expert': 1, 'tensor': 2}
+    assert sizes == {'data': 2, 'pipe': 1, 'fsdp': 2, 'seq': 1, 'expert': 1,
+                     'tensor': 2}
     with pytest.raises(ValueError):
         mesh_lib.MeshSpec(data=3, fsdp=-1).resolve(8)
 
