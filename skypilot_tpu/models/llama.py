@@ -20,6 +20,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import ad_checkpoint
 
 from skypilot_tpu.models import moe
 from skypilot_tpu.ops import flash_attention
@@ -192,6 +193,10 @@ def _decoder_layer(cfg: LlamaConfig, x: jax.Array, layer: Params,
     att = flash_attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
                           v.transpose(0, 2, 1, 3), causal=True)
     att = att.transpose(0, 2, 1, 3)
+    # Named so the remat policy can keep attention outputs (the most
+    # expensive recompute) while rematerializing cheap elementwise/matmul
+    # activations.
+    att = ad_checkpoint.checkpoint_name(att, 'attn_out')
     x = x + jnp.einsum('bshk,hkd->bsd', att, layer['wo'])
     # MLP block: dense SwiGLU or expert-parallel MoE
     h = rms_norm(x, layer['mlp_norm'], cfg.norm_eps)
@@ -221,6 +226,10 @@ def _layer_stack(cfg: LlamaConfig, x: jax.Array, layers: Params,
         return (y, aux + a), None
 
     if remat:
+        # Full remat wins on this chip: saving attention outputs
+        # ('save_only_these_names("attn_out")') was measured slightly slower
+        # than recomputing them (HBM traffic for the saved activations costs
+        # more than the recompute).
         body = jax.checkpoint(
             body, policy=jax.checkpoint_policies.nothing_saveable)
     (x, aux), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)), layers)
@@ -293,9 +302,16 @@ MOE_AUX_WEIGHT = 0.01
 def loss_fn(params: Params, tokens: jax.Array, cfg: LlamaConfig,
             remat: bool = True, mesh=None,
             rules=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """Next-token cross-entropy over tokens[:, 1:] (+ MoE balance loss)."""
-    logits, aux = forward_with_aux(params, tokens[:, :-1], cfg, remat=remat,
+    """Next-token cross-entropy over tokens[:, 1:] (+ MoE balance loss).
+
+    The forward runs on the FULL sequence (length stays 128-aligned so the
+    pallas flash-attention path is taken — slicing to S-1 here would silently
+    drop every training step to the O(S^2) reference kernel); the shift
+    happens at the loss: logits[:, :-1] predict tokens[:, 1:].
+    """
+    logits, aux = forward_with_aux(params, tokens, cfg, remat=remat,
                                    mesh=mesh, rules=rules)
+    logits = logits[:, :-1]
     targets = tokens[:, 1:]
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None],

@@ -1,0 +1,92 @@
+"""Numerics tests for the pallas flash-attention kernels (fwd + fused bwd).
+
+Runs the kernels in pallas interpret mode on CPU (same lowering semantics,
+no TPU needed) against the jnp reference and its ``jax.vjp`` — the oracle
+the fused backward replaces. Block sizes are shrunk so the tests exercise
+multi-block online softmax, the causally-skipped dk/dv grid cells, and the
+split masked/unmasked loops.
+
+Reference counterpart: the reference has no attention kernels of its own
+(delegated to workloads, SURVEY.md §2.11); the oracle here plays the role
+its workload-level kernels' unit tests play.
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from skypilot_tpu.ops import attention
+
+
+@pytest.fixture()
+def small_blocks(monkeypatch):
+    """Shrink kernel blocks so S=384 spans several blocks per kernel."""
+    monkeypatch.setattr(attention, 'FWD_BLOCK_Q', 128)
+    monkeypatch.setattr(attention, 'FWD_BLOCK_K', 128)
+    monkeypatch.setattr(attention, 'DQ_BLOCK_Q', 128)
+    monkeypatch.setattr(attention, 'DQ_BLOCK_K', 128)
+    monkeypatch.setattr(attention, 'DKV_BLOCK', 128)
+
+
+def _rand(shape, key, dtype=jnp.float32):
+    return jax.random.normal(key, shape, dtype)
+
+
+@pytest.mark.parametrize('causal', [True, False])
+@pytest.mark.parametrize('group', [1, 2])
+def test_flash_fwd_bwd_matches_reference_vjp(small_blocks, causal, group):
+    b, hkv, s, d = 2, 2, 384, 64
+    hq = hkv * group
+    ks = jax.random.split(jax.random.PRNGKey(0), 4)
+    q = _rand((b, hq, s, d), ks[0])
+    k = _rand((b, hkv, s, d), ks[1])
+    v = _rand((b, hkv, s, d), ks[2])
+    g = _rand((b, hq, s, d), ks[3])
+
+    o_ref, vjp_ref = jax.vjp(
+        lambda a, b_, c: attention.attention_reference(a, b_, c, causal),
+        q, k, v)
+    o_pal, vjp_pal = jax.vjp(
+        lambda a, b_, c: attention._flash_attention(a, b_, c, causal, True),
+        q, k, v)
+
+    assert jnp.allclose(o_ref, o_pal, atol=2e-2), 'forward mismatch'
+    for name, a, b_ in zip(('dq', 'dk', 'dv'), vjp_ref(g), vjp_pal(g)):
+        err = float(jnp.abs(a - b_).max())
+        assert err < 5e-2, f'{name} max err {err}'
+
+
+def test_flash_fwd_lse_is_logsumexp(small_blocks):
+    b, h, s, d = 1, 2, 256, 64
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q, k, v = (_rand((b, h, s, d), kk) for kk in ks)
+    _, lse = attention._flash_fwd(q, k, v, causal=False, interpret=True)
+    scale = d ** -0.5
+    logits = jnp.einsum('bhqd,bhkd->bhqk', q, k) * scale
+    expect = jax.scipy.special.logsumexp(logits, axis=-1)[..., None]
+    assert jnp.allclose(lse, expect, atol=1e-3)
+
+
+def test_bwd_vmem_fallback_matches(monkeypatch):
+    """Beyond the VMEM cap the bwd falls back to the reference vjp."""
+    monkeypatch.setattr(attention, '_BWD_VMEM_CAP_ELEMS', 1)
+    b, h, s, d = 1, 2, 256, 64
+    ks = jax.random.split(jax.random.PRNGKey(2), 4)
+    q, k, v, g = (_rand((b, h, s, d), kk) for kk in ks)
+    _, vjp = jax.vjp(
+        lambda a, b_, c: attention._flash_attention(a, b_, c, True, True),
+        q, k, v)
+    _, vjp_ref = jax.vjp(
+        lambda a, b_, c: attention.attention_reference(a, b_, c, True),
+        q, k, v)
+    for a, b_ in zip(vjp(g), vjp_ref(g)):
+        assert jnp.allclose(a, b_, atol=1e-3)
+
+
+def test_flash_gate_falls_back_on_unaligned_seq():
+    """Sequence not divisible by 128 uses the reference path (no crash)."""
+    b, h, s, d = 1, 2, 100, 64
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q, k, v = (_rand((b, h, s, d), kk) for kk in ks)
+    out = attention.flash_attention(q, k, v, causal=True)
+    ref = attention.attention_reference(q, k, v, causal=True)
+    assert jnp.allclose(out, ref, atol=1e-5)
