@@ -26,6 +26,7 @@ setup(
     install_requires=[
         'pyyaml', 'jinja2', 'networkx', 'pandas', 'filelock', 'click',
         'requests', 'aiohttp', 'psutil', 'rich',
+        'cryptography',  # SSH keypair generation (authentication.py)
     ],
     extras_require={
         'tpu': ['jax', 'flax', 'optax', 'orbax-checkpoint', 'einops'],

@@ -112,8 +112,20 @@ def run_gang(cmds_envs_logs: List[tuple], on_spawn=None,
     if binary is not None:
         workers = []
         for argv, env, log_path, prefix in cmds_envs_logs:
-            cmd = ' '.join(shlex.quote(a) for a in argv)
-            workers.append((cmd, env or {}, log_path, prefix))
+            # The gangspec format is line-based, but user run commands are
+            # routinely multi-line (YAML `run: |`) and contract env vars can
+            # hold newlines (SKYPILOT_NODE_IPS). Indirect through a per-rank
+            # launch script: exports + exec, newline-safe, and kept next to
+            # the rank log for debuggability.
+            script = log_path + '.cmd.sh'
+            with open(script, 'w', encoding='utf-8') as sf:
+                sf.write('#!/bin/bash\n')
+                for k, v in (env or {}).items():
+                    sf.write(f'export {k}={shlex.quote(str(v))}\n')
+                sf.write('exec ' + ' '.join(shlex.quote(a) for a in argv)
+                         + '\n')
+            workers.append((f'bash {shlex.quote(script)}', {}, log_path,
+                            prefix))
         with tempfile.NamedTemporaryFile('w', suffix='.gangspec',
                                          delete=False) as f:
             spec_path = f.name

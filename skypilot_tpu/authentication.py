@@ -1,0 +1,64 @@
+"""SSH keypair management for remote clusters.
+
+Reference analog: ``sky/authentication.py`` (per-cloud keypair setup,
+``:1-60``): generate one framework-owned keypair lazily, inject the public
+key at provision time (GCP TPU VMs take it via instance metadata
+``ssh-keys``), and hand the private key path to every SSHCommandRunner.
+
+The keypair lives under the state dir so tests are hermetic
+(``SKYTPU_STATE_DIR``).
+"""
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+KEY_NAME = 'skytpu-key'
+
+
+def _ssh_dir() -> str:
+    return os.path.expanduser(
+        os.path.join(os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'),
+                     'ssh'))
+
+
+def get_or_create_ssh_keypair() -> Tuple[str, str]:
+    """Returns (private_key_path, public_key_line). Generates an ed25519
+    keypair (OpenSSH formats, pure python — no ssh-keygen binary needed) on
+    first use; idempotent afterwards."""
+    ssh_dir = _ssh_dir()
+    priv = os.path.join(ssh_dir, KEY_NAME)
+    pub = priv + '.pub'
+    if not (os.path.exists(priv) and os.path.exists(pub)):
+        from cryptography.hazmat.primitives import serialization
+        from cryptography.hazmat.primitives.asymmetric import ed25519
+
+        os.makedirs(ssh_dir, mode=0o700, exist_ok=True)
+        key = ed25519.Ed25519PrivateKey.generate()
+        priv_bytes = key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.OpenSSH,
+            serialization.NoEncryption())
+        pub_bytes = key.public_key().public_bytes(
+            serialization.Encoding.OpenSSH,
+            serialization.PublicFormat.OpenSSH)
+        fd = os.open(priv, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, 'wb') as f:
+            f.write(priv_bytes)
+        with open(pub, 'wb') as f:
+            f.write(pub_bytes + b' skypilot-tpu\n')
+    with open(pub, encoding='utf-8') as f:
+        pub_line = f.read().strip()
+    return priv, pub_line
+
+
+def ssh_keys_metadata(user: str) -> str:
+    """GCP ``ssh-keys`` metadata value granting ``user`` login with our key
+    (reference: the cloud-specific public-key injection in
+    ``sky/authentication.py``)."""
+    _, pub_line = get_or_create_ssh_keypair()
+    return f'{user}:{pub_line}'
+
+
+def default_ssh_user() -> str:
+    return os.environ.get('SKYTPU_SSH_USER', os.environ.get('USER', 'skytpu'))

@@ -141,6 +141,19 @@ class TpuGangBackend(Backend):
                 is_tpu=to_provision.tpu is not None,
                 price_per_hour=to_provision.price_per_hour)
             os.makedirs(runtime_dir(cluster_name), exist_ok=True)
+            try:
+                self._post_provision_setup(handle)
+            except (exceptions.ClusterNotUpError, subprocess.CalledProcessError,
+                    OSError) as e:
+                # Bootstrap failure is a provisioning failure: clean up and
+                # fail over like a capacity error (reference:
+                # provisioner._post_provision_setup error path).
+                failover_history.append(e)
+                global_user_state.add_cluster_event(
+                    cluster_name, 'BOOTSTRAP_FAILED', f'{region}/{zone}: {e}')
+                provision_lib.terminate_instances(to_provision.cloud,
+                                                  name_on_cloud)
+                continue
             global_user_state.add_or_update_cluster(
                 cluster_name, handle.to_dict(),
                 global_user_state.ClusterStatus.UP, is_launch=True)
@@ -149,6 +162,22 @@ class TpuGangBackend(Backend):
             self._start_cluster_daemon(cluster_name)
             return handle
         return None
+
+    @timeline.event
+    def _post_provision_setup(self, handle: ClusterHandle) -> None:
+        """Remote-node bootstrap: wait for SSH, ship the runtime, prepare
+        workers (reference: ``provision/instance_setup.py:292-490``).
+        Local/fake workers run on this host — nothing to install."""
+        if handle.cloud in ('local', 'fake'):
+            return
+        from skypilot_tpu.provision import instance_setup
+        info = self._cluster_info(handle)
+        runners = [self._runner_spec_for(handle, inst, info).make()
+                   for inst in info.all_workers_sorted()]
+        # The client-side daemon owns autostop for now (the on-cluster
+        # agent daemon lands with the gRPC agent); start_daemon=False.
+        instance_setup.bootstrap_cluster(handle.cluster_name, info, runners,
+                                         start_daemon=False)
 
     def _start_cluster_daemon(self, cluster_name: str) -> None:
         """Spawn the per-cluster autostop/heartbeat daemon (skylet analog).

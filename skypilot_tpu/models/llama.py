@@ -178,9 +178,15 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+def _use_seq_parallel(mesh) -> bool:
+    return (mesh is not None and 'seq' in mesh.shape
+            and mesh.shape['seq'] > 1)
+
+
 def _decoder_layer(cfg: LlamaConfig, x: jax.Array, layer: Params,
                    positions: jax.Array,
-                   moe_constrain=None) -> Tuple[jax.Array, jax.Array]:
+                   moe_constrain=None,
+                   mesh=None) -> Tuple[jax.Array, jax.Array]:
     """One decoder block; returns (x, moe_aux_loss)."""
     # Attention block
     h = rms_norm(x, layer['attn_norm'], cfg.norm_eps)
@@ -190,10 +196,16 @@ def _decoder_layer(cfg: LlamaConfig, x: jax.Array, layer: Params,
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
     # [B, S, H, D] -> [B, H, S, D] for attention
-    att = flash_attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-                          v.transpose(0, 2, 1, 3), causal=True)
+    qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+    if _use_seq_parallel(mesh):
+        # Sequence parallelism: S stays sharded over the `seq` mesh axis;
+        # KV shards rotate around the ring over ICI (O(S/n) memory/chip).
+        from skypilot_tpu.parallel import ring_attention as ring_lib
+        att = ring_lib.ring_attention(qt, kt, vt, mesh, causal=True)
+    else:
+        att = flash_attention(qt, kt, vt, causal=True)
     att = att.transpose(0, 2, 1, 3)
-    # Named so the remat policy can keep attention outputs (the most
+    # Named so a remat policy can keep attention outputs (the most
     # expensive recompute) while rematerializing cheap elementwise/matmul
     # activations.
     att = ad_checkpoint.checkpoint_name(att, 'attn_out')
@@ -216,13 +228,14 @@ def _decoder_layer(cfg: LlamaConfig, x: jax.Array, layer: Params,
 
 def _layer_stack(cfg: LlamaConfig, x: jax.Array, layers: Params,
                  positions: jax.Array, remat: bool,
-                 moe_constrain=None) -> Tuple[jax.Array, jax.Array]:
+                 moe_constrain=None,
+                 mesh=None) -> Tuple[jax.Array, jax.Array]:
     """Scan over (a slice of) the layer stack; returns (x, aux_sum)."""
 
     def body(carry, layer):
         x, aux = carry
         y, a = _decoder_layer(cfg, x, layer, positions,
-                              moe_constrain=moe_constrain)
+                              moe_constrain=moe_constrain, mesh=mesh)
         return (y, aux + a), None
 
     if remat:
@@ -243,6 +256,14 @@ def forward_with_aux(params: Params, tokens: jax.Array, cfg: LlamaConfig,
     b, s = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
     x = params['embed'].astype(cfg.dtype)[tokens]
+    if mesh is not None and rules is not None:
+        # Sequence parallelism: keep activations S-sharded through the whole
+        # stack (norms/projections compute on S-shards; ring attention owns
+        # the cross-shard exchange).
+        from skypilot_tpu.parallel import sharding as _sh
+        x = _sh.constrain(x, mesh, rules, ('batch', 'seqlen', None))
+        positions = _sh.constrain(positions, mesh, rules,
+                                  ('batch', 'seqlen'))
 
     moe_constrain = None
     if mesh is not None and rules is not None and cfg.num_experts > 0:
@@ -265,7 +286,7 @@ def forward_with_aux(params: Params, tokens: jax.Array, cfg: LlamaConfig,
 
         def stage_fn(layers, x_mb):
             return _layer_stack(cfg, x_mb, layers, mb_positions, remat,
-                                moe_constrain=moe_constrain)
+                                moe_constrain=moe_constrain, mesh=mesh)
 
         constrain = None
         if mesh is not None and rules is not None:
@@ -281,7 +302,7 @@ def forward_with_aux(params: Params, tokens: jax.Array, cfg: LlamaConfig,
         x = micro_out.reshape(b, s, x.shape[-1])
     else:
         x, aux = _layer_stack(cfg, x, params['layers'], positions, remat,
-                              moe_constrain=moe_constrain)
+                              moe_constrain=moe_constrain, mesh=mesh)
 
     x = rms_norm(x, params['final_norm'], cfg.norm_eps)
     logits = jnp.einsum('bsd,dv->bsv', x, params['lm_head'],

@@ -17,6 +17,7 @@ from __future__ import annotations
 import os
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu import authentication
 from skypilot_tpu import config as config_lib
 from skypilot_tpu import exceptions
 from skypilot_tpu.provision import common
@@ -83,7 +84,12 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
                 spot=bool(nc.get('use_spot', False)),
                 reserved=bool(nc.get('reserved', False)),
                 network=nc.get('network', 'default'),
-                labels={**config.tags, 'skytpu-slice': str(slice_idx)})
+                labels={**config.tags, 'skytpu-slice': str(slice_idx)},
+                # Inject the framework keypair so every worker is SSH-
+                # reachable right after READY (authentication.py; reference:
+                # sky/authentication.py per-cloud key setup).
+                metadata={'ssh-keys': authentication.ssh_keys_metadata(
+                    authentication.default_ssh_user())})
             client.wait_operation(op)
             created.append(node_id)
         except tpu_client_lib.GcpApiError as e:
@@ -207,10 +213,11 @@ def get_cluster_info(region: str, cluster_name_on_cloud: str,
                 external_ip=access.get('externalIp') or ep.get('ipAddress'),
                 status='running'))
     head = f'{cluster_name_on_cloud}-0-w0'
+    key_path, _ = authentication.get_or_create_ssh_keypair()
     return common.ClusterInfo(
         instances=instances,
         head_instance_id=head if any(
             i.instance_id == head for i in instances) else None,
         provider_name='gcp', region=region, zone=zone,
-        ssh_user=os.environ.get('USER', 'skytpu'),
-        ssh_key_path='~/.ssh/skytpu-key')
+        ssh_user=authentication.default_ssh_user(),
+        ssh_key_path=key_path)

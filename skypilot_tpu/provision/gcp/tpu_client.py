@@ -110,12 +110,18 @@ class TpuClient:
                     topology: Optional[str] = None,
                     spot: bool = False, reserved: bool = False,
                     network: str = 'default',
-                    labels: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+                    labels: Optional[Dict[str, str]] = None,
+                    metadata: Optional[Dict[str, str]] = None
+                    ) -> Dict[str, Any]:
         body: Dict[str, Any] = {
             'runtimeVersion': runtime_version,
             'networkConfig': {'network': network, 'enableExternalIps': True},
             'labels': labels or {},
         }
+        if metadata:
+            # ``ssh-keys`` here is how the framework's public key reaches
+            # every worker of the slice (authentication.py).
+            body['metadata'] = dict(metadata)
         # v4+ slices take acceleratorConfig{type, topology}; older
         # generations take the flat acceleratorType string
         # (reference: instance_utils.py create body construction).

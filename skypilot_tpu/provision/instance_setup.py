@@ -1,0 +1,109 @@
+"""Post-provision node bootstrap: wait for SSH, install the runtime, start
+the cluster daemon.
+
+Reference analog: ``sky/provision/instance_setup.py`` (``:292-490`` — runtime
+install over parallel SSH, head/worker daemon start) and
+``sky/backends/wheel_utils.py`` (the client's own code is shipped to the
+cluster so remote runtime == client version). TPU-native differences: no Ray
+to start and no wheel build — the pure-python package tree is rsynced as-is
+and run with the system python3 (TPU VM images ship one); the gang substrate
+is the C++ ``gangd`` / python driver, which runs from that tree.
+"""
+from __future__ import annotations
+
+import concurrent.futures as cf
+import os
+import shlex
+import time
+from typing import List, Sequence
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.utils.command_runner import CommandRunner
+
+# Where the framework lives on every worker (HOME-relative).
+REMOTE_RUNTIME_DIR = '~/.skytpu/runtime'
+REMOTE_WORKDIR = '~/sky_workdir'
+
+
+def _package_root() -> str:
+    """Directory containing the ``skypilot_tpu`` package (synced to nodes)."""
+    import skypilot_tpu
+    return os.path.dirname(os.path.dirname(
+        os.path.abspath(skypilot_tpu.__file__)))
+
+
+def wait_for_ssh(runners: Sequence[CommandRunner], timeout: float = 300.0,
+                 poll: float = 5.0) -> None:
+    """Block until every worker answers a trivial command (reference:
+    ``provisioner.wait_for_ssh :387``). Parallel across workers."""
+    deadline = time.time() + timeout
+
+    def _wait_one(runner: CommandRunner) -> None:
+        while True:
+            if runner.run('true') == 0:
+                return
+            if time.time() > deadline:
+                raise exceptions.ClusterNotUpError(
+                    f'Worker {getattr(runner, "ip", "?")} unreachable over '
+                    f'SSH after {timeout:.0f}s')
+            time.sleep(poll)
+
+    with cf.ThreadPoolExecutor(max_workers=min(32, len(runners))) as pool:
+        list(pool.map(_wait_one, runners))
+
+
+def install_runtime(runners: Sequence[CommandRunner],
+                    python: str = 'python3') -> None:
+    """Ship the framework to every worker and verify the worker's python can
+    import it (the wheel-upload analog, ``wheel_utils.py:1-60``).
+
+    ``python`` is the interpreter on the WORKER (TPU VM images ship the ML
+    stack on the system python3); tests point it at their own venv."""
+    src = os.path.join(_package_root(), 'skypilot_tpu')
+
+    def _install_one(runner: CommandRunner) -> None:
+        runner.run(f'mkdir -p {REMOTE_RUNTIME_DIR} {REMOTE_WORKDIR}')
+        runner.rsync(src, f'{REMOTE_RUNTIME_DIR}/skypilot_tpu', up=True)
+        rc = runner.run(
+            f'PYTHONPATH={REMOTE_RUNTIME_DIR} {shlex.quote(python)} -c '
+            + shlex.quote('import skypilot_tpu.agent.job_lib'))
+        if rc != 0:
+            raise exceptions.ClusterNotUpError(
+                f'Runtime install failed on {getattr(runner, "ip", "?")}: '
+                f'{python} cannot import the synced skypilot_tpu package')
+
+    with cf.ThreadPoolExecutor(max_workers=min(32, len(runners))) as pool:
+        list(pool.map(_install_one, runners))
+
+
+def start_agent_on_head(head_runner: CommandRunner, cluster_name: str) -> None:
+    """Start the on-cluster daemon (skylet analog) detached on the head
+    (reference: ``start_skylet_on_head_node :490``). Idempotent: a second
+    start finds the pidfile's process alive and exits."""
+    pidfile = f'{REMOTE_RUNTIME_DIR}/daemon-{cluster_name}.pid'
+    cmd = (
+        f'if [ -f {pidfile} ] && kill -0 $(cat {pidfile}) 2>/dev/null; then '
+        f'true; else '
+        f'PYTHONPATH={REMOTE_RUNTIME_DIR} nohup python3 -m '
+        f'skypilot_tpu.agent.daemon --cluster-name {shlex.quote(cluster_name)}'
+        f' >/dev/null 2>&1 & echo $! > {pidfile}; fi')
+    rc = head_runner.run(cmd)
+    if rc != 0:
+        raise exceptions.ClusterNotUpError(
+            f'Starting the cluster daemon on the head failed (rc={rc})')
+
+
+def bootstrap_cluster(cluster_name: str, info: common.ClusterInfo,
+                      runners: Sequence[CommandRunner],
+                      ssh_timeout: float = 300.0,
+                      start_daemon: bool = True,
+                      python: str = 'python3') -> None:
+    """Full post-provision setup for a freshly created cluster: SSH
+    reachability -> runtime install on every worker -> head daemon."""
+    if not runners:
+        return
+    wait_for_ssh(runners, timeout=ssh_timeout)
+    install_runtime(runners, python=python)
+    if start_daemon:
+        start_agent_on_head(runners[0], cluster_name)

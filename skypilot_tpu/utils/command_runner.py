@@ -11,10 +11,13 @@ from __future__ import annotations
 import dataclasses
 import os
 import shlex
+import shutil
 import subprocess
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.agent import log_lib
+
+_HAVE_RSYNC = shutil.which('rsync') is not None
 
 SSH_OPTIONS = [
     '-o', 'StrictHostKeyChecking=no',
@@ -74,9 +77,13 @@ class CommandRunner:
 
 
 def _env_prefix(env: Optional[Dict[str, str]]) -> str:
+    # `export` (not bare prefix assignments) so the vars are visible both to
+    # child processes AND to shell expansions in the user command itself
+    # (`echo $SKYPILOT_NODE_RANK` must work over SSH).
     if not env:
         return ''
-    return ' '.join(f'{k}={shlex.quote(v)}' for k, v in env.items()) + ' '
+    return ''.join(f'export {k}={shlex.quote(str(v))}; '
+                   for k, v in env.items())
 
 
 class LocalProcessCommandRunner(CommandRunner):
@@ -103,12 +110,23 @@ class LocalProcessCommandRunner(CommandRunner):
                                     prefix=prefix)
 
     def rsync(self, src: str, dst: str, up: bool = True) -> None:
+        # Same machine either way; up=False means "pull dst into src"
+        # (mirrors SSHCommandRunner's direction semantics).
+        if not up:
+            src, dst = dst, src
         src, dst = os.path.expanduser(src), os.path.expanduser(dst)
         os.makedirs(os.path.dirname(dst.rstrip('/')) or '/', exist_ok=True)
-        subprocess.run(
-            ['rsync', '-a', '--delete',
-             src.rstrip('/') + '/', dst.rstrip('/') + '/'],
-            check=True)
+        if _HAVE_RSYNC:
+            subprocess.run(
+                ['rsync', '-a', '--delete',
+                 src.rstrip('/') + '/', dst.rstrip('/') + '/'],
+                check=True)
+            return
+        # Mirror semantics without the rsync binary (delete-then-copy).
+        dst = dst.rstrip('/')
+        if os.path.exists(dst):
+            shutil.rmtree(dst)
+        shutil.copytree(src.rstrip('/'), dst, symlinks=True)
 
 
 class SSHCommandRunner(CommandRunner):
@@ -145,8 +163,45 @@ class SSHCommandRunner(CommandRunner):
                                     prefix=prefix)
 
     def rsync(self, src: str, dst: str, up: bool = True) -> None:
-        ssh_cmd = ' '.join(self._ssh_base()[:-1])  # without host
-        remote = f'{self.user}@{self.ip}:{dst}'
-        pair = [src.rstrip('/') + '/', remote] if up else [remote, src]
-        subprocess.run(['rsync', '-a', '--delete', '-e', ssh_cmd] + pair,
-                       check=True)
+        if _HAVE_RSYNC:
+            ssh_cmd = ' '.join(self._ssh_base()[:-1])  # without host
+            remote = f'{self.user}@{self.ip}:{dst}'
+            pair = [src.rstrip('/') + '/', remote] if up else [remote, src]
+            subprocess.run(['rsync', '-a', '--delete', '-e', ssh_cmd] + pair,
+                           check=True)
+            return
+        self._tar_sync(src, dst, up)
+
+    def _tar_sync(self, src: str, dst: str, up: bool) -> None:
+        """rsync fallback: stream a tar archive through the SSH channel
+        (mirror semantics: the destination dir is replaced)."""
+        if up:
+            src = os.path.expanduser(src).rstrip('/')
+            remote_cmd = (f'rm -rf {dst} && mkdir -p {dst} && '
+                          f'tar -xf - -C {dst}')
+            ssh_argv = self._ssh_base() + ['bash', '-c',
+                                           shlex.quote(remote_cmd)]
+            tar = subprocess.Popen(['tar', '-cf', '-', '-C', src, '.'],
+                                   stdout=subprocess.PIPE)
+            ssh = subprocess.Popen(ssh_argv, stdin=tar.stdout)
+            tar.stdout.close()
+            ssh.wait()
+            tar.wait()
+            if tar.returncode or ssh.returncode:
+                raise subprocess.CalledProcessError(
+                    ssh.returncode or tar.returncode, ssh_argv)
+        else:
+            local = os.path.expanduser(src).rstrip('/')
+            os.makedirs(local, exist_ok=True)
+            remote_cmd = f'tar -cf - -C {dst.rstrip("/")} .'
+            ssh_argv = self._ssh_base() + ['bash', '-c',
+                                           shlex.quote(remote_cmd)]
+            ssh = subprocess.Popen(ssh_argv, stdout=subprocess.PIPE)
+            tar = subprocess.Popen(['tar', '-xf', '-', '-C', local],
+                                   stdin=ssh.stdout)
+            ssh.stdout.close()
+            tar.wait()
+            ssh.wait()
+            if tar.returncode or ssh.returncode:
+                raise subprocess.CalledProcessError(
+                    ssh.returncode or tar.returncode, ssh_argv)

@@ -75,3 +75,18 @@ def test_python_fallback_parity(tmp_path, monkeypatch):
     ]))
     assert rc == 3
     assert 'py-one' in (tmp_path / 'r0.log').read_text()
+
+
+def test_gang_multiline_cmd_and_newline_env(tmp_path):
+    """Multi-line run commands (YAML `run: |`) and newline-valued env vars
+    (SKYPILOT_NODE_IPS) must survive the native gangspec (which is
+    line-based: both are routed through a per-rank launch script)."""
+    from skypilot_tpu.agent import log_lib
+    log = tmp_path / 'r0.log'
+    argv = ['bash', '-c', 'echo line-one\necho ips="$IPS"\n']
+    rc = log_lib.run_gang([(argv, {'IPS': '10.0.0.1\n10.0.0.2'}, str(log),
+                           '')])
+    assert rc == 0
+    content = log.read_text()
+    assert 'line-one' in content
+    assert 'ips=10.0.0.1' in content and '10.0.0.2' in content
