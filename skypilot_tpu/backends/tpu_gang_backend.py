@@ -210,6 +210,11 @@ class TpuGangBackend(Backend):
                          info: provision_common.ClusterInfo) -> RunnerSpec:
         if handle.cloud in ('local', 'fake'):
             return RunnerSpec(kind='local', ip=inst.internal_ip)
+        if handle.cloud == 'gke':
+            # Workers are pods; the "address" is the pod name.
+            return RunnerSpec(
+                kind='k8s', ip=inst.instance_id,
+                namespace=os.environ.get('SKYTPU_GKE_NAMESPACE', 'default'))
         return RunnerSpec(kind='ssh', ip=inst.external_ip or inst.internal_ip,
                           user=info.ssh_user, ssh_key=info.ssh_key_path)
 
@@ -283,6 +288,20 @@ class TpuGangBackend(Backend):
             else:
                 if info is None:
                     info = self._cluster_info(handle)
+                if st.mode == storage_lib.StorageMode.COPY:
+                    # COPY on remote workers: pull once onto the submitting
+                    # host, rsync-fan-out — workers need no object-store
+                    # credentials (reference: COPY-mode sync,
+                    # sky/data/storage.py:306).
+                    import tempfile
+                    with tempfile.TemporaryDirectory(
+                            prefix='skytpu-copy-') as cache:
+                        st.store().download(cache)
+                        for inst in info.all_workers_sorted():
+                            self._runner_spec_for(
+                                handle, inst, info).make().rsync(
+                                    cache, dst, up=True)
+                    continue
                 cmd = st.mount_command(dst)
                 for inst in info.all_workers_sorted():
                     runner = self._runner_spec_for(handle, inst, info).make()

@@ -2,7 +2,8 @@
 from skypilot_tpu.clouds.cloud import Cloud, CloudImplementationFeatures, Region
 from skypilot_tpu.clouds.fake import Fake
 from skypilot_tpu.clouds.gcp import GCP
+from skypilot_tpu.clouds.gke import GKE
 from skypilot_tpu.clouds.local import Local
 
-__all__ = ['Cloud', 'CloudImplementationFeatures', 'Region', 'GCP', 'Local',
-           'Fake']
+__all__ = ['Cloud', 'CloudImplementationFeatures', 'Region', 'GCP', 'GKE',
+           'Local', 'Fake']

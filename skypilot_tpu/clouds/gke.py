@@ -1,0 +1,114 @@
+"""GKE cloud: TPU slices as Kubernetes node pools.
+
+Reference analog: ``sky/clouds/kubernetes.py`` + the GKE TPU logic in
+``sky/provision/kubernetes/utils.py`` (``is_tpu_on_gke :3363``,
+``reduce_tpu_topology``/``is_multi_host_tpu`` ``:3398-3420``). TPU-native
+framing: the same topology-typed TpuSlice resolves to a GKE node pool
+selector pair (accelerator, topology) instead of a TPU VM create call.
+Pricing reuses the GCP TPU catalog (the node pools are the same hardware in
+the same regions).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.catalog import gcp_catalog
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.provision.gke.instance import GKE_TPU_ACCELERATOR
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+Features = cloud_lib.CloudImplementationFeatures
+
+
+@CLOUD_REGISTRY.register
+class GKE(cloud_lib.Cloud):
+
+    _REPR = 'gke'
+
+    @classmethod
+    def supported_features(cls) -> set:
+        # Pods cannot STOP/AUTOSTOP; ports become Services (TBD).
+        return {
+            Features.MULTI_NODE, Features.SPOT_INSTANCE, Features.TPU_SLICE,
+            Features.MULTISLICE, Features.STORAGE_MOUNTING,
+        }
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        path = os.environ.get('KUBECONFIG',
+                              os.path.expanduser('~/.kube/config'))
+        if os.path.exists(os.path.expanduser(path)):
+            return True, None
+        return False, ('No kubeconfig found. Run `gcloud container clusters '
+                       'get-credentials <cluster>` or set KUBECONFIG.')
+
+    def regions(self) -> List[cloud_lib.Region]:
+        df = gcp_catalog.list_accelerators()
+        names = sorted({row['Region'] for _, row in df.iterrows()})
+        return [cloud_lib.Region(name=r) for r in names]
+
+    def zones_for(self, resources: Resources) -> Iterator[Tuple[str, str]]:
+        # One logical "zone" per region: scheduling granularity is the
+        # node pool, and the kube-scheduler owns in-cluster placement.
+        assert resources.tpu is not None
+        rows = gcp_catalog.get_tpu_offerings(
+            resources.tpu.name, region=resources.region,
+            zone=resources.zone, use_spot=resources.use_spot)
+        seen = set()
+        for row in rows:
+            if row['Region'] in seen:
+                continue
+            seen.add(row['Region'])
+            yield row['Region'], row['AvailabilityZone']
+
+    def get_feasible_launchable_resources(
+            self, resources: Resources) -> List[Resources]:
+        if resources.cloud is not None and resources.cloud != self._REPR:
+            return []
+        if resources.tpu is None:
+            return []  # GKE here schedules TPU node pools only
+        if resources.tpu.generation not in GKE_TPU_ACCELERATOR:
+            return []
+        rows = gcp_catalog.get_tpu_offerings(
+            resources.tpu.name, region=resources.region,
+            zone=resources.zone, use_spot=resources.use_spot)
+        out: List[Resources] = []
+        seen_regions = set()
+        for row in rows:
+            if row['Region'] in seen_regions:
+                continue
+            seen_regions.add(row['Region'])
+            price = row['SpotPrice' if resources.use_spot else 'Price']
+            out.append(resources.copy(
+                cloud=self._REPR, region=row['Region'],
+                _price_per_hour=float(price)))
+        return out
+
+    def make_deploy_variables(self, resources: Resources,
+                              cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str],
+                              num_nodes: int) -> Dict[str, Any]:
+        sl = resources.tpu
+        assert sl is not None
+        return {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': zone,
+            'tpu_vm': True,
+            'tpu_generation': sl.generation,
+            'gke_accelerator': GKE_TPU_ACCELERATOR[sl.generation],
+            'topology': sl.topology_str,
+            'hosts_per_slice': sl.hosts,
+            'chips_per_host': sl.chips_per_host,
+            'use_spot': resources.use_spot,
+            'image_id': resources.image_id,
+            'namespace': os.environ.get('SKYTPU_GKE_NAMESPACE', 'default'),
+            'num_nodes': num_nodes,
+            'labels': resources.labels,
+        }
+
+    @property
+    def provisioner_module(self) -> str:
+        return 'skypilot_tpu.provision.gke'

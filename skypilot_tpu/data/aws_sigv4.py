@@ -1,0 +1,86 @@
+"""AWS Signature Version 4 signing (dependency-free).
+
+Reference analog: the reference's S3 path (``sky/data/storage.py:4502``)
+rides boto3, which is not in this image; SigV4 is ~60 lines of hmac/sha256
+and also unlocks every S3-compatible endpoint (R2, MinIO, GCS-interop) with
+one code path. Verified against the published AWS signature test vector
+(``get-vanilla`` / the IAM ListUsers example from the SigV4 docs).
+"""
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+from typing import Dict, Mapping, Optional, Tuple
+from urllib.parse import quote
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode('utf-8'), hashlib.sha256).digest()
+
+
+def _canonical_query(params: Mapping[str, str]) -> str:
+    pairs = sorted((quote(str(k), safe='-_.~'), quote(str(v), safe='-_.~'))
+                   for k, v in params.items())
+    return '&'.join(f'{k}={v}' for k, v in pairs)
+
+
+def sign_request(method: str, host: str, path: str,
+                 params: Mapping[str, str],
+                 headers: Dict[str, str],
+                 payload: bytes,
+                 access_key: str, secret_key: str,
+                 region: str, service: str = 's3',
+                 now: Optional[datetime.datetime] = None,
+                 sign_payload_header: bool = True) -> Dict[str, str]:
+    """Returns ``headers`` augmented with Authorization + x-amz-* headers.
+
+    ``sign_payload_header``: S3 requires ``x-amz-content-sha256``; other
+    services (and the published doc test vector) omit it."""
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime('%Y%m%dT%H%M%SZ')
+    datestamp = now.strftime('%Y%m%d')
+    payload_hash = _sha256(payload)
+
+    all_headers = dict(headers)
+    all_headers['host'] = host
+    all_headers['x-amz-date'] = amz_date
+    if sign_payload_header:
+        all_headers['x-amz-content-sha256'] = payload_hash
+
+    signed_names = sorted(k.lower() for k in all_headers)
+    canonical_headers = ''.join(
+        f'{k}:{str(all_headers[next(h for h in all_headers if h.lower() == k)]).strip()}\n'
+        for k in signed_names)
+    signed_headers = ';'.join(signed_names)
+
+    canonical_request = '\n'.join([
+        method.upper(),
+        quote(path, safe='/-_.~'),
+        _canonical_query(params),
+        canonical_headers,
+        signed_headers,
+        payload_hash,
+    ])
+
+    scope = f'{datestamp}/{region}/{service}/aws4_request'
+    string_to_sign = '\n'.join([
+        'AWS4-HMAC-SHA256', amz_date, scope,
+        _sha256(canonical_request.encode('utf-8')),
+    ])
+
+    k_date = _hmac(('AWS4' + secret_key).encode('utf-8'), datestamp)
+    k_region = _hmac(k_date, region)
+    k_service = _hmac(k_region, service)
+    k_signing = _hmac(k_service, 'aws4_request')
+    signature = hmac.new(k_signing, string_to_sign.encode('utf-8'),
+                         hashlib.sha256).hexdigest()
+
+    all_headers['Authorization'] = (
+        f'AWS4-HMAC-SHA256 Credential={access_key}/{scope}, '
+        f'SignedHeaders={signed_headers}, Signature={signature}')
+    return all_headers

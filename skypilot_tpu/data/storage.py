@@ -27,6 +27,15 @@ from typing import Dict, List, Optional, Tuple
 from skypilot_tpu import exceptions
 
 
+def _boundary_filter(names: List[str], src_rel: str) -> List[str]:
+    """Prefix listing matches 'ckpt-10/x' for src_rel='ckpt-1'; keep only
+    the object itself or true children ('ckpt-1/...')."""
+    if not src_rel:
+        return names
+    base = src_rel.rstrip('/')
+    return [n for n in names if n == base or n.startswith(base + '/')]
+
+
 class StorageMode(enum.Enum):
     MOUNT = 'MOUNT'
     COPY = 'COPY'
@@ -156,30 +165,80 @@ class GcsStore(AbstractStore):
         return key.strip('/')
 
     def list_objects(self, rel: str = '') -> List[str]:
-        out = self.transport.request(
-            'GET', f'{self.API}/b/{self.bucket}/o',
-            params={'prefix': self._obj(rel)})
-        items = out.get('items', [])
-        names = [i['name'] for i in items]
+        names: List[str] = []
+        page_token: Optional[str] = None
+        while True:  # GCS pages at 1000 objects
+            params = {'prefix': self._obj(rel)}
+            if page_token:
+                params['pageToken'] = page_token
+            out = self.transport.request(
+                'GET', f'{self.API}/b/{self.bucket}/o', params=params)
+            names.extend(i['name'] for i in out.get('items', []))
+            page_token = out.get('nextPageToken')
+            if not page_token:
+                break
         if self.prefix:
             names = [n[len(self.prefix) + 1:] for n in names
                      if n.startswith(self.prefix + '/')]
         return names
 
+    def _quote(self, name: str) -> str:
+        from urllib.parse import quote
+        return quote(name, safe='')
+
     def upload(self, local_path: str, dest_rel: str = '') -> None:
-        raise exceptions.NotSupportedError(
-            'GcsStore.upload from this host requires gsutil/gcloud; on '
-            'cluster workers data lands via gcsfuse mounts.')
+        """Upload a file or directory via the JSON media API
+        (reference parity: ``sky/data/storage.py:2149`` GcsStore transfer,
+        minus the gsutil dependency)."""
+        local_path = os.path.expanduser(local_path)
+        if os.path.isdir(local_path):
+            for dirpath, _, files in os.walk(local_path):
+                for f in files:
+                    full = os.path.join(dirpath, f)
+                    rel = os.path.relpath(full, local_path)
+                    obj_rel = os.path.join(dest_rel, rel) if dest_rel else rel
+                    self._upload_file(full, obj_rel)
+        else:
+            dest = dest_rel or os.path.basename(local_path)
+            self._upload_file(local_path, dest)
+
+    def _upload_file(self, path: str, obj_rel: str) -> None:
+        with open(path, 'rb') as f:
+            data = f.read()
+        self.transport.upload_media(
+            f'{self.UPLOAD_API}/b/{self.bucket}/o', data,
+            params={'uploadType': 'media', 'name': self._obj(obj_rel)})
 
     def download(self, local_path: str, src_rel: str = '') -> None:
-        raise exceptions.NotSupportedError(
-            'GcsStore.download from this host requires gsutil/gcloud.')
+        """Download an object (or all objects under a prefix) to a local
+        path via ``alt=media``."""
+        local_path = os.path.expanduser(local_path)
+        names = _boundary_filter(self.list_objects(src_rel), src_rel)
+        if not names:
+            raise exceptions.StorageBucketGetError(f'{self.url}/{src_rel}')
+        single = len(names) == 1 and names[0] == (src_rel or names[0])
+        for name in names:
+            data = self.transport.download_media(
+                f'{self.API}/b/{self.bucket}/o/'
+                f'{self._quote(self._obj(name))}',
+                params={'alt': 'media'})
+            if single and name == src_rel:
+                dst = local_path
+            else:
+                rel = name[len(src_rel):].lstrip('/') if src_rel else name
+                dst = os.path.join(local_path, rel)
+            os.makedirs(os.path.dirname(dst) or '.', exist_ok=True)
+            with open(dst, 'wb') as f:
+                f.write(data)
 
     def delete(self) -> None:
         for name in self.list_objects():
+            # list_objects returns prefix-relative names; the API wants the
+            # full object key.
             self.transport.request(
                 'DELETE',
-                f'{self.API}/b/{self.bucket}/o/{name.replace("/", "%2F")}')
+                f'{self.API}/b/{self.bucket}/o/'
+                f'{self._quote(self._obj(name))}')
 
     def mount_command(self, mount_path: str) -> str:
         from skypilot_tpu.data import mounting_utils
@@ -187,7 +246,154 @@ class GcsStore(AbstractStore):
             self.bucket, mount_path, only_dir=self.prefix or None)
 
 
-_SCHEMES = {'gs': GcsStore, 'file': LocalStore}
+class S3Store(AbstractStore):
+    """S3 and S3-compatible stores (R2, MinIO) via SigV4-signed REST
+    (reference parity: ``sky/data/storage.py:4502`` S3Store + the
+    S3-compatible registry at ``:128``, without the boto3 dependency).
+
+    Credentials: ``AWS_ACCESS_KEY_ID`` / ``AWS_SECRET_ACCESS_KEY`` /
+    ``AWS_DEFAULT_REGION``; ``AWS_ENDPOINT_URL`` switches to a compatible
+    endpoint (path-style addressing).
+    """
+
+    scheme = 's3'
+
+    def __init__(self, bucket: str, prefix: str = '', http=None):
+        super().__init__(bucket, prefix)
+        self._http = http or self._requests_http
+        self.region = os.environ.get('AWS_DEFAULT_REGION', 'us-east-1')
+        endpoint = os.environ.get('AWS_ENDPOINT_URL')
+        if endpoint:
+            self.host = endpoint.split('://', 1)[-1].rstrip('/')
+            self.base_path = f'/{bucket}'
+        else:
+            self.host = f'{bucket}.s3.{self.region}.amazonaws.com'
+            self.base_path = ''
+
+    @staticmethod
+    def _requests_http(method, url, headers, data):
+        import requests
+        resp = requests.request(method, url, headers=headers, data=data,
+                                timeout=300)
+        return resp.status_code, resp.content
+
+    def _creds(self) -> Tuple[str, str]:
+        ak = os.environ.get('AWS_ACCESS_KEY_ID')
+        sk = os.environ.get('AWS_SECRET_ACCESS_KEY')
+        if not ak or not sk:
+            raise exceptions.NoCloudAccessError(
+                'S3 credentials not set (AWS_ACCESS_KEY_ID / '
+                'AWS_SECRET_ACCESS_KEY).')
+        return ak, sk
+
+    def _request(self, method: str, key: str = '',
+                 params: Optional[Dict[str, str]] = None,
+                 data: bytes = b'',
+                 allow_404: bool = False) -> Tuple[int, bytes]:
+        from urllib.parse import quote
+
+        from skypilot_tpu.data import aws_sigv4
+        ak, sk = self._creds()
+        path = self.base_path + ('/' + key if key else '/')
+        params = params or {}
+        headers = aws_sigv4.sign_request(
+            method, self.host, path, params, {}, data, ak, sk, self.region)
+        qs = '&'.join(f'{quote(str(k), safe="-_.~")}='
+                      f'{quote(str(v), safe="-_.~")}'
+                      for k, v in sorted(params.items()))
+        url = (f'https://{self.host}{quote(path, safe="/-_.~")}'
+               + (f'?{qs}' if qs else ''))
+        status, content = self._http(method, url, headers, data)
+        if status >= 400 and not (allow_404 and status == 404):
+            # A PUT hitting 404 (NoSuchBucket) must NOT look like success —
+            # a silently dropped upload is lost checkpoint data.
+            raise exceptions.StorageError(
+                f'S3 {method} {path}: HTTP {status}: {content[:300]!r}')
+        return status, content
+
+    def exists(self) -> bool:
+        status, _ = self._request('GET', params={'list-type': '2',
+                                                 'max-keys': '1'},
+                                  allow_404=True)
+        return status < 400
+
+    def list_objects(self, rel: str = '') -> List[str]:
+        import xml.etree.ElementTree as ET
+        names: List[str] = []
+        token: Optional[str] = None
+        while True:
+            params = {'list-type': '2', 'prefix': self._obj(rel)}
+            if token:
+                params['continuation-token'] = token
+            status, content = self._request('GET', params=params,
+                                            allow_404=True)
+            if status == 404:
+                return []
+            root = ET.fromstring(content)
+            ns = root.tag.split('}')[0] + '}' if '}' in root.tag else ''
+            for c in root.findall(f'{ns}Contents'):
+                names.append(c.find(f'{ns}Key').text)
+            trunc = root.find(f'{ns}IsTruncated')
+            if trunc is None or trunc.text != 'true':
+                break
+            token = root.find(f'{ns}NextContinuationToken').text
+        if self.prefix:
+            names = [n[len(self.prefix) + 1:] for n in names
+                     if n.startswith(self.prefix + '/')]
+        return sorted(names)
+
+    def _obj(self, rel: str) -> str:
+        key = f'{self.prefix}/{rel}' if self.prefix else rel
+        return key.strip('/')
+
+    def upload(self, local_path: str, dest_rel: str = '') -> None:
+        local_path = os.path.expanduser(local_path)
+        if os.path.isdir(local_path):
+            for dirpath, _, files in os.walk(local_path):
+                for f in files:
+                    full = os.path.join(dirpath, f)
+                    rel = os.path.relpath(full, local_path)
+                    obj = os.path.join(dest_rel, rel) if dest_rel else rel
+                    with open(full, 'rb') as fh:
+                        self._request('PUT', self._obj(obj), data=fh.read())
+        else:
+            dest = dest_rel or os.path.basename(local_path)
+            with open(local_path, 'rb') as fh:
+                self._request('PUT', self._obj(dest), data=fh.read())
+
+    def download(self, local_path: str, src_rel: str = '') -> None:
+        local_path = os.path.expanduser(local_path)
+        names = _boundary_filter(self.list_objects(src_rel), src_rel)
+        if not names:
+            raise exceptions.StorageBucketGetError(f'{self.url}/{src_rel}')
+        single = len(names) == 1 and names[0] == (src_rel or names[0])
+        for name in names:
+            status, data = self._request('GET', self._obj(name),
+                                         allow_404=True)
+            if status == 404:
+                raise exceptions.StorageBucketGetError(f'{self.url}/{name}')
+            if single and name == src_rel:
+                dst = local_path
+            else:
+                rel = name[len(src_rel):].lstrip('/') if src_rel else name
+                dst = os.path.join(local_path, rel)
+            os.makedirs(os.path.dirname(dst) or '.', exist_ok=True)
+            with open(dst, 'wb') as f:
+                f.write(data)
+
+    def delete(self) -> None:
+        for name in self.list_objects():
+            self._request('DELETE', self._obj(name))
+
+    def mount_command(self, mount_path: str) -> str:
+        from skypilot_tpu.data import mounting_utils
+        bucket_path = (f'{self.bucket}/{self.prefix}' if self.prefix
+                       else self.bucket)
+        return mounting_utils.rclone_mount_command('s3', bucket_path,
+                                                   mount_path)
+
+
+_SCHEMES = {'gs': GcsStore, 'file': LocalStore, 's3': S3Store, 'r2': S3Store}
 
 
 def parse_source(source: str) -> Tuple[str, str, str]:

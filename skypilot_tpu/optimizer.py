@@ -65,11 +65,56 @@ def _egress_cost(src: Resources, dst: Resources, gigabytes: float) -> float:
     return gigabytes * _EGRESS_COST_PER_GB
 
 
-def _estimated_runtime_hours(task: Task) -> float:
-    """Without a runtime estimator, rank by hourly cost (1h normalization).
-    A per-task `estimated_runtime` attr (seconds) overrides."""
+# Normalization point for the default TPU runtime model: a task's
+# `estimated_runtime` is interpreted as its duration at this aggregate
+# throughput; bigger/faster slices shrink it proportionally (perfect-scaling
+# assumption, reference: the optimizer's time_estimator_fn contract,
+# ``sky/optimizer.py`` run-time estimation).
+_REFERENCE_AGG_TFLOPS = 100.0
+# Cross-region/cloud transfer speed for TIME-target egress (10 Gbps).
+_EGRESS_GBPS = 10.0 / 8.0
+
+
+def _estimated_runtime_hours(task: Task,
+                             resources: Optional[Resources] = None,
+                             scale_default: bool = False) -> float:
+    """Candidate-dependent runtime estimate.
+
+    Order of preference: a task-attached ``time_estimator_fn(resources) ->
+    seconds``; else ``estimated_runtime`` (seconds) scaled by the candidate
+    slice's aggregate bf16 TFLOPs (TPU candidates — perfect-scaling
+    assumption); else 1h. The 1h default is scaled by hardware speed only
+    when ``scale_default`` (TIME target) — COST with no runtime estimate
+    stays a pure hourly-price ranking."""
+    fn = getattr(task, 'time_estimator_fn', None)
+    if fn is not None and resources is not None:
+        return max(float(fn(resources)) / 3600.0, 0.0)
     runtime_s = getattr(task, 'estimated_runtime', None)
-    return (runtime_s / 3600.0) if runtime_s else 1.0
+    base = (runtime_s / 3600.0) if runtime_s else 1.0
+    if runtime_s is None and not scale_default:
+        return base
+    if resources is not None and resources.tpu is not None:
+        speed = resources.tpu.total_bf16_tflops / _REFERENCE_AGG_TFLOPS
+        return base / max(speed, 1e-6)
+    return base
+
+
+def _run_metric(task: Task, cand: Resources,
+                minimize: 'OptimizeTarget') -> float:
+    """The per-candidate objective term: $ for COST, hours for TIME."""
+    if minimize == OptimizeTarget.TIME:
+        return _estimated_runtime_hours(task, cand, scale_default=True)
+    return cand.price_per_hour * _estimated_runtime_hours(task, cand)
+
+
+def _egress_metric(src: Resources, dst: Resources, gigabytes: float,
+                   minimize: 'OptimizeTarget') -> float:
+    if minimize == OptimizeTarget.TIME:
+        if gigabytes <= 0 or (src.cloud == dst.cloud
+                              and src.region == dst.region):
+            return 0.0
+        return gigabytes / _EGRESS_GBPS / 3600.0  # hours
+    return _egress_cost(src, dst, gigabytes)
 
 
 @timeline.event
@@ -104,9 +149,9 @@ def optimize(dag_or_task,
 
     order = dag.topological_order()
     if dag.is_chain():
-        choice = _optimize_chain_dp(dag, order, per_task)
+        choice = _optimize_chain_dp(dag, order, per_task, minimize)
     else:
-        choice = _optimize_enumerate(dag, order, per_task)
+        choice = _optimize_enumerate(dag, order, per_task, minimize)
 
     for task, res in choice.items():
         task.best_resources = res
@@ -125,18 +170,19 @@ def _transfer_gb(task: Task) -> float:
 
 def _optimize_chain_dp(
         dag: Dag, order: List[Task],
-        per_task: Dict[Task, List[Resources]]) -> Dict[Task, Resources]:
+        per_task: Dict[Task, List[Resources]],
+        minimize: OptimizeTarget = OptimizeTarget.COST
+) -> Dict[Task, Resources]:
     """DP over the chain (reference: ``_optimize_by_dp``, ``optimizer.py:429``):
-    state = (task index, candidate), transition cost = run cost + egress."""
+    state = (task index, candidate), transition = run metric + egress metric
+    ($ for COST, hours for TIME)."""
     INF = float('inf')
-    # dp[i][j] = min total cost ending with task i on candidate j
+    # dp[i][j] = min total metric ending with task i on candidate j
     dp: List[List[float]] = []
     parent: List[List[int]] = []
     for i, task in enumerate(order):
         cands = per_task[task]
-        run_cost = [
-            c.price_per_hour * _estimated_runtime_hours(task) for c in cands
-        ]
+        run_cost = [_run_metric(task, c, minimize) for c in cands]
         row = [INF] * len(cands)
         par = [-1] * len(cands)
         if i == 0:
@@ -147,8 +193,8 @@ def _optimize_chain_dp(
             gb = _transfer_gb(prev_task)
             for j, cand in enumerate(cands):
                 for k, pcand in enumerate(prev_cands):
-                    cost = dp[i - 1][k] + run_cost[j] + _egress_cost(
-                        pcand, cand, gb)
+                    cost = dp[i - 1][k] + run_cost[j] + _egress_metric(
+                        pcand, cand, gb, minimize)
                     if cost < row[j]:
                         row[j] = cost
                         par[j] = k
@@ -165,7 +211,9 @@ def _optimize_chain_dp(
 
 def _optimize_enumerate(
         dag: Dag, order: List[Task],
-        per_task: Dict[Task, List[Resources]]) -> Dict[Task, Resources]:
+        per_task: Dict[Task, List[Resources]],
+        minimize: OptimizeTarget = OptimizeTarget.COST
+) -> Dict[Task, Resources]:
     """Exact search for general DAGs. Candidate lists are truncated to the
     cheapest few per task to bound the product space (they are sorted)."""
     MAX_CANDS = 4
@@ -183,10 +231,11 @@ def _optimize_enumerate(
             return
         task = order[i]
         for cand in pruned[task]:
-            run = cand.price_per_hour * _estimated_runtime_hours(task)
+            run = _run_metric(task, cand, minimize)
             egress = 0.0
             for pred in dag.graph.predecessors(task):
-                egress += _egress_cost(acc[pred], cand, _transfer_gb(pred))
+                egress += _egress_metric(acc[pred], cand, _transfer_gb(pred),
+                                         minimize)
             acc[task] = cand
             rec(i + 1, acc, cost + run + egress)
             del acc[task]

@@ -1,0 +1,128 @@
+"""Minimal GCP Compute Engine REST client (CPU VMs).
+
+Reference analog: ``sky/provision/gcp/instance_utils.py`` ``GCPComputeInstance``
+(``:311``) driving ``compute.googleapis.com`` through googleapiclient. Same
+injectable-transport pattern as ``tpu_client.py`` so the provisioner is
+unit-testable with a fake transport.
+
+Endpoints used:
+  * instances: POST/GET/DELETE/LIST
+      compute/v1/projects/{p}/zones/{z}/instances
+  * instances.stop/start: POST .../instances/{name}/stop|start
+  * zone operations: GET .../zones/{z}/operations/{op} polling
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision.gcp.tpu_client import (GcpApiError, Transport)
+
+COMPUTE_API = 'https://compute.googleapis.com/compute/v1'
+
+DEFAULT_IMAGE = 'projects/debian-cloud/global/images/family/debian-12'
+
+
+class ComputeClient:
+
+    def __init__(self, project: str, transport: Optional[Transport] = None):
+        self.project = project
+        self.transport = transport or Transport()
+
+    def _zone_url(self, zone: str) -> str:
+        return f'{COMPUTE_API}/projects/{self.project}/zones/{zone}'
+
+    # -- instances ----------------------------------------------------------
+
+    def insert_instance(self, zone: str, name: str, machine_type: str,
+                        image: Optional[str] = None,
+                        disk_size_gb: int = 100,
+                        network: str = 'default',
+                        spot: bool = False,
+                        labels: Optional[Dict[str, str]] = None,
+                        metadata: Optional[Dict[str, str]] = None
+                        ) -> Dict[str, Any]:
+        body: Dict[str, Any] = {
+            'name': name,
+            'machineType': f'zones/{zone}/machineTypes/{machine_type}',
+            'disks': [{
+                'boot': True,
+                'autoDelete': True,
+                'initializeParams': {
+                    'sourceImage': image or DEFAULT_IMAGE,
+                    'diskSizeGb': str(disk_size_gb),
+                },
+            }],
+            'networkInterfaces': [{
+                'network': f'global/networks/{network}',
+                'accessConfigs': [{'name': 'External NAT',
+                                   'type': 'ONE_TO_ONE_NAT'}],
+            }],
+            'labels': labels or {},
+            'metadata': {
+                'items': [{'key': k, 'value': v}
+                          for k, v in (metadata or {}).items()],
+            },
+        }
+        if spot:
+            body['scheduling'] = {
+                'provisioningModel': 'SPOT',
+                'instanceTerminationAction': 'STOP',
+            }
+        return self.transport.request(
+            'POST', f'{self._zone_url(zone)}/instances', body=body)
+
+    def get_instance(self, zone: str, name: str) -> Dict[str, Any]:
+        return self.transport.request(
+            'GET', f'{self._zone_url(zone)}/instances/{name}')
+
+    def list_instances(self, zone: str,
+                       name_prefix: Optional[str] = None
+                       ) -> List[Dict[str, Any]]:
+        params = {}
+        if name_prefix:
+            params['filter'] = f'name eq {name_prefix}.*'
+        out = self.transport.request(
+            'GET', f'{self._zone_url(zone)}/instances', params=params or None)
+        return out.get('items', [])
+
+    def delete_instance(self, zone: str, name: str) -> Dict[str, Any]:
+        return self.transport.request(
+            'DELETE', f'{self._zone_url(zone)}/instances/{name}')
+
+    def stop_instance(self, zone: str, name: str) -> Dict[str, Any]:
+        return self.transport.request(
+            'POST', f'{self._zone_url(zone)}/instances/{name}/stop')
+
+    def start_instance(self, zone: str, name: str) -> Dict[str, Any]:
+        return self.transport.request(
+            'POST', f'{self._zone_url(zone)}/instances/{name}/start')
+
+    # -- operations ---------------------------------------------------------
+
+    def wait_operation(self, zone: str, op: Dict[str, Any],
+                       timeout: float = 600.0, poll: float = 2.0
+                       ) -> Dict[str, Any]:
+        """Poll a zone operation until DONE; surfaces operation errors."""
+        name = op.get('name')
+        if name is None or op.get('status') == 'DONE':
+            self._raise_if_error(op)
+            return op
+        deadline = time.time() + timeout
+        while True:
+            cur = self.transport.request(
+                'GET', f'{self._zone_url(zone)}/operations/{name}')
+            if cur.get('status') == 'DONE':
+                self._raise_if_error(cur)
+                return cur
+            if time.time() > deadline:
+                raise exceptions.ClusterNotUpError(
+                    f'GCE operation {name} timed out after {timeout:.0f}s')
+            time.sleep(poll)
+
+    @staticmethod
+    def _raise_if_error(op: Dict[str, Any]) -> None:
+        err = op.get('error')
+        if err:
+            raise GcpApiError(400, str(err))

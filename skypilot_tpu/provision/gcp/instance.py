@@ -21,9 +21,11 @@ from skypilot_tpu import authentication
 from skypilot_tpu import config as config_lib
 from skypilot_tpu import exceptions
 from skypilot_tpu.provision import common
+from skypilot_tpu.provision.gcp import compute_client as compute_client_lib
 from skypilot_tpu.provision.gcp import tpu_client as tpu_client_lib
 
 _clients: Dict[str, tpu_client_lib.TpuClient] = {}
+_compute_clients: Dict[str, compute_client_lib.ComputeClient] = {}
 
 
 def _project() -> str:
@@ -43,8 +45,21 @@ def _client() -> tpu_client_lib.TpuClient:
     return _clients[project]
 
 
+def _compute_client() -> compute_client_lib.ComputeClient:
+    project = _project()
+    if project not in _compute_clients:
+        _compute_clients[project] = compute_client_lib.ComputeClient(project)
+    return _compute_clients[project]
+
+
 def set_client_for_testing(client: tpu_client_lib.TpuClient) -> None:
     _clients[client.project] = client
+    os.environ.setdefault('GOOGLE_CLOUD_PROJECT', client.project)
+
+
+def set_compute_client_for_testing(
+        client: compute_client_lib.ComputeClient) -> None:
+    _compute_clients[client.project] = client
     os.environ.setdefault('GOOGLE_CLOUD_PROJECT', client.project)
 
 
@@ -53,13 +68,11 @@ def _slice_node_id(cluster_name_on_cloud: str, slice_idx: int) -> str:
 
 
 def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
-    assert config.zone is not None, 'GCP TPU provisioning requires a zone'
-    client = _client()
+    assert config.zone is not None, 'GCP provisioning requires a zone'
     nc = config.node_config
     if not nc.get('tpu_vm', False):
-        raise exceptions.NotSupportedError(
-            'CPU VM provisioning on GCP lands with the compute client; '
-            'use a TPU slice or the local cloud.')
+        return _run_cpu_instances(config)
+    client = _client()
     created, resumed = [], []
     existing = {n['name'].rsplit('/', 1)[-1]: n
                 for n in client.list_nodes(config.zone)}
@@ -111,6 +124,68 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
         created_instance_ids=created, resumed_instance_ids=resumed)
 
 
+def _run_cpu_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    """CPU VMs via the Compute Engine client (reference:
+    ``sky/provision/gcp/instance.py:364`` run_instances for compute).
+    Same atomic create-all-or-rollback semantics as the TPU path."""
+    client = _compute_client()
+    nc = config.node_config
+    created, resumed = [], []
+    existing = {i['name']: i
+                for i in client.list_instances(
+                    config.zone, config.cluster_name_on_cloud)}
+    ssh_meta = {'ssh-keys': authentication.ssh_keys_metadata(
+        authentication.default_ssh_user())}
+    for idx in range(config.num_nodes):
+        name = _slice_node_id(config.cluster_name_on_cloud, idx)
+        inst = existing.get(name)
+        if inst is not None:
+            state = inst.get('status', '')
+            if state == 'RUNNING':
+                continue
+            if state == 'TERMINATED':
+                if config.resume_stopped_nodes:
+                    client.wait_operation(
+                        config.zone, client.start_instance(config.zone, name))
+                    resumed.append(name)
+                    continue
+                raise exceptions.ClusterNotUpError(
+                    f'Instance {name} is stopped; start the cluster or '
+                    'launch with resume.')
+            # PROVISIONING/STAGING/STOPPING/...: re-creating under the same
+            # name would 409 and tear down siblings via rollback.
+            raise exceptions.ClusterNotUpError(
+                f'Instance {name} is in transition ({state}); retry once it '
+                'settles.')
+        try:
+            op = client.insert_instance(
+                config.zone, name,
+                machine_type=nc['instance_type'],
+                image=nc.get('image_id'),
+                disk_size_gb=nc.get('disk_size_gb') or 100,
+                network=nc.get('network', 'default'),
+                spot=bool(nc.get('use_spot', False)),
+                labels={**config.tags, 'skytpu-node': str(idx)},
+                metadata=ssh_meta)
+            client.wait_operation(config.zone, op)
+            created.append(name)
+        except tpu_client_lib.GcpApiError as e:
+            for rollback in created:
+                try:
+                    client.delete_instance(config.zone, rollback)
+                except tpu_client_lib.GcpApiError:
+                    pass
+            if e.is_stockout():
+                raise exceptions.QuotaExceededError(
+                    f'GCE stockout in {config.zone}: {e}') from e
+            raise
+    return common.ProvisionRecord(
+        provider_name='gcp', region=config.region, zone=config.zone,
+        cluster_name_on_cloud=config.cluster_name_on_cloud,
+        head_instance_id=_slice_node_id(config.cluster_name_on_cloud, 0),
+        created_instance_ids=created, resumed_instance_ids=resumed)
+
+
 def _nodes_of_cluster(zone: str,
                       cluster_name_on_cloud: str) -> List[Dict[str, Any]]:
     client = _client()
@@ -120,6 +195,58 @@ def _nodes_of_cluster(zone: str,
         if name.startswith(cluster_name_on_cloud + '-'):
             out.append(node)
     return sorted(out, key=lambda n: n['name'])
+
+
+def _cpu_instances_of_cluster(zone: str, cluster_name_on_cloud: str
+                              ) -> List[Dict[str, Any]]:
+    """CPU VMs of the cluster; tolerates the Compute API being unavailable
+    (TPU-only projects/credentials must not break TPU-cluster lifecycle
+    ops, which query both kinds because the cluster kind is not recorded)."""
+    client = _compute_client()
+    try:
+        instances = client.list_instances(zone, cluster_name_on_cloud)
+    except tpu_client_lib.GcpApiError as e:
+        if e.status_code in (403, 404):
+            return []
+        raise
+    out = [i for i in instances
+           if i['name'].startswith(cluster_name_on_cloud + '-')]
+    return sorted(out, key=lambda i: i['name'])
+
+
+def _workers_of_node(node: Dict[str, Any]) -> int:
+    """Host (worker VM) count of a TPU node from its accelerator spec —
+    valid in ANY state, unlike counting ``networkEndpoints`` (a STOPPED
+    node reports none, which previously made refresh_status miscount
+    multi-host slices)."""
+    from skypilot_tpu import topology as topo_lib
+
+    at = node.get('acceleratorType', '')
+    name = None
+    if at.startswith('v5litepod-'):
+        name = 'tpu-v5e-' + at.split('-', 1)[1]
+    elif at:
+        name = 'tpu-' + at
+    else:
+        acc_cfg = node.get('acceleratorConfig', {})
+        gen = acc_cfg.get('type', '').lower()
+        dims = acc_cfg.get('topology', '')
+        if gen in topo_lib.GENERATIONS and dims:
+            chips = 1
+            for d in dims.split('x'):
+                chips *= int(d)
+            g = topo_lib.GENERATIONS[gen]
+            if chips <= g.max_chips_single_host:
+                return 1
+            return max(1, chips // g.chips_per_host)
+    if name is not None:
+        try:
+            sl = topo_lib.parse_accelerator(name)
+            if sl is not None:
+                return sl.hosts
+        except exceptions.InvalidTopologyError:
+            pass
+    return max(1, len(node.get('networkEndpoints', [])))
 
 
 def _find_zone(cluster_name_on_cloud: str,
@@ -144,6 +271,10 @@ def stop_instances(cluster_name_on_cloud: str,
     for node in _nodes_of_cluster(zone, cluster_name_on_cloud):
         node_id = node['name'].rsplit('/', 1)[-1]
         client.wait_operation(client.stop_node(zone, node_id))
+    cclient = _compute_client()
+    for inst in _cpu_instances_of_cluster(zone, cluster_name_on_cloud):
+        cclient.wait_operation(zone, cclient.stop_instance(zone,
+                                                           inst['name']))
 
 
 def terminate_instances(cluster_name_on_cloud: str,
@@ -155,6 +286,14 @@ def terminate_instances(cluster_name_on_cloud: str,
         node_id = node['name'].rsplit('/', 1)[-1]
         try:
             client.wait_operation(client.delete_node(zone, node_id))
+        except tpu_client_lib.GcpApiError as e:
+            if e.status_code != 404:
+                raise
+    cclient = _compute_client()
+    for inst in _cpu_instances_of_cluster(zone, cluster_name_on_cloud):
+        try:
+            cclient.wait_operation(
+                zone, cclient.delete_instance(zone, inst['name']))
         except tpu_client_lib.GcpApiError as e:
             if e.status_code != 404:
                 raise
@@ -173,6 +312,18 @@ _STATE_MAP = {
 }
 
 
+_GCE_STATE_MAP = {
+    'PROVISIONING': 'pending',
+    'STAGING': 'pending',
+    'RUNNING': 'running',
+    'REPAIRING': 'pending',
+    'STOPPING': 'stopped',
+    'SUSPENDING': 'stopped',
+    'SUSPENDED': 'stopped',
+    'TERMINATED': 'stopped',  # GCE TERMINATED == stopped (restartable)
+}
+
+
 def query_instances(cluster_name_on_cloud: str,
                     provider_config: Optional[Dict[str, Any]] = None
                     ) -> Dict[str, Optional[str]]:
@@ -182,11 +333,14 @@ def query_instances(cluster_name_on_cloud: str,
     for node in _nodes_of_cluster(zone, cluster_name_on_cloud):
         name = node['name'].rsplit('/', 1)[-1]
         # Every worker of the slice shares the node's state; expand to
-        # per-worker entries so worker-count health checks are uniform.
-        endpoints = node.get('networkEndpoints', [{}])
+        # per-worker entries (count from the accelerator topology, which is
+        # state-independent) so worker-count health checks are uniform.
         state = _STATE_MAP.get(node.get('state', ''), None)
-        for worker_id in range(max(1, len(endpoints))):
+        for worker_id in range(_workers_of_node(node)):
             out[f'{name}-w{worker_id}'] = state
+    for inst in _cpu_instances_of_cluster(zone, cluster_name_on_cloud):
+        out[f'{inst["name"]}-w0'] = _GCE_STATE_MAP.get(
+            inst.get('status', ''), None)
     return out
 
 
@@ -212,6 +366,20 @@ def get_cluster_info(region: str, cluster_name_on_cloud: str,
                 internal_ip=ep.get('ipAddress', ''),
                 external_ip=access.get('externalIp') or ep.get('ipAddress'),
                 status='running'))
+    for inst in _cpu_instances_of_cluster(zone, cluster_name_on_cloud):
+        if inst.get('status') != 'RUNNING':
+            continue
+        name = inst['name']
+        node_idx = int(name.rsplit('-', 1)[-1])
+        nic = (inst.get('networkInterfaces') or [{}])[0]
+        access = (nic.get('accessConfigs') or [{}])[0]
+        instances.append(common.InstanceInfo(
+            instance_id=f'{name}-w0',
+            node_id=node_idx,
+            worker_id=0,
+            internal_ip=nic.get('networkIP', ''),
+            external_ip=access.get('natIP') or nic.get('networkIP'),
+            status='running'))
     head = f'{cluster_name_on_cloud}-0-w0'
     key_path, _ = authentication.get_or_create_ssh_keypair()
     return common.ClusterInfo(

@@ -56,6 +56,27 @@ class Transport:
             raise GcpApiError(resp.status_code, resp.text)
         return resp.json() if resp.text else {}
 
+    def upload_media(self, url: str, data: bytes,
+                     params: Optional[Dict[str, str]] = None
+                     ) -> Dict[str, Any]:
+        """Raw-bytes POST (GCS JSON media upload)."""
+        headers = {'Authorization': f'Bearer {self._token_provider()}',
+                   'Content-Type': 'application/octet-stream'}
+        resp = requests.post(url, headers=headers, data=data, params=params,
+                             timeout=300)
+        if resp.status_code >= 400:
+            raise GcpApiError(resp.status_code, resp.text)
+        return resp.json() if resp.text else {}
+
+    def download_media(self, url: str,
+                       params: Optional[Dict[str, str]] = None) -> bytes:
+        """Raw-bytes GET (GCS ``alt=media``)."""
+        headers = {'Authorization': f'Bearer {self._token_provider()}'}
+        resp = requests.get(url, headers=headers, params=params, timeout=300)
+        if resp.status_code >= 400:
+            raise GcpApiError(resp.status_code, resp.text)
+        return resp.content
+
 
 class GcpApiError(exceptions.SkyTpuError):
 

@@ -1,0 +1,1 @@
+"""GKE provisioner package (pods pinned to TPU node pools)."""

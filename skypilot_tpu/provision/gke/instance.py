@@ -1,0 +1,246 @@
+"""GKE TPU provisioner: pods pinned to TPU node pools.
+
+Reference analog: ``sky/provision/kubernetes/`` with its GKE TPU support in
+``utils.py`` — accelerator→generation map (``:193-199``), topology
+reduction / multi-host detection (``:3398-3420``), the ``google.com/tpu``
+resource key (``:159``) and the GKE node selectors (``:531-533``).
+
+Model: one pod per worker HOST. A multi-host slice (``tpu-v5e-16`` = 4
+hosts) becomes ``hosts`` pods landing on the same multi-host TPU node pool;
+GKE's TPU webhook + our gang driver provide the worker env contract. Pods
+sleep and are exec'd into by the command runner (kubectl), mirroring the
+reference's pods-as-nodes design.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.gke import k8s_client as k8s_lib
+
+# GKE node-pool selector values per TPU generation
+# (reference: provision/kubernetes/utils.py:193-199).
+GKE_TPU_ACCELERATOR = {
+    'v4': 'tpu-v4-podslice',
+    'v5e': 'tpu-v5-lite-podslice',
+    'v5p': 'tpu-v5p-slice',
+    'v6e': 'tpu-v6e-slice',
+}
+
+LABEL_CLUSTER = 'skytpu-cluster'
+LABEL_NODE = 'skytpu-node'
+LABEL_WORKER = 'skytpu-worker'
+
+DEFAULT_IMAGE = 'python:3.11-slim'
+
+_client_override: Optional[k8s_lib.K8sClient] = None
+
+
+def set_client_for_testing(client: k8s_lib.K8sClient) -> None:
+    global _client_override
+    _client_override = client
+
+
+def _default_namespace() -> str:
+    return os.environ.get('SKYTPU_GKE_NAMESPACE', 'default')
+
+
+def _client(namespace: Optional[str] = None) -> k8s_lib.K8sClient:
+    if _client_override is not None:
+        return _client_override
+    # Lifecycle ops (wait/query/terminate/info) must look in the SAME
+    # namespace run_instances created pods in; both default from
+    # SKYTPU_GKE_NAMESPACE (the cloud's deploy vars use it too).
+    return k8s_lib.K8sClient(k8s_lib.transport_from_kubeconfig(),
+                             namespace=namespace or _default_namespace())
+
+
+def _pod_name(cluster: str, node: int, worker: int) -> str:
+    return f'{cluster}-{node}-w{worker}'
+
+
+def _pod_body(config: common.ProvisionConfig, node: int, worker: int
+              ) -> Dict[str, Any]:
+    nc = config.node_config
+    gen = nc['tpu_generation']
+    chips_per_host = nc['chips_per_host']
+    name = _pod_name(config.cluster_name_on_cloud, node, worker)
+    return {
+        'apiVersion': 'v1',
+        'kind': 'Pod',
+        'metadata': {
+            'name': name,
+            'labels': {
+                LABEL_CLUSTER: config.cluster_name_on_cloud,
+                LABEL_NODE: str(node),
+                LABEL_WORKER: str(worker),
+                **config.tags,
+            },
+        },
+        'spec': {
+            'restartPolicy': 'Never',
+            'nodeSelector': {
+                'cloud.google.com/gke-tpu-accelerator':
+                    GKE_TPU_ACCELERATOR[gen],
+                'cloud.google.com/gke-tpu-topology': nc['topology'],
+                **({'cloud.google.com/gke-spot': 'true'}
+                   if nc.get('use_spot') else {}),
+            },
+            'containers': [{
+                'name': 'worker',
+                'image': nc.get('image_id') or DEFAULT_IMAGE,
+                'command': ['/bin/sh', '-c', 'sleep infinity'],
+                'resources': {
+                    'requests': {'google.com/tpu': str(chips_per_host)},
+                    'limits': {'google.com/tpu': str(chips_per_host)},
+                },
+            }],
+        },
+    }
+
+
+def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    nc = config.node_config
+    if not nc.get('tpu_vm', False):
+        raise exceptions.NotSupportedError(
+            'The GKE provider schedules TPU node pools; use GCP for CPU VMs.')
+    client = _client(nc.get('namespace'))
+    existing = {p['metadata']['name']: p for p in client.list_pods(
+        f'{LABEL_CLUSTER}={config.cluster_name_on_cloud}')}
+    hosts = nc['hosts_per_slice']
+    created: List[str] = []
+    try:
+        for node in range(config.num_nodes):
+            for worker in range(hosts):
+                name = _pod_name(config.cluster_name_on_cloud, node, worker)
+                if name in existing:
+                    continue
+                client.create_pod(_pod_body(config, node, worker))
+                created.append(name)
+    except k8s_lib.K8sApiError as e:
+        for name in created:  # atomic slice semantics
+            try:
+                client.delete_pod(name)
+            except k8s_lib.K8sApiError:
+                pass
+        low = str(e).lower()
+        if 'quota' in low or 'exceeded' in low or e.status_code == 403:
+            raise exceptions.QuotaExceededError(
+                f'GKE quota/capacity: {e}') from e
+        raise
+    return common.ProvisionRecord(
+        provider_name='gke', region=config.region, zone=config.zone,
+        cluster_name_on_cloud=config.cluster_name_on_cloud,
+        head_instance_id=_pod_name(config.cluster_name_on_cloud, 0, 0),
+        created_instance_ids=created, resumed_instance_ids=[])
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str, state: str,
+                   timeout: float = 600.0, poll: float = 3.0) -> None:
+    """Wait until every pod is Running. Unschedulable pods (no TPU node
+    pool capacity) surface as QuotaExceededError so the backend fails over
+    — the k8s analog of a TPU stockout."""
+    del region, state
+    client = _client()
+    deadline = time.time() + timeout
+    while True:
+        pods = client.list_pods(f'{LABEL_CLUSTER}={cluster_name_on_cloud}')
+        phases = [p.get('status', {}).get('phase') for p in pods]
+        if pods and all(ph == 'Running' for ph in phases):
+            return
+        for pod in pods:
+            for cond in pod.get('status', {}).get('conditions', []):
+                if (cond.get('reason') == 'Unschedulable'
+                        and cond.get('status') == 'False'):
+                    # No TPU node pool can host this topology right now.
+                    # (With cluster autoscaling this can be transient; the
+                    # failover loop retries other candidates first, which
+                    # matches stockout semantics.)
+                    _cleanup(client, cluster_name_on_cloud)
+                    raise exceptions.QuotaExceededError(
+                        f'GKE: pod {pod["metadata"]["name"]} unschedulable: '
+                        f'{cond.get("message", "")}')
+        if time.time() > deadline:
+            _cleanup(client, cluster_name_on_cloud)
+            raise exceptions.QuotaExceededError(
+                f'GKE: pods not Running after {timeout:.0f}s '
+                f'(phases: {phases})')
+        time.sleep(poll)
+
+
+def _cleanup(client: k8s_lib.K8sClient, cluster_name_on_cloud: str) -> None:
+    for pod in client.list_pods(f'{LABEL_CLUSTER}={cluster_name_on_cloud}'):
+        try:
+            client.delete_pod(pod['metadata']['name'])
+        except k8s_lib.K8sApiError:
+            pass
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
+    raise exceptions.NotSupportedError(
+        'GKE pods cannot be stopped; use down (terminate) instead.')
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None
+                        ) -> None:
+    _cleanup(_client(), cluster_name_on_cloud)
+
+
+_PHASE_MAP = {
+    'Pending': 'pending',
+    'Running': 'running',
+    'Succeeded': 'terminated',
+    'Failed': 'terminated',
+    'Unknown': None,
+}
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Optional[str]]:
+    client = _client()
+    out: Dict[str, Optional[str]] = {}
+    for pod in client.list_pods(f'{LABEL_CLUSTER}={cluster_name_on_cloud}'):
+        out[pod['metadata']['name']] = _PHASE_MAP.get(
+            pod.get('status', {}).get('phase', ''), None)
+    return out
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    client = _client()
+    instances: List[common.InstanceInfo] = []
+    for pod in client.list_pods(f'{LABEL_CLUSTER}={cluster_name_on_cloud}'):
+        if pod.get('status', {}).get('phase') != 'Running':
+            continue
+        meta = pod['metadata']
+        instances.append(common.InstanceInfo(
+            instance_id=meta['name'],
+            node_id=int(meta['labels'][LABEL_NODE]),
+            worker_id=int(meta['labels'][LABEL_WORKER]),
+            internal_ip=pod.get('status', {}).get('podIP', ''),
+            external_ip=pod.get('status', {}).get('podIP', ''),
+            status='running'))
+    head = _pod_name(cluster_name_on_cloud, 0, 0)
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=head if any(
+            i.instance_id == head for i in instances) else None,
+        provider_name='gke', region=region, zone=None,
+        ssh_user='root', ssh_key_path=None)
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[int],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del cluster_name_on_cloud, ports, provider_config  # services TBD
+
+
+def cleanup_ports(cluster_name_on_cloud: str,
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del cluster_name_on_cloud, provider_config

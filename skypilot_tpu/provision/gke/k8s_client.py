@@ -1,0 +1,124 @@
+"""Minimal Kubernetes API client for GKE TPU node pools.
+
+Reference analog: ``sky/provision/kubernetes/`` drives the cluster through
+the official kubernetes SDK; here it is the same injectable-transport
+pattern as ``provision/gcp/tpu_client.py`` — a thin REST wrapper over the
+kube-apiserver (pods + events only: the provisioner's scheduling atom is a
+pod pinned to a TPU node pool), unit-testable with a fake transport.
+
+Auth: bearer token + server from the active kubeconfig context (GKE
+kubeconfigs carry an access token or exec plugin; the exec path shells out
+once). No kubernetes SDK dependency.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import os
+import subprocess
+import tempfile
+from typing import Any, Dict, List, Optional
+
+import requests
+import yaml
+
+from skypilot_tpu import exceptions
+
+
+class K8sApiError(exceptions.SkyTpuError):
+
+    def __init__(self, status_code: int, body: str):
+        self.status_code = status_code
+        self.body = body
+        super().__init__(f'Kubernetes API error {status_code}: {body[:500]}')
+
+
+class K8sTransport:
+    """HTTP transport to one cluster; replaced by a fake in tests."""
+
+    def __init__(self, server: str, token: Optional[str] = None,
+                 ca_cert_file: Optional[str] = None):
+        self.server = server.rstrip('/')
+        self.token = token
+        self.ca_cert_file = ca_cert_file
+
+    def request(self, method: str, path: str,
+                body: Optional[Dict[str, Any]] = None,
+                params: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+        headers = {'Content-Type': 'application/json'}
+        if self.token:
+            headers['Authorization'] = f'Bearer {self.token}'
+        resp = requests.request(
+            method, self.server + path, headers=headers, json=body,
+            params=params, timeout=60,
+            # No explicit CA in the kubeconfig => system trust store
+            # (never disable verification).
+            verify=self.ca_cert_file if self.ca_cert_file else True)
+        if resp.status_code >= 400:
+            raise K8sApiError(resp.status_code, resp.text)
+        return resp.json() if resp.text else {}
+
+
+def _load_kubeconfig() -> Dict[str, Any]:
+    path = os.environ.get('KUBECONFIG',
+                          os.path.expanduser('~/.kube/config'))
+    with open(os.path.expanduser(path), encoding='utf-8') as f:
+        return yaml.safe_load(f)
+
+
+def transport_from_kubeconfig(context: Optional[str] = None) -> K8sTransport:
+    """Build a transport from the active (or named) kubeconfig context."""
+    cfg = _load_kubeconfig()
+    ctx_name = context or cfg.get('current-context')
+    ctx = next(c['context'] for c in cfg.get('contexts', [])
+               if c['name'] == ctx_name)
+    cluster = next(c['cluster'] for c in cfg.get('clusters', [])
+                   if c['name'] == ctx['cluster'])
+    user = next(u['user'] for u in cfg.get('users', [])
+                if u['name'] == ctx['user'])
+    token = user.get('token')
+    if token is None and 'exec' in user:
+        ex = user['exec']
+        out = subprocess.run([ex['command']] + list(ex.get('args') or []),
+                             capture_output=True, text=True, check=False)
+        if out.returncode == 0:
+            cred = json.loads(out.stdout)
+            token = cred.get('status', {}).get('token')
+    ca_file = cluster.get('certificate-authority')
+    if ca_file is None and 'certificate-authority-data' in cluster:
+        fd, ca_file = tempfile.mkstemp(suffix='.crt')
+        with os.fdopen(fd, 'wb') as f:
+            f.write(base64.b64decode(cluster['certificate-authority-data']))
+    return K8sTransport(cluster['server'], token=token, ca_cert_file=ca_file)
+
+
+class K8sClient:
+
+    def __init__(self, transport: K8sTransport,
+                 namespace: str = 'default'):
+        self.transport = transport
+        self.namespace = namespace
+
+    def _pods(self) -> str:
+        return f'/api/v1/namespaces/{self.namespace}/pods'
+
+    def create_pod(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        return self.transport.request('POST', self._pods(), body=body)
+
+    def get_pod(self, name: str) -> Dict[str, Any]:
+        return self.transport.request('GET', f'{self._pods()}/{name}')
+
+    def list_pods(self, label_selector: Optional[str] = None
+                  ) -> List[Dict[str, Any]]:
+        params = {'labelSelector': label_selector} if label_selector else None
+        out = self.transport.request('GET', self._pods(), params=params)
+        return out.get('items', [])
+
+    def delete_pod(self, name: str) -> Dict[str, Any]:
+        return self.transport.request('DELETE', f'{self._pods()}/{name}')
+
+    def pod_events(self, name: str) -> List[Dict[str, Any]]:
+        out = self.transport.request(
+            'GET', f'/api/v1/namespaces/{self.namespace}/events',
+            params={'fieldSelector': f'involvedObject.name={name}'})
+        return out.get('items', [])

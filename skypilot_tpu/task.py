@@ -82,6 +82,11 @@ class Task:
         self._resources_ordered: List[Resources] = [Resources()]
         self.service: Optional[Any] = None  # serve.SpecType, set by serve layer
         self.best_resources: Optional[Resources] = None  # optimizer output
+        # Optimizer TIME-target inputs (reference: the time-estimator
+        # contract in sky/optimizer.py): seconds at the reference
+        # throughput, or a per-candidate estimator.
+        self.estimated_runtime: Optional[float] = None
+        self.time_estimator_fn: Optional[Any] = None
 
         self._validate()
 
@@ -121,6 +126,17 @@ class Task:
             raise ValueError('At least one Resources candidate is required.')
         self._resources_ordered = ordered
         self._resources = set(ordered)
+        return self
+
+    def set_estimated_runtime(self, seconds: float) -> 'Task':
+        """Expected duration (s) at the optimizer's reference throughput."""
+        self.estimated_runtime = float(seconds)
+        return self
+
+    def set_time_estimator(self, fn) -> 'Task':
+        """``fn(resources) -> seconds``: per-candidate runtime estimate used
+        by the TIME optimize target."""
+        self.time_estimator_fn = fn
         return self
 
     # -- envs / secrets ----------------------------------------------------

@@ -38,11 +38,12 @@ SSH_OPTIONS = [
 @dataclasses.dataclass
 class RunnerSpec:
     """Serializable description of how to reach one worker."""
-    kind: str  # 'local' | 'ssh'
-    ip: str = '127.0.0.1'
+    kind: str  # 'local' | 'ssh' | 'k8s'
+    ip: str = '127.0.0.1'  # for k8s: the pod name
     user: Optional[str] = None
     ssh_key: Optional[str] = None
     port: int = 22
+    namespace: str = 'default'  # k8s only
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -57,6 +58,8 @@ class RunnerSpec:
         if self.kind == 'ssh':
             return SSHCommandRunner(self.ip, self.user or 'skytpu',
                                     self.ssh_key, self.port)
+        if self.kind == 'k8s':
+            return KubectlCommandRunner(self.ip, self.namespace)
         raise ValueError(f'Unknown runner kind {self.kind!r}')
 
 
@@ -74,6 +77,16 @@ class CommandRunner:
 
     def rsync(self, src: str, dst: str, up: bool = True) -> None:
         raise NotImplementedError
+
+
+def _remote_quote(path: str) -> str:
+    """Quote a remote path for shell interpolation while preserving leading
+    ``~`` expansion (``~/x`` -> ``"$HOME"/'x'``)."""
+    if path == '~':
+        return '"$HOME"'
+    if path.startswith('~/'):
+        return '"$HOME"/' + shlex.quote(path[2:])
+    return shlex.quote(path)
 
 
 def _env_prefix(env: Optional[Dict[str, str]]) -> str:
@@ -177,8 +190,9 @@ class SSHCommandRunner(CommandRunner):
         (mirror semantics: the destination dir is replaced)."""
         if up:
             src = os.path.expanduser(src).rstrip('/')
-            remote_cmd = (f'rm -rf {dst} && mkdir -p {dst} && '
-                          f'tar -xf - -C {dst}')
+            qdst = _remote_quote(dst)
+            remote_cmd = (f'rm -rf {qdst} && mkdir -p {qdst} && '
+                          f'tar -xf - -C {qdst}')
             ssh_argv = self._ssh_base() + ['bash', '-c',
                                            shlex.quote(remote_cmd)]
             tar = subprocess.Popen(['tar', '-cf', '-', '-C', src, '.'],
@@ -193,7 +207,7 @@ class SSHCommandRunner(CommandRunner):
         else:
             local = os.path.expanduser(src).rstrip('/')
             os.makedirs(local, exist_ok=True)
-            remote_cmd = f'tar -cf - -C {dst.rstrip("/")} .'
+            remote_cmd = f'tar -cf - -C {_remote_quote(dst.rstrip("/"))} .'
             ssh_argv = self._ssh_base() + ['bash', '-c',
                                            shlex.quote(remote_cmd)]
             ssh = subprocess.Popen(ssh_argv, stdout=subprocess.PIPE)
@@ -205,3 +219,65 @@ class SSHCommandRunner(CommandRunner):
             if tar.returncode or ssh.returncode:
                 raise subprocess.CalledProcessError(
                     ssh.returncode or tar.returncode, ssh_argv)
+
+
+class KubectlCommandRunner(CommandRunner):
+    """Exec into a GKE pod (reference: ``KubernetesCommandRunner :938``,
+    which shells through kubectl exec the same way)."""
+
+    def __init__(self, pod_name: str, namespace: str = 'default'):
+        self.ip = pod_name  # `.ip` is the uniform "address" attr
+        self.pod_name = pod_name
+        self.namespace = namespace
+
+    def _kubectl_base(self) -> List[str]:
+        return ['kubectl', 'exec', '-i', '-n', self.namespace, self.pod_name,
+                '--']
+
+    def popen_argv(self, cmd, env=None, cwd=None):
+        inner = _env_prefix(env) + cmd
+        if cwd:
+            inner = f'cd {shlex.quote(cwd)} && {inner}'
+        return self._kubectl_base() + ['bash', '-c', inner]
+
+    def run(self, cmd, env=None, log_path=None, stream=False, prefix='',
+            cwd=None) -> int:
+        argv = self.popen_argv(cmd, env=env, cwd=cwd)
+        if log_path is None:
+            return subprocess.run(argv, check=False).returncode
+        return log_lib.run_with_log(argv, log_path, stream=stream,
+                                    prefix=prefix)
+
+    def rsync(self, src: str, dst: str, up: bool = True) -> None:
+        """tar pipe through kubectl exec (kubectl cp equivalent without
+        requiring tar on the local image assumptions kubectl cp makes)."""
+        if up:
+            src = os.path.expanduser(src).rstrip('/')
+            qdst = _remote_quote(dst)
+            remote_cmd = (f'rm -rf {qdst} && mkdir -p {qdst} && '
+                          f'tar -xf - -C {qdst}')
+            argv = self._kubectl_base() + ['bash', '-c', remote_cmd]
+            tar = subprocess.Popen(['tar', '-cf', '-', '-C', src, '.'],
+                                   stdout=subprocess.PIPE)
+            k = subprocess.Popen(argv, stdin=tar.stdout)
+            tar.stdout.close()
+            k.wait()
+            tar.wait()
+            if tar.returncode or k.returncode:
+                raise subprocess.CalledProcessError(
+                    k.returncode or tar.returncode, argv)
+        else:
+            local = os.path.expanduser(src).rstrip('/')
+            os.makedirs(local, exist_ok=True)
+            argv = self._kubectl_base() + [
+                'bash', '-c',
+                f'tar -cf - -C {_remote_quote(dst.rstrip("/"))} .']
+            k = subprocess.Popen(argv, stdout=subprocess.PIPE)
+            tar = subprocess.Popen(['tar', '-xf', '-', '-C', local],
+                                   stdin=k.stdout)
+            k.stdout.close()
+            tar.wait()
+            k.wait()
+            if tar.returncode or k.returncode:
+                raise subprocess.CalledProcessError(
+                    k.returncode or tar.returncode, argv)

