@@ -21,7 +21,7 @@ import json
 import os
 import signal
 import sys
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.agent import constants, job_lib, log_lib
 from skypilot_tpu.utils.command_runner import RunnerSpec
@@ -127,7 +127,8 @@ def _wait_for_turn(table: job_lib.JobTable, job_id: int,
         _time.sleep(poll_s)
 
 
-def run_job(cluster_dir: str, job_id: int) -> int:
+def run_job(cluster_dir: str, job_id: int,
+            nonce: Optional[str] = None) -> int:
     table = job_lib.JobTable(cluster_dir)
     signal.signal(signal.SIGTERM, _kill_workers)
     if not _wait_for_turn(table, job_id):
@@ -137,6 +138,22 @@ def run_job(cluster_dir: str, job_id: int) -> int:
     log_dir = job['log_dir']
     with open(os.path.join(log_dir, 'spec.json'), encoding='utf-8') as f:
         spec = json.load(f)
+    if nonce is not None and spec.get('nonce') != nonce:
+        # The cluster runtime dir was torn down and relaunched under this
+        # driver (managed-job recovery reuses the cluster name): the spec
+        # on disk belongs to a NEWER incarnation. Abort without touching
+        # the (new) job table.
+        return 0
+
+    def _still_mine() -> bool:
+        if nonce is None:
+            return True
+        try:
+            with open(os.path.join(log_dir, 'spec.json'),
+                      encoding='utf-8') as sf:
+                return json.load(sf).get('nonce') == nonce
+        except (OSError, json.JSONDecodeError):
+            return False
 
     workers = spec['workers']
     hosts_per_slice = max(1, len(workers) // max(1, spec['num_nodes']))
@@ -147,8 +164,9 @@ def run_job(cluster_dir: str, job_id: int) -> int:
     # -- setup phase (once per worker, parallel) ---------------------------
     setup_cmd = spec.get('setup')
     if setup_cmd:
-        if not table.set_status(job_id, job_lib.JobStatus.SETTING_UP,
-                                driver_pid=os.getpid()):
+        if not _still_mine() or not table.set_status(
+                job_id, job_lib.JobStatus.SETTING_UP,
+                driver_pid=os.getpid()):
             return 0  # cancelled in the admission race
         gang = []
         for w in workers:
@@ -163,13 +181,14 @@ def run_job(cluster_dir: str, job_id: int) -> int:
         rc = log_lib.run_gang(gang, on_spawn=_register_proc)
         _live_procs.clear()
         if rc != 0:
-            table.set_status(job_id, job_lib.JobStatus.FAILED_SETUP)
+            if _still_mine():
+                table.set_status(job_id, job_lib.JobStatus.FAILED_SETUP)
             return 1
 
     # -- run phase (gang) --------------------------------------------------
-    if not table.set_status(job_id, job_lib.JobStatus.RUNNING,
-                            driver_pid=os.getpid()):
-        return 0  # cancelled in the admission race
+    if not _still_mine() or not table.set_status(
+            job_id, job_lib.JobStatus.RUNNING, driver_pid=os.getpid()):
+        return 0  # cancelled (or superseded) in the admission race
     run_cmd = spec.get('run')
     if not run_cmd:
         table.set_status(job_id, job_lib.JobStatus.SUCCEEDED)
@@ -187,8 +206,10 @@ def run_job(cluster_dir: str, job_id: int) -> int:
     rc = log_lib.run_gang(gang, on_spawn=_register_proc)
     _live_procs.clear()
     ok = rc == 0
-    table.set_status(
-        job_id, job_lib.JobStatus.SUCCEEDED if ok else job_lib.JobStatus.FAILED)
+    if _still_mine():
+        table.set_status(
+            job_id,
+            job_lib.JobStatus.SUCCEEDED if ok else job_lib.JobStatus.FAILED)
     return 0 if ok else 1
 
 
@@ -196,6 +217,7 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--cluster-dir', required=True)
     parser.add_argument('--job-id', type=int, required=True)
+    parser.add_argument('--nonce', default=None)
     args = parser.parse_args()
 
     # The driver's own stdout goes to the merged job log.
@@ -208,10 +230,23 @@ def main() -> None:
         os.dup2(out.fileno(), sys.stdout.fileno())
         os.dup2(out.fileno(), sys.stderr.fileno())
         try:
-            code = run_job(args.cluster_dir, args.job_id)
+            code = run_job(args.cluster_dir, args.job_id,
+                           nonce=args.nonce)
         except Exception as e:  # noqa: BLE001 — record driver crashes
             print(f'[driver] crashed: {e!r}')
-            table.set_status(args.job_id, job_lib.JobStatus.FAILED)
+            # Same incarnation guard as run_job's writes: a stale driver
+            # crashing (e.g. its runtime dir was torn down under it) must
+            # not FAIL the relaunched incarnation's job of the same id.
+            still_mine = True
+            if args.nonce is not None:
+                try:
+                    with open(os.path.join(job['log_dir'], 'spec.json'),
+                              encoding='utf-8') as sf:
+                        still_mine = json.load(sf).get('nonce') == args.nonce
+                except (OSError, json.JSONDecodeError):
+                    still_mine = False
+            if still_mine:
+                table.set_status(args.job_id, job_lib.JobStatus.FAILED)
             code = 1
     sys.exit(code)
 

@@ -47,6 +47,10 @@ CREATE TABLE IF NOT EXISTS jobs (
     log_dir TEXT,
     metadata TEXT
 );
+CREATE TABLE IF NOT EXISTS meta (
+    key TEXT PRIMARY KEY,
+    value TEXT
+);
 """
 
 
@@ -138,16 +142,35 @@ class JobTable:
             row = conn.execute('SELECT MAX(job_id) AS m FROM jobs').fetchone()
             return row['m']
 
+    def set_max_parallel(self, n: int) -> None:
+        """Parallel job slots on this cluster. Default 1: one gang owns the
+        slice at a time (chips don't timeshare). Controller clusters (CPU)
+        raise it so many managed-job/serve controllers run concurrently
+        (reference: the jobs-controller VM runs one process per job,
+        ``sky/jobs/scheduler.py``)."""
+        with self._lock, self._conn() as conn:
+            conn.execute(
+                'INSERT INTO meta (key, value) VALUES ("max_parallel", ?) '
+                'ON CONFLICT(key) DO UPDATE SET value = excluded.value',
+                (str(int(n)),))
+
+    def max_parallel(self) -> int:
+        with self._conn() as conn:
+            row = conn.execute(
+                'SELECT value FROM meta WHERE key = "max_parallel"'
+            ).fetchone()
+            return int(row['value']) if row else 1
+
     def next_pending(self) -> Optional[Dict[str, Any]]:
-        """FIFO: oldest PENDING job, only if nothing is running/setting up
-        (one gang job owns the slice at a time — what Ray placement groups
+        """FIFO: oldest PENDING job, only while fewer than ``max_parallel``
+        jobs are running/setting up (default 1 — what Ray placement groups
         serialized in the reference, reference ``job_lib.py:350``)."""
         with self._conn() as conn:
             busy = conn.execute(
                 'SELECT COUNT(*) AS c FROM jobs WHERE status IN (?, ?)',
                 (JobStatus.RUNNING.value,
                  JobStatus.SETTING_UP.value)).fetchone()['c']
-            if busy:
+            if busy >= self.max_parallel():
                 return None
             row = conn.execute(
                 'SELECT * FROM jobs WHERE status = ? ORDER BY job_id LIMIT 1',

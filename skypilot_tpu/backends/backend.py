@@ -33,6 +33,11 @@ class ClusterHandle:
     launched_resources: Dict[str, Any]  # Resources.to_yaml_config()
     is_tpu: bool = False
     price_per_hour: Optional[float] = None
+    # Per-provider lookup context for lifecycle ops (zone, k8s namespace,
+    # ...), captured at provision time so stop/down/status work from any
+    # later process/env (reference: provider_config threading in
+    # sky/provision/__init__.py).
+    provider_config: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)

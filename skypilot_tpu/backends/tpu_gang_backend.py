@@ -139,7 +139,11 @@ class TpuGangBackend(Backend):
                 chips_per_host=to_provision.chips_per_host,
                 launched_resources=to_provision.to_yaml_config(),
                 is_tpu=to_provision.tpu is not None,
-                price_per_hour=to_provision.price_per_hour)
+                price_per_hour=to_provision.price_per_hour,
+                provider_config={
+                    'zone': zone,
+                    'namespace': deploy_vars.get('namespace'),
+                })
             os.makedirs(runtime_dir(cluster_name), exist_ok=True)
             try:
                 self._post_provision_setup(handle)
@@ -203,7 +207,8 @@ class TpuGangBackend(Backend):
 
     def _cluster_info(self, handle: ClusterHandle) -> provision_common.ClusterInfo:
         return provision_lib.get_cluster_info(
-            handle.cloud, handle.region, handle.cluster_name_on_cloud)
+            handle.cloud, handle.region, handle.cluster_name_on_cloud,
+            provider_config=handle.provider_config)
 
     def _runner_spec_for(self, handle: ClusterHandle,
                          inst: provision_common.InstanceInfo,
@@ -349,6 +354,11 @@ class TpuGangBackend(Backend):
         os.makedirs(log_dir, exist_ok=True)
         table.set_log_dir(job_id, log_dir)
 
+        # The nonce ties this driver to THIS incarnation of the cluster
+        # runtime dir: a stale driver surviving a teardown+relaunch (same
+        # cluster name) must not execute the new spec or write into the
+        # new job table.
+        nonce = common_utils.random_id()
         spec = {
             'cluster_name': handle.cluster_name,
             'num_nodes': handle.num_nodes,
@@ -359,6 +369,7 @@ class TpuGangBackend(Backend):
             'setup': task.setup if include_setup else None,
             'run': task.run if isinstance(task.run, str) else None,
             'workdir_on_worker': workdir_on_worker,
+            'nonce': nonce,
         }
         with open(os.path.join(log_dir, 'spec.json'), 'w',
                   encoding='utf-8') as f:
@@ -368,6 +379,7 @@ class TpuGangBackend(Backend):
         driver_cmd = [
             sys.executable, '-m', 'skypilot_tpu.agent.driver',
             '--cluster-dir', cdir, '--job-id', str(job_id),
+            '--nonce', nonce,
         ]
         env = dict(os.environ)
         env['PYTHONPATH'] = (os.path.dirname(os.path.dirname(__file__)) +
@@ -436,14 +448,16 @@ class TpuGangBackend(Backend):
         except Exception:  # noqa: BLE001 — teardown must not fail on this
             pass
         if terminate:
-            provision_lib.terminate_instances(handle.cloud,
-                                              handle.cluster_name_on_cloud)
+            provision_lib.terminate_instances(
+                handle.cloud, handle.cluster_name_on_cloud,
+                provider_config=handle.provider_config)
             global_user_state.remove_cluster(handle.cluster_name)
             shutil.rmtree(runtime_dir(handle.cluster_name),
                           ignore_errors=True)
         else:
-            provision_lib.stop_instances(handle.cloud,
-                                         handle.cluster_name_on_cloud)
+            provision_lib.stop_instances(
+                handle.cloud, handle.cluster_name_on_cloud,
+                provider_config=handle.provider_config)
             global_user_state.update_cluster_status(
                 handle.cluster_name, global_user_state.ClusterStatus.STOPPED)
 
@@ -456,7 +470,8 @@ class TpuGangBackend(Backend):
             return None
         handle = ClusterHandle.from_dict(record['handle'])
         statuses = provision_lib.query_instances(
-            handle.cloud, handle.cluster_name_on_cloud)
+            handle.cloud, handle.cluster_name_on_cloud,
+            provider_config=handle.provider_config)
         if not statuses:
             # All instances gone: preempted or externally deleted.
             global_user_state.remove_cluster(cluster_name)

@@ -27,9 +27,15 @@ def server_url() -> str:
     return os.environ.get('SKYTPU_API_SERVER_URL', DEFAULT_SERVER_URL)
 
 
+def _headers() -> Dict[str, str]:
+    token = os.environ.get('SKYTPU_API_TOKEN')
+    return {'Authorization': f'Bearer {token}'} if token else {}
+
+
 def api_info() -> Dict[str, Any]:
     try:
-        r = requests_lib.get(f'{server_url()}/health', timeout=5)
+        r = requests_lib.get(f'{server_url()}/health', timeout=5,
+                             headers=_headers())
         return r.json()
     except requests_lib.RequestException as e:
         raise exceptions.ApiServerConnectionError(server_url(), str(e)) from e
@@ -64,7 +70,7 @@ def ensure_server(timeout: float = 20.0) -> None:
 
 def _post(path: str, payload: Dict[str, Any]) -> str:
     r = requests_lib.post(f'{server_url()}/api/v1/{path}', json=payload,
-                          timeout=30)
+                          timeout=30, headers=_headers())
     body = r.json()
     if r.status_code != 200:
         raise exceptions.SkyTpuError(body.get('error', r.text))
@@ -73,7 +79,7 @@ def _post(path: str, payload: Dict[str, Any]) -> str:
 
 def _get(path: str, params: Dict[str, Any]) -> str:
     r = requests_lib.get(f'{server_url()}/api/v1/{path}', params=params,
-                         timeout=30)
+                         timeout=30, headers=_headers())
     body = r.json()
     if r.status_code != 200:
         raise exceptions.SkyTpuError(body.get('error', r.text))
@@ -86,7 +92,7 @@ def get(request_id: str, timeout: float = 600.0) -> Any:
     r = requests_lib.get(f'{server_url()}/api/v1/api/get',
                          params={'request_id': request_id,
                                  'timeout': str(timeout)},
-                         timeout=timeout + 10)
+                         timeout=timeout + 10, headers=_headers())
     body = r.json()
     if r.status_code == 202:
         raise TimeoutError(f'request {request_id} still {body.get("status")}')
@@ -103,7 +109,7 @@ def stream_and_get(request_id: str, timeout: float = 600.0,
     with requests_lib.get(
             f'{server_url()}/api/v1/api/stream',
             params={'request_id': request_id}, stream=True,
-            timeout=timeout) as r:
+            timeout=timeout, headers=_headers()) as r:
         for raw in r.iter_lines():
             if not raw:
                 continue
@@ -124,13 +130,14 @@ def stream_and_get(request_id: str, timeout: float = 600.0,
 def launch(task: Task, cluster_name: Optional[str] = None,
            retry_until_up: bool = False,
            idle_minutes_to_autostop: Optional[int] = None,
-           down: bool = False) -> str:
+           down: bool = False, detach_run: bool = True) -> str:
     return _post('launch', {
         'task': task.to_yaml_config(),
         'cluster_name': cluster_name,
         'retry_until_up': retry_until_up,
         'idle_minutes_to_autostop': idle_minutes_to_autostop,
         'down': down,
+        'detach_run': detach_run,
     })
 
 
@@ -203,6 +210,16 @@ def jobs_cancel(job_id: int) -> str:
     return _post('jobs/cancel', {'job_id': job_id})
 
 
+def api_cancel(request_id: str) -> bool:
+    """Cancel an in-flight API request: kills its runner process group
+    server-side (reference: ``sky api cancel``)."""
+    r = requests_lib.post(f'{server_url()}/api/v1/api/cancel',
+                          json={'request_id': request_id}, timeout=10,
+                          headers=_headers())
+    return bool(r.json().get('cancelled'))
+
+
 def api_requests() -> List[Dict[str, Any]]:
-    r = requests_lib.get(f'{server_url()}/api/v1/api/requests', timeout=10)
+    r = requests_lib.get(f'{server_url()}/api/v1/api/requests', timeout=10,
+                         headers=_headers())
     return r.json()

@@ -36,15 +36,19 @@ def sign_request(method: str, host: str, path: str,
                  access_key: str, secret_key: str,
                  region: str, service: str = 's3',
                  now: Optional[datetime.datetime] = None,
-                 sign_payload_header: bool = True) -> Dict[str, str]:
+                 sign_payload_header: bool = True,
+                 payload_hash: Optional[str] = None) -> Dict[str, str]:
     """Returns ``headers`` augmented with Authorization + x-amz-* headers.
 
     ``sign_payload_header``: S3 requires ``x-amz-content-sha256``; other
-    services (and the published doc test vector) omit it."""
+    services (and the published doc test vector) omit it.
+    ``payload_hash``: precomputed sha256 hexdigest — lets callers stream
+    large bodies instead of holding them in memory."""
     now = now or datetime.datetime.now(datetime.timezone.utc)
     amz_date = now.strftime('%Y%m%dT%H%M%SZ')
     datestamp = now.strftime('%Y%m%d')
-    payload_hash = _sha256(payload)
+    if payload_hash is None:
+        payload_hash = _sha256(payload)
 
     all_headers = dict(headers)
     all_headers['host'] = host

@@ -203,11 +203,10 @@ class GcsStore(AbstractStore):
             self._upload_file(local_path, dest)
 
     def _upload_file(self, path: str, obj_rel: str) -> None:
-        with open(path, 'rb') as f:
-            data = f.read()
-        self.transport.upload_media(
-            f'{self.UPLOAD_API}/b/{self.bucket}/o', data,
-            params={'uploadType': 'media', 'name': self._obj(obj_rel)})
+        with open(path, 'rb') as f:  # streamed, not buffered
+            self.transport.upload_media(
+                f'{self.UPLOAD_API}/b/{self.bucket}/o', f,
+                params={'uploadType': 'media', 'name': self._obj(obj_rel)})
 
     def download(self, local_path: str, src_rel: str = '') -> None:
         """Download an object (or all objects under a prefix) to a local
@@ -218,18 +217,16 @@ class GcsStore(AbstractStore):
             raise exceptions.StorageBucketGetError(f'{self.url}/{src_rel}')
         single = len(names) == 1 and names[0] == (src_rel or names[0])
         for name in names:
-            data = self.transport.download_media(
-                f'{self.API}/b/{self.bucket}/o/'
-                f'{self._quote(self._obj(name))}',
-                params={'alt': 'media'})
             if single and name == src_rel:
                 dst = local_path
             else:
                 rel = name[len(src_rel):].lstrip('/') if src_rel else name
                 dst = os.path.join(local_path, rel)
             os.makedirs(os.path.dirname(dst) or '.', exist_ok=True)
-            with open(dst, 'wb') as f:
-                f.write(data)
+            self.transport.download_media_to(
+                f'{self.API}/b/{self.bucket}/o/'
+                f'{self._quote(self._obj(name))}', dst,
+                params={'alt': 'media'})
 
     def delete(self) -> None:
         for name in self.list_objects():
@@ -271,10 +268,19 @@ class S3Store(AbstractStore):
             self.base_path = ''
 
     @staticmethod
-    def _requests_http(method, url, headers, data):
+    def _requests_http(method, url, headers, data, stream_to=None):
         import requests
+        if stream_to is not None:
+            with requests.request(method, url, headers=headers, data=data,
+                                  timeout=3600, stream=True) as resp:
+                if resp.status_code < 400:
+                    with open(stream_to, 'wb') as f:
+                        for chunk in resp.iter_content(chunk_size=1 << 20):
+                            f.write(chunk)
+                    return resp.status_code, b''
+                return resp.status_code, resp.content
         resp = requests.request(method, url, headers=headers, data=data,
-                                timeout=300)
+                                timeout=3600)
         return resp.status_code, resp.content
 
     def _creds(self) -> Tuple[str, str]:
@@ -288,22 +294,47 @@ class S3Store(AbstractStore):
 
     def _request(self, method: str, key: str = '',
                  params: Optional[Dict[str, str]] = None,
-                 data: bytes = b'',
-                 allow_404: bool = False) -> Tuple[int, bytes]:
+                 data=b'',
+                 allow_404: bool = False,
+                 stream_to: Optional[str] = None) -> Tuple[int, bytes]:
+        """``data`` may be bytes or an open binary file (streamed upload:
+        the sha256 is computed in a chunked pre-pass so multi-GB checkpoint
+        shards never sit in memory); ``stream_to`` downloads straight to a
+        file."""
+        import hashlib
         from urllib.parse import quote
 
         from skypilot_tpu.data import aws_sigv4
         ak, sk = self._creds()
         path = self.base_path + ('/' + key if key else '/')
         params = params or {}
+        payload_hash = None
+        if hasattr(data, 'read'):
+            h = hashlib.sha256()
+            for chunk in iter(lambda: data.read(1 << 20), b''):
+                h.update(chunk)
+            payload_hash = h.hexdigest()
+            data.seek(0)
+            sign_payload = b''
+        else:
+            sign_payload = data
         headers = aws_sigv4.sign_request(
-            method, self.host, path, params, {}, data, ak, sk, self.region)
+            method, self.host, path, params, {}, sign_payload, ak, sk,
+            self.region, payload_hash=payload_hash)
         qs = '&'.join(f'{quote(str(k), safe="-_.~")}='
                       f'{quote(str(v), safe="-_.~")}'
                       for k, v in sorted(params.items()))
         url = (f'https://{self.host}{quote(path, safe="/-_.~")}'
                + (f'?{qs}' if qs else ''))
-        status, content = self._http(method, url, headers, data)
+        try:
+            status, content = self._http(method, url, headers, data,
+                                         stream_to=stream_to)
+        except TypeError:  # older injected http without stream support
+            status, content = self._http(method, url, headers, data)
+            if stream_to is not None and status < 400:
+                with open(stream_to, 'wb') as f:
+                    f.write(content)
+                content = b''
         if status >= 400 and not (allow_404 and status == 404):
             # A PUT hitting 404 (NoSuchBucket) must NOT look like success —
             # a silently dropped upload is lost checkpoint data.
@@ -355,11 +386,11 @@ class S3Store(AbstractStore):
                     rel = os.path.relpath(full, local_path)
                     obj = os.path.join(dest_rel, rel) if dest_rel else rel
                     with open(full, 'rb') as fh:
-                        self._request('PUT', self._obj(obj), data=fh.read())
+                        self._request('PUT', self._obj(obj), data=fh)
         else:
             dest = dest_rel or os.path.basename(local_path)
             with open(local_path, 'rb') as fh:
-                self._request('PUT', self._obj(dest), data=fh.read())
+                self._request('PUT', self._obj(dest), data=fh)
 
     def download(self, local_path: str, src_rel: str = '') -> None:
         local_path = os.path.expanduser(local_path)
@@ -368,18 +399,16 @@ class S3Store(AbstractStore):
             raise exceptions.StorageBucketGetError(f'{self.url}/{src_rel}')
         single = len(names) == 1 and names[0] == (src_rel or names[0])
         for name in names:
-            status, data = self._request('GET', self._obj(name),
-                                         allow_404=True)
-            if status == 404:
-                raise exceptions.StorageBucketGetError(f'{self.url}/{name}')
             if single and name == src_rel:
                 dst = local_path
             else:
                 rel = name[len(src_rel):].lstrip('/') if src_rel else name
                 dst = os.path.join(local_path, rel)
             os.makedirs(os.path.dirname(dst) or '.', exist_ok=True)
-            with open(dst, 'wb') as f:
-                f.write(data)
+            status, _ = self._request('GET', self._obj(name),
+                                      allow_404=True, stream_to=dst)
+            if status == 404:
+                raise exceptions.StorageBucketGetError(f'{self.url}/{name}')
 
     def delete(self) -> None:
         for name in self.list_objects():

@@ -5,44 +5,35 @@ Reference analog: ``sky/jobs/`` — the public verbs (`launch`, `queue`,
 """
 from __future__ import annotations
 
-import os
-import subprocess
-import sys
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.jobs import state
 from skypilot_tpu.task import Task
-
-MAX_CONCURRENT_CONTROLLERS = 16
 
 
 def launch(task: Task, name: Optional[str] = None,
            recovery_strategy: str = 'FAILOVER',
            max_restarts_on_errors: int = 0,
            _in_process: bool = False) -> int:
-    """Submit a managed job; returns the managed job id.
+    """Submit a managed job; returns the managed job id immediately.
 
-    Admission control (reference ``jobs/scheduler.py:266``): bounded number
-    of live controllers; beyond that jobs stay PENDING until slots free
-    (round 1: submission fails fast instead of queuing a waiting pool).
-    """
-    if state.count_nonterminal() >= MAX_CONCURRENT_CONTROLLERS:
-        raise RuntimeError(
-            f'Too many active managed jobs (>{MAX_CONCURRENT_CONTROLLERS}).')
+    Admission control (reference ``jobs/scheduler.py:266``): jobs enter a
+    WAITING pool; a bounded number of controllers run at once, each as a
+    task on the jobs-controller cluster (survives this client)."""
     job_id = state.submit(name or task.name, task.to_yaml_config(),
                           recovery_strategy=recovery_strategy,
                           max_restarts_on_errors=max_restarts_on_errors)
     state.set_status(job_id, state.ManagedJobStatus.SUBMITTED)
     if _in_process:
         from skypilot_tpu.jobs.controller import JobController
-        JobController(job_id).run()
+        state.set_schedule_state(job_id, state.ScheduleState.ALIVE)
+        try:
+            JobController(job_id).run()
+        finally:
+            state.set_schedule_state(job_id, state.ScheduleState.DONE)
     else:
-        env = dict(os.environ)
-        subprocess.Popen(
-            [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
-             '--job-id', str(job_id)],
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
-            start_new_session=True)
+        from skypilot_tpu.jobs import scheduler
+        scheduler.submit_job(job_id)
     return job_id
 
 

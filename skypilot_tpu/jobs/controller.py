@@ -55,7 +55,8 @@ class JobController:
         handle = ClusterHandle.from_dict(record['handle'])
         try:
             statuses = provision_lib.query_instances(
-                handle.cloud, handle.cluster_name_on_cloud)
+                handle.cloud, handle.cluster_name_on_cloud,
+                provider_config=handle.provider_config)
         except exceptions.SkyTpuError:
             return False
         running = [s for s in statuses.values() if s == 'running']
@@ -154,8 +155,14 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--job-id', type=int, required=True)
     args = parser.parse_args()
+    from skypilot_tpu.jobs import scheduler
     state.set_controller_pid(args.job_id, os.getpid())
-    JobController(args.job_id).run()
+    scheduler.controller_started(args.job_id)
+    try:
+        JobController(args.job_id).run()
+    finally:
+        # Frees the admission slot and pulls the next WAITING job.
+        scheduler.controller_finished(args.job_id)
 
 
 if __name__ == '__main__':

@@ -43,6 +43,16 @@ _TERMINAL = {
     ManagedJobStatus.CANCELLED,
 }
 
+class ScheduleState(enum.Enum):
+    """Controller admission states (reference: ``ManagedJobScheduleState``,
+    ``sky/jobs/state.py:593``): WAITING in the pool -> LAUNCHING (controller
+    being started) -> ALIVE (controller running) -> DONE."""
+    WAITING = 'WAITING'
+    LAUNCHING = 'LAUNCHING'
+    ALIVE = 'ALIVE'
+    DONE = 'DONE'
+
+
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS managed_jobs (
     job_id INTEGER PRIMARY KEY AUTOINCREMENT,
@@ -57,7 +67,9 @@ CREATE TABLE IF NOT EXISTS managed_jobs (
     started_at REAL,
     ended_at REAL,
     last_event TEXT,
-    controller_pid INTEGER
+    controller_pid INTEGER,
+    schedule_state TEXT DEFAULT 'WAITING',
+    schedule_state_at REAL
 );
 CREATE TABLE IF NOT EXISTS managed_job_events (
     id INTEGER PRIMARY KEY AUTOINCREMENT,
@@ -81,6 +93,14 @@ def _conn() -> sqlite3.Connection:
     conn = sqlite3.connect(_db_path(), timeout=10)
     conn.row_factory = sqlite3.Row
     conn.executescript(_SCHEMA)
+    # Migration for databases created before schedule_state existed.
+    for ddl in ("ALTER TABLE managed_jobs ADD COLUMN schedule_state "
+                "TEXT DEFAULT 'WAITING'",
+                'ALTER TABLE managed_jobs ADD COLUMN schedule_state_at REAL'):
+        try:
+            conn.execute(ddl)
+        except sqlite3.OperationalError:
+            pass  # already present
     return conn
 
 
@@ -182,6 +202,46 @@ def events(job_id: int) -> List[Dict[str, Any]]:
             'SELECT * FROM managed_job_events WHERE job_id = ? ORDER BY id',
             (job_id,)).fetchall()
         return [dict(r) for r in rows]
+
+
+def set_schedule_state(job_id: int, sched: ScheduleState) -> None:
+    with _lock(), _conn() as conn:
+        conn.execute(
+            'UPDATE managed_jobs SET schedule_state = ?, '
+            'schedule_state_at = ? WHERE job_id = ?',
+            (sched.value, time.time(), job_id))
+
+
+def stale_launching_jobs(older_than_s: float) -> List[int]:
+    """LAUNCHING jobs whose controller never reported in (crashed between
+    task submission and controller_started): candidates for reconciliation
+    so they do not leak admission slots forever."""
+    cutoff = time.time() - older_than_s
+    with _conn() as conn:
+        rows = conn.execute(
+            'SELECT job_id FROM managed_jobs WHERE schedule_state = ? AND '
+            '(schedule_state_at IS NULL OR schedule_state_at < ?)',
+            (ScheduleState.LAUNCHING.value, cutoff)).fetchall()
+        return [int(r['job_id']) for r in rows]
+
+
+def count_live_controllers() -> int:
+    with _conn() as conn:
+        row = conn.execute(
+            'SELECT COUNT(*) AS c FROM managed_jobs WHERE schedule_state '
+            'IN (?, ?)', (ScheduleState.LAUNCHING.value,
+                          ScheduleState.ALIVE.value)).fetchone()
+        return int(row['c'])
+
+
+def next_waiting() -> Optional[int]:
+    """Oldest job still in the WAITING pool (FIFO admission)."""
+    with _conn() as conn:
+        row = conn.execute(
+            'SELECT job_id FROM managed_jobs WHERE schedule_state = ? '
+            'ORDER BY job_id LIMIT 1',
+            (ScheduleState.WAITING.value,)).fetchone()
+        return int(row['job_id']) if row else None
 
 
 def count_nonterminal() -> int:

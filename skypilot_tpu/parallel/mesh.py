@@ -90,8 +90,24 @@ def build_mesh(spec: Optional[MeshSpec] = None,
         dcn_parallelism[0] = num_slices
         ici_shape = list(shape)
         ici_shape[0] //= num_slices
-        device_array = mesh_utils.create_hybrid_device_mesh(
-            tuple(ici_shape), tuple(dcn_parallelism), devices=devices)
+        try:
+            device_array = mesh_utils.create_hybrid_device_mesh(
+                tuple(ici_shape), tuple(dcn_parallelism), devices=devices)
+        except (ValueError, AssertionError):
+            # Virtual CPU devices carry no slice_index; emulate the DCN
+            # grouping with contiguous device blocks so multislice programs
+            # compile/execute in the 8-device CPU dryrun. Real TPU slices
+            # take the mesh_utils path above.
+            if n % num_slices:
+                raise
+            per_slice = n // num_slices
+            groups = [
+                mesh_utils.create_device_mesh(
+                    tuple(ici_shape),
+                    devices=devices[i * per_slice:(i + 1) * per_slice])
+                for i in range(num_slices)
+            ]
+            device_array = np.stack(groups, axis=0).reshape(shape)
     else:
         device_array = mesh_utils.create_device_mesh(shape, devices=devices)
     return Mesh(device_array, AXIS_ORDER)

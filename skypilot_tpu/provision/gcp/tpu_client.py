@@ -56,26 +56,31 @@ class Transport:
             raise GcpApiError(resp.status_code, resp.text)
         return resp.json() if resp.text else {}
 
-    def upload_media(self, url: str, data: bytes,
+    def upload_media(self, url: str, data,
                      params: Optional[Dict[str, str]] = None
                      ) -> Dict[str, Any]:
-        """Raw-bytes POST (GCS JSON media upload)."""
+        """Raw POST (GCS JSON media upload). ``data`` may be bytes or an
+        open binary file — file objects are streamed (multi-GB checkpoint
+        shards must not be buffered in memory)."""
         headers = {'Authorization': f'Bearer {self._token_provider()}',
                    'Content-Type': 'application/octet-stream'}
         resp = requests.post(url, headers=headers, data=data, params=params,
-                             timeout=300)
+                             timeout=3600)
         if resp.status_code >= 400:
             raise GcpApiError(resp.status_code, resp.text)
         return resp.json() if resp.text else {}
 
-    def download_media(self, url: str,
-                       params: Optional[Dict[str, str]] = None) -> bytes:
-        """Raw-bytes GET (GCS ``alt=media``)."""
+    def download_media_to(self, url: str, dst_path: str,
+                          params: Optional[Dict[str, str]] = None) -> None:
+        """Streamed GET (GCS ``alt=media``) straight to a file."""
         headers = {'Authorization': f'Bearer {self._token_provider()}'}
-        resp = requests.get(url, headers=headers, params=params, timeout=300)
-        if resp.status_code >= 400:
-            raise GcpApiError(resp.status_code, resp.text)
-        return resp.content
+        with requests.get(url, headers=headers, params=params, timeout=3600,
+                          stream=True) as resp:
+            if resp.status_code >= 400:
+                raise GcpApiError(resp.status_code, resp.text)
+            with open(dst_path, 'wb') as f:
+                for chunk in resp.iter_content(chunk_size=1 << 20):
+                    f.write(chunk)
 
 
 class GcpApiError(exceptions.SkyTpuError):

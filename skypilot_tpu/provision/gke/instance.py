@@ -138,13 +138,20 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
         created_instance_ids=created, resumed_instance_ids=[])
 
 
+def _ns_of(provider_config: Optional[Dict[str, Any]]) -> Optional[str]:
+    if provider_config and provider_config.get('namespace'):
+        return provider_config['namespace']
+    return None  # _client falls back to SKYTPU_GKE_NAMESPACE
+
+
 def wait_instances(region: str, cluster_name_on_cloud: str, state: str,
-                   timeout: float = 600.0, poll: float = 3.0) -> None:
+                   timeout: float = 600.0, poll: float = 3.0,
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
     """Wait until every pod is Running. Unschedulable pods (no TPU node
     pool capacity) surface as QuotaExceededError so the backend fails over
     — the k8s analog of a TPU stockout."""
     del region, state
-    client = _client()
+    client = _client(_ns_of(provider_config))
     deadline = time.time() + timeout
     while True:
         pods = client.list_pods(f'{LABEL_CLUSTER}={cluster_name_on_cloud}')
@@ -188,7 +195,7 @@ def stop_instances(cluster_name_on_cloud: str,
 def terminate_instances(cluster_name_on_cloud: str,
                         provider_config: Optional[Dict[str, Any]] = None
                         ) -> None:
-    _cleanup(_client(), cluster_name_on_cloud)
+    _cleanup(_client(_ns_of(provider_config)), cluster_name_on_cloud)
 
 
 _PHASE_MAP = {
@@ -203,7 +210,7 @@ _PHASE_MAP = {
 def query_instances(cluster_name_on_cloud: str,
                     provider_config: Optional[Dict[str, Any]] = None
                     ) -> Dict[str, Optional[str]]:
-    client = _client()
+    client = _client(_ns_of(provider_config))
     out: Dict[str, Optional[str]] = {}
     for pod in client.list_pods(f'{LABEL_CLUSTER}={cluster_name_on_cloud}'):
         out[pod['metadata']['name']] = _PHASE_MAP.get(
@@ -214,7 +221,7 @@ def query_instances(cluster_name_on_cloud: str,
 def get_cluster_info(region: str, cluster_name_on_cloud: str,
                      provider_config: Optional[Dict[str, Any]] = None
                      ) -> common.ClusterInfo:
-    client = _client()
+    client = _client(_ns_of(provider_config))
     instances: List[common.InstanceInfo] = []
     for pod in client.list_pods(f'{LABEL_CLUSTER}={cluster_name_on_cloud}'):
         if pod.get('status', {}).get('phase') != 'Running':

@@ -17,7 +17,11 @@ from skypilot_tpu.utils import common_utils
 
 def up(task: Task, service_name: str,
        _in_process: bool = False) -> str:
-    """Start a service; returns the LB endpoint."""
+    """Start a service; returns the LB endpoint.
+
+    The controller+LB run as a task on the serve-controller cluster
+    (reference: ``sky-serve-controller.yaml.j2`` — the controller is itself
+    a framework task), so the service survives this client process."""
     if task.service is None:
         raise ValueError('Task has no `service:` section.')
     spec: ServiceSpec = task.service
@@ -26,26 +30,52 @@ def up(task: Task, service_name: str,
             serve_state.ServiceStatus.SHUTDOWN,
             serve_state.ServiceStatus.FAILED):
         raise ValueError(f'Service {service_name!r} already exists.')
-    lb_port = common_utils.find_free_port(30000)
     serve_state.add_service(service_name, spec.to_yaml_config(),
                             task.to_yaml_config())
     if _in_process:
         from skypilot_tpu.serve.controller import ServeController
         import threading
+        lb_port = common_utils.find_free_port(30000)
         controller = ServeController(service_name, lb_port)
         t = threading.Thread(target=controller.run, daemon=True)
         t.start()
         up._controllers[service_name] = controller  # type: ignore[attr-defined]
-    else:
-        subprocess.Popen(
-            [sys.executable, '-m', 'skypilot_tpu.serve.controller',
-             '--service-name', service_name, '--lb-port', str(lb_port)],
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-            env=dict(os.environ), start_new_session=True)
-    return f'127.0.0.1:{lb_port}'
+        return f'{common_utils.advertise_host()}:{lb_port}'
+    # The controller task picks its own port on ITS host (--lb-port 0) and
+    # records the endpoint in serve state; wait for it to appear.
+    from skypilot_tpu.utils import controller_utils
+    controller_utils.launch_controller_task(
+        'skypilot_tpu.serve.controller',
+        f'--service-name {service_name} --lb-port 0',
+        job_name=f'serve-controller-{service_name}',
+        cluster_name=controller_utils.SERVE_CONTROLLER_CLUSTER)
+    import time as time_lib
+    deadline = time_lib.time() + 120
+    while time_lib.time() < deadline:
+        record = serve_state.get_service(service_name)
+        if record and record['endpoint']:
+            return record['endpoint']
+        time_lib.sleep(0.5)
+    return '(pending — see `serve status`)'
 
 
 up._controllers = {}  # in-process controllers for tests
+
+
+def update(task: Task, service_name: str) -> int:
+    """Rolling update: register a new service version; the controller
+    surges new-version replicas and drains old ones without dropping ready
+    capacity (reference: ``sky/serve/replica_managers.py:447-537``)."""
+    if task.service is None:
+        raise ValueError('Task has no `service:` section.')
+    record = serve_state.get_service(service_name)
+    if record is None or record['status'] in (
+            serve_state.ServiceStatus.SHUTDOWN,
+            serve_state.ServiceStatus.FAILED):
+        raise ValueError(f'Service {service_name!r} is not running.')
+    spec: ServiceSpec = task.service
+    return serve_state.bump_service_version(
+        service_name, spec.to_yaml_config(), task.to_yaml_config())
 
 
 def down(service_name: str) -> None:

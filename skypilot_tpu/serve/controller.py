@@ -39,9 +39,11 @@ class ServeController:
         self._stop.set()
 
     def run(self) -> None:
+        from skypilot_tpu.utils import common_utils
+        advertise = common_utils.advertise_host()
         serve_state.set_service_status(
             self.service_name, serve_state.ServiceStatus.REPLICA_INIT,
-            endpoint=f'127.0.0.1:{self.lb.port}')
+            endpoint=f'{advertise}:{self.lb.port}')
         self.lb.start_in_thread()
         self.replica_manager.scale_to(self.spec.replica_policy.min_replicas)
         became_ready = False
@@ -51,20 +53,49 @@ class ServeController:
                 if record is None or record['status'] == \
                         serve_state.ServiceStatus.SHUTTING_DOWN:
                     break
+                # Rolling update: a version bump (serve.update) swaps the
+                # spec/task for new launches and drains old replicas.
+                version = int(record.get('version') or 1)
+                if version != self.replica_manager.version:
+                    self.spec = ServiceSpec.from_yaml_config(record['spec'])
+                    self.task = Task.from_yaml_config(record['task_config'])
+                    self.replica_manager.set_version(version, self.spec,
+                                                     self.task)
+                    # The new spec's policies take effect immediately: the
+                    # autoscaler and LB policy are rebuilt, not just the
+                    # replica launches.
+                    self.autoscaler = make_autoscaler(self.spec.replica_policy)
+                    from skypilot_tpu.serve.load_balancing_policies import \
+                        make_policy
+                    self.lb.policy = make_policy(
+                        self.spec.load_balancing_policy)
+                num_ready_now = len(self.lb.policy.replicas)
+                decision = self.autoscaler.evaluate(
+                    num_ready=num_ready_now,
+                    num_launching=(self.replica_manager.num_alive()
+                                   - num_ready_now),
+                    request_times=self.lb.drain_request_times())
+                target = decision.target_num_replicas
+                # Rolling step BEFORE probe/set_replicas: a replica retired
+                # here is excluded from this very tick's LB set, minimizing
+                # the stale-endpoint window.
+                self.replica_manager.maybe_rolling_update(target)
                 ready = self.replica_manager.probe_all()
                 self.lb.set_replicas(ready)
                 if ready and not became_ready:
                     became_ready = True
                     serve_state.set_service_status(
                         self.service_name, serve_state.ServiceStatus.READY)
-                decision = self.autoscaler.evaluate(
-                    num_ready=len(ready),
-                    num_launching=self.replica_manager.num_alive() - len(ready),
-                    request_times=self.lb.drain_request_times())
-                if decision.target_num_replicas != \
-                        self.replica_manager.num_alive():
-                    self.replica_manager.scale_to(
-                        decision.target_num_replicas)
+                live_statuses = (serve_state.ReplicaStatus.PROVISIONING,
+                                 serve_state.ReplicaStatus.STARTING,
+                                 serve_state.ReplicaStatus.READY,
+                                 serve_state.ReplicaStatus.NOT_READY)
+                rolling = any(
+                    int(r.get('version') or 1) < self.replica_manager.version
+                    for r in serve_state.list_replicas(self.service_name)
+                    if r['status'] in live_statuses)
+                if target != self.replica_manager.num_alive() and not rolling:
+                    self.replica_manager.scale_to(target)
                 self._stop.wait(self.poll_seconds)
         finally:
             self.replica_manager.teardown_all()
@@ -76,9 +107,15 @@ class ServeController:
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--service-name', required=True)
-    parser.add_argument('--lb-port', type=int, required=True)
+    # 0 = pick a free port HERE: when the controller runs on a remote
+    # controller cluster, the client cannot know this host's free ports.
+    parser.add_argument('--lb-port', type=int, default=0)
     args = parser.parse_args()
-    ServeController(args.service_name, args.lb_port).run()
+    port = args.lb_port
+    if port == 0:
+        from skypilot_tpu.utils import common_utils
+        port = common_utils.find_free_port(30000)
+    ServeController(args.service_name, port).run()
 
 
 if __name__ == '__main__':

@@ -83,7 +83,9 @@ class LoadBalancer:
             asyncio.set_event_loop(self._loop)
             self._runner = web.AppRunner(self.make_app())
             self._loop.run_until_complete(self._runner.setup())
-            site = web.TCPSite(self._runner, '127.0.0.1', self.port)
+            # Bind all interfaces: the endpoint is advertised with the
+            # host's routable IP (common_utils.advertise_host).
+            site = web.TCPSite(self._runner, '0.0.0.0', self.port)
             self._loop.run_until_complete(site.start())
             started.set()
             self._loop.run_forever()

@@ -18,6 +18,7 @@ import requests as requests_lib
 
 from skypilot_tpu import core, exceptions, execution, global_user_state
 from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import spot_placer as spot_placer_lib
 from skypilot_tpu.serve.service_spec import ServiceSpec
 from skypilot_tpu.task import Task
 from skypilot_tpu.utils import common_utils
@@ -29,10 +30,25 @@ class ReplicaManager:
         self.service_name = service_name
         self.spec = spec
         self.task = task
+        self.version = 1
+        record = serve_state.get_service(service_name)
+        if record is not None:
+            self.version = int(record.get('version') or 1)
         self._next_replica_id = 1 + max(
             [r['replica_id'] for r in
              serve_state.list_replicas(service_name)] or [0])
         self._ready_since: Dict[int, float] = {}
+        self.spot_placer = (
+            spot_placer_lib.DynamicFallbackSpotPlacer()
+            if spec.replica_policy.dynamic_ondemand_fallback else None)
+
+    def set_version(self, version: int, spec: ServiceSpec,
+                    task: Task) -> None:
+        """Adopt a new service version (rolling update: new launches use the
+        new spec/task; old-version replicas drain via maybe_rolling_update)."""
+        self.version = version
+        self.spec = spec
+        self.task = task
 
     def _cluster_name(self, replica_id: int) -> str:
         return f'sv-{self.service_name}-r{replica_id}'
@@ -45,8 +61,14 @@ class ReplicaManager:
         cluster = self._cluster_name(replica_id)
         serve_state.upsert_replica(self.service_name, replica_id,
                                    serve_state.ReplicaStatus.PROVISIONING,
-                                   cluster_name=cluster)
+                                   cluster_name=cluster,
+                                   version=self.version)
         task = Task.from_yaml_config(self.task.to_yaml_config())
+        if self.spot_placer is not None:
+            # Spot with dynamic on-demand fallback under preemption pressure.
+            use_spot = self.spot_placer.use_spot()
+            task.set_resources([
+                r.copy(use_spot=use_spot) for r in task.resources_ordered])
         is_local = any(r.cloud in ('local', 'fake') or r.cloud is None
                        for r in task.resources_ordered)
         port = (common_utils.find_free_port(20000 + replica_id * 17)
@@ -137,9 +159,41 @@ class ReplicaManager:
                     serve_state.upsert_replica(
                         self.service_name, rid,
                         serve_state.ReplicaStatus.NOT_READY)
+                    if self.spot_placer is not None:
+                        # A READY replica going dark is preemption-shaped.
+                        self.spot_placer.report_preemption()
                     self.terminate_replica(rid, failed=True)
                     self.launch_replica()
         return ready
+
+    # -- rolling update -----------------------------------------------------
+
+    def maybe_rolling_update(self, target: int) -> None:
+        """One step of the rolling update (called every controller tick;
+        reference: versioned replicas + rolling update,
+        ``sky/serve/replica_managers.py:447-537``): surge one new-version
+        replica at a time, and retire an old-version replica only once a
+        new-version one is READY — ready capacity never dips."""
+        reps = [r for r in serve_state.list_replicas(self.service_name)
+                if r['status'] in (serve_state.ReplicaStatus.PROVISIONING,
+                                   serve_state.ReplicaStatus.STARTING,
+                                   serve_state.ReplicaStatus.READY,
+                                   serve_state.ReplicaStatus.NOT_READY)]
+        old = [r for r in reps if int(r.get('version') or 1) < self.version]
+        if not old:
+            return
+        new = [r for r in reps if int(r.get('version') or 1) >= self.version]
+        new_ready = [r for r in new
+                     if r['status'] == serve_state.ReplicaStatus.READY]
+        if len(new) < target and len(reps) <= target:
+            self.launch_replica()  # surge (+1 above target)
+            return
+        if new_ready:
+            # Retire the oldest old-version replica (non-ready first).
+            order = sorted(old, key=lambda r: (
+                r['status'] == serve_state.ReplicaStatus.READY,
+                r['replica_id']))
+            self.terminate_replica(order[0]['replica_id'])
 
     def num_alive(self) -> int:
         alive = {serve_state.ReplicaStatus.PROVISIONING,
@@ -163,7 +217,8 @@ class ReplicaManager:
                     serve_state.ReplicaStatus.STARTING,
                     serve_state.ReplicaStatus.NOT_READY,
                     serve_state.ReplicaStatus.READY)),
-                key=lambda r: (r['status'] == serve_state.ReplicaStatus.READY,
+                key=lambda r: (int(r.get('version') or 1) >= self.version,
+                               r['status'] == serve_state.ReplicaStatus.READY,
                                r['replica_id']))
             for rep in order[:alive - target]:
                 self.terminate_replica(rep['replica_id'])

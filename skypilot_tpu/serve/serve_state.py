@@ -38,7 +38,8 @@ CREATE TABLE IF NOT EXISTS services (
     task_config TEXT NOT NULL,
     endpoint TEXT,
     created_at REAL,
-    controller_pid INTEGER
+    controller_pid INTEGER,
+    version INTEGER DEFAULT 1
 );
 CREATE TABLE IF NOT EXISTS replicas (
     service_name TEXT,
@@ -47,6 +48,7 @@ CREATE TABLE IF NOT EXISTS replicas (
     cluster_name TEXT,
     endpoint TEXT,
     created_at REAL,
+    version INTEGER DEFAULT 1,
     PRIMARY KEY (service_name, replica_id)
 );
 """
@@ -63,6 +65,12 @@ def _conn() -> sqlite3.Connection:
     conn = sqlite3.connect(_db_path(), timeout=10)
     conn.row_factory = sqlite3.Row
     conn.executescript(_SCHEMA)
+    for table in ('services', 'replicas'):  # pre-version DB migration
+        try:
+            conn.execute(f'ALTER TABLE {table} ADD COLUMN version '
+                         'INTEGER DEFAULT 1')
+        except sqlite3.OperationalError:
+            pass
     return conn
 
 
@@ -89,6 +97,23 @@ def set_service_status(name: str, status: ServiceStatus,
         else:
             conn.execute('UPDATE services SET status = ? WHERE name = ?',
                          (status.value, name))
+
+
+def bump_service_version(name: str, spec: Dict[str, Any],
+                         task_config: Dict[str, Any]) -> int:
+    """Record a new service version (rolling update input; reference:
+    versioned replicas in ``sky/serve/replica_managers.py:447-537``)."""
+    with _lock(), _conn() as conn:
+        row = conn.execute('SELECT version FROM services WHERE name = ?',
+                           (name,)).fetchone()
+        if row is None:
+            raise ValueError(f'service {name!r} not found')
+        new_version = int(row['version'] or 1) + 1
+        conn.execute(
+            'UPDATE services SET spec = ?, task_config = ?, version = ? '
+            'WHERE name = ?',
+            (json.dumps(spec), json.dumps(task_config), new_version, name))
+        return new_version
 
 
 def get_service(name: str) -> Optional[Dict[str, Any]]:
@@ -119,7 +144,8 @@ def remove_service(name: str) -> None:
 def upsert_replica(service_name: str, replica_id: int,
                    status: ReplicaStatus,
                    cluster_name: Optional[str] = None,
-                   endpoint: Optional[str] = None) -> None:
+                   endpoint: Optional[str] = None,
+                   version: Optional[int] = None) -> None:
     with _lock(), _conn() as conn:
         existing = conn.execute(
             'SELECT replica_id FROM replicas WHERE service_name = ? AND '
@@ -127,9 +153,10 @@ def upsert_replica(service_name: str, replica_id: int,
         if existing is None:
             conn.execute(
                 'INSERT INTO replicas (service_name, replica_id, status, '
-                'cluster_name, endpoint, created_at) VALUES (?, ?, ?, ?, ?, ?)',
+                'cluster_name, endpoint, created_at, version) '
+                'VALUES (?, ?, ?, ?, ?, ?, ?)',
                 (service_name, replica_id, status.value, cluster_name,
-                 endpoint, time.time()))
+                 endpoint, time.time(), version or 1))
         else:
             sets, args = ['status = ?'], [status.value]
             if cluster_name is not None:
@@ -138,6 +165,9 @@ def upsert_replica(service_name: str, replica_id: int,
             if endpoint is not None:
                 sets.append('endpoint = ?')
                 args.append(endpoint)
+            if version is not None:
+                sets.append('version = ?')
+                args.append(version)
             args += [service_name, replica_id]
             conn.execute(
                 f'UPDATE replicas SET {", ".join(sets)} WHERE '

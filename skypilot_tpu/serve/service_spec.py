@@ -43,6 +43,9 @@ class ReplicaPolicy:
     min_replicas: int = 1
     max_replicas: Optional[int] = None  # None = fixed at min
     target_qps_per_replica: Optional[float] = None
+    # Spot replicas with automatic on-demand fallback under preemption
+    # pressure (reference: ``sky/serve/spot_placer.py:254``).
+    dynamic_ondemand_fallback: bool = False
 
     @property
     def autoscaling(self) -> bool:
@@ -57,7 +60,9 @@ class ReplicaPolicy:
             return cls(min_replicas=cfg)
         return cls(min_replicas=cfg.get('min_replicas', 1),
                    max_replicas=cfg.get('max_replicas'),
-                   target_qps_per_replica=cfg.get('target_qps_per_replica'))
+                   target_qps_per_replica=cfg.get('target_qps_per_replica'),
+                   dynamic_ondemand_fallback=bool(
+                       cfg.get('dynamic_ondemand_fallback', False)))
 
 
 @dataclasses.dataclass
@@ -95,6 +100,8 @@ class ServiceSpec:
                 'max_replicas': self.replica_policy.max_replicas,
                 'target_qps_per_replica':
                     self.replica_policy.target_qps_per_replica,
+                'dynamic_ondemand_fallback':
+                    self.replica_policy.dynamic_ondemand_fallback,
             },
             'port': self.port,
             'load_balancing_policy': self.load_balancing_policy,

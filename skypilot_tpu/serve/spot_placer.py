@@ -1,0 +1,35 @@
+"""Spot placement policy for serve replicas.
+
+Reference analog: ``sky/serve/spot_placer.py`` ``DynamicFallbackSpotPlacer
+(:254)`` — mix spot and on-demand replicas, reacting to preemptions.
+Difference: zone choice already lives in the provision failover loop here
+(blocklists move replicas off bad zones), so the placer decides the one
+thing the failover loop cannot: whether the NEXT replica launch should be
+spot or on-demand, based on recent preemption pressure, decaying back to
+spot when the pressure clears.
+"""
+from __future__ import annotations
+
+import time
+from typing import List
+
+
+class DynamicFallbackSpotPlacer:
+    """Prefer spot; after ``threshold`` preemptions inside ``window_s``,
+    place new replicas on-demand until the window drains."""
+
+    def __init__(self, window_s: float = 600.0, threshold: int = 2):
+        self.window_s = window_s
+        self.threshold = threshold
+        self._preemptions: List[float] = []
+
+    def report_preemption(self) -> None:
+        self._preemptions.append(time.time())
+
+    def _recent(self) -> int:
+        cutoff = time.time() - self.window_s
+        self._preemptions = [t for t in self._preemptions if t > cutoff]
+        return len(self._preemptions)
+
+    def use_spot(self) -> bool:
+        return self._recent() < self.threshold

@@ -12,6 +12,7 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+import threading
 from typing import Any, Dict
 
 from skypilot_tpu.server import requests_db
@@ -35,9 +36,12 @@ def schedule(op: str, payload: Dict[str, Any]) -> str:
     request_id = requests_db.create(op, {'op': op, **payload}, lane=lane)
     log_path = requests_db.request_log_path(request_id)
     with open(log_path, 'ab') as log_file:
-        subprocess.Popen(
+        proc = subprocess.Popen(
             [sys.executable, '-m', 'skypilot_tpu.server.request_runner',
              '--request-id', request_id],
             stdout=log_file, stderr=subprocess.STDOUT,
             env=dict(os.environ), start_new_session=True)
+    # Reap the runner when it exits (otherwise cancelled runners linger as
+    # zombies of the server process).
+    threading.Thread(target=proc.wait, daemon=True).start()
     return request_id

@@ -15,12 +15,15 @@ def _run_op(payload: Dict[str, Any]) -> Any:
         from skypilot_tpu import execution
         from skypilot_tpu.task import Task
         task = Task.from_yaml_config(payload['task'])
+        # detach_run=False keeps this request attached (streaming the job's
+        # log into the request log) until the job finishes — that is what
+        # `/api/stream` + request-cancel operate on for follow-mode launches.
         job_id, handle = execution.launch(
             task, cluster_name=payload.get('cluster_name'),
             retry_until_up=payload.get('retry_until_up', False),
             idle_minutes_to_autostop=payload.get('idle_minutes_to_autostop'),
             down=payload.get('down', False),
-            detach_run=True)
+            detach_run=payload.get('detach_run', True))
         return {'job_id': job_id,
                 'handle': handle.to_dict() if handle else None}
     if op == 'exec':

@@ -140,8 +140,9 @@ def get(request_id: str) -> Optional[Dict[str, Any]]:
 def list_requests(limit: int = 100) -> List[Dict[str, Any]]:
     with _conn() as conn:
         rows = conn.execute(
-            'SELECT request_id, name, status, created_at, finished_at FROM '
-            'requests ORDER BY created_at DESC LIMIT ?', (limit,)).fetchall()
+            'SELECT request_id, name, status, pid, created_at, finished_at '
+            'FROM requests ORDER BY created_at DESC LIMIT ?',
+            (limit,)).fetchall()
         return [dict(r) for r in rows]
 
 

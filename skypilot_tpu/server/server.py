@@ -146,15 +146,34 @@ async def api_cancel(request: web.Request) -> web.Response:
     payload = await request.json()
     pid = requests_db.cancel(payload['request_id'])
     if pid:
+        # Runners start_new_session, so the pid is its process-group leader:
+        # kill the whole group so provisioning/exec children die with it
+        # (reference: executor-side cancel, sky/server/requests/executor.py).
         try:
-            os.kill(pid, 15)
+            os.killpg(pid, 15)
         except (ProcessLookupError, PermissionError):
-            pass
+            try:
+                os.kill(pid, 15)
+            except (ProcessLookupError, PermissionError):
+                pass
     return web.json_response({'cancelled': pid is not None})
 
 
+@web.middleware
+async def auth_middleware(request: web.Request, handler):
+    """Bearer-token auth (reference: ``sky/server/auth/``). Enabled by
+    setting SKYTPU_API_TOKEN on the server; /health stays open so clients
+    can discover they need a token."""
+    token = os.environ.get('SKYTPU_API_TOKEN')
+    if token and request.path != '/health':
+        supplied = request.headers.get('Authorization', '')
+        if supplied != f'Bearer {token}':
+            return web.json_response({'error': 'unauthorized'}, status=401)
+    return await handler(request)
+
+
 def make_app() -> web.Application:
-    app = web.Application()
+    app = web.Application(middlewares=[auth_middleware])
     app.add_routes(routes)
     for op in ('launch', 'exec', 'down', 'stop', 'start', 'autostop',
                'cancel'):

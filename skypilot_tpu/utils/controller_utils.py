@@ -1,0 +1,50 @@
+"""Controllers as tasks.
+
+Reference analog: ``sky/utils/controller_utils.py:117`` + the
+``jobs-controller.yaml.j2`` / ``sky-serve-controller.yaml.j2`` templates —
+the managed-jobs and serve controllers are themselves launched as framework
+tasks on a controller cluster, which is what makes submit-and-forget
+survive the submitting client.
+
+The controller cluster defaults to the ``local`` cloud (in-sandbox: the
+same host; on real infra set ``SKYTPU_CONTROLLER_CLOUD=gcp`` to place it on
+a CPU VM). Its job table gets a raised parallel-slot count so many
+controllers run concurrently (CPU processes, not gang-exclusive TPU jobs).
+"""
+from __future__ import annotations
+
+import os
+import shlex
+import sys
+from typing import Optional
+
+JOBS_CONTROLLER_CLUSTER = 'sky-jobs-controller'
+SERVE_CONTROLLER_CLUSTER = 'sky-serve-controller'
+CONTROLLER_PARALLELISM = 64
+
+
+def controller_cloud() -> str:
+    return os.environ.get('SKYTPU_CONTROLLER_CLOUD', 'local')
+
+
+def launch_controller_task(module: str, args: str, job_name: str,
+                           cluster_name: str) -> int:
+    """Run ``python -m <module> <args>`` as a detached task on the
+    controller cluster; returns the cluster job id."""
+    from skypilot_tpu import execution
+    from skypilot_tpu.backends.tpu_gang_backend import runtime_dir
+    from skypilot_tpu.agent import job_lib
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
+
+    task = Task(
+        job_name,
+        run=f'{shlex.quote(sys.executable)} -m {module} {args}')
+    task.set_resources(Resources(cloud=controller_cloud()))
+    job_id, _ = execution.launch(task, cluster_name=cluster_name,
+                                 detach_run=True)
+    # Controllers are plain CPU processes: widen the cluster's parallel job
+    # slots so they do not serialize behind each other.
+    job_lib.JobTable(runtime_dir(cluster_name)).set_max_parallel(
+        CONTROLLER_PARALLELISM)
+    return job_id

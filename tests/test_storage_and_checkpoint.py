@@ -173,13 +173,16 @@ class FakeGcsTransport:
         raise AssertionError(f'unhandled {method} {url}')
 
     def upload_media(self, url, data, params=None):
+        if hasattr(data, 'read'):  # streamed file objects
+            data = data.read()
         self.objects[params['name']] = data
         return {'name': params['name']}
 
-    def download_media(self, url, params=None):
+    def download_media_to(self, url, dst_path, params=None):
         from urllib.parse import unquote
         name = unquote(url.rsplit('/o/', 1)[1])
-        return self.objects[name]
+        with open(dst_path, 'wb') as f:
+            f.write(self.objects[name])
 
 
 def test_gcs_store_upload_download_roundtrip(tmp_path):
@@ -210,14 +213,22 @@ class FakeS3Http:
         self.objects = {}
         self.requests = []
 
-    def __call__(self, method, url, headers, data):
+    def __call__(self, method, url, headers, data, stream_to=None):
         from urllib.parse import parse_qs, unquote, urlparse
         self.requests.append((method, url, headers))
         assert 'Authorization' in headers and 'AWS4-HMAC-SHA256' in \
             headers['Authorization']
+        if hasattr(data, 'read'):  # streamed file objects
+            data = data.read()
         u = urlparse(url)
         qs = {k: v[0] for k, v in parse_qs(u.query).items()}
         key = unquote(u.path.lstrip('/'))
+        if stream_to is not None and method == 'GET' and 'list-type' not in qs:
+            if key not in self.objects:
+                return 404, b''
+            with open(stream_to, 'wb') as f:
+                f.write(self.objects[key])
+            return 200, b''
         if method == 'GET' and qs.get('list-type') == '2':
             prefix = qs.get('prefix', '')
             names = sorted(n for n in self.objects if n.startswith(prefix))
