@@ -1,0 +1,77 @@
+"""Client for the on-cluster agent gRPC service.
+
+Reference analog: ``SkyletClient`` (``cloud_vm_ray_backend.py:2640``) — the
+backend-side wrapper over the skylet stubs.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional
+
+import grpc
+
+from skypilot_tpu.agent import rpc as rpc_lib
+from skypilot_tpu.schemas.generated import agent_pb2 as pb
+
+
+class AgentClient:
+
+    def __init__(self, address: str, timeout: float = 10.0):
+        self.address = address
+        self.timeout = timeout
+        self._channel = grpc.insecure_channel(address)
+        self._stub = rpc_lib.AgentStub(self._channel)
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def health(self) -> Dict[str, Any]:
+        reply = self._stub.Health(pb.HealthRequest(), timeout=self.timeout)
+        return {'version': reply.version, 'uptime_s': reply.uptime_s}
+
+    def list_jobs(self, limit: int = 200) -> List[Dict[str, Any]]:
+        reply = self._stub.ListJobs(pb.ListJobsRequest(limit=limit),
+                                    timeout=self.timeout)
+        return [self._job_dict(j) for j in reply.jobs]
+
+    def get_job(self, job_id: int) -> Optional[Dict[str, Any]]:
+        try:
+            return self._job_dict(
+                self._stub.GetJob(pb.GetJobRequest(job_id=job_id),
+                                  timeout=self.timeout))
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.NOT_FOUND:
+                return None
+            raise
+
+    def cancel_job(self, job_id: int) -> bool:
+        reply = self._stub.CancelJob(pb.CancelJobRequest(job_id=job_id),
+                                     timeout=self.timeout)
+        return reply.cancelled
+
+    def tail_log(self, job_id: int, lines: int = 100,
+                 follow: bool = False) -> Iterator[str]:
+        for chunk in self._stub.TailLog(
+                pb.TailLogRequest(job_id=job_id, lines=lines, follow=follow)):
+            yield chunk.data
+
+    def set_autostop(self, idle_minutes: int, down: bool = False) -> bool:
+        reply = self._stub.SetAutostop(
+            pb.SetAutostopRequest(idle_minutes=idle_minutes, down=down),
+            timeout=self.timeout)
+        return reply.ok
+
+    def cancel_autostop(self) -> bool:
+        reply = self._stub.SetAutostop(pb.SetAutostopRequest(cancel=True),
+                                       timeout=self.timeout)
+        return reply.ok
+
+    @staticmethod
+    def _job_dict(j: pb.JobRecord) -> Dict[str, Any]:
+        return {
+            'job_id': j.job_id, 'name': j.name, 'status': j.status,
+            'submitted_at': j.submitted_at or None,
+            'started_at': j.started_at or None,
+            'ended_at': j.ended_at or None,
+            'num_nodes': j.num_nodes, 'num_workers': j.num_workers,
+            'log_dir': j.log_dir,
+        }

@@ -14,32 +14,46 @@ from typing import Dict, List, Optional, Tuple
 
 _DIR = os.path.dirname(__file__)
 _BINARY = os.path.join(_DIR, 'skytpu_gangd')
+_FUSE_BINARY = os.path.join(_DIR, 'skytpu_fuse_proxy')
 _build_lock = threading.Lock()
-_build_failed = False
+_build_failed: Dict[str, bool] = {}
+
+
+def _built_binary(target: str, src_name: str) -> Optional[str]:
+    """Build-once-with-fallback for a native target; None when make is
+    unavailable or the build fails (callers degrade to pure-Python/noop)."""
+    binary = os.path.join(_DIR, target)
+    with _build_lock:
+        src = os.path.join(_DIR, src_name)
+        if os.path.exists(binary) and \
+                os.path.getmtime(binary) >= os.path.getmtime(src):
+            return binary
+        if _build_failed.get(target):
+            return None
+        if shutil.which('make') is None:
+            _build_failed[target] = True
+            return None
+        proc = subprocess.run(['make', '-C', _DIR, target],
+                              capture_output=True, text=True, check=False)
+        if proc.returncode != 0 or not os.path.exists(binary):
+            _build_failed[target] = True
+            return None
+        return binary
 
 
 def gang_binary() -> Optional[str]:
     """Path to the built supervisor, building it if needed; None if the
-    native path is unavailable (no compiler / build failure / opt-out)."""
-    global _build_failed
+    native path is unavailable (no toolchain / build failure / opt-out)."""
     if os.environ.get('SKYTPU_NATIVE_GANG', '1') == '0':
         return None
-    with _build_lock:
-        if os.path.exists(_BINARY):
-            src_mtime = os.path.getmtime(os.path.join(_DIR, 'gangd.cc'))
-            if os.path.getmtime(_BINARY) >= src_mtime:
-                return _BINARY
-        if _build_failed:
-            return None
-        if shutil.which('g++') is None and shutil.which('make') is None:
-            _build_failed = True
-            return None
-        proc = subprocess.run(['make', '-C', _DIR, 'skytpu_gangd'],
-                              capture_output=True, text=True, check=False)
-        if proc.returncode != 0 or not os.path.exists(_BINARY):
-            _build_failed = True
-            return None
-        return _BINARY
+    return _built_binary('skytpu_gangd', 'gangd.cc')
+
+
+def fuse_proxy_binary() -> Optional[str]:
+    """Path to the built fuse-proxy (shim+server), building on first use;
+    None when no toolchain is available. Reference analog: the Go
+    fuse-proxy addon binaries (addons/fuse-proxy/)."""
+    return _built_binary('skytpu_fuse_proxy', 'fuse_proxy.cc')
 
 
 def write_spec(path: str, workers: List[Tuple[str, Dict[str, str], str, str]]

@@ -1,0 +1,259 @@
+// skytpu_fuse_proxy: rootless FUSE mounts via a privileged broker.
+//
+// Reference analog: the Go fuse-proxy addon
+// (addons/fuse-proxy/cmd/{fusermount-shim,fusermount-server}/main.go, 712
+// LoC): unprivileged containers cannot run fusermount, so a shim
+// masquerading as `fusermount` forwards the call over a unix socket to a
+// privileged daemon, which runs the real fusermount and relays the opened
+// /dev/fuse file descriptor back over SCM_RIGHTS. Same shim/daemon split
+// here, in C++ (Rust/Go are not in the image).
+//
+// One binary, two modes:
+//   skytpu_fuse_proxy --server --socket S [--fusermount /usr/bin/fusermount3]
+//   skytpu_fuse_proxy --shim --socket S [args...]
+//
+// Shim protocol (one connection per fusermount invocation):
+//   shim -> server:  argc then argv ('\0'-separated), plus whether the
+//                    caller expects an fd (env FUSE_COMMFD set).
+//   server: runs the real fusermount with a socketpair as FUSE_COMMFD,
+//           captures the fd fusermount sends, relays exit code (+ the fd
+//           via SCM_RIGHTS) back to the shim.
+//   shim: forwards the fd to ITS caller over the caller's FUSE_COMMFD and
+//         exits with the relayed code — byte-compatible with libfuse's
+//         fusermount handshake.
+
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr size_t kMaxMsg = 1 << 16;
+
+int die(const char* msg) {
+  std::perror(msg);
+  return 1;
+}
+
+// -- SCM_RIGHTS helpers ------------------------------------------------------
+
+int send_fd(int sock, const void* data, size_t len, int fd) {
+  struct msghdr msg = {};
+  struct iovec iov = {const_cast<void*>(data), len};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  char cbuf[CMSG_SPACE(sizeof(int))] = {};
+  if (fd >= 0) {
+    msg.msg_control = cbuf;
+    msg.msg_controllen = sizeof(cbuf);
+    struct cmsghdr* cm = CMSG_FIRSTHDR(&msg);
+    cm->cmsg_level = SOL_SOCKET;
+    cm->cmsg_type = SCM_RIGHTS;
+    cm->cmsg_len = CMSG_LEN(sizeof(int));
+    std::memcpy(CMSG_DATA(cm), &fd, sizeof(int));
+  }
+  return sendmsg(sock, &msg, 0) < 0 ? -1 : 0;
+}
+
+// Returns bytes read; *fd_out = received fd or -1.
+ssize_t recv_fd(int sock, void* buf, size_t len, int* fd_out) {
+  struct msghdr msg = {};
+  struct iovec iov = {buf, len};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  char cbuf[CMSG_SPACE(sizeof(int))] = {};
+  msg.msg_control = cbuf;
+  msg.msg_controllen = sizeof(cbuf);
+  ssize_t n = recvmsg(sock, &msg, 0);
+  *fd_out = -1;
+  if (n >= 0) {
+    for (struct cmsghdr* cm = CMSG_FIRSTHDR(&msg); cm != nullptr;
+         cm = CMSG_NXTHDR(&msg, cm)) {
+      if (cm->cmsg_level == SOL_SOCKET && cm->cmsg_type == SCM_RIGHTS) {
+        std::memcpy(fd_out, CMSG_DATA(cm), sizeof(int));
+      }
+    }
+  }
+  return n;
+}
+
+int connect_unix(const std::string& path) {
+  int s = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (s < 0) return -1;
+  struct sockaddr_un addr = {};
+  addr.sun_family = AF_UNIX;
+  std::snprintf(addr.sun_path, sizeof(addr.sun_path), "%s", path.c_str());
+  if (connect(s, reinterpret_cast<struct sockaddr*>(&addr),
+              sizeof(addr)) < 0) {
+    close(s);
+    return -1;
+  }
+  return s;
+}
+
+int listen_unix(const std::string& path) {
+  unlink(path.c_str());
+  int s = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (s < 0) return -1;
+  struct sockaddr_un addr = {};
+  addr.sun_family = AF_UNIX;
+  std::snprintf(addr.sun_path, sizeof(addr.sun_path), "%s", path.c_str());
+  if (bind(s, reinterpret_cast<struct sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      listen(s, 16) < 0) {
+    close(s);
+    return -1;
+  }
+  return s;
+}
+
+// -- server ------------------------------------------------------------------
+
+// Handle one shim connection: read argv, run fusermount, relay fd + code.
+void handle_conn(int conn, const std::string& fusermount) {
+  char buf[kMaxMsg];
+  int unused_fd;
+  ssize_t n = recv_fd(conn, buf, sizeof(buf), &unused_fd);
+  if (n <= 0) {
+    close(conn);
+    return;
+  }
+  // Wire format: "<want_fd:0|1>\0<arg1>\0<arg2>\0..."
+  bool want_fd = buf[0] == '1';
+  std::vector<std::string> args;
+  size_t pos = 2;  // skip flag byte + NUL
+  while (pos < static_cast<size_t>(n)) {
+    std::string a(buf + pos);
+    pos += a.size() + 1;
+    args.push_back(a);
+  }
+
+  int pair[2] = {-1, -1};
+  if (want_fd &&
+      socketpair(AF_UNIX, SOCK_STREAM, 0, pair) < 0) {
+    const char fail[] = "1\0", *p = fail;
+    send_fd(conn, p, 2, -1);
+    close(conn);
+    return;
+  }
+
+  pid_t pid = fork();
+  if (pid == 0) {
+    if (want_fd) {
+      char commfd[16];
+      std::snprintf(commfd, sizeof(commfd), "%d", pair[1]);
+      setenv("_FUSE_COMMFD", commfd, 1);
+      close(pair[0]);
+    }
+    std::vector<char*> argv;
+    argv.push_back(const_cast<char*>(fusermount.c_str()));
+    for (auto& a : args) argv.push_back(const_cast<char*>(a.c_str()));
+    argv.push_back(nullptr);
+    execvp(argv[0], argv.data());
+    _exit(127);
+  }
+  if (want_fd) close(pair[1]);
+
+  int mount_fd = -1;
+  if (want_fd && pid > 0) {
+    // The real fusermount sends the /dev/fuse fd over _FUSE_COMMFD.
+    char tmp[8];
+    recv_fd(pair[0], tmp, sizeof(tmp), &mount_fd);
+  }
+  int status = 0;
+  if (pid > 0) waitpid(pid, &status, 0);
+  int code = WIFEXITED(status) ? WEXITSTATUS(status) : 1;
+
+  char reply[8];
+  std::snprintf(reply, sizeof(reply), "%d", code);
+  send_fd(conn, reply, std::strlen(reply) + 1, mount_fd);
+  if (mount_fd >= 0) close(mount_fd);
+  if (want_fd) close(pair[0]);
+  close(conn);
+}
+
+int run_server(const std::string& socket_path,
+               const std::string& fusermount) {
+  int ls = listen_unix(socket_path);
+  if (ls < 0) return die("listen");
+  std::fprintf(stderr, "skytpu_fuse_proxy: serving on %s (fusermount=%s)\n",
+               socket_path.c_str(), fusermount.c_str());
+  for (;;) {
+    int conn = accept(ls, nullptr, nullptr);
+    if (conn < 0) {
+      if (errno == EINTR) continue;
+      return die("accept");
+    }
+    handle_conn(conn, fusermount);
+  }
+}
+
+// -- shim --------------------------------------------------------------------
+
+int run_shim(const std::string& socket_path, int argc, char** argv) {
+  const char* commfd_env = getenv("_FUSE_COMMFD");
+  bool want_fd = commfd_env != nullptr;
+
+  std::string msg;
+  msg.push_back(want_fd ? '1' : '0');
+  msg.push_back('\0');
+  for (int i = 0; i < argc; i++) {
+    msg.append(argv[i]);
+    msg.push_back('\0');
+  }
+
+  int s = connect_unix(socket_path);
+  if (s < 0) return die("connect (is the fuse-proxy server running?)");
+  if (send_fd(s, msg.data(), msg.size(), -1) < 0) return die("send");
+
+  char reply[8] = {};
+  int mount_fd = -1;
+  if (recv_fd(s, reply, sizeof(reply), &mount_fd) <= 0) return die("recv");
+  int code = std::atoi(reply);
+
+  if (want_fd && mount_fd >= 0) {
+    // Relay the fd to OUR caller over its _FUSE_COMMFD socket.
+    int caller_fd = std::atoi(commfd_env);
+    char byte = '\0';
+    send_fd(caller_fd, &byte, 1, mount_fd);
+    close(mount_fd);
+  }
+  close(s);
+  return code;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string mode, socket_path, fusermount = "fusermount3";
+  int rest = argc;
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    if (a == "--server" || a == "--shim") {
+      mode = a;
+    } else if (a == "--socket" && i + 1 < argc) {
+      socket_path = argv[++i];
+    } else if (a == "--fusermount" && i + 1 < argc) {
+      fusermount = argv[++i];
+    } else {
+      rest = i;
+      break;
+    }
+  }
+  if (mode.empty() || socket_path.empty()) {
+    std::fprintf(stderr,
+                 "usage: %s --server|--shim --socket PATH "
+                 "[--fusermount BIN] [shim args...]\n",
+                 argv[0]);
+    return 2;
+  }
+  if (mode == "--server") return run_server(socket_path, fusermount);
+  return run_shim(socket_path, argc - rest, argv + rest);
+}

@@ -1,0 +1,59 @@
+"""gRPC plumbing for the on-cluster agent service.
+
+Reference analog: the generated ``sky/schemas/generated/*_pb2_grpc.py``
+stubs. The grpc_tools codegen plugin is not in this image, so the ~50 lines
+it would emit (method handler registration + client stub) are written by
+hand against the protoc-generated messages
+(``schemas/generated/agent_pb2.py``); the wire format is identical.
+"""
+from __future__ import annotations
+
+import grpc
+
+from skypilot_tpu.schemas.generated import agent_pb2 as pb
+
+SERVICE = 'skytpu.agent.v1.Agent'
+
+# method name -> (is_server_streaming, request class, reply class)
+_METHODS = {
+    'Health': (False, pb.HealthRequest, pb.HealthReply),
+    'ListJobs': (False, pb.ListJobsRequest, pb.ListJobsReply),
+    'GetJob': (False, pb.GetJobRequest, pb.JobRecord),
+    'CancelJob': (False, pb.CancelJobRequest, pb.CancelJobReply),
+    'TailLog': (True, pb.TailLogRequest, pb.LogChunk),
+    'SetAutostop': (False, pb.SetAutostopRequest, pb.SetAutostopReply),
+}
+
+
+def add_agent_servicer(server: grpc.Server, servicer) -> None:
+    """Register a servicer object exposing methods named as in _METHODS."""
+    handlers = {}
+    for name, (streaming, req_cls, _reply_cls) in _METHODS.items():
+        fn = getattr(servicer, name)
+        if streaming:
+            handlers[name] = grpc.unary_stream_rpc_method_handler(
+                fn, request_deserializer=req_cls.FromString,
+                response_serializer=lambda m: m.SerializeToString())
+        else:
+            handlers[name] = grpc.unary_unary_rpc_method_handler(
+                fn, request_deserializer=req_cls.FromString,
+                response_serializer=lambda m: m.SerializeToString())
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+
+
+class AgentStub:
+    """Client stub (what *_pb2_grpc.AgentStub would be)."""
+
+    def __init__(self, channel: grpc.Channel):
+        for name, (streaming, req_cls, reply_cls) in _METHODS.items():
+            path = f'/{SERVICE}/{name}'
+            if streaming:
+                call = channel.unary_stream(
+                    path, request_serializer=req_cls.SerializeToString,
+                    response_deserializer=reply_cls.FromString)
+            else:
+                call = channel.unary_unary(
+                    path, request_serializer=req_cls.SerializeToString,
+                    response_deserializer=reply_cls.FromString)
+            setattr(self, name, call)

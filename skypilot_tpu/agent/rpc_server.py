@@ -1,0 +1,161 @@
+"""On-cluster agent gRPC server (skylet analog).
+
+Reference analog: ``sky/skylet/skylet.py:45-74`` — a gRPC server on the
+head node (bound to 127.0.0.1, reached through an SSH tunnel) serving the
+job table, log tails, and autostop control so ``queue``/``logs``/``cancel``
+work from ANY client machine, not just the submitting host.
+
+Run: ``python -m skypilot_tpu.agent.rpc_server --cluster-dir D --port P``
+(started on the head by ``provision/instance_setup.start_agent_on_head``).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from concurrent import futures
+from typing import Iterator
+
+import grpc
+
+from skypilot_tpu import __version__
+from skypilot_tpu.agent import constants, job_lib
+from skypilot_tpu.agent import rpc as rpc_lib
+from skypilot_tpu.schemas.generated import agent_pb2 as pb
+
+
+class AgentServicer:
+
+    def __init__(self, cluster_dir: str):
+        self.cluster_dir = os.path.expanduser(cluster_dir)
+        self.table = job_lib.JobTable(self.cluster_dir)
+        self.started = time.time()
+
+    # -- RPCs --------------------------------------------------------------
+
+    def Health(self, request: pb.HealthRequest, context) -> pb.HealthReply:
+        del request, context
+        return pb.HealthReply(version=__version__,
+                              uptime_s=time.time() - self.started)
+
+    def _to_record(self, job) -> pb.JobRecord:
+        return pb.JobRecord(
+            job_id=job['job_id'], name=job.get('name') or '',
+            status=job['status'],
+            submitted_at=job.get('submitted_at') or 0.0,
+            started_at=job.get('started_at') or 0.0,
+            ended_at=job.get('ended_at') or 0.0,
+            num_nodes=job.get('num_nodes') or 0,
+            num_workers=job.get('num_workers') or 0,
+            log_dir=job.get('log_dir') or '')
+
+    def ListJobs(self, request: pb.ListJobsRequest, context
+                 ) -> pb.ListJobsReply:
+        del context
+        jobs = self.table.list_jobs(limit=request.limit or 200)
+        return pb.ListJobsReply(jobs=[self._to_record(j) for j in jobs])
+
+    def GetJob(self, request: pb.GetJobRequest, context) -> pb.JobRecord:
+        job = self.table.get(request.job_id)
+        if job is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f'job {request.job_id} not found')
+        return self._to_record(job)
+
+    def CancelJob(self, request: pb.CancelJobRequest, context
+                  ) -> pb.CancelJobReply:
+        del context
+        cancelled, pid = self.table.cancel(request.job_id)
+        if cancelled and pid:
+            try:
+                os.kill(pid, 15)
+            except (ProcessLookupError, PermissionError):
+                pass
+        return pb.CancelJobReply(cancelled=cancelled)
+
+    def TailLog(self, request: pb.TailLogRequest, context
+                ) -> Iterator[pb.LogChunk]:
+        job = self.table.get(request.job_id)
+        if job is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f'job {request.job_id} not found')
+        path = os.path.join(job['log_dir'], constants.MERGED_LOG_FILE)
+        pos = 0
+        lines = request.lines or 100
+        # Initial tail.
+        if os.path.exists(path):
+            with open(path, 'rb') as f:
+                content = f.read()
+                pos = len(content)
+            tail = content.decode('utf-8', errors='replace').splitlines()
+            for line in tail[-lines:]:
+                yield pb.LogChunk(data=line + '\n')
+        if not request.follow:
+            return
+        while context.is_active():
+            job = self.table.get(request.job_id)
+            if os.path.exists(path):
+                with open(path, 'rb') as f:
+                    f.seek(pos)
+                    chunk = f.read()
+                    pos = f.tell()
+                if chunk:
+                    yield pb.LogChunk(
+                        data=chunk.decode('utf-8', errors='replace'))
+            if job is None or job_lib.JobStatus(job['status']).is_terminal():
+                return
+            time.sleep(0.3)
+
+    def SetAutostop(self, request: pb.SetAutostopRequest, context
+                    ) -> pb.SetAutostopReply:
+        del context
+        path = os.path.join(self.cluster_dir, constants.AUTOSTOP_FILE)
+        if request.cancel:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        else:
+            with open(path, 'w', encoding='utf-8') as f:
+                json.dump({'idle_minutes': request.idle_minutes,
+                           'down': request.down}, f)
+        return pb.SetAutostopReply(ok=True)
+
+
+def serve(cluster_dir: str, port: int, host: str = '127.0.0.1'
+          ) -> grpc.Server:
+    """Start the agent server; returns the grpc.Server (caller owns it).
+    127.0.0.1-only by default: remote clients come through an SSH tunnel
+    (the reference's security model, cloud_vm_ray_backend.py:2272-2443)."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))
+    rpc_lib.add_agent_servicer(server, AgentServicer(cluster_dir))
+    bound = server.add_insecure_port(f'{host}:{port}')
+    if bound == 0:
+        # grpc returns 0 on bind failure (port taken by another cluster's
+        # agent); serving anyway would silently answer for the WRONG
+        # cluster once a client dials the shared port.
+        raise OSError(f'agent rpc: cannot bind {host}:{port}')
+    server.start()
+    server.bound_port = bound  # type: ignore[attr-defined]
+    return server
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--cluster-dir', required=True)
+    parser.add_argument('--port', type=int, default=0)
+    parser.add_argument('--port-file', default=None,
+                        help='write the bound port here (cluster-unique '
+                             'ports: clients read this file over SSH)')
+    args = parser.parse_args()
+    server = serve(args.cluster_dir, args.port)
+    if args.port_file:
+        with open(args.port_file, 'w', encoding='utf-8') as f:
+            f.write(str(server.bound_port))
+    print(f'agent rpc server on 127.0.0.1:{server.bound_port}', flush=True)
+    server.wait_for_termination()
+
+
+if __name__ == '__main__':
+    main()

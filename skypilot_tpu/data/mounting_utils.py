@@ -7,10 +7,46 @@ fallback for S3-compatible stores.
 """
 from __future__ import annotations
 
+import os
 import shlex
 from typing import Optional
 
 GCSFUSE_VERSION = '2.5.1'
+
+# Unix socket of the privileged fuse-proxy broker (agent/native/
+# fuse_proxy.cc). When set, workers have no direct fusermount privilege —
+# a shim masquerading as fusermount relays through the broker (reference:
+# the fuse-proxy addon's fusermount-shim PATH interception).
+FUSE_PROXY_SOCKET_ENV = 'SKYTPU_FUSE_PROXY_SOCKET'
+
+
+# Where the runtime install (provision/instance_setup.py) lands the
+# framework on workers; the fuse-proxy sources/binary live inside it.
+_REMOTE_NATIVE_DIR = '~/.skytpu/runtime/skypilot_tpu/agent/native'
+
+
+def fuse_proxy_prelude() -> str:
+    """Shell prelude installing the fusermount shim first on PATH when the
+    fuse-proxy broker is configured (env on the submitting host — mount
+    commands are composed there); empty string otherwise. The shim execs
+    the worker-local binary, building it from the synced sources if the
+    worker image has a toolchain."""
+    sock = os.environ.get(FUSE_PROXY_SOCKET_ENV)
+    if not sock:
+        return ''
+    qsock = shlex.quote(sock)
+    bin_path = f'{_REMOTE_NATIVE_DIR}/skytpu_fuse_proxy'
+    return (
+        f'(test -x {bin_path} || '
+        f'make -C {_REMOTE_NATIVE_DIR} skytpu_fuse_proxy) && '
+        'mkdir -p ~/.skytpu/fuse-shim && '
+        'printf \'#!/bin/sh\\nexec %s --shim --socket %s "$@"\\n\' '
+        f'"$(cd {_REMOTE_NATIVE_DIR} && pwd)/skytpu_fuse_proxy" {qsock} '
+        '> ~/.skytpu/fuse-shim/fusermount3 && '
+        'chmod +x ~/.skytpu/fuse-shim/fusermount3 && '
+        'cp ~/.skytpu/fuse-shim/fusermount3 ~/.skytpu/fuse-shim/fusermount '
+        '&& export PATH=~/.skytpu/fuse-shim:$PATH && '
+        f'test -S {qsock} && ')
 
 _INSTALL_GCSFUSE = (
     'command -v gcsfuse >/dev/null || ('
@@ -33,7 +69,7 @@ def gcsfuse_mount_command(bucket: str, mount_path: str,
     ]
     if only_dir:
         flags.append(f'--only-dir {shlex.quote(only_dir)}')
-    return (f'{_INSTALL_GCSFUSE} && '
+    return (f'{fuse_proxy_prelude()}{_INSTALL_GCSFUSE} && '
             f'mkdir -p {shlex.quote(mount_path)} && '
             f'(mountpoint -q {shlex.quote(mount_path)} || '
             f'gcsfuse {" ".join(flags)} {shlex.quote(bucket)} '

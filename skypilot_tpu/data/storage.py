@@ -258,6 +258,7 @@ class S3Store(AbstractStore):
     def __init__(self, bucket: str, prefix: str = '', http=None):
         super().__init__(bucket, prefix)
         self._http = http or self._requests_http
+        self._http_supports_stream = None  # resolved on first request
         self.region = os.environ.get('AWS_DEFAULT_REGION', 'us-east-1')
         endpoint = os.environ.get('AWS_ENDPOINT_URL')
         if endpoint:
@@ -326,10 +327,17 @@ class S3Store(AbstractStore):
                       for k, v in sorted(params.items()))
         url = (f'https://{self.host}{quote(path, safe="/-_.~")}'
                + (f'?{qs}' if qs else ''))
-        try:
+        if self._http_supports_stream is None:
+            import inspect
+            try:
+                params_ = inspect.signature(self._http).parameters
+                self._http_supports_stream = 'stream_to' in params_
+            except (TypeError, ValueError):
+                self._http_supports_stream = False
+        if self._http_supports_stream:
             status, content = self._http(method, url, headers, data,
                                          stream_to=stream_to)
-        except TypeError:  # older injected http without stream support
+        else:  # injected http without stream support (tests)
             status, content = self._http(method, url, headers, data)
             if stream_to is not None and status < 400:
                 with open(stream_to, 'wb') as f:

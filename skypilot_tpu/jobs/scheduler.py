@@ -38,9 +38,11 @@ def submit_job(job_id: int) -> None:
 
 
 # A controller that crashed between task submission and controller_started
-# would hold its LAUNCHING slot forever; past this age the slot is
-# reclaimed and the job marked failed.
-LAUNCHING_GRACE_S = 300.0
+# would hold its LAUNCHING slot forever; past this age (measured from AFTER
+# the controller task was submitted — provisioning the controller cluster
+# can itself take minutes and must not count) the slot is reclaimed and
+# the job marked failed.
+LAUNCHING_GRACE_S = 900.0
 
 
 def _reconcile_stale_launching() -> None:
@@ -74,6 +76,9 @@ def maybe_schedule_next() -> None:
                 'skypilot_tpu.jobs.controller', f'--job-id {job_id}',
                 job_name=f'jobs-controller-{job_id}',
                 cluster_name=controller_utils.JOBS_CONTROLLER_CLUSTER)
+            # Restart the grace clock now that the (possibly slow)
+            # controller-cluster provisioning is behind us.
+            state.set_schedule_state(job_id, state.ScheduleState.LAUNCHING)
         except Exception as e:  # noqa: BLE001 — record, release the slot
             state.set_schedule_state(job_id, state.ScheduleState.DONE)
             state.set_status(job_id, state.ManagedJobStatus.FAILED_CONTROLLER,
@@ -81,6 +86,10 @@ def maybe_schedule_next() -> None:
 
 
 def controller_started(job_id: int) -> None:
+    record = state.get(job_id)
+    if record is not None and record.get('schedule_state') == \
+            state.ScheduleState.DONE.value:
+        return  # reaped as stale before we got here; stay DONE
     state.set_schedule_state(job_id, state.ScheduleState.ALIVE)
 
 

@@ -77,21 +77,29 @@ def install_runtime(runners: Sequence[CommandRunner],
         list(pool.map(_install_one, runners))
 
 
-def start_agent_on_head(head_runner: CommandRunner, cluster_name: str) -> None:
-    """Start the on-cluster daemon (skylet analog) detached on the head
-    (reference: ``start_skylet_on_head_node :490``). Idempotent: a second
+def start_agent_on_head(head_runner: CommandRunner, cluster_name: str,
+                        python: str = 'python3') -> None:
+    """Start the on-cluster agent (skylet analog: the gRPC server over the
+    head's job table/logs, ``agent/rpc_server.py``) detached on the head
+    (reference: ``start_skylet_on_head_node :490``). The server picks a
+    free port (heads can be shared hosts — local controller clusters) and
+    records it in ``agent.port`` inside the cluster dir; clients read that
+    file over SSH before dialing through the tunnel. Idempotent: a second
     start finds the pidfile's process alive and exits."""
     pidfile = f'{REMOTE_RUNTIME_DIR}/daemon-{cluster_name}.pid'
+    cluster_dir = f'{REMOTE_RUNTIME_DIR}/clusters/{cluster_name}'
     cmd = (
         f'if [ -f {pidfile} ] && kill -0 $(cat {pidfile}) 2>/dev/null; then '
         f'true; else '
-        f'PYTHONPATH={REMOTE_RUNTIME_DIR} nohup python3 -m '
-        f'skypilot_tpu.agent.daemon --cluster-name {shlex.quote(cluster_name)}'
-        f' >/dev/null 2>&1 & echo $! > {pidfile}; fi')
+        f'mkdir -p {cluster_dir} && '
+        f'PYTHONPATH={REMOTE_RUNTIME_DIR} nohup {shlex.quote(python)} -m '
+        f'skypilot_tpu.agent.rpc_server --cluster-dir {cluster_dir} '
+        f'--port 0 --port-file {cluster_dir}/agent.port '
+        f'>/dev/null 2>&1 & echo $! > {pidfile}; fi')
     rc = head_runner.run(cmd)
     if rc != 0:
         raise exceptions.ClusterNotUpError(
-            f'Starting the cluster daemon on the head failed (rc={rc})')
+            f'Starting the cluster agent on the head failed (rc={rc})')
 
 
 def bootstrap_cluster(cluster_name: str, info: common.ClusterInfo,

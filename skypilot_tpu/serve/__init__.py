@@ -4,9 +4,6 @@ Reference analog: ``sky/serve/`` public verbs (`up`, `down`, `status`).
 """
 from __future__ import annotations
 
-import os
-import subprocess
-import sys
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.serve import serve_state

@@ -188,12 +188,21 @@ class ReplicaManager:
         if len(new) < target and len(reps) <= target:
             self.launch_replica()  # surge (+1 above target)
             return
-        if new_ready:
-            # Retire the oldest old-version replica (non-ready first).
-            order = sorted(old, key=lambda r: (
-                r['status'] == serve_state.ReplicaStatus.READY,
-                r['replica_id']))
-            self.terminate_replica(order[0]['replica_id'])
+        if not new_ready:
+            return
+        # Retire the oldest old-version replica, non-ready first. A READY
+        # old replica is retired only while total READY stays >= target —
+        # the capacity invariant that makes the update "rolling".
+        total_ready = len(new_ready) + sum(
+            1 for r in old if r['status'] == serve_state.ReplicaStatus.READY)
+        order = sorted(old, key=lambda r: (
+            r['status'] == serve_state.ReplicaStatus.READY,
+            r['replica_id']))
+        victim = order[0]
+        victim_ready = victim['status'] == serve_state.ReplicaStatus.READY
+        if victim_ready and total_ready - 1 < target:
+            return  # wait for another new-version replica to come READY
+        self.terminate_replica(victim['replica_id'])
 
     def num_alive(self) -> int:
         alive = {serve_state.ReplicaStatus.PROVISIONING,

@@ -1,0 +1,53 @@
+"""Prometheus metrics for the API server.
+
+Reference analog: ``sky/server/metrics.py`` (API-server prometheus
+metrics). Request counters update on every scheduled request; fleet-state
+gauges (clusters/jobs/services by status) are computed at scrape time from
+the state tables, so the endpoint is always consistent with reality.
+"""
+from __future__ import annotations
+
+from prometheus_client import (CollectorRegistry, Counter, Gauge,
+                               generate_latest)
+
+REGISTRY = CollectorRegistry()
+
+REQUESTS_TOTAL = Counter(
+    'skytpu_api_requests_total', 'API requests scheduled, by operation.',
+    ['op'], registry=REGISTRY)
+
+_CLUSTERS = Gauge('skytpu_clusters', 'Clusters by status.', ['status'],
+                  registry=REGISTRY)
+_MANAGED_JOBS = Gauge('skytpu_managed_jobs', 'Managed jobs by status.',
+                      ['status'], registry=REGISTRY)
+_SERVICES = Gauge('skytpu_services', 'Services by status.', ['status'],
+                  registry=REGISTRY)
+_API_REQUESTS = Gauge('skytpu_api_request_table', 'Request table by status.',
+                      ['status'], registry=REGISTRY)
+
+
+def _refresh_gauges() -> None:
+    from collections import Counter as C
+
+    from skypilot_tpu import global_user_state
+    from skypilot_tpu.jobs import state as jobs_state
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.server import requests_db
+
+    for gauge, counts in (
+        (_CLUSTERS, C(r['status'].value
+                      for r in global_user_state.get_clusters())),
+        (_MANAGED_JOBS, C(r['status'].value
+                          for r in jobs_state.list_jobs())),
+        (_SERVICES, C(s['status'].value for s in serve_state.list_services()
+                      if s is not None)),
+        (_API_REQUESTS, C(r['status'] for r in requests_db.list_requests())),
+    ):
+        gauge.clear()
+        for status, n in counts.items():
+            gauge.labels(status=status).set(n)
+
+
+def render() -> bytes:
+    _refresh_gauges()
+    return generate_latest(REGISTRY)

@@ -40,6 +40,8 @@ def _schedule_response(op: str, payload: Dict[str, Any]) -> web.Response:
         request_id = executor.schedule(op, payload)
     except RuntimeError as e:
         return web.json_response({'error': str(e)}, status=503)
+    from skypilot_tpu.server import metrics
+    metrics.REQUESTS_TOTAL.labels(op=op).inc()
     return web.json_response({'request_id': request_id})
 
 
@@ -135,6 +137,15 @@ async def api_stream(request: web.Request) -> web.StreamResponse:
     return resp
 
 
+@routes.get('/metrics')
+async def prometheus_metrics(request: web.Request) -> web.Response:
+    """Prometheus scrape endpoint (reference: ``sky/server/metrics.py``)."""
+    del request
+    from skypilot_tpu.server import metrics
+    return web.Response(body=metrics.render(),
+                        content_type='text/plain', charset='utf-8')
+
+
 @routes.get('/api/v1/api/requests')
 async def api_requests(request: web.Request) -> web.Response:
     del request
@@ -166,8 +177,9 @@ async def auth_middleware(request: web.Request, handler):
     can discover they need a token."""
     token = os.environ.get('SKYTPU_API_TOKEN')
     if token and request.path != '/health':
+        import hmac
         supplied = request.headers.get('Authorization', '')
-        if supplied != f'Bearer {token}':
+        if not hmac.compare_digest(supplied, f'Bearer {token}'):
             return web.json_response({'error': 'unauthorized'}, status=401)
     return await handler(request)
 

@@ -278,3 +278,13 @@ def test_token_auth(tmp_path):
         assert r.status_code == 200
     finally:
         proc.terminate()
+
+
+def test_metrics_endpoint(server):
+    """Prometheus scrape endpoint: request counters + fleet-state gauges
+    (reference: sky/server/metrics.py)."""
+    r = requests_lib.get(f'{server}/metrics', timeout=10)
+    assert r.status_code == 200
+    body = r.text
+    assert 'skytpu_api_requests_total' in body
+    assert 'skytpu_api_request_table' in body

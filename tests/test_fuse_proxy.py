@@ -1,0 +1,126 @@
+"""Native fuse-proxy tests: shim -> unix-socket broker -> fusermount, with
+the /dev/fuse fd relayed back over SCM_RIGHTS.
+
+Reference analog: addons/fuse-proxy (Go, fusermount-shim/-server) — the
+rootless-FUSE enabler for k8s pods. The sandbox has no /dev/fuse, so a
+fake ``fusermount`` stands in: it validates argv, speaks the real
+``_FUSE_COMMFD`` handshake (sends a pipe fd via SCM_RIGHTS), and exits
+with a chosen code — exercising every byte of the relay path.
+"""
+import array
+import os
+import socket
+import stat
+import subprocess
+import time
+
+import pytest
+
+from skypilot_tpu.agent import native
+
+FAKE_FUSERMOUNT = r'''#!/usr/bin/env python3
+import array, os, socket, sys
+# Log argv for assertions.
+with open(os.environ['FAKE_LOG'], 'a') as f:
+    f.write(' '.join(sys.argv[1:]) + '\n')
+commfd = os.environ.get('_FUSE_COMMFD')
+if commfd is not None:
+    # The real fusermount opens /dev/fuse and sends it over _FUSE_COMMFD;
+    # here: a pipe whose read end doubles as the "device".
+    r, w = os.pipe()
+    os.write(w, b'fake-fuse-device')
+    os.close(w)
+    sock = socket.socket(fileno=os.dup(int(commfd)))
+    sock.sendmsg([b'\0'], [(socket.SOL_SOCKET, socket.SCM_RIGHTS,
+                            array.array('i', [r]))])
+    sock.close()
+code = 0
+try:  # exit code chosen by the test via a file (the fake runs in the
+      # SERVER's env, not the shim's)
+    with open(os.environ['FAKE_LOG'] + '.exit') as f:
+        code = int(f.read())
+except OSError:
+    pass
+sys.exit(code)
+'''
+
+
+def _recv_fd(sock):
+    fds = array.array('i')
+    msg, ancdata, _flags, _addr = sock.recvmsg(
+        1, socket.CMSG_SPACE(fds.itemsize))
+    for level, ctype, data in ancdata:
+        if level == socket.SOL_SOCKET and ctype == socket.SCM_RIGHTS:
+            fds.frombytes(data[:fds.itemsize])
+    return msg, (fds[0] if fds else -1)
+
+
+@pytest.fixture()
+def proxy(tmp_path):
+    binary = native.fuse_proxy_binary()
+    if binary is None:
+        pytest.skip('no native toolchain')
+    fake = tmp_path / 'fusermount'
+    fake.write_text(FAKE_FUSERMOUNT)
+    fake.chmod(fake.stat().st_mode | stat.S_IEXEC)
+    log = tmp_path / 'calls.log'
+    sock_path = str(tmp_path / 'fuse.sock')
+    env = dict(os.environ, FAKE_LOG=str(log))
+    server = subprocess.Popen(
+        [binary, '--server', '--socket', sock_path,
+         '--fusermount', str(fake)],
+        env=env, stderr=subprocess.DEVNULL)
+    deadline = time.time() + 10
+    while not os.path.exists(sock_path) and time.time() < deadline:
+        time.sleep(0.05)
+    assert os.path.exists(sock_path)
+    yield binary, sock_path, log, env
+    server.kill()
+    server.wait()
+
+
+def test_shim_relays_argv_and_exit_code(proxy, tmp_path):
+    binary, sock_path, log, env = proxy
+    rc = subprocess.run(
+        [binary, '--shim', '--socket', sock_path,
+         '-o', 'rw,nosuid,nodev', '/mnt/bucket'],
+        env=env, check=False).returncode
+    assert rc == 0
+    assert '-o rw,nosuid,nodev /mnt/bucket' in log.read_text()
+
+    # Non-zero exit codes propagate back through the broker.
+    (tmp_path / 'calls.log.exit').write_text('3')
+    rc = subprocess.run(
+        [binary, '--shim', '--socket', sock_path, '-u', '/mnt/bucket'],
+        env=env, check=False).returncode
+    (tmp_path / 'calls.log.exit').unlink()
+    assert rc == 3
+
+
+def test_shim_relays_fuse_fd_over_scm_rights(proxy):
+    """The full libfuse handshake: caller sets _FUSE_COMMFD; the device fd
+    opened on the privileged side arrives in the caller's process."""
+    binary, sock_path, _log, env = proxy
+    parent, child = socket.socketpair(socket.AF_UNIX, socket.SOCK_STREAM)
+    env2 = dict(env, _FUSE_COMMFD=str(child.fileno()))
+    rc = subprocess.run(
+        [binary, '--shim', '--socket', sock_path, '/mnt/bucket'],
+        env=env2, check=False, pass_fds=(child.fileno(),)).returncode
+    child.close()
+    assert rc == 0
+    _msg, fd = _recv_fd(parent)
+    parent.close()
+    assert fd >= 0, 'no fd relayed over SCM_RIGHTS'
+    # The relayed fd is the fake "/dev/fuse": readable end of the pipe.
+    assert os.read(fd, 64) == b'fake-fuse-device'
+    os.close(fd)
+
+
+def test_shim_fails_cleanly_without_server(tmp_path):
+    binary = native.fuse_proxy_binary()
+    if binary is None:
+        pytest.skip('no native toolchain')
+    rc = subprocess.run(
+        [binary, '--shim', '--socket', str(tmp_path / 'nope.sock'), '/m'],
+        check=False, capture_output=True).returncode
+    assert rc != 0
