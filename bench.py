@@ -70,6 +70,30 @@ def _measure_step_throughput(cfg, warmup: int, iters: int):
     return tflops_per_chip, tokens_per_s_chip, steps_per_s, final_loss
 
 
+def _measure_decode_throughput(cfg) -> float:
+    """Serving-side decode tokens/s (KV-cache generate path; the JetStream
+    analog metric — reference baseline: 2500 tok/s input throughput on
+    v6e, ``examples/tpu/v6e/README.md:118``)."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models import generate as gen_lib
+    from skypilot_tpu.models import llama
+
+    batch, prompt_len, new_tokens = 8, 128, 128
+    params = llama.init_params(jax.random.PRNGKey(0), cfg.model)
+    prompt = jnp.ones((batch, prompt_len), jnp.int32)
+    out = gen_lib.generate(params, cfg.model, prompt, new_tokens)  # compile
+    jax.device_get(out[0, 0])
+    t0 = _time.perf_counter()
+    out = gen_lib.generate(params, cfg.model, prompt, new_tokens)
+    jax.device_get(out[0, 0])
+    dt = _time.perf_counter() - t0
+    return batch * new_tokens / dt
+
+
 def _measure_provision_to_first_step() -> float:
     """Launch a task on the local provider; time launch-call -> first run
     output. Exercises provision + runtime bootstrap + gang exec for real."""
@@ -136,6 +160,12 @@ def _bench_tpu() -> dict:
         provision_s = round(_measure_provision_to_first_step(), 3)
     except Exception as exc:  # never let the latency probe kill the bench
         provision_s = f'failed: {type(exc).__name__}'
+    decode_tps = None
+    if on_tpu:
+        try:
+            decode_tps = round(_measure_decode_throughput(cfg), 1)
+        except Exception as exc:  # secondary metric: never kill the bench
+            decode_tps = f'failed: {type(exc).__name__}'
 
     baseline_tflops_per_chip = 23.48  # reference recipe, see module docstring
     n_chips = jax.device_count()
@@ -156,6 +186,7 @@ def _bench_tpu() -> dict:
             'tflops_per_chip_seq2048': (round(tf2k, 3)
                                         if tf2k is not None else None),
             'provision_to_first_step_s': provision_s,
+            'decode_tokens_per_sec': decode_tps,
             'cpu_fallback': not on_tpu,
         },
     }

@@ -167,9 +167,12 @@ void handle_conn(int conn, const std::string& fusermount) {
     char tmp[8];
     recv_fd(pair[0], tmp, sizeof(tmp), &mount_fd);
   }
-  int status = 0;
-  if (pid > 0) waitpid(pid, &status, 0);
-  int code = WIFEXITED(status) ? WEXITSTATUS(status) : 1;
+  int code = 1;  // fork failure must NOT read as success
+  if (pid > 0) {
+    int status = 0;
+    waitpid(pid, &status, 0);
+    code = WIFEXITED(status) ? WEXITSTATUS(status) : 1;
+  }
 
   char reply[8];
   std::snprintf(reply, sizeof(reply), "%d", code);

@@ -123,13 +123,61 @@ class AgentServicer:
         return pb.SetAutostopReply(ok=True)
 
 
+AUTOSTOP_FIRED_FILE = 'autostop.fired'
+
+
+def autostop_check_once(cluster_dir: str) -> bool:
+    """Head-side autostop evaluation (one step, pure — tests drive it
+    directly; the server polls it). When the job table has been idle past
+    the recorded policy, writes ``autostop.fired`` with the policy — the
+    signal the client-side daemon (and `status -r`) act on to stop/down
+    via the provider API (provider credentials live client-side this
+    round; reference: AutostopEvent, sky/skylet/events.py:161)."""
+    path = os.path.join(cluster_dir, constants.AUTOSTOP_FILE)
+    fired_path = os.path.join(cluster_dir, AUTOSTOP_FIRED_FILE)
+    try:
+        with open(path, encoding='utf-8') as f:
+            policy = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return False
+    if os.path.exists(fired_path):
+        return False
+    table = job_lib.JobTable(cluster_dir)
+    if table.unfinished_jobs():
+        return False
+    jobs = table.list_jobs(limit=1)
+    last = max([j['ended_at'] for j in jobs if j.get('ended_at')] or [0.0])
+    if last == 0.0:
+        # No job ever ran: idle since the policy was set.
+        last = os.path.getmtime(path)
+    if time.time() - last < policy.get('idle_minutes', 0) * 60:
+        return False
+    with open(fired_path, 'w', encoding='utf-8') as f:
+        json.dump({'fired_at': time.time(), **policy}, f)
+    return True
+
+
 def serve(cluster_dir: str, port: int, host: str = '127.0.0.1'
           ) -> grpc.Server:
     """Start the agent server; returns the grpc.Server (caller owns it).
     127.0.0.1-only by default: remote clients come through an SSH tunnel
     (the reference's security model, cloud_vm_ray_backend.py:2272-2443)."""
+    import threading
+
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))
     rpc_lib.add_agent_servicer(server, AgentServicer(cluster_dir))
+
+    def _autostop_loop(stop_event):  # 20s tick, like skylet events
+        while not stop_event.wait(20.0):
+            try:
+                autostop_check_once(cluster_dir)
+            except Exception:  # noqa: BLE001 — the watcher must survive
+                pass
+
+    stop_event = threading.Event()
+    threading.Thread(target=_autostop_loop, args=(stop_event,),
+                     daemon=True).start()
+    server.autostop_stop_event = stop_event  # type: ignore[attr-defined]
     bound = server.add_insecure_port(f'{host}:{port}')
     if bound == 0:
         # grpc returns 0 on bind failure (port taken by another cluster's

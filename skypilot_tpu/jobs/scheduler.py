@@ -47,13 +47,15 @@ LAUNCHING_GRACE_S = 900.0
 
 def _reconcile_stale_launching() -> None:
     for job_id in state.stale_launching_jobs(LAUNCHING_GRACE_S):
+        # CAS LAUNCHING->DONE: if the controller won the race and is ALIVE,
+        # the CAS fails and the healthy job is left alone.
+        if not state.cas_schedule_state(job_id,
+                                        [state.ScheduleState.LAUNCHING],
+                                        state.ScheduleState.DONE):
+            continue
         record = state.get(job_id)
-        if record is None:
+        if record is None or record['status'].is_terminal():
             continue
-        if record['status'].is_terminal():
-            state.set_schedule_state(job_id, state.ScheduleState.DONE)
-            continue
-        state.set_schedule_state(job_id, state.ScheduleState.DONE)
         state.set_status(
             job_id, state.ManagedJobStatus.FAILED_CONTROLLER,
             detail=f'controller never started within {LAUNCHING_GRACE_S:.0f}s')
@@ -77,8 +79,11 @@ def maybe_schedule_next() -> None:
                 job_name=f'jobs-controller-{job_id}',
                 cluster_name=controller_utils.JOBS_CONTROLLER_CLUSTER)
             # Restart the grace clock now that the (possibly slow)
-            # controller-cluster provisioning is behind us.
-            state.set_schedule_state(job_id, state.ScheduleState.LAUNCHING)
+            # controller-cluster provisioning is behind us — but only if
+            # the controller has not ALREADY reported in (a fast
+            # controller's ALIVE must not be clobbered back to LAUNCHING).
+            state.cas_schedule_state(job_id, [state.ScheduleState.LAUNCHING],
+                                     state.ScheduleState.LAUNCHING)
         except Exception as e:  # noqa: BLE001 — record, release the slot
             state.set_schedule_state(job_id, state.ScheduleState.DONE)
             state.set_status(job_id, state.ManagedJobStatus.FAILED_CONTROLLER,
@@ -86,11 +91,12 @@ def maybe_schedule_next() -> None:
 
 
 def controller_started(job_id: int) -> None:
-    record = state.get(job_id)
-    if record is not None and record.get('schedule_state') == \
-            state.ScheduleState.DONE.value:
-        return  # reaped as stale before we got here; stay DONE
-    state.set_schedule_state(job_id, state.ScheduleState.ALIVE)
+    # Atomic: a job reaped to DONE by the stale-LAUNCHING sweep stays DONE
+    # (the CAS fails); otherwise LAUNCHING/WAITING -> ALIVE.
+    state.cas_schedule_state(
+        job_id,
+        [state.ScheduleState.WAITING, state.ScheduleState.LAUNCHING],
+        state.ScheduleState.ALIVE)
 
 
 def controller_finished(job_id: int) -> None:

@@ -212,6 +212,22 @@ def set_schedule_state(job_id: int, sched: ScheduleState) -> None:
             (sched.value, time.time(), job_id))
 
 
+def cas_schedule_state(job_id: int, expected: List[ScheduleState],
+                       new: ScheduleState) -> bool:
+    """Atomic compare-and-set: transition only from an expected state.
+    The scheduler and the controller process race on these transitions
+    (LAUNCHING->ALIVE vs stale-reap->DONE); single-UPDATE atomicity keeps
+    the admission accounting consistent."""
+    values = [s.value for s in expected]
+    with _lock(), _conn() as conn:
+        cur = conn.execute(
+            f'UPDATE managed_jobs SET schedule_state = ?, '
+            f'schedule_state_at = ? WHERE job_id = ? AND schedule_state IN '
+            f'({",".join("?" * len(values))})',
+            [new.value, time.time(), job_id] + values)
+        return cur.rowcount > 0
+
+
 def stale_launching_jobs(older_than_s: float) -> List[int]:
     """LAUNCHING jobs whose controller never reported in (crashed between
     task submission and controller_started): candidates for reconciliation

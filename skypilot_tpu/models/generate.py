@@ -1,0 +1,194 @@
+"""KV-cache autoregressive generation (the serving-side compute path).
+
+Reference analog: the reference serves LLMs by launching JetStream / vLLM
+workloads (``examples/tpu/v6e/README.md:112-118``); this is the TPU-native
+in-framework equivalent: prefill + cached decode, everything jitted with
+static shapes (XLA-friendly: the cache is a fixed ``max_len`` ring buffer
+indexed with ``dynamic_update_slice``; the decode loop is ``lax.scan``).
+
+Layers run under ``lax.scan`` with the per-layer cache slices as scan
+xs/ys, so one compiled layer body serves any depth — same trick as the
+training stack (``models/llama.py``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models import llama
+
+Params = llama.Params
+_NEG_INF = -1e30
+
+
+@dataclasses.dataclass
+class KVCache:
+    """Per-layer key/value ring buffers: [L, B, Hkv, max_len, D]."""
+    k: jax.Array
+    v: jax.Array
+    length: jax.Array  # int32 scalar: tokens currently cached
+
+
+jax.tree_util.register_dataclass(
+    KVCache, data_fields=['k', 'v', 'length'], meta_fields=[])
+
+
+def init_cache(cfg: llama.LlamaConfig, batch: int, max_len: int,
+               dtype=None) -> KVCache:
+    dtype = dtype or cfg.dtype
+    shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, cfg.head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                   length=jnp.zeros((), jnp.int32))
+
+
+def _cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                      positions: jax.Array, valid_len: jax.Array
+                      ) -> jax.Array:
+    """q: [B, S, Hq, D] (absolute ``positions`` [B, S]);
+    k/v_cache: [B, Hkv, max_len, D] already containing this block's keys.
+    Attends causally over the first ``valid_len`` cache slots."""
+    b, s, hq, d = q.shape
+    hkv = k_cache.shape[1]
+    group = hq // hkv
+    max_len = k_cache.shape[2]
+    qg = q.transpose(0, 2, 1, 3).reshape(b, hkv, group, s, d)
+    scale = d ** -0.5
+    logits = jnp.einsum('bhgqd,bhkd->bhgqk', qg, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    ki = jax.lax.broadcasted_iota(jnp.int32, (b, 1, 1, s, max_len), 4)
+    qi = positions[:, None, None, :, None]  # absolute query positions
+    mask = (ki <= qi) & (ki < valid_len)
+    logits = jnp.where(mask, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum('bhgqk,bhkd->bhgqd', probs.astype(v_cache.dtype),
+                     v_cache, preferred_element_type=jnp.float32)
+    return out.reshape(b, hkv * group, s, d).transpose(0, 2, 1, 3).astype(
+        q.dtype)
+
+
+def _cached_layer(cfg: llama.LlamaConfig, x: jax.Array, layer: Params,
+                  positions: jax.Array, k_cache: jax.Array,
+                  v_cache: jax.Array, cache_len: jax.Array
+                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One decoder block writing this block's K/V into the cache.
+    x: [B, S, d]; k/v_cache: [B, Hkv, max_len, D]; returns (x, k, v)."""
+    h = llama.rms_norm(x, layer['attn_norm'], cfg.norm_eps)
+    q = jnp.einsum('bsd,dhk->bshk', h, layer['wq'])
+    k = jnp.einsum('bsd,dhk->bshk', h, layer['wk'])
+    v = jnp.einsum('bsd,dhk->bshk', h, layer['wv'])
+    q = llama.rope(q, positions, cfg.rope_theta)
+    k = llama.rope(k, positions, cfg.rope_theta)
+    # Write the new keys/values at [cache_len, cache_len + S).
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k.transpose(0, 2, 1, 3).astype(k_cache.dtype),
+        (0, 0, cache_len, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v.transpose(0, 2, 1, 3).astype(v_cache.dtype),
+        (0, 0, cache_len, 0))
+    valid = cache_len + x.shape[1]
+    att = _cached_attention(q, k_cache, v_cache, positions, valid)
+    x = x + jnp.einsum('bshk,hkd->bsd', att, layer['wo'])
+    h = llama.rms_norm(x, layer['mlp_norm'], cfg.norm_eps)
+    gate = jnp.einsum('bsd,df->bsf', h, layer['w_gate'])
+    up = jnp.einsum('bsd,df->bsf', h, layer['w_up'])
+    x = x + jnp.einsum('bsf,fd->bsd', jax.nn.silu(gate) * up,
+                       layer['w_down'])
+    return x, k_cache, v_cache
+
+
+def forward_cached(params: Params, tokens: jax.Array,
+                   cache: KVCache, cfg: llama.LlamaConfig
+                   ) -> Tuple[jax.Array, KVCache]:
+    """Run ``tokens`` [B, S] through the model appending to ``cache``;
+    returns (logits for the LAST position [B, vocab], updated cache).
+    Works for both prefill (S = prompt length) and decode (S = 1)."""
+    if cfg.num_experts > 0:
+        raise NotImplementedError(
+            'Cached generation covers dense models; MoE decode lands with '
+            'the expert-parallel serving path.')
+    b, s = tokens.shape
+    positions = (cache.length
+                 + jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s)))
+    x = params['embed'].astype(cfg.dtype)[tokens]
+
+    def body(carry, xs):
+        x = carry
+        layer, k_c, v_c = xs
+        x, k_c, v_c = _cached_layer(cfg, x, layer, positions, k_c, v_c,
+                                    cache.length)
+        return x, (k_c, v_c)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params['layers'], cache.k, cache.v))
+    x = llama.rms_norm(x, params['final_norm'], cfg.norm_eps)
+    logits = jnp.einsum('bd,dv->bv', x[:, -1], params['lm_head'],
+                        preferred_element_type=jnp.float32)
+    return logits, KVCache(k=new_k, v=new_v, length=cache.length + s)
+
+
+def _sample(logits: jax.Array, temperature: float,
+            key: Optional[jax.Array]) -> jax.Array:
+    if temperature == 0.0 or key is None:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(key, logits / temperature,
+                                  axis=-1).astype(jnp.int32)
+
+
+# Module-level jits: the caches are keyed by (shapes, static args) and
+# persist across generate() calls — a serving replica compiles once per
+# (batch, prompt_len, max_len, n, temperature) shape, then decodes at
+# steady-state speed.
+_jit_prefill = jax.jit(forward_cached, static_argnums=(3,))
+
+
+def _decode_scan_impl(params, cache, first, key, cfg, n, temperature):
+    def step(carry, _):
+        cache, token, key = carry
+        logits, cache = forward_cached(params, token[:, None], cache, cfg)
+        if temperature > 0.0:
+            key, sub = jax.random.split(key)
+        else:
+            sub = None
+        nxt = _sample(logits, temperature, sub)
+        return (cache, nxt, key), nxt
+
+    (_, _, _), toks = jax.lax.scan(step, (cache, first, key),
+                                   None, length=n - 1)
+    return toks
+
+
+_jit_decode_scan = jax.jit(_decode_scan_impl, static_argnums=(4, 5, 6))
+
+
+def generate(params: Params, cfg: llama.LlamaConfig,
+             prompt: jax.Array, max_new_tokens: int,
+             temperature: float = 0.0,
+             key: Optional[jax.Array] = None,
+             max_len: Optional[int] = None) -> jax.Array:
+    """prompt: [B, S_p] int32 -> [B, max_new_tokens] generated ids.
+    Greedy when temperature == 0 (deterministic parity with full forward);
+    one jitted prefill + one jitted lax.scan of decode steps."""
+    b, s_p = prompt.shape
+    max_len = max_len or min(cfg.max_seq_len, s_p + max_new_tokens)
+    assert s_p + max_new_tokens <= max_len, (s_p, max_new_tokens, max_len)
+    cache = init_cache(cfg, b, max_len)
+    if temperature > 0.0 and key is None:
+        raise ValueError('temperature > 0 requires a PRNG key')
+    if key is None:
+        key = jax.random.PRNGKey(0)  # unused in the greedy branch
+
+    logits, cache = _jit_prefill(params, prompt, cache, cfg)
+    if temperature > 0.0:
+        key, first_key = jax.random.split(key)
+    else:
+        first_key = None
+    first = _sample(logits, temperature, first_key)
+
+    if max_new_tokens == 1:
+        return first[:, None]
+    rest = _jit_decode_scan(params, cache, first, key, cfg,
+                            max_new_tokens, temperature)  # [T-1, B]
+    return jnp.concatenate([first[:, None], rest.transpose(1, 0)], axis=1)

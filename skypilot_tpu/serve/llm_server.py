@@ -1,0 +1,103 @@
+"""In-framework LLM inference server (JetStream analog).
+
+Reference analog: the reference serves LLMs by pointing ``sky serve`` at
+JetStream/vLLM containers (``examples/tpu/v6e/README.md:112-118``); this is
+the TPU-native replica process: the KV-cache generate path
+(``models/generate.py``) behind a minimal HTTP API, ready to sit behind the
+serve load balancer.
+
+API (token-level; tokenization is the client's concern — no tokenizer
+assets ship in-image):
+  GET  /health               -> {"status": "ok", "model": ...}
+  POST /generate             {"tokens": [[...]], "max_new_tokens": N,
+                              "temperature": t?, "seed": s?}
+                             -> {"tokens": [[...]]}
+
+Run: ``python -m skypilot_tpu.serve.llm_server --model tiny``
+(port from --port or SKYTPU_REPLICA_PORT — the serve plane's contract).
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from aiohttp import web
+
+from skypilot_tpu.models import generate as gen_lib
+from skypilot_tpu.models import llama
+
+
+class LlmServer:
+
+    def __init__(self, model: str, max_len: int = 1024, seed: int = 0):
+        self.model_name = model
+        self.cfg = llama.PRESETS[model]
+        self.max_len = min(max_len, self.cfg.max_seq_len)
+        self.params = llama.init_params(jax.random.PRNGKey(seed), self.cfg)
+        # One request generates at a time per replica (the LB's least-load
+        # policy spreads concurrency across replicas).
+        self._lock = asyncio.Lock()
+
+    async def health(self, request: web.Request) -> web.Response:
+        del request
+        return web.json_response({'status': 'ok', 'model': self.model_name,
+                                  'max_len': self.max_len})
+
+    async def generate(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        tokens = body.get('tokens')
+        if not tokens:
+            return web.json_response({'error': 'tokens required'},
+                                     status=400)
+        max_new = int(body.get('max_new_tokens', 32))
+        temperature = float(body.get('temperature', 0.0))
+        seed: Optional[int] = body.get('seed')
+        prompt = jnp.asarray(tokens, jnp.int32)
+        if prompt.ndim == 1:
+            prompt = prompt[None]
+        if prompt.shape[1] + max_new > self.max_len:
+            return web.json_response(
+                {'error': f'prompt+max_new_tokens exceeds max_len '
+                          f'{self.max_len}'}, status=400)
+        key = None
+        if temperature > 0:
+            # No seed given: sample a fresh one — "temperature 0.8" must
+            # actually sample, not silently fall back to greedy.
+            import secrets
+            key = jax.random.PRNGKey(
+                seed if seed is not None else secrets.randbits(31))
+        async with self._lock:
+            out = await asyncio.get_event_loop().run_in_executor(
+                None, lambda: jax.device_get(gen_lib.generate(
+                    self.params, self.cfg, prompt, max_new,
+                    temperature=temperature, key=key,
+                    max_len=self.max_len)))
+        return web.json_response({'tokens': out.tolist()})
+
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get('/health', self.health)
+        app.router.add_post('/generate', self.generate)
+        return app
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='tiny')
+    parser.add_argument('--max-len', type=int, default=1024)
+    parser.add_argument('--port', type=int,
+                        default=int(os.environ.get('SKYTPU_REPLICA_PORT',
+                                                   '8080')))
+    parser.add_argument('--host', default='0.0.0.0')
+    args = parser.parse_args()
+    server = LlmServer(args.model, max_len=args.max_len)
+    web.run_app(server.make_app(), host=args.host, port=args.port,
+                print=lambda *a: None)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,165 @@
+"""Web dashboard: fleet state in the browser.
+
+Reference analog: ``sky/dashboard/`` (a 29k-LoC Next.js app served from the
+API server, ``server.py:2100``). TPU-native build keeps the dashboard
+dependency-free: one self-contained HTML page (no build step, no node)
+polling a read-only JSON state endpoint; clusters, managed jobs, services
+and API requests in one view.
+
+Routes (registered by ``server.py``):
+  GET /dashboard            -> the page
+  GET /dashboard/api/state  -> {"clusters": [...], "jobs": [...],
+                                "services": [...], "requests": [...]}
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from aiohttp import web
+
+
+def state_snapshot() -> Dict[str, Any]:
+    """Synchronous read-only snapshot of all state tables (cheap SQLite
+    reads — no request-executor round trip needed for a dashboard poll)."""
+    from skypilot_tpu import global_user_state
+    from skypilot_tpu.jobs import state as jobs_state
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.server import requests_db
+
+    clusters = []
+    for rec in global_user_state.get_clusters():
+        handle = rec.get('handle') or {}
+        res = handle.get('launched_resources') or {}
+        clusters.append({
+            'name': rec['name'],
+            'status': rec['status'].value,
+            'cloud': handle.get('cloud'),
+            'region': handle.get('region'),
+            'resources': res.get('accelerators') or res.get('instance_type')
+            or res.get('cpus') or '-',
+            'nodes': handle.get('num_nodes'),
+            'price_per_hour': handle.get('price_per_hour'),
+            'launched_at': rec.get('launched_at'),
+        })
+    jobs = [{
+        'job_id': r['job_id'],
+        'name': r['name'],
+        'status': r['status'].value,
+        'schedule_state': r.get('schedule_state'),
+        'cluster': r['cluster_name'],
+        'recoveries': r['recovery_count'],
+        'submitted_at': r['submitted_at'],
+    } for r in jobs_state.list_jobs()]
+    services = []
+    for svc in serve_state.list_services():
+        if svc is None:
+            continue
+        replicas = serve_state.list_replicas(svc['name'])
+        services.append({
+            'name': svc['name'],
+            'status': svc['status'].value,
+            'endpoint': svc['endpoint'],
+            'version': svc.get('version'),
+            'replicas': [{
+                'replica_id': r['replica_id'],
+                'status': r['status'].value,
+                'version': r.get('version'),
+                'endpoint': r['endpoint'],
+            } for r in replicas],
+        })
+    return {
+        'clusters': clusters,
+        'jobs': jobs,
+        'services': services,
+        'requests': requests_db.list_requests(limit=50),
+    }
+
+
+async def api_state(request: web.Request) -> web.Response:
+    del request
+    return web.json_response(state_snapshot())
+
+
+_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>skypilot-tpu</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:24px;background:#fafafa;
+      color:#1a1a1a}
+ h1{font-size:20px} h2{font-size:15px;margin:24px 0 8px}
+ table{border-collapse:collapse;width:100%;background:#fff;
+       box-shadow:0 1px 2px rgba(0,0,0,.08)}
+ th,td{padding:6px 10px;text-align:left;font-size:13px;
+       border-bottom:1px solid #eee}
+ th{background:#f0f0f3;font-weight:600}
+ .b{display:inline-block;padding:1px 8px;border-radius:9px;font-size:12px}
+ .UP,.RUNNING,.READY,.SUCCEEDED,.ALIVE{background:#d9f2e2;color:#066a2e}
+ .INIT,.PENDING,.STARTING,.PROVISIONING,.SUBMITTED,.RECOVERING,.WAITING,
+ .LAUNCHING,.SETTING_UP,.REPLICA_INIT,.CONTROLLER_INIT{background:#fdf2d0;
+ color:#7a5b00}
+ .STOPPED,.CANCELLED,.SHUTDOWN,.DONE{background:#e8e8ec;color:#444}
+ .FAILED,.FAILED_SETUP,.FAILED_CONTROLLER,.FAILED_NO_RESOURCE,.NOT_READY
+ {background:#fbdcd9;color:#9d1c0e}
+ #ts{color:#888;font-size:12px}
+</style></head><body>
+<h1>skypilot-tpu <span id="ts"></span></h1>
+<h2>Clusters</h2><table id="clusters"></table>
+<h2>Managed jobs</h2><table id="jobs"></table>
+<h2>Services</h2><table id="services"></table>
+<h2>API requests</h2><table id="requests"></table>
+<script>
+// Token-protected servers: open /dashboard?token=...; the token rides
+// along on state polls.
+const TOKEN = new URLSearchParams(location.search).get('token');
+const HDRS = TOKEN ? {'Authorization': 'Bearer ' + TOKEN} : {};
+// Escape EVERYTHING interpolated into innerHTML: names/endpoints are
+// user-controlled (stored-XSS vector otherwise).
+const esc = v => String(v ?? '-').replace(/[&<>"']/g,
+    ch => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[ch]));
+const B = s => `<span class="b ${esc(s)}">${esc(s)}</span>`;
+const T = t => t ? new Date(t*1000).toLocaleTimeString() : '-';
+function fill(id, cols, rows, render){
+  const el = document.getElementById(id);
+  el.innerHTML = '<tr>' + cols.map(c=>`<th>${c}</th>`).join('') + '</tr>' +
+    (rows.length ? rows.map(render).join('')
+                 : `<tr><td colspan="${cols.length}">none</td></tr>`);
+}
+async function tick(){
+  try{
+    const s = await (await fetch('dashboard/api/state', {headers: HDRS})).json();
+    document.getElementById('ts').textContent =
+        'updated ' + new Date().toLocaleTimeString();
+    fill('clusters',
+         ['name','status','cloud','region','resources','nodes','$/hr',
+          'launched'],
+         s.clusters, c=>`<tr><td>${esc(c.name)}</td><td>${B(c.status)}</td>
+          <td>${esc(c.cloud)}</td><td>${esc(c.region)}</td>
+          <td>${esc(c.resources)}</td><td>${c.nodes??'-'}</td>
+          <td>${c.price_per_hour!=null?c.price_per_hour.toFixed(2):'-'}</td>
+          <td>${T(c.launched_at)}</td></tr>`);
+    fill('jobs',
+         ['id','name','status','schedule','cluster','recoveries',
+          'submitted'],
+         s.jobs, j=>`<tr><td>${esc(j.job_id)}</td><td>${esc(j.name)}</td>
+          <td>${B(j.status)}</td><td>${B(j.schedule_state)}</td>
+          <td>${esc(j.cluster)}</td><td>${esc(j.recoveries)}</td>
+          <td>${T(j.submitted_at)}</td></tr>`);
+    fill('services',
+         ['name','status','version','endpoint','replicas'],
+         s.services, v=>`<tr><td>${esc(v.name)}</td><td>${B(v.status)}</td>
+          <td>v${v.version??1}</td><td>${esc(v.endpoint)}</td>
+          <td>${v.replicas.map(r=>`#${esc(r.replica_id)} ${B(r.status)}
+          v${r.version??1}`).join(' ')}</td></tr>`);
+    fill('requests',
+         ['request id','op','status','created','finished'],
+         s.requests, r=>`<tr><td>${esc(r.request_id)}</td><td>${esc(r.name)}</td>
+          <td>${B(r.status)}</td><td>${T(r.created_at)}</td>
+          <td>${T(r.finished_at)}</td></tr>`);
+  }catch(e){ document.getElementById('ts').textContent = 'error: '+e; }
+}
+tick(); setInterval(tick, 2000);
+</script></body></html>"""
+
+
+async def page(request: web.Request) -> web.Response:
+    del request
+    return web.Response(text=_PAGE, content_type='text/html')

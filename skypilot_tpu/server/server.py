@@ -176,7 +176,10 @@ async def auth_middleware(request: web.Request, handler):
     setting SKYTPU_API_TOKEN on the server; /health stays open so clients
     can discover they need a token."""
     token = os.environ.get('SKYTPU_API_TOKEN')
-    if token and request.path != '/health':
+    # /health stays open for discovery; /dashboard (the static page, no
+    # data) too — it attaches the token from its ?token= query to the
+    # protected /dashboard/api/state polls.
+    if token and request.path not in ('/health', '/dashboard'):
         import hmac
         supplied = request.headers.get('Authorization', '')
         if not hmac.compare_digest(supplied, f'Bearer {token}'):
@@ -185,8 +188,11 @@ async def auth_middleware(request: web.Request, handler):
 
 
 def make_app() -> web.Application:
+    from skypilot_tpu.server import dashboard
     app = web.Application(middlewares=[auth_middleware])
     app.add_routes(routes)
+    app.router.add_get('/dashboard', dashboard.page)
+    app.router.add_get('/dashboard/api/state', dashboard.api_state)
     for op in ('launch', 'exec', 'down', 'stop', 'start', 'autostop',
                'cancel'):
         app.router.add_post(f'/api/v1/{op}', _make_post(op))

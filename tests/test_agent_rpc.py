@@ -91,3 +91,20 @@ def test_autostop_rpc(agent):
         assert json.load(f) == {'idle_minutes': 7, 'down': True}
     assert client.cancel_autostop()
     assert not os.path.exists(path)
+
+
+def test_autostop_fires_on_idle(agent, tmp_path):
+    """Head-side autostop evaluation: policy set over RPC, idleness past
+    the deadline produces the fired marker (the stop/down signal)."""
+    table, client, cluster_dir = agent
+    assert client.set_autostop(idle_minutes=0, down=False)  # fire instantly
+    # A running job blocks firing.
+    jid = table.submit('busy', 1, 1, log_dir=os.path.join(cluster_dir, 'j'))
+    table.set_status(jid, job_lib.JobStatus.RUNNING, driver_pid=0)
+    assert not rpc_server.autostop_check_once(cluster_dir)
+    # Finished job + 0-minute policy: fires once, then stays fired.
+    table.set_status(jid, job_lib.JobStatus.SUCCEEDED)
+    assert rpc_server.autostop_check_once(cluster_dir)
+    fired = os.path.join(cluster_dir, rpc_server.AUTOSTOP_FIRED_FILE)
+    assert os.path.exists(fired)
+    assert not rpc_server.autostop_check_once(cluster_dir)  # idempotent

@@ -288,3 +288,15 @@ def test_metrics_endpoint(server):
     body = r.text
     assert 'skytpu_api_requests_total' in body
     assert 'skytpu_api_request_table' in body
+
+
+def test_dashboard_page_and_state(server):
+    """The dashboard (reference: sky/dashboard/, Next.js) — here a self-
+    contained page + JSON state endpoint on the API server."""
+    r = requests_lib.get(f'{server}/dashboard', timeout=10)
+    assert r.status_code == 200
+    assert 'skypilot-tpu' in r.text and 'Clusters' in r.text
+    r = requests_lib.get(f'{server}/dashboard/api/state', timeout=10)
+    assert r.status_code == 200
+    body = r.json()
+    assert set(body) == {'clusters', 'jobs', 'services', 'requests'}
