@@ -111,6 +111,12 @@ class AgentServicer:
                     ) -> pb.SetAutostopReply:
         del context
         path = os.path.join(self.cluster_dir, constants.AUTOSTOP_FILE)
+        # Setting OR cancelling re-arms: a stale fired marker must not
+        # block a fresh policy from ever firing again.
+        try:
+            os.unlink(os.path.join(self.cluster_dir, AUTOSTOP_FIRED_FILE))
+        except OSError:
+            pass
         if request.cancel:
             try:
                 os.unlink(path)
@@ -145,7 +151,9 @@ def autostop_check_once(cluster_dir: str) -> bool:
     table = job_lib.JobTable(cluster_dir)
     if table.unfinished_jobs():
         return False
-    jobs = table.list_jobs(limit=1)
+    # Idle since the LAST job to END anywhere in the table (not the last
+    # submitted: an early-submitted long-runner can end after later jobs).
+    jobs = table.list_jobs(limit=100000)
     last = max([j['ended_at'] for j in jobs if j.get('ended_at')] or [0.0])
     if last == 0.0:
         # No job ever ran: idle since the policy was set.

@@ -67,6 +67,12 @@ class Backend:
                          file_mounts: Dict[str, str]) -> None:
         raise NotImplementedError
 
+    def sync_volumes(self, handle: ClusterHandle,
+                     volumes: Dict[str, str]) -> None:
+        """Attach/mount persistent volumes; default: none supported."""
+        if volumes:
+            raise NotImplementedError
+
     def execute(self, handle: ClusterHandle, task: Task,
                 detach_run: bool = False,
                 include_setup: bool = True) -> int:

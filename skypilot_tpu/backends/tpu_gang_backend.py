@@ -316,6 +316,85 @@ class TpuGangBackend(Backend):
                             f'Mounting {st.source} at {dst} failed on '
                             f'{inst.instance_id} (rc={rc})')
 
+    @timeline.event
+    def sync_volumes(self, handle: ClusterHandle,
+                     volumes: Dict[str, str]) -> None:
+        """Attach + mount persistent volumes (reference: ``sky/volumes/``
+        applied through the task's ``volumes:`` section).
+        GCP: attach the disk to every instance then mount by device id;
+        local/fake: the volume's backing dir is symlinked in."""
+        if not volumes:
+            return
+        from skypilot_tpu import volumes as volumes_lib
+        # Attachment conflicts are rejected up front (a volume attached to
+        # another live cluster must not be stolen); the attachment itself
+        # is recorded only after mounts succeed.
+        from skypilot_tpu import global_user_state as _gus
+        for vol_name in volumes.values():
+            vol = _gus.get_volume(vol_name)
+            if vol is None:
+                raise exceptions.StorageError(
+                    f'Volume {vol_name!r} not found.')
+            if vol['attached_to'] and \
+                    vol['attached_to'] != handle.cluster_name:
+                raise exceptions.StorageError(
+                    f'Volume {vol_name!r} is attached to '
+                    f'{vol["attached_to"]!r}; down that cluster first.')
+        if handle.cloud in ('local', 'fake'):
+            for dst, vol_name in volumes.items():
+                dst_local = dst
+                if not os.path.isabs(dst_local):
+                    dst_local = os.path.join(
+                        runtime_dir(handle.cluster_name),
+                        constants.WORKDIR_SUBDIR, dst_local)
+                cmd = volumes_lib.mount_command(vol_name, dst_local)
+                rc = RunnerSpec(kind='local').make().run(cmd)
+                if rc != 0:
+                    raise exceptions.StorageError(
+                        f'Mounting volume {vol_name} at {dst} failed '
+                        f'(rc={rc})')
+                volumes_lib.record_attachment(vol_name, handle.cluster_name)
+            return
+        info = self._cluster_info(handle)
+        multi_worker = info.num_workers > 1
+        for dst, vol_name in volumes.items():
+            if handle.cloud == 'gcp':
+                from skypilot_tpu import global_user_state as gus
+                from skypilot_tpu.provision.gcp import \
+                    instance as gcp_instance
+                from skypilot_tpu.provision.gcp import \
+                    tpu_client as tpu_client_lib
+                vol = gus.get_volume(vol_name)
+                if vol is None:
+                    raise exceptions.StorageError(
+                        f'Volume {vol_name!r} not found.')
+                client = gcp_instance._compute_client()  # pylint: disable=protected-access
+                for inst in info.all_workers_sorted():
+                    # instance name = instance_id minus the -wK suffix.
+                    # >1 worker: attach read-only (GCP rejects multi-RW on
+                    # standard disk types); already-attached is idempotent.
+                    vm = inst.instance_id.rsplit('-w', 1)[0]
+                    try:
+                        client.wait_operation(
+                            vol['zone'],
+                            client.attach_disk(vol['zone'], vm, vol_name,
+                                               read_only=multi_worker))
+                    except tpu_client_lib.GcpApiError as e:
+                        low = str(e).lower()
+                        if ('already' in low or 'in_use' in low
+                                or 'in use' in low):
+                            continue
+                        raise
+            cmd = volumes_lib.mount_command(vol_name, dst)
+            for inst in info.all_workers_sorted():
+                runner = self._runner_spec_for(handle, inst, info).make()
+                rc = runner.run(cmd)
+                if rc != 0:
+                    raise exceptions.StorageError(
+                        f'Mounting volume {vol_name} at {dst} failed on '
+                        f'{inst.instance_id} (rc={rc})')
+            volumes_lib.record_attachment(vol_name, handle.cluster_name)
+
     # -- execute -----------------------------------------------------------
 
     @timeline.event
@@ -452,6 +531,8 @@ class TpuGangBackend(Backend):
                 handle.cloud, handle.cluster_name_on_cloud,
                 provider_config=handle.provider_config)
             global_user_state.remove_cluster(handle.cluster_name)
+            from skypilot_tpu import volumes as volumes_lib
+            volumes_lib.detach_all(handle.cluster_name)
             shutil.rmtree(runtime_dir(handle.cluster_name),
                           ignore_errors=True)
         else:

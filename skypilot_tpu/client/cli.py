@@ -279,9 +279,6 @@ def cost_report():
                  ('price_per_hour', '$/HR'), ('cost', 'COST($)')])
 
 
-if __name__ == '__main__':
-    cli()
-
 
 @cli.group('jobs')
 def jobs_group():
@@ -413,3 +410,81 @@ def serve_down(service_name):
     from skypilot_tpu import serve
     serve.down(service_name)
     click.echo(f'Service {service_name} shutting down.')
+
+
+@cli.group('volumes')
+def volumes_group():
+    """Persistent volumes (reference: `sky volumes`)."""
+
+
+@volumes_group.command('create')
+@click.argument('name')
+@click.option('--size', default=100, help='Size in GB.')
+@click.option('--cloud', default='local')
+@click.option('--zone', default=None)
+@click.option('--type', 'volume_type', default='pd-balanced')
+def volumes_create(name, size, cloud, zone, volume_type):
+    from skypilot_tpu import volumes as volumes_lib
+    vol = volumes_lib.create(name, size_gb=size, cloud=cloud, zone=zone,
+                             volume_type=volume_type)
+    click.echo(f'Created volume {vol["name"]} ({vol["size_gb"]} GB, '
+               f'{vol["cloud"]}).')
+
+
+@volumes_group.command('ls')
+def volumes_ls():
+    from skypilot_tpu import volumes as volumes_lib
+    vols = volumes_lib.list_volumes()
+    if not vols:
+        click.echo('No volumes.')
+        return
+    for v in vols:
+        click.echo(f'{v["name"]:24s} {v["cloud"]:8s} {v["size_gb"]:>6d}GB '
+                   f'{v["status"]:8s} attached={v["attached_to"] or "-"}')
+
+
+@volumes_group.command('rm')
+@click.argument('name')
+def volumes_rm(name):
+    from skypilot_tpu import volumes as volumes_lib
+    volumes_lib.delete(name)
+    click.echo(f'Deleted volume {name}.')
+
+
+@cli.group('users')
+def users_group():
+    """User/RBAC management for the API server (reference: `sky/users`)."""
+
+
+@users_group.command('add')
+@click.argument('name')
+@click.option('--token', required=True, help='Bearer token for this user.')
+@click.option('--role', default='user',
+              type=click.Choice(['viewer', 'user', 'admin']))
+def users_add(name, token, role):
+    from skypilot_tpu import users as users_lib
+    users_lib.add_user(name, token, role)
+    click.echo(f'Added user {name} ({role}).')
+
+
+@users_group.command('ls')
+def users_ls():
+    from skypilot_tpu import users as users_lib
+    rows = users_lib.list_users()
+    if not rows:
+        click.echo('No users registered (single-user mode).')
+        return
+    for u in rows:
+        click.echo(f'{u["name"]:24s} {u["role"]}')
+
+
+@users_group.command('rm')
+@click.argument('name')
+def users_rm(name):
+    from skypilot_tpu import users as users_lib
+    users_lib.remove_user(name)
+    click.echo(f'Removed user {name}.')
+
+
+if __name__ == '__main__':
+    cli()

@@ -85,6 +85,10 @@ class ClusterOwnerIdentityMismatchError(SkyTpuError):
     """Cluster was created under a different cloud identity."""
 
 
+class PermissionDeniedError(SkyTpuError):
+    """RBAC/ownership violation (reference: sky/users/permission.py)."""
+
+
 class NotSupportedError(SkyTpuError):
     """The requested operation is not supported by this cloud/backend."""
 

@@ -12,6 +12,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from skypilot_tpu import exceptions
 from skypilot_tpu import global_user_state
 from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import usage
 from skypilot_tpu.backends import ClusterHandle, TpuGangBackend
 from skypilot_tpu.task import Task
 from skypilot_tpu.utils import timeline
@@ -30,6 +31,7 @@ def _generate_cluster_name() -> str:
     return f'stpu-{uuid.uuid4().hex[:6]}'
 
 
+@usage.entrypoint('launch')
 @timeline.event
 def launch(task: Task,
            cluster_name: Optional[str] = None,
@@ -55,6 +57,11 @@ def launch(task: Task,
         task=task, cluster_name=cluster_name,
         idle_minutes_to_autostop=idle_minutes_to_autostop, down=down))
 
+    # Fail-fast config validation BEFORE anything bills: an invalid
+    # logs.store would otherwise only surface mid-bootstrap.
+    from skypilot_tpu import logs as logs_lib
+    logs_lib.agent_from_config()
+
     if Stage.OPTIMIZE in stages:
         existing = global_user_state.get_cluster(cluster_name)
         if existing is None and task.best_resources is None:
@@ -78,6 +85,7 @@ def launch(task: Task,
     if Stage.SYNC_FILE_MOUNTS in stages:
         backend.sync_file_mounts(handle, task.file_mounts)
         backend.sync_storage_mounts(handle, task.storage_mounts)
+        backend.sync_volumes(handle, getattr(task, 'volumes', {}))
 
     job_id: Optional[int] = None
     if Stage.EXEC in stages and (task.run is not None or task.setup):
@@ -89,6 +97,7 @@ def launch(task: Task,
     return job_id, handle
 
 
+@usage.entrypoint('exec')
 @timeline.event
 def exec_(task: Task, cluster_name: str,
           detach_run: bool = False) -> Tuple[Optional[int], ClusterHandle]:

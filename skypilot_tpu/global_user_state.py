@@ -54,7 +54,56 @@ CREATE TABLE IF NOT EXISTS cluster_events (
     event TEXT,
     detail TEXT
 );
+CREATE TABLE IF NOT EXISTS volumes (
+    name TEXT PRIMARY KEY,
+    cloud TEXT,
+    region TEXT,
+    zone TEXT,
+    size_gb INTEGER,
+    volume_type TEXT,
+    status TEXT,
+    created_at REAL,
+    attached_to TEXT,
+    backing TEXT
+);
 """
+
+
+def add_volume(name: str, cloud: str, region: Optional[str],
+               zone: Optional[str], size_gb: int, volume_type: str,
+               backing: str) -> None:
+    with _lock(), _conn() as conn:
+        conn.execute(
+            'INSERT INTO volumes (name, cloud, region, zone, size_gb, '
+            'volume_type, status, created_at, backing) '
+            'VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)',
+            (name, cloud, region, zone, size_gb, volume_type, 'READY',
+             time.time(), backing))
+
+
+def get_volume(name: str) -> Optional[Dict[str, Any]]:
+    with _conn() as conn:
+        row = conn.execute('SELECT * FROM volumes WHERE name = ?',
+                           (name,)).fetchone()
+        return dict(row) if row else None
+
+
+def list_volumes() -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute(
+            'SELECT * FROM volumes ORDER BY created_at DESC').fetchall()
+        return [dict(r) for r in rows]
+
+
+def set_volume_attachment(name: str, attached_to: Optional[str]) -> None:
+    with _lock(), _conn() as conn:
+        conn.execute('UPDATE volumes SET attached_to = ? WHERE name = ?',
+                     (attached_to, name))
+
+
+def remove_volume(name: str) -> None:
+    with _lock(), _conn() as conn:
+        conn.execute('DELETE FROM volumes WHERE name = ?', (name,))
 
 
 def _conn() -> sqlite3.Connection:
@@ -70,7 +119,8 @@ def _lock() -> filelock.FileLock:
 
 def add_or_update_cluster(name: str, handle: Dict[str, Any],
                           status: ClusterStatus,
-                          is_launch: bool = False) -> None:
+                          is_launch: bool = False,
+                          owner: Optional[str] = None) -> None:
     now = time.time()
     with _lock(), _conn() as conn:
         existing = conn.execute('SELECT name FROM clusters WHERE name = ?',
@@ -81,13 +131,24 @@ def add_or_update_cluster(name: str, handle: Dict[str, Any],
             if is_launch:
                 sets += ', launched_at = ?'
                 args.append(now)
+            if owner is not None:
+                sets += ', owner = COALESCE(owner, ?)'
+                args.append(owner)
             args.append(name)
             conn.execute(f'UPDATE clusters SET {sets} WHERE name = ?', args)
         else:
             conn.execute(
                 'INSERT INTO clusters (name, launched_at, handle, status, '
-                'last_activity) VALUES (?, ?, ?, ?, ?)',
-                (name, now, json.dumps(handle), status.value, now))
+                'last_activity, owner) VALUES (?, ?, ?, ?, ?, ?)',
+                (name, now, json.dumps(handle), status.value, now, owner))
+
+
+def set_cluster_owner(name: str, owner: str) -> None:
+    """Record the launching user (first writer wins)."""
+    with _lock(), _conn() as conn:
+        conn.execute(
+            'UPDATE clusters SET owner = COALESCE(owner, ?) WHERE name = ?',
+            (owner, name))
 
 
 def update_cluster_status(name: str, status: ClusterStatus) -> None:

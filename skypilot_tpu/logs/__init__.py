@@ -1,0 +1,112 @@
+"""External log shipping: fluent-bit agent configs per store.
+
+Reference analog: ``sky/logs/`` (``__init__.py:11-22`` store registry,
+``agent.py``/``gcp.py``/``aws.py`` fluentbit configs installed at provision
+time, ``provisioner.py:714-722``). Same shape: a store name from config
+(``logs.store: gcp``) resolves to an agent that renders the fluent-bit
+config and the install/start command executed on every worker at
+bootstrap.
+"""
+from __future__ import annotations
+
+import shlex
+import textwrap
+from typing import Dict, Optional
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+
+# What gets tailed on workers: every rank/setup/driver log under the
+# runtime dir (agent/constants.py layout).
+_TAIL_GLOB = '~/.skytpu/runtime/clusters/*/jobs/*/*.log'
+
+_INSTALL_FLUENTBIT = (
+    'command -v fluent-bit >/dev/null || '
+    '(curl -fsSL https://raw.githubusercontent.com/fluent/fluent-bit/'
+    'master/install.sh | sh)')
+
+
+class LogAgent:
+    """Renders the fluent-bit config + the command that installs/starts it
+    on a worker."""
+
+    name = 'abstract'
+
+    def fluentbit_config(self, cluster_name: str) -> str:
+        raise NotImplementedError
+
+    def install_command(self, cluster_name: str) -> str:
+        cfg = self.fluentbit_config(cluster_name)
+        qcfg = shlex.quote(cfg)
+        return (f'{_INSTALL_FLUENTBIT} && mkdir -p ~/.skytpu && '
+                f'printf %s {qcfg} > ~/.skytpu/fluent-bit.conf && '
+                f'(pgrep -f "fluent-bit.*skytpu" >/dev/null || '
+                f'nohup fluent-bit -c ~/.skytpu/fluent-bit.conf '
+                f'>/dev/null 2>&1 &)')
+
+    def _input_section(self) -> str:
+        return textwrap.dedent(f"""\
+            [INPUT]
+                Name tail
+                Path {_TAIL_GLOB}
+                Tag skytpu.*
+                Refresh_Interval 5
+            """)
+
+
+class GcpLogAgent(LogAgent):
+    """Ship to Google Cloud Logging (the store a TPU fleet pairs with;
+    reference: ``sky/logs/gcp.py``)."""
+
+    name = 'gcp'
+
+    def __init__(self, project_id: Optional[str] = None):
+        self.project_id = project_id or config_lib.get_nested(
+            ('gcp', 'project_id'), None)
+
+    def fluentbit_config(self, cluster_name: str) -> str:
+        return self._input_section() + textwrap.dedent(f"""\
+            [OUTPUT]
+                Name stackdriver
+                Match skytpu.*
+                google_service_credentials /etc/google/auth.json
+                resource global
+                labels cluster={cluster_name}
+            """)
+
+
+class AwsLogAgent(LogAgent):
+    """Ship to CloudWatch Logs (reference: ``sky/logs/aws.py``)."""
+
+    name = 'aws'
+
+    def __init__(self, region: str = 'us-east-1',
+                 log_group: str = 'skypilot-tpu'):
+        self.region = region
+        self.log_group = log_group
+
+    def fluentbit_config(self, cluster_name: str) -> str:
+        return self._input_section() + textwrap.dedent(f"""\
+            [OUTPUT]
+                Name cloudwatch_logs
+                Match skytpu.*
+                region {self.region}
+                log_group_name {self.log_group}
+                log_stream_prefix {cluster_name}-
+                auto_create_group true
+            """)
+
+
+_STORES = {'gcp': GcpLogAgent, 'aws': AwsLogAgent}
+
+
+def agent_from_config() -> Optional[LogAgent]:
+    """The configured agent (``logs.store`` in layered config), or None
+    when log shipping is off (the default)."""
+    store = config_lib.get_nested(('logs', 'store'), None)
+    if store is None:
+        return None
+    if store not in _STORES:
+        raise exceptions.SkyTpuError(
+            f'Unknown logs.store {store!r}; have {sorted(_STORES)}')
+    return _STORES[store]()

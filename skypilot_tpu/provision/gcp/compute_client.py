@@ -99,6 +99,41 @@ class ComputeClient:
         return self.transport.request(
             'POST', f'{self._zone_url(zone)}/instances/{name}/start')
 
+    # -- disks (persistent volumes) ----------------------------------------
+
+    def insert_disk(self, zone: str, name: str, size_gb: int = 100,
+                    disk_type: str = 'pd-balanced') -> Dict[str, Any]:
+        body = {
+            'name': name,
+            'sizeGb': str(size_gb),
+            'type': f'zones/{zone}/diskTypes/{disk_type}',
+        }
+        return self.transport.request(
+            'POST', f'{self._zone_url(zone)}/disks', body=body)
+
+    def delete_disk(self, zone: str, name: str) -> Dict[str, Any]:
+        return self.transport.request(
+            'DELETE', f'{self._zone_url(zone)}/disks/{name}')
+
+    def attach_disk(self, zone: str, instance: str, disk_name: str,
+                    read_only: bool = False) -> Dict[str, Any]:
+        body = {
+            'source': f'zones/{zone}/disks/{disk_name}',
+            'deviceName': disk_name,
+            'mode': 'READ_ONLY' if read_only else 'READ_WRITE',
+        }
+        return self.transport.request(
+            'POST',
+            f'{self._zone_url(zone)}/instances/{instance}/attachDisk',
+            body=body)
+
+    def detach_disk(self, zone: str, instance: str,
+                    disk_name: str) -> Dict[str, Any]:
+        return self.transport.request(
+            'POST',
+            f'{self._zone_url(zone)}/instances/{instance}/detachDisk',
+            params={'deviceName': disk_name})
+
     # -- operations ---------------------------------------------------------
 
     def wait_operation(self, zone: str, op: Dict[str, Any],

@@ -115,3 +115,17 @@ def bootstrap_cluster(cluster_name: str, info: common.ClusterInfo,
     install_runtime(runners, python=python)
     if start_daemon:
         start_agent_on_head(runners[0], cluster_name)
+    # Optional external log shipping (logs.store in config; reference:
+    # provisioner.py:714-722 installing fluentbit at provision time).
+    # Genuinely best-effort here: a config typo surfaced at launch entry
+    # (execution.launch validates) and must not strand a half-bootstrapped
+    # cluster this late.
+    try:
+        from skypilot_tpu import logs as logs_lib
+        agent = logs_lib.agent_from_config()
+        if agent is not None:
+            cmd = agent.install_command(cluster_name)
+            for runner in runners:
+                runner.run(cmd)
+    except Exception as exc:  # noqa: BLE001 — shipping is auxiliary
+        print(f'[bootstrap] log shipping skipped: {exc}')

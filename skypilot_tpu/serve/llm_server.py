@@ -53,12 +53,28 @@ class LlmServer:
         if not tokens:
             return web.json_response({'error': 'tokens required'},
                                      status=400)
-        max_new = int(body.get('max_new_tokens', 32))
-        temperature = float(body.get('temperature', 0.0))
+        try:
+            max_new = int(body.get('max_new_tokens', 32))
+            temperature = float(body.get('temperature', 0.0))
+        except (TypeError, ValueError):
+            return web.json_response(
+                {'error': 'max_new_tokens/temperature must be numeric'},
+                status=400)
+        if max_new < 1:
+            return web.json_response(
+                {'error': 'max_new_tokens must be >= 1'}, status=400)
         seed: Optional[int] = body.get('seed')
-        prompt = jnp.asarray(tokens, jnp.int32)
+        try:
+            prompt = jnp.asarray(tokens, jnp.int32)
+        except (TypeError, ValueError):
+            return web.json_response(
+                {'error': 'tokens must be a rectangular int array'},
+                status=400)
         if prompt.ndim == 1:
             prompt = prompt[None]
+        if prompt.ndim != 2:
+            return web.json_response(
+                {'error': 'tokens must be 1- or 2-dimensional'}, status=400)
         if prompt.shape[1] + max_new > self.max_len:
             return web.json_response(
                 {'error': f'prompt+max_new_tokens exceeds max_len '

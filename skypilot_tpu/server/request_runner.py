@@ -9,6 +9,14 @@ from skypilot_tpu import exceptions
 from skypilot_tpu.server import requests_db
 
 
+def _check_access(payload: Dict[str, Any], cluster_name: str) -> None:
+    """Ownership enforcement for mutating ops (reference:
+    sky/users/permission.py): non-admin users only touch clusters they
+    launched."""
+    from skypilot_tpu import users as users_lib
+    users_lib.check_cluster_access(payload.get('_user'), cluster_name)
+
+
 def _run_op(payload: Dict[str, Any]) -> Any:
     op = payload['op']
     if op == 'launch':
@@ -18,17 +26,25 @@ def _run_op(payload: Dict[str, Any]) -> Any:
         # detach_run=False keeps this request attached (streaming the job's
         # log into the request log) until the job finishes — that is what
         # `/api/stream` + request-cancel operate on for follow-mode launches.
+        if payload.get('cluster_name'):
+            _check_access(payload, payload['cluster_name'])
         job_id, handle = execution.launch(
             task, cluster_name=payload.get('cluster_name'),
             retry_until_up=payload.get('retry_until_up', False),
             idle_minutes_to_autostop=payload.get('idle_minutes_to_autostop'),
             down=payload.get('down', False),
             detach_run=payload.get('detach_run', True))
+        user = payload.get('_user')
+        if handle is not None and user is not None:
+            from skypilot_tpu import global_user_state
+            global_user_state.set_cluster_owner(handle.cluster_name,
+                                                user['name'])
         return {'job_id': job_id,
                 'handle': handle.to_dict() if handle else None}
     if op == 'exec':
         from skypilot_tpu import execution
         from skypilot_tpu.task import Task
+        _check_access(payload, payload['cluster_name'])
         task = Task.from_yaml_config(payload['task'])
         job_id, handle = execution.exec_(task, payload['cluster_name'],
                                          detach_run=True)
@@ -45,21 +61,26 @@ def _run_op(payload: Dict[str, Any]) -> Any:
                                payload.get('job_id'))
     if op == 'cancel':
         from skypilot_tpu import core
+        _check_access(payload, payload['cluster_name'])
         return core.cancel(payload['cluster_name'], payload.get('job_id'))
     if op == 'down':
         from skypilot_tpu import core
+        _check_access(payload, payload['cluster_name'])
         core.down(payload['cluster_name'])
         return True
     if op == 'stop':
         from skypilot_tpu import core
+        _check_access(payload, payload['cluster_name'])
         core.stop(payload['cluster_name'])
         return True
     if op == 'start':
         from skypilot_tpu import core
+        _check_access(payload, payload['cluster_name'])
         core.start(payload['cluster_name'])
         return True
     if op == 'autostop':
         from skypilot_tpu import core
+        _check_access(payload, payload['cluster_name'])
         core.autostop(payload['cluster_name'], payload['idle_minutes'],
                       payload.get('down', False))
         return True

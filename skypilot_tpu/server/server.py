@@ -35,7 +35,16 @@ DEFAULT_PORT = 46580
 routes = web.RouteTableDef()
 
 
-def _schedule_response(op: str, payload: Dict[str, Any]) -> web.Response:
+def _schedule_response(op: str, payload: Dict[str, Any],
+                       request: web.Request = None) -> web.Response:
+    user = request.get('user') if request is not None else None
+    if user is not None:
+        from skypilot_tpu import users as users_lib
+        if not users_lib.role_allows(user['role'], op):
+            return web.json_response(
+                {'error': f'role {user["role"]!r} may not {op!r}'},
+                status=403)
+        payload = {**payload, '_user': user}
     try:
         request_id = executor.schedule(op, payload)
     except RuntimeError as e:
@@ -59,7 +68,7 @@ def _make_post(op: str):
 
     async def handler(request: web.Request) -> web.Response:
         payload = await request.json() if request.can_read_body else {}
-        return _schedule_response(op, payload)
+        return _schedule_response(op, payload, request)
 
     return handler
 
@@ -72,7 +81,7 @@ def _make_get(op: str):
             payload['refresh'] = payload['refresh'] in ('1', 'true', 'True')
         if 'job_id' in payload and payload['job_id']:
             payload['job_id'] = int(payload['job_id'])
-        return _schedule_response(op, payload)
+        return _schedule_response(op, payload, request)
 
     return handler
 
@@ -172,18 +181,19 @@ async def api_cancel(request: web.Request) -> web.Response:
 
 @web.middleware
 async def auth_middleware(request: web.Request, handler):
-    """Bearer-token auth (reference: ``sky/server/auth/``). Enabled by
-    setting SKYTPU_API_TOKEN on the server; /health stays open so clients
-    can discover they need a token."""
-    token = os.environ.get('SKYTPU_API_TOKEN')
-    # /health stays open for discovery; /dashboard (the static page, no
-    # data) too — it attaches the token from its ?token= query to the
-    # protected /dashboard/api/state polls.
-    if token and request.path not in ('/health', '/dashboard'):
-        import hmac
-        supplied = request.headers.get('Authorization', '')
-        if not hmac.compare_digest(supplied, f'Bearer {token}'):
-            return web.json_response({'error': 'unauthorized'}, status=401)
+    """Token auth + identity resolution (reference: ``sky/server/auth/`` +
+    ``sky/users/permission.py``). Auth is on when SKYTPU_API_TOKEN is set
+    OR users are registered; /health stays open for discovery, /dashboard
+    (static page, no data) forwards its ?token= to the protected state
+    endpoint."""
+    from skypilot_tpu import users as users_lib
+    supplied = request.headers.get('Authorization', '')
+    token = supplied[len('Bearer '):] if supplied.startswith(
+        'Bearer ') else None
+    user = users_lib.authenticate(token)
+    if user is None and request.path not in ('/health', '/dashboard'):
+        return web.json_response({'error': 'unauthorized'}, status=401)
+    request['user'] = user
     return await handler(request)
 
 

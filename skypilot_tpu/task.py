@@ -78,6 +78,8 @@ class Task:
         }
         self.file_mounts: Dict[str, str] = dict(file_mounts or {})
         self.storage_mounts: Dict[str, Any] = dict(storage_mounts or {})
+        # mount path -> volume name (volumes/__init__.py)
+        self.volumes: Dict[str, str] = {}
         self._resources: Set[Resources] = {Resources()}
         self._resources_ordered: List[Resources] = [Resources()]
         self.service: Optional[Any] = None  # serve.SpecType, set by serve layer
@@ -177,13 +179,14 @@ class Task:
         config = dict(config)
         known = {
             'name', 'setup', 'run', 'envs', 'secrets', 'workdir', 'num_nodes',
-            'file_mounts', 'resources', 'config', 'service',
+            'file_mounts', 'resources', 'config', 'service', 'volumes',
         }
         unknown = set(config) - known
         if unknown:
             raise ValueError(f'Unknown fields in task YAML: {sorted(unknown)}')
         resources_cfg = config.pop('resources', None)
         service_cfg = config.pop('service', None)
+        volumes_cfg = config.pop('volumes', None) or {}
         config.pop('config', None)  # consumed by execution via config.override
         file_mounts_cfg = config.pop('file_mounts', None) or {}
         # Split file_mounts into plain path copies vs storage specs
@@ -200,6 +203,7 @@ class Task:
                 file_mounts[dst] = src
         task = cls(file_mounts=file_mounts, storage_mounts=storage_mounts,
                    **config)
+        task.volumes = dict(volumes_cfg)
         parsed = Resources.from_yaml_config(resources_cfg)
         task.set_resources(parsed if isinstance(parsed, list) else [parsed])
         if service_cfg is not None:
@@ -237,6 +241,8 @@ class Task:
             mounts[dst] = spec
         if mounts:
             cfg['file_mounts'] = mounts
+        if self.volumes:
+            cfg['volumes'] = dict(self.volumes)
         if self.setup:
             cfg['setup'] = self.setup
         if isinstance(self.run, str):

@@ -1,0 +1,97 @@
+"""Anonymized usage telemetry.
+
+Reference analog: ``sky/usage/usage_lib.py`` (messages shipped to a Loki
+endpoint; heartbeat event ``skylet/events.py:153``; opt-out env var). Here
+the collector spools locally (``$SKYTPU_STATE_DIR/usage/*.jsonl``) and only
+POSTs when an endpoint is explicitly configured (``SKYTPU_USAGE_ENDPOINT``)
+— a zero-egress-safe default that still exercises the full pipeline.
+
+Opt out entirely with ``SKYTPU_DISABLE_USAGE_COLLECTION=1`` (same contract
+as the reference's ``SKYPILOT_DISABLE_USAGE_COLLECTION``).
+"""
+from __future__ import annotations
+
+import functools
+import getpass
+import hashlib
+import json
+import os
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+_RUN_ID = uuid.uuid4().hex[:12]
+
+
+def disabled() -> bool:
+    return os.environ.get('SKYTPU_DISABLE_USAGE_COLLECTION', '0') == '1'
+
+
+def _spool_dir() -> str:
+    d = os.path.join(
+        os.path.expanduser(
+            os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu')), 'usage')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _user_hash() -> str:
+    try:
+        ident = f'{getpass.getuser()}@{os.uname().nodename}'
+    except OSError:
+        ident = 'unknown'
+    return hashlib.sha256(ident.encode()).hexdigest()[:16]
+
+
+def record(event: str, **fields: Any) -> None:
+    """Append one anonymized usage message; best-effort POST when an
+    endpoint is configured. Never raises."""
+    if disabled():
+        return
+    msg: Dict[str, Any] = {
+        'schema': 1,
+        'run_id': _RUN_ID,
+        'user': _user_hash(),
+        'time': time.time(),
+        'event': event,
+        **fields,
+    }
+    try:
+        path = os.path.join(_spool_dir(),
+                            time.strftime('%Y%m%d') + '.jsonl')
+        with open(path, 'a', encoding='utf-8') as f:
+            f.write(json.dumps(msg) + '\n')
+    except OSError:
+        return
+    endpoint = os.environ.get('SKYTPU_USAGE_ENDPOINT')
+    if endpoint:
+        try:
+            import requests
+            requests.post(endpoint, json=msg, timeout=2)
+        except Exception:  # noqa: BLE001 — telemetry must never break verbs
+            pass
+
+
+def entrypoint(name: Optional[str] = None):
+    """Decorator timing a public verb and recording its outcome."""
+
+    def deco(fn):
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if disabled():
+                return fn(*args, **kwargs)
+            t0 = time.time()
+            try:
+                out = fn(*args, **kwargs)
+                record(name or fn.__name__, duration_s=time.time() - t0,
+                       ok=True)
+                return out
+            except BaseException as e:
+                record(name or fn.__name__, duration_s=time.time() - t0,
+                       ok=False, error=type(e).__name__)
+                raise
+
+        return wrapper
+
+    return deco

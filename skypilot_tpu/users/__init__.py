@@ -1,0 +1,138 @@
+"""Users + RBAC for the API server.
+
+Reference analog: ``sky/users/permission.py`` (casbin RBAC) +
+``sky/server/auth`` token auth + ``sky/workspaces`` ownership. Compact
+TPU-native form:
+
+* a users table (name, token hash, role) under the server state dir;
+* roles: ``admin`` > ``user`` > ``viewer`` with an op -> minimum-role map;
+* single-user mode stays zero-config: with no users registered and no
+  ``SKYTPU_API_TOKEN``, every request is the implicit local admin.
+
+Identity flows as ``_user`` in the request payload (the executor runs ops
+in worker processes); ownership checks (a ``user`` may only mutate
+clusters they launched) happen in the op implementations via
+``check_cluster_access``.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+import filelock
+
+from skypilot_tpu import exceptions
+
+ROLES = ('viewer', 'user', 'admin')
+
+# op -> minimum role (reads: viewer; mutations: user; user management and
+# other server admin ops: admin).
+_OP_MIN_ROLE: Dict[str, str] = {
+    'status': 'viewer', 'queue': 'viewer', 'cost_report': 'viewer',
+    'job_status': 'viewer', 'check': 'viewer', 'jobs_queue': 'viewer',
+    'launch': 'user', 'exec': 'user', 'down': 'user', 'stop': 'user',
+    'start': 'user', 'autostop': 'user', 'cancel': 'user',
+    'jobs_launch': 'user', 'jobs_cancel': 'user',
+}
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS users (
+    name TEXT PRIMARY KEY,
+    token_hash TEXT NOT NULL,
+    role TEXT NOT NULL,
+    created_at REAL
+);
+"""
+
+
+def _db_path() -> str:
+    d = os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, 'users.db')
+
+
+def _conn() -> sqlite3.Connection:
+    conn = sqlite3.connect(_db_path(), timeout=10)
+    conn.row_factory = sqlite3.Row
+    conn.executescript(_SCHEMA)
+    return conn
+
+
+def _lock() -> filelock.FileLock:
+    return filelock.FileLock(_db_path() + '.lock')
+
+
+def _hash(token: str) -> str:
+    return hashlib.sha256(token.encode()).hexdigest()
+
+
+def add_user(name: str, token: str, role: str = 'user') -> None:
+    if role not in ROLES:
+        raise ValueError(f'role must be one of {ROLES}')
+    with _lock(), _conn() as conn:
+        conn.execute(
+            'INSERT OR REPLACE INTO users (name, token_hash, role, '
+            'created_at) VALUES (?, ?, ?, ?)',
+            (name, _hash(token), role, time.time()))
+
+
+def remove_user(name: str) -> None:
+    with _lock(), _conn() as conn:
+        conn.execute('DELETE FROM users WHERE name = ?', (name,))
+
+
+def list_users() -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute(
+            'SELECT name, role, created_at FROM users ORDER BY name'
+        ).fetchall()
+        return [dict(r) for r in rows]
+
+
+def authenticate(token: Optional[str]) -> Optional[Dict[str, str]]:
+    """token -> {'name', 'role'}; None = unauthenticated.
+
+    Single-user mode: no users registered and no SKYTPU_API_TOKEN => the
+    implicit local admin (zero-config localhost usage, like the
+    reference's default no-auth deployment)."""
+    root = os.environ.get('SKYTPU_API_TOKEN')
+    users = list_users()
+    if not users and not root:
+        return {'name': os.environ.get('USER', 'local'), 'role': 'admin'}
+    if token is None:
+        return None
+    if root and hashlib.sha256(token.encode()).hexdigest() == \
+            hashlib.sha256(root.encode()).hexdigest() and token == root:
+        return {'name': 'root', 'role': 'admin'}
+    h = _hash(token)
+    with _conn() as conn:
+        row = conn.execute(
+            'SELECT name, role FROM users WHERE token_hash = ?',
+            (h,)).fetchone()
+        return {'name': row['name'], 'role': row['role']} if row else None
+
+
+def role_allows(role: str, op: str) -> bool:
+    needed = _OP_MIN_ROLE.get(op, 'admin')
+    return ROLES.index(role) >= ROLES.index(needed)
+
+
+def check_cluster_access(user: Optional[Dict[str, str]],
+                         cluster_name: str) -> None:
+    """Mutating a cluster requires admin or ownership (reference:
+    workspace/ownership checks in sky/users/permission.py)."""
+    if user is None or user.get('role') == 'admin':
+        return
+    from skypilot_tpu import global_user_state
+    record = global_user_state.get_cluster(cluster_name)
+    if record is None:
+        return  # nonexistent: the op itself errors properly
+    owner = record.get('owner')
+    if owner and owner != user.get('name'):
+        raise exceptions.PermissionDeniedError(
+            f'Cluster {cluster_name!r} is owned by {owner!r}; '
+            f'{user.get("name")!r} ({user.get("role")}) may not modify it.')

@@ -1,0 +1,122 @@
+"""Persistent volumes: create/list/delete + task attachment.
+
+Reference analog: ``sky/volumes/`` (772 LoC — k8s PVCs and GCP persistent
+disks attached to tasks via a ``volumes:`` task section). TPU-native scope:
+
+* ``gcp``  — persistent disks via the Compute Engine client (created in a
+  zone; attach/mount commands are emitted for the cluster's workers).
+* ``local``/``fake`` — a host directory stands in for the disk (the same
+  in-sandbox substrate the local buckets use), fully functional for tests
+  and the local cloud.
+
+Task YAML::
+
+    volumes:
+      /mnt/scratch: my-volume
+"""
+from __future__ import annotations
+
+import os
+import shlex
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions, global_user_state
+
+
+def _local_root(name: str) -> str:
+    base = os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    return os.path.join(base, 'volumes', name)
+
+
+def create(name: str, size_gb: int = 100, cloud: str = 'local',
+           region: Optional[str] = None, zone: Optional[str] = None,
+           volume_type: str = 'pd-balanced') -> Dict[str, Any]:
+    """Create a volume; idempotence is an error (matches the reference's
+    volume CRUD semantics)."""
+    if global_user_state.get_volume(name) is not None:
+        raise exceptions.StorageError(f'Volume {name!r} already exists.')
+    if cloud in ('local', 'fake'):
+        backing = _local_root(name)
+        os.makedirs(backing, exist_ok=True)
+    elif cloud == 'gcp':
+        if zone is None:
+            raise exceptions.StorageError('GCP volumes require a zone.')
+        from skypilot_tpu.provision.gcp import instance as gcp_instance
+        client = gcp_instance._compute_client()  # pylint: disable=protected-access
+        client.wait_operation(zone, client.insert_disk(
+            zone, name, size_gb=size_gb, disk_type=volume_type))
+        backing = f'projects/-/zones/{zone}/disks/{name}'
+    else:
+        raise exceptions.NotSupportedError(
+            f'Volumes on {cloud!r} not supported (gcp/local/fake).')
+    global_user_state.add_volume(name, cloud, region, zone, size_gb,
+                                 volume_type, backing)
+    return global_user_state.get_volume(name)
+
+
+def list_volumes() -> List[Dict[str, Any]]:
+    return global_user_state.list_volumes()
+
+
+def delete(name: str) -> None:
+    vol = global_user_state.get_volume(name)
+    if vol is None:
+        raise exceptions.StorageError(f'Volume {name!r} not found.')
+    if vol['attached_to']:
+        raise exceptions.StorageError(
+            f'Volume {name!r} is attached to {vol["attached_to"]!r}; '
+            'down that cluster first.')
+    if vol['cloud'] in ('local', 'fake'):
+        import shutil
+        shutil.rmtree(vol['backing'], ignore_errors=True)
+    elif vol['cloud'] == 'gcp':
+        from skypilot_tpu.provision.gcp import instance as gcp_instance
+        client = gcp_instance._compute_client()  # pylint: disable=protected-access
+        client.wait_operation(vol['zone'],
+                              client.delete_disk(vol['zone'], vol['name']))
+    global_user_state.remove_volume(name)
+
+
+def record_attachment(name: str, cluster_name: str) -> None:
+    """Record an attachment AFTER a successful mount; refuses to steal a
+    volume already attached to a different live cluster (a deleted backing
+    dir under a live mount is data loss)."""
+    vol = global_user_state.get_volume(name)
+    if vol is None:
+        raise exceptions.StorageError(f'Volume {name!r} not found.')
+    if vol['attached_to'] and vol['attached_to'] != cluster_name:
+        raise exceptions.StorageError(
+            f'Volume {name!r} is attached to {vol["attached_to"]!r}; '
+            'down that cluster first.')
+    global_user_state.set_volume_attachment(name, cluster_name)
+
+
+def mount_command(name: str, mount_path: str) -> str:
+    """Shell command mounting the volume on a worker (pure builder — the
+    backend records the attachment only after the mount succeeds)."""
+    vol = global_user_state.get_volume(name)
+    if vol is None:
+        raise exceptions.StorageError(f'Volume {name!r} not found.')
+    if vol['cloud'] in ('local', 'fake'):
+        backing = shlex.quote(vol['backing'])
+        mp = shlex.quote(mount_path)
+        return (f'mkdir -p $(dirname {mp}) && rm -rf {mp} && '
+                f'ln -sfn {backing} {mp}')
+    # GCP: the disk is attached to the instance at provision/exec time;
+    # on the worker it appears as /dev/disk/by-id/google-<name>.
+    dev = f'/dev/disk/by-id/google-{vol["name"]}'
+    mp = shlex.quote(mount_path)
+    return (
+        f'sudo mkdir -p {mp} && '
+        f'(sudo blkid {dev} >/dev/null 2>&1 || '
+        f'sudo mkfs.ext4 -q {dev}) && '
+        f'(mountpoint -q {mp} || sudo mount {dev} {mp}) && '
+        f'sudo chown $(id -u):$(id -g) {mp}')
+
+
+def detach_all(cluster_name: str) -> None:
+    """Clear attachments pointing at a (downed) cluster."""
+    for vol in global_user_state.list_volumes():
+        if vol['attached_to'] == cluster_name:
+            global_user_state.set_volume_attachment(vol['name'], None)

@@ -74,6 +74,17 @@ def proxy(tmp_path):
     while not os.path.exists(sock_path) and time.time() < deadline:
         time.sleep(0.05)
     assert os.path.exists(sock_path)
+    assert server.poll() is None, f'broker died rc={server.returncode}'
+    # Under parallel-suite load the listener can lag the socket file by a
+    # beat: probe until a trivial shim call connects.
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        rc = subprocess.run([binary, '--shim', '--socket', sock_path,
+                             '--probe'], env=env,
+                            capture_output=True).returncode
+        if rc == 0:
+            break
+        time.sleep(0.1)
     yield binary, sock_path, log, env
     server.kill()
     server.wait()

@@ -1,0 +1,71 @@
+"""Log shipping + usage telemetry tests (SURVEY §5 observability)."""
+import json
+import os
+
+import pytest
+
+from skypilot_tpu import logs as logs_lib
+from skypilot_tpu import usage
+
+
+def test_log_agents_render_fluentbit_configs(monkeypatch):
+    gcp = logs_lib.GcpLogAgent(project_id='p1')
+    cfg = gcp.fluentbit_config('c1')
+    assert '[INPUT]' in cfg and 'tail' in cfg
+    assert 'stackdriver' in cfg and 'cluster=c1' in cfg
+    cmd = gcp.install_command('c1')
+    assert 'fluent-bit' in cmd and 'nohup' in cmd
+
+    aws = logs_lib.AwsLogAgent(region='eu-west-1', log_group='g')
+    cfg = aws.fluentbit_config('c2')
+    assert 'cloudwatch_logs' in cfg and 'eu-west-1' in cfg
+    assert 'log_stream_prefix c2-' in cfg
+
+
+def test_log_store_registry(monkeypatch):
+    assert logs_lib.agent_from_config() is None  # off by default
+    from skypilot_tpu import config as config_lib
+    monkeypatch.setattr(config_lib, 'get_nested',
+                        lambda path, default=None: 'gcp'
+                        if path == ('logs', 'store') else default)
+    agent = logs_lib.agent_from_config()
+    assert isinstance(agent, logs_lib.GcpLogAgent)
+
+
+def test_usage_records_spool(tmp_state_dir, monkeypatch):
+    monkeypatch.delenv('SKYTPU_DISABLE_USAGE_COLLECTION', raising=False)
+    monkeypatch.delenv('SKYTPU_USAGE_ENDPOINT', raising=False)
+    usage.record('test-event', foo=1)
+    spool = os.path.join(str(tmp_state_dir), 'usage')
+    files = os.listdir(spool)
+    assert len(files) == 1
+    with open(os.path.join(spool, files[0]), encoding='utf-8') as f:
+        msg = json.loads(f.read().splitlines()[-1])
+    assert msg['event'] == 'test-event' and msg['foo'] == 1
+    # anonymized: a hash, not the raw username
+    import getpass
+    assert getpass.getuser() not in json.dumps(msg)
+
+
+def test_usage_opt_out(tmp_state_dir, monkeypatch):
+    monkeypatch.setenv('SKYTPU_DISABLE_USAGE_COLLECTION', '1')
+    usage.record('nope')
+    assert not os.path.exists(os.path.join(str(tmp_state_dir), 'usage'))
+
+
+def test_usage_entrypoint_times_and_records_errors(tmp_state_dir,
+                                                   monkeypatch):
+    monkeypatch.delenv('SKYTPU_DISABLE_USAGE_COLLECTION', raising=False)
+
+    @usage.entrypoint('boom')
+    def boom():
+        raise ValueError('x')
+
+    with pytest.raises(ValueError):
+        boom()
+    spool = os.path.join(str(tmp_state_dir), 'usage')
+    content = open(os.path.join(spool, os.listdir(spool)[0]),
+                   encoding='utf-8').read()
+    msg = json.loads(content.splitlines()[-1])
+    assert msg['event'] == 'boom' and msg['ok'] is False
+    assert msg['error'] == 'ValueError'

@@ -86,3 +86,20 @@ def test_dag_non_chain():
         b >> c
     assert not d.is_chain()
     d.validate()
+
+
+def test_cli_module_entry_registers_all_groups(tmp_path):
+    """Regression: a mid-file __main__ block once cut off every CLI group
+    defined after it when run via `python -m` (jobs/serve/api/volumes/
+    users were silently missing)."""
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ, SKYTPU_STATE_DIR=str(tmp_path), JAX_PLATFORMS='cpu')
+    env.pop('PYTHONPATH', None)
+    out = subprocess.run(
+        [sys.executable, '-m', 'skypilot_tpu.client.cli', '--help'],
+        capture_output=True, text=True, env=env, check=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    for group in ('launch', 'jobs', 'serve', 'api', 'volumes', 'users'):
+        assert group in out.stdout, f'{group} missing from CLI help'

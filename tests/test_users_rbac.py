@@ -1,0 +1,136 @@
+"""Users + RBAC tests (reference analog: sky/users/permission.py RBAC and
+sky/server/auth token auth, via the real server subprocess)."""
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+import requests as requests_lib
+
+from skypilot_tpu import exceptions, global_user_state
+from skypilot_tpu import users as users_lib
+from skypilot_tpu.utils import common_utils
+
+
+def test_roles_and_authentication(tmp_state_dir, monkeypatch):
+    monkeypatch.delenv('SKYTPU_API_TOKEN', raising=False)
+    # Single-user mode: implicit local admin.
+    u = users_lib.authenticate(None)
+    assert u is not None and u['role'] == 'admin'
+    users_lib.add_user('alice', 'tok-a', 'user')
+    users_lib.add_user('vera', 'tok-v', 'viewer')
+    # Users registered: anonymous is rejected.
+    assert users_lib.authenticate(None) is None
+    assert users_lib.authenticate('nope') is None
+    assert users_lib.authenticate('tok-a') == {'name': 'alice',
+                                               'role': 'user'}
+    assert users_lib.role_allows('viewer', 'status')
+    assert not users_lib.role_allows('viewer', 'launch')
+    assert users_lib.role_allows('user', 'launch')
+    users_lib.remove_user('alice')
+    assert users_lib.authenticate('tok-a') is None
+
+
+def test_ownership_check(tmp_state_dir):
+    global_user_state.add_or_update_cluster(
+        'bobs', {'cloud': 'local'}, global_user_state.ClusterStatus.UP,
+        is_launch=True, owner='bob')
+    users_lib.check_cluster_access({'name': 'bob', 'role': 'user'}, 'bobs')
+    users_lib.check_cluster_access({'name': 'root', 'role': 'admin'},
+                                   'bobs')
+    with pytest.raises(exceptions.PermissionDeniedError):
+        users_lib.check_cluster_access({'name': 'eve', 'role': 'user'},
+                                       'bobs')
+    global_user_state.remove_cluster('bobs')
+
+
+@pytest.fixture()
+def rbac_server(tmp_path):
+    state_dir = str(tmp_path / 'state')
+    os.environ['SKYTPU_STATE_DIR'] = state_dir
+    users_lib.add_user('alice', 'tok-a', 'user')
+    users_lib.add_user('vera', 'tok-v', 'viewer')
+    port = common_utils.find_free_port(48400)
+    env = dict(os.environ)
+    env['SKYTPU_STATE_DIR'] = state_dir
+    env['SKYTPU_ENABLE_FAKE_CLOUD'] = '1'
+    env.pop('JAX_PLATFORMS', None)
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.server.server',
+         '--port', str(port)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    url = f'http://127.0.0.1:{port}'
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            requests_lib.get(f'{url}/health', timeout=2)
+            break
+        except requests_lib.RequestException:
+            time.sleep(0.2)
+    yield url
+    proc.terminate()
+    os.environ.pop('SKYTPU_STATE_DIR', None)
+
+
+def _h(token):
+    return {'Authorization': f'Bearer {token}'}
+
+
+def test_rbac_through_server(rbac_server):
+    url = rbac_server
+    # Anonymous: rejected.
+    assert requests_lib.get(f'{url}/api/v1/status', timeout=5
+                            ).status_code == 401
+    # Viewer: reads ok, mutations 403.
+    assert requests_lib.get(f'{url}/api/v1/status', timeout=5,
+                            headers=_h('tok-v')).status_code == 200
+    r = requests_lib.post(f'{url}/api/v1/down', timeout=5,
+                          json={'cluster_name': 'x'}, headers=_h('tok-v'))
+    assert r.status_code == 403
+    # User: launch allowed; the cluster is recorded with their ownership.
+    task = {'name': 'owned', 'resources': {'cloud': 'local'},
+            'run': 'echo mine'}
+    r = requests_lib.post(f'{url}/api/v1/launch', timeout=5,
+                          json={'task': task, 'cluster_name': 'alice-c'},
+                          headers=_h('tok-a'))
+    assert r.status_code == 200
+    rid = r.json()['request_id']
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        g = requests_lib.get(f'{url}/api/v1/api/get',
+                             params={'request_id': rid, 'timeout': '5'},
+                             headers=_h('tok-a'), timeout=15)
+        if g.status_code == 200:
+            break
+    assert g.status_code == 200, g.text
+    rec = global_user_state.get_cluster('alice-c')
+    assert rec['owner'] == 'alice'
+    # Another non-admin user cannot down alice's cluster.
+    users_lib.add_user('eve', 'tok-e', 'user')
+    r = requests_lib.post(f'{url}/api/v1/down', timeout=5,
+                          json={'cluster_name': 'alice-c'},
+                          headers=_h('tok-e'))
+    rid = r.json()['request_id']
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        g = requests_lib.get(f'{url}/api/v1/api/get',
+                             params={'request_id': rid, 'timeout': '5'},
+                             headers=_h('tok-e'), timeout=15)
+        if g.status_code == 200:
+            break
+    assert 'PermissionDenied' in str(g.json().get('error') or ''), g.text
+    assert global_user_state.get_cluster('alice-c') is not None
+    # The owner downs it fine.
+    r = requests_lib.post(f'{url}/api/v1/down', timeout=5,
+                          json={'cluster_name': 'alice-c'},
+                          headers=_h('tok-a'))
+    rid = r.json()['request_id']
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        g = requests_lib.get(f'{url}/api/v1/api/get',
+                             params={'request_id': rid, 'timeout': '5'},
+                             headers=_h('tok-a'), timeout=15)
+        if g.status_code == 200 and not g.json().get('error'):
+            break
+    assert global_user_state.get_cluster('alice-c') is None
