@@ -43,7 +43,11 @@ def _built_binary(target: str, src_name: str) -> Optional[str]:
 
 def gang_binary() -> Optional[str]:
     """Path to the built supervisor, building it if needed; None if the
-    native path is unavailable (no toolchain / build failure / opt-out)."""
+    native path is unavailable (no toolchain / build failure / opt-out).
+    SKYTPU_GANGD_BIN overrides (sanitizer builds, prebuilt deploys)."""
+    override = os.environ.get('SKYTPU_GANGD_BIN')
+    if override:
+        return override if os.path.exists(override) else None
     if os.environ.get('SKYTPU_NATIVE_GANG', '1') == '0':
         return None
     return _built_binary('skytpu_gangd', 'gangd.cc')
@@ -51,8 +55,12 @@ def gang_binary() -> Optional[str]:
 
 def fuse_proxy_binary() -> Optional[str]:
     """Path to the built fuse-proxy (shim+server), building on first use;
-    None when no toolchain is available. Reference analog: the Go
-    fuse-proxy addon binaries (addons/fuse-proxy/)."""
+    None when no toolchain is available. SKYTPU_FUSE_PROXY_BIN overrides.
+    Reference analog: the Go fuse-proxy addon binaries (addons/fuse-proxy/).
+    """
+    override = os.environ.get('SKYTPU_FUSE_PROXY_BIN')
+    if override:
+        return override if os.path.exists(override) else None
     return _built_binary('skytpu_fuse_proxy', 'fuse_proxy.cc')
 
 

@@ -180,8 +180,11 @@ class TpuGangBackend(Backend):
                    for inst in info.all_workers_sorted()]
         # The client-side daemon owns autostop for now (the on-cluster
         # agent daemon lands with the gRPC agent); start_daemon=False.
-        instance_setup.bootstrap_cluster(handle.cluster_name, info, runners,
-                                         start_daemon=False)
+        # SKYTPU_REMOTE_PYTHON overrides the worker interpreter (TPU VM
+        # images ship the ML stack on python3; tests point at their venv).
+        instance_setup.bootstrap_cluster(
+            handle.cluster_name, info, runners, start_daemon=False,
+            python=os.environ.get('SKYTPU_REMOTE_PYTHON', 'python3'))
 
     def _start_cluster_daemon(self, cluster_name: str) -> None:
         """Spawn the per-cluster autostop/heartbeat daemon (skylet analog).

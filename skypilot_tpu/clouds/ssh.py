@@ -1,0 +1,80 @@
+"""BYO-SSH cloud: existing machines (node pools) as a provider.
+
+Reference analog: ``sky/clouds/ssh.py`` + ``sky/ssh_node_pools/`` — plain
+SSH hosts declared by the user become schedulable capacity. Free ($0), no
+stop/autostop (the machines are not ours to power off), CPU-only (TPU
+slices always come from GCP/GKE).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+Features = cloud_lib.CloudImplementationFeatures
+
+
+@CLOUD_REGISTRY.register
+class Ssh(cloud_lib.Cloud):
+
+    _REPR = 'ssh'
+
+    @classmethod
+    def supported_features(cls) -> set:
+        return {Features.MULTI_NODE, Features.STORAGE_MOUNTING}
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu import exceptions
+        from skypilot_tpu.provision.ssh_pool import instance as ssh_instance
+        try:
+            pools = ssh_instance.load_pools()
+        except exceptions.SkyTpuError as e:
+            return False, str(e)
+        if pools:
+            return True, None
+        return False, (f'No SSH node pools declared. Add pools to '
+                       f'{ssh_instance.pools_path()}.')
+
+    def regions(self) -> List[cloud_lib.Region]:
+        from skypilot_tpu.provision.ssh_pool import instance as ssh_instance
+        return [cloud_lib.Region(name=p)
+                for p in sorted(ssh_instance.load_pools())]
+
+    def zones_for(self, resources: Resources) -> Iterator[Tuple[str, str]]:
+        from skypilot_tpu.provision.ssh_pool import instance as ssh_instance
+        for pool in sorted(ssh_instance.load_pools()):
+            if resources.region in (None, pool):
+                yield pool, pool
+
+    def get_feasible_launchable_resources(
+            self, resources: Resources) -> List[Resources]:
+        if resources.cloud is not None and resources.cloud != self._REPR:
+            return []
+        if resources.accelerator_name is not None or resources.tpu is not None:
+            return []  # CPU hosts only
+        if resources.use_spot:
+            return []  # BYO machines have no spot semantics
+        from skypilot_tpu.provision.ssh_pool import instance as ssh_instance
+        out = []
+        for pool in sorted(ssh_instance.load_pools()):
+            if resources.region in (None, pool):
+                out.append(resources.copy(cloud=self._REPR, region=pool,
+                                          _price_per_hour=0.0))
+        return out
+
+    def make_deploy_variables(self, resources: Resources,
+                              cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str],
+                              num_nodes: int) -> Dict[str, Any]:
+        return {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'pool': region,
+            'num_nodes': num_nodes,
+        }
+
+    @property
+    def provisioner_module(self) -> str:
+        return 'skypilot_tpu.provision.ssh_pool'

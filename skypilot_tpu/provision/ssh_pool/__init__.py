@@ -1,0 +1,1 @@
+"""BYO-node "cloud": existing SSH machines as a provider."""

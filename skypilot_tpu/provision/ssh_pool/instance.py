@@ -1,0 +1,201 @@
+"""SSH node-pool provisioner: bring-your-own machines.
+
+Reference analog: ``sky/provision/ssh/`` + ``sky/ssh_node_pools/`` — a
+"cloud" whose capacity is a user-supplied inventory of SSH-reachable
+hosts. Pools are declared in ``$SKYTPU_STATE_DIR/ssh_node_pools.yaml``::
+
+    my-pool:
+      user: ubuntu
+      identity_file: ~/.ssh/id_ed25519   # optional; framework key default
+      hosts:
+        - 10.0.0.5
+        - 10.0.0.6
+
+"Provisioning" = leasing hosts from the pool (recorded in a JSON lease
+file per pool — no cloud API); terminate releases them. Stop is not
+supported (the machines are not ours to power off).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import filelock
+import yaml
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+
+
+def pools_path() -> str:
+    return os.path.expanduser(os.path.join(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'),
+        'ssh_node_pools.yaml'))
+
+
+def load_pools() -> Dict[str, Any]:
+    """Parse the pool inventory; malformed files become a clean SkyTpuError
+    (an unhandled YAML traceback here would break `check` for EVERY
+    cloud)."""
+    path = pools_path()
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path, encoding='utf-8') as f:
+            pools = yaml.safe_load(f) or {}
+    except yaml.YAMLError as e:
+        raise exceptions.SkyTpuError(
+            f'Invalid YAML in {path}: {e}') from e
+    if not isinstance(pools, dict):
+        raise exceptions.SkyTpuError(
+            f'{path} must map pool names to {{user, hosts}} entries.')
+    for name, pool in pools.items():
+        if not isinstance(pool, dict) or not isinstance(
+                pool.get('hosts', []), list):
+            raise exceptions.SkyTpuError(
+                f'{path}: pool {name!r} must be a mapping with a '
+                f'`hosts:` list.')
+    return pools
+
+
+def _leases_path(pool: str) -> str:
+    d = os.path.expanduser(os.path.join(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'), 'ssh_leases'))
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f'{pool}.json')
+
+
+def _with_leases(pool: str):
+    return filelock.FileLock(_leases_path(pool) + '.lock')
+
+
+def _read_leases(pool: str) -> Dict[str, str]:
+    try:
+        with open(_leases_path(pool), encoding='utf-8') as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def _write_leases(pool: str, leases: Dict[str, str]) -> None:
+    with open(_leases_path(pool), 'w', encoding='utf-8') as f:
+        json.dump(leases, f)
+
+
+def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    pool_name = config.node_config.get('pool')
+    pools = load_pools()
+    if pool_name not in pools:
+        raise exceptions.ResourcesUnavailableError(
+            f'SSH pool {pool_name!r} not found in {pools_path()} '
+            f'(have: {sorted(pools)})')
+    pool = pools[pool_name]
+    hosts: List[str] = list(pool.get('hosts') or [])
+    n = config.num_nodes
+    with _with_leases(pool_name):
+        leases = _read_leases(pool_name)
+        mine = [h for h, c in leases.items()
+                if c == config.cluster_name_on_cloud]
+        if len(mine) > n:
+            # Shrink (stale leases from a crashed provision): release the
+            # surplus so the world size matches num_nodes and the pool
+            # regains capacity.
+            for h in mine[n:]:
+                del leases[h]
+            mine = mine[:n]
+        free = [h for h in hosts if h not in leases]
+        needed = n - len(mine)
+        if needed > len(free):
+            raise exceptions.QuotaExceededError(
+                f'SSH pool {pool_name!r}: need {needed} hosts, '
+                f'{len(free)} free of {len(hosts)}.')
+        newly = free[:max(0, needed)]
+        for h in newly:
+            leases[h] = config.cluster_name_on_cloud
+        _write_leases(pool_name, leases)
+    name = config.cluster_name_on_cloud
+    return common.ProvisionRecord(
+        provider_name='ssh', region=pool_name, zone=None,
+        cluster_name_on_cloud=name,
+        head_instance_id=f'{name}-0',
+        created_instance_ids=[f'{name}-{len(mine) + i}'
+                              for i in range(len(newly))],
+        resumed_instance_ids=[f'{name}-{i}' for i in range(len(mine))])
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: str) -> None:
+    del region, cluster_name_on_cloud, state  # hosts already exist
+
+
+def _cluster_hosts(cluster_name_on_cloud: str) -> List[Dict[str, Any]]:
+    out = []
+    for pool_name, pool in load_pools().items():
+        leases = _read_leases(pool_name)
+        for idx, host in enumerate(
+                h for h in (pool.get('hosts') or [])
+                if leases.get(h) == cluster_name_on_cloud):
+            out.append({'pool': pool_name, 'host': host, 'idx': idx,
+                        'user': pool.get('user'),
+                        'identity_file': pool.get('identity_file')})
+    return out
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
+    raise exceptions.NotSupportedError(
+        'BYO SSH machines cannot be stopped; use down to release them.')
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None
+                        ) -> None:
+    for pool_name in load_pools():
+        with _with_leases(pool_name):
+            leases = _read_leases(pool_name)
+            leases = {h: c for h, c in leases.items()
+                      if c != cluster_name_on_cloud}
+            _write_leases(pool_name, leases)
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Optional[str]]:
+    return {f'{cluster_name_on_cloud}-{i}': 'running'
+            for i, _ in enumerate(_cluster_hosts(cluster_name_on_cloud))}
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    del region
+    hosts = _cluster_hosts(cluster_name_on_cloud)
+    instances = [
+        common.InstanceInfo(
+            instance_id=f'{cluster_name_on_cloud}-{i}',
+            node_id=i, worker_id=0,
+            internal_ip=h['host'], external_ip=h['host'], status='running')
+        for i, h in enumerate(hosts)
+    ]
+    user = hosts[0]['user'] if hosts else None
+    identity = hosts[0]['identity_file'] if hosts else None
+    if identity is None:
+        from skypilot_tpu import authentication
+        identity, _ = authentication.get_or_create_ssh_keypair()
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=(instances[0].instance_id if instances else None),
+        provider_name='ssh', region=hosts[0]['pool'] if hosts else '-',
+        zone=None, ssh_user=user or 'root',
+        ssh_key_path=os.path.expanduser(identity))
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[int],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del cluster_name_on_cloud, ports, provider_config  # user-managed hosts
+
+
+def cleanup_ports(cluster_name_on_cloud: str,
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del cluster_name_on_cloud, provider_config

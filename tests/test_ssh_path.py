@@ -257,3 +257,67 @@ def test_gang_launch_over_ssh_full_env_contract(fake_ssh, enable_fake_cloud,
     hosts = {c['host'] for c in fake_ssh.calls()}
     assert {f'{name_on_cloud}-n0-w{i}' for i in range(4)} <= hosts
     core.down('ssh-gang')
+
+
+def test_ssh_node_pool_cloud_end_to_end(fake_ssh, tmp_state_dir,
+                                        monkeypatch):
+    """BYO-SSH cloud (reference sky/clouds/ssh.py + ssh_node_pools): pool
+    declared in YAML, hosts leased at provision, gang runs over the shim,
+    down releases the lease."""
+    import yaml as yaml_lib
+
+    from skypilot_tpu import core, execution
+    from skypilot_tpu.agent import job_lib
+    from skypilot_tpu.backends.tpu_gang_backend import runtime_dir
+    from skypilot_tpu.provision.ssh_pool import instance as ssh_instance
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
+
+    import sys
+    monkeypatch.setenv('SKYTPU_REMOTE_PYTHON', sys.executable)
+    key, _ = authentication.get_or_create_ssh_keypair()
+    with open(ssh_instance.pools_path(), 'w', encoding='utf-8') as f:
+        yaml_lib.safe_dump({
+            'rack1': {'user': 'tester', 'identity_file': key,
+                      'hosts': ['hostA', 'hostB', 'hostC']},
+        }, f)
+    fake_ssh.up('hostA')
+    fake_ssh.up('hostB')
+
+    task = Task('byossh', num_nodes=2,
+                run='echo pool-rank=$SKYPILOT_NODE_RANK host=$(basename $HOME)')
+    task.set_resources(Resources(cloud='ssh'))
+    job_id, handle = execution.launch(task, cluster_name='byo',
+                                      detach_run=True)
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        s = core.job_status('byo', job_id)
+        if s and job_lib.JobStatus(s).is_terminal():
+            break
+        time.sleep(0.3)
+    assert s == 'SUCCEEDED', s
+    merged = os.path.join(runtime_dir('byo'), 'jobs', str(job_id), 'run.log')
+    content = open(merged, encoding='utf-8').read()
+    assert 'pool-rank=0 host=hostA' in content
+    assert 'pool-rank=1 host=hostB' in content
+    # Leases held while up; released on down.
+    leases = ssh_instance._read_leases('rack1')
+    assert len(leases) == 2
+    core.down('byo')
+    assert ssh_instance._read_leases('rack1') == {}
+
+
+def test_ssh_pool_malformed_yaml_degrades_cleanly(tmp_state_dir):
+    """A broken pools file must not traceback `check` for every cloud."""
+    from skypilot_tpu.clouds.ssh import Ssh
+    from skypilot_tpu.provision.ssh_pool import instance as ssh_instance
+
+    os.makedirs(os.path.dirname(ssh_instance.pools_path()), exist_ok=True)
+    with open(ssh_instance.pools_path(), 'w', encoding='utf-8') as f:
+        f.write('rack1: [unclosed\n  bad: ::yaml')
+    ok, reason = Ssh.check_credentials()
+    assert not ok and 'Invalid YAML' in reason
+    with open(ssh_instance.pools_path(), 'w', encoding='utf-8') as f:
+        f.write('- just\n- a\n- list\n')
+    ok, reason = Ssh.check_credentials()
+    assert not ok and 'must map pool names' in reason
