@@ -14,6 +14,18 @@ from typing import Callable, Dict, List, Optional
 
 import pandas as pd
 
+# pandas 3 infers str columns as pyarrow-backed arrays. In processes that
+# also load grpc (the on-cluster agent client), constructing an
+# ArrowStringArray segfaults — pyarrow's and grpc's bundled
+# abseil/protobuf symbols clash when grpc loads after pyarrow (observed:
+# hard crash in ArrowStringArray._from_sequence inside read_csv on a
+# jobs-controller thread). Catalog frames are small; object dtype (the
+# pandas<3 default) keeps them off the arrow path entirely.
+pd.set_option('future.infer_string', False)
+
+# Serializes every catalog CSV read in the process (see LazyDataFrame._load).
+_READ_CSV_LOCK = threading.Lock()
+
 _PACKAGE_DATA_DIR = os.path.join(os.path.dirname(__file__), 'data')
 _OVERRIDE_DIR = os.path.expanduser('~/.skypilot_tpu/catalogs')
 
@@ -36,7 +48,7 @@ class LazyDataFrame:
 
     def _load(self) -> pd.DataFrame:
         path = catalog_path(self._filename)
-        with self._lock:
+        with self._lock, _READ_CSV_LOCK:
             try:
                 mtime = os.path.getmtime(path)
             except OSError as e:
@@ -45,7 +57,19 @@ class LazyDataFrame:
                     f'`python -m skypilot_tpu.catalog.data_fetchers.fetch_gcp_tpu` '
                     'to regenerate.') from e
             if self._df is None or mtime != self._mtime:
-                self._df = pd.read_csv(path)
+                df = pd.read_csv(path)
+                # pandas 3 backs str columns with pyarrow arrays, whose
+                # construction is not safe under concurrent catalog reads
+                # from multiple threads (observed: segfault in
+                # ArrowStringArray._from_sequence when an optimizer thread
+                # and a jobs-controller thread load two catalogs at once).
+                # The global lock serializes the reads; object dtype keeps
+                # every LATER filter/compare on the escaped frame off the
+                # arrow path entirely.
+                for col in df.columns:
+                    if str(df[col].dtype) == 'str':
+                        df[col] = df[col].astype(object)
+                self._df = df
                 self._mtime = mtime
             return self._df
 
