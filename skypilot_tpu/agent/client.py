@@ -54,6 +54,17 @@ class AgentClient:
                 pb.TailLogRequest(job_id=job_id, lines=lines, follow=follow)):
             yield chunk.data
 
+    def submit_job(self, name: str, num_nodes: int, num_workers: int,
+                   spec: Dict[str, Any]) -> int:
+        """Submit a job for driver-on-head execution; returns the job id."""
+        import json
+        reply = self._stub.SubmitJob(
+            pb.SubmitJobRequest(name=name, num_nodes=num_nodes,
+                                num_workers=num_workers,
+                                spec_json=json.dumps(spec)),
+            timeout=self.timeout)
+        return reply.job_id
+
     def set_autostop(self, idle_minutes: int, down: bool = False) -> bool:
         reply = self._stub.SetAutostop(
             pb.SetAutostopRequest(idle_minutes=idle_minutes, down=down),

@@ -27,12 +27,31 @@ def _runtime_dir(cluster_name: str) -> str:
 
 
 def _idle_seconds(cluster_name: str) -> Optional[float]:
-    """Seconds since the last job activity; None while a job is active."""
-    table = job_lib.JobTable(_runtime_dir(cluster_name))
-    if table.unfinished_jobs():
-        return None
-    jobs = table.list_jobs(limit=1)
+    """Seconds since the last job activity; None while a job is active.
+
+    Remote-control clusters keep their job table on the HEAD: idleness is
+    judged through the agent (an unreachable head yields None — never
+    stop/down a cluster on missing data)."""
     record = global_user_state.get_cluster(cluster_name)
+    jobs = None
+    if record is not None and record.get('handle'):
+        from skypilot_tpu.backends import ClusterHandle, TpuGangBackend
+        handle = ClusterHandle.from_dict(record['handle'])
+        backend = TpuGangBackend()
+        if backend.is_remote_controlled(handle):
+            try:
+                head_jobs = backend.job_queue(handle)
+            except Exception:  # noqa: BLE001 — no data => no action
+                return None
+            if any(not job_lib.JobStatus(j['status']).is_terminal()
+                   for j in head_jobs):
+                return None
+            jobs = head_jobs[:1]
+    if jobs is None:
+        table = job_lib.JobTable(_runtime_dir(cluster_name))
+        if table.unfinished_jobs():
+            return None
+        jobs = table.list_jobs(limit=1)
     candidates = []
     if jobs and jobs[0].get('ended_at'):
         candidates.append(jobs[0]['ended_at'])

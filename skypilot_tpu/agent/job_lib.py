@@ -184,3 +184,36 @@ class JobTable:
                 tuple(s.value for s in JobStatus if s.is_terminal())
             ).fetchall()
             return [dict(r) for r in rows]
+
+
+def submit_and_spawn_driver(cluster_dir: str, name: str, num_nodes: int,
+                            num_workers: int, spec: Dict[str, Any],
+                            env: Optional[Dict[str, str]] = None) -> int:
+    """Record a job, persist its spec, and spawn the detached gang driver.
+
+    The one submission sequence, shared by the backend's local path and the
+    head agent's ``SubmitJob`` RPC: the spec lands on disk BEFORE the driver
+    starts, and the driver is detached (``start_new_session``) so it
+    survives the submitting process. Returns the job id.
+    """
+    import subprocess
+    import sys
+
+    from skypilot_tpu.agent import constants
+
+    table = JobTable(cluster_dir)
+    job_id = table.submit(name or 'task', num_nodes, num_workers,
+                          log_dir='pending')
+    log_dir = os.path.join(cluster_dir, constants.JOBS_SUBDIR, str(job_id))
+    os.makedirs(log_dir, exist_ok=True)
+    table.set_log_dir(job_id, log_dir)
+    with open(os.path.join(log_dir, 'spec.json'), 'w', encoding='utf-8') as f:
+        json.dump(spec, f, indent=1)
+    subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.agent.driver',
+         '--cluster-dir', cluster_dir, '--job-id', str(job_id),
+         '--nonce', spec.get('nonce', '')],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        env=env if env is not None else dict(os.environ),
+        start_new_session=True)
+    return job_id

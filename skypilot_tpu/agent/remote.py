@@ -1,0 +1,170 @@
+"""Dialing the on-cluster agent from a client machine.
+
+Reference analog: ``SkyletClient`` setup in ``cloud_vm_ray_backend.py:
+2272-2443`` — the skylet gRPC server binds 127.0.0.1 on the head and the
+client reaches it through an SSH local-port-forward tunnel.  Same model
+here: ``start_agent_on_head`` records the bound port in ``agent.port``
+inside the head-side cluster dir; the client reads that file over SSH,
+then either
+
+* opens an ``ssh -N -L`` tunnel and dials ``127.0.0.1:<local>`` (default
+  for SSH-reachable heads), or
+* dials ``<host>:<port>`` directly when ``SKYTPU_AGENT_DIAL=direct`` —
+  the in-sandbox test mode, where the "remote" agent actually listens on
+  loopback (the fake-ssh rig executes head commands locally).
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import socket
+import subprocess
+import time
+from typing import Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.agent.client import AgentClient
+from skypilot_tpu.utils.command_runner import RunnerSpec, SSHCommandRunner
+
+# Head-side filesystem contract (HOME-relative on the head; see
+# provision/instance_setup.py which creates all of these at bootstrap).
+HEAD_RUNTIME_DIR = '~/.skytpu/runtime'
+HEAD_CLUSTER_KEY = f'{HEAD_RUNTIME_DIR}/keys/cluster_key'
+
+
+def head_cluster_dir(cluster_name: str) -> str:
+    return f'{HEAD_RUNTIME_DIR}/clusters/{cluster_name}'
+
+
+def read_agent_port(head_spec: RunnerSpec, cluster_name: str,
+                    timeout: float = 30.0) -> int:
+    """Read the agent's bound port from the head over SSH (retrying: the
+    agent writes the file asynchronously after its nohup start)."""
+    runner = head_spec.make()
+    path = f'{head_cluster_dir(cluster_name)}/agent.port'
+    deadline = time.time() + timeout
+    while True:
+        rc, out = runner.output(f'cat {path} 2>/dev/null')
+        if rc == 0 and out.strip().isdigit():
+            return int(out.strip())
+        if time.time() > deadline:
+            raise exceptions.HeadUnreachableError(
+                f'Cluster agent port file {path} unreadable on head '
+                f'{head_spec.ip} after {timeout:.0f}s (agent not running?)')
+        time.sleep(0.5)
+
+
+def _free_local_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+class AgentTunnel:
+    """An SSH local port forward to the head's agent (owns the ssh proc)."""
+
+    def __init__(self, head_spec: RunnerSpec, remote_port: int):
+        assert head_spec.kind == 'ssh', head_spec
+        self.local_port = _free_local_port()
+        runner = head_spec.make()
+        assert isinstance(runner, SSHCommandRunner)
+        # Reuse the runner's ssh argv recipe (options/port/key/user@host)
+        # so option changes propagate to tunnels; insert the forward
+        # before the destination.
+        base = runner._ssh_base()  # pylint: disable=protected-access
+        argv = (base[:-1] +
+                ['-N', '-L', f'{self.local_port}:127.0.0.1:{remote_port}',
+                 base[-1]])
+        self.proc = subprocess.Popen(argv, stdout=subprocess.DEVNULL,
+                                     stderr=subprocess.DEVNULL)
+        self._wait_listening()
+
+    def _wait_listening(self, timeout: float = 20.0) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.proc.poll() is not None:
+                raise exceptions.HeadUnreachableError(
+                    'agent tunnel ssh exited '
+                    f'(rc={self.proc.returncode})')
+            try:
+                with socket.create_connection(
+                        ('127.0.0.1', self.local_port), timeout=1.0):
+                    return
+            except OSError:
+                time.sleep(0.2)
+        raise exceptions.HeadUnreachableError('agent tunnel never came up')
+
+    @property
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def close(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+
+
+class _Conn:
+
+    def __init__(self, client: AgentClient, tunnel: Optional[AgentTunnel]):
+        self.client = client
+        self.tunnel = tunnel
+
+    @property
+    def alive(self) -> bool:
+        if self.tunnel is not None and not self.tunnel.alive:
+            return False
+        try:
+            self.client.health()
+            return True
+        except Exception:  # noqa: BLE001 — any rpc error means redial
+            return False
+
+    def close(self) -> None:
+        self.client.close()
+        if self.tunnel is not None:
+            self.tunnel.close()
+
+
+# cluster name -> live connection (tunnels are expensive; reuse them).
+_conns: Dict[str, _Conn] = {}
+
+
+@atexit.register
+def _close_all_connections() -> None:
+    """Short-lived CLI invocations must not leak their `ssh -N -L` tunnel
+    children — without this, every `queue`/`logs` against a remote cluster
+    would orphan one ssh process on the client."""
+    for name in list(_conns):
+        drop_connection(name)
+
+
+def agent_client(cluster_name: str, head_spec: RunnerSpec) -> AgentClient:
+    """A (cached) AgentClient for the cluster's head agent.
+
+    Cached connections are health-probed before reuse (one cheap Health
+    RPC): a tunnel or agent that died out-of-band is torn down and
+    redialed instead of poisoning every later verb — long-lived callers
+    (jobs controllers, the autostop daemon) depend on this self-healing."""
+    conn = _conns.get(cluster_name)
+    if conn is not None:
+        if conn.alive:
+            return conn.client
+        conn.close()
+        del _conns[cluster_name]
+    port = read_agent_port(head_spec, cluster_name)
+    mode = os.environ.get('SKYTPU_AGENT_DIAL', 'tunnel')
+    tunnel: Optional[AgentTunnel] = None
+    if mode == 'direct' or head_spec.kind != 'ssh':
+        address = f'127.0.0.1:{port}'
+    else:
+        tunnel = AgentTunnel(head_spec, port)
+        address = f'127.0.0.1:{tunnel.local_port}'
+    client = AgentClient(address, timeout=30.0)
+    _conns[cluster_name] = _Conn(client, tunnel)
+    return client
+
+
+def drop_connection(cluster_name: str) -> None:
+    conn = _conns.pop(cluster_name, None)
+    if conn is not None:
+        conn.close()

@@ -22,6 +22,7 @@ _METHODS = {
     'CancelJob': (False, pb.CancelJobRequest, pb.CancelJobReply),
     'TailLog': (True, pb.TailLogRequest, pb.LogChunk),
     'SetAutostop': (False, pb.SetAutostopRequest, pb.SetAutostopReply),
+    'SubmitJob': (False, pb.SubmitJobRequest, pb.SubmitJobReply),
 }
 
 

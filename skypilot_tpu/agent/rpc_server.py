@@ -107,6 +107,19 @@ class AgentServicer:
                 return
             time.sleep(0.3)
 
+    def SubmitJob(self, request: pb.SubmitJobRequest, context
+                  ) -> pb.SubmitJobReply:
+        """Driver-on-head submission: record the job, persist the spec, and
+        spawn the detached gang driver HERE (the head), so the job outlives
+        the submitting client (reference: ``_exec_code_on_head``,
+        ``cloud_vm_ray_backend.py:3739`` — the driver always ran on the
+        head there; this is the same contract for the TPU gang)."""
+        del context
+        job_id = job_lib.submit_and_spawn_driver(
+            self.cluster_dir, request.name, request.num_nodes,
+            request.num_workers, json.loads(request.spec_json))
+        return pb.SubmitJobReply(job_id=job_id)
+
     def SetAutostop(self, request: pb.SetAutostopRequest, context
                     ) -> pb.SetAutostopReply:
         del context

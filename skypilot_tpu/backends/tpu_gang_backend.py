@@ -10,14 +10,18 @@ TPU-native differences:
 * no Ray: the gang driver (``agent/driver.py``) fans the job out over all
   slice workers with the rank env contract; the FIFO job table serializes
   jobs per cluster;
-* the driver runs on the submitting host and reaches workers through
-  RunnerSpecs (local subprocess or pooled-ControlMaster SSH), which is the
-  Slurm-path execution model the reference already trusts
-  (``uses_ray()=False``, ``clouds/slurm.py:77``).
+* control plane: for SSH-reachable clusters the job table, logs, and gang
+  driver live ON the head node behind the gRPC agent
+  (``agent/rpc_server.py``) — submission goes through ``SubmitJob`` and the
+  driver fans out to peer workers with the cluster key installed at
+  bootstrap, so jobs survive the submitting machine and ``queue``/``logs``/
+  ``cancel`` work from any client (reference: ``_exec_code_on_head``
+  ``cloud_vm_ray_backend.py:3739`` + skylet gRPC). Local/fake/GKE clusters
+  keep the client-side driver (the Slurm-path execution model the
+  reference already trusts: ``uses_ray()=False``, ``clouds/slurm.py:77``).
 """
 from __future__ import annotations
 
-import json
 import os
 import shutil
 import subprocess
@@ -167,23 +171,59 @@ class TpuGangBackend(Backend):
             return handle
         return None
 
+    def _remote_control(self, handle: ClusterHandle) -> bool:
+        """True when the cluster's control plane (job table, logs, gang
+        driver) lives on the head node behind the gRPC agent. Local/fake
+        workers share this host (nothing to tunnel to); GKE pods are
+        reached by kubectl-exec from the client, so their driver stays
+        client-side this round."""
+        return handle.cloud not in ('local', 'fake', 'gke')
+
+    def is_remote_controlled(self, handle: ClusterHandle) -> bool:
+        """Public control-plane dispatch query (core/daemon/controllers ask
+        this instead of reimplementing the routing rule)."""
+        return self._remote_control(handle)
+
+    def set_cluster_autostop(self, handle: ClusterHandle, idle_minutes: int,
+                             down: bool = False) -> bool:
+        """Mirror the autostop policy to the head agent of a
+        remote-control cluster (the head evaluates idleness against the
+        authoritative job table). Returns True if mirrored; False when the
+        cluster is client-controlled or the head could not be reached (the
+        client-side daemon still enforces the policy)."""
+        if not self._remote_control(handle):
+            return False
+        try:
+            client = self._agent(handle)
+            if idle_minutes < 0:
+                client.cancel_autostop()
+            else:
+                client.set_autostop(idle_minutes, down)
+            return True
+        except Exception as exc:  # noqa: BLE001 — head mirror is advisory
+            print(f'[autostop] head agent not reachable ({exc}); '
+                  'client-side daemon will enforce the policy')
+            return False
+
     @timeline.event
     def _post_provision_setup(self, handle: ClusterHandle) -> None:
         """Remote-node bootstrap: wait for SSH, ship the runtime, prepare
-        workers (reference: ``provision/instance_setup.py:292-490``).
-        Local/fake workers run on this host — nothing to install."""
-        if handle.cloud in ('local', 'fake'):
+        workers, start the head agent (reference:
+        ``provision/instance_setup.py:292-490``). Local/fake workers run
+        on this host — nothing to install (unless the remote-control path
+        is forced, as the fake-ssh test rig does)."""
+        if handle.cloud in ('local', 'fake') and \
+                not self._remote_control(handle):
             return
         from skypilot_tpu.provision import instance_setup
         info = self._cluster_info(handle)
         runners = [self._runner_spec_for(handle, inst, info).make()
                    for inst in info.all_workers_sorted()]
-        # The client-side daemon owns autostop for now (the on-cluster
-        # agent daemon lands with the gRPC agent); start_daemon=False.
         # SKYTPU_REMOTE_PYTHON overrides the worker interpreter (TPU VM
         # images ship the ML stack on python3; tests point at their venv).
         instance_setup.bootstrap_cluster(
-            handle.cluster_name, info, runners, start_daemon=False,
+            handle.cluster_name, info, runners,
+            start_daemon=self._remote_control(handle),
             python=os.environ.get('SKYTPU_REMOTE_PYTHON', 'python3'))
 
     def _start_cluster_daemon(self, cluster_name: str) -> None:
@@ -400,6 +440,38 @@ class TpuGangBackend(Backend):
 
     # -- execute -----------------------------------------------------------
 
+    def _head_spec(self, handle: ClusterHandle,
+                   info: Optional[provision_common.ClusterInfo] = None
+                   ) -> RunnerSpec:
+        """Client->head runner spec (for dialing the agent). Raises
+        ClusterNotUpError when no worker is running (stopped/preempted) —
+        there is no head to dial."""
+        if info is None:
+            info = self._cluster_info(handle)
+        workers = info.all_workers_sorted()
+        if not workers:
+            raise exceptions.ClusterNotUpError(
+                f'Cluster {handle.cluster_name!r} has no running workers '
+                '(stopped or preempted); its head agent is unreachable.')
+        return self._runner_spec_for(handle, workers[0], info)
+
+    def _agent(self, handle: ClusterHandle,
+               info: Optional[provision_common.ClusterInfo] = None):
+        from skypilot_tpu.agent import remote as remote_lib
+        return remote_lib.agent_client(handle.cluster_name,
+                                       self._head_spec(handle, info))
+
+    def _peer_runner_spec(self, handle: ClusterHandle,
+                          inst: provision_common.InstanceInfo,
+                          info: provision_common.ClusterInfo) -> RunnerSpec:
+        """Head->worker runner spec, used by the head-side gang driver:
+        internal IPs + the cluster key installed at bootstrap."""
+        from skypilot_tpu.agent import remote as remote_lib
+        del handle
+        return RunnerSpec(kind='ssh', ip=inst.internal_ip,
+                          user=info.ssh_user,
+                          ssh_key=remote_lib.HEAD_CLUSTER_KEY)
+
     @timeline.event
     def execute(self, handle: ClusterHandle, task: Task,
                 detach_run: bool = False,
@@ -411,16 +483,26 @@ class TpuGangBackend(Backend):
                 f'Cluster {handle.cluster_name!r} has {info.num_workers} '
                 f'live workers, expected {expected} (preempted or partially '
                 'stopped?)')
+        remote = self._remote_control(handle)
         cdir = runtime_dir(handle.cluster_name)
-        table = job_lib.JobTable(cdir)
 
+        all_insts = info.all_workers_sorted()
         workers = []
-        for inst in info.all_workers_sorted():
+        for i, inst in enumerate(all_insts):
+            if remote:
+                # Runner specs are HEAD-relative: the driver runs on the
+                # head (worker 0 = plain subprocess; peers = SSH with the
+                # cluster key pushed at bootstrap).
+                runner = (RunnerSpec(kind='local', ip=inst.internal_ip)
+                          if i == 0 else
+                          self._peer_runner_spec(handle, inst, info))
+            else:
+                runner = self._runner_spec_for(handle, inst, info)
             workers.append({
                 'node_id': inst.node_id,
                 'worker_id': inst.worker_id,
                 'ip': inst.internal_ip,
-                'runner': self._runner_spec_for(handle, inst, info).to_dict(),
+                'runner': runner.to_dict(),
             })
         workdir_on_worker = None
         if task.workdir:
@@ -429,13 +511,6 @@ class TpuGangBackend(Backend):
                 if handle.cloud in ('local', 'fake') else '~/sky_workdir')
 
         job_name = task.name or 'task'
-        log_root = os.path.join(cdir, constants.JOBS_SUBDIR)
-        job_id = table.submit(job_name, handle.num_nodes, len(workers),
-                              log_dir='pending')
-        log_dir = os.path.join(log_root, str(job_id))
-        os.makedirs(log_dir, exist_ok=True)
-        table.set_log_dir(job_id, log_dir)
-
         # The nonce ties this driver to THIS incarnation of the cluster
         # runtime dir: a stale driver surviving a teardown+relaunch (same
         # cluster name) must not execute the new spec or write into the
@@ -453,22 +528,17 @@ class TpuGangBackend(Backend):
             'workdir_on_worker': workdir_on_worker,
             'nonce': nonce,
         }
-        with open(os.path.join(log_dir, 'spec.json'), 'w',
-                  encoding='utf-8') as f:
-            json.dump(spec, f, indent=1)
 
-        # Detached driver: survives this process; job table tracks it.
-        driver_cmd = [
-            sys.executable, '-m', 'skypilot_tpu.agent.driver',
-            '--cluster-dir', cdir, '--job-id', str(job_id),
-            '--nonce', nonce,
-        ]
-        env = dict(os.environ)
-        env['PYTHONPATH'] = (os.path.dirname(os.path.dirname(__file__)) +
-                             os.pathsep + env.get('PYTHONPATH', ''))
-        subprocess.Popen(driver_cmd, stdout=subprocess.DEVNULL,
-                         stderr=subprocess.DEVNULL, env=env,
-                         start_new_session=True)
+        if remote:
+            job_id = self._agent(handle, info).submit_job(
+                job_name, handle.num_nodes, len(workers), spec)
+        else:
+            env = dict(os.environ)
+            env['PYTHONPATH'] = (os.path.dirname(os.path.dirname(__file__)) +
+                                 os.pathsep + env.get('PYTHONPATH', ''))
+            job_id = job_lib.submit_and_spawn_driver(
+                cdir, job_name, handle.num_nodes, len(workers), spec,
+                env=env)
         global_user_state.touch_activity(handle.cluster_name)
         global_user_state.add_cluster_event(
             handle.cluster_name, 'JOB_SUBMITTED', f'job {job_id} {job_name}')
@@ -480,6 +550,26 @@ class TpuGangBackend(Backend):
 
     def tail_logs(self, handle: ClusterHandle, job_id: Optional[int],
                   follow: bool = True) -> None:
+        if self._remote_control(handle):
+            try:
+                client = self._agent(handle)
+            except exceptions.ClusterNotUpError as e:
+                print(f'Cannot reach the cluster head: {e}')
+                return
+            if job_id is None:
+                jobs = client.list_jobs(limit=1)
+                if not jobs:
+                    print('No jobs on this cluster.')
+                    return
+                job_id = jobs[0]['job_id']
+            for chunk in client.tail_log(job_id, lines=100000,
+                                         follow=follow):
+                print(chunk, end='', flush=True)
+            if follow:
+                j = client.get_job(job_id)
+                if j:
+                    print(f'Job {job_id} finished (status: {j["status"]}).')
+            return
         cdir = runtime_dir(handle.cluster_name)
         table = job_lib.JobTable(cdir)
         if job_id is None:
@@ -503,10 +593,51 @@ class TpuGangBackend(Backend):
                 print(f'Job {job_id} finished (status: {j["status"]}).')
 
     def job_queue(self, handle: ClusterHandle) -> List[Dict[str, Any]]:
+        if self._remote_control(handle):
+            try:
+                return self._agent(handle).list_jobs()
+            except exceptions.ClusterNotUpError:
+                return []  # stopped/preempted: no head to ask
         return job_lib.JobTable(runtime_dir(handle.cluster_name)).list_jobs()
 
-    def cancel_job(self, handle: ClusterHandle, job_id: int) -> bool:
+    def job_status(self, handle: ClusterHandle,
+                   job_id: Optional[int] = None) -> Optional[str]:
+        if self._remote_control(handle):
+            try:
+                client = self._agent(handle)
+            except exceptions.ClusterNotUpError:
+                return None  # stopped/preempted: no head to ask
+            if job_id is None:
+                jobs = client.list_jobs(limit=1)
+                return jobs[0]['status'] if jobs else None
+            job = client.get_job(job_id)
+            return job['status'] if job else None
         table = job_lib.JobTable(runtime_dir(handle.cluster_name))
+        if job_id is None:
+            job_id = table.latest_job_id()
+        if job_id is None:
+            return None
+        job = table.get(job_id)
+        return job['status'] if job else None
+
+    def cancel_job(self, handle: ClusterHandle,
+                   job_id: Optional[int] = None) -> bool:
+        if self._remote_control(handle):
+            try:
+                client = self._agent(handle)
+            except exceptions.ClusterNotUpError:
+                return False  # stopped/preempted: nothing running to cancel
+            if job_id is None:
+                jobs = client.list_jobs(limit=1)
+                if not jobs:
+                    return False
+                job_id = jobs[0]['job_id']
+            return client.cancel_job(job_id)
+        table = job_lib.JobTable(runtime_dir(handle.cluster_name))
+        if job_id is None:
+            job_id = table.latest_job_id()
+            if job_id is None:
+                return False
         cancelled, pid = table.cancel(job_id)
         if cancelled and pid:
             # SIGTERM the driver; its handler forwards to every worker
@@ -524,11 +655,19 @@ class TpuGangBackend(Backend):
         # Kill unfinished jobs first: their detached drivers (and gang
         # worker processes) must not outlive the cluster.
         try:
-            table = job_lib.JobTable(runtime_dir(handle.cluster_name))
-            for job in table.unfinished_jobs():
-                self.cancel_job(handle, job['job_id'])
+            if self._remote_control(handle):
+                client = self._agent(handle)
+                for job in client.list_jobs():
+                    if not job_lib.JobStatus(job['status']).is_terminal():
+                        client.cancel_job(job['job_id'])
+            else:
+                table = job_lib.JobTable(runtime_dir(handle.cluster_name))
+                for job in table.unfinished_jobs():
+                    self.cancel_job(handle, job['job_id'])
         except Exception:  # noqa: BLE001 — teardown must not fail on this
             pass
+        from skypilot_tpu.agent import remote as remote_lib
+        remote_lib.drop_connection(handle.cluster_name)
         if terminate:
             provision_lib.terminate_instances(
                 handle.cloud, handle.cluster_name_on_cloud,

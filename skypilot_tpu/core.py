@@ -13,7 +13,7 @@ from typing import Any, Dict, List, Optional
 from skypilot_tpu import exceptions
 from skypilot_tpu import global_user_state
 from skypilot_tpu import provision as provision_lib
-from skypilot_tpu.agent import constants, job_lib
+from skypilot_tpu.agent import constants
 from skypilot_tpu.backends import ClusterHandle, TpuGangBackend
 from skypilot_tpu.backends.tpu_gang_backend import runtime_dir
 from skypilot_tpu.resources import Resources
@@ -93,7 +93,7 @@ def down(cluster_name: str) -> None:
 def autostop(cluster_name: str, idle_minutes: int, down: bool = False) -> None:
     """Set (or -1 to cancel) the autostop policy; enforced by the cluster
     daemon (reference: ``skylet/autostop_lib.py`` + AutostopEvent)."""
-    _get_handle(cluster_name)  # existence check
+    handle = _get_handle(cluster_name)
     global_user_state.set_autostop(cluster_name, idle_minutes, down)
     cdir = runtime_dir(cluster_name)
     os.makedirs(cdir, exist_ok=True)
@@ -101,6 +101,9 @@ def autostop(cluster_name: str, idle_minutes: int, down: bool = False) -> None:
               encoding='utf-8') as f:
         json.dump({'idle_minutes': idle_minutes, 'down': down,
                    'set_at': time.time()}, f)
+    # Remote-control clusters: mirror the policy to the head agent, which
+    # evaluates idleness against the authoritative (head-side) job table.
+    TpuGangBackend().set_cluster_autostop(handle, idle_minutes, down)
 
 
 def queue(cluster_name: str) -> List[Dict[str, Any]]:
@@ -110,13 +113,7 @@ def queue(cluster_name: str) -> List[Dict[str, Any]]:
 
 def cancel(cluster_name: str, job_id: Optional[int] = None) -> bool:
     handle = _get_handle(cluster_name)
-    backend = TpuGangBackend()
-    if job_id is None:
-        table = job_lib.JobTable(runtime_dir(cluster_name))
-        job_id = table.latest_job_id()
-        if job_id is None:
-            return False
-    return backend.cancel_job(handle, job_id)
+    return TpuGangBackend().cancel_job(handle, job_id)
 
 
 def tail_logs(cluster_name: str, job_id: Optional[int] = None,
@@ -127,14 +124,8 @@ def tail_logs(cluster_name: str, job_id: Optional[int] = None,
 
 def job_status(cluster_name: str,
                job_id: Optional[int] = None) -> Optional[str]:
-    _get_handle(cluster_name)
-    table = job_lib.JobTable(runtime_dir(cluster_name))
-    if job_id is None:
-        job_id = table.latest_job_id()
-    if job_id is None:
-        return None
-    job = table.get(job_id)
-    return job['status'] if job else None
+    handle = _get_handle(cluster_name)
+    return TpuGangBackend().job_status(handle, job_id)
 
 
 def cost_report() -> List[Dict[str, Any]]:

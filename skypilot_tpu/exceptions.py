@@ -77,6 +77,14 @@ class ClusterNotUpError(SkyTpuError):
         self.handle = handle
 
 
+class HeadUnreachableError(SkyTpuError):
+    """The cluster LOOKS up (provider reports running workers) but its head
+    agent cannot be reached (SSH/tunnel/agent failure). Distinct from
+    ClusterNotUpError so callers never mistake a transiently unreachable
+    head for an idle/stopped cluster — acting on that confusion (autostop,
+    duplicate relaunch) loses running work."""
+
+
 class ClusterDoesNotExist(SkyTpuError):
     """Named cluster not found in state."""
 

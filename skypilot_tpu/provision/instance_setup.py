@@ -77,6 +77,25 @@ def install_runtime(runners: Sequence[CommandRunner],
         list(pool.map(_install_one, runners))
 
 
+def push_cluster_key_to_head(head_runner: CommandRunner,
+                             key_path: str) -> None:
+    """Install the cluster SSH private key on the head so the head-side
+    gang driver can fan out to peer workers (driver-on-head; reference: the
+    cluster YAML's auth key is uploaded so Ray head reaches workers,
+    ``backends/backend_utils.py:643`` ssh_private_key plumbing). Staged
+    through a directory rsync — runners sync dirs, and the key must never
+    appear on a command line."""
+    import shutil
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix='skytpu-key-') as td:
+        shutil.copy(os.path.expanduser(key_path),
+                    os.path.join(td, 'cluster_key'))
+        head_runner.rsync(td, f'{REMOTE_RUNTIME_DIR}/keys', up=True)
+    head_runner.run(f'chmod 700 {REMOTE_RUNTIME_DIR}/keys && '
+                    f'chmod 600 {REMOTE_RUNTIME_DIR}/keys/cluster_key')
+
+
 def start_agent_on_head(head_runner: CommandRunner, cluster_name: str,
                         python: str = 'python3') -> None:
     """Start the on-cluster agent (skylet analog: the gRPC server over the
@@ -114,7 +133,10 @@ def bootstrap_cluster(cluster_name: str, info: common.ClusterInfo,
     wait_for_ssh(runners, timeout=ssh_timeout)
     install_runtime(runners, python=python)
     if start_daemon:
-        start_agent_on_head(runners[0], cluster_name)
+        from skypilot_tpu import authentication
+        key_path, _ = authentication.get_or_create_ssh_keypair()
+        push_cluster_key_to_head(runners[0], key_path)
+        start_agent_on_head(runners[0], cluster_name, python=python)
     # Optional external log shipping (logs.store in config; reference:
     # provisioner.py:714-722 installing fluentbit at provision time).
     # Genuinely best-effort here: a config typo surfaced at launch entry

@@ -75,6 +75,12 @@ class CommandRunner:
         """argv that executes `cmd` on the worker (for gang fan-out)."""
         raise NotImplementedError
 
+    def output(self, cmd: str) -> 'tuple[int, str]':
+        """Run `cmd` on the worker; return (rc, captured stdout)."""
+        r = subprocess.run(self.popen_argv(cmd), check=False,
+                           capture_output=True, text=True)
+        return r.returncode, r.stdout
+
     def rsync(self, src: str, dst: str, up: bool = True) -> None:
         raise NotImplementedError
 

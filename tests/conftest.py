@@ -45,3 +45,105 @@ def enable_fake_cloud(monkeypatch, tmp_state_dir):
     from skypilot_tpu.provision.fake import instance as fake_instance
     fake_instance.reset_state()
     yield
+
+
+# --- fake-ssh rig (shared by test_ssh_path + test_remote_control) ----------
+# There is no sshd in the sandbox: an ``ssh`` shim installed first on PATH
+# emulates a remote host — validates key/options, refuses while the host is
+# "down", records every invocation, then executes the command locally under
+# the host's private HOME. Real ``rsync`` runs against it via ``-e ssh``, so
+# the full argv path is exercised; only the TCP/auth legs are faked.
+
+FAKE_SSH_SHIM = r'''#!/usr/bin/env python3
+import json, os, subprocess, sys
+
+args = sys.argv[1:]
+opts, key, port = [], None, None
+i = 0
+while i < len(args):
+    a = args[i]
+    if a == '-o':
+        opts.append(args[i + 1]); i += 2
+    elif a in ('-p', '-P'):
+        port = args[i + 1]; i += 2
+    elif a == '-i':
+        key = args[i + 1]; i += 2
+    elif a == '-N':
+        i += 1
+    else:
+        break
+dest = args[i]; i += 1
+cmd_words = args[i:]
+root = os.environ['FAKE_SSH_ROOT']
+user, _, host = dest.partition('@')
+record = {'host': host, 'user': user, 'opts': opts, 'key': key,
+          'cmd': cmd_words}
+with open(os.path.join(root, 'calls.jsonl'), 'a') as f:
+    f.write(json.dumps(record) + '\n')
+if not os.path.exists(os.path.join(root, host + '.up')):
+    sys.exit(255)  # host still booting
+if key is not None and not os.path.exists(os.path.expanduser(key)):
+    sys.exit(255)  # auth failure
+home = os.path.join(root, 'homes', host)
+os.makedirs(home, exist_ok=True)
+env = dict(os.environ)
+env['HOME'] = home
+line = ' '.join(cmd_words)  # ssh semantics: words joined, remote shell
+r = subprocess.run(['bash', '-c', line], env=env, cwd=home)
+sys.exit(r.returncode)
+'''
+
+
+@pytest.fixture()
+def fake_ssh(tmp_path, monkeypatch, tmp_state_dir):
+    import json as _json
+    import signal as _signal
+    import stat as _stat
+
+    root = tmp_path / 'fake-ssh'
+    root.mkdir()
+    (root / 'homes').mkdir()
+    bindir = tmp_path / 'shim-bin'
+    bindir.mkdir()
+    shim = bindir / 'ssh'
+    shim.write_text(FAKE_SSH_SHIM)
+    shim.chmod(shim.stat().st_mode | _stat.S_IEXEC)
+    monkeypatch.setenv('PATH', f'{bindir}:{os.environ["PATH"]}')
+    monkeypatch.setenv('FAKE_SSH_ROOT', str(root))
+
+    class Rig:
+        def __init__(self):
+            self.root = root
+
+        def up(self, host):
+            # A host's login shells (`bash -lc`, the real-SSH invocation
+            # path) reset PATH from /etc/profile; on a real node `ssh`
+            # lives in the standard PATH, here the shim dir must be
+            # restored by the profile.
+            home = root / 'homes' / host
+            home.mkdir(parents=True, exist_ok=True)
+            (home / '.profile').write_text(
+                f'export PATH={bindir}:$PATH\n')
+            (root / f'{host}.up').touch()
+
+        def calls(self):
+            path = root / 'calls.jsonl'
+            if not path.exists():
+                return []
+            return [_json.loads(l) for l in path.read_text().splitlines()]
+
+        def home(self, host):
+            return root / 'homes' / host
+
+    yield Rig()
+
+    # Daemons nohup'd inside fake homes (head agents) outlive monkeypatch:
+    # kill anything that recorded a pidfile.
+    for pidfile in root.glob('homes/*/.skytpu/runtime/daemon-*.pid'):
+        try:
+            os.kill(int(pidfile.read_text().strip()), _signal.SIGTERM)
+        except (ValueError, ProcessLookupError, PermissionError):
+            pass
+    from skypilot_tpu.agent import remote as remote_lib
+    for name in list(remote_lib._conns):  # pylint: disable=protected-access
+        remote_lib.drop_connection(name)

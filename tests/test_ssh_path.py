@@ -13,7 +13,6 @@ generation, instance_setup (wait_for_ssh / install_runtime /
 start_agent_on_head), and a 4-worker gang launch over "SSH" with the full
 rank env contract (reference: ``provision/instance_setup.py:292-490``).
 """
-import json
 import os
 import stat
 import subprocess
@@ -26,74 +25,8 @@ from skypilot_tpu import authentication
 from skypilot_tpu.provision import instance_setup
 from skypilot_tpu.utils.command_runner import RunnerSpec, SSHCommandRunner
 
-SHIM = r'''#!/usr/bin/env python3
-import json, os, subprocess, sys
-
-args = sys.argv[1:]
-opts, key, port = [], None, None
-i = 0
-while i < len(args):
-    a = args[i]
-    if a == '-o':
-        opts.append(args[i + 1]); i += 2
-    elif a in ('-p', '-P'):
-        port = args[i + 1]; i += 2
-    elif a == '-i':
-        key = args[i + 1]; i += 2
-    else:
-        break
-dest = args[i]; i += 1
-cmd_words = args[i:]
-root = os.environ['FAKE_SSH_ROOT']
-user, _, host = dest.partition('@')
-record = {'host': host, 'user': user, 'opts': opts, 'key': key,
-          'cmd': cmd_words}
-with open(os.path.join(root, 'calls.jsonl'), 'a') as f:
-    f.write(json.dumps(record) + '\n')
-if not os.path.exists(os.path.join(root, host + '.up')):
-    sys.exit(255)  # host still booting
-if key is not None and not os.path.exists(os.path.expanduser(key)):
-    sys.exit(255)  # auth failure
-home = os.path.join(root, 'homes', host)
-os.makedirs(home, exist_ok=True)
-env = dict(os.environ)
-env['HOME'] = home
-line = ' '.join(cmd_words)  # ssh semantics: words joined, remote shell
-r = subprocess.run(['bash', '-c', line], env=env, cwd=home)
-sys.exit(r.returncode)
-'''
-
-
-@pytest.fixture()
-def fake_ssh(tmp_path, monkeypatch, tmp_state_dir):
-    root = tmp_path / 'fake-ssh'
-    root.mkdir()
-    (root / 'homes').mkdir()
-    bindir = tmp_path / 'shim-bin'
-    bindir.mkdir()
-    shim = bindir / 'ssh'
-    shim.write_text(SHIM)
-    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
-    monkeypatch.setenv('PATH', f'{bindir}:{os.environ["PATH"]}')
-    monkeypatch.setenv('FAKE_SSH_ROOT', str(root))
-
-    class Rig:
-        def __init__(self):
-            self.root = root
-
-        def up(self, host):
-            (root / f'{host}.up').touch()
-
-        def calls(self):
-            path = root / 'calls.jsonl'
-            if not path.exists():
-                return []
-            return [json.loads(l) for l in path.read_text().splitlines()]
-
-        def home(self, host):
-            return root / 'homes' / host
-
-    yield Rig()
+# The ``fake_ssh`` rig (ssh shim + per-host fake HOMEs) lives in
+# conftest.py, shared with test_remote_control.py.
 
 
 def _runner(host: str) -> SSHCommandRunner:
@@ -275,6 +208,10 @@ def test_ssh_node_pool_cloud_end_to_end(fake_ssh, tmp_state_dir,
 
     import sys
     monkeypatch.setenv('SKYTPU_REMOTE_PYTHON', sys.executable)
+    # BYO-SSH is a remote-control cloud: the driver runs on the head
+    # behind the gRPC agent; the rig's agent binds loopback, so dial it
+    # directly instead of tunneling.
+    monkeypatch.setenv('SKYTPU_AGENT_DIAL', 'direct')
     key, _ = authentication.get_or_create_ssh_keypair()
     with open(ssh_instance.pools_path(), 'w', encoding='utf-8') as f:
         yaml_lib.safe_dump({
@@ -296,8 +233,11 @@ def test_ssh_node_pool_cloud_end_to_end(fake_ssh, tmp_state_dir,
             break
         time.sleep(0.3)
     assert s == 'SUCCEEDED', s
-    merged = os.path.join(runtime_dir('byo'), 'jobs', str(job_id), 'run.log')
-    content = open(merged, encoding='utf-8').read()
+    # Driver-on-head: the merged log lives on the head (hostA), not the
+    # client.
+    merged = (fake_ssh.home('hostA') / '.skytpu' / 'runtime' / 'clusters' /
+              'byo' / 'jobs' / str(job_id) / 'run.log')
+    content = merged.read_text()
     assert 'pool-rank=0 host=hostA' in content
     assert 'pool-rank=1 host=hostB' in content
     # Leases held while up; released on down.
