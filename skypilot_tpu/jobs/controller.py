@@ -62,10 +62,25 @@ class JobController:
         running = [s for s in statuses.values() if s == 'running']
         return len(running) == handle.total_workers
 
+    def _backend_and_handle(self):
+        record = global_user_state.get_cluster(self.cluster_name)
+        if record is None or not record['handle']:
+            return None, None
+        from skypilot_tpu.backends import TpuGangBackend
+        return TpuGangBackend(), ClusterHandle.from_dict(record['handle'])
+
     def _agent_job_status(self, agent_job_id: int) -> Optional[str]:
-        table = job_lib.JobTable(runtime_dir(self.cluster_name))
-        job = table.get(agent_job_id)
-        return job['status'] if job else None
+        """Workload job status via the backend, which routes to the HEAD
+        agent for remote-control clusters (the job table is head-side
+        there; the client-local table stays empty). An unreachable head
+        returns None and the provider-side health check drives recovery."""
+        backend, handle = self._backend_and_handle()
+        if backend is None:
+            return None
+        try:
+            return backend.job_status(handle, agent_job_id)
+        except Exception:  # noqa: BLE001 — head gone == no status
+            return None
 
     # -- main loop ---------------------------------------------------------
 
@@ -78,16 +93,70 @@ class JobController:
                              detail=repr(e))
             return state.ManagedJobStatus.FAILED_CONTROLLER
 
+    def _adoptable_agent_job(self) -> Optional[int]:
+        """After an HA controller restart: the previous incarnation's launch,
+        if its cluster is still healthy and has a job on its table. Adopting
+        (instead of relaunching) is what makes controller crashes invisible
+        to the workload (reference: HA controllers resume from dumped run
+        scripts, ``execution.py:296-302``)."""
+        backend, handle = self._backend_and_handle()
+        if backend is None or not self._cluster_is_healthy():
+            return None
+        # HeadUnreachableError (and rpc failures) propagate: a HEALTHY
+        # cluster whose agent merely failed to answer must NOT be treated
+        # as adoption-impossible — relaunching would duplicate the gang
+        # job. The controller fails (FAILED_CONTROLLER) and the watchdog
+        # retries once the head answers.
+        try:
+            jobs_list = backend.job_queue(handle)  # newest first
+        except exceptions.ClusterNotUpError:
+            return None  # genuinely stopped under us
+        return jobs_list[0]['job_id'] if jobs_list else None
+
     def _run_inner(self) -> state.ManagedJobStatus:
         job_id = self.job_id
-        state.set_status(job_id, state.ManagedJobStatus.STARTING)
-        try:
-            agent_job_id = self.strategy.launch()
-        except exceptions.ResourcesUnfeasibleError as e:
-            state.set_status(job_id, state.ManagedJobStatus.FAILED_NO_RESOURCE,
-                             detail=str(e))
-            return state.ManagedJobStatus.FAILED_NO_RESOURCE
-        state.set_status(job_id, state.ManagedJobStatus.RUNNING)
+        prev = self.record['status']
+        agent_job_id: Optional[int] = None
+        restarted = prev in (state.ManagedJobStatus.STARTING,
+                             state.ManagedJobStatus.RUNNING,
+                             state.ManagedJobStatus.RECOVERING,
+                             state.ManagedJobStatus.CANCELLING)
+        if restarted:
+            agent_job_id = self._adoptable_agent_job()
+        if prev == state.ManagedJobStatus.CANCELLING and agent_job_id is None:
+            # Cancelled while the controller was down and there is nothing
+            # adoptable to cancel gracefully: clean up whatever exists and
+            # finish the cancellation — NEVER relaunch a cancelled job.
+            self._teardown()
+            state.set_status(job_id, state.ManagedJobStatus.CANCELLED)
+            return state.ManagedJobStatus.CANCELLED
+        if agent_job_id is None:
+            if restarted and \
+                    global_user_state.get_cluster(self.cluster_name) \
+                    is not None and not self._cluster_is_healthy():
+                # The slice died while the controller was down: straight to
+                # the recovery path (terminate remnants, relaunch).
+                state.bump_recovery_count(job_id)
+                state.set_status(
+                    job_id, state.ManagedJobStatus.RECOVERING,
+                    detail='controller restarted; cluster unhealthy')
+                agent_job_id = self.strategy.recover()
+            else:
+                state.set_status(job_id, state.ManagedJobStatus.STARTING)
+                try:
+                    agent_job_id = self.strategy.launch()
+                except exceptions.ResourcesUnfeasibleError as e:
+                    state.set_status(job_id,
+                                     state.ManagedJobStatus.FAILED_NO_RESOURCE,
+                                     detail=str(e))
+                    return state.ManagedJobStatus.FAILED_NO_RESOURCE
+        current = state.get(job_id)
+        if current is None or \
+                current['status'] != state.ManagedJobStatus.CANCELLING:
+            # Do not clobber a cancellation that arrived while restarting;
+            # the monitor loop below honors it first thing.
+            state.set_status(job_id, state.ManagedJobStatus.RUNNING,
+                             detail='resumed' if restarted else None)
 
         failure_restarts = 0
         while True:

@@ -35,6 +35,8 @@ def submit_job(job_id: int) -> None:
     """Enter the WAITING pool and start controllers if there is room."""
     state.set_schedule_state(job_id, state.ScheduleState.WAITING)
     maybe_schedule_next()
+    from skypilot_tpu.jobs import watchdog
+    watchdog.ensure_running()
 
 
 # A controller that crashed between task submission and controller_started
@@ -43,6 +45,56 @@ def submit_job(job_id: int) -> None:
 # can itself take minutes and must not count) the slot is reclaimed and
 # the job marked failed.
 LAUNCHING_GRACE_S = 900.0
+
+
+def max_controller_restarts() -> int:
+    return int(os.environ.get('SKYTPU_CONTROLLER_MAX_RESTARTS', '3'))
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+def _reconcile_dead_controllers() -> None:
+    """HA sweep (reference: HIGH_AVAILABILITY_CONTROLLERS — the k8s
+    deployment restarts a crashed controller and its run script resumes the
+    job, ``sky/utils/controller_utils.py:255``): an ALIVE job whose
+    controller process is gone while the managed job is non-terminal is
+    re-queued (bounded restarts); its restarted controller ADOPTS the
+    running launch instead of relaunching (see JobController resume path).
+    pid liveness is host-local, so this sweep runs ONLY from the watchdog
+    (itself a controller-cluster task on the same host as the controller
+    pids) — never from the client's submit path, where every remote pid
+    would look dead and healthy controllers would be duplicated."""
+    for row in state.alive_controllers():
+        if row['status'].is_terminal():
+            # Controller exited without flipping its slot; free it.
+            state.cas_schedule_state(row['job_id'],
+                                     [state.ScheduleState.ALIVE],
+                                     state.ScheduleState.DONE)
+            continue
+        pid = row['controller_pid']
+        if pid is None or _pid_alive(int(pid)):
+            continue
+        job_id = row['job_id']
+        restarts = state.bump_controller_restarts(job_id)
+        if restarts > max_controller_restarts():
+            if state.cas_schedule_state(job_id, [state.ScheduleState.ALIVE],
+                                        state.ScheduleState.DONE):
+                state.set_status(
+                    job_id, state.ManagedJobStatus.FAILED_CONTROLLER,
+                    detail=f'controller died {restarts} times; giving up')
+            continue
+        # Back into the pool; the CAS keeps a racing healthy controller
+        # (pid reused / just reported in) authoritative.
+        state.cas_schedule_state(job_id, [state.ScheduleState.ALIVE],
+                                 state.ScheduleState.WAITING)
 
 
 def _reconcile_stale_launching() -> None:
@@ -61,12 +113,16 @@ def _reconcile_stale_launching() -> None:
             detail=f'controller never started within {LAUNCHING_GRACE_S:.0f}s')
 
 
-def maybe_schedule_next() -> None:
+def maybe_schedule_next(reap_dead_controllers: bool = False) -> None:
     """Promote WAITING jobs to LAUNCHING while under the cap. Called on
-    submit and whenever a controller exits."""
+    submit and whenever a controller exits. ``reap_dead_controllers`` is
+    the HA sweep — only the watchdog (co-located with the controller pids)
+    may pass it."""
     while True:
         with _sched_lock():
             _reconcile_stale_launching()
+            if reap_dead_controllers:
+                _reconcile_dead_controllers()
             if state.count_live_controllers() >= max_concurrent_controllers():
                 return
             job_id = state.next_waiting()

@@ -69,7 +69,8 @@ CREATE TABLE IF NOT EXISTS managed_jobs (
     last_event TEXT,
     controller_pid INTEGER,
     schedule_state TEXT DEFAULT 'WAITING',
-    schedule_state_at REAL
+    schedule_state_at REAL,
+    controller_restarts INTEGER DEFAULT 0
 );
 CREATE TABLE IF NOT EXISTS managed_job_events (
     id INTEGER PRIMARY KEY AUTOINCREMENT,
@@ -96,7 +97,9 @@ def _conn() -> sqlite3.Connection:
     # Migration for databases created before schedule_state existed.
     for ddl in ("ALTER TABLE managed_jobs ADD COLUMN schedule_state "
                 "TEXT DEFAULT 'WAITING'",
-                'ALTER TABLE managed_jobs ADD COLUMN schedule_state_at REAL'):
+                'ALTER TABLE managed_jobs ADD COLUMN schedule_state_at REAL',
+                'ALTER TABLE managed_jobs ADD COLUMN controller_restarts '
+                'INTEGER DEFAULT 0'):
         try:
             conn.execute(ddl)
         except sqlite3.OperationalError:
@@ -160,6 +163,29 @@ def set_controller_pid(job_id: int, pid: int) -> None:
     with _lock(), _conn() as conn:
         conn.execute('UPDATE managed_jobs SET controller_pid = ? '
                      'WHERE job_id = ?', (pid, job_id))
+
+
+def bump_controller_restarts(job_id: int) -> int:
+    """Count an HA controller restart; returns the new total."""
+    with _lock(), _conn() as conn:
+        conn.execute('UPDATE managed_jobs SET controller_restarts = '
+                     'controller_restarts + 1 WHERE job_id = ?', (job_id,))
+        row = conn.execute('SELECT controller_restarts FROM managed_jobs '
+                           'WHERE job_id = ?', (job_id,)).fetchone()
+        return int(row['controller_restarts'])
+
+
+def alive_controllers() -> List[Dict[str, Any]]:
+    """Jobs whose schedule state says a controller is running (ALIVE):
+    (job_id, controller_pid, status) rows for the HA liveness sweep."""
+    with _conn() as conn:
+        rows = conn.execute(
+            'SELECT job_id, controller_pid, status FROM managed_jobs '
+            'WHERE schedule_state = ?',
+            (ScheduleState.ALIVE.value,)).fetchall()
+        return [{'job_id': int(r['job_id']),
+                 'controller_pid': r['controller_pid'],
+                 'status': ManagedJobStatus(r['status'])} for r in rows]
 
 
 def bump_recovery_count(job_id: int) -> int:

@@ -1,0 +1,87 @@
+"""Managed-jobs HA watchdog: restarts crashed controllers.
+
+Reference analog: HIGH_AVAILABILITY_CONTROLLERS
+(``sky/execution.py:296-302``, ``sky/utils/controller_utils.py:255``) — the
+reference deploys its controllers under a k8s Deployment so a crashed
+controller process is restarted and its dumped run script resumes the job.
+Here the supervisor is explicit: a loop over
+``scheduler.maybe_schedule_next()``, whose reconciliation sweeps
+
+* re-queue ALIVE jobs whose controller pid is gone (bounded restarts,
+  ``SKYTPU_CONTROLLER_MAX_RESTARTS``) — the restarted controller ADOPTS the
+  still-running launch (``JobController._adoptable_agent_job``);
+* reap LAUNCHING slots whose controller never reported in;
+* promote WAITING jobs while under the admission cap.
+
+The watchdog runs as a task on the jobs-controller cluster (same host as
+the controller pids it probes) and exits once the job table has been fully
+terminal for a few ticks, so it never outlives the work.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+import filelock
+
+from skypilot_tpu.jobs import scheduler, state
+
+_IDLE_EXIT_TICKS = 5
+
+
+def _lock_path() -> str:
+    d = os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, 'jobs_watchdog.pid.lock')
+
+
+def ensure_running() -> bool:
+    """Start the watchdog as a controller-cluster task if none is alive
+    (probe: the running watchdog holds the pid lock). Returns True if a
+    watchdog was (already) running or was started."""
+    probe = filelock.FileLock(_lock_path())
+    try:
+        probe.acquire(timeout=0)
+    except filelock.Timeout:
+        return True  # a live watchdog holds it
+    probe.release()
+    from skypilot_tpu.utils import controller_utils
+    try:
+        controller_utils.launch_controller_task(
+            'skypilot_tpu.jobs.watchdog', '',
+            job_name='jobs-watchdog',
+            cluster_name=controller_utils.JOBS_CONTROLLER_CLUSTER)
+        return True
+    except Exception as e:  # noqa: BLE001 — HA is best-effort; jobs still run
+        print(f'[jobs] watchdog start failed: {e!r}')
+        return False
+
+
+def run(interval_s: float = 2.0) -> None:
+    lock = filelock.FileLock(_lock_path())
+    try:
+        lock.acquire(timeout=0)
+    except filelock.Timeout:
+        return  # another watchdog owns this state dir
+    idle = 0
+    with lock:
+        while idle < _IDLE_EXIT_TICKS:
+            try:
+                scheduler.maybe_schedule_next(reap_dead_controllers=True)
+            except Exception as e:  # noqa: BLE001 — the watchdog must survive
+                print(f'[watchdog] sweep failed: {e!r}')
+            idle = idle + 1 if state.count_nonterminal() == 0 else 0
+            time.sleep(interval_s)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--interval', type=float, default=2.0)
+    args = parser.parse_args()
+    run(interval_s=args.interval)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,4 +1,4 @@
-"""Fake provisioner: in-memory TPU topology backend for tests.
+"""Fake provisioner: disk-backed TPU topology backend for tests.
 
 The testing gap SURVEY.md §4 calls out in the reference: multi-node logic is
 only testable by mocking the provision interface ad hoc.  Here the fake
@@ -13,62 +13,101 @@ provider *implements* the interface with full slice semantics:
   vanish at once, the TPU failure mode (SURVEY.md §7 hard parts);
 * stop/resume, status queries, and deterministic fake IPs.
 
-State is process-global so backend code under test sees a consistent cloud;
-``reset_state()`` runs per-test from the ``enable_fake_cloud`` fixture.
+State lives in ``$SKYTPU_STATE_DIR/fake_cloud.json`` behind a filelock so
+SEPARATE PROCESSES see the same fake cloud — controllers-as-tasks, the HA
+watchdog, and remote-control tests all query instance state from processes
+other than the one that provisioned (the reference gets this for free from
+real cloud APIs). ``reset_state()`` runs per-test from the
+``enable_fake_cloud`` fixture; tmp state dirs isolate tests.
 """
 from __future__ import annotations
 
-import threading
-from typing import Any, Dict, List, Optional, Set
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import filelock
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.provision import common
 
-_lock = threading.RLock()
-# cluster_name_on_cloud -> {'config': ProvisionConfig, 'instances': {id: dict}}
-_clusters: Dict[str, Dict[str, Any]] = {}
-_stockout_zones: Set[str] = set()
-_stockout_once_zones: Set[str] = set()
-_provision_attempts: List[str] = []  # zone per run_instances call (for asserts)
+_EMPTY: Dict[str, Any] = {
+    'clusters': {},            # name -> {'zone': str, 'instances': {id: dict}}
+    'stockout_zones': [],
+    'stockout_once_zones': [],
+    'provision_attempts': [],  # zone per run_instances call (for asserts)
+}
+
+
+def _state_path() -> str:
+    d = os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, 'fake_cloud.json')
+
+
+def _flock() -> filelock.FileLock:
+    return filelock.FileLock(_state_path() + '.lock')
+
+
+def _read() -> Dict[str, Any]:
+    try:
+        with open(_state_path(), encoding='utf-8') as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return json.loads(json.dumps(_EMPTY))
+
+
+def _write(st: Dict[str, Any]) -> None:
+    tmp = _state_path() + '.tmp'
+    with open(tmp, 'w', encoding='utf-8') as f:
+        json.dump(st, f)
+    os.replace(tmp, _state_path())
 
 
 def reset_state() -> None:
-    with _lock:
-        _clusters.clear()
-        _stockout_zones.clear()
-        _stockout_once_zones.clear()
-        _provision_attempts.clear()
+    with _flock():
+        _write(json.loads(json.dumps(_EMPTY)))
 
 
 def inject_stockout(zone: str, once: bool = False) -> None:
-    with _lock:
-        (_stockout_once_zones if once else _stockout_zones).add(zone)
+    with _flock():
+        st = _read()
+        key = 'stockout_once_zones' if once else 'stockout_zones'
+        if zone not in st[key]:
+            st[key].append(zone)
+        _write(st)
 
 
 def clear_stockout(zone: str) -> None:
-    with _lock:
-        _stockout_zones.discard(zone)
-        _stockout_once_zones.discard(zone)
+    with _flock():
+        st = _read()
+        for key in ('stockout_zones', 'stockout_once_zones'):
+            if zone in st[key]:
+                st[key].remove(zone)
+        _write(st)
 
 
 def provision_attempts() -> List[str]:
-    with _lock:
-        return list(_provision_attempts)
+    with _flock():
+        return list(_read()['provision_attempts'])
 
 
 def preempt_cluster(cluster_name_on_cloud: str) -> None:
     """Simulate spot reclamation: every worker of every slice terminates."""
-    with _lock:
-        cluster = _clusters.get(cluster_name_on_cloud)
+    with _flock():
+        st = _read()
+        cluster = st['clusters'].get(cluster_name_on_cloud)
         if cluster is None:
             return
         for inst in cluster['instances'].values():
             inst['status'] = 'terminated'
+        _write(st)
 
 
 def list_cluster_names() -> List[str]:
-    with _lock:
-        return list(_clusters)
+    with _flock():
+        return list(_read()['clusters'])
 
 
 def _fake_ip(cluster: str, node_id: int, worker_id: int) -> str:
@@ -78,20 +117,24 @@ def _fake_ip(cluster: str, node_id: int, worker_id: int) -> str:
 
 def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
     zone = config.zone or f'{config.region}-a'
-    with _lock:
-        _provision_attempts.append(zone)
-        if zone in _stockout_once_zones:
-            _stockout_once_zones.discard(zone)
+    with _flock():
+        st = _read()
+        st['provision_attempts'].append(zone)
+        if zone in st['stockout_once_zones']:
+            st['stockout_once_zones'].remove(zone)
+            _write(st)
             raise exceptions.QuotaExceededError(
                 f'[fake] transient stockout in {zone}')
-        if zone in _stockout_zones:
+        if zone in st['stockout_zones']:
+            _write(st)
             raise exceptions.QuotaExceededError(
-                f'[fake] no capacity for {config.node_config.get("accelerator_type", "vm")} '
+                f'[fake] no capacity for '
+                f'{config.node_config.get("accelerator_type", "vm")} '
                 f'in {zone}')
         name = config.cluster_name_on_cloud
         hosts_per_slice = int(config.node_config.get('hosts_per_slice', 1))
-        cluster = _clusters.setdefault(
-            name, {'config': config, 'instances': {}})
+        cluster = st['clusters'].setdefault(
+            name, {'zone': zone, 'instances': {}})
         created, resumed = [], []
         for node_id in range(config.num_nodes):
             for worker_id in range(hosts_per_slice):
@@ -110,6 +153,7 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
                 elif inst['status'] in ('stopped', 'terminated'):
                     inst['status'] = 'running'
                     resumed.append(iid)
+        _write(st)
         head = f'{name}-n0-w0'
         return common.ProvisionRecord(
             provider_name='fake', region=config.region, zone=zone,
@@ -119,38 +163,43 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
 
 def wait_instances(region: str, cluster_name_on_cloud: str,
                    state: str) -> None:
-    # In-memory instances transition instantly.
+    # Fake instances transition instantly.
     del region, state
-    with _lock:
-        if cluster_name_on_cloud not in _clusters:
+    with _flock():
+        if cluster_name_on_cloud not in _read()['clusters']:
             raise exceptions.ClusterDoesNotExist(cluster_name_on_cloud)
 
 
 def stop_instances(cluster_name_on_cloud: str,
                    provider_config: Optional[Dict[str, Any]] = None) -> None:
     del provider_config
-    with _lock:
-        cluster = _clusters.get(cluster_name_on_cloud)
+    with _flock():
+        st = _read()
+        cluster = st['clusters'].get(cluster_name_on_cloud)
         if cluster is None:
             return
         for inst in cluster['instances'].values():
             if inst['status'] == 'running':
                 inst['status'] = 'stopped'
+        _write(st)
 
 
 def terminate_instances(cluster_name_on_cloud: str,
-                        provider_config: Optional[Dict[str, Any]] = None) -> None:
+                        provider_config: Optional[Dict[str, Any]] = None
+                        ) -> None:
     del provider_config
-    with _lock:
-        _clusters.pop(cluster_name_on_cloud, None)
+    with _flock():
+        st = _read()
+        st['clusters'].pop(cluster_name_on_cloud, None)
+        _write(st)
 
 
 def query_instances(cluster_name_on_cloud: str,
                     provider_config: Optional[Dict[str, Any]] = None
                     ) -> Dict[str, Optional[str]]:
     del provider_config
-    with _lock:
-        cluster = _clusters.get(cluster_name_on_cloud)
+    with _flock():
+        cluster = _read()['clusters'].get(cluster_name_on_cloud)
         if cluster is None:
             return {}
         return {iid: i['status'] for iid, i in cluster['instances'].items()}
@@ -160,8 +209,8 @@ def get_cluster_info(region: str, cluster_name_on_cloud: str,
                      provider_config: Optional[Dict[str, Any]] = None
                      ) -> common.ClusterInfo:
     del provider_config
-    with _lock:
-        cluster = _clusters.get(cluster_name_on_cloud)
+    with _flock():
+        cluster = _read()['clusters'].get(cluster_name_on_cloud)
         if cluster is None:
             raise exceptions.ClusterDoesNotExist(cluster_name_on_cloud)
         instances = [
@@ -178,4 +227,4 @@ def get_cluster_info(region: str, cluster_name_on_cloud: str,
             head_instance_id=head if any(
                 i.instance_id == head for i in instances) else None,
             provider_name='fake', region=region,
-            zone=cluster['config'].zone)
+            zone=cluster['zone'])

@@ -1,0 +1,136 @@
+"""HA controllers: crashed managed-job controllers restart and resume.
+
+Reference analog: HIGH_AVAILABILITY_CONTROLLERS (``sky/execution.py:
+296-302``, ``sky/utils/controller_utils.py:255``) — controllers run under a
+supervisor that restarts them after a crash, and the restarted controller
+resumes the job rather than relaunching it. Here the supervisor is the
+jobs watchdog (``jobs/watchdog.py``) driving the scheduler's
+dead-controller sweep; these tests SIGKILL real controller processes and
+assert the job still completes.
+"""
+import os
+import signal
+import time
+
+import pytest
+
+from skypilot_tpu import core, global_user_state, jobs
+from skypilot_tpu.jobs import scheduler, state
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+
+@pytest.fixture(autouse=True)
+def _fake(enable_fake_cloud):
+    yield
+
+
+def _wait(pred, timeout=60.0, interval=0.2, desc='condition'):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(interval)
+    raise TimeoutError(f'timed out waiting for {desc}')
+
+
+def _wait_running_with_pid(job_id: int) -> int:
+    def check():
+        r = state.get(job_id)
+        if r and r['status'] == state.ManagedJobStatus.RUNNING and \
+                r['controller_pid']:
+            return int(r['controller_pid'])
+        if r and r['status'].is_terminal():
+            raise AssertionError(
+                f'job ended early: {r["status"]} events={state.events(job_id)}')
+        return None
+    return _wait(check, desc=f'job {job_id} RUNNING with controller pid')
+
+
+def _kill_hard(pid: int) -> None:
+    os.kill(pid, signal.SIGKILL)
+    _wait(lambda: not scheduler._pid_alive(pid), timeout=10,
+          desc=f'pid {pid} to die')
+
+
+def test_controller_crash_restarts_and_adopts():
+    """SIGKILL the controller mid-run; the watchdog sweep restarts it; the
+    new controller ADOPTS the healthy cluster (no relaunch) and the job
+    succeeds."""
+    task = Task('ha-adopt', run='sleep 8; echo finished')
+    task.set_resources(Resources(accelerators='tpu-v5e-8', cloud='fake'))
+    job_id = jobs.launch(task)
+    pid = _wait_running_with_pid(job_id)
+    cluster = state.get(job_id)['cluster_name']
+    launched_at = global_user_state.get_cluster(cluster)['launched_at']
+
+    _kill_hard(pid)
+    scheduler.maybe_schedule_next(reap_dead_controllers=True)  # watchdog tick
+
+    final = _wait(
+        lambda: (state.get(job_id)['status']
+                 if state.get(job_id)['status'].is_terminal() else None),
+        timeout=90, desc='terminal status')
+    assert final == state.ManagedJobStatus.SUCCEEDED, state.events(job_id)
+    r = state.get(job_id)
+    assert r['controller_restarts'] >= 1
+    # Adoption, not relaunch: the original cluster incarnation served the
+    # whole job and the recovery path never ran.
+    assert r['recovery_count'] == 0
+    assert any(e['detail'] == 'resumed' for e in state.events(job_id))
+    # The restarted controller's cluster record was the same launch.
+    assert global_user_state.get_cluster(cluster) is None  # cleaned up
+
+
+def test_controller_crash_with_dead_cluster_recovers():
+    """Controller AND slice die together: the restarted controller takes
+    the recovery path (terminate remnants, relaunch) and still succeeds."""
+    from skypilot_tpu.provision.fake import instance as fake
+
+    task = Task('ha-recover', run='sleep 8; echo finished')
+    task.set_resources(Resources(accelerators='tpu-v5e-8', cloud='fake',
+                                 use_spot=True))
+    job_id = jobs.launch(task)
+    pid = _wait_running_with_pid(job_id)
+    cluster = state.get(job_id)['cluster_name']
+    record = global_user_state.get_cluster(cluster)
+
+    _kill_hard(pid)
+    fake.preempt_cluster(record['handle']['cluster_name_on_cloud'])
+    scheduler.maybe_schedule_next(reap_dead_controllers=True)
+
+    final = _wait(
+        lambda: (state.get(job_id)['status']
+                 if state.get(job_id)['status'].is_terminal() else None),
+        timeout=120, desc='terminal status')
+    assert final == state.ManagedJobStatus.SUCCEEDED, state.events(job_id)
+    r = state.get(job_id)
+    assert r['controller_restarts'] >= 1
+    assert r['recovery_count'] >= 1  # cluster was relaunched
+
+
+def test_controller_restart_cap(monkeypatch):
+    """Beyond SKYTPU_CONTROLLER_MAX_RESTARTS the job is declared
+    FAILED_CONTROLLER instead of looping forever."""
+    monkeypatch.setenv('SKYTPU_CONTROLLER_MAX_RESTARTS', '0')
+    task = Task('ha-cap', run='sleep 120')
+    task.set_resources(Resources(accelerators='tpu-v5e-8', cloud='fake'))
+    job_id = jobs.launch(task)
+    pid = _wait_running_with_pid(job_id)
+    cluster = state.get(job_id)['cluster_name']
+
+    _kill_hard(pid)
+    scheduler.maybe_schedule_next(reap_dead_controllers=True)
+
+    final = _wait(
+        lambda: (state.get(job_id)['status']
+                 if state.get(job_id)['status'].is_terminal() else None),
+        timeout=30, desc='terminal status')
+    assert final == state.ManagedJobStatus.FAILED_CONTROLLER
+    # The abandoned cluster is the operator's to reclaim (matches the
+    # reference: FAILED_CONTROLLER leaves resources for inspection).
+    try:
+        core.down(cluster)
+    except Exception:
+        pass
