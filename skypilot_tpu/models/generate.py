@@ -18,7 +18,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from skypilot_tpu.models import llama
+from skypilot_tpu.models import llama, moe
 
 Params = llama.Params
 _NEG_INF = -1e30
@@ -92,10 +92,20 @@ def _cached_layer(cfg: llama.LlamaConfig, x: jax.Array, layer: Params,
     att = _cached_attention(q, k_cache, v_cache, positions, valid)
     x = x + jnp.einsum('bshk,hkd->bsd', att, layer['wo'])
     h = llama.rms_norm(x, layer['mlp_norm'], cfg.norm_eps)
-    gate = jnp.einsum('bsd,df->bsf', h, layer['w_gate'])
-    up = jnp.einsum('bsd,df->bsf', h, layer['w_up'])
-    x = x + jnp.einsum('bsf,fd->bsd', jax.nn.silu(gate) * up,
-                       layer['w_down'])
+    if cfg.num_experts > 0:
+        # MoE decode: same GShard dense-einsum dispatch as training
+        # (models/moe.py) — at S=1 the "token" dim is just the batch, and
+        # the static capacity keeps decode shapes compile-once. The aux
+        # loss is irrelevant at inference.
+        mlp_out, _ = moe.moe_mlp(h, layer['moe'], cfg.num_experts,
+                                 cfg.expert_top_k,
+                                 cfg.expert_capacity_factor)
+        x = x + mlp_out
+    else:
+        gate = jnp.einsum('bsd,df->bsf', h, layer['w_gate'])
+        up = jnp.einsum('bsd,df->bsf', h, layer['w_up'])
+        x = x + jnp.einsum('bsf,fd->bsd', jax.nn.silu(gate) * up,
+                           layer['w_down'])
     return x, k_cache, v_cache
 
 
@@ -104,11 +114,8 @@ def forward_cached(params: Params, tokens: jax.Array,
                    ) -> Tuple[jax.Array, KVCache]:
     """Run ``tokens`` [B, S] through the model appending to ``cache``;
     returns (logits for the LAST position [B, vocab], updated cache).
-    Works for both prefill (S = prompt length) and decode (S = 1)."""
-    if cfg.num_experts > 0:
-        raise NotImplementedError(
-            'Cached generation covers dense models; MoE decode lands with '
-            'the expert-parallel serving path.')
+    Works for both prefill (S = prompt length) and decode (S = 1), dense
+    and MoE models alike."""
     b, s = tokens.shape
     positions = (cache.length
                  + jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s)))

@@ -127,3 +127,40 @@ def test_llm_server_http_roundtrip(tiny):
                            json={'tokens': [[1]], 'max_new_tokens': 1000},
                            timeout=10)
     assert r3.status_code == 400
+
+
+# -- MoE decode (COVERAGE known-gap: cached generation for MoE models) ------
+
+
+@pytest.fixture(scope='module')
+def tiny_moe():
+    import dataclasses
+    # capacity_factor high enough that no token is ever dropped: capacity
+    # depends on the call's token count, so prefill/decode/full-forward
+    # would otherwise be allowed to drop *different* tokens and parity
+    # would be routing-dependent rather than exact.
+    cfg = dataclasses.replace(llama.MOE_TINY, expert_capacity_factor=4.0)
+    params = llama.init_params(jax.random.PRNGKey(7), cfg)
+    return cfg, params
+
+
+def test_moe_cached_prefill_logits_match_forward(tiny_moe):
+    cfg, params = tiny_moe
+    prompt = jax.random.randint(jax.random.PRNGKey(11), (2, 9), 0,
+                                cfg.vocab_size)
+    cache = generate.init_cache(cfg, 2, 32)
+    logits_cached, cache = generate.forward_cached(params, prompt, cache,
+                                                   cfg)
+    logits_full = llama.forward(params, prompt, cfg)[:, -1]
+    np.testing.assert_allclose(np.asarray(logits_cached),
+                               np.asarray(logits_full), atol=2e-2)
+    assert int(cache.length) == 9
+
+
+def test_moe_greedy_generation_matches_full_reforward(tiny_moe):
+    cfg, params = tiny_moe
+    prompt = jax.random.randint(jax.random.PRNGKey(12), (2, 5), 0,
+                                cfg.vocab_size)
+    got = generate.generate(params, cfg, prompt, max_new_tokens=6)
+    want = _naive_greedy(params, cfg, prompt, 6)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
