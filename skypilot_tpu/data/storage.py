@@ -243,30 +243,17 @@ class GcsStore(AbstractStore):
             self.bucket, mount_path, only_dir=self.prefix or None)
 
 
-class S3Store(AbstractStore):
-    """S3 and S3-compatible stores (R2, MinIO) via SigV4-signed REST
-    (reference parity: ``sky/data/storage.py:4502`` S3Store + the
-    S3-compatible registry at ``:128``, without the boto3 dependency).
-
-    Credentials: ``AWS_ACCESS_KEY_ID`` / ``AWS_SECRET_ACCESS_KEY`` /
-    ``AWS_DEFAULT_REGION``; ``AWS_ENDPOINT_URL`` switches to a compatible
-    endpoint (path-style addressing).
-    """
-
-    scheme = 's3'
+class _RestObjectStore(AbstractStore):
+    """Shared plumbing for REST object stores (S3-compatible, Azure Blob):
+    prefix-keyed object naming, recursive upload/download/delete over an
+    injectable HTTP callable, and the stream-capability dispatch. Concrete
+    stores provide ``_request`` (auth + wire format) and the three
+    single-object hooks."""
 
     def __init__(self, bucket: str, prefix: str = '', http=None):
         super().__init__(bucket, prefix)
         self._http = http or self._requests_http
         self._http_supports_stream = None  # resolved on first request
-        self.region = os.environ.get('AWS_DEFAULT_REGION', 'us-east-1')
-        endpoint = os.environ.get('AWS_ENDPOINT_URL')
-        if endpoint:
-            self.host = endpoint.split('://', 1)[-1].rstrip('/')
-            self.base_path = f'/{bucket}'
-        else:
-            self.host = f'{bucket}.s3.{self.region}.amazonaws.com'
-            self.base_path = ''
 
     @staticmethod
     def _requests_http(method, url, headers, data, stream_to=None):
@@ -283,6 +270,111 @@ class S3Store(AbstractStore):
         resp = requests.request(method, url, headers=headers, data=data,
                                 timeout=3600)
         return resp.status_code, resp.content
+
+    def _dispatch_http(self, method: str, url: str, headers: Dict[str, str],
+                      data, stream_to: Optional[str]) -> Tuple[int, bytes]:
+        """Call the injected HTTP, degrading gracefully when it does not
+        support streaming downloads (test fakes)."""
+        if self._http_supports_stream is None:
+            import inspect
+            try:
+                params_ = inspect.signature(self._http).parameters
+                self._http_supports_stream = 'stream_to' in params_
+            except (TypeError, ValueError):
+                self._http_supports_stream = False
+        if self._http_supports_stream:
+            return self._http(method, url, headers, data,
+                              stream_to=stream_to)
+        status, content = self._http(method, url, headers, data)
+        if stream_to is not None and status < 400:
+            with open(stream_to, 'wb') as f:
+                f.write(content)
+            content = b''
+        return status, content
+
+    def _obj(self, rel: str) -> str:
+        key = f'{self.prefix}/{rel}' if self.prefix else rel
+        return key.strip('/')
+
+    # -- single-object hooks (auth + wire format live in the subclass) -----
+
+    def _put_file(self, key: str, fileobj) -> None:
+        raise NotImplementedError
+
+    def _get_to(self, key: str, dst: str) -> int:
+        """Download one object to ``dst``; returns the HTTP status (404
+        allowed)."""
+        raise NotImplementedError
+
+    def _delete_key(self, key: str) -> None:
+        raise NotImplementedError
+
+    # -- recursive operations ----------------------------------------------
+
+    def upload(self, local_path: str, dest_rel: str = '') -> None:
+        local_path = os.path.expanduser(local_path)
+        if os.path.isdir(local_path):
+            for dirpath, _, files in os.walk(local_path):
+                for f in files:
+                    full = os.path.join(dirpath, f)
+                    rel = os.path.relpath(full, local_path)
+                    obj = os.path.join(dest_rel, rel) if dest_rel else rel
+                    with open(full, 'rb') as fh:
+                        self._put_file(self._obj(obj), fh)
+        else:
+            dest = dest_rel or os.path.basename(local_path)
+            with open(local_path, 'rb') as fh:
+                self._put_file(self._obj(dest), fh)
+
+    def download(self, local_path: str, src_rel: str = '') -> None:
+        local_path = os.path.expanduser(local_path)
+        names = _boundary_filter(self.list_objects(src_rel), src_rel)
+        if not names:
+            raise exceptions.StorageBucketGetError(f'{self.url}/{src_rel}')
+        single = len(names) == 1 and names[0] == (src_rel or names[0])
+        for name in names:
+            if single and name == src_rel:
+                dst = local_path
+            else:
+                rel = name[len(src_rel):].lstrip('/') if src_rel else name
+                dst = os.path.join(local_path, rel)
+            os.makedirs(os.path.dirname(dst) or '.', exist_ok=True)
+            if self._get_to(self._obj(name), dst) == 404:
+                raise exceptions.StorageBucketGetError(f'{self.url}/{name}')
+
+    def delete(self) -> None:
+        for name in self.list_objects():
+            self._delete_key(self._obj(name))
+
+    def _strip_prefix(self, names: List[str]) -> List[str]:
+        if self.prefix:
+            names = [n[len(self.prefix) + 1:] for n in names
+                     if n.startswith(self.prefix + '/')]
+        return names
+
+
+class S3Store(_RestObjectStore):
+    """S3 and S3-compatible stores (R2, MinIO) via SigV4-signed REST
+    (reference parity: ``sky/data/storage.py:4502`` S3Store + the
+    S3-compatible registry at ``:128``, without the boto3 dependency).
+
+    Credentials: ``AWS_ACCESS_KEY_ID`` / ``AWS_SECRET_ACCESS_KEY`` /
+    ``AWS_DEFAULT_REGION``; ``AWS_ENDPOINT_URL`` switches to a compatible
+    endpoint (path-style addressing).
+    """
+
+    scheme = 's3'
+
+    def __init__(self, bucket: str, prefix: str = '', http=None):
+        super().__init__(bucket, prefix, http=http)
+        self.region = os.environ.get('AWS_DEFAULT_REGION', 'us-east-1')
+        endpoint = os.environ.get('AWS_ENDPOINT_URL')
+        if endpoint:
+            self.host = endpoint.split('://', 1)[-1].rstrip('/')
+            self.base_path = f'/{bucket}'
+        else:
+            self.host = f'{bucket}.s3.{self.region}.amazonaws.com'
+            self.base_path = ''
 
     def _creds(self) -> Tuple[str, str]:
         ak = os.environ.get('AWS_ACCESS_KEY_ID')
@@ -327,22 +419,8 @@ class S3Store(AbstractStore):
                       for k, v in sorted(params.items()))
         url = (f'https://{self.host}{quote(path, safe="/-_.~")}'
                + (f'?{qs}' if qs else ''))
-        if self._http_supports_stream is None:
-            import inspect
-            try:
-                params_ = inspect.signature(self._http).parameters
-                self._http_supports_stream = 'stream_to' in params_
-            except (TypeError, ValueError):
-                self._http_supports_stream = False
-        if self._http_supports_stream:
-            status, content = self._http(method, url, headers, data,
-                                         stream_to=stream_to)
-        else:  # injected http without stream support (tests)
-            status, content = self._http(method, url, headers, data)
-            if stream_to is not None and status < 400:
-                with open(stream_to, 'wb') as f:
-                    f.write(content)
-                content = b''
+        status, content = self._dispatch_http(method, url, headers, data,
+                                              stream_to)
         if status >= 400 and not (allow_404 and status == 404):
             # A PUT hitting 404 (NoSuchBucket) must NOT look like success —
             # a silently dropped upload is lost checkpoint data.
@@ -376,51 +454,17 @@ class S3Store(AbstractStore):
             if trunc is None or trunc.text != 'true':
                 break
             token = root.find(f'{ns}NextContinuationToken').text
-        if self.prefix:
-            names = [n[len(self.prefix) + 1:] for n in names
-                     if n.startswith(self.prefix + '/')]
-        return sorted(names)
+        return sorted(self._strip_prefix(names))
 
-    def _obj(self, rel: str) -> str:
-        key = f'{self.prefix}/{rel}' if self.prefix else rel
-        return key.strip('/')
+    def _put_file(self, key: str, fileobj) -> None:
+        self._request('PUT', key, data=fileobj)
 
-    def upload(self, local_path: str, dest_rel: str = '') -> None:
-        local_path = os.path.expanduser(local_path)
-        if os.path.isdir(local_path):
-            for dirpath, _, files in os.walk(local_path):
-                for f in files:
-                    full = os.path.join(dirpath, f)
-                    rel = os.path.relpath(full, local_path)
-                    obj = os.path.join(dest_rel, rel) if dest_rel else rel
-                    with open(full, 'rb') as fh:
-                        self._request('PUT', self._obj(obj), data=fh)
-        else:
-            dest = dest_rel or os.path.basename(local_path)
-            with open(local_path, 'rb') as fh:
-                self._request('PUT', self._obj(dest), data=fh)
+    def _get_to(self, key: str, dst: str) -> int:
+        status, _ = self._request('GET', key, allow_404=True, stream_to=dst)
+        return status
 
-    def download(self, local_path: str, src_rel: str = '') -> None:
-        local_path = os.path.expanduser(local_path)
-        names = _boundary_filter(self.list_objects(src_rel), src_rel)
-        if not names:
-            raise exceptions.StorageBucketGetError(f'{self.url}/{src_rel}')
-        single = len(names) == 1 and names[0] == (src_rel or names[0])
-        for name in names:
-            if single and name == src_rel:
-                dst = local_path
-            else:
-                rel = name[len(src_rel):].lstrip('/') if src_rel else name
-                dst = os.path.join(local_path, rel)
-            os.makedirs(os.path.dirname(dst) or '.', exist_ok=True)
-            status, _ = self._request('GET', self._obj(name),
-                                      allow_404=True, stream_to=dst)
-            if status == 404:
-                raise exceptions.StorageBucketGetError(f'{self.url}/{name}')
-
-    def delete(self) -> None:
-        for name in self.list_objects():
-            self._request('DELETE', self._obj(name))
+    def _delete_key(self, key: str) -> None:
+        self._request('DELETE', key)
 
     def mount_command(self, mount_path: str) -> str:
         from skypilot_tpu.data import mounting_utils
@@ -430,7 +474,141 @@ class S3Store(AbstractStore):
                                                    mount_path)
 
 
-_SCHEMES = {'gs': GcsStore, 'file': LocalStore, 's3': S3Store, 'r2': S3Store}
+class AzureBlobStore(_RestObjectStore):
+    """Azure Blob Storage via SharedKey-signed REST (reference parity:
+    ``sky/data/storage.py:2680`` AzureBlobStore, without the azure SDK).
+
+    URI: ``az://container/prefix``. Credentials:
+    ``AZURE_STORAGE_ACCOUNT`` (account name) + ``AZURE_STORAGE_KEY``
+    (base64 SharedKey). Mounting uses rclone's azureblob backend
+    (the reference mounts with blobfuse2 — same role).
+    """
+
+    scheme = 'az'
+    API_VERSION = '2021-08-06'
+
+    def _creds(self) -> Tuple[str, str]:
+        account = os.environ.get('AZURE_STORAGE_ACCOUNT')
+        key = os.environ.get('AZURE_STORAGE_KEY')
+        if not account or not key:
+            raise exceptions.NoCloudAccessError(
+                'Azure credentials not set (AZURE_STORAGE_ACCOUNT / '
+                'AZURE_STORAGE_KEY).')
+        return account, key
+
+    def _sign(self, method: str, account: str, key_b64: str, path: str,
+              params: Dict[str, str], headers: Dict[str, str],
+              content_length: int) -> str:
+        """SharedKey signature (the 2015-02-21+ canonicalization: empty
+        Content-Length when 0)."""
+        import base64
+        import hashlib
+        import hmac
+        ms_headers = ''.join(
+            f'{k.lower()}:{v}\n'
+            for k, v in sorted(headers.items())
+            if k.lower().startswith('x-ms-'))
+        resource = f'/{account}{path}'
+        canon_params = ''.join(
+            f'\n{k.lower()}:{params[k]}'
+            for k in sorted(params, key=str.lower))
+        cl = str(content_length) if content_length else ''
+        to_sign = '\n'.join([
+            method, '', '', cl, '', headers.get('Content-Type', ''), '',
+            '', '', '', '', '',
+        ]) + '\n' + ms_headers + resource + canon_params
+        mac = hmac.new(base64.b64decode(key_b64), to_sign.encode('utf-8'),
+                       hashlib.sha256)
+        return base64.b64encode(mac.digest()).decode()
+
+    def _request(self, method: str, key: str = '',
+                 params: Optional[Dict[str, str]] = None,
+                 data=b'', extra_headers: Optional[Dict[str, str]] = None,
+                 allow_404: bool = False,
+                 stream_to: Optional[str] = None) -> Tuple[int, bytes]:
+        from email.utils import formatdate
+        from urllib.parse import quote
+
+        account, key_b64 = self._creds()
+        host = f'{account}.blob.core.windows.net'
+        path = f'/{self.bucket}' + (f'/{key}' if key else '')
+        params = params or {}
+        if hasattr(data, 'read'):
+            import os as _os
+            content_length = _os.fstat(data.fileno()).st_size
+        else:
+            content_length = len(data)
+        headers = {
+            'x-ms-date': formatdate(usegmt=True),
+            'x-ms-version': self.API_VERSION,
+            **(extra_headers or {}),
+        }
+        sig = self._sign(method, account, key_b64, path, params, headers,
+                         content_length)
+        headers['Authorization'] = f'SharedKey {account}:{sig}'
+        if content_length:
+            headers['Content-Length'] = str(content_length)
+        qs = '&'.join(f'{quote(str(k), safe="-_.~")}='
+                      f'{quote(str(v), safe="-_.~")}'
+                      for k, v in sorted(params.items()))
+        url = (f'https://{host}{quote(path, safe="/-_.~")}'
+               + (f'?{qs}' if qs else ''))
+        status, content = self._dispatch_http(method, url, headers, data,
+                                              stream_to)
+        if status >= 400 and not (allow_404 and status == 404):
+            raise exceptions.StorageError(
+                f'Azure {method} {path}: HTTP {status}: {content[:300]!r}')
+        return status, content
+
+    def exists(self) -> bool:
+        status, _ = self._request(
+            'GET', params={'restype': 'container', 'comp': 'list',
+                           'maxresults': '1'}, allow_404=True)
+        return status < 400
+
+    def list_objects(self, rel: str = '') -> List[str]:
+        import xml.etree.ElementTree as ET
+        names: List[str] = []
+        marker: Optional[str] = None
+        while True:
+            params = {'restype': 'container', 'comp': 'list',
+                      'prefix': self._obj(rel)}
+            if marker:
+                params['marker'] = marker
+            status, content = self._request('GET', params=params,
+                                            allow_404=True)
+            if status == 404:
+                return []
+            root = ET.fromstring(content)
+            for blob in root.iter('Blob'):
+                names.append(blob.find('Name').text)
+            nxt = root.find('NextMarker')
+            marker = nxt.text if nxt is not None else None
+            if not marker:
+                break
+        return sorted(self._strip_prefix(names))
+
+    def _put_file(self, key: str, fileobj) -> None:
+        self._request('PUT', key, data=fileobj,
+                      extra_headers={'x-ms-blob-type': 'BlockBlob'})
+
+    def _get_to(self, key: str, dst: str) -> int:
+        status, _ = self._request('GET', key, allow_404=True, stream_to=dst)
+        return status
+
+    def _delete_key(self, key: str) -> None:
+        self._request('DELETE', key)
+
+    def mount_command(self, mount_path: str) -> str:
+        from skypilot_tpu.data import mounting_utils
+        bucket_path = (f'{self.bucket}/{self.prefix}' if self.prefix
+                       else self.bucket)
+        return mounting_utils.rclone_mount_command('azureblob', bucket_path,
+                                                   mount_path)
+
+
+_SCHEMES = {'gs': GcsStore, 'file': LocalStore, 's3': S3Store,
+            'r2': S3Store, 'az': AzureBlobStore}
 
 
 def parse_source(source: str) -> Tuple[str, str, str]:

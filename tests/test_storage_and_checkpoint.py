@@ -339,3 +339,94 @@ def test_copy_mode_fans_out_to_remote_workers(tmp_path, monkeypatch,
     for i in range(2):
         assert (worker_roots[i] / 'mnt' / 'data' / 'd.txt').read_text() == \
             'data'
+
+
+class FakeAzureHttp:
+    """Emulates enough of the Azure Blob REST surface for AzureBlobStore."""
+
+    def __init__(self):
+        self.objects = {}
+        self.requests = []
+
+    def __call__(self, method, url, headers, data, stream_to=None):
+        from urllib.parse import parse_qs, unquote, urlparse
+        self.requests.append((method, url, headers))
+        assert headers['Authorization'].startswith('SharedKey acct:')
+        assert 'x-ms-date' in headers and 'x-ms-version' in headers
+        if hasattr(data, 'read'):
+            data = data.read()
+        u = urlparse(url)
+        assert u.netloc == 'acct.blob.core.windows.net'
+        qs = {k: v[0] for k, v in parse_qs(u.query).items()}
+        key = unquote(u.path.lstrip('/'))  # container/blob
+        if qs.get('comp') == 'list':
+            prefix = 'ctr/' + qs.get('prefix', '')
+            names = sorted(n[len('ctr/'):]
+                           for n in self.objects if n.startswith(prefix))
+            body = '<EnumerationResults><Blobs>'
+            for n in names:
+                body += f'<Blob><Name>{n}</Name></Blob>'
+            body += '</Blobs></EnumerationResults>'
+            return 200, body.encode()
+        if method == 'PUT':
+            assert headers.get('x-ms-blob-type') == 'BlockBlob'
+            self.objects[key] = data
+            return 201, b''
+        if method == 'GET':
+            if key not in self.objects:
+                return 404, b''
+            if stream_to is not None:
+                with open(stream_to, 'wb') as f:
+                    f.write(self.objects[key])
+                return 200, b''
+            return 200, self.objects[key]
+        if method == 'DELETE':
+            self.objects.pop(key, None)
+            return 202, b''
+        raise AssertionError(f'unhandled {method} {url}')
+
+
+def test_azure_blob_store_roundtrip(tmp_path, monkeypatch):
+    """COVERAGE known-gap #3: Azure Blob store (SharedKey REST, no SDK;
+    reference: sky/data/storage.py:2680 AzureBlobStore)."""
+    import base64
+    monkeypatch.setenv('AZURE_STORAGE_ACCOUNT', 'acct')
+    monkeypatch.setenv('AZURE_STORAGE_KEY',
+                       base64.b64encode(b'secretkey').decode())
+    http = FakeAzureHttp()
+    store = storage_lib.AzureBlobStore('ctr', 'data', http=http)
+    src = tmp_path / 'src'
+    (src / 'sub').mkdir(parents=True)
+    (src / 'a.txt').write_bytes(b'aval')
+    (src / 'sub' / 'b.txt').write_bytes(b'bval')
+    store.upload(str(src))
+    assert store.list_objects() == ['a.txt', 'sub/b.txt']
+    assert http.objects['ctr/data/a.txt'] == b'aval'
+    dst = tmp_path / 'out'
+    store.download(str(dst))
+    assert (dst / 'a.txt').read_bytes() == b'aval'
+    assert (dst / 'sub' / 'b.txt').read_bytes() == b'bval'
+    store.delete()
+    assert store.list_objects() == []
+    # az:// scheme resolves to the Azure store; mount uses rclone azureblob
+    st = storage_lib.Storage(source='az://ctr/pre')
+    assert isinstance(st.store(), storage_lib.AzureBlobStore)
+    assert 'azureblob' in store.mount_command('/mnt/x')
+
+
+def test_azure_shared_key_signature_is_deterministic(monkeypatch):
+    """Pin the canonicalization so a refactor cannot silently break auth."""
+    import base64
+    monkeypatch.setenv('AZURE_STORAGE_ACCOUNT', 'acct')
+    key = base64.b64encode(b'k' * 32).decode()
+    monkeypatch.setenv('AZURE_STORAGE_KEY', key)
+    store = storage_lib.AzureBlobStore('ctr', http=lambda *a, **k: (200, b''))
+    sig = store._sign('GET', 'acct', key, '/ctr',
+                      {'comp': 'list', 'restype': 'container'},
+                      {'x-ms-date': 'Wed, 01 Jan 2025 00:00:00 GMT',
+                       'x-ms-version': '2021-08-06'}, 0)
+    sig2 = store._sign('GET', 'acct', key, '/ctr',
+                       {'restype': 'container', 'comp': 'list'},
+                       {'x-ms-version': '2021-08-06',
+                        'x-ms-date': 'Wed, 01 Jan 2025 00:00:00 GMT'}, 0)
+    assert sig == sig2  # param/header order must not matter
