@@ -245,9 +245,86 @@ def get_cluster_info(region: str, cluster_name_on_cloud: str,
 
 def open_ports(cluster_name_on_cloud: str, ports: List[int],
                provider_config: Optional[Dict[str, Any]] = None) -> None:
-    del cluster_name_on_cloud, ports, provider_config  # services TBD
+    """Expose ports on the head pod via a k8s Service (reference analog:
+    ``sky/provision/kubernetes/network.py`` — per-cluster LoadBalancer /
+    NodePort services for opened ports). One Service per cluster carries
+    every requested port; ``SKYTPU_GKE_SERVICE_TYPE`` picks LoadBalancer
+    (default, external IP on GKE) or NodePort."""
+    if not ports:
+        return
+    client = _client(_ns_of(provider_config))
+    svc_name = f'{cluster_name_on_cloud}-svc'
+    svc_type = os.environ.get('SKYTPU_GKE_SERVICE_TYPE', 'LoadBalancer')
+    ports = sorted({int(p) for p in ports})
+    existing = next(
+        (svc for svc in client.list_services(
+            f'{LABEL_CLUSTER}={cluster_name_on_cloud}')
+         if svc['metadata']['name'] == svc_name), None)
+    if existing is not None:
+        old_ports = existing.get('spec', {}).get('ports', [])
+        have = {int(p['port']) for p in old_ports}
+        union = sorted(have | set(ports))
+        if union == sorted(have):
+            return  # idempotent: every requested port already exposed
+        # New ports requested (e.g. a serve update): PUT-replace the
+        # Service in place — existing ports (and their nodePort
+        # allocations / LB ingress) stay live throughout.
+        by_port = {int(p['port']): p for p in old_ports}
+        new_ports = []
+        for p in union:
+            entry = dict(by_port.get(p, {'name': f'port-{p}', 'port': p,
+                                         'targetPort': p}))
+            new_ports.append(entry)
+        body = dict(existing)
+        body['spec'] = dict(existing['spec'])
+        body['spec']['ports'] = new_ports
+        client.replace_service(svc_name, body)
+        return
+    client.create_service({
+        'apiVersion': 'v1',
+        'kind': 'Service',
+        'metadata': {
+            'name': svc_name,
+            'labels': {LABEL_CLUSTER: cluster_name_on_cloud},
+        },
+        'spec': {
+            'type': svc_type,
+            'selector': {
+                LABEL_CLUSTER: cluster_name_on_cloud,
+                LABEL_NODE: '0',
+                LABEL_WORKER: '0',
+            },
+            'ports': [{'name': f'port-{p}', 'port': int(p),
+                       'targetPort': int(p)} for p in ports],
+        },
+    })
 
 
 def cleanup_ports(cluster_name_on_cloud: str,
                   provider_config: Optional[Dict[str, Any]] = None) -> None:
-    del cluster_name_on_cloud, provider_config
+    client = _client(_ns_of(provider_config))
+    for svc in client.list_services(
+            f'{LABEL_CLUSTER}={cluster_name_on_cloud}'):
+        try:
+            client.delete_service(svc['metadata']['name'])
+        except k8s_lib.K8sApiError:
+            pass
+
+
+def external_endpoint(cluster_name_on_cloud: str, port: int,
+                      provider_config: Optional[Dict[str, Any]] = None
+                      ) -> Optional[str]:
+    """'ip:port' of the cluster's Service, once GKE assigns the
+    LoadBalancer ingress (None while pending)."""
+    client = _client(_ns_of(provider_config))
+    for svc in client.list_services(
+            f'{LABEL_CLUSTER}={cluster_name_on_cloud}'):
+        ingress = (svc.get('status', {}).get('loadBalancer', {})
+                   .get('ingress') or [])
+        if ingress:
+            ip = ingress[0].get('ip') or ingress[0].get('hostname')
+            if ip:
+                return f'{ip}:{port}'
+    # NodePort services have no resolvable address without a node IP
+    # lookup; callers treat None as "not externally reachable yet".
+    return None

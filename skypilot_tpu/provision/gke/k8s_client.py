@@ -117,6 +117,29 @@ class K8sClient:
     def delete_pod(self, name: str) -> Dict[str, Any]:
         return self.transport.request('DELETE', f'{self._pods()}/{name}')
 
+    def _services(self) -> str:
+        return f'/api/v1/namespaces/{self.namespace}/services'
+
+    def create_service(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        return self.transport.request('POST', self._services(), body=body)
+
+    def list_services(self, label_selector: Optional[str] = None
+                      ) -> List[Dict[str, Any]]:
+        params = {'labelSelector': label_selector} if label_selector else None
+        out = self.transport.request('GET', self._services(), params=params)
+        return out.get('items', [])
+
+    def delete_service(self, name: str) -> Dict[str, Any]:
+        return self.transport.request('DELETE', f'{self._services()}/{name}')
+
+    def replace_service(self, name: str, body: Dict[str, Any]
+                        ) -> Dict[str, Any]:
+        """PUT-replace a Service in place (ports can change without the
+        Service ever disappearing; the caller must carry over
+        metadata.resourceVersion and spec.clusterIP from the live object)."""
+        return self.transport.request('PUT', f'{self._services()}/{name}',
+                                      body=body)
+
     def pod_events(self, name: str) -> List[Dict[str, Any]]:
         out = self.transport.request(
             'GET', f'/api/v1/namespaces/{self.namespace}/events',

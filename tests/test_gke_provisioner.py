@@ -19,15 +19,51 @@ class FakeK8sApi:
 
     def __init__(self):
         self.pods = {}  # name -> pod dict
+        self.services = {}  # name -> service dict
         self.schedulable = True
         self.quota_error = False
         self.calls = []
         self._ip = 0
 
+    def _handle_services(self, method, name, body, params):
+        if method == 'POST':
+            svc = dict(body)
+            # GKE assigns the LB ingress asynchronously; the fake grants it
+            # immediately.
+            if svc.get('spec', {}).get('type') == 'LoadBalancer':
+                svc['status'] = {
+                    'loadBalancer': {'ingress': [{'ip': '35.0.0.9'}]}}
+            self.services[svc['metadata']['name']] = svc
+            return svc
+        if method == 'PUT':
+            if name not in self.services:
+                raise k8s_client.K8sApiError(404, 'service not found')
+            svc = dict(body)
+            self.services[name] = svc
+            return svc
+        if method == 'GET' and name is None:
+            sel = (params or {}).get('labelSelector', '')
+            items = list(self.services.values())
+            if sel:
+                k, v = sel.split('=', 1)
+                items = [s for s in items
+                         if s['metadata'].get('labels', {}).get(k) == v]
+            return {'items': items}
+        if method == 'DELETE':
+            self.services.pop(name, None)
+            return {}
+        raise AssertionError(f'unhandled service {method} {name}')
+
     def request(self, method, path, body=None, params=None):
         self.calls.append((method, path))
         if path.endswith('/events'):
             return {'items': []}
+        ms = re.match(
+            r'/api/v1/namespaces/(?P<ns>[^/]+)/services(/(?P<name>.+))?$',
+            path)
+        if ms:
+            return self._handle_services(method, ms.group('name'), body,
+                                         params)
         m = re.match(r'/api/v1/namespaces/(?P<ns>[^/]+)/pods(/(?P<name>.+))?$',
                      path)
         assert m, path
@@ -175,3 +211,37 @@ def test_multislice(fake_k8s):
     info = gke_instance.get_cluster_info('us-west4', 'g-abc')
     assert info.num_nodes == 2
     assert info.num_workers == 8
+
+
+def test_open_ports_creates_head_service(fake_k8s):
+    """COVERAGE known-gap #3: GKE port Services (reference:
+    sky/provision/kubernetes/network.py LoadBalancer services)."""
+    gke_instance.run_instances(_cfg())
+    gke_instance.open_ports('g-abc', [8000, 9000])
+    assert len(fake_k8s.services) == 1
+    svc = fake_k8s.services['g-abc-svc']
+    assert svc['spec']['type'] == 'LoadBalancer'
+    assert svc['spec']['selector'][gke_instance.LABEL_NODE] == '0'
+    assert sorted(p['port'] for p in svc['spec']['ports']) == [8000, 9000]
+    # idempotent
+    gke_instance.open_ports('g-abc', [8000, 9000])
+    assert len(fake_k8s.services) == 1
+    # growing the port set replaces the Service IN PLACE (a PUT, never a
+    # delete) so live ports stay open through the update
+    gke_instance.open_ports('g-abc', [9500])
+    svc = fake_k8s.services['g-abc-svc']
+    assert sorted(p['port'] for p in svc['spec']['ports']) == \
+        [8000, 9000, 9500]
+    assert not any(m == 'DELETE' and 'services' in p
+                   for m, p in fake_k8s.calls)
+    # the LB ingress surfaces as the external endpoint
+    assert gke_instance.external_endpoint('g-abc', 8000) == '35.0.0.9:8000'
+    gke_instance.cleanup_ports('g-abc')
+    assert fake_k8s.services == {}
+
+
+def test_open_ports_nodeport_type(fake_k8s, monkeypatch):
+    monkeypatch.setenv('SKYTPU_GKE_SERVICE_TYPE', 'NodePort')
+    gke_instance.run_instances(_cfg())
+    gke_instance.open_ports('g-abc', [8080])
+    assert fake_k8s.services['g-abc-svc']['spec']['type'] == 'NodePort'
