@@ -190,9 +190,14 @@ def _decoder_layer(cfg: LlamaConfig, x: jax.Array, layer: Params,
     """One decoder block; returns (x, moe_aux_loss)."""
     # Attention block
     h = rms_norm(x, layer['attn_norm'], cfg.norm_eps)
-    q = jnp.einsum('bsd,dhk->bshk', h, layer['wq'])
-    k = jnp.einsum('bsd,dhk->bshk', h, layer['wk'])
-    v = jnp.einsum('bsd,dhk->bshk', h, layer['wv'])
+    # Checkpoint names let remat policies (REMAT_POLICIES) pick precisely
+    # which matmul outputs to keep; under 'full' they are ignored.
+    q = ad_checkpoint.checkpoint_name(
+        jnp.einsum('bsd,dhk->bshk', h, layer['wq']), 'qkv_proj')
+    k = ad_checkpoint.checkpoint_name(
+        jnp.einsum('bsd,dhk->bshk', h, layer['wk']), 'qkv_proj')
+    v = ad_checkpoint.checkpoint_name(
+        jnp.einsum('bsd,dhk->bshk', h, layer['wv']), 'qkv_proj')
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
     # [B, S, H, D] -> [B, H, S, D] for attention
@@ -209,7 +214,8 @@ def _decoder_layer(cfg: LlamaConfig, x: jax.Array, layer: Params,
     # expensive recompute) while rematerializing cheap elementwise/matmul
     # activations.
     att = ad_checkpoint.checkpoint_name(att, 'attn_out')
-    x = x + jnp.einsum('bshk,hkd->bsd', att, layer['wo'])
+    x = x + ad_checkpoint.checkpoint_name(
+        jnp.einsum('bshk,hkd->bsd', att, layer['wo']), 'attn_proj')
     # MLP block: dense SwiGLU or expert-parallel MoE
     h = rms_norm(x, layer['mlp_norm'], cfg.norm_eps)
     if cfg.num_experts > 0:
@@ -220,16 +226,37 @@ def _decoder_layer(cfg: LlamaConfig, x: jax.Array, layer: Params,
     else:
         gate = jnp.einsum('bsd,df->bsf', h, layer['w_gate'])
         up = jnp.einsum('bsd,df->bsf', h, layer['w_up'])
-        mlp_out = jnp.einsum('bsf,fd->bsd', jax.nn.silu(gate) * up,
-                             layer['w_down'])
+        mlp_out = ad_checkpoint.checkpoint_name(
+            jnp.einsum('bsf,fd->bsd', jax.nn.silu(gate) * up,
+                       layer['w_down']), 'mlp_down')
         aux = jnp.zeros((), jnp.float32)
     return x + mlp_out, aux
+
+
+REMAT_POLICIES = {
+    # Recompute everything in the layer during backward (lowest memory).
+    'full': lambda: jax.checkpoint_policies.nothing_saveable,
+    # Keep flash-attention outputs; recompute the (cheap, HBM-light)
+    # elementwise/matmul activations. Wins over 'full' once S is large
+    # enough that re-running the O(S^2) attention forward dominates the
+    # HBM cost of the saved [B, S, H, D] tensor.
+    'attn': lambda: jax.checkpoint_policies.save_only_these_names('attn_out'),
+    # Keep every non-batch matmul output (highest memory, least recompute).
+    'dots': lambda: jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    # Keep every per-layer matmul output EXCEPT the [B, S, d_ff] MLP
+    # hiddens (gate/up — the two largest activations by far): near-'dots'
+    # recompute savings at a fraction of the memory, which is what fits at
+    # long seq where 'dots' OOMs.
+    'heavy': lambda: jax.checkpoint_policies.save_only_these_names(
+        'attn_out', 'qkv_proj', 'attn_proj', 'mlp_down'),
+}
 
 
 def _layer_stack(cfg: LlamaConfig, x: jax.Array, layers: Params,
                  positions: jax.Array, remat: bool,
                  moe_constrain=None,
-                 mesh=None) -> Tuple[jax.Array, jax.Array]:
+                 mesh=None, remat_policy: str = 'full'
+                 ) -> Tuple[jax.Array, jax.Array]:
     """Scan over (a slice of) the layer stack; returns (x, aux_sum)."""
 
     def body(carry, layer):
@@ -239,19 +266,16 @@ def _layer_stack(cfg: LlamaConfig, x: jax.Array, layers: Params,
         return (y, aux + a), None
 
     if remat:
-        # Full remat wins on this chip: saving attention outputs
-        # ('save_only_these_names("attn_out")') was measured slightly slower
-        # than recomputing them (HBM traffic for the saved activations costs
-        # more than the recompute).
-        body = jax.checkpoint(
-            body, policy=jax.checkpoint_policies.nothing_saveable)
+        body = jax.checkpoint(body,
+                              policy=REMAT_POLICIES[remat_policy]())
     (x, aux), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)), layers)
     return x, aux
 
 
 def forward_with_aux(params: Params, tokens: jax.Array, cfg: LlamaConfig,
                      remat: bool = False, mesh=None,
-                     rules=None) -> Tuple[jax.Array, jax.Array]:
+                     rules=None,
+                     remat_policy: str = 'full') -> Tuple[jax.Array, jax.Array]:
     """tokens: [B, S] int32 -> (logits [B, S, vocab] fp32, moe aux loss)."""
     b, s = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
@@ -286,7 +310,8 @@ def forward_with_aux(params: Params, tokens: jax.Array, cfg: LlamaConfig,
 
         def stage_fn(layers, x_mb):
             return _layer_stack(cfg, x_mb, layers, mb_positions, remat,
-                                moe_constrain=moe_constrain, mesh=mesh)
+                                moe_constrain=moe_constrain, mesh=mesh,
+                                remat_policy=remat_policy)
 
         constrain = None
         if mesh is not None and rules is not None:
@@ -302,7 +327,8 @@ def forward_with_aux(params: Params, tokens: jax.Array, cfg: LlamaConfig,
         x = micro_out.reshape(b, s, x.shape[-1])
     else:
         x, aux = _layer_stack(cfg, x, params['layers'], positions, remat,
-                              moe_constrain=moe_constrain, mesh=mesh)
+                              moe_constrain=moe_constrain, mesh=mesh,
+                              remat_policy=remat_policy)
 
     x = rms_norm(x, params['final_norm'], cfg.norm_eps)
     logits = jnp.einsum('bsd,dv->bsv', x, params['lm_head'],
@@ -322,7 +348,9 @@ MOE_AUX_WEIGHT = 0.01
 
 def loss_fn(params: Params, tokens: jax.Array, cfg: LlamaConfig,
             remat: bool = True, mesh=None,
-            rules=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+            rules=None,
+            remat_policy: str = 'full'
+            ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Next-token cross-entropy over tokens[:, 1:] (+ MoE balance loss).
 
     The forward runs on the FULL sequence (length stays 128-aligned so the
@@ -331,7 +359,8 @@ def loss_fn(params: Params, tokens: jax.Array, cfg: LlamaConfig,
     happens at the loss: logits[:, :-1] predict tokens[:, 1:].
     """
     logits, aux = forward_with_aux(params, tokens, cfg, remat=remat,
-                                   mesh=mesh, rules=rules)
+                                   mesh=mesh, rules=rules,
+                                   remat_policy=remat_policy)
     logits = logits[:, :-1]
     targets = tokens[:, 1:]
     logz = jax.scipy.special.logsumexp(logits, axis=-1)

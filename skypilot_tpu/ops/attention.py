@@ -50,7 +50,7 @@ FWD_BLOCK_Q = 256
 FWD_BLOCK_K = 512
 DQ_BLOCK_Q = 256
 DQ_BLOCK_K = 512
-DKV_BLOCK = 256
+DKV_BLOCK = 512
 _MIN_BLOCK = 128
 _NEG_INF = -1e30
 # The dq kernel keeps full K and V ([S, D] each, double-buffered) resident

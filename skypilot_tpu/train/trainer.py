@@ -33,6 +33,17 @@ class TrainerConfig:
     grad_clip_norm: float = 1.0
     optimizer: str = 'adafactor'  # 'adafactor' | 'adamw'
     remat: bool = True
+    # One of models/llama.py REMAT_POLICIES: 'full' (recompute everything,
+    # lowest memory), 'attn' (keep flash-attention outputs), 'heavy' (keep
+    # all matmul outputs except the big MLP hiddens), 'dots' (keep every
+    # matmul output — fastest where it fits; the v5e bench default).
+    remat_policy: str = 'full'
+
+    def __post_init__(self):
+        if self.remat_policy not in llama.REMAT_POLICIES:
+            raise ValueError(
+                f'Unknown remat_policy {self.remat_policy!r}; choose from '
+                f'{sorted(llama.REMAT_POLICIES)}')
 
 
 def make_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
@@ -100,7 +111,8 @@ class Trainer:
 
         def loss(params):
             return llama.loss_fn(params, tokens, cfg.model, remat=cfg.remat,
-                                 mesh=self.mesh, rules=self.rules)
+                                 mesh=self.mesh, rules=self.rules,
+                                 remat_policy=cfg.remat_policy)
 
         (loss_val, metrics), grads = jax.value_and_grad(
             loss, has_aux=True)(state['params'])
