@@ -1,0 +1,109 @@
+"""API-server load test: concurrent request storm through the real server.
+
+Reference analog: ``tests/load_tests/test_load_on_server.py`` — N clients
+hammering the server concurrently; the request executor's worker lanes must
+absorb the burst without dropping, erroring, or wedging the event loop.
+"""
+import concurrent.futures as cf
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+import requests as requests_lib
+
+from skypilot_tpu.client import sdk
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import common_utils
+
+
+@pytest.fixture(scope='module')
+def server(tmp_path_factory):
+    state_dir = str(tmp_path_factory.mktemp('load_state'))
+    port = common_utils.find_free_port(47600)
+    env = dict(os.environ)
+    env['SKYTPU_STATE_DIR'] = state_dir
+    env.pop('JAX_PLATFORMS', None)
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.server.server',
+         '--port', str(port)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    url = f'http://127.0.0.1:{port}'
+    os.environ['SKYTPU_API_SERVER_URL'] = url
+    os.environ['SKYTPU_STATE_DIR'] = state_dir
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            requests_lib.get(f'{url}/health', timeout=2)
+            break
+        except requests_lib.RequestException:
+            time.sleep(0.2)
+    else:
+        proc.kill()
+        raise RuntimeError('server did not come up')
+    yield url
+    proc.terminate()
+    os.environ.pop('SKYTPU_API_SERVER_URL', None)
+    os.environ.pop('SKYTPU_STATE_DIR', None)
+
+
+def test_concurrent_short_request_storm(server):
+    """80 status requests from 8 concurrent clients: all succeed, none
+    slower than a generous per-request bound once the burst drains."""
+    n_clients, per_client = 8, 10
+    latencies = []
+
+    def client(_):
+        out = []
+        for _ in range(per_client):
+            t0 = time.perf_counter()
+            result = sdk.get(sdk.status(), timeout=60)
+            out.append(time.perf_counter() - t0)
+            assert isinstance(result, list)
+        return out
+
+    t0 = time.perf_counter()
+    with cf.ThreadPoolExecutor(max_workers=n_clients) as pool:
+        for lat in pool.map(client, range(n_clients)):
+            latencies.extend(lat)
+    wall = time.perf_counter() - t0
+
+    assert len(latencies) == n_clients * per_client
+    latencies.sort()
+    p50 = latencies[len(latencies) // 2]
+    p95 = latencies[int(len(latencies) * 0.95)]
+    print(f'storm: {len(latencies)} reqs in {wall:.1f}s '
+          f'p50={p50:.2f}s p95={p95:.2f}s')
+    # Generous bounds: the point is no wedge/timeout collapse, not speed.
+    assert p95 < 30.0
+    # The server is still healthy after the storm.
+    assert sdk.api_info()['status'] == 'healthy'
+
+
+def test_concurrent_launches_do_not_collide(server):
+    """4 concurrent launches on distinct local clusters: every one
+    provisions, runs, and reports SUCCEEDED; no cross-talk between the
+    per-request worker processes."""
+    from skypilot_tpu.resources import Resources
+
+    def launch_one(i):
+        task = Task(f'load{i}', run=f'echo load-{i}-ok')
+        task.set_resources(Resources(cloud='local'))
+        rid = sdk.launch(task, cluster_name=f'load{i}')
+        result = sdk.get(rid, timeout=120)
+        assert result['handle']['cluster_name'] == f'load{i}'
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            s = sdk.get(sdk.job_status(f'load{i}', result['job_id']),
+                        timeout=60)
+            if s in ('SUCCEEDED', 'FAILED', 'FAILED_SETUP'):
+                return s
+            time.sleep(0.4)
+        return 'TIMEOUT'
+
+    with cf.ThreadPoolExecutor(max_workers=4) as pool:
+        results = list(pool.map(launch_one, range(4)))
+    assert results == ['SUCCEEDED'] * 4
+    for i in range(4):
+        sdk.get(sdk.down(f'load{i}'), timeout=60)
