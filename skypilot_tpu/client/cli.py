@@ -149,15 +149,20 @@ def exec_cmd(cluster, entrypoint, detach_run, name, workdir, cloud,
 
 @cli.command()
 @click.option('--refresh', '-r', is_flag=True, default=False)
+@click.option('--all-workspaces', '-a', is_flag=True, default=False,
+              help='Show clusters from every workspace.')
 @_clean_errors
-def status(refresh):
-    """Show clusters."""
+def status(refresh, all_workspaces):
+    """Show clusters (active workspace unless --all-workspaces)."""
     from skypilot_tpu import core
-    rows = core.status(refresh=refresh)
-    _echo_table(rows, [('name', 'NAME'), ('status', 'STATUS'),
-                       ('cloud', 'CLOUD'), ('region', 'REGION'),
-                       ('resources', 'RESOURCES'), ('nodes', 'NODES'),
-                       ('workers', 'WORKERS'), ('autostop', 'AUTOSTOP')])
+    rows = core.status(refresh=refresh, all_workspaces=all_workspaces)
+    cols = [('name', 'NAME'), ('status', 'STATUS'),
+            ('cloud', 'CLOUD'), ('region', 'REGION'),
+            ('resources', 'RESOURCES'), ('nodes', 'NODES'),
+            ('workers', 'WORKERS'), ('autostop', 'AUTOSTOP')]
+    if all_workspaces:
+        cols.insert(1, ('workspace', 'WORKSPACE'))
+    _echo_table(rows, cols)
 
 
 @cli.command()
@@ -305,13 +310,17 @@ def jobs_launch(entrypoint, recovery, max_restarts_on_errors, name, workdir,
 
 
 @jobs_group.command('queue')
+@click.option('--all-workspaces', '-a', is_flag=True, default=False,
+              help='Show managed jobs from every workspace.')
 @_clean_errors
-def jobs_queue():
-    """List managed jobs."""
+def jobs_queue(all_workspaces):
+    """List managed jobs (active workspace unless --all-workspaces)."""
     from skypilot_tpu import jobs
-    _echo_table(jobs.queue(),
-                [('job_id', 'ID'), ('name', 'NAME'), ('status', 'STATUS'),
-                 ('cluster', 'CLUSTER'), ('recoveries', 'RECOVERIES')])
+    cols = [('job_id', 'ID'), ('name', 'NAME'), ('status', 'STATUS'),
+            ('cluster', 'CLUSTER'), ('recoveries', 'RECOVERIES')]
+    if all_workspaces:
+        cols.insert(1, ('workspace', 'WORKSPACE'))
+    _echo_table(jobs.queue(all_workspaces=all_workspaces), cols)
 
 
 @jobs_group.command('cancel')
@@ -484,6 +493,46 @@ def users_rm(name):
     from skypilot_tpu import users as users_lib
     users_lib.remove_user(name)
     click.echo(f'Removed user {name}.')
+
+
+@cli.group('workspaces')
+def workspaces_group():
+    """Workspace management (reference: `sky/workspaces` grouping)."""
+
+
+@workspaces_group.command('ls')
+def workspaces_ls():
+    from skypilot_tpu import workspaces as workspaces_lib
+    for w in workspaces_lib.list_workspaces():
+        marker = '*' if w['active'] else ' '
+        click.echo(f'{marker} {w["name"]:24s} clusters={w["clusters"]}')
+
+
+@workspaces_group.command('create')
+@click.argument('name')
+@_clean_errors
+def workspaces_create(name):
+    from skypilot_tpu import workspaces as workspaces_lib
+    workspaces_lib.create(name)
+    click.echo(f'Created workspace {name}.')
+
+
+@workspaces_group.command('switch')
+@click.argument('name')
+@_clean_errors
+def workspaces_switch(name):
+    from skypilot_tpu import workspaces as workspaces_lib
+    workspaces_lib.switch(name)
+    click.echo(f'Active workspace: {name}.')
+
+
+@workspaces_group.command('rm')
+@click.argument('name')
+@_clean_errors
+def workspaces_rm(name):
+    from skypilot_tpu import workspaces as workspaces_lib
+    workspaces_lib.delete(name)
+    click.echo(f'Removed workspace {name}.')
 
 
 if __name__ == '__main__':

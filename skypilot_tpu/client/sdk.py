@@ -68,7 +68,13 @@ def ensure_server(timeout: float = 20.0) -> None:
     raise exceptions.ApiServerConnectionError(url, 'auto-start timed out')
 
 
+def _workspace() -> str:
+    from skypilot_tpu import workspaces as workspaces_lib
+    return workspaces_lib.active_workspace()
+
+
 def _post(path: str, payload: Dict[str, Any]) -> str:
+    payload = {**payload, '_workspace': _workspace()}
     r = requests_lib.post(f'{server_url()}/api/v1/{path}', json=payload,
                           timeout=30, headers=_headers())
     body = r.json()
@@ -78,6 +84,7 @@ def _post(path: str, payload: Dict[str, Any]) -> str:
 
 
 def _get(path: str, params: Dict[str, Any]) -> str:
+    params = {**params, '_workspace': _workspace()}
     r = requests_lib.get(f'{server_url()}/api/v1/{path}', params=params,
                          timeout=30, headers=_headers())
     body = r.json()
@@ -146,8 +153,9 @@ def exec_(task: Task, cluster_name: str) -> str:
                           'cluster_name': cluster_name})
 
 
-def status(refresh: bool = False) -> str:
-    return _get('status', {'refresh': '1' if refresh else '0'})
+def status(refresh: bool = False, all_workspaces: bool = False) -> str:
+    return _get('status', {'refresh': '1' if refresh else '0',
+                           'all_workspaces': '1' if all_workspaces else '0'})
 
 
 def queue(cluster_name: str) -> str:
@@ -202,8 +210,9 @@ def jobs_launch(task: Task, recovery_strategy: str = 'FAILOVER',
     })
 
 
-def jobs_queue() -> str:
-    return _get('jobs/queue', {})
+def jobs_queue(all_workspaces: bool = False) -> str:
+    return _get('jobs/queue',
+                {'all_workspaces': '1' if all_workspaces else '0'})
 
 
 def jobs_cancel(job_id: int) -> str:

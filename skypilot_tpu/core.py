@@ -28,10 +28,15 @@ def _get_handle(cluster_name: str) -> ClusterHandle:
 
 
 def status(cluster_names: Optional[List[str]] = None,
-           refresh: bool = False) -> List[Dict[str, Any]]:
-    """Cluster table (reference ``core.status :99``)."""
+           refresh: bool = False,
+           all_workspaces: bool = False) -> List[Dict[str, Any]]:
+    """Cluster table (reference ``core.status :99``), filtered to the
+    active workspace unless ``all_workspaces`` (or explicit names)."""
+    from skypilot_tpu import workspaces as workspaces_lib
     backend = TpuGangBackend()
-    records = global_user_state.get_clusters()
+    workspace = (None if all_workspaces or cluster_names
+                 else workspaces_lib.active_workspace())
+    records = global_user_state.get_clusters(workspace=workspace)
     if cluster_names:
         records = [r for r in records if r['name'] in cluster_names]
     out = []
@@ -46,6 +51,7 @@ def status(cluster_names: Optional[List[str]] = None,
             handle['launched_resources']) if handle else None
         out.append({
             'name': r['name'],
+            'workspace': r.get('workspace', 'default'),
             'status': r['status'].value if hasattr(r['status'], 'value')
                       else r['status'],
             'launched_at': r['launched_at'],

@@ -66,6 +66,11 @@ CREATE TABLE IF NOT EXISTS volumes (
     attached_to TEXT,
     backing TEXT
 );
+CREATE TABLE IF NOT EXISTS workspaces (
+    name TEXT PRIMARY KEY,
+    created_at REAL,
+    created_by TEXT
+);
 """
 
 
@@ -110,6 +115,11 @@ def _conn() -> sqlite3.Connection:
     conn = sqlite3.connect(_db_path(), timeout=10)
     conn.row_factory = sqlite3.Row
     conn.executescript(_SCHEMA)
+    try:  # migration for pre-workspace databases
+        conn.execute("ALTER TABLE clusters ADD COLUMN workspace "
+                     "TEXT DEFAULT 'default'")
+    except sqlite3.OperationalError:
+        pass  # already present
     return conn
 
 
@@ -137,10 +147,13 @@ def add_or_update_cluster(name: str, handle: Dict[str, Any],
             args.append(name)
             conn.execute(f'UPDATE clusters SET {sets} WHERE name = ?', args)
         else:
+            from skypilot_tpu import workspaces as workspaces_lib
             conn.execute(
                 'INSERT INTO clusters (name, launched_at, handle, status, '
-                'last_activity, owner) VALUES (?, ?, ?, ?, ?, ?)',
-                (name, now, json.dumps(handle), status.value, now, owner))
+                'last_activity, owner, workspace) '
+                'VALUES (?, ?, ?, ?, ?, ?, ?)',
+                (name, now, json.dumps(handle), status.value, now, owner,
+                 workspaces_lib.active_workspace()))
 
 
 def set_cluster_owner(name: str, owner: str) -> None:
@@ -187,10 +200,16 @@ def get_cluster(name: str) -> Optional[Dict[str, Any]]:
         return d
 
 
-def get_clusters() -> List[Dict[str, Any]]:
+def get_clusters(workspace: Optional[str] = None) -> List[Dict[str, Any]]:
+    """All clusters, optionally filtered to one workspace."""
     with _conn() as conn:
-        rows = conn.execute(
-            'SELECT * FROM clusters ORDER BY launched_at DESC').fetchall()
+        if workspace is None:
+            rows = conn.execute(
+                'SELECT * FROM clusters ORDER BY launched_at DESC').fetchall()
+        else:
+            rows = conn.execute(
+                'SELECT * FROM clusters WHERE workspace = ? '
+                'ORDER BY launched_at DESC', (workspace,)).fetchall()
     out = []
     for row in rows:
         d = dict(row)

@@ -37,13 +37,18 @@ def launch(task: Task, name: Optional[str] = None,
     return job_id
 
 
-def queue(limit: int = 200) -> List[Dict[str, Any]]:
-    rows = state.list_jobs(limit)
+def queue(limit: int = 200,
+          all_workspaces: bool = False) -> List[Dict[str, Any]]:
+    from skypilot_tpu import workspaces as workspaces_lib
+    workspace = (None if all_workspaces
+                 else workspaces_lib.active_workspace())
+    rows = state.list_jobs(limit, workspace=workspace)
     return [{
         'job_id': r['job_id'],
         'name': r['name'],
         'status': r['status'].value,
         'cluster': r['cluster_name'],
+        'workspace': r.get('workspace', 'default'),
         'recoveries': r['recovery_count'],
         'submitted_at': r['submitted_at'],
     } for r in rows]

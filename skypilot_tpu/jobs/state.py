@@ -99,7 +99,9 @@ def _conn() -> sqlite3.Connection:
                 "TEXT DEFAULT 'WAITING'",
                 'ALTER TABLE managed_jobs ADD COLUMN schedule_state_at REAL',
                 'ALTER TABLE managed_jobs ADD COLUMN controller_restarts '
-                'INTEGER DEFAULT 0'):
+                'INTEGER DEFAULT 0',
+                "ALTER TABLE managed_jobs ADD COLUMN workspace "
+                "TEXT DEFAULT 'default'"):
         try:
             conn.execute(ddl)
         except sqlite3.OperationalError:
@@ -114,13 +116,15 @@ def _lock() -> filelock.FileLock:
 def submit(name: Optional[str], task_config: Dict[str, Any],
            recovery_strategy: str = 'FAILOVER',
            max_restarts_on_errors: int = 0) -> int:
+    from skypilot_tpu import workspaces as workspaces_lib
     with _lock(), _conn() as conn:
         cur = conn.execute(
             'INSERT INTO managed_jobs (name, task_config, status, '
-            'recovery_strategy, max_restarts_on_errors, submitted_at) '
-            'VALUES (?, ?, ?, ?, ?, ?)',
+            'recovery_strategy, max_restarts_on_errors, submitted_at, '
+            'workspace) VALUES (?, ?, ?, ?, ?, ?, ?)',
             (name, json.dumps(task_config), ManagedJobStatus.PENDING.value,
-             recovery_strategy, max_restarts_on_errors, time.time()))
+             recovery_strategy, max_restarts_on_errors, time.time(),
+             workspaces_lib.active_workspace()))
         return int(cur.lastrowid)
 
 
@@ -209,10 +213,20 @@ def get(job_id: int) -> Optional[Dict[str, Any]]:
         return d
 
 
-def list_jobs(limit: int = 200) -> List[Dict[str, Any]]:
+def list_jobs(limit: int = 200,
+              workspace: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Newest-first managed jobs; the workspace predicate runs IN the SQL
+    so LIMIT applies after filtering (a busy neighbor workspace must not
+    push this one's jobs past the limit)."""
     with _conn() as conn:
-        rows = conn.execute('SELECT * FROM managed_jobs ORDER BY job_id DESC '
-                            'LIMIT ?', (limit,)).fetchall()
+        if workspace is None:
+            rows = conn.execute(
+                'SELECT * FROM managed_jobs ORDER BY job_id DESC '
+                'LIMIT ?', (limit,)).fetchall()
+        else:
+            rows = conn.execute(
+                'SELECT * FROM managed_jobs WHERE workspace = ? '
+                'ORDER BY job_id DESC LIMIT ?', (workspace, limit)).fetchall()
     out = []
     for row in rows:
         d = dict(row)

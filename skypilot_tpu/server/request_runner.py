@@ -51,7 +51,9 @@ def _run_op(payload: Dict[str, Any]) -> Any:
         return {'job_id': job_id, 'handle': handle.to_dict()}
     if op == 'status':
         from skypilot_tpu import core
-        return core.status(refresh=payload.get('refresh', False))
+        return core.status(refresh=payload.get('refresh', False),
+                           all_workspaces=payload.get('all_workspaces',
+                                                      False))
     if op == 'queue':
         from skypilot_tpu import core
         return core.queue(payload['cluster_name'])
@@ -102,7 +104,8 @@ def _run_op(payload: Dict[str, Any]) -> Any:
             max_restarts_on_errors=payload.get('max_restarts_on_errors', 0))
     if op == 'jobs_queue':
         from skypilot_tpu import jobs
-        return jobs.queue()
+        return jobs.queue(
+            all_workspaces=payload.get('all_workspaces', False))
     if op == 'jobs_cancel':
         from skypilot_tpu import jobs
         return jobs.cancel(payload['job_id'])
@@ -118,6 +121,12 @@ def main() -> None:
     if record['status'].is_terminal():  # cancelled before start
         return
     requests_db.set_running(args.request_id, os.getpid())
+    # The client's active workspace rides the payload; exporting it makes
+    # every stamping/filtering call in this op (global_user_state,
+    # jobs.state) see the caller's workspace, not the server host's.
+    workspace = record['payload'].get('_workspace')
+    if workspace:
+        os.environ['SKYTPU_WORKSPACE'] = workspace
     try:
         result = _run_op(record['payload'])
         requests_db.finish(args.request_id, result=result)

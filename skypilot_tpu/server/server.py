@@ -79,6 +79,9 @@ def _make_get(op: str):
         payload = dict(request.query)
         if 'refresh' in payload:
             payload['refresh'] = payload['refresh'] in ('1', 'true', 'True')
+        if 'all_workspaces' in payload:
+            payload['all_workspaces'] = payload['all_workspaces'] in (
+                '1', 'true', 'True')
         if 'job_id' in payload and payload['job_id']:
             payload['job_id'] = int(payload['job_id'])
         return _schedule_response(op, payload, request)

@@ -1,0 +1,130 @@
+"""Workspaces: named groupings of clusters and managed jobs.
+
+Reference analog: ``sky/workspaces/`` — multi-tenant resource grouping so
+teams share one API server without seeing each other's resources by
+default. Compact TPU-native form:
+
+* a workspaces registry (SQLite, ``global_user_state`` DB);
+* every cluster and managed job is stamped with the workspace active at
+  creation; ``status``/``jobs queue`` filter to the active workspace
+  unless asked for all;
+* the active workspace resolves ``SKYTPU_WORKSPACE`` env > the
+  ``workspace.active`` file under the state dir (written by
+  ``stpu workspaces switch``) > ``default``.
+
+Workspaces are a GROUPING concept here, not a security boundary — access
+control stays with users/RBAC ownership checks (``skypilot_tpu/users``),
+matching the reference's split.
+"""
+from __future__ import annotations
+
+import os
+import re
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+
+DEFAULT_WORKSPACE = 'default'
+_NAME_RE = re.compile(r'^[a-z0-9][a-z0-9-]{0,62}$')
+
+
+def _active_file() -> str:
+    d = os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, 'workspace.active')
+
+
+def active_workspace() -> str:
+    env = os.environ.get('SKYTPU_WORKSPACE')
+    if env:
+        return env
+    try:
+        with open(_active_file(), encoding='utf-8') as f:
+            name = f.read().strip()
+            return name or DEFAULT_WORKSPACE
+    except OSError:
+        return DEFAULT_WORKSPACE
+
+
+def switch(name: str) -> None:
+    """Persist the active workspace for this client (env still wins)."""
+    if name != DEFAULT_WORKSPACE and get(name) is None:
+        raise exceptions.SkyTpuError(
+            f'Workspace {name!r} does not exist; create it first '
+            f'(`stpu workspaces create {name}`).')
+    with open(_active_file(), 'w', encoding='utf-8') as f:
+        f.write(name + '\n')
+
+
+def create(name: str, created_by: Optional[str] = None) -> None:
+    if not _NAME_RE.match(name):
+        raise exceptions.SkyTpuError(
+            f'Invalid workspace name {name!r} (lowercase alphanumeric + '
+            'dashes, <=63 chars).')
+    from skypilot_tpu import global_user_state as gus
+    with gus._lock(), gus._conn() as conn:  # pylint: disable=protected-access
+        existing = conn.execute(
+            'SELECT name FROM workspaces WHERE name = ?', (name,)).fetchone()
+        if existing:
+            raise exceptions.SkyTpuError(f'Workspace {name!r} exists.')
+        conn.execute(
+            'INSERT INTO workspaces (name, created_at, created_by) '
+            'VALUES (?, ?, ?)', (name, time.time(), created_by))
+
+
+def get(name: str) -> Optional[Dict[str, Any]]:
+    if name == DEFAULT_WORKSPACE:
+        return {'name': DEFAULT_WORKSPACE, 'created_at': None,
+                'created_by': None}
+    from skypilot_tpu import global_user_state as gus
+    with gus._conn() as conn:  # pylint: disable=protected-access
+        row = conn.execute('SELECT * FROM workspaces WHERE name = ?',
+                           (name,)).fetchone()
+        return dict(row) if row else None
+
+
+def delete(name: str) -> None:
+    """Remove an EMPTY workspace (live clusters/jobs must go first)."""
+    if name == DEFAULT_WORKSPACE:
+        raise exceptions.SkyTpuError(
+            'The default workspace cannot be deleted.')
+    from skypilot_tpu import global_user_state as gus
+    clusters = gus.get_clusters(workspace=name)
+    if clusters:
+        raise exceptions.SkyTpuError(
+            f'Workspace {name!r} still has {len(clusters)} cluster(s): '
+            f'{[c["name"] for c in clusters]}. Down them first.')
+    from skypilot_tpu.jobs import state as jobs_state
+    live = [j for j in jobs_state.list_jobs(100000)
+            if j.get('workspace') == name and not j['status'].is_terminal()]
+    if live:
+        raise exceptions.SkyTpuError(
+            f'Workspace {name!r} still has {len(live)} live managed '
+            'job(s). Cancel them first.')
+    with gus._lock(), gus._conn() as conn:  # pylint: disable=protected-access
+        conn.execute('DELETE FROM workspaces WHERE name = ?', (name,))
+    if active_workspace() == name:
+        switch(DEFAULT_WORKSPACE)
+
+
+def list_workspaces() -> List[Dict[str, Any]]:
+    """All workspaces with live-resource counts."""
+    from skypilot_tpu import global_user_state as gus
+    with gus._conn() as conn:  # pylint: disable=protected-access
+        rows = [dict(r) for r in conn.execute(
+            'SELECT * FROM workspaces ORDER BY created_at').fetchall()]
+    names = [DEFAULT_WORKSPACE] + [r['name'] for r in rows]
+    by_name = {r['name']: r for r in rows}
+    active = active_workspace()
+    out = []
+    for name in names:
+        clusters = gus.get_clusters(workspace=name)
+        out.append({
+            'name': name,
+            'active': name == active,
+            'clusters': len(clusters),
+            'created_by': by_name.get(name, {}).get('created_by'),
+        })
+    return out

@@ -1,0 +1,115 @@
+"""Workspace grouping tests (reference: ``sky/workspaces``)."""
+import pytest
+
+from skypilot_tpu import exceptions, global_user_state, workspaces
+from skypilot_tpu.jobs import state as jobs_state
+
+
+def test_lifecycle_and_active_resolution(tmp_state_dir, monkeypatch):
+    monkeypatch.delenv('SKYTPU_WORKSPACE', raising=False)
+    assert workspaces.active_workspace() == 'default'
+    workspaces.create('team-a')
+    names = [w['name'] for w in workspaces.list_workspaces()]
+    assert names == ['default', 'team-a']
+
+    workspaces.switch('team-a')
+    assert workspaces.active_workspace() == 'team-a'
+    # env beats the persisted file
+    monkeypatch.setenv('SKYTPU_WORKSPACE', 'default')
+    assert workspaces.active_workspace() == 'default'
+    monkeypatch.delenv('SKYTPU_WORKSPACE')
+    assert workspaces.active_workspace() == 'team-a'
+
+    # deleting the active workspace falls back to default
+    workspaces.delete('team-a')
+    assert workspaces.active_workspace() == 'default'
+    assert [w['name'] for w in workspaces.list_workspaces()] == ['default']
+
+
+def test_validation(tmp_state_dir):
+    with pytest.raises(exceptions.SkyTpuError):
+        workspaces.create('Bad_Name!')
+    with pytest.raises(exceptions.SkyTpuError):
+        workspaces.delete('default')
+    with pytest.raises(exceptions.SkyTpuError):
+        workspaces.switch('ghost')  # must exist before switching
+    workspaces.create('dup')
+    with pytest.raises(exceptions.SkyTpuError):
+        workspaces.create('dup')
+
+
+def test_cluster_stamping_and_status_filter(enable_fake_cloud, monkeypatch):
+    from skypilot_tpu import core, execution
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
+
+    workspaces.create('team-b')
+
+    def launch(cluster, ws):
+        monkeypatch.setenv('SKYTPU_WORKSPACE', ws)
+        t = Task(f't-{cluster}', run='echo hi')
+        t.set_resources(Resources(cloud='fake'))
+        execution.launch(t, cluster_name=cluster, detach_run=True)
+
+    launch('c-def', 'default')
+    launch('c-team', 'team-b')
+
+    monkeypatch.setenv('SKYTPU_WORKSPACE', 'default')
+    assert [r['name'] for r in core.status()] == ['c-def']
+    monkeypatch.setenv('SKYTPU_WORKSPACE', 'team-b')
+    assert [r['name'] for r in core.status()] == ['c-team']
+    both = core.status(all_workspaces=True)
+    assert {(r['name'], r['workspace']) for r in both} == {
+        ('c-def', 'default'), ('c-team', 'team-b')}
+    # Named access crosses workspaces (grouping, not a security boundary).
+    assert [r['name'] for r in core.status(cluster_names=['c-def'])] == \
+        ['c-def']
+    # Workspace with live clusters refuses deletion.
+    with pytest.raises(exceptions.SkyTpuError):
+        workspaces.delete('team-b')
+    core.down('c-def')
+    core.down('c-team')
+    workspaces.delete('team-b')
+
+
+def test_managed_job_stamping_and_queue_filter(tmp_state_dir, monkeypatch):
+    from skypilot_tpu import jobs
+
+    workspaces.create('team-c')
+    monkeypatch.setenv('SKYTPU_WORKSPACE', 'team-c')
+    jid_team = jobs_state.submit('in-team', {'name': 'x'})
+    monkeypatch.setenv('SKYTPU_WORKSPACE', 'default')
+    jid_def = jobs_state.submit('in-default', {'name': 'y'})
+
+    assert [j['job_id'] for j in jobs.queue()] == [jid_def]
+    monkeypatch.setenv('SKYTPU_WORKSPACE', 'team-c')
+    assert [j['job_id'] for j in jobs.queue()] == [jid_team]
+    allq = jobs.queue(all_workspaces=True)
+    assert {(j['job_id'], j['workspace']) for j in allq} == {
+        (jid_def, 'default'), (jid_team, 'team-c')}
+
+
+def test_cluster_table_migration_defaults_to_default_ws(tmp_state_dir):
+    """Pre-workspace rows (no workspace column value) surface as
+    'default'."""
+    global_user_state.add_or_update_cluster(
+        'legacy', {'h': 1}, global_user_state.ClusterStatus.UP,
+        is_launch=True)
+    rows = global_user_state.get_clusters(workspace='default')
+    assert [r['name'] for r in rows] == ['legacy']
+
+
+def test_queue_limit_applies_after_workspace_filter(tmp_state_dir,
+                                                    monkeypatch):
+    """A busy neighbor workspace must not push this one's jobs past the
+    SQL LIMIT (the workspace predicate runs in the query)."""
+    from skypilot_tpu import jobs
+
+    workspaces.create('quiet')
+    monkeypatch.setenv('SKYTPU_WORKSPACE', 'quiet')
+    mine = jobs_state.submit('mine', {'name': 'm'})
+    monkeypatch.setenv('SKYTPU_WORKSPACE', 'default')
+    for i in range(30):
+        jobs_state.submit(f'noise{i}', {'name': 'n'})
+    monkeypatch.setenv('SKYTPU_WORKSPACE', 'quiet')
+    assert [j['job_id'] for j in jobs.queue(limit=10)] == [mine]
