@@ -430,3 +430,40 @@ def test_azure_shared_key_signature_is_deterministic(monkeypatch):
                        {'x-ms-version': '2021-08-06',
                         'x-ms-date': 'Wed, 01 Jan 2025 00:00:00 GMT'}, 0)
     assert sig == sig2  # param/header order must not matter
+
+
+def test_cross_cloud_transfer_gcs_to_s3(tmp_path, monkeypatch):
+    """reference sky/data/data_transfer.py: bucket copy across providers,
+    here gs:// -> s3:// over fake transports."""
+    from skypilot_tpu.data import data_transfer
+
+    monkeypatch.setenv('AWS_ACCESS_KEY_ID', 'AKID')
+    monkeypatch.setenv('AWS_SECRET_ACCESS_KEY', 'SECRET')
+    monkeypatch.delenv('AWS_ENDPOINT_URL', raising=False)
+
+    gcs_transport = FakeGcsTransport()
+    s3_http = FakeS3Http()
+    src = storage_lib.GcsStore('srcbkt', 'ck', transport=gcs_transport)
+    dst = storage_lib.S3Store('dstbkt', 'mirror', http=s3_http)
+    seed = tmp_path / 'seed'
+    (seed / 'deep').mkdir(parents=True)
+    (seed / 'a.bin').write_bytes(b'alpha')
+    (seed / 'deep' / 'b.bin').write_bytes(b'bravo')
+    src.upload(str(seed))
+
+    stores = {'gs': lambda b, p: storage_lib.GcsStore(
+                  b, p, transport=gcs_transport),
+              's3': lambda b, p: storage_lib.S3Store(b, p, http=s3_http)}
+
+    def fake_store(self):
+        scheme, bucket, prefix = storage_lib.parse_source(self.source)
+        return stores[scheme](bucket, prefix)
+
+    monkeypatch.setattr(storage_lib.Storage, 'store', fake_store)
+    n = data_transfer.transfer('gs://srcbkt/ck', 's3://dstbkt/mirror')
+    assert n == 2
+    # virtual-host addressing: the bucket is in the hostname, keys are
+    # path-only
+    assert s3_http.objects['mirror/a.bin'] == b'alpha'
+    assert s3_http.objects['mirror/deep/b.bin'] == b'bravo'
+    assert dst.list_objects() == ['a.bin', 'deep/b.bin']
