@@ -4,7 +4,8 @@ from skypilot_tpu.clouds.fake import Fake
 from skypilot_tpu.clouds.gcp import GCP
 from skypilot_tpu.clouds.gke import GKE
 from skypilot_tpu.clouds.local import Local
+from skypilot_tpu.clouds.slurm import Slurm
 from skypilot_tpu.clouds.ssh import Ssh
 
 __all__ = ['Cloud', 'CloudImplementationFeatures', 'Region', 'GCP', 'GKE',
-           'Local', 'Fake', 'Ssh']
+           'Local', 'Fake', 'Ssh', 'Slurm']

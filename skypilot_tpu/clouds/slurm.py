@@ -1,0 +1,83 @@
+"""Slurm cloud: an existing Slurm cluster as a provider.
+
+Reference analog: ``sky/clouds/slurm.py`` (``uses_ray()=False``,
+``slurm.py:77``) — the proof in the reference that the backend tolerates
+non-Ray execution, which is this framework's PRIMARY mode. Partitions play
+the role of regions; allocations are free at the framework's accounting
+level (the site owns billing); stop is meaningless (scancel = down).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+Features = cloud_lib.CloudImplementationFeatures
+
+
+@CLOUD_REGISTRY.register
+class Slurm(cloud_lib.Cloud):
+
+    _REPR = 'slurm'
+
+    @classmethod
+    def supported_features(cls) -> set:
+        return {Features.MULTI_NODE, Features.STORAGE_MOUNTING}
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu import exceptions
+        from skypilot_tpu.provision.slurm import instance as slurm_instance
+        try:
+            cfg = slurm_instance.load_config()
+        except exceptions.SkyTpuError as e:
+            return False, str(e)
+        if cfg is None:
+            return False, (f'No Slurm config. Declare the login node in '
+                           f'{slurm_instance.config_path()}.')
+        return True, None
+
+    def _partitions(self) -> List[str]:
+        from skypilot_tpu.provision.slurm import instance as slurm_instance
+        cfg = slurm_instance.load_config() or {}
+        return list(cfg.get('partitions') or ['default'])
+
+    def regions(self) -> List[cloud_lib.Region]:
+        return [cloud_lib.Region(name=p) for p in self._partitions()]
+
+    def zones_for(self, resources: Resources) -> Iterator[Tuple[str, str]]:
+        for part in self._partitions():
+            if resources.region in (None, part):
+                yield part, part
+
+    def get_feasible_launchable_resources(
+            self, resources: Resources) -> List[Resources]:
+        if resources.cloud is not None and resources.cloud != self._REPR:
+            return []
+        if resources.accelerator_name is not None or resources.tpu is not None:
+            return []  # site CPU/GPU partitions; TPUs come from GCP/GKE
+        if resources.use_spot:
+            return []  # no spot semantics on a batch scheduler
+        out = []
+        for part in self._partitions():
+            if resources.region in (None, part):
+                out.append(resources.copy(cloud=self._REPR, region=part,
+                                          _price_per_hour=0.0))
+        return out
+
+    def make_deploy_variables(self, resources: Resources,
+                              cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str],
+                              num_nodes: int) -> Dict[str, Any]:
+        partition = None if region == 'default' else region
+        return {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'partition': partition,
+            'num_nodes': num_nodes,
+        }
+
+    @property
+    def provisioner_module(self) -> str:
+        return 'skypilot_tpu.provision.slurm'

@@ -1,0 +1,12 @@
+from skypilot_tpu.provision.slurm.instance import (cleanup_ports,
+                                                   get_cluster_info,
+                                                   open_ports,
+                                                   query_instances,
+                                                   run_instances,
+                                                   stop_instances,
+                                                   terminate_instances,
+                                                   wait_instances)
+
+__all__ = ['run_instances', 'wait_instances', 'stop_instances',
+           'terminate_instances', 'query_instances', 'get_cluster_info',
+           'open_ports', 'cleanup_ports']

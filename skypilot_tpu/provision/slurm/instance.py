@@ -1,0 +1,281 @@
+"""Slurm provisioner: an existing Slurm cluster as a provider.
+
+Reference analog: ``sky/provision/slurm/`` + ``sky/clouds/slurm.py`` — the
+reference submits a sleep allocation via sbatch and gang-runs with srun
+(``SlurmCodeGen``, ``task_codegen.py:639``; ``uses_ray()=False``). Here the
+allocation is the same (``sbatch --wrap 'sleep infinity'`` holds N nodes),
+but execution rides the framework's own gang stack: the allocated compute
+nodes are SSH-reachable instances, so the standard driver-on-head path
+(bootstrap + head agent + rank env contract) applies unchanged — no
+srun-specific codegen needed.
+
+Config ``$SKYTPU_STATE_DIR/slurm.yaml``::
+
+    login: login-node.example.com   # sbatch/squeue/scancel run here via SSH
+    user: alice
+    identity_file: ~/.ssh/id_ed25519  # optional; framework key default
+    partitions: [debug, batch]        # optional; cluster default otherwise
+
+A PENDING allocation beyond the wait deadline is cancelled and surfaces as
+QuotaExceededError — the failover loop treats a busy partition exactly
+like a cloud stockout.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import time
+from typing import Any, Dict, List, Optional
+
+import filelock
+import yaml
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.utils.command_runner import CommandRunner, RunnerSpec
+
+ALLOC_WAIT_S = float(os.environ.get('SKYTPU_SLURM_ALLOC_WAIT_S', '300'))
+_POLL_S = 2.0
+
+
+def config_path() -> str:
+    return os.path.expanduser(os.path.join(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'), 'slurm.yaml'))
+
+
+def load_config() -> Optional[Dict[str, Any]]:
+    path = config_path()
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding='utf-8') as f:
+            cfg = yaml.safe_load(f) or {}
+    except yaml.YAMLError as e:
+        raise exceptions.SkyTpuError(f'Invalid YAML in {path}: {e}') from e
+    if not isinstance(cfg, dict) or 'login' not in cfg:
+        raise exceptions.SkyTpuError(
+            f'{path} must be a mapping with at least `login:` '
+            '(the node where sbatch/squeue run).')
+    return cfg
+
+
+def login_runner_spec(cfg: Optional[Dict[str, Any]] = None) -> RunnerSpec:
+    cfg = cfg or load_config()
+    assert cfg is not None, 'slurm.yaml required'
+    identity = cfg.get('identity_file')
+    if identity is None:
+        from skypilot_tpu import authentication
+        identity, _ = authentication.get_or_create_ssh_keypair()
+    return RunnerSpec(kind='ssh', ip=cfg['login'],
+                      user=cfg.get('user') or 'root',
+                      ssh_key=os.path.expanduser(identity))
+
+
+def _login(cfg: Optional[Dict[str, Any]] = None) -> CommandRunner:
+    return login_runner_spec(cfg).make()
+
+
+def _run_or_raise(runner: CommandRunner, cmd: str) -> str:
+    rc, out = runner.output(cmd)
+    if rc != 0:
+        raise exceptions.SkyTpuError(
+            f'slurm login command failed (rc={rc}): {cmd}: {out[:300]}')
+    return out.strip()
+
+
+# -- client-side allocation record ------------------------------------------
+
+
+def _allocs_path() -> str:
+    d = os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, 'slurm_allocs.json')
+
+
+def _allocs_lock() -> filelock.FileLock:
+    return filelock.FileLock(_allocs_path() + '.lock')
+
+
+def _read_allocs() -> Dict[str, Any]:
+    try:
+        with open(_allocs_path(), encoding='utf-8') as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def _write_allocs(allocs: Dict[str, Any]) -> None:
+    with open(_allocs_path(), 'w', encoding='utf-8') as f:
+        json.dump(allocs, f)
+
+
+# -- provision function interface -------------------------------------------
+
+
+def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    cfg = load_config()
+    if cfg is None:
+        raise exceptions.ResourcesUnavailableError(
+            f'No Slurm config at {config_path()}.')
+    runner = _login(cfg)
+    name = config.cluster_name_on_cloud
+    partition = config.node_config.get('partition')
+    with _allocs_lock():
+        allocs = _read_allocs()
+        if name in allocs:
+            # Already allocated (resume/idempotent relaunch): reuse ONLY a
+            # live allocation of the same shape — a stale 2-node alloc must
+            # not satisfy a 4-node (or other-partition) request.
+            alloc = allocs[name]
+            state = _job_state(runner, alloc['job_id'])
+            if (state == 'RUNNING'
+                    and len(alloc['nodes']) == config.num_nodes
+                    and alloc.get('partition') == partition):
+                return common.ProvisionRecord(
+                    provider_name='slurm', region=partition or 'default',
+                    zone=None, cluster_name_on_cloud=name,
+                    head_instance_id=f'{name}-0',
+                    created_instance_ids=[],
+                    resumed_instance_ids=[
+                        f'{name}-{i}'
+                        for i in range(len(alloc['nodes']))])
+            if state == 'RUNNING':
+                runner.run(f'scancel {alloc["job_id"]}')  # wrong shape
+            del allocs[name]
+            _write_allocs(allocs)
+
+    part_flag = f'-p {shlex.quote(partition)} ' if partition else ''
+    job_id = _run_or_raise(
+        runner,
+        f'sbatch --parsable --job-name skytpu-{shlex.quote(name)} '
+        f'--nodes {config.num_nodes} {part_flag}'
+        f"--output /dev/null --wrap 'sleep infinity'").splitlines()[-1]
+    if not job_id.isdigit():
+        raise exceptions.SkyTpuError(f'sbatch returned {job_id!r}')
+
+    deadline = time.time() + ALLOC_WAIT_S
+    while True:
+        state = _job_state(runner, job_id)
+        if state == 'RUNNING':
+            break
+        if state in ('FAILED', 'CANCELLED', 'TIMEOUT'):
+            # Unconditional scancel: even a "finished" job id is cancelled
+            # defensively — a leaked sleep-infinity allocation holds N
+            # nodes with nothing left that would ever release it.
+            runner.run(f'scancel {job_id}')
+            raise exceptions.QuotaExceededError(
+                f'slurm: allocation {job_id} ended in state {state}')
+        # state None (job not visible in squeue yet — accounting lag right
+        # after submit) falls through to the deadline check and retries.
+        if time.time() > deadline:
+            runner.run(f'scancel {job_id}')
+            raise exceptions.QuotaExceededError(
+                f'slurm: allocation {job_id} still {state} after '
+                f'{ALLOC_WAIT_S:.0f}s (partition busy) — cancelled')
+        time.sleep(_POLL_S)
+
+    nodelist = _run_or_raise(runner, f'squeue -h -j {job_id} -o %N')
+    nodes = _run_or_raise(
+        runner, f'scontrol show hostnames {shlex.quote(nodelist)}'
+    ).split()
+    if len(nodes) != config.num_nodes:
+        runner.run(f'scancel {job_id}')
+        raise exceptions.SkyTpuError(
+            f'slurm: expected {config.num_nodes} nodes, got {nodes}')
+    with _allocs_lock():
+        allocs = _read_allocs()
+        allocs[name] = {'job_id': job_id, 'partition': partition,
+                        'nodes': nodes}
+        _write_allocs(allocs)
+    return common.ProvisionRecord(
+        provider_name='slurm', region=partition or 'default', zone=None,
+        cluster_name_on_cloud=name, head_instance_id=f'{name}-0',
+        created_instance_ids=[f'{name}-{i}' for i in range(len(nodes))],
+        resumed_instance_ids=[])
+
+
+def _job_state(runner: CommandRunner, job_id: str) -> Optional[str]:
+    rc, out = runner.output(f'squeue -h -j {job_id} -o %T')
+    if rc != 0 or not out.strip():
+        return None  # job left the queue (finished/cancelled/unknown)
+    return out.strip().splitlines()[0]
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: str) -> None:
+    del region, state  # run_instances waits for RUNNING synchronously
+    if cluster_name_on_cloud not in _read_allocs():
+        raise exceptions.ClusterDoesNotExist(cluster_name_on_cloud)
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
+    raise exceptions.NotSupportedError(
+        'Slurm allocations cannot be stopped; use down (scancel) instead.')
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None
+                        ) -> None:
+    del provider_config
+    with _allocs_lock():
+        allocs = _read_allocs()
+        alloc = allocs.pop(cluster_name_on_cloud, None)
+        _write_allocs(allocs)
+    if alloc is not None:
+        cfg = load_config()
+        if cfg is not None:
+            _login(cfg).run(f'scancel {alloc["job_id"]}')
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Optional[str]]:
+    del provider_config
+    alloc = _read_allocs().get(cluster_name_on_cloud)
+    if alloc is None:
+        return {}
+    cfg = load_config()
+    state = _job_state(_login(cfg), alloc['job_id']) if cfg else None
+    status = 'running' if state == 'RUNNING' else 'terminated'
+    return {f'{cluster_name_on_cloud}-{i}': status
+            for i in range(len(alloc['nodes']))}
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    del region, provider_config
+    alloc = _read_allocs().get(cluster_name_on_cloud)
+    if alloc is None:
+        raise exceptions.ClusterDoesNotExist(cluster_name_on_cloud)
+    cfg = load_config() or {}
+    identity = cfg.get('identity_file')
+    if identity is None:
+        from skypilot_tpu import authentication
+        identity, _ = authentication.get_or_create_ssh_keypair()
+    instances = [
+        common.InstanceInfo(
+            instance_id=f'{cluster_name_on_cloud}-{i}',
+            node_id=i, worker_id=0,
+            internal_ip=node, external_ip=node, status='running')
+        for i, node in enumerate(alloc['nodes'])
+    ]
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=instances[0].instance_id if instances else None,
+        provider_name='slurm', region=alloc.get('partition') or 'default',
+        zone=None, ssh_user=cfg.get('user') or 'root',
+        ssh_key_path=os.path.expanduser(identity))
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[int],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del cluster_name_on_cloud, ports, provider_config  # site-managed network
+
+
+def cleanup_ports(cluster_name_on_cloud: str,
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del cluster_name_on_cloud, provider_config

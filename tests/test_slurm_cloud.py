@@ -1,0 +1,173 @@
+"""Slurm-as-cloud end to end over the fake-ssh rig.
+
+Reference analog: ``sky/clouds/slurm.py`` + ``sky/provision/slurm`` smoke
+coverage. The rig's login host carries fake ``sbatch``/``squeue``/
+``scontrol``/``scancel`` in ``~/bin`` managing a JSON job table in its
+HOME; allocated compute nodes are further rig hosts, so the standard
+driver-on-head path (bootstrap, agent, rank env) runs unchanged on top of
+the allocation.
+"""
+import json
+import os
+import stat
+import sys
+import time
+
+import pytest
+import yaml as yaml_lib
+
+from skypilot_tpu import authentication
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.provision.slurm import instance as slurm_instance
+
+FAKE_SLURM = {
+    'sbatch': r'''#!/usr/bin/env python3
+import json, os, sys
+args = sys.argv[1:]
+nodes = 1
+i = 0
+while i < len(args):
+    if args[i] == '--nodes':
+        nodes = int(args[i + 1]); i += 2
+    else:
+        i += 1
+path = os.path.expanduser('~/slurm_jobs.json')
+jobs = json.load(open(path)) if os.path.exists(path) else {}
+jid = str(max([int(j) for j in jobs] or [100]) + 1)
+state = 'PENDING' if os.path.exists(
+    os.path.expanduser('~/partition_busy')) else 'RUNNING'
+jobs[jid] = {'state': state,
+             'nodes': [f'slurmnode{i}' for i in range(nodes)]}
+json.dump(jobs, open(path, 'w'))
+print(jid)
+''',
+    'squeue': r'''#!/usr/bin/env python3
+import json, os, sys
+args = sys.argv[1:]
+jid, fmt = None, '%T'
+i = 0
+while i < len(args):
+    if args[i] == '-j':
+        jid = args[i + 1]; i += 2
+    elif args[i] == '-o':
+        fmt = args[i + 1]; i += 2
+    else:
+        i += 1
+path = os.path.expanduser('~/slurm_jobs.json')
+jobs = json.load(open(path)) if os.path.exists(path) else {}
+job = jobs.get(jid)
+if job is None or job['state'] in ('CANCELLED',):
+    sys.exit(0)  # empty output: job left the queue
+if fmt == '%T':
+    print(job['state'])
+elif fmt == '%N':
+    print(','.join(job['nodes']))
+''',
+    'scontrol': r'''#!/usr/bin/env python3
+import sys
+assert sys.argv[1:3] == ['show', 'hostnames']
+for n in sys.argv[3].split(','):
+    print(n)
+''',
+    'scancel': r'''#!/usr/bin/env python3
+import json, os, sys
+path = os.path.expanduser('~/slurm_jobs.json')
+jobs = json.load(open(path)) if os.path.exists(path) else {}
+if sys.argv[1] in jobs:
+    jobs[sys.argv[1]]['state'] = 'CANCELLED'
+json.dump(jobs, open(path, 'w'))
+''',
+}
+
+LOGIN = 'slurmlogin'
+
+
+@pytest.fixture()
+def slurm_rig(fake_ssh, tmp_state_dir, monkeypatch):
+    monkeypatch.setenv('SKYTPU_REMOTE_PYTHON', sys.executable)
+    monkeypatch.setenv('SKYTPU_AGENT_DIAL', 'direct')
+    monkeypatch.setenv('SKYTPU_SLURM_ALLOC_WAIT_S', '4')
+    monkeypatch.setattr(slurm_instance, 'ALLOC_WAIT_S', 4.0)
+    key, _ = authentication.get_or_create_ssh_keypair()
+    fake_ssh.up(LOGIN)
+    home = fake_ssh.home(LOGIN)
+    bindir = home / 'bin'
+    bindir.mkdir(parents=True, exist_ok=True)
+    for name, src in FAKE_SLURM.items():
+        sc = bindir / name
+        sc.write_text(src)
+        sc.chmod(sc.stat().st_mode | stat.S_IEXEC)
+    with open(home / '.profile', 'a', encoding='utf-8') as f:
+        f.write('export PATH=$HOME/bin:$PATH\n')
+    with open(slurm_instance.config_path(), 'w', encoding='utf-8') as f:
+        yaml_lib.safe_dump({'login': LOGIN, 'user': 'tester',
+                            'identity_file': key,
+                            'partitions': ['debug']}, f)
+    yield fake_ssh
+
+
+def test_check_and_feasibility(slurm_rig):
+    from skypilot_tpu.clouds.slurm import Slurm
+    from skypilot_tpu.resources import Resources
+    ok, reason = Slurm.check_credentials()
+    assert ok, reason
+    feas = Slurm().get_feasible_launchable_resources(Resources(cloud='slurm'))
+    assert [r.region for r in feas] == ['debug']
+    assert Slurm().get_feasible_launchable_resources(
+        Resources(cloud='slurm', accelerators='tpu-v5e-8')) == []
+
+
+def test_slurm_gang_end_to_end(slurm_rig):
+    """2-node allocation -> bootstrap -> driver-on-head gang -> scancel."""
+    from skypilot_tpu import core, execution
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
+
+    slurm_rig.up('slurmnode0')
+    slurm_rig.up('slurmnode1')
+
+    task = Task('slurmjob', num_nodes=2,
+                run='echo srank=$SKYPILOT_NODE_RANK host=$(basename $HOME)')
+    task.set_resources(Resources(cloud='slurm'))
+    job_id, handle = execution.launch(task, cluster_name='sl',
+                                      detach_run=True)
+    assert handle.cloud == 'slurm'
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        s = core.job_status('sl', job_id)
+        if s and job_lib.JobStatus(s).is_terminal():
+            break
+        time.sleep(0.3)
+    assert s == 'SUCCEEDED', s
+
+    # Driver-on-head: merged log lives on slurmnode0.
+    merged = (slurm_rig.home('slurmnode0') / '.skytpu' / 'runtime' /
+              'clusters' / 'sl' / 'jobs' / str(job_id) / 'run.log')
+    content = merged.read_text()
+    assert 'srank=0 host=slurmnode0' in content
+    assert 'srank=1 host=slurmnode1' in content
+
+    # down = scancel on the login node + local alloc record removal.
+    core.down('sl')
+    jobs = json.loads(
+        (slurm_rig.home(LOGIN) / 'slurm_jobs.json').read_text())
+    assert all(j['state'] == 'CANCELLED' for j in jobs.values())
+    assert slurm_instance._read_allocs() == {}
+
+
+def test_busy_partition_is_a_stockout(slurm_rig):
+    """A PENDING-forever allocation is cancelled and fails over like a
+    cloud stockout (ResourcesUnavailableError once candidates exhaust)."""
+    from skypilot_tpu import exceptions, execution
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
+
+    (slurm_rig.home(LOGIN) / 'partition_busy').touch()
+    task = Task('busy', run='echo hi')
+    task.set_resources(Resources(cloud='slurm'))
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        execution.launch(task, cluster_name='slb', detach_run=True)
+    # The pending allocation was scancelled, not leaked.
+    jobs = json.loads(
+        (slurm_rig.home(LOGIN) / 'slurm_jobs.json').read_text())
+    assert all(j['state'] == 'CANCELLED' for j in jobs.values())
