@@ -65,6 +65,39 @@ class AgentClient:
             timeout=self.timeout)
         return reply.job_id
 
+    def exec_stream(self, command: str,
+                    env: Optional[Dict[str, str]] = None,
+                    cwd: Optional[str] = None) -> Iterator[Any]:
+        """Run a command on the agent's host; yields output bytes chunks,
+        then the final int exit code. Closing the generator early cancels
+        the RPC, which kills the remote process group."""
+        call = self._stub.Exec(
+            pb.ExecRequest(command=command, env=env or {}, cwd=cwd or ''))
+        finished = False
+        try:
+            for chunk in call:
+                if chunk.done:
+                    finished = True
+                    yield int(chunk.exit_code)
+                    return
+                yield bytes(chunk.data)
+            yield 255  # stream ended without an exit marker: remote died
+        finally:
+            if not finished:
+                call.cancel()
+
+    def exec_command(self, command: str,
+                     env: Optional[Dict[str, str]] = None,
+                     cwd: Optional[str] = None) -> 'tuple[int, bytes]':
+        out = b''
+        rc = 255
+        for item in self.exec_stream(command, env=env, cwd=cwd):
+            if isinstance(item, int):
+                rc = item
+            else:
+                out += item
+        return rc, out
+
     def set_autostop(self, idle_minutes: int, down: bool = False) -> bool:
         reply = self._stub.SetAutostop(
             pb.SetAutostopRequest(idle_minutes=idle_minutes, down=down),

@@ -1,0 +1,47 @@
+"""Relay a command to a peer agent's Exec RPC.
+
+The gang driver supervises plain local processes (``gangd``); where a
+worker has no sshd (GKE pods), the per-rank process is THIS relay: it
+dials the worker's agent, streams the command's combined output to its own
+stdout, and exits with the remote exit code — so the existing gang
+machinery (spawn/mux/fail-fast/log-prefixing) works unchanged over gRPC.
+
+Invoked as ``python -m skypilot_tpu.agent.exec_relay --address IP:PORT
+--payload-b64 <base64 json {command, env, cwd}>`` (payload is base64 so
+multi-line commands and env values survive argv).
+"""
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import sys
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--address', required=True)
+    parser.add_argument('--payload-b64', required=True)
+    args = parser.parse_args()
+    payload = json.loads(base64.b64decode(args.payload_b64))
+
+    from skypilot_tpu.agent import client as client_lib
+    client = client_lib.AgentClient(args.address, timeout=30.0)
+    rc = 255
+    try:
+        for item in client.exec_stream(payload['command'],
+                                       env=payload.get('env') or {},
+                                       cwd=payload.get('cwd')):
+            if isinstance(item, int):
+                rc = item
+            else:
+                sys.stdout.buffer.write(item)
+                sys.stdout.buffer.flush()
+    except Exception as e:  # noqa: BLE001 — a dead peer is a rank failure
+        print(f'[exec-relay] {args.address}: {e!r}', file=sys.stderr)
+        rc = 255
+    sys.exit(rc)
+
+
+if __name__ == '__main__':
+    main()

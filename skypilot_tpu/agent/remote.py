@@ -103,6 +103,25 @@ class AgentTunnel:
             self.proc.terminate()
 
 
+class KubectlTunnel:
+    """``kubectl port-forward`` to the head pod's agent (pods are not
+    SSH-dialable; same role as AgentTunnel on SSH clouds)."""
+
+    def __init__(self, head_spec: RunnerSpec, remote_port: int):
+        assert head_spec.kind == 'k8s', head_spec
+        self.local_port = _free_local_port()
+        argv = ['kubectl', 'port-forward', '-n', head_spec.namespace,
+                f'pod/{head_spec.ip}',
+                f'{self.local_port}:{remote_port}']
+        self.proc = subprocess.Popen(argv, stdout=subprocess.DEVNULL,
+                                     stderr=subprocess.DEVNULL)
+        self._wait_listening()
+
+    _wait_listening = AgentTunnel._wait_listening
+    alive = AgentTunnel.alive
+    close = AgentTunnel.close
+
+
 class _Conn:
 
     def __init__(self, client: AgentClient, tunnel: Optional[AgentTunnel]):
@@ -153,12 +172,17 @@ def agent_client(cluster_name: str, head_spec: RunnerSpec) -> AgentClient:
         del _conns[cluster_name]
     port = read_agent_port(head_spec, cluster_name)
     mode = os.environ.get('SKYTPU_AGENT_DIAL', 'tunnel')
-    tunnel: Optional[AgentTunnel] = None
-    if mode == 'direct' or head_spec.kind != 'ssh':
+    tunnel = None
+    if mode == 'direct':
         address = f'127.0.0.1:{port}'
-    else:
+    elif head_spec.kind == 'ssh':
         tunnel = AgentTunnel(head_spec, port)
         address = f'127.0.0.1:{tunnel.local_port}'
+    elif head_spec.kind == 'k8s':
+        tunnel = KubectlTunnel(head_spec, port)
+        address = f'127.0.0.1:{tunnel.local_port}'
+    else:
+        address = f'127.0.0.1:{port}'
     client = AgentClient(address, timeout=30.0)
     _conns[cluster_name] = _Conn(client, tunnel)
     return client

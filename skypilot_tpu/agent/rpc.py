@@ -23,6 +23,7 @@ _METHODS = {
     'TailLog': (True, pb.TailLogRequest, pb.LogChunk),
     'SetAutostop': (False, pb.SetAutostopRequest, pb.SetAutostopReply),
     'SubmitJob': (False, pb.SubmitJobRequest, pb.SubmitJobReply),
+    'Exec': (True, pb.ExecRequest, pb.ExecChunk),
 }
 
 

@@ -120,6 +120,71 @@ class AgentServicer:
             request.num_workers, json.loads(request.spec_json))
         return pb.SubmitJobReply(job_id=job_id)
 
+    def Exec(self, request: pb.ExecRequest, context
+             ) -> Iterator[pb.ExecChunk]:
+        """Run a command on this host, streaming combined output; the last
+        chunk carries the exit code. The gang driver's peer transport for
+        pods (no sshd) — reference analog: skylet's gRPC job services. A
+        dropped stream (client cancel / driver death) kills the whole
+        process group so gang commands never outlive their job."""
+        import signal as signal_lib
+        import subprocess
+
+        env = dict(os.environ)
+        env.update(dict(request.env))
+        cwd = os.path.expanduser(request.cwd) if request.cwd else None
+        proc = subprocess.Popen(
+            ['bash', '-c', request.command], stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, env=env, cwd=cwd,
+            start_new_session=True)
+
+        def _kill():
+            if proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, signal_lib.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    proc.terminate()
+            try:
+                # Reap: without this every cancelled Exec leaves a zombie
+                # on the agent host.
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                # TERM-ignoring command (trap '' TERM): escalate — a rank
+                # that outlives its job would hold the TPU devices and
+                # wedge the next job on this worker.
+                try:
+                    os.killpg(proc.pid, signal_lib.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    proc.kill()
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass
+
+        # Fires on RPC termination INCLUDING client cancel — the handler
+        # may be blocked in read1 below and would never observe
+        # context.is_active() flipping; killing the group closes the pipe
+        # and unblocks the read.
+        context.add_callback(_kill)
+        try:
+            assert proc.stdout is not None
+            while True:
+                # read1: return whatever is available NOW (plain read(n)
+                # would block until n bytes or EOF, batching all output to
+                # process exit).
+                chunk = proc.stdout.read1(1 << 14)
+                if not chunk:
+                    break
+                if not context.is_active():
+                    # Cancelled mid-stream: stop cleanly (finally kills the
+                    # gang process group).
+                    return
+                yield pb.ExecChunk(data=chunk)
+            rc = proc.wait()
+            yield pb.ExecChunk(done=True, exit_code=rc)
+        finally:
+            _kill()
+
     def SetAutostop(self, request: pb.SetAutostopRequest, context
                     ) -> pb.SetAutostopReply:
         del context
@@ -214,11 +279,14 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--cluster-dir', required=True)
     parser.add_argument('--port', type=int, default=0)
+    parser.add_argument('--host', default='127.0.0.1',
+                        help='bind address; 0.0.0.0 for worker agents '
+                             'reached by pod IP (GKE peer exec)')
     parser.add_argument('--port-file', default=None,
                         help='write the bound port here (cluster-unique '
                              'ports: clients read this file over SSH)')
     args = parser.parse_args()
-    server = serve(args.cluster_dir, args.port)
+    server = serve(args.cluster_dir, args.port, host=args.host)
     if args.port_file:
         with open(args.port_file, 'w', encoding='utf-8') as f:
             f.write(str(server.bound_port))

@@ -171,13 +171,18 @@ class TpuGangBackend(Backend):
             return handle
         return None
 
+    # Fixed port for worker agents on pod-network clusters (pods have
+    # unique IPs; the head-side driver dials <podIP>:<port> Exec RPCs).
+    WORKER_AGENT_PORT = 46590
+
     def _remote_control(self, handle: ClusterHandle) -> bool:
         """True when the cluster's control plane (job table, logs, gang
-        driver) lives on the head node behind the gRPC agent. Local/fake
-        workers share this host (nothing to tunnel to); GKE pods are
-        reached by kubectl-exec from the client, so their driver stays
-        client-side this round."""
-        return handle.cloud not in ('local', 'fake', 'gke')
+        driver) lives on the head node behind the gRPC agent. Only
+        local/fake clusters (workers share this host) keep the client-side
+        driver. SSH clouds fan out head->peers over SSH; GKE fans out over
+        the per-pod agents' Exec RPC (pods have no sshd), with the client
+        dialing the head agent through kubectl port-forward."""
+        return handle.cloud not in ('local', 'fake')
 
     def is_remote_controlled(self, handle: ClusterHandle) -> bool:
         """Public control-plane dispatch query (core/daemon/controllers ask
@@ -224,7 +229,9 @@ class TpuGangBackend(Backend):
         instance_setup.bootstrap_cluster(
             handle.cluster_name, info, runners,
             start_daemon=self._remote_control(handle),
-            python=os.environ.get('SKYTPU_REMOTE_PYTHON', 'python3'))
+            python=os.environ.get('SKYTPU_REMOTE_PYTHON', 'python3'),
+            worker_agents_port=(self.WORKER_AGENT_PORT
+                                if handle.cloud == 'gke' else None))
 
     def _start_cluster_daemon(self, cluster_name: str) -> None:
         """Spawn the per-cluster autostop/heartbeat daemon (skylet analog).
@@ -465,9 +472,12 @@ class TpuGangBackend(Backend):
                           inst: provision_common.InstanceInfo,
                           info: provision_common.ClusterInfo) -> RunnerSpec:
         """Head->worker runner spec, used by the head-side gang driver:
-        internal IPs + the cluster key installed at bootstrap."""
+        SSH with the bootstrap-installed cluster key, or the peer agent's
+        Exec RPC on pod networks (no sshd)."""
         from skypilot_tpu.agent import remote as remote_lib
-        del handle
+        if handle.cloud == 'gke':
+            return RunnerSpec(kind='grpc', ip=inst.internal_ip,
+                              port=self.WORKER_AGENT_PORT)
         return RunnerSpec(kind='ssh', ip=inst.internal_ip,
                           user=info.ssh_user,
                           ssh_key=remote_lib.HEAD_CLUSTER_KEY)

@@ -34,6 +34,11 @@ LABEL_CLUSTER = 'skytpu-cluster'
 LABEL_NODE = 'skytpu-node'
 LABEL_WORKER = 'skytpu-worker'
 
+# Pods must carry the framework runtime's python deps (grpcio, protobuf,
+# filelock, requests, yaml) for the on-pod agents — set `image_id:` to your
+# ML image (the reference likewise requires its wheel's deps in the pod
+# image). The slim default suffices only for exec-style workloads driven
+# entirely through kubectl.
 DEFAULT_IMAGE = 'python:3.11-slim'
 
 _client_override: Optional[k8s_lib.K8sClient] = None

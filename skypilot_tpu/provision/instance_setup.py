@@ -15,7 +15,7 @@ import concurrent.futures as cf
 import os
 import shlex
 import time
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.provision import common
@@ -96,6 +96,19 @@ def push_cluster_key_to_head(head_runner: CommandRunner,
                     f'chmod 600 {REMOTE_RUNTIME_DIR}/keys/cluster_key')
 
 
+def _agent_start_cmd(pidfile: str, cluster_dir: str, flags: str,
+                     python: str) -> str:
+    """The one pidfile-guarded nohup launch template for agents (head and
+    worker variants differ only in pidfile and flags)."""
+    return (
+        f'if [ -f {pidfile} ] && kill -0 $(cat {pidfile}) 2>/dev/null; then '
+        f'true; else '
+        f'mkdir -p {cluster_dir} && '
+        f'PYTHONPATH={REMOTE_RUNTIME_DIR} nohup {shlex.quote(python)} -m '
+        f'skypilot_tpu.agent.rpc_server --cluster-dir {cluster_dir} '
+        f'{flags} >/dev/null 2>&1 & echo $! > {pidfile}; fi')
+
+
 def start_agent_on_head(head_runner: CommandRunner, cluster_name: str,
                         python: str = 'python3') -> None:
     """Start the on-cluster agent (skylet analog: the gRPC server over the
@@ -107,27 +120,63 @@ def start_agent_on_head(head_runner: CommandRunner, cluster_name: str,
     start finds the pidfile's process alive and exits."""
     pidfile = f'{REMOTE_RUNTIME_DIR}/daemon-{cluster_name}.pid'
     cluster_dir = f'{REMOTE_RUNTIME_DIR}/clusters/{cluster_name}'
-    cmd = (
-        f'if [ -f {pidfile} ] && kill -0 $(cat {pidfile}) 2>/dev/null; then '
-        f'true; else '
-        f'mkdir -p {cluster_dir} && '
-        f'PYTHONPATH={REMOTE_RUNTIME_DIR} nohup {shlex.quote(python)} -m '
-        f'skypilot_tpu.agent.rpc_server --cluster-dir {cluster_dir} '
-        f'--port 0 --port-file {cluster_dir}/agent.port '
-        f'>/dev/null 2>&1 & echo $! > {pidfile}; fi')
-    rc = head_runner.run(cmd)
+    rc = head_runner.run(_agent_start_cmd(
+        pidfile, cluster_dir,
+        f'--port 0 --port-file {cluster_dir}/agent.port', python))
     if rc != 0:
         raise exceptions.ClusterNotUpError(
             f'Starting the cluster agent on the head failed (rc={rc})')
+
+
+def start_worker_agents(runners: Sequence[CommandRunner], cluster_name: str,
+                        port: int, python: str = 'python3') -> None:
+    """Start an agent on EVERY worker at a fixed port (pods have unique
+    IPs, so one well-known port works). This is the gang driver's peer
+    transport where no sshd exists: the head-side driver reaches workers
+    through their agents' Exec RPC (``agent/exec_relay.py``)."""
+
+    def _start_one(idx_runner) -> None:
+        idx, runner = idx_runner
+        pidfile = f'{REMOTE_RUNTIME_DIR}/agent-{cluster_name}-w{idx}.pid'
+        cluster_dir = f'{REMOTE_RUNTIME_DIR}/clusters/{cluster_name}'
+        rc = runner.run(_agent_start_cmd(
+            pidfile, cluster_dir, f'--port {port} --host 0.0.0.0', python))
+        if rc != 0:
+            raise exceptions.ClusterNotUpError(
+                f'Starting the worker agent failed on worker {idx} '
+                f'(rc={rc})')
+        # Liveness: nohup always exits 0, so an agent that dies at once
+        # (missing grpcio in the pod image, port taken) would otherwise
+        # surface only as opaque exec-relay errors at first job run.
+        probe = (f'{shlex.quote(python)} -c "import socket, time\n'
+                 'import sys\n'
+                 'for _ in range(30):\n'
+                 '    try:\n'
+                 f'        socket.create_connection((\'127.0.0.1\', {port}),'
+                 ' 1).close()\n'
+                 '        sys.exit(0)\n'
+                 '    except OSError:\n'
+                 '        time.sleep(0.5)\n'
+                 'sys.exit(1)"')
+        if runner.run(probe) != 0:
+            raise exceptions.ClusterNotUpError(
+                f'Worker agent on worker {idx} never started listening on '
+                f'port {port} — does the node image carry the runtime '
+                'deps (grpcio, protobuf)?')
+
+    with cf.ThreadPoolExecutor(max_workers=min(32, len(runners))) as pool:
+        list(pool.map(_start_one, enumerate(runners)))
 
 
 def bootstrap_cluster(cluster_name: str, info: common.ClusterInfo,
                       runners: Sequence[CommandRunner],
                       ssh_timeout: float = 300.0,
                       start_daemon: bool = True,
-                      python: str = 'python3') -> None:
+                      python: str = 'python3',
+                      worker_agents_port: Optional[int] = None) -> None:
     """Full post-provision setup for a freshly created cluster: SSH
-    reachability -> runtime install on every worker -> head daemon."""
+    reachability -> runtime install on every worker -> head daemon (and,
+    for agent-exec clusters like GKE, an agent on every worker)."""
     if not runners:
         return
     wait_for_ssh(runners, timeout=ssh_timeout)
@@ -137,6 +186,9 @@ def bootstrap_cluster(cluster_name: str, info: common.ClusterInfo,
         key_path, _ = authentication.get_or_create_ssh_keypair()
         push_cluster_key_to_head(runners[0], key_path)
         start_agent_on_head(runners[0], cluster_name, python=python)
+        if worker_agents_port is not None and len(runners) > 1:
+            start_worker_agents(runners[1:], cluster_name,
+                                worker_agents_port, python=python)
     # Optional external log shipping (logs.store in config; reference:
     # provisioner.py:714-722 installing fluentbit at provision time).
     # Genuinely best-effort here: a config typo surfaced at launch entry

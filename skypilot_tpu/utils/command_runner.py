@@ -38,11 +38,11 @@ SSH_OPTIONS = [
 @dataclasses.dataclass
 class RunnerSpec:
     """Serializable description of how to reach one worker."""
-    kind: str  # 'local' | 'ssh' | 'k8s'
+    kind: str  # 'local' | 'ssh' | 'k8s' | 'grpc'
     ip: str = '127.0.0.1'  # for k8s: the pod name
     user: Optional[str] = None
     ssh_key: Optional[str] = None
-    port: int = 22
+    port: int = 22  # ssh port; for grpc: the worker agent's port
     namespace: str = 'default'  # k8s only
 
     def to_dict(self) -> Dict[str, Any]:
@@ -60,6 +60,8 @@ class RunnerSpec:
                                     self.ssh_key, self.port)
         if self.kind == 'k8s':
             return KubectlCommandRunner(self.ip, self.namespace)
+        if self.kind == 'grpc':
+            return GrpcCommandRunner(self.ip, self.port)
         raise ValueError(f'Unknown runner kind {self.kind!r}')
 
 
@@ -225,6 +227,45 @@ class SSHCommandRunner(CommandRunner):
             if tar.returncode or ssh.returncode:
                 raise subprocess.CalledProcessError(
                     ssh.returncode or tar.returncode, ssh_argv)
+
+
+class GrpcCommandRunner(CommandRunner):
+    """Execute on a worker through its agent's Exec RPC (the peer
+    transport where no sshd exists — GKE pods; reference analog: skylet's
+    gRPC job services). Gang fan-out works unchanged: ``popen_argv``
+    returns an ``exec_relay`` invocation, a plain local process the gang
+    supervisor can spawn/kill, whose exit code is the remote one."""
+
+    def __init__(self, host: str, agent_port: int):
+        self.ip = host
+        self.agent_port = agent_port
+
+    @property
+    def address(self) -> str:
+        return f'{self.ip}:{self.agent_port}'
+
+    def popen_argv(self, cmd, env=None, cwd=None):
+        import base64
+        import json
+        import sys as sys_lib
+        payload = base64.b64encode(json.dumps({
+            'command': cmd, 'env': env or {}, 'cwd': cwd,
+        }).encode('utf-8')).decode('ascii')
+        return [sys_lib.executable, '-m', 'skypilot_tpu.agent.exec_relay',
+                '--address', self.address, '--payload-b64', payload]
+
+    def run(self, cmd, env=None, log_path=None, stream=False, prefix='',
+            cwd=None) -> int:
+        argv = self.popen_argv(cmd, env=env, cwd=cwd)
+        if log_path is None:
+            return subprocess.run(argv, check=False).returncode
+        return log_lib.run_with_log(argv, log_path, stream=stream,
+                                    prefix=prefix)
+
+    def rsync(self, src: str, dst: str, up: bool = True) -> None:
+        raise NotImplementedError(
+            'grpc runners carry exec only; file sync to pods goes through '
+            'the client-side kubectl runner at sync time.')
 
 
 class KubectlCommandRunner(CommandRunner):
