@@ -60,16 +60,23 @@ def load_config() -> Optional[Dict[str, Any]]:
     return cfg
 
 
-def login_runner_spec(cfg: Optional[Dict[str, Any]] = None) -> RunnerSpec:
-    cfg = cfg or load_config()
-    assert cfg is not None, 'slurm.yaml required'
+def _resolve_identity(cfg: Dict[str, Any]) -> str:
+    """The SSH key for BOTH the login node and the allocated compute nodes
+    (one rule, used everywhere: configured identity_file, else the
+    framework keypair)."""
     identity = cfg.get('identity_file')
     if identity is None:
         from skypilot_tpu import authentication
         identity, _ = authentication.get_or_create_ssh_keypair()
+    return os.path.expanduser(identity)
+
+
+def login_runner_spec(cfg: Optional[Dict[str, Any]] = None) -> RunnerSpec:
+    cfg = cfg or load_config()
+    assert cfg is not None, 'slurm.yaml required'
     return RunnerSpec(kind='ssh', ip=cfg['login'],
                       user=cfg.get('user') or 'root',
-                      ssh_key=os.path.expanduser(identity))
+                      ssh_key=_resolve_identity(cfg))
 
 
 def _login(cfg: Optional[Dict[str, Any]] = None) -> CommandRunner:
@@ -107,8 +114,13 @@ def _read_allocs() -> Dict[str, Any]:
 
 
 def _write_allocs(allocs: Dict[str, Any]) -> None:
-    with open(_allocs_path(), 'w', encoding='utf-8') as f:
+    # Atomic replace: a reader (or a crash) must never observe a torn
+    # file — swallowing a half-written record as {} would erase the only
+    # handle to live sleep-infinity allocations.
+    tmp = _allocs_path() + '.tmp'
+    with open(tmp, 'w', encoding='utf-8') as f:
         json.dump(allocs, f)
+    os.replace(tmp, _allocs_path())
 
 
 # -- provision function interface -------------------------------------------
@@ -141,34 +153,48 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
                     resumed_instance_ids=[
                         f'{name}-{i}'
                         for i in range(len(alloc['nodes']))])
-            if state == 'RUNNING':
-                runner.run(f'scancel {alloc["job_id"]}')  # wrong shape
+            if state != _GONE:
+                # Any still-queued/running old allocation (wrong shape, or
+                # requeued back to PENDING by Slurm) must be cancelled —
+                # and VERIFIED gone — before the record is dropped; an
+                # unrecorded live allocation would hold nodes forever.
+                runner.run(f'scancel {alloc["job_id"]}')
+                after = _job_state(runner, alloc['job_id'])
+                if after not in (_GONE, 'CANCELLED', 'COMPLETING'):
+                    raise exceptions.SkyTpuError(
+                        f'slurm: stale allocation {alloc["job_id"]} did '
+                        f'not cancel (still {after}); retry the launch.')
             del allocs[name]
             _write_allocs(allocs)
 
     part_flag = f'-p {shlex.quote(partition)} ' if partition else ''
-    job_id = _run_or_raise(
+    raw = _run_or_raise(
         runner,
         f'sbatch --parsable --job-name skytpu-{shlex.quote(name)} '
         f'--nodes {config.num_nodes} {part_flag}'
         f"--output /dev/null --wrap 'sleep infinity'").splitlines()[-1]
+    # --parsable prints 'jobid' or 'jobid;cluster' on federated sites.
+    job_id = raw.split(';', 1)[0]
     if not job_id.isdigit():
-        raise exceptions.SkyTpuError(f'sbatch returned {job_id!r}')
+        raise exceptions.SkyTpuError(f'sbatch returned {raw!r}')
 
     deadline = time.time() + ALLOC_WAIT_S
     while True:
-        state = _job_state(runner, job_id)
+        try:
+            state = _job_state(runner, job_id)
+        except exceptions.SkyTpuError:
+            state = 'PROBE-FAILED'  # transient during the wait: retry
         if state == 'RUNNING':
             break
         if state in ('FAILED', 'CANCELLED', 'TIMEOUT'):
-            # Unconditional scancel: even a "finished" job id is cancelled
-            # defensively — a leaked sleep-infinity allocation holds N
-            # nodes with nothing left that would ever release it.
+            # Defensive scancel even for a "finished" id — a leaked
+            # sleep-infinity allocation holds N nodes with nothing left
+            # that would ever release it.
             runner.run(f'scancel {job_id}')
             raise exceptions.QuotaExceededError(
                 f'slurm: allocation {job_id} ended in state {state}')
-        # state None (job not visible in squeue yet — accounting lag right
-        # after submit) falls through to the deadline check and retries.
+        # _GONE right after submit = accounting lag; retries until the
+        # deadline, whose scancel covers the late-appearing job too.
         if time.time() > deadline:
             runner.run(f'scancel {job_id}')
             raise exceptions.QuotaExceededError(
@@ -196,17 +222,29 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
         resumed_instance_ids=[])
 
 
-def _job_state(runner: CommandRunner, job_id: str) -> Optional[str]:
+_GONE = 'GONE'  # job no longer visible in squeue (finished/cancelled)
+
+
+def _job_state(runner: CommandRunner, job_id: str) -> str:
+    """Slurm job state via squeue. Empty output (job left the queue) is
+    the distinct ``_GONE``; a FAILED probe (login unreachable, squeue
+    error) raises — it must never be mistaken for a finished allocation,
+    or a transient SSH blip would read as a preemption."""
     rc, out = runner.output(f'squeue -h -j {job_id} -o %T')
-    if rc != 0 or not out.strip():
-        return None  # job left the queue (finished/cancelled/unknown)
+    if rc != 0:
+        raise exceptions.SkyTpuError(
+            f'squeue probe for job {job_id} failed (rc={rc}): {out[:200]}')
+    if not out.strip():
+        return _GONE
     return out.strip().splitlines()[0]
 
 
 def wait_instances(region: str, cluster_name_on_cloud: str,
                    state: str) -> None:
     del region, state  # run_instances waits for RUNNING synchronously
-    if cluster_name_on_cloud not in _read_allocs():
+    with _allocs_lock():
+        known = cluster_name_on_cloud in _read_allocs()
+    if not known:
         raise exceptions.ClusterDoesNotExist(cluster_name_on_cloud)
 
 
@@ -219,26 +257,45 @@ def stop_instances(cluster_name_on_cloud: str,
 def terminate_instances(cluster_name_on_cloud: str,
                         provider_config: Optional[Dict[str, Any]] = None
                         ) -> None:
+    """scancel FIRST, drop the record only once the allocation is verified
+    gone — losing the record while the job lives would leak an untracked
+    sleep-infinity allocation."""
     del provider_config
     with _allocs_lock():
+        alloc = _read_allocs().get(cluster_name_on_cloud)
+    if alloc is None:
+        return
+    cfg = load_config()
+    if cfg is not None:
+        runner = _login(cfg)
+        runner.run(f'scancel {alloc["job_id"]}')
+        state = _job_state(runner, alloc['job_id'])  # raises on probe error
+        if state not in (_GONE, 'CANCELLED', 'COMPLETING'):
+            raise exceptions.SkyTpuError(
+                f'slurm: scancel of allocation {alloc["job_id"]} did not '
+                f'take (still {state}); down again to retry.')
+    with _allocs_lock():
         allocs = _read_allocs()
-        alloc = allocs.pop(cluster_name_on_cloud, None)
+        allocs.pop(cluster_name_on_cloud, None)
         _write_allocs(allocs)
-    if alloc is not None:
-        cfg = load_config()
-        if cfg is not None:
-            _login(cfg).run(f'scancel {alloc["job_id"]}')
 
 
 def query_instances(cluster_name_on_cloud: str,
                     provider_config: Optional[Dict[str, Any]] = None
                     ) -> Dict[str, Optional[str]]:
     del provider_config
-    alloc = _read_allocs().get(cluster_name_on_cloud)
+    with _allocs_lock():
+        alloc = _read_allocs().get(cluster_name_on_cloud)
     if alloc is None:
         return {}
     cfg = load_config()
-    state = _job_state(_login(cfg), alloc['job_id']) if cfg else None
+    if cfg is None:
+        raise exceptions.SkyTpuError(
+            f'No Slurm config at {config_path()}; cannot query allocation '
+            f'{alloc["job_id"]}.')
+    # A failed probe RAISES (see _job_state) — callers must never read a
+    # login-node blip as "all nodes terminated" and trigger recovery.
+    state = _job_state(_login(cfg), alloc['job_id'])
     status = 'running' if state == 'RUNNING' else 'terminated'
     return {f'{cluster_name_on_cloud}-{i}': status
             for i in range(len(alloc['nodes']))}
@@ -248,14 +305,12 @@ def get_cluster_info(region: str, cluster_name_on_cloud: str,
                      provider_config: Optional[Dict[str, Any]] = None
                      ) -> common.ClusterInfo:
     del region, provider_config
-    alloc = _read_allocs().get(cluster_name_on_cloud)
+    with _allocs_lock():
+        alloc = _read_allocs().get(cluster_name_on_cloud)
     if alloc is None:
         raise exceptions.ClusterDoesNotExist(cluster_name_on_cloud)
     cfg = load_config() or {}
-    identity = cfg.get('identity_file')
-    if identity is None:
-        from skypilot_tpu import authentication
-        identity, _ = authentication.get_or_create_ssh_keypair()
+    identity = _resolve_identity(cfg)
     instances = [
         common.InstanceInfo(
             instance_id=f'{cluster_name_on_cloud}-{i}',
@@ -268,7 +323,7 @@ def get_cluster_info(region: str, cluster_name_on_cloud: str,
         head_instance_id=instances[0].instance_id if instances else None,
         provider_name='slurm', region=alloc.get('partition') or 'default',
         zone=None, ssh_user=cfg.get('user') or 'root',
-        ssh_key_path=os.path.expanduser(identity))
+        ssh_key_path=identity)
 
 
 def open_ports(cluster_name_on_cloud: str, ports: List[int],
