@@ -26,14 +26,17 @@ _NEG_INF = -1e30
 
 @dataclasses.dataclass
 class KVCache:
-    """Per-layer key/value ring buffers: [L, B, Hkv, max_len, D]."""
+    """Per-layer key/value ring buffers: [L, B, Hkv, max_len, D].
+    ``lengths`` is PER-ROW ([B] int32): rows advance independently, which
+    is what lets the serving replica batch prompts of different lengths
+    (right-padded) into one prefill/decode."""
     k: jax.Array
     v: jax.Array
-    length: jax.Array  # int32 scalar: tokens currently cached
+    lengths: jax.Array  # [B] int32: tokens currently cached per row
 
 
 jax.tree_util.register_dataclass(
-    KVCache, data_fields=['k', 'v', 'length'], meta_fields=[])
+    KVCache, data_fields=['k', 'v', 'lengths'], meta_fields=[])
 
 
 def init_cache(cfg: llama.LlamaConfig, batch: int, max_len: int,
@@ -41,7 +44,7 @@ def init_cache(cfg: llama.LlamaConfig, batch: int, max_len: int,
     dtype = dtype or cfg.dtype
     shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, cfg.head_dim)
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
-                   length=jnp.zeros((), jnp.int32))
+                   lengths=jnp.zeros((batch,), jnp.int32))
 
 
 def _cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
@@ -49,7 +52,8 @@ def _cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                       ) -> jax.Array:
     """q: [B, S, Hq, D] (absolute ``positions`` [B, S]);
     k/v_cache: [B, Hkv, max_len, D] already containing this block's keys.
-    Attends causally over the first ``valid_len`` cache slots."""
+    Attends causally over the first ``valid_len[b]`` cache slots per row
+    (padded cache slots beyond a row's valid length are never attended)."""
     b, s, hq, d = q.shape
     hkv = k_cache.shape[1]
     group = hq // hkv
@@ -60,7 +64,10 @@ def _cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                         preferred_element_type=jnp.float32) * scale
     ki = jax.lax.broadcasted_iota(jnp.int32, (b, 1, 1, s, max_len), 4)
     qi = positions[:, None, None, :, None]  # absolute query positions
-    mask = (ki <= qi) & (ki < valid_len)
+    if valid_len.ndim == 0:  # uniform batch: scalar broadcast
+        mask = (ki <= qi) & (ki < valid_len)
+    else:
+        mask = (ki <= qi) & (ki < valid_len[:, None, None, None, None])
     logits = jnp.where(mask, logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum('bhgqk,bhkd->bhgqd', probs.astype(v_cache.dtype),
@@ -69,26 +76,47 @@ def _cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
         q.dtype)
 
 
+def _row_update(cache: jax.Array, new: jax.Array,
+                starts: jax.Array) -> jax.Array:
+    """Write ``new`` [B, Hkv, S, D] into ``cache`` [B, Hkv, max_len, D] at
+    per-row offsets ``starts`` [B] (vmapped dynamic_update_slice — rows
+    advance independently under batched decode)."""
+    def one(c, n, s):
+        return jax.lax.dynamic_update_slice(c, n, (0, s, 0))
+    return jax.vmap(one)(cache, new, starts)
+
+
 def _cached_layer(cfg: llama.LlamaConfig, x: jax.Array, layer: Params,
                   positions: jax.Array, k_cache: jax.Array,
-                  v_cache: jax.Array, cache_len: jax.Array
+                  v_cache: jax.Array, cache_lens: jax.Array,
+                  valid: jax.Array
                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One decoder block writing this block's K/V into the cache.
-    x: [B, S, d]; k/v_cache: [B, Hkv, max_len, D]; returns (x, k, v)."""
+    x: [B, S, d]; k/v_cache: [B, Hkv, max_len, D]; ``cache_lens`` [B];
+    ``valid`` [B] = cache_lens + real new tokens per row (< S for padded
+    rows); returns (x, k, v)."""
     h = llama.rms_norm(x, layer['attn_norm'], cfg.norm_eps)
     q = jnp.einsum('bsd,dhk->bshk', h, layer['wq'])
     k = jnp.einsum('bsd,dhk->bshk', h, layer['wk'])
     v = jnp.einsum('bsd,dhk->bshk', h, layer['wv'])
     q = llama.rope(q, positions, cfg.rope_theta)
     k = llama.rope(k, positions, cfg.rope_theta)
-    # Write the new keys/values at [cache_len, cache_len + S).
-    k_cache = jax.lax.dynamic_update_slice(
-        k_cache, k.transpose(0, 2, 1, 3).astype(k_cache.dtype),
-        (0, 0, cache_len, 0))
-    v_cache = jax.lax.dynamic_update_slice(
-        v_cache, v.transpose(0, 2, 1, 3).astype(v_cache.dtype),
-        (0, 0, cache_len, 0))
-    valid = cache_len + x.shape[1]
+    # Write the new keys/values at [start, start + S). Uniform batches
+    # (scalar cache_lens) take a single dynamic_update_slice — measurably
+    # faster than the per-row vmap, which is reserved for genuinely
+    # mixed-length serving batches. Short rows of a padded batch write
+    # junk beyond their real length; it is never attended (valid mask)
+    # and each decode step overwrites the next junk slot first.
+    kt = k.transpose(0, 2, 1, 3).astype(k_cache.dtype)
+    vt = v.transpose(0, 2, 1, 3).astype(v_cache.dtype)
+    if cache_lens.ndim == 0:
+        k_cache = jax.lax.dynamic_update_slice(k_cache, kt,
+                                               (0, 0, cache_lens, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, vt,
+                                               (0, 0, cache_lens, 0))
+    else:
+        k_cache = _row_update(k_cache, kt, cache_lens)
+        v_cache = _row_update(v_cache, vt, cache_lens)
     att = _cached_attention(q, k_cache, v_cache, positions, valid)
     x = x + jnp.einsum('bshk,hkd->bsd', att, layer['wo'])
     h = llama.rms_norm(x, layer['mlp_norm'], cfg.norm_eps)
@@ -110,30 +138,55 @@ def _cached_layer(cfg: llama.LlamaConfig, x: jax.Array, layer: Params,
 
 
 def forward_cached(params: Params, tokens: jax.Array,
-                   cache: KVCache, cfg: llama.LlamaConfig
+                   cache: KVCache, cfg: llama.LlamaConfig,
+                   row_lens: Optional[jax.Array] = None
                    ) -> Tuple[jax.Array, KVCache]:
     """Run ``tokens`` [B, S] through the model appending to ``cache``;
-    returns (logits for the LAST position [B, vocab], updated cache).
-    Works for both prefill (S = prompt length) and decode (S = 1), dense
-    and MoE models alike."""
+    returns (logits for each row's LAST REAL position [B, vocab], updated
+    cache). Works for prefill (S = padded prompt length) and decode
+    (S = 1), dense and MoE models alike. ``row_lens`` [B] gives each row's
+    real token count within ``tokens`` (defaults to S — unpadded batch);
+    rows advance independently, enabling mixed-length serving batches."""
     b, s = tokens.shape
-    positions = (cache.length
-                 + jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s)))
+    uniform = row_lens is None  # STATIC: picks the cheap scalar-offset path
+    if uniform:
+        # All rows share lengths[0] (generate() without prompt_lengths
+        # maintains this invariant for the cache's whole lifetime).
+        start = cache.lengths[0]
+        positions = (start + jnp.broadcast_to(
+            jnp.arange(s, dtype=jnp.int32), (b, s)))
+        valid = start + s           # scalar
+        new_lengths = cache.lengths + s
+        write_start = start         # scalar -> single dynamic_update_slice
+    else:
+        positions = (cache.lengths[:, None] + jnp.broadcast_to(
+            jnp.arange(s, dtype=jnp.int32), (b, s)))
+        valid = cache.lengths + row_lens  # [B]
+        new_lengths = valid
+        write_start = cache.lengths       # [B] -> per-row writes
     x = params['embed'].astype(cfg.dtype)[tokens]
 
     def body(carry, xs):
         x = carry
         layer, k_c, v_c = xs
         x, k_c, v_c = _cached_layer(cfg, x, layer, positions, k_c, v_c,
-                                    cache.length)
+                                    write_start, valid)
         return x, (k_c, v_c)
 
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (params['layers'], cache.k, cache.v))
     x = llama.rms_norm(x, params['final_norm'], cfg.norm_eps)
-    logits = jnp.einsum('bd,dv->bv', x[:, -1], params['lm_head'],
+    if uniform:
+        last = x[:, -1]
+    else:
+        # Each row's logits come from its own last real token
+        # (row_lens - 1), not the padded tail.
+        last = jnp.take_along_axis(
+            x, (row_lens - 1)[:, None, None].astype(jnp.int32), axis=1
+        )[:, 0]
+    logits = jnp.einsum('bd,dv->bv', last, params['lm_head'],
                         preferred_element_type=jnp.float32)
-    return logits, KVCache(k=new_k, v=new_v, length=cache.length + s)
+    return logits, KVCache(k=new_k, v=new_v, lengths=new_lengths)
 
 
 def _sample(logits: jax.Array, temperature: float,
@@ -151,10 +204,26 @@ def _sample(logits: jax.Array, temperature: float,
 _jit_prefill = jax.jit(forward_cached, static_argnums=(3,))
 
 
-def _decode_scan_impl(params, cache, first, key, cfg, n, temperature):
+def pad_prompts(rows, pad_id: int = 0) -> Tuple[jax.Array, jax.Array]:
+    """Right-pad a list of variable-length token rows into
+    (tokens [B, S_max], lengths [B]) for a mixed-length serving batch."""
+    import numpy as np
+    lens = [len(r) for r in rows]
+    s = max(lens)
+    out = np.full((len(rows), s), pad_id, np.int32)
+    for i, r in enumerate(rows):
+        out[i, :len(r)] = np.asarray(r, np.int32)
+    return jnp.asarray(out), jnp.asarray(lens, jnp.int32)
+
+
+def _decode_scan_impl(params, cache, first, key, cfg, n, temperature,
+                      uniform):
     def step(carry, _):
         cache, token, key = carry
-        logits, cache = forward_cached(params, token[:, None], cache, cfg)
+        row_lens = (None if uniform
+                    else jnp.ones((token.shape[0],), jnp.int32))
+        logits, cache = forward_cached(params, token[:, None], cache, cfg,
+                                       row_lens)
         if temperature > 0.0:
             key, sub = jax.random.split(key)
         else:
@@ -167,17 +236,21 @@ def _decode_scan_impl(params, cache, first, key, cfg, n, temperature):
     return toks
 
 
-_jit_decode_scan = jax.jit(_decode_scan_impl, static_argnums=(4, 5, 6))
+_jit_decode_scan = jax.jit(_decode_scan_impl, static_argnums=(4, 5, 6, 7))
 
 
 def generate(params: Params, cfg: llama.LlamaConfig,
              prompt: jax.Array, max_new_tokens: int,
              temperature: float = 0.0,
              key: Optional[jax.Array] = None,
-             max_len: Optional[int] = None) -> jax.Array:
+             max_len: Optional[int] = None,
+             prompt_lengths: Optional[jax.Array] = None) -> jax.Array:
     """prompt: [B, S_p] int32 -> [B, max_new_tokens] generated ids.
     Greedy when temperature == 0 (deterministic parity with full forward);
-    one jitted prefill + one jitted lax.scan of decode steps."""
+    one jitted prefill + one jitted lax.scan of decode steps.
+    ``prompt_lengths`` [B] marks each row's real prompt length when the
+    batch is right-padded (``pad_prompts``) — rows generate from their own
+    last real token."""
     b, s_p = prompt.shape
     max_len = max_len or min(cfg.max_seq_len, s_p + max_new_tokens)
     assert s_p + max_new_tokens <= max_len, (s_p, max_new_tokens, max_len)
@@ -187,7 +260,8 @@ def generate(params: Params, cfg: llama.LlamaConfig,
     if key is None:
         key = jax.random.PRNGKey(0)  # unused in the greedy branch
 
-    logits, cache = _jit_prefill(params, prompt, cache, cfg)
+    logits, cache = _jit_prefill(params, prompt, cache, cfg,
+                                 prompt_lengths)
     if temperature > 0.0:
         key, first_key = jax.random.split(key)
     else:
@@ -197,5 +271,6 @@ def generate(params: Params, cfg: llama.LlamaConfig,
     if max_new_tokens == 1:
         return first[:, None]
     rest = _jit_decode_scan(params, cache, first, key, cfg,
-                            max_new_tokens, temperature)  # [T-1, B]
+                            max_new_tokens, temperature,
+                            prompt_lengths is None)  # [T-1, B]
     return jnp.concatenate([first[:, None], rest.transpose(1, 0)], axis=1)
