@@ -35,7 +35,18 @@ def main() -> None:
                              'preemption windows deterministic)')
     args = parser.parse_args()
 
+    import os
+
     import jax
+
+    # Honor JAX_PLATFORMS from the task env via jax.config: the sandbox's
+    # TPU plugin pins the platform at interpreter start and ignores the
+    # env var, so `JAX_PLATFORMS=cpu python -m skypilot_tpu.train.run`
+    # would otherwise initialize (and block on) the real chip.
+    plat = os.environ.get('JAX_PLATFORMS')
+    if plat:
+        jax.config.update('jax_platforms', plat)
+
     import jax.numpy as jnp
 
     from skypilot_tpu.models import llama
