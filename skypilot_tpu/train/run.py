@@ -26,6 +26,9 @@ def main() -> None:
     parser.add_argument('--global-batch-size', type=int, default=2)
     parser.add_argument('--seq-len', type=int, default=128)
     parser.add_argument('--optimizer', default='adafactor')
+    parser.add_argument('--data', default=None,
+                        help='pretokenized token file (train/data.py '
+                             'TokenDataset); synthetic stream when unset')
     parser.add_argument('--ckpt-dir', default=None,
                         help='checkpoint dir (mounted bucket for recovery)')
     parser.add_argument('--save-every', type=int, default=20)
@@ -35,18 +38,10 @@ def main() -> None:
                              'preemption windows deterministic)')
     args = parser.parse_args()
 
-    import os
+    from skypilot_tpu.utils.jax_env import apply_jax_platform_env
+    apply_jax_platform_env()
 
     import jax
-
-    # Honor JAX_PLATFORMS from the task env via jax.config: the sandbox's
-    # TPU plugin pins the platform at interpreter start and ignores the
-    # env var, so `JAX_PLATFORMS=cpu python -m skypilot_tpu.train.run`
-    # would otherwise initialize (and block on) the real chip.
-    plat = os.environ.get('JAX_PLATFORMS')
-    if plat:
-        jax.config.update('jax_platforms', plat)
-
     import jax.numpy as jnp
 
     from skypilot_tpu.models import llama
@@ -73,11 +68,22 @@ def main() -> None:
             print(f'[train] resumed from checkpoint step {start_step}',
                   flush=True)
 
+    dataset = None
+    if args.data:
+        # batch(step) is pure in step: resume replays the exact data
+        # trajectory the checkpoint was trained on.
+        dataset = data_lib.TokenDataset(
+            args.data, seq_len=cfg.seq_len,
+            batch_size=cfg.global_batch_size)
+
     step_fn = trainer.compiled_step()
     for i in range(start_step, args.steps):
-        batch = jnp.asarray(next(iter(data_lib.synthetic_batches(
-            cfg.global_batch_size, cfg.seq_len, cfg.model.vocab_size,
-            seed=i, num_batches=1))))
+        if dataset is not None:
+            batch = jnp.asarray(dataset.batch(i))
+        else:
+            batch = jnp.asarray(next(iter(data_lib.synthetic_batches(
+                cfg.global_batch_size, cfg.seq_len, cfg.model.vocab_size,
+                seed=i, num_batches=1))))
         t0 = time.time()
         state, metrics = step_fn(state, batch)
         step = i + 1

@@ -1,0 +1,18 @@
+"""The one JAX platform-override helper for task recipes.
+
+Pinned-TPU runtimes (plugins that register their backend at interpreter
+start) ignore the ``JAX_PLATFORMS`` env var; only ``jax.config`` moves
+them. Every recipe that wants ``JAX_PLATFORMS=cpu`` smoke runs to actually
+stay on CPU calls this once before first device use — one helper so the
+workaround has exactly one home.
+"""
+from __future__ import annotations
+
+import os
+
+
+def apply_jax_platform_env() -> None:
+    plat = os.environ.get('JAX_PLATFORMS')
+    if plat:
+        import jax
+        jax.config.update('jax_platforms', plat)

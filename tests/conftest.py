@@ -137,9 +137,9 @@ def fake_ssh(tmp_path, monkeypatch, tmp_state_dir):
 
     yield Rig()
 
-    # Daemons nohup'd inside fake homes (head agents) outlive monkeypatch:
-    # kill anything that recorded a pidfile.
-    for pidfile in root.glob('homes/*/.skytpu/runtime/daemon-*.pid'):
+    # Daemons nohup'd inside fake homes (head agents, worker agents)
+    # outlive monkeypatch: kill anything that recorded a pidfile.
+    for pidfile in root.glob('homes/*/.skytpu/runtime/*.pid'):
         try:
             os.kill(int(pidfile.read_text().strip()), _signal.SIGTERM)
         except (ValueError, ProcessLookupError, PermissionError):

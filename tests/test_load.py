@@ -75,8 +75,10 @@ def test_concurrent_short_request_storm(server):
     p95 = latencies[int(len(latencies) * 0.95)]
     print(f'storm: {len(latencies)} reqs in {wall:.1f}s '
           f'p50={p50:.2f}s p95={p95:.2f}s')
-    # Generous bounds: the point is no wedge/timeout collapse, not speed.
-    assert p95 < 30.0
+    # Generous bounds: the point is no wedge/timeout collapse, not speed
+    # (CI machines run suites concurrently; the bound only has to catch
+    # requests that never complete or queue behind a dead executor).
+    assert p95 < 60.0
     # The server is still healthy after the storm.
     assert sdk.api_info()['status'] == 'healthy'
 
