@@ -52,13 +52,8 @@ def max_controller_restarts() -> int:
 
 
 def _pid_alive(pid: int) -> bool:
-    try:
-        os.kill(pid, 0)
-        return True
-    except ProcessLookupError:
-        return False
-    except PermissionError:
-        return True
+    from skypilot_tpu.utils import common_utils
+    return common_utils.pid_alive(pid)
 
 
 def _reconcile_dead_controllers() -> None:

@@ -59,6 +59,27 @@ def ensure_running() -> bool:
         return False
 
 
+def _active_services() -> int:
+    from skypilot_tpu.serve import serve_state
+    active = (serve_state.ServiceStatus.CONTROLLER_INIT,
+              serve_state.ServiceStatus.REPLICA_INIT,
+              serve_state.ServiceStatus.READY,
+              serve_state.ServiceStatus.SHUTTING_DOWN)
+    return sum(1 for s in serve_state.list_services()
+               if s['status'] in active)
+
+
+def _sweep_serve() -> bool:
+    """Whether THIS watchdog may probe serve-controller pids: only when it
+    shares a host with the serve controller cluster (the local controller
+    cloud — both controller clusters are this machine). On a remote
+    controller cloud the serve cluster runs its own watchdog; probing from
+    here would read every healthy remote pid as dead and stack duplicate
+    controllers."""
+    from skypilot_tpu.utils import controller_utils
+    return controller_utils.controller_cloud() == 'local'
+
+
 def run(interval_s: float = 2.0) -> None:
     lock = filelock.FileLock(_lock_path())
     try:
@@ -72,7 +93,18 @@ def run(interval_s: float = 2.0) -> None:
                 scheduler.maybe_schedule_next(reap_dead_controllers=True)
             except Exception as e:  # noqa: BLE001 — the watchdog must survive
                 print(f'[watchdog] sweep failed: {e!r}')
-            idle = idle + 1 if state.count_nonterminal() == 0 else 0
+            try:
+                if _sweep_serve():
+                    from skypilot_tpu import serve as serve_lib
+                    serve_lib.reconcile_controllers()
+                services = _active_services()
+            except Exception as e:  # noqa: BLE001
+                print(f'[watchdog] serve sweep failed: {e!r}')
+                # Fail BUSY: a broken sweep must not let the watchdog count
+                # itself idle and exit while services may still be running.
+                services = 1
+            busy = state.count_nonterminal() > 0 or services > 0
+            idle = 0 if busy else idle + 1
             time.sleep(interval_s)
 
 

@@ -46,6 +46,8 @@ def up(task: Task, service_name: str,
         f'--service-name {service_name} --lb-port 0',
         job_name=f'serve-controller-{service_name}',
         cluster_name=controller_utils.SERVE_CONTROLLER_CLUSTER)
+    from skypilot_tpu.jobs import watchdog
+    watchdog.ensure_running()  # HA: restart this controller if it dies
     import time as time_lib
     deadline = time_lib.time() + 120
     while time_lib.time() < deadline:
@@ -84,6 +86,69 @@ def down(service_name: str) -> None:
     controller = up._controllers.pop(service_name, None)  # type: ignore[attr-defined]
     if controller is not None:
         controller.stop()
+
+
+def reconcile_controllers() -> List[str]:
+    """HA sweep for serve controllers (reference:
+    HIGH_AVAILABILITY_CONTROLLERS, ``sky/utils/controller_utils.py:255``):
+    an active service whose detached controller process died is given a
+    fresh controller task — the new controller ADOPTS the live replicas
+    (ReplicaManager reads everything from serve_state), so a controller
+    crash is invisible to traffic apart from the LB moving. Bounded by
+    SKYTPU_CONTROLLER_MAX_RESTARTS; beyond it the service is marked
+    FAILED. pid liveness is host-local: run this from the watchdog on the
+    controller cluster's host. Returns the restarted service names."""
+    import os
+
+    from skypilot_tpu.utils import controller_utils
+
+    import time as time_lib
+    max_restarts = int(os.environ.get('SKYTPU_CONTROLLER_MAX_RESTARTS', '3'))
+    claim_grace = float(os.environ.get('SKYTPU_SERVE_CLAIM_GRACE_S', '300'))
+    restarted: List[str] = []
+    # SHUTTING_DOWN is swept too: a controller that died mid-teardown
+    # must be restarted to FINISH the teardown, or the service's replica
+    # clusters run (and bill) forever.
+    active = (serve_state.ServiceStatus.CONTROLLER_INIT,
+              serve_state.ServiceStatus.REPLICA_INIT,
+              serve_state.ServiceStatus.READY,
+              serve_state.ServiceStatus.SHUTTING_DOWN)
+    for svc in serve_state.list_services():
+        if svc['status'] not in active:
+            continue
+        if svc['name'] in up._controllers:  # type: ignore[attr-defined]
+            continue  # in-process (tests): not this sweep's to manage
+        pid = svc.get('controller_pid')
+        if not pid:
+            # No pid: either the first controller is still provisioning
+            # (no claim timestamp — leave it to up()'s own wait), or a
+            # restart was claimed and the new controller hasn't reported
+            # in. Re-trigger only a STALE claim.
+            claim = svc.get('controller_claim_at')
+            if not claim or time_lib.time() - claim < claim_grace:
+                continue
+        elif common_utils.pid_alive(int(pid)):
+            continue  # healthy
+        restarts = serve_state.bump_controller_restarts(svc['name'])
+        if restarts > max_restarts:
+            serve_state.set_service_status(
+                svc['name'], serve_state.ServiceStatus.FAILED)
+            continue
+        # Claim BEFORE launching: ticks between now and the new
+        # controller's pid report must not re-detect the dead pid and
+        # stack duplicate controllers.
+        serve_state.set_controller_pid(svc['name'], None)
+        try:
+            controller_utils.launch_controller_task(
+                'skypilot_tpu.serve.controller',
+                f'--service-name {svc["name"]} --lb-port 0',
+                job_name=f'serve-controller-{svc["name"]}-r{restarts}',
+                cluster_name=controller_utils.SERVE_CONTROLLER_CLUSTER)
+            restarted.append(svc['name'])
+        except Exception as e:  # noqa: BLE001 — keep sweeping other svcs
+            print(f'[serve] controller restart for {svc["name"]} '
+                  f'failed: {e!r}')
+    return restarted
 
 
 def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:

@@ -115,6 +115,11 @@ def main() -> None:
     if port == 0:
         from skypilot_tpu.utils import common_utils
         port = common_utils.find_free_port(30000)
+    import os
+    # The HA sweep (serve.reconcile_controllers) probes this pid; only the
+    # detached-process path records one — in-process test controllers stay
+    # out of the sweep.
+    serve_state.set_controller_pid(args.service_name, os.getpid())
     ServeController(args.service_name, port).run()
 
 

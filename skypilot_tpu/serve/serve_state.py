@@ -39,7 +39,9 @@ CREATE TABLE IF NOT EXISTS services (
     endpoint TEXT,
     created_at REAL,
     controller_pid INTEGER,
-    version INTEGER DEFAULT 1
+    version INTEGER DEFAULT 1,
+    controller_restarts INTEGER DEFAULT 0,
+    controller_claim_at REAL
 );
 CREATE TABLE IF NOT EXISTS replicas (
     service_name TEXT,
@@ -71,6 +73,13 @@ def _conn() -> sqlite3.Connection:
                          'INTEGER DEFAULT 1')
         except sqlite3.OperationalError:
             pass
+    for ddl in ('ALTER TABLE services ADD COLUMN controller_restarts '
+                'INTEGER DEFAULT 0',
+                'ALTER TABLE services ADD COLUMN controller_claim_at REAL'):
+        try:
+            conn.execute(ddl)
+        except sqlite3.OperationalError:
+            pass
     return conn
 
 
@@ -81,11 +90,17 @@ def _lock() -> filelock.FileLock:
 def add_service(name: str, spec: Dict[str, Any],
                 task_config: Dict[str, Any]) -> None:
     with _lock(), _conn() as conn:
+        now = time.time()
         conn.execute(
             'INSERT OR REPLACE INTO services (name, status, spec, '
-            'task_config, created_at) VALUES (?, ?, ?, ?, ?)',
+            'task_config, created_at, controller_claim_at) '
+            'VALUES (?, ?, ?, ?, ?, ?)',
+            # controller_claim_at from birth: a first controller that dies
+            # before reporting its pid is re-launched by the HA sweep once
+            # the claim grace passes (the jobs plane's LAUNCHING_GRACE_S
+            # analog).
             (name, ServiceStatus.CONTROLLER_INIT.value, json.dumps(spec),
-             json.dumps(task_config), time.time()))
+             json.dumps(task_config), now, now))
 
 
 def set_service_status(name: str, status: ServiceStatus,
@@ -97,6 +112,32 @@ def set_service_status(name: str, status: ServiceStatus,
         else:
             conn.execute('UPDATE services SET status = ? WHERE name = ?',
                          (status.value, name))
+
+
+def set_controller_pid(name: str, pid: Optional[int]) -> None:
+    """Record the live controller (or None = restart claimed, new
+    controller not yet reported in — clears the claim timestamp when a
+    real pid lands)."""
+    with _lock(), _conn() as conn:
+        if pid is None:
+            conn.execute(
+                'UPDATE services SET controller_pid = NULL, '
+                'controller_claim_at = ? WHERE name = ?',
+                (time.time(), name))
+        else:
+            conn.execute(
+                'UPDATE services SET controller_pid = ?, '
+                'controller_claim_at = NULL WHERE name = ?', (pid, name))
+
+
+def bump_controller_restarts(name: str) -> int:
+    """Count an HA controller restart; returns the new total."""
+    with _lock(), _conn() as conn:
+        conn.execute('UPDATE services SET controller_restarts = '
+                     'controller_restarts + 1 WHERE name = ?', (name,))
+        row = conn.execute('SELECT controller_restarts FROM services '
+                           'WHERE name = ?', (name,)).fetchone()
+        return int(row['controller_restarts'])
 
 
 def bump_service_version(name: str, spec: Dict[str, Any],

@@ -184,3 +184,15 @@ class Backoff:
         d = self._delay
         self._delay = min(self._delay * self._factor, self._cap)
         return d
+
+
+def pid_alive(pid: int) -> bool:
+    """Host-local process liveness (signal-0 probe). THE pid probe — the
+    jobs scheduler, the serve HA sweep, and tests all share it."""
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True

@@ -1,0 +1,126 @@
+"""HA serve controllers: a crashed controller restarts and ADOPTS its
+replicas (reference: HIGH_AVAILABILITY_CONTROLLERS applied to the serve
+plane)."""
+import os
+import signal
+import time
+
+import pytest
+
+from skypilot_tpu import serve
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils.common_utils import pid_alive as _pid_alive
+
+
+@pytest.fixture(autouse=True)
+def _fake(enable_fake_cloud):
+    yield
+
+
+# A tiny HTTP replica (same shape as test_serve.py's).
+_REPLICA_SERVER = (
+    "python -c \""
+    "import http.server, os, json; "
+    "port = int(os.environ['SKYTPU_REPLICA_PORT']); "
+    "h = type('H', (http.server.BaseHTTPRequestHandler,), "
+    "{'do_GET': lambda s: (s.send_response(200), s.end_headers(), "
+    "s.wfile.write(json.dumps({'port': port}).encode())), "
+    "'log_message': lambda s, *a: None}); "
+    "http.server.HTTPServer(('127.0.0.1', port), h).serve_forever()\""
+)
+
+
+def _service_task():
+    cfg = {
+        'name': 'svc',
+        'run': _REPLICA_SERVER,
+        'resources': {'cloud': 'local'},
+        'service': {
+            'port': 9000,
+            'readiness_probe': {'path': '/health',
+                                'initial_delay_seconds': 90},
+            'replica_policy': {'min_replicas': 1, 'max_replicas': 1},
+        },
+    }
+    return Task.from_yaml_config(cfg)
+
+
+def _wait(pred, timeout=120.0, interval=0.3, desc='condition'):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(interval)
+    raise TimeoutError(f'timed out waiting for {desc}')
+
+
+def test_serve_controller_crash_restart_adopts_replicas(monkeypatch):
+    import sys
+    monkeypatch.setenv('SKYTPU_REMOTE_PYTHON', sys.executable)
+    task = _service_task()
+    serve.up(task, 'ha-svc')
+
+    def ready():
+        rec = serve_state.get_service('ha-svc')
+        return (rec and rec['status'] == serve_state.ServiceStatus.READY
+                and rec.get('controller_pid'))
+    _wait(ready, desc='service READY with controller pid')
+    rec = serve_state.get_service('ha-svc')
+    pid = int(rec['controller_pid'])
+    replicas_before = {
+        (r['replica_id'], r['cluster_name'], r['created_at'])
+        for r in serve_state.list_replicas('ha-svc')
+        if r['status'] == serve_state.ReplicaStatus.READY}
+    assert replicas_before
+
+    os.kill(pid, signal.SIGKILL)
+    _wait(lambda: not _pid_alive(pid), timeout=15, desc='controller death')
+
+    # Either this sweep or the background watchdog claims the restart;
+    # the claim protocol guarantees exactly ONE of them does.
+    serve.reconcile_controllers()
+
+    def new_controller():
+        r = serve_state.get_service('ha-svc')
+        return (r and r.get('controller_pid')
+                and int(r['controller_pid']) != pid
+                and r['status'] == serve_state.ServiceStatus.READY)
+    _wait(new_controller, desc='restarted controller READY')
+
+    # Adoption: the SAME replica (same cluster, same creation time) serves
+    # the restarted controller — no relaunch.
+    replicas_after = {
+        (r['replica_id'], r['cluster_name'], r['created_at'])
+        for r in serve_state.list_replicas('ha-svc')
+        if r['status'] == serve_state.ReplicaStatus.READY}
+    assert replicas_after == replicas_before
+    r = serve_state.get_service('ha-svc')
+    assert int(r['controller_restarts']) == 1
+    serve.down('ha-svc')
+    _wait(lambda: serve_state.get_service('ha-svc')['status'] ==
+          serve_state.ServiceStatus.SHUTDOWN, desc='shutdown')
+
+
+def test_serve_restart_cap(monkeypatch):
+    monkeypatch.setenv('SKYTPU_CONTROLLER_MAX_RESTARTS', '0')
+    serve_state.add_service('cap-svc', {'port': 0}, {'name': 'x'})
+    serve_state.set_service_status('cap-svc',
+                                   serve_state.ServiceStatus.READY)
+    serve_state.set_controller_pid('cap-svc', 999999999)  # definitely dead
+    assert serve.reconcile_controllers() == []
+    assert serve_state.get_service('cap-svc')['status'] == \
+        serve_state.ServiceStatus.FAILED
+
+
+def test_reconcile_skips_healthy_and_in_process(monkeypatch):
+    serve_state.add_service('ok-svc', {'port': 0}, {'name': 'x'})
+    serve_state.set_service_status('ok-svc',
+                                   serve_state.ServiceStatus.READY)
+    serve_state.set_controller_pid('ok-svc', os.getpid())  # alive
+    assert serve.reconcile_controllers() == []
+    r = serve_state.get_service('ok-svc')
+    assert int(r['controller_restarts'] or 0) == 0
+
+
