@@ -136,7 +136,34 @@ def _measure_provision_to_first_step() -> float:
     return dt
 
 
+def _tpu_reachable(timeout_s: float = 300.0) -> bool:
+    """Probe TPU backend init in a SUBPROCESS with a timeout: a wedged
+    device tunnel (stale claim from a killed client) blocks backend init
+    indefinitely and cannot be interrupted in-process; the bench must
+    degrade to the CPU line rather than hang forever."""
+    import subprocess
+    try:
+        r = subprocess.run(
+            [sys.executable, '-c', 'import jax; jax.devices()'],
+            timeout=timeout_s, capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def _bench_tpu() -> dict:
+    # Pinned-TPU runtimes ignore the env var; sync it into jax.config so
+    # JAX_PLATFORMS=cpu smoke runs stay off the chip.
+    from skypilot_tpu.utils.jax_env import apply_jax_platform_env
+    apply_jax_platform_env()
+    want_tpu = os.environ.get('JAX_PLATFORMS', 'axon') not in ('cpu',)
+    if want_tpu and not _tpu_reachable():
+        print('[bench] TPU backend unreachable; falling back to CPU',
+              file=sys.stderr)
+        os.environ['JAX_PLATFORMS'] = 'cpu'
+        import jax
+        jax.config.update('jax_platforms', 'cpu')
+
     import jax
 
     from skypilot_tpu.models import llama

@@ -130,6 +130,15 @@ def set_controller_pid(name: str, pid: Optional[int]) -> None:
                 'controller_claim_at = NULL WHERE name = ?', (pid, name))
 
 
+# Statuses with a controller that should be alive (HA sweep + watchdog
+# busy-count share this — SHUTTING_DOWN included: a controller that died
+# mid-teardown must be restarted to FINISH the teardown).
+ACTIVE_STATUSES = (ServiceStatus.CONTROLLER_INIT,
+                   ServiceStatus.REPLICA_INIT,
+                   ServiceStatus.READY,
+                   ServiceStatus.SHUTTING_DOWN)
+
+
 def bump_controller_restarts(name: str) -> int:
     """Count an HA controller restart; returns the new total."""
     with _lock(), _conn() as conn:
@@ -137,7 +146,38 @@ def bump_controller_restarts(name: str) -> int:
                      'controller_restarts + 1 WHERE name = ?', (name,))
         row = conn.execute('SELECT controller_restarts FROM services '
                            'WHERE name = ?', (name,)).fetchone()
+        if row is None:
+            return 0  # service removed concurrently
         return int(row['controller_restarts'])
+
+
+def claim_restart(name: str, observed_pid: Optional[int],
+                  observed_claim_at: Optional[float]) -> Optional[int]:
+    """Atomically claim an HA restart: clears the pid, stamps a fresh
+    claim, and bumps the restart count — but ONLY if the row still shows
+    exactly what the sweeper observed (dead pid, or the same stale claim).
+    Returns the new restart count, or None when another sweeper won the
+    race (or the service vanished) — the loser must do nothing."""
+    with _lock(), _conn() as conn:
+        if observed_pid is not None:
+            cur = conn.execute(
+                'UPDATE services SET controller_pid = NULL, '
+                'controller_claim_at = ?, controller_restarts = '
+                'controller_restarts + 1 '
+                'WHERE name = ? AND controller_pid = ?',
+                (time.time(), name, observed_pid))
+        else:
+            cur = conn.execute(
+                'UPDATE services SET controller_claim_at = ?, '
+                'controller_restarts = controller_restarts + 1 '
+                'WHERE name = ? AND controller_pid IS NULL AND '
+                'controller_claim_at = ?',
+                (time.time(), name, observed_claim_at))
+        if cur.rowcount != 1:
+            return None
+        row = conn.execute('SELECT controller_restarts FROM services '
+                           'WHERE name = ?', (name,)).fetchone()
+        return int(row['controller_restarts']) if row else None
 
 
 def bump_service_version(name: str, spec: Dict[str, Any],
