@@ -136,19 +136,95 @@ def _measure_provision_to_first_step() -> float:
     return dt
 
 
-def _tpu_reachable(timeout_s: float = 300.0) -> bool:
+# Framework daemons a previous session may have leaked. Any of them can
+# hold the (single-claimant) TPU tunnel and wedge backend init for every
+# later client — the round-2 artifact recorded 0.0 exactly this way.
+_STRAY_PATTERNS = ('skypilot_tpu.agent', 'skytpu_gangd',
+                   'SKYTPU_REPLICA_PORT', 'skypilot_tpu.serve',
+                   'skypilot_tpu.jobs')
+
+
+def _reap_stray_processes() -> int:
+    """Kill leaked framework daemons (agents, drivers, gang supervisors,
+    serving replicas) that may be holding the TPU device claim. Only
+    processes whose cmdline matches the framework's own entrypoints are
+    touched; self and ancestors are skipped. Returns the kill count."""
+    import signal
+
+    me = os.getpid()
+    ancestors = set()
+    pid = me
+    while pid > 1:
+        try:
+            with open(f'/proc/{pid}/stat', encoding='utf-8') as f:
+                pid = int(f.read().rsplit(')', 1)[1].split()[1])
+            ancestors.add(pid)
+        except (OSError, ValueError, IndexError):
+            break
+    killed = []
+    for entry in os.listdir('/proc'):
+        if not entry.isdigit():
+            continue
+        pid = int(entry)
+        if pid == me or pid in ancestors:
+            continue
+        try:
+            with open(f'/proc/{pid}/cmdline', 'rb') as f:
+                cmd = f.read().replace(b'\0', b' ').decode(
+                    'utf-8', errors='replace')
+        except OSError:
+            continue
+        if any(p in cmd for p in _STRAY_PATTERNS):
+            try:
+                os.kill(pid, signal.SIGTERM)
+                killed.append(pid)
+            except (ProcessLookupError, PermissionError):
+                pass
+    if killed:
+        time.sleep(2.0)
+        for pid in killed:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        print(f'[bench] reaped {len(killed)} stray framework '
+              f'process(es): {killed}', file=sys.stderr)
+    return len(killed)
+
+
+def _tpu_probe_once(timeout_s: float) -> bool:
     """Probe TPU backend init in a SUBPROCESS with a timeout: a wedged
     device tunnel (stale claim from a killed client) blocks backend init
-    indefinitely and cannot be interrupted in-process; the bench must
-    degrade to the CPU line rather than hang forever."""
+    indefinitely and cannot be interrupted in-process."""
     import subprocess
     try:
         r = subprocess.run(
-            [sys.executable, '-c', 'import jax; jax.devices()'],
+            [sys.executable, '-c',
+             'import jax; d = jax.devices(); '
+             'import jax.numpy as jnp; '
+             'print(float((jnp.ones((8, 8)) @ jnp.ones((8, 8))).sum()))'],
             timeout=timeout_s, capture_output=True)
         return r.returncode == 0
     except subprocess.TimeoutExpired:
         return False
+
+
+def _tpu_reachable() -> bool:
+    """Retry-with-cleanup probe: reap any stray device-holding framework
+    process, probe, and on failure back off and retry — a stale claim is
+    released by the pool once its holder dies, which can take a grace
+    period. Only after every attempt fails does the bench surrender to
+    the CPU line (a 0.0 artifact is a last resort, not a first reflex)."""
+    _reap_stray_processes()
+    for attempt, timeout_s in enumerate((120.0, 180.0, 300.0)):
+        if _tpu_probe_once(timeout_s):
+            return True
+        print(f'[bench] TPU probe attempt {attempt + 1} failed '
+              f'(timeout {timeout_s:.0f}s); reaping strays and retrying',
+              file=sys.stderr)
+        _reap_stray_processes()
+        time.sleep(10.0 * (attempt + 1))
+    return False
 
 
 def _bench_tpu() -> dict:
@@ -219,7 +295,10 @@ def _bench_tpu() -> dict:
             'loss': round(loss, 4),
             'tflops_per_chip_seq2048': (round(tf2k, 3)
                                         if tf2k is not None else None),
-            'provision_to_first_step_s': provision_s,
+            # Honest label: this times the IN-SANDBOX local provider's
+            # launch->first-output path (provision + bootstrap + gang
+            # exec), not provision on real cloud infra.
+            'local_provider_first_step_s': provision_s,
             'decode_tokens_per_sec': decode_tps,
             'cpu_fallback': not on_tpu,
         },

@@ -15,9 +15,15 @@ from skypilot_tpu.schemas.generated import agent_pb2 as pb
 
 class AgentClient:
 
-    def __init__(self, address: str, timeout: float = 10.0):
+    def __init__(self, address: str, timeout: float = 10.0,
+                 token: Optional[str] = None):
         self.address = address
         self.timeout = timeout
+        # Shared cluster token for worker agents bound to pod IPs (the
+        # server rejects tokenless RPCs there); loopback/tunneled agents
+        # need none.
+        self._metadata = (((rpc_lib.TOKEN_METADATA_KEY, token),)
+                          if token else None)
         self._channel = grpc.insecure_channel(address)
         self._stub = rpc_lib.AgentStub(self._channel)
 
@@ -25,19 +31,22 @@ class AgentClient:
         self._channel.close()
 
     def health(self) -> Dict[str, Any]:
-        reply = self._stub.Health(pb.HealthRequest(), timeout=self.timeout)
+        reply = self._stub.Health(pb.HealthRequest(), timeout=self.timeout,
+            metadata=self._metadata)
         return {'version': reply.version, 'uptime_s': reply.uptime_s}
 
     def list_jobs(self, limit: int = 200) -> List[Dict[str, Any]]:
         reply = self._stub.ListJobs(pb.ListJobsRequest(limit=limit),
-                                    timeout=self.timeout)
+                                    timeout=self.timeout,
+            metadata=self._metadata)
         return [self._job_dict(j) for j in reply.jobs]
 
     def get_job(self, job_id: int) -> Optional[Dict[str, Any]]:
         try:
             return self._job_dict(
                 self._stub.GetJob(pb.GetJobRequest(job_id=job_id),
-                                  timeout=self.timeout))
+                                  timeout=self.timeout,
+            metadata=self._metadata))
         except grpc.RpcError as e:
             if e.code() == grpc.StatusCode.NOT_FOUND:
                 return None
@@ -45,13 +54,15 @@ class AgentClient:
 
     def cancel_job(self, job_id: int) -> bool:
         reply = self._stub.CancelJob(pb.CancelJobRequest(job_id=job_id),
-                                     timeout=self.timeout)
+                                     timeout=self.timeout,
+            metadata=self._metadata)
         return reply.cancelled
 
     def tail_log(self, job_id: int, lines: int = 100,
                  follow: bool = False) -> Iterator[str]:
         for chunk in self._stub.TailLog(
-                pb.TailLogRequest(job_id=job_id, lines=lines, follow=follow)):
+                pb.TailLogRequest(job_id=job_id, lines=lines, follow=follow),
+                metadata=self._metadata):
             yield chunk.data
 
     def submit_job(self, name: str, num_nodes: int, num_workers: int,
@@ -62,7 +73,8 @@ class AgentClient:
             pb.SubmitJobRequest(name=name, num_nodes=num_nodes,
                                 num_workers=num_workers,
                                 spec_json=json.dumps(spec)),
-            timeout=self.timeout)
+            timeout=self.timeout,
+            metadata=self._metadata)
         return reply.job_id
 
     def exec_stream(self, command: str,
@@ -72,7 +84,8 @@ class AgentClient:
         then the final int exit code. Closing the generator early cancels
         the RPC, which kills the remote process group."""
         call = self._stub.Exec(
-            pb.ExecRequest(command=command, env=env or {}, cwd=cwd or ''))
+            pb.ExecRequest(command=command, env=env or {}, cwd=cwd or ''),
+            metadata=self._metadata)
         finished = False
         try:
             for chunk in call:
@@ -101,12 +114,14 @@ class AgentClient:
     def set_autostop(self, idle_minutes: int, down: bool = False) -> bool:
         reply = self._stub.SetAutostop(
             pb.SetAutostopRequest(idle_minutes=idle_minutes, down=down),
-            timeout=self.timeout)
+            timeout=self.timeout,
+            metadata=self._metadata)
         return reply.ok
 
     def cancel_autostop(self) -> bool:
         reply = self._stub.SetAutostop(pb.SetAutostopRequest(cancel=True),
-                                       timeout=self.timeout)
+                                       timeout=self.timeout,
+            metadata=self._metadata)
         return reply.ok
 
     @staticmethod

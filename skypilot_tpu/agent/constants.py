@@ -60,3 +60,9 @@ AGENT_LOG = 'agent.log'
 RANK_LOG_FILE = 'rank-{rank}.log'
 MERGED_LOG_FILE = 'run.log'
 SETUP_LOG_FILE = 'setup.log'
+
+# Fixed port for worker agents on pod-network clusters (pods have unique
+# IPs; the head-side driver dials <podIP>:<port> Exec RPCs). Shared by the
+# backend (agent start) and the GKE provisioner (NetworkPolicy scoping
+# ingress on this port to the cluster's own pods).
+WORKER_AGENT_PORT = 46590

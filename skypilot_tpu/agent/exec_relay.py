@@ -15,6 +15,7 @@ from __future__ import annotations
 import argparse
 import base64
 import json
+import os
 import sys
 
 
@@ -26,7 +27,20 @@ def main() -> None:
     payload = json.loads(base64.b64decode(args.payload_b64))
 
     from skypilot_tpu.agent import client as client_lib
-    client = client_lib.AgentClient(args.address, timeout=30.0)
+    token = None
+    token_file = payload.get('token_file')
+    if token_file:
+        try:
+            with open(os.path.expanduser(token_file),
+                      encoding='utf-8') as f:
+                token = f.read().strip()
+        except OSError as e:
+            # Proceed tokenless (the agent will reject with
+            # UNAUTHENTICATED) but say WHY — an unreadable token file
+            # must not surface as an opaque rc=255.
+            print(f'[exec-relay] cannot read agent token file '
+                  f'{token_file}: {e}', file=sys.stderr)
+    client = client_lib.AgentClient(args.address, timeout=30.0, token=token)
     rc = 255
     try:
         for item in client.exec_stream(payload['command'],

@@ -14,6 +14,9 @@ from skypilot_tpu.schemas.generated import agent_pb2 as pb
 
 SERVICE = 'skytpu.agent.v1.Agent'
 
+# Metadata key carrying the shared cluster token (non-loopback agents).
+TOKEN_METADATA_KEY = 'skytpu-agent-token'
+
 # method name -> (is_server_streaming, request class, reply class)
 _METHODS = {
     'Health': (False, pb.HealthRequest, pb.HealthReply),

@@ -15,7 +15,7 @@ import json
 import os
 import time
 from concurrent import futures
-from typing import Iterator
+from typing import Iterator, Optional
 
 import grpc
 
@@ -243,14 +243,71 @@ def autostop_check_once(cluster_dir: str) -> bool:
     return True
 
 
-def serve(cluster_dir: str, port: int, host: str = '127.0.0.1'
-          ) -> grpc.Server:
+TOKEN_METADATA_KEY = rpc_lib.TOKEN_METADATA_KEY
+_LOOPBACK_HOSTS = ('127.0.0.1', 'localhost', '::1')
+
+
+class _TokenAuthInterceptor(grpc.ServerInterceptor):
+    """Require the cluster's shared agent token on every RPC.
+
+    Worker agents bind pod IPs (no sshd on pod networks), so without this
+    any peer with pod-network reachability could drive the streaming Exec
+    RPC — arbitrary command execution. The token is generated at bootstrap
+    and distributed over the same authenticated channel as the cluster SSH
+    key (``provision/instance_setup.push_agent_token``)."""
+
+    def __init__(self, token: str):
+        self._token = token
+
+    def intercept_service(self, continuation, handler_call_details):
+        import hmac
+        md = dict(handler_call_details.invocation_metadata or ())
+        if hmac.compare_digest(md.get(TOKEN_METADATA_KEY, ''), self._token):
+            return continuation(handler_call_details)
+        handler = continuation(handler_call_details)
+        if handler is None:
+            return None
+
+        def deny_unary(request, context):
+            del request
+            context.abort(grpc.StatusCode.UNAUTHENTICATED,
+                          'missing or bad agent token')
+
+        def deny_stream(request, context):
+            del request
+            context.abort(grpc.StatusCode.UNAUTHENTICATED,
+                          'missing or bad agent token')
+            yield  # pragma: no cover — abort raises
+
+        if handler.response_streaming:
+            return grpc.unary_stream_rpc_method_handler(
+                deny_stream,
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer)
+        return grpc.unary_unary_rpc_method_handler(
+            deny_unary,
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer)
+
+
+def serve(cluster_dir: str, port: int, host: str = '127.0.0.1',
+          token: Optional[str] = None) -> grpc.Server:
     """Start the agent server; returns the grpc.Server (caller owns it).
     127.0.0.1-only by default: remote clients come through an SSH tunnel
-    (the reference's security model, cloud_vm_ray_backend.py:2272-2443)."""
+    (the reference's security model, cloud_vm_ray_backend.py:2272-2443).
+    A non-loopback bind REQUIRES ``token``: the only reason to leave
+    loopback is the pod-network peer-exec path, and Exec is arbitrary
+    command execution."""
     import threading
 
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))
+    if host not in _LOOPBACK_HOSTS and not token:
+        raise ValueError(
+            f'agent rpc: refusing to bind {host} without an auth token — '
+            'a non-loopback agent exposes Exec (arbitrary command '
+            'execution) to the whole pod network. Pass --token-file.')
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=16),
+        interceptors=((_TokenAuthInterceptor(token),) if token else ()))
     rpc_lib.add_agent_servicer(server, AgentServicer(cluster_dir))
 
     def _autostop_loop(stop_event):  # 20s tick, like skylet events
@@ -285,8 +342,16 @@ def main() -> None:
     parser.add_argument('--port-file', default=None,
                         help='write the bound port here (cluster-unique '
                              'ports: clients read this file over SSH)')
+    parser.add_argument('--token-file', default=None,
+                        help='file holding the shared agent auth token; '
+                             'REQUIRED for non-loopback binds')
     args = parser.parse_args()
-    server = serve(args.cluster_dir, args.port, host=args.host)
+    token = None
+    if args.token_file:
+        with open(os.path.expanduser(args.token_file),
+                  encoding='utf-8') as f:
+            token = f.read().strip()
+    server = serve(args.cluster_dir, args.port, host=args.host, token=token)
     if args.port_file:
         with open(args.port_file, 'w', encoding='utf-8') as f:
             f.write(str(server.bound_port))

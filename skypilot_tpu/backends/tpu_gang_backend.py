@@ -171,9 +171,9 @@ class TpuGangBackend(Backend):
             return handle
         return None
 
-    # Fixed port for worker agents on pod-network clusters (pods have
-    # unique IPs; the head-side driver dials <podIP>:<port> Exec RPCs).
-    WORKER_AGENT_PORT = 46590
+    # Fixed port for worker agents on pod-network clusters (see
+    # agent/constants.py — shared with the GKE NetworkPolicy).
+    WORKER_AGENT_PORT = constants.WORKER_AGENT_PORT
 
     def _remote_control(self, handle: ClusterHandle) -> bool:
         """True when the cluster's control plane (job table, logs, gang
@@ -476,8 +476,14 @@ class TpuGangBackend(Backend):
         Exec RPC on pod networks (no sshd)."""
         from skypilot_tpu.agent import remote as remote_lib
         if handle.cloud == 'gke':
-            return RunnerSpec(kind='grpc', ip=inst.internal_ip,
-                              port=self.WORKER_AGENT_PORT)
+            # token_file is HEAD-relative: the driver runs on the head,
+            # which received the token at bootstrap (push_agent_token).
+            from skypilot_tpu.provision import instance_setup
+            return RunnerSpec(
+                kind='grpc', ip=inst.internal_ip,
+                port=self.WORKER_AGENT_PORT,
+                token_file=instance_setup.agent_token_path(
+                    handle.cluster_name))
         return RunnerSpec(kind='ssh', ip=inst.internal_ip,
                           user=info.ssh_user,
                           ssh_key=remote_lib.HEAD_CLUSTER_KEY)

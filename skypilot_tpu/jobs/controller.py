@@ -102,16 +102,29 @@ class JobController:
         backend, handle = self._backend_and_handle()
         if backend is None or not self._cluster_is_healthy():
             return None
-        # HeadUnreachableError (and rpc failures) propagate: a HEALTHY
-        # cluster whose agent merely failed to answer must NOT be treated
-        # as adoption-impossible — relaunching would duplicate the gang
-        # job. The controller fails (FAILED_CONTROLLER) and the watchdog
-        # retries once the head answers.
-        try:
-            jobs_list = backend.job_queue(handle)  # newest first
-        except exceptions.ClusterNotUpError:
-            return None  # genuinely stopped under us
-        return jobs_list[0]['job_id'] if jobs_list else None
+        # A HEALTHY cluster whose agent merely failed to answer must NOT
+        # be treated as adoption-impossible — relaunching would duplicate
+        # the gang job. A transient head blip must also not escape to
+        # run() and terminally FAIL_CONTROLLER a job whose gang is fine:
+        # retry with backoff while the provider keeps reporting the slice
+        # healthy, and only escalate after the retry budget.
+        delay = max(self.poll_seconds, 0.2)
+        deadline = time.time() + float(
+            os.environ.get('SKYTPU_ADOPTION_RETRY_S', '600'))
+        while True:
+            try:
+                jobs_list = backend.job_queue(handle)  # newest first
+            except exceptions.ClusterNotUpError:
+                return None  # genuinely stopped under us
+            except Exception:  # noqa: BLE001 — transient head/RPC blip
+                if time.time() >= deadline:
+                    raise  # run() records FAILED_CONTROLLER
+                if not self._cluster_is_healthy():
+                    return None  # died while we were retrying
+                time.sleep(delay)
+                delay = min(delay * 2, 30.0)
+                continue
+            return jobs_list[0]['job_id'] if jobs_list else None
 
     def _run_inner(self) -> state.ManagedJobStatus:
         job_id = self.job_id

@@ -78,18 +78,24 @@ def _reconcile_dead_controllers() -> None:
         if pid is None or _pid_alive(int(pid)):
             continue
         job_id = row['job_id']
-        restarts = state.bump_controller_restarts(job_id)
-        if restarts > max_controller_restarts():
+        # Budget check BEFORE any transition: an over-cap job goes
+        # ALIVE->DONE directly (no WAITING window a concurrent scheduler
+        # could promote past the cap). Under-cap jobs CAS ALIVE->WAITING
+        # first and bump after — only the sweeper that actually wins the
+        # flip consumes restart budget, so spurious sweeps racing a
+        # healthy controller (pid reuse / just reported in) burn nothing.
+        restarts_so_far = int(row.get('controller_restarts') or 0)
+        if restarts_so_far >= max_controller_restarts():
             if state.cas_schedule_state(job_id, [state.ScheduleState.ALIVE],
                                         state.ScheduleState.DONE):
                 state.set_status(
                     job_id, state.ManagedJobStatus.FAILED_CONTROLLER,
-                    detail=f'controller died {restarts} times; giving up')
+                    detail=f'controller died {restarts_so_far + 1} times; '
+                           'giving up')
             continue
-        # Back into the pool; the CAS keeps a racing healthy controller
-        # (pid reused / just reported in) authoritative.
-        state.cas_schedule_state(job_id, [state.ScheduleState.ALIVE],
-                                 state.ScheduleState.WAITING)
+        if state.cas_schedule_state(job_id, [state.ScheduleState.ALIVE],
+                                    state.ScheduleState.WAITING):
+            state.bump_controller_restarts(job_id)
 
 
 def _reconcile_stale_launching() -> None:

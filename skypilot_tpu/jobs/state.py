@@ -181,15 +181,19 @@ def bump_controller_restarts(job_id: int) -> int:
 
 def alive_controllers() -> List[Dict[str, Any]]:
     """Jobs whose schedule state says a controller is running (ALIVE):
-    (job_id, controller_pid, status) rows for the HA liveness sweep."""
+    (job_id, controller_pid, status, controller_restarts) rows for the HA
+    liveness sweep (restarts lets the sweeper budget-check BEFORE any
+    schedule-state transition)."""
     with _conn() as conn:
         rows = conn.execute(
-            'SELECT job_id, controller_pid, status FROM managed_jobs '
-            'WHERE schedule_state = ?',
+            'SELECT job_id, controller_pid, status, controller_restarts '
+            'FROM managed_jobs WHERE schedule_state = ?',
             (ScheduleState.ALIVE.value,)).fetchall()
         return [{'job_id': int(r['job_id']),
                  'controller_pid': r['controller_pid'],
-                 'status': ManagedJobStatus(r['status'])} for r in rows]
+                 'status': ManagedJobStatus(r['status']),
+                 'controller_restarts': int(r['controller_restarts'] or 0)}
+                for r in rows]
 
 
 def bump_recovery_count(job_id: int) -> int:

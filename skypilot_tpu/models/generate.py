@@ -124,10 +124,19 @@ def _cached_layer(cfg: llama.LlamaConfig, x: jax.Array, layer: Params,
         # MoE decode: same GShard dense-einsum dispatch as training
         # (models/moe.py) — at S=1 the "token" dim is just the batch, and
         # the static capacity keeps decode shapes compile-once. The aux
-        # loss is irrelevant at inference.
+        # loss is irrelevant at inference. Padded positions of a
+        # mixed-length batch are masked OUT of routing so their junk
+        # tokens never consume expert capacity (they could otherwise
+        # displace other rows' real tokens under the choice-major
+        # capacity cumsum).
+        if valid.ndim == 0:
+            token_mask = None  # uniform batch: every position is real
+        else:
+            token_mask = (positions < valid[:, None]).astype(h.dtype)
         mlp_out, _ = moe.moe_mlp(h, layer['moe'], cfg.num_experts,
                                  cfg.expert_top_k,
-                                 cfg.expert_capacity_factor)
+                                 cfg.expert_capacity_factor,
+                                 token_mask=token_mask)
         x = x + mlp_out
     else:
         gate = jnp.einsum('bsd,df->bsf', h, layer['w_gate'])

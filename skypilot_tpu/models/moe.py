@@ -67,11 +67,18 @@ def expert_capacity(num_tokens: int, num_experts: int, top_k: int,
 
 def moe_mlp(x: jax.Array, params: Params, num_experts: int, top_k: int,
             capacity_factor: float,
-            constrain=None) -> Tuple[jax.Array, jax.Array]:
+            constrain=None,
+            token_mask=None) -> Tuple[jax.Array, jax.Array]:
     """``x: [B, S, D] -> ([B, S, D], aux_loss)``.
 
     Dispatch priority is choice-major (all first choices across tokens beat
     any second choice), matching GShard's overflow semantics.
+
+    ``token_mask`` ([B, S], 1 = real token) excludes positions from routing
+    entirely: masked tokens consume NO expert capacity (they are dropped
+    before the capacity cumsum) and produce zero output. Serving batches
+    with right-padded rows must pass it, or junk padded positions compete
+    for capacity slots and can displace other rows' real tokens.
     """
     b, s, d = x.shape
     n = b * s
@@ -85,6 +92,10 @@ def moe_mlp(x: jax.Array, params: Params, num_experts: int, top_k: int,
     gate_vals = gate_vals / jnp.maximum(
         gate_vals.sum(-1, keepdims=True), 1e-9)
     choice_hot = jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)  # [N, K, E]
+    if token_mask is not None:
+        m = token_mask.reshape(n).astype(jnp.float32)
+        gate_vals = gate_vals * m[:, None]
+        choice_hot = choice_hot * m[:, None, None]
 
     # Position of each (token, choice) in its expert's buffer: cumulative
     # count in choice-major order.

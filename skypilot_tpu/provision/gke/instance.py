@@ -136,11 +136,66 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
             raise exceptions.QuotaExceededError(
                 f'GKE quota/capacity: {e}') from e
         raise
+    _ensure_agent_network_policy(client, config.cluster_name_on_cloud)
     return common.ProvisionRecord(
         provider_name='gke', region=config.region, zone=config.zone,
         cluster_name_on_cloud=config.cluster_name_on_cloud,
         head_instance_id=_pod_name(config.cluster_name_on_cloud, 0, 0),
         created_instance_ids=created, resumed_instance_ids=[])
+
+
+def _agent_policy_name(cluster: str) -> str:
+    return f'{cluster}-agent-policy'
+
+
+def _ensure_agent_network_policy(client: k8s_lib.K8sClient,
+                                 cluster: str) -> None:
+    """Restrict the worker-agent port to the cluster's own pods.
+
+    Defense-in-depth beside the shared-token auth: the agents' streaming
+    Exec RPC is arbitrary command execution, so ingress on
+    WORKER_AGENT_PORT is limited to pods carrying this cluster's label —
+    any other pod in the namespace (or cluster, absent a permissive CNI)
+    is dropped at the network layer. Best-effort: clusters without a
+    NetworkPolicy controller still get the token check."""
+    from skypilot_tpu.agent import constants as agent_constants
+    name = _agent_policy_name(cluster)
+    # NetworkPolicy cannot express "deny just this port", and ingress
+    # rules are OR'd — so the construction is: same-cluster pods may
+    # reach everything, while all other peers may reach every port
+    # EXCEPT the agent port (expressed as the two endPort ranges around
+    # it, k8s >=1.25). jax coordinator/user ports stay open; kubectl
+    # exec does not traverse the pod network.
+    body = {
+        'apiVersion': 'networking.k8s.io/v1',
+        'kind': 'NetworkPolicy',
+        'metadata': {
+            'name': name,
+            'labels': {LABEL_CLUSTER: cluster},
+        },
+        'spec': {
+            'podSelector': {'matchLabels': {LABEL_CLUSTER: cluster}},
+            'policyTypes': ['Ingress'],
+            'ingress': [
+                {'from': [{'podSelector': {
+                    'matchLabels': {LABEL_CLUSTER: cluster}}}]},
+                {'ports': [
+                    {'protocol': 'TCP', 'port': 1,
+                     'endPort': agent_constants.WORKER_AGENT_PORT - 1},
+                    {'protocol': 'TCP',
+                     'port': agent_constants.WORKER_AGENT_PORT + 1,
+                     'endPort': 65535},
+                ]},
+            ],
+        },
+    }
+    try:
+        existing = client.list_network_policies(f'{LABEL_CLUSTER}={cluster}')
+        if any(p['metadata']['name'] == name for p in existing):
+            return
+        client.create_network_policy(body)
+    except k8s_lib.K8sApiError:
+        pass  # no NetworkPolicy support: token auth still enforces
 
 
 def _ns_of(provider_config: Optional[Dict[str, Any]]) -> Optional[str]:
@@ -189,6 +244,11 @@ def _cleanup(client: k8s_lib.K8sClient, cluster_name_on_cloud: str) -> None:
             client.delete_pod(pod['metadata']['name'])
         except k8s_lib.K8sApiError:
             pass
+    try:
+        client.delete_network_policy(
+            _agent_policy_name(cluster_name_on_cloud))
+    except k8s_lib.K8sApiError:
+        pass
 
 
 def stop_instances(cluster_name_on_cloud: str,

@@ -140,6 +140,25 @@ class K8sClient:
         return self.transport.request('PUT', f'{self._services()}/{name}',
                                       body=body)
 
+    def _network_policies(self) -> str:
+        return (f'/apis/networking.k8s.io/v1/namespaces/{self.namespace}'
+                '/networkpolicies')
+
+    def create_network_policy(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        return self.transport.request('POST', self._network_policies(),
+                                      body=body)
+
+    def list_network_policies(self, label_selector: Optional[str] = None
+                              ) -> List[Dict[str, Any]]:
+        params = {'labelSelector': label_selector} if label_selector else None
+        out = self.transport.request('GET', self._network_policies(),
+                                     params=params)
+        return out.get('items', [])
+
+    def delete_network_policy(self, name: str) -> Dict[str, Any]:
+        return self.transport.request(
+            'DELETE', f'{self._network_policies()}/{name}')
+
     def pod_events(self, name: str) -> List[Dict[str, Any]]:
         out = self.transport.request(
             'GET', f'/api/v1/namespaces/{self.namespace}/events',

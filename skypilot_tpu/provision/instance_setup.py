@@ -128,19 +128,56 @@ def start_agent_on_head(head_runner: CommandRunner, cluster_name: str,
             f'Starting the cluster agent on the head failed (rc={rc})')
 
 
+def agent_token_path(cluster_name: str) -> str:
+    """Where the shared agent auth token lives on every node (head reads
+    it to authenticate to worker agents; workers enforce it)."""
+    return f'{REMOTE_RUNTIME_DIR}/clusters/{cluster_name}/token/agent.token'
+
+
+def push_agent_token(runners: Sequence[CommandRunner],
+                     cluster_name: str) -> None:
+    """Generate the cluster's shared agent token and install it on every
+    node, over the same authenticated channel as the cluster SSH key.
+    Non-loopback worker agents reject RPCs without it (the streaming Exec
+    RPC is arbitrary command execution — it must not be reachable by any
+    peer with mere pod-network connectivity). Staged through a DEDICATED
+    ``token/`` subdir (like the key push's ``keys/``): runners rsync whole
+    directories with mirror semantics, so syncing onto the live cluster
+    dir would wipe the head agent's port file and job table."""
+    import secrets
+    import tempfile
+
+    token = secrets.token_hex(32)
+    token_dir = f'{REMOTE_RUNTIME_DIR}/clusters/{cluster_name}/token'
+    with tempfile.TemporaryDirectory(prefix='skytpu-token-') as td:
+        path = os.path.join(td, 'agent.token')
+        with open(path, 'w', encoding='utf-8') as f:
+            f.write(token)
+        os.chmod(path, 0o600)
+        for runner in runners:
+            runner.rsync(td, token_dir, up=True)
+            runner.run(f'chmod 700 {token_dir} && '
+                       f'chmod 600 {agent_token_path(cluster_name)}')
+
+
 def start_worker_agents(runners: Sequence[CommandRunner], cluster_name: str,
                         port: int, python: str = 'python3') -> None:
     """Start an agent on EVERY worker at a fixed port (pods have unique
     IPs, so one well-known port works). This is the gang driver's peer
     transport where no sshd exists: the head-side driver reaches workers
-    through their agents' Exec RPC (``agent/exec_relay.py``)."""
+    through their agents' Exec RPC (``agent/exec_relay.py``). The agents
+    require the bootstrap-pushed token (``push_agent_token``) on every
+    RPC — without it a non-loopback agent would hand arbitrary command
+    execution to the whole pod network."""
 
     def _start_one(idx_runner) -> None:
         idx, runner = idx_runner
         pidfile = f'{REMOTE_RUNTIME_DIR}/agent-{cluster_name}-w{idx}.pid'
         cluster_dir = f'{REMOTE_RUNTIME_DIR}/clusters/{cluster_name}'
         rc = runner.run(_agent_start_cmd(
-            pidfile, cluster_dir, f'--port {port} --host 0.0.0.0', python))
+            pidfile, cluster_dir,
+            f'--port {port} --host 0.0.0.0 '
+            f'--token-file {cluster_dir}/token/agent.token', python))
         if rc != 0:
             raise exceptions.ClusterNotUpError(
                 f'Starting the worker agent failed on worker {idx} '
@@ -187,6 +224,9 @@ def bootstrap_cluster(cluster_name: str, info: common.ClusterInfo,
         push_cluster_key_to_head(runners[0], key_path)
         start_agent_on_head(runners[0], cluster_name, python=python)
         if worker_agents_port is not None and len(runners) > 1:
+            # Token to ALL nodes (the head-side driver reads it to dial
+            # the workers), then start the enforcing worker agents.
+            push_agent_token(runners, cluster_name)
             start_worker_agents(runners[1:], cluster_name,
                                 worker_agents_port, python=python)
     # Optional external log shipping (logs.store in config; reference:

@@ -129,15 +129,19 @@ def reconcile_controllers() -> List[str]:
                 continue
         elif common_utils.pid_alive(int(pid)):
             continue  # healthy
-        restarts = serve_state.bump_controller_restarts(svc['name'])
+        # Atomic claim BEFORE launching: the CAS only succeeds for the
+        # sweeper that observed the current (dead pid | stale claim)
+        # state, so concurrent sweepers (direct reconcile + background
+        # watchdog) cannot both launch and stack duplicate controllers.
+        restarts = serve_state.claim_restart(
+            svc['name'], int(pid) if pid else None,
+            svc.get('controller_claim_at'))
+        if restarts is None:
+            continue  # another sweeper won the claim — nothing to do
         if restarts > max_restarts:
             serve_state.set_service_status(
                 svc['name'], serve_state.ServiceStatus.FAILED)
             continue
-        # Claim BEFORE launching: ticks between now and the new
-        # controller's pid report must not re-detect the dead pid and
-        # stack duplicate controllers.
-        serve_state.set_controller_pid(svc['name'], None)
         try:
             controller_utils.launch_controller_task(
                 'skypilot_tpu.serve.controller',

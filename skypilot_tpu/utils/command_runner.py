@@ -44,6 +44,7 @@ class RunnerSpec:
     ssh_key: Optional[str] = None
     port: int = 22  # ssh port; for grpc: the worker agent's port
     namespace: str = 'default'  # k8s only
+    token_file: Optional[str] = None  # grpc only: shared agent auth token
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -61,7 +62,8 @@ class RunnerSpec:
         if self.kind == 'k8s':
             return KubectlCommandRunner(self.ip, self.namespace)
         if self.kind == 'grpc':
-            return GrpcCommandRunner(self.ip, self.port)
+            return GrpcCommandRunner(self.ip, self.port,
+                                     token_file=self.token_file)
         raise ValueError(f'Unknown runner kind {self.kind!r}')
 
 
@@ -236,9 +238,11 @@ class GrpcCommandRunner(CommandRunner):
     returns an ``exec_relay`` invocation, a plain local process the gang
     supervisor can spawn/kill, whose exit code is the remote one."""
 
-    def __init__(self, host: str, agent_port: int):
+    def __init__(self, host: str, agent_port: int,
+                 token_file: Optional[str] = None):
         self.ip = host
         self.agent_port = agent_port
+        self.token_file = token_file
 
     @property
     def address(self) -> str:
@@ -248,8 +252,13 @@ class GrpcCommandRunner(CommandRunner):
         import base64
         import json
         import sys as sys_lib
+        # The payload carries the token file PATH, not the token: argv is
+        # world-readable via /proc/<pid>/cmdline, and the token grants
+        # command execution on every worker (same rule as the cluster
+        # key, push_cluster_key_to_head). The relay reads the file.
         payload = base64.b64encode(json.dumps({
             'command': cmd, 'env': env or {}, 'cwd': cwd,
+            'token_file': self.token_file,
         }).encode('utf-8')).decode('ascii')
         return [sys_lib.executable, '-m', 'skypilot_tpu.agent.exec_relay',
                 '--address', self.address, '--payload-b64', payload]

@@ -270,3 +270,49 @@ def test_llm_server_split_fitting_unit():
     c = P(10, 8)
     d = P(12, 6)
     assert server._split_fitting([c, d]) == [[c, d]]  # fits together
+
+
+def test_moe_padded_mixed_length_batch_matches_individual(tiny_moe):
+    """MoE variant of the padded-batch contract (ADVICE r2: junk padded
+    positions must be masked out of routing, or they compete for expert
+    capacity and can displace other rows' real tokens)."""
+    cfg, params = tiny_moe
+    key = jax.random.PRNGKey(31)
+    rows = [
+        jax.random.randint(jax.random.fold_in(key, i), (n,), 0,
+                           cfg.vocab_size).tolist()
+        for i, n in enumerate([3, 7, 4])
+    ]
+    padded, lens = generate.pad_prompts(rows)
+    got = generate.generate(params, cfg, padded, max_new_tokens=5,
+                            prompt_lengths=lens, max_len=32)
+    for i, row in enumerate(rows):
+        solo = generate.generate(
+            params, cfg, jnp.asarray([row], jnp.int32), max_new_tokens=5,
+            max_len=32)
+        np.testing.assert_array_equal(np.asarray(got[i]),
+                                      np.asarray(solo[0]),
+                                      err_msg=f'row {i} (len {len(row)})')
+
+
+def test_moe_token_mask_isolates_real_tokens_from_junk():
+    """Under TIGHT capacity, masked junk must (a) produce zero output,
+    (b) consume no expert capacity — so the real tokens' outputs are
+    bit-identical no matter what garbage sits in the padded tail."""
+    from skypilot_tpu.models import moe
+    d, e = 8, 2
+    params = moe.init_moe_params(jax.random.PRNGKey(0), d, 16, e,
+                                 jnp.float32)
+    key = jax.random.PRNGKey(1)
+    real = jax.random.normal(key, (1, 4, d))
+    junk_a = jax.random.normal(jax.random.fold_in(key, 1), (1, 4, d)) * 10
+    junk_b = jax.random.normal(jax.random.fold_in(key, 2), (1, 4, d)) * -7
+    mask = jnp.concatenate([jnp.ones((1, 4)), jnp.zeros((1, 4))], axis=1)
+    out_a, _ = moe.moe_mlp(jnp.concatenate([real, junk_a], axis=1), params,
+                           e, 1, 1.0, token_mask=mask)
+    out_b, _ = moe.moe_mlp(jnp.concatenate([real, junk_b], axis=1), params,
+                           e, 1, 1.0, token_mask=mask)
+    np.testing.assert_array_equal(np.asarray(out_a[:, :4]),
+                                  np.asarray(out_b[:, :4]))
+    np.testing.assert_array_equal(np.asarray(out_a[:, 4:]),
+                                  np.zeros((1, 4, d), np.float32))

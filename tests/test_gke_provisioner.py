@@ -20,10 +20,28 @@ class FakeK8sApi:
     def __init__(self):
         self.pods = {}  # name -> pod dict
         self.services = {}  # name -> service dict
+        self.network_policies = {}  # name -> policy dict
         self.schedulable = True
         self.quota_error = False
         self.calls = []
         self._ip = 0
+
+    def _handle_netpol(self, method, name, body, params):
+        if method == 'POST':
+            self.network_policies[body['metadata']['name']] = dict(body)
+            return body
+        if method == 'GET' and name is None:
+            sel = (params or {}).get('labelSelector', '')
+            items = list(self.network_policies.values())
+            if sel:
+                k, v = sel.split('=', 1)
+                items = [p for p in items
+                         if p['metadata'].get('labels', {}).get(k) == v]
+            return {'items': items}
+        if method == 'DELETE':
+            self.network_policies.pop(name, None)
+            return {}
+        raise AssertionError(f'unhandled netpol {method} {name}')
 
     def _handle_services(self, method, name, body, params):
         if method == 'POST':
@@ -64,6 +82,12 @@ class FakeK8sApi:
         if ms:
             return self._handle_services(method, ms.group('name'), body,
                                          params)
+        mn = re.match(
+            r'/apis/networking.k8s.io/v1/namespaces/(?P<ns>[^/]+)'
+            r'/networkpolicies(/(?P<name>.+))?$', path)
+        if mn:
+            return self._handle_netpol(method, mn.group('name'), body,
+                                       params)
         m = re.match(r'/api/v1/namespaces/(?P<ns>[^/]+)/pods(/(?P<name>.+))?$',
                      path)
         assert m, path
@@ -245,3 +269,32 @@ def test_open_ports_nodeport_type(fake_k8s, monkeypatch):
     gke_instance.run_instances(_cfg())
     gke_instance.open_ports('g-abc', [8080])
     assert fake_k8s.services['g-abc-svc']['spec']['type'] == 'NodePort'
+
+
+def test_agent_network_policy_fences_exec_port(fake_k8s):
+    """Provisioning installs a NetworkPolicy that keeps the worker-agent
+    Exec port reachable only from the cluster's own pods (ADVICE r2
+    high: 0.0.0.0-bound agents must not expose command execution to the
+    whole pod network)."""
+    from skypilot_tpu.agent import constants as agent_constants
+    gke_instance.run_instances(_cfg())
+    pol = fake_k8s.network_policies['g-abc-agent-policy']
+    spec = pol['spec']
+    assert spec['podSelector'] == {
+        'matchLabels': {gke_instance.LABEL_CLUSTER: 'g-abc'}}
+    assert spec['policyTypes'] == ['Ingress']
+    same_cluster, others = spec['ingress']
+    assert same_cluster['from'][0]['podSelector']['matchLabels'] == {
+        gke_instance.LABEL_CLUSTER: 'g-abc'}
+    # The catch-all rule must exclude exactly the agent port.
+    covered = set()
+    for p in others['ports']:
+        covered.update(range(p['port'], p['endPort'] + 1))
+    assert agent_constants.WORKER_AGENT_PORT not in covered
+    assert agent_constants.WORKER_AGENT_PORT - 1 in covered
+    assert agent_constants.WORKER_AGENT_PORT + 1 in covered
+    # Idempotent re-provision; torn down with the cluster.
+    gke_instance.run_instances(_cfg())
+    assert len(fake_k8s.network_policies) == 1
+    gke_instance.terminate_instances('g-abc')
+    assert not fake_k8s.network_policies

@@ -147,3 +147,67 @@ def test_gke_peers_use_grpc_runners():
     assert spec.port == TpuGangBackend.WORKER_AGENT_PORT
     # GKE is remote-controlled now (driver-on-head over the pod agents).
     assert backend.is_remote_controlled(handle)
+
+
+# --- agent token auth (ADVICE r2 high) -------------------------------------
+
+
+def test_non_loopback_bind_requires_token(tmp_path):
+    """An agent must refuse to expose Exec (arbitrary command execution)
+    beyond loopback without an auth token."""
+    with pytest.raises(ValueError, match='token'):
+        rpc_server.serve(str(tmp_path), port=0, host='0.0.0.0')
+
+
+def test_token_enforced_on_all_rpcs(tmp_path):
+    import grpc
+    server = rpc_server.serve(str(tmp_path), port=0, host='127.0.0.1',
+                              token='sekrit')
+    addr = f'127.0.0.1:{server.bound_port}'
+    try:
+        # No token: unary and streaming RPCs are both rejected.
+        bare = client_lib.AgentClient(addr)
+        with pytest.raises(grpc.RpcError) as err:
+            bare.health()
+        assert err.value.code() == grpc.StatusCode.UNAUTHENTICATED
+        with pytest.raises(grpc.RpcError) as err:
+            bare.exec_command('echo leak')
+        assert err.value.code() == grpc.StatusCode.UNAUTHENTICATED
+        bare.close()
+        # Wrong token: rejected.
+        wrong = client_lib.AgentClient(addr, token='wrong')
+        with pytest.raises(grpc.RpcError) as err:
+            wrong.health()
+        assert err.value.code() == grpc.StatusCode.UNAUTHENTICATED
+        wrong.close()
+        # Right token: full round trip including the Exec stream.
+        good = client_lib.AgentClient(addr, token='sekrit')
+        assert good.health()['uptime_s'] >= 0
+        rc, out = good.exec_command('echo authed; exit 4')
+        assert rc == 4 and b'authed' in out
+        good.close()
+    finally:
+        server.stop(0)
+
+
+def test_gang_over_authed_grpc_runners(tmp_path):
+    """The head->worker relay path carries the bootstrap token end to end
+    (RunnerSpec.token_file -> relay payload -> client metadata)."""
+    token_file = tmp_path / 'agent.token'
+    token_file.write_text('gang-tok')
+    home = str(tmp_path / 'pod1')
+    os.makedirs(home, exist_ok=True)
+    server = rpc_server.serve(home, port=0, host='127.0.0.1',
+                              token='gang-tok')
+    try:
+        spec = RunnerSpec(kind='grpc', ip='127.0.0.1',
+                          port=server.bound_port,
+                          token_file=str(token_file))
+        runner = spec.make()
+        assert runner.run('true') == 0
+        # Without the token the same agent refuses the relay.
+        bare = RunnerSpec(kind='grpc', ip='127.0.0.1',
+                          port=server.bound_port).make()
+        assert bare.run('true') != 0
+    finally:
+        server.stop(0)
