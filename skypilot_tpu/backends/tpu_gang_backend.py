@@ -145,6 +145,7 @@ class TpuGangBackend(Backend):
                 is_tpu=to_provision.tpu is not None,
                 price_per_hour=to_provision.price_per_hour,
                 provider_config={
+                    'region': region,
                     'zone': zone,
                     'namespace': deploy_vars.get('namespace'),
                 })

@@ -1,0 +1,85 @@
+"""AWS catalog queries: EC2 CPU VMs.
+
+Reference analog: ``sky/catalog/aws_catalog.py`` — lazy CSV frames with
+price/zone filtering. AWS carries no TPUs; this catalog exists so
+controllers, CPU tasks, and storage-adjacent work can land on EC2 and the
+optimizer can fail over GCP<->AWS (the cross-cloud pitch the reference's
+25-provider catalog serves).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import pandas as pd
+
+from skypilot_tpu.catalog import common
+
+_vm_df = common.LazyDataFrame('aws/vms.csv')
+
+
+def get_instance_type_for_cpus(
+        cpus: Optional[float], cpus_at_least: bool,
+        memory: Optional[float], memory_at_least: bool,
+        region: Optional[str] = None,
+        use_spot: bool = False) -> Optional[dict]:
+    """Smallest/cheapest VM satisfying a cpus/memory request (defaults to
+    4+ vCPUs when unspecified, mirroring ``gcp_catalog``)."""
+    df = _vm_df.df
+    if region:
+        df = df[df['Region'] == region]
+    want_cpus = cpus if cpus is not None else 4.0
+    if cpus_at_least or cpus is None:
+        df = df[df['vCPUs'] >= want_cpus]
+    else:
+        df = df[df['vCPUs'] == want_cpus]
+    if memory is not None:
+        if memory_at_least:
+            df = df[df['MemoryGiB'] >= memory]
+        else:
+            df = df[df['MemoryGiB'] == memory]
+    row = common.cheapest_row(df, use_spot)
+    return None if row is None else row.to_dict()
+
+
+def get_vm_offerings(instance_type: str, region: Optional[str] = None,
+                     zone: Optional[str] = None,
+                     use_spot: bool = False) -> List[dict]:
+    df = common.filter_df(_vm_df.df, InstanceType=instance_type,
+                          Region=region, AvailabilityZone=zone)
+    col = 'SpotPrice' if use_spot else 'Price'
+    df = df[df[col].notna()].sort_values(col)
+    return df.to_dict('records')
+
+
+def instance_type_exists(instance_type: str) -> bool:
+    return bool((_vm_df.df['InstanceType'] == instance_type).any())
+
+
+def get_vcpus_mem_from_instance_type(
+        instance_type: str) -> Tuple[Optional[float], Optional[float]]:
+    rows = _vm_df.df[_vm_df.df['InstanceType'] == instance_type]
+    if rows.empty:
+        return None, None
+    r = rows.iloc[0]
+    return float(r['vCPUs']), float(r['MemoryGiB'])
+
+
+def validate_region_zone(
+        region: Optional[str],
+        zone: Optional[str]) -> Tuple[Optional[str], Optional[str]]:
+    df = _vm_df.df[['Region', 'AvailabilityZone']]
+    if region is not None and not (df['Region'] == region).any():
+        raise ValueError(f'Unknown AWS region {region!r}')
+    if zone is not None:
+        rows = df[df['AvailabilityZone'] == zone]
+        if rows.empty:
+            raise ValueError(f'Unknown AWS zone {zone!r}')
+        zone_region = rows.iloc[0]['Region']
+        if region is not None and zone_region != region:
+            raise ValueError(f'Zone {zone!r} not in region {region!r}')
+        return zone_region, zone
+    return region, zone
+
+
+def regions() -> pd.DataFrame:
+    return _vm_df.df[['Region', 'AvailabilityZone']].drop_duplicates()

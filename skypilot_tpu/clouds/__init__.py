@@ -1,4 +1,5 @@
 """Clouds package. Importing it registers all built-in clouds."""
+from skypilot_tpu.clouds.aws import AWS
 from skypilot_tpu.clouds.cloud import Cloud, CloudImplementationFeatures, Region
 from skypilot_tpu.clouds.fake import Fake
 from skypilot_tpu.clouds.gcp import GCP
@@ -7,5 +8,5 @@ from skypilot_tpu.clouds.local import Local
 from skypilot_tpu.clouds.slurm import Slurm
 from skypilot_tpu.clouds.ssh import Ssh
 
-__all__ = ['Cloud', 'CloudImplementationFeatures', 'Region', 'GCP', 'GKE',
-           'Local', 'Fake', 'Ssh', 'Slurm']
+__all__ = ['AWS', 'Cloud', 'CloudImplementationFeatures', 'Region', 'GCP',
+           'GKE', 'Local', 'Fake', 'Ssh', 'Slurm']

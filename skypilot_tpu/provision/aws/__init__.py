@@ -1,0 +1,1 @@
+"""AWS EC2 provisioner package (first non-GCP compute provider)."""

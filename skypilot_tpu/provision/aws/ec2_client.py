@@ -1,0 +1,251 @@
+"""Minimal AWS EC2 client over the Query API (dependency-free).
+
+Reference analog: ``sky/provision/aws/instance.py`` drives EC2 through
+boto3, which is not in this image; the EC2 Query API is form-encoded
+requests signed with SigV4 (shared with the S3 client,
+``data/aws_sigv4.py``) and XML responses. Same injectable-transport
+pattern as ``provision/gcp/tpu_client.py`` so the provisioner is
+unit-testable with a fake transport.
+
+Actions used: RunInstances, DescribeInstances, TerminateInstances,
+StopInstances, StartInstances, AuthorizeSecurityGroupIngress.
+"""
+from __future__ import annotations
+
+import configparser
+import os
+import xml.etree.ElementTree as ET
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+EC2_API_VERSION = '2016-11-15'
+
+# EC2 error codes meaning "no capacity/quota here, try elsewhere" — the
+# failover loop turns these into a (region) blocklist entry, the same
+# role GCP stockout codes play (provision/gcp/tpu_client.py).
+STOCKOUT_CODES = (
+    'InsufficientInstanceCapacity', 'InstanceLimitExceeded',
+    'MaxSpotInstanceCountExceeded', 'SpotMaxPriceTooLow',
+    'Unsupported', 'VcpuLimitExceeded',
+)
+
+
+class AwsApiError(exceptions.SkyTpuError):
+
+    def __init__(self, status_code: int, code: str, message: str):
+        self.status_code = status_code
+        self.code = code
+        self.message = message
+        super().__init__(f'AWS API error {code} ({status_code}): '
+                         f'{message[:500]}')
+
+    def is_stockout(self) -> bool:
+        return self.code in STOCKOUT_CODES
+
+
+def load_credentials() -> Tuple[str, str]:
+    """Access key pair from env or ``~/.aws/credentials`` (same sources as
+    the S3 store, ``data/storage.py``)."""
+    access = os.environ.get('AWS_ACCESS_KEY_ID')
+    secret = os.environ.get('AWS_SECRET_ACCESS_KEY')
+    if access and secret:
+        return access, secret
+    path = os.path.expanduser(
+        os.environ.get('AWS_SHARED_CREDENTIALS_FILE', '~/.aws/credentials'))
+    if os.path.exists(path):
+        cp = configparser.ConfigParser()
+        cp.read(path)
+        profile = os.environ.get('AWS_PROFILE', 'default')
+        if cp.has_section(profile):
+            sec = cp[profile]
+            access = sec.get('aws_access_key_id')
+            secret = sec.get('aws_secret_access_key')
+            if access and secret:
+                return access, secret
+    raise exceptions.NoCloudAccessError(
+        'AWS credentials not found: set AWS_ACCESS_KEY_ID / '
+        'AWS_SECRET_ACCESS_KEY or populate ~/.aws/credentials.')
+
+
+def _strip_ns(tag: str) -> str:
+    return tag.rsplit('}', 1)[-1]
+
+
+def _xml_to_obj(el: ET.Element) -> Any:
+    """EC2 XML -> python: ``item`` children collapse to lists, leaves to
+    strings."""
+    children = list(el)
+    if not children:
+        return el.text or ''
+    names = [_strip_ns(c.tag) for c in children]
+    if all(n == 'item' for n in names):
+        return [_xml_to_obj(c) for c in children]
+    out: Dict[str, Any] = {}
+    for name, child in zip(names, children):
+        out[name] = _xml_to_obj(child)
+    return out
+
+
+class Ec2Transport:
+    """Signed HTTP transport to one region; replaced by a fake in tests.
+
+    ``request(action, params)`` returns the parsed response body (dict)."""
+
+    def __init__(self, region: str):
+        self.region = region
+        self.host = f'ec2.{region}.amazonaws.com'
+
+    def request(self, action: str, params: Dict[str, str]) -> Dict[str, Any]:
+        import requests
+
+        from skypilot_tpu.data import aws_sigv4
+
+        access, secret = load_credentials()
+        form = {'Action': action, 'Version': EC2_API_VERSION, **params}
+        body = '&'.join(
+            f'{aws_sigv4.quote(str(k), safe="-_.~")}='
+            f'{aws_sigv4.quote(str(v), safe="-_.~")}'
+            for k, v in sorted(form.items())).encode('utf-8')
+        headers = aws_sigv4.sign_request(
+            'POST', self.host, '/', {}, {
+                'content-type': 'application/x-www-form-urlencoded; '
+                                'charset=utf-8'},
+            body, access, secret, self.region, service='ec2',
+            sign_payload_header=False)
+        resp = requests.post(f'https://{self.host}/', headers=headers,
+                             data=body, timeout=60)
+        try:
+            root = ET.fromstring(resp.text) if resp.text else None
+        except ET.ParseError:
+            # Non-XML body (LB/proxy error page): still surface as an
+            # AwsApiError so the provisioner's rollback/failover handlers
+            # fire instead of a raw ParseError escaping them.
+            root = None
+        if resp.status_code >= 400:
+            code, message = 'Unknown', resp.text[:500]
+            if root is not None:
+                err = root.find('.//{*}Error')
+                if err is not None:
+                    code = err.findtext('{*}Code', 'Unknown')
+                    message = err.findtext('{*}Message', '')
+            raise AwsApiError(resp.status_code, code, message)
+        if root is None:
+            return {}
+        obj = _xml_to_obj(root)
+        return obj if isinstance(obj, dict) else {'items': obj}
+
+
+def _flatten_filters(filters: Dict[str, List[str]]) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for i, (name, values) in enumerate(sorted(filters.items()), start=1):
+        out[f'Filter.{i}.Name'] = name
+        for j, v in enumerate(values, start=1):
+            out[f'Filter.{i}.Value.{j}'] = v
+    return out
+
+
+def _flatten_tags(prefix: str, tags: Dict[str, str]) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for i, (k, v) in enumerate(sorted(tags.items()), start=1):
+        out[f'{prefix}.Tag.{i}.Key'] = k
+        out[f'{prefix}.Tag.{i}.Value'] = v
+    return out
+
+
+class Ec2Client:
+
+    def __init__(self, region: str,
+                 transport: Optional[Ec2Transport] = None):
+        self.region = region
+        self.transport = transport or Ec2Transport(region)
+
+    # -- instances ----------------------------------------------------------
+
+    def run_instances(self, *, count: int, instance_type: str, image_id: str,
+                      user_data_b64: Optional[str] = None,
+                      disk_size_gb: int = 100,
+                      spot: bool = False,
+                      security_group_ids: Optional[List[str]] = None,
+                      tags: Optional[Dict[str, str]] = None,
+                      zone: Optional[str] = None) -> List[Dict[str, Any]]:
+        """Launch ``count`` instances atomically (EC2 RunInstances is
+        all-or-nothing for MinCount == MaxCount). Returns instance dicts."""
+        params: Dict[str, str] = {
+            'MinCount': str(count), 'MaxCount': str(count),
+            'InstanceType': instance_type, 'ImageId': image_id,
+            'TagSpecification.1.ResourceType': 'instance',
+            'BlockDeviceMapping.1.DeviceName': '/dev/sda1',
+            'BlockDeviceMapping.1.Ebs.VolumeSize': str(disk_size_gb),
+            'BlockDeviceMapping.1.Ebs.VolumeType': 'gp3',
+            'BlockDeviceMapping.1.Ebs.DeleteOnTermination': 'true',
+        }
+        params.update(_flatten_tags('TagSpecification.1', tags or {}))
+        if user_data_b64:
+            params['UserData'] = user_data_b64
+        if zone:
+            params['Placement.AvailabilityZone'] = zone
+        if spot:
+            # One-time requests: a persistent request would re-open on
+            # terminate and relaunch instances nothing tracks. The
+            # provider-authoritative preemption detector treats a missing
+            # instance as preempted, so terminate-on-interruption is the
+            # correct contract (managed jobs recover by relaunching).
+            params['InstanceMarketOptions.MarketType'] = 'spot'
+            params['InstanceMarketOptions.SpotOptions.'
+                   'InstanceInterruptionBehavior'] = 'terminate'
+            params['InstanceMarketOptions.SpotOptions.'
+                   'SpotInstanceType'] = 'one-time'
+        for i, sg in enumerate(security_group_ids or [], start=1):
+            params[f'SecurityGroupId.{i}'] = sg
+        out = self.transport.request('RunInstances', params)
+        instances = out.get('instancesSet') or []
+        return instances if isinstance(instances, list) else [instances]
+
+    def describe_instances(self, filters: Dict[str, List[str]]
+                           ) -> List[Dict[str, Any]]:
+        out = self.transport.request('DescribeInstances',
+                                     _flatten_filters(filters))
+        reservations = out.get('reservationSet') or []
+        if isinstance(reservations, dict):
+            reservations = [reservations]
+        instances: List[Dict[str, Any]] = []
+        for r in reservations:
+            items = r.get('instancesSet') or []
+            instances.extend(items if isinstance(items, list) else [items])
+        return instances
+
+    def _instance_ids_params(self, ids: List[str]) -> Dict[str, str]:
+        return {f'InstanceId.{i}': iid
+                for i, iid in enumerate(ids, start=1)}
+
+    def terminate_instances(self, ids: List[str]) -> None:
+        if ids:
+            self.transport.request('TerminateInstances',
+                                   self._instance_ids_params(ids))
+
+    def stop_instances(self, ids: List[str]) -> None:
+        if ids:
+            self.transport.request('StopInstances',
+                                   self._instance_ids_params(ids))
+
+    def start_instances(self, ids: List[str]) -> None:
+        if ids:
+            self.transport.request('StartInstances',
+                                   self._instance_ids_params(ids))
+
+    # -- security groups (open_ports) ---------------------------------------
+
+    def authorize_ingress(self, group_id: str, port: int,
+                          cidr: str = '0.0.0.0/0') -> None:
+        try:
+            self.transport.request('AuthorizeSecurityGroupIngress', {
+                'GroupId': group_id,
+                'IpPermissions.1.IpProtocol': 'tcp',
+                'IpPermissions.1.FromPort': str(port),
+                'IpPermissions.1.ToPort': str(port),
+                'IpPermissions.1.IpRanges.1.CidrIp': cidr,
+            })
+        except AwsApiError as e:
+            if e.code != 'InvalidPermission.Duplicate':
+                raise

@@ -1,0 +1,302 @@
+"""AWS EC2 provisioner (uniform provision interface).
+
+Reference analog: ``sky/provision/aws/instance.py`` (``run_instances``,
+``get_cluster_info``, tag-based cluster membership via
+``Name``/cluster tags) — re-based on the dependency-free Query API client
+(``ec2_client.py``) instead of boto3.
+
+Identity model: instances carry tags ``skytpu-cluster`` (cluster name on
+cloud) and ``skytpu-node`` (node index); EC2 assigns opaque instance ids,
+so every lifecycle op filters by tag. Capacity errors
+(InsufficientInstanceCapacity & friends) map to QuotaExceededError so the
+backend's failover loop can move to the next region/cloud — the same
+stockout contract as the GCP provisioners.
+"""
+from __future__ import annotations
+
+import base64
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import authentication
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.aws import ec2_client as ec2_lib
+
+TAG_CLUSTER = 'skytpu-cluster'
+TAG_NODE = 'skytpu-node'
+
+_clients: Dict[str, ec2_lib.Ec2Client] = {}
+
+
+def _client(region: str) -> ec2_lib.Ec2Client:
+    if region not in _clients:
+        _clients[region] = ec2_lib.Ec2Client(region)
+    return _clients[region]
+
+
+def set_client_for_testing(client: ec2_lib.Ec2Client) -> None:
+    _clients[client.region] = client
+
+
+def default_ssh_user() -> str:
+    return os.environ.get('SKYTPU_AWS_SSH_USER', 'ubuntu')
+
+
+def _default_image() -> Optional[str]:
+    return config_lib.get_nested(('aws', 'image_id'),
+                                 os.environ.get('SKYTPU_AWS_DEFAULT_AMI'))
+
+
+def _user_data() -> str:
+    """Cloud-init shell script installing the framework SSH key for the
+    AMI's login user (the EC2 analog of GCP's ssh-keys metadata)."""
+    _, pubkey = authentication.get_or_create_ssh_keypair()
+    pubkey = pubkey.strip()
+    user = default_ssh_user()
+    script = f'''#!/bin/bash
+install -d -m 700 -o {user} -g {user} /home/{user}/.ssh
+echo '{pubkey}' >> /home/{user}/.ssh/authorized_keys
+chown {user}:{user} /home/{user}/.ssh/authorized_keys
+chmod 600 /home/{user}/.ssh/authorized_keys
+'''
+    return base64.b64encode(script.encode('utf-8')).decode('ascii')
+
+
+def _cluster_filter(cluster_name_on_cloud: str,
+                    states: Optional[List[str]] = None
+                    ) -> Dict[str, List[str]]:
+    f = {f'tag:{TAG_CLUSTER}': [cluster_name_on_cloud]}
+    if states:
+        f['instance-state-name'] = states
+    return f
+
+
+def _live_instances(client: ec2_lib.Ec2Client, cluster_name_on_cloud: str
+                    ) -> List[Dict[str, Any]]:
+    return client.describe_instances(_cluster_filter(
+        cluster_name_on_cloud,
+        states=['pending', 'running', 'stopping', 'stopped']))
+
+
+def _tag_value(inst: Dict[str, Any], key: str) -> Optional[str]:
+    tags = inst.get('tagSet') or []
+    if isinstance(tags, dict):
+        tags = [tags]
+    for t in tags:
+        if t.get('key') == key:
+            return t.get('value')
+    return None
+
+
+def _state_of(inst: Dict[str, Any]) -> str:
+    state = inst.get('instanceState') or {}
+    return state.get('name', '') if isinstance(state, dict) else str(state)
+
+
+def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    nc = config.node_config
+    if nc.get('tpu_vm', False):
+        raise exceptions.NotSupportedError(
+            'AWS carries no TPUs; TPU slices provision on the GCP family.')
+    image = nc.get('image_id') or _default_image()
+    if not image:
+        raise exceptions.NoCloudAccessError(
+            'AWS provisioning needs an AMI: set `image_id:` on the task, '
+            'aws.image_id in ~/.skypilot_tpu/config.yaml, or '
+            'SKYTPU_AWS_DEFAULT_AMI (an Ubuntu 22.04 AMI for the target '
+            'region).')
+    client = _client(config.region)
+    existing_by_node: Dict[int, Dict[str, Any]] = {}
+    for inst in _live_instances(client, config.cluster_name_on_cloud):
+        node = _tag_value(inst, TAG_NODE)
+        if node is not None:
+            existing_by_node[int(node)] = inst
+    created, resumed = [], []
+    to_start: List[str] = []
+    missing: List[int] = []
+    for idx in range(config.num_nodes):
+        inst = existing_by_node.get(idx)
+        if inst is None:
+            missing.append(idx)
+        elif _state_of(inst) in ('stopping', 'stopped'):
+            if config.resume_stopped_nodes:
+                to_start.append(inst['instanceId'])
+                resumed.append(inst['instanceId'])
+    try:
+        if to_start:
+            client.start_instances(to_start)
+        user_data = _user_data()
+        for idx in missing:
+            # One RunInstances per node so each carries its node-index
+            # tag (EC2 tags apply per-call); creation is rolled back as a
+            # unit on any capacity error, like the GCP slice path.
+            instances = client.run_instances(
+                count=1, instance_type=nc['instance_type'], image_id=image,
+                user_data_b64=user_data,
+                disk_size_gb=nc.get('disk_size_gb') or 100,
+                spot=bool(nc.get('use_spot', False)),
+                zone=config.zone,
+                tags={TAG_CLUSTER: config.cluster_name_on_cloud,
+                      TAG_NODE: str(idx),
+                      'Name': f'{config.cluster_name_on_cloud}-{idx}',
+                      **config.tags})
+            created.extend(i['instanceId'] for i in instances)
+    except ec2_lib.AwsApiError as e:
+        for iid in created:  # atomic create-all-or-rollback
+            try:
+                client.terminate_instances([iid])
+            except ec2_lib.AwsApiError:
+                pass
+        if resumed:
+            # Instances resumed THIS call must not keep running (and
+            # billing) in a region the failover loop is abandoning.
+            try:
+                client.stop_instances(resumed)
+            except ec2_lib.AwsApiError:
+                pass
+        if e.is_stockout():
+            raise exceptions.QuotaExceededError(
+                f'EC2 capacity in {config.region}: {e}') from e
+        raise
+    head = _head_instance_id(client, config.cluster_name_on_cloud)
+    return common.ProvisionRecord(
+        provider_name='aws', region=config.region, zone=config.zone,
+        cluster_name_on_cloud=config.cluster_name_on_cloud,
+        head_instance_id=head,
+        created_instance_ids=created, resumed_instance_ids=resumed)
+
+
+def _head_instance_id(client: ec2_lib.Ec2Client,
+                      cluster_name_on_cloud: str) -> Optional[str]:
+    for inst in _live_instances(client, cluster_name_on_cloud):
+        if _tag_value(inst, TAG_NODE) == '0':
+            return inst['instanceId']
+    return None
+
+
+def _region_of(provider_config: Optional[Dict[str, Any]]) -> str:
+    if provider_config:
+        if provider_config.get('region'):
+            return provider_config['region']
+        zone = provider_config.get('zone')
+        if zone:
+            # AWS zones are '<region><letter>' ('us-east-1a'): the
+            # backend's handle carries the zone, so lifecycle ops must
+            # be able to recover the region from it.
+            return zone.rstrip('abcdefghijklmnopqrstuvwxyz')
+    region = os.environ.get('SKYTPU_AWS_REGION')
+    if not region:
+        raise exceptions.NoCloudAccessError(
+            'AWS region unknown: provider_config has neither region nor '
+            'zone, and SKYTPU_AWS_REGION is unset.')
+    return region
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str, state: str,
+                   timeout: float = 600.0, poll: float = 3.0) -> None:
+    """Poll until every cluster instance reports ``running``."""
+    del state
+    client = _client(region)
+    deadline = time.time() + timeout
+    while True:
+        instances = _live_instances(client, cluster_name_on_cloud)
+        states = [_state_of(i) for i in instances]
+        if instances and all(s == 'running' for s in states):
+            return
+        if time.time() > deadline:
+            raise exceptions.ClusterNotUpError(
+                f'EC2 instances not running after {timeout:.0f}s '
+                f'(states: {states})')
+        time.sleep(poll)
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
+    client = _client(_region_of(provider_config))
+    ids = [i['instanceId']
+           for i in _live_instances(client, cluster_name_on_cloud)
+           if _state_of(i) in ('pending', 'running')]
+    client.stop_instances(ids)
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None
+                        ) -> None:
+    client = _client(_region_of(provider_config))
+    ids = [i['instanceId']
+           for i in _live_instances(client, cluster_name_on_cloud)]
+    client.terminate_instances(ids)
+
+
+_STATE_MAP = {
+    'pending': 'pending',
+    'running': 'running',
+    'stopping': 'stopped',
+    'stopped': 'stopped',
+    'shutting-down': 'terminated',
+    'terminated': 'terminated',
+}
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Optional[str]]:
+    client = _client(_region_of(provider_config))
+    out: Dict[str, Optional[str]] = {}
+    for inst in client.describe_instances(
+            _cluster_filter(cluster_name_on_cloud)):
+        out[inst['instanceId']] = _STATE_MAP.get(_state_of(inst), None)
+    return out
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    del provider_config
+    client = _client(region)
+    instances: List[common.InstanceInfo] = []
+    head_id = None
+    for inst in _live_instances(client, cluster_name_on_cloud):
+        if _state_of(inst) != 'running':
+            continue
+        node = int(_tag_value(inst, TAG_NODE) or 0)
+        if node == 0:
+            head_id = inst['instanceId']
+        instances.append(common.InstanceInfo(
+            instance_id=inst['instanceId'],
+            node_id=node,
+            worker_id=0,  # EC2 VMs are single-host nodes
+            internal_ip=inst.get('privateIpAddress', ''),
+            external_ip=inst.get('ipAddress')
+            or inst.get('privateIpAddress'),
+            status='running'))
+    instances.sort(key=lambda i: i.node_id)
+    key_path, _ = authentication.get_or_create_ssh_keypair()
+    return common.ClusterInfo(
+        instances=instances, head_instance_id=head_id,
+        provider_name='aws', region=region, zone=None,
+        ssh_user=default_ssh_user(), ssh_key_path=key_path)
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[int],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    """Authorize ingress on the security groups the cluster's instances
+    actually use (no SG creation: instances launch into the default VPC
+    SG, and mutating it per-port avoids VPC plumbing in this build)."""
+    if not ports:
+        return
+    client = _client(_region_of(provider_config))
+    group_ids = set()
+    for inst in _live_instances(client, cluster_name_on_cloud):
+        groups = inst.get('groupSet') or []
+        if isinstance(groups, dict):
+            groups = [groups]
+        for g in groups:
+            if g.get('groupId'):
+                group_ids.add(g['groupId'])
+    for gid in sorted(group_ids):
+        for port in ports:
+            client.authorize_ingress(gid, int(port))
