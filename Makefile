@@ -1,0 +1,37 @@
+# CI entry points (reference analog: .buildkite/ + .github/workflows/).
+# `make ci` is the gate: lint + fast tests + sanitized native suite,
+# targeted < 10 min on a laptop-class sandbox.
+
+PY ?= python
+NATIVE_DIR := skypilot_tpu/agent/native
+
+.PHONY: ci lint test-fast test test-all native native-asan clean
+
+ci: lint native-asan test-fast
+
+lint:
+	$(PY) tools/lint.py
+
+# Default selection: everything not marked slow/load (< 5 min).
+test-fast:
+	$(PY) -m pytest tests/ -q -m "not slow and not load" -p no:cacheprovider
+
+# Full suite minus sustained load tests (~30 min serial).
+test:
+	$(PY) -m pytest tests/ -q -m "not load"
+
+# Everything, including load/chaos suites.
+test-all:
+	$(PY) -m pytest tests/ -q
+
+native:
+	$(MAKE) -C $(NATIVE_DIR)
+
+# ASan/UBSan build + the native gang/fuse suites against it.
+native-asan:
+	$(MAKE) -C $(NATIVE_DIR) sanitize
+	$(PY) -m pytest tests/test_native_gang.py tests/test_fuse_proxy.py -q
+
+clean:
+	$(MAKE) -C $(NATIVE_DIR) clean || true
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

@@ -9,7 +9,7 @@ reference's sole real one is ``CloudVmRayBackend``).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 from skypilot_tpu.task import Task
 

@@ -533,6 +533,26 @@ class TpuGangBackend(Backend):
         # cluster name) must not execute the new spec or write into the
         # new job table.
         nonce = common_utils.random_id()
+        run_cmd = task.run if isinstance(task.run, str) else None
+        if run_cmd and task.storage_mounts:
+            # MOUNT_CACHED write-back barrier: the job must not report
+            # SUCCEEDED while its cached mounts still hold un-uploaded
+            # writes (a checkpoint that exists only in the local VFS
+            # cache is lost with the VM).
+            from skypilot_tpu.data import storage as storage_lib
+            flushes = []
+            for dst, cfg in task.storage_mounts.items():
+                script = storage_lib.Storage.from_config(cfg).flush_script(
+                    dst)
+                if script:
+                    flushes.append(script)
+            if flushes:
+                # Preserve the USER command's exit code: the barrier must
+                # not convert a crashed job into SUCCEEDED (the driver
+                # reads the shell's final status).
+                run_cmd = '\n'.join(
+                    [run_cmd, '__skytpu_rc=$?'] + flushes +
+                    ['exit $__skytpu_rc'])
         spec = {
             'cluster_name': handle.cluster_name,
             'num_nodes': handle.num_nodes,
@@ -541,7 +561,7 @@ class TpuGangBackend(Backend):
             'workers': workers,
             'envs': task.envs_and_secrets,
             'setup': task.setup if include_setup else None,
-            'run': task.run if isinstance(task.run, str) else None,
+            'run': run_cmd,
             'workdir_on_worker': workdir_on_worker,
             'nonce': nonce,
         }

@@ -10,7 +10,6 @@ from typing import Dict, List, Optional, Tuple
 
 import pandas as pd
 
-from skypilot_tpu import topology
 from skypilot_tpu.catalog import common
 
 _tpu_df = common.LazyDataFrame('gcp/tpus.csv')

@@ -1,0 +1,270 @@
+"""Async client SDK: the asyncio mirror of ``client/sdk.py``.
+
+Reference analog: ``sky/client/sdk_async.py`` (827 LoC) — identical verb
+surface to the sync SDK, each verb returning a ``request_id``;
+``get()``/``stream_and_get()`` await the result. Built on aiohttp (already
+a server-side dependency), one shared session per event loop.
+
+Usage::
+
+    async with sdk_async.AsyncClient() as client:
+        rid = await client.launch(task, cluster_name='c')
+        result = await client.get(rid)
+
+Module-level coroutines (``launch``, ``get``, ...) mirror the sync SDK's
+free functions on a default client for drop-in use.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import aiohttp
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.client import sdk as sync_sdk
+from skypilot_tpu.task import Task
+
+
+class AsyncClient:
+    """One aiohttp session over the API server; use as an async context
+    manager (or call ``close()``)."""
+
+    def __init__(self, server_url: Optional[str] = None):
+        self._url = server_url or sync_sdk.server_url()
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    async def __aenter__(self) -> 'AsyncClient':
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    def _headers(self) -> Dict[str, str]:
+        token = os.environ.get('SKYTPU_API_TOKEN')
+        return {'Authorization': f'Bearer {token}'} if token else {}
+
+    async def _ensure_session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    @staticmethod
+    def _workspace() -> str:
+        from skypilot_tpu import workspaces as workspaces_lib
+        return workspaces_lib.active_workspace()
+
+    async def _post(self, path: str, payload: Dict[str, Any]) -> str:
+        session = await self._ensure_session()
+        payload = {**payload, '_workspace': self._workspace()}
+        try:
+            async with session.post(f'{self._url}/api/v1/{path}',
+                                    json=payload, headers=self._headers(),
+                                    timeout=aiohttp.ClientTimeout(
+                                        total=30)) as r:
+                body = await r.json()
+                if r.status != 200:
+                    raise exceptions.SkyTpuError(
+                        body.get('error', str(body)))
+                return body['request_id']
+        except aiohttp.ClientConnectionError as e:
+            raise exceptions.ApiServerConnectionError(self._url,
+                                                      str(e)) from e
+
+    async def _get_rid(self, path: str, params: Dict[str, Any]) -> str:
+        session = await self._ensure_session()
+        params = {**params, '_workspace': self._workspace()}
+        try:
+            async with session.get(f'{self._url}/api/v1/{path}',
+                                   params=params, headers=self._headers(),
+                                   timeout=aiohttp.ClientTimeout(
+                                       total=30)) as r:
+                body = await r.json()
+                if r.status != 200:
+                    raise exceptions.SkyTpuError(
+                        body.get('error', str(body)))
+                return body['request_id']
+        except aiohttp.ClientConnectionError as e:
+            raise exceptions.ApiServerConnectionError(self._url,
+                                                      str(e)) from e
+
+    # -- result retrieval ----------------------------------------------------
+
+    async def get(self, request_id: str, timeout: float = 600.0) -> Any:
+        """Await the request's completion; return its result or raise its
+        (deserialized) error — the sync ``sdk.get`` contract."""
+        session = await self._ensure_session()
+        async with session.get(
+                f'{self._url}/api/v1/api/get',
+                params={'request_id': request_id, 'timeout': str(timeout)},
+                headers=self._headers(),
+                timeout=aiohttp.ClientTimeout(total=timeout + 10)) as r:
+            body = await r.json()
+            if r.status == 202:
+                raise TimeoutError(
+                    f'request {request_id} still {body.get("status")}')
+            if r.status != 200:
+                raise exceptions.SkyTpuError(body.get('error', str(body)))
+            if body.get('error'):
+                raise exceptions.deserialize_exception(body['error'])
+            return body.get('result')
+
+    async def stream_and_get(self, request_id: str, timeout: float = 600.0,
+                             quiet: bool = False) -> Any:
+        """Stream the request's server-side log (SSE), then return the
+        result."""
+        session = await self._ensure_session()
+        async with session.get(
+                f'{self._url}/api/v1/api/stream',
+                params={'request_id': request_id}, headers=self._headers(),
+                timeout=aiohttp.ClientTimeout(total=timeout)) as r:
+            async for raw in r.content:
+                line = raw.decode('utf-8', errors='replace').strip()
+                if line.startswith('data: ') and not quiet:
+                    try:
+                        print(json.loads(line[len('data: '):]))
+                    except json.JSONDecodeError:
+                        pass
+                elif line.startswith('event: done'):
+                    break
+        return await self.get(request_id, timeout=timeout)
+
+    # -- verbs (each returns a request_id) -----------------------------------
+
+    async def launch(self, task: Task, cluster_name: Optional[str] = None,
+                     retry_until_up: bool = False,
+                     idle_minutes_to_autostop: Optional[int] = None,
+                     down: bool = False, detach_run: bool = True) -> str:
+        return await self._post('launch', {
+            'task': task.to_yaml_config(),
+            'cluster_name': cluster_name,
+            'retry_until_up': retry_until_up,
+            'idle_minutes_to_autostop': idle_minutes_to_autostop,
+            'down': down,
+            'detach_run': detach_run,
+        })
+
+    async def exec_(self, task: Task, cluster_name: str) -> str:
+        return await self._post('exec', {'task': task.to_yaml_config(),
+                                         'cluster_name': cluster_name})
+
+    async def status(self, refresh: bool = False,
+                     all_workspaces: bool = False) -> str:
+        return await self._get_rid(
+            'status', {'refresh': '1' if refresh else '0',
+                       'all_workspaces': '1' if all_workspaces else '0'})
+
+    async def queue(self, cluster_name: str) -> str:
+        return await self._get_rid('queue', {'cluster_name': cluster_name})
+
+    async def job_status(self, cluster_name: str,
+                         job_id: Optional[int] = None) -> str:
+        params: Dict[str, Any] = {'cluster_name': cluster_name}
+        if job_id is not None:
+            params['job_id'] = job_id
+        return await self._get_rid('job_status', params)
+
+    async def cancel(self, cluster_name: str,
+                     job_id: Optional[int] = None) -> str:
+        payload: Dict[str, Any] = {'cluster_name': cluster_name}
+        if job_id is not None:
+            payload['job_id'] = job_id
+        return await self._post('cancel', payload)
+
+    async def down(self, cluster_name: str) -> str:
+        return await self._post('down', {'cluster_name': cluster_name})
+
+    async def stop(self, cluster_name: str) -> str:
+        return await self._post('stop', {'cluster_name': cluster_name})
+
+    async def start(self, cluster_name: str) -> str:
+        return await self._post('start', {'cluster_name': cluster_name})
+
+    async def autostop(self, cluster_name: str, idle_minutes: int,
+                       down: bool = False) -> str:
+        return await self._post('autostop',
+                                {'cluster_name': cluster_name,
+                                 'idle_minutes': idle_minutes,
+                                 'down': down})
+
+    async def cost_report(self) -> str:
+        return await self._get_rid('cost_report', {})
+
+    async def check(self) -> str:
+        return await self._get_rid('check', {})
+
+    async def jobs_launch(self, task: Task,
+                          recovery_strategy: str = 'FAILOVER',
+                          max_restarts_on_errors: int = 0) -> str:
+        return await self._post('jobs/launch', {
+            'task': task.to_yaml_config(),
+            'recovery_strategy': recovery_strategy,
+            'max_restarts_on_errors': max_restarts_on_errors,
+        })
+
+    async def jobs_queue(self, all_workspaces: bool = False) -> str:
+        return await self._get_rid(
+            'jobs/queue', {'all_workspaces': '1' if all_workspaces else '0'})
+
+    async def jobs_cancel(self, job_id: int) -> str:
+        return await self._post('jobs/cancel', {'job_id': job_id})
+
+    async def api_cancel(self, request_id: str) -> bool:
+        session = await self._ensure_session()
+        async with session.post(f'{self._url}/api/v1/api/cancel',
+                                json={'request_id': request_id},
+                                headers=self._headers(),
+                                timeout=aiohttp.ClientTimeout(
+                                    total=10)) as r:
+            body = await r.json()
+            return bool(body.get('cancelled'))
+
+    async def api_requests(self) -> List[Dict[str, Any]]:
+        session = await self._ensure_session()
+        async with session.get(f'{self._url}/api/v1/api/requests',
+                               headers=self._headers(),
+                               timeout=aiohttp.ClientTimeout(
+                                   total=10)) as r:
+            return await r.json()
+
+
+# -- module-level mirror -----------------------------------------------------
+# Each call opens and closes its own client: an aiohttp session is bound
+# to the event loop that created it, so a module-global client would
+# break (and leak) across sequential asyncio.run() calls. Long-lived
+# callers should hold an AsyncClient themselves to amortize connections.
+
+
+async def get(request_id: str, timeout: float = 600.0) -> Any:
+    async with AsyncClient() as client:
+        return await client.get(request_id, timeout=timeout)
+
+
+async def stream_and_get(request_id: str, timeout: float = 600.0,
+                         quiet: bool = False) -> Any:
+    async with AsyncClient() as client:
+        return await client.stream_and_get(request_id, timeout=timeout,
+                                           quiet=quiet)
+
+
+def __getattr__(name: str):
+    """Module-level verbs proxy to a per-call client (``await
+    sdk_async.launch(...)`` just works)."""
+    if name.startswith('_'):
+        raise AttributeError(name)
+    attr = getattr(AsyncClient, name, None)
+    if attr is None:
+        raise AttributeError(name)
+
+    async def call(*args, **kwargs):
+        async with AsyncClient() as client:
+            return await attr(client, *args, **kwargs)
+
+    call.__name__ = name
+    return call

@@ -9,7 +9,6 @@ zones.
 """
 from __future__ import annotations
 
-import os
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from skypilot_tpu.catalog import aws_catalog

@@ -14,7 +14,6 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.resources import Resources
-from skypilot_tpu.utils.registry import CLOUD_REGISTRY
 
 
 class CloudImplementationFeatures(enum.Enum):

@@ -11,7 +11,7 @@ from __future__ import annotations
 import datetime
 import hashlib
 import hmac
-from typing import Dict, Mapping, Optional, Tuple
+from typing import Dict, Mapping, Optional
 from urllib.parse import quote
 
 

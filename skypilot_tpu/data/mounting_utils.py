@@ -83,6 +83,65 @@ def rclone_mount_command(remote: str, bucket: str, mount_path: str) -> str:
             f'{shlex.quote(mount_path)} --daemon --vfs-cache-mode writes)')
 
 
+# Per-mount VFS cache + log home for MOUNT_CACHED (write-back) mounts.
+_CACHED_DIR = '~/.skytpu/rclone-cached'
+
+
+def _mount_tag(mount_path: str) -> str:
+    import hashlib
+    return hashlib.sha1(mount_path.encode('utf-8')).hexdigest()[:16]
+
+
+def rclone_cached_mount_command(remote: str, bucket: str,
+                                mount_path: str) -> str:
+    """Write-back cached mount (MOUNT_CACHED): rclone VFS in ``full``
+    cache mode — reads and writes land on local disk first and upload
+    asynchronously, the durability/latency contract checkpoint dirs want
+    (reference: ``sky/data/mounting_utils.py:472-500``). ``--transfers 1``
+    preserves creation order of uploads (a later checkpoint must never be
+    visible remotely before an earlier one); the per-mount log file is
+    what ``rclone_cached_flush_script`` polls to block job exit until the
+    cache is fully uploaded."""
+    tag = _mount_tag(mount_path)
+    log = f'{_CACHED_DIR}/{tag}.log'
+    cache = f'{_CACHED_DIR}/{tag}.cache'
+    return (f'mkdir -p {shlex.quote(mount_path)} {_CACHED_DIR} && '
+            f'touch {log} && '
+            f'(mountpoint -q {shlex.quote(mount_path)} || '
+            f'rclone mount {shlex.quote(remote)}:{shlex.quote(bucket)} '
+            f'{shlex.quote(mount_path)} --daemon --daemon-wait 10 '
+            f'--log-file {log} --log-level INFO '
+            '--vfs-cache-mode full --dir-cache-time 10s '
+            '--transfers 1 --vfs-cache-poll-interval 5s '
+            '--vfs-write-back 1s --vfs-cache-max-size 10G '
+            f'--cache-dir {cache})')
+
+
+def rclone_cached_flush_script(mount_path: str,
+                               timeout_s: int = 600) -> str:
+    """Block until the mount's VFS cache has fully uploaded (appended to
+    the job's run command for MOUNT_CACHED dirs): polls the rclone log
+    for a cache-clean report with zero pending uploads — without this a
+    job can "succeed" while its checkpoints are still local-only, and a
+    spot preemption right after loses them. Bounded: after ``timeout_s``
+    the barrier FAILS LOUDLY (exit 2) rather than hanging the job forever
+    on wedged uploads (expired credentials, rotated log) — an un-uploaded
+    checkpoint is a durability failure, not a success."""
+    log = f'{_CACHED_DIR}/{_mount_tag(mount_path)}.log'
+    return (f'if mountpoint -q {shlex.quote(mount_path)}; then '
+            f'sleep 1; __skytpu_flush_deadline=$(($(date +%s)+{timeout_s}));'
+            ' while true; do '
+            f'if tac {log} 2>/dev/null | '
+            'grep -m 1 "vfs cache: cleaned:" | '
+            'grep -q "in use 0, to upload 0, uploading 0"; then break; fi; '
+            'if [ $(date +%s) -gt $__skytpu_flush_deadline ]; then '
+            'echo "[skytpu] ERROR: cached mount still uploading after '
+            f'{timeout_s}s: {mount_path} — data may not be durable" >&2; '
+            'exit 2; fi; '
+            'echo "[skytpu] waiting for cached mount upload: '
+            f'{mount_path}"; sleep 5; done; fi')
+
+
 def rclone_flush_script(mount_path: str) -> str:
     """Flush cached writes before job exit (reference:
     ``task_codegen.py`` ``_get_rclone_flush_script``) so checkpoints are

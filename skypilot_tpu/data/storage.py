@@ -75,6 +75,29 @@ class AbstractStore:
         """Shell command mounting this store on a cluster worker."""
         raise NotImplementedError
 
+    # rclone remote name for cached mounts (None = no cached mount).
+    _rclone_remote: Optional[str] = None
+
+    def _bucket_path(self) -> str:
+        return (f'{self.bucket}/{self.prefix}' if self.prefix
+                else self.bucket)
+
+    def cached_mount_command(self, mount_path: str) -> str:
+        """MOUNT_CACHED: write-back cached mount (rclone VFS full) —
+        materially different durability/perf contract from MOUNT: writes
+        land on local disk and upload asynchronously; pair with
+        ``cached_mount_flush_script`` at job exit."""
+        if self._rclone_remote is None:
+            raise NotImplementedError(
+                f'{type(self).__name__} has no cached-mount support')
+        from skypilot_tpu.data import mounting_utils
+        return mounting_utils.rclone_cached_mount_command(
+            self._rclone_remote, self._bucket_path(), mount_path)
+
+    def cached_mount_flush_script(self, mount_path: str) -> str:
+        from skypilot_tpu.data import mounting_utils
+        return mounting_utils.rclone_cached_flush_script(mount_path)
+
 
 class LocalStore(AbstractStore):
     """Directory-backed 'bucket' (file:// scheme)."""
@@ -134,6 +157,12 @@ class LocalStore(AbstractStore):
         root = self._ensure()
         return (f'mkdir -p {os.path.dirname(mount_path)} && '
                 f'rm -rf {mount_path} && ln -sfn {root} {mount_path}')
+
+    def cached_mount_command(self, mount_path: str) -> str:
+        return self.mount_command(mount_path)  # local disk needs no cache
+
+    def cached_mount_flush_script(self, mount_path: str) -> str:
+        return 'true'  # nothing buffered
 
 
 class GcsStore(AbstractStore):
@@ -236,6 +265,8 @@ class GcsStore(AbstractStore):
                 'DELETE',
                 f'{self.API}/b/{self.bucket}/o/'
                 f'{self._quote(self._obj(name))}')
+
+    _rclone_remote = 'gcs'
 
     def mount_command(self, mount_path: str) -> str:
         from skypilot_tpu.data import mounting_utils
@@ -466,12 +497,12 @@ class S3Store(_RestObjectStore):
     def _delete_key(self, key: str) -> None:
         self._request('DELETE', key)
 
+    _rclone_remote = 's3'
+
     def mount_command(self, mount_path: str) -> str:
         from skypilot_tpu.data import mounting_utils
-        bucket_path = (f'{self.bucket}/{self.prefix}' if self.prefix
-                       else self.bucket)
-        return mounting_utils.rclone_mount_command('s3', bucket_path,
-                                                   mount_path)
+        return mounting_utils.rclone_mount_command(
+            's3', self._bucket_path(), mount_path)
 
 
 class AzureBlobStore(_RestObjectStore):
@@ -599,12 +630,12 @@ class AzureBlobStore(_RestObjectStore):
     def _delete_key(self, key: str) -> None:
         self._request('DELETE', key)
 
+    _rclone_remote = 'azureblob'
+
     def mount_command(self, mount_path: str) -> str:
         from skypilot_tpu.data import mounting_utils
-        bucket_path = (f'{self.bucket}/{self.prefix}' if self.prefix
-                       else self.bucket)
-        return mounting_utils.rclone_mount_command('azureblob', bucket_path,
-                                                   mount_path)
+        return mounting_utils.rclone_mount_command(
+            'azureblob', self._bucket_path(), mount_path)
 
 
 _SCHEMES = {'gs': GcsStore, 'file': LocalStore, 's3': S3Store,
@@ -648,7 +679,11 @@ class Storage:
         """Apply on a local/fake cluster: MOUNT=symlink, COPY=copy."""
         store = self.store()
         dst = os.path.expanduser(dst)
-        if self.mode in (StorageMode.MOUNT, StorageMode.MOUNT_CACHED):
+        if self.mode == StorageMode.MOUNT_CACHED:
+            cmd = store.cached_mount_command(dst)
+            import subprocess
+            subprocess.run(['bash', '-c', cmd], check=True)
+        elif self.mode == StorageMode.MOUNT:
             cmd = store.mount_command(dst)
             import subprocess
             subprocess.run(['bash', '-c', cmd], check=True)
@@ -656,4 +691,13 @@ class Storage:
             store.download(dst)
 
     def mount_command(self, dst: str) -> str:
+        if self.mode == StorageMode.MOUNT_CACHED:
+            return self.store().cached_mount_command(dst)
         return self.store().mount_command(dst)
+
+    def flush_script(self, dst: str) -> Optional[str]:
+        """Job-exit barrier for MOUNT_CACHED dirs (None otherwise):
+        blocks completion until the write-back cache is fully uploaded."""
+        if self.mode != StorageMode.MOUNT_CACHED:
+            return None
+        return self.store().cached_mount_flush_script(dst)

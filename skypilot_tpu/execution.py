@@ -7,7 +7,7 @@ from __future__ import annotations
 
 import enum
 import uuid
-from typing import Any, Dict, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import global_user_state

@@ -11,7 +11,6 @@ from __future__ import annotations
 import enum
 import json
 import os
-import sqlite3
 import time
 from typing import Any, Dict, List, Optional
 
@@ -111,16 +110,15 @@ def remove_volume(name: str) -> None:
         conn.execute('DELETE FROM volumes WHERE name = ?', (name,))
 
 
-def _conn() -> sqlite3.Connection:
-    conn = sqlite3.connect(_db_path(), timeout=10)
-    conn.row_factory = sqlite3.Row
-    conn.executescript(_SCHEMA)
-    try:  # migration for pre-workspace databases
-        conn.execute("ALTER TABLE clusters ADD COLUMN workspace "
-                     "TEXT DEFAULT 'default'")
-    except sqlite3.OperationalError:
-        pass  # already present
-    return conn
+def _conn():
+    # SQLite file by default; one shared Postgres when SKYTPU_DB_URL is
+    # set (multi-replica API servers; utils/db_utils.py).
+    from skypilot_tpu.utils import db_utils
+    return db_utils.connect(
+        _db_path(), _SCHEMA,
+        migrations=(  # pre-workspace databases
+            "ALTER TABLE clusters ADD COLUMN workspace TEXT "
+            "DEFAULT 'default'",))
 
 
 def _lock() -> filelock.FileLock:
@@ -148,12 +146,23 @@ def add_or_update_cluster(name: str, handle: Dict[str, Any],
             conn.execute(f'UPDATE clusters SET {sets} WHERE name = ?', args)
         else:
             from skypilot_tpu import workspaces as workspaces_lib
-            conn.execute(
-                'INSERT INTO clusters (name, launched_at, handle, status, '
-                'last_activity, owner, workspace) '
-                'VALUES (?, ?, ?, ?, ?, ?, ?)',
-                (name, now, json.dumps(handle), status.value, now, owner,
-                 workspaces_lib.active_workspace()))
+            from skypilot_tpu.utils import db_utils
+            try:
+                conn.execute(
+                    'INSERT INTO clusters (name, launched_at, handle, '
+                    'status, last_activity, owner, workspace) '
+                    'VALUES (?, ?, ?, ?, ?, ?, ?)',
+                    (name, now, json.dumps(handle), status.value, now,
+                     owner, workspaces_lib.active_workspace()))
+            except db_utils.OperationalError:
+                # Cross-replica race on a shared Postgres: the filelock
+                # above is host-local, so another API-server replica can
+                # win the SELECT->INSERT race. The primary-key violation
+                # means the row now exists — retry as an update.
+                conn.execute(
+                    'UPDATE clusters SET handle = ?, status = ?, '
+                    'last_activity = ? WHERE name = ?',
+                    (json.dumps(handle), status.value, now, name))
 
 
 def set_cluster_owner(name: str, owner: str) -> None:

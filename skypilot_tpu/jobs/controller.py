@@ -22,7 +22,6 @@ from skypilot_tpu import core, exceptions, global_user_state
 from skypilot_tpu import provision as provision_lib
 from skypilot_tpu.agent import job_lib
 from skypilot_tpu.backends import ClusterHandle
-from skypilot_tpu.backends.tpu_gang_backend import runtime_dir
 from skypilot_tpu.jobs import recovery_strategy, state
 from skypilot_tpu.task import Task
 

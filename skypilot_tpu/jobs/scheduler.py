@@ -12,7 +12,6 @@ Controllers run as tasks on the jobs-controller cluster
 from __future__ import annotations
 
 import os
-from typing import Optional
 
 import filelock
 

@@ -11,12 +11,12 @@ busbw = algbw * 2 * (n - 1) / n.
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 
 def allreduce_benchmark(payload_mb: float = 64.0,

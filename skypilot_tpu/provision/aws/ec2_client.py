@@ -95,13 +95,18 @@ class Ec2Transport:
     def __init__(self, region: str):
         self.region = region
         self.host = f'ec2.{region}.amazonaws.com'
+        self._creds: Optional[Tuple[str, str]] = None
 
     def request(self, action: str, params: Dict[str, str]) -> Dict[str, Any]:
         import requests
 
         from skypilot_tpu.data import aws_sigv4
 
-        access, secret = load_credentials()
+        if self._creds is None:
+            # Once per transport: wait_instances polls every 3s and must
+            # not re-stat/parse ~/.aws/credentials on every request.
+            self._creds = load_credentials()
+        access, secret = self._creds
         form = {'Action': action, 'Version': EC2_API_VERSION, **params}
         body = '&'.join(
             f'{aws_sigv4.quote(str(k), safe="-_.~")}='

@@ -15,7 +15,7 @@ import concurrent.futures as cf
 import os
 import shlex
 import time
-from typing import List, Optional, Sequence
+from typing import Optional, Sequence
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.provision import common
@@ -75,6 +75,46 @@ def install_runtime(runners: Sequence[CommandRunner],
 
     with cf.ThreadPoolExecutor(max_workers=min(32, len(runners))) as pool:
         list(pool.map(_install_one, runners))
+
+
+# Python deps the on-pod agent runtime needs beyond the stdlib. Slim pod
+# images (the GKE default) ship none of them; bootstrap installs them
+# rather than walling the user off behind "bring your own image"
+# (COVERAGE gap #3 — the reference requires its wheel's deps in the pod
+# image; we degrade gracefully instead).
+AGENT_RUNTIME_DEPS = ('grpcio', 'protobuf', 'requests', 'PyYAML',
+                      'filelock')
+
+
+def ensure_runtime_deps(runners: Sequence[CommandRunner],
+                        python: str = 'python3') -> None:
+    """Install the agent's python deps on workers whose image lacks them.
+    Probe first (no-op on full images), then pip install --user; a pod
+    with neither deps nor pip fails with an actionable message instead of
+    the opaque agent-never-listened error."""
+    probe = (f'{shlex.quote(python)} -c '
+             + shlex.quote('import grpc, google.protobuf, requests, yaml, '
+                           'filelock'))
+    pip_install = (f'{shlex.quote(python)} -m pip install --user '
+                   + ' '.join(AGENT_RUNTIME_DEPS))
+
+    def _ensure_one(idx_runner) -> None:
+        idx, runner = idx_runner
+        if runner.run(probe) == 0:
+            return
+        if runner.run(pip_install) != 0:
+            raise exceptions.ClusterNotUpError(
+                f'Worker {idx}: agent runtime deps missing and pip '
+                f'install failed — use an image with '
+                f'{", ".join(AGENT_RUNTIME_DEPS)} preinstalled '
+                '(set `image_id:` on the task).')
+        if runner.run(probe) != 0:
+            raise exceptions.ClusterNotUpError(
+                f'Worker {idx}: agent runtime deps still unimportable '
+                'after pip install.')
+
+    with cf.ThreadPoolExecutor(max_workers=min(32, len(runners))) as pool:
+        list(pool.map(_ensure_one, enumerate(runners)))
 
 
 def push_cluster_key_to_head(head_runner: CommandRunner,
@@ -218,6 +258,10 @@ def bootstrap_cluster(cluster_name: str, info: common.ClusterInfo,
         return
     wait_for_ssh(runners, timeout=ssh_timeout)
     install_runtime(runners, python=python)
+    if worker_agents_port is not None:
+        # Pod-network clusters run agents on EVERY node; slim images may
+        # lack the agent deps — install them before any agent starts.
+        ensure_runtime_deps(runners, python=python)
     if start_daemon:
         from skypilot_tpu import authentication
         key_path, _ = authentication.get_or_create_ssh_keypair()

@@ -15,9 +15,8 @@ region / zone / instance type) or *launchable* (everything pinned, produced by
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Optional, Set, Tuple, Union
+from typing import Any, Dict, List, Optional, Tuple, Union
 
-from skypilot_tpu import exceptions
 from skypilot_tpu import topology
 
 _DEFAULT_DISK_SIZE_GB = 100

@@ -1,15 +1,19 @@
 """Autoscalers: request-rate scaling with hysteresis.
 
 Reference analog: ``sky/serve/autoscalers.py`` — ``Autoscaler :116``,
-``RequestRateAutoscaler :455``, hysteresis base ``:369``.  The decision
-function is pure (request timestamps in, target count out), so it is
-unit-testable without any service running.
+``RequestRateAutoscaler :455``, hysteresis base ``:369``,
+``InstanceAwareRequestRateAutoscaler :581`` (per-replica capacity weights
+— on TPUs a v5e-8 replica is NOT a v5e-4 replica), and
+``FallbackRequestRateAutoscaler :909`` (spot scale + on-demand safety
+base). Decision functions are pure (replica snapshot + request timestamps
+in, targets out), so every policy is unit-testable without a service
+running.
 """
 from __future__ import annotations
 
 import dataclasses
 import time
-from typing import List, Optional
+from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.serve.service_spec import ReplicaPolicy
 
@@ -18,6 +22,13 @@ from skypilot_tpu.serve.service_spec import ReplicaPolicy
 class AutoscalerDecision:
     target_num_replicas: int
     reason: str = ''
+    # Capacity-aware scale-down: replica ids to retire first (smallest
+    # capacity first), so shrinking removes the least serving power.
+    preferred_victims: List[int] = dataclasses.field(default_factory=list)
+    # Mixed-pool targets (FallbackRequestRateAutoscaler): how many of the
+    # target replicas should be spot vs on-demand. None = single pool.
+    num_spot: Optional[int] = None
+    num_ondemand: Optional[int] = None
 
 
 class Autoscaler:
@@ -27,14 +38,20 @@ class Autoscaler:
 
     def evaluate(self, num_ready: int, num_launching: int,
                  request_times: List[float],
-                 now: Optional[float] = None) -> AutoscalerDecision:
+                 now: Optional[float] = None,
+                 replicas: Optional[List[Dict[str, Any]]] = None
+                 ) -> AutoscalerDecision:
+        """``replicas``: live replica snapshot dicts with at least
+        ``replica_id``/``status``/``weight``/``use_spot`` — consumed by
+        the instance-aware and fallback policies; base policies ignore
+        it."""
         raise NotImplementedError
 
 
 class FixedReplicaAutoscaler(Autoscaler):
 
     def evaluate(self, num_ready, num_launching, request_times,
-                 now=None) -> AutoscalerDecision:
+                 now=None, replicas=None) -> AutoscalerDecision:
         return AutoscalerDecision(self.policy.min_replicas, 'fixed')
 
 
@@ -56,19 +73,19 @@ class RequestRateAutoscaler(Autoscaler):
         self._downscale_counter = 0
         self._target = policy.min_replicas
 
-    def evaluate(self, num_ready, num_launching, request_times,
-                 now=None) -> AutoscalerDecision:
-        now = now if now is not None else time.time()
+    def _qps(self, request_times: List[float], now: float) -> float:
         window_start = now - self.QPS_WINDOW_SECONDS
         recent = [t for t in request_times if t >= window_start]
-        qps = len(recent) / self.QPS_WINDOW_SECONDS
-        desired = max(
-            self.policy.min_replicas,
-            -(-int(qps * 100) // int(self.policy.target_qps_per_replica * 100))
-            if qps > 0 else self.policy.min_replicas)
+        return len(recent) / self.QPS_WINDOW_SECONDS
+
+    def _clamp(self, desired: int) -> int:
+        desired = max(self.policy.min_replicas, desired)
         if self.policy.max_replicas is not None:
             desired = min(desired, self.policy.max_replicas)
+        return desired
 
+    def _apply_hysteresis(self, desired: int, qps: float
+                          ) -> AutoscalerDecision:
         if desired > self._target:
             self._upscale_counter += 1
             self._downscale_counter = 0
@@ -90,8 +107,148 @@ class RequestRateAutoscaler(Autoscaler):
             self._downscale_counter = 0
         return AutoscalerDecision(self._target, f'hold: qps={qps:.2f}')
 
+    def evaluate(self, num_ready, num_launching, request_times,
+                 now=None, replicas=None) -> AutoscalerDecision:
+        now = now if now is not None else time.time()
+        qps = self._qps(request_times, now)
+        desired = self._clamp(
+            -(-int(qps * 100) // int(self.policy.target_qps_per_replica * 100))
+            if qps > 0 else self.policy.min_replicas)
+        return self._apply_hysteresis(desired, qps)
 
-def make_autoscaler(policy: ReplicaPolicy) -> Autoscaler:
+
+_ALIVE = ('PROVISIONING', 'STARTING', 'READY', 'NOT_READY')
+
+
+def _alive(replicas: Optional[List[Dict[str, Any]]]
+           ) -> List[Dict[str, Any]]:
+    out = []
+    for r in replicas or []:
+        status = r.get('status')
+        status = getattr(status, 'value', status)
+        if status in _ALIVE:
+            out.append(r)
+    return out
+
+
+class InstanceAwareRequestRateAutoscaler(RequestRateAutoscaler):
+    """Capacity-weighted request-rate scaling.
+
+    ``target_qps_per_replica`` is the qps a WEIGHT-1 replica sustains;
+    each live replica contributes ``weight`` units (e.g. chips relative
+    to the task's base slice — a v5e-8 replica at weight 2 carries twice
+    a v5e-4's traffic). Scaling up adds replicas assuming new launches
+    arrive at the task's base weight; scaling down retires the
+    smallest-capacity replicas first (``preferred_victims``), so
+    heterogeneous fleets shed the least serving power.
+
+    Reference: ``sky/serve/autoscalers.py:581``.
+    """
+
+    def __init__(self, policy: ReplicaPolicy,
+                 new_replica_weight: float = 1.0, **kwargs):
+        super().__init__(policy, **kwargs)
+        self.new_replica_weight = max(new_replica_weight, 1e-6)
+
+    def evaluate(self, num_ready, num_launching, request_times,
+                 now=None, replicas=None) -> AutoscalerDecision:
+        now = now if now is not None else time.time()
+        qps = self._qps(request_times, now)
+        alive = _alive(replicas)
+        if not alive:
+            # No snapshot: degrade to the weight-1 rate policy.
+            return super().evaluate(num_ready, num_launching,
+                                    request_times, now=now)
+        per_unit = float(self.policy.target_qps_per_replica)
+        needed_units = qps / per_unit if qps > 0 else 0.0
+        by_weight = sorted(alive, key=lambda r: (
+            float(r.get('weight') or 1.0), r.get('replica_id', 0)))
+        have_units = sum(float(r.get('weight') or 1.0) for r in alive)
+        if have_units >= needed_units:
+            # Retire smallest-first while remaining capacity covers qps
+            # (never below min_replicas).
+            victims = []
+            remaining = have_units
+            count = len(alive)
+            for r in by_weight:
+                w = float(r.get('weight') or 1.0)
+                if count - 1 < self.policy.min_replicas:
+                    break
+                if remaining - w < needed_units:
+                    break
+                victims.append(int(r['replica_id']))
+                remaining -= w
+                count -= 1
+            desired = self._clamp(len(alive) - len(victims))
+            decision = self._apply_hysteresis(desired, qps)
+            if decision.target_num_replicas < len(alive):
+                decision.preferred_victims = victims[
+                    :len(alive) - decision.target_num_replicas]
+            return decision
+        # Short on capacity: add replicas at the base launch weight.
+        deficit = needed_units - have_units
+        extra = -(-int(deficit * 1000) //
+                  int(self.new_replica_weight * 1000))
+        desired = self._clamp(len(alive) + extra)
+        return self._apply_hysteresis(desired, qps)
+
+
+class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
+    """Spot scaling with an on-demand safety base.
+
+    The rate-derived target is served by SPOT replicas (cheap), on top of
+    a constant ``base_ondemand_fallback_replicas`` on-demand pool; when
+    ready spot capacity falls short of the spot target (preemption
+    pressure), the gap is temporarily covered by EXTRA on-demand
+    replicas, which drain once spot capacity recovers.
+
+    Reference: ``sky/serve/autoscalers.py:909``.
+    """
+
+    def evaluate(self, num_ready, num_launching, request_times,
+                 now=None, replicas=None) -> AutoscalerDecision:
+        now = now if now is not None else time.time()
+        qps = self._qps(request_times, now)
+        base_od = int(self.policy.base_ondemand_fallback_replicas)
+        desired_total = self._clamp(
+            -(-int(qps * 100) // int(self.policy.target_qps_per_replica * 100))
+            if qps > 0 else self.policy.min_replicas)
+        decision = self._apply_hysteresis(desired_total, qps)
+        spot_target = max(decision.target_num_replicas - base_od, 0)
+        alive = _alive(replicas)
+        # Spot capacity that is serving or healthily on the way: READY,
+        # plus PROVISIONING/STARTING (normal scale-up launches must not
+        # be misread as preemptions — that would over-launch on-demand
+        # and churn it back down minutes later). NOT_READY is excluded:
+        # a replica that went dark is preemption-shaped and DOES open
+        # the gap.
+        healthy_spot = sum(
+            1 for r in alive if bool(r.get('use_spot'))
+            and getattr(r.get('status'), 'value', r.get('status'))
+            in ('READY', 'PROVISIONING', 'STARTING'))
+        gap = (max(spot_target - healthy_spot, 0)
+               if replicas is not None else 0)
+        num_ondemand = base_od + gap
+        if self.policy.max_replicas is not None:
+            # The user's max bounds the TOTAL fleet; the safety base is
+            # never clamped away.
+            num_ondemand = max(
+                base_od,
+                min(num_ondemand, self.policy.max_replicas - spot_target))
+        decision.num_spot = spot_target
+        decision.num_ondemand = num_ondemand
+        decision.target_num_replicas = (decision.num_spot +
+                                        decision.num_ondemand)
+        if gap:
+            decision.reason += f' (+{gap} on-demand covering spot gap)'
+        return decision
+
+
+def make_autoscaler(policy: ReplicaPolicy,
+                    new_replica_weight: float = 1.0) -> Autoscaler:
     if policy.autoscaling and policy.target_qps_per_replica:
-        return RequestRateAutoscaler(policy)
+        if policy.base_ondemand_fallback_replicas > 0:
+            return FallbackRequestRateAutoscaler(policy)
+        return InstanceAwareRequestRateAutoscaler(
+            policy, new_replica_weight=new_replica_weight)
     return FixedReplicaAutoscaler(policy)

@@ -8,8 +8,6 @@ from __future__ import annotations
 
 import argparse
 import threading
-import time
-from typing import Optional
 
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.autoscalers import make_autoscaler
@@ -70,11 +68,14 @@ class ServeController:
                     self.lb.policy = make_policy(
                         self.spec.load_balancing_policy)
                 num_ready_now = len(self.lb.policy.replicas)
+                replica_snapshot = serve_state.list_replicas(
+                    self.service_name)
                 decision = self.autoscaler.evaluate(
                     num_ready=num_ready_now,
                     num_launching=(self.replica_manager.num_alive()
                                    - num_ready_now),
-                    request_times=self.lb.drain_request_times())
+                    request_times=self.lb.drain_request_times(),
+                    replicas=replica_snapshot)
                 target = decision.target_num_replicas
                 # Rolling step BEFORE probe/set_replicas: a replica retired
                 # here is excluded from this very tick's LB set, minimizing
@@ -82,6 +83,11 @@ class ServeController:
                 self.replica_manager.maybe_rolling_update(target)
                 ready = self.replica_manager.probe_all()
                 self.lb.set_replicas(ready)
+                if hasattr(self.lb.policy, 'set_weights'):
+                    # Instance-aware routing: endpoint -> capacity weight.
+                    self.lb.policy.set_weights({
+                        r['endpoint']: float(r.get('weight') or 1.0)
+                        for r in replica_snapshot if r.get('endpoint')})
                 if ready and not became_ready:
                     became_ready = True
                     serve_state.set_service_status(
@@ -94,8 +100,17 @@ class ServeController:
                     int(r.get('version') or 1) < self.replica_manager.version
                     for r in serve_state.list_replicas(self.service_name)
                     if r['status'] in live_statuses)
-                if target != self.replica_manager.num_alive() and not rolling:
-                    self.replica_manager.scale_to(target)
+                if rolling:
+                    pass  # version rollout owns replica churn this tick
+                elif decision.num_spot is not None:
+                    # Mixed-pool target (fallback autoscaler): spot fleet
+                    # plus the on-demand safety/gap pool.
+                    self.replica_manager.scale_mixed(
+                        decision.num_spot, decision.num_ondemand or 0)
+                elif target != self.replica_manager.num_alive():
+                    self.replica_manager.scale_to(
+                        target,
+                        preferred_victims=decision.preferred_victims)
                 self._stop.wait(self.poll_seconds)
         finally:
             self.replica_manager.teardown_all()

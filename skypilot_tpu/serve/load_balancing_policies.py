@@ -80,9 +80,39 @@ class LeastLoadPolicy(LoadBalancingPolicy):
                 0, self._inflight.get(replica, 0) - 1)
 
 
+class InstanceAwareLeastLoadPolicy(LeastLoadPolicy):
+    """Route to the replica with the lowest NORMALIZED load
+    (in-flight / capacity weight): a weight-2 replica (twice the chips)
+    keeps receiving traffic until it carries twice a weight-1 replica's
+    in-flight count (reference:
+    ``sky/serve/load_balancing_policies.py:151``)."""
+
+    def __init__(self):
+        super().__init__()
+        self._weights: Dict[str, float] = {}
+
+    def set_weights(self, weights: Dict[str, float]) -> None:
+        with self._lock:
+            self._weights = {k: max(float(v), 1e-6)
+                             for k, v in weights.items()}
+
+    def select(self) -> Optional[str]:
+        with self._lock:
+            if not self.replicas:
+                return None
+            def norm(r):
+                return (self._inflight.get(r, 0) /
+                        self._weights.get(r, 1.0))
+            low = min(norm(r) for r in self.replicas)
+            candidates = [r for r in self.replicas if norm(r) == low]
+            self._rotation += 1
+            return candidates[self._rotation % len(candidates)]
+
+
 POLICIES = {
     'round_robin': RoundRobinPolicy,
     'least_load': LeastLoadPolicy,
+    'instance_aware_least_load': InstanceAwareLeastLoadPolicy,
 }
 
 

@@ -55,7 +55,32 @@ class ReplicaManager:
 
     # -- scale up ----------------------------------------------------------
 
-    def launch_replica(self) -> int:
+    def _base_chips(self) -> float:
+        """Chips of the task's first-preference resources — the weight-1
+        capacity unit for instance-aware autoscaling/routing."""
+        for r in self.task.resources_ordered:
+            if r.tpu is not None:
+                return float(max(r.tpu.chips, 1))
+        return 1.0
+
+    def _replica_weight(self, cluster: str) -> float:
+        """Relative serving capacity of the LAUNCHED replica: chips vs the
+        task's base slice (any_of resources can land heterogeneous slice
+        sizes — a v5e-8 replica is worth two v5e-4s)."""
+        record = global_user_state.get_cluster(cluster)
+        if not record or not record.get('handle'):
+            return 1.0
+        h = record['handle']
+        chips = (float(h.get('chips_per_host') or 0) *
+                 float(h.get('hosts_per_node') or 1) *
+                 float(h.get('num_nodes') or 1))
+        if not h.get('is_tpu') or chips <= 0:
+            return 1.0
+        return chips / self._base_chips()
+
+    def launch_replica(self, use_spot: Optional[bool] = None) -> int:
+        """``use_spot`` overrides the task's spot preference (the fallback
+        autoscaler launches its on-demand safety pool this way)."""
         replica_id = self._next_replica_id
         self._next_replica_id += 1
         cluster = self._cluster_name(replica_id)
@@ -64,9 +89,10 @@ class ReplicaManager:
                                    cluster_name=cluster,
                                    version=self.version)
         task = Task.from_yaml_config(self.task.to_yaml_config())
-        if self.spot_placer is not None:
+        if use_spot is None and self.spot_placer is not None:
             # Spot with dynamic on-demand fallback under preemption pressure.
             use_spot = self.spot_placer.use_spot()
+        if use_spot is not None:
             task.set_resources([
                 r.copy(use_spot=use_spot) for r in task.resources_ordered])
         is_local = any(r.cloud in ('local', 'fake') or r.cloud is None
@@ -95,9 +121,13 @@ class ReplicaManager:
                     ip = head.external_ip or head.internal_ip
             except exceptions.SkyTpuError:
                 pass
-        serve_state.upsert_replica(self.service_name, replica_id,
-                                   serve_state.ReplicaStatus.STARTING,
-                                   endpoint=f'{ip}:{port}')
+        serve_state.upsert_replica(
+            self.service_name, replica_id,
+            serve_state.ReplicaStatus.STARTING,
+            endpoint=f'{ip}:{port}',
+            use_spot=bool(use_spot) if use_spot is not None else any(
+                r.use_spot for r in task.resources_ordered),
+            weight=self._replica_weight(cluster))
         return replica_id
 
     # -- scale down / replace ---------------------------------------------
@@ -212,13 +242,17 @@ class ReplicaManager:
         return sum(1 for r in serve_state.list_replicas(self.service_name)
                    if r['status'] in alive)
 
-    def scale_to(self, target: int) -> None:
+    def scale_to(self, target: int,
+                 preferred_victims: Optional[List[int]] = None) -> None:
+        """``preferred_victims``: replica ids the autoscaler wants retired
+        first on scale-down (instance-aware: smallest capacity first)."""
         alive = self.num_alive()
         while alive < target:
             self.launch_replica()
             alive += 1
         if alive > target:
-            # Prefer terminating non-ready replicas first.
+            preferred = preferred_victims or []
+            # Prefer the autoscaler's victims, then non-ready replicas.
             reps = serve_state.list_replicas(self.service_name)
             order = sorted(
                 (r for r in reps if r['status'] in (
@@ -226,11 +260,35 @@ class ReplicaManager:
                     serve_state.ReplicaStatus.STARTING,
                     serve_state.ReplicaStatus.NOT_READY,
                     serve_state.ReplicaStatus.READY)),
-                key=lambda r: (int(r.get('version') or 1) >= self.version,
+                key=lambda r: (r['replica_id'] not in preferred,
+                               int(r.get('version') or 1) >= self.version,
                                r['status'] == serve_state.ReplicaStatus.READY,
                                r['replica_id']))
             for rep in order[:alive - target]:
                 self.terminate_replica(rep['replica_id'])
+
+    def scale_mixed(self, num_spot: int, num_ondemand: int) -> None:
+        """Per-pool scaling for the fallback autoscaler: hold ``num_spot``
+        spot and ``num_ondemand`` on-demand replicas alive, launching and
+        retiring within each pool independently."""
+        alive_statuses = {serve_state.ReplicaStatus.PROVISIONING,
+                          serve_state.ReplicaStatus.STARTING,
+                          serve_state.ReplicaStatus.READY,
+                          serve_state.ReplicaStatus.NOT_READY}
+        pools = {True: [], False: []}
+        for r in serve_state.list_replicas(self.service_name):
+            if r['status'] in alive_statuses:
+                pools[bool(r.get('use_spot'))].append(r)
+        for spot, target in ((True, num_spot), (False, num_ondemand)):
+            have = pools[spot]
+            for _ in range(target - len(have)):
+                self.launch_replica(use_spot=spot)
+            if len(have) > target:
+                order = sorted(have, key=lambda r: (
+                    r['status'] == serve_state.ReplicaStatus.READY,
+                    r['replica_id']))
+                for rep in order[:len(have) - target]:
+                    self.terminate_replica(rep['replica_id'])
 
     def teardown_all(self) -> None:
         for rep in serve_state.list_replicas(self.service_name):

@@ -51,6 +51,8 @@ CREATE TABLE IF NOT EXISTS replicas (
     endpoint TEXT,
     created_at REAL,
     version INTEGER DEFAULT 1,
+    use_spot INTEGER DEFAULT 0,
+    weight REAL DEFAULT 1.0,
     PRIMARY KEY (service_name, replica_id)
 );
 """
@@ -75,7 +77,9 @@ def _conn() -> sqlite3.Connection:
             pass
     for ddl in ('ALTER TABLE services ADD COLUMN controller_restarts '
                 'INTEGER DEFAULT 0',
-                'ALTER TABLE services ADD COLUMN controller_claim_at REAL'):
+                'ALTER TABLE services ADD COLUMN controller_claim_at REAL',
+                'ALTER TABLE replicas ADD COLUMN use_spot INTEGER DEFAULT 0',
+                'ALTER TABLE replicas ADD COLUMN weight REAL DEFAULT 1.0'):
         try:
             conn.execute(ddl)
         except sqlite3.OperationalError:
@@ -226,7 +230,12 @@ def upsert_replica(service_name: str, replica_id: int,
                    status: ReplicaStatus,
                    cluster_name: Optional[str] = None,
                    endpoint: Optional[str] = None,
-                   version: Optional[int] = None) -> None:
+                   version: Optional[int] = None,
+                   use_spot: Optional[bool] = None,
+                   weight: Optional[float] = None) -> None:
+    """``use_spot``/``weight`` feed the instance-aware/fallback
+    autoscalers: weight is the replica's relative serving capacity (e.g.
+    chips vs the smallest replica), spot-ness drives on-demand fallback."""
     with _lock(), _conn() as conn:
         existing = conn.execute(
             'SELECT replica_id FROM replicas WHERE service_name = ? AND '
@@ -234,10 +243,11 @@ def upsert_replica(service_name: str, replica_id: int,
         if existing is None:
             conn.execute(
                 'INSERT INTO replicas (service_name, replica_id, status, '
-                'cluster_name, endpoint, created_at, version) '
-                'VALUES (?, ?, ?, ?, ?, ?, ?)',
+                'cluster_name, endpoint, created_at, version, use_spot, '
+                'weight) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)',
                 (service_name, replica_id, status.value, cluster_name,
-                 endpoint, time.time(), version or 1))
+                 endpoint, time.time(), version or 1,
+                 int(bool(use_spot)), weight if weight is not None else 1.0))
         else:
             sets, args = ['status = ?'], [status.value]
             if cluster_name is not None:
@@ -249,6 +259,12 @@ def upsert_replica(service_name: str, replica_id: int,
             if version is not None:
                 sets.append('version = ?')
                 args.append(version)
+            if use_spot is not None:
+                sets.append('use_spot = ?')
+                args.append(int(use_spot))
+            if weight is not None:
+                sets.append('weight = ?')
+                args.append(weight)
             args += [service_name, replica_id]
             conn.execute(
                 f'UPDATE replicas SET {", ".join(sets)} WHERE '

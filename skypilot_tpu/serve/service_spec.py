@@ -46,6 +46,9 @@ class ReplicaPolicy:
     # Spot replicas with automatic on-demand fallback under preemption
     # pressure (reference: ``sky/serve/spot_placer.py:254``).
     dynamic_ondemand_fallback: bool = False
+    # Always-on on-demand safety pool under a spot fleet; > 0 selects the
+    # FallbackRequestRateAutoscaler (reference: autoscalers.py:909).
+    base_ondemand_fallback_replicas: int = 0
 
     @property
     def autoscaling(self) -> bool:
@@ -62,7 +65,9 @@ class ReplicaPolicy:
                    max_replicas=cfg.get('max_replicas'),
                    target_qps_per_replica=cfg.get('target_qps_per_replica'),
                    dynamic_ondemand_fallback=bool(
-                       cfg.get('dynamic_ondemand_fallback', False)))
+                       cfg.get('dynamic_ondemand_fallback', False)),
+                   base_ondemand_fallback_replicas=int(
+                       cfg.get('base_ondemand_fallback_replicas', 0)))
 
 
 @dataclasses.dataclass
@@ -102,6 +107,8 @@ class ServiceSpec:
                     self.replica_policy.target_qps_per_replica,
                 'dynamic_ondemand_fallback':
                     self.replica_policy.dynamic_ondemand_fallback,
+                'base_ondemand_fallback_replicas':
+                    self.replica_policy.base_ondemand_fallback_replicas,
             },
             'port': self.port,
             'load_balancing_policy': self.load_balancing_policy,

@@ -1,19 +1,28 @@
-"""Web dashboard: fleet state in the browser.
+"""Web dashboard: fleet state in the browser, drillable per entity.
 
-Reference analog: ``sky/dashboard/`` (a 29k-LoC Next.js app served from the
-API server, ``server.py:2100``). TPU-native build keeps the dashboard
+Reference analog: ``sky/dashboard/`` (a 29k-LoC Next.js app served from
+the API server, ``server.py:2100``). TPU-native build keeps the dashboard
 dependency-free: one self-contained HTML page (no build step, no node)
-polling a read-only JSON state endpoint; clusters, managed jobs, services
-and API requests in one view.
+with hash-routed views — overview, per-cluster detail with live job log
+tail, per-managed-job detail, per-service detail with a replica/throughput
+chart, users and workspaces admin views — all over read-only JSON
+endpoints.
 
 Routes (registered by ``server.py``):
-  GET /dashboard            -> the page
-  GET /dashboard/api/state  -> {"clusters": [...], "jobs": [...],
-                                "services": [...], "requests": [...]}
+  GET /dashboard                           -> the app
+  GET /dashboard/api/state                 -> overview snapshot
+  GET /dashboard/api/cluster/{name}        -> cluster detail (+events,+jobs)
+  GET /dashboard/api/cluster/{name}/logs   -> job log tail (?job_id=, ?lines=)
+  GET /dashboard/api/job/{job_id}          -> managed-job detail
+  GET /dashboard/api/service/{name}        -> service detail (+replicas)
+  GET /dashboard/api/users                 -> users + roles
+  GET /dashboard/api/workspaces            -> workspaces + membership counts
 """
 from __future__ import annotations
 
-from typing import Any, Dict
+import asyncio
+import os
+from typing import Any, Dict, List, Optional
 
 from aiohttp import web
 
@@ -40,6 +49,7 @@ def state_snapshot() -> Dict[str, Any]:
             'nodes': handle.get('num_nodes'),
             'price_per_hour': handle.get('price_per_hour'),
             'launched_at': rec.get('launched_at'),
+            'workspace': rec.get('workspace'),
         })
     jobs = [{
         'job_id': r['job_id'],
@@ -65,6 +75,8 @@ def state_snapshot() -> Dict[str, Any]:
                 'status': r['status'].value,
                 'version': r.get('version'),
                 'endpoint': r['endpoint'],
+                'use_spot': bool(r.get('use_spot')),
+                'weight': r.get('weight'),
             } for r in replicas],
         })
     return {
@@ -75,9 +87,219 @@ def state_snapshot() -> Dict[str, Any]:
     }
 
 
+def _cluster_jobs(name: str) -> List[Dict[str, Any]]:
+    """The cluster's on-head job queue; remote heads are asked through the
+    agent (short timeout), unreachable heads return []."""
+    from skypilot_tpu import global_user_state
+    from skypilot_tpu.backends import ClusterHandle, TpuGangBackend
+    rec = global_user_state.get_cluster(name)
+    if not rec or not rec.get('handle'):
+        return []
+    try:
+        backend = TpuGangBackend()
+        handle = ClusterHandle.from_dict(rec['handle'])
+        return backend.job_queue(handle)[:50]
+    except Exception:  # noqa: BLE001 — dashboard read must not 500
+        return []
+
+
+def cluster_detail(name: str) -> Optional[Dict[str, Any]]:
+    from skypilot_tpu import global_user_state
+    rec = global_user_state.get_cluster(name)
+    if rec is None:
+        return None
+    handle = rec.get('handle') or {}
+    return {
+        'name': name,
+        'status': rec['status'].value,
+        'workspace': rec.get('workspace'),
+        'owner': rec.get('owner'),
+        'launched_at': rec.get('launched_at'),
+        'autostop_minutes': rec.get('autostop_minutes'),
+        'handle': handle,
+        'events': global_user_state.get_cluster_events(name, limit=50),
+        'jobs': _cluster_jobs(name),
+    }
+
+
+def _job_log_tail(cluster: str, job_id: Optional[int],
+                  lines: int = 500) -> Dict[str, Any]:
+    """Last N log lines of a job (newest job when unspecified): local
+    clusters read the runtime dir; remote-control clusters ask the head
+    agent."""
+    from skypilot_tpu import global_user_state
+    from skypilot_tpu.backends import ClusterHandle, TpuGangBackend
+    from skypilot_tpu.backends.tpu_gang_backend import runtime_dir
+    rec = global_user_state.get_cluster(cluster)
+    if not rec or not rec.get('handle'):
+        return {'error': 'cluster not found', 'lines': []}
+    handle = ClusterHandle.from_dict(rec['handle'])
+    backend = TpuGangBackend()
+    try:
+        if backend.is_remote_controlled(handle):
+            from skypilot_tpu.agent import remote as remote_lib
+            client = remote_lib.agent_client(
+                cluster, backend._head_spec(handle))  # pylint: disable=protected-access
+            if job_id is None:
+                jobs = client.list_jobs(limit=1)
+                if not jobs:
+                    return {'job_id': None, 'lines': []}
+                job_id = jobs[0]['job_id']
+            out = ''.join(client.tail_log(job_id, lines=lines,
+                                          follow=False))
+            return {'job_id': job_id, 'lines': out.splitlines()[-lines:]}
+        cdir = runtime_dir(cluster)
+        if job_id is None:
+            from skypilot_tpu.agent import job_lib
+            jobs = job_lib.JobTable(cdir).list_jobs(limit=1)
+            if not jobs:
+                return {'job_id': None, 'lines': []}
+            job_id = jobs[0]['job_id']
+        path = os.path.join(cdir, 'jobs', str(job_id), 'run.log')
+        if not os.path.exists(path):
+            return {'job_id': job_id, 'lines': []}
+        with open(path, 'rb') as f:
+            data = f.read()[-1 << 20:]
+        return {'job_id': job_id,
+                'lines': data.decode('utf-8',
+                                     errors='replace').splitlines()[-lines:]}
+    except Exception as e:  # noqa: BLE001 — dashboard read must not 500
+        return {'job_id': job_id, 'lines': [], 'error': str(e)}
+
+
+def job_detail(job_id: int) -> Optional[Dict[str, Any]]:
+    from skypilot_tpu.jobs import state as jobs_state
+    rec = jobs_state.get(job_id)
+    if rec is None:
+        return None
+    return {
+        'job_id': job_id,
+        'name': rec['name'],
+        'status': rec['status'].value,
+        'schedule_state': rec.get('schedule_state'),
+        'cluster': rec['cluster_name'],
+        'recoveries': rec['recovery_count'],
+        'controller_pid': rec.get('controller_pid'),
+        'controller_restarts': rec.get('controller_restarts'),
+        'recovery_strategy': rec.get('recovery_strategy'),
+        'submitted_at': rec.get('submitted_at'),
+        'detail': rec.get('detail'),
+        'task_config': rec.get('task_config'),
+    }
+
+
+def service_detail(name: str) -> Optional[Dict[str, Any]]:
+    from skypilot_tpu.serve import serve_state
+    svc = serve_state.get_service(name)
+    if svc is None:
+        return None
+    return {
+        'name': name,
+        'status': svc['status'].value,
+        'endpoint': svc['endpoint'],
+        'version': svc.get('version'),
+        'controller_pid': svc.get('controller_pid'),
+        'controller_restarts': svc.get('controller_restarts'),
+        'spec': svc.get('spec'),
+        'replicas': [{
+            'replica_id': r['replica_id'],
+            'status': r['status'].value,
+            'version': r.get('version'),
+            'endpoint': r['endpoint'],
+            'cluster_name': r.get('cluster_name'),
+            'use_spot': bool(r.get('use_spot')),
+            'weight': r.get('weight'),
+            'created_at': r.get('created_at'),
+        } for r in serve_state.list_replicas(name)],
+    }
+
+
+def users_view() -> List[Dict[str, Any]]:
+    from skypilot_tpu import users as users_lib
+    try:
+        return [{'name': u['name'], 'role': u['role'],
+                 'created_at': u.get('created_at')}
+                for u in users_lib.list_users()]
+    except Exception:  # noqa: BLE001 — no users table yet
+        return []
+
+
+def workspaces_view() -> List[Dict[str, Any]]:
+    from skypilot_tpu import global_user_state
+    from skypilot_tpu import workspaces as workspaces_lib
+    clusters = global_user_state.get_clusters()
+    out = []
+    for ws in workspaces_lib.list_workspaces():
+        n = sum(1 for c in clusters if c.get('workspace') == ws['name'])
+        out.append({'name': ws['name'], 'created_at': ws.get('created_at'),
+                    'created_by': ws.get('created_by'), 'clusters': n})
+    return out
+
+
+# -- aiohttp handlers (blocking reads run in the default executor) ----------
+
+
+async def _json(request: web.Request, fn, *args) -> web.Response:
+    loop = asyncio.get_event_loop()
+    result = await loop.run_in_executor(None, fn, *args)
+    if result is None:
+        return web.json_response({'error': 'not found'}, status=404)
+    return web.json_response(result)
+
+
 async def api_state(request: web.Request) -> web.Response:
-    del request
-    return web.json_response(state_snapshot())
+    return await _json(request, state_snapshot)
+
+
+async def api_cluster(request: web.Request) -> web.Response:
+    return await _json(request, cluster_detail,
+                       request.match_info['name'])
+
+
+def _int_or(value, default):
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        return default
+
+
+async def api_cluster_logs(request: web.Request) -> web.Response:
+    job_id = _int_or(request.query.get('job_id'), None)
+    lines = min(max(_int_or(request.query.get('lines'), 500), 1), 10000)
+    return await _json(request, _job_log_tail, request.match_info['name'],
+                       job_id, lines)
+
+
+async def api_job(request: web.Request) -> web.Response:
+    job_id = _int_or(request.match_info['job_id'], None)
+    if job_id is None:
+        return web.json_response({'error': 'bad job id'}, status=400)
+    return await _json(request, job_detail, job_id)
+
+
+async def api_service(request: web.Request) -> web.Response:
+    return await _json(request, service_detail,
+                       request.match_info['name'])
+
+
+async def api_users(request: web.Request) -> web.Response:
+    return await _json(request, users_view)
+
+
+async def api_workspaces(request: web.Request) -> web.Response:
+    return await _json(request, workspaces_view)
+
+
+def add_routes(app: web.Application) -> None:
+    app.router.add_get('/dashboard', page)
+    app.router.add_get('/dashboard/api/state', api_state)
+    app.router.add_get('/dashboard/api/cluster/{name}', api_cluster)
+    app.router.add_get('/dashboard/api/cluster/{name}/logs',
+                       api_cluster_logs)
+    app.router.add_get('/dashboard/api/job/{job_id}', api_job)
+    app.router.add_get('/dashboard/api/service/{name}', api_service)
+    app.router.add_get('/dashboard/api/users', api_users)
+    app.router.add_get('/dashboard/api/workspaces', api_workspaces)
 
 
 _PAGE = """<!doctype html>
@@ -86,6 +308,8 @@ _PAGE = """<!doctype html>
  body{font-family:system-ui,sans-serif;margin:24px;background:#fafafa;
       color:#1a1a1a}
  h1{font-size:20px} h2{font-size:15px;margin:24px 0 8px}
+ a{color:#0b57d0;text-decoration:none} a:hover{text-decoration:underline}
+ nav a{margin-right:14px;font-size:13px}
  table{border-collapse:collapse;width:100%;background:#fff;
        box-shadow:0 1px 2px rgba(0,0,0,.08)}
  th,td{padding:6px 10px;text-align:left;font-size:13px;
@@ -100,63 +324,187 @@ _PAGE = """<!doctype html>
  .FAILED,.FAILED_SETUP,.FAILED_CONTROLLER,.FAILED_NO_RESOURCE,.NOT_READY
  {background:#fbdcd9;color:#9d1c0e}
  #ts{color:#888;font-size:12px}
+ pre.log{background:#101418;color:#d7e2ea;padding:12px;border-radius:6px;
+      font-size:12px;max-height:420px;overflow:auto;white-space:pre-wrap}
+ .kv td:first-child{color:#666;width:220px}
+ svg.chart{background:#fff;box-shadow:0 1px 2px rgba(0,0,0,.08);
+      border-radius:4px}
 </style></head><body>
 <h1>skypilot-tpu <span id="ts"></span></h1>
-<h2>Clusters</h2><table id="clusters"></table>
-<h2>Managed jobs</h2><table id="jobs"></table>
-<h2>Services</h2><table id="services"></table>
-<h2>API requests</h2><table id="requests"></table>
+<nav><a href="#/">overview</a> <a href="#/users">users</a>
+ <a href="#/workspaces">workspaces</a></nav>
+<div id="view"></div>
 <script>
 // Token-protected servers: open /dashboard?token=...; the token rides
-// along on state polls.
+// along on every api poll.
 const TOKEN = new URLSearchParams(location.search).get('token');
 const HDRS = TOKEN ? {'Authorization': 'Bearer ' + TOKEN} : {};
-// Escape EVERYTHING interpolated into innerHTML: names/endpoints are
+// Escape EVERYTHING interpolated into innerHTML: names/endpoints/logs are
 // user-controlled (stored-XSS vector otherwise).
 const esc = v => String(v ?? '-').replace(/[&<>"']/g,
     ch => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[ch]));
 const B = s => `<span class="b ${esc(s)}">${esc(s)}</span>`;
 const T = t => t ? new Date(t*1000).toLocaleTimeString() : '-';
-function fill(id, cols, rows, render){
-  const el = document.getElementById(id);
-  el.innerHTML = '<tr>' + cols.map(c=>`<th>${c}</th>`).join('') + '</tr>' +
-    (rows.length ? rows.map(render).join('')
-                 : `<tr><td colspan="${cols.length}">none</td></tr>`);
+const J = async p => {
+  const r = await fetch(p, {headers: HDRS});
+  if(!r.ok) throw new Error(p + ' -> ' + r.status);
+  return r.json();
+};
+const table = (cols, rows, render) =>
+  '<table><tr>' + cols.map(c=>`<th>${c}</th>`).join('') + '</tr>' +
+  (rows.length ? rows.map(render).join('')
+               : `<tr><td colspan="${cols.length}">none</td></tr>`) +
+  '</table>';
+const kv = obj => '<table class="kv">' + Object.entries(obj).map(
+  ([k,v])=>`<tr><td>${esc(k)}</td><td>${v}</td></tr>`).join('') + '</table>';
+
+// Per-service time series the chart view accumulates while open:
+// [t, readyReplicas, reqPerPoll].
+const series = {};
+function sparkline(data, color, ymax){
+  if(data.length < 2) return '(collecting…)';
+  const W=560, H=80, n=data.length;
+  const pts = data.map((v,i)=>
+    `${(i/(n-1)*W).toFixed(1)},${(H-4-(v/Math.max(ymax,1))*(H-8)).toFixed(1)}`);
+  return `<svg class="chart" width="${W}" height="${H}">`+
+    `<polyline fill="none" stroke="${color}" stroke-width="2" `+
+    `points="${pts.join(' ')}"/></svg>`;
 }
-async function tick(){
+
+async function overview(){
+  const s = await J('dashboard/api/state');
+  return `<h2>Clusters</h2>` + table(
+    ['name','status','cloud','region','resources','nodes','$/hr','ws',
+     'launched'], s.clusters,
+    c=>`<tr><td><a href="#/cluster/${esc(c.name)}">${esc(c.name)}</a></td>
+     <td>${B(c.status)}</td><td>${esc(c.cloud)}</td><td>${esc(c.region)}</td>
+     <td>${esc(c.resources)}</td><td>${c.nodes??'-'}</td>
+     <td>${c.price_per_hour!=null?c.price_per_hour.toFixed(2):'-'}</td>
+     <td>${esc(c.workspace)}</td><td>${T(c.launched_at)}</td></tr>`) +
+  `<h2>Managed jobs</h2>` + table(
+    ['id','name','status','schedule','cluster','recoveries','submitted'],
+    s.jobs,
+    j=>`<tr><td><a href="#/job/${j.job_id}">${esc(j.job_id)}</a></td>
+     <td>${esc(j.name)}</td><td>${B(j.status)}</td>
+     <td>${B(j.schedule_state)}</td>
+     <td><a href="#/cluster/${esc(j.cluster)}">${esc(j.cluster)}</a></td>
+     <td>${esc(j.recoveries)}</td><td>${T(j.submitted_at)}</td></tr>`) +
+  `<h2>Services</h2>` + table(
+    ['name','status','version','endpoint','replicas'], s.services,
+    v=>`<tr><td><a href="#/service/${esc(v.name)}">${esc(v.name)}</a></td>
+     <td>${B(v.status)}</td><td>v${v.version??1}</td>
+     <td>${esc(v.endpoint)}</td>
+     <td>${v.replicas.map(r=>`#${esc(r.replica_id)} ${B(r.status)}`)
+          .join(' ')}</td></tr>`) +
+  `<h2>API requests</h2>` + table(
+    ['request id','op','status','created','finished'], s.requests,
+    r=>`<tr><td>${esc(r.request_id)}</td><td>${esc(r.name)}</td>
+     <td>${B(r.status)}</td><td>${T(r.created_at)}</td>
+     <td>${T(r.finished_at)}</td></tr>`);
+}
+
+async function clusterView(name){
+  const c = await J('dashboard/api/cluster/' + encodeURIComponent(name));
+  const logs = await J('dashboard/api/cluster/' +
+                       encodeURIComponent(name) + '/logs');
+  const h = c.handle || {};
+  return `<h2>Cluster ${esc(name)}</h2>` + kv({
+      status: B(c.status), cloud: esc(h.cloud), region: esc(h.region),
+      zone: esc(h.zone), nodes: esc(h.num_nodes),
+      'hosts/node': esc(h.hosts_per_node),
+      'chips/host': esc(h.chips_per_host),
+      workspace: esc(c.workspace), owner: esc(c.owner),
+      'autostop (min)': esc(c.autostop_minutes),
+      '$/hr': h.price_per_hour!=null?h.price_per_hour.toFixed(2):'-',
+      launched: T(c.launched_at)}) +
+    `<h2>Job queue</h2>` + table(
+      ['id','name','status','submitted','ended'], c.jobs||[],
+      j=>`<tr><td>${esc(j.job_id)}</td><td>${esc(j.name)}</td>
+       <td>${B(j.status)}</td><td>${T(j.submitted_at)}</td>
+       <td>${T(j.ended_at)}</td></tr>`) +
+    `<h2>Log tail ${logs.job_id!=null?'(job '+esc(logs.job_id)+')':''}</h2>`+
+    `<pre class="log">${esc((logs.lines||[]).join('\\n')) || '(no logs)'}`+
+    `</pre>` +
+    `<h2>Events</h2>` + table(
+      ['time','event','detail'], c.events||[],
+      e=>`<tr><td>${T(e.timestamp)}</td><td>${esc(e.event)}</td>
+       <td>${esc(e.detail)}</td></tr>`);
+}
+
+async function jobView(id){
+  const j = await J('dashboard/api/job/' + id);
+  return `<h2>Managed job ${esc(id)}: ${esc(j.name)}</h2>` + kv({
+      status: B(j.status), schedule: B(j.schedule_state),
+      cluster: `<a href="#/cluster/${esc(j.cluster)}">${esc(j.cluster)}</a>`,
+      recoveries: esc(j.recoveries),
+      'recovery strategy': esc(j.recovery_strategy),
+      'controller pid': esc(j.controller_pid),
+      'controller restarts': esc(j.controller_restarts),
+      submitted: T(j.submitted_at), detail: esc(j.detail)}) +
+    `<h2>Task config</h2><pre class="log">${
+      esc(JSON.stringify(j.task_config, null, 2))}</pre>`;
+}
+
+async function serviceView(name){
+  const v = await J('dashboard/api/service/' + encodeURIComponent(name));
+  const ready = v.replicas.filter(r=>r.status==='READY').length;
+  const st = series[name] = (series[name]||[]);
+  st.push(ready);
+  if(st.length > 120) st.shift();
+  const maxR = Math.max(...st, 1);
+  return `<h2>Service ${esc(name)}</h2>` + kv({
+      status: B(v.status), endpoint: esc(v.endpoint),
+      version: 'v' + (v.version??1),
+      'controller pid': esc(v.controller_pid),
+      'controller restarts': esc(v.controller_restarts),
+      'ready replicas': `${ready}/${v.replicas.length}`}) +
+    `<h2>Ready replicas over time</h2>` + sparkline(st, '#0b57d0', maxR) +
+    `<h2>Replicas</h2>` + table(
+      ['id','status','version','endpoint','cluster','spot','weight',
+       'created'], v.replicas,
+      r=>`<tr><td>${esc(r.replica_id)}</td><td>${B(r.status)}</td>
+       <td>v${r.version??1}</td><td>${esc(r.endpoint)}</td>
+       <td>${esc(r.cluster_name)}</td><td>${r.use_spot?'spot':'od'}</td>
+       <td>${esc(r.weight)}</td><td>${T(r.created_at)}</td></tr>`) +
+    `<h2>Spec</h2><pre class="log">${
+      esc(JSON.stringify(v.spec, null, 2))}</pre>`;
+}
+
+async function usersView(){
+  const u = await J('dashboard/api/users');
+  return '<h2>Users</h2>' + table(['name','role','created'], u,
+    x=>`<tr><td>${esc(x.name)}</td><td>${esc(x.role)}</td>
+     <td>${T(x.created_at)}</td></tr>`);
+}
+
+async function workspacesView(){
+  const w = await J('dashboard/api/workspaces');
+  return '<h2>Workspaces</h2>' + table(
+    ['name','clusters','created by','created'], w,
+    x=>`<tr><td>${esc(x.name)}</td><td>${esc(x.clusters)}</td>
+     <td>${esc(x.created_by)}</td><td>${T(x.created_at)}</td></tr>`);
+}
+
+async function route(){
+  const h = location.hash || '#/';
+  let html;
   try{
-    const s = await (await fetch('dashboard/api/state', {headers: HDRS})).json();
+    let m;
+    if((m = h.match(/^#\\/cluster\\/(.+)$/)))
+      html = await clusterView(decodeURIComponent(m[1]));
+    else if((m = h.match(/^#\\/job\\/(\\d+)$/))) html = await jobView(m[1]);
+    else if((m = h.match(/^#\\/service\\/(.+)$/)))
+      html = await serviceView(decodeURIComponent(m[1]));
+    else if(h === '#/users') html = await usersView();
+    else if(h === '#/workspaces') html = await workspacesView();
+    else html = await overview();
     document.getElementById('ts').textContent =
         'updated ' + new Date().toLocaleTimeString();
-    fill('clusters',
-         ['name','status','cloud','region','resources','nodes','$/hr',
-          'launched'],
-         s.clusters, c=>`<tr><td>${esc(c.name)}</td><td>${B(c.status)}</td>
-          <td>${esc(c.cloud)}</td><td>${esc(c.region)}</td>
-          <td>${esc(c.resources)}</td><td>${c.nodes??'-'}</td>
-          <td>${c.price_per_hour!=null?c.price_per_hour.toFixed(2):'-'}</td>
-          <td>${T(c.launched_at)}</td></tr>`);
-    fill('jobs',
-         ['id','name','status','schedule','cluster','recoveries',
-          'submitted'],
-         s.jobs, j=>`<tr><td>${esc(j.job_id)}</td><td>${esc(j.name)}</td>
-          <td>${B(j.status)}</td><td>${B(j.schedule_state)}</td>
-          <td>${esc(j.cluster)}</td><td>${esc(j.recoveries)}</td>
-          <td>${T(j.submitted_at)}</td></tr>`);
-    fill('services',
-         ['name','status','version','endpoint','replicas'],
-         s.services, v=>`<tr><td>${esc(v.name)}</td><td>${B(v.status)}</td>
-          <td>v${v.version??1}</td><td>${esc(v.endpoint)}</td>
-          <td>${v.replicas.map(r=>`#${esc(r.replica_id)} ${B(r.status)}
-          v${r.version??1}`).join(' ')}</td></tr>`);
-    fill('requests',
-         ['request id','op','status','created','finished'],
-         s.requests, r=>`<tr><td>${esc(r.request_id)}</td><td>${esc(r.name)}</td>
-          <td>${B(r.status)}</td><td>${T(r.created_at)}</td>
-          <td>${T(r.finished_at)}</td></tr>`);
-  }catch(e){ document.getElementById('ts').textContent = 'error: '+e; }
+  }catch(e){ html = `<p>error: ${esc(e.message)}</p>`; }
+  document.getElementById('view').innerHTML = html;
 }
-tick(); setInterval(tick, 2000);
+window.addEventListener('hashchange', route);
+route(); setInterval(route, 2000);
 </script></body></html>"""
 
 

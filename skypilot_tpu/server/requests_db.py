@@ -9,7 +9,6 @@ from __future__ import annotations
 import enum
 import json
 import os
-import sqlite3
 import time
 import uuid
 from typing import Any, Dict, List, Optional
@@ -65,11 +64,12 @@ def request_log_path(request_id: str) -> str:
     return os.path.join(d, f'{request_id}.log')
 
 
-def _conn() -> sqlite3.Connection:
-    conn = sqlite3.connect(_db_path(), timeout=10)
-    conn.row_factory = sqlite3.Row
-    conn.executescript(_SCHEMA)
-    return conn
+def _conn():
+    # SQLite file by default; one shared Postgres when SKYTPU_DB_URL is
+    # set — the requirement for running multiple API-server replicas
+    # against common request state (utils/db_utils.py).
+    from skypilot_tpu.utils import db_utils
+    return db_utils.connect(_db_path(), _SCHEMA)
 
 
 def _lock() -> filelock.FileLock:

@@ -204,8 +204,7 @@ def make_app() -> web.Application:
     from skypilot_tpu.server import dashboard
     app = web.Application(middlewares=[auth_middleware])
     app.add_routes(routes)
-    app.router.add_get('/dashboard', dashboard.page)
-    app.router.add_get('/dashboard/api/state', dashboard.api_state)
+    dashboard.add_routes(app)
     for op in ('launch', 'exec', 'down', 'stop', 'start', 'autostop',
                'cancel'):
         app.router.add_post(f'/api/v1/{op}', _make_post(op))

@@ -16,7 +16,6 @@ import os
 import re
 from typing import Any, Callable, Dict, List, Optional, Set, Union
 
-from skypilot_tpu import exceptions
 from skypilot_tpu.resources import Resources
 from skypilot_tpu.utils import common_utils
 

@@ -12,7 +12,6 @@ from __future__ import annotations
 import os
 from typing import Any, Dict, Optional
 
-import jax
 import orbax.checkpoint as ocp
 
 

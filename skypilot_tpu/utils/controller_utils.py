@@ -16,7 +16,6 @@ from __future__ import annotations
 import os
 import shlex
 import sys
-from typing import Optional
 
 JOBS_CONTROLLER_CLUSTER = 'sky-jobs-controller'
 SERVE_CONTROLLER_CLUSTER = 'sky-serve-controller'

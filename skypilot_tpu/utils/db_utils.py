@@ -1,0 +1,188 @@
+"""State-database abstraction: SQLite by default, Postgres by URL.
+
+Reference analog: ``sky/utils/db/db_utils.py`` + ``migration_utils.py`` —
+the reference abstracts its DB layer precisely so multi-replica API
+servers can share state. SQLite caps the API server at single-host
+deployments; pointing ``SKYTPU_DB_URL`` at ``postgres://user:pw@host/db``
+lets every state module that opts in (``global_user_state``,
+``server/requests_db``) share one Postgres instead.
+
+Design: call sites keep writing sqlite-flavored SQL ('?' placeholders,
+sqlite DDL); the Postgres adapter translates at execute time
+(placeholders, AUTOINCREMENT/REAL DDL, duplicate-column migration
+errors). The driver is psycopg2 or pg8000 when installed; tests inject a
+stub via ``set_postgres_driver_for_testing`` so the translation path is
+exercised without a live server.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sqlite3
+from typing import Any, Callable, Optional
+
+# Call sites catch sqlite3.OperationalError for idempotent ALTER TABLE
+# migrations; the Postgres adapter raises the same type so one except
+# clause covers both backends.
+OperationalError = sqlite3.OperationalError
+
+_pg_driver_override: Optional[Callable[[str], Any]] = None
+
+
+def set_postgres_driver_for_testing(
+        factory: Optional[Callable[[str], Any]]) -> None:
+    """``factory(url) -> DBAPI connection`` (None restores autodetect)."""
+    global _pg_driver_override
+    _pg_driver_override = factory
+
+
+def db_url() -> Optional[str]:
+    return os.environ.get('SKYTPU_DB_URL') or None
+
+
+def _pg_connect(url: str):
+    if _pg_driver_override is not None:
+        return _pg_driver_override(url)
+    try:
+        import psycopg2  # type: ignore
+        return psycopg2.connect(url)
+    except ImportError:
+        pass
+    try:
+        import pg8000.dbapi  # type: ignore
+        from urllib.parse import urlparse
+        u = urlparse(url)
+        return pg8000.dbapi.connect(
+            user=u.username or 'postgres', password=u.password,
+            host=u.hostname or 'localhost', port=u.port or 5432,
+            database=(u.path or '/postgres').lstrip('/'))
+    except ImportError as e:
+        raise OperationalError(
+            f'SKYTPU_DB_URL={url!r} set but no Postgres driver available '
+            '(install psycopg2 or pg8000).') from e
+
+
+_DDL_REWRITES = (
+    (re.compile(r'INTEGER PRIMARY KEY AUTOINCREMENT', re.I),
+     'BIGSERIAL PRIMARY KEY'),
+    (re.compile(r'\bREAL\b', re.I), 'DOUBLE PRECISION'),
+    (re.compile(r'\bBLOB\b', re.I), 'BYTEA'),
+)
+
+
+def _to_pg_sql(sql: str) -> str:
+    for pat, repl in _DDL_REWRITES:
+        sql = pat.sub(repl, sql)
+    # '?' -> '%s' outside quoted strings.
+    out, in_str = [], False
+    for ch in sql:
+        if ch == "'":
+            in_str = not in_str
+            out.append(ch)
+        elif ch == '?' and not in_str:
+            out.append('%s')
+        else:
+            out.append(ch)
+    return ''.join(out)
+
+
+class _PgCursorWrapper:
+    """Rows behave like sqlite3.Row enough for the call sites: mapping
+    access by column name plus dict()/iteration."""
+
+    def __init__(self, cursor):
+        self._c = cursor
+
+    @property
+    def rowcount(self) -> int:
+        return self._c.rowcount
+
+    def _cols(self):
+        return [d[0] for d in self._c.description or ()]
+
+    def _wrap(self, row):
+        if row is None:
+            return None
+        return _RowDict(zip(self._cols(), row))
+
+    def fetchone(self):
+        return self._wrap(self._c.fetchone())
+
+    def fetchall(self):
+        return [self._wrap(r) for r in self._c.fetchall()]
+
+
+class _RowDict(dict):
+    """dict subclass so both row['col'] and dict(row) work (sqlite3.Row
+    parity)."""
+
+    def keys(self):  # sqlite3.Row.keys() returns a list
+        return list(super().keys())
+
+
+class PostgresConnection:
+    """Context-managed adapter matching the sqlite3.Connection surface the
+    state modules use: execute/executescript, commit-on-exit."""
+
+    def __init__(self, url: str):
+        self._conn = _pg_connect(url)
+
+    def execute(self, sql: str, params=()) -> _PgCursorWrapper:
+        cur = self._conn.cursor()
+        try:
+            cur.execute(_to_pg_sql(sql), tuple(params))
+        except Exception as e:  # noqa: BLE001 — normalize driver errors
+            msg = str(e)
+            # Make idempotent-migration failures (duplicate column) look
+            # like sqlite's so call sites' except clause works; real
+            # errors keep their message.
+            try:
+                self._conn.rollback()
+            except Exception:  # noqa: BLE001
+                pass
+            raise OperationalError(msg) from e
+        return _PgCursorWrapper(cur)
+
+    def executescript(self, script: str) -> None:
+        for stmt in script.split(';'):
+            if stmt.strip():
+                self.execute(stmt)
+
+    def __enter__(self) -> 'PostgresConnection':
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            self._conn.commit()
+        else:
+            try:
+                self._conn.rollback()
+            except Exception:  # noqa: BLE001
+                pass
+        self._conn.close()
+
+    def close(self) -> None:
+        try:
+            self._conn.commit()
+        finally:
+            self._conn.close()
+
+
+def connect(sqlite_path: str, schema: str,
+            migrations: tuple = ()) -> Any:
+    """Open the state DB: Postgres when SKYTPU_DB_URL is set, else the
+    module's own SQLite file. Applies the schema and idempotent
+    migrations either way."""
+    url = db_url()
+    if url and url.startswith(('postgres://', 'postgresql://')):
+        conn = PostgresConnection(url)
+    else:
+        conn = sqlite3.connect(sqlite_path, timeout=10)
+        conn.row_factory = sqlite3.Row
+    conn.executescript(schema)
+    for ddl in migrations:
+        try:
+            conn.execute(ddl)
+        except OperationalError:
+            pass  # column already present
+    return conn

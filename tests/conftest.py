@@ -28,6 +28,29 @@ jax.config.update('jax_platforms', 'cpu')
 
 import pytest
 
+# Suite tiers for CI (`make test-fast` < 5 min): modules dominated by jax
+# compiles or real process orchestration are `slow`; sustained load/chaos
+# suites are `load`. Everything else runs in the default fast selection.
+_SLOW_MODULES = {
+    'test_agent_rpc', 'test_api_server', 'test_e2e_launch', 'test_examples',
+    'test_generate', 'test_grpc_exec', 'test_ha_controllers',
+    'test_managed_jobs', 'test_model_and_trainer', 'test_native_gang',
+    'test_ops_attention', 'test_parallel', 'test_pipeline_moe',
+    'test_remote_control', 'test_serve', 'test_serve_ha', 'test_slurm_cloud',
+    'test_ssh_path', 'test_storage_and_checkpoint',
+}
+_LOAD_MODULES = {'test_load'}
+
+
+def pytest_collection_modifyitems(config, items):
+    del config
+    for item in items:
+        mod = item.module.__name__.rsplit('.', 1)[-1]
+        if mod in _LOAD_MODULES:
+            item.add_marker(pytest.mark.load)
+        elif mod in _SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)
+
 
 @pytest.fixture()
 def tmp_state_dir(tmp_path, monkeypatch):

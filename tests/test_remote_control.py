@@ -13,7 +13,6 @@ The rig: provisioning uses the fake cloud, every "host" is a fake-ssh HOME,
 loopback, dialed with SKYTPU_AGENT_DIAL=direct). Submission, status, queue,
 logs, and cancel all round-trip through that agent.
 """
-import os
 import sys
 import time
 

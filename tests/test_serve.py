@@ -251,3 +251,195 @@ def test_replica_manager_applies_spot_placer(monkeypatch, tmp_state_dir):
     mgr.launch_replica()
     assert not any(launched[1])  # fallback to on-demand
     serve_state.remove_service('svc-sp')
+
+
+# -- instance-aware + fallback autoscaling (reference autoscalers.py:581,909)
+
+
+def _rep(rid, status='READY', weight=1.0, use_spot=False):
+    return {'replica_id': rid, 'status': status, 'weight': weight,
+            'use_spot': use_spot, 'endpoint': f'10.0.0.{rid}:80'}
+
+
+def _times(qps, now, window=60.0):
+    n = int(qps * window)
+    return [now - (i % int(window)) - 0.5 for i in range(n)]
+
+
+def test_instance_aware_upscale_counts_capacity_not_replicas():
+    from skypilot_tpu.serve.autoscalers import (
+        InstanceAwareRequestRateAutoscaler)
+    pol = ReplicaPolicy(min_replicas=1, max_replicas=10,
+                        target_qps_per_replica=10)
+    auto = InstanceAwareRequestRateAutoscaler(pol,
+                                              upscale_counter_threshold=1)
+    now = 1000.0
+    # Two replicas, but one is weight-3 (e.g. v5e-12 vs v5e-4): aggregate
+    # capacity = 4 units = 40 qps. 35 qps must NOT scale up...
+    reps = [_rep(1, weight=3.0), _rep(2, weight=1.0)]
+    d = auto.evaluate(2, 0, _times(35, now), now=now, replicas=reps)
+    assert d.target_num_replicas <= 2
+    # ...but 55 qps needs 1.5 more units -> 2 more weight-1 replicas.
+    auto2 = InstanceAwareRequestRateAutoscaler(pol,
+                                               upscale_counter_threshold=1)
+    d = auto2.evaluate(2, 0, _times(55, now), now=now, replicas=reps)
+    assert d.target_num_replicas == 4
+    # A replica-count policy would have asked for ceil(55/10)=6.
+
+
+def test_instance_aware_downscale_prefers_smallest_victims():
+    from skypilot_tpu.serve.autoscalers import (
+        InstanceAwareRequestRateAutoscaler)
+    pol = ReplicaPolicy(min_replicas=1, max_replicas=10,
+                        target_qps_per_replica=10)
+    auto = InstanceAwareRequestRateAutoscaler(
+        pol, upscale_counter_threshold=1, downscale_counter_threshold=1)
+    now = 1000.0
+    # weight-4 + two weight-1s = 6 units = 60 qps capacity; at 38 qps the
+    # two SMALL replicas cannot both go (4 < 3.8 units... 4 units >= 3.8
+    # -> both CAN go); victims must be the small ones, never the big one.
+    reps = [_rep(1, weight=4.0), _rep(2, weight=1.0), _rep(3, weight=1.0)]
+    d = auto.evaluate(3, 0, _times(38, now), now=now, replicas=reps)
+    assert d.target_num_replicas == 1
+    assert d.preferred_victims == [2, 3]
+    # At 45 qps only ONE small replica may retire (4+1=5 units covers
+    # 4.5; 4 units would not).
+    auto2 = InstanceAwareRequestRateAutoscaler(
+        pol, upscale_counter_threshold=1, downscale_counter_threshold=1)
+    d = auto2.evaluate(3, 0, _times(45, now), now=now, replicas=reps)
+    assert d.target_num_replicas == 2
+    assert d.preferred_victims == [2]
+
+
+def test_instance_aware_respects_min_replicas():
+    from skypilot_tpu.serve.autoscalers import (
+        InstanceAwareRequestRateAutoscaler)
+    pol = ReplicaPolicy(min_replicas=2, max_replicas=10,
+                        target_qps_per_replica=10)
+    auto = InstanceAwareRequestRateAutoscaler(
+        pol, downscale_counter_threshold=1)
+    auto._target = 3  # pretend we scaled up earlier
+    now = 1000.0
+    reps = [_rep(1, weight=5.0), _rep(2, weight=1.0), _rep(3, weight=1.0)]
+    d = auto.evaluate(3, 0, [], now=now, replicas=reps)  # zero traffic
+    assert d.target_num_replicas == 2  # never below min
+
+
+def test_fallback_autoscaler_base_ondemand_and_preemption_gap():
+    from skypilot_tpu.serve.autoscalers import FallbackRequestRateAutoscaler
+    pol = ReplicaPolicy(min_replicas=3, max_replicas=10,
+                        target_qps_per_replica=10,
+                        base_ondemand_fallback_replicas=1)
+    auto = FallbackRequestRateAutoscaler(pol, upscale_counter_threshold=1)
+    now = 1000.0
+    # 30 qps -> 3 total: 2 spot + 1 base on-demand, all spot READY.
+    reps = [_rep(1, use_spot=True), _rep(2, use_spot=True),
+            _rep(3, use_spot=False)]
+    d = auto.evaluate(3, 0, _times(30, now), now=now, replicas=reps)
+    assert (d.num_spot, d.num_ondemand) == (2, 1)
+    # A spot replica is preempted (only 1 spot READY): the gap is covered
+    # by an EXTRA on-demand replica until spot recovers.
+    reps = [_rep(1, use_spot=True), _rep(2, use_spot=True,
+                                         status='NOT_READY'),
+            _rep(3, use_spot=False)]
+    d = auto.evaluate(2, 1, _times(30, now), now=now, replicas=reps)
+    assert (d.num_spot, d.num_ondemand) == (2, 2)
+    assert 'covering spot gap' in d.reason
+
+
+def test_make_autoscaler_selects_by_policy():
+    from skypilot_tpu.serve.autoscalers import (
+        FallbackRequestRateAutoscaler, FixedReplicaAutoscaler,
+        InstanceAwareRequestRateAutoscaler, make_autoscaler)
+    assert isinstance(make_autoscaler(ReplicaPolicy(min_replicas=2)),
+                      FixedReplicaAutoscaler)
+    assert isinstance(
+        make_autoscaler(ReplicaPolicy(min_replicas=1, max_replicas=4,
+                                      target_qps_per_replica=5)),
+        InstanceAwareRequestRateAutoscaler)
+    assert isinstance(
+        make_autoscaler(ReplicaPolicy(min_replicas=1, max_replicas=4,
+                                      target_qps_per_replica=5,
+                                      base_ondemand_fallback_replicas=1)),
+        FallbackRequestRateAutoscaler)
+
+
+def test_instance_aware_least_load_routing():
+    from skypilot_tpu.serve.load_balancing_policies import (
+        InstanceAwareLeastLoadPolicy, make_policy)
+    lb = make_policy('instance_aware_least_load')
+    assert isinstance(lb, InstanceAwareLeastLoadPolicy)
+    lb.set_replicas(['big:80', 'small:80'])
+    lb.set_weights({'big:80': 2.0, 'small:80': 1.0})
+    # Drive 30 requests without completions: the weight-2 replica must
+    # absorb ~2x the small one's share.
+    counts = {'big:80': 0, 'small:80': 0}
+    for _ in range(30):
+        r = lb.select()
+        counts[r] += 1
+        lb.on_request_start(r)
+    assert counts['big:80'] == 20 and counts['small:80'] == 10
+    # Completions rebalance: drain big's inflight and it takes the next.
+    for _ in range(20):
+        lb.on_request_end('big:80')
+    assert lb.select() == 'big:80'
+
+
+def test_service_yaml_roundtrip_fallback_policy():
+    from skypilot_tpu.serve.service_spec import ServiceSpec
+    spec = ServiceSpec.from_yaml_config({
+        'readiness_probe': '/health',
+        'replica_policy': {'min_replicas': 2, 'max_replicas': 6,
+                           'target_qps_per_replica': 4,
+                           'base_ondemand_fallback_replicas': 2},
+        'load_balancing_policy': 'instance_aware_least_load',
+    })
+    rt = ServiceSpec.from_yaml_config(spec.to_yaml_config())
+    assert rt.replica_policy.base_ondemand_fallback_replicas == 2
+    assert rt.load_balancing_policy == 'instance_aware_least_load'
+
+
+def test_scale_mixed_per_pool(monkeypatch, tmp_state_dir):
+    """scale_mixed launches/retires within each pool independently."""
+    from skypilot_tpu.serve.replica_managers import ReplicaManager
+    from skypilot_tpu.serve.service_spec import ServiceSpec
+    spec = ServiceSpec.from_yaml_config({
+        'readiness_probe': '/', 'replica_policy': 1})
+    serve_state.add_service('mix', spec.to_yaml_config(),
+                            Task('t', run='x').to_yaml_config())
+    mgr = ReplicaManager('mix', spec, Task('t', run='x'))
+    launched = []
+    monkeypatch.setattr(
+        mgr, 'launch_replica',
+        lambda use_spot=None: launched.append(use_spot))
+    # Seed state: 1 spot alive, 2 on-demand alive.
+    serve_state.upsert_replica('mix', 1, serve_state.ReplicaStatus.READY,
+                               use_spot=True)
+    serve_state.upsert_replica('mix', 2, serve_state.ReplicaStatus.READY,
+                               use_spot=False)
+    serve_state.upsert_replica('mix', 3, serve_state.ReplicaStatus.STARTING,
+                               use_spot=False)
+    retired = []
+    monkeypatch.setattr(mgr, 'terminate_replica',
+                        lambda rid, failed=False: retired.append(rid))
+    mgr.scale_mixed(num_spot=3, num_ondemand=1)
+    assert launched == [True, True]  # spot pool 1 -> 3
+    assert retired == [3]            # on-demand pool 2 -> 1, non-ready first
+
+
+def test_fallback_autoscaler_launching_spot_is_not_a_gap():
+    """Spot replicas still PROVISIONING/STARTING are capacity on the way,
+    not preemption: the autoscaler must not over-launch on-demand (and
+    blow past max_replicas) during a normal scale-up."""
+    from skypilot_tpu.serve.autoscalers import FallbackRequestRateAutoscaler
+    pol = ReplicaPolicy(min_replicas=3, max_replicas=3,
+                        target_qps_per_replica=10,
+                        base_ondemand_fallback_replicas=1)
+    auto = FallbackRequestRateAutoscaler(pol, upscale_counter_threshold=1)
+    now = 1000.0
+    reps = [_rep(1, use_spot=True, status='PROVISIONING'),
+            _rep(2, use_spot=True, status='STARTING'),
+            _rep(3, use_spot=False)]
+    d = auto.evaluate(1, 2, _times(30, now), now=now, replicas=reps)
+    assert (d.num_spot, d.num_ondemand) == (2, 1)
+    assert d.target_num_replicas == 3  # never exceeds max_replicas

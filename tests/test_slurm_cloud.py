@@ -8,7 +8,6 @@ driver-on-head path (bootstrap, agent, rank env) runs unchanged on top of
 the allocation.
 """
 import json
-import os
 import stat
 import sys
 import time
