@@ -44,7 +44,13 @@ class ClusterHandle:
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> 'ClusterHandle':
-        return cls(**d)
+        # Version tolerance both ways: a handle written by a NEWER
+        # version may carry fields this one doesn't know (dropped, not
+        # fatal), and optional fields added since the handle was written
+        # take their defaults — `stpu down` must always work across an
+        # upgrade (the reference's pickled handles break exactly here).
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
 
     @property
     def total_workers(self) -> int:

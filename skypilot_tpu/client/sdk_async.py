@@ -100,39 +100,50 @@ class AsyncClient:
         """Await the request's completion; return its result or raise its
         (deserialized) error — the sync ``sdk.get`` contract."""
         session = await self._ensure_session()
-        async with session.get(
-                f'{self._url}/api/v1/api/get',
-                params={'request_id': request_id, 'timeout': str(timeout)},
-                headers=self._headers(),
-                timeout=aiohttp.ClientTimeout(total=timeout + 10)) as r:
-            body = await r.json()
-            if r.status == 202:
-                raise TimeoutError(
-                    f'request {request_id} still {body.get("status")}')
-            if r.status != 200:
-                raise exceptions.SkyTpuError(body.get('error', str(body)))
-            if body.get('error'):
-                raise exceptions.deserialize_exception(body['error'])
-            return body.get('result')
+        try:
+            async with session.get(
+                    f'{self._url}/api/v1/api/get',
+                    params={'request_id': request_id,
+                            'timeout': str(timeout)},
+                    headers=self._headers(),
+                    timeout=aiohttp.ClientTimeout(total=timeout + 10)) as r:
+                body = await r.json()
+                if r.status == 202:
+                    raise TimeoutError(
+                        f'request {request_id} still {body.get("status")}')
+                if r.status != 200:
+                    raise exceptions.SkyTpuError(
+                        body.get('error', str(body)))
+                if body.get('error'):
+                    raise exceptions.deserialize_exception(body['error'])
+                return body.get('result')
+        except aiohttp.ClientConnectionError as e:
+            raise exceptions.ApiServerConnectionError(self._url,
+                                                      str(e)) from e
 
     async def stream_and_get(self, request_id: str, timeout: float = 600.0,
                              quiet: bool = False) -> Any:
         """Stream the request's server-side log (SSE), then return the
         result."""
         session = await self._ensure_session()
-        async with session.get(
-                f'{self._url}/api/v1/api/stream',
-                params={'request_id': request_id}, headers=self._headers(),
-                timeout=aiohttp.ClientTimeout(total=timeout)) as r:
-            async for raw in r.content:
-                line = raw.decode('utf-8', errors='replace').strip()
-                if line.startswith('data: ') and not quiet:
-                    try:
-                        print(json.loads(line[len('data: '):]))
-                    except json.JSONDecodeError:
-                        pass
-                elif line.startswith('event: done'):
-                    break
+        try:
+            async with session.get(
+                    f'{self._url}/api/v1/api/stream',
+                    params={'request_id': request_id},
+                    headers=self._headers(),
+                    timeout=aiohttp.ClientTimeout(total=timeout)) as r:
+                async for raw in r.content:
+                    line = raw.decode('utf-8', errors='replace').strip()
+                    if line.startswith('data: ') and not quiet:
+                        try:
+                            print(json.loads(line[len('data: '):]))
+                        except json.JSONDecodeError:
+                            pass
+                    elif line.startswith('event: done'):
+                        break
+        except aiohttp.ClientConnectionError as e:
+            raise exceptions.ApiServerConnectionError(self._url,
+                                                      str(e)) from e
         return await self.get(request_id, timeout=timeout)
 
     # -- verbs (each returns a request_id) -----------------------------------
@@ -217,21 +228,29 @@ class AsyncClient:
 
     async def api_cancel(self, request_id: str) -> bool:
         session = await self._ensure_session()
-        async with session.post(f'{self._url}/api/v1/api/cancel',
-                                json={'request_id': request_id},
-                                headers=self._headers(),
-                                timeout=aiohttp.ClientTimeout(
-                                    total=10)) as r:
-            body = await r.json()
-            return bool(body.get('cancelled'))
+        try:
+            async with session.post(f'{self._url}/api/v1/api/cancel',
+                                    json={'request_id': request_id},
+                                    headers=self._headers(),
+                                    timeout=aiohttp.ClientTimeout(
+                                        total=10)) as r:
+                body = await r.json()
+                return bool(body.get('cancelled'))
+        except aiohttp.ClientConnectionError as e:
+            raise exceptions.ApiServerConnectionError(self._url,
+                                                      str(e)) from e
 
     async def api_requests(self) -> List[Dict[str, Any]]:
         session = await self._ensure_session()
-        async with session.get(f'{self._url}/api/v1/api/requests',
-                               headers=self._headers(),
-                               timeout=aiohttp.ClientTimeout(
-                                   total=10)) as r:
-            return await r.json()
+        try:
+            async with session.get(f'{self._url}/api/v1/api/requests',
+                                   headers=self._headers(),
+                                   timeout=aiohttp.ClientTimeout(
+                                       total=10)) as r:
+                return await r.json()
+        except aiohttp.ClientConnectionError as e:
+            raise exceptions.ApiServerConnectionError(self._url,
+                                                      str(e)) from e
 
 
 # -- module-level mirror -----------------------------------------------------

@@ -128,11 +128,17 @@ def rclone_cached_flush_script(mount_path: str,
     on wedged uploads (expired credentials, rotated log) — an un-uploaded
     checkpoint is a durability failure, not a success."""
     log = f'{_CACHED_DIR}/{_mount_tag(mount_path)}.log'
+    # Only cleaned-reports logged AFTER the barrier started count: the
+    # poller emits a "to upload 0" line every ~5s, so a line from BEFORE
+    # the job's final write would otherwise satisfy the grep and report
+    # durability for a checkpoint whose upload hasn't begun. The byte
+    # offset snapshot fences the log to post-barrier lines.
     return (f'if mountpoint -q {shlex.quote(mount_path)}; then '
-            f'sleep 1; __skytpu_flush_deadline=$(($(date +%s)+{timeout_s}));'
+            f'__skytpu_flush_off=$(wc -c < {log} 2>/dev/null || echo 0); '
+            f'__skytpu_flush_deadline=$(($(date +%s)+{timeout_s}));'
             ' while true; do '
-            f'if tac {log} 2>/dev/null | '
-            'grep -m 1 "vfs cache: cleaned:" | '
+            f'if tail -c +$((__skytpu_flush_off+1)) {log} 2>/dev/null | '
+            'grep "vfs cache: cleaned:" | '
             'grep -q "in use 0, to upload 0, uploading 0"; then break; fi; '
             'if [ $(date +%s) -gt $__skytpu_flush_deadline ]; then '
             'echo "[skytpu] ERROR: cached mount still uploading after '

@@ -407,6 +407,7 @@ class S3Store(_RestObjectStore):
             self.host = f'{bucket}.s3.{self.region}.amazonaws.com'
             self.base_path = ''
 
+
     def _creds(self) -> Tuple[str, str]:
         ak = os.environ.get('AWS_ACCESS_KEY_ID')
         sk = os.environ.get('AWS_SECRET_ACCESS_KEY')
@@ -503,6 +504,68 @@ class S3Store(_RestObjectStore):
         from skypilot_tpu.data import mounting_utils
         return mounting_utils.rclone_mount_command(
             's3', self._bucket_path(), mount_path)
+
+
+class OciStore(S3Store):
+    """OCI Object Storage through its S3-compatibility endpoint
+    (reference: ``sky/data/storage.py:3565`` OciStore rides the oci SDK;
+    here it is one endpoint rule over the SigV4 client — OCI natively
+    speaks the S3 API at ``{namespace}.compat.objectstorage.{region}``).
+
+    Env: ``OCI_NAMESPACE``, ``OCI_REGION``, and S3-compat Customer Secret
+    Keys in ``AWS_ACCESS_KEY_ID`` / ``AWS_SECRET_ACCESS_KEY``.
+    """
+
+    scheme = 'oci'
+    # Mounts use a user-configured rclone remote named 'oci' pointing at
+    # the tenancy's compat endpoint (same by-name convention as
+    # 's3'/'azureblob'/'gcs') — inheriting S3Store's 's3' remote would
+    # silently mount the WRONG endpoint.
+    _rclone_remote = 'oci'
+
+    def __init__(self, bucket: str, prefix: str = '', http=None):
+        super().__init__(bucket, prefix, http=http)
+        namespace = os.environ.get('OCI_NAMESPACE')
+        region = os.environ.get('OCI_REGION', self.region)
+        if not namespace:
+            raise exceptions.StorageSpecError(
+                'oci:// needs OCI_NAMESPACE (tenancy object-storage '
+                'namespace) and S3-compat customer secret keys in '
+                'AWS_ACCESS_KEY_ID / AWS_SECRET_ACCESS_KEY.')
+        self.region = region
+        self.host = f'{namespace}.compat.objectstorage.{region}.oraclecloud.com'
+        self.base_path = f'/{bucket}'
+
+    def mount_command(self, mount_path: str) -> str:
+        from skypilot_tpu.data import mounting_utils
+        return mounting_utils.rclone_mount_command(
+            self._rclone_remote, self._bucket_path(), mount_path)
+
+
+class IbmCosStore(S3Store):
+    """IBM Cloud Object Storage via its S3-compatible API (reference:
+    ``sky/data/storage.py`` IBMCosStore rides ibm_boto3; COS speaks S3 at
+    ``s3.{region}.cloud-object-storage.appdomain.cloud`` with HMAC
+    credentials in the usual AWS env pair).
+
+    Env: ``IBM_COS_REGION`` (default us-south) + HMAC keys in
+    ``AWS_ACCESS_KEY_ID`` / ``AWS_SECRET_ACCESS_KEY``.
+    """
+
+    scheme = 'cos'
+    _rclone_remote = 'ibmcos'  # user-configured remote for the COS endpoint
+
+    def __init__(self, bucket: str, prefix: str = '', http=None):
+        super().__init__(bucket, prefix, http=http)
+        region = os.environ.get('IBM_COS_REGION', 'us-south')
+        self.region = region
+        self.host = f's3.{region}.cloud-object-storage.appdomain.cloud'
+        self.base_path = f'/{bucket}'
+
+    def mount_command(self, mount_path: str) -> str:
+        from skypilot_tpu.data import mounting_utils
+        return mounting_utils.rclone_mount_command(
+            self._rclone_remote, self._bucket_path(), mount_path)
 
 
 class AzureBlobStore(_RestObjectStore):
@@ -639,7 +702,8 @@ class AzureBlobStore(_RestObjectStore):
 
 
 _SCHEMES = {'gs': GcsStore, 'file': LocalStore, 's3': S3Store,
-            'r2': S3Store, 'az': AzureBlobStore}
+            'r2': S3Store, 'az': AzureBlobStore, 'oci': OciStore,
+            'cos': IbmCosStore}
 
 
 def parse_source(source: str) -> Tuple[str, str, str]:

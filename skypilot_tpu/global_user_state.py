@@ -154,11 +154,18 @@ def add_or_update_cluster(name: str, handle: Dict[str, Any],
                     'VALUES (?, ?, ?, ?, ?, ?, ?)',
                     (name, now, json.dumps(handle), status.value, now,
                      owner, workspaces_lib.active_workspace()))
-            except db_utils.OperationalError:
+            except db_utils.OperationalError as e:
                 # Cross-replica race on a shared Postgres: the filelock
                 # above is host-local, so another API-server replica can
-                # win the SELECT->INSERT race. The primary-key violation
-                # means the row now exists — retry as an update.
+                # win the SELECT->INSERT race. ONLY the duplicate-key
+                # violation means "row now exists — update instead";
+                # any other statement failure must propagate (an UPDATE
+                # fallback would match zero rows and silently drop the
+                # cluster record, leaking the launched resources).
+                msg = str(e).lower()
+                if not ('duplicate' in msg or 'unique' in msg
+                        or '23505' in msg):
+                    raise
                 conn.execute(
                     'UPDATE clusters SET handle = ?, status = ?, '
                     'last_activity = ? WHERE name = ?',

@@ -97,7 +97,24 @@ class Ec2Transport:
         self.host = f'ec2.{region}.amazonaws.com'
         self._creds: Optional[Tuple[str, str]] = None
 
+    # Auth error codes meaning "re-read the credential source and retry":
+    # the transport (and Ec2Client) is cached per region for the process
+    # lifetime, so rotated STS keys would otherwise be pinned forever.
+    _AUTH_RETRY_CODES = ('AuthFailure', 'SignatureDoesNotMatch',
+                         'RequestExpired', 'ExpiredToken',
+                         'InvalidClientTokenId')
+
     def request(self, action: str, params: Dict[str, str]) -> Dict[str, Any]:
+        try:
+            return self._request_once(action, params)
+        except AwsApiError as e:
+            if e.code not in self._AUTH_RETRY_CODES:
+                raise
+            self._creds = None  # rotated credentials: reload and retry once
+            return self._request_once(action, params)
+
+    def _request_once(self, action: str,
+                      params: Dict[str, str]) -> Dict[str, Any]:
         import requests
 
         from skypilot_tpu.data import aws_sigv4

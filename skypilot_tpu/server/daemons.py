@@ -1,0 +1,79 @@
+"""API-server background daemons: proactive state refresh + request GC.
+
+Reference analog: ``sky/server/daemons.py`` (295 LoC) — background
+refreshers so the cluster table tracks reality (externally terminated or
+preempted clusters flip status without anyone running ``status -r``) and
+the request table doesn't grow unboundedly.
+
+Loops run on the aiohttp event loop, with the blocking provider queries
+pushed to a dedicated executor; a failing tick is logged and skipped —
+daemons must outlive any one bad provider call.
+"""
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures as cf
+import os
+from typing import Optional
+
+_POOL = cf.ThreadPoolExecutor(max_workers=2, thread_name_prefix='daemon')
+
+
+def refresh_interval_s() -> float:
+    """0 disables the refresher (tests; single-shot CLIs use status -r)."""
+    return float(os.environ.get('SKYTPU_SERVER_REFRESH_S', '120'))
+
+
+def request_gc_age_s() -> float:
+    return float(os.environ.get('SKYTPU_REQUEST_GC_AGE_S',
+                                str(3 * 24 * 3600)))
+
+
+def refresh_clusters_once() -> int:
+    """Provider-authoritative refresh of every UP cluster's status;
+    returns how many clusters were checked."""
+    from skypilot_tpu import global_user_state
+    from skypilot_tpu.backends import TpuGangBackend
+    backend = TpuGangBackend()
+    checked = 0
+    for rec in global_user_state.get_clusters():
+        if rec['status'] != global_user_state.ClusterStatus.UP:
+            continue
+        checked += 1
+        try:
+            backend.refresh_status(rec['name'])
+        except Exception:  # noqa: BLE001 — one bad cluster must not stop
+            pass  # the sweep; next tick retries
+    return checked
+
+
+def gc_requests_once(older_than_s: Optional[float] = None) -> int:
+    """Drop terminal request rows (and their logs) past the GC age."""
+    from skypilot_tpu.server import requests_db
+    return requests_db.gc_terminal(older_than_s if older_than_s is not None
+                                   else request_gc_age_s())
+
+
+async def run_background(app) -> None:
+    """aiohttp on_startup hook: spawn the periodic loop."""
+    interval = refresh_interval_s()
+    if interval <= 0:
+        return
+
+    async def loop():
+        lp = asyncio.get_event_loop()
+        while True:
+            await asyncio.sleep(interval)
+            for fn in (refresh_clusters_once, gc_requests_once):
+                try:
+                    await lp.run_in_executor(_POOL, fn)
+                except Exception:  # noqa: BLE001 — daemon must survive
+                    pass
+
+    app['skytpu_daemons'] = asyncio.create_task(loop())
+
+
+async def stop_background(app) -> None:
+    task = app.get('skytpu_daemons')
+    if task is not None:
+        task.cancel()

@@ -95,6 +95,8 @@ def _cluster_jobs(name: str) -> List[Dict[str, Any]]:
     rec = global_user_state.get_cluster(name)
     if not rec or not rec.get('handle'):
         return []
+    if rec['status'] != global_user_state.ClusterStatus.UP:
+        return []  # stopped/init head has no queue to ask
     try:
         backend = TpuGangBackend()
         handle = ClusterHandle.from_dict(rec['handle'])
@@ -236,12 +238,30 @@ def workspaces_view() -> List[Dict[str, Any]]:
     return out
 
 
-# -- aiohttp handlers (blocking reads run in the default executor) ----------
+# -- aiohttp handlers --------------------------------------------------------
+# Blocking reads run in a DEDICATED small pool with a hard deadline: an
+# unreachable remote head (dead tunnel, stopped VM) must not pile up
+# 2-second dashboard polls until every executor thread is stuck and all
+# endpoints stall for every viewer. On deadline the poll degrades to 504;
+# the stuck thread finishes (or times out) in the background.
+
+import concurrent.futures as _cf
+
+_POOL = _cf.ThreadPoolExecutor(max_workers=4,
+                               thread_name_prefix='dashboard')
+_READ_DEADLINE_S = 5.0
 
 
 async def _json(request: web.Request, fn, *args) -> web.Response:
     loop = asyncio.get_event_loop()
-    result = await loop.run_in_executor(None, fn, *args)
+    try:
+        result = await asyncio.wait_for(
+            loop.run_in_executor(_POOL, fn, *args),
+            timeout=_READ_DEADLINE_S)
+    except asyncio.TimeoutError:
+        return web.json_response(
+            {'error': 'state read timed out (cluster head unreachable?)'},
+            status=504)
     if result is None:
         return web.json_response({'error': 'not found'}, status=404)
     return web.json_response(result)

@@ -146,6 +146,30 @@ def list_requests(limit: int = 100) -> List[Dict[str, Any]]:
         return [dict(r) for r in rows]
 
 
+def gc_terminal(older_than_s: float) -> int:
+    """Delete terminal request rows (and their log files) whose finish
+    time is older than ``older_than_s``; returns the count removed
+    (server daemon housekeeping — the table must not grow forever)."""
+    cutoff = time.time() - older_than_s
+    with _lock(), _conn() as conn:
+        rows = conn.execute(
+            'SELECT request_id, log_path FROM requests WHERE '
+            'finished_at IS NOT NULL AND finished_at < ? AND status IN '
+            '(?, ?, ?)',
+            (cutoff, RequestStatus.SUCCEEDED.value,
+             RequestStatus.FAILED.value,
+             RequestStatus.CANCELLED.value)).fetchall()
+        for row in rows:
+            if row['log_path']:
+                try:
+                    os.unlink(row['log_path'])
+                except OSError:
+                    pass
+            conn.execute('DELETE FROM requests WHERE request_id = ?',
+                         (row['request_id'],))
+        return len(rows)
+
+
 def count_active(lane: str) -> int:
     with _conn() as conn:
         row = conn.execute(

@@ -201,10 +201,14 @@ async def auth_middleware(request: web.Request, handler):
 
 
 def make_app() -> web.Application:
-    from skypilot_tpu.server import dashboard
+    from skypilot_tpu.server import daemons, dashboard
     app = web.Application(middlewares=[auth_middleware])
     app.add_routes(routes)
     dashboard.add_routes(app)
+    # Background refreshers (cluster status, request GC); disabled when
+    # SKYTPU_SERVER_REFRESH_S=0 (reference: sky/server/daemons.py).
+    app.on_startup.append(daemons.run_background)
+    app.on_cleanup.append(daemons.stop_background)
     for op in ('launch', 'exec', 'down', 'stop', 'start', 'autostop',
                'cancel'):
         app.router.add_post(f'/api/v1/{op}', _make_post(op))

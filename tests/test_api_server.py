@@ -387,3 +387,30 @@ def test_dashboard_v2_detail_pages(server):
                    'workspacesView', 'sparkline'):
         assert marker in page
     sdk.get(sdk.down('dash1'))
+
+
+def test_server_daemons_refresh_and_gc(tmp_state_dir, enable_fake_cloud):
+    """Background daemons (reference server/daemons.py): the status
+    refresher flips externally-terminated clusters, and request GC drops
+    old terminal rows + logs."""
+    import skypilot_tpu as sky
+    from skypilot_tpu import global_user_state as gus
+    from skypilot_tpu.provision.fake import instance as fake_instance
+    from skypilot_tpu.server import daemons, requests_db
+
+    task = Task('d', run='sleep 60')
+    task.set_resources(sky.Resources(accelerators='tpu-v5e-8',
+                                     cloud='fake'))
+    _, handle = sky.launch(task, cluster_name='dref', detach_run=True)
+    assert gus.get_cluster('dref')['status'] == gus.ClusterStatus.UP
+    # External termination (provider-side): the refresher must notice.
+    fake_instance.terminate_instances(handle.cluster_name_on_cloud)
+    assert daemons.refresh_clusters_once() >= 1
+    rec = gus.get_cluster('dref')
+    assert rec is None or rec['status'] != gus.ClusterStatus.UP
+
+    rid = requests_db.create('status', {})
+    requests_db.finish(rid, result=[])
+    assert requests_db.get(rid) is not None
+    assert daemons.gc_requests_once(older_than_s=0) >= 1
+    assert requests_db.get(rid) is None

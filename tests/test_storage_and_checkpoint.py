@@ -566,3 +566,43 @@ def test_execute_appends_flush_barrier_for_cached_mounts(
     with open(log, encoding='utf-8') as f:
         assert 'RAN_WITH_CACHED_MOUNT' in f.read()
     core.down('cmt')
+
+
+def test_oci_and_ibm_cos_ride_the_s3_client(monkeypatch):
+    """OCI / IBM COS (reference storage.py:3565 etc.): S3-compatible
+    endpoints over the same SigV4 client — one endpoint rule each."""
+    monkeypatch.setenv('AWS_ACCESS_KEY_ID', 'AK')
+    monkeypatch.setenv('AWS_SECRET_ACCESS_KEY', 'SK')
+    monkeypatch.setenv('OCI_NAMESPACE', 'mytenancy')
+    monkeypatch.setenv('OCI_REGION', 'us-ashburn-1')
+    st = storage_lib.Storage.from_config('oci://bkt/ck').store()
+    assert type(st).__name__ == 'OciStore'
+    assert st.host == \
+        'mytenancy.compat.objectstorage.us-ashburn-1.oraclecloud.com'
+    assert st.base_path == '/bkt'
+    monkeypatch.setenv('IBM_COS_REGION', 'eu-de')
+    st = storage_lib.Storage.from_config('cos://bkt2/x').store()
+    assert type(st).__name__ == 'IbmCosStore'
+    assert st.host == 's3.eu-de.cloud-object-storage.appdomain.cloud'
+    # Missing OCI namespace is an actionable spec error, not a crash.
+    monkeypatch.delenv('OCI_NAMESPACE')
+    with pytest.raises(Exception, match='OCI_NAMESPACE'):
+        storage_lib.Storage.from_config('oci://bkt/ck').store()
+
+
+def test_oci_cos_mounts_use_their_own_rclone_remote(monkeypatch):
+    """oci://'s mount must NOT inherit the 's3' rclone remote — that
+    would mount whatever endpoint the user's s3 remote points at."""
+    monkeypatch.setenv('AWS_ACCESS_KEY_ID', 'AK')
+    monkeypatch.setenv('AWS_SECRET_ACCESS_KEY', 'SK')
+    monkeypatch.setenv('OCI_NAMESPACE', 'tn')
+    oci = storage_lib.Storage.from_config('oci://b/p').store()
+    assert 'rclone mount oci:b/p' in oci.mount_command('/m')
+    assert 'rclone mount oci:b/p' not in \
+        storage_lib.Storage.from_config('s3://b/p').store().mount_command(
+            '/m')
+    cos = storage_lib.Storage.from_config('cos://b2').store()
+    assert 'rclone mount ibmcos:b2' in cos.mount_command('/m')
+    # Cached mounts fence to post-barrier log lines (stale-line race).
+    flush = oci.cached_mount_flush_script('/m')
+    assert '__skytpu_flush_off' in flush and 'tail -c' in flush
