@@ -503,7 +503,7 @@ class S3Store(_RestObjectStore):
     def mount_command(self, mount_path: str) -> str:
         from skypilot_tpu.data import mounting_utils
         return mounting_utils.rclone_mount_command(
-            's3', self._bucket_path(), mount_path)
+            self._rclone_remote, self._bucket_path(), mount_path)
 
 
 class OciStore(S3Store):
@@ -526,20 +526,18 @@ class OciStore(S3Store):
     def __init__(self, bucket: str, prefix: str = '', http=None):
         super().__init__(bucket, prefix, http=http)
         namespace = os.environ.get('OCI_NAMESPACE')
-        region = os.environ.get('OCI_REGION', self.region)
-        if not namespace:
+        region = os.environ.get('OCI_REGION')
+        if not namespace or not region:
+            # No AWS_DEFAULT_REGION fallback: an AWS region produces a
+            # nonexistent OCI hostname and a cryptic DNS error at first
+            # use — fail fast with the actionable message instead.
             raise exceptions.StorageSpecError(
                 'oci:// needs OCI_NAMESPACE (tenancy object-storage '
-                'namespace) and S3-compat customer secret keys in '
-                'AWS_ACCESS_KEY_ID / AWS_SECRET_ACCESS_KEY.')
+                'namespace), OCI_REGION, and S3-compat customer secret '
+                'keys in AWS_ACCESS_KEY_ID / AWS_SECRET_ACCESS_KEY.')
         self.region = region
         self.host = f'{namespace}.compat.objectstorage.{region}.oraclecloud.com'
         self.base_path = f'/{bucket}'
-
-    def mount_command(self, mount_path: str) -> str:
-        from skypilot_tpu.data import mounting_utils
-        return mounting_utils.rclone_mount_command(
-            self._rclone_remote, self._bucket_path(), mount_path)
 
 
 class IbmCosStore(S3Store):
@@ -561,11 +559,6 @@ class IbmCosStore(S3Store):
         self.region = region
         self.host = f's3.{region}.cloud-object-storage.appdomain.cloud'
         self.base_path = f'/{bucket}'
-
-    def mount_command(self, mount_path: str) -> str:
-        from skypilot_tpu.data import mounting_utils
-        return mounting_utils.rclone_mount_command(
-            self._rclone_remote, self._bucket_path(), mount_path)
 
 
 class AzureBlobStore(_RestObjectStore):

@@ -55,25 +55,35 @@ def gc_requests_once(older_than_s: Optional[float] = None) -> int:
 
 
 async def run_background(app) -> None:
-    """aiohttp on_startup hook: spawn the periodic loop."""
+    """aiohttp on_startup hook: spawn the periodic loops. The refresher
+    and request GC are gated independently — disabling provider polling
+    (SKYTPU_SERVER_REFRESH_S=0) must not also disable GC, or the request
+    table grows unboundedly on a long-lived server."""
     interval = refresh_interval_s()
-    if interval <= 0:
-        return
+    # GC every 10 refresh intervals (or hourly when polling is off).
+    gc_interval = interval * 10 if interval > 0 else 3600.0
 
-    async def loop():
+    async def loop(period, fn):
         lp = asyncio.get_event_loop()
         while True:
-            await asyncio.sleep(interval)
-            for fn in (refresh_clusters_once, gc_requests_once):
-                try:
-                    await lp.run_in_executor(_POOL, fn)
-                except Exception:  # noqa: BLE001 — daemon must survive
-                    pass
+            await asyncio.sleep(period)
+            try:
+                await lp.run_in_executor(_POOL, fn)
+            except Exception:  # noqa: BLE001 — daemon must survive
+                pass
 
-    app['skytpu_daemons'] = asyncio.create_task(loop())
+    tasks = [asyncio.create_task(loop(gc_interval, gc_requests_once))]
+    if interval > 0:
+        tasks.append(asyncio.create_task(
+            loop(interval, refresh_clusters_once)))
+    app['skytpu_daemons'] = tasks
 
 
 async def stop_background(app) -> None:
-    task = app.get('skytpu_daemons')
-    if task is not None:
+    import contextlib
+    for task in app.get('skytpu_daemons', ()):
         task.cancel()
+        # Await the unwind: the loop must not close with the task still
+        # pending ('Task was destroyed but it is pending').
+        with contextlib.suppress(asyncio.CancelledError):
+            await task

@@ -596,6 +596,7 @@ def test_oci_cos_mounts_use_their_own_rclone_remote(monkeypatch):
     monkeypatch.setenv('AWS_ACCESS_KEY_ID', 'AK')
     monkeypatch.setenv('AWS_SECRET_ACCESS_KEY', 'SK')
     monkeypatch.setenv('OCI_NAMESPACE', 'tn')
+    monkeypatch.setenv('OCI_REGION', 'us-ashburn-1')
     oci = storage_lib.Storage.from_config('oci://b/p').store()
     assert 'rclone mount oci:b/p' in oci.mount_command('/m')
     assert 'rclone mount oci:b/p' not in \
