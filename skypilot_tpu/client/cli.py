@@ -421,6 +421,91 @@ def serve_down(service_name):
     click.echo(f'Service {service_name} shutting down.')
 
 
+@cli.group('storage')
+def storage_group():
+    """Object-store buckets (reference: `sky storage`)."""
+
+
+def _store_for_read(uri):
+    """(store, names, exact_rel): the store + object names for a URI.
+    Prefix URIs list children; a URI naming an EXACT object falls back to
+    listing its parent prefix (the stores' prefix-stripping would
+    otherwise drop the exact-match key and report the object missing)."""
+    from skypilot_tpu.data import storage as storage_lib
+    store = storage_lib.Storage.from_config(uri).store()
+    names = store.list_objects()
+    if names:
+        return store, names, ''
+    scheme, bucket, prefix = storage_lib.parse_source(uri)
+    if not prefix:
+        return store, [], ''
+    parent, _, leaf = prefix.rpartition('/')
+    parent_uri = f'{scheme}://{bucket}' + (f'/{parent}' if parent else '')
+    parent_store = storage_lib.Storage.from_config(parent_uri).store()
+    if leaf in parent_store.list_objects():
+        return parent_store, [leaf], leaf
+    return store, [], ''
+
+
+@storage_group.command('ls')
+@click.argument('uri')
+@_clean_errors
+def storage_ls(uri):
+    """List objects under a bucket URI (gs:// s3:// az:// oci:// cos://
+    file://); an exact-object URI lists that object."""
+    store, names, _ = _store_for_read(uri)
+    if not names:
+        click.echo(f'{uri}: empty (or missing)')
+        return
+    for name in names:
+        click.echo(name)
+    click.echo(f'-- {len(names)} object(s) in {store.url}')
+
+
+@storage_group.command('delete')
+@click.argument('uri')
+@click.option('--yes', '-y', is_flag=True, help='Skip confirmation.')
+@_clean_errors
+def storage_delete(uri, yes):
+    """Delete every object under a bucket URI (prefix granularity)."""
+    from skypilot_tpu.data import storage as storage_lib
+    store = storage_lib.Storage.from_config(uri).store()
+    if not yes:
+        click.confirm(f'Delete ALL objects under {store.url}?', abort=True)
+    store.delete()
+    click.echo(f'Deleted {store.url}.')
+
+
+@storage_group.command('cp')
+@click.argument('src')
+@click.argument('dst')
+@_clean_errors
+def storage_cp(src, dst):
+    """Copy between a local path and a bucket URI (either direction), or
+    bucket-to-bucket across providers."""
+    from skypilot_tpu.data import storage as storage_lib
+    src_is_uri = '://' in src
+    dst_is_uri = '://' in dst
+    if src_is_uri and dst_is_uri:
+        from skypilot_tpu.data import data_transfer
+        n = data_transfer.transfer(src, dst)
+        click.echo(f'Copied {n} object(s) {src} -> {dst}.')
+    elif src_is_uri:
+        store, names, exact = _store_for_read(src)
+        if not names:
+            raise click.ClickException(f'{src}: no such object or prefix')
+        if exact:
+            store.download(dst, src_rel=exact)
+        else:
+            store.download(dst)
+        click.echo(f'Downloaded {src} -> {dst}.')
+    elif dst_is_uri:
+        storage_lib.Storage.from_config(dst).store().upload(src)
+        click.echo(f'Uploaded {src} -> {dst}.')
+    else:
+        raise click.UsageError('At least one side must be a bucket URI.')
+
+
 @cli.group('volumes')
 def volumes_group():
     """Persistent volumes (reference: `sky volumes`)."""

@@ -103,3 +103,51 @@ def test_cli_module_entry_registers_all_groups(tmp_path):
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     for group in ('launch', 'jobs', 'serve', 'api', 'volumes', 'users'):
         assert group in out.stdout, f'{group} missing from CLI help'
+
+
+def test_cli_storage_group(tmp_path, monkeypatch):
+    """`stpu storage ls/cp/delete` over file:// buckets (the sky storage
+    analog) round-trips through the real CLI entry points."""
+    from click.testing import CliRunner
+    from skypilot_tpu.client.cli import cli
+    monkeypatch.setenv('SKYTPU_LOCAL_BUCKET_ROOT', str(tmp_path / 'b'))
+    src = tmp_path / 'src'
+    src.mkdir()
+    (src / 'w.txt').write_text('hello')
+    runner = CliRunner()
+    r = runner.invoke(cli, ['storage', 'cp', str(src), 'file://bkt/run'])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, ['storage', 'ls', 'file://bkt/run'])
+    assert r.exit_code == 0 and 'w.txt' in r.output
+    out = tmp_path / 'out'
+    r = runner.invoke(cli, ['storage', 'cp', 'file://bkt/run', str(out)])
+    assert r.exit_code == 0 and (out / 'w.txt').read_text() == 'hello'
+    r = runner.invoke(cli, ['storage', 'delete', '-y', 'file://bkt/run'])
+    assert r.exit_code == 0
+    r = runner.invoke(cli, ['storage', 'ls', 'file://bkt/run'])
+    assert 'empty' in r.output
+
+
+def test_cli_storage_exact_object_and_clean_errors(tmp_path, monkeypatch):
+    """Exact-object URIs work (parent-prefix fallback) and expected
+    errors render as one-line CLI messages, not tracebacks."""
+    from click.testing import CliRunner
+    from skypilot_tpu.client.cli import cli
+    monkeypatch.setenv('SKYTPU_LOCAL_BUCKET_ROOT', str(tmp_path / 'b'))
+    src = tmp_path / 'src'
+    src.mkdir()
+    (src / 'model.bin').write_text('weights')
+    runner = CliRunner()
+    assert runner.invoke(cli, ['storage', 'cp', str(src),
+                               'file://bkt/run']).exit_code == 0
+    r = runner.invoke(cli, ['storage', 'ls', 'file://bkt/run/model.bin'])
+    assert r.exit_code == 0 and 'model.bin' in r.output
+    out = tmp_path / 'model.out'
+    r = runner.invoke(cli, ['storage', 'cp', 'file://bkt/run/model.bin',
+                            str(out)])
+    assert r.exit_code == 0, r.output
+    # Missing object: clean one-line error, not a traceback.
+    r = runner.invoke(cli, ['storage', 'cp', 'file://bkt/run/nope.bin',
+                            '/tmp/x'])
+    assert r.exit_code != 0
+    assert 'no such object' in r.output and 'Traceback' not in r.output
