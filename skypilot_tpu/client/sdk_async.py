@@ -16,6 +16,7 @@ free functions on a default client for drop-in use.
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 from typing import Any, Dict, List, Optional
@@ -55,6 +56,17 @@ class AsyncClient:
             self._session = aiohttp.ClientSession()
         return self._session
 
+    @contextlib.asynccontextmanager
+    async def _typed_errors(self):
+        """Translate transport failures into the SDK's typed error —
+        EVERY HTTP call goes through this so no endpoint can leak raw
+        aiohttp internals past the contract."""
+        try:
+            yield
+        except aiohttp.ClientConnectionError as e:
+            raise exceptions.ApiServerConnectionError(self._url,
+                                                      str(e)) from e
+
     @staticmethod
     def _workspace() -> str:
         from skypilot_tpu import workspaces as workspaces_lib
@@ -63,36 +75,26 @@ class AsyncClient:
     async def _post(self, path: str, payload: Dict[str, Any]) -> str:
         session = await self._ensure_session()
         payload = {**payload, '_workspace': self._workspace()}
-        try:
-            async with session.post(f'{self._url}/api/v1/{path}',
-                                    json=payload, headers=self._headers(),
-                                    timeout=aiohttp.ClientTimeout(
-                                        total=30)) as r:
-                body = await r.json()
-                if r.status != 200:
-                    raise exceptions.SkyTpuError(
-                        body.get('error', str(body)))
-                return body['request_id']
-        except aiohttp.ClientConnectionError as e:
-            raise exceptions.ApiServerConnectionError(self._url,
-                                                      str(e)) from e
+        async with self._typed_errors(), session.post(
+                f'{self._url}/api/v1/{path}', json=payload,
+                headers=self._headers(),
+                timeout=aiohttp.ClientTimeout(total=30)) as r:
+            body = await r.json()
+            if r.status != 200:
+                raise exceptions.SkyTpuError(body.get('error', str(body)))
+            return body['request_id']
 
     async def _get_rid(self, path: str, params: Dict[str, Any]) -> str:
         session = await self._ensure_session()
         params = {**params, '_workspace': self._workspace()}
-        try:
-            async with session.get(f'{self._url}/api/v1/{path}',
-                                   params=params, headers=self._headers(),
-                                   timeout=aiohttp.ClientTimeout(
-                                       total=30)) as r:
-                body = await r.json()
-                if r.status != 200:
-                    raise exceptions.SkyTpuError(
-                        body.get('error', str(body)))
-                return body['request_id']
-        except aiohttp.ClientConnectionError as e:
-            raise exceptions.ApiServerConnectionError(self._url,
-                                                      str(e)) from e
+        async with self._typed_errors(), session.get(
+                f'{self._url}/api/v1/{path}', params=params,
+                headers=self._headers(),
+                timeout=aiohttp.ClientTimeout(total=30)) as r:
+            body = await r.json()
+            if r.status != 200:
+                raise exceptions.SkyTpuError(body.get('error', str(body)))
+            return body['request_id']
 
     # -- result retrieval ----------------------------------------------------
 
@@ -100,50 +102,40 @@ class AsyncClient:
         """Await the request's completion; return its result or raise its
         (deserialized) error — the sync ``sdk.get`` contract."""
         session = await self._ensure_session()
-        try:
-            async with session.get(
-                    f'{self._url}/api/v1/api/get',
-                    params={'request_id': request_id,
-                            'timeout': str(timeout)},
-                    headers=self._headers(),
-                    timeout=aiohttp.ClientTimeout(total=timeout + 10)) as r:
-                body = await r.json()
-                if r.status == 202:
-                    raise TimeoutError(
-                        f'request {request_id} still {body.get("status")}')
-                if r.status != 200:
-                    raise exceptions.SkyTpuError(
-                        body.get('error', str(body)))
-                if body.get('error'):
-                    raise exceptions.deserialize_exception(body['error'])
-                return body.get('result')
-        except aiohttp.ClientConnectionError as e:
-            raise exceptions.ApiServerConnectionError(self._url,
-                                                      str(e)) from e
+        async with self._typed_errors(), session.get(
+                f'{self._url}/api/v1/api/get',
+                params={'request_id': request_id, 'timeout': str(timeout)},
+                headers=self._headers(),
+                timeout=aiohttp.ClientTimeout(total=timeout + 10)) as r:
+            body = await r.json()
+            if r.status == 202:
+                raise TimeoutError(
+                    f'request {request_id} still {body.get("status")}')
+            if r.status != 200:
+                raise exceptions.SkyTpuError(body.get('error', str(body)))
+            if body.get('error'):
+                raise exceptions.deserialize_exception(body['error'])
+            return body.get('result')
 
     async def stream_and_get(self, request_id: str, timeout: float = 600.0,
                              quiet: bool = False) -> Any:
         """Stream the request's server-side log (SSE), then return the
         result."""
         session = await self._ensure_session()
-        try:
-            async with session.get(
-                    f'{self._url}/api/v1/api/stream',
-                    params={'request_id': request_id},
-                    headers=self._headers(),
-                    timeout=aiohttp.ClientTimeout(total=timeout)) as r:
-                async for raw in r.content:
-                    line = raw.decode('utf-8', errors='replace').strip()
-                    if line.startswith('data: ') and not quiet:
-                        try:
-                            print(json.loads(line[len('data: '):]))
-                        except json.JSONDecodeError:
-                            pass
-                    elif line.startswith('event: done'):
-                        break
-        except aiohttp.ClientConnectionError as e:
-            raise exceptions.ApiServerConnectionError(self._url,
-                                                      str(e)) from e
+        async with self._typed_errors(), session.get(
+                f'{self._url}/api/v1/api/stream',
+                params={'request_id': request_id},
+                headers=self._headers(),
+                timeout=aiohttp.ClientTimeout(total=timeout)) as r:
+            async for raw in r.content:
+                line = raw.decode('utf-8', errors='replace').strip()
+                if line.startswith('data: ') and not quiet:
+                    try:
+                        print(json.loads(line[len('data: '):]))
+                    except json.JSONDecodeError:
+                        pass
+                elif line.startswith('event: done'):
+                    break
         return await self.get(request_id, timeout=timeout)
 
     # -- verbs (each returns a request_id) -----------------------------------
@@ -228,29 +220,20 @@ class AsyncClient:
 
     async def api_cancel(self, request_id: str) -> bool:
         session = await self._ensure_session()
-        try:
-            async with session.post(f'{self._url}/api/v1/api/cancel',
-                                    json={'request_id': request_id},
-                                    headers=self._headers(),
-                                    timeout=aiohttp.ClientTimeout(
-                                        total=10)) as r:
-                body = await r.json()
-                return bool(body.get('cancelled'))
-        except aiohttp.ClientConnectionError as e:
-            raise exceptions.ApiServerConnectionError(self._url,
-                                                      str(e)) from e
+        async with self._typed_errors(), session.post(
+                f'{self._url}/api/v1/api/cancel',
+                json={'request_id': request_id}, headers=self._headers(),
+                timeout=aiohttp.ClientTimeout(total=10)) as r:
+            body = await r.json()
+            return bool(body.get('cancelled'))
 
     async def api_requests(self) -> List[Dict[str, Any]]:
         session = await self._ensure_session()
-        try:
-            async with session.get(f'{self._url}/api/v1/api/requests',
-                                   headers=self._headers(),
-                                   timeout=aiohttp.ClientTimeout(
-                                       total=10)) as r:
-                return await r.json()
-        except aiohttp.ClientConnectionError as e:
-            raise exceptions.ApiServerConnectionError(self._url,
-                                                      str(e)) from e
+        async with self._typed_errors(), session.get(
+                f'{self._url}/api/v1/api/requests',
+                headers=self._headers(),
+                timeout=aiohttp.ClientTimeout(total=10)) as r:
+            return await r.json()
 
 
 # -- module-level mirror -----------------------------------------------------
