@@ -1,0 +1,16 @@
+"""Shared helpers for catalog generators."""
+from __future__ import annotations
+
+import csv
+import os
+from typing import List
+
+
+def write_csv(path: str, rows: List[dict]) -> None:
+    """One CSV convention for every catalog file (header from the first
+    row's keys) — generators must not diverge on encoding/terminators."""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+        writer.writeheader()
+        writer.writerows(rows)

@@ -1,0 +1,74 @@
+"""Generate the AWS EC2 catalog CSV.
+
+Reference analog: ``sky/catalog/data_fetchers/fetch_aws.py`` — which
+crawls the AWS pricing API. Same structure as ``fetch_gcp_tpu.py``:
+public on-demand list prices (us-east-1, USD/hr) as configuration data,
+expanded over regions with a price multiplier; in an environment with
+network access this is where a live pricing crawl slots in.
+
+Run ``python -m skypilot_tpu.catalog.data_fetchers.fetch_aws`` to
+regenerate ``skypilot_tpu/catalog/data/aws/vms.csv`` (idempotent).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Tuple
+
+from skypilot_tpu.catalog.data_fetchers.common import write_csv
+
+OUT_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                       'data', 'aws')
+
+# (instance type, vCPUs, memory GiB, on-demand USD/hr in us-east-1).
+SHAPES: List[Tuple[str, int, int, float]] = [
+    ('t3.medium', 2, 4, 0.0416),
+    ('c6i.large', 2, 4, 0.085),
+    ('m6i.large', 2, 8, 0.096),
+    ('r6i.large', 2, 16, 0.126),
+    ('c6i.xlarge', 4, 8, 0.17),
+    ('m6i.xlarge', 4, 16, 0.192),
+    ('r6i.xlarge', 4, 32, 0.252),
+    ('m6i.2xlarge', 8, 32, 0.384),
+    ('r6i.2xlarge', 8, 64, 0.504),
+    ('c6i.4xlarge', 16, 32, 0.68),
+    ('m6i.4xlarge', 16, 64, 0.768),
+    ('m6i.8xlarge', 32, 128, 1.536),
+]
+
+# (region, price multiplier vs us-east-1, zone suffixes offered).
+REGIONS: List[Tuple[str, float, List[str]]] = [
+    ('us-east-1', 1.0, ['a', 'b']),
+    ('us-west-2', 1.0, ['a', 'b']),
+    ('eu-west-1', 1.114, ['a', 'b']),
+]
+
+SPOT_DISCOUNT = 0.30  # typical sustained spot/on-demand ratio
+
+
+def generate_vm_rows() -> List[dict]:
+    rows = []
+    for name, vcpus, mem, base in SHAPES:
+        for region, mult, suffixes in REGIONS:
+            for suffix in suffixes:
+                price = round(base * mult, 6)
+                rows.append({
+                    'InstanceType': name,
+                    'vCPUs': vcpus,
+                    'MemoryGiB': mem,
+                    'Region': region,
+                    'AvailabilityZone': f'{region}{suffix}',
+                    'Price': price,
+                    'SpotPrice': round(price * SPOT_DISCOUNT, 6),
+                })
+    return rows
+
+
+def main() -> None:
+    rows = generate_vm_rows()
+    path = os.path.join(OUT_DIR, 'vms.csv')
+    write_csv(path, rows)
+    print(f'Wrote {len(rows)} EC2 rows to {path}')
+
+
+if __name__ == '__main__':
+    main()

@@ -14,7 +14,6 @@ USD/chip-hour) and are configuration data, not measurements.
 """
 from __future__ import annotations
 
-import csv
 import os
 from typing import Dict, List, Tuple
 
@@ -122,19 +121,12 @@ def generate_vm_rows() -> List[dict]:
     return rows
 
 
-def _write(path: str, rows: List[dict]) -> None:
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    with open(path, 'w', newline='', encoding='utf-8') as f:
-        writer = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
-        writer.writeheader()
-        writer.writerows(rows)
-
-
 def main() -> None:
+    from skypilot_tpu.catalog.data_fetchers.common import write_csv
     tpus = generate_tpu_rows()
     vms = generate_vm_rows()
-    _write(os.path.join(OUT_DIR, 'tpus.csv'), tpus)
-    _write(os.path.join(OUT_DIR, 'vms.csv'), vms)
+    write_csv(os.path.join(OUT_DIR, 'tpus.csv'), tpus)
+    write_csv(os.path.join(OUT_DIR, 'vms.csv'), vms)
     print(f'Wrote {len(tpus)} TPU rows, {len(vms)} VM rows to {OUT_DIR}')
 
 

@@ -56,3 +56,15 @@ def test_list_accelerators_filter():
     df = gcp_catalog.list_accelerators(name_filter='v6e')
     assert not df.empty
     assert set(df['Generation']) == {'v6e'}
+
+
+def test_aws_catalog_fetcher_is_idempotent(tmp_path, monkeypatch):
+    """Regenerating the AWS catalog reproduces the checked-in CSV byte
+    for byte (same contract as the GCP fetcher)."""
+    import pathlib
+    from skypilot_tpu.catalog.data_fetchers import fetch_aws
+    checked_in = pathlib.Path(fetch_aws.OUT_DIR) / 'vms.csv'
+    before = checked_in.read_bytes()
+    monkeypatch.setattr(fetch_aws, 'OUT_DIR', str(tmp_path))
+    fetch_aws.main()
+    assert (tmp_path / 'vms.csv').read_bytes() == before
