@@ -5,12 +5,26 @@
 PY ?= python
 NATIVE_DIR := skypilot_tpu/agent/native
 
-.PHONY: ci lint test-fast test test-all native native-asan clean
+.PHONY: ci lint test-fast test test-all native native-asan clean audit-clean
 
-ci: lint native-asan test-fast
+# Sequential sub-makes: audit-clean is a TEARDOWN gate and must scan the
+# process table only after the test tier finishes (`make -j` would
+# otherwise race them).
+ci:
+	$(MAKE) lint
+	$(MAKE) native-asan
+	$(MAKE) test-fast
+	$(MAKE) audit-clean
 
 lint:
 	$(PY) tools/lint.py
+
+# Assert ZERO framework/jax-holding processes survive (r3 verdict Next
+# #1): a leaked daemon wedges the single-claimant TPU tunnel for every
+# later client, including the driver's end-of-round bench. Run at the
+# end of every builder session and as the CI teardown gate.
+audit-clean:
+	$(PY) tools/audit_clean.py
 
 # Default selection: everything not marked slow/load (< 5 min).
 test-fast:

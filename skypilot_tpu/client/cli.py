@@ -258,6 +258,33 @@ def check():
         sys.exit(1)
 
 
+@cli.command()
+@click.option('--timeout', default=90.0, show_default=True,
+              help='Backend-init probe timeout (seconds).')
+@click.option('--no-probe', is_flag=True,
+              help='Skip the init probe: process table + relay only.')
+@click.option('--reap', is_flag=True,
+              help='Kill session-owned (fingerprinted) stray daemons.')
+@click.option('--reap-all', is_flag=True,
+              help='Kill ALL framework daemons, fingerprinted or not.')
+@_clean_errors
+def doctor(timeout, no_probe, reap, reap_all):
+    """Diagnose TPU backend health: phased init probe, stray framework
+    daemons, device-relay socket state (see utils/tpu_doctor.py)."""
+    import json as _json
+
+    from skypilot_tpu.utils import tpu_doctor
+    if reap or reap_all:
+        res = tpu_doctor.reap_stray_processes(reap_all=reap_all)
+        click.echo(f"Reaped {len(res['reaped'])} stray process(es); "
+                   f"spared {len(res['spared'])} unfingerprinted.",
+                   err=True)
+    report = tpu_doctor.doctor_report(timeout, probe=not no_probe)
+    click.echo(_json.dumps(report, indent=2))
+    if not no_probe and not report['probe']['ok']:
+        sys.exit(1)
+
+
 @cli.command('show-tpus')
 @click.option('--name-filter', default=None)
 @click.option('--region', default=None)

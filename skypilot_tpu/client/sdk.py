@@ -102,7 +102,8 @@ def get(request_id: str, timeout: float = 600.0) -> Any:
                          timeout=timeout + 10, headers=_headers())
     body = r.json()
     if r.status_code == 202:
-        raise TimeoutError(f'request {request_id} still {body.get("status")}')
+        raise exceptions.RequestPendingError(
+            f'request {request_id} still {body.get("status")}')
     if r.status_code != 200:
         raise exceptions.SkyTpuError(body.get('error', r.text))
     if body.get('error'):

@@ -16,6 +16,7 @@ free functions on a default client for drop-in use.
 """
 from __future__ import annotations
 
+import asyncio
 import contextlib
 import json
 import os
@@ -66,6 +67,22 @@ class AsyncClient:
         except aiohttp.ClientConnectionError as e:
             raise exceptions.ApiServerConnectionError(self._url,
                                                       str(e)) from e
+        except aiohttp.ContentTypeError as e:
+            # Non-JSON error body (a proxy's HTML 502, a truncated
+            # response): a malformed server reply, not a client bug.
+            raise exceptions.SkyTpuError(
+                f'API server at {self._url} returned a non-JSON '
+                f'response: {e}') from e
+        except aiohttp.ClientError as e:  # remaining transport failures
+            raise exceptions.ApiServerConnectionError(self._url,
+                                                      str(e)) from e
+        except exceptions.RequestPendingError:
+            raise  # our own poll-timeout raise, not a transport failure
+        except asyncio.TimeoutError as e:
+            # aiohttp raises this for ClientTimeout expiry; the sync
+            # SDK's analog is a connection error, so mirror that.
+            raise exceptions.ApiServerConnectionError(
+                self._url, 'request timed out') from e
 
     @staticmethod
     def _workspace() -> str:
@@ -109,7 +126,7 @@ class AsyncClient:
                 timeout=aiohttp.ClientTimeout(total=timeout + 10)) as r:
             body = await r.json()
             if r.status == 202:
-                raise TimeoutError(
+                raise exceptions.RequestPendingError(
                     f'request {request_id} still {body.get("status")}')
             if r.status != 200:
                 raise exceptions.SkyTpuError(body.get('error', str(body)))

@@ -206,6 +206,15 @@ class RequestCancelled(SkyTpuError):
     pass
 
 
+class RequestPendingError(TimeoutError):
+    """``sdk.get`` poll timeout: the request is still running server-side.
+
+    Subclasses TimeoutError so existing ``except TimeoutError: continue``
+    polling loops keep working, while letting the async SDK's transport
+    error translation tell this deliberate raise apart from aiohttp's
+    asyncio.TimeoutError (which IS builtin TimeoutError on py>=3.11)."""
+
+
 class RequestNotFoundError(SkyTpuError):
     pass
 

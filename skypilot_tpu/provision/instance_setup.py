@@ -176,18 +176,31 @@ def agent_token_path(cluster_name: str) -> str:
 
 def push_agent_token(runners: Sequence[CommandRunner],
                      cluster_name: str) -> None:
-    """Generate the cluster's shared agent token and install it on every
-    node, over the same authenticated channel as the cluster SSH key.
-    Non-loopback worker agents reject RPCs without it (the streaming Exec
-    RPC is arbitrary command execution — it must not be reachable by any
-    peer with mere pod-network connectivity). Staged through a DEDICATED
+    """Install the cluster's shared agent token on every node, over the
+    same authenticated channel as the cluster SSH key. Non-loopback
+    worker agents reject RPCs without it (the streaming Exec RPC is
+    arbitrary command execution — it must not be reachable by any peer
+    with mere pod-network connectivity). Staged through a DEDICATED
     ``token/`` subdir (like the key push's ``keys/``): runners rsync whole
     directories with mirror semantics, so syncing onto the live cluster
-    dir would wipe the head agent's port file and job table."""
+    dir would wipe the head agent's port file and job table.
+
+    GENERATE-IF-ABSENT (r3 advisor medium): agent starts are
+    pidfile-guarded no-ops when an agent is already alive, and running
+    agents hold their token in memory — so re-provisioning a cluster
+    whose agents survived (interrupted launch, stale record) must push
+    the token those agents already enforce, not mint a fresh one that
+    would wedge every subsequent Exec RPC with UNAUTHENTICATED."""
     import secrets
     import tempfile
 
-    token = secrets.token_hex(32)
+    token = None
+    rc, existing = runners[0].output(
+        f'cat {agent_token_path(cluster_name)} 2>/dev/null')
+    if rc == 0 and existing.strip():
+        token = existing.strip()
+    if token is None:
+        token = secrets.token_hex(32)
     token_dir = f'{REMOTE_RUNTIME_DIR}/clusters/{cluster_name}/token'
     with tempfile.TemporaryDirectory(prefix='skytpu-token-') as td:
         path = os.path.join(td, 'agent.token')

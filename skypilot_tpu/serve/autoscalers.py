@@ -120,6 +120,15 @@ class RequestRateAutoscaler(Autoscaler):
 _ALIVE = ('PROVISIONING', 'STARTING', 'READY', 'NOT_READY')
 
 
+def _ceil_units(units: float, weight: float) -> int:
+    """Replicas needed to supply ``units`` capacity at ``weight`` per
+    replica. Rounded before ceil so float fuzz (2.0000000001) does not
+    buy an extra replica; plain float division so tiny weights cannot
+    truncate a scaled-integer divisor to zero."""
+    import math
+    return max(int(math.ceil(round(units / weight, 6))), 0)
+
+
 def _alive(replicas: Optional[List[Dict[str, Any]]]
            ) -> List[Dict[str, Any]]:
     out = []
@@ -186,9 +195,8 @@ class InstanceAwareRequestRateAutoscaler(RequestRateAutoscaler):
                     :len(alive) - decision.target_num_replicas]
             return decision
         # Short on capacity: add replicas at the base launch weight.
-        deficit = needed_units - have_units
-        extra = -(-int(deficit * 1000) //
-                  int(self.new_replica_weight * 1000))
+        extra = _ceil_units(needed_units - have_units,
+                            self.new_replica_weight)
         desired = self._clamp(len(alive) + extra)
         return self._apply_hysteresis(desired, qps)
 
@@ -202,16 +210,31 @@ class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
     pressure), the gap is temporarily covered by EXTRA on-demand
     replicas, which drain once spot capacity recovers.
 
+    Capacity-weighted like ``InstanceAwareRequestRateAutoscaler`` (r3
+    advisor low): ``target_qps_per_replica`` is the weight-1 rate, new
+    launches are assumed to arrive at ``new_replica_weight``, and the
+    preemption gap is measured in capacity UNITS — in a heterogeneous
+    ``any_of`` fleet a surviving weight-2 spot replica covers for two
+    preempted weight-1s instead of triggering on-demand over-launch.
+
     Reference: ``sky/serve/autoscalers.py:909``.
     """
+
+    def __init__(self, policy: ReplicaPolicy,
+                 new_replica_weight: float = 1.0, **kwargs):
+        super().__init__(policy, **kwargs)
+        self.new_replica_weight = max(new_replica_weight, 1e-6)
 
     def evaluate(self, num_ready, num_launching, request_times,
                  now=None, replicas=None) -> AutoscalerDecision:
         now = now if now is not None else time.time()
         qps = self._qps(request_times, now)
         base_od = int(self.policy.base_ondemand_fallback_replicas)
+        w = self.new_replica_weight
+        needed_units = (qps / float(self.policy.target_qps_per_replica)
+                        if qps > 0 else 0.0)
         desired_total = self._clamp(
-            -(-int(qps * 100) // int(self.policy.target_qps_per_replica * 100))
+            _ceil_units(needed_units, w)
             if qps > 0 else self.policy.min_replicas)
         decision = self._apply_hysteresis(desired_total, qps)
         spot_target = max(decision.target_num_replicas - base_od, 0)
@@ -221,12 +244,14 @@ class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
         # be misread as preemptions — that would over-launch on-demand
         # and churn it back down minutes later). NOT_READY is excluded:
         # a replica that went dark is preemption-shaped and DOES open
-        # the gap.
-        healthy_spot = sum(
-            1 for r in alive if bool(r.get('use_spot'))
+        # the gap. Measured in capacity units, not heads.
+        healthy_spot_units = sum(
+            float(r.get('weight') or 1.0) for r in alive
+            if bool(r.get('use_spot'))
             and getattr(r.get('status'), 'value', r.get('status'))
             in ('READY', 'PROVISIONING', 'STARTING'))
-        gap = (max(spot_target - healthy_spot, 0)
+        gap_units = max(spot_target * w - healthy_spot_units, 0.0)
+        gap = (_ceil_units(gap_units, w)
                if replicas is not None else 0)
         num_ondemand = base_od + gap
         if self.policy.max_replicas is not None:
@@ -248,7 +273,8 @@ def make_autoscaler(policy: ReplicaPolicy,
                     new_replica_weight: float = 1.0) -> Autoscaler:
     if policy.autoscaling and policy.target_qps_per_replica:
         if policy.base_ondemand_fallback_replicas > 0:
-            return FallbackRequestRateAutoscaler(policy)
+            return FallbackRequestRateAutoscaler(
+                policy, new_replica_weight=new_replica_weight)
         return InstanceAwareRequestRateAutoscaler(
             policy, new_replica_weight=new_replica_weight)
     return FixedReplicaAutoscaler(policy)

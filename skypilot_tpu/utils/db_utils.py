@@ -144,9 +144,18 @@ class PostgresConnection:
         return _PgCursorWrapper(cur)
 
     def executescript(self, script: str) -> None:
+        # sqlite3.Connection.executescript commits the pending transaction
+        # and runs the script in autocommit; mirror that by committing
+        # after the script so a later failed (and rolled-back) statement —
+        # e.g. an idempotent duplicate-column migration — cannot undo the
+        # schema on transactional drivers (psycopg2/pg8000).
         for stmt in script.split(';'):
             if stmt.strip():
                 self.execute(stmt)
+        self._conn.commit()
+
+    def commit(self) -> None:
+        self._conn.commit()
 
     def __enter__(self) -> 'PostgresConnection':
         return self
@@ -181,8 +190,13 @@ def connect(sqlite_path: str, schema: str,
         conn.row_factory = sqlite3.Row
     conn.executescript(schema)
     for ddl in migrations:
+        # Each migration commits on its own: on transactional Postgres
+        # drivers a failed ALTER rolls back the open transaction, so a
+        # shared transaction would silently drop every earlier migration
+        # (and, before executescript committed, the schema itself).
         try:
             conn.execute(ddl)
+            conn.commit()
         except OperationalError:
-            pass  # column already present
+            pass  # column already present (adapter already rolled back)
     return conn

@@ -348,6 +348,75 @@ def test_async_sdk_connection_error_is_typed():
         asyncio.run(drive())
 
 
+def test_async_sdk_timeout_and_nonjson_are_typed():
+    """r3 advisor low: ClientTimeout expiry and non-JSON error bodies
+    must surface as typed SDK errors, matching the sync contract."""
+    import asyncio
+    import socket
+    import threading
+
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.client import sdk_async
+
+    # A server that accepts and never responds -> ClientTimeout expiry.
+    srv = socket.socket()
+    srv.bind(('127.0.0.1', 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    accepted = []
+    threading.Thread(target=lambda: accepted.append(srv.accept()),
+                     daemon=True).start()
+
+    async def drive_timeout():
+        async with sdk_async.AsyncClient(
+                f'http://127.0.0.1:{port}') as client:
+            import aiohttp
+            session = await client._ensure_session()
+            async with client._typed_errors(), session.get(
+                    f'http://127.0.0.1:{port}/api/v1/status',
+                    timeout=aiohttp.ClientTimeout(total=0.5)) as r:
+                await r.json()
+
+    try:
+        with pytest.raises(exceptions.ApiServerConnectionError):
+            asyncio.run(drive_timeout())
+    finally:
+        srv.close()
+
+    # A server speaking HTML (a proxy 502 page) -> typed SkyTpuError,
+    # not a raw aiohttp.ContentTypeError.
+    class _HtmlHandler(threading.Thread):
+        def __init__(self):
+            super().__init__(daemon=True)
+            self.sock = socket.socket()
+            self.sock.bind(('127.0.0.1', 0))
+            self.sock.listen(1)
+            self.port = self.sock.getsockname()[1]
+
+        def run(self):
+            conn, _ = self.sock.accept()
+            conn.recv(65536)
+            body = b'<html>bad gateway</html>'
+            conn.sendall(b'HTTP/1.1 200 OK\r\nContent-Type: text/html\r\n'
+                         b'Content-Length: %d\r\n\r\n%s' %
+                         (len(body), body))
+            conn.close()
+
+    handler = _HtmlHandler()
+    handler.start()
+
+    async def drive_html():
+        async with sdk_async.AsyncClient(
+                f'http://127.0.0.1:{handler.port}') as client:
+            await client.status()
+
+    try:
+        with pytest.raises(exceptions.SkyTpuError):
+            asyncio.run(drive_html())
+    finally:
+        handler.sock.close()
+
+
 def test_dashboard_v2_detail_pages(server):
     """Dashboard v2 (VERDICT r2 missing #2): every entity in status/queue
     is drillable — cluster detail with events + log tail, managed-job and

@@ -15,8 +15,8 @@ from skypilot_tpu.utils import db_utils
 
 
 class StubPgCursor:
-    def __init__(self, conn):
-        self._conn = conn
+    def __init__(self, owner):
+        self._owner = owner
         self._cur = None
 
     def execute(self, sql, params=()):
@@ -30,8 +30,9 @@ class StubPgCursor:
         back = re.sub(r'\bDOUBLE PRECISION\b', 'REAL', back)
         back = back.replace('%s', '?')
         import sqlite3
+        self._owner._begin()  # psycopg2 opens a tx on first statement
         try:
-            self._cur = self._conn.execute(back, tuple(params))
+            self._cur = self._owner._conn.execute(back, tuple(params))
         except sqlite3.OperationalError as e:
             raise RuntimeError(str(e))  # driver-native error shape
 
@@ -52,20 +53,38 @@ class StubPgCursor:
 
 class StubPgConnection:
     """DBAPI connection over ONE shared sqlite file per URL (the shared
-    Postgres all replicas would dial)."""
+    Postgres all replicas would dial).
+
+    TRANSACTIONAL like real Postgres drivers: every statement — DDL
+    included — joins an explicit transaction opened at first execute;
+    rollback() discards uncommitted DDL. Python's sqlite3 autocommits
+    DDL by default, which masked the r3 advisor-high bug (a failed
+    migration's rollback erasing the uncommitted schema), so the stub
+    manages BEGIN/COMMIT/ROLLBACK itself on an autocommit connection."""
 
     def __init__(self, backing_path):
         import sqlite3
-        self._conn = sqlite3.connect(backing_path, timeout=10)
+        self._conn = sqlite3.connect(backing_path, timeout=10,
+                                     isolation_level=None)
+        self._in_tx = False
+
+    def _begin(self):
+        if not self._in_tx:
+            self._conn.execute('BEGIN')
+            self._in_tx = True
 
     def cursor(self):
-        return StubPgCursor(self._conn)
+        return StubPgCursor(self)
 
     def commit(self):
-        self._conn.commit()
+        if self._in_tx:
+            self._conn.execute('COMMIT')
+            self._in_tx = False
 
     def rollback(self):
-        self._conn.rollback()
+        if self._in_tx:
+            self._conn.execute('ROLLBACK')
+            self._in_tx = False
 
     def close(self):
         self._conn.close()
@@ -114,6 +133,20 @@ def test_requests_db_over_postgres_shared_across_replicas(pg_stub):
         (rid,)).fetchall()
     assert [dict(r) for r in rows] == [
         {'request_id': rid, 'status': 'SUCCEEDED'}]
+
+
+def test_schema_survives_failed_migration_on_fresh_db(pg_stub):
+    """r3 advisor high: on transactional drivers a duplicate-column
+    migration failure must not roll back the just-created schema."""
+    conn = db_utils.connect(
+        'unused', 'CREATE TABLE IF NOT EXISTS t (a TEXT, b TEXT);',
+        migrations=('ALTER TABLE t ADD COLUMN b TEXT',))  # dup: fails
+    conn.execute('INSERT INTO t (a, b) VALUES (?, ?)', ('x', 'y'))
+    conn.close()
+    conn2 = db_utils.connect('unused', 'SELECT 1')
+    rows = conn2.execute('SELECT a, b FROM t').fetchall()
+    assert [dict(r) for r in rows] == [{'a': 'x', 'b': 'y'}]
+    conn2.close()
 
 
 def test_sqlite_default_unaffected(tmp_path, monkeypatch):

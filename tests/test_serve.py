@@ -347,6 +347,34 @@ def test_fallback_autoscaler_base_ondemand_and_preemption_gap():
     assert 'covering spot gap' in d.reason
 
 
+def test_fallback_autoscaler_capacity_weighted_gap():
+    """r3 advisor low: the preemption gap is measured in capacity units —
+    in a heterogeneous any_of fleet, one surviving weight-2 spot replica
+    covers for two preempted weight-1s instead of over-launching
+    on-demand."""
+    from skypilot_tpu.serve.autoscalers import FallbackRequestRateAutoscaler
+    pol = ReplicaPolicy(min_replicas=3, max_replicas=10,
+                        target_qps_per_replica=10,
+                        base_ondemand_fallback_replicas=1)
+    auto = FallbackRequestRateAutoscaler(pol, upscale_counter_threshold=1)
+    now = 1000.0
+    # 30 qps -> 3 total -> 2 spot heads (2 capacity units target). One
+    # weight-2 spot survives, a weight-1 went dark: units held = 2 >=
+    # target 2, so NO extra on-demand despite a head going NOT_READY.
+    reps = [_rep(1, use_spot=True, weight=2.0),
+            _rep(2, use_spot=True, weight=1.0, status='NOT_READY'),
+            _rep(3, use_spot=False)]
+    d = auto.evaluate(2, 0, _times(30, now), now=now, replicas=reps)
+    assert (d.num_spot, d.num_ondemand) == (2, 1)
+    # Both weight-1 spots dark, only units held = 0: gap of 2 units ->
+    # 2 extra on-demand.
+    reps = [_rep(1, use_spot=True, weight=1.0, status='NOT_READY'),
+            _rep(2, use_spot=True, weight=1.0, status='NOT_READY'),
+            _rep(3, use_spot=False)]
+    d = auto.evaluate(1, 0, _times(30, now), now=now, replicas=reps)
+    assert (d.num_spot, d.num_ondemand) == (2, 3)
+
+
 def test_make_autoscaler_selects_by_policy():
     from skypilot_tpu.serve.autoscalers import (
         FallbackRequestRateAutoscaler, FixedReplicaAutoscaler,

@@ -137,6 +137,28 @@ def test_start_agent_on_head_idempotent(fake_ssh):
         pass
 
 
+def test_push_agent_token_reuses_existing(fake_ssh):
+    """r3 advisor medium: re-provisioning a cluster whose agents survived
+    must push the token those agents already hold, not mint a new one."""
+    fake_ssh.up('head')
+    fake_ssh.up('w1')
+    runners = [_runner('head'), _runner('w1')]
+    instance_setup.push_agent_token(runners, 'ctok')
+    tok_path = ('.skytpu/runtime/clusters/ctok/token/agent.token')
+    first = (fake_ssh.home('head') / tok_path).read_text()
+    assert (fake_ssh.home('w1') / tok_path).read_text() == first
+    # Second bootstrap (same cluster): token unchanged everywhere.
+    instance_setup.push_agent_token(runners, 'ctok')
+    assert (fake_ssh.home('head') / tok_path).read_text() == first
+    assert (fake_ssh.home('w1') / tok_path).read_text() == first
+    # Different cluster: independent token.
+    fake_ssh.up('w2')
+    instance_setup.push_agent_token([_runner('w2')], 'other')
+    other = (fake_ssh.home('w2') /
+             '.skytpu/runtime/clusters/other/token/agent.token').read_text()
+    assert other != first
+
+
 def test_gang_launch_over_ssh_full_env_contract(fake_ssh, enable_fake_cloud,
                                                 monkeypatch):
     """4-worker fake slice executed through the SSH path end to end: the
