@@ -30,9 +30,10 @@ audit-clean:
 test-fast:
 	$(PY) -m pytest tests/ -q -m "not slow and not load" -p no:cacheprovider
 
-# Full suite minus sustained load tests (~30 min serial).
+# Full suite minus sustained load tests — with a 30-minute duration
+# budget asserted after the run (fails loudly if the tier regresses).
 test:
-	$(PY) -m pytest tests/ -q -m "not load"
+	$(PY) tools/run_budgeted.py 1800 $(PY) -m pytest tests/ -q -m "not load"
 
 # Everything, including load/chaos suites.
 test-all:

@@ -110,9 +110,12 @@ class KubectlTunnel:
     def __init__(self, head_spec: RunnerSpec, remote_port: int):
         assert head_spec.kind == 'k8s', head_spec
         self.local_port = _free_local_port()
-        argv = ['kubectl', 'port-forward', '-n', head_spec.namespace,
-                f'pod/{head_spec.ip}',
-                f'{self.local_port}:{remote_port}']
+        ctx = (['--context', head_spec.context]
+               if getattr(head_spec, 'context', None) else [])
+        argv = (['kubectl'] + ctx +
+                ['port-forward', '-n', head_spec.namespace,
+                 f'pod/{head_spec.ip}',
+                 f'{self.local_port}:{remote_port}'])
         self.proc = subprocess.Popen(argv, stdout=subprocess.DEVNULL,
                                      stderr=subprocess.DEVNULL)
         self._wait_listening()

@@ -53,6 +53,13 @@ def runtime_dir(cluster_name: str) -> str:
             'runtime', cluster_name))
 
 
+def _is_pod_cloud(cloud: str) -> bool:
+    """Clouds whose workers are k8s pods (kubectl runners, no sshd, gang
+    fan-out over per-pod agent Exec RPC): GKE TPU node pools and the
+    context-generic kubernetes provider share all pod semantics."""
+    return cloud in ('gke', 'kubernetes')
+
+
 class TpuGangBackend(Backend):
 
     NAME = 'tpu_gang'
@@ -123,10 +130,17 @@ class TpuGangBackend(Backend):
                 num_nodes=task.num_nodes, node_config=deploy_vars,
                 tags={'skytpu-cluster': cluster_name},
                 ports_to_open=to_provision.ports)
+            provider_config = {
+                'region': region,
+                'zone': zone,
+                'namespace': deploy_vars.get('namespace'),
+                'context': deploy_vars.get('context'),
+            }
             try:
                 provision_lib.run_instances(to_provision.cloud, cfg)
                 provision_lib.wait_instances(to_provision.cloud, region,
-                                             name_on_cloud, 'running')
+                                             name_on_cloud, 'running',
+                                             provider_config=provider_config)
             except (exceptions.QuotaExceededError,
                     exceptions.ResourcesUnavailableError) as e:
                 failover_history.append(e)
@@ -144,11 +158,7 @@ class TpuGangBackend(Backend):
                 launched_resources=to_provision.to_yaml_config(),
                 is_tpu=to_provision.tpu is not None,
                 price_per_hour=to_provision.price_per_hour,
-                provider_config={
-                    'region': region,
-                    'zone': zone,
-                    'namespace': deploy_vars.get('namespace'),
-                })
+                provider_config=provider_config)
             os.makedirs(runtime_dir(cluster_name), exist_ok=True)
             try:
                 self._post_provision_setup(handle)
@@ -232,7 +242,7 @@ class TpuGangBackend(Backend):
             start_daemon=self._remote_control(handle),
             python=os.environ.get('SKYTPU_REMOTE_PYTHON', 'python3'),
             worker_agents_port=(self.WORKER_AGENT_PORT
-                                if handle.cloud == 'gke' else None))
+                                if _is_pod_cloud(handle.cloud) else None))
 
     def _start_cluster_daemon(self, cluster_name: str) -> None:
         """Spawn the per-cluster autostop/heartbeat daemon (skylet analog).
@@ -266,11 +276,18 @@ class TpuGangBackend(Backend):
                          info: provision_common.ClusterInfo) -> RunnerSpec:
         if handle.cloud in ('local', 'fake'):
             return RunnerSpec(kind='local', ip=inst.internal_ip)
-        if handle.cloud == 'gke':
-            # Workers are pods; the "address" is the pod name.
+        if _is_pod_cloud(handle.cloud):
+            # Workers are pods; the "address" is the pod name. The
+            # generic kubernetes cloud also pins the kubeconfig context
+            # (its region IS the context).
+            from skypilot_tpu.provision.kubernetes import (
+                instance as k8s_instance)
+            pc = handle.provider_config or {}
             return RunnerSpec(
                 kind='k8s', ip=inst.instance_id,
-                namespace=os.environ.get('SKYTPU_GKE_NAMESPACE', 'default'))
+                namespace=(pc.get('namespace')
+                           or k8s_instance.default_namespace()),
+                context=pc.get('context'))
         return RunnerSpec(kind='ssh', ip=inst.external_ip or inst.internal_ip,
                           user=info.ssh_user, ssh_key=info.ssh_key_path)
 
@@ -476,7 +493,7 @@ class TpuGangBackend(Backend):
         SSH with the bootstrap-installed cluster key, or the peer agent's
         Exec RPC on pod networks (no sshd)."""
         from skypilot_tpu.agent import remote as remote_lib
-        if handle.cloud == 'gke':
+        if _is_pod_cloud(handle.cloud):
             # token_file is HEAD-relative: the driver runs on the head,
             # which received the token at bootstrap (push_agent_token).
             from skypilot_tpu.provision import instance_setup

@@ -448,6 +448,34 @@ def serve_down(service_name):
     click.echo(f'Service {service_name} shutting down.')
 
 
+@cli.group('local')
+def local_group():
+    """Local dev cluster via kind (analog of `sky local up`)."""
+
+
+@local_group.command('up')
+@click.option('--name', default=None, help='kind cluster name.')
+@_clean_errors
+def local_up_cmd(name):
+    """Create a local kind cluster and register it as capacity."""
+    from skypilot_tpu import local_cluster
+    ctx = local_cluster.local_up(name or local_cluster.DEFAULT_NAME)
+    click.echo(f'Local cluster up. Kubeconfig context: {ctx}\n'
+               f'Launch onto it with: stpu launch --cloud kubernetes '
+               f'-- <cmd>   (region {ctx})')
+
+
+@local_group.command('down')
+@click.option('--name', default=None, help='kind cluster name.')
+@_clean_errors
+def local_down_cmd(name):
+    """Tear the local kind cluster down."""
+    from skypilot_tpu import local_cluster
+    existed = local_cluster.local_down(name or local_cluster.DEFAULT_NAME)
+    click.echo('Local cluster deleted.' if existed
+               else 'No local cluster found.')
+
+
 @cli.group('storage')
 def storage_group():
     """Object-store buckets (reference: `sky storage`)."""

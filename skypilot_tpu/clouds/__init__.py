@@ -4,9 +4,10 @@ from skypilot_tpu.clouds.cloud import Cloud, CloudImplementationFeatures, Region
 from skypilot_tpu.clouds.fake import Fake
 from skypilot_tpu.clouds.gcp import GCP
 from skypilot_tpu.clouds.gke import GKE
+from skypilot_tpu.clouds.kubernetes import Kubernetes
 from skypilot_tpu.clouds.local import Local
 from skypilot_tpu.clouds.slurm import Slurm
 from skypilot_tpu.clouds.ssh import Ssh
 
 __all__ = ['AWS', 'Cloud', 'CloudImplementationFeatures', 'Region', 'GCP',
-           'GKE', 'Local', 'Fake', 'Ssh', 'Slurm']
+           'GKE', 'Kubernetes', 'Local', 'Fake', 'Ssh', 'Slurm']

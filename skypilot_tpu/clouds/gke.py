@@ -16,6 +16,8 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 from skypilot_tpu.catalog import gcp_catalog
 from skypilot_tpu.clouds import cloud as cloud_lib
 from skypilot_tpu.provision.gke.instance import GKE_TPU_ACCELERATOR
+from skypilot_tpu.provision.kubernetes.instance import (
+    default_namespace as _k8s_default_namespace)
 from skypilot_tpu.resources import Resources
 from skypilot_tpu.utils.registry import CLOUD_REGISTRY
 
@@ -104,7 +106,7 @@ class GKE(cloud_lib.Cloud):
             'chips_per_host': sl.chips_per_host,
             'use_spot': resources.use_spot,
             'image_id': resources.image_id,
-            'namespace': os.environ.get('SKYTPU_GKE_NAMESPACE', 'default'),
+            'namespace': _k8s_default_namespace(),
             'num_nodes': num_nodes,
             'labels': resources.labels,
         }

@@ -1,0 +1,105 @@
+"""Generic Kubernetes cloud: any kubeconfig context as capacity.
+
+Reference analog: ``sky/clouds/kubernetes.py`` — every context in the
+user's kubeconfig (kind, on-prem, EKS, a dev cluster from
+``stpu local up``) is a schedulable "region"; pods are nodes. Free ($0 —
+the cluster is the user's own), no stop/autostop (pods either run or
+don't), CPU pods only: TPU node pools are the GKE specialization
+(``clouds/gke.py``), which shares the same pods-as-nodes provisioner
+(``provision/kubernetes/instance.py``).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+Features = cloud_lib.CloudImplementationFeatures
+
+
+def _contexts() -> List[str]:
+    from skypilot_tpu.provision.kubernetes import k8s_client
+    return k8s_client.list_contexts()
+
+
+@CLOUD_REGISTRY.register
+class Kubernetes(cloud_lib.Cloud):
+
+    _REPR = 'kubernetes'
+
+    @classmethod
+    def supported_features(cls) -> set:
+        return {Features.MULTI_NODE, Features.STORAGE_MOUNTING,
+                Features.OPEN_PORTS}
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        path = os.environ.get('KUBECONFIG',
+                              os.path.expanduser('~/.kube/config'))
+        if not os.path.exists(os.path.expanduser(path)):
+            return False, ('No kubeconfig found. Point KUBECONFIG at a '
+                           'cluster config, or run `stpu local up` for a '
+                           'local kind cluster.')
+        try:
+            contexts = _contexts()
+        except Exception as e:  # noqa: BLE001 — malformed kubeconfig
+            return False, f'Could not parse kubeconfig: {e}'
+        if not contexts:
+            return False, 'Kubeconfig has no contexts.'
+        return True, None
+
+    def regions(self) -> List[cloud_lib.Region]:
+        # One "region" per kubeconfig context (the reference's model):
+        # `--region kind-skytpu` targets that cluster.
+        return [cloud_lib.Region(name=c) for c in _contexts()]
+
+    def zones_for(self, resources: Resources) -> Iterator[Tuple[str, str]]:
+        for ctx in _contexts():
+            if resources.region in (None, ctx):
+                yield ctx, ctx
+
+    def get_feasible_launchable_resources(
+            self, resources: Resources) -> List[Resources]:
+        if resources.cloud is not None and resources.cloud != self._REPR:
+            return []
+        if resources.accelerator_name is not None or resources.tpu is not None:
+            return []  # TPU slices come from GKE/GCP
+        if resources.use_spot:
+            return []  # the user's own cluster has no spot semantics
+        try:
+            contexts = _contexts()
+        except Exception:  # noqa: BLE001 — no/bad kubeconfig: not feasible
+            return []
+        out = []
+        for ctx in contexts:
+            if resources.region in (None, ctx):
+                out.append(resources.copy(cloud=self._REPR, region=ctx,
+                                          _price_per_hour=0.0))
+        return out
+
+    def make_deploy_variables(self, resources: Resources,
+                              cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str],
+                              num_nodes: int) -> Dict[str, Any]:
+        from skypilot_tpu.provision.kubernetes import instance as k8s_instance
+        cpus, _ = resources.cpus_requirement()
+        memory, _ = resources.memory_requirement()
+        return {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': zone,
+            'context': region,  # region IS the kubeconfig context
+            'namespace': k8s_instance.default_namespace(),
+            'cpus': cpus,
+            'memory': memory,
+            'image_id': resources.image_id,
+            'num_nodes': num_nodes,
+            'labels': resources.labels,
+        }
+
+    @property
+    def provisioner_module(self) -> str:
+        return 'skypilot_tpu.provision.kubernetes'

@@ -1,0 +1,86 @@
+"""`stpu local up/down`: a no-cloud dev loop on a local kind cluster.
+
+Reference analog: ``sky/core.py:1023`` (``local_up``) — spin up a local
+Kubernetes cluster and register it as capacity, so the full launch →
+pods → gang exec path runs on a laptop with zero cloud credentials. We
+shell out to ``kind`` (https://kind.sigs.k8s.io); the created context
+(``kind-<name>``) then shows up as a region of the generic kubernetes
+cloud (``clouds/kubernetes.py``) and `stpu check` reports it.
+"""
+from __future__ import annotations
+
+import shutil
+import subprocess
+from typing import Optional
+
+from skypilot_tpu import exceptions
+
+DEFAULT_NAME = 'skytpu'
+
+
+def _kind_binary() -> str:
+    kind = shutil.which('kind')
+    if kind is None:
+        raise exceptions.NotSupportedError(
+            '`kind` is not installed. Install it from '
+            'https://kind.sigs.k8s.io/docs/user/quick-start/ (a single '
+            'static binary), or point KUBECONFIG at any existing cluster '
+            '— the kubernetes cloud works with either.')
+    return kind
+
+
+def _existing_clusters(kind: str) -> list:
+    r = subprocess.run([kind, 'get', 'clusters'], capture_output=True,
+                       text=True, timeout=60, check=False)
+    if r.returncode != 0:
+        return []
+    return r.stdout.split()
+
+
+def context_name(name: str = DEFAULT_NAME) -> str:
+    return f'kind-{name}'
+
+
+def local_up(name: str = DEFAULT_NAME,
+             timeout: Optional[float] = 600.0) -> str:
+    """Create (or reuse) the local kind cluster; returns the kubeconfig
+    context name registered for it."""
+    kind = _kind_binary()
+    if name not in _existing_clusters(kind):
+        r = subprocess.run([kind, 'create', 'cluster', '--name', name],
+                           capture_output=True, text=True, timeout=timeout,
+                           check=False)
+        if r.returncode != 0:
+            raise exceptions.ClusterNotUpError(
+                f'kind create cluster failed (rc={r.returncode}): '
+                f'{r.stderr.strip()[-800:]}')
+    ctx = context_name(name)
+    # kind writes the context into the active kubeconfig; verify the
+    # kubernetes cloud can actually see it before declaring victory.
+    from skypilot_tpu.provision.kubernetes import k8s_client
+    try:
+        contexts = k8s_client.list_contexts()
+    except OSError as e:
+        raise exceptions.ClusterNotUpError(
+            f'kind reported success but no kubeconfig was written: {e}'
+        ) from e
+    if ctx not in contexts:
+        raise exceptions.ClusterNotUpError(
+            f'kind cluster {name!r} is up but context {ctx!r} is missing '
+            f'from the kubeconfig (have: {contexts}).')
+    return ctx
+
+
+def local_down(name: str = DEFAULT_NAME) -> bool:
+    """Delete the local kind cluster; True if one existed."""
+    kind = _kind_binary()
+    if name not in _existing_clusters(kind):
+        return False
+    r = subprocess.run([kind, 'delete', 'cluster', '--name', name],
+                       capture_output=True, text=True, timeout=300,
+                       check=False)
+    if r.returncode != 0:
+        raise exceptions.SkyTpuError(
+            f'kind delete cluster failed (rc={r.returncode}): '
+            f'{r.stderr.strip()[-800:]}')
+    return True

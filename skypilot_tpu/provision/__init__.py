@@ -32,9 +32,14 @@ def run_instances(provider_name: str,
 
 @timeline.event
 def wait_instances(provider_name: str, region: str,
-                   cluster_name_on_cloud: str, state: str) -> None:
-    return _impl(provider_name).wait_instances(region, cluster_name_on_cloud,
-                                               state)
+                   cluster_name_on_cloud: str, state: str,
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
+    # Part of the uniform provider contract (like terminate/query): k8s
+    # providers need the namespace/context during the provisioning wait;
+    # VM clouds ignore it.
+    return _impl(provider_name).wait_instances(
+        region, cluster_name_on_cloud, state,
+        provider_config=provider_config)
 
 
 @timeline.event

@@ -196,7 +196,8 @@ def _region_of(provider_config: Optional[Dict[str, Any]]) -> str:
 
 
 def wait_instances(region: str, cluster_name_on_cloud: str, state: str,
-                   timeout: float = 600.0, poll: float = 3.0) -> None:
+                   timeout: float = 600.0, poll: float = 3.0,
+                   provider_config=None) -> None:
     """Poll until every cluster instance reports ``running``."""
     del state
     client = _client(region)

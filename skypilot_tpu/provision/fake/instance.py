@@ -162,7 +162,7 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
 
 
 def wait_instances(region: str, cluster_name_on_cloud: str,
-                   state: str) -> None:
+                   state: str, provider_config=None) -> None:
     # Fake instances transition instantly.
     del region, state
     with _flock():

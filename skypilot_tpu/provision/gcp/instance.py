@@ -258,7 +258,7 @@ def _find_zone(cluster_name_on_cloud: str,
 
 
 def wait_instances(region: str, cluster_name_on_cloud: str,
-                   state: str) -> None:
+                   state: str, provider_config=None) -> None:
     del region, state  # creation ops are waited synchronously
     # Nothing further: run_instances waits each create op to completion.
 

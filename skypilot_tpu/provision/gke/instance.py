@@ -1,25 +1,29 @@
 """GKE TPU provisioner: pods pinned to TPU node pools.
 
-Reference analog: ``sky/provision/kubernetes/`` with its GKE TPU support in
-``utils.py`` — accelerator→generation map (``:193-199``), topology
+Reference analog: ``sky/provision/kubernetes/`` with its GKE TPU support
+in ``utils.py`` — accelerator→generation map (``:193-199``), topology
 reduction / multi-host detection (``:3398-3420``), the ``google.com/tpu``
 resource key (``:159``) and the GKE node selectors (``:531-533``).
 
 Model: one pod per worker HOST. A multi-host slice (``tpu-v5e-16`` = 4
-hosts) becomes ``hosts`` pods landing on the same multi-host TPU node pool;
-GKE's TPU webhook + our gang driver provide the worker env contract. Pods
-sleep and are exec'd into by the command runner (kubectl), mirroring the
-reference's pods-as-nodes design.
+hosts) becomes ``hosts`` pods landing on the same multi-host TPU node
+pool; GKE's TPU webhook + our gang driver provide the worker env
+contract. Pods sleep and are exec'd into by the command runner (kubectl),
+mirroring the reference's pods-as-nodes design.
+
+This module is ONLY the GKE-specific layer: the TPU node-pool selectors
+and the ``google.com/tpu`` resource requests. Every lifecycle function —
+create-all-or-rollback, waits, query/terminate, port Services, the agent
+NetworkPolicy — is the context-generic machinery in
+``provision/kubernetes/instance.py``, re-exported here.
 """
 from __future__ import annotations
 
-import os
-import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.provision import common
-from skypilot_tpu.provision.gke import k8s_client as k8s_lib
+from skypilot_tpu.provision.kubernetes import instance as k8s_instance
 
 # GKE node-pool selector values per TPU generation
 # (reference: provision/kubernetes/utils.py:193-199).
@@ -30,41 +34,26 @@ GKE_TPU_ACCELERATOR = {
     'v6e': 'tpu-v6e-slice',
 }
 
-LABEL_CLUSTER = 'skytpu-cluster'
-LABEL_NODE = 'skytpu-node'
-LABEL_WORKER = 'skytpu-worker'
+LABEL_CLUSTER = k8s_instance.LABEL_CLUSTER
+LABEL_NODE = k8s_instance.LABEL_NODE
+LABEL_WORKER = k8s_instance.LABEL_WORKER
+DEFAULT_IMAGE = k8s_instance.DEFAULT_IMAGE
 
-# Pods must carry the framework runtime's python deps (grpcio, protobuf,
-# filelock, requests, yaml) for the on-pod agents — set `image_id:` to your
-# ML image (the reference likewise requires its wheel's deps in the pod
-# image). The slim default suffices only for exec-style workloads driven
-# entirely through kubectl.
-DEFAULT_IMAGE = 'python:3.11-slim'
-
-_client_override: Optional[k8s_lib.K8sClient] = None
-
-
-def set_client_for_testing(client: k8s_lib.K8sClient) -> None:
-    global _client_override
-    _client_override = client
-
-
-def _default_namespace() -> str:
-    return os.environ.get('SKYTPU_GKE_NAMESPACE', 'default')
-
-
-def _client(namespace: Optional[str] = None) -> k8s_lib.K8sClient:
-    if _client_override is not None:
-        return _client_override
-    # Lifecycle ops (wait/query/terminate/info) must look in the SAME
-    # namespace run_instances created pods in; both default from
-    # SKYTPU_GKE_NAMESPACE (the cloud's deploy vars use it too).
-    return k8s_lib.K8sClient(k8s_lib.transport_from_kubeconfig(),
-                             namespace=namespace or _default_namespace())
-
-
-def _pod_name(cluster: str, node: int, worker: int) -> str:
-    return f'{cluster}-{node}-w{worker}'
+# Shared lifecycle machinery (context-generic; see module docstring).
+set_client_for_testing = k8s_instance.set_client_for_testing
+_client = k8s_instance._client  # noqa: SLF001 — same package family
+_pod_name = k8s_instance.pod_name
+_default_namespace = k8s_instance.default_namespace
+_ensure_agent_network_policy = k8s_instance.ensure_agent_network_policy
+_agent_policy_name = k8s_instance._agent_policy_name  # noqa: SLF001
+_cleanup = k8s_instance._cleanup  # noqa: SLF001
+wait_instances = k8s_instance.wait_instances
+stop_instances = k8s_instance.stop_instances
+terminate_instances = k8s_instance.terminate_instances
+query_instances = k8s_instance.query_instances
+open_ports = k8s_instance.open_ports
+cleanup_ports = k8s_instance.cleanup_ports
+external_endpoint = k8s_instance.external_endpoint
 
 
 def _pod_body(config: common.ProvisionConfig, node: int, worker: int
@@ -111,285 +100,15 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
     nc = config.node_config
     if not nc.get('tpu_vm', False):
         raise exceptions.NotSupportedError(
-            'The GKE provider schedules TPU node pools; use GCP for CPU VMs.')
-    client = _client(nc.get('namespace'))
-    existing = {p['metadata']['name']: p for p in client.list_pods(
-        f'{LABEL_CLUSTER}={config.cluster_name_on_cloud}')}
-    hosts = nc['hosts_per_slice']
-    created: List[str] = []
-    try:
-        for node in range(config.num_nodes):
-            for worker in range(hosts):
-                name = _pod_name(config.cluster_name_on_cloud, node, worker)
-                if name in existing:
-                    continue
-                client.create_pod(_pod_body(config, node, worker))
-                created.append(name)
-    except k8s_lib.K8sApiError as e:
-        for name in created:  # atomic slice semantics
-            try:
-                client.delete_pod(name)
-            except k8s_lib.K8sApiError:
-                pass
-        low = str(e).lower()
-        if 'quota' in low or 'exceeded' in low or e.status_code == 403:
-            raise exceptions.QuotaExceededError(
-                f'GKE quota/capacity: {e}') from e
-        raise
-    _ensure_agent_network_policy(client, config.cluster_name_on_cloud)
-    return common.ProvisionRecord(
-        provider_name='gke', region=config.region, zone=config.zone,
-        cluster_name_on_cloud=config.cluster_name_on_cloud,
-        head_instance_id=_pod_name(config.cluster_name_on_cloud, 0, 0),
-        created_instance_ids=created, resumed_instance_ids=[])
-
-
-def _agent_policy_name(cluster: str) -> str:
-    return f'{cluster}-agent-policy'
-
-
-def _ensure_agent_network_policy(client: k8s_lib.K8sClient,
-                                 cluster: str) -> None:
-    """Restrict the worker-agent port to the cluster's own pods.
-
-    Defense-in-depth beside the shared-token auth: the agents' streaming
-    Exec RPC is arbitrary command execution, so ingress on
-    WORKER_AGENT_PORT is limited to pods carrying this cluster's label —
-    any other pod in the namespace (or cluster, absent a permissive CNI)
-    is dropped at the network layer. Best-effort: clusters without a
-    NetworkPolicy controller still get the token check."""
-    from skypilot_tpu.agent import constants as agent_constants
-    name = _agent_policy_name(cluster)
-    # NetworkPolicy cannot express "deny just this port", and ingress
-    # rules are OR'd — so the construction is: same-cluster pods may
-    # reach everything, while all other peers may reach every port
-    # EXCEPT the agent port (expressed as the two endPort ranges around
-    # it, k8s >=1.25). jax coordinator/user ports stay open; kubectl
-    # exec does not traverse the pod network.
-    body = {
-        'apiVersion': 'networking.k8s.io/v1',
-        'kind': 'NetworkPolicy',
-        'metadata': {
-            'name': name,
-            'labels': {LABEL_CLUSTER: cluster},
-        },
-        'spec': {
-            'podSelector': {'matchLabels': {LABEL_CLUSTER: cluster}},
-            'policyTypes': ['Ingress'],
-            'ingress': [
-                {'from': [{'podSelector': {
-                    'matchLabels': {LABEL_CLUSTER: cluster}}}]},
-                {'ports': [
-                    {'protocol': 'TCP', 'port': 1,
-                     'endPort': agent_constants.WORKER_AGENT_PORT - 1},
-                    {'protocol': 'TCP',
-                     'port': agent_constants.WORKER_AGENT_PORT + 1,
-                     'endPort': 65535},
-                ]},
-            ],
-        },
-    }
-    try:
-        existing = client.list_network_policies(f'{LABEL_CLUSTER}={cluster}')
-        if any(p['metadata']['name'] == name for p in existing):
-            return
-        client.create_network_policy(body)
-    except k8s_lib.K8sApiError:
-        pass  # no NetworkPolicy support: token auth still enforces
-
-
-def _ns_of(provider_config: Optional[Dict[str, Any]]) -> Optional[str]:
-    if provider_config and provider_config.get('namespace'):
-        return provider_config['namespace']
-    return None  # _client falls back to SKYTPU_GKE_NAMESPACE
-
-
-def wait_instances(region: str, cluster_name_on_cloud: str, state: str,
-                   timeout: float = 600.0, poll: float = 3.0,
-                   provider_config: Optional[Dict[str, Any]] = None) -> None:
-    """Wait until every pod is Running. Unschedulable pods (no TPU node
-    pool capacity) surface as QuotaExceededError so the backend fails over
-    — the k8s analog of a TPU stockout."""
-    del region, state
-    client = _client(_ns_of(provider_config))
-    deadline = time.time() + timeout
-    while True:
-        pods = client.list_pods(f'{LABEL_CLUSTER}={cluster_name_on_cloud}')
-        phases = [p.get('status', {}).get('phase') for p in pods]
-        if pods and all(ph == 'Running' for ph in phases):
-            return
-        for pod in pods:
-            for cond in pod.get('status', {}).get('conditions', []):
-                if (cond.get('reason') == 'Unschedulable'
-                        and cond.get('status') == 'False'):
-                    # No TPU node pool can host this topology right now.
-                    # (With cluster autoscaling this can be transient; the
-                    # failover loop retries other candidates first, which
-                    # matches stockout semantics.)
-                    _cleanup(client, cluster_name_on_cloud)
-                    raise exceptions.QuotaExceededError(
-                        f'GKE: pod {pod["metadata"]["name"]} unschedulable: '
-                        f'{cond.get("message", "")}')
-        if time.time() > deadline:
-            _cleanup(client, cluster_name_on_cloud)
-            raise exceptions.QuotaExceededError(
-                f'GKE: pods not Running after {timeout:.0f}s '
-                f'(phases: {phases})')
-        time.sleep(poll)
-
-
-def _cleanup(client: k8s_lib.K8sClient, cluster_name_on_cloud: str) -> None:
-    for pod in client.list_pods(f'{LABEL_CLUSTER}={cluster_name_on_cloud}'):
-        try:
-            client.delete_pod(pod['metadata']['name'])
-        except k8s_lib.K8sApiError:
-            pass
-    try:
-        client.delete_network_policy(
-            _agent_policy_name(cluster_name_on_cloud))
-    except k8s_lib.K8sApiError:
-        pass
-
-
-def stop_instances(cluster_name_on_cloud: str,
-                   provider_config: Optional[Dict[str, Any]] = None) -> None:
-    raise exceptions.NotSupportedError(
-        'GKE pods cannot be stopped; use down (terminate) instead.')
-
-
-def terminate_instances(cluster_name_on_cloud: str,
-                        provider_config: Optional[Dict[str, Any]] = None
-                        ) -> None:
-    _cleanup(_client(_ns_of(provider_config)), cluster_name_on_cloud)
-
-
-_PHASE_MAP = {
-    'Pending': 'pending',
-    'Running': 'running',
-    'Succeeded': 'terminated',
-    'Failed': 'terminated',
-    'Unknown': None,
-}
-
-
-def query_instances(cluster_name_on_cloud: str,
-                    provider_config: Optional[Dict[str, Any]] = None
-                    ) -> Dict[str, Optional[str]]:
-    client = _client(_ns_of(provider_config))
-    out: Dict[str, Optional[str]] = {}
-    for pod in client.list_pods(f'{LABEL_CLUSTER}={cluster_name_on_cloud}'):
-        out[pod['metadata']['name']] = _PHASE_MAP.get(
-            pod.get('status', {}).get('phase', ''), None)
-    return out
+            'The GKE provider schedules TPU node pools; use the generic '
+            'kubernetes provider (or GCP) for CPU workloads.')
+    return k8s_instance.create_pods(config, _pod_body, 'gke',
+                                    workers_per_node=nc['hosts_per_slice'])
 
 
 def get_cluster_info(region: str, cluster_name_on_cloud: str,
                      provider_config: Optional[Dict[str, Any]] = None
                      ) -> common.ClusterInfo:
-    client = _client(_ns_of(provider_config))
-    instances: List[common.InstanceInfo] = []
-    for pod in client.list_pods(f'{LABEL_CLUSTER}={cluster_name_on_cloud}'):
-        if pod.get('status', {}).get('phase') != 'Running':
-            continue
-        meta = pod['metadata']
-        instances.append(common.InstanceInfo(
-            instance_id=meta['name'],
-            node_id=int(meta['labels'][LABEL_NODE]),
-            worker_id=int(meta['labels'][LABEL_WORKER]),
-            internal_ip=pod.get('status', {}).get('podIP', ''),
-            external_ip=pod.get('status', {}).get('podIP', ''),
-            status='running'))
-    head = _pod_name(cluster_name_on_cloud, 0, 0)
-    return common.ClusterInfo(
-        instances=instances,
-        head_instance_id=head if any(
-            i.instance_id == head for i in instances) else None,
-        provider_name='gke', region=region, zone=None,
-        ssh_user='root', ssh_key_path=None)
-
-
-def open_ports(cluster_name_on_cloud: str, ports: List[int],
-               provider_config: Optional[Dict[str, Any]] = None) -> None:
-    """Expose ports on the head pod via a k8s Service (reference analog:
-    ``sky/provision/kubernetes/network.py`` — per-cluster LoadBalancer /
-    NodePort services for opened ports). One Service per cluster carries
-    every requested port; ``SKYTPU_GKE_SERVICE_TYPE`` picks LoadBalancer
-    (default, external IP on GKE) or NodePort."""
-    if not ports:
-        return
-    client = _client(_ns_of(provider_config))
-    svc_name = f'{cluster_name_on_cloud}-svc'
-    svc_type = os.environ.get('SKYTPU_GKE_SERVICE_TYPE', 'LoadBalancer')
-    ports = sorted({int(p) for p in ports})
-    existing = next(
-        (svc for svc in client.list_services(
-            f'{LABEL_CLUSTER}={cluster_name_on_cloud}')
-         if svc['metadata']['name'] == svc_name), None)
-    if existing is not None:
-        old_ports = existing.get('spec', {}).get('ports', [])
-        have = {int(p['port']) for p in old_ports}
-        union = sorted(have | set(ports))
-        if union == sorted(have):
-            return  # idempotent: every requested port already exposed
-        # New ports requested (e.g. a serve update): PUT-replace the
-        # Service in place — existing ports (and their nodePort
-        # allocations / LB ingress) stay live throughout.
-        by_port = {int(p['port']): p for p in old_ports}
-        new_ports = []
-        for p in union:
-            entry = dict(by_port.get(p, {'name': f'port-{p}', 'port': p,
-                                         'targetPort': p}))
-            new_ports.append(entry)
-        body = dict(existing)
-        body['spec'] = dict(existing['spec'])
-        body['spec']['ports'] = new_ports
-        client.replace_service(svc_name, body)
-        return
-    client.create_service({
-        'apiVersion': 'v1',
-        'kind': 'Service',
-        'metadata': {
-            'name': svc_name,
-            'labels': {LABEL_CLUSTER: cluster_name_on_cloud},
-        },
-        'spec': {
-            'type': svc_type,
-            'selector': {
-                LABEL_CLUSTER: cluster_name_on_cloud,
-                LABEL_NODE: '0',
-                LABEL_WORKER: '0',
-            },
-            'ports': [{'name': f'port-{p}', 'port': int(p),
-                       'targetPort': int(p)} for p in ports],
-        },
-    })
-
-
-def cleanup_ports(cluster_name_on_cloud: str,
-                  provider_config: Optional[Dict[str, Any]] = None) -> None:
-    client = _client(_ns_of(provider_config))
-    for svc in client.list_services(
-            f'{LABEL_CLUSTER}={cluster_name_on_cloud}'):
-        try:
-            client.delete_service(svc['metadata']['name'])
-        except k8s_lib.K8sApiError:
-            pass
-
-
-def external_endpoint(cluster_name_on_cloud: str, port: int,
-                      provider_config: Optional[Dict[str, Any]] = None
-                      ) -> Optional[str]:
-    """'ip:port' of the cluster's Service, once GKE assigns the
-    LoadBalancer ingress (None while pending)."""
-    client = _client(_ns_of(provider_config))
-    for svc in client.list_services(
-            f'{LABEL_CLUSTER}={cluster_name_on_cloud}'):
-        ingress = (svc.get('status', {}).get('loadBalancer', {})
-                   .get('ingress') or [])
-        if ingress:
-            ip = ingress[0].get('ip') or ingress[0].get('hostname')
-            if ip:
-                return f'{ip}:{port}'
-    # NodePort services have no resolvable address without a node IP
-    # lookup; callers treat None as "not externally reachable yet".
-    return None
+    return k8s_instance.get_cluster_info(region, cluster_name_on_cloud,
+                                         provider_config,
+                                         provider_name='gke')

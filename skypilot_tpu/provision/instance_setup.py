@@ -107,7 +107,9 @@ def ensure_runtime_deps(runners: Sequence[CommandRunner],
                 f'Worker {idx}: agent runtime deps missing and pip '
                 f'install failed — use an image with '
                 f'{", ".join(AGENT_RUNTIME_DEPS)} preinstalled '
-                '(set `image_id:` on the task).')
+                '(set `image_id:` on the task). For air-gapped '
+                'clusters, build one from docker/Dockerfile.k8s-worker '
+                '(see docs/clouds.md).')
         if runner.run(probe) != 0:
             raise exceptions.ClusterNotUpError(
                 f'Worker {idx}: agent runtime deps still unimportable '

@@ -1,0 +1,1 @@
+"""Context-generic Kubernetes provisioner package (pods as nodes)."""

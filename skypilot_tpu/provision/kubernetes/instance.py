@@ -1,0 +1,405 @@
+"""Context-generic Kubernetes provisioner: pods as nodes, any kubeconfig.
+
+Reference analog: ``sky/provision/kubernetes/instance.py:1287``
+(``run_instances``) — the reference's kubernetes provider works against
+ANY cluster context (kind, on-prem, EKS, GKE); its GKE TPU support is a
+specialization layered on the same pods-as-nodes machinery. Mirrored
+here: this module owns the generic lifecycle — create-all-or-rollback
+pod creation, Running/Unschedulable waits, query/terminate, Services for
+opened ports, the agent NetworkPolicy — and builds plain CPU pods
+(cpu/memory requests) for any context. ``provision/gke/instance.py``
+reuses every lifecycle function and swaps in the TPU-node-pool pod body;
+that split keeps the GKE code honest about what is actually GKE-specific
+(node selectors + the ``google.com/tpu`` resource key, nothing else).
+
+Scheduling atom stays the pod; the kube-scheduler owns in-cluster
+placement. Pods sleep and are exec'd into by the kubectl command runner,
+and gang fan-out rides the per-pod agents' Exec RPC — identical to GKE.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.kubernetes import k8s_client as k8s_lib
+
+LABEL_CLUSTER = 'skytpu-cluster'
+LABEL_NODE = 'skytpu-node'
+LABEL_WORKER = 'skytpu-worker'
+
+# Pods must carry the framework runtime's python deps (grpcio, protobuf,
+# filelock, requests, yaml) for the on-pod agents — set `image_id:` to
+# your ML image. The slim default suffices only for exec-style workloads.
+DEFAULT_IMAGE = 'python:3.11-slim'
+
+_client_override: Optional[k8s_lib.K8sClient] = None
+
+
+def set_client_for_testing(client: Optional[k8s_lib.K8sClient]) -> None:
+    global _client_override
+    _client_override = client
+
+
+def default_namespace() -> str:
+    # SKYTPU_GKE_NAMESPACE kept as a fallback for existing deployments.
+    return (os.environ.get('SKYTPU_K8S_NAMESPACE')
+            or os.environ.get('SKYTPU_GKE_NAMESPACE') or 'default')
+
+
+def _client(namespace: Optional[str] = None,
+            context: Optional[str] = None) -> k8s_lib.K8sClient:
+    if _client_override is not None:
+        return _client_override
+    # Lifecycle ops (wait/query/terminate/info) must look in the SAME
+    # namespace run_instances created pods in; both default from the
+    # namespace env vars (the clouds' deploy vars use them too).
+    return k8s_lib.K8sClient(k8s_lib.transport_from_kubeconfig(context),
+                             namespace=namespace or default_namespace())
+
+
+def client_from_provider_config(
+        provider_config: Optional[Dict[str, Any]]) -> k8s_lib.K8sClient:
+    pc = provider_config or {}
+    return _client(pc.get('namespace'), pc.get('context'))
+
+
+def pod_name(cluster: str, node: int, worker: int) -> str:
+    return f'{cluster}-{node}-w{worker}'
+
+
+def _cpu_pod_body(config: common.ProvisionConfig, node: int, worker: int
+                  ) -> Dict[str, Any]:
+    """A plain compute pod: cpu/memory requests, no node selectors —
+    schedulable on any context (kind, on-prem, managed)."""
+    nc = config.node_config
+    resources: Dict[str, str] = {}
+    if nc.get('cpus'):
+        resources['cpu'] = str(nc['cpus'])
+    if nc.get('memory'):
+        resources['memory'] = f"{nc['memory']}Gi"
+    return {
+        'apiVersion': 'v1',
+        'kind': 'Pod',
+        'metadata': {
+            'name': pod_name(config.cluster_name_on_cloud, node, worker),
+            'labels': {
+                LABEL_CLUSTER: config.cluster_name_on_cloud,
+                LABEL_NODE: str(node),
+                LABEL_WORKER: str(worker),
+                **config.tags,
+            },
+        },
+        'spec': {
+            'restartPolicy': 'Never',
+            'containers': [{
+                'name': 'worker',
+                'image': nc.get('image_id') or DEFAULT_IMAGE,
+                'command': ['/bin/sh', '-c', 'sleep infinity'],
+                **({'resources': {'requests': resources,
+                                  'limits': dict(resources)}}
+                   if resources else {}),
+            }],
+        },
+    }
+
+
+def create_pods(config: common.ProvisionConfig,
+                pod_body_fn: Callable[[common.ProvisionConfig, int, int],
+                                      Dict[str, Any]],
+                provider_name: str,
+                workers_per_node: int = 1) -> common.ProvisionRecord:
+    """Shared create-all-or-rollback pod creation (atomic gang
+    semantics: a partial cluster is torn down, quota/capacity failures
+    surface as QuotaExceededError for the failover loop)."""
+    nc = config.node_config
+    client = _client(nc.get('namespace'), nc.get('context'))
+    existing = {p['metadata']['name']: p for p in client.list_pods(
+        f'{LABEL_CLUSTER}={config.cluster_name_on_cloud}')}
+    created: List[str] = []
+    try:
+        for node in range(config.num_nodes):
+            for worker in range(workers_per_node):
+                name = pod_name(config.cluster_name_on_cloud, node, worker)
+                if name in existing:
+                    continue
+                client.create_pod(pod_body_fn(config, node, worker))
+                created.append(name)
+    except k8s_lib.K8sApiError as e:
+        for name in created:  # atomic slice semantics
+            try:
+                client.delete_pod(name)
+            except k8s_lib.K8sApiError:
+                pass
+        low = str(e).lower()
+        if 'quota' in low or 'exceeded' in low or e.status_code == 403:
+            raise exceptions.QuotaExceededError(
+                f'{provider_name}: quota/capacity: {e}') from e
+        raise
+    ensure_agent_network_policy(client, config.cluster_name_on_cloud)
+    return common.ProvisionRecord(
+        provider_name=provider_name, region=config.region, zone=config.zone,
+        cluster_name_on_cloud=config.cluster_name_on_cloud,
+        head_instance_id=pod_name(config.cluster_name_on_cloud, 0, 0),
+        created_instance_ids=created, resumed_instance_ids=[])
+
+
+def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    if config.node_config.get('tpu_vm', False):
+        raise exceptions.NotSupportedError(
+            'The generic kubernetes provider schedules CPU pods; TPU node '
+            'pools are the GKE specialization (cloud: gke).')
+    return create_pods(config, _cpu_pod_body, 'kubernetes')
+
+
+def _agent_policy_name(cluster: str) -> str:
+    return f'{cluster}-agent-policy'
+
+
+def ensure_agent_network_policy(client: k8s_lib.K8sClient,
+                                cluster: str) -> None:
+    """Restrict the worker-agent port to the cluster's own pods.
+
+    Defense-in-depth beside the shared-token auth: the agents' streaming
+    Exec RPC is arbitrary command execution, so ingress on
+    WORKER_AGENT_PORT is limited to pods carrying this cluster's label —
+    any other pod in the namespace (or cluster, absent a permissive CNI)
+    is dropped at the network layer. Best-effort: clusters without a
+    NetworkPolicy controller still get the token check."""
+    from skypilot_tpu.agent import constants as agent_constants
+    name = _agent_policy_name(cluster)
+    # NetworkPolicy cannot express "deny just this port", and ingress
+    # rules are OR'd — so the construction is: same-cluster pods may
+    # reach everything, while all other peers may reach every port
+    # EXCEPT the agent port (expressed as the two endPort ranges around
+    # it, k8s >=1.25). jax coordinator/user ports stay open; kubectl
+    # exec does not traverse the pod network.
+    body = {
+        'apiVersion': 'networking.k8s.io/v1',
+        'kind': 'NetworkPolicy',
+        'metadata': {
+            'name': name,
+            'labels': {LABEL_CLUSTER: cluster},
+        },
+        'spec': {
+            'podSelector': {'matchLabels': {LABEL_CLUSTER: cluster}},
+            'policyTypes': ['Ingress'],
+            'ingress': [
+                {'from': [{'podSelector': {
+                    'matchLabels': {LABEL_CLUSTER: cluster}}}]},
+                {'ports': [
+                    {'protocol': 'TCP', 'port': 1,
+                     'endPort': agent_constants.WORKER_AGENT_PORT - 1},
+                    {'protocol': 'TCP',
+                     'port': agent_constants.WORKER_AGENT_PORT + 1,
+                     'endPort': 65535},
+                ]},
+            ],
+        },
+    }
+    try:
+        existing = client.list_network_policies(f'{LABEL_CLUSTER}={cluster}')
+        if any(p['metadata']['name'] == name for p in existing):
+            return
+        client.create_network_policy(body)
+    except k8s_lib.K8sApiError:
+        pass  # no NetworkPolicy support: token auth still enforces
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str, state: str,
+                   timeout: float = 600.0, poll: float = 3.0,
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
+    """Wait until every pod is Running. Unschedulable pods (no capacity
+    for the resource requests / node selectors) surface as
+    QuotaExceededError so the backend fails over — the k8s analog of a
+    stockout."""
+    del region, state
+    client = client_from_provider_config(provider_config)
+    deadline = time.time() + timeout
+    while True:
+        pods = client.list_pods(f'{LABEL_CLUSTER}={cluster_name_on_cloud}')
+        phases = [p.get('status', {}).get('phase') for p in pods]
+        if pods and all(ph == 'Running' for ph in phases):
+            return
+        for pod in pods:
+            for cond in pod.get('status', {}).get('conditions', []):
+                if (cond.get('reason') == 'Unschedulable'
+                        and cond.get('status') == 'False'):
+                    # No node can host this pod right now. (With cluster
+                    # autoscaling this can be transient; the failover
+                    # loop retries other candidates first, which matches
+                    # stockout semantics.)
+                    _cleanup(client, cluster_name_on_cloud)
+                    raise exceptions.QuotaExceededError(
+                        f'kubernetes: pod {pod["metadata"]["name"]} '
+                        f'unschedulable: {cond.get("message", "")}')
+        if time.time() > deadline:
+            _cleanup(client, cluster_name_on_cloud)
+            raise exceptions.QuotaExceededError(
+                f'kubernetes: pods not Running after {timeout:.0f}s '
+                f'(phases: {phases})')
+        time.sleep(poll)
+
+
+def _cleanup(client: k8s_lib.K8sClient, cluster_name_on_cloud: str) -> None:
+    for pod in client.list_pods(f'{LABEL_CLUSTER}={cluster_name_on_cloud}'):
+        try:
+            client.delete_pod(pod['metadata']['name'])
+        except k8s_lib.K8sApiError:
+            pass
+    try:
+        client.delete_network_policy(
+            _agent_policy_name(cluster_name_on_cloud))
+    except k8s_lib.K8sApiError:
+        pass
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
+    raise exceptions.NotSupportedError(
+        'Kubernetes pods cannot be stopped; use down (terminate) instead.')
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None
+                        ) -> None:
+    _cleanup(client_from_provider_config(provider_config),
+             cluster_name_on_cloud)
+
+
+_PHASE_MAP = {
+    'Pending': 'pending',
+    'Running': 'running',
+    'Succeeded': 'terminated',
+    'Failed': 'terminated',
+    'Unknown': None,
+}
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Optional[str]]:
+    client = client_from_provider_config(provider_config)
+    out: Dict[str, Optional[str]] = {}
+    for pod in client.list_pods(f'{LABEL_CLUSTER}={cluster_name_on_cloud}'):
+        out[pod['metadata']['name']] = _PHASE_MAP.get(
+            pod.get('status', {}).get('phase', ''), None)
+    return out
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None,
+                     provider_name: str = 'kubernetes'
+                     ) -> common.ClusterInfo:
+    client = client_from_provider_config(provider_config)
+    instances: List[common.InstanceInfo] = []
+    for pod in client.list_pods(f'{LABEL_CLUSTER}={cluster_name_on_cloud}'):
+        if pod.get('status', {}).get('phase') != 'Running':
+            continue
+        meta = pod['metadata']
+        instances.append(common.InstanceInfo(
+            instance_id=meta['name'],
+            node_id=int(meta['labels'][LABEL_NODE]),
+            worker_id=int(meta['labels'][LABEL_WORKER]),
+            internal_ip=pod.get('status', {}).get('podIP', ''),
+            external_ip=pod.get('status', {}).get('podIP', ''),
+            status='running'))
+    head = pod_name(cluster_name_on_cloud, 0, 0)
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=head if any(
+            i.instance_id == head for i in instances) else None,
+        provider_name=provider_name, region=region, zone=None,
+        ssh_user='root', ssh_key_path=None)
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[int],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    """Expose ports on the head pod via a k8s Service (reference analog:
+    ``sky/provision/kubernetes/network.py`` — per-cluster LoadBalancer /
+    NodePort services for opened ports). One Service per cluster carries
+    every requested port; ``SKYTPU_K8S_SERVICE_TYPE`` (or the legacy
+    ``SKYTPU_GKE_SERVICE_TYPE``) picks LoadBalancer (default) or
+    NodePort."""
+    if not ports:
+        return
+    client = client_from_provider_config(provider_config)
+    svc_name = f'{cluster_name_on_cloud}-svc'
+    svc_type = (os.environ.get('SKYTPU_K8S_SERVICE_TYPE')
+                or os.environ.get('SKYTPU_GKE_SERVICE_TYPE')
+                or 'LoadBalancer')
+    ports = sorted({int(p) for p in ports})
+    existing = next(
+        (svc for svc in client.list_services(
+            f'{LABEL_CLUSTER}={cluster_name_on_cloud}')
+         if svc['metadata']['name'] == svc_name), None)
+    if existing is not None:
+        old_ports = existing.get('spec', {}).get('ports', [])
+        have = {int(p['port']) for p in old_ports}
+        union = sorted(have | set(ports))
+        if union == sorted(have):
+            return  # idempotent: every requested port already exposed
+        # New ports requested (e.g. a serve update): PUT-replace the
+        # Service in place — existing ports (and their nodePort
+        # allocations / LB ingress) stay live throughout.
+        by_port = {int(p['port']): p for p in old_ports}
+        new_ports = []
+        for p in union:
+            entry = dict(by_port.get(p, {'name': f'port-{p}', 'port': p,
+                                         'targetPort': p}))
+            new_ports.append(entry)
+        body = dict(existing)
+        body['spec'] = dict(existing['spec'])
+        body['spec']['ports'] = new_ports
+        client.replace_service(svc_name, body)
+        return
+    client.create_service({
+        'apiVersion': 'v1',
+        'kind': 'Service',
+        'metadata': {
+            'name': svc_name,
+            'labels': {LABEL_CLUSTER: cluster_name_on_cloud},
+        },
+        'spec': {
+            'type': svc_type,
+            'selector': {
+                LABEL_CLUSTER: cluster_name_on_cloud,
+                LABEL_NODE: '0',
+                LABEL_WORKER: '0',
+            },
+            'ports': [{'name': f'port-{p}', 'port': int(p),
+                       'targetPort': int(p)} for p in ports],
+        },
+    })
+
+
+def cleanup_ports(cluster_name_on_cloud: str,
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    client = client_from_provider_config(provider_config)
+    for svc in client.list_services(
+            f'{LABEL_CLUSTER}={cluster_name_on_cloud}'):
+        try:
+            client.delete_service(svc['metadata']['name'])
+        except k8s_lib.K8sApiError:
+            pass
+
+
+def external_endpoint(cluster_name_on_cloud: str, port: int,
+                      provider_config: Optional[Dict[str, Any]] = None
+                      ) -> Optional[str]:
+    """'ip:port' of the cluster's Service, once the platform assigns the
+    LoadBalancer ingress (None while pending)."""
+    client = client_from_provider_config(provider_config)
+    for svc in client.list_services(
+            f'{LABEL_CLUSTER}={cluster_name_on_cloud}'):
+        ingress = (svc.get('status', {}).get('loadBalancer', {})
+                   .get('ingress') or [])
+        if ingress:
+            ip = ingress[0].get('ip') or ingress[0].get('hostname')
+            if ip:
+                return f'{ip}:{port}'
+    # NodePort services have no resolvable address without a node IP
+    # lookup; callers treat None as "not externally reachable yet".
+    return None

@@ -1,4 +1,4 @@
-"""Minimal Kubernetes API client for GKE TPU node pools.
+"""Minimal Kubernetes API client (any kubeconfig context).
 
 Reference analog: ``sky/provision/kubernetes/`` drives the cluster through
 the official kubernetes SDK; here it is the same injectable-transport
@@ -34,13 +34,19 @@ class K8sApiError(exceptions.SkyTpuError):
 
 
 class K8sTransport:
-    """HTTP transport to one cluster; replaced by a fake in tests."""
+    """HTTP transport to one cluster; replaced by a fake in tests.
+
+    Auth: bearer token (GKE/EKS-style) OR mTLS client certificate
+    (kind and kubeadm clusters write ``client-certificate-data`` /
+    ``client-key-data`` — no token at all)."""
 
     def __init__(self, server: str, token: Optional[str] = None,
-                 ca_cert_file: Optional[str] = None):
+                 ca_cert_file: Optional[str] = None,
+                 client_cert_files: Optional[tuple] = None):
         self.server = server.rstrip('/')
         self.token = token
         self.ca_cert_file = ca_cert_file
+        self.client_cert_files = client_cert_files  # (cert_path, key_path)
 
     def request(self, method: str, path: str,
                 body: Optional[Dict[str, Any]] = None,
@@ -51,6 +57,7 @@ class K8sTransport:
         resp = requests.request(
             method, self.server + path, headers=headers, json=body,
             params=params, timeout=60,
+            cert=self.client_cert_files,
             # No explicit CA in the kubeconfig => system trust store
             # (never disable verification).
             verify=self.ca_cert_file if self.ca_cert_file else True)
@@ -64,6 +71,13 @@ def _load_kubeconfig() -> Dict[str, Any]:
                           os.path.expanduser('~/.kube/config'))
     with open(os.path.expanduser(path), encoding='utf-8') as f:
         return yaml.safe_load(f)
+
+
+def list_contexts() -> List[str]:
+    """Context names in the active kubeconfig (the generic kubernetes
+    cloud models each as a region)."""
+    cfg = _load_kubeconfig()
+    return [c['name'] for c in (cfg or {}).get('contexts', []) or []]
 
 
 def transport_from_kubeconfig(context: Optional[str] = None) -> K8sTransport:
@@ -84,12 +98,29 @@ def transport_from_kubeconfig(context: Optional[str] = None) -> K8sTransport:
         if out.returncode == 0:
             cred = json.loads(out.stdout)
             token = cred.get('status', {}).get('token')
-    ca_file = cluster.get('certificate-authority')
-    if ca_file is None and 'certificate-authority-data' in cluster:
-        fd, ca_file = tempfile.mkstemp(suffix='.crt')
-        with os.fdopen(fd, 'wb') as f:
-            f.write(base64.b64decode(cluster['certificate-authority-data']))
-    return K8sTransport(cluster['server'], token=token, ca_cert_file=ca_file)
+
+    def _materialize(path_key: str, data_key: str, entry: Dict[str, Any],
+                     suffix: str) -> Optional[str]:
+        """Inline ...-data fields become temp files (requests wants
+        paths); explicit file paths pass through."""
+        if entry.get(path_key):
+            return entry[path_key]
+        if data_key in entry:
+            fd, path = tempfile.mkstemp(suffix=suffix)
+            with os.fdopen(fd, 'wb') as f:
+                f.write(base64.b64decode(entry[data_key]))
+            return path
+        return None
+
+    ca_file = _materialize('certificate-authority',
+                           'certificate-authority-data', cluster, '.crt')
+    # mTLS client-cert auth: what kind/kubeadm write instead of a token.
+    cert = _materialize('client-certificate', 'client-certificate-data',
+                        user, '.crt')
+    key = _materialize('client-key', 'client-key-data', user, '.key')
+    client_cert = (cert, key) if cert and key else None
+    return K8sTransport(cluster['server'], token=token, ca_cert_file=ca_file,
+                        client_cert_files=client_cert)
 
 
 class K8sClient:

@@ -70,7 +70,7 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
 
 
 def wait_instances(region: str, cluster_name_on_cloud: str,
-                   state: str) -> None:
+                   state: str, provider_config=None) -> None:
     del region, state
     if _load(cluster_name_on_cloud) is None:
         raise exceptions.ClusterDoesNotExist(cluster_name_on_cloud)

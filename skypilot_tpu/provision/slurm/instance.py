@@ -240,7 +240,7 @@ def _job_state(runner: CommandRunner, job_id: str) -> str:
 
 
 def wait_instances(region: str, cluster_name_on_cloud: str,
-                   state: str) -> None:
+                   state: str, provider_config=None) -> None:
     del region, state  # run_instances waits for RUNNING synchronously
     with _allocs_lock():
         known = cluster_name_on_cloud in _read_allocs()

@@ -125,7 +125,7 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
 
 
 def wait_instances(region: str, cluster_name_on_cloud: str,
-                   state: str) -> None:
+                   state: str, provider_config=None) -> None:
     del region, cluster_name_on_cloud, state  # hosts already exist
 
 

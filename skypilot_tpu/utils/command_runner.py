@@ -44,14 +44,21 @@ class RunnerSpec:
     ssh_key: Optional[str] = None
     port: int = 22  # ssh port; for grpc: the worker agent's port
     namespace: str = 'default'  # k8s only
+    context: Optional[str] = None  # k8s only: kubeconfig context
     token_file: Optional[str] = None  # grpc only: shared agent auth token
 
     def to_dict(self) -> Dict[str, Any]:
-        return dataclasses.asdict(self)
+        # Omit None-valued optional fields: the dict crosses the wire to
+        # the head-side driver, whose synced runtime may predate a newly
+        # added field — absent keys deserialize anywhere, unknown keys
+        # only on runtimes with the tolerant from_dict below.
+        return {k: v for k, v in dataclasses.asdict(self).items()
+                if v is not None}
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> 'RunnerSpec':
-        return cls(**d)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
 
     def make(self) -> 'CommandRunner':
         if self.kind == 'local':
@@ -60,7 +67,8 @@ class RunnerSpec:
             return SSHCommandRunner(self.ip, self.user or 'skytpu',
                                     self.ssh_key, self.port)
         if self.kind == 'k8s':
-            return KubectlCommandRunner(self.ip, self.namespace)
+            return KubectlCommandRunner(self.ip, self.namespace,
+                                        context=self.context)
         if self.kind == 'grpc':
             return GrpcCommandRunner(self.ip, self.port,
                                      token_file=self.token_file)
@@ -278,17 +286,22 @@ class GrpcCommandRunner(CommandRunner):
 
 
 class KubectlCommandRunner(CommandRunner):
-    """Exec into a GKE pod (reference: ``KubernetesCommandRunner :938``,
-    which shells through kubectl exec the same way)."""
+    """Exec into a k8s pod (reference: ``KubernetesCommandRunner :938``,
+    which shells through kubectl exec the same way). ``context`` targets
+    a non-current kubeconfig context (the generic kubernetes cloud's
+    region IS the context name)."""
 
-    def __init__(self, pod_name: str, namespace: str = 'default'):
+    def __init__(self, pod_name: str, namespace: str = 'default',
+                 context: Optional[str] = None):
         self.ip = pod_name  # `.ip` is the uniform "address" attr
         self.pod_name = pod_name
         self.namespace = namespace
+        self.context = context
 
     def _kubectl_base(self) -> List[str]:
-        return ['kubectl', 'exec', '-i', '-n', self.namespace, self.pod_name,
-                '--']
+        ctx = ['--context', self.context] if self.context else []
+        return (['kubectl'] + ctx +
+                ['exec', '-i', '-n', self.namespace, self.pod_name, '--'])
 
     def popen_argv(self, cmd, env=None, cwd=None):
         inner = _env_prefix(env) + cmd

@@ -67,12 +67,40 @@ _DDL_REWRITES = (
      'BIGSERIAL PRIMARY KEY'),
     (re.compile(r'\bREAL\b', re.I), 'DOUBLE PRECISION'),
     (re.compile(r'\bBLOB\b', re.I), 'BYTEA'),
+    # sqlite upsert shorthand -> standard upsert is not derivable from
+    # the statement text alone (needs the conflict target); call sites
+    # in db_utils-backed modules must write ON CONFLICT explicitly.
 )
+
+# sqlite-only constructs with NO mechanical Postgres rewrite: refuse at
+# execute time instead of shipping broken SQL to the server (r3 verdict
+# Next #5: "fail loudly on untranslatable statements"). Checked OUTSIDE
+# string literals.
+_UNTRANSLATABLE = (
+    re.compile(r'\bINSERT\s+OR\s+(REPLACE|IGNORE|ROLLBACK|ABORT|FAIL)\b',
+               re.I),
+    re.compile(r'\bPRAGMA\b', re.I),
+    re.compile(r'\bAUTOINCREMENT\b', re.I),  # any form the rewrite missed
+    re.compile(r'\bGLOB\b', re.I),
+    re.compile(r'\b(datetime|julianday|strftime)\s*\(', re.I),
+)
+
+
+def _strip_string_literals(sql: str) -> str:
+    return re.sub(r"'[^']*'", "''", sql)
 
 
 def _to_pg_sql(sql: str) -> str:
     for pat, repl in _DDL_REWRITES:
         sql = pat.sub(repl, sql)
+    bare = _strip_string_literals(sql)
+    for pat in _UNTRANSLATABLE:
+        m = pat.search(bare)
+        if m:
+            raise OperationalError(
+                f'sqlite construct {m.group(0)!r} has no Postgres '
+                f'translation; rewrite the statement portably '
+                f'(e.g. INSERT ... ON CONFLICT): {sql[:200]}')
     # '?' -> '%s' outside quoted strings.
     out, in_str = [], False
     for ch in sql:

@@ -11,7 +11,7 @@ import pytest
 from skypilot_tpu import exceptions
 from skypilot_tpu.provision import common
 from skypilot_tpu.provision.gke import instance as gke_instance
-from skypilot_tpu.provision.gke import k8s_client
+from skypilot_tpu.provision.kubernetes import k8s_client
 
 
 class FakeK8sApi:

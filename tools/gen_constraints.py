@@ -1,0 +1,29 @@
+"""Regenerate constraints-ci.txt from the versions installed here.
+
+The CI workflow installs exactly these pins so a runner's `pip install`
+resolves to the same stack the suite was developed and verified against.
+"""
+import importlib.metadata as md
+
+PACKAGES = ('jax', 'jaxlib', 'flax', 'optax', 'orbax-checkpoint', 'chex',
+            'einops', 'numpy', 'pytest', 'requests', 'PyYAML', 'aiohttp',
+            'grpcio', 'protobuf', 'filelock', 'pandas', 'click', 'psutil')
+
+HEADER = """\
+# CI dependency pins, generated from the working dev-sandbox versions
+# (r3 verdict Next #8: an unpinned `pip install jax` WILL break the
+# workflow the day jax bumps a major). Regenerate with:
+#   python tools/gen_constraints.py > constraints-ci.txt"""
+
+
+def main() -> None:
+    print(HEADER)
+    for pkg in PACKAGES:
+        try:
+            print(f'{pkg}=={md.version(pkg)}')
+        except md.PackageNotFoundError:
+            print(f'# {pkg}: not installed here')
+
+
+if __name__ == '__main__':
+    main()

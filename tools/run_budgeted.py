@@ -1,0 +1,35 @@
+"""Run a command and FAIL if it exceeds a wall-clock budget.
+
+`make test` wraps the full suite with a 30-minute budget (r3 verdict
+weak #7: the tier was untimed and drifting up). The command is not
+killed mid-run — it completes and the budget is asserted afterwards, so
+a slow regression fails loudly with the measured duration instead of a
+truncated run. Hangs are caught by the CI job's outer timeout.
+
+Usage: python tools/run_budgeted.py <budget_seconds> <cmd> [args...]
+"""
+import subprocess
+import sys
+import time
+
+
+def main() -> int:
+    budget = float(sys.argv[1])
+    cmd = sys.argv[2:]
+    t0 = time.monotonic()
+    rc = subprocess.call(cmd)
+    dur = time.monotonic() - t0
+    if rc != 0:
+        return rc
+    if dur > budget:
+        print(f'run_budgeted: FAIL — command took {dur:.0f}s, '
+              f'budget is {budget:.0f}s. The suite has regressed past '
+              'its duration budget; move slow modules to the load tier '
+              'or speed them up.', file=sys.stderr)
+        return 1
+    print(f'run_budgeted: OK — {dur:.0f}s of {budget:.0f}s budget')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
