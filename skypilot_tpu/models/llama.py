@@ -78,9 +78,15 @@ TINY = LlamaConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
                    n_kv_heads=2, d_ff=128, head_dim=16, max_seq_len=512)
 # Mixtral-shaped MoE variant of TINY for ep tests/dryruns.
 MOE_TINY = dataclasses.replace(TINY, num_experts=4, expert_top_k=2)
+# Mixtral-shaped recipe model: 8 experts top-2 over the BENCH_1B trunk —
+# active params per token stay ~BENCH_1B-sized while total params carry
+# 8x the MLP weight. Sized for a v5e-16 slice with expert parallelism
+# (examples/llm/moe-finetune/).
+MOE_8X1B = dataclasses.replace(BENCH_1B, num_experts=8, expert_top_k=2)
 
 PRESETS = {'llama3-8b': LLAMA3_8B, 'llama3-1b': LLAMA3_1B,
-           'bench-1b': BENCH_1B, 'tiny': TINY, 'moe-tiny': MOE_TINY}
+           'bench-1b': BENCH_1B, 'tiny': TINY, 'moe-tiny': MOE_TINY,
+           'moe-8x1b': MOE_8X1B}
 
 
 # -- params -----------------------------------------------------------------

@@ -98,8 +98,10 @@ def _cpu_pod_body(config: common.ProvisionConfig, node: int, worker: int
                 'name': 'worker',
                 'image': nc.get('image_id') or DEFAULT_IMAGE,
                 'command': ['/bin/sh', '-c', 'sleep infinity'],
-                **({'resources': {'requests': resources,
-                                  'limits': dict(resources)}}
+                # Requests only, no limits: 'cpus: 8+' means AT LEAST 8 —
+                # a limit would turn the user's floor into an OOM/throttle
+                # ceiling. The kube-scheduler places on requests.
+                **({'resources': {'requests': resources}}
                    if resources else {}),
             }],
         },

@@ -80,6 +80,34 @@ def list_contexts() -> List[str]:
     return [c['name'] for c in (cfg or {}).get('contexts', []) or []]
 
 
+# content-hash -> materialized temp path. Transports are rebuilt per
+# lifecycle call (status polls!), so uncached mkstemp would leak a new
+# .crt/.key file per call until /tmp fills — and keep re-writing private
+# key material. One file per distinct payload for the process lifetime.
+_materialized_cache: Dict[str, str] = {}
+
+
+def _materialize(path_key: str, data_key: str, entry: Dict[str, Any],
+                 suffix: str) -> Optional[str]:
+    """Inline ``...-data`` fields become temp files (requests wants
+    paths); explicit file paths pass through."""
+    import hashlib
+    if entry.get(path_key):
+        return entry[path_key]
+    if data_key not in entry:
+        return None
+    raw = base64.b64decode(entry[data_key])
+    key = hashlib.sha256(raw).hexdigest() + suffix
+    path = _materialized_cache.get(key)
+    if path and os.path.exists(path):
+        return path
+    fd, path = tempfile.mkstemp(suffix=suffix)
+    with os.fdopen(fd, 'wb') as f:
+        f.write(raw)
+    _materialized_cache[key] = path
+    return path
+
+
 def transport_from_kubeconfig(context: Optional[str] = None) -> K8sTransport:
     """Build a transport from the active (or named) kubeconfig context."""
     cfg = _load_kubeconfig()
@@ -98,19 +126,6 @@ def transport_from_kubeconfig(context: Optional[str] = None) -> K8sTransport:
         if out.returncode == 0:
             cred = json.loads(out.stdout)
             token = cred.get('status', {}).get('token')
-
-    def _materialize(path_key: str, data_key: str, entry: Dict[str, Any],
-                     suffix: str) -> Optional[str]:
-        """Inline ...-data fields become temp files (requests wants
-        paths); explicit file paths pass through."""
-        if entry.get(path_key):
-            return entry[path_key]
-        if data_key in entry:
-            fd, path = tempfile.mkstemp(suffix=suffix)
-            with os.fdopen(fd, 'wb') as f:
-                f.write(base64.b64decode(entry[data_key]))
-            return path
-        return None
 
     ca_file = _materialize('certificate-authority',
                            'certificate-authority-data', cluster, '.crt')

@@ -234,6 +234,10 @@ class LlmServer:
 
 
 def main() -> None:
+    # Honor JAX_PLATFORMS before first device use (pinned-TPU runtimes
+    # latch the platform at import; same dance as train/run.py).
+    from skypilot_tpu.utils.jax_env import apply_jax_platform_env
+    apply_jax_platform_env()
     parser = argparse.ArgumentParser()
     parser.add_argument('--model', default='tiny')
     parser.add_argument('--max-len', type=int, default=1024)

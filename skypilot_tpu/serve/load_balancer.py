@@ -60,8 +60,15 @@ class LoadBalancer:
                                  if k.lower() not in ('host',)},
                         timeout=aiohttp.ClientTimeout(total=300)) as resp:
                     payload = await resp.read()
+                    # Preserve the upstream Content-Type: clients parse
+                    # JSON by it, and a bare web.Response defaults to
+                    # text/plain (hop-by-hop headers stay stripped).
+                    out_headers = {'X-Served-By': replica}
+                    if 'Content-Type' in resp.headers:
+                        out_headers['Content-Type'] = \
+                            resp.headers['Content-Type']
                     return web.Response(status=resp.status, body=payload,
-                                        headers={'X-Served-By': replica})
+                                        headers=out_headers)
         except aiohttp.ClientError as e:
             return web.json_response(
                 {'error': f'replica {replica} failed: {e}'}, status=502)

@@ -1,0 +1,98 @@
+"""Token-throughput load generator for the LLM serving recipes.
+
+The measurement half of the JetStream-analog recipe
+(``examples/llm/serve-llama/``): fires concurrent ``/generate`` requests
+at a serve endpoint (replica or load balancer) and reports decode
+throughput — the metric the reference quotes for its v6e serving recipe
+(``examples/tpu/v6e/README.md:112-118``, 2500 tok/s input throughput).
+
+Prints ONE JSON line:
+  {"requests": N, "ok": N, "wall_s": S, "new_tokens": T,
+   "decode_tokens_per_sec": T/S, "p50_latency_s": ..., "p95_latency_s": ...}
+
+Run: ``python -m skypilot_tpu.serve.loadgen --url http://HOST:PORT``
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+import time
+
+
+async def _one(session, url: str, prompt_len: int, max_new: int,
+               vocab: int, seed: int):
+    rng = random.Random(seed)
+    tokens = [rng.randrange(1, vocab) for _ in range(prompt_len)]
+    t0 = time.perf_counter()
+    try:
+        async with session.post(
+                f'{url}/generate',
+                json={'tokens': [tokens], 'max_new_tokens': max_new},
+                timeout=__import__('aiohttp').ClientTimeout(total=600)) as r:
+            # content-type agnostic: some proxies in the path may not
+            # preserve application/json.
+            body = json.loads(await r.text())
+            ok = r.status == 200 and 'tokens' in body
+            # /generate returns ONLY the generated continuation rows.
+            new = len(body['tokens'][0]) if ok else 0
+    except Exception:  # noqa: BLE001 — a failed request is a data point
+        ok, new = False, 0
+    return ok, new, time.perf_counter() - t0
+
+
+async def run_load(url: str, requests_total: int, concurrency: int,
+                   prompt_len: int, max_new: int, vocab: int) -> dict:
+    import aiohttp
+    sem = asyncio.Semaphore(concurrency)
+    results = []
+
+    async with aiohttp.ClientSession() as session:
+        async def _bounded(i):
+            async with sem:
+                results.append(await _one(session, url, prompt_len,
+                                          max_new, vocab, seed=i))
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(_bounded(i) for i in range(requests_total)))
+        wall = time.perf_counter() - t0
+
+    oks = [r for r in results if r[0]]
+    lats = sorted(r[2] for r in results)
+    new_tokens = sum(r[1] for r in oks)
+    return {
+        'requests': requests_total,
+        'ok': len(oks),
+        'concurrency': concurrency,
+        'prompt_len': prompt_len,
+        'max_new_tokens': max_new,
+        'wall_s': round(wall, 3),
+        'new_tokens': new_tokens,
+        'decode_tokens_per_sec': round(new_tokens / wall, 1) if wall else 0,
+        'p50_latency_s': round(lats[len(lats) // 2], 3) if lats else None,
+        'p95_latency_s': round(lats[int(len(lats) * 0.95)], 3)
+        if lats else None,
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--url', required=True,
+                        help='serve endpoint, e.g. http://host:9000')
+    parser.add_argument('--requests', type=int, default=64)
+    parser.add_argument('--concurrency', type=int, default=16)
+    parser.add_argument('--prompt-len', type=int, default=128)
+    parser.add_argument('--max-new-tokens', type=int, default=64)
+    parser.add_argument('--vocab', type=int, default=256,
+                        help='token id range for synthetic prompts (match '
+                             'the served model vocab)')
+    args = parser.parse_args()
+    out = asyncio.run(run_load(args.url.rstrip('/'), args.requests,
+                               args.concurrency, args.prompt_len,
+                               args.max_new_tokens, args.vocab))
+    print(json.dumps(out))
+
+
+if __name__ == '__main__':
+    main()

@@ -36,10 +36,25 @@ def main() -> None:
     parser.add_argument('--step-time-floor', type=float, default=0.0,
                         help='min seconds per step (tests use it to make '
                              'preemption windows deterministic)')
+    parser.add_argument('--mesh', default=None,
+                        help='logical mesh axes, e.g. "data=2,fsdp=-1,'
+                             'tensor=4" (parallel/mesh.py MeshSpec; '
+                             'single-device when unset)')
+    parser.add_argument('--num-slices', type=int, default=None,
+                        help='TPU slices in the hybrid ICI/DCN mesh; '
+                             'defaults to MEGASCALE_NUM_SLICES (set by '
+                             'the gang driver on multislice clusters), '
+                             'else 1')
+    parser.add_argument('--remat-policy', default='full',
+                        help='remat policy (models/llama.py '
+                             'REMAT_POLICIES); "dots" is the v5e bench '
+                             'default where memory allows')
     args = parser.parse_args()
 
     from skypilot_tpu.utils.jax_env import apply_jax_platform_env
     apply_jax_platform_env()
+
+    import os
 
     import jax
     import jax.numpy as jnp
@@ -51,8 +66,23 @@ def main() -> None:
     cfg = TrainerConfig(model=llama.PRESETS[args.model],
                         global_batch_size=args.global_batch_size,
                         seq_len=args.seq_len, optimizer=args.optimizer,
-                        remat=True)
-    trainer = Trainer(cfg)
+                        remat=True, remat_policy=args.remat_policy)
+
+    mesh = None
+    num_slices = args.num_slices
+    if num_slices is None:
+        num_slices = int(os.environ.get('MEGASCALE_NUM_SLICES', '1'))
+    if args.mesh or num_slices > 1:
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        axes = {}
+        for part in (args.mesh or 'fsdp=-1').split(','):
+            k, v = part.split('=')
+            axes[k.strip()] = int(v)
+        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(**axes),
+                                   num_slices=num_slices)
+        print(f'[train] mesh {dict(zip(mesh.axis_names, mesh.devices.shape))}'
+              f' over {num_slices} slice(s)', flush=True)
+    trainer = Trainer(cfg, mesh=mesh) if mesh is not None else Trainer(cfg)
     state = trainer.init_state(seed=0)
 
     mgr = None

@@ -90,9 +90,25 @@ def _strip_string_literals(sql: str) -> str:
     return re.sub(r"'[^']*'", "''", sql)
 
 
-def _to_pg_sql(sql: str) -> str:
+def _map_outside_literals(sql: str, fn) -> str:
+    """Apply ``fn`` to every segment of ``sql`` OUTSIDE single-quoted
+    string literals (literals are data — 'REAL' in a VALUES clause must
+    not become 'DOUBLE PRECISION')."""
+    parts = re.split(r"('[^']*')", sql)
+    return ''.join(p if p.startswith("'") else fn(p) for p in parts)
+
+
+def _ddl_rewrite_segment(seg: str) -> str:
     for pat, repl in _DDL_REWRITES:
-        sql = pat.sub(repl, sql)
+        seg = pat.sub(repl, seg)
+    return seg
+
+
+def _to_pg_sql(sql: str) -> str:
+    # DDL rewrites first (outside literals): they legitimately consume
+    # INTEGER PRIMARY KEY AUTOINCREMENT; only what SURVIVES them is an
+    # untranslatable leftover.
+    sql = _map_outside_literals(sql, _ddl_rewrite_segment)
     bare = _strip_string_literals(sql)
     for pat in _UNTRANSLATABLE:
         m = pat.search(bare)
@@ -101,17 +117,7 @@ def _to_pg_sql(sql: str) -> str:
                 f'sqlite construct {m.group(0)!r} has no Postgres '
                 f'translation; rewrite the statement portably '
                 f'(e.g. INSERT ... ON CONFLICT): {sql[:200]}')
-    # '?' -> '%s' outside quoted strings.
-    out, in_str = [], False
-    for ch in sql:
-        if ch == "'":
-            in_str = not in_str
-            out.append(ch)
-        elif ch == '?' and not in_str:
-            out.append('%s')
-        else:
-            out.append(ch)
-    return ''.join(out)
+    return _map_outside_literals(sql, lambda s: s.replace('?', '%s'))
 
 
 class _PgCursorWrapper:

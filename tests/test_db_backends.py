@@ -202,6 +202,12 @@ def test_untranslatable_sqlite_constructs_fail_loudly():
     up = _to_pg_sql('INSERT INTO t (a) VALUES (?) '
                     'ON CONFLICT(a) DO UPDATE SET a = excluded.a')
     assert up.count('%s') == 1
+    # Dialect rewrites must not touch string LITERALS (data): 'REAL'
+    # stays 'REAL' while the column type is rewritten.
+    mixed = _to_pg_sql("ALTER TABLE t ADD COLUMN x REAL; "
+                       "INSERT INTO t (kind) VALUES ('REAL BLOB ?')")
+    assert 'DOUBLE PRECISION' in mixed
+    assert "'REAL BLOB ?'" in mixed
 
 
 def test_schema_survives_failed_migration_on_fresh_db(pg_stub):

@@ -167,3 +167,99 @@ def test_llama_finetune_resumes_after_cluster_kill(tmp_path, monkeypatch):
                if 'resumed from checkpoint step' in c]
     assert resumed, {k: v[-500:] for k, v in logs.items()}
     assert any('step 12/12' in c for c in resumed)
+
+
+def test_multislice_recipe_launches_over_two_slices(monkeypatch):
+    """examples/llm/multislice-train (r3 verdict Next #3): num_nodes=2
+    slices through the REAL Task path; the gang driver wires
+    MEGASCALE_NUM_SLICES and train.run builds the hybrid ICI/DCN mesh
+    (simulated on the virtual CPU mesh — the same code path the driver's
+    multichip dryrun D compiles)."""
+    cfg = yaml.safe_load(open(os.path.join(
+        EXAMPLES, 'llm', 'multislice-train', 'train.yaml')))
+    assert cfg['num_nodes'] == 2
+    cfg['resources'] = {'cloud': 'fake', 'accelerators': 'tpu-v5e-8'}
+    # Sandbox scale: tiny model, 8 virtual CPU devices standing in for
+    # the slice; --num-slices comes from MEGASCALE_NUM_SLICES (=2, set
+    # by the driver because num_nodes=2) — the recipe's real contract.
+    cfg['run'] = (
+        'JAX_PLATFORMS=cpu '
+        'XLA_FLAGS=--xla_force_host_platform_device_count=8 '
+        'python3 -m skypilot_tpu.train.run --model tiny --steps 4 '
+        '--global-batch-size 8 --seq-len 128 --log-every 2 '
+        '--mesh "data=2,fsdp=-1"')
+    task = Task.from_yaml_config(cfg)
+    job_id, _ = execution.launch(task, cluster_name='ex-ms',
+                                 detach_run=True)
+    assert _wait_job('ex-ms', job_id, timeout=300) == 'SUCCEEDED'
+    log = _read_log('ex-ms', job_id)
+    assert "over 2 slice(s)" in log  # mesh saw MEGASCALE_NUM_SLICES=2
+    assert "'data': 2" in log
+    assert 'step 4/4' in log
+    core.down('ex-ms')
+
+
+def test_moe_finetune_recipe_runs_with_expert_parallelism(tmp_path,
+                                                          monkeypatch):
+    """examples/llm/moe-finetune: expert-parallel mesh + checkpoint dir
+    through the real launch path (scaled to moe-tiny on the virtual CPU
+    mesh)."""
+    monkeypatch.setenv('SKYTPU_LOCAL_BUCKET_ROOT', str(tmp_path / 'b'))
+    cfg = yaml.safe_load(open(os.path.join(
+        EXAMPLES, 'llm', 'moe-finetune', 'moe_finetune.yaml')))
+    cfg['resources'] = {'cloud': 'fake', 'accelerators': 'tpu-v5e-8'}
+    cfg['run'] = (
+        'JAX_PLATFORMS=cpu '
+        'XLA_FLAGS=--xla_force_host_platform_device_count=8 '
+        'python3 -m skypilot_tpu.train.run --model moe-tiny --steps 4 '
+        '--global-batch-size 8 --seq-len 128 --log-every 2 '
+        '--mesh "fsdp=2,expert=4" --ckpt-dir /ckpt --save-every 2')
+    task = Task.from_yaml_config(cfg)
+    job_id, _ = execution.launch(task, cluster_name='ex-moe',
+                                 detach_run=True)
+    assert _wait_job('ex-moe', job_id, timeout=300) == 'SUCCEEDED'
+    log = _read_log('ex-moe', job_id)
+    assert "'expert': 4" in log
+    assert 'step 4/4' in log
+    core.down('ex-moe')
+
+
+def test_serve_recipe_measures_decode_throughput(monkeypatch):
+    """examples/llm/serve-llama: the service YAML through serve.up on the
+    fake cloud, then the shipped loadgen measures decode tok/s against
+    the live endpoint — the README's capture command, executed."""
+    import asyncio
+
+    from skypilot_tpu import serve
+    from skypilot_tpu.serve import loadgen
+
+    cfg = yaml.safe_load(open(os.path.join(
+        EXAMPLES, 'llm', 'serve-llama', 'serve.yaml')))
+    # local cloud: replicas are real processes on this host, so the
+    # readiness probe and loadgen traffic actually route.
+    cfg['resources'] = {'cloud': 'local'}
+    cfg['service']['readiness_probe']['initial_delay_seconds'] = 60
+    cfg['service']['replica_policy'] = {'min_replicas': 1,
+                                        'max_replicas': 1}
+    cfg['run'] = ('JAX_PLATFORMS=cpu python3 -m '
+                  'skypilot_tpu.serve.llm_server --model tiny '
+                  '--max-len 128 --port $SKYTPU_REPLICA_PORT')
+    task = Task.from_yaml_config(cfg)
+    endpoint = serve.up(task, 'exsvc', _in_process=True)
+    try:
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            st = serve.status('exsvc')
+            if st and st[0]['status'] == 'READY':
+                break
+            time.sleep(0.5)
+        else:
+            raise TimeoutError(serve.status('exsvc'))
+        out = asyncio.run(loadgen.run_load(
+            f'http://{endpoint}', requests_total=8, concurrency=4,
+            prompt_len=8, max_new=8, vocab=256))
+        assert out['ok'] == 8, out
+        assert out['decode_tokens_per_sec'] > 0
+        assert out['new_tokens'] == 8 * 8
+    finally:
+        serve.down('exsvc')

@@ -253,6 +253,10 @@ def test_local_up_creates_and_registers_context(fake_kind):
     cert, key = transport.client_cert_files
     assert open(cert).read() == 'FAKE CERT'
     assert open(key).read() == 'FAKE KEY'
+    # Rebuilding the transport (every status poll does) must REUSE the
+    # materialized cert files, not leak new ones into /tmp.
+    transport2 = k8s_client.transport_from_kubeconfig('kind-skytpu')
+    assert transport2.client_cert_files == (cert, key)
     # Idempotent: a second up reuses the cluster.
     assert local_cluster.local_up() == 'kind-skytpu'
     assert local_cluster.local_down() is True

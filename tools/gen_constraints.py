@@ -16,14 +16,24 @@ HEADER = """\
 #   python tools/gen_constraints.py > constraints-ci.txt"""
 
 
-def main() -> None:
+def main() -> int:
+    import sys
+    missing = []
     print(HEADER)
     for pkg in PACKAGES:
         try:
             print(f'{pkg}=={md.version(pkg)}')
         except md.PackageNotFoundError:
-            print(f'# {pkg}: not installed here')
+            missing.append(pkg)
+    if missing:
+        # A silently dropped pin would vanish from CI's `pip install -r`
+        # set entirely — fail the generation instead.
+        print(f'gen_constraints: REFUSING — not installed here: '
+              f'{", ".join(missing)}; generate from a complete dev '
+              'environment.', file=sys.stderr)
+        return 1
+    return 0
 
 
 if __name__ == '__main__':
-    main()
+    raise SystemExit(main())
