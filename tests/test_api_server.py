@@ -458,6 +458,70 @@ def test_dashboard_v2_detail_pages(server):
     sdk.get(sdk.down('dash1'))
 
 
+def test_dashboard_metrics_infra_config_pages(server):
+    """r3 verdict Next #4: every exported metric family is chartable
+    without external tooling (in-server time-series), plus infra and
+    config admin views."""
+    # Two samples so the history carries a drawable series; the endpoint
+    # itself takes a fresh sample per call.
+    r1 = requests_lib.get(f'{server}/dashboard/api/metrics/history',
+                          timeout=10)
+    assert r1.status_code == 200
+    r2 = requests_lib.get(f'{server}/dashboard/api/metrics/history',
+                          timeout=10)
+    samples = r2.json()['samples']
+    assert len(samples) >= 2
+    last = samples[-1]
+    # Every gauge family from server/metrics.py appears in the sample.
+    for family in ('clusters', 'managed_jobs', 'services', 'requests',
+                   'replicas_ready', 'replicas_total',
+                   'requests_total_by_op'):
+        assert family in last, last
+    # A launch shows up in the sampled cluster counts.
+    rid = sdk.launch(Task('mjob', run='echo hi'), cluster_name='mcl',
+                     detach_run=False)
+    sdk.get(rid)
+    samples = requests_lib.get(
+        f'{server}/dashboard/api/metrics/history',
+        timeout=10).json()['samples']
+    assert samples[-1]['clusters'].get('UP', 0) >= 1
+    assert sum(samples[-1]['requests_total_by_op'].values()) > 0
+
+    infra = requests_lib.get(f'{server}/dashboard/api/infra',
+                             timeout=10).json()
+    clouds = {c['name']: c for c in infra['clouds']}
+    assert clouds['local']['enabled']
+    assert 'fake' in clouds
+    assert any(c['rows'] > 0 for c in infra['catalogs'])
+    assert infra['server']['uptime_s'] >= 0
+    assert infra['server']['db_backend'] == 'sqlite'
+
+    cfg = requests_lib.get(f'{server}/dashboard/api/config',
+                           timeout=10).json()
+    assert 'config' in cfg
+
+    # The SPA carries the new views + the multi-series chart.
+    page = requests_lib.get(f'{server}/dashboard', timeout=10).text
+    for marker in ('metricsView', 'infraView', 'configView', 'lineChart',
+                   '#/metrics'):
+        assert marker in page
+    sdk.get(sdk.down('mcl'))
+
+
+def test_dashboard_config_redacts_secrets(server, tmp_path):
+    # Redaction is pure logic; exercise the view function directly (the
+    # server subprocess has its own config env).
+    from skypilot_tpu.server import dashboard
+    red = dashboard._redact({'gcp': {'project': 'p'},
+                             'api_token': 'hunter2',
+                             'nested': {'service_key': 'abc',
+                                        'ok': ['x', {'password': 'y'}]}})
+    assert red['api_token'] == '***'
+    assert red['nested']['service_key'] == '***'
+    assert red['nested']['ok'][1]['password'] == '***'
+    assert red['gcp']['project'] == 'p'
+
+
 def test_server_daemons_refresh_and_gc(tmp_state_dir, enable_fake_cloud):
     """Background daemons (reference server/daemons.py): the status
     refresher flips externally-terminated clusters, and request GC drops

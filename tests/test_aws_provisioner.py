@@ -28,8 +28,11 @@ class FakeEc2Api:
         self.instances = {}  # id -> instance dict
         self.stockout = False
         self.calls = []
-        self.ingress = []  # (group_id, port, cidr)
+        self.ingress = []  # (group_id, port, cidr-or-group)
+        self.security_groups = {}  # id -> {groupId, groupName, tags}
+        self.sg_dependency_violations = 0  # refuse N deletes first
         self._next = 0
+        self._next_sg = 0
 
     def request(self, action, params):
         self.calls.append((action, dict(params)))
@@ -120,10 +123,70 @@ class FakeEc2Api:
         return {}
 
     def _do_AuthorizeSecurityGroupIngress(self, params):
-        self.ingress.append((params['GroupId'],
-                             int(params['IpPermissions.1.FromPort']),
-                             params['IpPermissions.1.IpRanges.1.CidrIp']))
+        if 'IpPermissions.1.Groups.1.GroupId' in params:
+            # self-referencing all-traffic rule
+            self.ingress.append((params['GroupId'], -1,
+                                 params['IpPermissions.1.Groups.1.GroupId']))
+        else:
+            self.ingress.append(
+                (params['GroupId'],
+                 int(params['IpPermissions.1.FromPort']),
+                 params['IpPermissions.1.IpRanges.1.CidrIp']))
         return {}
+
+    def _do_DescribeVpcs(self, params):
+        del params
+        return {'vpcSet': [{'vpcId': 'vpc-default', 'isDefault': 'true'}]}
+
+    def _do_DescribeSecurityGroups(self, params):
+        names = []
+        i = 1
+        assert params.get('Filter.1.Name') == 'group-name'
+        j = 1
+        while f'Filter.1.Value.{j}' in params:
+            names.append(params[f'Filter.1.Value.{j}'])
+            j += 1
+        del i
+        matched = [g for g in self.security_groups.values()
+                   if g['groupName'] in names]
+        return {'securityGroupInfo': matched}
+
+    def _do_CreateSecurityGroup(self, params):
+        self._next_sg += 1
+        gid = f'sg-{self._next_sg:08x}'
+        tags = {}
+        i = 1
+        while f'TagSpecification.1.Tag.{i}.Key' in params:
+            tags[params[f'TagSpecification.1.Tag.{i}.Key']] = \
+                params[f'TagSpecification.1.Tag.{i}.Value']
+            i += 1
+        self.security_groups[gid] = {'groupId': gid,
+                                     'groupName': params['GroupName'],
+                                     'vpcId': params['VpcId'],
+                                     'tags': tags}
+        return {'groupId': gid}
+
+    def _do_DeleteSecurityGroup(self, params):
+        if self.sg_dependency_violations > 0:
+            self.sg_dependency_violations -= 1
+            raise ec2_client.AwsApiError(
+                400, 'DependencyViolation',
+                'resource sg has a dependent object')
+        self.security_groups.pop(params['GroupId'], None)
+        return {}
+
+
+class FakeSsm:
+    """Canonical's public AMI parameter, faked."""
+
+    def __init__(self, region='us-east-1'):
+        self.region = region
+        self.requests = []
+
+    def get_parameter(self, name):
+        self.requests.append(name)
+        assert 'canonical/ubuntu' in name
+        return f'ami-resolved-{self.region}'
 
 
 @pytest.fixture()
@@ -169,8 +232,71 @@ def test_run_instances_creates_tagged_vms(fake_ec2):
 
 def test_missing_ami_is_actionable(fake_ec2):
     cfg = _cfg(image=None)
-    with pytest.raises(exceptions.NoCloudAccessError, match='AMI'):
-        aws_instance.run_instances(cfg)
+    # No SSM reachable either (the override raises): the error must name
+    # every escape hatch.
+    class DeadSsm:
+        def get_parameter(self, name):
+            raise ec2_client.AwsApiError(403, 'AccessDeniedException',
+                                         'no ssm for you')
+    aws_instance.set_ssm_for_testing(DeadSsm())
+    try:
+        with pytest.raises(exceptions.NoCloudAccessError, match='AMI'):
+            aws_instance.run_instances(cfg)
+    finally:
+        aws_instance.set_ssm_for_testing(None)
+
+
+def test_default_ami_resolves_via_ssm_and_caches(fake_ec2, monkeypatch):
+    """r3 verdict Next #6: a bare account needs zero AWS-specific YAML —
+    the default AMI comes from Canonical's public SSM parameter."""
+    monkeypatch.delenv('SKYTPU_AWS_DEFAULT_AMI', raising=False)
+    ssm = FakeSsm()
+    aws_instance.set_ssm_for_testing(ssm)
+    try:
+        record = aws_instance.run_instances(_cfg(image=None))
+        assert len(record.created_instance_ids) == 2
+        images = {i['imageId'] for i in fake_ec2.instances.values()}
+        assert images == {'ami-resolved-us-east-1'}
+        # Resolution is cached per region: one SSM round trip.
+        aws_instance.run_instances(_cfg(num_nodes=3, image=None))
+        assert len(ssm.requests) == 1
+    finally:
+        aws_instance.set_ssm_for_testing(None)
+
+
+def test_security_group_bootstrap_and_cleanup(fake_ec2):
+    """r3 verdict Next #6: create-if-missing SG with the cluster tag —
+    SSH in, all traffic intra-cluster; reused on relaunch; deleted at
+    terminate (with DependencyViolation retries)."""
+    aws_instance.run_instances(_cfg())
+    assert len(fake_ec2.security_groups) == 1
+    gid, sg = next(iter(fake_ec2.security_groups.items()))
+    assert sg['groupName'] == 'skytpu-a-xyz'
+    assert sg['tags'] == {'skytpu-cluster': 'a-xyz'}
+    assert sg['vpcId'] == 'vpc-default'
+    # SSH from anywhere + all-traffic self rule.
+    assert (gid, 22, '0.0.0.0/0') in fake_ec2.ingress
+    assert (gid, -1, gid) in fake_ec2.ingress
+    # Instances launched INTO the group.
+    launches = [p for a, p in fake_ec2.calls if a == 'RunInstances']
+    assert all(p.get('SecurityGroupId.1') == gid for p in launches)
+    # Relaunch (scale up): group reused, not duplicated.
+    aws_instance.run_instances(_cfg(num_nodes=3))
+    assert len(fake_ec2.security_groups) == 1
+    # Terminate: first delete hits DependencyViolation (instances still
+    # shutting down), the retry succeeds.
+    fake_ec2.sg_dependency_violations = 1
+    import skypilot_tpu.provision.aws.instance as inst_mod
+    orig = inst_mod._cleanup_security_group
+    inst_mod._cleanup_security_group = (
+        lambda c, n: orig(c, n, retries=3, delay=0.01))
+    try:
+        aws_instance.terminate_instances(
+            'a-xyz', {'region': 'us-east-1'})
+    finally:
+        inst_mod._cleanup_security_group = orig
+    assert fake_ec2.instances == {}
+    assert fake_ec2.security_groups == {}
 
 
 def test_stockout_maps_to_quota_error_and_rolls_back(fake_ec2):

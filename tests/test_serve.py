@@ -471,3 +471,74 @@ def test_fallback_autoscaler_launching_spot_is_not_a_gap():
     d = auto.evaluate(1, 2, _times(30, now), now=now, replicas=reps)
     assert (d.num_spot, d.num_ondemand) == (2, 1)
     assert d.target_num_replicas == 3  # never exceeds max_replicas
+
+
+def test_expose_controller_port_provisions_gke_service(tmp_state_dir):
+    """r3 verdict Next #7: a serve controller on GKE gets an external
+    Service for its LB port, and the endpoint resolves once the platform
+    assigns the LoadBalancer ingress."""
+    from test_gke_provisioner import FakeK8sApi
+
+    from skypilot_tpu import global_user_state as gus
+    from skypilot_tpu.provision.kubernetes import instance as k8s_instance
+    from skypilot_tpu.provision.kubernetes import k8s_client
+    from skypilot_tpu.utils import controller_utils
+
+    api = FakeK8sApi()
+    k8s_instance.set_client_for_testing(
+        k8s_client.K8sClient(api, namespace='default'))
+    try:
+        handle = {'cluster_name': controller_utils.SERVE_CONTROLLER_CLUSTER,
+                  'cluster_name_on_cloud': 'ssc-1', 'cloud': 'gke',
+                  'region': 'us-west4', 'zone': None, 'num_nodes': 1,
+                  'hosts_per_node': 1, 'chips_per_host': 1,
+                  'launched_resources': {}, 'is_tpu': True,
+                  'price_per_hour': None,
+                  'provider_config': {'namespace': 'default'}}
+        gus.add_or_update_cluster(controller_utils.SERVE_CONTROLLER_CLUSTER,
+                                  handle, gus.ClusterStatus.UP)
+        ep = controller_utils.expose_controller_port(
+            controller_utils.SERVE_CONTROLLER_CLUSTER, 30123,
+            wait_s=5, poll_s=0.05)
+        assert ep == '35.0.0.9:30123'
+        svc = api.services['ssc-1-svc']
+        assert [p['port'] for p in svc['spec']['ports']] == [30123]
+        assert svc['spec']['selector']['skytpu-node'] == '0'
+    finally:
+        k8s_instance.set_client_for_testing(None)
+
+
+def test_expose_controller_port_noop_off_pod_clouds(tmp_state_dir):
+    from skypilot_tpu import global_user_state as gus
+    from skypilot_tpu.utils import controller_utils
+    gus.add_or_update_cluster(
+        controller_utils.SERVE_CONTROLLER_CLUSTER,
+        {'cloud': 'local', 'cluster_name_on_cloud': 'x'},
+        gus.ClusterStatus.UP)
+    assert controller_utils.expose_controller_port(
+        controller_utils.SERVE_CONTROLLER_CLUSTER, 1234) is None
+    # No controller cluster at all: also a no-op.
+    gus.remove_cluster(controller_utils.SERVE_CONTROLLER_CLUSTER)
+    assert controller_utils.expose_controller_port(
+        controller_utils.SERVE_CONTROLLER_CLUSTER, 1234) is None
+
+
+def test_serve_controller_records_external_endpoint(monkeypatch):
+    """The controller swaps its recorded endpoint for the external one
+    when ingress automation returns an address; `serve status` then
+    shows it."""
+    from skypilot_tpu.utils import controller_utils
+    monkeypatch.setattr(
+        controller_utils, 'expose_controller_port',
+        lambda cluster, port, **kw: f'203.0.113.7:{port}')
+    task = _service_task(min_replicas=1)
+    serve.up(task, 'svcext', _in_process=True)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        st = serve.status('svcext')
+        if st and st[0]['endpoint'] and \
+                st[0]['endpoint'].startswith('203.0.113.7:'):
+            break
+        time.sleep(0.2)
+    assert serve.status('svcext')[0]['endpoint'].startswith('203.0.113.7:')
+    serve.down('svcext')
