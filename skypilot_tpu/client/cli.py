@@ -412,7 +412,8 @@ def serve_group():
 
 @serve_group.command('up')
 @click.argument('entrypoint', nargs=-1)
-@click.option('--service-name', '-n', 'service_name', required=True)
+@click.option('--service-name', 'service_name', required=True,
+              help='Service name (long-only: -n is the task name).')
 @_common_task_options
 @_clean_errors
 def serve_up(entrypoint, service_name, name, workdir, cloud, accelerators,
@@ -446,6 +447,41 @@ def serve_down(service_name):
     from skypilot_tpu import serve
     serve.down(service_name)
     click.echo(f'Service {service_name} shutting down.')
+
+
+@serve_group.command('logs')
+@click.argument('service_name')
+@click.argument('replica_id', type=int)
+@click.option('--no-follow', is_flag=True, help='Print and exit.')
+@_clean_errors
+def serve_logs(service_name, replica_id, no_follow):
+    """Tail a replica's logs (analog of `sky serve logs`)."""
+    from skypilot_tpu import serve
+    try:
+        serve.tail_replica_logs(service_name, replica_id,
+                                follow=not no_follow)
+    except ValueError as e:
+        raise click.ClickException(str(e)) from e
+
+
+@serve_group.command('update')
+@click.argument('entrypoint', nargs=-1)
+@click.option('--service-name', 'service_name', required=True,
+              help='Service name (long-only: -n is the task name).')
+@_common_task_options
+@_clean_errors
+def serve_update(entrypoint, service_name, name, workdir, cloud,
+                 accelerators, num_nodes, use_spot, envs, secrets):
+    """Rolling-update a service to a new task version."""
+    from skypilot_tpu import serve
+    task = _load_task(entrypoint, name, workdir, cloud, accelerators,
+                      num_nodes, use_spot, envs, secrets)
+    try:
+        version = serve.update(task, service_name)
+    except ValueError as e:
+        raise click.ClickException(str(e)) from e
+    click.echo(f'Service {service_name} updating to v{version} '
+               '(rolling).')
 
 
 @cli.group('local')

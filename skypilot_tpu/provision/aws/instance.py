@@ -142,9 +142,17 @@ def _ensure_security_group(client: ec2_lib.Ec2Client,
         raise exceptions.NoCloudAccessError(
             'AWS account has no default VPC; create one (or pre-create a '
             f'security group named {name!r} in your VPC and retry).')
-    gid = client.create_security_group(
-        name, f'skypilot-tpu cluster {cluster_name_on_cloud}',
-        vpcs[0]['vpcId'], tags={TAG_CLUSTER: cluster_name_on_cloud})
+    try:
+        gid = client.create_security_group(
+            name, f'skypilot-tpu cluster {cluster_name_on_cloud}',
+            vpcs[0]['vpcId'], tags={TAG_CLUSTER: cluster_name_on_cloud})
+    except ec2_lib.AwsApiError as e:
+        if e.code != 'InvalidGroup.Duplicate':
+            raise
+        # Raced another provision of the same cluster name: the winner's
+        # group is usable — re-describe instead of failing the launch.
+        existing = client.describe_security_groups({'group-name': [name]})
+        return existing[0]['groupId']
     client.authorize_ingress(gid, 22)
     client.authorize_ingress_self(gid)
     return gid

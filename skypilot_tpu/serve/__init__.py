@@ -61,6 +61,23 @@ def up(task: Task, service_name: str,
 up._controllers = {}  # in-process controllers for tests
 
 
+def tail_replica_logs(service_name: str, replica_id: int,
+                      follow: bool = True) -> None:
+    """Tail a replica's job log (reference: ``sky serve logs``). The
+    replica runs as a job on its own cluster (``sv-<svc>-r<id>``)."""
+    from skypilot_tpu import core
+    record = serve_state.get_service(service_name)
+    if record is None:
+        raise ValueError(f'Service {service_name!r} not found.')
+    replicas = {r['replica_id'] for r in
+                serve_state.list_replicas(service_name)}
+    if replica_id not in replicas:
+        raise ValueError(
+            f'Service {service_name!r} has no replica {replica_id} '
+            f'(have: {sorted(replicas)}).')
+    core.tail_logs(f'sv-{service_name}-r{replica_id}', follow=follow)
+
+
 def update(task: Task, service_name: str) -> int:
     """Rolling update: register a new service version; the controller
     surges new-version replicas and drains old ones without dropping ready

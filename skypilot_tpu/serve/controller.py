@@ -55,11 +55,8 @@ class ServeController:
             except Exception:  # noqa: BLE001 — ingress is additive
                 return
             if external and not self._stop.is_set():
-                record = serve_state.get_service(self.service_name)
-                if record is not None:
-                    serve_state.set_service_status(
-                        self.service_name, record['status'],
-                        endpoint=external)
+                serve_state.set_service_endpoint(self.service_name,
+                                                 external)
 
         threading.Thread(target=_wait_and_record, daemon=True,
                          name='serve-ingress').start()

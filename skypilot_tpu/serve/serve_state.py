@@ -118,6 +118,15 @@ def set_service_status(name: str, status: ServiceStatus,
                          (status.value, name))
 
 
+def set_service_endpoint(name: str, endpoint: str) -> None:
+    """Endpoint-only update: late async writers (the k8s-ingress waiter)
+    must not read-modify-write status — they could resurrect a stale
+    one (e.g. overwrite SHUTTING_DOWN and wedge teardown)."""
+    with _lock(), _conn() as conn:
+        conn.execute('UPDATE services SET endpoint = ? WHERE name = ?',
+                     (endpoint, name))
+
+
 def set_controller_pid(name: str, pid: Optional[int]) -> None:
     """Record the live controller (or None = restart claimed, new
     controller not yet reported in — clears the claim timestamp when a

@@ -219,16 +219,76 @@ def service_detail(name: str) -> Optional[Dict[str, Any]]:
     }
 
 
+def logs_search_view(query: str, max_matches: int = 300,
+                     tail_bytes: int = 2 * 1024 * 1024) -> Dict[str, Any]:
+    """Case-insensitive substring search across every cluster job log
+    (reference analog: the dashboard's log search). Bounded: only the
+    last ``tail_bytes`` of each file are scanned and matches cap at
+    ``max_matches`` — a dashboard query must stay cheap no matter how
+    much log history exists."""
+    import glob
+
+    from skypilot_tpu.backends.tpu_gang_backend import runtime_dir
+    q = query.lower()
+    if not q:
+        return {'matches': [], 'truncated': False, 'files_scanned': 0}
+    root = os.path.dirname(runtime_dir('x'))  # .../runtime
+    matches: List[Dict[str, Any]] = []
+    truncated = False
+    def _mtime_or_zero(path: str) -> float:
+        try:  # a teardown may delete the file between glob and sort
+            return os.path.getmtime(path)
+        except OSError:
+            return 0.0
+
+    files = sorted(glob.glob(os.path.join(root, '*', 'jobs', '*', '*.log')),
+                   key=_mtime_or_zero, reverse=True)
+    for path in files:
+        rel = os.path.relpath(path, root)
+        parts = rel.split(os.sep)  # cluster/jobs/<id>/<file>.log
+        cluster, job_id, fname = parts[0], parts[2], parts[3]
+        try:
+            size = os.path.getsize(path)
+            with open(path, 'rb') as f:
+                if size > tail_bytes:
+                    f.seek(size - tail_bytes)
+                    f.readline()  # drop the partial line
+                text = f.read().decode('utf-8', errors='replace')
+        except OSError:
+            continue
+        for i, line in enumerate(text.splitlines(), start=1):
+            if q in line.lower():
+                matches.append({'cluster': cluster, 'job_id': job_id,
+                                'file': fname, 'line_no': i,
+                                'line': line[:400]})
+                if len(matches) >= max_matches:
+                    truncated = True
+                    break
+        if truncated:
+            break
+    return {'matches': matches, 'truncated': truncated,
+            'files_scanned': len(files)}
+
+
 _SERVER_STARTED_AT = __import__('time').time()
 
 
 def metrics_history_view() -> Dict[str, Any]:
-    """The sampler's ring buffer + a fresh sample so charts always have
-    a current point (and work even when the daemon is disabled)."""
+    """The sampler's ring buffer + a fresh (unrecorded) sample so charts
+    always have a current point. The GET must not append on every poll:
+    the dashboard refreshes every 2s and would evict the 4h@15s window
+    the daemon maintains — the view only records when the buffer has no
+    recent sample (daemon disabled or not yet ticked)."""
+    import time as time_lib
+
     from skypilot_tpu.server import metrics_history
-    metrics_history.sample_once()
-    return {'samples': metrics_history.history(),
-            'sample_interval_s': metrics_history.sample_interval_s()}
+    hist = metrics_history.history()
+    interval = metrics_history.sample_interval_s()
+    stale = (not hist or
+             time_lib.time() - hist[-1]['ts'] >= max(interval, 1.0))
+    fresh = metrics_history.sample_once(record=stale)
+    samples = metrics_history.history() if stale else hist + [fresh]
+    return {'samples': samples, 'sample_interval_s': interval}
 
 
 def infra_view() -> Dict[str, Any]:
@@ -407,6 +467,12 @@ async def api_metrics_history(request: web.Request) -> web.Response:
     return await _json(request, metrics_history_view)
 
 
+async def api_logs_search(request: web.Request) -> web.Response:
+    q = request.query.get('q', '')
+    limit = min(max(_int_or(request.query.get('limit'), 300), 1), 2000)
+    return await _json(request, logs_search_view, q, limit)
+
+
 async def api_infra(request: web.Request) -> web.Response:
     return await _json(request, infra_view)
 
@@ -427,6 +493,7 @@ def add_routes(app: web.Application) -> None:
     app.router.add_get('/dashboard/api/workspaces', api_workspaces)
     app.router.add_get('/dashboard/api/metrics/history',
                        api_metrics_history)
+    app.router.add_get('/dashboard/api/logs/search', api_logs_search)
     app.router.add_get('/dashboard/api/infra', api_infra)
     app.router.add_get('/dashboard/api/config', api_config)
 
@@ -461,8 +528,9 @@ _PAGE = """<!doctype html>
 </style></head><body>
 <h1>skypilot-tpu <span id="ts"></span></h1>
 <nav><a href="#/">overview</a> <a href="#/metrics">metrics</a>
- <a href="#/infra">infra</a> <a href="#/config">config</a>
- <a href="#/users">users</a> <a href="#/workspaces">workspaces</a></nav>
+ <a href="#/logs">logs</a> <a href="#/infra">infra</a>
+ <a href="#/config">config</a> <a href="#/users">users</a>
+ <a href="#/workspaces">workspaces</a></nav>
 <div id="view"></div>
 <script>
 // Token-protected servers: open /dashboard?token=...; the token rides
@@ -671,6 +739,29 @@ async function metricsView(){
                 {keepZero:true});
 }
 
+async function logsView(query){
+  let results = '';
+  if(query){
+    const r = await J('dashboard/api/logs/search?q=' +
+                      encodeURIComponent(query));
+    results = `<p style="color:#888;font-size:12px">${r.matches.length}
+        match(es) over ${r.files_scanned} file(s)${
+        r.truncated ? ' (truncated)' : ''}</p>` +
+      table(['cluster','job','file','line','text'], r.matches,
+        m=>`<tr><td><a href="#/cluster/${esc(m.cluster)}">${
+         esc(m.cluster)}</a></td><td>${esc(m.job_id)}</td>
+         <td>${esc(m.file)}</td><td>${esc(m.line_no)}</td>
+         <td><code style="font-size:12px">${esc(m.line)}</code></td></tr>`);
+  }
+  // Enter submits by updating the hash; the router re-renders.
+  return `<h2>Log search</h2>
+    <input id="logq" value="${esc(query||'')}" placeholder="substring…"
+      style="width:420px;padding:6px;font-size:13px"
+      onkeydown="if(event.key==='Enter')
+        location.hash='#/logs/'+encodeURIComponent(this.value)">
+    ${results}`;
+}
+
 async function infraView(){
   const i = await J('dashboard/api/infra');
   return '<h2>Clouds</h2>' + table(['cloud','enabled','reason'], i.clouds,
@@ -719,6 +810,8 @@ async function route(){
     else if(h === '#/users') html = await usersView();
     else if(h === '#/workspaces') html = await workspacesView();
     else if(h === '#/metrics') html = await metricsView();
+    else if((m = h.match(/^#\\/logs(?:\\/(.*))?$/)))
+      html = await logsView(m[1] ? decodeURIComponent(m[1]) : '');
     else if(h === '#/infra') html = await infraView();
     else if(h === '#/config') html = await configView();
     else html = await overview();
@@ -728,7 +821,12 @@ async function route(){
   document.getElementById('view').innerHTML = html;
 }
 window.addEventListener('hashchange', route);
-route(); setInterval(route, 2000);
+route();
+// Auto-refresh everywhere EXCEPT the log-search view: re-rendering
+// would wipe the query box mid-typing.
+setInterval(() => {
+  if(!(location.hash||'').startsWith('#/logs')) route();
+}, 2000);
 </script></body></html>"""
 
 

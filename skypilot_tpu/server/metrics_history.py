@@ -31,10 +31,11 @@ _lock = threading.Lock()
 _samples: Deque[Dict[str, Any]] = collections.deque(maxlen=_MAX_SAMPLES)
 
 
-def sample_once() -> Dict[str, Any]:
+def sample_once(record: bool = True) -> Dict[str, Any]:
     """Snapshot fleet state counts (same families as server/metrics.py
-    gauges, plus ready-replica and request-counter totals) and append to
-    the ring buffer."""
+    gauges, plus ready-replica and request-counter totals); append to
+    the ring buffer when ``record`` (the daemon's cadence owns the
+    buffer — ad-hoc dashboard reads pass record=False)."""
     from collections import Counter as C
 
     from skypilot_tpu import global_user_state
@@ -43,11 +44,10 @@ def sample_once() -> Dict[str, Any]:
     from skypilot_tpu.server import metrics as metrics_mod
     from skypilot_tpu.server import requests_db
 
+    services = [s for s in serve_state.list_services() if s]
     replicas_total = 0
     replicas_ready = 0
-    for svc in serve_state.list_services():
-        if not svc:
-            continue
+    for svc in services:
         for rep in serve_state.list_replicas(svc['name']):
             replicas_total += 1
             status = rep['status']
@@ -71,15 +71,15 @@ def sample_once() -> Dict[str, Any]:
                            for r in global_user_state.get_clusters())),
         'managed_jobs': dict(C(r['status'].value
                                for r in jobs_state.list_jobs())),
-        'services': dict(C(s['status'].value
-                           for s in serve_state.list_services() if s)),
+        'services': dict(C(s['status'].value for s in services)),
         'requests': requests_db.status_counts(),
         'replicas_total': replicas_total,
         'replicas_ready': replicas_ready,
         'requests_total_by_op': ops,
     }
-    with _lock:
-        _samples.append(sample)
+    if record:
+        with _lock:
+            _samples.append(sample)
     return sample
 
 
