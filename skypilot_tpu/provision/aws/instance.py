@@ -149,10 +149,12 @@ def _ensure_security_group(client: ec2_lib.Ec2Client,
     except ec2_lib.AwsApiError as e:
         if e.code != 'InvalidGroup.Duplicate':
             raise
-        # Raced another provision of the same cluster name: the winner's
-        # group is usable — re-describe instead of failing the launch.
+        # Raced another provision of the same cluster name: re-describe
+        # and fall through to the (idempotent) ingress authorization —
+        # the winner may have crashed between create and authorize, and
+        # a rule-less group would strand every later launch.
         existing = client.describe_security_groups({'group-name': [name]})
-        return existing[0]['groupId']
+        gid = existing[0]['groupId']
     client.authorize_ingress(gid, 22)
     client.authorize_ingress_self(gid)
     return gid
@@ -232,10 +234,14 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
                 spot=bool(nc.get('use_spot', False)),
                 zone=config.zone,
                 security_group_ids=[sg_id] if sg_id else None,
-                tags={TAG_CLUSTER: config.cluster_name_on_cloud,
+                # Identity tags LAST: config.tags carries the display
+                # name under the same 'skytpu-cluster' key, and letting
+                # it overwrite the name-on-cloud would break every
+                # lifecycle op's tag filter.
+                tags={**config.tags,
+                      TAG_CLUSTER: config.cluster_name_on_cloud,
                       TAG_NODE: str(idx),
-                      'Name': f'{config.cluster_name_on_cloud}-{idx}',
-                      **config.tags})
+                      'Name': f'{config.cluster_name_on_cloud}-{idx}'})
             created.extend(i['instanceId'] for i in instances)
     except ec2_lib.AwsApiError as e:
         for iid in created:  # atomic create-all-or-rollback

@@ -68,10 +68,12 @@ def _pod_body(config: common.ProvisionConfig, node: int, worker: int
         'metadata': {
             'name': name,
             'labels': {
+                # Identity labels LAST — see kubernetes/instance.py: the
+                # display-name tag shares the 'skytpu-cluster' key.
+                **config.tags,
                 LABEL_CLUSTER: config.cluster_name_on_cloud,
                 LABEL_NODE: str(node),
                 LABEL_WORKER: str(worker),
-                **config.tags,
             },
         },
         'spec': {

@@ -86,10 +86,13 @@ def _cpu_pod_body(config: common.ProvisionConfig, node: int, worker: int
         'metadata': {
             'name': pod_name(config.cluster_name_on_cloud, node, worker),
             'labels': {
+                # Identity labels LAST (config.tags carries the display
+                # name under the same key — it must not overwrite the
+                # name-on-cloud the lifecycle selectors filter by).
+                **config.tags,
                 LABEL_CLUSTER: config.cluster_name_on_cloud,
                 LABEL_NODE: str(node),
                 LABEL_WORKER: str(worker),
-                **config.tags,
             },
         },
         'spec': {

@@ -75,7 +75,9 @@ def tail_replica_logs(service_name: str, replica_id: int,
         raise ValueError(
             f'Service {service_name!r} has no replica {replica_id} '
             f'(have: {sorted(replicas)}).')
-    core.tail_logs(f'sv-{service_name}-r{replica_id}', follow=follow)
+    core.tail_logs(serve_state.replica_cluster_name(service_name,
+                                                    replica_id),
+                   follow=follow)
 
 
 def update(task: Task, service_name: str) -> int:

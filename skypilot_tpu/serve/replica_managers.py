@@ -51,7 +51,8 @@ class ReplicaManager:
         self.task = task
 
     def _cluster_name(self, replica_id: int) -> str:
-        return f'sv-{self.service_name}-r{replica_id}'
+        return serve_state.replica_cluster_name(self.service_name,
+                                                replica_id)
 
     # -- scale up ----------------------------------------------------------
 

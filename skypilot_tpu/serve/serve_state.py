@@ -118,6 +118,12 @@ def set_service_status(name: str, status: ServiceStatus,
                          (status.value, name))
 
 
+def replica_cluster_name(service_name: str, replica_id: int) -> str:
+    """The one naming contract for replica clusters (used by the replica
+    manager to launch and by `serve logs` to find them)."""
+    return f'sv-{service_name}-r{replica_id}'
+
+
 def set_service_endpoint(name: str, endpoint: str) -> None:
     """Endpoint-only update: late async writers (the k8s-ingress waiter)
     must not read-modify-write status — they could resurrect a stale

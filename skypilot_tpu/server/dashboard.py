@@ -235,6 +235,7 @@ def logs_search_view(query: str, max_matches: int = 300,
     root = os.path.dirname(runtime_dir('x'))  # .../runtime
     matches: List[Dict[str, Any]] = []
     truncated = False
+    scanned = 0
     def _mtime_or_zero(path: str) -> float:
         try:  # a teardown may delete the file between glob and sort
             return os.path.getmtime(path)
@@ -256,6 +257,7 @@ def logs_search_view(query: str, max_matches: int = 300,
                 text = f.read().decode('utf-8', errors='replace')
         except OSError:
             continue
+        scanned += 1
         for i, line in enumerate(text.splitlines(), start=1):
             if q in line.lower():
                 matches.append({'cluster': cluster, 'job_id': job_id,
@@ -266,8 +268,10 @@ def logs_search_view(query: str, max_matches: int = 300,
                     break
         if truncated:
             break
+    # files_scanned counts files actually OPENED: an early break must
+    # not claim coverage of files the search never reached.
     return {'matches': matches, 'truncated': truncated,
-            'files_scanned': len(files)}
+            'files_scanned': scanned}
 
 
 _SERVER_STARTED_AT = __import__('time').time()
@@ -284,8 +288,11 @@ def metrics_history_view() -> Dict[str, Any]:
     from skypilot_tpu.server import metrics_history
     hist = metrics_history.history()
     interval = metrics_history.sample_interval_s()
-    stale = (not hist or
-             time_lib.time() - hist[-1]['ts'] >= max(interval, 1.0))
+    # Record only as the FALLBACK sampler (daemon disabled, or clearly
+    # dead — 2x its interval without a tick; a bare >= interval would
+    # race the daemon's sleep+work cadence and double the density).
+    stale = (not hist or interval <= 0 or
+             time_lib.time() - hist[-1]['ts'] >= max(2 * interval, 2.0))
     fresh = metrics_history.sample_once(record=stale)
     samples = metrics_history.history() if stale else hist + [fresh]
     return {'samples': samples, 'sample_interval_s': interval}

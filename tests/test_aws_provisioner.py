@@ -230,6 +230,20 @@ def test_run_instances_creates_tagged_vms(fake_ec2):
     assert [i.node_id for i in info.instances] == [0, 1]
 
 
+def test_identity_tags_survive_display_name_tag(fake_ec2):
+    """Regression (caught by the kubectl e2e, same class here): the
+    backend's display-name tag shares the 'skytpu-cluster' key —
+    identity must win or every lifecycle op's tag filter misses."""
+    cfg = _cfg(num_nodes=1)
+    cfg.tags = {'skytpu-cluster': 'display-name'}
+    aws_instance.run_instances(cfg)
+    inst = next(iter(fake_ec2.instances.values()))
+    tags = {t['key']: t['value'] for t in inst['tagSet']}
+    assert tags['skytpu-cluster'] == 'a-xyz'
+    assert aws_instance.query_instances(
+        'a-xyz', {'region': 'us-east-1'}) != {}
+
+
 def test_missing_ami_is_actionable(fake_ec2):
     cfg = _cfg(image=None)
     # No SSM reachable either (the override raises): the error must name

@@ -1,0 +1,193 @@
+"""End-to-end launch onto the generic kubernetes cloud WITHOUT a real
+cluster.
+
+Two fakes compose: the in-memory kube-apiserver transport (pods as
+records, from test_gke_provisioner) handles the provision plane, and a
+``kubectl`` SHIM installed first on PATH handles the exec plane —
+``kubectl exec`` runs the command locally under a per-pod HOME (so the
+real tar-pipe rsync, agent nohup, and pidfile logic execute), and
+``kubectl port-forward`` is a real TCP proxy thread. Only the apiserver
+and the pod sandbox are faked; everything between — optimizer placement,
+pods-as-nodes provision, kubectl bootstrap, head-agent start, the
+remote-control submit over the tunnel, the gang driver in the "pod",
+log streaming, teardown — is the production path.
+
+Reference analog: the reference's kubernetes smoke tests run against a
+real kind cluster (``tests/smoke_tests``); no kind binary ships in this
+image, so the shim stands in at the kubectl boundary instead.
+"""
+import json
+import os
+import stat
+import sys
+import textwrap
+import time
+
+import pytest
+import yaml
+
+from skypilot_tpu import core, execution
+from skypilot_tpu.provision.kubernetes import instance as k8s_instance
+from skypilot_tpu.provision.kubernetes import k8s_client
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+from test_gke_provisioner import FakeK8sApi
+
+FAKE_KUBECTL = textwrap.dedent('''\
+    #!/usr/bin/env python3
+    import json, os, socket, subprocess, sys, threading
+    args = sys.argv[1:]
+    root = os.environ['FAKE_K8S_ROOT']
+    ctx = None
+    if args and args[0] == '--context':
+        ctx = args[1]; args = args[2:]
+    with open(os.path.join(root, 'calls.jsonl'), 'a') as f:
+        f.write(json.dumps({'ctx': ctx, 'args': args}) + chr(10))
+    if args[0] == 'exec':
+        i = 1
+        while args[i].startswith('-'):
+            if args[i] == '-i': i += 1
+            elif args[i] == '-n': i += 2
+            else: raise SystemExit(f'unhandled exec flag {args[i]}')
+        pod = args[i]
+        assert args[i + 1] == '--', args
+        cmd = args[i + 2:]
+        home = os.path.join(root, 'pods', pod)
+        os.makedirs(home, exist_ok=True)
+        env = dict(os.environ); env['HOME'] = home
+        r = subprocess.run(cmd, env=env, cwd=home)
+        sys.exit(r.returncode)
+    if args[0] == 'port-forward':
+        local, remote = args[-1].split(':')
+        def pipe(a, b):
+            try:
+                while True:
+                    d = a.recv(65536)
+                    if not d: break
+                    b.sendall(d)
+            except OSError:
+                pass
+            finally:
+                for s in (a, b):
+                    try: s.shutdown(socket.SHUT_RDWR)
+                    except OSError: pass
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(('127.0.0.1', int(local))); srv.listen(16)
+        while True:
+            c, _ = srv.accept()
+            u = socket.create_connection(('127.0.0.1', int(remote)))
+            threading.Thread(target=pipe, args=(c, u), daemon=True).start()
+            threading.Thread(target=pipe, args=(u, c), daemon=True).start()
+    raise SystemExit(f'unhandled kubectl verb {args[0]}')
+''')
+
+
+@pytest.fixture()
+def k8s_rig(tmp_path, monkeypatch, tmp_state_dir):
+    # kubeconfig so the kubernetes cloud reports a context/region.
+    kc = tmp_path / 'kubeconfig'
+    kc.write_text(yaml.safe_dump({
+        'apiVersion': 'v1', 'kind': 'Config',
+        'current-context': 'kind-test',
+        'contexts': [{'name': 'kind-test',
+                      'context': {'cluster': 'c', 'user': 'u'}}],
+        'clusters': [{'name': 'c',
+                      'cluster': {'server': 'https://127.0.0.1:1'}}],
+        'users': [{'name': 'u', 'user': {'token': 't'}}],
+    }))
+    monkeypatch.setenv('KUBECONFIG', str(kc))
+
+    root = tmp_path / 'fake-k8s'
+    (root / 'pods').mkdir(parents=True)
+    bindir = tmp_path / 'kubectl-bin'
+    bindir.mkdir()
+    shim = bindir / 'kubectl'
+    shim.write_text(FAKE_KUBECTL)
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv('PATH', f'{bindir}:{os.environ["PATH"]}')
+    monkeypatch.setenv('FAKE_K8S_ROOT', str(root))
+    monkeypatch.setenv('SKYTPU_REMOTE_PYTHON', sys.executable)
+
+    api = FakeK8sApi()
+    k8s_instance.set_client_for_testing(
+        k8s_client.K8sClient(api, namespace='default'))
+
+    class Rig:
+        def __init__(self):
+            self.api = api
+            self.root = root
+
+        def calls(self):
+            path = root / 'calls.jsonl'
+            if not path.exists():
+                return []
+            return [json.loads(l) for l in path.read_text().splitlines()]
+
+        def pod_home(self, pod):
+            return root / 'pods' / pod
+
+    yield Rig()
+
+    k8s_instance.set_client_for_testing(None)
+    # Real k8s kills pod processes on delete; the shim's "pods" share
+    # this host, so nohup'd agents survive — kill by pidfile.
+    import signal as signal_lib
+    for pidfile in root.glob('pods/*/.skytpu/runtime/*.pid'):
+        try:
+            os.kill(int(pidfile.read_text().strip()), signal_lib.SIGTERM)
+        except (ValueError, ProcessLookupError, PermissionError):
+            pass
+    from skypilot_tpu.agent import remote as remote_lib
+    for name in list(remote_lib._conns):  # pylint: disable=protected-access
+        remote_lib.drop_connection(name)
+
+
+def test_full_launch_on_kubernetes_pods(k8s_rig):
+    """launch -> queue -> logs -> down, entirely through the kubectl
+    boundary (r3 verdict Next #2's done criterion, end-to-end)."""
+    task = Task('k8sjob', run='echo K8S_E2E_OK')
+    task.set_resources(Resources(cloud='kubernetes', cpus=1))
+    job_id, handle = execution.launch(task, cluster_name='k8e',
+                                      detach_run=True)
+    assert handle.cloud == 'kubernetes'
+    assert handle.region == 'kind-test'
+    # The pod exists in the fake apiserver and carries resource requests.
+    pods = list(k8s_rig.api.pods.values())
+    assert len(pods) == 1
+    assert pods[0]['spec']['containers'][0]['resources']['requests'][
+        'cpu'] == '1.0'
+
+    # Remote control: queue/status answer through the head agent over
+    # the (shim) port-forward tunnel.
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        s = core.job_status('k8e', job_id)
+        if s == 'SUCCEEDED':
+            break
+        assert s in (None, 'PENDING', 'SETTING_UP', 'RUNNING'), s
+        time.sleep(0.5)
+    assert core.job_status('k8e', job_id) == 'SUCCEEDED'
+
+    rows = core.queue('k8e')
+    assert any(r['job_id'] == job_id for r in rows)
+
+    # The job genuinely ran inside the pod sandbox: its log lives under
+    # the pod's HOME, produced by the head-side gang driver.
+    logs = list(k8s_rig.pod_home('k8e').glob(
+        '**/.skytpu/runtime/clusters/k8e/jobs/*/run.log'))
+    if not logs:  # pod name is cluster_name_on_cloud-0-w0
+        logs = list((k8s_rig.root / 'pods').glob(
+            '*/.skytpu/runtime/clusters/*/jobs/*/run.log'))
+    assert logs, list((k8s_rig.root / 'pods').glob('**/*'))[:20]
+    assert 'K8S_E2E_OK' in logs[0].read_text()
+
+    # kubectl was actually exercised: exec (bootstrap + cat port file)
+    # and port-forward (agent tunnel), all against the pinned context.
+    verbs = {c['args'][0] for c in k8s_rig.calls()}
+    assert {'exec', 'port-forward'} <= verbs
+    assert all(c['ctx'] == 'kind-test' for c in k8s_rig.calls())
+
+    core.down('k8e')
+    assert k8s_rig.api.pods == {}

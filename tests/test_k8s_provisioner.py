@@ -57,6 +57,20 @@ def test_generic_run_instances_cpu_pods(fake_k8s):
     assert pod['spec']['containers'][0]['image'] == k8s_instance.DEFAULT_IMAGE
 
 
+def test_identity_labels_survive_display_name_tag(fake_k8s):
+    """Regression (caught by the kubectl e2e): the backend tags every
+    resource with the DISPLAY cluster name under the same
+    'skytpu-cluster' key the lifecycle selectors filter by — identity
+    must win or wait/query/terminate never match their own pods."""
+    cfg = _cfg()
+    cfg.tags = {'skytpu-cluster': 'display-name'}
+    k8s_instance.run_instances(cfg)
+    pod = fake_k8s.pods['k-abc-0-w0']
+    assert pod['metadata']['labels']['skytpu-cluster'] == 'k-abc'
+    assert k8s_instance.query_instances('k-abc') == {
+        'k-abc-0-w0': 'running'}
+
+
 def test_generic_rejects_tpu_requests(fake_k8s):
     cfg = _cfg()
     cfg.node_config['tpu_vm'] = True
