@@ -285,6 +285,27 @@ def doctor(timeout, no_probe, reap, reap_all):
         sys.exit(1)
 
 
+@cli.command()
+@_clean_errors
+def dashboard():
+    """Print (and try to open) the API server's dashboard URL."""
+    from urllib.parse import quote
+
+    from skypilot_tpu.client import sdk as sdk_lib
+    sdk_lib.ensure_server()
+    url = f'{sdk_lib.server_url()}/dashboard'
+    token = os.environ.get('SKYTPU_API_TOKEN')
+    if token:
+        # Percent-encode: URLSearchParams decodes '+' and splits on '&'.
+        url += f'?token={quote(token, safe="")}'
+    click.echo(url)
+    import webbrowser
+    try:
+        webbrowser.open(url)
+    except Exception:  # noqa: BLE001 — headless host: URL printed above
+        pass
+
+
 @cli.command('show-tpus')
 @click.option('--name-filter', default=None)
 @click.option('--region', default=None)
