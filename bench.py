@@ -229,11 +229,21 @@ def _sweep_best_config(candidates, warmup: int = 1, iters: int = 3):
     candidate that fails (HBM OOM on the bigger batches) is recorded and
     skipped — the sweep must never kill the capture. Falls back to the
     first candidate if everything failed (the final measurement will
-    then surface the real error)."""
+    then surface the real error). Wall-clock-budgeted: producing SOME
+    artifact beats finishing the sweep (SKYTPU_BENCH_SWEEP_BUDGET_S)."""
+    try:
+        budget_s = float(
+            os.environ.get('SKYTPU_BENCH_SWEEP_BUDGET_S', '600'))
+    except ValueError:
+        budget_s = 600.0  # malformed env must not kill the capture
+    t0 = time.monotonic()
     results = []
     best = None
     for cand in candidates:
         label = f'{cand.remat_policy}/b{cand.global_batch_size}'
+        if best is not None and time.monotonic() - t0 > budget_s:
+            results.append({'config': label, 'skipped': 'sweep budget'})
+            continue
         try:
             tf, _, _, _ = _measure_step_throughput(cand, warmup, iters)
         except Exception as exc:  # noqa: BLE001 — OOM/compile failure

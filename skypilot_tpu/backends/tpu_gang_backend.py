@@ -123,6 +123,16 @@ class TpuGangBackend(Backend):
         for region, zone in cloud.zones_for(to_provision):
             deploy_vars = cloud.make_deploy_variables(
                 to_provision, name_on_cloud, region, zone, task.num_nodes)
+            if _is_pod_cloud(to_provision.cloud) and task.volumes:
+                # Pods mount PVCs at CREATION (no post-hoc attach like VM
+                # disks): validate and thread the task's volumes into the
+                # pod bodies NOW — sync_volumes runs after provisioning,
+                # too late to stop a missing/stolen claim from being
+                # mounted (pods would hang Pending on a bad claimName,
+                # surfacing as a misleading provision timeout).
+                self._validate_volumes(task.volumes, cluster_name,
+                                       to_provision.cloud)
+                deploy_vars['pod_volumes'] = dict(task.volumes)
             cfg = provision_common.ProvisionConfig(
                 provider_name=to_provision.cloud, region=region, zone=zone,
                 cluster_name=cluster_name,
@@ -384,6 +394,27 @@ class TpuGangBackend(Backend):
                             f'Mounting {st.source} at {dst} failed on '
                             f'{inst.instance_id} (rc={rc})')
 
+    @staticmethod
+    def _validate_volumes(volumes: Dict[str, str], cluster_name: str,
+                          cloud: str) -> None:
+        """Existence + cloud-compatibility + attachment-conflict checks,
+        shared by the pre-provision pod path and sync_volumes."""
+        from skypilot_tpu import global_user_state as _gus
+        for vol_name in volumes.values():
+            vol = _gus.get_volume(vol_name)
+            if vol is None:
+                raise exceptions.StorageError(
+                    f'Volume {vol_name!r} not found.')
+            if cloud in ('gke', 'kubernetes') and \
+                    vol['cloud'] not in ('gke', 'kubernetes'):
+                raise exceptions.StorageError(
+                    f'Volume {vol_name!r} is a {vol["cloud"]} volume; '
+                    f'pods on {cloud} mount PVCs only.')
+            if vol['attached_to'] and vol['attached_to'] != cluster_name:
+                raise exceptions.StorageError(
+                    f'Volume {vol_name!r} is attached to '
+                    f'{vol["attached_to"]!r}; down that cluster first.')
+
     @timeline.event
     def sync_volumes(self, handle: ClusterHandle,
                      volumes: Dict[str, str]) -> None:
@@ -397,17 +428,15 @@ class TpuGangBackend(Backend):
         # Attachment conflicts are rejected up front (a volume attached to
         # another live cluster must not be stolen); the attachment itself
         # is recorded only after mounts succeed.
-        from skypilot_tpu import global_user_state as _gus
-        for vol_name in volumes.values():
-            vol = _gus.get_volume(vol_name)
-            if vol is None:
-                raise exceptions.StorageError(
-                    f'Volume {vol_name!r} not found.')
-            if vol['attached_to'] and \
-                    vol['attached_to'] != handle.cluster_name:
-                raise exceptions.StorageError(
-                    f'Volume {vol_name!r} is attached to '
-                    f'{vol["attached_to"]!r}; down that cluster first.')
+        self._validate_volumes(volumes, handle.cluster_name, handle.cloud)
+        if _is_pod_cloud(handle.cloud):
+            # PVCs were wired into the pod spec at provision time
+            # (pod_volumes deploy var); only the attachment bookkeeping
+            # remains.
+            for vol_name in volumes.values():
+                volumes_lib.record_attachment(vol_name,
+                                              handle.cluster_name)
+            return
         if handle.cloud in ('local', 'fake'):
             for dst, vol_name in volumes.items():
                 dst_local = dst

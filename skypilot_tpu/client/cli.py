@@ -627,12 +627,22 @@ def volumes_group():
 @click.argument('name')
 @click.option('--size', default=100, help='Size in GB.')
 @click.option('--cloud', default='local')
+@click.option('--region', default=None,
+              help='GCP region / kubeconfig context for k8s PVCs.')
 @click.option('--zone', default=None)
-@click.option('--type', 'volume_type', default='pd-balanced')
-def volumes_create(name, size, cloud, zone, volume_type):
+@click.option('--type', 'volume_type', default='pd-balanced',
+              help='GCP disk type / k8s StorageClass name.')
+@click.option('--access-mode', default='ReadWriteOnce', show_default=True,
+              help='k8s PVC access mode (ReadWriteMany for multi-pod '
+                   'clusters, if the StorageClass supports it).')
+@_clean_errors
+def volumes_create(name, size, cloud, region, zone, volume_type,
+                   access_mode):
     from skypilot_tpu import volumes as volumes_lib
-    vol = volumes_lib.create(name, size_gb=size, cloud=cloud, zone=zone,
-                             volume_type=volume_type)
+    vol = volumes_lib.create(name, size_gb=size, cloud=cloud,
+                             region=region, zone=zone,
+                             volume_type=volume_type,
+                             access_mode=access_mode)
     click.echo(f'Created volume {vol["name"]} ({vol["size_gb"]} GB, '
                f'{vol["cloud"]}).')
 

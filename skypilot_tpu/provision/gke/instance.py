@@ -62,6 +62,7 @@ def _pod_body(config: common.ProvisionConfig, node: int, worker: int
     gen = nc['tpu_generation']
     chips_per_host = nc['chips_per_host']
     name = _pod_name(config.cluster_name_on_cloud, node, worker)
+    vol_specs, vol_mounts = k8s_instance.pod_volume_spec(nc)
     return {
         'apiVersion': 'v1',
         'kind': 'Pod',
@@ -78,6 +79,7 @@ def _pod_body(config: common.ProvisionConfig, node: int, worker: int
         },
         'spec': {
             'restartPolicy': 'Never',
+            **({'volumes': vol_specs} if vol_specs else {}),
             'nodeSelector': {
                 'cloud.google.com/gke-tpu-accelerator':
                     GKE_TPU_ACCELERATOR[gen],
@@ -93,6 +95,7 @@ def _pod_body(config: common.ProvisionConfig, node: int, worker: int
                     'requests': {'google.com/tpu': str(chips_per_host)},
                     'limits': {'google.com/tpu': str(chips_per_host)},
                 },
+                **({'volumeMounts': vol_mounts} if vol_mounts else {}),
             }],
         },
     }

@@ -70,6 +70,20 @@ def pod_name(cluster: str, node: int, worker: int) -> str:
     return f'{cluster}-{node}-w{worker}'
 
 
+def pod_volume_spec(nc: Dict[str, Any]):
+    """PVC wiring for a pod body: the task's ``volumes:`` (mount path →
+    volume/claim name, threaded through deploy vars as ``pod_volumes``)
+    become persistentVolumeClaim volumes + volumeMounts — pods cannot
+    mount claims post-hoc the way VMs attach disks."""
+    specs, mounts = [], []
+    for i, (path, claim) in enumerate(
+            sorted((nc.get('pod_volumes') or {}).items())):
+        specs.append({'name': f'vol-{i}',
+                      'persistentVolumeClaim': {'claimName': claim}})
+        mounts.append({'name': f'vol-{i}', 'mountPath': path})
+    return specs, mounts
+
+
 def _cpu_pod_body(config: common.ProvisionConfig, node: int, worker: int
                   ) -> Dict[str, Any]:
     """A plain compute pod: cpu/memory requests, no node selectors —
@@ -80,6 +94,7 @@ def _cpu_pod_body(config: common.ProvisionConfig, node: int, worker: int
         resources['cpu'] = str(nc['cpus'])
     if nc.get('memory'):
         resources['memory'] = f"{nc['memory']}Gi"
+    vol_specs, vol_mounts = pod_volume_spec(nc)
     return {
         'apiVersion': 'v1',
         'kind': 'Pod',
@@ -97,6 +112,7 @@ def _cpu_pod_body(config: common.ProvisionConfig, node: int, worker: int
         },
         'spec': {
             'restartPolicy': 'Never',
+            **({'volumes': vol_specs} if vol_specs else {}),
             'containers': [{
                 'name': 'worker',
                 'image': nc.get('image_id') or DEFAULT_IMAGE,
@@ -106,6 +122,7 @@ def _cpu_pod_body(config: common.ProvisionConfig, node: int, worker: int
                 # ceiling. The kube-scheduler places on requests.
                 **({'resources': {'requests': resources}}
                    if resources else {}),
+                **({'volumeMounts': vol_mounts} if vol_mounts else {}),
             }],
         },
     }

@@ -205,6 +205,22 @@ class K8sClient:
         return self.transport.request(
             'DELETE', f'{self._network_policies()}/{name}')
 
+    def _pvcs(self) -> str:
+        return (f'/api/v1/namespaces/{self.namespace}'
+                '/persistentvolumeclaims')
+
+    def create_pvc(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        return self.transport.request('POST', self._pvcs(), body=body)
+
+    def list_pvcs(self, label_selector: Optional[str] = None
+                  ) -> List[Dict[str, Any]]:
+        params = {'labelSelector': label_selector} if label_selector else None
+        out = self.transport.request('GET', self._pvcs(), params=params)
+        return out.get('items', [])
+
+    def delete_pvc(self, name: str) -> Dict[str, Any]:
+        return self.transport.request('DELETE', f'{self._pvcs()}/{name}')
+
     def pod_events(self, name: str) -> List[Dict[str, Any]]:
         out = self.transport.request(
             'GET', f'/api/v1/namespaces/{self.namespace}/events',

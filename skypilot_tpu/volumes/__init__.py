@@ -5,6 +5,11 @@ disks attached to tasks via a ``volumes:`` task section). TPU-native scope:
 
 * ``gcp``  — persistent disks via the Compute Engine client (created in a
   zone; attach/mount commands are emitted for the cluster's workers).
+* ``kubernetes``/``gke`` — PersistentVolumeClaims in the cluster's
+  namespace; PVCs mount at POD CREATION (the backend threads the task's
+  ``volumes:`` into the pod bodies — pods cannot attach claims post-hoc
+  the way VMs attach disks). Created ReadWriteOnce: single-pod clusters
+  only, unless the cluster's StorageClass provides RWX.
 * ``local``/``fake`` — a host directory stands in for the disk (the same
   in-sandbox substrate the local buckets use), fully functional for tests
   and the local cloud.
@@ -31,7 +36,8 @@ def _local_root(name: str) -> str:
 
 def create(name: str, size_gb: int = 100, cloud: str = 'local',
            region: Optional[str] = None, zone: Optional[str] = None,
-           volume_type: str = 'pd-balanced') -> Dict[str, Any]:
+           volume_type: str = 'pd-balanced',
+           access_mode: str = 'ReadWriteOnce') -> Dict[str, Any]:
     """Create a volume; idempotence is an error (matches the reference's
     volume CRUD semantics)."""
     if global_user_state.get_volume(name) is not None:
@@ -47,9 +53,29 @@ def create(name: str, size_gb: int = 100, cloud: str = 'local',
         client.wait_operation(zone, client.insert_disk(
             zone, name, size_gb=size_gb, disk_type=volume_type))
         backing = f'projects/-/zones/{zone}/disks/{name}'
+    elif cloud in ('kubernetes', 'gke'):
+        from skypilot_tpu.provision.kubernetes import (
+            instance as k8s_instance)
+        client = k8s_instance._client(context=region)  # noqa: SLF001
+        client.create_pvc({
+            'apiVersion': 'v1',
+            'kind': 'PersistentVolumeClaim',
+            'metadata': {'name': name,
+                         'labels': {'skytpu-volume': name}},
+            'spec': {
+                # ReadWriteMany (with an RWX-capable StorageClass) is
+                # required for multi-pod clusters sharing the claim.
+                'accessModes': [access_mode],
+                'resources': {'requests': {'storage': f'{size_gb}Gi'}},
+                **({'storageClassName': volume_type}
+                   if volume_type not in ('pd-balanced', '') else {}),
+            },
+        })
+        backing = f'pvc/{client.namespace}/{name}'
     else:
         raise exceptions.NotSupportedError(
-            f'Volumes on {cloud!r} not supported (gcp/local/fake).')
+            f'Volumes on {cloud!r} not supported '
+            '(gcp/kubernetes/gke/local/fake).')
     global_user_state.add_volume(name, cloud, region, zone, size_gb,
                                  volume_type, backing)
     return global_user_state.get_volume(name)
@@ -75,6 +101,11 @@ def delete(name: str) -> None:
         client = gcp_instance._compute_client()  # pylint: disable=protected-access
         client.wait_operation(vol['zone'],
                               client.delete_disk(vol['zone'], vol['name']))
+    elif vol['cloud'] in ('kubernetes', 'gke'):
+        from skypilot_tpu.provision.kubernetes import (
+            instance as k8s_instance)
+        client = k8s_instance._client(context=vol['region'])  # noqa: SLF001
+        client.delete_pvc(vol['name'])
     global_user_state.remove_volume(name)
 
 

@@ -21,6 +21,7 @@ class FakeK8sApi:
         self.pods = {}  # name -> pod dict
         self.services = {}  # name -> service dict
         self.network_policies = {}  # name -> policy dict
+        self.pvcs = {}  # name -> pvc dict
         self.schedulable = True
         self.quota_error = False
         self.calls = []
@@ -72,10 +73,28 @@ class FakeK8sApi:
             return {}
         raise AssertionError(f'unhandled service {method} {name}')
 
+    def _handle_pvcs(self, method, name, body, params):
+        del params
+        if method == 'POST':
+            self.pvcs[body['metadata']['name']] = dict(body)
+            return body
+        if method == 'GET' and name is None:
+            return {'items': list(self.pvcs.values())}
+        if method == 'DELETE':
+            self.pvcs.pop(name, None)
+            return {}
+        raise AssertionError(f'unhandled pvc {method} {name}')
+
     def request(self, method, path, body=None, params=None):
         self.calls.append((method, path))
         if path.endswith('/events'):
             return {'items': []}
+        mp = re.match(
+            r'/api/v1/namespaces/(?P<ns>[^/]+)/persistentvolumeclaims'
+            r'(/(?P<name>.+))?$', path)
+        if mp:
+            return self._handle_pvcs(method, mp.group('name'), body,
+                                     params)
         ms = re.match(
             r'/api/v1/namespaces/(?P<ns>[^/]+)/services(/(?P<name>.+))?$',
             path)

@@ -144,6 +144,19 @@ def k8s_rig(tmp_path, monkeypatch, tmp_state_dir):
         remote_lib.drop_connection(name)
 
 
+def test_missing_pod_volume_fails_before_provision(k8s_rig):
+    """A bad volumes: entry must fail with a clean StorageError BEFORE
+    any pod is created (a pod referencing a missing claim hangs Pending
+    and would surface as a misleading provision timeout)."""
+    from skypilot_tpu import exceptions
+    task = Task('voljob', run='true')
+    task.set_resources(Resources(cloud='kubernetes', cpus=1))
+    task.volumes = {'/mnt/x': 'does-not-exist'}
+    with pytest.raises(exceptions.StorageError, match='not found'):
+        execution.launch(task, cluster_name='k8v', detach_run=True)
+    assert k8s_rig.api.pods == {}
+
+
 def test_full_launch_on_kubernetes_pods(k8s_rig):
     """launch -> queue -> logs -> down, entirely through the kubectl
     boundary (r3 verdict Next #2's done criterion, end-to-end)."""

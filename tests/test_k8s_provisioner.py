@@ -100,6 +100,30 @@ def test_generic_unschedulable_maps_to_quota(fake_k8s):
     assert fake_k8s.pods == {}  # cleaned up for failover
 
 
+def test_pvc_volumes_create_mount_delete(fake_k8s, tmp_state_dir):
+    """k8s volumes are PersistentVolumeClaims, mounted into pod specs at
+    creation (reference: sky/volumes/ k8s PVC support)."""
+    from skypilot_tpu import volumes as volumes_lib
+    vol = volumes_lib.create('scratch', size_gb=50, cloud='kubernetes',
+                             region='kind-skytpu')
+    assert vol['backing'] == 'pvc/default/scratch'
+    pvc = fake_k8s.pvcs['scratch']
+    assert pvc['spec']['resources']['requests']['storage'] == '50Gi'
+    # Pod body wiring: the task's volumes become claim mounts.
+    cfg = _cfg()
+    cfg.node_config['pod_volumes'] = {'/mnt/scratch': 'scratch'}
+    k8s_instance.run_instances(cfg)
+    pod = fake_k8s.pods['k-abc-0-w0']
+    assert pod['spec']['volumes'] == [
+        {'name': 'vol-0', 'persistentVolumeClaim': {'claimName': 'scratch'}}]
+    assert pod['spec']['containers'][0]['volumeMounts'] == [
+        {'name': 'vol-0', 'mountPath': '/mnt/scratch'}]
+    # Delete removes the claim and the record.
+    volumes_lib.delete('scratch')
+    assert 'scratch' not in fake_k8s.pvcs
+    assert volumes_lib.list_volumes() == []
+
+
 def test_generic_open_ports_service(fake_k8s):
     k8s_instance.run_instances(_cfg())
     k8s_instance.open_ports('k-abc', [8080])
