@@ -132,6 +132,21 @@ class TpuGangBackend(Backend):
                 # surfacing as a misleading provision timeout).
                 self._validate_volumes(task.volumes, cluster_name,
                                        to_provision.cloud)
+                total_pods = task.num_nodes * int(
+                    deploy_vars.get('hosts_per_slice') or 1)
+                if total_pods > 1:
+                    from skypilot_tpu import global_user_state as _gus
+                    for vol_name in task.volumes.values():
+                        vol = _gus.get_volume(vol_name)
+                        mode = (vol.get('access_mode')
+                                or 'ReadWriteOnce') if vol else ''
+                        if mode == 'ReadWriteOnce':
+                            raise exceptions.StorageError(
+                                f'Volume {vol_name!r} is ReadWriteOnce '
+                                f'but the cluster has {total_pods} pods; '
+                                'create it with --access-mode '
+                                'ReadWriteMany (needs an RWX '
+                                'StorageClass).')
                 deploy_vars['pod_volumes'] = dict(task.volumes)
             cfg = provision_common.ProvisionConfig(
                 provider_name=to_provision.cloud, region=region, zone=zone,
@@ -394,22 +409,35 @@ class TpuGangBackend(Backend):
                             f'Mounting {st.source} at {dst} failed on '
                             f'{inst.instance_id} (rc={rc})')
 
-    @staticmethod
-    def _validate_volumes(volumes: Dict[str, str], cluster_name: str,
+    # Which volume backings each cluster family can mount. BOTH
+    # directions matter: a PVC volume on a gcp cluster would hit the
+    # attach-disk API with a nonexistent disk, and on a local cluster
+    # mount_command's device branch would try to mkfs a host path.
+    _VOLUME_CLOUD_FAMILIES = {
+        'gke': ('gke', 'kubernetes'),
+        'kubernetes': ('gke', 'kubernetes'),
+        'gcp': ('gcp',),
+        'local': ('local', 'fake'),
+        'fake': ('local', 'fake'),
+    }
+
+    @classmethod
+    def _validate_volumes(cls, volumes: Dict[str, str], cluster_name: str,
                           cloud: str) -> None:
         """Existence + cloud-compatibility + attachment-conflict checks,
         shared by the pre-provision pod path and sync_volumes."""
         from skypilot_tpu import global_user_state as _gus
+        allowed = cls._VOLUME_CLOUD_FAMILIES.get(cloud, ())
         for vol_name in volumes.values():
             vol = _gus.get_volume(vol_name)
             if vol is None:
                 raise exceptions.StorageError(
                     f'Volume {vol_name!r} not found.')
-            if cloud in ('gke', 'kubernetes') and \
-                    vol['cloud'] not in ('gke', 'kubernetes'):
+            if vol['cloud'] not in allowed:
                 raise exceptions.StorageError(
-                    f'Volume {vol_name!r} is a {vol["cloud"]} volume; '
-                    f'pods on {cloud} mount PVCs only.')
+                    f'Volume {vol_name!r} is backed by {vol["cloud"]!r} '
+                    f'and cannot mount on a {cloud!r} cluster '
+                    f'(supported there: {allowed or "none"}).')
             if vol['attached_to'] and vol['attached_to'] != cluster_name:
                 raise exceptions.StorageError(
                     f'Volume {vol_name!r} is attached to '
@@ -430,9 +458,22 @@ class TpuGangBackend(Backend):
         # is recorded only after mounts succeed.
         self._validate_volumes(volumes, handle.cluster_name, handle.cloud)
         if _is_pod_cloud(handle.cloud):
-            # PVCs were wired into the pod spec at provision time
-            # (pod_volumes deploy var); only the attachment bookkeeping
-            # remains.
+            # PVCs mount at pod CREATION only. Verify the live pods
+            # actually carry every requested claim: re-using an UP
+            # cluster whose pods were created without them would
+            # otherwise silently record an attachment while the job
+            # writes to ephemeral container storage (data loss on down).
+            from skypilot_tpu.provision.kubernetes import (
+                instance as k8s_instance)
+            mounted = k8s_instance.mounted_claims(
+                handle.cluster_name_on_cloud, handle.provider_config)
+            missing = sorted(set(volumes.values()) - mounted)
+            if missing:
+                raise exceptions.StorageError(
+                    f'Pods of cluster {handle.cluster_name!r} do not '
+                    f'mount claim(s) {missing} — pods cannot attach '
+                    'volumes after creation. Relaunch on a fresh '
+                    'cluster (or `down` this one first).')
             for vol_name in volumes.values():
                 volumes_lib.record_attachment(vol_name,
                                               handle.cluster_name)

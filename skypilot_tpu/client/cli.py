@@ -655,8 +655,10 @@ def volumes_ls():
         click.echo('No volumes.')
         return
     for v in vols:
+        mode = v.get('access_mode') or 'ReadWriteOnce'
         click.echo(f'{v["name"]:24s} {v["cloud"]:8s} {v["size_gb"]:>6d}GB '
-                   f'{v["status"]:8s} attached={v["attached_to"] or "-"}')
+                   f'{v["status"]:8s} {mode:14s} '
+                   f'attached={v["attached_to"] or "-"}')
 
 
 @volumes_group.command('rm')

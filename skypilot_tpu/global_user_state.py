@@ -63,7 +63,8 @@ CREATE TABLE IF NOT EXISTS volumes (
     status TEXT,
     created_at REAL,
     attached_to TEXT,
-    backing TEXT
+    backing TEXT,
+    access_mode TEXT DEFAULT 'ReadWriteOnce'
 );
 CREATE TABLE IF NOT EXISTS workspaces (
     name TEXT PRIMARY KEY,
@@ -75,14 +76,15 @@ CREATE TABLE IF NOT EXISTS workspaces (
 
 def add_volume(name: str, cloud: str, region: Optional[str],
                zone: Optional[str], size_gb: int, volume_type: str,
-               backing: str) -> None:
+               backing: str,
+               access_mode: str = 'ReadWriteOnce') -> None:
     with _lock(), _conn() as conn:
         conn.execute(
             'INSERT INTO volumes (name, cloud, region, zone, size_gb, '
-            'volume_type, status, created_at, backing) '
-            'VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)',
+            'volume_type, status, created_at, backing, access_mode) '
+            'VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)',
             (name, cloud, region, zone, size_gb, volume_type, 'READY',
-             time.time(), backing))
+             time.time(), backing, access_mode))
 
 
 def get_volume(name: str) -> Optional[Dict[str, Any]]:
@@ -116,9 +118,11 @@ def _conn():
     from skypilot_tpu.utils import db_utils
     return db_utils.connect(
         _db_path(), _SCHEMA,
-        migrations=(  # pre-workspace databases
+        migrations=(  # pre-workspace / pre-access-mode databases
             "ALTER TABLE clusters ADD COLUMN workspace TEXT "
-            "DEFAULT 'default'",))
+            "DEFAULT 'default'",
+            "ALTER TABLE volumes ADD COLUMN access_mode TEXT "
+            "DEFAULT 'ReadWriteOnce'"))
 
 
 def _lock() -> filelock.FileLock:

@@ -337,6 +337,23 @@ def get_cluster_info(region: str, cluster_name_on_cloud: str,
         ssh_user='root', ssh_key_path=None)
 
 
+def mounted_claims(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None
+                   ) -> set:
+    """PVC claim names mounted by the cluster's live pods (the backend
+    verifies a reused cluster actually carries a requested volume —
+    pods cannot attach claims post-creation)."""
+    client = client_from_provider_config(provider_config)
+    claims = set()
+    for pod in client.list_pods(f'{LABEL_CLUSTER}={cluster_name_on_cloud}'):
+        for vol in pod.get('spec', {}).get('volumes', []) or []:
+            claim = (vol.get('persistentVolumeClaim') or {}).get(
+                'claimName')
+            if claim:
+                claims.add(claim)
+    return claims
+
+
 def open_ports(cluster_name_on_cloud: str, ports: List[int],
                provider_config: Optional[Dict[str, Any]] = None) -> None:
     """Expose ports on the head pod via a k8s Service (reference analog:

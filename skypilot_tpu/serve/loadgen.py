@@ -70,6 +70,9 @@ async def run_load(url: str, requests_total: int, concurrency: int,
         'wall_s': round(wall, 3),
         'new_tokens': new_tokens,
         'decode_tokens_per_sec': round(new_tokens / wall, 1) if wall else 0,
+        # The reference's JetStream recipe also quotes req/s (11.42 on
+        # v6e, examples/tpu/v6e/README.md:112-118).
+        'requests_per_sec': round(len(oks) / wall, 2) if wall else 0,
         'p50_latency_s': round(lats[len(lats) // 2], 3) if lats else None,
         # ceil(q*n)-1: the standard nearest-rank percentile index —
         # int(0.95*n) would report the MAX for every n <= 20.

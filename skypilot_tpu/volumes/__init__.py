@@ -42,6 +42,12 @@ def create(name: str, size_gb: int = 100, cloud: str = 'local',
     volume CRUD semantics)."""
     if global_user_state.get_volume(name) is not None:
         raise exceptions.StorageError(f'Volume {name!r} already exists.')
+    if access_mode != 'ReadWriteOnce' and cloud not in ('kubernetes',
+                                                        'gke'):
+        # Silently dropping the flag would misrepresent what was built.
+        raise exceptions.NotSupportedError(
+            f'access_mode={access_mode!r} applies to k8s PVCs only; '
+            f'{cloud!r} volumes are single-attach block devices.')
     if cloud in ('local', 'fake'):
         backing = _local_root(name)
         os.makedirs(backing, exist_ok=True)
@@ -77,7 +83,8 @@ def create(name: str, size_gb: int = 100, cloud: str = 'local',
             f'Volumes on {cloud!r} not supported '
             '(gcp/kubernetes/gke/local/fake).')
     global_user_state.add_volume(name, cloud, region, zone, size_gb,
-                                 volume_type, backing)
+                                 volume_type, backing,
+                                 access_mode=access_mode)
     return global_user_state.get_volume(name)
 
 

@@ -202,5 +202,32 @@ def test_full_launch_on_kubernetes_pods(k8s_rig):
     assert {'exec', 'port-forward'} <= verbs
     assert all(c['ctx'] == 'kind-test' for c in k8s_rig.calls())
 
+    # exec onto the live cluster: second job through the same agent
+    # path, no re-provision (pod count unchanged).
+    task2 = Task('k8sjob2', run='echo K8S_EXEC_OK')
+    job2, _ = execution.exec_(task2, 'k8e', detach_run=True)
+    assert job2 != job_id
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if core.job_status('k8e', job2) == 'SUCCEEDED':
+            break
+        time.sleep(0.5)
+    assert core.job_status('k8e', job2) == 'SUCCEEDED'
+    assert len(k8s_rig.api.pods) == 1
+
+    # Reuse hazard: pods were created WITHOUT volumes — launching a
+    # volume-bearing task onto the live cluster must refuse (pods
+    # cannot attach claims post-creation; silently recording the
+    # attachment would be data loss on down).
+    from skypilot_tpu import exceptions as exc
+    from skypilot_tpu import volumes as volumes_lib
+    volumes_lib.create('latevol', cloud='kubernetes')
+    task3 = Task('voljob', run='true')
+    task3.set_resources(Resources(cloud='kubernetes', cpus=1))
+    task3.volumes = {'/mnt/v': 'latevol'}
+    with pytest.raises(exc.StorageError, match='cannot attach'):
+        execution.launch(task3, cluster_name='k8e', detach_run=True)
+    volumes_lib.delete('latevol')
+
     core.down('k8e')
     assert k8s_rig.api.pods == {}

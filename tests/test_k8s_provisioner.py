@@ -124,6 +124,40 @@ def test_pvc_volumes_create_mount_delete(fake_k8s, tmp_state_dir):
     assert volumes_lib.list_volumes() == []
 
 
+def test_pvc_access_mode_persisted_and_guarded(fake_k8s, tmp_state_dir):
+    from skypilot_tpu import exceptions as exc
+    from skypilot_tpu import volumes as volumes_lib
+    vol = volumes_lib.create('shared', cloud='kubernetes',
+                             access_mode='ReadWriteMany')
+    assert vol['access_mode'] == 'ReadWriteMany'
+    assert fake_k8s.pvcs['shared']['spec']['accessModes'] == [
+        'ReadWriteMany']
+    # Non-k8s clouds must not silently drop the flag.
+    with pytest.raises(exc.NotSupportedError, match='PVCs only'):
+        volumes_lib.create('bad', cloud='local',
+                           access_mode='ReadWriteMany')
+    volumes_lib.delete('shared')
+
+
+def test_volume_cloud_family_rejection(fake_k8s, tmp_state_dir):
+    """A PVC volume on a non-pod cluster (and vice versa) is rejected
+    with a clean StorageError, not a downstream provider API error."""
+    from skypilot_tpu import exceptions as exc
+    from skypilot_tpu import volumes as volumes_lib
+    from skypilot_tpu.backends.tpu_gang_backend import TpuGangBackend
+    volumes_lib.create('pvcvol', cloud='kubernetes')
+    with pytest.raises(exc.StorageError, match='cannot mount'):
+        TpuGangBackend._validate_volumes(
+            {'/mnt': 'pvcvol'}, 'c1', 'local')
+    with pytest.raises(exc.StorageError, match='cannot mount'):
+        TpuGangBackend._validate_volumes(
+            {'/mnt': 'pvcvol'}, 'c1', 'gcp')
+    # Correct family passes.
+    TpuGangBackend._validate_volumes({'/mnt': 'pvcvol'}, 'c1',
+                                     'kubernetes')
+    volumes_lib.delete('pvcvol')
+
+
 def test_generic_open_ports_service(fake_k8s):
     k8s_instance.run_instances(_cfg())
     k8s_instance.open_ports('k-abc', [8080])
