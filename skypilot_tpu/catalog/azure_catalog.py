@@ -1,0 +1,95 @@
+"""Azure catalog queries: VM sizes for CPU work.
+
+Reference analog: ``sky/catalog/azure_catalog.py`` — lazy CSV frames
+with price/zone filtering. Azure carries no TPUs; like the AWS catalog
+this exists so controllers, CPU tasks, and storage-adjacent work can
+land on Azure VMs (we already speak Azure Blob natively) and the
+optimizer can fail over across all three vendors.
+
+Azure zone note: availability zones are per-subscription logical labels
+('1'/'2'/'3') scoped to a region — unlike EC2's region-prefixed zone
+names, a bare zone does not identify its region, so ``validate`` needs
+the region when a zone is given.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import pandas as pd
+
+from skypilot_tpu.catalog import common
+
+_vm_df = common.LazyDataFrame('azure/vms.csv',
+                              str_columns=('AvailabilityZone',))
+
+
+def get_instance_type_for_cpus(
+        cpus: Optional[float], cpus_at_least: bool,
+        memory: Optional[float], memory_at_least: bool,
+        region: Optional[str] = None,
+        use_spot: bool = False) -> Optional[dict]:
+    """Smallest/cheapest VM satisfying a cpus/memory request (defaults to
+    4+ vCPUs when unspecified, mirroring ``gcp_catalog``)."""
+    df = _vm_df.df
+    if region:
+        df = df[df['Region'] == region]
+    want_cpus = cpus if cpus is not None else 4.0
+    if cpus_at_least or cpus is None:
+        df = df[df['vCPUs'] >= want_cpus]
+    else:
+        df = df[df['vCPUs'] == want_cpus]
+    if memory is not None:
+        if memory_at_least:
+            df = df[df['MemoryGiB'] >= memory]
+        else:
+            df = df[df['MemoryGiB'] == memory]
+    row = common.cheapest_row(df, use_spot)
+    return None if row is None else row.to_dict()
+
+
+def get_vm_offerings(instance_type: str, region: Optional[str] = None,
+                     zone: Optional[str] = None,
+                     use_spot: bool = False) -> List[dict]:
+    df = common.filter_df(_vm_df.df, InstanceType=instance_type,
+                          Region=region,
+                          AvailabilityZone=None if zone is None
+                          else str(zone))
+    col = 'SpotPrice' if use_spot else 'Price'
+    df = df[df[col].notna()].sort_values(col)
+    return df.to_dict('records')
+
+
+def instance_type_exists(instance_type: str) -> bool:
+    return bool((_vm_df.df['InstanceType'] == instance_type).any())
+
+
+def get_vcpus_mem_from_instance_type(
+        instance_type: str) -> Tuple[Optional[float], Optional[float]]:
+    rows = _vm_df.df[_vm_df.df['InstanceType'] == instance_type]
+    if rows.empty:
+        return None, None
+    r = rows.iloc[0]
+    return float(r['vCPUs']), float(r['MemoryGiB'])
+
+
+def validate_region_zone(
+        region: Optional[str],
+        zone: Optional[str]) -> Tuple[Optional[str], Optional[str]]:
+    df = _vm_df.df[['Region', 'AvailabilityZone']]
+    if region is not None and not (df['Region'] == region).any():
+        raise ValueError(f'Unknown Azure region {region!r}')
+    if zone is not None:
+        if region is None:
+            raise ValueError(
+                f'Azure zone {zone!r} needs a region: zones are logical '
+                "labels ('1'/'2'/'3') scoped per region.")
+        rows = df[(df['Region'] == region)
+                  & (df['AvailabilityZone'].astype(str) == str(zone))]
+        if rows.empty:
+            raise ValueError(f'Unknown Azure zone {zone!r} in {region!r}')
+        return region, str(zone)
+    return region, zone
+
+
+def regions() -> pd.DataFrame:
+    return _vm_df.df[['Region', 'AvailabilityZone']].drop_duplicates()

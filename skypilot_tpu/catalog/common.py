@@ -40,8 +40,13 @@ def catalog_path(filename: str) -> str:
 class LazyDataFrame:
     """Loads a catalog CSV on first access; thread-safe; reload on mtime bump."""
 
-    def __init__(self, filename: str):
+    def __init__(self, filename: str,
+                 str_columns: Optional[tuple] = None):
         self._filename = filename
+        # Columns forced to str after load: zone-like labels ('1'/'2'/'3'
+        # on Azure) parse as int64 and then silently fail every equality
+        # filter against the user's string zone.
+        self._str_columns = str_columns or ()
         self._df: Optional[pd.DataFrame] = None
         self._mtime: Optional[float] = None
         self._lock = threading.Lock()
@@ -69,6 +74,8 @@ class LazyDataFrame:
                 for col in df.columns:
                     if str(df[col].dtype) == 'str':
                         df[col] = df[col].astype(object)
+                for col in self._str_columns:
+                    df[col] = df[col].astype(str).astype(object)
                 self._df = df
                 self._mtime = mtime
             return self._df

@@ -1,0 +1,75 @@
+"""Generate the Azure VM catalog CSV.
+
+Reference analog: ``sky/catalog/data_fetchers/fetch_azure.py`` — which
+crawls the Azure Retail Prices API. Same structure as ``fetch_aws.py``:
+public pay-as-you-go list prices (eastus, USD/hr) as configuration data,
+expanded over regions with a price multiplier; in an environment with
+network access this is where a live pricing crawl slots in.
+
+Run ``python -m skypilot_tpu.catalog.data_fetchers.fetch_azure`` to
+regenerate ``skypilot_tpu/catalog/data/azure/vms.csv`` (idempotent).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Tuple
+
+from skypilot_tpu.catalog.data_fetchers.common import write_csv
+
+OUT_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                       'data', 'azure')
+
+# (VM size, vCPUs, memory GiB, pay-as-you-go USD/hr in eastus).
+# Dsv5 (general), Esv5 (memory-opt), Fsv2 (compute-opt) — the CPU shapes
+# controllers and CPU tasks actually use.
+SHAPES: List[Tuple[str, int, int, float]] = [
+    ('Standard_D2s_v5', 2, 8, 0.096),
+    ('Standard_D4s_v5', 4, 16, 0.192),
+    ('Standard_D8s_v5', 8, 32, 0.384),
+    ('Standard_D16s_v5', 16, 64, 0.768),
+    ('Standard_D32s_v5', 32, 128, 1.536),
+    ('Standard_E2s_v5', 2, 16, 0.126),
+    ('Standard_E4s_v5', 4, 32, 0.252),
+    ('Standard_E8s_v5', 8, 64, 0.504),
+    ('Standard_F2s_v2', 2, 4, 0.0846),
+    ('Standard_F4s_v2', 4, 8, 0.1692),
+    ('Standard_F16s_v2', 16, 32, 0.677),
+]
+
+# (region, price multiplier vs eastus, availability zones offered).
+REGIONS: List[Tuple[str, float, List[str]]] = [
+    ('eastus', 1.0, ['1', '2', '3']),
+    ('westus2', 1.0, ['1', '2', '3']),
+    ('westeurope', 1.13, ['1', '2', '3']),
+]
+
+SPOT_DISCOUNT = 0.22  # typical sustained spot/PAYG ratio on Dsv5
+
+
+def generate_vm_rows() -> List[dict]:
+    rows = []
+    for name, vcpus, mem, base in SHAPES:
+        for region, mult, zones in REGIONS:
+            for zone in zones:
+                price = round(base * mult, 6)
+                rows.append({
+                    'InstanceType': name,
+                    'vCPUs': vcpus,
+                    'MemoryGiB': mem,
+                    'Region': region,
+                    'AvailabilityZone': zone,
+                    'Price': price,
+                    'SpotPrice': round(price * SPOT_DISCOUNT, 6),
+                })
+    return rows
+
+
+def main() -> None:
+    rows = generate_vm_rows()
+    path = os.path.join(OUT_DIR, 'vms.csv')
+    write_csv(path, rows)
+    print(f'Wrote {len(rows)} Azure VM rows to {path}')
+
+
+if __name__ == '__main__':
+    main()

@@ -5,32 +5,26 @@ provider. The TPU-native charter keeps accelerators on GCP-family infra;
 AWS is the proof that the cloud abstraction generalizes beyond one vendor:
 jobs/serve controllers and CPU tasks place here, and the optimizer fails
 over GCP<->AWS on capacity/quota errors exactly as it does across GCP
-zones.
+zones. Planning logic is the shared catalog-VM base
+(``clouds/catalog_vm.py``).
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Iterator, List, Optional, Tuple
+from typing import Optional, Tuple
 
-from skypilot_tpu.catalog import aws_catalog
-from skypilot_tpu.clouds import cloud as cloud_lib
-from skypilot_tpu.resources import Resources
+from skypilot_tpu.clouds.catalog_vm import CatalogVmCloud
 from skypilot_tpu.utils.registry import CLOUD_REGISTRY
-
-Features = cloud_lib.CloudImplementationFeatures
 
 
 @CLOUD_REGISTRY.register
-class AWS(cloud_lib.Cloud):
+class AWS(CatalogVmCloud):
 
     _REPR = 'aws'
 
     @classmethod
-    def supported_features(cls) -> set:
-        return {
-            Features.MULTI_NODE, Features.SPOT_INSTANCE, Features.STOP,
-            Features.AUTOSTOP, Features.OPEN_PORTS,
-            Features.STORAGE_MOUNTING, Features.CUSTOM_DISK_SIZE,
-        }
+    def _catalog(cls):
+        from skypilot_tpu.catalog import aws_catalog
+        return aws_catalog
 
     @classmethod
     def check_credentials(cls) -> Tuple[bool, Optional[str]]:
@@ -46,78 +40,6 @@ class AWS(cloud_lib.Cloud):
             return True, None
         except exceptions.NoCloudAccessError as e:
             return False, str(e)
-
-    def regions(self) -> List[cloud_lib.Region]:
-        df = aws_catalog.regions()
-        out: Dict[str, List[str]] = {}
-        for _, row in df.iterrows():
-            out.setdefault(row['Region'], [])
-            if row['AvailabilityZone'] not in out[row['Region']]:
-                out[row['Region']].append(row['AvailabilityZone'])
-        return [cloud_lib.Region(name=r, zones=z)
-                for r, z in sorted(out.items())]
-
-    def zones_for(self, resources: Resources) -> Iterator[Tuple[str, str]]:
-        assert resources.instance_type is not None, resources
-        rows = aws_catalog.get_vm_offerings(
-            resources.instance_type, region=resources.region,
-            zone=resources.zone, use_spot=resources.use_spot)
-        for row in rows:
-            yield row['Region'], row['AvailabilityZone']
-
-    def get_feasible_launchable_resources(
-            self, resources: Resources) -> List[Resources]:
-        if resources.cloud is not None and resources.cloud != self._REPR:
-            return []
-        # No accelerators on this provider: TPU (and GPU) requests are
-        # infeasible here and fail over to the TPU clouds.
-        if resources.tpu is not None or \
-                resources.accelerator_name is not None:
-            return []
-        if resources.instance_type is not None:
-            rows = aws_catalog.get_vm_offerings(
-                resources.instance_type, region=resources.region,
-                zone=resources.zone, use_spot=resources.use_spot)
-            seen_regions = set()
-            out: List[Resources] = []
-            for row in rows:
-                if row['Region'] in seen_regions:
-                    continue
-                seen_regions.add(row['Region'])
-                price = row['SpotPrice' if resources.use_spot else 'Price']
-                out.append(resources.copy(
-                    cloud=self._REPR, region=row['Region'],
-                    _price_per_hour=float(price)))
-            return out
-        cpus, cpus_plus = resources.cpus_requirement()
-        mem, mem_plus = resources.memory_requirement()
-        row = aws_catalog.get_instance_type_for_cpus(
-            cpus, cpus_plus, mem, mem_plus, region=resources.region,
-            use_spot=resources.use_spot)
-        if row is None:
-            return []
-        price = row['SpotPrice' if resources.use_spot else 'Price']
-        return [resources.copy(
-            cloud=self._REPR, region=row['Region'],
-            instance_type=row['InstanceType'],
-            _price_per_hour=float(price))]
-
-    def make_deploy_variables(self, resources: Resources,
-                              cluster_name_on_cloud: str,
-                              region: str, zone: Optional[str],
-                              num_nodes: int) -> Dict[str, Any]:
-        return {
-            'cluster_name_on_cloud': cluster_name_on_cloud,
-            'region': region,
-            'zone': zone,
-            'use_spot': resources.use_spot,
-            'disk_size_gb': resources.disk_size,
-            'labels': resources.labels,
-            'num_nodes': num_nodes,
-            'tpu_vm': False,
-            'instance_type': resources.instance_type,
-            'image_id': resources.image_id,
-        }
 
     @property
     def provisioner_module(self) -> str:

@@ -1,0 +1,45 @@
+"""Azure cloud: ARM VMs (controllers, CPU tasks, cross-cloud failover).
+
+Reference analog: ``sky/clouds/azure.py``. Third compute vendor after
+GCP and AWS: the TPU-native charter keeps accelerators on GCP-family
+infra; Azure rounds out the cross-cloud story (we already speak Azure
+Blob natively in ``data/storage.py``) — controllers and CPU tasks place
+here, and the optimizer fails over GCP<->AWS<->Azure on capacity
+errors. Planning logic is the shared catalog-VM base
+(``clouds/catalog_vm.py``).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from skypilot_tpu.clouds.catalog_vm import CatalogVmCloud
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+
+@CLOUD_REGISTRY.register
+class Azure(CatalogVmCloud):
+
+    _REPR = 'azure'
+
+    @classmethod
+    def _catalog(cls):
+        from skypilot_tpu.catalog import azure_catalog
+        return azure_catalog
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        """Env check only (like AWS's): API reachability is validated at
+        first provision. Delegates to the ARM client's loader so `check`
+        and provisioning agree on what counts as credentials (the
+        standard AZURE_* service-principal env quartet)."""
+        from skypilot_tpu import exceptions
+        from skypilot_tpu.provision.azure import arm_client
+        try:
+            arm_client.load_credentials()
+            return True, None
+        except exceptions.NoCloudAccessError as e:
+            return False, str(e)
+
+    @property
+    def provisioner_module(self) -> str:
+        return 'skypilot_tpu.provision.azure'

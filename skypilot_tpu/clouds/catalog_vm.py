@@ -1,0 +1,110 @@
+"""Shared base for CPU-VM vendors backed by a ``vms.csv`` catalog.
+
+AWS and Azure (and any future plain-VM provider) differ only in
+credentials and provisioner; their planning logic — region enumeration,
+price-ranked zone iteration, cheapest-type feasibility, the no-
+accelerators rule — is identical, parameterized by the catalog module.
+Keeping it here means a catalog-layer fix lands once, not per vendor
+(reference analog: ``sky/clouds/cloud.py`` shares the same role for its
+25 providers).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.resources import Resources
+
+Features = cloud_lib.CloudImplementationFeatures
+
+
+class CatalogVmCloud(cloud_lib.Cloud):
+    """Catalog-driven CPU-VM cloud. Subclasses set ``_REPR``, point
+    ``_catalog()`` at their catalog module, and implement
+    ``check_credentials`` + ``provisioner_module``."""
+
+    @classmethod
+    def _catalog(cls):
+        raise NotImplementedError
+
+    @classmethod
+    def supported_features(cls) -> set:
+        return {
+            Features.MULTI_NODE, Features.SPOT_INSTANCE, Features.STOP,
+            Features.AUTOSTOP, Features.OPEN_PORTS,
+            Features.STORAGE_MOUNTING, Features.CUSTOM_DISK_SIZE,
+        }
+
+    def regions(self) -> List[cloud_lib.Region]:
+        df = self._catalog().regions()
+        out: Dict[str, List[str]] = {}
+        for _, row in df.iterrows():
+            out.setdefault(row['Region'], [])
+            zone = str(row['AvailabilityZone'])
+            if zone not in out[row['Region']]:
+                out[row['Region']].append(zone)
+        return [cloud_lib.Region(name=r, zones=z)
+                for r, z in sorted(out.items())]
+
+    def zones_for(self, resources: Resources) -> Iterator[Tuple[str, str]]:
+        assert resources.instance_type is not None, resources
+        rows = self._catalog().get_vm_offerings(
+            resources.instance_type, region=resources.region,
+            zone=resources.zone, use_spot=resources.use_spot)
+        for row in rows:
+            yield row['Region'], str(row['AvailabilityZone'])
+
+    def get_feasible_launchable_resources(
+            self, resources: Resources) -> List[Resources]:
+        if resources.cloud is not None and resources.cloud != self._REPR:
+            return []
+        # No accelerators on these providers: TPU (and GPU) requests are
+        # infeasible here and fail over to the TPU clouds.
+        if resources.tpu is not None or \
+                resources.accelerator_name is not None:
+            return []
+        catalog = self._catalog()
+        if resources.instance_type is not None:
+            rows = catalog.get_vm_offerings(
+                resources.instance_type, region=resources.region,
+                zone=resources.zone, use_spot=resources.use_spot)
+            seen_regions = set()
+            out: List[Resources] = []
+            for row in rows:
+                if row['Region'] in seen_regions:
+                    continue
+                seen_regions.add(row['Region'])
+                price = row['SpotPrice' if resources.use_spot else 'Price']
+                out.append(resources.copy(
+                    cloud=self._REPR, region=row['Region'],
+                    _price_per_hour=float(price)))
+            return out
+        cpus, cpus_plus = resources.cpus_requirement()
+        mem, mem_plus = resources.memory_requirement()
+        row = catalog.get_instance_type_for_cpus(
+            cpus, cpus_plus, mem, mem_plus, region=resources.region,
+            use_spot=resources.use_spot)
+        if row is None:
+            return []
+        price = row['SpotPrice' if resources.use_spot else 'Price']
+        return [resources.copy(
+            cloud=self._REPR, region=row['Region'],
+            instance_type=row['InstanceType'],
+            _price_per_hour=float(price))]
+
+    def make_deploy_variables(self, resources: Resources,
+                              cluster_name_on_cloud: str,
+                              region: str, zone: Optional[str],
+                              num_nodes: int) -> Dict[str, Any]:
+        return {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': zone,
+            'use_spot': resources.use_spot,
+            'disk_size_gb': resources.disk_size,
+            'labels': resources.labels,
+            'num_nodes': num_nodes,
+            'tpu_vm': False,
+            'instance_type': resources.instance_type,
+            'image_id': resources.image_id,
+        }

@@ -1,0 +1,454 @@
+"""Minimal Azure Resource Manager client (dependency-free).
+
+Reference analog: ``sky/provision/azure/instance.py`` drives Azure
+through the ``azure-mgmt-*`` SDK family, which is not in this image; ARM
+is a plain JSON REST API under ``management.azure.com`` with OAuth2
+client-credential bearer tokens, so this client speaks it directly.
+Same injectable-transport pattern as ``provision/aws/ec2_client.py`` so
+the provisioner is unit-testable with a fake transport.
+
+Scope model (idiomatic Azure, unlike EC2's tag filtering): every cluster
+lives in its OWN resource group ``skytpu-<cluster>`` — membership is the
+group, teardown is one group delete, and a half-created cluster can
+never leak resources outside its group.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+
+API_COMPUTE = '2023-07-01'
+API_NETWORK = '2023-05-01'
+API_RESOURCES = '2021-04-01'
+
+# ARM error codes meaning "no capacity/quota for this size here, try
+# elsewhere" — the failover loop turns these into a region blocklist
+# entry, the same stockout contract as GCP/EC2.
+STOCKOUT_CODES = (
+    'SkuNotAvailable', 'AllocationFailed', 'ZonalAllocationFailed',
+    'OverconstrainedAllocationRequest', 'OverconstrainedZonalAllocationRequest',
+    'QuotaExceeded', 'OperationNotAllowed', 'SpotQuotaExceeded',
+    'LowPriorityQuotaExceeded',
+)
+
+
+class AzureApiError(exceptions.SkyTpuError):
+
+    def __init__(self, status_code: int, code: str, message: str):
+        self.status_code = status_code
+        self.code = code
+        self.message = message
+        super().__init__(f'Azure API error {code} ({status_code}): '
+                         f'{message[:500]}')
+
+    def is_stockout(self) -> bool:
+        return self.code in STOCKOUT_CODES
+
+
+def load_credentials() -> Dict[str, str]:
+    """Service-principal credentials from the standard Azure env contract
+    (``AZURE_TENANT_ID``/``AZURE_CLIENT_ID``/``AZURE_CLIENT_SECRET`` +
+    ``AZURE_SUBSCRIPTION_ID`` — the same variables the azure SDKs'
+    EnvironmentCredential reads)."""
+    creds = {k: os.environ.get(f'AZURE_{k.upper()}')
+             for k in ('tenant_id', 'client_id', 'client_secret',
+                       'subscription_id')}
+    missing = [k for k, v in creds.items() if not v]
+    if missing:
+        raise exceptions.NoCloudAccessError(
+            'Azure credentials not found: set '
+            + ', '.join(f'AZURE_{k.upper()}' for k in missing)
+            + ' (service principal with Contributor on the subscription).')
+    return creds  # type: ignore[return-value]
+
+
+class ArmTransport:
+    """Bearer-authed JSON transport to ARM; replaced by a fake in tests.
+
+    ``request(method, path, params, body)`` returns the parsed JSON body
+    (``{}`` for empty 200/201/202/204 responses). ``path`` is everything
+    after ``https://management.azure.com`` and must start with
+    ``/subscriptions/...``; the api-version query param is passed
+    explicitly by callers because it differs per resource provider."""
+
+    _LOGIN_HOST = 'https://login.microsoftonline.com'
+    _ARM_HOST = 'https://management.azure.com'
+
+    def __init__(self):
+        self._token: Optional[str] = None
+        self._token_expiry = 0.0
+
+    def _bearer(self) -> str:
+        if self._token is None or time.time() > self._token_expiry - 120:
+            import requests
+            creds = load_credentials()
+            resp = requests.post(
+                f'{self._LOGIN_HOST}/{creds["tenant_id"]}/oauth2/v2.0/token',
+                data={
+                    'grant_type': 'client_credentials',
+                    'client_id': creds['client_id'],
+                    'client_secret': creds['client_secret'],
+                    'scope': f'{self._ARM_HOST}/.default',
+                }, timeout=30)
+            if resp.status_code >= 400:
+                raise exceptions.NoCloudAccessError(
+                    f'Azure token request failed ({resp.status_code}): '
+                    f'{resp.text[:300]}')
+            tok = resp.json()
+            self._token = tok['access_token']
+            self._token_expiry = time.time() + float(
+                tok.get('expires_in', 3600))
+        return self._token
+
+    def request(self, method: str, path: str,
+                params: Optional[Dict[str, str]] = None,
+                body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        import requests
+        resp = requests.request(
+            method, f'{self._ARM_HOST}{path}', params=params or {},
+            json=body,
+            headers={'Authorization': f'Bearer {self._bearer()}'},
+            timeout=60)
+        if resp.status_code == 401:
+            # Token revoked/expired early: refresh once and retry.
+            self._token = None
+            resp = requests.request(
+                method, f'{self._ARM_HOST}{path}', params=params or {},
+                json=body,
+                headers={'Authorization': f'Bearer {self._bearer()}'},
+                timeout=60)
+        try:
+            payload = resp.json() if resp.text else {}
+        except ValueError:
+            payload = {}
+        if resp.status_code >= 400:
+            err = payload.get('error', payload) if isinstance(payload, dict) \
+                else {}
+            code = err.get('code', 'Unknown')
+            message = err.get('message', resp.text[:500])
+            # Quota/capacity details often hide one level down in
+            # ``details`` with the outer code a generic DeploymentFailed.
+            for d in err.get('details', []) or []:
+                if d.get('code') in STOCKOUT_CODES:
+                    code = d['code']
+                    message = d.get('message', message)
+                    break
+            raise AzureApiError(resp.status_code, code, message)
+        return payload if isinstance(payload, dict) else {'value': payload}
+
+
+class ArmClient:
+    """Subscription-scoped resource CRUD used by the provisioner.
+
+    PUTs are treated as idempotent upserts (ARM semantics); long-running
+    operations are handled by polling ``provisioningState`` on the
+    resource itself rather than the Azure-AsyncOperation header — fewer
+    moving parts, same terminal states."""
+
+    def __init__(self, transport: Optional[ArmTransport] = None,
+                 subscription_id: Optional[str] = None):
+        self.transport = transport or ArmTransport()
+        self._sub = subscription_id
+
+    @property
+    def subscription_id(self) -> str:
+        if self._sub is None:
+            self._sub = load_credentials()['subscription_id']
+        return self._sub
+
+    # -- paths ---------------------------------------------------------------
+
+    def _rg_path(self, rg: str) -> str:
+        return f'/subscriptions/{self.subscription_id}/resourcegroups/{rg}'
+
+    def _res_path(self, rg: str, provider: str, rtype: str,
+                  name: str = '') -> str:
+        base = (f'{self._rg_path(rg)}/providers/{provider}/{rtype}')
+        return f'{base}/{name}' if name else base
+
+    # -- resource groups -----------------------------------------------------
+
+    def ensure_resource_group(self, rg: str, location: str,
+                              tags: Optional[Dict[str, str]] = None) -> None:
+        self.transport.request(
+            'PUT', self._rg_path(rg), {'api-version': API_RESOURCES},
+            {'location': location, 'tags': tags or {}})
+
+    def resource_group_exists(self, rg: str) -> bool:
+        try:
+            self.transport.request('GET', self._rg_path(rg),
+                                   {'api-version': API_RESOURCES})
+            return True
+        except AzureApiError as e:
+            if e.status_code == 404 or e.code == 'ResourceGroupNotFound':
+                return False
+            raise
+
+    def delete_resource_group(self, rg: str) -> None:
+        """Async delete (ARM returns 202 and reaps in the background);
+        everything the cluster created lives inside, so this is the whole
+        teardown."""
+        try:
+            self.transport.request('DELETE', self._rg_path(rg),
+                                   {'api-version': API_RESOURCES})
+        except AzureApiError as e:
+            if e.status_code != 404 and e.code != 'ResourceGroupNotFound':
+                raise
+
+    # -- network -------------------------------------------------------------
+
+    def ensure_vnet(self, rg: str, name: str, location: str) -> None:
+        self.transport.request(
+            'PUT',
+            self._res_path(rg, 'Microsoft.Network', 'virtualNetworks', name),
+            {'api-version': API_NETWORK},
+            {'location': location, 'properties': {
+                'addressSpace': {'addressPrefixes': ['10.42.0.0/16']},
+                'subnets': [{'name': 'default', 'properties': {
+                    'addressPrefix': '10.42.0.0/20'}}],
+            }})
+
+    def ensure_nsg(self, rg: str, name: str, location: str) -> None:
+        """SSH in from anywhere (key auth only; bootstrap needs it), all
+        traffic inside the vnet (gang fan-out, jax coordinator) — the NSG
+        analog of the EC2 provisioner's security-group bootstrap."""
+        self.transport.request(
+            'PUT',
+            self._res_path(rg, 'Microsoft.Network',
+                           'networkSecurityGroups', name),
+            {'api-version': API_NETWORK},
+            {'location': location, 'properties': {'securityRules': [
+                {'name': 'skytpu-ssh', 'properties': {
+                    'priority': 1000, 'direction': 'Inbound',
+                    'access': 'Allow', 'protocol': 'Tcp',
+                    'sourceAddressPrefix': '*', 'sourcePortRange': '*',
+                    'destinationAddressPrefix': '*',
+                    'destinationPortRange': '22'}},
+                {'name': 'skytpu-intra', 'properties': {
+                    'priority': 1010, 'direction': 'Inbound',
+                    'access': 'Allow', 'protocol': '*',
+                    'sourceAddressPrefix': 'VirtualNetwork',
+                    'sourcePortRange': '*',
+                    'destinationAddressPrefix': 'VirtualNetwork',
+                    'destinationPortRange': '*'}},
+            ]}})
+
+    def get_nsg(self, rg: str, name: str) -> Dict[str, Any]:
+        return self.transport.request(
+            'GET',
+            self._res_path(rg, 'Microsoft.Network',
+                           'networkSecurityGroups', name),
+            {'api-version': API_NETWORK})
+
+    def add_nsg_rule(self, rg: str, nsg: str, port: int) -> None:
+        """Open a TCP port. Azure requires rule priorities to be UNIQUE
+        within the NSG, so derive the priority from the live rule set:
+        re-opening an already-open port reuses its rule (idempotent PUT),
+        a new port takes the smallest free slot above the bootstrap
+        rules (1000/1010)."""
+        rule_name = f'skytpu-port-{port}'
+        rules = (self.get_nsg(rg, nsg).get('properties') or {}).get(
+            'securityRules', [])
+        priority = None
+        used = set()
+        for r in rules:
+            props = r.get('properties') or {}
+            used.add(props.get('priority'))
+            if r.get('name') == rule_name:
+                priority = props.get('priority')
+        if priority is None:
+            priority = 1100
+            while priority in used:
+                priority += 1
+        self.transport.request(
+            'PUT',
+            self._res_path(rg, 'Microsoft.Network', 'networkSecurityGroups',
+                           f'{nsg}/securityRules/{rule_name}'),
+            {'api-version': API_NETWORK},
+            {'properties': {
+                'priority': priority, 'direction': 'Inbound',
+                'access': 'Allow', 'protocol': 'Tcp',
+                'sourceAddressPrefix': '*', 'sourcePortRange': '*',
+                'destinationAddressPrefix': '*',
+                'destinationPortRange': str(port)}})
+
+    def ensure_public_ip(self, rg: str, name: str, location: str
+                         ) -> Dict[str, Any]:
+        return self.transport.request(
+            'PUT',
+            self._res_path(rg, 'Microsoft.Network', 'publicIPAddresses',
+                           name),
+            {'api-version': API_NETWORK},
+            {'location': location,
+             'sku': {'name': 'Standard'},
+             'properties': {'publicIPAllocationMethod': 'Static'}})
+
+    def get_public_ip(self, rg: str, name: str) -> Optional[str]:
+        try:
+            out = self.transport.request(
+                'GET',
+                self._res_path(rg, 'Microsoft.Network', 'publicIPAddresses',
+                               name),
+                {'api-version': API_NETWORK})
+        except AzureApiError as e:
+            if e.status_code == 404:
+                return None
+            raise
+        return (out.get('properties') or {}).get('ipAddress')
+
+    def ensure_nic(self, rg: str, name: str, location: str, vnet: str,
+                   nsg: str, public_ip_name: Optional[str]) -> Dict[str, Any]:
+        sub = self.subscription_id
+        subnet_id = (f'/subscriptions/{sub}/resourceGroups/{rg}/providers/'
+                     f'Microsoft.Network/virtualNetworks/{vnet}/subnets/'
+                     'default')
+        nsg_id = (f'/subscriptions/{sub}/resourceGroups/{rg}/providers/'
+                  f'Microsoft.Network/networkSecurityGroups/{nsg}')
+        ipcfg: Dict[str, Any] = {
+            'name': 'primary',
+            'properties': {'subnet': {'id': subnet_id},
+                           'privateIPAllocationMethod': 'Dynamic'}}
+        if public_ip_name:
+            pip_id = (f'/subscriptions/{sub}/resourceGroups/{rg}/providers/'
+                      f'Microsoft.Network/publicIPAddresses/{public_ip_name}')
+            ipcfg['properties']['publicIPAddress'] = {'id': pip_id}
+        return self.transport.request(
+            'PUT',
+            self._res_path(rg, 'Microsoft.Network', 'networkInterfaces',
+                           name),
+            {'api-version': API_NETWORK},
+            {'location': location, 'properties': {
+                'networkSecurityGroup': {'id': nsg_id},
+                'ipConfigurations': [ipcfg]}})
+
+    def get_nic(self, rg: str, name: str) -> Optional[Dict[str, Any]]:
+        try:
+            return self.transport.request(
+                'GET',
+                self._res_path(rg, 'Microsoft.Network', 'networkInterfaces',
+                               name),
+                {'api-version': API_NETWORK})
+        except AzureApiError as e:
+            if e.status_code == 404:
+                return None
+            raise
+
+    # -- virtual machines ----------------------------------------------------
+
+    def create_vm(self, rg: str, name: str, location: str, *,
+                  vm_size: str, image: Dict[str, str], nic_name: str,
+                  ssh_user: str, ssh_pubkey: str,
+                  custom_data_b64: Optional[str] = None,
+                  disk_size_gb: int = 100, spot: bool = False,
+                  zone: Optional[str] = None,
+                  tags: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+        sub = self.subscription_id
+        nic_id = (f'/subscriptions/{sub}/resourceGroups/{rg}/providers/'
+                  f'Microsoft.Network/networkInterfaces/{nic_name}')
+        body: Dict[str, Any] = {
+            'location': location,
+            'tags': tags or {},
+            'properties': {
+                'hardwareProfile': {'vmSize': vm_size},
+                'storageProfile': {
+                    'imageReference': image,
+                    'osDisk': {'createOption': 'FromImage',
+                               'diskSizeGB': disk_size_gb,
+                               'deleteOption': 'Delete',
+                               'managedDisk': {
+                                   'storageAccountType': 'Premium_LRS'}},
+                },
+                'osProfile': {
+                    # Linux computerName allows 64 chars (15 is the
+                    # WINDOWS limit); truncate from the left so the
+                    # node-index suffix — the one distinguishing char on
+                    # a gang — always survives.
+                    'computerName': name[-63:] or 'node',
+                    'adminUsername': ssh_user,
+                    'linuxConfiguration': {
+                        'disablePasswordAuthentication': True,
+                        'ssh': {'publicKeys': [{
+                            'path': f'/home/{ssh_user}/.ssh/authorized_keys',
+                            'keyData': ssh_pubkey}]},
+                    },
+                },
+                'networkProfile': {'networkInterfaces': [{
+                    'id': nic_id,
+                    'properties': {'deleteOption': 'Delete'}}]},
+            },
+        }
+        if custom_data_b64:
+            body['properties']['osProfile']['customData'] = custom_data_b64
+        if spot:
+            # Deallocate (not Delete) on eviction: the cluster record and
+            # managed-job recovery treat a deallocated VM like a stopped
+            # one and the provider-authoritative preemption detector sees
+            # it as not-running — same contract as GCP preemptible TPUs.
+            body['properties']['priority'] = 'Spot'
+            body['properties']['evictionPolicy'] = 'Deallocate'
+            body['properties']['billingProfile'] = {'maxPrice': -1}
+        if zone:
+            body['zones'] = [zone]
+        return self.transport.request(
+            'PUT',
+            self._res_path(rg, 'Microsoft.Compute', 'virtualMachines', name),
+            {'api-version': API_COMPUTE}, body)
+
+    def list_vms(self, rg: str) -> List[Dict[str, Any]]:
+        try:
+            out = self.transport.request(
+                'GET',
+                self._res_path(rg, 'Microsoft.Compute', 'virtualMachines'),
+                {'api-version': API_COMPUTE})
+        except AzureApiError as e:
+            if e.status_code == 404 or e.code == 'ResourceGroupNotFound':
+                return []
+            raise
+        return out.get('value', [])
+
+    def vm_power_state(self, rg: str, name: str) -> str:
+        """'running' / 'deallocated' / 'starting' / ... from the instance
+        view; '' when the VM has no power status yet (still creating)."""
+        out = self.transport.request(
+            'GET',
+            self._res_path(rg, 'Microsoft.Compute', 'virtualMachines',
+                           f'{name}/instanceView'),
+            {'api-version': API_COMPUTE})
+        for status in out.get('statuses', []):
+            code = status.get('code', '')
+            if code.startswith('PowerState/'):
+                return code.split('/', 1)[1]
+        return ''
+
+    def vm_action(self, rg: str, name: str, action: str) -> None:
+        """POST lifecycle action: start | deallocate | restart."""
+        self.transport.request(
+            'POST',
+            self._res_path(rg, 'Microsoft.Compute', 'virtualMachines',
+                           f'{name}/{action}'),
+            {'api-version': API_COMPUTE})
+
+    def delete_vm(self, rg: str, name: str) -> None:
+        try:
+            self.transport.request(
+                'DELETE',
+                self._res_path(rg, 'Microsoft.Compute', 'virtualMachines',
+                               name),
+                {'api-version': API_COMPUTE})
+        except AzureApiError as e:
+            if e.status_code != 404:
+                raise
+
+
+# Canonical's current Ubuntu 22.04 LTS Gen2 image, latest at provision
+# time — the Azure analog of the EC2 provisioner's SSM-resolved AMI (no
+# catalog staleness; 'latest' resolves server-side).
+UBUNTU_2204_IMAGE = {
+    'publisher': 'Canonical',
+    'offer': '0001-com-ubuntu-server-jammy',
+    'sku': '22_04-lts-gen2',
+    'version': 'latest',
+}

@@ -1,0 +1,266 @@
+"""Azure VM provisioner (uniform provision interface).
+
+Reference analog: ``sky/provision/azure/instance.py`` (SDK-driven VM
+CRUD inside a per-cluster resource group) — re-based on the
+dependency-free ARM REST client (``arm_client.py``).
+
+Identity model: one resource group per cluster (``skytpu-<cluster>``),
+nodes named ``<cluster>-<idx>``; the group IS the membership filter, so
+lifecycle ops list the group instead of tag-filtering (the idiomatic
+Azure shape — EC2 has no grouping primitive, Azure's whole deployment
+model is built on one). Capacity errors (SkuNotAvailable & friends) map
+to QuotaExceededError for the backend's failover loop — the same
+stockout contract as the GCP and AWS provisioners.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import authentication
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.azure import arm_client as arm_lib
+
+_client: Optional[arm_lib.ArmClient] = None
+
+
+def _arm() -> arm_lib.ArmClient:
+    global _client
+    if _client is None:
+        _client = arm_lib.ArmClient()
+    return _client
+
+
+def set_client_for_testing(client: Optional[arm_lib.ArmClient]) -> None:
+    global _client
+    _client = client
+
+
+def default_ssh_user() -> str:
+    return os.environ.get('SKYTPU_AZURE_SSH_USER', 'azureuser')
+
+
+def resource_group(cluster_name_on_cloud: str) -> str:
+    return f'skytpu-{cluster_name_on_cloud}'
+
+
+def _vm_name(cluster_name_on_cloud: str, idx: int) -> str:
+    return f'{cluster_name_on_cloud}-{idx}'
+
+
+def _node_index(vm: Dict[str, Any]) -> Optional[int]:
+    name = vm.get('name', '')
+    _, _, idx = name.rpartition('-')
+    return int(idx) if idx.isdigit() else None
+
+
+def _image_for(node_config: Dict[str, Any]) -> Dict[str, str]:
+    """image_id as 'publisher:offer:sku[:version]' (the Azure URN form) or
+    the default latest Ubuntu 22.04 Gen2."""
+    image_id = node_config.get('image_id')
+    if not image_id:
+        return dict(arm_lib.UBUNTU_2204_IMAGE)
+    parts = str(image_id).split(':')
+    if len(parts) not in (3, 4):
+        raise ValueError(
+            f'Azure image_id must be "publisher:offer:sku[:version]", '
+            f'got {image_id!r}')
+    return {'publisher': parts[0], 'offer': parts[1], 'sku': parts[2],
+            'version': parts[3] if len(parts) == 4 else 'latest'}
+
+
+def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    nc = config.node_config
+    if nc.get('tpu_vm', False):
+        raise exceptions.NotSupportedError(
+            'Azure carries no TPUs; TPU slices provision on the GCP '
+            'family.')
+    arm = _arm()
+    rg = resource_group(config.cluster_name_on_cloud)
+    region = config.region
+    # Validate the image URN BEFORE creating anything: a ValueError mid-
+    # loop would bypass the AzureApiError rollback and orphan a group
+    # with a billed static public IP.
+    image = _image_for(nc)
+    created: List[str] = []
+    resumed: List[str] = []
+    existing: Dict[int, Dict[str, Any]] = {}
+    _, pubkey = authentication.get_or_create_ssh_keypair()
+    try:
+        arm.ensure_resource_group(rg, region, tags={
+            'skytpu-cluster': config.cluster_name_on_cloud,
+            **{k: str(v) for k, v in (config.tags or {}).items()}})
+        existing = {idx: vm for vm in arm.list_vms(rg)
+                    if (idx := _node_index(vm)) is not None}
+        if existing:
+            states = {idx: arm.vm_power_state(rg, vm['name'])
+                      for idx, vm in existing.items()}
+        else:
+            states = {}
+            # First node of a fresh group: network scaffolding (idempotent
+            # PUTs, so re-running after a partial failure self-heals).
+            arm.ensure_vnet(rg, 'skytpu-vnet', region)
+            arm.ensure_nsg(rg, 'skytpu-nsg', region)
+        for idx in range(config.num_nodes):
+            name = _vm_name(config.cluster_name_on_cloud, idx)
+            if idx in existing:
+                if states.get(idx) in ('deallocated', 'deallocating',
+                                       'stopped') \
+                        and config.resume_stopped_nodes:
+                    arm.vm_action(rg, name, 'start')
+                    resumed.append(name)
+                continue
+            arm.ensure_public_ip(rg, f'{name}-ip', region)
+            arm.ensure_nic(rg, f'{name}-nic', region, 'skytpu-vnet',
+                           'skytpu-nsg', f'{name}-ip')
+            arm.create_vm(
+                rg, name, region,
+                vm_size=nc['instance_type'],
+                image=image,
+                nic_name=f'{name}-nic',
+                ssh_user=default_ssh_user(),
+                ssh_pubkey=pubkey.strip(),
+                disk_size_gb=nc.get('disk_size_gb') or 100,
+                spot=bool(nc.get('use_spot', False)),
+                zone=config.zone,
+                tags={'skytpu-cluster': config.cluster_name_on_cloud,
+                      'skytpu-node': str(idx)})
+            created.append(name)
+    except arm_lib.AzureApiError as e:
+        # Atomic create-all-or-rollback, scoped by what this call made:
+        # a fresh group (nothing pre-existing) is deleted whole; on a
+        # reprovision only the VMs created THIS call are deleted, so
+        # surviving nodes keep running for the next attempt's resume.
+        try:
+            if not existing:
+                arm.delete_resource_group(rg)
+            else:
+                for name in created:
+                    arm.delete_vm(rg, name)
+                for name in resumed:
+                    try:
+                        arm.vm_action(rg, name, 'deallocate')
+                    except arm_lib.AzureApiError:
+                        pass
+        except arm_lib.AzureApiError:
+            pass
+        if e.is_stockout():
+            raise exceptions.QuotaExceededError(
+                f'Azure capacity in {region}: {e}') from e
+        raise
+    head = (_vm_name(config.cluster_name_on_cloud, 0)
+            if (0 in existing or created) else None)
+    return common.ProvisionRecord(
+        provider_name='azure', region=region, zone=config.zone,
+        cluster_name_on_cloud=config.cluster_name_on_cloud,
+        head_instance_id=head,
+        created_instance_ids=created, resumed_instance_ids=resumed)
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str, state: str,
+                   timeout: float = 600.0, poll: float = 3.0,
+                   provider_config=None) -> None:
+    del state, region
+    arm = _arm()
+    rg = resource_group(cluster_name_on_cloud)
+    deadline = time.time() + timeout
+    while True:
+        vms = arm.list_vms(rg)
+        states = [arm.vm_power_state(rg, vm['name']) for vm in vms]
+        if vms and all(s == 'running' for s in states):
+            return
+        if time.time() > deadline:
+            raise exceptions.ClusterNotUpError(
+                f'Azure VMs not running after {timeout:.0f}s '
+                f'(states: {states})')
+        time.sleep(poll)
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
+    """Deallocate: releases compute billing while keeping disks/NICs (the
+    Azure analog of EC2 stop; a plain power-off keeps billing)."""
+    arm = _arm()
+    rg = resource_group(cluster_name_on_cloud)
+    for vm in arm.list_vms(rg):
+        if arm.vm_power_state(rg, vm['name']) not in (
+                'deallocated', 'deallocating'):
+            arm.vm_action(rg, vm['name'], 'deallocate')
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None
+                        ) -> None:
+    """One group delete reaps VMs, NICs, IPs, disks, NSG, VNet — nothing
+    to leak (the reason the per-cluster-group layout exists)."""
+    _arm().delete_resource_group(resource_group(cluster_name_on_cloud))
+
+
+_STATE_MAP = {
+    'starting': 'pending',
+    'running': 'running',
+    'stopping': 'stopped',
+    'stopped': 'stopped',
+    'deallocating': 'stopped',
+    'deallocated': 'stopped',
+}
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Optional[str]]:
+    arm = _arm()
+    rg = resource_group(cluster_name_on_cloud)
+    out: Dict[str, Optional[str]] = {}
+    for vm in arm.list_vms(rg):
+        power = arm.vm_power_state(rg, vm['name'])
+        out[vm['name']] = _STATE_MAP.get(power, 'pending')
+    return out
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    del provider_config
+    arm = _arm()
+    rg = resource_group(cluster_name_on_cloud)
+    instances: List[common.InstanceInfo] = []
+    head_id = None
+    for vm in arm.list_vms(rg):
+        idx = _node_index(vm)
+        if idx is None:
+            continue
+        if arm.vm_power_state(rg, vm['name']) != 'running':
+            continue
+        name = vm['name']
+        nic = arm.get_nic(rg, f'{name}-nic') or {}
+        private_ip = ''
+        for ipcfg in (nic.get('properties') or {}).get(
+                'ipConfigurations', []):
+            private_ip = (ipcfg.get('properties') or {}).get(
+                'privateIPAddress', '') or private_ip
+        public_ip = arm.get_public_ip(rg, f'{name}-ip')
+        if idx == 0:
+            head_id = name
+        instances.append(common.InstanceInfo(
+            instance_id=name, node_id=idx,
+            worker_id=0,  # Azure VMs are single-host nodes
+            internal_ip=private_ip,
+            external_ip=public_ip or private_ip,
+            status='running'))
+    instances.sort(key=lambda i: i.node_id)
+    key_path, _ = authentication.get_or_create_ssh_keypair()
+    return common.ClusterInfo(
+        instances=instances, head_instance_id=head_id,
+        provider_name='azure', region=region, zone=None,
+        ssh_user=default_ssh_user(), ssh_key_path=key_path)
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[int],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    arm = _arm()
+    rg = resource_group(cluster_name_on_cloud)
+    for port in ports:
+        arm.add_nsg_rule(rg, 'skytpu-nsg', int(port))

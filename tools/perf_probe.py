@@ -1,0 +1,74 @@
+"""One-off perf exploration on the live chip (not part of the bench).
+
+Measures every remat/batch candidate with the bench's full-length
+measurement (not the noisy 3-iter sweep), plus a wider decode batch
+sweep, so bench.py's candidate list and sweep iters can be tuned from
+real data. Writes JSON lines to stdout.
+"""
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def train_candidates():
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.train import TrainerConfig
+    for policy, batch in (('heavy', 4), ('heavy', 6), ('heavy', 8),
+                          ('dots', 2), ('dots', 4), ('attn', 4),
+                          ('attn', 6)):
+        yield TrainerConfig(model=llama.BENCH_1B, global_batch_size=batch,
+                            seq_len=4096, optimizer='adafactor',
+                            remat=True, remat_policy=policy)
+
+
+def measure(cfg, warmup=2, iters=8):
+    sys.path.insert(0, '/root/repo')
+    import bench
+    return bench._measure_step_throughput(cfg, warmup, iters)
+
+
+def main():
+    for cfg in train_candidates():
+        label = f'{cfg.remat_policy}/b{cfg.global_batch_size}'
+        try:
+            t0 = time.time()
+            tf, tok, steps, loss = measure(cfg)
+            print(json.dumps({'train': label, 'tflops': round(tf, 2),
+                              'wall_s': round(time.time() - t0, 1)}),
+                  flush=True)
+        except Exception as exc:  # noqa: BLE001
+            print(json.dumps({'train': label,
+                              'error': f'{type(exc).__name__}: '
+                                       f'{str(exc)[:160]}'}), flush=True)
+
+    from skypilot_tpu.models import generate as gen_lib
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.train import TrainerConfig
+    cfg = TrainerConfig(model=llama.BENCH_1B, global_batch_size=4,
+                        seq_len=4096)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg.model)
+    prompt_len, new_tokens = 128, 128
+    for batch in (64, 96, 128, 192, 256):
+        try:
+            prompt = jnp.ones((batch, prompt_len), jnp.int32)
+            out = gen_lib.generate(params, cfg.model, prompt, new_tokens)
+            jax.device_get(out[0, 0])
+            t0 = time.perf_counter()
+            out = gen_lib.generate(params, cfg.model, prompt, new_tokens)
+            jax.device_get(out[0, 0])
+            dt = time.perf_counter() - t0
+            print(json.dumps({'decode_batch': batch,
+                              'tok_s': round(batch * new_tokens / dt, 1)}),
+                  flush=True)
+        except Exception as exc:  # noqa: BLE001
+            print(json.dumps({'decode_batch': batch,
+                              'error': f'{type(exc).__name__}: '
+                                       f'{str(exc)[:160]}'}), flush=True)
+            break
+
+
+if __name__ == '__main__':
+    main()
