@@ -1,0 +1,150 @@
+"""LoRA adapters for the llama family — functional, sharding-aware.
+
+Reference analog: ``/root/reference/llm/llama-3_1-finetuning/lora.yaml``
+(torchtune LoRA finetune — the reference's headline finetuning recipe).
+The TPU-native shape is a pure tree transformation, not module surgery:
+
+* adapters are a SEPARATE pytree mirroring the targeted weights, stacked
+  over layers exactly like the base params (scan layout preserved);
+* the merged weight ``W + (alpha/r) * A @ B`` is computed INSIDE the
+  train step — a rank-r matmul per target per layer, negligible next to
+  the forward pass, and XLA fuses it into the consumer matmul's prologue;
+* gradients flow only through the adapter argument (``jax.grad`` w.r.t.
+  the adapters), so the base params are frozen by construction — no
+  ``stop_gradient`` bookkeeping, no trainable-mask optimizer wrapper, and
+  the optimizer state is adapter-sized (the point of LoRA: a 1B model's
+  adafactor state drops from ~1B to a few M entries).
+
+Adapter A carries the target's input axes + a replicated ``lora_rank``
+axis, B carries ``lora_rank`` + the output axes, so FSDP/TP shardings of
+the base model apply unchanged to the adapters.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models import llama
+
+Params = Dict[str, Any]
+
+# Per-target: number of input dims in the stacked weight (after the
+# leading layer axis); the rest are output dims. E.g. wq (L, d, heads,
+# head_dim) contracts d -> (heads, head_dim).
+_TARGET_IN_DIMS = {
+    'wq': 1, 'wk': 1, 'wv': 1,  # (L, d, n_heads/kv, head_dim)
+    'wo': 2,                    # (L, heads, head_dim, d)
+    'w_gate': 1, 'w_up': 1,     # (L, d, d_ff)
+    'w_down': 1,                # (L, d_ff, d)
+}
+
+DEFAULT_TARGETS = ('wq', 'wk', 'wv', 'wo')
+ALL_TARGETS = tuple(_TARGET_IN_DIMS)
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    rank: int = 16
+    alpha: float = 32.0
+    targets: Tuple[str, ...] = DEFAULT_TARGETS
+
+    def __post_init__(self):
+        if self.rank <= 0:
+            raise ValueError(f'LoRA rank must be positive, got {self.rank}')
+        unknown = set(self.targets) - set(_TARGET_IN_DIMS)
+        if unknown:
+            raise ValueError(
+                f'Unknown LoRA targets {sorted(unknown)}; choose from '
+                f'{sorted(_TARGET_IN_DIMS)}')
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+def _split_shape(w_shape: Tuple[int, ...], target: str):
+    """(layer, *in, *out) split of a stacked weight's shape."""
+    n_in = _TARGET_IN_DIMS[target]
+    return w_shape[0], w_shape[1:1 + n_in], w_shape[1 + n_in:]
+
+
+def _check_targets(layer_keys, targets) -> None:
+    """Shared by init_lora AND lora_logical_axes so both entrypoints
+    (Trainer.init_state resolves axes first) raise the same actionable
+    error instead of a bare KeyError."""
+    missing = [t for t in targets if t not in layer_keys]
+    if missing:
+        raise ValueError(
+            f'LoRA target(s) {missing} not in this model (MoE models '
+            "adapt attention only: targets=('wq','wk','wv','wo'))")
+
+
+def init_lora(key: jax.Array, params: Params, cfg: LoraConfig,
+              dtype=jnp.bfloat16) -> Params:
+    """Adapter tree for the targeted layer weights. A ~ N(0, 1/fan_in),
+    B = 0, so the merged model starts EXACTLY at the base model (delta
+    zero) — finetuning moves away from it smoothly."""
+    adapters: Params = {}
+    layers = params['layers']
+    _check_targets(layers, cfg.targets)
+    for i, target in enumerate(sorted(cfg.targets)):
+        w = layers[target]
+        n_layers, in_shape, out_shape = _split_shape(w.shape, target)
+        fan_in = 1
+        for s in in_shape:
+            fan_in *= s
+        k = jax.random.fold_in(key, i)
+        adapters[target] = {
+            'a': (jax.random.normal(k, (n_layers, *in_shape, cfg.rank),
+                                    jnp.float32)
+                  * (fan_in ** -0.5)).astype(dtype),
+            'b': jnp.zeros((n_layers, cfg.rank, *out_shape), dtype),
+        }
+    return adapters
+
+
+def lora_logical_axes(model_cfg: llama.LlamaConfig,
+                      cfg: LoraConfig) -> Params:
+    """Logical sharding axes mirroring ``llama.param_logical_axes``: A
+    keeps the target's input axes, B its output axes; ``lora_rank``
+    replicates (rank is tiny — sharding it would only fragment the
+    rank-r matmuls)."""
+    base = llama.param_logical_axes(model_cfg)['layers']
+    _check_targets(base, cfg.targets)
+    axes: Params = {}
+    for target in sorted(cfg.targets):
+        w_axes = base[target]  # ('layers', *in_axes, *out_axes)
+        n_in = _TARGET_IN_DIMS[target]
+        axes[target] = {
+            'a': ('layers',) + tuple(w_axes[1:1 + n_in]) + ('lora_rank',),
+            'b': ('layers', 'lora_rank') + tuple(w_axes[1 + n_in:]),
+        }
+    return axes
+
+
+def _delta(a: jax.Array, b: jax.Array) -> jax.Array:
+    """(L, *in, r) x (L, r, *out) -> (L, *in, *out), batched over the
+    layer axis (one dot_general — XLA maps it onto the MXU)."""
+    return jax.lax.dot_general(
+        a, b, dimension_numbers=(((a.ndim - 1,), (1,)), ((0,), (0,))))
+
+
+def merge(params: Params, adapters: Params, cfg: LoraConfig) -> Params:
+    """Effective params: targeted weights get ``W + scale * A@B``; the
+    rest pass through untouched (same tree structure, so every consumer
+    — loss_fn, generate, checkpointing — works unchanged)."""
+    layers = dict(params['layers'])
+    for target, ab in adapters.items():
+        w = layers[target]
+        delta = _delta(ab['a'].astype(jnp.float32),
+                       ab['b'].astype(jnp.float32))
+        layers[target] = (w.astype(jnp.float32)
+                          + cfg.scale * delta).astype(w.dtype)
+    return {**params, 'layers': layers}
+
+
+def param_count(adapters: Params) -> int:
+    return sum(leaf.size for leaf in jax.tree.leaves(adapters))

@@ -397,21 +397,49 @@ class ArmClient:
             self._res_path(rg, 'Microsoft.Compute', 'virtualMachines', name),
             {'api-version': API_COMPUTE}, body)
 
-    def list_vms(self, rg: str) -> List[Dict[str, Any]]:
+    def list_vms(self, rg: str,
+                 with_power_state: bool = False) -> List[Dict[str, Any]]:
+        """All VMs in the group, following ARM pagination (one list page
+        is ~50 VMs — a pod-scale gang would silently truncate without
+        the nextLink walk). ``with_power_state`` uses ``$expand=
+        instanceView`` so every poll is ONE request, not 1+N
+        per-instanceView GETs (ARM throttles at provision-wait rates)."""
+        params = {'api-version': API_COMPUTE}
+        if with_power_state:
+            params['$expand'] = 'instanceView'
         try:
             out = self.transport.request(
                 'GET',
                 self._res_path(rg, 'Microsoft.Compute', 'virtualMachines'),
-                {'api-version': API_COMPUTE})
+                params)
         except AzureApiError as e:
             if e.status_code == 404 or e.code == 'ResourceGroupNotFound':
                 return []
             raise
-        return out.get('value', [])
+        vms = list(out.get('value', []))
+        while out.get('nextLink'):
+            # nextLink is a full URL with the continuation token baked
+            # into its query string.
+            path = out['nextLink'].split('management.azure.com', 1)[-1]
+            out = self.transport.request('GET', path)
+            vms.extend(out.get('value', []))
+        return vms
+
+    @staticmethod
+    def power_state_of(vm: Dict[str, Any]) -> str:
+        """'running' / 'deallocated' / 'starting' / ... from an expanded
+        VM dict (``list_vms(with_power_state=True)``); '' when the VM
+        has no power status yet (still creating)."""
+        view = (vm.get('properties') or {}).get('instanceView') or {}
+        for status in view.get('statuses', []):
+            code = status.get('code', '')
+            if code.startswith('PowerState/'):
+                return code.split('/', 1)[1]
+        return ''
 
     def vm_power_state(self, rg: str, name: str) -> str:
-        """'running' / 'deallocated' / 'starting' / ... from the instance
-        view; '' when the VM has no power status yet (still creating)."""
+        """Single-VM power state (per-VM instanceView GET; polling loops
+        should use ``list_vms(with_power_state=True)`` instead)."""
         out = self.transport.request(
             'GET',
             self._res_path(rg, 'Microsoft.Compute', 'virtualMachines',

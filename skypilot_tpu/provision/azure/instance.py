@@ -4,7 +4,9 @@ Reference analog: ``sky/provision/azure/instance.py`` (SDK-driven VM
 CRUD inside a per-cluster resource group) — re-based on the
 dependency-free ARM REST client (``arm_client.py``).
 
-Identity model: one resource group per cluster (``skytpu-<cluster>``),
+Identity model: one resource group per cluster per region
+(``skytpu-<cluster>-<region>`` — region-qualified because group names
+are subscription-global and deletes are async, see ``resource_group``),
 nodes named ``<cluster>-<idx>``; the group IS the membership filter, so
 lifecycle ops list the group instead of tag-filtering (the idiomatic
 Azure shape — EC2 has no grouping primitive, Azure's whole deployment
@@ -42,8 +44,27 @@ def default_ssh_user() -> str:
     return os.environ.get('SKYTPU_AZURE_SSH_USER', 'azureuser')
 
 
-def resource_group(cluster_name_on_cloud: str) -> str:
-    return f'skytpu-{cluster_name_on_cloud}'
+def resource_group(cluster_name_on_cloud: str, region: str) -> str:
+    """REGION-QUALIFIED: resource-group names are subscription-global
+    and deletes are async, so a cross-region failover retry with a bare
+    ``skytpu-<cluster>`` name would collide with the previous region's
+    group still reaping (409 'Deleting', not a stockout — the failover
+    loop would abort instead of moving on)."""
+    return f'skytpu-{cluster_name_on_cloud}-{region}'
+
+
+def _region_of(provider_config: Optional[Dict[str, Any]]) -> str:
+    """Lifecycle ops recover the region from the backend handle's
+    provider_config (Azure zones are bare '1'/'2'/'3' labels, so —
+    unlike EC2 — the zone can never yield the region)."""
+    if provider_config and provider_config.get('region'):
+        return provider_config['region']
+    region = os.environ.get('SKYTPU_AZURE_REGION')
+    if not region:
+        raise exceptions.NoCloudAccessError(
+            'Azure region unknown: provider_config has no region and '
+            'SKYTPU_AZURE_REGION is unset.')
+    return region
 
 
 def _vm_name(cluster_name_on_cloud: str, idx: int) -> str:
@@ -78,8 +99,8 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
             'Azure carries no TPUs; TPU slices provision on the GCP '
             'family.')
     arm = _arm()
-    rg = resource_group(config.cluster_name_on_cloud)
     region = config.region
+    rg = resource_group(config.cluster_name_on_cloud, region)
     # Validate the image URN BEFORE creating anything: a ValueError mid-
     # loop would bypass the AzureApiError rollback and orphan a group
     # with a billed static public IP.
@@ -92,10 +113,11 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
         arm.ensure_resource_group(rg, region, tags={
             'skytpu-cluster': config.cluster_name_on_cloud,
             **{k: str(v) for k, v in (config.tags or {}).items()}})
-        existing = {idx: vm for vm in arm.list_vms(rg)
+        existing = {idx: vm
+                    for vm in arm.list_vms(rg, with_power_state=True)
                     if (idx := _node_index(vm)) is not None}
         if existing:
-            states = {idx: arm.vm_power_state(rg, vm['name'])
+            states = {idx: arm_lib.ArmClient.power_state_of(vm)
                       for idx, vm in existing.items()}
         else:
             states = {}
@@ -162,13 +184,13 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
 def wait_instances(region: str, cluster_name_on_cloud: str, state: str,
                    timeout: float = 600.0, poll: float = 3.0,
                    provider_config=None) -> None:
-    del state, region
+    del state
     arm = _arm()
-    rg = resource_group(cluster_name_on_cloud)
+    rg = resource_group(cluster_name_on_cloud, region)
     deadline = time.time() + timeout
     while True:
-        vms = arm.list_vms(rg)
-        states = [arm.vm_power_state(rg, vm['name']) for vm in vms]
+        vms = arm.list_vms(rg, with_power_state=True)
+        states = [arm_lib.ArmClient.power_state_of(vm) for vm in vms]
         if vms and all(s == 'running' for s in states):
             return
         if time.time() > deadline:
@@ -183,9 +205,9 @@ def stop_instances(cluster_name_on_cloud: str,
     """Deallocate: releases compute billing while keeping disks/NICs (the
     Azure analog of EC2 stop; a plain power-off keeps billing)."""
     arm = _arm()
-    rg = resource_group(cluster_name_on_cloud)
-    for vm in arm.list_vms(rg):
-        if arm.vm_power_state(rg, vm['name']) not in (
+    rg = resource_group(cluster_name_on_cloud, _region_of(provider_config))
+    for vm in arm.list_vms(rg, with_power_state=True):
+        if arm_lib.ArmClient.power_state_of(vm) not in (
                 'deallocated', 'deallocating'):
             arm.vm_action(rg, vm['name'], 'deallocate')
 
@@ -195,7 +217,9 @@ def terminate_instances(cluster_name_on_cloud: str,
                         ) -> None:
     """One group delete reaps VMs, NICs, IPs, disks, NSG, VNet — nothing
     to leak (the reason the per-cluster-group layout exists)."""
-    _arm().delete_resource_group(resource_group(cluster_name_on_cloud))
+    _arm().delete_resource_group(
+        resource_group(cluster_name_on_cloud,
+                       _region_of(provider_config)))
 
 
 _STATE_MAP = {
@@ -212,10 +236,10 @@ def query_instances(cluster_name_on_cloud: str,
                     provider_config: Optional[Dict[str, Any]] = None
                     ) -> Dict[str, Optional[str]]:
     arm = _arm()
-    rg = resource_group(cluster_name_on_cloud)
+    rg = resource_group(cluster_name_on_cloud, _region_of(provider_config))
     out: Dict[str, Optional[str]] = {}
-    for vm in arm.list_vms(rg):
-        power = arm.vm_power_state(rg, vm['name'])
+    for vm in arm.list_vms(rg, with_power_state=True):
+        power = arm_lib.ArmClient.power_state_of(vm)
         out[vm['name']] = _STATE_MAP.get(power, 'pending')
     return out
 
@@ -225,14 +249,14 @@ def get_cluster_info(region: str, cluster_name_on_cloud: str,
                      ) -> common.ClusterInfo:
     del provider_config
     arm = _arm()
-    rg = resource_group(cluster_name_on_cloud)
+    rg = resource_group(cluster_name_on_cloud, region)
     instances: List[common.InstanceInfo] = []
     head_id = None
-    for vm in arm.list_vms(rg):
+    for vm in arm.list_vms(rg, with_power_state=True):
         idx = _node_index(vm)
         if idx is None:
             continue
-        if arm.vm_power_state(rg, vm['name']) != 'running':
+        if arm_lib.ArmClient.power_state_of(vm) != 'running':
             continue
         name = vm['name']
         nic = arm.get_nic(rg, f'{name}-nic') or {}
@@ -261,6 +285,6 @@ def get_cluster_info(region: str, cluster_name_on_cloud: str,
 def open_ports(cluster_name_on_cloud: str, ports: List[int],
                provider_config: Optional[Dict[str, Any]] = None) -> None:
     arm = _arm()
-    rg = resource_group(cluster_name_on_cloud)
+    rg = resource_group(cluster_name_on_cloud, _region_of(provider_config))
     for port in ports:
         arm.add_nsg_rule(rg, 'skytpu-nsg', int(port))

@@ -49,6 +49,13 @@ def main() -> None:
                         help='remat policy (models/llama.py '
                              'REMAT_POLICIES); "dots" is the v5e bench '
                              'default where memory allows')
+    parser.add_argument('--lora-rank', type=int, default=0,
+                        help='LoRA adapter rank; 0 = full finetune '
+                             '(models/lora.py)')
+    parser.add_argument('--lora-alpha', type=float, default=32.0)
+    parser.add_argument('--lora-targets', default='wq,wk,wv,wo',
+                        help='comma-separated weight names to adapt '
+                             '(also: w_gate,w_up,w_down)')
     args = parser.parse_args()
 
     from skypilot_tpu.utils.jax_env import apply_jax_platform_env
@@ -63,10 +70,18 @@ def main() -> None:
     from skypilot_tpu.train import Trainer, TrainerConfig
     from skypilot_tpu.train import data as data_lib
 
+    lora_cfg = None
+    if args.lora_rank > 0:
+        from skypilot_tpu.models import lora as lora_lib
+        lora_cfg = lora_lib.LoraConfig(
+            rank=args.lora_rank, alpha=args.lora_alpha,
+            targets=tuple(t.strip()
+                          for t in args.lora_targets.split(',') if t.strip()))
     cfg = TrainerConfig(model=llama.PRESETS[args.model],
                         global_batch_size=args.global_batch_size,
                         seq_len=args.seq_len, optimizer=args.optimizer,
-                        remat=True, remat_policy=args.remat_policy)
+                        remat=True, remat_policy=args.remat_policy,
+                        lora=lora_cfg)
 
     mesh = None
     num_slices = args.num_slices

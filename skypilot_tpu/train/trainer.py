@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import optax
 
 from skypilot_tpu.models import llama
+from skypilot_tpu.models import lora as lora_lib
 from skypilot_tpu.parallel import mesh as mesh_lib
 from skypilot_tpu.parallel import sharding as sharding_lib
 
@@ -38,6 +39,10 @@ class TrainerConfig:
     # all matmul outputs except the big MLP hiddens), 'dots' (keep every
     # matmul output — fastest where it fits; the v5e bench default).
     remat_policy: str = 'full'
+    # LoRA finetuning (models/lora.py): None = full finetune. When set,
+    # the base params are frozen by construction (grads are taken w.r.t.
+    # the adapter tree only) and the optimizer state is adapter-sized.
+    lora: Optional[lora_lib.LoraConfig] = None
 
     def __post_init__(self):
         if self.remat_policy not in llama.REMAT_POLICIES:
@@ -95,6 +100,20 @@ class Trainer:
         init = jax.jit(functools.partial(llama.init_params, cfg=self.cfg.model),
                        out_shardings=self.param_shardings)
         params = init(key)
+        if self.cfg.lora is not None:
+            lora_shardings = sharding_lib.sharding_tree(
+                lora_lib.lora_logical_axes(self.cfg.model, self.cfg.lora),
+                self.mesh, self.rules)
+            adapters = jax.jit(
+                functools.partial(lora_lib.init_lora, cfg=self.cfg.lora),
+                static_argnames=(), out_shardings=lora_shardings,
+            )(jax.random.fold_in(key, 1), params)
+            # Optimizer state over the ADAPTERS only — the base stays
+            # frozen and untracked (the memory win that makes LoRA fit
+            # where full finetune OOMs).
+            opt_state = jax.jit(self.optimizer.init)(adapters)
+            return {'step': jnp.zeros((), jnp.int32), 'params': params,
+                    'lora': adapters, 'opt_state': opt_state}
         opt_state = jax.jit(
             self.optimizer.init,
             # optimizer states mirror param shardings where shaped like
@@ -114,13 +133,27 @@ class Trainer:
                                  mesh=self.mesh, rules=self.rules,
                                  remat_policy=cfg.remat_policy)
 
-        (loss_val, metrics), grads = jax.value_and_grad(
-            loss, has_aux=True)(state['params'])
+        # With LoRA the trainable tree is the adapters and the base
+        # params enter the loss as a closure constant — frozen by
+        # construction, no stop_gradient bookkeeping. One optimizer
+        # block serves both modes so they can never drift.
+        if cfg.lora is not None:
+            trainable = state['lora']
+            loss_of = lambda t: loss(  # noqa: E731
+                lora_lib.merge(state['params'], t, cfg.lora))
+        else:
+            trainable = state['params']
+            loss_of = loss
+        (_, metrics), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(trainable)
         updates, new_opt = self.optimizer.update(
-            grads, state['opt_state'], state['params'])
-        new_params = optax.apply_updates(state['params'], updates)
-        new_state = {'step': state['step'] + 1, 'params': new_params,
-                     'opt_state': new_opt}
+            grads, state['opt_state'], trainable)
+        new_trainable = optax.apply_updates(trainable, updates)
+        new_state = {'step': state['step'] + 1, 'opt_state': new_opt}
+        if cfg.lora is not None:
+            new_state.update(params=state['params'], lora=new_trainable)
+        else:
+            new_state.update(params=new_trainable)
         metrics = dict(metrics)
         metrics['grad_norm'] = optax.global_norm(grads)
         return new_state, metrics

@@ -39,6 +39,7 @@ class FakeArmApi:
 
     def request(self, method, path, params=None, body=None):
         self.calls.append((method, path))
+        self._last_params = params or {}
         m = re.match(
             rf'/subscriptions/{SUB}/resourcegroups/(?P<rg>[^/]+)'
             r'(?:/providers/(?P<provider>[^/]+)/(?P<rtype>[^/]+)'
@@ -154,7 +155,17 @@ class FakeArmApi:
         del body
         vms = self.groups.get(rg, {}).get('vms', {})
         if rest is None:  # list
-            return {'value': list(vms.values())}
+            out = []
+            for name, vm in vms.items():
+                vm = dict(vm)
+                if '$expand' in self._last_params:
+                    state = self.power.get((rg, name), '')
+                    vm['properties'] = {
+                        **vm.get('properties', {}),
+                        'instanceView': {'statuses': [
+                            {'code': f'PowerState/{state}'}]}}
+                out.append(vm)
+            return {'value': out}
         if rest.endswith('/instanceView'):
             vm = rest[:-len('/instanceView')]
             if vm not in vms:
@@ -193,6 +204,11 @@ def fake_arm(tmp_path, monkeypatch):
     az_instance.set_client_for_testing(None)
 
 
+def _pc():
+    """provider_config as the backend handle carries it."""
+    return {'region': 'eastus'}
+
+
 def _cfg(num_nodes=2, instance_type='Standard_D2s_v5', spot=False,
          image=None):
     return common.ProvisionConfig(
@@ -209,7 +225,7 @@ def test_run_instances_builds_group_scoped_cluster(fake_arm):
     record = az_instance.run_instances(_cfg())
     assert record.created_instance_ids == ['a-xyz-0', 'a-xyz-1']
     assert record.head_instance_id == 'a-xyz-0'
-    rg = fake_arm.groups['skytpu-a-xyz']
+    rg = fake_arm.groups['skytpu-a-xyz-eastus']
     # Network scaffolding inside the SAME group: vnet + nsg with the two
     # bootstrap rules, one NIC + public IP per node.
     assert set(rg['vnets']) == {'skytpu-vnet'}
@@ -237,23 +253,23 @@ def test_run_instances_builds_group_scoped_cluster(fake_arm):
 
 def test_stop_resume_terminate_cycle(fake_arm):
     az_instance.run_instances(_cfg())
-    az_instance.stop_instances('a-xyz')
-    statuses = az_instance.query_instances('a-xyz')
+    az_instance.stop_instances('a-xyz', _pc())
+    statuses = az_instance.query_instances('a-xyz', _pc())
     assert set(statuses.values()) == {'stopped'}  # deallocated
     record = az_instance.run_instances(_cfg())
     assert sorted(record.resumed_instance_ids) == ['a-xyz-0', 'a-xyz-1']
-    assert set(az_instance.query_instances('a-xyz').values()) == {'running'}
-    az_instance.terminate_instances('a-xyz')
+    assert set(az_instance.query_instances('a-xyz', _pc()).values()) == {'running'}
+    az_instance.terminate_instances('a-xyz', _pc())
     # Group delete reaps EVERYTHING — no per-resource cleanup to leak.
-    assert 'skytpu-a-xyz' not in fake_arm.groups
-    assert az_instance.query_instances('a-xyz') == {}
+    assert 'skytpu-a-xyz-eastus' not in fake_arm.groups
+    assert az_instance.query_instances('a-xyz', _pc()) == {}
 
 
 def test_scale_up_reuses_network_and_keeps_existing_nodes(fake_arm):
     az_instance.run_instances(_cfg(num_nodes=1))
     record = az_instance.run_instances(_cfg(num_nodes=3))
     assert record.created_instance_ids == ['a-xyz-1', 'a-xyz-2']
-    rg = fake_arm.groups['skytpu-a-xyz']
+    rg = fake_arm.groups['skytpu-a-xyz-eastus']
     assert set(rg['vms']) == {'a-xyz-0', 'a-xyz-1', 'a-xyz-2'}
     assert set(rg['vnets']) == {'skytpu-vnet'}
 
@@ -263,7 +279,7 @@ def test_stockout_maps_to_quota_error_and_rolls_back_fresh_group(fake_arm):
     with pytest.raises(exceptions.QuotaExceededError):
         az_instance.run_instances(_cfg())
     # Fresh provision: the whole group goes, nothing half-built remains.
-    assert 'skytpu-a-xyz' not in fake_arm.groups
+    assert 'skytpu-a-xyz-eastus' not in fake_arm.groups
 
 
 def test_stockout_on_scale_up_keeps_survivors(fake_arm):
@@ -282,12 +298,12 @@ def test_stockout_on_scale_up_keeps_survivors(fake_arm):
         az_instance.run_instances(_cfg(num_nodes=3))
     # The pre-existing node survives for the next attempt's resume; the
     # group is NOT deleted out from under it.
-    assert set(fake_arm.groups['skytpu-a-xyz']['vms']) == {'a-xyz-0'}
+    assert set(fake_arm.groups['skytpu-a-xyz-eastus']['vms']) == {'a-xyz-0'}
 
 
 def test_spot_carries_priority_and_deallocate_eviction(fake_arm):
     az_instance.run_instances(_cfg(num_nodes=1, spot=True))
-    vm = fake_arm.groups['skytpu-a-xyz']['vms']['a-xyz-0']
+    vm = fake_arm.groups['skytpu-a-xyz-eastus']['vms']['a-xyz-0']
     assert vm['properties']['priority'] == 'Spot'
     # Deallocate (not Delete): preemption looks like a stopped VM, which
     # the provider-authoritative preemption detector already handles.
@@ -296,11 +312,11 @@ def test_spot_carries_priority_and_deallocate_eviction(fake_arm):
 
 def test_open_ports_adds_idempotent_nsg_rules(fake_arm):
     az_instance.run_instances(_cfg(num_nodes=1))
-    az_instance.open_ports('a-xyz', [8080, 9090])
-    first_prio = fake_arm.groups['skytpu-a-xyz']['rules'][
+    az_instance.open_ports('a-xyz', [8080, 9090], _pc())
+    first_prio = fake_arm.groups['skytpu-a-xyz-eastus']['rules'][
         'skytpu-port-8080']['properties']['priority']
-    az_instance.open_ports('a-xyz', [8080])  # idempotent re-open
-    rules = fake_arm.groups['skytpu-a-xyz']['rules']
+    az_instance.open_ports('a-xyz', [8080], _pc())  # idempotent re-open
+    rules = fake_arm.groups['skytpu-a-xyz-eastus']['rules']
     assert set(rules) == {'skytpu-port-8080', 'skytpu-port-9090'}
     assert rules['skytpu-port-8080']['properties'][
         'destinationPortRange'] == '8080'
@@ -313,10 +329,53 @@ def test_open_ports_adds_idempotent_nsg_rules(fake_arm):
     assert not {1000, 1010} & set(prios)
 
 
+def test_list_vms_follows_pagination(fake_arm):
+    """ARM list responses page at ~50 items; membership must follow
+    nextLink or a pod-scale gang silently truncates."""
+    az_instance.run_instances(_cfg(num_nodes=3))
+    client = arm_client.ArmClient(transport=fake_arm, subscription_id=SUB)
+
+    orig = fake_arm._virtualmachines_get
+
+    def paged(rg, rest, body):
+        out = orig(rg, rest, body)
+        if rest is None and '$skiptoken' not in fake_arm._last_params:
+            return {'value': out['value'][:2],
+                    'nextLink': ('https://management.azure.com'
+                                 f'/subscriptions/{SUB}/resourcegroups/'
+                                 f'{rg}/providers/Microsoft.Compute/'
+                                 'virtualMachines?$skiptoken=2')}
+        if rest is None:
+            return {'value': out['value'][2:]}
+        return out
+
+    fake_arm._virtualmachines_get = paged
+    # The fake routes query strings as part of 'rest'; strip for match.
+    real_request = fake_arm.request
+
+    def request(method, path, params=None, body=None):
+        if '?' in path:
+            path, _, qs = path.partition('?')
+            params = {**(params or {}),
+                      **dict(kv.split('=') for kv in qs.split('&'))}
+        return real_request(method, path, params, body)
+
+    fake_arm_request = fake_arm.request
+    del fake_arm_request
+    fake_arm.request = request
+    try:
+        vms = client.list_vms('skytpu-a-xyz-eastus')
+    finally:
+        fake_arm.request = real_request
+        fake_arm._virtualmachines_get = orig
+    assert sorted(vm['name'] for vm in vms) == \
+        ['a-xyz-0', 'a-xyz-1', 'a-xyz-2']
+
+
 def test_image_urn_parsing(fake_arm):
     az_instance.run_instances(_cfg(
         num_nodes=1, image='Canonical:ubuntu-24_04-lts:server'))
-    vm = fake_arm.groups['skytpu-a-xyz']['vms']['a-xyz-0']
+    vm = fake_arm.groups['skytpu-a-xyz-eastus']['vms']['a-xyz-0']
     ref = vm['properties']['storageProfile']['imageReference']
     assert ref == {'publisher': 'Canonical', 'offer': 'ubuntu-24_04-lts',
                    'sku': 'server', 'version': 'latest'}
@@ -328,7 +387,7 @@ def test_image_urn_parsing(fake_arm):
 
 def test_default_image_is_ubuntu_2204_latest(fake_arm):
     az_instance.run_instances(_cfg(num_nodes=1))
-    vm = fake_arm.groups['skytpu-a-xyz']['vms']['a-xyz-0']
+    vm = fake_arm.groups['skytpu-a-xyz-eastus']['vms']['a-xyz-0']
     ref = vm['properties']['storageProfile']['imageReference']
     assert ref['offer'] == '0001-com-ubuntu-server-jammy'
     assert ref['version'] == 'latest'

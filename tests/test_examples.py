@@ -199,6 +199,30 @@ def test_multislice_recipe_launches_over_two_slices(monkeypatch):
     core.down('ex-ms')
 
 
+def test_lora_finetune_recipe_runs_frozen_base(tmp_path, monkeypatch):
+    """examples/llm/lora-finetune: adapter finetune + checkpoint dir
+    through the real launch path (scaled to tiny on the virtual CPU
+    mesh). The recipe's own flags drive models/lora.py."""
+    monkeypatch.setenv('SKYTPU_LOCAL_BUCKET_ROOT', str(tmp_path / 'b'))
+    cfg = yaml.safe_load(open(os.path.join(
+        EXAMPLES, 'llm', 'lora-finetune', 'lora_finetune.yaml')))
+    assert '--lora-rank 16' in cfg['run']
+    cfg['resources'] = {'cloud': 'fake', 'accelerators': 'tpu-v5e-8'}
+    cfg['run'] = (
+        'JAX_PLATFORMS=cpu '
+        'XLA_FLAGS=--xla_force_host_platform_device_count=8 '
+        'python3 -m skypilot_tpu.train.run --model tiny --steps 4 '
+        '--global-batch-size 8 --seq-len 128 --log-every 2 '
+        '--mesh "fsdp=-1" --lora-rank 4 --ckpt-dir /ckpt --save-every 2')
+    task = Task.from_yaml_config(cfg)
+    job_id, _ = execution.launch(task, cluster_name='ex-lora',
+                                 detach_run=True)
+    assert _wait_job('ex-lora', job_id, timeout=300) == 'SUCCEEDED'
+    log = _read_log('ex-lora', job_id)
+    assert 'step 4/4' in log
+    core.down('ex-lora')
+
+
 def test_moe_finetune_recipe_runs_with_expert_parallelism(tmp_path,
                                                           monkeypatch):
     """examples/llm/moe-finetune: expert-parallel mesh + checkpoint dir

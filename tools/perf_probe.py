@@ -6,6 +6,7 @@ sweep, so bench.py's candidate list and sweep iters can be tuned from
 real data. Writes JSON lines to stdout.
 """
 import json
+import os
 import sys
 import time
 
@@ -25,7 +26,9 @@ def train_candidates():
 
 
 def measure(cfg, warmup=2, iters=8):
-    sys.path.insert(0, '/root/repo')
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
     import bench
     return bench._measure_step_throughput(cfg, warmup, iters)
 
