@@ -13,7 +13,7 @@ the region when a zone is given.
 """
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import Optional, Tuple
 
 import pandas as pd
 
@@ -24,52 +24,25 @@ _vm_df = common.LazyDataFrame('azure/vms.csv',
 
 
 def get_instance_type_for_cpus(
-        cpus: Optional[float], cpus_at_least: bool,
-        memory: Optional[float], memory_at_least: bool,
-        region: Optional[str] = None,
-        use_spot: bool = False) -> Optional[dict]:
-    """Smallest/cheapest VM satisfying a cpus/memory request (defaults to
-    4+ vCPUs when unspecified, mirroring ``gcp_catalog``)."""
-    df = _vm_df.df
-    if region:
-        df = df[df['Region'] == region]
-    want_cpus = cpus if cpus is not None else 4.0
-    if cpus_at_least or cpus is None:
-        df = df[df['vCPUs'] >= want_cpus]
-    else:
-        df = df[df['vCPUs'] == want_cpus]
-    if memory is not None:
-        if memory_at_least:
-            df = df[df['MemoryGiB'] >= memory]
-        else:
-            df = df[df['MemoryGiB'] == memory]
-    row = common.cheapest_row(df, use_spot)
-    return None if row is None else row.to_dict()
+        cpus, cpus_at_least, memory, memory_at_least,
+        region=None, use_spot=False):
+    return common.vm_instance_type_for_cpus(
+        _vm_df.df, cpus, cpus_at_least, memory, memory_at_least,
+        region=region, use_spot=use_spot)
 
 
-def get_vm_offerings(instance_type: str, region: Optional[str] = None,
-                     zone: Optional[str] = None,
-                     use_spot: bool = False) -> List[dict]:
-    df = common.filter_df(_vm_df.df, InstanceType=instance_type,
-                          Region=region,
-                          AvailabilityZone=None if zone is None
-                          else str(zone))
-    col = 'SpotPrice' if use_spot else 'Price'
-    df = df[df[col].notna()].sort_values(col)
-    return df.to_dict('records')
+def get_vm_offerings(instance_type, region=None, zone=None,
+                     use_spot=False):
+    return common.vm_offerings(_vm_df.df, instance_type, region=region,
+                               zone=zone, use_spot=use_spot)
 
 
-def instance_type_exists(instance_type: str) -> bool:
-    return bool((_vm_df.df['InstanceType'] == instance_type).any())
+def instance_type_exists(instance_type):
+    return common.vm_instance_type_exists(_vm_df.df, instance_type)
 
 
-def get_vcpus_mem_from_instance_type(
-        instance_type: str) -> Tuple[Optional[float], Optional[float]]:
-    rows = _vm_df.df[_vm_df.df['InstanceType'] == instance_type]
-    if rows.empty:
-        return None, None
-    r = rows.iloc[0]
-    return float(r['vCPUs']), float(r['MemoryGiB'])
+def get_vcpus_mem_from_instance_type(instance_type):
+    return common.vm_vcpus_mem(_vm_df.df, instance_type)
 
 
 def validate_region_zone(

@@ -105,3 +105,56 @@ def cheapest_row(df: pd.DataFrame, use_spot: bool) -> Optional[pd.Series]:
     if df.empty:
         return None
     return df.loc[df[col].idxmin()]
+
+
+# -- shared VM-catalog queries ----------------------------------------------
+# One implementation for every vms.csv-backed vendor catalog (AWS, Azure,
+# DO, ...): the per-vendor modules are thin wrappers binding their frame,
+# so selection-logic fixes land once.
+
+
+def vm_instance_type_for_cpus(
+        df: pd.DataFrame,
+        cpus: Optional[float], cpus_at_least: bool,
+        memory: Optional[float], memory_at_least: bool,
+        region: Optional[str] = None,
+        use_spot: bool = False) -> Optional[dict]:
+    """Smallest/cheapest VM satisfying a cpus/memory request (defaults to
+    4+ vCPUs when unspecified, mirroring ``gcp_catalog``)."""
+    if region:
+        df = df[df['Region'] == region]
+    want_cpus = cpus if cpus is not None else 4.0
+    if cpus_at_least or cpus is None:
+        df = df[df['vCPUs'] >= want_cpus]
+    else:
+        df = df[df['vCPUs'] == want_cpus]
+    if memory is not None:
+        if memory_at_least:
+            df = df[df['MemoryGiB'] >= memory]
+        else:
+            df = df[df['MemoryGiB'] == memory]
+    row = cheapest_row(df, use_spot)
+    return None if row is None else row.to_dict()
+
+
+def vm_offerings(df: pd.DataFrame, instance_type: str,
+                 region: Optional[str] = None,
+                 zone: Optional[str] = None,
+                 use_spot: bool = False) -> list:
+    df = filter_df(df, InstanceType=instance_type, Region=region,
+                   AvailabilityZone=None if zone is None else str(zone))
+    col = 'SpotPrice' if use_spot else 'Price'
+    df = df[df[col].notna()].sort_values(col)
+    return df.to_dict('records')
+
+
+def vm_instance_type_exists(df: pd.DataFrame, instance_type: str) -> bool:
+    return bool((df['InstanceType'] == instance_type).any())
+
+
+def vm_vcpus_mem(df: pd.DataFrame, instance_type: str):
+    rows = df[df['InstanceType'] == instance_type]
+    if rows.empty:
+        return None, None
+    r = rows.iloc[0]
+    return float(r['vCPUs']), float(r['MemoryGiB'])

@@ -1,0 +1,67 @@
+"""Generate the DigitalOcean droplet catalog CSV.
+
+Reference analog: ``sky/catalog/data_fetchers/fetch_do.py``. Public
+per-hour list prices (identical across regions — DO prices are flat
+worldwide) as configuration data; a live crawl of ``GET /v2/sizes``
+slots in here when network access exists.
+
+Run ``python -m skypilot_tpu.catalog.data_fetchers.fetch_do`` to
+regenerate ``skypilot_tpu/catalog/data/do/vms.csv`` (idempotent).
+
+No SpotPrice column values: DigitalOcean has no spot market, so spot
+requests are naturally infeasible on this provider (the catalog query
+filters on SpotPrice notna).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Tuple
+
+from skypilot_tpu.catalog.data_fetchers.common import write_csv
+
+OUT_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                       'data', 'do')
+
+# (size slug, vCPUs, memory GiB, USD/hr — flat across regions).
+SHAPES: List[Tuple[str, int, int, float]] = [
+    ('s-1vcpu-1gb', 1, 1, 0.00893),
+    ('s-2vcpu-2gb', 2, 2, 0.02679),
+    ('s-2vcpu-4gb', 2, 4, 0.03571),
+    ('s-4vcpu-8gb', 4, 8, 0.07143),
+    ('s-8vcpu-16gb', 8, 16, 0.14286),
+    ('c-4', 4, 8, 0.125),        # dedicated compute-optimized
+    ('g-2vcpu-8gb', 2, 8, 0.09375),
+    ('g-4vcpu-16gb', 4, 16, 0.1875),
+]
+
+REGIONS = ['nyc3', 'sfo3', 'ams3']
+
+
+def generate_vm_rows() -> List[dict]:
+    rows = []
+    for name, vcpus, mem, price in SHAPES:
+        for region in REGIONS:
+            rows.append({
+                'InstanceType': name,
+                'vCPUs': vcpus,
+                'MemoryGiB': mem,
+                'Region': region,
+                # DO has no zones; the region doubles as the zone label
+                # so the shared catalog-VM planning code needs no
+                # special case.
+                'AvailabilityZone': region,
+                'Price': price,
+                'SpotPrice': '',
+            })
+    return rows
+
+
+def main() -> None:
+    rows = generate_vm_rows()
+    path = os.path.join(OUT_DIR, 'vms.csv')
+    write_csv(path, rows)
+    print(f'Wrote {len(rows)} DigitalOcean rows to {path}')
+
+
+if __name__ == '__main__':
+    main()

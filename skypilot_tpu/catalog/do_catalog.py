@@ -1,10 +1,10 @@
-"""AWS catalog queries: EC2 CPU VMs.
+"""DigitalOcean catalog queries: droplet sizes for CPU work.
 
-Reference analog: ``sky/catalog/aws_catalog.py`` — lazy CSV frames with
-price/zone filtering. AWS carries no TPUs; this catalog exists so
-controllers, CPU tasks, and storage-adjacent work can land on EC2 and the
-optimizer can fail over GCP<->AWS (the cross-cloud pitch the reference's
-25-provider catalog serves).
+Reference analog: ``sky/catalog/do_catalog.py``. Same query surface as
+the AWS/Azure catalogs so the shared ``CatalogVmCloud`` planning logic
+applies unchanged; DO has no spot market (SpotPrice empty → spot
+requests infeasible here) and no zones (the region doubles as the zone
+label).
 """
 from __future__ import annotations
 
@@ -14,7 +14,7 @@ import pandas as pd
 
 from skypilot_tpu.catalog import common
 
-_vm_df = common.LazyDataFrame('aws/vms.csv')
+_vm_df = common.LazyDataFrame('do/vms.csv')
 
 
 def get_instance_type_for_cpus(
@@ -42,17 +42,13 @@ def get_vcpus_mem_from_instance_type(instance_type):
 def validate_region_zone(
         region: Optional[str],
         zone: Optional[str]) -> Tuple[Optional[str], Optional[str]]:
-    df = _vm_df.df[['Region', 'AvailabilityZone']]
+    df = _vm_df.df
     if region is not None and not (df['Region'] == region).any():
-        raise ValueError(f'Unknown AWS region {region!r}')
-    if zone is not None:
-        rows = df[df['AvailabilityZone'] == zone]
-        if rows.empty:
-            raise ValueError(f'Unknown AWS zone {zone!r}')
-        zone_region = rows.iloc[0]['Region']
-        if region is not None and zone_region != region:
-            raise ValueError(f'Zone {zone!r} not in region {region!r}')
-        return zone_region, zone
+        raise ValueError(f'Unknown DigitalOcean region {region!r}')
+    if zone is not None and zone != region:
+        raise ValueError(
+            f'DigitalOcean has no zones; drop zone {zone!r} (or set it '
+            'equal to the region).')
     return region, zone
 
 

@@ -1,6 +1,7 @@
 """Clouds package. Importing it registers all built-in clouds."""
 from skypilot_tpu.clouds.aws import AWS
 from skypilot_tpu.clouds.azure import Azure
+from skypilot_tpu.clouds.do import DO
 from skypilot_tpu.clouds.cloud import Cloud, CloudImplementationFeatures, Region
 from skypilot_tpu.clouds.fake import Fake
 from skypilot_tpu.clouds.gcp import GCP
@@ -10,6 +11,6 @@ from skypilot_tpu.clouds.local import Local
 from skypilot_tpu.clouds.slurm import Slurm
 from skypilot_tpu.clouds.ssh import Ssh
 
-__all__ = ['AWS', 'Azure', 'Cloud', 'CloudImplementationFeatures', 'Region',
+__all__ = ['AWS', 'Azure', 'DO', 'Cloud', 'CloudImplementationFeatures', 'Region',
            'GCP',
            'GKE', 'Kubernetes', 'Local', 'Fake', 'Ssh', 'Slurm']

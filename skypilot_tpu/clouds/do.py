@@ -1,0 +1,55 @@
+"""DigitalOcean cloud: droplets (cheap CPU controllers and tasks).
+
+Reference analog: ``sky/clouds/do.py`` — one of the reference's
+"neocloud" providers. Fourth compute vendor here, and the proof that a
+new provider is now ~a day's work: the planning logic is the shared
+catalog-VM base, the REST client is ~150 lines, and the provisioner
+implements the same uniform interface as GCP/AWS/Azure.
+
+DO quirks surfaced honestly: no spot market (spot requests are
+infeasible here and fail over to vendors that have one), and droplets
+bill while powered off, so STOP/AUTOSTOP are not declared — autostop
+falls back to down, and `stpu stop` on a DO cluster raises an
+actionable NotSupportedError.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.clouds.catalog_vm import CatalogVmCloud
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+Features = cloud_lib.CloudImplementationFeatures
+
+
+@CLOUD_REGISTRY.register(aliases=['digitalocean'])
+class DO(CatalogVmCloud):
+
+    _REPR = 'do'
+
+    @classmethod
+    def _catalog(cls):
+        from skypilot_tpu.catalog import do_catalog
+        return do_catalog
+
+    @classmethod
+    def supported_features(cls) -> set:
+        # No SPOT (no market), no STOP/AUTOSTOP (powered-off droplets
+        # still bill), no CUSTOM_DISK_SIZE (disk is fixed per size).
+        return {Features.MULTI_NODE, Features.OPEN_PORTS,
+                Features.STORAGE_MOUNTING}
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu import exceptions
+        from skypilot_tpu.provision.do import do_client
+        try:
+            do_client.load_credentials()
+            return True, None
+        except exceptions.NoCloudAccessError as e:
+            return False, str(e)
+
+    @property
+    def provisioner_module(self) -> str:
+        return 'skypilot_tpu.provision.do'

@@ -1,0 +1,174 @@
+"""Minimal DigitalOcean API client (dependency-free).
+
+Reference analog: ``sky/provision/do/`` drives DigitalOcean through the
+``pydo`` SDK; the DO API is plain JSON REST with a bearer token, so this
+client speaks it directly. Same injectable-transport pattern as the EC2
+and ARM clients so the provisioner is unit-testable with a fake.
+
+DigitalOcean is the simplest vendor shape in the fleet: flat regions
+(no zones), fixed disk per size, no spot market, and droplets bill
+while powered off (so the cloud declares no STOP feature).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+
+API_HOST = 'https://api.digitalocean.com'
+
+
+class DoApiError(exceptions.SkyTpuError):
+
+    def __init__(self, status_code: int, code: str, message: str):
+        self.status_code = status_code
+        self.code = code
+        self.message = message
+        super().__init__(f'DigitalOcean API error {code} ({status_code}): '
+                         f'{message[:500]}')
+
+    # Substrings of 422 messages that mean "no capacity/limit here, try
+    # elsewhere". 422 is ALSO DO's generic validation error (bad image
+    # slug, malformed body) — those must surface to the user, not spin
+    # the failover loop through every region.
+    _STOCKOUT_HINTS = ('limit', 'exceed', 'unavailable', 'not available',
+                      'capacity', 'sold out', 'out of stock')
+
+    def is_stockout(self) -> bool:
+        if self.status_code != 422:
+            return False
+        msg = self.message.lower()
+        return any(h in msg for h in self._STOCKOUT_HINTS)
+
+
+def load_credentials() -> str:
+    token = os.environ.get('DIGITALOCEAN_TOKEN') or \
+        os.environ.get('DIGITALOCEAN_ACCESS_TOKEN')
+    if not token:
+        raise exceptions.NoCloudAccessError(
+            'DigitalOcean credentials not found: set DIGITALOCEAN_TOKEN '
+            '(API token with read/write scope).')
+    return token
+
+
+class DoTransport:
+    """Bearer-authed JSON transport; replaced by a fake in tests."""
+
+    def request(self, method: str, path: str,
+                params: Optional[Dict[str, str]] = None,
+                body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        import requests
+        resp = requests.request(
+            method, f'{API_HOST}{path}', params=params or {}, json=body,
+            headers={'Authorization': f'Bearer {load_credentials()}'},
+            timeout=60)
+        try:
+            payload = resp.json() if resp.text else {}
+        except ValueError:
+            payload = {}
+        if resp.status_code >= 400:
+            raise DoApiError(resp.status_code,
+                             payload.get('id', 'unknown'),
+                             payload.get('message', resp.text[:500]))
+        return payload
+
+
+class DoClient:
+
+    def __init__(self, transport: Optional[DoTransport] = None):
+        self.transport = transport or DoTransport()
+
+    # -- droplets ------------------------------------------------------------
+
+    def create_droplet(self, *, name: str, region: str, size: str,
+                       image: str, user_data: Optional[str] = None,
+                       tags: Optional[List[str]] = None) -> Dict[str, Any]:
+        body: Dict[str, Any] = {
+            'name': name, 'region': region, 'size': size, 'image': image,
+            'tags': tags or [],
+        }
+        if user_data:
+            body['user_data'] = user_data
+        out = self.transport.request('POST', '/v2/droplets', body=body)
+        return out['droplet']
+
+    def _paginate(self, path: str, params: Optional[Dict[str, str]],
+                  key: str) -> List[Dict[str, Any]]:
+        """GET a paged collection, following ``links.pages.next`` (the
+        next link is a full URL carrying its own query string)."""
+        items: List[Dict[str, Any]] = []
+        while path:
+            out = self.transport.request('GET', path, params)
+            items.extend(out.get(key, []))
+            nxt = ((out.get('links') or {}).get('pages') or {}).get('next')
+            if not nxt:
+                break
+            path = nxt.split('api.digitalocean.com', 1)[-1]
+            params = None
+        return items
+
+    def list_droplets(self, tag: str) -> List[Dict[str, Any]]:
+        """All droplets carrying ``tag``, following pagination."""
+        return self._paginate('/v2/droplets',
+                              {'tag_name': tag, 'per_page': '200'},
+                              'droplets')
+
+    def delete_droplets_by_tag(self, tag: str) -> None:
+        self.transport.request('DELETE', '/v2/droplets',
+                               {'tag_name': tag})
+
+    def delete_droplet(self, droplet_id: Any) -> None:
+        try:
+            self.transport.request('DELETE', f'/v2/droplets/{droplet_id}')
+        except DoApiError as e:
+            if e.status_code != 404:
+                raise
+
+    def droplet_action(self, droplet_id: int, action_type: str) -> None:
+        """power_on | power_off | reboot."""
+        self.transport.request('POST', f'/v2/droplets/{droplet_id}/actions',
+                               body={'type': action_type})
+
+    # -- firewalls -----------------------------------------------------------
+
+    def find_firewall(self, name: str) -> Optional[Dict[str, Any]]:
+        for fw in self._paginate('/v2/firewalls', {'per_page': '200'},
+                                 'firewalls'):
+            if fw.get('name') == name:
+                return fw
+        return None
+
+    def create_firewall(self, name: str, tag: str,
+                        inbound_rules: List[Dict[str, Any]]
+                        ) -> Dict[str, Any]:
+        out = self.transport.request('POST', '/v2/firewalls', body={
+            'name': name,
+            'tags': [tag],
+            'inbound_rules': inbound_rules,
+            # DO's port grammar: a single port, a range, or '0' for all
+            # ports; icmp rules carry NO ports field.
+            'outbound_rules': [
+                {'protocol': 'tcp', 'ports': '0',
+                 'destinations': {'addresses': ['0.0.0.0/0', '::/0']}},
+                {'protocol': 'udp', 'ports': '0',
+                 'destinations': {'addresses': ['0.0.0.0/0', '::/0']}},
+                {'protocol': 'icmp',
+                 'destinations': {'addresses': ['0.0.0.0/0', '::/0']}},
+            ],
+        })
+        return out['firewall']
+
+    def update_firewall(self, firewall: Dict[str, Any]) -> None:
+        self.transport.request('PUT', f"/v2/firewalls/{firewall['id']}",
+                               body=firewall)
+
+    def delete_firewall(self, firewall_id: str) -> None:
+        try:
+            self.transport.request('DELETE', f'/v2/firewalls/{firewall_id}')
+        except DoApiError as e:
+            if e.status_code != 404:
+                raise
+
+
+DEFAULT_IMAGE = 'ubuntu-22-04-x64'

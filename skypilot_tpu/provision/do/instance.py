@@ -1,0 +1,267 @@
+"""DigitalOcean droplet provisioner (uniform provision interface).
+
+Reference analog: ``sky/provision/do/instance.py`` (pydo SDK) — re-based
+on the dependency-free REST client (``do_client.py``).
+
+Identity model: droplets are named ``<cluster>-<idx>`` and tagged
+``skytpu-<cluster>`` — DO's tag primitive does the membership filtering
+(list/delete-by-tag are first-class API calls), and a tag-targeted
+cluster firewall covers every member automatically, including later
+scale-ups. Capacity/limit errors (422) map to QuotaExceededError for
+the failover loop — the same stockout contract as GCP/AWS/Azure.
+
+DigitalOcean quirk the interface surfaces honestly: powered-off
+droplets still bill, so there is no STOP path — ``stop_instances``
+raises NotSupportedError and the cloud omits the STOP/AUTOSTOP
+features (autostop falls back to down).
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import authentication
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.do import do_client as do_lib
+
+_client: Optional[do_lib.DoClient] = None
+
+
+def _do() -> do_lib.DoClient:
+    global _client
+    if _client is None:
+        _client = do_lib.DoClient()
+    return _client
+
+
+def set_client_for_testing(client: Optional[do_lib.DoClient]) -> None:
+    global _client
+    _client = client
+
+
+def default_ssh_user() -> str:
+    return os.environ.get('SKYTPU_DO_SSH_USER', 'root')
+
+
+def cluster_tag(cluster_name_on_cloud: str) -> str:
+    return f'skytpu-{cluster_name_on_cloud}'
+
+
+def _vm_name(cluster_name_on_cloud: str, idx: int) -> str:
+    return f'{cluster_name_on_cloud}-{idx}'
+
+
+def _node_index(droplet: Dict[str, Any]) -> Optional[int]:
+    _, _, idx = droplet.get('name', '').rpartition('-')
+    return int(idx) if idx.isdigit() else None
+
+
+def _user_data() -> str:
+    """Cloud-init installing the framework key for root (DO images log
+    in as root; same contract as the EC2 user-data path)."""
+    _, pubkey = authentication.get_or_create_ssh_keypair()
+    user = default_ssh_user()
+    home = '/root' if user == 'root' else f'/home/{user}'
+    return (f'#!/bin/bash\nmkdir -p {home}/.ssh\n'
+            f"echo '{pubkey.strip()}' >> {home}/.ssh/authorized_keys\n"
+            f'chmod 700 {home}/.ssh && chmod 600 '
+            f'{home}/.ssh/authorized_keys\n')
+
+
+def _bootstrap_firewall(client: do_lib.DoClient,
+                        tag: str) -> Dict[str, Any]:
+    """Tag-targeted cluster firewall: SSH in from anywhere (key auth
+    only), all traffic between cluster members (gang fan-out, jax
+    coordinator). Tag targeting means droplets added later are covered
+    automatically — no per-node attach step. Returns the firewall dict
+    (found or created) so callers never need a second list call.
+
+    Port grammar note: DO accepts a single port, a range, or '0' for
+    all ports — never 'all'."""
+    name = f'{tag}-fw'
+    fw = client.find_firewall(name)
+    if fw is not None:
+        return fw
+    return client.create_firewall(name, tag, [
+        {'protocol': 'tcp', 'ports': '22',
+         'sources': {'addresses': ['0.0.0.0/0', '::/0']}},
+        {'protocol': 'tcp', 'ports': '0', 'sources': {'tags': [tag]}},
+        {'protocol': 'udp', 'ports': '0', 'sources': {'tags': [tag]}},
+    ])
+
+
+def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    nc = config.node_config
+    if nc.get('tpu_vm', False):
+        raise exceptions.NotSupportedError(
+            'DigitalOcean carries no TPUs; TPU slices provision on the '
+            'GCP family.')
+    client = _do()
+    tag = cluster_tag(config.cluster_name_on_cloud)
+    existing: Dict[int, Dict[str, Any]] = {
+        idx: d for d in client.list_droplets(tag)
+        if (idx := _node_index(d)) is not None}
+    created: List[str] = []
+    resumed: List[str] = []
+    try:
+        _bootstrap_firewall(client, tag)
+        user_data = _user_data()
+        for idx in range(config.num_nodes):
+            d = existing.get(idx)
+            if d is not None:
+                if d.get('status') == 'off' and config.resume_stopped_nodes:
+                    client.droplet_action(d['id'], 'power_on')
+                    resumed.append(str(d['id']))
+                continue
+            droplet = client.create_droplet(
+                name=_vm_name(config.cluster_name_on_cloud, idx),
+                region=config.region,
+                size=nc['instance_type'],
+                image=nc.get('image_id') or do_lib.DEFAULT_IMAGE,
+                user_data=user_data,
+                tags=[tag])
+            created.append(str(droplet['id']))
+    except do_lib.DoApiError as e:
+        if not existing:
+            # Fresh cluster: reap everything this call made (delete by
+            # tag covers every created droplet in one call).
+            try:
+                client.delete_droplets_by_tag(tag)
+                fw = client.find_firewall(f'{tag}-fw')
+                if fw:
+                    client.delete_firewall(fw['id'])
+            except do_lib.DoApiError:
+                pass
+        else:
+            for did in created:
+                try:
+                    client.delete_droplet(did)
+                except do_lib.DoApiError:
+                    pass
+        if e.is_stockout():
+            raise exceptions.QuotaExceededError(
+                f'DigitalOcean capacity/limit in {config.region}: {e}'
+            ) from e
+        raise
+    head = (str(existing[0]['id']) if 0 in existing
+            else (created[0] if created else None))
+    return common.ProvisionRecord(
+        provider_name='do', region=config.region, zone=None,
+        cluster_name_on_cloud=config.cluster_name_on_cloud,
+        head_instance_id=head,
+        created_instance_ids=created, resumed_instance_ids=resumed)
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str, state: str,
+                   timeout: float = 600.0, poll: float = 3.0,
+                   provider_config=None) -> None:
+    del state, region
+    client = _do()
+    tag = cluster_tag(cluster_name_on_cloud)
+    deadline = time.time() + timeout
+    while True:
+        droplets = client.list_droplets(tag)
+        states = [d.get('status') for d in droplets]
+        if droplets and all(s == 'active' for s in states):
+            return
+        if time.time() > deadline:
+            raise exceptions.ClusterNotUpError(
+                f'Droplets not active after {timeout:.0f}s '
+                f'(states: {states})')
+        time.sleep(poll)
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
+    raise exceptions.NotSupportedError(
+        'DigitalOcean droplets bill while powered off — stopping would '
+        'only hide the cost. Use `stpu down` instead (the DO cloud '
+        'declares no STOP feature, so autostop falls back to down).')
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None
+                        ) -> None:
+    client = _do()
+    tag = cluster_tag(cluster_name_on_cloud)
+    client.delete_droplets_by_tag(tag)
+    fw = client.find_firewall(f'{tag}-fw')
+    if fw is not None:
+        client.delete_firewall(fw['id'])
+
+
+_STATE_MAP = {
+    'new': 'pending',
+    'active': 'running',
+    'off': 'stopped',
+    'archive': 'terminated',
+}
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Optional[str]]:
+    client = _do()
+    return {str(d['id']): _STATE_MAP.get(d.get('status'), None)
+            for d in client.list_droplets(
+                cluster_tag(cluster_name_on_cloud))}
+
+
+def _ips_of(droplet: Dict[str, Any]) -> Dict[str, str]:
+    out = {}
+    for v4 in (droplet.get('networks') or {}).get('v4', []):
+        out[v4.get('type')] = v4.get('ip_address', '')
+    return out
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    del provider_config
+    client = _do()
+    instances: List[common.InstanceInfo] = []
+    head_id = None
+    for d in client.list_droplets(cluster_tag(cluster_name_on_cloud)):
+        idx = _node_index(d)
+        if idx is None or d.get('status') != 'active':
+            continue
+        ips = _ips_of(d)
+        if idx == 0:
+            head_id = str(d['id'])
+        instances.append(common.InstanceInfo(
+            instance_id=str(d['id']), node_id=idx,
+            worker_id=0,  # droplets are single-host nodes
+            internal_ip=ips.get('private', ips.get('public', '')),
+            external_ip=ips.get('public', ips.get('private', '')),
+            status='running'))
+    instances.sort(key=lambda i: i.node_id)
+    key_path, _ = authentication.get_or_create_ssh_keypair()
+    return common.ClusterInfo(
+        instances=instances, head_instance_id=head_id,
+        provider_name='do', region=region, zone=None,
+        ssh_user=default_ssh_user(), ssh_key_path=key_path)
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[int],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    """Add inbound TCP rules to the tag-targeted cluster firewall (PUT
+    replaces the rule set, so read-modify-write; idempotent re-open)."""
+    if not ports:
+        return
+    client = _do()
+    tag = cluster_tag(cluster_name_on_cloud)
+    fw = _bootstrap_firewall(client, tag)
+    rules = list(fw.get('inbound_rules', []))
+    have = {(r.get('protocol'), str(r.get('ports')))
+            for r in rules}
+    changed = False
+    for port in ports:
+        if ('tcp', str(port)) not in have:
+            rules.append({'protocol': 'tcp', 'ports': str(port),
+                          'sources': {'addresses': ['0.0.0.0/0', '::/0']}})
+            changed = True
+    if changed:
+        fw['inbound_rules'] = rules
+        client.update_firewall(fw)
