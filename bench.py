@@ -70,7 +70,7 @@ def _measure_step_throughput(cfg, warmup: int, iters: int):
     return tflops_per_chip, tokens_per_s_chip, steps_per_s, final_loss
 
 
-def _measure_decode_throughput(cfg) -> float:
+def _measure_decode_throughput(cfg):
     """Serving-side decode tokens/s (KV-cache generate path; the JetStream
     analog metric — reference baseline: 2500 tok/s input throughput on
     v6e, ``examples/tpu/v6e/README.md:118``).
@@ -86,30 +86,46 @@ def _measure_decode_throughput(cfg) -> float:
     from skypilot_tpu.models import generate as gen_lib
     from skypilot_tpu.models import llama
 
+    from skypilot_tpu.models import quantization as quant_lib
+
     prompt_len, new_tokens = 128, 128
     params = llama.init_params(jax.random.PRNGKey(0), cfg.model)
-    best = 0.0
-    for batch in (32, 64):
-        try:
-            prompt = jnp.ones((batch, prompt_len), jnp.int32)
-            out = gen_lib.generate(params, cfg.model, prompt,
-                                   new_tokens)  # compile
-            jax.device_get(out[0, 0])
-            t0 = _time.perf_counter()
-            out = gen_lib.generate(params, cfg.model, prompt, new_tokens)
-            jax.device_get(out[0, 0])
-            dt = _time.perf_counter() - t0
-            tps = batch * new_tokens / dt
-        except Exception as exc:  # noqa: BLE001 — KV cache OOM: keep best
-            if best == 0.0:
-                raise  # nothing measured: surface the REAL error type
-            print(f'[bench] decode b{batch} failed '
-                  f'({type(exc).__name__}); keeping the b<{batch} result',
+    per_variant: dict = {}
+
+    def sweep(label, p):
+        best = 0.0
+        for batch in (32, 64, 128):
+            try:
+                prompt = jnp.ones((batch, prompt_len), jnp.int32)
+                out = gen_lib.generate(p, cfg.model, prompt,
+                                       new_tokens)  # compile
+                jax.device_get(out[0, 0])
+                t0 = _time.perf_counter()
+                out = gen_lib.generate(p, cfg.model, prompt, new_tokens)
+                jax.device_get(out[0, 0])
+                dt = _time.perf_counter() - t0
+                tps = batch * new_tokens / dt
+            except Exception as exc:  # noqa: BLE001 — KV-cache OOM: keep best
+                if best == 0.0 and not per_variant:
+                    raise  # nothing measured: surface the REAL error type
+                print(f'[bench] decode {label} b{batch} failed '
+                      f'({type(exc).__name__}); keeping earlier results',
+                      file=sys.stderr)
+                break
+            print(f'[bench] decode {label} b{batch}: {tps:.0f} tok/s',
                   file=sys.stderr)
-            break
-        print(f'[bench] decode b{batch}: {tps:.0f} tok/s', file=sys.stderr)
-        best = max(best, tps)
-    return best
+            best = max(best, tps)
+        per_variant[label] = round(best, 1)
+        return best
+
+    # bf16 first, then REPLACE the weight tree with the int8 one before
+    # its sweep — holding both resident would shrink KV-cache headroom
+    # and under-report the batches a real deployment (one tree) fits.
+    best = sweep('bf16', params)
+    q = quant_lib.quantize_params(params)
+    del params
+    best = max(best, sweep('int8', q))
+    return best, per_variant
 
 
 def _measure_provision_to_first_step() -> float:
@@ -311,9 +327,11 @@ def _bench_tpu() -> dict:
     except Exception as exc:  # never let the latency probe kill the bench
         provision_s = f'failed: {type(exc).__name__}'
     decode_tps = None
+    decode_variants = None
     if on_tpu:
         try:
-            decode_tps = round(_measure_decode_throughput(cfg), 1)
+            best, decode_variants = _measure_decode_throughput(cfg)
+            decode_tps = round(best, 1)
         except Exception as exc:  # secondary metric: never kill the bench
             decode_tps = f'failed: {type(exc).__name__}'
 
@@ -341,7 +359,10 @@ def _bench_tpu() -> dict:
             # launch->first-output path (provision + bootstrap + gang
             # exec), not provision on real cloud infra.
             'local_provider_first_step_s': provision_s,
+            # Best across weight formats; the per-format breakdown
+            # (bf16 vs int8 weight-only) is decode_variants.
             'decode_tokens_per_sec': decode_tps,
+            'decode_variants': decode_variants,
             'cpu_fallback': not on_tpu,
             # Present only when the TPU probe failed: hang phase + child
             # stack + process table + relay sockets, so the artifact

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from skypilot_tpu.models import llama, moe
+from skypilot_tpu.models.quantization import mm as _mm
 
 Params = llama.Params
 _NEG_INF = -1e30
@@ -96,9 +97,12 @@ def _cached_layer(cfg: llama.LlamaConfig, x: jax.Array, layer: Params,
     ``valid`` [B] = cache_lens + real new tokens per row (< S for padded
     rows); returns (x, k, v)."""
     h = llama.rms_norm(x, layer['attn_norm'], cfg.norm_eps)
-    q = jnp.einsum('bsd,dhk->bshk', h, layer['wq'])
-    k = jnp.einsum('bsd,dhk->bshk', h, layer['wk'])
-    v = jnp.einsum('bsd,dhk->bshk', h, layer['wv'])
+    # _mm = einsum that transparently handles int8 weight-only
+    # quantized leaves (models/quantization.py) — the serving
+    # deployment path; full-precision weights take the same route.
+    q = _mm(h, layer['wq'], 'bsd,dhk->bshk')
+    k = _mm(h, layer['wk'], 'bsd,dhk->bshk')
+    v = _mm(h, layer['wv'], 'bsd,dhk->bshk')
     q = llama.rope(q, positions, cfg.rope_theta)
     k = llama.rope(k, positions, cfg.rope_theta)
     # Write the new keys/values at [start, start + S). Uniform batches
@@ -118,7 +122,7 @@ def _cached_layer(cfg: llama.LlamaConfig, x: jax.Array, layer: Params,
         k_cache = _row_update(k_cache, kt, cache_lens)
         v_cache = _row_update(v_cache, vt, cache_lens)
     att = _cached_attention(q, k_cache, v_cache, positions, valid)
-    x = x + jnp.einsum('bshk,hkd->bsd', att, layer['wo'])
+    x = x + _mm(att, layer['wo'], 'bshk,hkd->bsd')
     h = llama.rms_norm(x, layer['mlp_norm'], cfg.norm_eps)
     if cfg.num_experts > 0:
         # MoE decode: same GShard dense-einsum dispatch as training
@@ -139,10 +143,10 @@ def _cached_layer(cfg: llama.LlamaConfig, x: jax.Array, layer: Params,
                                  token_mask=token_mask)
         x = x + mlp_out
     else:
-        gate = jnp.einsum('bsd,df->bsf', h, layer['w_gate'])
-        up = jnp.einsum('bsd,df->bsf', h, layer['w_up'])
-        x = x + jnp.einsum('bsf,fd->bsd', jax.nn.silu(gate) * up,
-                           layer['w_down'])
+        gate = _mm(h, layer['w_gate'], 'bsd,df->bsf')
+        up = _mm(h, layer['w_up'], 'bsd,df->bsf')
+        x = x + _mm(jax.nn.silu(gate) * up, layer['w_down'],
+                    'bsf,fd->bsd')
     return x, k_cache, v_cache
 
 
@@ -193,8 +197,8 @@ def forward_cached(params: Params, tokens: jax.Array,
         last = jnp.take_along_axis(
             x, (row_lens - 1)[:, None, None].astype(jnp.int32), axis=1
         )[:, 0]
-    logits = jnp.einsum('bd,dv->bv', last, params['lm_head'],
-                        preferred_element_type=jnp.float32)
+    logits = _mm(last, params['lm_head'], 'bd,dv->bv',
+                 preferred_element_type=jnp.float32)
     return logits, KVCache(k=new_k, v=new_v, lengths=new_lengths)
 
 

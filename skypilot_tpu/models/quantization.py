@@ -1,0 +1,106 @@
+"""Int8 weight-only quantization for the serving path.
+
+Reference analog: the reference serves via JetStream/vLLM, whose TPU
+configs ship int8 weight quantization as the standard decode speedup
+(``examples/tpu/v6e/README.md`` serving section). Decode is HBM-bound —
+every step streams the full weight set — so halving weight bytes is the
+highest-leverage serving optimization after batching.
+
+TPU-native shape: a pure tree transformation (like ``models/lora.py``).
+Target weights are replaced by ``{'q8': int8, 's': float32}`` leaves with
+symmetric per-output-channel scales; the consuming einsum computes in the
+activation dtype and applies the scale POST-matmul (exact for per-output
+channels), so XLA fuses the int8 load + convert into the matmul's operand
+read and the full-precision weight never materializes in HBM.
+
+Training stays full precision — quantize at deployment
+(``quantize_params``), serve with the same ``generate`` path.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models import llama
+
+Params = llama.Params
+
+# Per-target: number of CONTRACTION dims at the front of the (unstacked)
+# weight; the remaining dims are output channels (one scale each).
+# Layer weights carry a leading stacked-layer dim handled separately.
+_LAYER_TARGETS = {
+    'wq': 1, 'wk': 1, 'wv': 1,   # (d, h, k): contract d
+    'wo': 2,                     # (h, k, d): contract h,k
+    'w_gate': 1, 'w_up': 1,      # (d, f)
+    'w_down': 1,                 # (f, d)
+}
+_TOP_TARGETS = {'lm_head': 1}    # (d, v): contract d; embed stays bf16
+                                 # (it is a gather, not a matmul)
+
+
+def is_quantized(w: Any) -> bool:
+    return isinstance(w, dict) and 'q8' in w
+
+
+def _quantize(w: jax.Array, n_contract: int, stacked: bool) -> Dict[str, Any]:
+    """Symmetric per-output-channel int8: s = max|W|/127 over the
+    contraction dims, q = round(W/s)."""
+    axes = tuple(range(1, 1 + n_contract) if stacked
+                 else range(n_contract))
+    w32 = w.astype(jnp.float32)
+    s = jnp.max(jnp.abs(w32), axis=axes) / 127.0
+    s = jnp.maximum(s, 1e-8)  # all-zero channels: avoid div-by-zero
+    s_b = jnp.expand_dims(s, axes)
+    q = jnp.clip(jnp.round(w32 / s_b), -127, 127).astype(jnp.int8)
+    return {'q8': q, 's': s}
+
+
+def dequantize(w: Dict[str, Any], n_contract: int,
+               stacked: bool) -> jax.Array:
+    axes = tuple(range(1, 1 + n_contract) if stacked
+                 else range(n_contract))
+    return (w['q8'].astype(jnp.float32)
+            * jnp.expand_dims(w['s'], axes))
+
+
+def quantize_params(params: Params) -> Params:
+    """Quantize the dense matmul weights; everything else (embed, norms,
+    MoE experts) passes through untouched. The returned tree drops into
+    ``generate.forward_cached`` unchanged — its einsums dispatch on the
+    quantized leaves."""
+    layers = dict(params['layers'])
+    for name, n_c in _LAYER_TARGETS.items():
+        if name in layers:
+            layers[name] = _quantize(layers[name], n_c, stacked=True)
+    out = {**params, 'layers': layers}
+    for name, n_c in _TOP_TARGETS.items():
+        if name in out:
+            out[name] = _quantize(out[name], n_c, stacked=False)
+    return out
+
+
+def mm(x: jax.Array, w: Any, spec: str,
+       preferred_element_type: Any = None) -> jax.Array:
+    """``jnp.einsum(spec, x, w)`` that transparently handles a quantized
+    weight: matmul against the raw int8 codes (converted to the
+    activation dtype — XLA fuses the convert into the matmul's operand
+    read, so HBM traffic is the int8 bytes) then scale per output
+    channel. The scale's dims are exactly the weight's non-contracted
+    dims, which an einsum always emits as the output's TRAILING dims — a
+    plain trailing broadcast."""
+    if not is_quantized(w):
+        return jnp.einsum(spec, x, w,
+                          preferred_element_type=preferred_element_type)
+    y = jnp.einsum(spec, x, w['q8'].astype(x.dtype),
+                   preferred_element_type=jnp.float32)
+    y = y * w['s']
+    if preferred_element_type is not None:
+        return y.astype(preferred_element_type)
+    return y.astype(x.dtype)
+
+
+def param_bytes(tree: Any) -> int:
+    return sum(leaf.size * leaf.dtype.itemsize
+               for leaf in jax.tree.leaves(tree))

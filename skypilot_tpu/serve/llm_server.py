@@ -59,11 +59,22 @@ class _Pending:
 
 class LlmServer:
 
-    def __init__(self, model: str, max_len: int = 1024, seed: int = 0):
+    def __init__(self, model: str, max_len: int = 1024, seed: int = 0,
+                 quantize: Optional[str] = None):
         self.model_name = model
         self.cfg = llama.PRESETS[model]
         self.max_len = min(max_len, self.cfg.max_seq_len)
         self.params = llama.init_params(jax.random.PRNGKey(seed), self.cfg)
+        self.quantize = quantize or os.environ.get('SKYTPU_LLM_QUANTIZE')
+        if self.quantize:
+            if self.quantize != 'int8':
+                raise ValueError(
+                    f'Unknown quantization {self.quantize!r}; only '
+                    "'int8' (weight-only) is supported")
+            # Deployment-time int8 weight-only quantization: halves the
+            # per-decode-step weight stream (models/quantization.py).
+            from skypilot_tpu.models import quantization as quant_lib
+            self.params = quant_lib.quantize_params(self.params)
         self._queue: asyncio.Queue = asyncio.Queue()
         self._overflow: List[_Pending] = []  # spilled past MAX_BATCH
         self._worker: Optional[asyncio.Task] = None
@@ -73,6 +84,7 @@ class LlmServer:
     async def health(self, request: web.Request) -> web.Response:
         del request
         return web.json_response({'status': 'ok', 'model': self.model_name,
+                                  'quantize': self.quantize,
                                   'max_len': self.max_len,
                                   'batches_served': self.batches_served,
                                   'max_batch_seen': self.max_batch_seen})
@@ -245,8 +257,12 @@ def main() -> None:
                         default=int(os.environ.get('SKYTPU_REPLICA_PORT',
                                                    '8080')))
     parser.add_argument('--host', default='0.0.0.0')
+    parser.add_argument('--quantize', default=None,
+                        help="'int8' = weight-only quantized decode "
+                             '(also via SKYTPU_LLM_QUANTIZE)')
     args = parser.parse_args()
-    server = LlmServer(args.model, max_len=args.max_len)
+    server = LlmServer(args.model, max_len=args.max_len,
+                       quantize=args.quantize)
     web.run_app(server.make_app(), host=args.host, port=args.port,
                 print=lambda *a: None)
 
