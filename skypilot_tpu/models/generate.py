@@ -90,12 +90,17 @@ def _row_update(cache: jax.Array, new: jax.Array,
 def _cached_layer(cfg: llama.LlamaConfig, x: jax.Array, layer: Params,
                   positions: jax.Array, k_cache: jax.Array,
                   v_cache: jax.Array, cache_lens: jax.Array,
-                  valid: jax.Array
+                  valid: jax.Array,
+                  active_rows: Optional[jax.Array] = None
                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One decoder block writing this block's K/V into the cache.
     x: [B, S, d]; k/v_cache: [B, Hkv, max_len, D]; ``cache_lens`` [B];
     ``valid`` [B] = cache_lens + real new tokens per row (< S for padded
-    rows); returns (x, k, v)."""
+    rows); ``active_rows`` [B] bool marks rows that are live requests —
+    the continuous-batching engine (``models/engine.py``) decodes its
+    FULL slot batch every step, and a freed slot's junk row must not
+    consume MoE expert capacity (attention is per-row, so only expert
+    routing couples rows); returns (x, k, v)."""
     h = llama.rms_norm(x, layer['attn_norm'], cfg.norm_eps)
     # _mm = einsum that transparently handles int8 weight-only
     # quantized leaves (models/quantization.py) — the serving
@@ -133,10 +138,14 @@ def _cached_layer(cfg: llama.LlamaConfig, x: jax.Array, layer: Params,
         # tokens never consume expert capacity (they could otherwise
         # displace other rows' real tokens under the choice-major
         # capacity cumsum).
-        if valid.ndim == 0:
+        if valid.ndim == 0 and active_rows is None:
             token_mask = None  # uniform batch: every position is real
         else:
-            token_mask = (positions < valid[:, None]).astype(h.dtype)
+            vb = valid if valid.ndim == 0 else valid[:, None]
+            mask = positions < vb
+            if active_rows is not None:
+                mask = mask & active_rows[:, None]
+            token_mask = mask.astype(h.dtype)
         mlp_out, _ = moe.moe_mlp(h, layer['moe'], cfg.num_experts,
                                  cfg.expert_top_k,
                                  cfg.expert_capacity_factor,
@@ -152,14 +161,17 @@ def _cached_layer(cfg: llama.LlamaConfig, x: jax.Array, layer: Params,
 
 def forward_cached(params: Params, tokens: jax.Array,
                    cache: KVCache, cfg: llama.LlamaConfig,
-                   row_lens: Optional[jax.Array] = None
+                   row_lens: Optional[jax.Array] = None,
+                   active_rows: Optional[jax.Array] = None
                    ) -> Tuple[jax.Array, KVCache]:
     """Run ``tokens`` [B, S] through the model appending to ``cache``;
     returns (logits for each row's LAST REAL position [B, vocab], updated
     cache). Works for prefill (S = padded prompt length) and decode
     (S = 1), dense and MoE models alike. ``row_lens`` [B] gives each row's
     real token count within ``tokens`` (defaults to S — unpadded batch);
-    rows advance independently, enabling mixed-length serving batches."""
+    rows advance independently, enabling mixed-length serving batches.
+    ``active_rows`` [B] bool (optional) marks live rows; see
+    ``_cached_layer`` — only MoE expert routing couples rows."""
     b, s = tokens.shape
     uniform = row_lens is None  # STATIC: picks the cheap scalar-offset path
     if uniform:
@@ -183,7 +195,7 @@ def forward_cached(params: Params, tokens: jax.Array,
         x = carry
         layer, k_c, v_c = xs
         x, k_c, v_c = _cached_layer(cfg, x, layer, positions, k_c, v_c,
-                                    write_start, valid)
+                                    write_start, valid, active_rows)
         return x, (k_c, v_c)
 
     x, (new_k, new_v) = jax.lax.scan(

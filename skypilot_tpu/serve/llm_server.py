@@ -3,10 +3,20 @@
 Reference analog: the reference serves LLMs by pointing ``sky serve`` at
 JetStream/vLLM containers (``examples/tpu/v6e/README.md:112-118``); this is
 the TPU-native replica process: the KV-cache generate path
-(``models/generate.py``) behind a minimal HTTP API with DYNAMIC BATCHING —
-concurrent requests landing within the batch window are right-padded into
-one prefill/decode (decode is HBM-bound, so throughput scales nearly
-linearly with batch; measured on v5e: 1.8k tok/s single -> 4k+ batched).
+(``models/generate.py``) behind a minimal HTTP API.
+
+Two execution paths:
+
+* CONTINUOUS BATCHING (default — ``models/engine.py``): JetStream-style
+  slot server; requests prefill into free slots of a persistent decode
+  batch, so short requests drain mid-stream instead of waiting for the
+  batch's slowest member. ``SKYTPU_LLM_ENGINE=off`` disables.
+* WINDOW BATCHING (legacy, and always used for seeded sampling — whose
+  determinism contract is incompatible with continuous batching):
+  concurrent requests landing within the batch window are right-padded
+  into one prefill/decode (decode is HBM-bound, so throughput scales
+  nearly linearly with batch; measured on v5e: 1.8k tok/s single ->
+  4k+ batched -> 5k+ continuous).
 
 API (token-level; tokenization is the client's concern — no tokenizer
 assets ship in-image):
@@ -60,7 +70,8 @@ class _Pending:
 class LlmServer:
 
     def __init__(self, model: str, max_len: int = 1024, seed: int = 0,
-                 quantize: Optional[str] = None):
+                 quantize: Optional[str] = None,
+                 engine: Optional[str] = None):
         self.model_name = model
         self.cfg = llama.PRESETS[model]
         self.max_len = min(max_len, self.cfg.max_seq_len)
@@ -75,6 +86,15 @@ class LlmServer:
             # per-decode-step weight stream (models/quantization.py).
             from skypilot_tpu.models import quantization as quant_lib
             self.params = quant_lib.quantize_params(self.params)
+        engine = engine or os.environ.get('SKYTPU_LLM_ENGINE', 'continuous')
+        if engine not in ('continuous', 'off'):
+            raise ValueError(f"Unknown engine {engine!r}; 'continuous' "
+                             "or 'off'")
+        self.engine = None
+        if engine == 'continuous':
+            from skypilot_tpu.models.engine import ContinuousEngine
+            self.engine = ContinuousEngine(self.params, self.cfg,
+                                           max_len=self.max_len)
         self._queue: asyncio.Queue = asyncio.Queue()
         self._overflow: List[_Pending] = []  # spilled past MAX_BATCH
         self._worker: Optional[asyncio.Task] = None
@@ -83,11 +103,14 @@ class LlmServer:
 
     async def health(self, request: web.Request) -> web.Response:
         del request
-        return web.json_response({'status': 'ok', 'model': self.model_name,
-                                  'quantize': self.quantize,
-                                  'max_len': self.max_len,
-                                  'batches_served': self.batches_served,
-                                  'max_batch_seen': self.max_batch_seen})
+        body = {'status': 'ok', 'model': self.model_name,
+                'quantize': self.quantize,
+                'max_len': self.max_len,
+                'batches_served': self.batches_served,
+                'max_batch_seen': self.max_batch_seen}
+        if self.engine is not None:
+            body['engine'] = self.engine.stats()
+        return web.json_response(body)
 
     # -- batching worker ---------------------------------------------------
 
@@ -232,7 +255,15 @@ class LlmServer:
             return web.json_response(
                 {'error': f'prompt+max_new_tokens exceeds max_len '
                           f'{self.max_len}'}, status=400)
-        pending = _Pending(rows, max_new, temperature, body.get('seed'))
+        seed = body.get('seed')
+        seeded = temperature > 0 and seed is not None
+        if self.engine is not None and not seeded:
+            # Continuous-batching path: one engine slot per row.
+            futs = [asyncio.wrap_future(
+                self.engine.submit(r, max_new, temperature)) for r in rows]
+            out = await asyncio.gather(*futs)
+            return web.json_response({'tokens': [list(o) for o in out]})
+        pending = _Pending(rows, max_new, temperature, seed)
         self._ensure_worker()
         await self._queue.put(pending)
         out = await pending.future
@@ -260,9 +291,13 @@ def main() -> None:
     parser.add_argument('--quantize', default=None,
                         help="'int8' = weight-only quantized decode "
                              '(also via SKYTPU_LLM_QUANTIZE)')
+    parser.add_argument('--engine', default=None,
+                        help="'continuous' (default: JetStream-style slot "
+                             "server) or 'off' (window batching only; "
+                             'also via SKYTPU_LLM_ENGINE)')
     args = parser.parse_args()
     server = LlmServer(args.model, max_len=args.max_len,
-                       quantize=args.quantize)
+                       quantize=args.quantize, engine=args.engine)
     web.run_app(server.make_app(), host=args.host, port=args.port,
                 print=lambda *a: None)
 

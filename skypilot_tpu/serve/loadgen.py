@@ -21,9 +21,21 @@ import random
 import time
 
 
-async def _one(session, url: str, prompt_len: int, max_new: int,
+def _span(spec: str):
+    """'128' -> (128, 128); '32:128' -> (32, 128) — per-request uniform
+    sampling. Mixed lengths are the workload continuous batching exists
+    for (short requests drain and refill slots while long ones stream);
+    fixed lengths are window batching's best case. Measure both."""
+    lo, _, hi = str(spec).partition(':')
+    lo = int(lo)
+    return lo, int(hi) if hi else lo
+
+
+async def _one(session, url: str, prompt_span, max_new_span,
                vocab: int, seed: int):
     rng = random.Random(seed)
+    prompt_len = rng.randint(*prompt_span)
+    max_new = rng.randint(*max_new_span)
     tokens = [rng.randrange(1, vocab) for _ in range(prompt_len)]
     t0 = time.perf_counter()
     try:
@@ -43,16 +55,17 @@ async def _one(session, url: str, prompt_len: int, max_new: int,
 
 
 async def run_load(url: str, requests_total: int, concurrency: int,
-                   prompt_len: int, max_new: int, vocab: int) -> dict:
+                   prompt_len, max_new, vocab: int) -> dict:
     import aiohttp
+    prompt_span, max_new_span = _span(prompt_len), _span(max_new)
     sem = asyncio.Semaphore(concurrency)
     results = []
 
     async with aiohttp.ClientSession() as session:
         async def _bounded(i):
             async with sem:
-                results.append(await _one(session, url, prompt_len,
-                                          max_new, vocab, seed=i))
+                results.append(await _one(session, url, prompt_span,
+                                          max_new_span, vocab, seed=i))
 
         t0 = time.perf_counter()
         await asyncio.gather(*(_bounded(i) for i in range(requests_total)))
@@ -65,8 +78,8 @@ async def run_load(url: str, requests_total: int, concurrency: int,
         'requests': requests_total,
         'ok': len(oks),
         'concurrency': concurrency,
-        'prompt_len': prompt_len,
-        'max_new_tokens': max_new,
+        'prompt_len': str(prompt_len),
+        'max_new_tokens': str(max_new),
         'wall_s': round(wall, 3),
         'new_tokens': new_tokens,
         'decode_tokens_per_sec': round(new_tokens / wall, 1) if wall else 0,
@@ -88,8 +101,12 @@ def main() -> None:
                         help='serve endpoint, e.g. http://host:9000')
     parser.add_argument('--requests', type=int, default=64)
     parser.add_argument('--concurrency', type=int, default=16)
-    parser.add_argument('--prompt-len', type=int, default=128)
-    parser.add_argument('--max-new-tokens', type=int, default=64)
+    parser.add_argument('--prompt-len', default='128',
+                        help="fixed ('128') or per-request uniform range "
+                             "('32:128')")
+    parser.add_argument('--max-new-tokens', default='64',
+                        help="fixed ('64') or per-request uniform range "
+                             "('16:128')")
     parser.add_argument('--vocab', type=int, default=256,
                         help='token id range for synthetic prompts (match '
                              'the served model vocab)')

@@ -204,7 +204,10 @@ def test_llm_server_dynamic_batching(tiny, monkeypatch):
 
     monkeypatch.setattr(llm_mod, 'BATCH_WINDOW_S', 0.5)
     cfg, params = tiny
-    server = llm_mod.LlmServer('tiny', max_len=64)
+    # engine='off' pins the legacy window-batched path (the continuous
+    # engine would otherwise absorb these; it has its own suite in
+    # tests/test_engine.py).
+    server = llm_mod.LlmServer('tiny', max_len=64, engine='off')
     server.params = params  # same weights as the oracle below
     port = common_utils.find_free_port(21200)
     started = threading.Event()
