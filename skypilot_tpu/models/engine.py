@@ -141,7 +141,8 @@ class ContinuousEngine:
     def __init__(self, params, cfg: llama.LlamaConfig, *,
                  slots: Optional[int] = None, max_len: int = 1024,
                  chunk_steps: Optional[int] = None,
-                 prefill_batch: Optional[int] = None, seed: int = 0):
+                 prefill_batch: Optional[int] = None, seed: int = 0,
+                 mesh=None, rules=None):
         self.params = params
         self.cfg = cfg
         self.slots = slots or int(os.environ.get('SKYTPU_LLM_SLOTS', '16'))
@@ -151,8 +152,25 @@ class ContinuousEngine:
         self.prefill_batch = min(
             prefill_batch or int(os.environ.get('SKYTPU_LLM_PREFILL_BATCH',
                                                 '8')), self.slots)
-        self._cache = gen_lib.init_cache(cfg, self.slots, self.max_len)
-        self._last = jnp.zeros((self.slots,), jnp.int32)
+        # Sharded serving (JetStream serves 8B+ models sharded the same
+        # way): with a mesh, weights are placed by the training stack's
+        # logical rules (tensor axis -> heads/mlp/vocab, i.e. classic TP)
+        # and the KV cache shards its kv_heads; every jitted engine fn
+        # then compiles to an SPMD program — XLA inserts the collectives.
+        self.mesh = mesh
+        self.rules = rules
+        if mesh is not None:
+            from skypilot_tpu.models import quantization as quant_lib
+            from skypilot_tpu.parallel import sharding as sharding_lib
+            self.rules = rules or sharding_lib.ShardingRules()
+            self.params = quant_lib.shard_params(params, cfg, mesh,
+                                                 self.rules)
+            self._kv_sharding = sharding_lib.logical_sharding(
+                mesh, self.rules,
+                ('layers', 'batch', 'kv_heads', None, 'head_dim'))
+            self._vec_sharding = sharding_lib.logical_sharding(
+                mesh, self.rules, ('batch',))
+        self._init_device_state()
         self._slot_req: List[Optional[_Request]] = [None] * self.slots
         self._pending: collections.deque = collections.deque()
         self._unfetched: List[tuple] = []  # [(reqs, firsts-device-array)]
@@ -251,9 +269,28 @@ class ContinuousEngine:
                 req.future.set_exception(exc)
         # Fresh device state: the failed dispatch may have already
         # consumed (donation) or half-written the old buffers.
-        self._cache = gen_lib.init_cache(self.cfg, self.slots,
-                                         self.max_len)
-        self._last = jnp.zeros((self.slots,), jnp.int32)
+        self._init_device_state()
+
+    def _init_device_state(self) -> None:
+        if self.mesh is None:
+            self._cache = gen_lib.init_cache(self.cfg, self.slots,
+                                             self.max_len)
+            self._last = jnp.zeros((self.slots,), jnp.int32)
+            return
+        # Born sharded: on a replica sized so the cache only fits spread
+        # over the slice, a transient single-device allocation (plain
+        # init_cache + device_put) would OOM chip 0 — at construction
+        # AND at every _fail_everything recovery.
+        cfg = self.cfg
+        shape = (cfg.n_layers, self.slots, cfg.n_kv_heads, self.max_len,
+                 cfg.head_dim)
+        self._cache = gen_lib.KVCache(
+            k=jnp.zeros(shape, cfg.dtype, device=self._kv_sharding),
+            v=jnp.zeros(shape, cfg.dtype, device=self._kv_sharding),
+            lengths=jnp.zeros((self.slots,), jnp.int32,
+                              device=self._vec_sharding))
+        self._last = jnp.zeros((self.slots,), jnp.int32,
+                               device=self._vec_sharding)
 
     def _next_key(self) -> jax.Array:
         self._key, sub = jax.random.split(self._key)

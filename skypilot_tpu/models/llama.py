@@ -134,6 +134,19 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
     }
 
 
+def init_params_sharded(key: jax.Array, cfg: LlamaConfig, mesh,
+                        rules=None) -> Params:
+    """``init_params`` jitted with sharded out_shardings: each device
+    materializes only ITS shard, so a model that only fits sharded
+    (8B on v5e-8 tensor parallel) never transits one chip whole."""
+    from skypilot_tpu.parallel import sharding as sharding_lib
+    rules = rules or sharding_lib.ShardingRules()
+    shardings = sharding_lib.sharding_tree(param_logical_axes(cfg), mesh,
+                                           rules)
+    return jax.jit(init_params, static_argnums=(1,),
+                   out_shardings=shardings)(key, cfg)
+
+
 def param_logical_axes(cfg: LlamaConfig) -> Params:
     """Logical sharding axes matching init_params' tree (leaves = tuples)."""
     layers: Params = {

@@ -81,6 +81,62 @@ def quantize_params(params: Params) -> Params:
     return out
 
 
+def logical_axes_for(params: Params, cfg: llama.LlamaConfig) -> Params:
+    """Logical sharding axes matching ``params``, which may be a
+    ``quantize_params`` output: a quantized leaf's ``q8`` codes keep the
+    original weight's axes, and its per-output-channel ``s`` scales keep
+    exactly the NON-contracted axes (so a tensor-parallel mesh shards the
+    scales with the output channels they belong to). Full-precision trees
+    come back as plain ``llama.param_logical_axes``."""
+    base = llama.param_logical_axes(cfg)
+    layers = dict(base['layers'])
+    for name, n_c in _LAYER_TARGETS.items():
+        if name in layers and is_quantized(params['layers'][name]):
+            axes = layers[name]  # ('layers', <contract...>, <outputs...>)
+            layers[name] = {'q8': axes,
+                            's': (axes[0],) + axes[1 + n_c:]}
+    out = {**base, 'layers': layers}
+    for name, n_c in _TOP_TARGETS.items():
+        if name in out and is_quantized(params[name]):
+            axes = out[name]
+            out[name] = {'q8': axes, 's': axes[n_c:]}
+    return out
+
+
+def shard_params(params: Params, cfg: llama.LlamaConfig, mesh,
+                 rules=None) -> Params:
+    """Place a (possibly quantized) serving tree on ``mesh`` by the
+    training stack's logical rules — THE one shard recipe every serving
+    path uses (engine and window path must never diverge). Already-
+    sharded trees pass through as a no-op device_put."""
+    from skypilot_tpu.parallel import sharding as sharding_lib
+    rules = rules or sharding_lib.ShardingRules()
+    return sharding_lib.shard_pytree(params, logical_axes_for(params, cfg),
+                                     mesh, rules)
+
+
+def quantize_params_sharded(params: Params, cfg: llama.LlamaConfig, mesh,
+                            rules=None) -> Params:
+    """``quantize_params`` jitted with sharded out_shardings: the int8
+    codes/scales are born sharded, so quantizing a model that only fits
+    sharded never materializes fp32 intermediates on one chip."""
+    from skypilot_tpu.parallel import sharding as sharding_lib
+    rules = rules or sharding_lib.ShardingRules()
+    base = llama.param_logical_axes(cfg)
+    layers = dict(base['layers'])
+    for name, n_c in _LAYER_TARGETS.items():
+        if name in layers:
+            axes = layers[name]
+            layers[name] = {'q8': axes, 's': (axes[0],) + axes[1 + n_c:]}
+    out_axes = {**base, 'layers': layers}
+    for name, n_c in _TOP_TARGETS.items():
+        if name in out_axes:
+            axes = out_axes[name]
+            out_axes[name] = {'q8': axes, 's': axes[n_c:]}
+    shardings = sharding_lib.sharding_tree(out_axes, mesh, rules)
+    return jax.jit(quantize_params, out_shardings=shardings)(params)
+
+
 def mm(x: jax.Array, w: Any, spec: str,
        preferred_element_type: Any = None) -> jax.Array:
     """``jnp.einsum(spec, x, w)`` that transparently handles a quantized

@@ -71,11 +71,28 @@ class LlmServer:
 
     def __init__(self, model: str, max_len: int = 1024, seed: int = 0,
                  quantize: Optional[str] = None,
-                 engine: Optional[str] = None):
+                 engine: Optional[str] = None, tp: Optional[int] = None):
         self.model_name = model
         self.cfg = llama.PRESETS[model]
         self.max_len = min(max_len, self.cfg.max_seq_len)
-        self.params = llama.init_params(jax.random.PRNGKey(seed), self.cfg)
+        # Tensor-parallel serving over the replica's slice: a mesh whose
+        # `tensor` axis spans tp chips; weights/KV shard by the training
+        # stack's logical rules and every decode step runs SPMD (the way
+        # JetStream serves sharded 8B+ models). Weights are initialized
+        # (and quantized) SHARDED — a model that only fits spread over
+        # the slice must never transit one chip whole.
+        self.tp = tp or int(os.environ.get('SKYTPU_LLM_TP', '1'))
+        self.mesh = None
+        key = jax.random.PRNGKey(seed)
+        if self.tp > 1:
+            from skypilot_tpu.parallel import mesh as mesh_lib
+            self.mesh = mesh_lib.build_mesh(
+                mesh_lib.MeshSpec(fsdp=1, tensor=self.tp),
+                devices=jax.devices()[:self.tp])
+            self.params = llama.init_params_sharded(key, self.cfg,
+                                                    self.mesh)
+        else:
+            self.params = llama.init_params(key, self.cfg)
         self.quantize = quantize or os.environ.get('SKYTPU_LLM_QUANTIZE')
         if self.quantize:
             if self.quantize != 'int8':
@@ -85,7 +102,11 @@ class LlmServer:
             # Deployment-time int8 weight-only quantization: halves the
             # per-decode-step weight stream (models/quantization.py).
             from skypilot_tpu.models import quantization as quant_lib
-            self.params = quant_lib.quantize_params(self.params)
+            if self.mesh is not None:
+                self.params = quant_lib.quantize_params_sharded(
+                    self.params, self.cfg, self.mesh)
+            else:
+                self.params = quant_lib.quantize_params(self.params)
         engine = engine or os.environ.get('SKYTPU_LLM_ENGINE', 'continuous')
         if engine not in ('continuous', 'off'):
             raise ValueError(f"Unknown engine {engine!r}; 'continuous' "
@@ -93,8 +114,13 @@ class LlmServer:
         self.engine = None
         if engine == 'continuous':
             from skypilot_tpu.models.engine import ContinuousEngine
+            # params are already mesh-placed when tp > 1, so the engine's
+            # own shard_params is a no-op placement — both paths serve
+            # the SAME resident weights.
             self.engine = ContinuousEngine(self.params, self.cfg,
-                                           max_len=self.max_len)
+                                           max_len=self.max_len,
+                                           mesh=self.mesh)
+            self.params = self.engine.params
         self._queue: asyncio.Queue = asyncio.Queue()
         self._overflow: List[_Pending] = []  # spilled past MAX_BATCH
         self._worker: Optional[asyncio.Task] = None
@@ -104,7 +130,7 @@ class LlmServer:
     async def health(self, request: web.Request) -> web.Response:
         del request
         body = {'status': 'ok', 'model': self.model_name,
-                'quantize': self.quantize,
+                'quantize': self.quantize, 'tp': self.tp,
                 'max_len': self.max_len,
                 'batches_served': self.batches_served,
                 'max_batch_seen': self.max_batch_seen}
@@ -295,9 +321,14 @@ def main() -> None:
                         help="'continuous' (default: JetStream-style slot "
                              "server) or 'off' (window batching only; "
                              'also via SKYTPU_LLM_ENGINE)')
+    parser.add_argument('--tp', type=int, default=None,
+                        help='tensor-parallel degree: shard weights/KV '
+                             'over the first N local devices (also via '
+                             'SKYTPU_LLM_TP)')
     args = parser.parse_args()
     server = LlmServer(args.model, max_len=args.max_len,
-                       quantize=args.quantize, engine=args.engine)
+                       quantize=args.quantize, engine=args.engine,
+                       tp=args.tp)
     web.run_app(server.make_app(), host=args.host, port=args.port,
                 print=lambda *a: None)
 
