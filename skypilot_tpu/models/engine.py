@@ -55,12 +55,15 @@ from skypilot_tpu.models import llama
 class _Request:
     """Host-side bookkeeping for one prompt row occupying (at most) one
     slot. ``tokens`` accumulates emitted ids; the future resolves with
-    the full list once ``max_new`` have been produced."""
+    the full list once ``max_new`` have been produced. ``on_tokens``
+    (optional) is called from the ENGINE thread with each newly emitted
+    batch of ids as it lands (streaming) — it must not block."""
     row: List[int]
     max_new: int
     temperature: float
     future: concurrent.futures.Future
     tokens: List[int] = dataclasses.field(default_factory=list)
+    on_tokens: Optional[object] = None
 
 
 def prompt_bucket(n: int, lo: int = 16) -> int:
@@ -189,13 +192,14 @@ class ContinuousEngine:
     # -- public API (any thread) ------------------------------------------
 
     def submit(self, row: List[int], max_new: int,
-               temperature: float = 0.0) -> concurrent.futures.Future:
+               temperature: float = 0.0,
+               on_tokens=None) -> concurrent.futures.Future:
         if len(row) + max_new > self.max_len:
             raise ValueError(
                 f'prompt ({len(row)}) + max_new ({max_new}) exceeds '
                 f'engine max_len {self.max_len}')
         req = _Request(list(row), max_new, float(temperature),
-                       concurrent.futures.Future())
+                       concurrent.futures.Future(), on_tokens=on_tokens)
         with self._lock:
             self._pending.append(req)
         self.start()  # idempotent; revives a stop()ped engine
@@ -272,25 +276,29 @@ class ContinuousEngine:
         self._init_device_state()
 
     def _init_device_state(self) -> None:
-        if self.mesh is None:
-            self._cache = gen_lib.init_cache(self.cfg, self.slots,
-                                             self.max_len)
-            self._last = jnp.zeros((self.slots,), jnp.int32)
-            return
-        # Born sharded: on a replica sized so the cache only fits spread
-        # over the slice, a transient single-device allocation (plain
-        # init_cache + device_put) would OOM chip 0 — at construction
-        # AND at every _fail_everything recovery.
-        cfg = self.cfg
-        shape = (cfg.n_layers, self.slots, cfg.n_kv_heads, self.max_len,
-                 cfg.head_dim)
-        self._cache = gen_lib.KVCache(
-            k=jnp.zeros(shape, cfg.dtype, device=self._kv_sharding),
-            v=jnp.zeros(shape, cfg.dtype, device=self._kv_sharding),
-            lengths=jnp.zeros((self.slots,), jnp.int32,
-                              device=self._vec_sharding))
-        self._last = jnp.zeros((self.slots,), jnp.int32,
-                               device=self._vec_sharding)
+        # Born sharded under a mesh: on a replica sized so the cache only
+        # fits spread over the slice, a transient single-device
+        # allocation would OOM chip 0 — at construction AND at every
+        # _fail_everything recovery. (Shardings are None single-device.)
+        kv = self._kv_sharding if self.mesh is not None else None
+        vec = self._vec_sharding if self.mesh is not None else None
+        self._cache = gen_lib.init_cache(self.cfg, self.slots,
+                                         self.max_len, kv_sharding=kv,
+                                         lengths_sharding=vec)
+        self._last = jnp.zeros((self.slots,), jnp.int32, device=vec)
+
+    @staticmethod
+    def _fire_callbacks(emitted: List[tuple]) -> None:
+        """Run on_tokens callbacks OUTSIDE the lock, each guarded: a
+        raising callback (e.g. a streaming client whose event loop died)
+        loses ITS stream only — it must not reach _loop's failure path,
+        which would fail every other client's in-flight request and
+        rebuild the device cache."""
+        for req, new in emitted:
+            try:
+                req.on_tokens(new)
+            except Exception:  # noqa: BLE001 — isolate per request
+                req.on_tokens = None  # stop notifying the dead consumer
 
     def _next_key(self) -> jax.Array:
         self._key, sub = jax.random.split(self._key)
@@ -359,14 +367,19 @@ class ContinuousEngine:
             batches = self._unfetched
             self._unfetched = []
         done: List[_Request] = []
+        emitted: List[tuple] = []
         for reqs, firsts in batches:
             firsts_host = np.asarray(jax.device_get(firsts))
             with self._lock:
                 for i, req in enumerate(reqs):
-                    req.tokens.append(int(firsts_host[i]))
+                    first = int(firsts_host[i])
+                    req.tokens.append(first)
                     self.tokens_emitted += 1
+                    if req.on_tokens is not None:
+                        emitted.append((req, [first]))
                     if len(req.tokens) >= req.max_new:
                         done.append(req)
+        self._fire_callbacks(emitted)
         for req in done:
             if not req.future.done():
                 req.future.set_result(req.tokens)
@@ -392,17 +405,22 @@ class ContinuousEngine:
         toks_host = np.asarray(jax.device_get(toks))  # [K, B]
         self.chunks_run += 1
         done: List[_Request] = []
+        emitted: List[tuple] = []
         with self._lock:
             for i, req in enumerate(reqs):
                 if req is None:
                     continue
                 need = req.max_new - len(req.tokens)
                 take = min(need, self.chunk_steps)
-                req.tokens.extend(int(t) for t in toks_host[:take, i])
+                new = [int(t) for t in toks_host[:take, i]]
+                req.tokens.extend(new)
                 self.tokens_emitted += take
+                if req.on_tokens is not None and new:
+                    emitted.append((req, new))
                 if len(req.tokens) >= req.max_new:
                     self._slot_req[i] = None
                     done.append(req)
+        self._fire_callbacks(emitted)
         for req in done:
             if not req.future.done():
                 req.future.set_result(req.tokens)

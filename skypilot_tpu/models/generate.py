@@ -41,11 +41,18 @@ jax.tree_util.register_dataclass(
 
 
 def init_cache(cfg: llama.LlamaConfig, batch: int, max_len: int,
-               dtype=None) -> KVCache:
+               dtype=None, kv_sharding=None,
+               lengths_sharding=None) -> KVCache:
+    """Optional shardings allocate the buffers BORN sharded (a cache
+    sized to fit only spread over a slice must never transit one chip);
+    None = default placement. This is the one definition of the cache
+    layout — sharded and single-device paths must not diverge."""
     dtype = dtype or cfg.dtype
     shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, cfg.head_dim)
-    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
-                   lengths=jnp.zeros((batch,), jnp.int32))
+    return KVCache(k=jnp.zeros(shape, dtype, device=kv_sharding),
+                   v=jnp.zeros(shape, dtype, device=kv_sharding),
+                   lengths=jnp.zeros((batch,), jnp.int32,
+                                     device=lengths_sharding))
 
 
 def _cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,

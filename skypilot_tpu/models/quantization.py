@@ -81,26 +81,34 @@ def quantize_params(params: Params) -> Params:
     return out
 
 
-def logical_axes_for(params: Params, cfg: llama.LlamaConfig) -> Params:
-    """Logical sharding axes matching ``params``, which may be a
-    ``quantize_params`` output: a quantized leaf's ``q8`` codes keep the
-    original weight's axes, and its per-output-channel ``s`` scales keep
-    exactly the NON-contracted axes (so a tensor-parallel mesh shards the
-    scales with the output channels they belong to). Full-precision trees
-    come back as plain ``llama.param_logical_axes``."""
+def _axes_tree(cfg: llama.LlamaConfig, quantized_pred) -> Params:
+    """Logical-axes tree where targets selected by ``quantized_pred``
+    carry quantized-leaf axes: ``q8`` codes keep the original weight's
+    axes, per-output-channel ``s`` scales keep exactly the NON-contracted
+    axes (so a tensor-parallel mesh shards the scales with the output
+    channels they belong to). One formula — callers must not re-derive
+    the scale axes."""
     base = llama.param_logical_axes(cfg)
     layers = dict(base['layers'])
     for name, n_c in _LAYER_TARGETS.items():
-        if name in layers and is_quantized(params['layers'][name]):
+        if name in layers and quantized_pred('layers', name):
             axes = layers[name]  # ('layers', <contract...>, <outputs...>)
             layers[name] = {'q8': axes,
                             's': (axes[0],) + axes[1 + n_c:]}
     out = {**base, 'layers': layers}
     for name, n_c in _TOP_TARGETS.items():
-        if name in out and is_quantized(params[name]):
+        if name in out and quantized_pred('top', name):
             axes = out[name]
             out[name] = {'q8': axes, 's': axes[n_c:]}
     return out
+
+
+def logical_axes_for(params: Params, cfg: llama.LlamaConfig) -> Params:
+    """Logical sharding axes matching ``params``, which may be a
+    ``quantize_params`` output (possibly partially quantized).
+    Full-precision trees come back as plain ``llama.param_logical_axes``."""
+    return _axes_tree(cfg, lambda scope, name: is_quantized(
+        params['layers'][name] if scope == 'layers' else params[name]))
 
 
 def shard_params(params: Params, cfg: llama.LlamaConfig, mesh,
@@ -122,17 +130,7 @@ def quantize_params_sharded(params: Params, cfg: llama.LlamaConfig, mesh,
     sharded never materializes fp32 intermediates on one chip."""
     from skypilot_tpu.parallel import sharding as sharding_lib
     rules = rules or sharding_lib.ShardingRules()
-    base = llama.param_logical_axes(cfg)
-    layers = dict(base['layers'])
-    for name, n_c in _LAYER_TARGETS.items():
-        if name in layers:
-            axes = layers[name]
-            layers[name] = {'q8': axes, 's': (axes[0],) + axes[1 + n_c:]}
-    out_axes = {**base, 'layers': layers}
-    for name, n_c in _TOP_TARGETS.items():
-        if name in out_axes:
-            axes = out_axes[name]
-            out_axes[name] = {'q8': axes, 's': axes[n_c:]}
+    out_axes = _axes_tree(cfg, lambda scope, name: True)
     shardings = sharding_lib.sharding_tree(out_axes, mesh, rules)
     return jax.jit(quantize_params, out_shardings=shardings)(params)
 

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import contextlib
 import os
 from typing import Any, Dict, List, Optional
 
@@ -283,6 +284,15 @@ class LlmServer:
                           f'{self.max_len}'}, status=400)
         seed = body.get('seed')
         seeded = temperature > 0 and seed is not None
+        stream = bool(body.get('stream'))
+        if stream and (self.engine is None or seeded):
+            return web.json_response(
+                {'error': 'stream requires the continuous engine '
+                          '(unseeded requests, SKYTPU_LLM_ENGINE!=off)'},
+                status=400)
+        if stream:
+            return await self._generate_stream(request, rows, max_new,
+                                               temperature)
         if self.engine is not None and not seeded:
             # Continuous-batching path: one engine slot per row.
             futs = [asyncio.wrap_future(
@@ -294,6 +304,71 @@ class LlmServer:
         await self._queue.put(pending)
         out = await pending.future
         return web.json_response({'tokens': out})
+
+    async def _generate_stream(self, request: web.Request,
+                               rows, max_new: int,
+                               temperature: float) -> web.StreamResponse:
+        """NDJSON streaming (the JetStream-style serving contract):
+        tokens are written as the engine emits them, one
+        ``{"row": i, "tokens": [...]}`` object per line, at decode-chunk
+        granularity (``SKYTPU_LLM_CHUNK_STEPS`` trades stream latency
+        against dispatch amortization); terminated by ``{"done": true}``."""
+        import json as json_lib
+
+        loop = asyncio.get_event_loop()
+        q: asyncio.Queue = asyncio.Queue()
+        futs = []
+        for ri, row in enumerate(rows):
+            def cb(toks, ri=ri):
+                loop.call_soon_threadsafe(q.put_nowait, (ri, toks))
+            futs.append(asyncio.wrap_future(
+                self.engine.submit(row, max_new, temperature,
+                                   on_tokens=cb)))
+        resp = web.StreamResponse()
+        resp.content_type = 'application/x-ndjson'
+        await resp.prepare(request)
+        remaining = {i: max_new for i in range(len(rows))}
+        done_task = asyncio.ensure_future(asyncio.gather(*futs))
+
+        async def _emit(item):
+            ri, toks = item
+            remaining[ri] -= len(toks)
+            if remaining[ri] <= 0:
+                del remaining[ri]
+            await resp.write(json_lib.dumps(
+                {'row': ri, 'tokens': toks}).encode() + b'\n')
+
+        try:
+            while remaining:
+                get_task = asyncio.ensure_future(q.get())
+                await asyncio.wait({get_task, done_task},
+                                   return_when=asyncio.FIRST_COMPLETED)
+                if get_task.done():
+                    await _emit(get_task.result())
+                    continue
+                get_task.cancel()
+                # Futures resolved first: either the engine failed (no
+                # more callbacks will ever come — raise instead of
+                # waiting forever) or the tail emissions are already
+                # scheduled on this loop and a bounded drain finds them.
+                done_task.result()
+                while remaining:
+                    await _emit(await asyncio.wait_for(q.get(), timeout=5))
+            await done_task
+            await resp.write(json_lib.dumps({'done': True}).encode()
+                             + b'\n')
+        except Exception as e:  # noqa: BLE001 — mid-stream: report in-band
+            done_task.cancel()
+            # The failure may BE the transport (client disconnected):
+            # the in-band error line and the eof below are best-effort —
+            # a second raise here would skip cleanup and leak the
+            # pending queue task as an un-awaited orphan.
+            with contextlib.suppress(Exception):
+                await resp.write(json_lib.dumps(
+                    {'error': str(e)}).encode() + b'\n')
+        with contextlib.suppress(Exception):
+            await resp.write_eof()
+        return resp
 
     def make_app(self) -> web.Application:
         app = web.Application()

@@ -32,30 +32,51 @@ def _span(spec: str):
 
 
 async def _one(session, url: str, prompt_span, max_new_span,
-               vocab: int, seed: int):
+               vocab: int, seed: int, stream: bool = False):
     rng = random.Random(seed)
     prompt_len = rng.randint(*prompt_span)
     max_new = rng.randint(*max_new_span)
     tokens = [rng.randrange(1, vocab) for _ in range(prompt_len)]
     t0 = time.perf_counter()
+    ttft = None
+    timeout = __import__('aiohttp').ClientTimeout(total=600)
     try:
         async with session.post(
                 f'{url}/generate',
-                json={'tokens': [tokens], 'max_new_tokens': max_new},
-                timeout=__import__('aiohttp').ClientTimeout(total=600)) as r:
-            # content-type agnostic: some proxies in the path may not
-            # preserve application/json.
-            body = json.loads(await r.text())
-            ok = r.status == 200 and 'tokens' in body
-            # /generate returns ONLY the generated continuation rows.
-            new = len(body['tokens'][0]) if ok else 0
+                json={'tokens': [tokens], 'max_new_tokens': max_new,
+                      'stream': stream},
+                timeout=timeout) as r:
+            if stream:
+                # NDJSON: count tokens per line; first line = TTFT (the
+                # serving latency JetStream-class systems quote).
+                new, ok = 0, r.status == 200
+                async for line in r.content:
+                    if not line.strip():
+                        continue
+                    obj = json.loads(line)
+                    if 'error' in obj:
+                        ok = False
+                        break
+                    if 'tokens' in obj:
+                        if ttft is None:
+                            ttft = time.perf_counter() - t0
+                        new += len(obj['tokens'])
+                ok = ok and new >= max_new
+            else:
+                # content-type agnostic: some proxies in the path may
+                # not preserve application/json.
+                body = json.loads(await r.text())
+                ok = r.status == 200 and 'tokens' in body
+                # /generate returns ONLY the generated continuation rows.
+                new = len(body['tokens'][0]) if ok else 0
     except Exception:  # noqa: BLE001 — a failed request is a data point
         ok, new = False, 0
-    return ok, new, time.perf_counter() - t0
+    return ok, new, time.perf_counter() - t0, ttft
 
 
 async def run_load(url: str, requests_total: int, concurrency: int,
-                   prompt_len, max_new, vocab: int) -> dict:
+                   prompt_len, max_new, vocab: int,
+                   stream: bool = False) -> dict:
     import aiohttp
     prompt_span, max_new_span = _span(prompt_len), _span(max_new)
     sem = asyncio.Semaphore(concurrency)
@@ -65,7 +86,8 @@ async def run_load(url: str, requests_total: int, concurrency: int,
         async def _bounded(i):
             async with sem:
                 results.append(await _one(session, url, prompt_span,
-                                          max_new_span, vocab, seed=i))
+                                          max_new_span, vocab, seed=i,
+                                          stream=stream))
 
         t0 = time.perf_counter()
         await asyncio.gather(*(_bounded(i) for i in range(requests_total)))
@@ -74,7 +96,19 @@ async def run_load(url: str, requests_total: int, concurrency: int,
     oks = [r for r in results if r[0]]
     lats = sorted(r[2] for r in results)
     new_tokens = sum(r[1] for r in oks)
+    ttfts = sorted(r[3] for r in oks if r[3] is not None)
+    extra = {}
+    if stream:
+        extra = {
+            'stream': True,
+            'p50_ttft_s': round(ttfts[len(ttfts) // 2], 3)
+            if ttfts else None,
+            'p95_ttft_s': round(
+                ttfts[max(-(-len(ttfts) * 95 // 100) - 1, 0)], 3)
+            if ttfts else None,
+        }
     return {
+        **extra,
         'requests': requests_total,
         'ok': len(oks),
         'concurrency': concurrency,
@@ -110,10 +144,15 @@ def main() -> None:
     parser.add_argument('--vocab', type=int, default=256,
                         help='token id range for synthetic prompts (match '
                              'the served model vocab)')
+    parser.add_argument('--stream', action='store_true',
+                        help='use NDJSON streaming and report TTFT '
+                             'percentiles (requires the continuous '
+                             'engine on the server)')
     args = parser.parse_args()
     out = asyncio.run(run_load(args.url.rstrip('/'), args.requests,
                                args.concurrency, args.prompt_len,
-                               args.max_new_tokens, args.vocab))
+                               args.max_new_tokens, args.vocab,
+                               stream=args.stream))
     print(json.dumps(out))
 
 

@@ -242,6 +242,101 @@ def test_engine_survives_device_failure(tiny):
         eng.stop()
 
 
+def test_engine_streaming_callback(tiny):
+    """on_tokens fires incrementally (first token, then per decode
+    chunk) and the concatenation equals the future's final result."""
+    cfg, params = tiny
+    eng = _mk(params, cfg, chunk_steps=2)
+    try:
+        chunks = []
+        fut = eng.submit([5, 6, 7], 7, on_tokens=chunks.append)
+        final = fut.result(timeout=120)
+        assert final == _solo(params, cfg, [5, 6, 7], 7)
+        assert [t for c in chunks for t in c] == final
+        assert len(chunks) >= 3  # 1 (prefill) + ceil(6/2) chunk batches
+    finally:
+        eng.stop()
+
+
+def test_engine_raising_callback_isolated(tiny):
+    """A raising on_tokens (dead streaming client) must lose only its
+    own stream — both its future AND other concurrent requests still
+    complete with correct tokens."""
+    cfg, params = tiny
+    eng = _mk(params, cfg, chunk_steps=2)
+    try:
+        def boom(_):
+            raise RuntimeError('client went away')
+
+        bad = eng.submit([1, 2, 3], 6, on_tokens=boom)
+        good_chunks = []
+        good = eng.submit([9, 8, 7], 6, on_tokens=good_chunks.append)
+        assert good.result(timeout=120) == _solo(params, cfg, [9, 8, 7], 6)
+        assert bad.result(timeout=120) == _solo(params, cfg, [1, 2, 3], 6)
+        assert [t for c in good_chunks for t in c] == good.result()
+    finally:
+        eng.stop()
+
+
+def test_llm_server_http_streaming(tiny):
+    """NDJSON streaming over HTTP: per-chunk lines whose concatenation
+    equals the non-streamed response, terminated by {'done': true};
+    stream without the engine is a 400."""
+    import json as json_lib
+    import threading
+
+    import requests as requests_lib
+    from aiohttp import web
+
+    from skypilot_tpu.models.engine import ContinuousEngine
+    from skypilot_tpu.serve import llm_server as llm_mod
+    from skypilot_tpu.utils import common_utils
+
+    cfg, params = tiny
+    server = llm_mod.LlmServer('tiny', max_len=64, engine='continuous')
+    server.params = params
+    server.engine.stop()
+    server.engine = ContinuousEngine(params, cfg, slots=4, max_len=64,
+                                     chunk_steps=2)
+    port = common_utils.find_free_port(21600)
+    started = threading.Event()
+
+    def run():
+        import asyncio
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(server.make_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, '127.0.0.1', port)
+        loop.run_until_complete(site.start())
+        started.set()
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(10)
+
+    row = [5, 6, 7, 8]
+    r = requests_lib.post(
+        f'http://127.0.0.1:{port}/generate',
+        json={'tokens': [row], 'max_new_tokens': 7, 'stream': True},
+        stream=True, timeout=180)
+    assert r.status_code == 200
+    lines = [json_lib.loads(ln) for ln in r.iter_lines() if ln.strip()]
+    assert lines[-1] == {'done': True}
+    toks = [t for ln in lines[:-1] for t in ln['tokens']]
+    assert all(ln['row'] == 0 for ln in lines[:-1])
+    assert len(lines) >= 4  # first + >=2 chunks + done
+    assert toks == _solo(params, cfg, row, 7)
+
+    # Seeded streaming is refused (determinism needs the window path).
+    r2 = requests_lib.post(
+        f'http://127.0.0.1:{port}/generate',
+        json={'tokens': [row], 'max_new_tokens': 4, 'stream': True,
+              'temperature': 1.0, 'seed': 3}, timeout=30)
+    assert r2.status_code == 400
+    server.engine.stop()
+
+
 def test_engine_rejects_oversized_request(tiny):
     cfg, params = tiny
     eng = engine_lib.ContinuousEngine(params, cfg, slots=2, max_len=32)
