@@ -88,7 +88,11 @@ def _insert_impl(cache: gen_lib.KVCache, last: jax.Array,
     k = cache.k.at[:, slots, :, :width].set(cache_n.k)
     v = cache.v.at[:, slots, :, :width].set(cache_n.v)
     lengths = cache.lengths.at[slots].set(cache_n.lengths)
-    return (gen_lib.KVCache(k=k, v=v, lengths=lengths),
+    k_s, v_s = cache.k_s, cache.v_s
+    if cache.quantized:
+        k_s = k_s.at[:, slots, :, :width].set(cache_n.k_s)
+        v_s = v_s.at[:, slots, :, :width].set(cache_n.v_s)
+    return (gen_lib.KVCache(k=k, v=v, lengths=lengths, k_s=k_s, v_s=v_s),
             last.at[slots].set(firsts))
 
 
@@ -145,7 +149,8 @@ class ContinuousEngine:
                  slots: Optional[int] = None, max_len: int = 1024,
                  chunk_steps: Optional[int] = None,
                  prefill_batch: Optional[int] = None, seed: int = 0,
-                 mesh=None, rules=None):
+                 mesh=None, rules=None,
+                 kv_quantize: Optional[bool] = None):
         self.params = params
         self.cfg = cfg
         self.slots = slots or int(os.environ.get('SKYTPU_LLM_SLOTS', '16'))
@@ -155,6 +160,9 @@ class ContinuousEngine:
         self.prefill_batch = min(
             prefill_batch or int(os.environ.get('SKYTPU_LLM_PREFILL_BATCH',
                                                 '8')), self.slots)
+        if kv_quantize is None:
+            kv_quantize = os.environ.get('SKYTPU_LLM_KV_CACHE') == 'int8'
+        self.kv_quantize = bool(kv_quantize)
         # Sharded serving (JetStream serves 8B+ models sharded the same
         # way): with a mesh, weights are placed by the training stack's
         # logical rules (tensor axis -> heads/mlp/vocab, i.e. classic TP)
@@ -171,6 +179,8 @@ class ContinuousEngine:
             self._kv_sharding = sharding_lib.logical_sharding(
                 mesh, self.rules,
                 ('layers', 'batch', 'kv_heads', None, 'head_dim'))
+            self._kv_scale_sharding = sharding_lib.logical_sharding(
+                mesh, self.rules, ('layers', 'batch', 'kv_heads', None))
             self._vec_sharding = sharding_lib.logical_sharding(
                 mesh, self.rules, ('batch',))
         self._init_device_state()
@@ -229,6 +239,7 @@ class ContinuousEngine:
             active = sum(r is not None for r in self._slot_req)
             queued = len(self._pending)
         return {'slots': self.slots, 'active_slots': active,
+                'kv_cache': 'int8' if self.kv_quantize else 'bf16',
                 'queued': queued, 'prefills': self.prefills,
                 'prefill_groups': self.prefill_groups,
                 'prefill_batch': self.prefill_batch,
@@ -281,10 +292,12 @@ class ContinuousEngine:
         # allocation would OOM chip 0 — at construction AND at every
         # _fail_everything recovery. (Shardings are None single-device.)
         kv = self._kv_sharding if self.mesh is not None else None
+        kv_s = self._kv_scale_sharding if self.mesh is not None else None
         vec = self._vec_sharding if self.mesh is not None else None
-        self._cache = gen_lib.init_cache(self.cfg, self.slots,
-                                         self.max_len, kv_sharding=kv,
-                                         lengths_sharding=vec)
+        self._cache = gen_lib.init_cache(
+            self.cfg, self.slots, self.max_len, kv_sharding=kv,
+            lengths_sharding=vec, quantize=self.kv_quantize,
+            kv_scale_sharding=kv_s)
         self._last = jnp.zeros((self.slots,), jnp.int32, device=vec)
 
     @staticmethod
@@ -338,7 +351,8 @@ class ContinuousEngine:
             padded[i, :len(r.row)] = r.row
             lens[i] = len(r.row)
             temps[i] = r.temperature
-        cache_n = gen_lib.init_cache(self.cfg, n, width)
+        cache_n = gen_lib.init_cache(self.cfg, n, width,
+                                     quantize=self.kv_quantize)
         logits, cache_n = gen_lib._jit_prefill(  # noqa: SLF001 — same pkg
             self.params, jnp.asarray(padded), cache_n, self.cfg,
             jnp.asarray(lens))

@@ -30,25 +30,51 @@ class KVCache:
     """Per-layer key/value ring buffers: [L, B, Hkv, max_len, D].
     ``lengths`` is PER-ROW ([B] int32): rows advance independently, which
     is what lets the serving replica batch prompts of different lengths
-    (right-padded) into one prefill/decode."""
+    (right-padded) into one prefill/decode.
+
+    INT8 mode (``k_s``/``v_s`` set — [L, B, Hkv, max_len] fp32 scales):
+    k/v hold int8 codes with a symmetric per-(layer, row, head, position)
+    scale over the D dim. Decode is bound by streaming the cache from
+    HBM, so halving KV bytes is the same lever as int8 weights; both
+    scales fold into the attention matmuls per POSITION (keys: post-QK
+    logits product; values: into the probs before PV), never
+    rematerializing a full-precision cache."""
     k: jax.Array
     v: jax.Array
     lengths: jax.Array  # [B] int32: tokens currently cached per row
+    k_s: Optional[jax.Array] = None
+    v_s: Optional[jax.Array] = None
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_s is not None
 
 
 jax.tree_util.register_dataclass(
-    KVCache, data_fields=['k', 'v', 'lengths'], meta_fields=[])
+    KVCache, data_fields=['k', 'v', 'lengths', 'k_s', 'v_s'],
+    meta_fields=[])
 
 
 def init_cache(cfg: llama.LlamaConfig, batch: int, max_len: int,
                dtype=None, kv_sharding=None,
-               lengths_sharding=None) -> KVCache:
+               lengths_sharding=None, quantize: bool = False,
+               kv_scale_sharding=None) -> KVCache:
     """Optional shardings allocate the buffers BORN sharded (a cache
     sized to fit only spread over a slice must never transit one chip);
     None = default placement. This is the one definition of the cache
-    layout — sharded and single-device paths must not diverge."""
+    layout — sharded and single-device paths must not diverge.
+    ``quantize=True`` = int8 codes + fp32 per-position scales."""
     dtype = dtype or cfg.dtype
     shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, cfg.head_dim)
+    if quantize:
+        s_shape = shape[:-1]
+        return KVCache(
+            k=jnp.zeros(shape, jnp.int8, device=kv_sharding),
+            v=jnp.zeros(shape, jnp.int8, device=kv_sharding),
+            lengths=jnp.zeros((batch,), jnp.int32,
+                              device=lengths_sharding),
+            k_s=jnp.zeros(s_shape, jnp.float32, device=kv_scale_sharding),
+            v_s=jnp.zeros(s_shape, jnp.float32, device=kv_scale_sharding))
     return KVCache(k=jnp.zeros(shape, dtype, device=kv_sharding),
                    v=jnp.zeros(shape, dtype, device=kv_sharding),
                    lengths=jnp.zeros((batch,), jnp.int32,
@@ -56,20 +82,27 @@ def init_cache(cfg: llama.LlamaConfig, batch: int, max_len: int,
 
 
 def _cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
-                      positions: jax.Array, valid_len: jax.Array
-                      ) -> jax.Array:
+                      positions: jax.Array, valid_len: jax.Array,
+                      k_s: Optional[jax.Array] = None,
+                      v_s: Optional[jax.Array] = None) -> jax.Array:
     """q: [B, S, Hq, D] (absolute ``positions`` [B, S]);
     k/v_cache: [B, Hkv, max_len, D] already containing this block's keys.
     Attends causally over the first ``valid_len[b]`` cache slots per row
-    (padded cache slots beyond a row's valid length are never attended)."""
+    (padded cache slots beyond a row's valid length are never attended).
+    With int8 caches, ``k_s``/``v_s`` [B, Hkv, max_len] fold in per
+    position: keys scale the post-QK logits, values scale the probs
+    before PV — the full-precision cache never materializes."""
     b, s, hq, d = q.shape
     hkv = k_cache.shape[1]
     group = hq // hkv
     max_len = k_cache.shape[2]
     qg = q.transpose(0, 2, 1, 3).reshape(b, hkv, group, s, d)
     scale = d ** -0.5
-    logits = jnp.einsum('bhgqd,bhkd->bhgqk', qg, k_cache,
+    logits = jnp.einsum('bhgqd,bhkd->bhgqk', qg,
+                        k_cache.astype(q.dtype),
                         preferred_element_type=jnp.float32) * scale
+    if k_s is not None:
+        logits = logits * k_s[:, :, None, None, :]
     ki = jax.lax.broadcasted_iota(jnp.int32, (b, 1, 1, s, max_len), 4)
     qi = positions[:, None, None, :, None]  # absolute query positions
     if valid_len.ndim == 0:  # uniform batch: scalar broadcast
@@ -78,8 +111,11 @@ def _cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
         mask = (ki <= qi) & (ki < valid_len[:, None, None, None, None])
     logits = jnp.where(mask, logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum('bhgqk,bhkd->bhgqd', probs.astype(v_cache.dtype),
-                     v_cache, preferred_element_type=jnp.float32)
+    if v_s is not None:
+        probs = probs * v_s[:, :, None, None, :]
+    out = jnp.einsum('bhgqk,bhkd->bhgqd', probs.astype(q.dtype),
+                     v_cache.astype(q.dtype),
+                     preferred_element_type=jnp.float32)
     return out.reshape(b, hkv * group, s, d).transpose(0, 2, 1, 3).astype(
         q.dtype)
 
@@ -94,12 +130,56 @@ def _row_update(cache: jax.Array, new: jax.Array,
     return jax.vmap(one)(cache, new, starts)
 
 
+def _row_update_scale(cache: jax.Array, new: jax.Array,
+                      starts: jax.Array) -> jax.Array:
+    """[B, Hkv, max_len] scale-cache counterpart of ``_row_update``."""
+    def one(c, n, s):
+        return jax.lax.dynamic_update_slice(c, n, (0, s))
+    return jax.vmap(one)(cache, new, starts)
+
+
+def _quantize_block(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """[B, Hkv, S, D] -> (int8 codes, [B, Hkv, S] fp32 scales):
+    symmetric per-position max|x|/127 over D (same recipe as weight
+    quantization, models/quantization.py)."""
+    x32 = x.astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(x32), axis=-1) / 127.0, 1e-8)
+    q8 = jnp.clip(jnp.round(x32 / s[..., None]), -127,
+                  127).astype(jnp.int8)
+    return q8, s
+
+
+def _write_block(cache_arr: jax.Array, scale_arr: Optional[jax.Array],
+                 block: jax.Array, starts: jax.Array):
+    """Write a [B, Hkv, S, D] block at scalar/per-row offsets,
+    quantizing on the way in when the cache is int8 (scale_arr set).
+    Uniform batches (scalar ``starts``) take single dynamic_update_slices
+    — measurably faster than the per-row vmap, which is reserved for
+    genuinely mixed-length serving batches."""
+    if scale_arr is not None:
+        block, s = _quantize_block(block)
+    else:
+        block = block.astype(cache_arr.dtype)
+    if starts.ndim == 0:
+        cache_arr = jax.lax.dynamic_update_slice(cache_arr, block,
+                                                 (0, 0, starts, 0))
+        if scale_arr is not None:
+            scale_arr = jax.lax.dynamic_update_slice(scale_arr, s,
+                                                     (0, 0, starts))
+    else:
+        cache_arr = _row_update(cache_arr, block, starts)
+        if scale_arr is not None:
+            scale_arr = _row_update_scale(scale_arr, s, starts)
+    return cache_arr, scale_arr
+
+
 def _cached_layer(cfg: llama.LlamaConfig, x: jax.Array, layer: Params,
                   positions: jax.Array, k_cache: jax.Array,
                   v_cache: jax.Array, cache_lens: jax.Array,
                   valid: jax.Array,
-                  active_rows: Optional[jax.Array] = None
-                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+                  active_rows: Optional[jax.Array] = None,
+                  k_s: Optional[jax.Array] = None,
+                  v_s: Optional[jax.Array] = None):
     """One decoder block writing this block's K/V into the cache.
     x: [B, S, d]; k/v_cache: [B, Hkv, max_len, D]; ``cache_lens`` [B];
     ``valid`` [B] = cache_lens + real new tokens per row (< S for padded
@@ -117,23 +197,16 @@ def _cached_layer(cfg: llama.LlamaConfig, x: jax.Array, layer: Params,
     v = _mm(h, layer['wv'], 'bsd,dhk->bshk')
     q = llama.rope(q, positions, cfg.rope_theta)
     k = llama.rope(k, positions, cfg.rope_theta)
-    # Write the new keys/values at [start, start + S). Uniform batches
-    # (scalar cache_lens) take a single dynamic_update_slice — measurably
-    # faster than the per-row vmap, which is reserved for genuinely
-    # mixed-length serving batches. Short rows of a padded batch write
-    # junk beyond their real length; it is never attended (valid mask)
-    # and each decode step overwrites the next junk slot first.
-    kt = k.transpose(0, 2, 1, 3).astype(k_cache.dtype)
-    vt = v.transpose(0, 2, 1, 3).astype(v_cache.dtype)
-    if cache_lens.ndim == 0:
-        k_cache = jax.lax.dynamic_update_slice(k_cache, kt,
-                                               (0, 0, cache_lens, 0))
-        v_cache = jax.lax.dynamic_update_slice(v_cache, vt,
-                                               (0, 0, cache_lens, 0))
-    else:
-        k_cache = _row_update(k_cache, kt, cache_lens)
-        v_cache = _row_update(v_cache, vt, cache_lens)
-    att = _cached_attention(q, k_cache, v_cache, positions, valid)
+    # Write the new keys/values at [start, start + S) (quantizing on the
+    # way in for int8 caches). Short rows of a padded batch write junk
+    # beyond their real length; it is never attended (valid mask) and
+    # each decode step overwrites the next junk slot first.
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    k_cache, k_s = _write_block(k_cache, k_s, kt, cache_lens)
+    v_cache, v_s = _write_block(v_cache, v_s, vt, cache_lens)
+    att = _cached_attention(q, k_cache, v_cache, positions, valid,
+                            k_s, v_s)
     x = x + _mm(att, layer['wo'], 'bshk,hkd->bsd')
     h = llama.rms_norm(x, layer['mlp_norm'], cfg.norm_eps)
     if cfg.num_experts > 0:
@@ -163,7 +236,7 @@ def _cached_layer(cfg: llama.LlamaConfig, x: jax.Array, layer: Params,
         up = _mm(h, layer['w_up'], 'bsd,df->bsf')
         x = x + _mm(jax.nn.silu(gate) * up, layer['w_down'],
                     'bsf,fd->bsd')
-    return x, k_cache, v_cache
+    return x, k_cache, v_cache, k_s, v_s
 
 
 def forward_cached(params: Params, tokens: jax.Array,
@@ -198,15 +271,28 @@ def forward_cached(params: Params, tokens: jax.Array,
         write_start = cache.lengths       # [B] -> per-row writes
     x = params['embed'].astype(cfg.dtype)[tokens]
 
+    quantized = cache.quantized  # STATIC: pytree structure per jit key
+
     def body(carry, xs):
         x = carry
-        layer, k_c, v_c = xs
-        x, k_c, v_c = _cached_layer(cfg, x, layer, positions, k_c, v_c,
-                                    write_start, valid, active_rows)
-        return x, (k_c, v_c)
+        if quantized:
+            layer, k_c, v_c, ks_c, vs_c = xs
+        else:
+            layer, k_c, v_c = xs
+            ks_c = vs_c = None
+        x, k_c, v_c, ks_c, vs_c = _cached_layer(
+            cfg, x, layer, positions, k_c, v_c, write_start, valid,
+            active_rows, ks_c, vs_c)
+        ys = (k_c, v_c, ks_c, vs_c) if quantized else (k_c, v_c)
+        return x, ys
 
-    x, (new_k, new_v) = jax.lax.scan(
-        body, x, (params['layers'], cache.k, cache.v))
+    if quantized:
+        xs = (params['layers'], cache.k, cache.v, cache.k_s, cache.v_s)
+        x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(body, x, xs)
+    else:
+        xs = (params['layers'], cache.k, cache.v)
+        x, (new_k, new_v) = jax.lax.scan(body, x, xs)
+        new_ks = new_vs = None
     x = llama.rms_norm(x, params['final_norm'], cfg.norm_eps)
     if uniform:
         last = x[:, -1]
@@ -218,7 +304,8 @@ def forward_cached(params: Params, tokens: jax.Array,
         )[:, 0]
     logits = _mm(last, params['lm_head'], 'bd,dv->bv',
                  preferred_element_type=jnp.float32)
-    return logits, KVCache(k=new_k, v=new_v, lengths=new_lengths)
+    return logits, KVCache(k=new_k, v=new_v, lengths=new_lengths,
+                           k_s=new_ks, v_s=new_vs)
 
 
 def _sample(logits: jax.Array, temperature: float,
@@ -276,17 +363,19 @@ def generate(params: Params, cfg: llama.LlamaConfig,
              temperature: float = 0.0,
              key: Optional[jax.Array] = None,
              max_len: Optional[int] = None,
-             prompt_lengths: Optional[jax.Array] = None) -> jax.Array:
+             prompt_lengths: Optional[jax.Array] = None,
+             kv_quantize: bool = False) -> jax.Array:
     """prompt: [B, S_p] int32 -> [B, max_new_tokens] generated ids.
     Greedy when temperature == 0 (deterministic parity with full forward);
     one jitted prefill + one jitted lax.scan of decode steps.
     ``prompt_lengths`` [B] marks each row's real prompt length when the
     batch is right-padded (``pad_prompts``) — rows generate from their own
-    last real token."""
+    last real token. ``kv_quantize`` = int8 KV cache (halves the decode
+    step's dominant HBM stream; see ``KVCache``)."""
     b, s_p = prompt.shape
     max_len = max_len or min(cfg.max_seq_len, s_p + max_new_tokens)
     assert s_p + max_new_tokens <= max_len, (s_p, max_new_tokens, max_len)
-    cache = init_cache(cfg, b, max_len)
+    cache = init_cache(cfg, b, max_len, quantize=kv_quantize)
     if temperature > 0.0 and key is None:
         raise ValueError('temperature > 0 requires a PRNG key')
     if key is None:

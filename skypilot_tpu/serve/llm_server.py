@@ -72,10 +72,19 @@ class LlmServer:
 
     def __init__(self, model: str, max_len: int = 1024, seed: int = 0,
                  quantize: Optional[str] = None,
-                 engine: Optional[str] = None, tp: Optional[int] = None):
+                 engine: Optional[str] = None, tp: Optional[int] = None,
+                 kv_cache: Optional[str] = None):
         self.model_name = model
         self.cfg = llama.PRESETS[model]
         self.max_len = min(max_len, self.cfg.max_seq_len)
+        # Validate cheap string knobs BEFORE weight init: on a real
+        # slice the sharded init+quantize pass takes minutes, and a
+        # typo'd env var must not cost the operator that startup.
+        self.kv_cache = (kv_cache
+                         or os.environ.get('SKYTPU_LLM_KV_CACHE', 'bf16'))
+        if self.kv_cache not in ('bf16', 'int8'):
+            raise ValueError(f'Unknown kv_cache {self.kv_cache!r}; '
+                             "'bf16' or 'int8'")
         # Tensor-parallel serving over the replica's slice: a mesh whose
         # `tensor` axis spans tp chips; weights/KV shard by the training
         # stack's logical rules and every decode step runs SPMD (the way
@@ -118,9 +127,9 @@ class LlmServer:
             # params are already mesh-placed when tp > 1, so the engine's
             # own shard_params is a no-op placement — both paths serve
             # the SAME resident weights.
-            self.engine = ContinuousEngine(self.params, self.cfg,
-                                           max_len=self.max_len,
-                                           mesh=self.mesh)
+            self.engine = ContinuousEngine(
+                self.params, self.cfg, max_len=self.max_len,
+                mesh=self.mesh, kv_quantize=self.kv_cache == 'int8')
             self.params = self.engine.params
         self._queue: asyncio.Queue = asyncio.Queue()
         self._overflow: List[_Pending] = []  # spilled past MAX_BATCH
@@ -132,6 +141,7 @@ class LlmServer:
         del request
         body = {'status': 'ok', 'model': self.model_name,
                 'quantize': self.quantize, 'tp': self.tp,
+                'kv_cache': self.kv_cache,
                 'max_len': self.max_len,
                 'batches_served': self.batches_served,
                 'max_batch_seen': self.max_batch_seen}
@@ -218,7 +228,8 @@ class LlmServer:
             out = jax.device_get(gen_lib.generate(
                 self.params, self.cfg, padded, max_new,
                 temperature=temperature, key=key, max_len=self.max_len,
-                prompt_lengths=lens))
+                prompt_lengths=lens,
+                kv_quantize=self.kv_cache == 'int8'))
             i = 0
             for p in sub:
                 n = len(p.rows)
@@ -338,15 +349,18 @@ class LlmServer:
             await resp.write(json_lib.dumps(
                 {'row': ri, 'tokens': toks}).encode() + b'\n')
 
+        get_task = None
         try:
             while remaining:
                 get_task = asyncio.ensure_future(q.get())
                 await asyncio.wait({get_task, done_task},
                                    return_when=asyncio.FIRST_COMPLETED)
                 if get_task.done():
-                    await _emit(get_task.result())
+                    task, get_task = get_task, None
+                    await _emit(task.result())
                     continue
                 get_task.cancel()
+                get_task = None
                 # Futures resolved first: either the engine failed (no
                 # more callbacks will ever come — raise instead of
                 # waiting forever) or the tail emissions are already
@@ -358,16 +372,24 @@ class LlmServer:
             await resp.write(json_lib.dumps({'done': True}).encode()
                              + b'\n')
         except Exception as e:  # noqa: BLE001 — mid-stream: report in-band
-            done_task.cancel()
             # The failure may BE the transport (client disconnected):
-            # the in-band error line and the eof below are best-effort —
-            # a second raise here would skip cleanup and leak the
-            # pending queue task as an un-awaited orphan.
+            # the in-band error line is best-effort.
             with contextlib.suppress(Exception):
                 await resp.write(json_lib.dumps(
                     {'error': str(e)}).encode() + b'\n')
-        with contextlib.suppress(Exception):
-            await resp.write_eof()
+        finally:
+            # Runs on CancelledError too (aiohttp cancels the handler
+            # when the client disconnects): the gather and any in-flight
+            # queue get must not outlive the response as orphans whose
+            # eventual exception is never retrieved.
+            if get_task is not None:
+                get_task.cancel()
+            if not done_task.done():
+                done_task.cancel()
+            done_task.add_done_callback(
+                lambda t: None if t.cancelled() else t.exception())
+            with contextlib.suppress(Exception):
+                await resp.write_eof()
         return resp
 
     def make_app(self) -> web.Application:
@@ -400,10 +422,15 @@ def main() -> None:
                         help='tensor-parallel degree: shard weights/KV '
                              'over the first N local devices (also via '
                              'SKYTPU_LLM_TP)')
+    parser.add_argument('--kv-cache', default=None,
+                        choices=('bf16', 'int8'),
+                        help='int8 = quantized KV cache, halves the '
+                             'decode HBM stream (also via '
+                             'SKYTPU_LLM_KV_CACHE)')
     args = parser.parse_args()
     server = LlmServer(args.model, max_len=args.max_len,
                        quantize=args.quantize, engine=args.engine,
-                       tp=args.tp)
+                       tp=args.tp, kv_cache=args.kv_cache)
     web.run_app(server.make_app(), host=args.host, port=args.port,
                 print=lambda *a: None)
 

@@ -213,6 +213,45 @@ def test_server_tp_quantized_params_born_sharded(tiny):
         server.engine.stop()
 
 
+def test_engine_kv_int8_matches_generate_kv_int8(tiny):
+    """Engine with the int8 KV cache: same quantization recipe at write
+    time as generate(kv_quantize=True), so outputs are exactly equal —
+    slot insertion scatters the scale planes alongside the codes."""
+    cfg, params = tiny
+    eng = _mk(params, cfg, kv_quantize=True)
+    try:
+        rows = [[5, 6, 7], [8, 9, 10, 11], [13, 14]]
+        futs = [eng.submit(r, 6) for r in rows]
+        for row, fut in zip(rows, futs):
+            want = np.asarray(generate.generate(
+                params, cfg, jnp.asarray([row], jnp.int32),
+                max_new_tokens=6, max_len=64,
+                kv_quantize=True)[0]).tolist()
+            assert fut.result(timeout=120) == want, row
+        assert eng.stats()['kv_cache'] == 'int8'
+    finally:
+        eng.stop()
+
+
+def test_engine_kv_int8_tp(tiny):
+    """int8 KV + tensor parallelism: scale planes shard with their
+    kv_heads."""
+    from skypilot_tpu.parallel import mesh as mesh_lib
+
+    cfg, params = tiny
+    mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(fsdp=1, tensor=2),
+                               devices=jax.devices()[:2])
+    eng = _mk(params, cfg, mesh=mesh, kv_quantize=True)
+    try:
+        row = [3, 4, 5, 6]
+        want = np.asarray(generate.generate(
+            params, cfg, jnp.asarray([row], jnp.int32), max_new_tokens=5,
+            max_len=64, kv_quantize=True)[0]).tolist()
+        assert eng.submit(row, 5).result(timeout=120) == want
+    finally:
+        eng.stop()
+
+
 def test_engine_temperature_sampling_runs(tiny):
     cfg, params = tiny
     eng = _mk(params, cfg)

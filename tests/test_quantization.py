@@ -90,3 +90,67 @@ def test_embed_stays_full_precision():
     q = quant.quantize_params(_params())
     assert not quant.is_quantized(q['embed'])
     assert quant.is_quantized(q['lm_head'])
+
+
+# -- int8 KV cache (generate.init_cache(quantize=True)) ---------------------
+
+
+def test_kv_int8_cache_halves_kv_bytes():
+    cfg = llama.TINY
+    full = gen_lib.init_cache(cfg, 4, 64)
+    q = gen_lib.init_cache(cfg, 4, 64, quantize=True)
+    assert q.quantized and not full.quantized
+    kv = lambda c: c.k.size * c.k.dtype.itemsize * 2  # noqa: E731
+    scales = q.k_s.size * q.k_s.dtype.itemsize * 2
+    # int8 codes are half of bf16; scales add 4/(D) relative overhead.
+    assert kv(q) == kv(full) // 2
+    assert scales < 0.3 * kv(q)
+
+
+def test_kv_int8_prefill_logits_close_to_bf16_cache():
+    cfg = llama.TINY
+    params = _params(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 24), 0,
+                                cfg.vocab_size)
+    logits_fp, _ = gen_lib.forward_cached(
+        params, tokens, gen_lib.init_cache(cfg, 2, 32), cfg)
+    logits_q, cache = gen_lib.forward_cached(
+        params, tokens, gen_lib.init_cache(cfg, 2, 32, quantize=True),
+        cfg)
+    assert cache.quantized and cache.k.dtype == jnp.int8
+    a = np.asarray(logits_fp, np.float32)
+    b = np.asarray(logits_q, np.float32)
+    cos = (a * b).sum() / (np.linalg.norm(a) * np.linalg.norm(b))
+    assert cos > 0.99, cos
+
+
+def test_kv_int8_decode_matches_replay():
+    """Same invariant as the weight-quantized path: with the SAME int8
+    KV config, incremental decode must agree exactly with the one-shot
+    generate (quantization must not break the cache path's exactness)."""
+    cfg = llama.TINY
+    params = _params(cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (2, 8), 0,
+                                cfg.vocab_size)
+    out = gen_lib.generate(params, cfg, prompt, 6, max_len=32,
+                           kv_quantize=True)
+    cache = gen_lib.init_cache(cfg, 2, 32, quantize=True)
+    logits, cache = gen_lib.forward_cached(params, prompt, cache, cfg)
+    toks = [jnp.argmax(logits, -1).astype(jnp.int32)]
+    for _ in range(5):
+        logits, cache = gen_lib.forward_cached(
+            params, toks[-1][:, None], cache, cfg)
+        toks.append(jnp.argmax(logits, -1).astype(jnp.int32))
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.stack(toks, axis=1))
+
+
+def test_kv_int8_composes_with_int8_weights():
+    cfg = llama.TINY
+    q = quant.quantize_params(_params(cfg))
+    prompt = jnp.ones((2, 8), jnp.int32)
+    out = gen_lib.generate(q, cfg, prompt, 5, max_len=32,
+                           kv_quantize=True)
+    assert out.shape == (2, 5)
+    assert np.all((np.asarray(out) >= 0)
+                  & (np.asarray(out) < cfg.vocab_size))
