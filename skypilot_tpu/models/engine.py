@@ -28,6 +28,16 @@ shrinking the batch); junk rows are masked out of MoE expert routing via
 ``forward_cached``'s ``active_rows`` — attention is per-row, so expert
 capacity is the only cross-row coupling.
 
+PREFIX CACHING (vLLM/JetStream-style, ``SKYTPU_LLM_PREFIX_CACHE``
+slots; opt-in — the pool costs extra HBM — and dense models only, see
+``__init__``): popular prompt prefixes keep their KV rows in a small
+device pool; a matching request gathers the prefix row and prefills
+only its suffix. Matching/storage happen at power-of-two lengths
+(bounded lookups and compile shapes), and a prefix is stored only on
+its second sighting so one-shot prompts never thrash the pool. For
+dense models causality makes reuse exact: a prompt's first p cache
+positions depend only on its first p tokens.
+
 Sampling: per-slot temperature rides the decode step (greedy rows take
 ``argmax``, sampled rows ``categorical`` with a fresh per-step key).
 Per-request SEEDED determinism is impossible under continuous batching
@@ -103,6 +113,45 @@ def _insert_impl(cache: gen_lib.KVCache, last: jax.Array,
 _jit_insert = jax.jit(_insert_impl, donate_argnums=(0, 1))
 
 
+def _gather_prefix_impl(pool: gen_lib.KVCache, idx: jax.Array,
+                        lengths: jax.Array, width: int) -> gen_lib.KVCache:
+    """Assemble a prefill cache whose row i starts as pool row idx[i]'s
+    first ``width`` positions with ``lengths[i]`` valid prefix tokens
+    (0 = miss: the junk gathered from slot 0 is never attended and the
+    suffix write starts at 0)."""
+    ks = vs = None
+    if pool.quantized:
+        ks = pool.k_s[:, idx, :, :width]
+        vs = pool.v_s[:, idx, :, :width]
+    return gen_lib.KVCache(k=pool.k[:, idx, :, :width],
+                           v=pool.v[:, idx, :, :width],
+                           lengths=lengths, k_s=ks, v_s=vs)
+
+
+_jit_gather_prefix = jax.jit(_gather_prefix_impl, static_argnums=(3,))
+
+
+def _store_prefix_impl(pool: gen_lib.KVCache, cache_n: gen_lib.KVCache,
+                       row: jax.Array, slot: jax.Array,
+                       p: int) -> gen_lib.KVCache:
+    """Copy the first ``p`` cache positions of prefill row ``row`` into
+    pool slot ``slot``. Causality makes this exact: position i's KV
+    depends only on tokens <= i, so a longer prompt's first p positions
+    ARE the prefix's KV (quantized per position, so codes/scales copy
+    verbatim)."""
+    k = pool.k.at[:, slot, :, :p].set(cache_n.k[:, row, :, :p])
+    v = pool.v.at[:, slot, :, :p].set(cache_n.v[:, row, :, :p])
+    ks, vs = pool.k_s, pool.v_s
+    if pool.quantized:
+        ks = ks.at[:, slot, :, :p].set(cache_n.k_s[:, row, :, :p])
+        vs = vs.at[:, slot, :, :p].set(cache_n.v_s[:, row, :, :p])
+    return gen_lib.KVCache(k=k, v=v, lengths=pool.lengths, k_s=ks, v_s=vs)
+
+
+_jit_store_prefix = jax.jit(_store_prefix_impl, static_argnums=(4,),
+                            donate_argnums=(0,))
+
+
 def _sample_impl(logits: jax.Array, temps: jax.Array, key: jax.Array
                  ) -> jax.Array:
     """Per-row temperature sampling: [B, V] logits -> [B] int32 ids.
@@ -150,7 +199,8 @@ class ContinuousEngine:
                  chunk_steps: Optional[int] = None,
                  prefill_batch: Optional[int] = None, seed: int = 0,
                  mesh=None, rules=None,
-                 kv_quantize: Optional[bool] = None):
+                 kv_quantize: Optional[bool] = None,
+                 prefix_slots: Optional[int] = None):
         self.params = params
         self.cfg = cfg
         self.slots = slots or int(os.environ.get('SKYTPU_LLM_SLOTS', '16'))
@@ -163,6 +213,29 @@ class ContinuousEngine:
         if kv_quantize is None:
             kv_quantize = os.environ.get('SKYTPU_LLM_KV_CACHE') == 'int8'
         self.kv_quantize = bool(kv_quantize)
+        # Prefix caching (vLLM/JetStream-style): popular prompt prefixes
+        # keep their KV rows in a small device pool; a hit prefills only
+        # the suffix. Prefixes are matched at power-of-two lengths
+        # (bounded lookups + bounded compile shapes) and stored on their
+        # SECOND sighting — one-shot prompts never thrash the pool.
+        if prefix_slots is None:
+            prefix_slots = int(os.environ.get('SKYTPU_LLM_PREFIX_CACHE',
+                                              '0'))
+        self.prefix_slots = max(int(prefix_slots), 0)
+        # OPT-IN (default 0): the pool reserves prefix_slots extra
+        # max_len cache rows of HBM a deployment sized to the edge did
+        # not budget for. And NOT for MoE: expert capacity couples
+        # co-batched rows (a busy prefill group can drop a prefix
+        # token's expert routing), so stored prefix KV would replay its
+        # store-time batchmates' contention — reuse is only exact for
+        # dense models, where rows are independent.
+        if cfg.num_experts > 0:
+            self.prefix_slots = 0
+        self.prefix_min = 16  # smallest cacheable/matchable prefix
+        self._prefix_index: 'collections.OrderedDict[tuple, int]' = \
+            collections.OrderedDict()  # prefix tokens -> pool row
+        self._prefix_seen: 'collections.OrderedDict[tuple, int]' = \
+            collections.OrderedDict()  # sighting counts (bounded)
         # Sharded serving (JetStream serves 8B+ models sharded the same
         # way): with a mesh, weights are placed by the training stack's
         # logical rules (tensor axis -> heads/mlp/vocab, i.e. classic TP)
@@ -195,6 +268,9 @@ class ContinuousEngine:
         # Stats (read by /health).
         self.prefills = 0
         self.prefill_groups = 0
+        self.prefix_hits = 0
+        self.prefix_hit_tokens = 0
+        self.prefix_stores = 0
         self.chunks_run = 0
         self.tokens_emitted = 0
         self.peak_active = 0
@@ -246,7 +322,13 @@ class ContinuousEngine:
                 'chunks_run': self.chunks_run,
                 'chunk_steps': self.chunk_steps,
                 'tokens_emitted': self.tokens_emitted,
-                'peak_active_slots': self.peak_active}
+                'peak_active_slots': self.peak_active,
+                'prefix_cache': {
+                    'slots': self.prefix_slots,
+                    'entries': len(self._prefix_index),
+                    'hits': self.prefix_hits,
+                    'hit_tokens': self.prefix_hit_tokens,
+                    'stores': self.prefix_stores}}
 
     # -- engine thread -----------------------------------------------------
 
@@ -299,6 +381,15 @@ class ContinuousEngine:
             lengths_sharding=vec, quantize=self.kv_quantize,
             kv_scale_sharding=kv_s)
         self._last = jnp.zeros((self.slots,), jnp.int32, device=vec)
+        self._prefix_pool = None
+        if self.prefix_slots > 0:
+            self._prefix_pool = gen_lib.init_cache(
+                self.cfg, self.prefix_slots, self.max_len, kv_sharding=kv,
+                lengths_sharding=vec, quantize=self.kv_quantize,
+                kv_scale_sharding=kv_s)
+        self._prefix_index.clear()
+        self._prefix_seen.clear()
+        self._prefix_free = list(range(self.prefix_slots))
 
     @staticmethod
     def _fire_callbacks(emitted: List[tuple]) -> None:
@@ -339,23 +430,101 @@ class ContinuousEngine:
                 reqs = [self._pending.popleft() for _ in range(g)]
             self._prefill_group(reqs, free[:g])
 
+    def _match_prefix(self, row: List[int]):
+        """Longest cached prefix of ``row`` at power-of-two lengths
+        STRICTLY shorter than the prompt (the last prompt token must be
+        prefilled to produce the first logits). Returns (p, pool_row)."""
+        best = (0, 0)
+        b = self.prefix_min
+        while b <= len(row) - 1:
+            slot = self._prefix_index.get(tuple(row[:b]))
+            if slot is not None:
+                best = (b, slot)
+                self._prefix_index.move_to_end(tuple(row[:b]))  # LRU
+            b *= 2
+        return best
+
+    def _maybe_store_prefixes(self, rows, p_lens,
+                              cache_n: gen_lib.KVCache) -> None:
+        """Store each row's largest bucket prefix on its SECOND sighting
+        (a pool slot is too precious for one-shot prompts); LRU-evict
+        when full."""
+        for i, row in enumerate(rows):
+            p = self.prefix_min
+            while p * 2 <= len(row):
+                p *= 2
+            if p > len(row) or p < self.prefix_min:
+                continue
+            if p_lens[i] >= p:
+                continue  # the hit already covers this prefix
+            key = tuple(row[:p])
+            if key in self._prefix_index:
+                continue
+            self._prefix_seen[key] = self._prefix_seen.get(key, 0) + 1
+            self._prefix_seen.move_to_end(key)
+            while len(self._prefix_seen) > 512:
+                self._prefix_seen.popitem(last=False)
+            if self._prefix_seen[key] < 2:
+                continue
+            if self._prefix_free:
+                slot = self._prefix_free.pop()
+            else:
+                _, slot = self._prefix_index.popitem(last=False)  # LRU
+            self._prefix_pool = _jit_store_prefix(
+                self._prefix_pool, cache_n, jnp.int32(i), jnp.int32(slot),
+                p)
+            self._prefix_index[key] = slot
+            self.prefix_stores += 1
+
     def _prefill_group(self, reqs: List[_Request],
                        slots: List[int]) -> None:
         n = len(reqs)
-        width = min(prompt_bucket(max(len(r.row) for r in reqs)),
+        rows = [r.row for r in reqs]
+        p_lens = [0] * n
+        pool_rows = [0] * n
+        if self._prefix_pool is not None:
+            for i, row in enumerate(rows):
+                p_lens[i], pool_rows[i] = self._match_prefix(row)
+            # Demote any hit whose prefix + PADDED suffix would overflow
+            # the cache width — dynamic_update_slice clamps out-of-range
+            # starts, which would smear padded junk over real prefix KV.
+            while True:
+                s_b = min(prompt_bucket(max(
+                    len(r) - p for r, p in zip(rows, p_lens))),
                     self.max_len)
-        padded = np.zeros((n, width), np.int32)
+                bad = [i for i in range(n)
+                       if p_lens[i] and p_lens[i] + s_b > self.max_len]
+                if not bad:
+                    break
+                for i in bad:
+                    p_lens[i], pool_rows[i] = 0, 0
+        suffixes = [row[p:] for row, p in zip(rows, p_lens)]
+        width_s = min(prompt_bucket(max(len(s) for s in suffixes)),
+                      self.max_len)
+        cache_width = min(prompt_bucket(
+            max(p + width_s for p in p_lens)), self.max_len)
+        padded = np.zeros((n, width_s), np.int32)
         lens = np.zeros((n,), np.int32)
         temps = np.zeros((n,), np.float32)
-        for i, r in enumerate(reqs):
-            padded[i, :len(r.row)] = r.row
-            lens[i] = len(r.row)
+        for i, (r, suf) in enumerate(zip(reqs, suffixes)):
+            padded[i, :len(suf)] = suf
+            lens[i] = len(suf)
             temps[i] = r.temperature
-        cache_n = gen_lib.init_cache(self.cfg, n, width,
-                                     quantize=self.kv_quantize)
+        hits = sum(1 for p in p_lens if p)
+        if self._prefix_pool is not None and hits:
+            cache_n = _jit_gather_prefix(
+                self._prefix_pool, jnp.asarray(pool_rows, jnp.int32),
+                jnp.asarray(p_lens, jnp.int32), cache_width)
+            self.prefix_hits += hits
+            self.prefix_hit_tokens += sum(p_lens)
+        else:
+            cache_n = gen_lib.init_cache(self.cfg, n, cache_width,
+                                         quantize=self.kv_quantize)
         logits, cache_n = gen_lib._jit_prefill(  # noqa: SLF001 — same pkg
             self.params, jnp.asarray(padded), cache_n, self.cfg,
             jnp.asarray(lens))
+        if self._prefix_pool is not None:
+            self._maybe_store_prefixes(rows, p_lens, cache_n)
         firsts = _jit_sample(logits, jnp.asarray(temps), self._next_key())
         # Insert EVERY row (a single-token request's row becomes harmless
         # junk in a still-free slot). The first-token VALUES are fetched

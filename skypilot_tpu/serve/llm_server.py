@@ -73,18 +73,33 @@ class LlmServer:
     def __init__(self, model: str, max_len: int = 1024, seed: int = 0,
                  quantize: Optional[str] = None,
                  engine: Optional[str] = None, tp: Optional[int] = None,
-                 kv_cache: Optional[str] = None):
+                 kv_cache: Optional[str] = None,
+                 prefix_cache: Optional[int] = None):
         self.model_name = model
         self.cfg = llama.PRESETS[model]
         self.max_len = min(max_len, self.cfg.max_seq_len)
-        # Validate cheap string knobs BEFORE weight init: on a real
+        # Validate ALL the cheap knobs BEFORE weight init: on a real
         # slice the sharded init+quantize pass takes minutes, and a
-        # typo'd env var must not cost the operator that startup.
+        # typo'd flag or env var must not cost the operator that
+        # startup.
         self.kv_cache = (kv_cache
                          or os.environ.get('SKYTPU_LLM_KV_CACHE', 'bf16'))
         if self.kv_cache not in ('bf16', 'int8'):
             raise ValueError(f'Unknown kv_cache {self.kv_cache!r}; '
                              "'bf16' or 'int8'")
+        self.quantize = quantize or os.environ.get('SKYTPU_LLM_QUANTIZE')
+        if self.quantize and self.quantize != 'int8':
+            raise ValueError(f'Unknown quantization {self.quantize!r}; '
+                             "only 'int8' (weight-only) is supported")
+        engine = engine or os.environ.get('SKYTPU_LLM_ENGINE',
+                                          'continuous')
+        if engine not in ('continuous', 'off'):
+            raise ValueError(f"Unknown engine {engine!r}; 'continuous' "
+                             "or 'off'")
+        if prefix_cache is None:
+            prefix_cache = int(os.environ.get('SKYTPU_LLM_PREFIX_CACHE',
+                                              '0'))
+        prefix_cache = int(prefix_cache)
         # Tensor-parallel serving over the replica's slice: a mesh whose
         # `tensor` axis spans tp chips; weights/KV shard by the training
         # stack's logical rules and every decode step runs SPMD (the way
@@ -103,12 +118,7 @@ class LlmServer:
                                                     self.mesh)
         else:
             self.params = llama.init_params(key, self.cfg)
-        self.quantize = quantize or os.environ.get('SKYTPU_LLM_QUANTIZE')
         if self.quantize:
-            if self.quantize != 'int8':
-                raise ValueError(
-                    f'Unknown quantization {self.quantize!r}; only '
-                    "'int8' (weight-only) is supported")
             # Deployment-time int8 weight-only quantization: halves the
             # per-decode-step weight stream (models/quantization.py).
             from skypilot_tpu.models import quantization as quant_lib
@@ -117,10 +127,6 @@ class LlmServer:
                     self.params, self.cfg, self.mesh)
             else:
                 self.params = quant_lib.quantize_params(self.params)
-        engine = engine or os.environ.get('SKYTPU_LLM_ENGINE', 'continuous')
-        if engine not in ('continuous', 'off'):
-            raise ValueError(f"Unknown engine {engine!r}; 'continuous' "
-                             "or 'off'")
         self.engine = None
         if engine == 'continuous':
             from skypilot_tpu.models.engine import ContinuousEngine
@@ -129,7 +135,8 @@ class LlmServer:
             # the SAME resident weights.
             self.engine = ContinuousEngine(
                 self.params, self.cfg, max_len=self.max_len,
-                mesh=self.mesh, kv_quantize=self.kv_cache == 'int8')
+                mesh=self.mesh, kv_quantize=self.kv_cache == 'int8',
+                prefix_slots=prefix_cache)
             self.params = self.engine.params
         self._queue: asyncio.Queue = asyncio.Queue()
         self._overflow: List[_Pending] = []  # spilled past MAX_BATCH
@@ -427,10 +434,16 @@ def main() -> None:
                         help='int8 = quantized KV cache, halves the '
                              'decode HBM stream (also via '
                              'SKYTPU_LLM_KV_CACHE)')
+    parser.add_argument('--prefix-cache', type=int, default=None,
+                        help='device pool slots for popular prompt '
+                             'prefixes (opt-in, default 0; costs N extra '
+                             'max_len cache rows of HBM; also via '
+                             'SKYTPU_LLM_PREFIX_CACHE; dense models only)')
     args = parser.parse_args()
     server = LlmServer(args.model, max_len=args.max_len,
                        quantize=args.quantize, engine=args.engine,
-                       tp=args.tp, kv_cache=args.kv_cache)
+                       tp=args.tp, kv_cache=args.kv_cache,
+                       prefix_cache=args.prefix_cache)
     web.run_app(server.make_app(), host=args.host, port=args.port,
                 print=lambda *a: None)
 
