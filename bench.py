@@ -315,7 +315,7 @@ def _bench_tpu() -> dict:
                           seq_len=4096, optimizer='adafactor', remat=True,
                           remat_policy=p)
             for p, b in (('dots', 2), ('dots', 3), ('heavy', 4),
-                         ('attn', 4))
+                         ('attn', 4), ('attn', 6), ('heavy', 6))
         ]
         cfg4k, sweep = _sweep_best_config(candidates)
         cfg2k = TrainerConfig(model=llama.BENCH_1B, global_batch_size=4,

@@ -59,6 +59,7 @@ import numpy as np
 
 from skypilot_tpu.models import generate as gen_lib
 from skypilot_tpu.models import llama
+from skypilot_tpu.models import sampling
 
 
 @dataclasses.dataclass
@@ -74,6 +75,8 @@ class _Request:
     future: concurrent.futures.Future
     tokens: List[int] = dataclasses.field(default_factory=list)
     on_tokens: Optional[object] = None
+    top_k: int = 0        # 0 = off
+    top_p: float = 1.0    # >= 1 = off
 
 
 def prompt_bucket(n: int, lo: int = 16) -> int:
@@ -152,23 +155,25 @@ _jit_store_prefix = jax.jit(_store_prefix_impl, static_argnums=(4,),
                             donate_argnums=(0,))
 
 
-def _sample_impl(logits: jax.Array, temps: jax.Array, key: jax.Array
-                 ) -> jax.Array:
-    """Per-row temperature sampling: [B, V] logits -> [B] int32 ids.
-    temps == 0 rows are exact argmax (greedy parity with generate())."""
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
-    return jnp.where(temps > 0, sampled, greedy)
+_jit_sample = jax.jit(sampling.sample)
 
 
-_jit_sample = jax.jit(_sample_impl)
+def _filters_or_none(top_ks: np.ndarray, top_ps: np.ndarray):
+    """None when every row's filters are off — filter_logits then skips
+    the full-vocab sort on the hot decode loop entirely (the None/array
+    pytree difference gives two cached jit variants)."""
+    if bool(top_ks.any()) or bool((top_ps < 1.0).any()):
+        return jnp.asarray(top_ks), jnp.asarray(top_ps)
+    return None, None
 
 
 def _chunk_impl(cfg: llama.LlamaConfig, k_steps: int, params,
                 cache: gen_lib.KVCache, last: jax.Array,
-                temps: jax.Array, active: jax.Array, key: jax.Array):
-    """K decode steps over ALL slots: returns (cache, last, toks[K, B])."""
+                temps: jax.Array, top_ks: jax.Array, top_ps: jax.Array,
+                active: jax.Array, key: jax.Array):
+    """K decode steps over ALL slots: returns (cache, last, toks[K, B]).
+    Per-slot sampling params ride as data (temps 0 = greedy, top_ks 0 /
+    top_ps 1 = filters off) — no recompile per request mix."""
     b = last.shape[0]
     row_lens = jnp.ones((b,), jnp.int32)
 
@@ -177,7 +182,7 @@ def _chunk_impl(cfg: llama.LlamaConfig, k_steps: int, params,
         logits, cache = gen_lib.forward_cached(params, last[:, None],
                                                cache, cfg, row_lens,
                                                active)
-        nxt = _sample_impl(logits, temps, key_t)
+        nxt = sampling.sample(logits, temps, key_t, top_ks, top_ps)
         return (cache, nxt), nxt
 
     keys = jax.random.split(key, k_steps)
@@ -278,14 +283,20 @@ class ContinuousEngine:
     # -- public API (any thread) ------------------------------------------
 
     def submit(self, row: List[int], max_new: int,
-               temperature: float = 0.0,
-               on_tokens=None) -> concurrent.futures.Future:
+               temperature: float = 0.0, on_tokens=None,
+               top_k: int = 0,
+               top_p: float = 1.0) -> concurrent.futures.Future:
         if len(row) + max_new > self.max_len:
             raise ValueError(
                 f'prompt ({len(row)}) + max_new ({max_new}) exceeds '
                 f'engine max_len {self.max_len}')
+        if top_k < 0 or not 0.0 < top_p <= 1.0:
+            # top_p <= 0 would mask EVERY token and degenerate to
+            # uniform-random ids — reject like the HTTP layer does.
+            raise ValueError('top_k must be >= 0 and top_p in (0, 1]')
         req = _Request(list(row), max_new, float(temperature),
-                       concurrent.futures.Future(), on_tokens=on_tokens)
+                       concurrent.futures.Future(), on_tokens=on_tokens,
+                       top_k=int(top_k), top_p=float(top_p))
         with self._lock:
             self._pending.append(req)
         self.start()  # idempotent; revives a stop()ped engine
@@ -506,10 +517,14 @@ class ContinuousEngine:
         padded = np.zeros((n, width_s), np.int32)
         lens = np.zeros((n,), np.int32)
         temps = np.zeros((n,), np.float32)
+        top_ks = np.zeros((n,), np.int32)
+        top_ps = np.ones((n,), np.float32)
         for i, (r, suf) in enumerate(zip(reqs, suffixes)):
             padded[i, :len(suf)] = suf
             lens[i] = len(suf)
             temps[i] = r.temperature
+            top_ks[i] = r.top_k
+            top_ps[i] = r.top_p
         hits = sum(1 for p in p_lens if p)
         if self._prefix_pool is not None and hits:
             cache_n = _jit_gather_prefix(
@@ -525,7 +540,9 @@ class ContinuousEngine:
             jnp.asarray(lens))
         if self._prefix_pool is not None:
             self._maybe_store_prefixes(rows, p_lens, cache_n)
-        firsts = _jit_sample(logits, jnp.asarray(temps), self._next_key())
+        tk, tp = _filters_or_none(top_ks, top_ps)
+        firsts = _jit_sample(logits, jnp.asarray(temps), self._next_key(),
+                             tk, tp)
         # Insert EVERY row (a single-token request's row becomes harmless
         # junk in a still-free slot). The first-token VALUES are fetched
         # lazily (``_drain_firsts``) — prefill+insert are then pure async
@@ -571,16 +588,21 @@ class ContinuousEngine:
         with self._lock:
             reqs = list(self._slot_req)
         temps = np.zeros((self.slots,), np.float32)
+        top_ks = np.zeros((self.slots,), np.int32)
+        top_ps = np.ones((self.slots,), np.float32)
         active = np.zeros((self.slots,), bool)
         for i, r in enumerate(reqs):
             if r is not None:
                 temps[i] = r.temperature
+                top_ks[i] = r.top_k
+                top_ps[i] = r.top_p
                 active[i] = True
         self.peak_active = max(self.peak_active, int(active.sum()))
+        tk, tp = _filters_or_none(top_ks, top_ps)
         self._cache, self._last, toks = _jit_chunk(
             self.cfg, self.chunk_steps, self.params, self._cache,
-            self._last, jnp.asarray(temps), jnp.asarray(active),
-            self._next_key())
+            self._last, jnp.asarray(temps), tk, tp,
+            jnp.asarray(active), self._next_key())
         # The chunk is dispatched (async); fetch deferred first tokens
         # while it runs on-device — emission below counts on every
         # admitted request's token list already holding its first token.

@@ -309,11 +309,19 @@ def forward_cached(params: Params, tokens: jax.Array,
 
 
 def _sample(logits: jax.Array, temperature: float,
-            key: Optional[jax.Array]) -> jax.Array:
+            key: Optional[jax.Array], top_k: int = 0,
+            top_p: float = 1.0) -> jax.Array:
+    """Scalar-config sampling for the batch path (models/sampling.py has
+    the per-row vector core shared with the continuous engine)."""
     if temperature == 0.0 or key is None:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(key, logits / temperature,
-                                  axis=-1).astype(jnp.int32)
+    from skypilot_tpu.models import sampling
+    b = logits.shape[0]
+    filters_on = top_k > 0 or top_p < 1.0  # off: skip the vocab sort
+    return sampling.sample(
+        logits, jnp.full((b,), temperature, jnp.float32), key,
+        jnp.full((b,), top_k, jnp.int32) if filters_on else None,
+        jnp.full((b,), top_p, jnp.float32) if filters_on else None)
 
 
 # Module-level jits: the caches are keyed by (shapes, static args) and
@@ -336,7 +344,7 @@ def pad_prompts(rows, pad_id: int = 0) -> Tuple[jax.Array, jax.Array]:
 
 
 def _decode_scan_impl(params, cache, first, key, cfg, n, temperature,
-                      uniform):
+                      top_k, top_p, uniform):
     def step(carry, _):
         cache, token, key = carry
         row_lens = (None if uniform
@@ -347,7 +355,7 @@ def _decode_scan_impl(params, cache, first, key, cfg, n, temperature,
             key, sub = jax.random.split(key)
         else:
             sub = None
-        nxt = _sample(logits, temperature, sub)
+        nxt = _sample(logits, temperature, sub, top_k, top_p)
         return (cache, nxt, key), nxt
 
     (_, _, _), toks = jax.lax.scan(step, (cache, first, key),
@@ -355,7 +363,8 @@ def _decode_scan_impl(params, cache, first, key, cfg, n, temperature,
     return toks
 
 
-_jit_decode_scan = jax.jit(_decode_scan_impl, static_argnums=(4, 5, 6, 7))
+_jit_decode_scan = jax.jit(_decode_scan_impl,
+                           static_argnums=(4, 5, 6, 7, 8, 9))
 
 
 def generate(params: Params, cfg: llama.LlamaConfig,
@@ -364,17 +373,22 @@ def generate(params: Params, cfg: llama.LlamaConfig,
              key: Optional[jax.Array] = None,
              max_len: Optional[int] = None,
              prompt_lengths: Optional[jax.Array] = None,
-             kv_quantize: bool = False) -> jax.Array:
+             kv_quantize: bool = False, top_k: int = 0,
+             top_p: float = 1.0) -> jax.Array:
     """prompt: [B, S_p] int32 -> [B, max_new_tokens] generated ids.
     Greedy when temperature == 0 (deterministic parity with full forward);
     one jitted prefill + one jitted lax.scan of decode steps.
     ``prompt_lengths`` [B] marks each row's real prompt length when the
     batch is right-padded (``pad_prompts``) — rows generate from their own
     last real token. ``kv_quantize`` = int8 KV cache (halves the decode
-    step's dominant HBM stream; see ``KVCache``)."""
+    step's dominant HBM stream; see ``KVCache``). ``top_k``/``top_p``
+    filter sampled rows (models/sampling.py); ignored when greedy."""
     b, s_p = prompt.shape
     max_len = max_len or min(cfg.max_seq_len, s_p + max_new_tokens)
     assert s_p + max_new_tokens <= max_len, (s_p, max_new_tokens, max_len)
+    if top_k < 0 or not 0.0 < top_p <= 1.0:
+        # top_p <= 0 would mask every token (uniform-random garbage).
+        raise ValueError('top_k must be >= 0 and top_p in (0, 1]')
     cache = init_cache(cfg, b, max_len, quantize=kv_quantize)
     if temperature > 0.0 and key is None:
         raise ValueError('temperature > 0 requires a PRNG key')
@@ -387,11 +401,11 @@ def generate(params: Params, cfg: llama.LlamaConfig,
         key, first_key = jax.random.split(key)
     else:
         first_key = None
-    first = _sample(logits, temperature, first_key)
+    first = _sample(logits, temperature, first_key, top_k, top_p)
 
     if max_new_tokens == 1:
         return first[:, None]
     rest = _jit_decode_scan(params, cache, first, key, cfg,
-                            max_new_tokens, temperature,
+                            max_new_tokens, temperature, top_k, top_p,
                             prompt_lengths is None)  # [T-1, B]
     return jnp.concatenate([first[:, None], rest.transpose(1, 0)], axis=1)

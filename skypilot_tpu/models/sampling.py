@@ -1,0 +1,73 @@
+"""Token sampling: temperature, top-k, and top-p (nucleus), in-graph.
+
+Reference analog: the reference serves through JetStream/vLLM, whose
+sampling params (temperature/top_k/top_p) are table stakes for an LLM
+endpoint; here they are one jit-friendly function shared by the batch
+``generate`` path and the continuous engine's decode step.
+
+TPU shape discipline: everything is per-ROW vectors over a static [B, V]
+logits block — one ``jnp.sort`` (descending) feeds both filters, k and p
+ride as data (no per-request recompiles), and disabled rows use neutral
+values (k=0, p=1, temp=0 => greedy) selected with ``jnp.where`` instead
+of control flow.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def filter_logits(logits: jax.Array, top_k: Optional[jax.Array],
+                  top_p: Optional[jax.Array]) -> jax.Array:
+    """Mask ``logits`` [B, V] to each row's top-k ids and/or smallest
+    nucleus with cumulative probability >= top_p. ``top_k`` [B] int32
+    (0 = off); ``top_p`` [B] float (>= 1 = off). Returns filtered logits
+    (masked-out entries at -1e30)."""
+    if top_k is None and top_p is None:
+        return logits  # fast path: no sort on the hot decode loop
+    v = logits.shape[-1]
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]  # desc
+    keep = jnp.ones_like(logits, dtype=bool)
+    if top_k is not None:
+        k = jnp.clip(top_k, 0, v)
+        # Threshold = k-th largest logit per row; k=0 disables (-inf).
+        idx = jnp.clip(k - 1, 0, v - 1)
+        kth = jnp.take_along_axis(sorted_logits, idx[:, None],
+                                  axis=-1)[:, 0]
+        thr = jnp.where(k > 0, kth, -jnp.inf)
+        keep &= logits >= thr[:, None]
+    if top_p is not None:
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Nucleus: positions whose PRECEDING mass is < p (the first
+        # token is always kept). Threshold = smallest kept logit.
+        in_nucleus = (cum - probs) < top_p[:, None]
+        nucleus_min = jnp.min(
+            jnp.where(in_nucleus, sorted_logits, jnp.inf), axis=-1)
+        thr_p = jnp.where(top_p < 1.0, nucleus_min, -jnp.inf)
+        keep &= logits >= thr_p[:, None]
+    return jnp.where(keep, logits, _NEG_INF)
+
+
+def sample(logits: jax.Array, temps: jax.Array, key: jax.Array,
+           top_k: Optional[jax.Array] = None,
+           top_p: Optional[jax.Array] = None) -> jax.Array:
+    """[B, V] logits -> [B] int32 ids. Per-row ``temps`` (0 = exact
+    argmax greedy — filters are irrelevant there, argmax is always in
+    every nucleus/top-k set); filters apply to sampled rows.
+
+    Temperature scales BEFORE the nucleus is taken (the HF/vLLM order):
+    high temperature flattens the distribution, so the same top_p keeps
+    a LARGER nucleus — top_p values ported from those stacks behave
+    identically. top_k is scale-invariant, so the order only matters
+    for top_p."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    filtered = filter_logits(scaled, top_k, top_p)
+    sampled = jax.random.categorical(key, filtered, axis=-1).astype(
+        jnp.int32)
+    return jnp.where(temps > 0, sampled, greedy)

@@ -51,11 +51,14 @@ BATCH_WINDOW_S = float(os.environ.get('SKYTPU_LLM_BATCH_WINDOW_MS',
 class _Pending:
 
     def __init__(self, rows: List[List[int]], max_new: int,
-                 temperature: float, seed: Optional[int]):
+                 temperature: float, seed: Optional[int],
+                 top_k: int = 0, top_p: float = 1.0):
         self.rows = rows
         self.max_new = max_new
         self.temperature = temperature
         self.seed = seed
+        self.top_k = top_k
+        self.top_p = top_p
         self.future: asyncio.Future = asyncio.get_event_loop().create_future()
 
     @property
@@ -65,7 +68,9 @@ class _Pending:
         # is NEVER batched with anything else (unique key per request).
         if self.temperature > 0 and self.seed is not None:
             return ('seeded', id(self))
-        return (self.temperature, None)
+        # Sampling params are per-generate()-call scalars on the window
+        # path, so only like-configured requests share a batch.
+        return (self.temperature, self.top_k, self.top_p, None)
 
 
 class LlmServer:
@@ -236,7 +241,8 @@ class LlmServer:
                 self.params, self.cfg, padded, max_new,
                 temperature=temperature, key=key, max_len=self.max_len,
                 prompt_lengths=lens,
-                kv_quantize=self.kv_cache == 'int8'))
+                kv_quantize=self.kv_cache == 'int8',
+                top_k=sub[0].top_k, top_p=sub[0].top_p))
             i = 0
             for p in sub:
                 n = len(p.rows)
@@ -278,13 +284,19 @@ class LlmServer:
         try:
             max_new = int(body.get('max_new_tokens', 32))
             temperature = float(body.get('temperature', 0.0))
+            top_k = int(body.get('top_k', 0))
+            top_p = float(body.get('top_p', 1.0))
         except (TypeError, ValueError):
             return web.json_response(
-                {'error': 'max_new_tokens/temperature must be numeric'},
-                status=400)
+                {'error': 'max_new_tokens/temperature/top_k/top_p must '
+                          'be numeric'}, status=400)
         if max_new < 1:
             return web.json_response(
                 {'error': 'max_new_tokens must be >= 1'}, status=400)
+        if top_k < 0 or not 0.0 < top_p <= 1.0:
+            return web.json_response(
+                {'error': 'top_k must be >= 0 and top_p in (0, 1]'},
+                status=400)
         try:
             if isinstance(tokens[0], int):
                 tokens = [tokens]
@@ -310,22 +322,25 @@ class LlmServer:
                 status=400)
         if stream:
             return await self._generate_stream(request, rows, max_new,
-                                               temperature)
+                                               temperature, top_k, top_p)
         if self.engine is not None and not seeded:
             # Continuous-batching path: one engine slot per row.
             futs = [asyncio.wrap_future(
-                self.engine.submit(r, max_new, temperature)) for r in rows]
+                self.engine.submit(r, max_new, temperature, top_k=top_k,
+                                   top_p=top_p)) for r in rows]
             out = await asyncio.gather(*futs)
             return web.json_response({'tokens': [list(o) for o in out]})
-        pending = _Pending(rows, max_new, temperature, seed)
+        pending = _Pending(rows, max_new, temperature, seed,
+                           top_k=top_k, top_p=top_p)
         self._ensure_worker()
         await self._queue.put(pending)
         out = await pending.future
         return web.json_response({'tokens': out})
 
     async def _generate_stream(self, request: web.Request,
-                               rows, max_new: int,
-                               temperature: float) -> web.StreamResponse:
+                               rows, max_new: int, temperature: float,
+                               top_k: int = 0,
+                               top_p: float = 1.0) -> web.StreamResponse:
         """NDJSON streaming (the JetStream-style serving contract):
         tokens are written as the engine emits them, one
         ``{"row": i, "tokens": [...]}`` object per line, at decode-chunk
@@ -341,7 +356,8 @@ class LlmServer:
                 loop.call_soon_threadsafe(q.put_nowait, (ri, toks))
             futs.append(asyncio.wrap_future(
                 self.engine.submit(row, max_new, temperature,
-                                   on_tokens=cb)))
+                                   on_tokens=cb, top_k=top_k,
+                                   top_p=top_p)))
         resp = web.StreamResponse()
         resp.content_type = 'application/x-ndjson'
         await resp.prepare(request)

@@ -566,3 +566,114 @@ def test_llm_server_engine_http_roundtrip(tiny):
     assert h2['engine']['prefills'] == len(prompts)
     assert h2['batches_served'] == 2
     server.engine.stop()
+
+
+def test_sampling_top_k_one_is_greedy(tiny):
+    """top_k=1 at any temperature collapses to argmax — the cheapest
+    end-to-end check that the filter really constrains sampling."""
+    cfg, params = tiny
+    row = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
+    greedy = generate.generate(params, cfg, row, 6, max_len=64)
+    sampled = generate.generate(params, cfg, row, 6, max_len=64,
+                                temperature=1.5,
+                                key=jax.random.PRNGKey(3), top_k=1)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(sampled))
+
+
+def test_sampling_top_p_tiny_is_greedy(tiny):
+    cfg, params = tiny
+    row = jnp.asarray([[9, 8, 7]], jnp.int32)
+    greedy = generate.generate(params, cfg, row, 5, max_len=64)
+    sampled = generate.generate(params, cfg, row, 5, max_len=64,
+                                temperature=2.0,
+                                key=jax.random.PRNGKey(4), top_p=1e-6)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(sampled))
+
+
+def test_sampling_top_k_restricts_support(tiny):
+    """Every sampled first token must come from the prompt logits'
+    top-k set."""
+    from skypilot_tpu.models import sampling as sampling_lib
+
+    cfg, params = tiny
+    prompt = jnp.asarray([[3, 4, 5]], jnp.int32)
+    cache = generate.init_cache(cfg, 1, 32)
+    logits, _ = generate.forward_cached(params, prompt, cache, cfg)
+    k = 5
+    allowed = set(np.argsort(np.asarray(logits[0]))[-k:].tolist())
+    for seed in range(20):
+        tok = sampling_lib.sample(
+            logits, jnp.asarray([2.0], jnp.float32),
+            jax.random.PRNGKey(seed), jnp.asarray([k], jnp.int32),
+            jnp.asarray([1.0], jnp.float32))
+        assert int(tok[0]) in allowed
+
+
+def test_engine_per_slot_sampling_mix(tiny):
+    """One greedy request and one top-k sampled request share the decode
+    batch; the greedy one must stay exactly greedy."""
+    cfg, params = tiny
+    eng = _mk(params, cfg, chunk_steps=2)
+    try:
+        g = eng.submit([5, 6, 7], 6)
+        s = eng.submit([8, 9, 10], 6, temperature=1.0, top_k=8)
+        assert g.result(timeout=120) == _solo(params, cfg, [5, 6, 7], 6)
+        out = s.result(timeout=120)
+        assert len(out) == 6
+        assert all(0 <= t < cfg.vocab_size for t in out)
+    finally:
+        eng.stop()
+
+
+def test_engine_stream_honors_top_k(tiny):
+    """Streamed requests must apply sampling filters too: stream with
+    top_k=1 equals the greedy stream token-for-token (the non-stream
+    path already guarantees this; a dropped param would sample the full
+    vocab)."""
+    import json as json_lib
+    import threading
+
+    import requests as requests_lib
+    from aiohttp import web
+
+    from skypilot_tpu.serve import llm_server as llm_mod
+    from skypilot_tpu.utils import common_utils
+
+    cfg, params = tiny
+    server = llm_mod.LlmServer('tiny', max_len=64, engine='continuous')
+    server.params = params
+    server.engine.params = params
+    port = common_utils.find_free_port(21800)
+    started = threading.Event()
+
+    def run():
+        import asyncio
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(server.make_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, '127.0.0.1', port)
+        loop.run_until_complete(site.start())
+        started.set()
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(10)
+
+    row = [5, 6, 7, 8]
+
+    def stream_tokens(extra):
+        r = requests_lib.post(
+            f'http://127.0.0.1:{port}/generate',
+            json={'tokens': [row], 'max_new_tokens': 6, 'stream': True,
+                  **extra}, stream=True, timeout=180)
+        assert r.status_code == 200
+        lines = [json_lib.loads(ln) for ln in r.iter_lines()
+                 if ln.strip()]
+        assert lines[-1] == {'done': True}, lines[-1]
+        return [t for ln in lines[:-1] for t in ln['tokens']]
+
+    greedy = stream_tokens({})
+    topk1 = stream_tokens({'temperature': 1.7, 'top_k': 1})
+    assert greedy == topk1 == _solo(params, cfg, row, 6)
+    server.engine.stop()
