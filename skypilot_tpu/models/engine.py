@@ -77,6 +77,7 @@ class _Request:
     on_tokens: Optional[object] = None
     top_k: int = 0        # 0 = off
     top_p: float = 1.0    # >= 1 = off
+    eos: Optional[frozenset] = None  # stop ids; None = run to max_new
 
 
 def prompt_bucket(n: int, lo: int = 16) -> int:
@@ -284,8 +285,8 @@ class ContinuousEngine:
 
     def submit(self, row: List[int], max_new: int,
                temperature: float = 0.0, on_tokens=None,
-               top_k: int = 0,
-               top_p: float = 1.0) -> concurrent.futures.Future:
+               top_k: int = 0, top_p: float = 1.0,
+               eos=None) -> concurrent.futures.Future:
         if len(row) + max_new > self.max_len:
             raise ValueError(
                 f'prompt ({len(row)}) + max_new ({max_new}) exceeds '
@@ -294,9 +295,13 @@ class ContinuousEngine:
             # top_p <= 0 would mask EVERY token and degenerate to
             # uniform-random ids — reject like the HTTP layer does.
             raise ValueError('top_k must be >= 0 and top_p in (0, 1]')
+        if eos is not None and not isinstance(eos, frozenset):
+            # (the HTTP layer already normalizes; don't re-build)
+            eos = frozenset([eos] if isinstance(eos, int) else
+                            (int(t) for t in eos))
         req = _Request(list(row), max_new, float(temperature),
                        concurrent.futures.Future(), on_tokens=on_tokens,
-                       top_k=int(top_k), top_p=float(top_p))
+                       top_k=int(top_k), top_p=float(top_p), eos=eos)
         with self._lock:
             self._pending.append(req)
         self.start()  # idempotent; revives a stop()ped engine
@@ -577,8 +582,16 @@ class ContinuousEngine:
                     self.tokens_emitted += 1
                     if req.on_tokens is not None:
                         emitted.append((req, [first]))
-                    if len(req.tokens) >= req.max_new:
+                    first_is_eos = bool(req.eos) and first in req.eos
+                    if first_is_eos or len(req.tokens) >= req.max_new:
                         done.append(req)
+                        if first_is_eos:
+                            # The slot was occupied at admission (only
+                            # max_new==1 requests skip occupancy).
+                            for si, r in enumerate(self._slot_req):
+                                if r is req:
+                                    self._slot_req[si] = None
+                                    break
         self._fire_callbacks(emitted)
         for req in done:
             if not req.future.done():
@@ -613,16 +626,32 @@ class ContinuousEngine:
         emitted: List[tuple] = []
         with self._lock:
             for i, req in enumerate(reqs):
-                if req is None:
+                if req is None or self._slot_req[i] is not req \
+                        or req.future.done():
+                    # Stale snapshot entry: _drain_firsts (between this
+                    # chunk's dispatch and its fetch) may have resolved
+                    # a first-token-eos request and freed its slot —
+                    # appending this chunk's tokens would mutate a list
+                    # already handed to the future and leak post-eos
+                    # tokens to streaming clients.
                     continue
                 need = req.max_new - len(req.tokens)
                 take = min(need, self.chunk_steps)
                 new = [int(t) for t in toks_host[:take, i]]
+                hit_eos = False
+                if req.eos:
+                    for j, t in enumerate(new):
+                        if t in req.eos:
+                            # Stop INCLUDING the stop id; the slot frees
+                            # now instead of burning max_new's tail.
+                            new = new[:j + 1]
+                            hit_eos = True
+                            break
                 req.tokens.extend(new)
-                self.tokens_emitted += take
+                self.tokens_emitted += len(new)
                 if req.on_tokens is not None and new:
                     emitted.append((req, new))
-                if len(req.tokens) >= req.max_new:
+                if hit_eos or len(req.tokens) >= req.max_new:
                     self._slot_req[i] = None
                     done.append(req)
         self._fire_callbacks(emitted)

@@ -52,13 +52,14 @@ class _Pending:
 
     def __init__(self, rows: List[List[int]], max_new: int,
                  temperature: float, seed: Optional[int],
-                 top_k: int = 0, top_p: float = 1.0):
+                 top_k: int = 0, top_p: float = 1.0, eos=None):
         self.rows = rows
         self.max_new = max_new
         self.temperature = temperature
         self.seed = seed
         self.top_k = top_k
         self.top_p = top_p
+        self.eos = eos  # frozenset of stop ids, or None
         self.future: asyncio.Future = asyncio.get_event_loop().create_future()
 
     @property
@@ -246,8 +247,18 @@ class LlmServer:
             i = 0
             for p in sub:
                 n = len(p.rows)
-                # Each request gets only the tokens it asked for.
-                self._deliver(p, out[i:i + n, :p.max_new].tolist())
+                # Each request gets only the tokens it asked for,
+                # truncated at its first stop id (inclusive). The batch
+                # still decodes to the group max (no per-row early exit
+                # on this path — the continuous engine has that).
+                result = out[i:i + n, :p.max_new].tolist()
+                if p.eos:
+                    for r_i, r_toks in enumerate(result):
+                        for j, t in enumerate(r_toks):
+                            if t in p.eos:
+                                result[r_i] = r_toks[:j + 1]
+                                break
+                self._deliver(p, result)
                 i += n
 
     async def _worker_loop(self) -> None:
@@ -297,6 +308,15 @@ class LlmServer:
             return web.json_response(
                 {'error': 'top_k must be >= 0 and top_p in (0, 1]'},
                 status=400)
+        eos = body.get('eos_token')
+        if eos is not None:
+            try:
+                eos = frozenset([int(eos)] if isinstance(eos, int)
+                                else (int(t) for t in eos))
+            except (TypeError, ValueError):
+                return web.json_response(
+                    {'error': 'eos_token must be an int or list of '
+                              'ints'}, status=400)
         try:
             if isinstance(tokens[0], int):
                 tokens = [tokens]
@@ -322,16 +342,17 @@ class LlmServer:
                 status=400)
         if stream:
             return await self._generate_stream(request, rows, max_new,
-                                               temperature, top_k, top_p)
+                                               temperature, top_k, top_p,
+                                               eos)
         if self.engine is not None and not seeded:
             # Continuous-batching path: one engine slot per row.
             futs = [asyncio.wrap_future(
                 self.engine.submit(r, max_new, temperature, top_k=top_k,
-                                   top_p=top_p)) for r in rows]
+                                   top_p=top_p, eos=eos)) for r in rows]
             out = await asyncio.gather(*futs)
             return web.json_response({'tokens': [list(o) for o in out]})
         pending = _Pending(rows, max_new, temperature, seed,
-                           top_k=top_k, top_p=top_p)
+                           top_k=top_k, top_p=top_p, eos=eos)
         self._ensure_worker()
         await self._queue.put(pending)
         out = await pending.future
@@ -339,8 +360,8 @@ class LlmServer:
 
     async def _generate_stream(self, request: web.Request,
                                rows, max_new: int, temperature: float,
-                               top_k: int = 0,
-                               top_p: float = 1.0) -> web.StreamResponse:
+                               top_k: int = 0, top_p: float = 1.0,
+                               eos=None) -> web.StreamResponse:
         """NDJSON streaming (the JetStream-style serving contract):
         tokens are written as the engine emits them, one
         ``{"row": i, "tokens": [...]}`` object per line, at decode-chunk
@@ -357,7 +378,7 @@ class LlmServer:
             futs.append(asyncio.wrap_future(
                 self.engine.submit(row, max_new, temperature,
                                    on_tokens=cb, top_k=top_k,
-                                   top_p=top_p)))
+                                   top_p=top_p, eos=eos)))
         resp = web.StreamResponse()
         resp.content_type = 'application/x-ndjson'
         await resp.prepare(request)
@@ -386,11 +407,16 @@ class LlmServer:
                 get_task = None
                 # Futures resolved first: either the engine failed (no
                 # more callbacks will ever come — raise instead of
-                # waiting forever) or the tail emissions are already
-                # scheduled on this loop and a bounded drain finds them.
+                # waiting forever) or every request completed. Engine
+                # emissions are scheduled (call_soon_threadsafe, FIFO)
+                # BEFORE future resolution, so on success everything is
+                # already in the queue — drain it and stop; `remaining`
+                # may legitimately stay nonzero when stop tokens ended
+                # rows before max_new.
                 done_task.result()
-                while remaining:
-                    await _emit(await asyncio.wait_for(q.get(), timeout=5))
+                while not q.empty():
+                    await _emit(q.get_nowait())
+                break
             await done_task
             await resp.write(json_lib.dumps({'done': True}).encode()
                              + b'\n')

@@ -677,3 +677,117 @@ def test_engine_stream_honors_top_k(tiny):
     topk1 = stream_tokens({'temperature': 1.7, 'top_k': 1})
     assert greedy == topk1 == _solo(params, cfg, row, 6)
     server.engine.stop()
+
+
+def test_engine_eos_stops_early_and_frees_slot(tiny):
+    """Generation ends at the stop id (inclusive) instead of burning
+    max_new; the slot frees immediately."""
+    cfg, params = tiny
+    eng = _mk(params, cfg, chunk_steps=2)
+    try:
+        row = [5, 6, 7]
+        solo = _solo(params, cfg, row, 10)
+        eos = solo[3]  # known greedy 4th token
+        got = eng.submit(row, 10, eos=eos).result(timeout=120)
+        assert got == solo[:4]
+        assert eng.stats()['active_slots'] == 0
+        # Multi-id stop set, and eos-not-reached runs to max_new.
+        got2 = eng.submit(row, 4, eos=[99999]).result(timeout=120)
+        assert got2 == solo[:4]
+    finally:
+        eng.stop()
+
+
+def test_engine_eos_on_first_token(tiny):
+    """Prefill's sampled token itself being the stop id must resolve the
+    request at drain time and free the already-occupied slot."""
+    cfg, params = tiny
+    eng = _mk(params, cfg, slots=1)
+    try:
+        row = [5, 6, 7]
+        first = _solo(params, cfg, row, 1)[0]
+        got = eng.submit(row, 10, eos=first).result(timeout=120)
+        assert got == [first]
+        assert eng.stats()['active_slots'] == 0
+        # The in-flight chunk (dispatched before the drain resolved this
+        # request) must NOT append post-eos tokens to the delivered list.
+        time.sleep(1.0)
+        assert got == [first]
+        # The single slot is reusable immediately.
+        other = [9, 8, 7]
+        assert (eng.submit(other, 3).result(timeout=120)
+                == _solo(params, cfg, other, 3))
+    finally:
+        eng.stop()
+
+
+def test_llm_server_eos_token(tiny):
+    """eos_token over HTTP: engine path, window path (engine off via
+    seeded request), and the stream all truncate at the stop id."""
+    import json as json_lib
+    import threading
+
+    import requests as requests_lib
+    from aiohttp import web
+
+    from skypilot_tpu.serve import llm_server as llm_mod
+    from skypilot_tpu.utils import common_utils
+
+    cfg, params = tiny
+    server = llm_mod.LlmServer('tiny', max_len=64, engine='continuous')
+    server.params = params
+    server.engine.params = params
+    port = common_utils.find_free_port(21900)
+    started = threading.Event()
+
+    def run():
+        import asyncio
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(server.make_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, '127.0.0.1', port)
+        loop.run_until_complete(site.start())
+        started.set()
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(10)
+
+    row = [5, 6, 7]
+    solo = _solo(params, cfg, row, 10)
+    eos = solo[3]
+    url = f'http://127.0.0.1:{port}/generate'
+
+    r = requests_lib.post(url, json={
+        'tokens': [row], 'max_new_tokens': 10, 'eos_token': eos},
+        timeout=180)
+    assert r.json()['tokens'][0] == solo[:4]
+
+    # Seeded => window path; greedy-equivalent via temperature 0 is not
+    # seeded, so force the window path with a seed + temperature and
+    # only check truncation semantics (ends with a stop id, shorter
+    # than max_new OR exactly max_new without the id).
+    r2 = requests_lib.post(url, json={
+        'tokens': [row], 'max_new_tokens': 10, 'temperature': 1.0,
+        'seed': 5, 'eos_token': list(range(0, 128))}, timeout=180)
+    toks2 = r2.json()['tokens'][0]
+    hits = [t for t in toks2 if t < 128]
+    if len(toks2) < 10:
+        assert toks2[-1] < 128 and len(hits) == 1
+    else:
+        assert not hits[:-1]
+
+    sr = requests_lib.post(url, json={
+        'tokens': [row], 'max_new_tokens': 10, 'stream': True,
+        'eos_token': eos}, stream=True, timeout=180)
+    lines = [json_lib.loads(ln) for ln in sr.iter_lines() if ln.strip()]
+    assert lines[-1] == {'done': True}
+    streamed = [t for ln in lines[:-1] for t in ln['tokens']]
+    assert streamed == solo[:4]
+
+    r3 = requests_lib.post(url, json={
+        'tokens': [row], 'max_new_tokens': 4, 'eos_token': 'nope'},
+        timeout=30)
+    assert r3.status_code == 400
+    server.engine.stop()
