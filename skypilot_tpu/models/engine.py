@@ -266,6 +266,7 @@ class ContinuousEngine:
         self._slot_req: List[Optional[_Request]] = [None] * self.slots
         self._pending: collections.deque = collections.deque()
         self._unfetched: List[tuple] = []  # [(reqs, firsts-device-array)]
+        self._admitting: List[_Request] = []  # mid-prefill group
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
@@ -373,10 +374,12 @@ class ContinuousEngine:
         with self._lock:
             doomed = list(self._pending) + [
                 r for r in self._slot_req if r is not None] + [
-                r for reqs, _ in self._unfetched for r in reqs]
+                r for reqs, _ in self._unfetched for r in reqs] + \
+                list(self._admitting)
             self._pending.clear()
             self._slot_req = [None] * self.slots
             self._unfetched = []
+            self._admitting = []
         for req in doomed:  # dupes are safe: first set_exception wins
             if not req.future.done():
                 req.future.set_exception(exc)
@@ -444,7 +447,12 @@ class ContinuousEngine:
                 while g * 2 <= n:
                     g *= 2
                 reqs = [self._pending.popleft() for _ in range(g)]
+                # Mid-prefill requests live in NO other structure — a
+                # device failure here must still fail their futures.
+                self._admitting = reqs
             self._prefill_group(reqs, free[:g])
+            with self._lock:
+                self._admitting = []
 
     def _match_prefix(self, row: List[int]):
         """Longest cached prefix of ``row`` at power-of-two lengths
@@ -582,7 +590,8 @@ class ContinuousEngine:
                     self.tokens_emitted += 1
                     if req.on_tokens is not None:
                         emitted.append((req, [first]))
-                    first_is_eos = bool(req.eos) and first in req.eos
+                    first_is_eos = gen_lib.truncate_at_stop(
+                        [first], req.eos)[1]
                     if first_is_eos or len(req.tokens) >= req.max_new:
                         done.append(req)
                         if first_is_eos:
@@ -638,15 +647,9 @@ class ContinuousEngine:
                 need = req.max_new - len(req.tokens)
                 take = min(need, self.chunk_steps)
                 new = [int(t) for t in toks_host[:take, i]]
-                hit_eos = False
-                if req.eos:
-                    for j, t in enumerate(new):
-                        if t in req.eos:
-                            # Stop INCLUDING the stop id; the slot frees
-                            # now instead of burning max_new's tail.
-                            new = new[:j + 1]
-                            hit_eos = True
-                            break
+                # Stop at the first stop id; the slot frees now instead
+                # of burning max_new's tail.
+                new, hit_eos = gen_lib.truncate_at_stop(new, req.eos)
                 req.tokens.extend(new)
                 self.tokens_emitted += len(new)
                 if req.on_tokens is not None and new:

@@ -331,6 +331,17 @@ def _sample(logits: jax.Array, temperature: float,
 _jit_prefill = jax.jit(forward_cached, static_argnums=(3,))
 
 
+def truncate_at_stop(tokens, eos):
+    """Cut a generated row at its first stop id, INCLUSIVE. The single
+    definition of stop semantics — the continuous engine and the
+    window-batched path must never diverge. Returns (tokens, hit)."""
+    if eos:
+        for j, t in enumerate(tokens):
+            if t in eos:
+                return tokens[:j + 1], True
+    return tokens, False
+
+
 def pad_prompts(rows, pad_id: int = 0) -> Tuple[jax.Array, jax.Array]:
     """Right-pad a list of variable-length token rows into
     (tokens [B, S_max], lengths [B]) for a mixed-length serving batch."""

@@ -251,13 +251,8 @@ class LlmServer:
                 # truncated at its first stop id (inclusive). The batch
                 # still decodes to the group max (no per-row early exit
                 # on this path — the continuous engine has that).
-                result = out[i:i + n, :p.max_new].tolist()
-                if p.eos:
-                    for r_i, r_toks in enumerate(result):
-                        for j, t in enumerate(r_toks):
-                            if t in p.eos:
-                                result[r_i] = r_toks[:j + 1]
-                                break
+                result = [gen_lib.truncate_at_stop(r, p.eos)[0]
+                          for r in out[i:i + n, :p.max_new].tolist()]
                 self._deliver(p, result)
                 i += n
 
@@ -310,9 +305,15 @@ class LlmServer:
                 status=400)
         eos = body.get('eos_token')
         if eos is not None:
+            def _id(x):
+                # JSON true/false pass isinstance(x, int) — a silent
+                # stop-id 0/1 instead of a 400.
+                if isinstance(x, bool):
+                    raise ValueError(x)
+                return int(x)
             try:
-                eos = frozenset([int(eos)] if isinstance(eos, int)
-                                else (int(t) for t in eos))
+                eos = frozenset([_id(eos)] if isinstance(eos, int)
+                                else (_id(t) for t in eos))
             except (TypeError, ValueError):
                 return web.json_response(
                     {'error': 'eos_token must be an int or list of '

@@ -377,8 +377,13 @@ def test_engine_survives_device_failure(tiny):
         ok = eng.submit([1, 2, 3], 4)
         assert ok.result(timeout=120) == _solo(params, cfg, [1, 2, 3], 4)
         eng._cache = None  # sabotage the device state
-        with pytest.raises(Exception):
+        import concurrent.futures as cf
+        with pytest.raises(Exception) as excinfo:
             eng.submit([4, 5, 6], 4).result(timeout=120)
+        # The future must carry the REAL failure promptly — a mid-prefill
+        # request dropped from every tracking structure would only ever
+        # "fail" by result() timeout.
+        assert not isinstance(excinfo.value, cf.TimeoutError)
         after = eng.submit([7, 8, 9], 4)
         assert after.result(timeout=120) == _solo(params, cfg, [7, 8, 9], 4)
     finally:
