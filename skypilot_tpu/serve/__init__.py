@@ -190,6 +190,9 @@ def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:
                 'replica_id': r['replica_id'],
                 'status': r['status'].value,
                 'endpoint': r['endpoint'],
+                # Last readiness-probe body (the LLM replica reports
+                # engine stats here); JSON text -> dict, best effort.
+                'health': serve_state.parse_health(r.get('health')),
             } for r in replicas],
         })
     return out

@@ -138,7 +138,8 @@ class ReplicaManager:
         serve_state.upsert_replica(
             self.service_name, replica_id,
             serve_state.ReplicaStatus.FAILED if failed
-            else serve_state.ReplicaStatus.SHUTTING_DOWN)
+            else serve_state.ReplicaStatus.SHUTTING_DOWN,
+            health='')  # stale stats must not outlive the replica
         try:
             core.down(cluster)
         except exceptions.SkyTpuError:
@@ -152,14 +153,30 @@ class ReplicaManager:
 
     # -- probing -----------------------------------------------------------
 
-    def _probe(self, endpoint: str) -> bool:
+    def _probe(self, endpoint: str):
+        """(ok, health_json_text_or_None): besides readiness, the probe
+        body is kept when it is a JSON object — the in-framework LLM
+        replica reports live engine stats (tok emitted, slots, prefix
+        hits, kv/quantize modes) on /health, and recording them here
+        gives `serve status`/the dashboard per-replica observability
+        with zero extra requests."""
         probe = self.spec.readiness_probe
         try:
             r = requests_lib.get(f'http://{endpoint}{probe.path}',
                                  timeout=probe.timeout_seconds)
-            return r.status_code < 500
         except requests_lib.RequestException:
-            return False
+            return False, None
+        health = None
+        if r.status_code < 500:
+            try:
+                body = r.text
+                # Whole-or-nothing: truncating JSON mid-object would
+                # store text neither consumer can parse.
+                if len(body) <= 16384 and isinstance(r.json(), dict):
+                    health = body
+            except ValueError:
+                pass
+        return r.status_code < 500, health
 
     def probe_all(self) -> List[str]:
         """Probe every live replica; update statuses; replace dead READY
@@ -175,11 +192,12 @@ class ReplicaManager:
                 continue
             if endpoint is None:
                 continue
-            ok = self._probe(endpoint)
+            ok, health = self._probe(endpoint)
             if ok:
                 self._ready_since.setdefault(rid, now)
                 serve_state.upsert_replica(self.service_name, rid,
-                                           serve_state.ReplicaStatus.READY)
+                                           serve_state.ReplicaStatus.READY,
+                                           health=health)
                 ready.append(endpoint)
             else:
                 age = now - rep['created_at']
@@ -189,7 +207,7 @@ class ReplicaManager:
                     # not: tear down and replace.
                     serve_state.upsert_replica(
                         self.service_name, rid,
-                        serve_state.ReplicaStatus.NOT_READY)
+                        serve_state.ReplicaStatus.NOT_READY, health='')
                     if self.spot_placer is not None:
                         # A READY replica going dark is preemption-shaped.
                         self.spot_placer.report_preemption()

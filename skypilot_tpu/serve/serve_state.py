@@ -53,6 +53,7 @@ CREATE TABLE IF NOT EXISTS replicas (
     version INTEGER DEFAULT 1,
     use_spot INTEGER DEFAULT 0,
     weight REAL DEFAULT 1.0,
+    health TEXT,
     PRIMARY KEY (service_name, replica_id)
 );
 """
@@ -79,7 +80,8 @@ def _conn() -> sqlite3.Connection:
                 'INTEGER DEFAULT 0',
                 'ALTER TABLE services ADD COLUMN controller_claim_at REAL',
                 'ALTER TABLE replicas ADD COLUMN use_spot INTEGER DEFAULT 0',
-                'ALTER TABLE replicas ADD COLUMN weight REAL DEFAULT 1.0'):
+                'ALTER TABLE replicas ADD COLUMN weight REAL DEFAULT 1.0',
+                'ALTER TABLE replicas ADD COLUMN health TEXT'):
         try:
             conn.execute(ddl)
         except sqlite3.OperationalError:
@@ -247,10 +249,14 @@ def upsert_replica(service_name: str, replica_id: int,
                    endpoint: Optional[str] = None,
                    version: Optional[int] = None,
                    use_spot: Optional[bool] = None,
-                   weight: Optional[float] = None) -> None:
+                   weight: Optional[float] = None,
+                   health: Optional[str] = None) -> None:
     """``use_spot``/``weight`` feed the instance-aware/fallback
     autoscalers: weight is the replica's relative serving capacity (e.g.
-    chips vs the smallest replica), spot-ness drives on-demand fallback."""
+    chips vs the smallest replica), spot-ness drives on-demand fallback.
+    ``health`` is the replica's last readiness-probe response body (JSON
+    text) — the in-framework LLM replica reports engine stats there,
+    which `serve status`/the dashboard surface per replica."""
     with _lock(), _conn() as conn:
         existing = conn.execute(
             'SELECT replica_id FROM replicas WHERE service_name = ? AND '
@@ -280,10 +286,28 @@ def upsert_replica(service_name: str, replica_id: int,
             if weight is not None:
                 sets.append('weight = ?')
                 args.append(weight)
+            if health is not None:
+                # '' clears (a replica that went dark must not keep
+                # showing its last READY-era stats as current).
+                sets.append('health = ?')
+                args.append(health or None)
             args += [service_name, replica_id]
             conn.execute(
                 f'UPDATE replicas SET {", ".join(sets)} WHERE '
                 'service_name = ? AND replica_id = ?', args)
+
+
+def parse_health(text: Optional[str]) -> Optional[Dict[str, Any]]:
+    """The replicas.health column holds probe-response JSON text; every
+    consumer (serve.status, dashboard) surfaces it through THIS dict-only
+    parser so semantics cannot drift. None when absent/invalid/non-dict."""
+    if not text:
+        return None
+    try:
+        out = json.loads(text)
+    except ValueError:
+        return None
+    return out if isinstance(out, dict) else None
 
 
 def list_replicas(service_name: str) -> List[Dict[str, Any]]:

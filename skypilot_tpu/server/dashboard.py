@@ -215,6 +215,7 @@ def service_detail(name: str) -> Optional[Dict[str, Any]]:
             'use_spot': bool(r.get('use_spot')),
             'weight': r.get('weight'),
             'created_at': r.get('created_at'),
+            'health': serve_state.parse_health(r.get('health')),
         } for r in serve_state.list_replicas(name)],
     }
 
@@ -666,13 +667,33 @@ async function serviceView(name){
     `<h2>Ready replicas over time</h2>` + sparkline(st, '#0b57d0', maxR) +
     `<h2>Replicas</h2>` + table(
       ['id','status','version','endpoint','cluster','spot','weight',
-       'created'], v.replicas,
+       'created','health'], v.replicas,
       r=>`<tr><td>${esc(r.replica_id)}</td><td>${B(r.status)}</td>
        <td>v${r.version??1}</td><td>${esc(r.endpoint)}</td>
        <td>${esc(r.cluster_name)}</td><td>${r.use_spot?'spot':'od'}</td>
-       <td>${esc(r.weight)}</td><td>${T(r.created_at)}</td></tr>`) +
+       <td>${esc(r.weight)}</td><td>${T(r.created_at)}</td>
+       <td>${healthCell(r.health)}</td></tr>`) +
     `<h2>Spec</h2><pre class="log">${
       esc(JSON.stringify(v.spec, null, 2))}</pre>`;
+}
+
+// Last probe body, compacted: the LLM replica's engine stats become
+// "12.3k tok, 5/16 slots, pfx 40%"; anything else shows key count.
+function healthCell(h){
+  if(!h) return '—';
+  const e = h.engine;
+  if(e){
+    const parts = [`${(e.tokens_emitted||0).toLocaleString()} tok`,
+                   `${e.active_slots??0}/${e.slots??'?'} slots`];
+    const pc = e.prefix_cache;
+    if(pc && pc.slots > 0 && (pc.hits + pc.stores) > 0)
+      parts.push(`pfx ${pc.hits} hit`);
+    if(h.kv_cache === 'int8') parts.push('kv8');
+    if(h.quantize) parts.push(h.quantize);  // outer esc covers it
+    return esc(parts.join(', '));
+  }
+  return `<span title="${esc(JSON.stringify(h))}">${
+    Object.keys(h).length} field(s)</span>`;
 }
 
 // Multi-series line chart over the sampler's ring buffer.

@@ -80,6 +80,12 @@ def test_service_up_lb_round_trip_and_down():
     endpoint = serve.up(task, 'svc1', _in_process=True)
     st = _wait_ready('svc1', want_replicas=2)
     assert len(st['replicas']) == 2
+    # The readiness probe's JSON body is recorded per replica (the LLM
+    # replica reports engine stats this way; the stub reports its port).
+    ready = [r for r in st['replicas'] if r['status'] == 'READY']
+    assert ready and all(
+        isinstance(r['health'], dict) and 'port' in r['health']
+        for r in ready), st['replicas']
 
     # Requests through the LB reach both replicas (least-load spreads).
     seen_ports = set()
