@@ -242,7 +242,8 @@ def _cached_layer(cfg: llama.LlamaConfig, x: jax.Array, layer: Params,
 def forward_cached(params: Params, tokens: jax.Array,
                    cache: KVCache, cfg: llama.LlamaConfig,
                    row_lens: Optional[jax.Array] = None,
-                   active_rows: Optional[jax.Array] = None
+                   active_rows: Optional[jax.Array] = None,
+                   all_logits: bool = False
                    ) -> Tuple[jax.Array, KVCache]:
     """Run ``tokens`` [B, S] through the model appending to ``cache``;
     returns (logits for each row's LAST REAL position [B, vocab], updated
@@ -302,10 +303,18 @@ def forward_cached(params: Params, tokens: jax.Array,
         last = jnp.take_along_axis(
             x, (row_lens - 1)[:, None, None].astype(jnp.int32), axis=1
         )[:, 0]
+    new_cache = KVCache(k=new_k, v=new_v, lengths=new_lengths,
+                        k_s=new_ks, v_s=new_vs)
+    if all_logits:
+        # Per-POSITION logits [B, S, V]: speculative verification needs
+        # the target's prediction after every proposed token, not just
+        # the block's last (S is the small draft window, so the extra
+        # lm_head matmul is k rows, not a memory hazard).
+        return (_mm(x, params['lm_head'], 'bsd,dv->bsv',
+                    preferred_element_type=jnp.float32), new_cache)
     logits = _mm(last, params['lm_head'], 'bd,dv->bv',
                  preferred_element_type=jnp.float32)
-    return logits, KVCache(k=new_k, v=new_v, lengths=new_lengths,
-                           k_s=new_ks, v_s=new_vs)
+    return logits, new_cache
 
 
 def _sample(logits: jax.Array, temperature: float,

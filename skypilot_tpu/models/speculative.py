@@ -1,0 +1,182 @@
+"""Greedy speculative decoding: draft proposes, target verifies.
+
+Reference analog: the reference's serving engines (JetStream/vLLM,
+``examples/tpu/v6e/README.md:112-118``) ship speculative decoding as the
+standard latency lever for memory-bound decode: a small DRAFT model
+proposes ``k`` tokens autoregressively (cheap steps), then the TARGET
+scores all k in ONE forward — each accepted proposal turns a
+memory-bound target step into 1/k-th of a compute-bound verify.
+
+This implementation is the GREEDY variant: both models decode argmax, a
+proposal is accepted while it equals the target's own argmax, and the
+first divergence is replaced by the target's token. The committed stream
+is therefore EXACTLY the target's greedy generation — byte-identical to
+``generate.generate(target, ...)`` for any draft whatsoever (the draft
+only changes speed, never output), which is also what makes it testable.
+
+TPU shape discipline: the draft's k proposal steps are one ``lax.scan``;
+the verify is one k-token ``forward_cached`` with per-position logits;
+acceptance is decided host-side and "rollback" is just rewriting the
+caches' ``lengths`` vectors — positions past a row's valid length are
+never attended and get overwritten by the next window, so rejected
+junk costs nothing (the same invariant the serving engine relies on).
+
+Both models must share a vocabulary (true of Llama draft/target pairs).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu.models import generate as gen_lib
+from skypilot_tpu.models import llama
+
+
+def _propose_impl(cfg, k, params, cache, cur):
+    """k+1 greedy draft steps from ``cur`` [B]: returns (cache,
+    proposals [k+1, B]) of which the first k are verified. The extra
+    step exists to WRITE p_k's KV into the draft cache — without it a
+    fully-accepted window would leave the draft missing its newest
+    committed token, and capping the window at k-1 proposals instead
+    would waste one verified target token per round (the expensive
+    kind). One surplus draft forward is the cheap side of that trade;
+    its output token is discarded."""
+    def step(carry, _):
+        cache, tok = carry
+        logits, cache = gen_lib.forward_cached(params, tok[:, None],
+                                               cache, cfg)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (cache, nxt), nxt
+
+    (cache, _), toks = jax.lax.scan(step, (cache, cur), None,
+                                    length=k + 1)
+    return cache, toks
+
+
+_jit_propose = jax.jit(_propose_impl, static_argnums=(0, 1),
+                       donate_argnums=(3,))
+
+
+def _verify_impl(cfg, params, cache, window):
+    """One target forward over ``window`` [B, k] (= [cur, p1..p_{k-1}]):
+    returns (cache, target argmax at every position [B, k])."""
+    logits, cache = gen_lib.forward_cached(params, window, cache, cfg,
+                                           all_logits=True)
+    return cache, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+_jit_verify = jax.jit(_verify_impl, static_argnums=(0,),
+                      donate_argnums=(2,))
+
+
+def generate_speculative(target_params, target_cfg: llama.LlamaConfig,
+                         draft_params, draft_cfg: llama.LlamaConfig,
+                         prompt: jax.Array, max_new_tokens: int,
+                         k: int = 4,
+                         max_len: Optional[int] = None
+                         ) -> Tuple[jax.Array, dict]:
+    """prompt [B, S] int32 -> ([B, max_new_tokens] ids, stats).
+
+    Greedy-exact: the output equals ``generate.generate(target_params,
+    target_cfg, prompt, max_new_tokens)`` regardless of the draft.
+    ``stats['acceptance_rate']`` is the fraction of draft proposals the
+    target accepted (the speedup driver: committed tokens per verify is
+    ``1 + k * acceptance_rate`` on average)."""
+    if target_cfg.vocab_size != draft_cfg.vocab_size:
+        raise ValueError('draft and target must share a vocabulary '
+                         f'({draft_cfg.vocab_size} vs '
+                         f'{target_cfg.vocab_size})')
+    if k < 1:
+        raise ValueError(f'k must be >= 1, got {k}')
+    b, s_p = prompt.shape
+    # +k+1 slack: a verify window may overhang the last committed
+    # position before its tail is rolled back.
+    max_len = max_len or min(target_cfg.max_seq_len,
+                             draft_cfg.max_seq_len,
+                             s_p + max_new_tokens + k + 1)
+    if s_p + max_new_tokens + k > max_len:
+        raise ValueError(
+            f'prompt ({s_p}) + max_new ({max_new_tokens}) + window '
+            f'({k + 1}) exceeds max_len {max_len}')
+    if max_len > draft_cfg.max_seq_len:
+        # The draft would decode past its trained context — RoPE keeps
+        # computing, but proposals degrade to out-of-distribution junk
+        # and acceptance silently collapses. Fail loudly instead.
+        raise ValueError(
+            f'max_len {max_len} exceeds the draft model\'s max_seq_len '
+            f'{draft_cfg.max_seq_len}')
+
+    t_cache = gen_lib.init_cache(target_cfg, b, max_len)
+    d_cache = gen_lib.init_cache(draft_cfg, b, max_len)
+    logits, t_cache = gen_lib._jit_prefill(  # noqa: SLF001 — same pkg
+        target_params, prompt, t_cache, target_cfg, None)
+    _, d_cache = gen_lib._jit_prefill(  # noqa: SLF001
+        draft_params, prompt, d_cache, draft_cfg, None)
+    cur = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    out = [[int(t)] for t in np.asarray(jax.device_get(cur))]
+    proposals_total = 0
+    proposals_accepted = 0
+    verifies = 0
+    # Invariant at loop top: both caches hold exactly the committed
+    # context EXCLUDING cur (the newest committed token per row); all
+    # rows share one committed length (rows that already have max_new
+    # keep decoding, their surplus is simply not emitted).
+    while min(len(o) for o in out) < max_new_tokens:
+        d_cache, props = _jit_propose(draft_cfg, k, draft_params,
+                                      d_cache, cur)
+        props_host = np.asarray(jax.device_get(props))  # [k+1, B]
+        # Verify window [cur, p1..pk] (k+1 tokens): EVERY proposal gets
+        # checked; tgt[:, j] is the target's choice after window[:j+1].
+        window = jnp.concatenate(
+            [cur[:, None], props.transpose(1, 0)[:, :k]], axis=1)
+        t_cache, tgt = _jit_verify(target_cfg, target_params, t_cache,
+                                   window)
+        tgt_host = np.asarray(jax.device_get(tgt))  # [B, k+1]
+        # Accept the longest shared prefix ACROSS rows (rows share the
+        # cache length; per-row divergence is handled by emitting only
+        # each row's own accepted prefix + correction).
+        a_rows = []
+        for r in range(b):
+            a = 0
+            while a < k and props_host[a, r] == tgt_host[r, a]:
+                a += 1
+            a_rows.append(a)
+        a_min = min(a_rows)
+        verifies += 1
+        proposals_total += k * b
+        proposals_accepted += sum(a_rows)
+        for r in range(b):
+            # Emit row r's accepted proposals up to the BATCH commit
+            # point, then the target's own token there.
+            out[r].extend(int(t) for t in props_host[:a_min, r])
+            out[r].append(int(tgt_host[r, a_min]))
+        cur = tgt[:, a_min]
+        committed = a_min + 1  # tokens the caches keep (incl cur's KV)
+        # Rollback = rewind lengths from the post-window position (both
+        # models advanced exactly k+1); the pre-window lengths were
+        # donated away with the old cache objects.
+        t_cache = gen_lib.KVCache(
+            k=t_cache.k, v=t_cache.v,
+            lengths=t_cache.lengths - (k + 1 - committed),
+            k_s=t_cache.k_s, v_s=t_cache.v_s)
+        d_cache = gen_lib.KVCache(
+            k=d_cache.k, v=d_cache.v,
+            lengths=d_cache.lengths - (k + 1 - committed),
+            k_s=d_cache.k_s, v_s=d_cache.v_s)
+
+    toks = jnp.asarray(
+        np.asarray([o[:max_new_tokens] for o in out], np.int32))
+    stats = {
+        'verifies': verifies,
+        'proposals': proposals_total,
+        'accepted': proposals_accepted,
+        'acceptance_rate': (proposals_accepted / proposals_total
+                            if proposals_total else 0.0),
+        'tokens_per_verify': (sum(len(o) for o in out) / b - 1)
+                             / max(verifies, 1),
+    }
+    return toks, stats

@@ -265,10 +265,11 @@ def upsert_replica(service_name: str, replica_id: int,
             conn.execute(
                 'INSERT INTO replicas (service_name, replica_id, status, '
                 'cluster_name, endpoint, created_at, version, use_spot, '
-                'weight) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)',
+                'weight, health) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)',
                 (service_name, replica_id, status.value, cluster_name,
                  endpoint, time.time(), version or 1,
-                 int(bool(use_spot)), weight if weight is not None else 1.0))
+                 int(bool(use_spot)),
+                 weight if weight is not None else 1.0, health or None))
         else:
             sets, args = ['status = ?'], [status.value]
             if cluster_name is not None:

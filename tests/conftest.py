@@ -46,6 +46,7 @@ _SLOW_MODULES = {
     'test_managed_jobs', 'test_model_and_trainer', 'test_native_gang',
     'test_ops_attention', 'test_parallel', 'test_pipeline_moe',
     'test_remote_control', 'test_serve', 'test_serve_ha', 'test_slurm_cloud',
+    'test_speculative',
     'test_ssh_path', 'test_storage_and_checkpoint',
 }
 _LOAD_MODULES = {'test_load'}

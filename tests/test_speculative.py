@@ -1,0 +1,88 @@
+"""Speculative decoding tests (models/speculative.py).
+
+The load-bearing property: GREEDY speculative output is byte-identical
+to the target's plain greedy generation for ANY draft — the draft can
+only change speed, never content. That makes correctness testable
+without a trained model pair: even a random 'draft' (near-zero
+acceptance) must reproduce the target stream exactly, and the target
+itself as draft (100% acceptance) must too.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import generate, llama, speculative
+
+
+@pytest.fixture(scope='module')
+def pair():
+    target_cfg = llama.TINY
+    target = llama.init_params(jax.random.PRNGKey(0), target_cfg)
+    # A smaller, differently-initialized draft with the same vocab.
+    draft_cfg = dataclasses.replace(llama.TINY, n_layers=1, d_model=32,
+                                    n_heads=2, n_kv_heads=1, d_ff=64,
+                                    head_dim=16)
+    draft = llama.init_params(jax.random.PRNGKey(99), draft_cfg)
+    return target, target_cfg, draft, draft_cfg
+
+
+def _target_greedy(params, cfg, prompt, n):
+    return np.asarray(generate.generate(params, cfg, prompt,
+                                        max_new_tokens=n, max_len=64))
+
+
+def test_speculative_exact_with_random_draft(pair):
+    target, tcfg, draft, dcfg = pair
+    prompt = jnp.asarray([[5, 6, 7], [9, 8, 7]], jnp.int32)
+    want = _target_greedy(target, tcfg, prompt, 10)
+    for k in (1, 2, 4):
+        got, stats = speculative.generate_speculative(
+            target, tcfg, draft, dcfg, prompt, 10, k=k, max_len=64)
+        np.testing.assert_array_equal(np.asarray(got), want,
+                                      err_msg=f'k={k}')
+        assert stats['verifies'] >= 1
+
+
+def test_speculative_exact_with_perfect_draft(pair):
+    """Target-as-draft: every proposal accepted, so each verify commits
+    the full window — and the stream is still exactly greedy."""
+    target, tcfg, _, _ = pair
+    prompt = jnp.asarray([[3, 4, 5, 6]], jnp.int32)
+    want = _target_greedy(target, tcfg, prompt, 12)
+    got, stats = speculative.generate_speculative(
+        target, tcfg, target, tcfg, prompt, 12, k=4, max_len=64)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert stats['acceptance_rate'] == 1.0
+    # k accepted proposals + 1 target token per verify (k+1 = 5).
+    assert stats['tokens_per_verify'] >= 3.6
+    # Far fewer verifies than tokens: the speedup mechanism.
+    assert stats['verifies'] <= 3
+
+
+def test_speculative_rejects_draft_context_overflow(pair):
+    target, tcfg, draft, dcfg = pair
+    short_draft_cfg = dataclasses.replace(dcfg, max_seq_len=32)
+    with pytest.raises(ValueError, match='draft'):
+        speculative.generate_speculative(
+            target, tcfg, draft, short_draft_cfg,
+            jnp.asarray([[1, 2, 3]], jnp.int32), 10, k=4, max_len=64)
+
+
+def test_speculative_rejects_vocab_mismatch(pair):
+    target, tcfg, draft, dcfg = pair
+    bad_cfg = dataclasses.replace(dcfg, vocab_size=tcfg.vocab_size + 1)
+    with pytest.raises(ValueError, match='vocab'):
+        speculative.generate_speculative(
+            target, tcfg, draft, bad_cfg,
+            jnp.asarray([[1, 2]], jnp.int32), 4)
+
+
+def test_speculative_rejects_overlong(pair):
+    target, tcfg, draft, dcfg = pair
+    with pytest.raises(ValueError, match='max_len'):
+        speculative.generate_speculative(
+            target, tcfg, draft, dcfg,
+            jnp.asarray([[1] * 30], jnp.int32), 30, k=8, max_len=64)
