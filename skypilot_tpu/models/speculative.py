@@ -61,8 +61,8 @@ _jit_propose = jax.jit(_propose_impl, static_argnums=(0, 1),
 
 
 def _verify_impl(cfg, params, cache, window):
-    """One target forward over ``window`` [B, k] (= [cur, p1..p_{k-1}]):
-    returns (cache, target argmax at every position [B, k])."""
+    """One target forward over ``window`` [B, k+1] (= [cur, p1..pk]):
+    returns (cache, target argmax at every position [B, k+1])."""
     logits, cache = gen_lib.forward_cached(params, window, cache, cfg,
                                            all_logits=True)
     return cache, jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -100,14 +100,15 @@ def generate_speculative(target_params, target_cfg: llama.LlamaConfig,
     if s_p + max_new_tokens + k > max_len:
         raise ValueError(
             f'prompt ({s_p}) + max_new ({max_new_tokens}) + window '
-            f'({k + 1}) exceeds max_len {max_len}')
-    if max_len > draft_cfg.max_seq_len:
-        # The draft would decode past its trained context — RoPE keeps
-        # computing, but proposals degrade to out-of-distribution junk
-        # and acceptance silently collapses. Fail loudly instead.
+            f'overhang ({k}) exceeds max_len {max_len}')
+    if max_len > draft_cfg.max_seq_len or \
+            max_len > target_cfg.max_seq_len:
+        # Either model decoding past its trained context silently
+        # degrades (RoPE keeps computing, outputs go out-of-
+        # distribution). Fail loudly instead.
         raise ValueError(
-            f'max_len {max_len} exceeds the draft model\'s max_seq_len '
-            f'{draft_cfg.max_seq_len}')
+            f'max_len {max_len} exceeds a model max_seq_len (draft '
+            f'{draft_cfg.max_seq_len}, target {target_cfg.max_seq_len})')
 
     t_cache = gen_lib.init_cache(target_cfg, b, max_len)
     d_cache = gen_lib.init_cache(draft_cfg, b, max_len)

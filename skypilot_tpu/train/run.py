@@ -26,6 +26,11 @@ def main() -> None:
     parser.add_argument('--global-batch-size', type=int, default=2)
     parser.add_argument('--seq-len', type=int, default=128)
     parser.add_argument('--optimizer', default='adafactor')
+    parser.add_argument('--accum-steps', type=int, default=1,
+                        help='gradient accumulation: microbatches per '
+                             'optimizer step (global batch must divide)')
+    parser.add_argument('--total-steps', type=int, default=10_000,
+                        help='LR cosine-decay horizon')
     parser.add_argument('--data', default=None,
                         help='pretokenized token file (train/data.py '
                              'TokenDataset); synthetic stream when unset')
@@ -80,6 +85,8 @@ def main() -> None:
     cfg = TrainerConfig(model=llama.PRESETS[args.model],
                         global_batch_size=args.global_batch_size,
                         seq_len=args.seq_len, optimizer=args.optimizer,
+                        accum_steps=args.accum_steps,
+                        total_steps=args.total_steps,
                         remat=True, remat_policy=args.remat_policy,
                         lora=lora_cfg)
 

@@ -30,9 +30,16 @@ class TrainerConfig:
     seq_len: int = 2048
     learning_rate: float = 3e-4
     warmup_steps: int = 100
+    total_steps: int = 10_000  # LR cosine-decay horizon
     weight_decay: float = 0.1
     grad_clip_norm: float = 1.0
     optimizer: str = 'adafactor'  # 'adafactor' | 'adamw'
+    # Gradient accumulation: the global batch is split into accum_steps
+    # microbatches whose activations live one at a time (lax.scan), so a
+    # batch that does not fit HBM still takes ONE optimizer step over
+    # its full gradient. grads accumulate in fp32 regardless of the
+    # param dtype.
+    accum_steps: int = 1
     remat: bool = True
     # One of models/llama.py REMAT_POLICIES: 'full' (recompute everything,
     # lowest memory), 'attn' (keep flash-attention outputs), 'heavy' (keep
@@ -49,11 +56,19 @@ class TrainerConfig:
             raise ValueError(
                 f'Unknown remat_policy {self.remat_policy!r}; choose from '
                 f'{sorted(llama.REMAT_POLICIES)}')
+        if self.accum_steps < 1 or \
+                self.global_batch_size % self.accum_steps:
+            raise ValueError(
+                f'accum_steps ({self.accum_steps}) must divide '
+                f'global_batch_size ({self.global_batch_size})')
 
 
 def make_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
+    # optax requires decay_steps > warmup_steps; a short run whose
+    # total_steps <= warmup simply never leaves warmup.
     schedule = optax.warmup_cosine_decay_schedule(
-        0.0, cfg.learning_rate, cfg.warmup_steps, 10_000)
+        0.0, cfg.learning_rate, cfg.warmup_steps,
+        max(cfg.total_steps, cfg.warmup_steps + 1))
     if cfg.optimizer == 'adafactor':
         opt = optax.adafactor(learning_rate=schedule)
     elif cfg.optimizer == 'adamw':
@@ -128,8 +143,8 @@ class Trainer:
               tokens: jax.Array) -> Tuple[Dict[str, Any], Dict[str, Any]]:
         cfg = self.cfg
 
-        def loss(params):
-            return llama.loss_fn(params, tokens, cfg.model, remat=cfg.remat,
+        def loss(params, toks):
+            return llama.loss_fn(params, toks, cfg.model, remat=cfg.remat,
                                  mesh=self.mesh, rules=self.rules,
                                  remat_policy=cfg.remat_policy)
 
@@ -139,13 +154,16 @@ class Trainer:
         # block serves both modes so they can never drift.
         if cfg.lora is not None:
             trainable = state['lora']
-            loss_of = lambda t: loss(  # noqa: E731
-                lora_lib.merge(state['params'], t, cfg.lora))
+            loss_of = lambda t, toks: loss(  # noqa: E731
+                lora_lib.merge(state['params'], t, cfg.lora), toks)
         else:
             trainable = state['params']
             loss_of = loss
-        (_, metrics), grads = jax.value_and_grad(
-            loss_of, has_aux=True)(trainable)
+        if cfg.accum_steps == 1:
+            (_, metrics), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(trainable, tokens)
+        else:
+            metrics, grads = self._accumulate(trainable, loss_of, tokens)
         updates, new_opt = self.optimizer.update(
             grads, state['opt_state'], trainable)
         new_trainable = optax.apply_updates(trainable, updates)
@@ -157,6 +175,47 @@ class Trainer:
         metrics = dict(metrics)
         metrics['grad_norm'] = optax.global_norm(grads)
         return new_state, metrics
+
+    def _accumulate(self, trainable, loss_of, tokens):
+        """Microbatched gradient: lax.scan over accum_steps chunks of
+        the global batch, so only ONE chunk's activations are ever
+        live; grads sum in fp32 and average back to the param dtype.
+        Equal-sized chunks make the chunk-mean of per-token-mean losses
+        equal the full-batch mean."""
+        a = self.cfg.accum_steps
+        chunks = tokens.reshape(a, tokens.shape[0] // a, tokens.shape[1])
+
+        def one(chunk):
+            return jax.value_and_grad(loss_of, has_aux=True)(trainable,
+                                                             chunk)
+
+        # eval_shape supplies the carry pytree structure WITHOUT tracing
+        # the fwd+bwd a second time — an unrolled first chunk would
+        # double the step's HLO (and compile time) for a real model.
+        (_, m_shape), g_shape = jax.eval_shape(one, chunks[0])
+        zeros_f32 = lambda t: jax.tree.map(  # noqa: E731
+            lambda s: jnp.zeros(s.shape, jnp.float32), t)
+        carry0 = (zeros_f32(g_shape), zeros_f32(m_shape))
+
+        def body(carry, chunk):
+            g_acc, m_acc = carry
+            (_, m), g = one(chunk)
+            g_acc = jax.tree.map(
+                lambda x, y: x + y.astype(jnp.float32), g_acc, g)
+            m_acc = jax.tree.map(
+                lambda x, y: x + y.astype(jnp.float32), m_acc, m)
+            return (g_acc, m_acc), None
+
+        (g_sum, m_sum), _ = jax.lax.scan(body, carry0, chunks)
+        grads = jax.tree.map(lambda x, p: (x / a).astype(p.dtype),
+                             g_sum, trainable)
+        metrics = dict(jax.tree.map(lambda x: x / a, m_sum))
+        if 'perplexity' in metrics:
+            # exp is nonlinear: the mean of chunk perplexities is NOT
+            # the full-batch perplexity — recompute from the mean nll
+            # so accum_steps never changes reported metrics.
+            metrics['perplexity'] = jnp.exp(metrics['loss'])
+        return metrics, grads
 
     def compiled_step(self) -> Callable:
         if self._train_step is None:

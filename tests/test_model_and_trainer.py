@@ -97,3 +97,49 @@ def test_flops_accounting():
     from skypilot_tpu.train import trainer as trainer_mod
     flops = trainer_mod.model_flops_per_step(cfg)
     assert flops == pytest.approx(6 * n * 16 * 8191)
+
+
+def test_grad_accumulation_matches_single_step():
+    """accum_steps=2 must take the same optimizer step as one pass over
+    the full batch (grads sum in fp32, equal-sized chunks => the chunk
+    mean equals the batch mean)."""
+    kw = dict(model=llama.TINY, global_batch_size=4, seq_len=32,
+              learning_rate=1e-2, warmup_steps=1, optimizer='adamw',
+              remat=False)
+    batch = jnp.asarray(next(iter(data_lib.synthetic_batches(
+        4, 32, llama.TINY.vocab_size, seed=3, num_batches=1))))
+    results = {}
+    for accum in (1, 2):
+        trainer = Trainer(TrainerConfig(accum_steps=accum, **kw))
+        state = trainer.init_state(seed=0)
+        state, metrics = trainer.compiled_step()(state, batch)
+        results[accum] = (float(metrics['loss']),
+                          np.asarray(state['params']['layers']['wq'],
+                                     np.float32))
+    l1, w1 = results[1]
+    l2, w2 = results[2]
+    assert abs(l1 - l2) < 2e-3, (l1, l2)
+    np.testing.assert_allclose(w1, w2, atol=2e-3)
+
+
+def test_grad_accumulation_on_mesh():
+    """Accumulation composes with dp/tp sharding (the microbatch scan
+    runs inside the same SPMD program)."""
+    cfg = TrainerConfig(model=llama.TINY, global_batch_size=4, seq_len=32,
+                        learning_rate=1e-2, warmup_steps=1,
+                        optimizer='adamw', remat=False, accum_steps=2)
+    mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=2, fsdp=1, tensor=2),
+                               devices=jax.devices()[:4])
+    trainer = Trainer(cfg, mesh=mesh)
+    state = trainer.init_state(seed=0)
+    batch = jnp.asarray(next(iter(data_lib.synthetic_batches(
+        4, 32, cfg.model.vocab_size, seed=3, num_batches=1))))
+    state, metrics = trainer.compiled_step()(state, batch)
+    assert np.isfinite(float(metrics['loss']))
+    assert int(state['step']) == 1
+
+
+def test_accum_steps_must_divide_batch():
+    with pytest.raises(ValueError, match='accum_steps'):
+        TrainerConfig(model=llama.TINY, global_batch_size=4,
+                      accum_steps=3)
