@@ -13,6 +13,7 @@ training stack (``models/llama.py``).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -23,6 +24,19 @@ from skypilot_tpu.models.quantization import mm as _mm
 
 Params = llama.Params
 _NEG_INF = -1e30
+
+
+# Latched at IMPORT: generate()'s module-level jits cache on shapes and
+# static args only, so a flag that changed mid-process would be
+# silently ignored for already-compiled shapes — latching makes the
+# semantics honest (set the env before the serving process starts).
+# Tests monkeypatch the module attribute directly.
+_DECODE_KERNEL_ENABLED = (
+    os.environ.get('SKYTPU_DECODE_KERNEL') == 'pallas')
+
+
+def _use_decode_kernel() -> bool:
+    return _DECODE_KERNEL_ENABLED
 
 
 @dataclasses.dataclass
@@ -93,6 +107,24 @@ def _cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     position: keys scale the post-QK logits, values scale the probs
     before PV — the full-precision cache never materializes."""
     b, s, hq, d = q.shape
+    if s == 1 and _use_decode_kernel():
+        # Opt-in pallas flash-decode (ops/decode_attention.py): streams
+        # the cache once with an online softmax instead of
+        # materializing the [B, Hkv, G, 1, M] fp32 logits between two
+        # einsums. Tolerance-level (not bit-exact) vs this path, hence
+        # opt-in: SKYTPU_DECODE_KERNEL=pallas.
+        from skypilot_tpu.ops import decode_attention
+        from skypilot_tpu.ops.attention import _use_pallas
+        if decode_attention.fits(k_cache.shape[2], d):
+            lengths = (jnp.broadcast_to(valid_len, (b,)).astype(jnp.int32)
+                       if valid_len.ndim == 0
+                       else valid_len.astype(jnp.int32))
+            out = decode_attention.flash_decode(
+                q[:, 0], k_cache, v_cache, lengths, k_s, v_s,
+                interpret=not _use_pallas())
+            return out[:, None].astype(q.dtype)
+        # else: geometry the kernel can't take (VMEM cap / non-128
+        # cache) — fall through to the einsum path.
     hkv = k_cache.shape[1]
     group = hq // hkv
     max_len = k_cache.shape[2]
