@@ -30,10 +30,13 @@ audit-clean:
 test-fast:
 	$(PY) -m pytest tests/ -q -m "not slow and not load" -p no:cacheprovider
 
-# Full suite minus sustained load tests — with a 30-minute duration
-# budget asserted after the run (fails loudly if the tier regresses).
+# Full suite minus sustained load tests — duration-budgeted (fails
+# loudly if the tier regresses). 2100 s: measured 31:05 on an idle
+# sandbox after round 4 grew the serving/training suites (engine,
+# speculative, kv-int8, prefix cache, grad accumulation) — raised from
+# 1800 with ~12% headroom rather than cutting integration coverage.
 test:
-	$(PY) tools/run_budgeted.py 1800 $(PY) -m pytest tests/ -q -m "not load"
+	$(PY) tools/run_budgeted.py 2100 $(PY) -m pytest tests/ -q -m "not load"
 
 # Everything, including load/chaos suites.
 test-all:

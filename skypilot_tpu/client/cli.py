@@ -456,8 +456,16 @@ def serve_status(service_name):
     for svc in serve.status(service_name):
         click.echo(f"{svc['name']}: {svc['status']} @ {svc['endpoint']}")
         for r in svc['replicas']:
-            click.echo(f"  replica {r['replica_id']}: {r['status']} "
-                       f"@ {r['endpoint']}")
+            line = (f"  replica {r['replica_id']}: {r['status']} "
+                    f"@ {r['endpoint']}")
+            h = r.get('health') or {}
+            eng = h.get('engine')
+            if eng:
+                # The LLM replica's live engine stats, compacted.
+                line += (f"  [{eng.get('tokens_emitted', 0)} tok, "
+                         f"{eng.get('active_slots', 0)}/"
+                         f"{eng.get('slots', '?')} slots]")
+            click.echo(line)
 
 
 @serve_group.command('down')

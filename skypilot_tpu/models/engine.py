@@ -249,6 +249,11 @@ class ContinuousEngine:
         # then compiles to an SPMD program — XLA inserts the collectives.
         self.mesh = mesh
         self.rules = rules
+        if mesh is not None and gen_lib._DECODE_KERNEL_ENABLED:
+            raise ValueError(
+                'SKYTPU_DECODE_KERNEL=pallas is single-device (the '
+                'kernel carries no sharding rule); unset it for '
+                'sharded serving')
         if mesh is not None:
             from skypilot_tpu.models import quantization as quant_lib
             from skypilot_tpu.parallel import sharding as sharding_lib

@@ -76,7 +76,8 @@ def generate_speculative(target_params, target_cfg: llama.LlamaConfig,
                          draft_params, draft_cfg: llama.LlamaConfig,
                          prompt: jax.Array, max_new_tokens: int,
                          k: int = 4,
-                         max_len: Optional[int] = None
+                         max_len: Optional[int] = None,
+                         kv_quantize: bool = False
                          ) -> Tuple[jax.Array, dict]:
     """prompt [B, S] int32 -> ([B, max_new_tokens] ids, stats).
 
@@ -110,8 +111,14 @@ def generate_speculative(target_params, target_cfg: llama.LlamaConfig,
             f'max_len {max_len} exceeds a model max_seq_len (draft '
             f'{draft_cfg.max_seq_len}, target {target_cfg.max_seq_len})')
 
-    t_cache = gen_lib.init_cache(target_cfg, b, max_len)
-    d_cache = gen_lib.init_cache(draft_cfg, b, max_len)
+    # int8 caches compose transparently: quantization is per position
+    # and deterministic in (value, position), so accepted prefixes carry
+    # exactly the codes the sequential path would have written — the
+    # greedy-exactness argument is unchanged.
+    t_cache = gen_lib.init_cache(target_cfg, b, max_len,
+                                 quantize=kv_quantize)
+    d_cache = gen_lib.init_cache(draft_cfg, b, max_len,
+                                 quantize=kv_quantize)
     logits, t_cache = gen_lib._jit_prefill(  # noqa: SLF001 — same pkg
         target_params, prompt, t_cache, target_cfg, None)
     _, d_cache = gen_lib._jit_prefill(  # noqa: SLF001

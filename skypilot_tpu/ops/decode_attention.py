@@ -60,10 +60,18 @@ def _pick_block(m: int) -> int:
     return b
 
 
-def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, block_k: int,
-                   max_len: int):
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *rest, block_k: int,
+                   max_len: int, quant: bool):
     """q_ref [G, D]; k_ref/v_ref [M, D] (one (row, kv-head) slice);
-    len_ref: scalar-prefetched [B] valid lengths."""
+    len_ref: scalar-prefetched [B] valid lengths. ``quant`` (static):
+    k/v are int8 codes and ``rest`` leads with their [M, 1] fp32
+    per-position scales, folded exactly where the jnp path folds them
+    (keys into the logits, values into the probs). ONE body serves both
+    modes so the masking/accumulation can never diverge."""
+    if quant:
+        ks_ref, vs_ref, o_ref = rest
+    else:
+        (o_ref,) = rest
     b = pl.program_id(0)
     q = q_ref[...]
     g, d = q.shape
@@ -78,6 +86,8 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, block_k: int,
         s = jax.lax.dot_general(
             q, kblk.astype(q.dtype), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [G, bk]
+        if quant:
+            s = s * ks_ref[pl.ds(start, block_k), :][:, 0][None, :]
         ki = start + jax.lax.broadcasted_iota(jnp.int32, (g, block_k), 1)
         s = jnp.where(ki < valid, s, _NEG_INF)
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -86,51 +96,10 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, block_k: int,
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         vblk = v_ref[pl.ds(start, block_k), :]
+        if quant:
+            p = p * vs_ref[pl.ds(start, block_k), :][:, 0][None, :]
         acc = acc * alpha + jax.lax.dot_general(
             p.astype(q.dtype), vblk.astype(q.dtype),
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return acc, m_new, l_new
-
-    acc0 = jnp.zeros((g, d), jnp.float32)
-    m0 = jnp.full((g, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((g, 1), jnp.float32)
-    acc, _, l = jax.lax.fori_loop(0, num_blocks, body, (acc0, m0, l0))
-    o_ref[...] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-
-
-def _decode_kernel_quant(len_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
-                         o_ref, *, block_k: int, max_len: int):
-    """int8 cache variant: k/v are int8 codes, ks/vs [M, 1] fp32
-    per-position scales folded exactly where the jnp path folds them."""
-    b = pl.program_id(0)
-    q = q_ref[...]
-    g, d = q.shape
-    scale = d ** -0.5
-    valid = len_ref[b]
-    num_blocks = pl.cdiv(max_len, block_k)
-
-    def body(kb, carry):
-        acc, m_prev, l_prev = carry
-        start = kb * block_k
-        kblk = k_ref[pl.ds(start, block_k), :]
-        ks = ks_ref[pl.ds(start, block_k), :]  # [bk, 1]
-        s = jax.lax.dot_general(
-            q, kblk.astype(q.dtype), (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        s = s * ks[:, 0][None, :]
-        ki = start + jax.lax.broadcasted_iota(jnp.int32, (g, block_k), 1)
-        s = jnp.where(ki < valid, s, _NEG_INF)
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        vblk = v_ref[pl.ds(start, block_k), :]
-        vs = vs_ref[pl.ds(start, block_k), :]
-        pv = p * vs[:, 0][None, :]
-        acc = acc * alpha + jax.lax.dot_general(
-            pv.astype(q.dtype), vblk.astype(q.dtype),
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return acc, m_new, l_new
@@ -176,7 +145,7 @@ def flash_decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
             num_scalar_prefetch=1, grid=grid,
             in_specs=[qspec, kvspec, kvspec], out_specs=out_spec)
         out = pl.pallas_call(
-            functools.partial(_decode_kernel, **common),
+            functools.partial(_decode_kernel, quant=False, **common),
             grid_spec=grid_spec, out_shape=out_shape,
             interpret=interpret,
         )(lengths, qg, k_cache, v_cache)
@@ -191,7 +160,7 @@ def flash_decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
             in_specs=[qspec, kvspec, kvspec, sspec, sspec],
             out_specs=out_spec)
         out = pl.pallas_call(
-            functools.partial(_decode_kernel_quant, **common),
+            functools.partial(_decode_kernel, quant=True, **common),
             grid_spec=grid_spec, out_shape=out_shape,
             interpret=interpret,
         )(lengths, qg, k_cache, v_cache, k_s[..., None], v_s[..., None])

@@ -80,7 +80,8 @@ class LlmServer:
                  quantize: Optional[str] = None,
                  engine: Optional[str] = None, tp: Optional[int] = None,
                  kv_cache: Optional[str] = None,
-                 prefix_cache: Optional[int] = None):
+                 prefix_cache: Optional[int] = None,
+                 draft_model: Optional[str] = None):
         self.model_name = model
         self.cfg = llama.PRESETS[model]
         self.max_len = min(max_len, self.cfg.max_seq_len)
@@ -106,6 +107,33 @@ class LlmServer:
             prefix_cache = int(os.environ.get('SKYTPU_LLM_PREFIX_CACHE',
                                               '0'))
         prefix_cache = int(prefix_cache)
+        # Speculative decoding (models/speculative.py) rides the
+        # window-batched path — it owns both models' caches per call.
+        # Greedy-only by construction; sampled requests keep the plain
+        # path. Takes effect with --engine off (the continuous engine
+        # otherwise absorbs unseeded traffic first).
+        self.draft_model = (draft_model
+                            or os.environ.get('SKYTPU_LLM_DRAFT') or None)
+        self.spec_k = int(os.environ.get('SKYTPU_LLM_SPEC_K', '4'))
+        if self.spec_k < 1:
+            raise ValueError(f'SKYTPU_LLM_SPEC_K must be >= 1, got '
+                             f'{self.spec_k}')
+        if self.draft_model is not None:
+            if self.draft_model not in llama.PRESETS:
+                raise ValueError(f'Unknown draft model '
+                                 f'{self.draft_model!r}')
+            draft_cfg = llama.PRESETS[self.draft_model]
+            if draft_cfg.vocab_size != self.cfg.vocab_size:
+                raise ValueError(
+                    'draft and target must share a vocabulary '
+                    f'({draft_cfg.vocab_size} vs {self.cfg.vocab_size})')
+            if draft_cfg.max_seq_len < self.max_len:
+                # Otherwise every spec-eligible request would 500 at
+                # generate_speculative's own context check.
+                raise ValueError(
+                    f'draft model {self.draft_model!r} max_seq_len '
+                    f'{draft_cfg.max_seq_len} < server max_len '
+                    f'{self.max_len}')
         # Tensor-parallel serving over the replica's slice: a mesh whose
         # `tensor` axis spans tp chips; weights/KV shard by the training
         # stack's logical rules and every decode step runs SPMD (the way
@@ -113,6 +141,12 @@ class LlmServer:
         # (and quantized) SHARDED — a model that only fits spread over
         # the slice must never transit one chip whole.
         self.tp = tp or int(os.environ.get('SKYTPU_LLM_TP', '1'))
+        if self.tp > 1 and gen_lib._DECODE_KERNEL_ENABLED:
+            # pallas_call carries no sharding rule: under TP, GSPMD
+            # would all-gather the full per-layer caches (or fail) —
+            # defeating the never-transit-one-chip-whole invariant.
+            raise ValueError('SKYTPU_DECODE_KERNEL=pallas is '
+                             'single-device; unset it for --tp > 1')
         self.mesh = None
         key = jax.random.PRNGKey(seed)
         if self.tp > 1:
@@ -144,6 +178,14 @@ class LlmServer:
                 mesh=self.mesh, kv_quantize=self.kv_cache == 'int8',
                 prefix_slots=prefix_cache)
             self.params = self.engine.params
+        self.draft_cfg = None
+        self.draft_params = None
+        self._spec_stats = {'requests': 0, 'verifies': 0,
+                            'proposals': 0, 'accepted': 0}
+        if self.draft_model is not None:
+            self.draft_cfg = llama.PRESETS[self.draft_model]
+            self.draft_params = llama.init_params(
+                jax.random.PRNGKey(seed + 1), self.draft_cfg)
         self._queue: asyncio.Queue = asyncio.Queue()
         self._overflow: List[_Pending] = []  # spilled past MAX_BATCH
         self._worker: Optional[asyncio.Task] = None
@@ -156,10 +198,17 @@ class LlmServer:
                 'quantize': self.quantize, 'tp': self.tp,
                 'kv_cache': self.kv_cache,
                 'max_len': self.max_len,
+                'draft_model': self.draft_model,
                 'batches_served': self.batches_served,
                 'max_batch_seen': self.max_batch_seen}
         if self.engine is not None:
             body['engine'] = self.engine.stats()
+        if self.draft_params is not None:
+            s = dict(self._spec_stats)
+            s['acceptance_rate'] = (
+                round(s['accepted'] / s['proposals'], 4)
+                if s['proposals'] else None)
+            body['speculative'] = s
         return web.json_response(body)
 
     # -- batching worker ---------------------------------------------------
@@ -233,6 +282,35 @@ class LlmServer:
             max_new = max(p.max_new for p in sub)
             temperature = sub[0].temperature
             seed = sub[0].seed
+            lens_host = [len(r) for r in rows]
+            # Speculative path (--draft-model): greedy, uniform-length
+            # batches only (generate_speculative owns both caches and
+            # takes no per-row prompt lengths); everything else keeps
+            # the plain path.
+            use_spec = (
+                self.draft_params is not None and temperature == 0
+                and min(lens_host) == max(lens_host)
+                and max(lens_host) + max_new + self.spec_k + 1
+                <= self.max_len)
+            if use_spec:
+                from skypilot_tpu.models import speculative
+                out_arr, spec = speculative.generate_speculative(
+                    self.params, self.cfg, self.draft_params,
+                    self.draft_cfg, padded, max_new, k=self.spec_k,
+                    max_len=self.max_len,
+                    kv_quantize=self.kv_cache == 'int8')
+                self._spec_stats['requests'] += len(sub)
+                for key_ in ('verifies', 'proposals', 'accepted'):
+                    self._spec_stats[key_] += spec[key_]
+                out = jax.device_get(out_arr)
+                i = 0
+                for p in sub:
+                    n = len(p.rows)
+                    result = [gen_lib.truncate_at_stop(r, p.eos)[0]
+                              for r in out[i:i + n, :p.max_new].tolist()]
+                    self._deliver(p, result)
+                    i += n
+                continue
             key = None
             if temperature > 0:
                 import secrets
@@ -482,11 +560,17 @@ def main() -> None:
                              'prefixes (opt-in, default 0; costs N extra '
                              'max_len cache rows of HBM; also via '
                              'SKYTPU_LLM_PREFIX_CACHE; dense models only)')
+    parser.add_argument('--draft-model', default=None,
+                        help='preset name of a small draft model for '
+                             'speculative decoding on the window path '
+                             '(greedy requests; use with --engine off; '
+                             'also via SKYTPU_LLM_DRAFT)')
     args = parser.parse_args()
     server = LlmServer(args.model, max_len=args.max_len,
                        quantize=args.quantize, engine=args.engine,
                        tp=args.tp, kv_cache=args.kv_cache,
-                       prefix_cache=args.prefix_cache)
+                       prefix_cache=args.prefix_cache,
+                       draft_model=args.draft_model)
     web.run_app(server.make_app(), host=args.host, port=args.port,
                 print=lambda *a: None)
 

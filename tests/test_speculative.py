@@ -86,3 +86,77 @@ def test_speculative_rejects_overlong(pair):
         speculative.generate_speculative(
             target, tcfg, draft, dcfg,
             jnp.asarray([[1] * 30], jnp.int32), 30, k=8, max_len=64)
+
+
+def test_llm_server_draft_model_window_path(pair):
+    """--draft-model on the window path: greedy requests decode
+    speculatively and still return the target's exact greedy stream;
+    /health reports the acceptance counters."""
+    import threading
+
+    import requests as requests_lib
+    from aiohttp import web
+
+    from skypilot_tpu.serve import llm_server as llm_mod
+    from skypilot_tpu.utils import common_utils
+
+    target, tcfg, _, _ = pair
+    server = llm_mod.LlmServer('tiny', max_len=64, engine='off',
+                               draft_model='tiny')
+    server.params = target  # oracle weights
+    port = common_utils.find_free_port(22000)
+    started = threading.Event()
+
+    def run():
+        import asyncio
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(server.make_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, '127.0.0.1', port)
+        loop.run_until_complete(site.start())
+        started.set()
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(10)
+
+    row = [5, 6, 7]
+    want = _target_greedy(target, tcfg, jnp.asarray([row], jnp.int32), 8)
+    r = requests_lib.post(
+        f'http://127.0.0.1:{port}/generate',
+        json={'tokens': [row], 'max_new_tokens': 8}, timeout=180)
+    assert r.status_code == 200, r.text
+    assert r.json()['tokens'][0] == want[0].tolist()
+
+    h = requests_lib.get(f'http://127.0.0.1:{port}/health',
+                         timeout=10).json()
+    assert h['draft_model'] == 'tiny'
+    assert h['speculative']['requests'] >= 1
+    assert h['speculative']['verifies'] >= 1
+
+
+def test_speculative_kv_int8_exact(pair):
+    """int8 KV caches compose: speculative output equals the target's
+    own int8-cache greedy stream (quantization is deterministic per
+    (value, position), so accepted prefixes carry identical codes)."""
+    target, tcfg, draft, dcfg = pair
+    prompt = jnp.asarray([[5, 6, 7]], jnp.int32)
+    want = np.asarray(generate.generate(target, tcfg, prompt, 10,
+                                        max_len=64, kv_quantize=True))
+    got, _ = speculative.generate_speculative(
+        target, tcfg, draft, dcfg, prompt, 10, k=3, max_len=64,
+        kv_quantize=True)
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_llm_server_rejects_short_context_draft(monkeypatch):
+    """A draft whose trained context is shorter than the server max_len
+    must be rejected at startup, not 500 every spec-eligible request."""
+    from skypilot_tpu.serve import llm_server as llm_mod
+
+    short = dataclasses.replace(llama.TINY, max_seq_len=128)
+    monkeypatch.setitem(llama.PRESETS, 'tiny-short', short)
+    with pytest.raises(ValueError, match='max_seq_len'):
+        llm_mod.LlmServer('tiny', max_len=512, engine='off',
+                         draft_model='tiny-short')
