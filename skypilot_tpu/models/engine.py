@@ -206,7 +206,8 @@ class ContinuousEngine:
                  prefill_batch: Optional[int] = None, seed: int = 0,
                  mesh=None, rules=None,
                  kv_quantize: Optional[bool] = None,
-                 prefix_slots: Optional[int] = None):
+                 prefix_slots: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None):
         self.params = params
         self.cfg = cfg
         self.slots = slots or int(os.environ.get('SKYTPU_LLM_SLOTS', '16'))
@@ -219,6 +220,21 @@ class ContinuousEngine:
         if kv_quantize is None:
             kv_quantize = os.environ.get('SKYTPU_LLM_KV_CACHE') == 'int8'
         self.kv_quantize = bool(kv_quantize)
+        # Chunked prefill (opt-in): prompts longer than this advance in
+        # prefill_chunk-token pieces interleaved with decode chunks, so
+        # long admissions don't stall every active slot's stream. Each
+        # in-flight long prefill holds one scratch max_len cache row
+        # (capped at 2 concurrent).
+        if prefill_chunk is None:
+            prefill_chunk = int(os.environ.get('SKYTPU_LLM_PREFILL_CHUNK',
+                                               '0'))
+        self.prefill_chunk = max(int(prefill_chunk), 0)
+        if cfg.num_experts > 0:
+            # Expert capacity is per forward CALL (token count of the
+            # call), so a chunked prefill routes/drops differently than
+            # the monolithic prefill the greedy-exactness oracle uses —
+            # same reason the prefix pool is disabled for MoE below.
+            self.prefill_chunk = 0
         # Prefix caching (vLLM/JetStream-style): popular prompt prefixes
         # keep their KV rows in a small device pool; a hit prefills only
         # the suffix. Prefixes are matched at power-of-two lengths
@@ -272,6 +288,8 @@ class ContinuousEngine:
         self._pending: collections.deque = collections.deque()
         self._unfetched: List[tuple] = []  # [(reqs, firsts-device-array)]
         self._admitting: List[_Request] = []  # mid-prefill group
+        # Incremental long prefills: [req, scratch-cache-or-None, consumed]
+        self._prefilling: List[list] = []
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
@@ -280,6 +298,7 @@ class ContinuousEngine:
         # Stats (read by /health).
         self.prefills = 0
         self.prefill_groups = 0
+        self.prefill_chunks = 0
         self.prefix_hits = 0
         self.prefix_hit_tokens = 0
         self.prefix_stores = 0
@@ -341,6 +360,9 @@ class ContinuousEngine:
                 'queued': queued, 'prefills': self.prefills,
                 'prefill_groups': self.prefill_groups,
                 'prefill_batch': self.prefill_batch,
+                'prefill_chunk': self.prefill_chunk,
+                'prefill_chunks': self.prefill_chunks,
+                'prefilling': len(self._prefilling),
                 'chunks_run': self.chunks_run,
                 'chunk_steps': self.chunk_steps,
                 'tokens_emitted': self.tokens_emitted,
@@ -357,9 +379,14 @@ class ContinuousEngine:
     def _loop(self) -> None:
         while not self._stop:
             try:
+                # Prefill advance BEFORE admission: a parked finished
+                # prefill must win a freed slot over younger shorts.
+                self._advance_prefill()
                 self._admit()
                 if not any(r is not None for r in self._slot_req):
                     self._drain_firsts()  # e.g. all-max_new==1 traffic
+                    if self._prefilling:
+                        continue  # keep chunking the long prompt
                     self._wake.wait(0.05)
                     self._wake.clear()
                     continue
@@ -380,11 +407,12 @@ class ContinuousEngine:
             doomed = list(self._pending) + [
                 r for r in self._slot_req if r is not None] + [
                 r for reqs, _ in self._unfetched for r in reqs] + \
-                list(self._admitting)
+                list(self._admitting) + [p[0] for p in self._prefilling]
             self._pending.clear()
             self._slot_req = [None] * self.slots
             self._unfetched = []
             self._admitting = []
+            self._prefilling = []
         for req in doomed:  # dupes are safe: first set_exception wins
             if not req.future.done():
                 req.future.set_exception(exc)
@@ -443,9 +471,39 @@ class ContinuousEngine:
         bucket."""
         while True:
             with self._lock:
+                # Long prompts (> prefill_chunk) leave the queue for the
+                # INCREMENTAL path (_advance_prefill): one bounded chunk
+                # per engine iteration, interleaved with decode, so a
+                # 4k-token prompt never stalls every active slot for a
+                # whole monolithic prefill. FIFO order is preserved: a
+                # long head blocks later shorts only while the in-flight
+                # prefill capacity is exhausted.
+                while (self.prefill_chunk and self._pending
+                       and len(self._prefilling) < 2
+                       and len(self._pending[0].row) > self.prefill_chunk):
+                    self._prefilling.append(
+                        [self._pending.popleft(), None, 0])
+                if (self.prefill_chunk and self._pending
+                        and len(self._pending[0].row) > self.prefill_chunk):
+                    return  # long head waiting on prefill capacity
                 free = [i for i, r in enumerate(self._slot_req)
                         if r is None]
-                n = min(len(free), len(self._pending), self.prefill_batch)
+                # Slots owed to parked finished prefills are reserved —
+                # without this, a sustained short-prompt stream would
+                # starve the long request forever (it holds a scratch
+                # cache row and blocks further long admissions while
+                # parked).
+                parked = sum(1 for e in self._prefilling if len(e) >= 5)
+                n = min(max(len(free) - parked, 0), len(self._pending),
+                        self.prefill_batch)
+                if self.prefill_chunk:
+                    # Only CONSECUTIVE short requests join a group.
+                    run = 0
+                    for p in self._pending:
+                        if len(p.row) > self.prefill_chunk or run >= n:
+                            break
+                        run += 1
+                    n = run
                 if n == 0:
                     return
                 g = 1
@@ -504,6 +562,96 @@ class ContinuousEngine:
                 p)
             self._prefix_index[key] = slot
             self.prefix_stores += 1
+
+    def _advance_prefill(self) -> None:
+        """Advance the oldest in-flight long prefill by ONE chunk (the
+        per-iteration budget that bounds how long active slots wait
+        between decode chunks). On the final chunk: sample the first
+        token, insert into a free slot (or park until one frees)."""
+        if not self._prefilling:
+            return
+        entry = self._prefilling[0]
+        req, cache1, consumed = entry[0], entry[1], entry[2]
+        n = len(req.row)
+        if consumed >= n:
+            self._finish_long_prefill(entry)
+            return
+        if cache1 is None:
+            # First chunk: seed from the prefix pool when the prompt's
+            # head is cached — long popular prompts (system preambles)
+            # are where prefix reuse pays most.
+            p_hit = 0
+            if self._prefix_pool is not None:
+                p_hit, pool_row = self._match_prefix(req.row)
+                if p_hit:
+                    cache1 = _jit_gather_prefix(
+                        self._prefix_pool,
+                        jnp.asarray([pool_row], jnp.int32),
+                        jnp.asarray([p_hit], jnp.int32), self.max_len)
+                    self.prefix_hits += 1
+                    self.prefix_hit_tokens += p_hit
+            if cache1 is None:
+                cache1 = gen_lib.init_cache(self.cfg, 1, self.max_len,
+                                            quantize=self.kv_quantize)
+            entry[1], entry[2] = cache1, p_hit
+            consumed = p_hit
+        c = self.prefill_chunk
+        # Pad width may not overhang max_len: dynamic_update_slice CLAMPS
+        # out-of-range starts, and a clamped padded tail would smear
+        # junk over REAL prefix KV. Room always suffices: the prompt is
+        # < max_len (submit validates row + max_new <= max_len).
+        w = min(c, self.max_len - consumed)
+        chunk = req.row[consumed:consumed + w]
+        padded = np.zeros((1, w), np.int32)
+        padded[0, :len(chunk)] = chunk
+        logits, cache1 = gen_lib._jit_prefill(  # noqa: SLF001 — same pkg
+            self.params, jnp.asarray(padded), cache1, self.cfg,
+            jnp.asarray([len(chunk)], jnp.int32))
+        entry[1] = cache1
+        entry[2] = consumed + len(chunk)
+        self.prefill_chunks += 1
+        if entry[2] >= n:
+            if self._prefix_pool is not None:
+                # Store this prompt's bucket prefix on its second
+                # sighting, like the grouped path (cache1 row 0 holds
+                # the full prompt's KV).
+                self._maybe_store_prefixes([req.row], [0], cache1)
+            # Sample the first token ONCE off the final chunk's logits;
+            # the entry may then park for a free slot.
+            first = _jit_sample(
+                logits, jnp.asarray([req.temperature], jnp.float32),
+                self._next_key(),
+                *_filters_or_none(np.asarray([req.top_k], np.int32),
+                                  np.asarray([req.top_p], np.float32)))
+            entry.extend([first, int(jax.device_get(first)[0])])
+            self._finish_long_prefill(entry)
+
+    def _finish_long_prefill(self, entry) -> None:
+        req, cache1, _, first, first_host = entry
+        done = (req.max_new == 1
+                or gen_lib.truncate_at_stop([first_host], req.eos)[1])
+        slot = None
+        with self._lock:
+            if not done:
+                free = [i for i, r in enumerate(self._slot_req)
+                        if r is None]
+                if not free:
+                    return  # park; retried next iteration
+                slot = free[0]
+                self._slot_req[slot] = req
+        self._prefilling.pop(0)
+        self.prefills += 1
+        req.tokens.append(first_host)
+        self.tokens_emitted += 1
+        if req.on_tokens is not None:
+            self._fire_callbacks([(req, [first_host])])
+        if done:
+            if not req.future.done():
+                req.future.set_result(req.tokens)
+            return
+        self._cache, self._last = _jit_insert(
+            self._cache, self._last, cache1, first,
+            jnp.asarray([slot], jnp.int32))
 
     def _prefill_group(self, reqs: List[_Request],
                        slots: List[int]) -> None:

@@ -98,22 +98,27 @@ class LlmServer:
         if self.quantize and self.quantize != 'int8':
             raise ValueError(f'Unknown quantization {self.quantize!r}; '
                              "only 'int8' (weight-only) is supported")
+        # Speculative decoding (models/speculative.py) rides the
+        # window-batched path — it owns both models' caches per call.
+        # Greedy-only by construction; sampled requests keep the plain
+        # path.
+        self.draft_model = (draft_model
+                            or os.environ.get('SKYTPU_LLM_DRAFT') or None)
         engine = engine or os.environ.get('SKYTPU_LLM_ENGINE',
                                           'continuous')
         if engine not in ('continuous', 'off'):
             raise ValueError(f"Unknown engine {engine!r}; 'continuous' "
                              "or 'off'")
+        if self.draft_model is not None and engine != 'off':
+            # The continuous engine absorbs unseeded traffic first, so
+            # the speculative window path would never run: the draft
+            # weights would sit inert in HBM with frozen counters.
+            raise ValueError('--draft-model requires --engine off (the '
+                             'speculative path rides window batching)')
         if prefix_cache is None:
             prefix_cache = int(os.environ.get('SKYTPU_LLM_PREFIX_CACHE',
                                               '0'))
         prefix_cache = int(prefix_cache)
-        # Speculative decoding (models/speculative.py) rides the
-        # window-batched path — it owns both models' caches per call.
-        # Greedy-only by construction; sampled requests keep the plain
-        # path. Takes effect with --engine off (the continuous engine
-        # otherwise absorbs unseeded traffic first).
-        self.draft_model = (draft_model
-                            or os.environ.get('SKYTPU_LLM_DRAFT') or None)
         self.spec_k = int(os.environ.get('SKYTPU_LLM_SPEC_K', '4'))
         if self.spec_k < 1:
             raise ValueError(f'SKYTPU_LLM_SPEC_K must be >= 1, got '

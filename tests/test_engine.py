@@ -796,3 +796,91 @@ def test_llm_server_eos_token(tiny):
         timeout=30)
     assert r3.status_code == 400
     server.engine.stop()
+
+
+def test_engine_chunked_prefill_exact(tiny):
+    """A prompt longer than prefill_chunk advances in chunks and still
+    produces EXACTLY the solo greedy generation (positions/cache writes
+    are identical to a monolithic prefill)."""
+    cfg, params = tiny
+    eng = _mk(params, cfg, prefill_chunk=8)
+    try:
+        long_row = list(range(1, 31))  # 30 tokens -> 4 chunks of <=8
+        got = eng.submit(long_row, 6).result(timeout=120)
+        assert got == _solo(params, cfg, long_row, 6)
+        st = eng.stats()
+        assert st['prefill_chunks'] >= 4
+        assert st['prefilling'] == 0 and st['active_slots'] == 0
+        # Short prompts still take the grouped path.
+        short = [5, 6, 7]
+        assert eng.submit(short, 4).result(timeout=120) == \
+            _solo(params, cfg, short, 4)
+    finally:
+        eng.stop()
+
+
+def test_engine_chunked_prefill_interleaves_with_decode(tiny):
+    """Active slots keep decoding while a long prompt chunks in: the
+    short request admitted first must finish well before the long one,
+    and both stay exact."""
+    cfg, params = tiny
+    eng = _mk(params, cfg, prefill_chunk=4, chunk_steps=2)
+    try:
+        short = [9, 8, 7]
+        f_short = eng.submit(short, 12)
+        long_row = list(range(1, 41))  # 40 tokens -> 10 chunks
+        f_long = eng.submit(long_row, 4)
+        assert f_short.result(timeout=120) == _solo(params, cfg, short, 12)
+        assert f_long.result(timeout=120) == _solo(params, cfg,
+                                                   long_row, 4)
+        assert eng.stats()['prefill_chunks'] >= 10
+    finally:
+        eng.stop()
+
+
+def test_engine_chunked_prefill_parks_until_slot_frees(tiny):
+    """With ONE slot busy, a finished long prefill parks and lands once
+    the slot frees — no deadlock, exact output."""
+    cfg, params = tiny
+    eng = _mk(params, cfg, slots=1, prefill_chunk=4, chunk_steps=2)
+    try:
+        holder = [3, 4, 5]
+        f1 = eng.submit(holder, 10)
+        long_row = list(range(10, 30))
+        f2 = eng.submit(long_row, 3)
+        assert f1.result(timeout=120) == _solo(params, cfg, holder, 10)
+        assert f2.result(timeout=120) == _solo(params, cfg, long_row, 3)
+    finally:
+        eng.stop()
+
+
+def test_engine_chunked_prefill_disabled_for_moe(tiny_moe):
+    """Per-call expert capacity makes chunked prefill route differently
+    than the monolithic oracle — MoE configs must refuse it."""
+    cfg, params = tiny_moe
+    eng = engine_lib.ContinuousEngine(params, cfg, slots=2, max_len=32,
+                                      prefill_chunk=8)
+    assert eng.prefill_chunk == 0
+
+
+def test_engine_chunked_prefill_with_prefix_cache(tiny):
+    """A long prompt whose head is pooled seeds its incremental prefill
+    from the pool (fewer chunks) and stays exact; completion stores the
+    prompt's own bucket prefix for future hits."""
+    cfg, params = tiny
+    eng = _mk(params, cfg, prefill_chunk=8, prefix_slots=4)
+    try:
+        long_row = list(range(1, 41))  # 40 tokens
+        want = _solo(params, cfg, long_row, 4)
+        assert eng.submit(long_row, 4).result(timeout=120) == want
+        assert eng.submit(long_row, 4).result(timeout=120) == want
+        # Second sighting stored the 32-token bucket prefix...
+        assert eng.stats()['prefix_cache']['stores'] >= 1
+        chunks_before = eng.stats()['prefill_chunks']
+        assert eng.submit(long_row, 4).result(timeout=120) == want
+        # ...so the third prefill seeded from it: 40-32=8 tokens = 1
+        # chunk instead of 5.
+        assert eng.stats()['prefill_chunks'] - chunks_before == 1
+        assert eng.stats()['prefix_cache']['hits'] >= 1
+    finally:
+        eng.stop()
