@@ -738,15 +738,30 @@ async function metricsView(){
   const m = await J('dashboard/api/metrics/history');
   const s = m.samples;
   if(!s.length) return '<p>(no samples yet)</p>';
+  // Delta-rate over consecutive samples; `delta(prev, cur)` returns
+  // the (already non-negative) count advanced between them.
+  const rateSeries = (delta) => {
+    const out = [];
+    for(let i=1;i<s.length;i++){
+      const dt = Math.max(s[i].ts - s[i-1].ts, 1e-9);
+      out.push(Math.max(0, delta(s[i-1], s[i]))/dt);
+    }
+    return out;
+  };
+  const sumv = o => Object.values(o||{}).reduce((x,y)=>x+y,0);
   // Request RATE: per-op cumulative counter deltas between samples.
-  const rate = [];
-  for(let i=1;i<s.length;i++){
-    const a=s[i-1].requests_total_by_op||{}, b=s[i].requests_total_by_op||{};
-    const da = Object.values(a).reduce((x,y)=>x+y,0);
-    const db = Object.values(b).reduce((x,y)=>x+y,0);
-    const dt = Math.max(s[i].ts - s[i-1].ts, 1e-9);
-    rate.push(Math.max(0, (db-da)/dt));
-  }
+  const rate = rateSeries((a,b)=>
+      sumv(b.requests_total_by_op) - sumv(a.requests_total_by_op));
+  // Serving token RATE: per-REPLICA clamped deltas summed, so one
+  // replica's restart (counter reset) or a scale-down zeroes only its
+  // own contribution instead of cratering the fleet rate; a replica's
+  // first appearance contributes 0 (no baseline).
+  const tokRate = rateSeries((a,b)=>{
+    const pa=a.serve_tokens_by_replica||{}, pb=b.serve_tokens_by_replica||{};
+    let d=0;
+    for(const k in pb) d += Math.max(0, pb[k] - (pa[k] ?? pb[k]));
+    return d;
+  });
   const span = s.length > 1 ?
       ((s[s.length-1].ts - s[0].ts)/60).toFixed(1) + ' min' : '';
   return `<h2>Fleet metrics <span id="ts2" style="color:#888;font-size:12px">
@@ -760,6 +775,9 @@ async function metricsView(){
     `<h2>Serve replicas</h2>` +
       lineChart({ready: s.map(x=>x.replicas_ready||0),
                  total: s.map(x=>x.replicas_total||0)}) +
+    `<h2>Serving throughput (tok/s)</h2>` +
+      lineChart({'tok/s': tokRate.map(v=>Math.round(v*10)/10)},
+                {keepZero:true}) +
     `<h2>API requests by status</h2>` +
       lineChart(familySeries(s, 'requests')) +
     `<h2>API request rate (req/s)</h2>` +

@@ -47,12 +47,24 @@ def sample_once(record: bool = True) -> Dict[str, Any]:
     services = [s for s in serve_state.list_services() if s]
     replicas_total = 0
     replicas_ready = 0
+    # PER-REPLICA cumulative engine token counters (probe-recorded
+    # health). Kept per replica — not pre-summed — so the dashboard can
+    # rate each counter independently and a single replica's restart
+    # (counter reset) or scale-down zeroes only ITS contribution
+    # instead of cratering the whole fleet's delta (the same reason
+    # requests_total_by_op keeps per-op counters).
+    serve_tokens_by_replica: Dict[str, int] = {}
     for svc in services:
         for rep in serve_state.list_replicas(svc['name']):
             replicas_total += 1
             status = rep['status']
             if getattr(status, 'value', status) == 'READY':
                 replicas_ready += 1
+            health = serve_state.parse_health(rep.get('health')) or {}
+            tok = (health.get('engine') or {}).get('tokens_emitted')
+            if isinstance(tok, (int, float)):
+                serve_tokens_by_replica[
+                    f"{svc['name']}/{rep['replica_id']}"] = int(tok)
 
     # Cumulative per-op request counters (client derives rates from
     # deltas between samples).
@@ -75,6 +87,8 @@ def sample_once(record: bool = True) -> Dict[str, Any]:
         'requests': requests_db.status_counts(),
         'replicas_total': replicas_total,
         'replicas_ready': replicas_ready,
+        'serve_tokens_emitted': sum(serve_tokens_by_replica.values()),
+        'serve_tokens_by_replica': serve_tokens_by_replica,
         'requests_total_by_op': ops,
     }
     if record:

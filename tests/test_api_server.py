@@ -475,8 +475,19 @@ def test_dashboard_metrics_infra_config_pages(server):
     # Every gauge family from server/metrics.py appears in the sample.
     for family in ('clusters', 'managed_jobs', 'services', 'requests',
                    'replicas_ready', 'replicas_total',
-                   'requests_total_by_op'):
+                   'serve_tokens_emitted', 'requests_total_by_op'):
         assert family in last, last
+    # Replica engine counters (probe-recorded health) roll up into the
+    # fleet serving-throughput series.
+    from skypilot_tpu.serve import serve_state
+    serve_state.add_service('tok-svc', spec={}, task_config={})
+    serve_state.upsert_replica(
+        'tok-svc', 1, serve_state.ReplicaStatus.READY,
+        health='{"engine": {"tokens_emitted": 1234}}')
+    fresh = requests_lib.get(f'{server}/dashboard/api/metrics/history',
+                             timeout=10).json()['samples'][-1]
+    assert fresh['serve_tokens_emitted'] >= 1234
+    assert fresh['serve_tokens_by_replica'].get('tok-svc/1') == 1234
     # A launch shows up in the sampled cluster counts.
     rid = sdk.launch(Task('mjob', run='echo hi'), cluster_name='mcl',
                      detach_run=False)
