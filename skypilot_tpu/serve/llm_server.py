@@ -195,10 +195,18 @@ class LlmServer:
         self._overflow: List[_Pending] = []  # spilled past MAX_BATCH
         self._worker: Optional[asyncio.Task] = None
         self.batches_served = 0
+        self.draining = False
+        self._inflight = 0
         self.max_batch_seen = 0
 
     async def health(self, request: web.Request) -> web.Response:
         del request
+        if self.draining:
+            # Readiness probes see 503: the LB stops routing here while
+            # in-flight requests finish (graceful drain, see drain()).
+            return web.json_response(
+                {'status': 'draining', 'model': self.model_name},
+                status=503)
         body = {'status': 'ok', 'model': self.model_name,
                 'quantize': self.quantize, 'tp': self.tp,
                 'kv_cache': self.kv_cache,
@@ -365,6 +373,19 @@ class LlmServer:
     # -- handlers ----------------------------------------------------------
 
     async def generate(self, request: web.Request) -> web.Response:
+        # Draining still ACCEPTS work: the LB keeps routing here until
+        # the controller's next probe cycle sees the 503 readiness, and
+        # refusing during that lag would drop requests the LB already
+        # committed — the exact loss drain exists to prevent. Admission
+        # ends naturally once the LB's ready set refreshes.
+        self._inflight += 1
+        try:
+            return await self._generate_inner(request)
+        finally:
+            self._inflight -= 1
+
+    async def _generate_inner(self,
+                              request: web.Request) -> web.Response:
         body = await request.json()
         tokens = body.get('tokens')
         if not tokens:
@@ -576,8 +597,49 @@ def main() -> None:
                        tp=args.tp, kv_cache=args.kv_cache,
                        prefix_cache=args.prefix_cache,
                        draft_model=args.draft_model)
-    web.run_app(server.make_app(), host=args.host, port=args.port,
-                print=lambda *a: None)
+    app = server.make_app()
+
+    async def _install_drain(app_):
+        # GRACEFUL DRAIN (rolling updates / scale-down): on SIGTERM the
+        # replica flips to draining — /health returns 503 so the LB
+        # stops routing here, new /generate requests are refused — and
+        # exits once in-flight requests finish (bounded by
+        # SKYTPU_LLM_DRAIN_S). A raw kill mid-generation would drop
+        # requests the LB already routed.
+        import signal
+
+        loop = asyncio.get_event_loop()
+
+        def _graceful(*_):
+            if server.draining:
+                # Second signal escalates: exit now (conventional
+                # Ctrl+C-twice semantics; kill -9 would skip even the
+                # engine stop).
+                if server.engine is not None:
+                    server.engine.stop()
+                raise web.GracefulExit()
+            server.draining = True
+
+            async def _finish():
+                deadline = loop.time() + float(
+                    os.environ.get('SKYTPU_LLM_DRAIN_S', '30'))
+                while server._inflight > 0 and loop.time() < deadline:
+                    await asyncio.sleep(0.2)
+                if server.engine is not None:
+                    server.engine.stop()
+
+                def _exit():
+                    raise web.GracefulExit()
+                loop.call_soon(_exit)
+
+            loop.create_task(_finish())
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, _graceful)
+
+    app.on_startup.append(_install_drain)
+    web.run_app(app, host=args.host, port=args.port,
+                handle_signals=False, print=lambda *a: None)
 
 
 if __name__ == '__main__':

@@ -154,29 +154,37 @@ class ReplicaManager:
     # -- probing -----------------------------------------------------------
 
     def _probe(self, endpoint: str):
-        """(ok, health_json_text_or_None): besides readiness, the probe
-        body is kept when it is a JSON object — the in-framework LLM
-        replica reports live engine stats (tok emitted, slots, prefix
-        hits, kv/quantize modes) on /health, and recording them here
-        gives `serve status`/the dashboard per-replica observability
-        with zero extra requests."""
+        """(ok, health_json_text_or_None, draining): besides readiness,
+        the probe body is kept when it is a JSON object — the
+        in-framework LLM replica reports live engine stats (tok emitted,
+        slots, prefix hits, kv/quantize modes) on /health, and recording
+        them here gives `serve status`/the dashboard per-replica
+        observability with zero extra requests. ``draining`` marks a
+        503 whose body declares a graceful drain (SIGTERM received,
+        finishing in-flight work): NOT ready, but NOT dead — tearing it
+        down would kill the very requests the drain protects."""
         probe = self.spec.readiness_probe
         try:
             r = requests_lib.get(f'http://{endpoint}{probe.path}',
                                  timeout=probe.timeout_seconds)
         except requests_lib.RequestException:
-            return False, None
+            return False, None, False
         health = None
+        draining = False
+        try:
+            body_json = r.json() if r.text else None
+        except ValueError:
+            body_json = None
         if r.status_code < 500:
-            try:
-                body = r.text
-                # Whole-or-nothing: truncating JSON mid-object would
-                # store text neither consumer can parse.
-                if len(body) <= 16384 and isinstance(r.json(), dict):
-                    health = body
-            except ValueError:
-                pass
-        return r.status_code < 500, health
+            body = r.text
+            # Whole-or-nothing: truncating JSON mid-object would store
+            # text neither consumer can parse.
+            if len(body) <= 16384 and isinstance(body_json, dict):
+                health = body
+        elif isinstance(body_json, dict) and \
+                body_json.get('status') == 'draining':
+            draining = True
+        return r.status_code < 500, health, draining
 
     def probe_all(self) -> List[str]:
         """Probe every live replica; update statuses; replace dead READY
@@ -192,13 +200,22 @@ class ReplicaManager:
                 continue
             if endpoint is None:
                 continue
-            ok, health = self._probe(endpoint)
+            ok, health, draining = self._probe(endpoint)
             if ok:
                 self._ready_since.setdefault(rid, now)
                 serve_state.upsert_replica(self.service_name, rid,
                                            serve_state.ReplicaStatus.READY,
                                            health=health)
                 ready.append(endpoint)
+            elif draining:
+                # Graceful drain: pull it from the LB set but do NOT
+                # tear it down (that would kill its in-flight requests)
+                # and do NOT count a preemption. Once the process exits
+                # the probe fails outright and the normal dark-replica
+                # replacement path below takes over.
+                serve_state.upsert_replica(
+                    self.service_name, rid,
+                    serve_state.ReplicaStatus.NOT_READY, health='')
             else:
                 age = now - rep['created_at']
                 grace = self.spec.readiness_probe.initial_delay_seconds

@@ -577,3 +577,42 @@ def test_serve_logs_cli(tmp_path):
         assert r.exit_code != 0 and 'not found' in r.output
     finally:
         serve.down('svclog')
+
+
+def test_probe_classifies_draining_replica():
+    """A 503 whose body says 'draining' is NOT-ready-but-alive: no
+    teardown, no preemption report — unlike a dead 503."""
+    import http.server
+    import threading
+    import types
+
+    from skypilot_tpu.serve import replica_managers
+    from skypilot_tpu.utils import common_utils
+
+    port = common_utils.find_free_port(22200)
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = (b'{"status": "draining"}' if 'drain' in self.path
+                    else b'{"boom": 1}')
+            self.send_response(503)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(('127.0.0.1', port), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        mgr = replica_managers.ReplicaManager.__new__(
+            replica_managers.ReplicaManager)
+        mgr.spec = types.SimpleNamespace(readiness_probe=types.
+            SimpleNamespace(path='/health-drain', timeout_seconds=5))
+        ok, health, draining = mgr._probe(f'127.0.0.1:{port}')
+        assert (ok, health, draining) == (False, None, True)
+        mgr.spec.readiness_probe.path = '/health'
+        ok, health, draining = mgr._probe(f'127.0.0.1:{port}')
+        assert (ok, health, draining) == (False, None, False)
+    finally:
+        srv.shutdown()
