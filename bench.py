@@ -31,6 +31,15 @@ import os
 import sys
 import time
 
+# The driver captures ONE line; r4's artifact embedded multi-KB probe
+# diagnostics and overflowed the capture window (`parsed: null` — the
+# round recorded NO metric). The artifact is the product surface:
+# everything bulky goes to a sidecar file under bench_runs/ and the
+# final line carries only the metric + compact detail + a pointer.
+MAX_ARTIFACT_BYTES = 4096
+SIDECAR_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           'bench_runs')
+
 
 def _measure_step_throughput(cfg, warmup: int, iters: int):
     import jax
@@ -287,9 +296,10 @@ def _sweep_best_config(candidates, warmup: int = 1, iters: int = 3):
 def _bench_tpu() -> dict:
     # Pinned-TPU runtimes ignore the env var; sync it into jax.config so
     # JAX_PLATFORMS=cpu smoke runs stay off the chip.
-    from skypilot_tpu.utils.jax_env import apply_jax_platform_env
+    from skypilot_tpu.utils.jax_env import (apply_jax_platform_env,
+                                            wants_real_chip)
     apply_jax_platform_env()
-    want_tpu = os.environ.get('JAX_PLATFORMS', 'axon') not in ('cpu',)
+    want_tpu = wants_real_chip()
     if want_tpu and not _tpu_reachable():
         print('[bench] TPU backend unreachable; falling back to CPU',
               file=sys.stderr)
@@ -298,6 +308,12 @@ def _bench_tpu() -> dict:
         jax.config.update('jax_platforms', 'cpu')
 
     import jax
+
+    # Backend init happens HERE, signal-guarded: a polite shutdown
+    # arriving mid-PJRT-construction is deferred until the client
+    # exists (the r4 relay-wedge lesson as code; utils/tpu_client_guard).
+    from skypilot_tpu.utils.tpu_client_guard import init_backend_guarded
+    init_backend_guarded()
 
     from skypilot_tpu.models import llama
     from skypilot_tpu.train import TrainerConfig
@@ -348,9 +364,12 @@ def _bench_tpu() -> dict:
     n_chips = jax.device_count()
     return {
         'metric': 'llama_train_model_tflops_per_chip',
-        'value': round(tf4k, 3),
+        # 6 digits: a CPU-fallback run's tiny-model throughput must not
+        # round to a metric-less 0.0 (r4 lesson: ALWAYS record a number).
+        'value': round(tf4k, 3 if tf4k >= 1 else 6),
         'unit': 'TFLOP/s/chip (6ND)',
-        'vs_baseline': round(tf4k / baseline_tflops_per_chip, 3),
+        'vs_baseline': round(tf4k / baseline_tflops_per_chip,
+                             3 if tf4k >= baseline_tflops_per_chip else 6),
         'detail': {
             'backend': backend,
             'chips': n_chips,
@@ -373,19 +392,88 @@ def _bench_tpu() -> dict:
             'decode_tokens_per_sec': decode_tps,
             'decode_variants': decode_variants,
             'cpu_fallback': not on_tpu,
-            # Present only when the TPU probe failed: hang phase + child
-            # stack + process table + relay sockets, so the artifact
-            # itself proves whether the wedge is ours (leaked daemon) or
-            # relay-side (clean table, dead endpoint). See
-            # skypilot_tpu/utils/tpu_doctor.py and `stpu doctor`.
-            'probe_diagnostics': _PROBE_DIAGNOSTICS or None,
         },
     }
 
 
+def _diag_summary(diag: dict) -> str:
+    """One line that lets the artifact adjudicate the failure by itself:
+    hang phase + whose fault the process-table/relay evidence says it
+    is. The full picture lives in the sidecar file."""
+    attempts = diag.get('failed_attempts') or []
+    if 'final_diagnosis' not in diag:
+        # Success-after-retries: only transient attempt records exist —
+        # no surrender evidence, so no fault claim belongs in the line.
+        return (f'{len(attempts)} transient probe attempt(s) failed '
+                'before a successful init; details in sidecar')
+    clean = diag.get('process_table_clean')
+    fault = ('terminal-side (clean process table)' if clean
+             else 'possibly local (framework processes alive)')
+    return (f"{len(attempts)} probe attempt(s) failed; "
+            f"final: {diag.get('final_diagnosis', 'unknown')}; "
+            f"{fault}")[:300]
+
+
+def finalize_result(result: dict, diagnostics: dict | None = None,
+                    out_dir: str = SIDECAR_DIR) -> str:
+    """Render the ONE driver-parseable artifact line (< 4 KB guaranteed).
+
+    Bulky evidence — probe diagnostics, and if needed the sweep /
+    per-variant tables — is written to a timestamped sidecar JSON under
+    ``out_dir`` with only its path + a one-line summary inlined. The
+    returned line is verified to round-trip through ``json.loads``
+    before being handed to the caller (r4 verdict Next #1a).
+    """
+    detail = result.setdefault('detail', {})
+    sidecar: dict = {}
+    sidecar_path = os.path.join(
+        out_dir, f'diag_{int(time.time())}_{os.getpid()}.json')
+    if diagnostics:
+        sidecar['probe_diagnostics'] = diagnostics
+        detail['probe_diagnostics'] = {
+            'path': os.path.relpath(
+                sidecar_path,
+                os.path.dirname(os.path.abspath(out_dir))),
+            'summary': _diag_summary(diagnostics),
+        }
+
+    def render() -> str:
+        return json.dumps(result, separators=(',', ':'))
+
+    line = render()
+    # Progressive offload: if the line is still too big, move the
+    # largest optional detail blocks to the sidecar, biggest first.
+    for key in ('sweep', 'decode_variants', 'probe_diagnostics'):
+        if len(line.encode()) <= MAX_ARTIFACT_BYTES:
+            break
+        if key in detail and detail[key] is not None:
+            if key not in sidecar:  # never clobber already-offloaded
+                sidecar[key] = detail[key]  # evidence with its pointer
+            detail[key] = f'see sidecar: {os.path.basename(sidecar_path)}'
+            line = render()
+    if len(line.encode()) > MAX_ARTIFACT_BYTES:
+        # Last resort — the metric line must survive at any cost.
+        result['detail'] = {
+            'truncated': True,
+            'sidecar': os.path.basename(sidecar_path),
+        }
+        sidecar['detail'] = detail
+        line = render()
+    if sidecar:
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            with open(sidecar_path, 'w', encoding='utf-8') as f:
+                json.dump(sidecar, f, indent=2, default=str)
+        except OSError as exc:  # sidecar is evidence, not the product
+            print(f'[bench] sidecar write failed: {exc}', file=sys.stderr)
+    json.loads(line)  # self-check: the artifact MUST parse
+    assert len(line.encode()) <= MAX_ARTIFACT_BYTES, len(line)
+    return line
+
+
 def main() -> None:
     result = _bench_tpu()
-    print(json.dumps(result))
+    print(finalize_result(result, _PROBE_DIAGNOSTICS or None))
 
 
 if __name__ == '__main__':

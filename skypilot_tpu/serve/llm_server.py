@@ -592,6 +592,13 @@ def main() -> None:
                              '(greedy requests; use with --engine off; '
                              'also via SKYTPU_LLM_DRAFT)')
     args = parser.parse_args()
+    # Backend init under the shutdown-signal guard (AFTER argparse so
+    # --help/usage never touches the chip): a drain/stop landing
+    # mid-PJRT-construction is deferred until the client exists —
+    # killing a client mid-init wedges the single-claimant relay (r4
+    # incident, bench_runs/README.md).
+    from skypilot_tpu.utils.tpu_client_guard import init_backend_guarded
+    init_backend_guarded()
     server = LlmServer(args.model, max_len=args.max_len,
                        quantize=args.quantize, engine=args.engine,
                        tp=args.tp, kv_cache=args.kv_cache,

@@ -65,6 +65,10 @@ def main() -> None:
 
     from skypilot_tpu.utils.jax_env import apply_jax_platform_env
     apply_jax_platform_env()
+    # Signal-guarded backend init (see utils/tpu_client_guard: a
+    # preemption/cancel signal mid-PJRT-construction wedges the relay).
+    from skypilot_tpu.utils.tpu_client_guard import init_backend_guarded
+    init_backend_guarded()
 
     import os
 

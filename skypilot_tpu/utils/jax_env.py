@@ -16,3 +16,10 @@ def apply_jax_platform_env() -> None:
     if plat:
         import jax
         jax.config.update('jax_platforms', plat)
+
+
+def wants_real_chip() -> bool:
+    """Whether this process intends to claim the real TPU (vs an explicit
+    CPU run). The single home for the default-'axon' predicate shared by
+    bench fallback logic and the probe's single-claimant pidfile."""
+    return os.environ.get('JAX_PLATFORMS', 'axon') not in ('cpu',)

@@ -1,0 +1,164 @@
+"""Defer SIGTERM/SIGINT while a TPU client is inside backend init.
+
+The sandbox chip attaches through a single-claimant relay: killing a
+client while it is inside PJRT client construction
+(``make_c_api_client``) can wedge the relay leg for every later client
+— the r4 incident (``bench_runs/README.md``) cost a full round its
+driver-verified capture. This module turns the written-down lesson
+("never SIGKILL/SIGTERM a TPU client during backend init") into code so
+no session can recreate the wedge by accident:
+
+  * ``deferred_signals()`` — context manager that RECORDS SIGTERM /
+    SIGINT instead of dying, then re-delivers them after the critical
+    section. CPython runs Python-level handlers only between bytecodes,
+    so a signal arriving while init is inside the PJRT C call is
+    delivered only AFTER the call returns — exactly the "let it reach
+    steady state" discipline. (SIGKILL cannot be deferred; the point is
+    that polite shutdown paths — drivers, test harnesses, Ctrl-C —
+    never land mid-handshake.)
+  * ``init_backend_guarded()`` — run ``jax.devices()`` under the guard;
+    the idempotent entry every bench/serve/train path calls before
+    touching the chip.
+  * ``tools/tpu_client_guard.py`` — CLI wrapper: pre-initialize the
+    backend under the guard, then exec any Python entrypoint (backend
+    already cached, so the target's own init is instant and unkillable
+    windows are gone).
+
+Reference analog: the reference's provisioner wraps its bootstrap in
+retry/cleanup discipline (``sky/provision/provisioner.py``); here the
+critical resource is the device tunnel rather than a VM.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import signal
+import tempfile
+import threading
+import time
+from typing import Dict, Iterator, List, Sequence
+
+GUARD_SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+# Marker files make an in-flight guarded init visible ACROSS processes
+# (/proc/<pid>/environ only shows the startup environment, so an env
+# var cannot carry this): reapers (tpu_doctor.classify_strays) spare
+# any live pid holding a marker — "mid-init, do not touch".
+_MARKER_PREFIX = 'skytpu-guarded-init-'
+
+
+def _marker_path(pid: int | None = None) -> str:
+    return os.path.join(tempfile.gettempdir(),
+                        f'{_MARKER_PREFIX}{pid or os.getpid()}')
+
+
+def _starttime(pid: int) -> str | None:
+    """Kernel start-time ticks for pid — the identity check that makes a
+    marker survive pid recycling (a SIGKILLed guard holder leaks its
+    marker; without this, a recycled pid would shield an unrelated
+    process from reaping forever)."""
+    try:
+        with open(f'/proc/{pid}/stat', encoding='utf-8') as f:
+            return f.read().rsplit(')', 1)[1].split()[19]
+    except (OSError, IndexError):
+        return None
+
+
+def guarded_init_pids() -> Dict[int, float]:
+    """Live pids currently inside a guarded backend init, mapped to how
+    long (seconds) the marker has existed. Stale markers of dead pids
+    are cleaned as a side effect. A very old marker means the holder is
+    permanently wedged in init, not merely slow — reapers use the age to
+    decide when the mid-init spare stops applying (see
+    tpu_doctor.classify_strays)."""
+    out: Dict[int, float] = {}
+    now = time.time()
+    try:
+        names = os.listdir(tempfile.gettempdir())
+    except OSError:
+        return out
+    for name in names:
+        if not name.startswith(_MARKER_PREFIX):
+            continue
+        try:
+            pid = int(name[len(_MARKER_PREFIX):])
+        except ValueError:
+            continue
+        path = os.path.join(tempfile.gettempdir(), name)
+        try:
+            with open(path, encoding='utf-8') as f:
+                recorded_start = f.read().strip()
+        except OSError:
+            continue
+        if recorded_start and recorded_start == _starttime(pid):
+            try:
+                out[pid] = max(0.0, now - os.stat(path).st_mtime)
+            except OSError:
+                pass
+        else:  # pid dead, recycled, or marker unreadable: stale
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    return out
+
+
+@contextlib.contextmanager
+def deferred_signals(
+        signals: Sequence[signal.Signals] = GUARD_SIGNALS,
+) -> Iterator[List[int]]:
+    """Record-and-defer ``signals`` for the duration of the block.
+
+    Yields the (live) list of deferred signal numbers. On exit the old
+    handlers are restored and every deferred signal is re-delivered to
+    this process in arrival order — a deferred SIGTERM still terminates,
+    just not mid-handshake. No-op off the main thread (CPython only
+    allows handler installation there; worker threads don't receive
+    signals anyway).
+    """
+    pending: List[int] = []
+    if threading.current_thread() is not threading.main_thread():
+        yield pending
+        return
+    old = {}
+    for sig in signals:
+        try:
+            old[sig] = signal.signal(
+                sig, lambda signum, frame: pending.append(signum))
+        except (ValueError, OSError):  # unsupported signal on platform
+            pass
+    marker = _marker_path()
+    try:
+        with open(marker, 'w', encoding='utf-8') as f:
+            f.write(_starttime(os.getpid()) or '')
+    except OSError:
+        marker = None
+    try:
+        yield pending
+    finally:
+        if marker:
+            try:
+                os.unlink(marker)
+            except OSError:
+                pass
+        for sig, handler in old.items():
+            try:
+                signal.signal(sig, handler)
+            except (ValueError, OSError):
+                pass
+        for signum in pending:
+            os.kill(os.getpid(), signum)
+
+
+def init_backend_guarded(platform: str | None = None):
+    """``jax.devices()`` with shutdown signals deferred until the PJRT
+    client exists. Returns the device list. Idempotent: once the backend
+    is cached this is instant and the guard window is ~zero."""
+    with deferred_signals():
+        import jax
+        if platform:
+            jax.config.update('jax_platforms', platform)
+        else:
+            from skypilot_tpu.utils.jax_env import apply_jax_platform_env
+            apply_jax_platform_env()
+        return jax.devices()

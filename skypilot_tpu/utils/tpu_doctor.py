@@ -133,12 +133,31 @@ def classify_strays(own_fingerprint: Optional[str] = None,
     """Split live framework processes into (victims, spared) under the
     ownership rules of ``reap_stray_processes`` — without killing
     anything (tests exercise the policy through this)."""
+    from skypilot_tpu.utils import tpu_client_guard
     if own_fingerprint is None:
         own_fingerprint = os.environ.get(SESSION_ENV)
     ancestors = _ancestors_of(os.getpid())
+    # A client inside guarded backend init is never a victim while the
+    # init could still be legitimately in flight: killing a client
+    # mid-PJRT-construction is what wedged the relay in r4
+    # (bench_runs/README.md). Under reap_all an OLD marker (far beyond
+    # any healthy init time) means the holder is permanently wedged —
+    # the operator's explicit recovery sweep may then clear it.
+    mid_init = tpu_client_guard.guarded_init_pids()
+    try:
+        spare_max_s = float(
+            os.environ.get('SKYTPU_GUARD_SPARE_MAX_S', '900'))
+    except ValueError:
+        spare_max_s = 900.0
     victims, spared = [], []
     for info in framework_processes():
         if info['pid'] in ancestors:
+            continue
+        marker_age = mid_init.get(info['pid'])
+        if marker_age is not None and not (
+                reap_all and marker_age > spare_max_s):
+            spared.append({**info,
+                           'spared_reason': 'inside guarded backend init'})
             continue
         mine = (own_fingerprint is not None
                 and info['fingerprint'] == own_fingerprint)
@@ -272,13 +291,27 @@ def relay_state() -> Dict[str, Any]:
 # Phased backend probe
 
 _PROBE_CHILD = r'''
-import faulthandler, signal, sys
+import faulthandler, os, signal, sys, threading
 phase_f = open(sys.argv[1], 'w', buffering=1)
 faulthandler.register(signal.SIGUSR1, file=sys.stderr, all_threads=True)
 def phase(p):
     phase_f.write(p + '\n')
+pkg_root = os.environ.get('SKYTPU_PKG_ROOT')
+if pkg_root and pkg_root not in sys.path:
+    sys.path.insert(0, pkg_root)
 phase('python-started')
-import os
+# Hard deadline: if init NEVER completes the child must eventually give
+# up — an abrupt exit is unavoidable then, but the deadline sits far
+# beyond any healthy init time, so a live handshake that would have
+# succeeded is never aborted (the r4 wedge lesson; the parent never
+# kills this child mid-init — see probe_backend).
+hard_s = float(os.environ.get('SKYTPU_PROBE_HARD_DEADLINE_S', '600'))
+init_done = threading.Event()
+def _watchdog():
+    if not init_done.wait(hard_s):
+        phase('hard-deadline-abort')
+        os._exit(9)
+threading.Thread(target=_watchdog, daemon=True).start()
 import jax
 # The sandbox's sitecustomize imports jax at interpreter start and may
 # latch a pinned platform; honor the caller's JAX_PLATFORMS explicitly
@@ -286,7 +319,11 @@ import jax
 if os.environ.get('JAX_PLATFORMS'):
     jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
 phase('jax-imported')
-devs = jax.devices()   # backend init: plugin discovery + device enumeration
+from skypilot_tpu.utils.tpu_client_guard import deferred_signals
+with deferred_signals():
+    # backend init: plugin discovery + device enumeration
+    devs = jax.devices()
+init_done.set()
 phase('devices-enumerated:%d:%s' % (len(devs), devs[0].platform))
 import jax.numpy as jnp
 r = float((jnp.ones((256, 256)) @ jnp.ones((256, 256))).sum())
@@ -301,70 +338,226 @@ _PHASE_MEANING = {
                     'enumeration — the single-claimant tunnel leg)',
     'devices-enumerated': 'hung in first XLA compile/execute',
     'first-compile-done': 'completed',
+    'hard-deadline-abort': 'child self-aborted at its hard deadline '
+                           '(init never completed)',
 }
+
+# A timed-out probe child is NEVER killed mid-init (killing a client
+# inside PJRT construction is what wedged the relay in r4 —
+# bench_runs/README.md). It is left to finish on its own, with an
+# in-child hard deadline as the only backstop. The pidfile makes the
+# claim visible across processes so no second claimant is started while
+# one is still inside init ("run exactly ONE TPU process at a time").
+_PROBE_PIDFILE = os.path.join(tempfile.gettempdir(),
+                              'skytpu-probe-child.pid')
+PROBE_CHILD_TAG = 'skytpu-probe-child'
+
+# Repo root (this file is skypilot_tpu/utils/tpu_doctor.py): the probe
+# child is a `python -c` subprocess whose sys.path[0] is the CWD, so the
+# package location must travel explicitly for probes run from anywhere.
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _full_cmdline(pid: int) -> Optional[str]:
+    """Untruncated cmdline (identity checks need the trailing argv tag,
+    which _read_proc's 300-char display cap would drop)."""
+    try:
+        with open(f'/proc/{pid}/cmdline', 'rb') as f:
+            return f.read().replace(b'\0', b' ').decode(
+                'utf-8', errors='replace')
+    except OSError:
+        return None
+
+
+def live_probe_child() -> Optional[Dict[str, Any]]:
+    """The still-running detached probe child from an earlier timed-out
+    probe (this process or any other), or None."""
+    try:
+        with open(_PROBE_PIDFILE, encoding='utf-8') as f:
+            pid = int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+    cmd = _full_cmdline(pid)
+    if cmd is not None and PROBE_CHILD_TAG in cmd:
+        return _read_proc(pid) or {'pid': pid, 'age_s': None}
+    # Stale (pid dead or recycled by an unrelated process). Do NOT
+    # unlink here: this reader runs outside the probe flock, and an
+    # unlock-free unlink can erase a concurrent prober's freshly
+    # written claim (review finding). probe_backend cleans stale
+    # pidfiles under the lock.
+    return None
+
+
+def _sweep_stale_probe_dirs(max_age_s: float = 3600.0) -> None:
+    """Detached probe children keep their scratch dirs alive past the
+    probe call; clean up any old enough that no child can still be
+    writing (in-child hard deadline << this age)."""
+    import shutil
+    tmp = tempfile.gettempdir()
+    now = time.time()
+    try:
+        names = os.listdir(tmp)
+    except OSError:
+        return
+    for name in names:
+        if not name.startswith('skytpu-doctor-'):
+            continue
+        path = os.path.join(tmp, name)
+        try:
+            if now - os.stat(path).st_mtime > max_age_s:
+                shutil.rmtree(path, ignore_errors=True)
+        except OSError:
+            pass
 
 
 def probe_backend(timeout_s: float = 90.0) -> Dict[str, Any]:
     """Run device init in a subprocess; on timeout, capture WHERE it hung
-    (last phase marker + SIGUSR1 faulthandler stack of the child)."""
-    with tempfile.TemporaryDirectory(prefix='skytpu-doctor-') as td:
-        phases_path = os.path.join(td, 'phases')
-        t0 = time.monotonic()
-        proc = subprocess.Popen(
-            [sys.executable, '-c', _PROBE_CHILD, phases_path],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
-        hang_stack = None
-        timed_out = False
+    (last phase marker + SIGUSR1 faulthandler stack of the child), then
+    DETACH the child to finish init on its own — never kill it mid-init.
+    """
+    from skypilot_tpu.utils.jax_env import wants_real_chip
+    t0 = time.monotonic()
+    _sweep_stale_probe_dirs()
+    real = wants_real_chip()
+    lock_fd = None
+    if real:
+        # Honor the single-claimant discipline: wait (within budget) for
+        # any prior detached probe child to finish rather than starting
+        # a second client against the relay. The flock closes the
+        # check-then-spawn race between concurrent probers.
+        import fcntl
+        prior = live_probe_child()
+        while prior is not None and time.monotonic() - t0 < timeout_s:
+            time.sleep(2.0)
+            prior = live_probe_child()
         try:
-            _, err = proc.communicate(timeout=timeout_s)
-            ok = proc.returncode == 0
-        except subprocess.TimeoutExpired:
-            ok = False
-            timed_out = True
-            try:  # ask the child for its stacks, then put it down
-                proc.send_signal(signal.SIGUSR1)
-                time.sleep(2.0)
-            except ProcessLookupError:
-                pass
-            proc.kill()
-            _, err = proc.communicate()
-        elapsed = round(time.monotonic() - t0, 1)
-        try:
-            with open(phases_path, encoding='utf-8') as f:
-                phases = [l.strip() for l in f if l.strip()]
+            lock_fd = os.open(_PROBE_PIDFILE + '.lock',
+                              os.O_CREAT | os.O_RDWR, 0o644)
+            fcntl.flock(lock_fd, fcntl.LOCK_EX)
         except OSError:
-            phases = []
-        err_text = err.decode('utf-8', errors='replace') if err else ''
-        if not ok and ('Current thread' in err_text
-                       or 'Thread 0x' in err_text):
-            hang_stack = err_text[-4000:]
-        last = phases[-1].split(':')[0] if phases else None
-        if ok:
-            outcome, diagnosis = 'completed', 'completed'
-        elif timed_out:
-            outcome = 'timeout'
-            diagnosis = _PHASE_MEANING.get(last, 'unknown phase')
-        else:
-            # A fast, clean failure (e.g. "No TPU device found", plugin
-            # not registered) is a different animal from a wedged
-            # tunnel: the error text, not the phase, names the fault.
-            outcome = 'crashed'
-            err_line = next(
-                (l for l in reversed(err_text.splitlines()) if l.strip()),
-                '')
-            diagnosis = (f'backend init CRASHED (rc={proc.returncode}) '
-                         f'after phase {last!r}: {err_line[:300]}')
-        return {
-            'ok': ok,
-            'outcome': outcome,
-            'elapsed_s': elapsed,
-            'timeout_s': timeout_s,
-            'phases': phases,
-            'last_phase': last,
-            'diagnosis': diagnosis,
-            'hang_stack': hang_stack,
-            'stderr_tail': None if ok else err_text[-1500:],
-        }
+            lock_fd = None
+        prior = live_probe_child()
+        if prior is not None:
+            if lock_fd is not None:
+                os.close(lock_fd)
+            return {
+                'ok': False, 'outcome': 'blocked',
+                'elapsed_s': round(time.monotonic() - t0, 1),
+                'timeout_s': timeout_s, 'phases': [], 'last_phase': None,
+                'diagnosis': (
+                    f"a prior probe child (pid {prior['pid']}, age "
+                    f"{prior['age_s']}s) is still inside backend init; "
+                    'refusing to start a second claimant'),
+                'hang_stack': None, 'stderr_tail': None,
+            }
+        try:  # stale claim (dead/recycled pid): clean it under the lock
+            os.unlink(_PROBE_PIDFILE)
+        except OSError:
+            pass
+    td = tempfile.mkdtemp(prefix='skytpu-doctor-')
+    phases_path = os.path.join(td, 'phases')
+    err_path = os.path.join(td, 'stderr')
+    # Files (not pipes) + new session: the child can outlive this probe
+    # call without blocking on a dead pipe reader or catching our
+    # process-group signals.
+    child_env = dict(os.environ, SKYTPU_PKG_ROOT=_PKG_ROOT)
+    with open(err_path, 'wb') as err_f:
+        proc = subprocess.Popen(
+            [sys.executable, '-c', _PROBE_CHILD, phases_path,
+             PROBE_CHILD_TAG],
+            stdout=subprocess.DEVNULL, stderr=err_f,
+            start_new_session=True, env=child_env)
+    if real:
+        try:
+            with open(_PROBE_PIDFILE, 'w', encoding='utf-8') as f:
+                f.write(str(proc.pid))
+        except OSError:
+            pass
+        if lock_fd is not None:
+            os.close(lock_fd)
+    hang_stack = None
+    timed_out = False
+    detached = None
+    try:
+        proc.wait(timeout=timeout_s)
+        ok = proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        ok = False
+        timed_out = True
+        try:  # ask the child for its stacks — and leave it running
+            proc.send_signal(signal.SIGUSR1)
+            time.sleep(2.0)
+        except ProcessLookupError:
+            pass
+        if proc.poll() is None:
+            detached = ('child left to finish init on its own '
+                        f'(pid {proc.pid}, in-child hard deadline '
+                        f"{os.environ.get('SKYTPU_PROBE_HARD_DEADLINE_S', '600')}s)")
+    elapsed = round(time.monotonic() - t0, 1)
+    try:
+        with open(phases_path, encoding='utf-8') as f:
+            phases = [l.strip() for l in f if l.strip()]
+    except OSError:
+        phases = []
+    try:
+        with open(err_path, 'rb') as f:
+            err_text = f.read().decode('utf-8', errors='replace')
+    except OSError:
+        err_text = ''
+    if proc.poll() is not None and real:
+        # Claim released: clear the pidfile — under the lock, and only
+        # if it still names OUR child (a successor prober may have
+        # already claimed; erasing its live claim would let a third
+        # prober start a second concurrent claimant).
+        import fcntl
+        try:
+            cleanup_fd = os.open(_PROBE_PIDFILE + '.lock',
+                                 os.O_CREAT | os.O_RDWR, 0o644)
+            fcntl.flock(cleanup_fd, fcntl.LOCK_EX)
+            try:
+                with open(_PROBE_PIDFILE, encoding='utf-8') as f:
+                    if f.read().strip() == str(proc.pid):
+                        os.unlink(_PROBE_PIDFILE)
+            finally:
+                os.close(cleanup_fd)
+        except OSError:
+            pass
+    if proc.poll() is not None:
+        import shutil
+        shutil.rmtree(td, ignore_errors=True)
+    if not ok and ('Current thread' in err_text
+                   or 'Thread 0x' in err_text):
+        hang_stack = err_text[-4000:]
+    last = phases[-1].split(':')[0] if phases else None
+    if ok:
+        outcome, diagnosis = 'completed', 'completed'
+    elif timed_out:
+        outcome = 'timeout'
+        diagnosis = _PHASE_MEANING.get(last, 'unknown phase')
+        if detached:
+            diagnosis += f'; {detached}'
+    else:
+        # A fast, clean failure (e.g. "No TPU device found", plugin
+        # not registered) is a different animal from a wedged
+        # tunnel: the error text, not the phase, names the fault.
+        outcome = 'crashed'
+        err_line = next(
+            (l for l in reversed(err_text.splitlines()) if l.strip()),
+            '')
+        diagnosis = (f'backend init CRASHED (rc={proc.returncode}) '
+                     f'after phase {last!r}: {err_line[:300]}')
+    return {
+        'ok': ok,
+        'outcome': outcome,
+        'elapsed_s': elapsed,
+        'timeout_s': timeout_s,
+        'phases': phases,
+        'last_phase': last,
+        'diagnosis': diagnosis,
+        'hang_stack': hang_stack,
+        'stderr_tail': None if ok else err_text[-1500:],
+    }
 
 
 def doctor_report(probe_timeout_s: float = 90.0,

@@ -1,0 +1,138 @@
+"""The bench artifact contract (r4 verdict Next #1a).
+
+r4's driver capture had ``rc: 0`` but ``parsed: null``: the single
+output line embedded multi-KB probe diagnostics and overflowed the
+driver's capture window, recording NO metric. These tests pin the
+contract: the final line ALWAYS parses as one JSON object and is
+< 4 KB, for success-shaped, fallback-shaped, and pathologically bulky
+results alike; bulky evidence lands in a sidecar file the line points
+to.
+"""
+import json
+import pathlib
+import sys
+
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).parents[1]))
+import bench  # noqa: E402
+
+
+def _success_result():
+    """Shaped like a real TPU capture (bench_runs/r04_session_capture)."""
+    return {
+        'metric': 'llama_train_model_tflops_per_chip',
+        'value': 102.1,
+        'unit': 'TFLOP/s/chip (6ND)',
+        'vs_baseline': 4.348,
+        'detail': {
+            'backend': 'axon', 'chips': 1, 'model_params': 1100048384,
+            'seq_len': 4096, 'global_batch': 2,
+            'tokens_per_sec_per_chip': 15468.9, 'steps_per_sec': 1.888,
+            'loss': 10.47, 'tflops_per_chip_seq2048': 111.1,
+            'remat_policy': 'dots',
+            'sweep': [{'config': f'{p}/b{b}', 'tflops_per_chip': 90.0}
+                      for p, b in (('dots', 2), ('dots', 3), ('heavy', 4),
+                                   ('attn', 4), ('attn', 6), ('heavy', 6))],
+            'local_provider_first_step_s': 4.9,
+            'decode_tokens_per_sec': 9476.0,
+            'decode_variants': {'bf16': 5167.0, 'int8': 5648.0,
+                                'int8+kv8': 9476.0},
+            'cpu_fallback': False,
+        },
+    }
+
+
+def _fallback_diagnostics():
+    """Shaped like the r4 wedge: big hang stack + process/socket dumps."""
+    stack = 'File "xla_client.py", line 161 in make_c_api_client\n' * 120
+    return {
+        'failed_attempts': [
+            {'ok': False, 'outcome': 'timeout', 'elapsed_s': t,
+             'last_phase': 'jax-imported', 'hang_stack': stack,
+             'diagnosis': 'hung in backend init'}
+            for t in (120.0, 180.0, 300.0)],
+        'final_hang_phase': 'jax-imported',
+        'final_diagnosis': 'hung in backend init (plugin discovery / '
+                           'device enumeration)',
+        'hang_stack': stack,
+        'framework_processes': [],
+        'relay': {'env': {f'TPU_VAR_{i}': 'x' * 80 for i in range(12)},
+                  'pool_ips': ['127.0.0.1'], 'pool_listeners': [],
+                  'established_to_pool': [], 'listener_count_total': 40},
+        'process_table_clean': True,
+    }
+
+
+def _check_line(line):
+    assert '\n' not in line
+    assert len(line.encode()) <= bench.MAX_ARTIFACT_BYTES
+    parsed = json.loads(line)
+    for key in ('metric', 'value', 'unit', 'vs_baseline'):
+        assert key in parsed, key
+    assert isinstance(parsed['value'], (int, float))
+    return parsed
+
+
+def test_success_shape_parses_and_fits(tmp_path):
+    line = bench.finalize_result(_success_result(), None,
+                                 out_dir=str(tmp_path))
+    parsed = _check_line(line)
+    assert parsed['detail']['decode_tokens_per_sec'] == 9476.0
+    # No diagnostics → no sidecar needed for a normally-sized success.
+    assert parsed['detail'].get('probe_diagnostics') is None
+
+
+def test_fallback_shape_offloads_diagnostics_to_sidecar(tmp_path):
+    result = _success_result()
+    result['value'] = 0.035
+    result['detail']['backend'] = 'cpu'
+    result['detail']['cpu_fallback'] = True
+    diag = _fallback_diagnostics()
+    line = bench.finalize_result(result, diag, out_dir=str(tmp_path))
+    parsed = _check_line(line)
+    pd = parsed['detail']['probe_diagnostics']
+    assert 'summary' in pd and 'terminal-side' in pd['summary']
+    sidecars = list(tmp_path.glob('diag_*.json'))
+    assert len(sidecars) == 1
+    stored = json.loads(sidecars[0].read_text())
+    assert stored['probe_diagnostics']['final_hang_phase'] == 'jax-imported'
+    assert 'make_c_api_client' in stored['probe_diagnostics']['hang_stack']
+    # The pointer in the artifact names the sidecar actually written.
+    assert pd['path'].endswith(sidecars[0].name)
+
+
+def test_pathological_detail_is_offloaded_not_overflowed(tmp_path):
+    result = _success_result()
+    result['detail']['sweep'] = [
+        {'config': f'c{i}', 'error': 'RuntimeError: ' + 'x' * 400}
+        for i in range(40)]
+    line = bench.finalize_result(result, _fallback_diagnostics(),
+                                 out_dir=str(tmp_path))
+    parsed = _check_line(line)
+    assert isinstance(parsed['detail']['sweep'], str)  # pointer, not blob
+    stored = json.loads(next(tmp_path.glob('diag_*.json')).read_text())
+    assert len(stored['sweep']) == 40
+
+
+@pytest.mark.slow
+def test_cli_emits_single_compact_line_cpu(tmp_path, monkeypatch):
+    """End-to-end: `python bench.py` on CPU emits exactly one stdout
+    line that parses and fits — the exact thing the driver captures."""
+    import os
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS='cpu',
+               SKYTPU_STATE_DIR=str(tmp_path / 'state'))
+    r = subprocess.run([sys.executable, 'bench.py'],
+                       capture_output=True, text=True, timeout=600,
+                       cwd=str(pathlib.Path(__file__).parents[1]),
+                       env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [l for l in r.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, r.stdout
+    parsed = _check_line(lines[0])
+    assert parsed['detail']['cpu_fallback'] is True
+
+
+if __name__ == '__main__':
+    raise SystemExit(pytest.main([__file__, '-v']))

@@ -1,0 +1,112 @@
+"""tpu_client_guard: shutdown signals are deferred across backend init,
+never dropped (r4 verdict Next #1b — the relay-wedge lesson as code)."""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from skypilot_tpu.utils import tpu_client_guard, tpu_doctor
+
+
+def test_signal_deferred_and_redelivered():
+    """SIGTERM sent inside the guard must not interrupt the block, and
+    must be re-delivered (and kill) after the guard exits."""
+    code = r'''
+import os, signal, sys
+from skypilot_tpu.utils.tpu_client_guard import deferred_signals
+with deferred_signals() as pending:
+    os.kill(os.getpid(), signal.SIGTERM)
+    # Python-level delivery happens at the next bytecode boundary: by
+    # the next statement the recording handler has run.
+    for _ in range(1000):
+        pass
+    print('survived-inside-guard', len(pending), flush=True)
+print('UNREACHABLE-after-guard', flush=True)
+'''
+    r = subprocess.run([sys.executable, '-c', code],
+                       capture_output=True, text=True, timeout=60)
+    assert 'survived-inside-guard 1' in r.stdout
+    assert 'UNREACHABLE' not in r.stdout  # redelivered SIGTERM killed it
+    assert r.returncode == -signal.SIGTERM
+
+
+def test_no_pending_signal_is_a_noop():
+    with tpu_client_guard.deferred_signals() as pending:
+        assert pending == []
+    # Handlers restored: a default SIGTERM disposition again.
+    assert signal.getsignal(signal.SIGTERM) in (
+        signal.SIG_DFL, signal.Handlers.SIG_DFL)
+
+
+def test_marker_file_visible_cross_process_and_cleaned():
+    """While a process is inside the guard its pid is listed by
+    guarded_init_pids(); after exit the marker is gone."""
+    code = r'''
+import sys, time
+from skypilot_tpu.utils.tpu_client_guard import deferred_signals
+with deferred_signals():
+    print('in-guard', flush=True)
+    time.sleep(30)
+'''
+    child = subprocess.Popen([sys.executable, '-c', code],
+                             stdout=subprocess.PIPE, text=True)
+    try:
+        assert child.stdout.readline().strip() == 'in-guard'
+        assert child.pid in tpu_client_guard.guarded_init_pids()
+    finally:
+        child.kill()
+        child.wait()
+    # Marker of the (killed) pid is stale; the next listing cleans it.
+    deadline = time.time() + 10
+    while child.pid in tpu_client_guard.guarded_init_pids():
+        assert time.time() < deadline
+        time.sleep(0.2)
+
+
+def test_reaper_spares_mid_init_client():
+    """A framework-pattern process inside guarded init is spared even
+    though it carries OUR session fingerprint (normally reaped)."""
+    my_fp = tpu_doctor.session_fingerprint()
+    env = dict(os.environ, **{tpu_doctor.SESSION_ENV: my_fp})
+    code = r'''
+import time
+from skypilot_tpu.utils.tpu_client_guard import deferred_signals
+with deferred_signals():
+    print('in-guard', flush=True)
+    time.sleep(60)
+'''
+    child = subprocess.Popen(
+        [sys.executable, '-c', code, 'skypilot_tpu.agent.test-midinit'],
+        stdout=subprocess.PIPE, text=True, env=env)
+    try:
+        assert child.stdout.readline().strip() == 'in-guard'
+        victims, spared = tpu_doctor.classify_strays()
+        assert child.pid not in {p['pid'] for p in victims}
+        mine = [p for p in spared if p['pid'] == child.pid]
+        assert mine and mine[0]['spared_reason'] == \
+            'inside guarded backend init'
+        # reap_all must not override the mid-init spare either.
+        victims_all, _ = tpu_doctor.classify_strays(reap_all=True)
+        assert child.pid not in {p['pid'] for p in victims_all}
+    finally:
+        child.kill()
+        child.wait()
+
+
+def test_init_backend_guarded_returns_devices():
+    devs = tpu_client_guard.init_backend_guarded()
+    assert len(devs) >= 1  # conftest: 8-device virtual CPU platform
+
+
+def test_cli_wrapper_runs_target_with_backend_cached(tmp_path):
+    target = tmp_path / 'target.py'
+    target.write_text(
+        'import jax, sys\n'
+        'print("target-ran", len(jax.devices()), sys.argv[1])\n')
+    r = subprocess.run(
+        [sys.executable, 'tools/tpu_client_guard.py', str(target), 'argA'],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert 'target-ran' in r.stdout
+    assert 'argA' in r.stdout

@@ -1,0 +1,45 @@
+#!/usr/bin/env python3
+"""Run any Python entrypoint with TPU backend init signal-guarded.
+
+    python tools/tpu_client_guard.py bench.py [args...]
+    python tools/tpu_client_guard.py -m skypilot_tpu.serve.llm_server ...
+
+Pre-initializes the JAX backend under
+``skypilot_tpu.utils.tpu_client_guard.deferred_signals`` (SIGTERM /
+SIGINT are recorded and re-delivered AFTER the PJRT client exists —
+killing a client mid-init wedged the sandbox relay in r4,
+``bench_runs/README.md``), then runs the target in-process with the
+backend already cached, so the target has no unguarded init window at
+all. A deferred signal is re-delivered before the target starts: the
+process still dies on polite shutdown, just never mid-handshake.
+"""
+import os
+import runpy
+import sys
+
+
+def main() -> None:
+    argv = sys.argv[1:]
+    if not argv or argv[0] in ('-h', '--help'):
+        print(__doc__)
+        raise SystemExit(0 if argv else 2)
+    # Repo root on sys.path so bench.py / tools run from anywhere.
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from skypilot_tpu.utils.tpu_client_guard import init_backend_guarded
+    init_backend_guarded()
+
+    if argv[0] == '-m':
+        if len(argv) < 2:
+            print('tpu_client_guard: -m requires a module name',
+                  file=sys.stderr)
+            raise SystemExit(2)
+        sys.argv = argv[1:]
+        runpy.run_module(argv[1], run_name='__main__', alter_sys=True)
+    else:
+        sys.argv = argv
+        runpy.run_path(argv[0], run_name='__main__')
+
+
+if __name__ == '__main__':
+    main()
