@@ -43,6 +43,25 @@ Sampling: per-slot temperature rides the decode step (greedy rows take
 Per-request SEEDED determinism is impossible under continuous batching
 (noise depends on arrival order), so the serving layer routes seeded
 requests to the window-batched path instead.
+
+SPECULATIVE DECODING (``draft_params``/``draft_cfg`` set): each engine
+iteration becomes a draft-propose / target-verify ROUND over all slots
+(JetStream/vLLM-class engines run draft/verify per-slot inside the
+continuous batch — r4 verdict Next #2). A parallel draft KV cache
+tracks the same committed stream; per round the draft proposes
+``spec_k`` greedy tokens per slot (one ``lax.scan``), the target scores
+the whole window in ONE k+1-token forward (its existing multi-token
+path), and acceptance is decided host-side PER SLOT — rollback is a
+per-row ``lengths`` rewrite, the same never-attended-past-length
+invariant decode already relies on. Greedy slots emit their accepted
+prefix + the target's correction (byte-identical to the plain engine /
+solo generation — the draft only changes speed); SAMPLED slots advance
+exactly one token per round, drawn from the verify's position-0 logits
+(= the plain decode step's logits), so temperature/top-k/top-p traffic
+shares the engine instead of forcing it off. Dense targets only: MoE
+expert capacity is per forward CALL, so a k+1-token verify routes
+differently than sequential decode and would break greedy exactness
+(same capacity-coupling reason as chunked prefill / the prefix pool).
 """
 from __future__ import annotations
 
@@ -78,6 +97,24 @@ class _Request:
     top_k: int = 0        # 0 = off
     top_p: float = 1.0    # >= 1 = off
     eos: Optional[frozenset] = None  # stop ids; None = run to max_new
+
+
+@dataclasses.dataclass
+class _Prefilling:
+    """An in-flight incremental (chunked) long prefill. ``first`` is set
+    once the final chunk has sampled the request's first token; the
+    entry may then PARK awaiting a free slot."""
+    req: _Request
+    cache: Optional[gen_lib.KVCache] = None  # target scratch row
+    consumed: int = 0                        # target tokens prefilled
+    d_cache: Optional[gen_lib.KVCache] = None  # draft scratch (spec mode)
+    d_consumed: int = 0
+    first: Optional[jax.Array] = None
+    first_host: Optional[int] = None
+
+    @property
+    def parked(self) -> bool:
+        return self.first is not None
 
 
 def prompt_bucket(n: int, lo: int = 16) -> int:
@@ -195,6 +232,80 @@ _jit_chunk = jax.jit(_chunk_impl, static_argnums=(0, 1),
                      donate_argnums=(3, 4))
 
 
+def _insert_cache_impl(cache: gen_lib.KVCache, cache_n: gen_lib.KVCache,
+                       slots: jax.Array) -> gen_lib.KVCache:
+    """Cache-only variant of ``_insert_impl`` for the DRAFT cache: the
+    committed token stream (``last``) is shared with the target, so the
+    draft insert carries no firsts."""
+    width = cache_n.k.shape[3]
+    k = cache.k.at[:, slots, :, :width].set(cache_n.k)
+    v = cache.v.at[:, slots, :, :width].set(cache_n.v)
+    lengths = cache.lengths.at[slots].set(cache_n.lengths)
+    k_s, v_s = cache.k_s, cache.v_s
+    if cache.quantized:
+        k_s = k_s.at[:, slots, :, :width].set(cache_n.k_s)
+        v_s = v_s.at[:, slots, :, :width].set(cache_n.v_s)
+    return gen_lib.KVCache(k=k, v=v, lengths=lengths, k_s=k_s, v_s=v_s)
+
+
+_jit_insert_cache = jax.jit(_insert_cache_impl, donate_argnums=(0,))
+
+
+def _rewind_impl(cache: gen_lib.KVCache, adj: jax.Array) -> gen_lib.KVCache:
+    """Per-row rollback: positions past a row's valid length are never
+    attended and get overwritten, so rejecting proposals is just a
+    lengths subtraction (models/speculative.py's invariant, per row)."""
+    return gen_lib.KVCache(k=cache.k, v=cache.v,
+                           lengths=cache.lengths - adj,
+                           k_s=cache.k_s, v_s=cache.v_s)
+
+
+_jit_rewind = jax.jit(_rewind_impl, donate_argnums=(0,))
+
+
+def _spec_impl(t_cfg: llama.LlamaConfig, d_cfg: llama.LlamaConfig,
+               k: int, t_params, d_params, t_cache: gen_lib.KVCache,
+               d_cache: gen_lib.KVCache, last: jax.Array,
+               temps: jax.Array, top_ks, top_ps, active: jax.Array,
+               key: jax.Array):
+    """One speculative round over ALL slots. Returns (t_cache, d_cache,
+    props [B, k+1], tgt [B, k+1], samp [B]) with BOTH caches advanced
+    k+1 positions (the host rolls back per row by rewriting lengths).
+
+    The draft runs k+1 proposal steps (the surplus step writes p_k's KV
+    so a fully-accepted window leaves the draft cache complete —
+    models/speculative.py's trade); the target scores the whole window
+    [last, p_1..p_k] in one forward with per-position logits. ``samp``
+    is drawn from the verify's position-0 logits with each row's
+    sampling params — for sampled rows one round == one plain decode
+    step on exactly the logits that step would have produced."""
+    b = last.shape[0]
+    ones = jnp.ones((b,), jnp.int32)
+
+    def dstep(carry, _):
+        dc, tok = carry
+        logits, dc = gen_lib.forward_cached(d_params, tok[:, None], dc,
+                                            d_cfg, ones, active)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (dc, nxt), nxt
+
+    (d_cache, _), props = jax.lax.scan(dstep, (d_cache, last), None,
+                                       length=k + 1)
+    props = props.transpose(1, 0)  # [B, k+1]
+    window = jnp.concatenate([last[:, None], props[:, :k]], axis=1)
+    logits_all, t_cache = gen_lib.forward_cached(
+        t_params, window, t_cache, t_cfg, (k + 1) * ones, active,
+        all_logits=True)
+    tgt = jnp.argmax(logits_all, axis=-1).astype(jnp.int32)  # [B, k+1]
+    samp = sampling.sample(logits_all[:, 0].astype(jnp.float32), temps,
+                           key, top_ks, top_ps)
+    return t_cache, d_cache, props, tgt, samp
+
+
+_jit_spec = jax.jit(_spec_impl, static_argnums=(0, 1, 2),
+                    donate_argnums=(5, 6))
+
+
 class ContinuousEngine:
     """Slot server: submit() rows from any thread; a dedicated engine
     thread owns the device state and loops admit -> decode-chunk ->
@@ -207,9 +318,37 @@ class ContinuousEngine:
                  mesh=None, rules=None,
                  kv_quantize: Optional[bool] = None,
                  prefix_slots: Optional[int] = None,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 draft_params=None,
+                 draft_cfg: Optional[llama.LlamaConfig] = None,
+                 spec_k: Optional[int] = None):
         self.params = params
         self.cfg = cfg
+        # Speculative mode (see module docstring): draft proposes,
+        # target verifies, per slot, inside the continuous batch.
+        if (draft_params is None) != (draft_cfg is None):
+            raise ValueError('draft_params and draft_cfg go together')
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        self.spec_k = (spec_k if spec_k is not None
+                       else int(os.environ.get('SKYTPU_LLM_SPEC_K', '4')))
+        if draft_cfg is not None:
+            if self.spec_k < 1:
+                raise ValueError(f'spec_k must be >= 1, got {self.spec_k}')
+            if cfg.num_experts > 0:
+                # Expert capacity is per forward CALL: a k+1-token verify
+                # routes (and drops) differently than sequential decode,
+                # breaking the byte-identical greedy-exactness contract
+                # (same capacity coupling that disables chunked prefill
+                # and the prefix pool for MoE).
+                raise ValueError('speculative decoding requires a dense '
+                                 'target (MoE expert capacity is per '
+                                 'forward call; a k+1-token verify would '
+                                 'break greedy exactness)')
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    'draft and target must share a vocabulary '
+                    f'({draft_cfg.vocab_size} vs {cfg.vocab_size})')
         self.slots = slots or int(os.environ.get('SKYTPU_LLM_SLOTS', '16'))
         self.max_len = min(max_len, cfg.max_seq_len)
         self.chunk_steps = chunk_steps or int(
@@ -276,6 +415,11 @@ class ContinuousEngine:
             self.rules = rules or sharding_lib.ShardingRules()
             self.params = quant_lib.shard_params(params, cfg, mesh,
                                                  self.rules)
+            if self.draft_params is not None:
+                # Draft rides the same TP mesh (its kv_heads must divide
+                # the tensor axis like the target's do).
+                self.draft_params = quant_lib.shard_params(
+                    self.draft_params, self.draft_cfg, mesh, self.rules)
             self._kv_sharding = sharding_lib.logical_sharding(
                 mesh, self.rules,
                 ('layers', 'batch', 'kv_heads', None, 'head_dim'))
@@ -283,13 +427,18 @@ class ContinuousEngine:
                 mesh, self.rules, ('layers', 'batch', 'kv_heads', None))
             self._vec_sharding = sharding_lib.logical_sharding(
                 mesh, self.rules, ('batch',))
+        # Spec mode reserves window overhang below max_len: a verify may
+        # write k+1 positions past the last committed one before its
+        # tail rolls back, and a clamped out-of-range write would smear
+        # junk over real KV (same clamping hazard as chunked prefill).
+        self._submit_max = self.max_len - (
+            self.spec_k + 1 if self.draft_cfg is not None else 0)
         self._init_device_state()
         self._slot_req: List[Optional[_Request]] = [None] * self.slots
         self._pending: collections.deque = collections.deque()
         self._unfetched: List[tuple] = []  # [(reqs, firsts-device-array)]
         self._admitting: List[_Request] = []  # mid-prefill group
-        # Incremental long prefills: [req, scratch-cache-or-None, consumed]
-        self._prefilling: List[list] = []
+        self._prefilling: List[_Prefilling] = []
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
@@ -305,6 +454,9 @@ class ContinuousEngine:
         self.chunks_run = 0
         self.tokens_emitted = 0
         self.peak_active = 0
+        self.spec_rounds = 0
+        self.spec_proposals = 0
+        self.spec_accepted = 0
 
     # -- public API (any thread) ------------------------------------------
 
@@ -312,10 +464,13 @@ class ContinuousEngine:
                temperature: float = 0.0, on_tokens=None,
                top_k: int = 0, top_p: float = 1.0,
                eos=None) -> concurrent.futures.Future:
-        if len(row) + max_new > self.max_len:
+        if len(row) + max_new > self._submit_max:
+            extra = ('' if self._submit_max == self.max_len else
+                     f' (max_len {self.max_len} minus the speculative '
+                     f'verify window overhang {self.spec_k + 1})')
             raise ValueError(
                 f'prompt ({len(row)}) + max_new ({max_new}) exceeds '
-                f'engine max_len {self.max_len}')
+                f'engine max_len limit {self._submit_max}{extra}')
         if top_k < 0 or not 0.0 < top_p <= 1.0:
             # top_p <= 0 would mask EVERY token and degenerate to
             # uniform-random ids — reject like the HTTP layer does.
@@ -367,6 +522,14 @@ class ContinuousEngine:
                 'chunk_steps': self.chunk_steps,
                 'tokens_emitted': self.tokens_emitted,
                 'peak_active_slots': self.peak_active,
+                'speculative': None if self.draft_cfg is None else {
+                    'k': self.spec_k,
+                    'rounds': self.spec_rounds,
+                    'proposals': self.spec_proposals,
+                    'accepted': self.spec_accepted,
+                    'acceptance_rate': (
+                        self.spec_accepted / self.spec_proposals
+                        if self.spec_proposals else 0.0)},
                 'prefix_cache': {
                     'slots': self.prefix_slots,
                     'entries': len(self._prefix_index),
@@ -390,7 +553,10 @@ class ContinuousEngine:
                     self._wake.wait(0.05)
                     self._wake.clear()
                     continue
-                self._run_chunk()
+                if self.draft_cfg is not None:
+                    self._run_spec_round()
+                else:
+                    self._run_chunk()
             except Exception as exc:  # noqa: BLE001 — fail all waiters
                 # Fail in-flight work, rebuild device state, KEEP LOOPING:
                 # the failed call may have consumed the donated cache
@@ -407,7 +573,7 @@ class ContinuousEngine:
             doomed = list(self._pending) + [
                 r for r in self._slot_req if r is not None] + [
                 r for reqs, _ in self._unfetched for r in reqs] + \
-                list(self._admitting) + [p[0] for p in self._prefilling]
+                list(self._admitting) + [p.req for p in self._prefilling]
             self._pending.clear()
             self._slot_req = [None] * self.slots
             self._unfetched = []
@@ -433,6 +599,12 @@ class ContinuousEngine:
             lengths_sharding=vec, quantize=self.kv_quantize,
             kv_scale_sharding=kv_s)
         self._last = jnp.zeros((self.slots,), jnp.int32, device=vec)
+        self._d_cache = None
+        if self.draft_cfg is not None:
+            self._d_cache = gen_lib.init_cache(
+                self.draft_cfg, self.slots, self.max_len, kv_sharding=kv,
+                lengths_sharding=vec, quantize=self.kv_quantize,
+                kv_scale_sharding=kv_s)
         self._prefix_pool = None
         if self.prefix_slots > 0:
             self._prefix_pool = gen_lib.init_cache(
@@ -482,7 +654,7 @@ class ContinuousEngine:
                        and len(self._prefilling) < 2
                        and len(self._pending[0].row) > self.prefill_chunk):
                     self._prefilling.append(
-                        [self._pending.popleft(), None, 0])
+                        _Prefilling(self._pending.popleft()))
                 if (self.prefill_chunk and self._pending
                         and len(self._pending[0].row) > self.prefill_chunk):
                     return  # long head waiting on prefill capacity
@@ -493,7 +665,7 @@ class ContinuousEngine:
                 # starve the long request forever (it holds a scratch
                 # cache row and blocks further long admissions while
                 # parked).
-                parked = sum(1 for e in self._prefilling if len(e) >= 5)
+                parked = sum(1 for e in self._prefilling if e.parked)
                 n = min(max(len(free) - parked, 0), len(self._pending),
                         self.prefill_batch)
                 if self.prefill_chunk:
@@ -563,24 +735,50 @@ class ContinuousEngine:
             self._prefix_index[key] = slot
             self.prefix_stores += 1
 
+    def _prefill_one_chunk(self, params, cfg, cache1, row, consumed):
+        """One bounded chunk of a single-row incremental prefill.
+        Returns (logits, cache, new_consumed). Pad width may not
+        overhang max_len: dynamic_update_slice CLAMPS out-of-range
+        starts, and a clamped padded tail would smear junk over REAL
+        prefix KV. Room always suffices: the prompt is < max_len
+        (submit validates row + max_new <= the engine limit)."""
+        w = min(self.prefill_chunk, self.max_len - consumed)
+        chunk = row[consumed:consumed + w]
+        padded = np.zeros((1, w), np.int32)
+        padded[0, :len(chunk)] = chunk
+        logits, cache1 = gen_lib._jit_prefill(  # noqa: SLF001 — same pkg
+            params, jnp.asarray(padded), cache1, cfg,
+            jnp.asarray([len(chunk)], jnp.int32))
+        return logits, cache1, consumed + len(chunk)
+
     def _advance_prefill(self) -> None:
-        """Advance the oldest in-flight long prefill by ONE chunk (the
-        per-iteration budget that bounds how long active slots wait
-        between decode chunks). On the final chunk: sample the first
-        token, insert into a free slot (or park until one frees)."""
+        """Advance the oldest in-flight long prefill by ONE chunk per
+        model (the per-iteration budget that bounds how long active
+        slots wait between decode chunks). On the target's final chunk:
+        sample the first token; insert once the draft cache (spec mode)
+        has caught up and a slot frees."""
         if not self._prefilling:
             return
         entry = self._prefilling[0]
-        req, cache1, consumed = entry[0], entry[1], entry[2]
+        req = entry.req
         n = len(req.row)
-        if consumed >= n:
+        spec = self.draft_cfg is not None
+        # Draft advances first: it starts at 0 even when the target got
+        # a prefix-pool head start (the pool stores TARGET KV only), and
+        # a parked target must not stall the draft's remaining chunks.
+        if spec and entry.cache is not None and entry.d_consumed < n:
+            _, entry.d_cache, entry.d_consumed = self._prefill_one_chunk(
+                self.draft_params, self.draft_cfg, entry.d_cache,
+                req.row, entry.d_consumed)
+            self.prefill_chunks += 1
+        if entry.parked:
             self._finish_long_prefill(entry)
             return
-        if cache1 is None:
+        if entry.cache is None:
             # First chunk: seed from the prefix pool when the prompt's
             # head is cached — long popular prompts (system preambles)
             # are where prefix reuse pays most.
-            p_hit = 0
+            cache1, p_hit = None, 0
             if self._prefix_pool is not None:
                 p_hit, pool_row = self._match_prefix(req.row)
                 if p_hit:
@@ -593,43 +791,39 @@ class ContinuousEngine:
             if cache1 is None:
                 cache1 = gen_lib.init_cache(self.cfg, 1, self.max_len,
                                             quantize=self.kv_quantize)
-            entry[1], entry[2] = cache1, p_hit
-            consumed = p_hit
-        c = self.prefill_chunk
-        # Pad width may not overhang max_len: dynamic_update_slice CLAMPS
-        # out-of-range starts, and a clamped padded tail would smear
-        # junk over REAL prefix KV. Room always suffices: the prompt is
-        # < max_len (submit validates row + max_new <= max_len).
-        w = min(c, self.max_len - consumed)
-        chunk = req.row[consumed:consumed + w]
-        padded = np.zeros((1, w), np.int32)
-        padded[0, :len(chunk)] = chunk
-        logits, cache1 = gen_lib._jit_prefill(  # noqa: SLF001 — same pkg
-            self.params, jnp.asarray(padded), cache1, self.cfg,
-            jnp.asarray([len(chunk)], jnp.int32))
-        entry[1] = cache1
-        entry[2] = consumed + len(chunk)
+            entry.cache, entry.consumed = cache1, p_hit
+            if spec:
+                entry.d_cache = gen_lib.init_cache(
+                    self.draft_cfg, 1, self.max_len,
+                    quantize=self.kv_quantize)
+        logits, entry.cache, entry.consumed = self._prefill_one_chunk(
+            self.params, self.cfg, entry.cache, req.row, entry.consumed)
         self.prefill_chunks += 1
-        if entry[2] >= n:
+        if entry.consumed >= n:
             if self._prefix_pool is not None:
                 # Store this prompt's bucket prefix on its second
-                # sighting, like the grouped path (cache1 row 0 holds
+                # sighting, like the grouped path (cache row 0 holds
                 # the full prompt's KV).
-                self._maybe_store_prefixes([req.row], [0], cache1)
+                self._maybe_store_prefixes([req.row], [0], entry.cache)
             # Sample the first token ONCE off the final chunk's logits;
-            # the entry may then park for a free slot.
+            # the entry may then park for a free slot (or, spec mode,
+            # for the draft's remaining chunks).
             first = _jit_sample(
                 logits, jnp.asarray([req.temperature], jnp.float32),
                 self._next_key(),
                 *_filters_or_none(np.asarray([req.top_k], np.int32),
                                   np.asarray([req.top_p], np.float32)))
-            entry.extend([first, int(jax.device_get(first)[0])])
+            entry.first = first
+            entry.first_host = int(jax.device_get(first)[0])
             self._finish_long_prefill(entry)
 
-    def _finish_long_prefill(self, entry) -> None:
-        req, cache1, _, first, first_host = entry
+    def _finish_long_prefill(self, entry: _Prefilling) -> None:
+        req = entry.req
+        if self.draft_cfg is not None and entry.d_consumed < len(req.row):
+            return  # draft cache still catching up; retried next iter
         done = (req.max_new == 1
-                or gen_lib.truncate_at_stop([first_host], req.eos)[1])
+                or gen_lib.truncate_at_stop([entry.first_host],
+                                            req.eos)[1])
         slot = None
         with self._lock:
             if not done:
@@ -641,17 +835,21 @@ class ContinuousEngine:
                 self._slot_req[slot] = req
         self._prefilling.pop(0)
         self.prefills += 1
-        req.tokens.append(first_host)
+        req.tokens.append(entry.first_host)
         self.tokens_emitted += 1
         if req.on_tokens is not None:
-            self._fire_callbacks([(req, [first_host])])
+            self._fire_callbacks([(req, [entry.first_host])])
         if done:
             if not req.future.done():
                 req.future.set_result(req.tokens)
             return
         self._cache, self._last = _jit_insert(
-            self._cache, self._last, cache1, first,
+            self._cache, self._last, entry.cache, entry.first,
             jnp.asarray([slot], jnp.int32))
+        if self.draft_cfg is not None:
+            self._d_cache = _jit_insert_cache(
+                self._d_cache, entry.d_cache,
+                jnp.asarray([slot], jnp.int32))
 
     def _prefill_group(self, reqs: List[_Request],
                        slots: List[int]) -> None:
@@ -717,6 +915,25 @@ class ContinuousEngine:
         self._cache, self._last = _jit_insert(
             self._cache, self._last, cache_n, firsts,
             jnp.asarray(slots, jnp.int32))
+        if self.draft_cfg is not None:
+            # The draft tracks the same committed stream, so its cache
+            # prefills the FULL rows (the prefix pool stores target KV
+            # only — the draft model is small enough that re-prefilling
+            # a cached head costs little).
+            width_f = min(prompt_bucket(max(len(r) for r in rows)),
+                          self.max_len)
+            padded_f = np.zeros((n, width_f), np.int32)
+            lens_f = np.zeros((n,), np.int32)
+            for i, r in enumerate(rows):
+                padded_f[i, :len(r)] = r
+                lens_f[i] = len(r)
+            d_cache_n = gen_lib.init_cache(self.draft_cfg, n, width_f,
+                                           quantize=self.kv_quantize)
+            _, d_cache_n = gen_lib._jit_prefill(  # noqa: SLF001
+                self.draft_params, jnp.asarray(padded_f), d_cache_n,
+                self.draft_cfg, jnp.asarray(lens_f))
+            self._d_cache = _jit_insert_cache(
+                self._d_cache, d_cache_n, jnp.asarray(slots, jnp.int32))
         self.prefills += n
         self.prefill_groups += 1
         with self._lock:
@@ -754,6 +971,95 @@ class ContinuousEngine:
                                 if r is req:
                                     self._slot_req[si] = None
                                     break
+        self._fire_callbacks(emitted)
+        for req in done:
+            if not req.future.done():
+                req.future.set_result(req.tokens)
+
+    def _run_spec_round(self) -> None:
+        """One draft-propose / target-verify round over all slots (spec
+        mode's decode step; see module docstring). Greedy slots commit
+        their accepted prefix + the target's correction; sampled slots
+        commit one token drawn from the verify's position-0 logits;
+        junk slots commit one target token (mimicking a decode step).
+        Both caches then roll back per row to their committed lengths."""
+        with self._lock:
+            reqs = list(self._slot_req)
+        k = self.spec_k
+        temps = np.zeros((self.slots,), np.float32)
+        top_ks = np.zeros((self.slots,), np.int32)
+        top_ps = np.ones((self.slots,), np.float32)
+        active = np.zeros((self.slots,), bool)
+        for i, r in enumerate(reqs):
+            if r is not None:
+                temps[i] = r.temperature
+                top_ks[i] = r.top_k
+                top_ps[i] = r.top_p
+                active[i] = True
+        self.peak_active = max(self.peak_active, int(active.sum()))
+        tk, tp = _filters_or_none(top_ks, top_ps)
+        t_cache, d_cache, props, tgt, samp = _jit_spec(
+            self.cfg, self.draft_cfg, k, self.params, self.draft_params,
+            self._cache, self._d_cache, self._last, jnp.asarray(temps),
+            tk, tp, jnp.asarray(active), self._next_key())
+        # Fetch deferred first tokens while the round runs on-device —
+        # emission counts on every admitted request's token list already
+        # holding its prefill token.
+        self._drain_firsts()
+        props_h = np.asarray(jax.device_get(props))  # [B, k+1]
+        tgt_h = np.asarray(jax.device_get(tgt))      # [B, k+1]
+        samp_h = np.asarray(jax.device_get(samp))    # [B]
+        self.spec_rounds += 1
+        self.chunks_run += 1
+        committed = np.ones((self.slots,), np.int32)
+        new_last = tgt_h[:, 0].astype(np.int32).copy()  # junk-slot default
+        done: List[_Request] = []
+        emitted: List[tuple] = []
+        with self._lock:
+            for i, req in enumerate(reqs):
+                if req is None or self._slot_req[i] is not req \
+                        or req.future.done():
+                    continue  # junk slot (see _run_chunk's rationale)
+                if req.temperature == 0.0:
+                    a = 0
+                    while a < k and props_h[i, a] == tgt_h[i, a]:
+                        a += 1
+                    new = [int(t) for t in props_h[i, :a]]
+                    new.append(int(tgt_h[i, a]))
+                    self.spec_proposals += k
+                    self.spec_accepted += a
+                    committed[i] = a + 1
+                    new_last[i] = int(tgt_h[i, a])
+                else:
+                    # Sampled rows: exactly one plain decode step per
+                    # round (greedy acceptance would skew the sampling
+                    # distribution; the verify's position-0 logits ARE
+                    # that step's logits).
+                    new = [int(samp_h[i])]
+                    committed[i] = 1
+                    new_last[i] = int(samp_h[i])
+                need = req.max_new - len(req.tokens)
+                new = new[:need]
+                new, hit_eos = gen_lib.truncate_at_stop(new, req.eos)
+                req.tokens.extend(new)
+                self.tokens_emitted += len(new)
+                if req.on_tokens is not None and new:
+                    emitted.append((req, new))
+                if hit_eos or len(req.tokens) >= req.max_new:
+                    self._slot_req[i] = None  # slot -> junk; committed
+                    done.append(req)          # value no longer matters
+        # Rollback: both models advanced exactly k+1; keep committed.
+        adj = np.int32(k + 1) - committed
+        if self.mesh is not None:
+            adj_dev = jax.device_put(jnp.asarray(adj), self._vec_sharding)
+            last_dev = jax.device_put(jnp.asarray(new_last),
+                                      self._vec_sharding)
+        else:
+            adj_dev = jnp.asarray(adj)
+            last_dev = jnp.asarray(new_last)
+        self._cache = _jit_rewind(t_cache, adj_dev)
+        self._d_cache = _jit_rewind(d_cache, adj_dev)
+        self._last = last_dev
         self._fire_callbacks(emitted)
         for req in done:
             if not req.future.done():

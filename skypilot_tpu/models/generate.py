@@ -395,19 +395,26 @@ def pad_prompts(rows, pad_id: int = 0) -> Tuple[jax.Array, jax.Array]:
     return jnp.asarray(out), jnp.asarray(lens, jnp.int32)
 
 
-def _decode_scan_impl(params, cache, first, key, cfg, n, temperature,
-                      top_k, top_p, uniform):
+def _decode_scan_impl(params, cache, first, key, cfg, n, temps,
+                      top_ks, top_ps, uniform):
+    """``temps`` [B] / ``top_ks`` [B] / ``top_ps`` [B] ride as DATA
+    (``top_ks``/``top_ps`` may be None = filters off, skipping the
+    vocab sort): client-supplied sampling params must not key the jit
+    cache, or every distinct (temperature, top_k, top_p) combination
+    costs a full XLA recompile — top_p alone has unbounded distinct
+    float values (r4 advisor low). Only the None/array pytree structure
+    gives a second cached variant (same scheme as the engine's
+    ``_chunk_impl``)."""
+    from skypilot_tpu.models import sampling
+
     def step(carry, _):
         cache, token, key = carry
         row_lens = (None if uniform
                     else jnp.ones((token.shape[0],), jnp.int32))
         logits, cache = forward_cached(params, token[:, None], cache, cfg,
                                        row_lens)
-        if temperature > 0.0:
-            key, sub = jax.random.split(key)
-        else:
-            sub = None
-        nxt = _sample(logits, temperature, sub, top_k, top_p)
+        key, sub = jax.random.split(key)
+        nxt = sampling.sample(logits, temps, sub, top_ks, top_ps)
         return (cache, nxt, key), nxt
 
     (_, _, _), toks = jax.lax.scan(step, (cache, first, key),
@@ -415,8 +422,7 @@ def _decode_scan_impl(params, cache, first, key, cfg, n, temperature,
     return toks
 
 
-_jit_decode_scan = jax.jit(_decode_scan_impl,
-                           static_argnums=(4, 5, 6, 7, 8, 9))
+_jit_decode_scan = jax.jit(_decode_scan_impl, static_argnums=(4, 5, 9))
 
 
 def generate(params: Params, cfg: llama.LlamaConfig,
@@ -457,7 +463,11 @@ def generate(params: Params, cfg: llama.LlamaConfig,
 
     if max_new_tokens == 1:
         return first[:, None]
-    rest = _jit_decode_scan(params, cache, first, key, cfg,
-                            max_new_tokens, temperature, top_k, top_p,
-                            prompt_lengths is None)  # [T-1, B]
+    filters_on = top_k > 0 or top_p < 1.0
+    rest = _jit_decode_scan(
+        params, cache, first, key, cfg, max_new_tokens,
+        jnp.full((b,), temperature, jnp.float32),
+        jnp.full((b,), top_k, jnp.int32) if filters_on else None,
+        jnp.full((b,), top_p, jnp.float32) if filters_on else None,
+        prompt_lengths is None)  # [T-1, B]
     return jnp.concatenate([first[:, None], rest.transpose(1, 0)], axis=1)

@@ -23,15 +23,25 @@ _NEG_INF = -1e30
 
 def filter_logits(logits: jax.Array, top_k: Optional[jax.Array],
                   top_p: Optional[jax.Array]) -> jax.Array:
-    """Mask ``logits`` [B, V] to each row's top-k ids and/or smallest
-    nucleus with cumulative probability >= top_p. ``top_k`` [B] int32
-    (0 = off); ``top_p`` [B] float (>= 1 = off). Returns filtered logits
-    (masked-out entries at -1e30)."""
+    """Mask ``logits`` [B, V] to each row's top-k ids, then to the
+    smallest nucleus with cumulative probability >= top_p. ``top_k``
+    [B] int32 (0 = off); ``top_p`` [B] float (>= 1 = off). Returns
+    filtered logits (masked-out entries at -1e30).
+
+    Warpers apply SEQUENTIALLY, matching HF/vLLM: when both are set,
+    the nucleus mass is computed over the RENORMALIZED top-k
+    distribution (softmax of the masked logits — masked entries carry
+    zero mass), not the full distribution, so (top_k, top_p) pairs
+    ported from those stacks keep the same candidate set (r4 advisor
+    low). Each filter alone is also identical to its HF counterpart."""
     if top_k is None and top_p is None:
         return logits  # fast path: no sort on the hot decode loop
     v = logits.shape[-1]
+    out = logits
+    # ONE full-vocab sort feeds both filters (it's the hot decode loop):
+    # after the top-k mask, the sorted view is the same array with rank
+    # positions >= k set to -inf — no re-sort needed.
     sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]  # desc
-    keep = jnp.ones_like(logits, dtype=bool)
     if top_k is not None:
         k = jnp.clip(top_k, 0, v)
         # Threshold = k-th largest logit per row; k=0 disables (-inf).
@@ -39,8 +49,14 @@ def filter_logits(logits: jax.Array, top_k: Optional[jax.Array],
         kth = jnp.take_along_axis(sorted_logits, idx[:, None],
                                   axis=-1)[:, 0]
         thr = jnp.where(k > 0, kth, -jnp.inf)
-        keep &= logits >= thr[:, None]
+        out = jnp.where(out >= thr[:, None], out, _NEG_INF)
+        ranks = jnp.arange(v, dtype=jnp.int32)[None, :]
+        sorted_logits = jnp.where(
+            (k[:, None] > 0) & (ranks >= k[:, None]), _NEG_INF,
+            sorted_logits)
     if top_p is not None:
+        # The (masked) sorted view's softmax: exp(-1e30) = 0, so this
+        # is the renormalized top-k distribution.
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
         # Nucleus: positions whose PRECEDING mass is < p (the first
@@ -49,8 +65,8 @@ def filter_logits(logits: jax.Array, top_k: Optional[jax.Array],
         nucleus_min = jnp.min(
             jnp.where(in_nucleus, sorted_logits, jnp.inf), axis=-1)
         thr_p = jnp.where(top_p < 1.0, nucleus_min, -jnp.inf)
-        keep &= logits >= thr_p[:, None]
-    return jnp.where(keep, logits, _NEG_INF)
+        out = jnp.where(out >= thr_p[:, None], out, _NEG_INF)
+    return out
 
 
 def sample(logits: jax.Array, temps: jax.Array, key: jax.Array,

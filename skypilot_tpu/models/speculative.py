@@ -86,6 +86,18 @@ def generate_speculative(target_params, target_cfg: llama.LlamaConfig,
     ``stats['acceptance_rate']`` is the fraction of draft proposals the
     target accepted (the speedup driver: committed tokens per verify is
     ``1 + k * acceptance_rate`` on average)."""
+    if target_cfg.num_experts > 0:
+        # MoE expert capacity is per forward CALL: the k+1-token verify
+        # routes (and drops) tokens differently than sequential 1-token
+        # decode, so the byte-identical greedy contract below would
+        # silently break — the same capacity-coupling reason the serving
+        # engine disables chunked prefill and the prefix pool for MoE
+        # (engine.py). Dense targets only; the draft may be anything
+        # (its output only changes speed, never correctness).
+        raise ValueError('speculative decoding requires a dense target '
+                         'model (MoE expert capacity is per forward '
+                         'call; a multi-token verify breaks greedy '
+                         'exactness)')
     if target_cfg.vocab_size != draft_cfg.vocab_size:
         raise ValueError('draft and target must share a vocabulary '
                          f'({draft_cfg.vocab_size} vs '

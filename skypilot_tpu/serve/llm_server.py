@@ -98,10 +98,12 @@ class LlmServer:
         if self.quantize and self.quantize != 'int8':
             raise ValueError(f'Unknown quantization {self.quantize!r}; '
                              "only 'int8' (weight-only) is supported")
-        # Speculative decoding (models/speculative.py) rides the
-        # window-batched path — it owns both models' caches per call.
-        # Greedy-only by construction; sampled requests keep the plain
-        # path.
+        # Speculative decoding: with the continuous engine the draft
+        # rides INSIDE it (per-slot propose/verify rounds,
+        # models/engine.py); with --engine off it rides the
+        # window-batched path (models/speculative.py). Greedy requests
+        # get the acceleration either way; sampled requests advance one
+        # verified token per round on the engine path.
         self.draft_model = (draft_model
                             or os.environ.get('SKYTPU_LLM_DRAFT') or None)
         engine = engine or os.environ.get('SKYTPU_LLM_ENGINE',
@@ -109,12 +111,6 @@ class LlmServer:
         if engine not in ('continuous', 'off'):
             raise ValueError(f"Unknown engine {engine!r}; 'continuous' "
                              "or 'off'")
-        if self.draft_model is not None and engine != 'off':
-            # The continuous engine absorbs unseeded traffic first, so
-            # the speculative window path would never run: the draft
-            # weights would sit inert in HBM with frozen counters.
-            raise ValueError('--draft-model requires --engine off (the '
-                             'speculative path rides window batching)')
         if prefix_cache is None:
             prefix_cache = int(os.environ.get('SKYTPU_LLM_PREFIX_CACHE',
                                               '0'))
@@ -127,6 +123,16 @@ class LlmServer:
             if self.draft_model not in llama.PRESETS:
                 raise ValueError(f'Unknown draft model '
                                  f'{self.draft_model!r}')
+            if self.cfg.num_experts > 0:
+                # MoE expert capacity is per forward CALL: the k+1-token
+                # verify routes (and drops) differently than sequential
+                # decode, so the documented byte-identical greedy
+                # contract would silently break (r4 advisor medium).
+                raise ValueError(
+                    '--draft-model requires a dense target model; '
+                    f'{model!r} is MoE (expert capacity is per forward '
+                    'call, so a multi-token verify breaks greedy '
+                    'exactness)')
             draft_cfg = llama.PRESETS[self.draft_model]
             if draft_cfg.vocab_size != self.cfg.vocab_size:
                 raise ValueError(
@@ -172,17 +178,6 @@ class LlmServer:
                     self.params, self.cfg, self.mesh)
             else:
                 self.params = quant_lib.quantize_params(self.params)
-        self.engine = None
-        if engine == 'continuous':
-            from skypilot_tpu.models.engine import ContinuousEngine
-            # params are already mesh-placed when tp > 1, so the engine's
-            # own shard_params is a no-op placement — both paths serve
-            # the SAME resident weights.
-            self.engine = ContinuousEngine(
-                self.params, self.cfg, max_len=self.max_len,
-                mesh=self.mesh, kv_quantize=self.kv_cache == 'int8',
-                prefix_slots=prefix_cache)
-            self.params = self.engine.params
         self.draft_cfg = None
         self.draft_params = None
         self._spec_stats = {'requests': 0, 'verifies': 0,
@@ -191,6 +186,22 @@ class LlmServer:
             self.draft_cfg = llama.PRESETS[self.draft_model]
             self.draft_params = llama.init_params(
                 jax.random.PRNGKey(seed + 1), self.draft_cfg)
+        self.engine = None
+        if engine == 'continuous':
+            from skypilot_tpu.models.engine import ContinuousEngine
+            # params are already mesh-placed when tp > 1, so the engine's
+            # own shard_params is a no-op placement — both paths serve
+            # the SAME resident weights. The draft (if any) rides inside
+            # the engine: per-slot propose/verify rounds.
+            self.engine = ContinuousEngine(
+                self.params, self.cfg, max_len=self.max_len,
+                mesh=self.mesh, kv_quantize=self.kv_cache == 'int8',
+                prefix_slots=prefix_cache,
+                draft_params=self.draft_params, draft_cfg=self.draft_cfg,
+                spec_k=self.spec_k)
+            self.params = self.engine.params
+            if self.draft_params is not None:
+                self.draft_params = self.engine.draft_params
         self._queue: asyncio.Queue = asyncio.Queue()
         self._overflow: List[_Pending] = []  # spilled past MAX_BATCH
         self._worker: Optional[asyncio.Task] = None
@@ -609,8 +620,11 @@ def main() -> None:
     async def _install_drain(app_):
         # GRACEFUL DRAIN (rolling updates / scale-down): on SIGTERM the
         # replica flips to draining — /health returns 503 so the LB
-        # stops routing here, new /generate requests are refused — and
-        # exits once in-flight requests finish (bounded by
+        # stops routing here. New /generate requests are still ACCEPTED
+        # until the LB's ready set refreshes off that 503 probe (the
+        # generate handler deliberately keeps serving; refusing would
+        # drop requests routed in the probe-interval window) — then the
+        # process exits once in-flight requests finish (bounded by
         # SKYTPU_LLM_DRAIN_S). A raw kill mid-generation would drop
         # requests the LB already routed.
         import signal

@@ -178,9 +178,13 @@ class ReplicaManager:
         if r.status_code < 500:
             body = r.text
             # Whole-or-nothing: truncating JSON mid-object would store
-            # text neither consumer can parse.
-            if len(body) <= 16384 and isinstance(body_json, dict):
-                health = body
+            # text neither consumer can parse. An unusable body
+            # (oversized / non-dict) CLEARS the stored snapshot (''),
+            # never leaves it (None = unchanged) — a frozen stale
+            # snapshot would surface as current engine stats in
+            # status/dashboard/metrics indefinitely (r4 advisor low).
+            health = (body if len(body) <= 16384
+                      and isinstance(body_json, dict) else '')
         elif isinstance(body_json, dict) and \
                 body_json.get('status') == 'draining':
             draining = True

@@ -480,7 +480,10 @@ def probe_backend(timeout_s: float = 90.0) -> Dict[str, Any]:
     timed_out = False
     detached = None
     try:
-        proc.wait(timeout=timeout_s)
+        # Remaining budget only: the prior-child wait loop may have
+        # consumed part of timeout_s, and each probe attempt is meant to
+        # bound at timeout_s total (bench's PROBE_TIMEOUTS contract).
+        proc.wait(timeout=max(timeout_s - (time.monotonic() - t0), 1.0))
         ok = proc.returncode == 0
     except subprocess.TimeoutExpired:
         ok = False

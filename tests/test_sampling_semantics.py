@@ -1,0 +1,98 @@
+"""Sampling-warper semantics + jit-cache discipline (r4 advisor lows).
+
+* top_k + top_p compose SEQUENTIALLY (top_p over the renormalized top-k
+  distribution), matching HF/vLLM — ported (k, p) pairs keep the same
+  candidate set.
+* Client-supplied sampling params ride as DATA on the window decode
+  path: distinct (temperature, top_k, top_p) values must not grow the
+  jit cache (top_p alone has unbounded distinct floats — a recompile
+  grinder).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu.models import generate as gen_lib
+from skypilot_tpu.models import llama, sampling
+
+
+def _kept(filtered):
+    return np.asarray(filtered[0] > -1e29)
+
+
+def test_top_p_composes_sequentially_over_top_k():
+    """Logits chosen so sequential and intersect-with-full semantics
+    differ: full-distribution nucleus(0.5) keeps {0, 1}, but over the
+    RENORMALIZED top-3 distribution token 0 alone carries > 0.5 mass,
+    so HF-sequential keeps only {0}."""
+    v = 32
+    logits = np.full((1, v), -2.0, np.float32)
+    logits[0, :3] = [2.0, 1.0, 0.5]
+    logits = jnp.asarray(logits)
+    k3 = jnp.asarray([3], jnp.int32)
+    p5 = jnp.asarray([0.5], jnp.float32)
+    # Sanity: each filter alone.
+    kept_k = _kept(sampling.filter_logits(logits, k3, None))
+    assert kept_k.sum() == 3 and kept_k[:3].all()
+    kept_p_full = _kept(sampling.filter_logits(logits, None, p5))
+    assert kept_p_full[0] and kept_p_full[1]  # full-dist nucleus: {0,1}
+    # Combined: sequential semantics keep ONLY token 0 (renormalized
+    # top-3 gives token 0 mass ~0.59 >= 0.5).
+    kept_seq = _kept(sampling.filter_logits(logits, k3, p5))
+    assert kept_seq[0] and kept_seq.sum() == 1, kept_seq[:4]
+
+
+def test_top_k_alone_unchanged_and_top_p_alone_unchanged():
+    key = jax.random.PRNGKey(0)
+    logits = jax.random.normal(key, (4, 64), jnp.float32)
+    k = jnp.asarray([5, 1, 0, 64], jnp.int32)
+    kept = np.asarray(sampling.filter_logits(logits, k, None) > -1e29)
+    assert kept[0].sum() == 5 and kept[1].sum() == 1
+    assert kept[2].all() and kept[3].all()  # k=0 off; k=V keeps all
+    p = jnp.asarray([1.0, 0.0001, 1.0, 0.9], jnp.float32)
+    keptp = np.asarray(sampling.filter_logits(logits, None, p) > -1e29)
+    assert keptp[0].all() and keptp[2].all()  # p>=1 off
+    assert keptp[1].sum() == 1  # tiny p: argmax only
+
+
+def test_window_decode_params_do_not_grow_jit_cache():
+    cfg = llama.TINY
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.asarray([[5, 6, 7]], jnp.int32)
+    key = jax.random.PRNGKey(1)
+
+    def run(t, k, p):
+        return np.asarray(gen_lib.generate(
+            params, cfg, prompt, 4, temperature=t, key=key, max_len=32,
+            top_k=k, top_p=p))
+
+    run(0.7, 5, 0.9)
+    size_after_first = gen_lib._jit_decode_scan._cache_size()
+    # Distinct temperature/top_k/top_p values: data, not jit keys.
+    run(1.3, 9, 0.73)
+    run(0.21, 17, 0.5104)
+    assert gen_lib._jit_decode_scan._cache_size() == size_after_first
+    # Greedy (filters off) is the one legitimate second variant
+    # (None/array pytree structure).
+    run(0.0, 0, 1.0)
+    assert gen_lib._jit_decode_scan._cache_size() <= size_after_first + 1
+
+
+def test_seeded_generation_still_deterministic():
+    cfg = llama.TINY
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.asarray([[5, 6, 7]], jnp.int32)
+    a = np.asarray(gen_lib.generate(params, cfg, prompt, 6,
+                                    temperature=0.9,
+                                    key=jax.random.PRNGKey(42),
+                                    max_len=32, top_k=8))
+    b = np.asarray(gen_lib.generate(params, cfg, prompt, 6,
+                                    temperature=0.9,
+                                    key=jax.random.PRNGKey(42),
+                                    max_len=32, top_k=8))
+    assert (a == b).all()
+    c = np.asarray(gen_lib.generate(params, cfg, prompt, 6,
+                                    temperature=0.9,
+                                    key=jax.random.PRNGKey(7),
+                                    max_len=32, top_k=8))
+    assert a.shape == c.shape

@@ -616,3 +616,51 @@ def test_probe_classifies_draining_replica():
         assert (ok, health, draining) == (False, None, False)
     finally:
         srv.shutdown()
+
+
+def test_probe_unusable_ready_body_clears_health_snapshot():
+    """A READY probe whose body is oversized or non-dict must return
+    health='' (CLEAR the stored snapshot), not None (leave unchanged) —
+    a frozen stale snapshot would surface as current engine stats
+    forever (r4 advisor low)."""
+    import http.server
+    import threading
+    import types
+
+    from skypilot_tpu.serve import replica_managers
+    from skypilot_tpu.utils import common_utils
+
+    port = common_utils.find_free_port(22300)
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            if 'big' in self.path:
+                body = b'{"pad": "' + b'x' * 20000 + b'"}'  # oversized
+            elif 'list' in self.path:
+                body = b'[1, 2, 3]'  # non-dict JSON
+            else:
+                body = b'{"status": "ok"}'
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(('127.0.0.1', port), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        mgr = replica_managers.ReplicaManager.__new__(
+            replica_managers.ReplicaManager)
+        mgr.spec = types.SimpleNamespace(readiness_probe=types.
+            SimpleNamespace(path='/big', timeout_seconds=5))
+        ok, health, _ = mgr._probe(f'127.0.0.1:{port}')
+        assert ok and health == ''
+        mgr.spec.readiness_probe.path = '/list'
+        ok, health, _ = mgr._probe(f'127.0.0.1:{port}')
+        assert ok and health == ''
+        mgr.spec.readiness_probe.path = '/health'
+        ok, health, _ = mgr._probe(f'127.0.0.1:{port}')
+        assert ok and health == '{"status": "ok"}'
+    finally:
+        srv.shutdown()
