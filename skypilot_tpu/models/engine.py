@@ -196,19 +196,43 @@ _jit_store_prefix = jax.jit(_store_prefix_impl, static_argnums=(4,),
 _jit_sample = jax.jit(sampling.sample)
 
 
+def _paged_chunk_impl(cfg: llama.LlamaConfig, k_steps: int, params,
+                      cache, last: jax.Array, temps: jax.Array,
+                      top_ks, top_ps, active: jax.Array, key: jax.Array):
+    """K decode steps over the PAGED pool (models/paged.py): the
+    structural twin of ``_chunk_impl`` with block scatter/gather
+    replacing the dense row update."""
+    from skypilot_tpu.models import paged as paged_lib
+
+    def step(carry, key_t):
+        cache, last = carry
+        logits, cache = paged_lib.forward_paged(params, last[:, None],
+                                                cache, cfg, active)
+        nxt = sampling.sample(logits, temps, key_t, top_ks, top_ps)
+        return (cache, nxt), nxt
+
+    keys = jax.random.split(key, k_steps)
+    (cache, last), toks = jax.lax.scan(step, (cache, last), keys)
+    return cache, last, toks
+
+
+_jit_paged_chunk = jax.jit(_paged_chunk_impl, static_argnums=(0, 1),
+                           donate_argnums=(3, 4))
+
+
 def _filters_or_none(top_ks: np.ndarray, top_ps: np.ndarray):
     """None when every row's filters are off — filter_logits then skips
     the full-vocab sort on the hot decode loop entirely (the None/array
     pytree difference gives two cached jit variants)."""
     if bool(top_ks.any()) or bool((top_ps < 1.0).any()):
-        return jnp.asarray(top_ks), jnp.asarray(top_ps)
+        return np.asarray(top_ks), np.asarray(top_ps)
     return None, None
 
 
 def _chunk_impl(cfg: llama.LlamaConfig, k_steps: int, params,
                 cache: gen_lib.KVCache, last: jax.Array,
                 temps: jax.Array, top_ks: jax.Array, top_ps: jax.Array,
-                active: jax.Array, key: jax.Array):
+                active: jax.Array, key: jax.Array, shard_ctx=None):
     """K decode steps over ALL slots: returns (cache, last, toks[K, B]).
     Per-slot sampling params ride as data (temps 0 = greedy, top_ks 0 /
     top_ps 1 = filters off) — no recompile per request mix."""
@@ -219,7 +243,8 @@ def _chunk_impl(cfg: llama.LlamaConfig, k_steps: int, params,
         cache, last = carry
         logits, cache = gen_lib.forward_cached(params, last[:, None],
                                                cache, cfg, row_lens,
-                                               active)
+                                               active,
+                                               shard_ctx=shard_ctx)
         nxt = sampling.sample(logits, temps, key_t, top_ks, top_ps)
         return (cache, nxt), nxt
 
@@ -228,7 +253,7 @@ def _chunk_impl(cfg: llama.LlamaConfig, k_steps: int, params,
     return cache, last, toks
 
 
-_jit_chunk = jax.jit(_chunk_impl, static_argnums=(0, 1),
+_jit_chunk = jax.jit(_chunk_impl, static_argnums=(0, 1, 10),
                      donate_argnums=(3, 4))
 
 
@@ -267,7 +292,7 @@ def _spec_impl(t_cfg: llama.LlamaConfig, d_cfg: llama.LlamaConfig,
                k: int, t_params, d_params, t_cache: gen_lib.KVCache,
                d_cache: gen_lib.KVCache, last: jax.Array,
                temps: jax.Array, top_ks, top_ps, active: jax.Array,
-               key: jax.Array):
+               key: jax.Array, shard_ctx=None):
     """One speculative round over ALL slots. Returns (t_cache, d_cache,
     props [B, k+1], tgt [B, k+1], samp [B]) with BOTH caches advanced
     k+1 positions (the host rolls back per row by rewriting lengths).
@@ -285,7 +310,8 @@ def _spec_impl(t_cfg: llama.LlamaConfig, d_cfg: llama.LlamaConfig,
     def dstep(carry, _):
         dc, tok = carry
         logits, dc = gen_lib.forward_cached(d_params, tok[:, None], dc,
-                                            d_cfg, ones, active)
+                                            d_cfg, ones, active,
+                                            shard_ctx=shard_ctx)
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return (dc, nxt), nxt
 
@@ -302,7 +328,7 @@ def _spec_impl(t_cfg: llama.LlamaConfig, d_cfg: llama.LlamaConfig,
     return t_cache, d_cache, props, tgt, samp
 
 
-_jit_spec = jax.jit(_spec_impl, static_argnums=(0, 1, 2),
+_jit_spec = jax.jit(_spec_impl, static_argnums=(0, 1, 2, 13),
                     donate_argnums=(5, 6))
 
 
@@ -321,7 +347,10 @@ class ContinuousEngine:
                  prefill_chunk: Optional[int] = None,
                  draft_params=None,
                  draft_cfg: Optional[llama.LlamaConfig] = None,
-                 spec_k: Optional[int] = None):
+                 spec_k: Optional[int] = None,
+                 kv_layout: Optional[str] = None,
+                 kv_blocks: Optional[int] = None,
+                 kv_block: Optional[int] = None):
         self.params = params
         self.cfg = cfg
         # Speculative mode (see module docstring): draft proposes,
@@ -359,6 +388,31 @@ class ContinuousEngine:
         if kv_quantize is None:
             kv_quantize = os.environ.get('SKYTPU_LLM_KV_CACHE') == 'int8'
         self.kv_quantize = bool(kv_quantize)
+        # KV layout: 'slot' pins one [max_len] cache row per slot (the
+        # default; zero gather cost); 'paged' shares fixed-size blocks
+        # from a pool sized below slots*max_len (models/paged.py — the
+        # vLLM-style memory innovation, r4 verdict Next #3). Requests
+        # reserve ceil((prompt+max_new)/block) blocks at admission and
+        # QUEUE when the pool is exhausted (natural backpressure).
+        self.kv_layout = (kv_layout
+                          or os.environ.get('SKYTPU_LLM_KV_LAYOUT')
+                          or 'slot')
+        if self.kv_layout not in ('slot', 'paged'):
+            raise ValueError(f'Unknown kv_layout {self.kv_layout!r}; '
+                             "'slot' or 'paged'")
+        self.kv_block = kv_block or int(
+            os.environ.get('SKYTPU_LLM_KV_BLOCK', '16'))
+        if self.kv_layout == 'paged':
+            if draft_cfg is not None:
+                raise ValueError('kv_layout=paged does not compose with '
+                                 'speculative decoding yet (the verify '
+                                 'window needs multi-token block '
+                                 'writes); use kv_layout=slot')
+            if mesh is not None:
+                raise ValueError('kv_layout=paged is single-device for '
+                                 'now (the block pool carries no '
+                                 'sharding rule); use kv_layout=slot '
+                                 'for sharded serving')
         # Chunked prefill (opt-in): prompts longer than this advance in
         # prefill_chunk-token pieces interleaved with decode chunks, so
         # long admissions don't stall every active slot's stream. Each
@@ -392,6 +446,11 @@ class ContinuousEngine:
         # dense models, where rows are independent.
         if cfg.num_experts > 0:
             self.prefix_slots = 0
+        if self.kv_layout == 'paged':
+            # The prefix pool stores dense max_len rows; composing it
+            # with block tables is future work (compat matrix,
+            # docs/serving.md).
+            self.prefix_slots = 0
         self.prefix_min = 16  # smallest cacheable/matchable prefix
         self._prefix_index: 'collections.OrderedDict[tuple, int]' = \
             collections.OrderedDict()  # prefix tokens -> pool row
@@ -404,11 +463,7 @@ class ContinuousEngine:
         # then compiles to an SPMD program — XLA inserts the collectives.
         self.mesh = mesh
         self.rules = rules
-        if mesh is not None and gen_lib._DECODE_KERNEL_ENABLED:
-            raise ValueError(
-                'SKYTPU_DECODE_KERNEL=pallas is single-device (the '
-                'kernel carries no sharding rule); unset it for '
-                'sharded serving')
+        self._shard_ctx = None
         if mesh is not None:
             from skypilot_tpu.models import quantization as quant_lib
             from skypilot_tpu.parallel import sharding as sharding_lib
@@ -427,6 +482,17 @@ class ContinuousEngine:
                 mesh, self.rules, ('layers', 'batch', 'kv_heads', None))
             self._vec_sharding = sharding_lib.logical_sharding(
                 mesh, self.rules, ('batch',))
+            if gen_lib._DECODE_KERNEL_ENABLED:
+                # The pallas decode kernel runs per head shard under TP
+                # via shard_map (generate.kernel_shard_ctx) — no gate.
+                self._shard_ctx = gen_lib.kernel_shard_ctx(mesh,
+                                                           self.rules)
+        if self.kv_layout == 'paged':
+            # Pool size (INCLUDING the junk-sink block 0): default is
+            # full capacity — no saving, always safe; deployments size
+            # it down (that's the point) and admission backpressures.
+            self.kv_blocks = kv_blocks or (
+                self.slots * (self.max_len // self.kv_block) + 1)
         # Spec mode reserves window overhang below max_len: a verify may
         # write k+1 positions past the last committed one before its
         # tail rolls back, and a clamped out-of-range write would smear
@@ -464,6 +530,18 @@ class ContinuousEngine:
                temperature: float = 0.0, on_tokens=None,
                top_k: int = 0, top_p: float = 1.0,
                eos=None) -> concurrent.futures.Future:
+        req = self._build_request(row, max_new, temperature, on_tokens,
+                                  top_k, top_p, eos)
+        with self._lock:
+            self._pending.append(req)
+        self.start()  # idempotent; revives a stop()ped engine
+        self._wake.set()
+        return req.future
+
+    def _build_request(self, row, max_new, temperature, on_tokens,
+                       top_k, top_p, eos) -> _Request:
+        """Validation + construction shared by submit() and the SPMD
+        engine's collective-arrival path (serve/spmd.py)."""
         if len(row) + max_new > self._submit_max:
             extra = ('' if self._submit_max == self.max_len else
                      f' (max_len {self.max_len} minus the speculative '
@@ -479,14 +557,18 @@ class ContinuousEngine:
             # (the HTTP layer already normalizes; don't re-build)
             eos = frozenset([eos] if isinstance(eos, int) else
                             (int(t) for t in eos))
-        req = _Request(list(row), max_new, float(temperature),
-                       concurrent.futures.Future(), on_tokens=on_tokens,
-                       top_k=int(top_k), top_p=float(top_p), eos=eos)
-        with self._lock:
-            self._pending.append(req)
-        self.start()  # idempotent; revives a stop()ped engine
-        self._wake.set()
-        return req.future
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        # Engine futures are UNCANCELLABLE (state RUNNING from birth): a
+        # client disconnect cancelling a PENDING future would flip it
+        # done, making the emission loop skip the slot forever (slot +
+        # paged-block leak) — and on a multi-host replica only the
+        # head's future would cancel, desynchronizing the ranks'
+        # slot state (review finding). The request simply runs to
+        # completion with nobody reading the result.
+        fut.set_running_or_notify_cancel()
+        return _Request(list(row), max_new, float(temperature), fut,
+                        on_tokens=on_tokens, top_k=int(top_k),
+                        top_p=float(top_p), eos=eos)
 
     def start(self) -> None:
         # Under the lock: two first-submitters racing here must not both
@@ -512,6 +594,10 @@ class ContinuousEngine:
             queued = len(self._pending)
         return {'slots': self.slots, 'active_slots': active,
                 'kv_cache': 'int8' if self.kv_quantize else 'bf16',
+                'kv_layout': self.kv_layout,
+                'kv_blocks': (None if self.kv_layout != 'paged' else {
+                    'total': self.kv_blocks, 'block': self.kv_block,
+                    'free': len(self._free_blocks)}),
                 'queued': queued, 'prefills': self.prefills,
                 'prefill_groups': self.prefill_groups,
                 'prefill_batch': self.prefill_batch,
@@ -594,10 +680,22 @@ class ContinuousEngine:
         kv = self._kv_sharding if self.mesh is not None else None
         kv_s = self._kv_scale_sharding if self.mesh is not None else None
         vec = self._vec_sharding if self.mesh is not None else None
-        self._cache = gen_lib.init_cache(
-            self.cfg, self.slots, self.max_len, kv_sharding=kv,
-            lengths_sharding=vec, quantize=self.kv_quantize,
-            kv_scale_sharding=kv_s)
+        if self.kv_layout == 'paged':
+            from skypilot_tpu.models import paged as paged_lib
+            self._cache = paged_lib.init_pool(
+                self.cfg, self.slots, self.max_len, self.kv_blocks,
+                self.kv_block, quantize=self.kv_quantize)
+            # Host-side accounting: block 0 is the junk sink, never
+            # allocated; per-slot block lists return to the free list
+            # when the slot's request completes.
+            self._free_blocks = list(range(1, self.kv_blocks))
+            self._slot_blocks: List[List[int]] = [
+                [] for _ in range(self.slots)]
+        else:
+            self._cache = gen_lib.init_cache(
+                self.cfg, self.slots, self.max_len, kv_sharding=kv,
+                lengths_sharding=vec, quantize=self.kv_quantize,
+                kv_scale_sharding=kv_s)
         self._last = jnp.zeros((self.slots,), jnp.int32, device=vec)
         self._d_cache = None
         if self.draft_cfg is not None:
@@ -614,6 +712,16 @@ class ContinuousEngine:
         self._prefix_index.clear()
         self._prefix_seen.clear()
         self._prefix_free = list(range(self.prefix_slots))
+
+    def _blocks_needed(self, req: _Request) -> int:
+        """Blocks reserved at admission: the request's actual ask, not
+        max_len — the paged layout's whole point."""
+        return -(-(len(req.row) + req.max_new) // self.kv_block)
+
+    def _release_blocks(self, slot: int) -> None:
+        if self.kv_layout == 'paged':
+            self._free_blocks.extend(self._slot_blocks[slot])
+            self._slot_blocks[slot] = []
 
     @staticmethod
     def _fire_callbacks(emitted: List[tuple]) -> None:
@@ -674,6 +782,21 @@ class ContinuousEngine:
                     for p in self._pending:
                         if len(p.row) > self.prefill_chunk or run >= n:
                             break
+                        run += 1
+                    n = run
+                if self.kv_layout == 'paged':
+                    # Backpressure: admit only requests whose block
+                    # reservation fits the free pool; the rest queue.
+                    avail = len(self._free_blocks)
+                    run = 0
+                    for p in self._pending:
+                        if run >= n:
+                            break
+                        nb = (self._blocks_needed(p)
+                              if p.max_new > 1 else 0)
+                        if nb > avail:
+                            break
+                        avail -= nb
                         run += 1
                     n = run
                 if n == 0:
@@ -747,8 +870,8 @@ class ContinuousEngine:
         padded = np.zeros((1, w), np.int32)
         padded[0, :len(chunk)] = chunk
         logits, cache1 = gen_lib._jit_prefill(  # noqa: SLF001 — same pkg
-            params, jnp.asarray(padded), cache1, cfg,
-            jnp.asarray([len(chunk)], jnp.int32))
+            params, padded, cache1, cfg,
+            np.asarray([len(chunk)], np.int32))
         return logits, cache1, consumed + len(chunk)
 
     def _advance_prefill(self) -> None:
@@ -784,8 +907,8 @@ class ContinuousEngine:
                 if p_hit:
                     cache1 = _jit_gather_prefix(
                         self._prefix_pool,
-                        jnp.asarray([pool_row], jnp.int32),
-                        jnp.asarray([p_hit], jnp.int32), self.max_len)
+                        np.asarray([pool_row], np.int32),
+                        np.asarray([p_hit], np.int32), self.max_len)
                     self.prefix_hits += 1
                     self.prefix_hit_tokens += p_hit
             if cache1 is None:
@@ -809,7 +932,7 @@ class ContinuousEngine:
             # the entry may then park for a free slot (or, spec mode,
             # for the draft's remaining chunks).
             first = _jit_sample(
-                logits, jnp.asarray([req.temperature], jnp.float32),
+                logits, np.asarray([req.temperature], np.float32),
                 self._next_key(),
                 *_filters_or_none(np.asarray([req.top_k], np.int32),
                                   np.asarray([req.top_p], np.float32)))
@@ -825,14 +948,25 @@ class ContinuousEngine:
                 or gen_lib.truncate_at_stop([entry.first_host],
                                             req.eos)[1])
         slot = None
+        table_row = None
         with self._lock:
             if not done:
                 free = [i for i, r in enumerate(self._slot_req)
                         if r is None]
                 if not free:
                     return  # park; retried next iteration
+                if self.kv_layout == 'paged':
+                    nb = self._blocks_needed(req)
+                    if len(self._free_blocks) < nb:
+                        return  # park until a completion frees blocks
+                    blocks = [self._free_blocks.pop() for _ in range(nb)]
+                    table_row = np.zeros(
+                        (self.max_len // self.kv_block,), np.int32)
+                    table_row[:nb] = blocks
                 slot = free[0]
                 self._slot_req[slot] = req
+                if table_row is not None:
+                    self._slot_blocks[slot] = list(table_row[:nb])
         self._prefilling.pop(0)
         self.prefills += 1
         req.tokens.append(entry.first_host)
@@ -843,9 +977,17 @@ class ContinuousEngine:
             if not req.future.done():
                 req.future.set_result(req.tokens)
             return
-        self._cache, self._last = _jit_insert(
-            self._cache, self._last, entry.cache, entry.first,
-            jnp.asarray([slot], jnp.int32))
+        if self.kv_layout == 'paged':
+            from skypilot_tpu.models import paged as paged_lib
+            self._cache = paged_lib.jit_insert(
+                self._cache, entry.cache, np.asarray(table_row[None]),
+                np.asarray([slot], np.int32))
+            self._last = self._last.at[
+                jnp.asarray([slot], jnp.int32)].set(entry.first)
+        else:
+            self._cache, self._last = _jit_insert(
+                self._cache, self._last, entry.cache, entry.first,
+                jnp.asarray([slot], jnp.int32))
         if self.draft_cfg is not None:
             self._d_cache = _jit_insert_cache(
                 self._d_cache, entry.d_cache,
@@ -892,29 +1034,48 @@ class ContinuousEngine:
         hits = sum(1 for p in p_lens if p)
         if self._prefix_pool is not None and hits:
             cache_n = _jit_gather_prefix(
-                self._prefix_pool, jnp.asarray(pool_rows, jnp.int32),
-                jnp.asarray(p_lens, jnp.int32), cache_width)
+                self._prefix_pool, np.asarray(pool_rows, np.int32),
+                np.asarray(p_lens, np.int32), cache_width)
             self.prefix_hits += hits
             self.prefix_hit_tokens += sum(p_lens)
         else:
             cache_n = gen_lib.init_cache(self.cfg, n, cache_width,
                                          quantize=self.kv_quantize)
         logits, cache_n = gen_lib._jit_prefill(  # noqa: SLF001 — same pkg
-            self.params, jnp.asarray(padded), cache_n, self.cfg,
-            jnp.asarray(lens))
+            self.params, padded, cache_n, self.cfg,
+            np.asarray(lens))
         if self._prefix_pool is not None:
             self._maybe_store_prefixes(rows, p_lens, cache_n)
         tk, tp = _filters_or_none(top_ks, top_ps)
-        firsts = _jit_sample(logits, jnp.asarray(temps), self._next_key(),
+        firsts = _jit_sample(logits, np.asarray(temps), self._next_key(),
                              tk, tp)
         # Insert EVERY row (a single-token request's row becomes harmless
         # junk in a still-free slot). The first-token VALUES are fetched
         # lazily (``_drain_firsts``) — prefill+insert are then pure async
         # dispatches, and the fetch overlaps the next decode chunk's
         # device time instead of paying its own relay round trip.
-        self._cache, self._last = _jit_insert(
-            self._cache, self._last, cache_n, firsts,
-            jnp.asarray(slots, jnp.int32))
+        if self.kv_layout == 'paged':
+            from skypilot_tpu.models import paged as paged_lib
+            mb = self.max_len // self.kv_block
+            tables_host = np.zeros((n, mb), np.int32)
+            with self._lock:
+                for i, r in enumerate(reqs):
+                    if r.max_new <= 1:
+                        continue  # resolves at prefill: junk-sink row
+                    nb = self._blocks_needed(r)
+                    blocks = [self._free_blocks.pop()
+                              for _ in range(nb)]  # _admit reserved them
+                    self._slot_blocks[slots[i]] = blocks
+                    tables_host[i, :nb] = blocks
+            self._cache = paged_lib.jit_insert(
+                self._cache, cache_n, tables_host,
+                np.asarray(slots, np.int32))
+            self._last = self._last.at[
+                jnp.asarray(slots, jnp.int32)].set(firsts)
+        else:
+            self._cache, self._last = _jit_insert(
+                self._cache, self._last, cache_n, firsts,
+                jnp.asarray(slots, jnp.int32))
         if self.draft_cfg is not None:
             # The draft tracks the same committed stream, so its cache
             # prefills the FULL rows (the prefix pool stores target KV
@@ -930,10 +1091,10 @@ class ContinuousEngine:
             d_cache_n = gen_lib.init_cache(self.draft_cfg, n, width_f,
                                            quantize=self.kv_quantize)
             _, d_cache_n = gen_lib._jit_prefill(  # noqa: SLF001
-                self.draft_params, jnp.asarray(padded_f), d_cache_n,
-                self.draft_cfg, jnp.asarray(lens_f))
+                self.draft_params, padded_f, d_cache_n,
+                self.draft_cfg, lens_f)
             self._d_cache = _jit_insert_cache(
-                self._d_cache, d_cache_n, jnp.asarray(slots, jnp.int32))
+                self._d_cache, d_cache_n, np.asarray(slots, np.int32))
         self.prefills += n
         self.prefill_groups += 1
         with self._lock:
@@ -970,6 +1131,7 @@ class ContinuousEngine:
                             for si, r in enumerate(self._slot_req):
                                 if r is req:
                                     self._slot_req[si] = None
+                                    self._release_blocks(si)
                                     break
         self._fire_callbacks(emitted)
         for req in done:
@@ -1000,8 +1162,9 @@ class ContinuousEngine:
         tk, tp = _filters_or_none(top_ks, top_ps)
         t_cache, d_cache, props, tgt, samp = _jit_spec(
             self.cfg, self.draft_cfg, k, self.params, self.draft_params,
-            self._cache, self._d_cache, self._last, jnp.asarray(temps),
-            tk, tp, jnp.asarray(active), self._next_key())
+            self._cache, self._d_cache, self._last, np.asarray(temps),
+            tk, tp, np.asarray(active), self._next_key(),
+            self._shard_ctx)
         # Fetch deferred first tokens while the round runs on-device —
         # emission counts on every admitted request's token list already
         # holding its prefill token.
@@ -1080,10 +1243,16 @@ class ContinuousEngine:
                 active[i] = True
         self.peak_active = max(self.peak_active, int(active.sum()))
         tk, tp = _filters_or_none(top_ks, top_ps)
-        self._cache, self._last, toks = _jit_chunk(
-            self.cfg, self.chunk_steps, self.params, self._cache,
-            self._last, jnp.asarray(temps), tk, tp,
-            jnp.asarray(active), self._next_key())
+        if self.kv_layout == 'paged':
+            self._cache, self._last, toks = _jit_paged_chunk(
+                self.cfg, self.chunk_steps, self.params, self._cache,
+                self._last, np.asarray(temps), tk, tp,
+                np.asarray(active), self._next_key())
+        else:
+            self._cache, self._last, toks = _jit_chunk(
+                self.cfg, self.chunk_steps, self.params, self._cache,
+                self._last, np.asarray(temps), tk, tp,
+                np.asarray(active), self._next_key(), self._shard_ctx)
         # The chunk is dispatched (async); fetch deferred first tokens
         # while it runs on-device — emission below counts on every
         # admitted request's token list already holding its first token.
@@ -1115,6 +1284,7 @@ class ContinuousEngine:
                     emitted.append((req, new))
                 if hit_eos or len(req.tokens) >= req.max_new:
                     self._slot_req[i] = None
+                    self._release_blocks(i)
                     done.append(req)
         self._fire_callbacks(emitted)
         for req in done:

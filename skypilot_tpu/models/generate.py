@@ -39,6 +39,23 @@ def _use_decode_kernel() -> bool:
     return _DECODE_KERNEL_ENABLED
 
 
+def kernel_shard_ctx(mesh, rules):
+    """Hashable context that lets the pallas decode kernel run under a
+    TP mesh: ``shard_map`` launches the kernel per head SHARD — its grid
+    is (B, Hkv) with no cross-head communication, so head-sharded
+    inputs need no collectives and the output stays head-sharded for
+    the wo matmul (GSPMD inserts that psum as usual). Without this, a
+    ``pallas_call`` traced under GSPMD would all-gather the full
+    per-layer caches (r4 verdict Next #6's worst remaining ✗)."""
+    if mesh is None:
+        return None
+    return (mesh,
+            rules.mesh_axes(('batch', 'heads', None)),           # q
+            rules.mesh_axes(('batch', 'kv_heads', None, None)),  # k/v
+            rules.mesh_axes(('batch',)),                         # lengths
+            rules.mesh_axes(('batch', 'kv_heads', None)))        # scales
+
+
 @dataclasses.dataclass
 class KVCache:
     """Per-layer key/value ring buffers: [L, B, Hkv, max_len, D].
@@ -98,7 +115,8 @@ def init_cache(cfg: llama.LlamaConfig, batch: int, max_len: int,
 def _cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                       positions: jax.Array, valid_len: jax.Array,
                       k_s: Optional[jax.Array] = None,
-                      v_s: Optional[jax.Array] = None) -> jax.Array:
+                      v_s: Optional[jax.Array] = None,
+                      shard_ctx=None) -> jax.Array:
     """q: [B, S, Hq, D] (absolute ``positions`` [B, S]);
     k/v_cache: [B, Hkv, max_len, D] already containing this block's keys.
     Attends causally over the first ``valid_len[b]`` cache slots per row
@@ -119,9 +137,33 @@ def _cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
             lengths = (jnp.broadcast_to(valid_len, (b,)).astype(jnp.int32)
                        if valid_len.ndim == 0
                        else valid_len.astype(jnp.int32))
-            out = decode_attention.flash_decode(
-                q[:, 0], k_cache, v_cache, lengths, k_s, v_s,
-                interpret=not _use_pallas())
+            interp = not _use_pallas()
+            if shard_ctx is None:
+                out = decode_attention.flash_decode(
+                    q[:, 0], k_cache, v_cache, lengths, k_s, v_s,
+                    interpret=interp)
+            else:
+                # TP serving: run the kernel per head shard (see
+                # kernel_shard_ctx). check_rep off: the scalar-prefetch
+                # grid confuses the replication checker.
+                from jax.experimental.shard_map import shard_map
+                mesh, p_q, p_kv, p_len, p_s = shard_ctx
+                if k_s is None:
+                    out = shard_map(
+                        lambda q_, k_, v_, l_: decode_attention.
+                        flash_decode(q_, k_, v_, l_, interpret=interp),
+                        mesh=mesh, in_specs=(p_q, p_kv, p_kv, p_len),
+                        out_specs=p_q, check_rep=False)(
+                            q[:, 0], k_cache, v_cache, lengths)
+                else:
+                    out = shard_map(
+                        lambda q_, k_, v_, l_, ks_, vs_: decode_attention.
+                        flash_decode(q_, k_, v_, l_, ks_, vs_,
+                                     interpret=interp),
+                        mesh=mesh,
+                        in_specs=(p_q, p_kv, p_kv, p_len, p_s, p_s),
+                        out_specs=p_q, check_rep=False)(
+                            q[:, 0], k_cache, v_cache, lengths, k_s, v_s)
             return out[:, None].astype(q.dtype)
         # else: geometry the kernel can't take (VMEM cap / non-128
         # cache) — fall through to the einsum path.
@@ -205,21 +247,11 @@ def _write_block(cache_arr: jax.Array, scale_arr: Optional[jax.Array],
     return cache_arr, scale_arr
 
 
-def _cached_layer(cfg: llama.LlamaConfig, x: jax.Array, layer: Params,
-                  positions: jax.Array, k_cache: jax.Array,
-                  v_cache: jax.Array, cache_lens: jax.Array,
-                  valid: jax.Array,
-                  active_rows: Optional[jax.Array] = None,
-                  k_s: Optional[jax.Array] = None,
-                  v_s: Optional[jax.Array] = None):
-    """One decoder block writing this block's K/V into the cache.
-    x: [B, S, d]; k/v_cache: [B, Hkv, max_len, D]; ``cache_lens`` [B];
-    ``valid`` [B] = cache_lens + real new tokens per row (< S for padded
-    rows); ``active_rows`` [B] bool marks rows that are live requests —
-    the continuous-batching engine (``models/engine.py``) decodes its
-    FULL slot batch every step, and a freed slot's junk row must not
-    consume MoE expert capacity (attention is per-row, so only expert
-    routing couples rows); returns (x, k, v)."""
+def _qkv_proj(cfg: llama.LlamaConfig, x: jax.Array, layer: Params,
+              positions: jax.Array):
+    """Shared attention front half (norm + QKV projections + RoPE) —
+    one definition for the dense (slot-pinned) and paged layers; only
+    the cache write/read strategy differs between them."""
     h = llama.rms_norm(x, layer['attn_norm'], cfg.norm_eps)
     # _mm = einsum that transparently handles int8 weight-only
     # quantized leaves (models/quantization.py) — the serving
@@ -229,6 +261,44 @@ def _cached_layer(cfg: llama.LlamaConfig, x: jax.Array, layer: Params,
     v = _mm(h, layer['wv'], 'bsd,dhk->bshk')
     q = llama.rope(q, positions, cfg.rope_theta)
     k = llama.rope(k, positions, cfg.rope_theta)
+    return q, k, v
+
+
+def _mlp_tail(cfg: llama.LlamaConfig, x: jax.Array, layer: Params,
+              token_mask: Optional[jax.Array]):
+    """Shared decoder-block back half (post-attention norm + MoE or
+    dense MLP), residual included. ``token_mask`` [B, S] (MoE only)
+    keeps padded/junk positions out of expert routing."""
+    h = llama.rms_norm(x, layer['mlp_norm'], cfg.norm_eps)
+    if cfg.num_experts > 0:
+        mlp_out, _ = moe.moe_mlp(h, layer['moe'], cfg.num_experts,
+                                 cfg.expert_top_k,
+                                 cfg.expert_capacity_factor,
+                                 token_mask=token_mask)
+        return x + mlp_out
+    gate = _mm(h, layer['w_gate'], 'bsd,df->bsf')
+    up = _mm(h, layer['w_up'], 'bsd,df->bsf')
+    return x + _mm(jax.nn.silu(gate) * up, layer['w_down'],
+                   'bsf,fd->bsd')
+
+
+def _cached_layer(cfg: llama.LlamaConfig, x: jax.Array, layer: Params,
+                  positions: jax.Array, k_cache: jax.Array,
+                  v_cache: jax.Array, cache_lens: jax.Array,
+                  valid: jax.Array,
+                  active_rows: Optional[jax.Array] = None,
+                  k_s: Optional[jax.Array] = None,
+                  v_s: Optional[jax.Array] = None,
+                  shard_ctx=None):
+    """One decoder block writing this block's K/V into the cache.
+    x: [B, S, d]; k/v_cache: [B, Hkv, max_len, D]; ``cache_lens`` [B];
+    ``valid`` [B] = cache_lens + real new tokens per row (< S for padded
+    rows); ``active_rows`` [B] bool marks rows that are live requests —
+    the continuous-batching engine (``models/engine.py``) decodes its
+    FULL slot batch every step, and a freed slot's junk row must not
+    consume MoE expert capacity (attention is per-row, so only expert
+    routing couples rows); returns (x, k, v)."""
+    q, k, v = _qkv_proj(cfg, x, layer, positions)
     # Write the new keys/values at [start, start + S) (quantizing on the
     # way in for int8 caches). Short rows of a padded batch write junk
     # beyond their real length; it is never attended (valid mask) and
@@ -238,36 +308,25 @@ def _cached_layer(cfg: llama.LlamaConfig, x: jax.Array, layer: Params,
     k_cache, k_s = _write_block(k_cache, k_s, kt, cache_lens)
     v_cache, v_s = _write_block(v_cache, v_s, vt, cache_lens)
     att = _cached_attention(q, k_cache, v_cache, positions, valid,
-                            k_s, v_s)
+                            k_s, v_s, shard_ctx)
     x = x + _mm(att, layer['wo'], 'bshk,hkd->bsd')
-    h = llama.rms_norm(x, layer['mlp_norm'], cfg.norm_eps)
-    if cfg.num_experts > 0:
-        # MoE decode: same GShard dense-einsum dispatch as training
-        # (models/moe.py) — at S=1 the "token" dim is just the batch, and
-        # the static capacity keeps decode shapes compile-once. The aux
-        # loss is irrelevant at inference. Padded positions of a
-        # mixed-length batch are masked OUT of routing so their junk
-        # tokens never consume expert capacity (they could otherwise
-        # displace other rows' real tokens under the choice-major
-        # capacity cumsum).
-        if valid.ndim == 0 and active_rows is None:
-            token_mask = None  # uniform batch: every position is real
-        else:
-            vb = valid if valid.ndim == 0 else valid[:, None]
-            mask = positions < vb
-            if active_rows is not None:
-                mask = mask & active_rows[:, None]
-            token_mask = mask.astype(h.dtype)
-        mlp_out, _ = moe.moe_mlp(h, layer['moe'], cfg.num_experts,
-                                 cfg.expert_top_k,
-                                 cfg.expert_capacity_factor,
-                                 token_mask=token_mask)
-        x = x + mlp_out
+    # MoE decode: same GShard dense-einsum dispatch as training
+    # (models/moe.py) — at S=1 the "token" dim is just the batch, and
+    # the static capacity keeps decode shapes compile-once. The aux
+    # loss is irrelevant at inference. Padded positions of a
+    # mixed-length batch are masked OUT of routing so their junk
+    # tokens never consume expert capacity (they could otherwise
+    # displace other rows' real tokens under the choice-major
+    # capacity cumsum).
+    if valid.ndim == 0 and active_rows is None:
+        token_mask = None  # uniform batch: every position is real
     else:
-        gate = _mm(h, layer['w_gate'], 'bsd,df->bsf')
-        up = _mm(h, layer['w_up'], 'bsd,df->bsf')
-        x = x + _mm(jax.nn.silu(gate) * up, layer['w_down'],
-                    'bsf,fd->bsd')
+        vb = valid if valid.ndim == 0 else valid[:, None]
+        mask = positions < vb
+        if active_rows is not None:
+            mask = mask & active_rows[:, None]
+        token_mask = mask.astype(x.dtype)
+    x = _mlp_tail(cfg, x, layer, token_mask)
     return x, k_cache, v_cache, k_s, v_s
 
 
@@ -275,8 +334,8 @@ def forward_cached(params: Params, tokens: jax.Array,
                    cache: KVCache, cfg: llama.LlamaConfig,
                    row_lens: Optional[jax.Array] = None,
                    active_rows: Optional[jax.Array] = None,
-                   all_logits: bool = False
-                   ) -> Tuple[jax.Array, KVCache]:
+                   all_logits: bool = False,
+                   shard_ctx=None) -> Tuple[jax.Array, KVCache]:
     """Run ``tokens`` [B, S] through the model appending to ``cache``;
     returns (logits for each row's LAST REAL position [B, vocab], updated
     cache). Works for prefill (S = padded prompt length) and decode
@@ -315,7 +374,7 @@ def forward_cached(params: Params, tokens: jax.Array,
             ks_c = vs_c = None
         x, k_c, v_c, ks_c, vs_c = _cached_layer(
             cfg, x, layer, positions, k_c, v_c, write_start, valid,
-            active_rows, ks_c, vs_c)
+            active_rows, ks_c, vs_c, shard_ctx)
         ys = (k_c, v_c, ks_c, vs_c) if quantized else (k_c, v_c)
         return x, ys
 

@@ -83,10 +83,13 @@ MOE_TINY = dataclasses.replace(TINY, num_experts=4, expert_top_k=2)
 # 8x the MLP weight. Sized for a v5e-16 slice with expert parallelism
 # (examples/llm/moe-finetune/).
 MOE_8X1B = dataclasses.replace(BENCH_1B, num_experts=8, expert_top_k=2)
+# Multi-host serving test shape: 8 kv heads so the TP axis can span a
+# 2-host x 4-virtual-device CPU dryrun mesh (tests/test_serve_spmd.py).
+TINY_MH = dataclasses.replace(TINY, n_heads=8, n_kv_heads=8)
 
 PRESETS = {'llama3-8b': LLAMA3_8B, 'llama3-1b': LLAMA3_1B,
            'bench-1b': BENCH_1B, 'tiny': TINY, 'moe-tiny': MOE_TINY,
-           'moe-8x1b': MOE_8X1B}
+           'moe-8x1b': MOE_8X1B, 'tiny-mh': TINY_MH}
 
 
 # -- params -----------------------------------------------------------------

@@ -1,0 +1,269 @@
+"""Paged (block-table) KV cache for the continuous serving engine.
+
+Reference analog: paged attention is the defining memory innovation of
+the reference's serving workloads (``/root/reference/llm/vllm/`` — the
+vLLM recipes its TPU serving docs are built around). The slot-pinned
+engine cache (``models/engine.py``) reserves one full ``[max_len]``
+cache row per slot, so mixed-length traffic strands HBM in tail padding
+(a 64-token chat in a 4096-max_len slot wastes 98% of its row). Paged
+layout carves the cache into fixed-size position BLOCKS shared from one
+pool; each slot holds a small block table, requests reserve only
+``ceil((prompt + max_new) / block) `` blocks, and the pool can be sized
+well below ``slots × max_len`` — more concurrent slots at fixed HBM.
+
+TPU-first shape discipline (vs the GPU original's per-block kernels):
+
+* the pool is one static ``[L, NB, Hkv, P, D]`` buffer; block tables
+  are a ``[B, MB]`` int32 array — every shape is fixed at engine
+  construction, so decode remains ONE compiled program;
+* decode writes are per-row scatters ``pool.at[table[b, len//P], :,
+  len%P]``; the GATHER assembles each slot's blocks into the standard
+  ``[B, H, MB·P, D]`` attention view and reuses the engine's exact
+  attention math (``generate._cached_attention``) — attention reads the
+  whole cache from HBM either way, so the gather's cost is one extra
+  materialized copy per layer per step. Whether that copy or the
+  stranded padding costs more on TPU is the measured A/B question
+  (``docs/serving.md``);
+* unallocated table entries point at block 0, a dedicated JUNK SINK no
+  request ever owns: freed slots keep decoding (static shapes forbid
+  shrinking the batch) and their overflow writes land harmlessly there.
+
+Accounting (free list, per-slot block lists) is host-side in the
+engine — the device never sees an allocation decision, only tables.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.models.generate import (_cached_attention, _mlp_tail,
+                                          _qkv_proj, _quantize_block)
+from skypilot_tpu.models.quantization import mm as _mm
+
+
+@dataclasses.dataclass
+class PagedKVCache:
+    """Block pool + per-slot tables. ``k``/``v``: [L, NB, Hkv, P, D];
+    ``tables``: [B, MB] int32 block ids (0 = junk sink / unallocated);
+    ``lengths``: [B] tokens cached per slot. INT8 mode adds per-position
+    scales [L, NB, Hkv, P] (same recipe as the dense cache)."""
+    k: jax.Array
+    v: jax.Array
+    tables: jax.Array
+    lengths: jax.Array
+    k_s: Optional[jax.Array] = None
+    v_s: Optional[jax.Array] = None
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_s is not None
+
+    @property
+    def block(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def max_blocks(self) -> int:
+        return self.tables.shape[1]
+
+
+jax.tree_util.register_dataclass(
+    PagedKVCache, data_fields=['k', 'v', 'tables', 'lengths', 'k_s',
+                               'v_s'], meta_fields=[])
+
+
+def init_pool(cfg: llama.LlamaConfig, slots: int, max_len: int,
+              n_blocks: int, block: int,
+              quantize: bool = False) -> PagedKVCache:
+    """``n_blocks`` INCLUDES block 0 (the junk sink); usable capacity is
+    ``(n_blocks - 1) * block`` positions. ``max_blocks`` per slot covers
+    ``max_len`` so a single request can still use its full budget."""
+    if block < 1 or block & (block - 1):
+        # Prefill widths are power-of-two buckets: a non-power-of-two
+        # block could leave w >= block with w % block != 0, and the
+        # insert's floor(w / block) scatter would silently DROP the
+        # prompt's tail KV (review finding).
+        raise ValueError(f'block size must be a power of two, '
+                         f'got {block}')
+    if max_len % block:
+        raise ValueError(f'max_len {max_len} must be a multiple of the '
+                         f'block size {block}')
+    mb = max_len // block
+    shape = (cfg.n_layers, n_blocks, cfg.n_kv_heads, block, cfg.head_dim)
+    tables = jnp.zeros((slots, mb), jnp.int32)
+    lengths = jnp.zeros((slots,), jnp.int32)
+    if quantize:
+        return PagedKVCache(
+            k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
+            tables=tables, lengths=lengths,
+            k_s=jnp.zeros(shape[:-1], jnp.float32),
+            v_s=jnp.zeros(shape[:-1], jnp.float32))
+    return PagedKVCache(k=jnp.zeros(shape, cfg.dtype),
+                        v=jnp.zeros(shape, cfg.dtype),
+                        tables=tables, lengths=lengths)
+
+
+# ---------------------------------------------------------------------------
+# Insert: scatter a dense prefilled cache (models/generate.KVCache, the
+# prefill path is unchanged) into pool blocks.
+
+
+def _insert_impl(pool: PagedKVCache, cache_n, tables_new: jax.Array,
+                 slots: jax.Array) -> PagedKVCache:
+    """Write dense rows ``cache_n`` [L, N, H, W, D] (W a multiple-of-P
+    or < P bucket) into the pool under each row's block table
+    ``tables_new`` [N, MB], and install those tables at ``slots``.
+    Positions beyond a row's reserved blocks carry junk (never attended)
+    and scatter into the junk sink."""
+    p = pool.block
+    w = cache_n.k.shape[3]
+
+    def scatter(pool_arr, new):  # new: [L, N, H, W, D]
+        if w < p:
+            blk = tables_new[:, 0]
+            return pool_arr.at[:, blk, :, :w].set(new)
+        nb = w // p
+        # [L, N, H, nb, P, D] -> [L, N*nb, H, P, D] against flat ids.
+        l, n, h, _, d = new.shape
+        v = new.reshape(l, n, h, nb, p, d).transpose(0, 1, 3, 2, 4, 5)
+        v = v.reshape(l, n * nb, h, p, d)
+        return pool_arr.at[:, tables_new[:, :nb].reshape(-1)].set(v)
+
+    def scatter_s(pool_s, new_s):  # scales: [L, N, H, W]
+        if w < p:
+            blk = tables_new[:, 0]
+            return pool_s.at[:, blk, :, :w].set(new_s)
+        nb = w // p
+        l, n, h, _ = new_s.shape
+        v = new_s.reshape(l, n, h, nb, p).transpose(0, 1, 3, 2, 4)
+        v = v.reshape(l, n * nb, h, p)
+        return pool_s.at[:, tables_new[:, :nb].reshape(-1)].set(v)
+
+    k = scatter(pool.k, cache_n.k)
+    v = scatter(pool.v, cache_n.v)
+    k_s, v_s = pool.k_s, pool.v_s
+    if pool.quantized:
+        k_s = scatter_s(pool.k_s, cache_n.k_s)
+        v_s = scatter_s(pool.v_s, cache_n.v_s)
+    return PagedKVCache(
+        k=k, v=v, tables=pool.tables.at[slots].set(tables_new),
+        lengths=pool.lengths.at[slots].set(cache_n.lengths),
+        k_s=k_s, v_s=v_s)
+
+
+jit_insert = jax.jit(_insert_impl, donate_argnums=(0,))
+
+
+# ---------------------------------------------------------------------------
+# Decode forward (S = 1): scatter this step's K/V, gather the slot's
+# blocks into the standard attention view, reuse the dense math.
+
+
+def _paged_layer(cfg: llama.LlamaConfig, x: jax.Array, layer,
+                 lengths: jax.Array, tables: jax.Array,
+                 k_pool: jax.Array, v_pool: jax.Array,
+                 active_rows: Optional[jax.Array],
+                 k_s: Optional[jax.Array], v_s: Optional[jax.Array]):
+    """One decoder block at S=1 over the paged pool. x: [B, 1, d].
+    The math is generate.py's (_qkv_proj/_cached_attention/_mlp_tail);
+    only the cache write (pool scatter) and read (block gather) differ
+    from the dense layer."""
+    b = x.shape[0]
+    p = k_pool.shape[3]
+    mb = tables.shape[1]
+    positions = lengths[:, None]  # [B, 1]
+    q, k, v = _qkv_proj(cfg, x, layer, positions)
+    # Scatter the new position: block table entry len//P (clamped so a
+    # junk row grown past its table writes its LAST entry), offset
+    # len%P. INACTIVE rows write to the junk sink (block 0)
+    # unconditionally: a freed slot's stale table may point at blocks
+    # already reallocated to another request, and an unmasked junk
+    # write there would corrupt the new owner's live KV (review
+    # finding). Within a chunk a finishing row stays active and its
+    # blocks are only released after the chunk returns, so active
+    # writes never race a reallocation.
+    rows = jnp.arange(b)
+    blk = tables[rows, jnp.clip(lengths // p, 0, mb - 1)]  # [B]
+    if active_rows is not None:
+        blk = jnp.where(active_rows, blk, 0)
+    off = lengths % p
+    kt = k[:, 0]  # [B, Hkv, D]
+    vt = v[:, 0]
+    if k_s is not None:
+        k8, ks_new = _quantize_block(kt[:, :, None, :])  # [B,H,1,D]
+        v8, vs_new = _quantize_block(vt[:, :, None, :])
+        k_pool = k_pool.at[blk, :, off].set(k8[:, :, 0])
+        v_pool = v_pool.at[blk, :, off].set(v8[:, :, 0])
+        k_s = k_s.at[blk, :, off].set(ks_new[:, :, 0])
+        v_s = v_s.at[blk, :, off].set(vs_new[:, :, 0])
+    else:
+        k_pool = k_pool.at[blk, :, off].set(kt.astype(k_pool.dtype))
+        v_pool = v_pool.at[blk, :, off].set(vt.astype(v_pool.dtype))
+    # Gather: [B, MB, H, P, D] -> [B, H, MB*P, D] attention view.
+    def view(pool):
+        g = pool[tables]  # [B, MB, H, P, D]
+        g = g.transpose(0, 2, 1, 3, 4)
+        return g.reshape(b, g.shape[1], mb * p, g.shape[4])
+
+    def view_s(pool_s):
+        g = pool_s[tables]  # [B, MB, H, P]
+        g = g.transpose(0, 2, 1, 3)
+        return g.reshape(b, g.shape[1], mb * p)
+
+    att = _cached_attention(
+        q, view(k_pool), view(v_pool), positions, lengths + 1,
+        view_s(k_s) if k_s is not None else None,
+        view_s(v_s) if v_s is not None else None)
+    x = x + _mm(att, layer['wo'], 'bshk,hkd->bsd')
+    token_mask = None
+    if cfg.num_experts > 0:
+        mask = jnp.ones((b, 1), bool)
+        if active_rows is not None:
+            mask = mask & active_rows[:, None]
+        token_mask = mask.astype(x.dtype)
+    x = _mlp_tail(cfg, x, layer, token_mask)
+    return x, k_pool, v_pool, k_s, v_s
+
+
+def forward_paged(params, tokens: jax.Array, cache: PagedKVCache,
+                  cfg: llama.LlamaConfig,
+                  active_rows: Optional[jax.Array] = None
+                  ) -> Tuple[jax.Array, PagedKVCache]:
+    """One decode step (tokens [B, 1]) over the paged pool; returns
+    (last-position logits [B, V], updated cache). The structural twin of
+    ``generate.forward_cached`` at S=1 with pool scatter/gather replacing
+    the dense row update."""
+    x = params['embed'].astype(cfg.dtype)[tokens]
+    quantized = cache.quantized
+
+    def body(carry, xs):
+        x = carry
+        if quantized:
+            layer, k_p, v_p, ks_p, vs_p = xs
+        else:
+            layer, k_p, v_p = xs
+            ks_p = vs_p = None
+        x, k_p, v_p, ks_p, vs_p = _paged_layer(
+            cfg, x, layer, cache.lengths, cache.tables, k_p, v_p,
+            active_rows, ks_p, vs_p)
+        ys = (k_p, v_p, ks_p, vs_p) if quantized else (k_p, v_p)
+        return x, ys
+
+    if quantized:
+        xs = (params['layers'], cache.k, cache.v, cache.k_s, cache.v_s)
+        x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(body, x, xs)
+    else:
+        xs = (params['layers'], cache.k, cache.v)
+        x, (new_k, new_v) = jax.lax.scan(body, x, xs)
+        new_ks = new_vs = None
+    x = llama.rms_norm(x, params['final_norm'], cfg.norm_eps)
+    logits = _mm(x[:, -1], params['lm_head'], 'bd,dv->bv',
+                 preferred_element_type=jnp.float32)
+    new_cache = PagedKVCache(k=new_k, v=new_v, tables=cache.tables,
+                             lengths=cache.lengths + 1,
+                             k_s=new_ks, v_s=new_vs)
+    return logits, new_cache

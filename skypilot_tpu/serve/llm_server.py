@@ -81,7 +81,9 @@ class LlmServer:
                  engine: Optional[str] = None, tp: Optional[int] = None,
                  kv_cache: Optional[str] = None,
                  prefix_cache: Optional[int] = None,
-                 draft_model: Optional[str] = None):
+                 draft_model: Optional[str] = None,
+                 kv_layout: Optional[str] = None,
+                 kv_blocks: Optional[int] = None):
         self.model_name = model
         self.cfg = llama.PRESETS[model]
         self.max_len = min(max_len, self.cfg.max_seq_len)
@@ -94,6 +96,16 @@ class LlmServer:
         if self.kv_cache not in ('bf16', 'int8'):
             raise ValueError(f'Unknown kv_cache {self.kv_cache!r}; '
                              "'bf16' or 'int8'")
+        self.kv_layout = (kv_layout
+                          or os.environ.get('SKYTPU_LLM_KV_LAYOUT')
+                          or 'slot')
+        if self.kv_layout not in ('slot', 'paged'):
+            raise ValueError(f'Unknown kv_layout {self.kv_layout!r}; '
+                             "'slot' or 'paged'")
+        # Pool size is THE paged knob (a full-capacity pool saves no
+        # HBM); 0/None = engine default (full capacity, always safe).
+        self.kv_blocks = kv_blocks or int(
+            os.environ.get('SKYTPU_LLM_KV_BLOCKS', '0')) or None
         self.quantize = quantize or os.environ.get('SKYTPU_LLM_QUANTIZE')
         if self.quantize and self.quantize != 'int8':
             raise ValueError(f'Unknown quantization {self.quantize!r}; '
@@ -152,12 +164,8 @@ class LlmServer:
         # (and quantized) SHARDED — a model that only fits spread over
         # the slice must never transit one chip whole.
         self.tp = tp or int(os.environ.get('SKYTPU_LLM_TP', '1'))
-        if self.tp > 1 and gen_lib._DECODE_KERNEL_ENABLED:
-            # pallas_call carries no sharding rule: under TP, GSPMD
-            # would all-gather the full per-layer caches (or fail) —
-            # defeating the never-transit-one-chip-whole invariant.
-            raise ValueError('SKYTPU_DECODE_KERNEL=pallas is '
-                             'single-device; unset it for --tp > 1')
+        # SKYTPU_DECODE_KERNEL=pallas composes with --tp > 1: the engine
+        # shard_maps the kernel per head shard (generate.kernel_shard_ctx).
         self.mesh = None
         key = jax.random.PRNGKey(seed)
         if self.tp > 1:
@@ -186,9 +194,21 @@ class LlmServer:
             self.draft_cfg = llama.PRESETS[self.draft_model]
             self.draft_params = llama.init_params(
                 jax.random.PRNGKey(seed + 1), self.draft_cfg)
+        # Multi-host SPMD replica (serve/spmd.py): every worker process
+        # runs the same engine in lockstep; HTTP lives on rank 0 only.
+        self.world = jax.process_count()
+        if self.world > 1 and engine != 'continuous':
+            raise ValueError('multi-host serving requires the '
+                             'continuous engine (the window path is '
+                             'head-local and would deadlock the '
+                             'collective over sharded weights)')
         self.engine = None
         if engine == 'continuous':
-            from skypilot_tpu.models.engine import ContinuousEngine
+            if self.world > 1:
+                from skypilot_tpu.serve.spmd import SpmdEngine \
+                    as ContinuousEngine
+            else:
+                from skypilot_tpu.models.engine import ContinuousEngine
             # params are already mesh-placed when tp > 1, so the engine's
             # own shard_params is a no-op placement — both paths serve
             # the SAME resident weights. The draft (if any) rides inside
@@ -198,7 +218,8 @@ class LlmServer:
                 mesh=self.mesh, kv_quantize=self.kv_cache == 'int8',
                 prefix_slots=prefix_cache,
                 draft_params=self.draft_params, draft_cfg=self.draft_cfg,
-                spec_k=self.spec_k)
+                spec_k=self.spec_k, kv_layout=self.kv_layout,
+                kv_blocks=self.kv_blocks)
             self.params = self.engine.params
             if self.draft_params is not None:
                 self.draft_params = self.engine.draft_params
@@ -450,6 +471,13 @@ class LlmServer:
                           f'{self.max_len}'}, status=400)
         seed = body.get('seed')
         seeded = temperature > 0 and seed is not None
+        if seeded and self.world > 1:
+            # The seeded window path is head-local; a head-only forward
+            # over globally sharded weights would deadlock the other
+            # ranks' collectives (serve/spmd.py caveats).
+            return web.json_response(
+                {'error': 'seeded sampling is not available on a '
+                          'multi-host replica'}, status=400)
         stream = bool(body.get('stream'))
         if stream and (self.engine is None or seeded):
             return web.json_response(
@@ -564,11 +592,11 @@ class LlmServer:
         return app
 
 
-def main() -> None:
-    # Honor JAX_PLATFORMS before first device use (pinned-TPU runtimes
-    # latch the platform at import; same dance as train/run.py).
-    from skypilot_tpu.utils.jax_env import apply_jax_platform_env
-    apply_jax_platform_env()
+def build_parser() -> argparse.ArgumentParser:
+    """The replica's full flag set — shared with serve/spmd.py's
+    follower ranks, which must construct an IDENTICAL server (every
+    serving knob changes the compiled programs all ranks must agree
+    on)."""
     parser = argparse.ArgumentParser()
     parser.add_argument('--model', default='tiny')
     parser.add_argument('--max-len', type=int, default=1024)
@@ -592,6 +620,17 @@ def main() -> None:
                         help='int8 = quantized KV cache, halves the '
                              'decode HBM stream (also via '
                              'SKYTPU_LLM_KV_CACHE)')
+    parser.add_argument('--kv-layout', default=None,
+                        choices=('slot', 'paged'),
+                        help='paged = vLLM-style block-table KV pool: '
+                             'requests reserve only their actual ask '
+                             '(also via SKYTPU_LLM_KV_LAYOUT)')
+    parser.add_argument('--kv-blocks', type=int, default=None,
+                        help='paged pool size in blocks incl. the junk '
+                             'sink (also via SKYTPU_LLM_KV_BLOCKS; '
+                             'default = full capacity — size it BELOW '
+                             'slots*max_len/block for the HBM saving; '
+                             'exhaustion queues admissions)')
     parser.add_argument('--prefix-cache', type=int, default=None,
                         help='device pool slots for popular prompt '
                              'prefixes (opt-in, default 0; costs N extra '
@@ -599,9 +638,29 @@ def main() -> None:
                              'SKYTPU_LLM_PREFIX_CACHE; dense models only)')
     parser.add_argument('--draft-model', default=None,
                         help='preset name of a small draft model for '
-                             'speculative decoding on the window path '
-                             '(greedy requests; use with --engine off; '
+                             'speculative decoding (rides inside the '
+                             'continuous engine, or the window path '
+                             "with --engine off; dense targets only; "
                              'also via SKYTPU_LLM_DRAFT)')
+    return parser
+
+
+def server_from_args(args) -> 'LlmServer':
+    return LlmServer(args.model, max_len=args.max_len,
+                     quantize=args.quantize, engine=args.engine,
+                     tp=args.tp, kv_cache=args.kv_cache,
+                     prefix_cache=args.prefix_cache,
+                     draft_model=args.draft_model,
+                     kv_layout=args.kv_layout,
+                     kv_blocks=args.kv_blocks)
+
+
+def main() -> None:
+    # Honor JAX_PLATFORMS before first device use (pinned-TPU runtimes
+    # latch the platform at import; same dance as train/run.py).
+    from skypilot_tpu.utils.jax_env import apply_jax_platform_env
+    apply_jax_platform_env()
+    parser = build_parser()
     args = parser.parse_args()
     # Backend init under the shutdown-signal guard (AFTER argparse so
     # --help/usage never touches the chip): a drain/stop landing
@@ -610,11 +669,13 @@ def main() -> None:
     # incident, bench_runs/README.md).
     from skypilot_tpu.utils.tpu_client_guard import init_backend_guarded
     init_backend_guarded()
-    server = LlmServer(args.model, max_len=args.max_len,
-                       quantize=args.quantize, engine=args.engine,
-                       tp=args.tp, kv_cache=args.kv_cache,
-                       prefix_cache=args.prefix_cache,
-                       draft_model=args.draft_model)
+    server = server_from_args(args)
+    if server.world > 1:
+        # Multi-host: the head's lockstep loop must run from startup —
+        # follower ranks are already blocked in the arrival collective,
+        # and a drain signal arriving before the first request must
+        # still reach them via the stop broadcast (serve/spmd.py).
+        server.engine.start()
     app = server.make_app()
 
     async def _install_drain(app_):

@@ -41,7 +41,8 @@ import pytest
 # suites are `load`. Everything else runs in the default fast selection.
 _SLOW_MODULES = {
     'test_agent_rpc', 'test_api_server', 'test_e2e_launch', 'test_examples',
-    'test_engine', 'test_engine_spec', 'test_generate', 'test_grpc_exec',
+    'test_engine', 'test_engine_paged', 'test_engine_spec',
+    'test_generate', 'test_grpc_exec',
     'test_ha_controllers',
     'test_k8s_e2e',
     'test_managed_jobs', 'test_model_and_trainer', 'test_native_gang',

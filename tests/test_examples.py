@@ -287,3 +287,67 @@ def test_serve_recipe_measures_decode_throughput(monkeypatch):
         assert out['new_tokens'] == 8 * 8
     finally:
         serve.down('exsvc')
+
+
+def test_multihost_serve_recipe_spmd_replica():
+    """examples/llm/serve-multihost (r4 verdict Next #4): a num_nodes=2
+    REPLICA through the real Task/gang path. The gang driver wires
+    JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID across
+    both fake-cloud nodes; serve/spmd.py joins them with
+    jax.distributed, rank 0 serves HTTP, rank 1 follows in lockstep —
+    the exact wiring a real multi-host slice gets."""
+    import requests as requests_lib
+
+    from skypilot_tpu.utils import common_utils
+    cfg = yaml.safe_load(open(os.path.join(
+        EXAMPLES, 'llm', 'serve-multihost', 'serve.yaml')))
+    assert cfg['num_nodes'] == 2
+    cfg['resources'] = {'cloud': 'fake', 'accelerators': 'tpu-v5e-8'}
+    cfg.pop('service', None)  # control plane covered in test_serve*;
+    port = common_utils.find_free_port(23500)  # here: the gang contract
+    coord_port = common_utils.find_free_port(23600)
+    cfg['run'] = (
+        # The driver MUST have wired the distributed contract...
+        'test -n "$JAX_COORDINATOR_ADDRESS" || exit 97\n'
+        'test "$JAX_NUM_PROCESSES" = 2 || exit 98\n'
+        'test -n "$JAX_PROCESS_ID" || exit 99\n'
+        # ...but the fake cloud's head IP is synthetic (10.x,
+        # provision/fake/instance.py) and both "nodes" are really this
+        # host, so rebind the coordinator to loopback for the sandbox.
+        f'export JAX_COORDINATOR_ADDRESS=127.0.0.1:{coord_port}\n'
+        'JAX_PLATFORMS=cpu '
+        'XLA_FLAGS=--xla_force_host_platform_device_count=4 '
+        'SKYTPU_LLM_SLOTS=2 SKYTPU_LLM_CHUNK_STEPS=4 '
+        'python3 -m skypilot_tpu.serve.spmd --model tiny-mh '
+        f'--max-len 64 --tp 8 --port {port} --host 127.0.0.1')
+    task = Task.from_yaml_config(cfg)
+    job_id, _ = execution.launch(task, cluster_name='ex-mh-serve',
+                                 detach_run=True)
+    try:
+        deadline = time.time() + 240
+        up = False
+        while time.time() < deadline:
+            s = core.job_status('ex-mh-serve', job_id)
+            assert not (s and job_lib.JobStatus(s).is_terminal()), \
+                _read_log('ex-mh-serve', job_id)[-3000:]
+            try:
+                if requests_lib.get(f'http://127.0.0.1:{port}/health',
+                                    timeout=2).status_code == 200:
+                    up = True
+                    break
+            except requests_lib.RequestException:
+                pass
+            time.sleep(1.0)
+        assert up, _read_log('ex-mh-serve', job_id)[-3000:]
+        r = requests_lib.post(
+            f'http://127.0.0.1:{port}/generate',
+            json={'tokens': [[5, 6, 7, 8]], 'max_new_tokens': 5},
+            timeout=300)
+        assert r.status_code == 200, r.text
+        out = r.json()['tokens'][0]
+        assert len(out) == 5 and all(isinstance(t, int) for t in out)
+        h = requests_lib.get(f'http://127.0.0.1:{port}/health',
+                             timeout=10).json()
+        assert h['engine']['tokens_emitted'] >= 5
+    finally:
+        core.down('ex-mh-serve')
