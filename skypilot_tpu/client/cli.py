@@ -408,6 +408,61 @@ def api_start(port):
     click.echo(f'API server healthy at {sdk.server_url()}')
 
 
+@api_group.command('login')
+@_clean_errors
+def api_login():
+    """Log in to the API server via its OAuth2/OIDC IdP (device flow).
+
+    The server relays an RFC 8628 device authorization: open the
+    printed URL, confirm the code, and the minted framework bearer
+    token lands in ~/.skypilot_tpu/api_token (used automatically by
+    every later CLI/SDK call; SKYTPU_API_TOKEN still overrides)."""
+    import time as time_lib
+
+    import requests as requests_lib
+
+    from skypilot_tpu.client import sdk as sdk_lib
+    url = sdk_lib.server_url()
+    r = requests_lib.post(f'{url}/oauth/login/start', timeout=30)
+    if r.status_code == 404:
+        raise click.ClickException(
+            'this API server has no OAuth IdP configured '
+            '(SKYTPU_OAUTH_ISSUER); ask the operator for a token '
+            'instead')
+    if r.status_code != 200:
+        raise click.ClickException(f'login start failed: {r.text[:300]}')
+    flow = r.json()
+    click.echo(f"Open {flow['verification_uri']}")
+    click.echo(f"and confirm code: {flow['user_code']}")
+    interval = max(int(flow.get('interval', 5)), 1)
+    deadline = time_lib.time() + int(flow.get('expires_in', 600))
+    while time_lib.time() < deadline:
+        time_lib.sleep(interval)
+        pr = requests_lib.post(f'{url}/oauth/login/poll',
+                               json={'handle': flow['handle']},
+                               timeout=30)
+        if pr.status_code != 200:
+            try:  # a proxy 502 may carry an HTML body, not JSON
+                detail = pr.json().get('error', pr.text[:300])
+            except ValueError:
+                detail = pr.text[:300]
+            raise click.ClickException(f'login failed: {detail}')
+        body = pr.json()
+        if body.get('pending'):
+            if body.get('slow_down'):
+                interval += 5
+            continue
+        path = sdk_lib.token_file_path()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, 'w', encoding='utf-8') as f:
+            f.write(body['token'])
+        click.echo(f"Logged in as {body['name']} (role "
+                   f"{body['role']}); token saved to {path}")
+        return
+    raise click.ClickException('login timed out; run it again')
+
+
 @api_group.command('info')
 @_clean_errors
 def api_info_cmd():

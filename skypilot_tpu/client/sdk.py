@@ -27,8 +27,27 @@ def server_url() -> str:
     return os.environ.get('SKYTPU_API_SERVER_URL', DEFAULT_SERVER_URL)
 
 
-def _headers() -> Dict[str, str]:
+def token_file_path() -> str:
+    """Where `stpu api login` stores the minted bearer token (the env
+    var wins so scripts/CI can still inject one)."""
+    return os.environ.get(
+        'SKYTPU_API_TOKEN_FILE',
+        os.path.expanduser('~/.skypilot_tpu/api_token'))
+
+
+def load_token() -> 'str | None':
     token = os.environ.get('SKYTPU_API_TOKEN')
+    if token:
+        return token
+    try:
+        with open(token_file_path(), encoding='utf-8') as f:
+            return f.read().strip() or None
+    except OSError:
+        return None
+
+
+def _headers() -> Dict[str, str]:
+    token = load_token()
     return {'Authorization': f'Bearer {token}'} if token else {}
 
 

@@ -549,6 +549,16 @@ class ContinuousEngine:
             raise ValueError(
                 f'prompt ({len(row)}) + max_new ({max_new}) exceeds '
                 f'engine max_len limit {self._submit_max}{extra}')
+        if self.kv_layout == 'paged' and max_new > 1:
+            need = -(-(len(row) + max_new) // self.kv_block)
+            if need > self.kv_blocks - 1:
+                # Bigger than the WHOLE pool: admission could never
+                # succeed — the request would stall itself and starve
+                # everything queued behind it (review finding).
+                raise ValueError(
+                    f'request needs {need} KV blocks but the pool has '
+                    f'only {self.kv_blocks - 1}; raise kv_blocks or '
+                    'shrink prompt+max_new')
         if top_k < 0 or not 0.0 < top_p <= 1.0:
             # top_p <= 0 would mask EVERY token and degenerate to
             # uniform-random ids — reject like the HTTP layer does.

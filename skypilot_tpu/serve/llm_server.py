@@ -164,8 +164,18 @@ class LlmServer:
         # (and quantized) SHARDED — a model that only fits spread over
         # the slice must never transit one chip whole.
         self.tp = tp or int(os.environ.get('SKYTPU_LLM_TP', '1'))
-        # SKYTPU_DECODE_KERNEL=pallas composes with --tp > 1: the engine
-        # shard_maps the kernel per head shard (generate.kernel_shard_ctx).
+        # SKYTPU_DECODE_KERNEL=pallas composes with --tp > 1 on the
+        # CONTINUOUS engine only: the engine shard_maps the kernel per
+        # head shard (generate.kernel_shard_ctx). The window path
+        # carries no shard ctx, so a pallas_call traced under GSPMD
+        # would all-gather the full per-layer caches — keep the old
+        # startup refusal for --engine off (seeded requests, which also
+        # ride the window path, are refused per-request below).
+        if (self.tp > 1 and gen_lib._DECODE_KERNEL_ENABLED
+                and engine == 'off'):
+            raise ValueError('SKYTPU_DECODE_KERNEL=pallas with --tp > 1 '
+                             'requires the continuous engine (the '
+                             'window path cannot shard the kernel)')
         self.mesh = None
         key = jax.random.PRNGKey(seed)
         if self.tp > 1:
@@ -471,6 +481,13 @@ class LlmServer:
                           f'{self.max_len}'}, status=400)
         seed = body.get('seed')
         seeded = temperature > 0 and seed is not None
+        if seeded and self.tp > 1 and gen_lib._DECODE_KERNEL_ENABLED:
+            # Seeded requests ride the window path, which cannot shard
+            # the pallas decode kernel (see the --engine off gate).
+            return web.json_response(
+                {'error': 'seeded sampling is unavailable with '
+                          'SKYTPU_DECODE_KERNEL=pallas on a --tp > 1 '
+                          'replica'}, status=400)
         if seeded and self.world > 1:
             # The seeded window path is head-local; a head-only forward
             # over globally sharded weights would deadlock the other

@@ -123,14 +123,20 @@ class SpmdEngine(ContinuousEngine):
             with self._incoming_lock:
                 batch = self._incoming
                 self._incoming = []
+            # SNAPSHOT stop once: returning the live flag instead of
+            # the broadcast value would let a stop() landing
+            # mid-iteration exit the head while followers got
+            # stop=False and hang in the next collective (review
+            # finding).
+            stop = self._stop
             payload = pickle.dumps(
-                {'stop': self._stop,
+                {'stop': stop,
                  'reqs': [self._spec_of(r) for r in batch]})
             buf = np.frombuffer(payload, np.uint8)
             multihost_utils.broadcast_one_to_all(
                 np.int64(len(buf)))
             multihost_utils.broadcast_one_to_all(buf)
-            return self._stop, batch
+            return stop, batch
         n = int(multihost_utils.broadcast_one_to_all(np.int64(0)))
         buf = multihost_utils.broadcast_one_to_all(
             np.zeros((n,), np.uint8))

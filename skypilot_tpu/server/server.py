@@ -194,10 +194,48 @@ async def auth_middleware(request: web.Request, handler):
     token = supplied[len('Bearer '):] if supplied.startswith(
         'Bearer ') else None
     user = users_lib.authenticate(token)
-    if user is None and request.path not in ('/health', '/dashboard'):
+    if user is None and request.path not in ('/health', '/dashboard') \
+            and not request.path.startswith('/oauth/'):
+        # /oauth/* is the login BOOTSTRAP (the whole point is having no
+        # token yet); the handlers 404 unless an IdP is configured.
         return web.json_response({'error': 'unauthorized'}, status=401)
     request['user'] = user
     return await handler(request)
+
+
+async def oauth_login_start(request: web.Request) -> web.Response:
+    """OAuth2 device-code login, leg 1 (users/oauth.py)."""
+    del request
+    from skypilot_tpu.users import oauth
+    if not oauth.enabled():
+        return web.json_response(
+            {'error': 'OAuth login is not configured on this server '
+                      '(set SKYTPU_OAUTH_ISSUER + '
+                      'SKYTPU_OAUTH_CLIENT_ID)'}, status=404)
+    loop = asyncio.get_event_loop()
+    try:
+        out = await loop.run_in_executor(None, oauth.start_device_flow)
+    except Exception as exc:  # noqa: BLE001 — surface IdP failures
+        return web.json_response({'error': str(exc)}, status=502)
+    return web.json_response(out)
+
+
+async def oauth_login_poll(request: web.Request) -> web.Response:
+    """OAuth2 device-code login, leg 2: poll until the user confirms;
+    success mints a framework bearer token."""
+    from skypilot_tpu.users import oauth
+    if not oauth.enabled():
+        return web.json_response({'error': 'OAuth login is not '
+                                           'configured'}, status=404)
+    body = await request.json()
+    handle = body.get('handle', '')
+    loop = asyncio.get_event_loop()
+    try:
+        out = await loop.run_in_executor(
+            None, lambda: oauth.poll_device_flow(handle))
+    except Exception as exc:  # noqa: BLE001
+        return web.json_response({'error': str(exc)}, status=400)
+    return web.json_response(out)
 
 
 def make_app() -> web.Application:
@@ -217,6 +255,8 @@ def make_app() -> web.Application:
     app.router.add_post('/api/v1/jobs/launch', _make_post('jobs_launch'))
     app.router.add_get('/api/v1/jobs/queue', _make_get('jobs_queue'))
     app.router.add_post('/api/v1/jobs/cancel', _make_post('jobs_cancel'))
+    app.router.add_post('/oauth/login/start', oauth_login_start)
+    app.router.add_post('/oauth/login/poll', oauth_login_poll)
     return app
 
 

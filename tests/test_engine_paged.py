@@ -224,6 +224,12 @@ def test_paged_gates():
                                     slots=2)._init_device_state()
     with pytest.raises(ValueError, match='Unknown kv_layout'):
         engine_lib.ContinuousEngine(params, cfg, kv_layout='banana')
+    # A request bigger than the WHOLE pool is refused at submit — it
+    # could never be admitted and would starve the queue behind it.
+    eng = engine_lib.ContinuousEngine(params, cfg, kv_layout='paged',
+                                      slots=2, max_len=64, kv_blocks=2)
+    with pytest.raises(ValueError, match='KV blocks'):
+        eng.submit(list(range(10)), 10)  # 20 tokens -> 2 blocks > 1
 
 
 def test_llm_server_paged_roundtrip(tiny):

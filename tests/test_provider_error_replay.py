@@ -1,0 +1,160 @@
+"""Replay RECORDED vendor error payloads through the REAL transport
+parsers (r4 verdict Next #7).
+
+The provisioner fakes inject pre-constructed exceptions, which means
+the code that actually parses real HTTP error bodies (code extraction,
+nested ARM details, XML error schema, stockout classification) was
+only ever tested against hand-written guesses. These tests feed the
+payload shapes recorded in ``fixtures/provider_error_payloads.json``
+(transcribed from the public API docs' example bodies) through each
+vendor's real ``Transport.request`` via a faked ``requests`` layer —
+so the parse path and the stockout/auth classification are pinned
+against what the wire actually carries.
+"""
+import json
+import os
+
+import pytest
+
+from skypilot_tpu import exceptions
+
+FIXTURES = json.load(open(os.path.join(
+    os.path.dirname(__file__), 'fixtures',
+    'provider_error_payloads.json')))
+
+
+class _Resp:
+    def __init__(self, status, body=None, text=None):
+        self.status_code = status
+        if text is None:
+            text = json.dumps(body)
+        self.text = text
+
+    def json(self):
+        return json.loads(self.text)
+
+
+def _fixture_resp(fx):
+    if 'body_xml' in fx:
+        return _Resp(fx['status'], text=fx['body_xml'])
+    return _Resp(fx['status'], body=fx['body'])
+
+
+# -- Azure ------------------------------------------------------------------
+
+
+def _arm_transport(monkeypatch, fx):
+    from skypilot_tpu.provision.azure import arm_client
+    monkeypatch.setenv('AZURE_TENANT_ID', 't')
+    monkeypatch.setenv('AZURE_CLIENT_ID', 'c')
+    monkeypatch.setenv('AZURE_CLIENT_SECRET', 's')
+    monkeypatch.setenv('AZURE_SUBSCRIPTION_ID', 'sub')
+    t = arm_client.ArmTransport()
+    t._token = 'tok'
+    t._token_expiry = 4e9  # skip the token leg; request path only
+    import requests as requests_lib
+    monkeypatch.setattr(
+        requests_lib, 'request',
+        lambda *a, **k: _fixture_resp(fx))
+    return arm_client, t
+
+
+@pytest.mark.parametrize('name', [
+    'sku_not_available', 'nested_zonal_allocation_failed',
+    'quota_operation_not_allowed', 'resource_not_found',
+    'poll_allocation_failed'])
+def test_azure_error_payloads_parse_and_classify(monkeypatch, name):
+    fx = FIXTURES['azure'][name]
+    arm_client, t = _arm_transport(monkeypatch, fx)
+    with pytest.raises(arm_client.AzureApiError) as ei:
+        t.request('PUT', '/subscriptions/sub/resourcegroups/rg')
+    err = ei.value
+    assert err.code == fx['expect']['code']
+    assert err.is_stockout() == fx['expect']['stockout']
+    assert err.status_code == fx['status']
+    # The human-facing message must carry the REAL text (the nested
+    # case must surface the inner detail message, not the generic
+    # DeploymentFailed wrapper).
+    if name == 'nested_zonal_allocation_failed':
+        assert 'sufficient capacity' in err.message
+
+
+def test_azure_token_endpoint_auth_failure(monkeypatch):
+    fx = FIXTURES['azure']['token_invalid_client_secret']
+    from skypilot_tpu.provision.azure import arm_client
+    monkeypatch.setenv('AZURE_TENANT_ID', 't')
+    monkeypatch.setenv('AZURE_CLIENT_ID', 'c')
+    monkeypatch.setenv('AZURE_CLIENT_SECRET', 'wrong')
+    monkeypatch.setenv('AZURE_SUBSCRIPTION_ID', 'sub')
+    import requests as requests_lib
+    monkeypatch.setattr(requests_lib, 'post',
+                        lambda *a, **k: _fixture_resp(fx))
+    t = arm_client.ArmTransport()
+    with pytest.raises(exceptions.NoCloudAccessError) as ei:
+        t.request('GET', '/subscriptions/sub/resourcegroups/rg')
+    assert 'AADSTS7000215' in str(ei.value)
+
+
+# -- DigitalOcean -----------------------------------------------------------
+
+
+@pytest.mark.parametrize('name', ['droplet_limit', 'invalid_image',
+                                  'unauthorized', 'rate_limited'])
+def test_do_error_payloads_parse_and_classify(monkeypatch, name):
+    fx = FIXTURES['do'][name]
+    from skypilot_tpu.provision.do import do_client
+    monkeypatch.setenv('DIGITALOCEAN_TOKEN', 'tok')
+    import requests as requests_lib
+    monkeypatch.setattr(requests_lib, 'request',
+                        lambda *a, **k: _fixture_resp(fx))
+    t = do_client.DoTransport()
+    with pytest.raises(do_client.DoApiError) as ei:
+        t.request('POST', '/v2/droplets', body={'name': 'x'})
+    err = ei.value
+    assert err.code == fx['expect']['code']
+    assert err.is_stockout() == fx['expect']['stockout']
+    assert err.status_code == fx['status']
+
+
+# -- AWS (EC2 Query API XML) ------------------------------------------------
+
+
+@pytest.mark.parametrize('name', [
+    'insufficient_instance_capacity', 'vcpu_limit_exceeded',
+    'auth_failure', 'proxy_html_error_page'])
+def test_aws_error_payloads_parse_and_classify(monkeypatch, name):
+    fx = FIXTURES['aws'][name]
+    from skypilot_tpu.provision.aws import ec2_client
+    monkeypatch.setenv('AWS_ACCESS_KEY_ID', 'AKIA_TEST')
+    monkeypatch.setenv('AWS_SECRET_ACCESS_KEY', 'secret')
+    import requests as requests_lib
+    monkeypatch.setattr(requests_lib, 'post',
+                        lambda *a, **k: _fixture_resp(fx))
+    t = ec2_client.Ec2Transport('us-east-1')
+    with pytest.raises(ec2_client.AwsApiError) as ei:
+        t.request('RunInstances', {'InstanceType': 'p4d.24xlarge'})
+    err = ei.value
+    assert err.code == fx['expect']['code']
+    assert err.is_stockout() == fx['expect']['stockout']
+    if name == 'insufficient_instance_capacity':
+        assert 'us-east-1a' in err.message  # real message text surfaced
+
+
+# -- GCP (Cloud TPU REST) ---------------------------------------------------
+
+
+@pytest.mark.parametrize('name', ['tpu_zone_exhausted', 'quota_exceeded',
+                                  'permission_denied_plain'])
+def test_gcp_error_payloads_classify(monkeypatch, name):
+    fx = FIXTURES['gcp'][name]
+    from skypilot_tpu.provision.gcp import tpu_client
+    import requests as requests_lib
+    monkeypatch.setattr(requests_lib, 'request',
+                        lambda *a, **k: _fixture_resp(fx))
+    t = tpu_client.Transport(token_provider=lambda: 'tok')
+    with pytest.raises(tpu_client.GcpApiError) as ei:
+        t.request('POST', 'https://tpu.googleapis.com/v2/projects/p/'
+                          'locations/z/nodes')
+    err = ei.value
+    assert err.is_stockout() == fx['expect']['stockout']
+    assert err.status_code == fx['status']
