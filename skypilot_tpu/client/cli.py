@@ -438,11 +438,16 @@ def api_login():
     deadline = time_lib.time() + int(flow.get('expires_in', 600))
     while time_lib.time() < deadline:
         time_lib.sleep(interval)
-        pr = requests_lib.post(f'{url}/oauth/login/poll',
-                               json={'handle': flow['handle']},
-                               timeout=30)
+        try:
+            pr = requests_lib.post(f'{url}/oauth/login/poll',
+                                   json={'handle': flow['handle']},
+                                   timeout=30)
+        except requests_lib.RequestException:
+            continue  # transient network blip: keep polling (RFC 8628)
+        if pr.status_code >= 500:
+            continue  # proxy 502 / server restart: transient, retry
         if pr.status_code != 200:
-            try:  # a proxy 502 may carry an HTML body, not JSON
+            try:  # a proxy error may carry an HTML body, not JSON
                 detail = pr.json().get('error', pr.text[:300])
             except ValueError:
                 detail = pr.text[:300]
@@ -453,7 +458,8 @@ def api_login():
                 interval += 5
             continue
         path = sdk_lib.token_file_path()
-        os.makedirs(os.path.dirname(path), exist_ok=True)
+        if os.path.dirname(path):  # bare filename: no dir to create
+            os.makedirs(os.path.dirname(path), exist_ok=True)
         fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
         with os.fdopen(fd, 'w', encoding='utf-8') as f:
             f.write(body['token'])

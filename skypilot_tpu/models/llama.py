@@ -86,9 +86,16 @@ MOE_8X1B = dataclasses.replace(BENCH_1B, num_experts=8, expert_top_k=2)
 # Multi-host serving test shape: 8 kv heads so the TP axis can span a
 # 2-host x 4-virtual-device CPU dryrun mesh (tests/test_serve_spmd.py).
 TINY_MH = dataclasses.replace(TINY, n_heads=8, n_kv_heads=8)
+# Draft companion to BENCH_1B (~47M params, shared 32k vocab): the
+# speculative-decoding pair for the TPU speedup table
+# (docs/serving.md; `--model bench-1b --draft-model bench-draft`).
+BENCH_DRAFT = LlamaConfig(vocab_size=32_768, d_model=512, n_layers=4,
+                          n_heads=8, n_kv_heads=8, d_ff=1536,
+                          head_dim=64, max_seq_len=4096)
 
 PRESETS = {'llama3-8b': LLAMA3_8B, 'llama3-1b': LLAMA3_1B,
-           'bench-1b': BENCH_1B, 'tiny': TINY, 'moe-tiny': MOE_TINY,
+           'bench-1b': BENCH_1B, 'bench-draft': BENCH_DRAFT,
+           'tiny': TINY, 'moe-tiny': MOE_TINY,
            'moe-8x1b': MOE_8X1B, 'tiny-mh': TINY_MH}
 
 

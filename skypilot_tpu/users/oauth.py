@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import os
 import secrets
+import threading
 import time
 from typing import Any, Dict, Optional
 
@@ -38,8 +39,12 @@ from skypilot_tpu import exceptions
 
 # Device codes are held server-side and returned to the CLI as opaque
 # handles — the IdP device_code is a credential and must not transit
-# more than necessary. {handle: (device_code, expires_at)}
+# more than necessary. {handle: (device_code, expires_at)}. The lock
+# makes handle take/put atomic: poll handlers run in executor THREADS,
+# and a duplicated concurrent poll must lose cleanly (no KeyError, no
+# double-minted token), not race the dict.
 _PENDING: Dict[str, tuple] = {}
+_PENDING_LOCK = threading.Lock()
 _DISCOVERY_CACHE: Dict[str, Dict[str, Any]] = {}
 # /oauth/login/start is UNAUTHENTICATED by necessity (it's the login
 # bootstrap): bound both the server-side pending state and the
@@ -106,16 +111,18 @@ def start_device_flow() -> Dict[str, Any]:
             f'{resp.text[:300]}')
     body = resp.json()
     handle = secrets.token_urlsafe(16)
-    _PENDING[handle] = (body['device_code'],
-                        time.time() + float(body.get('expires_in', 600)))
-    # Expired handles age out so an abandoned login can't accumulate;
-    # beyond the cap, evict soonest-to-expire (oldest logins).
-    now = time.time()
-    for h in [h for h, (_, exp) in _PENDING.items() if exp < now]:
-        del _PENDING[h]
-    while len(_PENDING) > _MAX_PENDING:
-        oldest = min(_PENDING, key=lambda h: _PENDING[h][1])
-        del _PENDING[oldest]
+    with _PENDING_LOCK:
+        _PENDING[handle] = (
+            body['device_code'],
+            time.time() + float(body.get('expires_in', 600)))
+        # Expired handles age out so an abandoned login can't
+        # accumulate; beyond the cap, evict soonest-to-expire.
+        now = time.time()
+        for h in [h for h, (_, exp) in _PENDING.items() if exp < now]:
+            del _PENDING[h]
+        while len(_PENDING) > _MAX_PENDING:
+            oldest = min(_PENDING, key=lambda h: _PENDING[h][1])
+            del _PENDING[oldest]
     return {
         'handle': handle,
         'user_code': body['user_code'],
@@ -132,13 +139,16 @@ def poll_device_flow(handle: str) -> Dict[str, Any]:
     bearer token."""
     import requests
     from skypilot_tpu import users as users_lib
-    entry = _PENDING.get(handle)
+    # TAKE the handle atomically: a concurrent duplicate poll gets
+    # 'unknown handle' instead of racing toward a second token mint; a
+    # pending outcome puts it back for the next poll.
+    with _PENDING_LOCK:
+        entry = _PENDING.pop(handle, None)
     if entry is None:
         raise exceptions.SkyTpuError('unknown or expired login handle; '
                                      'restart the login')
     device_code, expires_at = entry
     if time.time() > expires_at:
-        del _PENDING[handle]
         raise exceptions.SkyTpuError('login expired; restart the login')
     doc = _discover()
     resp = requests.post(
@@ -151,13 +161,13 @@ def poll_device_flow(handle: str) -> Dict[str, Any]:
     if resp.status_code != 200:
         err = body.get('error', 'unknown')
         if err in ('authorization_pending', 'slow_down'):
+            with _PENDING_LOCK:
+                _PENDING[handle] = entry
             return {'pending': True,
                     'slow_down': err == 'slow_down'}
-        del _PENDING[handle]
         raise exceptions.SkyTpuError(
             f'device login failed: {err}: '
             f'{body.get("error_description", "")[:300]}')
-    del _PENDING[handle]
     claims = _userinfo(doc, body)
     email = claims.get('email') or claims.get('sub')
     if not email:

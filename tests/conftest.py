@@ -44,12 +44,13 @@ _SLOW_MODULES = {
     'test_engine', 'test_engine_paged', 'test_engine_spec',
     'test_generate', 'test_grpc_exec',
     'test_ha_controllers',
-    'test_k8s_e2e',
+    'test_k8s_e2e', 'test_lora',
     'test_managed_jobs', 'test_model_and_trainer', 'test_native_gang',
     'test_ops_attention', 'test_parallel', 'test_pipeline_moe',
-    'test_remote_control', 'test_serve', 'test_serve_ha', 'test_slurm_cloud',
+    'test_oauth_login', 'test_remote_control', 'test_sampling_semantics',
+    'test_serve', 'test_serve_ha', 'test_slurm_cloud',
     'test_speculative',
-    'test_ssh_path', 'test_storage_and_checkpoint',
+    'test_ssh_path', 'test_storage_and_checkpoint', 'test_token_dataset',
 }
 _LOAD_MODULES = {'test_load'}
 

@@ -248,6 +248,7 @@ def test_moe_finetune_recipe_runs_with_expert_parallelism(tmp_path,
     core.down('ex-moe')
 
 
+@pytest.mark.load  # pure-perf measurement: load tier (r4 verdict #5)
 def test_serve_recipe_measures_decode_throughput(monkeypatch):
     """examples/llm/serve-llama: the service YAML through serve.up on the
     fake cloud, then the shipped loadgen measures decode tok/s against

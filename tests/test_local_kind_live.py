@@ -74,10 +74,10 @@ def test_local_up_is_idempotent_and_down_removes(monkeypatch, tmp_path):
     assert local_cluster.local_down(name=name) is False
 
 
-def test_live_kind_suite_skips_cleanly_without_kind():
-    """The guard itself: in an image without `kind` (or without the
-    opt-in env), the live tests above must SKIP, not error."""
-    if shutil.which('kind') is not None and \
-            os.environ.get('SKYTPU_LIVE_KIND') == '1':
-        pytest.skip('kind available: the live tests run instead')
-    assert requires_kind.args[0] or True  # marker constructed
+def test_live_kind_guard_condition_matches_environment():
+    """The guard itself: the skipif condition must track the actual
+    environment (kind binary presence + explicit opt-in), so the live
+    tests skip exactly when they should."""
+    expected = (shutil.which('kind') is None
+                or os.environ.get('SKYTPU_LIVE_KIND') != '1')
+    assert requires_kind.args[0] == expected

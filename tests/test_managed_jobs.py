@@ -160,13 +160,16 @@ def test_waiting_pool_and_controllers_as_tasks(enable_fake_cloud,
 
     monkeypatch.setenv('SKYTPU_MAX_CONTROLLERS', '2')
     ids = []
-    for i in range(4):
+    # 3 jobs over 2 slots: exercises WAITING + both controller slots at
+    # one whole job less wall-clock than the original 4 (suite budget,
+    # r4 verdict Next #5).
+    for i in range(3):
         t = Task(f'mj{i}', run='sleep 0.5; echo done')
         t.set_resources(Resources(cloud='local'))
         ids.append(jobs.launch(t, name=f'mj{i}'))
 
     # More submissions than slots: all accepted, none rejected.
-    assert len(ids) == 4
+    assert len(ids) == 3
     scheds = {state.get(j)['schedule_state'] for j in ids}
     assert 'WAITING' in scheds or state.count_live_controllers() <= 2
 

@@ -32,11 +32,13 @@ print('UNREACHABLE-after-guard', flush=True)
 
 
 def test_no_pending_signal_is_a_noop():
+    # Restoration is to WHATEVER was installed before (pytest or other
+    # fixtures may own SIGTERM), not blindly to SIG_DFL.
+    prior = signal.getsignal(signal.SIGTERM)
     with tpu_client_guard.deferred_signals() as pending:
         assert pending == []
-    # Handlers restored: a default SIGTERM disposition again.
-    assert signal.getsignal(signal.SIGTERM) in (
-        signal.SIG_DFL, signal.Handlers.SIG_DFL)
+        assert signal.getsignal(signal.SIGTERM) is not prior
+    assert signal.getsignal(signal.SIGTERM) is prior
 
 
 def test_marker_file_visible_cross_process_and_cleaned():
