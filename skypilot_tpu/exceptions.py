@@ -253,3 +253,10 @@ def deserialize_exception(d: dict) -> Exception:
         return cls(msg)
     except Exception:  # noqa: BLE001 — never let deserialization raise
         return SkyTpuError(f"{d.get('type')}: {msg}")
+
+
+class TransientOauthError(SkyTpuError):
+    """A login-poll failure that leaves the device code usable (IdP
+    timeout, proxy error page, discovery blip): the server answers 503
+    so the CLI's RFC 8628 keep-polling loop retries instead of killing
+    a half-confirmed login (users/oauth.py)."""

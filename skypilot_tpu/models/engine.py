@@ -198,7 +198,8 @@ _jit_sample = jax.jit(sampling.sample)
 
 def _paged_chunk_impl(cfg: llama.LlamaConfig, k_steps: int, params,
                       cache, last: jax.Array, temps: jax.Array,
-                      top_ks, top_ps, active: jax.Array, key: jax.Array):
+                      top_ks, top_ps, active: jax.Array, key: jax.Array,
+                      shard_ctx=None):
     """K decode steps over the PAGED pool (models/paged.py): the
     structural twin of ``_chunk_impl`` with block scatter/gather
     replacing the dense row update."""
@@ -207,7 +208,8 @@ def _paged_chunk_impl(cfg: llama.LlamaConfig, k_steps: int, params,
     def step(carry, key_t):
         cache, last = carry
         logits, cache = paged_lib.forward_paged(params, last[:, None],
-                                                cache, cfg, active)
+                                                cache, cfg, active,
+                                                shard_ctx=shard_ctx)
         nxt = sampling.sample(logits, temps, key_t, top_ks, top_ps)
         return (cache, nxt), nxt
 
@@ -216,7 +218,7 @@ def _paged_chunk_impl(cfg: llama.LlamaConfig, k_steps: int, params,
     return cache, last, toks
 
 
-_jit_paged_chunk = jax.jit(_paged_chunk_impl, static_argnums=(0, 1),
+_jit_paged_chunk = jax.jit(_paged_chunk_impl, static_argnums=(0, 1, 10),
                            donate_argnums=(3, 4))
 
 
@@ -408,11 +410,6 @@ class ContinuousEngine:
                                  'speculative decoding yet (the verify '
                                  'window needs multi-token block '
                                  'writes); use kv_layout=slot')
-            if mesh is not None:
-                raise ValueError('kv_layout=paged is single-device for '
-                                 'now (the block pool carries no '
-                                 'sharding rule); use kv_layout=slot '
-                                 'for sharded serving')
         # Chunked prefill (opt-in): prompts longer than this advance in
         # prefill_chunk-token pieces interleaved with decode chunks, so
         # long admissions don't stall every active slot's stream. Each
@@ -692,9 +689,24 @@ class ContinuousEngine:
         vec = self._vec_sharding if self.mesh is not None else None
         if self.kv_layout == 'paged':
             from skypilot_tpu.models import paged as paged_lib
+            pool_kv = pool_s = None
+            if self.mesh is not None:
+                # The pool shards on kv_heads over the tensor axis (the
+                # same plane as the dense cache); block tables stay
+                # replicated — scatter/gather index replicated dims
+                # only, so the pool ops partition with no collectives.
+                from skypilot_tpu.parallel import sharding as sharding_lib
+                pool_kv = sharding_lib.logical_sharding(
+                    self.mesh, self.rules,
+                    ('layers', None, 'kv_heads', None, 'head_dim'))
+                pool_s = sharding_lib.logical_sharding(
+                    self.mesh, self.rules,
+                    ('layers', None, 'kv_heads', None))
             self._cache = paged_lib.init_pool(
                 self.cfg, self.slots, self.max_len, self.kv_blocks,
-                self.kv_block, quantize=self.kv_quantize)
+                self.kv_block, quantize=self.kv_quantize,
+                kv_sharding=pool_kv, scale_sharding=pool_s,
+                lengths_sharding=vec)
             # Host-side accounting: block 0 is the junk sink, never
             # allocated; per-slot block lists return to the free list
             # when the slot's request completes.
@@ -1257,7 +1269,7 @@ class ContinuousEngine:
             self._cache, self._last, toks = _jit_paged_chunk(
                 self.cfg, self.chunk_steps, self.params, self._cache,
                 self._last, np.asarray(temps), tk, tp,
-                np.asarray(active), self._next_key())
+                np.asarray(active), self._next_key(), self._shard_ctx)
         else:
             self._cache, self._last, toks = _jit_chunk(
                 self.cfg, self.chunk_steps, self.params, self._cache,

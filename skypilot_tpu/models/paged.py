@@ -78,10 +78,18 @@ jax.tree_util.register_dataclass(
 
 def init_pool(cfg: llama.LlamaConfig, slots: int, max_len: int,
               n_blocks: int, block: int,
-              quantize: bool = False) -> PagedKVCache:
+              quantize: bool = False, kv_sharding=None,
+              scale_sharding=None,
+              lengths_sharding=None) -> PagedKVCache:
     """``n_blocks`` INCLUDES block 0 (the junk sink); usable capacity is
     ``(n_blocks - 1) * block`` positions. ``max_blocks`` per slot covers
-    ``max_len`` so a single request can still use its full budget."""
+    ``max_len`` so a single request can still use its full budget.
+
+    Optional shardings allocate the pool BORN sharded for TP serving
+    (kv_heads over the tensor axis — the same plane the dense cache
+    shards). Block tables stay replicated: every scatter/gather indexes
+    the replicated NB/P dims only, so GSPMD partitions the pool ops
+    with zero collectives."""
     if block < 1 or block & (block - 1):
         # Prefill widths are power-of-two buckets: a non-power-of-two
         # block could leave w >= block with w % block != 0, and the
@@ -95,15 +103,18 @@ def init_pool(cfg: llama.LlamaConfig, slots: int, max_len: int,
     mb = max_len // block
     shape = (cfg.n_layers, n_blocks, cfg.n_kv_heads, block, cfg.head_dim)
     tables = jnp.zeros((slots, mb), jnp.int32)
-    lengths = jnp.zeros((slots,), jnp.int32)
+    lengths = jnp.zeros((slots,), jnp.int32, device=lengths_sharding)
     if quantize:
         return PagedKVCache(
-            k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
+            k=jnp.zeros(shape, jnp.int8, device=kv_sharding),
+            v=jnp.zeros(shape, jnp.int8, device=kv_sharding),
             tables=tables, lengths=lengths,
-            k_s=jnp.zeros(shape[:-1], jnp.float32),
-            v_s=jnp.zeros(shape[:-1], jnp.float32))
-    return PagedKVCache(k=jnp.zeros(shape, cfg.dtype),
-                        v=jnp.zeros(shape, cfg.dtype),
+            k_s=jnp.zeros(shape[:-1], jnp.float32,
+                          device=scale_sharding),
+            v_s=jnp.zeros(shape[:-1], jnp.float32,
+                          device=scale_sharding))
+    return PagedKVCache(k=jnp.zeros(shape, cfg.dtype, device=kv_sharding),
+                        v=jnp.zeros(shape, cfg.dtype, device=kv_sharding),
                         tables=tables, lengths=lengths)
 
 
@@ -167,7 +178,8 @@ def _paged_layer(cfg: llama.LlamaConfig, x: jax.Array, layer,
                  lengths: jax.Array, tables: jax.Array,
                  k_pool: jax.Array, v_pool: jax.Array,
                  active_rows: Optional[jax.Array],
-                 k_s: Optional[jax.Array], v_s: Optional[jax.Array]):
+                 k_s: Optional[jax.Array], v_s: Optional[jax.Array],
+                 shard_ctx=None):
     """One decoder block at S=1 over the paged pool. x: [B, 1, d].
     The math is generate.py's (_qkv_proj/_cached_attention/_mlp_tail);
     only the cache write (pool scatter) and read (block gather) differ
@@ -217,7 +229,7 @@ def _paged_layer(cfg: llama.LlamaConfig, x: jax.Array, layer,
     att = _cached_attention(
         q, view(k_pool), view(v_pool), positions, lengths + 1,
         view_s(k_s) if k_s is not None else None,
-        view_s(v_s) if v_s is not None else None)
+        view_s(v_s) if v_s is not None else None, shard_ctx)
     x = x + _mm(att, layer['wo'], 'bshk,hkd->bsd')
     token_mask = None
     if cfg.num_experts > 0:
@@ -231,8 +243,8 @@ def _paged_layer(cfg: llama.LlamaConfig, x: jax.Array, layer,
 
 def forward_paged(params, tokens: jax.Array, cache: PagedKVCache,
                   cfg: llama.LlamaConfig,
-                  active_rows: Optional[jax.Array] = None
-                  ) -> Tuple[jax.Array, PagedKVCache]:
+                  active_rows: Optional[jax.Array] = None,
+                  shard_ctx=None) -> Tuple[jax.Array, PagedKVCache]:
     """One decode step (tokens [B, 1]) over the paged pool; returns
     (last-position logits [B, V], updated cache). The structural twin of
     ``generate.forward_cached`` at S=1 with pool scatter/gather replacing
@@ -249,7 +261,7 @@ def forward_paged(params, tokens: jax.Array, cache: PagedKVCache,
             ks_p = vs_p = None
         x, k_p, v_p, ks_p, vs_p = _paged_layer(
             cfg, x, layer, cache.lengths, cache.tables, k_p, v_p,
-            active_rows, ks_p, vs_p)
+            active_rows, ks_p, vs_p, shard_ctx)
         ys = (k_p, v_p, ks_p, vs_p) if quantized else (k_p, v_p)
         return x, ys
 

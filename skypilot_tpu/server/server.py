@@ -230,11 +230,18 @@ async def oauth_login_poll(request: web.Request) -> web.Response:
     body = await request.json()
     handle = body.get('handle', '')
     loop = asyncio.get_event_loop()
+    from skypilot_tpu import exceptions as exc_lib
     try:
         out = await loop.run_in_executor(
             None, lambda: oauth.poll_device_flow(handle))
-    except Exception as exc:  # noqa: BLE001
+    except exc_lib.TransientOauthError as exc:
+        # Handle still usable: 503 tells the CLI's RFC 8628 loop to
+        # keep polling rather than abort a half-confirmed login.
+        return web.json_response({'error': str(exc)}, status=503)
+    except exc_lib.SkyTpuError as exc:  # fatal protocol outcome
         return web.json_response({'error': str(exc)}, status=400)
+    except Exception as exc:  # noqa: BLE001 — IdP network blip etc.
+        return web.json_response({'error': str(exc)}, status=503)
     return web.json_response(out)
 
 

@@ -140,8 +140,13 @@ def poll_device_flow(handle: str) -> Dict[str, Any]:
     import requests
     from skypilot_tpu import users as users_lib
     # TAKE the handle atomically: a concurrent duplicate poll gets
-    # 'unknown handle' instead of racing toward a second token mint; a
-    # pending outcome puts it back for the next poll.
+    # 'unknown handle' instead of racing toward a second token mint.
+    # The finally-restore puts it back on every outcome that leaves the
+    # device code still usable — pending, AND transient failures (IdP
+    # timeout, HTML error body, discovery blip) — so one network blip
+    # mid-authorization doesn't force the user to restart the whole
+    # flow (review finding). Only a fatal protocol answer or a consumed
+    # code (token endpoint returned 200) retires the handle.
     with _PENDING_LOCK:
         entry = _PENDING.pop(handle, None)
     if entry is None:
@@ -150,24 +155,36 @@ def poll_device_flow(handle: str) -> Dict[str, Any]:
     device_code, expires_at = entry
     if time.time() > expires_at:
         raise exceptions.SkyTpuError('login expired; restart the login')
-    doc = _discover()
-    resp = requests.post(
-        doc['token_endpoint'],
-        data={**_client_auth(), 'device_code': device_code,
-              'grant_type': 'urn:ietf:params:oauth:grant-type:'
-                            'device_code'},
-        timeout=15)
-    body = resp.json() if resp.text else {}
-    if resp.status_code != 200:
-        err = body.get('error', 'unknown')
-        if err in ('authorization_pending', 'slow_down'):
+    restore = True
+    try:
+        doc = _discover()
+        resp = requests.post(
+            doc['token_endpoint'],
+            data={**_client_auth(), 'device_code': device_code,
+                  'grant_type': 'urn:ietf:params:oauth:grant-type:'
+                                'device_code'},
+            timeout=15)
+        try:
+            body = resp.json() if resp.text else {}
+        except ValueError:  # proxy HTML page: transient, keep handle
+            raise exceptions.TransientOauthError(
+                f'IdP returned a non-JSON body '
+                f'({resp.status_code}); retrying')
+        if resp.status_code != 200:
+            err = body.get('error', 'unknown')
+            if err in ('authorization_pending', 'slow_down'):
+                return {'pending': True,
+                        'slow_down': err == 'slow_down'}
+            restore = False  # fatal protocol answer: handle is dead
+            raise exceptions.SkyTpuError(
+                f'device login failed: {err}: '
+                f'{body.get("error_description", "")[:300]}')
+        # 200: the device code is CONSUMED either way from here.
+        restore = False
+    finally:
+        if restore:
             with _PENDING_LOCK:
                 _PENDING[handle] = entry
-            return {'pending': True,
-                    'slow_down': err == 'slow_down'}
-        raise exceptions.SkyTpuError(
-            f'device login failed: {err}: '
-            f'{body.get("error_description", "")[:300]}')
     claims = _userinfo(doc, body)
     email = claims.get('email') or claims.get('sub')
     if not email:

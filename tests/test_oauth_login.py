@@ -25,6 +25,7 @@ class FakeIdp:
         self.approved_email = None
         self.device_codes = set()
         self.token_polls = 0
+        self.fail_next_token_with_html = False
         srv = self
 
         class H(http.server.BaseHTTPRequestHandler):
@@ -70,7 +71,14 @@ class FakeIdp:
                         'expires_in': 300, 'interval': 1})
                 elif self.path == '/token':
                     srv.token_polls += 1
-                    if form.get('device_code') not in srv.device_codes:
+                    if srv.fail_next_token_with_html:
+                        srv.fail_next_token_with_html = False
+                        data = b'<html>502 Bad Gateway</html>'
+                        self.send_response(502)
+                        self.send_header('Content-Type', 'text/html')
+                        self.end_headers()
+                        self.wfile.write(data)
+                    elif form.get('device_code') not in srv.device_codes:
                         self._json(400, {'error': 'invalid_grant'})
                     elif srv.approved_email is None:
                         self._json(400,
@@ -181,6 +189,24 @@ def test_device_login_issues_rbac_scoped_token(oauth_server):
     r = requests_lib.post(f'{url}/oauth/login/poll',
                           json={'handle': flow2['handle']}, timeout=30)
     assert r.status_code == 400
+
+
+def test_transient_idp_failure_keeps_handle_alive(oauth_server):
+    """An IdP blip mid-poll (proxy HTML body) answers 503 — the handle
+    survives and the SAME handle succeeds on the next poll, so the
+    CLI's keep-polling loop never kills a half-confirmed login."""
+    url, idp = oauth_server
+    flow = requests_lib.post(f'{url}/oauth/login/start',
+                             timeout=30).json()
+    idp.fail_next_token_with_html = True
+    r = requests_lib.post(f'{url}/oauth/login/poll',
+                          json={'handle': flow['handle']}, timeout=30)
+    assert r.status_code == 503  # transient: CLI retries on >= 500
+    idp.approve('blip@example.com')
+    r = requests_lib.post(f'{url}/oauth/login/poll',
+                          json={'handle': flow['handle']}, timeout=30)
+    assert r.status_code == 200, r.text
+    assert r.json()['name'] == 'blip@example.com'
 
 
 def test_cli_login_stores_token_and_authenticates(oauth_server,
