@@ -278,13 +278,12 @@ def _insert_cache_impl(cache: gen_lib.KVCache, cache_n: gen_lib.KVCache,
 _jit_insert_cache = jax.jit(_insert_cache_impl, donate_argnums=(0,))
 
 
-def _rewind_impl(cache: gen_lib.KVCache, adj: jax.Array) -> gen_lib.KVCache:
+def _rewind_impl(cache, adj: jax.Array):
     """Per-row rollback: positions past a row's valid length are never
     attended and get overwritten, so rejecting proposals is just a
-    lengths subtraction (models/speculative.py's invariant, per row)."""
-    return gen_lib.KVCache(k=cache.k, v=cache.v,
-                           lengths=cache.lengths - adj,
-                           k_s=cache.k_s, v_s=cache.v_s)
+    lengths subtraction (models/speculative.py's invariant, per row).
+    Works for the dense KVCache and the paged pool alike."""
+    return dataclasses.replace(cache, lengths=cache.lengths - adj)
 
 
 _jit_rewind = jax.jit(_rewind_impl, donate_argnums=(0,))
@@ -321,9 +320,15 @@ def _spec_impl(t_cfg: llama.LlamaConfig, d_cfg: llama.LlamaConfig,
                                        length=k + 1)
     props = props.transpose(1, 0)  # [B, k+1]
     window = jnp.concatenate([last[:, None], props[:, :k]], axis=1)
-    logits_all, t_cache = gen_lib.forward_cached(
-        t_params, window, t_cache, t_cfg, (k + 1) * ones, active,
-        all_logits=True)
+    if isinstance(t_cache, gen_lib.KVCache):
+        logits_all, t_cache = gen_lib.forward_cached(
+            t_params, window, t_cache, t_cfg, (k + 1) * ones, active,
+            all_logits=True)
+    else:  # paged target: multi-token block writes + lengths rewind
+        from skypilot_tpu.models import paged as paged_lib
+        logits_all, t_cache = paged_lib.forward_paged(
+            t_params, window, t_cache, t_cfg, active,
+            shard_ctx=shard_ctx, all_logits=True)
     tgt = jnp.argmax(logits_all, axis=-1).astype(jnp.int32)  # [B, k+1]
     samp = sampling.sample(logits_all[:, 0].astype(jnp.float32), temps,
                            key, top_ks, top_ps)
@@ -404,12 +409,9 @@ class ContinuousEngine:
                              "'slot' or 'paged'")
         self.kv_block = kv_block or int(
             os.environ.get('SKYTPU_LLM_KV_BLOCK', '16'))
-        if self.kv_layout == 'paged':
-            if draft_cfg is not None:
-                raise ValueError('kv_layout=paged does not compose with '
-                                 'speculative decoding yet (the verify '
-                                 'window needs multi-token block '
-                                 'writes); use kv_layout=slot')
+        # paged composes with spec (multi-token paged verify) and TP
+        # (pool sharded on kv_heads); the remaining exclusion is the
+        # prefix pool (dense-row storage), handled below.
         # Chunked prefill (opt-in): prompts longer than this advance in
         # prefill_chunk-token pieces interleaved with decode chunks, so
         # long admissions don't stall every active slot's stream. Each
@@ -547,7 +549,7 @@ class ContinuousEngine:
                 f'prompt ({len(row)}) + max_new ({max_new}) exceeds '
                 f'engine max_len limit {self._submit_max}{extra}')
         if self.kv_layout == 'paged' and max_new > 1:
-            need = -(-(len(row) + max_new) // self.kv_block)
+            need = self._blocks_for(len(row), max_new)
             if need > self.kv_blocks - 1:
                 # Bigger than the WHOLE pool: admission could never
                 # succeed — the request would stall itself and starve
@@ -735,10 +737,19 @@ class ContinuousEngine:
         self._prefix_seen.clear()
         self._prefix_free = list(range(self.prefix_slots))
 
-    def _blocks_needed(self, req: _Request) -> int:
+    def _blocks_for(self, row_len: int, max_new: int) -> int:
         """Blocks reserved at admission: the request's actual ask, not
-        max_len — the paged layout's whole point."""
-        return -(-(len(req.row) + req.max_new) // self.kv_block)
+        max_len — the paged layout's whole point. Spec mode adds the
+        k+1 verify-window overhang: a verify may WRITE that far past
+        the committed length before rollback, and a write diverted to
+        the junk sink would lose KV the round then commits. The ONE
+        definition — submit-time feasibility and admission-time
+        reservation must never disagree."""
+        extra = self.spec_k + 1 if self.draft_cfg is not None else 0
+        return -(-(row_len + max_new + extra) // self.kv_block)
+
+    def _blocks_needed(self, req: _Request) -> int:
+        return self._blocks_for(len(req.row), req.max_new)
 
     def _release_blocks(self, slot: int) -> None:
         if self.kv_layout == 'paged':
@@ -1232,7 +1243,8 @@ class ContinuousEngine:
                     emitted.append((req, new))
                 if hit_eos or len(req.tokens) >= req.max_new:
                     self._slot_req[i] = None  # slot -> junk; committed
-                    done.append(req)          # value no longer matters
+                    self._release_blocks(i)   # value no longer matters
+                    done.append(req)
         # Rollback: both models advanced exactly k+1; keep committed.
         adj = np.int32(k + 1) - committed
         if self.mesh is not None:

@@ -170,8 +170,51 @@ jit_insert = jax.jit(_insert_impl, donate_argnums=(0,))
 
 
 # ---------------------------------------------------------------------------
-# Decode forward (S = 1): scatter this step's K/V, gather the slot's
-# blocks into the standard attention view, reuse the dense math.
+# Decode forwards: scatter the step's K/V, gather the slot's blocks
+# into the standard attention view, reuse the dense math. S=1 is the
+# chunked decode step; S=k+1 is the speculative VERIFY window (writes
+# span up to two blocks per row; rollback afterwards is just a lengths
+# rewind — rolled-back block positions are never attended and get
+# overwritten on the next write, the same invariant as the dense
+# cache).
+
+
+def _block_offsets(tables: jax.Array, lengths: jax.Array, s: int,
+                   p: int, active_rows) -> Tuple[jax.Array, jax.Array]:
+    """Flattened (block ids, in-block offsets) for positions
+    [lengths, lengths+S) per row — the ONE definition of the table
+    lookup (clip past-table writes to the last entry; divert inactive
+    rows to the junk sink) shared by the code and scale planes."""
+    mb = tables.shape[1]
+    pos = lengths[:, None] + jnp.arange(s, dtype=jnp.int32)[None]  # B,S
+    blk = jnp.take_along_axis(
+        tables, jnp.clip(pos // p, 0, mb - 1), axis=1)  # [B, S]
+    if active_rows is not None:
+        blk = jnp.where(active_rows[:, None], blk, 0)
+    return blk.reshape(-1), (pos % p).reshape(-1)
+
+
+def _scatter_multi(pool: jax.Array, tables: jax.Array,
+                   lengths: jax.Array, new: jax.Array,
+                   active_rows) -> jax.Array:
+    """Scatter ``new`` [B, H, S, D] at positions [lengths, lengths+S)
+    per row into ``pool`` [NB, H, P, D] under ``tables`` [B, MB]."""
+    b, h, s, d = new.shape
+    blk, off = _block_offsets(tables, lengths, s, pool.shape[2],
+                              active_rows)
+    vals = new.transpose(0, 2, 1, 3).reshape(b * s, h, d)
+    return pool.at[blk, :, off].set(vals)
+
+
+def _scatter_multi_s(pool_s: jax.Array, tables: jax.Array,
+                     lengths: jax.Array, new_s: jax.Array,
+                     active_rows) -> jax.Array:
+    """[B, H, S] scale-plane counterpart of ``_scatter_multi``."""
+    b, h, s = new_s.shape
+    blk, off = _block_offsets(tables, lengths, s, pool_s.shape[2],
+                              active_rows)
+    vals = new_s.transpose(0, 2, 1).reshape(b * s, h)
+    return pool_s.at[blk, :, off].set(vals)
 
 
 def _paged_layer(cfg: llama.LlamaConfig, x: jax.Array, layer,
@@ -180,41 +223,37 @@ def _paged_layer(cfg: llama.LlamaConfig, x: jax.Array, layer,
                  active_rows: Optional[jax.Array],
                  k_s: Optional[jax.Array], v_s: Optional[jax.Array],
                  shard_ctx=None):
-    """One decoder block at S=1 over the paged pool. x: [B, 1, d].
-    The math is generate.py's (_qkv_proj/_cached_attention/_mlp_tail);
-    only the cache write (pool scatter) and read (block gather) differ
-    from the dense layer."""
-    b = x.shape[0]
+    """One decoder block at S>=1 over the paged pool. x: [B, S, d]
+    (S=1 decode step; S=k+1 speculative verify). The math is
+    generate.py's (_qkv_proj/_cached_attention/_mlp_tail); only the
+    cache write (pool scatter) and read (block gather) differ from the
+    dense layer. INACTIVE rows scatter to the junk sink (block 0)
+    unconditionally: a freed slot's stale table may point at blocks
+    already reallocated to another request, and an unmasked junk write
+    there would corrupt the new owner's live KV. Within a chunk a
+    finishing row stays active and its blocks are only released after
+    the chunk returns, so active writes never race a reallocation."""
+    b, s = x.shape[0], x.shape[1]
     p = k_pool.shape[3]
     mb = tables.shape[1]
-    positions = lengths[:, None]  # [B, 1]
+    positions = (lengths[:, None]
+                 + jnp.arange(s, dtype=jnp.int32)[None])  # [B, S]
     q, k, v = _qkv_proj(cfg, x, layer, positions)
-    # Scatter the new position: block table entry len//P (clamped so a
-    # junk row grown past its table writes its LAST entry), offset
-    # len%P. INACTIVE rows write to the junk sink (block 0)
-    # unconditionally: a freed slot's stale table may point at blocks
-    # already reallocated to another request, and an unmasked junk
-    # write there would corrupt the new owner's live KV (review
-    # finding). Within a chunk a finishing row stays active and its
-    # blocks are only released after the chunk returns, so active
-    # writes never race a reallocation.
-    rows = jnp.arange(b)
-    blk = tables[rows, jnp.clip(lengths // p, 0, mb - 1)]  # [B]
-    if active_rows is not None:
-        blk = jnp.where(active_rows, blk, 0)
-    off = lengths % p
-    kt = k[:, 0]  # [B, Hkv, D]
-    vt = v[:, 0]
+    kt = k.transpose(0, 2, 1, 3)  # [B, Hkv, S, D]
+    vt = v.transpose(0, 2, 1, 3)
     if k_s is not None:
-        k8, ks_new = _quantize_block(kt[:, :, None, :])  # [B,H,1,D]
-        v8, vs_new = _quantize_block(vt[:, :, None, :])
-        k_pool = k_pool.at[blk, :, off].set(k8[:, :, 0])
-        v_pool = v_pool.at[blk, :, off].set(v8[:, :, 0])
-        k_s = k_s.at[blk, :, off].set(ks_new[:, :, 0])
-        v_s = v_s.at[blk, :, off].set(vs_new[:, :, 0])
+        k8, ks_new = _quantize_block(kt)
+        v8, vs_new = _quantize_block(vt)
+        k_pool = _scatter_multi(k_pool, tables, lengths, k8, active_rows)
+        v_pool = _scatter_multi(v_pool, tables, lengths, v8, active_rows)
+        k_s = _scatter_multi_s(k_s, tables, lengths, ks_new, active_rows)
+        v_s = _scatter_multi_s(v_s, tables, lengths, vs_new, active_rows)
     else:
-        k_pool = k_pool.at[blk, :, off].set(kt.astype(k_pool.dtype))
-        v_pool = v_pool.at[blk, :, off].set(vt.astype(v_pool.dtype))
+        k_pool = _scatter_multi(k_pool, tables, lengths,
+                                kt.astype(k_pool.dtype), active_rows)
+        v_pool = _scatter_multi(v_pool, tables, lengths,
+                                vt.astype(v_pool.dtype), active_rows)
+
     # Gather: [B, MB, H, P, D] -> [B, H, MB*P, D] attention view.
     def view(pool):
         g = pool[tables]  # [B, MB, H, P, D]
@@ -227,13 +266,13 @@ def _paged_layer(cfg: llama.LlamaConfig, x: jax.Array, layer,
         return g.reshape(b, g.shape[1], mb * p)
 
     att = _cached_attention(
-        q, view(k_pool), view(v_pool), positions, lengths + 1,
+        q, view(k_pool), view(v_pool), positions, lengths + s,
         view_s(k_s) if k_s is not None else None,
         view_s(v_s) if v_s is not None else None, shard_ctx)
     x = x + _mm(att, layer['wo'], 'bshk,hkd->bsd')
     token_mask = None
     if cfg.num_experts > 0:
-        mask = jnp.ones((b, 1), bool)
+        mask = jnp.ones((b, s), bool)
         if active_rows is not None:
             mask = mask & active_rows[:, None]
         token_mask = mask.astype(x.dtype)
@@ -244,12 +283,17 @@ def _paged_layer(cfg: llama.LlamaConfig, x: jax.Array, layer,
 def forward_paged(params, tokens: jax.Array, cache: PagedKVCache,
                   cfg: llama.LlamaConfig,
                   active_rows: Optional[jax.Array] = None,
-                  shard_ctx=None) -> Tuple[jax.Array, PagedKVCache]:
-    """One decode step (tokens [B, 1]) over the paged pool; returns
-    (last-position logits [B, V], updated cache). The structural twin of
-    ``generate.forward_cached`` at S=1 with pool scatter/gather replacing
-    the dense row update."""
+                  shard_ctx=None,
+                  all_logits: bool = False
+                  ) -> Tuple[jax.Array, PagedKVCache]:
+    """Run ``tokens`` [B, S] over the paged pool (S=1 decode step;
+    S=k+1 speculative verify); returns (logits, cache advanced S).
+    ``all_logits`` returns per-POSITION logits [B, S, V] (the verify
+    needs the target's prediction after every proposed token). The
+    structural twin of ``generate.forward_cached`` with pool
+    scatter/gather replacing the dense row update."""
     x = params['embed'].astype(cfg.dtype)[tokens]
+    s = tokens.shape[1]
     quantized = cache.quantized
 
     def body(carry, xs):
@@ -273,9 +317,12 @@ def forward_paged(params, tokens: jax.Array, cache: PagedKVCache,
         x, (new_k, new_v) = jax.lax.scan(body, x, xs)
         new_ks = new_vs = None
     x = llama.rms_norm(x, params['final_norm'], cfg.norm_eps)
+    new_cache = PagedKVCache(k=new_k, v=new_v, tables=cache.tables,
+                             lengths=cache.lengths + s,
+                             k_s=new_ks, v_s=new_vs)
+    if all_logits:
+        return (_mm(x, params['lm_head'], 'bsd,dv->bsv',
+                    preferred_element_type=jnp.float32), new_cache)
     logits = _mm(x[:, -1], params['lm_head'], 'bd,dv->bv',
                  preferred_element_type=jnp.float32)
-    new_cache = PagedKVCache(k=new_k, v=new_v, tables=cache.tables,
-                             lengths=cache.lengths + 1,
-                             k_s=new_ks, v_s=new_vs)
     return logits, new_cache

@@ -185,7 +185,18 @@ def poll_device_flow(handle: str) -> Dict[str, Any]:
         if restore:
             with _PENDING_LOCK:
                 _PENDING[handle] = entry
-    claims = _userinfo(doc, body)
+    try:
+        claims = _userinfo(doc, body)
+    except exceptions.SkyTpuError:
+        raise
+    except Exception as exc:  # noqa: BLE001 — userinfo network blip
+        # The device code is already consumed: a retry can never
+        # succeed, so this must be FATAL (400) with the real cause —
+        # not the generic-transient 503 that would send the CLI into a
+        # doomed re-poll ending in 'unknown handle' (review finding).
+        raise exceptions.SkyTpuError(
+            f'identity fetch failed after the device code was consumed '
+            f'({exc}); restart the login') from exc
     email = claims.get('email') or claims.get('sub')
     if not email:
         raise exceptions.SkyTpuError(

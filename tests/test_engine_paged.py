@@ -249,9 +249,6 @@ def test_paged_tp_with_kv_int8(tiny):
 def test_paged_gates():
     cfg = llama.TINY
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
-    with pytest.raises(ValueError, match='speculative'):
-        engine_lib.ContinuousEngine(params, cfg, kv_layout='paged',
-                                    draft_params=params, draft_cfg=cfg)
     with pytest.raises(ValueError, match='multiple of the'):
         engine_lib.ContinuousEngine(params, cfg, kv_layout='paged',
                                     max_len=72, kv_block=16,
