@@ -445,11 +445,11 @@ class ContinuousEngine:
         # dense models, where rows are independent.
         if cfg.num_experts > 0:
             self.prefix_slots = 0
-        if self.kv_layout == 'paged':
-            # The prefix pool stores dense max_len rows; composing it
-            # with block tables is future work (compat matrix,
-            # docs/serving.md).
-            self.prefix_slots = 0
+        # The prefix pool composes with BOTH cache layouts: it lives
+        # entirely on the dense prefill side (pool rows, gather, store
+        # all operate on the prefilled cache_n before insert), and the
+        # paged insert scatters the seeded rows into blocks like any
+        # other prefill.
         self.prefix_min = 16  # smallest cacheable/matchable prefix
         self._prefix_index: 'collections.OrderedDict[tuple, int]' = \
             collections.OrderedDict()  # prefix tokens -> pool row

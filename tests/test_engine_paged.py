@@ -212,6 +212,26 @@ def test_paged_freed_slot_junk_never_corrupts_reallocated_blocks(tiny):
         eng.stop()
 
 
+def test_paged_prefix_cache_exact_on_repeat(tiny):
+    """Prefix pool x paged: the pool lives on the dense prefill side
+    (gather/store on cache_n) and the paged insert scatters the seeded
+    rows into blocks — repeats hit the pool and stay byte-exact."""
+    cfg, params = tiny
+    eng = _mk(params, cfg, prefix_slots=4)
+    try:
+        row = list(range(40, 60)) + [7, 8, 9]  # 23 tokens: 16-bucket
+        want = _solo(params, cfg, row, 6)
+        assert eng.submit(row, 6).result(timeout=120) == want
+        assert eng.submit(row, 6).result(timeout=120) == want
+        assert eng.submit(row, 6).result(timeout=120) == want
+        st = eng.stats()
+        assert st['prefix_cache']['hits'] >= 1
+        assert st['prefix_cache']['stores'] >= 1
+        assert st['kv_blocks']['free'] == st['kv_blocks']['total'] - 1
+    finally:
+        eng.stop()
+
+
 def test_paged_tensor_parallel_matches_single_device(tiny):
     """Paged + TP: the pool shards on kv_heads over the tensor axis
     (tables replicated — scatter/gather index replicated dims only),
