@@ -606,7 +606,12 @@ class ContinuousEngine:
                 'kv_layout': self.kv_layout,
                 'kv_blocks': (None if self.kv_layout != 'paged' else {
                     'total': self.kv_blocks, 'block': self.kv_block,
-                    'free': len(self._free_blocks)}),
+                    'free': len(self._free_blocks),
+                    # used/usable are authoritative here (block 0 is
+                    # the junk sink): consumers must not re-derive the
+                    # convention (review finding).
+                    'usable': self.kv_blocks - 1,
+                    'used': self.kv_blocks - 1 - len(self._free_blocks)}),
                 'queued': queued, 'prefills': self.prefills,
                 'prefill_groups': self.prefill_groups,
                 'prefill_batch': self.prefill_batch,

@@ -688,6 +688,12 @@ function healthCell(h){
     const pc = e.prefix_cache;
     if(pc && pc.slots > 0 && (pc.hits + pc.stores) > 0)
       parts.push(`pfx ${pc.hits} hit`);
+    const sp = e.speculative;
+    if(sp && sp.rounds > 0)
+      parts.push(`spec ${Math.round((sp.acceptance_rate||0)*100)}%`);
+    const kb = e.kv_blocks;
+    if(kb && kb.usable > 0)
+      parts.push(`${kb.used}/${kb.usable} blk`);
     if(h.kv_cache === 'int8') parts.push('kv8');
     if(h.quantize) parts.push(h.quantize);  // outer esc covers it
     return esc(parts.join(', '));
