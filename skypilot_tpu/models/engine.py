@@ -601,17 +601,22 @@ class ContinuousEngine:
         with self._lock:
             active = sum(r is not None for r in self._slot_req)
             queued = len(self._pending)
+            # ONE read: free and used must agree within a snapshot
+            # (used + free == usable), or the dashboard can render an
+            # impossible state mid-admission.
+            free_blocks = (len(self._free_blocks)
+                           if self.kv_layout == 'paged' else 0)
         return {'slots': self.slots, 'active_slots': active,
                 'kv_cache': 'int8' if self.kv_quantize else 'bf16',
                 'kv_layout': self.kv_layout,
                 'kv_blocks': (None if self.kv_layout != 'paged' else {
                     'total': self.kv_blocks, 'block': self.kv_block,
-                    'free': len(self._free_blocks),
+                    'free': free_blocks,
                     # used/usable are authoritative here (block 0 is
                     # the junk sink): consumers must not re-derive the
                     # convention (review finding).
                     'usable': self.kv_blocks - 1,
-                    'used': self.kv_blocks - 1 - len(self._free_blocks)}),
+                    'used': self.kv_blocks - 1 - free_blocks}),
                 'queued': queued, 'prefills': self.prefills,
                 'prefill_groups': self.prefill_groups,
                 'prefill_batch': self.prefill_batch,

@@ -568,11 +568,19 @@ def doctor_report(probe_timeout_s: float = 90.0,
     """Full diagnosis: process table + relay sockets + (optionally) the
     phased init probe. Self-adjudicating: ``verdict`` says whether a
     failure is explainable by local framework debris or is relay-side."""
+    from skypilot_tpu.utils import tpu_client_guard
     procs = framework_processes()
     relay = relay_state()
     report: Dict[str, Any] = {
         'framework_processes': procs,
         'relay': relay,
+        # Pids currently inside a guarded backend init (marker age in
+        # seconds): a wedge diagnosis must distinguish "a client is
+        # mid-handshake right now" from "nothing local is talking to
+        # the relay at all".
+        'guarded_init': {str(pid): round(age, 1) for pid, age in
+                         tpu_client_guard.guarded_init_pids().items()},
+        'probe_child': live_probe_child(),
     }
     if probe:
         report['probe'] = probe_backend(probe_timeout_s)
