@@ -143,7 +143,79 @@ def _measure_decode_throughput(cfg):
     # tok/s vs 5.8k int8-weights-only on one v5e chip).
     best = max(best, sweep('int8+kv8', q, kv=True,
                            batches=(64, 128, 192)))
+    # Continuous-engine A/B: pipelined dispatch (one chunk in flight,
+    # host bookkeeping overlapped) vs the serial engine on the same
+    # weights and load. Reported alongside the generate()-path variants
+    # but kept OUT of `best` — the engine number includes admission/
+    # prefill, a different quantity than the pure decode sweeps above.
+    try:
+        per_variant.update(_measure_engine_decode(cfg.model, q))
+    except Exception as exc:  # noqa: BLE001 — A/B must not kill capture
+        print(f'[bench] engine decode A/B failed '
+              f'({type(exc).__name__}: {str(exc)[:160]})',
+              file=sys.stderr)
     return best, per_variant
+
+
+def engine_ab_rates(engines: dict, rows_lens: list, rounds: int,
+                    timeout: float) -> dict:
+    """The ONE engine A/B measurement protocol, shared with
+    ``tools/perf_probe.py --smoke``: one full concurrent warmup round
+    per engine (sequential submits would leave the grouped-prefill
+    shapes uncompiled and bill them to a measured round), then
+    back-to-back rounds with order alternating — each pair shares one
+    machine state, so per-round comparisons are drift-immune where raw
+    tok/s is not. Returns {label: [tok/s per round]}."""
+    import time as _time
+
+    rates: dict = {label: [] for label in engines}
+    for eng in engines.values():
+        for f in [eng.submit(r, n) for r, n in rows_lens]:
+            f.result(timeout=timeout)
+    for i in range(rounds):
+        order = list(engines.items())
+        if i % 2:
+            order.reverse()
+        for label, eng in order:
+            t0 = _time.perf_counter()
+            futs = [eng.submit(r, n) for r, n in rows_lens]
+            toks = sum(len(f.result(timeout=timeout)) for f in futs)
+            rates[label].append(toks / (_time.perf_counter() - t0))
+    return rates
+
+
+def _measure_engine_decode(model_cfg, params) -> dict:
+    """Continuous-engine decode tokens/s, ``pipelined`` vs ``serial``
+    dispatch (models/engine.py): the pipelined engine dispatches chunk
+    N+1 before fetching chunk N, hiding per-chunk host bookkeeping
+    (device_get, EOS truncation, admission) behind device compute —
+    the per-chunk bubble that caps the serial engine on a
+    remote-attached chip. int8 KV (the lean serving config); per-variant
+    MEDIAN over paired rounds so one scheduler hiccup or thermal phase
+    decides neither side."""
+    import statistics
+
+    from skypilot_tpu.models.engine import ContinuousEngine
+
+    prompt_len, new_tokens, n_req = 128, 128, 64
+    rows = [[(37 * i + j) % 1000 + 1 for j in range(prompt_len)]
+            for i in range(n_req)]
+    engines = {
+        label: ContinuousEngine(params, model_cfg, slots=32, max_len=512,
+                                kv_quantize=True, pipeline=pipe)
+        for label, pipe in (('serial', False), ('pipelined', True))}
+    try:
+        rates = engine_ab_rates(engines, [(r, new_tokens) for r in rows],
+                                rounds=3, timeout=600)
+    finally:
+        for eng in engines.values():
+            eng.stop()
+    out = {}
+    for label, rs in rates.items():
+        out[label] = round(statistics.median(rs), 1)
+        print(f"[bench] engine decode {label}: {out[label]} tok/s "
+              f"(rounds: {[round(r, 1) for r in rs]})", file=sys.stderr)
+    return out
 
 
 def _measure_provision_to_first_step() -> float:

@@ -20,6 +20,18 @@ TPU-first shape discipline (everything compiles exactly once per shape):
 * decode runs in K-step ``lax.scan`` chunks, amortizing the host→device
   dispatch round trip (the dominant per-step cost on a remote-attached
   chip); K=1 recovers per-token latency;
+* chunks are PIPELINED one deep (``SKYTPU_LLM_PIPELINE``, default on):
+  chunk N+1 is dispatched against the current slot snapshot BEFORE
+  chunk N's tokens are fetched, so ``jax.device_get``, stop-token
+  truncation, callback firing, slot freeing, admission, and chunked
+  prefill all run while the device computes the next chunk. Safe
+  because slots are static and junk rows are masked: a slot that
+  finished in chunk N just decodes one discardable chunk more (the
+  stale-snapshot guard drops its tokens), and reuse overwrites
+  ``lengths`` at insert exactly as speculative rollback does. Depth is
+  capped at ONE so a paged slot's stale-active writes always precede
+  (in device program order) any insert that re-populates its released
+  blocks — see ``_dispatch_chunk``;
 * inserts are ``dynamic_update_slice`` on the batch axis and the big
   cache buffers are donated, so steady state allocates nothing.
 
@@ -70,6 +82,7 @@ import concurrent.futures
 import dataclasses
 import os
 import threading
+import time
 from typing import List, Optional
 
 import jax
@@ -115,6 +128,24 @@ class _Prefilling:
     @property
     def parked(self) -> bool:
         return self.first is not None
+
+
+@dataclasses.dataclass
+class _Inflight:
+    """One dispatched-but-unfetched decode chunk: the slot snapshot it
+    was dispatched against plus the device handle for its tokens. The
+    snapshot is what retirement emits against — a slot freed (or
+    reused) after dispatch fails the ``_slot_req[i] is req`` identity
+    check and its tokens are dropped as junk."""
+    reqs: List[Optional[_Request]]
+    toks: jax.Array
+    steps: int
+
+
+# Idle engine pacing: the loop parks in _wake.wait(_IDLE_WAIT_S) when no
+# slot is active — submit() sets the event, so the wait length only
+# bounds how often an IDLE replica spins, not admission latency.
+_IDLE_WAIT_S = 1.0
 
 
 def prompt_bucket(n: int, lo: int = 16) -> int:
@@ -357,7 +388,8 @@ class ContinuousEngine:
                  spec_k: Optional[int] = None,
                  kv_layout: Optional[str] = None,
                  kv_blocks: Optional[int] = None,
-                 kv_block: Optional[int] = None):
+                 kv_block: Optional[int] = None,
+                 pipeline: Optional[bool] = None):
         self.params = params
         self.cfg = cfg
         # Speculative mode (see module docstring): draft proposes,
@@ -409,6 +441,25 @@ class ContinuousEngine:
                              "'slot' or 'paged'")
         self.kv_block = kv_block or int(
             os.environ.get('SKYTPU_LLM_KV_BLOCK', '16'))
+        # Pipelined dispatch (default ON): keep one decode chunk in
+        # flight so all host bookkeeping overlaps device compute (see
+        # module docstring / _run_chunk). Depth 0 = the serial engine.
+        if pipeline is None:
+            pipeline = os.environ.get('SKYTPU_LLM_PIPELINE', '1') != '0'
+        self.pipeline_depth = 1 if pipeline else 0
+        if cfg.num_experts > 0:
+            # Expert capacity is per forward CALL and couples co-batched
+            # rows: an in-flight chunk runs with a slot-snapshot active
+            # mask one retirement stale, so a row freed meanwhile would
+            # still consume capacity and change LIVE rows' routing vs
+            # the serial oracle — the same coupling that disables
+            # chunked prefill and the prefix pool for MoE.
+            self.pipeline_depth = 0
+        if draft_cfg is not None:
+            # Speculative rounds are host-synchronous by construction:
+            # acceptance decides the rollback that shapes the next
+            # round's inputs, so there is nothing to keep in flight.
+            self.pipeline_depth = 0
         # paged composes with spec (multi-token paged verify) and TP
         # (pool sharded on kv_heads); the remaining exclusion is the
         # prefix pool (dense-row storage), handled below.
@@ -509,6 +560,10 @@ class ContinuousEngine:
         self._stop = False
         self._thread: Optional[threading.Thread] = None
         self._key = jax.random.PRNGKey(seed)
+        # Pipeline state: at most ONE dispatched-but-unfetched chunk.
+        self._inflight: Optional[_Inflight] = None
+        self._last_dispatch_t: Optional[float] = None
+        self._no_flight_since: Optional[float] = None
         # Stats (read by /health).
         self.prefills = 0
         self.prefill_groups = 0
@@ -522,6 +577,14 @@ class ContinuousEngine:
         self.spec_rounds = 0
         self.spec_proposals = 0
         self.spec_accepted = 0
+        # Overlap observability (see stats()['pipeline']): host work
+        # done while a chunk computes vs host time the device provably
+        # idled with work waiting (the serial-mode bubble).
+        self.dispatches = 0
+        self.host_overlap_ms = 0.0
+        self.bubble_ms = 0.0
+        self._gap_ms_total = 0.0
+        self._gap_count = 0
 
     # -- public API (any thread) ------------------------------------------
 
@@ -627,6 +690,20 @@ class ContinuousEngine:
                 'chunk_steps': self.chunk_steps,
                 'tokens_emitted': self.tokens_emitted,
                 'peak_active_slots': self.peak_active,
+                # Decode-dispatch pipeline: depth 1 = one chunk kept in
+                # flight (host bookkeeping overlaps device compute);
+                # depth 0 = serial (MoE / speculative / opted out).
+                # host_overlap_ms and bubble_ms are CUMULATIVE;
+                # dispatch_gap_ms is the mean host-side gap between
+                # consecutive chunk dispatches.
+                'pipeline': {
+                    'pipeline_depth': self.pipeline_depth,
+                    'dispatches': self.dispatches,
+                    'dispatch_gap_ms': round(
+                        self._gap_ms_total / max(self._gap_count, 1),
+                        3),
+                    'host_overlap_ms': round(self.host_overlap_ms, 3),
+                    'bubble_ms': round(self.bubble_ms, 3)},
                 'speculative': None if self.draft_cfg is None else {
                     'k': self.spec_k,
                     'rounds': self.spec_rounds,
@@ -649,13 +726,29 @@ class ContinuousEngine:
             try:
                 # Prefill advance BEFORE admission: a parked finished
                 # prefill must win a freed slot over younger shorts.
+                t0 = time.perf_counter()
                 self._advance_prefill()
                 self._admit()
+                if self._inflight is not None:
+                    # Prefill/admission dispatches issued while a chunk
+                    # computes are pure overlap — the host work this
+                    # pipeline exists to hide.
+                    self.host_overlap_ms += (time.perf_counter() - t0) \
+                        * 1e3
                 if not any(r is not None for r in self._slot_req):
+                    # Every request in a still-in-flight chunk's
+                    # snapshot is done by now (a live one would occupy
+                    # its slot), so the flush just drops junk tokens.
+                    self._flush_pipeline(quiet=True)
                     self._drain_firsts()  # e.g. all-max_new==1 traffic
+                    self._note_decode_quiet()
                     if self._prefilling:
                         continue  # keep chunking the long prompt
-                    self._wake.wait(0.05)
+                    # Long wait, event-paced: submit() sets _wake, and
+                    # the loop re-checks _pending at the top either
+                    # way, so a sleeping replica admits immediately
+                    # instead of burning a core on a 50 ms poll.
+                    self._wake.wait(_IDLE_WAIT_S)
                     self._wake.clear()
                     continue
                 if self.draft_cfg is not None:
@@ -684,6 +777,13 @@ class ContinuousEngine:
             self._unfetched = []
             self._admitting = []
             self._prefilling = []
+            # Drop the in-flight chunk with the device state: its toks
+            # handle chains off buffers the failed dispatch may have
+            # consumed, and its snapshot requests are all in the doomed
+            # list (or already resolved) via _slot_req.
+            self._inflight = None
+            self._last_dispatch_t = None
+            self._no_flight_since = None
         for req in doomed:  # dupes are safe: first set_exception wins
             if not req.future.done():
                 req.future.set_exception(exc)
@@ -1212,9 +1312,13 @@ class ContinuousEngine:
         # emission counts on every admitted request's token list already
         # holding its prefill token.
         self._drain_firsts()
-        props_h = np.asarray(jax.device_get(props))  # [B, k+1]
-        tgt_h = np.asarray(jax.device_get(tgt))      # [B, k+1]
-        samp_h = np.asarray(jax.device_get(samp))    # [B]
+        # ONE fused fetch: three sequential device_gets would pay three
+        # host↔device relay round trips per round; the tuple transfer
+        # pays one.
+        props_h, tgt_h, samp_h = (
+            np.asarray(a)
+            for a in jax.device_get((props, tgt, samp)))
+        # props_h/tgt_h: [B, k+1]; samp_h: [B]
         self.spec_rounds += 1
         self.chunks_run += 1
         committed = np.ones((self.slots,), np.int32)
@@ -1273,6 +1377,40 @@ class ContinuousEngine:
                 req.future.set_result(req.tokens)
 
     def _run_chunk(self) -> None:
+        """Dispatch one decode chunk and retire its predecessor.
+
+        Pipelined (``pipeline_depth == 1``, the default): chunk N+1 is
+        dispatched against the current slot snapshot BEFORE chunk N's
+        tokens are fetched, so N's ``device_get``, stop-token
+        truncation, callback firing, slot freeing — and the admission /
+        prefill work at the top of the next loop iteration — all run
+        while the device computes N+1. Greedy output is byte-identical
+        to the serial engine: rows are attention-independent, and a
+        slot that finished in N just decodes one discardable chunk more
+        (the retirement guard drops it; the reuse insert overwrites
+        ``lengths``). Serial (depth 0): dispatch, fetch, bookkeep — the
+        device idles through all host work (the measured bubble)."""
+        prev, self._inflight = self._inflight, self._dispatch_chunk()
+        if prev is not None:
+            self._retire_chunk(prev)
+        if self.pipeline_depth == 0:
+            self._flush_pipeline()
+
+    def _dispatch_chunk(self) -> _Inflight:
+        """Issue (async) one K-step decode chunk over ALL slots against
+        the current slot snapshot. Dispatch and retirement strictly
+        alternate (one of each per _run_chunk), which is exactly the
+        paged layout's safety boundary: a slot is freed (blocks
+        released) during retirement of chunk N, so exactly ONE chunk —
+        N+1, dispatched just before that retirement — runs with the
+        slot stale-active, writing junk through its own still-current
+        device-side block table; any insert reusing the released
+        blocks is dispatched at a LATER admission, after N+1, so the
+        donated-pool dependency chain orders the junk writes before
+        the insert that overwrites them. A deeper pipeline would let a
+        chunk dispatched with a stale snapshot land AFTER such an
+        insert and corrupt the new owner's KV — do not raise the depth
+        without revisiting this argument."""
         with self._lock:
             reqs = list(self._slot_req)
         temps = np.zeros((self.slots,), np.float32)
@@ -1287,6 +1425,20 @@ class ContinuousEngine:
                 active[i] = True
         self.peak_active = max(self.peak_active, int(active.sum()))
         tk, tp = _filters_or_none(top_ks, top_ps)
+        now = time.perf_counter()
+        if self._last_dispatch_t is not None:
+            # Gaps across quiet stretches are excluded (the baseline is
+            # nulled in _note_decode_quiet), so the mean divides by the
+            # gaps actually recorded, not dispatches - 1.
+            self._gap_ms_total += (now - self._last_dispatch_t) * 1e3
+            self._gap_count += 1
+        self._last_dispatch_t = now
+        if self._no_flight_since is not None:
+            # Host time spent with slots waiting and nothing on the
+            # device: the serial-mode bubble pipelining closes.
+            self.bubble_ms += (now - self._no_flight_since) * 1e3
+            self._no_flight_since = None
+        self.dispatches += 1
         if self.kv_layout == 'paged':
             self._cache, self._last, toks = _jit_paged_chunk(
                 self.cfg, self.chunk_steps, self.params, self._cache,
@@ -1297,27 +1449,61 @@ class ContinuousEngine:
                 self.cfg, self.chunk_steps, self.params, self._cache,
                 self._last, np.asarray(temps), tk, tp,
                 np.asarray(active), self._next_key(), self._shard_ctx)
-        # The chunk is dispatched (async); fetch deferred first tokens
-        # while it runs on-device — emission below counts on every
-        # admitted request's token list already holding its first token.
+        return _Inflight(reqs=reqs, toks=toks, steps=self.chunk_steps)
+
+    def _note_decode_quiet(self) -> None:
+        """The decode pipeline went quiet (no active slot): stop the
+        bubble clock — idle waiting and prefill-only compute are not
+        device-idle-with-decode-waiting — and the dispatch-gap baseline
+        (the gap across a quiet stretch is not chunk cadence). Called
+        by both the plain and the SPMD lockstep loop's idle branch."""
+        self._no_flight_since = None
+        self._last_dispatch_t = None
+
+    def _flush_pipeline(self, quiet: bool = False) -> None:
+        """Retire the in-flight chunk (if any) and mark the device
+        idle-with-host-working so time until the next dispatch counts
+        as bubble (cleared again when the loop goes truly idle).
+        ``quiet``: this is the idle branch draining a junk-only chunk —
+        no decode work is waiting, so its bookkeeping time counts
+        toward neither overlap nor bubble."""
+        flight, self._inflight = self._inflight, None
+        if flight is not None:
+            self._retire_chunk(flight, quiet=quiet)
+        if self._no_flight_since is None:
+            self._no_flight_since = time.perf_counter()
+
+    def _retire_chunk(self, flight: _Inflight,
+                      quiet: bool = False) -> None:
+        """Fetch a dispatched chunk's tokens and run all host-side
+        bookkeeping: EOS truncation, streaming callbacks, slot freeing,
+        future resolution. Under pipelining this runs while the NEXT
+        chunk computes on-device."""
+        # Fetch deferred first tokens first — emission counts on every
+        # admitted request's token list already holding its prefill
+        # token (and a first-token-eos resolved here frees its slot
+        # before this chunk's junk for it could be appended).
         self._drain_firsts()
-        toks_host = np.asarray(jax.device_get(toks))  # [K, B]
+        toks_host = np.asarray(jax.device_get(flight.toks))  # [K, B]
+        t0 = time.perf_counter()
         self.chunks_run += 1
         done: List[_Request] = []
         emitted: List[tuple] = []
         with self._lock:
-            for i, req in enumerate(reqs):
+            for i, req in enumerate(flight.reqs):
                 if req is None or self._slot_req[i] is not req \
                         or req.future.done():
-                    # Stale snapshot entry: _drain_firsts (between this
-                    # chunk's dispatch and its fetch) may have resolved
-                    # a first-token-eos request and freed its slot —
+                    # Stale snapshot entry: between this chunk's
+                    # dispatch and its retirement, _drain_firsts may
+                    # have resolved a first-token-eos request, or the
+                    # PREVIOUS retirement freed the slot (possibly
+                    # already reused by a younger admission) —
                     # appending this chunk's tokens would mutate a list
                     # already handed to the future and leak post-eos
-                    # tokens to streaming clients.
+                    # junk to streaming clients.
                     continue
                 need = req.max_new - len(req.tokens)
-                take = min(need, self.chunk_steps)
+                take = min(need, flight.steps)
                 new = [int(t) for t in toks_host[:take, i]]
                 # Stop at the first stop id; the slot frees now instead
                 # of burning max_new's tail.
@@ -1334,3 +1520,10 @@ class ContinuousEngine:
         for req in done:
             if not req.future.done():
                 req.future.set_result(req.tokens)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        if self._inflight is not None:
+            self.host_overlap_ms += dt_ms  # a chunk computed meanwhile
+        elif not quiet:
+            self.bubble_ms += dt_ms  # serial: the device sat idle
+        # quiet flush: junk-only drop with no decode work waiting —
+        # neither overlap nor bubble.

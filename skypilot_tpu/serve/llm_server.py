@@ -83,7 +83,8 @@ class LlmServer:
                  prefix_cache: Optional[int] = None,
                  draft_model: Optional[str] = None,
                  kv_layout: Optional[str] = None,
-                 kv_blocks: Optional[int] = None):
+                 kv_blocks: Optional[int] = None,
+                 pipeline: Optional[str] = None):
         self.model_name = model
         self.cfg = llama.PRESETS[model]
         self.max_len = min(max_len, self.cfg.max_seq_len)
@@ -106,6 +107,14 @@ class LlmServer:
         # HBM); 0/None = engine default (full capacity, always safe).
         self.kv_blocks = kv_blocks or int(
             os.environ.get('SKYTPU_LLM_KV_BLOCKS', '0')) or None
+        # Pipelined decode dispatch (models/engine.py): 'on' keeps one
+        # chunk in flight so host bookkeeping overlaps device compute;
+        # 'off' = the serial engine (A/B and debugging). None defers to
+        # SKYTPU_LLM_PIPELINE inside the engine (default on).
+        if pipeline not in (None, 'on', 'off'):
+            raise ValueError(f'Unknown pipeline {pipeline!r}; '
+                             "'on' or 'off'")
+        self.pipeline = pipeline
         self.quantize = quantize or os.environ.get('SKYTPU_LLM_QUANTIZE')
         if self.quantize and self.quantize != 'int8':
             raise ValueError(f'Unknown quantization {self.quantize!r}; '
@@ -229,7 +238,9 @@ class LlmServer:
                 prefix_slots=prefix_cache,
                 draft_params=self.draft_params, draft_cfg=self.draft_cfg,
                 spec_k=self.spec_k, kv_layout=self.kv_layout,
-                kv_blocks=self.kv_blocks)
+                kv_blocks=self.kv_blocks,
+                pipeline=(None if self.pipeline is None
+                          else self.pipeline == 'on'))
             self.params = self.engine.params
             if self.draft_params is not None:
                 self.draft_params = self.engine.draft_params
@@ -659,6 +670,12 @@ def build_parser() -> argparse.ArgumentParser:
                              'continuous engine, or the window path '
                              "with --engine off; dense targets only; "
                              'also via SKYTPU_LLM_DRAFT)')
+    parser.add_argument('--pipeline', default=None,
+                        choices=('on', 'off'),
+                        help='pipelined decode dispatch: keep one chunk '
+                             'in flight so host bookkeeping overlaps '
+                             'device compute (default on; off = serial '
+                             'engine; also via SKYTPU_LLM_PIPELINE)')
     return parser
 
 
@@ -669,7 +686,8 @@ def server_from_args(args) -> 'LlmServer':
                      prefix_cache=args.prefix_cache,
                      draft_model=args.draft_model,
                      kv_layout=args.kv_layout,
-                     kv_blocks=args.kv_blocks)
+                     kv_blocks=args.kv_blocks,
+                     pipeline=args.pipeline)
 
 
 def main() -> None:

@@ -177,7 +177,12 @@ class SpmdEngine(ContinuousEngine):
                     else:
                         self._run_chunk()
                 else:
+                    # Retire any pipelined chunk left in flight (all
+                    # its snapshot requests are done — junk only).
+                    # Deterministic, so every rank flushes in lockstep.
+                    self._flush_pipeline(quiet=True)
                     self._drain_firsts()
+                    self._note_decode_quiet()
                     if self.rank == 0 and not self._prefilling \
                             and not self._pending:
                         # Idle pacing lives on the head; followers pace

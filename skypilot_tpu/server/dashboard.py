@@ -694,6 +694,17 @@ function healthCell(h){
     const kb = e.kv_blocks;
     if(kb && kb.usable > 0)
       parts.push(`${kb.used}/${kb.usable} blk`);
+    // Decode-dispatch pipeline: depth + how much host bookkeeping the
+    // in-flight chunk hid (cumulative), e.g. "pipe d1 ovl 1.2s".
+    const pl = e.pipeline;
+    if(pl && pl.dispatches > 0){
+      const ms = pl.pipeline_depth > 0 ? pl.host_overlap_ms
+                                       : pl.bubble_ms;
+      const t = ms >= 1000 ? `${(ms/1000).toFixed(1)}s`
+                           : `${Math.round(ms)}ms`;
+      parts.push(`pipe d${pl.pipeline_depth} ${
+        pl.pipeline_depth > 0 ? 'ovl' : 'bub'} ${t}`);
+    }
     if(h.kv_cache === 'int8') parts.push('kv8');
     if(h.quantize) parts.push(h.quantize);  // outer esc covers it
     return esc(parts.join(', '));

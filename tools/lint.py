@@ -12,7 +12,8 @@ import pathlib
 import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
-TARGETS = ('skypilot_tpu', 'tests', 'bench.py', '__graft_entry__.py')
+TARGETS = ('skypilot_tpu', 'tests', 'tools', 'bench.py',
+           '__graft_entry__.py')
 BANNED_CALLS = {'breakpoint'}
 BANNED_IMPORTS = {'pdb', 'ipdb'}
 
