@@ -5,7 +5,8 @@
 PY ?= python
 NATIVE_DIR := skypilot_tpu/agent/native
 
-.PHONY: ci lint test-fast test test-all native native-asan clean audit-clean
+.PHONY: ci lint test-fast test test-all native native-asan clean \
+	audit-clean verify
 
 # Sequential sub-makes: audit-clean is a TEARDOWN gate and must scan the
 # process table only after the test tier finishes (`make -j` would
@@ -14,7 +15,15 @@ ci:
 	$(MAKE) lint
 	$(MAKE) native-asan
 	$(MAKE) test-fast
+	$(MAKE) verify
 	$(MAKE) audit-clean
+
+# Serving smokes (CPU, seconds; no chip touched): the decode-overlap
+# A/B and the QoS overload admission gate (interactive bounded, batch
+# absorbs 100% of sheds under 2x load).
+verify:
+	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --smoke
+	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --qos
 
 lint:
 	$(PY) tools/lint.py

@@ -218,6 +218,96 @@ def _measure_engine_decode(model_cfg, params) -> dict:
     return out
 
 
+def qos_overload_probe(assert_gates: bool = False) -> dict:
+    """Deterministic 2x-overload probe for the QoS admission layer
+    (serve/qos.py) — shared by ``bench.py`` (the ``qos_overload``
+    detail entry) and ``tools/perf_probe.py --qos`` (the CI gate,
+    ``assert_gates=True``).
+
+    A real tiny-model replica runs with QoS on and a 2-slot dispatch
+    gate; after one warmup request (compile time must not count as
+    queue wait), a deterministic 1:1 interactive/batch mix of 24
+    requests lands at concurrency 20 against a hold capacity of 14
+    (2 in flight + 12 queued) — ~2x what the server can hold, so the
+    queue saturates and sheds. Parameters are chosen so batch MUST
+    absorb 100% of sheds: the mix offers only 12 interactive in total,
+    so the 12-deep queue can never be all-interactive when an
+    interactive request arrives — a full queue always contains a batch
+    victim. Gates: sheds happened, every shed was batch-class, and
+    every interactive request was served with bounded queue wait."""
+    import asyncio
+    import threading
+
+    import requests as requests_lib
+    from aiohttp import web
+
+    from skypilot_tpu.serve import llm_server as llm_mod
+    from skypilot_tpu.serve import loadgen
+    from skypilot_tpu.utils import common_utils
+
+    server = llm_mod.LlmServer(
+        'tiny', max_len=64, engine='continuous', qos='on',
+        qos_opts=dict(max_inflight=2, max_queue=12,
+                      ttl_s={'interactive': 300.0, 'standard': 300.0,
+                             'batch': 300.0},
+                      tenant_rps=0, tenant_tps=0))
+    port = common_utils.find_free_port(23400)
+    started = threading.Event()
+
+    def run():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(server.make_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, '127.0.0.1', port)
+        loop.run_until_complete(site.start())
+        started.set()
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    if not started.wait(30):
+        raise RuntimeError('qos probe replica failed to start')
+    url = f'http://127.0.0.1:{port}'
+    try:
+        # Warmup: one request compiles prefill/decode so engine compile
+        # time never counts as queue wait in the measured run.
+        r = requests_lib.post(f'{url}/generate',
+                              json={'tokens': [[1, 2, 3, 4, 5, 6, 7, 8]],
+                                    'max_new_tokens': 8}, timeout=600)
+        r.raise_for_status()
+        out = asyncio.run(loadgen.run_load(
+            url, requests_total=24, concurrency=20, prompt_len='8',
+            max_new='16', vocab=256, mix='interactive:1,batch:1'))
+        health = requests_lib.get(f'{url}/health', timeout=10).json()
+    finally:
+        server.engine.stop()
+    qos = health.get('qos') or {}
+    classes = qos.get('classes') or {}
+    inter = classes.get('interactive') or {}
+    per_class = out.get('per_class') or {}
+    summary = {
+        'offered_concurrency': 20,
+        'max_inflight': 2,
+        'max_queue': 12,
+        'shed_total': qos.get('shed_total', 0),
+        'evicted_total': qos.get('evicted_total', 0),
+        'batch_shed': (classes.get('batch') or {}).get('shed', 0),
+        'interactive_shed': inter.get('shed', 0),
+        'interactive_p95_wait_ms':
+            (inter.get('queue_wait_ms') or {}).get('p95'),
+        'per_class': per_class,
+    }
+    if assert_gates:
+        pci = per_class.get('interactive') or {}
+        assert summary['shed_total'] > 0, summary
+        assert summary['interactive_shed'] == 0, summary
+        assert summary['batch_shed'] == summary['shed_total'], summary
+        assert pci.get('ok') == pci.get('requests'), summary
+        p95 = summary['interactive_p95_wait_ms']
+        assert p95 is not None and p95 < 30000, summary
+    return summary
+
+
 def _measure_provision_to_first_step() -> float:
     """Launch a task on the local provider; time launch-call -> first run
     output. Exercises provision + runtime bootstrap + gang exec for real."""
@@ -431,6 +521,13 @@ def _bench_tpu() -> dict:
             decode_tps = round(best, 1)
         except Exception as exc:  # secondary metric: never kill the bench
             decode_tps = f'failed: {type(exc).__name__}'
+    try:
+        # QoS admission under 2x overload (tiny model — cheap on any
+        # backend): interactive bounded, batch absorbs the sheds.
+        qos_overload = qos_overload_probe()
+    except Exception as exc:  # secondary metric: never kill the bench
+        qos_overload = {'error': f'{type(exc).__name__}: '
+                                 f'{str(exc)[:160]}'}
 
     baseline_tflops_per_chip = 23.48  # reference recipe, see module docstring
     n_chips = jax.device_count()
@@ -463,6 +560,7 @@ def _bench_tpu() -> dict:
             # (bf16 vs int8 weight-only) is decode_variants.
             'decode_tokens_per_sec': decode_tps,
             'decode_variants': decode_variants,
+            'qos_overload': qos_overload,
             'cpu_fallback': not on_tpu,
         },
     }
@@ -515,7 +613,8 @@ def finalize_result(result: dict, diagnostics: dict | None = None,
     line = render()
     # Progressive offload: if the line is still too big, move the
     # largest optional detail blocks to the sidecar, biggest first.
-    for key in ('sweep', 'decode_variants', 'probe_diagnostics'):
+    for key in ('sweep', 'qos_overload', 'decode_variants',
+                'probe_diagnostics'):
         if len(line.encode()) <= MAX_ARTIFACT_BYTES:
             break
         if key in detail and detail[key] is not None:

@@ -39,19 +39,25 @@ class Autoscaler:
     def evaluate(self, num_ready: int, num_launching: int,
                  request_times: List[float],
                  now: Optional[float] = None,
-                 replicas: Optional[List[Dict[str, Any]]] = None
+                 replicas: Optional[List[Dict[str, Any]]] = None,
+                 queue_pressure: Optional[float] = None
                  ) -> AutoscalerDecision:
         """``replicas``: live replica snapshot dicts with at least
         ``replica_id``/``status``/``weight``/``use_spot`` — consumed by
         the instance-aware and fallback policies; base policies ignore
-        it."""
+        it. ``queue_pressure``: total queued requests reported by the
+        replicas' /health bodies (QoS + batching queues) — a saturation
+        signal qps cannot see (few, long requests pile up queues at low
+        request rates); consumed when the policy sets
+        ``target_queue_per_replica``."""
         raise NotImplementedError
 
 
 class FixedReplicaAutoscaler(Autoscaler):
 
     def evaluate(self, num_ready, num_launching, request_times,
-                 now=None, replicas=None) -> AutoscalerDecision:
+                 now=None, replicas=None,
+                 queue_pressure=None) -> AutoscalerDecision:
         return AutoscalerDecision(self.policy.min_replicas, 'fixed')
 
 
@@ -77,6 +83,15 @@ class RequestRateAutoscaler(Autoscaler):
         window_start = now - self.QPS_WINDOW_SECONDS
         recent = [t for t in request_times if t >= window_start]
         return len(recent) / self.QPS_WINDOW_SECONDS
+
+    def _pressure_units(self, queue_pressure: Optional[float]) -> float:
+        """Capacity units demanded by queued-but-unserved work:
+        total queue depth / tolerated depth per weight-1 replica.
+        0 when the policy knob or the signal is absent."""
+        target = getattr(self.policy, 'target_queue_per_replica', None)
+        if not target or not queue_pressure or queue_pressure <= 0:
+            return 0.0
+        return float(queue_pressure) / float(target)
 
     def _clamp(self, desired: int) -> int:
         desired = max(self.policy.min_replicas, desired)
@@ -108,13 +123,17 @@ class RequestRateAutoscaler(Autoscaler):
         return AutoscalerDecision(self._target, f'hold: qps={qps:.2f}')
 
     def evaluate(self, num_ready, num_launching, request_times,
-                 now=None, replicas=None) -> AutoscalerDecision:
+                 now=None, replicas=None,
+                 queue_pressure=None) -> AutoscalerDecision:
         now = now if now is not None else time.time()
         qps = self._qps(request_times, now)
-        desired = self._clamp(
+        desired = (
             -(-int(qps * 100) // int(self.policy.target_qps_per_replica * 100))
             if qps > 0 else self.policy.min_replicas)
-        return self._apply_hysteresis(desired, qps)
+        pressure = self._pressure_units(queue_pressure)
+        if pressure > 0:
+            desired = max(desired, _ceil_units(pressure, 1.0))
+        return self._apply_hysteresis(self._clamp(desired), qps)
 
 
 _ALIVE = ('PROVISIONING', 'STARTING', 'READY', 'NOT_READY')
@@ -160,16 +179,19 @@ class InstanceAwareRequestRateAutoscaler(RequestRateAutoscaler):
         self.new_replica_weight = max(new_replica_weight, 1e-6)
 
     def evaluate(self, num_ready, num_launching, request_times,
-                 now=None, replicas=None) -> AutoscalerDecision:
+                 now=None, replicas=None,
+                 queue_pressure=None) -> AutoscalerDecision:
         now = now if now is not None else time.time()
         qps = self._qps(request_times, now)
         alive = _alive(replicas)
         if not alive:
             # No snapshot: degrade to the weight-1 rate policy.
             return super().evaluate(num_ready, num_launching,
-                                    request_times, now=now)
+                                    request_times, now=now,
+                                    queue_pressure=queue_pressure)
         per_unit = float(self.policy.target_qps_per_replica)
-        needed_units = qps / per_unit if qps > 0 else 0.0
+        needed_units = max(qps / per_unit if qps > 0 else 0.0,
+                           self._pressure_units(queue_pressure))
         by_weight = sorted(alive, key=lambda r: (
             float(r.get('weight') or 1.0), r.get('replica_id', 0)))
         have_units = sum(float(r.get('weight') or 1.0) for r in alive)
@@ -226,16 +248,19 @@ class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
         self.new_replica_weight = max(new_replica_weight, 1e-6)
 
     def evaluate(self, num_ready, num_launching, request_times,
-                 now=None, replicas=None) -> AutoscalerDecision:
+                 now=None, replicas=None,
+                 queue_pressure=None) -> AutoscalerDecision:
         now = now if now is not None else time.time()
         qps = self._qps(request_times, now)
         base_od = int(self.policy.base_ondemand_fallback_replicas)
         w = self.new_replica_weight
-        needed_units = (qps / float(self.policy.target_qps_per_replica)
-                        if qps > 0 else 0.0)
+        needed_units = max(
+            qps / float(self.policy.target_qps_per_replica)
+            if qps > 0 else 0.0,
+            self._pressure_units(queue_pressure))
         desired_total = self._clamp(
             _ceil_units(needed_units, w)
-            if qps > 0 else self.policy.min_replicas)
+            if needed_units > 0 else self.policy.min_replicas)
         decision = self._apply_hysteresis(desired_total, qps)
         spot_target = max(decision.target_num_replicas - base_od, 0)
         alive = _alive(replicas)

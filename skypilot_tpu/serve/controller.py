@@ -17,6 +17,33 @@ from skypilot_tpu.serve.service_spec import ServiceSpec
 from skypilot_tpu.task import Task
 
 
+def _queue_pressure(replica_snapshot) -> 'tuple':
+    """(total queued requests, {endpoint: depth}) from the replicas'
+    probe-recorded /health bodies. ``queue.depth_total`` is the full
+    picture (batching FIFO + overflow + QoS weighted-fair queue — the
+    replica sums them); ``qos.queue_depth_total`` alone is the
+    fallback for bodies that only carry the QoS block. Total is None
+    when NO replica reports a queue — absent signal must not read as
+    zero pressure of a different kind."""
+    total = None
+    by_endpoint = {}
+    for rep in replica_snapshot:
+        health = serve_state.parse_health(rep.get('health')) or {}
+        depth = None
+        queue = health.get('queue')
+        if isinstance(queue, dict):
+            depth = queue.get('depth_total')
+        if depth is None:
+            qos = health.get('qos')
+            if isinstance(qos, dict):
+                depth = qos.get('queue_depth_total')
+        if isinstance(depth, (int, float)):
+            total = (total or 0.0) + float(depth)
+            if rep.get('endpoint'):
+                by_endpoint[rep['endpoint']] = float(depth)
+    return total, by_endpoint
+
+
 class ServeController:
 
     def __init__(self, service_name: str, lb_port: int,
@@ -96,12 +123,20 @@ class ServeController:
                 num_ready_now = len(self.lb.policy.replicas)
                 replica_snapshot = serve_state.list_replicas(
                     self.service_name)
+                # Queue-pressure signal (replica /health queue depth):
+                # routing and scaling react to SATURATION, not just
+                # in-flight counts and request rates.
+                total_pressure, pressure_by_ep = _queue_pressure(
+                    replica_snapshot)
+                if hasattr(self.lb.policy, 'set_queue_pressure'):
+                    self.lb.policy.set_queue_pressure(pressure_by_ep)
                 decision = self.autoscaler.evaluate(
                     num_ready=num_ready_now,
                     num_launching=(self.replica_manager.num_alive()
                                    - num_ready_now),
                     request_times=self.lb.drain_request_times(),
-                    replicas=replica_snapshot)
+                    replicas=replica_snapshot,
+                    queue_pressure=total_pressure)
                 target = decision.target_num_replicas
                 # Rolling step BEFORE probe/set_replicas: a replica retired
                 # here is excluded from this very tick's LB set, minimizing

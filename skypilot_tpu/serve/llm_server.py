@@ -18,6 +18,13 @@ Two execution paths:
   nearly linearly with batch; measured on v5e: 1.8k tok/s single ->
   4k+ batched -> 5k+ continuous).
 
+QOS ADMISSION (``--qos on`` / ``SKYTPU_QOS=1``; default off —
+``serve/qos.py``): requests carry an optional ``priority``
+(``interactive``/``standard``/``batch``) and tenant identity; a
+weighted-fair scheduler orders admission, per-tenant token buckets cap
+request and generated-token rates, and overload sheds batch-first with
+429 + Retry-After while queue TTLs evict stale waiters (504).
+
 API (token-level; tokenization is the client's concern — no tokenizer
 assets ship in-image):
   GET  /health               -> {"status": "ok", "model": ...,
@@ -33,15 +40,17 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import collections
 import contextlib
 import os
-from typing import Any, Dict, List, Optional
+from typing import Any, Deque, Dict, List, Optional
 
 import jax
 from aiohttp import web
 
 from skypilot_tpu.models import generate as gen_lib
 from skypilot_tpu.models import llama
+from skypilot_tpu.serve import qos as qos_lib
 
 MAX_BATCH = int(os.environ.get('SKYTPU_LLM_MAX_BATCH', '32'))
 BATCH_WINDOW_S = float(os.environ.get('SKYTPU_LLM_BATCH_WINDOW_MS',
@@ -84,7 +93,9 @@ class LlmServer:
                  draft_model: Optional[str] = None,
                  kv_layout: Optional[str] = None,
                  kv_blocks: Optional[int] = None,
-                 pipeline: Optional[str] = None):
+                 pipeline: Optional[str] = None,
+                 qos: Optional[str] = None,
+                 qos_opts: Optional[Dict[str, Any]] = None):
         self.model_name = model
         self.cfg = llama.PRESETS[model]
         self.max_len = min(max_len, self.cfg.max_seq_len)
@@ -115,6 +126,16 @@ class LlmServer:
             raise ValueError(f'Unknown pipeline {pipeline!r}; '
                              "'on' or 'off'")
         self.pipeline = pipeline
+        # QoS admission control (serve/qos.py): priority classes,
+        # per-tenant quotas, overload shedding. OFF by default — with
+        # SKYTPU_QOS=0 no scheduler is constructed and the serving path
+        # is byte-identical to the pre-QoS server.
+        if qos not in (None, 'on', 'off'):
+            raise ValueError(f"Unknown qos {qos!r}; 'on' or 'off'")
+        self.qos_enabled = qos_lib.enabled(qos)
+        self._qos_opts = dict(qos_opts or {})
+        if self.qos_enabled and not self._qos_opts:
+            qos_lib.validate_env()  # typo'd env must fail pre-init
         self.quantize = quantize or os.environ.get('SKYTPU_LLM_QUANTIZE')
         if self.quantize and self.quantize != 'int8':
             raise ValueError(f'Unknown quantization {self.quantize!r}; '
@@ -244,8 +265,21 @@ class LlmServer:
             self.params = self.engine.params
             if self.draft_params is not None:
                 self.draft_params = self.engine.draft_params
+        self.qos: Optional[qos_lib.QosScheduler] = None
+        if self.qos_enabled:
+            opts = self._qos_opts
+            if not opts.get('max_inflight'):
+                # The gate lives where the device's concurrency bound
+                # lives: engine slots, or the window path's batch cap.
+                opts['max_inflight'] = (
+                    int(os.environ.get('SKYTPU_QOS_MAX_INFLIGHT', '0'))
+                    or (self.engine.slots if self.engine is not None
+                        else MAX_BATCH))
+            self.qos = qos_lib.QosScheduler(**opts)
         self._queue: asyncio.Queue = asyncio.Queue()
-        self._overflow: List[_Pending] = []  # spilled past MAX_BATCH
+        # deque: overflow spills pop from the FRONT every batch — the
+        # old list's pop(0) was O(n) per pop under sustained overflow.
+        self._overflow: Deque[_Pending] = collections.deque()
         self._worker: Optional[asyncio.Task] = None
         self.batches_served = 0
         self.draining = False
@@ -267,6 +301,17 @@ class LlmServer:
                 'draft_model': self.draft_model,
                 'batches_served': self.batches_served,
                 'max_batch_seen': self.max_batch_seen}
+        # Queue/backpressure snapshot: the controller reads depth_total
+        # as the routing/scaling pressure signal (satellite: overflow
+        # and queue depth surfaced in the health body).
+        queue = {'pending': self._queue.qsize(),
+                 'overflow': len(self._overflow)}
+        queue['depth_total'] = queue['pending'] + queue['overflow']
+        if self.qos is not None:
+            qos_stats = self.qos.stats()
+            body['qos'] = qos_stats
+            queue['depth_total'] += qos_stats['queue_depth_total']
+        body['queue'] = queue
         if self.engine is not None:
             body['engine'] = self.engine.stats()
         if self.draft_params is not None:
@@ -285,14 +330,14 @@ class LlmServer:
         the batch past the cap spills into the NEXT batch rather than
         blowing the operator's HBM bound."""
         if self._overflow:
-            batch = [self._overflow.pop(0)]
+            batch = [self._overflow.popleft()]
         else:
             batch = [await self._queue.get()]
         rows = len(batch[0].rows)
         deadline = asyncio.get_event_loop().time() + BATCH_WINDOW_S
         while rows < MAX_BATCH:
             if self._overflow:
-                nxt = self._overflow.pop(0)
+                nxt = self._overflow.popleft()
             else:
                 timeout = deadline - asyncio.get_event_loop().time()
                 if timeout <= 0:
@@ -512,6 +557,10 @@ class LlmServer:
                 {'error': 'stream requires the continuous engine '
                           '(unseeded requests, SKYTPU_LLM_ENGINE!=off)'},
                 status=400)
+        if self.qos is not None:
+            return await self._generate_qos(request, body, rows, max_new,
+                                            temperature, seed, top_k,
+                                            top_p, eos, seeded, stream)
         if stream:
             return await self._generate_stream(request, rows, max_new,
                                                temperature, top_k, top_p,
@@ -530,10 +579,108 @@ class LlmServer:
         out = await pending.future
         return web.json_response({'tokens': out})
 
+    # -- QoS-gated dispatch (serve/qos.py; SKYTPU_QOS=1 / --qos on) --------
+
+    def _dispatch_window(self, pending: _Pending) -> None:
+        """Dispatch grant for a window-path request: only now does it
+        enter the batching FIFO — until the grant, waiting (and TTL
+        expiry, and shed victimhood) happens in the weighted-fair
+        queue, which replaces the old unbounded FIFO as the place
+        requests queue."""
+        self._ensure_worker()
+        self._queue.put_nowait(pending)
+
+    @staticmethod
+    def _shed_response(e: qos_lib.ShedError,
+                       qos_class: str) -> web.Response:
+        return web.json_response(
+            {'error': str(e), 'qos_class': qos_class, 'shed': True},
+            status=429, headers={'Retry-After': str(e.retry_after_s)})
+
+    async def _generate_qos(self, request: web.Request, body, rows,
+                            max_new: int, temperature: float, seed,
+                            top_k: int, top_p: float, eos,
+                            seeded: bool, stream: bool) -> web.Response:
+        """The QoS-enabled request path: classify -> admit (quota +
+        overload) -> wait for the weighted-fair dispatch grant -> run
+        on the normal engine/window path -> release. Output for any
+        admitted request is identical to the ungated path; QoS only
+        changes WHEN work starts and which requests are refused."""
+        try:
+            qos_class = qos_lib.classify(body, request.headers)
+        except ValueError as e:
+            return web.json_response({'error': str(e)}, status=400)
+        if request.headers.get('Authorization', '').startswith('Bearer '):
+            # Token resolution can hit the users sqlite DB (cold cache;
+            # 10 s lock timeout) — never block the serving event loop
+            # on it, or every in-flight stream on the replica stalls.
+            tenant = await asyncio.get_event_loop().run_in_executor(
+                None, qos_lib.resolve_tenant, request.headers, body)
+        else:  # header/field/anonymous: pure dict reads
+            tenant = qos_lib.resolve_tenant(request.headers, body)
+        use_window = self.engine is None or seeded
+        pending = None
+        on_dispatch = None
+        if use_window and not stream:
+            pending = _Pending(rows, max_new, temperature, seed,
+                               top_k=top_k, top_p=top_p, eos=eos)
+            on_dispatch = (lambda p=pending: self._dispatch_window(p))
+        try:
+            ticket = self.qos.submit(
+                qos_class, tenant, cost=float(len(rows)),
+                est_tokens=float(len(rows) * max_new),
+                on_dispatch=on_dispatch)
+        except qos_lib.ShedError as e:
+            return self._shed_response(e, qos_class)
+        try:
+            await ticket.granted
+        except qos_lib.ShedError as e:
+            return self._shed_response(e, qos_class)
+        except qos_lib.QueueTimeout as e:
+            return web.json_response(
+                {'error': str(e), 'qos_class': qos_class}, status=504)
+        except asyncio.CancelledError:
+            self.qos.abandon(ticket)  # client disconnected while queued
+            raise
+        # generated drives the quota refund at release: the actual
+        # count on success (unused ask refunded), 0 on server-side
+        # failure (full refund — the work was not done), None on client
+        # disconnect (full CHARGE — the engine completes the work
+        # anyway, and disconnects must not become a quota bypass).
+        generated: Optional[int] = 0
+        try:
+            if stream:
+                # Streamed tokens are counted as emitted, so completion
+                # still refunds the unused ask and feeds the throughput
+                # estimator exactly like the buffered path.
+                counter = [0]
+                resp = await self._generate_stream(
+                    request, rows, max_new, temperature, top_k, top_p,
+                    eos, token_count=counter)
+                generated = counter[0]
+                return resp
+            if pending is None:  # continuous engine
+                futs = [asyncio.wrap_future(
+                    self.engine.submit(r, max_new, temperature,
+                                       top_k=top_k, top_p=top_p,
+                                       eos=eos)) for r in rows]
+                out = [list(o) for o in await asyncio.gather(*futs)]
+            else:
+                out = await pending.future
+            generated = sum(len(o) for o in out)
+            return web.json_response({'tokens': out})
+        except asyncio.CancelledError:
+            generated = None
+            raise
+        finally:
+            self.qos.release(ticket, generated_tokens=generated)
+
     async def _generate_stream(self, request: web.Request,
                                rows, max_new: int, temperature: float,
                                top_k: int = 0, top_p: float = 1.0,
-                               eos=None) -> web.StreamResponse:
+                               eos=None,
+                               token_count: Optional[List[int]] = None
+                               ) -> web.StreamResponse:
         """NDJSON streaming (the JetStream-style serving contract):
         tokens are written as the engine emits them, one
         ``{"row": i, "tokens": [...]}`` object per line, at decode-chunk
@@ -559,6 +706,8 @@ class LlmServer:
 
         async def _emit(item):
             ri, toks = item
+            if token_count is not None:  # QoS quota/throughput feed
+                token_count[0] += len(toks)
             remaining[ri] -= len(toks)
             if remaining[ri] <= 0:
                 del remaining[ri]
@@ -676,6 +825,15 @@ def build_parser() -> argparse.ArgumentParser:
                              'in flight so host bookkeeping overlaps '
                              'device compute (default on; off = serial '
                              'engine; also via SKYTPU_LLM_PIPELINE)')
+    parser.add_argument('--qos', default=None, choices=('on', 'off'),
+                        help='QoS admission control: priority classes '
+                             '(interactive/standard/batch), per-tenant '
+                             'token-bucket quotas, and overload '
+                             'shedding with 429+Retry-After (default '
+                             'off; also via SKYTPU_QOS; knobs: '
+                             'SKYTPU_QOS_WEIGHTS/_MAX_QUEUE/_TTL_S/'
+                             '_TENANT_RPS/_TENANT_TPS/_TENANT_LIMITS/'
+                             '_MAX_INFLIGHT)')
     return parser
 
 
@@ -687,7 +845,8 @@ def server_from_args(args) -> 'LlmServer':
                      draft_model=args.draft_model,
                      kv_layout=args.kv_layout,
                      kv_blocks=args.kv_blocks,
-                     pipeline=args.pipeline)
+                     pipeline=args.pipeline,
+                     qos=args.qos)
 
 
 def main() -> None:

@@ -42,13 +42,30 @@ class RoundRobinPolicy(LoadBalancingPolicy):
             return replica
 
 
+def _argmin_candidates(loads: Dict[str, float]) -> List[str]:
+    """Every replica within float tolerance of the minimum load.
+
+    The old exact ``== low`` compare operated on values computed through
+    division: two replicas whose loads are MATHEMATICALLY equal can
+    differ in the last ulp (e.g. weights that arrived as 0.3 vs
+    0.1 + 0.2), collapsing the tie-break rotation onto one replica
+    forever. A relative tolerance keeps real ties rotating without ever
+    conflating genuinely different load levels (which differ by >= 1
+    in-flight request / weight, many orders of magnitude above 1e-9)."""
+    low = min(loads.values())
+    tol = 1e-9 * max(1.0, abs(low))
+    return [r for r, v in loads.items() if v - low <= tol]
+
+
 class LeastLoadPolicy(LoadBalancingPolicy):
-    """Route to the replica with the fewest in-flight requests; ties are
-    broken by rotation so sequential (zero-load) traffic still spreads."""
+    """Route to the replica with the fewest in-flight requests plus its
+    reported queue pressure; ties are broken by rotation so sequential
+    (zero-load) traffic still spreads."""
 
     def __init__(self):
         super().__init__()
         self._inflight: Dict[str, int] = {}
+        self._pressure: Dict[str, float] = {}
         self._rotation = 0
 
     def set_replicas(self, replicas: List[str]) -> None:
@@ -60,13 +77,25 @@ class LeastLoadPolicy(LoadBalancingPolicy):
                 if r not in replicas:
                     del self._inflight[r]
 
+    def set_queue_pressure(self, pressure: Dict[str, float]) -> None:
+        """Per-endpoint queued-work depth (the replica /health
+        ``queue.depth_total`` / QoS queue depth, pushed by the
+        controller each probe tick): saturation then shows up in
+        routing even when in-flight counts look balanced — a slow
+        replica holds few in-flight requests but a deep queue."""
+        with self._lock:
+            self._pressure = {k: max(float(v), 0.0)
+                              for k, v in pressure.items()}
+
+    def _load(self, r: str) -> float:
+        return self._inflight.get(r, 0) + self._pressure.get(r, 0.0)
+
     def select(self) -> Optional[str]:
         with self._lock:
             if not self.replicas:
                 return None
-            low = min(self._inflight.get(r, 0) for r in self.replicas)
-            candidates = [r for r in self.replicas
-                          if self._inflight.get(r, 0) == low]
+            loads = {r: self._load(r) for r in self.replicas}
+            candidates = _argmin_candidates(loads)
             self._rotation += 1
             return candidates[self._rotation % len(candidates)]
 
@@ -82,9 +111,9 @@ class LeastLoadPolicy(LoadBalancingPolicy):
 
 class InstanceAwareLeastLoadPolicy(LeastLoadPolicy):
     """Route to the replica with the lowest NORMALIZED load
-    (in-flight / capacity weight): a weight-2 replica (twice the chips)
-    keeps receiving traffic until it carries twice a weight-1 replica's
-    in-flight count (reference:
+    ((in-flight + queue pressure) / capacity weight): a weight-2 replica
+    (twice the chips) keeps receiving traffic until it carries twice a
+    weight-1 replica's load (reference:
     ``sky/serve/load_balancing_policies.py:151``)."""
 
     def __init__(self):
@@ -100,11 +129,9 @@ class InstanceAwareLeastLoadPolicy(LeastLoadPolicy):
         with self._lock:
             if not self.replicas:
                 return None
-            def norm(r):
-                return (self._inflight.get(r, 0) /
-                        self._weights.get(r, 1.0))
-            low = min(norm(r) for r in self.replicas)
-            candidates = [r for r in self.replicas if norm(r) == low]
+            loads = {r: self._load(r) / self._weights.get(r, 1.0)
+                     for r in self.replicas}
+            candidates = _argmin_candidates(loads)
             self._rotation += 1
             return candidates[self._rotation % len(candidates)]
 

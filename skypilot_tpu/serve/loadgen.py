@@ -31,21 +31,56 @@ def _span(spec: str):
     return lo, int(hi) if hi else lo
 
 
+def mix_classes(spec, n: int):
+    """``'interactive:8,batch:2'`` -> a priority class per request
+    index, by DETERMINISTIC weighted round-robin (largest accumulated
+    credit; ties resolve in spec order) — overload experiments must be
+    reproducible run to run, so no random draws. Returns None when no
+    mix is requested."""
+    if not spec:
+        return None
+    weights = []
+    for part in str(spec).split(','):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, w = part.partition(':')
+        weights.append((name.strip(), float(w or 1)))
+    total = sum(w for _, w in weights)
+    if total <= 0:
+        raise ValueError(f'--mix weights must sum to > 0, got {spec!r}')
+    credit = {name: 0.0 for name, _ in weights}
+    out = []
+    for _ in range(n):
+        for name, w in weights:
+            credit[name] += w / total
+        pick = max(credit, key=lambda k: credit[k])
+        credit[pick] -= 1.0
+        out.append(pick)
+    return out
+
+
 async def _one(session, url: str, prompt_span, max_new_span,
-               vocab: int, seed: int, stream: bool = False):
+               vocab: int, seed: int, stream: bool = False,
+               priority=None, tenant=None):
     rng = random.Random(seed)
     prompt_len = rng.randint(*prompt_span)
     max_new = rng.randint(*max_new_span)
     tokens = [rng.randrange(1, vocab) for _ in range(prompt_len)]
+    payload = {'tokens': [tokens], 'max_new_tokens': max_new,
+               'stream': stream}
+    if priority is not None:
+        payload['priority'] = priority
+    headers = {'X-SkyTPU-Tenant': tenant} if tenant is not None else None
     t0 = time.perf_counter()
     ttft = None
+    status = None
     timeout = __import__('aiohttp').ClientTimeout(total=600)
     try:
         async with session.post(
-                f'{url}/generate',
-                json={'tokens': [tokens], 'max_new_tokens': max_new,
-                      'stream': stream},
+                f'{url}/generate', json=payload, headers=headers,
                 timeout=timeout) as r:
+            status = r.status
             if stream:
                 # NDJSON: count tokens per line; first line = TTFT (the
                 # serving latency JetStream-class systems quote).
@@ -71,46 +106,86 @@ async def _one(session, url: str, prompt_span, max_new_span,
                 new = len(body['tokens'][0]) if ok else 0
     except Exception:  # noqa: BLE001 — a failed request is a data point
         ok, new = False, 0
-    return ok, new, time.perf_counter() - t0, ttft
+    return ok, new, time.perf_counter() - t0, ttft, status
+
+
+def _pctile(sorted_vals, q: int):
+    """Nearest-rank percentile in seconds, rounded for the report (the
+    index math lives in serve/qos.py so server-side queue-wait
+    percentiles and these latency percentiles cannot diverge)."""
+    from skypilot_tpu.serve.qos import nearest_rank
+    v = nearest_rank(sorted_vals, q)
+    return round(v, 3) if v is not None else None
 
 
 async def run_load(url: str, requests_total: int, concurrency: int,
                    prompt_len, max_new, vocab: int,
-                   stream: bool = False) -> dict:
+                   stream: bool = False, mix=None, tenants: int = 1
+                   ) -> dict:
     import aiohttp
     prompt_span, max_new_span = _span(prompt_len), _span(max_new)
     sem = asyncio.Semaphore(concurrency)
+    classes = mix_classes(mix, requests_total)
     results = []
 
     async with aiohttp.ClientSession() as session:
         async def _bounded(i):
             async with sem:
-                results.append(await _one(session, url, prompt_span,
-                                          max_new_span, vocab, seed=i,
-                                          stream=stream))
+                cls = classes[i] if classes else None
+                tenant = f't{i % tenants}' if tenants > 1 else None
+                results.append((cls, await _one(
+                    session, url, prompt_span, max_new_span, vocab,
+                    seed=i, stream=stream, priority=cls, tenant=tenant)))
 
         t0 = time.perf_counter()
         await asyncio.gather(*(_bounded(i) for i in range(requests_total)))
         wall = time.perf_counter() - t0
 
-    oks = [r for r in results if r[0]]
-    lats = sorted(r[2] for r in results)
+    flat = [r for _, r in results]
+    oks = [r for r in flat if r[0]]
+    lats = sorted(r[2] for r in flat)
     new_tokens = sum(r[1] for r in oks)
     ttfts = sorted(r[3] for r in oks if r[3] is not None)
     extra = {}
     if stream:
         extra = {
             'stream': True,
-            'p50_ttft_s': round(ttfts[len(ttfts) // 2], 3)
-            if ttfts else None,
-            'p95_ttft_s': round(
-                ttfts[max(-(-len(ttfts) * 95 // 100) - 1, 0)], 3)
-            if ttfts else None,
+            'p50_ttft_s': _pctile(ttfts, 50),
+            'p95_ttft_s': _pctile(ttfts, 95),
         }
+    if classes:
+        # Per-class breakdown (QoS workloads): latency/TTFT percentiles
+        # over SERVED requests, plus shed (429) / evicted (504) counts —
+        # the numbers the admission layer is supposed to move.
+        per_class = {}
+        for cls in dict.fromkeys(classes):
+            rs = [r for c, r in results if c == cls]
+            oks_c = [r for r in rs if r[0]]
+            shed = sum(1 for r in rs if r[4] == 429)
+            evicted = sum(1 for r in rs if r[4] == 504)
+            entry = {
+                'requests': len(rs),
+                'ok': len(oks_c),
+                'shed': shed,
+                'evicted': evicted,
+                'shed_rate': round(shed / len(rs), 3) if rs else 0,
+                'p50_latency_s': _pctile(sorted(r[2] for r in oks_c), 50),
+                'p95_latency_s': _pctile(sorted(r[2] for r in oks_c), 95),
+            }
+            if stream:
+                tt = sorted(r[3] for r in oks_c if r[3] is not None)
+                entry['p50_ttft_s'] = _pctile(tt, 50)
+                entry['p95_ttft_s'] = _pctile(tt, 95)
+            per_class[cls] = entry
+        extra['mix'] = str(mix)
+        extra['per_class'] = per_class
+        if tenants > 1:
+            extra['tenants'] = tenants
     return {
         **extra,
         'requests': requests_total,
         'ok': len(oks),
+        'shed': sum(1 for r in flat if r[4] == 429),
         'concurrency': concurrency,
         'prompt_len': str(prompt_len),
         'max_new_tokens': str(max_new),
@@ -120,12 +195,8 @@ async def run_load(url: str, requests_total: int, concurrency: int,
         # The reference's JetStream recipe also quotes req/s (11.42 on
         # v6e, examples/tpu/v6e/README.md:112-118).
         'requests_per_sec': round(len(oks) / wall, 2) if wall else 0,
-        'p50_latency_s': round(lats[len(lats) // 2], 3) if lats else None,
-        # ceil(q*n)-1: the standard nearest-rank percentile index —
-        # int(0.95*n) would report the MAX for every n <= 20.
-        'p95_latency_s': round(
-            lats[max(-(-len(lats) * 95 // 100) - 1, 0)], 3)
-        if lats else None,
+        'p50_latency_s': _pctile(lats, 50),
+        'p95_latency_s': _pctile(lats, 95),
     }
 
 
@@ -148,11 +219,24 @@ def main() -> None:
                         help='use NDJSON streaming and report TTFT '
                              'percentiles (requires the continuous '
                              'engine on the server)')
+    parser.add_argument('--mix', default=None,
+                        help="priority-class mix, e.g. "
+                             "'interactive:8,batch:2': deterministic "
+                             'weighted round-robin class assignment; '
+                             'reports per-class latency/TTFT '
+                             'percentiles and shed (429) / evicted '
+                             '(504) counts (pair with a --qos on '
+                             'server)')
+    parser.add_argument('--tenants', type=int, default=1,
+                        help='spread requests over N synthetic tenant '
+                             'ids (X-SkyTPU-Tenant: t0..tN-1) to '
+                             'exercise per-tenant quotas')
     args = parser.parse_args()
     out = asyncio.run(run_load(args.url.rstrip('/'), args.requests,
                                args.concurrency, args.prompt_len,
                                args.max_new_tokens, args.vocab,
-                               stream=args.stream))
+                               stream=args.stream, mix=args.mix,
+                               tenants=args.tenants))
     print(json.dumps(out))
 
 

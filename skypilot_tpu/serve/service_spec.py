@@ -49,6 +49,12 @@ class ReplicaPolicy:
     # Always-on on-demand safety pool under a spot fleet; > 0 selects the
     # FallbackRequestRateAutoscaler (reference: autoscalers.py:909).
     base_ondemand_fallback_replicas: int = 0
+    # Queue-pressure scaling: tolerated queued requests per (weight-1)
+    # replica. When set, the autoscaler scales to cover the replicas'
+    # reported queue depth as well as qps — saturation (deep queues at
+    # modest request rates, e.g. long generations) triggers scale-up
+    # that in-flight counts alone would miss. None = rate-only.
+    target_queue_per_replica: Optional[float] = None
 
     @property
     def autoscaling(self) -> bool:
@@ -67,7 +73,9 @@ class ReplicaPolicy:
                    dynamic_ondemand_fallback=bool(
                        cfg.get('dynamic_ondemand_fallback', False)),
                    base_ondemand_fallback_replicas=int(
-                       cfg.get('base_ondemand_fallback_replicas', 0)))
+                       cfg.get('base_ondemand_fallback_replicas', 0)),
+                   target_queue_per_replica=cfg.get(
+                       'target_queue_per_replica'))
 
 
 @dataclasses.dataclass
@@ -109,6 +117,8 @@ class ServiceSpec:
                     self.replica_policy.dynamic_ondemand_fallback,
                 'base_ondemand_fallback_replicas':
                     self.replica_policy.base_ondemand_fallback_replicas,
+                'target_queue_per_replica':
+                    self.replica_policy.target_queue_per_replica,
             },
             'port': self.port,
             'load_balancing_policy': self.load_balancing_policy,

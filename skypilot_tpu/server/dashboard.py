@@ -705,6 +705,15 @@ function healthCell(h){
       parts.push(`pipe d${pl.pipeline_depth} ${
         pl.pipeline_depth > 0 ? 'ovl' : 'bub'} ${t}`);
     }
+    // QoS admission: queue depth + cumulative shed/evict counters,
+    // e.g. "q3 shed12 ev1" (serve/qos.py; absent when QoS is off).
+    const qo = h.qos;
+    if(qo && qo.enabled){
+      let t = `q${qo.queue_depth_total||0}`;
+      if(qo.shed_total) t += ` shed${qo.shed_total}`;
+      if(qo.evicted_total) t += ` ev${qo.evicted_total}`;
+      parts.push(t);
+    }
     if(h.kv_cache === 'int8') parts.push('kv8');
     if(h.quantize) parts.push(h.quantize);  // outer esc covers it
     return esc(parts.join(', '));
@@ -779,6 +788,18 @@ async function metricsView(){
     for(const k in pb) d += Math.max(0, pb[k] - (pa[k] ?? pb[k]));
     return d;
   });
+  // QoS shed/evict RATE: per-replica clamped counter deltas, same
+  // restart-reset handling as the token rate above.
+  const qosRate = (field) => rateSeries((a,b)=>{
+    const pa=a.serve_qos_by_replica||{}, pb=b.serve_qos_by_replica||{};
+    let d=0;
+    for(const k in pb){
+      const base = pa[k] ? (pa[k][field]||0) : (pb[k][field]||0);
+      d += Math.max(0, (pb[k][field]||0) - base);
+    }
+    return d;
+  });
+  const anyQos = s.some(x=>Object.keys(x.serve_qos_by_replica||{}).length);
   const span = s.length > 1 ?
       ((s[s.length-1].ts - s[0].ts)/60).toFixed(1) + ' min' : '';
   return `<h2>Fleet metrics <span id="ts2" style="color:#888;font-size:12px">
@@ -795,6 +816,13 @@ async function metricsView(){
     `<h2>Serving throughput (tok/s)</h2>` +
       lineChart({'tok/s': tokRate.map(v=>Math.round(v*10)/10)},
                 {keepZero:true}) +
+    (anyQos ? `<h2>Serve QoS queue depth</h2>` +
+      lineChart({queued: s.map(x=>x.serve_queue_depth||0)},
+                {keepZero:true}) +
+    `<h2>Serve QoS shed / evict rate (1/s)</h2>` +
+      lineChart({shed: qosRate('shed').map(v=>Math.round(v*100)/100),
+                 evicted: qosRate('evicted').map(v=>Math.round(v*100)/100)},
+                {keepZero:true}) : '') +
     `<h2>API requests by status</h2>` +
       lineChart(familySeries(s, 'requests')) +
     `<h2>API request rate (req/s)</h2>` +

@@ -25,6 +25,27 @@ _SERVICES = Gauge('skytpu_services', 'Services by status.', ['status'],
 _API_REQUESTS = Gauge('skytpu_api_request_table', 'Request table by status.',
                       ['status'], registry=REGISTRY)
 
+# Serve-plane QoS backpressure, re-read at scrape time from the replicas'
+# probe-recorded /health bodies (serve/qos.py). Gauges, not Counters:
+# the shed/evict totals are the REPLICA's cumulative counters mirrored
+# here — a replica restart legitimately resets them.
+_SERVE_QOS_DEPTH = Gauge(
+    'skytpu_serve_qos_queue_depth',
+    'Replica QoS queue depth by priority class.',
+    ['service', 'replica', 'qos_class'], registry=REGISTRY)
+_SERVE_QOS_SHED = Gauge(
+    'skytpu_serve_qos_shed_total',
+    'Replica cumulative shed (429) count by priority class.',
+    ['service', 'replica', 'qos_class'], registry=REGISTRY)
+_SERVE_QOS_EVICTED = Gauge(
+    'skytpu_serve_qos_evicted_total',
+    'Replica cumulative queue-TTL eviction count by priority class.',
+    ['service', 'replica', 'qos_class'], registry=REGISTRY)
+_SERVE_QOS_WAIT_P95 = Gauge(
+    'skytpu_serve_qos_queue_wait_p95_ms',
+    'Replica p95 queue wait (ms, recent window) by priority class.',
+    ['service', 'replica', 'qos_class'], registry=REGISTRY)
+
 
 def _refresh_gauges() -> None:
     from collections import Counter as C
@@ -46,6 +67,33 @@ def _refresh_gauges() -> None:
         gauge.clear()
         for status, n in counts.items():
             gauge.labels(status=status).set(n)
+
+    for gauge in (_SERVE_QOS_DEPTH, _SERVE_QOS_SHED, _SERVE_QOS_EVICTED,
+                  _SERVE_QOS_WAIT_P95):
+        gauge.clear()
+    for svc in serve_state.list_services():
+        if svc is None:
+            continue
+        for rep in serve_state.list_replicas(svc['name']):
+            health = serve_state.parse_health(rep.get('health')) or {}
+            qos = health.get('qos')
+            if not isinstance(qos, dict):
+                continue
+            labels = {'service': svc['name'],
+                      'replica': str(rep['replica_id'])}
+            for cls, c in (qos.get('classes') or {}).items():
+                if not isinstance(c, dict):
+                    continue
+                _SERVE_QOS_DEPTH.labels(qos_class=cls, **labels).set(
+                    c.get('depth') or 0)
+                _SERVE_QOS_SHED.labels(qos_class=cls, **labels).set(
+                    c.get('shed') or 0)
+                _SERVE_QOS_EVICTED.labels(qos_class=cls, **labels).set(
+                    c.get('evicted') or 0)
+                p95 = (c.get('queue_wait_ms') or {}).get('p95')
+                if isinstance(p95, (int, float)):
+                    _SERVE_QOS_WAIT_P95.labels(qos_class=cls,
+                                               **labels).set(p95)
 
 
 def render() -> bytes:

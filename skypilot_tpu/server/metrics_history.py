@@ -54,6 +54,11 @@ def sample_once(record: bool = True) -> Dict[str, Any]:
     # instead of cratering the whole fleet's delta (the same reason
     # requests_total_by_op keeps per-op counters).
     serve_tokens_by_replica: Dict[str, int] = {}
+    # QoS backpressure per replica: queue depth is a level; shed/evicted
+    # are the replica's cumulative counters (kept per replica, same
+    # restart-reset rationale as the token counters above — the
+    # dashboard rates them with per-replica clamped deltas).
+    serve_qos_by_replica: Dict[str, Dict[str, float]] = {}
     for svc in services:
         for rep in serve_state.list_replicas(svc['name']):
             replicas_total += 1
@@ -61,10 +66,17 @@ def sample_once(record: bool = True) -> Dict[str, Any]:
             if getattr(status, 'value', status) == 'READY':
                 replicas_ready += 1
             health = serve_state.parse_health(rep.get('health')) or {}
+            key = f"{svc['name']}/{rep['replica_id']}"
             tok = (health.get('engine') or {}).get('tokens_emitted')
             if isinstance(tok, (int, float)):
-                serve_tokens_by_replica[
-                    f"{svc['name']}/{rep['replica_id']}"] = int(tok)
+                serve_tokens_by_replica[key] = int(tok)
+            qos = health.get('qos')
+            if isinstance(qos, dict):
+                serve_qos_by_replica[key] = {
+                    'depth': qos.get('queue_depth_total') or 0,
+                    'shed': qos.get('shed_total') or 0,
+                    'evicted': qos.get('evicted_total') or 0,
+                }
 
     # Cumulative per-op request counters (client derives rates from
     # deltas between samples).
@@ -89,6 +101,9 @@ def sample_once(record: bool = True) -> Dict[str, Any]:
         'replicas_ready': replicas_ready,
         'serve_tokens_emitted': sum(serve_tokens_by_replica.values()),
         'serve_tokens_by_replica': serve_tokens_by_replica,
+        'serve_queue_depth': sum(d['depth']
+                                 for d in serve_qos_by_replica.values()),
+        'serve_qos_by_replica': serve_qos_by_replica,
         'requests_total_by_op': ops,
     }
     if record:

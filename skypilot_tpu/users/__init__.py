@@ -116,6 +116,27 @@ def authenticate(token: Optional[str]) -> Optional[Dict[str, str]]:
         return {'name': row['name'], 'role': row['role']} if row else None
 
 
+_TENANT_CACHE: Dict[str, Any] = {}
+_TENANT_CACHE_TTL_S = 30.0
+
+
+def tenant_from_token(token: str) -> Optional[str]:
+    """QoS tenant id for a bearer token: the authenticated user's name,
+    or None when the token resolves to nobody. Briefly cached — serving
+    admission runs per request and must not pay a sqlite read each
+    time (a revoked token lingers at most the cache TTL)."""
+    now = time.time()
+    hit = _TENANT_CACHE.get(token)
+    if hit is not None and now - hit[0] < _TENANT_CACHE_TTL_S:
+        return hit[1]
+    user = authenticate(token)
+    name = user['name'] if user else None
+    if len(_TENANT_CACHE) >= 1024:  # abuse bound
+        _TENANT_CACHE.clear()
+    _TENANT_CACHE[token] = (now, name)
+    return name
+
+
 def role_allows(role: str, op: str) -> bool:
     needed = _OP_MIN_ROLE.get(op, 'admin')
     return ROLES.index(role) >= ROLES.index(needed)

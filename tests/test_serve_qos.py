@@ -1,0 +1,700 @@
+"""QoS admission control for the serving path (serve/qos.py).
+
+Pins the subsystem's contracts: weighted-fair ordering across priority
+classes, per-tenant token-bucket exhaustion -> 429 with a sane
+Retry-After, queue-TTL eviction under a stalled engine, overload sheds
+absorbed entirely by the batch class while interactive stays bounded,
+the LB/autoscaler queue-pressure signal, the float-equality tie fix in
+``InstanceAwareLeastLoadPolicy``, and byte-parity of the serving path
+with QoS disabled (the default)."""
+import asyncio
+import concurrent.futures as cf
+import json
+import pathlib
+import sys
+import threading
+import time
+
+import pytest
+import requests as requests_lib
+
+from skypilot_tpu.serve import qos as qos_lib
+from skypilot_tpu.serve.qos import (QosScheduler, QueueTimeout, ShedError,
+                                    TokenBucket, WeightedFairQueue)
+
+sys.path.insert(0, str(pathlib.Path(__file__).parents[1]))
+
+
+class FakeClock:
+
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# -- weighted-fair queue -----------------------------------------------------
+
+
+def test_weighted_fair_ordering():
+    """Under shared backlog a weight-4 class drains 4x a weight-1 class:
+    the first 10 pops of a 12+12 alternating backlog are 8 interactive +
+    2 batch, and nothing is lost overall."""
+    wfq = WeightedFairQueue({'interactive': 4.0, 'batch': 1.0})
+    for i in range(12):
+        wfq.push(('i', i), 'interactive')
+        wfq.push(('b', i), 'batch')
+    first10 = [wfq.pop().cls for _ in range(10)]
+    assert first10.count('interactive') == 8, first10
+    assert first10.count('batch') == 2, first10
+    rest = []
+    while True:
+        item = wfq.pop()
+        if item is None:
+            break
+        rest.append(item)
+    assert len(first10) + len(rest) == 24  # nothing starved or lost
+    assert wfq.total == 0
+
+
+def test_wfq_lone_class_and_no_banked_credit():
+    """A lone class drains at full speed, and an idle class cannot bank
+    credit while absent: after batch drains alone, a fresh interactive
+    arrival still wins the next pop but batch is not locked out."""
+    wfq = WeightedFairQueue({'interactive': 8.0, 'batch': 1.0})
+    for i in range(3):
+        wfq.push(('b', i), 'batch')
+    assert [wfq.pop().payload[1] for _ in range(3)] == [0, 1, 2]
+    wfq.push('late-b', 'batch')
+    wfq.push('late-i', 'interactive')
+    assert wfq.pop().payload == 'late-i'  # tag starts at current vtime
+    assert wfq.pop().payload == 'late-b'  # ...and batch still drains
+
+
+def test_wfq_ttl_expiry_and_removal():
+    clock = FakeClock()
+    wfq = WeightedFairQueue(time_fn=clock)
+    a = wfq.push('a', 'standard', ttl_s=5.0)
+    wfq.push('b', 'standard', ttl_s=50.0)
+    clock.advance(6.0)
+    expired = wfq.expired()
+    assert [i.payload for i in expired] == ['a']
+    assert wfq.total == 1
+    assert not wfq.remove(a)  # already gone
+    assert wfq.pop().payload == 'b'
+
+
+def test_wfq_heap_compacts_under_saturated_gate():
+    """Shed/evict churn without any pop (stalled dispatch gate) must
+    not grow the heap with every admission: dead entries are compacted
+    once they outnumber live ones."""
+    clock = FakeClock()
+    wfq = WeightedFairQueue(time_fn=clock)
+    for i in range(5000):
+        item = wfq.push(i, 'batch', ttl_s=0.5)
+        if i % 2:
+            wfq.remove(item)  # shed-victim churn
+        clock.advance(0.001)
+        wfq.expired()  # sweeper churn
+    assert wfq.total <= 500  # TTL bounds the live set
+    assert len(wfq._heap) <= 2 * max(wfq.total, 16) + 1
+
+
+# -- token bucket ------------------------------------------------------------
+
+
+def test_token_bucket_refill_and_retry_seconds():
+    clock = FakeClock()
+    b = TokenBucket(rate=2.0, burst=2.0, time_fn=clock)
+    assert b.try_take(1.0) and b.try_take(1.0)
+    assert not b.try_take(1.0)
+    assert b.seconds_until(1.0) == pytest.approx(0.5)
+    clock.advance(0.5)
+    assert b.try_take(1.0)
+    b.give(10.0)  # refund caps at burst
+    assert b.level == 2.0
+
+
+# -- classification / tenant resolution -------------------------------------
+
+
+def test_classify_field_header_default_and_reject():
+    assert qos_lib.classify({'priority': 'interactive'}) == 'interactive'
+    assert qos_lib.classify({}, {'X-SkyTPU-Priority': 'Batch'}) == 'batch'
+    assert qos_lib.classify({}) == 'standard'
+    # The request field beats the header.
+    assert qos_lib.classify({'priority': 'batch'},
+                            {'X-SkyTPU-Priority': 'interactive'}) == 'batch'
+    with pytest.raises(ValueError):
+        qos_lib.classify({'priority': 'urgent'})
+
+
+def test_resolve_tenant_precedence(monkeypatch):
+    from skypilot_tpu import users as users_lib
+    monkeypatch.setattr(users_lib, 'tenant_from_token',
+                        lambda tok: 'alice' if tok == 'tok-a' else None)
+    # Authenticated identity wins over the self-declared header.
+    assert qos_lib.resolve_tenant(
+        {'Authorization': 'Bearer tok-a',
+         'X-SkyTPU-Tenant': 'spoof'}, {}) == 'alice'
+    # Unresolvable token falls back to the declared tenant.
+    assert qos_lib.resolve_tenant(
+        {'Authorization': 'Bearer nope',
+         'X-SkyTPU-Tenant': 'team-x'}, {}) == 'team-x'
+    assert qos_lib.resolve_tenant({}, {'tenant': 'bodyside'}) == 'bodyside'
+    assert qos_lib.resolve_tenant({}, {}) == 'anonymous'
+
+
+def test_parse_maps():
+    w = qos_lib.parse_class_map('interactive:10,batch:0.5',
+                                {'interactive': 8.0, 'standard': 4.0,
+                                 'batch': 1.0})
+    assert w == {'interactive': 10.0, 'standard': 4.0, 'batch': 0.5}
+    with pytest.raises(ValueError):
+        qos_lib.parse_class_map('gold:1', {})
+    assert qos_lib.parse_tenant_limits('alice=5/1000, bob=1/50') == {
+        'alice': (5.0, 1000.0), 'bob': (1.0, 50.0)}
+
+
+def test_validate_env_rejects_typos_before_weight_init(monkeypatch):
+    monkeypatch.setenv('SKYTPU_QOS_WEIGHTS', 'gold:1')
+    with pytest.raises(ValueError):
+        qos_lib.validate_env()
+    monkeypatch.setenv('SKYTPU_QOS_WEIGHTS', 'interactive:9')
+    monkeypatch.setenv('SKYTPU_QOS_MAX_QUEUE', 'many')
+    with pytest.raises(ValueError):
+        qos_lib.validate_env()
+    monkeypatch.setenv('SKYTPU_QOS_MAX_QUEUE', '64')
+    qos_lib.validate_env()
+    # A typo'd quota knob must fail loudly, not silently disable quotas.
+    monkeypatch.setenv('SKYTPU_QOS_TENANT_RPS', '1O')
+    with pytest.raises(ValueError):
+        qos_lib.validate_env()
+
+
+# -- scheduler ---------------------------------------------------------------
+
+
+def _scheduler(clock, **kw):
+    opts = dict(max_inflight=2, max_queue=12,
+                weights={'interactive': 8.0, 'standard': 4.0,
+                         'batch': 1.0},
+                ttl_s={'interactive': 60.0, 'standard': 60.0,
+                       'batch': 60.0},
+                tenant_rps=0, tenant_tps=0, sweep_s=0, time_fn=clock)
+    opts.update(kw)
+    return QosScheduler(**opts)
+
+
+async def _settle(futs):
+    await asyncio.gather(*futs, return_exceptions=True)
+
+
+def test_scheduler_dispatch_follows_priority():
+    """With the gate full, the next grant goes to the highest-weight
+    waiter regardless of arrival order."""
+
+    async def scenario():
+        qos = _scheduler(FakeClock(), max_inflight=1)
+        t0 = qos.submit('standard', 'a')
+        tb = qos.submit('batch', 'a')
+        ti = qos.submit('interactive', 'a')
+        assert t0.granted.done()
+        assert not tb.granted.done() and not ti.granted.done()
+        qos.release(t0, generated_tokens=1)
+        assert ti.granted.done() and not tb.granted.done()
+        qos.release(ti, generated_tokens=1)
+        assert tb.granted.done()
+        qos.release(tb, generated_tokens=1)
+        stats = qos.stats()
+        assert stats['inflight'] == 0
+        assert stats['classes']['interactive']['admitted'] == 1
+        await _settle([t0.granted, tb.granted, ti.granted])
+
+    asyncio.run(scenario())
+
+
+def test_scheduler_tenant_quota_429_with_sane_retry_after():
+
+    async def scenario():
+        clock = FakeClock()
+        qos = _scheduler(clock, max_inflight=4,
+                         tenant_limits={'alice': (1.0, 0.0),
+                                        'bob': (0.0, 10.0)})
+        ok = qos.submit('standard', 'alice')  # burst of 1
+        with pytest.raises(ShedError) as e:
+            qos.submit('standard', 'alice')
+        assert 1 <= e.value.retry_after_s <= 2
+        # Another tenant is unaffected (per-tenant isolation).
+        other = qos.submit('standard', 'carol')
+        # Token quota: rate 10/s, burst 20. 16 fits, 16 more does not.
+        t1 = qos.submit('standard', 'bob', est_tokens=16.0)
+        with pytest.raises(ShedError) as e:
+            qos.submit('standard', 'bob', est_tokens=16.0)
+        assert 1 <= e.value.retry_after_s <= 3
+        # Completion refunds the unused ask: 16 reserved, 4 generated.
+        qos.release(t1, generated_tokens=4)
+        t2 = qos.submit('standard', 'bob', est_tokens=16.0)
+        assert qos.stats()['shed_total'] == 2
+        for t in (ok, other, t2):
+            qos.release(t, generated_tokens=1)
+        await _settle([ok.granted, other.granted, t1.granted, t2.granted])
+
+    asyncio.run(scenario())
+
+
+def test_scheduler_ttl_eviction_without_dispatch_progress():
+    """A waiter past its class TTL is evicted with QueueTimeout even
+    when nothing ever dispatches (stalled engine): expiry is clock-
+    driven, not pop-driven."""
+
+    async def scenario():
+        clock = FakeClock()
+        qos = _scheduler(clock, max_inflight=1,
+                         ttl_s={'interactive': 5.0, 'standard': 60.0,
+                                'batch': 60.0})
+        stuck = qos.submit('standard', 'a')  # holds the only slot
+        waiting = qos.submit('interactive', 'a')
+        clock.advance(6.0)
+        qos._expire()  # the sweeper's tick, driven manually
+        assert waiting.granted.done()
+        with pytest.raises(QueueTimeout):
+            waiting.granted.result()
+        stats = qos.stats()
+        assert stats['classes']['interactive']['evicted'] == 1
+        assert stats['evicted_total'] == 1
+        qos.release(stuck, generated_tokens=0)
+        await _settle([stuck.granted])
+
+    asyncio.run(scenario())
+
+
+def test_scheduler_overload_interactive_bounded_batch_absorbs_sheds():
+    """The acceptance scenario at scheduler level, fully deterministic:
+    2x offered load (24 alternating arrivals vs 2 in flight + 12
+    queued) — every shed is batch-class, every interactive arrival is
+    served, and interactive queue waits are recorded/bounded."""
+
+    async def scenario():
+        clock = FakeClock()
+        qos = _scheduler(clock)
+        tickets, incoming_sheds, futs = [], [], []
+        for i in range(24):
+            cls = 'interactive' if i % 2 == 0 else 'batch'
+            try:
+                t = qos.submit(cls, 'tenant', est_tokens=8.0)
+                tickets.append((cls, t))
+                futs.append(t.granted)
+            except ShedError:
+                incoming_sheds.append(cls)
+            clock.advance(0.01)
+        # Drain: complete dispatched work until nothing is left.
+        for _ in range(100):
+            inflight = [t for _, t in tickets if t.state == 'inflight']
+            if not inflight:
+                break
+            for t in inflight:
+                qos.release(t, generated_tokens=8)
+            clock.advance(0.05)
+        stats = qos.stats()
+        assert stats['shed_total'] > 0
+        assert incoming_sheds.count('interactive') == 0
+        assert stats['classes']['interactive']['shed'] == 0
+        assert stats['classes']['batch']['shed'] == stats['shed_total']
+        # Every admitted interactive ticket was served (none evicted).
+        assert all(t.state == 'done' for c, t in tickets
+                   if c == 'interactive')
+        assert stats['evicted_total'] == 0
+        waits = stats['classes']['interactive']['queue_wait_ms']
+        assert waits['count'] == 12  # all 12 interactive dispatched
+        assert waits['p95'] is not None and waits['p95'] < 10_000
+        await _settle(futs)
+
+    asyncio.run(scenario())
+
+
+def test_scheduler_abandon_refunds_queued_token_ask():
+    """A client disconnect while QUEUED refunds the token debit (the
+    work never ran) — same refund path as TTL eviction and shed
+    displacement; an in-flight abandon releases the slot instead."""
+
+    async def scenario():
+        clock = FakeClock()
+        qos = _scheduler(clock, max_inflight=1,
+                         tenant_limits={'bob': (0.0, 10.0)})  # burst 20
+        t1 = qos.submit('standard', 'bob', est_tokens=12.0)  # inflight
+        t2 = qos.submit('standard', 'bob', est_tokens=8.0)   # queued
+        with pytest.raises(ShedError):  # bucket drained: 20 - 12 - 8
+            qos.submit('standard', 'bob', est_tokens=8.0)
+        qos.abandon(t2)  # disconnect while queued -> refund 8
+        t3 = qos.submit('standard', 'bob', est_tokens=8.0)
+        qos.release(t1, generated_tokens=12)
+        qos.release(t3, generated_tokens=8)
+        await _settle([t1.granted, t2.granted, t3.granted])
+
+    asyncio.run(scenario())
+
+
+def test_scheduler_victim_shed_refunds_rps_token():
+    """A displaced (never-served) victim gets BOTH quota debits back —
+    overload caused by other tenants' arrivals must not burn the
+    victim tenant's request quota (429s would mutate from 'overloaded'
+    into 'quota exceeded' through no fault of its own)."""
+
+    async def scenario():
+        clock = FakeClock()
+        qos = _scheduler(clock, max_inflight=1, max_queue=1,
+                         tenant_limits={'slow': (1.0, 0.0)})  # burst 1
+        filler = qos.submit('standard', 'other')     # occupies the gate
+        victim = qos.submit('batch', 'slow')          # queued; rps now 0
+        disp = qos.submit('interactive', 'other')     # displaces victim
+        with pytest.raises(ShedError):
+            victim.granted.result()
+        qos.release(filler, generated_tokens=1)       # disp dispatches
+        # The refund restored the rps token: an immediate retry is
+        # admitted instead of 429 'request quota exceeded'.
+        retry = qos.submit('batch', 'slow')
+        qos.release(disp, generated_tokens=1)
+        qos.release(retry, generated_tokens=1)
+        await _settle([filler.granted, victim.granted, disp.granted,
+                       retry.granted])
+
+    asyncio.run(scenario())
+
+
+def test_scheduler_gate_budgets_rows_not_requests():
+    """max_inflight is a ROW budget (its default is engine slots): a
+    multi-row request consumes its row count, so row traffic cannot
+    overcommit the gate and push waiting back into the engine."""
+
+    async def scenario():
+        qos = _scheduler(FakeClock(), max_inflight=4)
+        big = qos.submit('standard', 'a', cost=4.0)   # fills the gate
+        small = qos.submit('standard', 'a', cost=1.0)
+        assert big.granted.done() and not small.granted.done()
+        qos.release(big, generated_tokens=4)
+        assert small.granted.done()
+        qos.release(small, generated_tokens=1)
+        assert qos.stats()['inflight'] == 0
+        await _settle([big.granted, small.granted])
+
+    asyncio.run(scenario())
+
+
+def test_scheduler_victim_shed_carries_retry_after():
+
+    async def scenario():
+        qos = _scheduler(FakeClock(), max_inflight=1, max_queue=1)
+        t0 = qos.submit('batch', 'a')       # dispatched
+        tb = qos.submit('batch', 'a')       # queued (queue now full)
+        ti = qos.submit('interactive', 'a')  # displaces tb
+        assert ti.item is not None and not ti.granted.done()
+        with pytest.raises(ShedError) as e:
+            tb.granted.result()
+        assert e.value.retry_after_s >= 1
+        qos.release(t0, generated_tokens=1)
+        assert ti.granted.done()
+        qos.release(ti, generated_tokens=1)
+        await _settle([t0.granted, tb.granted, ti.granted])
+
+    asyncio.run(scenario())
+
+
+# -- LB policy: float-compare fix + queue pressure ---------------------------
+
+
+def test_instance_aware_float_equality_tie_rotates():
+    """Satellite fix: mathematically-equal normalized loads that differ
+    in the last ulp (weights arriving as 0.3 vs 0.1+0.2) are TIES and
+    must rotate — the exact `== low` compare pinned all traffic to one
+    replica."""
+    from skypilot_tpu.serve.load_balancing_policies import (
+        InstanceAwareLeastLoadPolicy)
+    lb = InstanceAwareLeastLoadPolicy()
+    lb.set_replicas(['a:80', 'b:80'])
+    lb.set_weights({'a:80': 0.3, 'b:80': 0.1 + 0.2})
+    lb.on_request_start('a:80')
+    lb.on_request_start('b:80')
+    assert {lb.select() for _ in range(4)} == {'a:80', 'b:80'}
+
+
+def test_least_load_routes_around_queue_pressure():
+    from skypilot_tpu.serve.load_balancing_policies import LeastLoadPolicy
+    lb = LeastLoadPolicy()
+    lb.set_replicas(['a:1', 'b:1'])
+    lb.set_queue_pressure({'a:1': 5.0})
+    # a's deep queue repels traffic even at zero in-flight...
+    assert all(lb.select() == 'b:1' for _ in range(3))
+    for _ in range(6):
+        lb.on_request_start('b:1')
+    # ...until b's in-flight load exceeds it.
+    assert lb.select() == 'a:1'
+
+
+# -- autoscaler queue-pressure signal ----------------------------------------
+
+
+def test_autoscaler_scales_up_on_queue_pressure():
+    from skypilot_tpu.serve.autoscalers import RequestRateAutoscaler
+    from skypilot_tpu.serve.service_spec import ReplicaPolicy
+    pol = ReplicaPolicy(min_replicas=1, max_replicas=6,
+                        target_qps_per_replica=10,
+                        target_queue_per_replica=8)
+    auto = RequestRateAutoscaler(pol, upscale_counter_threshold=1)
+    # Zero qps but 30 queued requests: saturation that rate alone
+    # misses -> ceil(30/8) = 4 replicas.
+    d = auto.evaluate(1, 0, [], now=1000.0, queue_pressure=30)
+    assert d.target_num_replicas == 4
+    # No signal (or knob unset): pure rate behavior.
+    auto2 = RequestRateAutoscaler(pol, upscale_counter_threshold=1)
+    d = auto2.evaluate(1, 0, [], now=1000.0, queue_pressure=None)
+    assert d.target_num_replicas == 1
+    pol_off = ReplicaPolicy(min_replicas=1, max_replicas=6,
+                            target_qps_per_replica=10)
+    auto3 = RequestRateAutoscaler(pol_off, upscale_counter_threshold=1)
+    d = auto3.evaluate(1, 0, [], now=1000.0, queue_pressure=30)
+    assert d.target_num_replicas == 1
+
+
+def test_service_spec_roundtrips_target_queue_per_replica():
+    from skypilot_tpu.serve.service_spec import ServiceSpec
+    spec = ServiceSpec.from_yaml_config({
+        'replica_policy': {'min_replicas': 1, 'max_replicas': 4,
+                           'target_qps_per_replica': 5,
+                           'target_queue_per_replica': 16},
+    })
+    rt = ServiceSpec.from_yaml_config(spec.to_yaml_config())
+    assert rt.replica_policy.target_queue_per_replica == 16
+
+
+def test_controller_queue_pressure_extraction():
+    from skypilot_tpu.serve.controller import _queue_pressure
+    snap = [
+        {'endpoint': 'a:1',
+         'health': json.dumps({'qos': {'queue_depth_total': 5}})},
+        {'endpoint': 'b:2',
+         'health': json.dumps({'queue': {'depth_total': 2}})},
+        {'endpoint': 'c:3', 'health': None},
+    ]
+    total, by_ep = _queue_pressure(snap)
+    assert total == 7.0
+    assert by_ep == {'a:1': 5.0, 'b:2': 2.0}
+    # queue.depth_total wins when both exist: it is the superset (FIFO +
+    # overflow + QoS depth) — taking the qos block would undercount.
+    both = [{'endpoint': 'd:4',
+             'health': json.dumps({'queue': {'depth_total': 20},
+                                   'qos': {'queue_depth_total': 12}})}]
+    assert _queue_pressure(both) == (20.0, {'d:4': 20.0})
+    # Absent signal everywhere is None (unknown), not zero pressure.
+    assert _queue_pressure([{'endpoint': 'x', 'health': None}]) == (None,
+                                                                    {})
+
+
+# -- loadgen mix -------------------------------------------------------------
+
+
+def test_loadgen_mix_classes_deterministic_wrr():
+    from skypilot_tpu.serve import loadgen
+    a = loadgen.mix_classes('interactive:8,batch:2', 10)
+    assert a.count('interactive') == 8 and a.count('batch') == 2
+    assert a == loadgen.mix_classes('interactive:8,batch:2', 10)
+    assert loadgen.mix_classes('interactive:1,batch:1', 6) == \
+        ['interactive', 'batch'] * 3
+    assert loadgen.mix_classes(None, 5) is None
+    with pytest.raises(ValueError):  # zero-weight mix: clean error
+        loadgen.mix_classes('interactive:0,batch:0', 4)
+
+
+# -- HTTP surface ------------------------------------------------------------
+
+
+class _FakeEngine:
+    """Engine stand-in for admission-path tests: instant results (or a
+    permanent stall) with zero jax compile cost."""
+    slots = 4
+
+    def __init__(self, stalled: bool = False):
+        self.stalled = stalled
+
+    def submit(self, row, max_new, temperature=0.0, top_k=0, top_p=1.0,
+               eos=None, on_tokens=None):
+        fut: cf.Future = cf.Future()
+        if not self.stalled:
+            fut.set_result([1] * max_new)
+        return fut
+
+    def stats(self):
+        return {'slots': self.slots}
+
+    def stop(self):
+        pass
+
+
+def _start_http(server, port_base: int) -> str:
+    from aiohttp import web
+
+    from skypilot_tpu.utils import common_utils
+    port = common_utils.find_free_port(port_base)
+    started = threading.Event()
+
+    def run():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(server.make_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, '127.0.0.1', port)
+        loop.run_until_complete(site.start())
+        started.set()
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(15)
+    return f'http://127.0.0.1:{port}'
+
+
+def _qos_server(stalled=False, **qos_opts):
+    """LlmServer with QoS on and the engine swapped for the fake:
+    constructed with --engine off (no real engine thread) and then
+    given the stub, so admission-path tests never pay a jax compile."""
+    from skypilot_tpu.serve import llm_server as llm_mod
+    opts = dict(max_inflight=2, max_queue=8,
+                ttl_s={'interactive': 30.0, 'standard': 30.0,
+                       'batch': 30.0},
+                tenant_rps=0, tenant_tps=0)
+    opts.update(qos_opts)
+    server = llm_mod.LlmServer('tiny', max_len=64, engine='off',
+                               qos='on', qos_opts=opts)
+    server.engine = _FakeEngine(stalled=stalled)
+    return server
+
+
+def test_http_tenant_bucket_exhaustion_429_retry_after():
+    server = _qos_server(tenant_limits={'limited': (1.0, 0.0)})
+    url = _start_http(server, 22510)
+    payload = {'tokens': [[1, 2, 3]], 'max_new_tokens': 4}
+    hdrs = {'X-SkyTPU-Tenant': 'limited'}
+    r1 = requests_lib.post(f'{url}/generate', json=payload, headers=hdrs,
+                           timeout=30)
+    assert r1.status_code == 200
+    assert r1.json()['tokens'] == [[1, 1, 1, 1]]
+    r2 = requests_lib.post(f'{url}/generate', json=payload, headers=hdrs,
+                           timeout=30)
+    assert r2.status_code == 429, r2.text
+    retry_after = int(r2.headers['Retry-After'])
+    assert 1 <= retry_after <= 3600
+    assert r2.json()['shed'] is True
+    # Another tenant is unaffected.
+    r3 = requests_lib.post(f'{url}/generate', json=payload,
+                           headers={'X-SkyTPU-Tenant': 'other'},
+                           timeout=30)
+    assert r3.status_code == 200
+    # Counters surface on /health for the controller/metrics/dashboard.
+    h = requests_lib.get(f'{url}/health', timeout=10).json()
+    assert h['qos']['shed_total'] == 1
+    assert h['qos']['classes']['standard']['shed'] == 1
+    assert h['queue']['depth_total'] == 0
+
+
+def test_http_ttl_eviction_under_stalled_engine():
+    """A stalled engine must not grow the queue forever: the waiter is
+    evicted at its TTL with a 504, driven by the sweeper timer."""
+    server = _qos_server(stalled=True, max_inflight=1,
+                         ttl_s={'interactive': 0.8, 'standard': 30.0,
+                                'batch': 30.0},
+                         sweep_s=0.1)
+    url = _start_http(server, 22530)
+    payload = {'tokens': [[1, 2, 3]], 'max_new_tokens': 4}
+
+    def _stuck():
+        try:  # occupies the only in-flight slot forever
+            requests_lib.post(f'{url}/generate', json=payload, timeout=20)
+        except Exception:  # noqa: BLE001 — abandoned at test end
+            pass
+
+    threading.Thread(target=_stuck, daemon=True).start()
+    deadline = time.time() + 5
+    while time.time() < deadline:  # wait until the slot is held
+        h = requests_lib.get(f'{url}/health', timeout=10).json()
+        if h['qos']['inflight'] == 1:
+            break
+        time.sleep(0.05)
+    t0 = time.time()
+    r = requests_lib.post(f'{url}/generate',
+                          json={**payload, 'priority': 'interactive'},
+                          timeout=20)
+    assert r.status_code == 504, r.text
+    assert 'TTL' in r.json()['error']
+    assert time.time() - t0 < 10
+    h = requests_lib.get(f'{url}/health', timeout=10).json()
+    assert h['qos']['classes']['interactive']['evicted'] == 1
+
+
+def test_http_unknown_priority_is_400():
+    server = _qos_server()
+    url = _start_http(server, 22550)
+    r = requests_lib.post(f'{url}/generate',
+                          json={'tokens': [[1, 2]], 'max_new_tokens': 2,
+                                'priority': 'urgent'}, timeout=30)
+    assert r.status_code == 400
+    assert 'priority' in r.json()['error']
+
+
+@pytest.mark.slow
+def test_greedy_byte_parity_with_qos_disabled(monkeypatch):
+    """Acceptance: with SKYTPU_QOS=0 (default) the serving path is the
+    pre-QoS path — greedy output matches the solo-generate oracle and
+    no QoS state exists; the same request through a QoS-on server is
+    byte-identical (admission changes WHEN work runs, never WHAT it
+    computes)."""
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models import generate as gen_lib
+    from skypilot_tpu.serve import llm_server as llm_mod
+
+    monkeypatch.delenv('SKYTPU_QOS', raising=False)
+    prompt = [1, 2, 3, 4]
+    payload = {'tokens': [prompt], 'max_new_tokens': 5}
+
+    server_off = llm_mod.LlmServer('tiny', max_len=64, engine='off')
+    assert server_off.qos is None  # default: no scheduler constructed
+    url_off = _start_http(server_off, 22570)
+    r_off = requests_lib.post(f'{url_off}/generate', json=payload,
+                              timeout=300)
+    assert r_off.status_code == 200
+
+    oracle = gen_lib.generate(server_off.params, server_off.cfg,
+                              jnp.asarray([prompt], jnp.int32),
+                              max_new_tokens=5, max_len=64)
+    import numpy as np
+    assert r_off.json()['tokens'] == [np.asarray(oracle[0]).tolist()]
+
+    server_on = llm_mod.LlmServer('tiny', max_len=64, engine='off',
+                                  qos='on')
+    server_on.params = server_off.params  # same weights, same oracle
+    url_on = _start_http(server_on, 22590)
+    r_on = requests_lib.post(f'{url_on}/generate', json=payload,
+                             timeout=300)
+    assert r_on.status_code == 200
+    assert r_on.json()['tokens'] == r_off.json()['tokens']
+
+    h_off = requests_lib.get(f'{url_off}/health', timeout=10).json()
+    h_on = requests_lib.get(f'{url_on}/health', timeout=10).json()
+    assert 'qos' not in h_off and 'queue' in h_off  # satellite: depth
+    assert h_on['qos']['enabled'] is True
+
+
+@pytest.mark.slow
+def test_qos_overload_acceptance_probe():
+    """Acceptance end-to-end (shared with bench.py's ``qos_overload``
+    entry and ``perf_probe --qos``): real tiny-model replica, 2x
+    offered load, deterministic interactive/batch mix — sheds happen,
+    batch absorbs 100% of them, interactive is fully served with
+    bounded queue wait."""
+    import bench
+    summary = bench.qos_overload_probe(assert_gates=True)
+    assert summary['shed_total'] > 0
+    assert summary['interactive_shed'] == 0

@@ -8,6 +8,13 @@ real data. Writes JSON lines to stdout.
 ``--smoke`` instead runs ONLY the CPU-backend decode-overlap check
 (pipelined vs serial engine on a tiny model) — a seconds-long CI gate,
 no chip required.
+
+``--qos`` runs the QoS overload smoke (bench.qos_overload_probe with
+its assertion gates): a tiny-model replica with admission control on,
+driven at ~2x capacity with a deterministic interactive/batch mix —
+asserts sheds happened, batch absorbed 100% of them, and interactive
+queue wait stayed bounded. CPU-only, seconds-long, wired into
+``make verify``.
 """
 import json
 import os
@@ -108,6 +115,15 @@ def decode_overlap_smoke() -> dict:
 
 
 def main():
+    if '--qos' in sys.argv:
+        # CPU-only by design (same rationale as --smoke): never touch
+        # or wait on a chip in CI.
+        jax.config.update('jax_platforms', 'cpu')
+        import bench
+        print(json.dumps({'qos_overload_smoke': 'ok',
+                          **bench.qos_overload_probe(assert_gates=True)}),
+              flush=True)
+        return
     if '--smoke' in sys.argv:
         # CPU-only by design: never touch (or wait on) a chip in CI.
         # Single-threaded XLA compute (set BEFORE backend init): on a
