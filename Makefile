@@ -19,11 +19,14 @@ ci:
 	$(MAKE) audit-clean
 
 # Serving smokes (CPU, seconds; no chip touched): the decode-overlap
-# A/B and the QoS overload admission gate (interactive bounded, batch
-# absorbs 100% of sheds under 2x load).
+# A/B, the QoS overload admission gate (interactive bounded, batch
+# absorbs 100% of sheds under 2x load), and the tracing gate (every
+# sampled trace closes + nests, TTFT/queue-wait histograms fill,
+# greedy output byte-identical traced vs untraced).
 verify:
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --smoke
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --qos
+	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --trace
 
 lint:
 	$(PY) tools/lint.py

@@ -27,6 +27,7 @@ setup(
         'pyyaml', 'jinja2', 'networkx', 'pandas', 'filelock', 'click',
         'requests', 'aiohttp', 'psutil', 'rich',
         'cryptography',  # SSH keypair generation (authentication.py)
+        'prometheus_client',  # /metrics histograms (server/metrics.py)
     ],
     extras_require={
         'tpu': ['jax', 'flax', 'optax', 'orbax-checkpoint', 'einops'],

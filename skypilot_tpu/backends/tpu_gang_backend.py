@@ -36,6 +36,7 @@ from skypilot_tpu import optimizer as optimizer_lib
 from skypilot_tpu import provision as provision_lib
 from skypilot_tpu.agent import constants, job_lib, log_lib
 from skypilot_tpu.backends.backend import Backend, ClusterHandle
+from skypilot_tpu.observability import trace as trace_lib
 from skypilot_tpu.provision import common as provision_common
 from skypilot_tpu.resources import Resources
 from skypilot_tpu.task import Task
@@ -162,10 +163,13 @@ class TpuGangBackend(Backend):
                 'context': deploy_vars.get('context'),
             }
             try:
-                provision_lib.run_instances(to_provision.cloud, cfg)
-                provision_lib.wait_instances(to_provision.cloud, region,
-                                             name_on_cloud, 'running',
-                                             provider_config=provider_config)
+                with trace_lib.span('provision.instances',
+                                    cloud=to_provision.cloud,
+                                    region=region, zone=zone):
+                    provision_lib.run_instances(to_provision.cloud, cfg)
+                    provision_lib.wait_instances(
+                        to_provision.cloud, region, name_on_cloud,
+                        'running', provider_config=provider_config)
             except (exceptions.QuotaExceededError,
                     exceptions.ResourcesUnavailableError) as e:
                 failover_history.append(e)
@@ -186,7 +190,9 @@ class TpuGangBackend(Backend):
                 provider_config=provider_config)
             os.makedirs(runtime_dir(cluster_name), exist_ok=True)
             try:
-                self._post_provision_setup(handle)
+                with trace_lib.span('provision.agent_setup',
+                                    cloud=to_provision.cloud):
+                    self._post_provision_setup(handle)
             except (exceptions.ClusterNotUpError, subprocess.CalledProcessError,
                     OSError) as e:
                 # Bootstrap failure is a provisioning failure: clean up and
@@ -653,21 +659,27 @@ class TpuGangBackend(Backend):
             'nonce': nonce,
         }
 
-        if remote:
-            job_id = self._agent(handle, info).submit_job(
-                job_name, handle.num_nodes, len(workers), spec)
-        else:
-            env = dict(os.environ)
-            env['PYTHONPATH'] = (os.path.dirname(os.path.dirname(__file__)) +
-                                 os.pathsep + env.get('PYTHONPATH', ''))
-            job_id = job_lib.submit_and_spawn_driver(
-                cdir, job_name, handle.num_nodes, len(workers), spec,
-                env=env)
+        with trace_lib.span('agent.submit_job', remote=remote,
+                            job_name=job_name):
+            if remote:
+                job_id = self._agent(handle, info).submit_job(
+                    job_name, handle.num_nodes, len(workers), spec)
+            else:
+                env = dict(os.environ)
+                env['PYTHONPATH'] = (
+                    os.path.dirname(os.path.dirname(__file__)) +
+                    os.pathsep + env.get('PYTHONPATH', ''))
+                job_id = job_lib.submit_and_spawn_driver(
+                    cdir, job_name, handle.num_nodes, len(workers), spec,
+                    env=env)
         global_user_state.touch_activity(handle.cluster_name)
         global_user_state.add_cluster_event(
             handle.cluster_name, 'JOB_SUBMITTED', f'job {job_id} {job_name}')
         if not detach_run:
-            self.tail_logs(handle, job_id, follow=True)
+            # Follow-mode: the span covers the job's whole run (the
+            # agent "run" phase a traced launch waits on).
+            with trace_lib.span('agent.run_follow', job_id=job_id):
+                self.tail_logs(handle, job_id, follow=True)
         return job_id
 
     # -- logs / queue ------------------------------------------------------

@@ -14,6 +14,7 @@ from skypilot_tpu import global_user_state
 from skypilot_tpu import optimizer as optimizer_lib
 from skypilot_tpu import usage
 from skypilot_tpu.backends import ClusterHandle, TpuGangBackend
+from skypilot_tpu.observability import trace as trace_lib
 from skypilot_tpu.task import Task
 from skypilot_tpu.utils import timeline
 
@@ -62,16 +63,22 @@ def launch(task: Task,
     from skypilot_tpu import logs as logs_lib
     logs_lib.agent_from_config()
 
+    # Stage spans (observability/trace.py): no-ops outside a trace; a
+    # traced launch (API request runner, or any caller holding a trace)
+    # gets per-stage timings nested under its root.
+    trace_lib.set_attr(cluster_name=cluster_name)
     if Stage.OPTIMIZE in stages:
         existing = global_user_state.get_cluster(cluster_name)
         if existing is None and task.best_resources is None:
-            optimizer_lib.optimize(task)
+            with trace_lib.span('launch.optimize'):
+                optimizer_lib.optimize(task)
 
     handle: Optional[ClusterHandle] = None
     if Stage.PROVISION in stages:
-        handle = backend.provision(task, cluster_name,
-                                   retry_until_up=retry_until_up,
-                                   dryrun=dryrun)
+        with trace_lib.span('launch.provision'):
+            handle = backend.provision(task, cluster_name,
+                                       retry_until_up=retry_until_up,
+                                       dryrun=dryrun)
         if dryrun:
             return None, None
     assert handle is not None
@@ -81,16 +88,19 @@ def launch(task: Task,
         core.autostop(cluster_name, idle_minutes_to_autostop, down=down)
 
     if Stage.SYNC_WORKDIR in stages and task.workdir:
-        backend.sync_workdir(handle, task.workdir)
+        with trace_lib.span('launch.sync_workdir'):
+            backend.sync_workdir(handle, task.workdir)
     if Stage.SYNC_FILE_MOUNTS in stages:
-        backend.sync_file_mounts(handle, task.file_mounts)
-        backend.sync_storage_mounts(handle, task.storage_mounts)
-        backend.sync_volumes(handle, getattr(task, 'volumes', {}))
+        with trace_lib.span('launch.sync_mounts'):
+            backend.sync_file_mounts(handle, task.file_mounts)
+            backend.sync_storage_mounts(handle, task.storage_mounts)
+            backend.sync_volumes(handle, getattr(task, 'volumes', {}))
 
     job_id: Optional[int] = None
     if Stage.EXEC in stages and (task.run is not None or task.setup):
-        job_id = backend.execute(handle, task, detach_run=detach_run,
-                                 include_setup=True)
+        with trace_lib.span('launch.exec'):
+            job_id = backend.execute(handle, task, detach_run=detach_run,
+                                     include_setup=True)
     if Stage.DOWN in stages and down and idle_minutes_to_autostop is None:
         backend.teardown(handle, terminate=True)
         handle = None
@@ -115,7 +125,9 @@ def exec_(task: Task, cluster_name: str,
     handle = ClusterHandle.from_dict(record['handle'])
     backend._check_task_fits(task, handle)  # pylint: disable=protected-access
     if task.workdir:
-        backend.sync_workdir(handle, task.workdir)
-    job_id = backend.execute(handle, task, detach_run=detach_run,
-                             include_setup=False)
+        with trace_lib.span('launch.sync_workdir'):
+            backend.sync_workdir(handle, task.workdir)
+    with trace_lib.span('launch.exec'):
+        job_id = backend.execute(handle, task, detach_run=detach_run,
+                                 include_setup=False)
     return job_id, handle

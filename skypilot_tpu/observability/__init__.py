@@ -1,0 +1,6 @@
+"""Observability subsystems: end-to-end request tracing (`trace`).
+
+Dependency-free by design — the modules here ride inside every process
+of the deployment (serving replicas, the API server, request runners)
+and must never add import weight or a hard dependency to any of them.
+"""

@@ -1,0 +1,520 @@
+"""End-to-end request tracing with per-phase spans.
+
+Reference analog: none in the reference (it ships Chrome-trace profiling
+of control-plane verbs, ``sky/utils/timeline.py`` — mirrored here as
+``utils/timeline.py``); this is the request-scoped half: one trace per
+request, spans per phase, correlated ACROSS processes and layers so
+"where did this one slow request spend its time?" has an answer.
+
+Design constraints (why not OpenTelemetry): the tracer rides inside the
+serving hot path of every replica, the API server, and every request
+runner — it must be dependency-free, near-zero overhead when idle, and
+bounded in memory. Spans are plain dataclasses; completed traces land in
+a fixed-size ring; everything else is stdlib.
+
+Concepts:
+
+* A **trace** is one request's tree of **spans** (name + start/end +
+  attrs), identified by a 32-hex trace id. Spans carry 16-hex span ids
+  and a parent id, so consumers can rebuild the tree (the dashboard's
+  waterfall, ``tools/perf_probe.py --trace``'s nesting checks).
+* **Propagation** is ``contextvars``-based in-process (async handlers
+  and nested sync calls see the current span) and header-based across
+  processes: ``X-SkyTPU-Trace: 00-<trace32>-<span16>-<flags>`` (the
+  W3C ``traceparent`` shape, under our own header name). ``flags``
+  bit 0 = sampled; an unsampled inbound header suppresses local work.
+* **Sampling** is env-controlled: ``SKYTPU_TRACE=0`` disables tracing
+  entirely; ``SKYTPU_TRACE_SAMPLE=0.1`` samples 10% of locally-rooted
+  traces (default 1.0 — sample-all; each span is one small object
+  appended to a list, so sample-all is the sane default).
+* **Collection**: a completed trace (its process-local root span ended)
+  becomes one JSON-able record in a bounded ring
+  (``SKYTPU_TRACE_RING``, default 256). Short-lived processes (request
+  runners) export records as JSON files instead
+  (``SKYTPU_TRACE_EXPORT=1``; directory ``SKYTPU_TRACE_EXPORT_DIR``,
+  default ``$SKYTPU_STATE_DIR/traces``, rotated to
+  ``SKYTPU_TRACE_EXPORT_KEEP`` newest files) — ``collect()`` merges
+  ring + exported records by trace id, which is how a runner's
+  provision spans reattach to the API server's middleware root.
+* **Retroactive spans** (``add_span``): serving timings come from
+  engine callbacks on other threads; handlers record cheap float
+  timestamps and build the spans afterwards, so the decode loop never
+  touches the tracer.
+
+Instrumented paths: the serving path (queue wait -> prefill -> decode
+chunks -> stream complete, ``serve/llm_server.py``), the API-server
+path (middleware -> executor -> request runner, keyed by request id),
+and the launch path (``execution.py`` stages -> provisioner -> agent
+setup/run). ``/debug/traces`` on both servers queries the ring.
+"""
+from __future__ import annotations
+
+import collections
+import contextvars
+import dataclasses
+import json
+import os
+import random
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+TRACE_HEADER = 'X-SkyTPU-Trace'
+_VERSION = '00'
+
+_current: contextvars.ContextVar[Optional['Span']] = \
+    contextvars.ContextVar('skytpu_trace_span', default=None)
+
+
+def enabled() -> bool:
+    """Tracing master switch (read live: tests and the byte-parity probe
+    flip it mid-process)."""
+    return os.environ.get('SKYTPU_TRACE', '1') not in ('0', '', 'off')
+
+
+def sample_rate() -> float:
+    try:
+        return min(max(
+            float(os.environ.get('SKYTPU_TRACE_SAMPLE', '1')), 0.0), 1.0)
+    except ValueError:
+        return 1.0
+
+
+def _ring_size() -> int:
+    try:
+        return max(int(os.environ.get('SKYTPU_TRACE_RING', '256')), 1)
+    except ValueError:
+        return 256
+
+
+@dataclasses.dataclass
+class Span:
+    """One phase of one trace. Plain data: creating a span is an object
+    allocation plus a ``time.time()`` call.
+
+    ``bucket`` is the process-local root's span list, inherited from the
+    parent at creation — collection is keyed by ROOT, not by trace id,
+    so two concurrent requests joining the SAME inbound trace id (the
+    traceparent model invites that) never steal each other's spans."""
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    start: float
+    end: Optional[float] = None
+    attrs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    bucket: Optional[List['Span']] = dataclasses.field(
+        default=None, repr=False, compare=False)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {'name': self.name, 'span_id': self.span_id,
+             'parent_id': self.parent_id,
+             'start': self.start, 'end': self.end}
+        if self.end is not None:
+            d['duration_ms'] = round((self.end - self.start) * 1000.0, 3)
+        if self.attrs:
+            d['attrs'] = self.attrs
+        return d
+
+
+class _Tracer:
+    """Process-wide collector: completed traces in a bounded ring.
+    In-flight spans accumulate on their root span's ``bucket`` (no
+    global live table — see Span.bucket)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(
+            maxlen=_ring_size())
+
+    @staticmethod
+    def record(span: Span) -> None:
+        """File a finished non-root span. Spans with no bucket (their
+        root already finalized its snapshot, or none existed) are
+        dropped — nothing grows unboundedly. List append under the GIL:
+        safe from engine threads."""
+        if span.bucket is not None:
+            span.bucket.append(span)
+
+    def finalize(self, root: Span) -> Dict[str, Any]:
+        # Snapshot: appends landing after this (late engine callbacks)
+        # are deliberately dropped.
+        spans = list(root.bucket or ())
+        spans.append(root)
+        spans.sort(key=lambda s: s.start)
+        record = {
+            'trace_id': root.trace_id,
+            'name': root.name,
+            'start': root.start,
+            'duration_ms': round(((root.end or root.start) - root.start)
+                                 * 1000.0, 3),
+            'attrs': root.attrs,
+            'spans': [s.to_dict() for s in spans],
+        }
+        with self._lock:
+            if self._ring.maxlen != _ring_size():  # env changed (tests)
+                self._ring = collections.deque(self._ring,
+                                               maxlen=_ring_size())
+            self._ring.append(record)
+        if export_enabled():
+            _export(record)
+        return record
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._ring)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+_TRACER = _Tracer()
+
+
+class _NoopCtx:
+    """Shared do-nothing context manager: the cost of tracing-off is one
+    attribute load and one truthiness check."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+    def __bool__(self):
+        return False
+
+
+_NOOP = _NoopCtx()
+
+
+class _SpanCtx:
+    __slots__ = ('span', '_token', '_root')
+
+    def __init__(self, span: Span, root: bool = False):
+        self.span = span
+        self._root = root
+
+    def __bool__(self):
+        return True
+
+    def __enter__(self) -> Span:
+        if self._root and self.span.bucket is None:
+            self.span.bucket = []
+        self._token = _current.set(self.span)
+        return self.span
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.span.end = time.time()
+        if exc_type is not None:
+            self.span.attrs.setdefault('error', exc_type.__name__)
+        _current.reset(self._token)
+        if self._root:
+            _TRACER.finalize(self.span)
+        else:
+            _TRACER.record(self.span)
+        return False
+
+
+# -- ids / header propagation ------------------------------------------------
+
+
+def make_header(trace_id: Optional[str] = None,
+                span_id: Optional[str] = None,
+                sampled: bool = True) -> str:
+    """A propagation header for a (possibly brand-new) trace — what a
+    client (load balancer, loadgen) sends to correlate its request."""
+    tid = trace_id or uuid.uuid4().hex
+    sid = span_id or uuid.uuid4().hex[:16]
+    return f'{_VERSION}-{tid}-{sid}-{"01" if sampled else "00"}'
+
+
+def mint_sampled() -> bool:
+    """Roll the local sampling decision for a header MINTER (the load
+    balancer): an inbound sampled header overrides downstream sampling,
+    so the minter must honor SKYTPU_TRACE_SAMPLE itself or the knob
+    becomes ineffective for proxied traffic."""
+    rate = sample_rate()
+    return rate >= 1.0 or random.random() < rate
+
+
+def mint_header() -> Optional[str]:
+    """A fresh outbound header for CLIENTS that originate requests (the
+    LB proxy, loadgen): None when tracing is disabled in this process,
+    else a new trace id whose sampled flag rolls this process's
+    SKYTPU_TRACE_SAMPLE — one implementation so minters cannot drift on
+    the sampling semantics."""
+    if not enabled():
+        return None
+    return make_header(sampled=mint_sampled())
+
+
+def parse_header(value: Optional[str]):
+    """``'00-<32hex>-<16hex>-<flags>'`` -> (trace_id, span_id, sampled),
+    or None for anything malformed (a bad header must never 500 the
+    request it rode in on)."""
+    if not value:
+        return None
+    parts = str(value).strip().split('-')
+    if len(parts) != 4:
+        return None
+    _, tid, sid, flags = parts
+    if len(tid) != 32 or len(sid) != 16 or len(flags) != 2:
+        return None
+    try:
+        int(tid, 16)
+        int(sid, 16)
+        flag_bits = int(flags, 16)
+    except ValueError:
+        return None
+    return tid, sid, bool(flag_bits & 1)
+
+
+def header_value() -> Optional[str]:
+    """The outbound propagation header for the current span (None when
+    nothing is being traced) — what crosses a process boundary."""
+    s = _current.get()
+    if s is None:
+        return None
+    return f'{_VERSION}-{s.trace_id}-{s.span_id}-01'
+
+
+# -- span construction -------------------------------------------------------
+
+
+def start_trace(name: str, headers: Any = None,
+                parent_header: Optional[str] = None, **attrs):
+    """Open this process's root span for a request. Joins the caller's
+    trace when a valid sampled ``X-SkyTPU-Trace`` arrives (an unsampled
+    one suppresses local tracing); otherwise makes the local sampling
+    decision. Use as a context manager; falsy/no-op when not sampled."""
+    if parent_header is None and headers is not None:
+        parent_header = headers.get(TRACE_HEADER)
+    parsed = parse_header(parent_header)
+    if not enabled():
+        return _NOOP
+    if parsed is not None:
+        tid, parent_id, sampled = parsed
+        if not sampled:
+            return _NOOP
+    else:
+        rate = sample_rate()
+        if rate <= 0.0 or (rate < 1.0 and random.random() >= rate):
+            return _NOOP
+        tid, parent_id = uuid.uuid4().hex, None
+    span = Span(name=name, trace_id=tid, span_id=uuid.uuid4().hex[:16],
+                parent_id=parent_id, start=time.time(), attrs=dict(attrs))
+    return _SpanCtx(span, root=True)
+
+
+def span(name: str, **attrs):
+    """A child span under the current one; no-op outside any trace (so
+    instrumented library code costs one contextvar read on untraced
+    calls)."""
+    parent = _current.get()
+    if parent is None:
+        return _NOOP
+    s = Span(name=name, trace_id=parent.trace_id,
+             span_id=uuid.uuid4().hex[:16], parent_id=parent.span_id,
+             start=time.time(), attrs=dict(attrs), bucket=parent.bucket)
+    return _SpanCtx(s)
+
+
+def current() -> Optional[Span]:
+    return _current.get()
+
+
+def set_attr(**attrs) -> None:
+    """Attach attributes to the current span (no-op when untraced)."""
+    s = _current.get()
+    if s is not None:
+        s.attrs.update(attrs)
+
+
+def add_span(name: str, start: float, end: float,
+             parent: Optional[Span] = None, **attrs) -> Optional[Span]:
+    """Retroactive span from already-recorded timestamps: serving phases
+    are timed by engine callbacks on other threads (cheap float
+    appends); the handler builds the spans afterwards. Parents to the
+    current span unless an explicit parent Span is given."""
+    anchor = parent if parent is not None else _current.get()
+    if anchor is None:
+        return None
+    s = Span(name=name, trace_id=anchor.trace_id,
+             span_id=uuid.uuid4().hex[:16], parent_id=anchor.span_id,
+             start=start, end=end, attrs=dict(attrs),
+             bucket=anchor.bucket)
+    _TRACER.record(s)
+    return s
+
+
+def reset() -> None:
+    """Drop all collected state (tests / probes)."""
+    _TRACER.reset()
+
+
+# -- export (cross-process traces: request runners -> API server) -----------
+
+
+def export_enabled() -> bool:
+    return os.environ.get('SKYTPU_TRACE_EXPORT', '0') == '1'
+
+
+def export_dir() -> str:
+    d = os.environ.get('SKYTPU_TRACE_EXPORT_DIR')
+    if d:
+        return os.path.expanduser(d)
+    state = os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    return os.path.join(state, 'traces')
+
+
+def _export_keep() -> int:
+    try:
+        return max(int(os.environ.get('SKYTPU_TRACE_EXPORT_KEEP', '512')),
+                   1)
+    except ValueError:
+        return 512
+
+
+def _export(record: Dict[str, Any]) -> None:
+    """One JSON file per completed trace record, newest-N rotation.
+    Best-effort: tracing must never fail the traced work."""
+    try:
+        d = export_dir()
+        os.makedirs(d, exist_ok=True)
+        fname = (f'{int(record["start"] * 1000):013d}-'
+                 f'{record["trace_id"][:12]}-{os.getpid()}.json')
+        tmp = os.path.join(d, f'.{fname}.tmp')
+        with open(tmp, 'w', encoding='utf-8') as f:
+            json.dump(record, f)
+        os.replace(tmp, os.path.join(d, fname))
+        names = sorted(n for n in os.listdir(d) if n.endswith('.json'))
+        for stale in names[:-_export_keep()]:
+            try:
+                os.remove(os.path.join(d, stale))
+            except OSError:
+                pass
+    except (OSError, TypeError, ValueError):
+        return
+
+
+def read_exported(limit: int = 200,
+                  trace_prefix: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Newest exported trace records (unreadable files skipped). The
+    read is BOUNDED — it runs synchronously inside the /debug/traces
+    handlers — and a trace-id prefix filters on the FILENAME (which
+    embeds the first 12 id chars) before any file is opened."""
+    d = export_dir()
+    try:
+        names = sorted((n for n in os.listdir(d) if n.endswith('.json')),
+                       reverse=True)
+    except OSError:
+        return []
+    if trace_prefix:
+        p = trace_prefix[:12]
+        names = [n for n in names
+                 if len(n.split('-')) >= 2 and n.split('-')[1].startswith(p)]
+    names = names[:max(limit, 0)]
+    out = []
+    for name in names:
+        try:
+            with open(os.path.join(d, name), encoding='utf-8') as f:
+                rec = json.load(f)
+            if isinstance(rec, dict) and rec.get('trace_id'):
+                out.append(rec)
+        except (OSError, ValueError):
+            continue
+    return out
+
+
+# -- query (/debug/traces on both servers) -----------------------------------
+
+
+def collect(trace_id: Optional[str] = None,
+            qos_class: Optional[str] = None,
+            tenant: Optional[str] = None,
+            limit: int = 20,
+            slowest_first: bool = False,
+            include_exported: bool = True) -> List[Dict[str, Any]]:
+    """Completed traces, ring + exported records merged by trace id (a
+    trace's spans may come from several processes: API-server middleware
+    in-ring, request-runner record exported). Filters: trace-id prefix,
+    root ``qos_class``/``tenant`` attrs."""
+    records = _TRACER.snapshot()
+    if include_exported:
+        # Bounded: ~5 export files per requested trace (a trace rarely
+        # spans more than two processes), floor 100 — /debug/traces must
+        # not open the whole 512-file spool for a limit-10 dashboard
+        # poll.
+        records = records + read_exported(
+            limit=max(limit * 5, 100), trace_prefix=trace_id)
+    merged: Dict[str, Dict[str, Any]] = {}
+    seen_spans: Dict[str, set] = {}
+    for rec in records:
+        tid = rec['trace_id']
+        spans = rec.get('spans') or []
+        cur = merged.get(tid)
+        if cur is None:
+            merged[tid] = cur = {
+                'trace_id': tid,
+                'name': rec.get('name'),
+                'start': rec.get('start'),
+                'attrs': dict(rec.get('attrs') or {}),
+                'spans': [],
+            }
+            seen_spans[tid] = set()
+        else:
+            cur['attrs'].update(rec.get('attrs') or {})
+            cur['start'] = min(cur['start'], rec.get('start', cur['start']))
+        for s in spans:
+            sid = s.get('span_id')
+            if sid in seen_spans[tid]:  # same record in ring AND on disk
+                continue
+            seen_spans[tid].add(sid)
+            cur['spans'].append(s)
+    out = []
+    for tr in merged.values():
+        tr['spans'].sort(key=lambda s: (s.get('start') or 0))
+        roots = [s for s in tr['spans'] if not s.get('parent_id')]
+        if roots:
+            tr['name'] = roots[0]['name']
+        ends = [s['end'] for s in tr['spans'] if s.get('end') is not None]
+        tr['duration_ms'] = (round((max(ends) - tr['start']) * 1000.0, 3)
+                             if ends else 0.0)
+        if trace_id and not tr['trace_id'].startswith(trace_id):
+            continue
+        if qos_class and tr['attrs'].get('qos_class') != qos_class:
+            continue
+        if tenant and tr['attrs'].get('tenant') != tenant:
+            continue
+        out.append(tr)
+    if slowest_first:
+        out.sort(key=lambda t: t['duration_ms'], reverse=True)
+    else:
+        out.sort(key=lambda t: t['start'], reverse=True)
+    return out[:max(limit, 0)]
+
+
+def debug_payload(query: Any) -> Dict[str, Any]:
+    """The ``/debug/traces`` response body, shared by the API server and
+    the serving replica (``query`` = the request's query mapping)."""
+    def _get(key):
+        v = query.get(key)
+        return str(v) if v else None
+
+    try:
+        limit = min(max(int(query.get('limit', 20)), 1), 200)
+    except (TypeError, ValueError):
+        limit = 20
+    traces = collect(
+        trace_id=_get('trace_id'),
+        qos_class=_get('qos_class') or _get('class'),
+        tenant=_get('tenant'),
+        limit=limit,
+        slowest_first=str(query.get('slowest', '')) in ('1', 'true'))
+    return {'enabled': enabled(), 'sample_rate': sample_rate(),
+            'count': len(traces), 'traces': traces}

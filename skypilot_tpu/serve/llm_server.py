@@ -25,10 +25,27 @@ weighted-fair scheduler orders admission, per-tenant token buckets cap
 request and generated-token rates, and overload sheds batch-first with
 429 + Retry-After while queue TTLs evict stale waiters (504).
 
+TRACING (``observability/trace.py``; on by default, ``SKYTPU_TRACE=0``
+disables): every request gets a ``serve.generate`` root span — joined
+to the caller's trace when an ``X-SkyTPU-Trace`` header arrives — with
+``qos.queue_wait`` / ``serve.prefill`` / ``serve.decode`` (per-chunk
+children annotated with the engine's pipeline-overlap deltas) /
+``serve.stream`` phases built retroactively from engine-callback
+timestamps, so the decode loop never touches the tracer. The same
+timestamps feed the Prometheus latency histograms
+(``server/metrics.py``: TTFT, queue wait, per-phase, decode tok/s, per
+QoS class). Tracing is observational only: greedy output is
+byte-identical with it on or off.
+
 API (token-level; tokenization is the client's concern — no tokenizer
 assets ship in-image):
   GET  /health               -> {"status": "ok", "model": ...,
                                  "batches_served": N, "max_batch_seen": M}
+  GET  /metrics              -> Prometheus scrape (latency histograms +
+                                engine/queue gauges)
+  GET  /debug/traces         -> recent/slowest completed traces
+                                (?slowest=1, ?trace_id=, ?qos_class=,
+                                ?tenant=, ?limit=)
   POST /generate             {"tokens": [[...]], "max_new_tokens": N,
                               "temperature": t?, "seed": s?}
                              -> {"tokens": [[...]]}
@@ -43,6 +60,7 @@ import asyncio
 import collections
 import contextlib
 import os
+import time
 from typing import Any, Deque, Dict, List, Optional
 
 import jax
@@ -50,6 +68,7 @@ from aiohttp import web
 
 from skypilot_tpu.models import generate as gen_lib
 from skypilot_tpu.models import llama
+from skypilot_tpu.observability import trace as trace_lib
 from skypilot_tpu.serve import qos as qos_lib
 
 MAX_BATCH = int(os.environ.get('SKYTPU_LLM_MAX_BATCH', '32'))
@@ -81,6 +100,58 @@ class _Pending:
         # Sampling params are per-generate()-call scalars on the window
         # path, so only like-configured requests share a batch.
         return (self.temperature, self.top_k, self.top_p, None)
+
+
+_METRICS = None
+
+
+def _metrics():
+    """``server/metrics.py``, or a no-op stand-in when prometheus_client
+    is absent (minimal replica images): observability must never fail a
+    request whose tokens were already generated."""
+    global _METRICS
+    if _METRICS is None:
+        try:
+            from skypilot_tpu.server import metrics as metrics_lib
+            _METRICS = metrics_lib
+        except ImportError:
+            class _NoopMetric:
+                def labels(self, **_kw):
+                    return self
+
+                def observe(self, _value):
+                    pass
+
+            class _Shim:
+                SERVE_TTFT = SERVE_QUEUE_WAIT = SERVE_PHASE = \
+                    SERVE_DECODE_RATE = _NoopMetric()
+
+                @staticmethod
+                def render_serving(engine=None, qos=None):
+                    del engine, qos
+                    return b'# prometheus_client not installed\n'
+
+            _METRICS = _Shim()
+    return _METRICS
+
+
+class _ChunkRecorder:
+    """Per-request emission timestamps: the engine-thread callback cost
+    is one ``time.time()`` plus a tuple append — spans and histogram
+    observations are built AFTER the request completes, so the decode
+    loop never blocks on observability."""
+    __slots__ = ('t0', 'events')
+
+    def __init__(self):
+        self.t0 = time.time()
+        self.events: List = []  # (t, row_index, n_tokens)
+
+    def cb(self, ri: int):
+        events = self.events
+
+        def _cb(toks):
+            events.append((time.time(), ri, len(toks)))
+        return _cb
 
 
 class LlmServer:
@@ -468,6 +539,138 @@ class LlmServer:
             self._worker = asyncio.get_event_loop().create_task(
                 self._worker_loop())
 
+    # -- per-request observability (trace spans + latency histograms) ------
+
+    def _pipeline_stats(self) -> Optional[Dict[str, Any]]:
+        """Lock-free snapshot of the engine's pipeline-overlap counters
+        (plain float attrs; GIL-consistent, and these are trace
+        annotations, not accounting). The full ``stats()`` takes the
+        engine lock — a sampled-by-default hot path must not contend
+        for it twice per request."""
+        eng = self.engine
+        if eng is None or not hasattr(eng, 'host_overlap_ms'):
+            return None  # stub/foreign engine: no pipeline counters
+        try:
+            return {
+                'pipeline_depth': getattr(eng, 'pipeline_depth', 0),
+                'dispatch_gap_ms': round(
+                    getattr(eng, '_gap_ms_total', 0.0)
+                    / max(getattr(eng, '_gap_count', 0), 1), 3),
+                'host_overlap_ms': eng.host_overlap_ms,
+                'bubble_ms': eng.bubble_ms,
+            }
+        except Exception:  # noqa: BLE001 — observability must never 500
+            return None
+
+    def _observe_serving(self, rec: _ChunkRecorder, qos_class: str,
+                         pipe0: Optional[Dict[str, Any]],
+                         parent: Optional[trace_lib.Span] = None) -> None:
+        """Turn the recorder's timestamps into histogram observations
+        and (when this request is sampled) prefill/decode spans. Purely
+        after-the-fact: the tokens are already delivered."""
+        metrics_lib = _metrics()
+        events = sorted(rec.events)
+        if not events:
+            return
+        ttft = max(events[0][0] - rec.t0, 0.0)
+        metrics_lib.SERVE_TTFT.labels(qos_class=qos_class).observe(ttft)
+        metrics_lib.SERVE_PHASE.labels(
+            phase='prefill', qos_class=qos_class).observe(ttft)
+        first_t, last_t = events[0][0], events[-1][0]
+        toks = sum(n for _, _, n in events)
+        decode_s = max(last_t - first_t, 0.0)
+        metrics_lib.SERVE_PHASE.labels(
+            phase='decode', qos_class=qos_class).observe(decode_s)
+        # Rate over the decode window only: the first emission's tokens
+        # were produced during the prefill window the denominator
+        # excludes — counting them would inflate short generations ~2x.
+        decode_toks = toks - events[0][2]
+        if decode_s > 0 and decode_toks > 0:
+            metrics_lib.SERVE_DECODE_RATE.labels(
+                qos_class=qos_class).observe(decode_toks / decode_s)
+        anchor = parent if parent is not None else trace_lib.current()
+        if anchor is None:
+            return
+        if anchor.end is not None:
+            # Already-closed parent (the retroactive stream span after a
+            # client disconnect): the engine thread keeps emitting, and
+            # events past the parent's end would make the decode span
+            # outgrow it — clamp to keep the nesting invariant.
+            events = [e for e in events if e[0] <= anchor.end]
+            if not events:
+                return
+            first_t, last_t = events[0][0], events[-1][0]
+            toks = sum(n for _, _, n in events)
+        trace_lib.set_attr(qos_class=qos_class,
+                           ttft_ms=round(ttft * 1000.0, 3), tokens=toks)
+        # "prefill" here is submit -> first emission: engine queue time
+        # plus the actual prefill plus the first decode chunk — the TTFT
+        # phase a serving operator tunes.
+        trace_lib.add_span('serve.prefill', rec.t0, first_t,
+                           parent=anchor, tokens=events[0][2])
+        dattrs: Dict[str, Any] = {'tokens': toks}
+        pipe1 = self._pipeline_stats()
+        if pipe0 and pipe1:
+            # The engine's overlap counters are cumulative across ALL
+            # requests; the before/after delta is what the engine did
+            # while this request was in flight (co-resident requests
+            # share it — it contextualizes, it does not attribute).
+            for k in ('host_overlap_ms', 'bubble_ms'):
+                dattrs[k] = round(
+                    (pipe1.get(k) or 0.0) - (pipe0.get(k) or 0.0), 3)
+            dattrs['dispatch_gap_ms'] = pipe1.get('dispatch_gap_ms')
+            dattrs['pipeline_depth'] = pipe1.get('pipeline_depth')
+        decode_span = trace_lib.add_span('serve.decode', first_t, last_t,
+                                         parent=anchor, **dattrs)
+        # Per-chunk children (capped: a 4k-token stream must not mint
+        # thousands of spans — the tail aggregates into one).
+        prev_t = first_t
+        for t, ri, n in events[1:65]:
+            trace_lib.add_span('serve.decode.chunk', prev_t, t,
+                               parent=decode_span, row=ri, tokens=n)
+            prev_t = t
+        if len(events) > 65:
+            trace_lib.add_span('serve.decode.chunk', prev_t, last_t,
+                               parent=decode_span, aggregated=True,
+                               tokens=sum(n for _, _, n in events[65:]))
+
+    def _observe_window(self, t_start: float, out, qos_class: str) -> None:
+        """Window-batch path: no per-chunk signal exists — the batch is
+        one opaque phase (first tokens become visible at completion, so
+        TTFT degenerates to the full duration here)."""
+        metrics_lib = _metrics()
+        now = time.time()
+        dur = max(now - t_start, 0.0)
+        toks = sum(len(r) for r in out)
+        metrics_lib.SERVE_TTFT.labels(qos_class=qos_class).observe(dur)
+        metrics_lib.SERVE_PHASE.labels(
+            phase='window', qos_class=qos_class).observe(dur)
+        if dur > 0 and toks:
+            metrics_lib.SERVE_DECODE_RATE.labels(
+                qos_class=qos_class).observe(toks / dur)
+        trace_lib.set_attr(qos_class=qos_class, tokens=toks)
+        trace_lib.add_span('serve.window', t_start, now, tokens=toks)
+
+    async def _run_engine(self, rows, max_new: int, temperature: float,
+                          top_k: int, top_p: float, eos,
+                          qos_class: str = 'standard') -> List[List[int]]:
+        """Continuous-engine path shared by the plain and QoS handlers:
+        one slot per row, with emission timestamps feeding the latency
+        histograms and the request's trace."""
+        rec = _ChunkRecorder()
+        # Engine stats take the engine lock — only worth it when this
+        # request is sampled (the spans are the only consumer of pipe0).
+        pipe0 = (self._pipeline_stats()
+                 if trace_lib.current() is not None else None)
+        futs = [asyncio.wrap_future(
+            self.engine.submit(r, max_new, temperature, top_k=top_k,
+                               top_p=top_p, eos=eos,
+                               on_tokens=rec.cb(i)))
+                for i, r in enumerate(rows)]
+        out = await asyncio.gather(*futs)
+        self._observe_serving(rec, qos_class, pipe0)
+        return [list(o) for o in out]
+
     # -- handlers ----------------------------------------------------------
 
     async def generate(self, request: web.Request) -> web.Response:
@@ -478,7 +681,14 @@ class LlmServer:
         # ends naturally once the LB's ready set refreshes.
         self._inflight += 1
         try:
-            return await self._generate_inner(request)
+            tctx = trace_lib.start_trace('serve.generate',
+                                         headers=request.headers)
+            if not tctx:  # unsampled: zero further tracing cost
+                return await self._generate_inner(request)
+            with tctx:
+                resp = await self._generate_inner(request)
+                trace_lib.set_attr(status=resp.status)
+                return resp
         finally:
             self._inflight -= 1
 
@@ -557,26 +767,35 @@ class LlmServer:
                 {'error': 'stream requires the continuous engine '
                           '(unseeded requests, SKYTPU_LLM_ENGINE!=off)'},
                 status=400)
+        trace_lib.set_attr(rows=len(rows), max_new=max_new, stream=stream)
         if self.qos is not None:
             return await self._generate_qos(request, body, rows, max_new,
                                             temperature, seed, top_k,
                                             top_p, eos, seeded, stream)
+        # Histogram/trace label only: admission (QoS on) uses its own
+        # classify with a 400 on unknown values; with QoS off the
+        # priority field is advisory and must never reject.
+        try:
+            qos_class = qos_lib.classify(body, request.headers)
+        except ValueError:
+            qos_class = 'standard'
         if stream:
             return await self._generate_stream(request, rows, max_new,
                                                temperature, top_k, top_p,
-                                               eos)
+                                               eos, qos_class=qos_class)
         if self.engine is not None and not seeded:
             # Continuous-batching path: one engine slot per row.
-            futs = [asyncio.wrap_future(
-                self.engine.submit(r, max_new, temperature, top_k=top_k,
-                                   top_p=top_p, eos=eos)) for r in rows]
-            out = await asyncio.gather(*futs)
-            return web.json_response({'tokens': [list(o) for o in out]})
+            out = await self._run_engine(rows, max_new, temperature,
+                                         top_k, top_p, eos,
+                                         qos_class=qos_class)
+            return web.json_response({'tokens': out})
         pending = _Pending(rows, max_new, temperature, seed,
                            top_k=top_k, top_p=top_p, eos=eos)
         self._ensure_worker()
+        t_queued = time.time()
         await self._queue.put(pending)
         out = await pending.future
+        self._observe_window(t_queued, out, qos_class)
         return web.json_response({'tokens': out})
 
     # -- QoS-gated dispatch (serve/qos.py; SKYTPU_QOS=1 / --qos on) --------
@@ -625,6 +844,8 @@ class LlmServer:
             pending = _Pending(rows, max_new, temperature, seed,
                                top_k=top_k, top_p=top_p, eos=eos)
             on_dispatch = (lambda p=pending: self._dispatch_window(p))
+        trace_lib.set_attr(qos_class=qos_class, tenant=tenant)
+        t_submit = time.time()
         try:
             ticket = self.qos.submit(
                 qos_class, tenant, cost=float(len(rows)),
@@ -642,6 +863,11 @@ class LlmServer:
         except asyncio.CancelledError:
             self.qos.abandon(ticket)  # client disconnected while queued
             raise
+        t_granted = time.time()
+        _metrics().SERVE_QUEUE_WAIT.labels(qos_class=qos_class).observe(
+            max(t_granted - t_submit, 0.0))
+        trace_lib.add_span('qos.queue_wait', t_submit, t_granted,
+                           tenant=tenant)
         # generated drives the quota refund at release: the actual
         # count on success (unused ask refunded), 0 on server-side
         # failure (full refund — the work was not done), None on client
@@ -656,17 +882,16 @@ class LlmServer:
                 counter = [0]
                 resp = await self._generate_stream(
                     request, rows, max_new, temperature, top_k, top_p,
-                    eos, token_count=counter)
+                    eos, token_count=counter, qos_class=qos_class)
                 generated = counter[0]
                 return resp
             if pending is None:  # continuous engine
-                futs = [asyncio.wrap_future(
-                    self.engine.submit(r, max_new, temperature,
-                                       top_k=top_k, top_p=top_p,
-                                       eos=eos)) for r in rows]
-                out = [list(o) for o in await asyncio.gather(*futs)]
+                out = await self._run_engine(rows, max_new, temperature,
+                                             top_k, top_p, eos,
+                                             qos_class=qos_class)
             else:
                 out = await pending.future
+                self._observe_window(t_granted, out, qos_class)
             generated = sum(len(o) for o in out)
             return web.json_response({'tokens': out})
         except asyncio.CancelledError:
@@ -679,7 +904,8 @@ class LlmServer:
                                rows, max_new: int, temperature: float,
                                top_k: int = 0, top_p: float = 1.0,
                                eos=None,
-                               token_count: Optional[List[int]] = None
+                               token_count: Optional[List[int]] = None,
+                               qos_class: str = 'standard'
                                ) -> web.StreamResponse:
         """NDJSON streaming (the JetStream-style serving contract):
         tokens are written as the engine emits them, one
@@ -690,9 +916,15 @@ class LlmServer:
 
         loop = asyncio.get_event_loop()
         q: asyncio.Queue = asyncio.Queue()
+        rec = _ChunkRecorder()
+        pipe0 = (self._pipeline_stats()
+                 if trace_lib.current() is not None else None)
         futs = []
         for ri, row in enumerate(rows):
             def cb(toks, ri=ri):
+                # Timestamp on the engine thread (true emission time,
+                # not loop-drain time), then hand off to the writer.
+                rec.events.append((time.time(), ri, len(toks)))
                 loop.call_soon_threadsafe(q.put_nowait, (ri, toks))
             futs.append(asyncio.wrap_future(
                 self.engine.submit(row, max_new, temperature,
@@ -760,11 +992,60 @@ class LlmServer:
                 lambda t: None if t.cancelled() else t.exception())
             with contextlib.suppress(Exception):
                 await resp.write_eof()
+            # The stream span runs submit -> eof ("stream-complete" in
+            # the trace); prefill/decode nest inside it — it must open
+            # at submit, since the first chunk can emit while prepare()
+            # is still in flight.
+            stream_span = trace_lib.add_span('serve.stream', rec.t0,
+                                             time.time())
+            self._observe_serving(rec, qos_class, pipe0,
+                                  parent=stream_span)
         return resp
+
+    @staticmethod
+    def _scrape_authorized(request: web.Request) -> bool:
+        """Replica /metrics + /debug/traces honor the same optional
+        scrape token as the API server (SKYTPU_METRICS_TOKEN, one
+        shared implementation in users/): unset = open (single-operator
+        default; the LB additionally refuses to proxy /debug/*), set =
+        require the bearer — the knob for multi-tenant deployments
+        where trace attrs name tenants."""
+        from skypilot_tpu import users as users_lib
+        return users_lib.metrics_scrape_allowed(request.headers)
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        """Native Prometheus scrape: replicas are scrapeable directly
+        (latency histograms + engine/queue gauges) instead of only via
+        controller probes of /health."""
+        if not self._scrape_authorized(request):
+            return web.json_response({'error': 'unauthorized'},
+                                     status=401)
+        try:
+            engine = (self.engine.stats()
+                      if self.engine is not None else None)
+            qos_stats = self.qos.stats() if self.qos is not None else None
+        except Exception:  # noqa: BLE001 — a stopping engine must not
+            engine, qos_stats = None, None  # fail the whole scrape
+        return web.Response(
+            body=_metrics().render_serving(engine=engine, qos=qos_stats),
+            content_type='text/plain', charset='utf-8')
+
+    async def debug_traces(self, request: web.Request) -> web.Response:
+        """Recent + slowest completed traces (?slowest=1, ?trace_id=,
+        ?qos_class=, ?tenant=, ?limit=). Off-loop: the export-spool read
+        must never stall in-flight token streams."""
+        if not self._scrape_authorized(request):
+            return web.json_response({'error': 'unauthorized'},
+                                     status=401)
+        payload = await asyncio.get_event_loop().run_in_executor(
+            None, trace_lib.debug_payload, dict(request.query))
+        return web.json_response(payload)
 
     def make_app(self) -> web.Application:
         app = web.Application()
         app.router.add_get('/health', self.health)
+        app.router.add_get('/metrics', self.metrics)
+        app.router.add_get('/debug/traces', self.debug_traces)
         app.router.add_post('/generate', self.generate)
         return app
 

@@ -43,6 +43,13 @@ class LoadBalancer:
     # -- proxy -------------------------------------------------------------
 
     async def _proxy(self, request: web.Request) -> web.StreamResponse:
+        if request.path.startswith('/debug/'):
+            # Operator-facing endpoints (replica /debug/traces carries
+            # cross-tenant request metadata) never transit the
+            # tenant-facing LB — operators scrape replicas directly.
+            return web.json_response(
+                {'error': 'debug endpoints are not proxied; query the '
+                          'replica directly'}, status=403)
         replica = self.policy.select()
         if replica is None:
             return web.json_response(
@@ -54,10 +61,23 @@ class LoadBalancer:
         try:
             async with aiohttp.ClientSession() as session:
                 body = await request.read()
+                headers = {k: v for k, v in request.headers.items()
+                           if k.lower() not in ('host',)}
+                # Serving-path traces begin at the LB: mint a trace id
+                # for clients that did not send one (clients that did
+                # keep theirs — the header forwards untouched), so every
+                # request is correlatable in the replica's /debug/traces
+                # via the X-Served-By replica this response names. The
+                # presence check runs on the CIMultiDict (client header
+                # casing is arbitrary); mint_header() rolls the LB's
+                # own sampling knobs.
+                from skypilot_tpu.observability import trace as trace_lib
+                if trace_lib.TRACE_HEADER not in request.headers:
+                    minted = trace_lib.mint_header()
+                    if minted:
+                        headers[trace_lib.TRACE_HEADER] = minted
                 async with session.request(
-                        request.method, url, data=body,
-                        headers={k: v for k, v in request.headers.items()
-                                 if k.lower() not in ('host',)},
+                        request.method, url, data=body, headers=headers,
                         timeout=aiohttp.ClientTimeout(total=300)) as resp:
                     payload = await resp.read()
                     # Preserve the upstream Content-Type: clients parse

@@ -63,6 +63,7 @@ def mix_classes(spec, n: int):
 async def _one(session, url: str, prompt_span, max_new_span,
                vocab: int, seed: int, stream: bool = False,
                priority=None, tenant=None):
+    from skypilot_tpu.observability import trace as trace_lib
     rng = random.Random(seed)
     prompt_len = rng.randint(*prompt_span)
     max_new = rng.randint(*max_new_span)
@@ -71,7 +72,16 @@ async def _one(session, url: str, prompt_span, max_new_span,
                'stream': stream}
     if priority is not None:
         payload['priority'] = priority
-    headers = {'X-SkyTPU-Tenant': tenant} if tenant is not None else None
+    # Every request carries a trace header, so a slow percentile outlier
+    # in this report can be looked up in the server's /debug/traces;
+    # mint_header() honors THIS process's SKYTPU_TRACE/_SAMPLE knobs (a
+    # sampled header overrides server-side sampling).
+    headers = {}
+    minted = trace_lib.mint_header()
+    if minted:
+        headers[trace_lib.TRACE_HEADER] = minted
+    if tenant is not None:
+        headers['X-SkyTPU-Tenant'] = tenant
     t0 = time.perf_counter()
     ttft = None
     status = None

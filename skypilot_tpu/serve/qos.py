@@ -143,16 +143,15 @@ def resolve_tenant(headers: Any = None, body: Any = None) -> str:
     identity wins (a bearer token is verifiable); the self-declared
     header/field is honored otherwise (trusted inside single-operator
     deployments); everything else shares one ``anonymous`` bucket."""
-    token = None
     if headers is not None:
-        auth = headers.get('Authorization', '') or ''
-        if auth.startswith('Bearer '):
-            token = auth[len('Bearer '):].strip()
-    if token:
         from skypilot_tpu import users as users_lib
-        name = users_lib.tenant_from_token(token)
-        if name:
-            return name
+        # users.bearer_token also rejects non-UTF-8 (surrogate-escaped)
+        # bearers, which would otherwise crash token hashing mid-request.
+        token = (users_lib.bearer_token(headers) or '').strip()
+        if token:
+            name = users_lib.tenant_from_token(token)
+            if name:
+                return name
     declared = headers.get(TENANT_HEADER) if headers is not None else None
     if not declared and isinstance(body, dict):
         declared = body.get('tenant')

@@ -536,6 +536,7 @@ _PAGE = """<!doctype html>
 </style></head><body>
 <h1>skypilot-tpu <span id="ts"></span></h1>
 <nav><a href="#/">overview</a> <a href="#/metrics">metrics</a>
+ <a href="#/traces">traces</a>
  <a href="#/logs">logs</a> <a href="#/infra">infra</a>
  <a href="#/config">config</a> <a href="#/users">users</a>
  <a href="#/workspaces">workspaces</a></nav>
@@ -830,6 +831,53 @@ async function metricsView(){
                 {keepZero:true});
 }
 
+// Waterfall of one completed trace: rows indented by span depth, bars
+// positioned by (start - trace start) / duration. Spans arrive sorted
+// by start from /debug/traces.
+function waterfall(tr){
+  const t0 = tr.start, dur = Math.max(tr.duration_ms, 0.01);
+  const byId = {};
+  tr.spans.forEach(s => { byId[s.span_id] = s; });
+  const rows = tr.spans.map(s => {
+    let d = 0, p = byId[s.parent_id], guard = 0;
+    while(p && guard++ < 12){ d++; p = byId[p.parent_id]; }
+    const ms = ((s.end ?? s.start) - s.start) * 1000;
+    const left = Math.max(Math.min((s.start - t0) * 1000 / dur * 100, 100), 0);
+    const w = Math.max(Math.min(ms / dur * 100, 100 - left), 0.4);
+    const a = s.attrs || {};
+    const extra = ['tokens','row','host_overlap_ms','bubble_ms','error']
+      .filter(k => a[k] !== undefined).map(k => `${k}=${a[k]}`).join(' ');
+    return `<tr><td style="padding-left:${8+d*14}px;white-space:nowrap">${
+       esc(s.name)}</td>
+     <td style="width:55%"><div style="position:relative;height:12px;
+       background:#f0f0f3;border-radius:2px"><div title="${esc(extra)}"
+       style="position:absolute;left:${left.toFixed(2)}%;width:${
+       w.toFixed(2)}%;height:12px;border-radius:2px;background:${
+       PALETTE[d % PALETTE.length]}"></div></div></td>
+     <td style="color:#666;white-space:nowrap">${ms.toFixed(1)} ms</td>
+     <td style="color:#999;font-size:11px">${esc(extra)}</td></tr>`;
+  }).join('');
+  const a = tr.attrs || {};
+  const tags = [tr.trace_id.slice(0,16), a.qos_class, a.tenant,
+                a.request_id, a.ttft_ms !== undefined ?
+                `ttft ${a.ttft_ms}ms` : null]
+    .filter(Boolean).map(esc).join(' · ');
+  return `<h2>${esc(tr.name)} — ${tr.duration_ms.toFixed(1)} ms
+    <span style="color:#888;font-weight:400;font-size:12px">${tags}</span>
+    </h2><table>${rows}</table>`;
+}
+
+async function tracesView(){
+  const d = await J('debug/traces?slowest=1&limit=10');
+  if(!d.traces.length)
+    return '<h2>Traces</h2><p>(no completed traces yet' +
+      (d.enabled ? '' : ' — tracing is disabled, set SKYTPU_TRACE=1') +
+      ')</p>';
+  return `<h2>Slowest recent traces <span style="color:#888;font-size:12px
+    ">ring of completed traces; filter via /debug/traces?trace_id=…
+    </span></h2>` + d.traces.map(waterfall).join('');
+}
+
 async function logsView(query){
   let results = '';
   if(query){
@@ -901,6 +949,7 @@ async function route(){
     else if(h === '#/users') html = await usersView();
     else if(h === '#/workspaces') html = await workspacesView();
     else if(h === '#/metrics') html = await metricsView();
+    else if(h === '#/traces') html = await tracesView();
     else if((m = h.match(/^#\\/logs(?:\\/(.*))?$/)))
       html = await logsView(m[1] ? decodeURIComponent(m[1]) : '');
     else if(h === '#/infra') html = await infraView();

@@ -35,12 +35,24 @@ def schedule(op: str, payload: Dict[str, Any]) -> str:
             f'Server busy: {MAX_LONG_REQUESTS} long requests in flight.')
     request_id = requests_db.create(op, {'op': op, **payload}, lane=lane)
     log_path = requests_db.request_log_path(request_id)
+    env = dict(os.environ)
+    # Trace propagation into the worker process: the runner roots its
+    # spans under the scheduling request's span and EXPORTS its record
+    # to the state-dir spool (it exits before anyone could query an
+    # in-memory ring) — /debug/traces merges by trace id.
+    from skypilot_tpu.observability import trace as trace_lib
+    parent_header = trace_lib.header_value()
+    if parent_header:
+        env['SKYTPU_TRACE_PARENT'] = parent_header
+        env['SKYTPU_TRACE_EXPORT'] = '1'
+    else:
+        env.pop('SKYTPU_TRACE_PARENT', None)
     with open(log_path, 'ab') as log_file:
         proc = subprocess.Popen(
             [sys.executable, '-m', 'skypilot_tpu.server.request_runner',
              '--request-id', request_id],
             stdout=log_file, stderr=subprocess.STDOUT,
-            env=dict(os.environ), start_new_session=True)
+            env=env, start_new_session=True)
     # Reap the runner when it exits (otherwise cancelled runners linger as
     # zombies of the server process).
     threading.Thread(target=proc.wait, daemon=True).start()

@@ -1,16 +1,84 @@
-"""Prometheus metrics for the API server.
+"""Prometheus metrics for the API server and the serving replicas.
 
 Reference analog: ``sky/server/metrics.py`` (API-server prometheus
 metrics). Request counters update on every scheduled request; fleet-state
 gauges (clusters/jobs/services by status) are computed at scrape time from
 the state tables, so the endpoint is always consistent with reality.
+
+Two registries:
+
+* ``REGISTRY`` — the API server's fleet view (``/metrics`` there).
+* ``SERVING_REGISTRY`` — request-latency **histograms** fed by the
+  serving path (``serve/llm_server.py``): TTFT, QoS queue wait,
+  per-phase durations, and per-request decode throughput, all labeled
+  by QoS class. Histograms, not gauges: the p95-style gauges mirrored
+  from replica /health bodies (below) are probe-sampled summaries; the
+  histograms are the raw distribution Prometheus/Grafana can aggregate
+  across replicas and window arbitrarily. Replicas serve this registry
+  natively on their own ``/metrics``; the API server appends it to its
+  scrape too (zero-valued there — serving happens in replicas).
 """
 from __future__ import annotations
 
+from typing import Any, Dict, Optional
+
 from prometheus_client import (CollectorRegistry, Counter, Gauge,
-                               generate_latest)
+                               Histogram, generate_latest)
 
 REGISTRY = CollectorRegistry()
+SERVING_REGISTRY = CollectorRegistry()
+
+# Latency buckets spanning sub-ms CPU-fake replies through minutes-long
+# queue waits (shared by every duration histogram so dashboards can
+# overlay phases).
+LATENCY_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
+
+SERVE_TTFT = Histogram(
+    'skytpu_serve_ttft_seconds',
+    'Time to first generated token AFTER admission (engine submit -> '
+    'first emission; QoS queue wait is excluded — add '
+    'skytpu_serve_queue_wait_seconds for the client-experienced '
+    'total), by QoS class.',
+    ['qos_class'], buckets=LATENCY_BUCKETS_S, registry=SERVING_REGISTRY)
+SERVE_QUEUE_WAIT = Histogram(
+    'skytpu_serve_queue_wait_seconds',
+    'QoS admission queue wait (submit -> dispatch grant), by QoS class.',
+    ['qos_class'], buckets=LATENCY_BUCKETS_S, registry=SERVING_REGISTRY)
+SERVE_PHASE = Histogram(
+    'skytpu_serve_phase_seconds',
+    'Per-phase serving durations (phase = prefill | decode | window).',
+    ['phase', 'qos_class'], buckets=LATENCY_BUCKETS_S,
+    registry=SERVING_REGISTRY)
+SERVE_DECODE_RATE = Histogram(
+    'skytpu_serve_decode_tok_s',
+    'Per-request decode throughput (tokens / decode seconds).',
+    ['qos_class'],
+    buckets=(1, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000,
+             25000), registry=SERVING_REGISTRY)
+
+# Replica-local engine/queue gauges, set at scrape time by the replica's
+# own /metrics handler (satellite: replicas scrapeable directly instead
+# of only via controller probes of /health).
+_REPLICA_TOKENS = Gauge(
+    'skytpu_replica_tokens_emitted',
+    'Cumulative tokens emitted by this replica engine.',
+    registry=SERVING_REGISTRY)
+_REPLICA_SLOTS = Gauge(
+    'skytpu_replica_slots', 'Engine decode slots on this replica.',
+    registry=SERVING_REGISTRY)
+_REPLICA_ACTIVE = Gauge(
+    'skytpu_replica_active_slots', 'Engine slots currently decoding.',
+    registry=SERVING_REGISTRY)
+_REPLICA_QUEUE_DEPTH = Gauge(
+    'skytpu_replica_qos_queue_depth',
+    'QoS admission queue depth on this replica, by class.',
+    ['qos_class'], registry=SERVING_REGISTRY)
+
+API_REQUEST = Histogram(
+    'skytpu_api_request_seconds',
+    'API-server HTTP handler duration by operation.',
+    ['op'], buckets=LATENCY_BUCKETS_S, registry=REGISTRY)
 
 REQUESTS_TOTAL = Counter(
     'skytpu_api_requests_total', 'API requests scheduled, by operation.',
@@ -98,4 +166,30 @@ def _refresh_gauges() -> None:
 
 def render() -> bytes:
     _refresh_gauges()
-    return generate_latest(REGISTRY)
+    return generate_latest(REGISTRY) + generate_latest(SERVING_REGISTRY)
+
+
+def render_serving(engine: Optional[Dict[str, Any]] = None,
+                   qos: Optional[Dict[str, Any]] = None) -> bytes:
+    """The serving replica's scrape body: the latency histograms plus
+    point-in-time engine/queue gauges from the stats dicts the replica
+    already maintains for /health."""
+    if engine:
+        _REPLICA_TOKENS.set(engine.get('tokens_emitted') or 0)
+        _REPLICA_SLOTS.set(engine.get('slots') or 0)
+        _REPLICA_ACTIVE.set(engine.get('active_slots') or 0)
+    else:
+        # Stats unavailable (engine stopping/absent): zero rather than
+        # re-render the last live values forever — stale "3 active
+        # slots" would mislead alerting exactly when the replica wedged.
+        _REPLICA_TOKENS.set(0)
+        _REPLICA_SLOTS.set(0)
+        _REPLICA_ACTIVE.set(0)
+    if qos:
+        for cls, c in (qos.get('classes') or {}).items():
+            if isinstance(c, dict):
+                _REPLICA_QUEUE_DEPTH.labels(qos_class=cls).set(
+                    c.get('depth') or 0)
+    else:
+        _REPLICA_QUEUE_DEPTH.clear()
+    return generate_latest(SERVING_REGISTRY)

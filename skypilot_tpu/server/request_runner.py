@@ -127,8 +127,18 @@ def main() -> None:
     workspace = record['payload'].get('_workspace')
     if workspace:
         os.environ['SKYTPU_WORKSPACE'] = workspace
+    # This process's root span: joined to the API server's middleware
+    # span via SKYTPU_TRACE_PARENT (executor.py), exported on completion
+    # so /debug/traces can stitch the full request together. The op's
+    # own stage spans (execution.py, the backend) nest under it.
+    from skypilot_tpu.observability import trace as trace_lib
+    op = record['payload'].get('op', 'unknown')
     try:
-        result = _run_op(record['payload'])
+        with trace_lib.start_trace(
+                f'api.run.{op}',
+                parent_header=os.environ.get('SKYTPU_TRACE_PARENT'),
+                request_id=args.request_id):
+            result = _run_op(record['payload'])
         requests_db.finish(args.request_id, result=result)
     except Exception as e:  # noqa: BLE001 — errors become request state
         print(f'[request] failed: {e!r}', flush=True)

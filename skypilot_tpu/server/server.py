@@ -49,7 +49,11 @@ def _schedule_response(op: str, payload: Dict[str, Any],
         request_id = executor.schedule(op, payload)
     except RuntimeError as e:
         return web.json_response({'error': str(e)}, status=503)
+    from skypilot_tpu.observability import trace as trace_lib
     from skypilot_tpu.server import metrics
+    # The request id is THE cross-layer correlation key: /debug/traces
+    # filters on it, and the runner's spans re-attach by trace id.
+    trace_lib.set_attr(op=op, request_id=request_id)
     metrics.REQUESTS_TOTAL.labels(op=op).inc()
     return web.json_response({'request_id': request_id})
 
@@ -158,6 +162,19 @@ async def prometheus_metrics(request: web.Request) -> web.Response:
                         content_type='text/plain', charset='utf-8')
 
 
+@routes.get('/debug/traces')
+async def debug_traces(request: web.Request) -> web.Response:
+    """Recent + slowest completed traces (API-server middleware spans
+    merged with request-runner exports by trace id; ?slowest=1,
+    ?trace_id=, ?qos_class=, ?tenant=, ?limit=). The export-spool read
+    is file I/O — run it off the event loop so a slow state dir never
+    stalls /api/v1 handlers."""
+    from skypilot_tpu.observability import trace as trace_lib
+    payload = await asyncio.get_event_loop().run_in_executor(
+        None, trace_lib.debug_payload, dict(request.query))
+    return web.json_response(payload)
+
+
 @routes.get('/api/v1/api/requests')
 async def api_requests(request: web.Request) -> web.Response:
     del request
@@ -188,12 +205,22 @@ async def auth_middleware(request: web.Request, handler):
     ``sky/users/permission.py``). Auth is on when SKYTPU_API_TOKEN is set
     OR users are registered; /health stays open for discovery, /dashboard
     (static page, no data) forwards its ?token= to the protected state
-    endpoint."""
+    endpoint. /metrics honors a dedicated scrape token
+    (SKYTPU_METRICS_TOKEN) so Prometheus never needs a user bearer
+    token; with no scrape token configured the endpoint BECOMES exempt
+    (counters and fleet-state gauges — nothing secret; operators who
+    want /metrics gated on an authed server must set the scrape
+    token)."""
     from skypilot_tpu import users as users_lib
-    supplied = request.headers.get('Authorization', '')
-    token = supplied[len('Bearer '):] if supplied.startswith(
-        'Bearer ') else None
-    user = users_lib.authenticate(token)
+    user = users_lib.authenticate(users_lib.bearer_token(request.headers))
+    if request.path == '/metrics' and user is None:
+        # One shared implementation with the replica's scrape gate
+        # (users.metrics_scrape_allowed) so the two surfaces never
+        # drift.
+        if users_lib.metrics_scrape_allowed(request.headers):
+            request['user'] = None
+            return await handler(request)
+        return web.json_response({'error': 'unauthorized'}, status=401)
     if user is None and request.path not in ('/health', '/dashboard') \
             and not request.path.startswith('/oauth/'):
         # /oauth/* is the login BOOTSTRAP (the whole point is having no
@@ -201,6 +228,46 @@ async def auth_middleware(request: web.Request, handler):
         return web.json_response({'error': 'unauthorized'}, status=401)
     request['user'] = user
     return await handler(request)
+
+
+# Bounded label set for the per-op duration histogram: unauthenticated
+# scans of /api/v1/<garbage> must not mint unbounded label children.
+_API_OPS = frozenset((
+    'launch', 'exec', 'down', 'stop', 'start', 'autostop', 'cancel',
+    'status', 'queue', 'cost_report', 'job_status', 'check',
+    'jobs/launch', 'jobs/queue', 'jobs/cancel',
+    'api/get', 'api/stream', 'api/requests', 'api/cancel'))
+
+
+@web.middleware
+async def trace_middleware(request: web.Request, handler):
+    """Per-request tracing + duration histogram for the /api/v1 surface
+    (observability/trace.py); joins the client's trace when an
+    X-SkyTPU-Trace header arrives. Runs INSIDE the auth middleware:
+    401-refused requests never reach here, so an unauthenticated scan
+    cannot churn real traces out of the bounded ring — the same reason
+    health/dashboard polls are deliberately untraced."""
+    if not request.path.startswith('/api/v1/'):
+        return await handler(request)
+    import time as time_lib
+
+    from skypilot_tpu.observability import trace as trace_lib
+    from skypilot_tpu.server import metrics
+    op = request.path[len('/api/v1/'):]
+    label = op if op in _API_OPS else 'other'
+    t0 = time_lib.perf_counter()
+    try:
+        tctx = trace_lib.start_trace(f'api.{op}', headers=request.headers,
+                                     method=request.method)
+        if not tctx:
+            return await handler(request)
+        with tctx:
+            resp = await handler(request)
+            trace_lib.set_attr(status=resp.status)
+            return resp
+    finally:
+        metrics.API_REQUEST.labels(op=label).observe(
+            time_lib.perf_counter() - t0)
 
 
 async def oauth_login_start(request: web.Request) -> web.Response:
@@ -247,7 +314,7 @@ async def oauth_login_poll(request: web.Request) -> web.Response:
 
 def make_app() -> web.Application:
     from skypilot_tpu.server import daemons, dashboard
-    app = web.Application(middlewares=[auth_middleware])
+    app = web.Application(middlewares=[auth_middleware, trace_middleware])
     app.add_routes(routes)
     dashboard.add_routes(app)
     # Background refreshers (cluster status, request GC); disabled when

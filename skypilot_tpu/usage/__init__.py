@@ -43,6 +43,38 @@ def _user_hash() -> str:
     return hashlib.sha256(ident.encode()).hexdigest()[:16]
 
 
+def _rotate_spool(d: str) -> None:
+    """Bound the spool directory (one .jsonl per day, appended per
+    event — unbounded on a long-lived host otherwise): keep the newest
+    files within SKYTPU_USAGE_SPOOL_MAX_FILES (default 32) and
+    SKYTPU_USAGE_SPOOL_MAX_MB (default 16) total. Oldest-first
+    deletion; the newest (live) file always survives, even when it
+    alone exceeds the byte bound. Best-effort like the rest of
+    telemetry."""
+    try:
+        max_files = max(
+            int(os.environ.get('SKYTPU_USAGE_SPOOL_MAX_FILES', '32')), 1)
+        max_bytes = max(int(float(
+            os.environ.get('SKYTPU_USAGE_SPOOL_MAX_MB', '16'))
+            * 1024 * 1024), 1)
+        entries = []
+        with os.scandir(d) as it:
+            for e in it:
+                if e.is_file() and e.name.endswith('.jsonl'):
+                    st = e.stat()
+                    entries.append((st.st_mtime, e.name, st.st_size,
+                                    e.path))
+        entries.sort()  # oldest first (mtime, then name)
+        total = sum(size for _, _, size, _ in entries)
+        while len(entries) > 1 and (len(entries) > max_files
+                                    or total > max_bytes):
+            _, _, size, path = entries.pop(0)
+            os.remove(path)
+            total -= size
+    except (OSError, ValueError):  # bad env knob must not break verbs
+        return
+
+
 def record(event: str, **fields: Any) -> None:
     """Append one anonymized usage message; best-effort POST when an
     endpoint is configured. Never raises."""
@@ -57,10 +89,16 @@ def record(event: str, **fields: Any) -> None:
         **fields,
     }
     try:
-        path = os.path.join(_spool_dir(),
-                            time.strftime('%Y%m%d') + '.jsonl')
+        spool = _spool_dir()
+        path = os.path.join(spool, time.strftime('%Y%m%d') + '.jsonl')
+        day_rolled = not os.path.exists(path)
         with open(path, 'a', encoding='utf-8') as f:
             f.write(json.dumps(msg) + '\n')
+        if day_rolled:
+            # The file SET only changes when a new day-file appears;
+            # rotating then gives the same bounds without a scandir +
+            # stat sweep on every event.
+            _rotate_spool(spool)
     except OSError:
         return
     endpoint = os.environ.get('SKYTPU_USAGE_ENDPOINT')

@@ -93,6 +93,39 @@ def list_users() -> List[Dict[str, Any]]:
         return [dict(r) for r in rows]
 
 
+def bearer_token(headers: Any) -> Optional[str]:
+    """The request's bearer token, or None when absent OR not UTF-8
+    encodable: aiohttp surrogate-escapes raw non-ASCII header bytes,
+    and such a token can never match ours — it must read as 'no token'
+    instead of crashing downstream hashing/compares with an encode
+    error. The single parse for the auth middleware, the scrape gate,
+    and QoS tenant resolution."""
+    supplied = headers.get('Authorization', '') or ''
+    if not supplied.startswith('Bearer '):
+        return None
+    token = supplied[len('Bearer '):]
+    try:
+        token.encode('utf-8')
+    except UnicodeEncodeError:
+        return None
+    return token
+
+
+def metrics_scrape_allowed(headers: Any) -> bool:
+    """The SKYTPU_METRICS_TOKEN gate, shared by the API server's
+    /metrics exemption and the LLM replica's /metrics + /debug/traces:
+    unset = open (the ISSUE-specified exempt-when-unset default); set =
+    the request's bearer must match it (timing-safe bytes compare). One
+    implementation so the two surfaces cannot drift."""
+    import hmac
+    scrape_token = os.environ.get('SKYTPU_METRICS_TOKEN')
+    if not scrape_token:
+        return True
+    token = bearer_token(headers) or ''
+    return hmac.compare_digest(token.encode('utf-8'),
+                               scrape_token.encode('utf-8'))
+
+
 def authenticate(token: Optional[str]) -> Optional[Dict[str, str]]:
     """token -> {'name', 'role'}; None = unauthenticated.
 

@@ -288,6 +288,119 @@ def test_metrics_endpoint(server):
     body = r.text
     assert 'skytpu_api_requests_total' in body
     assert 'skytpu_api_request_table' in body
+    # Latency histograms render too: the per-op API histogram and the
+    # serving families (zero-valued here; replicas fill them).
+    assert 'skytpu_api_request_seconds' in body
+    assert 'skytpu_serve_ttft_seconds' in body
+
+
+def _wait_healthy(url: str, proc) -> None:
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            requests_lib.get(f'{url}/health', timeout=2)
+            return
+        except requests_lib.RequestException:
+            time.sleep(0.2)
+    proc.kill()
+    raise RuntimeError('server did not come up')
+
+
+def test_metrics_scrape_token(tmp_path):
+    """Satellite fix: on a token-protected server Prometheus must not
+    need a user bearer token — SKYTPU_METRICS_TOKEN unlocks /metrics
+    (and ONLY /metrics); with it unset, /metrics is exempt from auth."""
+    env_base = dict(os.environ)
+    env_base['SKYTPU_API_TOKEN'] = 'sekret'
+
+    # No scrape token configured: /metrics exempt, API still closed.
+    env = dict(env_base)
+    env['SKYTPU_STATE_DIR'] = str(tmp_path / 'state_a')
+    port = common_utils.find_free_port(48300)
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.server.server',
+         '--port', str(port)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    url = f'http://127.0.0.1:{port}'
+    try:
+        _wait_healthy(url, proc)
+        assert requests_lib.get(f'{url}/metrics',
+                                timeout=5).status_code == 200
+        assert requests_lib.get(f'{url}/api/v1/status',
+                                timeout=5).status_code == 401
+    finally:
+        proc.terminate()
+
+    # Scrape token configured: /metrics requires it (or a user token);
+    # the scrape token is NOT a user token for the API surface.
+    env = dict(env_base)
+    env['SKYTPU_STATE_DIR'] = str(tmp_path / 'state_b')
+    env['SKYTPU_METRICS_TOKEN'] = 'scrape-only'
+    port = common_utils.find_free_port(48400)
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.server.server',
+         '--port', str(port)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    url = f'http://127.0.0.1:{port}'
+    try:
+        _wait_healthy(url, proc)
+        assert requests_lib.get(f'{url}/metrics',
+                                timeout=5).status_code == 401
+        assert requests_lib.get(
+            f'{url}/metrics', timeout=5,
+            headers={'Authorization': 'Bearer wrong'}).status_code == 401
+        assert requests_lib.get(
+            f'{url}/metrics', timeout=5,
+            headers={'Authorization':
+                     'Bearer scrape-only'}).status_code == 200
+        # A real user token still scrapes.
+        assert requests_lib.get(
+            f'{url}/metrics', timeout=5,
+            headers={'Authorization': 'Bearer sekret'}).status_code == 200
+        # The scrape token must not open the API.
+        assert requests_lib.get(
+            f'{url}/api/v1/status', timeout=5,
+            headers={'Authorization':
+                     'Bearer scrape-only'}).status_code == 401
+    finally:
+        proc.terminate()
+
+
+def test_debug_traces_cover_launch_pipeline(server):
+    """Tentpole acceptance (API path): a launched request leaves one
+    trace stitched across processes — the middleware span (server ring)
+    plus the runner's stage spans (export spool) — keyed by request id,
+    with closed, ordered spans."""
+    task = Task('tracejob', run='echo TRACE_ME')
+    from skypilot_tpu.resources import Resources
+    task.set_resources(Resources(cloud='local'))
+    request_id = sdk.launch(task, cluster_name='trc1', detach_run=False)
+    sdk.get(request_id, timeout=60)
+    body = requests_lib.get(f'{server}/debug/traces',
+                            params={'limit': 100}, timeout=10).json()
+    assert body['enabled'] is True
+    launches = [t for t in body['traces']
+                if t['attrs'].get('request_id') == request_id]
+    assert launches, [t['name'] for t in body['traces']]
+    tr = launches[0]
+    names = {s['name'] for s in tr['spans']}
+    # Middleware root + runner root + launch stages, one tree.
+    assert 'api.launch' in names, names
+    assert 'api.run.launch' in names, names
+    assert 'launch.provision' in names, names
+    assert 'launch.exec' in names, names
+    for s in tr['spans']:
+        assert s['end'] is not None and s['end'] >= s['start'], s
+    # Filter by trace id prefix finds the same trace.
+    filtered = requests_lib.get(
+        f'{server}/debug/traces',
+        params={'trace_id': tr['trace_id'][:12]}, timeout=10).json()
+    assert filtered['count'] >= 1
+    # The dashboard ships the waterfall view for these.
+    page = requests_lib.get(f'{server}/dashboard', timeout=10).text
+    for marker in ('tracesView', 'waterfall', '#/traces'):
+        assert marker in page
+    sdk.get(sdk.down('trc1'))
 
 
 def test_dashboard_page_and_state(server):

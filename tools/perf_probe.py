@@ -15,6 +15,15 @@ driven at ~2x capacity with a deterministic interactive/batch mix —
 asserts sheds happened, batch absorbed 100% of them, and interactive
 queue wait stayed bounded. CPU-only, seconds-long, wired into
 ``make verify``.
+
+``--trace`` runs the tracing smoke: a short CPU loadgen pass (streamed,
+mixed classes, trace headers) against a tiny-model replica with
+tracing + QoS on, then asserts every sampled trace closed all its
+spans, spans nest without overlap, the serving phases (queue wait,
+prefill, decode, stream) are present, the TTFT/queue-wait histograms
+have non-empty buckets per class on the replica's /metrics — and that
+greedy output is byte-identical with tracing on vs off. Also wired
+into ``make verify``.
 """
 import json
 import os
@@ -114,7 +123,165 @@ def decode_overlap_smoke() -> dict:
         f'pipelined < 0.9x serial in every attempt: {attempts}')
 
 
+def _check_trace_spans(tr: dict) -> None:
+    """One completed trace: every span closed, timestamps monotonic,
+    children inside their parent's bounds, siblings non-overlapping."""
+    import collections
+
+    spans = tr['spans']
+    assert spans, tr
+    by_id = {s['span_id']: s for s in spans}
+    starts = [s['start'] for s in spans]
+    assert starts == sorted(starts), tr  # monotonic presentation order
+    kids = collections.defaultdict(list)
+    for s in spans:
+        assert s.get('end') is not None, ('unclosed span', s, tr)
+        assert s['end'] >= s['start'] - 1e-6, ('negative span', s)
+        parent = by_id.get(s.get('parent_id'))
+        if parent is None:
+            continue
+        kids[s['parent_id']].append(s)
+        assert s['start'] >= parent['start'] - 1e-3, ('starts before '
+                                                      'parent', s, parent)
+        assert s['end'] <= parent['end'] + 1e-3, ('ends after parent',
+                                                  s, parent)
+    for group in kids.values():
+        group.sort(key=lambda s: s['start'])
+        for a, b in zip(group, group[1:]):
+            assert b['start'] >= a['end'] - 1e-3, ('sibling overlap',
+                                                   a, b)
+
+
+def _hist_count(metrics_text: str, family: str, **labels) -> float:
+    total = 0.0
+    for line in metrics_text.splitlines():
+        if line.startswith(f'{family}_count') and all(
+                f'{k}="{v}"' in line for k, v in labels.items()):
+            total += float(line.rsplit(' ', 1)[1])
+    return total
+
+
+def trace_smoke() -> dict:
+    """End-to-end tracing smoke on the CPU backend: a short streamed
+    loadgen pass (mixed classes, trace headers) against a tiny-model
+    replica with tracing + QoS admission on. Asserts every sampled
+    trace closed all spans with proper nesting, the serving phases
+    (queue wait -> prefill -> decode -> stream) are present, the
+    TTFT/queue-wait histograms filled per class on the replica's own
+    /metrics — and that greedy output is byte-identical with tracing
+    on vs off."""
+    import asyncio
+    import threading
+
+    import requests as requests_lib
+    from aiohttp import web
+
+    from skypilot_tpu.observability import trace as trace_lib
+    from skypilot_tpu.serve import llm_server as llm_mod
+    from skypilot_tpu.serve import loadgen
+    from skypilot_tpu.utils import common_utils
+
+    # Pin every knob the count assertions depend on — an inherited
+    # SKYTPU_TRACE_SAMPLE/_RING must not flake the CI gate.
+    os.environ['SKYTPU_TRACE'] = '1'
+    os.environ['SKYTPU_TRACE_SAMPLE'] = '1'
+    os.environ['SKYTPU_TRACE_RING'] = '256'
+    trace_lib.reset()
+    server = llm_mod.LlmServer(
+        'tiny', max_len=64, engine='continuous', qos='on',
+        qos_opts=dict(max_inflight=4, max_queue=64,
+                      ttl_s={'interactive': 300.0, 'standard': 300.0,
+                             'batch': 300.0},
+                      tenant_rps=0, tenant_tps=0))
+    port = common_utils.find_free_port(23500)
+    started = threading.Event()
+
+    def run():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(server.make_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, '127.0.0.1', port)
+        loop.run_until_complete(site.start())
+        started.set()
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    if not started.wait(30):
+        raise RuntimeError('trace probe replica failed to start')
+    url = f'http://127.0.0.1:{port}'
+    try:
+        # Warmup compiles prefill/decode so later phases time serving,
+        # not XLA.
+        payload = {'tokens': [[1, 2, 3, 4, 5, 6, 7, 8]],
+                   'max_new_tokens': 8}
+        requests_lib.post(f'{url}/generate', json=payload,
+                          timeout=600).raise_for_status()
+        out = asyncio.run(loadgen.run_load(
+            url, requests_total=12, concurrency=4, prompt_len='8',
+            max_new='16', vocab=256, stream=True,
+            mix='interactive:1,batch:1'))
+        assert out['ok'] == 12, out
+
+        # Greedy byte parity, traced vs untraced, same resident engine.
+        r_traced = requests_lib.post(f'{url}/generate', json=payload,
+                                     timeout=600)
+        os.environ['SKYTPU_TRACE'] = '0'
+        r_plain = requests_lib.post(f'{url}/generate', json=payload,
+                                    timeout=600)
+        os.environ['SKYTPU_TRACE'] = '1'
+        assert r_traced.status_code == r_plain.status_code == 200
+        assert r_traced.json() == r_plain.json(), 'tracing changed output'
+
+        traces = requests_lib.get(f'{url}/debug/traces?limit=100',
+                                  timeout=10).json()['traces']
+        serving = [t for t in traces if t['name'] == 'serve.generate']
+        # 12 loadgen + warmup + the traced parity request (the untraced
+        # one must NOT appear).
+        assert len(serving) >= 14, len(serving)
+        for tr in serving:
+            _check_trace_spans(tr)
+        streamed = [t for t in serving
+                    if {'qos.queue_wait', 'serve.prefill', 'serve.decode',
+                        'serve.stream'} <=
+                    {s['name'] for s in t['spans']}]
+        assert len(streamed) >= 12, (len(streamed),
+                                     [t['attrs'] for t in serving])
+        classes = {t['attrs'].get('qos_class') for t in streamed}
+        assert {'interactive', 'batch'} <= classes, classes
+
+        metrics_text = requests_lib.get(f'{url}/metrics',
+                                        timeout=10).text
+        ttft_n = sum(_hist_count(metrics_text, 'skytpu_serve_ttft_seconds',
+                                 qos_class=cls)
+                     for cls in ('interactive', 'batch'))
+        wait_n = sum(_hist_count(metrics_text,
+                                 'skytpu_serve_queue_wait_seconds',
+                                 qos_class=cls)
+                     for cls in ('interactive', 'batch'))
+        assert ttft_n >= 12, metrics_text[:2000]
+        assert wait_n >= 12, metrics_text[:2000]
+        assert any(line.startswith('skytpu_serve_ttft_seconds_bucket')
+                   and not line.rstrip().endswith(' 0.0')
+                   for line in metrics_text.splitlines()), 'empty buckets'
+    finally:
+        os.environ['SKYTPU_TRACE'] = '1'
+        server.engine.stop()
+    return {'traces_checked': len(serving),
+            'streamed_phase_traces': len(streamed),
+            'ttft_observations': ttft_n,
+            'queue_wait_observations': wait_n,
+            'loadgen': {k: out[k] for k in ('ok', 'p50_ttft_s',
+                                            'p95_ttft_s')}}
+
+
 def main():
+    if '--trace' in sys.argv:
+        # CPU-only by design (same rationale as --smoke/--qos).
+        jax.config.update('jax_platforms', 'cpu')
+        print(json.dumps({'trace_smoke': 'ok', **trace_smoke()}),
+              flush=True)
+        return
     if '--qos' in sys.argv:
         # CPU-only by design (same rationale as --smoke): never touch
         # or wait on a chip in CI.
