@@ -18,15 +18,19 @@ ci:
 	$(MAKE) verify
 	$(MAKE) audit-clean
 
-# Serving smokes (CPU, seconds; no chip touched): the decode-overlap
-# A/B, the QoS overload admission gate (interactive bounded, batch
-# absorbs 100% of sheds under 2x load), and the tracing gate (every
-# sampled trace closes + nests, TTFT/queue-wait histograms fill,
-# greedy output byte-identical traced vs untraced).
+# Serving + telemetry smokes (CPU, seconds-to-a-minute; no chip
+# touched): the decode-overlap A/B, the QoS overload admission gate
+# (interactive bounded, batch absorbs 100% of sheds under 2x load),
+# the tracing gate (every sampled trace closes + nests, TTFT/queue-wait
+# histograms fill, greedy output byte-identical traced vs untraced),
+# and the goodput gate (trainer stdout byte-identical with telemetry
+# off vs on; managed-job phase ledger gap-free and summing to
+# wall-clock across an injected preemption).
 verify:
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --smoke
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --qos
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --trace
+	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --goodput
 
 lint:
 	$(PY) tools/lint.py

@@ -58,6 +58,12 @@ AUTOSTOP_FILE = 'autostop.json'
 AGENT_LOG = 'agent.log'
 
 RANK_LOG_FILE = 'rank-{rank}.log'
+# Per-job trainer telemetry spools: <log_dir>/telemetry/rank-N/ (written
+# by train/run.py via observability/train_telemetry.py, read by the
+# heartbeat daemon). The env var is the on/off switch: the driver exports
+# it per worker; unset = telemetry disabled.
+TELEMETRY_SUBDIR = 'telemetry'
+ENV_TRAIN_TELEMETRY_DIR = 'SKYTPU_TRAIN_TELEMETRY_DIR'
 MERGED_LOG_FILE = 'run.log'
 SETUP_LOG_FILE = 'setup.log'
 

@@ -6,8 +6,16 @@ last-active tracking.  One daemon process per cluster, spawned at first
 launch; it watches the job table for idleness and executes the recorded
 autostop policy (stop or down) against the provider.
 
-``check_once`` is a pure step (read state, maybe act) so tests drive it
-synchronously without a process.
+Each tick also ships a heartbeat into the cluster table
+(``global_user_state.record_heartbeat``): host health (disk, framework
+process count — the same /proc probes ``utils/tpu_doctor`` uses), job
+progress counts, and the newest trainer-telemetry window
+(``observability/train_telemetry``), so the controller and `stpu status`
+see *progress*, not just liveness. The daemon must never import jax —
+the sandbox TPU tunnel is single-claimant.
+
+``check_once`` / ``heartbeat_once`` are pure steps (read state, maybe
+act) so tests drive them synchronously without a process.
 """
 from __future__ import annotations
 
@@ -92,12 +100,69 @@ def check_once(cluster_name: str) -> Optional[str]:
         return None
 
 
+def heartbeat_once(cluster_name: str,
+                   interval_s: float = 20.0) -> Optional[dict]:
+    """Assemble and store one heartbeat. Best-effort throughout: a
+    heartbeat failure must never take the autostop daemon down, so every
+    probe degrades to omission. Returns the stored payload (tests), or
+    None when the cluster row is gone."""
+    payload: dict = {'ts': time.time(), 'interval_s': interval_s}
+    try:
+        import shutil
+        cdir = _runtime_dir(cluster_name)
+        usage = shutil.disk_usage(
+            cdir if os.path.isdir(cdir) else os.path.expanduser('~'))
+        payload['host'] = {
+            'disk_free_gb': round(usage.free / 1e9, 2),
+            'disk_used_pct': round(100.0 * usage.used / max(usage.total, 1),
+                                   1),
+        }
+    except OSError:
+        pass
+    try:
+        # Same /proc probe tpu_doctor's process table uses — a leaked
+        # framework daemon on this host shows up in the heartbeat long
+        # before it wedges the device tunnel.
+        from skypilot_tpu.utils import tpu_doctor
+        payload.setdefault('host', {})['framework_procs'] = len(
+            tpu_doctor.framework_processes())
+    except Exception:  # noqa: BLE001 — /proc probing is best-effort
+        pass
+    try:
+        table = job_lib.JobTable(_runtime_dir(cluster_name))
+        unfinished = table.unfinished_jobs()
+        latest = table.list_jobs(limit=1)
+        payload['jobs'] = {'unfinished': len(unfinished)}
+        if latest:
+            payload['jobs']['latest'] = {
+                'job_id': latest[0]['job_id'],
+                'status': latest[0]['status'],
+            }
+    except Exception:  # noqa: BLE001 — job table may not exist yet
+        pass
+    try:
+        from skypilot_tpu.observability import train_telemetry
+        window = train_telemetry.latest_window_for_cluster(
+            _runtime_dir(cluster_name))
+        if window is not None:
+            payload['train'] = window
+    except Exception:  # noqa: BLE001 — telemetry spool is optional
+        pass
+    try:
+        if not global_user_state.record_heartbeat(cluster_name, payload):
+            return None
+    except Exception:  # noqa: BLE001 — a full disk / corrupt DB must not
+        return None  # kill the autostop daemon; next tick retries
+    return payload
+
+
 def run_loop(cluster_name: str, interval_s: float = 20.0) -> None:
     """Daemon loop (20 s tick, matching the reference's SkyletEvent)."""
     while True:
         record = global_user_state.get_cluster(cluster_name)
         if record is None:
             return  # cluster downed: daemon exits
+        heartbeat_once(cluster_name, interval_s)
         acted = check_once(cluster_name)
         if acted == 'down':
             return

@@ -197,6 +197,13 @@ def run_job(cluster_dir: str, job_id: int,
     for w in workers:
         runner = RunnerSpec.from_dict(w['runner'])
         env = build_worker_env(spec, w, job_id)
+        # Trainer telemetry spool under this job's log dir (setdefault:
+        # a task-provided dir wins). The trainer emits only if it opts
+        # in by importing the writer; non-training jobs ignore it.
+        env.setdefault(
+            constants.ENV_TRAIN_TELEMETRY_DIR,
+            os.path.join(log_dir, constants.TELEMETRY_SUBDIR,
+                         f'rank-{w["global_rank"]}'))
         argv = runner.make().popen_argv(run_cmd, env=env,
                                         cwd=spec.get('workdir_on_worker'))
         log_path = os.path.join(

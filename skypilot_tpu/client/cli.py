@@ -147,6 +147,16 @@ def exec_cmd(cluster, entrypoint, detach_run, name, workdir, cloud,
     click.echo(f'Job {job_id} submitted to {cluster}.')
 
 
+def _format_heartbeat(row: dict) -> str:
+    """Heartbeat-age cell: '-' before the first heartbeat; 'STALE!' when
+    older than 3 daemon intervals (core.status computes the flag)."""
+    age = row.get('heartbeat_age')
+    if age is None:
+        return '-'
+    text = f'{int(age)}s' if age < 120 else f'{int(age / 60)}m'
+    return f'{text} STALE!' if row.get('heartbeat_stale') else text
+
+
 @cli.command()
 @click.option('--refresh', '-r', is_flag=True, default=False)
 @click.option('--all-workspaces', '-a', is_flag=True, default=False,
@@ -156,13 +166,21 @@ def status(refresh, all_workspaces):
     """Show clusters (active workspace unless --all-workspaces)."""
     from skypilot_tpu import core
     rows = core.status(refresh=refresh, all_workspaces=all_workspaces)
+    for r in rows:
+        r['heartbeat'] = _format_heartbeat(r)
     cols = [('name', 'NAME'), ('status', 'STATUS'),
             ('cloud', 'CLOUD'), ('region', 'REGION'),
             ('resources', 'RESOURCES'), ('nodes', 'NODES'),
-            ('workers', 'WORKERS'), ('autostop', 'AUTOSTOP')]
+            ('workers', 'WORKERS'), ('autostop', 'AUTOSTOP'),
+            ('heartbeat', 'HEARTBEAT')]
     if all_workspaces:
         cols.insert(1, ('workspace', 'WORKSPACE'))
     _echo_table(rows, cols)
+    stale = [r['name'] for r in rows if r.get('heartbeat_stale')]
+    if stale:
+        click.echo(click.style(
+            f'Stale heartbeat (> 3 intervals): {", ".join(stale)} — the '
+            'cluster daemon may be dead or the host wedged.', fg='yellow'))
 
 
 @cli.command()
@@ -369,6 +387,42 @@ def jobs_queue(all_workspaces):
     if all_workspaces:
         cols.insert(1, ('workspace', 'WORKSPACE'))
     _echo_table(jobs.queue(all_workspaces=all_workspaces), cols)
+
+
+@jobs_group.command('goodput')
+@click.argument('job_id', type=int)
+@_clean_errors
+def jobs_goodput(job_id):
+    """Goodput/badput breakdown for a managed job: how much of the
+    wall-clock was productive compute (RUNNING) vs. provisioning,
+    queueing, and recovery — from the phase ledger."""
+    from skypilot_tpu import jobs
+    g = jobs.goodput(job_id)
+    if g is None:
+        raise click.ClickException(
+            f'managed job {job_id} not found (or predates the ledger)')
+    wall = max(g['wall_s'], 1e-9)
+    click.echo(f"Managed job {job_id} ({g['status']}"
+               f"{'' if g['closed'] else ', still running'}): "
+               f"wall-clock {g['wall_s']:.1f}s, "
+               f"goodput {100 * g['goodput_ratio']:.1f}%, "
+               f"recoveries {g['recoveries']}")
+    rows = [{
+        'phase': r['phase'],
+        'kind': r['kind'],
+        'seconds': f"{r['ended_at'] - r['started_at']:.2f}"
+                   if r['ended_at'] is not None else '(open)',
+        'pct': f"{100 * ((r['ended_at'] - r['started_at']) / wall):.1f}%"
+               if r['ended_at'] is not None else '-',
+        'detail': r['detail'],
+    } for r in g['ledger']]
+    _echo_table(rows, [('phase', 'PHASE'), ('kind', 'KIND'),
+                       ('seconds', 'SECONDS'), ('pct', '%WALL'),
+                       ('detail', 'DETAIL')])
+    totals = [f"{k}={v:.1f}s" for k, v in (('goodput', g['goodput_s']),
+                                           ('badput', g['badput_s']),
+                                           ('overhead', g['overhead_s']))]
+    click.echo('Totals: ' + '  '.join(totals))
 
 
 @jobs_group.command('cancel')

@@ -239,6 +239,11 @@ def jobs_cancel(job_id: int) -> str:
     return _post('jobs/cancel', {'job_id': job_id})
 
 
+def jobs_goodput(job_id: int) -> str:
+    """Goodput ledger for a managed job (summary + phase rows)."""
+    return _get('jobs/goodput', {'job_id': job_id})
+
+
 def api_cancel(request_id: str) -> bool:
     """Cancel an in-flight API request: kills its runner process group
     server-side (reference: ``sky api cancel``)."""

@@ -49,6 +49,9 @@ def status(cluster_names: Optional[List[str]] = None,
         handle = r['handle']
         launched = Resources.from_yaml_config(
             handle['launched_resources']) if handle else None
+        # Heartbeat age + staleness (shared rule: the operator's first
+        # hint that a cluster daemon died or the host wedged).
+        hb_age, hb_stale = global_user_state.heartbeat_age(r)
         out.append({
             'name': r['name'],
             'workspace': r.get('workspace', 'default'),
@@ -63,6 +66,9 @@ def status(cluster_names: Optional[List[str]] = None,
                        if handle else 0,
             'autostop': r.get('autostop_minutes', -1),
             'price_per_hour': handle.get('price_per_hour') if handle else None,
+            'heartbeat_age': hb_age,
+            'heartbeat_stale': hb_stale,
+            'heartbeat': r.get('heartbeat'),
         })
     return out
 

@@ -44,7 +44,9 @@ CREATE TABLE IF NOT EXISTS clusters (
     autostop_minutes INTEGER DEFAULT -1,
     autostop_down INTEGER DEFAULT 0,
     last_activity REAL,
-    owner TEXT
+    owner TEXT,
+    last_heartbeat REAL,
+    heartbeat TEXT
 );
 CREATE TABLE IF NOT EXISTS cluster_events (
     id INTEGER PRIMARY KEY AUTOINCREMENT,
@@ -118,11 +120,13 @@ def _conn():
     from skypilot_tpu.utils import db_utils
     return db_utils.connect(
         _db_path(), _SCHEMA,
-        migrations=(  # pre-workspace / pre-access-mode databases
+        migrations=(  # pre-workspace / pre-access-mode / pre-heartbeat
             "ALTER TABLE clusters ADD COLUMN workspace TEXT "
             "DEFAULT 'default'",
             "ALTER TABLE volumes ADD COLUMN access_mode TEXT "
-            "DEFAULT 'ReadWriteOnce'"))
+            "DEFAULT 'ReadWriteOnce'",
+            'ALTER TABLE clusters ADD COLUMN last_heartbeat REAL',
+            'ALTER TABLE clusters ADD COLUMN heartbeat TEXT'))
 
 
 def _lock() -> filelock.FileLock:
@@ -203,6 +207,33 @@ def touch_activity(name: str) -> None:
                      (time.time(), name))
 
 
+def heartbeat_age(record: Dict[str, Any],
+                  stale_after_intervals: int = 3):
+    """(age_seconds, stale) for a cluster record — THE staleness rule
+    (> N daemon intervals old), shared by `stpu status`, the dashboard
+    fleet panel, and the Prometheus gauges so they can never drift.
+    (None, False) before the first heartbeat."""
+    last = record.get('last_heartbeat')
+    if not last:
+        return None, False
+    age = max(time.time() - last, 0.0)
+    interval = float(
+        (record.get('heartbeat') or {}).get('interval_s') or 20.0)
+    return age, age > stale_after_intervals * interval
+
+
+def record_heartbeat(name: str, payload: Dict[str, Any]) -> bool:
+    """Store the cluster daemon's latest heartbeat (agent/daemon.py). The
+    payload carries host health + the newest training-telemetry window;
+    ``last_heartbeat`` is what `stpu status` ages against. Returns False
+    if the cluster row is gone (daemon about to exit)."""
+    with _lock(), _conn() as conn:
+        cur = conn.execute(
+            'UPDATE clusters SET last_heartbeat = ?, heartbeat = ? '
+            'WHERE name = ?', (time.time(), json.dumps(payload), name))
+        return cur.rowcount > 0
+
+
 def remove_cluster(name: str) -> None:
     with _lock(), _conn() as conn:
         conn.execute('DELETE FROM clusters WHERE name = ?', (name,))
@@ -214,10 +245,19 @@ def get_cluster(name: str) -> Optional[Dict[str, Any]]:
                            (name,)).fetchone()
         if row is None:
             return None
-        d = dict(row)
-        d['handle'] = json.loads(d['handle']) if d['handle'] else None
-        d['status'] = ClusterStatus(d['status'])
-        return d
+        return _cluster_row_to_dict(row)
+
+
+def _cluster_row_to_dict(row) -> Dict[str, Any]:
+    d = dict(row)
+    d['handle'] = json.loads(d['handle']) if d['handle'] else None
+    d['status'] = ClusterStatus(d['status'])
+    try:
+        d['heartbeat'] = (json.loads(d['heartbeat'])
+                          if d.get('heartbeat') else None)
+    except json.JSONDecodeError:
+        d['heartbeat'] = None
+    return d
 
 
 def get_clusters(workspace: Optional[str] = None) -> List[Dict[str, Any]]:
@@ -230,13 +270,7 @@ def get_clusters(workspace: Optional[str] = None) -> List[Dict[str, Any]]:
             rows = conn.execute(
                 'SELECT * FROM clusters WHERE workspace = ? '
                 'ORDER BY launched_at DESC', (workspace,)).fetchall()
-    out = []
-    for row in rows:
-        d = dict(row)
-        d['handle'] = json.loads(d['handle']) if d['handle'] else None
-        d['status'] = ClusterStatus(d['status'])
-        out.append(d)
-    return out
+    return [_cluster_row_to_dict(row) for row in rows]
 
 
 def add_cluster_event(name: str, event: str, detail: str = '') -> None:

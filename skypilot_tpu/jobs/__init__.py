@@ -54,6 +54,16 @@ def queue(limit: int = 200,
     } for r in rows]
 
 
+def goodput(job_id: int) -> Optional[Dict[str, Any]]:
+    """The job's goodput ledger: summary (goodput/badput/overhead
+    seconds, ratio) plus the raw phase rows. None if the job does not
+    exist or predates the ledger."""
+    summary = state.goodput_summary(job_id)
+    if summary is None:
+        return None
+    return {**summary, 'ledger': state.phase_ledger(job_id)}
+
+
 def cancel(job_id: int) -> bool:
     """Request cancellation; the controller notices CANCELLING and cleans
     up. For jobs with a dead controller the status flips directly."""

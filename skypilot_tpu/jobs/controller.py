@@ -41,8 +41,21 @@ class JobController:
             f'managed-{job_id}-{(record["name"] or "job")[:20]}'
         state.set_cluster_name(job_id, self.cluster_name)
         self.strategy = recovery_strategy.make(
-            record['recovery_strategy'], self.task, self.cluster_name)
+            record['recovery_strategy'], self.task, self.cluster_name,
+            job_id=job_id)
         self.max_restarts_on_errors = record['max_restarts_on_errors']
+
+    def _location_detail(self) -> str:
+        """Where the (possibly just-preempted) cluster lived — stamped on
+        the goodput ledger's badput interval so post-mortems can name the
+        zone that cost the wall-clock."""
+        record = global_user_state.get_cluster(self.cluster_name)
+        if record is None or not record.get('handle'):
+            return ''
+        h = record['handle']
+        parts = [f'{k}={h.get(k)}' for k in ('cloud', 'region', 'zone')
+                 if h.get(k)]
+        return f' ({", ".join(parts)})' if parts else ''
 
     # -- health ------------------------------------------------------------
 
@@ -151,7 +164,8 @@ class JobController:
                 state.bump_recovery_count(job_id)
                 state.set_status(
                     job_id, state.ManagedJobStatus.RECOVERING,
-                    detail='controller restarted; cluster unhealthy')
+                    detail='controller restarted; cluster unhealthy'
+                           + self._location_detail())
                 agent_job_id = self.strategy.recover()
             else:
                 state.set_status(job_id, state.ManagedJobStatus.STARTING)
@@ -215,7 +229,8 @@ class JobController:
                 # Whole-slice preemption (or external deletion): recover.
                 state.bump_recovery_count(job_id)
                 state.set_status(job_id, state.ManagedJobStatus.RECOVERING,
-                                 detail='slice preempted')
+                                 detail='slice preempted'
+                                        + self._location_detail())
                 agent_job_id = self.strategy.recover()
                 state.set_status(job_id, state.ManagedJobStatus.RUNNING)
                 continue

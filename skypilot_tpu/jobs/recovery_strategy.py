@@ -34,11 +34,12 @@ def register(name: str):
     return deco
 
 
-def make(name: str, task: Task, cluster_name: str) -> 'StrategyExecutor':
+def make(name: str, task: Task, cluster_name: str,
+         job_id: Optional[int] = None) -> 'StrategyExecutor':
     if name not in _STRATEGIES:
         raise ValueError(
             f'Unknown recovery strategy {name!r}; have {sorted(_STRATEGIES)}')
-    return _STRATEGIES[name](task, cluster_name)
+    return _STRATEGIES[name](task, cluster_name, job_id=job_id)
 
 
 class StrategyExecutor:
@@ -47,10 +48,21 @@ class StrategyExecutor:
     NAME = 'abstract'
     RETRY_INIT_GAP_SECONDS = 5.0
 
-    def __init__(self, task: Task, cluster_name: str):
+    def __init__(self, task: Task, cluster_name: str,
+                 job_id: Optional[int] = None):
         self.task = task
         self.cluster_name = cluster_name
+        self.job_id = job_id
         self.backend = TpuGangBackend()
+
+    def _annotate(self, note: str) -> None:
+        """Stamp a recovery decision on the goodput ledger's open
+        (badput) phase — which zone was retried/blocklisted is what the
+        post-mortem needs next to the interval it cost."""
+        if self.job_id is None:
+            return
+        from skypilot_tpu.jobs import state
+        state.annotate_phase(self.job_id, note)
 
     # -- helpers -----------------------------------------------------------
 
@@ -103,6 +115,7 @@ class FailoverStrategyExecutor(StrategyExecutor):
             prev_cloud = record['handle'].get('cloud')
         self._cleanup_remnants()
         if prev_region is not None:
+            self._annotate(f'same-region retry (region={prev_region})')
             pinned = [
                 r.copy(region=prev_region, cloud=prev_cloud)
                 for r in self.task.resources_ordered
@@ -119,6 +132,7 @@ class FailoverStrategyExecutor(StrategyExecutor):
             finally:
                 self.task.set_resources(original)
         # 2. Anywhere: full re-optimize, retry until capacity appears.
+        self._annotate('failover: re-optimizing across all regions')
         self.task.best_resources = None
         time.sleep(self.RETRY_INIT_GAP_SECONDS)
         job_id = self._launch_once(retry_until_up=True)
@@ -140,6 +154,9 @@ class EagerFailoverStrategyExecutor(StrategyExecutor):
             prev = Resources.from_yaml_config(h['launched_resources'])
             if isinstance(prev, Resources):
                 blocked.append(prev)
+            self._annotate(
+                'eager failover: blocklisted '
+                f"zone={h.get('zone') or h.get('region') or '?'}")
         self._cleanup_remnants()
         self.task.best_resources = None
         if blocked:

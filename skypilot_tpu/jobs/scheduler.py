@@ -12,6 +12,7 @@ Controllers run as tasks on the jobs-controller cluster
 from __future__ import annotations
 
 import os
+from typing import Dict, List
 
 import filelock
 
@@ -65,13 +66,17 @@ def _reconcile_dead_controllers() -> None:
     pid liveness is host-local, so this sweep runs ONLY from the watchdog
     (itself a controller-cluster task on the same host as the controller
     pids) — never from the client's submit path, where every remote pid
-    would look dead and healthy controllers would be duplicated."""
+    would look dead and healthy controllers would be duplicated.
+    Returns the sweep's decisions for the watchdog's structured log."""
+    actions: Dict[str, List[int]] = {'freed': [], 'requeued': [],
+                                     'gave_up': []}
     for row in state.alive_controllers():
         if row['status'].is_terminal():
             # Controller exited without flipping its slot; free it.
-            state.cas_schedule_state(row['job_id'],
-                                     [state.ScheduleState.ALIVE],
-                                     state.ScheduleState.DONE)
+            if state.cas_schedule_state(row['job_id'],
+                                        [state.ScheduleState.ALIVE],
+                                        state.ScheduleState.DONE):
+                actions['freed'].append(row['job_id'])
             continue
         pid = row['controller_pid']
         if pid is None or _pid_alive(int(pid)):
@@ -91,13 +96,17 @@ def _reconcile_dead_controllers() -> None:
                     job_id, state.ManagedJobStatus.FAILED_CONTROLLER,
                     detail=f'controller died {restarts_so_far + 1} times; '
                            'giving up')
+                actions['gave_up'].append(job_id)
             continue
         if state.cas_schedule_state(job_id, [state.ScheduleState.ALIVE],
                                     state.ScheduleState.WAITING):
             state.bump_controller_restarts(job_id)
+            actions['requeued'].append(job_id)
+    return actions
 
 
-def _reconcile_stale_launching() -> None:
+def _reconcile_stale_launching() -> List[int]:
+    reaped = []
     for job_id in state.stale_launching_jobs(LAUNCHING_GRACE_S):
         # CAS LAUNCHING->DONE: if the controller won the race and is ALIVE,
         # the CAS fails and the healthy job is left alone.
@@ -105,29 +114,38 @@ def _reconcile_stale_launching() -> None:
                                         [state.ScheduleState.LAUNCHING],
                                         state.ScheduleState.DONE):
             continue
+        reaped.append(job_id)
         record = state.get(job_id)
         if record is None or record['status'].is_terminal():
             continue
         state.set_status(
             job_id, state.ManagedJobStatus.FAILED_CONTROLLER,
             detail=f'controller never started within {LAUNCHING_GRACE_S:.0f}s')
+    return reaped
 
 
-def maybe_schedule_next(reap_dead_controllers: bool = False) -> None:
+def maybe_schedule_next(
+        reap_dead_controllers: bool = False) -> Dict[str, List[int]]:
     """Promote WAITING jobs to LAUNCHING while under the cap. Called on
     submit and whenever a controller exits. ``reap_dead_controllers`` is
     the HA sweep — only the watchdog (co-located with the controller pids)
-    may pass it."""
+    may pass it. Returns every decision taken (job-id lists) so the
+    watchdog can log the sweep as one structured event; other callers
+    ignore the return value."""
+    summary: Dict[str, List[int]] = {
+        'promoted': [], 'launch_failed': [], 'reaped_stale': [],
+        'freed': [], 'requeued': [], 'gave_up': []}
     while True:
         with _sched_lock():
-            _reconcile_stale_launching()
+            summary['reaped_stale'].extend(_reconcile_stale_launching())
             if reap_dead_controllers:
-                _reconcile_dead_controllers()
+                for key, ids in _reconcile_dead_controllers().items():
+                    summary[key].extend(ids)
             if state.count_live_controllers() >= max_concurrent_controllers():
-                return
+                return summary
             job_id = state.next_waiting()
             if job_id is None:
-                return
+                return summary
             state.set_schedule_state(job_id, state.ScheduleState.LAUNCHING)
         try:
             controller_utils.launch_controller_task(
@@ -140,10 +158,12 @@ def maybe_schedule_next(reap_dead_controllers: bool = False) -> None:
             # controller's ALIVE must not be clobbered back to LAUNCHING).
             state.cas_schedule_state(job_id, [state.ScheduleState.LAUNCHING],
                                      state.ScheduleState.LAUNCHING)
+            summary['promoted'].append(job_id)
         except Exception as e:  # noqa: BLE001 — record, release the slot
             state.set_schedule_state(job_id, state.ScheduleState.DONE)
             state.set_status(job_id, state.ManagedJobStatus.FAILED_CONTROLLER,
                              detail=f'controller launch failed: {e!r}')
+            summary['launch_failed'].append(job_id)
 
 
 def controller_started(job_id: int) -> None:

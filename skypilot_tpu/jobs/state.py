@@ -80,7 +80,76 @@ CREATE TABLE IF NOT EXISTS managed_job_events (
     to_status TEXT,
     detail TEXT
 );
+CREATE TABLE IF NOT EXISTS managed_job_phases (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    job_id INTEGER,
+    phase TEXT,
+    started_at REAL,
+    ended_at REAL,
+    detail TEXT
+);
 """
+
+# ---------------------------------------------------------------------------
+# Goodput ledger: every status transition closes the open phase row and
+# opens the next one AT THE SAME TIMESTAMP (inside the same locked
+# transaction as the status update), so the ledger is gap-free and
+# non-overlapping BY CONSTRUCTION and its durations sum exactly to the
+# job's wall-clock (submitted_at -> ended_at). The operator's question
+# after a preempted pod-slice job — "how much wall-clock was productive
+# compute vs. provisioning/recovery?" — is a single SELECT.
+
+# Status -> ledger phase. Statuses sharing a phase (PENDING/SUBMITTED)
+# do not open a new row; terminal statuses close the ledger.
+_PHASE_OF = {
+    ManagedJobStatus.PENDING: 'pending',
+    ManagedJobStatus.SUBMITTED: 'pending',
+    ManagedJobStatus.STARTING: 'launching',
+    ManagedJobStatus.RUNNING: 'running',
+    ManagedJobStatus.RECOVERING: 'recovering',
+    ManagedJobStatus.CANCELLING: 'cancelling',
+}
+
+# Goodput accounting per phase: 'running' is productive compute;
+# 'recovering' is badput (work lost to preemption/failure + re-acquire);
+# the rest is provisioning/queueing overhead.
+PHASE_KIND = {
+    'pending': 'overhead',
+    'launching': 'overhead',
+    'running': 'goodput',
+    'recovering': 'badput',
+    'cancelling': 'overhead',
+}
+
+
+def _open_phase(conn, job_id: int):
+    return conn.execute(
+        'SELECT id, phase, started_at FROM managed_job_phases WHERE '
+        'job_id = ? AND ended_at IS NULL ORDER BY id DESC LIMIT 1',
+        (job_id,)).fetchone()
+
+
+def _ledger_transition(conn, job_id: int, status: ManagedJobStatus,
+                       now: float, detail: str, open_row) -> None:
+    """Close/open phase rows for one status transition (caller holds the
+    lock and the transaction, and has clamped ``now`` against the open
+    row's start)."""
+    if status.is_terminal():
+        if open_row is not None:
+            conn.execute('UPDATE managed_job_phases SET ended_at = ? '
+                         'WHERE id = ?', (now, open_row['id']))
+        return
+    phase = _PHASE_OF.get(status)
+    if phase is None or (open_row is not None
+                         and open_row['phase'] == phase):
+        return  # same phase: the open row keeps accruing
+    if open_row is not None:
+        conn.execute('UPDATE managed_job_phases SET ended_at = ? '
+                     'WHERE id = ?', (now, open_row['id']))
+    conn.execute(
+        'INSERT INTO managed_job_phases (job_id, phase, started_at, '
+        'ended_at, detail) VALUES (?, ?, ?, NULL, ?)',
+        (job_id, phase, now, detail))
 
 
 def _db_path() -> str:
@@ -117,20 +186,31 @@ def submit(name: Optional[str], task_config: Dict[str, Any],
            recovery_strategy: str = 'FAILOVER',
            max_restarts_on_errors: int = 0) -> int:
     from skypilot_tpu import workspaces as workspaces_lib
+    now = time.time()
     with _lock(), _conn() as conn:
         cur = conn.execute(
             'INSERT INTO managed_jobs (name, task_config, status, '
             'recovery_strategy, max_restarts_on_errors, submitted_at, '
             'workspace) VALUES (?, ?, ?, ?, ?, ?, ?)',
             (name, json.dumps(task_config), ManagedJobStatus.PENDING.value,
-             recovery_strategy, max_restarts_on_errors, time.time(),
+             recovery_strategy, max_restarts_on_errors, now,
              workspaces_lib.active_workspace()))
-        return int(cur.lastrowid)
+        job_id = int(cur.lastrowid)
+        # Ledger anchor: the first phase opens at the SAME timestamp as
+        # submitted_at, so phase durations sum to wall-clock exactly.
+        conn.execute(
+            'INSERT INTO managed_job_phases (job_id, phase, started_at, '
+            'ended_at, detail) VALUES (?, ?, ?, NULL, ?)',
+            (job_id, _PHASE_OF[ManagedJobStatus.PENDING], now, ''))
+        return job_id
 
 
 def set_status(job_id: int, status: ManagedJobStatus,
                detail: str = '') -> bool:
-    """Record a transition (terminal states frozen, like the job table)."""
+    """Record a transition (terminal states frozen, like the job table).
+    One timestamp serves the status row, the event, and the goodput
+    ledger's close/open, keeping the ledger gap-free and its total equal
+    to ended_at - submitted_at exactly."""
     with _lock(), _conn() as conn:
         row = conn.execute(
             'SELECT status FROM managed_jobs WHERE job_id = ?',
@@ -140,20 +220,29 @@ def set_status(job_id: int, status: ManagedJobStatus,
         cur_status = ManagedJobStatus(row['status'])
         if cur_status.is_terminal():
             return False
+        # Timestamp INSIDE the lock, clamped to the open phase's start:
+        # a writer that sampled the clock early and then lost the lock
+        # race must not close a row before it was opened (that would
+        # punch a gap — and a negative phase — into the ledger).
+        now = time.time()
+        open_row = _open_phase(conn, job_id)
+        if open_row is not None:
+            now = max(now, open_row['started_at'])
         sets = 'status = ?, last_event = ?'
         args: List[Any] = [status.value, detail]
         if status == ManagedJobStatus.RUNNING:
             sets += ', started_at = COALESCE(started_at, ?)'
-            args.append(time.time())
+            args.append(now)
         if status.is_terminal():
             sets += ', ended_at = ?'
-            args.append(time.time())
+            args.append(now)
         args.append(job_id)
         conn.execute(f'UPDATE managed_jobs SET {sets} WHERE job_id = ?', args)
         conn.execute(
             'INSERT INTO managed_job_events (job_id, timestamp, from_status, '
             'to_status, detail) VALUES (?, ?, ?, ?, ?)',
-            (job_id, time.time(), cur_status.value, status.value, detail))
+            (job_id, now, cur_status.value, status.value, detail))
+        _ledger_transition(conn, job_id, status, now, detail, open_row)
         return True
 
 
@@ -311,3 +400,94 @@ def count_nonterminal() -> int:
             f'SELECT COUNT(*) AS c FROM managed_jobs WHERE status NOT IN '
             f'({",".join("?" * len(terminal))})', terminal).fetchone()
         return int(row['c'])
+
+
+# -- goodput ledger reads ----------------------------------------------------
+
+
+def phase_ledger(job_id: int) -> List[Dict[str, Any]]:
+    """The job's phase rows, oldest first, each tagged with its goodput
+    kind. ``ended_at`` is None on the (single) open phase of a live job."""
+    with _conn() as conn:
+        rows = conn.execute(
+            'SELECT id, phase, started_at, ended_at, detail FROM '
+            'managed_job_phases WHERE job_id = ? ORDER BY id',
+            (job_id,)).fetchall()
+    return [{
+        'phase': r['phase'],
+        'kind': PHASE_KIND.get(r['phase'], 'overhead'),
+        'started_at': r['started_at'],
+        'ended_at': r['ended_at'],
+        'detail': r['detail'] or '',
+    } for r in rows]
+
+
+def annotate_phase(job_id: int, note: str) -> None:
+    """Append an annotation to the open phase (e.g. the recovery
+    strategy recording WHICH zone's preemption caused this badput
+    interval, or which zone it blocklisted on the way out)."""
+    with _lock(), _conn() as conn:
+        row = conn.execute(
+            'SELECT id, detail FROM managed_job_phases WHERE job_id = ? '
+            'AND ended_at IS NULL ORDER BY id DESC LIMIT 1',
+            (job_id,)).fetchone()
+        if row is None:
+            return
+        detail = f"{row['detail']}; {note}" if row['detail'] else note
+        conn.execute('UPDATE managed_job_phases SET detail = ? WHERE id = ?',
+                     (detail, row['id']))
+
+
+def goodput_summary(job_id: int) -> Optional[Dict[str, Any]]:
+    """Aggregate the ledger into the operator's goodput answer: seconds
+    per phase/kind over the job's wall-clock (open phase measured to
+    now), plus the badput annotations (which zone/preemption)."""
+    record = get(job_id)
+    if record is None:
+        return None
+    rows = phase_ledger(job_id)
+    if not rows:
+        return None
+    now = time.time()
+    t_end = rows[-1]['ended_at'] if rows[-1]['ended_at'] is not None else now
+    wall_s = max(t_end - rows[0]['started_at'], 0.0)
+    phases: Dict[str, float] = {}
+    kinds = {'goodput': 0.0, 'badput': 0.0, 'overhead': 0.0}
+    badput_events = []
+    for r in rows:
+        dur = max((r['ended_at'] if r['ended_at'] is not None else now)
+                  - r['started_at'], 0.0)
+        phases[r['phase']] = phases.get(r['phase'], 0.0) + dur
+        kinds[r['kind']] = kinds.get(r['kind'], 0.0) + dur
+        if r['kind'] == 'badput' and r['detail']:
+            badput_events.append(r['detail'])
+    return {
+        'job_id': job_id,
+        'status': record['status'].value,
+        'wall_s': round(wall_s, 3),
+        'closed': rows[-1]['ended_at'] is not None,
+        'phases': {k: round(v, 3) for k, v in sorted(phases.items())},
+        'goodput_s': round(kinds['goodput'], 3),
+        'badput_s': round(kinds['badput'], 3),
+        'overhead_s': round(kinds['overhead'], 3),
+        'goodput_ratio': round(kinds['goodput'] / wall_s, 4)
+                         if wall_s > 0 else 0.0,
+        'recoveries': record['recovery_count'],
+        'badput_events': badput_events,
+    }
+
+
+def phase_totals() -> Dict[int, Dict[str, float]]:
+    """Seconds per (job, phase) across every ledger in one query — the
+    Prometheus scrape path (open phases measured to now)."""
+    now = time.time()
+    with _conn() as conn:
+        rows = conn.execute(
+            'SELECT job_id, phase, SUM(COALESCE(ended_at, ?) - started_at) '
+            'AS secs FROM managed_job_phases GROUP BY job_id, phase',
+            (now,)).fetchall()
+    out: Dict[int, Dict[str, float]] = {}
+    for r in rows:
+        out.setdefault(int(r['job_id']), {})[r['phase']] = \
+            max(float(r['secs'] or 0.0), 0.0)
+    return out

@@ -20,6 +20,7 @@ terminal for a few ticks, so it never outlives the work.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import time
 
@@ -28,6 +29,14 @@ import filelock
 from skypilot_tpu.jobs import scheduler, state
 
 _IDLE_EXIT_TICKS = 5
+
+
+def _log_event(event: str, **fields) -> None:
+    """One-line JSON to stdout (the watchdog's task log): every sweep
+    decision is grep-able for controller post-mortems —
+    ``{"event": "watchdog_sweep", "requeued": [7], ...}``."""
+    print(json.dumps({'event': event, 'ts': round(time.time(), 3),
+                      **fields}, sort_keys=True), flush=True)
 
 
 def _lock_path() -> str:
@@ -55,7 +64,7 @@ def ensure_running() -> bool:
             cluster_name=controller_utils.JOBS_CONTROLLER_CLUSTER)
         return True
     except Exception as e:  # noqa: BLE001 — HA is best-effort; jobs still run
-        print(f'[jobs] watchdog start failed: {e!r}')
+        _log_event('watchdog_start_failed', error=repr(e))
         return False
 
 
@@ -89,23 +98,36 @@ def run(interval_s: float = 2.0) -> None:
     idle = 0
     with lock:
         while idle < _IDLE_EXIT_TICKS:
+            sweep = {}
             try:
-                scheduler.maybe_schedule_next(reap_dead_controllers=True)
+                sweep = scheduler.maybe_schedule_next(
+                    reap_dead_controllers=True)
             except Exception as e:  # noqa: BLE001 — the watchdog must survive
-                print(f'[watchdog] sweep failed: {e!r}')
+                _log_event('watchdog_sweep_error', error=repr(e))
             try:
                 if _sweep_serve():
                     from skypilot_tpu import serve as serve_lib
                     serve_lib.reconcile_controllers()
                 services = _active_services()
             except Exception as e:  # noqa: BLE001
-                print(f'[watchdog] serve sweep failed: {e!r}')
+                _log_event('watchdog_serve_sweep_error', error=repr(e))
                 # Fail BUSY: a broken sweep must not let the watchdog count
                 # itself idle and exit while services may still be running.
                 services = 1
-            busy = state.count_nonterminal() > 0 or services > 0
+            nonterminal = state.count_nonterminal()
+            busy = nonterminal > 0 or services > 0
             idle = 0 if busy else idle + 1
+            # One structured line per sweep THAT DECIDED something (why:
+            # requeued = dead controller pid, reaped_stale = LAUNCHING
+            # grace expired, gave_up = restart budget exhausted, freed =
+            # controller exited without releasing its slot).
+            acted = {k: v for k, v in sweep.items() if v}
+            if acted:
+                _log_event('watchdog_sweep', nonterminal_jobs=nonterminal,
+                           active_services=services, **acted)
             time.sleep(interval_s)
+        _log_event('watchdog_exit', reason='job table fully terminal',
+                   idle_ticks=idle)
 
 
 def main() -> None:

@@ -20,6 +20,7 @@ Routes (registered by ``server.py``):
   GET /dashboard/api/metrics/history       -> fleet time-series ring buffer
   GET /dashboard/api/infra                 -> clouds/catalogs/server health
   GET /dashboard/api/config                -> layered config (redacted)
+  GET /dashboard/api/fleet                 -> heartbeats + job goodput
 """
 from __future__ import annotations
 
@@ -172,12 +173,57 @@ def _job_log_tail(cluster: str, job_id: Optional[int],
         return {'job_id': job_id, 'lines': [], 'error': str(e)}
 
 
+def fleet_view() -> Dict[str, Any]:
+    """The fleet telemetry panel: per-cluster heartbeat health (age,
+    staleness, disk, newest training window) + per-job goodput from the
+    phase ledger. Pure state-table reads — the ledger aggregation is ONE
+    grouped query (``phase_totals``), not a per-job fan-out, so the 2 s
+    dashboard poll stays cheap at fleet scale."""
+    from skypilot_tpu import global_user_state
+    from skypilot_tpu.jobs import state as jobs_state
+
+    clusters = []
+    for rec in global_user_state.get_clusters():
+        hb = rec.get('heartbeat') or {}
+        age, stale = global_user_state.heartbeat_age(rec)
+        clusters.append({
+            'name': rec['name'],
+            'status': rec['status'].value,
+            'heartbeat_age': round(age, 1) if age is not None else None,
+            'heartbeat_stale': stale,
+            'host': hb.get('host'),
+            'jobs': hb.get('jobs'),
+            'train': hb.get('train'),
+        })
+    totals = jobs_state.phase_totals()
+    jobs = []
+    for rec in jobs_state.list_jobs(limit=100):
+        phases = totals.get(rec['job_id'])
+        if not phases:
+            continue  # predates the ledger
+        wall = sum(phases.values())
+        jobs.append({
+            'job_id': rec['job_id'],
+            'name': rec['name'],
+            'cluster': rec['cluster_name'],
+            'status': rec['status'].value,
+            'wall_s': round(wall, 3),
+            'phases': {k: round(v, 3) for k, v in sorted(phases.items())},
+            'goodput_ratio': round(phases.get('running', 0.0) / wall, 4)
+                             if wall > 0 else 0.0,
+            'recoveries': rec['recovery_count'],
+        })
+    return {'clusters': clusters, 'jobs': jobs}
+
+
 def job_detail(job_id: int) -> Optional[Dict[str, Any]]:
     from skypilot_tpu.jobs import state as jobs_state
     rec = jobs_state.get(job_id)
     if rec is None:
         return None
     return {
+        'goodput': jobs_state.goodput_summary(job_id),
+        'ledger': jobs_state.phase_ledger(job_id),
         'job_id': job_id,
         'name': rec['name'],
         'status': rec['status'].value,
@@ -485,6 +531,10 @@ async def api_infra(request: web.Request) -> web.Response:
     return await _json(request, infra_view)
 
 
+async def api_fleet(request: web.Request) -> web.Response:
+    return await _json(request, fleet_view)
+
+
 async def api_config(request: web.Request) -> web.Response:
     return await _json(request, config_view)
 
@@ -504,6 +554,7 @@ def add_routes(app: web.Application) -> None:
     app.router.add_get('/dashboard/api/logs/search', api_logs_search)
     app.router.add_get('/dashboard/api/infra', api_infra)
     app.router.add_get('/dashboard/api/config', api_config)
+    app.router.add_get('/dashboard/api/fleet', api_fleet)
 
 
 _PAGE = """<!doctype html>
@@ -536,7 +587,7 @@ _PAGE = """<!doctype html>
 </style></head><body>
 <h1>skypilot-tpu <span id="ts"></span></h1>
 <nav><a href="#/">overview</a> <a href="#/metrics">metrics</a>
- <a href="#/traces">traces</a>
+ <a href="#/traces">traces</a> <a href="#/fleet">fleet</a>
  <a href="#/logs">logs</a> <a href="#/infra">infra</a>
  <a href="#/config">config</a> <a href="#/users">users</a>
  <a href="#/workspaces">workspaces</a></nav>
@@ -638,8 +689,33 @@ async function clusterView(name){
        <td>${esc(e.detail)}</td></tr>`);
 }
 
+// Stacked wall-clock bar from a goodput summary's {phase: seconds}.
+const PHASE_COLOR = {running:'#0a7d33', recovering:'#b3261e',
+  launching:'#7a5b00', pending:'#a0a0a8', cancelling:'#52525b'};
+function goodputBar(g){
+  if(!g || !g.wall_s) return '';
+  const segs = Object.entries(g.phases).map(([p,s])=>
+    `<div title="${esc(p)} ${s.toFixed(1)}s" style="display:inline-block;
+      height:14px;width:${(100*s/g.wall_s).toFixed(2)}%;
+      background:${PHASE_COLOR[p]||'#888'}"></div>`).join('');
+  return `<div style="width:100%;background:#f0f0f3;border-radius:3px;
+    overflow:hidden;white-space:nowrap">${segs}</div>`;
+}
+const goodputLegend = Object.entries(PHASE_COLOR).map(([p,c])=>
+  `<span style="color:${c};font-size:11px;margin-right:8px">&#9632; ${p}
+   </span>`).join('');
+
 async function jobView(id){
   const j = await J('dashboard/api/job/' + id);
+  const g = j.goodput;
+  const goodputHtml = g ? `<h2>Goodput ${
+      (100*g.goodput_ratio).toFixed(1)}% of ${g.wall_s.toFixed(1)}s
+      wall-clock</h2>` + goodputBar(g) + `<div>${goodputLegend}</div>` +
+    table(['phase','kind','start','seconds','detail'], j.ledger||[],
+      r=>`<tr><td>${esc(r.phase)}</td><td>${esc(r.kind)}</td>
+       <td>${T(r.started_at)}</td>
+       <td>${r.ended_at!=null?(r.ended_at-r.started_at).toFixed(2):'(open)'}
+       </td><td>${esc(r.detail)}</td></tr>`) : '';
   return `<h2>Managed job ${esc(id)}: ${esc(j.name)}</h2>` + kv({
       status: B(j.status), schedule: B(j.schedule_state),
       cluster: `<a href="#/cluster/${esc(j.cluster)}">${esc(j.cluster)}</a>`,
@@ -648,8 +724,48 @@ async function jobView(id){
       'controller pid': esc(j.controller_pid),
       'controller restarts': esc(j.controller_restarts),
       submitted: T(j.submitted_at), detail: esc(j.detail)}) +
+    goodputHtml +
     `<h2>Task config</h2><pre class="log">${
       esc(JSON.stringify(j.task_config, null, 2))}</pre>`;
+}
+
+async function fleetView(){
+  const f = await J('dashboard/api/fleet');
+  const hb = c => c.heartbeat_age==null ? '—'
+    : (c.heartbeat_age < 120 ? `${Math.round(c.heartbeat_age)}s`
+                             : `${Math.round(c.heartbeat_age/60)}m`) +
+      (c.heartbeat_stale ? ' <span style="color:#9d1c0e">STALE</span>' : '');
+  const train = c => {
+    const t = c.train;
+    if(!t) return '—';
+    const parts = [`step ${t.step_time_s}s`,
+                   `${Math.round(t.tokens_per_s)} tok/s`];
+    if(t.mfu != null) parts.push(`MFU ${(100*t.mfu).toFixed(1)}%`);
+    if(t.loss != null) parts.push(`loss ${t.loss.toFixed(3)}`);
+    if(t.step != null) parts.push(`@step ${t.step}`);
+    return esc(parts.join(', '));
+  };
+  const host = c => {
+    const h = c.host;
+    if(!h) return '—';
+    const parts = [];
+    if(h.disk_used_pct != null) parts.push(`disk ${h.disk_used_pct}%`);
+    if(h.framework_procs != null) parts.push(`${h.framework_procs} procs`);
+    return esc(parts.join(', '));
+  };
+  return `<h2>Cluster heartbeats</h2>` + table(
+    ['cluster','status','heartbeat','host','training'], f.clusters,
+    c=>`<tr><td><a href="#/cluster/${esc(c.name)}">${esc(c.name)}</a></td>
+     <td>${B(c.status)}</td><td>${hb(c)}</td><td>${host(c)}</td>
+     <td>${train(c)}</td></tr>`) +
+  `<h2>Managed-job goodput</h2><div>${goodputLegend}</div>` + table(
+    ['job','status','wall','goodput','recoveries','breakdown'], f.jobs,
+    g=>`<tr><td><a href="#/job/${g.job_id}">${esc(g.job_id)} ${
+       esc(g.name)}</a></td><td>${B(g.status)}</td>
+     <td>${g.wall_s.toFixed(1)}s</td>
+     <td>${(100*g.goodput_ratio).toFixed(1)}%</td>
+     <td>${esc(g.recoveries)}</td>
+     <td style="min-width:220px">${goodputBar(g)}</td></tr>`);
 }
 
 async function serviceView(name){
@@ -950,6 +1066,7 @@ async function route(){
     else if(h === '#/workspaces') html = await workspacesView();
     else if(h === '#/metrics') html = await metricsView();
     else if(h === '#/traces') html = await tracesView();
+    else if(h === '#/fleet') html = await fleetView();
     else if((m = h.match(/^#\\/logs(?:\\/(.*))?$/)))
       html = await logsView(m[1] ? decodeURIComponent(m[1]) : '');
     else if(h === '#/infra') html = await infraView();

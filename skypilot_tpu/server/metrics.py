@@ -86,6 +86,38 @@ REQUESTS_TOTAL = Counter(
 
 _CLUSTERS = Gauge('skytpu_clusters', 'Clusters by status.', ['status'],
                   registry=REGISTRY)
+
+# Training/fleet telemetry (computed at scrape time from the goodput
+# ledger and the clusters' heartbeat payloads — the same
+# read-state-at-scrape discipline as the fleet gauges below).
+_JOB_GOODPUT = Gauge(
+    'skytpu_job_goodput_ratio',
+    'Managed-job goodput: fraction of wall-clock spent RUNNING (vs '
+    'provisioning, queueing, and recovery), from the phase ledger.',
+    ['job_id'], registry=REGISTRY)
+_JOB_PHASE_SECONDS = Gauge(
+    'skytpu_job_phase_seconds',
+    'Managed-job wall-clock seconds per ledger phase (pending | '
+    'launching | running | recovering | cancelling); the phases of one '
+    'job sum to its wall-clock. A gauge, not a counter: series are '
+    'recomputed each scrape and retire with the job — no _total suffix.',
+    ['job_id', 'phase'], registry=REGISTRY)
+_TRAIN_STEP_SECONDS = Gauge(
+    'skytpu_train_step_seconds',
+    'Latest trainer step time per cluster (heartbeat-shipped telemetry '
+    'window).', ['cluster'], registry=REGISTRY)
+_TRAIN_TOKENS_PER_S = Gauge(
+    'skytpu_train_tokens_per_s',
+    'Latest trainer throughput per cluster (heartbeat-shipped).',
+    ['cluster'], registry=REGISTRY)
+_TRAIN_MFU = Gauge(
+    'skytpu_train_mfu',
+    'Latest achieved MFU per cluster (needs SKYTPU_PEAK_FLOPS on the '
+    'trainer host; absent otherwise).', ['cluster'], registry=REGISTRY)
+_CLUSTER_HEARTBEAT_AGE = Gauge(
+    'skytpu_cluster_heartbeat_age_seconds',
+    'Seconds since each cluster daemon last heartbeated.',
+    ['cluster'], registry=REGISTRY)
 _MANAGED_JOBS = Gauge('skytpu_managed_jobs', 'Managed jobs by status.',
                       ['status'], registry=REGISTRY)
 _SERVICES = Gauge('skytpu_services', 'Services by status.', ['status'],
@@ -115,6 +147,45 @@ _SERVE_QOS_WAIT_P95 = Gauge(
     ['service', 'replica', 'qos_class'], registry=REGISTRY)
 
 
+def _refresh_goodput_gauges(clusters, jobs) -> None:
+    """Goodput/phase gauges from the ledger (one grouped query) and
+    train/heartbeat gauges from the cluster heartbeats."""
+    import time as time_lib
+
+    from skypilot_tpu.jobs import state as jobs_state
+
+    for gauge in (_JOB_GOODPUT, _JOB_PHASE_SECONDS, _TRAIN_STEP_SECONDS,
+                  _TRAIN_TOKENS_PER_S, _TRAIN_MFU, _CLUSTER_HEARTBEAT_AGE):
+        gauge.clear()
+    totals = jobs_state.phase_totals()
+    listed = {r['job_id'] for r in jobs}
+    for job_id, phases in totals.items():
+        if job_id not in listed:
+            continue  # past the list_jobs window: keep label sets bounded
+        wall = sum(phases.values())
+        for phase, secs in phases.items():
+            _JOB_PHASE_SECONDS.labels(job_id=str(job_id),
+                                      phase=phase).set(secs)
+        if wall > 0:
+            _JOB_GOODPUT.labels(job_id=str(job_id)).set(
+                phases.get('running', 0.0) / wall)
+    now = time_lib.time()
+    for rec in clusters:
+        if rec.get('last_heartbeat'):
+            _CLUSTER_HEARTBEAT_AGE.labels(cluster=rec['name']).set(
+                max(now - rec['last_heartbeat'], 0.0))
+        train = (rec.get('heartbeat') or {}).get('train')
+        if not isinstance(train, dict):
+            continue
+        labels = {'cluster': rec['name']}
+        if isinstance(train.get('step_time_s'), (int, float)):
+            _TRAIN_STEP_SECONDS.labels(**labels).set(train['step_time_s'])
+        if isinstance(train.get('tokens_per_s'), (int, float)):
+            _TRAIN_TOKENS_PER_S.labels(**labels).set(train['tokens_per_s'])
+        if isinstance(train.get('mfu'), (int, float)):
+            _TRAIN_MFU.labels(**labels).set(train['mfu'])
+
+
 def _refresh_gauges() -> None:
     from collections import Counter as C
 
@@ -123,11 +194,12 @@ def _refresh_gauges() -> None:
     from skypilot_tpu.serve import serve_state
     from skypilot_tpu.server import requests_db
 
+    clusters = global_user_state.get_clusters()
+    jobs = jobs_state.list_jobs()
+    _refresh_goodput_gauges(clusters, jobs)
     for gauge, counts in (
-        (_CLUSTERS, C(r['status'].value
-                      for r in global_user_state.get_clusters())),
-        (_MANAGED_JOBS, C(r['status'].value
-                          for r in jobs_state.list_jobs())),
+        (_CLUSTERS, C(r['status'].value for r in clusters)),
+        (_MANAGED_JOBS, C(r['status'].value for r in jobs)),
         (_SERVICES, C(s['status'].value for s in serve_state.list_services()
                       if s is not None)),
         (_API_REQUESTS, C(r['status'] for r in requests_db.list_requests())),

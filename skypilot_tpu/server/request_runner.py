@@ -109,6 +109,9 @@ def _run_op(payload: Dict[str, Any]) -> Any:
     if op == 'jobs_cancel':
         from skypilot_tpu import jobs
         return jobs.cancel(payload['job_id'])
+    if op == 'jobs_goodput':
+        from skypilot_tpu import jobs
+        return jobs.goodput(payload['job_id'])
     raise ValueError(f'Unknown op {op!r}')
 
 

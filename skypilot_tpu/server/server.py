@@ -235,7 +235,7 @@ async def auth_middleware(request: web.Request, handler):
 _API_OPS = frozenset((
     'launch', 'exec', 'down', 'stop', 'start', 'autostop', 'cancel',
     'status', 'queue', 'cost_report', 'job_status', 'check',
-    'jobs/launch', 'jobs/queue', 'jobs/cancel',
+    'jobs/launch', 'jobs/queue', 'jobs/cancel', 'jobs/goodput',
     'api/get', 'api/stream', 'api/requests', 'api/cancel'))
 
 
@@ -329,6 +329,7 @@ def make_app() -> web.Application:
     app.router.add_post('/api/v1/jobs/launch', _make_post('jobs_launch'))
     app.router.add_get('/api/v1/jobs/queue', _make_get('jobs_queue'))
     app.router.add_post('/api/v1/jobs/cancel', _make_post('jobs_cancel'))
+    app.router.add_get('/api/v1/jobs/goodput', _make_get('jobs_goodput'))
     app.router.add_post('/oauth/login/start', oauth_login_start)
     app.router.add_post('/oauth/login/poll', oauth_login_poll)
     return app

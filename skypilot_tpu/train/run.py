@@ -136,6 +136,16 @@ def main() -> None:
             args.data, seq_len=cfg.seq_len,
             batch_size=cfg.global_batch_size)
 
+    # Step telemetry (observability/train_telemetry.py): one JSONL record
+    # per --log-every window, riding the loss fetch that window already
+    # pays for. Writer is None (and the loop byte-identical) unless the
+    # spool dir env var is set — the gang driver exports it per worker.
+    from skypilot_tpu.observability import train_telemetry
+    telem = train_telemetry.TelemetryWriter.from_env()
+    from skypilot_tpu.train import trainer as trainer_lib
+    window_t0 = time.time()
+    window_steps = 0
+
     step_fn = trainer.compiled_step()
     for i in range(start_step, args.steps):
         if dataset is not None:
@@ -147,10 +157,22 @@ def main() -> None:
         t0 = time.time()
         state, metrics = step_fn(state, batch)
         step = i + 1
+        window_steps += 1
         if step % args.log_every == 0 or step == args.steps:
             loss = float(jax.device_get(metrics['loss']))
             print(f'[train] step {step}/{args.steps} loss={loss:.4f}',
                   flush=True)
+            now = time.time()
+            if telem is not None:
+                telem.emit(train_telemetry.window_record(
+                    step=step, steps=window_steps,
+                    window_s=now - window_t0,
+                    tokens_per_step=trainer_lib.tokens_per_step(cfg),
+                    model_flops_per_step=trainer_lib.model_flops_per_step(
+                        cfg),
+                    loss=loss, ts=now))
+            window_t0 = now
+            window_steps = 0
         if mgr is not None:
             mgr.save(step, state)
         dt = time.time() - t0

@@ -300,6 +300,17 @@ pkg_root = os.environ.get('SKYTPU_PKG_ROOT')
 if pkg_root and pkg_root not in sys.path:
     sys.path.insert(0, pkg_root)
 phase('python-started')
+# Deterministic hang injection (tests): hold here until the named file
+# appears, so timeout-path assertions gate on a fake deadline instead of
+# racing the real init ladder (which can finish inside the parent's
+# post-timeout SIGUSR1 window on a fast box).
+_hold = os.environ.get('SKYTPU_PROBE_HOLD_FILE')
+if _hold:
+    import time as _time
+    _give_up = _time.time() + float(
+        os.environ.get('SKYTPU_PROBE_HOLD_MAX_S', '60'))
+    while not os.path.exists(_hold) and _time.time() < _give_up:
+        _time.sleep(0.05)
 # Hard deadline: if init NEVER completes the child must eventually give
 # up — an abrupt exit is unavoidable then, but the deadline sits far
 # beyond any healthy init time, so a live handshake that would have

@@ -1,0 +1,260 @@
+"""Training/fleet telemetry: spool writer bounds, heartbeat enrichment,
+goodput CLI + Prometheus surfacing, `stpu status` staleness flag.
+
+Kept jax-free (the writer/reader/daemon paths must never pull the model
+stack) so the module stays in the fast tier.
+"""
+import json
+import os
+import time
+
+import pytest
+from click.testing import CliRunner
+
+from skypilot_tpu import global_user_state
+from skypilot_tpu.observability import train_telemetry
+
+
+@pytest.fixture(autouse=True)
+def _state(tmp_state_dir):
+    yield
+
+
+# -- spool writer ------------------------------------------------------------
+
+
+def test_writer_disabled_without_env(monkeypatch):
+    monkeypatch.delenv(train_telemetry.ENV_DIR, raising=False)
+    assert train_telemetry.TelemetryWriter.from_env() is None
+
+
+def test_writer_emit_and_read(tmp_path):
+    spool = tmp_path / 'telem'
+    writer = train_telemetry.TelemetryWriter(str(spool))
+    for step in (10, 20):
+        writer.emit(train_telemetry.window_record(
+            step=step, steps=10, window_s=2.0, tokens_per_step=30,
+            model_flops_per_step=1e9, loss=1.5))
+    records = train_telemetry.read_records(str(spool))
+    assert [r['step'] for r in records] == [10, 20]
+    rec = records[-1]
+    assert rec['step_time_s'] == pytest.approx(0.2)
+    assert rec['tokens_per_s'] == pytest.approx(150.0)
+    assert rec['loss'] == pytest.approx(1.5)
+    assert 'mfu' not in rec  # no SKYTPU_PEAK_FLOPS set
+    assert train_telemetry.latest_record(str(spool))['step'] == 20
+
+
+def test_writer_mfu_from_peak_env(monkeypatch):
+    monkeypatch.setenv('SKYTPU_PEAK_FLOPS', '2e9')
+    rec = train_telemetry.window_record(
+        step=1, steps=1, window_s=1.0, tokens_per_step=1,
+        model_flops_per_step=1e9)
+    assert rec['mfu'] == pytest.approx(0.5)
+
+
+def test_writer_spool_is_bounded(tmp_path):
+    spool = tmp_path / 'telem'
+    writer = train_telemetry.TelemetryWriter(str(spool), max_bytes=2000)
+    for step in range(200):
+        writer.emit({'step': step, 'pad': 'x' * 40})
+    live = os.path.join(str(spool), train_telemetry.SPOOL_FILE)
+    # Bounded: live file + one rotated generation, each under the cap.
+    assert os.path.getsize(live) <= 2100
+    assert os.path.getsize(live + '.1') <= 2100
+    records = train_telemetry.read_records(str(spool))
+    assert records[-1]['step'] == 199  # newest record always survives
+
+
+def test_reader_skips_torn_lines(tmp_path):
+    spool = tmp_path / 'telem'
+    train_telemetry.TelemetryWriter(str(spool)).emit({'step': 1})
+    path = os.path.join(str(spool), train_telemetry.SPOOL_FILE)
+    with open(path, 'a', encoding='utf-8') as f:
+        f.write('{"torn": tru')  # crash mid-append, line unterminated
+    # The NEXT writer (e.g. the relaunched trainer after a preemption)
+    # must not fuse its first record onto the torn line.
+    train_telemetry.TelemetryWriter(str(spool)).emit({'step': 2})
+    assert [r['step'] for r in train_telemetry.read_records(str(spool))] \
+        == [1, 2]
+
+
+def test_latest_window_for_cluster(tmp_path):
+    root = tmp_path / 'runtime'
+    old = root / 'jobs' / '3' / 'telemetry' / 'rank-0'
+    new = root / 'jobs' / '7' / 'telemetry' / 'rank-1'
+    train_telemetry.TelemetryWriter(str(old)).emit({'step': 5})
+    time.sleep(0.05)
+    train_telemetry.TelemetryWriter(str(new)).emit({'step': 9})
+    os.utime(os.path.join(str(new), train_telemetry.SPOOL_FILE))
+    window = train_telemetry.latest_window_for_cluster(str(root))
+    assert window['step'] == 9
+    assert window['job_id'] == 7
+    assert window['rank'] == 'rank-1'
+    assert train_telemetry.latest_window_for_cluster(
+        str(tmp_path / 'nothing')) is None
+
+
+# -- heartbeat ---------------------------------------------------------------
+
+
+def _make_cluster(name='hb-c1'):
+    global_user_state.add_or_update_cluster(
+        name, handle={}, status=global_user_state.ClusterStatus.UP)
+    return name
+
+
+def test_heartbeat_once_enriches_cluster_record(monkeypatch, tmp_path):
+    from skypilot_tpu.agent import daemon
+    name = _make_cluster()
+    rdir = tmp_path / 'runtime' / name
+    monkeypatch.setattr(daemon, '_runtime_dir', lambda _: str(rdir))
+    spool = rdir / 'jobs' / '1' / 'telemetry' / 'rank-0'
+    train_telemetry.TelemetryWriter(str(spool)).emit(
+        {'step': 42, 'tokens_per_s': 123.0, 'step_time_s': 0.5})
+    payload = daemon.heartbeat_once(name, interval_s=5.0)
+    assert payload['interval_s'] == 5.0
+    assert payload['host']['disk_free_gb'] > 0
+    assert isinstance(payload['host']['framework_procs'], int)
+    assert payload['train']['step'] == 42
+    assert payload['train']['job_id'] == 1
+    rec = global_user_state.get_cluster(name)
+    assert rec['last_heartbeat'] == pytest.approx(time.time(), abs=30)
+    assert rec['heartbeat']['train']['tokens_per_s'] == 123.0
+    # Cluster row gone (downed): heartbeat reports it instead of raising.
+    global_user_state.remove_cluster(name)
+    assert daemon.heartbeat_once(name) is None
+
+
+def test_status_flags_stale_heartbeat(monkeypatch, tmp_path):
+    from skypilot_tpu import core
+    from skypilot_tpu.agent import daemon
+    name = _make_cluster()
+    monkeypatch.setattr(daemon, '_runtime_dir',
+                        lambda _: str(tmp_path / 'rt'))
+    daemon.heartbeat_once(name, interval_s=5.0)
+    rows = {r['name']: r for r in core.status()}
+    assert rows[name]['heartbeat_age'] is not None
+    assert rows[name]['heartbeat_age'] < 30
+    assert not rows[name]['heartbeat_stale']
+    # Age the heartbeat past 3 intervals by rewriting last_heartbeat.
+    with global_user_state._lock(), global_user_state._conn() as conn:  # pylint: disable=protected-access
+        conn.execute(
+            'UPDATE clusters SET last_heartbeat = ? WHERE name = ?',
+            (time.time() - 60, name))
+    rows = {r['name']: r for r in core.status()}
+    assert rows[name]['heartbeat_stale']
+
+
+def test_cli_status_renders_heartbeat_column(monkeypatch, tmp_path):
+    from skypilot_tpu.agent import daemon
+    from skypilot_tpu.client import cli as cli_mod
+    name = _make_cluster()
+    monkeypatch.setattr(daemon, '_runtime_dir',
+                        lambda _: str(tmp_path / 'rt'))
+    daemon.heartbeat_once(name, interval_s=5.0)
+    result = CliRunner().invoke(cli_mod.cli, ['status'])
+    assert result.exit_code == 0, result.output
+    assert 'HEARTBEAT' in result.output
+    assert name in result.output
+    assert 'STALE' not in result.output
+    with global_user_state._lock(), global_user_state._conn() as conn:  # pylint: disable=protected-access
+        conn.execute(
+            'UPDATE clusters SET last_heartbeat = ? WHERE name = ?',
+            (time.time() - 600, name))
+    result = CliRunner().invoke(cli_mod.cli, ['status'])
+    assert 'STALE' in result.output
+
+
+# -- goodput CLI + metrics ---------------------------------------------------
+
+
+def _ledgered_job():
+    from skypilot_tpu.jobs import state
+    S = state.ManagedJobStatus
+    job_id = state.submit('telemetry-job', {'run': 'x'},
+                          recovery_strategy='FAILOVER')
+    for status in (S.SUBMITTED, S.STARTING, S.RUNNING,
+                   S.RECOVERING, S.RUNNING, S.SUCCEEDED):
+        state.set_status(job_id, status,
+                         detail='slice preempted (zone=us-z1)'
+                         if status == S.RECOVERING else '')
+    return job_id
+
+
+def test_cli_jobs_goodput(monkeypatch):
+    from skypilot_tpu.client import cli as cli_mod
+    job_id = _ledgered_job()
+    result = CliRunner().invoke(cli_mod.cli, ['jobs', 'goodput',
+                                              str(job_id)])
+    assert result.exit_code == 0, result.output
+    assert 'goodput' in result.output
+    assert 'recovering' in result.output
+    assert 'zone=us-z1' in result.output
+    assert 'badput' in result.output
+    result = CliRunner().invoke(cli_mod.cli, ['jobs', 'goodput', '99999'])
+    assert result.exit_code != 0
+    assert 'not found' in result.output
+
+
+def test_sdk_jobs_goodput_op_roundtrip():
+    """The server-side op the SDK verb schedules (request_runner)."""
+    from skypilot_tpu.server import request_runner
+    job_id = _ledgered_job()
+    out = request_runner._run_op(  # pylint: disable=protected-access
+        {'op': 'jobs_goodput', 'job_id': job_id})
+    assert out['job_id'] == job_id
+    assert out['closed'] and out['ledger']
+    assert out['badput_s'] >= 0
+
+
+def test_prometheus_goodput_and_train_gauges(monkeypatch, tmp_path):
+    from skypilot_tpu.agent import daemon
+    from skypilot_tpu.server import metrics
+    job_id = _ledgered_job()
+    name = _make_cluster('hb-metrics')
+    rdir = tmp_path / 'runtime-m'
+    monkeypatch.setattr(daemon, '_runtime_dir', lambda _: str(rdir))
+    spool = rdir / 'jobs' / '2' / 'telemetry' / 'rank-0'
+    monkeypatch.setenv('SKYTPU_PEAK_FLOPS', '1e9')
+    train_telemetry.TelemetryWriter(str(spool)).emit(
+        train_telemetry.window_record(
+            step=4, steps=2, window_s=1.0, tokens_per_step=100,
+            model_flops_per_step=2.5e8, loss=2.0))
+    daemon.heartbeat_once(name)
+    text = metrics.render().decode('utf-8')
+    assert f'skytpu_job_goodput_ratio{{job_id="{job_id}"}}' in text
+    assert (f'skytpu_job_phase_seconds{{job_id="{job_id}",'
+            'phase="recovering"}') in text
+    assert f'skytpu_train_tokens_per_s{{cluster="{name}"}} 200.0' in text
+    assert f'skytpu_train_step_seconds{{cluster="{name}"}} 0.5' in text
+    assert f'skytpu_train_mfu{{cluster="{name}"}} 0.5' in text
+    assert f'skytpu_cluster_heartbeat_age_seconds{{cluster="{name}"}}' \
+        in text
+    # Phase seconds of one job sum to its wall-clock.
+    from skypilot_tpu.jobs import state
+    rec = state.get(job_id)
+    wall = rec['ended_at'] - rec['submitted_at']
+    totals = state.phase_totals()[job_id]
+    assert sum(totals.values()) == pytest.approx(wall, abs=1e-6)
+
+
+def test_dashboard_fleet_view(monkeypatch, tmp_path):
+    from skypilot_tpu.agent import daemon
+    from skypilot_tpu.server import dashboard
+    job_id = _ledgered_job()
+    name = _make_cluster('hb-fleet')
+    monkeypatch.setattr(daemon, '_runtime_dir',
+                        lambda _: str(tmp_path / 'rt-f'))
+    daemon.heartbeat_once(name)
+    fleet = dashboard.fleet_view()
+    clusters = {c['name']: c for c in fleet['clusters']}
+    assert clusters[name]['heartbeat_age'] is not None
+    assert not clusters[name]['heartbeat_stale']
+    jobs = {j['job_id']: j for j in fleet['jobs']}
+    assert jobs[job_id]['goodput_ratio'] >= 0
+    assert 'recovering' in jobs[job_id]['phases']
+    detail = dashboard.job_detail(job_id)
+    assert detail['goodput']['closed']
+    assert any(r['phase'] == 'recovering' for r in detail['ledger'])
+    assert json.dumps(fleet)  # JSON-serializable end to end

@@ -106,13 +106,24 @@ def test_probe_backend_completes_on_cpu():
     assert any(p.startswith('devices-enumerated') for p in probe['phases'])
 
 
-def test_probe_backend_timeout_pins_phase():
-    probe = tpu_doctor.probe_backend(timeout_s=0.05)
+def test_probe_backend_timeout_pins_phase(monkeypatch, tmp_path):
+    """Timeout path, deterministically: the child is HELD at the
+    python-started phase via the injected hold-file gate, so the
+    assertion never races real jax import/compile speed (the old
+    timing flake: with timeout_s=0.05 a fast box could reach
+    first-compile-done inside the parent's post-timeout SIGUSR1
+    window)."""
+    gate = tmp_path / 'release-probe-child'
+    monkeypatch.setenv('SKYTPU_PROBE_HOLD_FILE', str(gate))
+    try:
+        probe = tpu_doctor.probe_backend(timeout_s=0.05)
+    finally:
+        gate.touch()  # release the detached child; it exits on its own
     assert not probe['ok']
     assert probe['outcome'] == 'timeout'
     assert probe['elapsed_s'] < 30
-    # Hung before the ladder finished; the diagnosis names the stage.
-    assert probe['last_phase'] in (None, 'python-started', 'jax-imported')
+    # Held before the ladder finished; the diagnosis names the stage.
+    assert probe['last_phase'] in (None, 'python-started')
     assert probe['diagnosis'] != 'completed'
 
 

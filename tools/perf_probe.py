@@ -24,6 +24,15 @@ prefill, decode, stream) are present, the TTFT/queue-wait histograms
 have non-empty buckets per class on the replica's /metrics — and that
 greedy output is byte-identical with tracing on vs off. Also wired
 into ``make verify``.
+
+``--goodput`` runs the training/fleet telemetry gate: (a) a tiny
+trainer run with the telemetry spool off then on — stdout must be
+byte-identical and the spool must hold one record per log window;
+(b) a fake-cloud managed job with one injected whole-slice preemption —
+the goodput phase ledger must be terminal-closed, monotonic, gap-free,
+sum to the job's wall-clock within 1%, contain a zone-annotated
+badput (recovering) interval, and yield a goodput ratio in (0, 1).
+Also wired into ``make verify``.
 """
 import json
 import os
@@ -275,7 +284,135 @@ def trace_smoke() -> dict:
                                             'p95_ttft_s')}}
 
 
+def _trainer_telemetry_parity(workdir: str) -> dict:
+    """Run the tiny trainer twice in subprocesses — spool env unset,
+    then set — and assert byte-identical stdout plus a filled spool."""
+    import subprocess
+
+    from skypilot_tpu.observability import train_telemetry
+
+    argv = [sys.executable, '-m', 'skypilot_tpu.train.run',
+            '--model', 'tiny', '--steps', '3', '--global-batch-size', '2',
+            '--seq-len', '16', '--log-every', '1']
+    env_off = dict(os.environ, JAX_PLATFORMS='cpu')
+    env_off.pop(train_telemetry.ENV_DIR, None)
+    r_off = subprocess.run(argv, env=env_off, capture_output=True,
+                           timeout=600)
+    assert r_off.returncode == 0, r_off.stderr[-2000:]
+    spool = os.path.join(workdir, 'telemetry-spool')
+    assert not os.path.exists(spool)  # the off-run must write NOTHING
+    env_on = dict(env_off)
+    env_on[train_telemetry.ENV_DIR] = spool
+    r_on = subprocess.run(argv, env=env_on, capture_output=True,
+                          timeout=600)
+    assert r_on.returncode == 0, r_on.stderr[-2000:]
+    assert r_on.stdout == r_off.stdout, (
+        'telemetry changed trainer stdout',
+        r_off.stdout[-500:], r_on.stdout[-500:])
+    records = train_telemetry.read_records(spool)
+    assert len(records) == 3, records  # --log-every 1 x 3 steps
+    for rec in records:
+        assert rec['step_time_s'] > 0 and rec['tokens_per_s'] > 0, rec
+        assert 'loss' in rec, rec
+    assert [r['step'] for r in records] == [1, 2, 3], records
+    return {'telemetry_records': len(records),
+            'stdout_bytes': len(r_on.stdout)}
+
+
+def goodput_probe() -> dict:
+    """Managed-job goodput ledger gate on the fake cloud: one injected
+    whole-slice preemption mid-run, then the ledger invariants the
+    operators' dashboards depend on."""
+    import tempfile
+    import threading
+    import time as time_lib
+
+    from skypilot_tpu.utils import tpu_doctor
+    tpu_doctor.session_fingerprint()  # daemons we spawn become reapable
+    workdir = tempfile.mkdtemp(prefix='skytpu-goodput-')
+    out = _trainer_telemetry_parity(workdir)
+
+    os.environ['SKYTPU_STATE_DIR'] = os.path.join(workdir, 'state')
+    os.environ['SKYTPU_ENABLE_FAKE_CLOUD'] = '1'
+    from skypilot_tpu import global_user_state
+    from skypilot_tpu.jobs import state as jobs_state
+    from skypilot_tpu.jobs.controller import JobController
+    from skypilot_tpu.provision.fake import instance as fake
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
+    fake.reset_state()
+
+    task = Task('goodput-probe', run='sleep 4; echo done')
+    task.set_resources(Resources(accelerators='tpu-v5e-8', cloud='fake',
+                                 use_spot=True))
+    job_id = jobs_state.submit('goodput-probe', task.to_yaml_config(),
+                               recovery_strategy='EAGER_FAILOVER')
+    jobs_state.set_status(job_id, jobs_state.ManagedJobStatus.SUBMITTED)
+    thread = threading.Thread(
+        target=lambda: JobController(job_id, poll_seconds=0.2).run(),
+        daemon=True)
+    thread.start()
+
+    def wait_status(targets, timeout):
+        deadline = time_lib.time() + timeout
+        while time_lib.time() < deadline:
+            rec = jobs_state.get(job_id)
+            if rec and rec['status'] in targets:
+                return rec
+            time_lib.sleep(0.1)
+        raise AssertionError(
+            f'job stuck at {jobs_state.get(job_id)["status"]}, '
+            f'events={jobs_state.events(job_id)}')
+
+    rec = wait_status({jobs_state.ManagedJobStatus.RUNNING}, 120)
+    cluster = global_user_state.get_cluster(rec['cluster_name'])
+    fake.preempt_cluster(cluster['handle']['cluster_name_on_cloud'])
+    rec = wait_status({jobs_state.ManagedJobStatus.SUCCEEDED}, 300)
+    thread.join(timeout=10)
+
+    # --- the ledger invariants ------------------------------------------
+    rows = jobs_state.phase_ledger(job_id)
+    assert rows, 'empty ledger'
+    assert all(r['ended_at'] is not None for r in rows), \
+        ('terminal job left an open phase', rows)
+    for r in rows:
+        assert r['ended_at'] >= r['started_at'], ('negative phase', r)
+    for a, b in zip(rows, rows[1:]):
+        assert abs(a['ended_at'] - b['started_at']) < 1e-6, \
+            ('gap/overlap between phases', a, b)
+    phases = [r['phase'] for r in rows]
+    assert 'running' in phases and 'recovering' in phases, phases
+    recovery_details = ' '.join(
+        r['detail'] for r in rows if r['phase'] == 'recovering')
+    assert 'preempted' in recovery_details, rows
+    assert ('zone=' in recovery_details
+            or 'region=' in recovery_details), rows
+    wall = rec['ended_at'] - rec['submitted_at']
+    total = sum(r['ended_at'] - r['started_at'] for r in rows)
+    assert abs(total - wall) <= max(0.01 * wall, 0.01), (total, wall)
+    summary = jobs_state.goodput_summary(job_id)
+    assert summary['closed'] and 0.0 < summary['goodput_ratio'] < 1.0, \
+        summary
+    assert summary['badput_s'] > 0 and summary['recoveries'] >= 1, summary
+
+    # Reap the cluster daemons our launches spawned (they also exit on
+    # their own once they notice the cluster record is gone).
+    tpu_doctor.reap_stray_processes()
+    return {**out, 'wall_s': round(wall, 2),
+            'goodput_ratio': summary['goodput_ratio'],
+            'badput_s': summary['badput_s'],
+            'phases': summary['phases'],
+            'recoveries': summary['recoveries']}
+
+
 def main():
+    if '--goodput' in sys.argv:
+        # CPU-only by design (same rationale as --smoke): never touch
+        # or wait on a chip in CI.
+        jax.config.update('jax_platforms', 'cpu')
+        print(json.dumps({'goodput_smoke': 'ok', **goodput_probe()}),
+              flush=True)
+        return
     if '--trace' in sys.argv:
         # CPU-only by design (same rationale as --smoke/--qos).
         jax.config.update('jax_platforms', 'cpu')
