@@ -23,14 +23,19 @@ ci:
 # (interactive bounded, batch absorbs 100% of sheds under 2x load),
 # the tracing gate (every sampled trace closes + nests, TTFT/queue-wait
 # histograms fill, greedy output byte-identical traced vs untraced),
-# and the goodput gate (trainer stdout byte-identical with telemetry
+# the goodput gate (trainer stdout byte-identical with telemetry
 # off vs on; managed-job phase ledger gap-free and summing to
-# wall-clock across an injected preemption).
+# wall-clock across an injected preemption), and the checkpoint gate
+# (sync/async loss trajectory byte-identical with async step-loop
+# stall < 50% of the sync save wall-time; kill -9 mid-commit resumes
+# from the last committed checksum-valid step; managed-job ledger and
+# skytpu_ckpt_* gauges carry nonzero save+restore accounting).
 verify:
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --smoke
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --qos
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --trace
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --goodput
+	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --ckpt
 
 lint:
 	$(PY) tools/lint.py

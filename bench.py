@@ -308,6 +308,59 @@ def qos_overload_probe(assert_gates: bool = False) -> dict:
     return summary
 
 
+def ckpt_stall_probe(assert_gates: bool = False) -> dict:
+    """Checkpoint-stall A/B (skypilot_tpu/ckpt/): per-save step-loop
+    stall, synchronous persist vs async snapshot+background commit, on
+    a tiny real param tree. The async stall should be the device->host
+    copy alone — an order of magnitude under the sync write+fsync on
+    any backend; the ratio is the BENCH artifact's 'checkpoint_stall'
+    entry and (with ``assert_gates``) the perf_probe --ckpt bound of
+    50% is enforced by the probe's subprocess variant instead (this
+    in-process probe drains between saves, so it isolates the snapshot
+    cost from back-pressure)."""
+    import shutil
+    import statistics
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.ckpt.manager import AsyncCheckpointManager
+    from skypilot_tpu.models import llama
+
+    params = llama.init_params(jax.random.PRNGKey(0), llama.TINY)
+    state = {'step': jnp.zeros((), jnp.int32), 'params': params}
+    stalls = {}
+    dirs = []
+    try:
+        for mode in ('sync', 'async'):
+            d = tempfile.mkdtemp(prefix=f'skytpu-bench-ck-{mode}-')
+            dirs.append(d)
+            mgr = AsyncCheckpointManager(
+                d, save_interval_steps=1, async_save=(mode == 'async'),
+                telemetry=None)
+            samples = []
+            for step in range(1, 9):
+                t0 = time.perf_counter()
+                mgr.save(step, state, force=True)
+                samples.append(time.perf_counter() - t0)
+                # Drain between saves: measure the snapshot cost, not
+                # back-pressure (the probe's trainer-subprocess variant
+                # covers the loaded case).
+                mgr.wait_until_finished()
+            mgr.close()
+            stalls[mode] = statistics.median(samples[1:])
+    finally:
+        for d in dirs:
+            shutil.rmtree(d, ignore_errors=True)
+    out = {'sync_save_ms_p50': round(stalls['sync'] * 1e3, 3),
+           'async_stall_ms_p50': round(stalls['async'] * 1e3, 3),
+           'stall_ratio': round(stalls['async'] / stalls['sync'], 4)}
+    if assert_gates:
+        assert out['stall_ratio'] < 0.5, out
+    return out
+
+
 def _measure_provision_to_first_step() -> float:
     """Launch a task on the local provider; time launch-call -> first run
     output. Exercises provision + runtime bootstrap + gang exec for real."""
@@ -528,6 +581,13 @@ def _bench_tpu() -> dict:
     except Exception as exc:  # secondary metric: never kill the bench
         qos_overload = {'error': f'{type(exc).__name__}: '
                                  f'{str(exc)[:160]}'}
+    try:
+        # Checkpoint-stall A/B: what the step loop pays per save, sync
+        # persist vs async snapshot (skypilot_tpu/ckpt/).
+        checkpoint_stall = ckpt_stall_probe()
+    except Exception as exc:  # secondary metric: never kill the bench
+        checkpoint_stall = {'error': f'{type(exc).__name__}: '
+                                     f'{str(exc)[:160]}'}
 
     baseline_tflops_per_chip = 23.48  # reference recipe, see module docstring
     n_chips = jax.device_count()
@@ -561,6 +621,7 @@ def _bench_tpu() -> dict:
             'decode_tokens_per_sec': decode_tps,
             'decode_variants': decode_variants,
             'qos_overload': qos_overload,
+            'checkpoint_stall': checkpoint_stall,
             'cpu_fallback': not on_tpu,
         },
     }
@@ -614,7 +675,7 @@ def finalize_result(result: dict, diagnostics: dict | None = None,
     # Progressive offload: if the line is still too big, move the
     # largest optional detail blocks to the sidecar, biggest first.
     for key in ('sweep', 'qos_overload', 'decode_variants',
-                'probe_diagnostics'):
+                'checkpoint_stall', 'probe_diagnostics'):
         if len(line.encode()) <= MAX_ARTIFACT_BYTES:
             break
         if key in detail and detail[key] is not None:

@@ -141,11 +141,16 @@ def heartbeat_once(cluster_name: str,
     except Exception:  # noqa: BLE001 — job table may not exist yet
         pass
     try:
+        # One pass over the spools yields both the newest training
+        # window and the cumulative checkpoint accounting (the latter
+        # surfaces as skytpu_ckpt_* gauges at metrics scrape time).
         from skypilot_tpu.observability import train_telemetry
-        window = train_telemetry.latest_window_for_cluster(
+        summary = train_telemetry.cluster_telemetry_summary(
             _runtime_dir(cluster_name))
-        if window is not None:
-            payload['train'] = window
+        if summary['train'] is not None:
+            payload['train'] = summary['train']
+        if summary['ckpt'] is not None:
+            payload['ckpt'] = summary['ckpt']
     except Exception:  # noqa: BLE001 — telemetry spool is optional
         pass
     try:

@@ -97,16 +97,36 @@ def _register_proc(proc) -> None:
     _live_procs.append(proc)
 
 
-def _kill_workers(signum=None, frame=None) -> None:
-    del frame
+def _signal_procs(sig: int) -> None:
     for proc in _live_procs:
         try:
-            os.killpg(proc.pid, signal.SIGTERM)
+            os.killpg(proc.pid, sig)
         except (ProcessLookupError, PermissionError):
             try:
-                proc.terminate()
-            except ProcessLookupError:
+                proc.send_signal(sig)
+            except (ProcessLookupError, OSError):
                 pass
+
+
+def _kill_workers(signum=None, frame=None) -> None:
+    del frame
+    _signal_procs(signal.SIGTERM)
+    # Grace window before escalating to SIGKILL: a trainer that catches
+    # SIGTERM uses it to persist its freshest checkpoint snapshot
+    # (train/run.py preemption hook -> ckpt.manager.emergency_persist)
+    # — host-side file writes only, so seconds suffice. The escalation
+    # bounds cancel latency: a wedged rank can never hold the slice.
+    import time as _time
+    try:
+        grace = float(os.environ.get('SKYTPU_TERM_GRACE_S', '10'))
+    except ValueError:
+        grace = 10.0
+    deadline = _time.time() + grace
+    for proc in _live_procs:
+        while proc.poll() is None and _time.time() < deadline:
+            _time.sleep(0.1)
+    if any(proc.poll() is None for proc in _live_procs):
+        _signal_procs(signal.SIGKILL)
     if signum is not None:
         sys.exit(143)
 

@@ -22,31 +22,76 @@ def _ssh_dir() -> str:
                      'ssh'))
 
 
+def _generate_with_cryptography(priv: str, pub: str) -> None:
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import ed25519
+
+    key = ed25519.Ed25519PrivateKey.generate()
+    priv_bytes = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.OpenSSH,
+        serialization.NoEncryption())
+    pub_bytes = key.public_key().public_bytes(
+        serialization.Encoding.OpenSSH,
+        serialization.PublicFormat.OpenSSH)
+    fd = os.open(priv, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, 'wb') as f:
+        f.write(priv_bytes)
+    with open(pub, 'wb') as f:
+        f.write(pub_bytes + b' skypilot-tpu\n')
+
+
+def _generate_with_ssh_keygen(priv: str) -> None:
+    import subprocess
+    # A half-written pair (crash between priv and pub writes) would make
+    # ssh-keygen block on its interactive overwrite prompt: clear first,
+    # and close stdin so no prompt can ever hang a headless run.
+    for path in (priv, priv + '.pub'):
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
+    subprocess.run(
+        ['ssh-keygen', '-t', 'ed25519', '-N', '', '-q', '-f', priv,
+         '-C', 'skypilot-tpu'],
+        check=True, capture_output=True, stdin=subprocess.DEVNULL)
+
+
+def keypair_backend_available() -> bool:
+    """True when SSH keypair generation can work here: either the
+    ``cryptography`` package or the ``ssh-keygen`` binary. Tests that
+    exercise the lazy import below skip (not error) when neither is
+    present."""
+    try:
+        import cryptography  # noqa: F401
+        return True
+    except ImportError:
+        import shutil
+        return shutil.which('ssh-keygen') is not None
+
+
 def get_or_create_ssh_keypair() -> Tuple[str, str]:
     """Returns (private_key_path, public_key_line). Generates an ed25519
-    keypair (OpenSSH formats, pure python — no ssh-keygen binary needed) on
-    first use; idempotent afterwards."""
+    keypair (OpenSSH formats) on first use; idempotent afterwards.
+    Prefers the pure-python ``cryptography`` backend; environments
+    without it (minimal CI images) fall back to the ``ssh-keygen``
+    binary — same key type, same file layout."""
     ssh_dir = _ssh_dir()
     priv = os.path.join(ssh_dir, KEY_NAME)
     pub = priv + '.pub'
     if not (os.path.exists(priv) and os.path.exists(pub)):
-        from cryptography.hazmat.primitives import serialization
-        from cryptography.hazmat.primitives.asymmetric import ed25519
-
         os.makedirs(ssh_dir, mode=0o700, exist_ok=True)
-        key = ed25519.Ed25519PrivateKey.generate()
-        priv_bytes = key.private_bytes(
-            serialization.Encoding.PEM,
-            serialization.PrivateFormat.OpenSSH,
-            serialization.NoEncryption())
-        pub_bytes = key.public_key().public_bytes(
-            serialization.Encoding.OpenSSH,
-            serialization.PublicFormat.OpenSSH)
-        fd = os.open(priv, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
-        with os.fdopen(fd, 'wb') as f:
-            f.write(priv_bytes)
-        with open(pub, 'wb') as f:
-            f.write(pub_bytes + b' skypilot-tpu\n')
+        try:
+            _generate_with_cryptography(priv, pub)
+        except ImportError:
+            try:
+                _generate_with_ssh_keygen(priv)
+            except Exception as e:  # noqa: BLE001 — missing binary etc.
+                raise RuntimeError(
+                    'cannot generate an SSH keypair: the cryptography '
+                    'package is not installed and the ssh-keygen '
+                    f'fallback failed ({e!r}); install cryptography or '
+                    'fix ssh-keygen') from e
     with open(pub, encoding='utf-8') as f:
         pub_line = f.read().strip()
     return priv, pub_line

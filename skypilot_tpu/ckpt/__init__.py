@@ -1,0 +1,32 @@
+"""Crash-consistent checkpointing: snapshot -> commit -> mirror.
+
+The resilience backbone behind the managed-jobs recovery contract
+(PAPER §5): the trainer saves asynchronously (the step loop blocks only
+for the device->host snapshot), a background committer writes
+checksummed shard+manifest step directories with atomic-rename /
+commit-marker durability, and an optional mirror stage replicates
+committed steps from fast local staging into the mounted bucket.
+Restore validates checksums, skips torn steps, and falls back to the
+previous durable one.
+
+Layering: ``manifest`` (read side + file format, numpy/stdlib only — the
+``stpu ckpt`` CLI imports just this), ``committer``/``mirror`` (write
+side, numpy/stdlib), ``snapshot``/``manager`` (jax-facing orchestration).
+``train/checkpoint.py`` keeps the historical API as a facade over this
+package; orbax remains a compat reader/codec there.
+"""
+from skypilot_tpu.ckpt.manager import (AsyncCheckpointManager,
+                                       CheckpointError, live_manager,
+                                       oneshot_save)
+from skypilot_tpu.ckpt.manifest import (committed_steps, partial_dirs,
+                                        verify_step)
+
+__all__ = [
+    'AsyncCheckpointManager',
+    'CheckpointError',
+    'committed_steps',
+    'live_manager',
+    'oneshot_save',
+    'partial_dirs',
+    'verify_step',
+]

@@ -1,0 +1,292 @@
+"""Checkpoint on-disk format: shard files + checksummed JSON manifests.
+
+Layout of one committed step (the native ``skytpu-ckpt/1`` format)::
+
+    <root>/step_00000040/
+        shard-h0000.bin        per-host raw array bytes, concatenated
+        manifest-h0000.json    that host's array table (shape/dtype/
+                               offset/nbytes/crc32 per array)
+        MANIFEST.json          aggregate: step, num_hosts, format
+        COMMIT                 commit marker — written LAST
+
+Durability protocol (write side lives in ``committer.py``/``mirror.py``):
+on a POSIX filesystem the step is assembled in ``step_N.tmp`` and
+atomically renamed, so a final-named dir is complete by construction.
+On fuse-mounted object stores (the bucket mirror) a directory rename is
+NOT atomic (gcsfuse/rclone rewrite it object-by-object), so there the
+files are uploaded in place and the ``COMMIT`` marker — written last —
+is the commit point. Readers therefore require BOTH: a final-named dir
+AND its marker. Anything else (a ``.tmp`` dir, a marker-less dir, a
+manifest that fails its checksum) is a torn write to skip and GC.
+
+This module is the READ side plus the shared file helpers; it imports
+only the stdlib and numpy (ml_dtypes lazily, for bf16/fp8 arrays) so the
+``stpu ckpt`` CLI can inspect checkpoints without dragging in jax.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+FORMAT = 'skytpu-ckpt/1'
+MANIFEST_FILE = 'MANIFEST.json'
+COMMIT_FILE = 'COMMIT'
+TMP_SUFFIX = '.tmp'
+_STEP_RE = re.compile(r'^step_(\d{8})$')
+
+
+class CheckpointError(Exception):
+    """A checkpoint directory failed validation. The message names the
+    step dir and the first failing check so operators can GC or debug
+    it."""
+
+
+class CorruptionError(CheckpointError):
+    """The on-disk BYTES are bad (torn write, truncation, checksum
+    mismatch, unreadable manifest) — safe to quarantine/GC the step.
+    Distinct from layout mismatches (state shape/dtype/key drift),
+    which describe a perfectly good checkpoint the CALLER cannot load:
+    deleting those would turn a recoverable config error into data
+    loss."""
+
+
+def step_dirname(step: int) -> str:
+    return f'step_{step:08d}'
+
+
+def parse_step_dirname(name: str) -> Optional[int]:
+    m = _STEP_RE.match(name)
+    return int(m.group(1)) if m else None
+
+
+def shard_name(host: int) -> str:
+    return f'shard-h{host:04d}.bin'
+
+
+def host_manifest_name(host: int) -> str:
+    return f'manifest-h{host:04d}.json'
+
+
+def resolve_dtype(name: str) -> np.dtype:
+    """np.dtype from its saved name; jax's extension dtypes (bfloat16,
+    float8_*) resolve through ml_dtypes, which ships with jax."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        pass
+    try:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+    except (ImportError, AttributeError) as e:
+        raise CheckpointError(f'cannot resolve dtype {name!r}: {e}') from e
+
+
+def fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # fuse mounts may refuse O_RDONLY on dirs; best-effort
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def write_json(path: str, obj: Dict[str, Any]) -> None:
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump(obj, f, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def write_host_files(step_dir: str, host: int,
+                     named_arrays: Sequence[Tuple[str, np.ndarray]],
+                     ) -> Dict[str, Any]:
+    """Write one host's shard + manifest into ``step_dir`` (fsynced).
+    Returns the host manifest dict."""
+    shard_path = os.path.join(step_dir, shard_name(host))
+    entries: List[Dict[str, Any]] = []
+    offset = 0
+    with open(shard_path, 'wb') as f:
+        for name, arr in named_arrays:
+            # NOT ascontiguousarray: that promotes 0-d scalars to 1-d,
+            # corrupting the shape table. tobytes() already emits C order.
+            arr = np.asarray(arr)
+            raw = arr.tobytes()
+            f.write(raw)
+            entries.append({
+                'name': name,
+                'shape': list(arr.shape),
+                'dtype': str(arr.dtype),
+                'offset': offset,
+                'nbytes': len(raw),
+                'crc32': zlib.crc32(raw) & 0xFFFFFFFF,
+            })
+            offset += len(raw)
+        f.flush()
+        os.fsync(f.fileno())
+    manifest = {
+        'format': FORMAT,
+        'host': host,
+        'shard': shard_name(host),
+        'shard_nbytes': offset,
+        'arrays': entries,
+    }
+    write_json(os.path.join(step_dir, host_manifest_name(host)), manifest)
+    return manifest
+
+
+def read_json(path: str) -> Dict[str, Any]:
+    try:
+        with open(path, encoding='utf-8') as f:
+            obj = json.load(f)
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise CorruptionError(f'{path}: unreadable manifest: {e}') from e
+    if not isinstance(obj, dict):
+        raise CorruptionError(f'{path}: manifest is not a JSON object')
+    return obj
+
+
+def read_manifest(step_dir: str) -> Dict[str, Any]:
+    m = read_json(os.path.join(step_dir, MANIFEST_FILE))
+    if m.get('format') != FORMAT:
+        raise CheckpointError(
+            f'{step_dir}: unknown checkpoint format {m.get("format")!r} '
+            f'(expected {FORMAT})')
+    return m
+
+
+def is_committed(step_dir: str) -> bool:
+    return (parse_step_dirname(os.path.basename(step_dir)) is not None
+            and os.path.exists(os.path.join(step_dir, COMMIT_FILE))
+            and os.path.exists(os.path.join(step_dir, MANIFEST_FILE)))
+
+
+def committed_steps(root: str) -> List[Tuple[int, str]]:
+    """(step, path) for every committed step under ``root``, ascending.
+    Marker-less or ``.tmp`` dirs are invisible by design — they are torn
+    writes (kill mid-commit, partial mirror upload)."""
+    out = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return []
+    for name in names:
+        step = parse_step_dirname(name)
+        path = os.path.join(root, name)
+        if step is not None and is_committed(path):
+            out.append((step, path))
+    return sorted(out)
+
+
+def partial_dirs(root: str) -> List[str]:
+    """Torn-write debris under ``root``: ``.tmp`` dirs and final-named
+    dirs missing their commit marker. GC candidates."""
+    out = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return []
+    for name in names:
+        path = os.path.join(root, name)
+        if not os.path.isdir(path):
+            continue
+        if name.endswith(TMP_SUFFIX) and \
+                parse_step_dirname(name[:-len(TMP_SUFFIX)]) is not None:
+            out.append(path)
+        elif parse_step_dirname(name) is not None and not is_committed(path):
+            out.append(path)
+    return sorted(out)
+
+
+def load_host_arrays(step_dir: str, host: int,
+                     verify: bool = True) -> Dict[str, np.ndarray]:
+    """Read one host's arrays, checksum-verified. Raises CheckpointError
+    on a truncated shard or any crc32 mismatch — a torn or bit-rotted
+    write must never restore silently."""
+    manifest = read_json(os.path.join(step_dir, host_manifest_name(host)))
+    shard_path = os.path.join(step_dir, manifest['shard'])
+    try:
+        size = os.path.getsize(shard_path)
+    except OSError as e:
+        raise CorruptionError(f'{step_dir}: missing shard '
+                              f'{manifest["shard"]}: {e}') from e
+    if size != manifest['shard_nbytes']:
+        raise CorruptionError(
+            f'{step_dir}: truncated shard {manifest["shard"]}: '
+            f'{size} bytes on disk, manifest says '
+            f'{manifest["shard_nbytes"]}')
+    out: Dict[str, np.ndarray] = {}
+    with open(shard_path, 'rb') as f:
+        for entry in manifest['arrays']:
+            f.seek(entry['offset'])
+            raw = f.read(entry['nbytes'])
+            if len(raw) != entry['nbytes']:
+                raise CorruptionError(
+                    f'{step_dir}: short read for {entry["name"]!r}')
+            if verify and (zlib.crc32(raw) & 0xFFFFFFFF) != entry['crc32']:
+                raise CorruptionError(
+                    f'{step_dir}: checksum mismatch for {entry["name"]!r} '
+                    f'in {manifest["shard"]} — corrupt or torn write')
+            arr = np.frombuffer(raw, dtype=resolve_dtype(entry['dtype']))
+            out[entry['name']] = arr.reshape(entry['shape'])
+    return out
+
+
+def verify_step(step_dir: str, deep: bool = True) -> Dict[str, Any]:
+    """Validate one step dir; never raises. ``deep`` re-reads every
+    array and checks its crc32 (the restore-path check); shallow only
+    validates manifests + shard sizes."""
+    report: Dict[str, Any] = {
+        'path': step_dir,
+        'step': parse_step_dirname(os.path.basename(step_dir)),
+        'committed': is_committed(step_dir),
+        'hosts': 0, 'arrays': 0, 'nbytes': 0,
+        'ok': False, 'errors': [],
+    }
+    if not report['committed']:
+        report['errors'].append(
+            'uncommitted (missing COMMIT marker or MANIFEST.json)')
+        return report
+    try:
+        top = read_manifest(step_dir)
+        num_hosts = int(top.get('num_hosts', 1))
+        if top.get('step') != report['step']:
+            raise CheckpointError(
+                f'{step_dir}: manifest step {top.get("step")} does not '
+                f'match directory name')
+        report['hosts'] = num_hosts
+        for host in range(num_hosts):
+            hm = read_json(os.path.join(step_dir,
+                                        host_manifest_name(host)))
+            shard_path = os.path.join(step_dir, hm['shard'])
+            size = os.path.getsize(shard_path)
+            if size != hm['shard_nbytes']:
+                raise CheckpointError(
+                    f'{step_dir}: truncated shard {hm["shard"]}: {size} '
+                    f'!= {hm["shard_nbytes"]}')
+            report['arrays'] += len(hm['arrays'])
+            report['nbytes'] += hm['shard_nbytes']
+            if deep:
+                load_host_arrays(step_dir, host, verify=True)
+    except (CheckpointError, OSError, KeyError, TypeError,
+            ValueError) as e:
+        report['errors'].append(str(e))
+        return report
+    report['ok'] = True
+    return report

@@ -1,0 +1,64 @@
+"""Snapshot stage: device -> host copy of the train state.
+
+The ONLY part of a save the step loop ever waits for. ``take`` flattens
+the state pytree with path-derived names (stable across identical
+configs — restore looks arrays up by these names against the caller's
+abstract state) and ``jax.device_get``s every leaf into host numpy
+arrays. Donated-buffer safe: the trainer's jitted step donates its
+input state, so the host copy must complete before the next step may
+reuse those buffers — which is exactly the blocking transfer here.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Tuple
+
+import numpy as np
+
+from skypilot_tpu.ckpt.manifest import CheckpointError
+
+
+def flatten_named(tree: Any) -> Tuple[List[Tuple[str, Any]], Any]:
+    """[(name, leaf)] + treedef; names are jax keystr paths, e.g.
+    ``['params']['layers']['wq']`` or ``['opt_state'][1][0].count``."""
+    import jax
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat], \
+        treedef
+
+
+@dataclasses.dataclass
+class Snapshot:
+    step: int
+    arrays: List[Tuple[str, np.ndarray]]
+    nbytes: int
+    # Step-loop stall the save cost (transfer + back-pressure wait);
+    # filled by the manager, reported via checkpoint telemetry.
+    stall_s: float = 0.0
+
+
+def take(step: int, state: Any) -> Snapshot:
+    """NOTE on multi-host scope: each host snapshots its FULL view, so
+    the per-host shard files hold replicated copies — correct for
+    host-replicated state (data-parallel across slices), and the commit
+    barrier still guards against partial-gang death. State that is
+    sharded ACROSS hosts is not fully addressable here; partitioned
+    per-host shards (addressable-shard extraction + index-aware
+    reassembly) are future work, so fail with an actionable error
+    instead of jax's opaque span-non-addressable RuntimeError."""
+    import jax
+    named, _ = flatten_named(state)
+    for name, leaf in named:
+        if not getattr(leaf, 'is_fully_addressable', True):
+            raise CheckpointError(
+                f'cannot snapshot {name!r}: array is sharded across '
+                'hosts (not fully addressable). The native checkpoint '
+                "path currently supports host-replicated state only — "
+                "use codec='orbax' (train/checkpoint.py) for cross-host "
+                'sharded arrays.')
+    arrays = [(name, np.asarray(jax.device_get(leaf)))
+              for name, leaf in named]
+    return Snapshot(
+        step=int(step),
+        arrays=arrays,
+        nbytes=sum(a.nbytes for _, a in arrays))

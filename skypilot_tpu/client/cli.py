@@ -423,6 +423,13 @@ def jobs_goodput(job_id):
                                            ('badput', g['badput_s']),
                                            ('overhead', g['overhead_s']))]
     click.echo('Totals: ' + '  '.join(totals))
+    ck = g.get('ckpt')
+    if ck:
+        click.echo(f"Checkpointing: {ck['saves']} save(s) "
+                   f"{ck['save_s']:.1f}s persisted / "
+                   f"{ck['stall_s']:.1f}s step-loop stall, "
+                   f"{ck['restores']} restore(s) {ck['restore_s']:.1f}s, "
+                   f"last durable step {ck['last_step']}")
 
 
 @jobs_group.command('cancel')
@@ -739,6 +746,103 @@ def storage_cp(src, dst):
         click.echo(f'Uploaded {src} -> {dst}.')
     else:
         raise click.UsageError('At least one side must be a bucket URI.')
+
+
+@cli.group('ckpt')
+def ckpt_group():
+    """Inspect native checkpoint directories (skypilot_tpu/ckpt/
+    format: checksummed shard+manifest step dirs with commit markers).
+    Works on any local path or mounted bucket dir — no server, no jax."""
+
+
+def _ckpt_rows(directory):
+    from skypilot_tpu.ckpt import manifest as manifest_lib
+    rows = []
+    for step, path in manifest_lib.committed_steps(directory):
+        rows.append((step, path, True))
+    for path in manifest_lib.partial_dirs(directory):
+        name = os.path.basename(path)
+        if name.endswith(manifest_lib.TMP_SUFFIX):
+            name = name[:-len(manifest_lib.TMP_SUFFIX)]
+        step = manifest_lib.parse_step_dirname(name)
+        rows.append((step if step is not None else -1, path, False))
+    return sorted(rows)
+
+
+@ckpt_group.command('ls')
+@click.argument('directory', type=click.Path(exists=True, file_okay=False))
+@_clean_errors
+def ckpt_ls(directory):
+    """List checkpoint steps: committed ones plus torn-write debris
+    (uncommitted/.tmp dirs a crash or partial mirror upload left)."""
+    import time as time_lib
+
+    from skypilot_tpu.ckpt import manifest as manifest_lib
+    rows = []
+    for step, path, committed in _ckpt_rows(directory):
+        row = {'step': step, 'state': 'committed' if committed
+               else 'PARTIAL', 'hosts': '-', 'arrays': '-', 'mb': '-',
+               'age': '-'}
+        if committed:
+            report = manifest_lib.verify_step(path, deep=False)
+            row.update(hosts=report['hosts'], arrays=report['arrays'],
+                       mb=f"{report['nbytes'] / 1e6:.1f}")
+            if not report['ok']:
+                # Shallow validation (manifests + shard sizes) already
+                # failed: restore would skip this step — say so here,
+                # not only in `ckpt verify`.
+                row['state'] = 'CORRUPT'
+            else:
+                try:
+                    top = manifest_lib.read_manifest(path)
+                    row['age'] = \
+                        f"{int(time_lib.time() - top.get('ts', 0))}s"
+                except manifest_lib.CheckpointError:
+                    row['state'] = 'CORRUPT'
+        rows.append(row)
+    _echo_table(rows, [('step', 'STEP'), ('state', 'STATE'),
+                       ('hosts', 'HOSTS'), ('arrays', 'ARRAYS'),
+                       ('mb', 'MB'), ('age', 'AGE')])
+
+
+@ckpt_group.command('verify')
+@click.argument('directory', type=click.Path(exists=True, file_okay=False))
+@click.option('--step', type=int, default=None,
+              help='Verify one step only (default: every committed step).')
+@click.option('--shallow', is_flag=True, default=False,
+              help='Manifest + shard-size checks only; skip the '
+                   'per-array checksum re-read.')
+@_clean_errors
+def ckpt_verify(directory, step, shallow):
+    """Checksum-verify committed steps — the same validation restore
+    runs. Exit 1 if any verified step is corrupt (restore would skip it
+    and fall back to the previous durable step)."""
+    from skypilot_tpu.ckpt import manifest as manifest_lib
+    targets = [(s, p) for s, p in manifest_lib.committed_steps(directory)
+               if step is None or s == step]
+    if not targets:
+        raise click.ClickException(
+            f'no committed step{f" {step}" if step is not None else "s"} '
+            f'under {directory}')
+    bad = 0
+    for s, path in targets:
+        report = manifest_lib.verify_step(path, deep=not shallow)
+        if report['ok']:
+            click.echo(f"step {s}: OK ({report['hosts']} host(s), "
+                       f"{report['arrays']} arrays, "
+                       f"{report['nbytes'] / 1e6:.1f} MB)")
+        else:
+            bad += 1
+            click.echo(click.style(
+                f"step {s}: CORRUPT — {'; '.join(report['errors'])}",
+                fg='red'))
+    partials = manifest_lib.partial_dirs(directory)
+    if partials:
+        click.echo(f'{len(partials)} partial dir(s) (torn writes, '
+                   f'invisible to restore): '
+                   + ', '.join(os.path.basename(p) for p in partials))
+    if bad:
+        sys.exit(1)
 
 
 @cli.group('volumes')

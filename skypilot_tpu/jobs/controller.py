@@ -45,6 +45,24 @@ class JobController:
             job_id=job_id)
         self.max_restarts_on_errors = record['max_restarts_on_errors']
 
+    def _annotate_ckpt(self) -> None:
+        """Stamp the cluster's cumulative checkpoint accounting (from the
+        trainer telemetry spools) onto the OPEN ledger phase, called just
+        before a transition closes it — so goodput_summary can attribute
+        checkpoint overhead (and the async save's stall-vs-save delta)
+        per running interval. Best-effort: a job with no trainer
+        telemetry simply gets no note."""
+        try:
+            from skypilot_tpu.backends.tpu_gang_backend import runtime_dir
+            from skypilot_tpu.observability import train_telemetry
+            totals = train_telemetry.ckpt_totals_for_cluster(
+                runtime_dir(self.cluster_name))
+        except Exception:  # noqa: BLE001 — runtime dir may already be gone
+            return
+        if totals is None:
+            return
+        state.annotate_phase(self.job_id, state.format_ckpt_note(totals))
+
     def _location_detail(self) -> str:
         """Where the (possibly just-preempted) cluster lived — stamped on
         the goodput ledger's badput interval so post-mortems can name the
@@ -161,6 +179,7 @@ class JobController:
                     is not None and not self._cluster_is_healthy():
                 # The slice died while the controller was down: straight to
                 # the recovery path (terminate remnants, relaunch).
+                self._annotate_ckpt()
                 state.bump_recovery_count(job_id)
                 state.set_status(
                     job_id, state.ManagedJobStatus.RECOVERING,
@@ -198,6 +217,7 @@ class JobController:
             if agent_status is not None and \
                     job_lib.JobStatus(agent_status).is_terminal():
                 if agent_status == 'SUCCEEDED':
+                    self._annotate_ckpt()
                     self._teardown()
                     state.set_status(job_id, state.ManagedJobStatus.SUCCEEDED)
                     return state.ManagedJobStatus.SUCCEEDED
@@ -206,6 +226,7 @@ class JobController:
                     # (reference ``should_restart_on_failure :592``).
                     if failure_restarts < self.max_restarts_on_errors:
                         failure_restarts += 1
+                        self._annotate_ckpt()
                         state.bump_recovery_count(job_id)
                         state.set_status(
                             job_id, state.ManagedJobStatus.RECOVERING,
@@ -214,6 +235,7 @@ class JobController:
                         state.set_status(job_id,
                                          state.ManagedJobStatus.RUNNING)
                         continue
+                    self._annotate_ckpt()
                     self._teardown()
                     final = (state.ManagedJobStatus.FAILED_SETUP
                              if agent_status == 'FAILED_SETUP'
@@ -227,6 +249,7 @@ class JobController:
 
             if not self._cluster_is_healthy():
                 # Whole-slice preemption (or external deletion): recover.
+                self._annotate_ckpt()
                 state.bump_recovery_count(job_id)
                 state.set_status(job_id, state.ManagedJobStatus.RECOVERING,
                                  detail='slice preempted'

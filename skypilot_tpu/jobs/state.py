@@ -10,6 +10,7 @@ from __future__ import annotations
 import enum
 import json
 import os
+import re
 import sqlite3
 import time
 from typing import Any, Dict, List, Optional
@@ -438,6 +439,46 @@ def annotate_phase(job_id: int, note: str) -> None:
                      (detail, row['id']))
 
 
+# Checkpoint-overhead annotation the controller stamps onto a phase
+# just before closing it (jobs/controller.py _annotate_ckpt): one
+# incarnation's cumulative ckpt accounting, parsed back out by
+# goodput_summary so the ledger can answer "how much of this job's
+# wall-clock went to checkpointing, and what did async save of it".
+CKPT_NOTE_RE = re.compile(
+    r'ckpt\[saves=(\d+) save_s=([\d.]+) stall_s=([\d.]+) '
+    r'restores=(\d+) restore_s=([\d.]+) last_step=(\d+)\]')
+
+
+def format_ckpt_note(totals: Dict[str, Any]) -> str:
+    return ('ckpt[saves=%d save_s=%.3f stall_s=%.3f restores=%d '
+            'restore_s=%.3f last_step=%d]' % (
+                totals.get('saves', 0), totals.get('save_s', 0.0),
+                totals.get('stall_s', 0.0), totals.get('restores', 0),
+                totals.get('restore_s', 0.0), totals.get('last_step', 0)))
+
+
+def _ckpt_from_details(details: List[str]) -> Optional[Dict[str, Any]]:
+    """Sum per-incarnation ckpt notes (each note is cumulative WITHIN
+    its incarnation; incarnations are disjoint, so notes add)."""
+    out = {'saves': 0, 'save_s': 0.0, 'stall_s': 0.0,
+           'restores': 0, 'restore_s': 0.0, 'last_step': 0}
+    found = False
+    for detail in details:
+        for m in CKPT_NOTE_RE.finditer(detail or ''):
+            found = True
+            out['saves'] += int(m.group(1))
+            out['save_s'] += float(m.group(2))
+            out['stall_s'] += float(m.group(3))
+            out['restores'] += int(m.group(4))
+            out['restore_s'] += float(m.group(5))
+            out['last_step'] = max(out['last_step'], int(m.group(6)))
+    if not found:
+        return None
+    for k in ('save_s', 'stall_s', 'restore_s'):
+        out[k] = round(out[k], 3)
+    return out
+
+
 def goodput_summary(job_id: int) -> Optional[Dict[str, Any]]:
     """Aggregate the ledger into the operator's goodput answer: seconds
     per phase/kind over the job's wall-clock (open phase measured to
@@ -461,9 +502,11 @@ def goodput_summary(job_id: int) -> Optional[Dict[str, Any]]:
         kinds[r['kind']] = kinds.get(r['kind'], 0.0) + dur
         if r['kind'] == 'badput' and r['detail']:
             badput_events.append(r['detail'])
+    ckpt = _ckpt_from_details([r['detail'] for r in rows])
     return {
         'job_id': job_id,
         'status': record['status'].value,
+        'ckpt': ckpt,
         'wall_s': round(wall_s, 3),
         'closed': rows[-1]['ended_at'] is not None,
         'phases': {k: round(v, 3) for k, v in sorted(phases.items())},

@@ -79,6 +79,115 @@ def window_record(*, step: int, steps: int, window_s: float,
     return rec
 
 
+def ckpt_record(*, op: str, step: int, seconds: float,
+                stall_s: Optional[float] = None,
+                nbytes: Optional[int] = None,
+                source: Optional[str] = None,
+                async_save: Optional[bool] = None,
+                emergency: bool = False,
+                ts: Optional[float] = None) -> Dict[str, Any]:
+    """One checkpoint event (``op`` = 'save' | 'restore') from the ckpt
+    manager. Rides the same spool as the window records; the ``kind``
+    field keeps the two record families separable (window records have
+    none — the PR-4 on-disk format predates it)."""
+    import time
+    rec: Dict[str, Any] = {
+        'kind': 'ckpt',
+        'op': op,
+        'ts': round(ts if ts is not None else time.time(), 3),
+        'step': int(step),
+        'seconds': round(float(seconds), 6),
+    }
+    if stall_s is not None:
+        rec['stall_s'] = round(float(stall_s), 6)
+    if nbytes is not None:
+        rec['nbytes'] = int(nbytes)
+    if source is not None:
+        rec['source'] = source
+    if async_save is not None:
+        rec['async'] = bool(async_save)
+    if emergency:
+        rec['emergency'] = True
+    return rec
+
+
+def ckpt_totals(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold a spool's ckpt records into the cumulative accounting the
+    heartbeat ships and the goodput ledger attributes: seconds spent
+    persisting (save_s), seconds the step loop actually stalled
+    (stall_s — the async win is save_s >> stall_s), restore cost, and
+    checkpoint freshness (last_step / last_save_ts)."""
+    out: Dict[str, Any] = {'saves': 0, 'save_s': 0.0, 'stall_s': 0.0,
+                           'restores': 0, 'restore_s': 0.0,
+                           'last_step': 0, 'last_save_ts': 0.0}
+    for rec in records:
+        if rec.get('kind') != 'ckpt':
+            continue
+        if rec.get('op') == 'save':
+            out['saves'] += 1
+            out['save_s'] += float(rec.get('seconds') or 0.0)
+            out['stall_s'] += float(rec.get('stall_s') or 0.0)
+            out['last_step'] = max(out['last_step'],
+                                   int(rec.get('step') or 0))
+            out['last_save_ts'] = max(out['last_save_ts'],
+                                      float(rec.get('ts') or 0.0))
+        elif rec.get('op') == 'restore':
+            out['restores'] += 1
+            out['restore_s'] += float(rec.get('seconds') or 0.0)
+    for k in ('save_s', 'stall_s', 'restore_s'):
+        out[k] = round(out[k], 6)
+    return out
+
+
+def cluster_telemetry_summary(
+        cluster_runtime_dir: str) -> Dict[str, Optional[Dict[str, Any]]]:
+    """ONE pass over every job/rank spool under a cluster runtime dir:
+    ``train`` = the newest training window (tagged with the job id and
+    rank it came from; None without telemetry) and ``ckpt`` = the
+    cumulative checkpoint accounting (None without ckpt records). The
+    heartbeat needs both every tick and must not glob + re-parse the
+    spools once per consumer."""
+    import glob
+    root = os.path.expanduser(cluster_runtime_dir)
+    pattern = os.path.join(root, 'jobs', '*', 'telemetry', '*', SPOOL_FILE)
+    newest_path, newest_mtime = None, -1.0
+    newest_records: List[Dict[str, Any]] = []
+    all_records: List[Dict[str, Any]] = []
+    for path in glob.glob(pattern):
+        try:
+            mtime = os.stat(path).st_mtime
+        except OSError:
+            continue
+        records = read_records(os.path.dirname(path))
+        all_records.extend(records)
+        if mtime > newest_mtime:
+            newest_path, newest_mtime, newest_records = \
+                path, mtime, records
+    window = None
+    if newest_path is not None:
+        windows = [r for r in newest_records if 'kind' not in r]
+        if windows:
+            # .../jobs/<job_id>/telemetry/<rank>/train_telemetry.jsonl
+            parts = newest_path.split(os.sep)
+            try:
+                window = dict(windows[-1], job_id=int(parts[-4]),
+                              rank=parts[-2])
+            except (ValueError, IndexError):
+                window = dict(windows[-1])
+    totals: Optional[Dict[str, Any]] = ckpt_totals(all_records)
+    if not totals['saves'] and not totals['restores']:
+        totals = None
+    return {'train': window, 'ckpt': totals}
+
+
+def ckpt_totals_for_cluster(
+        cluster_runtime_dir: str) -> Optional[Dict[str, Any]]:
+    """Cumulative ckpt accounting across every job/rank spool under a
+    cluster runtime dir (goodput-ledger consumer). None when no spool
+    holds a checkpoint record."""
+    return cluster_telemetry_summary(cluster_runtime_dir)['ckpt']
+
+
 class TelemetryWriter:
     """Append-only JSONL spool, bounded by one-generation rotation.
 
@@ -87,9 +196,15 @@ class TelemetryWriter:
 
     def __init__(self, spool_dir: str,
                  max_bytes: Optional[int] = None):
+        import threading
         self._path = os.path.join(os.path.expanduser(spool_dir), SPOOL_FILE)
         self._max_bytes = max_bytes if max_bytes is not None else _max_bytes()
         self._broken = False
+        # One writer instance is shared across threads (train loop,
+        # ckpt commit worker, SIGTERM handler): the check-then-rotate
+        # in emit() must not race itself, or a stale size check can
+        # os.replace a fresh spool over the rotated generation.
+        self._emit_lock = threading.Lock()
         try:
             os.makedirs(os.path.dirname(self._path), exist_ok=True)
             self._heal_torn_tail()
@@ -123,13 +238,15 @@ class TelemetryWriter:
             return
         try:
             line = json.dumps(record, sort_keys=True)
-            try:
-                if os.path.getsize(self._path) + len(line) > self._max_bytes:
-                    os.replace(self._path, self._path + '.1')
-            except OSError:
-                pass  # no spool yet: nothing to rotate
-            with open(self._path, 'a', encoding='utf-8') as f:
-                f.write(line + '\n')
+            with self._emit_lock:
+                try:
+                    if os.path.getsize(self._path) + len(line) > \
+                            self._max_bytes:
+                        os.replace(self._path, self._path + '.1')
+                except OSError:
+                    pass  # no spool yet: nothing to rotate
+                with open(self._path, 'a', encoding='utf-8') as f:
+                    f.write(line + '\n')
         except (OSError, TypeError, ValueError):
             self._broken = True
 
@@ -159,7 +276,10 @@ def read_records(spool_dir: str) -> List[Dict[str, Any]]:
 
 
 def latest_record(spool_dir: str) -> Optional[Dict[str, Any]]:
-    records = read_records(spool_dir)
+    """Newest WINDOW record — records carrying a ``kind`` (checkpoint
+    events share the spool) must not masquerade as a training-progress
+    window in heartbeats."""
+    records = [r for r in read_records(spool_dir) if 'kind' not in r]
     return records[-1] if records else None
 
 
@@ -167,28 +287,5 @@ def latest_window_for_cluster(
         cluster_runtime_dir: str) -> Optional[Dict[str, Any]]:
     """Newest telemetry window across every job/rank spool under a cluster
     runtime dir (``jobs/<id>/telemetry/<rank>/``), tagged with the job id
-    it came from. Used by the heartbeat daemon; a cluster with no
-    training telemetry returns None."""
-    import glob
-    root = os.path.expanduser(cluster_runtime_dir)
-    pattern = os.path.join(root, 'jobs', '*', 'telemetry', '*', SPOOL_FILE)
-    newest_path, newest_mtime = None, -1.0
-    for path in glob.glob(pattern):
-        try:
-            mtime = os.stat(path).st_mtime
-        except OSError:
-            continue
-        if mtime > newest_mtime:
-            newest_path, newest_mtime = path, mtime
-    if newest_path is None:
-        return None
-    rec = latest_record(os.path.dirname(newest_path))
-    if rec is None:
-        return None
-    # .../jobs/<job_id>/telemetry/<rank>/train_telemetry.jsonl
-    parts = newest_path.split(os.sep)
-    try:
-        rec = dict(rec, job_id=int(parts[-4]), rank=parts[-2])
-    except (ValueError, IndexError):
-        rec = dict(rec)
-    return rec
+    it came from. A cluster with no training telemetry returns None."""
+    return cluster_telemetry_summary(cluster_runtime_dir)['train']

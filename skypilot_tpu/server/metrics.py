@@ -118,6 +118,29 @@ _CLUSTER_HEARTBEAT_AGE = Gauge(
     'skytpu_cluster_heartbeat_age_seconds',
     'Seconds since each cluster daemon last heartbeated.',
     ['cluster'], registry=REGISTRY)
+# Checkpoint pipeline accounting (heartbeat-shipped ckpt manager
+# telemetry; see skypilot_tpu/ckpt/). save vs stall is the async win:
+# stall is what the step loop actually paid; save is the background
+# persist cost the loop overlapped.
+_CKPT_SAVE_S = Gauge(
+    'skytpu_ckpt_save_seconds',
+    'Cumulative seconds spent persisting checkpoints on this cluster '
+    '(commit + mirror, background under async saves).',
+    ['cluster'], registry=REGISTRY)
+_CKPT_STALL_S = Gauge(
+    'skytpu_ckpt_stall_seconds',
+    'Cumulative seconds the train step loop stalled for checkpointing '
+    '(device->host snapshot + back-pressure).',
+    ['cluster'], registry=REGISTRY)
+_CKPT_LAST_STEP = Gauge(
+    'skytpu_ckpt_last_step',
+    'Newest durably checkpointed train step on this cluster.',
+    ['cluster'], registry=REGISTRY)
+_CKPT_STALENESS = Gauge(
+    'skytpu_ckpt_staleness_seconds',
+    'Seconds since the last successful checkpoint save — the work at '
+    'risk if the slice is preempted right now.',
+    ['cluster'], registry=REGISTRY)
 _MANAGED_JOBS = Gauge('skytpu_managed_jobs', 'Managed jobs by status.',
                       ['status'], registry=REGISTRY)
 _SERVICES = Gauge('skytpu_services', 'Services by status.', ['status'],
@@ -155,7 +178,9 @@ def _refresh_goodput_gauges(clusters, jobs) -> None:
     from skypilot_tpu.jobs import state as jobs_state
 
     for gauge in (_JOB_GOODPUT, _JOB_PHASE_SECONDS, _TRAIN_STEP_SECONDS,
-                  _TRAIN_TOKENS_PER_S, _TRAIN_MFU, _CLUSTER_HEARTBEAT_AGE):
+                  _TRAIN_TOKENS_PER_S, _TRAIN_MFU, _CLUSTER_HEARTBEAT_AGE,
+                  _CKPT_SAVE_S, _CKPT_STALL_S, _CKPT_LAST_STEP,
+                  _CKPT_STALENESS):
         gauge.clear()
     totals = jobs_state.phase_totals()
     listed = {r['job_id'] for r in jobs}
@@ -174,10 +199,23 @@ def _refresh_goodput_gauges(clusters, jobs) -> None:
         if rec.get('last_heartbeat'):
             _CLUSTER_HEARTBEAT_AGE.labels(cluster=rec['name']).set(
                 max(now - rec['last_heartbeat'], 0.0))
-        train = (rec.get('heartbeat') or {}).get('train')
+        heartbeat = rec.get('heartbeat') or {}
+        labels = {'cluster': rec['name']}
+        ckpt = heartbeat.get('ckpt')
+        if isinstance(ckpt, dict):
+            if isinstance(ckpt.get('save_s'), (int, float)):
+                _CKPT_SAVE_S.labels(**labels).set(ckpt['save_s'])
+            if isinstance(ckpt.get('stall_s'), (int, float)):
+                _CKPT_STALL_S.labels(**labels).set(ckpt['stall_s'])
+            if isinstance(ckpt.get('last_step'), (int, float)):
+                _CKPT_LAST_STEP.labels(**labels).set(ckpt['last_step'])
+            if isinstance(ckpt.get('last_save_ts'), (int, float)) \
+                    and ckpt['last_save_ts'] > 0:
+                _CKPT_STALENESS.labels(**labels).set(
+                    max(now - ckpt['last_save_ts'], 0.0))
+        train = heartbeat.get('train')
         if not isinstance(train, dict):
             continue
-        labels = {'cluster': rec['name']}
         if isinstance(train.get('step_time_s'), (int, float)):
             _TRAIN_STEP_SECONDS.labels(**labels).set(train['step_time_s'])
         if isinstance(train.get('tokens_per_s'), (int, float)):

@@ -1,54 +1,97 @@
-"""Checkpoint/restore for train state (orbax-backed).
+"""Checkpoint/restore for train state — facade over ``skypilot_tpu.ckpt``.
 
 The framework-level contract (reference SURVEY.md §5 checkpoint/resume):
 recipes mount a bucket at e.g. ``/ckpt`` (MOUNT mode) and save here; on
-spot preemption the managed-jobs controller relaunches the task, which calls
-``restore_latest`` and resumes from the last durable step.  Orbax handles
-sharded arrays natively, so the same checkpoint round-trips between
-different mesh shapes (save on v5e-256, restore on v5e-128 resharded).
+spot preemption the managed-jobs controller relaunches the task, which
+calls ``restore_latest`` and resumes from the last durable step.
+
+The implementation is the native snapshot->commit->mirror pipeline in
+``skypilot_tpu/ckpt/`` (crash-consistent: checksummed manifests, atomic
+renames, commit markers; ``async_save=True`` stalls the step loop only
+for the device->host transfer). This module keeps the historical API
+surface. Orbax remains available two ways: directories written by the
+old orbax wrapper restore transparently (compat reader inside the
+manager), and ``codec='orbax'`` routes writes through orbax for
+deployments that need its resharding tooling (save on v5e-256, restore
+on v5e-128).
 """
 from __future__ import annotations
 
-import os
 from typing import Any, Dict, Optional
 
-import orbax.checkpoint as ocp
+from skypilot_tpu.ckpt import manager as manager_lib
 
 
 class CheckpointManager:
 
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 save_interval_steps: int = 100):
-        self.directory = os.path.abspath(os.path.expanduser(directory))
-        os.makedirs(self.directory, exist_ok=True)
-        self._mgr = ocp.CheckpointManager(
-            self.directory,
-            options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep,
-                save_interval_steps=save_interval_steps,
-                enable_async_checkpointing=False))
+                 save_interval_steps: int = 100,
+                 async_save: bool = False,
+                 local_dir: Optional[str] = None,
+                 codec: str = 'native', **manager_kwargs: Any):
+        self.directory = directory
+        self.codec = codec
+        if codec == 'orbax':
+            import orbax.checkpoint as ocp
+            import os
+            self.directory = os.path.abspath(
+                os.path.expanduser(directory))
+            os.makedirs(self.directory, exist_ok=True)
+            self._ocp = ocp
+            self._mgr = ocp.CheckpointManager(
+                self.directory,
+                options=ocp.CheckpointManagerOptions(
+                    max_to_keep=max_to_keep,
+                    save_interval_steps=save_interval_steps,
+                    enable_async_checkpointing=False))
+            return
+        if codec != 'native':
+            raise ValueError(f'unknown checkpoint codec {codec!r} '
+                             "(expected 'native' or 'orbax')")
+        self._ocp = None
+        self._mgr = manager_lib.AsyncCheckpointManager(
+            directory, local_dir=local_dir, max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            async_save=async_save, **manager_kwargs)
 
     def save(self, step: int, state: Dict[str, Any],
              force: bool = False) -> bool:
-        """Save if the interval policy says so (or force=True)."""
-        saved = self._mgr.save(
-            step, args=ocp.args.StandardSave(state), force=force)
-        self._mgr.wait_until_finished()
-        return bool(saved)
+        """Save if the interval policy says so (or force=True). Native
+        async mode returns once the snapshot is host-side; durability
+        follows in the background (``close``/``latest_step`` flush)."""
+        if self._ocp is not None:
+            saved = self._mgr.save(
+                step, args=self._ocp.args.StandardSave(state), force=force)
+            self._mgr.wait_until_finished()
+            return bool(saved)
+        return self._mgr.save(step, state, force=force)
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
     def restore_latest(
             self, abstract_state: Dict[str, Any]) -> Optional[Dict[str, Any]]:
-        """Restore the newest checkpoint into the given state layout
-        (shardings come from abstract_state's arrays). None if no
-        checkpoint exists yet — caller starts from scratch."""
-        step = self._mgr.latest_step()
-        if step is None:
-            return None
-        return self._mgr.restore(
-            step, args=ocp.args.StandardRestore(abstract_state))
+        """Restore the newest VALID checkpoint into the given state
+        layout (shardings come from abstract_state's arrays). None if no
+        checkpoint exists yet — caller starts from scratch. Torn or
+        corrupt steps are skipped with fallback to the previous durable
+        one (ckpt.manager)."""
+        if self._ocp is not None:
+            step = self._mgr.latest_step()
+            if step is None:
+                return None
+            return self._mgr.restore(
+                step, args=self._ocp.args.StandardRestore(abstract_state))
+        return self._mgr.restore_latest(abstract_state)
+
+    def emergency_persist(self) -> Optional[int]:
+        """Preemption path: make the freshest snapshot durable without
+        touching the device (no-op sync under the orbax codec — its
+        saves are already durable on return)."""
+        if self._ocp is not None:
+            self._mgr.wait_until_finished()
+            return self._mgr.latest_step()
+        return self._mgr.emergency_persist()
 
     def close(self) -> None:
         self._mgr.close()
@@ -56,9 +99,20 @@ class CheckpointManager:
 
 def save_for_preemption(directory: str, step: int,
                         state: Dict[str, Any]) -> None:
-    """One-shot forced save (for SIGTERM handlers on spot VMs)."""
-    mgr = CheckpointManager(directory, save_interval_steps=1)
-    try:
-        mgr.save(step, state, force=True)
-    finally:
-        mgr.close()
+    """One-shot forced save (for SIGTERM handlers on spot VMs).
+
+    Reuses the LIVE manager for this directory when one exists — its
+    last host-side snapshot persists without re-serializing state from
+    device under the preemption deadline (an in-flight async persist is
+    simply flushed, and if no snapshot was ever taken the manager
+    snapshots the given state once). The manager owns the directory:
+    never bolt on a second writer — racing its mid-commit worker on the
+    same step dir is exactly the torn write this subsystem exists to
+    prevent. Only a caller with NO open manager takes the standalone
+    path, via a single native commit — never a throwaway manager build
+    per call."""
+    live = manager_lib.live_manager(directory)
+    if live is not None:
+        live.emergency_persist(state=state, step=step)
+        return
+    manager_lib.oneshot_save(directory, step, state)

@@ -36,6 +36,16 @@ def main() -> None:
                              'TokenDataset); synthetic stream when unset')
     parser.add_argument('--ckpt-dir', default=None,
                         help='checkpoint dir (mounted bucket for recovery)')
+    parser.add_argument('--ckpt-local-dir', default=None,
+                        help='fast local staging dir: saves commit here '
+                             'and mirror to --ckpt-dir in the background '
+                             '(restore prefers local, falls back to the '
+                             'bucket)')
+    parser.add_argument('--ckpt-sync', action='store_true',
+                        help='persist synchronously (stalls the step '
+                             'loop for the full write; default is async '
+                             '— the loop blocks only for the '
+                             'device->host snapshot)')
     parser.add_argument('--save-every', type=int, default=20)
     parser.add_argument('--log-every', type=int, default=10)
     parser.add_argument('--step-time-floor', type=float, default=0.0,
@@ -115,18 +125,41 @@ def main() -> None:
     trainer = Trainer(cfg, mesh=mesh)
     state = trainer.init_state(seed=0)
 
+    # Step/ckpt telemetry (observability/train_telemetry.py): created
+    # before the checkpoint manager so restore/save events ride the same
+    # spool as the loss windows. Writer is None (and the loop
+    # byte-identical) unless the spool dir env var is set — the gang
+    # driver exports it per worker.
+    from skypilot_tpu.observability import train_telemetry
+    telem = train_telemetry.TelemetryWriter.from_env()
+
     mgr = None
     start_step = 0
     if args.ckpt_dir:
         from skypilot_tpu.train import checkpoint as ckpt_lib
         mgr = ckpt_lib.CheckpointManager(
-            args.ckpt_dir, save_interval_steps=args.save_every)
+            args.ckpt_dir, save_interval_steps=args.save_every,
+            async_save=not args.ckpt_sync,
+            local_dir=args.ckpt_local_dir, telemetry=telem)
         restored = mgr.restore_latest(state)
         if restored is not None:
             state = restored
             start_step = int(jax.device_get(state['step']))
             print(f'[train] resumed from checkpoint step {start_step}',
                   flush=True)
+
+        # Preemption hook: the agent driver's cancel path SIGTERMs the
+        # gang (then escalates after a grace window) — persist the
+        # freshest host-side snapshot before dying. Never touches the
+        # device: safe even mid-step (ckpt.manager.emergency_persist).
+        import signal as signal_lib
+
+        def _on_sigterm(signum, frame):
+            del signum, frame
+            mgr.emergency_persist()
+            raise SystemExit(143)
+
+        signal_lib.signal(signal_lib.SIGTERM, _on_sigterm)
 
     dataset = None
     if args.data:
@@ -136,17 +169,27 @@ def main() -> None:
             args.data, seq_len=cfg.seq_len,
             batch_size=cfg.global_batch_size)
 
-    # Step telemetry (observability/train_telemetry.py): one JSONL record
-    # per --log-every window, riding the loss fetch that window already
-    # pays for. Writer is None (and the loop byte-identical) unless the
-    # spool dir env var is set — the gang driver exports it per worker.
+    step_fn = trainer.compiled_step()
+    try:
+        _train_loop(args, cfg, state, step_fn, dataset, mgr, telem,
+                    start_step)
+    finally:
+        if mgr is not None:
+            mgr.close()  # flushes any in-flight async persist
+    print('[train] done', flush=True)
+
+
+def _train_loop(args, cfg, state, step_fn, dataset, mgr, telem,
+                start_step) -> None:
     from skypilot_tpu.observability import train_telemetry
-    telem = train_telemetry.TelemetryWriter.from_env()
+    from skypilot_tpu.train import data as data_lib
     from skypilot_tpu.train import trainer as trainer_lib
+
+    import jax
+    import jax.numpy as jnp
+
     window_t0 = time.time()
     window_steps = 0
-
-    step_fn = trainer.compiled_step()
     for i in range(start_step, args.steps):
         if dataset is not None:
             batch = jnp.asarray(dataset.batch(i))
@@ -178,11 +221,8 @@ def main() -> None:
         dt = time.time() - t0
         if args.step_time_floor > dt:
             time.sleep(args.step_time_floor - dt)
-    if mgr is not None:
-        if mgr.latest_step() != args.steps:
-            mgr.save(args.steps, state, force=True)
-        mgr.close()
-    print('[train] done', flush=True)
+    if mgr is not None and mgr.latest_step() != args.steps:
+        mgr.save(args.steps, state, force=True)
 
 
 if __name__ == '__main__':

@@ -1,0 +1,498 @@
+"""Crash-consistency tests for the checkpoint subsystem
+(skypilot_tpu/ckpt/): snapshot -> commit -> mirror.
+
+The contract under test is durability, not performance: a kill -9 at
+ANY point leaves a directory that restores from the last COMMITTED
+step; corrupt manifests and truncated shards are rejected with a clear
+error (never restored silently); a marker-less step dir — what a dead
+host or a torn mirror upload produces — is invisible; and when the
+local staging dir and the bucket mirror diverge, the newest committed
+step wins. perf_probe --ckpt drives the same invariants end-to-end
+through a real trainer + managed-job preemption.
+"""
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from skypilot_tpu.ckpt import committer, manifest as manifest_lib, mirror
+from skypilot_tpu.ckpt.manager import (AsyncCheckpointManager,
+                                       CheckpointError, live_manager)
+
+
+def _state(seed: int = 0):
+    rng = np.random.default_rng(seed)
+    return {
+        # np.full, not zeros()+seed: the latter yields a numpy SCALAR,
+        # which orbax's StandardSave (compat codec under test) rejects.
+        'step': np.full((), seed, np.int32),
+        'params': {'w': rng.normal(size=(16, 8)).astype(np.float32),
+                   'b': rng.normal(size=(8,)).astype(np.float32)},
+        # 0-d ndarray, not np.int32(): orbax's StandardSave (the compat
+        # codec under test) rejects non-ndarray leaves.
+        'opt': (np.asarray(seed, dtype=np.int32),
+                {'m': rng.normal(size=(16, 8)).astype(np.float32)}),
+    }
+
+
+def _assert_tree_equal(got, want):
+    import jax
+    got_named = {jax.tree_util.keystr(p): np.asarray(v)
+                 for p, v in jax.tree_util.tree_flatten_with_path(got)[0]}
+    want_named = {jax.tree_util.keystr(p): np.asarray(v)
+                  for p, v in
+                  jax.tree_util.tree_flatten_with_path(want)[0]}
+    assert got_named.keys() == want_named.keys()
+    for name in want_named:
+        np.testing.assert_array_equal(got_named[name], want_named[name],
+                                      err_msg=name)
+
+
+def _commit(root, step, state, **kw):
+    from skypilot_tpu.ckpt import snapshot as snapshot_lib
+    snap = snapshot_lib.take(step, state)
+    return committer.commit_step(root, step, snap.arrays, **kw)
+
+
+# -- round trip + async semantics -------------------------------------------
+
+
+def test_async_roundtrip_matches_sync(tmp_path):
+    state = _state(3)
+    for mode, sub in ((False, 'sync'), (True, 'async')):
+        mgr = AsyncCheckpointManager(str(tmp_path / sub),
+                                     save_interval_steps=1,
+                                     async_save=mode, telemetry=None)
+        assert mgr.save(1, state)
+        assert mgr.save(2, _state(4))
+        assert mgr.latest_step() == 2
+        restored = mgr.restore_latest(_state(99))
+        _assert_tree_equal(restored, _state(4))
+        mgr.close()
+
+
+def test_interval_policy_and_force(tmp_path):
+    mgr = AsyncCheckpointManager(str(tmp_path), save_interval_steps=5,
+                                 async_save=False, telemetry=None)
+    assert not mgr.save(3, _state())
+    assert mgr.save(5, _state())
+    assert mgr.save(7, _state(), force=True)
+    assert mgr.latest_step() == 7
+    mgr.close()
+
+
+def test_backpressure_single_snapshot_in_flight(tmp_path, monkeypatch):
+    """A save issued while the previous persist is in flight must block
+    (back-pressure) rather than queue a second snapshot."""
+    gate = threading.Event()
+    orig = committer.commit_step
+    in_flight = []
+
+    def slow_commit(root, step, arrays, **kw):
+        in_flight.append(step)
+        assert gate.wait(30)
+        return orig(root, step, arrays, **kw)
+
+    monkeypatch.setattr(committer, 'commit_step', slow_commit)
+    mgr = AsyncCheckpointManager(str(tmp_path), save_interval_steps=1,
+                                 async_save=True, telemetry=None)
+    mgr.save(1, _state(1))
+    deadline = time.time() + 10
+    while not in_flight and time.time() < deadline:
+        time.sleep(0.01)
+    assert in_flight == [1]
+    done = []
+    t = threading.Thread(
+        target=lambda: (mgr.save(2, _state(2)), done.append(True)))
+    t.start()
+    time.sleep(0.3)
+    assert not done, 'second save must block while persist 1 in flight'
+    gate.set()
+    t.join(timeout=30)
+    assert done
+    mgr.close()
+    assert mgr.latest_step() == 2
+
+
+def test_telemetry_records_save_and_restore(tmp_path, monkeypatch):
+    from skypilot_tpu.observability import train_telemetry
+    spool = str(tmp_path / 'spool')
+    writer = train_telemetry.TelemetryWriter(spool)
+    mgr = AsyncCheckpointManager(str(tmp_path / 'ck'),
+                                 save_interval_steps=1, async_save=True,
+                                 telemetry=writer)
+    mgr.save(1, _state(1))
+    mgr.close()
+    mgr2 = AsyncCheckpointManager(str(tmp_path / 'ck'),
+                                  save_interval_steps=1,
+                                  telemetry=writer)
+    assert mgr2.restore_latest(_state(0)) is not None
+    mgr2.close()
+    recs = train_telemetry.read_records(spool)
+    saves = [r for r in recs if r.get('kind') == 'ckpt'
+             and r['op'] == 'save']
+    restores = [r for r in recs if r.get('kind') == 'ckpt'
+                and r['op'] == 'restore']
+    assert len(saves) == 1 and saves[0]['async'] and \
+        saves[0]['seconds'] > 0 and 'stall_s' in saves[0]
+    assert len(restores) == 1 and restores[0]['step'] == 1
+    totals = train_telemetry.ckpt_totals(recs)
+    assert totals['saves'] == 1 and totals['restores'] == 1
+    assert totals['last_step'] == 1 and totals['save_s'] > 0
+    # ckpt records must not masquerade as training windows.
+    assert train_telemetry.latest_record(spool) is None
+
+
+# -- crash consistency -------------------------------------------------------
+
+
+def test_kill_mid_commit_falls_back_to_previous_step(tmp_path):
+    """A .tmp dir (kill before the atomic rename) and a marker-less
+    final dir (torn mirror upload / dead multi-host writer) are both
+    invisible: restore lands on the last committed step and the next
+    manager GCs the debris."""
+    root = str(tmp_path)
+    _commit(root, 2, _state(2))
+    # Crash before rename: shards + manifest inside step_4.tmp.
+    tmp_dir = os.path.join(root, manifest_lib.step_dirname(4)
+                           + manifest_lib.TMP_SUFFIX)
+    os.makedirs(tmp_dir)
+    from skypilot_tpu.ckpt import snapshot as snapshot_lib
+    manifest_lib.write_host_files(tmp_dir, 0,
+                                  snapshot_lib.take(4, _state(4)).arrays)
+    # Crash between rename and marker cannot happen locally (marker is
+    # written inside the tmp dir) — but a torn MIRROR upload leaves
+    # exactly this: final-named dir, no COMMIT.
+    bare = os.path.join(root, manifest_lib.step_dirname(6))
+    os.makedirs(bare)
+    manifest_lib.write_host_files(bare, 0,
+                                  snapshot_lib.take(6, _state(6)).arrays)
+
+    assert [s for s, _ in manifest_lib.committed_steps(root)] == [2]
+    assert sorted(manifest_lib.partial_dirs(root)) == sorted(
+        [tmp_dir, bare])
+    mgr = AsyncCheckpointManager(root, telemetry=None)
+    assert mgr.latest_step() == 2
+    _assert_tree_equal(mgr.restore_latest(_state(0)), _state(2))
+    mgr.close()
+    assert manifest_lib.partial_dirs(root) == []  # GC'd at init
+
+
+def test_corrupt_manifest_rejected_with_fallback(tmp_path):
+    root = str(tmp_path)
+    _commit(root, 2, _state(2))
+    path4 = _commit(root, 4, _state(4))
+    with open(os.path.join(path4, manifest_lib.host_manifest_name(0)),
+              'w', encoding='utf-8') as f:
+        f.write('{"not": "a manifest\x00')
+    mgr = AsyncCheckpointManager(root, telemetry=None)
+    restored = mgr.restore_latest(_state(0))
+    _assert_tree_equal(restored, _state(2))  # fell back past the corrupt
+    mgr.close()
+
+
+def test_corrupt_only_checkpoint_raises_clear_error(tmp_path):
+    root = str(tmp_path)
+    path2 = _commit(root, 2, _state(2))
+    shard = os.path.join(path2, manifest_lib.shard_name(0))
+    data = bytearray(open(shard, 'rb').read())
+    data[len(data) // 2] ^= 0xFF  # single bit-flip inside an array
+    with open(shard, 'wb') as f:
+        f.write(bytes(data))
+    mgr = AsyncCheckpointManager(root, telemetry=None)
+    with pytest.raises(CheckpointError, match='checksum mismatch'):
+        mgr.restore_latest(_state(0))
+    mgr.close()
+
+
+def test_layout_mismatch_rejected_but_never_deleted(tmp_path):
+    """Shape/dtype/key drift vs the caller's abstract state is a GOOD
+    checkpoint the caller cannot load: restore must fail with a clear
+    error and must NOT quarantine it (only byte-level corruption is
+    GC'd) — relaunching with the right config must still find it."""
+    root = str(tmp_path)
+    path2 = _commit(root, 2, _state(2))
+    mgr = AsyncCheckpointManager(root, telemetry=None)
+    wrong = dict(_state(0),
+                 params={'w': np.zeros((4, 4), np.float32),
+                         'b': np.zeros((8,), np.float32)})
+    with pytest.raises(CheckpointError, match='shape'):
+        mgr.restore_latest(wrong)
+    assert os.path.isdir(path2), 'layout mismatch must not delete data'
+    wrong_dtype = dict(_state(0),
+                       step=np.zeros((), np.int64))
+    with pytest.raises(CheckpointError, match='dtype'):
+        mgr.restore_latest(wrong_dtype)
+    assert os.path.isdir(path2)
+    # The right layout still restores.
+    _assert_tree_equal(mgr.restore_latest(_state(0)), _state(2))
+    mgr.close()
+
+
+def test_truncated_shard_rejected(tmp_path):
+    root = str(tmp_path)
+    _commit(root, 2, _state(2))
+    path4 = _commit(root, 4, _state(4))
+    shard = os.path.join(path4, manifest_lib.shard_name(0))
+    with open(shard, 'rb+') as f:
+        f.truncate(os.path.getsize(shard) - 16)
+    report = manifest_lib.verify_step(path4, deep=False)
+    assert not report['ok'] and 'truncated' in report['errors'][0]
+    mgr = AsyncCheckpointManager(root, telemetry=None)
+    _assert_tree_equal(mgr.restore_latest(_state(0)), _state(2))
+    mgr.close()
+
+
+# -- multi-host --------------------------------------------------------------
+
+
+def test_multihost_marker_only_after_all_hosts_barrier(tmp_path):
+    """Rank 0 must not write the commit marker before every host's
+    shard is on disk: the barrier wrapper asserts both shards exist and
+    no marker does, at the moment each rank enters it."""
+    root = str(tmp_path)
+    barrier = threading.Barrier(2)
+    observed = []
+
+    def checked_barrier():
+        tmp_dir = os.path.join(root, manifest_lib.step_dirname(1)
+                               + manifest_lib.TMP_SUFFIX)
+        # At ENTRY only this host's shard is guaranteed; the marker
+        # must not exist yet. At RELEASE every host's shard must.
+        marker_at_entry = os.path.exists(
+            os.path.join(tmp_dir, manifest_lib.COMMIT_FILE))
+        barrier.wait(timeout=30)
+        observed.append({
+            'shards': sorted(os.listdir(tmp_dir)),
+            'marker': marker_at_entry,
+        })
+
+    from skypilot_tpu.ckpt import snapshot as snapshot_lib
+    errs = []
+
+    def run(host):
+        try:
+            committer.commit_step(
+                root, 1, snapshot_lib.take(1, _state(host)).arrays,
+                host=host, num_hosts=2, barrier=checked_barrier)
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=run, args=(h,)) for h in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs, errs
+    for obs in observed:
+        assert not obs['marker'], observed
+        assert {manifest_lib.shard_name(0),
+                manifest_lib.shard_name(1)} <= set(obs['shards']), observed
+    final = os.path.join(root, manifest_lib.step_dirname(1))
+    assert manifest_lib.is_committed(final)
+    top = manifest_lib.read_manifest(final)
+    assert top['num_hosts'] == 2
+    # Each host restores its own shard; a host beyond the saved
+    # topology falls back to rank 0's.
+    for host, seed in ((0, 0), (1, 1), (3, 0)):
+        mgr = AsyncCheckpointManager(root, process_index=host,
+                                     process_count=4,
+                                     barrier=lambda: None,
+                                     telemetry=None)
+        _assert_tree_equal(mgr.restore_latest(_state(9)), _state(seed))
+        mgr.close()
+
+
+# -- mirror ------------------------------------------------------------------
+
+
+def test_mirror_push_and_divergence_resolution(tmp_path):
+    local, bucket = str(tmp_path / 'local'), str(tmp_path / 'bucket')
+    mgr = AsyncCheckpointManager(bucket, local_dir=local,
+                                 save_interval_steps=1, async_save=False,
+                                 telemetry=None)
+    mgr.save(2, _state(2))
+    mgr.save(4, _state(4))
+    mgr.close()
+    assert [s for s, _ in manifest_lib.committed_steps(bucket)] == [2, 4]
+
+    # Bucket ahead of local (previous incarnation's staging died):
+    # newest committed step — the bucket's — wins.
+    _commit(bucket, 6, _state(6))
+    mgr = AsyncCheckpointManager(bucket, local_dir=local, telemetry=None)
+    assert mgr.latest_step() == 6
+    _assert_tree_equal(mgr.restore_latest(_state(0)), _state(6))
+    mgr.close()
+
+    # Local ahead of bucket (upload never finished — simulate with a
+    # marker-less bucket copy): local wins, torn upload is invisible.
+    _commit(local, 8, _state(8))
+    torn = os.path.join(bucket, manifest_lib.step_dirname(9))
+    os.makedirs(torn)
+    mgr = AsyncCheckpointManager(bucket, local_dir=local, telemetry=None)
+    _assert_tree_equal(mgr.restore_latest(_state(0)), _state(8))
+    mgr.close()
+
+
+def test_mirror_upload_writes_marker_last(tmp_path, monkeypatch):
+    """The mirror must order the COMMIT marker after every data file —
+    on fuse-mounted object stores the marker IS the commit point."""
+    local, bucket = str(tmp_path / 'l'), str(tmp_path / 'b')
+    step_path = _commit(local, 2, _state(2))
+    copied = []
+    orig = shutil.copyfile
+
+    def spy(src, dst):
+        copied.append(os.path.basename(dst))
+        return orig(src, dst)
+
+    monkeypatch.setattr(shutil, 'copyfile', spy)
+    mirror.push_step(step_path, bucket)
+    assert copied[-1] == manifest_lib.COMMIT_FILE
+    assert copied.count(manifest_lib.COMMIT_FILE) == 1
+    assert manifest_lib.is_committed(
+        os.path.join(bucket, manifest_lib.step_dirname(2)))
+
+
+# -- preemption path ---------------------------------------------------------
+
+
+def test_emergency_persist_reuses_snapshot_without_device(tmp_path,
+                                                          monkeypatch):
+    """save_for_preemption must reuse the live manager's host-side
+    snapshot: no device re-serialization, no orbax manager build."""
+    from skypilot_tpu.ckpt import snapshot as snapshot_lib
+    from skypilot_tpu.train import checkpoint as ckpt_lib
+
+    root = str(tmp_path)
+    mgr = ckpt_lib.CheckpointManager(root, save_interval_steps=1,
+                                     async_save=True, telemetry=None)
+    state = _state(5)
+    mgr.save(5, state)
+    assert live_manager(root) is not None
+
+    def no_device(*a, **k):
+        raise AssertionError('emergency save touched the device')
+
+    monkeypatch.setattr(snapshot_lib, 'take', no_device)
+    import orbax.checkpoint as ocp
+
+    def no_orbax(*a, **k):
+        raise AssertionError('emergency save built an orbax manager')
+
+    monkeypatch.setattr(ocp, 'CheckpointManager', no_orbax)
+    ckpt_lib.save_for_preemption(root, 5, state)
+    assert mgr.latest_step() == 5
+    mgr.close()
+
+
+def test_emergency_persist_flushes_held_commit(tmp_path, monkeypatch):
+    """SIGTERM while an async persist is parked mid-commit: emergency
+    waits the persist out and the step lands durably."""
+    root = str(tmp_path)
+    hold = str(tmp_path / 'hold')
+    open(hold, 'w').close()
+    monkeypatch.setenv(committer.ENV_HOLD_FILE, hold)
+    mgr = AsyncCheckpointManager(root, save_interval_steps=1,
+                                 async_save=True, telemetry=None)
+    mgr.save(3, _state(3))
+    threading.Timer(0.4, os.unlink, args=(hold,)).start()
+    assert mgr.emergency_persist(timeout=30) == 3
+    assert [s for s, _ in manifest_lib.committed_steps(root)] == [3]
+    mgr.close()
+
+
+def test_save_for_preemption_without_manager_is_oneshot_native(
+        tmp_path, monkeypatch):
+    from skypilot_tpu.train import checkpoint as ckpt_lib
+    import orbax.checkpoint as ocp
+
+    def no_orbax(*a, **k):
+        raise AssertionError('oneshot path built an orbax manager')
+
+    monkeypatch.setattr(ocp, 'CheckpointManager', no_orbax)
+    root = str(tmp_path / 'fresh')
+    ckpt_lib.save_for_preemption(root, 7, _state(7))
+    assert [s for s, _ in manifest_lib.committed_steps(root)] == [7]
+
+
+# -- compat + facade ---------------------------------------------------------
+
+
+def test_orbax_written_checkpoint_restores_through_native_facade(tmp_path):
+    pytest.importorskip('orbax.checkpoint')
+    from skypilot_tpu.train import checkpoint as ckpt_lib
+    root = str(tmp_path)
+    state = _state(2)
+    legacy = ckpt_lib.CheckpointManager(root, save_interval_steps=1,
+                                        codec='orbax')
+    assert legacy.save(2, state, force=True)
+    legacy.close()
+    mgr = ckpt_lib.CheckpointManager(root)
+    assert mgr.latest_step() == 2
+    restored = mgr.restore_latest(_state(0))
+    _assert_tree_equal(restored, state)
+    mgr.close()
+
+
+# -- goodput ledger attribution ----------------------------------------------
+
+
+def test_goodput_summary_sums_ckpt_notes(tmp_state_dir):
+    from skypilot_tpu.jobs import state as jobs_state
+    job_id = jobs_state.submit('ck', {'name': 'ck'})
+    jobs_state.set_status(job_id, jobs_state.ManagedJobStatus.RUNNING)
+    jobs_state.annotate_phase(job_id, jobs_state.format_ckpt_note(
+        {'saves': 3, 'save_s': 1.25, 'stall_s': 0.05, 'restores': 0,
+         'restore_s': 0.0, 'last_step': 12}))
+    jobs_state.set_status(job_id, jobs_state.ManagedJobStatus.RECOVERING,
+                          detail='slice preempted')
+    jobs_state.set_status(job_id, jobs_state.ManagedJobStatus.RUNNING)
+    jobs_state.annotate_phase(job_id, jobs_state.format_ckpt_note(
+        {'saves': 2, 'save_s': 0.75, 'stall_s': 0.03, 'restores': 1,
+         'restore_s': 0.4, 'last_step': 20}))
+    jobs_state.set_status(job_id, jobs_state.ManagedJobStatus.SUCCEEDED)
+    ck = jobs_state.goodput_summary(job_id)['ckpt']
+    assert ck == {'saves': 5, 'save_s': 2.0, 'stall_s': 0.08,
+                  'restores': 1, 'restore_s': 0.4, 'last_step': 20}
+
+
+def test_goodput_summary_without_notes_has_no_ckpt(tmp_state_dir):
+    from skypilot_tpu.jobs import state as jobs_state
+    job_id = jobs_state.submit('nock', {'name': 'nock'})
+    jobs_state.set_status(job_id, jobs_state.ManagedJobStatus.SUCCEEDED)
+    assert jobs_state.goodput_summary(job_id)['ckpt'] is None
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def test_cli_ckpt_ls_and_verify(tmp_path):
+    from click.testing import CliRunner
+    from skypilot_tpu.client.cli import cli
+    root = str(tmp_path)
+    _commit(root, 2, _state(2))
+    path4 = _commit(root, 4, _state(4))
+    runner = CliRunner()
+    r = runner.invoke(cli, ['ckpt', 'ls', root])
+    assert r.exit_code == 0, r.output
+    assert 'committed' in r.output and '2' in r.output
+    r = runner.invoke(cli, ['ckpt', 'verify', root])
+    assert r.exit_code == 0, r.output
+    assert r.output.count('OK') == 2
+
+    shard = os.path.join(path4, manifest_lib.shard_name(0))
+    data = bytearray(open(shard, 'rb').read())
+    data[8] ^= 0xFF
+    with open(shard, 'wb') as f:
+        f.write(bytes(data))
+    r = runner.invoke(cli, ['ckpt', 'verify', root])
+    assert r.exit_code == 1, r.output
+    assert 'CORRUPT' in r.output and 'checksum mismatch' in r.output
+    # Shallow verify misses the bit-flip (sizes match) — documented
+    # trade-off, deep is the default.
+    r = runner.invoke(cli, ['ckpt', 'verify', root, '--shallow'])
+    assert r.exit_code == 0, r.output

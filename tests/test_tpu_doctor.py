@@ -182,16 +182,26 @@ def test_audit_clean_tool_flags_and_clears():
     assert str(alien.pid) not in r.stderr
 
 
-def test_bench_probe_diagnostics_assembled_on_failure(monkeypatch):
+def test_bench_probe_diagnostics_assembled_on_failure(monkeypatch,
+                                                      tmp_path):
     """A surrendered bench run must carry the full adjudication picture
     (r3 verdict Next #1): per-attempt phases, final hang diagnosis,
-    process table, relay sockets."""
+    process table, relay sockets. The probe children are HELD via the
+    injected hold-file gate (same determinism rig as
+    test_probe_backend_timeout_pins_phase): without it, a fast
+    scheduling window let a 0.05s-timeout child reach 'completed' and
+    flake the final_diagnosis assertion."""
     import pathlib
     sys.path.insert(0, str(pathlib.Path(__file__).parents[1]))
     import bench
     monkeypatch.setenv('SKYTPU_BENCH_PROBE_TIMEOUTS', '0.05,0.05')
+    gate = tmp_path / 'release-bench-probe-children'
+    monkeypatch.setenv('SKYTPU_PROBE_HOLD_FILE', str(gate))
     bench._PROBE_DIAGNOSTICS.clear()
-    assert bench._tpu_reachable() is False
+    try:
+        assert bench._tpu_reachable() is False
+    finally:
+        gate.touch()  # release the detached children; they exit alone
     d = bench._PROBE_DIAGNOSTICS
     assert len(d['failed_attempts']) == 2
     assert d['final_diagnosis'] and d['final_diagnosis'] != 'completed'

@@ -33,6 +33,19 @@ the goodput phase ledger must be terminal-closed, monotonic, gap-free,
 sum to the job's wall-clock within 1%, contain a zone-annotated
 badput (recovering) interval, and yield a goodput ratio in (0, 1).
 Also wired into ``make verify``.
+
+``--ckpt`` runs the crash-consistent checkpointing gate
+(skypilot_tpu/ckpt/): (a) sync vs async trainer runs produce
+byte-identical stdout (loss trajectory) while the async per-save
+step-loop stall stays under 50% of the sync save's wall-time;
+(b) a deterministic kill -9 mid-commit (hold-file injection between
+manifest and commit marker) leaves a directory that restores from the
+last COMMITTED step, the relaunch resumes there and completes, every
+surviving step checksum-verifies, and the torn partial is GC'd;
+(c) a fake-cloud managed job training through an injected preemption
+with its checkpoint dir on a mounted bucket — the goodput ledger
+carries nonzero checkpoint save+restore accounting and the
+skytpu_ckpt_* gauges expose it. Also wired into ``make verify``.
 """
 import json
 import os
@@ -319,6 +332,250 @@ def _trainer_telemetry_parity(workdir: str) -> dict:
             'stdout_bytes': len(r_on.stdout)}
 
 
+def _trainer_argv(ckpt_dir: str, steps: int, save_every: int,
+                  extra: list = ()) -> list:
+    return [sys.executable, '-m', 'skypilot_tpu.train.run',
+            '--model', 'tiny', '--steps', str(steps),
+            '--global-batch-size', '2', '--seq-len', '16',
+            '--log-every', '2', '--save-every', str(save_every),
+            '--ckpt-dir', ckpt_dir, *extra]
+
+
+def _ckpt_stall_parity(workdir: str) -> dict:
+    """(a) of the --ckpt gate: sync vs async runs are byte-identical on
+    stdout (the loss trajectory — async persists must not perturb the
+    data/step path) and the async step-loop stall per save is < 50% of
+    the sync save's wall-time. A 50 ms step floor gives the background
+    committer headroom so the async stall measures the snapshot, not
+    back-pressure; the whole block retries against sandbox cpu-quota
+    noise (one clean attempt proves the pipeline)."""
+    import statistics
+    import subprocess
+
+    from skypilot_tpu.observability import train_telemetry
+
+    attempts = []
+    for attempt in range(3):
+        stdout, saves = {}, {}
+        for mode in ('sync', 'async'):
+            ckdir = os.path.join(workdir, f'ck-{mode}-{attempt}')
+            spool = os.path.join(workdir, f'telem-{mode}-{attempt}')
+            env = dict(os.environ, JAX_PLATFORMS='cpu')
+            env[train_telemetry.ENV_DIR] = spool
+            argv = _trainer_argv(ckdir, steps=8, save_every=2,
+                                 extra=['--step-time-floor', '0.05']
+                                 + (['--ckpt-sync'] if mode == 'sync'
+                                    else []))
+            r = subprocess.run(argv, env=env, capture_output=True,
+                               timeout=600)
+            assert r.returncode == 0, r.stderr[-2000:]
+            stdout[mode] = r.stdout
+            saves[mode] = [rec for rec in
+                           train_telemetry.read_records(spool)
+                           if rec.get('kind') == 'ckpt'
+                           and rec.get('op') == 'save']
+        assert stdout['sync'] == stdout['async'], (
+            'async checkpointing changed the loss trajectory',
+            stdout['sync'][-400:], stdout['async'][-400:])
+        assert len(saves['sync']) == len(saves['async']) == 4, saves
+        assert all(not rec['async'] for rec in saves['sync'])
+        assert all(rec['async'] for rec in saves['async'])
+        sync_save = statistics.median(r['seconds'] for r in saves['sync'])
+        async_stall = statistics.median(r['stall_s']
+                                        for r in saves['async'])
+        attempts.append({'sync_save_s': round(sync_save, 5),
+                         'async_stall_s': round(async_stall, 5)})
+        if async_stall < 0.5 * sync_save:
+            return {'sync_save_s_p50': attempts[-1]['sync_save_s'],
+                    'async_stall_s_p50': attempts[-1]['async_stall_s'],
+                    'stall_ratio': round(async_stall / sync_save, 4),
+                    'attempts': attempts}
+    raise AssertionError(
+        f'async stall >= 50% of sync save in every attempt: {attempts}')
+
+
+def _ckpt_kill_mid_commit(workdir: str) -> dict:
+    """(b) of the --ckpt gate: kill -9 exactly between a step's manifest
+    and its commit marker; the directory must restore from the last
+    COMMITTED step, the relaunch resumes there and completes, and the
+    torn partial is swept."""
+    import subprocess
+    import time as time_lib
+
+    from skypilot_tpu.ckpt import committer as committer_lib
+    from skypilot_tpu.ckpt import manifest as manifest_lib
+
+    ckdir = os.path.join(workdir, 'ck-crash')
+    hold = os.path.join(workdir, 'ckpt-hold')
+    with open(hold, 'w', encoding='utf-8'):
+        pass
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env[committer_lib.ENV_HOLD_FILE] = hold
+    env[committer_lib.ENV_HOLD_STEP] = '4'
+    argv = _trainer_argv(ckdir, steps=8, save_every=2)
+    proc = subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT)
+    tmp = os.path.join(
+        ckdir, manifest_lib.step_dirname(4) + manifest_lib.TMP_SUFFIX)
+    try:
+        deadline = time_lib.time() + 300
+        # The committer parks AFTER writing shards + MANIFEST into the
+        # .tmp dir and BEFORE the COMMIT marker — the canonical torn
+        # write a spot kill produces.
+        while not os.path.exists(os.path.join(
+                tmp, manifest_lib.MANIFEST_FILE)):
+            assert proc.poll() is None, proc.stdout.read()[-2000:]
+            assert time_lib.time() < deadline, 'hold point never reached'
+            time_lib.sleep(0.05)
+        proc.kill()  # SIGKILL: no cleanup handler gets to run
+        proc.wait(timeout=60)
+    finally:
+        os.unlink(hold)
+        if proc.poll() is None:
+            proc.kill()
+    committed = [s for s, _ in manifest_lib.committed_steps(ckdir)]
+    assert committed == [2], (committed, os.listdir(ckdir))
+    assert os.path.isdir(tmp), 'expected the torn .tmp partial'
+
+    env_clean = dict(os.environ, JAX_PLATFORMS='cpu')
+    r = subprocess.run(_trainer_argv(ckdir, steps=8, save_every=2),
+                       env=env_clean, capture_output=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert b'resumed from checkpoint step 2' in r.stdout, r.stdout[-800:]
+    steps_after = [s for s, _ in manifest_lib.committed_steps(ckdir)]
+    assert steps_after and steps_after[-1] == 8, steps_after
+    for _, path in manifest_lib.committed_steps(ckdir):
+        report = manifest_lib.verify_step(path, deep=True)
+        assert report['ok'], report
+    assert not manifest_lib.partial_dirs(ckdir), \
+        ('torn partial survived GC', os.listdir(ckdir))
+    return {'resumed_from_step': 2, 'final_step': steps_after[-1],
+            'committed_steps': steps_after}
+
+
+def ckpt_probe() -> dict:
+    """Crash-consistent checkpointing gate (see module docstring)."""
+    import tempfile
+    import threading
+    import time as time_lib
+
+    from skypilot_tpu.utils import tpu_doctor
+    tpu_doctor.session_fingerprint()  # daemons we spawn become reapable
+    workdir = tempfile.mkdtemp(prefix='skytpu-ckpt-')
+    out = {'stall': _ckpt_stall_parity(workdir),
+           'crash': _ckpt_kill_mid_commit(workdir)}
+
+    # (c) managed job on the fake cloud: train through an injected
+    # preemption with the checkpoint dir on a mounted bucket; the
+    # goodput ledger and the skytpu_ckpt_* gauges must carry nonzero
+    # save+restore accounting for the run.
+    os.environ['SKYTPU_STATE_DIR'] = os.path.join(workdir, 'state')
+    os.environ['SKYTPU_ENABLE_FAKE_CLOUD'] = '1'
+    os.environ.setdefault('SKYTPU_LOCAL_BUCKET_ROOT',
+                          os.path.join(workdir, 'buckets'))
+    from skypilot_tpu import global_user_state
+    from skypilot_tpu.agent import daemon as daemon_lib
+    from skypilot_tpu.ckpt import manifest as manifest_lib
+    from skypilot_tpu.jobs import state as jobs_state
+    from skypilot_tpu.jobs.controller import JobController
+    from skypilot_tpu.provision.fake import instance as fake
+    from skypilot_tpu.server import metrics as metrics_lib
+    from skypilot_tpu.task import Task
+    fake.reset_state()
+
+    mnt = os.path.join(workdir, 'ckpt-mnt')
+    trainer_cmd = ' '.join(_trainer_argv(mnt, steps=36, save_every=3,
+                                         extra=['--step-time-floor',
+                                                '0.15']))
+    task = Task.from_yaml_config({
+        'name': 'ckpt-probe',
+        'resources': {'cloud': 'fake', 'accelerators': 'tpu-v5e-8',
+                      'use_spot': True},
+        'file_mounts': {mnt: 'file://skytpu-ckpt-probe/run1'},
+        'envs': {'JAX_PLATFORMS': 'cpu'},
+        'run': trainer_cmd,
+    })
+    job_id = jobs_state.submit('ckpt-probe', task.to_yaml_config(),
+                               recovery_strategy='EAGER_FAILOVER')
+    jobs_state.set_status(job_id, jobs_state.ManagedJobStatus.SUBMITTED)
+    thread = threading.Thread(
+        target=lambda: JobController(job_id, poll_seconds=0.2).run(),
+        daemon=True)
+    thread.start()
+
+    bucket_dir = os.path.join(os.environ['SKYTPU_LOCAL_BUCKET_ROOT'],
+                              'skytpu-ckpt-probe', 'run1')
+
+    def wait_for(predicate, timeout, what):
+        deadline = time_lib.time() + timeout
+        while time_lib.time() < deadline:
+            if predicate():
+                return
+            rec = jobs_state.get(job_id)
+            if rec is not None and rec['status'].is_terminal():
+                raise AssertionError(
+                    f'job went terminal before {what}: {rec["status"]}, '
+                    f'events={jobs_state.events(job_id)}')
+            time_lib.sleep(0.2)
+        raise AssertionError(
+            f'timed out waiting for {what}; status='
+            f'{jobs_state.get(job_id)["status"]}, '
+            f'events={jobs_state.events(job_id)}')
+
+    wait_for(lambda: bool(manifest_lib.committed_steps(bucket_dir)),
+             300, 'first committed checkpoint in the bucket')
+    rec = jobs_state.get(job_id)
+    cluster = global_user_state.get_cluster(rec['cluster_name'])
+    fake.preempt_cluster(cluster['handle']['cluster_name_on_cloud'])
+
+    # While the relaunched incarnation runs, drive one heartbeat and
+    # assert the ckpt gauges surface on the fleet scrape.
+    metrics_seen = None
+    deadline = time_lib.time() + 300
+    while time_lib.time() < deadline:
+        record = jobs_state.get(job_id)
+        if record['status'].is_terminal():
+            break
+        name = record['cluster_name']
+        if name and global_user_state.get_cluster(name) is not None:
+            hb = daemon_lib.heartbeat_once(name)
+            if hb and isinstance(hb.get('ckpt'), dict) \
+                    and hb['ckpt'].get('last_step', 0) > 0:
+                text = metrics_lib.render().decode()
+                for line in text.splitlines():
+                    if line.startswith('skytpu_ckpt_last_step') \
+                            and not line.rstrip().endswith(' 0.0'):
+                        metrics_seen = line
+                if metrics_seen:
+                    break
+        time_lib.sleep(0.3)
+    assert metrics_seen, 'skytpu_ckpt_last_step never surfaced nonzero'
+
+    deadline = time_lib.time() + 300
+    while time_lib.time() < deadline:
+        record = jobs_state.get(job_id)
+        if record['status'].is_terminal():
+            break
+        time_lib.sleep(0.2)
+    assert record['status'] == jobs_state.ManagedJobStatus.SUCCEEDED, \
+        (record['status'], jobs_state.events(job_id))
+    thread.join(timeout=10)
+
+    summary = jobs_state.goodput_summary(job_id)
+    ck = summary.get('ckpt')
+    assert ck, ('ledger carries no checkpoint accounting', summary)
+    assert ck['saves'] > 0 and ck['save_s'] > 0, ck
+    assert ck['restores'] >= 1 and ck['restore_s'] > 0, ck
+    assert ck['last_step'] == 36, ck
+    assert summary['badput_s'] > 0 and summary['recoveries'] >= 1, summary
+
+    tpu_doctor.reap_stray_processes()
+    return {**out, 'managed_job': {
+        'ckpt': ck, 'goodput_ratio': summary['goodput_ratio'],
+        'recoveries': summary['recoveries'],
+        'metrics_line': metrics_seen}}
+
+
 def goodput_probe() -> dict:
     """Managed-job goodput ledger gate on the fake cloud: one injected
     whole-slice preemption mid-run, then the ledger invariants the
@@ -406,6 +663,13 @@ def goodput_probe() -> dict:
 
 
 def main():
+    if '--ckpt' in sys.argv:
+        # CPU-only by design (same rationale as --smoke): never touch
+        # or wait on a chip in CI.
+        jax.config.update('jax_platforms', 'cpu')
+        print(json.dumps({'ckpt_smoke': 'ok', **ckpt_probe()}),
+              flush=True)
+        return
     if '--goodput' in sys.argv:
         # CPU-only by design (same rationale as --smoke): never touch
         # or wait on a chip in CI.
