@@ -21,7 +21,11 @@ ci:
 # Serving + telemetry smokes (CPU, seconds-to-a-minute; no chip
 # touched): the decode-overlap A/B, the QoS overload admission gate
 # (interactive bounded, batch absorbs 100% of sheds under 2x load),
-# the tracing gate (every sampled trace closes + nests, TTFT/queue-wait
+# the block-prefix-sharing gate (greedy byte parity sharing on vs off,
+# >= 40% fewer prefill tokens on an 80%-shared mix with CoW forks and
+# exact block-state reconciliation after drain, no decode regression
+# unshared, loadgen --shared-prefix hit rate nonzero), the tracing
+# gate (every sampled trace closes + nests, TTFT/queue-wait
 # histograms fill, greedy output byte-identical traced vs untraced),
 # the goodput gate (trainer stdout byte-identical with telemetry
 # off vs on; managed-job phase ledger gap-free and summing to
@@ -33,6 +37,7 @@ ci:
 verify:
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --smoke
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --qos
+	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --prefix
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --trace
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --goodput
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --ckpt

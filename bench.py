@@ -218,6 +218,188 @@ def _measure_engine_decode(model_cfg, params) -> dict:
     return out
 
 
+def prefix_share_probe(assert_gates: bool = False) -> dict:
+    """Copy-on-write block-prefix-sharing gate (models/paged.py
+    BlockTrie + the paged engine's pool-direct tail prefill) — shared
+    by ``bench.py`` (the ``prefix_share`` detail entry) and
+    ``tools/perf_probe.py --prefix`` (the CI gate, assert_gates=True).
+
+    Three legs, all CPU, tiny model:
+    (a) an 80%-shared mix (16/20 requests open with one 24-token head
+        — one full block plus a partial, so copy-on-write forks fire)
+        run share ON vs OFF on identical engines: greedy outputs must
+        be byte-identical, hit rate > 0, and the ON engine must
+        prefill-compute >= 40% fewer prompt tokens;
+    (b) a 0%-shared mix (fresh unique prompts EVERY round, so the ON
+        engine's commits never pay back): decode tok/s ON vs OFF as a
+        median of back-to-back paired rounds — the trie's bookkeeping
+        must not tax unshared traffic (>= 0.9x, 3 attempts, same drift
+        discipline as the decode-overlap smoke);
+    (c) an HTTP replica driven by ``loadgen --shared-prefix 0.8``
+        (2 tenants x shared head + unique tails, streamed): the
+        per-mix TTFT report fills and the engine's /health hit rate is
+        nonzero — the CLI-reproducible form of the win.
+    After every drain the free/owned/shared/cached block states must
+    reconcile exactly (no leaked blocks)."""
+    import asyncio
+    import statistics
+    import threading
+
+    import jax
+    import requests as requests_lib
+    from aiohttp import web
+
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.models.engine import ContinuousEngine
+    from skypilot_tpu.serve import llm_server as llm_mod
+    from skypilot_tpu.serve import loadgen
+    from skypilot_tpu.utils import common_utils
+
+    cfg = llama.TINY
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    head = [((11 * j) % 250) + 1 for j in range(24)]
+    rows80 = []
+    for i in range(20):
+        if i % 5 != 4:  # 16/20 = 80% shared
+            rows80.append(head + [((7 * i + j) % 250) + 1
+                                  for j in range(8)])
+        else:
+            rows80.append([((13 * i + j) % 250) + 1 for j in range(32)])
+
+    def _drained(kb):
+        return (kb['owned'] == 0 and kb['shared'] == 0
+                and kb['free'] + kb['cached'] == kb['usable'])
+
+    def _engine(share):
+        return ContinuousEngine(params, cfg, slots=4, max_len=64,
+                                chunk_steps=2, kv_layout='paged',
+                                prefix_share=share)
+
+    # (a) parity + savings on the 80% mix. The first request runs alone
+    # so its blocks are committed before the sharers arrive (concurrent
+    # first sightings all miss, like any cache).
+    outs, stats = {}, {}
+    for label, share in (('on', True), ('off', False)):
+        eng = _engine(share)
+        try:
+            out = [eng.submit(rows80[0], 6).result(timeout=600)]
+            futs = [eng.submit(r, 6) for r in rows80[1:]]
+            out += [f.result(timeout=600) for f in futs]
+            outs[label] = out
+            stats[label] = eng.stats()
+        finally:
+            eng.stop()
+    on, off = stats['on'], stats['off']
+    saved_frac = 1.0 - (on['prefill_tokens']
+                        / max(off['prefill_tokens'], 1))
+    summary = {
+        'parity_ok': outs['on'] == outs['off'],
+        'hits': on['prefix_share']['hits'],
+        'hit_rate': on['prefix_share']['hit_rate'],
+        'cow_forks': on['prefix_share']['cow_forks'],
+        'prefill_tokens_on': on['prefill_tokens'],
+        'prefill_tokens_off': off['prefill_tokens'],
+        'prefill_saved_frac': round(saved_frac, 4),
+        'drain_reconciled': (_drained(on['kv_blocks'])
+                            and _drained(off['kv_blocks'])),
+        'blocks_after_drain': {
+            k: on['kv_blocks'][k]
+            for k in ('free', 'owned', 'shared', 'cached', 'usable')},
+    }
+
+    # (b) decode parity on a genuinely 0%-shared mix: fresh prompts
+    # every round (same shapes — one compile), paired back-to-back.
+    attempts = []
+    for attempt in range(3):
+        engines = {lbl: _engine(lbl == 'on') for lbl in ('on', 'off')}
+        try:
+            warm = [[((41 * attempt + 5 * i + j) % 250) + 1
+                     for j in range(24)] for i in range(12)]
+            for eng in engines.values():
+                for f in [eng.submit(r, 8) for r in warm]:
+                    f.result(timeout=600)
+            rates = {lbl: [] for lbl in engines}
+            for rnd in range(3):
+                order = list(engines.items())
+                if rnd % 2:
+                    order.reverse()
+                rows0 = [[((59 * attempt + 13 * rnd + 7 * i + j) % 250)
+                          + 1 for j in range(24)] for i in range(12)]
+                for lbl, eng in order:
+                    t0 = time.perf_counter()
+                    futs = [eng.submit(r, 8) for r in rows0]
+                    toks = sum(len(f.result(timeout=600)) for f in futs)
+                    rates[lbl].append(toks / (time.perf_counter() - t0))
+        finally:
+            for eng in engines.values():
+                eng.stop()
+        ratio = statistics.median(o / s for o, s in zip(rates['on'],
+                                                        rates['off']))
+        attempts.append(round(ratio, 3))
+        if ratio >= 0.9:
+            break
+    summary['decode_ratio_unshared'] = attempts[-1]
+    summary['decode_ratio_attempts'] = attempts
+
+    # (c) the CLI-reproducible form: loadgen --shared-prefix against a
+    # paged replica, per-mix TTFT + engine hit rate in one report.
+    server = llm_mod.LlmServer('tiny', max_len=64, engine='continuous',
+                               kv_layout='paged')
+    port = common_utils.find_free_port(23600)
+    started = threading.Event()
+
+    def run():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(server.make_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, '127.0.0.1', port)
+        loop.run_until_complete(site.start())
+        started.set()
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    if not started.wait(30):
+        raise RuntimeError('prefix probe replica failed to start')
+    url = f'http://127.0.0.1:{port}'
+    try:
+        requests_lib.post(f'{url}/generate',
+                          json={'tokens': [[1, 2, 3, 4, 5, 6, 7, 8]],
+                                'max_new_tokens': 4},
+                          timeout=600).raise_for_status()
+        load = asyncio.run(loadgen.run_load(
+            url, requests_total=12, concurrency=3, prompt_len='6:10',
+            max_new='8', vocab=256, stream=True, tenants=2,
+            shared_prefix=0.8, shared_prefix_len=24))
+    finally:
+        if server.engine is not None:  # built lazily on first request
+            server.engine.stop()
+    sp = load.get('shared_prefix') or {}
+    eng_side = (sp.get('engine') or {})
+    summary['loadgen'] = {
+        'ok': load.get('ok'),
+        'shared_p50_ttft_s': (sp.get('shared') or {}).get('p50_ttft_s'),
+        'unique_p50_ttft_s': (sp.get('unique') or {}).get('p50_ttft_s'),
+        'engine_hits': ((eng_side.get('prefix_share') or {})
+                        .get('hits')),
+        'engine_hit_rate': ((eng_side.get('prefix_share') or {})
+                            .get('hit_rate')),
+    }
+
+    if assert_gates:
+        assert summary['parity_ok'], 'sharing changed greedy output'
+        assert summary['hits'] > 0 and summary['hit_rate'] > 0, summary
+        assert summary['cow_forks'] >= 1, summary
+        assert summary['prefill_saved_frac'] >= 0.4, summary
+        assert summary['drain_reconciled'], summary
+        assert summary['decode_ratio_unshared'] >= 0.9, summary
+        lg = summary['loadgen']
+        assert lg['ok'] == 12, summary
+        assert lg['engine_hits'] and lg['engine_hits'] > 0, summary
+        assert lg['shared_p50_ttft_s'] is not None, summary
+    return summary
+
+
 def qos_overload_probe(assert_gates: bool = False) -> dict:
     """Deterministic 2x-overload probe for the QoS admission layer
     (serve/qos.py) — shared by ``bench.py`` (the ``qos_overload``
@@ -515,8 +697,18 @@ def _bench_tpu() -> dict:
                                             wants_real_chip)
     apply_jax_platform_env()
     want_tpu = wants_real_chip()
+    tpu_unreachable = False
     if want_tpu and not _tpu_reachable():
-        print('[bench] TPU backend unreachable; falling back to CPU',
+        # LOUD failure, not a silent trajectory lie: the run still
+        # completes on CPU (so the artifact line always exists), but
+        # the headline metric is marked FAILED with the stuck init
+        # phase named — see mark_tpu_unreachable.
+        tpu_unreachable = True
+        print('[bench] TPU expected but UNREACHABLE after all probe '
+              'attempts (stuck phase: '
+              f"{_PROBE_DIAGNOSTICS.get('final_hang_phase')!r}); the "
+              'artifact will record a FAILED TPU metric with the CPU '
+              'measurement demoted to detail.cpu_reference',
               file=sys.stderr)
         os.environ['JAX_PLATFORMS'] = 'cpu'
         import jax
@@ -582,6 +774,13 @@ def _bench_tpu() -> dict:
         qos_overload = {'error': f'{type(exc).__name__}: '
                                  f'{str(exc)[:160]}'}
     try:
+        # Block-prefix sharing A/B: parity, prefill-token savings on an
+        # 80%-shared mix, decode parity unshared, loadgen TTFT per mix.
+        prefix_share = prefix_share_probe()
+    except Exception as exc:  # secondary metric: never kill the bench
+        prefix_share = {'error': f'{type(exc).__name__}: '
+                                 f'{str(exc)[:160]}'}
+    try:
         # Checkpoint-stall A/B: what the step loop pays per save, sync
         # persist vs async snapshot (skypilot_tpu/ckpt/).
         checkpoint_stall = ckpt_stall_probe()
@@ -591,7 +790,7 @@ def _bench_tpu() -> dict:
 
     baseline_tflops_per_chip = 23.48  # reference recipe, see module docstring
     n_chips = jax.device_count()
-    return {
+    result = {
         'metric': 'llama_train_model_tflops_per_chip',
         # 6 digits: a CPU-fallback run's tiny-model throughput must not
         # round to a metric-less 0.0 (r4 lesson: ALWAYS record a number).
@@ -621,10 +820,35 @@ def _bench_tpu() -> dict:
             'decode_tokens_per_sec': decode_tps,
             'decode_variants': decode_variants,
             'qos_overload': qos_overload,
+            'prefix_share': prefix_share,
             'checkpoint_stall': checkpoint_stall,
             'cpu_fallback': not on_tpu,
         },
     }
+    if tpu_unreachable:
+        result = mark_tpu_unreachable(result, _PROBE_DIAGNOSTICS)
+    return result
+
+
+def mark_tpu_unreachable(result: dict, diagnostics: dict) -> dict:
+    """A wanted-TPU run whose phased probe never reached the chip must
+    FAIL LOUDLY (ROADMAP bench caveat: since r02 a silent CPU fallback
+    masqueraded as the TPU trajectory). The headline metric becomes 0.0
+    with the stuck init phase named inline; the CPU measurement is
+    demoted to ``detail.cpu_reference`` — still recorded, never the
+    trajectory."""
+    detail = result.setdefault('detail', {})
+    detail['cpu_reference'] = {
+        'tflops_per_chip': result.get('value'),
+        'tokens_per_sec_per_chip': detail.get('tokens_per_sec_per_chip'),
+    }
+    detail['tpu_unreachable'] = True
+    detail['tpu_stuck_phase'] = diagnostics.get('final_hang_phase')
+    detail['tpu_diagnosis'] = (diagnostics.get('final_diagnosis')
+                               or 'probe failed')[:200]
+    result['value'] = 0.0
+    result['vs_baseline'] = 0.0
+    return result
 
 
 def _diag_summary(diag: dict) -> str:
@@ -674,8 +898,9 @@ def finalize_result(result: dict, diagnostics: dict | None = None,
     line = render()
     # Progressive offload: if the line is still too big, move the
     # largest optional detail blocks to the sidecar, biggest first.
-    for key in ('sweep', 'qos_overload', 'decode_variants',
-                'checkpoint_stall', 'probe_diagnostics'):
+    for key in ('sweep', 'qos_overload', 'prefix_share',
+                'decode_variants', 'checkpoint_stall',
+                'probe_diagnostics'):
         if len(line.encode()) <= MAX_ARTIFACT_BYTES:
             break
         if key in detail and detail[key] is not None:

@@ -389,7 +389,8 @@ class ContinuousEngine:
                  kv_layout: Optional[str] = None,
                  kv_blocks: Optional[int] = None,
                  kv_block: Optional[int] = None,
-                 pipeline: Optional[bool] = None):
+                 pipeline: Optional[bool] = None,
+                 prefix_share: Optional[bool] = None):
         self.params = params
         self.cfg = cfg
         # Speculative mode (see module docstring): draft proposes,
@@ -502,6 +503,23 @@ class ContinuousEngine:
         # paged insert scatters the seeded rows into blocks like any
         # other prefill.
         self.prefix_min = 16  # smallest cacheable/matchable prefix
+        # COPY-ON-WRITE BLOCK SHARING (paged layout only; default ON):
+        # committed full prompt blocks are indexed in a host-side trie
+        # (models/paged.py BlockTrie) with per-block refcounts; a
+        # matching request points its block table at the shared blocks
+        # — a hit is a table write, not a KV copy — and prefills only
+        # its unshared tail directly over the pool. A partially-matched
+        # tail block copy-on-write-forks; eviction is refcount-aware
+        # LRU over idle blocks. Dense models only (same MoE capacity
+        # coupling as the prefix pool); spec mode keeps its own dense
+        # draft-cache prefill path and opts out.
+        if prefix_share is None:
+            prefix_share = os.environ.get('SKYTPU_LLM_PREFIX_SHARE',
+                                          '1') != '0'
+        self.prefix_share = (bool(prefix_share)
+                             and self.kv_layout == 'paged'
+                             and cfg.num_experts == 0
+                             and draft_cfg is None)
         self._prefix_index: 'collections.OrderedDict[tuple, int]' = \
             collections.OrderedDict()  # prefix tokens -> pool row
         self._prefix_seen: 'collections.OrderedDict[tuple, int]' = \
@@ -571,6 +589,20 @@ class ContinuousEngine:
         self.prefix_hits = 0
         self.prefix_hit_tokens = 0
         self.prefix_stores = 0
+        # Block-share accounting (prefix_share; see stats()).
+        self.share_hits = 0
+        self.share_hit_tokens = 0
+        self.share_misses = 0
+        self.share_commits = 0
+        self.share_evictions = 0
+        self.cow_forks = 0
+        # Prefill cost counters (all layouts): real prompt tokens the
+        # prefill actually computed vs tokens skipped via shared/cached
+        # prefix KV — the probe's >= 40% savings gate reads these.
+        self.prefill_tokens = 0
+        self.prefill_tokens_saved = 0
+        self.prefill_ms = 0.0
+        self.prefill_bubble_ms = 0.0  # prefill host time decode waited on
         self.chunks_run = 0
         self.tokens_emitted = 0
         self.peak_active = 0
@@ -664,11 +696,16 @@ class ContinuousEngine:
         with self._lock:
             active = sum(r is not None for r in self._slot_req)
             queued = len(self._pending)
-            # ONE read: free and used must agree within a snapshot
-            # (used + free == usable), or the dashboard can render an
-            # impossible state mid-admission.
-            free_blocks = (len(self._free_blocks)
-                           if self.kv_layout == 'paged' else 0)
+            # ONE read: the block states must agree within a snapshot
+            # (free + owned + shared + cached == usable), or the
+            # dashboard can render an impossible state mid-admission.
+            free_blocks = owned_blocks = shared_blocks = cached_blocks = 0
+            if self.kv_layout == 'paged':
+                free_blocks = len(self._free_blocks)
+                owned_blocks = sum(len(b) for b in self._slot_blocks)
+                if self._trie is not None:
+                    shared_blocks = self._trie.referenced
+                    cached_blocks = self._trie.reclaimable
         return {'slots': self.slots, 'active_slots': active,
                 'kv_cache': 'int8' if self.kv_quantize else 'bf16',
                 'kv_layout': self.kv_layout,
@@ -677,9 +714,20 @@ class ContinuousEngine:
                     'free': free_blocks,
                     # used/usable are authoritative here (block 0 is
                     # the junk sink): consumers must not re-derive the
-                    # convention (review finding).
+                    # convention (review finding). With block sharing,
+                    # physical non-free blocks split into owned
+                    # (slot-exclusive), shared (trie-committed,
+                    # refcounted by >= 1 live slot), and cached (idle
+                    # refs-0, reclaimable by LRU eviction); the states
+                    # partition exactly — the old used = total-1-free
+                    # would double-count a block every time two slots
+                    # reference it.
                     'usable': self.kv_blocks - 1,
-                    'used': self.kv_blocks - 1 - free_blocks}),
+                    'used': self.kv_blocks - 1 - free_blocks,
+                    'owned': owned_blocks,
+                    'shared': shared_blocks,
+                    'cached': cached_blocks,
+                    'cow_forks': self.cow_forks}),
                 'queued': queued, 'prefills': self.prefills,
                 'prefill_groups': self.prefill_groups,
                 'prefill_batch': self.prefill_batch,
@@ -717,7 +765,31 @@ class ContinuousEngine:
                     'entries': len(self._prefix_index),
                     'hits': self.prefix_hits,
                     'hit_tokens': self.prefix_hit_tokens,
-                    'stores': self.prefix_stores}}
+                    'stores': self.prefix_stores},
+                # Copy-on-write block sharing (paged layout; see the
+                # ctor comment). prefill_tokens is the prompt tokens
+                # prefill actually COMPUTED across all paths;
+                # prefill_tokens_saved is what shared/cached prefix KV
+                # skipped — the pair the perf_probe --prefix savings
+                # gate reads. prefill_bubble_ms is cumulative prefill
+                # host time decode provably waited on.
+                'prefix_share': {
+                    'enabled': self.prefix_share,
+                    'hits': self.share_hits,
+                    'hit_tokens': self.share_hit_tokens,
+                    'misses': self.share_misses,
+                    'hit_rate': round(
+                        self.share_hits
+                        / max(self.share_hits + self.share_misses, 1), 4),
+                    'commits': self.share_commits,
+                    'evictions': self.share_evictions,
+                    'cow_forks': self.cow_forks,
+                    'shared_blocks': shared_blocks,
+                    'cached_blocks': cached_blocks},
+                'prefill_tokens': self.prefill_tokens,
+                'prefill_tokens_saved': self.prefill_tokens_saved,
+                'prefill_ms': round(self.prefill_ms, 3),
+                'prefill_bubble_ms': round(self.prefill_bubble_ms, 3)}
 
     # -- engine thread -----------------------------------------------------
 
@@ -799,6 +871,10 @@ class ContinuousEngine:
         kv = self._kv_sharding if self.mesh is not None else None
         kv_s = self._kv_scale_sharding if self.mesh is not None else None
         vec = self._vec_sharding if self.mesh is not None else None
+        # Share-trie state exists on every layout (None = sharing off)
+        # so the admission/release paths never branch on layout first.
+        self._trie = None
+        self._slot_shared = [[] for _ in range(self.slots)]
         if self.kv_layout == 'paged':
             from skypilot_tpu.models import paged as paged_lib
             pool_kv = pool_s = None
@@ -821,10 +897,14 @@ class ContinuousEngine:
                 lengths_sharding=vec)
             # Host-side accounting: block 0 is the junk sink, never
             # allocated; per-slot block lists return to the free list
-            # when the slot's request completes.
+            # when the slot's request completes. With block sharing,
+            # _slot_blocks holds only the slot's OWNED blocks; shared
+            # (trie-committed, refcounted) blocks live in _slot_shared.
             self._free_blocks = list(range(1, self.kv_blocks))
             self._slot_blocks: List[List[int]] = [
                 [] for _ in range(self.slots)]
+            self._trie = (paged_lib.BlockTrie(self.kv_block)
+                          if self.prefix_share else None)
         else:
             self._cache = gen_lib.init_cache(
                 self.cfg, self.slots, self.max_len, kv_sharding=kv,
@@ -865,6 +945,34 @@ class ContinuousEngine:
         if self.kv_layout == 'paged':
             self._free_blocks.extend(self._slot_blocks[slot])
             self._slot_blocks[slot] = []
+            if self._trie is not None and self._slot_shared[slot]:
+                # Shared blocks DECREF instead of freeing: refs-0 blocks
+                # park in the trie's idle LRU as reusable cache (a
+                # detached node's block frees for real).
+                for node in self._slot_shared[slot]:
+                    freed = self._trie.release(node)
+                    if freed is not None:
+                        self._free_blocks.append(freed)
+                self._slot_shared[slot] = []
+
+    def _blocks_avail(self) -> int:
+        """Allocatable blocks RIGHT NOW: the free list plus idle
+        (refs == 0) trie blocks the allocator may evict. Callers hold
+        the lock."""
+        avail = len(self._free_blocks)
+        if self._trie is not None:
+            avail += self._trie.reclaimable
+        return avail
+
+    def _alloc_blocks(self, n: int) -> List[int]:
+        """Pop ``n`` blocks, refcount-aware-LRU-evicting idle trie
+        blocks when the free list runs short. Callers hold the lock and
+        have checked ``_blocks_avail() >= n``."""
+        if len(self._free_blocks) < n and self._trie is not None:
+            freed = self._trie.evict(n - len(self._free_blocks))
+            self.share_evictions += len(freed)
+            self._free_blocks.extend(freed)
+        return [self._free_blocks.pop() for _ in range(n)]
 
     @staticmethod
     def _fire_callbacks(emitted: List[tuple]) -> None:
@@ -909,51 +1017,231 @@ class ContinuousEngine:
                 if (self.prefill_chunk and self._pending
                         and len(self._pending[0].row) > self.prefill_chunk):
                     return  # long head waiting on prefill capacity
-                free = [i for i, r in enumerate(self._slot_req)
-                        if r is None]
-                # Slots owed to parked finished prefills are reserved —
-                # without this, a sustained short-prompt stream would
-                # starve the long request forever (it holds a scratch
-                # cache row and blocks further long admissions while
-                # parked).
-                parked = sum(1 for e in self._prefilling if e.parked)
-                n = min(max(len(free) - parked, 0), len(self._pending),
-                        self.prefill_batch)
-                if self.prefill_chunk:
-                    # Only CONSECUTIVE short requests join a group.
-                    run = 0
-                    for p in self._pending:
-                        if len(p.row) > self.prefill_chunk or run >= n:
-                            break
-                        run += 1
-                    n = run
-                if self.kv_layout == 'paged':
-                    # Backpressure: admit only requests whose block
-                    # reservation fits the free pool; the rest queue.
-                    avail = len(self._free_blocks)
-                    run = 0
-                    for p in self._pending:
-                        if run >= n:
-                            break
-                        nb = (self._blocks_needed(p)
-                              if p.max_new > 1 else 0)
-                        if nb > avail:
-                            break
-                        avail -= nb
-                        run += 1
-                    n = run
-                if n == 0:
-                    return
-                g = 1
-                while g * 2 <= n:
-                    g *= 2
-                reqs = [self._pending.popleft() for _ in range(g)]
-                # Mid-prefill requests live in NO other structure — a
-                # device failure here must still fail their futures.
-                self._admitting = reqs
+                # Block-share HIT at the queue head: it leaves the
+                # grouped path for a pool-direct tail prefill (the
+                # shared head is a table write; only the short unshared
+                # tail computes). FIFO is preserved — a hit head that
+                # cannot admit yet (no slot / no blocks) parks the
+                # queue rather than letting younger requests jump it.
+                shared = None
+                if (self._trie is not None and self._pending
+                        and self._pending[0].max_new > 1):
+                    head = self._pending[0]
+                    nodes, partial, plen = self._trie.match(head.row)
+                    if nodes:
+                        free_s = [i for i, r in enumerate(self._slot_req)
+                                  if r is None]
+                        pk = sum(1 for e in self._prefilling if e.parked)
+                        need = self._blocks_needed(head) - len(nodes)
+                        # The matched chain's IDLE blocks are about to
+                        # be pinned, so they must not count as
+                        # allocatable supply for this same admission —
+                        # counting them would pass the check, then
+                        # _alloc_blocks finds the idle LRU already
+                        # drained by acquire() and pops an empty free
+                        # list (engine-thread crash).
+                        pinned = sum(1 for nd in nodes if nd.refs == 0)
+                        p_idle = int(partial is not None
+                                     and partial.refs == 0)
+                        if (self._blocks_avail() - pinned - p_idle < need
+                                and partial is not None):
+                            # The fork donor is pure upside — drop it
+                            # (full-block hit only) before parking the
+                            # whole queue on its pin.
+                            partial, plen = None, 0
+                            p_idle = 0
+                        if (len(free_s) - pk <= 0
+                                or self._blocks_avail() - pinned - p_idle
+                                < need):
+                            return  # backpressure: the head waits
+                        # Pin the matched chain (and the CoW fork
+                        # donor) BEFORE allocating — eviction must not
+                        # reclaim blocks this admission is using.
+                        # (LRU recency lands at release() time, when
+                        # the node re-enters the idle dict.)
+                        for nd in nodes:
+                            self._trie.acquire(nd)
+                        if partial is not None:
+                            self._trie.acquire(partial)
+                        owned = self._alloc_blocks(need)
+                        slot = free_s[0]
+                        self._pending.popleft()
+                        self._slot_req[slot] = head
+                        self._slot_blocks[slot] = list(owned)
+                        self._slot_shared[slot] = list(nodes)
+                        self._admitting = [head]
+                        shared = (head, slot, nodes, partial, plen,
+                                  owned)
+                if shared is None:
+                    free = [i for i, r in enumerate(self._slot_req)
+                            if r is None]
+                    # Slots owed to parked finished prefills are
+                    # reserved — without this, a sustained short-prompt
+                    # stream would starve the long request forever (it
+                    # holds a scratch cache row and blocks further long
+                    # admissions while parked).
+                    parked = sum(1 for e in self._prefilling if e.parked)
+                    n = min(max(len(free) - parked, 0),
+                            len(self._pending), self.prefill_batch)
+                    if self.prefill_chunk:
+                        # Only CONSECUTIVE short requests join a group.
+                        run = 0
+                        for p in self._pending:
+                            if len(p.row) > self.prefill_chunk or run >= n:
+                                break
+                            run += 1
+                        n = run
+                    if self.kv_layout == 'paged':
+                        # Backpressure: admit only requests whose block
+                        # reservation fits the allocatable pool (free +
+                        # evictable idle); the rest queue. A later
+                        # block-share HIT also ends the group — it
+                        # becomes the head next iteration and takes the
+                        # pool-direct path instead of re-prefilling its
+                        # shared head.
+                        avail = self._blocks_avail()
+                        run = 0
+                        for p in self._pending:
+                            if run >= n:
+                                break
+                            if (run > 0 and self._trie is not None
+                                    and p.max_new > 1
+                                    and self._trie.match(p.row)[0]):
+                                break
+                            nb = (self._blocks_needed(p)
+                                  if p.max_new > 1 else 0)
+                            if nb > avail:
+                                break
+                            avail -= nb
+                            run += 1
+                        n = run
+                    if n == 0:
+                        return
+                    g = 1
+                    while g * 2 <= n:
+                        g *= 2
+                    reqs = [self._pending.popleft() for _ in range(g)]
+                    # Mid-prefill requests live in NO other structure —
+                    # a device failure here must still fail their
+                    # futures.
+                    self._admitting = reqs
+            if shared is not None:
+                self._admit_shared(*shared)
+                with self._lock:
+                    self._admitting = []
+                continue
             self._prefill_group(reqs, free[:g])
             with self._lock:
                 self._admitting = []
+
+    def _admit_shared(self, req: _Request, slot: int, nodes: list,
+                      partial, plen: int, owned: List[int]) -> None:
+        """Admit ONE block-share hit: the table head points at the
+        shared blocks (incref'd by _admit), a partially matched tail
+        block is copy-on-write-forked into the first owned block, and
+        only the unshared tail prefills — directly over the pool
+        (models/paged.py jit_prefill_shared), no dense scratch row and
+        no insert copy."""
+        from skypilot_tpu.models import paged as paged_lib
+        t0 = time.perf_counter()
+        had_active = any(r is not None and r is not req
+                         for r in self._slot_req)
+        p = self.kv_block
+        row = req.row
+        covered = len(nodes) * p + plen
+        mb = self.max_len // p
+        table = np.zeros((mb,), np.int32)
+        table[:len(nodes)] = [nd.block for nd in nodes]
+        table[len(nodes):len(nodes) + len(owned)] = owned
+        if partial is not None:
+            # First append past the shared partial block forks it: copy
+            # the donor into our first owned block; the tail prefill
+            # then writes from in-block offset ``plen``.
+            self._cache = paged_lib.jit_fork_block(
+                self._cache, jnp.int32(partial.block), jnp.int32(owned[0]))
+            self.cow_forks += 1
+        suffix = row[covered:]
+        # The padded width must not overhang max_len: positions past
+        # the table are CLIPPED to its last entry, and with a full
+        # reservation that entry is the request's own live block — the
+        # padded junk would scribble over real prompt KV (the same
+        # hazard the dense path's demote guard covers). Room always
+        # suffices: submit validates row + max_new <= max_len, so
+        # max_len - covered >= len(suffix) + max_new.
+        w = min(prompt_bucket(len(suffix)), self.max_len - covered)
+        padded = np.zeros((1, w), np.int32)
+        padded[0, :len(suffix)] = suffix
+        logits, self._cache = paged_lib.jit_prefill_shared(
+            self.cfg, self.params, self._cache, padded, table[None],
+            jnp.int32(slot), np.asarray([covered], np.int32),
+            np.asarray([len(suffix)], np.int32), self._shard_ctx)
+        first = _jit_sample(
+            logits, np.asarray([req.temperature], np.float32),
+            self._next_key(),
+            *_filters_or_none(np.asarray([req.top_k], np.int32),
+                              np.asarray([req.top_p], np.float32)))
+        self._last = self._last.at[jnp.asarray([slot], jnp.int32)].set(
+            first)
+        with self._lock:
+            if partial is not None:
+                # The fork donor was pinned only across the copy
+                # dispatch; it returns to the idle LRU (or frees, if an
+                # eviction detached it meanwhile — impossible while
+                # pinned, but release() handles it uniformly).
+                freed = self._trie.release(partial)
+                if freed is not None:
+                    self._free_blocks.append(freed)
+            self._commit_prompt_blocks(slot, row, nodes)
+            self._unfetched.append(([req], first))
+        self.prefills += 1
+        self.prefill_groups += 1
+        self.share_hits += 1
+        self.share_hit_tokens += covered
+        self.prefill_tokens += len(suffix)
+        self.prefill_tokens_saved += covered
+        self._note_prefill_time(t0, had_active)
+
+    def _commit_prompt_blocks(self, slot: int, row: List[int],
+                              shared_nodes: list) -> None:
+        """Index the slot's full PROMPT blocks in the share trie.
+        Ownership transfers: committed blocks leave ``_slot_blocks``
+        for the refcounted ``_slot_shared`` (released as decrefs).
+        Duplicate content — a racing identical commit, or a chunked
+        long prefill that COPIED its matched head — keeps our copy
+        owned and chains deeper commits under the existing node.
+        Caller holds the lock."""
+        if self._trie is None:
+            return
+        p = self.kv_block
+        nb_commit = len(row) // p  # only blocks fully inside the prompt
+        base = len(shared_nodes)
+        if nb_commit <= base:
+            return
+        owned = self._slot_blocks[slot]
+        idx_block = {base + j: b for j, b in enumerate(owned)}
+        parent = shared_nodes[-1] if shared_nodes else None
+        for i in range(base, nb_commit):
+            key = tuple(row[i * p:(i + 1) * p])
+            existing = self._trie.child(parent, key)
+            if existing is not None:
+                parent = existing
+                continue
+            blk = idx_block[i]
+            node = self._trie.commit(parent, key, blk)
+            owned.remove(blk)
+            self._slot_shared[slot].append(node)
+            self.share_commits += 1
+            parent = node
+
+    def _note_prefill_time(self, t0: float, had_active: bool) -> None:
+        """Prefill cost bookkeeping: total host wall time spent
+        dispatching prefill work, and the slice of it decode provably
+        waited on (active slots, nothing in flight) — the prefill
+        bubble sharing and chunking shrink."""
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        self.prefill_ms += dt_ms
+        if had_active and self._inflight is None:
+            self.prefill_bubble_ms += dt_ms
 
     def _match_prefix(self, row: List[int]):
         """Longest cached prefix of ``row`` at power-of-two lengths
@@ -1015,16 +1303,26 @@ class ContinuousEngine:
         logits, cache1 = gen_lib._jit_prefill(  # noqa: SLF001 — same pkg
             params, padded, cache1, cfg,
             np.asarray([len(chunk)], np.int32))
+        if params is self.params:  # draft-model chunks don't count
+            self.prefill_tokens += len(chunk)
         return logits, cache1, consumed + len(chunk)
 
     def _advance_prefill(self) -> None:
+        if not self._prefilling:
+            return
+        t0 = time.perf_counter()
+        had_active = any(r is not None for r in self._slot_req)
+        try:
+            self._advance_prefill_impl()
+        finally:
+            self._note_prefill_time(t0, had_active)
+
+    def _advance_prefill_impl(self) -> None:
         """Advance the oldest in-flight long prefill by ONE chunk per
         model (the per-iteration budget that bounds how long active
         slots wait between decode chunks). On the target's final chunk:
         sample the first token; insert once the draft cache (spec mode)
         has caught up and a slot frees."""
-        if not self._prefilling:
-            return
         entry = self._prefilling[0]
         req = entry.req
         n = len(req.row)
@@ -1041,11 +1339,38 @@ class ContinuousEngine:
             self._finish_long_prefill(entry)
             return
         if entry.cache is None:
-            # First chunk: seed from the prefix pool when the prompt's
+            # First chunk: seed from the share trie (block granularity,
+            # preferred) or the dense prefix pool when the prompt's
             # head is cached — long popular prompts (system preambles)
             # are where prefix reuse pays most.
             cache1, p_hit = None, 0
-            if self._prefix_pool is not None:
+            if self._trie is not None:
+                from skypilot_tpu.models import paged as paged_lib
+                with self._lock:
+                    t_nodes, _, _ = self._trie.match(req.row)
+                    t_blocks = [nd.block for nd in t_nodes]
+                    for nd in t_nodes:
+                        self._trie.touch(nd)
+                if t_blocks:
+                    # Seed the dense scratch row from the shared blocks
+                    # (one gather); the chunked tail then computes only
+                    # unshared tokens. The scratch row is inserted
+                    # wholesale at finish, so the long-prompt path
+                    # shares COMPUTE, not storage — its novel blocks
+                    # still commit (duplicates of the matched head
+                    # dedup against the existing chain).
+                    mb = self.max_len // self.kv_block
+                    tbl = np.zeros((mb,), np.int32)
+                    tbl[:len(t_blocks)] = t_blocks
+                    p_hit = len(t_blocks) * self.kv_block
+                    cache1 = paged_lib.jit_gather_blocks(
+                        self._cache, tbl, np.asarray([p_hit], np.int32))
+                    self.share_hits += 1
+                    self.share_hit_tokens += p_hit
+                    self.prefill_tokens_saved += p_hit
+                else:
+                    self.share_misses += 1
+            if cache1 is None and self._prefix_pool is not None:
                 p_hit, pool_row = self._match_prefix(req.row)
                 if p_hit:
                     cache1 = _jit_gather_prefix(
@@ -1100,9 +1425,9 @@ class ContinuousEngine:
                     return  # park; retried next iteration
                 if self.kv_layout == 'paged':
                     nb = self._blocks_needed(req)
-                    if len(self._free_blocks) < nb:
+                    if self._blocks_avail() < nb:
                         return  # park until a completion frees blocks
-                    blocks = [self._free_blocks.pop() for _ in range(nb)]
+                    blocks = self._alloc_blocks(nb)
                     table_row = np.zeros(
                         (self.max_len // self.kv_block,), np.int32)
                     table_row[:nb] = blocks
@@ -1127,6 +1452,10 @@ class ContinuousEngine:
                 np.asarray([slot], np.int32))
             self._last = self._last.at[
                 jnp.asarray([slot], jnp.int32)].set(entry.first)
+            if self._trie is not None:
+                with self._lock:
+                    if self._slot_req[slot] is req:
+                        self._commit_prompt_blocks(slot, req.row, [])
         else:
             self._cache, self._last = _jit_insert(
                 self._cache, self._last, entry.cache, entry.first,
@@ -1138,6 +1467,8 @@ class ContinuousEngine:
 
     def _prefill_group(self, reqs: List[_Request],
                        slots: List[int]) -> None:
+        t0 = time.perf_counter()
+        had_active = any(r is not None for r in self._slot_req)
         n = len(reqs)
         rows = [r.row for r in reqs]
         p_lens = [0] * n
@@ -1187,6 +1518,8 @@ class ContinuousEngine:
         logits, cache_n = gen_lib._jit_prefill(  # noqa: SLF001 — same pkg
             self.params, padded, cache_n, self.cfg,
             np.asarray(lens))
+        self.prefill_tokens += int(lens.sum())
+        self.prefill_tokens_saved += sum(p_lens)
         if self._prefix_pool is not None:
             self._maybe_store_prefixes(rows, p_lens, cache_n)
         tk, tp = _filters_or_none(top_ks, top_ps)
@@ -1206,8 +1539,7 @@ class ContinuousEngine:
                     if r.max_new <= 1:
                         continue  # resolves at prefill: junk-sink row
                     nb = self._blocks_needed(r)
-                    blocks = [self._free_blocks.pop()
-                              for _ in range(nb)]  # _admit reserved them
+                    blocks = self._alloc_blocks(nb)  # _admit reserved
                     self._slot_blocks[slots[i]] = blocks
                     tables_host[i, :nb] = blocks
             self._cache = paged_lib.jit_insert(
@@ -1215,6 +1547,17 @@ class ContinuousEngine:
                 np.asarray(slots, np.int32))
             self._last = self._last.at[
                 jnp.asarray(slots, jnp.int32)].set(firsts)
+            if self._trie is not None:
+                # Index the group's full prompt blocks for later
+                # sharers (the insert above was already dispatched, so
+                # any future gather of these blocks is device-ordered
+                # after their content lands).
+                with self._lock:
+                    for i, r in enumerate(reqs):
+                        if r.max_new > 1:
+                            self._commit_prompt_blocks(slots[i], rows[i],
+                                                       [])
+                            self.share_misses += 1
         else:
             self._cache, self._last = _jit_insert(
                 self._cache, self._last, cache_n, firsts,
@@ -1245,6 +1588,7 @@ class ContinuousEngine:
             for i, req in enumerate(reqs):
                 if req.max_new > 1:
                     self._slot_req[slots[i]] = req
+        self._note_prefill_time(t0, had_active)
 
     def _drain_firsts(self) -> None:
         """Materialize deferred first tokens. MUST run before a chunk's

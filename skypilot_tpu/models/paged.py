@@ -33,15 +33,17 @@ engine — the device never sees an allocation decision, only tables.
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
-from typing import Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from skypilot_tpu.models import llama
-from skypilot_tpu.models.generate import (_cached_attention, _mlp_tail,
-                                          _qkv_proj, _quantize_block)
+from skypilot_tpu.models.generate import (KVCache, _cached_attention,
+                                          _mlp_tail, _qkv_proj,
+                                          _quantize_block)
 from skypilot_tpu.models.quantization import mm as _mm
 
 
@@ -284,14 +286,17 @@ def forward_paged(params, tokens: jax.Array, cache: PagedKVCache,
                   cfg: llama.LlamaConfig,
                   active_rows: Optional[jax.Array] = None,
                   shard_ctx=None,
-                  all_logits: bool = False
+                  all_logits: bool = False,
+                  logit_index: Optional[jax.Array] = None
                   ) -> Tuple[jax.Array, PagedKVCache]:
     """Run ``tokens`` [B, S] over the paged pool (S=1 decode step;
-    S=k+1 speculative verify); returns (logits, cache advanced S).
-    ``all_logits`` returns per-POSITION logits [B, S, V] (the verify
-    needs the target's prediction after every proposed token). The
-    structural twin of ``generate.forward_cached`` with pool
-    scatter/gather replacing the dense row update."""
+    S=k+1 speculative verify; S=W padded tail prefill); returns
+    (logits, cache advanced S). ``all_logits`` returns per-POSITION
+    logits [B, S, V] (the verify needs the target's prediction after
+    every proposed token); ``logit_index`` [B] instead picks each row's
+    own last REAL position (padded prefill). The structural twin of
+    ``generate.forward_cached`` with pool scatter/gather replacing the
+    dense row update."""
     x = params['embed'].astype(cfg.dtype)[tokens]
     s = tokens.shape[1]
     quantized = cache.quantized
@@ -323,6 +328,254 @@ def forward_paged(params, tokens: jax.Array, cache: PagedKVCache,
     if all_logits:
         return (_mm(x, params['lm_head'], 'bsd,dv->bsv',
                     preferred_element_type=jnp.float32), new_cache)
+    if logit_index is not None:
+        # Padded multi-token prefill: each row's logits come from its own
+        # last REAL position, not the padded tail (forward_cached's
+        # row_lens - 1 trick, against the paged pool).
+        last = jnp.take_along_axis(
+            x, logit_index[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        return (_mm(last, params['lm_head'], 'bd,dv->bv',
+                    preferred_element_type=jnp.float32), new_cache)
     logits = _mm(x[:, -1], params['lm_head'], 'bd,dv->bv',
                  preferred_element_type=jnp.float32)
     return logits, new_cache
+
+
+# ---------------------------------------------------------------------------
+# Copy-on-write block-level prefix sharing (vLLM/SGLang-style).
+#
+# The pool's block tables make prefix reuse a TABLE WRITE instead of a
+# KV copy: committed full token blocks are indexed host-side in a trie
+# keyed by token-block chains (exact-match — no hash collisions), with
+# per-block refcounts. A matching request points its table head at the
+# shared blocks and prefills only its unshared tail DIRECTLY over the
+# pool (``jit_prefill_shared``); a partially-matched tail block is
+# copy-on-write-forked (``jit_fork_block``) before the first divergent
+# append. Eviction is refcount-aware LRU over idle (refs == 0) blocks.
+# All BlockTrie methods assume the caller holds the engine lock.
+
+
+class _TrieNode:
+    """One committed full KV block. ``key`` is the block's token tuple;
+    ``children`` chain deeper blocks of the same prefix. ``detached``
+    marks a node whose ancestor was evicted: it can never be matched
+    again, so when its refs drop to zero its block frees directly
+    instead of parking in the idle LRU."""
+    __slots__ = ('block', 'key', 'parent', 'children', 'refs', 'detached')
+
+    def __init__(self, block: int, key: tuple,
+                 parent: Optional['_TrieNode']):
+        self.block = block
+        self.key = key
+        self.parent = parent
+        self.children: Dict[tuple, '_TrieNode'] = {}
+        self.refs = 1
+        self.detached = False
+
+
+class BlockTrie:
+    """Host-side index of committed prefix blocks. Pure bookkeeping —
+    the device only ever sees block ids via tables. Invariant: every
+    block the trie holds is either ``referenced`` (refs > 0, pinned by
+    at least one live slot) or in the ``idle`` LRU (refs == 0,
+    reclaimable); ``reclaimable`` is exact because eviction cascades
+    over a popped node's whole idle subtree."""
+
+    def __init__(self, block: int):
+        self.block = block
+        self.children: Dict[tuple, _TrieNode] = {}
+        self.idle: 'collections.OrderedDict[_TrieNode, None]' = \
+            collections.OrderedDict()
+        self.referenced = 0  # nodes with refs > 0 (incl. detached)
+
+    @property
+    def reclaimable(self) -> int:
+        return len(self.idle)
+
+    @property
+    def blocks_held(self) -> int:
+        return self.referenced + len(self.idle)
+
+    def match(self, row: List[int],
+              limit: Optional[int] = None
+              ) -> Tuple[List[_TrieNode], Optional[_TrieNode], int]:
+        """Longest committed chain covering ``row`` at block
+        granularity, capped at ``limit`` tokens (default ``len(row) - 1``
+        — the last prompt token must be computed to produce the first
+        logits). Returns (full-block nodes, partial-tail node, partial
+        length): the partial node is a committed child whose token
+        tuple extends the row past the full matches by 1..block-1
+        tokens — the copy-on-write fork candidate."""
+        limit = len(row) - 1 if limit is None else limit
+        p = self.block
+        nodes: List[_TrieNode] = []
+        kids = self.children
+        pos = 0
+        while pos + p <= limit:
+            node = kids.get(tuple(row[pos:pos + p]))
+            if node is None:
+                break
+            nodes.append(node)
+            pos += p
+            kids = node.children
+        partial, plen = None, 0
+        rest = row[pos:limit]
+        if rest:
+            for key, node in kids.items():
+                m = 0
+                for a, b in zip(key, rest):
+                    if a != b:
+                        break
+                    m += 1
+                if m > plen:
+                    partial, plen = node, m
+        return nodes, partial, plen
+
+    def acquire(self, node: _TrieNode) -> None:
+        if node.refs == 0:
+            self.referenced += 1
+            self.idle.pop(node, None)
+        node.refs += 1
+
+    def release(self, node: _TrieNode) -> Optional[int]:
+        """Decref; returns the node's block id when it must be FREED
+        now (a detached node dying), else None (live nodes park in the
+        idle LRU as reusable cache)."""
+        node.refs -= 1
+        if node.refs > 0:
+            return None
+        self.referenced -= 1
+        if node.detached:
+            return node.block
+        self.idle[node] = None  # newest end of the LRU
+        return None
+
+    def touch(self, node: _TrieNode) -> None:
+        if node in self.idle:
+            self.idle.move_to_end(node)
+
+    def commit(self, parent: Optional[_TrieNode], key: tuple,
+               block: int) -> Optional[_TrieNode]:
+        """Attach ``block`` as a committed child of ``parent`` (None =
+        root). Returns the new node (born with refs=1, held by the
+        committing slot), or None when an identical-content child
+        already exists — the caller keeps ownership of its duplicate
+        and chains deeper commits under the existing node."""
+        kids = parent.children if parent is not None else self.children
+        if key in kids:
+            return None
+        node = _TrieNode(block, key, parent)
+        kids[key] = node
+        self.referenced += 1
+        return node
+
+    def child(self, parent: Optional[_TrieNode],
+              key: tuple) -> Optional[_TrieNode]:
+        kids = parent.children if parent is not None else self.children
+        return kids.get(key)
+
+    def evict(self, n: int) -> List[int]:
+        """Reclaim >= n blocks from the idle LRU (may free more: a
+        popped node's unreachable idle descendants free with it).
+        Returns the freed block ids."""
+        freed: List[int] = []
+        while self.idle and len(freed) < n:
+            node, _ = self.idle.popitem(last=False)
+            freed.extend(self._detach(node))
+        return freed
+
+    def _detach(self, node: _TrieNode) -> List[int]:
+        kids = (node.parent.children if node.parent is not None
+                else self.children)
+        kids.pop(node.key, None)
+        freed = [node.block]
+        stack = list(node.children.values())
+        node.children = {}
+        while stack:
+            ch = stack.pop()
+            stack.extend(ch.children.values())
+            if ch.refs == 0:
+                # Reachable refs-0 nodes are in the idle LRU by
+                # construction; unreachable ones free with the subtree.
+                self.idle.pop(ch, None)
+                freed.append(ch.block)
+            else:
+                ch.detached = True  # frees at its final release()
+        return freed
+
+
+def _fork_block_impl(pool: PagedKVCache, src: jax.Array,
+                     dst: jax.Array) -> PagedKVCache:
+    """Copy-on-write fork: duplicate block ``src`` into owned block
+    ``dst`` (all planes, all positions — positions past the shared
+    partial length are overwritten by the tail prefill / decode writes
+    and never attended before that)."""
+    k = pool.k.at[:, dst].set(pool.k[:, src])
+    v = pool.v.at[:, dst].set(pool.v[:, src])
+    k_s, v_s = pool.k_s, pool.v_s
+    if pool.quantized:
+        k_s = k_s.at[:, dst].set(k_s[:, src])
+        v_s = v_s.at[:, dst].set(v_s[:, src])
+    return PagedKVCache(k=k, v=v, tables=pool.tables,
+                        lengths=pool.lengths, k_s=k_s, v_s=v_s)
+
+
+jit_fork_block = jax.jit(_fork_block_impl, donate_argnums=(0,))
+
+
+def _gather_blocks_impl(pool: PagedKVCache,
+                        blocks: jax.Array,
+                        p_len: jax.Array) -> KVCache:
+    """Assemble shared blocks into a DENSE 1-row prefill cache (the
+    chunked long-prefill path seeds its scratch row from the trie this
+    way). ``blocks`` is a full [MB] table row padded with junk-sink 0s,
+    so the gather compiles ONCE (width is always MB*P = max_len);
+    ``p_len`` [1] marks the valid shared-prefix tokens — sink junk
+    beyond it is never attended."""
+    def view(arr):  # [L, NB, H, P, D] -> [L, 1, H, MB*P, D]
+        g = arr[:, blocks].transpose(0, 2, 1, 3, 4)
+        l, h, mb, p, d = g.shape
+        return g.reshape(l, 1, h, mb * p, d)
+
+    ks = vs = None
+    if pool.quantized:
+        def view_s(arr):
+            g = arr[:, blocks].transpose(0, 2, 1, 3)
+            l, h, mb, p = g.shape
+            return g.reshape(l, 1, h, mb * p)
+        ks, vs = view_s(pool.k_s), view_s(pool.v_s)
+    return KVCache(k=view(pool.k), v=view(pool.v), lengths=p_len,
+                   k_s=ks, v_s=vs)
+
+
+jit_gather_blocks = jax.jit(_gather_blocks_impl)
+
+
+def _prefill_shared_impl(cfg: llama.LlamaConfig, params,
+                         cache: PagedKVCache, tokens: jax.Array,
+                         table_row: jax.Array, slot: jax.Array,
+                         start: jax.Array, slen: jax.Array,
+                         shard_ctx=None) -> Tuple[jax.Array, PagedKVCache]:
+    """Suffix prefill DIRECTLY over the pool — the block-share hit
+    path. ``tokens`` [1, W] is the padded unshared tail; ``table_row``
+    [1, MB] already points its head at the shared blocks and its tail
+    at freshly owned ones; ``start`` [1] is the shared token count and
+    ``slen`` [1] the real tail length. The forward reads the shared
+    prefix through the block gather (the same read decode pays) and
+    scatters tail KV straight into the owned blocks — no dense scratch
+    row, no insert copy. Installs the table and final length at
+    ``slot`` and returns the tail's last-real-token logits."""
+    row_cache = PagedKVCache(k=cache.k, v=cache.v, tables=table_row,
+                             lengths=start, k_s=cache.k_s, v_s=cache.v_s)
+    logits, row_cache = forward_paged(params, tokens, row_cache, cfg,
+                                      shard_ctx=shard_ctx,
+                                      logit_index=slen - 1)
+    tables = cache.tables.at[slot].set(table_row[0])
+    lengths = cache.lengths.at[slot].set(start[0] + slen[0])
+    return logits, PagedKVCache(k=row_cache.k, v=row_cache.v,
+                                tables=tables, lengths=lengths,
+                                k_s=row_cache.k_s, v_s=row_cache.v_s)
+
+
+jit_prefill_shared = jax.jit(_prefill_shared_impl,
+                             static_argnums=(0, 8), donate_argnums=(2,))

@@ -166,7 +166,8 @@ class LlmServer:
                  kv_blocks: Optional[int] = None,
                  pipeline: Optional[str] = None,
                  qos: Optional[str] = None,
-                 qos_opts: Optional[Dict[str, Any]] = None):
+                 qos_opts: Optional[Dict[str, Any]] = None,
+                 prefix_share: Optional[str] = None):
         self.model_name = model
         self.cfg = llama.PRESETS[model]
         self.max_len = min(max_len, self.cfg.max_seq_len)
@@ -189,6 +190,14 @@ class LlmServer:
         # HBM); 0/None = engine default (full capacity, always safe).
         self.kv_blocks = kv_blocks or int(
             os.environ.get('SKYTPU_LLM_KV_BLOCKS', '0')) or None
+        # Copy-on-write block-level prefix sharing (paged layout;
+        # models/paged.py BlockTrie). Default ON for paged dense
+        # engines — 'off' is the A/B and escape hatch (also via
+        # SKYTPU_LLM_PREFIX_SHARE=0).
+        if prefix_share not in (None, 'on', 'off'):
+            raise ValueError(f'Unknown prefix_share {prefix_share!r}; '
+                             "'on' or 'off'")
+        self.prefix_share = prefix_share
         # Pipelined decode dispatch (models/engine.py): 'on' keeps one
         # chunk in flight so host bookkeeping overlaps device compute;
         # 'off' = the serial engine (A/B and debugging). None defers to
@@ -332,7 +341,9 @@ class LlmServer:
                 spec_k=self.spec_k, kv_layout=self.kv_layout,
                 kv_blocks=self.kv_blocks,
                 pipeline=(None if self.pipeline is None
-                          else self.pipeline == 'on'))
+                          else self.pipeline == 'on'),
+                prefix_share=(None if self.prefix_share is None
+                              else self.prefix_share == 'on'))
             self.params = self.engine.params
             if self.draft_params is not None:
                 self.draft_params = self.engine.draft_params
@@ -558,6 +569,12 @@ class LlmServer:
                     / max(getattr(eng, '_gap_count', 0), 1), 3),
                 'host_overlap_ms': eng.host_overlap_ms,
                 'bubble_ms': eng.bubble_ms,
+                # Block-share counters ride the same lock-free snapshot
+                # so the serve.prefill span can annotate the delta.
+                'share_hits': getattr(eng, 'share_hits', 0),
+                'cow_forks': getattr(eng, 'cow_forks', 0),
+                'prefill_tokens_saved': getattr(eng,
+                                                'prefill_tokens_saved', 0),
             }
         except Exception:  # noqa: BLE001 — observability must never 500
             return None
@@ -606,10 +623,20 @@ class LlmServer:
         # "prefill" here is submit -> first emission: engine queue time
         # plus the actual prefill plus the first decode chunk — the TTFT
         # phase a serving operator tunes.
-        trace_lib.add_span('serve.prefill', rec.t0, first_t,
-                           parent=anchor, tokens=events[0][2])
-        dattrs: Dict[str, Any] = {'tokens': toks}
         pipe1 = self._pipeline_stats()
+        pattrs: Dict[str, Any] = {'tokens': events[0][2]}
+        if pipe0 and pipe1 and 'share_hits' in pipe1:
+            # Engine-wide deltas while this request was in flight
+            # (co-resident requests share them — context, not
+            # attribution; same convention as the decode-span overlap
+            # deltas below).
+            for k in ('share_hits', 'cow_forks', 'prefill_tokens_saved'):
+                d = (pipe1.get(k) or 0) - (pipe0.get(k) or 0)
+                if d:
+                    pattrs[k] = d
+        trace_lib.add_span('serve.prefill', rec.t0, first_t,
+                           parent=anchor, **pattrs)
+        dattrs: Dict[str, Any] = {'tokens': toks}
         if pipe0 and pipe1:
             # The engine's overlap counters are cumulative across ALL
             # requests; the before/after delta is what the engine did
@@ -1089,6 +1116,16 @@ def build_parser() -> argparse.ArgumentParser:
                              'default = full capacity — size it BELOW '
                              'slots*max_len/block for the HBM saving; '
                              'exhaustion queues admissions)')
+    parser.add_argument('--prefix-share', default=None,
+                        choices=('on', 'off'),
+                        help='copy-on-write block-level prefix sharing '
+                             'on the paged KV pool: committed prompt '
+                             'blocks are refcount-shared via a trie, so '
+                             'a hit is a table write and only the '
+                             'unshared tail prefills (default on with '
+                             '--kv-layout paged; also via '
+                             'SKYTPU_LLM_PREFIX_SHARE; dense models '
+                             'only)')
     parser.add_argument('--prefix-cache', type=int, default=None,
                         help='device pool slots for popular prompt '
                              'prefixes (opt-in, default 0; costs N extra '
@@ -1127,7 +1164,8 @@ def server_from_args(args) -> 'LlmServer':
                      kv_layout=args.kv_layout,
                      kv_blocks=args.kv_blocks,
                      pipeline=args.pipeline,
-                     qos=args.qos)
+                     qos=args.qos,
+                     prefix_share=args.prefix_share)
 
 
 def main() -> None:

@@ -60,14 +60,27 @@ def mix_classes(spec, n: int):
     return out
 
 
+def shared_prefix_tokens(tenant_idx: int, length: int,
+                         vocab: int) -> list:
+    """The tenant's system-prompt stand-in: deterministic per tenant
+    (every request of tenant t repeats the same head — the traffic
+    shape block-level prefix sharing exists for)."""
+    rng = random.Random(7_000_000 + tenant_idx)
+    return [rng.randrange(1, vocab) for _ in range(length)]
+
+
 async def _one(session, url: str, prompt_span, max_new_span,
                vocab: int, seed: int, stream: bool = False,
-               priority=None, tenant=None):
+               priority=None, tenant=None, prefix_tokens=None):
     from skypilot_tpu.observability import trace as trace_lib
     rng = random.Random(seed)
     prompt_len = rng.randint(*prompt_span)
     max_new = rng.randint(*max_new_span)
     tokens = [rng.randrange(1, vocab) for _ in range(prompt_len)]
+    if prefix_tokens:
+        # Shared head + unique tail: prompt_len spans the TAIL, so the
+        # shared and unique sub-mixes differ only by the shared head.
+        tokens = list(prefix_tokens) + tokens
     payload = {'tokens': [tokens], 'max_new_tokens': max_new,
                'stream': stream}
     if priority is not None:
@@ -130,26 +143,69 @@ def _pctile(sorted_vals, q: int):
 
 async def run_load(url: str, requests_total: int, concurrency: int,
                    prompt_len, max_new, vocab: int,
-                   stream: bool = False, mix=None, tenants: int = 1
-                   ) -> dict:
+                   stream: bool = False, mix=None, tenants: int = 1,
+                   shared_prefix: float = 0.0,
+                   shared_prefix_len: int = 32) -> dict:
     import aiohttp
     prompt_span, max_new_span = _span(prompt_len), _span(max_new)
     sem = asyncio.Semaphore(concurrency)
     classes = mix_classes(mix, requests_total)
+    # --shared-prefix FRAC: that fraction of requests (deterministic
+    # weighted round-robin, reproducible run to run) opens with its
+    # tenant's shared system-prompt head; the rest stay fully unique —
+    # the N-tenants x (shared head + unique tail) traffic shape that
+    # exercises block-level prefix sharing in the paged engine.
+    if not 0.0 <= shared_prefix <= 1.0:
+        raise ValueError(f'--shared-prefix must be in [0, 1], '
+                         f'got {shared_prefix}')
+    shared_flags = None
+    if shared_prefix > 0:
+        picks = mix_classes(
+            f'shared:{shared_prefix},unique:{1.0 - shared_prefix}',
+            requests_total)
+        shared_flags = [p == 'shared' for p in picks]
+        prefixes = [shared_prefix_tokens(t, shared_prefix_len, vocab)
+                    for t in range(max(tenants, 1))]
     results = []
+    shared_of = []  # per-result shared/unique tag, parallel to results
 
     async with aiohttp.ClientSession() as session:
         async def _bounded(i):
             async with sem:
                 cls = classes[i] if classes else None
                 tenant = f't{i % tenants}' if tenants > 1 else None
-                results.append((cls, await _one(
+                prefix = None
+                if shared_flags is not None and shared_flags[i]:
+                    prefix = prefixes[i % max(tenants, 1)]
+                r = await _one(
                     session, url, prompt_span, max_new_span, vocab,
-                    seed=i, stream=stream, priority=cls, tenant=tenant)))
+                    seed=i, stream=stream, priority=cls, tenant=tenant,
+                    prefix_tokens=prefix)
+                results.append((cls, r))
+                shared_of.append((prefix is not None, r))
 
         t0 = time.perf_counter()
         await asyncio.gather(*(_bounded(i) for i in range(requests_total)))
         wall = time.perf_counter() - t0
+
+        engine_share = None
+        if shared_flags is not None:
+            # Engine-side truth for the report: hit rate and block
+            # states from /health (best-effort — a bare LB or an older
+            # replica simply omits the block).
+            try:
+                async with session.get(f'{url}/health') as hr:
+                    body = json.loads(await hr.text())
+                eng = body.get('engine') or {}
+                engine_share = {
+                    'prefix_share': eng.get('prefix_share'),
+                    'kv_blocks': eng.get('kv_blocks'),
+                    'prefill_tokens': eng.get('prefill_tokens'),
+                    'prefill_tokens_saved':
+                        eng.get('prefill_tokens_saved'),
+                }
+            except Exception:  # noqa: BLE001 — report is best-effort
+                engine_share = None
 
     flat = [r for _, r in results]
     oks = [r for r in flat if r[0]]
@@ -162,6 +218,34 @@ async def run_load(url: str, requests_total: int, concurrency: int,
             'stream': True,
             'p50_ttft_s': _pctile(ttfts, 50),
             'p95_ttft_s': _pctile(ttfts, 95),
+        }
+    if shared_flags is not None:
+        # Per-mix breakdown: the TTFT gap between the shared and unique
+        # sub-mixes is the number block-level prefix sharing is
+        # supposed to move; engine-side hit rate / block states ride
+        # along so the win is attributable from ONE report line.
+        def _grp(flag):
+            rs = [r for f, r in shared_of if f == flag]
+            oks_g = [r for r in rs if r[0]]
+            entry = {
+                'requests': len(rs),
+                'ok': len(oks_g),
+                'p50_latency_s': _pctile(sorted(r[2] for r in oks_g), 50),
+                'p95_latency_s': _pctile(sorted(r[2] for r in oks_g), 95),
+            }
+            if stream:
+                tt = sorted(r[3] for r in oks_g if r[3] is not None)
+                entry['p50_ttft_s'] = _pctile(tt, 50)
+                entry['p95_ttft_s'] = _pctile(tt, 95)
+            return entry
+
+        extra['shared_prefix'] = {
+            'frac': shared_prefix,
+            'prefix_len': shared_prefix_len,
+            'tenants': tenants,
+            'shared': _grp(True),
+            'unique': _grp(False),
+            'engine': engine_share,
         }
     if classes:
         # Per-class breakdown (QoS workloads): latency/TTFT percentiles
@@ -241,12 +325,25 @@ def main() -> None:
                         help='spread requests over N synthetic tenant '
                              'ids (X-SkyTPU-Tenant: t0..tN-1) to '
                              'exercise per-tenant quotas')
+    parser.add_argument('--shared-prefix', type=float, default=0.0,
+                        help='fraction of requests (deterministic '
+                             'round-robin) that open with their '
+                             "tenant's shared system-prompt head — the "
+                             'traffic shape for block-level prefix '
+                             'sharing; reports per-mix TTFT/latency '
+                             'percentiles plus the engine hit rate '
+                             'from /health')
+    parser.add_argument('--shared-prefix-len', type=int, default=32,
+                        help='shared head length in tokens (per '
+                             'tenant; default 32)')
     args = parser.parse_args()
     out = asyncio.run(run_load(args.url.rstrip('/'), args.requests,
                                args.concurrency, args.prompt_len,
                                args.max_new_tokens, args.vocab,
                                stream=args.stream, mix=args.mix,
-                               tenants=args.tenants))
+                               tenants=args.tenants,
+                               shared_prefix=args.shared_prefix,
+                               shared_prefix_len=args.shared_prefix_len))
     print(json.dumps(out))
 
 

@@ -808,9 +808,25 @@ function healthCell(h){
     const sp = e.speculative;
     if(sp && sp.rounds > 0)
       parts.push(`spec ${Math.round((sp.acceptance_rate||0)*100)}%`);
+    // Paged pool block states: free/owned/shared/cached partition the
+    // usable pool exactly once blocks are refcount-shared (the old
+    // used/usable pair double-counted shared blocks); e.g.
+    // "12/30 blk shr4 c6".
     const kb = e.kv_blocks;
-    if(kb && kb.usable > 0)
-      parts.push(`${kb.used}/${kb.usable} blk`);
+    if(kb && kb.usable > 0){
+      let t = `${kb.used ?? 0}/${kb.usable} blk`;
+      if(kb.shared) t += ` shr${kb.shared}`;
+      if(kb.cached) t += ` c${kb.cached}`;
+      parts.push(t);
+    }
+    // Block-share hit rate once the trie has seen traffic, e.g.
+    // "share 72%" (+fork count when CoW forks happened).
+    const px = e.prefix_share;
+    if(px && px.enabled && (px.hits + px.misses) > 0){
+      let t = `share ${Math.round((px.hit_rate||0)*100)}%`;
+      if(px.cow_forks) t += ` f${px.cow_forks}`;
+      parts.push(t);
+    }
     // Decode-dispatch pipeline: depth + how much host bookkeeping the
     // in-flight chunk hid (cumulative), e.g. "pipe d1 ovl 1.2s".
     const pl = e.pipeline;

@@ -74,6 +74,37 @@ _REPLICA_QUEUE_DEPTH = Gauge(
     'skytpu_replica_qos_queue_depth',
     'QoS admission queue depth on this replica, by class.',
     ['qos_class'], registry=SERVING_REGISTRY)
+# Copy-on-write block-prefix sharing on the paged KV pool
+# (models/paged.py BlockTrie; stats()['prefix_share'] / ['kv_blocks']).
+_REPLICA_PREFIX_HITS = Gauge(
+    'skytpu_replica_prefix_hits',
+    'Cumulative block-share prefix-cache hits on this replica.',
+    registry=SERVING_REGISTRY)
+_REPLICA_PREFIX_HIT_RATE = Gauge(
+    'skytpu_replica_prefix_hit_rate',
+    'Block-share hit rate (hits / (hits + misses)) over the replica '
+    'lifetime.', registry=SERVING_REGISTRY)
+_REPLICA_COW_FORKS = Gauge(
+    'skytpu_replica_prefix_cow_forks',
+    'Cumulative copy-on-write forks of partially shared KV blocks.',
+    registry=SERVING_REGISTRY)
+_REPLICA_PREFILL_TOKENS = Gauge(
+    'skytpu_replica_prefill_tokens',
+    'Cumulative prompt tokens the prefill actually computed.',
+    registry=SERVING_REGISTRY)
+_REPLICA_PREFILL_SAVED = Gauge(
+    'skytpu_replica_prefill_tokens_saved',
+    'Cumulative prompt tokens skipped via shared/cached prefix KV.',
+    registry=SERVING_REGISTRY)
+_REPLICA_PREFILL_BUBBLE = Gauge(
+    'skytpu_replica_prefill_bubble_ms',
+    'Cumulative prefill host time decode provably waited on (ms).',
+    registry=SERVING_REGISTRY)
+_REPLICA_KV_BLOCKS = Gauge(
+    'skytpu_replica_kv_blocks',
+    'Paged KV pool block accounting by state (free | owned | shared | '
+    'cached); the states partition the usable pool exactly.',
+    ['state'], registry=SERVING_REGISTRY)
 
 API_REQUEST = Histogram(
     'skytpu_api_request_seconds',
@@ -288,6 +319,21 @@ def render_serving(engine: Optional[Dict[str, Any]] = None,
         _REPLICA_TOKENS.set(engine.get('tokens_emitted') or 0)
         _REPLICA_SLOTS.set(engine.get('slots') or 0)
         _REPLICA_ACTIVE.set(engine.get('active_slots') or 0)
+        share = engine.get('prefix_share') or {}
+        _REPLICA_PREFIX_HITS.set(share.get('hits') or 0)
+        _REPLICA_PREFIX_HIT_RATE.set(share.get('hit_rate') or 0)
+        _REPLICA_COW_FORKS.set(share.get('cow_forks') or 0)
+        _REPLICA_PREFILL_TOKENS.set(engine.get('prefill_tokens') or 0)
+        _REPLICA_PREFILL_SAVED.set(
+            engine.get('prefill_tokens_saved') or 0)
+        _REPLICA_PREFILL_BUBBLE.set(engine.get('prefill_bubble_ms') or 0)
+        kb = engine.get('kv_blocks')
+        if isinstance(kb, dict):
+            for state in ('free', 'owned', 'shared', 'cached'):
+                _REPLICA_KV_BLOCKS.labels(state=state).set(
+                    kb.get(state) or 0)
+        else:
+            _REPLICA_KV_BLOCKS.clear()
     else:
         # Stats unavailable (engine stopping/absent): zero rather than
         # re-render the last live values forever — stale "3 active
@@ -295,6 +341,11 @@ def render_serving(engine: Optional[Dict[str, Any]] = None,
         _REPLICA_TOKENS.set(0)
         _REPLICA_SLOTS.set(0)
         _REPLICA_ACTIVE.set(0)
+        for g in (_REPLICA_PREFIX_HITS, _REPLICA_PREFIX_HIT_RATE,
+                  _REPLICA_COW_FORKS, _REPLICA_PREFILL_TOKENS,
+                  _REPLICA_PREFILL_SAVED, _REPLICA_PREFILL_BUBBLE):
+            g.set(0)
+        _REPLICA_KV_BLOCKS.clear()
     if qos:
         for cls, c in (qos.get('classes') or {}).items():
             if isinstance(c, dict):

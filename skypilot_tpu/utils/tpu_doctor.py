@@ -291,38 +291,53 @@ def relay_state() -> Dict[str, Any]:
 # Phased backend probe
 
 _PROBE_CHILD = r'''
-import faulthandler, os, signal, sys, threading
+import faulthandler, os, signal, sys, threading, time
 phase_f = open(sys.argv[1], 'w', buffering=1)
 faulthandler.register(signal.SIGUSR1, file=sys.stderr, all_threads=True)
+_last = [time.monotonic(), 'spawn']
 def phase(p):
     phase_f.write(p + '\n')
+    _last[0] = time.monotonic()
+    _last[1] = p
 pkg_root = os.environ.get('SKYTPU_PKG_ROOT')
 if pkg_root and pkg_root not in sys.path:
     sys.path.insert(0, pkg_root)
 phase('python-started')
+# Hard deadlines: if init NEVER completes the child must eventually
+# give up — an abrupt exit is unavoidable then, but both deadlines sit
+# far beyond any healthy init time, so a live handshake that would
+# have succeeded is never aborted (the r4 wedge lesson; the parent
+# never kills this child mid-init — see probe_backend). The PER-PHASE
+# deadline is the un-blinding lever (r06): a hang inside ONE init
+# stage self-aborts NAMING the stuck phase, so a real-TPU bench run
+# either completes or fails loudly instead of silently reporting a
+# CPU number as the trajectory.
+hard_s = float(os.environ.get('SKYTPU_PROBE_HARD_DEADLINE_S', '600'))
+phase_s = float(os.environ.get('SKYTPU_PROBE_PHASE_DEADLINE_S', '300'))
+t_hard = time.monotonic() + hard_s
+init_done = threading.Event()
+def _watchdog():
+    while not init_done.wait(1.0):
+        now = time.monotonic()
+        if now - _last[0] > phase_s:
+            phase('phase-deadline-abort:' + _last[1])
+            os._exit(9)
+        if now > t_hard:
+            phase('hard-deadline-abort')
+            os._exit(9)
+threading.Thread(target=_watchdog, daemon=True).start()
 # Deterministic hang injection (tests): hold here until the named file
 # appears, so timeout-path assertions gate on a fake deadline instead of
 # racing the real init ladder (which can finish inside the parent's
-# post-timeout SIGUSR1 window on a fast box).
+# post-timeout SIGUSR1 window on a fast box). The watchdog is already
+# armed, so a small SKYTPU_PROBE_PHASE_DEADLINE_S turns the hold into
+# a deterministic stuck-phase abort (the per-phase deadline's test).
 _hold = os.environ.get('SKYTPU_PROBE_HOLD_FILE')
 if _hold:
-    import time as _time
-    _give_up = _time.time() + float(
+    _give_up = time.time() + float(
         os.environ.get('SKYTPU_PROBE_HOLD_MAX_S', '60'))
-    while not os.path.exists(_hold) and _time.time() < _give_up:
-        _time.sleep(0.05)
-# Hard deadline: if init NEVER completes the child must eventually give
-# up — an abrupt exit is unavoidable then, but the deadline sits far
-# beyond any healthy init time, so a live handshake that would have
-# succeeded is never aborted (the r4 wedge lesson; the parent never
-# kills this child mid-init — see probe_backend).
-hard_s = float(os.environ.get('SKYTPU_PROBE_HARD_DEADLINE_S', '600'))
-init_done = threading.Event()
-def _watchdog():
-    if not init_done.wait(hard_s):
-        phase('hard-deadline-abort')
-        os._exit(9)
-threading.Thread(target=_watchdog, daemon=True).start()
+    while not os.path.exists(_hold) and time.time() < _give_up:
+        time.sleep(0.05)
 import jax
 # The sandbox's sitecustomize imports jax at interpreter start and may
 # latch a pinned platform; honor the caller's JAX_PLATFORMS explicitly
@@ -351,6 +366,9 @@ _PHASE_MEANING = {
     'first-compile-done': 'completed',
     'hard-deadline-abort': 'child self-aborted at its hard deadline '
                            '(init never completed)',
+    'phase-deadline-abort': 'child self-aborted: a single init phase '
+                            'exceeded its deadline '
+                            '(SKYTPU_PROBE_PHASE_DEADLINE_S)',
 }
 
 # A timed-out probe child is NEVER killed mid-init (killing a client
@@ -551,6 +569,16 @@ def probe_backend(timeout_s: float = 90.0) -> Dict[str, Any]:
         diagnosis = _PHASE_MEANING.get(last, 'unknown phase')
         if detached:
             diagnosis += f'; {detached}'
+    elif last in ('phase-deadline-abort', 'hard-deadline-abort'):
+        # The child's own watchdog aborted it: a deadline overrun, not
+        # a crash — the marker (not the error stream) names the fault,
+        # and for the per-phase deadline the STUCK phase rides after
+        # the colon.
+        outcome = 'timeout'
+        diagnosis = _PHASE_MEANING[last]
+        if last == 'phase-deadline-abort' and ':' in phases[-1]:
+            diagnosis += (f" (stuck phase: "
+                          f"{phases[-1].split(':', 1)[1]!r})")
     else:
         # A fast, clean failure (e.g. "No TPU device found", plugin
         # not registered) is a different animal from a wedged

@@ -127,6 +127,51 @@ def test_probe_backend_timeout_pins_phase(monkeypatch, tmp_path):
     assert probe['diagnosis'] != 'completed'
 
 
+def test_probe_phase_deadline_aborts_naming_stuck_phase(monkeypatch,
+                                                        tmp_path):
+    """Per-phase deadline (r06 un-blinding satellite): a child whose
+    CURRENT phase overruns SKYTPU_PROBE_PHASE_DEADLINE_S self-aborts
+    and the probe result names the stuck phase — a real-TPU bench run
+    either completes or fails loudly, never hangs blind. The hold gate
+    (never released) simulates the hang at python-started; the 1s
+    phase deadline turns it into a deterministic abort well inside the
+    parent's 60s budget."""
+    gate = tmp_path / 'never-created'
+    monkeypatch.setenv('SKYTPU_PROBE_HOLD_FILE', str(gate))
+    monkeypatch.setenv('SKYTPU_PROBE_HOLD_MAX_S', '30')
+    monkeypatch.setenv('SKYTPU_PROBE_PHASE_DEADLINE_S', '1')
+    probe = tpu_doctor.probe_backend(timeout_s=60.0)
+    assert not probe['ok']
+    assert probe['outcome'] == 'timeout', probe
+    assert probe['last_phase'] == 'phase-deadline-abort', probe
+    assert 'python-started' in probe['diagnosis'], probe
+    assert 'deadline' in probe['diagnosis'], probe
+
+
+def test_bench_tpu_unreachable_fails_loudly():
+    """bench satellite: a wanted-TPU run whose probe surrendered must
+    not report its CPU measurement as the trajectory — the headline
+    value becomes 0.0 with the stuck phase named, and the CPU number is
+    demoted to detail.cpu_reference."""
+    import pathlib
+    import sys as sys_mod
+    sys_mod.path.insert(0, str(pathlib.Path(__file__).parents[1]))
+    import bench
+    result = {'metric': 'llama_train_model_tflops_per_chip',
+              'value': 0.123456, 'vs_baseline': 0.005,
+              'detail': {'backend': 'cpu', 'cpu_fallback': True,
+                         'tokens_per_sec_per_chip': 321.0}}
+    out = bench.mark_tpu_unreachable(
+        result, {'final_hang_phase': 'jax-imported',
+                 'final_diagnosis': 'hung in backend init'})
+    assert out['value'] == 0.0 and out['vs_baseline'] == 0.0
+    assert out['detail']['tpu_unreachable'] is True
+    assert out['detail']['tpu_stuck_phase'] == 'jax-imported'
+    assert out['detail']['cpu_reference']['tflops_per_chip'] == 0.123456
+    assert out['detail']['cpu_reference']['tokens_per_sec_per_chip'] \
+        == 321.0
+
+
 def test_probe_backend_crash_reports_error_line(monkeypatch):
     """A clean fast failure (unknown platform, no device attached) is a
     CRASH, not a hang — the diagnosis must carry the error text."""

@@ -25,6 +25,16 @@ have non-empty buckets per class on the replica's /metrics — and that
 greedy output is byte-identical with tracing on vs off. Also wired
 into ``make verify``.
 
+``--prefix`` runs the copy-on-write block-prefix-sharing gate
+(bench.prefix_share_probe with its assertion gates): greedy outputs
+byte-identical sharing ON vs OFF on an 80%-shared mix with hit rate
+> 0, >= 40% fewer prompt tokens prefill-computed, and at least one
+copy-on-write fork; decode tok/s within 10% on a genuinely 0%-shared
+mix (fresh prompts every round); free/owned/shared/cached block states
+reconciling exactly after drain; and a `loadgen --shared-prefix 0.8`
+pass against a live replica whose /health hit rate is nonzero.
+CPU-only, ~a minute, wired into ``make verify``.
+
 ``--goodput`` runs the training/fleet telemetry gate: (a) a tiny
 trainer run with the telemetry spool off then on — stdout must be
 byte-identical and the spool must hold one record per log window;
@@ -681,6 +691,15 @@ def main():
         # CPU-only by design (same rationale as --smoke/--qos).
         jax.config.update('jax_platforms', 'cpu')
         print(json.dumps({'trace_smoke': 'ok', **trace_smoke()}),
+              flush=True)
+        return
+    if '--prefix' in sys.argv:
+        # CPU-only by design (same rationale as --smoke): never touch
+        # or wait on a chip in CI.
+        jax.config.update('jax_platforms', 'cpu')
+        import bench
+        print(json.dumps({'prefix_share_smoke': 'ok',
+                          **bench.prefix_share_probe(assert_gates=True)}),
               flush=True)
         return
     if '--qos' in sys.argv:
