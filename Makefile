@@ -27,6 +27,11 @@ ci:
 # unshared, loadgen --shared-prefix hit rate nonzero), the tracing
 # gate (every sampled trace closes + nests, TTFT/queue-wait
 # histograms fill, greedy output byte-identical traced vs untraced),
+# the disaggregated-serving gate (two-process prefill/decode pair
+# over localhost HTTP: greedy byte parity colocated vs disaggregated,
+# nonzero handoff gauges, decode pool >= 0.9x colocated tok/s while a
+# long-prompt prefill runs on the prefill pool, kill -9 of the
+# prefill replica served through the colocated fallback),
 # the goodput gate (trainer stdout byte-identical with telemetry
 # off vs on; managed-job phase ledger gap-free and summing to
 # wall-clock across an injected preemption), and the checkpoint gate
@@ -39,6 +44,7 @@ verify:
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --qos
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --prefix
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --trace
+	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --disagg
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --goodput
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --ckpt
 

@@ -110,6 +110,67 @@ class _Request:
     top_k: int = 0        # 0 = off
     top_p: float = 1.0    # >= 1 = off
     eos: Optional[frozenset] = None  # stop ids; None = run to max_new
+    # Disaggregated serving (serve/disagg.py): an EXPORT request is the
+    # prefill-role admission — it prefills normally (block reservation
+    # sized to the prompt only), then retires at its first sampled
+    # token with the future resolving to a PrefillHandoff instead of
+    # ever decoding. ``export_src`` carries the dense-layout source
+    # (prefill cache, row index) from prefill to the drain that
+    # serializes it; paged exports gather from the pool instead.
+    export: bool = False
+    export_src: Optional[tuple] = None
+
+
+@dataclasses.dataclass
+class PrefillHandoff:
+    """One prompt's computed KV state, host-side, ready to transfer to
+    a decode-role engine (the disaggregated-serving handoff unit).
+
+    Paged layout: ``k``/``v`` are [L, nb, Hkv, P, D] in pool block
+    layout (block i covers prompt positions [i*P, (i+1)*P)); the last
+    block may be partial — positions past ``prompt_len`` carry junk
+    that is never attended. The full-block CHAIN (the trie keys) is
+    derivable from ``row`` + ``block``, which is what lets shared
+    prefixes transfer as references instead of bytes. Dense ('slot')
+    layout: ``k``/``v`` are [L, 1, Hkv, prompt_len, D].
+    Scale planes (``k_s``/``v_s``) present iff the KV cache is int8."""
+    row: List[int]
+    first: int
+    max_new: int
+    temperature: float
+    top_k: int
+    top_p: float
+    eos: Optional[frozenset]
+    layout: str
+    prompt_len: int
+    block: int = 0
+    n_blocks: int = 0
+    k: Optional[np.ndarray] = None
+    v: Optional[np.ndarray] = None
+    k_s: Optional[np.ndarray] = None
+    v_s: Optional[np.ndarray] = None
+
+    @property
+    def full_blocks(self) -> int:
+        """Blocks fully covered by the prompt — the shareable chain."""
+        return self.prompt_len // self.block if self.block else 0
+
+
+@dataclasses.dataclass
+class _ImportEntry:
+    """A decode-role admission waiting for a slot + blocks: the
+    imported prompt KV plus the mid-flight request state (first token
+    already sampled by the prefill side). ``block_start`` is the index
+    of the first prompt block present in the data arrays — earlier
+    blocks were negotiated away as local trie references."""
+    req: _Request
+    first: int
+    layout: str
+    block_start: int = 0
+    k: Optional[np.ndarray] = None
+    v: Optional[np.ndarray] = None
+    k_s: Optional[np.ndarray] = None
+    v_s: Optional[np.ndarray] = None
 
 
 @dataclasses.dataclass
@@ -140,6 +201,13 @@ class _Inflight:
     reqs: List[Optional[_Request]]
     toks: jax.Array
     steps: int
+
+
+class KVImportError(RuntimeError):
+    """A transferred handoff could not be installed (e.g. blocks
+    negotiated away as shared references were evicted between the
+    prepare round trip and the import). The serving layer maps this to
+    a 409 and falls back to colocated serving."""
 
 
 # Idle engine pacing: the loop parks in _wake.wait(_IDLE_WAIT_S) when no
@@ -390,9 +458,21 @@ class ContinuousEngine:
                  kv_blocks: Optional[int] = None,
                  kv_block: Optional[int] = None,
                  pipeline: Optional[bool] = None,
-                 prefix_share: Optional[bool] = None):
+                 prefix_share: Optional[bool] = None,
+                 role: Optional[str] = None):
         self.params = params
         self.cfg = cfg
+        # Disaggregated serving role (serve/disagg.py): 'prefill'
+        # engines mostly see export admissions (submit_prefill — retire
+        # at first token with a handoff), 'decode' engines mostly see
+        # imported tables (submit_import). The role is advisory — every
+        # engine keeps the full capability set so the LB's colocated
+        # fallback can route /generate at ANY surviving replica.
+        self.role = role or os.environ.get('SKYTPU_LLM_ROLE',
+                                           'colocated')
+        if self.role not in ('colocated', 'prefill', 'decode'):
+            raise ValueError(f'Unknown engine role {self.role!r}; '
+                             "'colocated', 'prefill' or 'decode'")
         # Speculative mode (see module docstring): draft proposes,
         # target verifies, per slot, inside the continuous batch.
         if (draft_params is None) != (draft_cfg is None):
@@ -570,6 +650,7 @@ class ContinuousEngine:
         self._init_device_state()
         self._slot_req: List[Optional[_Request]] = [None] * self.slots
         self._pending: collections.deque = collections.deque()
+        self._pending_imports: collections.deque = collections.deque()
         self._unfetched: List[tuple] = []  # [(reqs, firsts-device-array)]
         self._admitting: List[_Request] = []  # mid-prefill group
         self._prefilling: List[_Prefilling] = []
@@ -609,6 +690,12 @@ class ContinuousEngine:
         self.spec_rounds = 0
         self.spec_proposals = 0
         self.spec_accepted = 0
+        # KV handoff accounting (disaggregated serving).
+        self.exports = 0
+        self.imports = 0
+        self.export_ms = 0.0
+        self.import_ms = 0.0
+        self.import_errors = 0
         # Overlap observability (see stats()['pipeline']): host work
         # done while a chunk computes vs host time the device provably
         # idled with work waiting (the serial-mode bubble).
@@ -632,19 +719,134 @@ class ContinuousEngine:
         self._wake.set()
         return req.future
 
+    def submit_prefill(self, row: List[int], max_new: int,
+                       temperature: float = 0.0, top_k: int = 0,
+                       top_p: float = 1.0,
+                       eos=None) -> concurrent.futures.Future:
+        """Prefill-role admission: compute the prompt's KV, sample the
+        first token, and RETIRE — the future resolves with a
+        ``PrefillHandoff`` a decode-role engine can import
+        (``submit_import``). ``max_new`` is the downstream ask and only
+        rides the handoff; this engine reserves blocks for the prompt
+        alone. Dense targets only in the exactness sense that matters:
+        MoE expert capacity couples co-batched rows, so exported KV
+        would replay its batchmates' contention on a different replica
+        — same reason the prefix pool refuses MoE."""
+        if self.cfg.num_experts > 0:
+            raise ValueError('KV handoff requires a dense model (MoE '
+                             'expert capacity is per forward call, so '
+                             'exported prompt KV is not batch-'
+                             'independent)')
+        if self.draft_cfg is not None:
+            raise ValueError('KV handoff does not compose with '
+                             'speculative decoding (the draft cache '
+                             'does not transfer)')
+        req = self._build_request(row, max_new, temperature, None,
+                                  top_k, top_p, eos, export=True)
+        with self._lock:
+            self._pending.append(req)
+        self.start()
+        self._wake.set()
+        return req.future
+
+    def submit_import(self, row: List[int], max_new: int, first: int,
+                      *, temperature: float = 0.0, top_k: int = 0,
+                      top_p: float = 1.0, eos=None, on_tokens=None,
+                      layout: str = 'paged', block_start: int = 0,
+                      k=None, v=None, k_s=None,
+                      v_s=None) -> concurrent.futures.Future:
+        """Decode-role admission of an imported prompt: install the
+        transferred KV (paged: block scatter + table install; dense:
+        row insert), emit ``first`` as the request's first token, and
+        resume continuous decode. Backpressures exactly like local
+        admission — entries queue until a slot and the full block
+        reservation are allocatable."""
+        if layout != self.kv_layout:
+            raise ValueError(f'handoff layout {layout!r} does not match '
+                             f'engine kv_layout {self.kv_layout!r}')
+        if self.cfg.num_experts > 0 or self.draft_cfg is not None:
+            raise ValueError('KV handoff requires a dense, '
+                             'non-speculative engine')
+        if ((k_s is not None) != self.kv_quantize) and k is not None:
+            raise ValueError('handoff KV quantization does not match '
+                             'the engine kv_cache mode')
+        # Plane-shape validation HERE, synchronously: an install that
+        # raises on the engine thread fails every in-flight request
+        # (_fail_everything blast radius), so a shape-skewed payload —
+        # header corruption survives crc32, which covers plane bytes
+        # only — must be rejected before it is ever enqueued.
+        cfg = self.cfg
+        if self.kv_layout == 'paged':
+            p = self.kv_block
+            nb_prompt = -(-len(row) // p)
+            nb_present = nb_prompt - int(block_start)
+            if nb_present < 0:
+                raise ValueError(
+                    f'handoff block_start {block_start} exceeds the '
+                    f'prompt chain ({nb_prompt} blocks)')
+            want = (cfg.n_layers, nb_present, cfg.n_kv_heads, p,
+                    cfg.head_dim)
+        else:
+            nb_present = 1  # one dense record, exact prompt width
+            want = (cfg.n_layers, 1, cfg.n_kv_heads, len(row),
+                    cfg.head_dim)
+        if nb_present > 0:  # == 0: full local prefix share, no planes
+            if k is None or v is None \
+                    or tuple(k.shape) != want or tuple(v.shape) != want:
+                raise ValueError(
+                    f'handoff k/v planes must be {want}, got '
+                    f'{None if k is None else tuple(k.shape)} / '
+                    f'{None if v is None else tuple(v.shape)}')
+            if self.kv_quantize and (
+                    k_s is None or v_s is None
+                    or tuple(k_s.shape) != want[:-1]
+                    or tuple(v_s.shape) != want[:-1]):
+                raise ValueError(
+                    f'handoff k_s/v_s scale planes must be {want[:-1]}')
+        req = self._build_request(row, max_new, temperature, on_tokens,
+                                  top_k, top_p, eos)
+        entry = _ImportEntry(req=req, first=int(first), layout=layout,
+                             block_start=int(block_start),
+                             k=k, v=v, k_s=k_s, v_s=v_s)
+        with self._lock:
+            self._pending_imports.append(entry)
+        self.start()
+        self._wake.set()
+        return req.future
+
+    def probe_chain(self, row: List[int]) -> int:
+        """How many leading FULL prompt blocks of ``row`` this engine's
+        share trie already holds — the handoff negotiation answer that
+        lets the transfer skip those blocks' bytes. Touches the matched
+        nodes (LRU refresh) so eviction is unlikely to race the import
+        that follows; a race that still loses simply fails the import
+        and falls back."""
+        if self._trie is None:
+            return 0
+        p = self.kv_block
+        with self._lock:
+            nodes, _, _ = self._trie.match(row, limit=(len(row) // p) * p)
+            for nd in nodes:
+                self._trie.touch(nd)
+        return len(nodes)
+
     def _build_request(self, row, max_new, temperature, on_tokens,
-                       top_k, top_p, eos) -> _Request:
+                       top_k, top_p, eos, export: bool = False
+                       ) -> _Request:
         """Validation + construction shared by submit() and the SPMD
-        engine's collective-arrival path (serve/spmd.py)."""
-        if len(row) + max_new > self._submit_max:
+        engine's collective-arrival path (serve/spmd.py). Export
+        requests validate against the PROMPT footprint only (they
+        retire at the first token; max_new is spent downstream)."""
+        budget = 1 if export else max_new
+        if len(row) + budget > self._submit_max:
             extra = ('' if self._submit_max == self.max_len else
                      f' (max_len {self.max_len} minus the speculative '
                      f'verify window overhang {self.spec_k + 1})')
             raise ValueError(
-                f'prompt ({len(row)}) + max_new ({max_new}) exceeds '
+                f'prompt ({len(row)}) + max_new ({budget}) exceeds '
                 f'engine max_len limit {self._submit_max}{extra}')
-        if self.kv_layout == 'paged' and max_new > 1:
-            need = self._blocks_for(len(row), max_new)
+        if self.kv_layout == 'paged' and (max_new > 1 or export):
+            need = self._blocks_for(len(row), budget)
             if need > self.kv_blocks - 1:
                 # Bigger than the WHOLE pool: admission could never
                 # succeed — the request would stall itself and starve
@@ -672,7 +874,7 @@ class ContinuousEngine:
         fut.set_running_or_notify_cancel()
         return _Request(list(row), max_new, float(temperature), fut,
                         on_tokens=on_tokens, top_k=int(top_k),
-                        top_p=float(top_p), eos=eos)
+                        top_p=float(top_p), eos=eos, export=export)
 
     def start(self) -> None:
         # Under the lock: two first-submitters racing here must not both
@@ -691,11 +893,25 @@ class ContinuousEngine:
         self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
+            if self._thread.is_alive():
+                return  # wedged mid-chunk; don't race its state
+        # The loop thread is gone: anything still queued or occupying a
+        # slot would otherwise wait FOREVER — the HTTP streaming handler
+        # blocks on these futures, so a decode replica killed mid-stream
+        # must fail fast for the LB to resume the request on a survivor.
+        with self._lock:
+            live = bool(self._pending or self._pending_imports
+                        or self._admitting or self._prefilling
+                        or self._unfetched
+                        or any(r is not None for r in self._slot_req))
+        if live:
+            self._fail_everything(RuntimeError('engine stopped'))
 
     def stats(self) -> dict:
         with self._lock:
             active = sum(r is not None for r in self._slot_req)
             queued = len(self._pending)
+            queued_imports = len(self._pending_imports)
             # ONE read: the block states must agree within a snapshot
             # (free + owned + shared + cached == usable), or the
             # dashboard can render an impossible state mid-admission.
@@ -709,6 +925,18 @@ class ContinuousEngine:
         return {'slots': self.slots, 'active_slots': active,
                 'kv_cache': 'int8' if self.kv_quantize else 'bf16',
                 'kv_layout': self.kv_layout,
+                # Disaggregated-serving role + handoff accounting
+                # (serve/disagg.py): exports are prefill-role
+                # retirements, imports are decode-role admissions of
+                # transferred tables; queued_imports is the decode
+                # pool's admission backpressure signal.
+                'role': self.role,
+                'disagg': {'exports': self.exports,
+                           'imports': self.imports,
+                           'export_ms': round(self.export_ms, 3),
+                           'import_ms': round(self.import_ms, 3),
+                           'import_errors': self.import_errors,
+                           'queued_imports': queued_imports},
                 'kv_blocks': (None if self.kv_layout != 'paged' else {
                     'total': self.kv_blocks, 'block': self.kv_block,
                     'free': free_blocks,
@@ -800,6 +1028,13 @@ class ContinuousEngine:
                 # prefill must win a freed slot over younger shorts.
                 t0 = time.perf_counter()
                 self._advance_prefill()
+                # Imported prompts admit FIRST: their prefill compute
+                # is already spent on the prefill pool — parking them
+                # behind younger local admissions would strand paid-for
+                # work (they do NOT block local admission when parked:
+                # the colocated-fallback traffic a decode replica also
+                # serves must keep flowing).
+                self._admit_imports()
                 self._admit()
                 if self._inflight is not None:
                     # Prefill/admission dispatches issued while a chunk
@@ -823,6 +1058,17 @@ class ContinuousEngine:
                     self._wake.wait(_IDLE_WAIT_S)
                     self._wake.clear()
                     continue
+                with self._lock:
+                    only_exports = all(r is None or r.export
+                                       for r in self._slot_req)
+                if only_exports:
+                    # Prefill-role steady state: every occupied slot is
+                    # an export awaiting its drain — a decode chunk
+                    # over them would be pure junk compute. Drain (which
+                    # serializes + retires them) and admit again.
+                    self._flush_pipeline(quiet=True)
+                    self._drain_firsts()
+                    continue
                 if self.draft_cfg is not None:
                     self._run_spec_round()
                 else:
@@ -843,8 +1089,10 @@ class ContinuousEngine:
             doomed = list(self._pending) + [
                 r for r in self._slot_req if r is not None] + [
                 r for reqs, _ in self._unfetched for r in reqs] + \
-                list(self._admitting) + [p.req for p in self._prefilling]
+                list(self._admitting) + [p.req for p in self._prefilling] \
+                + [e.req for e in self._pending_imports]
             self._pending.clear()
+            self._pending_imports.clear()
             self._slot_req = [None] * self.slots
             self._unfetched = []
             self._admitting = []
@@ -875,6 +1123,12 @@ class ContinuousEngine:
         # so the admission/release paths never branch on layout first.
         self._trie = None
         self._slot_shared = [[] for _ in range(self.slots)]
+        # The slot's INSTALLED table row (host copy, paged layout):
+        # exports reconstruct the exact device table from it — deriving
+        # it from the owned/shared lists breaks when a commit deduped
+        # against an existing chain node.
+        self._slot_table: List[Optional[np.ndarray]] = \
+            [None] * self.slots
         if self.kv_layout == 'paged':
             from skypilot_tpu.models import paged as paged_lib
             pool_kv = pool_s = None
@@ -939,9 +1193,14 @@ class ContinuousEngine:
         return -(-(row_len + max_new + extra) // self.kv_block)
 
     def _blocks_needed(self, req: _Request) -> int:
-        return self._blocks_for(len(req.row), req.max_new)
+        # Export requests retire at the first token: the reservation
+        # covers the prompt (plus the one junk decode position a
+        # pipelined chunk may write before retirement), never max_new.
+        budget = 1 if req.export else req.max_new
+        return self._blocks_for(len(req.row), budget)
 
     def _release_blocks(self, slot: int) -> None:
+        self._slot_table[slot] = None
         if self.kv_layout == 'paged':
             self._free_blocks.extend(self._slot_blocks[slot])
             self._slot_blocks[slot] = []
@@ -1025,7 +1284,8 @@ class ContinuousEngine:
                 # queue rather than letting younger requests jump it.
                 shared = None
                 if (self._trie is not None and self._pending
-                        and self._pending[0].max_new > 1):
+                        and (self._pending[0].max_new > 1
+                             or self._pending[0].export)):
                     head = self._pending[0]
                     nodes, partial, plen = self._trie.match(head.row)
                     if nodes:
@@ -1105,11 +1365,11 @@ class ContinuousEngine:
                             if run >= n:
                                 break
                             if (run > 0 and self._trie is not None
-                                    and p.max_new > 1
+                                    and (p.max_new > 1 or p.export)
                                     and self._trie.match(p.row)[0]):
                                 break
                             nb = (self._blocks_needed(p)
-                                  if p.max_new > 1 else 0)
+                                  if p.max_new > 1 or p.export else 0)
                             if nb > avail:
                                 break
                             avail -= nb
@@ -1191,6 +1451,7 @@ class ContinuousEngine:
                 freed = self._trie.release(partial)
                 if freed is not None:
                     self._free_blocks.append(freed)
+            self._slot_table[slot] = table.copy()
             self._commit_prompt_blocks(slot, row, nodes)
             self._unfetched.append(([req], first))
         self.prefills += 1
@@ -1412,6 +1673,9 @@ class ContinuousEngine:
         req = entry.req
         if self.draft_cfg is not None and entry.d_consumed < len(req.row):
             return  # draft cache still catching up; retried next iter
+        if req.export:
+            self._finish_long_export(entry)
+            return
         done = (req.max_new == 1
                 or gen_lib.truncate_at_stop([entry.first_host],
                                             req.eos)[1])
@@ -1464,6 +1728,43 @@ class ContinuousEngine:
             self._d_cache = _jit_insert_cache(
                 self._d_cache, entry.d_cache,
                 jnp.asarray([slot], jnp.int32))
+
+    def _finish_long_export(self, entry: _Prefilling) -> None:
+        """Export retirement for a chunked long prefill. Dense engines
+        serialize the scratch row directly (no slot at all); paged
+        engines insert into pool blocks first — COMMITTING the prompt
+        chain, so later sharers and later exports of the same long
+        preamble hit the trie — and gather back out. May PARK (return
+        without popping) awaiting a slot/blocks like a normal finish."""
+        req = entry.req
+        if self.kv_layout == 'paged':
+            with self._lock:
+                free = [i for i, r in enumerate(self._slot_req)
+                        if r is None]
+                nb = self._blocks_needed(req)
+                if not free or self._blocks_avail() < nb:
+                    return  # park; retried next iteration
+                blocks = self._alloc_blocks(nb)
+                table_row = np.zeros((self.max_len // self.kv_block,),
+                                     np.int32)
+                table_row[:nb] = blocks
+                slot = free[0]
+                self._slot_req[slot] = req
+                self._slot_blocks[slot] = list(blocks)
+                self._slot_table[slot] = table_row.copy()
+            from skypilot_tpu.models import paged as paged_lib
+            self._cache = paged_lib.jit_insert(
+                self._cache, entry.cache, np.asarray(table_row[None]),
+                np.asarray([slot], np.int32))
+            if self._trie is not None:
+                with self._lock:
+                    if self._slot_req[slot] is req:
+                        self._commit_prompt_blocks(slot, req.row, [])
+        else:
+            req.export_src = (entry.cache, 0)
+        self._prefilling.pop(0)
+        self.prefills += 1
+        self._export_and_retire(req, entry.first_host)
 
     def _prefill_group(self, reqs: List[_Request],
                        slots: List[int]) -> None:
@@ -1536,12 +1837,16 @@ class ContinuousEngine:
             tables_host = np.zeros((n, mb), np.int32)
             with self._lock:
                 for i, r in enumerate(reqs):
-                    if r.max_new <= 1:
+                    if r.max_new <= 1 and not r.export:
                         continue  # resolves at prefill: junk-sink row
+                    # Export requests DO take blocks even at
+                    # max_new == 1: the handoff serializes from the
+                    # pool, and a junk-sink row would lose the KV.
                     nb = self._blocks_needed(r)
                     blocks = self._alloc_blocks(nb)  # _admit reserved
                     self._slot_blocks[slots[i]] = blocks
                     tables_host[i, :nb] = blocks
+                    self._slot_table[slots[i]] = tables_host[i].copy()
             self._cache = paged_lib.jit_insert(
                 self._cache, cache_n, tables_host,
                 np.asarray(slots, np.int32))
@@ -1554,7 +1859,7 @@ class ContinuousEngine:
                 # after their content lands).
                 with self._lock:
                     for i, r in enumerate(reqs):
-                        if r.max_new > 1:
+                        if r.max_new > 1 or r.export:
                             self._commit_prompt_blocks(slots[i], rows[i],
                                                        [])
                             self.share_misses += 1
@@ -1586,7 +1891,13 @@ class ContinuousEngine:
         with self._lock:
             self._unfetched.append((reqs, firsts))
             for i, req in enumerate(reqs):
-                if req.max_new > 1:
+                if req.export and self.kv_layout != 'paged':
+                    # Dense export serializes straight from the prefill
+                    # cache at drain time — no slot occupancy at all.
+                    req.export_src = (cache_n, i)
+                elif req.max_new > 1 or req.export:
+                    # Paged exports hold their slot (and blocks) until
+                    # the drain gathers them out of the pool.
                     self._slot_req[slots[i]] = req
         self._note_prefill_time(t0, had_active)
 
@@ -1599,11 +1910,19 @@ class ContinuousEngine:
             self._unfetched = []
         done: List[_Request] = []
         emitted: List[tuple] = []
+        exports: List[tuple] = []
         for reqs, firsts in batches:
             firsts_host = np.asarray(jax.device_get(firsts))
             with self._lock:
                 for i, req in enumerate(reqs):
                     first = int(firsts_host[i])
+                    if req.export:
+                        # Prefill-role retirement: the first token rides
+                        # the handoff — nothing is emitted here, and the
+                        # serialization (device gather + get) must not
+                        # run under the lock.
+                        exports.append((req, first))
+                        continue
                     req.tokens.append(first)
                     self.tokens_emitted += 1
                     if req.on_tokens is not None:
@@ -1624,6 +1943,260 @@ class ContinuousEngine:
         for req in done:
             if not req.future.done():
                 req.future.set_result(req.tokens)
+        for req, first in exports:
+            self._export_and_retire(req, first)
+
+    # -- disaggregated prefill/decode handoff (serve/disagg.py) -----------
+
+    def _export_and_retire(self, req: _Request, first: int) -> None:
+        """Resolve an export request with its ``PrefillHandoff`` and
+        free its resources (engine thread only). A failed serialization
+        fails THIS request alone — the engine keeps serving."""
+        t0 = time.perf_counter()
+        err = None
+        try:
+            handoff = self._build_handoff(req, first)
+        except Exception as exc:  # noqa: BLE001 — isolate per request
+            handoff, err = None, exc
+        with self._lock:
+            for si, r in enumerate(self._slot_req):
+                if r is req:
+                    self._slot_req[si] = None
+                    self._release_blocks(si)
+                    break
+        req.export_src = None  # drop the dense prefill-cache reference
+        self.export_ms += (time.perf_counter() - t0) * 1e3
+        if handoff is None:
+            if not req.future.done():
+                req.future.set_exception(err)
+            return
+        self.exports += 1
+        if not req.future.done():
+            req.future.set_result(handoff)
+
+    def _build_handoff(self, req: _Request, first: int) -> PrefillHandoff:
+        n = len(req.row)
+        base = dict(row=list(req.row), first=int(first),
+                    max_new=req.max_new, temperature=req.temperature,
+                    top_k=req.top_k, top_p=req.top_p, eos=req.eos,
+                    prompt_len=n)
+        if self.kv_layout != 'paged':
+            cache_n, i = req.export_src  # retained by _prefill_group
+            k, v, k_s, v_s = jax.device_get(
+                (cache_n.k[:, i], cache_n.v[:, i], cache_n.k_s,
+                 cache_n.v_s))
+            k = np.asarray(k)[:, None, :, :n]     # [L, 1, H, n, D]
+            v = np.asarray(v)[:, None, :, :n]
+            if k_s is not None:
+                k_s = np.asarray(k_s)[:, i][:, None, :, :n]
+                v_s = np.asarray(v_s)[:, i][:, None, :, :n]
+            return PrefillHandoff(layout='slot', k=k, v=v, k_s=k_s,
+                                  v_s=v_s, **base)
+        from skypilot_tpu.models import paged as paged_lib
+        p = self.kv_block
+        nb = -(-n // p)
+        with self._lock:
+            slot = next((si for si, r in enumerate(self._slot_req)
+                         if r is req), None)
+            table = (self._slot_table[slot]
+                     if slot is not None else None)
+        if table is None:
+            raise RuntimeError('export request lost its slot before '
+                               'serialization')
+        nbp = 1
+        while nbp < nb:
+            nbp *= 2  # pow2-padded gather: log2(MB) compiled shapes
+        tbl = np.zeros((nbp,), np.int32)
+        tbl[:nb] = table[:nb]
+        k, v, k_s, v_s = jax.device_get(
+            paged_lib.jit_export_blocks(self._cache, tbl))
+        k = np.asarray(k)[:, :nb]                 # [L, nb, H, P, D]
+        v = np.asarray(v)[:, :nb]
+        if k_s is not None:
+            k_s = np.asarray(k_s)[:, :nb]
+            v_s = np.asarray(v_s)[:, :nb]
+        return PrefillHandoff(layout='paged', block=p, n_blocks=nb,
+                              k=k, v=v, k_s=k_s, v_s=v_s, **base)
+
+    def _admit_imports(self) -> None:
+        """Install queued imported prompts (decode-role admission),
+        FIFO. Each head needs a free slot plus its FULL block
+        reservation (prompt + max_new — the decode side owns the
+        generation budget); a head that cannot admit parks the import
+        queue, which is the decode pool's backpressure the autoscaler
+        watches via ``queued_imports``. The leading locally-shared
+        chain installs as table REFERENCES (trie acquire) and only
+        genuinely new blocks scatter."""
+        from skypilot_tpu.models import paged as paged_lib
+        while True:
+            t0 = time.perf_counter()
+            doomed = None
+            with self._lock:
+                if not self._pending_imports:
+                    return
+                entry = self._pending_imports[0]
+                req = entry.req
+                first_is_eos = gen_lib.truncate_at_stop(
+                    [entry.first], req.eos)[1]
+                trivial = first_is_eos or req.max_new <= 1
+                slot = None
+                nodes: list = []
+                table_row = None
+                if not trivial:
+                    free = [i for i, r in enumerate(self._slot_req)
+                            if r is None]
+                    parked = sum(1 for e in self._prefilling if e.parked)
+                    if len(free) - parked <= 0:
+                        return  # backpressure: the head waits
+                    slot = free[0]
+                    if self.kv_layout == 'paged':
+                        n = len(req.row)
+                        p = self.kv_block
+                        if self._trie is not None:
+                            nodes, _, _ = self._trie.match(
+                                req.row, limit=(n // p) * p)
+                        if len(nodes) < entry.block_start:
+                            # Blocks negotiated away as references were
+                            # evicted between prepare and import: the
+                            # payload cannot be installed — reject, the
+                            # serving layer falls back to colocated.
+                            self._pending_imports.popleft()
+                            self.import_errors += 1
+                            doomed = req
+                        else:
+                            need = (self._blocks_for(n, req.max_new)
+                                    - len(nodes))
+                            pinned = sum(1 for nd in nodes
+                                         if nd.refs == 0)
+                            if self._blocks_avail() - pinned < need:
+                                return  # backpressure: the head waits
+                            for nd in nodes:
+                                self._trie.acquire(nd)
+                            owned = self._alloc_blocks(need)
+                            mb = self.max_len // p
+                            table_row = np.zeros((mb,), np.int32)
+                            table_row[:len(nodes)] = [nd.block
+                                                      for nd in nodes]
+                            table_row[len(nodes):len(nodes) + len(owned)] \
+                                = owned
+                            self._slot_blocks[slot] = list(owned)
+                            self._slot_shared[slot] = list(nodes)
+                            self._slot_table[slot] = table_row.copy()
+                    if doomed is None:
+                        self._slot_req[slot] = req
+                        self._pending_imports.popleft()
+                else:
+                    self._pending_imports.popleft()
+            if doomed is not None:
+                if not doomed.future.done():
+                    doomed.future.set_exception(KVImportError(
+                        'handoff blocks negotiated as shared references '
+                        'were evicted before import'))
+                continue
+            if trivial:
+                req.tokens.append(entry.first)
+                self.tokens_emitted += 1
+                if req.on_tokens is not None:
+                    self._fire_callbacks([(req, [entry.first])])
+                self.imports += 1
+                if not req.future.done():
+                    req.future.set_result(req.tokens)
+                continue
+            # Device install (outside the lock: submit() must not wait
+            # on a scatter dispatch).
+            if self.kv_layout == 'paged':
+                self._install_import_paged(entry, slot, nodes, table_row)
+            else:
+                self._install_import_dense(entry, slot)
+            with self._lock:
+                if self._slot_req[slot] is req:
+                    self._commit_prompt_blocks(slot, req.row, nodes)
+                if self._trie is not None:
+                    if nodes:
+                        self.share_hits += 1
+                        self.share_hit_tokens += len(nodes) * self.kv_block
+                    else:
+                        self.share_misses += 1
+            req.tokens.append(entry.first)
+            self.tokens_emitted += 1
+            if req.on_tokens is not None:
+                self._fire_callbacks([(req, [entry.first])])
+            self.imports += 1
+            self.import_ms += (time.perf_counter() - t0) * 1e3
+
+    def _install_import_paged(self, entry: _ImportEntry, slot: int,
+                              nodes: list, table_row: np.ndarray) -> None:
+        """Scatter the transferred prompt blocks into the pool and
+        install table/length/last at ``slot`` — one jit dispatch plus
+        the ``last`` write. Blocks below the local share point install
+        as references (their bytes, if transferred, are ignored)."""
+        from skypilot_tpu.models import paged as paged_lib
+        req = entry.req
+        n = len(req.row)
+        p = self.kv_block
+        nb_prompt = -(-n // p)
+        start = max(len(nodes), entry.block_start)
+        ids = table_row[start:nb_prompt]
+        nbp = 1
+        while nbp < max(len(ids), 1):
+            nbp *= 2
+        blocks = np.zeros((nbp,), np.int32)  # pad -> junk sink
+        blocks[:len(ids)] = ids
+        cfg = self.cfg
+        shp = (cfg.n_layers, nbp, cfg.n_kv_heads, p, cfg.head_dim)
+        # Pool dtype, not entry dtype: a full-skip handoff (every
+        # prompt block negotiated as a trie reference) legitimately
+        # carries NO plane bytes — entry.k is None and the install is
+        # the documented all-sink scatter plus the table write.
+        kdt = self._cache.k.dtype
+        k_pad = np.zeros(shp, dtype=kdt)
+        v_pad = np.zeros(shp, dtype=kdt)
+        lo = start - entry.block_start
+        hi = nb_prompt - entry.block_start
+        if len(ids):
+            k_pad[:, :len(ids)] = entry.k[:, lo:hi]
+            v_pad[:, :len(ids)] = entry.v[:, lo:hi]
+        ks_pad = vs_pad = None
+        if self.kv_quantize:
+            ks_pad = np.zeros(shp[:-1], np.float32)
+            vs_pad = np.zeros(shp[:-1], np.float32)
+            if len(ids):
+                ks_pad[:, :len(ids)] = entry.k_s[:, lo:hi]
+                vs_pad[:, :len(ids)] = entry.v_s[:, lo:hi]
+        self._cache = paged_lib.jit_import_blocks(
+            self._cache, k_pad, v_pad, ks_pad, vs_pad, blocks,
+            table_row, np.int32(slot), np.int32(n))
+        self._last = self._last.at[jnp.asarray([slot], jnp.int32)].set(
+            jnp.asarray([entry.first], jnp.int32))
+
+    def _install_import_dense(self, entry: _ImportEntry,
+                              slot: int) -> None:
+        """Dense ('slot') install: rebuild a 1-row prefill cache from
+        the transferred bytes and reuse the standard insert."""
+        req = entry.req
+        n = len(req.row)
+        w = min(prompt_bucket(n), self.max_len)
+        l, _, h, _, d = entry.k.shape
+        k = np.zeros((l, 1, h, w, d), dtype=entry.k.dtype)
+        v = np.zeros((l, 1, h, w, d), dtype=entry.v.dtype)
+        k[:, :, :, :n] = entry.k
+        v[:, :, :, :n] = entry.v
+        k_s = v_s = None
+        if self.kv_quantize:
+            k_s = np.zeros((l, 1, h, w), np.float32)
+            v_s = np.zeros((l, 1, h, w), np.float32)
+            k_s[:, :, :, :n] = entry.k_s
+            v_s[:, :, :, :n] = entry.v_s
+        cache_n = gen_lib.KVCache(k=jnp.asarray(k), v=jnp.asarray(v),
+                                  lengths=np.asarray([n], np.int32),
+                                  k_s=None if k_s is None
+                                  else jnp.asarray(k_s),
+                                  v_s=None if v_s is None
+                                  else jnp.asarray(v_s))
+        self._cache, self._last = _jit_insert(
+            self._cache, self._last, cache_n,
+            np.asarray([entry.first], np.int32),
+            jnp.asarray([slot], jnp.int32))
 
     def _run_spec_round(self) -> None:
         """One draft-propose / target-verify round over all slots (spec
@@ -1672,7 +2245,7 @@ class ContinuousEngine:
         with self._lock:
             for i, req in enumerate(reqs):
                 if req is None or self._slot_req[i] is not req \
-                        or req.future.done():
+                        or req.future.done() or req.export:
                     continue  # junk slot (see _run_chunk's rationale)
                 if req.temperature == 0.0:
                     a = 0
@@ -1836,7 +2409,7 @@ class ContinuousEngine:
         with self._lock:
             for i, req in enumerate(flight.reqs):
                 if req is None or self._slot_req[i] is not req \
-                        or req.future.done():
+                        or req.future.done() or req.export:
                     # Stale snapshot entry: between this chunk's
                     # dispatch and its retirement, _drain_firsts may
                     # have resolved a first-token-eos request, or the

@@ -551,6 +551,55 @@ def _gather_blocks_impl(pool: PagedKVCache,
 jit_gather_blocks = jax.jit(_gather_blocks_impl)
 
 
+# ---------------------------------------------------------------------------
+# Disaggregated prefill/decode KV handoff (serve/disagg.py): a
+# prefill-role engine exports a prompt's committed blocks in POOL
+# LAYOUT — [L, NB, Hkv, P, D], the exact on-device arrangement — so the
+# same-host staging path needs zero re-layout and the decode-role
+# import is one scatter. Block counts are padded to a power of two by
+# the caller (junk-sink ids), bounding compiles at log2(max_blocks)
+# per direction.
+
+
+def _export_blocks_impl(pool: PagedKVCache, blocks: jax.Array):
+    """Gather ``blocks`` [NB] (junk-sink-0-padded) out of the pool,
+    keeping the block layout. Returns (k, v, k_s, v_s) with the scale
+    planes None for bf16 pools (None is a pytree leaf-less node, so
+    the two variants trace separately)."""
+    k = pool.k[:, blocks]
+    v = pool.v[:, blocks]
+    if pool.quantized:
+        return k, v, pool.k_s[:, blocks], pool.v_s[:, blocks]
+    return k, v, None, None
+
+
+jit_export_blocks = jax.jit(_export_blocks_impl)
+
+
+def _import_blocks_impl(pool: PagedKVCache, k_new, v_new, k_s_new,
+                        v_s_new, blocks: jax.Array,
+                        table_row: jax.Array, slot: jax.Array,
+                        length: jax.Array) -> PagedKVCache:
+    """Scatter imported block data [L, NB, H, P, D] into the pool at
+    ``blocks`` [NB] and install ``table_row`` [MB] + ``length`` at
+    ``slot`` in the SAME dispatch — the decode-role admission is one
+    program. Padding entries point at the junk sink (block 0), so a
+    zero-block install (full local prefix share) reuses this path with
+    an all-sink scatter."""
+    k = pool.k.at[:, blocks].set(k_new)
+    v = pool.v.at[:, blocks].set(v_new)
+    k_s, v_s = pool.k_s, pool.v_s
+    if k_s_new is not None:
+        k_s = k_s.at[:, blocks].set(k_s_new)
+        v_s = v_s.at[:, blocks].set(v_s_new)
+    return PagedKVCache(
+        k=k, v=v, tables=pool.tables.at[slot].set(table_row),
+        lengths=pool.lengths.at[slot].set(length), k_s=k_s, v_s=v_s)
+
+
+jit_import_blocks = jax.jit(_import_blocks_impl, donate_argnums=(0,))
+
+
 def _prefill_shared_impl(cfg: llama.LlamaConfig, params,
                          cache: PagedKVCache, tokens: jax.Array,
                          table_row: jax.Array, slot: jax.Array,

@@ -29,6 +29,10 @@ class AutoscalerDecision:
     # target replicas should be spot vs on-demand. None = single pool.
     num_spot: Optional[int] = None
     num_ondemand: Optional[int] = None
+    # Role-pool targets (DualPoolAutoscaler, disaggregated serving):
+    # prefill and decode pool sizes. None = not disaggregated.
+    num_prefill: Optional[int] = None
+    num_decode: Optional[int] = None
 
 
 class Autoscaler:
@@ -294,8 +298,198 @@ class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
         return decision
 
 
+class _PoolHysteresis:
+    """Per-pool hysteresis state (the RequestRateAutoscaler discipline,
+    factored so each role pool counts its own way up and down)."""
+
+    def __init__(self, initial: int, up_threshold: int = 2,
+                 down_threshold: int = 5):
+        self.target = initial
+        self.up_threshold = up_threshold
+        self.down_threshold = down_threshold
+        self._up = 0
+        self._down = 0
+
+    def step(self, desired: int) -> int:
+        if desired > self.target:
+            self._up += 1
+            self._down = 0
+            if self._up >= self.up_threshold:
+                self._up = 0
+                self.target = desired
+        elif desired < self.target:
+            self._down += 1
+            self._up = 0
+            if self._down >= self.down_threshold:
+                self._down = 0
+                self.target = desired
+        else:
+            self._up = self._down = 0
+        return self.target
+
+
+class DualPoolAutoscaler(Autoscaler):
+    """Disaggregated prefill/decode serving: each role pool scales on
+    ITS phase's saturation signal, because the phases saturate
+    differently (the whole reason the pools exist):
+
+    * PREFILL pool — queue depth on the prefill replicas (prompts
+      waiting for a prefill slot; /health ``queue.depth_total``) per
+      replica vs ``target_queue_per_replica``, plus the engine's
+      prefill-bubble rate (``prefill_bubble_ms`` growth between
+      evaluations): a pool whose replicas spend >30% of wall-clock in
+      prefill bubbles is compute-starved even at shallow queues.
+    * DECODE pool — decode throughput (``tokens_emitted`` growth) per
+      replica vs ``target_decode_tok_s_per_replica``, plus KV-block
+      occupancy: past ``kv_occupancy_high`` the pool is MEMORY-bound —
+      imported prompts queue for blocks (``queued_imports``
+      backpressure) no matter the tok/s headroom, so the pool grows.
+
+    Cumulative engine counters are turned into rates by differencing
+    between evaluate() calls (the autoscaler is already stateful for
+    hysteresis); a replica restart resets its counters, which reads as
+    one zero-rate tick, absorbed by hysteresis.
+    """
+
+    BUBBLE_HIGH_FRAC = 0.30
+
+    def __init__(self, policy: ReplicaPolicy,
+                 upscale_counter_threshold: int = 2,
+                 downscale_counter_threshold: int = 5):
+        super().__init__(policy)
+        assert policy.disaggregated
+        self._prefill = _PoolHysteresis(policy.prefill_pool.min_replicas,
+                                        upscale_counter_threshold,
+                                        downscale_counter_threshold)
+        self._decode = _PoolHysteresis(policy.decode_pool.min_replicas,
+                                       upscale_counter_threshold,
+                                       downscale_counter_threshold)
+        # replica_id -> (t, tokens_emitted, prefill_bubble_ms)
+        self._last: Dict[int, tuple] = {}
+
+    @staticmethod
+    def _pool(replicas, role: str) -> List[Dict[str, Any]]:
+        return [r for r in _alive(replicas) if r.get('role') == role]
+
+    @staticmethod
+    def _engine(r: Dict[str, Any]) -> Dict[str, Any]:
+        from skypilot_tpu.serve import serve_state
+        health = serve_state.parse_health(r.get('health')) or {}
+        eng = health.get('engine')
+        return eng if isinstance(eng, dict) else {}
+
+    @staticmethod
+    def _queue_depth(r: Dict[str, Any]) -> float:
+        from skypilot_tpu.serve import serve_state
+        health = serve_state.parse_health(r.get('health')) or {}
+        depth = 0.0
+        queue = health.get('queue')
+        if isinstance(queue, dict) and isinstance(
+                queue.get('depth_total'), (int, float)):
+            depth = float(queue['depth_total'])
+        # /v1/kv/export submits straight into the continuous engine
+        # (no window queue, no QoS gate), so a prefill replica's
+        # backlog lives in engine 'queued' — without it the pool's
+        # primary scale-up signal reads 0 under an export flood.
+        eng = health.get('engine')
+        if isinstance(eng, dict) and isinstance(
+                eng.get('queued'), (int, float)):
+            depth += float(eng['queued'])
+        return depth
+
+    def _clamp_pool(self, desired: int, pool) -> int:
+        desired = max(pool.min_replicas, desired)
+        if pool.max_replicas is not None:
+            desired = min(desired, pool.max_replicas)
+        return desired
+
+    def evaluate(self, num_ready, num_launching, request_times,
+                 now=None, replicas=None,
+                 queue_pressure=None) -> AutoscalerDecision:
+        now = now if now is not None else time.time()
+        prefill = self._pool(replicas, 'prefill')
+        decode = self._pool(replicas, 'decode')
+        reasons = []
+
+        # -- prefill pool: queue depth + prefill-bubble rate -------------
+        queue_total = sum(self._queue_depth(r) for r in prefill)
+        per_replica = float(self.policy.target_queue_per_replica or 4.0)
+        desired_p = (_ceil_units(queue_total, per_replica)
+                     if queue_total > 0
+                     else self.policy.prefill_pool.min_replicas)
+        bubble_fracs = []
+        tok_rates = []
+        occupancies = []
+        seen = set()
+        for role, pool in (('prefill', prefill), ('decode', decode)):
+            for r in pool:
+                rid = int(r.get('replica_id') or 0)
+                seen.add(rid)
+                eng = self._engine(r)
+                tokens = float(eng.get('tokens_emitted') or 0)
+                bubble = float(eng.get('prefill_bubble_ms') or 0)
+                last = self._last.get(rid)
+                self._last[rid] = (now, tokens, bubble)
+                if last is None or now <= last[0]:
+                    continue
+                dt = now - last[0]
+                if role == 'prefill':
+                    # Counter reset (replica restart) reads as one
+                    # zero-rate tick, absorbed by hysteresis.
+                    d_bubble = max(bubble - last[2], 0.0)
+                    bubble_fracs.append(d_bubble / (dt * 1000.0))
+                else:
+                    tok_rates.append(max(tokens - last[1], 0.0) / dt)
+                    kb = eng.get('kv_blocks')
+                    if isinstance(kb, dict) \
+                            and (kb.get('usable') or 0) > 0:
+                        # 'cached' blocks (idle trie, refs 0) are
+                        # reclaimable on demand — counting them as
+                        # occupied would latch a warmed prefix-share
+                        # replica at ~1.0 forever.
+                        occupancies.append(
+                            1.0 - (float(kb.get('free') or 0)
+                                   + float(kb.get('cached') or 0))
+                            / float(kb['usable']))
+        self._last = {k: v for k, v in self._last.items() if k in seen}
+        if bubble_fracs and (sum(bubble_fracs) / len(bubble_fracs)
+                             > self.BUBBLE_HIGH_FRAC):
+            desired_p = max(desired_p, len(prefill) + 1)
+            reasons.append('prefill bubble-bound')
+        if queue_total:
+            reasons.append(f'prefill queue={queue_total:.0f}')
+        desired_p = self._clamp_pool(desired_p, self.policy.prefill_pool)
+
+        # -- decode pool: tok/s + KV-block occupancy ---------------------
+        target_tok = self.policy.target_decode_tok_s_per_replica
+        # No throughput signal (no target, or first tick): hold the
+        # current hysteresis target rather than chasing pool size.
+        desired_d = self._decode.target
+        if target_tok and tok_rates:
+            total_tok_s = sum(tok_rates)
+            desired_d = _ceil_units(total_tok_s, float(target_tok))
+            reasons.append(f'decode {total_tok_s:.0f} tok/s')
+        if occupancies:
+            occ = max(occupancies)
+            if occ > self.policy.kv_occupancy_high:
+                # Memory-bound: imported prompts are queueing for
+                # blocks; throughput headroom is irrelevant.
+                desired_d = max(desired_d, len(decode) + 1)
+                reasons.append(f'kv occupancy {occ:.0%}')
+        desired_d = self._clamp_pool(desired_d, self.policy.decode_pool)
+
+        num_prefill = self._prefill.step(desired_p)
+        num_decode = self._decode.step(desired_d)
+        return AutoscalerDecision(
+            num_prefill + num_decode,
+            reason=('; '.join(reasons) or 'hold'),
+            num_prefill=num_prefill, num_decode=num_decode)
+
+
 def make_autoscaler(policy: ReplicaPolicy,
                     new_replica_weight: float = 1.0) -> Autoscaler:
+    if policy.disaggregated:
+        return DualPoolAutoscaler(policy)
     if policy.autoscaling and policy.target_qps_per_replica:
         if policy.base_ondemand_fallback_replicas > 0:
             return FallbackRequestRateAutoscaler(

@@ -96,7 +96,16 @@ class ServeController:
             endpoint=f'{advertise}:{self.lb.port}')
         self.lb.start_in_thread()
         self._expose_external_endpoint()
-        self.replica_manager.scale_to(self.spec.replica_policy.min_replicas)
+        policy = self.spec.replica_policy
+        if policy.disaggregated:
+            # Disaggregated serving: the fleet IS the two role pools
+            # (prefill replicas export KV, decode replicas import and
+            # stream; serve/disagg.py).
+            self.replica_manager.scale_pools(
+                policy.prefill_pool.min_replicas,
+                policy.decode_pool.min_replicas)
+        else:
+            self.replica_manager.scale_to(policy.min_replicas)
         became_ready = False
         try:
             while not self._stop.is_set():
@@ -143,7 +152,12 @@ class ServeController:
                 # the stale-endpoint window.
                 self.replica_manager.maybe_rolling_update(target)
                 ready = self.replica_manager.probe_all()
-                self.lb.set_replicas(ready)
+                # Role map rides along so the LB can pool prefill/decode
+                # replicas for KV-handoff routing (colocated when the
+                # service is not disaggregated — zero behavior change).
+                self.lb.set_replicas(ready, roles={
+                    r['endpoint']: r.get('role') or 'colocated'
+                    for r in replica_snapshot if r.get('endpoint')})
                 if hasattr(self.lb.policy, 'set_weights'):
                     # Instance-aware routing: endpoint -> capacity weight.
                     self.lb.policy.set_weights({
@@ -163,6 +177,11 @@ class ServeController:
                     if r['status'] in live_statuses)
                 if rolling:
                     pass  # version rollout owns replica churn this tick
+                elif decision.num_prefill is not None:
+                    # Role-pool targets (DualPoolAutoscaler): each pool
+                    # scales on its own phase's saturation signal.
+                    self.replica_manager.scale_pools(
+                        decision.num_prefill, decision.num_decode or 0)
                 elif decision.num_spot is not None:
                     # Mixed-pool target (fallback autoscaler): spot fleet
                     # plus the on-demand safety/gap pool.

@@ -59,6 +59,7 @@ import argparse
 import asyncio
 import collections
 import contextlib
+import functools
 import os
 import time
 from typing import Any, Deque, Dict, List, Optional
@@ -127,8 +128,8 @@ def _metrics():
                     SERVE_DECODE_RATE = _NoopMetric()
 
                 @staticmethod
-                def render_serving(engine=None, qos=None):
-                    del engine, qos
+                def render_serving(engine=None, qos=None, disagg=None):
+                    del engine, qos, disagg
                     return b'# prometheus_client not installed\n'
 
             _METRICS = _Shim()
@@ -154,6 +155,34 @@ class _ChunkRecorder:
         return _cb
 
 
+
+# Handoff payloads span ~100 KB (short prompts) to hundreds of MB (long
+# prompts on big models). The crc32/serialize/parse work is real CPU
+# time that must not stall in-flight streams on the event loop — but an
+# executor hop has fixed cost that loses on small payloads, so only
+# off-load past this size.
+_DISAGG_OFFLOAD_MIN_BYTES = int(os.environ.get(
+    'SKYTPU_DISAGG_OFFLOAD_MIN_BYTES', str(4 * 1024 * 1024)))
+
+
+async def _run_sized(nbytes: int, fn, *args, **kw):
+    """Run CPU-bound handoff work inline when small, in the default
+    executor when large (see _DISAGG_OFFLOAD_MIN_BYTES)."""
+    if nbytes < _DISAGG_OFFLOAD_MIN_BYTES:
+        return fn(*args, **kw)
+    return await asyncio.get_event_loop().run_in_executor(
+        None, functools.partial(fn, *args, **kw))
+
+
+def _handoff_nbytes(handoff) -> int:
+    """Rough plane-bytes size of an un-serialized handoff."""
+    total = 0
+    for arr in (handoff.k, handoff.v, handoff.k_s, handoff.v_s):
+        if arr is not None:
+            total += int(arr.nbytes)
+    return total
+
+
 class LlmServer:
 
     def __init__(self, model: str, max_len: int = 1024, seed: int = 0,
@@ -167,10 +196,21 @@ class LlmServer:
                  pipeline: Optional[str] = None,
                  qos: Optional[str] = None,
                  qos_opts: Optional[Dict[str, Any]] = None,
-                 prefix_share: Optional[str] = None):
+                 prefix_share: Optional[str] = None,
+                 role: Optional[str] = None):
         self.model_name = model
         self.cfg = llama.PRESETS[model]
         self.max_len = min(max_len, self.cfg.max_seq_len)
+        # Disaggregated serving role (serve/disagg.py): 'prefill'
+        # replicas are routed /v1/kv/export (compute prompt KV, hand
+        # off), 'decode' replicas /v1/kv/import (install + stream).
+        # Every role still serves /generate — the LB's colocated
+        # fallback must be able to land anywhere that survives.
+        self.role = role or os.environ.get('SKYTPU_LLM_ROLE',
+                                           'colocated')
+        if self.role not in ('colocated', 'prefill', 'decode'):
+            raise ValueError(f'Unknown role {self.role!r}; '
+                             "'colocated', 'prefill' or 'decode'")
         # Validate ALL the cheap knobs BEFORE weight init: on a real
         # slice the sharded init+quantize pass takes minutes, and a
         # typo'd flag or env var must not cost the operator that
@@ -343,7 +383,8 @@ class LlmServer:
                 pipeline=(None if self.pipeline is None
                           else self.pipeline == 'on'),
                 prefix_share=(None if self.prefix_share is None
-                              else self.prefix_share == 'on'))
+                              else self.prefix_share == 'on'),
+                role=self.role)
             self.params = self.engine.params
             if self.draft_params is not None:
                 self.draft_params = self.engine.draft_params
@@ -367,6 +408,18 @@ class LlmServer:
         self.draining = False
         self._inflight = 0
         self.max_batch_seen = 0
+        # KV-handoff plumbing (serve/disagg.py): parked exports await
+        # their fetch under a TTL; a configured staging dir enables the
+        # same-host zero-copy-over-HTTP path. Server-level byte/second
+        # accounting feeds /health and the skytpu_disagg_* gauges.
+        from skypilot_tpu.serve import disagg as disagg_lib
+        self._disagg_lib = disagg_lib
+        self._handoffs = disagg_lib.HandoffRegistry()
+        self.staging_dir = os.environ.get(disagg_lib.STAGING_ENV) or None
+        self.disagg_stats: Dict[str, Any] = {
+            'exports': 0, 'export_bytes': 0, 'export_seconds': 0.0,
+            'imports': 0, 'import_bytes': 0, 'import_seconds': 0.0,
+            'import_rejects': 0, 'fallbacks_served': 0}
 
     async def health(self, request: web.Request) -> web.Response:
         del request
@@ -382,7 +435,15 @@ class LlmServer:
                 'max_len': self.max_len,
                 'draft_model': self.draft_model,
                 'batches_served': self.batches_served,
-                'max_batch_seen': self.max_batch_seen}
+                'max_batch_seen': self.max_batch_seen,
+                # Disaggregated serving (serve/disagg.py): the pool
+                # role plus server-level handoff accounting — the
+                # controller mirrors these into the skytpu_disagg_*
+                # gauges and the dashboard pool column.
+                'role': self.role,
+                'disagg': {**self.disagg_stats,
+                           'parked': len(self._handoffs),
+                           'staging': bool(self.staging_dir)}}
         # Queue/backpressure snapshot: the controller reads depth_total
         # as the routing/scaling pressure signal (satellite: overflow
         # and queue depth surfaced in the health body).
@@ -707,6 +768,11 @@ class LlmServer:
         # committed — the exact loss drain exists to prevent. Admission
         # ends naturally once the LB's ready set refreshes.
         self._inflight += 1
+        if request.headers.get('X-SkyTPU-Disagg-Fallback'):
+            # The LB re-served this request whole after a handoff
+            # failure — count it so the fallback rate is observable
+            # (skytpu_disagg_fallback_total).
+            self.disagg_stats['fallbacks_served'] += 1
         try:
             tctx = trace_lib.start_trace('serve.generate',
                                          headers=request.headers)
@@ -1029,6 +1095,359 @@ class LlmServer:
                                   parent=stream_span)
         return resp
 
+    # -- KV handoff endpoints (disaggregated serving, serve/disagg.py) -----
+
+    def _parse_handoff_request(self, body):
+        """Shared request validation for /v1/kv/export: one row + the
+        generation ask that will ride the handoff. Returns (row,
+        max_new, temperature, top_k, top_p, eos) or raises ValueError
+        with a client-facing message."""
+        tokens = body.get('tokens')
+        if not tokens:
+            raise ValueError('tokens required')
+        if tokens and isinstance(tokens[0], list):
+            if len(tokens) != 1:
+                raise ValueError('KV handoff carries ONE prompt per '
+                                 'request (the handoff unit is a row)')
+            tokens = tokens[0]
+        row = [int(t) for t in tokens]
+        if not row:
+            raise ValueError('empty token rows not allowed')
+        max_new = int(body.get('max_new_tokens', 32))
+        if max_new < 1:
+            raise ValueError('max_new_tokens must be >= 1')
+        temperature = float(body.get('temperature', 0.0))
+        top_k = int(body.get('top_k', 0))
+        top_p = float(body.get('top_p', 1.0))
+        if top_k < 0 or not 0.0 < top_p <= 1.0:
+            raise ValueError('top_k must be >= 0 and top_p in (0, 1]')
+        eos = body.get('eos_token')
+        if eos is not None:
+            eos = frozenset([int(eos)] if isinstance(eos, int)
+                            else (int(t) for t in eos))
+        if len(row) + max_new > self.max_len:
+            raise ValueError(f'prompt+max_new_tokens exceeds max_len '
+                             f'{self.max_len}')
+        return row, max_new, temperature, top_k, top_p, eos
+
+    async def kv_export(self, request: web.Request) -> web.Response:
+        """Prefill-role admission over HTTP: compute the prompt's KV,
+        sample the first token, and PARK the handoff — the response
+        carries the negotiation header (sizes, shareable chain) and a
+        claim id for /v1/kv/fetch, or a staging ref when the same-host
+        fast path is configured (payload already durable in the shared
+        dir, zero bytes over HTTP)."""
+        if self.engine is None:
+            return web.json_response(
+                {'error': 'KV export requires the continuous engine'},
+                status=400)
+        self._inflight += 1
+        tctx = trace_lib.start_trace('serve.kv_export',
+                                     headers=request.headers)
+        try:
+            with tctx if tctx else contextlib.nullcontext():
+                return await self._kv_export_inner(request)
+        finally:
+            self._inflight -= 1
+
+    async def _kv_export_inner(self,
+                               request: web.Request) -> web.Response:
+        disagg_lib = self._disagg_lib
+        try:
+            body = await request.json()
+            row, max_new, temperature, top_k, top_p, eos = \
+                self._parse_handoff_request(body)
+        except (ValueError, TypeError) as e:
+            return web.json_response({'error': str(e)}, status=400)
+        # QoS admission gates the EXPORT — on a disaggregated fleet the
+        # queue forms here, and skipping the gate would turn every
+        # handoff into a per-tenant quota bypass. The full generation
+        # budget is charged on this side (the decode pool does the
+        # emitting but never re-meters); early EOS overcharges, which
+        # is the conservative direction for a quota.
+        ticket = None
+        if self.qos is not None:
+            try:
+                qos_class = qos_lib.classify(body, request.headers)
+            except ValueError as e:
+                return web.json_response({'error': str(e)}, status=400)
+            if request.headers.get('Authorization',
+                                   '').startswith('Bearer '):
+                tenant = await asyncio.get_event_loop().run_in_executor(
+                    None, qos_lib.resolve_tenant, request.headers, body)
+            else:
+                tenant = qos_lib.resolve_tenant(request.headers, body)
+            try:
+                ticket = self.qos.submit(
+                    qos_class, tenant, cost=float(len(row)),
+                    est_tokens=float(len(row) * max_new))
+            except qos_lib.ShedError as e:
+                return self._shed_response(e, qos_class)
+            try:
+                await ticket.granted
+            except qos_lib.ShedError as e:
+                return self._shed_response(e, qos_class)
+            except qos_lib.QueueTimeout as e:
+                return web.json_response(
+                    {'error': str(e), 'qos_class': qos_class},
+                    status=504)
+            except asyncio.CancelledError:
+                self.qos.abandon(ticket)  # client gone while queued
+                raise
+        try:
+            resp = await self._kv_export_admitted(
+                disagg_lib, row, max_new, temperature, top_k, top_p,
+                eos)
+        except BaseException:  # incl. client-disconnect cancellation
+            if ticket is not None:
+                self.qos.abandon(ticket)  # no in-flight slot leaks
+            raise
+        if ticket is not None:
+            # Success charges the full budget; any refusal refunds it
+            # whole — the work was not done.
+            self.qos.release(ticket, generated_tokens=(
+                max_new if resp.status == 200 else 0))
+        return resp
+
+    async def _kv_export_admitted(self, disagg_lib, row, max_new,
+                                  temperature, top_k, top_p,
+                                  eos) -> web.Response:
+        t0 = time.time()
+        try:
+            fut = self.engine.submit_prefill(
+                row, max_new, temperature, top_k=top_k, top_p=top_p,
+                eos=eos)
+        except ValueError as e:  # MoE/spec/footprint refusals
+            return web.json_response({'error': str(e)}, status=400)
+        try:
+            handoff = await asyncio.wrap_future(fut)
+        except Exception as e:  # noqa: BLE001 — engine-side failure
+            return web.json_response(
+                {'error': f'prefill export failed: {e}'}, status=500)
+        header = await _run_sized(
+            _handoff_nbytes(handoff), disagg_lib.build_header, handoff,
+            model=self.model_name, kv_cache=self.kv_cache)
+        nbytes = disagg_lib.payload_nbytes(header)
+        resp = {'layout': handoff.layout, 'nbytes': nbytes,
+                'prompt_len': handoff.prompt_len,
+                'full_blocks': handoff.full_blocks,
+                'block': handoff.block}
+        if self.staging_dir:
+            # Same-host fast path: payload written once into the shared
+            # dir; the decode replica reads it directly (off-loop: the
+            # fsync'd write must not stall in-flight streams).
+            ref, nbytes = await asyncio.get_event_loop().run_in_executor(
+                None, disagg_lib.write_staging, self.staging_dir,
+                handoff, header)
+            resp['staging_ref'] = ref
+            resp['nbytes'] = nbytes
+        else:
+            resp['handoff'] = self._handoffs.put(handoff)
+        dt = time.time() - t0
+        st = self.disagg_stats
+        st['exports'] += 1
+        st['export_bytes'] += nbytes
+        st['export_seconds'] += dt
+        trace_lib.add_span('serve.prefill', t0, time.time(),
+                           tokens=len(row))
+        trace_lib.set_attr(nbytes=nbytes, prompt_len=len(row),
+                           staged=bool(self.staging_dir))
+        return web.json_response(resp)
+
+    async def kv_fetch(self, request: web.Request) -> web.Response:
+        """Claim a parked export's bytes. ``?skip_blocks=N`` (from the
+        decode side's /v1/kv/prepare answer) drops the first N full
+        blocks' plane records — they transfer as trie references.
+        One-shot: the handoff is consumed whether serialization
+        succeeds or not (the LB retries by re-exporting)."""
+        hid = request.query.get('handoff', '')
+        handoff = self._handoffs.pop(hid)
+        if handoff is None:
+            return web.json_response(
+                {'error': f'unknown or expired handoff {hid!r}'},
+                status=404)
+        try:
+            skip = int(request.query.get('skip_blocks', 0))
+            header = await _run_sized(
+                _handoff_nbytes(handoff), self._disagg_lib.build_header,
+                handoff, model=self.model_name, kv_cache=self.kv_cache,
+                skip_blocks=skip)
+        except ValueError as e:
+            return web.json_response({'error': str(e)}, status=400)
+        payload = await _run_sized(
+            _handoff_nbytes(handoff), self._disagg_lib.serialize_bytes,
+            handoff, header)
+        return web.Response(body=payload,
+                            content_type='application/octet-stream')
+
+    async def kv_prepare(self, request: web.Request) -> web.Response:
+        """Handoff negotiation: how many leading FULL prompt blocks this
+        replica already holds in its share trie — the prefix the
+        transfer can skip."""
+        if self.engine is None or not hasattr(self.engine, 'probe_chain'):
+            return web.json_response({'skip_blocks': 0})
+        try:
+            body = await request.json()
+            tokens = body.get('tokens') or []
+            if tokens and isinstance(tokens[0], list):
+                tokens = tokens[0]
+            row = [int(t) for t in tokens]
+        except (ValueError, TypeError):
+            return web.json_response({'error': 'tokens must be ints'},
+                                     status=400)
+        return web.json_response(
+            {'skip_blocks': self.engine.probe_chain(row)})
+
+    async def kv_import(self, request: web.Request) -> web.Response:
+        """Decode-role admission over HTTP: validate the payload
+        (checksums first — corrupt bytes never reach the device),
+        install it, and serve the generation. Buffered by default;
+        ``?stream=1`` streams NDJSON exactly like /generate. Error
+        contract the LB's fallback depends on: 400 = unusable bytes,
+        409 = well-formed but not installable here, both mean
+        're-serve colocated'."""
+        if self.engine is None \
+                or not hasattr(self.engine, 'submit_import'):
+            return web.json_response(
+                {'error': 'KV import requires the continuous engine'},
+                status=400)
+        self._inflight += 1
+        tctx = trace_lib.start_trace('serve.kv_import',
+                                     headers=request.headers)
+        try:
+            with tctx if tctx else contextlib.nullcontext():
+                return await self._kv_import_inner(request)
+        finally:
+            self._inflight -= 1
+
+    async def _kv_import_inner(self,
+                               request: web.Request) -> web.Response:
+        disagg_lib = self._disagg_lib
+        t0 = time.time()
+        try:
+            if request.content_type == 'application/json':
+                # Same-host fast path: the body is a staging REF, the
+                # bytes are read from the shared dir.
+                body = await request.json()
+                data = await asyncio.get_event_loop().run_in_executor(
+                    None, disagg_lib.read_staging, self.staging_dir,
+                    str(body.get('staging_ref') or ''))
+            else:
+                data = await request.read()
+            header, arrays = await _run_sized(
+                len(data), disagg_lib.parse, data)
+            disagg_lib.check_compat(
+                header, model=self.model_name, kv_cache=self.kv_cache,
+                kv_layout=self.kv_layout,
+                kv_block=getattr(self.engine, 'kv_block', 0),
+                max_len=self.max_len)
+            # Inside the try: a header whose JSON parses but whose
+            # request-state fields are missing/garbage (crc32 covers
+            # plane bytes only) must 400, not 500.
+            kwargs = disagg_lib.import_kwargs(header, arrays)
+        except disagg_lib.DisaggCompatError as e:
+            self.disagg_stats['import_rejects'] += 1
+            return web.json_response({'error': str(e)}, status=409)
+        except (disagg_lib.DisaggError, ValueError, TypeError,
+                KeyError) as e:
+            self.disagg_stats['import_rejects'] += 1
+            return web.json_response({'error': str(e)}, status=400)
+        stream = request.query.get('stream') in ('1', 'true')
+        rec = _ChunkRecorder()
+        try:
+            if stream:
+                return await self._kv_import_stream(request, kwargs,
+                                                    data, rec, t0)
+            fut = self.engine.submit_import(on_tokens=rec.cb(0),
+                                            **kwargs)
+            tokens = await asyncio.wrap_future(fut)
+        except ValueError as e:
+            self.disagg_stats['import_rejects'] += 1
+            return web.json_response({'error': str(e)}, status=400)
+        except Exception as e:  # noqa: BLE001 — install failure: 409 so
+            # the LB re-serves colocated (KVImportError's contract).
+            self.disagg_stats['import_rejects'] += 1
+            return web.json_response(
+                {'error': f'import install failed: {e}'}, status=409)
+        self._note_import(len(data), t0, rec)
+        return web.json_response({'tokens': [list(tokens)]})
+
+    def _note_import(self, nbytes: int, t0: float,
+                     rec: _ChunkRecorder) -> None:
+        st = self.disagg_stats
+        st['imports'] += 1
+        st['import_bytes'] += nbytes
+        st['import_seconds'] += time.time() - t0
+        self._observe_serving(rec, 'standard', None)
+
+    async def _kv_import_stream(self, request: web.Request, kwargs,
+                                data: bytes, rec: _ChunkRecorder,
+                                t0: float) -> web.StreamResponse:
+        """NDJSON streaming for an imported request — same wire shape
+        as /generate?stream, so the LB pipes it straight through to the
+        client."""
+        import json as json_lib
+        loop = asyncio.get_event_loop()
+        q: asyncio.Queue = asyncio.Queue()
+
+        def cb(toks):
+            rec.events.append((time.time(), 0, len(toks)))
+            loop.call_soon_threadsafe(q.put_nowait, toks)
+
+        fut = asyncio.wrap_future(
+            self.engine.submit_import(on_tokens=cb, **kwargs))
+        # The first failure mode (evicted negotiated blocks) surfaces at
+        # admission — wait for either the first emission or the future,
+        # so a doomed import still gets its 409 instead of a broken
+        # stream.
+        first_get = asyncio.ensure_future(q.get())
+        await asyncio.wait({first_get, fut},
+                           return_when=asyncio.FIRST_COMPLETED)
+        if fut.done() and not first_get.done():
+            first_get.cancel()
+            try:
+                fut.result()
+            except Exception as e:  # noqa: BLE001
+                self.disagg_stats['import_rejects'] += 1
+                return web.json_response(
+                    {'error': f'import install failed: {e}'}, status=409)
+        resp = web.StreamResponse()
+        resp.content_type = 'application/x-ndjson'
+        await resp.prepare(request)
+        try:
+            if first_get.done():
+                await resp.write(json_lib.dumps(
+                    {'row': 0, 'tokens': first_get.result()}).encode()
+                    + b'\n')
+            else:
+                first_get.cancel()
+            while not fut.done() or not q.empty():
+                if fut.done() and q.empty():
+                    break
+                get_task = asyncio.ensure_future(q.get())
+                await asyncio.wait({get_task, fut},
+                                   return_when=asyncio.FIRST_COMPLETED)
+                if get_task.done():
+                    await resp.write(json_lib.dumps(
+                        {'row': 0, 'tokens': get_task.result()}).encode()
+                        + b'\n')
+                else:
+                    get_task.cancel()
+            await fut
+            await resp.write(json_lib.dumps({'done': True}).encode()
+                             + b'\n')
+            self._note_import(len(data), t0, rec)
+        except Exception as e:  # noqa: BLE001 — mid-stream: in-band
+            with contextlib.suppress(Exception):
+                await resp.write(json_lib.dumps(
+                    {'error': str(e)}).encode() + b'\n')
+        finally:
+            if not fut.done():
+                fut.cancel()
+            with contextlib.suppress(Exception):
+                await resp.write_eof()
+        return resp
+
     @staticmethod
     def _scrape_authorized(request: web.Request) -> bool:
         """Replica /metrics + /debug/traces honor the same optional
@@ -1054,7 +1473,8 @@ class LlmServer:
         except Exception:  # noqa: BLE001 — a stopping engine must not
             engine, qos_stats = None, None  # fail the whole scrape
         return web.Response(
-            body=_metrics().render_serving(engine=engine, qos=qos_stats),
+            body=_metrics().render_serving(engine=engine, qos=qos_stats,
+                                           disagg=self.disagg_stats),
             content_type='text/plain', charset='utf-8')
 
     async def debug_traces(self, request: web.Request) -> web.Response:
@@ -1074,6 +1494,11 @@ class LlmServer:
         app.router.add_get('/metrics', self.metrics)
         app.router.add_get('/debug/traces', self.debug_traces)
         app.router.add_post('/generate', self.generate)
+        # KV handoff (disaggregated prefill/decode, serve/disagg.py).
+        app.router.add_post('/v1/kv/export', self.kv_export)
+        app.router.add_get('/v1/kv/fetch', self.kv_fetch)
+        app.router.add_post('/v1/kv/prepare', self.kv_prepare)
+        app.router.add_post('/v1/kv/import', self.kv_import)
         return app
 
 
@@ -1143,6 +1568,16 @@ def build_parser() -> argparse.ArgumentParser:
                              'in flight so host bookkeeping overlaps '
                              'device compute (default on; off = serial '
                              'engine; also via SKYTPU_LLM_PIPELINE)')
+    parser.add_argument('--role', default=None,
+                        choices=('colocated', 'prefill', 'decode'),
+                        help='disaggregated-serving pool role (also via '
+                             'SKYTPU_LLM_ROLE): prefill replicas retire '
+                             'prompts at the first token and export the '
+                             'KV (/v1/kv/export), decode replicas '
+                             'import it and stream (/v1/kv/import); '
+                             'every role still serves /generate for '
+                             'the colocated fallback (default '
+                             'colocated)')
     parser.add_argument('--qos', default=None, choices=('on', 'off'),
                         help='QoS admission control: priority classes '
                              '(interactive/standard/batch), per-tenant '
@@ -1165,7 +1600,8 @@ def server_from_args(args) -> 'LlmServer':
                      kv_blocks=args.kv_blocks,
                      pipeline=args.pipeline,
                      qos=args.qos,
-                     prefix_share=args.prefix_share)
+                     prefix_share=args.prefix_share,
+                     role=args.role)
 
 
 def main() -> None:

@@ -3,13 +3,28 @@
 Reference analog: ``sky/serve/load_balancer.py`` ``SkyServeLoadBalancer
 :24`` — an async reverse proxy that forwards each request to a replica
 chosen by the policy and records request timestamps for the autoscaler.
+
+DISAGGREGATED PREFILL/DECODE (serve/disagg.py): when the controller
+reports both a prefill-role and a decode-role pool, eligible
+``/generate`` requests are ORCHESTRATED instead of proxied — prefill
+replica computes the prompt KV (``/v1/kv/export``), the decode replica
+is asked how much of the prefix it already holds (``/v1/kv/prepare``),
+the payload transfers (staging ref on the same-host fast path, chunked
+bytes otherwise) and the decode replica installs it and serves the
+stream (``/v1/kv/import``). ANY handoff failure — export refusal,
+expired handoff, corrupt payload, install rejection, a decode replica
+dying mid-stream — falls back to colocated serving on a surviving
+replica (re-serving the request whole, minus tokens already streamed),
+so the split is a perf optimization that can never lose a request.
 """
 from __future__ import annotations
 
 import asyncio
+import contextlib
+import json
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import aiohttp
 from aiohttp import web
@@ -17,30 +32,106 @@ from aiohttp import web
 from skypilot_tpu.serve.load_balancing_policies import (LoadBalancingPolicy,
                                                         make_policy)
 
+_HANDOFF_TIMEOUT_S = 300.0
+
+
+class _HandoffFailed(Exception):
+    """Any handoff-flow failure that should trigger colocated fallback."""
+
 
 class LoadBalancer:
 
     def __init__(self, port: int, policy: str = 'least_load'):
         self.port = port
+        self._policy_name = policy
         self.policy: LoadBalancingPolicy = make_policy(policy)
-        self.request_times: List[float] = []
+        # Role pools (disaggregated serving): endpoint -> role from the
+        # controller; the prefill/decode sub-policies select within
+        # their pool with the same policy class (in-flight balancing
+        # per pool).
+        self.roles: Dict[str, str] = {}
+        self._prefill_policy: LoadBalancingPolicy = make_policy(policy)
+        self._decode_policy: LoadBalancingPolicy = make_policy(policy)
+        # Request times are bucketed PER UPSTREAM REPLICA (satellite
+        # fix: one global list could not attribute latency/pressure to
+        # a pool, which dual-pool autoscaling needs).
+        self._times: Dict[str, List[float]] = {}
         self._times_lock = threading.Lock()
+        self.disagg_stats = {'handoffs': 0, 'fallbacks': 0,
+                             'resumed_streams': 0}
         self._runner: Optional[web.AppRunner] = None
         self._thread: Optional[threading.Thread] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
 
     # -- autoscaler API ----------------------------------------------------
 
-    def set_replicas(self, endpoints: List[str]) -> None:
-        self.policy.set_replicas(endpoints)
+    def set_replicas(self, endpoints: List[str],
+                     roles: Optional[Dict[str, str]] = None) -> None:
+        """``roles``: endpoint -> pool role from the controller's
+        replica snapshot (absent/None = all colocated, the
+        non-disaggregated default). The main routing pool excludes
+        prefill-role replicas — a long prefill must never stall plain
+        decode traffic, which is the whole point of the split — unless
+        prefill replicas are ALL that survives (fallback must keep
+        serving)."""
+        self.roles = dict(roles or {})
+        prefill = [e for e in endpoints
+                   if self.roles.get(e) == 'prefill']
+        decode = [e for e in endpoints if self.roles.get(e) == 'decode']
+        main = [e for e in endpoints
+                if self.roles.get(e, 'colocated') != 'prefill']
+        self.policy.set_replicas(main if main else list(endpoints))
+        self._prefill_policy.set_replicas(prefill)
+        self._decode_policy.set_replicas(decode)
+
+    def disagg_active(self) -> bool:
+        return bool(self._prefill_policy.replicas
+                    and self._decode_policy.replicas)
+
+    def _note_request(self, replica: str) -> None:
+        with self._times_lock:
+            self._times.setdefault(replica, []).append(time.time())
 
     def drain_request_times(self, window_seconds: float = 120.0) -> List[float]:
+        """All recent request times, flattened (rate-autoscaler input);
+        prunes the per-replica buckets to the window."""
+        out = []
+        for times in self.drain_request_times_by_replica(
+                window_seconds).values():
+            out.extend(times)
+        out.sort()
+        return out
+
+    def drain_request_times_by_replica(
+            self, window_seconds: float = 120.0
+    ) -> Dict[str, List[float]]:
+        """Recent request times bucketed per upstream replica — the
+        attribution dual-pool autoscaling and the fleet dashboard need
+        (which pool is hot, not just how hot the service is)."""
         cutoff = time.time() - window_seconds
         with self._times_lock:
-            self.request_times = [t for t in self.request_times if t > cutoff]
-            return list(self.request_times)
+            for ep in list(self._times):
+                kept = [t for t in self._times[ep] if t > cutoff]
+                if kept:
+                    self._times[ep] = kept
+                else:
+                    del self._times[ep]
+            return {ep: list(ts) for ep, ts in self._times.items()}
 
     # -- proxy -------------------------------------------------------------
+
+    @staticmethod
+    def _fwd_headers(request: web.Request) -> Dict[str, str]:
+        headers = {k: v for k, v in request.headers.items()
+                   if k.lower() not in ('host', 'content-length')}
+        # Serving-path traces begin at the LB: mint a trace id for
+        # clients that did not send one (clients that did keep theirs).
+        from skypilot_tpu.observability import trace as trace_lib
+        if trace_lib.TRACE_HEADER not in request.headers:
+            minted = trace_lib.mint_header()
+            if minted:
+                headers[trace_lib.TRACE_HEADER] = minted
+        return headers
 
     async def _proxy(self, request: web.Request) -> web.StreamResponse:
         if request.path.startswith('/debug/'):
@@ -50,32 +141,32 @@ class LoadBalancer:
             return web.json_response(
                 {'error': 'debug endpoints are not proxied; query the '
                           'replica directly'}, status=403)
+        if (request.method == 'POST' and request.path == '/generate'
+                and self.disagg_active()):
+            body = None
+            try:
+                body = json.loads(await request.read())
+            except ValueError:
+                pass
+            if self._disagg_eligible(body):
+                return await self._proxy_disagg(request, body)
+            if body is not None:
+                # Ineligible for handoff (batched rows, seeded): serve
+                # colocated without counting a fallback — nothing
+                # failed.
+                return await self._serve_colocated(
+                    request, body, fallback=False)
         replica = self.policy.select()
         if replica is None:
             return web.json_response(
                 {'error': 'No ready replicas.'}, status=503)
-        with self._times_lock:
-            self.request_times.append(time.time())
+        self._note_request(replica)
         url = f'http://{replica}{request.path_qs}'
         self.policy.on_request_start(replica)
         try:
             async with aiohttp.ClientSession() as session:
                 body = await request.read()
-                headers = {k: v for k, v in request.headers.items()
-                           if k.lower() not in ('host',)}
-                # Serving-path traces begin at the LB: mint a trace id
-                # for clients that did not send one (clients that did
-                # keep theirs — the header forwards untouched), so every
-                # request is correlatable in the replica's /debug/traces
-                # via the X-Served-By replica this response names. The
-                # presence check runs on the CIMultiDict (client header
-                # casing is arbitrary); mint_header() rolls the LB's
-                # own sampling knobs.
-                from skypilot_tpu.observability import trace as trace_lib
-                if trace_lib.TRACE_HEADER not in request.headers:
-                    minted = trace_lib.mint_header()
-                    if minted:
-                        headers[trace_lib.TRACE_HEADER] = minted
+                headers = self._fwd_headers(request)
                 async with session.request(
                         request.method, url, data=body, headers=headers,
                         timeout=aiohttp.ClientTimeout(total=300)) as resp:
@@ -89,6 +180,302 @@ class LoadBalancer:
                             resp.headers['Content-Type']
                     return web.Response(status=resp.status, body=payload,
                                         headers=out_headers)
+        except aiohttp.ClientError as e:
+            return web.json_response(
+                {'error': f'replica {replica} failed: {e}'}, status=502)
+        finally:
+            self.policy.on_request_end(replica)
+
+    # -- disaggregated prefill/decode orchestration ------------------------
+
+    @staticmethod
+    def _disagg_eligible(body) -> bool:
+        """Single-row, unseeded /generate requests ride the handoff;
+        everything else serves colocated (batched rows would need N
+        handoffs; seeded sampling rides the window path, which has no
+        export). Streamed SAMPLED requests are also excluded: the
+        mid-stream resume splices the retry by token count, which is
+        only sound when decode is deterministic — a greedy retry
+        reproduces the delivered prefix, a sampled one would stitch
+        two unrelated trajectories."""
+        if not isinstance(body, dict):
+            return False
+        tokens = body.get('tokens')
+        if not tokens or not isinstance(tokens, list):
+            return False
+        if isinstance(tokens[0], list) and len(tokens) != 1:
+            return False
+        temperature = float(body.get('temperature') or 0.0)
+        if body.get('seed') is not None and temperature > 0:
+            return False
+        if body.get('stream') and temperature > 0:
+            return False
+        return True
+
+    async def _proxy_disagg(self, request: web.Request,
+                            body: dict) -> web.StreamResponse:
+        stream = bool(body.get('stream'))
+        prefill = self._prefill_policy.select()
+        decode = self._decode_policy.select()
+        if prefill is None or decode is None:
+            return await self._serve_colocated(request, body)
+        headers = self._fwd_headers(request)
+        self._note_request(decode)
+        self._prefill_policy.on_request_start(prefill)
+        self._decode_policy.on_request_start(decode)
+        prefill_busy = True
+        timeout = aiohttp.ClientTimeout(total=_HANDOFF_TIMEOUT_S)
+        try:
+            async with aiohttp.ClientSession() as session:
+                try:
+                    import_kwargs, mode = await self._handoff(
+                        session, prefill, decode, body, headers, timeout)
+                    # The prefill replica's work ended with the
+                    # export/fetch round-trip — release its in-flight
+                    # count NOW, not minutes later when the decode
+                    # stream drains, or least_load routes new exports
+                    # away from idle prefill replicas.
+                    self._prefill_policy.on_request_end(prefill)
+                    prefill_busy = False
+                    url = (f'http://{decode}/v1/kv/import'
+                           + ('?stream=1' if stream else ''))
+                    if not stream:
+                        async with session.post(url, timeout=timeout,
+                                                **import_kwargs) as r:
+                            payload = await r.read()
+                            if r.status != 200:
+                                raise _HandoffFailed(
+                                    f'import {r.status}: '
+                                    f'{payload[:200]!r}')
+                        self.disagg_stats['handoffs'] += 1
+                        return web.Response(
+                            status=200, body=payload,
+                            headers={'X-Served-By': decode,
+                                     'X-SkyTPU-Disagg': mode,
+                                     'Content-Type': 'application/json'})
+                except (aiohttp.ClientError, asyncio.TimeoutError,
+                        _HandoffFailed, KeyError, ValueError):
+                    return await self._serve_colocated(request, body)
+                # Streaming: the client response must not be prepared
+                # until the import is known good — everything above
+                # fell back whole; from here, mid-stream failures
+                # RESUME on a surviving replica.
+                return await self._pipe_stream(request, session, url,
+                                               import_kwargs, decode,
+                                               mode, body, headers,
+                                               timeout)
+        finally:
+            if prefill_busy:
+                self._prefill_policy.on_request_end(prefill)
+            self._decode_policy.on_request_end(decode)
+
+    async def _handoff(self, session, prefill: str, decode: str,
+                       body: dict, headers, timeout):
+        """Export on the prefill replica and build the import request:
+        (import_kwargs, mode) where mode is 'staged' (same-host ref) or
+        'remote' (bytes). Raises _HandoffFailed on any refusal."""
+        export_req = {k: body[k] for k in
+                      ('tokens', 'max_new_tokens', 'temperature',
+                       'top_k', 'top_p', 'eos_token',
+                       # QoS class/tenant declared in the body must
+                       # survive the handoff — the export side runs
+                       # the admission gate (header forms forward via
+                       # _fwd_headers already).
+                       'priority', 'tenant') if k in body}
+        async with session.post(f'http://{prefill}/v1/kv/export',
+                                json=export_req, headers=headers,
+                                timeout=timeout) as r:
+            if r.status != 200:
+                raise _HandoffFailed(
+                    f'export {r.status}: {(await r.text())[:200]}')
+            exp = await r.json()
+        ref = exp.get('staging_ref')
+        if ref:
+            return dict(json={'staging_ref': ref},
+                        headers=headers), 'staged'
+        # Prefix negotiation (best-effort: a decode replica without a
+        # share trie answers 0; an unreachable one will fail the import
+        # anyway).
+        skip = 0
+        if exp.get('full_blocks'):
+            try:
+                async with session.post(
+                        f'http://{decode}/v1/kv/prepare',
+                        json={'tokens': export_req['tokens']},
+                        timeout=timeout) as r:
+                    if r.status == 200:
+                        skip = min(
+                            int((await r.json()).get('skip_blocks')
+                                or 0),
+                            int(exp['full_blocks']))
+            except (aiohttp.ClientError, asyncio.TimeoutError,
+                    ValueError):
+                skip = 0
+        async with session.get(
+                f'http://{prefill}/v1/kv/fetch',
+                params={'handoff': exp['handoff'],
+                        'skip_blocks': str(skip)},
+                timeout=timeout) as r:
+            if r.status != 200:
+                raise _HandoffFailed(
+                    f'fetch {r.status}: {(await r.text())[:200]}')
+            payload = await r.read()
+        hdrs = dict(headers)
+        hdrs['Content-Type'] = 'application/octet-stream'
+        return dict(data=payload, headers=hdrs), 'remote'
+
+    async def _pipe_stream(self, request, session, url, import_kwargs,
+                           decode: str, mode: str, body: dict, headers,
+                           timeout) -> web.StreamResponse:
+        """Pipe the decode replica's NDJSON stream to the client,
+        counting forwarded tokens; if the replica dies mid-stream,
+        RESUME the request on a surviving replica — greedy decode is
+        deterministic, so the retry's first ``sent`` tokens are the
+        ones already delivered and are skipped."""
+        resp = web.StreamResponse(
+            headers={'X-Served-By': decode, 'X-SkyTPU-Disagg': mode})
+        resp.content_type = 'application/x-ndjson'
+        sent = 0
+        prepared = False
+        try:
+            async with session.post(url, timeout=timeout,
+                                    **import_kwargs) as r:
+                if r.status != 200:
+                    raise _HandoffFailed(
+                        f'import {r.status}: '
+                        f'{(await r.read())[:200]!r}')
+                async for line in r.content:
+                    if not line.strip():
+                        continue
+                    obj = json.loads(line)
+                    if 'error' in obj:
+                        raise _HandoffFailed(obj['error'])
+                    if not prepared:
+                        await resp.prepare(request)
+                        prepared = True
+                    await resp.write(line)
+                    if obj.get('done'):
+                        self.disagg_stats['handoffs'] += 1
+                        await resp.write_eof()
+                        return resp
+                    sent += len(obj.get('tokens') or [])
+                raise _HandoffFailed('stream ended without done marker')
+        except (aiohttp.ClientError, asyncio.TimeoutError,
+                _HandoffFailed, ValueError):
+            if not prepared:
+                # Nothing reached the client yet: fall back whole.
+                return await self._serve_colocated(request, body)
+            await self._resume_stream(request, resp, body, headers,
+                                      sent, exclude=decode)
+            with contextlib.suppress(Exception):
+                await resp.write_eof()
+            return resp
+
+    async def _resume_stream(self, request, resp: web.StreamResponse,
+                             body: dict, headers, sent: int,
+                             exclude: str) -> None:
+        """Re-serve the request whole on a surviving replica and
+        forward only the tokens past ``sent`` — the mid-stream
+        colocated fallback."""
+        self.disagg_stats['fallbacks'] += 1
+        self.disagg_stats['resumed_streams'] += 1
+        replica = self._select_fallback(exclude)
+        if replica is None:
+            with contextlib.suppress(Exception):
+                await resp.write(json.dumps(
+                    {'error': 'decode replica died; no surviving '
+                              'replica to resume on'}).encode() + b'\n')
+            return
+        retry = dict(body)
+        retry['stream'] = True
+        hdrs = dict(headers)
+        hdrs['X-SkyTPU-Disagg-Fallback'] = '1'
+        self._note_request(replica)
+        self.policy.on_request_start(replica)
+        skipped = 0
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                        f'http://{replica}/generate', json=retry,
+                        headers=hdrs,
+                        timeout=aiohttp.ClientTimeout(
+                            total=_HANDOFF_TIMEOUT_S)) as r:
+                    if r.status != 200:
+                        raise _HandoffFailed(f'resume {r.status}')
+                    async for line in r.content:
+                        if not line.strip():
+                            continue
+                        obj = json.loads(line)
+                        if 'error' in obj:
+                            raise _HandoffFailed(obj['error'])
+                        if obj.get('done'):
+                            await resp.write(line)
+                            return
+                        toks = obj.get('tokens') or []
+                        if skipped < sent:
+                            drop = min(len(toks), sent - skipped)
+                            skipped += drop
+                            toks = toks[drop:]
+                        if toks:
+                            await resp.write(json.dumps(
+                                {'row': obj.get('row', 0),
+                                 'tokens': toks}).encode() + b'\n')
+        except (aiohttp.ClientError, asyncio.TimeoutError,
+                _HandoffFailed, ValueError) as e:
+            with contextlib.suppress(Exception):
+                await resp.write(json.dumps(
+                    {'error': f'resume failed: {e}'}).encode() + b'\n')
+        finally:
+            self.policy.on_request_end(replica)
+
+    def _select_fallback(self, exclude: str) -> Optional[str]:
+        replica = self.policy.select()
+        if replica == exclude:
+            others = [r for r in self.policy.replicas if r != exclude]
+            replica = others[0] if others else replica
+        return replica
+
+    async def _serve_colocated(self, request: web.Request, body: dict,
+                               fallback: bool = True
+                               ) -> web.StreamResponse:
+        """Serve a /generate whole on the main (non-prefill) pool — the
+        colocated fallback for failed handoffs and the plain path for
+        handoff-ineligible requests."""
+        replica = self.policy.select()
+        if replica is None:
+            return web.json_response(
+                {'error': 'No ready replicas.'}, status=503)
+        headers = self._fwd_headers(request)
+        if fallback:
+            self.disagg_stats['fallbacks'] += 1
+            headers['X-SkyTPU-Disagg-Fallback'] = '1'
+        self._note_request(replica)
+        self.policy.on_request_start(replica)
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                        f'http://{replica}/generate', json=body,
+                        headers=headers,
+                        timeout=aiohttp.ClientTimeout(total=300)) as r:
+                    if not bool(body.get('stream')):
+                        payload = await r.read()
+                        out_headers = {'X-Served-By': replica}
+                        if 'Content-Type' in r.headers:
+                            out_headers['Content-Type'] = \
+                                r.headers['Content-Type']
+                        return web.Response(status=r.status,
+                                            body=payload,
+                                            headers=out_headers)
+                    resp = web.StreamResponse(
+                        status=r.status,
+                        headers={'X-Served-By': replica})
+                    resp.content_type = (r.headers.get('Content-Type')
+                                         or 'application/x-ndjson')
+                    await resp.prepare(request)
+                    async for chunk in r.content.iter_any():
+                        await resp.write(chunk)
+                    await resp.write_eof()
+                    return resp
         except aiohttp.ClientError as e:
             return web.json_response(
                 {'error': f'replica {replica} failed: {e}'}, status=502)
@@ -135,3 +522,4 @@ class LoadBalancer:
         asyncio.run_coroutine_threadsafe(shutdown(), loop)
         if self._thread is not None:
             self._thread.join(timeout=5)
+

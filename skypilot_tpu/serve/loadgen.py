@@ -71,10 +71,12 @@ def shared_prefix_tokens(tenant_idx: int, length: int,
 
 async def _one(session, url: str, prompt_span, max_new_span,
                vocab: int, seed: int, stream: bool = False,
-               priority=None, tenant=None, prefix_tokens=None):
+               priority=None, tenant=None, prefix_tokens=None,
+               force_prompt_len=None):
     from skypilot_tpu.observability import trace as trace_lib
     rng = random.Random(seed)
-    prompt_len = rng.randint(*prompt_span)
+    prompt_len = (int(force_prompt_len) if force_prompt_len
+                  else rng.randint(*prompt_span))
     max_new = rng.randint(*max_new_span)
     tokens = [rng.randrange(1, vocab) for _ in range(prompt_len)]
     if prefix_tokens:
@@ -145,7 +147,9 @@ async def run_load(url: str, requests_total: int, concurrency: int,
                    prompt_len, max_new, vocab: int,
                    stream: bool = False, mix=None, tenants: int = 1,
                    shared_prefix: float = 0.0,
-                   shared_prefix_len: int = 32) -> dict:
+                   shared_prefix_len: int = 32,
+                   long_prompt_frac: float = 0.0,
+                   long_prompt_len: int = 512) -> dict:
     import aiohttp
     prompt_span, max_new_span = _span(prompt_len), _span(max_new)
     sem = asyncio.Semaphore(concurrency)
@@ -166,8 +170,24 @@ async def run_load(url: str, requests_total: int, concurrency: int,
         shared_flags = [p == 'shared' for p in picks]
         prefixes = [shared_prefix_tokens(t, shared_prefix_len, vocab)
                     for t in range(max(tenants, 1))]
+    # --long-prompt-frac FRAC: that fraction of requests (deterministic
+    # weighted round-robin) carries a LONG prompt of --long-prompt-len
+    # tokens — the prefill-heavy mixed load that exposes the
+    # prefill/decode imbalance disaggregated serving splits away (short
+    # requests' TTFT stalls behind long prefills on a colocated
+    # replica; on a split fleet the pools isolate them).
+    if not 0.0 <= long_prompt_frac <= 1.0:
+        raise ValueError(f'--long-prompt-frac must be in [0, 1], '
+                         f'got {long_prompt_frac}')
+    long_flags = None
+    if long_prompt_frac > 0:
+        picks = mix_classes(
+            f'long:{long_prompt_frac},short:{1.0 - long_prompt_frac}',
+            requests_total)
+        long_flags = [p == 'long' for p in picks]
     results = []
     shared_of = []  # per-result shared/unique tag, parallel to results
+    long_of = []    # per-result long/short tag, parallel to results
 
     async with aiohttp.ClientSession() as session:
         async def _bounded(i):
@@ -177,12 +197,16 @@ async def run_load(url: str, requests_total: int, concurrency: int,
                 prefix = None
                 if shared_flags is not None and shared_flags[i]:
                     prefix = prefixes[i % max(tenants, 1)]
+                is_long = bool(long_flags and long_flags[i])
                 r = await _one(
                     session, url, prompt_span, max_new_span, vocab,
                     seed=i, stream=stream, priority=cls, tenant=tenant,
-                    prefix_tokens=prefix)
+                    prefix_tokens=prefix,
+                    force_prompt_len=(long_prompt_len if is_long
+                                      else None))
                 results.append((cls, r))
                 shared_of.append((prefix is not None, r))
+                long_of.append((is_long, r))
 
         t0 = time.perf_counter()
         await asyncio.gather(*(_bounded(i) for i in range(requests_total)))
@@ -246,6 +270,32 @@ async def run_load(url: str, requests_total: int, concurrency: int,
             'shared': _grp(True),
             'unique': _grp(False),
             'engine': engine_share,
+        }
+    if long_flags is not None:
+        # Per-pool TTFT breakdown: long requests land prefill-bound (the
+        # prefill pool's work), short ones are decode-interactive — the
+        # short sub-mix's TTFT under concurrent long prefills is the
+        # number disaggregated serving is supposed to protect.
+        def _lgrp(flag):
+            rs = [r for f, r in long_of if f == flag]
+            oks_g = [r for r in rs if r[0]]
+            entry = {
+                'requests': len(rs),
+                'ok': len(oks_g),
+                'p50_latency_s': _pctile(sorted(r[2] for r in oks_g), 50),
+                'p95_latency_s': _pctile(sorted(r[2] for r in oks_g), 95),
+            }
+            if stream:
+                tt = sorted(r[3] for r in oks_g if r[3] is not None)
+                entry['p50_ttft_s'] = _pctile(tt, 50)
+                entry['p95_ttft_s'] = _pctile(tt, 95)
+            return entry
+
+        extra['long_prompt'] = {
+            'frac': long_prompt_frac,
+            'long_prompt_len': long_prompt_len,
+            'long': _lgrp(True),
+            'short': _lgrp(False),
         }
     if classes:
         # Per-class breakdown (QoS workloads): latency/TTFT percentiles
@@ -336,6 +386,18 @@ def main() -> None:
     parser.add_argument('--shared-prefix-len', type=int, default=32,
                         help='shared head length in tokens (per '
                              'tenant; default 32)')
+    parser.add_argument('--long-prompt-frac', type=float, default=0.0,
+                        help='fraction of requests (deterministic '
+                             'round-robin) carrying a LONG prompt of '
+                             '--long-prompt-len tokens — the '
+                             'prefill-heavy mixed load that '
+                             'demonstrates disaggregated '
+                             'prefill/decode; reports long vs short '
+                             'TTFT/latency percentiles')
+    parser.add_argument('--long-prompt-len', type=int, default=512,
+                        help='prompt length for the long sub-mix '
+                             '(default 512; keep < server max_len '
+                             'minus max_new)')
     args = parser.parse_args()
     out = asyncio.run(run_load(args.url.rstrip('/'), args.requests,
                                args.concurrency, args.prompt_len,
@@ -343,7 +405,9 @@ def main() -> None:
                                stream=args.stream, mix=args.mix,
                                tenants=args.tenants,
                                shared_prefix=args.shared_prefix,
-                               shared_prefix_len=args.shared_prefix_len))
+                               shared_prefix_len=args.shared_prefix_len,
+                               long_prompt_frac=args.long_prompt_frac,
+                               long_prompt_len=args.long_prompt_len))
     print(json.dumps(out))
 
 

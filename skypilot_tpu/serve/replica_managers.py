@@ -79,16 +79,22 @@ class ReplicaManager:
             return 1.0
         return chips / self._base_chips()
 
-    def launch_replica(self, use_spot: Optional[bool] = None) -> int:
+    def launch_replica(self, use_spot: Optional[bool] = None,
+                       role: Optional[str] = None) -> int:
         """``use_spot`` overrides the task's spot preference (the fallback
-        autoscaler launches its on-demand safety pool this way)."""
+        autoscaler launches its on-demand safety pool this way).
+        ``role`` launches the replica into a disaggregated-serving pool
+        (prefill | decode): SKYTPU_LLM_ROLE is injected so the replica
+        process comes up role-aware, and the role is recorded so the
+        LB/autoscaler can pool it."""
         replica_id = self._next_replica_id
         self._next_replica_id += 1
         cluster = self._cluster_name(replica_id)
         serve_state.upsert_replica(self.service_name, replica_id,
                                    serve_state.ReplicaStatus.PROVISIONING,
                                    cluster_name=cluster,
-                                   version=self.version)
+                                   version=self.version,
+                                   role=role)
         task = Task.from_yaml_config(self.task.to_yaml_config())
         if use_spot is None and self.spot_placer is not None:
             # Spot with dynamic on-demand fallback under preemption pressure.
@@ -101,6 +107,8 @@ class ReplicaManager:
         port = (common_utils.find_free_port(20000 + replica_id * 17)
                 if is_local else self.spec.port)
         task.update_envs({'SKYTPU_REPLICA_PORT': str(port)})
+        if role is not None:
+            task.update_envs({'SKYTPU_LLM_ROLE': role})
         try:
             execution.launch(task, cluster_name=cluster, detach_run=True)
         except exceptions.SkyTpuError as e:
@@ -128,7 +136,8 @@ class ReplicaManager:
             endpoint=f'{ip}:{port}',
             use_spot=bool(use_spot) if use_spot is not None else any(
                 r.use_spot for r in task.resources_ordered),
-            weight=self._replica_weight(cluster))
+            weight=self._replica_weight(cluster),
+            role=role)
         return replica_id
 
     # -- scale down / replace ---------------------------------------------
@@ -233,7 +242,13 @@ class ReplicaManager:
                         # A READY replica going dark is preemption-shaped.
                         self.spot_placer.report_preemption()
                     self.terminate_replica(rid, failed=True)
-                    self.launch_replica()
+                    # The replacement joins the SAME pool: a dead
+                    # prefill replica replaced by a colocated one would
+                    # silently un-disaggregate the service.
+                    role = rep.get('role')
+                    self.launch_replica(
+                        role=role if role in ('prefill', 'decode')
+                        else None)
         return ready
 
     # -- rolling update -----------------------------------------------------
@@ -323,6 +338,33 @@ class ReplicaManager:
             have = pools[spot]
             for _ in range(target - len(have)):
                 self.launch_replica(use_spot=spot)
+            if len(have) > target:
+                order = sorted(have, key=lambda r: (
+                    r['status'] == serve_state.ReplicaStatus.READY,
+                    r['replica_id']))
+                for rep in order[:len(have) - target]:
+                    self.terminate_replica(rep['replica_id'])
+
+    def scale_pools(self, num_prefill: int, num_decode: int) -> None:
+        """Per-role-pool scaling for disaggregated serving: hold
+        ``num_prefill`` prefill-role and ``num_decode`` decode-role
+        replicas alive, launching and retiring within each pool
+        independently (the scale_mixed analog keyed by role instead of
+        spot-ness)."""
+        alive_statuses = {serve_state.ReplicaStatus.PROVISIONING,
+                          serve_state.ReplicaStatus.STARTING,
+                          serve_state.ReplicaStatus.READY,
+                          serve_state.ReplicaStatus.NOT_READY}
+        pools: dict = {'prefill': [], 'decode': []}
+        for r in serve_state.list_replicas(self.service_name):
+            if r['status'] in alive_statuses \
+                    and r.get('role') in pools:
+                pools[r['role']].append(r)
+        for role, target in (('prefill', num_prefill),
+                             ('decode', num_decode)):
+            have = pools[role]
+            for _ in range(target - len(have)):
+                self.launch_replica(role=role)
             if len(have) > target:
                 order = sorted(have, key=lambda r: (
                     r['status'] == serve_state.ReplicaStatus.READY,

@@ -54,6 +54,7 @@ CREATE TABLE IF NOT EXISTS replicas (
     use_spot INTEGER DEFAULT 0,
     weight REAL DEFAULT 1.0,
     health TEXT,
+    role TEXT DEFAULT 'colocated',
     PRIMARY KEY (service_name, replica_id)
 );
 """
@@ -81,7 +82,9 @@ def _conn() -> sqlite3.Connection:
                 'ALTER TABLE services ADD COLUMN controller_claim_at REAL',
                 'ALTER TABLE replicas ADD COLUMN use_spot INTEGER DEFAULT 0',
                 'ALTER TABLE replicas ADD COLUMN weight REAL DEFAULT 1.0',
-                'ALTER TABLE replicas ADD COLUMN health TEXT'):
+                'ALTER TABLE replicas ADD COLUMN health TEXT',
+                "ALTER TABLE replicas ADD COLUMN role TEXT "
+                "DEFAULT 'colocated'"):
         try:
             conn.execute(ddl)
         except sqlite3.OperationalError:
@@ -250,13 +253,17 @@ def upsert_replica(service_name: str, replica_id: int,
                    version: Optional[int] = None,
                    use_spot: Optional[bool] = None,
                    weight: Optional[float] = None,
-                   health: Optional[str] = None) -> None:
+                   health: Optional[str] = None,
+                   role: Optional[str] = None) -> None:
     """``use_spot``/``weight`` feed the instance-aware/fallback
     autoscalers: weight is the replica's relative serving capacity (e.g.
     chips vs the smallest replica), spot-ness drives on-demand fallback.
     ``health`` is the replica's last readiness-probe response body (JSON
     text) — the in-framework LLM replica reports engine stats there,
-    which `serve status`/the dashboard surface per replica."""
+    which `serve status`/the dashboard surface per replica. ``role`` is
+    the disaggregated-serving pool (colocated | prefill | decode) the
+    replica was launched into — the LB routes and the
+    DualPoolAutoscaler scales by it."""
     with _lock(), _conn() as conn:
         existing = conn.execute(
             'SELECT replica_id FROM replicas WHERE service_name = ? AND '
@@ -265,11 +272,13 @@ def upsert_replica(service_name: str, replica_id: int,
             conn.execute(
                 'INSERT INTO replicas (service_name, replica_id, status, '
                 'cluster_name, endpoint, created_at, version, use_spot, '
-                'weight, health) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)',
+                'weight, health, role) '
+                'VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)',
                 (service_name, replica_id, status.value, cluster_name,
                  endpoint, time.time(), version or 1,
                  int(bool(use_spot)),
-                 weight if weight is not None else 1.0, health or None))
+                 weight if weight is not None else 1.0, health or None,
+                 role or 'colocated'))
         else:
             sets, args = ['status = ?'], [status.value]
             if cluster_name is not None:
@@ -292,6 +301,9 @@ def upsert_replica(service_name: str, replica_id: int,
                 # showing its last READY-era stats as current).
                 sets.append('health = ?')
                 args.append(health or None)
+            if role is not None:
+                sets.append('role = ?')
+                args.append(role)
             args += [service_name, replica_id]
             conn.execute(
                 f'UPDATE replicas SET {", ".join(sets)} WHERE '

@@ -39,6 +39,29 @@ class ReadinessProbe:
 
 
 @dataclasses.dataclass
+class PoolPolicy:
+    """One role pool of a disaggregated service (prefill or decode):
+    its own replica count bounds, scaled independently by the
+    DualPoolAutoscaler — the two phases have opposite batch optima, so
+    one shared count cannot be right for both."""
+    min_replicas: int = 1
+    max_replicas: Optional[int] = None  # None = fixed at min
+
+    @classmethod
+    def from_config(cls, cfg: Any) -> 'PoolPolicy':
+        if cfg is None:
+            return cls()
+        if isinstance(cfg, int):
+            return cls(min_replicas=cfg)
+        return cls(min_replicas=int(cfg.get('min_replicas', 1)),
+                   max_replicas=cfg.get('max_replicas'))
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        return {'min_replicas': self.min_replicas,
+                'max_replicas': self.max_replicas}
+
+
+@dataclasses.dataclass
 class ReplicaPolicy:
     min_replicas: int = 1
     max_replicas: Optional[int] = None  # None = fixed at min
@@ -55,11 +78,30 @@ class ReplicaPolicy:
     # modest request rates, e.g. long generations) triggers scale-up
     # that in-flight counts alone would miss. None = rate-only.
     target_queue_per_replica: Optional[float] = None
+    # Disaggregated prefill/decode serving (serve/disagg.py): when both
+    # pools are configured the fleet is the two role pools (replicas
+    # launch with SKYTPU_LLM_ROLE), the LB orchestrates KV handoffs,
+    # and the DualPoolAutoscaler scales the prefill pool on queue
+    # depth/prefill bubble and the decode pool on decode tok/s and
+    # KV-block occupancy. ``min_replicas``/``max_replicas`` then bound
+    # nothing — the pools carry their own bounds.
+    prefill_pool: Optional[PoolPolicy] = None
+    decode_pool: Optional[PoolPolicy] = None
+    # Decode-pool signals: tokens/s one decode replica sustains, and the
+    # KV-pool occupancy fraction above which the pool is memory-bound
+    # and must grow regardless of throughput headroom.
+    target_decode_tok_s_per_replica: Optional[float] = None
+    kv_occupancy_high: float = 0.85
 
     @property
     def autoscaling(self) -> bool:
         return (self.max_replicas is not None and
                 self.max_replicas > self.min_replicas)
+
+    @property
+    def disaggregated(self) -> bool:
+        return (self.prefill_pool is not None
+                and self.decode_pool is not None)
 
     @classmethod
     def from_config(cls, cfg: Any) -> 'ReplicaPolicy':
@@ -67,6 +109,11 @@ class ReplicaPolicy:
             return cls()
         if isinstance(cfg, int):
             return cls(min_replicas=cfg)
+        disagg = cfg.get('disagg') or {}
+        if disagg and ('prefill' not in disagg or 'decode' not in disagg):
+            raise ValueError(
+                "replica_policy.disagg needs BOTH 'prefill' and "
+                "'decode' pool entries (one pool is just a fleet)")
         return cls(min_replicas=cfg.get('min_replicas', 1),
                    max_replicas=cfg.get('max_replicas'),
                    target_qps_per_replica=cfg.get('target_qps_per_replica'),
@@ -75,7 +122,15 @@ class ReplicaPolicy:
                    base_ondemand_fallback_replicas=int(
                        cfg.get('base_ondemand_fallback_replicas', 0)),
                    target_queue_per_replica=cfg.get(
-                       'target_queue_per_replica'))
+                       'target_queue_per_replica'),
+                   prefill_pool=(PoolPolicy.from_config(disagg['prefill'])
+                                 if disagg else None),
+                   decode_pool=(PoolPolicy.from_config(disagg['decode'])
+                                if disagg else None),
+                   target_decode_tok_s_per_replica=cfg.get(
+                       'target_decode_tok_s_per_replica'),
+                   kv_occupancy_high=float(
+                       cfg.get('kv_occupancy_high', 0.85)))
 
 
 @dataclasses.dataclass
@@ -119,6 +174,16 @@ class ServiceSpec:
                     self.replica_policy.base_ondemand_fallback_replicas,
                 'target_queue_per_replica':
                     self.replica_policy.target_queue_per_replica,
+                **({'disagg': {
+                    'prefill':
+                        self.replica_policy.prefill_pool.to_yaml_config(),
+                    'decode':
+                        self.replica_policy.decode_pool.to_yaml_config(),
+                }, 'target_decode_tok_s_per_replica':
+                        self.replica_policy.target_decode_tok_s_per_replica,
+                    'kv_occupancy_high':
+                        self.replica_policy.kv_occupancy_high}
+                   if self.replica_policy.disaggregated else {}),
             },
             'port': self.port,
             'load_balancing_policy': self.load_balancing_policy,

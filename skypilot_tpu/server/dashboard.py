@@ -783,15 +783,24 @@ async function serviceView(name){
       'ready replicas': `${ready}/${v.replicas.length}`}) +
     `<h2>Ready replicas over time</h2>` + sparkline(st, '#0b57d0', maxR) +
     `<h2>Replicas</h2>` + table(
-      ['id','status','version','endpoint','cluster','spot','weight',
-       'created','health'], v.replicas,
+      ['id','status','pool','version','endpoint','cluster','spot',
+       'weight','created','health'], v.replicas,
       r=>`<tr><td>${esc(r.replica_id)}</td><td>${B(r.status)}</td>
+       <td>${poolCell(r.role)}</td>
        <td>v${r.version??1}</td><td>${esc(r.endpoint)}</td>
        <td>${esc(r.cluster_name)}</td><td>${r.use_spot?'spot':'od'}</td>
        <td>${esc(r.weight)}</td><td>${T(r.created_at)}</td>
        <td>${healthCell(r.health)}</td></tr>`) +
     `<h2>Spec</h2><pre class="log">${
       esc(JSON.stringify(v.spec, null, 2))}</pre>`;
+}
+
+// Disaggregated-serving pool role, compacted for the replicas table:
+// prefill/decode pools get a colored badge, colocated stays quiet.
+function poolCell(role){
+  if(role === 'prefill') return '<b style="color:#7a5b00">prefill</b>';
+  if(role === 'decode') return '<b style="color:#0a7d33">decode</b>';
+  return '—';
 }
 
 // Last probe body, compacted: the LLM replica's engine stats become
@@ -846,6 +855,17 @@ function healthCell(h){
       if(qo.shed_total) t += ` shed${qo.shed_total}`;
       if(qo.evicted_total) t += ` ev${qo.evicted_total}`;
       parts.push(t);
+    }
+    // KV-handoff accounting (disaggregated serving, serve/disagg.py):
+    // exports on prefill replicas, imports on decode replicas, plus
+    // colocated fallbacks this replica absorbed — e.g. "exp12 imp9 fb1".
+    const dg = h.disagg;
+    if(dg && (dg.exports || dg.imports || dg.fallbacks_served)){
+      let t = [];
+      if(dg.exports) t.push(`exp${dg.exports}`);
+      if(dg.imports) t.push(`imp${dg.imports}`);
+      if(dg.fallbacks_served) t.push(`fb${dg.fallbacks_served}`);
+      parts.push(t.join(' '));
     }
     if(h.kv_cache === 'int8') parts.push('kv8');
     if(h.quantize) parts.push(h.quantize);  // outer esc covers it

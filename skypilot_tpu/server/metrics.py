@@ -105,6 +105,31 @@ _REPLICA_KV_BLOCKS = Gauge(
     'Paged KV pool block accounting by state (free | owned | shared | '
     'cached); the states partition the usable pool exactly.',
     ['state'], registry=SERVING_REGISTRY)
+# Disaggregated prefill/decode KV handoff (serve/disagg.py): cumulative
+# per-replica handoff accounting by direction. Gauges mirroring the
+# replica's own counters (restart legitimately resets them).
+_DISAGG_HANDOFFS = Gauge(
+    'skytpu_disagg_handoffs',
+    'Cumulative KV handoffs on this replica by direction (export = '
+    'prefill-role retirements, import = decode-role installs).',
+    ['direction'], registry=SERVING_REGISTRY)
+_DISAGG_BYTES = Gauge(
+    'skytpu_disagg_handoff_bytes',
+    'Cumulative KV-handoff payload bytes by direction (export planes '
+    'serialized / import planes installed; skipped shared-prefix '
+    'blocks transfer as references and cost nothing here).',
+    ['direction'], registry=SERVING_REGISTRY)
+_DISAGG_SECONDS = Gauge(
+    'skytpu_disagg_handoff_seconds',
+    'Cumulative wall-clock spent in KV handoffs by direction '
+    '(export: prefill + serialize + park; import: parse + validate + '
+    'install + decode-admission wait).',
+    ['direction'], registry=SERVING_REGISTRY)
+_DISAGG_FALLBACK = Gauge(
+    'skytpu_disagg_fallback_total',
+    'Requests this replica served whole after the LB abandoned a KV '
+    'handoff (export/transfer/import failure or a decode replica '
+    'dying mid-stream).', registry=SERVING_REGISTRY)
 
 API_REQUEST = Histogram(
     'skytpu_api_request_seconds',
@@ -311,10 +336,27 @@ def render() -> bytes:
 
 
 def render_serving(engine: Optional[Dict[str, Any]] = None,
-                   qos: Optional[Dict[str, Any]] = None) -> bytes:
+                   qos: Optional[Dict[str, Any]] = None,
+                   disagg: Optional[Dict[str, Any]] = None) -> bytes:
     """The serving replica's scrape body: the latency histograms plus
     point-in-time engine/queue gauges from the stats dicts the replica
-    already maintains for /health."""
+    already maintains for /health. ``disagg`` is the server-level
+    KV-handoff accounting (serve/llm_server.py disagg_stats)."""
+    if disagg:
+        for direction, prefix in (('export', 'export'),
+                                  ('import', 'import')):
+            _DISAGG_HANDOFFS.labels(direction=direction).set(
+                disagg.get(f'{prefix}s') or 0)
+            _DISAGG_BYTES.labels(direction=direction).set(
+                disagg.get(f'{prefix}_bytes') or 0)
+            _DISAGG_SECONDS.labels(direction=direction).set(
+                disagg.get(f'{prefix}_seconds') or 0)
+        _DISAGG_FALLBACK.set(disagg.get('fallbacks_served') or 0)
+    else:
+        _DISAGG_HANDOFFS.clear()
+        _DISAGG_BYTES.clear()
+        _DISAGG_SECONDS.clear()
+        _DISAGG_FALLBACK.set(0)
     if engine:
         _REPLICA_TOKENS.set(engine.get('tokens_emitted') or 0)
         _REPLICA_SLOTS.set(engine.get('slots') or 0)
