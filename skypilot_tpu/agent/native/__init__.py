@@ -17,6 +17,7 @@ _BINARY = os.path.join(_DIR, 'skytpu_gangd')
 _FUSE_BINARY = os.path.join(_DIR, 'skytpu_fuse_proxy')
 _build_lock = threading.Lock()
 _build_failed: Dict[str, bool] = {}
+_GUARDED_BY = {'_build_failed': '_build_lock'}
 
 
 def _built_binary(target: str, src_name: str) -> Optional[str]:

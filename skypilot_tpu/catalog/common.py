@@ -40,6 +40,8 @@ def catalog_path(filename: str) -> str:
 class LazyDataFrame:
     """Loads a catalog CSV on first access; thread-safe; reload on mtime bump."""
 
+    _GUARDED_BY = {'_df': '_lock', '_mtime': '_lock'}
+
     def __init__(self, filename: str,
                  str_columns: Optional[tuple] = None):
         self._filename = filename

@@ -55,6 +55,10 @@ def live_manager(directory: str) -> Optional['AsyncCheckpointManager']:
 
 class AsyncCheckpointManager:
 
+    _GUARDED_BY = {'_pending': '_lock', '_snapshot': '_lock',
+                   '_last_committed': '_lock', '_worker': '_lock',
+                   '_closed': '_lock', '_worker_error': '_lock'}
+
     def __init__(self, directory: str, *, local_dir: Optional[str] = None,
                  max_to_keep: int = 3, save_interval_steps: int = 100,
                  async_save: bool = True,
@@ -158,10 +162,15 @@ class AsyncCheckpointManager:
                 self._ensure_worker_locked()
                 self._idle.notify_all()
         else:
+            # skylint: locked(sync mode never starts the worker thread —
+            # the trainer thread is the sole mutator here; emergency
+            # persist on this thread is serialized by _busy_thread)
             self._snapshot = snap
             self._persist(snap, sync_stall0=stall0)
         return True
 
+    # skylint: locked(the _locked suffix contract — every caller holds
+    # _lock when ensuring the worker)
     def _ensure_worker_locked(self) -> None:
         if self._worker is None or not self._worker.is_alive():
             self._worker = threading.Thread(
@@ -205,6 +214,11 @@ class AsyncCheckpointManager:
                 self._mirror_root)
             mirror.gc_bucket(self._mirror_root, self.max_to_keep)
         save_s = time.perf_counter() - t0
+        # skylint: locked(cross-thread publish kept DELIBERATELY bare —
+        # _pending back-pressure means one persist in flight, so this is
+        # a single-writer GIL-atomic int store; taking the non-reentrant
+        # lock here would re-open the second-SIGTERM self-deadlock
+        # window emergency_persist's lock-free path exists to avoid)
         self._last_committed = snap.step
         if sync_stall0 is not None:
             # Sync mode: the caller stalled for the WHOLE persist.
@@ -214,6 +228,8 @@ class AsyncCheckpointManager:
                    async_save=self.async_save and sync_stall0 is None,
                    emergency=emergency)
 
+    # skylint: locked(the _locked suffix contract — every caller holds
+    # _lock when draining the worker error)
     def _raise_worker_error_locked(self) -> None:
         if self._worker_error is not None:
             err, self._worker_error = self._worker_error, None
@@ -252,6 +268,9 @@ class AsyncCheckpointManager:
             # thread (save/close/latest_step may hold the non-reentrant
             # lock): re-entering would self-deadlock. The trainer's
             # finally-close() flushes the pending persist.
+            # skylint: locked(taking the non-reentrant lock here IS the
+            # deadlock this branch exists to avoid; GIL-atomic read of a
+            # monotonic int publish)
             return self._last_committed
         try:
             if not self.wait_until_finished(timeout=timeout):
@@ -262,13 +281,17 @@ class AsyncCheckpointManager:
                 return None
         except CheckpointError:
             pass  # worker died — safe to persist the snapshot directly
+        # skylint: locked(post wait_until_finished the worker is idle and
+        # the process is dying — this thread is the sole toucher; taking
+        # the lock would add a self-deadlock window under a second
+        # signal, not safety)
         snap = self._snapshot
         if snap is None:
             if state is None:
-                return self._last_committed
+                return self._last_committed  # skylint: locked(as above)
             snap = snapshot_lib.take(step or 0, state)
-            self._snapshot = snap
-        if self._last_committed != snap.step:
+            self._snapshot = snap  # skylint: locked(as above)
+        if self._last_committed != snap.step:  # skylint: locked(as above)
             self._persist(snap, emergency=True)
         elif self._mirror_root and self._host == 0:
             # Committed locally but the VM is about to vanish: make sure
@@ -332,6 +355,8 @@ class AsyncCheckpointManager:
                     # error into irreversible data loss.
                     self._quarantine(path)
                 continue
+            # skylint: locked(restore runs before the step loop starts —
+            # no worker thread exists yet to race with)
             self._last_committed = step
             self._emit('restore', step=step,
                        seconds=time.perf_counter() - t0,
@@ -450,7 +475,11 @@ class AsyncCheckpointManager:
             with self._lock:
                 self._closed = True
                 self._idle.notify_all()
+            # skylint: locked(join must run unlocked — the exiting worker
+            # needs _lock to observe _closed; _closed=True above stops
+            # any new worker from being ensured)
             if self._worker is not None:
+                # skylint: locked(as above — unlocked join by design)
                 self._worker.join(timeout=30)
 
 

@@ -54,6 +54,7 @@ def _load_file(path: str) -> Dict[str, Any]:
 
 _base_cache_lock = threading.Lock()
 _base_cache: Optional[Tuple[tuple, Dict[str, Any]]] = None  # (stamp, config)
+_GUARDED_BY = {'_base_cache': '_base_cache_lock'}
 
 
 def _config_paths() -> List[str]:

@@ -1,0 +1,316 @@
+"""The SKYTPU_* environment-flag registry.
+
+Every environment flag the tree reads is declared here — name, type,
+default, one-line doc — and `make lint` (skylint's env-flag checker)
+fails on any ``SKYTPU_*`` string literal that is not a declared name
+(typo-proofing: ``os.environ.get('SKYTPU_LLM_PIPLINE')`` would
+otherwise silently read the default forever) and on any declared flag
+no code reads (dead-flag detection). ``tools/gen_flag_docs.py``
+generates ``docs/env_flags.md`` from this module; its ``--check`` mode
+runs under `make lint`, so the docs cannot drift either.
+
+This module is import-light ON PURPOSE (stdlib dataclasses only): the
+lint tooling and the docs generator load it standalone, without paying
+for (or requiring) the package's jax-adjacent imports.
+
+Conventions: booleans are env-string booleans — unset/''/'0'/'off' is
+false, anything else true — unless the doc says otherwise. ``default``
+is the code-side fallback as a string, or None when the flag is simply
+unset (feature off / auto-detect)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+TYPES = ('bool', 'int', 'float', 'str', 'path', 'url', 'csv', 'map')
+
+
+@dataclasses.dataclass(frozen=True)
+class Flag:
+    name: str
+    type: str  # one of TYPES
+    default: Optional[str]  # code-side fallback (None = unset)
+    doc: str
+
+
+FLAGS: Tuple[Flag, ...] = (
+    # -- state, config, workspaces ------------------------------------
+    Flag('SKYTPU_STATE_DIR', 'path', '~/.skypilot_tpu',
+         'Root of all local state: the sqlite DBs, cluster YAMLs, SSH '
+         'leases, trace exports, benchmark artifacts.'),
+    Flag('SKYTPU_CONFIG', 'path', None,
+         'Path to the user config YAML (overrides the default '
+         '~/.skypilot_tpu/config.yaml lookup).'),
+    Flag('SKYTPU_WORKSPACE', 'str', None,
+         'Active workspace name; set by the request runner for every '
+         'server-executed request.'),
+    Flag('SKYTPU_PKG_ROOT', 'path', None,
+         'Override for the installed package root (tpu_doctor uses it '
+         'to attribute framework processes to this checkout).'),
+    Flag('SKYTPU_DB_URL', 'url', None,
+         'External database URL for server state; unset = per-user '
+         'sqlite under SKYTPU_STATE_DIR.'),
+    # -- API server / client ------------------------------------------
+    Flag('SKYTPU_API_SERVER_URL', 'url', 'http://127.0.0.1:46580',
+         'API server endpoint the SDK/CLI talks to.'),
+    Flag('SKYTPU_API_TOKEN', 'str', None,
+         'Bearer token the SDK/CLI sends to the API server.'),
+    Flag('SKYTPU_API_TOKEN_FILE', 'path', '~/.skypilot_tpu/token',
+         'File the client reads the bearer token from when '
+         'SKYTPU_API_TOKEN is unset.'),
+    Flag('SKYTPU_METRICS_TOKEN', 'str', None,
+         'Separate scrape token granting /metrics-only access (so '
+         'Prometheus need not hold an admin bearer).'),
+    Flag('SKYTPU_SERVER_REFRESH_S', 'float', '120',
+         'API-server background fleet-state refresh interval.'),
+    Flag('SKYTPU_REQUEST_GC_AGE_S', 'float', '86400',
+         'Age after which finished request-table rows are garbage-'
+         'collected by the server daemons.'),
+    Flag('SKYTPU_MAX_CONTROLLERS', 'int', '16',
+         'Cap on concurrently running in-process service controllers.'),
+    Flag('SKYTPU_ADVERTISE_IP', 'str', None,
+         'Routable IP advertised for endpoints on multi-homed hosts '
+         '(default: auto-detected local IP).'),
+    # -- auth (OAuth / users) -----------------------------------------
+    Flag('SKYTPU_OAUTH_ISSUER', 'url', None,
+         'OIDC issuer URL; setting it enables the OAuth login flow.'),
+    Flag('SKYTPU_OAUTH_CLIENT_ID', 'str', None,
+         'OAuth client id registered with the issuer.'),
+    Flag('SKYTPU_OAUTH_CLIENT_SECRET', 'str', None,
+         'OAuth client secret (confidential clients only).'),
+    Flag('SKYTPU_OAUTH_ADMIN_EMAILS', 'csv', None,
+         'Emails auto-granted the admin role at first OAuth login.'),
+    Flag('SKYTPU_OAUTH_DEFAULT_ROLE', 'str', 'user',
+         'Role granted to OAuth logins not in the admin list.'),
+    # -- telemetry / usage collection ---------------------------------
+    Flag('SKYTPU_DISABLE_USAGE_COLLECTION', 'bool', '0',
+         'Disable anonymous usage reporting entirely.'),
+    Flag('SKYTPU_USAGE_ENDPOINT', 'url', None,
+         'Usage-report POST endpoint; unset spools locally only.'),
+    Flag('SKYTPU_USAGE_SPOOL_MAX_FILES', 'int', '32',
+         'Max spooled usage-report files before oldest-first pruning.'),
+    Flag('SKYTPU_USAGE_SPOOL_MAX_MB', 'float', '16',
+         'Max total MB of spooled usage reports.'),
+    Flag('SKYTPU_SESSION_FINGERPRINT', 'str', None,
+         'Session id stamped into child processes so tpu_doctor can '
+         'attribute strays to the test/bench session that leaked them.'),
+    Flag('SKYTPU_TIMELINE_FILE_PATH', 'path', None,
+         'When set, timeline-decorated control-plane calls append '
+         'Chrome-trace events to this file.'),
+    # -- tracing (observability/trace.py) -----------------------------
+    Flag('SKYTPU_TRACE', 'bool', '1',
+         'Master switch for request tracing.'),
+    Flag('SKYTPU_TRACE_SAMPLE', 'float', '1',
+         'Trace sampling rate in [0, 1] for LB-minted trace ids.'),
+    Flag('SKYTPU_TRACE_RING', 'int', '256',
+         'Per-process in-memory ring of finished traces '
+         '(/debug/traces).'),
+    Flag('SKYTPU_TRACE_EXPORT', 'bool', '0',
+         'Also persist finished traces to the export spool dir.'),
+    Flag('SKYTPU_TRACE_EXPORT_DIR', 'path',
+         '$SKYTPU_STATE_DIR/traces',
+         'Trace export spool directory.'),
+    Flag('SKYTPU_TRACE_EXPORT_KEEP', 'int', '512',
+         'Max exported trace files kept (oldest pruned).'),
+    Flag('SKYTPU_TRACE_PARENT', 'str', None,
+         'Inherited trace-context header value for server-spawned '
+         'request runners (keeps child spans in the parent trace).'),
+    # -- serving: replica / LLM server --------------------------------
+    Flag('SKYTPU_REPLICA_PORT', 'int', '8001',
+         'Port a serving replica binds.'),
+    Flag('SKYTPU_LLM_ENGINE', 'str', 'continuous',
+         "Serving engine: 'continuous' (batching engine) or 'simple'."),
+    Flag('SKYTPU_LLM_ROLE', 'str', 'colocated',
+         "Disaggregated-serving role: 'prefill', 'decode', or "
+         "'colocated'."),
+    Flag('SKYTPU_LLM_SLOTS', 'int', '16',
+         'Engine decode slots (continuous-batch width).'),
+    Flag('SKYTPU_LLM_MAX_BATCH', 'int', '32',
+         'Max rows per simple-engine batch window.'),
+    Flag('SKYTPU_LLM_BATCH_WINDOW_MS', 'float', '0',
+         'Simple-engine arrival-batching window.'),
+    Flag('SKYTPU_LLM_CHUNK_STEPS', 'int', '8',
+         'Decode steps fused per dispatched chunk.'),
+    Flag('SKYTPU_LLM_PIPELINE', 'bool', '1',
+         'Depth-1 decode dispatch pipeline (host bookkeeping overlaps '
+         'device compute); 0 = serial dispatch.'),
+    Flag('SKYTPU_LLM_TP', 'int', '1',
+         'Tensor-parallel ways for the serving engine.'),
+    Flag('SKYTPU_LLM_PREFILL_BATCH', 'int', '4',
+         'Max prompts prefilled per admission group.'),
+    Flag('SKYTPU_LLM_PREFILL_CHUNK', 'int', '0',
+         'Chunked-prefill chunk length (0 = whole prompt).'),
+    Flag('SKYTPU_LLM_PREFIX_CACHE', 'int', '0',
+         'Dense-layout prefix-cache slots (0 = off).'),
+    Flag('SKYTPU_LLM_PREFIX_SHARE', 'bool', '1',
+         'Copy-on-write block-level prefix sharing in the paged KV '
+         'pool.'),
+    Flag('SKYTPU_LLM_KV_LAYOUT', 'str', 'paged',
+         "KV cache layout: 'paged' or 'dense'."),
+    Flag('SKYTPU_LLM_KV_CACHE', 'str', 'bf16',
+         "KV cache dtype: 'bf16' or 'int8'."),
+    Flag('SKYTPU_LLM_KV_BLOCK', 'int', '16',
+         'Paged-KV block length (tokens).'),
+    Flag('SKYTPU_LLM_KV_BLOCKS', 'int', '0',
+         'Paged-KV pool size in blocks (0 = full capacity).'),
+    Flag('SKYTPU_LLM_QUANTIZE', 'str', None,
+         "Weight quantization mode for serving (e.g. 'int8')."),
+    Flag('SKYTPU_LLM_DRAFT', 'path', None,
+         'Draft-model checkpoint enabling speculative decoding.'),
+    Flag('SKYTPU_LLM_SPEC_K', 'int', '4',
+         'Speculative-decoding proposal length.'),
+    Flag('SKYTPU_LLM_DRAIN_S', 'float', '30',
+         'Graceful drain window before a replica exits.'),
+    Flag('SKYTPU_DECODE_KERNEL', 'str', None,
+         "Set to 'pallas' to enable the fused decode attention "
+         'kernel.'),
+    # -- serving: QoS gate --------------------------------------------
+    Flag('SKYTPU_QOS', 'bool', '0',
+         'Enable the QoS admission gate on serving replicas.'),
+    Flag('SKYTPU_QOS_WEIGHTS', 'map', None,
+         "Per-class weighted-fair shares, e.g. 'interactive:8,batch:2'."),
+    Flag('SKYTPU_QOS_TTL_S', 'map', None,
+         'Per-class queue-wait TTLs before eviction (429).'),
+    Flag('SKYTPU_QOS_MAX_QUEUE', 'int', '256',
+         'Aggregate admission-queue depth before shedding.'),
+    Flag('SKYTPU_QOS_MAX_INFLIGHT', 'int', '0',
+         'Dispatch-gate in-flight cost cap (0 = engine slot budget).'),
+    Flag('SKYTPU_QOS_TENANT_RPS', 'float', '0',
+         'Default per-tenant request/s quota (0 = unlimited).'),
+    Flag('SKYTPU_QOS_TENANT_TPS', 'float', '0',
+         'Default per-tenant generated-tokens/s quota (0 = unlimited).'),
+    Flag('SKYTPU_QOS_TENANT_LIMITS', 'map', None,
+         "Per-tenant quota overrides, e.g. 'alice=5/1000,bob=1/50'."),
+    Flag('SKYTPU_QOS_SWEEP_S', 'float', '0.25',
+         'TTL-eviction sweeper period.'),
+    Flag('SKYTPU_QOS_FALLBACK_TOK_S', 'float', '100',
+         'Assumed decode tok/s for Retry-After before any throughput '
+         'is observed.'),
+    # -- serving: disaggregated prefill/decode ------------------------
+    Flag('SKYTPU_DISAGG_STAGING', 'path', None,
+         'Shared staging dir for same-host KV handoffs (payload moves '
+         'as a file ref instead of HTTP bytes).'),
+    Flag('SKYTPU_DISAGG_TTL_S', 'float', '60',
+         'Parked-export lifetime before the prefill replica reclaims '
+         'its blocks.'),
+    Flag('SKYTPU_DISAGG_OFFLOAD_MIN_BYTES', 'int', '4194304',
+         'Payloads below this serialize inline in /v1/kv/export; '
+         'above it they park for a separate /v1/kv/fetch.'),
+    # -- training / checkpointing -------------------------------------
+    Flag('SKYTPU_PEAK_FLOPS', 'float', '0',
+         'Per-chip peak FLOP/s for MFU in trainer telemetry (0 = MFU '
+         'not reported).'),
+    Flag('SKYTPU_TRAIN_TELEMETRY_DIR', 'path', None,
+         'Directory the trainer drops per-step telemetry JSON into '
+         '(the agent heartbeat ships it).'),
+    Flag('SKYTPU_TRAIN_TELEMETRY_MAX_KB', 'int', '64',
+         'Size cap for one telemetry window file.'),
+    Flag('SKYTPU_CKPT_HOLD_FILE', 'path', None,
+         'Crash-probe hook: while this file exists, commit_step parks '
+         'mid-commit so a prober can kill -9 the process.'),
+    Flag('SKYTPU_CKPT_HOLD_STEP', 'int', None,
+         'Restrict SKYTPU_CKPT_HOLD_FILE parking to one step.'),
+    # -- agent / multi-host gang --------------------------------------
+    Flag('SKYTPU_AGENT_DIAL', 'str', 'tunnel',
+         "How clients dial cluster agents: 'tunnel' (SSH) or 'direct'."),
+    Flag('SKYTPU_WORKER_RANK', 'int', None,
+         'Global host rank, exported to gang job processes.'),
+    Flag('SKYTPU_NUM_WORKERS', 'int', None,
+         'Global host count, exported to gang job processes.'),
+    Flag('SKYTPU_WORKER_IPS', 'csv', None,
+         'All worker IPs, exported to gang job processes.'),
+    Flag('SKYTPU_NUM_SLICES', 'int', None,
+         'Slice count, exported to multislice gang jobs.'),
+    Flag('SKYTPU_SLICE_ID', 'int', None,
+         'This host\'s slice id in a multislice gang.'),
+    Flag('SKYTPU_CHIPS_PER_HOST', 'int', None,
+         'Accelerator chips per host, exported to gang jobs.'),
+    Flag('SKYTPU_NATIVE_GANG', 'bool', '1',
+         'Use the native gangd coordinator (0 = pure-python fallback).'),
+    Flag('SKYTPU_GANGD_BIN', 'path', None,
+         'Prebuilt skytpu_gangd binary override (sanitizer builds, '
+         'deploys without a toolchain).'),
+    Flag('SKYTPU_FUSE_PROXY_BIN', 'path', None,
+         'Prebuilt skytpu_fuse_proxy binary override.'),
+    Flag('SKYTPU_FUSE_PROXY_SOCKET', 'path', None,
+         'Control socket of a running fuse proxy (set for mounted '
+         'storage jobs).'),
+    Flag('SKYTPU_TERM_GRACE_S', 'float', '10',
+         'SIGTERM-to-SIGKILL grace when stopping job processes.'),
+    Flag('SKYTPU_REMOTE_PYTHON', 'str', 'python3',
+         'Python interpreter used on provisioned hosts.'),
+    # -- provisioning / clouds ----------------------------------------
+    Flag('SKYTPU_ENABLE_FAKE_CLOUD', 'bool', None,
+         'Enable the in-process fake cloud (tests, local dev).'),
+    Flag('SKYTPU_CONTROLLER_CLOUD', 'str', 'local',
+         'Cloud the managed-jobs/serve controller launches into.'),
+    Flag('SKYTPU_CONTROLLER_MAX_RESTARTS', 'int', '3',
+         'Controller HA restart budget before a service is marked '
+         'failed.'),
+    Flag('SKYTPU_ADOPTION_RETRY_S', 'float', '600',
+         'HA controller retry period for adopting orphaned services.'),
+    Flag('SKYTPU_SERVE_CLAIM_GRACE_S', 'float', '300',
+         'Grace before a dead controller\'s service claim may be '
+         'adopted.'),
+    Flag('SKYTPU_GUARD_SPARE_MAX_S', 'float', '900',
+         'Max seconds the spot-guard keeps an idle spare alive.'),
+    Flag('SKYTPU_SSH_USER', 'str', '$USER',
+         'SSH user for the ssh_pool provisioner.'),
+    Flag('SKYTPU_LOCAL_BUCKET_ROOT', 'path', None,
+         'Root dir backing the local:// storage scheme.'),
+    Flag('SKYTPU_GCP_ZONE', 'str', None,
+         'Default GCP zone for provisioning.'),
+    Flag('SKYTPU_AWS_REGION', 'str', None,
+         'Default AWS region for provisioning.'),
+    Flag('SKYTPU_AWS_DEFAULT_AMI', 'str', None,
+         'AMI override for AWS instances.'),
+    Flag('SKYTPU_AWS_SSH_USER', 'str', 'ubuntu',
+         'SSH user on AWS instances.'),
+    Flag('SKYTPU_AZURE_REGION', 'str', None,
+         'Default Azure region for provisioning.'),
+    Flag('SKYTPU_AZURE_SSH_USER', 'str', 'azureuser',
+         'SSH user on Azure instances.'),
+    Flag('SKYTPU_DO_SSH_USER', 'str', 'root',
+         'SSH user on DigitalOcean instances.'),
+    Flag('SKYTPU_GKE_NAMESPACE', 'str', None,
+         'Kubernetes namespace for GKE provisioning.'),
+    Flag('SKYTPU_GKE_SERVICE_TYPE', 'str', None,
+         'Service type exposing GKE-provisioned endpoints.'),
+    Flag('SKYTPU_K8S_NAMESPACE', 'str', None,
+         'Kubernetes namespace for generic k8s provisioning.'),
+    Flag('SKYTPU_K8S_SERVICE_TYPE', 'str', None,
+         'Service type exposing k8s-provisioned endpoints.'),
+    Flag('SKYTPU_SLURM_ALLOC_WAIT_S', 'float', '300',
+         'Max wait for a Slurm allocation before giving up.'),
+    # -- server metrics history ---------------------------------------
+    Flag('SKYTPU_METRICS_SAMPLE_S', 'float', '15',
+         'Fleet metrics-history sampling period.'),
+    Flag('SKYTPU_METRICS_HISTORY_SAMPLES', 'int', '960',
+         'Ring size of retained fleet metrics samples.'),
+    # -- bench / probe / test harness ---------------------------------
+    Flag('SKYTPU_BENCH_SWEEP_BUDGET_S', 'float', '600',
+         'Wall-clock budget for one bench sweep phase.'),
+    Flag('SKYTPU_BENCH_REAP_ALL', 'bool', None,
+         'Bench teardown reaps every framework process, not just its '
+         'own session.'),
+    Flag('SKYTPU_BENCH_PROBE_TIMEOUTS', 'csv', None,
+         'Per-probe timeout overrides for bench runs.'),
+    Flag('SKYTPU_PROBE_PHASE_DEADLINE_S', 'float', '300',
+         'perf_probe per-phase deadline.'),
+    Flag('SKYTPU_PROBE_HARD_DEADLINE_S', 'float', '600',
+         'perf_probe whole-run hard deadline.'),
+    Flag('SKYTPU_PROBE_HOLD_FILE', 'path', None,
+         'Probe synchronization hold-file (kill/resume scenarios).'),
+    Flag('SKYTPU_PROBE_HOLD_MAX_S', 'float', '60',
+         'Max seconds a probe parks on the hold-file.'),
+    Flag('SKYTPU_LIVE_KIND', 'bool', None,
+         'Opt into the live kind-cluster integration test.'),
+)
+
+NAMES = frozenset(f.name for f in FLAGS)
+_BY_NAME: Dict[str, Flag] = {f.name: f for f in FLAGS}
+assert len(_BY_NAME) == len(FLAGS), 'duplicate flag declaration'
+
+
+def get(name: str) -> Flag:
+    return _BY_NAME[name]

@@ -321,6 +321,9 @@ _jit_paged_chunk = jax.jit(_paged_chunk_impl, static_argnums=(0, 1, 10),
                            donate_argnums=(3, 4))
 
 
+# skylint: allow-host-sync(top_ks/top_ps arrive as host np arrays built
+# from request fields — asarray is host-to-host normalization, no device
+# transfer)
 def _filters_or_none(top_ks: np.ndarray, top_ps: np.ndarray):
     """None when every row's filters are off — filter_logits then skips
     the full-vocab sort on the hot decode loop entirely (the None/array
@@ -442,6 +445,33 @@ class ContinuousEngine:
     """Slot server: submit() rows from any thread; a dedicated engine
     thread owns the device state and loops admit -> decode-chunk ->
     emit. See module docstring for the design."""
+
+    # Cross-thread state: submitters append to the queues and stats()
+    # (the /health endpoint) snapshots queues + counters, while the
+    # engine thread mutates both. Counter bumps are grouped under the
+    # lock at the few emission/retire points; engine-thread-only reads
+    # carry per-line locked(...) annotations.
+    _GUARDED_BY = {
+        '_pending': '_lock', '_pending_imports': '_lock',
+        '_admitting': '_lock', '_prefilling': '_lock',
+        '_unfetched': '_lock', '_slot_req': '_lock',
+        'prefills': '_lock', 'prefill_groups': '_lock',
+        'prefill_chunks': '_lock', 'prefix_hits': '_lock',
+        'prefix_hit_tokens': '_lock', 'prefix_stores': '_lock',
+        'share_hits': '_lock', 'share_hit_tokens': '_lock',
+        'share_misses': '_lock', 'share_commits': '_lock',
+        'share_evictions': '_lock', 'cow_forks': '_lock',
+        'prefill_tokens': '_lock', 'prefill_tokens_saved': '_lock',
+        'prefill_ms': '_lock', 'prefill_bubble_ms': '_lock',
+        'chunks_run': '_lock', 'tokens_emitted': '_lock',
+        'peak_active': '_lock', 'spec_rounds': '_lock',
+        'spec_proposals': '_lock', 'spec_accepted': '_lock',
+        'exports': '_lock', 'imports': '_lock',
+        'export_ms': '_lock', 'import_ms': '_lock',
+        'import_errors': '_lock', 'dispatches': '_lock',
+        'host_overlap_ms': '_lock', 'bubble_ms': '_lock',
+        '_gap_ms_total': '_lock', '_gap_count': '_lock',
+    }
 
     def __init__(self, params, cfg: llama.LlamaConfig, *,
                  slots: Optional[int] = None, max_len: int = 1024,
@@ -922,7 +952,13 @@ class ContinuousEngine:
                 if self._trie is not None:
                     shared_blocks = self._trie.referenced
                     cached_blocks = self._trie.reclaimable
-        return {'slots': self.slots, 'active_slots': active,
+            # skylint finding (guarded-by): this return used to sit
+            # OUTSIDE the with-block — every counter below was read
+            # unlocked while the engine thread bumps them, so /health
+            # could see a snapshot where e.g. queue state and the
+            # token/prefill counters disagree mid-emission. The whole
+            # snapshot now builds under the lock.
+            return {'slots': self.slots, 'active_slots': active,
                 'kv_cache': 'int8' if self.kv_quantize else 'bf16',
                 'kv_layout': self.kv_layout,
                 # Disaggregated-serving role + handoff accounting
@@ -1021,6 +1057,7 @@ class ContinuousEngine:
 
     # -- engine thread -----------------------------------------------------
 
+    # skylint: engine-thread, hot-path
     def _loop(self) -> None:
         while not self._stop:
             try:
@@ -1040,8 +1077,11 @@ class ContinuousEngine:
                     # Prefill/admission dispatches issued while a chunk
                     # computes are pure overlap — the host work this
                     # pipeline exists to hide.
-                    self.host_overlap_ms += (time.perf_counter() - t0) \
-                        * 1e3
+                    with self._lock:
+                        self.host_overlap_ms += \
+                            (time.perf_counter() - t0) * 1e3
+                # skylint: locked(engine thread is the sole slot-table
+                # mutator; a stale read here only delays one loop turn)
                 if not any(r is not None for r in self._slot_req):
                     # Every request in a still-in-flight chunk's
                     # snapshot is done by now (a live one would occupy
@@ -1049,6 +1089,8 @@ class ContinuousEngine:
                     self._flush_pipeline(quiet=True)
                     self._drain_firsts()  # e.g. all-max_new==1 traffic
                     self._note_decode_quiet()
+                    # skylint: locked(only the engine thread appends or
+                    # retires _prefilling entries; emptiness is stable)
                     if self._prefilling:
                         continue  # keep chunking the long prompt
                     # Long wait, event-paced: submit() sets _wake, and
@@ -1084,6 +1126,7 @@ class ContinuousEngine:
                 self._wake.wait(0.1)
                 self._wake.clear()
 
+    # skylint: engine-thread
     def _fail_everything(self, exc: Exception) -> None:
         with self._lock:
             doomed = list(self._pending) + [
@@ -1223,6 +1266,8 @@ class ContinuousEngine:
             avail += self._trie.reclaimable
         return avail
 
+    # skylint: locked(every caller holds _lock per the docstring
+    # contract below)
     def _alloc_blocks(self, n: int) -> List[int]:
         """Pop ``n`` blocks, refcount-aware-LRU-evicting idle trie
         blocks when the free list runs short. Callers hold the lock and
@@ -1233,6 +1278,7 @@ class ContinuousEngine:
             self._free_blocks.extend(freed)
         return [self._free_blocks.pop() for _ in range(n)]
 
+    # skylint: engine-thread
     @staticmethod
     def _fire_callbacks(emitted: List[tuple]) -> None:
         """Run on_tokens callbacks OUTSIDE the lock, each guarded: a
@@ -1250,6 +1296,7 @@ class ContinuousEngine:
         self._key, sub = jax.random.split(self._key)
         return sub
 
+    # skylint: engine-thread
     def _admit(self) -> None:
         """Prefill pending requests into free slots, in power-of-two
         GROUPS: one padded [N, S] forward + one scatter insert per group.
@@ -1394,6 +1441,7 @@ class ContinuousEngine:
             with self._lock:
                 self._admitting = []
 
+    # skylint: engine-thread
     def _admit_shared(self, req: _Request, slot: int, nodes: list,
                       partial, plen: int, owned: List[int]) -> None:
         """Admit ONE block-share hit: the table head points at the
@@ -1404,6 +1452,8 @@ class ContinuousEngine:
         no insert copy."""
         from skypilot_tpu.models import paged as paged_lib
         t0 = time.perf_counter()
+        # skylint: locked(engine thread is the sole slot-table mutator;
+        # this is a point-in-time bubble-attribution hint only)
         had_active = any(r is not None and r is not req
                          for r in self._slot_req)
         p = self.kv_block
@@ -1419,7 +1469,6 @@ class ContinuousEngine:
             # then writes from in-block offset ``plen``.
             self._cache = paged_lib.jit_fork_block(
                 self._cache, jnp.int32(partial.block), jnp.int32(owned[0]))
-            self.cow_forks += 1
         suffix = row[covered:]
         # The padded width must not overhang max_len: positions past
         # the table are CLIPPED to its last entry, and with a full
@@ -1451,17 +1500,23 @@ class ContinuousEngine:
                 freed = self._trie.release(partial)
                 if freed is not None:
                     self._free_blocks.append(freed)
+                self.cow_forks += 1
             self._slot_table[slot] = table.copy()
             self._commit_prompt_blocks(slot, row, nodes)
             self._unfetched.append(([req], first))
-        self.prefills += 1
-        self.prefill_groups += 1
-        self.share_hits += 1
-        self.share_hit_tokens += covered
-        self.prefill_tokens += len(suffix)
-        self.prefill_tokens_saved += covered
+            # skylint finding (guarded-by): these bumps sat outside the
+            # lock while /health snapshots them — fold into the commit
+            # critical section.
+            self.prefills += 1
+            self.prefill_groups += 1
+            self.share_hits += 1
+            self.share_hit_tokens += covered
+            self.prefill_tokens += len(suffix)
+            self.prefill_tokens_saved += covered
         self._note_prefill_time(t0, had_active)
 
+    # skylint: locked(every caller holds _lock per the docstring
+    # contract below)
     def _commit_prompt_blocks(self, slot: int, row: List[int],
                               shared_nodes: list) -> None:
         """Index the slot's full PROMPT blocks in the share trie.
@@ -1494,15 +1549,17 @@ class ContinuousEngine:
             self.share_commits += 1
             parent = node
 
+    # skylint: engine-thread
     def _note_prefill_time(self, t0: float, had_active: bool) -> None:
         """Prefill cost bookkeeping: total host wall time spent
         dispatching prefill work, and the slice of it decode provably
         waited on (active slots, nothing in flight) — the prefill
         bubble sharing and chunking shrink."""
         dt_ms = (time.perf_counter() - t0) * 1e3
-        self.prefill_ms += dt_ms
-        if had_active and self._inflight is None:
-            self.prefill_bubble_ms += dt_ms
+        with self._lock:
+            self.prefill_ms += dt_ms
+            if had_active and self._inflight is None:
+                self.prefill_bubble_ms += dt_ms
 
     def _match_prefix(self, row: List[int]):
         """Longest cached prefix of ``row`` at power-of-two lengths
@@ -1518,6 +1575,7 @@ class ContinuousEngine:
             b *= 2
         return best
 
+    # skylint: engine-thread
     def _maybe_store_prefixes(self, rows, p_lens,
                               cache_n: gen_lib.KVCache) -> None:
         """Store each row's largest bucket prefix on its SECOND sighting
@@ -1548,8 +1606,10 @@ class ContinuousEngine:
                 self._prefix_pool, cache_n, jnp.int32(i), jnp.int32(slot),
                 p)
             self._prefix_index[key] = slot
-            self.prefix_stores += 1
+            with self._lock:
+                self.prefix_stores += 1
 
+    # skylint: engine-thread
     def _prefill_one_chunk(self, params, cfg, cache1, row, consumed):
         """One bounded chunk of a single-row incremental prefill.
         Returns (logits, cache, new_consumed). Pad width may not
@@ -1565,9 +1625,12 @@ class ContinuousEngine:
             params, padded, cache1, cfg,
             np.asarray([len(chunk)], np.int32))
         if params is self.params:  # draft-model chunks don't count
-            self.prefill_tokens += len(chunk)
+            with self._lock:
+                self.prefill_tokens += len(chunk)
         return logits, cache1, consumed + len(chunk)
 
+    # skylint: locked(engine thread is the sole mutator of _prefilling
+    # and _slot_req; both reads are loop-pacing hints, not invariants)
     def _advance_prefill(self) -> None:
         if not self._prefilling:
             return
@@ -1578,12 +1641,15 @@ class ContinuousEngine:
         finally:
             self._note_prefill_time(t0, had_active)
 
+    # skylint: engine-thread
     def _advance_prefill_impl(self) -> None:
         """Advance the oldest in-flight long prefill by ONE chunk per
         model (the per-iteration budget that bounds how long active
         slots wait between decode chunks). On the target's final chunk:
         sample the first token; insert once the draft cache (spec mode)
         has caught up and a slot frees."""
+        # skylint: locked(only the engine thread reorders _prefilling;
+        # cross-thread appends go through _admit under the lock)
         entry = self._prefilling[0]
         req = entry.req
         n = len(req.row)
@@ -1595,7 +1661,8 @@ class ContinuousEngine:
             _, entry.d_cache, entry.d_consumed = self._prefill_one_chunk(
                 self.draft_params, self.draft_cfg, entry.d_cache,
                 req.row, entry.d_consumed)
-            self.prefill_chunks += 1
+            with self._lock:
+                self.prefill_chunks += 1
         if entry.parked:
             self._finish_long_prefill(entry)
             return
@@ -1626,11 +1693,13 @@ class ContinuousEngine:
                     p_hit = len(t_blocks) * self.kv_block
                     cache1 = paged_lib.jit_gather_blocks(
                         self._cache, tbl, np.asarray([p_hit], np.int32))
-                    self.share_hits += 1
-                    self.share_hit_tokens += p_hit
-                    self.prefill_tokens_saved += p_hit
+                    with self._lock:
+                        self.share_hits += 1
+                        self.share_hit_tokens += p_hit
+                        self.prefill_tokens_saved += p_hit
                 else:
-                    self.share_misses += 1
+                    with self._lock:
+                        self.share_misses += 1
             if cache1 is None and self._prefix_pool is not None:
                 p_hit, pool_row = self._match_prefix(req.row)
                 if p_hit:
@@ -1638,8 +1707,9 @@ class ContinuousEngine:
                         self._prefix_pool,
                         np.asarray([pool_row], np.int32),
                         np.asarray([p_hit], np.int32), self.max_len)
-                    self.prefix_hits += 1
-                    self.prefix_hit_tokens += p_hit
+                    with self._lock:
+                        self.prefix_hits += 1
+                        self.prefix_hit_tokens += p_hit
             if cache1 is None:
                 cache1 = gen_lib.init_cache(self.cfg, 1, self.max_len,
                                             quantize=self.kv_quantize)
@@ -1650,7 +1720,8 @@ class ContinuousEngine:
                     quantize=self.kv_quantize)
         logits, entry.cache, entry.consumed = self._prefill_one_chunk(
             self.params, self.cfg, entry.cache, req.row, entry.consumed)
-        self.prefill_chunks += 1
+        with self._lock:
+            self.prefill_chunks += 1
         if entry.consumed >= n:
             if self._prefix_pool is not None:
                 # Store this prompt's bucket prefix on its second
@@ -1666,9 +1737,13 @@ class ContinuousEngine:
                 *_filters_or_none(np.asarray([req.top_k], np.int32),
                                   np.asarray([req.top_p], np.float32)))
             entry.first = first
+            # skylint: allow-host-sync(designed fetch point — one scalar
+            # first token at long-prefill retirement, the chunked path's
+            # only sync; EOS/export routing needs the host value now)
             entry.first_host = int(jax.device_get(first)[0])
             self._finish_long_prefill(entry)
 
+    # skylint: engine-thread
     def _finish_long_prefill(self, entry: _Prefilling) -> None:
         req = entry.req
         if self.draft_cfg is not None and entry.d_consumed < len(req.row):
@@ -1699,10 +1774,11 @@ class ContinuousEngine:
                 self._slot_req[slot] = req
                 if table_row is not None:
                     self._slot_blocks[slot] = list(table_row[:nb])
-        self._prefilling.pop(0)
-        self.prefills += 1
-        req.tokens.append(entry.first_host)
-        self.tokens_emitted += 1
+        with self._lock:
+            self._prefilling.pop(0)
+            self.prefills += 1
+            req.tokens.append(entry.first_host)
+            self.tokens_emitted += 1
         if req.on_tokens is not None:
             self._fire_callbacks([(req, [entry.first_host])])
         if done:
@@ -1729,6 +1805,7 @@ class ContinuousEngine:
                 self._d_cache, entry.d_cache,
                 jnp.asarray([slot], jnp.int32))
 
+    # skylint: engine-thread
     def _finish_long_export(self, entry: _Prefilling) -> None:
         """Export retirement for a chunked long prefill. Dense engines
         serialize the scratch row directly (no slot at all); paged
@@ -1762,13 +1839,17 @@ class ContinuousEngine:
                         self._commit_prompt_blocks(slot, req.row, [])
         else:
             req.export_src = (entry.cache, 0)
-        self._prefilling.pop(0)
-        self.prefills += 1
+        with self._lock:
+            self._prefilling.pop(0)
+            self.prefills += 1
         self._export_and_retire(req, entry.first_host)
 
+    # skylint: engine-thread
     def _prefill_group(self, reqs: List[_Request],
                        slots: List[int]) -> None:
         t0 = time.perf_counter()
+        # skylint: locked(engine thread is the sole slot-table mutator;
+        # point-in-time bubble-attribution hint only)
         had_active = any(r is not None for r in self._slot_req)
         n = len(reqs)
         rows = [r.row for r in reqs]
@@ -1811,16 +1892,18 @@ class ContinuousEngine:
             cache_n = _jit_gather_prefix(
                 self._prefix_pool, np.asarray(pool_rows, np.int32),
                 np.asarray(p_lens, np.int32), cache_width)
-            self.prefix_hits += hits
-            self.prefix_hit_tokens += sum(p_lens)
+            with self._lock:
+                self.prefix_hits += hits
+                self.prefix_hit_tokens += sum(p_lens)
         else:
             cache_n = gen_lib.init_cache(self.cfg, n, cache_width,
                                          quantize=self.kv_quantize)
         logits, cache_n = gen_lib._jit_prefill(  # noqa: SLF001 — same pkg
             self.params, padded, cache_n, self.cfg,
             np.asarray(lens))
-        self.prefill_tokens += int(lens.sum())
-        self.prefill_tokens_saved += sum(p_lens)
+        with self._lock:
+            self.prefill_tokens += int(lens.sum())
+            self.prefill_tokens_saved += sum(p_lens)
         if self._prefix_pool is not None:
             self._maybe_store_prefixes(rows, p_lens, cache_n)
         tk, tp = _filters_or_none(top_ks, top_ps)
@@ -1849,6 +1932,8 @@ class ContinuousEngine:
                     self._slot_table[slots[i]] = tables_host[i].copy()
             self._cache = paged_lib.jit_insert(
                 self._cache, cache_n, tables_host,
+                # skylint: allow-host-sync(slots is a host list of slot
+                # indices — asarray builds the jit operand, no transfer)
                 np.asarray(slots, np.int32))
             self._last = self._last.at[
                 jnp.asarray(slots, jnp.int32)].set(firsts)
@@ -1885,10 +1970,13 @@ class ContinuousEngine:
                 self.draft_params, padded_f, d_cache_n,
                 self.draft_cfg, lens_f)
             self._d_cache = _jit_insert_cache(
-                self._d_cache, d_cache_n, np.asarray(slots, np.int32))
-        self.prefills += n
-        self.prefill_groups += 1
+                self._d_cache, d_cache_n,
+                # skylint: allow-host-sync(slots is a host list of slot
+                # indices — asarray builds the jit operand, no transfer)
+                np.asarray(slots, np.int32))
         with self._lock:
+            self.prefills += n
+            self.prefill_groups += 1
             self._unfetched.append((reqs, firsts))
             for i, req in enumerate(reqs):
                 if req.export and self.kv_layout != 'paged':
@@ -1901,6 +1989,7 @@ class ContinuousEngine:
                     self._slot_req[slots[i]] = req
         self._note_prefill_time(t0, had_active)
 
+    # skylint: engine-thread
     def _drain_firsts(self) -> None:
         """Materialize deferred first tokens. MUST run before a chunk's
         emission so every admitted request's token list starts with its
@@ -1912,6 +2001,9 @@ class ContinuousEngine:
         emitted: List[tuple] = []
         exports: List[tuple] = []
         for reqs, firsts in batches:
+            # skylint: allow-host-sync(designed deferred fetch point —
+            # first tokens batched per prefill group and fetched while
+            # the next chunk runs on-device, per the pipeline contract)
             firsts_host = np.asarray(jax.device_get(firsts))
             with self._lock:
                 for i, req in enumerate(reqs):
@@ -1948,6 +2040,7 @@ class ContinuousEngine:
 
     # -- disaggregated prefill/decode handoff (serve/disagg.py) -----------
 
+    # skylint: engine-thread
     def _export_and_retire(self, req: _Request, first: int) -> None:
         """Resolve an export request with its ``PrefillHandoff`` and
         free its resources (engine thread only). A failed serialization
@@ -1965,15 +2058,21 @@ class ContinuousEngine:
                     self._release_blocks(si)
                     break
         req.export_src = None  # drop the dense prefill-cache reference
-        self.export_ms += (time.perf_counter() - t0) * 1e3
+        with self._lock:
+            self.export_ms += (time.perf_counter() - t0) * 1e3
+            if handoff is not None:
+                self.exports += 1
         if handoff is None:
             if not req.future.done():
                 req.future.set_exception(err)
             return
-        self.exports += 1
         if not req.future.done():
             req.future.set_result(handoff)
 
+    # skylint: allow-host-sync(this function IS the designed device-to-
+    # host serialization surface — the KV export gathers the prompt's
+    # cache planes for the disagg handoff; runs once per export at
+    # prefill retirement, never per decode chunk)
     def _build_handoff(self, req: _Request, first: int) -> PrefillHandoff:
         n = len(req.row)
         base = dict(row=list(req.row), first=int(first),
@@ -2018,6 +2117,7 @@ class ContinuousEngine:
         return PrefillHandoff(layout='paged', block=p, n_blocks=nb,
                               k=k, v=v, k_s=k_s, v_s=v_s, **base)
 
+    # skylint: engine-thread
     def _admit_imports(self) -> None:
         """Install queued imported prompts (decode-role admission),
         FIFO. Each head needs a free slot plus its FULL block
@@ -2095,10 +2195,11 @@ class ContinuousEngine:
                 continue
             if trivial:
                 req.tokens.append(entry.first)
-                self.tokens_emitted += 1
+                with self._lock:
+                    self.tokens_emitted += 1
+                    self.imports += 1
                 if req.on_tokens is not None:
                     self._fire_callbacks([(req, [entry.first])])
-                self.imports += 1
                 if not req.future.done():
                     req.future.set_result(req.tokens)
                 continue
@@ -2118,12 +2219,14 @@ class ContinuousEngine:
                     else:
                         self.share_misses += 1
             req.tokens.append(entry.first)
-            self.tokens_emitted += 1
+            with self._lock:
+                self.tokens_emitted += 1
+                self.imports += 1
+                self.import_ms += (time.perf_counter() - t0) * 1e3
             if req.on_tokens is not None:
                 self._fire_callbacks([(req, [entry.first])])
-            self.imports += 1
-            self.import_ms += (time.perf_counter() - t0) * 1e3
 
+    # skylint: engine-thread
     def _install_import_paged(self, entry: _ImportEntry, slot: int,
                               nodes: list, table_row: np.ndarray) -> None:
         """Scatter the transferred prompt blocks into the pool and
@@ -2169,6 +2272,7 @@ class ContinuousEngine:
         self._last = self._last.at[jnp.asarray([slot], jnp.int32)].set(
             jnp.asarray([entry.first], jnp.int32))
 
+    # skylint: engine-thread
     def _install_import_dense(self, entry: _ImportEntry,
                               slot: int) -> None:
         """Dense ('slot') install: rebuild a 1-row prefill cache from
@@ -2198,6 +2302,7 @@ class ContinuousEngine:
             np.asarray([entry.first], np.int32),
             jnp.asarray([slot], jnp.int32))
 
+    # skylint: engine-thread
     def _run_spec_round(self) -> None:
         """One draft-propose / target-verify round over all slots (spec
         mode's decode step; see module docstring). Greedy slots commit
@@ -2218,7 +2323,8 @@ class ContinuousEngine:
                 top_ks[i] = r.top_k
                 top_ps[i] = r.top_p
                 active[i] = True
-        self.peak_active = max(self.peak_active, int(active.sum()))
+        with self._lock:
+            self.peak_active = max(self.peak_active, int(active.sum()))
         tk, tp = _filters_or_none(top_ks, top_ps)
         t_cache, d_cache, props, tgt, samp = _jit_spec(
             self.cfg, self.draft_cfg, k, self.params, self.draft_params,
@@ -2232,12 +2338,16 @@ class ContinuousEngine:
         # ONE fused fetch: three sequential device_gets would pay three
         # host↔device relay round trips per round; the tuple transfer
         # pays one.
+        # skylint: allow-host-sync(designed fetch point — the spec
+        # round's single fused result transfer; acceptance bookkeeping
+        # needs host values before the next round can be shaped)
         props_h, tgt_h, samp_h = (
             np.asarray(a)
             for a in jax.device_get((props, tgt, samp)))
         # props_h/tgt_h: [B, k+1]; samp_h: [B]
-        self.spec_rounds += 1
-        self.chunks_run += 1
+        with self._lock:
+            self.spec_rounds += 1
+            self.chunks_run += 1
         committed = np.ones((self.slots,), np.int32)
         new_last = tgt_h[:, 0].astype(np.int32).copy()  # junk-slot default
         done: List[_Request] = []
@@ -2293,6 +2403,7 @@ class ContinuousEngine:
             if not req.future.done():
                 req.future.set_result(req.tokens)
 
+    # skylint: engine-thread
     def _run_chunk(self) -> None:
         """Dispatch one decode chunk and retire its predecessor.
 
@@ -2313,6 +2424,7 @@ class ContinuousEngine:
         if self.pipeline_depth == 0:
             self._flush_pipeline()
 
+    # skylint: engine-thread
     def _dispatch_chunk(self) -> _Inflight:
         """Issue (async) one K-step decode chunk over ALL slots against
         the current slot snapshot. Dispatch and retirement strictly
@@ -2340,22 +2452,25 @@ class ContinuousEngine:
                 top_ks[i] = r.top_k
                 top_ps[i] = r.top_p
                 active[i] = True
-        self.peak_active = max(self.peak_active, int(active.sum()))
-        tk, tp = _filters_or_none(top_ks, top_ps)
         now = time.perf_counter()
-        if self._last_dispatch_t is not None:
-            # Gaps across quiet stretches are excluded (the baseline is
-            # nulled in _note_decode_quiet), so the mean divides by the
-            # gaps actually recorded, not dispatches - 1.
-            self._gap_ms_total += (now - self._last_dispatch_t) * 1e3
-            self._gap_count += 1
-        self._last_dispatch_t = now
-        if self._no_flight_since is not None:
-            # Host time spent with slots waiting and nothing on the
-            # device: the serial-mode bubble pipelining closes.
-            self.bubble_ms += (now - self._no_flight_since) * 1e3
-            self._no_flight_since = None
-        self.dispatches += 1
+        with self._lock:
+            self.peak_active = max(self.peak_active, int(active.sum()))
+            if self._last_dispatch_t is not None:
+                # Gaps across quiet stretches are excluded (the
+                # baseline is nulled in _note_decode_quiet), so the
+                # mean divides by the gaps actually recorded, not
+                # dispatches - 1.
+                self._gap_ms_total += (now - self._last_dispatch_t) \
+                    * 1e3
+                self._gap_count += 1
+            self._last_dispatch_t = now
+            if self._no_flight_since is not None:
+                # Host time spent with slots waiting and nothing on the
+                # device: the serial-mode bubble pipelining closes.
+                self.bubble_ms += (now - self._no_flight_since) * 1e3
+                self._no_flight_since = None
+            self.dispatches += 1
+        tk, tp = _filters_or_none(top_ks, top_ps)
         if self.kv_layout == 'paged':
             self._cache, self._last, toks = _jit_paged_chunk(
                 self.cfg, self.chunk_steps, self.params, self._cache,
@@ -2368,6 +2483,7 @@ class ContinuousEngine:
                 np.asarray(active), self._next_key(), self._shard_ctx)
         return _Inflight(reqs=reqs, toks=toks, steps=self.chunk_steps)
 
+    # skylint: engine-thread
     def _note_decode_quiet(self) -> None:
         """The decode pipeline went quiet (no active slot): stop the
         bubble clock — idle waiting and prefill-only compute are not
@@ -2377,6 +2493,7 @@ class ContinuousEngine:
         self._no_flight_since = None
         self._last_dispatch_t = None
 
+    # skylint: engine-thread
     def _flush_pipeline(self, quiet: bool = False) -> None:
         """Retire the in-flight chunk (if any) and mark the device
         idle-with-host-working so time until the next dispatch counts
@@ -2390,6 +2507,7 @@ class ContinuousEngine:
         if self._no_flight_since is None:
             self._no_flight_since = time.perf_counter()
 
+    # skylint: engine-thread
     def _retire_chunk(self, flight: _Inflight,
                       quiet: bool = False) -> None:
         """Fetch a dispatched chunk's tokens and run all host-side
@@ -2401,9 +2519,13 @@ class ContinuousEngine:
         # token (and a first-token-eos resolved here frees its slot
         # before this chunk's junk for it could be appended).
         self._drain_firsts()
+        # skylint: allow-host-sync(designed fetch point — THE chunk
+        # result transfer; under pipelining it lands while the next
+        # chunk computes, which is the whole overlap design)
         toks_host = np.asarray(jax.device_get(flight.toks))  # [K, B]
         t0 = time.perf_counter()
-        self.chunks_run += 1
+        with self._lock:
+            self.chunks_run += 1
         done: List[_Request] = []
         emitted: List[tuple] = []
         with self._lock:
@@ -2438,9 +2560,11 @@ class ContinuousEngine:
             if not req.future.done():
                 req.future.set_result(req.tokens)
         dt_ms = (time.perf_counter() - t0) * 1e3
-        if self._inflight is not None:
-            self.host_overlap_ms += dt_ms  # a chunk computed meanwhile
-        elif not quiet:
-            self.bubble_ms += dt_ms  # serial: the device sat idle
+        with self._lock:
+            if self._inflight is not None:
+                # a chunk computed meanwhile
+                self.host_overlap_ms += dt_ms
+            elif not quiet:
+                self.bubble_ms += dt_ms  # serial: the device sat idle
         # quiet flush: junk-only drop with no decode work waiting —
         # neither overlap nor bubble.

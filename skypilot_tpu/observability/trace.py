@@ -123,6 +123,8 @@ class _Tracer:
     In-flight spans accumulate on their root span's ``bucket`` (no
     global live table — see Span.bucket)."""
 
+    _GUARDED_BY = {'_ring': '_lock'}
+
     def __init__(self):
         self._lock = threading.Lock()
         self._ring: collections.deque = collections.deque(

@@ -260,12 +260,16 @@ def check_compat(header: Dict[str, Any], *, model: str, kv_cache: str,
 
 class HandoffRegistry:
 
+    _GUARDED_BY = {'_entries': '_lock', 'expired': '_lock'}
+
     def __init__(self, ttl_s: float = DEFAULT_TTL_S):
         self.ttl_s = ttl_s
         self._lock = threading.Lock()
         self._entries: Dict[str, Tuple[float, Any]] = {}
         self.expired = 0
 
+    # skylint: locked(the _locked suffix contract — put/pop sweep under
+    # their own `with self._lock`)
     def _sweep_locked(self, now: float) -> None:
         dead = [hid for hid, (exp, _) in self._entries.items()
                 if exp < now]

@@ -41,6 +41,12 @@ class _HandoffFailed(Exception):
 
 class LoadBalancer:
 
+    # Request-time buckets and the handoff counters cross threads: the
+    # LB's private event loop writes them while the controller thread
+    # (autoscaler drain, /health mirror) and probes read them.
+    _GUARDED_BY = {'_times': '_times_lock',
+                   'disagg_stats': '_stats_lock'}
+
     def __init__(self, port: int, policy: str = 'least_load'):
         self.port = port
         self._policy_name = policy
@@ -57,6 +63,11 @@ class LoadBalancer:
         # a pool, which dual-pool autoscaling needs).
         self._times: Dict[str, List[float]] = {}
         self._times_lock = threading.Lock()
+        # skylint finding (guarded-by): these counters were incremented
+        # on the event-loop thread and read bare by the controller /
+        # probes; int += is a read-modify-write, so a torn interleave
+        # undercounts handoffs exactly when the probe gates on them.
+        self._stats_lock = threading.Lock()
         self.disagg_stats = {'handoffs': 0, 'fallbacks': 0,
                              'resumed_streams': 0}
         self._runner: Optional[web.AppRunner] = None
@@ -247,7 +258,8 @@ class LoadBalancer:
                                 raise _HandoffFailed(
                                     f'import {r.status}: '
                                     f'{payload[:200]!r}')
-                        self.disagg_stats['handoffs'] += 1
+                        with self._stats_lock:
+                            self.disagg_stats['handoffs'] += 1
                         return web.Response(
                             status=200, body=payload,
                             headers={'X-Served-By': decode,
@@ -355,7 +367,8 @@ class LoadBalancer:
                         prepared = True
                     await resp.write(line)
                     if obj.get('done'):
-                        self.disagg_stats['handoffs'] += 1
+                        with self._stats_lock:
+                            self.disagg_stats['handoffs'] += 1
                         await resp.write_eof()
                         return resp
                     sent += len(obj.get('tokens') or [])
@@ -377,8 +390,9 @@ class LoadBalancer:
         """Re-serve the request whole on a surviving replica and
         forward only the tokens past ``sent`` — the mid-stream
         colocated fallback."""
-        self.disagg_stats['fallbacks'] += 1
-        self.disagg_stats['resumed_streams'] += 1
+        with self._stats_lock:
+            self.disagg_stats['fallbacks'] += 1
+            self.disagg_stats['resumed_streams'] += 1
         replica = self._select_fallback(exclude)
         if replica is None:
             with contextlib.suppress(Exception):
@@ -447,7 +461,8 @@ class LoadBalancer:
                 {'error': 'No ready replicas.'}, status=503)
         headers = self._fwd_headers(request)
         if fallback:
-            self.disagg_stats['fallbacks'] += 1
+            with self._stats_lock:
+                self.disagg_stats['fallbacks'] += 1
             headers['X-SkyTPU-Disagg-Fallback'] = '1'
         self._note_request(replica)
         self.policy.on_request_start(replica)

@@ -9,6 +9,8 @@ from typing import Dict, List, Optional
 
 class LoadBalancingPolicy:
 
+    _GUARDED_BY = {'replicas': '_lock'}
+
     def __init__(self):
         self._lock = threading.Lock()
         self.replicas: List[str] = []
@@ -28,6 +30,10 @@ class LoadBalancingPolicy:
 
 
 class RoundRobinPolicy(LoadBalancingPolicy):
+
+    # _GUARDED_BY is re-stated per class: the checker is deliberately
+    # inheritance-blind (a subclass may swap the locking scheme).
+    _GUARDED_BY = {'replicas': '_lock', '_idx': '_lock'}
 
     def __init__(self):
         super().__init__()
@@ -62,6 +68,9 @@ class LeastLoadPolicy(LoadBalancingPolicy):
     reported queue pressure; ties are broken by rotation so sequential
     (zero-load) traffic still spreads."""
 
+    _GUARDED_BY = {'replicas': '_lock', '_inflight': '_lock',
+                   '_pressure': '_lock', '_rotation': '_lock'}
+
     def __init__(self):
         super().__init__()
         self._inflight: Dict[str, int] = {}
@@ -87,6 +96,7 @@ class LeastLoadPolicy(LoadBalancingPolicy):
             self._pressure = {k: max(float(v), 0.0)
                               for k, v in pressure.items()}
 
+    # skylint: locked(called only from select, under `with self._lock`)
     def _load(self, r: str) -> float:
         return self._inflight.get(r, 0) + self._pressure.get(r, 0.0)
 
@@ -115,6 +125,10 @@ class InstanceAwareLeastLoadPolicy(LeastLoadPolicy):
     (twice the chips) keeps receiving traffic until it carries twice a
     weight-1 replica's load (reference:
     ``sky/serve/load_balancing_policies.py:151``)."""
+
+    _GUARDED_BY = {'replicas': '_lock', '_inflight': '_lock',
+                   '_pressure': '_lock', '_rotation': '_lock',
+                   '_weights': '_lock'}
 
     def __init__(self):
         super().__init__()

@@ -366,6 +366,10 @@ class QosScheduler:
     queue -> dispatch gate. All mutation happens on the server's event
     loop (handlers and the sweeper); only counters cross threads."""
 
+    _GUARDED_BY = {'_admitted': '_lock', '_shed': '_lock',
+                   '_evicted': '_lock', '_waits': '_lock',
+                   '_tok_events': '_lock'}
+
     def __init__(self, *, max_inflight: int,
                  weights: Optional[Dict[str, float]] = None,
                  max_queue: Optional[int] = None,
@@ -412,6 +416,9 @@ class QosScheduler:
         self._inflight = 0.0
         self._sweeper: Optional[asyncio.Task] = None
         self._lock = threading.Lock()  # counters / wait samples only
+        # (_wfq/_buckets/_inflight are event-loop-confined — admission
+        # runs only on the asyncio loop thread; the lock exists because
+        # stats() is called from the health-endpoint thread.)
         self._admitted = {c: 0 for c in CLASSES}
         self._shed = {c: 0 for c in CLASSES}
         self._evicted = {c: 0 for c in CLASSES}

@@ -80,6 +80,8 @@ class SpmdEngine(ContinuousEngine):
     module docstring. Construct identically on every rank (same seed,
     same knobs) — the head additionally serves submit()/HTTP."""
 
+    _GUARDED_BY = {'_incoming': '_incoming_lock'}
+
     def __init__(self, *args, **kw):
         import jax
         self.rank = jax.process_index()

@@ -29,6 +29,7 @@ _MAX_SAMPLES = int(os.environ.get('SKYTPU_METRICS_HISTORY_SAMPLES', '960'))
 
 _lock = threading.Lock()
 _samples: Deque[Dict[str, Any]] = collections.deque(maxlen=_MAX_SAMPLES)
+_GUARDED_BY = {'_samples': '_lock'}
 
 
 def sample_once(record: bool = True) -> Dict[str, Any]:

@@ -45,6 +45,8 @@ from skypilot_tpu import exceptions
 # double-minted token), not race the dict.
 _PENDING: Dict[str, tuple] = {}
 _PENDING_LOCK = threading.Lock()
+_GUARDED_BY = {'_PENDING': '_PENDING_LOCK',
+               '_START_TIMES': '_PENDING_LOCK'}
 _DISCOVERY_CACHE: Dict[str, Dict[str, Any]] = {}
 # /oauth/login/start is UNAUTHENTICATED by necessity (it's the login
 # bootstrap): bound both the server-side pending state and the
@@ -94,12 +96,17 @@ def start_device_flow() -> Dict[str, Any]:
     user plus the opaque ``handle`` it polls with."""
     import requests
     now = time.time()
-    _START_TIMES[:] = [t for t in _START_TIMES
-                       if now - t < _START_WINDOW_S]
-    if len(_START_TIMES) >= _MAX_STARTS_PER_WINDOW:
-        raise exceptions.SkyTpuError(
-            'too many login attempts; try again in a minute')
-    _START_TIMES.append(now)
+    # Trim + check + append under the lock: start handlers run on
+    # executor threads, and an unlocked read-modify-write here let
+    # concurrent starts slip past the window cap (skylint guarded-by
+    # caught the bare mutation).
+    with _PENDING_LOCK:
+        _START_TIMES[:] = [t for t in _START_TIMES
+                           if now - t < _START_WINDOW_S]
+        if len(_START_TIMES) >= _MAX_STARTS_PER_WINDOW:
+            raise exceptions.SkyTpuError(
+                'too many login attempts; try again in a minute')
+        _START_TIMES.append(now)
     doc = _discover()
     resp = requests.post(doc['device_authorization_endpoint'],
                          data={**_client_auth(),
@@ -121,6 +128,8 @@ def start_device_flow() -> Dict[str, Any]:
         for h in [h for h, (_, exp) in _PENDING.items() if exp < now]:
             del _PENDING[h]
         while len(_PENDING) > _MAX_PENDING:
+            # skylint: locked(the key lambda runs synchronously inside
+            # min, still under the enclosing _PENDING_LOCK scope)
             oldest = min(_PENDING, key=lambda h: _PENDING[h][1])
             del _PENDING[oldest]
     return {

@@ -19,6 +19,7 @@ from typing import Callable, List, Optional, Union
 _ENV_VAR = 'SKYTPU_TIMELINE_FILE_PATH'
 _events: List[dict] = []
 _lock = threading.Lock()
+_GUARDED_BY = {'_events': '_lock'}
 
 
 def _enabled() -> bool:
@@ -88,6 +89,8 @@ def event(name_or_fn: Union[str, Callable], message: Optional[str] = None):
 
 def save_timeline() -> None:
     path = os.environ.get(_ENV_VAR)
+    # skylint: locked(emptiness peek — a racing append re-checks under
+    # the lock below; worst case is one benign extra snapshot)
     if not path or not _events:
         return
     with _lock:

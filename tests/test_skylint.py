@@ -1,0 +1,414 @@
+"""skylint: one seeded violation + one annotated suppression per rule,
+the env-flag typo case, and the PR 7 regression re-introduction proof.
+
+jax-free (pure AST analysis) so the whole suite stays in the fast tier.
+"""
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / 'tools'))
+
+import skylint  # noqa: E402
+from skylint.checkers import base as base_mod  # noqa: E402
+from skylint.checkers import engine_thread  # noqa: E402
+from skylint.checkers import env_flags as env_mod  # noqa: E402
+from skylint.checkers import host_sync  # noqa: E402
+from skylint.checkers import lock_discipline  # noqa: E402
+from skylint.checkers import metric_names  # noqa: E402
+from skylint.checkers import pycache as pycache_mod  # noqa: E402
+
+
+def _sf(tmp_path, code, name='fixture.py', rel_root=None):
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(code), encoding='utf-8')
+    return skylint.SourceFile(p, rel_root or tmp_path)
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# -- (1) lock discipline -----------------------------------------------------
+
+
+def test_guarded_by_flags_unlocked_access(tmp_path):
+    sf = _sf(tmp_path, '''
+        class Engine:
+            _GUARDED_BY = {'_requests': '_lock'}
+
+            def bad(self):
+                self._requests.append(1)
+
+            def good(self):
+                with self._lock:
+                    self._requests.append(1)
+        ''')
+    findings = lock_discipline.LockDiscipline().check_file(sf)
+    assert len(findings) == 1
+    assert findings[0].rule == 'guarded-by'
+    assert '_requests' in findings[0].message
+    # the finding is in bad(), not good()
+    assert sf.lines[findings[0].line - 1].strip() == \
+        'self._requests.append(1)'
+    assert findings[0].line < sf.text.index('def good')
+
+
+def test_guarded_by_locked_suppression_and_reason_required(tmp_path):
+    sf = _sf(tmp_path, '''
+        class Engine:
+            _GUARDED_BY = {'_n': '_lock'}
+
+            # skylint: locked(callers hold _lock per the docstring)
+            def bump_locked(self):
+                self._n += 1
+
+            def peek(self):
+                return self._n  # skylint: locked(single-writer read)
+        ''')
+    assert lock_discipline.LockDiscipline().check_file(sf) == []
+    # A reasonless suppression is itself a finding (base checker).
+    sf2 = _sf(tmp_path, '''
+        class Engine:
+            _GUARDED_BY = {'_n': '_lock'}
+
+            # skylint: locked()
+            def bump_locked(self):
+                self._n += 1
+        ''', name='reasonless.py')
+    ann = base_mod.Annotations().check_file(sf2)
+    assert any(f.rule == 'annotation' and 'reason' in f.message
+               for f in ann)
+
+
+def test_guarded_by_per_assignment_comment_form(tmp_path):
+    sf = _sf(tmp_path, '''
+        class Engine:
+            def __init__(self):
+                self._q = []  # skylint: guarded-by=_lock
+
+            def bad(self):
+                self._q.pop()
+        ''')
+    findings = lock_discipline.LockDiscipline().check_file(sf)
+    assert _rules(findings) == ['guarded-by']
+
+
+def test_guarded_by_nested_def_does_not_inherit_lock(tmp_path):
+    # A closure may run after the with-block releases the lock.
+    sf = _sf(tmp_path, '''
+        class Engine:
+            _GUARDED_BY = {'_q': '_lock'}
+
+            def sched(self):
+                with self._lock:
+                    def cb():
+                        self._q.pop()
+                    return cb
+        ''')
+    findings = lock_discipline.LockDiscipline().check_file(sf)
+    assert _rules(findings) == ['guarded-by']
+
+
+def test_guarded_by_module_level(tmp_path):
+    sf = _sf(tmp_path, '''
+        import threading
+        _lock = threading.Lock()
+        _samples = []
+        _GUARDED_BY = {'_samples': '_lock'}
+
+        def bad():
+            _samples.append(1)
+
+        def good():
+            with _lock:
+                _samples.append(1)
+        ''')
+    findings = lock_discipline.LockDiscipline().check_file(sf)
+    assert _rules(findings) == ['guarded-by']
+
+
+# -- (2) engine-thread raise safety ------------------------------------------
+
+
+ENGINE_FIXTURE = '''
+    class Engine:
+        # skylint: engine-thread
+        def _retire(self, req):
+            if req is None:
+                raise ValueError('no request')   # escapes -> finding
+
+        # skylint: engine-thread
+        def _retire_contained(self, req):
+            try:
+                if req is None:
+                    raise ValueError('no request')
+            except Exception:
+                self._fail_one(req)
+
+        # skylint: engine-thread
+        def _invariant(self, req):
+            # skylint: allow-raise(corrupt slot table: every stream is
+            # already poisoned, nuking them IS the correct blast radius)
+            raise RuntimeError('slot table corrupt')
+
+        def _http_surface(self, req):
+            raise ValueError('fine: not an engine-thread function')
+    '''
+
+
+def test_engine_raise_seeded_violation_and_suppressions(tmp_path):
+    sf = _sf(tmp_path, ENGINE_FIXTURE)
+    findings = engine_thread.EngineThreadRaise().check_file(sf)
+    assert len(findings) == 1
+    assert findings[0].rule == 'engine-raise'
+    assert '_retire' in findings[0].message
+    assert '_fail_everything' in findings[0].message
+
+
+def test_engine_raise_handler_body_not_protected(tmp_path):
+    sf = _sf(tmp_path, '''
+        # skylint: engine-thread
+        def _step():
+            try:
+                pass
+            except Exception:
+                raise RuntimeError('re-raise escapes the engine loop')
+        ''')
+    findings = engine_thread.EngineThreadRaise().check_file(sf)
+    assert _rules(findings) == ['engine-raise']
+
+
+def test_pr7_regression_reintroduced_is_caught(tmp_path):
+    """Re-introduce the PR 7 bug — a shape-skew raise on the
+    engine-thread install path of the REAL engine.py — and prove the
+    unmodified rule set catches it (acceptance criterion)."""
+    src = (REPO / 'skypilot_tpu/models/engine.py').read_text(
+        encoding='utf-8')
+    marker = '    def _install_import_paged(self, entry: _ImportEntry,'
+    assert marker in src, 'engine.py install surface moved'
+    # Clean copy: no engine-raise findings today.
+    clean = _sf(tmp_path, src, name='engine_clean.py')
+    checker = engine_thread.EngineThreadRaise()
+    assert [f for f in checker.check_file(clean)
+            if f.rule == 'engine-raise'] == []
+    # Put the synchronous validation back where PR 7 removed it from:
+    # inside the engine-thread install, raising instead of 400-ing.
+    lines = src.splitlines(keepends=True)
+    at = next(i for i, ln in enumerate(lines) if marker in ln)
+    body = next(i for i in range(at + 1, len(lines))
+                if lines[i].strip().startswith('from skypilot_tpu'))
+    lines.insert(body + 1, (
+        '        if entry.k is not None and entry.k.shape[0] != '
+        'self.cfg.n_layers:\n'
+        "            raise ValueError('shape-skewed import payload')\n"))
+    bugged = _sf(tmp_path, ''.join(lines), name='engine_bugged.py')
+    findings = [f for f in checker.check_file(bugged)
+                if f.rule == 'engine-raise']
+    assert len(findings) == 1
+    assert '_install_import_paged' in findings[0].message
+
+
+# -- (3) host-sync in hot path -----------------------------------------------
+
+
+def test_host_sync_seeded_violation_and_suppression(tmp_path):
+    sf = _sf(tmp_path, '''
+        class Engine:
+            # skylint: hot-path
+            def _loop(self):
+                self._step()
+
+            def _step(self):
+                n = self._count.item()        # sync inside the closure
+                # skylint: allow-host-sync(designed fetch point)
+                toks = jax.device_get(self._toks)
+                return n, toks
+        ''')
+    findings = host_sync.HostSync().check_file(sf)
+    assert len(findings) == 1
+    assert findings[0].rule == 'host-sync'
+    assert '.item()' in findings[0].message
+    assert '_step' in findings[0].message  # reached transitively
+
+
+def test_host_sync_jit_scope_and_host_locals_exempt(tmp_path):
+    sf = _sf(tmp_path, '''
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def _kernel(x):
+            return jax.device_get(x)    # sync under trace -> finding
+
+        def _cold(x):
+            buf = np.zeros((4,))
+            a = np.asarray(buf)         # host local: exempt
+            b = np.asarray([1, 2, 3])   # literal: exempt
+            return a, b, x.item()       # not hot, not jit: no finding
+        ''')
+    findings = host_sync.HostSync().check_file(sf)
+    assert len(findings) == 1
+    assert '_kernel' in findings[0].message
+    assert 'jit' in findings[0].message
+
+
+def test_host_sync_function_level_allow(tmp_path):
+    sf = _sf(tmp_path, '''
+        class Engine:
+            # skylint: hot-path
+            def _loop(self):
+                self._export()
+
+            # skylint: allow-host-sync(whole function is the designed
+            # serialization surface)
+            def _export(self):
+                return jax.device_get(self._cache)
+        ''')
+    assert host_sync.HostSync().check_file(sf) == []
+
+
+# -- (4) env-flag registry ---------------------------------------------------
+
+
+def test_env_flag_typo_is_caught_with_hint(tmp_path):
+    sf = _sf(tmp_path, '''
+        import os
+        v = os.environ.get('SKYTPU_LLM_PIPLINE', '1')
+        ''')
+    findings = env_mod.EnvFlags().check_file(sf)
+    assert len(findings) == 1
+    assert findings[0].rule == 'env-flag'
+    # skylint: allow-env(the deliberate typo this test seeds)
+    assert 'SKYTPU_LLM_PIPLINE' in findings[0].message
+    assert 'SKYTPU_LLM_PIPELINE' in findings[0].message  # typo hint
+
+
+def test_env_flag_declared_ok_and_allow_env(tmp_path):
+    sf = _sf(tmp_path, '''
+        import os
+        a = os.environ.get('SKYTPU_LLM_PIPELINE', '1')
+        # skylint: allow-env(fixture flag for this very test)
+        b = os.environ.get('SKYTPU_NOT_A_REAL_FLAG')
+        ''')
+    assert env_mod.EnvFlags().check_file(sf) == []
+
+
+def test_env_flag_registry_has_no_dead_flags():
+    """Every declared flag is read somewhere in the real tree (the
+    tree-wide direction of the checker, against the live registry)."""
+    files = skylint.load_files()
+    findings = env_mod.EnvFlags().check_tree(files, skylint.ROOT)
+    assert findings == [], '\n'.join(str(f) for f in findings)
+
+
+# -- (5) metric-name cross-check ---------------------------------------------
+
+
+def test_metric_defined_outside_registry_flagged(tmp_path):
+    sf = _sf(tmp_path, '''
+        from prometheus_client import Gauge
+        G = Gauge('skytpu_rogue_series', 'defined outside metrics.py')
+        ''')
+    findings = metric_names.MetricNames().check_file(sf)
+    assert _rules(findings) == ['metric-name']
+    assert 'skytpu_rogue_series' in findings[0].message
+
+
+def test_metric_unknown_reference_in_serve_scope(tmp_path):
+    sf = _sf(tmp_path / 'skypilot_tpu' / 'serve', '''
+        NAME = 'skytpu_series_nobody_defined'
+        ''', name='fake.py', rel_root=tmp_path)
+    findings = metric_names.MetricNames().check_tree([sf], REPO)
+    mine = [f for f in findings if f.path == sf.rel]
+    assert len(mine) == 1
+    assert 'skytpu_series_nobody_defined' in mine[0].message
+
+
+def test_metric_cross_check_clean_on_real_tree():
+    files = skylint.load_files()
+    findings = metric_names.MetricNames().check_tree(files, skylint.ROOT)
+    assert findings == [], '\n'.join(str(f) for f in findings)
+
+
+# -- tracked-pycache ---------------------------------------------------------
+
+
+def test_pycache_gitignore_patterns_required(tmp_path):
+    # Bare dir (no .gitignore): both required patterns are findings.
+    findings = pycache_mod.TrackedPycache().check_tree([], tmp_path)
+    msgs = ' '.join(f.message for f in findings)
+    assert '__pycache__/' in msgs and '*.pyc' in msgs
+    # Covering .gitignore: clean.
+    (tmp_path / '.gitignore').write_text('__pycache__/\n*.pyc\n')
+    assert pycache_mod.TrackedPycache().check_tree([], tmp_path) == []
+
+
+def test_no_tracked_bytecode_in_repo():
+    findings = pycache_mod.TrackedPycache().check_tree([], REPO)
+    assert findings == [], '\n'.join(str(f) for f in findings)
+
+
+# -- annotations are part of the contract ------------------------------------
+
+
+def test_unknown_directive_is_a_finding(tmp_path):
+    sf = _sf(tmp_path, 'x = 1  # skylint: gaurded-by=_lock\n')
+    findings = base_mod.Annotations().check_file(sf)
+    assert _rules(findings) == ['annotation']
+    assert 'gaurded-by' in findings[0].message
+
+
+def test_multiline_comment_block_reason_parses(tmp_path):
+    sf = _sf(tmp_path, '''
+        class Engine:
+            _GUARDED_BY = {'_n': '_lock'}
+
+            # skylint: locked(a reason long enough that it wraps across
+            # two comment lines and must still parse as one directive)
+            def bump_locked(self):
+                self._n += 1
+        ''')
+    assert base_mod.Annotations().check_file(sf) == []
+    assert lock_discipline.LockDiscipline().check_file(sf) == []
+
+
+# -- driver / CI gate --------------------------------------------------------
+
+
+def test_cli_exit_codes(tmp_path):
+    from skylint import cli
+    bad = tmp_path / 'bad.py'
+    bad.write_text(textwrap.dedent('''
+        class Engine:
+            _GUARDED_BY = {'_n': '_lock'}
+
+            def bump(self):
+                self._n += 1
+        '''), encoding='utf-8')
+    assert cli.main([str(bad)]) == 1
+    good = tmp_path / 'good.py'
+    good.write_text('x = 1\n', encoding='utf-8')
+    assert cli.main([str(good)]) == 0
+
+
+@pytest.mark.slow
+def test_full_suite_zero_findings():
+    """`make lint` parity: the committed tree is finding-free."""
+    findings, nfiles = skylint.run()
+    assert nfiles > 100
+    assert findings == [], '\n'.join(str(f) for f in findings)
+
+
+def test_changed_mode_runs(tmp_path):
+    """--changed never crashes outside a work tree and lints nothing."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / 'tools' / 'lint.py'), '--changed'],
+        cwd=tmp_path, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    assert '0 finding(s)' in proc.stdout

@@ -1,0 +1,89 @@
+"""Generate docs/env_flags.md from the skypilot_tpu/env_flags.py
+registry — the doc is a build artifact, so docs and registry cannot
+drift.
+
+``python tools/gen_flag_docs.py``          rewrite docs/env_flags.md
+``python tools/gen_flag_docs.py --check``  fail (exit 1) when the
+                                           committed doc is stale —
+                                           runs under `make lint`
+
+The registry module is loaded standalone (it is import-light by
+design), never through the skypilot_tpu package import.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+REGISTRY = ROOT / 'skypilot_tpu' / 'env_flags.py'
+DOC = ROOT / 'docs' / 'env_flags.md'
+
+HEADER = """\
+# Environment flags
+
+<!-- GENERATED FILE — do not edit. Regenerate with
+     `python tools/gen_flag_docs.py`; `make lint` fails when this file
+     drifts from skypilot_tpu/env_flags.py. -->
+
+Every `SKYTPU_*` flag the tree reads, from the single registry
+`skypilot_tpu/env_flags.py` (skylint's env-flag checker fails CI on any
+read of an undeclared name and on declared-but-never-read flags).
+Booleans follow the env-string convention — unset/``''``/``'0'``/
+``'off'`` is false — unless a flag's doc says otherwise. *(unset)*
+means the code path treats absence as "feature off" or auto-detects.
+"""
+
+
+def _load_registry():
+    spec = importlib.util.spec_from_file_location('skytpu_env_flags',
+                                                  REGISTRY)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclass processing resolves string annotations through
+    # sys.modules[cls.__module__] — register before exec.
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def render() -> str:
+    mod = _load_registry()
+    lines = [HEADER]
+    lines.append(f'\n{len(mod.FLAGS)} flags.\n')
+    lines.append('\n| flag | type | default | what it does |')
+    lines.append('|------|------|---------|--------------|')
+    for flag in mod.FLAGS:
+        default = (f'`{flag.default}`' if flag.default is not None
+                   else '*(unset)*')
+        doc = flag.doc.replace('|', '\\|')
+        lines.append(f'| `{flag.name}` | {flag.type} | {default} '
+                     f'| {doc} |')
+    lines.append('')
+    return '\n'.join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument('--check', action='store_true',
+                        help='verify docs/env_flags.md is current')
+    args = parser.parse_args(argv)
+    want = render()
+    if args.check:
+        have = DOC.read_text(encoding='utf-8') if DOC.is_file() else ''
+        if have != want:
+            print('docs/env_flags.md is stale — run '
+                  '`python tools/gen_flag_docs.py` and commit the '
+                  'result', file=sys.stderr)
+            return 1
+        print(f'docs/env_flags.md is current '
+              f'({len(_load_registry().FLAGS)} flags)')
+        return 0
+    DOC.write_text(want, encoding='utf-8')
+    print(f'wrote {DOC.relative_to(ROOT)}')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

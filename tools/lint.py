@@ -1,99 +1,20 @@
-"""Minimal lint for CI (`make lint`).
+"""CI lint gate (`make lint`): drives the skylint suite.
 
-No third-party linters ship in this image, so this covers the checks that
-catch real regressions cheaply: every file compiles, no debugger
-artifacts, no syntax-level unused-import noise in NEW code paths via AST
-(import-and-never-referenced at module scope).
+The original minimal checks (compile, debugger artifacts, unused
+imports) moved into ``tools/skylint/checkers/base.py``; the suite adds
+the project-contract rules — lock discipline, engine-thread raise
+safety, host-sync-in-hot-path, the SKYTPU_* env-flag registry, the
+skytpu_* metric-name cross-check, and git bytecode hygiene. See
+docs/development.md §Static analysis.
 """
 from __future__ import annotations
 
-import ast
 import pathlib
 import sys
 
-ROOT = pathlib.Path(__file__).resolve().parent.parent
-TARGETS = ('skypilot_tpu', 'tests', 'tools', 'bench.py',
-           '__graft_entry__.py')
-BANNED_CALLS = {'breakpoint'}
-BANNED_IMPORTS = {'pdb', 'ipdb'}
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
 
-
-def _py_files():
-    for t in TARGETS:
-        p = ROOT / t
-        if p.is_file():
-            yield p
-        else:
-            yield from sorted(p.rglob('*.py'))
-
-
-def _used_names(tree: ast.AST) -> set:
-    used = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            cur = node
-            while isinstance(cur, ast.Attribute):
-                cur = cur.value
-            if isinstance(cur, ast.Name):
-                used.add(cur.id)
-    return used
-
-
-def lint_file(path: pathlib.Path) -> list:
-    errors = []
-    src = path.read_text(encoding='utf-8')
-    try:
-        tree = ast.parse(src, filename=str(path))
-    except SyntaxError as e:
-        return [f'{path}:{e.lineno}: syntax error: {e.msg}']
-    used = _used_names(tree)
-    has_all = any(
-        isinstance(n, ast.Assign) and any(
-            isinstance(t, ast.Name) and t.id == '__all__' for t in n.targets)
-        for n in tree.body)
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call) and \
-                isinstance(node.func, ast.Name) and \
-                node.func.id in BANNED_CALLS:
-            errors.append(f'{path}:{node.lineno}: banned call '
-                          f'{node.func.id}()')
-        if isinstance(node, (ast.Import, ast.ImportFrom)):
-            mod = getattr(node, 'module', None) or ''
-            names = {a.name.split('.')[0] for a in node.names}
-            if (mod.split('.')[0] in BANNED_IMPORTS or
-                    names & BANNED_IMPORTS):
-                errors.append(f'{path}:{node.lineno}: debugger import')
-    # Unused module-scope imports (skip __init__.py re-exports and files
-    # declaring __all__).
-    if path.name != '__init__.py' and not has_all:
-        for node in tree.body:
-            if isinstance(node, (ast.Import, ast.ImportFrom)):
-                if isinstance(node, ast.ImportFrom) and \
-                        node.module in (None, '__future__'):
-                    continue
-                for alias in node.names:
-                    if alias.name == '*':
-                        continue
-                    bound = (alias.asname or alias.name).split('.')[0]
-                    if bound not in used:
-                        errors.append(
-                            f'{path}:{node.lineno}: unused import '
-                            f'{bound!r}')
-    return errors
-
-
-def main() -> int:
-    errors = []
-    for path in _py_files():
-        errors.extend(lint_file(path))
-    for e in errors:
-        print(e)
-    print(f'lint: {len(errors)} finding(s) over '
-          f'{sum(1 for _ in _py_files())} files')
-    return 1 if errors else 0
-
+from skylint.cli import main  # noqa: E402
 
 if __name__ == '__main__':
-    sys.exit(main())
+    sys.exit(main(sys.argv[1:]))

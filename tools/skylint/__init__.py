@@ -1,0 +1,281 @@
+"""skylint: project-specific static analysis for the skypilot_tpu tree.
+
+The codebase's correctness rests on conventions that used to live only in
+review lore: guarded state is touched under its lock, nothing raises on
+the engine loop thread, no host sync inside the pipelined decode
+dispatch path, every SKYTPU_* env flag is declared in the registry, and
+every skytpu_* metric name referenced anywhere is defined in
+``server/metrics.py``. skylint machine-checks those conventions in CI.
+
+Dependency-free by design (stdlib ``ast`` + ``tokenize`` only — no
+third-party linters ship in this image). Checkers are pluggable:
+subclass :class:`Checker`, decorate with :func:`register`, and import
+the module from ``skylint.checkers``.
+
+Annotation / suppression syntax (ordinary ``#`` comments; a directive
+applies to its own line, or to the next line when it sits alone on a
+line — e.g. above a ``def``):
+
+== ======================================= ==============================
+rule  annotation                            meaning
+== ======================================= ==============================
+guarded-by   ``_GUARDED_BY = {'_x': '_lock'}``  class/module attr is
+                                               touched only under lock
+guarded-by   ``# skylint: guarded-by=_lock``    same, per-assignment form
+guarded-by   ``# skylint: locked(reason)``      def: callers hold the
+                                               lock; line: access is safe
+engine-raise ``# skylint: engine-thread``       def runs on the engine
+                                               loop thread (no raises)
+engine-raise ``# skylint: allow-raise(reason)`` suppress one raise
+host-sync    ``# skylint: hot-path``            decode-dispatch root
+host-sync    ``# skylint: allow-host-sync(r)``  suppress one sync site
+env-flag     ``# skylint: allow-env(reason)``   suppress one env literal
+metric-name  ``# skylint: allow-metric(r)``     suppress one metric ref
+== ======================================= ==============================
+
+Every suppression MUST carry a non-empty human-readable reason; a bare
+``locked()`` is itself a finding. See docs/development.md §Static
+analysis for the checker catalog and how to add a checker.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import pathlib
+import re
+import tokenize
+from typing import Dict, List, Optional, Sequence, Tuple
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent.parent
+# What `make lint` walks. examples/ is text-scanned by the env-flag
+# checker for flag liveness but not AST-linted (notebook-style scripts).
+TARGETS = ('skypilot_tpu', 'tests', 'tools', 'bench.py',
+           '__graft_entry__.py')
+
+_DIRECTIVE_RE = re.compile(r'skylint:\s*(?P<body>.*)$')
+_ITEM_RE = re.compile(
+    r'\s*(?P<name>[a-z][a-z-]*)'
+    r'(?:\s*\((?P<reason>[^()]*)\)|\s*=\s*(?P<value>[A-Za-z_][\w.]*))?')
+
+#: directives that suppress a finding and therefore need a reason
+REASON_REQUIRED = frozenset(
+    {'locked', 'allow-raise', 'allow-host-sync', 'allow-env',
+     'allow-metric'})
+#: marker directives (no argument)
+MARKERS = frozenset({'engine-thread', 'hot-path'})
+#: value directives (name=value)
+VALUED = frozenset({'guarded-by'})
+KNOWN_DIRECTIVES = REASON_REQUIRED | MARKERS | VALUED
+
+
+@dataclasses.dataclass
+class Directive:
+    """One parsed ``# skylint: ...`` item."""
+    name: str
+    arg: str  # reason text or =value ('' when absent)
+    lineno: int
+    malformed: Optional[str] = None  # parse-error text, if any
+
+
+@dataclasses.dataclass
+class Finding:
+    path: str  # repo-relative
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f'{self.path}:{self.line}: [{self.rule}] {self.message}'
+
+
+class SourceFile:
+    """A parsed source file: text, AST, and skylint directives."""
+
+    def __init__(self, path: pathlib.Path, root: pathlib.Path = ROOT):
+        self.path = path
+        try:
+            self.rel = str(path.relative_to(root))
+        except ValueError:
+            self.rel = str(path)
+        self.text = path.read_text(encoding='utf-8')
+        self.lines = self.text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.syntax_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(self.text, filename=str(path))
+        except SyntaxError as e:
+            self.syntax_error = e
+        self.directives: Dict[int, List[Directive]] = {}
+        self.comment_only_lines: set = set()
+        self._scan_comments()
+
+    def _scan_comments(self) -> None:
+        for i, line in enumerate(self.lines, start=1):
+            if line.lstrip().startswith('#'):
+                self.comment_only_lines.add(i)
+        comments: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(self.text).readline):
+                if tok.type == tokenize.COMMENT:
+                    comments[tok.start[0]] = tok.string
+        except tokenize.TokenError:
+            return  # syntax checker reports the underlying problem
+        # Trailing comments parse per-line. Comment-only lines parse as
+        # CONTIGUOUS BLOCKS with their text joined, so a directive's
+        # reason may wrap across lines; the parsed directives register
+        # on every line of the block (suppression lookups check the
+        # line above an access, function lookups scan upward).
+        block: List[int] = []
+        for i in sorted(comments):
+            if i in self.comment_only_lines:
+                if block and block[-1] == i - 1:
+                    block.append(i)
+                else:
+                    self._flush_block(block, comments)
+                    block = [i]
+            else:
+                for d in _parse_directives(comments[i], i):
+                    self.directives.setdefault(i, []).append(d)
+        self._flush_block(block, comments)
+
+    def _flush_block(self, block: List[int], comments) -> None:
+        if not block:
+            return
+        joined = ' '.join(comments[i].lstrip('#').strip() for i in block)
+        for d in _parse_directives('# ' + joined, block[0]):
+            for i in block:
+                self.directives.setdefault(i, []).append(d)
+
+    # -- lookup helpers ----------------------------------------------------
+
+    def directives_at(self, line: int) -> List[Directive]:
+        return self.directives.get(line, [])
+
+    def suppression(self, line: int, name: str) -> Optional[Directive]:
+        """Directive ``name`` at ``line`` (trailing comment) or on a
+        comment-only line directly above it."""
+        for d in self.directives_at(line):
+            if d.name == name:
+                return d
+        prev = line - 1
+        if prev in self.comment_only_lines:
+            for d in self.directives_at(prev):
+                if d.name == name:
+                    return d
+        return None
+
+    def func_directives(self, node: ast.AST) -> List[Directive]:
+        """Directives annotating a function: trailing comments on the
+        decorator/def lines plus contiguous comment-only lines
+        immediately above."""
+        start = min([node.lineno]
+                    + [d.lineno for d in
+                       getattr(node, 'decorator_list', [])])
+        # body start bounds the def statement's own lines
+        body = getattr(node, 'body', None)
+        end = body[0].lineno - 1 if body else node.lineno
+        out: List[Directive] = []
+        for line in range(start, end + 1):
+            out.extend(self.directives_at(line))
+        line = start - 1
+        while line >= 1 and line in self.comment_only_lines:
+            out.extend(self.directives_at(line))
+            line -= 1
+        return out
+
+
+def _parse_directives(comment: str, lineno: int) -> List[Directive]:
+    """Parse a directive stream after ``skylint:``: one or more
+    comma-separated ``name``, ``name(reason)``, or ``name=value`` items.
+    Prose after the last item is tolerated (joined comment blocks)."""
+    m = _DIRECTIVE_RE.search(comment)
+    if m is None:
+        return []
+    body = m.group('body')
+    out: List[Directive] = []
+    pos = 0
+    while True:
+        item = _ITEM_RE.match(body, pos)
+        if item is None or not item.group('name'):
+            break
+        arg = (item.group('reason') if item.group('reason') is not None
+               else item.group('value') or '')
+        out.append(Directive(item.group('name'), arg.strip(), lineno))
+        pos = item.end()
+        nxt = re.match(r'\s*,', body[pos:])
+        if nxt is None:
+            break
+        pos += nxt.end()
+    if not out:
+        out.append(Directive(
+            '', body, lineno,
+            malformed=f'skylint comment with no parseable directive: '
+                      f'{body[:60]!r}'))
+    return out
+
+
+# -- checker registry ------------------------------------------------------
+
+class Checker:
+    """One rule. Per-file rules implement ``check_file``; cross-file
+    rules (registries, name cross-checks, git state) implement
+    ``check_tree`` and run once over the whole file set."""
+
+    name = ''
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        return []
+
+    def check_tree(self, files: Sequence[SourceFile],
+                   root: pathlib.Path) -> List[Finding]:
+        return []
+
+
+_REGISTRY: List[type] = []
+
+
+def register(cls: type) -> type:
+    _REGISTRY.append(cls)
+    return cls
+
+
+def all_checkers() -> List[Checker]:
+    from skylint import checkers  # noqa: F401 — populates the registry
+    return [cls() for cls in _REGISTRY]
+
+
+# -- runner ----------------------------------------------------------------
+
+def iter_py_files(root: pathlib.Path = ROOT,
+                  targets: Sequence[str] = TARGETS):
+    for t in targets:
+        p = root / t
+        if p.is_file():
+            yield p
+        elif p.is_dir():
+            for f in sorted(p.rglob('*.py')):
+                if '__pycache__' not in f.parts:
+                    yield f
+
+
+def load_files(paths=None, root: pathlib.Path = ROOT) -> List[SourceFile]:
+    return [SourceFile(p, root)
+            for p in (paths if paths is not None else iter_py_files(root))]
+
+
+def run(paths=None, root: pathlib.Path = ROOT, tree_wide: bool = True
+        ) -> Tuple[List[Finding], int]:
+    """Run every registered checker. ``tree_wide=False`` (the
+    ``--changed`` inner loop) limits the run to per-file rules over
+    ``paths`` plus the always-cheap git hygiene rule."""
+    files = load_files(paths, root)
+    findings: List[Finding] = []
+    for checker in all_checkers():
+        for sf in files:
+            findings.extend(checker.check_file(sf))
+        if tree_wide or checker.name == 'tracked-pycache':
+            findings.extend(checker.check_tree(files, root))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, len(files)

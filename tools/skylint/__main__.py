@@ -1,0 +1,11 @@
+"""Entry point for ``python tools/skylint``."""
+import pathlib
+import sys
+
+# Executed as a directory: make the package importable by name.
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from skylint.cli import main  # noqa: E402
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv[1:]))

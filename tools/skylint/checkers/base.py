@@ -1,0 +1,115 @@
+"""Baseline hygiene rules (the original tools/lint.py checks) plus
+validation of the skylint annotations themselves."""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from skylint import (KNOWN_DIRECTIVES, MARKERS, REASON_REQUIRED, Checker,
+                     Finding, SourceFile, register)
+
+BANNED_CALLS = {'breakpoint'}
+BANNED_IMPORTS = {'pdb', 'ipdb'}
+
+
+@register
+class Base(Checker):
+    """Every file compiles, no debugger artifacts, no unused
+    module-scope imports."""
+
+    name = 'base'
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        if sf.syntax_error is not None:
+            e = sf.syntax_error
+            return [Finding(sf.rel, e.lineno or 1, 'syntax',
+                            f'syntax error: {e.msg}')]
+        out: List[Finding] = []
+        tree = sf.tree
+        used = _used_names(tree)
+        has_all = any(
+            isinstance(n, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == '__all__'
+                for t in n.targets)
+            for n in tree.body)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id in BANNED_CALLS:
+                out.append(Finding(sf.rel, node.lineno, 'debugger',
+                                   f'banned call {node.func.id}()'))
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                mod = getattr(node, 'module', None) or ''
+                names = {a.name.split('.')[0] for a in node.names}
+                if (mod.split('.')[0] in BANNED_IMPORTS or
+                        names & BANNED_IMPORTS):
+                    out.append(Finding(sf.rel, node.lineno, 'debugger',
+                                       'debugger import'))
+        # Unused module-scope imports (skip __init__.py re-exports and
+        # files declaring __all__).
+        if sf.path.name != '__init__.py' and not has_all:
+            for node in tree.body:
+                if isinstance(node, (ast.Import, ast.ImportFrom)):
+                    if isinstance(node, ast.ImportFrom) and \
+                            node.module in (None, '__future__'):
+                        continue
+                    for alias in node.names:
+                        if alias.name == '*':
+                            continue
+                        bound = (alias.asname or alias.name).split('.')[0]
+                        if bound not in used:
+                            out.append(Finding(
+                                sf.rel, node.lineno, 'unused-import',
+                                f'unused import {bound!r}'))
+        return out
+
+
+@register
+class Annotations(Checker):
+    """The annotations are part of the contract: a typo'd directive or a
+    reasonless suppression silently disables a rule, so both are
+    findings themselves."""
+
+    name = 'annotation'
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        out: List[Finding] = []
+        for line, directives in sorted(sf.directives.items()):
+            for d in directives:
+                if d.lineno != line:
+                    # A joined comment block registers its directives on
+                    # every block line for suppression lookups; report
+                    # each parse defect once, at its home line.
+                    continue
+                if d.malformed:
+                    out.append(Finding(sf.rel, line, self.name,
+                                       d.malformed))
+                elif d.name not in KNOWN_DIRECTIVES:
+                    out.append(Finding(
+                        sf.rel, line, self.name,
+                        f'unknown skylint directive {d.name!r} (have: '
+                        f'{", ".join(sorted(KNOWN_DIRECTIVES))})'))
+                elif d.name in REASON_REQUIRED and not d.arg:
+                    out.append(Finding(
+                        sf.rel, line, self.name,
+                        f'suppression {d.name!r} needs a human-readable '
+                        f'reason: # skylint: {d.name}(why this is safe)'))
+                elif d.name in MARKERS and d.arg:
+                    out.append(Finding(
+                        sf.rel, line, self.name,
+                        f'directive {d.name!r} takes no argument'))
+        return out
+
+
+def _used_names(tree: ast.AST) -> set:
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            cur = node
+            while isinstance(cur, ast.Attribute):
+                cur = cur.value
+            if isinstance(cur, ast.Name):
+                used.add(cur.id)
+    return used

@@ -1,0 +1,117 @@
+"""Engine-thread raise-safety.
+
+Functions annotated ``# skylint: engine-thread`` run on the continuous
+-batching engine loop thread. An exception escaping one of them lands in
+``_loop``'s catch-all, which calls ``_fail_everything`` — killing every
+in-flight stream on the replica (the PR 7 shape-skewed-import bug).
+Errors on these surfaces must flow through the per-request path (fail
+the one future / map to an HTTP status), so a ``raise`` that can escape
+the annotated function is a finding.
+
+Intraprocedural escape analysis: a raise is fine when an enclosing
+``try`` *within the same function* catches it — a bare ``except``, an
+``except Exception/BaseException``, or a handler naming the raised
+exception class. ``else:`` clauses and handler bodies are correctly NOT
+protected by their own ``try``. Nested defs are separate callables and
+are skipped (annotate them directly if they run on the engine thread).
+
+Escape hatch: ``# skylint: allow-raise(reason)`` on the raise line, for
+the rare invariant breach where nuking every stream IS the right call.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from skylint import Checker, Finding, SourceFile, register
+
+_CATCH_ALL = ('Exception', 'BaseException')
+
+
+@register
+class EngineThreadRaise(Checker):
+
+    name = 'engine-raise'
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        if sf.tree is None:
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and any(d.name == 'engine-thread'
+                            for d in sf.func_directives(node)):
+                for stmt in node.body:
+                    self._visit(sf, stmt, [], node.name, out)
+        return out
+
+    def _visit(self, sf: SourceFile, node, guards: List[frozenset],
+               fn_name: str, out: List[Finding]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # separate callable
+        if isinstance(node, ast.Try):
+            inner = guards + [_catch_spec(node.handlers)]
+            for child in node.body:
+                self._visit(sf, child, inner, fn_name, out)
+            # handlers and else/finally are NOT protected by this try
+            for h in node.handlers:
+                for child in h.body:
+                    self._visit(sf, child, guards, fn_name, out)
+            for child in node.orelse + node.finalbody:
+                self._visit(sf, child, guards, fn_name, out)
+            return
+        if isinstance(node, ast.Raise):
+            if not _caught(node, guards) and \
+                    not sf.suppression(node.lineno, 'allow-raise'):
+                raised = _raised_name(node) or 'exception'
+                out.append(Finding(
+                    sf.rel, node.lineno, self.name,
+                    f'raise {raised} can escape engine-thread function '
+                    f'{fn_name}() to the engine loop — _fail_everything '
+                    'would kill every in-flight stream; fail the one '
+                    'request instead (or # skylint: allow-raise(reason))'))
+        for child in ast.iter_child_nodes(node):
+            self._visit(sf, child, guards, fn_name, out)
+
+
+def _catch_spec(handlers) -> frozenset:
+    """The set of exception names a try's handlers catch; {'*'} for a
+    catch-all."""
+    names = set()
+    for h in handlers:
+        if h.type is None:
+            return frozenset({'*'})
+        for t in (h.type.elts if isinstance(h.type, ast.Tuple)
+                  else [h.type]):
+            tail = _tail_name(t)
+            if tail in _CATCH_ALL:
+                return frozenset({'*'})
+            if tail:
+                names.add(tail)
+    return frozenset(names)
+
+
+def _caught(node: ast.Raise, guards: List[frozenset]) -> bool:
+    raised = _raised_name(node)
+    for spec in guards:
+        if '*' in spec:
+            return True
+        if raised is not None and raised in spec:
+            return True
+    return False
+
+
+def _raised_name(node: ast.Raise) -> Optional[str]:
+    exc = node.exc
+    if isinstance(exc, ast.Call):
+        exc = exc.func
+    return _tail_name(exc) if exc is not None else None
+
+
+def _tail_name(node) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None

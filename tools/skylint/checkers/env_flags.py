@@ -1,0 +1,157 @@
+"""Env-flag registry cross-check.
+
+Every ``SKYTPU_*`` environment flag is declared once in
+``skypilot_tpu/env_flags.py`` (name, type, default, one-line doc). This
+checker ties the tree to the registry in both directions:
+
+* **typo-proofing** — any string literal that *is* a ``SKYTPU_*`` name
+  (full match, so prose mentioning flags inside longer strings is not
+  scanned) must be a declared flag. ``os.environ.get('SKYTPU_LLM_PIPLINE')``
+  fails lint instead of silently reading an empty default forever;
+* **dead-flag detection** — a declared flag whose name appears nowhere
+  else in the tree (including ``examples/``, text-scanned) is dead and
+  must be deleted from the registry.
+
+Escape hatch: ``# skylint: allow-env(reason)`` on the literal's line
+(used by the lint fixtures themselves)."""
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from typing import Dict, List, Optional, Sequence
+
+from skylint import Checker, Finding, SourceFile, register
+
+REGISTRY_REL = 'skypilot_tpu/env_flags.py'
+_NAME_RE = re.compile(r'SKYTPU_[A-Z0-9][A-Z0-9_]*\Z')
+# Extra trees text-scanned for flag liveness only (not AST-linted).
+_EXTRA_USAGE_DIRS = ('examples', 'docker')
+
+
+@register
+class EnvFlags(Checker):
+
+    name = 'env-flag'
+
+    def __init__(self):
+        self._registry: Optional[Dict[str, int]] = None  # name -> lineno
+        self._registry_error: Optional[str] = None
+
+    def _load_registry(self, root: pathlib.Path) -> Dict[str, int]:
+        if self._registry is not None:
+            return self._registry
+        self._registry = {}
+        path = root / REGISTRY_REL
+        if not path.is_file():
+            self._registry_error = f'{REGISTRY_REL} is missing'
+            return self._registry
+        try:
+            tree = ast.parse(path.read_text(encoding='utf-8'),
+                             filename=str(path))
+        except SyntaxError as e:
+            self._registry_error = f'{REGISTRY_REL}:{e.lineno}: {e.msg}'
+            return self._registry
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id == 'Flag' and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                self._registry.setdefault(node.args[0].value,
+                                          node.args[0].lineno)
+        return self._registry
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        if sf.tree is None or sf.rel == REGISTRY_REL:
+            return []
+        # The registry is anchored at skylint.ROOT (this checkout) BY
+        # DESIGN: skylint is this project's linter, and fixture files in
+        # a tmp dir (tests) still cross-check against the real registry.
+        from skylint import ROOT
+        registry = self._load_registry(ROOT)
+        out: List[Finding] = []
+        if self._registry_error:
+            return out  # reported once, in check_tree
+        for node in ast.walk(sf.tree):
+            name = _flag_literal(node)
+            if name is None or name in registry:
+                continue
+            if sf.suppression(node.lineno, 'allow-env'):
+                continue
+            hint = _closest(name, registry)
+            out.append(Finding(
+                sf.rel, node.lineno, self.name,
+                f'{name} is not declared in {REGISTRY_REL}'
+                + (f' — did you mean {hint}?' if hint else '')
+                + ' (declare it, or # skylint: allow-env(reason))'))
+        return out
+
+    def check_tree(self, files: Sequence[SourceFile],
+                   root: pathlib.Path) -> List[Finding]:
+        registry = self._load_registry(root)
+        if self._registry_error:
+            return [Finding(REGISTRY_REL, 1, self.name,
+                            f'flag registry unreadable: '
+                            f'{self._registry_error}')]
+        used = set()
+        for sf in files:
+            if sf.rel == REGISTRY_REL:
+                continue
+            # Liveness is a raw-text scan, not an AST-literal one:
+            # flags also get read inside generated-script template
+            # strings (agent setup scripts, tpu_doctor payloads).
+            used.update(re.findall(r'SKYTPU_[A-Z0-9_]+', sf.text))
+        for d in _EXTRA_USAGE_DIRS:
+            base = root / d
+            if not base.is_dir():
+                continue
+            for p in base.rglob('*'):
+                if p.suffix in ('.py', '.sh', '.yaml', '.yml', '.md') \
+                        and p.is_file():
+                    used.update(re.findall(r'SKYTPU_[A-Z0-9_]+',
+                                           p.read_text(encoding='utf-8',
+                                                       errors='replace')))
+        out: List[Finding] = []
+        for name, lineno in sorted(registry.items()):
+            if name not in used:
+                out.append(Finding(
+                    REGISTRY_REL, lineno, self.name,
+                    f'{name} is declared but never read anywhere in the '
+                    'tree — dead flag; delete the declaration'))
+        return out
+
+
+def _flag_literal(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and _NAME_RE.match(node.value):
+        return node.value
+    return None
+
+
+def _closest(name: str, registry: Dict[str, int]) -> Optional[str]:
+    """Cheap typo hint: a declared flag within edit-ish distance (same
+    length ±1 and >= 80% common prefix+suffix)."""
+    best = None
+    for cand in registry:
+        if abs(len(cand) - len(name)) > 1:
+            continue
+        common = _overlap(name, cand)
+        if common >= max(len(name), len(cand)) - 2 and common > 8:
+            best = cand
+            break
+    return best
+
+
+def _overlap(a: str, b: str) -> int:
+    pre = 0
+    for x, y in zip(a, b):
+        if x != y:
+            break
+        pre += 1
+    suf = 0
+    for x, y in zip(reversed(a[pre:]), reversed(b[pre:])):
+        if x != y:
+            break
+        suf += 1
+    return pre + suf

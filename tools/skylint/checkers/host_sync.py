@@ -1,0 +1,245 @@
+"""Host-sync-in-hot-path.
+
+A single stray host synchronization — ``jax.device_get``, ``.item()``,
+``.block_until_ready()``, or ``np.asarray`` over a device value —
+inside the decode dispatch path serializes host and device and caps
+throughput (the "limits of concurrency on TPUs" failure mode). The
+designed sync points are few and deliberate; everything else is a bug.
+
+Scope, per file:
+
+* functions annotated ``# skylint: hot-path`` (the decode dispatch
+  roots, e.g. the engine loop) plus everything reachable from them
+  through same-class ``self.x()`` calls and same-module calls
+  (file-local transitive closure);
+* functions compiled under ``jax.jit`` — detected from decorators
+  (``@jax.jit``, ``@partial(jax.jit, ...)``) and the module-level
+  ``_f = jax.jit(_f_impl, ...)`` binding form. A host sync inside a
+  traced scope is wrong twice over.
+
+``np.asarray``/``np.array`` over a literal list/tuple is host→host and
+exempt, as is a local name the same function assigned from a host
+constructor (``np.zeros``, a list expression, ...) — minimal local
+dataflow so the ubiquitous build-a-jit-input pattern does not need
+annotations. Anything else (attributes, jit-call results) *may* hide a
+device transfer and is flagged. Escape hatch:
+``# skylint: allow-host-sync(reason)`` on the sync line — reserved for
+the designed fetch points — or on a ``def`` whose entire purpose is
+device→host serialization (the KV-export builder)."""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from skylint import Checker, Finding, SourceFile, register
+
+_SYNC_METHODS = {'item', 'block_until_ready'}
+_NP_MODULES = {'np', 'numpy', 'onp'}
+_NP_FUNCS = {'asarray', 'array'}
+_HOST_LITERALS = (ast.List, ast.Tuple, ast.Constant, ast.ListComp,
+                  ast.GeneratorExp, ast.Dict, ast.Set)
+
+
+@register
+class HostSync(Checker):
+
+    name = 'host-sync'
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        if sf.tree is None:
+            return []
+        functions = _collect_functions(sf.tree)
+        jit_roots = _jit_bound_names(sf.tree)
+        roots: Dict[str, str] = {}  # qualname -> why it is hot
+        for qual, fn in functions.items():
+            if any(d.name == 'hot-path'
+                   for d in sf.func_directives(fn.node)):
+                roots[qual] = f'hot-path root {fn.node.name}'
+            elif _is_jit_decorated(fn.node) or fn.node.name in jit_roots:
+                roots[qual] = f'jax.jit scope {fn.node.name}'
+        hot = _closure(functions, roots)
+        out: List[Finding] = []
+        for qual, why in sorted(hot.items()):
+            fn = functions[qual]
+            if any(d.name == 'allow-host-sync'
+                   for d in sf.func_directives(fn.node)):
+                continue  # whole function is a designed sync surface
+            host_names = _host_assigned_names(fn.node)
+            stmt_line = _stmt_lines(fn.node)
+            for node in ast.walk(fn.node):
+                msg = _sync_call(node, host_names)
+                if msg is None:
+                    continue
+                # A directive suppresses at the call line, or — for
+                # wrapped statements — at the statement's first line.
+                if sf.suppression(node.lineno, 'allow-host-sync') or \
+                        sf.suppression(stmt_line.get(id(node),
+                                                     node.lineno),
+                                       'allow-host-sync'):
+                    continue
+                out.append(Finding(
+                    sf.rel, node.lineno, self.name,
+                    f'{msg} in {fn.node.name}() — a host sync on the '
+                    f'hot path ({why}); move it to a designed fetch '
+                    'point or annotate '
+                    '# skylint: allow-host-sync(reason)'))
+        return out
+
+
+class _Fn:
+    def __init__(self, node, cls: Optional[str]):
+        self.node = node
+        self.cls = cls
+
+
+def _stmt_lines(fn) -> Dict[int, int]:
+    """id(sub-node) -> first line of its enclosing statement, so a
+    suppression above a wrapped multi-line statement covers calls on
+    its continuation lines."""
+    out: Dict[int, int] = {}
+    for stmt in ast.walk(fn):  # BFS: later visits are more nested, so
+        if isinstance(stmt, ast.stmt):  # last write = innermost stmt
+            for sub in ast.walk(stmt):
+                out[id(sub)] = stmt.lineno
+    return out
+
+
+def _collect_functions(tree) -> Dict[str, _Fn]:
+    out: Dict[str, _Fn] = {}
+
+    def visit(node, cls: Optional[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                visit(child, child.name)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                qual = f'{cls}.{child.name}' if cls else child.name
+                out.setdefault(qual, _Fn(child, cls))
+                visit(child, cls)  # nested defs share the class scope
+            else:
+                visit(child, cls)
+
+    visit(tree, None)
+    return out
+
+
+def _jit_bound_names(tree) -> Set[str]:
+    """Names passed to a *jit call: ``_j = jax.jit(_impl, ...)`` marks
+    ``_impl`` as a traced scope."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _mentions_jit(node.func):
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    names.add(arg.id)
+    return names
+
+
+def _mentions_jit(func) -> bool:
+    if isinstance(func, ast.Attribute):
+        return func.attr == 'jit'
+    if isinstance(func, ast.Name):
+        return func.id == 'jit' or func.id.endswith('_jit')
+    return False
+
+
+def _is_jit_decorated(fn) -> bool:
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if _mentions_jit(target):
+            return True
+        # @partial(jax.jit, ...)
+        if isinstance(dec, ast.Call) and any(
+                _mentions_jit(a) for a in dec.args
+                if isinstance(a, (ast.Attribute, ast.Name))):
+            return True
+    return False
+
+
+def _closure(functions: Dict[str, _Fn],
+             roots: Dict[str, str]) -> Dict[str, str]:
+    hot = dict(roots)
+    frontier = list(roots)
+    while frontier:
+        qual = frontier.pop()
+        fn = functions[qual]
+        for callee in _callees(fn):
+            if callee in functions and callee not in hot:
+                hot[callee] = hot[qual]
+                frontier.append(callee)
+    return hot
+
+
+def _callees(fn: _Fn) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn.node):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and f.value.id == 'self' \
+                and fn.cls:
+            out.add(f'{fn.cls}.{f.attr}')
+        elif isinstance(f, ast.Name):
+            out.add(f.id)
+    return out
+
+
+def _host_assigned_names(fn) -> Set[str]:
+    """Local names assigned from host-side constructors: np.* factory
+    calls, list/tuple expressions, arithmetic over them. One pass, no
+    fixpoint — enough for the build-a-jit-input idiom."""
+    out: Set[str] = set()
+
+    def is_host(value) -> bool:
+        if isinstance(value, _HOST_LITERALS):
+            return True
+        if isinstance(value, ast.BinOp):
+            return is_host(value.left) or is_host(value.right)
+        if isinstance(value, ast.Call):
+            f = value.func
+            if isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id in _NP_MODULES:
+                return True
+            if isinstance(f, ast.Name) and f.id in (
+                    'list', 'tuple', 'sorted', 'len', 'range', 'int',
+                    'float', 'min', 'max', 'sum'):
+                return True
+        return False
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and is_host(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _is_host_value(arg, host_names: Set[str]) -> bool:
+    if isinstance(arg, _HOST_LITERALS):
+        return True
+    if isinstance(arg, ast.Name):
+        return arg.id in host_names
+    if isinstance(arg, (ast.Subscript, ast.Starred)):
+        return _is_host_value(arg.value, host_names)
+    return False
+
+
+def _sync_call(node, host_names: Set[str]) -> Optional[str]:
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _SYNC_METHODS:
+        return f'.{f.attr}()'
+    tail = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+    if tail == 'device_get':
+        return 'jax.device_get'
+    if isinstance(f, ast.Attribute) and \
+            isinstance(f.value, ast.Name) and \
+            f.value.id in _NP_MODULES and f.attr in _NP_FUNCS:
+        if node.args and not _is_host_value(node.args[0], host_names):
+            return f'{f.value.id}.{f.attr} over a non-host value '\
+                   '(possible device transfer)'
+    return None

@@ -1,0 +1,225 @@
+"""Lock discipline: guarded state is touched only under its lock.
+
+A class (or module) declares which attributes a lock guards::
+
+    class Engine:
+        _GUARDED_BY = {'_pending': '_lock', 'tokens_emitted': '_lock'}
+
+or, per-assignment::
+
+    self._requests = {}  # skylint: guarded-by=_lock
+
+The checker then flags every read/write of a guarded attribute outside a
+``with self._lock:`` scope, intraprocedurally. A guard value may be a
+tuple when several context managers acquire the same underlying lock
+(e.g. a ``threading.Condition`` built on it)::
+
+    _GUARDED_BY = {'_queue': ('_lock', '_idle')}
+
+Escape hatches (reasons mandatory):
+
+* ``# skylint: locked(reason)`` on a ``def`` — every caller holds the
+  lock (the ``_locked`` suffix convention), or the function is otherwise
+  exempt for the stated reason; the body is skipped.
+* ``# skylint: locked(reason)`` on an access line — that one access is
+  safe (e.g. single-writer thread reading its own counter).
+
+``__init__`` is exempt: construction happens-before the object is
+published to other threads. Nested functions do NOT inherit the
+enclosing lock scope — a closure may run after the lock is released."""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from skylint import Checker, Finding, SourceFile, register
+
+_DECL = '_GUARDED_BY'
+
+
+@register
+class LockDiscipline(Checker):
+
+    name = 'guarded-by'
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        if sf.tree is None:
+            return []
+        out: List[Finding] = []
+        # Module-level declaration guards module globals.
+        mod_guards, decl_errors = _literal_decl(sf, sf.tree.body)
+        out.extend(decl_errors)
+        if mod_guards:
+            for node in sf.tree.body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    _check_function(sf, node, mod_guards,
+                                    self_based=False, out=out)
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._check_class(sf, node))
+        return out
+
+    def _check_class(self, sf: SourceFile,
+                     cls: ast.ClassDef) -> List[Finding]:
+        out: List[Finding] = []
+        guards, decl_errors = _literal_decl(sf, cls.body)
+        out.extend(decl_errors)
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        # Per-assignment form: self._x = ...  # skylint: guarded-by=_lock
+        for m in methods:
+            for node in ast.walk(m):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == 'self':
+                        d = sf.suppression(node.lineno, 'guarded-by')
+                        if d is not None and d.arg:
+                            guards.setdefault(t.attr, set()).add(d.arg)
+        if not guards:
+            return out
+        for m in methods:
+            if m.name == '__init__':
+                continue
+            _check_function(sf, m, guards, self_based=True, out=out)
+        return out
+
+
+def _literal_decl(sf: SourceFile, body) -> Tuple[Dict[str, Set[str]],
+                                                 List[Finding]]:
+    """Parse a literal ``_GUARDED_BY = {...}`` in ``body``."""
+    guards: Dict[str, Set[str]] = {}
+    errors: List[Finding] = []
+    for node in body:
+        if not (isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == _DECL
+                for t in node.targets)):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            errors.append(Finding(
+                sf.rel, node.lineno, 'guarded-by',
+                f'{_DECL} must be a literal dict of '
+                "{'attr': 'lock'} (or tuple-of-locks values)"))
+            continue
+        for k, v in zip(node.value.keys, node.value.values):
+            attr = _const_str(k)
+            locks = _lock_names(v)
+            if attr is None or locks is None:
+                errors.append(Finding(
+                    sf.rel, node.lineno, 'guarded-by',
+                    f'{_DECL} entries must be string keys with string '
+                    'or tuple-of-string lock values'))
+                continue
+            guards.setdefault(attr, set()).update(locks)
+    return guards, errors
+
+
+def _const_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _lock_names(node) -> Optional[Set[str]]:
+    s = _const_str(node)
+    if s is not None:
+        return {s}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        names = [_const_str(e) for e in node.elts]
+        if all(n is not None for n in names):
+            return set(names)
+    return None
+
+
+def _check_function(sf: SourceFile, fn, guards: Dict[str, Set[str]],
+                    self_based: bool, out: List[Finding]) -> None:
+    for d in sf.func_directives(fn):
+        if d.name == 'locked':
+            return  # callers hold the lock (reason checked by base)
+    scope = 'self' if self_based else 'module'
+    for stmt in fn.body:
+        _visit(sf, stmt, guards, frozenset(), self_based, scope,
+               stmt.lineno, out)
+
+
+def _visit(sf: SourceFile, node, guards, held: frozenset,
+           self_based: bool, scope: str, anchor: int,
+           out: List[Finding]) -> None:
+    if isinstance(node, ast.stmt):
+        # Suppressions on a wrapped statement's FIRST line cover the
+        # whole statement.
+        anchor = node.lineno
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda)):
+        # A nested callable does not inherit the lock: it may outlive
+        # the with-block (callbacks, threads). It is checked lock-free
+        # unless annotated locked(...) itself.
+        if not isinstance(node, ast.Lambda):
+            for d in sf.func_directives(node):
+                if d.name == 'locked':
+                    return
+        body = node.body if isinstance(node.body, list) else [node.body]
+        for child in body:
+            _visit(sf, child, guards, frozenset(), self_based, scope,
+                   anchor, out)
+        return
+    if isinstance(node, (ast.With, ast.AsyncWith)):
+        acquired = set()
+        for item in node.items:
+            name = _ctx_lock_name(item.context_expr, self_based)
+            if name:
+                acquired.add(name)
+            _visit(sf, item.context_expr, guards, held, self_based,
+                   scope, anchor, out)
+        inner = frozenset(held | acquired)
+        for child in node.body:
+            _visit(sf, child, guards, inner, self_based, scope, anchor,
+                   out)
+        return
+    _flag_access(sf, node, guards, held, self_based, scope, anchor, out)
+    for child in ast.iter_child_nodes(node):
+        _visit(sf, child, guards, held, self_based, scope, anchor, out)
+
+
+def _ctx_lock_name(expr, self_based: bool) -> Optional[str]:
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == 'self':
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _flag_access(sf: SourceFile, node, guards, held: frozenset,
+                 self_based: bool, scope: str, anchor: int,
+                 out: List[Finding]) -> None:
+    attr = None
+    if self_based:
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == 'self' and node.attr in guards:
+            attr = node.attr
+    else:
+        if isinstance(node, ast.Name) and node.id in guards and \
+                isinstance(node.ctx, (ast.Load, ast.Store, ast.Del)):
+            attr = node.id
+    if attr is None:
+        return
+    if guards[attr] & held:
+        return
+    if sf.suppression(node.lineno, 'locked') or \
+            sf.suppression(anchor, 'locked'):
+        return
+    locks = '/'.join(sorted(guards[attr]))
+    where = f'self.{attr}' if self_based else attr
+    out.append(Finding(
+        sf.rel, node.lineno, 'guarded-by',
+        f'{where} is guarded by {locks} but accessed outside a '
+        f'`with {locks}` scope (annotate `# skylint: locked(reason)` '
+        'if every caller holds it)'))

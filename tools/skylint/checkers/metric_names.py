@@ -1,0 +1,167 @@
+"""Metric-name cross-check.
+
+Every ``skytpu_*`` Prometheus series is defined exactly once, in
+``skypilot_tpu/server/metrics.py``. Dashboards, the serving path, and
+the operator docs refer to those series BY STRING — a renamed gauge
+silently blanks a dashboard panel. Two directions:
+
+* every ``skytpu_*`` token referenced in ``server/dashboard.py``,
+  ``serve/``, or ``docs/*.md`` must be a defined metric (exposition
+  suffixes ``_bucket``/``_sum``/``_count`` are normalized away; a token
+  ending in ``_`` is a family reference like ``skytpu_ckpt_*`` and must
+  match at least one defined metric's prefix);
+* every defined metric must be referenced in at least one of those
+  places — an undocumented, undashboarded series is unobservable by
+  operators and probably a leftover.
+
+Definitions outside metrics.py are flagged too (single registry file is
+the contract). Escape hatch in Python sources:
+``# skylint: allow-metric(reason)``; doc references have no escape —
+fix the doc."""
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from typing import Dict, List, Sequence, Tuple
+
+from skylint import Checker, Finding, SourceFile, register
+
+METRICS_REL = 'skypilot_tpu/server/metrics.py'
+_REF_PY = ('skypilot_tpu/server/dashboard.py',)
+_REF_DIRS_PY = ('skypilot_tpu/serve',)
+_DOCS_GLOB = 'docs/*.md'
+# Generated from env_flags.py, not hand-written operator docs; native
+# binary names (skytpu_gangd, skytpu_fuse_proxy) share the prefix and
+# would false-positive the token scan.
+_DOCS_EXCLUDE = ('docs/env_flags.md',)
+_METRIC_CLASSES = {'Gauge', 'Counter', 'Histogram', 'Summary'}
+_TOKEN_RE = re.compile(r'skytpu_[a-z0-9_]+')
+_EXPO_SUFFIXES = ('_bucket', '_sum', '_count')
+
+
+@register
+class MetricNames(Checker):
+
+    name = 'metric-name'
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        # Definitions must live in metrics.py alone.
+        if sf.tree is None or sf.rel == METRICS_REL:
+            return []
+        out: List[Finding] = []
+        for node, metric in _definitions(sf.tree):
+            if sf.suppression(node.lineno, 'allow-metric'):
+                continue
+            out.append(Finding(
+                sf.rel, node.lineno, self.name,
+                f'metric {metric!r} defined outside {METRICS_REL} — '
+                'all skytpu_* series live in the one registry module'))
+        return out
+
+    def check_tree(self, files: Sequence[SourceFile],
+                   root: pathlib.Path) -> List[Finding]:
+        defined = self._defined(root)
+        if not defined:
+            return [Finding(METRICS_REL, 1, self.name,
+                            'no skytpu_* metric definitions found — '
+                            'registry unreadable?')]
+        by_file = {sf.rel: sf for sf in files}
+        out: List[Finding] = []
+        referenced: Dict[str, Tuple[str, int]] = {}
+
+        def scan_text(rel: str, text: str, sf=None) -> None:
+            for i, line in enumerate(text.splitlines(), start=1):
+                for tok in _TOKEN_RE.findall(line):
+                    if sf is not None and \
+                            sf.suppression(i, 'allow-metric'):
+                        continue
+                    referenced.setdefault(tok, (rel, i))
+                    if not _valid_ref(tok, defined):
+                        out.append(Finding(
+                            rel, i, self.name,
+                            f'{tok} is not defined in {METRICS_REL} '
+                            '(renamed or typo\'d series?)'))
+
+        ref_files = [rel for rel in _REF_PY if rel in by_file]
+        ref_files += [rel for rel in by_file
+                      if any(rel.startswith(d + '/')
+                             for d in _REF_DIRS_PY)]
+        for rel in sorted(set(ref_files)):
+            sf = by_file[rel]
+            scan_text(rel, sf.text, sf)
+        # metrics.py's own prose (docstrings cross-reference series)
+        # must not mention stale names either; its definitions are
+        # trivially valid references and are not counted for coverage.
+        mpath = root / METRICS_REL
+        if mpath.is_file():
+            for i, line in enumerate(
+                    mpath.read_text(encoding='utf-8').splitlines(),
+                    start=1):
+                for tok in _TOKEN_RE.findall(line):
+                    if not _valid_ref(tok, defined):
+                        out.append(Finding(
+                            METRICS_REL, i, self.name,
+                            f'{tok} mentioned but not defined '
+                            '(stale docstring?)'))
+        for doc in sorted(root.glob(_DOCS_GLOB)):
+            rel = str(doc.relative_to(root))
+            if rel in _DOCS_EXCLUDE:
+                continue
+            scan_text(rel, doc.read_text(encoding='utf-8'))
+        # Vice versa: every defined series is reachable by an operator.
+        for metric, lineno in sorted(defined.items()):
+            if not any(_covers(tok, metric) for tok in referenced):
+                out.append(Finding(
+                    METRICS_REL, lineno, self.name,
+                    f'{metric} is defined but never referenced in the '
+                    'dashboard, serve/, or docs/ — document it in '
+                    'docs/operations.md or delete the series'))
+        return out
+
+    def _defined(self, root: pathlib.Path) -> Dict[str, int]:
+        path = root / METRICS_REL
+        if not path.is_file():
+            return {}
+        try:
+            tree = ast.parse(path.read_text(encoding='utf-8'),
+                             filename=str(path))
+        except SyntaxError:
+            return {}
+        return {metric: node.lineno
+                for node, metric in _definitions(tree)}
+
+
+def _definitions(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            tail = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None)
+            if tail in _METRIC_CLASSES and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str) and \
+                    node.args[0].value.startswith('skytpu_'):
+                yield node, node.args[0].value
+
+
+def _valid_ref(tok: str, defined: Dict[str, int]) -> bool:
+    if tok.endswith('_'):  # family reference: skytpu_ckpt_* prose
+        return any(m.startswith(tok) for m in defined)
+    if tok in defined:
+        return True
+    for suf in _EXPO_SUFFIXES:
+        if tok.endswith(suf) and tok[:-len(suf)] in defined:
+            return True
+    return False
+
+
+def _covers(tok: str, metric: str) -> bool:
+    if tok.endswith('_'):
+        return metric.startswith(tok)
+    if tok == metric:
+        return True
+    for suf in _EXPO_SUFFIXES:
+        if tok.endswith(suf) and tok[:-len(suf)] == metric:
+            return True
+    return False

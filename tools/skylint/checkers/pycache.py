@@ -1,0 +1,50 @@
+"""Git hygiene: no tracked bytecode, and .gitignore keeps it that way.
+
+A tracked ``__pycache__``/``.pyc`` goes stale the moment its source
+changes and then shadows or confuses imports on checkouts with a
+different interpreter. The rule fails if git tracks any, and if
+``.gitignore`` stops covering the patterns that prevent re-adding them.
+"""
+from __future__ import annotations
+
+import pathlib
+import subprocess
+from typing import List, Sequence
+
+from skylint import Checker, Finding, SourceFile, register
+
+_REQUIRED_IGNORES = ('__pycache__/', '*.pyc')
+
+
+@register
+class TrackedPycache(Checker):
+
+    name = 'tracked-pycache'
+
+    def check_tree(self, files: Sequence[SourceFile],
+                   root: pathlib.Path) -> List[Finding]:
+        out: List[Finding] = []
+        try:
+            tracked = subprocess.run(
+                ['git', 'ls-files', '--', '*__pycache__*', '*.pyc',
+                 '*.pyo'],
+                cwd=root, capture_output=True, text=True, timeout=30,
+                check=False).stdout.splitlines()
+        except (OSError, subprocess.SubprocessError):
+            return out  # not a git checkout (sdist): nothing to enforce
+        for path in tracked:
+            if path.strip():
+                out.append(Finding(
+                    path.strip(), 1, self.name,
+                    'bytecode is tracked by git — `git rm --cached` it '
+                    '(.gitignore already covers the pattern)'))
+        gitignore = root / '.gitignore'
+        patterns = (gitignore.read_text(encoding='utf-8').splitlines()
+                    if gitignore.is_file() else [])
+        for required in _REQUIRED_IGNORES:
+            if required not in (p.strip() for p in patterns):
+                out.append(Finding(
+                    '.gitignore', 1, self.name,
+                    f'missing {required!r} — bytecode would be '
+                    'addable to the index again'))
+        return out

@@ -1,0 +1,74 @@
+"""skylint command line.
+
+``python tools/lint.py``            full suite (the `make lint` gate)
+``python tools/skylint``            same
+``python tools/skylint --changed``  per-file rules over git-dirty files
+                                    only (the subsecond inner loop;
+                                    tree-wide cross-checks are skipped
+                                    except git bytecode hygiene)
+``python tools/skylint PATH ...``   per-file rules over specific files
+"""
+from __future__ import annotations
+
+import argparse
+import pathlib
+import subprocess
+from typing import List, Optional
+
+import skylint
+
+
+def _changed_files(root: pathlib.Path) -> List[pathlib.Path]:
+    # -uall: plain porcelain collapses an untracked directory to one
+    # `?? dir/` entry, silently skipping every .py inside a brand-new
+    # package.
+    proc = subprocess.run(
+        ['git', 'status', '--porcelain', '--untracked-files=all'],
+        cwd=root, capture_output=True, text=True, timeout=30,
+        check=False)
+    out = []
+    for line in proc.stdout.splitlines():
+        if len(line) < 4 or line[0] == 'D' or line[1] == 'D':
+            continue
+        path = line[3:].split(' -> ')[-1].strip().strip('"')
+        p = root / path
+        if p.suffix == '.py' and p.is_file() and \
+                '__pycache__' not in p.parts:
+            out.append(p)
+    return sorted(out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog='skylint', description=skylint.__doc__.splitlines()[0])
+    parser.add_argument('paths', nargs='*',
+                        help='files to lint (default: the whole tree)')
+    parser.add_argument('--changed', action='store_true',
+                        help='lint only git-dirty files (per-file rules)')
+    parser.add_argument('--list-checkers', action='store_true',
+                        help='print the registered rules and exit')
+    args = parser.parse_args(argv)
+    if args.list_checkers:
+        import sys
+        for checker in skylint.all_checkers():
+            doc = (checker.__doc__
+                   or sys.modules[type(checker).__module__].__doc__
+                   or '').strip().splitlines()
+            print(f'{checker.name}: {doc[0] if doc else ""}')
+        return 0
+    root = skylint.ROOT
+    if args.changed:
+        paths: Optional[List[pathlib.Path]] = _changed_files(root)
+        tree_wide = False
+    elif args.paths:
+        paths = [pathlib.Path(p).resolve() for p in args.paths]
+        tree_wide = False
+    else:
+        paths = None
+        tree_wide = True
+    findings, nfiles = skylint.run(paths, root, tree_wide=tree_wide)
+    for f in findings:
+        print(f)
+    scope = 'changed file(s)' if args.changed else 'file(s)'
+    print(f'skylint: {len(findings)} finding(s) over {nfiles} {scope}')
+    return 1 if findings else 0
