@@ -34,11 +34,16 @@ ci:
 # prefill replica served through the colocated fallback),
 # the goodput gate (trainer stdout byte-identical with telemetry
 # off vs on; managed-job phase ledger gap-free and summing to
-# wall-clock across an injected preemption), and the checkpoint gate
+# wall-clock across an injected preemption), the checkpoint gate
 # (sync/async loss trajectory byte-identical with async step-loop
 # stall < 50% of the sync save wall-time; kill -9 mid-commit resumes
 # from the last committed checksum-valid step; managed-job ledger and
-# skytpu_ckpt_* gauges carry nonzero save+restore accounting).
+# skytpu_ckpt_* gauges carry nonzero save+restore accounting), and
+# the black-box flight-recorder gate (greedy byte parity recorder on
+# vs SKYTPU_BLACKBOX=0; /debug/blackbox dump-now round trip over HTTP
+# with engine ring events + thread stacks in the bundle; kill -9 of a
+# replica under load with the survivor's bundle + the LB ring
+# reconstructing the timeline).
 verify:
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --smoke
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --qos
@@ -47,6 +52,7 @@ verify:
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --disagg
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --goodput
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --ckpt
+	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --blackbox
 
 # Full skylint suite (lock discipline, engine-thread raise safety,
 # host-sync, env-flag registry, metric names, git bytecode hygiene) at

@@ -652,6 +652,14 @@ def _tpu_reachable() -> bool:
         'relay': report['relay'],
         'process_table_clean': not report['framework_processes'],
     })
+    # The probe child's self-dumped incident bundle (deadline aborts):
+    # phase-crossing ring + all-thread stacks at the abort. Rides the
+    # sidecar with the rest of the diagnostics; the artifact detail
+    # references it (mark_tpu_unreachable).
+    bundle = next((a.get('bundle') for a in reversed(attempts)
+                   if a.get('bundle')), None)
+    if bundle is not None:
+        _PROBE_DIAGNOSTICS['incident_bundle'] = bundle
     return False
 
 
@@ -846,6 +854,17 @@ def mark_tpu_unreachable(result: dict, diagnostics: dict) -> dict:
     detail['tpu_stuck_phase'] = diagnostics.get('final_hang_phase')
     detail['tpu_diagnosis'] = (diagnostics.get('final_diagnosis')
                                or 'probe failed')[:200]
+    if diagnostics.get('incident_bundle'):
+        # The probe child froze ring + stacks at its deadline abort;
+        # the full bundle rides the diagnostics sidecar
+        # (finalize_result), referenced here so the 0.0 line points at
+        # its own forensics.
+        b = diagnostics['incident_bundle']
+        detail['tpu_incident_bundle'] = {
+            'in_sidecar': 'probe_diagnostics.incident_bundle',
+            'trigger': b.get('trigger'),
+            'events': len(b.get('events') or ()),
+        }
     result['value'] = 0.0
     result['vs_baseline'] = 0.0
     return result

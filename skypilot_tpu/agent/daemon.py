@@ -27,6 +27,7 @@ from typing import Optional
 
 from skypilot_tpu import exceptions, global_user_state
 from skypilot_tpu.agent import constants, job_lib
+from skypilot_tpu.observability import blackbox
 
 
 def _runtime_dir(cluster_name: str) -> str:
@@ -89,12 +90,18 @@ def check_once(cluster_name: str) -> Optional[str]:
     try:
         if policy.get('down'):
             core.down(cluster_name)
+            blackbox.record('agent.autostop', action='down',
+                            cluster=cluster_name)
             return 'down'
         core.stop(cluster_name)
+        blackbox.record('agent.autostop', action='stop',
+                        cluster=cluster_name)
         return 'stop'
     except exceptions.NotSupportedError:
         # Cloud cannot stop (e.g. local): fall back to down.
         core.down(cluster_name)
+        blackbox.record('agent.autostop', action='down',
+                        cluster=cluster_name)
         return 'down'
     except exceptions.ClusterDoesNotExist:
         return None
@@ -158,6 +165,9 @@ def heartbeat_once(cluster_name: str,
             return None
     except Exception:  # noqa: BLE001 — a full disk / corrupt DB must not
         return None  # kill the autostop daemon; next tick retries
+    blackbox.record('agent.heartbeat', cluster=cluster_name,
+                    unfinished=(payload.get('jobs') or {}).get(
+                        'unfinished'))
     return payload
 
 
@@ -179,6 +189,10 @@ def main() -> None:
     parser.add_argument('--cluster-name', required=True)
     parser.add_argument('--interval', type=float, default=20.0)
     args = parser.parse_args()
+    # kill -QUIT interrogates a wedged daemon without killing it:
+    # faulthandler stacks land in the bundle spool, not stderr.
+    blackbox.set_process_label('agent_daemon')
+    blackbox.install_sigquit()
     run_loop(args.cluster_name, args.interval)
 
 

@@ -22,6 +22,7 @@ TPU-native differences:
 """
 from __future__ import annotations
 
+import json
 import os
 import shutil
 import subprocess
@@ -755,6 +756,45 @@ class TpuGangBackend(Backend):
             return None
         job = table.get(job_id)
         return job['status'] if job else None
+
+    def blackbox(self, handle: ClusterHandle,
+                 dump: bool = False) -> Dict[str, Any]:
+        """Incident forensics on the cluster head
+        (observability/blackbox.py CLI): ``dump=True`` SIGQUITs every
+        handler-registered framework process there (thread stacks land
+        in the bundle spool; processes without the handler are left
+        alone — default SIGQUIT kills) before listing; ``dump=False`` just lists the committed
+        bundles. Remote-control clusters relay through the head agent's
+        Exec RPC; local clusters run in-process."""
+        flag = '--dump' if dump else '--list'
+        if self._remote_control(handle):
+            client = self._agent(handle)  # ClusterNotUpError surfaces
+            python = os.environ.get('SKYTPU_REMOTE_PYTHON', 'python3')
+            rc, out = client.exec_command(
+                f'{python} -m skypilot_tpu.observability.blackbox {flag}')
+            text = out.decode('utf-8', errors='replace')
+            if rc != 0:
+                raise exceptions.SkyTpuError(
+                    f'blackbox {flag} failed on '
+                    f'{handle.cluster_name!r} head (rc {rc}): '
+                    f'{text[-500:]}')
+            # Last stdout line is the JSON report (the tool prints one
+            # line; anything earlier is stray interpreter noise).
+            for line in reversed(text.strip().splitlines()):
+                try:
+                    return json.loads(line)
+                except ValueError:
+                    continue
+            raise exceptions.SkyTpuError(
+                f'blackbox {flag} on {handle.cluster_name!r} produced '
+                f'no JSON report: {text[-500:]}')
+        from skypilot_tpu.observability import blackbox as blackbox_lib
+        signalled = (blackbox_lib.sigquit_framework_procs()
+                     if dump else None)
+        out_local = blackbox_lib.listing()
+        if signalled is not None:
+            out_local['signalled'] = signalled
+        return out_local
 
     def cancel_job(self, handle: ClusterHandle,
                    job_id: Optional[int] = None) -> bool:

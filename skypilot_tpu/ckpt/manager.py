@@ -39,6 +39,7 @@ import numpy as np
 
 from skypilot_tpu.ckpt import committer, manifest as manifest_lib, mirror
 from skypilot_tpu.ckpt import snapshot as snapshot_lib
+from skypilot_tpu.observability import blackbox
 
 CheckpointError = manifest_lib.CheckpointError
 
@@ -155,6 +156,11 @@ class AsyncCheckpointManager:
                 self._raise_worker_error_locked()
         snap = snapshot_lib.take(step, state)
         snap.stall_s = time.perf_counter() - stall0
+        # Flight recorder: each pipeline stage leaves an edge on the
+        # ring, so a preemption bundle shows exactly how far the last
+        # save got (snapshot taken? committed? mirrored?).
+        blackbox.record('ckpt.snapshot', step=int(step),
+                        stall_s=round(snap.stall_s, 6))
         if self.async_save:
             with self._lock:
                 self._snapshot = snap
@@ -207,12 +213,15 @@ class AsyncCheckpointManager:
             self._commit_root, snap.step, snap.arrays,
             host=self._host, num_hosts=self._num_hosts,
             barrier=self._barrier, keep=self.max_to_keep)
+        blackbox.record('ckpt.commit', step=int(snap.step),
+                        emergency=emergency)
         if self._mirror_root and self._host == 0:
             mirror.push_step(
                 os.path.join(self._commit_root,
                              manifest_lib.step_dirname(snap.step)),
                 self._mirror_root)
             mirror.gc_bucket(self._mirror_root, self.max_to_keep)
+            blackbox.record('ckpt.mirror', step=int(snap.step))
         save_s = time.perf_counter() - t0
         # skylint: locked(cross-thread publish kept DELIBERATELY bare —
         # _pending back-pressure means one persist in flight, so this is
@@ -263,6 +272,7 @@ class AsyncCheckpointManager:
         save_for_preemption path), one is taken now — that case is the
         only device access. Returns the durable step, or None when no
         durability could be guaranteed."""
+        blackbox.record('ckpt.emergency')
         if self._busy_thread == threading.get_ident():
             # Signal handler interrupted a manager entry on this very
             # thread (save/close/latest_step may hold the non-reentrant
@@ -358,10 +368,12 @@ class AsyncCheckpointManager:
             # skylint: locked(restore runs before the step loop starts —
             # no worker thread exists yet to race with)
             self._last_committed = step
+            source = ('local' if path.startswith(self._commit_root)
+                      else 'mirror')
+            blackbox.record('ckpt.restore', step=step, source=source)
             self._emit('restore', step=step,
                        seconds=time.perf_counter() - t0,
-                       source=('local' if path.startswith(
-                           self._commit_root) else 'mirror'))
+                       source=source)
             return state
         restored = self._orbax_restore(abstract_state)
         if restored is not None:

@@ -7,6 +7,7 @@ their planes land).
 """
 from __future__ import annotations
 
+import datetime as _dt
 import os
 import sys
 from typing import List, Optional, Tuple
@@ -450,6 +451,60 @@ def jobs_logs(job_id, no_follow):
     """Tail a managed job's logs."""
     from skypilot_tpu import jobs
     jobs.tail_logs(job_id, follow=not no_follow)
+
+
+@cli.group('debug')
+def debug_group():
+    """Incident debugging: black-box flight-recorder bundles
+    (docs/operations.md §Incident debugging)."""
+
+
+def _echo_bundle_listing(out: dict) -> None:
+    click.echo(f"Spool: {out.get('dir')} "
+               f"(recorder {'on' if out.get('enabled', True) else 'OFF'})")
+    rows = [{
+        'file': b['file'],
+        'when': _dt.datetime.fromtimestamp(b['ts']).strftime(
+            '%m-%d %H:%M:%S') if b.get('ts') else '-',
+        'proc': f"{b.get('proc')}[{b.get('pid')}]",
+        'trigger': b.get('trigger'),
+        'events': b.get('events'),
+        'reason': (b.get('reason') or '')[:60],
+    } for b in out.get('bundles', [])]
+    _echo_table(rows, [('file', 'BUNDLE'), ('when', 'WHEN'),
+                       ('proc', 'PROCESS'), ('trigger', 'TRIGGER'),
+                       ('events', 'EVENTS'), ('reason', 'REASON')])
+    dumps = out.get('sigquit_dumps') or []
+    if dumps:
+        click.echo(f'{len(dumps)} SIGQUIT stack dump(s): '
+                   + ', '.join(d['file'] for d in dumps[:8]))
+
+
+@debug_group.command('dump')
+@click.argument('cluster')
+@_clean_errors
+def debug_dump(cluster):
+    """Interrogate CLUSTER now: SIGQUIT every handler-registered
+    framework process on its head (faulthandler thread stacks land in
+    the bundle spool — no process is killed), then list the spool. The forensic first move
+    on a hung or misbehaving cluster."""
+    from skypilot_tpu import core
+    out = core.debug_dump(cluster)
+    signalled = out.get('signalled') or []
+    click.echo(f'Signalled {len(signalled)} framework process(es) '
+               f'on {cluster}.')
+    _echo_bundle_listing(out)
+
+
+@debug_group.command('bundles')
+@click.argument('cluster', required=False)
+@_clean_errors
+def debug_bundles(cluster):
+    """List committed incident bundles: CLUSTER's spool via its head
+    agent, or the local/API-server host's spool when no cluster is
+    named."""
+    from skypilot_tpu import core
+    _echo_bundle_listing(core.debug_bundles(cluster))
 
 
 @cli.group('api')

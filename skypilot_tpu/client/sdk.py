@@ -244,6 +244,22 @@ def jobs_goodput(job_id: int) -> str:
     return _get('jobs/goodput', {'job_id': job_id})
 
 
+def debug_dump(cluster_name: str) -> str:
+    """Interrogate a cluster's framework processes (SIGQUIT via the
+    head agent; stacks land in its incident-bundle spool) and return
+    the spool listing — `stpu debug dump`."""
+    return _post('debug/dump', {'cluster_name': cluster_name})
+
+
+def debug_bundles(cluster_name: Optional[str] = None) -> str:
+    """List committed incident bundles: a cluster's spool, or the API
+    server host's when no cluster is named."""
+    params: Dict[str, Any] = {}
+    if cluster_name:
+        params['cluster_name'] = cluster_name
+    return _get('debug/bundles', params)
+
+
 def api_cancel(request_id: str) -> bool:
     """Cancel an in-flight API request: kills its runner process group
     server-side (reference: ``sky api cancel``)."""

@@ -140,6 +140,29 @@ def job_status(cluster_name: str,
     return TpuGangBackend().job_status(handle, job_id)
 
 
+def debug_dump(cluster_name: str) -> Dict[str, Any]:
+    """Interrogate a cluster's framework processes through its head
+    agent (observability/blackbox.py CLI relayed over the agent's Exec
+    RPC): every handler-registered framework process gets SIGQUIT
+    (faulthandler stacks into the bundle spool, no process killed),
+    then the spool listing
+    comes back — `stpu debug dump <cluster>`."""
+    handle = _get_handle(cluster_name)
+    return TpuGangBackend().blackbox(handle, dump=True)
+
+
+def debug_bundles(
+        cluster_name: Optional[str] = None) -> Dict[str, Any]:
+    """List committed incident bundles: a cluster's spool via its head
+    agent, or — with no cluster named — the local (API-server host)
+    spool."""
+    if not cluster_name:
+        from skypilot_tpu.observability import blackbox
+        return blackbox.listing()
+    handle = _get_handle(cluster_name)
+    return TpuGangBackend().blackbox(handle, dump=False)
+
+
 def cost_report() -> List[Dict[str, Any]]:
     """Per-cluster accumulated cost estimate (reference ``core.py:1023``)."""
     out = []

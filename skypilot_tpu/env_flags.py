@@ -97,6 +97,18 @@ FLAGS: Tuple[Flag, ...] = (
     Flag('SKYTPU_TIMELINE_FILE_PATH', 'path', None,
          'When set, timeline-decorated control-plane calls append '
          'Chrome-trace events to this file.'),
+    # -- black-box flight recorder (observability/blackbox.py) --------
+    Flag('SKYTPU_BLACKBOX', 'bool', '1',
+         'Master switch for the black-box flight recorder (event ring '
+         '+ incident bundles).'),
+    Flag('SKYTPU_BLACKBOX_RING', 'int', '512',
+         'Per-process bounded event-ring size (events kept for '
+         'incident bundles).'),
+    Flag('SKYTPU_BLACKBOX_DIR', 'path',
+         '$SKYTPU_STATE_DIR/blackbox',
+         'Incident-bundle spool directory.'),
+    Flag('SKYTPU_BLACKBOX_KEEP', 'int', '32',
+         'Max committed incident bundles kept (oldest pruned).'),
     # -- tracing (observability/trace.py) -----------------------------
     Flag('SKYTPU_TRACE', 'bool', '1',
          'Master switch for request tracing.'),

@@ -27,6 +27,7 @@ import time
 import filelock
 
 from skypilot_tpu.jobs import scheduler, state
+from skypilot_tpu.observability import blackbox
 
 _IDLE_EXIT_TICKS = 5
 
@@ -125,6 +126,19 @@ def run(interval_s: float = 2.0) -> None:
             if acted:
                 _log_event('watchdog_sweep', nonterminal_jobs=nonterminal,
                            active_services=services, **acted)
+                blackbox.record('sched.watchdog', **{
+                    k: v for k, v in acted.items()
+                    if k in ('requeued', 'reaped_stale', 'gave_up',
+                             'freed', 'promoted')})
+                if any(acted.get(k) for k in
+                       ('requeued', 'reaped_stale', 'gave_up')):
+                    # A stalled/crashed controller is exactly the
+                    # "things went wrong" moment the flight recorder
+                    # exists for: freeze the evidence alongside the
+                    # one-line log.
+                    blackbox.dump(
+                        'watchdog',
+                        reason=json.dumps(acted, sort_keys=True)[:200])
             time.sleep(interval_s)
         _log_event('watchdog_exit', reason='job table fully terminal',
                    idle_ticks=idle)
@@ -134,6 +148,8 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--interval', type=float, default=2.0)
     args = parser.parse_args()
+    blackbox.set_process_label('jobs_watchdog')
+    blackbox.install_sigquit()
     run(interval_s=args.interval)
 
 

@@ -92,6 +92,11 @@ import numpy as np
 from skypilot_tpu.models import generate as gen_lib
 from skypilot_tpu.models import llama
 from skypilot_tpu.models import sampling
+# Flight recorder (observability/blackbox.py): record() is one deque
+# append under its own lock — no I/O, no host sync — so the engine
+# thread's admit/retire/dispatch edges are legal recording sites, and
+# _fail_everything can dump the ring as an incident bundle.
+from skypilot_tpu.observability import blackbox
 
 
 @dataclasses.dataclass
@@ -1150,6 +1155,15 @@ class ContinuousEngine:
         for req in doomed:  # dupes are safe: first set_exception wins
             if not req.future.done():
                 req.future.set_exception(exc)
+        # Black box: the failure cause and blast radius go on the ring,
+        # then the whole ring (plus stacks/traces/health) freezes into
+        # an incident bundle — the post-mortem for every stream this
+        # failure just killed. Waiters were failed FIRST (dump does
+        # file I/O); device-state rebuild runs after, so a rebuild
+        # crash cannot lose the evidence of the original fault.
+        blackbox.record('engine.fail', cause=repr(exc)[:200],
+                        doomed=len(doomed))
+        blackbox.dump('engine_failure', reason=repr(exc)[:200])
         # Fresh device state: the failed dispatch may have already
         # consumed (donation) or half-written the old buffers.
         self._init_device_state()
@@ -1436,10 +1450,14 @@ class ContinuousEngine:
                 self._admit_shared(*shared)
                 with self._lock:
                     self._admitting = []
+                blackbox.record('engine.admit', n=1, shared=True,
+                                prompt_len=len(shared[0].row))
                 continue
             self._prefill_group(reqs, free[:g])
             with self._lock:
                 self._admitting = []
+            blackbox.record('engine.admit', n=len(reqs), shared=False,
+                            prompt_len=max(len(r.row) for r in reqs))
 
     # skylint: engine-thread
     def _admit_shared(self, req: _Request, slot: int, nodes: list,
@@ -2453,6 +2471,7 @@ class ContinuousEngine:
                 top_ps[i] = r.top_p
                 active[i] = True
         now = time.perf_counter()
+        bubble_closed_ms = None
         with self._lock:
             self.peak_active = max(self.peak_active, int(active.sum()))
             if self._last_dispatch_t is not None:
@@ -2467,9 +2486,15 @@ class ContinuousEngine:
             if self._no_flight_since is not None:
                 # Host time spent with slots waiting and nothing on the
                 # device: the serial-mode bubble pipelining closes.
-                self.bubble_ms += (now - self._no_flight_since) * 1e3
+                bubble_closed_ms = (now - self._no_flight_since) * 1e3
+                self.bubble_ms += bubble_closed_ms
                 self._no_flight_since = None
             self.dispatches += 1
+        blackbox.record('engine.dispatch', active=int(active.sum()))
+        if bubble_closed_ms is not None:
+            blackbox.record('engine.bubble',
+                            ms=round(bubble_closed_ms, 3),
+                            edge='dispatch')
         tk, tp = _filters_or_none(top_ks, top_ps)
         if self.kv_layout == 'paged':
             self._cache, self._last, toks = _jit_paged_chunk(
@@ -2559,12 +2584,23 @@ class ContinuousEngine:
         for req in done:
             if not req.future.done():
                 req.future.set_result(req.tokens)
+            # Counts only — token ids/prompt text never enter the ring
+            # (the bundle redaction contract).
+            blackbox.record('engine.retire', emitted=len(req.tokens),
+                            max_new=req.max_new)
         dt_ms = (time.perf_counter() - t0) * 1e3
+        was_bubble = False
         with self._lock:
             if self._inflight is not None:
                 # a chunk computed meanwhile
                 self.host_overlap_ms += dt_ms
             elif not quiet:
                 self.bubble_ms += dt_ms  # serial: the device sat idle
+                was_bubble = True
+        if was_bubble:
+            # Captured under the lock above so the ring event can never
+            # disagree with the bubble_ms counter it mirrors.
+            blackbox.record('engine.bubble', ms=round(dt_ms, 3),
+                            edge='retire')
         # quiet flush: junk-only drop with no decode work waiting —
         # neither overlap nor bubble.

@@ -58,10 +58,27 @@ import random
 import threading
 import time
 import uuid
+import weakref
 from typing import Any, Dict, List, Optional
 
 TRACE_HEADER = 'X-SkyTPU-Trace'
 _VERSION = '00'
+
+# Live (not yet finalized) process-local root spans, weakly held: the
+# black-box flight recorder (observability/blackbox.py) snapshots them
+# into incident bundles so a crash dump shows what was IN FLIGHT, not
+# just what completed. Weak refs: a root abandoned without __exit__
+# (killed task) must not pin its span tree forever. Keyed by span id
+# (Span is an eq-dataclass, so instances are unhashable). All access
+# goes under _LIVE_LOCK: open_spans() runs on failure paths (engine
+# thread, /debug executors) concurrently with request threads
+# entering/exiting roots, and an unsynchronized snapshot can raise
+# "dictionary changed size during iteration" — which the bundle
+# builder would swallow, blanking trace data exactly when the process
+# is busiest.
+_LIVE_ROOTS: 'weakref.WeakValueDictionary[str, Span]' = \
+    weakref.WeakValueDictionary()
+_LIVE_LOCK = threading.Lock()
 
 _current: contextvars.ContextVar[Optional['Span']] = \
     contextvars.ContextVar('skytpu_trace_span', default=None)
@@ -114,7 +131,11 @@ class Span:
         if self.end is not None:
             d['duration_ms'] = round((self.end - self.start) * 1000.0, 3)
         if self.attrs:
-            d['attrs'] = self.attrs
+            # COPY: open_spans() serializes OPEN spans whose attrs a
+            # request thread may still be set_attr()-ing — handing the
+            # live dict to json.dump would abort the incident bundle
+            # with "dictionary changed size during iteration".
+            d['attrs'] = dict(self.attrs)
         return d
 
 
@@ -206,6 +227,9 @@ class _SpanCtx:
     def __enter__(self) -> Span:
         if self._root and self.span.bucket is None:
             self.span.bucket = []
+        if self._root:
+            with _LIVE_LOCK:
+                _LIVE_ROOTS[self.span.span_id] = self.span
         self._token = _current.set(self.span)
         return self.span
 
@@ -215,6 +239,8 @@ class _SpanCtx:
             self.span.attrs.setdefault('error', exc_type.__name__)
         _current.reset(self._token)
         if self._root:
+            with _LIVE_LOCK:
+                _LIVE_ROOTS.pop(self.span.span_id, None)
             _TRACER.finalize(self.span)
         else:
             _TRACER.record(self.span)
@@ -351,6 +377,37 @@ def add_span(name: str, start: float, end: float,
              bucket=anchor.bucket)
     _TRACER.record(s)
     return s
+
+
+def open_spans(limit: int = 32) -> List[Dict[str, Any]]:
+    """The OPEN (not yet finalized) traces of this process: each live
+    root span with the spans accumulated on its bucket so far. This is
+    the crash-time view — an incident bundle's link from "the process
+    wedged" to "inside which request, in which phase". Bounded and
+    copy-out; safe to call from failure paths."""
+    out: List[Dict[str, Any]] = []
+    # Bounded acquire: callers include SIGTERM handlers, which may have
+    # interrupted a thread inside the enter/exit critical section — a
+    # blocking wait would self-deadlock; better an open-span-less
+    # bundle than a hung preemption path.
+    if not _LIVE_LOCK.acquire(timeout=0.5):
+        return out
+    try:
+        roots = list(_LIVE_ROOTS.values())
+    finally:
+        _LIVE_LOCK.release()
+    for root in roots[:max(limit, 0)]:
+        spans = list(root.bucket or ())
+        out.append({
+            'trace_id': root.trace_id,
+            'name': root.name,
+            'start': root.start,
+            'open_ms': round((time.time() - root.start) * 1000.0, 3),
+            'attrs': dict(root.attrs),
+            'spans': [s.to_dict() for s in spans[:64]] + [root.to_dict()],
+        })
+    out.sort(key=lambda t: t['start'])
+    return out
 
 
 def reset() -> None:

@@ -9,6 +9,7 @@ from __future__ import annotations
 import argparse
 import threading
 
+from skypilot_tpu.observability import blackbox
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.autoscalers import make_autoscaler
 from skypilot_tpu.serve.load_balancer import LoadBalancer
@@ -180,14 +181,23 @@ class ServeController:
                 elif decision.num_prefill is not None:
                     # Role-pool targets (DualPoolAutoscaler): each pool
                     # scales on its own phase's saturation signal.
+                    blackbox.record('serve.scale', kind='pools',
+                                    prefill=decision.num_prefill,
+                                    decode=decision.num_decode or 0)
                     self.replica_manager.scale_pools(
                         decision.num_prefill, decision.num_decode or 0)
                 elif decision.num_spot is not None:
                     # Mixed-pool target (fallback autoscaler): spot fleet
                     # plus the on-demand safety/gap pool.
+                    blackbox.record('serve.scale', kind='mixed',
+                                    spot=decision.num_spot,
+                                    ondemand=decision.num_ondemand or 0)
                     self.replica_manager.scale_mixed(
                         decision.num_spot, decision.num_ondemand or 0)
                 elif target != self.replica_manager.num_alive():
+                    blackbox.record(
+                        'serve.scale', kind='flat', target=target,
+                        alive=self.replica_manager.num_alive())
                     self.replica_manager.scale_to(
                         target,
                         preferred_victims=decision.preferred_victims)
@@ -211,6 +221,10 @@ def main() -> None:
         from skypilot_tpu.utils import common_utils
         port = common_utils.find_free_port(30000)
     import os
+    # Operator interrogation + incident bundles for a wedged controller
+    # (kill -QUIT dumps stacks into the bundle spool, never stderr).
+    blackbox.set_process_label('serve_controller')
+    blackbox.install_sigquit()
     # The HA sweep (serve.reconcile_controllers) probes this pid; only the
     # detached-process path records one — in-process test controllers stay
     # out of the sweep.

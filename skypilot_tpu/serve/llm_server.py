@@ -420,6 +420,11 @@ class LlmServer:
             'exports': 0, 'export_bytes': 0, 'export_seconds': 0.0,
             'imports': 0, 'import_bytes': 0, 'import_seconds': 0.0,
             'import_rejects': 0, 'fallbacks_served': 0}
+        # Black-box flight recorder: incident bundles from this process
+        # embed the replica's live /health snapshot.
+        from skypilot_tpu.observability import blackbox
+        blackbox.set_process_label(f'llm_server:{self.role}')
+        blackbox.register_health_provider(self.health_snapshot)
 
     async def health(self, request: web.Request) -> web.Response:
         del request
@@ -429,7 +434,17 @@ class LlmServer:
             return web.json_response(
                 {'status': 'draining', 'model': self.model_name},
                 status=503)
-        body = {'status': 'ok', 'model': self.model_name,
+        return web.json_response(self.health_snapshot())
+
+    def health_snapshot(self) -> Dict[str, Any]:
+        """The /health body, factored sync so the black-box recorder's
+        incident bundles carry the exact snapshot operators already
+        read (blackbox.register_health_provider in __init__). Reports
+        'draining' once SIGTERM landed — the drain-triggered bundle
+        must not describe the replica as healthy (the async handler
+        503s before reaching here, so /health is unchanged)."""
+        body = {'status': 'draining' if self.draining else 'ok',
+                'model': self.model_name,
                 'quantize': self.quantize, 'tp': self.tp,
                 'kv_cache': self.kv_cache,
                 'max_len': self.max_len,
@@ -463,7 +478,7 @@ class LlmServer:
                 round(s['accepted'] / s['proposals'], 4)
                 if s['proposals'] else None)
             body['speculative'] = s
-        return web.json_response(body)
+        return body
 
     # -- batching worker ---------------------------------------------------
 
@@ -1488,11 +1503,27 @@ class LlmServer:
             None, trace_lib.debug_payload, dict(request.query))
         return web.json_response(payload)
 
+    async def debug_blackbox(self, request: web.Request) -> web.Response:
+        """Incident-bundle spool: ``?dump=1`` freezes this replica's
+        event ring into a bundle NOW (and inlines it), ``?file=``
+        fetches one, plain GET lists. Same scrape-token gate as
+        /metrics (bundles carry engine state and trace attrs); the LB
+        refuses to proxy /debug/*, so operators hit replicas directly.
+        Off-loop: dumping reads engine stats and writes a file."""
+        if not self._scrape_authorized(request):
+            return web.json_response({'error': 'unauthorized'},
+                                     status=401)
+        from skypilot_tpu.observability import blackbox
+        payload = await asyncio.get_event_loop().run_in_executor(
+            None, blackbox.debug_payload, dict(request.query))
+        return web.json_response(payload)
+
     def make_app(self) -> web.Application:
         app = web.Application()
         app.router.add_get('/health', self.health)
         app.router.add_get('/metrics', self.metrics)
         app.router.add_get('/debug/traces', self.debug_traces)
+        app.router.add_get('/debug/blackbox', self.debug_blackbox)
         app.router.add_post('/generate', self.generate)
         # KV handoff (disaggregated prefill/decode, serve/disagg.py).
         app.router.add_post('/v1/kv/export', self.kv_export)
@@ -1611,6 +1642,14 @@ def main() -> None:
     apply_jax_platform_env()
     parser = build_parser()
     args = parser.parse_args()
+    # SIGQUIT interrogation BEFORE backend init: a replica hung inside
+    # PJRT construction is exactly the process an operator most needs
+    # to `kill -QUIT` — registering only at app startup would leave
+    # the hung-in-init case with SIGQUIT's default kill disposition.
+    from skypilot_tpu.observability import blackbox
+    blackbox.set_process_label(
+        f'llm_server:{args.role or os.environ.get("SKYTPU_LLM_ROLE") or "colocated"}')
+    blackbox.install_sigquit()
     # Backend init under the shutdown-signal guard (AFTER argparse so
     # --help/usage never touches the chip): a drain/stop landing
     # mid-PJRT-construction is deferred until the client exists —
@@ -1639,6 +1678,8 @@ def main() -> None:
         # requests the LB already routed.
         import signal
 
+        from skypilot_tpu.observability import blackbox
+
         loop = asyncio.get_event_loop()
 
         def _graceful(*_):
@@ -1650,6 +1691,13 @@ def main() -> None:
                     server.engine.stop()
                 raise web.GracefulExit()
             server.draining = True
+            blackbox.record('server.drain',
+                            inflight=int(server._inflight))
+            # Preemption forensics: snapshot the ring before the drain
+            # window runs out (off-loop; dump is best-effort file I/O).
+            loop.run_in_executor(
+                None, lambda: blackbox.dump('sigterm',
+                                            reason='replica drain'))
 
             async def _finish():
                 deadline = loop.time() + float(

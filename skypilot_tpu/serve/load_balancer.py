@@ -29,6 +29,7 @@ from typing import Dict, List, Optional
 import aiohttp
 from aiohttp import web
 
+from skypilot_tpu.observability import blackbox
 from skypilot_tpu.serve.load_balancing_policies import (LoadBalancingPolicy,
                                                         make_policy)
 
@@ -70,6 +71,7 @@ class LoadBalancer:
         self._stats_lock = threading.Lock()
         self.disagg_stats = {'handoffs': 0, 'fallbacks': 0,
                              'resumed_streams': 0}
+        self._last_ready_set: set = set()
         self._runner: Optional[web.AppRunner] = None
         self._thread: Optional[threading.Thread] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -85,6 +87,18 @@ class LoadBalancer:
         decode traffic, which is the whole point of the split — unless
         prefill replicas are ALL that survives (fallback must keep
         serving)."""
+        # Health-flip edge for the flight recorder: the controller calls
+        # this every tick, so record only CHANGES to the ready set — a
+        # replica appearing/vanishing here is the LB-side trace of a
+        # health flip, scale event, or preemption.
+        new_set = set(endpoints)
+        if new_set != self._last_ready_set:
+            blackbox.record(
+                'lb.replica_set',
+                ready=len(new_set),
+                added=sorted(new_set - self._last_ready_set)[:8],
+                removed=sorted(self._last_ready_set - new_set)[:8])
+            self._last_ready_set = new_set
         self.roles = dict(roles or {})
         prefill = [e for e in endpoints
                    if self.roles.get(e) == 'prefill']
@@ -260,6 +274,8 @@ class LoadBalancer:
                                     f'{payload[:200]!r}')
                         with self._stats_lock:
                             self.disagg_stats['handoffs'] += 1
+                        blackbox.record('lb.handoff', mode=mode,
+                                        decode=decode, streamed=False)
                         return web.Response(
                             status=200, body=payload,
                             headers={'X-Served-By': decode,
@@ -369,6 +385,8 @@ class LoadBalancer:
                     if obj.get('done'):
                         with self._stats_lock:
                             self.disagg_stats['handoffs'] += 1
+                        blackbox.record('lb.handoff', mode=mode,
+                                        decode=decode, streamed=True)
                         await resp.write_eof()
                         return resp
                     sent += len(obj.get('tokens') or [])
@@ -393,6 +411,10 @@ class LoadBalancer:
         with self._stats_lock:
             self.disagg_stats['fallbacks'] += 1
             self.disagg_stats['resumed_streams'] += 1
+        # A decode replica died (or wedged) mid-stream: the highest-
+        # signal LB event a post-mortem can ask for.
+        blackbox.record('lb.fallback', reason='mid_stream',
+                        lost=exclude, sent=sent)
         replica = self._select_fallback(exclude)
         if replica is None:
             with contextlib.suppress(Exception):
@@ -463,6 +485,8 @@ class LoadBalancer:
         if fallback:
             with self._stats_lock:
                 self.disagg_stats['fallbacks'] += 1
+            blackbox.record('lb.fallback', reason='handoff_failed',
+                            replica=replica)
             headers['X-SkyTPU-Disagg-Fallback'] = '1'
         self._note_request(replica)
         self.policy.on_request_start(replica)

@@ -143,13 +143,47 @@ def _pctile(sorted_vals, q: int):
     return round(v, 3) if v is not None else None
 
 
+async def _dump_replica_bundles(session, endpoints, out_dir: str) -> list:
+    """--dump-on-error: fetch /debug/blackbox?dump=1 from every replica
+    endpoint and save each bundle next to the report, so a failed run
+    ships its own forensics (CI probe failures become self-diagnosing).
+    Best-effort per endpoint — a dead replica is often WHY the run
+    failed and must not hide the survivors' bundles."""
+    import os
+    os.makedirs(out_dir, exist_ok=True)
+    saved = []
+    for ep in endpoints:
+        base = ep if ep.startswith('http') else f'http://{ep}'
+        tag = base.split('//', 1)[-1].replace(':', '_').replace('/', '_')
+        path = os.path.join(out_dir, f'blackbox-{tag}.json')
+        try:
+            async with session.get(
+                    f'{base}/debug/blackbox', params={'dump': '1'},
+                    timeout=__import__('aiohttp').ClientTimeout(
+                        total=30)) as r:
+                body = await r.text()
+                if r.status != 200:
+                    saved.append({'endpoint': base, 'error':
+                                  f'{r.status}: {body[:200]}'})
+                    continue
+            with open(path, 'w', encoding='utf-8') as f:
+                f.write(body)
+            saved.append({'endpoint': base, 'path': path})
+        except Exception as e:  # noqa: BLE001 — see docstring
+            saved.append({'endpoint': base,
+                          'error': f'{type(e).__name__}: {e}'})
+    return saved
+
+
 async def run_load(url: str, requests_total: int, concurrency: int,
                    prompt_len, max_new, vocab: int,
                    stream: bool = False, mix=None, tenants: int = 1,
                    shared_prefix: float = 0.0,
                    shared_prefix_len: int = 32,
                    long_prompt_frac: float = 0.0,
-                   long_prompt_len: int = 512) -> dict:
+                   long_prompt_len: int = 512,
+                   dump_on_error: str = '',
+                   dump_endpoints=None) -> dict:
     import aiohttp
     prompt_span, max_new_span = _span(prompt_len), _span(max_new)
     sem = asyncio.Semaphore(concurrency)
@@ -230,6 +264,12 @@ async def run_load(url: str, requests_total: int, concurrency: int,
                 }
             except Exception:  # noqa: BLE001 — report is best-effort
                 engine_share = None
+
+        incident_bundles = None
+        failed = sum(1 for _, r in results if not r[0])
+        if dump_on_error and failed:
+            incident_bundles = await _dump_replica_bundles(
+                session, dump_endpoints or [url], dump_on_error)
 
     flat = [r for _, r in results]
     oks = [r for r in flat if r[0]]
@@ -325,6 +365,8 @@ async def run_load(url: str, requests_total: int, concurrency: int,
         extra['per_class'] = per_class
         if tenants > 1:
             extra['tenants'] = tenants
+    if incident_bundles is not None:
+        extra['incident_bundles'] = incident_bundles
     return {
         **extra,
         'requests': requests_total,
@@ -398,7 +440,24 @@ def main() -> None:
                         help='prompt length for the long sub-mix '
                              '(default 512; keep < server max_len '
                              'minus max_new)')
+    parser.add_argument('--dump-on-error', default='', metavar='DIR',
+                        help='on any failed request, fetch '
+                             '/debug/blackbox?dump=1 from every replica '
+                             '(see --replica-endpoints) and save the '
+                             'incident bundles into DIR next to the '
+                             'report — probe/CI failures ship their own '
+                             'forensics')
+    parser.add_argument('--replica-endpoints', default=None,
+                        help='comma-separated replica endpoints '
+                             '(host:port) to dump bundles from; default '
+                             'is the --url target itself (the LB does '
+                             'not proxy /debug/*, so list replicas '
+                             'explicitly when driving an LB)')
     args = parser.parse_args()
+    dump_eps = None
+    if args.replica_endpoints:
+        dump_eps = [e.strip() for e in args.replica_endpoints.split(',')
+                    if e.strip()]
     out = asyncio.run(run_load(args.url.rstrip('/'), args.requests,
                                args.concurrency, args.prompt_len,
                                args.max_new_tokens, args.vocab,
@@ -407,7 +466,9 @@ def main() -> None:
                                shared_prefix=args.shared_prefix,
                                shared_prefix_len=args.shared_prefix_len,
                                long_prompt_frac=args.long_prompt_frac,
-                               long_prompt_len=args.long_prompt_len))
+                               long_prompt_len=args.long_prompt_len,
+                               dump_on_error=args.dump_on_error,
+                               dump_endpoints=dump_eps))
     print(json.dumps(out))
 
 

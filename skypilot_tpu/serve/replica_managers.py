@@ -17,6 +17,7 @@ from typing import Dict, List, Optional
 import requests as requests_lib
 
 from skypilot_tpu import core, exceptions, execution, global_user_state
+from skypilot_tpu.observability import blackbox
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve import spot_placer as spot_placer_lib
 from skypilot_tpu.serve.service_spec import ServiceSpec
@@ -90,6 +91,10 @@ class ReplicaManager:
         replica_id = self._next_replica_id
         self._next_replica_id += 1
         cluster = self._cluster_name(replica_id)
+        blackbox.record('serve.replica_launch', replica=replica_id,
+                        role=role or 'colocated',
+                        spot=bool(use_spot) if use_spot is not None
+                        else None)
         serve_state.upsert_replica(self.service_name, replica_id,
                                    serve_state.ReplicaStatus.PROVISIONING,
                                    cluster_name=cluster,
@@ -144,6 +149,8 @@ class ReplicaManager:
 
     def terminate_replica(self, replica_id: int, failed: bool = False) -> None:
         cluster = self._cluster_name(replica_id)
+        blackbox.record('serve.replica_terminate', replica=replica_id,
+                        failed=failed)
         serve_state.upsert_replica(
             self.service_name, replica_id,
             serve_state.ReplicaStatus.FAILED if failed
@@ -235,6 +242,15 @@ class ReplicaManager:
                 if status == serve_state.ReplicaStatus.READY or age > grace:
                     # Was ready (or exceeded its grace period) and now is
                     # not: tear down and replace.
+                    # Preemption notice for the flight recorder: WHY a
+                    # replica vanished is the question incident bundles
+                    # exist to answer at fleet scale.
+                    blackbox.record(
+                        'serve.replica_dark', replica=rid,
+                        endpoint=endpoint,
+                        was_ready=(status ==
+                                   serve_state.ReplicaStatus.READY),
+                        spot=bool(rep.get('use_spot')))
                     serve_state.upsert_replica(
                         self.service_name, rid,
                         serve_state.ReplicaStatus.NOT_READY, health='')

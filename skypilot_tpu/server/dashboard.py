@@ -21,6 +21,8 @@ Routes (registered by ``server.py``):
   GET /dashboard/api/infra                 -> clouds/catalogs/server health
   GET /dashboard/api/config                -> layered config (redacted)
   GET /dashboard/api/fleet                 -> heartbeats + job goodput
+  GET /dashboard/api/incidents             -> incident-bundle spool list
+  GET /dashboard/api/incident/{file}       -> one full incident bundle
 """
 from __future__ import annotations
 
@@ -527,6 +529,30 @@ async def api_logs_search(request: web.Request) -> web.Response:
     return await _json(request, logs_search_view, q, limit)
 
 
+def incidents_view() -> Dict[str, Any]:
+    """The incident panel's data: the API-server host's bundle spool
+    (observability/blackbox.py), newest first. Replica-local bundles
+    are fetched from the replicas' own /debug/blackbox or via
+    `stpu debug dump <cluster>` — the panel documents that."""
+    from skypilot_tpu.observability import blackbox
+    return {'dir': blackbox.spool_dir(), 'enabled': blackbox.enabled(),
+            'bundles': blackbox.list_bundles(limit=50)}
+
+
+def incident_detail(fname: str) -> Optional[Dict[str, Any]]:
+    from skypilot_tpu.observability import blackbox
+    return blackbox.read_bundle(fname)
+
+
+async def api_incidents(request: web.Request) -> web.Response:
+    return await _json(request, incidents_view)
+
+
+async def api_incident(request: web.Request) -> web.Response:
+    return await _json(request, incident_detail,
+                       request.match_info['file'])
+
+
 async def api_infra(request: web.Request) -> web.Response:
     return await _json(request, infra_view)
 
@@ -555,6 +581,8 @@ def add_routes(app: web.Application) -> None:
     app.router.add_get('/dashboard/api/infra', api_infra)
     app.router.add_get('/dashboard/api/config', api_config)
     app.router.add_get('/dashboard/api/fleet', api_fleet)
+    app.router.add_get('/dashboard/api/incidents', api_incidents)
+    app.router.add_get('/dashboard/api/incident/{file}', api_incident)
 
 
 _PAGE = """<!doctype html>
@@ -587,7 +615,8 @@ _PAGE = """<!doctype html>
 </style></head><body>
 <h1>skypilot-tpu <span id="ts"></span></h1>
 <nav><a href="#/">overview</a> <a href="#/metrics">metrics</a>
- <a href="#/traces">traces</a> <a href="#/fleet">fleet</a>
+ <a href="#/traces">traces</a> <a href="#/incidents">incidents</a>
+ <a href="#/fleet">fleet</a>
  <a href="#/logs">logs</a> <a href="#/infra">infra</a>
  <a href="#/config">config</a> <a href="#/users">users</a>
  <a href="#/workspaces">workspaces</a></nav>
@@ -1019,15 +1048,77 @@ function waterfall(tr){
     </h2><table>${rows}</table>`;
 }
 
-async function tracesView(){
-  const d = await J('debug/traces?slowest=1&limit=10');
+async function tracesView(traceId){
+  const d = await J(traceId
+      ? 'debug/traces?trace_id=' + encodeURIComponent(traceId)
+      : 'debug/traces?slowest=1&limit=10');
   if(!d.traces.length)
-    return '<h2>Traces</h2><p>(no completed traces yet' +
-      (d.enabled ? '' : ' — tracing is disabled, set SKYTPU_TRACE=1') +
+    return '<h2>Traces</h2><p>(no ' +
+      (traceId ? `trace ${esc(traceId.slice(0,16))} in the ring — it `+
+                 'may have rotated out; the incident bundle retains '+
+                 'its frozen copy' : 'completed traces yet' +
+      (d.enabled ? '' : ' — tracing is disabled, set SKYTPU_TRACE=1')) +
       ')</p>';
-  return `<h2>Slowest recent traces <span style="color:#888;font-size:12px
+  return `<h2>${traceId ? 'Trace ' + esc(traceId.slice(0,16))
+    : 'Slowest recent traces'} <span style="color:#888;font-size:12px
     ">ring of completed traces; filter via /debug/traces?trace_id=…
     </span></h2>` + d.traces.map(waterfall).join('');
+}
+
+// Incident panel (observability/blackbox.py): the API-server host's
+// bundle spool. Each bundle links to its full JSON and — via the trace
+// ids frozen inside it — to the trace waterfall.
+async function incidentsView(){
+  const d = await J('dashboard/api/incidents');
+  const head = `<h2>Incident bundles <span style="color:#888;
+    font-size:12px">${esc(d.dir)}${d.enabled ? '' :
+    ' — recorder DISABLED (SKYTPU_BLACKBOX=0)'}; replica-local bundles:
+    replica /debug/blackbox or 'stpu debug dump &lt;cluster&gt;'
+    </span></h2>`;
+  if(!d.bundles.length)
+    return head + '<p>(no incident bundles — nothing has gone wrong ' +
+      'on this host, or nothing dumped yet)</p>';
+  return head + table(
+    ['when','process','trigger','events','reason','traces',''],
+    d.bundles,
+    b=>`<tr><td>${T(b.ts)}</td><td>${esc(b.proc)}[${esc(b.pid)}]</td>
+     <td>${B(b.trigger)}</td><td>${esc(b.events)}</td>
+     <td>${esc(b.reason)}</td>
+     <td>${(b.trace_ids||[]).map(t=>
+        `<a href="#/traces/${esc(t)}">${esc(t.slice(0,12))}</a>`)
+        .join(' ')}</td>
+     <td><a href="#/incidents/${esc(b.file)}">open</a></td></tr>`);
+}
+
+async function incidentView(file){
+  let b = null;
+  try{
+    b = await J('dashboard/api/incident/' + encodeURIComponent(file));
+  }catch(e){ /* 404 = rotated out */ }
+  if(!b)
+    return `<h2>Bundle ${esc(file)}</h2><p>(not in the spool — it may
+      have rotated out; bundles keep the newest SKYTPU_BLACKBOX_KEEP
+      files)</p>`;
+  const evs = (b.events||[]).slice(-100).reverse();
+  const open_ = ((b.traces||{}).open)||[];
+  return `<h2>Bundle ${esc(file)}</h2>` + kv({
+      when: T(b.ts), process: `${esc(b.proc)}[${esc(b.pid)}]`,
+      trigger: B(b.trigger), reason: esc(b.reason),
+      events: esc((b.events||[]).length),
+      'open traces at dump': esc(open_.length)}) +
+    `<h2>Ring (newest first)</h2>` + table(
+      ['t','event','attrs'], evs,
+      e=>`<tr><td>${T(e.ts)}</td><td>${esc(e.name)}</td>
+       <td><code style="font-size:11px">${
+         esc(JSON.stringify(e.attrs||{}))}</code></td></tr>`) +
+    (open_.length ? `<h2>Open traces at dump time</h2>` +
+      open_.map(t=>`<p><a href="#/traces/${esc(t.trace_id)}">${
+        esc(t.trace_id.slice(0,16))}</a> ${esc(t.name)} — open ${
+        (t.open_ms/1000).toFixed(1)}s</p>`).join('') : '') +
+    `<h2>Thread stacks</h2><pre class="log">${
+      esc(b.stacks||'(none captured)')}</pre>` +
+    `<h2>Env flags</h2><pre class="log">${
+      esc(JSON.stringify(b.env_flags||{}, null, 2))}</pre>`;
 }
 
 async function logsView(query){
@@ -1101,7 +1192,12 @@ async function route(){
     else if(h === '#/users') html = await usersView();
     else if(h === '#/workspaces') html = await workspacesView();
     else if(h === '#/metrics') html = await metricsView();
+    else if((m = h.match(/^#\\/traces\\/(.+)$/)))
+      html = await tracesView(decodeURIComponent(m[1]));
     else if(h === '#/traces') html = await tracesView();
+    else if((m = h.match(/^#\\/incidents\\/(.+)$/)))
+      html = await incidentView(decodeURIComponent(m[1]));
+    else if(h === '#/incidents') html = await incidentsView();
     else if(h === '#/fleet') html = await fleetView();
     else if((m = h.match(/^#\\/logs(?:\\/(.*))?$/)))
       html = await logsView(m[1] ? decodeURIComponent(m[1]) : '');

@@ -25,6 +25,7 @@ _OPS_LANES: Dict[str, str] = {
     'status': 'short', 'queue': 'short', 'cost_report': 'short',
     'cancel': 'short', 'autostop': 'short', 'jobs_queue': 'short',
     'jobs_cancel': 'short', 'job_status': 'short', 'check': 'short',
+    'debug_dump': 'short', 'debug_bundles': 'short',
 }
 
 

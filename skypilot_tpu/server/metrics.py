@@ -130,6 +130,25 @@ _DISAGG_FALLBACK = Gauge(
     'Requests this replica served whole after the LB abandoned a KV '
     'handoff (export/transfer/import failure or a decode replica '
     'dying mid-stream).', registry=SERVING_REGISTRY)
+# Black-box flight recorder (observability/blackbox.py): incident
+# bundles THIS PROCESS has written, by trigger — a nonzero
+# engine_failure/watchdog count is the alert that forensics exist to
+# fetch (`stpu debug bundles`, /debug/blackbox). A gauge mirroring the
+# recorder's own cumulative counters (restart legitimately resets), in
+# the serving registry so replicas and the API server both expose it.
+# The label set is the recorder's bounded TRIGGERS vocabulary.
+_INCIDENT_BUNDLES = Gauge(
+    'skytpu_incident_bundles_total',
+    'Incident bundles written by this process since start, by trigger '
+    '(engine_failure | sigterm | watchdog | probe_deadline | manual).',
+    ['trigger'], registry=SERVING_REGISTRY)
+
+
+def _refresh_incident_gauge() -> None:
+    from skypilot_tpu.observability import blackbox
+    _INCIDENT_BUNDLES.clear()
+    for trigger, n in blackbox.dump_counts().items():
+        _INCIDENT_BUNDLES.labels(trigger=trigger).set(n)
 
 API_REQUEST = Histogram(
     'skytpu_api_request_seconds',
@@ -332,6 +351,7 @@ def _refresh_gauges() -> None:
 
 def render() -> bytes:
     _refresh_gauges()
+    _refresh_incident_gauge()
     return generate_latest(REGISTRY) + generate_latest(SERVING_REGISTRY)
 
 
@@ -342,6 +362,7 @@ def render_serving(engine: Optional[Dict[str, Any]] = None,
     point-in-time engine/queue gauges from the stats dicts the replica
     already maintains for /health. ``disagg`` is the server-level
     KV-handoff accounting (serve/llm_server.py disagg_stats)."""
+    _refresh_incident_gauge()
     if disagg:
         for direction, prefix in (('export', 'export'),
                                   ('import', 'import')):

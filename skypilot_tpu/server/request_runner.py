@@ -112,6 +112,17 @@ def _run_op(payload: Dict[str, Any]) -> Any:
     if op == 'jobs_goodput':
         from skypilot_tpu import jobs
         return jobs.goodput(payload['job_id'])
+    if op == 'debug_dump':
+        # Interrogates (SIGQUITs) the cluster's framework processes via
+        # its head agent — ownership-gated like other cluster verbs.
+        from skypilot_tpu import core
+        _check_access(payload, payload['cluster_name'])
+        return core.debug_dump(payload['cluster_name'])
+    if op == 'debug_bundles':
+        from skypilot_tpu import core
+        if payload.get('cluster_name'):
+            _check_access(payload, payload['cluster_name'])
+        return core.debug_bundles(payload.get('cluster_name'))
     raise ValueError(f'Unknown op {op!r}')
 
 

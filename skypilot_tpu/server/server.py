@@ -175,6 +175,19 @@ async def debug_traces(request: web.Request) -> web.Response:
     return web.json_response(payload)
 
 
+@routes.get('/debug/blackbox')
+async def debug_blackbox(request: web.Request) -> web.Response:
+    """Incident-bundle spool of the API-server host (token-gated by the
+    auth middleware like every non-exempt path): ``?dump=1`` freezes
+    this process's flight-recorder ring into a bundle now, ``?file=``
+    fetches one bundle, plain GET lists. File I/O runs off the event
+    loop (same discipline as /debug/traces)."""
+    from skypilot_tpu.observability import blackbox
+    payload = await asyncio.get_event_loop().run_in_executor(
+        None, blackbox.debug_payload, dict(request.query))
+    return web.json_response(payload)
+
+
 @routes.get('/api/v1/api/requests')
 async def api_requests(request: web.Request) -> web.Response:
     del request
@@ -236,6 +249,7 @@ _API_OPS = frozenset((
     'launch', 'exec', 'down', 'stop', 'start', 'autostop', 'cancel',
     'status', 'queue', 'cost_report', 'job_status', 'check',
     'jobs/launch', 'jobs/queue', 'jobs/cancel', 'jobs/goodput',
+    'debug/dump', 'debug/bundles',
     'api/get', 'api/stream', 'api/requests', 'api/cancel'))
 
 
@@ -330,6 +344,12 @@ def make_app() -> web.Application:
     app.router.add_get('/api/v1/jobs/queue', _make_get('jobs_queue'))
     app.router.add_post('/api/v1/jobs/cancel', _make_post('jobs_cancel'))
     app.router.add_get('/api/v1/jobs/goodput', _make_get('jobs_goodput'))
+    # Incident forensics (observability/blackbox.py): dump interrogates
+    # a cluster's framework processes via its head agent; bundles lists
+    # a cluster's spool (or this server host's, with no cluster named).
+    app.router.add_post('/api/v1/debug/dump', _make_post('debug_dump'))
+    app.router.add_get('/api/v1/debug/bundles',
+                       _make_get('debug_bundles'))
     app.router.add_post('/oauth/login/start', oauth_login_start)
     app.router.add_post('/oauth/login/poll', oauth_login_poll)
     return app
@@ -340,6 +360,16 @@ def main() -> None:
     parser.add_argument('--host', default='127.0.0.1')
     parser.add_argument('--port', type=int, default=DEFAULT_PORT)
     args = parser.parse_args()
+    # Flight recorder (observability/blackbox.py): kill -QUIT dumps all
+    # thread stacks into the bundle spool — a hung API server can be
+    # interrogated without killing it — and incident bundles carry the
+    # same health body /health serves.
+    from skypilot_tpu.observability import blackbox
+    blackbox.set_process_label('api_server')
+    blackbox.install_sigquit()
+    blackbox.register_health_provider(
+        lambda: {'status': 'healthy', 'api_version': '1',
+                 'version': __version__})
     web.run_app(make_app(), host=args.host, port=args.port,
                 print=lambda *a: None)
 

@@ -18,6 +18,25 @@ import argparse
 import time
 
 
+def make_sigterm_handler(mgr):
+    """The preemption SIGTERM handler, factored for tests: emergency-
+    persist FIRST (durability beats forensics — the ckpt write races
+    the SIGKILL escalation deadline and must not wait on a bundle),
+    THEN freeze the flight-recorder ring into an incident bundle, THEN
+    exit 143. The bundle answers the fleet-scale question the ledger
+    alone cannot: where exactly was this trainer when the preemption
+    landed (ckpt.snapshot/commit/mirror edges + thread stacks)."""
+    from skypilot_tpu.observability import blackbox
+
+    def _on_sigterm(signum, frame):
+        del signum, frame
+        mgr.emergency_persist()
+        blackbox.dump('sigterm', reason='trainer preemption')
+        raise SystemExit(143)
+
+    return _on_sigterm
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--model', default='tiny',
@@ -154,12 +173,7 @@ def main() -> None:
         # device: safe even mid-step (ckpt.manager.emergency_persist).
         import signal as signal_lib
 
-        def _on_sigterm(signum, frame):
-            del signum, frame
-            mgr.emergency_persist()
-            raise SystemExit(143)
-
-        signal_lib.signal(signal_lib.SIGTERM, _on_sigterm)
+        signal_lib.signal(signal_lib.SIGTERM, make_sigterm_handler(mgr))
 
     dataset = None
     if args.data:

@@ -295,13 +295,25 @@ import faulthandler, os, signal, sys, threading, time
 phase_f = open(sys.argv[1], 'w', buffering=1)
 faulthandler.register(signal.SIGUSR1, file=sys.stderr, all_threads=True)
 _last = [time.monotonic(), 'spawn']
+pkg_root = os.environ.get('SKYTPU_PKG_ROOT')
+if pkg_root and pkg_root not in sys.path:
+    sys.path.insert(0, pkg_root)
+# Black-box flight recorder (import-light; best-effort — a broken
+# package must never break the probe): phase crossings land on the
+# ring, and a deadline abort freezes ring + thread stacks into an
+# incident bundle in the probe scratch dir (SKYTPU_BLACKBOX_DIR, set
+# by probe_backend), un-blinding "the TPU probe hung" from a stuck
+# phase NAME into an actionable dump.
+try:
+    from skypilot_tpu.observability import blackbox as _bb
+except Exception:
+    _bb = None
 def phase(p):
     phase_f.write(p + '\n')
     _last[0] = time.monotonic()
     _last[1] = p
-pkg_root = os.environ.get('SKYTPU_PKG_ROOT')
-if pkg_root and pkg_root not in sys.path:
-    sys.path.insert(0, pkg_root)
+    if _bb is not None:
+        _bb.record('probe.phase', phase=p)
 phase('python-started')
 # Hard deadlines: if init NEVER completes the child must eventually
 # give up — an abrupt exit is unavoidable then, but both deadlines sit
@@ -320,10 +332,15 @@ def _watchdog():
     while not init_done.wait(1.0):
         now = time.monotonic()
         if now - _last[0] > phase_s:
-            phase('phase-deadline-abort:' + _last[1])
+            stuck = _last[1]
+            phase('phase-deadline-abort:' + stuck)
+            if _bb is not None:
+                _bb.dump('probe_deadline', reason='stuck phase: ' + stuck)
             os._exit(9)
         if now > t_hard:
             phase('hard-deadline-abort')
+            if _bb is not None:
+                _bb.dump('probe_deadline', reason='hard deadline')
             os._exit(9)
 threading.Thread(target=_watchdog, daemon=True).start()
 # Deterministic hang injection (tests): hold here until the named file
@@ -479,6 +496,7 @@ def probe_backend(timeout_s: float = 90.0) -> Dict[str, Any]:
                     f"{prior['age_s']}s) is still inside backend init; "
                     'refusing to start a second claimant'),
                 'hang_stack': None, 'stderr_tail': None,
+                'bundle': None,
             }
         try:  # stale claim (dead/recycled pid): clean it under the lock
             os.unlink(_PROBE_PIDFILE)
@@ -490,7 +508,11 @@ def probe_backend(timeout_s: float = 90.0) -> Dict[str, Any]:
     # Files (not pipes) + new session: the child can outlive this probe
     # call without blocking on a dead pipe reader or catching our
     # process-group signals.
-    child_env = dict(os.environ, SKYTPU_PKG_ROOT=_PKG_ROOT)
+    # The child's incident-bundle spool is its scratch dir: a
+    # deadline-aborting child dumps ring + stacks there, and the report
+    # below carries the bundle home before the scratch dir is cleaned.
+    child_env = dict(os.environ, SKYTPU_PKG_ROOT=_PKG_ROOT,
+                     SKYTPU_BLACKBOX_DIR=td)
     with open(err_path, 'wb') as err_f:
         proc = subprocess.Popen(
             [sys.executable, '-c', _PROBE_CHILD, phases_path,
@@ -555,6 +577,19 @@ def probe_backend(timeout_s: float = 90.0) -> Dict[str, Any]:
                 os.close(cleanup_fd)
         except OSError:
             pass
+    # Harvest the child's self-dumped incident bundle (deadline aborts
+    # write one into the scratch spool) BEFORE the scratch dir goes.
+    bundle = None
+    try:
+        bundle_names = sorted(n for n in os.listdir(td)
+                              if n.startswith('incident-')
+                              and n.endswith('.json'))
+        if bundle_names:
+            with open(os.path.join(td, bundle_names[-1]),
+                      encoding='utf-8') as f:
+                bundle = json.load(f)
+    except (OSError, ValueError):
+        bundle = None
     if proc.poll() is not None:
         import shutil
         shutil.rmtree(td, ignore_errors=True)
@@ -599,6 +634,10 @@ def probe_backend(timeout_s: float = 90.0) -> Dict[str, Any]:
         'diagnosis': diagnosis,
         'hang_stack': hang_stack,
         'stderr_tail': None if ok else err_text[-1500:],
+        # The child's self-dumped incident bundle (deadline aborts):
+        # ring of phase crossings + all-thread stacks at the moment of
+        # the abort. None on success/crash-without-dump.
+        'bundle': bundle,
     }
 
 

@@ -34,6 +34,14 @@ os.environ.setdefault(
     'SKYTPU_SESSION_FINGERPRINT',
     f'pytest-{os.uname().nodename}-{os.getpid()}-{int(__import__("time").time())}')
 
+# Keep black-box incident bundles out of the operator's real spool:
+# engine tests legitimately trip _fail_everything (stop with live work,
+# injected faults) and each trip dumps a bundle to the spool dir.
+os.environ.setdefault(
+    'SKYTPU_BLACKBOX_DIR',
+    os.path.join(__import__('tempfile').gettempdir(),
+                 f'skytpu-test-blackbox-{os.getpid()}'))
+
 import pytest
 
 # Suite tiers for CI (`make test-fast` < 5 min): modules dominated by jax

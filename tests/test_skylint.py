@@ -17,6 +17,7 @@ import skylint  # noqa: E402
 from skylint.checkers import base as base_mod  # noqa: E402
 from skylint.checkers import engine_thread  # noqa: E402
 from skylint.checkers import env_flags as env_mod  # noqa: E402
+from skylint.checkers import event_names as event_mod  # noqa: E402
 from skylint.checkers import host_sync  # noqa: E402
 from skylint.checkers import lock_discipline  # noqa: E402
 from skylint.checkers import metric_names  # noqa: E402
@@ -333,6 +334,72 @@ def test_metric_unknown_reference_in_serve_scope(tmp_path):
 def test_metric_cross_check_clean_on_real_tree():
     files = skylint.load_files()
     findings = metric_names.MetricNames().check_tree(files, skylint.ROOT)
+    assert findings == [], '\n'.join(str(f) for f in findings)
+
+
+# -- event-name (black-box flight-recorder registry) -------------------------
+
+
+def test_event_undeclared_record_flagged_with_hint(tmp_path):
+    sf = _sf(tmp_path, '''
+        from skypilot_tpu.observability import blackbox
+        blackbox.record('engine.admitx', n=1)
+        ''')
+    findings = event_mod.EventNames().check_file(sf)
+    assert _rules(findings) == ['event-name']
+    assert 'engine.admitx' in findings[0].message
+    assert "'engine.admit'" in findings[0].message  # did-you-mean
+
+
+def test_event_dynamic_name_flagged_and_suppressible(tmp_path):
+    sf = _sf(tmp_path, '''
+        from skypilot_tpu.observability import blackbox as bb
+        name = 'engine.admit'
+        bb.record(name)
+        bb.record(name)  # skylint: allow-event(fixture: dynamic name)
+        ''')
+    findings = event_mod.EventNames().check_file(sf)
+    assert len(findings) == 1
+    assert 'string literal' in findings[0].message
+
+
+def test_event_unrelated_record_methods_ignored(tmp_path):
+    # trace.py's ring, heartbeat recorders etc. also have .record
+    # methods — only callees resolving to the blackbox module count.
+    sf = _sf(tmp_path, '''
+        class Ring:
+            def record(self, item):
+                return item
+        Ring().record('not.an.event')
+        ''')
+    assert event_mod.EventNames().check_file(sf) == []
+
+
+def test_event_declared_ok_via_function_import(tmp_path):
+    sf = _sf(tmp_path, '''
+        from skypilot_tpu.observability.blackbox import record
+        record('engine.admit', n=1)
+        ''')
+    assert event_mod.EventNames().check_file(sf) == []
+
+
+def test_event_dead_declaration_detected(tmp_path):
+    reg = tmp_path / 'skypilot_tpu' / 'observability' / 'blackbox.py'
+    reg.parent.mkdir(parents=True)
+    reg.write_text(textwrap.dedent('''
+        def Event(name, doc):
+            return (name, doc)
+        EVENTS = (Event('ghost.event', 'declared, never recorded'),)
+        '''), encoding='utf-8')
+    findings = event_mod.EventNames().check_tree([], tmp_path)
+    assert _rules(findings) == ['event-name']
+    assert 'ghost.event' in findings[0].message
+    assert 'dead event' in findings[0].message
+
+
+def test_event_cross_check_clean_on_real_tree():
+    files = skylint.load_files()
+    findings = event_mod.EventNames().check_tree(files, skylint.ROOT)
     assert findings == [], '\n'.join(str(f) for f in findings)
 
 

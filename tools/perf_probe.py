@@ -66,6 +66,17 @@ surviving step checksum-verifies, and the torn partial is GC'd;
 with its checkpoint dir on a mounted bucket — the goodput ledger
 carries nonzero checkpoint save+restore accounting and the
 skytpu_ckpt_* gauges expose it. Also wired into ``make verify``.
+
+``--blackbox`` runs the black-box flight-recorder gate
+(observability/blackbox.py): greedy output byte-identical from a
+recorder-ON replica vs a SKYTPU_BLACKBOX=0 replica; a
+/debug/blackbox?dump=1 round trip over HTTP whose bundle holds the
+engine's admit/dispatch/retire ring events, the /health snapshot, and
+faulthandler thread stacks (and the disabled replica dumps nothing);
+and a kill -9 of one of two replicas under load — serving continues on
+the survivor and the survivor's bundle merged with the LB process's
+own ring reconstructs the timeline (ready-set flip, then survivor
+dispatches). CPU-only, wired into ``make verify``.
 """
 import json
 import os
@@ -683,12 +694,17 @@ def goodput_probe() -> dict:
 
 
 def _spawn_replica(role: str, port: int, workdir: str,
-                   max_len: int) -> 'subprocess.Popen':
+                   max_len: int, tag: str = None,
+                   extra_env: dict = None) -> 'subprocess.Popen':
     """One OS-process tiny-model replica — the disagg gate is only
     honest when the prefill and decode engines live in DIFFERENT
     processes talking over localhost HTTP (no shared jit cache, no
-    shared GIL, a real serialized payload on the wire)."""
+    shared GIL, a real serialized payload on the wire). ``tag`` names
+    the state dir/log when several replicas share a role (the blackbox
+    gate runs multiple colocated replicas); ``extra_env`` overlays the
+    child env (e.g. SKYTPU_BLACKBOX=0 for the parity leg)."""
     import subprocess
+    tag = tag or role
     env = dict(os.environ)
     env['JAX_PLATFORMS'] = 'cpu'
     # One compute thread per replica (same rationale as --smoke): the
@@ -698,8 +714,9 @@ def _spawn_replica(role: str, port: int, workdir: str,
     # architecture.
     env['XLA_FLAGS'] = (env.get('XLA_FLAGS', '')
                         + ' --xla_cpu_multi_thread_eigen=false').strip()
-    env['SKYTPU_STATE_DIR'] = os.path.join(workdir, f'state-{role}')
+    env['SKYTPU_STATE_DIR'] = os.path.join(workdir, f'state-{tag}')
     env.pop('SKYTPU_DISAGG_STAGING', None)  # force the remote wire path
+    env.pop('SKYTPU_BLACKBOX_DIR', None)  # spool under the state dir
     # Fat decode chunks: on the CPU backend every chunk boundary costs
     # host dispatch + an NDJSON line through the LB pipe, and at the
     # tiny model's tok/s that per-line overhead — not decode compute —
@@ -707,7 +724,9 @@ def _spawn_replica(role: str, port: int, workdir: str,
     # both legs, so the ratio is unaffected; it just stops measuring
     # line-handling noise.
     env.setdefault('SKYTPU_LLM_CHUNK_STEPS', '16')
-    log = open(os.path.join(workdir, f'{role}.log'), 'wb')
+    if extra_env:
+        env.update(extra_env)
+    log = open(os.path.join(workdir, f'{tag}.log'), 'wb')
     proc = subprocess.Popen(
         [sys.executable, '-m', 'skypilot_tpu.serve.llm_server',
          '--model', 'tiny', '--max-len', str(max_len),
@@ -1028,7 +1047,180 @@ def disagg_probe() -> dict:
             'decode_ratio_under_prefill_load': round(ratio, 3)}
 
 
+def blackbox_probe() -> dict:
+    """Black-box flight-recorder gate, three legs over real OS-process
+    replicas on localhost HTTP:
+
+    (a) **byte parity** — greedy output from a recorder-ON replica is
+        byte-identical to a SKYTPU_BLACKBOX=0 replica (the recorder may
+        cost a deque append, never a token);
+    (b) **dump-now round trip** — /debug/blackbox?dump=1 on a replica
+        that served traffic returns a committed bundle holding the
+        engine's admit/dispatch/retire ring events, the /health
+        snapshot, and thread stacks, and the plain list shows it (the
+        disabled replica dumps nothing);
+    (c) **kill -9 under load** — one of two replicas behind the LB dies
+        mid-traffic; serving continues on the survivor, and the
+        survivor's dump-now bundle merged with the LB process's own
+        ring reconstructs the timeline: the ready-set flip
+        (lb.replica_set removing the dead endpoint) followed by engine
+        dispatches on the survivor.
+    """
+    import shutil
+    import tempfile
+
+    import requests as requests_lib
+
+    from skypilot_tpu.observability import blackbox
+    from skypilot_tpu.serve.load_balancer import LoadBalancer
+    from skypilot_tpu.utils import common_utils
+
+    max_len = 256
+    workdir = tempfile.mkdtemp(prefix='skytpu-blackbox-')
+    # The probe process hosts the LB thread: give its recorder its own
+    # spool so leg (c) can dump the LB-side ring.
+    os.environ['SKYTPU_BLACKBOX_DIR'] = os.path.join(workdir, 'lb-spool')
+    blackbox.reset()
+    specs = {'on': None, 'off': {'SKYTPU_BLACKBOX': '0'}, 'peer': None}
+    ports = {t: common_utils.find_free_port(23600 + 40 * i)
+             for i, t in enumerate(specs)}
+    procs = {t: _spawn_replica('colocated', ports[t], workdir, max_len,
+                               tag=t, extra_env=env)
+             for t, env in specs.items()}
+    eps = {t: f'127.0.0.1:{port}' for t, port in ports.items()}
+    lb = LoadBalancer(common_utils.find_free_port(23740))
+
+    def row(n, salt):
+        return [(5 * i + 13 * salt) % 240 + 1 for i in range(n)]
+
+    try:
+        deadline = time.time() + 300
+        for tag, ep in eps.items():
+            while True:
+                if procs[tag].poll() is not None:
+                    raise RuntimeError(
+                        f'{tag} replica exited at startup; see '
+                        f'{workdir}/{tag}.log')
+                try:
+                    requests_lib.get(f'http://{ep}/health',
+                                     timeout=5).raise_for_status()
+                    break
+                except requests_lib.RequestException:
+                    if time.time() > deadline:
+                        raise RuntimeError(
+                            f'{tag} replica never became healthy')
+                    time.sleep(0.5)
+
+        # --- (a) greedy byte parity, recorder on vs off -----------------
+        for n, max_new, salt in ((12, 16, 1), (60, 24, 2)):
+            payload = {'tokens': [row(n, salt)],
+                       'max_new_tokens': max_new}
+            on = requests_lib.post(f'http://{eps["on"]}/generate',
+                                   json=payload, timeout=600)
+            off = requests_lib.post(f'http://{eps["off"]}/generate',
+                                    json=payload, timeout=600)
+            assert on.status_code == off.status_code == 200, \
+                (on.text, off.text)
+            assert on.json() == off.json(), (n, max_new)
+
+        # --- (b) dump-now round trip over HTTP --------------------------
+        d = requests_lib.get(
+            f'http://{eps["on"]}/debug/blackbox',
+            params={'dump': '1', 'reason': 'probe round-trip'},
+            timeout=60).json()
+        assert d['dumped'], d
+        bundle = d['bundle']
+        assert bundle['trigger'] == 'manual', bundle['trigger']
+        names = {e['name'] for e in bundle['events']}
+        assert {'engine.admit', 'engine.dispatch',
+                'engine.retire'} <= names, sorted(names)
+        assert bundle['health']['engine']['slots'] >= 1
+        assert 'Thread 0x' in bundle['stacks'] \
+            or 'Current thread' in bundle['stacks']
+        assert bundle['env_flags'].get('SKYTPU_LLM_CHUNK_STEPS') == '16'
+        listed = requests_lib.get(
+            f'http://{eps["on"]}/debug/blackbox', timeout=60).json()
+        assert [b['file'] for b in listed['bundles']] == \
+            [os.path.basename(d['dumped'])]
+        d_off = requests_lib.get(
+            f'http://{eps["off"]}/debug/blackbox',
+            params={'dump': '1'}, timeout=60).json()
+        assert d_off['enabled'] is False and d_off['dumped'] is None \
+            and d_off['bundles'] == [], d_off
+
+        # --- (c) kill -9 one replica under load -------------------------
+        lb.set_replicas([eps['on'], eps['peer']])
+        lb.start_in_thread()
+        lb_url = f'http://127.0.0.1:{lb.port}'
+        payload = {'tokens': [row(20, 5)], 'max_new_tokens': 12}
+        want = requests_lib.post(
+            f'http://{eps["on"]}/generate', json=payload,
+            timeout=600).json()
+        for _ in range(4):
+            requests_lib.post(f'{lb_url}/generate', json=payload,
+                              timeout=600).raise_for_status()
+        procs['peer'].kill()  # SIGKILL: no drain, no goodbye
+        procs['peer'].wait(timeout=60)
+        kill_t = time.time()
+        # The controller would flip the ready set off the failed probe;
+        # the probe plays that role here — the flip is what the LB ring
+        # must remember.
+        lb.set_replicas([eps['on']])
+        served = 0
+        deadline = time.time() + 120
+        while served < 3 and time.time() < deadline:
+            try:
+                r = requests_lib.post(f'{lb_url}/generate',
+                                      json=payload, timeout=600)
+            except requests_lib.RequestException:
+                continue
+            if r.status_code == 200:
+                assert r.json() == want  # byte-identical on the survivor
+                served += 1
+        assert served >= 3, 'serving did not continue past the kill'
+        survivor = requests_lib.get(
+            f'http://{eps["on"]}/debug/blackbox',
+            params={'dump': '1', 'reason': 'probe kill leg'},
+            timeout=60).json()['bundle']
+        lb_bundle = blackbox.debug_payload(
+            {'dump': '1', 'reason': 'probe kill leg'})['bundle']
+        flips = [e for e in lb_bundle['events']
+                 if e['name'] == 'lb.replica_set'
+                 and eps['peer'] in (e.get('attrs') or {}).get(
+                     'removed', ())]
+        assert flips, lb_bundle['events']
+        # Timeline reconstruction: merged by wall clock, the flip is
+        # followed by engine dispatches on the survivor — "the replica
+        # died, the LB re-routed, serving continued" readable from the
+        # bundles alone.
+        merged = sorted(survivor['events'] + lb_bundle['events'],
+                        key=lambda e: e['ts'])
+        flip_ts = flips[-1]['ts']
+        after = [e for e in merged if e['ts'] > flip_ts
+                 and e['name'] == 'engine.dispatch']
+        assert after, 'no survivor dispatches after the ready-set flip'
+        return {'parity': 'byte-identical (on vs SKYTPU_BLACKBOX=0)',
+                'bundle_events': len(bundle['events']),
+                'survivor_events': len(survivor['events']),
+                'lb_flips': len(flips),
+                'dispatches_after_flip': len(after),
+                'kill_to_flip_s': round(flips[-1]['ts'] - kill_t, 3)}
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+        lb.stop()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def main():
+    if '--blackbox' in sys.argv:
+        # CPU-only by design (same rationale as --smoke): never touch
+        # or wait on a chip in CI.
+        jax.config.update('jax_platforms', 'cpu')
+        print(json.dumps({'blackbox_smoke': 'ok', **blackbox_probe()}),
+              flush=True)
+        return
     if '--disagg' in sys.argv:
         # CPU-only by design (same rationale as --smoke): never touch
         # or wait on a chip in CI.

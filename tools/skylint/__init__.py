@@ -31,6 +31,8 @@ host-sync    ``# skylint: hot-path``            decode-dispatch root
 host-sync    ``# skylint: allow-host-sync(r)``  suppress one sync site
 env-flag     ``# skylint: allow-env(reason)``   suppress one env literal
 metric-name  ``# skylint: allow-metric(r)``     suppress one metric ref
+event-name   ``# skylint: allow-event(r)``      suppress one black-box
+                                               event ref
 == ======================================= ==============================
 
 Every suppression MUST carry a non-empty human-readable reason; a bare
@@ -61,7 +63,7 @@ _ITEM_RE = re.compile(
 #: directives that suppress a finding and therefore need a reason
 REASON_REQUIRED = frozenset(
     {'locked', 'allow-raise', 'allow-host-sync', 'allow-env',
-     'allow-metric'})
+     'allow-metric', 'allow-event'})
 #: marker directives (no argument)
 MARKERS = frozenset({'engine-thread', 'hot-path'})
 #: value directives (name=value)

@@ -1,4 +1,4 @@
 """Checker modules. Importing this package populates the registry."""
 from skylint.checkers import (base, engine_thread, env_flags,  # noqa: F401
-                              host_sync, lock_discipline, metric_names,
-                              pycache)
+                              event_names, host_sync, lock_discipline,
+                              metric_names, pycache)

@@ -1,0 +1,203 @@
+"""Black-box event-name cross-check.
+
+Every flight-recorder event name is declared exactly once, in
+``skypilot_tpu/observability/blackbox.py``'s :data:`EVENTS` registry
+(the ``metric-name`` rule's mirror for the crash-forensics plane).
+Incident-bundle consumers — the dashboard incident panel, post-mortem
+tooling, the docs trigger matrix — match events BY NAME, so a renamed
+or typo'd event silently blanks the very forensics it was supposed to
+produce. Two directions:
+
+* every ``blackbox.record('name', ...)`` call anywhere in the tree must
+  pass a string LITERAL that is a declared event name (a dynamic first
+  argument defeats the registry and is itself a finding);
+* every declared event must be recorded somewhere — a dead event is a
+  forensic capability the docs promise but no code delivers.
+
+Reference detection is alias-aware, not textual: only calls whose
+callee resolves to the blackbox module (``from
+skypilot_tpu.observability import blackbox [as bb]`` →
+``bb.record(...)``, or ``from ...blackbox import record``) are scanned,
+so unrelated ``.record()`` methods (trace ring, heartbeats) never
+false-positive. The probe child embeds its recorder as ``_bb`` inside a
+string template; liveness therefore ALSO does a raw-text scan for
+``record('<name>'`` occurrences, the same template-string concession
+the env-flag checker makes.
+
+Escape hatch: ``# skylint: allow-event(reason)`` on the call line."""
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from skylint import Checker, Finding, SourceFile, register
+
+REGISTRY_REL = 'skypilot_tpu/observability/blackbox.py'
+_MODULE = 'skypilot_tpu.observability.blackbox'
+
+
+@register
+class EventNames(Checker):
+
+    name = 'event-name'
+
+    def __init__(self):
+        self._registry: Optional[Dict[str, int]] = None
+        self._registry_error: Optional[str] = None
+
+    def _load_registry(self, root: pathlib.Path) -> Dict[str, int]:
+        if self._registry is not None:
+            return self._registry
+        self._registry = {}
+        path = root / REGISTRY_REL
+        if not path.is_file():
+            self._registry_error = f'{REGISTRY_REL} is missing'
+            return self._registry
+        try:
+            tree = ast.parse(path.read_text(encoding='utf-8'),
+                             filename=str(path))
+        except SyntaxError as e:
+            self._registry_error = f'{REGISTRY_REL}:{e.lineno}: {e.msg}'
+            return self._registry
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id == 'Event' and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                self._registry.setdefault(node.args[0].value,
+                                          node.args[0].lineno)
+        return self._registry
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        if sf.tree is None or sf.rel == REGISTRY_REL:
+            return []
+        # Registry anchored at skylint.ROOT (this checkout) by design —
+        # fixture files in tmp dirs still check against the real one.
+        from skylint import ROOT
+        registry = self._load_registry(ROOT)
+        if self._registry_error:
+            return []  # reported once, in check_tree
+        out: List[Finding] = []
+        for node, arg in _record_calls(sf):
+            if sf.suppression(node.lineno, 'allow-event'):
+                continue
+            if arg is None:
+                out.append(Finding(
+                    sf.rel, node.lineno, self.name,
+                    'blackbox.record() event name must be a string '
+                    'literal — a computed name defeats the registry '
+                    'cross-check (or # skylint: allow-event(reason))'))
+                continue
+            if arg in registry:
+                continue
+            hint = _closest(arg, registry)
+            out.append(Finding(
+                sf.rel, node.lineno, self.name,
+                f'event {arg!r} is not declared in {REGISTRY_REL} '
+                'EVENTS'
+                + (f' — did you mean {hint!r}?' if hint else '')
+                + ' (declare it, or # skylint: allow-event(reason))'))
+        return out
+
+    def check_tree(self, files: Sequence[SourceFile],
+                   root: pathlib.Path) -> List[Finding]:
+        registry = self._load_registry(root)
+        if self._registry_error:
+            return [Finding(REGISTRY_REL, 1, self.name,
+                            f'event registry unreadable: '
+                            f'{self._registry_error}')]
+        if not registry:
+            return [Finding(REGISTRY_REL, 1, self.name,
+                            'no Event(...) declarations found — '
+                            'registry unreadable?')]
+        recorded = set()
+        for sf in files:
+            if sf.rel == REGISTRY_REL:
+                continue
+            for _, arg in _record_calls(sf):
+                if arg is not None:
+                    recorded.add(arg)
+            # Template-string concession (the probe child embeds its
+            # recorder in a python -c source string): count a raw-text
+            # record('name' occurrence as liveness.
+            for name in registry:
+                if f"record('{name}'" in sf.text \
+                        or f'record("{name}"' in sf.text:
+                    recorded.add(name)
+        out: List[Finding] = []
+        for name, lineno in sorted(registry.items()):
+            if name not in recorded:
+                out.append(Finding(
+                    REGISTRY_REL, lineno, self.name,
+                    f'event {name!r} is declared but never recorded '
+                    'anywhere in the tree — dead event; delete the '
+                    'declaration or instrument the path it documents'))
+        return out
+
+
+def _blackbox_aliases(tree: ast.AST) -> Tuple[set, set]:
+    """(module aliases bound to the blackbox module, function names
+    bound to its ``record``)."""
+    mods, funcs = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module == 'skypilot_tpu.observability':
+                for a in node.names:
+                    if a.name == 'blackbox':
+                        mods.add(a.asname or a.name)
+            elif node.module == _MODULE:
+                for a in node.names:
+                    if a.name == 'record':
+                        funcs.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == _MODULE and a.asname:
+                    mods.add(a.asname)
+    return mods, funcs
+
+
+def _record_calls(sf: SourceFile):
+    """Yield (call_node, first_arg_literal_or_None) for every call that
+    resolves to blackbox.record in this file."""
+    mods, funcs = _blackbox_aliases(sf.tree)
+    if not mods and not funcs:
+        return
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        hit = False
+        if isinstance(fn, ast.Attribute) and fn.attr == 'record' and \
+                isinstance(fn.value, ast.Name) and fn.value.id in mods:
+            hit = True
+        elif isinstance(fn, ast.Name) and fn.id in funcs:
+            hit = True
+        if not hit:
+            continue
+        arg = None
+        if node.args and isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            arg = node.args[0].value
+        yield node, arg
+
+
+def _closest(name: str, registry: Dict[str, int]) -> Optional[str]:
+    """Cheap typo hint (same heuristic as the env-flag checker)."""
+    for cand in registry:
+        if abs(len(cand) - len(name)) > 1:
+            continue
+        pre = 0
+        for x, y in zip(name, cand):
+            if x != y:
+                break
+            pre += 1
+        suf = 0
+        for x, y in zip(reversed(name[pre:]), reversed(cand[pre:])):
+            if x != y:
+                break
+            suf += 1
+        if pre + suf >= max(len(name), len(cand)) - 2 and pre + suf > 6:
+            return cand
+    return None
