@@ -43,7 +43,12 @@ ci:
 # vs SKYTPU_BLACKBOX=0; /debug/blackbox dump-now round trip over HTTP
 # with engine ring events + thread stacks in the bundle; kill -9 of a
 # replica under load with the survivor's bundle + the LB ring
-# reconstructing the timeline).
+# reconstructing the timeline), and the SLO alerting gate (a hammer
+# stalls one of two replicas, the queue-depth burn-rate rule fires
+# within two evaluation ticks, slo_breach bundles land locally and in
+# the replica spool, the alert resolves on recovery, the
+# skytpu_alerts_firing gauge is nonzero only while firing, and greedy
+# output is byte-identical SKYTPU_SLO=1 vs =0).
 verify:
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --smoke
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --qos
@@ -53,6 +58,7 @@ verify:
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --goodput
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --ckpt
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --blackbox
+	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --slo
 
 # Full skylint suite (lock discipline, engine-thread raise safety,
 # host-sync, env-flag registry, metric names, git bytecode hygiene) at

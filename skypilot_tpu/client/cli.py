@@ -453,6 +453,63 @@ def jobs_logs(job_id, no_follow):
     jobs.tail_logs(job_id, follow=not no_follow)
 
 
+@cli.command('alerts')
+@click.option('--history', is_flag=True, default=False,
+              help='also list resolved alerts (newest first)')
+@_clean_errors
+def alerts_cmd(history):
+    """Current SLO alerts from the API server's burn-rate evaluator
+    (docs/operations.md §SLOs & alerting). Page-severity breaches
+    freeze black-box incident bundles (`stpu debug bundles`)."""
+    import requests as requests_lib
+
+    from skypilot_tpu.client import sdk
+    try:
+        out = sdk.alerts(history=history)
+    except requests_lib.RequestException as e:
+        raise click.ClickException(
+            f'API server unreachable at {sdk.server_url()} ({e}); '
+            'start one with `stpu api start`') from e
+    if not out.get('enabled'):
+        click.echo('SLO evaluator is OFF (set SKYTPU_SLO=1 on the '
+                   'API server).')
+    rows = [{
+        'rule': a.get('rule'),
+        'sev': a.get('severity'),
+        'target': a.get('target'),
+        'state': a.get('state'),
+        'value': (f"{a['value']:.1f} {a.get('op')} "
+                  f"{a.get('threshold')}"
+                  if isinstance(a.get('value'), (int, float)) else '-'),
+        'burn': (f"{round((a.get('fast_frac') or 0) * 100)}%/"
+                 f"{round((a.get('slow_frac') or 0) * 100)}%"),
+        'since': _dt.datetime.fromtimestamp(
+            a['fired_at'] or a['started_at']).strftime('%m-%d %H:%M:%S')
+        if a.get('fired_at') or a.get('started_at') else '-',
+    } for a in out.get('alerts', [])]
+    _echo_table(rows, [('rule', 'RULE'), ('sev', 'SEV'),
+                       ('target', 'TARGET'), ('state', 'STATE'),
+                       ('value', 'VALUE'), ('burn', 'BURN F/S'),
+                       ('since', 'SINCE')])
+    if history:
+        click.echo(click.style('Resolved:', bold=True))
+        hrows = [{
+            'rule': a.get('rule'),
+            'sev': a.get('severity'),
+            'target': a.get('target'),
+            'fired': _dt.datetime.fromtimestamp(a['fired_at']).strftime(
+                '%m-%d %H:%M:%S') if a.get('fired_at') else '-',
+            'resolved': _dt.datetime.fromtimestamp(
+                a['resolved_at']).strftime('%m-%d %H:%M:%S')
+            if a.get('resolved_at') else '-',
+            'paged': 'bundle' if a.get('paged') else '',
+        } for a in out.get('history', [])]
+        _echo_table(hrows, [('rule', 'RULE'), ('sev', 'SEV'),
+                            ('target', 'TARGET'), ('fired', 'FIRED'),
+                            ('resolved', 'RESOLVED'),
+                            ('paged', 'CAPTURE')])
+
+
 @cli.group('debug')
 def debug_group():
     """Incident debugging: black-box flight-recorder bundles

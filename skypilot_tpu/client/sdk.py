@@ -260,6 +260,21 @@ def debug_bundles(cluster_name: Optional[str] = None) -> str:
     return _get('debug/bundles', params)
 
 
+def alerts(history: bool = False) -> Dict[str, Any]:
+    """Current SLO alerts from the API server's evaluator
+    (observability/slo.py). A DIRECT read like api_requests — the
+    payload returns immediately, no request-id round trip (loadgen and
+    CI poll this at end of run)."""
+    r = requests_lib.get(f'{server_url()}/api/v1/alerts',
+                         params={'history': '1' if history else '0',
+                                 'rules': '1'},
+                         timeout=15, headers=_headers())
+    body = r.json()
+    if r.status_code != 200:
+        raise exceptions.SkyTpuError(body.get('error', r.text))
+    return body
+
+
 def api_cancel(request_id: str) -> bool:
     """Cancel an in-flight API request: kills its runner process group
     server-side (reference: ``sky api cancel``)."""

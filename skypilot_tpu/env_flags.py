@@ -299,6 +299,21 @@ FLAGS: Tuple[Flag, ...] = (
          'Fleet metrics-history sampling period.'),
     Flag('SKYTPU_METRICS_HISTORY_SAMPLES', 'int', '960',
          'Ring size of retained fleet metrics samples.'),
+    Flag('SKYTPU_METRICS_SPOOL', 'bool', '1',
+         'Persist the metrics-history ring to a JSONL spool under '
+         'SKYTPU_STATE_DIR and reload it at server start (keeps the '
+         'SLO slow burn-rate window across restarts).'),
+    # -- SLO engine (observability/slo.py) ----------------------------
+    Flag('SKYTPU_SLO', 'bool', '0',
+         'Enable the SLO burn-rate alert evaluator on the API server.'),
+    Flag('SKYTPU_SLO_EVAL_S', 'float', None,
+         'Evaluator cadence override (default: the metrics-history '
+         'sampler cadence).'),
+    Flag('SKYTPU_SLO_DUMP', 'bool', '1',
+         'Auto-capture black-box incident bundles (trigger slo_breach) '
+         'on page-severity firing transitions.'),
+    Flag('SKYTPU_SLO_HISTORY', 'int', '256',
+         'Max resolved alerts kept in the persisted history.'),
     # -- bench / probe / test harness ---------------------------------
     Flag('SKYTPU_BENCH_SWEEP_BUDGET_S', 'float', '600',
          'Wall-clock budget for one bench sweep phase.'),

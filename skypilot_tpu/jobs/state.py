@@ -520,6 +520,18 @@ def goodput_summary(job_id: int) -> Optional[Dict[str, Any]]:
     }
 
 
+def goodput_ratio_from_phases(
+        phases: Dict[str, float]) -> Optional[float]:
+    """running / wall-clock for one job's phase totals — THE goodput
+    ratio definition, shared by the Prometheus gauge and the SLO
+    metrics sampler so the alerting plane cannot drift from the scrape
+    plane. None for an empty ledger."""
+    wall = sum(phases.values())
+    if wall <= 0:
+        return None
+    return phases.get('running', 0.0) / wall
+
+
 def phase_totals() -> Dict[int, Dict[str, float]]:
     """Seconds per (job, phase) across every ledger in one query — the
     Prometheus scrape path (open phases measured to now)."""

@@ -138,8 +138,12 @@ assert len(EVENT_NAMES) == len(EVENTS), 'duplicate event declaration'
 
 #: Bounded trigger vocabulary — the ``skytpu_incident_bundles_total``
 #: label set, and what ``?dump=1&trigger=`` is clamped to.
+#: ``slo_breach`` is the SLO engine's degradation capture
+#: (observability/slo.py): a page-severity alert transitioning to
+#: firing dumps the implicated processes, so gradual saturation — not
+#: just crashes — arrives with a frozen timeline attached.
 TRIGGERS = ('engine_failure', 'sigterm', 'watchdog', 'probe_deadline',
-            'manual')
+            'slo_breach', 'manual')
 
 #: Env flags whose values are secrets: bundles record presence, never
 #: the value.

@@ -1,0 +1,801 @@
+"""SLO engine: declarative burn-rate alerting over the fleet's own
+signals, with degradation-triggered incident capture.
+
+The tree emits every signal a production operator needs — TTFT/phase
+histograms (trace.py / server/metrics.py), the goodput ledger and
+heartbeats (jobs/state.py, agent/daemon.py), per-replica health and QoS
+counters, and crash-time incident bundles (blackbox.py) — but nothing
+*watched* them: a replica whose queue quietly grows, a cluster whose
+heartbeat goes stale, or a job whose goodput craters got no alert and no
+forensic capture, because blackbox dumps trigger on crashes and signals,
+never on degradation. TPU serving regressions are gradual saturation
+phenomena (queue growth, bubble-rate creep, tok/s decay — see PAPERS.md),
+exactly the failures that need threshold evaluation over *history*
+rather than a crash trigger.
+
+This module closes that gap with three bounded registries (the
+``EVENTS`` / ``env_flags`` convention, cross-checked by skylint's
+``alert-rule`` rule):
+
+* :data:`HEALTH_FIELDS` — the declared vocabulary of sampled health
+  fields the evaluator may read (``metrics_history`` sample paths);
+* :data:`SIGNALS` — signal extractors (literal keys; a rule whose
+  signal has no extractor is *declared but never evaluated* — a lint
+  finding, not a silent no-op);
+* :data:`RULES` — the alert rules themselves: severity tier
+  (``info`` / ``warn`` / ``page``), breach direction + threshold, and
+  **multi-window burn rates** (fast ~5 min window for onset, slow ~1 h
+  window to confirm the degradation is sustained — the SRE-book
+  multiwindow shape, so a single bad sample can never page).
+
+The evaluator (:class:`SloEngine`) rides the API server's
+``server/daemons.py`` sampler cadence over ``metrics_history`` samples
+(which carry per-replica health, heartbeat ages, goodput ratios, and
+checkpoint staleness — see ``sample_once``). Alert lifecycle is
+``pending`` -> ``firing`` -> ``resolved`` with tick hysteresis on both
+edges (a flapping signal fires once; resolve requires the fast window
+to stay clean), persisted atomically under ``$SKYTPU_STATE_DIR`` so a
+server restart does not re-page.
+
+On a ``page``-severity transition to firing the engine auto-triggers a
+black-box dump on the implicated process(es) — the new bounded
+``slo_breach`` trigger in ``blackbox.TRIGGERS``: locally (the server's
+own ring), over the replica's ``/debug/blackbox?dump=1`` for replica
+targets, and over the same head-agent relay ``stpu debug dump`` uses
+for cluster targets — so degradations, not just crashes, arrive with a
+frozen timeline attached (dashboard ``#/incidents``).
+
+Surfaced at ``GET /api/v1/alerts`` + token-gated ``/debug/alerts`` on
+both servers, ``stpu alerts [--history]``, the dashboard ``#/alerts``
+panel (plus firing-interval annotations on the metric charts), and the
+``skytpu_alerts_firing`` gauge.
+
+Off by default behind ``SKYTPU_SLO`` (byte-parity pinned by
+``tools/perf_probe.py --slo``); dependency-free by the observability
+package charter. See docs/operations.md §SLOs & alerting for the rule
+catalog and tuning.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+SEVERITIES = ('info', 'warn', 'page')
+
+STATE_FILE = 'slo_alerts.json'
+
+
+def enabled() -> bool:
+    """Master switch, read live (the probe and tests flip it
+    mid-process). OFF by default like every admission-adjacent layer."""
+    return os.environ.get('SKYTPU_SLO', '0') not in ('0', '', 'off')
+
+
+def dump_enabled() -> bool:
+    """Whether a page-severity firing transition auto-captures black-box
+    bundles (SKYTPU_SLO_DUMP; on by default when the engine itself is
+    on — the frozen timeline is the point of degradation alerting)."""
+    return os.environ.get('SKYTPU_SLO_DUMP', '1') not in ('0', '', 'off')
+
+
+def eval_interval_s(sample_s: float) -> float:
+    """Evaluator cadence: SKYTPU_SLO_EVAL_S override, else the
+    metrics-history sampler cadence it rides (15 s default)."""
+    raw = os.environ.get('SKYTPU_SLO_EVAL_S')
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return sample_s if sample_s > 0 else 15.0
+
+
+def _history_keep() -> int:
+    try:
+        return max(int(os.environ.get('SKYTPU_SLO_HISTORY', '256')), 8)
+    except ValueError:
+        return 256
+
+
+# -- declared health vocabulary ----------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthField:
+    """One sampled health field the evaluator may read. ``name`` is the
+    vocabulary token rules reference in their ``sources``; skylint's
+    ``alert-rule`` checker fails any rule referencing an undeclared
+    name (did-you-mean on typos) and any declared field no rule uses."""
+    name: str
+    doc: str
+
+
+HEALTH_FIELDS: Tuple[HealthField, ...] = (
+    HealthField('replica.queue_depth',
+                'Admission backlog on one replica: server window queue '
+                '+ QoS queue + engine pending admissions '
+                '(health queue.depth_total + engine.queued).'),
+    HealthField('replica.ttft_p99_ms',
+                'p99 time-to-first-token over the replica\'s recent '
+                'request window (health ttft_ms.p99).'),
+    HealthField('replica.tokens_emitted',
+                'Cumulative engine token counter; the evaluator rates '
+                'it between samples for decode tok/s.'),
+    HealthField('replica.active_slots',
+                'Engine slots currently decoding — gates the tok/s '
+                'rule so an idle replica never reads as "slow".'),
+    HealthField('replica.decode_tok_s',
+                'QoS-observed decode throughput when the gate is on '
+                '(health qos.observed_tok_s).'),
+    HealthField('replica.shed_total',
+                'Cumulative QoS shed (429) counter, rated between '
+                'samples.'),
+    HealthField('replica.evicted_total',
+                'Cumulative QoS queue-TTL eviction counter, rated with '
+                'sheds (both are refused work).'),
+    HealthField('replica.prefill_ms',
+                'Cumulative prefill host milliseconds '
+                '(health engine.prefill_ms).'),
+    HealthField('replica.prefill_bubble_ms',
+                'Cumulative prefill host time decode provably waited '
+                'on; bubble rate = its delta over the prefill_ms '
+                'delta.'),
+    HealthField('cluster.heartbeat_age_s',
+                'Seconds since the cluster daemon last heartbeated '
+                '(the shared global_user_state.heartbeat_age rule; '
+                'sampled for UP clusters only — a deliberately stopped '
+                'cluster must not page forever).'),
+    HealthField('cluster.ckpt_staleness_s',
+                'Seconds since the last durable checkpoint save on the '
+                'cluster (heartbeat ckpt block; UP clusters only) — '
+                'the work at risk.'),
+    HealthField('job.goodput_ratio',
+                'RUNNING fraction of a managed job\'s wall-clock, from '
+                'the phase ledger (RUNNING jobs past their first 5 '
+                'minutes only).'),
+)
+
+HEALTH_FIELD_NAMES = frozenset(f.name for f in HEALTH_FIELDS)
+assert len(HEALTH_FIELD_NAMES) == len(HEALTH_FIELDS), \
+    'duplicate health-field declaration'
+
+
+def replica_signal_fields(health: Dict[str, Any]) -> Dict[str, Any]:
+    """The SLO-relevant per-replica slice of one /health body — ONE
+    builder shared by ``metrics_history.sample_once`` and the perf
+    probe, so the sampled shape and the extractors cannot drift. Keys
+    here are the tails of the ``replica.*`` vocabulary above."""
+    eng = health.get('engine') or {}
+    queue = health.get('queue') or {}
+    qos = health.get('qos') or {}
+    ttft = health.get('ttft_ms') or {}
+
+    def num(v):
+        return float(v) if isinstance(v, (int, float)) else None
+
+    return {
+        'queue_depth': (num(queue.get('depth_total')) or 0.0)
+                       + (num(eng.get('queued')) or 0.0),
+        'ttft_p99_ms': num(ttft.get('p99')),
+        'tokens_emitted': num(eng.get('tokens_emitted')),
+        'active_slots': num(eng.get('active_slots')) or 0.0,
+        'decode_tok_s': num(qos.get('observed_tok_s')),
+        'shed_total': num(qos.get('shed_total')),
+        'evicted_total': num(qos.get('evicted_total')),
+        'prefill_ms': num(eng.get('prefill_ms')),
+        'prefill_bubble_ms': num(eng.get('prefill_bubble_ms')),
+    }
+
+
+# -- signal extractors -------------------------------------------------------
+# Each extractor maps (prev_sample, sample) -> {target: value | None}.
+# None = "no observation at this tick" (idle engine, counter reset,
+# missing field) and is excluded from burn windows — an idle fleet must
+# never breach a lower-bound rule.
+
+
+def _replicas(sample: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    reps = sample.get('serve_replica_health')
+    return reps if isinstance(reps, dict) else {}
+
+
+def _level(field: str):
+
+    def extract(prev, cur):
+        del prev
+        return {key: h.get(field) if isinstance(h.get(field),
+                                                (int, float)) else None
+                for key, h in _replicas(cur).items()}
+
+    return extract
+
+
+def _delta(prev, cur, key: str, field: str) -> Optional[float]:
+    """Clamped per-target counter delta between consecutive samples;
+    None when there is no baseline or the counter reset (restart)."""
+    if prev is None:
+        return None
+    was = (_replicas(prev).get(key) or {}).get(field)
+    now = (_replicas(cur).get(key) or {}).get(field)
+    if not isinstance(was, (int, float)) or not isinstance(
+            now, (int, float)) or now < was:
+        return None
+    return float(now - was)
+
+
+def _sig_decode_tok_s(prev, cur):
+    """Decode throughput per replica: the QoS-observed rate when
+    present, else the token-counter delta rate — but ONLY while the
+    engine is actively decoding (idle != slow)."""
+    out: Dict[str, Optional[float]] = {}
+    dt = (cur.get('ts', 0.0) - prev.get('ts', 0.0)) if prev else 0.0
+    for key, h in _replicas(cur).items():
+        if not h.get('active_slots'):
+            out[key] = None
+            continue
+        observed = h.get('decode_tok_s')
+        if isinstance(observed, (int, float)) and observed > 0:
+            out[key] = float(observed)
+            continue
+        d = _delta(prev, cur, key, 'tokens_emitted')
+        out[key] = (d / dt) if (d is not None and dt > 0) else None
+    return out
+
+
+def _sig_shed_rate(prev, cur):
+    """Refused-work rate (sheds + TTL evictions) per second."""
+    out: Dict[str, Optional[float]] = {}
+    dt = (cur.get('ts', 0.0) - prev.get('ts', 0.0)) if prev else 0.0
+    for key in _replicas(cur):
+        shed = _delta(prev, cur, key, 'shed_total')
+        evicted = _delta(prev, cur, key, 'evicted_total')
+        if shed is None and evicted is None:
+            out[key] = None
+        elif dt > 0:
+            out[key] = ((shed or 0.0) + (evicted or 0.0)) / dt
+        else:
+            out[key] = None
+    return out
+
+
+def _sig_prefill_bubble_rate(prev, cur):
+    """Fraction of recent prefill host time decode provably waited on
+    (the >30% creep the dual-pool autoscaler also watches)."""
+    out: Dict[str, Optional[float]] = {}
+    for key in _replicas(cur):
+        d_prefill = _delta(prev, cur, key, 'prefill_ms')
+        d_bubble = _delta(prev, cur, key, 'prefill_bubble_ms')
+        if d_prefill is None or d_bubble is None or d_prefill <= 1e-9:
+            out[key] = None
+        else:
+            out[key] = max(min(d_bubble / d_prefill, 1.0), 0.0)
+    return out
+
+
+def _family(sample_key: str):
+
+    def extract(prev, cur):
+        del prev
+        fam = cur.get(sample_key)
+        if not isinstance(fam, dict):
+            return {}
+        return {str(k): float(v) if isinstance(v, (int, float)) else None
+                for k, v in fam.items()}
+
+    return extract
+
+
+#: Signal key -> extractor. LITERAL keys on purpose: skylint's
+#: ``alert-rule`` checker cross-references every Rule.signal against
+#: this table — a rule whose signal is missing here is *declared but
+#: never evaluated* (dead rule), which fails lint instead of silently
+#: never alerting.
+SIGNALS: Dict[str, Callable] = {
+    'ttft_p99_ms': _level('ttft_p99_ms'),
+    'queue_depth': _level('queue_depth'),
+    'decode_tok_s': _sig_decode_tok_s,
+    'shed_rate': _sig_shed_rate,
+    'prefill_bubble_rate': _sig_prefill_bubble_rate,
+    'heartbeat_age': _family('cluster_heartbeat_age'),
+    'goodput_ratio': _family('job_goodput'),
+    'ckpt_staleness': _family('ckpt_staleness_s'),
+}
+
+
+# -- the rule registry -------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One declarative burn-rate alert rule.
+
+    A sample *breaches* when ``value <op> threshold``. The rule fires
+    only when the breaching fraction ("burn rate") of BOTH windows
+    exceeds its bound: the fast window (~5 min) catches onset, the slow
+    window (~1 h) proves the degradation is sustained — over whatever
+    history actually exists, so a young server converges to fast-window
+    behavior instead of staying blind for an hour."""
+    name: str
+    doc: str
+    severity: str  # one of SEVERITIES
+    signal: str  # key into SIGNALS
+    sources: Tuple[str, ...]  # HEALTH_FIELDS names + skytpu_* series
+    op: str  # '>' or '<'
+    threshold: float
+    fast_s: float = 300.0
+    slow_s: float = 3600.0
+    fast_burn: float = 0.5
+    slow_burn: float = 0.1
+
+
+RULES: Tuple[Rule, ...] = (
+    Rule('serve.ttft_p99',
+         'Replica p99 time-to-first-token over 2 s sustained — the '
+         'interactive-latency SLO.',
+         severity='page', signal='ttft_p99_ms',
+         sources=('replica.ttft_p99_ms', 'skytpu_serve_ttft_seconds'),
+         op='>', threshold=2000.0),
+    Rule('serve.queue_depth',
+         'Replica admission backlog sustained past the saturation '
+         'line — queue growth is the leading edge of every gradual '
+         'serving collapse.',
+         severity='page', signal='queue_depth',
+         sources=('replica.queue_depth', 'skytpu_serve_qos_queue_depth'),
+         op='>', threshold=16.0),
+    Rule('serve.decode_tok_s',
+         'Replica decode throughput below floor WHILE actively '
+         'decoding — tok/s decay under load, not idleness.',
+         severity='warn', signal='decode_tok_s',
+         sources=('replica.decode_tok_s', 'replica.tokens_emitted',
+                  'replica.active_slots', 'skytpu_serve_decode_tok_s'),
+         op='<', threshold=20.0),
+    Rule('serve.shed_rate',
+         'Replica shedding/evicting requests (429/504) at a sustained '
+         'rate — capacity, not a blip.',
+         severity='warn', signal='shed_rate',
+         sources=('replica.shed_total', 'replica.evicted_total',
+                  'skytpu_serve_qos_shed_total'),
+         op='>', threshold=0.5),
+    Rule('serve.prefill_bubble',
+         'Prefill bubble rate creep: decode waits on prefill host work '
+         'more than 30% of prefill time (the disagg autoscaler\'s '
+         'scale trigger, surfaced as an alert).',
+         severity='info', signal='prefill_bubble_rate',
+         sources=('replica.prefill_bubble_ms', 'replica.prefill_ms',
+                  'skytpu_replica_prefill_bubble_ms'),
+         op='>', threshold=0.3),
+    Rule('fleet.heartbeat_age',
+         'Cluster daemon heartbeat stale: the host wedged, the daemon '
+         'died, or the network partitioned.',
+         severity='page', signal='heartbeat_age',
+         sources=('cluster.heartbeat_age_s',
+                  'skytpu_cluster_heartbeat_age_seconds'),
+         op='>', threshold=180.0),
+    Rule('job.goodput',
+         'Managed-job goodput ratio below half: the job burns most of '
+         'its wall-clock on recovery/launch, not training.',
+         severity='warn', signal='goodput_ratio',
+         sources=('job.goodput_ratio', 'skytpu_job_goodput_ratio'),
+         op='<', threshold=0.5),
+    Rule('ckpt.staleness',
+         'No durable checkpoint for 30 min on a training cluster — '
+         'the work at risk on the next preemption.',
+         severity='warn', signal='ckpt_staleness',
+         sources=('cluster.ckpt_staleness_s',
+                  'skytpu_ckpt_staleness_seconds'),
+         op='>', threshold=1800.0),
+)
+
+RULE_NAMES = frozenset(r.name for r in RULES)
+assert len(RULE_NAMES) == len(RULES), 'duplicate rule declaration'
+
+
+# -- burn-rate window math ---------------------------------------------------
+
+#: Minimum fast-window observations before a rule may fire: one bad
+#: sample is an outlier, two sustained are a trend.
+MIN_FAST_N = 2
+
+
+def burn_fractions(rule: Rule, samples: List[Dict[str, Any]],
+                   now: Optional[float] = None) -> Dict[str, Dict[str, Any]]:
+    """Per-target burn state for one rule over a sample stream (oldest
+    first): the breaching fraction of the fast and slow windows, the
+    observation counts, and the latest value. Pure function — the unit
+    tests and the perf probe feed synthetic streams through it."""
+    now = time.time() if now is None else now
+    series: Dict[str, List[Tuple[float, float]]] = {}
+    extract = SIGNALS.get(rule.signal)
+    if extract is None:
+        return {}
+    prev = None
+    for sample in samples:
+        ts = sample.get('ts')
+        if not isinstance(ts, (int, float)) or ts > now:
+            continue
+        for target, value in extract(prev, sample).items():
+            if value is None:
+                continue
+            series.setdefault(target, []).append((ts, float(value)))
+        prev = sample
+
+    if rule.op == '>':
+        breach = lambda v: v > rule.threshold  # noqa: E731
+    else:
+        breach = lambda v: v < rule.threshold  # noqa: E731
+    out: Dict[str, Dict[str, Any]] = {}
+    for target, points in series.items():
+        fast = [v for ts, v in points if ts >= now - rule.fast_s]
+        slow = [v for ts, v in points if ts >= now - rule.slow_s]
+        fast_bad = sum(1 for v in fast if breach(v))
+        slow_bad = sum(1 for v in slow if breach(v))
+        out[target] = {
+            'value': points[-1][1],
+            'fast_n': len(fast), 'slow_n': len(slow),
+            'fast_frac': fast_bad / len(fast) if fast else 0.0,
+            'slow_frac': slow_bad / len(slow) if slow else 0.0,
+        }
+    return out
+
+
+# -- the engine --------------------------------------------------------------
+
+
+def _default_state_dir() -> str:
+    return os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+
+
+class SloEngine:
+    """Evaluates the rule registry over metrics-history samples and owns
+    the alert lifecycle. One instance per server process (the daemon
+    builds it lazily via :func:`evaluate_once`); the perf probe and the
+    tests build their own with scaled rules, a stub dumper, or an
+    explicit endpoint map."""
+
+    _GUARDED_BY = {'_active': '_lock', '_history': '_lock'}
+
+    def __init__(self, state_dir: Optional[str] = None,
+                 rules: Optional[List[Rule]] = None,
+                 pending_ticks: int = 2, resolve_ticks: int = 3,
+                 endpoints: Optional[Dict[str, str]] = None,
+                 dump_fn: Optional[Callable[[Dict[str, Any]], None]] = None,
+                 http_get: Optional[Callable[[str], None]] = None):
+        self.rules = tuple(rules) if rules is not None else RULES
+        self.pending_ticks = max(pending_ticks, 1)
+        self.resolve_ticks = max(resolve_ticks, 1)
+        self._endpoints = dict(endpoints or {})
+        self._dump_fn = dump_fn
+        self._http_get = http_get
+        self._state_path = os.path.join(state_dir or _default_state_dir(),
+                                        STATE_FILE)
+        self._lock = threading.Lock()
+        # key 'rule|target' -> live alert dict (pending or firing)
+        self._active: Dict[str, Dict[str, Any]] = {}
+        self._history: List[Dict[str, Any]] = []
+        self._last_persisted: Optional[str] = None
+        self._load()
+
+    # -- persistence (tmp-write + rename; a torn write is invisible) ---------
+
+    def _load(self) -> None:
+        state = _read_state_file(self._state_path)
+        with self._lock:
+            self._active = state.get('active', {})
+            self._history = state.get('history', [])
+
+    # skylint: locked(called under self._lock from tick)
+    def _persist(self) -> None:
+        payload = json.dumps({'version': 1, 'active': self._active,
+                              'history': self._history}, sort_keys=True)
+        if payload == self._last_persisted:
+            return
+        try:
+            d = os.path.dirname(self._state_path)
+            os.makedirs(d, exist_ok=True)
+            tmp = self._state_path + '.tmp'
+            with open(tmp, 'w', encoding='utf-8') as f:
+                f.write(payload)
+            os.replace(tmp, self._state_path)
+            self._last_persisted = payload
+        except OSError:
+            pass  # alerting still works in-process; re-page risk only
+
+    # -- evaluation ----------------------------------------------------------
+
+    def tick(self, samples: List[Dict[str, Any]],
+             now: Optional[float] = None) -> List[Dict[str, Any]]:
+        """One evaluation pass. Returns the lifecycle transitions that
+        happened this tick (each a copy of the alert with a
+        ``transition`` key). No-op while SKYTPU_SLO is off."""
+        if not enabled():
+            return []
+        now = time.time() if now is None else now
+        transitions: List[Dict[str, Any]] = []
+        to_dump: List[Dict[str, Any]] = []
+        with self._lock:
+            seen_keys = set()
+            for rule in self.rules:
+                burns = burn_fractions(rule, samples, now=now)
+                for target, burn in burns.items():
+                    key = f'{rule.name}|{target}'
+                    seen_keys.add(key)
+                    self._step(rule, target, key, burn, now,
+                               transitions, to_dump)
+            # Firing alerts whose target vanished entirely (replica
+            # scaled away, job finished): the signal is gone; count the
+            # absence toward resolution rather than firing forever.
+            for key, alert in list(self._active.items()):
+                if key in seen_keys:
+                    continue
+                if alert['state'] == 'pending':
+                    del self._active[key]
+                    continue
+                alert['clear_streak'] = alert.get('clear_streak', 0) + 1
+                if alert['clear_streak'] >= self.resolve_ticks:
+                    self._resolve(key, alert, now, transitions)
+            self._persist()
+        for alert in to_dump:
+            self._dump_breach(alert)
+        return transitions
+
+    # skylint: locked(called under self._lock from tick)
+    def _step(self, rule: Rule, target: str, key: str,
+              burn: Dict[str, Any], now: float,
+              transitions: List[Dict[str, Any]],
+              to_dump: List[Dict[str, Any]]) -> None:
+        firing_cond = (burn['fast_n'] >= MIN_FAST_N
+                       and burn['fast_frac'] >= rule.fast_burn
+                       and burn['slow_frac'] >= rule.slow_burn)
+        # Hysteresis band: resolving needs the fast window meaningfully
+        # cleaner than half the firing burn, so a signal hovering at the
+        # threshold cannot flap the alert.
+        clear_cond = (burn['fast_n'] == 0
+                      or burn['fast_frac'] <= rule.fast_burn / 2.0)
+        alert = self._active.get(key)
+        if alert is None:
+            if firing_cond:
+                alert = {
+                    'rule': rule.name, 'severity': rule.severity,
+                    'target': target, 'state': 'pending',
+                    'op': rule.op, 'threshold': rule.threshold,
+                    'started_at': round(now, 3), 'streak': 1,
+                    'clear_streak': 0, 'paged': False,
+                    'fired_at': None, 'resolved_at': None,
+                }
+                alert.update({k: round(burn[k], 4) if isinstance(
+                    burn[k], float) else burn[k] for k in burn})
+                self._active[key] = alert
+                transitions.append(dict(alert, transition='pending'))
+            return
+        alert.update({k: round(burn[k], 4) if isinstance(burn[k], float)
+                      else burn[k] for k in burn})
+        if alert['state'] == 'pending':
+            if not firing_cond:
+                # Never confirmed: drop silently (no history entry —
+                # pending is the evaluator's own debounce, not an
+                # operator-visible incident).
+                del self._active[key]
+                return
+            alert['streak'] = alert.get('streak', 0) + 1
+            if alert['streak'] >= self.pending_ticks:
+                alert['state'] = 'firing'
+                alert['fired_at'] = round(now, 3)
+                transitions.append(dict(alert, transition='firing'))
+                # The restart-no-re-page contract: 'paged' persists with
+                # the alert, so a reloaded firing alert never re-dumps.
+                if rule.severity == 'page' and not alert['paged']:
+                    alert['paged'] = True
+                    to_dump.append(dict(alert))
+            return
+        # firing
+        if clear_cond:
+            alert['clear_streak'] = alert.get('clear_streak', 0) + 1
+            if alert['clear_streak'] >= self.resolve_ticks:
+                self._resolve(key, alert, now, transitions)
+        else:
+            alert['clear_streak'] = 0
+
+    # skylint: locked(called under self._lock from tick)
+    def _resolve(self, key: str, alert: Dict[str, Any], now: float,
+                 transitions: List[Dict[str, Any]]) -> None:
+        alert['state'] = 'resolved'
+        alert['resolved_at'] = round(now, 3)
+        del self._active[key]
+        self._history.append(alert)
+        del self._history[:-_history_keep()]
+        transitions.append(dict(alert, transition='resolved'))
+
+    # -- degradation-triggered incident capture ------------------------------
+
+    def _dump_breach(self, alert: Dict[str, Any]) -> None:
+        """Freeze timelines for a page that just started firing. Every
+        leg is best-effort: capture must never take the evaluator (or
+        the paged component) down with it."""
+        if not dump_enabled():
+            return
+        if self._dump_fn is not None:  # tests / probe stub
+            self._dump_fn(alert)
+            return
+        reason = (f"slo {alert['rule']} firing on {alert['target']}: "
+                  f"value {alert.get('value')} {alert['op']} "
+                  f"threshold {alert['threshold']}")
+        try:
+            from skypilot_tpu.observability import blackbox
+            blackbox.dump('slo_breach', reason=reason,
+                          extra={'alert': alert})
+        except Exception:  # noqa: BLE001 — see docstring
+            pass
+        target = alert['target']
+        endpoint = self._resolve_endpoint(target)
+        if endpoint is not None:
+            self._dump_replica(endpoint, reason)
+            return
+        self._dump_cluster(target)
+
+    def _resolve_endpoint(self, target: str) -> Optional[str]:
+        """Replica target ('service/replica_id') -> its endpoint, via
+        the explicit map (probe/tests) or serve_state."""
+        if target in self._endpoints:
+            return self._endpoints[target]
+        if '/' not in target:
+            return None
+        service, _, replica_id = target.rpartition('/')
+        try:
+            from skypilot_tpu.serve import serve_state
+            for rep in serve_state.list_replicas(service):
+                if str(rep.get('replica_id')) == replica_id:
+                    return rep.get('endpoint') or None
+        except Exception:  # noqa: BLE001 — state read is best-effort
+            return None
+        return None
+
+    def _dump_replica(self, endpoint: str, reason: str) -> None:
+        url = endpoint if endpoint.startswith('http') \
+            else f'http://{endpoint}'
+        full = (f'{url}/debug/blackbox?dump=1&trigger=slo_breach'
+                f'&reason={_quote(reason)}')
+        try:
+            if self._http_get is not None:
+                self._http_get(full)
+            else:
+                import urllib.request
+                with urllib.request.urlopen(full, timeout=10):
+                    pass
+        except Exception:  # noqa: BLE001 — the degraded replica may be
+            pass           # exactly the one that cannot answer
+
+    def _dump_cluster(self, target: str) -> None:
+        """Cluster-scoped page (heartbeat/ckpt rules): interrogate the
+        cluster's framework processes over the same head-agent relay
+        `stpu debug dump` uses (stacks land in ITS spool)."""
+        try:
+            from skypilot_tpu import global_user_state
+            record = global_user_state.get_cluster(target)
+            if record is None or not record.get('handle'):
+                return
+            from skypilot_tpu.backends import ClusterHandle, TpuGangBackend
+            handle = ClusterHandle.from_dict(record['handle'])
+            TpuGangBackend().blackbox(handle, dump=True)
+        except Exception:  # noqa: BLE001 — a stale-heartbeat cluster is
+            pass           # often unreachable; the alert already says so
+
+    # -- read side -----------------------------------------------------------
+
+    def snapshot(self) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]]]:
+        """(active alerts newest-first, resolved history newest-first)."""
+        with self._lock:
+            active = sorted((dict(a) for a in self._active.values()),
+                            key=lambda a: a['started_at'], reverse=True)
+            history = [dict(a) for a in reversed(self._history)]
+        return active, history
+
+    def firing(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(a) for a in self._active.values()
+                    if a['state'] == 'firing']
+
+
+def _quote(text: str) -> str:
+    import urllib.parse
+    return urllib.parse.quote(text[:200])
+
+
+def _read_state_file(path: str) -> Dict[str, Any]:
+    try:
+        with open(path, encoding='utf-8') as f:
+            state = json.load(f)
+        if isinstance(state, dict) and isinstance(
+                state.get('active'), dict):
+            return state
+    except (OSError, ValueError):
+        pass
+    return {'active': {}, 'history': []}
+
+
+# -- process singleton + shared payload builders -----------------------------
+
+_ENGINE: Optional[SloEngine] = None
+_ENGINE_LOCK = threading.Lock()
+
+
+def install(engine: Optional[SloEngine]) -> None:
+    """Make ``engine`` this process's engine (the daemon does this via
+    evaluate_once; the probe installs its own so the gauge and the
+    payloads read it)."""
+    global _ENGINE
+    with _ENGINE_LOCK:
+        _ENGINE = engine
+
+
+def get_engine(create: bool = False) -> Optional[SloEngine]:
+    global _ENGINE
+    with _ENGINE_LOCK:
+        if _ENGINE is None and create:
+            _ENGINE = SloEngine()
+        return _ENGINE
+
+
+def evaluate_once() -> Optional[List[Dict[str, Any]]]:
+    """One daemon tick: evaluate the registry over the metrics-history
+    ring. None (and no engine built) while disabled."""
+    if not enabled():
+        return None
+    engine = get_engine(create=True)
+    from skypilot_tpu.server import metrics_history
+    return engine.tick(metrics_history.history())
+
+
+def firing() -> List[Dict[str, Any]]:
+    """Currently-firing alerts, for the ``skytpu_alerts_firing`` gauge:
+    the in-process engine when one runs, else the persisted state (a
+    scrape right after restart, before the first tick). Empty while
+    disabled — the gauge must be nonzero only while genuinely firing."""
+    if not enabled():
+        return []
+    engine = get_engine()
+    if engine is not None:
+        return engine.firing()
+    state = _read_state_file(
+        os.path.join(_default_state_dir(), STATE_FILE))
+    return [a for a in state['active'].values()
+            if a.get('state') == 'firing']
+
+
+def rules_catalog() -> List[Dict[str, Any]]:
+    return [dataclasses.asdict(r) for r in RULES]
+
+
+def alerts_payload(query: Optional[Dict[str, Any]] = None
+                   ) -> Dict[str, Any]:
+    """The ``/api/v1/alerts`` / ``/debug/alerts`` / dashboard / CLI
+    response body — ONE builder so the surfaces cannot drift.
+    ``?history=1`` appends the resolved history, ``?rules=1`` the rule
+    catalog."""
+    query = query or {}
+    engine = get_engine()
+    if engine is not None:
+        active, history = engine.snapshot()
+    else:
+        state = _read_state_file(
+            os.path.join(_default_state_dir(), STATE_FILE))
+        active = sorted(state['active'].values(),
+                        key=lambda a: a.get('started_at') or 0,
+                        reverse=True)
+        history = list(reversed(state.get('history', [])))
+    out: Dict[str, Any] = {'enabled': enabled(), 'alerts': active,
+                           'firing': sum(1 for a in active
+                                         if a.get('state') == 'firing')}
+    if str(query.get('history', '')) in ('1', 'true'):
+        try:
+            limit = min(max(int(query.get('limit', 100)), 1), 1000)
+        except (TypeError, ValueError):
+            limit = 100
+        out['history'] = history[:limit]
+    if str(query.get('rules', '')) in ('1', 'true'):
+        out['rules'] = rules_catalog()
+    return out

@@ -420,6 +420,12 @@ class LlmServer:
             'exports': 0, 'export_bytes': 0, 'export_seconds': 0.0,
             'imports': 0, 'import_bytes': 0, 'import_seconds': 0.0,
             'import_rejects': 0, 'fallbacks_served': 0}
+        # Recent-request TTFT window (seconds): feeds the /health
+        # ttft_ms percentiles the SLO engine's serve.ttft_p99 rule
+        # samples (observability/slo.py). Appended from the handler
+        # coroutines and read by /health — both on the event loop, and
+        # deque appends are atomic besides.
+        self._ttft_window: Deque[float] = collections.deque(maxlen=512)
         # Black-box flight recorder: incident bundles from this process
         # embed the replica's live /health snapshot.
         from skypilot_tpu.observability import blackbox
@@ -470,6 +476,14 @@ class LlmServer:
             body['qos'] = qos_stats
             queue['depth_total'] += qos_stats['queue_depth_total']
         body['queue'] = queue
+        if self._ttft_window:
+            from skypilot_tpu.serve.qos import nearest_rank
+            waits = sorted(round(t * 1000.0, 1)
+                           for t in self._ttft_window)
+            body['ttft_ms'] = {'count': len(waits),
+                               'p50': nearest_rank(waits, 50),
+                               'p95': nearest_rank(waits, 95),
+                               'p99': nearest_rank(waits, 99)}
         if self.engine is not None:
             body['engine'] = self.engine.stats()
         if self.draft_params is not None:
@@ -666,6 +680,7 @@ class LlmServer:
         if not events:
             return
         ttft = max(events[0][0] - rec.t0, 0.0)
+        self._ttft_window.append(ttft)
         metrics_lib.SERVE_TTFT.labels(qos_class=qos_class).observe(ttft)
         metrics_lib.SERVE_PHASE.labels(
             phase='prefill', qos_class=qos_class).observe(ttft)
@@ -745,6 +760,7 @@ class LlmServer:
         now = time.time()
         dur = max(now - t_start, 0.0)
         toks = sum(len(r) for r in out)
+        self._ttft_window.append(dur)
         metrics_lib.SERVE_TTFT.labels(qos_class=qos_class).observe(dur)
         metrics_lib.SERVE_PHASE.labels(
             phase='window', qos_class=qos_class).observe(dur)
@@ -1518,12 +1534,28 @@ class LlmServer:
             None, blackbox.debug_payload, dict(request.query))
         return web.json_response(payload)
 
+    async def debug_alerts(self, request: web.Request) -> web.Response:
+        """SLO alert state visible from THIS process (observability/
+        slo.py): the evaluator runs on the API server, so a replica
+        normally reports enabled/empty — the endpoint exists on both
+        servers so operators (and loadgen) can ask either side with the
+        same path. Same scrape-token gate as /metrics."""
+        if not self._scrape_authorized(request):
+            return web.json_response({'error': 'unauthorized'},
+                                     status=401)
+        from skypilot_tpu.observability import slo
+        query = {'history': '1', **dict(request.query)}
+        payload = await asyncio.get_event_loop().run_in_executor(
+            None, slo.alerts_payload, query)
+        return web.json_response(payload)
+
     def make_app(self) -> web.Application:
         app = web.Application()
         app.router.add_get('/health', self.health)
         app.router.add_get('/metrics', self.metrics)
         app.router.add_get('/debug/traces', self.debug_traces)
         app.router.add_get('/debug/blackbox', self.debug_blackbox)
+        app.router.add_get('/debug/alerts', self.debug_alerts)
         app.router.add_post('/generate', self.generate)
         # KV handoff (disaggregated prefill/decode, serve/disagg.py).
         app.router.add_post('/v1/kv/export', self.kv_export)

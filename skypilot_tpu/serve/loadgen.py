@@ -175,6 +175,45 @@ async def _dump_replica_bundles(session, endpoints, out_dir: str) -> list:
     return saved
 
 
+async def _alerts_fired_in_window(session, alerts_url: str,
+                                  t0: float, t1: float) -> list:
+    """Rule names whose firing interval overlaps [t0, t1], from the API
+    server's /api/v1/alerts (active + resolved history)."""
+    base = alerts_url if alerts_url.startswith('http') \
+        else f'http://{alerts_url}'
+    headers = {}
+    try:
+        # Same bearer resolution as every SDK call (env var, then the
+        # token file `stpu api login` minted): a token-authed server
+        # must not silently turn into alerts_fired=[].
+        from skypilot_tpu.client import sdk as sdk_lib
+        token = sdk_lib.load_token()
+        if token:
+            headers['Authorization'] = f'Bearer {token}'
+    except Exception:  # noqa: BLE001 — anonymous fetch still valid
+        pass           # against an unauthed server
+    try:
+        async with session.get(
+                f'{base.rstrip("/")}/api/v1/alerts',
+                params={'history': '1'}, headers=headers,
+                timeout=__import__('aiohttp').ClientTimeout(
+                    total=15)) as r:
+            if r.status != 200:
+                return []
+            body = json.loads(await r.text())
+    except Exception:  # noqa: BLE001 — see caller
+        return []
+    fired = set()
+    for a in (body.get('alerts') or []) + (body.get('history') or []):
+        fired_at = a.get('fired_at')
+        if not fired_at:
+            continue
+        resolved_at = a.get('resolved_at') or t1
+        if fired_at <= t1 and resolved_at >= t0:
+            fired.add(a.get('rule'))
+    return sorted(fired)
+
+
 async def run_load(url: str, requests_total: int, concurrency: int,
                    prompt_len, max_new, vocab: int,
                    stream: bool = False, mix=None, tenants: int = 1,
@@ -183,7 +222,8 @@ async def run_load(url: str, requests_total: int, concurrency: int,
                    long_prompt_frac: float = 0.0,
                    long_prompt_len: int = 512,
                    dump_on_error: str = '',
-                   dump_endpoints=None) -> dict:
+                   dump_endpoints=None,
+                   alerts_url: str = '') -> dict:
     import aiohttp
     prompt_span, max_new_span = _span(prompt_len), _span(max_new)
     sem = asyncio.Semaphore(concurrency)
@@ -242,9 +282,11 @@ async def run_load(url: str, requests_total: int, concurrency: int,
                 shared_of.append((prefix is not None, r))
                 long_of.append((is_long, r))
 
+        wall_t0 = time.time()
         t0 = time.perf_counter()
         await asyncio.gather(*(_bounded(i) for i in range(requests_total)))
         wall = time.perf_counter() - t0
+        wall_t1 = time.time()
 
         engine_share = None
         if shared_flags is not None:
@@ -270,6 +312,16 @@ async def run_load(url: str, requests_total: int, concurrency: int,
         if dump_on_error and failed:
             incident_bundles = await _dump_replica_bundles(
                 session, dump_endpoints or [url], dump_on_error)
+
+        alerts_fired = None
+        if alerts_url:
+            # --alerts-url: ask the API server's SLO evaluator which
+            # rules fired DURING this run's wall-clock window, so perf
+            # runs self-report degradation in the same report line the
+            # throughput numbers land in. Best-effort: a down or
+            # SLO-disabled server yields an empty list, not a failure.
+            alerts_fired = await _alerts_fired_in_window(
+                session, alerts_url, wall_t0, wall_t1)
 
     flat = [r for _, r in results]
     oks = [r for r in flat if r[0]]
@@ -367,6 +419,8 @@ async def run_load(url: str, requests_total: int, concurrency: int,
             extra['tenants'] = tenants
     if incident_bundles is not None:
         extra['incident_bundles'] = incident_bundles
+    if alerts_fired is not None:
+        extra['alerts_fired'] = alerts_fired
     return {
         **extra,
         'requests': requests_total,
@@ -453,6 +507,12 @@ def main() -> None:
                              'is the --url target itself (the LB does '
                              'not proxy /debug/*, so list replicas '
                              'explicitly when driving an LB)')
+    parser.add_argument('--alerts-url', default='',
+                        help='API server base URL; at end of run fetch '
+                             '/api/v1/alerts and record the SLO rules '
+                             'that fired during the load window in the '
+                             "report line ('alerts_fired') — perf runs "
+                             'self-report degradation')
     args = parser.parse_args()
     dump_eps = None
     if args.replica_endpoints:
@@ -468,7 +528,8 @@ def main() -> None:
                                long_prompt_frac=args.long_prompt_frac,
                                long_prompt_len=args.long_prompt_len,
                                dump_on_error=args.dump_on_error,
-                               dump_endpoints=dump_eps))
+                               dump_endpoints=dump_eps,
+                               alerts_url=args.alerts_url))
     print(json.dumps(out))
 
 

@@ -77,12 +77,26 @@ async def run_background(app) -> None:
         tasks.append(asyncio.create_task(
             loop(interval, refresh_clusters_once)))
     from skypilot_tpu.server import metrics_history
+    try:
+        # Refill the ring from the persistence spool BEFORE the first
+        # sampler tick: a restart must not blind the SLO evaluator's
+        # slow burn-rate window (or blank the dashboard charts).
+        metrics_history.load_spool()
+    except Exception:  # noqa: BLE001 — a corrupt spool must not stop
+        pass           # the server from starting
     sample_s = metrics_history.sample_interval_s()
     if sample_s > 0:
         # Fleet-metric sampler: feeds the dashboard's time-series charts
         # (ring buffer; metrics_history.py).
         tasks.append(asyncio.create_task(
             loop(sample_s, metrics_history.sample_once)))
+    from skypilot_tpu.observability import slo
+    if slo.enabled():
+        # SLO evaluator (observability/slo.py): burn-rate rules over
+        # the sampler's ring, riding the same cadence (its own knob:
+        # SKYTPU_SLO_EVAL_S). Gated on SKYTPU_SLO — off by default.
+        tasks.append(asyncio.create_task(
+            loop(slo.eval_interval_s(sample_s), slo.evaluate_once)))
     app['skytpu_daemons'] = tasks
 
 

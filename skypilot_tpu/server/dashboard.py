@@ -529,6 +529,19 @@ async def api_logs_search(request: web.Request) -> web.Response:
     return await _json(request, logs_search_view, q, limit)
 
 
+def alerts_view() -> Dict[str, Any]:
+    """The #/alerts panel's data: the SLO engine's active alerts plus
+    resolved history and the rule catalog (observability/slo.py). The
+    metrics view also polls this to overlay firing intervals on the
+    charts."""
+    from skypilot_tpu.observability import slo
+    return slo.alerts_payload({'history': '1', 'rules': '1'})
+
+
+async def api_alerts(request: web.Request) -> web.Response:
+    return await _json(request, alerts_view)
+
+
 def incidents_view() -> Dict[str, Any]:
     """The incident panel's data: the API-server host's bundle spool
     (observability/blackbox.py), newest first. Replica-local bundles
@@ -583,6 +596,7 @@ def add_routes(app: web.Application) -> None:
     app.router.add_get('/dashboard/api/fleet', api_fleet)
     app.router.add_get('/dashboard/api/incidents', api_incidents)
     app.router.add_get('/dashboard/api/incident/{file}', api_incident)
+    app.router.add_get('/dashboard/api/alerts', api_alerts)
 
 
 _PAGE = """<!doctype html>
@@ -606,6 +620,10 @@ _PAGE = """<!doctype html>
  .STOPPED,.CANCELLED,.SHUTDOWN,.DONE{background:#e8e8ec;color:#444}
  .FAILED,.FAILED_SETUP,.FAILED_CONTROLLER,.FAILED_NO_RESOURCE,.NOT_READY
  {background:#fbdcd9;color:#9d1c0e}
+ .page,.firing{background:#fbdcd9;color:#9d1c0e}
+ .warn,.pending{background:#fdf2d0;color:#7a5b00}
+ .info{background:#e0ecff;color:#0b57d0}
+ .resolved{background:#e8e8ec;color:#444}
  #ts{color:#888;font-size:12px}
  pre.log{background:#101418;color:#d7e2ea;padding:12px;border-radius:6px;
       font-size:12px;max-height:420px;overflow:auto;white-space:pre-wrap}
@@ -615,6 +633,7 @@ _PAGE = """<!doctype html>
 </style></head><body>
 <h1>skypilot-tpu <span id="ts"></span></h1>
 <nav><a href="#/">overview</a> <a href="#/metrics">metrics</a>
+ <a href="#/alerts">alerts</a>
  <a href="#/traces">traces</a> <a href="#/incidents">incidents</a>
  <a href="#/fleet">fleet</a>
  <a href="#/logs">logs</a> <a href="#/infra">infra</a>
@@ -915,6 +934,12 @@ function lineChart(seriesMap, opts){
   if(n < 2) return '<p>(collecting… charts need two samples; the '+
       'sampler daemon ticks every few seconds)</p>';
   const W=680, H=140, P=6;
+  // SLO firing-interval annotations (observability/slo.py): translucent
+  // bands behind the series, [x0frac, x1frac] of the charted window.
+  const bands = ((opts||{}).bands||[]).map(([a,b])=>
+    `<rect x="${(P+a*(W-2*P)).toFixed(1)}" y="0" width="${
+      Math.max((b-a)*(W-2*P), 2).toFixed(1)}" height="${H}"
+      fill="#b3261e" opacity="0.09"/>`).join('');
   const ymax = Math.max(1, ...names.flatMap(k => seriesMap[k]));
   const lines = names.map((k,i)=>{
     const d = seriesMap[k];
@@ -928,7 +953,7 @@ function lineChart(seriesMap, opts){
     `<span style="color:${PALETTE[i%PALETTE.length]};font-size:12px;
       margin-right:10px">&#9632; ${esc(k)} (${
       seriesMap[k][seriesMap[k].length-1]})</span>`).join('');
-  return `<svg class="chart" width="${W}" height="${H}">`+
+  return `<svg class="chart" width="${W}" height="${H}">${bands}`+
     `<text x="${W-P}" y="12" font-size="10" fill="#888" `+
     `text-anchor="end">max ${ymax}</text>${lines.join('')}</svg>`+
     `<div>${legend}</div>`;
@@ -984,32 +1009,94 @@ async function metricsView(){
   const anyQos = s.some(x=>Object.keys(x.serve_qos_by_replica||{}).length);
   const span = s.length > 1 ?
       ((s[s.length-1].ts - s[0].ts)/60).toFixed(1) + ' min' : '';
+  // SLO firing intervals overlaid on every chart: [fired_at,
+  // resolved_at-or-now] clipped to the charted sample window
+  // (observability/slo.py; disabled/unreachable engine = no bands).
+  let alerts = {alerts: [], history: []};
+  try{ alerts = await J('dashboard/api/alerts'); }catch(e){}
+  const t0 = s[0].ts, t1 = s[s.length-1].ts, dt = Math.max(t1 - t0, 1e-9);
+  const bands = [];
+  const firingNow = [];
+  for(const a of (alerts.alerts||[]).concat(alerts.history||[])){
+    if(!a.fired_at) continue;
+    if(a.state === 'firing') firingNow.push(a);
+    const b0 = Math.max((a.fired_at - t0)/dt, 0);
+    const b1 = Math.min(((a.resolved_at||t1) - t0)/dt, 1);
+    if(b1 > 0 && b0 < 1) bands.push([b0, b1]);
+  }
+  const LC = (m, o) => lineChart(m, Object.assign({bands}, o||{}));
+  const alertLine = firingNow.length ?
+    `<p><a href="#/alerts">${firingNow.length} SLO alert(s) firing</a>: ` +
+    firingNow.slice(0,6).map(a=>`${B(a.severity)} ${esc(a.rule)} on ${
+      esc(a.target)}`).join(' · ') + '</p>' : '';
   return `<h2>Fleet metrics <span id="ts2" style="color:#888;font-size:12px">
-      ${s.length} samples over ${span}</span></h2>` +
+      ${s.length} samples over ${span}${bands.length ?
+      '; red bands = SLO alert firing intervals' : ''}</span></h2>` +
+    alertLine +
     `<h2>Clusters by status</h2>` +
-      lineChart(familySeries(s, 'clusters')) +
+      LC(familySeries(s, 'clusters')) +
     `<h2>Managed jobs by status</h2>` +
-      lineChart(familySeries(s, 'managed_jobs')) +
+      LC(familySeries(s, 'managed_jobs')) +
     `<h2>Services by status</h2>` +
-      lineChart(familySeries(s, 'services')) +
+      LC(familySeries(s, 'services')) +
     `<h2>Serve replicas</h2>` +
-      lineChart({ready: s.map(x=>x.replicas_ready||0),
-                 total: s.map(x=>x.replicas_total||0)}) +
+      LC({ready: s.map(x=>x.replicas_ready||0),
+          total: s.map(x=>x.replicas_total||0)}) +
     `<h2>Serving throughput (tok/s)</h2>` +
-      lineChart({'tok/s': tokRate.map(v=>Math.round(v*10)/10)},
-                {keepZero:true}) +
+      LC({'tok/s': tokRate.map(v=>Math.round(v*10)/10)},
+         {keepZero:true}) +
     (anyQos ? `<h2>Serve QoS queue depth</h2>` +
-      lineChart({queued: s.map(x=>x.serve_queue_depth||0)},
-                {keepZero:true}) +
+      LC({queued: s.map(x=>x.serve_queue_depth||0)},
+         {keepZero:true}) +
     `<h2>Serve QoS shed / evict rate (1/s)</h2>` +
-      lineChart({shed: qosRate('shed').map(v=>Math.round(v*100)/100),
-                 evicted: qosRate('evicted').map(v=>Math.round(v*100)/100)},
-                {keepZero:true}) : '') +
+      LC({shed: qosRate('shed').map(v=>Math.round(v*100)/100),
+          evicted: qosRate('evicted').map(v=>Math.round(v*100)/100)},
+         {keepZero:true}) : '') +
     `<h2>API requests by status</h2>` +
-      lineChart(familySeries(s, 'requests')) +
+      LC(familySeries(s, 'requests')) +
     `<h2>API request rate (req/s)</h2>` +
-      lineChart({'req/s': rate.map(v=>Math.round(v*100)/100)},
-                {keepZero:true});
+      LC({'req/s': rate.map(v=>Math.round(v*100)/100)},
+         {keepZero:true});
+}
+
+// SLO alert panel (observability/slo.py): active pending/firing alerts,
+// resolved history, and the declared rule catalog with burn-rate
+// parameters. Page-severity breaches link to #/incidents — the engine
+// froze a black-box bundle (trigger slo_breach) when they fired.
+async function alertsView(){
+  const d = await J('dashboard/api/alerts');
+  const head = `<h2>SLO alerts <span style="color:#888;font-size:12px">${
+    d.enabled ? 'evaluator on' :
+    'evaluator DISABLED (set SKYTPU_SLO=1 on the API server)'}; page
+    breaches freeze incident bundles — see <a href="#/incidents">
+    incidents</a></span></h2>`;
+  const when = a => a.fired_at ? T(a.fired_at) : T(a.started_at);
+  const burn = a => `${Math.round((a.fast_frac||0)*100)}% / ${
+    Math.round((a.slow_frac||0)*100)}%`;
+  const val = a => `${a.value!=null ? (+a.value).toFixed(1) : '-'} ${
+    esc(a.op)} ${a.threshold}`;
+  const active = table(
+    ['rule','severity','target','state','value vs threshold',
+     'burn fast/slow','since'], d.alerts||[],
+    a=>`<tr><td>${esc(a.rule)}</td><td>${B(a.severity)}</td>
+     <td>${esc(a.target)}</td><td>${B(a.state)}</td>
+     <td>${val(a)}</td><td>${burn(a)}</td><td>${when(a)}</td></tr>`);
+  const hist = table(
+    ['rule','severity','target','fired','resolved','paged'],
+    d.history||[],
+    a=>`<tr><td>${esc(a.rule)}</td><td>${B(a.severity)}</td>
+     <td>${esc(a.target)}</td><td>${T(a.fired_at)}</td>
+     <td>${T(a.resolved_at)}</td><td>${a.paged?'bundle':''}</td></tr>`);
+  const rules = table(
+    ['rule','severity','signal','breach','fast window','slow window'],
+    d.rules||[],
+    r=>`<tr><td title="${esc(r.doc)}">${esc(r.name)}</td>
+     <td>${B(r.severity)}</td><td>${esc(r.signal)}</td>
+     <td>${esc(r.op)} ${r.threshold}</td>
+     <td>${r.fast_s}s @ ${Math.round(r.fast_burn*100)}%</td>
+     <td>${r.slow_s}s @ ${Math.round(r.slow_burn*100)}%</td></tr>`);
+  return head + active + `<h2>Resolved (recent)</h2>` + hist +
+    `<h2>Rule catalog</h2>` + rules;
 }
 
 // Waterfall of one completed trace: rows indented by span depth, bars
@@ -1192,6 +1279,7 @@ async function route(){
     else if(h === '#/users') html = await usersView();
     else if(h === '#/workspaces') html = await workspacesView();
     else if(h === '#/metrics') html = await metricsView();
+    else if(h === '#/alerts') html = await alertsView();
     else if((m = h.match(/^#\\/traces\\/(.+)$/)))
       html = await tracesView(decodeURIComponent(m[1]));
     else if(h === '#/traces') html = await tracesView();

@@ -140,7 +140,8 @@ _DISAGG_FALLBACK = Gauge(
 _INCIDENT_BUNDLES = Gauge(
     'skytpu_incident_bundles_total',
     'Incident bundles written by this process since start, by trigger '
-    '(engine_failure | sigterm | watchdog | probe_deadline | manual).',
+    '(engine_failure | sigterm | watchdog | probe_deadline | '
+    'slo_breach | manual).',
     ['trigger'], registry=SERVING_REGISTRY)
 
 
@@ -149,6 +150,29 @@ def _refresh_incident_gauge() -> None:
     _INCIDENT_BUNDLES.clear()
     for trigger, n in blackbox.dump_counts().items():
         _INCIDENT_BUNDLES.labels(trigger=trigger).set(n)
+
+
+# SLO engine (observability/slo.py): alerts currently FIRING, by rule
+# and severity — the scrape-side mirror of `stpu alerts`. Recomputed
+# from the engine's live state every scrape and cleared first, so the
+# series is nonzero only while an alert is genuinely firing (pending
+# and resolved states never surface here).
+_ALERTS_FIRING = Gauge(
+    'skytpu_alerts_firing',
+    'SLO alerts currently firing, by rule and severity '
+    '(observability/slo.py RULES registry; 0/absent when nothing '
+    'fires or SKYTPU_SLO is off).',
+    ['rule', 'severity'], registry=REGISTRY)
+
+
+def _refresh_alert_gauge() -> None:
+    from collections import Counter as C
+
+    from skypilot_tpu.observability import slo
+    _ALERTS_FIRING.clear()
+    counts = C((a['rule'], a['severity']) for a in slo.firing())
+    for (rule, severity), n in counts.items():
+        _ALERTS_FIRING.labels(rule=rule, severity=severity).set(n)
 
 API_REQUEST = Histogram(
     'skytpu_api_request_seconds',
@@ -262,13 +286,12 @@ def _refresh_goodput_gauges(clusters, jobs) -> None:
     for job_id, phases in totals.items():
         if job_id not in listed:
             continue  # past the list_jobs window: keep label sets bounded
-        wall = sum(phases.values())
         for phase, secs in phases.items():
             _JOB_PHASE_SECONDS.labels(job_id=str(job_id),
                                       phase=phase).set(secs)
-        if wall > 0:
-            _JOB_GOODPUT.labels(job_id=str(job_id)).set(
-                phases.get('running', 0.0) / wall)
+        ratio = jobs_state.goodput_ratio_from_phases(phases)
+        if ratio is not None:
+            _JOB_GOODPUT.labels(job_id=str(job_id)).set(ratio)
     now = time_lib.time()
     for rec in clusters:
         if rec.get('last_heartbeat'):
@@ -352,6 +375,7 @@ def _refresh_gauges() -> None:
 def render() -> bytes:
     _refresh_gauges()
     _refresh_incident_gauge()
+    _refresh_alert_gauge()
     return generate_latest(REGISTRY) + generate_latest(SERVING_REGISTRY)
 
 

@@ -1,4 +1,5 @@
-"""In-server time-series for the dashboard's metric charts.
+"""In-server time-series for the dashboard's metric charts and the SLO
+evaluator.
 
 Reference analog: the reference dashboard's chart.js metrics pages pull
 from an external Prometheus; this framework's `/metrics` endpoint is
@@ -10,14 +11,33 @@ dashboard's ``/dashboard/api/metrics/history`` endpoint serves it to the
 SPA's SVG charts. An external Prometheus remains the right answer for
 long retention — this buffer is sized for an operator's "what just
 happened" window (default 4h at 15s resolution).
+
+Since the SLO engine (``observability/slo.py``) evaluates burn-rate
+windows over this very ring, two things changed:
+
+* samples additionally carry the declared SLO health vocabulary —
+  per-replica signal fields (``slo.replica_signal_fields``, the ONE
+  builder so the sampled shape and the rule extractors cannot drift),
+  cluster heartbeat ages, managed-job goodput ratios, and checkpoint
+  staleness;
+* the ring is **persisted** to a bounded JSONL spool under
+  ``$SKYTPU_STATE_DIR`` (tmp-free append with one-generation rotation
+  and torn-tail healing, the ``train_telemetry`` discipline) and
+  reloaded at server start, so a restart doesn't blind the evaluator's
+  slow (~1 h) burn-rate window. ``SKYTPU_METRICS_HISTORY_SAMPLES``
+  keeps its meaning: it bounds both the ring and what a reload
+  restores; ``SKYTPU_METRICS_SPOOL=0`` disables persistence.
 """
 from __future__ import annotations
 
 import collections
+import json
 import os
 import threading
 import time
 from typing import Any, Deque, Dict, List
+
+SPOOL_FILE = 'metrics_history.jsonl'
 
 
 def sample_interval_s() -> float:
@@ -25,22 +45,39 @@ def sample_interval_s() -> float:
     return float(os.environ.get('SKYTPU_METRICS_SAMPLE_S', '15'))
 
 
+def _spool_enabled() -> bool:
+    return os.environ.get('SKYTPU_METRICS_SPOOL', '1') not in \
+        ('0', '', 'off')
+
+
+def spool_path() -> str:
+    state = os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    return os.path.join(state, SPOOL_FILE)
+
+
 _MAX_SAMPLES = int(os.environ.get('SKYTPU_METRICS_HISTORY_SAMPLES', '960'))
 
 _lock = threading.Lock()
 _samples: Deque[Dict[str, Any]] = collections.deque(maxlen=_MAX_SAMPLES)
-_GUARDED_BY = {'_samples': '_lock'}
+# Lines appended to the CURRENT spool generation; -1 = unknown (count
+# the file on first append so a restarted server keeps rotating
+# correctly mid-generation).
+_spool_lines = -1
+_GUARDED_BY = {'_samples': '_lock', '_spool_lines': '_lock'}
 
 
 def sample_once(record: bool = True) -> Dict[str, Any]:
     """Snapshot fleet state counts (same families as server/metrics.py
-    gauges, plus ready-replica and request-counter totals); append to
-    the ring buffer when ``record`` (the daemon's cadence owns the
-    buffer — ad-hoc dashboard reads pass record=False)."""
+    gauges, plus ready-replica and request-counter totals and the SLO
+    signal fields); append to the ring buffer AND the persistence spool
+    when ``record`` (the daemon's cadence owns the buffer — ad-hoc
+    dashboard reads pass record=False)."""
     from collections import Counter as C
 
     from skypilot_tpu import global_user_state
     from skypilot_tpu.jobs import state as jobs_state
+    from skypilot_tpu.observability import slo
     from skypilot_tpu.serve import serve_state
     from skypilot_tpu.server import metrics as metrics_mod
     from skypilot_tpu.server import requests_db
@@ -60,6 +97,9 @@ def sample_once(record: bool = True) -> Dict[str, Any]:
     # restart-reset rationale as the token counters above — the
     # dashboard rates them with per-replica clamped deltas).
     serve_qos_by_replica: Dict[str, Dict[str, float]] = {}
+    # The SLO evaluator's per-replica signal slice (declared vocabulary
+    # in observability/slo.py HEALTH_FIELDS; one shared builder).
+    serve_replica_health: Dict[str, Dict[str, Any]] = {}
     for svc in services:
         for rep in serve_state.list_replicas(svc['name']):
             replicas_total += 1
@@ -68,6 +108,9 @@ def sample_once(record: bool = True) -> Dict[str, Any]:
                 replicas_ready += 1
             health = serve_state.parse_health(rep.get('health')) or {}
             key = f"{svc['name']}/{rep['replica_id']}"
+            if health:
+                serve_replica_health[key] = \
+                    slo.replica_signal_fields(health)
             tok = (health.get('engine') or {}).get('tokens_emitted')
             if isinstance(tok, (int, float)):
                 serve_tokens_by_replica[key] = int(tok)
@@ -90,12 +133,51 @@ def sample_once(record: bool = True) -> Dict[str, Any]:
     except Exception:  # noqa: BLE001 — counters must not kill sampling
         pass
 
+    now = time.time()
+    clusters = global_user_state.get_clusters()
+    # Cluster-scoped SLO signals: heartbeat age (liveness, via the ONE
+    # shared staleness helper `stpu status` and the dashboard already
+    # use) and ckpt staleness (work at risk). UP clusters only: a
+    # deliberately stopped cluster has no daemon by design — its frozen
+    # last_heartbeat must not page fleet.heartbeat_age forever, and its
+    # checkpoints are not "at risk".
+    cluster_heartbeat_age: Dict[str, float] = {}
+    ckpt_staleness_s: Dict[str, float] = {}
+    for rec in clusters:
+        if getattr(rec['status'], 'value', rec['status']) != 'UP':
+            continue
+        age, _ = global_user_state.heartbeat_age(rec)
+        if age is not None:
+            cluster_heartbeat_age[rec['name']] = round(age, 3)
+        ckpt = (rec.get('heartbeat') or {}).get('ckpt')
+        if isinstance(ckpt, dict) and \
+                isinstance(ckpt.get('last_save_ts'), (int, float)) and \
+                ckpt['last_save_ts'] > 0:
+            ckpt_staleness_s[rec['name']] = round(
+                max(now - ckpt['last_save_ts'], 0.0), 3)
+
+    # Managed-job goodput ratios (the shared ledger-ratio definition)
+    # for RUNNING jobs past their first five minutes — younger ledgers
+    # are all launch overhead by construction; alerting on them would
+    # page every fresh submit.
+    job_goodput: Dict[str, float] = {}
+    jobs = jobs_state.list_jobs()
+    running = {str(r['job_id']) for r in jobs
+               if getattr(r['status'], 'value', r['status']) == 'RUNNING'}
+    if running:
+        try:
+            for job_id, phases in jobs_state.phase_totals().items():
+                ratio = jobs_state.goodput_ratio_from_phases(phases)
+                if str(job_id) in running and ratio is not None \
+                        and sum(phases.values()) >= 300.0:
+                    job_goodput[str(job_id)] = round(ratio, 4)
+        except Exception:  # noqa: BLE001 — ledger read must not kill
+            pass           # sampling
+
     sample = {
-        'ts': time.time(),
-        'clusters': dict(C(r['status'].value
-                           for r in global_user_state.get_clusters())),
-        'managed_jobs': dict(C(r['status'].value
-                               for r in jobs_state.list_jobs())),
+        'ts': now,
+        'clusters': dict(C(r['status'].value for r in clusters)),
+        'managed_jobs': dict(C(r['status'].value for r in jobs)),
         'services': dict(C(s['status'].value for s in services)),
         'requests': requests_db.status_counts(),
         'replicas_total': replicas_total,
@@ -105,12 +187,88 @@ def sample_once(record: bool = True) -> Dict[str, Any]:
         'serve_queue_depth': sum(d['depth']
                                  for d in serve_qos_by_replica.values()),
         'serve_qos_by_replica': serve_qos_by_replica,
+        'serve_replica_health': serve_replica_health,
+        'cluster_heartbeat_age': cluster_heartbeat_age,
+        'ckpt_staleness_s': ckpt_staleness_s,
+        'job_goodput': job_goodput,
         'requests_total_by_op': ops,
     }
     if record:
         with _lock:
             _samples.append(sample)
+            _append_spool(sample)
     return sample
+
+
+# skylint: locked(called under _lock by sample_once/clear_for_testing)
+def _append_spool(sample: Dict[str, Any]) -> None:
+    """Append one sample line to the persistence spool, rotating the
+    current generation out once it holds a full ring's worth — current
+    + ``.1`` together always cover at least the newest _MAX_SAMPLES, so
+    a reload can refill the whole ring, and disk stays bounded at ~2
+    generations. Failure disables nothing: the in-memory ring is the
+    authority; the spool only widens the restart window."""
+    global _spool_lines
+    if not _spool_enabled():
+        return
+    path = spool_path()
+    try:
+        if _spool_lines < 0:
+            _spool_lines = _count_lines(path)
+        if _spool_lines >= _MAX_SAMPLES:
+            os.replace(path, path + '.1')
+            _spool_lines = 0
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, 'a', encoding='utf-8') as f:
+            f.write(json.dumps(sample, sort_keys=True) + '\n')
+        _spool_lines += 1
+    except (OSError, TypeError, ValueError):
+        pass
+
+
+def _count_lines(path: str) -> int:
+    try:
+        with open(path, 'rb') as f:
+            return sum(1 for _ in f)
+    except OSError:
+        return 0
+
+
+def load_spool() -> int:
+    """Reload the newest spooled samples into the ring at server start
+    (server/daemons.py calls this once, before the sampler's first
+    tick), so a restart doesn't blind the SLO evaluator's slow
+    burn-rate window. A torn tail line — the process died mid-append —
+    is skipped, never fatal; rows already in the ring are not
+    duplicated (reload is an empty-ring operation). Returns how many
+    samples were restored."""
+    if not _spool_enabled():
+        return 0
+    base = spool_path()
+    restored: List[Dict[str, Any]] = []
+    for path in (base + '.1', base):
+        try:
+            with open(path, encoding='utf-8') as f:
+                lines = f.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                sample = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn write: healed by being invisible
+            if isinstance(sample, dict) and \
+                    isinstance(sample.get('ts'), (int, float)):
+                restored.append(sample)
+    restored = restored[-_MAX_SAMPLES:]
+    with _lock:
+        if _samples:
+            return 0
+        _samples.extend(restored)
+    return len(restored)
 
 
 def history() -> List[Dict[str, Any]]:
@@ -119,5 +277,7 @@ def history() -> List[Dict[str, Any]]:
 
 
 def clear_for_testing() -> None:
+    global _spool_lines
     with _lock:
         _samples.clear()
+        _spool_lines = -1

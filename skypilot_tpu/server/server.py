@@ -188,6 +188,32 @@ async def debug_blackbox(request: web.Request) -> web.Response:
     return web.json_response(payload)
 
 
+@routes.get('/api/v1/alerts')
+async def api_alerts(request: web.Request) -> web.Response:
+    """Current SLO alerts (observability/slo.py): active
+    pending/firing alerts, ``?history=1`` for the resolved history,
+    ``?rules=1`` for the rule catalog. A DIRECT read, not an executor
+    op: the evaluator lives in this process and loadgen/CI poll this
+    at end of run — a request-id round trip would buy nothing. Bearer
+    auth applies like every /api/v1 path."""
+    from skypilot_tpu.observability import slo
+    payload = await asyncio.get_event_loop().run_in_executor(
+        None, slo.alerts_payload, dict(request.query))
+    return web.json_response(payload)
+
+
+@routes.get('/debug/alerts')
+async def debug_alerts(request: web.Request) -> web.Response:
+    """Operator view of the SLO engine (token-gated by the auth
+    middleware like every non-exempt path): the /api/v1/alerts payload
+    with history and the rule catalog included by default."""
+    from skypilot_tpu.observability import slo
+    query = {'history': '1', 'rules': '1', **dict(request.query)}
+    payload = await asyncio.get_event_loop().run_in_executor(
+        None, slo.alerts_payload, query)
+    return web.json_response(payload)
+
+
 @routes.get('/api/v1/api/requests')
 async def api_requests(request: web.Request) -> web.Response:
     del request
@@ -249,7 +275,7 @@ _API_OPS = frozenset((
     'launch', 'exec', 'down', 'stop', 'start', 'autostop', 'cancel',
     'status', 'queue', 'cost_report', 'job_status', 'check',
     'jobs/launch', 'jobs/queue', 'jobs/cancel', 'jobs/goodput',
-    'debug/dump', 'debug/bundles',
+    'debug/dump', 'debug/bundles', 'alerts',
     'api/get', 'api/stream', 'api/requests', 'api/cancel'))
 
 

@@ -96,6 +96,27 @@ def test_request_table_lists_history(server):
     assert 'down' in names
 
 
+def test_alerts_endpoints(server):
+    """SLO alert surfaces (observability/slo.py): /api/v1/alerts is a
+    direct read, /debug/alerts adds the rule catalog; the server runs
+    with SKYTPU_SLO unset so the evaluator reports disabled/empty."""
+    r = requests_lib.get(f'{server}/api/v1/alerts', timeout=10)
+    assert r.status_code == 200
+    body = r.json()
+    assert body['enabled'] is False
+    assert body['alerts'] == [] and body['firing'] == 0
+    r = requests_lib.get(f'{server}/debug/alerts', timeout=10)
+    assert r.status_code == 200
+    dbg = r.json()
+    assert dbg['history'] == []
+    rule_names = {x['name'] for x in dbg['rules']}
+    assert {'serve.queue_depth', 'serve.ttft_p99',
+            'fleet.heartbeat_age'} <= rule_names
+    # The SDK's direct-read op (what loadgen --alerts-url consumes).
+    out = sdk.alerts(history=True)
+    assert out['enabled'] is False and out['history'] == []
+
+
 def test_stream_and_get(server, capsys):
     task = Task('streamy', run='echo streamed-line')
     from skypilot_tpu.resources import Resources

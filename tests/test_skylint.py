@@ -14,6 +14,7 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO / 'tools'))
 
 import skylint  # noqa: E402
+from skylint.checkers import alert_rules as alert_mod  # noqa: E402
 from skylint.checkers import base as base_mod  # noqa: E402
 from skylint.checkers import engine_thread  # noqa: E402
 from skylint.checkers import env_flags as env_mod  # noqa: E402
@@ -400,6 +401,95 @@ def test_event_dead_declaration_detected(tmp_path):
 def test_event_cross_check_clean_on_real_tree():
     files = skylint.load_files()
     findings = event_mod.EventNames().check_tree(files, skylint.ROOT)
+    assert findings == [], '\n'.join(str(f) for f in findings)
+
+
+# -- alert-rule (SLO registry cross-check) -----------------------------------
+
+
+_ALERT_METRICS_SRC = '''
+    G = Gauge('skytpu_serve_qos_queue_depth', 'doc', ['qos_class'])
+    '''
+
+
+def _alert_tree(tmp_path, slo_src):
+    slo_py = tmp_path / 'skypilot_tpu' / 'observability' / 'slo.py'
+    slo_py.parent.mkdir(parents=True)
+    slo_py.write_text(textwrap.dedent(slo_src), encoding='utf-8')
+    metrics_py = tmp_path / 'skypilot_tpu' / 'server' / 'metrics.py'
+    metrics_py.parent.mkdir(parents=True)
+    metrics_py.write_text(textwrap.dedent(_ALERT_METRICS_SRC),
+                          encoding='utf-8')
+    (tmp_path / 'docs').mkdir()
+    (tmp_path / 'docs' / 'operations.md').write_text(
+        '| `serve.queue_depth` | page |\n', encoding='utf-8')
+    return tmp_path
+
+
+def test_alert_rule_typo_source_gets_hint(tmp_path):
+    root = _alert_tree(tmp_path, '''
+        HEALTH_FIELDS = (HealthField('replica.queue_depth', 'doc'),)
+        RULES = (
+            Rule('serve.queue_depth', 'doc', severity='page',
+                 signal='queue_depth',
+                 sources=('replica.queue_depht',
+                          'skytpu_serve_qos_queue_depth'),
+                 op='>', threshold=1.0),
+        )
+        SIGNALS = {'queue_depth': None}
+        ''')
+    findings = alert_mod.AlertRules().check_tree([], root)
+    msgs = [f.message for f in findings]
+    # The typo'd health field is flagged with a did-you-mean, and the
+    # now-unreferenced declared field is the matching dead entry.
+    assert any("'replica.queue_depht'" in m
+               and "did you mean 'replica.queue_depth'" in m
+               for m in msgs), msgs
+    assert any('dead vocabulary entry' in m for m in msgs), msgs
+    assert all(f.rule == 'alert-rule' for f in findings)
+
+
+def test_alert_rule_dead_rule_dead_signal_and_unknown_metric(tmp_path):
+    root = _alert_tree(tmp_path, '''
+        HEALTH_FIELDS = (HealthField('replica.queue_depth', 'doc'),)
+        RULES = (
+            Rule('serve.queue_depth', 'doc', severity='page',
+                 signal='queue_dpth',
+                 sources=('replica.queue_depth',
+                          'skytpu_no_such_series'),
+                 op='>', threshold=1.0),
+        )
+        SIGNALS = {'queue_depth': None, 'unused_signal': None}
+        ''')
+    findings = alert_mod.AlertRules().check_tree([], root)
+    msgs = [f.message for f in findings]
+    assert any('declared but never evaluated' in m
+               and "did you mean 'queue_depth'" in m for m in msgs), msgs
+    assert any("'unused_signal'" in m and 'dead signal' in m
+               for m in msgs), msgs
+    assert any("'skytpu_no_such_series'" in m and 'not defined' in m
+               for m in msgs), msgs
+
+
+def test_alert_rule_undocumented_and_bad_severity(tmp_path):
+    root = _alert_tree(tmp_path, '''
+        HEALTH_FIELDS = (HealthField('replica.queue_depth', 'doc'),)
+        RULES = (
+            Rule('serve.mystery', 'doc', severity='critical',
+                 signal='queue_depth',
+                 sources=('replica.queue_depth',),
+                 op='>', threshold=1.0),
+        )
+        SIGNALS = {'queue_depth': None}
+        ''')
+    findings = alert_mod.AlertRules().check_tree([], root)
+    msgs = [f.message for f in findings]
+    assert any("severity 'critical'" in m for m in msgs), msgs
+    assert any('not documented' in m for m in msgs), msgs
+
+
+def test_alert_rule_clean_on_real_tree():
+    findings = alert_mod.AlertRules().check_tree([], skylint.ROOT)
     assert findings == [], '\n'.join(str(f) for f in findings)
 
 

@@ -1,0 +1,371 @@
+"""SLO engine (observability/slo.py): burn-rate window math on
+synthetic sample streams, the pending->firing->resolved lifecycle with
+hysteresis (a flapping signal fires once), restart persistence (no
+re-page), the slo_breach black-box capture end to end (stubbed replica
+dump + a real local bundle), the SKYTPU_SLO=0 no-op, and the
+metrics-history persistence spool (torn-tail healing + rotation).
+
+jax-free (pure sample-stream evaluation) so the suite stays in the
+fast tier; every tick passes an explicit ``now`` for determinism.
+"""
+import dataclasses
+import json
+import os
+
+import pytest
+
+from skypilot_tpu.observability import slo
+
+KEY = 'svc/0'
+
+
+def _rule(**over):
+    base = next(r for r in slo.RULES if r.name == 'serve.queue_depth')
+    params = dict(threshold=5.0, fast_s=10.0, slow_s=60.0,
+                  fast_burn=0.5, slow_burn=0.1)
+    params.update(over)
+    return dataclasses.replace(base, **params)
+
+
+def _sample(ts, depth, key=KEY, **fields):
+    health = {'queue_depth': float(depth), 'active_slots': 0.0,
+              'ttft_p99_ms': None, 'tokens_emitted': None,
+              'decode_tok_s': None, 'shed_total': None,
+              'evicted_total': None, 'prefill_ms': None,
+              'prefill_bubble_ms': None}
+    health.update(fields)
+    return {'ts': float(ts), 'serve_replica_health': {key: health}}
+
+
+def _stream(t0, n, depth, step=1.0):
+    return [_sample(t0 + i * step, depth) for i in range(n)]
+
+
+@pytest.fixture
+def slo_on(monkeypatch, tmp_path):
+    monkeypatch.setenv('SKYTPU_SLO', '1')
+    monkeypatch.setenv('SKYTPU_BLACKBOX_DIR', str(tmp_path / 'bb'))
+    yield tmp_path
+    slo.install(None)
+
+
+# -- burn-rate window math ---------------------------------------------------
+
+
+def test_burn_window_fractions():
+    rule = _rule()
+    samples = [_sample(100 + i, 10.0 if 100 + i >= 115 else 0.0)
+               for i in range(20)]  # t = 100..119, last 5 breach
+    burns = slo.burn_fractions(rule, samples, now=119.0)
+    b = burns[KEY]
+    # fast window [109, 119]: 11 samples, 5 breaching; slow window
+    # [59, 119]: all 20 samples, 5 breaching.
+    assert b['fast_n'] == 11 and b['slow_n'] == 20
+    assert b['fast_frac'] == pytest.approx(5 / 11)
+    assert b['slow_frac'] == pytest.approx(0.25)
+    assert b['value'] == 10.0
+
+
+def test_burn_lower_bound_and_idle_gating():
+    # decode_tok_s rule: an idle engine (active_slots == 0) yields NO
+    # observation — an idle fleet must never breach a lower-bound rule.
+    rule = next(r for r in slo.RULES if r.name == 'serve.decode_tok_s')
+    idle = [_sample(100 + i, 0.0, tokens_emitted=100.0)
+            for i in range(10)]
+    assert slo.burn_fractions(rule, idle, now=110.0) == {}
+    # Actively decoding but slow: the token-counter delta rate is the
+    # observation and breaches the < threshold.
+    busy = [_sample(200 + i, 0.0, active_slots=2.0,
+                    tokens_emitted=100.0 + i) for i in range(10)]
+    burns = slo.burn_fractions(rule, busy, now=209.0)
+    assert burns[KEY]['value'] == pytest.approx(1.0)  # 1 tok/s
+    assert burns[KEY]['fast_frac'] == 1.0
+
+
+def test_counter_reset_yields_no_observation():
+    rule = next(r for r in slo.RULES if r.name == 'serve.shed_rate')
+    samples = [_sample(100, 0, shed_total=50.0, evicted_total=20.0),
+               _sample(101, 0, shed_total=3.0, evicted_total=1.0)]
+    burns = slo.burn_fractions(rule, samples, now=101.0)
+    # Restart reset (both counters went backwards): clamped to None,
+    # not a negative rate.
+    assert KEY not in burns
+    # A genuine burst observes: 50->53 sheds in 1 s = 3/s, breaching.
+    samples = [_sample(200, 0, shed_total=50.0, evicted_total=0.0),
+               _sample(201, 0, shed_total=53.0, evicted_total=0.0)]
+    burns = slo.burn_fractions(rule, samples, now=201.0)
+    assert burns[KEY]['value'] == pytest.approx(3.0)
+
+
+# -- lifecycle ---------------------------------------------------------------
+
+
+def test_pending_firing_resolved_lifecycle(slo_on, tmp_path):
+    dumps = []
+    engine = slo.SloEngine(state_dir=str(tmp_path / 'state'),
+                           rules=[_rule()], dump_fn=dumps.append)
+    samples = _stream(1000, 6, depth=10)
+    t1 = engine.tick(list(samples), now=1005.0)
+    assert [t['transition'] for t in t1] == ['pending']
+    assert not dumps
+    samples.append(_sample(1006, 10))
+    t2 = engine.tick(list(samples), now=1006.0)
+    assert [t['transition'] for t in t2] == ['firing']
+    assert len(dumps) == 1 and dumps[0]['rule'] == 'serve.queue_depth'
+    assert dumps[0]['target'] == KEY
+    active, history = engine.snapshot()
+    assert active[0]['state'] == 'firing' and not history
+    # Recovery: clear samples age the breaching ones out of the fast
+    # window; resolution needs resolve_ticks consecutive clean ticks.
+    for i in range(7, 20):
+        samples.append(_sample(1000 + i, 0))
+    resolved = []
+    for now in (1012.0, 1017.0, 1018.0, 1019.0):
+        resolved += [t for t in engine.tick(list(samples), now=now)
+                     if t['transition'] == 'resolved']
+    assert len(resolved) == 1
+    active, history = engine.snapshot()
+    assert not active
+    assert history[0]['state'] == 'resolved'
+    assert history[0]['resolved_at'] >= history[0]['fired_at']
+    assert len(dumps) == 1  # resolution never dumps
+
+
+def test_flapping_signal_fires_once(slo_on, tmp_path):
+    dumps = []
+    engine = slo.SloEngine(state_dir=str(tmp_path / 'state'),
+                           rules=[_rule(fast_burn=0.4)],
+                           dump_fn=dumps.append)
+    samples = []
+    firings = 0
+    for i in range(30):  # strict alternation: 10, 0, 10, 0, ...
+        samples.append(_sample(2000 + i, 10 if i % 2 == 0 else 0))
+        if i >= 5:
+            ticks = engine.tick(list(samples), now=2000.0 + i)
+            firings += sum(1 for t in ticks
+                           if t['transition'] == 'firing')
+    # The window fraction smooths the flap (~0.5 breaching, above the
+    # 0.4 burn, never below the 0.2 resolve band): ONE alert, one dump.
+    assert firings == 1
+    assert len(dumps) == 1
+    assert engine.firing()
+
+
+def test_restart_does_not_repage(slo_on, tmp_path):
+    state = str(tmp_path / 'state')
+    dumps1, dumps2 = [], []
+    engine1 = slo.SloEngine(state_dir=state, rules=[_rule()],
+                            dump_fn=dumps1.append)
+    samples = _stream(1000, 8, depth=10)
+    engine1.tick(list(samples), now=1006.0)
+    engine1.tick(list(samples), now=1007.0)
+    assert len(dumps1) == 1 and engine1.firing()
+    assert os.path.exists(os.path.join(state, slo.STATE_FILE))
+    # "Restart": a fresh engine over the same state dir, signal still
+    # degraded — the alert reloads as firing and must NOT dump again.
+    engine2 = slo.SloEngine(state_dir=state, rules=[_rule()],
+                            dump_fn=dumps2.append)
+    assert engine2.firing(), 'persisted firing alert not reloaded'
+    samples.append(_sample(1008, 10))
+    transitions = engine2.tick(list(samples), now=1008.0)
+    assert transitions == []  # no new lifecycle edge
+    assert dumps2 == []       # and no re-page
+    assert engine2.firing()[0]['paged'] is True
+
+
+def test_torn_state_file_is_not_fatal(slo_on, tmp_path):
+    state = tmp_path / 'state'
+    state.mkdir()
+    (state / slo.STATE_FILE).write_text('{"active": {"x"', # torn write
+                                        encoding='utf-8')
+    engine = slo.SloEngine(state_dir=str(state), rules=[_rule()])
+    assert engine.snapshot() == ([], [])
+
+
+# -- slo_breach capture ------------------------------------------------------
+
+
+def test_slo_breach_bundle_end_to_end(slo_on, tmp_path):
+    from skypilot_tpu.observability import blackbox
+    blackbox.reset()
+    fetched = []
+    engine = slo.SloEngine(state_dir=str(tmp_path / 'state'),
+                           rules=[_rule()],
+                           endpoints={KEY: '127.0.0.1:1'},
+                           http_get=fetched.append)
+    samples = _stream(1000, 8, depth=10)
+    engine.tick(list(samples), now=1006.0)
+    engine.tick(list(samples), now=1007.0)
+    assert engine.firing()
+    # Local process bundle, with the bounded slo_breach trigger.
+    bundles = blackbox.list_bundles()
+    assert len(bundles) == 1
+    assert bundles[0]['trigger'] == 'slo_breach'
+    assert 'serve.queue_depth' in (bundles[0]['reason'] or '')
+    assert blackbox.dump_counts() == {'slo_breach': 1}
+    # Implicated replica interrogated over its /debug/blackbox with the
+    # same bounded trigger (HTTP stubbed here; perf_probe --slo drives
+    # a real replica).
+    assert len(fetched) == 1
+    assert fetched[0].startswith('http://127.0.0.1:1/debug/blackbox')
+    assert 'dump=1' in fetched[0] and 'trigger=slo_breach' in fetched[0]
+
+
+def test_dump_disabled_by_flag(slo_on, tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYTPU_SLO_DUMP', '0')
+    dumps = []
+    engine = slo.SloEngine(state_dir=str(tmp_path / 'state'),
+                           rules=[_rule()], dump_fn=dumps.append)
+    samples = _stream(1000, 8, depth=10)
+    engine.tick(list(samples), now=1006.0)
+    engine.tick(list(samples), now=1007.0)
+    assert engine.firing() and dumps == []
+
+
+# -- disabled = no-op --------------------------------------------------------
+
+
+def test_disabled_is_noop(monkeypatch, tmp_path):
+    monkeypatch.delenv('SKYTPU_SLO', raising=False)
+    assert not slo.enabled()
+    engine = slo.SloEngine(state_dir=str(tmp_path / 'state'),
+                           rules=[_rule()])
+    assert engine.tick(_stream(1000, 8, depth=10), now=1007.0) == []
+    assert not os.path.exists(os.path.join(str(tmp_path / 'state'),
+                                           slo.STATE_FILE))
+    assert slo.evaluate_once() is None
+    assert slo.get_engine() is None
+    assert slo.firing() == []
+    payload = slo.alerts_payload({'history': '1'})
+    assert payload == {'enabled': False, 'alerts': [], 'firing': 0,
+                       'history': []}
+
+
+# -- payload + gauge ---------------------------------------------------------
+
+
+def test_payload_and_firing_gauge(slo_on, tmp_path):
+    from prometheus_client import generate_latest
+
+    from skypilot_tpu.server import metrics as metrics_mod
+    engine = slo.SloEngine(state_dir=str(tmp_path / 'state'),
+                           rules=[_rule()], dump_fn=lambda a: None)
+    samples = _stream(1000, 8, depth=10)
+    engine.tick(list(samples), now=1006.0)
+    engine.tick(list(samples), now=1007.0)
+    slo.install(engine)
+    payload = slo.alerts_payload({'history': '1', 'rules': '1'})
+    assert payload['enabled'] is True and payload['firing'] == 1
+    assert payload['alerts'][0]['rule'] == 'serve.queue_depth'
+    assert payload['history'] == []
+    assert {r['name'] for r in payload['rules']} == set(slo.RULE_NAMES)
+    metrics_mod._refresh_alert_gauge()
+    text = generate_latest(metrics_mod.REGISTRY).decode()
+    assert ('skytpu_alerts_firing{rule="serve.queue_depth",'
+            'severity="page"} 1.0') in text
+    # The gauge is nonzero ONLY while firing: uninstall (nothing runs
+    # in-process, persisted state has the firing alert — still counts),
+    # then resolve and re-render.
+    for i in range(8, 25):
+        samples.append(_sample(1000 + i, 0))
+    for now in (1014.0, 1020.0, 1021.0, 1022.0):
+        engine.tick(list(samples), now=now)
+    assert not engine.firing()
+    metrics_mod._refresh_alert_gauge()
+    text = generate_latest(metrics_mod.REGISTRY).decode()
+    assert 'skytpu_alerts_firing{' not in text
+
+
+def test_firing_reads_persisted_state_without_engine(
+        slo_on, tmp_path, monkeypatch):
+    # A scrape right after restart, before the daemon's first tick:
+    # firing() falls back to the persisted state file.
+    state_root = tmp_path / 'state-root'
+    monkeypatch.setenv('SKYTPU_STATE_DIR', str(state_root))
+    engine = slo.SloEngine(rules=[_rule()], dump_fn=lambda a: None)
+    samples = _stream(1000, 8, depth=10)
+    engine.tick(list(samples), now=1006.0)
+    engine.tick(list(samples), now=1007.0)
+    slo.install(None)
+    firing = slo.firing()
+    assert len(firing) == 1 and firing[0]['rule'] == 'serve.queue_depth'
+
+
+# -- metrics-history persistence spool ---------------------------------------
+
+
+def test_spool_reload_heals_torn_tail(monkeypatch, tmp_path):
+    from skypilot_tpu.server import metrics_history
+    monkeypatch.setenv('SKYTPU_STATE_DIR', str(tmp_path))
+    metrics_history.clear_for_testing()
+    good = [{'ts': 100.0 + i, 'clusters': {}} for i in range(3)]
+    with open(metrics_history.spool_path(), 'w', encoding='utf-8') as f:
+        for s in good:
+            f.write(json.dumps(s) + '\n')
+        f.write('{"ts": 103.0, "clus')  # torn mid-append by a crash
+    restored = metrics_history.load_spool()
+    assert restored == 3  # torn tail skipped, never fatal
+    assert [s['ts'] for s in metrics_history.history()] == \
+        [100.0, 101.0, 102.0]
+    # Reload into a non-empty ring is a no-op (no duplication).
+    assert metrics_history.load_spool() == 0
+    metrics_history.clear_for_testing()
+
+
+def test_spool_rotation_keeps_ring_coverage(monkeypatch, tmp_path):
+    from skypilot_tpu.server import metrics_history
+    monkeypatch.setenv('SKYTPU_STATE_DIR', str(tmp_path))
+    monkeypatch.setattr(metrics_history, '_MAX_SAMPLES', 5)
+    metrics_history.clear_for_testing()
+    for i in range(12):
+        with metrics_history._lock:
+            metrics_history._append_spool({'ts': float(i)})
+    assert os.path.exists(metrics_history.spool_path() + '.1')
+    metrics_history.clear_for_testing()
+    restored = metrics_history.load_spool()
+    # SKYTPU_METRICS_HISTORY_SAMPLES semantics: a reload restores at
+    # most a full ring, newest first.
+    assert restored == 5
+    assert [s['ts'] for s in metrics_history.history()] == \
+        [7.0, 8.0, 9.0, 10.0, 11.0]
+    metrics_history.clear_for_testing()
+
+
+def test_sample_skips_stopped_clusters(monkeypatch, tmp_path):
+    """A deliberately stopped cluster keeps its row (and its frozen
+    last_heartbeat) — it must never feed the page-severity
+    fleet.heartbeat_age rule or the ckpt.staleness rule."""
+    import time
+    from types import SimpleNamespace
+
+    from skypilot_tpu import global_user_state
+    from skypilot_tpu.server import metrics_history
+    monkeypatch.setenv('SKYTPU_STATE_DIR', str(tmp_path))
+    now = time.time()
+    recs = [
+        {'name': 'live', 'status': SimpleNamespace(value='UP'),
+         'last_heartbeat': now - 500.0,
+         'heartbeat': {'ckpt': {'last_save_ts': now - 100.0}}},
+        {'name': 'parked', 'status': SimpleNamespace(value='STOPPED'),
+         'last_heartbeat': now - 500.0,
+         'heartbeat': {'ckpt': {'last_save_ts': now - 9999.0}}},
+    ]
+    monkeypatch.setattr(global_user_state, 'get_clusters',
+                        lambda **kw: recs)
+    sample = metrics_history.sample_once(record=False)
+    assert set(sample['cluster_heartbeat_age']) == {'live'}
+    assert sample['cluster_heartbeat_age']['live'] == \
+        pytest.approx(500.0, abs=5.0)
+    assert set(sample['ckpt_staleness_s']) == {'live'}
+
+
+def test_spool_disabled_writes_nothing(monkeypatch, tmp_path):
+    from skypilot_tpu.server import metrics_history
+    monkeypatch.setenv('SKYTPU_STATE_DIR', str(tmp_path))
+    monkeypatch.setenv('SKYTPU_METRICS_SPOOL', '0')
+    metrics_history.clear_for_testing()
+    with metrics_history._lock:
+        metrics_history._append_spool({'ts': 1.0})
+    assert not os.path.exists(metrics_history.spool_path())
+    assert metrics_history.load_spool() == 0
+    metrics_history.clear_for_testing()

@@ -77,6 +77,19 @@ and a kill -9 of one of two replicas under load — serving continues on
 the survivor and the survivor's bundle merged with the LB process's
 own ring reconstructs the timeline (ready-set flip, then survivor
 dispatches). CPU-only, wired into ``make verify``.
+
+``--slo`` runs the SLO burn-rate alerting gate (observability/slo.py):
+two single-slot replicas; a hammer stalls one under concurrent load so
+its admission backlog breaches the queue-depth rule — the alert must
+transition pending -> firing within two evaluation ticks, the firing
+page must freeze black-box bundles with the bounded ``slo_breach``
+trigger BOTH locally and in the implicated replica's spool (fetched
+over its /debug/blackbox), the ``skytpu_alerts_firing`` gauge must be
+nonzero exactly while firing, the alert must resolve after the hammer
+stops and the queue drains, and greedy output must be byte-identical
+between an SKYTPU_SLO=1 and an SKYTPU_SLO=0 replica (and unchanged on
+the degraded replica after recovery). CPU-only, wired into
+``make verify``.
 """
 import json
 import os
@@ -1213,7 +1226,229 @@ def blackbox_probe() -> dict:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def slo_probe() -> dict:
+    """SLO burn-rate alerting gate over real OS-process replicas:
+
+    (a) **no-op + byte parity** — with SKYTPU_SLO unset the engine's
+        tick is a no-op (no state file, no transitions); greedy output
+        from an SKYTPU_SLO=1 replica is byte-identical to an
+        SKYTPU_SLO=0 replica;
+    (b) **degradation -> firing within two ticks** — a hammer floods
+        the single-slot 'hot' replica, its admission backlog breaches
+        the queue-depth rule, and the alert transitions
+        pending -> firing on the next evaluation tick;
+    (c) **slo_breach capture** — the firing page freezes a local
+        bundle (this process's spool) AND one in the hot replica's own
+        spool via its /debug/blackbox, both with trigger 'slo_breach';
+        skytpu_alerts_firing is nonzero while (and only while) firing;
+    (d) **recovery** — hammer stops, the queue drains, the alert
+        resolves, the gauge clears, and the degraded replica's greedy
+        output is unchanged from before the episode.
+    """
+    import dataclasses
+    import shutil
+    import tempfile
+    import threading
+
+    import requests as requests_lib
+    from prometheus_client import generate_latest
+
+    from skypilot_tpu.observability import blackbox
+    from skypilot_tpu.observability import slo
+    from skypilot_tpu.server import metrics as metrics_mod
+    from skypilot_tpu.utils import common_utils
+
+    max_len = 256
+    workdir = tempfile.mkdtemp(prefix='skytpu-slo-')
+    # The probe process's own recorder spool (the engine's local
+    # slo_breach dump must land somewhere inspectable).
+    os.environ['SKYTPU_BLACKBOX_DIR'] = os.path.join(workdir, 'spool')
+    blackbox.reset()
+    os.environ.pop('SKYTPU_SLO', None)
+    # Identical serving configs except the SLO flag — slots=1 both so
+    # the parity legs compare byte-for-byte equal engines AND the hot
+    # replica's one slot lets a small hammer hold a deep queue.
+    specs = {'hot': {'SKYTPU_LLM_SLOTS': '1', 'SKYTPU_SLO': '1'},
+             'off': {'SKYTPU_LLM_SLOTS': '1', 'SKYTPU_SLO': '0'}}
+    ports = {t: common_utils.find_free_port(24600 + 40 * i)
+             for i, t in enumerate(specs)}
+    procs = {t: _spawn_replica('colocated', ports[t], workdir, max_len,
+                               tag=t, extra_env=env)
+             for t, env in specs.items()}
+    eps = {t: f'127.0.0.1:{port}' for t, port in ports.items()}
+
+    def row(n, salt):
+        return [(5 * i + 13 * salt) % 240 + 1 for i in range(n)]
+
+    parity_payload = {'tokens': [row(24, 3)], 'max_new_tokens': 24}
+    # Scaled rule: same registry rule, CI-sized windows. fast 6 s of
+    # ~0.7 s ticks, slow effectively the whole run.
+    qrule = dataclasses.replace(
+        next(r for r in slo.RULES if r.name == 'serve.queue_depth'),
+        threshold=3.0, fast_s=6.0, slow_s=120.0, fast_burn=0.5,
+        slow_burn=0.05)
+    stop_hammer = threading.Event()
+
+    def hammer():
+        body = {'tokens': [row(20, 7)], 'max_new_tokens': 64}
+        while not stop_hammer.is_set():
+            try:
+                requests_lib.post(f'http://{eps["hot"]}/generate',
+                                  json=body, timeout=600)
+            except requests_lib.RequestException:
+                time.sleep(0.2)
+
+    threads = [threading.Thread(target=hammer, daemon=True)
+               for _ in range(6)]
+    try:
+        deadline = time.time() + 300
+        for tag, ep in eps.items():
+            while True:
+                if procs[tag].poll() is not None:
+                    raise RuntimeError(
+                        f'{tag} replica exited at startup; see '
+                        f'{workdir}/{tag}.log')
+                try:
+                    requests_lib.get(f'http://{ep}/health',
+                                     timeout=5).raise_for_status()
+                    break
+                except requests_lib.RequestException:
+                    if time.time() > deadline:
+                        raise RuntimeError(
+                            f'{tag} replica never became healthy')
+                    time.sleep(0.5)
+
+        def sample():
+            reps = {}
+            for tag, ep in eps.items():
+                body = requests_lib.get(f'http://{ep}/health',
+                                        timeout=30).json()
+                reps[f'probe/{tag}'] = slo.replica_signal_fields(body)
+            return {'ts': time.time(), 'serve_replica_health': reps}
+
+        # --- (a) disabled no-op, then cross-replica byte parity ---------
+        noop_state = os.path.join(workdir, 'noop-state')
+        noop = slo.SloEngine(state_dir=noop_state, rules=[qrule])
+        assert noop.tick([sample()]) == [], 'disabled tick must no-op'
+        assert not os.path.exists(
+            os.path.join(noop_state, slo.STATE_FILE))
+        before = requests_lib.post(f'http://{eps["hot"]}/generate',
+                                   json=parity_payload, timeout=600)
+        off = requests_lib.post(f'http://{eps["off"]}/generate',
+                                json=parity_payload, timeout=600)
+        assert before.status_code == off.status_code == 200, \
+            (before.text, off.text)
+        assert before.json() == off.json(), \
+            'SKYTPU_SLO=1 vs =0 greedy outputs differ'
+
+        # --- (b) stall one replica under load -> firing in two ticks ----
+        os.environ['SKYTPU_SLO'] = '1'
+        engine = slo.SloEngine(
+            state_dir=os.path.join(workdir, 'slo-state'),
+            rules=[qrule], endpoints={'probe/hot': eps['hot']})
+        slo.install(engine)
+        for t in threads:
+            t.start()
+        samples = []
+        pending_tick = firing_tick = None
+        tick_no = 0
+        deadline = time.time() + 120
+        while firing_tick is None and time.time() < deadline:
+            time.sleep(0.7)
+            samples.append(sample())
+            tick_no += 1
+            for tr in engine.tick(list(samples)):
+                if tr['transition'] == 'pending' and pending_tick is None:
+                    pending_tick = tick_no
+                if tr['transition'] == 'firing':
+                    firing_tick = tick_no
+        assert firing_tick is not None, \
+            'queue-depth alert never transitioned to firing'
+        assert pending_tick is not None and \
+            firing_tick - pending_tick <= 1, \
+            (f'firing took {firing_tick - pending_tick + 1} ticks '
+             'from the first breaching evaluation, want <= 2')
+        alert = engine.firing()[0]
+        assert alert['rule'] == 'serve.queue_depth' and \
+            alert['severity'] == 'page' and \
+            alert['target'] == 'probe/hot', alert
+
+        # --- (c) slo_breach bundles + gauge nonzero while firing --------
+        local = blackbox.list_bundles()
+        assert local and local[0]['trigger'] == 'slo_breach', local
+        rep_deadline = time.time() + 60
+        rep_bundles = []
+        while time.time() < rep_deadline:
+            rep_bundles = requests_lib.get(
+                f'http://{eps["hot"]}/debug/blackbox',
+                timeout=60).json()['bundles']
+            if any(b['trigger'] == 'slo_breach' for b in rep_bundles):
+                break
+            time.sleep(0.5)
+        assert any(b['trigger'] == 'slo_breach' for b in rep_bundles), \
+            'no slo_breach bundle landed in the replica spool'
+        metrics_mod._refresh_alert_gauge()
+        text = generate_latest(metrics_mod.REGISTRY).decode()
+        assert ('skytpu_alerts_firing{rule="serve.queue_depth",'
+                'severity="page"} 1.0') in text
+        # Replica-side /debug/alerts answers on both servers.
+        rep_alerts = requests_lib.get(
+            f'http://{eps["hot"]}/debug/alerts', timeout=30).json()
+        assert rep_alerts['enabled'] is True and \
+            rep_alerts['alerts'] == [], rep_alerts
+
+        # --- (d) recovery: resolve + gauge clears + parity holds --------
+        stop_hammer.set()
+        for t in threads:
+            t.join(timeout=600)
+        resolved = False
+        deadline = time.time() + 120
+        while not resolved and time.time() < deadline:
+            time.sleep(0.7)
+            samples.append(sample())
+            resolved = any(tr['transition'] == 'resolved'
+                           for tr in engine.tick(list(samples)))
+        assert resolved, 'alert did not resolve after the queue drained'
+        assert not engine.firing()
+        _, history = engine.snapshot()
+        assert history[0]['rule'] == 'serve.queue_depth' and \
+            history[0]['paged'] is True
+        metrics_mod._refresh_alert_gauge()
+        text = generate_latest(metrics_mod.REGISTRY).decode()
+        assert 'skytpu_alerts_firing{' not in text, \
+            'gauge still nonzero after resolution'
+        after = requests_lib.post(f'http://{eps["hot"]}/generate',
+                                  json=parity_payload, timeout=600)
+        assert after.status_code == 200 and \
+            after.json() == before.json(), \
+            'degraded replica output changed across the episode'
+        return {'parity': 'byte-identical (SKYTPU_SLO=1 vs =0, and '
+                          'pre/post episode)',
+                'pending_tick': pending_tick, 'firing_tick': firing_tick,
+                'peak_queue_depth': max(
+                    (s['serve_replica_health']['probe/hot']
+                     ['queue_depth'] for s in samples)),
+                'local_bundles': len(local),
+                'replica_bundles': len(rep_bundles),
+                'resolved': resolved}
+    finally:
+        stop_hammer.set()
+        slo.install(None)
+        os.environ.pop('SKYTPU_SLO', None)
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def main():
+    if '--slo' in sys.argv:
+        # CPU-only by design (same rationale as --smoke): never touch
+        # or wait on a chip in CI.
+        jax.config.update('jax_platforms', 'cpu')
+        print(json.dumps({'slo_smoke': 'ok', **slo_probe()}),
+              flush=True)
+        return
     if '--blackbox' in sys.argv:
         # CPU-only by design (same rationale as --smoke): never touch
         # or wait on a chip in CI.

@@ -1,4 +1,5 @@
 """Checker modules. Importing this package populates the registry."""
-from skylint.checkers import (base, engine_thread, env_flags,  # noqa: F401
-                              event_names, host_sync, lock_discipline,
-                              metric_names, pycache)
+from skylint.checkers import (alert_rules, base,  # noqa: F401
+                              engine_thread, env_flags, event_names,
+                              host_sync, lock_discipline, metric_names,
+                              pycache)

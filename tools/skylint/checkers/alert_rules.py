@@ -1,0 +1,223 @@
+"""SLO alert-rule cross-check.
+
+Every burn-rate alert rule is declared exactly once, in
+``skypilot_tpu/observability/slo.py``'s :data:`RULES` registry (the
+``metric-name`` / ``event-name`` convention for the alerting plane).
+A rule is only as real as the signals it reads: a typo'd source name
+would evaluate over nothing and silently never fire — the worst
+possible failure mode for an alerting system. Checks:
+
+* every ``Rule.signal`` must be a literal key of slo.py's ``SIGNALS``
+  extractor table — a rule whose signal has no extractor is *declared
+  but never evaluated* (dead rule), with a did-you-mean hint on typos;
+* every ``Rule.sources`` entry must exist: ``skytpu_*`` tokens must be
+  defined in ``server/metrics.py`` (reusing the metric-name checker's
+  exposition-suffix normalization) and everything else must be a
+  declared ``HEALTH_FIELDS`` vocabulary name;
+* every ``SIGNALS`` key and every ``HEALTH_FIELDS`` name must be
+  referenced by at least one rule — a dead signal/field is evaluator
+  machinery the registry no longer exercises;
+* rule severities are bounded to slo.py's ``SEVERITIES`` tiers;
+* every rule name must appear in ``docs/operations.md`` (the §SLOs &
+  alerting rule catalog) — an undocumented page is a 3am mystery.
+
+No escape hatch: the registry module is the single source of truth;
+fix the registry, not the checker."""
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from skylint import Checker, Finding, register
+from skylint.checkers.event_names import _closest
+from skylint.checkers.metric_names import (METRICS_REL, _definitions,
+                                           _valid_ref)
+
+REGISTRY_REL = 'skypilot_tpu/observability/slo.py'
+DOCS_REL = 'docs/operations.md'
+SEVERITIES = ('info', 'warn', 'page')
+
+
+@register
+class AlertRules(Checker):
+
+    name = 'alert-rule'
+
+    def check_tree(self, files: Sequence[Any],
+                   root: pathlib.Path) -> List[Finding]:
+        del files
+        path = root / REGISTRY_REL
+        if not path.is_file():
+            return [Finding(REGISTRY_REL, 1, self.name,
+                            f'{REGISTRY_REL} is missing — no alert-rule '
+                            'registry to check')]
+        try:
+            tree = ast.parse(path.read_text(encoding='utf-8'),
+                             filename=str(path))
+        except SyntaxError as e:
+            return [Finding(REGISTRY_REL, e.lineno or 1, self.name,
+                            f'registry unreadable: {e.msg}')]
+        rules = _rule_calls(tree)
+        signals = _signal_keys(tree)
+        health = _health_fields(tree)
+        metrics = self._metrics_defined(root)
+        out: List[Finding] = []
+        if not rules:
+            return [Finding(REGISTRY_REL, 1, self.name,
+                            'no Rule(...) declarations found — registry '
+                            'unreadable?')]
+        if not signals:
+            out.append(Finding(REGISTRY_REL, 1, self.name,
+                               'no SIGNALS extractor table found — '
+                               'every rule is unevaluable'))
+        vocab = set(health)
+        seen_names: Dict[str, int] = {}
+        used_signals: set = set()
+        used_fields: set = set()
+        docs_text = ''
+        docs_path = root / DOCS_REL
+        if docs_path.is_file():
+            docs_text = docs_path.read_text(encoding='utf-8')
+        for rule in rules:
+            lineno = rule['lineno']
+            rname = rule.get('name')
+            if rname is None:
+                out.append(Finding(REGISTRY_REL, lineno, self.name,
+                                   'Rule name must be a string literal'))
+                continue
+            if rname in seen_names:
+                out.append(Finding(
+                    REGISTRY_REL, lineno, self.name,
+                    f'duplicate rule {rname!r} (first declared at line '
+                    f'{seen_names[rname]})'))
+            seen_names.setdefault(rname, lineno)
+            severity = rule.get('severity')
+            if severity not in SEVERITIES:
+                out.append(Finding(
+                    REGISTRY_REL, lineno, self.name,
+                    f'rule {rname!r} severity {severity!r} is not one '
+                    f'of {SEVERITIES}'))
+            signal = rule.get('signal')
+            if signal is None:
+                out.append(Finding(REGISTRY_REL, lineno, self.name,
+                                   f'rule {rname!r} has no literal '
+                                   'signal='))
+            elif signal not in signals:
+                hint = _closest(signal, signals)
+                out.append(Finding(
+                    REGISTRY_REL, lineno, self.name,
+                    f'rule {rname!r} signal {signal!r} has no extractor '
+                    'in SIGNALS — the rule is declared but never '
+                    'evaluated (dead rule)'
+                    + (f'; did you mean {hint!r}?' if hint else '')))
+            else:
+                used_signals.add(signal)
+            for source in rule.get('sources') or ():
+                if source.startswith('skytpu_'):
+                    if not _valid_ref(source, metrics):
+                        out.append(Finding(
+                            REGISTRY_REL, lineno, self.name,
+                            f'rule {rname!r} source {source!r} is not '
+                            f'defined in {METRICS_REL} (renamed or '
+                            "typo'd series?)"))
+                elif source in vocab:
+                    used_fields.add(source)
+                else:
+                    hint = _closest(source, vocab)
+                    out.append(Finding(
+                        REGISTRY_REL, lineno, self.name,
+                        f'rule {rname!r} source {source!r} is neither a '
+                        f'defined skytpu_* series nor a declared '
+                        'HEALTH_FIELDS name'
+                        + (f'; did you mean {hint!r}?' if hint else '')))
+            if docs_text and rname not in docs_text:
+                out.append(Finding(
+                    REGISTRY_REL, lineno, self.name,
+                    f'rule {rname!r} is not documented in {DOCS_REL} '
+                    '(§SLOs & alerting rule catalog) — an undocumented '
+                    'page is a 3am mystery'))
+        for signal, lineno in sorted(signals.items()):
+            if signal not in used_signals:
+                out.append(Finding(
+                    REGISTRY_REL, lineno, self.name,
+                    f'signal {signal!r} has an extractor but no rule '
+                    'references it — dead signal; delete the extractor '
+                    'or declare the rule it was built for'))
+        for field, lineno in sorted(health.items()):
+            if field not in used_fields:
+                out.append(Finding(
+                    REGISTRY_REL, lineno, self.name,
+                    f'health field {field!r} is declared but no rule '
+                    'sources it — dead vocabulary entry'))
+        return out
+
+    def _metrics_defined(self, root: pathlib.Path) -> Dict[str, int]:
+        path = root / METRICS_REL
+        if not path.is_file():
+            return {}
+        try:
+            tree = ast.parse(path.read_text(encoding='utf-8'),
+                             filename=str(path))
+        except SyntaxError:
+            return {}
+        return {metric: node.lineno
+                for node, metric in _definitions(tree)}
+
+
+def _const_str(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _rule_calls(tree: ast.AST) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == 'Rule'):
+            continue
+        rule: Dict[str, Any] = {'lineno': node.lineno}
+        if node.args:
+            rule['name'] = _const_str(node.args[0])
+        for kw in node.keywords:
+            if kw.arg in ('name', 'severity', 'signal', 'op'):
+                rule[kw.arg] = _const_str(kw.value)
+            elif kw.arg == 'sources' and isinstance(kw.value, ast.Tuple):
+                sources: Tuple[str, ...] = tuple(
+                    s for s in (_const_str(e) for e in kw.value.elts)
+                    if s is not None)
+                rule['sources'] = sources
+        out.append(rule)
+    return out
+
+
+def _signal_keys(tree: ast.AST) -> Dict[str, int]:
+    """Literal keys of the module-level SIGNALS dict (plain or
+    annotated assignment)."""
+    for node in ast.walk(tree):
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            target = node.target
+        if not (isinstance(target, ast.Name) and target.id == 'SIGNALS'
+                and isinstance(getattr(node, 'value', None), ast.Dict)):
+            continue
+        return {key.value: key.lineno for key in node.value.keys
+                if isinstance(key, ast.Constant)
+                and isinstance(key.value, str)}
+    return {}
+
+
+def _health_fields(tree: ast.AST) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id == 'HealthField' and node.args:
+            name = _const_str(node.args[0])
+            if name is not None:
+                out.setdefault(name, node.lineno)
+    return out
