@@ -32,6 +32,12 @@ ci:
 # nonzero handoff gauges, decode pool >= 0.9x colocated tok/s while a
 # long-prompt prefill runs on the prefill pool, kill -9 of the
 # prefill replica served through the colocated fallback),
+# the prefix-affinity routing gate (three replicas behind a
+# least-load vs affinity LB A/B: fleet-wide prefix hit rate >= 1.5x
+# on a many-tenant shared-prefix mix with p99 inside a 25% CI-jitter
+# allowance of baseline, a hot single prefix spills past the detour
+# budget instead of overloading one box, byte parity through the
+# affinity LB),
 # the goodput gate (trainer stdout byte-identical with telemetry
 # off vs on; managed-job phase ledger gap-free and summing to
 # wall-clock across an injected preemption), the checkpoint gate
@@ -55,6 +61,7 @@ verify:
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --prefix
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --trace
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --disagg
+	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --affinity
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --goodput
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --ckpt
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --blackbox

@@ -198,6 +198,25 @@ FLAGS: Tuple[Flag, ...] = (
     Flag('SKYTPU_QOS_FALLBACK_TOK_S', 'float', '100',
          'Assumed decode tok/s for Retry-After before any throughput '
          'is observed.'),
+    # -- serving: fleet prefix-affinity routing -----------------------
+    Flag('SKYTPU_PREFIX_AFFINITY', 'bool', '0',
+         'Route /generate requests to the replica whose advertised '
+         'BlockTrie summary matches the prompt head (LB + '
+         'autoscalers); 0 = plain least-load routing.'),
+    Flag('SKYTPU_PREFIX_SUMMARY_MAX', 'int', '64',
+         'Hard cap on trie-summary entries a replica adverts in '
+         '/health (deepest/hottest chains kept first).'),
+    Flag('SKYTPU_PREFIX_AFFINITY_WEIGHT', 'float', '1',
+         'Load-unit credit per matched chain block when scoring an '
+         'affinity pick against the least-loaded replica.'),
+    Flag('SKYTPU_PREFIX_AFFINITY_MAX_DETOUR', 'float', '4',
+         'Max load units an affinity pick may exceed the fleet '
+         'minimum by before the request spills to least-load (the '
+         'hot-prefix saturation budget; also discounted from the '
+         'autoscalers\' queue signal).'),
+    Flag('SKYTPU_PREFIX_AFFINITY_MAX_BLOCKS', 'int', '32',
+         'Leading full prompt blocks hashed per request for affinity '
+         'matching.'),
     # -- serving: disaggregated prefill/decode ------------------------
     Flag('SKYTPU_DISAGG_STAGING', 'path', None,
          'Shared staging dir for same-host KV handoffs (payload moves '

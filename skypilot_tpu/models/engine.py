@@ -635,6 +635,13 @@ class ContinuousEngine:
                              and self.kv_layout == 'paged'
                              and cfg.num_experts == 0
                              and draft_cfg is None)
+        # Fleet prefix-affinity advert (utils/prefix_affinity.py): hard
+        # entry bound on the trie summary /health ships — the replica
+        # probe stores health bodies whole-or-nothing under a 16 KiB
+        # cap, so an unbounded advert would blank the ENTIRE health
+        # snapshot exactly on the warmed replicas affinity needs.
+        self._summary_max = max(
+            int(os.environ.get('SKYTPU_PREFIX_SUMMARY_MAX', '64')), 0)
         self._prefix_index: 'collections.OrderedDict[tuple, int]' = \
             collections.OrderedDict()  # prefix tokens -> pool row
         self._prefix_seen: 'collections.OrderedDict[tuple, int]' = \
@@ -864,6 +871,17 @@ class ContinuousEngine:
             for nd in nodes:
                 self._trie.touch(nd)
         return len(nodes)
+
+    def prefix_summary(self) -> Optional[dict]:
+        """Bounded resident-chain summary for fleet prefix-affinity
+        routing (``BlockTrie.summary``), or None when sharing is off.
+        Shipped in the /health body (serve/llm_server.py) and pushed by
+        the controller into the LB's ``PrefixAffinityPolicy`` the same
+        way queue pressure is."""
+        if self._trie is None:
+            return None
+        with self._lock:
+            return self._trie.summary(self._summary_max)
 
     def _build_request(self, row, max_new, temperature, on_tokens,
                        top_k, top_p, eos, export: bool = False

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import heapq
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -45,6 +46,7 @@ from skypilot_tpu.models.generate import (KVCache, _cached_attention,
                                           _mlp_tail, _qkv_proj,
                                           _quantize_block)
 from skypilot_tpu.models.quantization import mm as _mm
+from skypilot_tpu.utils import prefix_affinity as affinity_lib
 
 
 @dataclasses.dataclass
@@ -360,8 +362,14 @@ class _TrieNode:
     ``children`` chain deeper blocks of the same prefix. ``detached``
     marks a node whose ancestor was evicted: it can never be matched
     again, so when its refs drop to zero its block frees directly
-    instead of parking in the idle LRU."""
-    __slots__ = ('block', 'key', 'parent', 'children', 'refs', 'detached')
+    instead of parking in the idle LRU. ``chain`` is the digest of the
+    whole token chain root->here (utils/prefix_affinity.py) — a pure
+    function of the tokens, so it is stable across commit/evict cycles
+    and across replicas; ``hits``/``hit_tick`` carry a DECAYED match
+    count (the hotness signal summary truncation orders by — see
+    ``BlockTrie._hotness``)."""
+    __slots__ = ('block', 'key', 'parent', 'children', 'refs', 'detached',
+                 'chain', 'hits', 'hit_tick')
 
     def __init__(self, block: int, key: tuple,
                  parent: Optional['_TrieNode']):
@@ -371,6 +379,10 @@ class _TrieNode:
         self.children: Dict[tuple, '_TrieNode'] = {}
         self.refs = 1
         self.detached = False
+        self.chain = affinity_lib.chain_digest(
+            parent.chain if parent is not None else None, key)
+        self.hits = 0.0
+        self.hit_tick = 0
 
 
 class BlockTrie:
@@ -381,12 +393,20 @@ class BlockTrie:
     reclaimable); ``reclaimable`` is exact because eviction cascades
     over a popped node's whole idle subtree."""
 
+    # Hotness half-life in MATCH EVENTS (not wall time — deterministic
+    # and replay-safe): a chain unmatched for this many trie matches
+    # counts half its hits, so a historically hot tenant that left
+    # cannot squat the bounded summary() advert forever against live
+    # traffic.
+    HITS_HALF_LIFE = 512
+
     def __init__(self, block: int):
         self.block = block
         self.children: Dict[tuple, _TrieNode] = {}
         self.idle: 'collections.OrderedDict[_TrieNode, None]' = \
             collections.OrderedDict()
         self.referenced = 0  # nodes with refs > 0 (incl. detached)
+        self._match_tick = 0  # total match() calls; the decay clock
 
     @property
     def reclaimable(self) -> int:
@@ -408,6 +428,7 @@ class BlockTrie:
         tokens — the copy-on-write fork candidate."""
         limit = len(row) - 1 if limit is None else limit
         p = self.block
+        self._match_tick += 1
         nodes: List[_TrieNode] = []
         kids = self.children
         pos = 0
@@ -415,6 +436,9 @@ class BlockTrie:
             node = kids.get(tuple(row[pos:pos + p]))
             if node is None:
                 break
+            # Hotness for summary() truncation order: decay-then-bump.
+            node.hits = self._hotness(node) + 1.0
+            node.hit_tick = self._match_tick
             nodes.append(node)
             pos += p
             kids = node.children
@@ -473,6 +497,46 @@ class BlockTrie:
               key: tuple) -> Optional[_TrieNode]:
         kids = parent.children if parent is not None else self.children
         return kids.get(key)
+
+    def _hotness(self, node: _TrieNode) -> float:
+        """Match count decayed by match events since the node's last
+        hit (half-life ``HITS_HALF_LIFE``) — the advert ordering
+        signal. Event-based, so it is deterministic and idle trees do
+        not decay."""
+        if node.hits <= 0.0:
+            return 0.0
+        age = self._match_tick - node.hit_tick
+        return node.hits * 0.5 ** (age / self.HITS_HALF_LIFE)
+
+    def summary(self, max_entries: int = 64) -> dict:
+        """Compact resident-chain advert for fleet prefix-affinity
+        routing (utils/prefix_affinity.py): up to ``max_entries``
+        ``[chain_hex, depth]`` pairs plus pool-level counts, shipped in
+        the replica's /health body. HARD payload bound: entries are
+        truncated hottest-first (decayed match count — see
+        ``_hotness``), then deepest-first, then by chain digest — a
+        deterministic order, so two identically-warmed replicas
+        advertise identical summaries. Detached nodes are excluded
+        (they can never match again); hashes are pure functions of the
+        token chain, so a chain evicted and re-committed keeps its
+        hash. Called under the engine lock on every /health: bounded
+        heap selection (O(n log k)), and only the kept entries pay the
+        hex conversion."""
+        items = []  # (-hotness, -depth, chain_bytes)
+        total = 0
+        stack = [(node, 1) for node in self.children.values()]
+        while stack:
+            node, depth = stack.pop()
+            total += 1
+            if not node.detached:
+                items.append((-self._hotness(node), -depth, node.chain))
+            stack.extend((ch, depth + 1)
+                         for ch in node.children.values())
+        kept = heapq.nsmallest(max(int(max_entries), 0), items)
+        return {'v': affinity_lib.SUMMARY_VERSION, 'block': self.block,
+                'nodes': total, 'resident': self.blocks_held,
+                'truncated': len(items) > len(kept),
+                'entries': [[c.hex(), -d] for (_, d, c) in kept]}
 
     def evict(self, n: int) -> List[int]:
         """Reclaim >= n blocks from the idle LRU (may free more: a

@@ -12,10 +12,38 @@ running.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.serve.service_spec import ReplicaPolicy
+
+
+def _affinity_queue_allowance(active: Optional[bool]) -> float:
+    """Queue depth prefix-affinity routing DELIBERATELY parks on
+    matched replicas before spilling a hot prefix (the
+    PrefixAffinityPolicy detour budget, serve/load_balancing_policies).
+    That intended skew is not unmet demand: routing spills past the
+    budget before scaling should react, so the queue-pressure signal
+    feeding the scalers discounts it once — otherwise affinity and the
+    autoscaler (DualPoolAutoscaler included) fight, each tick adding a
+    replica that cannot absorb the hot prefix anyway because it holds
+    none of its blocks. 0 with affinity off (the default): the signal
+    is byte-identical to pre-affinity behavior.
+
+    ``active`` is the controller-resolved truth (``Autoscaler.
+    affinity_active``): the env flag alone is NOT enough, because an
+    explicitly configured non-affinity LB policy (round_robin,
+    instance_aware) never skews on purpose — discounting real demand
+    there would under-scale. None (no controller, e.g. direct unit
+    construction) falls back to the env flag."""
+    if active is None:
+        active = os.environ.get('SKYTPU_PREFIX_AFFINITY',
+                                '0') not in ('', '0', 'off')
+    if not active:
+        return 0.0
+    return max(float(os.environ.get(
+        'SKYTPU_PREFIX_AFFINITY_MAX_DETOUR', '4')), 0.0)
 
 
 @dataclasses.dataclass
@@ -39,6 +67,11 @@ class Autoscaler:
 
     def __init__(self, policy: ReplicaPolicy):
         self.policy = policy
+        # Set by the controller to whether the LB is ACTUALLY doing
+        # affinity routing (flag on AND an affinity-capable policy);
+        # None = unknown, derive from the env flag alone
+        # (_affinity_queue_allowance).
+        self.affinity_active: Optional[bool] = None
 
     def evaluate(self, num_ready: int, num_launching: int,
                  request_times: List[float],
@@ -95,7 +128,10 @@ class RequestRateAutoscaler(Autoscaler):
         target = getattr(self.policy, 'target_queue_per_replica', None)
         if not target or not queue_pressure or queue_pressure <= 0:
             return 0.0
-        return float(queue_pressure) / float(target)
+        pressure = max(
+            float(queue_pressure)
+            - _affinity_queue_allowance(self.affinity_active), 0.0)
+        return pressure / float(target)
 
     def _clamp(self, desired: int) -> int:
         desired = max(self.policy.min_replicas, desired)
@@ -412,7 +448,13 @@ class DualPoolAutoscaler(Autoscaler):
         reasons = []
 
         # -- prefill pool: queue depth + prefill-bubble rate -------------
-        queue_total = sum(self._queue_depth(r) for r in prefill)
+        # The affinity detour allowance is discounted from the pool
+        # total for the same reason _pressure_units discounts it: a
+        # hot prefix parked (on purpose) on its matched prefill
+        # replica must not read as pool-wide demand.
+        queue_total = max(
+            sum(self._queue_depth(r) for r in prefill)
+            - _affinity_queue_allowance(self.affinity_active), 0.0)
         per_replica = float(self.policy.target_queue_per_replica or 4.0)
         desired_p = (_ceil_units(queue_total, per_replica)
                      if queue_total > 0

@@ -45,6 +45,21 @@ def _queue_pressure(replica_snapshot) -> 'tuple':
     return total, by_endpoint
 
 
+def _prefix_summaries(replica_snapshot) -> 'dict':
+    """{endpoint: trie summary} from the replicas' probe-recorded
+    /health bodies (utils/prefix_affinity.py) — the affinity analog of
+    ``_queue_pressure``. Replicas without a summary (dense layout,
+    sharing off, pre-upgrade version) are simply absent: the policy
+    treats them as match-nothing, which is correct."""
+    out = {}
+    for rep in replica_snapshot:
+        health = serve_state.parse_health(rep.get('health')) or {}
+        summary = health.get('prefix_summary')
+        if rep.get('endpoint') and isinstance(summary, dict):
+            out[rep['endpoint']] = summary
+    return out
+
+
 class ServeController:
 
     def __init__(self, service_name: str, lb_port: int,
@@ -59,10 +74,37 @@ class ServeController:
         self.replica_manager = ReplicaManager(service_name, self.spec,
                                               self.task)
         self.autoscaler = make_autoscaler(self.spec.replica_policy)
+        self._sync_affinity_active()
         self._stop = threading.Event()
+
+    def _sync_affinity_active(self) -> None:
+        """Tell the autoscaler whether the LB is ACTUALLY affinity-
+        routing (flag on AND an affinity-capable policy) so its
+        detour-allowance discount never under-reads demand for an
+        explicitly configured non-affinity policy
+        (serve/autoscalers.py _affinity_queue_allowance)."""
+        self.autoscaler.affinity_active = (
+            self.lb.affinity_enabled
+            and hasattr(self.lb.policy, 'select_affinity'))
 
     def stop(self) -> None:
         self._stop.set()
+
+    def _mirror_affinity_gauges(self) -> None:
+        """Best-effort mirror of the LB's affinity counters into the
+        skytpu_lb_affinity_* gauges. Visible on the /metrics scrape
+        when the controller runs in-process with the API server; a
+        detached controller's counters stay readable via
+        ``LoadBalancer.affinity_snapshot()`` (probes) and the replica
+        /health fleet aggregation (docs/operations.md)."""
+        try:
+            from skypilot_tpu.server import metrics as metrics_lib
+        except Exception:  # noqa: BLE001 — metrics are additive
+            return
+        snap = self.lb.affinity_snapshot()
+        metrics_lib.set_lb_affinity(self.service_name,
+                                    routed=snap['routed'],
+                                    fallbacks=snap['fallbacks'])
 
     def _expose_external_endpoint(self) -> None:
         """When the controller cluster is pods (gke/kubernetes), the LB
@@ -124,12 +166,12 @@ class ServeController:
                                                      self.task)
                     # The new spec's policies take effect immediately: the
                     # autoscaler and LB policy are rebuilt, not just the
-                    # replica launches.
+                    # replica launches (through make_data_policy, so a
+                    # version bump keeps the affinity upgrade).
                     self.autoscaler = make_autoscaler(self.spec.replica_policy)
-                    from skypilot_tpu.serve.load_balancing_policies import \
-                        make_policy
-                    self.lb.policy = make_policy(
+                    self.lb.policy = self.lb.make_data_policy(
                         self.spec.load_balancing_policy)
+                    self._sync_affinity_active()
                 num_ready_now = len(self.lb.policy.replicas)
                 replica_snapshot = serve_state.list_replicas(
                     self.service_name)
@@ -140,6 +182,15 @@ class ServeController:
                     replica_snapshot)
                 if hasattr(self.lb.policy, 'set_queue_pressure'):
                     self.lb.policy.set_queue_pressure(pressure_by_ep)
+                if self.lb.affinity_enabled:
+                    # Prefix-affinity routing: push the replicas'
+                    # /health trie summaries into the LB policies the
+                    # same way queue pressure rides this tick, and
+                    # mirror the routing-outcome counters into the
+                    # skytpu_lb_affinity_* gauges.
+                    self.lb.set_prefix_summaries(
+                        _prefix_summaries(replica_snapshot))
+                    self._mirror_affinity_gauges()
                 decision = self.autoscaler.evaluate(
                     num_ready=num_ready_now,
                     num_launching=(self.replica_manager.num_alive()

@@ -486,6 +486,16 @@ class LlmServer:
                                'p99': nearest_rank(waits, 99)}
         if self.engine is not None:
             body['engine'] = self.engine.stats()
+            # Fleet prefix-affinity advert (utils/prefix_affinity.py):
+            # a bounded set of resident trie-chain hashes the
+            # controller pushes into the LB's affinity policy. Top
+            # level, not inside engine stats: the routing contract is
+            # the SUMMARY schema, and consumers (controller, dashboard)
+            # must not couple to the engine-stats shape to find it.
+            if hasattr(self.engine, 'prefix_summary'):
+                summary = self.engine.prefix_summary()
+                if summary is not None:
+                    body['prefix_summary'] = summary
         if self.draft_params is not None:
             s = dict(self._spec_stats)
             s['acceptance_rate'] = (

@@ -22,9 +22,10 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import json
+import os
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import aiohttp
 from aiohttp import web
@@ -32,6 +33,7 @@ from aiohttp import web
 from skypilot_tpu.observability import blackbox
 from skypilot_tpu.serve.load_balancing_policies import (LoadBalancingPolicy,
                                                         make_policy)
+from skypilot_tpu.utils import prefix_affinity
 
 _HANDOFF_TIMEOUT_S = 300.0
 
@@ -42,23 +44,47 @@ class _HandoffFailed(Exception):
 
 class LoadBalancer:
 
-    # Request-time buckets and the handoff counters cross threads: the
-    # LB's private event loop writes them while the controller thread
-    # (autoscaler drain, /health mirror) and probes read them.
+    # Request-time buckets, the handoff counters, the affinity counters
+    # and the per-replica summary cache cross threads: the LB's private
+    # event loop writes/reads them while the controller thread
+    # (autoscaler drain, summary push, gauge mirror) and probes do the
+    # other half.
     _GUARDED_BY = {'_times': '_times_lock',
-                   'disagg_stats': '_stats_lock'}
+                   'disagg_stats': '_stats_lock',
+                   'affinity_stats': '_stats_lock',
+                   '_replica_summaries': '_stats_lock'}
 
-    def __init__(self, port: int, policy: str = 'least_load'):
+    def __init__(self, port: int, policy: str = 'least_load',
+                 affinity: Optional[bool] = None):
         self.port = port
+        # Fleet prefix-affinity routing (utils/prefix_affinity.py):
+        # OFF by default; SKYTPU_PREFIX_AFFINITY=1 (or an explicit
+        # ctor override, for probes that A/B both modes in one
+        # process) upgrades the default least_load policy to its
+        # affinity-aware subclass. Explicitly-chosen non-default
+        # policies are respected as configured.
+        if affinity is None:
+            affinity = os.environ.get('SKYTPU_PREFIX_AFFINITY',
+                                      '0') not in ('', '0', 'off')
+        # An EXPLICITLY configured prefix_affinity policy is its own
+        # opt-in: without this, a service spec choosing it would run
+        # the affinity-capable policy with the data-plane hook, the
+        # controller's summary push, and the gauges all dark.
+        self.affinity_enabled = bool(affinity) or policy == 'prefix_affinity'
         self._policy_name = policy
-        self.policy: LoadBalancingPolicy = make_policy(policy)
+        self.policy: LoadBalancingPolicy = self.make_data_policy(policy)
         # Role pools (disaggregated serving): endpoint -> role from the
         # controller; the prefill/decode sub-policies select within
         # their pool with the same policy class (in-flight balancing
         # per pool).
         self.roles: Dict[str, str] = {}
-        self._prefill_policy: LoadBalancingPolicy = make_policy(policy)
-        self._decode_policy: LoadBalancingPolicy = make_policy(policy)
+        # Through make_data_policy, like the main pool: pool affinity
+        # (prefill tail-only prefill, decode reference-handoff skips)
+        # is inert if these stay plain least_load (review finding).
+        self._prefill_policy: LoadBalancingPolicy = \
+            self.make_data_policy(policy)
+        self._decode_policy: LoadBalancingPolicy = \
+            self.make_data_policy(policy)
         # Request times are bucketed PER UPSTREAM REPLICA (satellite
         # fix: one global list could not attribute latency/pressure to
         # a pool, which dual-pool autoscaling needs).
@@ -71,6 +97,17 @@ class LoadBalancer:
         self._stats_lock = threading.Lock()
         self.disagg_stats = {'handoffs': 0, 'fallbacks': 0,
                              'resumed_streams': 0}
+        # Affinity routing outcomes: routed = prompt head matched a
+        # replica's advertised chains and the pick honored it;
+        # fallbacks = a match existed but the matched replica sat past
+        # its detour credit (the saturation spill — skytpu_lb_
+        # affinity_fallback_total); misses = no resident match
+        # anywhere (cold prefix, not a fallback).
+        self.affinity_stats = {'routed': 0, 'fallbacks': 0,
+                               'misses': 0, 'matched_blocks': 0}
+        # Last controller-pushed per-replica /health trie summaries,
+        # kept for operator introspection (probes, affinity_snapshot).
+        self._replica_summaries: Dict[str, dict] = {}
         self._last_ready_set: set = set()
         self._runner: Optional[web.AppRunner] = None
         self._thread: Optional[threading.Thread] = None
@@ -112,6 +149,88 @@ class LoadBalancer:
     def disagg_active(self) -> bool:
         return bool(self._prefill_policy.replicas
                     and self._decode_policy.replicas)
+
+    # -- prefix-affinity routing (utils/prefix_affinity.py) ----------------
+
+    def make_data_policy(self, name: str) -> LoadBalancingPolicy:
+        """Policy construction honoring the affinity upgrade: with
+        affinity enabled the DEFAULT least_load becomes its
+        affinity-aware subclass (explicitly chosen non-default
+        policies are respected as configured). The controller's
+        rolling-update policy rebuild must use this too, or a version
+        bump would silently drop affinity."""
+        if self.affinity_enabled and name == 'least_load':
+            name = 'prefix_affinity'
+        return make_policy(name)
+
+    def set_prefix_summaries(self, summaries: Dict[str, dict]) -> None:
+        """Controller push of the replicas' /health trie summaries —
+        the same cadence and shape-tolerance as queue pressure. Parsed
+        ONCE here, then fanned out to every pool policy (the prefill
+        pool routes exports by the same affinity; the decode pool's
+        affinity maximizes the reference-handoff skip_blocks
+        negotiation)."""
+        with self._stats_lock:
+            self._replica_summaries = dict(summaries or {})
+        parsed = prefix_affinity.parse_summaries(summaries)
+        for pol in (self.policy, self._prefill_policy,
+                    self._decode_policy):
+            if hasattr(pol, 'set_parsed_summaries'):
+                pol.set_parsed_summaries(parsed)
+
+    def affinity_snapshot(self) -> Dict[str, object]:
+        """Routing-outcome counters + advert coverage, one consistent
+        read (controller gauge mirror, probes)."""
+        with self._stats_lock:
+            return {**self.affinity_stats,
+                    'summaries': len(self._replica_summaries)}
+
+    def _affinity_ready(self) -> bool:
+        return (self.affinity_enabled
+                and hasattr(self.policy, 'select_affinity'))
+
+    def _affinity_pick(self, body, policy=None, count: bool = True,
+                       defer_routed: bool = False
+                       ) -> Tuple[Optional[str], int]:
+        """Affinity-weighted replica selection for one parsed /generate
+        body (first row of a batch keys the routing — affinity is a
+        hint, any row serves correctly anywhere). Returns
+        (endpoint|None, matched_blocks); None = fall back to the
+        policy's plain select(). ``count=False`` skips the outcome
+        counters: affinity_stats is per-REQUEST (the documented gauge
+        semantics), so a disagg request counting both of its pool
+        picks would double-book. ``defer_routed`` books miss/fallback
+        outcomes (final at pick time) but leaves the ROUTED outcome to
+        the caller — the disagg path books it only once the handoff
+        actually serves through the matched replica, so a handoff
+        failure that falls back to colocated never over-reports
+        affinity coverage."""
+        policy = policy if policy is not None else self.policy
+        if not self.affinity_enabled \
+                or not hasattr(policy, 'select_affinity'):
+            return None, 0
+        tokens = body.get('tokens') if isinstance(body, dict) else None
+        if isinstance(tokens, list) and tokens \
+                and isinstance(tokens[0], list):
+            tokens = tokens[0]
+        if not isinstance(tokens, list) or not tokens:
+            return None, 0
+        try:
+            row = [int(t) for t in tokens]
+        except (TypeError, ValueError):
+            return None, 0
+        pick, matched = policy.select_affinity(row)
+        if count:
+            with self._stats_lock:
+                if pick is not None:
+                    if not defer_routed:
+                        self.affinity_stats['routed'] += 1
+                        self.affinity_stats['matched_blocks'] += matched
+                elif matched > 0:
+                    self.affinity_stats['fallbacks'] += 1
+                else:
+                    self.affinity_stats['misses'] += 1
+        return pick, matched
 
     def _note_request(self, replica: str) -> None:
         with self._times_lock:
@@ -166,22 +285,32 @@ class LoadBalancer:
             return web.json_response(
                 {'error': 'debug endpoints are not proxied; query the '
                           'replica directly'}, status=403)
+        replica = None
         if (request.method == 'POST' and request.path == '/generate'
-                and self.disagg_active()):
+                and (self.disagg_active() or self._affinity_ready())):
             body = None
             try:
                 body = json.loads(await request.read())
             except ValueError:
                 pass
-            if self._disagg_eligible(body):
-                return await self._proxy_disagg(request, body)
-            if body is not None:
-                # Ineligible for handoff (batched rows, seeded): serve
-                # colocated without counting a fallback — nothing
-                # failed.
-                return await self._serve_colocated(
-                    request, body, fallback=False)
-        replica = self.policy.select()
+            if self.disagg_active():
+                if self._disagg_eligible(body):
+                    return await self._proxy_disagg(request, body)
+                if body is not None:
+                    # Ineligible for handoff (batched rows, seeded):
+                    # serve colocated without counting a fallback —
+                    # nothing failed.
+                    return await self._serve_colocated(
+                        request, body, fallback=False)
+            elif body is not None:
+                # Prefix-affinity routing (colocated fleet): prefer
+                # the replica already holding this prompt's head
+                # chains; a miss or a saturated match falls through to
+                # the plain policy pick below. (request.read() caches,
+                # so the generic forward re-reads the same bytes.)
+                replica, _ = self._affinity_pick(body)
+        if replica is None:
+            replica = self.policy.select()
         if replica is None:
             return web.json_response(
                 {'error': 'No ready replicas.'}, status=503)
@@ -240,8 +369,25 @@ class LoadBalancer:
     async def _proxy_disagg(self, request: web.Request,
                             body: dict) -> web.StreamResponse:
         stream = bool(body.get('stream'))
-        prefill = self._prefill_policy.select()
-        decode = self._decode_policy.select()
+        # Prefix affinity applies to BOTH pools: a prefill replica that
+        # already holds the head chains prefills only the unshared
+        # tail, and a decode replica that holds them turns the
+        # transfer into trie REFERENCES (the /v1/kv/prepare
+        # skip_blocks negotiation below finds the resident chains this
+        # routing just steered the request toward).
+        # The DECODE pick carries the request's affinity_stats entry
+        # (it is the replica that serves the stream); the prefill pick
+        # is uncounted so one request books one outcome, and the
+        # ROUTED outcome is deferred to handoff success below.
+        prefill, _ = self._affinity_pick(body, self._prefill_policy,
+                                         count=False)
+        if prefill is None:
+            prefill = self._prefill_policy.select()
+        decode, aff_matched = self._affinity_pick(
+            body, self._decode_policy, defer_routed=True)
+        aff_routed = aff_matched if decode is not None else 0
+        if decode is None:
+            decode = self._decode_policy.select()
         if prefill is None or decode is None:
             return await self._serve_colocated(request, body)
         headers = self._fwd_headers(request)
@@ -274,6 +420,10 @@ class LoadBalancer:
                                     f'{payload[:200]!r}')
                         with self._stats_lock:
                             self.disagg_stats['handoffs'] += 1
+                            if aff_routed:
+                                self.affinity_stats['routed'] += 1
+                                self.affinity_stats['matched_blocks'] \
+                                    += aff_routed
                         blackbox.record('lb.handoff', mode=mode,
                                         decode=decode, streamed=False)
                         return web.Response(
@@ -291,7 +441,7 @@ class LoadBalancer:
                 return await self._pipe_stream(request, session, url,
                                                import_kwargs, decode,
                                                mode, body, headers,
-                                               timeout)
+                                               timeout, aff_routed)
         finally:
             if prefill_busy:
                 self._prefill_policy.on_request_end(prefill)
@@ -354,7 +504,8 @@ class LoadBalancer:
 
     async def _pipe_stream(self, request, session, url, import_kwargs,
                            decode: str, mode: str, body: dict, headers,
-                           timeout) -> web.StreamResponse:
+                           timeout,
+                           aff_routed: int = 0) -> web.StreamResponse:
         """Pipe the decode replica's NDJSON stream to the client,
         counting forwarded tokens; if the replica dies mid-stream,
         RESUME the request on a surviving replica — greedy decode is
@@ -385,6 +536,10 @@ class LoadBalancer:
                     if obj.get('done'):
                         with self._stats_lock:
                             self.disagg_stats['handoffs'] += 1
+                            if aff_routed:
+                                self.affinity_stats['routed'] += 1
+                                self.affinity_stats['matched_blocks'] \
+                                    += aff_routed
                         blackbox.record('lb.handoff', mode=mode,
                                         decode=decode, streamed=True)
                         await resp.write_eof()

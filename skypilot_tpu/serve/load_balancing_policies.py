@@ -3,8 +3,11 @@
 ``LeastLoadPolicy`` (default) ``:111``)."""
 from __future__ import annotations
 
+import os
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu.utils import prefix_affinity
 
 
 class LoadBalancingPolicy:
@@ -96,7 +99,8 @@ class LeastLoadPolicy(LoadBalancingPolicy):
             self._pressure = {k: max(float(v), 0.0)
                               for k, v in pressure.items()}
 
-    # skylint: locked(called only from select, under `with self._lock`)
+    # skylint: locked(called only under `with self._lock` — select,
+    # select_affinity, loads_snapshot)
     def _load(self, r: str) -> float:
         return self._inflight.get(r, 0) + self._pressure.get(r, 0.0)
 
@@ -117,6 +121,108 @@ class LeastLoadPolicy(LoadBalancingPolicy):
         with self._lock:
             self._inflight[replica] = max(
                 0, self._inflight.get(replica, 0) - 1)
+
+
+class PrefixAffinityPolicy(LeastLoadPolicy):
+    """Least-load routing with a bounded prefix-affinity preference:
+    requests whose prompt head matches a replica's advertised resident
+    trie chains (``BlockTrie.summary`` via /health, pushed by the
+    controller like queue pressure) route to that replica — as long as
+    it is not meaningfully busier than the least-loaded one.
+
+    Semantics (tiebreak-with-weight, never a correctness dependency):
+    a matched replica earns a load CREDIT of ``weight x matched-chain
+    depth`` (in load units: in-flight requests + queue pressure),
+    capped at the detour budget. It wins the request only while its
+    load exceeds the fleet minimum by at most that credit; past the
+    budget the pick falls back to plain least-load, so a hot prefix
+    can never overload one box — the spill point is the SAME detour
+    constant the autoscalers discount from the queue signal
+    (serve/autoscalers.py), so routing spills before scaling reacts.
+    ``select()`` is untouched LeastLoadPolicy: with affinity disabled
+    (SKYTPU_PREFIX_AFFINITY=0, the default) routing is byte-identical
+    to least_load."""
+
+    _GUARDED_BY = {'replicas': '_lock', '_inflight': '_lock',
+                   '_pressure': '_lock', '_rotation': '_lock',
+                   '_summaries': '_lock'}
+
+    def __init__(self):
+        super().__init__()
+        # endpoint -> parsed summary (prefix_affinity.parse_summary).
+        self._summaries: Dict[str, dict] = {}
+        # Knobs read once at construction (routing must not pay a
+        # getenv per request); the controller rebuilds the policy on
+        # spec updates, which re-reads them.
+        self._weight = float(
+            os.environ.get('SKYTPU_PREFIX_AFFINITY_WEIGHT', '1'))
+        self._max_detour = max(float(
+            os.environ.get('SKYTPU_PREFIX_AFFINITY_MAX_DETOUR', '4')),
+            0.0)
+        self._max_blocks = max(int(
+            os.environ.get('SKYTPU_PREFIX_AFFINITY_MAX_BLOCKS', '32')),
+            1)
+
+    def set_prefix_summaries(self, summaries: Dict[str, dict]) -> None:
+        """Replace the per-endpoint resident-chain adverts (controller
+        push, every probe tick — mirrors ``set_queue_pressure``).
+        Malformed or version-skewed summaries are dropped per endpoint,
+        never raised: routing is best-effort by contract."""
+        self.set_parsed_summaries(
+            prefix_affinity.parse_summaries(summaries))
+
+    def set_parsed_summaries(self, parsed: Dict[str, dict]) -> None:
+        """Pre-validated variant: the LB parses one push once and fans
+        it out to the main/prefill/decode policies instead of each
+        re-parsing identical adverts under its own lock."""
+        with self._lock:
+            self._summaries = dict(parsed)
+
+    def loads_snapshot(self) -> Dict[str, float]:
+        """Current per-replica load (in-flight + pressure) — probe/test
+        introspection for the saturation-spill guarantee."""
+        with self._lock:
+            return {r: self._load(r) for r in self.replicas}
+
+    def select_affinity(self, tokens: List[int]
+                        ) -> Tuple[Optional[str], int]:
+        """(endpoint, matched_blocks) for an affinity-routed pick, or
+        (None, best_matched_blocks): None with a nonzero depth means a
+        replica matched but sat past its detour credit (saturation
+        fallback); (None, 0) means no resident match anywhere. The
+        caller falls back to ``select()`` on None."""
+        with self._lock:
+            if not self.replicas:
+                return None, 0
+            loads = {r: self._load(r) for r in self.replicas}
+            low = min(loads.values())
+            hashes_by_block: Dict[int, List[str]] = {}
+            best = None          # (depth, -load, resident, endpoint)
+            best_depth = 0       # deepest match seen, routed or not
+            for r in sorted(self.replicas):
+                info = self._summaries.get(r)
+                if info is None:
+                    continue
+                block = info['block']
+                hashes = hashes_by_block.get(block)
+                if hashes is None:
+                    hashes = hashes_by_block[block] = \
+                        prefix_affinity.chain_hashes(
+                            tokens, block, self._max_blocks)
+                depth = prefix_affinity.match_depth(hashes,
+                                                    info['hashes'])
+                if depth <= 0:
+                    continue
+                best_depth = max(best_depth, depth)
+                credit = min(self._weight * depth, self._max_detour)
+                if loads[r] - low > credit:
+                    continue  # saturated: the hot box must spill
+                key = (depth, -loads[r], info['resident'], r)
+                if best is None or key > best:
+                    best = key
+            if best is None:
+                return None, best_depth
+            return best[3], best[0]
 
 
 class InstanceAwareLeastLoadPolicy(LeastLoadPolicy):
@@ -153,6 +259,7 @@ class InstanceAwareLeastLoadPolicy(LeastLoadPolicy):
 POLICIES = {
     'round_robin': RoundRobinPolicy,
     'least_load': LeastLoadPolicy,
+    'prefix_affinity': PrefixAffinityPolicy,
     'instance_aware_least_load': InstanceAwareLeastLoadPolicy,
 }
 

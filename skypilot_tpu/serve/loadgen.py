@@ -69,6 +69,90 @@ def shared_prefix_tokens(tenant_idx: int, length: int,
     return [rng.randrange(1, vocab) for _ in range(length)]
 
 
+def aggregate_prefix_healths(bodies: dict) -> dict:
+    """FLEET-wide prefix-share stats from per-replica /health bodies
+    ({endpoint: body}): counters are SUMMED before dividing — the
+    per-replica hit rates the report also carries overstate the fleet
+    number once the LB spreads a tenant's traffic across replicas
+    (each replica re-misses the same prefix). Pure so the aggregation
+    is unit-testable without HTTP."""
+    per = {}
+    hits = misses = saved = computed = 0.0
+    for ep, body in sorted((bodies or {}).items()):
+        eng = (body or {}).get('engine') or {}
+        share = eng.get('prefix_share')
+        if not isinstance(share, dict) \
+                or not isinstance(share.get('hits'), (int, float)):
+            continue
+        h = float(share['hits'])
+        m = float(share.get('misses') or 0)
+        hits += h
+        misses += m
+        saved += float(eng.get('prefill_tokens_saved') or 0)
+        computed += float(eng.get('prefill_tokens') or 0)
+        per[ep] = {'hits': int(h), 'misses': int(m),
+                   'hit_rate': round(h / max(h + m, 1), 4),
+                   'prefill_tokens': int(float(
+                       eng.get('prefill_tokens') or 0)),
+                   'prefill_tokens_saved': int(float(
+                       eng.get('prefill_tokens_saved') or 0))}
+    return {'replicas': len(per), 'hits': int(hits),
+            'misses': int(misses),
+            'hit_rate': round(hits / max(hits + misses, 1), 4),
+            'prefill_tokens': int(computed),
+            'prefill_tokens_saved': int(saved),
+            'per_replica': per}
+
+
+def fleet_window_delta(before: dict, after: dict) -> dict:
+    """This run's fleet counter deltas from two ``fleet_prefix_stats``
+    snapshots. Per-replica, over the INTERSECTION of replicas that
+    answered both scrapes (one present in only one — health timeout —
+    would inject its whole lifetime counters), with each delta clamped
+    at >= 0 (a replica that RESTARTED between scrapes answers both
+    with reset counters; its backwards delta must not drag the window
+    negative). Pure so the A/B gate's input is unit-testable."""
+    both = set(before['per_replica']) & set(after['per_replica'])
+    dh = dm = dt = ds = 0
+    for ep in both:
+        b = before['per_replica'][ep]
+        a = after['per_replica'][ep]
+        dh += max(a['hits'] - b['hits'], 0)
+        dm += max(a['misses'] - b['misses'], 0)
+        dt += max(a['prefill_tokens'] - b['prefill_tokens'], 0)
+        ds += max(a['prefill_tokens_saved']
+                  - b['prefill_tokens_saved'], 0)
+    return {'replicas': len(both), 'hits': dh, 'misses': dm,
+            'hit_rate': round(dh / max(dh + dm, 1), 4),
+            'prefill_tokens': dt, 'prefill_tokens_saved': ds}
+
+
+async def fleet_prefix_stats(session, endpoints) -> dict:
+    """Fetch /health from every replica endpoint (concurrently — one
+    dead replica's timeout must not serialize into N x 15 s around the
+    measured window) and aggregate the prefix-share counters
+    fleet-wide. Best-effort per endpoint: a dead replica drops out of
+    the denominator rather than failing the report."""
+    import aiohttp
+
+    async def fetch(ep):
+        base = ep if ep.startswith('http') else f'http://{ep}'
+        try:
+            async with session.get(
+                    f'{base}/health',
+                    timeout=aiohttp.ClientTimeout(total=15)) as r:
+                if r.status == 200:
+                    return ep, json.loads(await r.text())
+        except Exception:  # noqa: BLE001 — see docstring
+            pass
+        return ep, None
+
+    results = await asyncio.gather(*(fetch(ep)
+                                     for ep in endpoints or []))
+    return aggregate_prefix_healths(
+        {ep: body for ep, body in results if body is not None})
+
+
 async def _one(session, url: str, prompt_span, max_new_span,
                vocab: int, seed: int, stream: bool = False,
                priority=None, tenant=None, prefix_tokens=None,
@@ -223,7 +307,19 @@ async def run_load(url: str, requests_total: int, concurrency: int,
                    long_prompt_len: int = 512,
                    dump_on_error: str = '',
                    dump_endpoints=None,
-                   alerts_url: str = '') -> dict:
+                   alerts_url: str = '',
+                   fleet_endpoints=None,
+                   seed_base: int = 0,
+                   tenant_offset: int = 0) -> dict:
+    """``fleet_endpoints``: replica endpoints to scrape /health from
+    before and after the run; with a shared-prefix mix the report then
+    carries the FLEET-wide hit rate over this run's window next to the
+    per-replica numbers (the quantity prefix-affinity routing moves —
+    per-replica rates look fine even while the LB slices the fleet
+    rate by replica count). ``seed_base``/``tenant_offset`` shift the
+    deterministic prompt tails and tenant heads so back-to-back A/B
+    legs against the same warm replicas cannot poach each other's
+    committed chains."""
     import aiohttp
     prompt_span, max_new_span = _span(prompt_len), _span(max_new)
     sem = asyncio.Semaphore(concurrency)
@@ -242,7 +338,8 @@ async def run_load(url: str, requests_total: int, concurrency: int,
             f'shared:{shared_prefix},unique:{1.0 - shared_prefix}',
             requests_total)
         shared_flags = [p == 'shared' for p in picks]
-        prefixes = [shared_prefix_tokens(t, shared_prefix_len, vocab)
+        prefixes = [shared_prefix_tokens(tenant_offset + t,
+                                         shared_prefix_len, vocab)
                     for t in range(max(tenants, 1))]
     # --long-prompt-frac FRAC: that fraction of requests (deterministic
     # weighted round-robin) carries a LONG prompt of --long-prompt-len
@@ -267,14 +364,16 @@ async def run_load(url: str, requests_total: int, concurrency: int,
         async def _bounded(i):
             async with sem:
                 cls = classes[i] if classes else None
-                tenant = f't{i % tenants}' if tenants > 1 else None
+                tenant = (f't{tenant_offset + i % tenants}'
+                          if tenants > 1 else None)
                 prefix = None
                 if shared_flags is not None and shared_flags[i]:
                     prefix = prefixes[i % max(tenants, 1)]
                 is_long = bool(long_flags and long_flags[i])
                 r = await _one(
                     session, url, prompt_span, max_new_span, vocab,
-                    seed=i, stream=stream, priority=cls, tenant=tenant,
+                    seed=seed_base + i, stream=stream, priority=cls,
+                    tenant=tenant,
                     prefix_tokens=prefix,
                     force_prompt_len=(long_prompt_len if is_long
                                       else None))
@@ -282,11 +381,20 @@ async def run_load(url: str, requests_total: int, concurrency: int,
                 shared_of.append((prefix is not None, r))
                 long_of.append((is_long, r))
 
+        fleet_before = None
+        if fleet_endpoints and shared_flags is not None:
+            fleet_before = await fleet_prefix_stats(session,
+                                                    fleet_endpoints)
         wall_t0 = time.time()
         t0 = time.perf_counter()
         await asyncio.gather(*(_bounded(i) for i in range(requests_total)))
         wall = time.perf_counter() - t0
         wall_t1 = time.time()
+
+        fleet_after = None
+        if fleet_endpoints and shared_flags is not None:
+            fleet_after = await fleet_prefix_stats(session,
+                                                   fleet_endpoints)
 
         engine_share = None
         if shared_flags is not None:
@@ -334,6 +442,7 @@ async def run_load(url: str, requests_total: int, concurrency: int,
             'stream': True,
             'p50_ttft_s': _pctile(ttfts, 50),
             'p95_ttft_s': _pctile(ttfts, 95),
+            'p99_ttft_s': _pctile(ttfts, 99),
         }
     if shared_flags is not None:
         # Per-mix breakdown: the TTFT gap between the shared and unique
@@ -363,6 +472,17 @@ async def run_load(url: str, requests_total: int, concurrency: int,
             'unique': _grp(False),
             'engine': engine_share,
         }
+        if fleet_after is not None:
+            # Fleet-wide hit rate next to the per-replica numbers:
+            # 'window' is THIS run's counter deltas (what an A/B gate
+            # compares); 'lifetime' is the replicas' cumulative view.
+            fleet = {'replicas': fleet_after['replicas'],
+                     'lifetime_hit_rate': fleet_after['hit_rate'],
+                     'per_replica': fleet_after['per_replica']}
+            if fleet_before is not None:
+                fleet['window'] = fleet_window_delta(fleet_before,
+                                                     fleet_after)
+            extra['shared_prefix']['fleet'] = fleet
     if long_flags is not None:
         # Per-pool TTFT breakdown: long requests land prefill-bound (the
         # prefill pool's work), short ones are decode-interactive — the
@@ -437,6 +557,9 @@ async def run_load(url: str, requests_total: int, concurrency: int,
         'requests_per_sec': round(len(oks) / wall, 2) if wall else 0,
         'p50_latency_s': _pctile(lats, 50),
         'p95_latency_s': _pctile(lats, 95),
+        # p99: the tail the prefix-affinity gate holds constant while
+        # it moves the fleet hit rate (tools/perf_probe.py --affinity).
+        'p99_latency_s': _pctile(lats, 99),
     }
 
 
@@ -506,7 +629,11 @@ def main() -> None:
                              '(host:port) to dump bundles from; default '
                              'is the --url target itself (the LB does '
                              'not proxy /debug/*, so list replicas '
-                             'explicitly when driving an LB)')
+                             'explicitly when driving an LB). With '
+                             '--shared-prefix these endpoints are also '
+                             'health-scraped before/after the run to '
+                             'report the FLEET-wide prefix hit rate '
+                             'next to the per-replica numbers')
     parser.add_argument('--alerts-url', default='',
                         help='API server base URL; at end of run fetch '
                              '/api/v1/alerts and record the SLO rules '
@@ -529,7 +656,11 @@ def main() -> None:
                                long_prompt_len=args.long_prompt_len,
                                dump_on_error=args.dump_on_error,
                                dump_endpoints=dump_eps,
-                               alerts_url=args.alerts_url))
+                               alerts_url=args.alerts_url,
+                               # Shared-prefix runs aggregate the
+                               # FLEET hit rate over the same replica
+                               # endpoints bundles dump from.
+                               fleet_endpoints=dump_eps))
     print(json.dumps(out))
 
 

@@ -884,6 +884,14 @@ function healthCell(h){
       if(px.cow_forks) t += ` f${px.cow_forks}`;
       parts.push(t);
     }
+    // Prefix-affinity advert (fleet routing): how much of the trie
+    // this replica exposes to the LB, e.g. "aff 12/30" = 12 chain
+    // entries advertised of 30 resident nodes ("+" = truncated at
+    // SKYTPU_PREFIX_SUMMARY_MAX).
+    const ps = h.prefix_summary;
+    if(ps && ps.entries && ps.entries.length)
+      parts.push(`aff ${ps.entries.length}/${ps.nodes??'?'}${
+        ps.truncated ? '+' : ''}`);
     // Decode-dispatch pipeline: depth + how much host bookkeeping the
     // in-flight chunk hid (cumulative), e.g. "pipe d1 ovl 1.2s".
     const pl = e.pipeline;

@@ -268,6 +268,47 @@ _SERVE_QOS_WAIT_P95 = Gauge(
     'Replica p95 queue wait (ms, recent window) by priority class.',
     ['service', 'replica', 'qos_class'], registry=REGISTRY)
 
+# Fleet-wide prefix-affinity routing (utils/prefix_affinity.py). The
+# hit rate is recomputed at scrape time from the replicas' probe-
+# recorded /health bodies (like the QoS gauges above); the LB routing
+# counters are pushed by the serve controller each tick
+# (ServeController._mirror_affinity_gauges) — gauges mirroring the
+# LB's cumulative counters, so a controller restart legitimately
+# resets them.
+_LB_AFFINITY_ROUTED = Gauge(
+    'skytpu_lb_affinity_routed_total',
+    'Cumulative /generate requests the LB routed to the replica whose '
+    'advertised trie summary matched the prompt head, by service.',
+    ['service'], registry=REGISTRY)
+_LB_AFFINITY_FALLBACK = Gauge(
+    'skytpu_lb_affinity_fallback_total',
+    'Cumulative affinity-eligible requests that matched a replica but '
+    'fell back to least-load because the match sat past its detour '
+    'credit (the hot-prefix saturation spill), by service.',
+    ['service'], registry=REGISTRY)
+_FLEET_PREFIX_HIT_RATE = Gauge(
+    'skytpu_fleet_prefix_hit_rate',
+    'Fleet-wide block-share prefix hit rate: sum(hits) / sum(hits + '
+    'misses) aggregated across all of a service\'s replica /health '
+    'bodies — the number per-replica hit rates overstate once the LB '
+    'spreads a tenant\'s traffic.', ['service'], registry=REGISTRY)
+
+
+# Last pushed values per service: the scrape-time refresh rebuilds the
+# gauges from this cache for LIVE services only, so a torn-down
+# service's series vanish instead of exporting its final counts
+# forever (every other serve gauge is clear-and-rebuilt the same way).
+_LB_AFFINITY_LAST: Dict[str, Any] = {}
+
+
+def set_lb_affinity(service: str, routed: float,
+                    fallbacks: float) -> None:
+    """Controller-pushed mirror of the LB's affinity routing counters
+    (LoadBalancer.affinity_snapshot)."""
+    _LB_AFFINITY_LAST[service] = (float(routed), float(fallbacks))
+    _LB_AFFINITY_ROUTED.labels(service=service).set(routed)
+    _LB_AFFINITY_FALLBACK.labels(service=service).set(fallbacks)
+
 
 def _refresh_goodput_gauges(clusters, jobs) -> None:
     """Goodput/phase gauges from the ledger (one grouped query) and
@@ -332,12 +373,12 @@ def _refresh_gauges() -> None:
 
     clusters = global_user_state.get_clusters()
     jobs = jobs_state.list_jobs()
+    services = [s for s in serve_state.list_services() if s is not None]
     _refresh_goodput_gauges(clusters, jobs)
     for gauge, counts in (
         (_CLUSTERS, C(r['status'].value for r in clusters)),
         (_MANAGED_JOBS, C(r['status'].value for r in jobs)),
-        (_SERVICES, C(s['status'].value for s in serve_state.list_services()
-                      if s is not None)),
+        (_SERVICES, C(s['status'].value for s in services)),
         (_API_REQUESTS, C(r['status'] for r in requests_db.list_requests())),
     ):
         gauge.clear()
@@ -345,13 +386,34 @@ def _refresh_gauges() -> None:
             gauge.labels(status=status).set(n)
 
     for gauge in (_SERVE_QOS_DEPTH, _SERVE_QOS_SHED, _SERVE_QOS_EVICTED,
-                  _SERVE_QOS_WAIT_P95):
+                  _SERVE_QOS_WAIT_P95, _FLEET_PREFIX_HIT_RATE,
+                  _LB_AFFINITY_ROUTED, _LB_AFFINITY_FALLBACK):
         gauge.clear()
-    for svc in serve_state.list_services():
-        if svc is None:
-            continue
+    live_services = {s['name'] for s in services
+                     if s['status'].value not in ('SHUTDOWN', 'FAILED')}
+    for name in list(_LB_AFFINITY_LAST):
+        if name not in live_services:
+            del _LB_AFFINITY_LAST[name]
+        else:
+            routed, fallbacks = _LB_AFFINITY_LAST[name]
+            _LB_AFFINITY_ROUTED.labels(service=name).set(routed)
+            _LB_AFFINITY_FALLBACK.labels(service=name).set(fallbacks)
+    for svc in services:
+        # Fleet prefix hit rate: aggregate the replicas' block-share
+        # counters BEFORE dividing — averaging per-replica rates would
+        # weight an idle replica's stale 100% the same as the replica
+        # actually serving the tenant.
+        fleet_hits = fleet_misses = 0.0
+        fleet_reported = False
         for rep in serve_state.list_replicas(svc['name']):
             health = serve_state.parse_health(rep.get('health')) or {}
+            share = (health.get('engine') or {}).get('prefix_share') \
+                if isinstance(health.get('engine'), dict) else None
+            if isinstance(share, dict) and isinstance(
+                    share.get('hits'), (int, float)):
+                fleet_reported = True
+                fleet_hits += float(share['hits'])
+                fleet_misses += float(share.get('misses') or 0)
             qos = health.get('qos')
             if not isinstance(qos, dict):
                 continue
@@ -370,6 +432,9 @@ def _refresh_gauges() -> None:
                 if isinstance(p95, (int, float)):
                     _SERVE_QOS_WAIT_P95.labels(qos_class=cls,
                                                **labels).set(p95)
+        if fleet_reported:
+            _FLEET_PREFIX_HIT_RATE.labels(service=svc['name']).set(
+                fleet_hits / max(fleet_hits + fleet_misses, 1.0))
 
 
 def render() -> bytes:

@@ -1,0 +1,125 @@
+"""Prefix-chain hashing for fleet-wide prefix-affinity routing.
+
+The paged engine's ``BlockTrie`` (``models/paged.py``) indexes committed
+full KV blocks by their token-block CHAINS. At fleet scale that cache is
+per-replica, and a load balancer that spreads a tenant's traffic slices
+the effective hit rate by replica count. The fix is routing-by-prefix:
+replicas advertise a bounded summary of their resident chains through
+``/health`` and the LB routes each eligible ``/generate`` request toward
+the replica that already holds its prompt head.
+
+The summary cannot carry token tuples (a 64-chain summary of 16-token
+blocks would be kilobytes of token ids, and a tenant's system prompt
+must not leak through a health endpoint), so chains travel as HASHES:
+``digest(chain) = blake2b8(digest(parent_chain) || block_tokens)``,
+computed identically by the trie at commit time and by the LB over an
+incoming prompt's head blocks. A hash match at index ``d`` IS a depth-d
+chain match (collisions only ever mis-route a request to a replica that
+serves it correctly anyway — affinity is strictly a routing hint, never
+a correctness dependency).
+
+This module is IMPORT-LIGHT ON PURPOSE (stdlib only): the load balancer
+and controller consume it without paying for jax, and ``models/paged.py``
+imports it for the trie-side half so the two ends of the wire share one
+definition.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional, Sequence, Union
+
+# Bump when the digest recipe or summary schema changes: a summary with
+# an unknown version is ignored by the LB (mixed-version fleets during a
+# rolling update must not mis-match hashes computed two different ways).
+SUMMARY_VERSION = 1
+
+_DIGEST_SIZE = 8  # 16 hex chars per chain on the wire
+
+
+def chain_digest(parent: Optional[bytes],
+                 block_tokens: Sequence[int]) -> bytes:
+    """Digest of one more block appended to a parent chain. ``parent``
+    is the parent chain's digest (None at the root)."""
+    h = hashlib.blake2b(digest_size=_DIGEST_SIZE)
+    if parent:
+        h.update(parent)
+    for t in block_tokens:
+        h.update(int(t).to_bytes(8, 'little', signed=True))
+    return h.digest()
+
+
+def chain_hashes(tokens: Sequence[int], block: int,
+                 max_chains: int) -> List[str]:
+    """Hex digests of the prompt's leading full-block chains:
+    ``out[d-1]`` covers ``tokens[:d*block]`` — the same granularity the
+    trie commits at, so a summary-hash match at index ``d-1`` means the
+    replica holds that depth-d chain resident."""
+    if block <= 0:
+        return []
+    out: List[str] = []
+    digest: Optional[bytes] = None
+    n_full = min(len(tokens) // block, max(int(max_chains), 0))
+    for d in range(n_full):
+        digest = chain_digest(digest, tokens[d * block:(d + 1) * block])
+        out.append(digest.hex())
+    return out
+
+
+def match_depth(prompt_hashes: Sequence[str],
+                resident: Union[Dict[str, int], set, frozenset]) -> int:
+    """Deepest chain of the prompt resident on a replica: the largest
+    ``d`` with ``prompt_hashes[d-1]`` in the advertised set (0 = no
+    match)."""
+    for d in range(len(prompt_hashes), 0, -1):
+        if prompt_hashes[d - 1] in resident:
+            return d
+    return 0
+
+
+def parse_summary(summary) -> Optional[Dict[str, object]]:
+    """Validate one replica's advertised summary into
+    ``{'block': int, 'hashes': frozenset, 'resident': int}``; None
+    when absent, malformed, or a different SUMMARY_VERSION (see the
+    module docstring on rolling updates). ``hashes`` is a SET: depth
+    is already encoded in the chained digest (a hash at prompt index d
+    IS a depth-d match), so matching is pure membership — the entry
+    depths exist for operators reading the raw advert, not for the
+    matcher."""
+    if not isinstance(summary, dict):
+        return None
+    if summary.get('v') != SUMMARY_VERSION:
+        return None
+    try:
+        block = int(summary.get('block') or 0)
+    except (TypeError, ValueError):
+        return None
+    if block <= 0:
+        return None
+    hashes = set()
+    for entry in summary.get('entries') or []:
+        try:
+            h, d = entry[0], int(entry[1])
+        except (TypeError, ValueError, IndexError, KeyError):
+            continue
+        if isinstance(h, str) and h and d > 0:
+            hashes.add(h)
+    if not hashes:
+        return None
+    try:
+        resident = int(summary.get('resident') or 0)
+    except (TypeError, ValueError):
+        resident = 0
+    return {'block': block, 'hashes': frozenset(hashes),
+            'resident': resident}
+
+
+def parse_summaries(summaries) -> Dict[str, Dict[str, object]]:
+    """``parse_summary`` over an {endpoint: summary} push, dropping
+    invalid entries per endpoint — parsed ONCE by the LB and fanned
+    out to every pool policy."""
+    parsed = {}
+    for ep, summary in (summaries or {}).items():
+        info = parse_summary(summary)
+        if info is not None:
+            parsed[ep] = info
+    return parsed

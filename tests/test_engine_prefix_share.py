@@ -383,6 +383,39 @@ def test_loadgen_shared_prefix_heads_deterministic():
     assert len(p0) == 24 and all(1 <= t < 256 for t in p0)
 
 
+def test_engine_prefix_summary_advertises_resident_chains(tiny,
+                                                          monkeypatch):
+    """The engine's /health advert (ISSUE 12): after shared-head
+    traffic, prefix_summary() exposes chains an LB-side hash of the
+    same prompt matches; SKYTPU_PREFIX_SUMMARY_MAX is a hard entry
+    bound; a share-off engine adverts nothing."""
+    from skypilot_tpu.utils import prefix_affinity
+    cfg, params = tiny
+    monkeypatch.setenv('SKYTPU_PREFIX_SUMMARY_MAX', '2')
+    eng = _mk(params, cfg)
+    try:
+        a = HEAD + [31, 32, 33, 34, 35, 36, 37, 38]
+        eng.submit(a, 6).result(timeout=300)
+        eng.submit(HEAD + [41, 42, 43, 44, 45, 46, 47, 48],
+                   6).result(timeout=300)
+        summary = eng.prefix_summary()
+        assert summary is not None and summary['entries'], summary
+        assert len(summary['entries']) <= 2  # the env bound, enforced
+        info = prefix_affinity.parse_summary(summary)
+        hashes = prefix_affinity.chain_hashes(a, summary['block'], 32)
+        # The shared head's full block is resident and matchable by
+        # the exact hash the LB computes.
+        assert prefix_affinity.match_depth(hashes,
+                                           info['hashes']) >= 1
+    finally:
+        eng.stop()
+    off = _mk(params, cfg, prefix_share=False)
+    try:
+        assert off.prefix_summary() is None
+    finally:
+        off.stop()
+
+
 def test_trie_duplicate_commit_dedups():
     t = paged_lib.BlockTrie(2)
     n = t.commit(None, (1, 2), 10)

@@ -1,0 +1,425 @@
+"""Fleet-wide prefix-affinity routing (ISSUE 12).
+
+Contract: replicas advertise a BOUNDED, deterministic summary of their
+resident trie chains (``BlockTrie.summary``; hashes stable across
+commit/evict cycles and identical across replicas for the same token
+chain); the LB's ``PrefixAffinityPolicy`` routes a prompt toward its
+deepest resident match as a tiebreak-with-weight over least-load —
+never past the detour budget, so a hot prefix spills instead of
+overloading one box; everything is default-off
+(``SKYTPU_PREFIX_AFFINITY=0``) and purely advisory — a mis-push or a
+stale summary can only cost a cache hit, never correctness.
+"""
+import pytest
+
+from skypilot_tpu.models import paged as paged_lib
+from skypilot_tpu.utils import prefix_affinity
+from skypilot_tpu.serve.load_balancer import LoadBalancer
+from skypilot_tpu.serve.load_balancing_policies import (
+    LeastLoadPolicy, PrefixAffinityPolicy, make_policy)
+
+
+def _chain(trie, blocks, base_block=10):
+    """Commit a token chain of full blocks; returns the nodes."""
+    nodes = []
+    parent = None
+    p = trie.block
+    for i, blk in enumerate(blocks):
+        node = trie.commit(parent, tuple(blk), base_block + i)
+        assert node is not None
+        nodes.append(node)
+        parent = node
+    del p
+    return nodes
+
+
+# ---------------------------------------------------------------------------
+# chain hashing
+
+
+def test_chain_hashes_match_trie_digests():
+    t = paged_lib.BlockTrie(4)
+    a, b = _chain(t, [(1, 2, 3, 4), (5, 6, 7, 8)])
+    hashes = prefix_affinity.chain_hashes([1, 2, 3, 4, 5, 6, 7, 8, 99],
+                                          4, 32)
+    assert hashes == [a.chain.hex(), b.chain.hex()]
+    # Full blocks only; bounded by max_chains.
+    assert prefix_affinity.chain_hashes([1, 2, 3], 4, 32) == []
+    assert len(prefix_affinity.chain_hashes(list(range(64)), 4, 2)) == 2
+
+
+def test_match_depth_deepest_wins():
+    hashes = ['h1', 'h2', 'h3']
+    assert prefix_affinity.match_depth(hashes, {'h1': 1, 'h3': 3}) == 3
+    assert prefix_affinity.match_depth(hashes, {'h2': 2}) == 2
+    assert prefix_affinity.match_depth(hashes, {'zz': 1}) == 0
+    assert prefix_affinity.match_depth([], {'h1': 1}) == 0
+
+
+def test_parse_summary_rejects_garbage_and_version_skew():
+    good = {'v': prefix_affinity.SUMMARY_VERSION, 'block': 4,
+            'resident': 7, 'entries': [['ab', 1], ['cd', 2]]}
+    info = prefix_affinity.parse_summary(good)
+    assert info == {'block': 4, 'hashes': frozenset({'ab', 'cd'}),
+                    'resident': 7}
+    # The batch form parses once for the LB's fan-out.
+    assert prefix_affinity.parse_summaries(
+        {'a:1': good, 'b:1': {'v': 99}}) == {'a:1': info}
+    assert prefix_affinity.parse_summary(None) is None
+    assert prefix_affinity.parse_summary({'v': 99, 'block': 4,
+                                          'entries': [['ab', 1]]}) is None
+    assert prefix_affinity.parse_summary(
+        {'v': 1, 'block': 0, 'entries': [['ab', 1]]}) is None
+    # Malformed entries are skipped, not fatal; all-bad -> None.
+    assert prefix_affinity.parse_summary(
+        {'v': 1, 'block': 4, 'entries': [[None, 'x'], 'junk']}) is None
+
+
+# ---------------------------------------------------------------------------
+# BlockTrie.summary: bound, determinism, hash stability
+
+
+def test_summary_hard_bound_and_truncation_order():
+    t = paged_lib.BlockTrie(2)
+    hot = _chain(t, [(1, 2), (3, 4), (5, 6)], base_block=10)
+    cold = _chain(t, [(7, 8), (9, 10)], base_block=20)
+    # Heat the first chain: two matches.
+    t.match([1, 2, 3, 4, 5, 6, 99])
+    t.match([1, 2, 3, 4, 5, 6, 98])
+    full = t.summary(64)
+    assert full['nodes'] == 5 and not full['truncated']
+    assert full['block'] == 2 and full['resident'] == 5
+    cut = t.summary(3)
+    assert len(cut['entries']) == 3 and cut['truncated']
+    # Hottest chains first, deepest first within equal heat: the three
+    # heated nodes (depths 3, 2, 1) beat the cold chain entirely.
+    hot_hex = {n.chain.hex() for n in hot}
+    assert {h for h, _ in cut['entries']} == hot_hex
+    assert [d for _, d in cut['entries']] == [3, 2, 1]
+    assert t.summary(0)['entries'] == [] and not cold[0].detached
+
+
+def test_summary_hotness_decays_so_dead_chains_cannot_squat():
+    """Truncation ranks by a DECAYED match count (half-life in match
+    events): a historically hot tenant that left stops outranking live
+    traffic in the bounded advert."""
+    t = paged_lib.BlockTrie(2)
+    a = _chain(t, [(1, 2)], base_block=10)[0]
+    b = _chain(t, [(3, 4)], base_block=12)[0]
+    for _ in range(5):
+        t.match([1, 2, 99])  # chain A is hot first...
+    assert t.summary(1)['entries'] == [[a.chain.hex(), 1]]
+    for _ in range(4 * paged_lib.BlockTrie.HITS_HALF_LIFE):
+        t.match([3, 4, 99])  # ...then traffic moves on for good
+    assert t.summary(1)['entries'] == [[b.chain.hex(), 1]]
+
+
+def test_summary_deterministic_across_build_order():
+    rows = [[(1, 2), (3, 4)], [(5, 6)], [(7, 8), (9, 10), (11, 12)]]
+    t1, t2 = paged_lib.BlockTrie(2), paged_lib.BlockTrie(2)
+    for chain in rows:
+        _chain(t1, chain, base_block=30)
+    for chain in reversed(rows):
+        _chain(t2, chain, base_block=70)
+    # Same chains, different commit order AND different block ids:
+    # identical adverts (block ids are replica-local, hashes are not).
+    assert t1.summary(64)['entries'] == t2.summary(64)['entries']
+
+
+def test_summary_hashes_stable_across_commit_evict_cycles():
+    t = paged_lib.BlockTrie(4)
+    nodes = _chain(t, [(1, 2, 3, 4), (5, 6, 7, 8)])
+    before = {h for h, _ in t.summary(64)['entries']}
+    for n in nodes:
+        t.release(n)
+    assert t.evict(2) != []
+    assert t.summary(64)['entries'] == []
+    again = _chain(t, [(1, 2, 3, 4), (5, 6, 7, 8)], base_block=40)
+    assert {h for h, _ in t.summary(64)['entries']} == before
+    assert [n.chain for n in again] == [n.chain for n in nodes]
+
+
+def test_summary_excludes_detached_nodes():
+    t = paged_lib.BlockTrie(2)
+    a, b, c = _chain(t, [(1, 2), (3, 4), (5, 6)])
+    t.release(a)
+    t.release(c)
+    t.evict(1)  # pops a, cascades idle c, detaches b (still referenced)
+    assert b.detached
+    assert t.summary(64)['entries'] == []
+
+
+# ---------------------------------------------------------------------------
+# PrefixAffinityPolicy
+
+
+def _summary_for(chains, block=4):
+    t = paged_lib.BlockTrie(block)
+    for chain in chains:
+        _chain(t, chain)
+    return t.summary(64)
+
+
+def _mk_policy(monkeypatch, weight='1', detour='4'):
+    monkeypatch.setenv('SKYTPU_PREFIX_AFFINITY_WEIGHT', weight)
+    monkeypatch.setenv('SKYTPU_PREFIX_AFFINITY_MAX_DETOUR', detour)
+    pol = make_policy('prefix_affinity')
+    assert isinstance(pol, PrefixAffinityPolicy)
+    return pol
+
+
+ROW = [1, 2, 3, 4, 5, 6, 7, 8, 99]  # 2 full blocks of 4 + tail
+
+
+def test_policy_routes_to_matching_replica(monkeypatch):
+    pol = _mk_policy(monkeypatch)
+    pol.set_replicas(['a:1', 'b:1', 'c:1'])
+    pol.set_prefix_summaries(
+        {'b:1': _summary_for([[(1, 2, 3, 4), (5, 6, 7, 8)]])})
+    pick, depth = pol.select_affinity(ROW)
+    assert (pick, depth) == ('b:1', 2)
+    # No resident match anywhere: (None, 0), caller falls back.
+    assert pol.select_affinity([9, 9, 9, 9, 9]) == (None, 0)
+    # Prompt shorter than one block: nothing to match on.
+    assert pol.select_affinity([1, 2, 3]) == (None, 0)
+
+
+def test_policy_prefers_deeper_match_then_load(monkeypatch):
+    pol = _mk_policy(monkeypatch)
+    pol.set_replicas(['a:1', 'b:1'])
+    pol.set_prefix_summaries({
+        'a:1': _summary_for([[(1, 2, 3, 4)]]),
+        'b:1': _summary_for([[(1, 2, 3, 4), (5, 6, 7, 8)]])})
+    pick, depth = pol.select_affinity(ROW)
+    assert (pick, depth) == ('b:1', 2)
+    # Equal depth: lighter replica wins.
+    pol.set_prefix_summaries({
+        'a:1': _summary_for([[(1, 2, 3, 4), (5, 6, 7, 8)]]),
+        'b:1': _summary_for([[(1, 2, 3, 4), (5, 6, 7, 8)]])})
+    pol.on_request_start('a:1')
+    pick, _ = pol.select_affinity(ROW)
+    assert pick == 'b:1'
+
+
+def test_policy_saturation_spills_to_least_load(monkeypatch):
+    """The matched replica may exceed the fleet minimum by at most
+    min(weight x depth, detour) load units; past that the pick is
+    None-with-depth (the caller's least-load fallback) — a hot prefix
+    must never overload one box."""
+    pol = _mk_policy(monkeypatch, weight='1', detour='4')
+    pol.set_replicas(['a:1', 'b:1'])
+    pol.set_prefix_summaries(
+        {'a:1': _summary_for([[(1, 2, 3, 4), (5, 6, 7, 8)]])})
+    # depth 2, weight 1 -> credit 2: two in-flight above b is fine...
+    pol.on_request_start('a:1')
+    pol.on_request_start('a:1')
+    assert pol.select_affinity(ROW)[0] == 'a:1'
+    # ...the third is not: spill.
+    pol.on_request_start('a:1')
+    assert pol.select_affinity(ROW) == (None, 2)
+    # Queue pressure counts as load the same way.
+    pol.on_request_end('a:1')
+    pol.on_request_end('a:1')
+    pol.on_request_end('a:1')
+    pol.set_queue_pressure({'a:1': 50.0})
+    assert pol.select_affinity(ROW) == (None, 2)
+
+
+def test_policy_detour_caps_deep_match_credit(monkeypatch):
+    pol = _mk_policy(monkeypatch, weight='10', detour='3')
+    pol.set_replicas(['a:1', 'b:1'])
+    pol.set_prefix_summaries(
+        {'a:1': _summary_for([[(1, 2, 3, 4), (5, 6, 7, 8)]])})
+    for _ in range(4):  # weight x depth = 20, but detour caps at 3
+        pol.on_request_start('a:1')
+    assert pol.select_affinity(ROW) == (None, 2)
+
+
+def test_policy_select_is_plain_least_load(monkeypatch):
+    """select() is inherited untouched: with the data-plane hook off
+    (SKYTPU_PREFIX_AFFINITY=0) routing is byte-identical least-load."""
+    pol = _mk_policy(monkeypatch)
+    assert PrefixAffinityPolicy.select is LeastLoadPolicy.select
+    pol.set_replicas(['a:1', 'b:1'])
+    assert pol.select() in ('a:1', 'b:1')
+
+
+# ---------------------------------------------------------------------------
+# LoadBalancer wiring
+
+
+def test_lb_default_off_and_explicit_upgrade(monkeypatch):
+    monkeypatch.delenv('SKYTPU_PREFIX_AFFINITY', raising=False)
+    lb = LoadBalancer(0)
+    assert not lb.affinity_enabled
+    assert type(lb.policy) is LeastLoadPolicy
+    monkeypatch.setenv('SKYTPU_PREFIX_AFFINITY', '1')
+    lb_env = LoadBalancer(0)
+    assert lb_env.affinity_enabled
+    assert isinstance(lb_env.policy, PrefixAffinityPolicy)
+    # An explicitly chosen non-default policy is respected.
+    lb_rr = LoadBalancer(0, policy='round_robin')
+    assert not hasattr(lb_rr.policy, 'select_affinity')
+    # An explicitly configured prefix_affinity policy is its own
+    # opt-in — no env flag required (review finding).
+    monkeypatch.delenv('SKYTPU_PREFIX_AFFINITY', raising=False)
+    lb_cfg = LoadBalancer(0, policy='prefix_affinity')
+    assert lb_cfg.affinity_enabled and lb_cfg._affinity_ready()
+
+
+def test_lb_affinity_gauges_cleared_for_dead_services(tmp_state_dir):
+    """The controller-pushed gauges are rebuilt from live services at
+    scrape time: a torn-down service's series must vanish instead of
+    exporting its last counts forever (review finding)."""
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.server import metrics
+    serve_state.add_service('aff-gauge-svc', {}, {})
+    serve_state.set_service_status('aff-gauge-svc',
+                                   serve_state.ServiceStatus.READY)
+    metrics.set_lb_affinity('aff-gauge-svc', routed=7, fallbacks=2)
+    text = metrics.render().decode()
+    assert 'skytpu_lb_affinity_routed_total{service="aff-gauge-svc"} 7.0' \
+        in text
+    serve_state.set_service_status('aff-gauge-svc',
+                                   serve_state.ServiceStatus.SHUTDOWN)
+    text = metrics.render().decode()
+    assert 'aff-gauge-svc' not in text.replace(
+        'skytpu_services{status="SHUTDOWN"}', '')
+
+
+def test_lb_affinity_pick_counts_outcomes(monkeypatch):
+    monkeypatch.setenv('SKYTPU_PREFIX_AFFINITY_MAX_DETOUR', '4')
+    lb = LoadBalancer(0, affinity=True)
+    lb.set_replicas(['a:1', 'b:1'])
+    summary = _summary_for([[(1, 2, 3, 4), (5, 6, 7, 8)]])
+    lb.set_prefix_summaries({'a:1': summary})
+    assert lb.affinity_snapshot()['summaries'] == 1
+    # Routed: prompt head resident on a:1.
+    pick, matched = lb._affinity_pick({'tokens': [ROW]})
+    assert (pick, matched) == ('a:1', 2)
+    # Miss: cold prefix.
+    assert lb._affinity_pick({'tokens': [[9] * 8]}) == (None, 0)
+    # Fallback: match exists but sits past its credit.
+    for _ in range(7):
+        lb.policy.on_request_start('a:1')
+    assert lb._affinity_pick({'tokens': ROW})[0] is None
+    snap = lb.affinity_snapshot()
+    assert snap['routed'] == 1 and snap['misses'] == 1 \
+        and snap['fallbacks'] == 1 and snap['matched_blocks'] == 2
+    # Unroutable bodies are a no-op, not an error.
+    assert lb._affinity_pick({'tokens': 'nope'}) == (None, 0)
+    assert lb._affinity_pick(None) == (None, 0)
+
+
+def test_lb_summary_fanout_reaches_role_pools(monkeypatch):
+    monkeypatch.setenv('SKYTPU_PREFIX_AFFINITY', '1')
+    # DEFAULT policy name on purpose: the pool policies must get the
+    # same least_load -> prefix_affinity upgrade as the main pool, or
+    # disagg affinity is silently inert (review finding).
+    lb = LoadBalancer(0)
+    assert isinstance(lb._prefill_policy, PrefixAffinityPolicy)
+    assert isinstance(lb._decode_policy, PrefixAffinityPolicy)
+    lb.set_replicas(['p:1', 'd:1', 'c:1'],
+                    roles={'p:1': 'prefill', 'd:1': 'decode'})
+    summary = _summary_for([[(1, 2, 3, 4), (5, 6, 7, 8)]])
+    lb.set_prefix_summaries({'p:1': summary, 'd:1': summary})
+    assert lb._affinity_pick({'tokens': [ROW]},
+                             lb._prefill_policy)[0] == 'p:1'
+    assert lb._affinity_pick({'tokens': [ROW]},
+                             lb._decode_policy)[0] == 'd:1'
+
+
+# ---------------------------------------------------------------------------
+# controller-side summary extraction, autoscaler interplay, loadgen
+
+
+def test_controller_prefix_summary_extraction():
+    import json
+
+    from skypilot_tpu.serve.controller import _prefix_summaries
+    summary = {'v': 1, 'block': 4, 'entries': [['ab', 1]]}
+    snapshot = [
+        {'endpoint': 'a:1',
+         'health': json.dumps({'prefix_summary': summary})},
+        {'endpoint': 'b:1', 'health': json.dumps({'status': 'ok'})},
+        {'endpoint': None,
+         'health': json.dumps({'prefix_summary': summary})},
+        {'endpoint': 'c:1', 'health': 'not json'},
+    ]
+    assert _prefix_summaries(snapshot) == {'a:1': summary}
+
+
+def test_autoscaler_discounts_affinity_detour(monkeypatch):
+    from skypilot_tpu.serve.autoscalers import RequestRateAutoscaler
+    from skypilot_tpu.serve.service_spec import ReplicaPolicy
+    policy = ReplicaPolicy(min_replicas=1, max_replicas=8,
+                           target_qps_per_replica=1,
+                           target_queue_per_replica=4)
+    scaler = RequestRateAutoscaler(policy)
+    monkeypatch.delenv('SKYTPU_PREFIX_AFFINITY', raising=False)
+    assert scaler._pressure_units(8.0) == 2.0
+    # Affinity on: the detour budget is intended skew, not demand.
+    monkeypatch.setenv('SKYTPU_PREFIX_AFFINITY', '1')
+    monkeypatch.setenv('SKYTPU_PREFIX_AFFINITY_MAX_DETOUR', '4')
+    assert scaler._pressure_units(8.0) == 1.0
+    assert scaler._pressure_units(3.0) == 0.0
+    # Controller-resolved truth beats the env flag: an explicitly
+    # configured non-affinity LB policy never skews on purpose, so its
+    # demand must not be discounted (review finding).
+    scaler.affinity_active = False
+    assert scaler._pressure_units(8.0) == 2.0
+    scaler.affinity_active = True
+    assert scaler._pressure_units(8.0) == 1.0
+    scaler.affinity_active = None
+    monkeypatch.setenv('SKYTPU_PREFIX_AFFINITY', '0')
+    assert scaler._pressure_units(8.0) == 2.0
+
+
+def test_loadgen_fleet_aggregation_sums_before_dividing():
+    from skypilot_tpu.serve.loadgen import aggregate_prefix_healths
+    bodies = {
+        'a:1': {'engine': {'prefix_share': {'hits': 9, 'misses': 1},
+                           'prefill_tokens': 100,
+                           'prefill_tokens_saved': 900}},
+        'b:1': {'engine': {'prefix_share': {'hits': 0, 'misses': 10},
+                           'prefill_tokens': 1000,
+                           'prefill_tokens_saved': 0}},
+        'dead': {},  # no engine block: drops out of the denominator
+    }
+    out = aggregate_prefix_healths(bodies)
+    assert out['replicas'] == 2
+    # Fleet rate is 9/20, NOT the 0.95/0.0 per-replica average.
+    assert out['hit_rate'] == 0.45
+    assert out['per_replica']['a:1']['hit_rate'] == 0.9
+    assert out['prefill_tokens'] == 1100
+    assert out['prefill_tokens_saved'] == 900
+    empty = aggregate_prefix_healths({})
+    assert empty['replicas'] == 0 and empty['hit_rate'] == 0.0
+
+
+def test_loadgen_window_delta_survives_timeouts_and_restarts():
+    """The A/B gate's window deltas only diff replicas present in BOTH
+    scrapes, and clamp per-replica deltas at >= 0 — a health timeout
+    must not inject lifetime counters and a restarted replica's reset
+    counters must not drag the window negative."""
+    from skypilot_tpu.serve.loadgen import fleet_window_delta
+
+    def rep(h, m, pt=0, ps=0):
+        return {'hits': h, 'misses': m, 'hit_rate': 0,
+                'prefill_tokens': pt, 'prefill_tokens_saved': ps}
+
+    before = {'per_replica': {'a:1': rep(10, 10, pt=100),
+                              'b:1': rep(500, 500)}}
+    after = {'per_replica': {'a:1': rep(16, 12, pt=130),
+                             'b:1': rep(2, 1),      # restarted: reset
+                             'c:1': rep(900, 100)}}  # timed out before
+    w = fleet_window_delta(before, after)
+    assert w['replicas'] == 2
+    # Only a:1's genuine window counts: +6 hits / +2 misses; b:1's
+    # backwards counters clamp to 0 and c:1 is excluded entirely.
+    assert (w['hits'], w['misses']) == (6, 2)
+    assert w['prefill_tokens'] == 30
+
+
+if __name__ == '__main__':
+    raise SystemExit(pytest.main([__file__, '-v']))

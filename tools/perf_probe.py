@@ -78,6 +78,23 @@ the survivor and the survivor's bundle merged with the LB process's
 own ring reconstructs the timeline (ready-set flip, then survivor
 dispatches). CPU-only, wired into ``make verify``.
 
+``--affinity`` runs the fleet-wide prefix-affinity routing gate
+(utils/prefix_affinity.py): three OS-process colocated replicas behind
+two LBs in A/B — a least-load baseline and an affinity LB fed replica
+/health trie summaries the way the controller pushes them. A
+many-tenant shared-prefix mix (fresh tenants per leg, so the legs
+cannot poach each other's committed chains) must show fleet-wide
+prefix hit rate >= 1.5x the baseline's with p99 latency inside a 25%
+(+50 ms) jitter allowance of the baseline — equal-or-better in
+expectation (prefill skips can only help TTFT; the allowance absorbs
+small-sample scheduler noise on a shared CI box, retried x3);
+a single deliberately hot prefix under high concurrency must SPILL —
+>= 2 replicas serve it, the affinity fallback counter moves, and the
+policy's load spread stays within the detour budget — and greedy
+output through the affinity LB is byte-identical to a direct replica
+hit (routing is never a correctness dependency; SKYTPU_PREFIX_AFFINITY
+stays default-off). CPU-only, wired into ``make verify``.
+
 ``--slo`` runs the SLO burn-rate alerting gate (observability/slo.py):
 two single-slot replicas; a hammer stalls one under concurrent load so
 its admission backlog breaches the queue-depth rule — the alert must
@@ -1060,6 +1077,240 @@ def disagg_probe() -> dict:
             'decode_ratio_under_prefill_load': round(ratio, 3)}
 
 
+def affinity_probe() -> dict:
+    """Fleet-wide prefix-affinity routing gate over >= 3 real replica
+    processes (see the module docstring ``--affinity`` entry). The A/B
+    uses per-leg fresh tenant ids and prompt seeds: both legs run
+    against the SAME warm replicas, so disjoint chains — not replica
+    restarts — keep the legs from contaminating each other."""
+    import asyncio
+    import shutil
+    import tempfile
+    import threading
+
+    import requests as requests_lib
+
+    from skypilot_tpu.serve import loadgen
+    from skypilot_tpu.serve.load_balancer import LoadBalancer
+    from skypilot_tpu.utils import common_utils
+
+    max_len = 256
+    detour = 4.0
+    # Policy knobs are read at policy construction: pin them so the
+    # gate's spill assertions test known numbers.
+    os.environ['SKYTPU_PREFIX_AFFINITY_WEIGHT'] = '1'
+    os.environ['SKYTPU_PREFIX_AFFINITY_MAX_DETOUR'] = str(int(detour))
+    workdir = tempfile.mkdtemp(prefix='skytpu-affinity-')
+    tags = ('r0', 'r1', 'r2')
+    ports = {t: common_utils.find_free_port(23900 + 40 * i)
+             for i, t in enumerate(tags)}
+    # Summary cap raised to cover the whole pool (~255 blocks at this
+    # config): the A/B runs three attempts against the SAME warm
+    # replicas, and a 64-entry advert could truncate a later leg's
+    # fresh chains behind an earlier leg's still-hot ones — the
+    # default-bound behavior is unit-tested, this gate tests routing.
+    procs = {t: _spawn_replica(
+        'colocated', ports[t], workdir, max_len, tag=t,
+        extra_env={'SKYTPU_PREFIX_SUMMARY_MAX': '256'})
+             for t in tags}
+    eps = [f'127.0.0.1:{ports[t]}' for t in tags]
+    lb_base = LoadBalancer(common_utils.find_free_port(24040),
+                           affinity=False)
+    lb_aff = LoadBalancer(common_utils.find_free_port(24080),
+                          affinity=True)
+    stop_push = threading.Event()
+    spread_samples: list = []
+
+    def health(ep: str) -> dict:
+        return requests_lib.get(f'http://{ep}/health',
+                                timeout=10).json()
+
+    def pusher() -> None:
+        """The controller stand-in: mirror each replica's /health trie
+        summary and queue pressure into both LBs every tick, and
+        sample the affinity policy's load spread (the saturation-spill
+        bound the hot leg asserts)."""
+        while not stop_push.is_set():
+            summaries, pressure = {}, {}
+            for ep in eps:
+                try:
+                    h = health(ep)
+                except (requests_lib.RequestException, ValueError):
+                    continue
+                if isinstance(h.get('prefix_summary'), dict):
+                    summaries[ep] = h['prefix_summary']
+                q = (h.get('queue') or {}).get('depth_total') or 0
+                eng = h.get('engine') or {}
+                pressure[ep] = float(q) + float(eng.get('queued') or 0)
+            for lb in (lb_base, lb_aff):
+                lb.set_prefix_summaries(summaries)
+                if hasattr(lb.policy, 'set_queue_pressure'):
+                    lb.policy.set_queue_pressure(pressure)
+            if hasattr(lb_aff.policy, 'loads_snapshot'):
+                loads = lb_aff.policy.loads_snapshot()
+                if loads:
+                    spread_samples.append(max(loads.values())
+                                          - min(loads.values()))
+            stop_push.wait(0.2)
+
+    def run_mix(lb_url: str, tenants: int, n: int, conc: int,
+                tenant_offset: int, seed_base: int) -> dict:
+        return asyncio.run(loadgen.run_load(
+            lb_url, n, conc, '16', '8', 256, tenants=tenants,
+            shared_prefix=1.0, shared_prefix_len=96,
+            fleet_endpoints=list(eps), tenant_offset=tenant_offset,
+            seed_base=seed_base))
+
+    def row(n, salt):
+        return [(5 * i + 13 * salt) % 240 + 1 for i in range(n)]
+
+    def prefill_counts() -> dict:
+        return {ep: float((health(ep).get('engine') or {})
+                          .get('prefills') or 0) for ep in eps}
+
+    try:
+        deadline = time.time() + 300
+        for tag, ep in zip(tags, eps):
+            while True:
+                if procs[tag].poll() is not None:
+                    raise RuntimeError(
+                        f'{tag} replica exited at startup; see '
+                        f'{workdir}/{tag}.log')
+                try:
+                    h = health(ep)
+                    assert h.get('engine'), h
+                    break
+                except (requests_lib.RequestException, ValueError):
+                    if time.time() > deadline:
+                        raise RuntimeError(
+                            f'{tag} replica never became healthy')
+                    time.sleep(0.5)
+        for lb in (lb_base, lb_aff):
+            lb.set_replicas(list(eps))
+            lb.start_in_thread()
+        base_url = f'http://127.0.0.1:{lb_base.port}'
+        aff_url = f'http://127.0.0.1:{lb_aff.port}'
+        threading.Thread(target=pusher, daemon=True).start()
+
+        # Warm every replica's compiled prefill/decode paths so the
+        # A/B times routing, not XLA.
+        warm = {'tokens': [row(112, 7)], 'max_new_tokens': 8}
+        for ep in eps:
+            requests_lib.post(f'http://{ep}/generate', json=warm,
+                              timeout=600).raise_for_status()
+
+        # --- (a) fleet hit rate A/B: many tenants, few requests each
+        # (the regime where per-replica caches are sliced by replica
+        # count), same replicas, disjoint tenant ids per leg. Retried
+        # x3: a scheduler-jitter p99 can lose one attempt, a real
+        # routing regression loses all three.
+        ratio = base_rate = aff_rate = None
+        base_mix = aff_mix = None
+        for attempt in range(3):
+            off = 1000 * attempt
+            base_mix = run_mix(base_url, tenants=12, n=48, conc=4,
+                               tenant_offset=off, seed_base=off * 100)
+            aff_mix = run_mix(aff_url, tenants=12, n=48, conc=4,
+                              tenant_offset=off + 500,
+                              seed_base=(off + 500) * 100)
+            assert base_mix['ok'] == base_mix['requests'], base_mix
+            assert aff_mix['ok'] == aff_mix['requests'], aff_mix
+            base_rate = base_mix['shared_prefix']['fleet']['window'][
+                'hit_rate']
+            aff_rate = aff_mix['shared_prefix']['fleet']['window'][
+                'hit_rate']
+            ratio = aff_rate / max(base_rate, 1e-6)
+            p99_ok = (aff_mix['p99_latency_s']
+                      <= base_mix['p99_latency_s'] * 1.25 + 0.05)
+            if ratio >= 1.5 and p99_ok:
+                break
+        assert ratio >= 1.5, (
+            f'fleet hit rate {aff_rate:.3f} with affinity vs '
+            f'{base_rate:.3f} least-load ({ratio:.2f}x < 1.5x)')
+        assert p99_ok, (
+            f"affinity p99 {aff_mix['p99_latency_s']}s vs baseline "
+            f"{base_mix['p99_latency_s']}s")
+        snap = lb_aff.affinity_snapshot()
+        assert snap['routed'] > 0, snap
+        assert lb_base.affinity_snapshot()['routed'] == 0, \
+            'affinity-off LB must never consult the affinity policy'
+
+        # --- (b) hot single prefix must SPILL, not overload one box:
+        # one tenant, concurrency well past the detour budget. The
+        # matched replica may run at most `detour` load units above
+        # the fleet minimum (policy credit cap), so the fallback
+        # counter moves and >= 2 replicas end up serving prefills.
+        # Seed the hot head on EXACTLY ONE replica first (direct hit,
+        # not via the LB): a cold burst's misses would least-load-
+        # spread and replicate the chain everywhere, after which
+        # affinity balances among matched replicas without ever
+        # needing the spill this leg exists to prove.
+        hot_head = loadgen.shared_prefix_tokens(9000, 96, 256)
+        seed_row = hot_head + [(3 * i) % 250 + 1 for i in range(16)]
+        requests_lib.post(
+            f'http://{eps[0]}/generate',
+            json={'tokens': [seed_row], 'max_new_tokens': 8},
+            timeout=600).raise_for_status()
+        wait_deadline = time.time() + 60
+        while lb_aff.policy.select_affinity(seed_row)[0] != eps[0]:
+            assert time.time() < wait_deadline, \
+                'seeded hot chain never reached the affinity policy'
+            time.sleep(0.2)
+        pre = prefill_counts()
+        fallbacks0 = lb_aff.affinity_snapshot()['fallbacks']
+        spread_samples.clear()
+        hot = run_mix(aff_url, tenants=1, n=32, conc=12,
+                      tenant_offset=9000, seed_base=9_000_000)
+        assert hot['ok'] == hot['requests'], hot
+        post = prefill_counts()
+        busy = sum(1 for ep in eps if post[ep] > pre[ep])
+        assert busy >= 2, (
+            f'hot prefix concentrated on {busy} replica(s): '
+            f'{pre} -> {post}')
+        snap = lb_aff.affinity_snapshot()
+        assert snap['fallbacks'] > fallbacks0, (
+            'saturation fallback never fired under a hot prefix', snap)
+        # The detour budget binds at PICK time on the loads the policy
+        # saw then; sampled asynchronously the spread can double-count
+        # a request that is both in-flight at the LB and already
+        # queued on the replica (pressure pushes lag picks by up to a
+        # tick), peaking near 2x the budget. A broken spill (credit
+        # uncapped) parks the whole burst on one box and blows well
+        # past even that.
+        spread_max = max(spread_samples) if spread_samples else 0.0
+        assert spread_max <= 2 * detour + 2.0, (
+            f'affinity load spread {spread_max:.1f} exceeded '
+            f'2 x detour budget {detour} (+2 sampling slack)')
+
+        # --- (c) byte parity: routing is a hint, never a correctness
+        # dependency — output through the affinity LB is byte-
+        # identical to a direct replica hit.
+        payload = {'tokens': [row(40, 11)], 'max_new_tokens': 12}
+        direct = requests_lib.post(f'http://{eps[0]}/generate',
+                                   json=payload, timeout=600)
+        via = requests_lib.post(f'{aff_url}/generate', json=payload,
+                                timeout=600)
+        assert via.status_code == direct.status_code == 200, via.text
+        assert via.json() == direct.json()
+    finally:
+        stop_push.set()
+        for lb in (lb_base, lb_aff):
+            lb.stop()
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        shutil.rmtree(workdir, ignore_errors=True)
+    return {'fleet_hit_rate_affinity': aff_rate,
+            'fleet_hit_rate_least_load': base_rate,
+            'hit_rate_ratio': round(ratio, 2),
+            'p99_latency_affinity_s': aff_mix['p99_latency_s'],
+            'p99_latency_least_load_s': base_mix['p99_latency_s'],
+            'hot_prefix_replicas_serving': busy,
+            'hot_prefix_load_spread_max': round(spread_max, 2),
+            'affinity': lb_aff.affinity_snapshot()}
+
+
 def blackbox_probe() -> dict:
     """Black-box flight-recorder gate, three legs over real OS-process
     replicas on localhost HTTP:
@@ -1442,6 +1693,13 @@ def slo_probe() -> dict:
 
 
 def main():
+    if '--affinity' in sys.argv:
+        # CPU-only by design (same rationale as --smoke): never touch
+        # or wait on a chip in CI.
+        jax.config.update('jax_platforms', 'cpu')
+        print(json.dumps({'affinity_smoke': 'ok', **affinity_probe()}),
+              flush=True)
+        return
     if '--slo' in sys.argv:
         # CPU-only by design (same rationale as --smoke): never touch
         # or wait on a chip in CI.
