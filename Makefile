@@ -54,7 +54,14 @@ ci:
 # within two evaluation ticks, slo_breach bundles land locally and in
 # the replica spool, the alert resolves on recovery, the
 # skytpu_alerts_firing gauge is nonzero only while firing, and greedy
-# output is byte-identical SKYTPU_SLO=1 vs =0).
+# output is byte-identical SKYTPU_SLO=1 vs =0), and the runtime-
+# profiler gate (cold-start phase ledger sums to the observed
+# dark→READY wall within 5%, greedy byte parity SKYTPU_PROFILE=1 vs
+# =0, ZERO steady-state compiles under a fixed-shape mix — the
+# compile-once-per-shape contract machine-gated — and an injected
+# shape-churn leg trips the recompile-storm detector, fires the
+# serve.recompile_storm SLO warn rule, and freezes the profiler
+# snapshot into a black-box bundle).
 verify:
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --smoke
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --qos
@@ -66,6 +73,7 @@ verify:
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --ckpt
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --blackbox
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --slo
+	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --profile
 
 # Full skylint suite (lock discipline, engine-thread raise safety,
 # host-sync, env-flag registry, metric names, git bytecode hygiene) at

@@ -322,6 +322,17 @@ FLAGS: Tuple[Flag, ...] = (
          'Persist the metrics-history ring to a JSONL spool under '
          'SKYTPU_STATE_DIR and reload it at server start (keeps the '
          'SLO slow burn-rate window across restarts).'),
+    # -- runtime profiler (observability/profiler.py) -----------------
+    Flag('SKYTPU_PROFILE', 'bool', '0',
+         'Enable the runtime profiler: compile ledger, device-memory '
+         'accounting, cold-start phase ledger (byte-parity gated).'),
+    Flag('SKYTPU_PROFILE_MEM_S', 'float', '15',
+         'Device-memory sampling period (daemon cadence on the API '
+         'server; /health-probe rate limit on replicas).'),
+    Flag('SKYTPU_PROFILE_BUDGETS', 'map', None,
+         "Per-program shape-budget overrides, e.g. "
+         "'generate.prefill=1,engine.chunk=2' — the recompile-storm "
+         'injection lever for probes/tests.'),
     # -- SLO engine (observability/slo.py) ----------------------------
     Flag('SKYTPU_SLO', 'bool', '0',
          'Enable the SLO burn-rate alert evaluator on the API server.'),

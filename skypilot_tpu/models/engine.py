@@ -97,6 +97,13 @@ from skypilot_tpu.models import sampling
 # thread's admit/retire/dispatch edges are legal recording sites, and
 # _fail_everything can dump the ring as an incident bundle.
 from skypilot_tpu.observability import blackbox
+# Compile ledger (observability/profiler.py): every jit program
+# registers by name against the bounded PROGRAMS registry, making the
+# compile-once-per-shape contract above machine-observable (and
+# machine-gated by perf_probe --profile). With SKYTPU_PROFILE off the
+# wrappers are passthroughs; on, the steady-state cost is two
+# thread-local writes per dispatch — skylint host-sync stays clean.
+from skypilot_tpu.observability.profiler import profiled_jit
 
 
 @dataclasses.dataclass
@@ -255,7 +262,8 @@ def _insert_impl(cache: gen_lib.KVCache, last: jax.Array,
 # HBM); donating it makes insert/chunk update in place on TPU. The
 # N-row prefill cache (arg 2) is NOT donated — its [L, N, ...] shapes
 # match no output, so donating it only buys a warning.
-_jit_insert = jax.jit(_insert_impl, donate_argnums=(0, 1))
+_jit_insert = profiled_jit('engine.insert', _insert_impl,
+                           donate_argnums=(0, 1))
 
 
 def _gather_prefix_impl(pool: gen_lib.KVCache, idx: jax.Array,
@@ -273,7 +281,9 @@ def _gather_prefix_impl(pool: gen_lib.KVCache, idx: jax.Array,
                            lengths=lengths, k_s=ks, v_s=vs)
 
 
-_jit_gather_prefix = jax.jit(_gather_prefix_impl, static_argnums=(3,))
+_jit_gather_prefix = profiled_jit('engine.gather_prefix',
+                                  _gather_prefix_impl,
+                                  static_argnums=(3,))
 
 
 def _store_prefix_impl(pool: gen_lib.KVCache, cache_n: gen_lib.KVCache,
@@ -293,11 +303,12 @@ def _store_prefix_impl(pool: gen_lib.KVCache, cache_n: gen_lib.KVCache,
     return gen_lib.KVCache(k=k, v=v, lengths=pool.lengths, k_s=ks, v_s=vs)
 
 
-_jit_store_prefix = jax.jit(_store_prefix_impl, static_argnums=(4,),
-                            donate_argnums=(0,))
+_jit_store_prefix = profiled_jit('engine.store_prefix',
+                                 _store_prefix_impl, static_argnums=(4,),
+                                 donate_argnums=(0,))
 
 
-_jit_sample = jax.jit(sampling.sample)
+_jit_sample = profiled_jit('engine.sample', sampling.sample)
 
 
 def _paged_chunk_impl(cfg: llama.LlamaConfig, k_steps: int, params,
@@ -322,8 +333,9 @@ def _paged_chunk_impl(cfg: llama.LlamaConfig, k_steps: int, params,
     return cache, last, toks
 
 
-_jit_paged_chunk = jax.jit(_paged_chunk_impl, static_argnums=(0, 1, 10),
-                           donate_argnums=(3, 4))
+_jit_paged_chunk = profiled_jit('engine.paged_chunk', _paged_chunk_impl,
+                                static_argnums=(0, 1, 10),
+                                donate_argnums=(3, 4))
 
 
 # skylint: allow-host-sync(top_ks/top_ps arrive as host np arrays built
@@ -362,8 +374,9 @@ def _chunk_impl(cfg: llama.LlamaConfig, k_steps: int, params,
     return cache, last, toks
 
 
-_jit_chunk = jax.jit(_chunk_impl, static_argnums=(0, 1, 10),
-                     donate_argnums=(3, 4))
+_jit_chunk = profiled_jit('engine.chunk', _chunk_impl,
+                          static_argnums=(0, 1, 10),
+                          donate_argnums=(3, 4))
 
 
 def _insert_cache_impl(cache: gen_lib.KVCache, cache_n: gen_lib.KVCache,
@@ -382,7 +395,8 @@ def _insert_cache_impl(cache: gen_lib.KVCache, cache_n: gen_lib.KVCache,
     return gen_lib.KVCache(k=k, v=v, lengths=lengths, k_s=k_s, v_s=v_s)
 
 
-_jit_insert_cache = jax.jit(_insert_cache_impl, donate_argnums=(0,))
+_jit_insert_cache = profiled_jit('engine.insert_cache',
+                                 _insert_cache_impl, donate_argnums=(0,))
 
 
 def _rewind_impl(cache, adj: jax.Array):
@@ -393,7 +407,8 @@ def _rewind_impl(cache, adj: jax.Array):
     return dataclasses.replace(cache, lengths=cache.lengths - adj)
 
 
-_jit_rewind = jax.jit(_rewind_impl, donate_argnums=(0,))
+_jit_rewind = profiled_jit('engine.rewind', _rewind_impl,
+                           donate_argnums=(0,))
 
 
 def _spec_impl(t_cfg: llama.LlamaConfig, d_cfg: llama.LlamaConfig,
@@ -442,8 +457,9 @@ def _spec_impl(t_cfg: llama.LlamaConfig, d_cfg: llama.LlamaConfig,
     return t_cache, d_cache, props, tgt, samp
 
 
-_jit_spec = jax.jit(_spec_impl, static_argnums=(0, 1, 2, 13),
-                    donate_argnums=(5, 6))
+_jit_spec = profiled_jit('engine.spec_round', _spec_impl,
+                         static_argnums=(0, 1, 2, 13),
+                         donate_argnums=(5, 6))
 
 
 class ContinuousEngine:
@@ -1255,6 +1271,21 @@ class ContinuousEngine:
         self._prefix_index.clear()
         self._prefix_seen.clear()
         self._prefix_free = list(range(self.prefix_slots))
+        # Logical device-memory registration (observability/profiler.py
+        # memory accounting): the engine's resident KV footprint by
+        # kind, re-registered on every rebuild so the reconciliation
+        # residue (allocator in_use minus logical) stays the
+        # leak/fragmentation signal. Host-side .nbytes attribute reads
+        # over already-allocated buffers — no device sync.
+        from skypilot_tpu.observability import profiler
+        profiler.register_logical('kv_cache',
+                                  profiler.tree_nbytes(self._cache))
+        if self._d_cache is not None:
+            profiler.register_logical(
+                'kv_draft', profiler.tree_nbytes(self._d_cache))
+        if self._prefix_pool is not None:
+            profiler.register_logical(
+                'prefix_pool', profiler.tree_nbytes(self._prefix_pool))
 
     def _blocks_for(self, row_len: int, max_new: int) -> int:
         """Blocks reserved at admission: the request's actual ask, not

@@ -21,6 +21,10 @@ import jax.numpy as jnp
 
 from skypilot_tpu.models import llama, moe
 from skypilot_tpu.models.quantization import mm as _mm
+# Compile ledger (observability/profiler.py): module-level jits
+# register by name so the compile-once-per-shape promise in the
+# docstring is machine-observable (skylint jit-program rule).
+from skypilot_tpu.observability.profiler import profiled_jit
 
 Params = llama.Params
 _NEG_INF = -1e30
@@ -428,7 +432,8 @@ def _sample(logits: jax.Array, temperature: float,
 # persist across generate() calls — a serving replica compiles once per
 # (batch, prompt_len, max_len, n, temperature) shape, then decodes at
 # steady-state speed.
-_jit_prefill = jax.jit(forward_cached, static_argnums=(3,))
+_jit_prefill = profiled_jit('generate.prefill', forward_cached,
+                            static_argnums=(3,))
 
 
 def truncate_at_stop(tokens, eos):
@@ -481,7 +486,9 @@ def _decode_scan_impl(params, cache, first, key, cfg, n, temps,
     return toks
 
 
-_jit_decode_scan = jax.jit(_decode_scan_impl, static_argnums=(4, 5, 9))
+_jit_decode_scan = profiled_jit('generate.decode_scan',
+                                _decode_scan_impl,
+                                static_argnums=(4, 5, 9))
 
 
 def generate(params: Params, cfg: llama.LlamaConfig,

@@ -153,6 +153,8 @@ def init_params_sharded(key: jax.Array, cfg: LlamaConfig, mesh,
     rules = rules or sharding_lib.ShardingRules()
     shardings = sharding_lib.sharding_tree(param_logical_axes(cfg), mesh,
                                            rules)
+    # skylint: allow-jit(one-shot sharded weight init at startup, not
+    # a serving program)
     return jax.jit(init_params, static_argnums=(1,),
                    out_shardings=shardings)(key, cfg)
 

@@ -46,6 +46,8 @@ from skypilot_tpu.models.generate import (KVCache, _cached_attention,
                                           _mlp_tail, _qkv_proj,
                                           _quantize_block)
 from skypilot_tpu.models.quantization import mm as _mm
+# Compile ledger (observability/profiler.py): see models/generate.py.
+from skypilot_tpu.observability.profiler import profiled_jit
 from skypilot_tpu.utils import prefix_affinity as affinity_lib
 
 
@@ -170,7 +172,8 @@ def _insert_impl(pool: PagedKVCache, cache_n, tables_new: jax.Array,
         k_s=k_s, v_s=v_s)
 
 
-jit_insert = jax.jit(_insert_impl, donate_argnums=(0,))
+jit_insert = profiled_jit('paged.insert', _insert_impl,
+                          donate_argnums=(0,))
 
 
 # ---------------------------------------------------------------------------
@@ -584,7 +587,8 @@ def _fork_block_impl(pool: PagedKVCache, src: jax.Array,
                         lengths=pool.lengths, k_s=k_s, v_s=v_s)
 
 
-jit_fork_block = jax.jit(_fork_block_impl, donate_argnums=(0,))
+jit_fork_block = profiled_jit('paged.fork_block', _fork_block_impl,
+                              donate_argnums=(0,))
 
 
 def _gather_blocks_impl(pool: PagedKVCache,
@@ -612,7 +616,8 @@ def _gather_blocks_impl(pool: PagedKVCache,
                    k_s=ks, v_s=vs)
 
 
-jit_gather_blocks = jax.jit(_gather_blocks_impl)
+jit_gather_blocks = profiled_jit('paged.gather_blocks',
+                                 _gather_blocks_impl)
 
 
 # ---------------------------------------------------------------------------
@@ -637,7 +642,8 @@ def _export_blocks_impl(pool: PagedKVCache, blocks: jax.Array):
     return k, v, None, None
 
 
-jit_export_blocks = jax.jit(_export_blocks_impl)
+jit_export_blocks = profiled_jit('paged.export_blocks',
+                                 _export_blocks_impl)
 
 
 def _import_blocks_impl(pool: PagedKVCache, k_new, v_new, k_s_new,
@@ -661,7 +667,9 @@ def _import_blocks_impl(pool: PagedKVCache, k_new, v_new, k_s_new,
         lengths=pool.lengths.at[slot].set(length), k_s=k_s, v_s=v_s)
 
 
-jit_import_blocks = jax.jit(_import_blocks_impl, donate_argnums=(0,))
+jit_import_blocks = profiled_jit('paged.import_blocks',
+                                 _import_blocks_impl,
+                                 donate_argnums=(0,))
 
 
 def _prefill_shared_impl(cfg: llama.LlamaConfig, params,
@@ -690,5 +698,7 @@ def _prefill_shared_impl(cfg: llama.LlamaConfig, params,
                                 k_s=row_cache.k_s, v_s=row_cache.v_s)
 
 
-jit_prefill_shared = jax.jit(_prefill_shared_impl,
-                             static_argnums=(0, 8), donate_argnums=(2,))
+jit_prefill_shared = profiled_jit('paged.prefill_shared',
+                                  _prefill_shared_impl,
+                                  static_argnums=(0, 8),
+                                  donate_argnums=(2,))

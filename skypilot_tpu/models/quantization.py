@@ -132,6 +132,7 @@ def quantize_params_sharded(params: Params, cfg: llama.LlamaConfig, mesh,
     rules = rules or sharding_lib.ShardingRules()
     out_axes = _axes_tree(cfg, lambda scope, name: True)
     shardings = sharding_lib.sharding_tree(out_axes, mesh, rules)
+    # skylint: allow-jit(one-shot deployment-time quantization pass)
     return jax.jit(quantize_params, out_shardings=shardings)(params)
 
 

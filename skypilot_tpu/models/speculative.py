@@ -33,6 +33,8 @@ import numpy as np
 
 from skypilot_tpu.models import generate as gen_lib
 from skypilot_tpu.models import llama
+# Compile ledger (observability/profiler.py): see models/generate.py.
+from skypilot_tpu.observability.profiler import profiled_jit
 
 
 def _propose_impl(cfg, k, params, cache, cur):
@@ -56,8 +58,8 @@ def _propose_impl(cfg, k, params, cache, cur):
     return cache, toks
 
 
-_jit_propose = jax.jit(_propose_impl, static_argnums=(0, 1),
-                       donate_argnums=(3,))
+_jit_propose = profiled_jit('spec.propose', _propose_impl,
+                            static_argnums=(0, 1), donate_argnums=(3,))
 
 
 def _verify_impl(cfg, params, cache, window):
@@ -68,8 +70,8 @@ def _verify_impl(cfg, params, cache, window):
     return cache, jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
-_jit_verify = jax.jit(_verify_impl, static_argnums=(0,),
-                      donate_argnums=(2,))
+_jit_verify = profiled_jit('spec.verify', _verify_impl,
+                           static_argnums=(0,), donate_argnums=(2,))
 
 
 def generate_speculative(target_params, target_cfg: llama.LlamaConfig,

@@ -131,6 +131,10 @@ EVENTS: Tuple[Event, ...] = (
     # -- probes --------------------------------------------------------
     Event('probe.phase',
           'The phased TPU init probe crossed (or aborted in) a phase.'),
+    # -- runtime profiler (observability/profiler.py) ------------------
+    Event('profiler.storm',
+          'A profiled jit program compiled past its declared shape '
+          'budget (recompile storm): program, count, budget.'),
 )
 
 EVENT_NAMES = frozenset(e.name for e in EVENTS)
@@ -356,6 +360,18 @@ def _trace_snapshot() -> Dict[str, Any]:
         return {'open': [], 'recent': []}
 
 
+def _profiler_snapshot() -> Optional[Dict[str, Any]]:
+    """Latest runtime-profiler state (observability/profiler.py) for
+    the bundle: compile ledger, device-memory accounting, cold-start
+    phases. None while SKYTPU_PROFILE is off — a disabled profiler
+    must not bloat bundles — and best-effort like every dump leg."""
+    try:
+        from skypilot_tpu.observability import profiler
+        return profiler.try_snapshot()
+    except Exception:  # noqa: BLE001 — a broken profiler must not
+        return None    # block the dump
+
+
 def build_bundle(trigger: str, reason: Optional[str] = None,
                  extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """The bundle dict (separated from the write path so the probe
@@ -377,6 +393,7 @@ def build_bundle(trigger: str, reason: Optional[str] = None,
         'traces': _trace_snapshot(),
         'health': health,
         'env_flags': _env_flag_values(),
+        'profile': _profiler_snapshot(),
         'stacks': _thread_stacks(),
     }
     if extra:

@@ -144,6 +144,18 @@ HEALTH_FIELDS: Tuple[HealthField, ...] = (
                 'Cumulative prefill host time decode provably waited '
                 'on; bubble rate = its delta over the prefill_ms '
                 'delta.'),
+    HealthField('replica.recompile_storms',
+                'Cumulative recompile-storm count from the runtime '
+                'profiler (health profile.storms_total): jit programs '
+                'compiled past their declared shape budget. Rated '
+                'between samples — the rule fires while storms are '
+                'actively occurring, not forever after one.'),
+    HealthField('replica.hbm_headroom_frac',
+                'Device-memory headroom fraction from the profiler\'s '
+                'memory accounting (health profile.device_memory.'
+                'headroom_frac); absent on CPU replicas and while '
+                'SKYTPU_PROFILE is off — no observation, never a '
+                'breach.'),
     HealthField('cluster.heartbeat_age_s',
                 'Seconds since the cluster daemon last heartbeated '
                 '(the shared global_user_state.heartbeat_age rule; '
@@ -173,11 +185,19 @@ def replica_signal_fields(health: Dict[str, Any]) -> Dict[str, Any]:
     queue = health.get('queue') or {}
     qos = health.get('qos') or {}
     ttft = health.get('ttft_ms') or {}
+    # Runtime profiler block (observability/profiler.py; present only
+    # with SKYTPU_PROFILE on — absent fields yield no observation).
+    prof = health.get('profile') if isinstance(health.get('profile'),
+                                               dict) else {}
+    mem = prof.get('device_memory') if isinstance(
+        prof.get('device_memory'), dict) else {}
 
     def num(v):
         return float(v) if isinstance(v, (int, float)) else None
 
     return {
+        'recompile_storms': num(prof.get('storms_total')),
+        'hbm_headroom_frac': num(mem.get('headroom_frac')),
         'queue_depth': (num(queue.get('depth_total')) or 0.0)
                        + (num(eng.get('queued')) or 0.0),
         'ttft_p99_ms': num(ttft.get('p99')),
@@ -276,6 +296,16 @@ def _sig_prefill_bubble_rate(prev, cur):
     return out
 
 
+def _sig_recompile_storm_rate(prev, cur):
+    """New recompile storms since the last sample, per replica. A
+    delta, not a level: one historical storm must not breach forever —
+    the rule fires while a storm is actively burning compiles."""
+    out: Dict[str, Optional[float]] = {}
+    for key in _replicas(cur):
+        out[key] = _delta(prev, cur, key, 'recompile_storms')
+    return out
+
+
 def _family(sample_key: str):
 
     def extract(prev, cur):
@@ -300,6 +330,8 @@ SIGNALS: Dict[str, Callable] = {
     'decode_tok_s': _sig_decode_tok_s,
     'shed_rate': _sig_shed_rate,
     'prefill_bubble_rate': _sig_prefill_bubble_rate,
+    'recompile_storm_rate': _sig_recompile_storm_rate,
+    'hbm_headroom': _level('hbm_headroom_frac'),
     'heartbeat_age': _family('cluster_heartbeat_age'),
     'goodput_ratio': _family('job_goodput'),
     'ckpt_staleness': _family('ckpt_staleness_s'),
@@ -368,6 +400,23 @@ RULES: Tuple[Rule, ...] = (
          sources=('replica.prefill_bubble_ms', 'replica.prefill_ms',
                   'skytpu_replica_prefill_bubble_ms'),
          op='>', threshold=0.3),
+    Rule('serve.recompile_storm',
+         'A replica is burning XLA compiles past a program\'s declared '
+         'shape budget — the compile-once-per-shape contract is being '
+         'violated live (shape churn, a regressed bucketing path), '
+         'and every storm compile stalls the engine for seconds.',
+         severity='warn', signal='recompile_storm_rate',
+         sources=('replica.recompile_storms',
+                  'skytpu_recompile_storm_total'),
+         op='>', threshold=0.0),
+    Rule('serve.hbm_headroom',
+         'Device-memory headroom below 10%: the next admission burst, '
+         'prefix-pool growth, or compile scratch allocation OOMs the '
+         'replica (the pod-scale binding constraint — PAPERS.md).',
+         severity='warn', signal='hbm_headroom',
+         sources=('replica.hbm_headroom_frac',
+                  'skytpu_device_mem_bytes'),
+         op='<', threshold=0.1),
     Rule('fleet.heartbeat_age',
          'Cluster daemon heartbeat stale: the host wedged, the daemon '
          'died, or the network partitioned.',

@@ -42,6 +42,8 @@ def allreduce_benchmark(payload_mb: float = 64.0,
     def body(x):
         return jax.lax.psum(x, axis_name)
 
+    # skylint: allow-jit(collective microbenchmark, not a serving
+    # program)
     fn = jax.jit(jax.shard_map(
         body, mesh=mesh, in_specs=P(axis_name), out_specs=P(axis_name),
         check_vma=False))
@@ -83,6 +85,8 @@ def verify_collectives(mesh: Optional[Mesh] = None) -> Dict[str, bool]:
             return s, g, rolled
 
         x = jnp.arange(8, dtype=jnp.float32)
+        # skylint: allow-jit(collective self-test, not a serving
+        # program)
         fn = jax.jit(jax.shard_map(
             body, mesh=mesh, in_specs=P(),
             out_specs=(P(), P(), P(axis)), check_vma=False))

@@ -69,6 +69,11 @@ from aiohttp import web
 
 from skypilot_tpu.models import generate as gen_lib
 from skypilot_tpu.models import llama
+# Runtime profiler (observability/profiler.py): cold-start phase marks
+# here (weights_load / jit_warmup / ready / first_token), the /health
+# `profile` block, and /debug/profile. mark() is a first-crossing
+# timestamp write; every SURFACE is SKYTPU_PROFILE-gated.
+from skypilot_tpu.observability import profiler
 from skypilot_tpu.observability import trace as trace_lib
 from skypilot_tpu.serve import qos as qos_lib
 
@@ -354,6 +359,14 @@ class LlmServer:
             self.draft_cfg = llama.PRESETS[self.draft_model]
             self.draft_params = llama.init_params(
                 jax.random.PRNGKey(seed + 1), self.draft_cfg)
+        # Cold-start ledger: target (+draft) weights are resident now;
+        # logical footprint registered for the memory reconciliation.
+        profiler.mark('weights_load')
+        profiler.register_logical('weights',
+                                  profiler.tree_nbytes(self.params))
+        if self.draft_params is not None:
+            profiler.register_logical(
+                'draft_weights', profiler.tree_nbytes(self.draft_params))
         # Multi-host SPMD replica (serve/spmd.py): every worker process
         # runs the same engine in lockstep; HTTP lives on rank 0 only.
         self.world = jax.process_count()
@@ -431,6 +444,12 @@ class LlmServer:
         from skypilot_tpu.observability import blackbox
         blackbox.set_process_label(f'llm_server:{self.role}')
         blackbox.register_health_provider(self.health_snapshot)
+        # Engine/server construction (device buffers + any eager
+        # tracing) is done; what remains before READY is the listener
+        # bind — lazy jit warm-up happens on the first requests and
+        # lands in the compile ledger per program. AOT warm-up before
+        # admitting traffic (ROADMAP item 2) will widen this phase.
+        profiler.mark('jit_warmup')
 
     async def health(self, request: web.Request) -> web.Response:
         del request
@@ -440,6 +459,20 @@ class LlmServer:
             return web.json_response(
                 {'status': 'draining', 'model': self.model_name},
                 status=503)
+        if profiler.enabled():
+            # 'ready' = the first successful readiness probe — HERE,
+            # not in health_snapshot(): the black-box health provider
+            # also builds snapshots (e.g. an engine_failure bundle
+            # during a failed start), and that must never fake the
+            # dark→READY crossing.
+            profiler.mark('ready')
+            # Device-memory sampling rides the probe cadence but runs
+            # OFF-LOOP and fire-and-forget: allocator queries on a
+            # wedged PJRT runtime must not freeze the event loop every
+            # other surface (streaming, /debug) shares. The body below
+            # carries whatever the last completed sample was.
+            asyncio.get_event_loop().run_in_executor(
+                None, profiler.maybe_sample_device_memory)
         return web.json_response(self.health_snapshot())
 
     def health_snapshot(self) -> Dict[str, Any]:
@@ -484,6 +517,15 @@ class LlmServer:
                                'p50': nearest_rank(waits, 50),
                                'p95': nearest_rank(waits, 95),
                                'p99': nearest_rank(waits, 99)}
+        if profiler.enabled():
+            # Runtime profiler block: compile ledger + cold-start
+            # phases + the last completed device-memory sample (the
+            # async /health handler refreshes it off-loop at the probe
+            # cadence — this sync builder must stay allocator-free for
+            # the black-box provider path). The SLO extractors
+            # (slo.replica_signal_fields) and the metrics-history
+            # sampler read exactly this shape.
+            body['profile'] = profiler.snapshot()
         if self.engine is not None:
             body['engine'] = self.engine.stats()
             # Fleet prefix-affinity advert (utils/prefix_affinity.py):
@@ -690,6 +732,7 @@ class LlmServer:
         if not events:
             return
         ttft = max(events[0][0] - rec.t0, 0.0)
+        profiler.mark('first_token')  # cold-start ledger: idempotent
         self._ttft_window.append(ttft)
         metrics_lib.SERVE_TTFT.labels(qos_class=qos_class).observe(ttft)
         metrics_lib.SERVE_PHASE.labels(
@@ -770,6 +813,7 @@ class LlmServer:
         now = time.time()
         dur = max(now - t_start, 0.0)
         toks = sum(len(r) for r in out)
+        profiler.mark('first_token')  # cold-start ledger: idempotent
         self._ttft_window.append(dur)
         metrics_lib.SERVE_TTFT.labels(qos_class=qos_class).observe(dur)
         metrics_lib.SERVE_PHASE.labels(
@@ -1544,6 +1588,20 @@ class LlmServer:
             None, blackbox.debug_payload, dict(request.query))
         return web.json_response(payload)
 
+    async def debug_profile(self, request: web.Request) -> web.Response:
+        """Runtime-profiler state (observability/profiler.py): compile
+        ledger, device-memory accounting, cold-start phases.
+        ``?programs=1`` appends the PROGRAMS catalog, ``?mem=1`` forces
+        a fresh memory sample. Same scrape-token gate as /metrics;
+        off-loop — a forced memory sample queries every device
+        allocator."""
+        if not self._scrape_authorized(request):
+            return web.json_response({'error': 'unauthorized'},
+                                     status=401)
+        payload = await asyncio.get_event_loop().run_in_executor(
+            None, profiler.debug_payload, dict(request.query))
+        return web.json_response(payload)
+
     async def debug_alerts(self, request: web.Request) -> web.Response:
         """SLO alert state visible from THIS process (observability/
         slo.py): the evaluator runs on the API server, so a replica
@@ -1565,6 +1623,7 @@ class LlmServer:
         app.router.add_get('/metrics', self.metrics)
         app.router.add_get('/debug/traces', self.debug_traces)
         app.router.add_get('/debug/blackbox', self.debug_blackbox)
+        app.router.add_get('/debug/profile', self.debug_profile)
         app.router.add_get('/debug/alerts', self.debug_alerts)
         app.router.add_post('/generate', self.generate)
         # KV handoff (disaggregated prefill/decode, serve/disagg.py).
@@ -1682,6 +1741,10 @@ def main() -> None:
     # latch the platform at import; same dance as train/run.py).
     from skypilot_tpu.utils.jax_env import apply_jax_platform_env
     apply_jax_platform_env()
+    # Cold-start ledger: python + package imports are done; what
+    # follows is backend init (sub-phases marked inside
+    # init_backend_guarded), weight init, and engine construction.
+    profiler.mark('imports')
     parser = build_parser()
     args = parser.parse_args()
     # SIGQUIT interrogation BEFORE backend init: a replica hung inside

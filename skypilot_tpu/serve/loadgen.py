@@ -127,12 +127,62 @@ def fleet_window_delta(before: dict, after: dict) -> dict:
             'prefill_tokens': dt, 'prefill_tokens_saved': ds}
 
 
-async def fleet_prefix_stats(session, endpoints) -> dict:
+def aggregate_profile_healths(bodies: dict) -> dict:
+    """Per-replica + fleet compile-ledger counts from /health
+    ``profile`` blocks ({endpoint: body}) — the runtime profiler
+    (observability/profiler.py). Replicas without the block
+    (SKYTPU_PROFILE off, older build) drop out; ``replicas`` counts
+    only reporters, so 0 means "nobody profiled", not "zero compiles".
+    Pure so the per-leg report math is unit-testable without HTTP."""
+    per = {}
+    compiles = storms = 0.0
+    ms = 0.0
+    for ep, body in sorted((bodies or {}).items()):
+        prof = (body or {}).get('profile')
+        if not isinstance(prof, dict) or not prof.get('enabled'):
+            continue
+        c = float(prof.get('compiles_total') or 0)
+        s = float(prof.get('storms_total') or 0)
+        m = float(prof.get('compile_ms_total') or 0)
+        compiles += c
+        storms += s
+        ms += m
+        per[ep] = {'compiles': int(c), 'storms': int(s),
+                   'compile_ms': round(m, 1)}
+    return {'replicas': len(per), 'compiles': int(compiles),
+            'storms': int(storms), 'compile_ms': round(ms, 1),
+            'per_replica': per}
+
+
+def profile_window_delta(before: dict, after: dict) -> dict:
+    """THIS leg's compile-ledger deltas from two
+    ``aggregate_profile_healths`` snapshots — intersection-of-replicas
+    + clamped-at-zero, same discipline as ``fleet_window_delta``. The
+    number a fixed-shape perf gate asserts ZERO on: steady-state
+    compiles mean the compile-once-per-shape contract broke."""
+    both = set(before['per_replica']) & set(after['per_replica'])
+    dc = ds = 0
+    dm = 0.0
+    per = {}
+    for ep in both:
+        b, a = before['per_replica'][ep], after['per_replica'][ep]
+        c = max(a['compiles'] - b['compiles'], 0)
+        s = max(a['storms'] - b['storms'], 0)
+        m = max(a['compile_ms'] - b['compile_ms'], 0.0)
+        dc += c
+        ds += s
+        dm += m
+        per[ep] = {'compiles': c, 'storms': s,
+                   'compile_ms': round(m, 1)}
+    return {'replicas': len(both), 'compiles': dc, 'storms': ds,
+            'compile_ms': round(dm, 1), 'per_replica': per}
+
+
+async def _fetch_healths(session, endpoints) -> dict:
     """Fetch /health from every replica endpoint (concurrently — one
     dead replica's timeout must not serialize into N x 15 s around the
-    measured window) and aggregate the prefix-share counters
-    fleet-wide. Best-effort per endpoint: a dead replica drops out of
-    the denominator rather than failing the report."""
+    measured window). Best-effort per endpoint: a dead replica drops
+    out rather than failing the report."""
     import aiohttp
 
     async def fetch(ep):
@@ -149,8 +199,14 @@ async def fleet_prefix_stats(session, endpoints) -> dict:
 
     results = await asyncio.gather(*(fetch(ep)
                                      for ep in endpoints or []))
+    return {ep: body for ep, body in results if body is not None}
+
+
+async def fleet_prefix_stats(session, endpoints) -> dict:
+    """Fleet-wide prefix-share aggregation over live /health bodies
+    (see aggregate_prefix_healths / _fetch_healths)."""
     return aggregate_prefix_healths(
-        {ep: body for ep, body in results if body is not None})
+        await _fetch_healths(session, endpoints))
 
 
 async def _one(session, url: str, prompt_span, max_new_span,
@@ -381,20 +437,28 @@ async def run_load(url: str, requests_total: int, concurrency: int,
                 shared_of.append((prefix is not None, r))
                 long_of.append((is_long, r))
 
-        fleet_before = None
-        if fleet_endpoints and shared_flags is not None:
-            fleet_before = await fleet_prefix_stats(session,
-                                                    fleet_endpoints)
+        fleet_before = prof_before = None
+        if fleet_endpoints:
+            # ONE health sweep feeds both aggregations: the prefix
+            # counters (shared-prefix mixes) and the compile ledger
+            # (every leg — a perf gate asserts zero steady-state
+            # compiles on the window delta).
+            bodies = await _fetch_healths(session, fleet_endpoints)
+            prof_before = aggregate_profile_healths(bodies)
+            if shared_flags is not None:
+                fleet_before = aggregate_prefix_healths(bodies)
         wall_t0 = time.time()
         t0 = time.perf_counter()
         await asyncio.gather(*(_bounded(i) for i in range(requests_total)))
         wall = time.perf_counter() - t0
         wall_t1 = time.time()
 
-        fleet_after = None
-        if fleet_endpoints and shared_flags is not None:
-            fleet_after = await fleet_prefix_stats(session,
-                                                   fleet_endpoints)
+        fleet_after = prof_after = None
+        if fleet_endpoints:
+            bodies = await _fetch_healths(session, fleet_endpoints)
+            prof_after = aggregate_profile_healths(bodies)
+            if shared_flags is not None:
+                fleet_after = aggregate_prefix_healths(bodies)
 
         engine_share = None
         if shared_flags is not None:
@@ -537,6 +601,16 @@ async def run_load(url: str, requests_total: int, concurrency: int,
         extra['per_class'] = per_class
         if tenants > 1:
             extra['tenants'] = tenants
+    if prof_after is not None and prof_after['replicas']:
+        # Per-leg compile accounting (runtime profiler): 'window' is
+        # THIS run's counter deltas — under a fixed-shape mix a warmed
+        # fleet must report window.compiles == 0 (the perf_probe
+        # --profile gate) — 'lifetime' the replicas' cumulative view.
+        extra['profile'] = {
+            'window': (profile_window_delta(prof_before, prof_after)
+                       if prof_before is not None else None),
+            'lifetime': prof_after,
+        }
     if incident_bundles is not None:
         extra['incident_bundles'] = incident_bundles
     if alerts_fired is not None:

@@ -206,6 +206,22 @@ class ReplicaManager:
             draining = True
         return r.status_code < 500, health, draining
 
+    def _note_first_ready(self, rep: Dict, now: float) -> None:
+        """Record ``skytpu_provision_to_first_token_s`` for a replica
+        crossing dark→READY: launch-issued (created_at) → readiness.
+        Best-effort — a metrics-less controller host must not fail the
+        probe loop that keeps the fleet routed."""
+        created = rep.get('created_at')
+        if not isinstance(created, (int, float)) or created <= 0:
+            return
+        try:
+            from skypilot_tpu.server import metrics as metrics_lib
+            metrics_lib.set_provision_to_first_token(
+                self.service_name, rep['replica_id'],
+                round(max(now - created, 0.0), 3))
+        except Exception:  # noqa: BLE001 — observability only
+            pass
+
     def probe_all(self) -> List[str]:
         """Probe every live replica; update statuses; replace dead READY
         replicas. Returns ready endpoints."""
@@ -222,6 +238,19 @@ class ReplicaManager:
                 continue
             ok, health, draining = self._probe(endpoint)
             if ok:
+                if rid not in self._ready_since and \
+                        status != serve_state.ReplicaStatus.READY:
+                    # Dark→READY for the first time: roll the whole
+                    # provision→first-token window up into the
+                    # cold-start budget metric (ROADMAP item 2). The
+                    # replica's own /health profile block breaks its
+                    # in-process share down by phase
+                    # (skytpu_replica_warmup_seconds). The persisted-
+                    # status guard matters across a CONTROLLER restart:
+                    # _ready_since is in-memory, and re-recording a
+                    # long-READY replica would overwrite its cold-start
+                    # figure with its whole uptime.
+                    self._note_first_ready(rep, now)
                 self._ready_since.setdefault(rid, now)
                 serve_state.upsert_replica(self.service_name, rid,
                                            serve_state.ReplicaStatus.READY,

@@ -97,6 +97,15 @@ async def run_background(app) -> None:
         # SKYTPU_SLO_EVAL_S). Gated on SKYTPU_SLO — off by default.
         tasks.append(asyncio.create_task(
             loop(slo.eval_interval_s(sample_s), slo.evaluate_once)))
+    from skypilot_tpu.observability import profiler
+    if profiler.enabled():
+        # Runtime profiler (observability/profiler.py): periodic
+        # device-memory snapshots on this host — the API server's own
+        # HBM/alloc view (replicas sample theirs at the /health probe
+        # cadence). Gated on SKYTPU_PROFILE — off by default.
+        tasks.append(asyncio.create_task(
+            loop(profiler.mem_sample_interval_s(),
+                 profiler.sample_device_memory)))
     app['skytpu_daemons'] = tasks
 
 

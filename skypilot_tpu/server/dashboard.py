@@ -923,6 +923,22 @@ function healthCell(h){
       if(dg.fallbacks_served) t.push(`fb${dg.fallbacks_served}`);
       parts.push(t.join(' '));
     }
+    // Runtime profiler (observability/profiler.py; SKYTPU_PROFILE=1):
+    // cumulative compiles (+storm count — nonzero means the
+    // compile-once-per-shape contract is being violated live), HBM
+    // headroom %, and the cold-start ledger total, e.g.
+    // "cmp14 STORM2 hbm 12% warm 8.4s".
+    const pf = h.profile;
+    if(pf && pf.enabled){
+      let t = `cmp${pf.compiles_total||0}`;
+      if(pf.storms_total) t += ` STORM${pf.storms_total}`;
+      const dm = pf.device_memory;
+      if(dm && typeof dm.headroom_frac === 'number')
+        t += ` hbm ${Math.round(dm.headroom_frac*100)}%`;
+      const cs = pf.cold_start;
+      if(cs && cs.complete) t += ` warm ${cs.total_s.toFixed(1)}s`;
+      parts.push(t);
+    }
     if(h.kv_cache === 'int8') parts.push('kv8');
     if(h.quantize) parts.push(h.quantize);  // outer esc covers it
     return esc(parts.join(', '));

@@ -143,6 +143,39 @@ _INCIDENT_BUNDLES = Gauge(
     '(engine_failure | sigterm | watchdog | probe_deadline | '
     'slo_breach | manual).',
     ['trigger'], registry=SERVING_REGISTRY)
+# Runtime profiler (observability/profiler.py): compile ledger, device
+# memory, cold-start phases. Gauges mirroring the profiler's own
+# cumulative ledgers (restart legitimately resets them), refreshed at
+# scrape time from the in-process profiler state; absent/cleared while
+# SKYTPU_PROFILE is off.
+_COMPILE_TOTAL = Gauge(
+    'skytpu_compile_total',
+    'Cumulative XLA compiles per profiled jit program (compile '
+    'ledger). Nonzero AFTER warm-up under a fixed-shape mix means the '
+    'compile-once-per-shape contract is being violated.',
+    ['program'], registry=SERVING_REGISTRY)
+_COMPILE_SECONDS = Gauge(
+    'skytpu_compile_seconds',
+    'Cumulative trace+lower+compile wall seconds per profiled jit '
+    'program.', ['program'], registry=SERVING_REGISTRY)
+_RECOMPILE_STORMS = Gauge(
+    'skytpu_recompile_storm_total',
+    'Cumulative compiles past a program\'s declared shape budget '
+    '(recompile storms), by program; feeds the serve.recompile_storm '
+    'SLO rule.', ['program'], registry=SERVING_REGISTRY)
+_DEVICE_MEM = Gauge(
+    'skytpu_device_mem_bytes',
+    'Device-memory accounting by kind: allocator in_use/peak/limit/'
+    'headroom plus the engine\'s logical registrations '
+    '(logical_weights, logical_kv_cache, ...) and the unattributed '
+    'residue (leak/fragmentation signal).',
+    ['kind'], registry=SERVING_REGISTRY)
+_WARMUP_SECONDS = Gauge(
+    'skytpu_replica_warmup_seconds',
+    'Cold-start phase-ledger durations on this replica by phase '
+    '(imports | backend_init.* | weights_load | jit_warmup | ready | '
+    'first_token); phases telescope and sum to the observed process '
+    'wall-clock.', ['phase'], registry=SERVING_REGISTRY)
 
 
 def _refresh_incident_gauge() -> None:
@@ -150,6 +183,38 @@ def _refresh_incident_gauge() -> None:
     _INCIDENT_BUNDLES.clear()
     for trigger, n in blackbox.dump_counts().items():
         _INCIDENT_BUNDLES.labels(trigger=trigger).set(n)
+
+
+def _refresh_profiler_gauges() -> None:
+    """Mirror the in-process runtime profiler (observability/
+    profiler.py) into the compile/memory/warm-up gauges at scrape
+    time. Cleared (series absent) while SKYTPU_PROFILE is off, so the
+    scrape stays byte-stable across the flag."""
+    from skypilot_tpu.observability import profiler
+    for gauge in (_COMPILE_TOTAL, _COMPILE_SECONDS, _RECOMPILE_STORMS,
+                  _DEVICE_MEM, _WARMUP_SECONDS):
+        gauge.clear()
+    if not profiler.enabled():
+        return
+    snap = profiler.snapshot()
+    for name, st in (snap.get('compile') or {}).items():
+        _COMPILE_TOTAL.labels(program=name).set(st['compiles'])
+        _COMPILE_SECONDS.labels(program=name).set(
+            st['compile_ms'] / 1000.0)
+        _RECOMPILE_STORMS.labels(program=name).set(st['storms'])
+    mem = snap.get('device_memory') or {}
+    for kind, key in (('in_use', 'bytes_in_use'),
+                      ('peak', 'peak_bytes'),
+                      ('limit', 'bytes_limit'),
+                      ('headroom', 'headroom_bytes'),
+                      ('unattributed', 'unattributed_bytes')):
+        if isinstance(mem.get(key), (int, float)):
+            _DEVICE_MEM.labels(kind=kind).set(mem[key])
+    for kind, nbytes in (mem.get('logical') or {}).items():
+        _DEVICE_MEM.labels(kind=f'logical_{kind}').set(nbytes)
+    for phase, secs in ((snap.get('cold_start') or {}).get('phases')
+                        or {}).items():
+        _WARMUP_SECONDS.labels(phase=phase).set(secs)
 
 
 # SLO engine (observability/slo.py): alerts currently FIRING, by rule
@@ -286,6 +351,19 @@ _LB_AFFINITY_FALLBACK = Gauge(
     'fell back to least-load because the match sat past its detour '
     'credit (the hot-prefix saturation spill), by service.',
     ['service'], registry=REGISTRY)
+# Cold-start budget (ROADMAP item 2): provision→first-token seconds
+# per replica, rolled up by replica_managers.py at each replica's
+# FIRST dark→READY transition (launch issued → readiness probe
+# succeeded; the replica-local skytpu_replica_warmup_seconds ledger
+# breaks the in-process share of it down by phase). Pushed like the
+# LB affinity counters and rebuilt at scrape for live services only.
+_PROVISION_TO_FIRST_TOKEN = Gauge(
+    'skytpu_provision_to_first_token_s',
+    'Seconds from replica launch to its first successful readiness '
+    'probe (provision→first-token cold-start budget), per replica; '
+    'set once at the dark→READY transition.',
+    ['service', 'replica'], registry=REGISTRY)
+
 _FLEET_PREFIX_HIT_RATE = Gauge(
     'skytpu_fleet_prefix_hit_rate',
     'Fleet-wide block-share prefix hit rate: sum(hits) / sum(hits + '
@@ -299,6 +377,8 @@ _FLEET_PREFIX_HIT_RATE = Gauge(
 # service's series vanish instead of exporting its final counts
 # forever (every other serve gauge is clear-and-rebuilt the same way).
 _LB_AFFINITY_LAST: Dict[str, Any] = {}
+# (service, replica) -> seconds; same live-services-only rebuild.
+_P2FT_LAST: Dict[Any, float] = {}
 
 
 def set_lb_affinity(service: str, routed: float,
@@ -308,6 +388,15 @@ def set_lb_affinity(service: str, routed: float,
     _LB_AFFINITY_LAST[service] = (float(routed), float(fallbacks))
     _LB_AFFINITY_ROUTED.labels(service=service).set(routed)
     _LB_AFFINITY_FALLBACK.labels(service=service).set(fallbacks)
+
+
+def set_provision_to_first_token(service: str, replica: Any,
+                                 seconds: float) -> None:
+    """Replica-manager-pushed cold-start rollup: one observation per
+    replica lifetime, at its first dark→READY transition."""
+    _P2FT_LAST[(service, str(replica))] = float(seconds)
+    _PROVISION_TO_FIRST_TOKEN.labels(
+        service=service, replica=str(replica)).set(seconds)
 
 
 def _refresh_goodput_gauges(clusters, jobs) -> None:
@@ -387,7 +476,8 @@ def _refresh_gauges() -> None:
 
     for gauge in (_SERVE_QOS_DEPTH, _SERVE_QOS_SHED, _SERVE_QOS_EVICTED,
                   _SERVE_QOS_WAIT_P95, _FLEET_PREFIX_HIT_RATE,
-                  _LB_AFFINITY_ROUTED, _LB_AFFINITY_FALLBACK):
+                  _LB_AFFINITY_ROUTED, _LB_AFFINITY_FALLBACK,
+                  _PROVISION_TO_FIRST_TOKEN):
         gauge.clear()
     live_services = {s['name'] for s in services
                      if s['status'].value not in ('SHUTDOWN', 'FAILED')}
@@ -398,6 +488,7 @@ def _refresh_gauges() -> None:
             routed, fallbacks = _LB_AFFINITY_LAST[name]
             _LB_AFFINITY_ROUTED.labels(service=name).set(routed)
             _LB_AFFINITY_FALLBACK.labels(service=name).set(fallbacks)
+    live_replicas = set()  # (service, replica_id) seen this scrape
     for svc in services:
         # Fleet prefix hit rate: aggregate the replicas' block-share
         # counters BEFORE dividing — averaging per-replica rates would
@@ -406,6 +497,7 @@ def _refresh_gauges() -> None:
         fleet_hits = fleet_misses = 0.0
         fleet_reported = False
         for rep in serve_state.list_replicas(svc['name']):
+            live_replicas.add((svc['name'], str(rep['replica_id'])))
             health = serve_state.parse_health(rep.get('health')) or {}
             share = (health.get('engine') or {}).get('prefix_share') \
                 if isinstance(health.get('engine'), dict) else None
@@ -435,12 +527,24 @@ def _refresh_gauges() -> None:
         if fleet_reported:
             _FLEET_PREFIX_HIT_RATE.labels(service=svc['name']).set(
                 fleet_hits / max(fleet_hits + fleet_misses, 1.0))
+    # Cold-start rollups survive only as long as their replica: a
+    # replaced/retired replica's series vanishes with it (per-replica,
+    # not merely per-service — an autoscaled service churning spot
+    # replicas for weeks must not accumulate unbounded label
+    # cardinality; same stale-stats discipline as replica_managers).
+    for key in list(_P2FT_LAST):
+        if key not in live_replicas:
+            del _P2FT_LAST[key]
+        else:
+            _PROVISION_TO_FIRST_TOKEN.labels(
+                service=key[0], replica=key[1]).set(_P2FT_LAST[key])
 
 
 def render() -> bytes:
     _refresh_gauges()
     _refresh_incident_gauge()
     _refresh_alert_gauge()
+    _refresh_profiler_gauges()
     return generate_latest(REGISTRY) + generate_latest(SERVING_REGISTRY)
 
 
@@ -452,6 +556,7 @@ def render_serving(engine: Optional[Dict[str, Any]] = None,
     already maintains for /health. ``disagg`` is the server-level
     KV-handoff accounting (serve/llm_server.py disagg_stats)."""
     _refresh_incident_gauge()
+    _refresh_profiler_gauges()
     if disagg:
         for direction, prefix in (('export', 'export'),
                                   ('import', 'import')):

@@ -188,6 +188,20 @@ async def debug_blackbox(request: web.Request) -> web.Response:
     return web.json_response(payload)
 
 
+@routes.get('/debug/profile')
+async def debug_profile(request: web.Request) -> web.Response:
+    """Runtime-profiler state of the API-server process (token-gated
+    by the auth middleware like every non-exempt path): compile
+    ledger, device-memory accounting, cold-start phases —
+    observability/profiler.py. ``?programs=1`` appends the PROGRAMS
+    catalog; ``?mem=1`` forces a fresh device-memory sample (allocator
+    queries — off the event loop like the other /debug handlers)."""
+    from skypilot_tpu.observability import profiler
+    payload = await asyncio.get_event_loop().run_in_executor(
+        None, profiler.debug_payload, dict(request.query))
+    return web.json_response(payload)
+
+
 @routes.get('/api/v1/alerts')
 async def api_alerts(request: web.Request) -> web.Response:
     """Current SLO alerts (observability/slo.py): active

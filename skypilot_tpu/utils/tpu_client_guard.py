@@ -153,7 +153,17 @@ def deferred_signals(
 def init_backend_guarded(platform: str | None = None):
     """``jax.devices()`` with shutdown signals deferred until the PJRT
     client exists. Returns the device list. Idempotent: once the backend
-    is cached this is instant and the guard window is ~zero."""
+    is cached this is instant and the guard window is ~zero.
+
+    Backend init is the leg the r02 ``tpu_unreachable`` hang lives in,
+    so the cold-start ledger (observability/profiler.py) splits it
+    here into its two sub-phases: PLUGIN DISCOVERY (PJRT plugin
+    registration + client construction — the single-claimant tunnel
+    handshake) and DEVICE ENUMERATION (listing the constructed
+    backend's chips). The tpu_doctor probe child marks the same
+    boundaries, so a hang names its exact sub-phase in the bench
+    artifact and the probe_deadline bundle."""
+    from skypilot_tpu.observability import profiler
     with deferred_signals():
         import jax
         if platform:
@@ -161,4 +171,17 @@ def init_backend_guarded(platform: str | None = None):
         else:
             from skypilot_tpu.utils.jax_env import apply_jax_platform_env
             apply_jax_platform_env()
-        return jax.devices()
+        try:
+            # Plugin discovery + PJRT client construction, separated
+            # from enumeration when the extension API exists (jax
+            # 0.4.x); on older jax the devices() call below covers
+            # both and the sub-phase marks collapse to one crossing.
+            from jax.extend import backend as jax_backend
+            jax_backend.get_backend()
+            profiler.mark('backend_init.plugin_discovery')
+        except Exception:  # noqa: BLE001 — enumeration still inits all
+            pass
+        devices = jax.devices()
+        profiler.mark('backend_init.plugin_discovery')  # idempotent
+        profiler.mark('backend_init.device_enumeration')
+        return devices

@@ -362,10 +362,31 @@ import jax
 if os.environ.get('JAX_PLATFORMS'):
     jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
 phase('jax-imported')
+# Cold-start-ledger sub-phase markers (observability/profiler.py
+# COLD_START_PHASES): backend init splits into PLUGIN DISCOVERY (PJRT
+# plugin registration + client construction — the single-claimant
+# tunnel handshake, where the r02 hang lives) and DEVICE ENUMERATION,
+# so a stuck-phase abort names the exact init leg in the bench
+# artifact and the probe_deadline bundle instead of one opaque
+# "hung in backend init".
+try:
+    from skypilot_tpu.observability import profiler as _prof
+except Exception:
+    _prof = None
 from skypilot_tpu.utils.tpu_client_guard import deferred_signals
 with deferred_signals():
-    # backend init: plugin discovery + device enumeration
+    try:
+        from jax.extend import backend as _jxb
+        _jxb.get_backend()
+        phase('backend-init:plugin-discovery')
+        if _prof is not None:
+            _prof.mark('backend_init.plugin_discovery')
+    except Exception:
+        pass  # older jax: devices() below covers both legs
     devs = jax.devices()
+if _prof is not None:
+    _prof.mark('backend_init.plugin_discovery')
+    _prof.mark('backend_init.device_enumeration')
 init_done.set()
 phase('devices-enumerated:%d:%s' % (len(devs), devs[0].platform))
 import jax.numpy as jnp
@@ -377,8 +398,12 @@ phase('first-compile-done:%g' % r)
 _PHASE_MEANING = {
     None: 'subprocess never started (python/env fault)',
     'python-started': 'hung importing jax',
-    'jax-imported': 'hung in backend init (plugin discovery / device '
-                    'enumeration — the single-claimant tunnel leg)',
+    'jax-imported': 'hung in backend init: PLUGIN DISCOVERY / PJRT '
+                    'client construction (the single-claimant tunnel '
+                    'handshake — the r02 wedge leg)',
+    'backend-init': 'hung in backend init: DEVICE ENUMERATION (the '
+                    'PJRT client constructed, so the tunnel answered '
+                    '— listing its chips hung)',
     'devices-enumerated': 'hung in first XLA compile/execute',
     'first-compile-done': 'completed',
     'hard-deadline-abort': 'child self-aborted at its hard deadline '
@@ -511,8 +536,13 @@ def probe_backend(timeout_s: float = 90.0) -> Dict[str, Any]:
     # The child's incident-bundle spool is its scratch dir: a
     # deadline-aborting child dumps ring + stacks there, and the report
     # below carries the bundle home before the scratch dir is cleaned.
+    # SKYTPU_PROFILE=1: the child adopts the cold-start phase ledger
+    # (observability/profiler.py), so a probe_deadline bundle carries
+    # the crossed backend-init sub-phases in its profile snapshot —
+    # profiling a throwaway probe child costs nothing, and the operator
+    # should not have to pre-set the flag to get init forensics.
     child_env = dict(os.environ, SKYTPU_PKG_ROOT=_PKG_ROOT,
-                     SKYTPU_BLACKBOX_DIR=td)
+                     SKYTPU_BLACKBOX_DIR=td, SKYTPU_PROFILE='1')
     with open(err_path, 'wb') as err_f:
         proc = subprocess.Popen(
             [sys.executable, '-c', _PROBE_CHILD, phases_path,

@@ -538,6 +538,112 @@ def test_multiline_comment_block_reason_parses(tmp_path):
 # -- driver / CI gate --------------------------------------------------------
 
 
+# -- jit-program (compile-ledger registry cross-check) -----------------------
+
+
+def test_bare_jax_jit_flagged_and_hatch_suppresses(tmp_path):
+    from skylint.checkers import jit_programs as jit_mod
+    sf = _sf(tmp_path, '''
+        import jax
+
+        def _impl(x):
+            return x
+
+        _f = jax.jit(_impl)
+        ''')
+    findings = jit_mod.JitPrograms().check_file(sf)
+    assert _rules(findings) == ['jit-program']
+    assert 'profiled_jit' in findings[0].message
+    ok = _sf(tmp_path, '''
+        import jax
+
+        def _impl(x):
+            return x
+
+        # skylint: allow-jit(startup-time init, not a serving program)
+        _f = jax.jit(_impl)
+        ''', name='hatched.py')
+    assert jit_mod.JitPrograms().check_file(ok) == []
+
+
+def test_profiled_jit_typo_gets_did_you_mean(tmp_path):
+    from skylint.checkers import jit_programs as jit_mod
+    sf = _sf(tmp_path, '''
+        from skypilot_tpu.observability.profiler import profiled_jit
+
+        def _impl(x):
+            return x
+
+        _f = profiled_jit('engine.chunks', _impl)
+        ''')
+    findings = jit_mod.JitPrograms().check_file(sf)
+    assert _rules(findings) == ['jit-program']
+    assert "'engine.chunk'" in findings[0].message  # did-you-mean
+    ok = _sf(tmp_path, '''
+        from skypilot_tpu.observability.profiler import profiled_jit
+
+        def _impl(x):
+            return x
+
+        _f = profiled_jit('engine.chunk', _impl)
+        ''', name='ok.py')
+    assert jit_mod.JitPrograms().check_file(ok) == []
+
+
+def test_profiled_jit_dynamic_name_flagged(tmp_path):
+    from skylint.checkers import jit_programs as jit_mod
+    sf = _sf(tmp_path, '''
+        from skypilot_tpu.observability.profiler import profiled_jit
+
+        NAME = 'engine.chunk'
+
+        def _impl(x):
+            return x
+
+        _f = profiled_jit(NAME, _impl)
+        ''')
+    findings = jit_mod.JitPrograms().check_file(sf)
+    assert _rules(findings) == ['jit-program']
+    assert 'string literal' in findings[0].message
+
+
+def test_jit_dead_program_detected(tmp_path):
+    from skylint.checkers import jit_programs as jit_mod
+    reg = tmp_path / 'skypilot_tpu' / 'observability' / 'profiler.py'
+    reg.parent.mkdir(parents=True)
+    reg.write_text(textwrap.dedent('''
+        def Program(name, doc, budget):
+            return (name, doc, budget)
+        PROGRAMS = (
+            Program('live.prog', 'wrapped below', budget=2),
+            Program('ghost.prog', 'declared, never wrapped', budget=2),
+        )
+        '''), encoding='utf-8')
+    user = _sf(tmp_path, '''
+        from skypilot_tpu.observability.profiler import profiled_jit
+
+        def _impl(x):
+            return x
+
+        _f = profiled_jit('live.prog', _impl)
+        ''', name='user.py')
+    checker = jit_mod.JitPrograms()
+    checker._load_registry(tmp_path)  # anchor at the fixture tree
+    findings = checker.check_tree([user], tmp_path)
+    assert _rules(findings) == ['jit-program']
+    assert 'ghost.prog' in findings[0].message
+    assert 'dead program' in findings[0].message
+
+
+def test_jit_program_clean_on_real_tree():
+    from skylint.checkers import jit_programs as jit_mod
+    files = skylint.load_files()
+    checker = jit_mod.JitPrograms()
+    findings = [f for sf in files for f in checker.check_file(sf)]
+    findings += checker.check_tree(files, skylint.ROOT)
+    assert findings == [], '\n'.join(str(f) for f in findings)
+
+
 def test_cli_exit_codes(tmp_path):
     from skylint import cli
     bad = tmp_path / 'bad.py'

@@ -82,6 +82,61 @@ def test_burn_lower_bound_and_idle_gating():
     assert burns[KEY]['fast_frac'] == 1.0
 
 
+def test_profiler_rules_registered():
+    """PR 13 (runtime profiler): the two profiler-fed rules are in the
+    registry with live extractors, and the health-field vocabulary
+    declares what they read — the registration contract the alert-rule
+    lint also enforces, asserted here so a refactor cannot silently
+    drop the rules between lint runs."""
+    assert {'serve.recompile_storm', 'serve.hbm_headroom'} \
+        <= slo.RULE_NAMES
+    storm = next(r for r in slo.RULES
+                 if r.name == 'serve.recompile_storm')
+    head = next(r for r in slo.RULES if r.name == 'serve.hbm_headroom')
+    assert storm.severity == 'warn' and storm.signal in slo.SIGNALS
+    assert head.severity == 'warn' and head.signal in slo.SIGNALS
+    assert {'replica.recompile_storms', 'replica.hbm_headroom_frac'} \
+        <= slo.HEALTH_FIELD_NAMES
+
+
+def test_profile_block_feeds_signal_fields():
+    fields = slo.replica_signal_fields({
+        'profile': {'enabled': True, 'storms_total': 3,
+                    'device_memory': {'headroom_frac': 0.07}}})
+    assert fields['recompile_storms'] == 3.0
+    assert fields['hbm_headroom_frac'] == 0.07
+    # Absent block (SKYTPU_PROFILE off): no observation, never 0.0.
+    bare = slo.replica_signal_fields({})
+    assert bare['recompile_storms'] is None
+    assert bare['hbm_headroom_frac'] is None
+
+
+def test_recompile_storm_rule_breaches_on_delta_only():
+    rule = next(r for r in slo.RULES
+                if r.name == 'serve.recompile_storm')
+    # A historical storm count that stays FLAT never breaches (delta
+    # 0); new storms between samples do.
+    flat = [_sample(100 + i, 0, recompile_storms=5.0)
+            for i in range(6)]
+    burns = slo.burn_fractions(rule, flat, now=105.0)
+    assert burns[KEY]['fast_frac'] == 0.0
+    rising = [_sample(200 + i, 0, recompile_storms=float(i))
+              for i in range(6)]
+    burns = slo.burn_fractions(rule, rising, now=205.0)
+    assert burns[KEY]['fast_frac'] == 1.0
+
+
+def test_hbm_headroom_rule_breaches_below_threshold():
+    rule = next(r for r in slo.RULES if r.name == 'serve.hbm_headroom')
+    low = [_sample(100 + i, 0, hbm_headroom_frac=0.05)
+           for i in range(6)]
+    burns = slo.burn_fractions(rule, low, now=105.0)
+    assert burns[KEY]['fast_frac'] == 1.0
+    # CPU replica / profiler off: the field is absent -> no series.
+    absent = [_sample(200 + i, 0) for i in range(6)]
+    assert slo.burn_fractions(rule, absent, now=205.0) == {}
+
+
 def test_counter_reset_yields_no_observation():
     rule = next(r for r in slo.RULES if r.name == 'serve.shed_rate')
     samples = [_sample(100, 0, shed_total=50.0, evicted_total=20.0),

@@ -1692,7 +1692,249 @@ def slo_probe() -> dict:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def profile_probe() -> dict:
+    """Runtime-profiler gate (observability/profiler.py), five legs
+    over real OS-process replicas on localhost HTTP:
+
+    (a) **cold-start ledger** — an SKYTPU_PROFILE=1 replica's first
+        /health carries a COMPLETE phase ledger (imports → backend
+        init sub-phases → weights_load → jit_warmup → ready) whose
+        telescoping phases sum to the observed spawn→READY wall-clock
+        within 5% (+1 s poll/exec slack floor);
+    (b) **byte parity** — greedy output from the profiled replica is
+        byte-identical to an SKYTPU_PROFILE=0 replica, whose /health
+        carries no profile block;
+    (c) **zero steady-state compiles** — after a fixed-shape warm-up,
+        a fixed-shape load leg's compile-ledger WINDOW delta (the
+        loadgen aggregation helpers) is ZERO compiles, zero storms:
+        the compile-once-per-shape contract, machine-gated;
+    (d) **recompile-storm detection** — a churn replica with
+        SKYTPU_PROFILE_BUDGETS='generate.prefill=1' takes prompts in
+        four distinct power-of-two buckets: the storm counter trips,
+        the profiler.storm event lands on the ring, the scaled
+        serve.recompile_storm SLO rule transitions pending→firing
+        within two evaluation ticks, and a /debug/blackbox dump-now
+        bundle freezes the profiler snapshot with the storms;
+    (e) **/debug/profile round trip** — the full ledger + PROGRAMS
+        catalog over HTTP.
+    """
+    import dataclasses
+    import shutil
+    import tempfile
+
+    import requests as requests_lib
+
+    from skypilot_tpu.observability import slo
+    from skypilot_tpu.serve import loadgen
+    from skypilot_tpu.utils import common_utils
+
+    max_len = 256
+    workdir = tempfile.mkdtemp(prefix='skytpu-profile-')
+    specs = {
+        'on': {'SKYTPU_PROFILE': '1'},
+        'off': {'SKYTPU_PROFILE': '0'},
+        'churn': {'SKYTPU_PROFILE': '1',
+                  'SKYTPU_PROFILE_BUDGETS': 'generate.prefill=1'},
+    }
+    ports = {t: common_utils.find_free_port(25600 + 40 * i)
+             for i, t in enumerate(specs)}
+    spawn_t = {}
+    procs = {}
+    for t, env in specs.items():
+        spawn_t[t] = time.time()
+        procs[t] = _spawn_replica('colocated', ports[t], workdir,
+                                  max_len, tag=t, extra_env=env)
+    eps = {t: f'127.0.0.1:{port}' for t, port in ports.items()}
+
+    def row(n, salt):
+        return [(5 * i + 13 * salt) % 240 + 1 for i in range(n)]
+
+    def health(tag):
+        return requests_lib.get(f'http://{eps[tag]}/health',
+                                timeout=30).json()
+
+    try:
+        # --- (a) cold-start ledger vs observed dark→READY wall ----------
+        first_health = {}
+        ready_wall = {}
+        deadline = time.time() + 300
+        pending = set(specs)
+        while pending:
+            for tag in sorted(pending):
+                if procs[tag].poll() is not None:
+                    raise RuntimeError(
+                        f'{tag} replica exited at startup; see '
+                        f'{workdir}/{tag}.log')
+                try:
+                    r = requests_lib.get(f'http://{eps[tag]}/health',
+                                         timeout=5)
+                    r.raise_for_status()
+                except requests_lib.RequestException:
+                    if time.time() > deadline:
+                        raise RuntimeError(
+                            f'{tag} replica never became healthy')
+                    continue
+                ready_wall[tag] = time.time() - spawn_t[tag]
+                first_health[tag] = r.json()
+                pending.discard(tag)
+            time.sleep(0.1)
+        cold = first_health['on']['profile']['cold_start']
+        assert cold['complete'], cold
+        for phase in ('imports', 'backend_init.plugin_discovery',
+                      'backend_init.device_enumeration', 'weights_load',
+                      'jit_warmup', 'ready'):
+            assert phase in cold['phases'], (phase, cold)
+        assert sum(cold['phases'].values()) == \
+            pytest_approx(cold['total_s'])
+        wall = ready_wall['on']
+        gap = wall - cold['total_s']
+        # The ledger anchors at the child's /proc birth tick (10 ms
+        # granularity, uptime-clock estimated), so it can nose a few
+        # ms PAST the parent-observed wall — tolerate that jitter, and
+        # cap the positive side at 5% (+1 s poll/exec slack floor).
+        assert -0.25 <= gap <= max(0.05 * wall, 1.0), (wall, cold)
+        assert 'profile' not in first_health['off'], \
+            'SKYTPU_PROFILE=0 health must omit the profile block'
+
+        # --- (b) greedy byte parity, profiler on vs off -----------------
+        for n, max_new, salt in ((12, 16, 1), (60, 24, 2)):
+            payload = {'tokens': [row(n, salt)],
+                       'max_new_tokens': max_new}
+            on = requests_lib.post(f'http://{eps["on"]}/generate',
+                                   json=payload, timeout=600)
+            off = requests_lib.post(f'http://{eps["off"]}/generate',
+                                    json=payload, timeout=600)
+            assert on.status_code == off.status_code == 200, \
+                (on.text, off.text)
+            assert on.json() == off.json(), (n, max_new)
+
+        # --- (c) zero steady-state compiles under a fixed-shape mix -----
+        def fixed_shape(salt):
+            requests_lib.post(
+                f'http://{eps["on"]}/generate',
+                json={'tokens': [row(24, salt)], 'max_new_tokens': 8},
+                timeout=600).raise_for_status()
+
+        for salt in (10, 11, 12):  # warm-up: every program compiles
+            fixed_shape(salt)
+        before = loadgen.aggregate_profile_healths(
+            {eps['on']: health('on')})
+        assert before['compiles'] > 0, \
+            'warm-up compiled nothing — is the ledger wired?'
+        for salt in (13, 14, 15, 16, 17):  # steady state: same shapes
+            fixed_shape(salt)
+        after = loadgen.aggregate_profile_healths(
+            {eps['on']: health('on')})
+        window = loadgen.profile_window_delta(before, after)
+        assert window['compiles'] == 0, (
+            'steady-state compiles under a fixed-shape mix — the '
+            'compile-once-per-shape contract broke', window, after)
+        assert window['storms'] == 0 and after['storms'] == 0, after
+
+        # --- (d) shape churn → storms + SLO warn + bundle snapshot ------
+        qrule = dataclasses.replace(
+            next(r for r in slo.RULES
+                 if r.name == 'serve.recompile_storm'),
+            fast_s=30.0, slow_s=300.0, fast_burn=0.3, slow_burn=0.05)
+        os.environ['SKYTPU_SLO'] = '1'
+        engine = slo.SloEngine(
+            state_dir=os.path.join(workdir, 'slo-state'), rules=[qrule])
+        samples = []
+
+        def sample():
+            samples.append({
+                'ts': time.time(),
+                'serve_replica_health': {
+                    'probe/churn': slo.replica_signal_fields(
+                        health('churn'))}})
+
+        sample()
+        pending_tick = firing_tick = None
+        tick_no = 0
+        # Distinct power-of-two prompt buckets: 32/64/128/256 — four
+        # generate.prefill shapes against a declared budget of ONE.
+        # DISTINCT salts per request: same-salt rows share their head,
+        # and the block-share trie would serve requests 2..4 through
+        # paged.prefill_shared instead of recompiling the full prefill
+        # (exactly the mitigation the storm rule exists to confirm is
+        # absent under genuine churn).
+        for salt, n in ((21, 20), (22, 40), (23, 80), (24, 150)):
+            requests_lib.post(
+                f'http://{eps["churn"]}/generate',
+                json={'tokens': [row(n, salt)], 'max_new_tokens': 4},
+                timeout=600).raise_for_status()
+            sample()
+            tick_no += 1
+            for tr in engine.tick(list(samples)):
+                if tr['transition'] == 'pending' and pending_tick is None:
+                    pending_tick = tick_no
+                if tr['transition'] == 'firing' and firing_tick is None:
+                    firing_tick = tick_no
+        churn_prof = health('churn')['profile']
+        storms = churn_prof['storms_total']
+        assert storms >= 1, churn_prof
+        assert churn_prof['compile']['generate.prefill']['storms'] \
+            >= 1, churn_prof
+        assert firing_tick is not None and pending_tick is not None \
+            and firing_tick - pending_tick <= 1, \
+            (pending_tick, firing_tick, samples)
+        alert = engine.firing()[0]
+        assert alert['rule'] == 'serve.recompile_storm' and \
+            alert['target'] == 'probe/churn', alert
+        bundle = requests_lib.get(
+            f'http://{eps["churn"]}/debug/blackbox',
+            params={'dump': '1', 'reason': 'profile probe storm leg'},
+            timeout=60).json()['bundle']
+        assert bundle['profile']['storms_total'] >= 1, \
+            'profiler snapshot missing from the incident bundle'
+        ring_storms = [e for e in bundle['events']
+                       if e['name'] == 'profiler.storm']
+        assert ring_storms and \
+            ring_storms[-1]['attrs']['program'] == 'generate.prefill'
+
+        # --- (e) /debug/profile round trip ------------------------------
+        dbg = requests_lib.get(
+            f'http://{eps["on"]}/debug/profile',
+            params={'programs': '1'}, timeout=60).json()
+        assert dbg['enabled'] is True
+        assert dbg['compile']['generate.prefill']['compiles'] >= 1
+        assert {p['name'] for p in dbg['programs']} >= {
+            'generate.prefill', 'engine.chunk', 'paged.insert'}
+        return {
+            'cold_start_wall_s': round(wall, 2),
+            'cold_start_ledger_s': cold['total_s'],
+            'cold_start_gap_s': round(gap, 3),
+            'parity': 'byte-identical (SKYTPU_PROFILE=1 vs =0)',
+            'warmup_compiles': before['compiles'],
+            'steady_state_compiles': window['compiles'],
+            'churn_storms': storms,
+            'slo_pending_tick': pending_tick,
+            'slo_firing_tick': firing_tick,
+        }
+    finally:
+        os.environ.pop('SKYTPU_SLO', None)
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def pytest_approx(x, rel=1e-3):
+    """Tolerant float compare without importing pytest in the probe."""
+    class _A:
+        def __eq__(self, other):
+            return abs(other - x) <= max(abs(x) * rel, 1e-3)
+    return _A()
+
+
 def main():
+    if '--profile' in sys.argv:
+        # CPU-only by design (same rationale as --smoke): never touch
+        # or wait on a chip in CI.
+        jax.config.update('jax_platforms', 'cpu')
+        print(json.dumps({'profile_smoke': 'ok', **profile_probe()}),
+              flush=True)
+        return
     if '--affinity' in sys.argv:
         # CPU-only by design (same rationale as --smoke): never touch
         # or wait on a chip in CI.

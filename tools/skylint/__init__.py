@@ -33,6 +33,8 @@ env-flag     ``# skylint: allow-env(reason)``   suppress one env literal
 metric-name  ``# skylint: allow-metric(r)``     suppress one metric ref
 event-name   ``# skylint: allow-event(r)``      suppress one black-box
                                                event ref
+jit-program  ``# skylint: allow-jit(r)``        suppress one bare
+                                               jax.jit call site
 == ======================================= ==============================
 
 Every suppression MUST carry a non-empty human-readable reason; a bare
@@ -63,7 +65,7 @@ _ITEM_RE = re.compile(
 #: directives that suppress a finding and therefore need a reason
 REASON_REQUIRED = frozenset(
     {'locked', 'allow-raise', 'allow-host-sync', 'allow-env',
-     'allow-metric', 'allow-event'})
+     'allow-metric', 'allow-event', 'allow-jit'})
 #: marker directives (no argument)
 MARKERS = frozenset({'engine-thread', 'hot-path'})
 #: value directives (name=value)

@@ -1,0 +1,158 @@
+"""jit-program registry cross-check.
+
+The serving engine's performance contract is COMPILE ONCE PER SHAPE
+(models/engine.py docstring) — and since the runtime profiler
+(``skypilot_tpu/observability/profiler.py``) that contract is
+machine-observable: every jit program registers by name through
+``profiled_jit`` against the bounded :data:`PROGRAMS` registry, with a
+declared shape budget and a recompile-storm detector. A bare
+``jax.jit`` call site would be an unledgered program — invisible to
+the compile ledger, the ``skytpu_compile_total`` gauges, and the
+``perf_probe --profile`` zero-steady-state-compiles gate. Checks:
+
+* **no bare jits** — every ``jax.jit(...)`` call site outside
+  profiler.py itself must route through ``profiled_jit(name, fn,
+  ...)``. Escape hatch: ``# skylint: allow-jit(reason)`` — reserved
+  for startup-time / training programs outside the serving contract
+  (sharded weight init, the train step, collective microbenches);
+* **typo-proofing** — every ``profiled_jit('name', ...)`` first
+  argument must be a string literal declared in ``PROGRAMS``
+  (did-you-mean on near-misses; a dynamic name defeats the registry
+  and is itself a finding);
+* **dead-program detection** — a declared program no call site wraps
+  is ledger vocabulary the docs promise but no code feeds; delete the
+  declaration.
+
+The registry is anchored at skylint.ROOT (this checkout) like the
+env-flag registry, so fixture files in a tmp dir still cross-check
+against the real PROGRAMS table."""
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import Dict, List, Optional, Sequence
+
+from skylint import Checker, Finding, SourceFile, register
+from skylint.checkers.event_names import _closest
+
+PROFILER_REL = 'skypilot_tpu/observability/profiler.py'
+
+
+@register
+class JitPrograms(Checker):
+
+    name = 'jit-program'
+
+    def __init__(self):
+        self._registry: Optional[Dict[str, int]] = None
+        self._registry_error: Optional[str] = None
+
+    def _load_registry(self, root: pathlib.Path) -> Dict[str, int]:
+        if self._registry is not None:
+            return self._registry
+        self._registry = {}
+        path = root / PROFILER_REL
+        if not path.is_file():
+            self._registry_error = f'{PROFILER_REL} is missing'
+            return self._registry
+        try:
+            tree = ast.parse(path.read_text(encoding='utf-8'),
+                             filename=str(path))
+        except SyntaxError as e:
+            self._registry_error = f'{PROFILER_REL}:{e.lineno}: {e.msg}'
+            return self._registry
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id == 'Program' and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                self._registry.setdefault(node.args[0].value,
+                                          node.args[0].lineno)
+        return self._registry
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        if sf.tree is None or sf.rel == PROFILER_REL:
+            return []
+        from skylint import ROOT
+        registry = self._load_registry(ROOT)
+        out: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_bare_jax_jit(node.func):
+                if sf.suppression(node.lineno, 'allow-jit'):
+                    continue
+                out.append(Finding(
+                    sf.rel, node.lineno, self.name,
+                    'bare jax.jit call site — an unledgered program is '
+                    'invisible to the compile ledger; route it through '
+                    'profiler.profiled_jit(name, fn, ...) or annotate '
+                    '# skylint: allow-jit(reason)'))
+                continue
+            if not _is_profiled_jit(node.func):
+                continue
+            if sf.suppression(node.lineno, 'allow-jit'):
+                continue  # negative-path test fixtures
+            if not node.args or not (
+                    isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                out.append(Finding(
+                    sf.rel, node.lineno, self.name,
+                    'profiled_jit program name must be a string '
+                    'literal (a dynamic name defeats the PROGRAMS '
+                    'registry)'))
+                continue
+            pname = node.args[0].value
+            if self._registry_error or pname in registry:
+                continue
+            hint = _closest(pname, registry)
+            out.append(Finding(
+                sf.rel, node.args[0].lineno, self.name,
+                f'program {pname!r} is not declared in '
+                f'{PROFILER_REL} PROGRAMS'
+                + (f' — did you mean {hint!r}?' if hint else '')))
+        return out
+
+    def check_tree(self, files: Sequence[SourceFile],
+                   root: pathlib.Path) -> List[Finding]:
+        registry = self._load_registry(root)
+        if self._registry_error:
+            return [Finding(PROFILER_REL, 1, self.name,
+                            f'program registry unreadable: '
+                            f'{self._registry_error}')]
+        wrapped = set()
+        for sf in files:
+            if sf.tree is None or sf.rel == PROFILER_REL:
+                continue
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Call) and \
+                        _is_profiled_jit(node.func) and node.args and \
+                        isinstance(node.args[0], ast.Constant) and \
+                        isinstance(node.args[0].value, str):
+                    wrapped.add(node.args[0].value)
+        out: List[Finding] = []
+        for pname, lineno in sorted(registry.items()):
+            if pname not in wrapped:
+                out.append(Finding(
+                    PROFILER_REL, lineno, self.name,
+                    f'program {pname!r} is declared but no call site '
+                    'wraps it through profiled_jit — dead program; '
+                    'delete the declaration'))
+        return out
+
+
+def _is_bare_jax_jit(func) -> bool:
+    """``jax.jit(...)`` exactly: Attribute ``jit`` on Name ``jax``.
+    (``profiler.profiled_jit`` / local ``*_jit`` wrappers are the
+    sanctioned forms and never match.)"""
+    return (isinstance(func, ast.Attribute) and func.attr == 'jit'
+            and isinstance(func.value, ast.Name)
+            and func.value.id == 'jax')
+
+
+def _is_profiled_jit(func) -> bool:
+    if isinstance(func, ast.Name):
+        return func.id == 'profiled_jit'
+    return isinstance(func, ast.Attribute) and \
+        func.attr == 'profiled_jit'
