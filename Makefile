@@ -76,10 +76,14 @@ verify:
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --profile
 
 # Full skylint suite (lock discipline, engine-thread raise safety,
-# host-sync, env-flag registry, metric names, git bytecode hygiene) at
+# host-sync, env-flag registry, metric names, git bytecode hygiene,
+# plus the interprocedural call-graph rules: lock-order deadlock
+# cycles, blocking-under-lock, event-loop-block, resource-pair) at
 # zero findings, plus the generated env-flag doc drift check. Budget:
-# <= 30 s wall-clock (runs in ~10 s). Inner loop:
-# `python tools/skylint --changed` lints only git-dirty files.
+# <= 30 s wall-clock (runs in ~10 s; test-asserted). Inner loop:
+# `python tools/skylint --changed` lints only git-dirty files (the
+# call-graph rules still run, behind the mtime-keyed summary cache).
+# `--format json` emits stable finding ids for CI diff annotation.
 lint:
 	$(PY) tools/lint.py
 	$(PY) tools/gen_flag_docs.py --check

@@ -34,6 +34,9 @@ def _built_binary(target: str, src_name: str) -> Optional[str]:
         if shutil.which('make') is None:
             _build_failed[target] = True
             return None
+        # skylint: allow-block(the lock's purpose IS to serialize the
+        # one-time native build; callers are agent start-up, never a
+        # serving or probe thread)
         proc = subprocess.run(['make', '-C', _DIR, target],
                               capture_output=True, text=True, check=False)
         if proc.returncode != 0 or not os.path.exists(binary):

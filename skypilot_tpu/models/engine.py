@@ -1305,6 +1305,7 @@ class ContinuousEngine:
         budget = 1 if req.export else req.max_new
         return self._blocks_for(len(req.row), budget)
 
+    # skylint: resource-pair=kv_blocks.release
     def _release_blocks(self, slot: int) -> None:
         self._slot_table[slot] = None
         if self.kv_layout == 'paged':
@@ -1330,7 +1331,7 @@ class ContinuousEngine:
         return avail
 
     # skylint: locked(every caller holds _lock per the docstring
-    # contract below)
+    # contract below), resource-pair=kv_blocks.acquire
     def _alloc_blocks(self, n: int) -> List[int]:
         """Pop ``n`` blocks, refcount-aware-LRU-evicting idle trie
         blocks when the free list runs short. Callers hold the lock and
@@ -1433,6 +1434,10 @@ class ContinuousEngine:
                             self._trie.acquire(nd)
                         if partial is not None:
                             self._trie.acquire(partial)
+                        # skylint: allow-leak(engine thread: an escape
+                        # between alloc and the slot-table install hits
+                        # _loop's catch-all -> _fail_everything, which
+                        # rebuilds the device state and the block pool)
                         owned = self._alloc_blocks(need)
                         slot = free_s[0]
                         self._pending.popleft()
@@ -1833,6 +1838,9 @@ class ContinuousEngine:
                     nb = self._blocks_needed(req)
                     if self._blocks_avail() < nb:
                         return  # park until a completion frees blocks
+                    # skylint: allow-leak(engine thread: an escape here
+                    # reaches _fail_everything, which rebuilds the
+                    # device state and the whole block pool)
                     blocks = self._alloc_blocks(nb)
                     table_row = np.zeros(
                         (self.max_len // self.kv_block,), np.int32)
@@ -1888,6 +1896,9 @@ class ContinuousEngine:
                 nb = self._blocks_needed(req)
                 if not free or self._blocks_avail() < nb:
                     return  # park; retried next iteration
+                # skylint: allow-leak(engine thread: an escape here
+                # reaches _fail_everything, which rebuilds the device
+                # state and the whole block pool)
                 blocks = self._alloc_blocks(nb)
                 table_row = np.zeros((self.max_len // self.kv_block,),
                                      np.int32)
@@ -2239,6 +2250,10 @@ class ContinuousEngine:
                                 return  # backpressure: the head waits
                             for nd in nodes:
                                 self._trie.acquire(nd)
+                            # skylint: allow-leak(engine thread: an
+                            # escape here reaches _fail_everything,
+                            # which rebuilds the device state and the
+                            # whole block pool)
                             owned = self._alloc_blocks(need)
                             mb = self.max_len // p
                             table_row = np.zeros((mb,), np.int32)

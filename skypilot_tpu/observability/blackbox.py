@@ -64,6 +64,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from skypilot_tpu.utils import atomic_io
+
 
 @dataclasses.dataclass(frozen=True)
 class Event:
@@ -415,14 +417,13 @@ def dump(trigger: str, reason: Optional[str] = None,
         os.makedirs(d, exist_ok=True)
         fname = (f'{BUNDLE_PREFIX}{int(bundle["ts"] * 1000):013d}-'
                  f'{os.getpid()}-{bundle["trigger"]}.json')
-        tmp = os.path.join(d, f'.{fname}.tmp')
-        with open(tmp, 'w', encoding='utf-8') as f:
-            json.dump(bundle, f)
-            f.flush()
-            os.fsync(f.fileno())
         # Atomic publish: a crash mid-write leaves only the dot-tmp,
-        # which list_bundles() never surfaces (torn-tail discipline).
-        os.replace(tmp, os.path.join(d, fname))
+        # which list_bundles() never surfaces (torn-tail discipline);
+        # a FAILED write unlinks it — bundle names are unique per
+        # dump, so orphans would accumulate forever (resource-pair).
+        atomic_io.atomic_write(
+            os.path.join(d, fname), lambda f: json.dump(bundle, f),
+            fsync=True, tmp=os.path.join(d, f'.{fname}.tmp'))
         _rotate(d)
         _note_dump(bundle['trigger'])
         return os.path.join(d, fname)

@@ -64,6 +64,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from skypilot_tpu.utils import atomic_io
+
 SEVERITIES = ('info', 'warn', 'page')
 
 STATE_FILE = 'slo_alerts.json'
@@ -537,7 +539,10 @@ class SloEngine:
             self._active = state.get('active', {})
             self._history = state.get('history', [])
 
-    # skylint: locked(called under self._lock from tick)
+    # skylint: locked(called under self._lock from tick), allow-block(
+    # rare small no-fsync state write; holding the lock across the
+    # atomic commit is the point — alert state and its durable copy
+    # must not diverge)
     def _persist(self) -> None:
         payload = json.dumps({'version': 1, 'active': self._active,
                               'history': self._history}, sort_keys=True)
@@ -546,10 +551,8 @@ class SloEngine:
         try:
             d = os.path.dirname(self._state_path)
             os.makedirs(d, exist_ok=True)
-            tmp = self._state_path + '.tmp'
-            with open(tmp, 'w', encoding='utf-8') as f:
-                f.write(payload)
-            os.replace(tmp, self._state_path)
+            atomic_io.atomic_write(self._state_path,
+                                   lambda f: f.write(payload))
             self._last_persisted = payload
         except OSError:
             pass  # alerting still works in-process; re-page risk only

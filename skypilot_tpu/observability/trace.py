@@ -61,6 +61,8 @@ import uuid
 import weakref
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.utils import atomic_io
+
 TRACE_HEADER = 'X-SkyTPU-Trace'
 _VERSION = '00'
 
@@ -233,6 +235,7 @@ class _SpanCtx:
         self._token = _current.set(self.span)
         return self.span
 
+    # skylint: resource-pair=trace_span.release
     def __exit__(self, exc_type, exc, tb) -> bool:
         self.span.end = time.time()
         if exc_type is not None:
@@ -313,6 +316,7 @@ def header_value() -> Optional[str]:
 # -- span construction -------------------------------------------------------
 
 
+# skylint: resource-pair=trace_span.acquire
 def start_trace(name: str, headers: Any = None,
                 parent_header: Optional[str] = None, **attrs):
     """Open this process's root span for a request. Joins the caller's
@@ -338,6 +342,7 @@ def start_trace(name: str, headers: Any = None,
     return _SpanCtx(span, root=True)
 
 
+# skylint: resource-pair=trace_span.acquire
 def span(name: str, **attrs):
     """A child span under the current one; no-op outside any trace (so
     instrumented library code costs one contextvar read on untraced
@@ -447,10 +452,12 @@ def _export(record: Dict[str, Any]) -> None:
         os.makedirs(d, exist_ok=True)
         fname = (f'{int(record["start"] * 1000):013d}-'
                  f'{record["trace_id"][:12]}-{os.getpid()}.json')
-        tmp = os.path.join(d, f'.{fname}.tmp')
-        with open(tmp, 'w', encoding='utf-8') as f:
-            json.dump(record, f)
-        os.replace(tmp, os.path.join(d, fname))
+        # Trace filenames are unique: an unserializable span attr
+        # (TypeError) would otherwise leak one dot-tmp per trace —
+        # atomic_write unlinks its tmp on any failure.
+        atomic_io.atomic_write(
+            os.path.join(d, fname), lambda f: json.dump(record, f),
+            tmp=os.path.join(d, f'.{fname}.tmp'))
         names = sorted(n for n in os.listdir(d) if n.endswith('.json'))
         for stale in names[:-_export_keep()]:
             try:

@@ -30,6 +30,7 @@ import filelock
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.provision import common
+from skypilot_tpu.utils import atomic_io
 
 _EMPTY: Dict[str, Any] = {
     'clusters': {},            # name -> {'zone': str, 'instances': {id: dict}}
@@ -59,10 +60,7 @@ def _read() -> Dict[str, Any]:
 
 
 def _write(st: Dict[str, Any]) -> None:
-    tmp = _state_path() + '.tmp'
-    with open(tmp, 'w', encoding='utf-8') as f:
-        json.dump(st, f)
-    os.replace(tmp, _state_path())
+    atomic_io.atomic_write(_state_path(), lambda f: json.dump(st, f))
 
 
 def reset_state() -> None:

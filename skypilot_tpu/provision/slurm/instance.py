@@ -33,6 +33,7 @@ import yaml
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.provision import common
+from skypilot_tpu.utils import atomic_io
 from skypilot_tpu.utils.command_runner import CommandRunner, RunnerSpec
 
 ALLOC_WAIT_S = float(os.environ.get('SKYTPU_SLURM_ALLOC_WAIT_S', '300'))
@@ -117,10 +118,8 @@ def _write_allocs(allocs: Dict[str, Any]) -> None:
     # Atomic replace: a reader (or a crash) must never observe a torn
     # file — swallowing a half-written record as {} would erase the only
     # handle to live sleep-infinity allocations.
-    tmp = _allocs_path() + '.tmp'
-    with open(tmp, 'w', encoding='utf-8') as f:
-        json.dump(allocs, f)
-    os.replace(tmp, _allocs_path())
+    atomic_io.atomic_write(_allocs_path(),
+                           lambda f: json.dump(allocs, f))
 
 
 # -- provision function interface -------------------------------------------

@@ -57,6 +57,8 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from skypilot_tpu.utils import atomic_io
+
 MAGIC = b'SKYTPUKV1'
 FORMAT = 'skytpu-kv/1'
 _LEN = struct.Struct('<I')
@@ -277,6 +279,7 @@ class HandoffRegistry:
             del self._entries[hid]
         self.expired += len(dead)
 
+    # skylint: resource-pair=handoff_park.acquire
     def put(self, handoff) -> str:
         hid = uuid.uuid4().hex
         now = time.time()
@@ -285,6 +288,7 @@ class HandoffRegistry:
             self._entries[hid] = (now + self.ttl_s, handoff)
         return hid
 
+    # skylint: resource-pair=handoff_park.release
     def pop(self, hid: str):
         """One-shot claim; None when unknown/expired."""
         now = time.time()
@@ -321,15 +325,20 @@ def write_staging(staging_dir: str, handoff,
         except OSError:
             pass
     ref = uuid.uuid4().hex + STAGING_SUFFIX
-    tmp = os.path.join(staging_dir, ref + '.tmp')
-    nbytes = 0
-    with open(tmp, 'wb') as f:
+
+    def _writer(f) -> int:
+        n = 0
         for chunk in serialize(handoff, header):
             f.write(chunk)
-            nbytes += len(chunk)
-        f.flush()
-        os.fsync(f.fileno())
-    os.rename(tmp, os.path.join(staging_dir, ref))
+            n += len(chunk)
+        return n
+
+    # The TTL sweep above only matches *STAGING_SUFFIX names, so a
+    # failed write (full disk mid-handoff) would strand its uuid'd
+    # '.tmp' forever — atomic_write unlinks it before propagating (the
+    # LB falls back to colocated on any handoff failure).
+    nbytes = atomic_io.atomic_write(
+        os.path.join(staging_dir, ref), _writer, mode='wb', fsync=True)
     return ref, nbytes
 
 

@@ -1,0 +1,51 @@
+"""The tree's one tmp-write → (fsync) → rename atomic-commit helper.
+
+Every durable file this tree publishes (blackbox bundles, exported
+traces, SLO alert state, disagg staging payloads, provisioner state)
+follows the same discipline: write into ``<final>.tmp`` (or a caller-
+chosen tmp name), optionally fsync, then atomically rename — so a
+reader (or a crash) can never observe a torn file. The failure half of
+that discipline is just as important and used to be copy-pasted with
+diverging exception breadth: on ANY error the half-written tmp must be
+unlinked before the error propagates, or unique-named spools leak one
+orphan per failed attempt forever (skylint's ``resource-pair`` checker
+enforces this tree-wide).
+
+Dependency-free and import-light: signal-handler-adjacent callers
+(blackbox) load it safely.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Optional
+
+
+def atomic_write(path: str, writer: Callable[[Any], Any], *,
+                 mode: str = 'w', encoding: Optional[str] = 'utf-8',
+                 fsync: bool = False, tmp: Optional[str] = None):
+    """Write ``path`` atomically: ``writer(f)`` fills the tmp file,
+    then it is fsync'd (opt-in) and renamed over ``path``. On any
+    failure the tmp is unlinked and the exception propagates — callers
+    keep their own swallow/propagate contracts. Returns ``writer``'s
+    return value (e.g. a byte count)."""
+    if tmp is None:
+        tmp = path + '.tmp'
+    if 'b' in mode:
+        encoding = None
+    try:
+        with open(tmp, mode, encoding=encoding) as f:
+            result = writer(f)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return result
+    except BaseException:
+        # Never strand the half-written tmp: unique-named spools would
+        # accumulate one orphan per failed attempt, invisible to their
+        # sweeps/rotation (which only count published files).
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise

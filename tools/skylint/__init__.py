@@ -35,6 +35,17 @@ event-name   ``# skylint: allow-event(r)``      suppress one black-box
                                                event ref
 jit-program  ``# skylint: allow-jit(r)``        suppress one bare
                                                jax.jit call site
+lock-order   ``# skylint: allow-order(reason)`` acquisition exempt from
+                                               ordering (edge target
+                                               and source)
+blocking-*   ``# skylint: allow-block(reason)`` sanctioned blocking call
+                                               on a line or def (also
+                                               event-loop-block)
+resource-pair ``resource-pair=N.acquire`` etc.  def acquires/releases one
+                                               N unit (or .transfer: a
+                                               runtime-bounded park)
+resource-pair ``# skylint: allow-leak(reason)`` resource intentionally
+                                               outlives this function
 == ======================================= ==============================
 
 Every suppression MUST carry a non-empty human-readable reason; a bare
@@ -65,11 +76,16 @@ _ITEM_RE = re.compile(
 #: directives that suppress a finding and therefore need a reason
 REASON_REQUIRED = frozenset(
     {'locked', 'allow-raise', 'allow-host-sync', 'allow-env',
-     'allow-metric', 'allow-event', 'allow-jit'})
+     'allow-metric', 'allow-event', 'allow-jit',
+     # interprocedural concurrency rules (checkers/concurrency.py)
+     'allow-block',   # blocking call sanctioned (event loop / under lock)
+     'allow-order',   # lock acquisition exempt from ordering (why safe)
+     'allow-leak'})   # resource intentionally outlives this function
 #: marker directives (no argument)
 MARKERS = frozenset({'engine-thread', 'hot-path'})
 #: value directives (name=value)
-VALUED = frozenset({'guarded-by'})
+VALUED = frozenset({'guarded-by',
+                    'resource-pair'})  # resource-pair=NAME.{acquire,release,transfer}
 KNOWN_DIRECTIVES = REASON_REQUIRED | MARKERS | VALUED
 
 
@@ -88,9 +104,26 @@ class Finding:
     line: int
     rule: str
     message: str
+    #: other repo-relative files implicated (interprocedural rules: the
+    #: acquisition/call chain may span files; ``--changed`` keeps a
+    #: finding when ANY involved file is dirty)
+    involved: Tuple[str, ...] = ()
 
     def __str__(self) -> str:
         return f'{self.path}:{self.line}: [{self.rule}] {self.message}'
+
+    def stable_id(self) -> str:
+        """Line-shift-tolerant identity for CI diffing (``--format
+        json``): digits are masked in the MESSAGE (where line numbers
+        live) so re-flowing an unrelated hunk does not churn every id
+        in the file — but the path stays verbatim, so same-shaped
+        findings in digit-differing files cannot collide (an id must
+        never change because a DIFFERENT file's finding was fixed)."""
+        import hashlib
+        masked = re.sub(r'\d+', '#', self.message)
+        core = f'{self.rule}|{self.path}|{masked}'
+        return hashlib.blake2s(core.encode('utf-8'),
+                               digest_size=6).hexdigest()
 
 
 class SourceFile:
@@ -228,6 +261,10 @@ class Checker:
     ``check_tree`` and run once over the whole file set."""
 
     name = ''
+    #: call-graph rules: run even in ``--changed`` mode (the graph is
+    #: whole-tree and cheap behind the summary cache); their findings
+    #: are then filtered to the dirty file set.
+    interprocedural = False
 
     def check_file(self, sf: SourceFile) -> List[Finding]:
         return []
@@ -264,22 +301,45 @@ def iter_py_files(root: pathlib.Path = ROOT,
                     yield f
 
 
-def load_files(paths=None, root: pathlib.Path = ROOT) -> List[SourceFile]:
-    return [SourceFile(p, root)
-            for p in (paths if paths is not None else iter_py_files(root))]
+def load_files(paths=None, root: pathlib.Path = ROOT,
+               missing_ok: bool = False) -> List[SourceFile]:
+    out = []
+    for p in (paths if paths is not None else iter_py_files(root)):
+        try:
+            out.append(SourceFile(p, root))
+        except (OSError, UnicodeDecodeError):
+            # A path in an explicit/--changed set may be deleted or
+            # renamed between `git status` and the read — skip it
+            # rather than crash the driver. The tree-wide CI gate must
+            # NOT swallow this: an unreadable committed file would be
+            # silently exempted from every rule.
+            if missing_ok:
+                continue
+            raise
+    return out
 
 
 def run(paths=None, root: pathlib.Path = ROOT, tree_wide: bool = True
         ) -> Tuple[List[Finding], int]:
     """Run every registered checker. ``tree_wide=False`` (the
     ``--changed`` inner loop) limits the run to per-file rules over
-    ``paths`` plus the always-cheap git hygiene rule."""
-    files = load_files(paths, root)
+    ``paths`` — plus the always-cheap git hygiene rule and, when any
+    dirty file lives under ``skypilot_tpu/``, the interprocedural
+    concurrency rules (whole-graph behind the summary cache, findings
+    filtered to the dirty set: an upstream callee edit re-summarizes
+    only that file, so cross-file findings stay fresh)."""
+    files = load_files(paths, root, missing_ok=not tree_wide)
+    focus = None if tree_wide else {sf.rel for sf in files}
     findings: List[Finding] = []
     for checker in all_checkers():
         for sf in files:
             findings.extend(checker.check_file(sf))
         if tree_wide or checker.name == 'tracked-pycache':
             findings.extend(checker.check_tree(files, root))
+        elif checker.interprocedural and focus and \
+                any(r.startswith('skypilot_tpu') for r in focus):
+            for f in checker.check_tree(files, root):
+                if f.path in focus or set(f.involved) & focus:
+                    findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings, len(files)

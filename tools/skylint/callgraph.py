@@ -1,0 +1,950 @@
+"""Project call graph + per-function concurrency summaries.
+
+skylint's first eight rules are per-file: none of them can see that a
+lock acquired in ``controller.py`` is still held when a call lands in
+``load_balancer.py`` and takes the LB's lock. This module gives the
+interprocedural rules (``checkers/concurrency.py``) the missing half:
+
+* a whole-tree call graph over ``skypilot_tpu/`` — module functions,
+  class methods, ``self._method()``, ``self._attr.method()`` (attribute
+  types inferred from ``self._attr = ClassName(...)`` assignments),
+  ``module.func()`` through the import table, bare-name calls, and
+  constructor calls. Calls the resolver cannot place are kept in an
+  explicit **unresolved** category (``Graph.unresolved``) so the
+  soundness gap is visible (``python tools/skylint --graph-stats``),
+  never silently dropped;
+* per-function **summaries** of the local facts the rules propagate:
+  locks acquired (``with self._lock:`` nesting, seeded by the same
+  ``_GUARDED_BY`` / ``# skylint: locked(...)`` declarations the
+  guarded-by rule reads), blocking calls from the declared vocabulary,
+  call sites with the locks held at each, and resource-pair roles;
+* an mtime+size-keyed on-disk cache (``.skylint_cache/callgraph.json``
+  under the tree root) of the **local** summaries only. Resolution and
+  propagation are recomputed from the summaries on every run — they are
+  cheap — so a change to an upstream callee invalidates exactly that
+  file's cache entry and the whole graph still sees the new body. The
+  cache is what keeps ``--changed`` runs subsecond without ever serving
+  stale interprocedural facts.
+
+The summary is deliberately *local*: nothing in a file's cache entry
+depends on any other file, which is the invariant that makes the cache
+sound under ``--changed``.
+"""
+from __future__ import annotations
+
+import ast
+import collections
+import json
+import os
+import pathlib
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from skylint import SourceFile
+
+_SCHEMA = 9  # bump when the summary shape changes: stale caches reparse
+CACHE_DIR = '.skylint_cache'
+CACHE_NAME = 'callgraph.json'
+TREE_PREFIX = 'skypilot_tpu'
+
+# --------------------------------------------------------------------------
+# Blocking vocabulary. Each entry is a *kind label* the finding prints;
+# detection logic lives in _classify_blocking. The vocabulary is the
+# contract docs/development.md documents — extend it there too.
+BLOCKING_KINDS = (
+    'time.sleep', 'urlopen', 'requests', 'subprocess', 'socket',
+    'fsync', 'disk-io', 'future-result', 'queue-get', 'join',
+    'jax-host-sync',
+)
+
+_SUBPROCESS_BLOCKING = {'run', 'check_output', 'check_call', 'call',
+                        'communicate'}
+_SOCKET_METHODS = {'recv', 'recvfrom', 'accept', 'sendall', 'makefile'}
+_DISK_IO_METHODS = {'read_text', 'read_bytes', 'write_text',
+                    'write_bytes'}
+
+
+class FuncInfo:
+    """One function node in the assembled graph."""
+
+    __slots__ = ('key', 'rel', 'qual', 'cls', 'line', 'is_async',
+                 'entry_locks', 'acquires', 'calls', 'blocking',
+                 'pair_roles', 'allow_block', 'name')
+
+    def __init__(self, key: str, rel: str, qual: str, s: dict):
+        self.key = key
+        self.rel = rel
+        self.qual = qual
+        self.name = qual.rsplit('.', 1)[-1]
+        self.cls = s.get('cls')
+        self.line = s.get('line', 1)
+        self.is_async = bool(s.get('is_async'))
+        # filled during resolution:
+        self.entry_locks: List[str] = []        # global lock ids
+        self.acquires: List[tuple] = []         # (gid, line, held)
+        self.calls: List[tuple] = []            # (key|None, cat, line, held, label)
+        self.blocking: List[tuple] = []         # (kind, line, held)
+        self.pair_roles: Dict[str, str] = dict(s.get('pair_roles') or {})
+        self.allow_block = bool(s.get('allow_block'))
+
+
+class Graph:
+    """Resolved whole-tree graph. ``functions`` maps global keys
+    (``rel::Qual.name``) to :class:`FuncInfo`; ``unresolved`` counts
+    call sites the resolver could not place, by category."""
+
+    def __init__(self):
+        self.functions: Dict[str, FuncInfo] = {}
+        self.lock_kinds: Dict[str, str] = {}    # gid -> 'lock'|'rlock'
+        self.lock_sites: Dict[str, tuple] = {}  # gid -> (rel, line) decl
+        self.pairs: Dict[str, Dict[str, Set[str]]] = {}
+        self.unresolved: collections.Counter = collections.Counter()
+        self.n_files = 0
+        self.from_cache = 0
+
+    def stats(self) -> Dict[str, Any]:
+        n_calls = sum(len(f.calls) for f in self.functions.values())
+        n_res = sum(1 for f in self.functions.values()
+                    for c in f.calls if c[0] is not None)
+        return {
+            'files': self.n_files,
+            'functions': len(self.functions),
+            'call_sites': n_calls,
+            'resolved': n_res,
+            'unresolved': dict(self.unresolved),
+            'locks': len(self.lock_kinds),
+            'cache_hits': self.from_cache,
+        }
+
+
+# ==========================================================================
+# Phase 1: local per-file summaries (cacheable)
+# ==========================================================================
+
+def summarize_file(sf: SourceFile) -> dict:
+    """Local facts only — nothing here may depend on another file."""
+    out: dict = {'schema': _SCHEMA, 'classes': {}, 'module_locks': {},
+                 'imports': {}, 'from_imports': {}, 'module_funcs': [],
+                 'functions': {}}
+    if sf.tree is None:
+        return out
+    for node in sf.tree.body:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out['imports'][a.asname or a.name.split('.')[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module and \
+                node.level == 0:
+            for a in node.names:
+                if a.name == '*':
+                    continue
+                out['from_imports'][a.asname or a.name] = [node.module,
+                                                           a.name]
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out['module_funcs'].append(node.name)
+        elif isinstance(node, ast.Assign):
+            _note_lock_assign(node, out['module_locks'], self_based=False)
+    # Classes (including nested-in-function classes are skipped — none
+    # in this tree hold locks).
+    for node in sf.tree.body:
+        if isinstance(node, ast.ClassDef):
+            out['classes'][node.name] = _summarize_class(sf, node)
+    # Functions: module-level and methods. Nested defs become their own
+    # entries (qual 'outer.inner') and are reachable only through
+    # local-name calls inside the parent — a definition is not a call.
+    for name, fnode, cls in _iter_functions(sf.tree):
+        out['functions'][name] = _summarize_function(sf, fnode, cls, out)
+    return out
+
+
+def _summarize_class(sf: SourceFile, cls: ast.ClassDef) -> dict:
+    info = {'bases': [], 'methods': [], 'attr_types': {},
+            'lock_attrs': {}, 'guard_locks': []}
+    for b in cls.bases:
+        if isinstance(b, ast.Name):
+            info['bases'].append(b.id)
+        elif isinstance(b, ast.Attribute) and \
+                isinstance(b.value, ast.Name):
+            info['bases'].append(f'{b.value.id}.{b.attr}')
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info['methods'].append(node.name)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == '_GUARDED_BY' \
+                        and isinstance(node.value, ast.Dict):
+                    for v in node.value.values:
+                        for n in _lock_value_names(v):
+                            if n not in info['guard_locks']:
+                                info['guard_locks'].append(n)
+    # attr types + lock attrs from self.X = ... assignments anywhere in
+    # the class body (usually __init__).
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        _note_lock_assign(node, info['lock_attrs'], self_based=True)
+        for t in node.targets:
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == 'self':
+                ty = _ctor_type(node.value)
+                if ty is not None:
+                    info['attr_types'].setdefault(t.attr, ty)
+    return info
+
+
+def _lock_value_names(v) -> List[str]:
+    if isinstance(v, ast.Constant) and isinstance(v.value, str):
+        return [v.value]
+    if isinstance(v, (ast.Tuple, ast.List)):
+        return [e.value for e in v.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+def _note_lock_assign(node: ast.Assign, into: Dict[str, Any],
+                      self_based: bool) -> None:
+    """Record ``X = threading.Lock()`` / ``RLock()`` / ``Condition(y)``
+    (module-level or ``self.X = ...``) so lock identity and reentrancy
+    are known. A Condition aliases its underlying lock."""
+    kind = None
+    v = node.value
+    if isinstance(v, ast.Call) and isinstance(v.func, ast.Attribute) \
+            and v.func.attr in ('Lock', 'RLock', 'Condition', 'Event',
+                                'Semaphore', 'BoundedSemaphore'):
+        if v.func.attr == 'Lock':
+            kind = 'lock'
+        elif v.func.attr == 'RLock':
+            kind = 'rlock'
+        elif v.func.attr == 'Condition':
+            under = None
+            if v.args:
+                a = v.args[0]
+                if isinstance(a, ast.Attribute) and \
+                        isinstance(a.value, ast.Name) and \
+                        a.value.id == 'self':
+                    under = a.attr
+                elif isinstance(a, ast.Name):
+                    under = a.id
+                kind = ['cond', under]
+            else:
+                # A no-arg Condition builds its own RLock: re-entry
+                # through a call chain is legal, not a self-deadlock.
+                kind = 'rlock'
+        else:
+            return  # Events/semaphores are not mutexes: no ordering
+    if kind is None:
+        return
+    for t in node.targets:
+        if self_based:
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == 'self':
+                into[t.attr] = kind
+        elif isinstance(t, ast.Name):
+            into[t.id] = kind
+
+
+def collect_local_types(fn) -> Dict[str, str]:
+    """Local var -> 'ClassName'/'mod.ClassName' from single-target
+    constructor assignments (shared by the summary walker and the
+    resource-pair checker so their resolution cannot diverge)."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            ty = _ctor_type(node.value)
+            if ty is not None:
+                out.setdefault(node.targets[0].id, ty)
+    return out
+
+
+def symbolic_target(node: ast.Call,
+                    local_types: Dict[str, str]) -> list:
+    """Classify a call's target into the symbolic form the resolver
+    consumes — the ONE place call shapes are recognized."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return ['name', f.id]
+    if isinstance(f, ast.Attribute):
+        v = f.value
+        if isinstance(v, ast.Name):
+            if v.id == 'self':
+                return ['self', f.attr]
+            if v.id in local_types:
+                return ['type', local_types[v.id], f.attr]
+            return ['dotted', v.id, f.attr]
+        if isinstance(v, ast.Attribute) and \
+                isinstance(v.value, ast.Name) and v.value.id == 'self':
+            return ['selfattr', v.attr, f.attr]
+        if isinstance(v, ast.Attribute) and \
+                isinstance(v.value, ast.Name):
+            # pkg.mod.func(...): collapse to dotted on last segment
+            return ['dotted', v.attr, f.attr]
+        return ['unres:attr-chain', ast.dump(f)[:40]]
+    return ['unres:dynamic', '']
+
+
+def _ctor_type(value) -> Optional[str]:
+    """'ClassName' or 'mod.ClassName' when value looks like a
+    constructor call (CamelCase convention — this tree's style)."""
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    if isinstance(f, ast.Name) and f.id[:1].isupper():
+        return f.id
+    if isinstance(f, ast.Attribute) and f.attr[:1].isupper() and \
+            isinstance(f.value, ast.Name):
+        return f'{f.value.id}.{f.attr}'
+    return None
+
+
+def _iter_functions(tree):
+    """Yield (qualname, node, classname) for every def in the module.
+    Methods: 'Cls.m'; nested defs: 'outer.inner' (class scope kept)."""
+    def visit(node, cls, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from visit(child, child.name, '')
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                qual = (f'{cls}.' if cls else '') + prefix + child.name
+                yield qual, child, cls
+                yield from visit(child, cls, prefix + child.name + '.')
+            else:
+                yield from visit(child, cls, prefix)
+    yield from visit(tree, None, '')
+
+
+# -- per-function local walk ------------------------------------------------
+
+class _FnWalker:
+    """Collects acquisitions, call sites and blocking sites with the
+    locally-held lock set at each point. Lock refs are symbolic —
+    ['self', attr] or ['name', name] — resolved globally later."""
+
+    def __init__(self, sf: SourceFile, fn, cls: Optional[str],
+                 mod: dict):
+        self.sf = sf
+        self.fn = fn
+        self.cls = cls
+        self.mod = mod
+        self.acquires: List[list] = []
+        self.calls: List[list] = []
+        self.blocking: List[list] = []
+        self.local_types = collect_local_types(fn)
+        self.async_exempt: Set[int] = set()  # id(Call) awaited/asyncio
+        self.async_locals: Set[str] = set()  # names bound to asyncio futs
+        self._collect_async_exempt(fn)
+
+    def run(self, entry_held: List[list]) -> None:
+        for stmt in self.fn.body:
+            self._visit(stmt, list(entry_held))
+
+    def _collect_async_exempt(self, fn) -> None:
+        """Call nodes that are awaited (directly or through an asyncio
+        wrapper) or passed to asyncio.* — their ``.get()``/``.wait()``
+        shape is the *async* queue API, not a thread-blocking call."""
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Await):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        self.async_exempt.add(id(sub))
+            elif isinstance(node, ast.Call):
+                f = node.func
+                is_asyncio = (
+                    isinstance(f, ast.Attribute) and
+                    isinstance(f.value, ast.Name) and
+                    f.value.id == 'asyncio') or (
+                    isinstance(f, ast.Attribute) and
+                    f.attr in ('ensure_future', 'create_task',
+                               'run_in_executor', 'wait_for', 'gather'))
+                if is_asyncio:
+                    for a in list(node.args) + [k.value
+                                                for k in node.keywords]:
+                        for sub in ast.walk(a):
+                            if isinstance(sub, ast.Call):
+                                self.async_exempt.add(id(sub))
+        # Locals bound to asyncio futures/tasks: `.result()`/`.get()`
+        # on them resolves an ALREADY-completed awaitable, it does not
+        # block a thread. Two passes so tuple-rebinding propagates
+        # (`task, get_task = get_task, None`).
+        for _ in (0, 1):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                v = node.value
+                src_async = isinstance(v, ast.Await) or (
+                    isinstance(v, ast.Call) and
+                    isinstance(v.func, ast.Attribute) and
+                    isinstance(v.func.value, ast.Name) and
+                    v.func.value.id == 'asyncio') or (
+                    isinstance(v, (ast.Name, ast.Tuple)) and
+                    any(n.id in self.async_locals
+                        for n in ast.walk(v)
+                        if isinstance(n, ast.Name)))
+                if src_async:
+                    for t in node.targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                self.async_locals.add(n.id)
+
+    # -- traversal ---------------------------------------------------------
+
+    def _visit(self, node, held: List[list]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # separate callable: does not run here, holds nothing
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = list(held)
+            for item in node.items:
+                for sub in ast.walk(item.context_expr):
+                    if isinstance(sub, ast.Call):
+                        self._note_call(sub, held)
+                ref = self._lock_ref(item.context_expr)
+                if ref is not None:
+                    line = item.context_expr.lineno
+                    # allow-order neutralizes this acquisition for
+                    # ORDERING (as both edge target and edge source —
+                    # the held entry carries the marker); the lock
+                    # still counts as held for blocking-under-lock.
+                    exempt = bool(
+                        self.sf.suppression(line, 'allow-order') or
+                        self.sf.suppression(node.lineno, 'allow-order'))
+                    self.acquires.append(
+                        [ref, line, [list(h) for h in inner], exempt])
+                    inner = inner + [[ref, line, exempt]]
+            for child in node.body:
+                self._visit(child, inner)
+            return
+        if isinstance(node, ast.Call):
+            self._note_call(node, held)
+            # fall through: arguments may contain nested calls/withs
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held)
+
+    def _lock_ref(self, expr) -> Optional[list]:
+        """Symbolic lock for a with-context expr, when it names a known
+        lock: ``self._x`` (class lock attr or _GUARDED_BY value) or a
+        module-level lock name."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == 'self':
+            if self.cls:
+                cinfo = self.mod['classes'].get(self.cls, {})
+                known = set(cinfo.get('lock_attrs', ())) | \
+                    set(cinfo.get('guard_locks', ()))
+                # Known constructed/declared locks, or the *_lock attr
+                # naming convention (locks built indirectly).
+                if expr.attr in known or expr.attr.endswith('lock'):
+                    return ['self', expr.attr]
+            return None
+        if isinstance(expr, ast.Name):
+            # Declared module-level locks, or the ALL_CAPS *_LOCK
+            # convention for locks constructed indirectly. A lowercase
+            # local named `lock` is NOT a mutex class (e.g. the
+            # watchdog's filelock ownership lease) — locals get no
+            # global identity.
+            if expr.id in self.mod['module_locks'] or \
+                    (expr.id.isupper() and 'LOCK' in expr.id):
+                return ['name', expr.id]
+        return None
+
+    def _note_call(self, node: ast.Call, held: List[list]) -> None:
+        line = node.lineno
+        held_copy = [list(h) for h in held]
+        kind = self._classify_blocking(node)
+        if kind is not None:
+            if not (self.sf.suppression(line, 'allow-block')):
+                self.blocking.append([kind, line, held_copy])
+            return
+        self.calls.append([symbolic_target(node, self.local_types),
+                           line, held_copy])
+
+    # -- blocking vocabulary ------------------------------------------------
+
+    def _classify_blocking(self, node: ast.Call) -> Optional[str]:
+        if id(node) in self.async_exempt:
+            return None
+        f = node.func
+        nargs = len(node.args)
+        kwnames = {k.arg for k in node.keywords}
+        if isinstance(f, ast.Attribute):
+            base = f.value.id if isinstance(f.value, ast.Name) else None
+            a = f.attr
+            if base in self.async_locals:
+                return None  # asyncio future/task: resolved, not blocking
+            if base == 'time' and a == 'sleep':
+                return 'time.sleep'
+            if a == 'urlopen':
+                return 'urlopen'
+            if base == 'requests' and a in ('get', 'post', 'put',
+                                            'delete', 'head', 'request'):
+                return 'requests'
+            if base == 'subprocess' and a in _SUBPROCESS_BLOCKING:
+                return 'subprocess'
+            if a == 'communicate':
+                return 'subprocess'
+            if base == 'os' and a in ('fsync', 'fdatasync'):
+                return 'fsync'
+            if a in _SOCKET_METHODS and base == 'sock' or \
+                    (base == 'socket' and a == 'create_connection'):
+                return 'socket'
+            if a in _DISK_IO_METHODS:
+                return 'disk-io'
+            if a == 'result' and nargs == 0 and kwnames <= {'timeout'}:
+                return 'future-result'
+            if a == 'get' and nargs == 0 and kwnames <= {'block',
+                                                         'timeout'}:
+                # Only queue-shaped receivers: a zero-arg `.get()` is
+                # also the ContextVar API, which never blocks.
+                recv = (base or (f.value.attr if isinstance(
+                    f.value, ast.Attribute) else '') or '').lower()
+                queue_ish = (recv in ('q', 'mq') or 'queue' in recv
+                             or recv.endswith('_q'))
+                if queue_ish and not _kw_false(node, 'block'):
+                    return 'queue-get'
+            if a == 'join' and nargs == 0 and kwnames <= {'timeout'}:
+                return 'join'
+            if a == 'item' and nargs == 0 and not kwnames:
+                return 'jax-host-sync'
+            if a == 'block_until_ready':
+                return 'jax-host-sync'
+            if a == 'device_get':
+                return 'jax-host-sync'
+        elif isinstance(f, ast.Name):
+            fi = self.mod['from_imports'].get(f.id)
+            src = fi[0] if fi else None
+            orig = fi[1] if fi else f.id
+            if orig == 'sleep' and src == 'time':
+                return 'time.sleep'
+            if orig == 'urlopen' and (src or '').startswith('urllib'):
+                return 'urlopen'
+            if f.id == 'device_get':
+                return 'jax-host-sync'
+        return None
+
+
+def _kw_false(node: ast.Call, name: str) -> bool:
+    for k in node.keywords:
+        if k.arg == name and isinstance(k.value, ast.Constant) and \
+                k.value.value is False:
+            return True
+    return False
+
+
+def _summarize_function(sf: SourceFile, fn, cls: Optional[str],
+                        mod: dict) -> dict:
+    directives = sf.func_directives(fn)
+    allow_block = any(d.name == 'allow-block' for d in directives)
+    pair_roles: Dict[str, str] = {}
+    for d in directives:
+        if d.name == 'resource-pair':
+            name, _, role = d.arg.rpartition('.')
+            # malformed values are the annotation checker's findings
+            if name and role in ('acquire', 'release', 'transfer'):
+                pair_roles[name] = role
+    # locked(...) reasons that NAME a lock mean the function truly runs
+    # with that lock held (the `_locked` suffix contract). Reasons that
+    # do not name one ("sole mutator thread") assert single-threaded
+    # access instead — no lock is held, so no edges may be derived.
+    entry_locks: List[list] = []
+    cinfo = mod['classes'].get(cls, {}) if cls else {}
+    known = set(cinfo.get('lock_attrs', ())) | \
+        set(cinfo.get('guard_locks', ()))
+    for d in directives:
+        if d.name == 'locked' and d.arg:
+            for lk in sorted(known):
+                if lk in d.arg.split() or f'`{lk}`' in d.arg:
+                    entry_locks.append(['self', lk])
+            for lk in mod['module_locks']:
+                if lk in d.arg.split():
+                    entry_locks.append(['name', lk])
+    w = _FnWalker(sf, fn, cls, mod)
+    w.run([[ref, fn.lineno, False] for ref in entry_locks])
+    return {
+        'line': fn.lineno,
+        'cls': cls,
+        'is_async': isinstance(fn, ast.AsyncFunctionDef),
+        'entry_locks': entry_locks,
+        'acquires': w.acquires,
+        'calls': w.calls,
+        'blocking': w.blocking,
+        'pair_roles': pair_roles,
+        'allow_block': allow_block,
+    }
+
+
+# ==========================================================================
+# Cache
+# ==========================================================================
+
+def _cache_path(root: pathlib.Path) -> pathlib.Path:
+    return root / CACHE_DIR / CACHE_NAME
+
+
+def _load_cache(root: pathlib.Path) -> dict:
+    try:
+        data = json.loads(_cache_path(root).read_text(encoding='utf-8'))
+        if data.get('schema') == _SCHEMA:
+            return data.get('files', {})
+    except (OSError, ValueError):
+        pass
+    return {}
+
+
+def _save_cache(root: pathlib.Path, files: dict) -> None:
+    path = _cache_path(root)
+    tmp = path.with_name(path.name + '.tmp')
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp.write_text(json.dumps({'schema': _SCHEMA, 'files': files}),
+                       encoding='utf-8')
+        os.replace(tmp, path)
+    except OSError:
+        # Best-effort (a cold run is only slower) — but follow our own
+        # resource-pair rule: never strand the half-written tmp.
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+# ==========================================================================
+# Phase 2: assembly + resolution (always recomputed)
+# ==========================================================================
+
+_MEMO: Dict[tuple, 'Graph'] = {}
+
+
+def get_graph(files: Sequence[SourceFile], root: pathlib.Path,
+              use_cache: bool = True) -> Graph:
+    """Build (or reuse within-process) the whole-tree graph. ``files``
+    are already-parsed SourceFiles to prefer over disk; every other
+    ``skypilot_tpu/**.py`` under ``root`` is loaded from the summary
+    cache when fresh, else reparsed."""
+    tree_dir = root / TREE_PREFIX
+    disk: List[pathlib.Path] = []
+    if tree_dir.is_dir():
+        disk = [p for p in sorted(tree_dir.rglob('*.py'))
+                if '__pycache__' not in p.parts]
+    key_parts = []
+    for p in disk:
+        try:
+            st = p.stat()
+            key_parts.append((str(p), st.st_mtime, st.st_size))
+        except OSError:
+            continue
+    memo_key = (str(root), tuple(key_parts))
+    if memo_key in _MEMO:
+        return _MEMO[memo_key]
+    by_path = {str(sf.path): sf for sf in files
+               if sf.rel.startswith(TREE_PREFIX)}
+    cache = _load_cache(root) if use_cache else {}
+    new_cache: dict = {}
+    summaries: Dict[str, dict] = {}
+    graph = Graph()
+    dirty = False  # any entry recomputed -> the cache file needs rewriting
+    for p in disk:
+        try:
+            st = p.stat()
+        except OSError:
+            continue
+        rel = str(p.relative_to(root))
+        ent = cache.get(rel)
+        # mtime+size match means the cached summary reflects the same
+        # disk bytes an already-parsed SourceFile was read from, so
+        # the cache wins even when the caller passed files in — this
+        # is what keeps the FULL `make lint` run warm, not just
+        # --changed.
+        if ent and ent.get('mtime') == st.st_mtime and \
+                ent.get('size') == st.st_size:
+            summaries[rel] = ent['summary']
+            new_cache[rel] = ent
+            graph.from_cache += 1
+            continue
+        sf = by_path.get(str(p))
+        if sf is None:
+            try:
+                sf = SourceFile(p, root)
+            except (OSError, UnicodeDecodeError):
+                continue
+        s = summarize_file(sf)
+        summaries[rel] = s
+        new_cache[rel] = {'mtime': st.st_mtime, 'size': st.st_size,
+                          'summary': s}
+        dirty = True
+    graph.n_files = len(summaries)
+    # Deleted files must leave the cache too, but a fully-warm run
+    # (the --changed inner loop's common case) skips the ~1 MB rewrite.
+    if use_cache and (dirty or set(new_cache) != set(cache)):
+        _save_cache(root, new_cache)
+    _resolve(graph, summaries)
+    if len(_MEMO) > 4:
+        _MEMO.clear()
+    _MEMO[memo_key] = graph
+    return graph
+
+
+class _Resolver:
+    def __init__(self, summaries: Dict[str, dict]):
+        self.summaries = summaries
+        # dotted module path -> rel (skypilot_tpu.a.b -> skypilot_tpu/a/b.py)
+        self.mod_rel: Dict[str, str] = {}
+        for rel in summaries:
+            dotted = rel[:-3].replace('/', '.').replace('\\', '.')
+            self.mod_rel[dotted] = rel
+            if dotted.endswith('.__init__'):
+                self.mod_rel[dotted[:-len('.__init__')]] = rel
+
+    def module_for(self, rel: str, dotted: str) -> Optional[str]:
+        return self.mod_rel.get(dotted)
+
+    def resolve_import(self, rel: str, local: str) -> Optional[str]:
+        """rel of the module a local name is bound to via imports."""
+        mod = self.summaries[rel]
+        if local in mod['imports']:
+            return self.mod_rel.get(mod['imports'][local])
+        if local in mod['from_imports']:
+            src, orig = mod['from_imports'][local]
+            # `from pkg import mod` binds a submodule
+            sub = self.mod_rel.get(f'{src}.{orig}')
+            if sub:
+                return sub
+        return None
+
+    def resolve_class(self, rel: str, sym: str
+                      ) -> Optional[Tuple[str, str]]:
+        """'Name' or 'mod.Name' -> (rel, ClassName)."""
+        mod = self.summaries.get(rel)
+        if mod is None:
+            return None
+        if '.' in sym:
+            base, name = sym.split('.', 1)
+            target = self.resolve_import(rel, base)
+            if target and name in self.summaries[target]['classes']:
+                return target, name
+            return None
+        if sym in mod['classes']:
+            return rel, sym
+        if sym in mod['from_imports']:
+            src, orig = mod['from_imports'][sym]
+            srel = self.mod_rel.get(src)
+            if srel and orig in self.summaries[srel]['classes']:
+                return srel, orig
+        return None
+
+    def mro(self, rel: str, cls: str, depth: int = 0):
+        """Yield (rel, clsname, info) along the (tree-resolvable) MRO."""
+        if depth > 8:
+            return
+        info = self.summaries.get(rel, {}).get('classes', {}).get(cls)
+        if info is None:
+            return
+        yield rel, cls, info
+        for b in info['bases']:
+            r = self.resolve_class(rel, b)
+            if r is not None:
+                yield from self.mro(r[0], r[1], depth + 1)
+
+    def find_method(self, rel: str, cls: str, name: str
+                    ) -> Optional[str]:
+        for crel, cname, info in self.mro(rel, cls):
+            if name in info['methods']:
+                return f'{crel}::{cname}.{name}'
+        return None
+
+    def attr_type(self, rel: str, cls: str, attr: str
+                  ) -> Optional[Tuple[str, str]]:
+        for crel, cname, info in self.mro(rel, cls):
+            ty = info['attr_types'].get(attr)
+            if ty is not None:
+                return self.resolve_class(crel, ty)
+        return None
+
+    def lock_gid(self, rel: str, cls: Optional[str], ref: list
+                 ) -> Optional[str]:
+        """Global lock id for a symbolic ref; Condition objects resolve
+        to their underlying lock; the id is anchored at the class that
+        *creates* the lock so base/subclass uses unify."""
+        if ref[0] == 'self' and cls:
+            attr = ref[1]
+            for crel, cname, info in self.mro(rel, cls):
+                kind = info['lock_attrs'].get(attr)
+                if kind is not None:
+                    if isinstance(kind, list) and kind[0] == 'cond' \
+                            and kind[1]:
+                        return self.lock_gid(crel, cname,
+                                             ['self', kind[1]])
+                    return f'{crel}::{cname}.{attr}'
+            # Not seen constructed (built indirectly): anchor at the
+            # declaring class if _GUARDED_BY names it, else own class.
+            for crel, cname, info in self.mro(rel, cls):
+                if attr in info['guard_locks']:
+                    return f'{crel}::{cname}.{attr}'
+            return f'{rel}::{cls}.{attr}'
+        if ref[0] == 'name':
+            mod = self.summaries.get(rel, {})
+            kind = mod.get('module_locks', {}).get(ref[1])
+            if isinstance(kind, list) and kind[0] == 'cond' and kind[1]:
+                return self.lock_gid(rel, None, ['name', kind[1]])
+            if kind is not None:
+                return f'{rel}::{ref[1]}'
+            fi = mod.get('from_imports', {}).get(ref[1])
+            if fi:
+                srel = self.mod_rel.get(fi[0])
+                if srel and fi[1] in self.summaries[srel].get(
+                        'module_locks', {}):
+                    return f'{srel}::{fi[1]}'
+            # Heuristic *_LOCK name never seen constructed: still give
+            # it module-local identity (better than dropping the edge).
+            return f'{rel}::{ref[1]}'
+        return None
+
+    def lock_kind(self, gid: str) -> str:
+        rel, _, name = gid.partition('::')
+        mod = self.summaries.get(rel, {})
+        if '.' in name:
+            cls, attr = name.split('.', 1)
+            kind = mod.get('classes', {}).get(cls, {}).get(
+                'lock_attrs', {}).get(attr)
+        else:
+            kind = mod.get('module_locks', {}).get(name)
+        if kind == 'rlock':
+            return 'rlock'
+        return 'lock'
+
+    def resolve_call(self, rel: str, cls: Optional[str], target: list,
+                     fn_qual: str = '') -> Tuple[Optional[str], str]:
+        """(function key, category). Key None => unresolved, category
+        says why — the visible soundness gap."""
+        kind = target[0]
+        mod = self.summaries[rel]
+        if kind == 'self':
+            name = target[1]
+            if cls:
+                key = self.find_method(rel, cls, name)
+                if key:
+                    return key, 'self'
+                return None, 'unres:no-such-method'
+            return None, 'unres:self-outside-class'
+        if kind == 'selfattr':
+            attr, meth = target[1], target[2]
+            if cls:
+                ty = self.attr_type(rel, cls, attr)
+                if ty:
+                    key = self.find_method(ty[0], ty[1], meth)
+                    if key:
+                        return key, 'attr-type'
+                    return None, 'unres:no-such-method'
+            return None, 'unres:untyped-attr'
+        if kind == 'type':
+            ty = self.resolve_class(rel, target[1])
+            if ty:
+                key = self.find_method(ty[0], ty[1], target[2])
+                if key:
+                    return key, 'local-type'
+                return None, 'unres:no-such-method'
+            return None, 'unres:unknown-type'
+        if kind == 'name':
+            name = target[1]
+            # nested def in the same enclosing function
+            if fn_qual:
+                parent = fn_qual.rsplit('.', 1)[0] if '.' in fn_qual \
+                    else ''
+                for scope in (fn_qual, parent):
+                    cand = f'{scope}.{name}' if scope else name
+                    if cand in mod['functions']:
+                        return f'{rel}::{cand}', 'local-def'
+            if name in mod['module_funcs']:
+                return f'{rel}::{name}', 'module-func'
+            if name in mod['classes']:
+                key = self.find_method(rel, name, '__init__')
+                return (key, 'ctor') if key else (None, 'unres:ctor')
+            if name in mod['from_imports']:
+                src, orig = mod['from_imports'][name]
+                srel = self.mod_rel.get(src)
+                if srel:
+                    smod = self.summaries[srel]
+                    if orig in smod['module_funcs']:
+                        return f'{srel}::{orig}', 'import-func'
+                    if orig in smod['classes']:
+                        key = self.find_method(srel, orig, '__init__')
+                        return (key, 'ctor') if key else (None,
+                                                          'unres:ctor')
+                    return None, 'unres:no-such-export'
+                return None, 'unres:external-module'
+            return None, 'unres:unknown-name'
+        if kind == 'dotted':
+            base, name = target[1], target[2]
+            srel = self.resolve_import(rel, base)
+            if srel:
+                smod = self.summaries[srel]
+                if name in smod['module_funcs']:
+                    return f'{srel}::{name}', 'module-attr'
+                if name in smod['classes']:
+                    key = self.find_method(srel, name, '__init__')
+                    return (key, 'ctor') if key else (None, 'unres:ctor')
+                return None, 'unres:no-such-export'
+            # ClassName.method(...) on a class in scope
+            ty = self.resolve_class(rel, base)
+            if ty:
+                key = self.find_method(ty[0], ty[1], name)
+                if key:
+                    return key, 'class-attr'
+                return None, 'unres:no-such-method'
+            return None, 'unres:external-module'
+        return None, kind if kind.startswith('unres:') else 'unres:other'
+
+
+def _resolve(graph: Graph, summaries: Dict[str, dict]) -> None:
+    res = _Resolver(summaries)
+    graph.resolver = res  # type: ignore[attr-defined]
+    for rel, mod in summaries.items():
+        for qual, s in mod['functions'].items():
+            key = f'{rel}::{qual}'
+            graph.functions[key] = FuncInfo(key, rel, qual, s)
+    for key, fi in graph.functions.items():
+        rel, cls = fi.rel, fi.cls
+        s = summaries[rel]['functions'][fi.qual]
+        for ref in s['entry_locks']:
+            gid = res.lock_gid(rel, cls, ref)
+            if gid and gid not in fi.entry_locks:
+                fi.entry_locks.append(gid)
+                graph.lock_kinds.setdefault(gid, res.lock_kind(gid))
+
+        def held_gids(held):
+            out = []
+            for ref, line, h_exempt in held:
+                gid = res.lock_gid(rel, cls, ref)
+                if gid:
+                    out.append((gid, line, h_exempt))
+            return out
+
+        for ref, line, held, exempt in s['acquires']:
+            gid = res.lock_gid(rel, cls, ref)
+            if gid is None:
+                graph.unresolved['unres:lock'] += 1
+                continue
+            graph.lock_kinds.setdefault(gid, res.lock_kind(gid))
+            graph.lock_sites.setdefault(gid, (rel, line))
+            fi.acquires.append((gid, line, held_gids(held), exempt))
+        for target, line, held in s['calls']:
+            ck, cat = res.resolve_call(rel, cls, target, fi.qual)
+            if ck is None:
+                graph.unresolved[cat] += 1
+            label = _call_label(target)
+            fi.calls.append((ck, cat, line, held_gids(held), label))
+        for kind, line, held in s['blocking']:
+            fi.blocking.append((kind, line, held_gids(held)))
+        for pair, role in fi.pair_roles.items():
+            graph.pairs.setdefault(pair, {}).setdefault(role,
+                                                        set()).add(key)
+
+
+def _call_label(target: list) -> str:
+    kind = target[0]
+    if kind == 'self':
+        return f'self.{target[1]}()'
+    if kind == 'selfattr':
+        return f'self.{target[1]}.{target[2]}()'
+    if kind in ('dotted', 'type'):
+        return f'{target[1]}.{target[2]}()'
+    if kind == 'name':
+        return f'{target[1]}()'
+    return 'call'

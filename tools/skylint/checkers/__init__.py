@@ -1,5 +1,5 @@
 """Checker modules. Importing this package populates the registry."""
 from skylint.checkers import (alert_rules, base,  # noqa: F401
-                              engine_thread, env_flags, event_names,
-                              host_sync, jit_programs, lock_discipline,
-                              metric_names, pycache)
+                              concurrency, engine_thread, env_flags,
+                              event_names, host_sync, jit_programs,
+                              lock_discipline, metric_names, pycache)

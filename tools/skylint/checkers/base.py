@@ -3,10 +3,13 @@ validation of the skylint annotations themselves."""
 from __future__ import annotations
 
 import ast
+import difflib
 from typing import List
 
 from skylint import (KNOWN_DIRECTIVES, MARKERS, REASON_REQUIRED, Checker,
                      Finding, SourceFile, register)
+
+_PAIR_ROLES = ('acquire', 'release', 'transfer')
 
 BANNED_CALLS = {'breakpoint'}
 BANNED_IMPORTS = {'pdb', 'ipdb'}
@@ -85,10 +88,14 @@ class Annotations(Checker):
                     out.append(Finding(sf.rel, line, self.name,
                                        d.malformed))
                 elif d.name not in KNOWN_DIRECTIVES:
+                    close = difflib.get_close_matches(
+                        d.name, sorted(KNOWN_DIRECTIVES), n=1)
+                    hint = (f' — did you mean {close[0]!r}?'
+                            if close else '')
                     out.append(Finding(
                         sf.rel, line, self.name,
-                        f'unknown skylint directive {d.name!r} (have: '
-                        f'{", ".join(sorted(KNOWN_DIRECTIVES))})'))
+                        f'unknown skylint directive {d.name!r}{hint} '
+                        f'(have: {", ".join(sorted(KNOWN_DIRECTIVES))})'))
                 elif d.name in REASON_REQUIRED and not d.arg:
                     out.append(Finding(
                         sf.rel, line, self.name,
@@ -98,7 +105,25 @@ class Annotations(Checker):
                     out.append(Finding(
                         sf.rel, line, self.name,
                         f'directive {d.name!r} takes no argument'))
+                elif d.name == 'resource-pair':
+                    out.extend(self._check_pair_value(sf, line, d.arg))
         return out
+
+    def _check_pair_value(self, sf: SourceFile, line: int,
+                          arg: str) -> List[Finding]:
+        """``resource-pair=NAME.ROLE``: a typo'd role would silently
+        drop the declaration (and with it the whole pair), so the
+        value grammar is validated here with a did-you-mean."""
+        name, _, role = arg.rpartition('.')
+        if name and role in _PAIR_ROLES:
+            return []
+        close = difflib.get_close_matches(role, _PAIR_ROLES, n=1)
+        hint = f" — did you mean '{name}.{close[0]}'?" if close and \
+            name else ''
+        return [Finding(
+            sf.rel, line, self.name,
+            f'resource-pair value {arg!r} must be NAME.ROLE with ROLE '
+            f'one of {", ".join(_PAIR_ROLES)}{hint}')]
 
 
 def _used_names(tree: ast.AST) -> set:

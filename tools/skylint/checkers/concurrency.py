@@ -1,0 +1,845 @@
+"""Interprocedural concurrency rules over the project call graph.
+
+Four rules ride :mod:`skylint.callgraph` (whole-tree graph + cached
+per-function summaries). All of them exist because the high-value
+concurrency bugs live in the *composition* of locally-correct functions
+— none of the per-file rules can see across a call.
+
+``lock-order``
+    Derives the lock-acquisition graph from nested ``with lock:``
+    scopes *through* calls (lock identity seeded by the same
+    ``_GUARDED_BY`` / ``# skylint: locked(...)`` declarations the
+    guarded-by rule reads). Any cycle — including a self-cycle, i.e. a
+    non-reentrant lock re-acquired through a call chain — is a
+    potential deadlock; the finding prints every acquisition chain
+    file:line by file:line. Hatch: ``# skylint: allow-order(reason)``
+    on the *second* acquisition line.
+
+``blocking-under-lock``
+    Nothing from the declared blocking vocabulary (see
+    ``callgraph.BLOCKING_KINDS``) may be reachable while a
+    ``_GUARDED_BY`` lock is held: a remediation thread sleeping under
+    the LB's stats lock freezes ``/health`` fleet-wide. Hatch:
+    ``# skylint: allow-block(reason)`` on the blocking line or def.
+
+``event-loop-block``
+    The same vocabulary is banned in the transitive closure of ``async
+    def`` bodies unless routed through ``run_in_executor`` /
+    ``asyncio.to_thread`` (reference-passing is not a call edge, so the
+    executor pattern is clean by construction) or annotated
+    ``allow-block``.
+
+``resource-pair``
+    Declared acquire/release pairs — ``# skylint:
+    resource-pair=NAME.acquire`` / ``NAME.release`` on the defs, plus
+    the built-in ``tmpfile`` pair (a ``*.tmp`` path must be renamed or
+    unlinked on every path) — must release on *every* path out of a
+    function, including exception edges (try/finally-aware). Ownership
+    may escape instead (result stored/returned/passed on). Hatches:
+    ``# skylint: allow-leak(reason)`` on the acquire line or def;
+    ``NAME.transfer`` on a def documents a runtime-bounded park (TTL,
+    refcount) whose callers are not charged.
+"""
+from __future__ import annotations
+
+import ast
+import difflib
+import pathlib
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from skylint import Checker, Finding, SourceFile, register
+from skylint import callgraph
+
+
+def _short(gid: str) -> str:
+    rel, _, name = gid.partition('::')
+    return f'{name} ({rel})'
+
+
+def _chain_text(chain: List[tuple]) -> str:
+    return '\n'.join(f'      {rel}:{line}: {desc}'
+                     for rel, line, desc in chain)
+
+
+class _GraphRules(Checker):
+    interprocedural = True
+
+    def _graph(self, files: Sequence[SourceFile], root: pathlib.Path
+               ) -> callgraph.Graph:
+        return callgraph.get_graph(files, root)
+
+
+# ==========================================================================
+# Shared closures
+# ==========================================================================
+
+def _locks_reached(graph: callgraph.Graph
+                   ) -> Dict[str, Dict[str, List[tuple]]]:
+    """function key -> {lock gid: acquisition chain from the function's
+    entry}. Chains are (rel, line, desc) triples, shortest-first-found.
+    Order-exempt acquisitions (allow-order) do not propagate."""
+    memo: Dict[str, Dict[str, List[tuple]]] = {}
+
+    def visit(key: str) -> Dict[str, List[tuple]]:
+        if key in memo:
+            return memo[key]
+        memo[key] = {}  # cycle guard: a back-edge sees the empty set
+        fi = graph.functions[key]
+        out: Dict[str, List[tuple]] = {}
+        for gid, line, _held, exempt in fi.acquires:
+            if not exempt:
+                out.setdefault(gid, [(fi.rel, line,
+                                      f'acquires {_short(gid)}')])
+        for ck, _cat, line, _held, label in fi.calls:
+            if ck is None or ck not in graph.functions:
+                continue
+            sub = visit(ck)
+            hop = (fi.rel, line,
+                   f'calls {label} -> {graph.functions[ck].qual}')
+            for gid, chain in sub.items():
+                if gid not in out:
+                    out[gid] = [hop] + chain
+        memo[key] = out
+        return out
+
+    for key in graph.functions:
+        visit(key)
+    return memo
+
+
+def _blocks_reached(graph: callgraph.Graph
+                    ) -> Dict[str, Optional[tuple]]:
+    """function key -> (kind, chain) for one representative blocking
+    site reachable from it, or None. allow-block functions absorb."""
+    memo: Dict[str, Optional[tuple]] = {}
+
+    def visit(key: str) -> Optional[tuple]:
+        if key in memo:
+            return memo[key]
+        memo[key] = None  # cycle guard
+        fi = graph.functions[key]
+        result = None
+        if not fi.allow_block:
+            for kind, line, _held in fi.blocking:
+                result = (kind, [(fi.rel, line, f'blocking {kind}')])
+                break
+            if result is None:
+                for ck, _cat, line, _held, label in fi.calls:
+                    if ck is None or ck not in graph.functions:
+                        continue
+                    sub = visit(ck)
+                    if sub is not None:
+                        hop = (fi.rel, line,
+                               f'calls {label} -> '
+                               f'{graph.functions[ck].qual}')
+                        result = (sub[0], [hop] + sub[1])
+                        break
+        memo[key] = result
+        return result
+
+    for key in graph.functions:
+        visit(key)
+    return memo
+
+
+# ==========================================================================
+# (1) lock-order
+# ==========================================================================
+
+@register
+class LockOrder(_GraphRules):
+    """Cross-tree lock-acquisition cycles (potential deadlocks)."""
+
+    name = 'lock-order'
+
+    def check_tree(self, files: Sequence[SourceFile],
+                   root: pathlib.Path) -> List[Finding]:
+        graph = self._graph(files, root)
+        reached = _locks_reached(graph)
+        # edge (A, B) -> witness chain: acquire A ... acquire B
+        edges: Dict[Tuple[str, str], List[tuple]] = {}
+        out: List[Finding] = []
+        for key, fi in graph.functions.items():
+            for gid, line, held, exempt in fi.acquires:
+                if exempt:
+                    continue
+                for h, hline, h_ex in held:
+                    if h_ex:
+                        continue  # allow-order'd holder: no edges from it
+                    if h == gid:
+                        if graph.lock_kinds.get(gid) != 'rlock':
+                            out.append(self._self_deadlock(
+                                fi, gid, hline, line))
+                        continue
+                    edges.setdefault((h, gid), [
+                        (fi.rel, hline, f'acquires {_short(h)}'),
+                        (fi.rel, line, f'acquires {_short(gid)}')])
+            for ck, _cat, line, held, label in fi.calls:
+                if ck is None or not held or ck not in graph.functions:
+                    continue
+                for gid, chain in reached.get(ck, {}).items():
+                    hop = (fi.rel, line,
+                           f'calls {label} -> '
+                           f'{graph.functions[ck].qual}')
+                    for h, hline, h_ex in held:
+                        if h_ex:
+                            continue
+                        if h == gid:
+                            if graph.lock_kinds.get(gid) != 'rlock':
+                                out.append(self._self_deadlock(
+                                    fi, gid, hline, line,
+                                    [hop] + chain))
+                            continue
+                        edges.setdefault((h, gid), [
+                            (fi.rel, hline,
+                             f'acquires {_short(h)}'), hop] + chain)
+        out.extend(self._cycles(edges))
+        return out
+
+    def _self_deadlock(self, fi, gid, hline, line,
+                       chain=None) -> Finding:
+        body = _chain_text(
+            [(fi.rel, hline, f'acquires {_short(gid)}')]
+            + (chain or [(fi.rel, line,
+                          f're-acquires {_short(gid)}')]))
+        return Finding(
+            fi.rel, hline, self.name,
+            f'self-deadlock: non-reentrant {_short(gid)} is '
+            f're-acquired while already held in {fi.qual}():\n{body}\n'
+            '    (make the inner path a _locked helper, or annotate '
+            'the inner acquisition # skylint: allow-order(reason))',
+            involved=tuple({r for r, _, _ in
+                            ([(fi.rel, 0, '')] + (chain or []))}))
+
+    def _cycles(self, edges: Dict[Tuple[str, str], List[tuple]]
+                ) -> List[Finding]:
+        adj: Dict[str, Set[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, set()).add(b)
+        out: List[Finding] = []
+        seen: Set[frozenset] = set()
+        # 2-cycles first (the common shape) ...
+        for (a, b) in sorted(edges):
+            if (b, a) in edges and frozenset((a, b)) not in seen:
+                seen.add(frozenset((a, b)))
+                out.append(self._cycle_finding([(a, b), (b, a)], edges))
+        # ... then longer cycles not already covered, via DFS (bounded).
+        for start in sorted(adj):
+            path = [start]
+
+            def dfs(node, depth):
+                if depth > 4:
+                    return None
+                for nxt in sorted(adj.get(node, ())):
+                    if nxt == start and len(path) > 2:
+                        return list(path)
+                    if nxt in path:
+                        continue
+                    path.append(nxt)
+                    got = dfs(nxt, depth + 1)
+                    path.pop()
+                    if got:
+                        return got
+                return None
+
+            cyc = dfs(start, 1)
+            if cyc and frozenset(cyc) not in seen:
+                seen.add(frozenset(cyc))
+                pairs = [(cyc[i], cyc[(i + 1) % len(cyc)])
+                         for i in range(len(cyc))]
+                out.append(self._cycle_finding(pairs, edges))
+        return out
+
+    def _cycle_finding(self, pairs, edges) -> Finding:
+        locks = ' -> '.join(_short(a) for a, _ in pairs)
+        parts = []
+        involved: Set[str] = set()
+        for a, b in pairs:
+            chain = edges[(a, b)]
+            involved.update(r for r, _, _ in chain)
+            parts.append(f'    chain {_short(a)} -> {_short(b)}:\n'
+                         + _chain_text(chain))
+        first = edges[pairs[0]][0]
+        return Finding(
+            first[0], first[1], self.name,
+            f'lock-order cycle {locks} -> {_short(pairs[0][0])} — two '
+            'threads taking these locks in opposite orders can '
+            'deadlock:\n' + '\n'.join(parts) + '\n    (fix the order, '
+            'or annotate the second acquisition '
+            '# skylint: allow-order(reason))',
+            involved=tuple(sorted(involved)))
+
+
+# ==========================================================================
+# (2) blocking-under-lock
+# ==========================================================================
+
+@register
+class BlockingUnderLock(_GraphRules):
+    """Declared-blocking vocabulary unreachable while holding any
+    ``_GUARDED_BY`` lock."""
+
+    name = 'blocking-under-lock'
+
+    def check_tree(self, files: Sequence[SourceFile],
+                   root: pathlib.Path) -> List[Finding]:
+        graph = self._graph(files, root)
+        blocks = _blocks_reached(graph)
+        out: List[Finding] = []
+        for key, fi in graph.functions.items():
+            if fi.allow_block:
+                continue
+            for kind, line, held in fi.blocking:
+                for h, hline, _h_ex in held:
+                    out.append(Finding(
+                        fi.rel, line, self.name,
+                        f'blocking call ({kind}) while holding '
+                        f'{_short(h)} (acquired {fi.rel}:{hline}) in '
+                        f'{fi.qual}() — every other thread touching '
+                        'that lock stalls behind this I/O; move it '
+                        'outside the critical section or annotate '
+                        '# skylint: allow-block(reason)'))
+                    break  # one finding per site, not per lock
+            for ck, _cat, line, held, label in fi.calls:
+                if ck is None or not held or ck not in graph.functions:
+                    continue
+                sub = blocks.get(ck)
+                if sub is None:
+                    continue
+                kind, chain = sub
+                h, hline = held[0][0], held[0][1]
+                hop = (fi.rel, line,
+                       f'calls {label} -> {graph.functions[ck].qual}')
+                body = _chain_text([hop] + chain)
+                out.append(Finding(
+                    fi.rel, line, self.name,
+                    f'blocking call ({kind}) reachable while holding '
+                    f'{_short(h)} (acquired {fi.rel}:{hline}) in '
+                    f'{fi.qual}():\n{body}\n    (move the blocking '
+                    'work outside the lock or annotate the blocking '
+                    'site # skylint: allow-block(reason))',
+                    involved=tuple({r for r, _, _ in chain})))
+        return out
+
+
+# ==========================================================================
+# (3) event-loop-block
+# ==========================================================================
+
+@register
+class EventLoopBlock(_GraphRules):
+    """Blocking vocabulary banned in the transitive closure of ``async
+    def`` bodies (run_in_executor / to_thread are clean by
+    construction: passing a callable is not a call edge)."""
+
+    name = 'event-loop-block'
+
+    def check_tree(self, files: Sequence[SourceFile],
+                   root: pathlib.Path) -> List[Finding]:
+        graph = self._graph(files, root)
+        # BFS from async roots, remembering one shortest chain each.
+        chain_to: Dict[str, List[tuple]] = {}
+        frontier: List[str] = []
+        for key, fi in graph.functions.items():
+            if fi.is_async and not fi.allow_block:
+                chain_to[key] = [(fi.rel, fi.line,
+                                  f'async def {fi.qual}')]
+                frontier.append(key)
+        while frontier:
+            nxt: List[str] = []
+            for key in frontier:
+                fi = graph.functions[key]
+                for ck, _cat, line, _held, label in fi.calls:
+                    if ck is None or ck in chain_to or \
+                            ck not in graph.functions:
+                        continue
+                    tfi = graph.functions[ck]
+                    if tfi.allow_block:
+                        continue
+                    chain_to[ck] = chain_to[key] + [
+                        (fi.rel, line, f'calls {label} -> {tfi.qual}')]
+                    nxt.append(ck)
+            frontier = nxt
+        out: List[Finding] = []
+        for key, chain in chain_to.items():
+            fi = graph.functions[key]
+            for kind, line, _held in fi.blocking:
+                body = _chain_text(
+                    chain + [(fi.rel, line, f'blocking {kind}')])
+                out.append(Finding(
+                    fi.rel, line, self.name,
+                    f'blocking call ({kind}) on the event loop — '
+                    'reachable from an async def, so every in-flight '
+                    f'request on this process stalls:\n{body}\n    '
+                    '(route through run_in_executor/asyncio.to_thread '
+                    'or annotate # skylint: allow-block(reason))',
+                    involved=tuple({r for r, _, _ in chain})))
+        return out
+
+
+# ==========================================================================
+# (4) resource-pair
+# ==========================================================================
+
+_ESCAPE_SAFE_CALLS = {'len', 'str', 'int', 'float', 'bool', 'repr',
+                      'isinstance', 'id', 'type', 'sorted', 'list',
+                      'tuple', 'dict', 'set', 'min', 'max', 'format'}
+_TMP_RELEASE_ATTRS = {'rename', 'replace', 'unlink', 'remove', 'move'}
+
+
+@register
+class ResourcePair(_GraphRules):
+    """Declared acquire/release pairs release on every path, including
+    exception edges."""
+
+    name = 'resource-pair'
+
+    def check_tree(self, files: Sequence[SourceFile],
+                   root: pathlib.Path) -> List[Finding]:
+        graph = self._graph(files, root)
+        out: List[Finding] = []
+        out.extend(self._validate_pairs(graph))
+        acquire_names: Dict[str, str] = {}   # def basename -> pair
+        release_names: Dict[str, str] = {}
+        acquire_keys: Dict[str, str] = {}    # key -> pair
+        release_keys: Dict[str, str] = {}
+        transfer_keys: Set[str] = set()
+        for pair, roles in graph.pairs.items():
+            for k in roles.get('acquire', ()):
+                acquire_keys[k] = pair
+                acquire_names[graph.functions[k].name] = pair
+            for k in roles.get('release', ()):
+                release_keys[k] = pair
+                release_names[graph.functions[k].name] = pair
+            transfer_keys |= roles.get('transfer', set())
+        # Candidate files come from the GRAPH, not a text scan: a file
+        # matters iff some function in it calls a declared acquire
+        # (resolved key, or — matching _FnCheck's fallback — a
+        # distinctive acquire name in the call label), or its source
+        # mentions a '.tmp' literal (the built-in pair). Everything
+        # else skips the expensive re-parse + path walk, which is what
+        # keeps the warm --changed loop subsecond-ish.
+        distinctive = [n for n in acquire_names if len(n) >= 8]
+        candidates: Set[str] = set()
+        for fi in graph.functions.values():
+            if fi.rel in candidates:
+                continue
+            for ck, _cat, _line, _held, label in fi.calls:
+                if ck in acquire_keys or \
+                        any(n in label for n in distinctive):
+                    candidates.add(fi.rel)
+                    break
+        by_path = {str(sf.path): sf for sf in files}
+        tree_dir = root / callgraph.TREE_PREFIX
+        if tree_dir.is_dir():
+            for p in sorted(tree_dir.rglob('*.py')):
+                if '__pycache__' in p.parts:
+                    continue
+                sf = by_path.get(str(p))
+                rel = str(p.relative_to(root))
+                if rel not in candidates:
+                    # '.tmp' check: cheap byte scan, no parse.
+                    try:
+                        text = sf.text if sf is not None else \
+                            p.read_text(encoding='utf-8')
+                    except (OSError, UnicodeDecodeError):
+                        continue
+                    if '.tmp' not in text:
+                        continue
+                if sf is None:
+                    try:
+                        sf = SourceFile(p, root)
+                    except (OSError, UnicodeDecodeError):
+                        continue
+                if sf.tree is None:
+                    continue
+                out.extend(self._check_source(
+                    sf, graph, acquire_keys, release_keys,
+                    acquire_names, release_names, transfer_keys))
+        return out
+
+    def _validate_pairs(self, graph: callgraph.Graph) -> List[Finding]:
+        out: List[Finding] = []
+        names = sorted(graph.pairs)
+        for pair, roles in sorted(graph.pairs.items()):
+            if 'acquire' in roles and not ({'release', 'transfer'}
+                                           & roles.keys()):
+                k = sorted(roles['acquire'])[0]
+                fi = graph.functions[k]
+                others = [n for n in names if n != pair]
+                hint = difflib.get_close_matches(pair, others, n=1)
+                hint_txt = (f" — did you mean '{hint[0]}'?"
+                            if hint else '')
+                out.append(Finding(
+                    fi.rel, fi.line, self.name,
+                    f"resource pair '{pair}' declares an acquire but "
+                    f'no release/transfer anywhere in the tree'
+                    f'{hint_txt} (a pair nobody can release is either '
+                    'a typo or a leak by construction)'))
+        return out
+
+    def _check_source(self, sf: SourceFile, graph, acquire_keys,
+                      release_keys, acquire_names, release_names,
+                      transfer_keys) -> List[Finding]:
+        out: List[Finding] = []
+        res = graph.resolver
+        for qual, fn, cls in callgraph._iter_functions(sf.tree):
+            key = f'{sf.rel}::{qual}'
+            if key in transfer_keys or key in acquire_keys:
+                continue  # the def IS the acquire surface: callers pay
+            if any(d.name == 'allow-leak'
+                   for d in sf.func_directives(fn)):
+                continue
+            out.extend(_FnCheck(
+                sf, fn, cls, graph, acquire_keys, release_keys,
+                acquire_names, release_names, self.name).run())
+        return out
+
+
+class _FnCheck:
+    """Path-sensitive local walk: tracks open holdings per pair, flags
+    exception-edge and fall-through leaks."""
+
+    def __init__(self, sf, fn, cls, graph, acquire_keys, release_keys,
+                 acquire_names, release_names, rule):
+        self.sf = sf
+        self.fn = fn
+        self.cls = cls
+        self.graph = graph
+        self.res = graph.resolver
+        self.acquire_keys = acquire_keys
+        self.release_keys = release_keys
+        self.acquire_names = acquire_names
+        self.release_names = release_names
+        self.rule = rule
+        self.out: List[Finding] = []
+        self.local_types = callgraph.collect_local_types(fn)
+        self.tmp_vars = self._tmp_vars()
+
+    def run(self) -> List[Finding]:
+        state: List[dict] = []   # holdings: {pair, var, line, reported}
+        self._walk(self.fn.body, state, protected=frozenset())
+        for h in state:
+            if not h['reported']:
+                self._leak(h, h['line'], 'not released on the '
+                           'fall-through path out of')
+        return self.out
+
+    # -- classification -----------------------------------------------------
+
+    def _tmp_vars(self) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(self.fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                if any(isinstance(s, ast.Constant)
+                       and isinstance(s.value, str) and '.tmp' in s.value
+                       for s in ast.walk(node.value)):
+                    out.add(node.targets[0].id)
+        return out
+
+    def _names_in(self, node) -> Set[str]:
+        return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+    def _pair_of_call(self, call: ast.Call, table_keys, table_names
+                      ) -> Optional[str]:
+        # The SAME target classification the summary walker uses
+        # (callgraph.symbolic_target), so the two analyses cannot
+        # drift on which call shapes resolve.
+        target = callgraph.symbolic_target(call, self.local_types)
+        key, _cat = self.res.resolve_call(self.sf.rel, self.cls,
+                                          target)
+        if key is not None:
+            return table_keys.get(key)
+        # name fallback for unresolved receivers, distinctive names only
+        f = call.func
+        name = (f.id if isinstance(f, ast.Name)
+                else getattr(f, 'attr', None))
+        if name and len(name) >= 8 and name in table_names:
+            return table_names[name]
+        return None
+
+    def _is_tmp_acquire(self, call: ast.Call) -> Optional[str]:
+        """Returns the tmp var name when this call creates a *.tmp
+        path's content (open / write_text / write_bytes)."""
+        f = call.func
+        args_names = set()
+        for a in call.args[:1]:
+            args_names |= self._names_in(a)
+        if isinstance(f, ast.Name) and f.id == 'open' and call.args:
+            hit = args_names & self.tmp_vars
+            if hit:
+                return sorted(hit)[0]
+        if isinstance(f, ast.Attribute) and \
+                f.attr in ('write_text', 'write_bytes') and \
+                isinstance(f.value, ast.Name) and \
+                f.value.id in self.tmp_vars:
+            return f.value.id
+        return None
+
+    def _is_tmp_release(self, call: ast.Call, var: str) -> bool:
+        f = call.func
+        if not isinstance(f, ast.Attribute) or \
+                f.attr not in _TMP_RELEASE_ATTRS:
+            return False
+        mentioned = set()
+        for a in list(call.args) + [k.value for k in call.keywords]:
+            mentioned |= self._names_in(a)
+        if isinstance(f.value, ast.Name) and f.value.id == var:
+            return True  # tmp.rename(...) / tmp.unlink()
+        return var in mentioned
+
+    # -- the walk -----------------------------------------------------------
+
+    def _leak(self, holding: dict, line: int, why: str) -> None:
+        holding['reported'] = True
+        label = holding['pair']
+        self.out.append(Finding(
+            self.sf.rel, line, self.rule,
+            f"resource '{label}' acquired at {self.sf.rel}:"
+            f"{holding['line']} is {why} {self.fn.name}() — release "
+            'it on every path (try/finally), let ownership escape, or '
+            'annotate the acquisition # skylint: allow-leak(reason)'))
+
+    def _walk(self, stmts, state: List[dict],
+              protected: frozenset) -> None:
+        for stmt in stmts:
+            self._stmt(stmt, state, protected)
+
+    def _stmt(self, stmt, state: List[dict],
+              protected: frozenset) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.Try):
+            # A release in `finally` (or in a handler body — the
+            # handler's type filter is trusted to match what the
+            # guarded code can raise) protects the try body's
+            # exception edges for that pair.
+            rel_final = self._released_in(stmt.finalbody)
+            rel_handlers = self._released_in(
+                [s for h in stmt.handlers for s in h.body])
+            inner_prot = protected | rel_final | rel_handlers
+            # Handlers run from the TRY-ENTRY state: if the acquire
+            # itself raised, nothing was acquired (`try: x = alloc()
+            # except ...: return` is leak-free). A leak between a
+            # mid-body acquire and the handler is still caught — the
+            # risky call inside the body is an exception edge unless a
+            # handler/finally releases the pair.
+            entry = [dict(h) for h in state]
+            self._walk(stmt.body, state, inner_prot)
+            for h in stmt.handlers:
+                hstate = [dict(x) for x in entry]
+                self._walk(h.body, hstate, protected)
+            self._walk(stmt.orelse, state, protected)
+            self._walk(stmt.finalbody, state, protected)
+            return
+        if isinstance(stmt, (ast.If,)):
+            self._risky_expr(stmt.test, state, protected)
+            a = [dict(h) for h in state]
+            b = [dict(h) for h in state]
+            # Truthiness guards: `if not ctx: ...` means the acquire
+            # was a no-op on that branch (the falsy-CM idiom the
+            # tracer uses for unsampled requests) — drop the holding
+            # there instead of flagging the early return.
+            falsy, truthy = _truthiness_vars(stmt.test)
+            a = [h for h in a if h['var'] not in falsy]
+            b = [h for h in b if h['var'] not in truthy]
+            self._walk(stmt.body, a, protected)
+            self._walk(stmt.orelse, b, protected)
+            state[:] = _merge(a, b)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._risky_expr(stmt.iter, state, protected)
+            a = [dict(h) for h in state]
+            self._walk(stmt.body, a, protected)
+            self._walk(stmt.orelse, a, protected)
+            state[:] = _merge(state, a)
+            return
+        if isinstance(stmt, ast.While):
+            self._risky_expr(stmt.test, state, protected)
+            a = [dict(h) for h in state]
+            self._walk(stmt.body, a, protected)
+            state[:] = _merge(state, a)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                ctx = item.context_expr
+                # `with ctx:` (or `with ctx if ctx else null():`) over
+                # a held CM-style resource: its __exit__ releases.
+                if not isinstance(ctx, ast.Call):
+                    names = self._names_in(ctx)
+                    state[:] = [h for h in state
+                                if h['var'] not in names]
+                if isinstance(ctx, ast.Call):
+                    pair = self._pair_of_call(ctx, self.acquire_keys,
+                                              self.acquire_names)
+                    if pair is not None:
+                        continue  # CM acquire: balanced by __exit__
+                    tmp = self._is_tmp_acquire(ctx)
+                    if tmp is not None:
+                        self._acquire(state, 'tmpfile', tmp,
+                                      ctx.lineno)
+                        continue
+                    self._risky_expr(ctx, state, protected)
+            self._walk(stmt.body, state, protected)
+            return
+        if isinstance(stmt, ast.Assign) and \
+                isinstance(stmt.value, ast.Call):
+            pair = self._pair_of_call(stmt.value, self.acquire_keys,
+                                      self.acquire_names)
+            tmp = None if pair else self._is_tmp_acquire(stmt.value)
+            if pair is not None or tmp is not None:
+                var = None
+                if len(stmt.targets) == 1 and \
+                        isinstance(stmt.targets[0], ast.Name):
+                    var = stmt.targets[0].id
+                elif len(stmt.targets) == 1:
+                    return  # acquired straight into a structure: escape
+                self._acquire(state, pair or 'tmpfile',
+                              var if pair else tmp, stmt.value.lineno)
+                return
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                names = self._names_in(stmt.value)
+                if isinstance(stmt.value, ast.Call):
+                    pair = self._pair_of_call(
+                        stmt.value, self.acquire_keys,
+                        self.acquire_names)
+                    if pair is not None:
+                        return  # acquired-and-returned: caller owns it
+                state[:] = [h for h in state if h['var'] not in names]
+            for h in state:
+                if not h['reported'] and h['pair'] not in protected:
+                    self._leak(h, stmt.lineno,
+                               'still held at the return from')
+            state[:] = [h for h in state if h['reported']]
+            return
+        if isinstance(stmt, ast.Raise):
+            for h in state:
+                if not h['reported'] and h['pair'] not in protected:
+                    self._leak(h, stmt.lineno,
+                               'leaked by the raise in')
+            state[:] = [h for h in state if h['reported']]
+            return
+        # generic statement: releases, escapes, then risky calls
+        self._risky_expr(stmt, state, protected)
+
+    def _acquire(self, state, pair, var, line) -> None:
+        if self.sf.suppression(line, 'allow-leak'):
+            return
+        state.append({'pair': pair, 'var': var, 'line': line,
+                      'reported': False})
+
+    def _risky_expr(self, node, state: List[dict],
+                    protected: frozenset) -> None:
+        """Process calls inside an arbitrary statement/expression:
+        release matches, ownership escapes, and exception edges."""
+        if not state:
+            # still record acquisitions appearing as bare expressions
+            for call in _calls_in(node):
+                pair = self._pair_of_call(call, self.acquire_keys,
+                                          self.acquire_names)
+                tmp = None if pair else self._is_tmp_acquire(call)
+                if pair is not None:
+                    self._acquire(state, pair, None, call.lineno)
+                elif tmp is not None:
+                    self._acquire(state, 'tmpfile', tmp, call.lineno)
+            return
+        # escapes via storing a held var into a structure
+        if isinstance(node, ast.Assign):
+            names = self._names_in(node.value)
+            stores = any(isinstance(t, (ast.Attribute, ast.Subscript))
+                         for t in node.targets)
+            if stores:
+                state[:] = [h for h in state if h['var'] not in names]
+        for call in _calls_in(node):
+            pair = self._pair_of_call(call, self.release_keys,
+                                      self.release_names)
+            if pair is not None:
+                state[:] = [h for h in state if h['pair'] != pair]
+                continue
+            tmp_rel = [h for h in state if h['pair'] == 'tmpfile'
+                       and h['var'] and self._is_tmp_release(
+                           call, h['var'])]
+            if tmp_rel:
+                ids = {id(h) for h in tmp_rel}
+                state[:] = [h for h in state if id(h) not in ids]
+                continue
+            acq = self._pair_of_call(call, self.acquire_keys,
+                                     self.acquire_names)
+            if acq is not None:
+                self._acquire(state, acq, None, call.lineno)
+                continue
+            tmp = self._is_tmp_acquire(call)
+            if tmp is not None:
+                self._acquire(state, 'tmpfile', tmp, call.lineno)
+                continue
+            fname = (call.func.id if isinstance(call.func, ast.Name)
+                     else getattr(call.func, 'attr', ''))
+            if fname in _ESCAPE_SAFE_CALLS:
+                continue  # neither an escape nor an exception edge
+            # ownership escape: held var passed onward
+            arg_names = set()
+            for a in list(call.args) + [k.value for k in call.keywords]:
+                arg_names |= self._names_in(a)
+            escaped = [h for h in state
+                       if h['var'] and h['var'] in arg_names
+                       and h['pair'] != 'tmpfile']
+            if escaped:
+                ids = {id(h) for h in escaped}
+                state[:] = [h for h in state if id(h) not in ids]
+                continue
+            # exception edge
+            if self.sf.suppression(call.lineno, 'allow-leak'):
+                continue
+            for h in state:
+                if not h['reported'] and h['pair'] not in protected:
+                    self._leak(
+                        h, call.lineno,
+                        f'leaked if {fname or "this call"}() raises in')
+
+    def _released_in(self, stmts) -> frozenset:
+        pairs: Set[str] = set()
+        for stmt in stmts:
+            for call in _calls_in(stmt):
+                p = self._pair_of_call(call, self.release_keys,
+                                       self.release_names)
+                if p is not None:
+                    pairs.add(p)
+                f = call.func
+                if isinstance(f, ast.Attribute) and \
+                        f.attr in _TMP_RELEASE_ATTRS:
+                    pairs.add('tmpfile')
+        return frozenset(pairs)
+
+
+def _calls_in(node) -> List[ast.Call]:
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return []
+    return [n for n in ast.walk(node) if isinstance(n, ast.Call)]
+
+
+def _truthiness_vars(test) -> Tuple[Set[str], Set[str]]:
+    """(names falsy in the body branch, names truthy in the body
+    branch) for simple `if v:` / `if not v:` tests."""
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not) \
+            and isinstance(test.operand, ast.Name):
+        return {test.operand.id}, set()
+    if isinstance(test, ast.Name):
+        return set(), {test.id}
+    return set(), set()
+
+
+def _merge(a: List[dict], b: List[dict]) -> List[dict]:
+    """Union of live holdings by (pair, var, line)."""
+    out: List[dict] = []
+    seen: Set[tuple] = set()
+    for h in a + b:
+        if h['reported']:
+            continue
+        key = (h['pair'], h['var'], h['line'])
+        if key not in seen:
+            seen.add(key)
+            out.append(h)
+    return out

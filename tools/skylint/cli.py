@@ -2,17 +2,26 @@
 
 ``python tools/lint.py``            full suite (the `make lint` gate)
 ``python tools/skylint``            same
-``python tools/skylint --changed``  per-file rules over git-dirty files
-                                    only (the subsecond inner loop;
-                                    tree-wide cross-checks are skipped
-                                    except git bytecode hygiene)
-``python tools/skylint PATH ...``   per-file rules over specific files
+``python tools/skylint --changed``  per-file rules + interprocedural
+                                    concurrency rules over git-dirty
+                                    files only (the subsecond inner
+                                    loop; other tree-wide cross-checks
+                                    are skipped except git bytecode
+                                    hygiene)
+``python tools/skylint PATH ...``   same, over specific files
+``--format json``                   machine-readable findings with
+                                    stable ids (CI diff annotation)
+``--graph-stats``                   call-graph resolution stats — the
+                                    explicit unresolved-call soundness
+                                    gap, made visible
 """
 from __future__ import annotations
 
 import argparse
+import json
 import pathlib
 import subprocess
+import sys
 from typing import List, Optional
 
 import skylint
@@ -28,6 +37,8 @@ def _changed_files(root: pathlib.Path) -> List[pathlib.Path]:
         check=False)
     out = []
     for line in proc.stdout.splitlines():
+        # Deleted files (worktree or index side) have nothing to lint;
+        # for renames only the right-hand (new) name exists on disk.
         if len(line) < 4 or line[0] == 'D' or line[1] == 'D':
             continue
         path = line[3:].split(' -> ')[-1].strip().strip('"')
@@ -38,18 +49,43 @@ def _changed_files(root: pathlib.Path) -> List[pathlib.Path]:
     return sorted(out)
 
 
+def _emit_json(findings, nfiles: int) -> None:
+    # Stable ids: digit-masked blake2s over (rule, path, message), with
+    # a -N suffix de-duplicating same-shaped findings in one file.
+    seen: dict = {}
+    items = []
+    for f in findings:
+        fid = f.stable_id()
+        seen[fid] = seen.get(fid, 0) + 1
+        if seen[fid] > 1:
+            fid = f'{fid}-{seen[fid]}'
+        items.append({'id': fid, 'path': f.path, 'line': f.line,
+                      'rule': f.rule, 'message': f.message,
+                      'involved': sorted(f.involved)})
+    print(json.dumps({'findings': items, 'files': nfiles},
+                     indent=1, sort_keys=True))
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog='skylint', description=skylint.__doc__.splitlines()[0])
     parser.add_argument('paths', nargs='*',
                         help='files to lint (default: the whole tree)')
     parser.add_argument('--changed', action='store_true',
-                        help='lint only git-dirty files (per-file rules)')
+                        help='lint only git-dirty files (per-file rules '
+                             '+ interprocedural concurrency rules)')
+    parser.add_argument('--format', choices=('text', 'json'),
+                        default='text',
+                        help='findings as text (default) or JSON with '
+                             'stable ids for CI annotation')
     parser.add_argument('--list-checkers', action='store_true',
                         help='print the registered rules and exit')
+    parser.add_argument('--graph-stats', action='store_true',
+                        help='print call-graph resolution stats '
+                             '(incl. the unresolved-call categories) '
+                             'and exit')
     args = parser.parse_args(argv)
     if args.list_checkers:
-        import sys
         for checker in skylint.all_checkers():
             doc = (checker.__doc__
                    or sys.modules[type(checker).__module__].__doc__
@@ -57,16 +93,35 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f'{checker.name}: {doc[0] if doc else ""}')
         return 0
     root = skylint.ROOT
+    if args.graph_stats:
+        from skylint import callgraph
+        graph = callgraph.get_graph([], root)
+        print(json.dumps(graph.stats(), indent=1, sort_keys=True))
+        return 0
     if args.changed:
         paths: Optional[List[pathlib.Path]] = _changed_files(root)
         tree_wide = False
     elif args.paths:
-        paths = [pathlib.Path(p).resolve() for p in args.paths]
+        # A nonexistent explicit path (deleted/renamed since the caller
+        # listed it) is skipped with a note, not a crash.
+        paths = []
+        for p in args.paths:
+            rp = pathlib.Path(p).resolve()
+            if rp.is_file():
+                paths.append(rp)
+            else:
+                # stderr: stdout is the machine-readable surface under
+                # --format json and must stay parseable.
+                print(f'skylint: skipping missing file {p}',
+                      file=sys.stderr)
         tree_wide = False
     else:
         paths = None
         tree_wide = True
     findings, nfiles = skylint.run(paths, root, tree_wide=tree_wide)
+    if args.format == 'json':
+        _emit_json(findings, nfiles)
+        return 1 if findings else 0
     for f in findings:
         print(f)
     scope = 'changed file(s)' if args.changed else 'file(s)'
