@@ -72,6 +72,7 @@ verify:
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --goodput
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --ckpt
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --blackbox
+	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --autopsy
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --slo
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --profile
 

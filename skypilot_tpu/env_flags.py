@@ -127,6 +127,33 @@ FLAGS: Tuple[Flag, ...] = (
     Flag('SKYTPU_TRACE_PARENT', 'str', None,
          'Inherited trace-context header value for server-spawned '
          'request runners (keeps child spans in the parent trace).'),
+    Flag('SKYTPU_TRACE_TAIL', 'bool', '1',
+         'Tail-based trace retention: trace every request into a '
+         'short-lived pending buffer and keep-vs-drop on a retention '
+         'verdict at completion (slow/error/shed/evicted/resumed/'
+         'slo_breach/recompile_storm/baseline).'),
+    Flag('SKYTPU_TRACE_TAIL_RING', 'int', '128',
+         'Per-process bounded ring of RETAINED (verdict-kept) '
+         'traces.'),
+    Flag('SKYTPU_TRACE_TAIL_KEEP', 'int', '256',
+         'Max retained keep-* spool files kept (their own rotation '
+         'budget — ring-overflow rotation never evicts kept traces).'),
+    Flag('SKYTPU_TRACE_TAIL_PENDING', 'int', '256',
+         'Max trace ids parked in the tail-pending buffer awaiting a '
+         'late (LB-propagated) retention verdict.'),
+    Flag('SKYTPU_TRACE_TAIL_PENDING_S', 'float', '120',
+         'Tail-pending fragment lifetime before it is dropped '
+         'unkept.'),
+    Flag('SKYTPU_TRACE_TAIL_LATENCY_MS', 'map', None,
+         "Per-QoS-class keep thresholds for end-to-end latency, e.g. "
+         "'interactive:500,batch:30000' (or one bare number for every "
+         'class); unset = auto-derive 2x the recent window p95.'),
+    Flag('SKYTPU_TRACE_TAIL_TTFT_MS', 'map', None,
+         'Per-QoS-class keep thresholds for TTFT (same syntax as '
+         'SKYTPU_TRACE_TAIL_LATENCY_MS); unset = auto-derived.'),
+    Flag('SKYTPU_TRACE_TAIL_BASELINE_PER_MIN', 'float', '2',
+         'Budget of boring traces kept per minute as a comparison '
+         'baseline (0 disables the baseline verdict).'),
     # -- serving: replica / LLM server --------------------------------
     Flag('SKYTPU_REPLICA_PORT', 'int', '8001',
          'Port a serving replica binds.'),

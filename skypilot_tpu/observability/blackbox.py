@@ -357,9 +357,14 @@ def _trace_snapshot() -> Dict[str, Any]:
         return {
             'open': trace_lib.open_spans(limit=32),
             'recent': trace_lib.collect(limit=8, include_exported=False),
+            # Tail-retention keeps: the journeys this process had just
+            # decided were interesting — a post-mortem fetches them by
+            # id (/debug/traces?trace_id=, LB ?stitch=1) even after the
+            # recency ring churned past them.
+            'retained': trace_lib.retained_ids(limit=16),
         }
     except Exception:  # noqa: BLE001 — tracing off/broken: still dump
-        return {'open': [], 'recent': []}
+        return {'open': [], 'recent': [], 'retained': []}
 
 
 def _profiler_snapshot() -> Optional[Dict[str, Any]]:

@@ -818,6 +818,14 @@ def firing() -> List[Dict[str, Any]]:
             if a.get('state') == 'firing']
 
 
+def firing_rules() -> List[str]:
+    """Just the rule names currently firing — the cheap membership
+    check tail-based trace retention runs at every request completion
+    (observability/trace.py verdict ``slo_breach``: a journey that
+    overlapped a firing rule is kept as forensic context for it)."""
+    return sorted({a['rule'] for a in firing() if a.get('rule')})
+
+
 def rules_catalog() -> List[Dict[str, Any]]:
     return [dataclasses.asdict(r) for r in RULES]
 

@@ -1,4 +1,5 @@
-"""End-to-end request tracing with per-phase spans.
+"""End-to-end request tracing with per-phase spans and tail-based
+retention.
 
 Reference analog: none in the reference (it ships Chrome-trace profiling
 of control-plane verbs, ``sky/utils/timeline.py`` — mirrored here as
@@ -22,29 +23,49 @@ Concepts:
   and nested sync calls see the current span) and header-based across
   processes: ``X-SkyTPU-Trace: 00-<trace32>-<span16>-<flags>`` (the
   W3C ``traceparent`` shape, under our own header name). ``flags``
-  bit 0 = sampled; an unsampled inbound header suppresses local work.
+  bit 0 = head-sampled. An unsampled inbound header no longer kills
+  local tracing: with tail retention on, the fragment is traced into
+  the PENDING buffer and a retention verdict decides its fate.
 * **Sampling** is env-controlled: ``SKYTPU_TRACE=0`` disables tracing
-  entirely; ``SKYTPU_TRACE_SAMPLE=0.1`` samples 10% of locally-rooted
-  traces (default 1.0 — sample-all; each span is one small object
-  appended to a list, so sample-all is the sane default).
-* **Collection**: a completed trace (its process-local root span ended)
-  becomes one JSON-able record in a bounded ring
-  (``SKYTPU_TRACE_RING``, default 256). Short-lived processes (request
-  runners) export records as JSON files instead
-  (``SKYTPU_TRACE_EXPORT=1``; directory ``SKYTPU_TRACE_EXPORT_DIR``,
-  default ``$SKYTPU_STATE_DIR/traces``, rotated to
-  ``SKYTPU_TRACE_EXPORT_KEEP`` newest files) — ``collect()`` merges
-  ring + exported records by trace id, which is how a runner's
-  provision spans reattach to the API server's middleware root.
+  entirely; ``SKYTPU_TRACE_SAMPLE=0.1`` head-samples 10% of
+  locally-rooted traces (head-sampled traces always land in the ring).
+* **Tail-based retention** (``SKYTPU_TRACE_TAIL``, default on): every
+  request is traced regardless of the head-sampling roll — cheap span
+  objects on the request's own bucket — and at root completion a
+  **retention verdict** (the bounded :data:`VERDICTS` registry, the
+  ``metric-name``-style vocabulary skylint's ``verdict-name`` rule
+  cross-checks) decides keep-vs-drop: kept if slow (per-QoS-class
+  latency/TTFT thresholds auto-derived from a recent in-process window
+  or pinned via ``SKYTPU_TRACE_TAIL_{LATENCY,TTFT}_MS``), errored /
+  shed (429) / evicted (504), resumed mid-stream, overlapping a firing
+  SLO rule or a recompile storm, or a bounded random baseline. Kept
+  records land in a bounded RETAINED ring and are durably exported as
+  ``keep-*`` spool files with their own rotation budget; unkept
+  tail-pending records park in a TTL'd pending buffer so a LATE verdict
+  (the load balancer's trailing ``/debug/traces?retain=<id>`` fetch)
+  can still promote every fragment of a kept journey on every process.
+* **Collection**: a completed head-sampled trace becomes one JSON-able
+  record in a bounded ring (``SKYTPU_TRACE_RING``, default 256).
+  Short-lived processes (request runners) export records as JSON files
+  instead (``SKYTPU_TRACE_EXPORT=1``; directory
+  ``SKYTPU_TRACE_EXPORT_DIR``, default ``$SKYTPU_STATE_DIR/traces``,
+  rotated to ``SKYTPU_TRACE_EXPORT_KEEP`` newest files) — ``collect()``
+  merges ring + retained store + exported records by trace id, which is
+  how a runner's provision spans reattach to the API server's
+  middleware root and how ``?slowest=1`` ranks what retention actually
+  kept, not just what the ring still holds.
 * **Retroactive spans** (``add_span``): serving timings come from
   engine callbacks on other threads; handlers record cheap float
   timestamps and build the spans afterwards, so the decode loop never
   touches the tracer.
 
 Instrumented paths: the serving path (queue wait -> prefill -> decode
-chunks -> stream complete, ``serve/llm_server.py``), the API-server
-path (middleware -> executor -> request runner, keyed by request id),
-and the launch path (``execution.py`` stages -> provisioner -> agent
+chunks -> stream complete, ``serve/llm_server.py``), the load-balancer
+path (``lb.request`` root + per-leg handoff/upstream spans,
+``serve/load_balancer.py`` — the LB can stitch its fragments with the
+replicas' via ``/debug/traces?stitch=1``), the API-server path
+(middleware -> executor -> request runner, keyed by request id), and
+the launch path (``execution.py`` stages -> provisioner -> agent
 setup/run). ``/debug/traces`` on both servers queries the ring.
 """
 from __future__ import annotations
@@ -54,16 +75,25 @@ import contextvars
 import dataclasses
 import json
 import os
+import queue
 import random
 import threading
 import time
 import uuid
 import weakref
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from skypilot_tpu.utils import atomic_io
 
 TRACE_HEADER = 'X-SkyTPU-Trace'
+# A replica's locally-decided retention verdict rides back to the LB on
+# this response header; the LB's own keep decision travels the other way
+# as a trailing /debug/traces?retain= fetch (you cannot add request
+# headers after the response started).
+VERDICT_HEADER = 'X-SkyTPU-Trace-Verdict'
+# The LB's died-mid-stream resume retry carries this so the surviving
+# replica tags its leg resume=true and both legs stitch into ONE trace.
+RESUME_HEADER = 'X-SkyTPU-Trace-Resume'
 _VERSION = '00'
 
 # Live (not yet finalized) process-local root spans, weakly held: the
@@ -107,6 +137,113 @@ def _ring_size() -> int:
         return 256
 
 
+# -- tail-based retention knobs (all read live, like the sampler) ------------
+
+
+def tail_enabled() -> bool:
+    """Tail retention master switch: trace EVERY request into the cheap
+    pending path and let the completion-time verdict decide keep/drop.
+    Meaningless (and skipped) while tracing itself is off."""
+    return enabled() and os.environ.get(
+        'SKYTPU_TRACE_TAIL', '1') not in ('0', '', 'off')
+
+
+def _int_env(name: str, default: int, floor: int = 1) -> int:
+    try:
+        return max(int(os.environ.get(name, str(default))), floor)
+    except ValueError:
+        return default
+
+
+def _float_env(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def _tail_ring() -> int:
+    return _int_env('SKYTPU_TRACE_TAIL_RING', 128)
+
+
+def _tail_keep() -> int:
+    return _int_env('SKYTPU_TRACE_TAIL_KEEP', 256)
+
+
+def _pending_cap() -> int:
+    return _int_env('SKYTPU_TRACE_TAIL_PENDING', 256)
+
+
+def _pending_ttl_s() -> float:
+    return max(_float_env('SKYTPU_TRACE_TAIL_PENDING_S', 120.0), 0.01)
+
+
+def _baseline_per_min() -> float:
+    return max(_float_env('SKYTPU_TRACE_TAIL_BASELINE_PER_MIN', 2.0), 0.0)
+
+
+def _threshold_overrides(env_name: str) -> Dict[str, float]:
+    """``'interactive:500,batch:5000'`` (or a bare ``'750'`` applying to
+    every class, key ``*``) -> {class: ms}. Malformed entries are
+    dropped — a typo'd threshold must never 500 the request path."""
+    raw = os.environ.get(env_name, '')
+    out: Dict[str, float] = {}
+    for part in raw.split(','):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, val = part.partition(':')
+        try:
+            if sep:
+                out[name.strip()] = float(val)
+            else:
+                out['*'] = float(name)
+        except ValueError:
+            continue
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class Verdict:
+    """One declared retention verdict (name + operator-facing doc).
+    Bounded vocabulary, like blackbox.TRIGGERS: consumers (the
+    dashboard autopsy view, incident tooling, docs) match verdicts BY
+    NAME, and skylint's ``verdict-name`` rule cross-checks every
+    literal verdict reference in the tree against this registry."""
+    name: str
+    doc: str
+
+
+VERDICTS: Tuple[Verdict, ...] = (
+    Verdict('slow', 'end-to-end latency above the per-QoS-class '
+                    'threshold (auto-derived p95*2 of the recent '
+                    'window, or SKYTPU_TRACE_TAIL_LATENCY_MS)'),
+    Verdict('slow_ttft', 'time-to-first-token above the per-class '
+                         'threshold (SKYTPU_TRACE_TAIL_TTFT_MS or '
+                         'auto-derived)'),
+    Verdict('error', 'request failed server-side (5xx status or an '
+                     'error attr on the root span)'),
+    Verdict('shed', 'QoS admission shed the request (429)'),
+    Verdict('evicted', 'queue-TTL eviction (504)'),
+    Verdict('resumed', 'the stream died mid-flight and was resumed on '
+                       'a surviving replica'),
+    Verdict('slo_breach', 'completed while an SLO rule was firing in '
+                          'this process'),
+    Verdict('recompile_storm', 'completed while the profiler counted '
+                               'a new recompile storm'),
+    Verdict('baseline', 'bounded random baseline keep '
+                        '(SKYTPU_TRACE_TAIL_BASELINE_PER_MIN)'),
+    Verdict('propagated', 'kept because a peer process (the LB) '
+                          'decided the journey is interesting'),
+)
+VERDICT_NAMES = frozenset(v.name for v in VERDICTS)
+# Registry order doubles as merge priority: when several fragments of
+# one journey were kept under different verdicts (the LB's 'resumed'
+# vs a leg's incidental 'baseline'), the stitched trace reports the
+# most outcome-specific one.
+_VERDICT_RANK = {v.name: i for i, v in enumerate(VERDICTS)}
+
+
 @dataclasses.dataclass
 class Span:
     """One phase of one trace. Plain data: creating a span is an object
@@ -115,7 +252,10 @@ class Span:
     ``bucket`` is the process-local root's span list, inherited from the
     parent at creation — collection is keyed by ROOT, not by trace id,
     so two concurrent requests joining the SAME inbound trace id (the
-    traceparent model invites that) never steal each other's spans."""
+    traceparent model invites that) never steal each other's spans.
+    ``sampled`` records the HEAD-sampling decision for the root; a
+    tail-pending (unsampled) root's record skips the ring and rides the
+    retention pipeline instead."""
     name: str
     trace_id: str
     span_id: str
@@ -125,6 +265,8 @@ class Span:
     attrs: Dict[str, Any] = dataclasses.field(default_factory=dict)
     bucket: Optional[List['Span']] = dataclasses.field(
         default=None, repr=False, compare=False)
+    sampled: bool = dataclasses.field(default=True, repr=False,
+                                      compare=False)
 
     def to_dict(self) -> Dict[str, Any]:
         d = {'name': self.name, 'span_id': self.span_id,
@@ -177,13 +319,18 @@ class _Tracer:
             'attrs': root.attrs,
             'spans': [s.to_dict() for s in spans],
         }
-        with self._lock:
-            if self._ring.maxlen != _ring_size():  # env changed (tests)
-                self._ring = collections.deque(self._ring,
-                                               maxlen=_ring_size())
-            self._ring.append(record)
-        if export_enabled():
-            _export(record)
+        # Tail retention rides EVERY finalize: the verdict is computed
+        # before the ring append so a head-sampled kept record carries
+        # its 'retained' marker in both stores.
+        _TAIL.evaluate(record, sampled=root.sampled)
+        if root.sampled:
+            with self._lock:
+                if self._ring.maxlen != _ring_size():  # env changed
+                    self._ring = collections.deque(self._ring,
+                                                   maxlen=_ring_size())
+                self._ring.append(record)
+            if export_enabled():
+                _export(record)
         return record
 
     def snapshot(self) -> List[Dict[str, Any]]:
@@ -196,6 +343,422 @@ class _Tracer:
 
 
 _TRACER = _Tracer()
+
+
+# -- tail retention store ----------------------------------------------------
+
+
+def _slo_overlap() -> bool:
+    """Any SLO rule firing in THIS process right now? Cheap when the
+    engine is disabled (env check); in-memory when it runs here."""
+    try:
+        from skypilot_tpu.observability import slo
+        if not slo.enabled():
+            return False
+        return bool(slo.firing_rules())
+    except Exception:  # noqa: BLE001 — retention must never fail a trace
+        return False
+
+
+class _TailStore:
+    """Pending buffer + retained ring + per-class threshold windows.
+
+    The PENDING buffer holds finalized-but-unkept tail records for a
+    TTL, so a trailing keep decision (``retain()``) can still promote
+    them; the RETAINED ring holds kept records (also durably exported
+    as ``keep-*`` spool files with their own rotation budget). The
+    threshold WINDOWS accumulate recent per-class durations/TTFTs, the
+    in-process analog of the metrics-history window, from which the
+    auto thresholds derive."""
+
+    _GUARDED_BY = {'_pending': '_lock', '_retained': '_lock',
+                   '_counts': '_lock', '_verdict_counts': '_lock',
+                   '_lat_window': '_lock', '_ttft_window': '_lock',
+                   '_baseline_minute': '_lock', '_baseline_used': '_lock'}
+
+    # Auto thresholds need this many window samples before 'slow' can
+    # fire — a cold server's first request must not self-retain.
+    MIN_WINDOW = 30
+    # slow = 2x the recent p95: "tail of the tail", not the p95 itself
+    # (which would keep a steady 5% of perfectly healthy traffic).
+    AUTO_FACTOR = 2.0
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # trace_id -> [(parked_ts, record), ...]; insertion-ordered so
+        # TTL/cap pruning pops the oldest id first.
+        self._pending: 'collections.OrderedDict[str, List]' = \
+            collections.OrderedDict()
+        self._retained: collections.deque = collections.deque(
+            maxlen=_tail_ring())
+        self._counts = {'kept': 0, 'dropped': 0, 'expired': 0,
+                        'promoted': 0}
+        self._verdict_counts: Dict[str, int] = {}
+        self._lat_window: Dict[str, collections.deque] = {}
+        self._ttft_window: Dict[str, collections.deque] = {}
+        self._baseline_minute = 0
+        self._baseline_used = 0.0
+        self._storm_mark: Optional[float] = None  # GIL-atomic float
+
+    # -- thresholds --------------------------------------------------------
+
+    def _observe_window(self, cls: str, duration_ms: float,
+                        ttft_ms: Optional[float]) -> None:
+        with self._lock:
+            self._lat_window.setdefault(
+                cls, collections.deque(maxlen=256)).append(duration_ms)
+            if ttft_ms is not None:
+                self._ttft_window.setdefault(
+                    cls, collections.deque(maxlen=256)).append(ttft_ms)
+
+    def _auto_threshold(self, window: Dict[str, collections.deque],
+                        cls: str) -> Optional[float]:
+        with self._lock:
+            vals = sorted(window.get(cls) or ())
+        if len(vals) < self.MIN_WINDOW:
+            return None
+        from skypilot_tpu.serve.qos import nearest_rank
+        p95 = nearest_rank(vals, 95)
+        return p95 * self.AUTO_FACTOR if p95 else None
+
+    def threshold(self, cls: str, kind: str) -> Optional[Dict[str, Any]]:
+        """The effective keep threshold for one class: the
+        ``SKYTPU_TRACE_TAIL_{LATENCY,TTFT}_MS`` override when set (per
+        class, or ``*`` for all), else 2x the recent window p95 once
+        enough samples exist. None = this class cannot go 'slow' yet."""
+        env = ('SKYTPU_TRACE_TAIL_LATENCY_MS' if kind == 'latency'
+               else 'SKYTPU_TRACE_TAIL_TTFT_MS')
+        overrides = _threshold_overrides(env)
+        if cls in overrides:
+            return {'ms': overrides[cls], 'source': 'flag'}
+        if '*' in overrides:
+            return {'ms': overrides['*'], 'source': 'flag'}
+        # skylint: locked(reference pick only — _auto_threshold does the
+        # actual window read under the lock)
+        window = (self._lat_window if kind == 'latency'
+                  else self._ttft_window)
+        auto = self._auto_threshold(window, cls)
+        if auto is not None:
+            return {'ms': round(auto, 1), 'source': 'auto'}
+        return None
+
+    def thresholds(self) -> Dict[str, Any]:
+        """Every class with either an override or a warm window — the
+        operator-facing view (/debug/traces payload, docs workflow)."""
+        classes = set(_threshold_overrides('SKYTPU_TRACE_TAIL_LATENCY_MS'))
+        classes |= set(_threshold_overrides('SKYTPU_TRACE_TAIL_TTFT_MS'))
+        classes.discard('*')
+        with self._lock:
+            classes |= set(self._lat_window) | set(self._ttft_window)
+        out = {}
+        for cls in sorted(classes):
+            entry = {}
+            lat = self.threshold(cls, 'latency')
+            if lat:
+                entry['latency'] = lat
+            ttft = self.threshold(cls, 'ttft')
+            if ttft:
+                entry['ttft'] = ttft
+            if entry:
+                out[cls] = entry
+        return out
+
+    # -- verdict -----------------------------------------------------------
+
+    def _baseline_allow(self) -> bool:
+        budget = _baseline_per_min()
+        if budget <= 0:
+            return False
+        minute = int(time.time() // 60)
+        with self._lock:
+            if minute != self._baseline_minute:
+                self._baseline_minute = minute
+                self._baseline_used = 0.0
+            if self._baseline_used >= budget:
+                return False
+            self._baseline_used += 1.0
+        return True
+
+    def _storm_overlap(self) -> bool:
+        """A recompile storm was counted since the last completed
+        trace checked — the 'this request overlapped compile churn'
+        signal. Profiler-off is a single cheap env check."""
+        try:
+            from skypilot_tpu.observability import profiler
+            if not profiler.enabled():
+                return False
+            snap = profiler.try_snapshot() or {}
+            storms = float(snap.get('storms_total') or 0)
+        except Exception:  # noqa: BLE001 — never fail the trace
+            return False
+        prev, self._storm_mark = self._storm_mark, storms
+        return prev is not None and storms > prev
+
+    def verdict(self, record: Dict[str, Any]) -> Optional[str]:
+        """The retention verdict for one finalized root record, first
+        match wins (outcome verdicts before threshold verdicts before
+        ambient/baseline ones). Every returned name is declared in
+        :data:`VERDICTS`."""
+        attrs = record.get('attrs') or {}
+        status = attrs.get('status')
+        if attrs.get('resume') or attrs.get('resumed'):
+            return 'resumed'
+        # A downstream fragment's verdict (the replica's
+        # X-SkyTPU-Trace-Verdict response header, mirrored onto the LB
+        # root) keeps this fragment too — the journey is interesting
+        # wherever it was judged so. baseline/propagated never echo:
+        # they would amplify boring keeps across hops.
+        rv = attrs.get('replica_verdict')
+        if isinstance(rv, str) and rv in VERDICT_NAMES \
+                and rv not in ('baseline', 'propagated'):
+            return rv
+        if status == 429 or attrs.get('shed'):
+            return 'shed'
+        if status == 504:
+            return 'evicted'
+        # Cancellation is the CLIENT hanging up (aiohttp cancels the
+        # handler), not a server-side failure: a disconnect storm must
+        # not rotate real errors out of the retained ring under the
+        # 'error' verdict.
+        err = attrs.get('error')
+        if (err is not None
+                and err not in ('CancelledError', 'GeneratorExit')) \
+                or (isinstance(status, int) and status >= 500):
+            return 'error'
+        cls = str(attrs.get('qos_class') or 'standard')
+        lat = self.threshold(cls, 'latency')
+        if lat and record.get('duration_ms', 0.0) > lat['ms']:
+            return 'slow'
+        ttft_ms = attrs.get('ttft_ms')
+        if isinstance(ttft_ms, (int, float)):
+            tth = self.threshold(cls, 'ttft')
+            if tth and ttft_ms > tth['ms']:
+                return 'slow_ttft'
+        if _slo_overlap():
+            return 'slo_breach'
+        if self._storm_overlap():
+            return 'recompile_storm'
+        if self._baseline_allow():
+            return 'baseline'
+        return None
+
+    # -- keep / park / promote ---------------------------------------------
+
+    def evaluate(self, record: Dict[str, Any], sampled: bool) -> \
+            Optional[str]:
+        """The retention decision at finalize: keep (verdict), park
+        (tail-pending, verdict may arrive later), or drop-from-tail
+        (head-sampled records still live in the ring)."""
+        if not tail_enabled():
+            return None
+        attrs = record.get('attrs') or {}
+        cls = str(attrs.get('qos_class') or 'standard')
+        ttft = attrs.get('ttft_ms')
+        self._observe_window(
+            cls, float(record.get('duration_ms') or 0.0),
+            float(ttft) if isinstance(ttft, (int, float)) else None)
+        v = self.verdict(record)
+        if v is not None:
+            self._keep(record, v)
+        elif not sampled:
+            self._park(record)
+        else:
+            with self._lock:
+                self._counts['dropped'] += 1
+        return v
+
+    def _keep(self, record: Dict[str, Any], verdict: str) -> None:
+        record['retained'] = verdict
+        with self._lock:
+            if self._retained.maxlen != _tail_ring():  # env changed
+                self._retained = collections.deque(self._retained,
+                                                   maxlen=_tail_ring())
+            self._retained.append(record)
+            self._counts['kept'] += 1
+            self._verdict_counts[verdict] = \
+                self._verdict_counts.get(verdict, 0) + 1
+        # Durable export rides a background writer: _keep runs inside
+        # root-span __exit__ — ON the serving event loop — and a
+        # verdict storm (slo_breach keeps everything while degraded)
+        # must not block token streams on spool writes + rotation
+        # scans. Hooks stay inline (cheap: list append + a threadsafe
+        # coroutine schedule).
+        _enqueue_keep_export(record)
+        for hook in list(_KEEP_HOOKS):
+            try:
+                hook(record, verdict)
+            except Exception:  # noqa: BLE001 — observational only
+                pass
+
+    def _park(self, record: Dict[str, Any]) -> None:
+        now = time.time()
+        ttl, cap = _pending_ttl_s(), _pending_cap()
+        with self._lock:
+            self._pending.setdefault(record['trace_id'], []).append(
+                (now, record))
+            # Amortized prune with EARLY EXIT: ids are ordered by first
+            # park, so walk expired ids off the front and stop at the
+            # first live one — O(expired), not O(cap), per completion.
+            expired = 0
+            while self._pending:
+                tid, frags = next(iter(self._pending.items()))
+                fresh = [(t, r) for t, r in frags if now - t <= ttl]
+                if len(fresh) == len(frags):
+                    break
+                expired += len(frags) - len(fresh)
+                if fresh:  # late fragments of an old id stay parked
+                    self._pending[tid] = fresh
+                    break
+                del self._pending[tid]
+            while len(self._pending) > cap:
+                _, frags = self._pending.popitem(last=False)
+                expired += len(frags)
+            if expired:
+                self._counts['expired'] += expired
+
+    def retain(self, trace_id: str, verdict: str = 'propagated') -> int:
+        """Trailing keep: promote every pending fragment of
+        ``trace_id`` (exact id or a unique prefix) into the retained
+        store — how the LB's completion-time verdict reaches the
+        replicas whose local verdicts said 'boring'."""
+        if verdict not in VERDICT_NAMES:
+            verdict = 'propagated'
+        promoted: List[Dict[str, Any]] = []
+        with self._lock:
+            for tid in list(self._pending):
+                if tid == trace_id or (len(trace_id) >= 8
+                                       and tid.startswith(trace_id)):
+                    promoted.extend(
+                        r for _, r in self._pending.pop(tid))
+            self._counts['promoted'] += len(promoted)
+        for rec in promoted:
+            self._keep(rec, verdict)
+        return len(promoted)
+
+    # -- views -------------------------------------------------------------
+
+    def retained_snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._retained)
+
+    def retained_ids(self, limit: int = 16) -> List[str]:
+        """Newest retained trace ids — ride incident bundles so a
+        post-mortem links straight from 'the process wedged' to the
+        interesting journeys it had just kept."""
+        with self._lock:
+            recs = list(self._retained)[-max(limit, 0):]
+        return [r['trace_id'] for r in reversed(recs)]
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            pending = sum(len(v) for v in self._pending.values())
+            counts = dict(self._counts)
+            verdicts = dict(self._verdict_counts)
+            retained = len(self._retained)
+        return {'enabled': tail_enabled(), 'pending': pending,
+                'retained': retained, 'verdicts': verdicts, **counts}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._pending.clear()
+            self._retained.clear()
+            self._counts = {'kept': 0, 'dropped': 0, 'expired': 0,
+                            'promoted': 0}
+            self._verdict_counts = {}
+            self._lat_window = {}
+            self._ttft_window = {}
+            self._storm_mark = None
+            self._baseline_minute = 0
+            self._baseline_used = 0.0
+
+
+_TAIL = _TailStore()
+
+# Background keep-export writer: a bounded queue drained by one lazy
+# daemon thread. Queue-full drops the DURABILITY of a keep (the
+# retained ring still holds it; incident bundles still name it) rather
+# than ever back-pressuring the serving path.
+_KEEP_QUEUE: 'queue.Queue[Dict[str, Any]]' = queue.Queue(maxsize=256)
+_KEEP_WRITER_LOCK = threading.Lock()
+_KEEP_WRITER: Optional[threading.Thread] = None
+
+
+def _keep_writer_loop() -> None:
+    while True:
+        record = _KEEP_QUEUE.get()
+        try:
+            _export(record, keep=True)
+        finally:
+            _KEEP_QUEUE.task_done()
+
+
+def _enqueue_keep_export(record: Dict[str, Any]) -> None:
+    global _KEEP_WRITER
+    try:
+        _KEEP_QUEUE.put_nowait(record)
+    except queue.Full:
+        return
+    with _KEEP_WRITER_LOCK:
+        if _KEEP_WRITER is None or not _KEEP_WRITER.is_alive():
+            _KEEP_WRITER = threading.Thread(
+                target=_keep_writer_loop, daemon=True,
+                name='skytpu-trace-keep-export')
+            _KEEP_WRITER.start()
+
+
+def flush_keep_exports(timeout: float = 10.0) -> bool:
+    """Block until queued keep exports hit disk (tests, probes, and
+    pre-exit flushes); True when the queue fully drained."""
+    deadline = time.time() + timeout
+    while _KEEP_QUEUE.unfinished_tasks:
+        if time.time() > deadline:
+            return False
+        time.sleep(0.01)
+    return True
+
+# Keep hooks: called (record, verdict) after a record enters the
+# retained store. The LB registers one to fan its keep decision out to
+# the replicas that served the journey's fragments.
+_KEEP_HOOKS: List[Callable[[Dict[str, Any], str], None]] = []
+
+
+def add_keep_hook(fn: Callable[[Dict[str, Any], str], None]) -> None:
+    if fn not in _KEEP_HOOKS:
+        _KEEP_HOOKS.append(fn)
+
+
+def remove_keep_hook(fn: Callable[[Dict[str, Any], str], None]) -> None:
+    if fn in _KEEP_HOOKS:
+        _KEEP_HOOKS.remove(fn)
+
+
+def retain(trace_id: str, verdict: str = 'propagated') -> int:
+    return _TAIL.retain(trace_id, verdict)
+
+
+def retained_ids(limit: int = 16) -> List[str]:
+    return _TAIL.retained_ids(limit)
+
+
+def tail_stats() -> Dict[str, Any]:
+    return _TAIL.stats()
+
+
+def tail_thresholds() -> Dict[str, Any]:
+    return _TAIL.thresholds()
+
+
+def verdict_for_status(status: int) -> Optional[str]:
+    """The outcome verdict one HTTP status implies (the replica's
+    response-header propagation uses this; threshold verdicts need the
+    finalized record and cannot ride a header)."""
+    if status == 429:
+        return 'shed'
+    if status == 504:
+        return 'evicted'
+    if status >= 500:
+        return 'error'
+    return None
 
 
 class _NoopCtx:
@@ -217,11 +780,15 @@ _NOOP = _NoopCtx()
 
 
 class _SpanCtx:
-    __slots__ = ('span', '_token', '_root')
+    __slots__ = ('span', '_token', '_root', 'record')
 
     def __init__(self, span: Span, root: bool = False):
         self.span = span
         self._root = root
+        # The finalized record (roots only, set at __exit__): handlers
+        # read record['retained'] AFTER the block to surface the
+        # retention verdict on a response header.
+        self.record: Optional[Dict[str, Any]] = None
 
     def __bool__(self):
         return True
@@ -244,7 +811,7 @@ class _SpanCtx:
         if self._root:
             with _LIVE_LOCK:
                 _LIVE_ROOTS.pop(self.span.span_id, None)
-            _TRACER.finalize(self.span)
+            self.record = _TRACER.finalize(self.span)
         else:
             _TRACER.record(self.span)
         return False
@@ -277,7 +844,8 @@ def mint_header() -> Optional[str]:
     LB proxy, loadgen): None when tracing is disabled in this process,
     else a new trace id whose sampled flag rolls this process's
     SKYTPU_TRACE_SAMPLE — one implementation so minters cannot drift on
-    the sampling semantics."""
+    the sampling semantics. An unsampled header still correlates the
+    journey for TAIL retention; the flag only decides the ring."""
     if not enabled():
         return None
     return make_header(sampled=mint_sampled())
@@ -306,11 +874,15 @@ def parse_header(value: Optional[str]):
 
 def header_value() -> Optional[str]:
     """The outbound propagation header for the current span (None when
-    nothing is being traced) — what crosses a process boundary."""
+    nothing is being traced) — what crosses a process boundary. The
+    sampled flag reflects the ROOT's head-sampling decision so a
+    tail-pending journey stays tail-pending downstream instead of
+    promoting itself into every ring it touches."""
     s = _current.get()
     if s is None:
         return None
-    return f'{_VERSION}-{s.trace_id}-{s.span_id}-01'
+    flag = '01' if s.sampled else '00'
+    return f'{_VERSION}-{s.trace_id}-{s.span_id}-{flag}'
 
 
 # -- span construction -------------------------------------------------------
@@ -320,25 +892,30 @@ def header_value() -> Optional[str]:
 def start_trace(name: str, headers: Any = None,
                 parent_header: Optional[str] = None, **attrs):
     """Open this process's root span for a request. Joins the caller's
-    trace when a valid sampled ``X-SkyTPU-Trace`` arrives (an unsampled
-    one suppresses local tracing); otherwise makes the local sampling
-    decision. Use as a context manager; falsy/no-op when not sampled."""
+    trace when a valid ``X-SkyTPU-Trace`` arrives; otherwise makes the
+    local head-sampling decision. With tail retention on, an UNSAMPLED
+    root is still traced — its record rides the pending/verdict path
+    instead of the ring. Use as a context manager; falsy/no-op when
+    nothing will be traced at all."""
     if parent_header is None and headers is not None:
         parent_header = headers.get(TRACE_HEADER)
     parsed = parse_header(parent_header)
     if not enabled():
         return _NOOP
+    tail = tail_enabled()
     if parsed is not None:
         tid, parent_id, sampled = parsed
-        if not sampled:
+        if not sampled and not tail:
             return _NOOP
     else:
         rate = sample_rate()
-        if rate <= 0.0 or (rate < 1.0 and random.random() >= rate):
+        sampled = rate >= 1.0 or (rate > 0.0 and random.random() < rate)
+        if not sampled and not tail:
             return _NOOP
         tid, parent_id = uuid.uuid4().hex, None
     span = Span(name=name, trace_id=tid, span_id=uuid.uuid4().hex[:16],
-                parent_id=parent_id, start=time.time(), attrs=dict(attrs))
+                parent_id=parent_id, start=time.time(), attrs=dict(attrs),
+                sampled=sampled)
     return _SpanCtx(span, root=True)
 
 
@@ -418,6 +995,7 @@ def open_spans(limit: int = 32) -> List[Dict[str, Any]]:
 def reset() -> None:
     """Drop all collected state (tests / probes)."""
     _TRACER.reset()
+    _TAIL.reset()
 
 
 # -- export (cross-process traces: request runners -> API server) -----------
@@ -444,13 +1022,32 @@ def _export_keep() -> int:
         return 512
 
 
-def _export(record: Dict[str, Any]) -> None:
+def _export_name_parts(name: str) -> Optional[Tuple[bool, str, str]]:
+    """``[keep-]<ts13>-<tid12>-<pid>.json`` -> (kept, ts, tid12), or
+    None for a foreign file."""
+    if not name.endswith('.json'):
+        return None
+    parts = name[:-len('.json')].split('-')
+    kept = bool(parts) and parts[0] == 'keep'
+    if kept:
+        parts = parts[1:]
+    if len(parts) < 2:
+        return None
+    return kept, parts[0], parts[1]
+
+
+def _export(record: Dict[str, Any], keep: bool = False) -> None:
     """One JSON file per completed trace record, newest-N rotation.
+    ``keep=True`` = a RETAINED record: durability is the whole point of
+    tail retention, so kept files get a ``keep-`` prefix and their own
+    (typically larger) ``SKYTPU_TRACE_TAIL_KEEP`` budget — ordinary
+    ring-overflow rotation never evicts what retention decided to keep.
     Best-effort: tracing must never fail the traced work."""
     try:
         d = export_dir()
         os.makedirs(d, exist_ok=True)
-        fname = (f'{int(record["start"] * 1000):013d}-'
+        prefix = 'keep-' if keep else ''
+        fname = (f'{prefix}{int(record["start"] * 1000):013d}-'
                  f'{record["trace_id"][:12]}-{os.getpid()}.json')
         # Trace filenames are unique: an unserializable span attr
         # (TypeError) would otherwise leak one dot-tmp per trace —
@@ -458,8 +1055,18 @@ def _export(record: Dict[str, Any]) -> None:
         atomic_io.atomic_write(
             os.path.join(d, fname), lambda f: json.dump(record, f),
             tmp=os.path.join(d, f'.{fname}.tmp'))
-        names = sorted(n for n in os.listdir(d) if n.endswith('.json'))
-        for stale in names[:-_export_keep()]:
+        plain, kept = [], []
+        for n in sorted(os.listdir(d)):
+            parts = _export_name_parts(n)
+            if parts is None:
+                continue
+            (kept if parts[0] else plain).append(n)
+        for stale in plain[:-_export_keep()]:
+            try:
+                os.remove(os.path.join(d, stale))
+            except OSError:
+                pass
+        for stale in kept[:-_tail_keep()]:
             try:
                 os.remove(os.path.join(d, stale))
             except OSError:
@@ -470,23 +1077,30 @@ def _export(record: Dict[str, Any]) -> None:
 
 def read_exported(limit: int = 200,
                   trace_prefix: Optional[str] = None) -> List[Dict[str, Any]]:
-    """Newest exported trace records (unreadable files skipped). The
-    read is BOUNDED — it runs synchronously inside the /debug/traces
-    handlers — and a trace-id prefix filters on the FILENAME (which
-    embeds the first 12 id chars) before any file is opened."""
+    """Newest exported trace records — plain exports AND retained
+    ``keep-`` files (unreadable/vanishing files skipped: keep-rotation
+    legitimately races readers). The read is BOUNDED — it runs
+    synchronously inside the /debug/traces handlers — and a trace-id
+    prefix filters on the FILENAME (which embeds the first 12 id
+    chars) before any file is opened."""
     d = export_dir()
     try:
-        names = sorted((n for n in os.listdir(d) if n.endswith('.json')),
-                       reverse=True)
+        names = os.listdir(d)
     except OSError:
         return []
+    parsed = []
+    for n in names:
+        parts = _export_name_parts(n)
+        if parts is None:
+            continue
+        parsed.append((parts[1], parts[2], n))  # (ts, tid12, name)
+    parsed.sort(reverse=True)  # newest first by embedded timestamp
     if trace_prefix:
         p = trace_prefix[:12]
-        names = [n for n in names
-                 if len(n.split('-')) >= 2 and n.split('-')[1].startswith(p)]
-    names = names[:max(limit, 0)]
+        parsed = [(ts, tid, n) for ts, tid, n in parsed
+                  if tid.startswith(p)]
     out = []
-    for name in names:
+    for _, _, name in parsed[:max(limit, 0)]:
         try:
             with open(os.path.join(d, name), encoding='utf-8') as f:
                 rec = json.load(f)
@@ -497,30 +1111,21 @@ def read_exported(limit: int = 200,
     return out
 
 
-# -- query (/debug/traces on both servers) -----------------------------------
+# -- query (/debug/traces on both servers + the LB) --------------------------
 
 
-def collect(trace_id: Optional[str] = None,
-            qos_class: Optional[str] = None,
-            tenant: Optional[str] = None,
-            limit: int = 20,
-            slowest_first: bool = False,
-            include_exported: bool = True) -> List[Dict[str, Any]]:
-    """Completed traces, ring + exported records merged by trace id (a
-    trace's spans may come from several processes: API-server middleware
-    in-ring, request-runner record exported). Filters: trace-id prefix,
-    root ``qos_class``/``tenant`` attrs."""
-    records = _TRACER.snapshot()
-    if include_exported:
-        # Bounded: ~5 export files per requested trace (a trace rarely
-        # spans more than two processes), floor 100 — /debug/traces must
-        # not open the whole 512-file spool for a limit-10 dashboard
-        # poll.
-        records = records + read_exported(
-            limit=max(limit * 5, 100), trace_prefix=trace_id)
+def merge_traces(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Merge trace records/fragments by trace id (a trace's spans may
+    come from several processes: LB root in its ring, replica fragments
+    fetched over HTTP, request-runner exports on disk), deduplicating
+    spans by span id. Shared by ``collect()`` and the LB's
+    ``?stitch=1`` cross-replica stitcher so the two can never disagree
+    on merge semantics."""
     merged: Dict[str, Dict[str, Any]] = {}
     seen_spans: Dict[str, set] = {}
     for rec in records:
+        if not isinstance(rec, dict) or not rec.get('trace_id'):
+            continue
         tid = rec['trace_id']
         spans = rec.get('spans') or []
         cur = merged.get(tid)
@@ -535,7 +1140,12 @@ def collect(trace_id: Optional[str] = None,
             seen_spans[tid] = set()
         else:
             cur['attrs'].update(rec.get('attrs') or {})
-            cur['start'] = min(cur['start'], rec.get('start', cur['start']))
+            cur['start'] = min(cur['start'],
+                               rec.get('start', cur['start']))
+        v = rec.get('retained')
+        if v and _VERDICT_RANK.get(v, 99) < _VERDICT_RANK.get(
+                cur.get('retained'), 99):
+            cur['retained'] = v
         for s in spans:
             sid = s.get('span_id')
             if sid in seen_spans[tid]:  # same record in ring AND on disk
@@ -551,11 +1161,45 @@ def collect(trace_id: Optional[str] = None,
         ends = [s['end'] for s in tr['spans'] if s.get('end') is not None]
         tr['duration_ms'] = (round((max(ends) - tr['start']) * 1000.0, 3)
                              if ends else 0.0)
+        out.append(tr)
+    return out
+
+
+def collect(trace_id: Optional[str] = None,
+            qos_class: Optional[str] = None,
+            tenant: Optional[str] = None,
+            limit: int = 20,
+            slowest_first: bool = False,
+            include_exported: bool = True,
+            retained_only: bool = False) -> List[Dict[str, Any]]:
+    """Completed traces: ring + RETAINED store + exported records
+    merged by trace id. Filters: trace-id prefix, root
+    ``qos_class``/``tenant`` attrs, ``retained_only``. ``slowest_first``
+    ranks over everything retention kept — including the export spool's
+    ``keep-`` files — not just the recency-biased ring."""
+    records = _TRACER.snapshot() + _TAIL.retained_snapshot()
+    if include_exported:
+        if slowest_first:
+            # Slowest-ranking must see the whole spool: a retained slow
+            # trace that rotated out of the ring is exactly what the
+            # operator is asking for. Bounded by the rotation budgets.
+            export_limit = _export_keep() + _tail_keep()
+        else:
+            # ~5 export files per requested trace (a trace rarely spans
+            # more than two processes), floor 100 — /debug/traces must
+            # not open the whole spool for a limit-10 dashboard poll.
+            export_limit = max(limit * 5, 100)
+        records = records + read_exported(
+            limit=export_limit, trace_prefix=trace_id)
+    out = []
+    for tr in merge_traces(records):
         if trace_id and not tr['trace_id'].startswith(trace_id):
             continue
         if qos_class and tr['attrs'].get('qos_class') != qos_class:
             continue
         if tenant and tr['attrs'].get('tenant') != tenant:
+            continue
+        if retained_only and not tr.get('retained'):
             continue
         out.append(tr)
     if slowest_first:
@@ -565,9 +1209,89 @@ def collect(trace_id: Optional[str] = None,
     return out[:max(limit, 0)]
 
 
+# -- autopsy: where-time-went breakdown --------------------------------------
+
+# Span-name -> phase mapping for the autopsy view. LB handoff legs are
+# wall-clock the LB spent orchestrating the KV transfer; the replica
+# prefill/decode spans nest inside their own legs (sums are per-phase
+# wall attributions, not an exact partition — 'other' absorbs the
+# un-mapped remainder, clamped at zero when phases overlap).
+_PHASE_OF = {
+    'qos.queue_wait': 'queue',
+    'serve.prefill': 'prefill',
+    'serve.decode': 'decode',
+    'serve.stream': 'stream',
+    'serve.window': 'decode',
+    'lb.handoff.export': 'handoff',
+    'lb.handoff.prepare': 'handoff',
+    'lb.handoff.fetch': 'handoff',
+    'lb.handoff.import': 'handoff',
+}
+
+
+def phase_breakdown(trace: Dict[str, Any]) -> Dict[str, float]:
+    """One merged trace -> {phase: ms} over the autopsy phases
+    (queue/prefill/handoff/decode/stream + total/other). Stream is
+    reported as its EXCLUSIVE tail (stream span minus decode) so the
+    phases roughly sum to the journey."""
+    sums: Dict[str, float] = {}
+    for s in trace.get('spans') or ():
+        phase = _PHASE_OF.get(s.get('name'))
+        if phase is None or s.get('end') is None:
+            continue
+        sums[phase] = sums.get(phase, 0.0) + max(
+            (s['end'] - s['start']) * 1000.0, 0.0)
+    if 'stream' in sums:
+        sums['stream'] = max(sums['stream'] - sums.get('decode', 0.0),
+                             0.0)
+    total = float(trace.get('duration_ms') or 0.0)
+    known = sum(sums.values())
+    out = {p: round(v, 3) for p, v in sums.items()}
+    out['total'] = round(total, 3)
+    out['other'] = round(max(total - known, 0.0), 3)
+    return out
+
+
+def class_baseline(qos_class: str,
+                   sample: int = 50) -> Optional[Dict[str, float]]:
+    """Mean phase breakdown over recent completed traces of one class —
+    what the autopsy view compares a kept outlier against."""
+    peers = [t for t in collect(limit=sample, include_exported=False)
+             if (t['attrs'].get('qos_class') or 'standard') == qos_class
+             and not t.get('retained')]
+    if not peers:
+        peers = [t for t in collect(limit=sample,
+                                    include_exported=False)
+                 if (t['attrs'].get('qos_class') or 'standard')
+                 == qos_class]
+    if not peers:
+        return None
+    acc: Dict[str, float] = {}
+    for t in peers:
+        for phase, ms in phase_breakdown(t).items():
+            acc[phase] = acc.get(phase, 0.0) + ms
+    return {'n': len(peers),
+            **{p: round(v / len(peers), 3) for p, v in acc.items()}}
+
+
+def autopsy(trace: Dict[str, Any]) -> Dict[str, Any]:
+    """The request-autopsy payload for one merged trace: its phase
+    breakdown next to the class baseline, plus the retention verdict."""
+    cls = str((trace.get('attrs') or {}).get('qos_class') or 'standard')
+    return {'trace_id': trace['trace_id'],
+            'qos_class': cls,
+            'retained': trace.get('retained'),
+            'breakdown': phase_breakdown(trace),
+            'baseline': class_baseline(cls)}
+
+
 def debug_payload(query: Any) -> Dict[str, Any]:
-    """The ``/debug/traces`` response body, shared by the API server and
-    the serving replica (``query`` = the request's query mapping)."""
+    """The ``/debug/traces`` response body, shared by the API server,
+    the serving replica, and the LB (``query`` = the request's query
+    mapping). Beyond listing: ``?retain=<id>&verdict=<v>`` promotes
+    pending fragments (the LB's trailing keep propagation),
+    ``?retained=1`` filters to what retention kept, ``?autopsy=1``
+    attaches the where-time-went breakdown for each returned trace."""
     def _get(key):
         v = query.get(key)
         return str(v) if v else None
@@ -576,11 +1300,23 @@ def debug_payload(query: Any) -> Dict[str, Any]:
         limit = min(max(int(query.get('limit', 20)), 1), 200)
     except (TypeError, ValueError):
         limit = 20
+    out: Dict[str, Any] = {'enabled': enabled(),
+                           'sample_rate': sample_rate(),
+                           'tail': tail_stats()}
+    retain_id = _get('retain')
+    if retain_id:
+        out['retained_promoted'] = retain(
+            retain_id, _get('verdict') or 'propagated')
     traces = collect(
         trace_id=_get('trace_id'),
         qos_class=_get('qos_class') or _get('class'),
         tenant=_get('tenant'),
         limit=limit,
-        slowest_first=str(query.get('slowest', '')) in ('1', 'true'))
-    return {'enabled': enabled(), 'sample_rate': sample_rate(),
-            'count': len(traces), 'traces': traces}
+        slowest_first=str(query.get('slowest', '')) in ('1', 'true'),
+        retained_only=str(query.get('retained', '')) in ('1', 'true'))
+    if str(query.get('autopsy', '')) in ('1', 'true'):
+        out['autopsy'] = [autopsy(t) for t in traces]
+        out['thresholds'] = tail_thresholds()
+    out['count'] = len(traces)
+    out['traces'] = traces
+    return out

@@ -43,9 +43,16 @@ assets ship in-image):
                                  "batches_served": N, "max_batch_seen": M}
   GET  /metrics              -> Prometheus scrape (latency histograms +
                                 engine/queue gauges)
-  GET  /debug/traces         -> recent/slowest completed traces
-                                (?slowest=1, ?trace_id=, ?qos_class=,
-                                ?tenant=, ?limit=)
+  GET  /debug/traces         -> recent/slowest completed + RETAINED
+                                traces (?slowest=1, ?trace_id=,
+                                ?qos_class=, ?tenant=, ?limit=,
+                                ?retained=1, ?autopsy=1; the LB's
+                                trailing ?retain=<id>&verdict=<v>
+                                promotes pending tail fragments)
+  GET  /debug/exemplars      -> newest trace id per serving-histogram
+                                bucket (the metric -> retained-trace
+                                jump; also in the OpenMetrics /metrics
+                                exposition)
   POST /generate             {"tokens": [[...]], "max_new_tokens": N,
                               "temperature": t?, "seed": s?}
                              -> {"tokens": [[...]]}
@@ -133,9 +140,20 @@ def _metrics():
                     SERVE_DECODE_RATE = _NoopMetric()
 
                 @staticmethod
-                def render_serving(engine=None, qos=None, disagg=None):
-                    del engine, qos, disagg
+                def render_serving(engine=None, qos=None, disagg=None,
+                                   openmetrics=False):
+                    del engine, qos, disagg, openmetrics
                     return b'# prometheus_client not installed\n'
+
+                @staticmethod
+                def observe_serving(name, value, trace_id=None,
+                                    **labels):
+                    del name, value, trace_id, labels
+
+                @staticmethod
+                def exemplars_payload(query=None):
+                    del query
+                    return {'count': 0, 'exemplars': []}
 
             _METRICS = _Shim()
     return _METRICS
@@ -509,6 +527,11 @@ class LlmServer:
             body['qos'] = qos_stats
             queue['depth_total'] += qos_stats['queue_depth_total']
         body['queue'] = queue
+        # Tail-retention accounting (observability/trace.py): pending/
+        # retained depth + per-verdict keep counts — how loadgen and
+        # the autopsy probe see that interesting journeys survived and
+        # boring ones were dropped.
+        body['trace'] = trace_lib.tail_stats()
         if self._ttft_window:
             from skypilot_tpu.serve.qos import nearest_rank
             waits = sorted(round(t * 1000.0, 1)
@@ -731,25 +754,33 @@ class LlmServer:
         events = sorted(rec.events)
         if not events:
             return
+        anchor = parent if parent is not None else trace_lib.current()
+        # The exemplar: the observation's trace id, whether head-sampled
+        # or tail-pending — a retained tail outlier is exactly what a
+        # hot bucket's exemplar should resolve to.
+        tid = anchor.trace_id if anchor is not None else None
         ttft = max(events[0][0] - rec.t0, 0.0)
         profiler.mark('first_token')  # cold-start ledger: idempotent
         self._ttft_window.append(ttft)
-        metrics_lib.SERVE_TTFT.labels(qos_class=qos_class).observe(ttft)
-        metrics_lib.SERVE_PHASE.labels(
-            phase='prefill', qos_class=qos_class).observe(ttft)
+        metrics_lib.observe_serving('skytpu_serve_ttft_seconds', ttft,
+                                    trace_id=tid, qos_class=qos_class)
+        metrics_lib.observe_serving('skytpu_serve_phase_seconds', ttft,
+                                    trace_id=tid, phase='prefill',
+                                    qos_class=qos_class)
         first_t, last_t = events[0][0], events[-1][0]
         toks = sum(n for _, _, n in events)
         decode_s = max(last_t - first_t, 0.0)
-        metrics_lib.SERVE_PHASE.labels(
-            phase='decode', qos_class=qos_class).observe(decode_s)
+        metrics_lib.observe_serving('skytpu_serve_phase_seconds',
+                                    decode_s, trace_id=tid,
+                                    phase='decode', qos_class=qos_class)
         # Rate over the decode window only: the first emission's tokens
         # were produced during the prefill window the denominator
         # excludes — counting them would inflate short generations ~2x.
         decode_toks = toks - events[0][2]
         if decode_s > 0 and decode_toks > 0:
-            metrics_lib.SERVE_DECODE_RATE.labels(
-                qos_class=qos_class).observe(decode_toks / decode_s)
-        anchor = parent if parent is not None else trace_lib.current()
+            metrics_lib.observe_serving(
+                'skytpu_serve_decode_tok_s', decode_toks / decode_s,
+                trace_id=tid, qos_class=qos_class)
         if anchor is None:
             return
         if anchor.end is not None:
@@ -813,14 +844,19 @@ class LlmServer:
         now = time.time()
         dur = max(now - t_start, 0.0)
         toks = sum(len(r) for r in out)
+        cur = trace_lib.current()
+        tid = cur.trace_id if cur is not None else None
         profiler.mark('first_token')  # cold-start ledger: idempotent
         self._ttft_window.append(dur)
-        metrics_lib.SERVE_TTFT.labels(qos_class=qos_class).observe(dur)
-        metrics_lib.SERVE_PHASE.labels(
-            phase='window', qos_class=qos_class).observe(dur)
+        metrics_lib.observe_serving('skytpu_serve_ttft_seconds', dur,
+                                    trace_id=tid, qos_class=qos_class)
+        metrics_lib.observe_serving('skytpu_serve_phase_seconds', dur,
+                                    trace_id=tid, phase='window',
+                                    qos_class=qos_class)
         if dur > 0 and toks:
-            metrics_lib.SERVE_DECODE_RATE.labels(
-                qos_class=qos_class).observe(toks / dur)
+            metrics_lib.observe_serving('skytpu_serve_decode_tok_s',
+                                        toks / dur, trace_id=tid,
+                                        qos_class=qos_class)
         trace_lib.set_attr(qos_class=qos_class, tokens=toks)
         trace_lib.add_span('serve.window', t_start, now, tokens=toks)
 
@@ -861,12 +897,27 @@ class LlmServer:
         try:
             tctx = trace_lib.start_trace('serve.generate',
                                          headers=request.headers)
-            if not tctx:  # unsampled: zero further tracing cost
+            if not tctx:  # untraced: zero further tracing cost
                 return await self._generate_inner(request)
             with tctx:
+                if request.headers.get(trace_lib.RESUME_HEADER):
+                    # The LB is re-serving a died-mid-stream request on
+                    # this replica: tag the leg so both legs stitch into
+                    # one journey (and retention keeps it as 'resumed').
+                    trace_lib.set_attr(resume=True)
                 resp = await self._generate_inner(request)
                 trace_lib.set_attr(status=resp.status)
-                return resp
+            # Replica-side verdict propagation: the retention verdict
+            # is final only at root finalize (slow/slow_ttft need the
+            # completed duration), which ran at the block's exit —
+            # surface it so the LB can keep ITS fragment of the journey
+            # without a second round trip. Prepared stream responses
+            # already shipped their headers; their verdicts travel via
+            # the LB's own judgment of the stream outcome instead.
+            verdict = (tctx.record or {}).get('retained')
+            if verdict and not getattr(resp, 'prepared', True):
+                resp.headers[trace_lib.VERDICT_HEADER] = verdict
+            return resp
         finally:
             self._inflight -= 1
 
@@ -1042,8 +1093,12 @@ class LlmServer:
             self.qos.abandon(ticket)  # client disconnected while queued
             raise
         t_granted = time.time()
-        _metrics().SERVE_QUEUE_WAIT.labels(qos_class=qos_class).observe(
-            max(t_granted - t_submit, 0.0))
+        cur = trace_lib.current()
+        _metrics().observe_serving(
+            'skytpu_serve_queue_wait_seconds',
+            max(t_granted - t_submit, 0.0),
+            trace_id=cur.trace_id if cur is not None else None,
+            qos_class=qos_class)
         trace_lib.add_span('qos.queue_wait', t_submit, t_granted,
                            tenant=tenant)
         # generated drives the quota refund at release: the actual
@@ -1557,10 +1612,24 @@ class LlmServer:
             qos_stats = self.qos.stats() if self.qos is not None else None
         except Exception:  # noqa: BLE001 — a stopping engine must not
             engine, qos_stats = None, None  # fail the whole scrape
-        return web.Response(
-            body=_metrics().render_serving(engine=engine, qos=qos_stats,
-                                           disagg=self.disagg_stats),
-            content_type='text/plain', charset='utf-8')
+        # Content negotiation: an OpenMetrics-speaking scraper gets the
+        # exposition that carries histogram exemplars (trace ids on the
+        # bucket lines — the metric→retained-trace jump).
+        metrics_lib = _metrics()
+        openmetrics = ('openmetrics-text'
+                       in request.headers.get('Accept', '')
+                       and getattr(metrics_lib, 'openmetrics_available',
+                                   lambda: False)())
+        body = metrics_lib.render_serving(engine=engine, qos=qos_stats,
+                                          disagg=self.disagg_stats,
+                                          openmetrics=openmetrics)
+        if openmetrics:
+            return web.Response(
+                body=body,
+                headers={'Content-Type':
+                         metrics_lib.OPENMETRICS_CONTENT_TYPE})
+        return web.Response(body=body, content_type='text/plain',
+                            charset='utf-8')
 
     async def debug_traces(self, request: web.Request) -> web.Response:
         """Recent + slowest completed traces (?slowest=1, ?trace_id=,
@@ -1602,6 +1671,17 @@ class LlmServer:
             None, profiler.debug_payload, dict(request.query))
         return web.json_response(payload)
 
+    async def debug_exemplars(self, request: web.Request) -> web.Response:
+        """The in-process metric exemplar store (server/metrics.py):
+        newest trace id per histogram bucket — the jump from a tail
+        latency bucket to a retained trace (?metric= filters one
+        family). Same scrape-token gate as /metrics."""
+        if not self._scrape_authorized(request):
+            return web.json_response({'error': 'unauthorized'},
+                                     status=401)
+        return web.json_response(
+            _metrics().exemplars_payload(dict(request.query)))
+
     async def debug_alerts(self, request: web.Request) -> web.Response:
         """SLO alert state visible from THIS process (observability/
         slo.py): the evaluator runs on the API server, so a replica
@@ -1624,6 +1704,7 @@ class LlmServer:
         app.router.add_get('/debug/traces', self.debug_traces)
         app.router.add_get('/debug/blackbox', self.debug_blackbox)
         app.router.add_get('/debug/profile', self.debug_profile)
+        app.router.add_get('/debug/exemplars', self.debug_exemplars)
         app.router.add_get('/debug/alerts', self.debug_alerts)
         app.router.add_post('/generate', self.generate)
         # KV handoff (disaggregated prefill/decode, serve/disagg.py).

@@ -16,6 +16,16 @@ expired handoff, corrupt payload, install rejection, a decode replica
 dying mid-stream — falls back to colocated serving on a surviving
 replica (re-serving the request whole, minus tokens already streamed),
 so the split is a perf optimization that can never lose a request.
+
+TRACING + TAIL RETENTION (observability/trace.py): every /generate
+opens an ``lb.request`` root (joined to the client's X-SkyTPU-Trace,
+minted otherwise) with per-leg handoff/upstream child spans; at
+completion the retention verdict decides keep-vs-drop, and a keep fans
+out as a trailing ``/debug/traces?retain=`` fetch to every replica
+that served a fragment — so all legs of an interesting journey survive.
+The LB serves its OWN ``/debug/traces`` (never proxied), whose
+``?stitch=1&trace_id=`` merges the replicas' fragments into one
+cross-replica waterfall.
 """
 from __future__ import annotations
 
@@ -31,11 +41,16 @@ import aiohttp
 from aiohttp import web
 
 from skypilot_tpu.observability import blackbox
+from skypilot_tpu.observability import trace as trace_lib
 from skypilot_tpu.serve.load_balancing_policies import (LoadBalancingPolicy,
                                                         make_policy)
 from skypilot_tpu.utils import prefix_affinity
 
 _HANDOFF_TIMEOUT_S = 300.0
+_FWD_HEADERS_KEY = '_lb_fwd_headers'
+# One-element tuple so "parsed to None (malformed)" and "never parsed"
+# stay distinguishable on the request mapping.
+_PARSED_BODY_KEY = '_lb_parsed_body'
 
 
 class _HandoffFailed(Exception):
@@ -52,6 +67,7 @@ class LoadBalancer:
     _GUARDED_BY = {'_times': '_times_lock',
                    'disagg_stats': '_stats_lock',
                    'affinity_stats': '_stats_lock',
+                   'trace_stats': '_stats_lock',
                    '_replica_summaries': '_stats_lock'}
 
     def __init__(self, port: int, policy: str = 'least_load',
@@ -105,6 +121,10 @@ class LoadBalancer:
         # anywhere (cold prefix, not a fallback).
         self.affinity_stats = {'routed': 0, 'fallbacks': 0,
                                'misses': 0, 'matched_blocks': 0}
+        # Tail-retention propagation accounting: keeps = LB-rooted
+        # journeys retention kept; notified = trailing retain fetches
+        # delivered to replicas so their fragments survive too.
+        self.trace_stats = {'keeps': 0, 'notified': 0}
         # Last controller-pushed per-replica /health trie summaries,
         # kept for operator introspection (probes, affinity_snapshot).
         self._replica_summaries: Dict[str, dict] = {}
@@ -233,6 +253,10 @@ class LoadBalancer:
         return pick, matched
 
     def _note_request(self, replica: str) -> None:
+        # Every serving path notes its upstream here (handler scope),
+        # so the trace root's upstream list stays complete across
+        # handoffs, fallbacks, and resumes.
+        self._tag_upstream(replica)
         with self._times_lock:
             self._times.setdefault(replica, []).append(time.time())
 
@@ -266,33 +290,123 @@ class LoadBalancer:
 
     @staticmethod
     def _fwd_headers(request: web.Request) -> Dict[str, str]:
-        headers = {k: v for k, v in request.headers.items()
-                   if k.lower() not in ('host', 'content-length')}
-        # Serving-path traces begin at the LB: mint a trace id for
-        # clients that did not send one (clients that did keep theirs).
-        from skypilot_tpu.observability import trace as trace_lib
-        if trace_lib.TRACE_HEADER not in request.headers:
-            minted = trace_lib.mint_header()
-            if minted:
-                headers[trace_lib.TRACE_HEADER] = minted
-        return headers
+        """Forwardable headers for one request, CACHED on the request:
+        every downstream leg (handoff, colocated fallback, mid-stream
+        resume) must re-send the SAME trace header — re-minting per
+        call used to split a resumed journey into orphan traces."""
+        base = request.get(_FWD_HEADERS_KEY)
+        if base is None:
+            skip = ('host', 'content-length',
+                    trace_lib.TRACE_HEADER.lower())
+            base = {k: v for k, v in request.headers.items()
+                    if k.lower() not in skip}
+            # Serving-path traces begin at the LB: mint a trace id for
+            # clients that did not send one (clients that did keep
+            # theirs). The inbound header is re-keyed under the
+            # CANONICAL name: request.headers is case-insensitive but
+            # this plain-dict copy is not, and a client casing like
+            # urllib's 'X-skytpu-trace' would otherwise hide the header
+            # from every .get(TRACE_HEADER) downstream — orphaning the
+            # journey it exists to correlate.
+            inbound = request.headers.get(trace_lib.TRACE_HEADER)
+            if inbound is None:
+                inbound = trace_lib.mint_header()
+            if inbound:
+                base[trace_lib.TRACE_HEADER] = inbound
+            request[_FWD_HEADERS_KEY] = base
+        return dict(base)
+
+    @staticmethod
+    def _tag_upstream(endpoint: str) -> None:
+        """Remember which replicas served fragments of the current
+        journey (root-span attr — call sites run at handler scope, not
+        inside a child-span ctx): the keep-notification fan-out reads
+        it back to promote every fragment of a kept journey."""
+        s = trace_lib.current()
+        if s is None:
+            return
+        ups = s.attrs.setdefault('upstreams', [])
+        if endpoint not in ups:
+            ups.append(endpoint)
+
+    def _known_endpoints(self) -> List[str]:
+        eps = set(self.policy.replicas or ())
+        eps |= set(self._prefill_policy.replicas or ())
+        eps |= set(self._decode_policy.replicas or ())
+        eps |= set(self.roles)
+        return sorted(eps)
 
     async def _proxy(self, request: web.Request) -> web.StreamResponse:
+        if request.path == '/debug/traces' and request.method == 'GET':
+            # The LB's OWN trace view (its lb.request fragments +
+            # cross-replica stitching) — served locally, never proxied,
+            # behind the same scrape-token gate as replica /debug/*.
+            return await self._debug_traces(request)
         if request.path.startswith('/debug/'):
             # Operator-facing endpoints (replica /debug/traces carries
             # cross-tenant request metadata) never transit the
             # tenant-facing LB — operators scrape replicas directly.
             return web.json_response(
                 {'error': 'debug endpoints are not proxied; query the '
-                          'replica directly'}, status=403)
+                          'replica directly (the LB serves only its '
+                          'own /debug/traces)'}, status=403)
+        if request.method == 'POST' and request.path == '/generate':
+            headers = self._fwd_headers(request)
+            tctx = trace_lib.start_trace(
+                'lb.request',
+                parent_header=headers.get(trace_lib.TRACE_HEADER))
+            if not tctx:
+                return await self._proxy_generate(request)
+            with tctx:
+                # Downstream legs nest under the LB root: overwrite the
+                # cached forward header with this root's span id (same
+                # trace id, LB span as the replica root's parent).
+                hv = trace_lib.header_value()
+                if hv:
+                    request[_FWD_HEADERS_KEY][trace_lib.TRACE_HEADER] = hv
+                # The QoS class keys the LB fragment's own tail
+                # thresholds (client-experienced latency). Parsed ONCE:
+                # the result is cached on the request so the disagg/
+                # affinity branch below never re-parses multi-KB token
+                # arrays on the event loop.
+                try:
+                    parsed = json.loads(await request.read())
+                except ValueError:
+                    parsed = None
+                request[_PARSED_BODY_KEY] = (parsed,)
+                if isinstance(parsed, dict) and parsed.get('priority'):
+                    trace_lib.set_attr(qos_class=str(parsed['priority']))
+                resp = await self._proxy_generate(request)
+                trace_lib.set_attr(status=resp.status)
+                verdict = resp.headers.get(trace_lib.VERDICT_HEADER) \
+                    if resp.headers is not None else None
+                if verdict:
+                    # Replica-propagated outcome verdict (shed/evicted/
+                    # error): mirror the status so the LB fragment's own
+                    # retention verdict agrees even when the LB saw a
+                    # 200-wrapped stream.
+                    trace_lib.set_attr(replica_verdict=verdict)
+                return resp
+        return await self._proxy_generate(request)
+
+    async def _proxy_generate(self,
+                              request: web.Request) -> web.StreamResponse:
         replica = None
         if (request.method == 'POST' and request.path == '/generate'
                 and (self.disagg_active() or self._affinity_ready())):
-            body = None
-            try:
-                body = json.loads(await request.read())
-            except ValueError:
-                pass
+            cached = request.get(_PARSED_BODY_KEY)
+            if cached is not None:  # the trace wrapper already parsed
+                body = cached[0]
+            else:
+                body = None
+                try:
+                    body = json.loads(await request.read())
+                except ValueError:
+                    pass
+            if isinstance(body, dict) and body.get('priority'):
+                # The class keys the tail-retention thresholds the LB
+                # fragment's verdict uses at completion.
+                trace_lib.set_attr(qos_class=str(body['priority']))
             if self.disagg_active():
                 if self._disagg_eligible(body):
                     return await self._proxy_disagg(request, body)
@@ -318,6 +432,14 @@ class LoadBalancer:
         url = f'http://{replica}{request.path_qs}'
         self.policy.on_request_start(replica)
         try:
+            with trace_lib.span('lb.upstream', replica=replica):
+                return await self._forward_plain(request, url, replica)
+        finally:
+            self.policy.on_request_end(replica)
+
+    async def _forward_plain(self, request: web.Request, url: str,
+                             replica: str) -> web.StreamResponse:
+        try:
             async with aiohttp.ClientSession() as session:
                 body = await request.read()
                 headers = self._fwd_headers(request)
@@ -332,13 +454,16 @@ class LoadBalancer:
                     if 'Content-Type' in resp.headers:
                         out_headers['Content-Type'] = \
                             resp.headers['Content-Type']
+                    # The replica's locally-decided retention verdict
+                    # rides back so the LB-root wrapper can mirror it.
+                    if trace_lib.VERDICT_HEADER in resp.headers:
+                        out_headers[trace_lib.VERDICT_HEADER] = \
+                            resp.headers[trace_lib.VERDICT_HEADER]
                     return web.Response(status=resp.status, body=payload,
                                         headers=out_headers)
         except aiohttp.ClientError as e:
             return web.json_response(
                 {'error': f'replica {replica} failed: {e}'}, status=502)
-        finally:
-            self.policy.on_request_end(replica)
 
     # -- disaggregated prefill/decode orchestration ------------------------
 
@@ -392,6 +517,7 @@ class LoadBalancer:
             return await self._serve_colocated(request, body)
         headers = self._fwd_headers(request)
         self._note_request(decode)
+        self._tag_upstream(prefill)  # its kv_export fragment stitches too
         self._prefill_policy.on_request_start(prefill)
         self._decode_policy.on_request_start(decode)
         prefill_busy = True
@@ -411,13 +537,16 @@ class LoadBalancer:
                     url = (f'http://{decode}/v1/kv/import'
                            + ('?stream=1' if stream else ''))
                     if not stream:
-                        async with session.post(url, timeout=timeout,
-                                                **import_kwargs) as r:
-                            payload = await r.read()
-                            if r.status != 200:
-                                raise _HandoffFailed(
-                                    f'import {r.status}: '
-                                    f'{payload[:200]!r}')
+                        with trace_lib.span('lb.handoff.import',
+                                            replica=decode):
+                            async with session.post(
+                                    url, timeout=timeout,
+                                    **import_kwargs) as r:
+                                payload = await r.read()
+                                if r.status != 200:
+                                    raise _HandoffFailed(
+                                        f'import {r.status}: '
+                                        f'{payload[:200]!r}')
                         with self._stats_lock:
                             self.disagg_stats['handoffs'] += 1
                             if aff_routed:
@@ -460,13 +589,14 @@ class LoadBalancer:
                        # the admission gate (header forms forward via
                        # _fwd_headers already).
                        'priority', 'tenant') if k in body}
-        async with session.post(f'http://{prefill}/v1/kv/export',
-                                json=export_req, headers=headers,
-                                timeout=timeout) as r:
-            if r.status != 200:
-                raise _HandoffFailed(
-                    f'export {r.status}: {(await r.text())[:200]}')
-            exp = await r.json()
+        with trace_lib.span('lb.handoff.export', replica=prefill):
+            async with session.post(f'http://{prefill}/v1/kv/export',
+                                    json=export_req, headers=headers,
+                                    timeout=timeout) as r:
+                if r.status != 200:
+                    raise _HandoffFailed(
+                        f'export {r.status}: {(await r.text())[:200]}')
+                exp = await r.json()
         ref = exp.get('staging_ref')
         if ref:
             return dict(json={'staging_ref': ref},
@@ -477,27 +607,31 @@ class LoadBalancer:
         skip = 0
         if exp.get('full_blocks'):
             try:
-                async with session.post(
-                        f'http://{decode}/v1/kv/prepare',
-                        json={'tokens': export_req['tokens']},
-                        timeout=timeout) as r:
-                    if r.status == 200:
-                        skip = min(
-                            int((await r.json()).get('skip_blocks')
-                                or 0),
-                            int(exp['full_blocks']))
+                with trace_lib.span('lb.handoff.prepare',
+                                    replica=decode):
+                    async with session.post(
+                            f'http://{decode}/v1/kv/prepare',
+                            json={'tokens': export_req['tokens']},
+                            timeout=timeout) as r:
+                        if r.status == 200:
+                            skip = min(
+                                int((await r.json()).get('skip_blocks')
+                                    or 0),
+                                int(exp['full_blocks']))
             except (aiohttp.ClientError, asyncio.TimeoutError,
                     ValueError):
                 skip = 0
-        async with session.get(
-                f'http://{prefill}/v1/kv/fetch',
-                params={'handoff': exp['handoff'],
-                        'skip_blocks': str(skip)},
-                timeout=timeout) as r:
-            if r.status != 200:
-                raise _HandoffFailed(
-                    f'fetch {r.status}: {(await r.text())[:200]}')
-            payload = await r.read()
+        with trace_lib.span('lb.handoff.fetch', replica=prefill,
+                            skip_blocks=skip):
+            async with session.get(
+                    f'http://{prefill}/v1/kv/fetch',
+                    params={'handoff': exp['handoff'],
+                            'skip_blocks': str(skip)},
+                    timeout=timeout) as r:
+                if r.status != 200:
+                    raise _HandoffFailed(
+                        f'fetch {r.status}: {(await r.text())[:200]}')
+                payload = await r.read()
         hdrs = dict(headers)
         hdrs['Content-Type'] = 'application/octet-stream'
         return dict(data=payload, headers=hdrs), 'remote'
@@ -567,7 +701,12 @@ class LoadBalancer:
             self.disagg_stats['fallbacks'] += 1
             self.disagg_stats['resumed_streams'] += 1
         # A decode replica died (or wedged) mid-stream: the highest-
-        # signal LB event a post-mortem can ask for.
+        # signal LB event a post-mortem can ask for — and a retention
+        # keep ('resumed') on its own: the root attr drives the LB
+        # fragment's verdict, the request header makes the survivor tag
+        # (and stitch) its leg instead of minting an orphan trace.
+        trace_lib.set_attr(resume=True, resume_lost=exclude,
+                           resume_sent=sent)
         blackbox.record('lb.fallback', reason='mid_stream',
                         lost=exclude, sent=sent)
         replica = self._select_fallback(exclude)
@@ -581,6 +720,7 @@ class LoadBalancer:
         retry['stream'] = True
         hdrs = dict(headers)
         hdrs['X-SkyTPU-Disagg-Fallback'] = '1'
+        hdrs[trace_lib.RESUME_HEADER] = '1'
         self._note_request(replica)
         self.policy.on_request_start(replica)
         skipped = 0
@@ -676,6 +816,117 @@ class LoadBalancer:
         finally:
             self.policy.on_request_end(replica)
 
+    # -- tail-retention propagation + cross-replica stitching --------------
+
+    def _on_trace_keep(self, record: Dict[str, object],
+                       verdict: str) -> None:
+        """Keep hook (trace.add_keep_hook): when retention keeps an
+        LB-rooted journey, fan the verdict out to every replica that
+        served a fragment — their local verdicts may have said
+        'boring', and without the trailing retain fetch the journey's
+        legs would expire out of their pending buffers."""
+        if not str(record.get('name') or '').startswith('lb.'):
+            return  # another component's trace (probe-local loadgen etc.)
+        attrs = record.get('attrs') or {}
+        upstreams = list(attrs.get('upstreams') or ())  # type: ignore
+        loop = self._loop
+        if not upstreams or loop is None or loop.is_closed():
+            return
+        with self._stats_lock:
+            self.trace_stats['keeps'] += 1
+        coro = self._notify_retain(str(record['trace_id']), verdict,
+                                   upstreams)
+        try:
+            asyncio.run_coroutine_threadsafe(coro, loop)
+        except RuntimeError:  # loop stopped between check and schedule
+            coro.close()
+
+    async def _notify_retain(self, trace_id: str, verdict: str,
+                             endpoints: List[str]) -> None:
+        headers = {}
+        token = os.environ.get('SKYTPU_METRICS_TOKEN')
+        if token:
+            # Replica /debug/traces sits behind the scrape token when
+            # one is configured; the LB holds the same env.
+            headers['Authorization'] = f'Bearer {token}'
+        async with aiohttp.ClientSession() as session:
+            for ep in endpoints:
+                try:
+                    async with session.get(
+                            f'http://{ep}/debug/traces',
+                            params={'retain': trace_id,
+                                    'verdict': verdict},
+                            headers=headers,
+                            timeout=aiohttp.ClientTimeout(
+                                total=10)) as r:
+                        await r.read()
+                    with self._stats_lock:
+                        self.trace_stats['notified'] += 1
+                except (aiohttp.ClientError, asyncio.TimeoutError):
+                    continue  # a dead replica's fragment died with it
+
+    async def _fetch_fragments(self, trace_id: str):
+        """Pull one trace's fragments from every known replica's
+        /debug/traces — the cross-replica half of ?stitch=1."""
+        headers = {}
+        token = os.environ.get('SKYTPU_METRICS_TOKEN')
+        if token:
+            headers['Authorization'] = f'Bearer {token}'
+        fragments: List[dict] = []
+        asked: List[str] = []
+        async with aiohttp.ClientSession() as session:
+            for ep in self._known_endpoints():
+                try:
+                    async with session.get(
+                            f'http://{ep}/debug/traces',
+                            params={'trace_id': trace_id, 'limit': '20'},
+                            headers=headers,
+                            timeout=aiohttp.ClientTimeout(
+                                total=10)) as r:
+                        if r.status != 200:
+                            continue
+                        payload = json.loads(await r.text())
+                except (aiohttp.ClientError, asyncio.TimeoutError,
+                        ValueError):
+                    continue
+                asked.append(ep)
+                for tr in payload.get('traces') or ():
+                    if isinstance(tr, dict):
+                        fragments.append(tr)
+        return fragments, asked
+
+    async def _debug_traces(self, request: web.Request) -> web.Response:
+        """The LB's own /debug/traces: its ``lb.request`` fragments and
+        retained journeys, plus ``?stitch=1&trace_id=<id>`` to merge
+        the replicas' fragments into ONE cross-replica waterfall
+        (disagg export→fetch→import legs, resume legs). Token-gated
+        like replica /debug/* (SKYTPU_METRICS_TOKEN; unset = open)."""
+        from skypilot_tpu import users as users_lib
+        if not users_lib.metrics_scrape_allowed(request.headers):
+            return web.json_response({'error': 'unauthorized'},
+                                     status=401)
+        query = dict(request.query)
+        stitch = str(query.pop('stitch', '')) in ('1', 'true')
+        payload = await asyncio.get_event_loop().run_in_executor(
+            None, trace_lib.debug_payload, query)
+        trace_id = query.get('trace_id')
+        if stitch and trace_id:
+            fragments, asked = await self._fetch_fragments(
+                str(trace_id))
+            merged = trace_lib.merge_traces(
+                list(payload.get('traces') or ()) + fragments)
+            merged = [t for t in merged
+                      if t['trace_id'].startswith(str(trace_id))]
+            if str(query.get('autopsy', '')) in ('1', 'true'):
+                payload['autopsy'] = [trace_lib.autopsy(t)
+                                      for t in merged]
+            payload['traces'] = merged
+            payload['count'] = len(merged)
+            payload['stitched_from'] = asked
+        with self._stats_lock:
+            payload['lb'] = dict(self.trace_stats)
+        return web.json_response(payload)
+
     def make_app(self) -> web.Application:
         app = web.Application()
         app.router.add_route('*', '/{tail:.*}', self._proxy)
@@ -702,8 +953,13 @@ class LoadBalancer:
         self._thread.start()
         if not started.wait(timeout=10):
             raise RuntimeError('load balancer failed to start')
+        # Retention keep decisions fan out to the replicas that served
+        # the journey (trailing /debug/traces?retain= fetch). Hooked
+        # only while the loop lives — stop() unhooks.
+        trace_lib.add_keep_hook(self._on_trace_keep)
 
     def stop(self) -> None:
+        trace_lib.remove_keep_hook(self._on_trace_keep)
         if self._loop is None:
             return
         loop = self._loop
@@ -711,6 +967,11 @@ class LoadBalancer:
         async def shutdown():
             if self._runner is not None:
                 await self._runner.cleanup()
+            # Fire-and-forget work (retain-notification fan-outs) must
+            # not outlive the loop as destroyed-pending tasks.
+            for task in asyncio.all_tasks(loop):
+                if task is not asyncio.current_task():
+                    task.cancel()
             loop.stop()
 
         asyncio.run_coroutine_threadsafe(shutdown(), loop)

@@ -237,6 +237,10 @@ async def _one(session, url: str, prompt_span, max_new_span,
         headers[trace_lib.TRACE_HEADER] = minted
     if tenant is not None:
         headers['X-SkyTPU-Tenant'] = tenant
+    # The minted trace id rides the whole journey (LB root + replica
+    # fragments); --autopsy resolves the slowest/errored requests back
+    # to their RETAINED traces by this id.
+    trace_id = minted.split('-')[1] if minted else None
     t0 = time.perf_counter()
     ttft = None
     status = None
@@ -271,7 +275,7 @@ async def _one(session, url: str, prompt_span, max_new_span,
                 new = len(body['tokens'][0]) if ok else 0
     except Exception:  # noqa: BLE001 — a failed request is a data point
         ok, new = False, 0
-    return ok, new, time.perf_counter() - t0, ttft, status
+    return ok, new, time.perf_counter() - t0, ttft, status, trace_id
 
 
 def _pctile(sorted_vals, q: int):
@@ -354,6 +358,69 @@ async def _alerts_fired_in_window(session, alerts_url: str,
     return sorted(fired)
 
 
+async def _autopsy_report(session, url: str, flat, slowest_n: int = 5,
+                          wait_s: float = 10.0) -> dict:
+    """--autopsy: resolve this run's slowest + errored/shed requests to
+    their RETAINED traces by trace id, fetched THROUGH the target
+    (``/debug/traces?trace_id=&stitch=1`` — against an LB the stitch
+    merges the replica fragments into one journey). Retention
+    propagation (the LB's trailing retain fetch) is asynchronous, so
+    each id polls briefly before it is declared missing. Candidates
+    without a trace id (SKYTPU_TRACE=0 in the loadgen process) are
+    reported, not failed."""
+    import aiohttp
+
+    failed = [r for r in flat if not r[0] or (r[4] or 0) >= 400]
+    oks = sorted((r for r in flat if r[0]), key=lambda r: r[2],
+                 reverse=True)
+    candidates = []
+    seen = set()
+    for r in failed + oks[:slowest_n]:
+        tid = r[5]
+        if tid in seen:
+            continue
+        seen.add(tid)
+        candidates.append({'trace_id': tid,
+                           'latency_s': round(r[2], 3),
+                           'status': r[4], 'ok': r[0]})
+    fetched, missing = [], []
+    for cand in candidates:
+        tid = cand['trace_id']
+        if not tid:
+            missing.append(cand)
+            continue
+        deadline = time.time() + wait_s
+        hit = None
+        while hit is None and time.time() <= deadline:
+            try:
+                async with session.get(
+                        f'{url}/debug/traces',
+                        params={'trace_id': tid, 'stitch': '1',
+                                'retained': '1'},
+                        timeout=aiohttp.ClientTimeout(total=15)) as r:
+                    if r.status == 200:
+                        body = json.loads(await r.text())
+                        for tr in body.get('traces') or ():
+                            if tr.get('retained'):
+                                hit = tr
+                                break
+            except Exception:  # noqa: BLE001 — poll until deadline
+                pass
+            if hit is None:
+                await asyncio.sleep(0.5)
+        if hit is not None:
+            fetched.append({**cand, 'retained': hit['retained'],
+                            'spans': len(hit.get('spans') or ()),
+                            'duration_ms': hit.get('duration_ms')})
+        else:
+            missing.append(cand)
+    return {'candidates': len(candidates),
+            'retained': fetched,
+            'fetched': len(fetched),
+            'missing': missing,
+            'ok': not missing}
+
+
 async def run_load(url: str, requests_total: int, concurrency: int,
                    prompt_len, max_new, vocab: int,
                    stream: bool = False, mix=None, tenants: int = 1,
@@ -366,7 +433,8 @@ async def run_load(url: str, requests_total: int, concurrency: int,
                    alerts_url: str = '',
                    fleet_endpoints=None,
                    seed_base: int = 0,
-                   tenant_offset: int = 0) -> dict:
+                   tenant_offset: int = 0,
+                   autopsy: bool = False) -> dict:
     """``fleet_endpoints``: replica endpoints to scrape /health from
     before and after the run; with a shared-prefix mix the report then
     carries the FLEET-wide hit rate over this run's window next to the
@@ -484,6 +552,14 @@ async def run_load(url: str, requests_total: int, concurrency: int,
         if dump_on_error and failed:
             incident_bundles = await _dump_replica_bundles(
                 session, dump_endpoints or [url], dump_on_error)
+
+        autopsy_out = None
+        if autopsy:
+            # --autopsy: the slowest/errored requests must resolve to
+            # retained, fetch-by-id traces through the target (stitched
+            # across LB + replicas when the target is an LB).
+            autopsy_out = await _autopsy_report(
+                session, url, [r for _, r in results])
 
         alerts_fired = None
         if alerts_url:
@@ -615,6 +691,8 @@ async def run_load(url: str, requests_total: int, concurrency: int,
         extra['incident_bundles'] = incident_bundles
     if alerts_fired is not None:
         extra['alerts_fired'] = alerts_fired
+    if autopsy_out is not None:
+        extra['autopsy'] = autopsy_out
     return {
         **extra,
         'requests': requests_total,
@@ -714,6 +792,14 @@ def main() -> None:
                              'that fired during the load window in the '
                              "report line ('alerts_fired') — perf runs "
                              'self-report degradation')
+    parser.add_argument('--autopsy', action='store_true',
+                        help='at end of run, resolve the slowest and '
+                             'errored/shed requests to their RETAINED '
+                             'traces by trace id through the target '
+                             '(/debug/traces?trace_id=&stitch=1 — '
+                             'against an LB the replicas\' fragments '
+                             'stitch into one journey) and record the '
+                             "outcome in the report line ('autopsy')")
     args = parser.parse_args()
     dump_eps = None
     if args.replica_endpoints:
@@ -734,7 +820,8 @@ def main() -> None:
                                # Shared-prefix runs aggregate the
                                # FLEET hit rate over the same replica
                                # endpoints bundles dump from.
-                               fleet_endpoints=dump_eps))
+                               fleet_endpoints=dump_eps,
+                               autopsy=args.autopsy))
     print(json.dumps(out))
 
 

@@ -1154,9 +1154,57 @@ function waterfall(tr){
                 a.request_id, a.ttft_ms !== undefined ?
                 `ttft ${a.ttft_ms}ms` : null]
     .filter(Boolean).map(esc).join(' · ');
-  return `<h2>${esc(tr.name)} — ${tr.duration_ms.toFixed(1)} ms
+  // Retention badge + autopsy link: kept journeys are the interesting
+  // 0.1% — the badge names WHY retention kept this one.
+  const kept = tr.retained
+    ? ` ${B('kept:' + tr.retained)}
+       <a href="#/autopsy/${esc(tr.trace_id)}" style="font-size:12px
+       ">autopsy</a>` : '';
+  return `<h2>${esc(tr.name)} — ${tr.duration_ms.toFixed(1)} ms${kept}
     <span style="color:#888;font-weight:400;font-size:12px">${tags}</span>
     </h2><table>${rows}</table>`;
+}
+
+// Request autopsy: one kept trace's where-time-went (queue / prefill /
+// handoff / decode / stream) next to its QoS class's baseline — the
+// "why was THIS one slow" view /debug/traces?autopsy=1 computes
+// server-side (observability/trace.py phase_breakdown).
+async function autopsyView(traceId){
+  const d = await J('debug/traces?autopsy=1&trace_id=' +
+                    encodeURIComponent(traceId));
+  if(!(d.autopsy||[]).length || !d.traces.length)
+    return `<h2>Autopsy</h2><p>(trace ${esc(traceId.slice(0,16))} not
+      found — it may have rotated out; retained traces survive in the
+      keep-* spool and incident bundles)</p>`;
+  const a = d.autopsy[0], tr = d.traces[0];
+  const phases = ['queue','prefill','handoff','decode','stream','other'];
+  const base = a.baseline || {};
+  const maxMs = Math.max(...phases.map(p => Math.max(
+      a.breakdown[p]||0, base[p]||0)), 0.01);
+  const rows = phases.filter(p =>
+      (a.breakdown[p]||0) > 0 || (base[p]||0) > 0).map(p => {
+    const ms = a.breakdown[p]||0, bms = base[p]||0;
+    const w = (ms/maxMs*100).toFixed(1), bw = (bms/maxMs*100).toFixed(1);
+    return `<tr><td>${esc(p)}</td>
+     <td style="width:45%"><div style="height:12px;background:#f0f0f3;
+       border-radius:2px"><div style="width:${w}%;height:12px;
+       border-radius:2px;background:${PALETTE[0]}"></div></div></td>
+     <td style="color:#666;white-space:nowrap">${ms.toFixed(1)} ms</td>
+     <td style="width:25%"><div style="height:8px;background:#f0f0f3;
+       border-radius:2px"><div style="width:${bw}%;height:8px;
+       border-radius:2px;background:#bbb"></div></div></td>
+     <td style="color:#999;white-space:nowrap">${bms.toFixed(1)} ms
+       baseline</td></tr>`;
+  }).join('');
+  return `<h2>Autopsy — ${esc(tr.name)} ${
+    a.retained ? B('kept:' + a.retained) : ''}
+    <span style="color:#888;font-weight:400;font-size:12px">${
+    esc(tr.trace_id.slice(0,16))} · ${esc(a.qos_class)} · ${
+    tr.duration_ms.toFixed(1)} ms vs class baseline ${
+    (base.total||0).toFixed(1)} ms (n=${base.n||0})</span></h2>
+    <table><tr><th>phase</th><th>this request</th><th></th>
+    <th>class baseline</th><th></th></tr>${rows}</table>` +
+    d.traces.map(waterfall).join('');
 }
 
 async function tracesView(traceId){
@@ -1307,6 +1355,8 @@ async function route(){
     else if((m = h.match(/^#\\/traces\\/(.+)$/)))
       html = await tracesView(decodeURIComponent(m[1]));
     else if(h === '#/traces') html = await tracesView();
+    else if((m = h.match(/^#\\/autopsy\\/(.+)$/)))
+      html = await autopsyView(decodeURIComponent(m[1]));
     else if((m = h.match(/^#\\/incidents\\/(.+)$/)))
       html = await incidentView(decodeURIComponent(m[1]));
     else if(h === '#/incidents') html = await incidentsView();

@@ -20,10 +20,25 @@ Two registries:
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
 
 from prometheus_client import (CollectorRegistry, Counter, Gauge,
                                Histogram, generate_latest)
+
+# text/plain exposition never carries exemplars; the OpenMetrics
+# exposition does (the `# {trace_id="..."} value ts` suffix on bucket
+# lines). Optional import: absent on older client libs, in which case
+# the in-process exemplar store below is the only surface.
+try:
+    from prometheus_client.openmetrics.exposition import (
+        generate_latest as _om_generate_latest)
+except ImportError:  # pragma: no cover - baked-in lib has it
+    _om_generate_latest = None
+
+OPENMETRICS_CONTENT_TYPE = \
+    'application/openmetrics-text; version=1.0.0; charset=utf-8'
 
 REGISTRY = CollectorRegistry()
 SERVING_REGISTRY = CollectorRegistry()
@@ -50,12 +65,116 @@ SERVE_PHASE = Histogram(
     'Per-phase serving durations (phase = prefill | decode | window).',
     ['phase', 'qos_class'], buckets=LATENCY_BUCKETS_S,
     registry=SERVING_REGISTRY)
+DECODE_RATE_BUCKETS = (1, 5, 10, 25, 50, 100, 250, 500, 1000, 2500,
+                       5000, 10000, 25000)
 SERVE_DECODE_RATE = Histogram(
     'skytpu_serve_decode_tok_s',
     'Per-request decode throughput (tokens / decode seconds).',
     ['qos_class'],
-    buckets=(1, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000,
-             25000), registry=SERVING_REGISTRY)
+    buckets=DECODE_RATE_BUCKETS, registry=SERVING_REGISTRY)
+
+# -- metric exemplars (tail-retention bridge) --------------------------------
+# Each serving histogram observation that happened inside a trace
+# records the trace id against the bucket it landed in: the operator
+# jumps from "the p99.9 TTFT bucket moved" straight to a retained
+# trace. Two surfaces: the OpenMetrics exposition (native exemplar
+# syntax, negotiated via the Accept header) and the in-process store on
+# /debug/exemplars (newest observation per (metric, labels, bucket),
+# bounded).
+_SERVE_HISTOGRAMS: Dict[str, Tuple[Histogram, tuple]] = {
+    'skytpu_serve_ttft_seconds': (SERVE_TTFT, LATENCY_BUCKETS_S),
+    'skytpu_serve_queue_wait_seconds': (SERVE_QUEUE_WAIT,
+                                        LATENCY_BUCKETS_S),
+    'skytpu_serve_phase_seconds': (SERVE_PHASE, LATENCY_BUCKETS_S),
+    'skytpu_serve_decode_tok_s': (SERVE_DECODE_RATE,
+                                  DECODE_RATE_BUCKETS),
+}
+_EXEMPLAR_CAP = 512
+_EXEMPLARS_LOCK = threading.Lock()
+# (metric, sorted-labels-tuple, le) -> {trace_id, value, ts}; dict
+# insertion order doubles as recency for the cap eviction.
+_EXEMPLARS: Dict[Tuple[str, tuple, float], Dict[str, Any]] = {}
+
+_GUARDED_BY = {'_EXEMPLARS': '_EXEMPLARS_LOCK'}
+
+
+def observe_serving(name: str, value: float,
+                    trace_id: Optional[str] = None,
+                    **labels: str) -> None:
+    """Observe one serving histogram sample, recording ``trace_id`` as
+    the bucket's exemplar when the request was traced (head-sampled OR
+    tail-pending — a tail-kept outlier is exactly what the exemplar
+    should point at). Falls back to a plain observe on client libs
+    without exemplar support."""
+    hist, buckets = _SERVE_HISTOGRAMS[name]
+    child = hist.labels(**labels)
+    exemplar = ({'trace_id': str(trace_id)[:64]} if trace_id else None)
+    try:
+        child.observe(value, exemplar=exemplar)
+    except (TypeError, ValueError):  # no exemplar kwarg / invalid runes
+        child.observe(value)
+    if not trace_id:
+        return
+    le = next((float(b) for b in buckets if value <= b), float('inf'))
+    key = (name, tuple(sorted(labels.items())), le)
+    entry = {'trace_id': str(trace_id), 'value': round(float(value), 6),
+             'ts': round(time.time(), 3)}
+    with _EXEMPLARS_LOCK:
+        _EXEMPLARS.pop(key, None)  # re-insert at the recency tail
+        _EXEMPLARS[key] = entry
+        while len(_EXEMPLARS) > _EXEMPLAR_CAP:
+            _EXEMPLARS.pop(next(iter(_EXEMPLARS)))
+
+
+def exemplars_payload(query: Optional[Dict[str, Any]] = None
+                      ) -> Dict[str, Any]:
+    """The ``/debug/exemplars`` body: the in-process exemplar store,
+    newest-first, optionally filtered to one ``?metric=``. Each entry
+    links a histogram bucket to the trace id of its most recent
+    observation — resolve it via /debug/traces?trace_id=."""
+    query = query or {}
+    want = str(query.get('metric') or '') or None
+    with _EXEMPLARS_LOCK:
+        items = list(_EXEMPLARS.items())
+    out = []
+    for (name, labels, le), entry in reversed(items):
+        if want and name != want:
+            continue
+        out.append({'metric': name, 'labels': dict(labels),
+                    'le': (le if le != float('inf') else '+Inf'),
+                    **entry})
+    return {'count': len(out), 'exemplars': out}
+
+
+def reset_exemplars_for_testing() -> None:
+    with _EXEMPLARS_LOCK:
+        _EXEMPLARS.clear()
+
+
+# Tail-based trace retention (observability/trace.py): keep/drop
+# accounting mirrored at scrape time from the in-process tail store.
+# Gauges mirroring cumulative counters (restart legitimately resets),
+# in the serving registry so replicas expose them natively.
+_TRACE_RETAINED = Gauge(
+    'skytpu_trace_retained_total',
+    'Traces kept by tail-based retention on this process, by verdict '
+    '(the bounded trace.VERDICTS vocabulary: slow | slow_ttft | error '
+    '| shed | evicted | resumed | slo_breach | recompile_storm | '
+    'baseline | propagated).',
+    ['verdict'], registry=SERVING_REGISTRY)
+_TRACE_PENDING = Gauge(
+    'skytpu_trace_pending',
+    'Tail-pending trace fragments currently parked awaiting a '
+    'retention verdict (TTL-bounded).', registry=SERVING_REGISTRY)
+
+
+def _refresh_trace_gauges() -> None:
+    from skypilot_tpu.observability import trace as trace_lib
+    _TRACE_RETAINED.clear()
+    stats = trace_lib.tail_stats()
+    for verdict, n in (stats.get('verdicts') or {}).items():
+        _TRACE_RETAINED.labels(verdict=verdict).set(n)
+    _TRACE_PENDING.set(stats.get('pending') or 0)
 
 # Replica-local engine/queue gauges, set at scrape time by the replica's
 # own /metrics handler (satellite: replicas scrapeable directly instead
@@ -540,23 +659,33 @@ def _refresh_gauges() -> None:
                 service=key[0], replica=key[1]).set(_P2FT_LAST[key])
 
 
+def openmetrics_available() -> bool:
+    return _om_generate_latest is not None
+
+
 def render() -> bytes:
     _refresh_gauges()
     _refresh_incident_gauge()
     _refresh_alert_gauge()
     _refresh_profiler_gauges()
+    _refresh_trace_gauges()
     return generate_latest(REGISTRY) + generate_latest(SERVING_REGISTRY)
 
 
 def render_serving(engine: Optional[Dict[str, Any]] = None,
                    qos: Optional[Dict[str, Any]] = None,
-                   disagg: Optional[Dict[str, Any]] = None) -> bytes:
+                   disagg: Optional[Dict[str, Any]] = None,
+                   openmetrics: bool = False) -> bytes:
     """The serving replica's scrape body: the latency histograms plus
     point-in-time engine/queue gauges from the stats dicts the replica
     already maintains for /health. ``disagg`` is the server-level
-    KV-handoff accounting (serve/llm_server.py disagg_stats)."""
+    KV-handoff accounting (serve/llm_server.py disagg_stats).
+    ``openmetrics=True`` renders the OpenMetrics exposition instead —
+    the one that carries histogram exemplars (trace ids on bucket
+    lines) — when the client negotiated it via Accept."""
     _refresh_incident_gauge()
     _refresh_profiler_gauges()
+    _refresh_trace_gauges()
     if disagg:
         for direction, prefix in (('export', 'export'),
                                   ('import', 'import')):
@@ -610,4 +739,6 @@ def render_serving(engine: Optional[Dict[str, Any]] = None,
                     c.get('depth') or 0)
     else:
         _REPLICA_QUEUE_DEPTH.clear()
+    if openmetrics and _om_generate_latest is not None:
+        return _om_generate_latest(SERVING_REGISTRY)
     return generate_latest(SERVING_REGISTRY)

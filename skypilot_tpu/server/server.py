@@ -202,6 +202,17 @@ async def debug_profile(request: web.Request) -> web.Response:
     return web.json_response(payload)
 
 
+@routes.get('/debug/exemplars')
+async def debug_exemplars(request: web.Request) -> web.Response:
+    """The in-process metric exemplar store (server/metrics.py):
+    newest trace id per serving-histogram bucket, the jump from a
+    latency bucket to a retained trace (token-gated by the auth
+    middleware like every non-exempt path; ?metric= filters)."""
+    from skypilot_tpu.server import metrics
+    return web.json_response(
+        metrics.exemplars_payload(dict(request.query)))
+
+
 @routes.get('/api/v1/alerts')
 async def api_alerts(request: web.Request) -> web.Response:
     """Current SLO alerts (observability/slo.py): active

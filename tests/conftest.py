@@ -42,6 +42,15 @@ os.environ.setdefault(
     os.path.join(__import__('tempfile').gettempdir(),
                  f'skytpu-test-blackbox-{os.getpid()}'))
 
+# Same rationale for the trace export spool: tail-based retention
+# durably exports keep-* files for every verdict-kept trace (errors and
+# slow requests that tests produce on purpose), which must not land in
+# — or be read back from — the operator's real ~/.skypilot_tpu/traces.
+os.environ.setdefault(
+    'SKYTPU_TRACE_EXPORT_DIR',
+    os.path.join(__import__('tempfile').gettempdir(),
+                 f'skytpu-test-traces-{os.getpid()}'))
+
 import pytest
 
 # Suite tiers for CI (`make test-fast` < 5 min): modules dominated by jax
@@ -78,6 +87,24 @@ def tmp_state_dir(tmp_path, monkeypatch):
     """Isolate on-disk state (cluster DB, logs) per test."""
     monkeypatch.setenv('SKYTPU_STATE_DIR', str(tmp_path / 'state'))
     yield tmp_path / 'state'
+
+
+@pytest.fixture(autouse=True)
+def _reset_trace_tail_store(tmp_path, monkeypatch):
+    """Tail-based trace retention keeps records in a process-global
+    store and a durable keep-* spool (that persistence is the feature)
+    — but across tests it leaks one suite's retained traces into
+    another's incident bundles and /debug payloads. Same isolation
+    rationale as pointing the blackbox spool at a tmp dir: per-test
+    export dir, per-test retained-store reset."""
+    monkeypatch.setenv('SKYTPU_TRACE_EXPORT_DIR',
+                       str(tmp_path / 'trace-exports'))
+    yield
+    from skypilot_tpu.observability import trace as trace_lib
+    # Drain queued keep exports BEFORE the env reverts, so a late
+    # background write cannot land in the next test's spool.
+    trace_lib.flush_keep_exports(timeout=5)
+    trace_lib._TAIL.reset()
 
 
 @pytest.fixture()

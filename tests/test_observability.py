@@ -119,12 +119,16 @@ def traced(monkeypatch):
     monkeypatch.setenv('SKYTPU_TRACE', '1')
     monkeypatch.delenv('SKYTPU_TRACE_SAMPLE', raising=False)
     monkeypatch.delenv('SKYTPU_TRACE_EXPORT', raising=False)
+    # Baseline keeps (2/min by default) would add nondeterministic
+    # keep-* files / retained records to the legacy assertions below;
+    # the retention tests opt back in explicitly.
+    monkeypatch.setenv('SKYTPU_TRACE_TAIL_BASELINE_PER_MIN', '0')
     trace.reset()
     yield
     trace.reset()
 
 
-def test_trace_header_roundtrip_and_rejection(traced):
+def test_trace_header_roundtrip_and_rejection(traced, monkeypatch):
     h = trace.make_header()
     tid, sid, sampled = trace.parse_header(h)
     assert sampled and len(tid) == 32 and len(sid) == 16
@@ -132,11 +136,24 @@ def test_trace_header_roundtrip_and_rejection(traced):
     assert trace.parse_header('') is None
     assert trace.parse_header('nonsense') is None
     assert trace.parse_header('00-zz-yy-01') is None
-    # Unsampled flag parses but suppresses local tracing.
+    # Unsampled flag parses; with tail retention OFF it suppresses
+    # local tracing entirely...
     _, _, sampled = trace.parse_header(trace.make_header(sampled=False))
     assert sampled is False
+    monkeypatch.setenv('SKYTPU_TRACE_TAIL', '0')
     assert not trace.start_trace('x', parent_header=trace.make_header(
         sampled=False))
+    # ...while with tail retention ON (the default) the request is
+    # still traced — into the pending/verdict path, not the ring — and
+    # the outbound header preserves the unsampled flag.
+    monkeypatch.setenv('SKYTPU_TRACE_TAIL', '1')
+    monkeypatch.setenv('SKYTPU_TRACE_TAIL_BASELINE_PER_MIN', '0')
+    tctx = trace.start_trace('x', parent_header=trace.make_header(
+        sampled=False))
+    assert tctx
+    with tctx:
+        assert trace.header_value().endswith('-00')
+    assert trace.collect(include_exported=False) == []  # not in ring
 
 
 def test_trace_span_nesting_and_attrs(traced):
@@ -185,8 +202,18 @@ def test_trace_disabled_and_sample_zero_are_noops(traced, monkeypatch):
     assert trace.span('y') is not None  # no-op CM, still usable
     monkeypatch.setenv('SKYTPU_TRACE', '1')
     monkeypatch.setenv('SKYTPU_TRACE_SAMPLE', '0')
+    # Head sampling off AND tail retention off: a true no-op.
+    monkeypatch.setenv('SKYTPU_TRACE_TAIL', '0')
     assert not trace.start_trace('x')
     assert trace.collect(include_exported=False) == []
+    # With tail retention (the default) a sample-0 root is still
+    # traced — tail-pending, never in the ring.
+    monkeypatch.setenv('SKYTPU_TRACE_TAIL', '1')
+    monkeypatch.setenv('SKYTPU_TRACE_TAIL_BASELINE_PER_MIN', '0')
+    with trace.start_trace('x') as s:
+        assert s is not None and s.sampled is False
+    assert trace.collect(include_exported=False) == []
+    assert trace.tail_stats()['pending'] == 1
     # span() outside any trace: no-op, nothing recorded.
     with trace.span('orphan'):
         pass
@@ -468,12 +495,372 @@ def test_replica_debug_scrape_token_and_lb_debug_refusal(traced,
             headers={'Authorization':
                      'Bearer scrape-only'}).status_code == 200
 
-    # The LB refuses /debug/* before even selecting a replica.
+    # The LB refuses to PROXY /debug/* before even selecting a replica;
+    # the one exception is its OWN /debug/traces (the lb.request
+    # fragments + cross-replica stitcher), behind the same scrape token.
     lb = LoadBalancer(port=common_utils.find_free_port(23750))
     lb.start_in_thread()
     try:
-        r = requests_lib.get(
-            f'http://127.0.0.1:{lb.port}/debug/traces', timeout=10)
+        lb_url = f'http://127.0.0.1:{lb.port}'
+        r = requests_lib.get(f'{lb_url}/debug/blackbox', timeout=10)
         assert r.status_code == 403, r.text
+        r = requests_lib.get(f'{lb_url}/debug/traces', timeout=10)
+        assert r.status_code == 401, r.text  # token still set above
+        r = requests_lib.get(
+            f'{lb_url}/debug/traces', timeout=10,
+            headers={'Authorization': 'Bearer scrape-only'})
+        assert r.status_code == 200, r.text
+        assert 'traces' in r.json() and 'tail' in r.json()
+        monkeypatch.delenv('SKYTPU_METRICS_TOKEN')
+        r = requests_lib.get(f'{lb_url}/debug/traces', timeout=10)
+        assert r.status_code == 200, r.text  # unset token = open
     finally:
         lb.stop()
+
+
+# -- tail-based retention (observability/trace.py) ---------------------------
+
+
+@pytest.fixture()
+def tailed(traced, monkeypatch, tmp_path):
+    """Pure-tail configuration: head sampling off, baseline off, spool
+    isolated — every trace rides the pending/verdict path and nothing
+    is kept unless a verdict fires."""
+    monkeypatch.setenv('SKYTPU_TRACE_SAMPLE', '0')
+    monkeypatch.setenv('SKYTPU_TRACE_TAIL', '1')
+    monkeypatch.setenv('SKYTPU_TRACE_TAIL_BASELINE_PER_MIN', '0')
+    monkeypatch.setenv('SKYTPU_TRACE_EXPORT_DIR', str(tmp_path / 'spool'))
+    yield tmp_path / 'spool'
+
+
+def _finish(name='serve.generate', **attrs):
+    with trace.start_trace(name, **attrs):
+        pass
+
+
+def test_tail_outcome_verdicts_keep_and_export(tailed):
+    _finish(status=429)                      # shed
+    _finish(status=504)                      # evicted
+    _finish(status=500)                      # error
+    _finish(resume=True)                     # resumed
+    _finish(status=200)                      # boring -> pending
+    # Client hang-ups are NOT server errors: a disconnect storm must
+    # not rotate real keeps out of the bounded ring.
+    _finish(error='CancelledError')          # -> pending, not 'error'
+    stats = trace.tail_stats()
+    assert stats['kept'] == 4 and stats['pending'] == 2
+    assert stats['verdicts'] == {'shed': 1, 'evicted': 1, 'error': 1,
+                                 'resumed': 1}
+    kept = trace.collect(include_exported=False, retained_only=True,
+                         limit=10)
+    assert {t['retained'] for t in kept} == {'shed', 'evicted', 'error',
+                                             'resumed'}
+    # Durable: every keep landed as a keep-* spool file (via the
+    # background writer — drained explicitly here), none of the
+    # pending/boring ones did.
+    assert trace.flush_keep_exports()
+    names = sorted(p.name for p in tailed.glob('*.json'))
+    assert len(names) == 4 and all(n.startswith('keep-') for n in names)
+    # The ring is EMPTY (nothing head-sampled), yet fetch-by-id works
+    # through the retained store.
+    tid = kept[0]['trace_id']
+    assert trace.collect(trace_id=tid, include_exported=False,
+                         limit=5)[0]['trace_id'] == tid
+
+
+def test_tail_threshold_flags_per_class(tailed, monkeypatch):
+    monkeypatch.setenv('SKYTPU_TRACE_TAIL_LATENCY_MS',
+                       'interactive:600000,batch:0.0001')
+    _finish(qos_class='interactive', status=200)   # far under its bar
+    _finish(qos_class='batch', status=200)         # over its 0.1us bar
+    stats = trace.tail_stats()
+    assert stats['verdicts'] == {'slow': 1}
+    kept = trace.collect(include_exported=False, retained_only=True)
+    assert kept[0]['attrs']['qos_class'] == 'batch'
+    th = trace.tail_thresholds()
+    assert th['batch']['latency'] == {'ms': 0.0001, 'source': 'flag'}
+    # Bare-number form applies to every class.
+    monkeypatch.setenv('SKYTPU_TRACE_TAIL_LATENCY_MS', '0.0001')
+    _finish(qos_class='interactive', status=200)
+    assert trace.tail_stats()['verdicts']['slow'] == 2
+
+
+def test_tail_auto_threshold_derivation(tailed):
+    store = trace._TAIL
+    rec = lambda ms, **attrs: {  # noqa: E731 — local record factory
+        'trace_id': __import__('uuid').uuid4().hex, 'name': 'g',
+        'start': time.time(), 'duration_ms': ms,
+        'attrs': {'qos_class': 'standard', 'status': 200, **attrs},
+        'spans': []}
+    # Below MIN_WINDOW samples: no auto threshold, nothing kept.
+    for _ in range(store.MIN_WINDOW - 1):
+        assert store.evaluate(rec(10.0), sampled=False) is None
+    assert trace.tail_thresholds().get('standard') is None
+    # Warm window (p95 ~= 10ms): threshold 2x p95; a 10x outlier keeps,
+    # a nominal request still parks.
+    store.evaluate(rec(10.0), sampled=False)
+    th = trace.tail_thresholds()['standard']['latency']
+    assert th['source'] == 'auto' and 15.0 <= th['ms'] <= 25.0
+    assert store.evaluate(rec(100.0), sampled=False) == 'slow'
+    assert store.evaluate(rec(11.0), sampled=False) is None
+    # TTFT rides its own window/threshold.
+    for _ in range(store.MIN_WINDOW):
+        store.evaluate(rec(10.0, ttft_ms=5.0), sampled=False)
+    assert store.evaluate(rec(10.0, ttft_ms=500.0),
+                          sampled=False) == 'slow_ttft'
+
+
+def test_tail_pending_park_retain_promotion(tailed):
+    with trace.start_trace('serve.generate', status=200) as root:
+        tid = root.trace_id
+    assert trace.tail_stats()['pending'] == 1
+    assert trace.collect(trace_id=tid, include_exported=False) == []
+    # Unknown verdicts clamp to 'propagated' (the bounded vocabulary);
+    # prefix retain works past 8 chars.
+    assert trace.retain(  # skylint: allow-verdict(tests the clamp)
+        tid[:12], 'not-a-verdict') == 1
+    assert trace.tail_stats()['pending'] == 0
+    got = trace.collect(trace_id=tid, include_exported=False,
+                        retained_only=True)
+    assert got and got[0]['retained'] == 'propagated'
+    assert trace.flush_keep_exports()
+    assert any(p.name.startswith('keep-')
+               for p in tailed.glob('*.json'))
+    # Idempotent-ish: nothing left to promote.
+    assert trace.retain(tid, 'propagated') == 0
+    # debug_payload drives the same promotion (the LB's trailing fetch).
+    with trace.start_trace('serve.generate', status=200) as root2:
+        tid2 = root2.trace_id
+    p = trace.debug_payload({'retain': tid2, 'verdict': 'propagated',
+                             'trace_id': tid2, 'retained': '1'})
+    assert p['retained_promoted'] == 1
+    assert p['count'] == 1 and p['traces'][0]['retained'] == 'propagated'
+
+
+def test_tail_pending_ttl_and_cap(tailed, monkeypatch):
+    monkeypatch.setenv('SKYTPU_TRACE_TAIL_PENDING', '3')
+    for _ in range(6):
+        _finish(status=200)
+    stats = trace.tail_stats()
+    assert stats['pending'] == 3 and stats['expired'] == 3
+    monkeypatch.setenv('SKYTPU_TRACE_TAIL_PENDING_S', '0.05')
+    time.sleep(0.1)
+    _finish(status=200)  # park triggers the TTL prune
+    assert trace.tail_stats()['pending'] == 1
+
+
+def test_tail_retained_ring_and_keep_rotation(tailed, monkeypatch):
+    monkeypatch.setenv('SKYTPU_TRACE_TAIL_RING', '4')
+    monkeypatch.setenv('SKYTPU_TRACE_TAIL_KEEP', '3')
+    monkeypatch.setenv('SKYTPU_TRACE_EXPORT', '1')
+    monkeypatch.setenv('SKYTPU_TRACE_SAMPLE', '1')
+    monkeypatch.setenv('SKYTPU_TRACE_EXPORT_KEEP', '2')
+    for i in range(8):
+        _finish(status=500)  # error: every one kept AND ring-exported
+        time.sleep(0.002)    # distinct export-file timestamps
+    # The retained ring itself is bounded (head-sampled kept records
+    # additionally live in the 256-deep main ring, which is why the
+    # assertion reads the store, not collect()).
+    assert len(trace._TAIL.retained_snapshot()) == 4
+    assert trace.flush_keep_exports()
+    keeps = sorted(p.name for p in tailed.glob('keep-*.json'))
+    plain = sorted(p.name for p in tailed.glob('[0-9]*.json'))
+    # The two rotation budgets are independent: keep-* files never
+    # count against the plain export budget or vice versa.
+    assert len(keeps) == 3 and len(plain) == 2
+
+
+def test_collect_slowest_ranks_retained_store_and_spool(tailed,
+                                                       monkeypatch):
+    """Satellite regression: ?slowest=1 must rank what retention kept —
+    the in-process retained store AND the keep-* spool (another
+    process's keep) — not just the head-sampled ring."""
+    monkeypatch.setenv('SKYTPU_TRACE_SAMPLE', '1')
+    _finish(name='fast.ring', status=200)  # in ring, boring, ~0ms
+    # A retained slow trace that never entered the ring (tail path).
+    monkeypatch.setenv('SKYTPU_TRACE_SAMPLE', '0')
+    monkeypatch.setenv('SKYTPU_TRACE_TAIL_LATENCY_MS', '10')
+    with trace.start_trace('slow.retained', status=200):
+        time.sleep(0.05)  # genuinely slower than the ring trace
+    monkeypatch.delenv('SKYTPU_TRACE_TAIL_LATENCY_MS')
+    # A foreign process's keep file, slower than everything local.
+    t0 = time.time()
+    foreign = {'trace_id': 'f' * 32, 'name': 'slow.foreign',
+               'start': t0 - 10, 'duration_ms': 9999.0, 'attrs': {},
+               'retained': 'slow',
+               'spans': [{'name': 'slow.foreign', 'span_id': 'a' * 16,
+                          'parent_id': None, 'start': t0 - 10,
+                          'end': t0 - 0.001}]}
+    tailed.mkdir(parents=True, exist_ok=True)
+    (tailed / f'keep-{int((t0 - 10) * 1000):013d}-{"f" * 12}-99.json'
+     ).write_text(json.dumps(foreign))
+    out = trace.collect(limit=3, slowest_first=True)
+    assert [t['name'] for t in out][:2] == ['slow.foreign',
+                                            'slow.retained']
+    assert out[0]['retained'] == 'slow'
+
+
+def test_spool_merge_torn_duplicate_and_rotation_race(tailed,
+                                                      monkeypatch):
+    """Satellite: collect() over a spool with torn/partial files,
+    duplicate trace ids (ring + disk), and keep-rotation racing the
+    reader — no exception, no dropped good records, no double-counted
+    spans."""
+    import threading
+    import uuid as uuid_lib
+    monkeypatch.setenv('SKYTPU_TRACE_SAMPLE', '1')
+    monkeypatch.setenv('SKYTPU_TRACE_EXPORT', '1')
+    with trace.start_trace('dup.root', status=200) as root:
+        tid = root.trace_id
+    # The same record is now in the ring AND on disk: spans dedup by id.
+    merged = trace.collect(trace_id=tid, limit=5)
+    assert len(merged) == 1 and len(merged[0]['spans']) == 1
+    # Torn tail (truncated json) + partial (valid json, no trace_id) +
+    # foreign garbage are all invisible.
+    (tailed / f'{int(time.time() * 1000):013d}-{"a" * 12}-1.json'
+     ).write_text('{"trace_id": "a')
+    (tailed / f'{int(time.time() * 1000):013d}-{"b" * 12}-1.json'
+     ).write_text('{"spans": []}')
+    (tailed / 'not-a-trace.json').write_text('[]')
+    assert [t['trace_id'] for t in trace.collect(trace_id=tid, limit=5)
+            ] == [tid]
+    # Keep-rotation racing a reader: a writer thread hammers keeps with
+    # a tiny budget (each write rotates older keep files away) while
+    # the reader loops collect(); unreadable/vanishing files skip.
+    monkeypatch.setenv('SKYTPU_TRACE_TAIL_KEEP', '2')
+    stop = threading.Event()
+
+    def writer():
+        i = 0
+        while not stop.is_set() and i < 200:
+            rec = {'trace_id': uuid_lib.uuid4().hex, 'name': 'w',
+                   'start': time.time(), 'duration_ms': 1.0,
+                   'attrs': {}, 'spans': []}
+            trace._export(rec, keep=True)
+            i += 1
+
+    th = threading.Thread(target=writer)
+    th.start()
+    try:
+        for _ in range(50):
+            out = trace.collect(limit=20, slowest_first=True)
+            assert all(t.get('trace_id') for t in out)
+    finally:
+        stop.set()
+        th.join(timeout=30)
+
+
+def test_tail_ambient_verdicts_slo_and_baseline(tailed, monkeypatch):
+    # slo_breach: a firing rule in this process keeps the journey.
+    from skypilot_tpu.observability import slo as slo_mod
+    monkeypatch.setattr(slo_mod, 'enabled', lambda: True)
+    monkeypatch.setattr(slo_mod, 'firing_rules',
+                        lambda: ['serve.ttft_p99'])
+    _finish(status=200)
+    assert trace.tail_stats()['verdicts'] == {'slo_breach': 1}
+    monkeypatch.setattr(slo_mod, 'firing_rules', lambda: [])
+    # baseline: bounded budget per minute.
+    monkeypatch.setenv('SKYTPU_TRACE_TAIL_BASELINE_PER_MIN', '2')
+    for _ in range(5):
+        _finish(status=200)
+    stats = trace.tail_stats()
+    assert stats['verdicts'].get('baseline') == 2
+    assert stats['pending'] == 3
+
+
+def test_keep_hooks_fire_and_remove(tailed):
+    seen = []
+    hook = lambda record, verdict: seen.append(  # noqa: E731
+        (record['trace_id'], verdict))
+    trace.add_keep_hook(hook)
+    try:
+        with trace.start_trace('serve.generate', status=500) as root:
+            tid = root.trace_id
+        assert seen == [(tid, 'error')]
+    finally:
+        trace.remove_keep_hook(hook)
+    _finish(status=500)
+    assert len(seen) == 1  # removed hook stays silent
+    assert trace.retained_ids(limit=4)[0] == \
+        trace.collect(retained_only=True, include_exported=False,
+                      limit=1)[0]['trace_id']
+
+
+def test_verdict_for_status_and_registry_bounds():
+    assert trace.verdict_for_status(429) == 'shed'
+    assert trace.verdict_for_status(504) == 'evicted'
+    assert trace.verdict_for_status(500) == 'error'
+    assert trace.verdict_for_status(200) is None
+    assert trace.verdict_for_status(400) is None  # client error: boring
+    for v in ('slow', 'slow_ttft', 'error', 'shed', 'evicted',
+              'resumed', 'slo_breach', 'recompile_storm', 'baseline',
+              'propagated'):
+        assert v in trace.VERDICT_NAMES
+
+
+def test_phase_breakdown_and_autopsy_payload(tailed, monkeypatch):
+    t0 = 1000.0
+    spans = [
+        {'name': 'lb.request', 'span_id': 'r' * 16, 'parent_id': None,
+         'start': t0, 'end': t0 + 1.0},
+        {'name': 'qos.queue_wait', 'span_id': 'q' * 16,
+         'parent_id': 'r' * 16, 'start': t0, 'end': t0 + 0.2},
+        {'name': 'serve.prefill', 'span_id': 'p' * 16,
+         'parent_id': 'r' * 16, 'start': t0 + 0.2, 'end': t0 + 0.5},
+        {'name': 'serve.decode', 'span_id': 'd' * 16,
+         'parent_id': 'r' * 16, 'start': t0 + 0.5, 'end': t0 + 0.8},
+        {'name': 'serve.stream', 'span_id': 's' * 16,
+         'parent_id': 'r' * 16, 'start': t0 + 0.5, 'end': t0 + 0.9},
+        {'name': 'lb.handoff.fetch', 'span_id': 'h' * 16,
+         'parent_id': 'r' * 16, 'start': t0 + 0.8, 'end': t0 + 0.85},
+    ]
+    tr = {'trace_id': 'c' * 32, 'name': 'lb.request', 'start': t0,
+          'duration_ms': 1000.0, 'attrs': {'qos_class': 'standard'},
+          'retained': 'slow', 'spans': spans}
+    b = trace.phase_breakdown(tr)
+    assert b['queue'] == 200.0 and b['prefill'] == 300.0
+    assert b['decode'] == 300.0 and b['handoff'] == 50.0
+    assert b['stream'] == 100.0  # stream minus decode overlap
+    assert b['total'] == 1000.0 and b['other'] == 50.0
+    a = trace.autopsy(tr)
+    assert a['retained'] == 'slow' and a['qos_class'] == 'standard'
+    # Baseline: mean over recent ring peers of the class.
+    monkeypatch.setenv('SKYTPU_TRACE_SAMPLE', '1')
+    _finish(qos_class='standard', status=200)
+    base = trace.class_baseline('standard')
+    assert base and base['n'] >= 1 and 'total' in base
+
+
+def test_exemplar_store_and_openmetrics_exposition(tailed, monkeypatch):
+    from skypilot_tpu.server import metrics
+    metrics.reset_exemplars_for_testing()
+    tid = 'e' * 32
+    metrics.observe_serving('skytpu_serve_ttft_seconds', 0.3,
+                            trace_id=tid, qos_class='batch')
+    metrics.observe_serving('skytpu_serve_ttft_seconds', 4.0,
+                            trace_id='f' * 32, qos_class='batch')
+    metrics.observe_serving('skytpu_serve_queue_wait_seconds', 0.01,
+                            qos_class='interactive')  # untraced: no ex.
+    p = metrics.exemplars_payload()
+    assert p['count'] == 2
+    by_le = {e['le']: e for e in p['exemplars']}
+    assert by_le[0.5]['trace_id'] == tid
+    assert by_le[5.0]['trace_id'] == 'f' * 32
+    assert all(e['metric'] == 'skytpu_serve_ttft_seconds'
+               for e in p['exemplars'])
+    # Newest observation wins a bucket.
+    metrics.observe_serving('skytpu_serve_ttft_seconds', 0.31,
+                            trace_id='9' * 32, qos_class='batch')
+    assert {e['le']: e for e in metrics.exemplars_payload()['exemplars']
+            }[0.5]['trace_id'] == '9' * 32
+    # The OpenMetrics exposition carries the exemplar on bucket lines.
+    if metrics.openmetrics_available():
+        text = metrics.render_serving(openmetrics=True).decode()
+        assert any('# {trace_id="' in line
+                   for line in text.splitlines()
+                   if line.startswith('skytpu_serve_ttft_seconds_bucket'))
+    # Retention gauges render from tail_stats.
+    _finish(status=500)
+    text = metrics.render_serving().decode()
+    assert 'skytpu_trace_retained_total{verdict="error"} 1.0' in text
+    metrics.reset_exemplars_for_testing()

@@ -525,10 +525,18 @@ def _midstream_kill_attempt(salt: int, port_base: int):
             json={**payload, 'stream': False}, timeout=300
         ).json()['tokens'][0]
 
+        # The client's own trace header: after a mid-stream kill BOTH
+        # legs (dead decode + surviving resume) must stitch into THIS
+        # one trace id — the resume retry re-sends the original header.
+        from skypilot_tpu.observability import trace as trace_lib
+        trace_lib.reset()
+        header = trace_lib.make_header()
+        trace_id = header.split('-')[1]
         got = []
         killed = False
         with requests_lib.post(f'http://127.0.0.1:{lb.port}/generate',
                                json=payload, stream=True,
+                               headers={trace_lib.TRACE_HEADER: header},
                                timeout=300) as r:
             assert r.status_code == 200
             for line in r.iter_lines():
@@ -547,7 +555,8 @@ def _midstream_kill_attempt(salt: int, port_base: int):
                     killed = True
         assert killed, 'no tokens before stream end'
         return (got, list(want), lb.disagg_stats['resumed_streams'],
-                servers['colocated'].disagg_stats['fallbacks_served'])
+                servers['colocated'].disagg_stats['fallbacks_served'],
+                trace_id)
     finally:
         lb.stop()
         for s in servers.values():
@@ -558,15 +567,39 @@ def _midstream_kill_attempt(salt: int, port_base: int):
 def test_http_lb_reroutes_when_decode_dies_midstream():
     """The decode replica's engine dies mid-stream: the LB resumes the
     request on a surviving replica, skipping tokens already delivered —
-    the client sees ONE complete, correct stream. Retried because the
-    tiny model can finish all 128 tokens before the kill lands (the
-    race is the test's point, not a flake)."""
+    the client sees ONE complete, correct stream, and both legs stitch
+    into ONE trace (the resume retry re-sends the original
+    X-SkyTPU-Trace header and tags the survivor leg resume=true)
+    retained under the 'resumed' verdict. Retried because the tiny
+    model can finish all 128 tokens before the kill lands (the race is
+    the test's point, not a flake)."""
+    from skypilot_tpu.observability import trace as trace_lib
     for attempt in range(3):
-        got, want, resumed, fallbacks = _midstream_kill_attempt(
-            salt=12 + attempt, port_base=24200 + 200 * attempt)
+        got, want, resumed, fallbacks, trace_id = \
+            _midstream_kill_attempt(
+                salt=12 + attempt, port_base=24200 + 200 * attempt)
         assert got == want, (got, want)
         if resumed:
             assert fallbacks == 1
+            # All servers + the LB share this process's tracer: every
+            # fragment of the journey must carry the CLIENT's trace id
+            # (one trace, not orphans) with the resume evidence intact.
+            traces = trace_lib.collect(trace_id=trace_id, limit=10,
+                                       include_exported=False)
+            assert len(traces) == 1, [t['trace_id'] for t in traces]
+            tr = traces[0]
+            names = {s['name'] for s in tr['spans']}
+            assert 'lb.request' in names, sorted(names)
+            # The survivor leg re-joined the SAME trace and is tagged.
+            resumed_legs = [
+                s for s in tr['spans']
+                if s['name'] == 'serve.generate'
+                and (s.get('attrs') or {}).get('resume')]
+            assert resumed_legs, [
+                (s['name'], s.get('attrs')) for s in tr['spans']]
+            assert tr['attrs'].get('resume') is True  # LB root attr
+            # Retention kept the journey as 'resumed'.
+            assert tr.get('retained') == 'resumed', tr.get('retained')
             return
     raise AssertionError(
         'decode finished before the kill in all 3 attempts — '

@@ -1356,3 +1356,97 @@ def test_full_cold_run_stays_in_lint_budget(tmp_path):
     warm = time_lib.perf_counter() - t0
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert warm < 3.0, f'warm --changed took {warm:.1f}s'
+
+
+# -- verdict-name (tail-retention verdict registry) ---------------------------
+
+from skylint.checkers import verdict_names as verdict_mod  # noqa: E402
+
+
+def test_verdict_undeclared_literal_flagged_with_hint(tmp_path):
+    sf = _sf(tmp_path, '''
+        from skypilot_tpu.observability import trace
+        trace.retain('abc123', 'resumedx')
+        ''')
+    findings = verdict_mod.VerdictNames().check_file(sf)
+    assert _rules(findings) == ['verdict-name']
+    assert 'resumedx' in findings[0].message
+    assert "'resumed'" in findings[0].message  # did-you-mean
+
+
+def test_verdict_declared_dynamic_and_suppressed_ok(tmp_path):
+    sf = _sf(tmp_path, '''
+        from skypilot_tpu.observability import trace as trace_lib
+        trace_lib.retain('abc123', 'propagated')      # declared
+        trace_lib.retain('abc123', verdict='slow')    # kwarg form
+        v = compute()
+        trace_lib.retain('abc123', v)                 # dynamic: clamped
+        trace_lib.retain('abc123')                    # defaulted
+        trace_lib.retain('abc123', 'wat')  # skylint: allow-verdict(fixture)
+        ''')
+    assert verdict_mod.VerdictNames().check_file(sf) == []
+
+
+def test_verdict_unrelated_retain_methods_ignored(tmp_path):
+    sf = _sf(tmp_path, '''
+        class Cache:
+            def retain(self, key, verdict):
+                return key
+        Cache().retain('k', 'not-a-verdict')
+        ''')
+    assert verdict_mod.VerdictNames().check_file(sf) == []
+
+
+def test_verdict_undocumented_declaration_flagged(tmp_path):
+    reg = tmp_path / 'skypilot_tpu' / 'observability' / 'trace.py'
+    reg.parent.mkdir(parents=True)
+    reg.write_text(textwrap.dedent('''
+        def Verdict(name, doc):
+            return (name, doc)
+        VERDICTS = (Verdict('slow', 'kept when slow'),
+                    Verdict('ghost_verdict', 'never documented'),)
+        '''), encoding='utf-8')
+    docs = tmp_path / 'docs' / 'operations.md'
+    docs.parent.mkdir(parents=True)
+    docs.write_text('| `slow` | kept because slow |\n', encoding='utf-8')
+    findings = verdict_mod.VerdictNames().check_tree([], tmp_path)
+    assert _rules(findings) == ['verdict-name']
+    assert 'ghost_verdict' in findings[0].message
+    # Duplicate declarations are findings too.
+    reg.write_text(textwrap.dedent('''
+        def Verdict(name, doc):
+            return (name, doc)
+        VERDICTS = (Verdict('slow', 'a'), Verdict('slow', 'b'),)
+        '''), encoding='utf-8')
+    findings = verdict_mod.VerdictNames().check_tree([], tmp_path)
+    assert any('duplicate' in f.message for f in findings)
+
+
+def test_verdict_cross_check_clean_on_real_tree():
+    files = skylint.load_files()
+    checker = verdict_mod.VerdictNames()
+    findings = checker.check_tree(files, skylint.ROOT)
+    findings += [f for sf in files for f in checker.check_file(sf)]
+    assert findings == [], '\n'.join(str(f) for f in findings)
+
+
+def test_metric_openmetrics_created_suffix_not_flagged(tmp_path):
+    """Docs quoting an exemplar-bearing OpenMetrics scrape verbatim —
+    bucket lines with `# {trace_id=...}` suffixes and the exposition's
+    `_created` series — must not false-positive the metric-name scan."""
+    doc = tmp_path / 'docs' / 'operations.md'
+    doc.parent.mkdir(parents=True)
+    doc.write_text(textwrap.dedent('''
+        ```
+        skytpu_serve_ttft_seconds_bucket{le="5.0"} 3 # {trace_id="4bf9"} 4.2 1726000000.0
+        skytpu_serve_ttft_seconds_created 1726000000.0
+        ```
+        '''), encoding='utf-8')
+    metrics_py = tmp_path / 'skypilot_tpu' / 'server' / 'metrics.py'
+    metrics_py.parent.mkdir(parents=True)
+    metrics_py.write_text(textwrap.dedent('''
+        from prometheus_client import Histogram
+        H = Histogram('skytpu_serve_ttft_seconds', 'ttft')
+        '''), encoding='utf-8')
+    findings = metric_names.MetricNames().check_tree([], tmp_path)
+    assert findings == [], '\n'.join(str(f) for f in findings)

@@ -95,6 +95,21 @@ output through the affinity LB is byte-identical to a direct replica
 hit (routing is never a correctness dependency; SKYTPU_PREFIX_AFFINITY
 stays default-off). CPU-only, wired into ``make verify``.
 
+``--autopsy`` runs the tail-based trace-retention gate
+(observability/trace.py): three real colocated replica processes behind
+the LB (plus a prefill/decode pair behind a second, role-aware LB) with
+head sampling pinned at 1% and tail retention ON — injected slow
+(batch-class, threshold-pinned), shed (QoS flood under occupied
+slots), and died-mid-stream-resumed requests must ALL yield retained,
+fetch-by-id traces whose LB ``?stitch=1`` view spans LB + replica legs
+(including both disagg export→import legs, promoted on the replicas by
+the LB's trailing retain fetch); boring traffic is dropped and the
+per-replica retained volume stays within SKYTPU_TRACE_TAIL_RING; at
+least one tail TTFT-bucket exemplar (/debug/exemplars) resolves to a
+retained trace; ``loadgen --autopsy`` resolves its slowest requests
+end-to-end; and greedy output is byte-identical retention-ON vs
+SKYTPU_TRACE=0. CPU-only, wired into ``make verify``.
+
 ``--slo`` runs the SLO burn-rate alerting gate (observability/slo.py):
 two single-slot replicas; a hammer stalls one under concurrent load so
 its admission backlog breaches the queue-depth rule — the alert must
@@ -1477,6 +1492,337 @@ def blackbox_probe() -> dict:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def autopsy_probe() -> dict:
+    """Tail-based trace retention gate over real OS-process replicas —
+    see the module docstring's ``--autopsy`` entry for the leg list."""
+    import shutil
+    import tempfile
+    import threading
+
+    import requests as requests_lib
+
+    from skypilot_tpu.observability import trace as trace_lib
+    from skypilot_tpu.serve import loadgen
+    from skypilot_tpu.serve.load_balancer import LoadBalancer
+    from skypilot_tpu.utils import common_utils
+
+    max_len = 256
+    workdir = tempfile.mkdtemp(prefix='skytpu-autopsy-')
+    # Retention knobs, shared by the replica CHILDREN and this probe
+    # process (whose LBs compute their own verdicts): head sampling at
+    # 1%, per-class thresholds pinned so 'batch' is always slow and the
+    # other classes never are (deterministic regardless of box speed),
+    # baseline off so boring traffic is provably dropped, tiny retained
+    # ring so the volume bound is a real assertion.
+    tail_env = {
+        'SKYTPU_TRACE': '1',
+        'SKYTPU_TRACE_SAMPLE': '0.01',
+        'SKYTPU_TRACE_TAIL': '1',
+        'SKYTPU_TRACE_TAIL_LATENCY_MS':
+            'interactive:600000,standard:600000,batch:1',
+        'SKYTPU_TRACE_TAIL_BASELINE_PER_MIN': '0',
+        'SKYTPU_TRACE_TAIL_RING': '8',
+    }
+    qos_env = {'SKYTPU_QOS': '1', 'SKYTPU_QOS_MAX_INFLIGHT': '1',
+               'SKYTPU_QOS_MAX_QUEUE': '2'}
+    os.environ.update(tail_env)
+    os.environ['SKYTPU_STATE_DIR'] = os.path.join(workdir, 'probe-state')
+    trace_lib.reset()
+    specs = {
+        'r1': {**tail_env, **qos_env},
+        'r2': {**tail_env, **qos_env},
+        'r3': {**tail_env, **qos_env},
+        # Byte-parity reference: identical serving config, tracing OFF.
+        'off': {**qos_env, 'SKYTPU_TRACE': '0'},
+        'p1': dict(tail_env),
+        'd1': {**tail_env, 'SKYTPU_LLM_CHUNK_STEPS': '2'},
+    }
+    roles = {'p1': 'prefill', 'd1': 'decode'}
+    ports = {t: common_utils.find_free_port(25100 + 40 * i)
+             for i, t in enumerate(specs)}
+    procs = {t: _spawn_replica(roles.get(t, 'colocated'), ports[t],
+                               workdir, max_len, tag=t, extra_env=env)
+             for t, env in specs.items()}
+    eps = {t: f'127.0.0.1:{port}' for t, port in ports.items()}
+    lb1 = LoadBalancer(common_utils.find_free_port(25400))
+    lb2 = LoadBalancer(common_utils.find_free_port(25420))
+
+    def row(n, salt):
+        return [(5 * i + 13 * salt) % 240 + 1 for i in range(n)]
+
+    def forced_tail_header():
+        """A client header with the sampled flag OFF: the journey rides
+        the tail path on every process — retention, not head sampling,
+        must be what saves it."""
+        h = trace_lib.make_header(sampled=False)
+        return h, h.split('-')[1]
+
+    def stitched(lb, tid, want_names=(), want_retained=True,
+                 timeout_s=60.0):
+        """Poll the LB's cross-replica stitcher until the trace shows
+        up retained with the wanted span names (retain propagation is
+        asynchronous). Returns the merged trace dict."""
+        deadline = time.time() + timeout_s
+        last = None
+        while time.time() < deadline:
+            try:
+                body = requests_lib.get(
+                    f'http://127.0.0.1:{lb.port}/debug/traces',
+                    params={'trace_id': tid, 'stitch': '1'},
+                    timeout=30).json()
+            except requests_lib.RequestException:
+                time.sleep(0.3)
+                continue
+            traces = body.get('traces') or []
+            if traces:
+                last = traces[0]
+                names = {s['name'] for s in last.get('spans') or ()}
+                if (not want_retained or last.get('retained')) \
+                        and set(want_names) <= names:
+                    return last
+            time.sleep(0.3)
+        raise AssertionError(
+            f'trace {tid[:12]} never stitched to {want_names} '
+            f'retained={want_retained}; last={last}')
+
+    try:
+        deadline = time.time() + 300
+        for tag, ep in eps.items():
+            while True:
+                if procs[tag].poll() is not None:
+                    raise RuntimeError(
+                        f'{tag} replica exited at startup; see '
+                        f'{workdir}/{tag}.log')
+                try:
+                    requests_lib.get(f'http://{ep}/health',
+                                     timeout=5).raise_for_status()
+                    break
+                except requests_lib.RequestException:
+                    if time.time() > deadline:
+                        raise RuntimeError(
+                            f'{tag} replica never became healthy')
+                    time.sleep(0.5)
+        lb1.set_replicas([eps['r1'], eps['r2'], eps['r3']])
+        lb1.start_in_thread()
+        lb2.set_replicas([eps['p1'], eps['d1'], eps['r1']],
+                         roles={eps['p1']: 'prefill',
+                                eps['d1']: 'decode'})
+        lb2.start_in_thread()
+        lb1_url = f'http://127.0.0.1:{lb1.port}'
+        lb2_url = f'http://127.0.0.1:{lb2.port}'
+
+        # --- (a) greedy byte parity, retention ON vs SKYTPU_TRACE=0 ----
+        for n, max_new, salt in ((12, 16, 1), (48, 24, 2)):
+            payload = {'tokens': [row(n, salt)],
+                       'max_new_tokens': max_new}
+            on = requests_lib.post(f'http://{eps["r1"]}/generate',
+                                   json=payload, timeout=600)
+            off = requests_lib.post(f'http://{eps["off"]}/generate',
+                                    json=payload, timeout=600)
+            assert on.status_code == off.status_code == 200, \
+                (on.text, off.text)
+            assert on.json() == off.json(), (n, max_new)
+
+        # --- (b) boring traffic is dropped ------------------------------
+        boring_tids = []
+        for i in range(3):
+            h, tid = forced_tail_header()
+            r = requests_lib.post(
+                f'{lb1_url}/generate',
+                json={'tokens': [row(8, 30 + i)], 'max_new_tokens': 4},
+                headers={trace_lib.TRACE_HEADER: h}, timeout=600)
+            assert r.status_code == 200, r.text
+            boring_tids.append(tid)
+
+        # --- (c) injected SLOW requests: 100% retained + stitched -------
+        slow_tids = []
+        for i in range(6):
+            h, tid = forced_tail_header()
+            r = requests_lib.post(
+                f'{lb1_url}/generate',
+                json={'tokens': [row(16, 40 + i)], 'max_new_tokens': 8,
+                      'priority': 'batch'},
+                headers={trace_lib.TRACE_HEADER: h}, timeout=600)
+            assert r.status_code == 200, r.text
+            slow_tids.append(tid)
+        for tid in slow_tids:
+            tr = stitched(lb1, tid,
+                          want_names=('lb.request', 'serve.generate'))
+            assert tr['retained'] in ('slow', 'slow_ttft'), tr['retained']
+
+        # --- (d) loadgen --autopsy end-to-end ---------------------------
+        import asyncio
+        out = asyncio.run(loadgen.run_load(
+            lb1_url, requests_total=8, concurrency=2, prompt_len='12',
+            max_new='8', vocab=240, mix='batch:1', autopsy=True))
+        assert out['ok'] == 8, out
+        autopsy = out['autopsy']
+        assert autopsy['candidates'] >= 1 and autopsy['ok'], autopsy
+        assert autopsy['fetched'] == autopsy['candidates'], autopsy
+
+        # --- (e) injected SHED requests under occupied slots ------------
+        occupiers = []
+
+        def occupy(salt):
+            try:
+                with requests_lib.post(
+                        f'{lb1_url}/generate',
+                        json={'tokens': [row(12, salt)],
+                              'max_new_tokens': 96, 'stream': True,
+                              'priority': 'batch'},
+                        stream=True, timeout=600) as r:
+                    for _ in r.iter_lines():
+                        pass
+            except Exception:  # noqa: BLE001 — drained at leg end
+                pass
+
+        for i in range(3):  # one per replica: every slot busy
+            t = threading.Thread(target=occupy, args=(60 + i,))
+            t.start()
+            occupiers.append(t)
+        time.sleep(1.0)  # let the occupiers claim their slots
+        import concurrent.futures as cf
+
+        def burst_one(i):
+            h, tid = forced_tail_header()
+            try:
+                r = requests_lib.post(
+                    f'{lb1_url}/generate',
+                    json={'tokens': [row(8, 80 + i)],
+                          'max_new_tokens': 4,
+                          'priority': 'interactive'},
+                    headers={trace_lib.TRACE_HEADER: h}, timeout=600)
+            except requests_lib.RequestException:
+                return tid, None
+            return tid, r.status_code
+
+        # CONCURRENT burst: with every slot occupied, the per-replica
+        # admission queues overflow past SKYTPU_QOS_MAX_QUEUE and the
+        # overflow sheds with 429 — a sequential burst would never
+        # build queue depth.
+        with cf.ThreadPoolExecutor(max_workers=12) as pool:
+            outcomes = list(pool.map(burst_one, range(12)))
+        shed_tids = [tid for tid, status in outcomes if status == 429]
+        for t in occupiers:
+            t.join(timeout=300)
+        assert shed_tids, \
+            f'flood produced no 429s — shed leg inert: {outcomes}'
+        for tid in shed_tids:
+            tr = stitched(lb1, tid,
+                          want_names=('lb.request', 'serve.generate'))
+            assert tr['retained'] == 'shed', tr['retained']
+
+        # --- (f) a tail TTFT-bucket exemplar resolves to a retained
+        #         trace ---------------------------------------------------
+        best = None
+        for tag in ('r1', 'r2', 'r3'):
+            body = requests_lib.get(
+                f'http://{eps[tag]}/debug/exemplars',
+                params={'metric': 'skytpu_serve_ttft_seconds'},
+                timeout=30).json()
+            for e in body.get('exemplars') or ():
+                if e['labels'].get('qos_class') != 'batch':
+                    continue
+                le = (float('inf') if e['le'] == '+Inf'
+                      else float(e['le']))
+                if best is None or le > best[0]:
+                    best = (le, e['trace_id'])
+        assert best is not None, 'no batch TTFT exemplars recorded'
+        exemplar_trace = stitched(lb1, best[1], want_names=())
+        assert exemplar_trace['retained'], exemplar_trace
+
+        # --- (g) disagg legs stitch via the trailing retain fetch -------
+        h, disagg_tid = forced_tail_header()
+        r = requests_lib.post(
+            f'{lb2_url}/generate',
+            json={'tokens': [row(40, 90)], 'max_new_tokens': 8,
+                  'priority': 'batch'},
+            headers={trace_lib.TRACE_HEADER: h}, timeout=600)
+        assert r.status_code == 200, r.text
+        assert r.headers.get('X-SkyTPU-Disagg'), \
+            'handoff did not fire; stitching leg would prove nothing'
+        # The kv legs' LOCAL verdicts are boring (no class attr): only
+        # the LB's trailing retain fetch saves them — the propagation
+        # this gate exists to prove.
+        disagg_tr = stitched(
+            lb2, disagg_tid,
+            want_names=('lb.request', 'lb.handoff.export',
+                        'serve.kv_export', 'serve.kv_import'))
+        assert disagg_tr['retained'], disagg_tr
+
+        # --- (h) died-mid-stream resume: one retained stitched trace ----
+        h, resume_tid = forced_tail_header()
+        got, done = 0, False
+        killed = False
+        with requests_lib.post(
+                f'{lb2_url}/generate',
+                json={'tokens': [row(20, 95)], 'max_new_tokens': 96,
+                      'stream': True, 'priority': 'batch'},
+                headers={trace_lib.TRACE_HEADER: h}, stream=True,
+                timeout=600) as r:
+            assert r.status_code == 200
+            for line in r.iter_lines():
+                if not line:
+                    continue
+                obj = json.loads(line)
+                assert 'error' not in obj, obj
+                if obj.get('done'):
+                    done = True
+                    break
+                got += len(obj.get('tokens') or [])
+                if not killed and got:
+                    procs['d1'].kill()  # SIGKILL mid-stream
+                    killed = True
+        assert done and got == 96, (done, got)
+        resumed = lb2.disagg_stats['resumed_streams']
+        if resumed:  # the tiny model can outrun the kill; the stream
+            # itself is asserted either way, the stitched resume
+            # evidence only when the race landed.
+            tr = stitched(lb2, resume_tid, want_names=('lb.request',))
+            assert tr['retained'] in ('resumed', 'slow'), tr['retained']
+            assert tr['attrs'].get('resume') is True, tr['attrs']
+
+        # --- (i) volume bound + boring dropped --------------------------
+        retained_counts = {}
+        for tag in ('r1', 'r2', 'r3'):
+            body = requests_lib.get(
+                f'http://{eps[tag]}/debug/traces',
+                params={'retained': '1', 'limit': '200'},
+                timeout=30).json()
+            retained_counts[tag] = body['tail']['retained']
+            # The RING depth is the configured bound; body['count'] may
+            # legitimately exceed it (keeps are durably spooled past
+            # ring churn on purpose).
+            assert body['tail']['retained'] <= 8, (tag, body['tail'])
+            assert body['tail']['enabled'] and body['tail']['kept'] >= 1
+        for tid in boring_tids:
+            body = requests_lib.get(
+                f'http://127.0.0.1:{lb1.port}/debug/traces',
+                params={'trace_id': tid, 'stitch': '1'},
+                timeout=30).json()
+            kept = [t for t in body.get('traces') or ()
+                    if t.get('retained')]
+            assert not kept, f'boring trace {tid[:12]} was retained'
+
+        return {'parity': 'byte-identical (tail-ON vs SKYTPU_TRACE=0)',
+                'slow_retained': len(slow_tids),
+                'shed_retained': len(shed_tids),
+                'loadgen_autopsy': autopsy['fetched'],
+                'exemplar_le': (best[0] if best[0] != float('inf')
+                                else '+Inf'),
+                'disagg_stitched_spans': len(disagg_tr['spans']),
+                'resume_exercised': bool(resumed),
+                'retained_per_replica': retained_counts,
+                'boring_dropped': len(boring_tids)}
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+        lb1.stop()
+        lb2.stop()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def slo_probe() -> dict:
     """SLO burn-rate alerting gate over real OS-process replicas:
 
@@ -1940,6 +2286,13 @@ def main():
         # or wait on a chip in CI.
         jax.config.update('jax_platforms', 'cpu')
         print(json.dumps({'affinity_smoke': 'ok', **affinity_probe()}),
+              flush=True)
+        return
+    if '--autopsy' in sys.argv:
+        # CPU-only by design (same rationale as --smoke): never touch
+        # or wait on a chip in CI.
+        jax.config.update('jax_platforms', 'cpu')
+        print(json.dumps({'autopsy_smoke': 'ok', **autopsy_probe()}),
               flush=True)
         return
     if '--slo' in sys.argv:

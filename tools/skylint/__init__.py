@@ -33,6 +33,8 @@ env-flag     ``# skylint: allow-env(reason)``   suppress one env literal
 metric-name  ``# skylint: allow-metric(r)``     suppress one metric ref
 event-name   ``# skylint: allow-event(r)``      suppress one black-box
                                                event ref
+verdict-name ``# skylint: allow-verdict(r)``    suppress one retention-
+                                               verdict literal
 jit-program  ``# skylint: allow-jit(r)``        suppress one bare
                                                jax.jit call site
 lock-order   ``# skylint: allow-order(reason)`` acquisition exempt from
@@ -76,7 +78,7 @@ _ITEM_RE = re.compile(
 #: directives that suppress a finding and therefore need a reason
 REASON_REQUIRED = frozenset(
     {'locked', 'allow-raise', 'allow-host-sync', 'allow-env',
-     'allow-metric', 'allow-event', 'allow-jit',
+     'allow-metric', 'allow-event', 'allow-jit', 'allow-verdict',
      # interprocedural concurrency rules (checkers/concurrency.py)
      'allow-block',   # blocking call sanctioned (event loop / under lock)
      'allow-order',   # lock acquisition exempt from ordering (why safe)

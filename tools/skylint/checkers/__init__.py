@@ -2,4 +2,5 @@
 from skylint.checkers import (alert_rules, base,  # noqa: F401
                               concurrency, engine_thread, env_flags,
                               event_names, host_sync, jit_programs,
-                              lock_discipline, metric_names, pycache)
+                              lock_discipline, metric_names, pycache,
+                              verdict_names)

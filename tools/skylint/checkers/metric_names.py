@@ -7,9 +7,13 @@ silently blanks a dashboard panel. Two directions:
 
 * every ``skytpu_*`` token referenced in ``server/dashboard.py``,
   ``serve/``, or ``docs/*.md`` must be a defined metric (exposition
-  suffixes ``_bucket``/``_sum``/``_count`` are normalized away; a token
-  ending in ``_`` is a family reference like ``skytpu_ckpt_*`` and must
-  match at least one defined metric's prefix);
+  suffixes ``_bucket``/``_sum``/``_count`` are normalized away, and so
+  is the OpenMetrics exposition's ``_created`` series — operator docs
+  quote exemplar-bearing OpenMetrics scrapes verbatim, whose bucket
+  lines end in ``# {trace_id="..."} v ts`` and whose families grow a
+  ``_created`` child; a token ending in ``_`` is a family reference
+  like ``skytpu_ckpt_*`` and must match at least one defined metric's
+  prefix);
 * every defined metric must be referenced in at least one of those
   places — an undocumented, undashboarded series is unobservable by
   operators and probably a leftover.
@@ -37,7 +41,9 @@ _DOCS_GLOB = 'docs/*.md'
 _DOCS_EXCLUDE = ('docs/env_flags.md',)
 _METRIC_CLASSES = {'Gauge', 'Counter', 'Histogram', 'Summary'}
 _TOKEN_RE = re.compile(r'skytpu_[a-z0-9_]+')
-_EXPO_SUFFIXES = ('_bucket', '_sum', '_count')
+# _created is the OpenMetrics exposition's extra per-family series —
+# it appears in docs that quote exemplar-bearing scrapes verbatim.
+_EXPO_SUFFIXES = ('_bucket', '_sum', '_count', '_created')
 
 
 @register

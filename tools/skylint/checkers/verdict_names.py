@@ -1,0 +1,202 @@
+"""Trace retention-verdict cross-check.
+
+Every tail-retention verdict is declared exactly once, in
+``skypilot_tpu/observability/trace.py``'s :data:`VERDICTS` registry
+(the ``metric-name`` / ``event-name`` / ``alert-rule`` convention for
+the retention plane, same bounded-vocabulary discipline as
+blackbox.TRIGGERS). Consumers — the LB's trailing
+``/debug/traces?retain=&verdict=`` propagation, the dashboard autopsy
+view, the operator docs — match verdicts BY NAME, so a typo'd verdict
+would silently clamp to ``propagated`` at runtime and mislabel the
+very forensics retention exists to keep. Two directions:
+
+* every string LITERAL passed as the verdict of a
+  ``trace.retain(...)`` / ``trace.keep(...)`` call anywhere in the
+  tree must be a declared verdict name (did-you-mean on typos;
+  dynamic arguments are legal — ``retain()`` clamps them at runtime —
+  so only literals are validated). Escape hatch:
+  ``# skylint: allow-verdict(reason)`` on the call line;
+* every declared verdict must be documented in ``docs/operations.md``
+  (the tracing section's verdict vocabulary table) — an undocumented
+  verdict is a dashboard badge nobody can interpret. Duplicate
+  declarations are findings too.
+"""
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import Dict, List, Optional, Sequence
+
+from skylint import Checker, Finding, SourceFile, register
+from skylint.checkers.event_names import _closest
+
+REGISTRY_REL = 'skypilot_tpu/observability/trace.py'
+DOCS_REL = 'docs/operations.md'
+_MODULE = 'skypilot_tpu.observability.trace'
+_VERDICT_FUNCS = ('retain', 'keep')
+
+
+@register
+class VerdictNames(Checker):
+
+    name = 'verdict-name'
+
+    def __init__(self):
+        self._registry: Optional[Dict[str, int]] = None
+        self._registry_error: Optional[str] = None
+
+    def _load_registry(self, root: pathlib.Path) -> Dict[str, int]:
+        if self._registry is not None:
+            return self._registry
+        self._registry = {}
+        path = root / REGISTRY_REL
+        if not path.is_file():
+            self._registry_error = f'{REGISTRY_REL} is missing'
+            return self._registry
+        try:
+            tree = ast.parse(path.read_text(encoding='utf-8'),
+                             filename=str(path))
+        except SyntaxError as e:
+            self._registry_error = f'{REGISTRY_REL}:{e.lineno}: {e.msg}'
+            return self._registry
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id == 'Verdict' and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                self._registry.setdefault(node.args[0].value,
+                                          node.args[0].lineno)
+        return self._registry
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        if sf.tree is None or sf.rel == REGISTRY_REL:
+            return []
+        # Registry anchored at skylint.ROOT (this checkout) by design —
+        # fixture files in tmp dirs still check against the real one.
+        from skylint import ROOT
+        registry = self._load_registry(ROOT)
+        if self._registry_error or not registry:
+            return []  # reported once, in check_tree
+        out: List[Finding] = []
+        for node, arg in _verdict_calls(sf):
+            if arg is None:  # dynamic: runtime-clamped, not a finding
+                continue
+            if sf.suppression(node.lineno, 'allow-verdict'):
+                continue
+            if arg in registry:
+                continue
+            hint = _closest(arg, registry)
+            out.append(Finding(
+                sf.rel, node.lineno, self.name,
+                f'verdict {arg!r} is not declared in {REGISTRY_REL} '
+                'VERDICTS — it would clamp to \'propagated\' at '
+                'runtime'
+                + (f' — did you mean {hint!r}?' if hint else '')
+                + ' (declare it, or # skylint: allow-verdict(reason))'))
+        return out
+
+    def check_tree(self, files: Sequence[SourceFile],
+                   root: pathlib.Path) -> List[Finding]:
+        del files
+        # Fresh parse against THIS root so fixture trees exercise the
+        # registry/docs legs independently of the checkout.
+        registry: Dict[str, int] = {}
+        duplicates: List[Finding] = []
+        path = root / REGISTRY_REL
+        if not path.is_file():
+            return [Finding(REGISTRY_REL, 1, self.name,
+                            f'{REGISTRY_REL} is missing — no verdict '
+                            'registry to check')]
+        try:
+            tree = ast.parse(path.read_text(encoding='utf-8'),
+                             filename=str(path))
+        except SyntaxError as e:
+            return [Finding(REGISTRY_REL, e.lineno or 1, self.name,
+                            f'verdict registry unreadable: {e.msg}')]
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id == 'Verdict' and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                vname = node.args[0].value
+                if vname in registry:
+                    duplicates.append(Finding(
+                        REGISTRY_REL, node.args[0].lineno, self.name,
+                        f'duplicate verdict {vname!r} (first declared '
+                        f'at line {registry[vname]})'))
+                registry.setdefault(vname, node.args[0].lineno)
+        if not registry:
+            return [Finding(REGISTRY_REL, 1, self.name,
+                            'no Verdict(...) declarations found — '
+                            'registry unreadable?')]
+        out = duplicates
+        docs_path = root / DOCS_REL
+        docs_text = (docs_path.read_text(encoding='utf-8')
+                     if docs_path.is_file() else '')
+        for vname, lineno in sorted(registry.items()):
+            if docs_text and f'`{vname}`' not in docs_text \
+                    and vname not in docs_text:
+                out.append(Finding(
+                    REGISTRY_REL, lineno, self.name,
+                    f'verdict {vname!r} is not documented in '
+                    f'{DOCS_REL} (tracing section verdict vocabulary) '
+                    '— an undocumented verdict is a dashboard badge '
+                    'nobody can interpret'))
+        return out
+
+
+def _trace_aliases(tree: ast.AST):
+    """(module aliases bound to the trace module, function names bound
+    to its retain/keep)."""
+    mods, funcs = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module == 'skypilot_tpu.observability':
+                for a in node.names:
+                    if a.name == 'trace':
+                        mods.add(a.asname or a.name)
+            elif node.module == _MODULE:
+                for a in node.names:
+                    if a.name in _VERDICT_FUNCS:
+                        funcs.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == _MODULE and a.asname:
+                    mods.add(a.asname)
+    return mods, funcs
+
+
+def _verdict_calls(sf: SourceFile):
+    """Yield (call_node, verdict_literal_or_None) for every call that
+    resolves to trace.retain/trace.keep in this file. The verdict is
+    positional arg 1 or the ``verdict=`` keyword."""
+    mods, funcs = _trace_aliases(sf.tree)
+    if not mods and not funcs:
+        return
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        hit = False
+        if isinstance(fn, ast.Attribute) and fn.attr in _VERDICT_FUNCS \
+                and isinstance(fn.value, ast.Name) and fn.value.id in mods:
+            hit = True
+        elif isinstance(fn, ast.Name) and fn.id in funcs:
+            hit = True
+        if not hit:
+            continue
+        arg_node = None
+        if len(node.args) >= 2:
+            arg_node = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == 'verdict':
+                arg_node = kw.value
+        if arg_node is None:
+            continue  # defaulted verdict ('propagated'): always legal
+        arg = None
+        if isinstance(arg_node, ast.Constant) and \
+                isinstance(arg_node.value, str):
+            arg = arg_node.value
+        yield node, arg
