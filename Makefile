@@ -75,6 +75,7 @@ verify:
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --autopsy
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --slo
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --profile
+	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --coldstart
 
 # Full skylint suite (lock discipline, engine-thread raise safety,
 # host-sync, env-flag registry, metric names, git bytecode hygiene,

@@ -274,7 +274,13 @@ class TpuGangBackend(Backend):
             start_daemon=self._remote_control(handle),
             python=os.environ.get('SKYTPU_REMOTE_PYTHON', 'python3'),
             worker_agents_port=(self.WORKER_AGENT_PORT
-                                if _is_pod_cloud(handle.cloud) else None))
+                                if _is_pod_cloud(handle.cloud) else None),
+            # Cold-start collapse: a compile-cache-enabled control plane
+            # (serve controller exporting SKYTPU_COMPILE_CACHE) gets the
+            # persistent-cache base tree provisioned on every node; the
+            # replica's injected per-version leaf lands under it.
+            compile_cache_dir=(
+                os.environ.get('SKYTPU_COMPILE_CACHE') or '').strip() or None)
 
     def _start_cluster_daemon(self, cluster_name: str) -> None:
         """Spawn the per-cluster autostop/heartbeat daemon (skylet analog).

@@ -402,37 +402,55 @@ class AsyncCheckpointManager:
         if not os.path.exists(os.path.join(
                 step_path, manifest_lib.host_manifest_name(host))):
             host = 0  # restore onto fewer hosts: fall back to rank 0's
-        arrays = manifest_lib.load_host_arrays(step_path, host,
-                                               verify=True)
         named, treedef = snapshot_lib.flatten_named(abstract_state)
-        leaves = []
+        # Layout validation off the manifest ALONE (name/shape/dtype all
+        # live in the entry table) before any array byte is read: a
+        # layout mismatch must fail fast, not after streaming gigabytes.
+        hm = manifest_lib.read_json(os.path.join(
+            step_path, manifest_lib.host_manifest_name(host)))
+        entries = {e['name']: e for e in hm['arrays']}
         for name, leaf in named:
-            if name not in arrays:
+            entry = entries.get(name)
+            if entry is None:
                 raise CheckpointError(
                     f'{step_path}: array {name!r} missing from manifest '
                     f'(state layout changed?)')
-            value = arrays[name]
-            shape = tuple(getattr(leaf, 'shape', value.shape))
-            if tuple(value.shape) != shape:
+            on_disk = tuple(entry['shape'])
+            shape = tuple(getattr(leaf, 'shape', on_disk))
+            if on_disk != shape:
                 raise CheckpointError(
-                    f'{step_path}: {name!r} shape {tuple(value.shape)} '
+                    f'{step_path}: {name!r} shape {on_disk} '
                     f'!= expected {shape}')
             want_dtype = getattr(leaf, 'dtype', None)
             if want_dtype is not None and \
-                    np.dtype(want_dtype) != value.dtype:
+                    np.dtype(want_dtype) != \
+                    manifest_lib.resolve_dtype(entry['dtype']):
                 # device_put/asarray would silently keep the on-disk
                 # dtype, handing the jitted (donated) step a state it
                 # was not compiled for — fail with the layout error the
                 # shape path produces for the equivalent drift.
                 raise CheckpointError(
-                    f'{step_path}: {name!r} dtype {value.dtype} != '
+                    f'{step_path}: {name!r} dtype '
+                    f'{manifest_lib.resolve_dtype(entry["dtype"])} != '
                     f'expected {np.dtype(want_dtype)}')
+        # Shard-parallel weight streaming: the bounded reader pool
+        # (SKYTPU_CKPT_READERS) fetches + crc32-verifies ranges AHEAD
+        # of this loop while it pushes the previous array to device —
+        # host→device transfer overlaps fetch instead of serializing
+        # after one monolithic shard read.
+        want = dict(named)
+        placed: dict = {}
+        for name, value in manifest_lib.iter_host_arrays(
+                step_path, host, verify=True):
+            leaf = want.get(name)
+            if leaf is None:
+                continue  # manifest superset: restoring onto a subtree
             sharding = getattr(leaf, 'sharding', None)
-            if sharding is not None:
-                leaves.append(jax.device_put(value, sharding))
-            else:
-                leaves.append(jnp.asarray(value))
-        return jax.tree_util.tree_unflatten(treedef, leaves)
+            placed[name] = (jax.device_put(value, sharding)
+                            if sharding is not None
+                            else jnp.asarray(value))
+        return jax.tree_util.tree_unflatten(
+            treedef, [placed[name] for name, _ in named])
 
     # -- orbax compat (read path for pre-existing checkpoints) -------------
 

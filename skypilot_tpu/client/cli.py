@@ -921,11 +921,16 @@ def ckpt_ls(directory):
 @click.argument('directory', type=click.Path(exists=True, file_okay=False))
 @click.option('--step', type=int, default=None,
               help='Verify one step only (default: every committed step).')
-@click.option('--shallow', is_flag=True, default=False,
-              help='Manifest + shard-size checks only; skip the '
-                   'per-array checksum re-read.')
+@click.option('--deep/--shallow', 'deep', default=True,
+              help='--deep (default) re-reads every array\'s byte range '
+                   'and verifies its crc32 through the same parallel '
+                   'range-reader restore uses; --shallow stops at '
+                   'manifest + shard-size checks.')
+@click.option('--readers', type=int, default=None,
+              help='Range-reader pool size for --deep '
+                   '(default: SKYTPU_CKPT_READERS, 8).')
 @_clean_errors
-def ckpt_verify(directory, step, shallow):
+def ckpt_verify(directory, step, deep, readers):
     """Checksum-verify committed steps — the same validation restore
     runs. Exit 1 if any verified step is corrupt (restore would skip it
     and fall back to the previous durable step)."""
@@ -938,7 +943,7 @@ def ckpt_verify(directory, step, shallow):
             f'under {directory}')
     bad = 0
     for s, path in targets:
-        report = manifest_lib.verify_step(path, deep=not shallow)
+        report = manifest_lib.verify_step(path, deep=deep, readers=readers)
         if report['ok']:
             click.echo(f"step {s}: OK ({report['hosts']} host(s), "
                        f"{report['arrays']} arrays, "

@@ -360,6 +360,39 @@ FLAGS: Tuple[Flag, ...] = (
          "Per-program shape-budget overrides, e.g. "
          "'generate.prefill=1,engine.chunk=2' — the recompile-storm "
          'injection lever for probes/tests.'),
+    # -- cold-start collapse (compile cache / AOT warm-up / restore) --
+    Flag('SKYTPU_COMPILE_CACHE', 'path', None,
+         'Persistent XLA compilation-cache directory (per model '
+         'version, provisioned by instance_setup). A replacement '
+         'replica reuses its predecessor\'s lowered programs instead '
+         'of recompiling every PROGRAMS entry.'),
+    Flag('SKYTPU_COMPILE_CACHE_MIN_S', 'float', '0',
+         'Minimum compile seconds before a program is persisted to '
+         'the compile cache (0 caches everything — required for the '
+         'CPU-backend coldstart probe; raise on real fleets to skip '
+         'trivial programs).'),
+    Flag('SKYTPU_WARMUP', 'bool', '0',
+         'AOT warm-up before traffic: during the dark-launch window '
+         'the replica drives the steady-state shape set through every '
+         'configured jit program and only starts serving once a '
+         'replay round compiles nothing new (zero post-READY '
+         'compiles becomes the gate).'),
+    Flag('SKYTPU_WARMUP_BUCKETS', 'int', '0',
+         'Cap on the number of prompt-length shape buckets warm-up '
+         'drives (smallest first); 0 = every power-of-two bucket that '
+         'fits max_len, bounded by the programs\' declared compile '
+         'budgets.'),
+    Flag('SKYTPU_WARMUP_ROUNDS', 'int', '4',
+         'Max warm-up replay rounds before the replica serves anyway '
+         '(coverage is then reported incomplete, not fatal).'),
+    Flag('SKYTPU_CKPT_READERS', 'int', '8',
+         'Reader-pool width for shard-parallel checkpoint range reads '
+         '(restore streaming + deep verify).'),
+    Flag('SKYTPU_SCALE_LEAD_SLOW_S', 'float', '60',
+         'Spin-up lead-time estimate at or above which the request-'
+         'rate autoscalers drop their upscale hysteresis to one tick '
+         '(waiting compounds the unserved-demand cost of a slow cold '
+         'boot).'),
     # -- SLO engine (observability/slo.py) ----------------------------
     Flag('SKYTPU_SLO', 'bool', '0',
          'Enable the SLO burn-rate alert evaluator on the API server.'),

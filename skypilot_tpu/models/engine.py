@@ -105,6 +105,65 @@ from skypilot_tpu.observability import blackbox
 # thread-local writes per dispatch — skylint host-sync stays clean.
 from skypilot_tpu.observability.profiler import profiled_jit
 
+# -- persistent XLA compilation cache (cold-start collapse) ------------------
+
+_COMPILE_CACHE_STATE: Optional[dict] = None
+
+
+def maybe_enable_compile_cache() -> dict:
+    """Point jax at the per-model-version persistent compilation cache
+    (``SKYTPU_COMPILE_CACHE``, provisioned by
+    ``provision/instance_setup.py`` alongside the ckpt mirror) so a
+    replacement replica REUSES its predecessor's lowered programs
+    instead of recompiling every ``PROGRAMS`` entry from source.
+
+    Idempotent and crash-proof: ``llm_server`` calls it before backend
+    init (the cache must be configured before the first lowering);
+    the engine constructor calls it again defensively for embedded
+    users. Returns the status block ``/health`` surfaces::
+
+        {'enabled': bool, 'dir': str, 'entries_at_start': int,
+         'warm': bool}
+
+    ``warm`` — the cache already held entries when THIS process
+    enabled it — is how boots classify warm vs cold for the
+    autoscaler's spin-up lead-time model (serve/autoscalers.py)."""
+    global _COMPILE_CACHE_STATE
+    if _COMPILE_CACHE_STATE is not None:
+        return _COMPILE_CACHE_STATE
+    cache_dir = (os.environ.get('SKYTPU_COMPILE_CACHE') or '').strip()
+    if not cache_dir:
+        _COMPILE_CACHE_STATE = {'enabled': False}
+        return _COMPILE_CACHE_STATE
+    cache_dir = os.path.abspath(os.path.expanduser(cache_dir))
+    try:
+        min_s = float(os.environ.get('SKYTPU_COMPILE_CACHE_MIN_S',
+                                     '0') or '0')
+    except ValueError:
+        min_s = 0.0
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        entries = sum(1 for n in os.listdir(cache_dir)
+                      if not n.endswith('-atime'))
+        jax.config.update('jax_compilation_cache_dir', cache_dir)
+        # Default min-compile-time (1 s) would skip every program the
+        # tiny CPU-backend probe replica compiles; 0 caches everything.
+        jax.config.update('jax_persistent_cache_min_compile_time_secs',
+                          min_s)
+        try:
+            jax.config.update('jax_persistent_cache_min_entry_size_bytes',
+                              -1)
+        except Exception:  # noqa: BLE001 — older jax: default caches all
+            pass
+        _COMPILE_CACHE_STATE = {'enabled': True, 'dir': cache_dir,
+                                'entries_at_start': entries,
+                                'warm': entries > 0}
+    except Exception as e:  # noqa: BLE001 — cache trouble must never
+        # fail a boot: serving without the cache is just slower.
+        _COMPILE_CACHE_STATE = {'enabled': False,
+                                'error': str(e)[:200]}
+    return _COMPILE_CACHE_STATE
+
 
 @dataclasses.dataclass
 class _Request:
@@ -511,6 +570,10 @@ class ContinuousEngine:
                  pipeline: Optional[bool] = None,
                  prefix_share: Optional[bool] = None,
                  role: Optional[str] = None):
+        # Defensive for embedded users; the serving entrypoint already
+        # enabled it before the backend initialized (first lowering
+        # must see the cache config).
+        maybe_enable_compile_cache()
         self.params = params
         self.cfg = cfg
         # Disaggregated serving role (serve/disagg.py): 'prefill'

@@ -412,15 +412,36 @@ def profiled_jit(name: str, fn, **jit_kwargs):
         return out
 
     wrapper.program_name = name
-    wrapper.jitted = jitted  # tests / AOT warm-up (ROADMAP item 2)
+    wrapper.jitted = jitted  # tests / AOT warm-up (serve/warmup.py)
     # Forward jit introspection so compile-count assertions and the
-    # coming AOT warm-up keep working against the wrapped callable.
+    # AOT warm-up driver keep working against the wrapped callable.
     for attr in ('_cache_size', 'lower', 'trace', 'clear_cache'):
         if hasattr(jitted, attr):
             setattr(wrapper, attr, getattr(jitted, attr))
     with _LOCK:
         _entry(name)  # the ledger lists every WRAPPED program
+        _WRAPPERS[name] = wrapper
     return wrapper
+
+
+# program name -> last wrapper built for it (bounded by the registry).
+# The warm-up driver's coverage fallback: with SKYTPU_PROFILE off the
+# compile ledger stays empty, but a compile still grows the jitted
+# callable's cache — so cache-size deltas stand in for ledger deltas.
+_WRAPPERS: Dict[str, Any] = {}
+
+
+def jit_cache_sizes() -> Dict[str, int]:
+    """Per-program jit-cache entry counts across every wrapper built so
+    far (programs whose jit lacks the cache-size API are omitted)."""
+    with _LOCK:
+        wrappers = dict(_WRAPPERS)
+    out: Dict[str, int] = {}
+    for name, w in wrappers.items():
+        size = _safe_cache_size(w)
+        if size is not None:
+            out[name] = size
+    return out
 
 
 def _safe_cache_size(jitted) -> Optional[int]:

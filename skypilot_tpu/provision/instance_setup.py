@@ -24,6 +24,12 @@ from skypilot_tpu.utils.command_runner import CommandRunner
 # Where the framework lives on every worker (HOME-relative).
 REMOTE_RUNTIME_DIR = '~/.skytpu/runtime'
 REMOTE_WORKDIR = '~/sky_workdir'
+# Base of the persistent XLA compilation cache tree on every worker.
+# Replicas get a per-model-version subdir (serve/replica_managers.py
+# injects SKYTPU_COMPILE_CACHE=<base>/<service>-v<version>) so a
+# replacement replica deserializes its predecessors' lowered programs
+# instead of recompiling them (models/engine.maybe_enable_compile_cache).
+REMOTE_COMPILE_CACHE_DIR = '~/.skytpu/compile_cache'
 
 
 def _package_root() -> str:
@@ -260,19 +266,58 @@ def start_worker_agents(runners: Sequence[CommandRunner], cluster_name: str,
         list(pool.map(_start_one, enumerate(runners)))
 
 
+def provision_compile_cache(runners: Sequence[CommandRunner],
+                            cache_dir: str,
+                            seed_dir: Optional[str] = None) -> None:
+    """Provision the persistent XLA compile-cache dir on every worker
+    (parallel, idempotent), optionally pre-seeding it from a bucket
+    mirror so a replica on a FRESH node still boots warm.
+
+    ``cache_dir`` is the per-model-version leaf (what the replica's
+    SKYTPU_COMPILE_CACHE will point at). ``seed_dir`` is a bucket-mounted
+    snapshot of a predecessor's cache (conventionally
+    ``<ckpt_bucket>/compile_cache/<key>``, next to the ckpt mirror);
+    ``cp -n`` pulls only entries the local dir lacks, so a re-bootstrap
+    never clobbers newer locally-written entries. Best-effort by design:
+    the cache accelerates boots, it never gates them — the engine
+    mkdirs the leaf itself and degrades to a cold compile on any
+    failure here."""
+
+    def _provision_one(runner: CommandRunner) -> None:
+        runner.run(f'mkdir -p {shlex.quote(cache_dir)}')
+        if seed_dir:
+            # -n: never overwrite; 2>/dev/null: an empty/absent seed is
+            # the normal first-deploy case, not an error.
+            runner.run(f'cp -rn {shlex.quote(seed_dir)}/. '
+                       f'{shlex.quote(cache_dir)}/ 2>/dev/null || true')
+
+    try:
+        with cf.ThreadPoolExecutor(max_workers=min(32, len(runners))) as pool:
+            list(pool.map(_provision_one, runners))
+    except Exception as exc:  # noqa: BLE001 — cache is an accelerator
+        print(f'[bootstrap] compile-cache provisioning skipped: {exc}')
+
+
 def bootstrap_cluster(cluster_name: str, info: common.ClusterInfo,
                       runners: Sequence[CommandRunner],
                       ssh_timeout: float = 300.0,
                       start_daemon: bool = True,
                       python: str = 'python3',
-                      worker_agents_port: Optional[int] = None) -> None:
+                      worker_agents_port: Optional[int] = None,
+                      compile_cache_dir: Optional[str] = None,
+                      compile_cache_seed: Optional[str] = None) -> None:
     """Full post-provision setup for a freshly created cluster: SSH
     reachability -> runtime install on every worker -> head daemon (and,
-    for agent-exec clusters like GKE, an agent on every worker)."""
+    for agent-exec clusters like GKE, an agent on every worker). When
+    ``compile_cache_dir`` is set (serve replicas), the persistent XLA
+    compile-cache tree is provisioned (and bucket-seeded) too."""
     if not runners:
         return
     wait_for_ssh(runners, timeout=ssh_timeout)
     install_runtime(runners, python=python)
+    if compile_cache_dir:
+        provision_compile_cache(runners, compile_cache_dir,
+                                seed_dir=compile_cache_seed)
     if worker_agents_port is not None:
         # Pod-network clusters run agents on EVERY node; slim images may
         # lack the agent deps — install them before any agent starts.

@@ -11,12 +11,65 @@ running.
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
 import os
+import statistics
 import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.serve.service_spec import ReplicaPolicy
+
+
+class SpinupLeadTime:
+    """Measured replica spin-up cost (``provision_to_first_token``
+    seconds), split warm vs cold: a WARM boot found its predecessor's
+    persistent compile cache populated (``/health``
+    ``compile_cache.warm``) and skips most of the compile phase; a
+    COLD boot pays it all. The estimate prices scale-up lead time for
+    the decision functions — a fleet whose replacements boot warm can
+    afford hysteresis patience; one that boots cold cannot.
+
+    Bounded (newest ``MAX_SAMPLES`` per class) and pure state — the
+    controller feeds it from first-READY crossings, probes feed it
+    measured boots directly."""
+
+    MAX_SAMPLES = 32
+
+    def __init__(self) -> None:
+        self._warm: 'collections.deque[float]' = collections.deque(
+            maxlen=self.MAX_SAMPLES)
+        self._cold: 'collections.deque[float]' = collections.deque(
+            maxlen=self.MAX_SAMPLES)
+
+    def note(self, seconds: float, warm: bool = False) -> None:
+        if seconds < 0:
+            return
+        (self._warm if warm else self._cold).append(float(seconds))
+
+    def estimate(self) -> Optional[float]:
+        """Expected seconds from a launch decision to a serving
+        replica: the warm distribution's median once any warm boot was
+        observed (a compile-cache-provisioned fleet replaces replicas
+        warm — the cold samples describe only the fleet's FIRST boot),
+        else the cold median; None with no samples."""
+        if self._warm:
+            return statistics.median(self._warm)
+        if self._cold:
+            return statistics.median(self._cold)
+        return None
+
+    def snapshot(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            'warm_samples': len(self._warm),
+            'cold_samples': len(self._cold),
+            'estimate_s': (round(self.estimate(), 3)
+                           if self.estimate() is not None else None)}
+        if self._warm:
+            out['warm_p50_s'] = round(statistics.median(self._warm), 3)
+        if self._cold:
+            out['cold_p50_s'] = round(statistics.median(self._cold), 3)
+        return out
 
 
 def _affinity_queue_allowance(active: Optional[bool]) -> float:
@@ -72,6 +125,17 @@ class Autoscaler:
         # None = unknown, derive from the env flag alone
         # (_affinity_queue_allowance).
         self.affinity_active: Optional[bool] = None
+        # Measured spin-up lead time (warm/cold provision_to_first_
+        # token): the controller calls note_spinup on every first-READY
+        # crossing, so scale-out decisions anticipate the REAL cost of
+        # a new replica rather than an assumed one.
+        self.lead_time = SpinupLeadTime()
+
+    def note_spinup(self, seconds: float, warm: bool = False) -> None:
+        """One observed replica spin-up (launch → first READY),
+        labeled warm when the boot reported a populated persistent
+        compile cache. Feeds :class:`SpinupLeadTime`."""
+        self.lead_time.note(seconds, warm)
 
     def evaluate(self, num_ready: int, num_launching: int,
                  request_times: List[float],
@@ -139,16 +203,41 @@ class RequestRateAutoscaler(Autoscaler):
             desired = min(desired, self.policy.max_replicas)
         return desired
 
+    def _upscale_patience(self) -> int:
+        """Consecutive over-threshold evaluations before scaling up,
+        priced by the MEASURED spin-up lead time: when replacements
+        boot warm (persistent compile cache + AOT warm-up) a replica
+        is cheap, so the full damping stays; when the estimate says a
+        new replica takes >= SKYTPU_SCALE_LEAD_SLOW_S to serve, every
+        tick of patience ADDS a lead time of unserved demand on top —
+        act on the first confirmation instead."""
+        est = self.lead_time.estimate()
+        if est is None:
+            return self.upscale_threshold
+        try:
+            slow = float(os.environ.get('SKYTPU_SCALE_LEAD_SLOW_S',
+                                        '60') or '60')
+        except ValueError:
+            slow = 60.0
+        if est >= slow:
+            return 1
+        return self.upscale_threshold
+
+    def _lead_suffix(self) -> str:
+        est = self.lead_time.estimate()
+        return f', lead~{est:.1f}s' if est is not None else ''
+
     def _apply_hysteresis(self, desired: int, qps: float
                           ) -> AutoscalerDecision:
         if desired > self._target:
             self._upscale_counter += 1
             self._downscale_counter = 0
-            if self._upscale_counter >= self.upscale_threshold:
+            if self._upscale_counter >= self._upscale_patience():
                 self._upscale_counter = 0
                 self._target = desired
                 return AutoscalerDecision(
-                    self._target, f'scale up: qps={qps:.2f}')
+                    self._target,
+                    f'scale up: qps={qps:.2f}{self._lead_suffix()}')
         elif desired < self._target:
             self._downscale_counter += 1
             self._upscale_counter = 0

@@ -74,6 +74,13 @@ class ServeController:
         self.replica_manager = ReplicaManager(service_name, self.spec,
                                               self.task)
         self.autoscaler = make_autoscaler(self.spec.replica_policy)
+        # Dark→READY crossings feed the autoscaler's spin-up lead-time
+        # model (warm/cold labeled from the replica's /health
+        # compile_cache block). Bound late via self so the samples keep
+        # flowing into whichever autoscaler a version bump rebuilds.
+        self.replica_manager.on_first_ready = (
+            lambda seconds, warm: self.autoscaler.note_spinup(
+                seconds, warm=bool(warm)))
         self._sync_affinity_active()
         self._stop = threading.Event()
 

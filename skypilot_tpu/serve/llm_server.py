@@ -83,6 +83,9 @@ from skypilot_tpu.models import llama
 from skypilot_tpu.observability import profiler
 from skypilot_tpu.observability import trace as trace_lib
 from skypilot_tpu.serve import qos as qos_lib
+# AOT warm-up driver (serve/warmup.py): main() runs it in the dark
+# window with SKYTPU_WARMUP=1; __init__ seeds the warmup_skipped note.
+from skypilot_tpu.serve import warmup as warmup_lib
 
 MAX_BATCH = int(os.environ.get('SKYTPU_LLM_MAX_BATCH', '32'))
 BATCH_WINDOW_S = float(os.environ.get('SKYTPU_LLM_BATCH_WINDOW_MS',
@@ -462,12 +465,16 @@ class LlmServer:
         from skypilot_tpu.observability import blackbox
         blackbox.set_process_label(f'llm_server:{self.role}')
         blackbox.register_health_provider(self.health_snapshot)
-        # Engine/server construction (device buffers + any eager
-        # tracing) is done; what remains before READY is the listener
-        # bind — lazy jit warm-up happens on the first requests and
-        # lands in the compile ledger per program. AOT warm-up before
-        # admitting traffic (ROADMAP item 2) will widen this phase.
-        profiler.mark('jit_warmup')
+        # AOT warm-up (serve/warmup.py) runs AFTER construction, from
+        # main(), inside the dark window — and marks the 'jit_warmup'
+        # phase crossing only when it actually ran. Marking it here
+        # unconditionally (the old behavior) misattributed the
+        # engine-build→ready gap to 'jit_warmup' on every boot that
+        # never warmed anything; a skipped warm-up now leaves the
+        # crossing absent and says why via the warmup_skipped note.
+        self.warmup_report: Dict[str, Any] = warmup_lib.skipped(
+            'SKYTPU_WARMUP disabled')
+        self._warming = False
 
     async def health(self, request: web.Request) -> web.Response:
         del request
@@ -476,6 +483,15 @@ class LlmServer:
             # in-flight requests finish (graceful drain, see drain()).
             return web.json_response(
                 {'status': 'draining', 'model': self.model_name},
+                status=503)
+        if self._warming:
+            # READY contract: the probe must not see a 200 until the
+            # compile ledger confirmed warm-up coverage. main() runs
+            # warm-up before the listener binds, so this branch is
+            # unreachable there — it guards any future async warm-up
+            # (and documents the contract structurally).
+            return web.json_response(
+                {'status': 'warming', 'model': self.model_name},
                 status=503)
         if profiler.enabled():
             # 'ready' = the first successful readiness probe — HERE,
@@ -527,6 +543,14 @@ class LlmServer:
             body['qos'] = qos_stats
             queue['depth_total'] += qos_stats['queue_depth_total']
         body['queue'] = queue
+        # Cold-start collapse surfaces (both independent of the
+        # SKYTPU_PROFILE gate): the persistent-compile-cache state —
+        # 'warm' is how the controller labels this boot for the
+        # autoscaler's spin-up lead-time model — and the AOT warm-up
+        # report (coverage, rounds, or the warmup_skipped note).
+        from skypilot_tpu.models import engine as engine_lib
+        body['compile_cache'] = engine_lib.maybe_enable_compile_cache()
+        body['warmup'] = self.warmup_report
         # Tail-retention accounting (observability/trace.py): pending/
         # retained depth + per-verdict keep counts — how loadgen and
         # the autopsy probe see that interesting journeys survived and
@@ -1841,9 +1865,31 @@ def main() -> None:
     # mid-PJRT-construction is deferred until the client exists —
     # killing a client mid-init wedges the single-claimant relay (r4
     # incident, bench_runs/README.md).
+    # Persistent XLA compile cache (SKYTPU_COMPILE_CACHE) must be
+    # configured before the backend exists / the first lowering runs —
+    # a replacement replica then deserializes its predecessor's
+    # programs instead of recompiling them.
+    from skypilot_tpu.models import engine as engine_lib
+    engine_lib.maybe_enable_compile_cache()
     from skypilot_tpu.utils.tpu_client_guard import init_backend_guarded
     init_backend_guarded()
     server = server_from_args(args)
+    # AOT warm-up before traffic (serve/warmup.py): runs in the dark
+    # window — the listener is not bound yet, so the controller's
+    # readiness probes CANNOT flip READY until the compile ledger
+    # confirmed steady-state coverage. Opt-in (SKYTPU_WARMUP=1);
+    # head-local, so multi-host replicas skip it (the lockstep loop
+    # owns the follower ranks' dispatch order).
+    if warmup_lib.enabled():
+        if server.world > 1:
+            server.warmup_report = warmup_lib.skipped(
+                'multi-host replica (warm-up is head-local)')
+        else:
+            server._warming = True
+            try:
+                server.warmup_report = warmup_lib.run(server)
+            finally:
+                server._warming = False
     if server.world > 1:
         # Multi-host: the head's lockstep loop must run from startup —
         # follower ranks are already blocked in the arrival collective,

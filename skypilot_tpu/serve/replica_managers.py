@@ -11,8 +11,10 @@ simply bind the spec port (the env equals it there).
 """
 from __future__ import annotations
 
+import json
+import os
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import requests as requests_lib
 
@@ -39,6 +41,12 @@ class ReplicaManager:
             [r['replica_id'] for r in
              serve_state.list_replicas(service_name)] or [0])
         self._ready_since: Dict[int, float] = {}
+        # Optional hook (seconds, warm) fired once per dark→READY
+        # crossing; the controller points it at the autoscaler's
+        # spin-up lead-time model so scale-up hysteresis tracks the
+        # fleet's MEASURED warm-vs-cold boot distribution.
+        self.on_first_ready: Optional[
+            Callable[[float, Optional[bool]], None]] = None
         self.spot_placer = (
             spot_placer_lib.DynamicFallbackSpotPlacer()
             if spec.replica_policy.dynamic_ondemand_fallback else None)
@@ -112,6 +120,14 @@ class ReplicaManager:
         port = (common_utils.find_free_port(20000 + replica_id * 17)
                 if is_local else self.spec.port)
         task.update_envs({'SKYTPU_REPLICA_PORT': str(port)})
+        cache_base = (os.environ.get('SKYTPU_COMPILE_CACHE') or '').strip()
+        if cache_base:
+            # Per-model-version key: replacement replicas of THIS
+            # version share their predecessors' lowered programs; a
+            # version bump (new weights/config = new shapes) gets a
+            # fresh subtree instead of poisoning the old one.
+            task.update_envs({'SKYTPU_COMPILE_CACHE': os.path.join(
+                cache_base, f'{self.service_name}-v{self.version}')})
         if role is not None:
             task.update_envs({'SKYTPU_LLM_ROLE': role})
         try:
@@ -206,21 +222,38 @@ class ReplicaManager:
             draining = True
         return r.status_code < 500, health, draining
 
-    def _note_first_ready(self, rep: Dict, now: float) -> None:
+    def _note_first_ready(self, rep: Dict, now: float,
+                          health: Optional[str] = None) -> None:
         """Record ``skytpu_provision_to_first_token_s`` for a replica
         crossing dark→READY: launch-issued (created_at) → readiness.
+        The replica's /health body says whether it booted against a
+        populated compilation cache (``compile_cache.warm``), which
+        labels this sample for the autoscaler's lead-time model.
         Best-effort — a metrics-less controller host must not fail the
         probe loop that keeps the fleet routed."""
         created = rep.get('created_at')
         if not isinstance(created, (int, float)) or created <= 0:
             return
+        seconds = round(max(now - created, 0.0), 3)
         try:
             from skypilot_tpu.server import metrics as metrics_lib
             metrics_lib.set_provision_to_first_token(
-                self.service_name, rep['replica_id'],
-                round(max(now - created, 0.0), 3))
+                self.service_name, rep['replica_id'], seconds)
         except Exception:  # noqa: BLE001 — observability only
             pass
+        warm: Optional[bool] = None
+        if health:
+            try:
+                cc = json.loads(health).get('compile_cache')
+                if isinstance(cc, dict) and 'warm' in cc:
+                    warm = bool(cc.get('warm'))
+            except (ValueError, AttributeError):
+                pass
+        if self.on_first_ready is not None:
+            try:
+                self.on_first_ready(seconds, warm)
+            except Exception:  # noqa: BLE001 — observability only
+                pass
 
     def probe_all(self) -> List[str]:
         """Probe every live replica; update statuses; replace dead READY
@@ -250,7 +283,7 @@ class ReplicaManager:
                     # _ready_since is in-memory, and re-recording a
                     # long-READY replica would overwrite its cold-start
                     # figure with its whole uptime.
-                    self._note_first_ready(rep, now)
+                    self._note_first_ready(rep, now, health)
                 self._ready_since.setdefault(rid, now)
                 serve_state.upsert_replica(self.service_name, rid,
                                            serve_state.ReplicaStatus.READY,

@@ -496,3 +496,92 @@ def test_cli_ckpt_ls_and_verify(tmp_path):
     # trade-off, deep is the default.
     r = runner.invoke(cli, ['ckpt', 'verify', root, '--shallow'])
     assert r.exit_code == 0, r.output
+    # Explicit --deep with a bounded reader pool catches it again.
+    r = runner.invoke(cli, ['ckpt', 'verify', root, '--deep',
+                            '--readers', '2'])
+    assert r.exit_code == 1, r.output
+    assert 'checksum mismatch' in r.output
+
+
+# -- shard-parallel restore ---------------------------------------------------
+
+
+def _wide_state(seed: int = 0, arrays: int = 100):
+    """A manifest wide enough to exercise the reader pool's windowing
+    (arrays >> pool size), with mixed dtypes/shapes."""
+    rng = np.random.default_rng(seed)
+    return {'params': {
+        f'a{i:03d}': rng.normal(size=(7, 3 + i % 5)).astype(
+            np.float32 if i % 2 else np.float64)
+        for i in range(arrays)}}
+
+
+def test_parallel_restore_byte_identical_to_sequential(tmp_path):
+    root = str(tmp_path)
+    path = _commit(root, 2, _wide_state(11))
+    seq = manifest_lib.load_host_arrays(path, 0)
+    par = manifest_lib.load_host_arrays_parallel(path, 0, readers=4)
+    assert list(par.keys()) == list(seq.keys())  # manifest order kept
+    for name in seq:
+        assert seq[name].dtype == par[name].dtype
+        assert seq[name].tobytes() == par[name].tobytes(), name
+
+
+def test_parallel_restore_bit_flip_rejected_with_fallback(tmp_path):
+    """A single flipped byte inside ONE array's range must fail THAT
+    range's checksum in the reader pool, and restore must fall back to
+    the previous committed step — same contract as the sequential
+    path."""
+    root = str(tmp_path)
+    _commit(root, 2, _state(2))
+    path4 = _commit(root, 4, _state(4))
+    hm = manifest_lib.read_json(
+        os.path.join(path4, manifest_lib.host_manifest_name(0)))
+    victim = hm['arrays'][len(hm['arrays']) // 2]
+    shard = os.path.join(path4, hm['shard'])
+    with open(shard, 'rb+') as f:
+        f.seek(victim['offset'] + victim['nbytes'] // 2)
+        byte = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    with pytest.raises(manifest_lib.CorruptionError,
+                       match=victim['name']):
+        manifest_lib.load_host_arrays_parallel(path4, 0)
+    report = manifest_lib.verify_step(path4, deep=True, readers=3)
+    assert not report['ok'] and 'checksum mismatch' in report['errors'][0]
+    mgr = AsyncCheckpointManager(root, telemetry=None)
+    _assert_tree_equal(mgr.restore_latest(_state(0)), _state(2))
+    mgr.close()
+
+
+def test_parallel_restore_reader_pool_bounded(tmp_path, monkeypatch):
+    """The reader pool must never exceed its configured width, even
+    against a 100-array manifest: SKYTPU_CKPT_READERS is the I/O
+    concurrency cap operators size against their store's rate limits."""
+    root = str(tmp_path)
+    path = _commit(root, 2, _wide_state(7))
+    lock = threading.Lock()
+    live = {'now': 0, 'max': 0, 'calls': 0}
+    orig = manifest_lib._read_range
+
+    def counted(fd, entry, step_dir, shard, verify):
+        with lock:
+            live['now'] += 1
+            live['calls'] += 1
+            live['max'] = max(live['max'], live['now'])
+        try:
+            time.sleep(0.002)  # let concurrency build up
+            return orig(fd, entry, step_dir, shard, verify)
+        finally:
+            with lock:
+                live['now'] -= 1
+
+    monkeypatch.setattr(manifest_lib, '_read_range', counted)
+    out = manifest_lib.load_host_arrays_parallel(path, 0, readers=4)
+    assert len(out) == 100 and live['calls'] == 100
+    assert live['max'] <= 4, f'pool exceeded its bound: {live["max"]}'
+    # The env knob feeds the default pool width the same way.
+    monkeypatch.setenv('SKYTPU_CKPT_READERS', '2')
+    live.update(now=0, max=0, calls=0)
+    list(manifest_lib.iter_host_arrays(path, 0))
+    assert live['calls'] == 100 and live['max'] <= 2

@@ -568,6 +568,36 @@ def test_bare_jax_jit_flagged_and_hatch_suppresses(tmp_path):
     assert jit_mod.JitPrograms().check_file(ok) == []
 
 
+def test_serve_tree_allow_jit_must_name_declared_exception(tmp_path):
+    """Inside skypilot_tpu/serve/ the allow-jit hatch is narrower: the
+    reason must name a declared exception category (the AOT warm-up
+    driver) — an arbitrary reasoned hatch there would let serving
+    programs dodge the zero-post-READY-compiles gate."""
+    from skylint.checkers import jit_programs as jit_mod
+    code = '''
+        import jax
+
+        def _impl(x):
+            return x
+
+        # skylint: allow-jit({reason})
+        _f = jax.jit(_impl)
+        '''
+    bad = _sf(tmp_path, code.format(reason='faster this way'),
+              name='skypilot_tpu/serve/thing.py')
+    findings = jit_mod.JitPrograms().check_file(bad)
+    assert _rules(findings) == ['jit-program']
+    assert 'declared exception' in findings[0].message
+    ok = _sf(tmp_path,
+             code.format(reason='AOT warm-up driver cache canary'),
+             name='skypilot_tpu/serve/warm.py')
+    assert jit_mod.JitPrograms().check_file(ok) == []
+    # Outside the serve tree any reasoned hatch still suppresses.
+    elsewhere = _sf(tmp_path, code.format(reason='faster this way'),
+                    name='skypilot_tpu/train/thing.py')
+    assert jit_mod.JitPrograms().check_file(elsewhere) == []
+
+
 def test_profiled_jit_typo_gets_did_you_mean(tmp_path):
     from skylint.checkers import jit_programs as jit_mod
     sf = _sf(tmp_path, '''

@@ -2128,8 +2128,15 @@ def profile_probe() -> dict:
         assert cold['complete'], cold
         for phase in ('imports', 'backend_init.plugin_discovery',
                       'backend_init.device_enumeration', 'weights_load',
-                      'jit_warmup', 'ready'):
+                      'ready'):
             assert phase in cold['phases'], (phase, cold)
+        # SKYTPU_WARMUP is off for this replica, so the 'jit_warmup'
+        # crossing must be ABSENT (marking it anyway would book the
+        # engine-build→ready gap to a warm-up that never ran) and the
+        # health warmup block must say why.
+        assert 'jit_warmup' not in cold['phases'], cold
+        assert first_health['on']['warmup'].get('warmup_skipped'), \
+            first_health['on'].get('warmup')
         assert sum(cold['phases'].values()) == \
             pytest_approx(cold['total_s'])
         wall = ready_wall['on']
@@ -2265,6 +2272,205 @@ def profile_probe() -> dict:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def coldstart_probe() -> dict:
+    """Cold-start collapse gate (persistent XLA compile cache + AOT
+    warm-up, serve/warmup.py + models/engine.maybe_enable_compile_cache),
+    five legs over real OS-process replicas sharing one cache dir:
+
+    (a) **cold boot, READY gated on coverage** — the FIRST 200 /health
+        of an SKYTPU_WARMUP=1 replica already carries
+        ``warmup.covered=true`` (warm-up runs before the listener
+        binds, so readiness structurally cannot precede coverage), a
+        ``jit_warmup`` phase crossing in the cold-start ledger, and
+        ``compile_cache`` reporting an enabled but COLD cache;
+    (b) **zero post-READY compiles** — replaying the exact bucket mix
+        warm-up drove (read off the replica's own warmup report) moves
+        the compile-ledger window by ZERO compiles and zero storms;
+    (c) **byte parity** — greedy output with cache+warm-up on is
+        byte-identical to a replica with both off;
+    (d) **warm second boot strictly faster on the compile ledger** — a
+        fresh process against the SAME cache dir reports
+        ``compile_cache.warm=true`` and a first-health
+        ``compile_ms_total`` strictly under 0.8x the cold boot's (its
+        programs deserialize instead of compiling);
+    (e) **lead-time model** — both measured boots feed
+        RequestRateAutoscaler.note_spinup: the estimate prefers the
+        warm median, and a slow estimate collapses scale-up hysteresis
+        to a single confirmation tick (reason carries ``lead~``).
+    """
+    import shutil
+    import tempfile
+
+    import requests as requests_lib
+
+    from skypilot_tpu.serve import autoscalers as autoscalers_lib
+    from skypilot_tpu.serve import loadgen
+    from skypilot_tpu.serve.service_spec import ReplicaPolicy
+    from skypilot_tpu.utils import common_utils
+
+    max_len = 256
+    workdir = tempfile.mkdtemp(prefix='skytpu-coldstart-')
+    cache_dir = os.path.join(workdir, 'compile-cache')
+    base_env = {'SKYTPU_PROFILE': '1', 'SKYTPU_WARMUP': '1',
+                'SKYTPU_COMPILE_CACHE': cache_dir}
+    procs = {}
+
+    def row(n, salt):
+        return [(5 * i + 13 * salt) % 240 + 1 for i in range(n)]
+
+    def cache_entries():
+        try:
+            return sum(1 for f in os.listdir(cache_dir)
+                       if not f.endswith('-atime'))
+        except OSError:
+            return 0
+
+    def boot(tag, env):
+        """Spawn one replica, wait for its first 200, return
+        (endpoint, first_health, spawn->ready wall seconds)."""
+        port = common_utils.find_free_port(26200 + 40 * len(procs))
+        t0 = time.time()
+        procs[tag] = _spawn_replica('colocated', port, workdir,
+                                    max_len, tag=tag, extra_env=env)
+        ep = f'127.0.0.1:{port}'
+        deadline = time.time() + 300
+        while True:
+            if procs[tag].poll() is not None:
+                raise RuntimeError(f'{tag} replica exited at startup; '
+                                   f'see {workdir}/{tag}.log')
+            try:
+                r = requests_lib.get(f'http://{ep}/health', timeout=5)
+                r.raise_for_status()
+                return ep, r.json(), time.time() - t0
+            except requests_lib.RequestException:
+                if time.time() > deadline:
+                    raise RuntimeError(
+                        f'{tag} replica never became healthy; see '
+                        f'{workdir}/{tag}.log')
+                time.sleep(0.1)
+
+    try:
+        # --- (a) cold boot: coverage gates READY ------------------------
+        cold_ep, cold_h, cold_wall = boot('cold', base_env)
+        wu = cold_h['warmup']
+        assert wu.get('ran') and wu.get('covered'), (
+            'first 200 /health must already confirm warm-up coverage '
+            '(READY gated on the replay-until-no-new-compiles check)',
+            wu)
+        assert 'error' not in wu and wu['rounds'] >= 2, wu
+        cc = cold_h['compile_cache']
+        assert cc.get('enabled') and not cc.get('warm'), (
+            'first boot against an empty cache dir must report cold',
+            cc)
+        cold_prof = cold_h['profile']
+        assert 'jit_warmup' in cold_prof['cold_start']['phases'], \
+            cold_prof['cold_start']
+        assert cold_prof['compiles_total'] > 0, \
+            'warm-up compiled nothing — is the ledger wired?'
+        cold_ms = cold_prof['compile_ms_total']
+        assert cold_ms > 0, cold_prof
+        assert cache_entries() > 0, (
+            'cold boot persisted nothing into SKYTPU_COMPILE_CACHE',
+            cache_dir)
+
+        # --- (b) zero post-READY compiles on the warmed shape set -------
+        def health(ep):
+            return requests_lib.get(f'http://{ep}/health',
+                                    timeout=30).json()
+
+        before = loadgen.aggregate_profile_healths(
+            {cold_ep: cold_h})
+        # The mix warm-up itself drove: one request per warmed bucket
+        # (lengths pad up to the bucket), greedy, same max_new.
+        for salt, bucket in enumerate(wu['buckets']):
+            for n in (bucket, max(bucket - 3, 1)):
+                requests_lib.post(
+                    f'http://{cold_ep}/generate',
+                    json={'tokens': [row(n, 31 + salt)],
+                          'max_new_tokens': 4},
+                    timeout=600).raise_for_status()
+        after = loadgen.aggregate_profile_healths({cold_ep: health(cold_ep)})
+        window = loadgen.profile_window_delta(before, after)
+        assert window['compiles'] == 0, (
+            'post-READY compiles under the warmed steady-state mix — '
+            'the warm-up coverage confirmation lied', window, after)
+        assert window['storms'] == 0 and after['storms'] == 0, after
+
+        # --- (c) byte parity, cache+warm-up on vs off -------------------
+        plain_ep, _h, _w = boot('plain', {
+            'SKYTPU_PROFILE': '0', 'SKYTPU_WARMUP': '0',
+            'SKYTPU_COMPILE_CACHE': ''})
+        for n, max_new, salt in ((12, 16, 1), (60, 24, 2)):
+            payload = {'tokens': [row(n, salt)],
+                       'max_new_tokens': max_new}
+            on = requests_lib.post(f'http://{cold_ep}/generate',
+                                   json=payload, timeout=600)
+            off = requests_lib.post(f'http://{plain_ep}/generate',
+                                    json=payload, timeout=600)
+            assert on.status_code == off.status_code == 200, \
+                (on.text, off.text)
+            assert on.json() == off.json(), (n, max_new)
+
+        # --- (d) warm second boot: strictly cheaper compile ledger ------
+        entries_before_warm = cache_entries()
+        _ep, warm_h, warm_wall = boot('warm', base_env)
+        wcc = warm_h['compile_cache']
+        assert wcc.get('enabled') and wcc.get('warm'), (
+            'second boot against the populated cache must report warm',
+            wcc)
+        assert wcc['entries_at_start'] >= entries_before_warm > 0, wcc
+        assert warm_h['warmup'].get('covered'), warm_h['warmup']
+        warm_ms = warm_h['profile']['compile_ms_total']
+        assert warm_ms < 0.8 * cold_ms, (
+            'warm boot did not beat the cold compile ledger — is the '
+            'persistent cache round-tripping?',
+            {'cold_ms': cold_ms, 'warm_ms': warm_ms})
+
+        # --- (e) measured boots feed the scale-up lead-time model -------
+        auto = autoscalers_lib.RequestRateAutoscaler(ReplicaPolicy(
+            min_replicas=1, max_replicas=4, target_qps_per_replica=1.0))
+        auto.note_spinup(cold_wall, warm=False)
+        assert auto.lead_time.estimate() == cold_wall  # cold-only
+        auto.note_spinup(warm_wall, warm=True)
+        snap = auto.lead_time.snapshot()
+        assert snap['warm_samples'] == 1 and snap['cold_samples'] == 1
+        assert snap['estimate_s'] == round(warm_wall, 3), (
+            'estimate must prefer the warm distribution once a warm '
+            'boot was observed', snap)
+        over = [time.time() - i * 0.2 for i in range(180)]  # ~3 qps
+        # Fast estimate (measured seconds << 60 s default): full
+        # hysteresis damping — the first over-threshold tick holds.
+        d = auto.evaluate(1, 0, list(over))
+        assert d.target_num_replicas == 1 and \
+            d.reason.startswith('hold'), d
+        # Slow estimate: patience collapses to one tick and the
+        # decision carries the lead-time price.
+        os.environ['SKYTPU_SCALE_LEAD_SLOW_S'] = '0.01'
+        d = auto.evaluate(1, 0, list(over))
+        assert d.target_num_replicas > 1 and \
+            d.reason.startswith('scale up') and 'lead~' in d.reason, d
+
+        return {
+            'cold_wall_s': round(cold_wall, 2),
+            'warm_wall_s': round(warm_wall, 2),
+            'cold_compile_ms': round(cold_ms, 1),
+            'warm_compile_ms': round(warm_ms, 1),
+            'compile_cut': round(1 - warm_ms / cold_ms, 3),
+            'warmup_buckets': wu['buckets'],
+            'warmup_rounds': wu['rounds'],
+            'steady_state_compiles': window['compiles'],
+            'cache_entries': cache_entries(),
+            'parity': 'byte-identical (cache+warmup on vs off)',
+            'lead_time': snap,
+        }
+    finally:
+        os.environ.pop('SKYTPU_SCALE_LEAD_SLOW_S', None)
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def pytest_approx(x, rel=1e-3):
     """Tolerant float compare without importing pytest in the probe."""
     class _A:
@@ -2279,6 +2485,13 @@ def main():
         # or wait on a chip in CI.
         jax.config.update('jax_platforms', 'cpu')
         print(json.dumps({'profile_smoke': 'ok', **profile_probe()}),
+              flush=True)
+        return
+    if '--coldstart' in sys.argv:
+        # CPU-only by design (same rationale as --smoke): never touch
+        # or wait on a chip in CI.
+        jax.config.update('jax_platforms', 'cpu')
+        print(json.dumps({'coldstart_smoke': 'ok', **coldstart_probe()}),
               flush=True)
         return
     if '--affinity' in sys.argv:

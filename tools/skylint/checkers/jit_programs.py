@@ -14,7 +14,15 @@ the compile ledger, the ``skytpu_compile_total`` gauges, and the
   profiler.py itself must route through ``profiled_jit(name, fn,
   ...)``. Escape hatch: ``# skylint: allow-jit(reason)`` — reserved
   for startup-time / training programs outside the serving contract
-  (sharded weight init, the train step, collective microbenches);
+  (sharded weight init, the train step, collective microbenches).
+  Inside the serving tree (``skypilot_tpu/serve/``) the hatch is
+  narrower still: the reason must NAME a declared exception category
+  — currently only the AOT **warm-up** driver (``serve/warmup.py``
+  compiles throwaway probe programs inside the dark window, before
+  READY, so they are deliberately outside the ledger). Any other
+  serve-tree allow-jit is a finding even with a reason: a blanket
+  hatch there would let steady-state serving programs escape the
+  zero-post-READY-compiles gate;
 * **typo-proofing** — every ``profiled_jit('name', ...)`` first
   argument must be a string literal declared in ``PROGRAMS``
   (did-you-mean on near-misses; a dynamic name defeats the registry
@@ -81,7 +89,18 @@ class JitPrograms(Checker):
             if not isinstance(node, ast.Call):
                 continue
             if _is_bare_jax_jit(node.func):
-                if sf.suppression(node.lineno, 'allow-jit'):
+                hatch = sf.suppression(node.lineno, 'allow-jit')
+                if hatch:
+                    if sf.rel.startswith('skypilot_tpu/serve/') and \
+                            not _names_serve_exception(hatch.arg):
+                        out.append(Finding(
+                            sf.rel, node.lineno, self.name,
+                            'serve-tree allow-jit must name a declared '
+                            'exception category (currently: the AOT '
+                            'warm-up driver — say "warm-up" in the '
+                            'reason); steady-state serving programs '
+                            'must route through profiled_jit so the '
+                            'zero-post-READY-compiles gate sees them'))
                     continue
                 out.append(Finding(
                     sf.rel, node.lineno, self.name,
@@ -140,6 +159,18 @@ class JitPrograms(Checker):
                     'wraps it through profiled_jit — dead program; '
                     'delete the declaration'))
         return out
+
+
+# Declared serve-tree allow-jit exception categories: the hatch reason
+# must name one. Today that is only the AOT warm-up driver
+# (serve/warmup.py) — its cache-canary program runs inside the dark
+# window, never after READY.
+_SERVE_EXCEPTIONS = ('warm-up', 'warmup')
+
+
+def _names_serve_exception(reason) -> bool:
+    low = (reason or '').lower()
+    return any(tag in low for tag in _SERVE_EXCEPTIONS)
 
 
 def _is_bare_jax_jit(func) -> bool:
