@@ -61,7 +61,17 @@ ci:
 # compile-once-per-shape contract machine-gated — and an injected
 # shape-churn leg trips the recompile-storm detector, fires the
 # serve.recompile_storm SLO warn rule, and freezes the profiler
-# snapshot into a black-box bundle).
+# snapshot into a black-box bundle), and the self-healing remediation
+# gate (kill -9 of a loaded replica → the engine claims the
+# replacement, the in-flight greedy stream resumes on the survivor
+# with full token parity and the successor boots warm with zero
+# post-READY compiles; an injected queue-burn page fires a
+# drain-migrate whose successor's BlockTrie is pre-warmed from the
+# victim's advert — nonzero trie hit on its first matching request;
+# every executed action retains a stitched trace and a
+# /debug/remediations record whose phase timings sum to its wall;
+# budget exhaustion downgrades to observe-only while the fleet keeps
+# serving; greedy byte parity SKYTPU_REMEDIATE=off vs =observe).
 verify:
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --smoke
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --qos
@@ -76,6 +86,7 @@ verify:
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --slo
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --profile
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --coldstart
+	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --heal
 
 # Full skylint suite (lock discipline, engine-thread raise safety,
 # host-sync, env-flag registry, metric names, git bytecode hygiene,

@@ -404,6 +404,32 @@ FLAGS: Tuple[Flag, ...] = (
          'on page-severity firing transitions.'),
     Flag('SKYTPU_SLO_HISTORY', 'int', '256',
          'Max resolved alerts kept in the persisted history.'),
+    # -- serving: self-healing remediation (serve/remediation.py) -----
+    Flag('SKYTPU_REMEDIATE', 'str', 'off',
+         "Remediation engine mode: 'off' (default), 'observe' (decide "
+         "and record without acting — dry run), 'act' (run the full "
+         'migration playbooks).'),
+    Flag('SKYTPU_REMEDIATE_MAX_PER_H', 'int', '6',
+         'Per-service remediation budget: token bucket of actions per '
+         'hour; an exhausted budget downgrades every decision to '
+         'noop_observe.'),
+    Flag('SKYTPU_REMEDIATE_COOLDOWN_S', 'float', '30',
+         'Cooldown after each executed action before the engine will '
+         'act again (observe-only decisions are exempt).'),
+    Flag('SKYTPU_REMEDIATE_HYSTERESIS_S', 'float', '120',
+         'Per-(rule,target) hysteresis: a trigger that already drove '
+         'an action is ignored for this long — a flapping alert '
+         'cannot thrash replacements.'),
+    Flag('SKYTPU_REMEDIATE_PREWARM_CHAINS', 'int', '8',
+         "Max hot trie chains replayed victim→successor in a "
+         'drain-migrate pre-warm (0 disables the BlockTrie handoff).'),
+    Flag('SKYTPU_REMEDIATE_DRAIN_TIMEOUT_S', 'float', '120',
+         'Max seconds a migration waits for the LB to confirm the '
+         "victim's in-flight streams drained before terminating "
+         'anyway.'),
+    Flag('SKYTPU_REMEDIATE_ZONE_BLOCK_S', 'float', '900',
+         'TTL of a zone_blocklist action: how long successor placement '
+         'avoids a preemption-stormy zone.'),
     # -- bench / probe / test harness ---------------------------------
     Flag('SKYTPU_BENCH_SWEEP_BUDGET_S', 'float', '600',
          'Wall-clock budget for one bench sweep phase.'),

@@ -951,6 +951,19 @@ class ContinuousEngine:
                 self._trie.touch(nd)
         return len(nodes)
 
+    def resolve_chains(self, digests: List[bytes]) -> List[List[int]]:
+        """Token rows for the advert chain digests this engine's trie
+        still holds (``BlockTrie.resolve_chains``), longest first. The
+        remediation pre-warm path asks the VICTIM to resolve its own
+        last affinity advert back to concrete prompts, then replays
+        them through the skytpu-kv/1 export/import path so the
+        successor's trie starts hot. Empty when sharing is off."""
+        if self._trie is None:
+            return []
+        with self._lock:
+            rows = self._trie.resolve_chains(digests)
+        return sorted(rows.values(), key=len, reverse=True)
+
     def prefix_summary(self) -> Optional[dict]:
         """Bounded resident-chain summary for fleet prefix-affinity
         routing (``BlockTrie.summary``), or None when sharing is off.

@@ -541,6 +541,34 @@ class BlockTrie:
                 'truncated': len(items) > len(kept),
                 'entries': [[c.hex(), -d] for (_, d, c) in kept]}
 
+    def resolve_chains(self, digests: List[bytes]) -> Dict[bytes, List[int]]:
+        """Map advert chain digests back to the token chains this trie
+        holds — the migration pre-warm answer (serve/remediation.py):
+        the advert carries only ``chain_digest`` values, but the OWNING
+        replica can reconstruct each digest's full token prefix by
+        walking parents root-ward. Detached nodes are excluded (their
+        blocks are mid-handoff and may vanish). Caller holds the engine
+        lock."""
+        want = set(digests)
+        out: Dict[bytes, List[int]] = {}
+        stack = list(self.children.values())
+        while stack and want:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            if node.detached or node.chain not in want:
+                continue
+            want.discard(node.chain)
+            parts = []
+            cur: Optional[_TrieNode] = node
+            while cur is not None:
+                parts.append(cur.key)
+                cur = cur.parent
+            row: List[int] = []
+            for key in reversed(parts):
+                row.extend(key)
+            out[node.chain] = row
+        return out
+
     def evict(self, n: int) -> List[int]:
         """Reclaim >= n blocks from the idle LRU (may free more: a
         popped node's unreachable idle descendants free with it).

@@ -112,6 +112,9 @@ EVENTS: Tuple[Event, ...] = (
           '(preemption-shaped).'),
     Event('serve.replica_terminate',
           'A replica was torn down (scale-down, failure, rollout).'),
+    Event('serve.remediation',
+          'The remediation engine decided an action (executed, '
+          'observed, or suppressed by budget/hysteresis).'),
     # -- checkpoint pipeline (skypilot_tpu/ckpt/) ----------------------
     Event('ckpt.snapshot',
           'Device->host snapshot taken on the step-loop thread.'),

@@ -529,7 +529,23 @@ class SloEngine:
         self._active: Dict[str, Dict[str, Any]] = {}
         self._history: List[Dict[str, Any]] = []
         self._last_persisted: Optional[str] = None
+        # Transition observers (serve/remediation.py): called outside
+        # self._lock with each transition dict from tick(), best-effort.
+        self._transition_hooks: List[Any] = []
         self._load()
+
+    def add_transition_hook(self, hook) -> None:
+        """Register ``hook(transition_dict)`` to run for every alert
+        lifecycle transition tick() reports (pending/firing/resolved).
+        Hooks run after the engine lock is released; exceptions are
+        swallowed — an observer must never take the evaluator down."""
+        self._transition_hooks.append(hook)
+
+    def remove_transition_hook(self, hook) -> None:
+        try:
+            self._transition_hooks.remove(hook)
+        except ValueError:
+            pass
 
     # -- persistence (tmp-write + rename; a torn write is invisible) ---------
 
@@ -593,6 +609,12 @@ class SloEngine:
             self._persist()
         for alert in to_dump:
             self._dump_breach(alert)
+        for t in transitions:
+            for hook in list(self._transition_hooks):
+                try:
+                    hook(dict(t))
+                except Exception:  # noqa: BLE001 — observer isolation
+                    pass
         return transitions
 
     # skylint: locked(called under self._lock from tick)
@@ -790,6 +812,16 @@ def get_engine(create: bool = False) -> Optional[SloEngine]:
         if _ENGINE is None and create:
             _ENGINE = SloEngine()
         return _ENGINE
+
+
+def on_transition(hook) -> None:
+    """Module-level hook registration: attach ``hook(transition)`` to
+    this process's engine (created on demand). The remediation engine
+    (serve/remediation.py) uses this to turn page firings into
+    supervised actions."""
+    engine = get_engine(create=True)
+    if engine is not None:
+        engine.add_transition_hook(hook)
 
 
 def evaluate_once() -> Optional[List[Dict[str, Any]]]:

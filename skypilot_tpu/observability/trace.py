@@ -229,6 +229,10 @@ VERDICTS: Tuple[Verdict, ...] = (
                        'a surviving replica'),
     Verdict('slo_breach', 'completed while an SLO rule was firing in '
                           'this process'),
+    Verdict('remediation', 'the journey of a remediation action '
+                           '(decision, pre-warm, drain, terminate) — '
+                           'the stitched audit trace every action '
+                           'retains'),
     Verdict('recompile_storm', 'completed while the profiler counted '
                                'a new recompile storm'),
     Verdict('baseline', 'bounded random baseline keep '
@@ -503,6 +507,14 @@ class _TailStore:
         status = attrs.get('status')
         if attrs.get('resume') or attrs.get('resumed'):
             return 'resumed'
+        # A remediation action's audit trace is an outcome verdict in
+        # its own right: the engine roots each playbook span under
+        # ``remediation.<action>`` and the record must survive tail
+        # retention unconditionally — a head-sampled root would
+        # otherwise be dropped from the tail store at completion,
+        # leaving ``retain()`` nothing to promote.
+        if str(record.get('name') or '').startswith('remediation.'):
+            return 'remediation'
         # A downstream fragment's verdict (the replica's
         # X-SkyTPU-Trace-Verdict response header, mirrored onto the LB
         # root) keeps this fragment too — the journey is interesting

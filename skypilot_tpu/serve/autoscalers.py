@@ -137,6 +137,23 @@ class Autoscaler:
         compile cache. Feeds :class:`SpinupLeadTime`."""
         self.lead_time.note(seconds, warm)
 
+    def max_concurrent_migrations(self, num_ready: int,
+                                  window_s: float = 60.0) -> int:
+        """How many replicas remediation may have mid-migration at
+        once: never drain faster than successors come up. With a
+        measured lead time, a migration holds a replica out of the
+        pool for ~estimate() seconds, so allow only as many concurrent
+        migrations as the window covers — and never more than would
+        drop ready capacity below half. No measurement yet = one at a
+        time (the conservative bound for an unpriced fleet)."""
+        est = self.lead_time.estimate()
+        if est is None or est <= 0:
+            by_lead = 1
+        else:
+            by_lead = max(int(window_s // est), 1)
+        by_capacity = max(num_ready // 2, 1)
+        return min(by_lead, by_capacity)
+
     def evaluate(self, num_ready: int, num_launching: int,
                  request_times: List[float],
                  now: Optional[float] = None,

@@ -7,9 +7,12 @@ Runs in-process (tests) or as a detached process per service (CLI).
 from __future__ import annotations
 
 import argparse
+import collections
 import threading
 
 from skypilot_tpu.observability import blackbox
+from skypilot_tpu.observability import slo as slo_lib
+from skypilot_tpu.serve import remediation as remediation_lib
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.autoscalers import make_autoscaler
 from skypilot_tpu.serve.load_balancer import LoadBalancer
@@ -82,6 +85,27 @@ class ServeController:
             lambda seconds, warm: self.autoscaler.note_spinup(
                 seconds, warm=bool(warm)))
         self._sync_affinity_active()
+        # Self-healing (serve/remediation.py): the engine rides this
+        # controller's tick. Preemption notices flow through the
+        # replica manager's dark hook; page-severity SLO firings flow
+        # through the transition hook of a controller-LOCAL SLO engine
+        # ticked over the replicas' probe-recorded /health bodies (the
+        # detached controller process has no metrics-history daemon).
+        self.remediation = remediation_lib.RemediationEngine(
+            service_name,
+            fleet=remediation_lib.ManagerFleet(self.replica_manager),
+            lb=self.lb, autoscaler=self.autoscaler,
+            spot_placer=self.replica_manager.spot_placer)
+        self.replica_manager.on_replica_dark = \
+            self.remediation.on_replica_dark
+        self.lb.remediation_payload = self.remediation.debug_payload
+        self._slo_engine = slo_lib.SloEngine()
+        self._slo_engine.add_transition_hook(
+            self.remediation.on_slo_transition)
+        # ~10 min of 1 s ticks — covers the widest default slow-burn
+        # window the replica.* rules evaluate over.
+        self._slo_samples: collections.deque = collections.deque(
+            maxlen=600)
         self._stop = threading.Event()
 
     def _sync_affinity_active(self) -> None:
@@ -112,6 +136,41 @@ class ServeController:
         metrics_lib.set_lb_affinity(self.service_name,
                                     routed=snap['routed'],
                                     fallbacks=snap['fallbacks'])
+
+    def _tick_slo(self, replica_snapshot) -> None:
+        """Feed the replicas' /health bodies through the controller-
+        local SLO engine (slo.replica_signal_fields is the shared
+        shape), so replica-scoped page firings reach the remediation
+        engine even when no metrics-history daemon runs in this
+        process. Targets are 'service/replica_id' — the same key the
+        daemon's sampler uses, so rules and runbooks match."""
+        if not slo_lib.enabled():
+            return
+        import time as time_lib
+        reps = {}
+        for rep in replica_snapshot:
+            body = serve_state.parse_health(rep.get('health'))
+            if body:
+                key = f"{self.service_name}/{rep['replica_id']}"
+                reps[key] = slo_lib.replica_signal_fields(body)
+        self._slo_samples.append({'ts': time_lib.time(),
+                                  'serve_replica_health': reps})
+        try:
+            self._slo_engine.tick(list(self._slo_samples))
+        except Exception:  # noqa: BLE001 — the SLO leg must never
+            pass           # take the serving loop down
+
+    def _mirror_remediation_gauges(self) -> None:
+        """skytpu_remediation_total{action,trigger,outcome} — same
+        in-process-visibility contract as the affinity gauges; a
+        detached controller's counts stay readable via
+        /debug/remediations."""
+        try:
+            from skypilot_tpu.server import metrics as metrics_lib
+        except Exception:  # noqa: BLE001 — metrics are additive
+            return
+        metrics_lib.set_remediation(self.service_name,
+                                    self.remediation.counts())
 
     def _expose_external_endpoint(self) -> None:
         """When the controller cluster is pods (gke/kubernetes), the LB
@@ -179,6 +238,9 @@ class ServeController:
                     self.lb.policy = self.lb.make_data_policy(
                         self.spec.load_balancing_policy)
                     self._sync_affinity_active()
+                    # Keep the migration concurrency bound reading the
+                    # CURRENT autoscaler's lead-time model.
+                    self.remediation.autoscaler = self.autoscaler
                 num_ready_now = len(self.lb.policy.replicas)
                 replica_snapshot = serve_state.list_replicas(
                     self.service_name)
@@ -222,6 +284,14 @@ class ServeController:
                     self.lb.policy.set_weights({
                         r['endpoint']: float(r.get('weight') or 1.0)
                         for r in replica_snapshot if r.get('endpoint')})
+                # Self-healing tick: SLO evaluation over this
+                # snapshot's health bodies (page firings → the
+                # remediation hook), then the engine's own step —
+                # worker harvest, stuck-launch watchdog, zone
+                # preemption pressure — and the gauge mirror.
+                self._tick_slo(replica_snapshot)
+                self.remediation.step(replica_snapshot)
+                self._mirror_remediation_gauges()
                 if ready and not became_ready:
                     became_ready = True
                     serve_state.set_service_status(

@@ -1462,6 +1462,26 @@ class LlmServer:
         return web.json_response(
             {'skip_blocks': self.engine.probe_chain(row)})
 
+    async def kv_chains(self, request: web.Request) -> web.Response:
+        """Resolve affinity-advert chain digests back to the token rows
+        this replica's trie still holds (engine.resolve_chains) — the
+        remediation pre-warm handshake: the controller reads the
+        victim's last advert (hex digests only), asks the victim for
+        the concrete prompts here, then replays them victim→successor
+        through the ordinary export/fetch/import path."""
+        if self.engine is None \
+                or not hasattr(self.engine, 'resolve_chains'):
+            return web.json_response({'chains': []})
+        try:
+            body = await request.json()
+            digests = [bytes.fromhex(str(h))
+                       for h in (body.get('digests') or [])]
+        except (ValueError, TypeError):
+            return web.json_response(
+                {'error': 'digests must be hex strings'}, status=400)
+        rows = self.engine.resolve_chains(digests)
+        return web.json_response({'chains': rows})
+
     async def kv_import(self, request: web.Request) -> web.Response:
         """Decode-role admission over HTTP: validate the payload
         (checksums first — corrupt bytes never reach the device),
@@ -1735,6 +1755,7 @@ class LlmServer:
         app.router.add_post('/v1/kv/export', self.kv_export)
         app.router.add_get('/v1/kv/fetch', self.kv_fetch)
         app.router.add_post('/v1/kv/prepare', self.kv_prepare)
+        app.router.add_post('/v1/kv/chains', self.kv_chains)
         app.router.add_post('/v1/kv/import', self.kv_import)
         return app
 

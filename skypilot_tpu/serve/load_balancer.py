@@ -68,7 +68,9 @@ class LoadBalancer:
                    'disagg_stats': '_stats_lock',
                    'affinity_stats': '_stats_lock',
                    'trace_stats': '_stats_lock',
-                   '_replica_summaries': '_stats_lock'}
+                   '_replica_summaries': '_stats_lock',
+                   '_upstream_active': '_stats_lock',
+                   '_draining': '_stats_lock'}
 
     def __init__(self, port: int, policy: str = 'least_load',
                  affinity: Optional[bool] = None):
@@ -128,6 +130,19 @@ class LoadBalancer:
         # Last controller-pushed per-replica /health trie summaries,
         # kept for operator introspection (probes, affinity_snapshot).
         self._replica_summaries: Dict[str, dict] = {}
+        # LB-level per-endpoint in-flight counts. The POLICY's inflight
+        # map is wrong for drain confirmation: set_replicas deletes a
+        # removed endpoint's entry, which is exactly when remediation
+        # needs to know whether the victim still serves streams.
+        self._upstream_active: Dict[str, int] = {}
+        # Endpoints mid-drain (remediation's begin_drain): sticky across
+        # the controller's per-tick set_replicas pushes — a draining
+        # victim must not be re-added to the routing pools by the next
+        # snapshot while its probe still answers READY.
+        self._draining: set = set()
+        # Controller-installed callable returning the /debug/remediations
+        # body (the remediation engine's record log + placer snapshot).
+        self.remediation_payload = None
         self._last_ready_set: set = set()
         self._runner: Optional[web.AppRunner] = None
         self._thread: Optional[threading.Thread] = None
@@ -148,6 +163,10 @@ class LoadBalancer:
         # this every tick, so record only CHANGES to the ready set — a
         # replica appearing/vanishing here is the LB-side trace of a
         # health flip, scale event, or preemption.
+        with self._stats_lock:
+            draining = set(self._draining)
+        if draining:
+            endpoints = [e for e in endpoints if e not in draining]
         new_set = set(endpoints)
         if new_set != self._last_ready_set:
             blackbox.record(
@@ -169,6 +188,57 @@ class LoadBalancer:
     def disagg_active(self) -> bool:
         return bool(self._prefill_policy.replicas
                     and self._decode_policy.replicas)
+
+    # -- drain coordination (serve/remediation.py) -------------------------
+
+    def _track_start(self, endpoint: str) -> None:
+        with self._stats_lock:
+            self._upstream_active[endpoint] = \
+                self._upstream_active.get(endpoint, 0) + 1
+
+    def _track_end(self, endpoint: str) -> None:
+        with self._stats_lock:
+            n = self._upstream_active.get(endpoint, 0) - 1
+            if n > 0:
+                self._upstream_active[endpoint] = n
+            else:
+                self._upstream_active.pop(endpoint, None)
+
+    def inflight(self, endpoint: str) -> int:
+        """Streams/requests this LB is CURRENTLY serving through
+        ``endpoint`` — survives the endpoint leaving the routing pools
+        (unlike the policy's inflight map), which is what drain
+        confirmation needs."""
+        with self._stats_lock:
+            return self._upstream_active.get(endpoint, 0)
+
+    def begin_drain(self, endpoint: str) -> None:
+        """Stop routing NEW work to ``endpoint`` (sticky across the
+        controller's set_replicas pushes) while in-flight requests
+        finish — or resume on a survivor if the replica dies mid-drain."""
+        with self._stats_lock:
+            self._draining.add(endpoint)
+        for pol in (self.policy, self._prefill_policy,
+                    self._decode_policy):
+            if pol.replicas and endpoint in pol.replicas:
+                pol.set_replicas([e for e in pol.replicas
+                                  if e != endpoint])
+
+    def end_drain(self, endpoint: str) -> None:
+        with self._stats_lock:
+            self._draining.discard(endpoint)
+
+    def wait_drained(self, endpoint: str, timeout_s: float = 120.0,
+                     poll_s: float = 0.1) -> bool:
+        """Block (remediation worker thread, never the event loop) until
+        no in-flight request still rides ``endpoint``. True = drained;
+        False = timed out with streams still open."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if self.inflight(endpoint) == 0:
+                return True
+            time.sleep(poll_s)
+        return self.inflight(endpoint) == 0
 
     # -- prefix-affinity routing (utils/prefix_affinity.py) ----------------
 
@@ -342,6 +412,13 @@ class LoadBalancer:
             # cross-replica stitching) — served locally, never proxied,
             # behind the same scrape-token gate as replica /debug/*.
             return await self._debug_traces(request)
+        if request.path == '/debug/remediations' \
+                and request.method == 'GET':
+            # The remediation engine's audit log (controller-installed
+            # payload fn) — LB-local like /debug/traces: the engine has
+            # no HTTP surface of its own, and operators asking "what
+            # did self-healing do" ask the service endpoint.
+            return await self._debug_remediations(request)
         if request.path.startswith('/debug/'):
             # Operator-facing endpoints (replica /debug/traces carries
             # cross-tenant request metadata) never transit the
@@ -392,8 +469,7 @@ class LoadBalancer:
     async def _proxy_generate(self,
                               request: web.Request) -> web.StreamResponse:
         replica = None
-        if (request.method == 'POST' and request.path == '/generate'
-                and (self.disagg_active() or self._affinity_ready())):
+        if request.method == 'POST' and request.path == '/generate':
             cached = request.get(_PARSED_BODY_KEY)
             if cached is not None:  # the trace wrapper already parsed
                 body = cached[0]
@@ -422,7 +498,16 @@ class LoadBalancer:
                 # chains; a miss or a saturated match falls through to
                 # the plain policy pick below. (request.read() caches,
                 # so the generic forward re-reads the same bytes.)
-                replica, _ = self._affinity_pick(body)
+                if self._affinity_ready():
+                    replica, _ = self._affinity_pick(body)
+                if self._resume_eligible(body):
+                    # Deterministic single-row stream on a colocated
+                    # fleet: serve line-piped, so a replica dying (or
+                    # drained away) mid-stream RESUMES on a survivor
+                    # instead of 502ing the client — the machinery a
+                    # live replica migration drains through.
+                    return await self._serve_colocated(
+                        request, body, fallback=False, replica=replica)
         if replica is None:
             replica = self.policy.select()
         if replica is None:
@@ -431,10 +516,12 @@ class LoadBalancer:
         self._note_request(replica)
         url = f'http://{replica}{request.path_qs}'
         self.policy.on_request_start(replica)
+        self._track_start(replica)
         try:
             with trace_lib.span('lb.upstream', replica=replica):
                 return await self._forward_plain(request, url, replica)
         finally:
+            self._track_end(replica)
             self.policy.on_request_end(replica)
 
     async def _forward_plain(self, request: web.Request, url: str,
@@ -491,6 +578,25 @@ class LoadBalancer:
             return False
         return True
 
+    @staticmethod
+    def _resume_eligible(body) -> bool:
+        """Colocated streams that may be RESUMED on a survivor after a
+        mid-stream death: streamed, single-row, greedy — the same
+        determinism argument as _disagg_eligible (the retry reproduces
+        the delivered prefix token-for-token, so splicing by count is
+        sound). Sampled streams keep the raw passthrough path."""
+        if not isinstance(body, dict) or not body.get('stream'):
+            return False
+        tokens = body.get('tokens')
+        if not tokens or not isinstance(tokens, list):
+            return False
+        if isinstance(tokens[0], list) and len(tokens) != 1:
+            return False
+        try:
+            return float(body.get('temperature') or 0.0) == 0.0
+        except (TypeError, ValueError):
+            return False
+
     async def _proxy_disagg(self, request: web.Request,
                             body: dict) -> web.StreamResponse:
         stream = bool(body.get('stream'))
@@ -520,6 +626,8 @@ class LoadBalancer:
         self._tag_upstream(prefill)  # its kv_export fragment stitches too
         self._prefill_policy.on_request_start(prefill)
         self._decode_policy.on_request_start(decode)
+        self._track_start(prefill)
+        self._track_start(decode)
         prefill_busy = True
         timeout = aiohttp.ClientTimeout(total=_HANDOFF_TIMEOUT_S)
         try:
@@ -533,6 +641,7 @@ class LoadBalancer:
                     # stream drains, or least_load routes new exports
                     # away from idle prefill replicas.
                     self._prefill_policy.on_request_end(prefill)
+                    self._track_end(prefill)
                     prefill_busy = False
                     url = (f'http://{decode}/v1/kv/import'
                            + ('?stream=1' if stream else ''))
@@ -574,7 +683,9 @@ class LoadBalancer:
         finally:
             if prefill_busy:
                 self._prefill_policy.on_request_end(prefill)
+                self._track_end(prefill)
             self._decode_policy.on_request_end(decode)
+            self._track_end(decode)
 
     async def _handoff(self, session, prefill: str, decode: str,
                        body: dict, headers, timeout):
@@ -723,6 +834,7 @@ class LoadBalancer:
         hdrs[trace_lib.RESUME_HEADER] = '1'
         self._note_request(replica)
         self.policy.on_request_start(replica)
+        self._track_start(replica)
         skipped = 0
         try:
             async with aiohttp.ClientSession() as session:
@@ -757,6 +869,7 @@ class LoadBalancer:
                 await resp.write(json.dumps(
                     {'error': f'resume failed: {e}'}).encode() + b'\n')
         finally:
+            self._track_end(replica)
             self.policy.on_request_end(replica)
 
     def _select_fallback(self, exclude: str) -> Optional[str]:
@@ -767,12 +880,17 @@ class LoadBalancer:
         return replica
 
     async def _serve_colocated(self, request: web.Request, body: dict,
-                               fallback: bool = True
+                               fallback: bool = True,
+                               replica: Optional[str] = None
                                ) -> web.StreamResponse:
         """Serve a /generate whole on the main (non-prefill) pool — the
         colocated fallback for failed handoffs and the plain path for
-        handoff-ineligible requests."""
-        replica = self.policy.select()
+        handoff-ineligible requests. ``replica`` pins the upstream (an
+        affinity pick already made). Resume-eligible streams
+        (_resume_eligible) are line-piped so a replica dying mid-stream
+        resumes on a survivor instead of truncating the client."""
+        if replica is None:
+            replica = self.policy.select()
         if replica is None:
             return web.json_response(
                 {'error': 'No ready replicas.'}, status=503)
@@ -785,6 +903,7 @@ class LoadBalancer:
             headers['X-SkyTPU-Disagg-Fallback'] = '1'
         self._note_request(replica)
         self.policy.on_request_start(replica)
+        self._track_start(replica)
         try:
             async with aiohttp.ClientSession() as session:
                 async with session.post(
@@ -800,6 +919,9 @@ class LoadBalancer:
                         return web.Response(status=r.status,
                                             body=payload,
                                             headers=out_headers)
+                    if r.status == 200 and self._resume_eligible(body):
+                        return await self._pipe_colocated(
+                            request, r, body, headers, replica)
                     resp = web.StreamResponse(
                         status=r.status,
                         headers={'X-Served-By': replica})
@@ -814,7 +936,44 @@ class LoadBalancer:
             return web.json_response(
                 {'error': f'replica {replica} failed: {e}'}, status=502)
         finally:
+            self._track_end(replica)
             self.policy.on_request_end(replica)
+
+    async def _pipe_colocated(self, request, r, body: dict, headers,
+                              replica: str) -> web.StreamResponse:
+        """NDJSON line piping for a resume-eligible colocated stream:
+        the _pipe_stream analog without the handoff — count forwarded
+        tokens; a mid-stream death resumes on a survivor with the
+        delivered prefix skipped."""
+        resp = web.StreamResponse(headers={'X-Served-By': replica})
+        resp.content_type = 'application/x-ndjson'
+        sent = 0
+        prepared = False
+        try:
+            async for line in r.content:
+                if not line.strip():
+                    continue
+                obj = json.loads(line)
+                if 'error' in obj:
+                    raise _HandoffFailed(obj['error'])
+                if not prepared:
+                    await resp.prepare(request)
+                    prepared = True
+                await resp.write(line)
+                if obj.get('done'):
+                    await resp.write_eof()
+                    return resp
+                sent += len(obj.get('tokens') or [])
+            raise _HandoffFailed('stream ended without done marker')
+        except (aiohttp.ClientError, asyncio.TimeoutError,
+                _HandoffFailed, ValueError):
+            if not prepared:
+                await resp.prepare(request)
+            await self._resume_stream(request, resp, body, headers,
+                                      sent, exclude=replica)
+            with contextlib.suppress(Exception):
+                await resp.write_eof()
+            return resp
 
     # -- tail-retention propagation + cross-replica stitching --------------
 
@@ -925,6 +1084,26 @@ class LoadBalancer:
             payload['stitched_from'] = asked
         with self._stats_lock:
             payload['lb'] = dict(self.trace_stats)
+        return web.json_response(payload)
+
+    async def _debug_remediations(self,
+                                  request: web.Request) -> web.Response:
+        """/debug/remediations: every action's frozen record (trigger
+        rule, alert id, victim/successor, retained trace ids, phase
+        timings) plus the live budget/placer state. Token-gated like
+        /debug/traces."""
+        from skypilot_tpu import users as users_lib
+        if not users_lib.metrics_scrape_allowed(request.headers):
+            return web.json_response({'error': 'unauthorized'},
+                                     status=401)
+        fn = self.remediation_payload
+        if fn is None:
+            return web.json_response({'enabled': False, 'records': []})
+        try:
+            payload = await asyncio.get_event_loop().run_in_executor(
+                None, fn)
+        except Exception as e:  # noqa: BLE001 — audit surface must not 500
+            payload = {'enabled': True, 'error': str(e), 'records': []}
         return web.json_response(payload)
 
     def make_app(self) -> web.Application:

@@ -47,8 +47,16 @@ class ReplicaManager:
         # fleet's MEASURED warm-vs-cold boot distribution.
         self.on_first_ready: Optional[
             Callable[[float, Optional[bool]], None]] = None
+        # Optional hook fired when a previously READY/grace-expired
+        # replica goes dark, BEFORE the inline terminate+replace. Return
+        # True to claim the replacement (serve/remediation.py runs its
+        # supervised replace_replica playbook instead); False/None (or
+        # raising) falls back to the inline path — a broken remediation
+        # engine must never strand a dead replica.
+        self.on_replica_dark: Optional[Callable[[Dict], bool]] = None
         self.spot_placer = (
-            spot_placer_lib.DynamicFallbackSpotPlacer()
+            spot_placer_lib.DynamicFallbackSpotPlacer(
+                persist=True, name=service_name)
             if spec.replica_policy.dynamic_ondemand_fallback else None)
 
     def set_version(self, version: int, spec: ServiceSpec,
@@ -161,17 +169,41 @@ class ReplicaManager:
             role=role)
         return replica_id
 
+    def replica_zone(self, replica_id: int) -> Optional[str]:
+        """The zone the replica's cluster landed in (provision failover
+        picks it), or None when unknown — the placer's per-zone
+        preemption attribution and remediation's zone-pressure signal."""
+        record = global_user_state.get_cluster(
+            self._cluster_name(replica_id))
+        if not record or not record.get('handle'):
+            return None
+        zone = record['handle'].get('zone')
+        return str(zone) if zone else None
+
     # -- scale down / replace ---------------------------------------------
 
-    def terminate_replica(self, replica_id: int, failed: bool = False) -> None:
+    def terminate_replica(self, replica_id: int, failed: bool = False,
+                          after_drain: Optional[Callable[[], None]] = None
+                          ) -> None:
+        """``after_drain``: called after the replica is marked
+        SHUTTING_DOWN (the controller stops routing to it) but BEFORE
+        the cluster teardown — remediation passes the LB drain-wait
+        here, so in-flight streams finish (or resume on a survivor)
+        before the process that serves them is killed. Calling
+        terminate without it keeps the old immediate-teardown order."""
         cluster = self._cluster_name(replica_id)
         blackbox.record('serve.replica_terminate', replica=replica_id,
-                        failed=failed)
+                        failed=failed, drained=after_drain is not None)
         serve_state.upsert_replica(
             self.service_name, replica_id,
             serve_state.ReplicaStatus.FAILED if failed
             else serve_state.ReplicaStatus.SHUTTING_DOWN,
             health='')  # stale stats must not outlive the replica
+        if after_drain is not None:
+            try:
+                after_drain()
+            except Exception:  # noqa: BLE001 — drain-wait is best-effort;
+                pass  # the teardown below must happen regardless
         try:
             core.down(cluster)
         except exceptions.SkyTpuError:
@@ -307,18 +339,28 @@ class ReplicaManager:
                     # Preemption notice for the flight recorder: WHY a
                     # replica vanished is the question incident bundles
                     # exist to answer at fleet scale.
+                    zone = self.replica_zone(rid)
                     blackbox.record(
                         'serve.replica_dark', replica=rid,
                         endpoint=endpoint,
                         was_ready=(status ==
                                    serve_state.ReplicaStatus.READY),
-                        spot=bool(rep.get('use_spot')))
+                        spot=bool(rep.get('use_spot')), zone=zone)
                     serve_state.upsert_replica(
                         self.service_name, rid,
                         serve_state.ReplicaStatus.NOT_READY, health='')
                     if self.spot_placer is not None:
                         # A READY replica going dark is preemption-shaped.
-                        self.spot_placer.report_preemption()
+                        self.spot_placer.report_preemption(zone=zone)
+                    handled = False
+                    if self.on_replica_dark is not None:
+                        try:
+                            handled = bool(self.on_replica_dark(
+                                dict(rep, zone=zone)))
+                        except Exception:  # noqa: BLE001 — remediation
+                            handled = False  # failure → inline replace
+                    if handled:
+                        continue
                     self.terminate_replica(rid, failed=True)
                     # The replacement joins the SAME pool: a dead
                     # prefill replica replaced by a colocated one would

@@ -7,29 +7,169 @@ Difference: zone choice already lives in the provision failover loop here
 thing the failover loop cannot: whether the NEXT replica launch should be
 spot or on-demand, based on recent preemption pressure, decaying back to
 spot when the pressure clears.
+
+This module is written to by two threads (the controller tick reporting
+probe-observed preemptions, and remediation actions running in their own
+threads) and read by launch paths — every mutation holds ``self._lock``.
+Pressure is per-zone (``report_preemption(zone=...)``) so the remediation
+engine's ``zone_blocklist`` action and successor placement can price a
+bad zone without punishing the healthy ones, and the whole state
+persists atomically under ``$SKYTPU_STATE_DIR`` (utils/atomic_io) so a
+controller restart does not forget a preemption storm mid-window.
 """
 from __future__ import annotations
 
+import json
+import os
+import threading
 import time
-from typing import List
+from typing import Dict, List, Optional
+
+from skypilot_tpu.utils import atomic_io
+
+# Zone key for preemptions whose zone the probe could not determine.
+UNKNOWN_ZONE = ''
+
+STATE_VERSION = 1
+
+
+def _default_state_dir() -> str:
+    return os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
 
 
 class DynamicFallbackSpotPlacer:
     """Prefer spot; after ``threshold`` preemptions inside ``window_s``,
     place new replicas on-demand until the window drains."""
 
-    def __init__(self, window_s: float = 600.0, threshold: int = 2):
+    def __init__(self, window_s: float = 600.0, threshold: int = 2,
+                 persist: bool = False, name: str = 'default'):
         self.window_s = window_s
         self.threshold = threshold
-        self._preemptions: List[float] = []
+        self._lock = threading.Lock()
+        # zone -> recent preemption timestamps (UNKNOWN_ZONE for
+        # preemptions the probe could not attribute).
+        self._preemptions: Dict[str, List[float]] = {}
+        # zone -> blocklist expiry (remediation's zone_blocklist action;
+        # pressure-derived avoidance is computed live, this is the
+        # explicit, TTL'd overlay).
+        self._blocklist: Dict[str, float] = {}
+        self._persist = persist
+        self._path = os.path.join(
+            _default_state_dir(), f'spot_placer-{name}.json')
+        if persist:
+            self._load()
 
-    def report_preemption(self) -> None:
-        self._preemptions.append(time.time())
+    # -- persistence (tmp-write + rename; a torn write is invisible) ----
 
-    def _recent(self) -> int:
-        cutoff = time.time() - self.window_s
-        self._preemptions = [t for t in self._preemptions if t > cutoff]
-        return len(self._preemptions)
+    def _load(self) -> None:
+        try:
+            with open(self._path, encoding='utf-8') as f:
+                state = json.load(f)
+        except (OSError, ValueError):
+            return
+        if not isinstance(state, dict) \
+                or state.get('version') != STATE_VERSION:
+            return
+        with self._lock:
+            pre = state.get('preemptions') or {}
+            if isinstance(pre, dict):
+                self._preemptions = {
+                    str(z): [float(t) for t in ts]
+                    for z, ts in pre.items() if isinstance(ts, list)}
+            bl = state.get('blocklist') or {}
+            if isinstance(bl, dict):
+                self._blocklist = {str(z): float(t)
+                                   for z, t in bl.items()}
 
-    def use_spot(self) -> bool:
-        return self._recent() < self.threshold
+    # skylint: locked(called under self._lock), allow-block(rare tiny
+    # no-fsync state write on preemption/blocklist events only — the
+    # durable copy must match the state the decision was made on)
+    def _save(self) -> None:
+        if not self._persist:
+            return
+        payload = json.dumps({'version': STATE_VERSION,
+                              'preemptions': self._preemptions,
+                              'blocklist': self._blocklist},
+                             sort_keys=True)
+        try:
+            os.makedirs(os.path.dirname(self._path), exist_ok=True)
+            atomic_io.atomic_write(self._path,
+                                   lambda f: f.write(payload))
+        except OSError:
+            pass  # in-memory pressure still works; restart-amnesia only
+
+    # -- reporting ------------------------------------------------------
+
+    def report_preemption(self, zone: Optional[str] = None) -> None:
+        with self._lock:
+            self._preemptions.setdefault(
+                zone or UNKNOWN_ZONE, []).append(time.time())
+            self._save()
+
+    def blocklist_zone(self, zone: str, ttl_s: float) -> None:
+        """Explicitly avoid ``zone`` for ``ttl_s`` seconds (the
+        remediation engine's ``zone_blocklist`` action)."""
+        with self._lock:
+            self._blocklist[zone] = time.time() + max(ttl_s, 0.0)
+            self._save()
+
+    # skylint: locked(called under self._lock)
+    def _gc(self, now: float) -> None:
+        cutoff = now - self.window_s
+        for zone in list(self._preemptions):
+            kept = [t for t in self._preemptions[zone] if t > cutoff]
+            if kept:
+                self._preemptions[zone] = kept
+            else:
+                del self._preemptions[zone]
+        for zone in list(self._blocklist):
+            if self._blocklist[zone] <= now:
+                del self._blocklist[zone]
+
+    # -- decisions ------------------------------------------------------
+
+    def _recent(self, zone: Optional[str] = None) -> int:
+        with self._lock:
+            self._gc(time.time())
+            if zone is not None:
+                return len(self._preemptions.get(zone, ()))
+            return sum(len(ts) for ts in self._preemptions.values())
+
+    def use_spot(self, zone: Optional[str] = None) -> bool:
+        """Fleet-wide by default; with ``zone`` the decision counts only
+        that zone's window (a storm in one zone should not force the
+        whole fleet on-demand when placement can steer around it)."""
+        return self._recent(zone) < self.threshold
+
+    def zone_rates(self) -> Dict[str, int]:
+        """Preemptions per zone inside the live window — the
+        remediation engine's zone-pressure signal and the dashboard's
+        placement column."""
+        with self._lock:
+            self._gc(time.time())
+            return {z: len(ts) for z, ts in self._preemptions.items()}
+
+    def avoid_zones(self) -> List[str]:
+        """Zones a successor launch should steer away from: explicitly
+        blocklisted (TTL live) or at/over the preemption threshold."""
+        with self._lock:
+            self._gc(time.time())
+            out = set(self._blocklist)
+            out.update(z for z, ts in self._preemptions.items()
+                       if z != UNKNOWN_ZONE and len(ts) >= self.threshold)
+            return sorted(out)
+
+    def snapshot(self) -> Dict[str, object]:
+        """JSON-able state for /debug/remediations + the dashboard."""
+        with self._lock:
+            self._gc(time.time())
+            return {'window_s': self.window_s,
+                    'threshold': self.threshold,
+                    'zones': {z: len(ts)
+                              for z, ts in self._preemptions.items()},
+                    'blocklist': {z: round(t, 3)
+                                  for z, t in self._blocklist.items()},
+                    'use_spot': (sum(len(ts) for ts
+                                     in self._preemptions.values())
+                                 < self.threshold)}

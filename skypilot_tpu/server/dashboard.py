@@ -23,6 +23,7 @@ Routes (registered by ``server.py``):
   GET /dashboard/api/fleet                 -> heartbeats + job goodput
   GET /dashboard/api/incidents             -> incident-bundle spool list
   GET /dashboard/api/incident/{file}       -> one full incident bundle
+  GET /dashboard/api/remediation           -> self-healing decision log
 """
 from __future__ import annotations
 
@@ -557,6 +558,42 @@ def incident_detail(fname: str) -> Optional[Dict[str, Any]]:
     return blackbox.read_bundle(fname)
 
 
+def remediation_view() -> Dict[str, Any]:
+    """The #/remediation panel's data: the self-healing engine's
+    journaled decisions (serve/remediation.py). The controller
+    persists each service's record log atomically under
+    $SKYTPU_STATE_DIR, so this read works from the API-server process
+    even for detached controllers; the live payload (budget tokens,
+    placer state) stays at the LB's /debug/remediations."""
+    import dataclasses
+    import glob
+    import json
+
+    from skypilot_tpu.serve import remediation as remediation_lib
+    state_dir = os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    records: List[Dict[str, Any]] = []
+    for path in sorted(glob.glob(
+            os.path.join(state_dir, 'remediations-*.json'))):
+        try:
+            with open(path, encoding='utf-8') as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(data, dict):
+            records.extend(r for r in (data.get('records') or [])
+                           if isinstance(r, dict))
+    records.sort(key=lambda r: r.get('ts') or 0, reverse=True)
+    return {'mode': remediation_lib.mode(),
+            'actions': [dataclasses.asdict(a)
+                        for a in remediation_lib.ACTIONS],
+            'records': records[:200]}
+
+
+async def api_remediation(request: web.Request) -> web.Response:
+    return await _json(request, remediation_view)
+
+
 async def api_incidents(request: web.Request) -> web.Response:
     return await _json(request, incidents_view)
 
@@ -597,6 +634,7 @@ def add_routes(app: web.Application) -> None:
     app.router.add_get('/dashboard/api/incidents', api_incidents)
     app.router.add_get('/dashboard/api/incident/{file}', api_incident)
     app.router.add_get('/dashboard/api/alerts', api_alerts)
+    app.router.add_get('/dashboard/api/remediation', api_remediation)
 
 
 _PAGE = """<!doctype html>
@@ -633,7 +671,7 @@ _PAGE = """<!doctype html>
 </style></head><body>
 <h1>skypilot-tpu <span id="ts"></span></h1>
 <nav><a href="#/">overview</a> <a href="#/metrics">metrics</a>
- <a href="#/alerts">alerts</a>
+ <a href="#/alerts">alerts</a> <a href="#/remediation">remediation</a>
  <a href="#/traces">traces</a> <a href="#/incidents">incidents</a>
  <a href="#/fleet">fleet</a>
  <a href="#/logs">logs</a> <a href="#/infra">infra</a>
@@ -1123,6 +1161,33 @@ async function alertsView(){
     `<h2>Rule catalog</h2>` + rules;
 }
 
+// Self-healing audit: every remediation decision (acted, observed,
+// suppressed) with its phase timings; the trace id links into the
+// autopsy view (retained verdict 'remediation').
+async function remediationView(){
+  const d = await J('dashboard/api/remediation');
+  const head = `<h2>Self-healing remediation <span style="color:#888;
+    font-size:12px">mode ${esc(d.mode)}${d.mode==='off' ?
+    ' (set SKYTPU_REMEDIATE=observe|act on the controller)' : ''}
+    </span></h2>`;
+  const phases = r => (r.phases||[]).map(
+    p=>`${esc(p.name)} ${(p.dt*1000).toFixed(0)}ms`).join(' → ');
+  const recs = table(
+    ['when','service','action','trigger','outcome','victim','successor',
+     'phases','trace'], d.records||[],
+    r=>`<tr><td>${T(r.ts)}</td><td>${esc(r.service)}</td>
+     <td>${B(r.action)}${r.intended ? ' ('+esc(r.intended)+')' : ''}</td>
+     <td>${esc(r.trigger)}</td><td>${B(r.outcome)}</td>
+     <td>${r.victim!=null ? esc(r.victim) : ''}</td>
+     <td>${r.successor!=null ? esc(r.successor) : ''}</td>
+     <td style="font-size:11px;color:#666">${phases(r)}</td>
+     <td>${r.trace_id ? `<a href="#/autopsy/${esc(r.trace_id)}">${
+       esc(r.trace_id.slice(0,12))}</a>` : ''}</td></tr>`);
+  const actions = table(['action','meaning'], d.actions||[],
+    a=>`<tr><td>${esc(a.name)}</td><td>${esc(a.doc)}</td></tr>`);
+  return head + recs + `<h2>Action registry</h2>` + actions;
+}
+
 // Waterfall of one completed trace: rows indented by span depth, bars
 // positioned by (start - trace start) / duration. Spans arrive sorted
 // by start from /debug/traces.
@@ -1352,6 +1417,7 @@ async function route(){
     else if(h === '#/workspaces') html = await workspacesView();
     else if(h === '#/metrics') html = await metricsView();
     else if(h === '#/alerts') html = await alertsView();
+    else if(h === '#/remediation') html = await remediationView();
     else if((m = h.match(/^#\\/traces\\/(.+)$/)))
       html = await tracesView(decodeURIComponent(m[1]));
     else if(h === '#/traces') html = await tracesView();

@@ -483,6 +483,17 @@ _PROVISION_TO_FIRST_TOKEN = Gauge(
     'set once at the dark→READY transition.',
     ['service', 'replica'], registry=REGISTRY)
 
+# Self-healing actions (serve/remediation.py), controller-pushed like
+# the affinity counters: cumulative decisions by (action, trigger,
+# outcome) — outcome 'executed'/'failed'/'observed' (dry run) or
+# 'suppressed_*' (budget/hysteresis/cooldown/concurrency downgraded
+# the decision to noop_observe).
+_REMEDIATION_TOTAL = Gauge(
+    'skytpu_remediation_total',
+    'Cumulative remediation-engine decisions by action, trigger and '
+    'outcome, per service (serve/remediation.py).',
+    ['service', 'action', 'trigger', 'outcome'], registry=REGISTRY)
+
 _FLEET_PREFIX_HIT_RATE = Gauge(
     'skytpu_fleet_prefix_hit_rate',
     'Fleet-wide block-share prefix hit rate: sum(hits) / sum(hits + '
@@ -498,6 +509,8 @@ _FLEET_PREFIX_HIT_RATE = Gauge(
 _LB_AFFINITY_LAST: Dict[str, Any] = {}
 # (service, replica) -> seconds; same live-services-only rebuild.
 _P2FT_LAST: Dict[Any, float] = {}
+# service -> {(action, trigger, outcome): count}; same rebuild.
+_REMEDIATION_LAST: Dict[str, Dict[Any, int]] = {}
 
 
 def set_lb_affinity(service: str, routed: float,
@@ -507,6 +520,16 @@ def set_lb_affinity(service: str, routed: float,
     _LB_AFFINITY_LAST[service] = (float(routed), float(fallbacks))
     _LB_AFFINITY_ROUTED.labels(service=service).set(routed)
     _LB_AFFINITY_FALLBACK.labels(service=service).set(fallbacks)
+
+
+def set_remediation(service: str, counts: Dict[Any, int]) -> None:
+    """Controller-pushed mirror of the remediation engine's decision
+    counts ({(action, trigger, outcome): n},
+    RemediationEngine.counts)."""
+    _REMEDIATION_LAST[service] = dict(counts)
+    for (action, trigger, outcome), n in counts.items():
+        _REMEDIATION_TOTAL.labels(service=service, action=action,
+                                  trigger=trigger, outcome=outcome).set(n)
 
 
 def set_provision_to_first_token(service: str, replica: Any,
@@ -596,7 +619,7 @@ def _refresh_gauges() -> None:
     for gauge in (_SERVE_QOS_DEPTH, _SERVE_QOS_SHED, _SERVE_QOS_EVICTED,
                   _SERVE_QOS_WAIT_P95, _FLEET_PREFIX_HIT_RATE,
                   _LB_AFFINITY_ROUTED, _LB_AFFINITY_FALLBACK,
-                  _PROVISION_TO_FIRST_TOKEN):
+                  _REMEDIATION_TOTAL, _PROVISION_TO_FIRST_TOKEN):
         gauge.clear()
     live_services = {s['name'] for s in services
                      if s['status'].value not in ('SHUTDOWN', 'FAILED')}
@@ -607,6 +630,15 @@ def _refresh_gauges() -> None:
             routed, fallbacks = _LB_AFFINITY_LAST[name]
             _LB_AFFINITY_ROUTED.labels(service=name).set(routed)
             _LB_AFFINITY_FALLBACK.labels(service=name).set(fallbacks)
+    for name in list(_REMEDIATION_LAST):
+        if name not in live_services:
+            del _REMEDIATION_LAST[name]
+        else:
+            for (action, trigger, outcome), n in \
+                    _REMEDIATION_LAST[name].items():
+                _REMEDIATION_TOTAL.labels(
+                    service=name, action=action, trigger=trigger,
+                    outcome=outcome).set(n)
     live_replicas = set()  # (service, replica_id) seen this scrape
     for svc in services:
         # Fleet prefix hit rate: aggregate the replicas' block-share

@@ -1,0 +1,637 @@
+"""Self-healing remediation engine (ISSUE 17).
+
+Contract: the engine turns page-severity SLO firings, preemption
+notices and watchdog hits into supervised actions from the bounded
+``ACTIONS`` registry — successor-first migration ordering (capacity
+never dips), BlockTrie pre-warm over the existing kv-handoff path,
+drain-before-terminate through the LB with mid-stream resume — and is
+safe by construction: off by default, dry-runnable
+(``SKYTPU_REMEDIATE=observe``), budgeted, hysteretic, and fully
+journaled (blackbox event + persisted record + retained trace per
+decision).
+"""
+import http.server
+import json
+import threading
+import time
+
+import pytest
+import requests as requests_lib
+
+from skypilot_tpu.models import paged as paged_lib
+from skypilot_tpu.observability import blackbox
+from skypilot_tpu.serve import remediation as rem_lib
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.load_balancer import LoadBalancer
+from skypilot_tpu.serve.spot_placer import DynamicFallbackSpotPlacer
+from skypilot_tpu.utils import common_utils
+
+
+# ---------------------------------------------------------------------------
+# fakes
+
+
+class FakeFleet:
+    """Records every fleet mutation with a sequence log, so tests can
+    assert ORDER (launch-before-drain, drain-before-terminate), not
+    just effects."""
+
+    def __init__(self, reps=None):
+        self.service_name = 'svc'
+        self._reps = {r['replica_id']: dict(r) for r in (reps or [])}
+        self._next = 100
+        self.log = []
+        self.adverts = {}
+
+    def replicas(self):
+        return [dict(r) for r in self._reps.values()]
+
+    def replica(self, rid):
+        r = self._reps.get(rid)
+        return dict(r) if r else None
+
+    def endpoint(self, rid):
+        r = self._reps.get(rid)
+        return r.get('endpoint') if r else None
+
+    def advert(self, rid):
+        return self.adverts.get(rid)
+
+    def launch(self, role=None):
+        rid = self._next
+        self._next += 1
+        self._reps[rid] = {'replica_id': rid,
+                           'status': serve_state.ReplicaStatus.READY,
+                           'endpoint': f'10.0.0.{rid}:80',
+                           'role': role, 'created_at': time.time()}
+        self.log.append(('launch', rid))
+        return rid
+
+    def wait_ready(self, rid, timeout_s=300.0):
+        del timeout_s
+        self.log.append(('ready', rid))
+        return self._reps[rid]['endpoint']
+
+    def terminate(self, rid, failed=False, after_drain=None):
+        # Mirrors ReplicaManager.terminate_replica ordering: drain-wait
+        # runs before teardown.
+        if after_drain is not None:
+            after_drain()
+        self.log.append(('terminate', rid, failed))
+        self._reps.pop(rid, None)
+
+
+class FakeLB:
+
+    def __init__(self):
+        self.log = []
+        self.drained = set()
+
+    def begin_drain(self, ep):
+        self.log.append(('begin_drain', ep))
+        self.drained.add(ep)
+
+    def end_drain(self, ep):
+        self.log.append(('end_drain', ep))
+
+    def wait_drained(self, ep, timeout_s=120.0, poll_s=0.1):
+        del timeout_s, poll_s
+        self.log.append(('wait_drained', ep))
+        return True
+
+
+def _engine(monkeypatch, tmp_path, mode='act', fleet=None, lb=None,
+            placer=None, budget=100, cooldown=0.0, hysteresis=0.0):
+    monkeypatch.setenv('SKYTPU_REMEDIATE', mode)
+    monkeypatch.setenv('SKYTPU_REMEDIATE_MAX_PER_H', str(budget))
+    monkeypatch.setenv('SKYTPU_REMEDIATE_COOLDOWN_S', str(cooldown))
+    monkeypatch.setenv('SKYTPU_REMEDIATE_HYSTERESIS_S', str(hysteresis))
+    return rem_lib.RemediationEngine(
+        'svc', fleet=fleet if fleet is not None else FakeFleet(),
+        lb=lb, spot_placer=placer, state_dir=str(tmp_path))
+
+
+def _firing(rule='serve.ttft_p99', target='svc/1', severity='page',
+            transition='firing'):
+    return {'rule': rule, 'target': target, 'severity': severity,
+            'transition': transition}
+
+
+# ---------------------------------------------------------------------------
+# decision table: each trigger picks its declared action
+
+
+def test_preemption_replaces_replica(monkeypatch, tmp_path):
+    fleet = FakeFleet([{'replica_id': 1,
+                        'status': serve_state.ReplicaStatus.READY,
+                        'endpoint': '10.0.0.1:80', 'role': None}])
+    lb = FakeLB()
+    eng = _engine(monkeypatch, tmp_path, fleet=fleet, lb=lb)
+    claimed = eng.on_replica_dark(fleet.replica(1))
+    assert claimed  # act mode: the engine owns the replacement
+    assert eng.join(10)
+    assert ('terminate', 1, True) in fleet.log
+    # Dead victim: terminate first, then launch (no drain possible).
+    assert fleet.log.index(('terminate', 1, True)) \
+        < fleet.log.index(('launch', 100))
+    recs = eng.records()
+    assert len(recs) == 1
+    rec = recs[0]
+    assert (rec['action'], rec['trigger'], rec['outcome']) == \
+        ('replace_replica', 'preemption', 'executed')
+    assert rec['victim'] == 1 and rec['successor'] == 100
+    # Phase timings are consecutive marks of one clock: they must sum
+    # exactly to the recorded wall (the /debug/remediations audit
+    # invariant).
+    assert rec['phases']
+    assert abs(sum(p['dt'] for p in rec['phases']) - rec['wall_s']) \
+        < 1e-3
+    assert rec['trace_id']
+
+
+def test_page_firing_on_replica_drain_migrates_in_order(
+        monkeypatch, tmp_path):
+    """drain_migrate ordering: successor launched and READY before the
+    victim stops taking traffic; drain confirmed before terminate."""
+    fleet = FakeFleet([{'replica_id': 1,
+                        'status': serve_state.ReplicaStatus.READY,
+                        'endpoint': '10.0.0.1:80', 'role': None}])
+    lb = FakeLB()
+    eng = _engine(monkeypatch, tmp_path, fleet=fleet, lb=lb)
+    eng.on_slo_transition(_firing(target='svc/1'))
+    assert eng.join(10)
+    rec = eng.records()[0]
+    assert rec['action'] == 'drain_migrate'
+    assert rec['trigger'] == 'slo:serve.ttft_p99'
+    assert rec['outcome'] == 'executed'
+    assert rec['drained'] is True
+    merged = fleet.log + lb.log  # interleave via explicit order checks
+    del merged
+    assert fleet.log.index(('ready', 100)) < len(fleet.log)
+    # LB saw: begin_drain -> wait_drained -> end_drain.
+    assert lb.log == [('begin_drain', '10.0.0.1:80'),
+                      ('wait_drained', '10.0.0.1:80'),
+                      ('end_drain', '10.0.0.1:80')]
+    # Successor was READY before the victim was terminated.
+    assert fleet.log.index(('ready', 100)) \
+        < fleet.log.index(('terminate', 1, False))
+
+
+def test_service_wide_page_rebalances(monkeypatch, tmp_path):
+    fleet = FakeFleet()
+    eng = _engine(monkeypatch, tmp_path, fleet=fleet)
+    eng.on_slo_transition(_firing(target='svc'))
+    assert eng.join(10)
+    rec = eng.records()[0]
+    assert rec['action'] == 'pool_rebalance'
+    assert ('launch', 100) in fleet.log
+    # No terminate: a surge relieves pressure, it removes nothing.
+    assert not any(e[0] == 'terminate' for e in fleet.log)
+
+
+def test_non_page_and_non_firing_transitions_ignored(
+        monkeypatch, tmp_path):
+    eng = _engine(monkeypatch, tmp_path)
+    eng.on_slo_transition(_firing(severity='warn'))
+    eng.on_slo_transition(_firing(transition='resolved'))
+    eng.on_slo_transition(_firing(transition='pending'))
+    assert eng.records() == []
+
+
+def test_other_services_page_is_not_ours(monkeypatch, tmp_path):
+    """A replica-scoped target for ANOTHER service must not resolve to
+    a replica id here — it falls through to the service-wide action
+    only when the service name matches."""
+    fleet = FakeFleet()
+    eng = _engine(monkeypatch, tmp_path, fleet=fleet)
+    assert eng._target_replica('other/1') is None
+    assert eng._target_replica('svc/1') == 1
+    assert eng._target_replica('svc') is None
+
+
+def test_zone_pressure_blocklists(monkeypatch, tmp_path):
+    monkeypatch.setenv('SKYTPU_REMEDIATE_ZONE_BLOCK_S', '900')
+    placer = DynamicFallbackSpotPlacer(threshold=2)
+    placer.report_preemption(zone='us-central2-b')
+    placer.report_preemption(zone='us-central2-b')
+    eng = _engine(monkeypatch, tmp_path, placer=placer,
+                  fleet=FakeFleet())
+    eng.step([])
+    assert eng.join(10)
+    rec = eng.records()[0]
+    assert rec['action'] == 'zone_blocklist'
+    assert rec['zone'] == 'us-central2-b'
+    assert 'us-central2-b' in placer.avoid_zones()
+    # Already-blocklisted zones are not re-decided next tick.
+    eng.step([])
+    assert eng.join(10)
+    assert len(eng.records()) == 1
+
+
+def test_watchdog_replaces_stuck_launch(monkeypatch, tmp_path):
+    fleet = FakeFleet([{
+        'replica_id': 7,
+        'status': serve_state.ReplicaStatus.PROVISIONING,
+        'endpoint': None, 'role': None,
+        'created_at': time.time() - 2 * rem_lib.WATCHDOG_S}])
+    eng = _engine(monkeypatch, tmp_path, fleet=fleet)
+    eng.step(fleet.replicas())
+    assert eng.join(10)
+    rec = eng.records()[0]
+    assert (rec['action'], rec['trigger']) == \
+        ('replace_replica', 'watchdog')
+    # One watchdog decision per stuck replica, ever — not per tick.
+    eng.step(fleet.replicas())
+    assert eng.join(10)
+    assert len([r for r in eng.records()
+                if r['trigger'] == 'watchdog']) == 1
+
+
+# ---------------------------------------------------------------------------
+# safety: mode gate, budget, hysteresis, dry run
+
+
+def test_off_mode_decides_nothing(monkeypatch, tmp_path):
+    fleet = FakeFleet([{'replica_id': 1,
+                        'status': serve_state.ReplicaStatus.READY,
+                        'endpoint': '10.0.0.1:80', 'role': None}])
+    eng = _engine(monkeypatch, tmp_path, mode='off', fleet=fleet)
+    assert eng.on_replica_dark(fleet.replica(1)) is False
+    eng.on_slo_transition(_firing())
+    eng.step(fleet.replicas())
+    assert eng.records() == []
+    assert fleet.log == []
+
+
+def test_observe_mode_records_without_acting(monkeypatch, tmp_path):
+    """Dry run: full decision journaled, zero fleet mutation, budget
+    token refunded (observing is free)."""
+    fleet = FakeFleet([{'replica_id': 1,
+                        'status': serve_state.ReplicaStatus.READY,
+                        'endpoint': '10.0.0.1:80', 'role': None}])
+    eng = _engine(monkeypatch, tmp_path, mode='observe', fleet=fleet,
+                  budget=5)
+    assert eng.on_replica_dark(fleet.replica(1)) is False
+    eng.on_slo_transition(_firing(target='svc/1'))
+    recs = eng.records()
+    assert [(r['action'], r['outcome']) for r in recs] == \
+        [('replace_replica', 'observed'), ('drain_migrate', 'observed')]
+    assert fleet.log == []  # nothing moved
+    assert eng.budget_remaining() == pytest.approx(5, abs=0.01)
+
+
+def test_budget_exhaustion_downgrades_to_noop_observe(
+        monkeypatch, tmp_path):
+    """Budget spent -> the engine keeps observing (noop_observe records
+    with the intended action + a blackbox event) but stops moving the
+    fleet; the inline replacement path stays available (hook returns
+    False)."""
+    blackbox.reset()
+    fleet = FakeFleet([
+        {'replica_id': i, 'status': serve_state.ReplicaStatus.READY,
+         'endpoint': f'10.0.0.{i}:80', 'role': None} for i in (1, 2)])
+    eng = _engine(monkeypatch, tmp_path, fleet=fleet, budget=1)
+    assert eng.on_replica_dark(fleet.replica(1)) is True
+    assert eng.join(10)
+    assert eng.on_replica_dark(fleet.replica(2)) is False
+    recs = eng.records()
+    assert recs[-1]['action'] == 'noop_observe'
+    assert recs[-1]['outcome'] == 'suppressed_budget'
+    assert recs[-1]['intended'] == 'replace_replica'
+    # Fleet kept its second replica: the engine did NOT touch it.
+    assert not any(e == ('terminate', 2, True) for e in fleet.log)
+    names = [(e['name'], (e.get('attrs') or {}).get('outcome'))
+             for e in blackbox.events()]
+    assert ('serve.remediation', 'suppressed_budget') in names
+
+
+def test_flapping_alert_yields_one_migration(monkeypatch, tmp_path):
+    """Hysteresis: the same (rule, target) re-firing inside the window
+    cannot thrash replacements — one drain_migrate, the rest observed
+    as suppressed."""
+    fleet = FakeFleet([{'replica_id': 1,
+                        'status': serve_state.ReplicaStatus.READY,
+                        'endpoint': '10.0.0.1:80', 'role': None}])
+    eng = _engine(monkeypatch, tmp_path, fleet=fleet, lb=FakeLB(),
+                  hysteresis=3600)
+    for _ in range(4):
+        eng.on_slo_transition(_firing(target='svc/1'))
+        assert eng.join(10)
+    recs = eng.records()
+    executed = [r for r in recs if r['outcome'] == 'executed']
+    assert len(executed) == 1
+    assert executed[0]['action'] == 'drain_migrate'
+    assert all(r['outcome'] == 'suppressed_hysteresis'
+               for r in recs if r is not executed[0])
+    assert len([e for e in fleet.log if e[0] == 'launch']) == 1
+
+
+def test_records_persist_atomically(monkeypatch, tmp_path):
+    eng = _engine(monkeypatch, tmp_path, mode='observe',
+                  fleet=FakeFleet([{
+                      'replica_id': 1,
+                      'status': serve_state.ReplicaStatus.READY,
+                      'endpoint': '10.0.0.1:80', 'role': None}]))
+    eng.on_replica_dark(eng.fleet.replica(1))
+    path = tmp_path / 'remediations-svc.json'
+    data = json.loads(path.read_text())
+    assert data['version'] == 1
+    assert data['records'][0]['action'] == 'replace_replica'
+    assert data['records'][0]['outcome'] == 'observed'
+    # debug payload mirrors the same records.
+    payload = eng.debug_payload()
+    assert payload['enabled'] and payload['mode'] == 'observe'
+    assert payload['records'] == eng.records()
+
+
+def test_action_registry_is_consistent():
+    assert len(rem_lib.ACTIONS) == len(rem_lib.ACTION_NAMES)
+    assert 'noop_observe' in rem_lib.ACTION_NAMES
+    for a in rem_lib.ACTIONS:
+        assert a.doc
+
+
+# ---------------------------------------------------------------------------
+# trie pre-warm: advert digests -> token rows -> kv replay
+
+
+def _chain(trie, blocks, base_block=10):
+    nodes, parent = [], None
+    for i, blk in enumerate(blocks):
+        node = trie.commit(parent, tuple(blk), base_block + i)
+        assert node is not None
+        nodes.append(node)
+        parent = node
+    return nodes
+
+
+def test_blocktrie_resolve_chains_round_trip():
+    """resolve_chains inverts the advert: the digests a summary
+    publishes resolve back to exactly the token rows that were
+    committed (deepest first), and unknown digests resolve to
+    nothing."""
+    t = paged_lib.BlockTrie(2)
+    _chain(t, [(1, 2), (3, 4), (5, 6)], base_block=10)
+    _chain(t, [(7, 8)], base_block=20)
+    entries = t.summary(16)['entries']
+    digests = [bytes.fromhex(h) for h, _ in entries]
+    rows = t.resolve_chains(digests)
+    assert sorted(rows.values(), key=len, reverse=True)[0] == \
+        [1, 2, 3, 4, 5, 6]
+    got = sorted(tuple(r) for r in rows.values())
+    assert (1, 2) in got and (7, 8) in got
+    assert t.resolve_chains([b'\x00' * 8]) == {}
+
+
+def test_prewarm_replays_chains_over_kv_path(monkeypatch, tmp_path):
+    """The engine's pre-warm drives the skytpu-kv/1 legs in order —
+    chains (victim) -> export (victim) -> prepare (successor) ->
+    fetch (victim) -> import (successor) — once per advert chain,
+    bounded by SKYTPU_REMEDIATE_PREWARM_CHAINS."""
+    calls = []
+
+    class FakeResp:
+        def __init__(self, payload, status=200, content=b''):
+            self._payload = payload
+            self.status_code = status
+            self.content = content
+
+        def json(self):
+            return self._payload
+
+    class FakeHTTP:
+        RequestException = requests_lib.RequestException
+
+        @staticmethod
+        def post(url, json=None, data=None, headers=None, timeout=None):
+            del headers, timeout
+            calls.append(('POST', url))
+            if url.endswith('/v1/kv/chains'):
+                return FakeResp({'chains': [[1, 2, 3, 4], [5, 6]]})
+            if url.endswith('/v1/kv/export'):
+                # 2, not 1: a max_new<=1 import short-circuits on the
+                # decode engine and never installs (or commits) the
+                # transferred blocks.
+                assert json['max_new_tokens'] == 2
+                return FakeResp({'handoff': f'h{len(calls)}',
+                                 'full_blocks': len(json['tokens']) // 2})
+            if url.endswith('/v1/kv/prepare'):
+                return FakeResp({'skip_blocks': 0})
+            if url.endswith('/v1/kv/import'):
+                assert data  # octet-stream bytes from fetch
+                return FakeResp({'imported': True})
+            raise AssertionError(url)
+
+        @staticmethod
+        def get(url, params=None, timeout=None):
+            del timeout
+            calls.append(('GET', url))
+            assert '/v1/kv/fetch' in url
+            assert params['skip_blocks'] == '0'
+            return FakeResp(None, content=b'kv-bytes')
+
+    monkeypatch.setattr(rem_lib, 'requests_lib', FakeHTTP)
+    monkeypatch.setenv('SKYTPU_REMEDIATE_PREWARM_CHAINS', '8')
+    eng = _engine(monkeypatch, tmp_path, mode='observe')
+    advert = {'entries': [['aa' * 8, 2], ['bb' * 8, 1]]}
+    installed = eng.prewarm('10.0.0.1:80', '10.0.0.2:80', advert)
+    assert installed == 2
+    # Victim answered chains/export/fetch; successor prepare/import.
+    assert ('POST', 'http://10.0.0.1:80/v1/kv/chains') == calls[0]
+    assert sum(1 for m, u in calls if u.endswith('/v1/kv/import')
+               and '10.0.0.2' in u) == 2
+    assert all('10.0.0.1' in u for m, u in calls
+               if '/v1/kv/export' in u or '/v1/kv/fetch' in u)
+    # Bound respected: a 1-chain budget stops after one digest.
+    calls.clear()
+    monkeypatch.setenv('SKYTPU_REMEDIATE_PREWARM_CHAINS', '1')
+    eng.prewarm('10.0.0.1:80', '10.0.0.2:80', advert)
+    chains_call = [u for m, u in calls if u.endswith('/v1/kv/chains')]
+    assert chains_call  # asked with exactly the bounded digest list
+
+
+def test_prewarm_survives_dead_victim(monkeypatch, tmp_path):
+    """Every pre-warm leg is best-effort: a victim that cannot answer
+    yields 0 installed chains, never an exception (a partially warmed
+    successor must still come up)."""
+
+    class DeadHTTP:
+        RequestException = requests_lib.RequestException
+
+        @staticmethod
+        def post(url, **kw):
+            raise requests_lib.RequestException('dead')
+
+        @staticmethod
+        def get(url, **kw):
+            raise requests_lib.RequestException('dead')
+
+    monkeypatch.setattr(rem_lib, 'requests_lib', DeadHTTP)
+    eng = _engine(monkeypatch, tmp_path, mode='observe')
+    assert eng.prewarm('10.0.0.1:80', '10.0.0.2:80',
+                       {'entries': [['aa' * 8, 1]]}) == 0
+
+
+# ---------------------------------------------------------------------------
+# satellite: terminate_replica(after_drain=...) ordering regression
+
+
+def test_terminate_replica_after_drain_runs_before_teardown(
+        monkeypatch, tmp_state_dir):
+    """The drain-wait callback must run AFTER the replica is marked
+    SHUTTING_DOWN (controller stops routing) and BEFORE core.down
+    (the process serving the drained streams dies last) — and a
+    raising callback must not block teardown."""
+    from skypilot_tpu.serve import replica_managers as rm
+    from skypilot_tpu.serve.replica_managers import ReplicaManager
+    from skypilot_tpu.serve.service_spec import ServiceSpec
+    from skypilot_tpu.task import Task
+
+    spec = ServiceSpec.from_yaml_config({
+        'port': 9000, 'replica_policy': {'min_replicas': 1}})
+    task = Task.from_yaml_config({'name': 'svc-drain', 'run': 'true'})
+    serve_state.add_service('svc-drain', spec.to_yaml_config(),
+                            task.to_yaml_config())
+    try:
+        mgr = ReplicaManager('svc-drain', spec, task)
+        serve_state.upsert_replica('svc-drain', 1,
+                                   serve_state.ReplicaStatus.READY,
+                                   endpoint='127.0.0.1:1')
+        order = []
+        monkeypatch.setattr(rm.core, 'down',
+                            lambda name: order.append(('down', name)))
+
+        def after_drain():
+            rep = [r for r in serve_state.list_replicas('svc-drain')
+                   if r['replica_id'] == 1][0]
+            order.append(('drain', rep['status']))
+
+        mgr.terminate_replica(1, failed=False, after_drain=after_drain)
+        assert [e[0] for e in order] == ['drain', 'down']
+        assert order[0][1] == serve_state.ReplicaStatus.SHUTTING_DOWN
+        assert not [r for r in serve_state.list_replicas('svc-drain')
+                    if r['replica_id'] == 1]
+
+        # A raising drain-wait still tears down.
+        serve_state.upsert_replica('svc-drain', 2,
+                                   serve_state.ReplicaStatus.READY)
+        order.clear()
+
+        def bad_drain():
+            raise RuntimeError('drain timed out')
+
+        mgr.terminate_replica(2, failed=False, after_drain=bad_drain)
+        assert order and order[0][0] == 'down'
+    finally:
+        serve_state.remove_service('svc-drain')
+
+
+# ---------------------------------------------------------------------------
+# mid-stream resume: greedy token parity through a real LB
+
+
+class _FakeReplicaHandler(http.server.BaseHTTPRequestHandler):
+    """A /generate NDJSON streamer with deterministic 'greedy' output
+    (tokens are a pure function of the prompt). The shared rig flag
+    makes exactly one request die mid-stream after 3 tokens."""
+
+    rig = None  # {'die_once': bool, 'lock': Lock}
+
+    def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
+        n = int(self.headers.get('Content-Length', 0))
+        body = json.loads(self.rfile.read(n))
+        row = body['tokens'][0] if isinstance(body['tokens'][0], list) \
+            else body['tokens']
+        out = [t + 100 for t in row][:8]
+        self.send_response(200)
+        self.send_header('Content-Type', 'application/x-ndjson')
+        self.end_headers()
+        with self.rig['lock']:
+            die = self.rig['die_once']
+            if die:
+                self.rig['die_once'] = False
+        sent = 0
+        for tok in out:
+            self.wfile.write(json.dumps(
+                {'row': 0, 'tokens': [tok]}).encode() + b'\n')
+            self.wfile.flush()
+            sent += 1
+            if die and sent == 3:
+                # Mid-stream death: close without the done marker.
+                self.connection.close()
+                return
+        self.wfile.write(json.dumps(
+            {'done': True, 'row': 0}).encode() + b'\n')
+
+    def log_message(self, *a):
+        del a
+
+
+def _start_fake_replica(rig, port):
+    handler = type('H', (_FakeReplicaHandler,), {'rig': rig})
+    srv = http.server.ThreadingHTTPServer(('127.0.0.1', port), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f'127.0.0.1:{port}'
+
+
+def test_drain_resume_token_parity():
+    """A replica dying mid-greedy-stream behind the LB: the client
+    still receives the FULL token sequence exactly once — the resume
+    leg re-serves the request on the survivor and skips the
+    already-delivered prefix (the drain-migrate in-flight guarantee)."""
+    rig = {'die_once': True, 'lock': threading.Lock()}
+    srv_a, ep_a = _start_fake_replica(
+        rig, common_utils.find_free_port(24810))
+    srv_b, ep_b = _start_fake_replica(
+        rig, common_utils.find_free_port(24830))
+    lb = LoadBalancer(common_utils.find_free_port(24850))
+    lb.set_replicas([ep_a, ep_b])
+    lb.start_in_thread()
+    try:
+        prompt = [1, 2, 3, 4]
+        want = [t + 100 for t in prompt][:8]
+        r = requests_lib.post(
+            f'http://127.0.0.1:{lb.port}/generate',
+            json={'tokens': [prompt], 'stream': True,
+                  'temperature': 0.0, 'max_new_tokens': 8},
+            stream=True, timeout=60)
+        assert r.status_code == 200
+        got, done = [], False
+        for line in r.iter_lines():
+            if not line:
+                continue
+            obj = json.loads(line)
+            assert 'error' not in obj, obj
+            if obj.get('done'):
+                done = True
+                break
+            got.extend(obj.get('tokens') or [])
+        assert done
+        assert got == want  # full parity: no gap, no duplicate
+        assert lb.disagg_stats['resumed_streams'] == 1
+        assert rig['die_once'] is False  # the victim really died
+    finally:
+        lb.stop()
+        srv_a.shutdown()
+        srv_b.shutdown()
+
+
+def test_lb_drain_coordination_counts_and_filters():
+    """begin_drain removes the endpoint from routing immediately and
+    set_replicas cannot re-add it until end_drain; wait_drained
+    reflects the in-flight counter."""
+    lb = LoadBalancer(0)
+    lb.set_replicas(['a:1', 'b:1'])
+    lb._track_start('a:1')
+    lb.begin_drain('a:1')
+    assert 'a:1' not in lb.policy.replicas
+    # Controller re-push mid-drain must not resurrect the victim.
+    lb.set_replicas(['a:1', 'b:1'])
+    assert 'a:1' not in lb.policy.replicas
+    assert lb.inflight('a:1') == 1
+    assert lb.wait_drained('a:1', timeout_s=0.2, poll_s=0.05) is False
+    lb._track_end('a:1')
+    assert lb.wait_drained('a:1', timeout_s=1.0, poll_s=0.05) is True
+    lb.end_drain('a:1')
+    lb.set_replicas(['a:1', 'b:1'])
+    assert 'a:1' in lb.policy.replicas

@@ -122,6 +122,27 @@ stops and the queue drains, and greedy output must be byte-identical
 between an SKYTPU_SLO=1 and an SKYTPU_SLO=0 replica (and unchanged on
 the degraded replica after recovery). CPU-only, wired into
 ``make verify``.
+
+``--heal`` runs the self-healing remediation gate
+(serve/remediation.py) over real OS-process replicas sharing one
+persistent compile cache behind a real LB, with the RemediationEngine
+driven exactly as the controller drives it (fleet adapter + LB drain
+seam + slo transition hook): greedy byte parity SKYTPU_REMEDIATE=off
+vs =observe (observe journals the decision without touching the
+fleet); a kill -9 of a loaded replica mid-greedy-stream → the engine
+claims the replacement, the in-flight stream resumes on the survivor
+with FULL token parity (no gap, no duplicate), and the successor boots
+warm (compile_cache.warm=true, ZERO post-READY compiles on the warmed
+mix); an injected queue-burn SLO firing scoped to one replica → a
+drain-migrate whose successor's BlockTrie is pre-warmed from the
+victim's affinity advert through the skytpu-kv/1 chains→export→import
+path (nonzero trie hit on the successor's FIRST matching request,
+victim drained through the LB before termination); every executed
+action leaves a retained stitched trace and a /debug/remediations
+record whose phase timings sum exactly to its wall; and with the
+token-bucket budget exhausted the next trigger downgrades to
+``noop_observe`` while the fleet keeps serving byte-identical output.
+CPU-only, wired into ``make verify``.
 """
 import json
 import os
@@ -740,14 +761,17 @@ def goodput_probe() -> dict:
 
 def _spawn_replica(role: str, port: int, workdir: str,
                    max_len: int, tag: str = None,
-                   extra_env: dict = None) -> 'subprocess.Popen':
+                   extra_env: dict = None,
+                   extra_args: list = None) -> 'subprocess.Popen':
     """One OS-process tiny-model replica — the disagg gate is only
     honest when the prefill and decode engines live in DIFFERENT
     processes talking over localhost HTTP (no shared jit cache, no
     shared GIL, a real serialized payload on the wire). ``tag`` names
     the state dir/log when several replicas share a role (the blackbox
     gate runs multiple colocated replicas); ``extra_env`` overlays the
-    child env (e.g. SKYTPU_BLACKBOX=0 for the parity leg)."""
+    child env (e.g. SKYTPU_BLACKBOX=0 for the parity leg);
+    ``extra_args`` appends llm_server CLI flags (e.g. --kv-blocks for
+    the heal gate's pre-warm capacity)."""
     import subprocess
     tag = tag or role
     env = dict(os.environ)
@@ -776,7 +800,8 @@ def _spawn_replica(role: str, port: int, workdir: str,
         [sys.executable, '-m', 'skypilot_tpu.serve.llm_server',
          '--model', 'tiny', '--max-len', str(max_len),
          '--kv-layout', 'paged', '--role', role,
-         '--host', '127.0.0.1', '--port', str(port)],
+         '--host', '127.0.0.1', '--port', str(port)]
+        + list(extra_args or ()),
         cwd=_REPO_ROOT, env=env, stdout=log, stderr=log)
     # Give the prefill replica its own core and keep the serving
     # replicas off it: on a real fleet each replica owns its host and
@@ -2471,6 +2496,471 @@ def coldstart_probe() -> dict:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def heal_probe() -> dict:
+    """Self-healing remediation gate (serve/remediation.py) — see the
+    module docstring's ``--heal`` entry for the leg list. The probe
+    process hosts the LB thread and the RemediationEngine; replicas
+    are real OS processes sharing one persistent compile cache, so a
+    successor launched by a playbook boots warm exactly the way a
+    fleet replacement does."""
+    import dataclasses as dataclasses_lib
+    import shutil
+    import tempfile
+    import threading
+
+    import requests as requests_lib
+
+    from skypilot_tpu.observability import blackbox
+    from skypilot_tpu.observability import slo
+    from skypilot_tpu.observability import trace as trace_lib
+    from skypilot_tpu.serve import loadgen
+    from skypilot_tpu.serve import remediation as rem_lib
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.serve.load_balancer import LoadBalancer
+    from skypilot_tpu.utils import common_utils
+
+    max_len = 256
+    workdir = tempfile.mkdtemp(prefix='skytpu-heal-')
+    cache_dir = os.path.join(workdir, 'compile-cache')
+    os.environ['SKYTPU_BLACKBOX_DIR'] = os.path.join(workdir, 'spool')
+    blackbox.reset()
+    # Safety-ladder knobs pinned for the gate: no cooldown/hysteresis
+    # (each leg is a distinct trigger key and the probe IS the flap
+    # guard), budget capacity 2 — exactly the two acting legs, so the
+    # final leg exercises exhaustion deterministically.
+    os.environ['SKYTPU_REMEDIATE_COOLDOWN_S'] = '0'
+    os.environ['SKYTPU_REMEDIATE_HYSTERESIS_S'] = '0'
+    os.environ['SKYTPU_REMEDIATE_MAX_PER_H'] = '2'
+    os.environ.pop('SKYTPU_REMEDIATE', None)
+    os.environ.pop('SKYTPU_METRICS_TOKEN', None)
+    # Single-slot replicas: the hammer leg needs one slot to hold a
+    # deep queue (slo_probe's rationale), and the kill leg's victim
+    # carries exactly the probe's own stream.
+    base_env = {'SKYTPU_PROFILE': '1', 'SKYTPU_WARMUP': '1',
+                'SKYTPU_COMPILE_CACHE': cache_dir,
+                'SKYTPU_LLM_SLOTS': '1'}
+    lb = LoadBalancer(common_utils.find_free_port(26700))
+
+    def row(n, salt):
+        return [(5 * i + 13 * salt) % 240 + 1 for i in range(n)]
+
+    def health(ep):
+        return requests_lib.get(f'http://{ep}/health',
+                                timeout=30).json()
+
+    class ProbeFleet:
+        """The perf-probe fleet adapter: same seam ManagerFleet fills
+        for the controller, but launch = _spawn_replica OS processes
+        and READY = the replica's own first 200 /health (the probe
+        plays the controller's probe loop). wait_ready pushes the
+        routing set into the LB the way the controller tick does."""
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._next = 1
+            self.reps = {}  # rid -> {'proc','endpoint','status',...}
+
+        def launch(self, role=None):
+            with self._lock:
+                rid = self._next
+                self._next += 1
+            port = common_utils.find_free_port(26720 + 20 * rid)
+            # 64-block pool (vs the 17-block single-slot default): the
+            # pre-warm replays up to 8 chains — the successor's cache
+            # must HOLD them past the replay, or the migrated tenant's
+            # first request measures eviction, not the handoff.
+            proc = _spawn_replica('colocated', port, workdir, max_len,
+                                  tag=f'r{rid}', extra_env=base_env,
+                                  extra_args=['--kv-blocks', '64'])
+            with self._lock:
+                self.reps[rid] = {
+                    'replica_id': rid, 'proc': proc,
+                    'endpoint': f'127.0.0.1:{port}',
+                    'status': serve_state.ReplicaStatus.STARTING,
+                    'created_at': time.time(), 'role': None}
+            return rid
+
+        def replicas(self):
+            with self._lock:
+                return [dict(r) for r in self.reps.values()]
+
+        def replica(self, rid):
+            with self._lock:
+                r = self.reps.get(rid)
+                return dict(r) if r else None
+
+        def endpoint(self, rid):
+            rep = self.replica(rid)
+            return rep['endpoint'] if rep else None
+
+        def advert(self, rid):
+            """Live /health trie summary — the drain-migrate victim is
+            alive when the playbook snapshots its advert."""
+            ep = self.endpoint(rid)
+            if ep is None:
+                return None
+            try:
+                summary = health(ep).get('prefix_summary')
+            except (requests_lib.RequestException, ValueError):
+                return None
+            return summary if isinstance(summary, dict) else None
+
+        def wait_ready(self, rid, timeout_s=300.0):
+            rep = self.replica(rid)
+            if rep is None:
+                return None
+            deadline = time.time() + timeout_s
+            while time.time() < deadline:
+                if rep['proc'].poll() is not None:
+                    return None
+                try:
+                    requests_lib.get(
+                        f"http://{rep['endpoint']}/health",
+                        timeout=5).raise_for_status()
+                    break
+                except requests_lib.RequestException:
+                    time.sleep(0.3)
+            else:
+                return None
+            with self._lock:
+                self.reps[rid]['status'] = \
+                    serve_state.ReplicaStatus.READY
+            self.push_routing()
+            return rep['endpoint']
+
+        def terminate(self, rid, failed=False, after_drain=None):
+            rep = self.replica(rid)
+            if after_drain is not None:
+                try:
+                    after_drain()
+                except Exception:  # noqa: BLE001 — mirror the manager
+                    pass
+            if rep is not None and rep['proc'].poll() is None:
+                rep['proc'].kill()
+                rep['proc'].wait(timeout=60)
+            with self._lock:
+                self.reps.pop(rid, None)
+            self.push_routing()
+
+        def push_routing(self):
+            with self._lock:
+                eps = [r['endpoint'] for r in self.reps.values()
+                       if r['status'] == serve_state.ReplicaStatus.READY]
+            lb.set_replicas(eps)
+
+        def kill_processes(self):
+            with self._lock:
+                procs = [r['proc'] for r in self.reps.values()]
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.kill()
+
+    fleet = ProbeFleet()
+    eng = rem_lib.RemediationEngine(
+        'heal', fleet=fleet, lb=lb,
+        state_dir=os.path.join(workdir, 'state'))
+    lb.remediation_payload = eng.debug_payload
+    stop_hammer = threading.Event()
+    hammer_threads = []
+    try:
+        r1, r2 = fleet.launch(), fleet.launch()
+        assert fleet.wait_ready(r1) and fleet.wait_ready(r2), \
+            f'seed replicas never became healthy; see {workdir}'
+        lb.start_in_thread()
+        lb_url = f'http://127.0.0.1:{lb.port}'
+
+        # --- (e) byte parity: SKYTPU_REMEDIATE=off vs =observe ----------
+        parity_payload = {'tokens': [row(24, 3)], 'max_new_tokens': 24}
+        want = requests_lib.post(
+            f"http://{fleet.endpoint(r1)}/generate",
+            json=parity_payload, timeout=600)
+        assert want.status_code == 200, want.text
+        want = want.json()
+        live_rep = fleet.replica(r1)
+        assert rem_lib.mode() == 'off'
+        assert eng.on_replica_dark(live_rep) is False
+        eng.step()
+        assert eng.records() == [], 'off mode must journal nothing'
+        off_out = requests_lib.post(f'{lb_url}/generate',
+                                    json=parity_payload, timeout=600)
+        assert off_out.status_code == 200 and off_out.json() == want, \
+            'LB output diverged with the engine off'
+        os.environ['SKYTPU_REMEDIATE'] = 'observe'
+        assert eng.on_replica_dark(live_rep) is False, \
+            'observe mode must never claim the replacement'
+        obs = eng.records()[-1]
+        assert obs['action'] == 'replace_replica' and \
+            obs['outcome'] == 'observed', obs
+        assert fleet.replica(r1)['proc'].poll() is None, \
+            'observe mode touched the fleet'
+        assert eng.budget_remaining() == pytest_approx(2.0), \
+            ('dry runs must refund their budget token',
+             eng.budget_remaining())
+        obs_out = requests_lib.post(f'{lb_url}/generate',
+                                    json=parity_payload, timeout=600)
+        assert obs_out.status_code == 200 and obs_out.json() == want, \
+            'SKYTPU_REMEDIATE=off vs =observe greedy outputs differ'
+
+        # --- (a) kill -9 of a loaded replica: stream resume + warm
+        # successor ------------------------------------------------------
+        os.environ['SKYTPU_REMEDIATE'] = 'act'
+        stream_payload = {'tokens': [row(20, 5)], 'stream': True,
+                          'temperature': 0.0, 'max_new_tokens': 160}
+        got, stream_done = [], threading.Event()
+
+        def stream_client():
+            with requests_lib.post(f'{lb_url}/generate',
+                                   json=stream_payload, stream=True,
+                                   timeout=600) as r:
+                assert r.status_code == 200, r.text
+                for line in r.iter_lines():
+                    if not line:
+                        continue
+                    obj = json.loads(line)
+                    assert 'error' not in obj, obj
+                    if obj.get('done'):
+                        stream_done.set()
+                        return
+                    got.extend(obj.get('tokens') or [])
+
+        # Pin the stream onto a KNOWN victim (the controller-push seam:
+        # route only r1 while the stream starts, then restore the full
+        # set so the resume has a survivor to land on), and kill the
+        # moment the first chunk reaches the client — mid-stream by
+        # construction, no health-poll race against a fast decode.
+        victim, survivor = r1, r2
+        lb.set_replicas([fleet.endpoint(victim)])
+        client = threading.Thread(target=stream_client, daemon=True)
+        client.start()
+        deadline = time.time() + 120
+        while not got and not stream_done.is_set() \
+                and time.time() < deadline:
+            time.sleep(0.01)
+        fleet.push_routing()  # survivor back in the set for the resume
+        assert got and not stream_done.is_set(), \
+            'stream finished before the probe could kill its replica'
+        vic_rep = fleet.replica(victim)
+        vic_rep['proc'].kill()  # SIGKILL: preemption-shaped, no goodbye
+        vic_rep['proc'].wait(timeout=60)
+        # The replica-manager probe loop notices the dark replica and
+        # offers it to the engine; act mode must CLAIM the replacement.
+        assert eng.on_replica_dark(vic_rep) is True, \
+            'act mode must claim the dead-replica replacement'
+        client.join(timeout=600)
+        assert stream_done.is_set(), 'stream never completed'
+        direct = []
+        with requests_lib.post(
+                f"http://{fleet.endpoint(survivor)}/generate",
+                json=stream_payload, stream=True, timeout=600) as r:
+            r.raise_for_status()
+            for line in r.iter_lines():
+                if not line:
+                    continue
+                obj = json.loads(line)
+                if obj.get('done'):
+                    break
+                direct.extend(obj.get('tokens') or [])
+        assert got and got == direct, \
+            ('resumed stream lost or duplicated tokens',
+             len(got), len(direct))
+        assert lb.disagg_stats['resumed_streams'] >= 1, lb.disagg_stats
+        assert eng.join(600), 'replace_replica playbook never finished'
+        replaced = [rec for rec in eng.records()
+                    if rec['action'] == 'replace_replica'
+                    and rec['trigger'] == 'preemption'
+                    and rec['outcome'] == 'executed']
+        assert replaced, eng.records()
+        succ1 = replaced[-1]['successor']
+        succ1_ep = fleet.endpoint(succ1)
+        succ1_h = health(succ1_ep)
+        cc = succ1_h['compile_cache']
+        assert cc.get('enabled') and cc.get('warm'), (
+            'replacement booted cold — is the playbook inheriting the '
+            'compile-cache env?', cc)
+        assert succ1_h['warmup'].get('covered'), succ1_h['warmup']
+        # Zero post-READY compiles: replay the successor's own warmed
+        # bucket mix and require the compile ledger not to move.
+        before = loadgen.aggregate_profile_healths({succ1_ep: succ1_h})
+        for salt, bucket in enumerate(succ1_h['warmup']['buckets']):
+            for n in (bucket, max(bucket - 3, 1)):
+                requests_lib.post(
+                    f'http://{succ1_ep}/generate',
+                    json={'tokens': [row(n, 41 + salt)],
+                          'max_new_tokens': 4},
+                    timeout=600).raise_for_status()
+        window = loadgen.profile_window_delta(
+            before,
+            loadgen.aggregate_profile_healths({succ1_ep:
+                                               health(succ1_ep)}))
+        assert window['compiles'] == 0, (
+            'warm successor compiled post-READY', window)
+
+        # --- (b) queue-burn SLO firing → drain-migrate with trie
+        # pre-warm ---------------------------------------------------------
+        vic2 = survivor
+        vic2_ep = fleet.endpoint(vic2)
+        # The hot tenant: one long shared prefix that BOTH seeds the
+        # victim's BlockTrie AND rides every hammer request below, so
+        # it is by far the hottest advert entry — `prewarm` replays the
+        # advert hottest-first, and the migrated tenant's first request
+        # after the drain must hit exactly this chain on the successor.
+        tenant_prompt = row(96, 11) + row(8, 12)
+        seed_payload = {'tokens': [tenant_prompt],
+                        'max_new_tokens': 4, 'temperature': 0.0}
+        for _ in range(2):
+            requests_lib.post(f'http://{vic2_ep}/generate',
+                              json=seed_payload,
+                              timeout=600).raise_for_status()
+        assert (health(vic2_ep).get('prefix_summary')
+                or {}).get('entries'), \
+            'victim advert is empty — nothing to pre-warm from'
+        # Injected queue burn: the slo_probe's CI-scaled queue-depth
+        # rule over a real SloEngine wired to the remediation hook the
+        # way the controller wires it.
+        qrule = dataclasses_lib.replace(
+            next(r for r in slo.RULES if r.name == 'serve.queue_depth'),
+            threshold=3.0, fast_s=6.0, slow_s=120.0, fast_burn=0.5,
+            slow_burn=0.05)
+        os.environ['SKYTPU_SLO'] = '1'
+        sloeng = slo.SloEngine(
+            state_dir=os.path.join(workdir, 'slo-state'), rules=[qrule])
+        sloeng.add_transition_hook(eng.on_slo_transition)
+
+        def hammer():
+            # Same tenant prompt as the seed: the queue burn and the
+            # chain heat come from the same workload, like a real hot
+            # tenant would produce (104 prompt + 64 new <= max_len).
+            body = {'tokens': [tenant_prompt], 'max_new_tokens': 64}
+            while not stop_hammer.is_set():
+                try:
+                    requests_lib.post(f'http://{vic2_ep}/generate',
+                                      json=body, timeout=600)
+                except requests_lib.RequestException:
+                    time.sleep(0.2)
+
+        hammer_threads = [threading.Thread(target=hammer, daemon=True)
+                          for _ in range(6)]
+        for t in hammer_threads:
+            t.start()
+        samples, fired = [], False
+        deadline = time.time() + 120
+        while not fired and time.time() < deadline:
+            time.sleep(0.7)
+            samples.append({
+                'ts': time.time(),
+                'serve_replica_health': {
+                    f'heal/{vic2}':
+                        slo.replica_signal_fields(health(vic2_ep))}})
+            fired = any(tr['transition'] == 'firing'
+                        for tr in sloeng.tick(list(samples)))
+        assert fired, 'queue-depth page never fired under the hammer'
+        stop_hammer.set()
+        assert eng.join(600), 'drain_migrate playbook never finished'
+        for t in hammer_threads:
+            t.join(timeout=600)
+        migrated = [rec for rec in eng.records()
+                    if rec['action'] == 'drain_migrate'
+                    and rec['outcome'] == 'executed']
+        assert migrated, eng.records()
+        mig = migrated[-1]
+        assert mig['victim'] == vic2 and \
+            mig['trigger'] == 'slo:serve.queue_depth', mig
+        assert mig.get('prewarmed_chains', 0) >= 1, (
+            'successor trie was not pre-warmed from the advert', mig)
+        assert mig.get('drained') is True, mig
+        assert fleet.replica(vic2) is None, \
+            'drain-migrate left the victim running'
+        succ2_ep = fleet.endpoint(mig['successor'])
+        share0 = (health(succ2_ep)['engine'] or {})['prefix_share']
+        requests_lib.post(f'http://{succ2_ep}/generate',
+                          json=seed_payload,
+                          timeout=600).raise_for_status()
+        share1 = (health(succ2_ep)['engine'] or {})['prefix_share']
+        prewarm_hit_tokens = \
+            share1['hit_tokens'] - share0['hit_tokens']
+        assert share1['hits'] > share0['hits'] and \
+            prewarm_hit_tokens > 0, (
+            "successor's first matching request missed the pre-warmed "
+            'trie', share0, share1)
+
+        # --- (c) audit invariants: retained traces, /debug records,
+        # phase sums -------------------------------------------------------
+        executed = [rec for rec in eng.records()
+                    if rec['outcome'] == 'executed']
+        assert len(executed) >= 2, eng.records()
+        retained = set(trace_lib.retained_ids(limit=64))
+        for rec in executed:
+            assert rec.get('trace_id'), rec
+            assert rec['trace_id'] in retained, (
+                'executed action lost its audit trace', rec['id'],
+                rec['trace_id'])
+            phase_sum = sum(p['dt'] for p in rec['phases'])
+            assert abs(phase_sum - rec['wall_s']) <= 1e-3, (
+                'phase timings do not sum to the action wall',
+                rec['phases'], rec['wall_s'])
+            assert rec['wall_s'] > 0, rec
+        http_payload = requests_lib.get(
+            f'{lb_url}/debug/remediations', timeout=30).json()
+        assert http_payload['enabled'] and \
+            http_payload['mode'] == 'act', http_payload
+        by_id = {rec['id']: rec for rec in http_payload['records']}
+        for rec in executed:
+            assert by_id[rec['id']]['phases'] == rec['phases'], rec['id']
+        bb_names = [(e['attrs'].get('action'),
+                     e['attrs'].get('outcome'))
+                    for e in blackbox.events()
+                    if e['name'] == 'serve.remediation']
+        assert ('replace_replica', 'executed') in bb_names and \
+            ('drain_migrate', 'executed') in bb_names, bb_names
+
+        # --- (d) budget exhausted → observe-only, fleet keeps serving ---
+        assert eng.budget_remaining() < 1.0, eng.budget_remaining()
+        ghost = {'replica_id': 4242, 'endpoint': None, 'zone': None,
+                 'status': serve_state.ReplicaStatus.READY}
+        assert eng.on_replica_dark(ghost) is False, \
+            'budget-exhausted trigger must not claim the replacement'
+        last = eng.records()[-1]
+        assert last['action'] == 'noop_observe' and \
+            last['outcome'] == 'suppressed_budget' and \
+            last['intended'] == 'replace_replica', last
+        exhausted_out = requests_lib.post(
+            f'{lb_url}/generate', json=parity_payload, timeout=600)
+        assert exhausted_out.status_code == 200 and \
+            exhausted_out.json() == want, \
+            'fleet stopped serving under budget exhaustion'
+
+        return {
+            'parity': 'byte-identical (SKYTPU_REMEDIATE=off vs '
+                      '=observe, and post-exhaustion)',
+            'resumed_stream_tokens': len(got),
+            'resumed_streams': lb.disagg_stats['resumed_streams'],
+            'successor_warm': True,
+            'post_ready_compiles': window['compiles'],
+            'prewarmed_chains': mig['prewarmed_chains'],
+            'prewarm_hit_tokens': prewarm_hit_tokens,
+            'executed_actions': [(rec['action'], rec['trigger'])
+                                 for rec in executed],
+            'action_walls_s': {rec['action']: rec['wall_s']
+                               for rec in executed},
+            'retained_traces': len(retained),
+            'budget_remaining': eng.budget_remaining(),
+            'suppressed': last['outcome'],
+        }
+    finally:
+        stop_hammer.set()
+        for t in hammer_threads:
+            t.join(timeout=5)
+        for name in ('SKYTPU_REMEDIATE', 'SKYTPU_SLO',
+                     'SKYTPU_REMEDIATE_COOLDOWN_S',
+                     'SKYTPU_REMEDIATE_HYSTERESIS_S',
+                     'SKYTPU_REMEDIATE_MAX_PER_H'):
+            os.environ.pop(name, None)
+        eng.join(30)
+        fleet.kill_processes()
+        lb.stop()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def pytest_approx(x, rel=1e-3):
     """Tolerant float compare without importing pytest in the probe."""
     class _A:
@@ -2492,6 +2982,13 @@ def main():
         # or wait on a chip in CI.
         jax.config.update('jax_platforms', 'cpu')
         print(json.dumps({'coldstart_smoke': 'ok', **coldstart_probe()}),
+              flush=True)
+        return
+    if '--heal' in sys.argv:
+        # CPU-only by design (same rationale as --smoke): never touch
+        # or wait on a chip in CI.
+        jax.config.update('jax_platforms', 'cpu')
+        print(json.dumps({'heal_smoke': 'ok', **heal_probe()}),
               flush=True)
         return
     if '--affinity' in sys.argv:

@@ -1,6 +1,6 @@
 """Checker modules. Importing this package populates the registry."""
-from skylint.checkers import (alert_rules, base,  # noqa: F401
-                              concurrency, engine_thread, env_flags,
-                              event_names, host_sync, jit_programs,
-                              lock_discipline, metric_names, pycache,
-                              verdict_names)
+from skylint.checkers import (action_names, alert_rules,  # noqa: F401
+                              base, concurrency, engine_thread,
+                              env_flags, event_names, host_sync,
+                              jit_programs, lock_discipline,
+                              metric_names, pycache, verdict_names)

@@ -1,0 +1,182 @@
+"""Remediation action-name cross-check.
+
+Every self-healing action is declared exactly once, in
+``skypilot_tpu/serve/remediation.py``'s :data:`ACTIONS` registry (the
+``event-name`` / ``verdict-name`` bounded-vocabulary convention for
+the remediation plane). Consumers — the ``skytpu_remediation_total``
+gauge labels, the ``/debug/remediations`` audit log, the dashboard
+``#/remediation`` panel, the operator runbook — match actions BY NAME,
+so a typo'd action at a decision call site would journal an audit
+record no runbook row explains and ``record_action``'s assert would
+kill the worker thread at runtime. Two directions:
+
+* every string LITERAL passed as the action of a
+  ``.decide(...)`` / ``.record_action(...)`` call anywhere in the
+  tree must be a declared action name (did-you-mean on typos; dynamic
+  arguments are legal — the engine asserts them at runtime — so only
+  literals are validated). Escape hatch:
+  ``# skylint: allow-action(reason)`` on the call line;
+* every declared action must be documented in ``docs/operations.md``
+  (the Self-healing section's action registry table) — an
+  undocumented action is an audit record nobody can act on.
+  Duplicate declarations are findings too.
+"""
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import Dict, List, Optional, Sequence
+
+from skylint import Checker, Finding, SourceFile, register
+from skylint.checkers.event_names import _closest
+
+REGISTRY_REL = 'skypilot_tpu/serve/remediation.py'
+DOCS_REL = 'docs/operations.md'
+_ACTION_METHODS = ('decide', 'record_action')
+
+
+def _parse_registry(path: pathlib.Path) -> Dict[str, int]:
+    """{action name: lineno} from Action('name', ...) declarations."""
+    registry: Dict[str, int] = {}
+    tree = ast.parse(path.read_text(encoding='utf-8'),
+                     filename=str(path))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id == 'Action' and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            registry.setdefault(node.args[0].value,
+                                node.args[0].lineno)
+    return registry
+
+
+@register
+class ActionNames(Checker):
+
+    name = 'action-name'
+
+    def __init__(self):
+        self._registry: Optional[Dict[str, int]] = None
+        self._registry_error: Optional[str] = None
+
+    def _load_registry(self, root: pathlib.Path) -> Dict[str, int]:
+        if self._registry is not None:
+            return self._registry
+        self._registry = {}
+        path = root / REGISTRY_REL
+        if not path.is_file():
+            self._registry_error = f'{REGISTRY_REL} is missing'
+            return self._registry
+        try:
+            self._registry = _parse_registry(path)
+        except SyntaxError as e:
+            self._registry_error = f'{REGISTRY_REL}:{e.lineno}: {e.msg}'
+        return self._registry
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        if sf.tree is None:
+            return []
+        # Registry anchored at skylint.ROOT (this checkout) by design —
+        # fixture files in tmp dirs still check against the real one.
+        from skylint import ROOT
+        registry = self._load_registry(ROOT)
+        if self._registry_error or not registry:
+            return []  # reported once, in check_tree
+        out: List[Finding] = []
+        for node, arg in _action_calls(sf):
+            if arg is None:  # dynamic: runtime-asserted, not a finding
+                continue
+            if sf.suppression(node.lineno, 'allow-action'):
+                continue
+            if arg in registry:
+                continue
+            hint = _closest(arg, registry)
+            out.append(Finding(
+                sf.rel, node.lineno, self.name,
+                f'action {arg!r} is not declared in {REGISTRY_REL} '
+                'ACTIONS — the engine would assert at runtime and the '
+                'audit record would match no runbook row'
+                + (f' — did you mean {hint!r}?' if hint else '')
+                + ' (declare it, or # skylint: allow-action(reason))'))
+        return out
+
+    def check_tree(self, files: Sequence[SourceFile],
+                   root: pathlib.Path) -> List[Finding]:
+        del files
+        # Fresh parse against THIS root so fixture trees exercise the
+        # registry/docs legs independently of the checkout.
+        path = root / REGISTRY_REL
+        if not path.is_file():
+            return [Finding(REGISTRY_REL, 1, self.name,
+                            f'{REGISTRY_REL} is missing — no action '
+                            'registry to check')]
+        registry: Dict[str, int] = {}
+        duplicates: List[Finding] = []
+        try:
+            tree = ast.parse(path.read_text(encoding='utf-8'),
+                             filename=str(path))
+        except SyntaxError as e:
+            return [Finding(REGISTRY_REL, e.lineno or 1, self.name,
+                            f'action registry unreadable: {e.msg}')]
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id == 'Action' and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                aname = node.args[0].value
+                if aname in registry:
+                    duplicates.append(Finding(
+                        REGISTRY_REL, node.args[0].lineno, self.name,
+                        f'duplicate action {aname!r} (first declared '
+                        f'at line {registry[aname]})'))
+                registry.setdefault(aname, node.args[0].lineno)
+        if not registry:
+            return [Finding(REGISTRY_REL, 1, self.name,
+                            'no Action(...) declarations found — '
+                            'registry unreadable?')]
+        out = duplicates
+        docs_path = root / DOCS_REL
+        docs_text = (docs_path.read_text(encoding='utf-8')
+                     if docs_path.is_file() else '')
+        for aname, lineno in sorted(registry.items()):
+            if docs_text and f'`{aname}`' not in docs_text \
+                    and aname not in docs_text:
+                out.append(Finding(
+                    REGISTRY_REL, lineno, self.name,
+                    f'action {aname!r} is not documented in '
+                    f'{DOCS_REL} (Self-healing section action '
+                    'registry table) — an undocumented action is an '
+                    'audit record nobody can act on'))
+        return out
+
+
+def _action_calls(sf: SourceFile):
+    """Yield (call_node, action_literal_or_None) for every
+    ``<obj>.decide(...)`` / ``<obj>.record_action(...)`` call in this
+    file. Methods cannot be alias-resolved like module functions
+    (verdict_names), so this matches by attribute name — the names are
+    specific enough that any collision is a real vocabulary clash
+    worth an allow-action escape. The action is positional arg 0 or
+    the ``action=`` keyword."""
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute)
+                and fn.attr in _ACTION_METHODS):
+            continue
+        arg_node = None
+        if node.args:
+            arg_node = node.args[0]
+        for kw in node.keywords:
+            if kw.arg == 'action':
+                arg_node = kw.value
+        if arg_node is None:
+            continue
+        arg = None
+        if isinstance(arg_node, ast.Constant) and \
+                isinstance(arg_node.value, str):
+            arg = arg_node.value
+        yield node, arg
