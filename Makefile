@@ -24,7 +24,13 @@ ci:
 # the block-prefix-sharing gate (greedy byte parity sharing on vs off,
 # >= 40% fewer prefill tokens on an 80%-shared mix with CoW forks and
 # exact block-state reconciliation after drain, no decode regression
-# unshared, loadgen --shared-prefix hit rate nonzero), the tracing
+# unshared, loadgen --shared-prefix hit rate nonzero),
+# the hierarchical-KV-tier gate (tiers on vs off on an
+# eviction-pressure revisit mix: byte parity with strictly fewer
+# prefill tokens and lower revisit TTFT via host-DRAM re-import;
+# bit-flipped spill segments quarantine and degrade to recompute with
+# zero failed requests; off-device host/spilled counts reconcile with
+# the tier stats after drain), the tracing
 # gate (every sampled trace closes + nests, TTFT/queue-wait
 # histograms fill, greedy output byte-identical traced vs untraced),
 # the disaggregated-serving gate (two-process prefill/decode pair
@@ -76,6 +82,7 @@ verify:
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --smoke
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --qos
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --prefix
+	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --kvtier
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --trace
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --disagg
 	JAX_PLATFORMS=cpu $(PY) tools/perf_probe.py --affinity

@@ -400,6 +400,193 @@ def prefix_share_probe(assert_gates: bool = False) -> dict:
     return summary
 
 
+def kvtier_probe(assert_gates: bool = False) -> dict:
+    """Hierarchical KV memory gate (serve/kv_tiers.py: HBM -> host
+    DRAM -> spill segments, re-import instead of recompute) — shared
+    by ``bench.py`` (the ``kv_tiers`` detail entry) and
+    ``tools/perf_probe.py --kvtier`` (the CI gate, assert_gates=True).
+
+    Three legs, all CPU, tiny model, 4-usable-block pool so three
+    24-token heads cannot coexist in HBM (every revisit finds its
+    chain evicted):
+    (a) tiers ON vs OFF on identical revisit traffic: greedy outputs
+        byte-identical, promotes happened, the ON engine
+        prefill-computes strictly fewer prompt tokens, and mean
+        revisit TTFT is lower (re-import beats recompute; median of
+        3 attempts, same drift discipline as the decode smoke);
+    (b) injected corruption: with a 1-byte host pool everything
+        spills; every segment file gets a payload byte flipped, then
+        the revisits must STILL match the solo oracle byte-for-byte
+        with zero failed requests — corrupt chains quarantine and
+        recompute, never a 500;
+    (c) after a full drain the device block states reconcile exactly
+        and the off-device host/spilled counts match the tier
+        stats."""
+    import shutil
+    import statistics
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from skypilot_tpu.models import generate as gen_lib
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.models.engine import ContinuousEngine
+
+    cfg = llama.TINY
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    heads = [[((17 * h + j) % 250) + 1 for j in range(24)]
+             for h in range(3)]
+    _ENV = ('SKYTPU_KV_TIERS', 'SKYTPU_KV_HOST_BYTES',
+            'SKYTPU_KV_SPILL_DIR')
+
+    def _engine(**env):
+        saved = {k: os.environ.get(k) for k in _ENV}
+        for k in _ENV:
+            os.environ.pop(k, None)
+        os.environ.update(env)
+        try:
+            return ContinuousEngine(params, cfg, slots=4, max_len=64,
+                                    chunk_steps=2, kv_layout='paged',
+                                    kv_blocks=5)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def _timed(eng, row, n):
+        t0 = time.perf_counter()
+        ttft = []
+
+        def cb(_tokens):
+            if not ttft:
+                ttft.append(time.perf_counter() - t0)
+
+        out = eng.submit(row, n, on_tokens=cb).result(timeout=600)
+        return out, (ttft[0] if ttft else None)
+
+    def _leg(tiers_on, attempt):
+        eng = _engine(SKYTPU_KV_TIERS='1' if tiers_on else '0')
+        outs, ttfts = [], []
+        try:
+            # Pressure + one untimed revisit round: commits, evicts,
+            # demotes, and compiles the promote/import path so the
+            # timed rounds measure steady state.
+            for rnd in ('p', 'w'):
+                for i, h in enumerate(heads):
+                    tail = [((3 if rnd == 'p' else 29) * (attempt + 1)
+                             + 7 * i + j) % 250 + 1 for j in range(4)]
+                    outs.append(eng.submit(h + tail, 6)
+                                .result(timeout=600))
+            for rnd in range(3):
+                for i, h in enumerate(heads):
+                    tail = [(53 * attempt + 11 * rnd + 5 * i + j) % 250
+                            + 1 for j in range(4)]
+                    out, tt = _timed(eng, h + tail, 6)
+                    outs.append(out)
+                    if tt is not None:
+                        ttfts.append(tt)
+            if tiers_on:
+                assert eng._kv_tiers.quiesce(20)
+            stats = eng.stats()
+        finally:
+            eng.stop()
+        return outs, ttfts, stats
+
+    def _drained(stats):
+        kb = stats['kv_blocks']
+        tiers = stats.get('kv_tiers') or {}
+        return (kb['owned'] == 0 and kb['shared'] == 0
+                and kb['free'] + kb['cached'] == kb['usable']
+                and kb.get('host', 0) == (tiers.get('host_blocks') or 0)
+                and kb.get('spilled', 0)
+                == (tiers.get('spilled_blocks') or 0))
+
+    # (a) tiered vs untiered A/B, with TTFT drift retries.
+    attempts = []
+    for attempt in range(3):
+        on_outs, on_ttfts, on_stats = _leg(True, attempt)
+        off_outs, off_ttfts, off_stats = _leg(False, attempt)
+        attempts.append(round(statistics.mean(on_ttfts)
+                              / statistics.mean(off_ttfts), 3))
+        if on_outs == off_outs and attempts[-1] < 1.0:
+            break
+    tiers = on_stats['kv_tiers']
+    summary = {
+        'parity_ok': on_outs == off_outs,
+        'demotes': tiers['demotes'],
+        'promotes': tiers['promotes'],
+        'host_hits': tiers['host_hits'],
+        'prefill_tokens_on': on_stats['prefill_tokens'],
+        'prefill_tokens_off': off_stats['prefill_tokens'],
+        'ttft_ratio': attempts[-1],
+        'ttft_ratio_attempts': attempts,
+        'drain_reconciled': (_drained(on_stats)
+                            and _drained(off_stats)),
+    }
+
+    # (b) corruption -> quarantine + recompute, zero failed requests.
+    spill_dir = tempfile.mkdtemp(prefix='kvtier-probe-')
+    corrupt_parity = True
+    try:
+        eng = _engine(SKYTPU_KV_TIERS='1', SKYTPU_KV_HOST_BYTES='1',
+                      SKYTPU_KV_SPILL_DIR=spill_dir)
+        try:
+            for i, h in enumerate(heads):
+                row = h + [5 + i, 6, 7, 8]
+                ok = eng.submit(row, 6).result(timeout=600) == \
+                    gen_lib.generate(
+                        params, cfg, np.asarray([row], np.int32),
+                        max_new_tokens=6, max_len=64)[0].tolist()
+                corrupt_parity = corrupt_parity and ok
+            assert eng._kv_tiers.quiesce(20)
+            segs = [os.path.join(spill_dir, n)
+                    for n in os.listdir(spill_dir)
+                    if n.endswith('.seg')]
+            for path in segs:
+                with open(path, 'r+b') as f:
+                    f.seek(-1, os.SEEK_END)
+                    last = f.read(1)
+                    f.seek(-1, os.SEEK_END)
+                    f.write(bytes([last[0] ^ 0xFF]))
+            for i, h in enumerate(heads):
+                row = h + [9, 9, 9 + i]
+                ok = eng.submit(row, 6).result(timeout=600) == \
+                    gen_lib.generate(
+                        params, cfg, np.asarray([row], np.int32),
+                        max_new_tokens=6, max_len=64)[0].tolist()
+                corrupt_parity = corrupt_parity and ok
+            assert eng._kv_tiers.quiesce(20)
+            cstats = eng.stats()['kv_tiers']
+        finally:
+            eng.stop()
+    finally:
+        shutil.rmtree(spill_dir, ignore_errors=True)
+    summary['corruption'] = {
+        'segments_flipped': len(segs),
+        'parity_ok': corrupt_parity,
+        'spills': cstats['spills'],
+        'corrupt': cstats['corrupt'],
+        'quarantined': cstats['quarantined'],
+    }
+
+    if assert_gates:
+        assert summary['parity_ok'], 'tiering changed greedy output'
+        assert summary['promotes'] > 0 and summary['host_hits'] > 0, \
+            summary
+        assert summary['prefill_tokens_on'] \
+            < summary['prefill_tokens_off'], summary
+        assert summary['ttft_ratio'] < 1.0, summary
+        assert summary['drain_reconciled'], summary
+        c = summary['corruption']
+        assert c['segments_flipped'] > 0 and c['spills'] > 0, summary
+        assert c['parity_ok'], 'corrupt spill broke byte parity'
+        assert c['corrupt'] >= 1 and c['quarantined'] >= 1, summary
+    return summary
+
+
 def qos_overload_probe(assert_gates: bool = False) -> dict:
     """Deterministic 2x-overload probe for the QoS admission layer
     (serve/qos.py) — shared by ``bench.py`` (the ``qos_overload``
@@ -789,6 +976,13 @@ def _bench_tpu() -> dict:
         prefix_share = {'error': f'{type(exc).__name__}: '
                                  f'{str(exc)[:160]}'}
     try:
+        # Hierarchical KV tiers A/B: re-import vs recompute on evicted
+        # prefix chains, plus the corruption->quarantine contract.
+        kv_tiers = kvtier_probe()
+    except Exception as exc:  # secondary metric: never kill the bench
+        kv_tiers = {'error': f'{type(exc).__name__}: '
+                             f'{str(exc)[:160]}'}
+    try:
         # Checkpoint-stall A/B: what the step loop pays per save, sync
         # persist vs async snapshot (skypilot_tpu/ckpt/).
         checkpoint_stall = ckpt_stall_probe()
@@ -829,6 +1023,7 @@ def _bench_tpu() -> dict:
             'decode_variants': decode_variants,
             'qos_overload': qos_overload,
             'prefix_share': prefix_share,
+            'kv_tiers': kv_tiers,
             'checkpoint_stall': checkpoint_stall,
             'cpu_fallback': not on_tpu,
         },
@@ -917,7 +1112,7 @@ def finalize_result(result: dict, diagnostics: dict | None = None,
     line = render()
     # Progressive offload: if the line is still too big, move the
     # largest optional detail blocks to the sidecar, biggest first.
-    for key in ('sweep', 'qos_overload', 'prefix_share',
+    for key in ('sweep', 'qos_overload', 'prefix_share', 'kv_tiers',
                 'decode_variants', 'checkpoint_stall',
                 'probe_diagnostics'):
         if len(line.encode()) <= MAX_ARTIFACT_BYTES:

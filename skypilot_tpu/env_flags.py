@@ -244,6 +244,25 @@ FLAGS: Tuple[Flag, ...] = (
     Flag('SKYTPU_PREFIX_AFFINITY_MAX_BLOCKS', 'int', '32',
          'Leading full prompt blocks hashed per request for affinity '
          'matching.'),
+    # -- serving: hierarchical KV memory (HBM -> host -> bucket) ------
+    Flag('SKYTPU_KV_TIERS', 'bool', '1',
+         'Tiered KV memory on the paged engine (serve/kv_tiers.py): '
+         'trie eviction demotes refcount-zero chains to a host-DRAM '
+         'pool and re-imports them on a later match instead of '
+         'recomputing; requires prefix sharing, 0 = evictions '
+         'discard as before.'),
+    Flag('SKYTPU_KV_HOST_BYTES', 'int', '268435456',
+         'Host-DRAM pool capacity for demoted KV chains (serialized '
+         'bytes); past it the decayed-hotness LRU spills cold entries '
+         'to the spill dir, or drops them when none is set.'),
+    Flag('SKYTPU_KV_SPILL_DIR', 'path', None,
+         'Bucket/mirror directory for spilled KV segment files '
+         '(range-readable, crc32 per block, tmp-write+rename); unset '
+         '= host-pool overflow is dropped, not spilled.'),
+    Flag('SKYTPU_KV_FETCH_MAX', 'int', '2',
+         'Max concurrent background spill-segment fetch jobs; at the '
+         'bound a cold-chain admission degrades to recompute instead '
+         'of parking.'),
     # -- serving: disaggregated prefill/decode ------------------------
     Flag('SKYTPU_DISAGG_STAGING', 'path', None,
          'Shared staging dir for same-host KV handoffs (payload moves '

@@ -104,6 +104,10 @@ from skypilot_tpu.observability import blackbox
 # wrappers are passthroughs; on, the steady-state cost is two
 # thread-local writes per dispatch — skylint host-sync stays clean.
 from skypilot_tpu.observability.profiler import profiled_jit
+# Tier promote/demote spans for the trace waterfall; add_span is a
+# retroactive ring append — no I/O on the engine thread.
+from skypilot_tpu.observability import trace as trace_lib
+from skypilot_tpu.utils import prefix_affinity as affinity_lib
 
 # -- persistent XLA compilation cache (cold-start collapse) ------------------
 
@@ -190,6 +194,10 @@ class _Request:
     # serializes it; paged exports gather from the pool instead.
     export: bool = False
     export_src: Optional[tuple] = None
+    # Hierarchical KV tiers (serve/kv_tiers.py): how many times this
+    # request has parked on a background spill fetch — bounded so a
+    # pathological spill state degrades to recompute, never a loop.
+    tier_parks: int = 0
 
 
 @dataclasses.dataclass
@@ -535,6 +543,7 @@ class ContinuousEngine:
         '_pending': '_lock', '_pending_imports': '_lock',
         '_admitting': '_lock', '_prefilling': '_lock',
         '_unfetched': '_lock', '_slot_req': '_lock',
+        '_tier_waiting': '_lock',
         'prefills': '_lock', 'prefill_groups': '_lock',
         'prefill_chunks': '_lock', 'prefix_hits': '_lock',
         'prefix_hit_tokens': '_lock', 'prefix_stores': '_lock',
@@ -569,6 +578,7 @@ class ContinuousEngine:
                  kv_block: Optional[int] = None,
                  pipeline: Optional[bool] = None,
                  prefix_share: Optional[bool] = None,
+                 kv_tiers: Optional[bool] = None,
                  role: Optional[str] = None):
         # Defensive for embedded users; the serving entrypoint already
         # enabled it before the backend initialized (first lowering
@@ -768,6 +778,25 @@ class ContinuousEngine:
         # junk over real KV (same clamping hazard as chunked prefill).
         self._submit_max = self.max_len - (
             self.spec_k + 1 if self.draft_cfg is not None else 0)
+        # HIERARCHICAL KV TIERS (serve/kv_tiers.py; default ON where
+        # the share trie runs): evicted refcount-zero chains DEMOTE to
+        # a bounded host-DRAM pool instead of being discarded, cold
+        # host entries SPILL to SKYTPU_KV_SPILL_DIR segment files, and
+        # _admit consults the tier index before declaring a miss — a
+        # demoted chain re-imports (jit_import_blocks) instead of
+        # recomputing its prefill. Host/spill state lives entirely off
+        # device; a corrupt entry quarantines and the request
+        # recomputes, so tiering can never fail a request.
+        if kv_tiers is None:
+            kv_tiers = os.environ.get('SKYTPU_KV_TIERS', '1') != '0'
+        self._kv_tiers = None
+        if kv_tiers and self.prefix_share:
+            from skypilot_tpu.serve import kv_tiers as kv_tiers_lib
+            self._kv_tiers = kv_tiers_lib.KVTiers.from_env(
+                cfg, self.kv_block, quantized=self.kv_quantize)
+        # Requests parked on a background spill->host fetch; the fetch
+        # completion re-queues them at the head of _pending.
+        self._tier_waiting: List[_Request] = []
         self._init_device_state()
         self._slot_req: List[Optional[_Request]] = [None] * self.slots
         self._pending: collections.deque = collections.deque()
@@ -957,11 +986,18 @@ class ContinuousEngine:
         remediation pre-warm path asks the VICTIM to resolve its own
         last affinity advert back to concrete prompts, then replays
         them through the skytpu-kv/1 export/import path so the
-        successor's trie starts hot. Empty when sharing is off."""
+        successor's trie starts hot. Empty when sharing is off. With
+        hierarchical tiers on, digests the trie no longer holds resolve
+        from the host/spill index too — a drain-migrate carries the
+        long tail, not just the HBM-hot head."""
         if self._trie is None:
             return []
         with self._lock:
             rows = self._trie.resolve_chains(digests)
+        if self._kv_tiers is not None:
+            missing = [d for d in digests if d not in rows]
+            if missing:
+                rows.update(self._kv_tiers.resolve_rows(missing))
         return sorted(rows.values(), key=len, reverse=True)
 
     def prefix_summary(self) -> Optional[dict]:
@@ -969,11 +1005,22 @@ class ContinuousEngine:
         routing (``BlockTrie.summary``), or None when sharing is off.
         Shipped in the /health body (serve/llm_server.py) and pushed by
         the controller into the LB's ``PrefixAffinityPolicy`` the same
-        way queue pressure is."""
+        way queue pressure is. Tier-resident chains ride along as
+        3-element ``[chain_hex, depth, tier]`` rows (1 = host, 2 =
+        spilled; plain 2-element rows stay HBM) so the LB can prefer
+        HBM over host over bucket over recompute."""
         if self._trie is None:
             return None
         with self._lock:
-            return self._trie.summary(self._summary_max)
+            summ = self._trie.summary(self._summary_max)
+        if self._kv_tiers is not None:
+            have = {e[0] for e in summ['entries']}
+            room = self._summary_max - len(summ['entries'])
+            extra, trunc = self._kv_tiers.advert_entries(room, have)
+            summ['entries'].extend(extra)
+            summ['truncated'] = bool(summ['truncated'] or trunc)
+            summ['tiers'] = True
+        return summ
 
     def _build_request(self, row, max_new, temperature, on_tokens,
                        top_k, top_p, eos, export: bool = False
@@ -1036,6 +1083,12 @@ class ContinuousEngine:
     def stop(self) -> None:
         self._stop = True
         self._wake.set()
+        if self._kv_tiers is not None:
+            # Tier worker first: a fetch completing after the loop
+            # thread dies would re-queue its parked requests into a
+            # _pending nobody drains — stopping the worker makes the
+            # _tier_waiting sweep below authoritative.
+            self._kv_tiers.stop()
         if self._thread is not None:
             self._thread.join(timeout=10)
             if self._thread.is_alive():
@@ -1047,7 +1100,7 @@ class ContinuousEngine:
         with self._lock:
             live = bool(self._pending or self._pending_imports
                         or self._admitting or self._prefilling
-                        or self._unfetched
+                        or self._unfetched or self._tier_waiting
                         or any(r is not None for r in self._slot_req))
         if live:
             self._fail_everything(RuntimeError('engine stopped'))
@@ -1067,6 +1120,13 @@ class ContinuousEngine:
                 if self._trie is not None:
                     shared_blocks = self._trie.referenced
                     cached_blocks = self._trie.reclaimable
+            # Tier snapshot in the SAME critical section as the pool
+            # states (lock order engine -> tiers): host/spilled must
+            # agree with the kv_tiers block they summarize.
+            tier_stats = None
+            if self._kv_tiers is not None:
+                tier_stats = self._kv_tiers.stats()
+                tier_stats['waiting'] = len(self._tier_waiting)
             # skylint finding (guarded-by): this return used to sit
             # OUTSIDE the with-block — every counter below was read
             # unlocked while the engine thread bumps them, so /health
@@ -1106,7 +1166,19 @@ class ContinuousEngine:
                     'owned': owned_blocks,
                     'shared': shared_blocks,
                     'cached': cached_blocks,
+                    # Hierarchical tiers (serve/kv_tiers.py): block
+                    # counts held OFF-DEVICE per tier. These are NOT
+                    # part of the device-pool partition (a demoted
+                    # block's device id is back on the free list) —
+                    # free+owned+shared+cached still sums to usable
+                    # exactly, and host/spilled must reconcile with
+                    # the kv_tiers stats block below.
+                    'host': (tier_stats['host_blocks']
+                             if tier_stats else 0),
+                    'spilled': (tier_stats['spilled_blocks']
+                                if tier_stats else 0),
                     'cow_forks': self.cow_forks}),
+                'kv_tiers': tier_stats,
                 'queued': queued, 'prefills': self.prefills,
                 'prefill_groups': self.prefill_groups,
                 'prefill_batch': self.prefill_batch,
@@ -1248,9 +1320,11 @@ class ContinuousEngine:
                 r for r in self._slot_req if r is not None] + [
                 r for reqs, _ in self._unfetched for r in reqs] + \
                 list(self._admitting) + [p.req for p in self._prefilling] \
-                + [e.req for e in self._pending_imports]
+                + [e.req for e in self._pending_imports] \
+                + list(self._tier_waiting)
             self._pending.clear()
             self._pending_imports.clear()
+            self._tier_waiting = []
             self._slot_req = [None] * self.slots
             self._unfetched = []
             self._admitting = []
@@ -1411,12 +1485,96 @@ class ContinuousEngine:
     def _alloc_blocks(self, n: int) -> List[int]:
         """Pop ``n`` blocks, refcount-aware-LRU-evicting idle trie
         blocks when the free list runs short. Callers hold the lock and
-        have checked ``_blocks_avail() >= n``."""
+        have checked ``_blocks_avail() >= n``. With hierarchical tiers
+        on, eviction DEMOTES instead of discarding: the chains' KV is
+        gathered off the pool (dispatch only — the tier thread does
+        the device_get) before the freed ids can be rescattered."""
         if len(self._free_blocks) < n and self._trie is not None:
-            freed = self._trie.evict(n - len(self._free_blocks))
-            self.share_evictions += len(freed)
-            self._free_blocks.extend(freed)
+            pairs = self._trie.evict_nodes(n - len(self._free_blocks))
+            self.share_evictions += len(pairs)
+            if self._kv_tiers is not None and pairs:
+                self._demote_evicted(pairs)
+            self._free_blocks.extend(b for b, _ in pairs)
         return [self._free_blocks.pop() for _ in range(n)]
+
+    # skylint: locked(callers of _alloc_blocks hold _lock), engine-thread
+    def _demote_evicted(self, pairs: list) -> None:
+        """Queue just-evicted trie chains for host-tier demotion: ONE
+        pow2-padded ``jit_export_blocks`` gather over the victim
+        blocks, dispatched HERE — before this admission (or any later
+        one) can rescatter the freed ids, so device program order
+        guarantees the gather reads the pre-eviction KV. The device
+        handles go to the tier thread; the engine thread never pays
+        the device_get or the serialization."""
+        from skypilot_tpu.models import paged as paged_lib
+        tiers = self._kv_tiers
+        items = []
+        for blk, node in pairs:
+            if not tiers.accepts(node.chain):
+                continue
+            parts = []
+            cur = node
+            while cur is not None:
+                parts.append(cur.key)
+                cur = cur.parent
+            row = [t for key in reversed(parts) for t in key]
+            items.append((node.chain, row, len(items), blk))
+        if not items:
+            return
+        nbp = 1
+        while nbp < len(items):
+            nbp *= 2
+        tbl = np.zeros((nbp,), np.int32)  # pad -> junk sink block 0
+        tbl[:len(items)] = [blk for _, _, _, blk in items]
+        handles = paged_lib.jit_export_blocks(self._cache, tbl)
+        tiers.offer_demote([(d, row, gi) for d, row, gi, _ in items],
+                           handles)
+
+    # skylint: locked(called from _admit under _lock), engine-thread
+    def _tier_consult(self, row: List[int], nodes: list) -> tuple:
+        """Extend a trie match through the tier index: walk the full
+        blocks past the HBM-resident chain, digesting block-by-block
+        (utils/prefix_affinity.chain_digest — same chain identity the
+        adverts use). Consecutive host-tier hits become the promote
+        list (re-import this admission); the first spilled block
+        switches to a fetch list (disk -> host warm-up); any gap ends
+        the walk — promotion must stay contiguous. The last prompt
+        token is never covered (it must compute the first logits)."""
+        p = self.kv_block
+        tiers = self._kv_tiers
+        promote: list = []
+        fetch: list = []
+        prev = nodes[-1].chain if nodes else None
+        pos = len(nodes) * p
+        limit = len(row) - 1
+        while pos + p <= limit:
+            digest = affinity_lib.chain_digest(prev, row[pos:pos + p])
+            where = tiers.lookup(digest)
+            if where == 'host' and not fetch:
+                promote.append(digest)
+            elif where == 'spilled':
+                fetch.append(digest)
+            else:
+                break
+            prev = digest
+            pos += p
+        return promote, fetch
+
+    def _tier_fetch_done(self, digests: List[bytes], ok: bool) -> None:
+        """Tier-thread callback: a background spill fetch finished
+        (fetched blocks are now host-resident, or quarantined on
+        corruption — either way re-matching converges). Re-queue every
+        parked request at the FRONT of the pending queue, preserving
+        their FIFO seniority over requests that arrived while they
+        waited."""
+        del digests, ok  # re-match consults the index fresh
+        with self._lock:
+            if not self._tier_waiting:
+                return
+            for req in reversed(self._tier_waiting):
+                self._pending.appendleft(req)
+            self._tier_waiting = []
+        self._wake.set()
 
     # skylint: engine-thread
     @staticmethod
@@ -1470,12 +1628,48 @@ class ContinuousEngine:
                 # cannot admit yet (no slot / no blocks) parks the
                 # queue rather than letting younger requests jump it.
                 shared = None
+                parked_on_fetch = False
                 if (self._trie is not None and self._pending
                         and (self._pending[0].max_new > 1
                              or self._pending[0].export)):
                     head = self._pending[0]
                     nodes, partial, plen = self._trie.match(head.row)
-                    if nodes:
+                    # Hierarchical tiers: consult the host/spill index
+                    # BEFORE declaring a miss — a demoted chain
+                    # extending (or replacing) the trie match promotes
+                    # via jit_import_blocks instead of recomputing.
+                    promote: list = []
+                    fetch: list = []
+                    if self._kv_tiers is not None:
+                        promote, fetch = self._tier_consult(head.row,
+                                                            nodes)
+                        if fetch and not nodes and not promote \
+                                and head.tier_parks < 2:
+                            # Whole chain cold on disk: park THIS
+                            # request on a bounded background fetch
+                            # (younger requests keep admitting, like a
+                            # queued disagg import); completion
+                            # re-queues it at the head. Saturation or
+                            # repeated parks degrade to a plain miss —
+                            # recompute, never a stall.
+                            if self._kv_tiers.request_fetch(
+                                    fetch, self._tier_fetch_done):
+                                head.tier_parks += 1
+                                self._pending.popleft()
+                                self._tier_waiting.append(head)
+                                parked_on_fetch = True
+                        elif fetch:
+                            # Partial warmth: admit with what is HBM/
+                            # host-resident now and warm the spilled
+                            # tail in the background for next time.
+                            self._kv_tiers.request_fetch(
+                                fetch, self._tier_fetch_done)
+                    if promote:
+                        # The promoted chain covers >= one full block
+                        # past the trie match — strictly more than any
+                        # partial-tail fork donor could.
+                        partial, plen = None, 0
+                    if not parked_on_fetch and (nodes or promote):
                         free_s = [i for i, r in enumerate(self._slot_req)
                                   if r is None]
                         pk = sum(1 for e in self._prefilling if e.parked)
@@ -1521,8 +1715,17 @@ class ContinuousEngine:
                         self._slot_blocks[slot] = list(owned)
                         self._slot_shared[slot] = list(nodes)
                         self._admitting = [head]
+                        # Claim the host-tier entries LAST (validated
+                        # + popped): a backpressure return above must
+                        # not have consumed them. Truncation on a
+                        # corrupt entry only shrinks the covered head
+                        # — the extra owned blocks serve the tail.
+                        pro = (self._kv_tiers.take_for_promote(promote)
+                               if promote else [])
                         shared = (head, slot, nodes, partial, plen,
-                                  owned)
+                                  owned, pro)
+                if parked_on_fetch:
+                    continue
                 if shared is None:
                     free = [i for i, r in enumerate(self._slot_req)
                             if r is None]
@@ -1591,13 +1794,18 @@ class ContinuousEngine:
 
     # skylint: engine-thread
     def _admit_shared(self, req: _Request, slot: int, nodes: list,
-                      partial, plen: int, owned: List[int]) -> None:
+                      partial, plen: int, owned: List[int],
+                      pro: Optional[list] = None) -> None:
         """Admit ONE block-share hit: the table head points at the
         shared blocks (incref'd by _admit), a partially matched tail
         block is copy-on-write-forked into the first owned block, and
         only the unshared tail prefills — directly over the pool
         (models/paged.py jit_prefill_shared), no dense scratch row and
-        no insert copy."""
+        no insert copy. ``pro`` carries host-tier promote payloads
+        (serve/kv_tiers.py, validated plane arrays, one per block):
+        they scatter into the leading owned blocks via
+        ``jit_import_blocks`` — a re-import instead of a recompute —
+        and then commit into the trie like any other prompt block."""
         from skypilot_tpu.models import paged as paged_lib
         t0 = time.perf_counter()
         # skylint: locked(engine thread is the sole slot-table mutator;
@@ -1606,11 +1814,46 @@ class ContinuousEngine:
                          for r in self._slot_req)
         p = self.kv_block
         row = req.row
-        covered = len(nodes) * p + plen
+        pro = pro or []
+        covered = (len(nodes) + len(pro)) * p + plen
         mb = self.max_len // p
         table = np.zeros((mb,), np.int32)
         table[:len(nodes)] = [nd.block for nd in nodes]
         table[len(nodes):len(nodes) + len(owned)] = owned
+        if pro:
+            # Promote: scatter the demoted chain's planes into the
+            # first len(pro) owned blocks and install table+covered
+            # length in the same dispatch (the disagg-import program —
+            # jit_prefill_shared below overwrites both with the final
+            # values). Pow2-padded to the junk sink, like every block
+            # mover.
+            tw0 = time.time()
+            nbp = 1
+            while nbp < len(pro):
+                nbp *= 2
+            blocks = np.zeros((nbp,), np.int32)
+            blocks[:len(pro)] = owned[:len(pro)]
+            cfg = self.cfg
+            shp = (cfg.n_layers, nbp, cfg.n_kv_heads, p, cfg.head_dim)
+            kdt = self._cache.k.dtype
+            k_pad = np.zeros(shp, dtype=kdt)
+            v_pad = np.zeros(shp, dtype=kdt)
+            for j, planes in enumerate(pro):
+                k_pad[:, j] = planes['k']
+                v_pad[:, j] = planes['v']
+            ks_pad = vs_pad = None
+            if self.kv_quantize:
+                ks_pad = np.zeros(shp[:-1], np.float32)
+                vs_pad = np.zeros(shp[:-1], np.float32)
+                for j, planes in enumerate(pro):
+                    ks_pad[:, j] = planes['k_s']
+                    vs_pad[:, j] = planes['v_s']
+            self._cache = paged_lib.jit_import_blocks(
+                self._cache, k_pad, v_pad, ks_pad, vs_pad, blocks,
+                table, np.int32(slot), np.int32(covered))
+            trace_lib.add_span('serve.kv_promote', tw0, time.time(),
+                               blocks=len(pro),
+                               tokens=len(pro) * p)
         if partial is not None:
             # First append past the shared partial block forks it: copy
             # the donor into our first owned block; the tail prefill

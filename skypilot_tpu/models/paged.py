@@ -573,17 +573,26 @@ class BlockTrie:
         """Reclaim >= n blocks from the idle LRU (may free more: a
         popped node's unreachable idle descendants free with it).
         Returns the freed block ids."""
-        freed: List[int] = []
+        return [b for b, _ in self.evict_nodes(n)]
+
+    def evict_nodes(self, n: int) -> List[Tuple[int, _TrieNode]]:
+        """Like :meth:`evict` but returns ``(block, node)`` pairs.
+        Detached nodes keep ``key``/``parent``/``chain``, so a tiering
+        layer (serve/kv_tiers.py) can rebuild each evicted chain's
+        token row by walking parents root-ward and DEMOTE the block's
+        KV instead of discarding it — the caller must capture (gather)
+        the blocks before the freed ids are rescattered."""
+        freed: List[Tuple[int, _TrieNode]] = []
         while self.idle and len(freed) < n:
             node, _ = self.idle.popitem(last=False)
             freed.extend(self._detach(node))
         return freed
 
-    def _detach(self, node: _TrieNode) -> List[int]:
+    def _detach(self, node: _TrieNode) -> List[Tuple[int, _TrieNode]]:
         kids = (node.parent.children if node.parent is not None
                 else self.children)
         kids.pop(node.key, None)
-        freed = [node.block]
+        freed = [(node.block, node)]
         stack = list(node.children.values())
         node.children = {}
         while stack:
@@ -593,7 +602,7 @@ class BlockTrie:
                 # Reachable refs-0 nodes are in the idle LRU by
                 # construction; unreachable ones free with the subtree.
                 self.idle.pop(ch, None)
-                freed.append(ch.block)
+                freed.append((ch.block, ch))
             else:
                 ch.detached = True  # frees at its final release()
         return freed

@@ -197,7 +197,7 @@ class PrefixAffinityPolicy(LeastLoadPolicy):
             loads = {r: self._load(r) for r in self.replicas}
             low = min(loads.values())
             hashes_by_block: Dict[int, List[str]] = {}
-            best = None          # (depth, -load, resident, endpoint)
+            best = None    # (depth, -tier, -load, resident, endpoint)
             best_depth = 0       # deepest match seen, routed or not
             for r in sorted(self.replicas):
                 info = self._summaries.get(r)
@@ -217,12 +217,18 @@ class PrefixAffinityPolicy(LeastLoadPolicy):
                 credit = min(self._weight * depth, self._max_detour)
                 if loads[r] - low > credit:
                     continue  # saturated: the hot box must spill
-                key = (depth, -loads[r], info['resident'], r)
+                # Memory-tier preference (serve/kv_tiers.py): at equal
+                # depth, HBM-resident (tier 0) beats host DRAM (1)
+                # beats bucket-spilled (2) — a promote is cheaper than
+                # a disk fetch but both beat recompute, so depth stays
+                # the primary key.
+                tier = info.get('tiers', {}).get(hashes[depth - 1], 0)
+                key = (depth, -tier, -loads[r], info['resident'], r)
                 if best is None or key > best:
                     best = key
             if best is None:
                 return None, best_depth
-            return best[3], best[0]
+            return best[4], best[0]
 
 
 class InstanceAwareLeastLoadPolicy(LeastLoadPolicy):

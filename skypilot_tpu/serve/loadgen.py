@@ -104,6 +104,45 @@ def aggregate_prefix_healths(bodies: dict) -> dict:
             'per_replica': per}
 
 
+def aggregate_tier_healths(bodies: dict) -> dict:
+    """FLEET-wide hierarchical-KV tier stats from per-replica /health
+    bodies ({endpoint: body}). Reports where this run's prefix
+    re-visits were served from: the HBM trie (prefix_share hits), the
+    host-DRAM pool (host_hits) or a spill-segment reload (spill_hits)
+    — the per-tier hit rates the serving doc's capacity planning reads
+    — plus the demote/promote/corrupt counters. Replicas without the
+    tier ladder (disabled or older) are skipped. Pure so the
+    aggregation is unit-testable without HTTP."""
+    per = {}
+    tot = {'hbm_hits': 0, 'host_hits': 0, 'spill_hits': 0,
+           'demotes': 0, 'promotes': 0, 'spills': 0, 'reloads': 0,
+           'corrupt': 0, 'host_blocks': 0, 'spilled_blocks': 0}
+    for ep, body in sorted((bodies or {}).items()):
+        eng = (body or {}).get('engine') or {}
+        tiers = eng.get('kv_tiers')
+        if not isinstance(tiers, dict) or not tiers.get('enabled'):
+            continue
+        share = eng.get('prefix_share') or {}
+        row = {'hbm_hits': int(share.get('hits') or 0)}
+        for k in ('host_hits', 'spill_hits', 'demotes', 'promotes',
+                  'spills', 'reloads', 'corrupt', 'host_blocks',
+                  'spilled_blocks'):
+            row[k] = int(tiers.get(k) or 0)
+        per[ep] = row
+        for k, v in row.items():
+            tot[k] += v
+    hits = tot['hbm_hits'] + tot['host_hits'] + tot['spill_hits']
+    return {
+        'replicas': len(per), **tot,
+        'tier_hit_rates': {
+            'hbm': round(tot['hbm_hits'] / max(hits, 1), 4),
+            'host': round(tot['host_hits'] / max(hits, 1), 4),
+            'spilled': round(tot['spill_hits'] / max(hits, 1), 4),
+        },
+        'per_replica': per,
+    }
+
+
 def fleet_window_delta(before: dict, after: dict) -> dict:
     """This run's fleet counter deltas from two ``fleet_prefix_stats``
     snapshots. Per-replica, over the INTERSECTION of replicas that
@@ -426,6 +465,7 @@ async def run_load(url: str, requests_total: int, concurrency: int,
                    stream: bool = False, mix=None, tenants: int = 1,
                    shared_prefix: float = 0.0,
                    shared_prefix_len: int = 32,
+                   prefix_cardinality: int = 0,
                    long_prompt_frac: float = 0.0,
                    long_prompt_len: int = 512,
                    dump_on_error: str = '',
@@ -456,15 +496,25 @@ async def run_load(url: str, requests_total: int, concurrency: int,
     if not 0.0 <= shared_prefix <= 1.0:
         raise ValueError(f'--shared-prefix must be in [0, 1], '
                          f'got {shared_prefix}')
+    if prefix_cardinality < 0:
+        raise ValueError(f'--prefix-cardinality must be >= 0, '
+                         f'got {prefix_cardinality}')
     shared_flags = None
     if shared_prefix > 0:
         picks = mix_classes(
             f'shared:{shared_prefix},unique:{1.0 - shared_prefix}',
             requests_total)
         shared_flags = [p == 'shared' for p in picks]
+        # --prefix-cardinality N: spread the shared sub-mix over N
+        # DISTINCT prefix heads instead of one per tenant. Size N past
+        # the replica's device block pool and the working set no
+        # longer fits in HBM — the traffic shape that exercises the
+        # hierarchical KV tiers (demote to host, spill, re-import on
+        # re-visit) rather than pure trie hits.
+        n_prefixes = prefix_cardinality or max(tenants, 1)
         prefixes = [shared_prefix_tokens(tenant_offset + t,
                                          shared_prefix_len, vocab)
-                    for t in range(max(tenants, 1))]
+                    for t in range(n_prefixes)]
     # --long-prompt-frac FRAC: that fraction of requests (deterministic
     # weighted round-robin) carries a LONG prompt of --long-prompt-len
     # tokens — the prefill-heavy mixed load that exposes the
@@ -492,7 +542,7 @@ async def run_load(url: str, requests_total: int, concurrency: int,
                           if tenants > 1 else None)
                 prefix = None
                 if shared_flags is not None and shared_flags[i]:
-                    prefix = prefixes[i % max(tenants, 1)]
+                    prefix = prefixes[i % len(prefixes)]
                 is_long = bool(long_flags and long_flags[i])
                 r = await _one(
                     session, url, prompt_span, max_new_span, vocab,
@@ -521,12 +571,13 @@ async def run_load(url: str, requests_total: int, concurrency: int,
         wall = time.perf_counter() - t0
         wall_t1 = time.time()
 
-        fleet_after = prof_after = None
+        fleet_after = prof_after = tiers_after = None
         if fleet_endpoints:
             bodies = await _fetch_healths(session, fleet_endpoints)
             prof_after = aggregate_profile_healths(bodies)
             if shared_flags is not None:
                 fleet_after = aggregate_prefix_healths(bodies)
+                tiers_after = aggregate_tier_healths(bodies)
 
         engine_share = None
         if shared_flags is not None:
@@ -540,6 +591,7 @@ async def run_load(url: str, requests_total: int, concurrency: int,
                 engine_share = {
                     'prefix_share': eng.get('prefix_share'),
                     'kv_blocks': eng.get('kv_blocks'),
+                    'kv_tiers': eng.get('kv_tiers'),
                     'prefill_tokens': eng.get('prefill_tokens'),
                     'prefill_tokens_saved':
                         eng.get('prefill_tokens_saved'),
@@ -612,6 +664,15 @@ async def run_load(url: str, requests_total: int, concurrency: int,
             'unique': _grp(False),
             'engine': engine_share,
         }
+        if prefix_cardinality:
+            extra['shared_prefix']['prefix_cardinality'] = \
+                prefix_cardinality
+        if tiers_after is not None and tiers_after['replicas']:
+            # Per-tier serve breakdown for the shared sub-mix: how much
+            # of the re-visit traffic the HBM trie absorbed vs the
+            # host pool vs a spill reload (lifetime counters — the
+            # kvtier probe reads the engine-side deltas directly).
+            extra['shared_prefix']['tiers'] = tiers_after
         if fleet_after is not None:
             # Fleet-wide hit rate next to the per-replica numbers:
             # 'window' is THIS run's counter deltas (what an A/B gate
@@ -757,6 +818,15 @@ def main() -> None:
     parser.add_argument('--shared-prefix-len', type=int, default=32,
                         help='shared head length in tokens (per '
                              'tenant; default 32)')
+    parser.add_argument('--prefix-cardinality', type=int, default=0,
+                        help='spread the shared sub-mix over N '
+                             'distinct prefix heads instead of one '
+                             'per tenant; size N past the replica '
+                             'device block pool to exercise the '
+                             'hierarchical KV tiers (demote to host '
+                             'DRAM, spill, re-import on re-visit) — '
+                             'the report then carries per-tier hit '
+                             'rates from the /health sweep')
     parser.add_argument('--long-prompt-frac', type=float, default=0.0,
                         help='fraction of requests (deterministic '
                              'round-robin) carrying a LONG prompt of '
@@ -812,6 +882,7 @@ def main() -> None:
                                tenants=args.tenants,
                                shared_prefix=args.shared_prefix,
                                shared_prefix_len=args.shared_prefix_len,
+                               prefix_cardinality=args.prefix_cardinality,
                                long_prompt_frac=args.long_prompt_frac,
                                long_prompt_len=args.long_prompt_len,
                                dump_on_error=args.dump_on_error,

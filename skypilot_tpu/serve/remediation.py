@@ -653,13 +653,26 @@ class RemediationEngine:
         into the successor's trie; the two generated tokens are the
         cost of admission. Every leg is
         best-effort per chain — a partially warmed successor is still
-        warmer than a cold one. Returns chains installed."""
+        warmer than a cold one. Tier-tagged adverts (serve/kv_tiers.py:
+        3-element entries, 1 = host DRAM, 2 = spilled) are replayed
+        HBM-first but NOT dropped: the victim resolves its host/spill
+        index too, and its export promotes the chain back through
+        ``jit_import_blocks`` — so a migration carries the long tail,
+        not just the HBM-hot head. Returns chains installed."""
         limit = self.prewarm_chains
         if limit <= 0:
             return 0
-        entries = advert.get('entries') or []
-        digests = [e[0] for e in entries[:limit]
-                   if isinstance(e, (list, tuple)) and e]
+
+        def _tier(e):
+            try:
+                return int(e[2]) if len(e) > 2 else 0
+            except (TypeError, ValueError):
+                return 0
+
+        entries = sorted((e for e in advert.get('entries') or []
+                          if isinstance(e, (list, tuple)) and e),
+                         key=_tier)  # stable: advert order within a tier
+        digests = [e[0] for e in entries[:limit]]
         if not digests:
             return 0
         headers = {}

@@ -912,6 +912,11 @@ function healthCell(h){
       let t = `${kb.used ?? 0}/${kb.usable} blk`;
       if(kb.shared) t += ` shr${kb.shared}`;
       if(kb.cached) t += ` c${kb.cached}`;
+      // Hierarchical tiers: demoted chains living OFF-device — host
+      // DRAM (h) and bucket spill segments (d) — next to the device
+      // partition, e.g. "12/30 blk shr4 c6 h8 d20".
+      if(kb.host) t += ` h${kb.host}`;
+      if(kb.spilled) t += ` d${kb.spilled}`;
       parts.push(t);
     }
     // Block-share hit rate once the trie has seen traffic, e.g.

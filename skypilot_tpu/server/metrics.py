@@ -222,8 +222,29 @@ _REPLICA_PREFILL_BUBBLE = Gauge(
 _REPLICA_KV_BLOCKS = Gauge(
     'skytpu_replica_kv_blocks',
     'Paged KV pool block accounting by state (free | owned | shared | '
-    'cached); the states partition the usable pool exactly.',
+    'cached partition the usable device pool exactly; host and '
+    'spilled count hierarchical-tier blocks living OFF-device in the '
+    'host-DRAM pool and the spill segment store).',
     ['state'], registry=SERVING_REGISTRY)
+# Hierarchical KV memory (serve/kv_tiers.py): demoted prefix chains
+# living in host DRAM or spilled to range-readable segment files, and
+# the promote path that re-imports them instead of recomputing.
+_KV_TIER_HITS = Gauge(
+    'skytpu_kv_tier_hits',
+    'Cumulative admissions served from a KV tier instead of recompute '
+    '(host = promoted straight from the host-DRAM pool; spilled = '
+    'fetched from a spill segment first).',
+    ['tier'], registry=SERVING_REGISTRY)
+_KV_TIER_BYTES = Gauge(
+    'skytpu_kv_tier_bytes',
+    'Serialized KV bytes currently resident per tier (host-DRAM pool '
+    'vs on-disk spill segments).',
+    ['tier'], registry=SERVING_REGISTRY)
+_KV_TIER_PROMOTE_SECONDS = Gauge(
+    'skytpu_kv_tier_promote_seconds',
+    'Cumulative wall-clock spent promoting demoted chains back into '
+    'the device pool (validate + jit_import_blocks scatter).',
+    registry=SERVING_REGISTRY)
 # Disaggregated prefill/decode KV handoff (serve/disagg.py): cumulative
 # per-replica handoff accounting by direction. Gauges mirroring the
 # replica's own counters (restart legitimately resets them).
@@ -747,11 +768,28 @@ def render_serving(engine: Optional[Dict[str, Any]] = None,
         _REPLICA_PREFILL_BUBBLE.set(engine.get('prefill_bubble_ms') or 0)
         kb = engine.get('kv_blocks')
         if isinstance(kb, dict):
-            for state in ('free', 'owned', 'shared', 'cached'):
+            for state in ('free', 'owned', 'shared', 'cached',
+                          'host', 'spilled'):
                 _REPLICA_KV_BLOCKS.labels(state=state).set(
                     kb.get(state) or 0)
         else:
             _REPLICA_KV_BLOCKS.clear()
+        tiers = engine.get('kv_tiers')
+        if isinstance(tiers, dict) and tiers.get('enabled'):
+            _KV_TIER_HITS.labels(tier='host').set(
+                tiers.get('host_hits') or 0)
+            _KV_TIER_HITS.labels(tier='spilled').set(
+                tiers.get('spill_hits') or 0)
+            _KV_TIER_BYTES.labels(tier='host').set(
+                tiers.get('host_bytes') or 0)
+            _KV_TIER_BYTES.labels(tier='spilled').set(
+                tiers.get('spilled_bytes') or 0)
+            _KV_TIER_PROMOTE_SECONDS.set(
+                (tiers.get('promote_ms') or 0) / 1e3)
+        else:
+            _KV_TIER_HITS.clear()
+            _KV_TIER_BYTES.clear()
+            _KV_TIER_PROMOTE_SECONDS.set(0)
     else:
         # Stats unavailable (engine stopping/absent): zero rather than
         # re-render the last live values forever — stale "3 active
@@ -764,6 +802,9 @@ def render_serving(engine: Optional[Dict[str, Any]] = None,
                   _REPLICA_PREFILL_SAVED, _REPLICA_PREFILL_BUBBLE):
             g.set(0)
         _REPLICA_KV_BLOCKS.clear()
+        _KV_TIER_HITS.clear()
+        _KV_TIER_BYTES.clear()
+        _KV_TIER_PROMOTE_SECONDS.set(0)
     if qos:
         for cls, c in (qos.get('classes') or {}).items():
             if isinstance(c, dict):

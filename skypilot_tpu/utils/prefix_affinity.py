@@ -78,13 +78,18 @@ def match_depth(prompt_hashes: Sequence[str],
 
 def parse_summary(summary) -> Optional[Dict[str, object]]:
     """Validate one replica's advertised summary into
-    ``{'block': int, 'hashes': frozenset, 'resident': int}``; None
-    when absent, malformed, or a different SUMMARY_VERSION (see the
-    module docstring on rolling updates). ``hashes`` is a SET: depth
-    is already encoded in the chained digest (a hash at prompt index d
-    IS a depth-d match), so matching is pure membership — the entry
-    depths exist for operators reading the raw advert, not for the
-    matcher."""
+    ``{'block': int, 'hashes': frozenset, 'resident': int,
+    'tiers': dict}``; None when absent, malformed, or a different
+    SUMMARY_VERSION (see the module docstring on rolling updates).
+    ``hashes`` is a SET: depth is already encoded in the chained digest
+    (a hash at prompt index d IS a depth-d match), so matching is pure
+    membership — the entry depths exist for operators reading the raw
+    advert, not for the matcher. Entries may carry an optional third
+    element, the chain's memory TIER (0 = HBM-resident, 1 = host DRAM,
+    2 = spilled to bucket — serve/kv_tiers.py); plain 2-element
+    entries are tier 0, so pre-tiering replicas in a mixed fleet parse
+    unchanged. ``tiers`` maps hex -> tier for the LB's HBM > host >
+    bucket preference and is empty when every entry is HBM."""
     if not isinstance(summary, dict):
         return None
     if summary.get('v') != SUMMARY_VERSION:
@@ -96,13 +101,21 @@ def parse_summary(summary) -> Optional[Dict[str, object]]:
     if block <= 0:
         return None
     hashes = set()
+    tiers: Dict[str, int] = {}
     for entry in summary.get('entries') or []:
         try:
             h, d = entry[0], int(entry[1])
         except (TypeError, ValueError, IndexError, KeyError):
             continue
-        if isinstance(h, str) and h and d > 0:
-            hashes.add(h)
+        if not (isinstance(h, str) and h and d > 0):
+            continue
+        hashes.add(h)
+        try:
+            tier = int(entry[2]) if len(entry) > 2 else 0
+        except (TypeError, ValueError):
+            tier = 0
+        if tier > 0:
+            tiers[h] = tier
     if not hashes:
         return None
     try:
@@ -110,7 +123,7 @@ def parse_summary(summary) -> Optional[Dict[str, object]]:
     except (TypeError, ValueError):
         resident = 0
     return {'block': block, 'hashes': frozenset(hashes),
-            'resident': resident}
+            'resident': resident, 'tiers': tiers}
 
 
 def parse_summaries(summaries) -> Dict[str, Dict[str, object]]:

@@ -61,8 +61,14 @@ def _mixed_rows(n=12, shared_frac=0.75, tail=8):
 
 def _drained(eng):
     """Block states after a full drain: nothing owned or referenced,
-    free + cached == usable."""
-    kb = eng.stats()['kv_blocks']
+    free + cached == usable — and the hierarchical-tier counts (host /
+    spilled, OFF-device by contract) must reconcile exactly with the
+    kv_tiers stats block, never leak into the device partition."""
+    st = eng.stats()
+    kb = st['kv_blocks']
+    tiers = st.get('kv_tiers') or {}
+    assert kb['host'] == (tiers.get('host_blocks') or 0), st
+    assert kb['spilled'] == (tiers.get('spilled_blocks') or 0), st
     return (kb['owned'] == 0 and kb['shared'] == 0
             and kb['free'] + kb['cached'] == kb['usable'])
 
@@ -305,7 +311,7 @@ def test_stats_surface_share_counters(tiny):
         st = eng.stats()
         kb = st['kv_blocks']
         for key in ('free', 'usable', 'used', 'owned', 'shared',
-                    'cached', 'cow_forks'):
+                    'cached', 'host', 'spilled', 'cow_forks'):
             assert key in kb, kb
         ps = st['prefix_share']
         for key in ('enabled', 'hits', 'misses', 'hit_rate',

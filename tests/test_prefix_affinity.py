@@ -61,7 +61,17 @@ def test_parse_summary_rejects_garbage_and_version_skew():
             'resident': 7, 'entries': [['ab', 1], ['cd', 2]]}
     info = prefix_affinity.parse_summary(good)
     assert info == {'block': 4, 'hashes': frozenset({'ab', 'cd'}),
-                    'resident': 7}
+                    'resident': 7, 'tiers': {}}
+    # Tier-tagged 3-element entries (hierarchical KV adverts) parse
+    # alongside plain 2-element ones — mixed-fleet compatible; only
+    # off-HBM tiers (tier > 0) land in the tiers map.
+    tiered = prefix_affinity.parse_summary(
+        {'v': prefix_affinity.SUMMARY_VERSION, 'block': 4,
+         'resident': 7,
+         'entries': [['ab', 1], ['cd', 2, 1], ['ef', 3, 2],
+                     ['gh', 4, 0]]})
+    assert tiered['hashes'] == frozenset({'ab', 'cd', 'ef', 'gh'})
+    assert tiered['tiers'] == {'cd': 1, 'ef': 2}
     # The batch form parses once for the LB's fan-out.
     assert prefix_affinity.parse_summaries(
         {'a:1': good, 'b:1': {'v': 99}}) == {'a:1': info}
@@ -395,6 +405,41 @@ def test_loadgen_fleet_aggregation_sums_before_dividing():
     assert out['prefill_tokens_saved'] == 900
     empty = aggregate_prefix_healths({})
     assert empty['replicas'] == 0 and empty['hit_rate'] == 0.0
+
+
+def test_loadgen_tier_aggregation_per_tier_hit_rates():
+    """Per-tier serve rates sum counters across replicas (HBM trie
+    hits + host pool hits + spill reload hits form the denominator);
+    a replica without the tier ladder drops out entirely."""
+    from skypilot_tpu.serve.loadgen import aggregate_tier_healths
+    bodies = {
+        'a:1': {'engine': {
+            'prefix_share': {'hits': 6, 'misses': 4},
+            'kv_tiers': {'enabled': True, 'host_hits': 3,
+                         'spill_hits': 1, 'demotes': 5, 'promotes': 4,
+                         'spills': 2, 'reloads': 1, 'corrupt': 0,
+                         'host_blocks': 7, 'spilled_blocks': 2}}},
+        'b:1': {'engine': {
+            'prefix_share': {'hits': 4, 'misses': 6},
+            'kv_tiers': {'enabled': True, 'host_hits': 5,
+                         'spill_hits': 1, 'demotes': 8, 'promotes': 6,
+                         'spills': 3, 'reloads': 1, 'corrupt': 1,
+                         'host_blocks': 4, 'spilled_blocks': 5}}},
+        'old:1': {'engine': {'prefix_share': {'hits': 99, 'misses': 0},
+                             'kv_tiers': {'enabled': False}}},
+        'dead': {},
+    }
+    out = aggregate_tier_healths(bodies)
+    assert out['replicas'] == 2
+    # 10 hbm + 8 host + 2 spilled = 20 tier-attributed serves.
+    assert out['tier_hit_rates'] == {'hbm': 0.5, 'host': 0.4,
+                                     'spilled': 0.1}
+    assert out['corrupt'] == 1 and out['spills'] == 5
+    assert out['host_blocks'] == 11 and out['spilled_blocks'] == 7
+    assert 'old:1' not in out['per_replica']
+    empty = aggregate_tier_healths({})
+    assert empty['replicas'] == 0
+    assert empty['tier_hit_rates']['hbm'] == 0.0
 
 
 def test_loadgen_window_delta_survives_timeouts_and_restarts():

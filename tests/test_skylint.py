@@ -1051,6 +1051,105 @@ def test_resource_pair_tmpfile_builtin_and_cleanup(tmp_path):
     assert "'tmpfile'" in findings[0].message
 
 
+def test_resource_pair_kv_tier_leaked_host_entry(tmp_path):
+    """The hierarchical-KV demote/promote lifecycle (ISSUE 20) is a
+    declared resource pair: a host-pool entry acquired (insert) but
+    neither released (pop), transferred (spill), nor hatch-annotated
+    on an exception edge is a lint finding — while the transfer def
+    itself and a reasoned allow-leak are clean."""
+    root = _tree(tmp_path, tiers='''
+        class Tiers:
+            # skylint: resource-pair=kv_tier.acquire
+            def insert_entry(self, entry):
+                return entry
+
+            # skylint: resource-pair=kv_tier.release
+            def pop_entry(self, entry):
+                del entry
+
+            # skylint: resource-pair=kv_tier.transfer
+            def spill_entries(self, batch):
+                del batch
+
+            def leaky_demote(self, entry):
+                self.insert_entry(entry)
+                self.fallible()  # exception edge: the entry leaks
+
+            def ok_released(self, entry):
+                self.insert_entry(entry)
+                try:
+                    self.fallible()
+                finally:
+                    self.pop_entry(entry)
+
+            def ok_hatched(self, entry):
+                # skylint: allow-leak(fixture: ownership parks in the
+                # pool's own LRU)
+                self.insert_entry(entry)
+                self.fallible()
+
+            def fallible(self):
+                raise ValueError('boom')
+        ''')
+    findings = concurrency.ResourcePair().check_tree([], root)
+    msgs = [f.message for f in findings]
+    assert any("'kv_tier'" in m and 'leaky_demote' in m
+               for m in msgs), msgs
+    assert all('ok_released' not in m for m in msgs), msgs
+    assert all('ok_hatched' not in m for m in msgs), msgs
+    assert all('spill_entries' not in m for m in msgs), msgs
+
+
+def test_resource_pair_kv_tier_acquire_without_release_anywhere(
+        tmp_path):
+    """A kv_tier acquire with no release/transfer in the whole tree is
+    a pair-declaration finding (a leak by construction)."""
+    root = _tree(tmp_path, tiers='''
+        class Tiers:
+            # skylint: resource-pair=kv_tier.acquire
+            def insert_entry(self, entry):
+                return entry
+        ''')
+    findings = concurrency.ResourcePair().check_tree([], root)
+    assert any("'kv_tier'" in f.message
+               and 'no release/transfer' in f.message
+               for f in findings), [f.message for f in findings]
+
+
+def test_hatches_audit_ledger_and_reasonless_failure(tmp_path, capsys):
+    """``skylint --hatches`` enumerates every allow-* suppression with
+    its reason (the reviewable ledger) and exits nonzero when any
+    hatch lacks one."""
+    root = _tree(tmp_path, mod='''
+        import time
+
+        def documented():
+            time.sleep(1)  # skylint: allow-block(fixture: documented)
+
+        def silent():
+            time.sleep(1)  # skylint: allow-block()
+        ''')
+    rc = cli_mod._audit_hatches(root, 'text')
+    out = capsys.readouterr().out
+    assert rc == 1, out
+    assert 'fixture: documented' in out
+    assert '1 without a reason' in out
+    # JSON surface carries the same ledger for CI annotation.
+    rc = cli_mod._audit_hatches(root, 'json')
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1 and payload['reasonless'] == 1
+    assert len(payload['hatches']) == 2
+    # A fully reasoned tree passes.
+    root2 = _tree(tmp_path / 'ok', mod='''
+        import time
+
+        def documented():
+            time.sleep(1)  # skylint: allow-block(fixture: documented)
+        ''')
+    assert cli_mod._audit_hatches(root2, 'text') == 0
+    assert 'without a reason' in capsys.readouterr().out
+
+
 def test_resource_pair_name_typo_did_you_mean(tmp_path):
     root = _tree(tmp_path, pool='''
         class Pool:

@@ -3055,6 +3055,15 @@ def main():
                           **bench.prefix_share_probe(assert_gates=True)}),
               flush=True)
         return
+    if '--kvtier' in sys.argv:
+        # CPU-only by design (same rationale as --smoke): never touch
+        # or wait on a chip in CI.
+        jax.config.update('jax_platforms', 'cpu')
+        import bench
+        print(json.dumps({'kvtier_smoke': 'ok',
+                          **bench.kvtier_probe(assert_gates=True)}),
+              flush=True)
+        return
     if '--qos' in sys.argv:
         # CPU-only by design (same rationale as --smoke): never touch
         # or wait on a chip in CI.

@@ -14,6 +14,11 @@
 ``--graph-stats``                   call-graph resolution stats — the
                                     explicit unresolved-call soundness
                                     gap, made visible
+``--hatches``                       audit every ``allow-*`` suppression
+                                    hatch in the tree (name, site,
+                                    reason) — the reviewable ledger of
+                                    what the linter was told to ignore;
+                                    exits nonzero on a reasonless hatch
 """
 from __future__ import annotations
 
@@ -66,6 +71,41 @@ def _emit_json(findings, nfiles: int) -> None:
                      indent=1, sort_keys=True))
 
 
+def _audit_hatches(root: pathlib.Path, fmt: str) -> int:
+    """Enumerate every ``allow-*`` suppression directive in the tree —
+    the reviewable ledger of what the linter was told to ignore. Each
+    hatch prints as ``path:line: [name] reason``; a hatch with no
+    reason is itself a defect (base.py's directive hygiene also flags
+    it) and makes the audit exit nonzero, so a drive-by
+    ``allow-leak()`` cannot slip a silent suppression past review."""
+    hatches = []
+    bad = 0
+    for sf in skylint.load_files(None, root):
+        for line in sorted(sf.directives):
+            for d in sf.directives[line]:
+                if not d.name.startswith('allow-'):
+                    continue
+                hatches.append((sf.rel, line, d.name, d.arg))
+                if not d.arg:
+                    bad += 1
+    if fmt == 'json':
+        print(json.dumps({'hatches': [
+            {'path': rel, 'line': line, 'name': name, 'reason': reason}
+            for rel, line, name, reason in hatches],
+            'reasonless': bad}, indent=1, sort_keys=True))
+        return 1 if bad else 0
+    for rel, line, name, reason in hatches:
+        print(f'{rel}:{line}: [{name}] {reason or "<NO REASON>"}')
+    by_name: dict = {}
+    for _rel, _line, name, _reason in hatches:
+        by_name[name] = by_name.get(name, 0) + 1
+    summary = ', '.join(f'{n} {name}'
+                        for name, n in sorted(by_name.items()))
+    print(f'skylint: {len(hatches)} hatch(es) ({summary or "none"}); '
+          f'{bad} without a reason')
+    return 1 if bad else 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog='skylint', description=skylint.__doc__.splitlines()[0])
@@ -84,6 +124,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help='print call-graph resolution stats '
                              '(incl. the unresolved-call categories) '
                              'and exit')
+    parser.add_argument('--hatches', action='store_true',
+                        help='list every allow-* suppression hatch in '
+                             'the tree with its reason and exit '
+                             '(nonzero when any hatch lacks one)')
     args = parser.parse_args(argv)
     if args.list_checkers:
         for checker in skylint.all_checkers():
@@ -93,6 +137,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f'{checker.name}: {doc[0] if doc else ""}')
         return 0
     root = skylint.ROOT
+    if args.hatches:
+        return _audit_hatches(root, args.format)
     if args.graph_stats:
         from skylint import callgraph
         graph = callgraph.get_graph([], root)
